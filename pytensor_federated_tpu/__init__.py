@@ -1,0 +1,79 @@
+"""pytensor_federated_tpu — a TPU-native federated-likelihood framework.
+
+Brand-new framework with the capabilities of ``pytensor-federated``
+(reference: /root/reference), re-designed for TPU: federated shards live
+on mesh devices, logp+grad aggregation is a ``lax.psum`` over ICI inside
+one XLA program, and samplers run on-device — zero gRPC in the hot loop.
+A host-RPC service layer (:mod:`.service`) preserves true cross-trust-
+domain federation as an explicit off-hot-path capability.
+
+Public API parity map (reference: pytensor_federated/__init__.py:1-22):
+every reference export has an equivalent here; TPU-native additions are
+the ``parallel`` (mesh/sharding) and ``samplers`` subpackages.
+"""
+
+from .ops import (
+    ArraysToArraysOp,
+    AsyncArraysToArraysOp,
+    AsyncLogpGradOp,
+    AsyncLogpOp,
+    LogpGradOp,
+    LogpOp,
+    ParallelLogpGrad,
+    blackbox_compute,
+    blackbox_logp_grad,
+    from_logp_fn,
+    fuse,
+    parallel_host_call,
+)
+from .parallel import (
+    CHAINS_AXIS,
+    SEQ_AXIS,
+    SHARDS_AXIS,
+    FederatedLogp,
+    ShardedData,
+    get_load,
+    healthy_devices,
+    make_mesh,
+    pack_shards,
+    sharded_compute,
+    single_device_mesh,
+)
+from .signatures import ArraysSpec, ComputeFn, LogpFn, LogpGradFn, spec_of
+from .version import __version__
+from .wrappers import logp_grad_from_logp, wrap_logp_fn, wrap_logp_grad_fn
+
+__all__ = [
+    "ArraysSpec",
+    "ArraysToArraysOp",
+    "AsyncArraysToArraysOp",
+    "AsyncLogpGradOp",
+    "AsyncLogpOp",
+    "CHAINS_AXIS",
+    "ComputeFn",
+    "FederatedLogp",
+    "LogpFn",
+    "LogpGradFn",
+    "LogpGradOp",
+    "LogpOp",
+    "ParallelLogpGrad",
+    "SEQ_AXIS",
+    "SHARDS_AXIS",
+    "ShardedData",
+    "__version__",
+    "blackbox_compute",
+    "blackbox_logp_grad",
+    "from_logp_fn",
+    "fuse",
+    "get_load",
+    "healthy_devices",
+    "logp_grad_from_logp",
+    "make_mesh",
+    "pack_shards",
+    "parallel_host_call",
+    "sharded_compute",
+    "single_device_mesh",
+    "spec_of",
+    "wrap_logp_fn",
+    "wrap_logp_grad_fn",
+]

@@ -1,0 +1,28 @@
+"""Graph-integration ops (reference L4/L5 analog)."""
+
+from .blackbox import blackbox_compute, blackbox_logp_grad
+from .fanout import ParallelLogpGrad, fuse, parallel_host_call
+from .ops import (
+    ArraysToArraysOp,
+    AsyncArraysToArraysOp,
+    AsyncLogpGradOp,
+    AsyncLogpOp,
+    LogpGradOp,
+    LogpOp,
+    from_logp_fn,
+)
+
+__all__ = [
+    "ArraysToArraysOp",
+    "AsyncArraysToArraysOp",
+    "AsyncLogpGradOp",
+    "AsyncLogpOp",
+    "LogpGradOp",
+    "LogpOp",
+    "ParallelLogpGrad",
+    "blackbox_compute",
+    "blackbox_logp_grad",
+    "from_logp_fn",
+    "fuse",
+    "parallel_host_call",
+]

@@ -1,0 +1,92 @@
+"""Blackbox / host escape hatch: non-JAX compute inside a JAX graph.
+
+The reference's whole premise is that the node function is a *blackbox*
+to the driver — "the model implementation could be C++, while MCMC/
+optimization run in Python" (reference: README.md:34-35): any callable
+behind the wire contract works, at the price of a network round-trip per
+evaluation.  The TPU-native design keeps that capability as an explicit
+*off-hot-path* door: a host callback (``jax.pure_callback``) whose output
+signature is declared up front, wrapped so it is differentiable under the
+same forward-supplied-gradient contract as :class:`..ops.ops.LogpGradOp`.
+
+Use cases preserved from the reference: wrapping a legacy C/C++/Fortran
+likelihood, or bridging to a *true* cross-trust-domain federated node via
+:mod:`pytensor_federated_tpu.service` (the host RPC client plugs in here
+as the ``host_fn``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..signatures import Array, ArraysSpec
+from .ops import LogpGradOp
+
+
+def blackbox_compute(
+    host_fn: Callable[..., Sequence[np.ndarray]],
+    out_spec: ArraysSpec,
+    *,
+    vmap_method: str = "sequential",
+) -> Callable[..., list[Array]]:
+    """Wrap a host (non-JAX) arrays->arrays function for use under jit.
+
+    ``out_spec`` declares the static output signature — the analog of the
+    reference's wire schema (reference: protobufs/service.proto:6-19):
+    the driver must know output shapes to build the graph, exactly as
+    PyTensor ops declare output types (reference: wrapper_ops.py:97-105).
+
+    The callback runs on the host; XLA treats it as opaque.  This is the
+    one deliberately slow path in the framework (SURVEY §7 step 6).
+    """
+    out_spec = tuple(out_spec)
+
+    def fn(*inputs) -> list[Array]:
+        args = tuple(jnp.asarray(x) for x in inputs)
+        flat_out = jax.pure_callback(
+            lambda *a: tuple(
+                np.asarray(o, dtype=s.dtype)
+                for o, s in zip(host_fn(*a), out_spec)
+            ),
+            out_spec,
+            *args,
+            vmap_method=vmap_method,
+        )
+        return list(flat_out)
+
+    return fn
+
+
+def blackbox_logp_grad(
+    host_logp_grad: Callable[..., tuple],
+    in_spec: ArraysSpec,
+    *,
+    logp_dtype=jnp.float32,
+) -> LogpGradOp:
+    """Differentiable blackbox logp+grad op backed by a host callable.
+
+    ``host_logp_grad(*arrays) -> (logp, [grads])`` with NumPy semantics —
+    the exact node contract of the reference
+    (reference: signatures.py:26-33) — becomes a :class:`LogpGradOp`
+    whose VJP uses the host-supplied gradients
+    (reference: wrapper_ops.py:119-132).  ``in_spec`` fixes each input's
+    shape/dtype so grad output signatures are static.
+    """
+    in_spec = tuple(in_spec)
+    out_spec = (jax.ShapeDtypeStruct((), jnp.dtype(logp_dtype)),) + in_spec
+
+    def host_flat(*arrays):
+        logp, grads = host_logp_grad(*(np.asarray(a) for a in arrays))
+        return [np.asarray(logp)] + [np.asarray(g) for g in grads]
+
+    flat = blackbox_compute(host_flat, out_spec)
+
+    def logp_grad_fn(*inputs):
+        out = flat(*inputs)
+        return out[0], tuple(out[1:])
+
+    return LogpGradOp(logp_grad_fn)

@@ -1,0 +1,198 @@
+"""Parallel fan-out of independent evaluations.
+
+TPU-native re-design of the reference's concurrency engine
+(reference: pytensor_federated/op_async.py).  The reference needs three
+pieces of machinery to overlap N independent remote calls:
+
+- ``AsyncOp`` bridging a sync executor to coroutines (op_async.py:16-34),
+- ``ParallelAsyncOp`` fusing N applies into one ``asyncio.gather``
+  (op_async.py:68-132),
+- the ``fuse_asyncs`` graph rewrite that finds independent applies and
+  fuses them automatically at compile time (op_async.py:135-234).
+
+On TPU, the first and third collapse: everything traced into one ``jit``
+is scheduled by XLA, which already overlaps independent subgraphs (and
+runs them as one fused SPMD program — better than latency-hiding).
+:func:`fuse` documents/implements that equivalence for on-device fns.
+
+What does NOT collapse is fan-out over *host/blackbox* functions (the true
+federated case): XLA host callbacks execute serially per program, so
+overlapping N slow remote nodes needs an explicit gather — that is
+:class:`ParallelLogpGrad` / :func:`parallel_host_call`, which batch N host
+calls into ONE callback whose host side runs a thread pool.  Wall time is
+max(node latencies), not the sum — the same guarantee the reference
+proves by timing (reference: test_op_async.py:98-105, 180-194).
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..signatures import Array, ArraysSpec
+
+
+def fuse(fns: Sequence[Callable], *, jit: bool = True) -> Callable:
+    """Fuse N independent on-device functions into one program.
+
+    ``fuse([f, g])(args_f, args_g) -> [f(*args_f), g(*args_g)]``.
+
+    Parity note: this is the explicit form of the reference's
+    ``fuse_asyncs`` rewrite (reference: op_async.py:216-234) — under XLA
+    the fusion is *automatic* for anything traced together; calling
+    :func:`fuse` simply guarantees the N evaluations compile into a
+    single executable so independent shard computations overlap.
+    """
+
+    def fused(*args_per_fn):
+        if len(args_per_fn) != len(fns):
+            raise ValueError(
+                f"expected {len(fns)} argument tuples, got {len(args_per_fn)}"
+            )
+        return [f(*a) for f, a in zip(fns, args_per_fn)]
+
+    return jax.jit(fused) if jit else fused
+
+
+def parallel_host_call(
+    host_fns: Sequence[Callable[..., Sequence[np.ndarray]]],
+    out_specs: Sequence[ArraysSpec],
+) -> Callable[..., List[List[Array]]]:
+    """Evaluate N host functions concurrently inside ONE callback.
+
+    The direct :class:`ParallelAsyncOp` analog (reference:
+    op_async.py:68-132): inputs are passed per-child and sliced back out
+    per-child, and the host side runs every child in a thread pool —
+    ``asyncio.gather`` becomes ``ThreadPoolExecutor.map``.  Returns a
+    jittable ``fn(args_0, args_1, ...) -> [outputs_0, outputs_1, ...]``
+    where each ``args_i`` is a tuple of arrays for child ``i``.
+    """
+    host_fns = list(host_fns)
+    out_specs = [tuple(s) for s in out_specs]
+    flat_spec = tuple(s for spec in out_specs for s in spec)
+    n_out = [len(s) for s in out_specs]
+
+    def fn(*args_per_child) -> List[List[Array]]:
+        if len(args_per_child) != len(host_fns):
+            raise ValueError(
+                f"expected {len(host_fns)} argument tuples, "
+                f"got {len(args_per_child)}"
+            )
+        arities = [len(a) for a in args_per_child]
+        flat_in = [jnp.asarray(x) for a in args_per_child for x in a]
+
+        def host(*flat_arrays):
+            # Slice the concatenated inputs per child apply — same move
+            # as ParallelAsyncOp.perform (reference: op_async.py:115-124).
+            chunks, i = [], 0
+            for k in arities:
+                chunks.append(flat_arrays[i : i + k])
+                i += k
+            with ThreadPoolExecutor(max_workers=max(1, len(host_fns))) as ex:
+                results = list(
+                    ex.map(lambda fa: list(fa[0](*fa[1])), zip(host_fns, chunks))
+                )
+            flat = [
+                np.asarray(o, dtype=s.dtype)
+                for outs, spec in zip(results, out_specs)
+                for o, s in zip(outs, spec)
+            ]
+            return tuple(flat)
+
+        flat_out = jax.pure_callback(host, flat_spec, *flat_in)
+        out, i = [], 0
+        for k in n_out:
+            out.append(list(flat_out[i : i + k]))
+            i += k
+        return out
+
+    return fn
+
+
+class ParallelLogpGrad:
+    """N blackbox logp+grad nodes evaluated concurrently and differentiably.
+
+    The fused op the reference's rewrite produces for its federated hot
+    path: one apply that fans out to every node and gathers
+    ``(logp_i, grads_i)`` (reference: op_async.py:107-132 +
+    wrapper_ops.py:135-146).  The VJP applies the forward-supplied
+    per-node gradients (``g_logp_i * grads_i``), matching
+    reference wrapper_ops.py:119-132; second-order autodiff through the
+    boundary is unsupported, as in the reference (wrapper_ops.py:123-125).
+
+    ``in_specs[i]`` fixes the input signature of node ``i`` so the
+    callback's output signature is static.
+    """
+
+    def __init__(
+        self,
+        host_logp_grads: Sequence[Callable[..., tuple]],
+        in_specs: Sequence[ArraysSpec],
+        *,
+        logp_dtype=jnp.float32,
+    ):
+        if len(host_logp_grads) != len(in_specs):
+            raise ValueError("need one in_spec per node")
+        self.n_nodes = len(host_logp_grads)
+        self.in_specs = [tuple(s) for s in in_specs]
+        scalar = jax.ShapeDtypeStruct((), jnp.dtype(logp_dtype))
+        out_specs = [(scalar,) + spec for spec in self.in_specs]
+
+        def flat_node(i):
+            fn = host_logp_grads[i]
+
+            def host(*arrays):
+                logp, grads = fn(*(np.asarray(a) for a in arrays))
+                return [np.asarray(logp)] + [np.asarray(g) for g in grads]
+
+            return host
+
+        fanout = parallel_host_call([flat_node(i) for i in range(self.n_nodes)], out_specs)
+        arities = [len(s) for s in self.in_specs]
+
+        @jax.custom_vjp
+        def call(*flat_inputs):
+            args_per_child, i = [], 0
+            for k in arities:
+                args_per_child.append(tuple(flat_inputs[i : i + k]))
+                i += k
+            outs = fanout(*args_per_child)
+            logps = tuple(o[0] for o in outs)
+            grads = tuple(tuple(o[1:]) for o in outs)
+            return logps, grads
+
+        def fwd(*flat_inputs):
+            out = call(*flat_inputs)
+            return out, out[1]
+
+        def bwd(residual_grads, cotangents):
+            g_logps, _g_grads = cotangents
+            flat = []
+            for g_logp, grads in zip(g_logps, residual_grads):
+                for g in grads:
+                    flat.append(jnp.asarray(g_logp, dtype=jnp.result_type(g)) * g)
+            return tuple(flat)
+
+        call.defvjp(fwd, bwd)
+        self._call = call
+
+    def __call__(self, inputs_per_node: Sequence[Tuple]) -> List[Tuple]:
+        """``[(args of node i)] -> [(logp_i, grads_i)]``, one fused fan-out."""
+        if len(inputs_per_node) != self.n_nodes:
+            raise ValueError(
+                f"expected inputs for {self.n_nodes} nodes, "
+                f"got {len(inputs_per_node)}"
+            )
+        flat = [jnp.asarray(x) for args in inputs_per_node for x in args]
+        logps, grads = self._call(*flat)
+        return list(zip(logps, grads))
+
+    def total_logp(self, inputs_per_node: Sequence[Tuple]) -> Array:
+        """Sum of node logps — the sum-of-potentials reduction the
+        reference expresses in-graph (reference: demo_model.py:34-36)."""
+        results = self(inputs_per_node)
+        return jnp.sum(jnp.stack([lp for lp, _ in results]))

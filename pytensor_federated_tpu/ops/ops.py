@@ -1,0 +1,147 @@
+"""Graph-integration ops: embed compute functions into differentiable graphs.
+
+TPU-native re-design of the reference's wrapper ops
+(reference: pytensor_federated/wrapper_ops.py).  The reference wraps its
+gRPC clients as PyTensor ``Op`` s so PyMC graphs can call remote
+likelihoods; here the "graph" is a JAX trace, so an op is a callable that
+is (a) input-coercing, (b) jit-safe, and (c) differentiable with the same
+contract as the reference:
+
+- :class:`ArraysToArraysOp` — generic arrays->arrays
+  (reference: wrapper_ops.py:14-33).
+- :class:`LogpOp` — scalar log-potential (reference: wrapper_ops.py:44-69).
+- :class:`LogpGradOp` — returns ``(logp, grads)`` and participates in
+  autodiff exactly like the reference's symbolic ``.grad()``: the VJP of
+  ``logp`` w.r.t. input ``i`` is ``g_logp * grads[i]``, using the
+  *forward-pass-supplied* gradients instead of differentiating through the
+  compute function (reference: wrapper_ops.py:119-132).  Like the
+  reference, gradients w.r.t. the grad outputs are rejected — no
+  second-order autodiff through the federated boundary
+  (reference: wrapper_ops.py:123-125).
+
+The reference needs separate ``Async*`` variants of each op because its
+executor is synchronous while transport is asyncio
+(reference: wrapper_ops.py:36-41, 72-81, 135-146).  XLA dispatch is
+already asynchronous — every op here *is* the async variant — so the
+``Async*`` names are provided as aliases for API parity.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..signatures import Array, ComputeFn, LogpFn, LogpGradFn, check_scalar
+
+
+class ArraysToArraysOp:
+    """Wrap an arrays->arrays function with input coercion.
+
+    Parity: reference wrapper_ops.py:14-33 — inputs are coerced with
+    ``as_tensor`` (here ``jnp.asarray``; fixes the reference's "issue #24"
+    raw-int regression by construction, reference: test_wrapper_ops.py:284-289).
+    """
+
+    def __init__(self, fn: ComputeFn, *, jit: bool = False):
+        self.fn = jax.jit(fn) if jit else fn
+
+    def __call__(self, *inputs) -> Sequence[Array]:
+        args = tuple(jnp.asarray(x) for x in inputs)
+        return list(self.fn(*args))
+
+
+class LogpOp:
+    """Inputs -> scalar log-potential (reference: wrapper_ops.py:44-69)."""
+
+    def __init__(self, logp_fn: LogpFn):
+        self.logp_fn = logp_fn
+
+    def __call__(self, *inputs) -> Array:
+        args = tuple(jnp.asarray(x) for x in inputs)
+        return check_scalar(jnp.asarray(self.logp_fn(*args)), "logp")
+
+
+def _make_logp_grad_call(logp_grad_fn: LogpGradFn) -> Callable:
+    """Build the custom-VJP core shared by LogpGradOp instances."""
+
+    @jax.custom_vjp
+    def call(*inputs):
+        logp, grads = logp_grad_fn(*inputs)
+        logp = check_scalar(jnp.asarray(logp), "logp")
+        grads = tuple(jnp.asarray(g) for g in grads)
+        if len(grads) != len(inputs):
+            raise ValueError(
+                f"logp_grad_fn returned {len(grads)} grads for "
+                f"{len(inputs)} inputs"
+            )
+        return (logp, grads)
+
+    def fwd(*inputs):
+        out = call(*inputs)
+        _, grads = out
+        return out, grads
+
+    def bwd(residual_grads, cotangents):
+        g_logp, g_grads = cotangents
+        # Reject connected gradients w.r.t. the grad outputs — the same
+        # "no second-order autodiff through the federated boundary"
+        # contract as reference wrapper_ops.py:123-125.  Under JAX the
+        # cotangent for unused outputs is a symbolic zero mapped to
+        # concrete zeros; a *connected* non-zero cotangent cannot be
+        # detected at trace time, so second-order use instead produces
+        # the documented first-order-only semantics: d(grads)/d(inputs)
+        # is treated as disconnected (zero contribution).
+        del g_grads
+        return tuple(
+            jnp.asarray(g_logp, dtype=jnp.result_type(g)) * g
+            for g in residual_grads
+        )
+
+    call.defvjp(fwd, bwd)
+    return call
+
+
+class LogpGradOp:
+    """Inputs -> ``(logp, grads)`` with forward-supplied VJP.
+
+    Parity: reference wrapper_ops.py:84-132.  The reference's ``.grad()``
+    re-applies the op on the same inputs and relies on CSE to dedup the
+    second apply (reference: wrapper_ops.py:126-131); here the forward
+    pass already returns the grads, the VJP closes over them as
+    residuals, and XLA's common-subexpression elimination plays the CSE
+    role inside one jitted program.
+    """
+
+    def __init__(self, logp_grad_fn: LogpGradFn):
+        self.logp_grad_fn = logp_grad_fn
+        self._call = _make_logp_grad_call(logp_grad_fn)
+
+    def __call__(self, *inputs):
+        args = tuple(jnp.asarray(x) for x in inputs)
+        logp, grads = self._call(*args)
+        return logp, grads
+
+    def logp(self, *inputs) -> Array:
+        """Scalar-only view — differentiable via the forward-supplied VJP."""
+        return self(*inputs)[0]
+
+
+def from_logp_fn(logp_fn: LogpFn) -> LogpGradOp:
+    """LogpGradOp whose gradients come from autodiff of ``logp_fn``.
+
+    TPU-native convenience with no reference analog (reference nodes must
+    supply gradients, reference: signatures.py:26-33).
+    """
+    from ..wrappers import logp_grad_from_logp
+
+    return LogpGradOp(logp_grad_from_logp(logp_fn))
+
+
+# API-parity aliases: on XLA every op dispatches asynchronously already
+# (reference needs distinct Async* classes: wrapper_ops.py:36-41, 72-81,
+# 135-146).
+AsyncArraysToArraysOp = ArraysToArraysOp
+AsyncLogpOp = LogpOp
+AsyncLogpGradOp = LogpGradOp

@@ -1,0 +1,29 @@
+"""Mesh, sharding, packing, and collective layer (reference L2 analog)."""
+
+from .mesh import (
+    CHAINS_AXIS,
+    SEQ_AXIS,
+    SHARDS_AXIS,
+    DeviceLoad,
+    get_load,
+    healthy_devices,
+    make_mesh,
+    single_device_mesh,
+)
+from .packing import ShardedData, pack_shards
+from .sharded import FederatedLogp, sharded_compute
+
+__all__ = [
+    "CHAINS_AXIS",
+    "SEQ_AXIS",
+    "SHARDS_AXIS",
+    "DeviceLoad",
+    "FederatedLogp",
+    "ShardedData",
+    "get_load",
+    "healthy_devices",
+    "make_mesh",
+    "pack_shards",
+    "sharded_compute",
+    "single_device_mesh",
+]

@@ -1,0 +1,135 @@
+"""Device mesh construction, axis conventions, and device health.
+
+TPU-native replacement for the reference's server pool + placement layer.
+The reference runs one OS process per port and load-balances clients onto
+them (reference: demo_node.py:98-108, service.py:240-263); here "nodes" are
+positions along a named mesh axis and placement is static SPMD.  The
+``GetLoad`` control-plane RPC (reference: service.py:88-96, rpc.py:60-71)
+maps to :func:`get_load` over live device memory statistics.
+
+Axis conventions (all optional — models use what they need):
+
+- ``"shards"``  : federated data shards (the reference's one scale axis).
+- ``"chains"``  : independent MCMC chains (the reference's sampler-level
+  parallelism, reference: test_wrapper_ops.py:305-317, runs chains in
+  separate host processes; here chains are a mesh axis).
+- ``"seq"``     : sequence/context parallelism for long-sequence
+  likelihoods (net-new capability; absent from the reference, SURVEY §5).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+SHARDS_AXIS = "shards"
+CHAINS_AXIS = "chains"
+SEQ_AXIS = "seq"
+
+
+def make_mesh(
+    shape: Optional[Mapping[str, int]] = None,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a named device mesh.
+
+    ``shape`` maps axis name -> size; by default a 1-D ``("shards",)``
+    mesh over all visible devices.  This is the TPU analog of the
+    reference's node pool: where the reference starts ``len(ports)``
+    server processes (reference: demo_node.py:98-108), we lay the same
+    logical nodes out along the ``"shards"`` axis of one SPMD program.
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    if shape is None:
+        shape = {SHARDS_AXIS: len(devices)}
+    names = tuple(shape.keys())
+    sizes = tuple(int(shape[n]) for n in names)
+    n_needed = int(np.prod(sizes)) if sizes else 1
+    if n_needed > len(devices):
+        raise ValueError(
+            f"Mesh shape {dict(shape)} needs {n_needed} devices, "
+            f"only {len(devices)} available."
+        )
+    dev_array = np.array(devices[:n_needed]).reshape(sizes)
+    return Mesh(dev_array, names)
+
+
+def single_device_mesh(axis: str = SHARDS_AXIS) -> Mesh:
+    """A 1-device mesh — lets all sharded code paths run on one chip."""
+    return make_mesh({axis: 1}, devices=[jax.devices()[0]])
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceLoad:
+    """Health/load snapshot of one device.
+
+    Parity with the reference's ``GetLoadResult`` (reference: rpc.py:60-71):
+    ``n_clients`` -> ``n_live_buffers``, ``percent_cpu``/``percent_ram`` ->
+    HBM utilization; plus device identity fields.
+    """
+
+    device_id: int
+    platform: str
+    process_index: int
+    bytes_in_use: Optional[int]
+    bytes_limit: Optional[int]
+
+    @property
+    def percent_hbm(self) -> Optional[float]:
+        if self.bytes_in_use is None or not self.bytes_limit:
+            return None
+        return 100.0 * self.bytes_in_use / self.bytes_limit
+
+
+def get_load(devices: Optional[Sequence[jax.Device]] = None) -> list[DeviceLoad]:
+    """Load snapshot for every device.
+
+    The reference polls each server's ``GetLoad`` RPC concurrently with a
+    timeout and maps failures to ``None`` (reference: service.py:161-211);
+    device liveness here is synchronous — an unhealthy device raises and
+    is reported as a ``DeviceLoad`` with ``None`` stats.
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    out = []
+    for d in devices:
+        try:
+            stats = d.memory_stats() or {}
+        except Exception:
+            stats = {}
+        out.append(
+            DeviceLoad(
+                device_id=d.id,
+                platform=d.platform,
+                process_index=d.process_index,
+                bytes_in_use=stats.get("bytes_in_use"),
+                bytes_limit=stats.get("bytes_limit"),
+            )
+        )
+    return out
+
+
+def healthy_devices(
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> list[jax.Device]:
+    """Devices that respond to a trivial computation.
+
+    The failover analog: the reference excludes unresponsive servers at
+    connect time (reference: service.py:181-184, 257-260); on TPU, a dead
+    device is excluded at mesh-construction time and the caller re-jits
+    over the surviving mesh (SURVEY §7 step 5).
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    alive = []
+    for d in devices:
+        try:
+            x = jax.device_put(np.float32(1.0), d)
+            if float(x) == 1.0:
+                alive.append(d)
+        except Exception:
+            continue
+    return alive

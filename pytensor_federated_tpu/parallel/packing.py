@@ -1,0 +1,86 @@
+"""Pack heterogeneous per-shard datasets into one SPMD-friendly layout.
+
+The reference's federated nodes each own private data of *arbitrary* size
+(reference: demo_node.py:58-61 — every node draws its own dataset; the
+wire format carries any shape, reference: npproto/utils.py:9-15).  SPMD
+wants uniform static shapes, so "each node has different data" becomes
+pad-to-max + mask (SURVEY §7 "hard parts").  The mask rides along as a
+first-class array; likelihoods multiply by it so padded rows contribute
+exactly zero to logp *and* grad.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass
+class ShardedData:
+    """Stacked per-shard data with a validity mask.
+
+    ``data`` is a pytree whose leaves have shape ``(n_shards, max_len, ...)``;
+    ``mask`` is ``(n_shards, max_len)`` float32 with 1.0 on real rows.
+    """
+
+    data: Any
+    mask: jax.Array
+
+    @property
+    def n_shards(self) -> int:
+        return int(self.mask.shape[0])
+
+    @property
+    def max_len(self) -> int:
+        return int(self.mask.shape[1])
+
+    def tree(self) -> Any:
+        """The pytree handed to the sharded evaluator: (data, mask)."""
+        return (self.data, self.mask)
+
+
+def pack_shards(shards: Sequence[Any], *, pad_to_multiple: int = 1) -> ShardedData:
+    """Stack a list of per-shard pytrees, padding the leading axis to max.
+
+    Each element of ``shards`` is a pytree of arrays whose *leading* axis
+    is that shard's number of observations (axes beyond the first must
+    match across shards).  ``pad_to_multiple`` rounds the padded length up
+    (e.g. to 8/128 multiples so downstream ops tile cleanly onto the VPU/MXU).
+    """
+    if not shards:
+        raise ValueError("need at least one shard")
+    treedef = jax.tree_util.tree_structure(shards[0])
+    for s in shards[1:]:
+        if jax.tree_util.tree_structure(s) != treedef:
+            raise ValueError("all shards must share one pytree structure")
+
+    lengths = []
+    for s in shards:
+        leaves = jax.tree_util.tree_leaves(s)
+        ns = {np.shape(l)[0] for l in leaves}
+        if len(ns) != 1:
+            raise ValueError(
+                f"leaves of one shard must share a leading axis, got {ns}"
+            )
+        lengths.append(ns.pop())
+    max_len = max(lengths)
+    if pad_to_multiple > 1:
+        max_len = -(-max_len // pad_to_multiple) * pad_to_multiple
+
+    def pad_leaf(*leaves):
+        padded = []
+        for l in leaves:
+            l = np.asarray(l)
+            pad = [(0, max_len - l.shape[0])] + [(0, 0)] * (l.ndim - 1)
+            padded.append(np.pad(l, pad))
+        return jnp.asarray(np.stack(padded))
+
+    data = jax.tree_util.tree_map(lambda *ls: pad_leaf(*ls), *shards)
+    mask = np.zeros((len(shards), max_len), dtype=np.float32)
+    for i, n in enumerate(lengths):
+        mask[i, :n] = 1.0
+    return ShardedData(data=data, mask=jnp.asarray(mask))

@@ -1,0 +1,210 @@
+"""The sharded evaluator — this framework's replacement for the gRPC core.
+
+In the reference, evaluating the federated log-likelihood means N
+concurrent network round-trips: encode arrays, HTTP/2 to each node, the
+node runs its compiled function, reply, decode, and the driver's graph
+sums the per-node logps (reference: service.py:150-158 hot loop;
+op_async.py:107-132 fan-out; demo_model.py:34-36 sum-of-potentials).
+
+Here the entire exchange collapses into ONE XLA program: per-shard data
+lives device-resident along a mesh axis, the per-shard logp runs as SPMD
+under ``shard_map``, and the sum-of-potentials is a ``lax.psum`` over ICI.
+Gradients come from ``jax.value_and_grad`` *through* the collective (psum
+transposes to psum), so logp+grad is a single fused executable — zero
+serialization, zero gRPC (BASELINE.json north star).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import SHARDS_AXIS
+
+# per_shard_logp(params, shard_data) -> scalar logp contribution of one shard.
+PerShardLogpFn = Callable[[Any, Any], jax.Array]
+# per_shard_fn(params, shard_data) -> pytree of per-shard outputs.
+PerShardComputeFn = Callable[[Any, Any], Any]
+
+
+def _leading_dim(data: Any) -> int:
+    leaves = jax.tree_util.tree_leaves(data)
+    if not leaves:
+        raise ValueError("data pytree has no leaves")
+    dims = {jnp.shape(l)[0] for l in leaves}
+    if len(dims) != 1:
+        raise ValueError(f"all data leaves must share a leading shard axis, got {dims}")
+    return dims.pop()
+
+
+def _shard_data_to_mesh(data: Any, mesh: Mesh, axis: str) -> Any:
+    """Place the stacked data pytree with its leading axis split over ``axis``.
+
+    This is the moment the reference ships private datasets to node
+    processes (reference: demo_node.py:58-61); here it is a one-time
+    host->device layout, after which data never moves again.
+    """
+    sharding = NamedSharding(mesh, P(axis))
+    return jax.tree_util.tree_map(lambda l: jax.device_put(l, sharding), data)
+
+
+class FederatedLogp:
+    """Sharded log-potential: ``logp(params) = Σ_shards per_shard_logp``.
+
+    The TPU-native ``ArraysToArraysService`` + ``LogpGradServiceClient``
+    + ``ParallelAsyncOp`` stack in one object (reference: service.py:75-115,
+    common.py:105-161, op_async.py:68-132):
+
+    - each "node" is a slot along ``axis`` on the mesh;
+    - ``logp`` / ``logp_and_grad`` are jitted SPMD executables;
+    - aggregation is ``lax.psum`` over ICI, not a sum of RPC replies.
+
+    ``data`` is a pytree whose leaves carry a leading ``n_shards`` axis
+    (build heterogeneous shards with :func:`..parallel.packing.pack_shards`).
+    ``n_shards`` may exceed the mesh axis size: each device then vmaps over
+    its local block of shards — large, batched, MXU-friendly.
+
+    With ``mesh=None`` the same model runs single-device (vmap + sum),
+    which is also the fastest single-chip layout.
+    """
+
+    def __init__(
+        self,
+        per_shard_logp: PerShardLogpFn,
+        data: Any,
+        *,
+        mesh: Optional[Mesh] = None,
+        axis: str = SHARDS_AXIS,
+    ):
+        self.per_shard_logp = per_shard_logp
+        self.axis = axis
+        self.mesh = mesh
+        self.n_shards = _leading_dim(data)
+
+        if mesh is not None:
+            if axis not in mesh.axis_names:
+                raise ValueError(f"mesh has no axis {axis!r}: {mesh.axis_names}")
+            axis_size = mesh.shape[axis]
+            if self.n_shards % axis_size != 0:
+                raise ValueError(
+                    f"n_shards={self.n_shards} not divisible by mesh axis "
+                    f"{axis!r} of size {axis_size}"
+                )
+            self.data = _shard_data_to_mesh(data, mesh, axis)
+
+            data_specs = jax.tree_util.tree_map(lambda _: P(axis), self.data)
+
+            def total_logp(params, data):
+                def local(params, local_data):
+                    # local_data: this device's block of shards.
+                    lp = jax.vmap(lambda d: self.per_shard_logp(params, d))(
+                        local_data
+                    )
+                    return jax.lax.psum(jnp.sum(lp), axis)
+
+                return shard_map(
+                    local,
+                    mesh=mesh,
+                    in_specs=(
+                        jax.tree_util.tree_map(lambda _: P(), params),
+                        data_specs,
+                    ),
+                    out_specs=P(),
+                )(params, data)
+
+        else:
+            self.data = data
+
+            def total_logp(params, data):
+                lp = jax.vmap(lambda d: self.per_shard_logp(params, d))(data)
+                return jnp.sum(lp)
+
+        self._total_logp = total_logp
+        self._logp = jax.jit(lambda params: total_logp(params, self.data))
+        self._logp_and_grad = jax.jit(
+            jax.value_and_grad(lambda params: total_logp(params, self.data))
+        )
+
+    # -- the public evaluation surface (reference: common.py:52-161) --
+
+    def logp(self, params: Any) -> jax.Array:
+        """Scalar total log-potential (``LogpServiceClient.evaluate`` analog)."""
+        return self._logp(params)
+
+    def logp_and_grad(self, params: Any):
+        """(logp, grads) in one fused executable
+        (``LogpGradServiceClient.evaluate`` analog, reference: common.py:134-155)."""
+        return self._logp_and_grad(params)
+
+    __call__ = logp
+
+    def per_shard_logps(self, params: Any) -> jax.Array:
+        """Vector of per-shard contributions (diagnostic; the reference
+        exposes these as individual node replies)."""
+
+        def f(params, data):
+            return jax.vmap(lambda d: self.per_shard_logp(params, d))(data)
+
+        if self.mesh is None:
+            return jax.jit(f)(params, self.data)
+        return jax.jit(
+            shard_map(
+                f,
+                mesh=self.mesh,
+                in_specs=(
+                    jax.tree_util.tree_map(lambda _: P(), params),
+                    jax.tree_util.tree_map(lambda _: P(self.axis), self.data),
+                ),
+                out_specs=P(self.axis),
+            )
+        )(params, self.data)
+
+
+def sharded_compute(
+    per_shard_fn: PerShardComputeFn,
+    data: Any,
+    *,
+    mesh: Optional[Mesh] = None,
+    axis: str = SHARDS_AXIS,
+) -> Callable[[Any], Any]:
+    """Generic arrays->arrays over every shard, outputs stacked by shard.
+
+    The TPU analog of the reference's *generic* service core — an
+    ``ArraysToArraysService`` per node returning arbitrary arrays
+    (reference: service.py:75-115, README.md:27-35) — for compute that is
+    not a log-potential.  Returns a jitted ``fn(params) -> pytree`` whose
+    leaves have a leading ``n_shards`` axis.
+    """
+    n_shards = _leading_dim(data)
+    if mesh is None:
+        placed = data
+
+        def fn(params):
+            return jax.vmap(lambda d: per_shard_fn(params, d))(placed)
+
+        return jax.jit(fn)
+
+    axis_size = mesh.shape[axis]
+    if n_shards % axis_size != 0:
+        raise ValueError(
+            f"n_shards={n_shards} not divisible by mesh axis size {axis_size}"
+        )
+    placed = _shard_data_to_mesh(data, mesh, axis)
+    data_specs = jax.tree_util.tree_map(lambda _: P(axis), placed)
+
+    def fn(params):
+        def local(params, local_data):
+            return jax.vmap(lambda d: per_shard_fn(params, d))(local_data)
+
+        return shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(jax.tree_util.tree_map(lambda _: P(), params), data_specs),
+            out_specs=P(axis),
+        )(params, placed)
+
+    return jax.jit(fn)

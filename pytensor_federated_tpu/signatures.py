@@ -1,0 +1,64 @@
+"""Typed compute-function contracts.
+
+TPU-native re-design of the reference's modeling-signature layer
+(reference: pytensor_federated/signatures.py:8-33).  The reference defines
+three ``Callable`` aliases over NumPy arrays; here the same contracts are
+expressed over JAX arrays, plus an explicit *shape signature* type
+(:class:`ArraysSpec`) built from :class:`jax.ShapeDtypeStruct`.  Shape
+signatures are first-class because XLA compiles one executable per static
+signature — the TPU analog of the reference's wire-format schema
+(reference: protobufs/service.proto:6-19).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+ArrayLike = Any  # anything jnp.asarray accepts
+
+#: Arrays in -> arrays out.  The universal compute contract
+#: (reference: signatures.py:8-14 ``ComputeFunc``).  Must be JAX-traceable
+#: for the on-device path; host/blackbox functions enter through
+#: :mod:`pytensor_federated_tpu.ops.blackbox` instead.
+ComputeFn = Callable[..., Sequence[Array]]
+
+#: Model parameters -> scalar log-potential
+#: (reference: signatures.py:17-23 ``LogpFunc``).
+LogpFn = Callable[..., Array]
+
+#: Model parameters -> (scalar log-potential, gradients w.r.t. every input)
+#: (reference: signatures.py:26-33 ``LogpGradFunc``).
+LogpGradFn = Callable[..., Tuple[Array, Tuple[Array, ...]]]
+
+#: A static arrays signature: one ShapeDtypeStruct per array.
+ArraysSpec = Tuple[jax.ShapeDtypeStruct, ...]
+
+
+def spec_of(*arrays: ArrayLike) -> ArraysSpec:
+    """Return the static signature of concrete arrays."""
+    out = []
+    for a in arrays:
+        a = jnp.asarray(a)
+        out.append(jax.ShapeDtypeStruct(a.shape, a.dtype))
+    return tuple(out)
+
+
+def scalar_spec(dtype=jnp.float32) -> jax.ShapeDtypeStruct:
+    """Signature of a 0-d scalar (the logp output)."""
+    return jax.ShapeDtypeStruct((), jnp.dtype(dtype))
+
+
+def check_scalar(x: Array, what: str = "logp") -> Array:
+    """Trace-time check that ``x`` is a scalar.
+
+    Mirrors the reference's runtime validation that a log-potential is
+    scalar (reference: common.py:18-22), but runs at trace time: XLA shapes
+    are static, so an ill-typed model fails at compile, not mid-sampling.
+    """
+    if jnp.shape(x) != ():
+        raise ValueError(f"{what} must be scalar, got shape {jnp.shape(x)}")
+    return x

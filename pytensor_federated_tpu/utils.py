@@ -1,0 +1,61 @@
+"""Small utilities.
+
+Maps the reference's utility layer (reference: pytensor_federated/utils.py).
+``argmin_none_or_func`` keeps the exact contract of the reference's load
+balancer helper (reference: utils.py:13-34).  The event-loop machinery
+(reference: utils.py:37-61, ``get_useful_event_loop`` + nest_asyncio) exists
+only because the reference bridges a *synchronous* graph executor into
+async gRPC calls; the TPU hot path has no event loop at all — XLA dispatch
+is already asynchronous — so that helper survives only for the optional
+host-federation transport (:mod:`pytensor_federated_tpu.service`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Callable, Optional, Sequence, TypeVar
+
+T = TypeVar("T")
+
+
+def argmin_none_or_func(
+    items: Sequence[Optional[T]], func: Callable[[T], float]
+) -> Optional[int]:
+    """Index of the item minimizing ``func``, ignoring ``None`` entries.
+
+    Returns ``None`` if every item is ``None``.  Exact behavioral parity
+    with reference utils.py:13-34 (used by load balancing to pick the
+    least-loaded healthy server; ``None`` marks an unresponsive one).
+    """
+    best_i: Optional[int] = None
+    best_v: Optional[float] = None
+    for i, item in enumerate(items):
+        if item is None:
+            continue
+        v = func(item)
+        if best_v is None or v < best_v:
+            best_i, best_v = i, v
+    return best_i
+
+
+def get_event_loop() -> asyncio.AbstractEventLoop:
+    """Return a usable asyncio event loop (create one if necessary).
+
+    Slimmed-down analog of reference utils.py:37-61.  The reference needs
+    ``nest_asyncio`` because PyTensor's sync executor re-enters a running
+    loop; our executor is XLA, so re-entrancy never happens on the compute
+    path and this helper only serves the host transport's sync wrappers.
+    """
+    try:
+        return asyncio.get_running_loop()
+    except RuntimeError:
+        pass
+    try:
+        loop = asyncio.get_event_loop_policy().get_event_loop()
+        if loop.is_closed():
+            raise RuntimeError
+        return loop
+    except RuntimeError:
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        return loop

@@ -1,0 +1,83 @@
+"""Adapters between modeling signatures and the flat arrays contract.
+
+TPU-native re-design of the reference's server-side adapters
+(reference: pytensor_federated/common.py:12-49).  The reference wraps a
+``LogpFunc`` / ``LogpGradFunc`` into the flat ``ComputeFunc`` convention
+with *runtime* shape checks; here the same contracts are validated at
+trace time (static XLA shapes) and the wrapped functions stay jittable.
+
+A TPU-native extra: :func:`logp_grad_from_logp` derives the gradient with
+``jax.value_and_grad`` instead of requiring the node author to hand-derive
+it (the reference's nodes compile a separate dlogp graph,
+reference: demo_node.py:39-42).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .signatures import Array, ComputeFn, LogpFn, LogpGradFn, check_scalar
+
+
+def wrap_logp_fn(logp_fn: LogpFn) -> ComputeFn:
+    """Adapt a logp function to the ``arrays -> [arrays]`` contract.
+
+    Parity: reference common.py:12-23 (``wrap_logp_func``) — output is a
+    single scalar array; non-scalar logp is rejected (at trace time here).
+    """
+
+    def compute_fn(*inputs: Array) -> Sequence[Array]:
+        logp = logp_fn(*inputs)
+        return [check_scalar(jnp.asarray(logp), "logp")]
+
+    return compute_fn
+
+
+def wrap_logp_grad_fn(logp_grad_fn: LogpGradFn) -> ComputeFn:
+    """Adapt a logp-and-grad function to ``arrays -> [logp, *grads]``.
+
+    Parity: reference common.py:26-49 (``wrap_logp_grad_func``) — exactly
+    one gradient per input, each with its input's shape; scalar logp.
+    """
+
+    def compute_fn(*inputs: Array) -> Sequence[Array]:
+        logp, grads = logp_grad_fn(*inputs)
+        logp = check_scalar(jnp.asarray(logp), "logp")
+        grads = tuple(jnp.asarray(g) for g in grads)
+        if len(grads) != len(inputs):
+            raise ValueError(
+                f"Expected one gradient per input ({len(inputs)}), "
+                f"got {len(grads)}."
+            )
+        for i, (g, x) in enumerate(zip(grads, inputs)):
+            xs = jnp.shape(jnp.asarray(x))
+            if jnp.shape(g) != xs:
+                raise ValueError(
+                    f"Gradient {i} has shape {jnp.shape(g)}, "
+                    f"expected input shape {xs}."
+                )
+        return [logp, *grads]
+
+    return compute_fn
+
+
+def logp_grad_from_logp(logp_fn: LogpFn) -> LogpGradFn:
+    """Derive a ``LogpGradFn`` from a logp function via autodiff.
+
+    TPU-native addition with no reference equivalent: the reference's
+    nodes must supply gradients explicitly (reference: signatures.py:26-33);
+    on the JAX path they come for free and fuse into one XLA program.
+    """
+
+    def logp_grad_fn(*inputs: Array):
+        args = tuple(jnp.asarray(x) for x in inputs)
+        logp, grads = jax.value_and_grad(
+            lambda *a: check_scalar(logp_fn(*a), "logp"),
+            argnums=tuple(range(len(args))),
+        )(*args)
+        return logp, tuple(grads)
+
+    return logp_grad_fn

@@ -1,0 +1,46 @@
+"""Test harness configuration.
+
+Distributed tests run on a virtual 8-device CPU mesh
+(``--xla_force_host_platform_device_count=8``) — the TPU analog of the
+reference's localhost multi-process "multi-node" servers (reference:
+test_service.py:180-224; SURVEY §4) — so the full sharded path executes
+without TPU hardware.
+
+This environment may pre-register a TPU PJRT plugin at interpreter
+startup (sitecustomize), before pytest loads this file.  JAX's *CPU*
+backend initializes lazily, so it is still possible to (a) request 8
+virtual CPU devices via XLA_FLAGS and (b) route all un-placed
+computation to CPU via ``jax_default_device`` — no re-exec needed.
+"""
+
+import os
+import sys
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+_CPUS = jax.devices("cpu")
+jax.config.update("jax_default_device", _CPUS[0])
+
+# Make the repo root importable regardless of cwd.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    if len(_CPUS) < 8:
+        pytest.skip(f"needs 8 CPU devices, have {len(_CPUS)}")
+    return _CPUS[:8]
+
+
+@pytest.fixture(scope="session")
+def mesh8(devices8):
+    from pytensor_federated_tpu.parallel import make_mesh
+
+    return make_mesh({"shards": 8}, devices=devices8)

@@ -1,0 +1,125 @@
+"""Op contract tests (reference: test_wrapper_ops.py Op-contract section).
+
+Uses an in-process quadratic model with hand-derived gradients as ground
+truth — the reference's ``dummy_quadratic_model`` pattern
+(reference: test_wrapper_ops.py:34-45).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytensor_federated_tpu import (
+    ArraysToArraysOp,
+    LogpGradOp,
+    LogpOp,
+    blackbox_compute,
+    blackbox_logp_grad,
+    from_logp_fn,
+)
+
+
+def quad_logp(x, y):
+    return -jnp.sum((x - 1.0) ** 2) - jnp.sum((y + 2.0) ** 2)
+
+
+def quad_logp_grad(x, y):
+    return quad_logp(x, y), (-2 * (x - 1.0), -2 * (y + 2.0))
+
+
+def test_arrays_to_arrays_op_coerces_ints():
+    """Raw python ints must coerce ('issue #24' regression,
+    reference: test_wrapper_ops.py:284-289)."""
+    op = ArraysToArraysOp(lambda a, b: [a + b, a * b])
+    s, p = op(2, 3)
+    np.testing.assert_allclose(s, 5)
+    np.testing.assert_allclose(p, 6)
+
+
+def test_logp_op_scalar_contract():
+    op = LogpOp(quad_logp)
+    out = op(jnp.zeros(3), jnp.zeros(2))
+    assert out.shape == ()
+    np.testing.assert_allclose(out, -3.0 - 8.0)
+
+
+def test_logp_op_rejects_nonscalar():
+    op = LogpOp(lambda x: x)
+    with pytest.raises(ValueError, match="scalar"):
+        op(jnp.zeros(3))
+
+
+def test_logp_grad_op_outputs():
+    op = LogpGradOp(quad_logp_grad)
+    x, y = jnp.array([0.0, 2.0]), jnp.array(1.0)
+    logp, (gx, gy) = op(x, y)
+    np.testing.assert_allclose(logp, -2.0 - 9.0)
+    np.testing.assert_allclose(gx, [2.0, -2.0])
+    np.testing.assert_allclose(gy, -6.0)
+
+
+def test_logp_grad_op_vjp_matches_hand_gradients():
+    """jax.grad through the op must use the forward-supplied grads
+    (reference: test_wrapper_ops.py:224-237)."""
+    op = LogpGradOp(quad_logp_grad)
+
+    def scalar_loss(x, y):
+        logp, _ = op(x, y)
+        return 3.0 * logp  # non-trivial cotangent
+
+    x, y = jnp.array([0.5, -1.0]), jnp.array(0.25)
+    gx, gy = jax.grad(scalar_loss, argnums=(0, 1))(x, y)
+    np.testing.assert_allclose(gx, 3.0 * (-2 * (x - 1.0)), rtol=1e-6)
+    np.testing.assert_allclose(gy, 3.0 * (-2 * (y + 2.0)), rtol=1e-6)
+
+
+def test_logp_grad_op_under_jit_and_grad():
+    op = LogpGradOp(quad_logp_grad)
+    g = jax.jit(jax.grad(lambda x: op(x, jnp.float32(0.0))[0]))
+    np.testing.assert_allclose(g(jnp.float32(0.0)), 2.0, rtol=1e-6)
+
+
+def test_from_logp_fn_derives_grads():
+    op = from_logp_fn(quad_logp)
+    x, y = jnp.array([2.0]), jnp.array(0.0)
+    logp, (gx, gy) = op(x, y)
+    ref_logp, (ref_gx, ref_gy) = quad_logp_grad(x, y)
+    np.testing.assert_allclose(logp, ref_logp)
+    np.testing.assert_allclose(gx, ref_gx)
+    np.testing.assert_allclose(gy, ref_gy)
+
+
+# ---- blackbox (host callback) path ----
+
+
+def test_blackbox_compute_roundtrip():
+    """Host numpy fn runs under jit with a declared out signature."""
+
+    def host(a, b):
+        return [np.asarray(a) + np.asarray(b)]
+
+    spec = (jax.ShapeDtypeStruct((3,), jnp.float32),)
+    fn = blackbox_compute(host, spec)
+    out = jax.jit(lambda a, b: fn(a, b)[0])(jnp.ones(3), jnp.full(3, 2.0))
+    np.testing.assert_allclose(out, 3.0)
+
+
+def test_blackbox_logp_grad_differentiable():
+    """A pure-NumPy node (the reference's true federated case) is
+    differentiable via forward-supplied grads."""
+
+    def host(x):
+        x = np.asarray(x)
+        return -np.sum((x - 3.0) ** 2), [-2.0 * (x - 3.0)]
+
+    spec = (jax.ShapeDtypeStruct((2,), jnp.float32),)
+    op = blackbox_logp_grad(host, spec)
+    x = jnp.array([1.0, 5.0])
+    logp, (gx,) = op(x)
+    np.testing.assert_allclose(logp, -8.0)
+    np.testing.assert_allclose(gx, [4.0, -4.0])
+    g = jax.grad(lambda x: op(x)[0])(x)
+    np.testing.assert_allclose(g, [4.0, -4.0])
+    g_jit = jax.jit(jax.grad(lambda x: op(x)[0]))(x)
+    np.testing.assert_allclose(g_jit, [4.0, -4.0])

@@ -1,0 +1,78 @@
+"""Signature/wrapper contract tests (reference: common.py:12-49 behavior)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytensor_federated_tpu import (
+    logp_grad_from_logp,
+    spec_of,
+    wrap_logp_fn,
+    wrap_logp_grad_fn,
+)
+
+
+def quadratic_logp(x, y):
+    return -jnp.sum(x**2) - jnp.sum(y**2)
+
+
+def quadratic_logp_grad(x, y):
+    return quadratic_logp(x, y), (-2 * x, -2 * y)
+
+
+def test_spec_of():
+    s = spec_of(np.zeros((2, 3), np.float32), 1.0)
+    assert s[0].shape == (2, 3)
+    assert s[1].shape == ()
+
+
+def test_wrap_logp_fn():
+    fn = wrap_logp_fn(quadratic_logp)
+    (out,) = fn(jnp.array([1.0, 2.0]), jnp.array(3.0))
+    np.testing.assert_allclose(out, -14.0)
+
+
+def test_wrap_logp_fn_rejects_nonscalar():
+    fn = wrap_logp_fn(lambda x: x)  # identity: not scalar for vector input
+    with pytest.raises(ValueError, match="scalar"):
+        fn(jnp.array([1.0, 2.0]))
+
+
+def test_wrap_logp_grad_fn():
+    fn = wrap_logp_grad_fn(quadratic_logp_grad)
+    x, y = jnp.array([1.0, 2.0]), jnp.array(3.0)
+    logp, gx, gy = fn(x, y)
+    np.testing.assert_allclose(logp, -14.0)
+    np.testing.assert_allclose(gx, [-2.0, -4.0])
+    np.testing.assert_allclose(gy, -6.0)
+
+
+def test_wrap_logp_grad_fn_arity_mismatch():
+    fn = wrap_logp_grad_fn(lambda x, y: (quadratic_logp(x, y), (-2 * x,)))
+    with pytest.raises(ValueError, match="one gradient per input"):
+        fn(jnp.ones(2), jnp.ones(2))
+
+
+def test_wrap_logp_grad_fn_shape_mismatch():
+    fn = wrap_logp_grad_fn(
+        lambda x: (-jnp.sum(x**2), (jnp.zeros((3,)),))
+    )
+    with pytest.raises(ValueError, match="shape"):
+        fn(jnp.ones(2))
+
+
+def test_logp_grad_from_logp_matches_hand_gradients():
+    derived = logp_grad_from_logp(quadratic_logp)
+    x, y = jnp.array([1.0, -2.0]), jnp.array(0.5)
+    logp_d, (gx_d, gy_d) = derived(x, y)
+    logp_h, (gx_h, gy_h) = quadratic_logp_grad(x, y)
+    np.testing.assert_allclose(logp_d, logp_h)
+    np.testing.assert_allclose(gx_d, gx_h)
+    np.testing.assert_allclose(gy_d, gy_h)
+
+
+def test_wrappers_are_jittable():
+    fn = jax.jit(lambda x, y: wrap_logp_grad_fn(quadratic_logp_grad)(x, y))
+    out = fn(jnp.ones(2), jnp.array(1.0))
+    np.testing.assert_allclose(out[0], -3.0)
