@@ -1,0 +1,90 @@
+"""Benchmark: federated logp+grad evals/sec, 8-shard Bayesian linear regression.
+
+The BASELINE.json metric.  The reference pays (serialize + 2x network +
+Python dispatch) per evaluation — O(ms) per logp+grad call over gRPC
+(reference: service.py:150-158); here the whole federated evaluation is
+one fused XLA executable, and the benchmark measures *sequential
+dependent* evaluations (the way NUTS consumes them: each leapfrog step
+feeds the previous gradient forward), chained inside a ``lax.scan`` with
+zero host round-trips.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "evals/s", "vs_baseline": N}
+``vs_baseline`` is value / 50_000 — the driver-set north-star target for
+a v4-16 (BASELINE.json); there is no reference-published number to
+compare against (the reference publishes none, BASELINE.md).
+"""
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+NORTH_STAR = 50_000.0
+
+
+def main():
+    from jax.flatten_util import ravel_pytree
+
+    from pytensor_federated_tpu.models.linear import (
+        FederatedLinearRegression,
+        generate_node_data,
+    )
+
+    data, _ = generate_node_data(8, n_obs=64, seed=123)
+    model = FederatedLinearRegression(data)
+    params = model.init_params()
+    flat0, unravel = ravel_pytree(params)
+
+    def logp_and_grad_flat(x):
+        v, g = jax.value_and_grad(lambda x: model.logp(unravel(x)))(x)
+        return v, g
+
+    n_evals = 20_000
+
+    @jax.jit
+    def chained(x0):
+        """Sequential dependent evals — no pipelining tricks: each step
+        consumes the previous gradient, like a leapfrog integrator."""
+
+        def body(carry, _):
+            x, acc = carry
+            v, g = logp_and_grad_flat(x)
+            # tiny dependent update keeps the chain honest (not DCE-able)
+            x = x + 1e-6 * g
+            return (x, acc + v), None
+
+        (x, acc), _ = jax.lax.scan(body, (x0, 0.0), None, length=n_evals)
+        return x, acc
+
+    # Warm up / compile.
+    out = chained(flat0)
+    jax.block_until_ready(out)
+
+    t0 = time.perf_counter()
+    out = chained(flat0)
+    jax.block_until_ready(out)
+    wall = time.perf_counter() - t0
+
+    evals_per_sec = n_evals / wall
+    print(
+        json.dumps(
+            {
+                "metric": "federated logp+grad evals/sec (8-shard Bayesian "
+                "linear regression, sequential dependent chain, zero gRPC)",
+                "value": round(evals_per_sec, 1),
+                "unit": "evals/s",
+                "vs_baseline": round(evals_per_sec / NORTH_STAR, 3),
+            }
+        )
+    )
+    print(
+        f"# backend={jax.default_backend()} wall={wall:.3f}s n={n_evals}",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

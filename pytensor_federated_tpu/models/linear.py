@@ -1,0 +1,152 @@
+"""Federated Bayesian linear regression — the flagship demo model.
+
+TPU-native collapse of the reference's two demo processes
+(reference: demo_node.py + demo_model.py): each "node" owns a private
+``(x, y)`` dataset and contributes a partial log-likelihood with a
+per-shard intercept offset; the driver places a hierarchical prior over
+intercepts and samples the posterior with NUTS.  Where the reference runs
+15 gRPC server processes and fans out one RPC per shard per leapfrog
+step (reference: demo_node.py:118, demo_model.py:33-36), everything here
+is one jitted SPMD program over the ``"shards"`` mesh axis.
+
+Model (matches the reference's multilevel regression,
+reference: demo_model.py:26-36):
+
+    intercept   ~ Normal(0, prior_scale)
+    offset_i    ~ Normal(0, offset_scale)      per shard i (fixed scale —
+                  see FederatedLinearRegression; a learned group sigma is
+                  the hierarchical GLM model's job, models/glm.py)
+    slope       ~ Normal(0, prior_scale)
+    sigma       ~ HalfNormal(1)  (via log_sigma + change of variables)
+    y_ij        ~ Normal((intercept + offset_i) + slope * x_ij, sigma)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from ..parallel.packing import ShardedData, pack_shards
+from ..parallel.sharded import FederatedLogp
+
+LOG_2PI = float(np.log(2.0 * np.pi))
+
+
+def generate_node_data(
+    n_shards: int = 8,
+    *,
+    n_obs: int | Sequence[int] = 64,
+    intercept: float = 1.5,
+    slope: float = 2.0,
+    sigma: float = 0.5,
+    intercept_spread: float = 0.3,
+    seed: int = 123,
+) -> tuple[ShardedData, np.ndarray]:
+    """Per-node private datasets (reference: demo_node.py:58-61 generates
+    one seeded private dataset per worker process).
+
+    Returns packed shard data and the true per-shard intercept offsets.
+    """
+    rng = np.random.default_rng(seed)
+    if isinstance(n_obs, int):
+        n_obs = [n_obs] * n_shards
+    offsets = rng.normal(0.0, intercept_spread, size=n_shards)
+    shards = []
+    for i in range(n_shards):
+        x = rng.uniform(-3.0, 3.0, size=n_obs[i]).astype(np.float32)
+        y = (
+            (intercept + offsets[i])
+            + slope * x
+            + rng.normal(0.0, sigma, size=n_obs[i])
+        ).astype(np.float32)
+        shards.append((x, y))
+    return pack_shards(shards, pad_to_multiple=8), offsets
+
+
+def _normal_logpdf(x, mu, sigma):
+    z = (x - mu) / sigma
+    return -0.5 * z * z - jnp.log(sigma) - 0.5 * LOG_2PI
+
+
+@dataclasses.dataclass
+class FederatedLinearRegression:
+    """Hierarchical linear regression over federated shards.
+
+    ``params`` pytree::
+
+        intercept: ()      slope: ()      log_sigma: ()
+        offsets: (n_shards,)
+
+    The per-shard likelihood closes over that shard's private data; the
+    shard picks out its own offset via the shard index carried in the
+    data pytree (SPMD-friendly: no gather across devices).
+    """
+
+    data: ShardedData
+    mesh: Optional[Mesh] = None
+    prior_scale: float = 10.0
+    offset_scale: float = 0.3
+
+    def __post_init__(self):
+        n = self.data.n_shards
+        shard_ids = jnp.arange(n, dtype=jnp.int32)
+        (x, y), mask = self.data.tree()
+        tree = ((x, y), mask, shard_ids)
+
+        def per_shard_logp(params, shard):
+            (x, y), mask, sid = shard
+            offset = jnp.take(params["offsets"], sid)
+            mu = (params["intercept"] + offset) + params["slope"] * x
+            sigma = jnp.exp(params["log_sigma"])
+            ll = _normal_logpdf(y, mu, sigma)
+            return jnp.sum(ll * mask)
+
+        self.fed = FederatedLogp(per_shard_logp, tree, mesh=self.mesh)
+        self.n_shards = n
+
+    # -- prior + posterior ------------------------------------------------
+
+    def prior_logp(self, params: Any) -> jax.Array:
+        s = self.prior_scale
+        lp = _normal_logpdf(params["intercept"], 0.0, s)
+        lp += _normal_logpdf(params["slope"], 0.0, s)
+        lp += jnp.sum(_normal_logpdf(params["offsets"], 0.0, self.offset_scale))
+        # HalfNormal(1) on sigma via log_sigma with Jacobian |d sigma/d log_sigma|.
+        sigma = jnp.exp(params["log_sigma"])
+        lp += -0.5 * sigma**2 + params["log_sigma"]
+        return lp
+
+    def logp(self, params: Any) -> jax.Array:
+        return self.prior_logp(params) + self.fed.logp(params)
+
+    def logp_and_grad(self, params: Any):
+        """Posterior logp+grad fused into one executable — this is the
+        callable the benchmark rates (BASELINE.json metric)."""
+        return jax.value_and_grad(self.logp)(params)
+
+    def init_params(self) -> Any:
+        return {
+            "intercept": jnp.zeros(()),
+            "slope": jnp.zeros(()),
+            "log_sigma": jnp.zeros(()),
+            "offsets": jnp.zeros((self.n_shards,)),
+        }
+
+    # -- driver conveniences (reference: demo_model.py:38-42) -------------
+
+    def find_map(self, **kwargs):
+        from ..samplers import find_map
+
+        return find_map(self.logp, self.init_params(), **kwargs)
+
+    def sample(self, *, key=None, **kwargs):
+        from ..samplers import sample
+
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        return sample(self.logp, self.init_params(), key=key, **kwargs)

@@ -1,0 +1,166 @@
+"""Chain-parallel federated sampling over a 2-D device mesh.
+
+The reference's two outer parallelism axes — PyMC chains in separate
+host processes (reference: test_wrapper_ops.py:305-317, ``cores=4``) and
+federated shards behind gRPC (reference: demo_model.py:33-36) — become
+the two axes of one device mesh:
+
+    mesh = make_mesh({"chains": C, "shards": S})
+
+One ``shard_map`` spans both axes: chain state is partitioned over
+``"chains"`` and replicated over ``"shards"``; shard data is partitioned
+over ``"shards"`` and replicated over ``"chains"``.  Inside, each chain
+row runs an independent NUTS/HMC transition whose logp+grad reduces over
+``"shards"`` with ``lax.psum`` — so the collective rides ICI within a
+row, and chains never communicate at all.  Every device executes the
+same program (SPMD); per-row control flow stays in lockstep because all
+row members see identical psum results.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.flatten_util import ravel_pytree
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..samplers.hmc import HMCState, hmc_init, hmc_step
+from ..samplers.nuts import nuts_step
+from .mesh import CHAINS_AXIS, SHARDS_AXIS
+from .sharded import _leading_dim, _shard_data_to_mesh
+
+
+def multichain_sample(
+    per_shard_logp: Callable[[Any, Any], jax.Array],
+    data: Any,
+    init_params: Any,
+    *,
+    mesh: Mesh,
+    key: jax.Array,
+    num_samples: int = 100,
+    step_size: float = 0.1,
+    kernel: str = "nuts",
+    max_depth: int = 6,
+    num_hmc_steps: int = 16,
+    prior_logp: Optional[Callable[[Any], jax.Array]] = None,
+    chains_axis: str = CHAINS_AXIS,
+    shards_axis: str = SHARDS_AXIS,
+    jitter: float = 0.5,
+):
+    """Run C independent chains over S-sharded data in ONE SPMD program.
+
+    ``init_params`` is a single params pytree; each chain starts from a
+    jittered copy.  Returns ``(draws, accept, unravel)`` where ``draws``
+    has shape ``(chains, num_samples, dim)`` (flat parameter vectors).
+
+    This is the scale path — for single-host convenience sampling use
+    :func:`pytensor_federated_tpu.samplers.sample` (vmap chains).
+    """
+    if kernel not in ("nuts", "hmc"):
+        raise ValueError(f"unknown kernel {kernel!r}")
+    n_chains = mesh.shape[chains_axis]
+    flat0, unravel = ravel_pytree(init_params)
+    dim = flat0.shape[0]
+    dtype = flat0.dtype
+
+    k_init, k_run = jax.random.split(key)
+    init_flat = flat0 + jitter * jax.random.normal(
+        k_init, (n_chains, dim), dtype
+    )
+    chain_keys = jax.random.split(k_run, n_chains)
+
+    n_shards = _leading_dim(data)
+    if n_shards % mesh.shape[shards_axis] != 0:
+        raise ValueError(
+            f"n_shards={n_shards} not divisible by mesh axis "
+            f"{shards_axis!r} of size {mesh.shape[shards_axis]}"
+        )
+    placed = _shard_data_to_mesh(data, mesh, shards_axis)
+    data_specs = jax.tree_util.tree_map(lambda _: P(shards_axis), placed)
+
+    def _det_allsum(t):
+        """Deterministic cross-shard sum: all_gather + fixed-order local sum.
+
+        Two reasons this is NOT a plain ``lax.psum``:
+        (1) gradients: total grad = sum of per-rank local grads, computed
+        explicitly rather than relying on collective transposes inside
+        ``shard_map``; (2) *bitwise determinism across ranks* — NUTS's
+        tree-doubling ``while_loop`` is data-dependent, so every rank in
+        a chain row must take identical branches or the row's next
+        collective deadlocks.  All-reduce implementations may reduce in
+        rank-dependent order; gathering and summing locally in a fixed
+        order makes every rank's result bit-identical.
+        """
+        return jnp.sum(jax.lax.all_gather(t, shards_axis), axis=0)
+
+    def local_logp_and_grad(x, local_data):
+        """logp+grad of one chain: local value_and_grad over this rank's
+        shard block, then a deterministic sum over the shards axis."""
+
+        def local_lp(x):
+            params = unravel(x)
+            lp = jax.vmap(lambda d: per_shard_logp(params, d))(local_data)
+            return jnp.sum(lp)
+
+        lv, lg = jax.value_and_grad(local_lp)(x)
+        v = _det_allsum(lv)
+        g = _det_allsum(lg)
+        if prior_logp is not None:
+            pv, pg = jax.value_and_grad(lambda x: prior_logp(unravel(x)))(x)
+            v = v + pv
+            g = g + pg
+        return v, g
+
+    inv_mass = jnp.ones((dim,), dtype)
+
+    def chain_block(x0_block, keys_block, local_data):
+        """Runs this device's chains (block of the chains axis)."""
+
+        def one_chain(x0, key):
+            lg = lambda x: local_logp_and_grad(x, local_data)
+            state = hmc_init(lg, x0)
+
+            def body(state, key):
+                if kernel == "nuts":
+                    state, info = nuts_step(
+                        lg,
+                        state,
+                        key,
+                        step_size=step_size,
+                        inv_mass=inv_mass,
+                        max_depth=max_depth,
+                    )
+                else:
+                    state, info = hmc_step(
+                        lg,
+                        state,
+                        key,
+                        step_size=step_size,
+                        inv_mass=inv_mass,
+                        num_steps=num_hmc_steps,
+                    )
+                return state, (state.x, info.accept_prob)
+
+            keys = jax.random.split(key, num_samples)
+            _, (draws, accept) = jax.lax.scan(body, state, keys)
+            return draws, accept
+
+        return jax.vmap(one_chain)(x0_block, keys_block)
+
+    fn = shard_map(
+        chain_block,
+        mesh=mesh,
+        in_specs=(P(chains_axis), P(chains_axis), data_specs),
+        out_specs=(P(chains_axis), P(chains_axis)),
+        check_vma=False,
+    )
+
+    # Chain state enters sharded over chains, replicated over shards.
+    init_flat = jax.device_put(init_flat, NamedSharding(mesh, P(chains_axis)))
+    chain_keys = jax.device_put(chain_keys, NamedSharding(mesh, P(chains_axis)))
+    draws, accept = jax.jit(fn)(init_flat, chain_keys, placed)
+    return draws, accept, unravel

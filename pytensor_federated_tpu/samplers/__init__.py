@@ -1,0 +1,24 @@
+"""On-device samplers (replaces the reference's PyMC driver dependency)."""
+
+from .hmc import HMCState, find_reasonable_step_size, hmc_init, hmc_step, leapfrog
+from .mcmc import SampleResult, find_map, sample
+from .metropolis import metropolis_init, metropolis_step
+from .nuts import NUTSInfo, nuts_step
+from .util import AdaptSchedule, flatten_logp
+
+__all__ = [
+    "AdaptSchedule",
+    "HMCState",
+    "NUTSInfo",
+    "SampleResult",
+    "find_map",
+    "find_reasonable_step_size",
+    "flatten_logp",
+    "hmc_init",
+    "hmc_step",
+    "leapfrog",
+    "metropolis_init",
+    "metropolis_step",
+    "nuts_step",
+    "sample",
+]

@@ -1,0 +1,147 @@
+"""Hamiltonian Monte Carlo: leapfrog integrator + HMC kernel.
+
+All control flow is ``lax``-level (static leapfrog count per step via
+``lax.scan``) so a full HMC transition — including the federated
+logp+grad psum — is one XLA program.  The gradient evaluations that cost
+the reference a round of gRPC round-trips each (reference:
+op_async.py:107-132, §3.3 of SURVEY.md) are here just fused device code.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class IntegratorState(NamedTuple):
+    x: jax.Array
+    r: jax.Array
+    logp: jax.Array
+    grad: jax.Array
+
+
+def leapfrog(
+    logp_and_grad: Callable,
+    state: IntegratorState,
+    step_size,
+    inv_mass: jax.Array,
+) -> IntegratorState:
+    """One leapfrog step with diagonal mass matrix."""
+    r_half = state.r + 0.5 * step_size * state.grad
+    x_new = state.x + step_size * inv_mass * r_half
+    logp_new, grad_new = logp_and_grad(x_new)
+    r_new = r_half + 0.5 * step_size * grad_new
+    return IntegratorState(x_new, r_new, logp_new, grad_new)
+
+
+def kinetic_energy(r: jax.Array, inv_mass: jax.Array) -> jax.Array:
+    return 0.5 * jnp.sum(inv_mass * r**2)
+
+
+def sample_momentum(key, x: jax.Array, inv_mass: jax.Array) -> jax.Array:
+    """r ~ N(0, M) with M = diag(1/inv_mass)."""
+    return jax.random.normal(key, x.shape, x.dtype) / jnp.sqrt(inv_mass)
+
+
+class HMCState(NamedTuple):
+    x: jax.Array
+    logp: jax.Array
+    grad: jax.Array
+
+
+class HMCInfo(NamedTuple):
+    accept_prob: jax.Array
+    accepted: jax.Array
+    energy: jax.Array
+    diverging: jax.Array
+
+
+def hmc_init(logp_and_grad: Callable, x0: jax.Array) -> HMCState:
+    logp, grad = logp_and_grad(x0)
+    return HMCState(x0, logp, grad)
+
+
+def hmc_step(
+    logp_and_grad: Callable,
+    state: HMCState,
+    key: jax.Array,
+    *,
+    step_size,
+    inv_mass: jax.Array,
+    num_steps: int = 16,
+    divergence_threshold: float = 1000.0,
+):
+    """One HMC transition with ``num_steps`` leapfrog steps (static)."""
+    k_mom, k_acc = jax.random.split(key)
+    r0 = sample_momentum(k_mom, state.x, inv_mass)
+    energy0 = -state.logp + kinetic_energy(r0, inv_mass)
+
+    init = IntegratorState(state.x, r0, state.logp, state.grad)
+
+    def body(carry, _):
+        return leapfrog(logp_and_grad, carry, step_size, inv_mass), None
+
+    end, _ = jax.lax.scan(body, init, None, length=num_steps)
+
+    energy1 = -end.logp + kinetic_energy(end.r, inv_mass)
+    delta = energy0 - energy1
+    delta = jnp.where(jnp.isnan(delta), -jnp.inf, delta)
+    diverging = -delta > divergence_threshold
+    accept_prob = jnp.minimum(1.0, jnp.exp(delta))
+    accept = jax.random.uniform(k_acc, dtype=accept_prob.dtype) < accept_prob
+
+    new_state = HMCState(
+        x=jnp.where(accept, end.x, state.x),
+        logp=jnp.where(accept, end.logp, state.logp),
+        grad=jnp.where(accept, end.grad, state.grad),
+    )
+    # Report the energy of the state the chain actually occupies, so
+    # energy-marginal diagnostics (E-BFMI) are not polluted by rejected
+    # (possibly divergent) trajectory endpoints.
+    info = HMCInfo(accept_prob, accept, jnp.where(accept, energy1, energy0), diverging)
+    return new_state, info
+
+
+def find_reasonable_step_size(
+    logp_and_grad: Callable,
+    x0: jax.Array,
+    key: jax.Array,
+    inv_mass: jax.Array,
+    *,
+    init_step_size: float = 1.0,
+    target: float = 0.8,
+    max_iters: int = 60,
+) -> jax.Array:
+    """Heuristic initial step size (Hoffman & Gelman 2014, Algorithm 4)."""
+    logp0, grad0 = logp_and_grad(x0)
+    r0 = sample_momentum(key, x0, inv_mass)
+    energy0 = -logp0 + kinetic_energy(r0, inv_mass)
+
+    def accept_prob(step_size):
+        st = IntegratorState(x0, r0, logp0, grad0)
+        end = leapfrog(logp_and_grad, st, step_size, inv_mass)
+        energy1 = -end.logp + kinetic_energy(end.r, inv_mass)
+        delta = energy0 - energy1
+        return jnp.where(jnp.isnan(delta), -jnp.inf, delta)
+
+    init_delta = accept_prob(jnp.asarray(init_step_size, x0.dtype))
+    direction = jnp.where(init_delta > jnp.log(target), 1.0, -1.0)
+
+    def cond(carry):
+        step_size, i = carry
+        delta = accept_prob(step_size)
+        crossed = jnp.where(
+            direction > 0, delta < jnp.log(target), delta > jnp.log(target)
+        )
+        return (~crossed) & (i < max_iters)
+
+    def body(carry):
+        step_size, i = carry
+        return step_size * (2.0**direction), i + 1
+
+    step_size, _ = jax.lax.while_loop(
+        cond, body, (jnp.asarray(init_step_size, x0.dtype), jnp.zeros((), jnp.int32))
+    )
+    return step_size

@@ -1,0 +1,299 @@
+"""The sampling front door: warmup adaptation, chains, on-device scan.
+
+Replaces the reference's driver-side ``pm.sample`` / ``pm.find_MAP``
+(reference: demo_model.py:38-42).  Where the reference runs chains in
+separate host processes with the federated client re-pickled per process
+(reference: service.py:266-275, test_wrapper_ops.py:305-317), chains here
+are a ``vmap`` axis — shardable over a mesh ``"chains"`` axis — and the
+entire warmup+sampling loop is a ``lax.scan`` on device.
+
+Returned samples keep the user's params-pytree structure with leading
+``(chains, draws)`` axes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .hmc import HMCState, find_reasonable_step_size, hmc_init, hmc_step
+from .metropolis import MetropolisState, metropolis_init, metropolis_step
+from .nuts import nuts_step
+from .util import (
+    AdaptSchedule,
+    da_init,
+    da_update,
+    flatten_logp,
+    welford_init,
+    welford_update,
+    welford_variance,
+)
+
+
+class WarmupResult(NamedTuple):
+    state: HMCState
+    step_size: jax.Array
+    inv_mass: jax.Array
+
+
+def _warmup(
+    logp_and_grad,
+    x0,
+    key,
+    *,
+    num_warmup: int,
+    kernel_step,
+    target_accept: float = 0.8,
+) -> WarmupResult:
+    """Stan-style three-stage warmup: step size + diagonal mass."""
+    dtype = x0.dtype
+    dim = x0.shape[0]
+    sched = AdaptSchedule.make(num_warmup)
+    k_init, k_scan = jax.random.split(key)
+
+    inv_mass = jnp.ones((dim,), dtype)
+    step0 = find_reasonable_step_size(logp_and_grad, x0, k_init, inv_mass)
+    da = da_init(step0)
+    wf = welford_init(dim, dtype)
+    state = hmc_init(logp_and_grad, x0)
+
+    def body(carry, inputs):
+        state, da, wf, inv_mass = carry
+        key, update_mass, in_slow = inputs
+        step_size = jnp.exp(da.log_step)
+        state, info = kernel_step(
+            state, key, step_size=step_size, inv_mass=inv_mass
+        )
+        da = da_update(da, info.accept_prob, target=target_accept)
+        wf = jax.tree_util.tree_map(
+            partial(jnp.where, in_slow), welford_update(wf, state.x), wf
+        )
+
+        def refresh(da, wf, inv_mass):
+            new_inv_mass = welford_variance(wf)
+            # Restart step-size search around the current averaged value.
+            new_da = da_init(jnp.exp(da.log_step_avg))
+            return new_da, welford_init(dim, dtype), new_inv_mass
+
+        da, wf, inv_mass = jax.tree_util.tree_map(
+            partial(jnp.where, update_mass),
+            refresh(da, wf, inv_mass),
+            (da, wf, inv_mass),
+        )
+        return (state, da, wf, inv_mass), None
+
+    keys = jax.random.split(k_scan, num_warmup)
+    (state, da, _, inv_mass), _ = jax.lax.scan(
+        body, (state, da, wf, inv_mass), (keys, sched.update_mass, sched.in_slow)
+    )
+    # With num_warmup=0 no da_update ever ran and log_step_avg is still
+    # its zero init — fall back to the found reasonable step size.
+    log_step = jnp.where(da.count > 0, da.log_step_avg, da.log_step)
+    return WarmupResult(state, jnp.exp(log_step), inv_mass)
+
+
+@dataclasses.dataclass
+class SampleResult:
+    """Posterior draws plus per-draw diagnostics."""
+
+    samples: Any  # user pytree with leading (chains, draws)
+    stats: dict  # accept_prob / diverging / depth / energy, (chains, draws)
+    step_size: jax.Array  # (chains,)
+    inv_mass: jax.Array  # (chains, dim)
+
+
+def sample(
+    logp_fn: Callable[[Any], jax.Array],
+    init_params: Any,
+    *,
+    key: jax.Array,
+    num_warmup: int = 500,
+    num_samples: int = 500,
+    num_chains: int = 4,
+    kernel: str = "nuts",
+    max_depth: int = 8,
+    num_hmc_steps: int = 16,
+    target_accept: float = 0.8,
+    jitter: float = 1.0,
+    logp_and_grad_fn: Optional[Callable] = None,
+) -> SampleResult:
+    """Run adaptive MCMC against ``logp_fn`` (params pytree -> scalar).
+
+    ``pm.sample`` analog (reference: demo_model.py:40-42).  ``kernel`` is
+    one of ``"nuts"`` (default, matching the reference's NUTS driver),
+    ``"hmc"``, or ``"metropolis"`` (the reference's CI sampler,
+    test_wrapper_ops.py:97-103).  Pass ``logp_and_grad_fn`` to supply a
+    fused value+grad (e.g. ``FederatedLogp.logp_and_grad`` or a
+    forward-supplied-gradient :class:`~pytensor_federated_tpu.LogpGradOp`);
+    otherwise gradients come from autodiff of ``logp_fn``.
+
+    Everything (warmup + sampling, all chains) runs in one jitted
+    program; chains are a vmap axis.
+    """
+    flat_logp, flat_init, unravel = flatten_logp(logp_fn, init_params)
+    dtype = flat_init.dtype
+
+    if logp_and_grad_fn is not None:
+        from jax.flatten_util import ravel_pytree
+
+        def lg(x):
+            v, g = logp_and_grad_fn(unravel(x))
+            return v, ravel_pytree(g)[0]
+
+    else:
+
+        def lg(x):
+            return jax.value_and_grad(flat_logp)(x)
+
+    k_jit, k_run = jax.random.split(key)
+    init_flat = jnp.broadcast_to(flat_init, (num_chains,) + flat_init.shape)
+    if jitter:
+        init_flat = init_flat + jitter * jax.random.normal(
+            k_jit, init_flat.shape, dtype
+        )
+
+    if kernel == "metropolis":
+        return _sample_metropolis(
+            flat_logp, unravel, init_flat, k_run, num_warmup, num_samples
+        )
+
+    if kernel == "nuts":
+        kernel_step = partial(nuts_step, lg, max_depth=max_depth)
+    elif kernel == "hmc":
+        kernel_step = partial(hmc_step, lg, num_steps=num_hmc_steps)
+    else:
+        raise ValueError(f"unknown kernel {kernel!r}")
+
+    def one_chain(x0, key):
+        k_warm, k_samp = jax.random.split(key)
+        warm = _warmup(
+            lg,
+            x0,
+            k_warm,
+            num_warmup=num_warmup,
+            kernel_step=kernel_step,
+            target_accept=target_accept,
+        )
+
+        def body(state, key):
+            state, info = kernel_step(
+                state,
+                key,
+                step_size=warm.step_size,
+                inv_mass=warm.inv_mass,
+            )
+            stats = {
+                "accept_prob": info.accept_prob,
+                "diverging": info.diverging,
+                "energy": info.energy,
+            }
+            if hasattr(info, "depth"):
+                stats["depth"] = info.depth
+            return state, (state.x, stats)
+
+        keys = jax.random.split(k_samp, num_samples)
+        _, (draws, stats) = jax.lax.scan(body, warm.state, keys)
+        return draws, stats, warm.step_size, warm.inv_mass
+
+    chain_keys = jax.random.split(k_run, num_chains)
+    draws, stats, step_sizes, inv_masses = jax.jit(jax.vmap(one_chain))(
+        init_flat, chain_keys
+    )
+    samples = jax.vmap(jax.vmap(unravel))(draws)
+    return SampleResult(
+        samples=samples, stats=stats, step_size=step_sizes, inv_mass=inv_masses
+    )
+
+
+def _sample_metropolis(flat_logp, unravel, init_flat, key, num_warmup, num_samples):
+    """Adaptive-scale random-walk Metropolis over all chains."""
+    dtype = init_flat.dtype
+
+    def one_chain(x0, key):
+        state = metropolis_init(flat_logp, x0)
+        log_scale0 = jnp.zeros((), dtype)
+
+        # Warmup: Robbins-Monro proposal-scale adaptation toward 0.35
+        # acceptance (the reference relies on PyMC's tuning phase,
+        # reference: test_wrapper_ops.py:99 ``tune=200``).
+        def warm_scan(carry, key):
+            state, log_scale = carry
+            prev_acc = state.n_accept
+            state = metropolis_step(
+                flat_logp, state, key, step_size=jnp.exp(log_scale)
+            )
+            accepted = state.n_accept - prev_acc
+            log_scale = log_scale + 0.1 * (accepted - 0.35)
+            return (state, log_scale), None
+
+        keys = jax.random.split(key, num_warmup + num_samples)
+        (state, log_scale), _ = jax.lax.scan(
+            warm_scan, (state, log_scale0), keys[:num_warmup]
+        )
+
+        def body(state, key):
+            state = metropolis_step(
+                flat_logp, state, key, step_size=jnp.exp(log_scale)
+            )
+            return state, (state.x, {"accept_total": state.n_accept})
+
+        _, (draws, stats) = jax.lax.scan(body, state, keys[num_warmup:])
+        return draws, stats, jnp.exp(log_scale)
+
+    chain_keys = jax.random.split(key, init_flat.shape[0])
+    draws, stats, scales = jax.jit(jax.vmap(one_chain))(init_flat, chain_keys)
+    samples = jax.vmap(jax.vmap(unravel))(draws)
+    return SampleResult(
+        samples=samples,
+        stats=stats,
+        step_size=scales,
+        inv_mass=jnp.ones_like(init_flat),
+    )
+
+
+def find_map(
+    logp_fn: Callable[[Any], jax.Array],
+    init_params: Any,
+    *,
+    num_steps: int = 500,
+    learning_rate: float = 0.05,
+    logp_and_grad_fn: Optional[Callable] = None,
+) -> Any:
+    """Maximum a-posteriori point via Adam — ``pm.find_MAP`` analog
+    (reference: demo_model.py:38-39)."""
+    import optax
+
+    flat_logp, flat_init, unravel = flatten_logp(logp_fn, init_params)
+
+    if logp_and_grad_fn is not None:
+        from jax.flatten_util import ravel_pytree
+
+        def neg_grad(x):
+            _, g = logp_and_grad_fn(unravel(x))
+            return -ravel_pytree(g)[0]
+
+    else:
+
+        def neg_grad(x):
+            return -jax.grad(flat_logp)(x)
+
+    opt = optax.adam(learning_rate)
+
+    @jax.jit
+    def run(x0):
+        def body(carry, _):
+            x, opt_state = carry
+            g = neg_grad(x)
+            updates, opt_state = opt.update(g, opt_state, x)
+            return (optax.apply_updates(x, updates), opt_state), None
+
+        (x, _), _ = jax.lax.scan(
+            body, (x0, opt.init(x0)), None, length=num_steps
+        )
+        return x
+
+    return unravel(run(flat_init))

@@ -1,0 +1,47 @@
+"""Gaussian random-walk Metropolis — the reference's CI sampler.
+
+The reference's end-to-end tests sample with PyMC Metropolis against the
+federated logp (reference: test_wrapper_ops.py:80-118); this is the same
+algorithm as a pure-JAX kernel so the whole chain runs in one
+``lax.scan`` on device.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class MetropolisState(NamedTuple):
+    x: jax.Array
+    logp: jax.Array
+    n_accept: jax.Array
+
+
+def metropolis_init(flat_logp: Callable, x0: jax.Array) -> MetropolisState:
+    return MetropolisState(
+        x=x0, logp=flat_logp(x0), n_accept=jnp.zeros((), x0.dtype)
+    )
+
+
+def metropolis_step(
+    flat_logp: Callable,
+    state: MetropolisState,
+    key: jax.Array,
+    *,
+    step_size,
+) -> MetropolisState:
+    k_prop, k_acc = jax.random.split(key)
+    prop = state.x + step_size * jax.random.normal(
+        k_prop, state.x.shape, state.x.dtype
+    )
+    logp_prop = flat_logp(prop)
+    log_u = jnp.log(jax.random.uniform(k_acc, dtype=state.logp.dtype))
+    accept = log_u < (logp_prop - state.logp)
+    return MetropolisState(
+        x=jnp.where(accept, prop, state.x),
+        logp=jnp.where(accept, logp_prop, state.logp),
+        n_accept=state.n_accept + accept.astype(state.x.dtype),
+    )

@@ -1,0 +1,150 @@
+"""Sampler building blocks: flattening, Welford variance, dual averaging.
+
+The reference delegates sampling to PyMC (reference: demo_model.py:38-42
+``pm.find_MAP`` + ``pm.sample``); this framework ships its own on-device
+samplers so the whole NUTS step — including the federated logp+grad —
+compiles into one XLA program with no host round-trips (SURVEY §7 step 3).
+
+Everything here is shape-static and jit/scan/vmap-safe.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+
+def flatten_logp(logp_fn: Callable[[Any], jax.Array], example_params: Any):
+    """Return ``(flat_logp, flat_init, unravel)`` over a flat float vector.
+
+    Samplers work on a single flat vector (good for the VPU: one fused
+    elementwise update per leapfrog step instead of a pytree of tiny
+    kernels); ``unravel`` restores user structure at the boundary.
+    """
+    flat_init, unravel = ravel_pytree(example_params)
+
+    def flat_logp(x):
+        return logp_fn(unravel(x))
+
+    return flat_logp, flat_init, unravel
+
+
+class WelfordState(NamedTuple):
+    """Streaming mean/variance (diagonal) — mass-matrix adaptation."""
+
+    mean: jax.Array
+    m2: jax.Array
+    count: jax.Array
+
+
+def welford_init(dim: int, dtype=jnp.float32) -> WelfordState:
+    return WelfordState(
+        mean=jnp.zeros((dim,), dtype),
+        m2=jnp.zeros((dim,), dtype),
+        count=jnp.zeros((), dtype),
+    )
+
+
+def welford_update(state: WelfordState, x: jax.Array) -> WelfordState:
+    count = state.count + 1.0
+    delta = x - state.mean
+    mean = state.mean + delta / count
+    m2 = state.m2 + delta * (x - mean)
+    return WelfordState(mean, m2, count)
+
+
+def welford_variance(state: WelfordState, *, regularize: bool = True) -> jax.Array:
+    """Diagonal variance estimate, Stan-style regularized toward unit."""
+    var = state.m2 / jnp.maximum(state.count - 1.0, 1.0)
+    if regularize:
+        n = state.count
+        var = (n / (n + 5.0)) * var + 1e-3 * (5.0 / (n + 5.0))
+    return var
+
+
+class DualAveragingState(NamedTuple):
+    """Nesterov dual averaging on log step size (Hoffman & Gelman 2014)."""
+
+    log_step: jax.Array
+    log_step_avg: jax.Array
+    h_avg: jax.Array
+    mu: jax.Array
+    count: jax.Array
+
+
+def da_init(step_size: jax.Array) -> DualAveragingState:
+    log_step = jnp.log(step_size)
+    return DualAveragingState(
+        log_step=log_step,
+        log_step_avg=jnp.zeros_like(log_step),
+        h_avg=jnp.zeros_like(log_step),
+        mu=jnp.log(10.0) + log_step,
+        count=jnp.zeros_like(log_step),
+    )
+
+
+def da_update(
+    state: DualAveragingState,
+    accept_prob: jax.Array,
+    *,
+    target: float = 0.8,
+    gamma: float = 0.05,
+    t0: float = 10.0,
+    kappa: float = 0.75,
+) -> DualAveragingState:
+    count = state.count + 1.0
+    w = 1.0 / (count + t0)
+    h_avg = (1.0 - w) * state.h_avg + w * (target - accept_prob)
+    log_step = state.mu - jnp.sqrt(count) / gamma * h_avg
+    eta = count ** (-kappa)
+    log_step_avg = eta * log_step + (1.0 - eta) * state.log_step_avg
+    return DualAveragingState(log_step, log_step_avg, h_avg, state.mu, count)
+
+
+@dataclasses.dataclass(frozen=True)
+class AdaptSchedule:
+    """Stan-style three-stage warmup window schedule (static, host-side).
+
+    ``update_mass[i]`` is True at the last step of each slow window —
+    the moment the mass matrix refreshes and dual averaging restarts.
+    """
+
+    update_mass: jnp.ndarray  # bool[num_warmup]
+    in_slow: jnp.ndarray  # bool[num_warmup] — collect samples into Welford
+
+    @staticmethod
+    def make(
+        num_warmup: int,
+        *,
+        init_buffer: int = 75,
+        term_buffer: int = 50,
+        base_window: int = 25,
+    ) -> "AdaptSchedule":
+        import numpy as np
+
+        update = np.zeros(num_warmup, dtype=bool)
+        slow = np.zeros(num_warmup, dtype=bool)
+        if num_warmup < 20:
+            return AdaptSchedule(jnp.asarray(update), jnp.asarray(slow))
+        if init_buffer + base_window + term_buffer > num_warmup:
+            # Scale buffers down proportionally (Stan's fallback).
+            total = init_buffer + base_window + term_buffer
+            init_buffer = int(0.15 * num_warmup)
+            term_buffer = int(0.1 * num_warmup)
+            del total
+        start = init_buffer
+        window = base_window
+        while start < num_warmup - term_buffer:
+            end = min(start + window, num_warmup - term_buffer)
+            # If the remaining tail can't fit another window, absorb it.
+            if end + window > num_warmup - term_buffer:
+                end = num_warmup - term_buffer
+            slow[start:end] = True
+            update[end - 1] = True
+            start = end
+            window *= 2
+        return AdaptSchedule(jnp.asarray(update), jnp.asarray(slow))

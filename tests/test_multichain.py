@@ -1,0 +1,42 @@
+"""2-D (chains x shards) mesh sampling test — the multi-chip scale path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytensor_federated_tpu.parallel import make_mesh
+from pytensor_federated_tpu.parallel.multichain import multichain_sample
+
+
+def per_shard_logp(params, shard):
+    x = shard
+    return jnp.sum(-0.5 * (x - params["mu"]) ** 2)
+
+
+@pytest.mark.parametrize("kernel", ["nuts", "hmc"])
+def test_multichain_2d_mesh(devices8, kernel):
+    mesh = make_mesh({"chains": 2, "shards": 4}, devices=devices8)
+    rng = np.random.default_rng(0)
+    # 4 shards of 32 obs from N(2, 1): posterior of mu ~ N(~2, 1/128)
+    data = jnp.asarray(rng.normal(2.0, 1.0, size=(4, 32)).astype(np.float32))
+
+    draws, accept, unravel = multichain_sample(
+        per_shard_logp,
+        data,
+        {"mu": jnp.zeros(())},
+        mesh=mesh,
+        key=jax.random.PRNGKey(0),
+        num_samples=300,
+        step_size=0.08,
+        kernel=kernel,
+        jitter=0.2,
+    )
+    assert draws.shape == (2, 300, 1)
+    mu = np.asarray(draws)[:, 100:, 0]
+    post_mean = float(np.asarray(data).mean())
+    assert abs(mu.mean() - post_mean) < 0.1
+    # chains must differ (independent RNG per chain)
+    assert abs(mu[0].mean() - mu[1].mean()) < 0.2
+    assert not np.allclose(mu[0], mu[1])
+    assert np.asarray(accept).mean() > 0.5
