@@ -1,0 +1,147 @@
+"""Driver demo/CLI (reference: demo_model.py).
+
+Two modes:
+
+- ``--local`` (default, the TPU-native path): the federated shards live
+  on the device mesh; logp+grad is one fused XLA program; MAP + NUTS run
+  on device.  This is demo_node+demo_model collapsed into one process
+  (SURVEY §7, BASELINE.json north star).
+- ``--remote``: connect to a running node pool (``demo_node.py``) over
+  gRPC, embed each remote node as a differentiable blackbox op, fan the
+  nodes out concurrently per evaluation, and sample — the reference's
+  deployment, kept for true cross-trust-domain federation.
+
+Run:  python -m pytensor_federated_tpu.demos.demo_model --local
+      python -m pytensor_federated_tpu.demos.demo_model --remote --ports 50000 50001 50002
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+import numpy as np
+
+_log = logging.getLogger(__name__)
+
+
+def run_local(n_shards: int = 8, draws: int = 300):
+    import jax
+
+    from ..models.linear import FederatedLinearRegression, generate_node_data
+    from ..parallel import make_mesh
+
+    data, _ = generate_node_data(n_shards, n_obs=96)
+    n_dev = len(jax.devices())
+    mesh = make_mesh({"shards": n_dev}) if n_shards % n_dev == 0 else None
+    model = FederatedLinearRegression(data, mesh=mesh)
+
+    est = model.find_map(num_steps=1000)
+    _log.info(
+        "MAP: intercept=%.3f slope=%.3f",
+        float(est["intercept"]),
+        float(est["slope"]),
+    )
+    res = model.sample(
+        key=jax.random.PRNGKey(0),
+        num_warmup=draws,
+        num_samples=draws,
+        num_chains=2,
+        jitter=0.1,
+    )
+    slope = np.asarray(res.samples["slope"])
+    _log.info(
+        "posterior slope: median=%.3f sd=%.3f (truth 2.0)",
+        float(np.median(slope)),
+        float(slope.std()),
+    )
+    return res
+
+
+def run_remote(host: str, ports, draws: int = 200, parallel: bool = True):
+    """Sample against remote gRPC nodes (reference: demo_model.py:15-45).
+
+    Each node is one term of the posterior; with ``parallel`` the nodes
+    evaluate concurrently through one fused fan-out op
+    (the reference's AsyncLogpGradOp + fuse_asyncs rewrite,
+    reference: demo_model.py:19-22).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import ParallelLogpGrad, blackbox_logp_grad
+    from ..samplers import sample
+    from ..service import LogpGradServiceClient
+
+    cpu = jax.devices("cpu")[0]
+    spec = (
+        jax.ShapeDtypeStruct((), jnp.float32),
+        jax.ShapeDtypeStruct((), jnp.float32),
+    )
+    clients = [
+        LogpGradServiceClient(host, p, use_stream=True) for p in ports
+    ]
+
+    if parallel:
+        fanout = ParallelLogpGrad(
+            [c.evaluate for c in clients], [spec] * len(clients)
+        )
+
+        def likelihood(params):
+            args = [(params["intercept"], params["slope"])] * len(clients)
+            return fanout.total_logp(args)
+
+    else:
+        ops = [blackbox_logp_grad(c.evaluate, spec) for c in clients]
+
+        def likelihood(params):
+            return sum(
+                op(params["intercept"], params["slope"])[0] for op in ops
+            )
+
+    def logp(params):
+        prior = -0.5 * (params["intercept"] ** 2 + params["slope"] ** 2) / 100.0
+        return prior + likelihood(params)
+
+    with jax.default_device(cpu):
+        res = sample(
+            logp,
+            {"intercept": jnp.zeros(()), "slope": jnp.zeros(())},
+            key=jax.random.PRNGKey(0),
+            num_warmup=draws,
+            num_samples=draws,
+            num_chains=1,
+            kernel="metropolis",  # gradient kernels also work; RWM keeps
+            # the demo's RPC volume small
+            jitter=0.5,
+        )
+    slope = np.asarray(res.samples["slope"])
+    _log.info(
+        "remote posterior slope: median=%.3f (truth 2.0)",
+        float(np.median(slope)),
+    )
+    return res
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--local", action="store_true")
+    parser.add_argument("--remote", action="store_true")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument(
+        "--ports", type=int, nargs="+", default=list(range(50000, 50003))
+    )
+    parser.add_argument("--draws", type=int, default=300)
+    parser.add_argument("--sequential", action="store_true")
+    args, _ = parser.parse_known_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    if args.remote:
+        run_remote(
+            args.host, args.ports, args.draws, parallel=not args.sequential
+        )
+    else:
+        run_local(draws=args.draws)
+
+
+if __name__ == "__main__":
+    main()

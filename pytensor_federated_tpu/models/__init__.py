@@ -1,0 +1,19 @@
+"""Model families covering the BASELINE.json benchmark configs."""
+
+from .glm import HierarchicalRadonGLM, generate_radon_data
+from .linear import FederatedLinearRegression, generate_node_data
+from .logistic import FederatedLogisticRegression, generate_logistic_data
+from .ode import LotkaVolterraModel, generate_lv_data, make_lv_model, rk4_integrate
+
+__all__ = [
+    "FederatedLinearRegression",
+    "FederatedLogisticRegression",
+    "HierarchicalRadonGLM",
+    "LotkaVolterraModel",
+    "generate_logistic_data",
+    "generate_lv_data",
+    "generate_node_data",
+    "generate_radon_data",
+    "make_lv_model",
+    "rk4_integrate",
+]

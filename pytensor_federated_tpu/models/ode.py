@@ -1,0 +1,168 @@
+"""Lotka-Volterra ODE parameter estimation — [theta] -> [LL, dLL] per shard.
+
+BASELINE.json config "Lotka-Volterra ODE param estimation: [theta] ->
+[LL, dLL] per shard": each federated shard owns a noisy observed
+predator/prey trajectory (e.g. replicate experiments or disjoint time
+windows); the driver infers the shared dynamics parameters.
+
+    du/dt = alpha*u - beta*u*v          (prey)
+    dv/dt = -gamma*v + delta*u*v        (predator)
+    y_obs ~ LogNormal(log(traj), sigma)
+
+The integrator is fixed-step RK4 under ``lax.scan`` — static step count,
+fully differentiable, and compiled once for all shards (the reference
+would run a SciPy solver per node behind gRPC; here dLL/dtheta flows
+through the integrator by autodiff, no adjoint hand-coding).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from ..parallel.sharded import FederatedLogp
+from .linear import _normal_logpdf
+
+
+def lv_vector_field(state, theta):
+    u, v = state[0], state[1]
+    alpha, beta, gamma, delta = theta
+    du = alpha * u - beta * u * v
+    dv = -gamma * v + delta * u * v
+    return jnp.stack([du, dv])
+
+
+def rk4_integrate(theta, y0, dt: float, n_steps: int) -> jax.Array:
+    """Fixed-step RK4; returns trajectory (n_steps+1, 2)."""
+
+    def step(y, _):
+        k1 = lv_vector_field(y, theta)
+        k2 = lv_vector_field(y + 0.5 * dt * k1, theta)
+        k3 = lv_vector_field(y + 0.5 * dt * k2, theta)
+        k4 = lv_vector_field(y + dt * k3, theta)
+        y_next = y + (dt / 6.0) * (k1 + 2 * k2 + 2 * k3 + k4)
+        return y_next, y_next
+
+    _, traj = jax.lax.scan(step, y0, None, length=n_steps)
+    return jnp.concatenate([y0[None], traj], axis=0)
+
+
+def generate_lv_data(
+    n_shards: int = 8,
+    *,
+    n_obs: int = 32,
+    dt: float = 0.1,
+    obs_every: int = 4,
+    seed: int = 31,
+):
+    """Noisy replicate observations of one true trajectory per shard."""
+    rng = np.random.default_rng(seed)
+    theta_true = np.array([0.8, 0.4, 0.6, 0.3], dtype=np.float32)
+    y0 = jnp.array([1.5, 1.0], dtype=jnp.float32)
+    n_steps = n_obs * obs_every
+    traj = np.asarray(rk4_integrate(jnp.asarray(theta_true), y0, dt, n_steps))
+    obs_idx = np.arange(1, n_obs + 1) * obs_every
+    clean = traj[obs_idx]  # (n_obs, 2)
+    sigma_true = 0.1
+    shards = np.stack(
+        [
+            clean * np.exp(rng.normal(0, sigma_true, size=clean.shape))
+            for _ in range(n_shards)
+        ]
+    ).astype(np.float32)
+    meta = {
+        "theta": theta_true,
+        "sigma": sigma_true,
+        "y0": np.asarray(y0),
+        "dt": dt,
+        "n_steps": n_steps,
+        "obs_idx": obs_idx,
+    }
+    return jnp.asarray(shards), meta
+
+
+@dataclasses.dataclass
+class LotkaVolterraModel:
+    """Infer shared ODE params from per-shard noisy trajectories.
+
+    ``params``: ``log_theta`` (4,) — positivity via log-transform — and
+    ``log_sigma``.  The trajectory is integrated ONCE per logp
+    evaluation and shared across shards (it depends only on theta), so
+    the per-shard work is just the observation likelihood.
+    """
+
+    observations: jax.Array  # (n_shards, n_obs, 2)
+    y0: Any
+    dt: float
+    n_steps: int
+    obs_idx: Any
+    mesh: Optional[Mesh] = None
+
+    def __post_init__(self):
+        y0 = jnp.asarray(self.y0, dtype=jnp.float32)
+        obs_idx = jnp.asarray(self.obs_idx)
+
+        def per_shard_logp(params, shard_obs):
+            # NOTE: integrated per shard under vmap, but XLA CSEs the
+            # shard-invariant integration into one scan per program.
+            theta = jnp.exp(params["log_theta"])
+            traj = rk4_integrate(theta, y0, self.dt, self.n_steps)
+            mu = jnp.log(jnp.maximum(traj[obs_idx], 1e-6))
+            sigma = jnp.exp(params["log_sigma"])
+            ll = _normal_logpdf(jnp.log(shard_obs), mu, sigma) - jnp.log(
+                shard_obs
+            )
+            return jnp.sum(ll)
+
+        self.fed = FederatedLogp(per_shard_logp, self.observations, mesh=self.mesh)
+
+    def prior_logp(self, params: Any) -> jax.Array:
+        # LogNormal(log 0.5, 1) on each theta; HalfNormal(1) on sigma.
+        lp = jnp.sum(_normal_logpdf(params["log_theta"], jnp.log(0.5), 1.0))
+        s = jnp.exp(params["log_sigma"])
+        lp += -0.5 * s**2 + params["log_sigma"]
+        return lp
+
+    def logp(self, params: Any) -> jax.Array:
+        return self.prior_logp(params) + self.fed.logp(params)
+
+    def logp_and_grad(self, params: Any):
+        """[theta] -> [LL, dLL] — the reference's per-node contract,
+        fused across all shards."""
+        return jax.value_and_grad(self.logp)(params)
+
+    def init_params(self) -> Any:
+        return {
+            "log_theta": jnp.full((4,), jnp.log(0.5)),
+            "log_sigma": jnp.array(-2.0),
+        }
+
+    def find_map(self, **kwargs):
+        from ..samplers import find_map
+
+        return find_map(self.logp, self.init_params(), **kwargs)
+
+    def sample(self, *, key=None, **kwargs):
+        from ..samplers import sample
+
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        return sample(self.logp, self.init_params(), key=key, **kwargs)
+
+
+def make_lv_model(n_shards: int = 8, *, mesh: Optional[Mesh] = None, **kwargs):
+    obs, meta = generate_lv_data(n_shards, **kwargs)
+    model = LotkaVolterraModel(
+        observations=obs,
+        y0=meta["y0"],
+        dt=meta["dt"],
+        n_steps=meta["n_steps"],
+        obs_idx=meta["obs_idx"],
+        mesh=mesh,
+    )
+    return model, meta

@@ -75,6 +75,13 @@ def parallel_host_call(
     out_specs = [tuple(s) for s in out_specs]
     flat_spec = tuple(s for spec in out_specs for s in spec)
     n_out = [len(s) for s in out_specs]
+    # One PERSISTENT single-thread executor PER CHILD: node i always runs
+    # on its own long-lived thread, so thread-keyed client state (event
+    # loop, cached gRPC stream) maps 1:1 to nodes.  A shared pool would
+    # run node i on a different thread each call (N x N connections); a
+    # fresh pool per call would recycle thread idents, handing a new
+    # thread a cached channel bound to a dead thread's event loop.
+    executors = [ThreadPoolExecutor(max_workers=1) for _ in host_fns]
 
     def fn(*args_per_child) -> List[List[Array]]:
         if len(args_per_child) != len(host_fns):
@@ -92,10 +99,11 @@ def parallel_host_call(
             for k in arities:
                 chunks.append(flat_arrays[i : i + k])
                 i += k
-            with ThreadPoolExecutor(max_workers=max(1, len(host_fns))) as ex:
-                results = list(
-                    ex.map(lambda fa: list(fa[0](*fa[1])), zip(host_fns, chunks))
-                )
+            futures = [
+                ex.submit(lambda f=f, c=c: list(f(*c)))
+                for ex, f, c in zip(executors, host_fns, chunks)
+            ]
+            results = [fut.result() for fut in futures]
             flat = [
                 np.asarray(o, dtype=s.dtype)
                 for outs, spec in zip(results, out_specs)
@@ -103,7 +111,12 @@ def parallel_host_call(
             ]
             return tuple(flat)
 
-        flat_out = jax.pure_callback(host, flat_spec, *flat_in)
+        # sequential vmap: a batched caller (e.g. vmap over MCMC chains)
+        # replays the fan-out per batch element — remote nodes see a
+        # stream of requests, matching the lock-step wire protocol.
+        flat_out = jax.pure_callback(
+            host, flat_spec, *flat_in, vmap_method="sequential"
+        )
         out, i = [], 0
         for k in n_out:
             out.append(list(flat_out[i : i + k]))

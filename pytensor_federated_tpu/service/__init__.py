@@ -1,0 +1,34 @@
+"""Host-federation transport (reference L1-L2 analog; off the hot path)."""
+
+from .client import (
+    ArraysToArraysServiceClient,
+    ClientPrivates,
+    get_load_async,
+    get_loads_async,
+    thread_pid_id,
+)
+from .clients import LogpGradServiceClient, LogpServiceClient
+from .npwire import WireError, decode_arrays, encode_arrays
+from .server import (
+    ArraysToArraysService,
+    device_compute_fn,
+    run_node,
+    serve,
+)
+
+__all__ = [
+    "ArraysToArraysService",
+    "ArraysToArraysServiceClient",
+    "ClientPrivates",
+    "LogpGradServiceClient",
+    "LogpServiceClient",
+    "WireError",
+    "decode_arrays",
+    "device_compute_fn",
+    "encode_arrays",
+    "get_load_async",
+    "get_loads_async",
+    "run_node",
+    "serve",
+    "thread_pid_id",
+]

@@ -1,0 +1,260 @@
+"""Host-federation client: load balancing, connection cache, failover.
+
+Re-design of the reference's client core (reference: service.py:161-423).
+All the behavioral contracts survive:
+
+- **GetLoad polling**: all candidate servers queried concurrently with a
+  timeout; unresponsive servers map to ``None``
+  (reference: get_loads_async, service.py:161-211).
+- **Balanced connect**: shuffle + small de-sync sleep, then pick the
+  server with the fewest active clients
+  (reference: ClientPrivates.connect_balanced, service.py:240-263) via
+  :func:`..utils.argmin_none_or_func`.  Ports stay ``int`` s — the
+  reference's numpy-shuffle turned them into strings (SURVEY §5 quirks);
+  here the shuffle uses ``random.sample`` on the tuple list.
+- **Connection cache**: gRPC objects are not picklable, so they live in
+  a module-global dict keyed ``(id(client), pid, thread_id)`` and are
+  re-created lazily after the client is pickled into worker processes
+  (reference: _privates, service.py:214-275).
+- **uuid correlation** on every evaluation
+  (reference: service.py:321-322).
+- **Failover**: on a dead connection the cached channel is dropped and
+  the retry loop rebalances onto a surviving server
+  (reference: service.py:407-416); all servers dead raises
+  ``TimeoutError`` (reference: service.py:257-260).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import logging
+import os
+import random
+import threading
+import uuid as uuid_mod
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import grpc
+import numpy as np
+
+from ..utils import argmin_none_or_func, get_event_loop
+from .npwire import decode_arrays, encode_arrays
+from .server import EVALUATE, EVALUATE_STREAM, GET_LOAD
+
+_log = logging.getLogger(__name__)
+
+HostPort = Tuple[str, int]
+_identity = lambda b: b  # noqa: E731
+
+
+async def get_load_async(
+    host: str, port: int, *, timeout: float = 5.0
+) -> Optional[dict]:
+    """Query one server's load; ``None`` if unreachable/slow
+    (reference: get_load_async, service.py:161-186)."""
+    try:
+        async with grpc.aio.insecure_channel(f"{host}:{port}") as channel:
+            method = channel.unary_unary(
+                GET_LOAD, request_serializer=_identity, response_deserializer=_identity
+            )
+            reply = await asyncio.wait_for(method(b""), timeout=timeout)
+            return json.loads(reply.decode("utf-8"))
+    except (asyncio.TimeoutError, grpc.aio.AioRpcError, OSError, ConnectionError):
+        return None
+
+
+async def get_loads_async(
+    hosts_and_ports: Sequence[HostPort], *, timeout: float = 5.0
+) -> List[Optional[dict]]:
+    """Concurrent load query over the pool (reference: service.py:189-211)."""
+    return list(
+        await asyncio.gather(
+            *(get_load_async(h, p, timeout=timeout) for h, p in hosts_and_ports)
+        )
+    )
+
+
+@dataclasses.dataclass
+class ClientPrivates:
+    """Non-picklable per-(client,process,thread) connection state
+    (reference: ClientPrivates, service.py:214-263)."""
+
+    host: str
+    port: int
+    channel: grpc.aio.Channel
+    stream: Optional[grpc.aio.StreamStreamCall] = None
+
+    @staticmethod
+    async def connect(host: str, port: int, *, use_stream: bool) -> "ClientPrivates":
+        channel = grpc.aio.insecure_channel(f"{host}:{port}")
+        privates = ClientPrivates(host=host, port=port, channel=channel)
+        if use_stream:
+            method = channel.stream_stream(
+                EVALUATE_STREAM,
+                request_serializer=_identity,
+                response_deserializer=_identity,
+            )
+            privates.stream = method()
+        _log.info("connected to %s:%d (stream=%s)", host, port, use_stream)
+        return privates
+
+    @staticmethod
+    async def connect_balanced(
+        hosts_and_ports: Sequence[HostPort],
+        *,
+        use_stream: bool,
+        timeout: float = 5.0,
+        desync: Tuple[float, float] = (0.0, 0.05),
+    ) -> "ClientPrivates":
+        """Pick the least-loaded healthy server
+        (reference: connect_balanced, service.py:240-263)."""
+        candidates = random.sample(list(hosts_and_ports), k=len(hosts_and_ports))
+        # De-sync concurrent clients so they don't all pick the same
+        # server (the reference sleeps U[0.2, 2] s; that dominates
+        # connect latency, so the window here is 50 ms).
+        await asyncio.sleep(random.uniform(*desync))
+        loads = await get_loads_async(candidates, timeout=timeout)
+        best = argmin_none_or_func(loads, lambda l: l["n_clients"])
+        if best is None:
+            raise TimeoutError(
+                f"none of {len(candidates)} servers responded to GetLoad"
+            )
+        host, port = candidates[best]
+        return await ClientPrivates.connect(host, port, use_stream=use_stream)
+
+    async def close(self) -> None:
+        if self.stream is not None:
+            try:
+                self.stream.cancel()
+            except Exception:
+                pass
+            self.stream = None
+        await self.channel.close()
+
+
+# Module-global cache so client objects survive pickling into worker
+# processes and reconnect lazily per process/thread
+# (reference: _privates + thread_pid_id, service.py:266-275).
+# Keyed by a per-instance token rather than id(obj): CPython recycles
+# object addresses, so an id-keyed cache could hand a new client a dead
+# client's connection.  The token survives pickling, so a client copied
+# into a worker process keys the same logical identity there.
+_privates: Dict[Tuple[str, int, int], ClientPrivates] = {}
+
+
+def thread_pid_id(obj) -> Tuple[str, int, int]:
+    token = getattr(obj, "_cache_token", None) or str(id(obj))
+    return (token, os.getpid(), threading.get_ident())
+
+
+class ArraysToArraysServiceClient:
+    """Sync+async evaluation client with balancing and failover
+    (reference: ArraysToArraysServiceClient, service.py:326-423)."""
+
+    def __init__(
+        self,
+        host: Optional[str] = None,
+        port: Optional[int] = None,
+        *,
+        hosts_and_ports: Optional[Sequence[HostPort]] = None,
+        use_stream: bool = True,
+        retries: int = 2,
+    ):
+        if hosts_and_ports is None:
+            if host is None or port is None:
+                raise ValueError("pass host+port or hosts_and_ports")
+            hosts_and_ports = [(host, int(port))]
+        elif host is not None or port is not None:
+            raise ValueError("pass either host+port or hosts_and_ports, not both")
+        self.hosts_and_ports: List[HostPort] = [
+            (h, int(p)) for h, p in hosts_and_ports
+        ]
+        self.use_stream = use_stream
+        self.retries = retries
+        self._cache_token = uuid_mod.uuid4().hex
+
+    # -- connection management -------------------------------------------
+
+    async def _get_privates(self) -> ClientPrivates:
+        cid = thread_pid_id(self)
+        privates = _privates.get(cid)
+        if privates is None:
+            privates = await ClientPrivates.connect_balanced(
+                self.hosts_and_ports, use_stream=self.use_stream
+            )
+            _privates[cid] = privates
+        return privates
+
+    async def _drop_privates(self) -> None:
+        cid = thread_pid_id(self)
+        privates = _privates.pop(cid, None)
+        if privates is not None:
+            _log.warning(
+                "dropping connection to %s:%d", privates.host, privates.port
+            )
+            await privates.close()
+
+    def __del__(self):
+        # Best-effort stream teardown (reference: service.py:355-365).
+        cid = thread_pid_id(self)
+        privates = _privates.pop(cid, None)
+        if privates is not None and privates.stream is not None:
+            try:
+                privates.stream.cancel()
+            except Exception:
+                pass
+
+    # -- evaluation -------------------------------------------------------
+
+    async def _evaluate_once(self, request: bytes) -> bytes:
+        privates = await self._get_privates()
+        if privates.stream is not None:
+            # Lock-step bidi hot loop (reference: _streamed_evaluate,
+            # service.py:150-158).
+            await privates.stream.write(request)
+            reply = await privates.stream.read()
+            if reply is grpc.aio.EOF:
+                raise ConnectionError("stream closed by server")
+            return reply
+        method = privates.channel.unary_unary(
+            EVALUATE, request_serializer=_identity, response_deserializer=_identity
+        )
+        return await method(request)
+
+    async def evaluate_async(self, *arrays: np.ndarray) -> List[np.ndarray]:
+        """Evaluate with retry-and-rebalance failover
+        (reference: evaluate_async, service.py:376-423)."""
+        uuid = uuid_mod.uuid4().bytes
+        request = encode_arrays([np.asarray(a) for a in arrays], uuid=uuid)
+        last_exc: Optional[BaseException] = None
+        for _ in range(self.retries + 1):
+            try:
+                reply = await self._evaluate_once(request)
+            except (grpc.aio.AioRpcError, ConnectionError, OSError) as e:
+                last_exc = e
+                await self._drop_privates()
+                continue
+            outputs, reply_uuid, error = decode_arrays(reply)
+            if error is not None:
+                raise RuntimeError(f"server error: {error}")
+            if reply_uuid != uuid:
+                # A desynchronized lock-step stream (e.g. a previous call
+                # cancelled between write and read) stays off-by-one
+                # forever — drop it so the next call reconnects cleanly.
+                await self._drop_privates()
+                raise RuntimeError(
+                    "uuid mismatch: response does not correlate with request"
+                )
+            return outputs
+        raise (
+            last_exc
+            if last_exc is not None
+            else ConnectionError("evaluation failed")
+        )
+
+    def evaluate(self, *arrays: np.ndarray) -> List[np.ndarray]:
+        """Sync wrapper (reference: evaluate, service.py:371-374)."""
+        loop = get_event_loop()
+        return loop.run_until_complete(self.evaluate_async(*arrays))

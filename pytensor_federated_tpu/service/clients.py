@@ -1,0 +1,70 @@
+"""Modeling-signature client adapters over the generic transport client.
+
+Parity with the reference's L3 client adapters
+(reference: common.py:52-161): reshape the flat arrays reply into the
+logp / (logp, grads) signatures, sync and async.  These are what plugs
+into :func:`pytensor_federated_tpu.blackbox_logp_grad` /
+:class:`~pytensor_federated_tpu.ParallelLogpGrad` to make a *remote*
+federated node differentiable inside a JAX graph.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from .client import ArraysToArraysServiceClient, HostPort
+
+
+class LogpServiceClient:
+    """Remote node returning a scalar logp (reference: common.py:52-102)."""
+
+    def __init__(self, *args, **kwargs):
+        self._client = ArraysToArraysServiceClient(*args, **kwargs)
+
+    async def evaluate_async(self, *inputs: np.ndarray) -> np.ndarray:
+        outputs = await self._client.evaluate_async(*inputs)
+        if len(outputs) != 1:
+            raise RuntimeError(
+                f"logp node must return exactly one array, got {len(outputs)}"
+            )
+        logp = outputs[0]
+        if np.shape(logp) != ():
+            raise RuntimeError(f"logp must be scalar, got shape {np.shape(logp)}")
+        return logp
+
+    def evaluate(self, *inputs: np.ndarray) -> np.ndarray:
+        from ..utils import get_event_loop
+
+        return get_event_loop().run_until_complete(self.evaluate_async(*inputs))
+
+    __call__ = evaluate
+
+
+class LogpGradServiceClient:
+    """Remote node returning (logp, grads) (reference: common.py:105-161)."""
+
+    def __init__(self, *args, **kwargs):
+        self._client = ArraysToArraysServiceClient(*args, **kwargs)
+
+    async def evaluate_async(
+        self, *inputs: np.ndarray
+    ) -> Tuple[np.ndarray, List[np.ndarray]]:
+        outputs = await self._client.evaluate_async(*inputs)
+        if len(outputs) != 1 + len(inputs):
+            raise RuntimeError(
+                f"logp+grad node must return 1 + {len(inputs)} arrays, "
+                f"got {len(outputs)}"
+            )
+        logp, *grads = outputs
+        if np.shape(logp) != ():
+            raise RuntimeError(f"logp must be scalar, got shape {np.shape(logp)}")
+        return logp, grads
+
+    def evaluate(self, *inputs):
+        from ..utils import get_event_loop
+
+        return get_event_loop().run_until_complete(self.evaluate_async(*inputs))
+
+    __call__ = evaluate

@@ -1,0 +1,130 @@
+"""Binary ndarray wire format for the host-federation transport.
+
+Re-design of the reference's ``npproto`` protobuf wire format
+(reference: npproto/__init__.py:13-22, npproto/utils.py:9-24,
+protobufs/npproto/ndarray.proto): any buffer-protocol NumPy array
+round-trips as raw data bytes + dtype string + shape.  Differences from
+the reference, on purpose:
+
+- Simple length-prefixed framing instead of protobuf — no codegen, no
+  betterproto dependency, and the payload bytes are written with a
+  single memcpy per array.
+- Non-contiguous arrays are made contiguous at encode time instead of
+  shipping strides (the reference serializes strides; every consumer
+  immediately reshapes anyway, and contiguous payloads are what the
+  device wants).
+- ``dtype=object`` is rejected loudly.  The reference's README admits
+  object dtype "doesn't work" while its test serializes pointers that
+  only round-trip in-process (reference: README.md:30,
+  test_npproto.py:20) — here it is a hard error.
+
+A message frames N arrays plus a 16-byte correlation uuid (parity with
+the reference's uuid field, reference: rpc.py:37-39) and an optional
+error string.
+
+Layout (little-endian):
+  message: MAGIC(4s) version(u8) flags(u8) uuid(16s) n_arrays(u32)
+           [error: len(u32) utf8]  then per array:
+  array:   dtype_len(u16) dtype_str shape_ndim(u8) shape(u64*ndim)
+           data_len(u64) data_bytes
+"""
+
+from __future__ import annotations
+
+import struct
+import uuid as uuid_mod
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+MAGIC = b"NPW1"
+_FLAG_ERROR = 1
+
+
+class WireError(ValueError):
+    """Malformed or unsupported wire payload."""
+
+
+def encode_arrays(
+    arrays: Sequence[np.ndarray],
+    *,
+    uuid: Optional[bytes] = None,
+    error: Optional[str] = None,
+) -> bytes:
+    """Encode arrays (+uuid, +optional error) into one framed message."""
+    if uuid is None:
+        uuid = uuid_mod.uuid4().bytes
+    if len(uuid) != 16:
+        raise WireError(f"uuid must be 16 bytes, got {len(uuid)}")
+    flags = _FLAG_ERROR if error is not None else 0
+    parts: List[bytes] = [
+        struct.pack("<4sBB16sI", MAGIC, 1, flags, uuid, len(arrays))
+    ]
+    if error is not None:
+        err = error.encode("utf-8")
+        parts.append(struct.pack("<I", len(err)))
+        parts.append(err)
+    for a in arrays:
+        a = np.asarray(a)
+        if a.dtype == object:
+            raise WireError(
+                "dtype=object arrays cannot cross the wire (they serialize "
+                "pointers); use a structured or numeric dtype"
+            )
+        if not a.flags["C_CONTIGUOUS"]:
+            # NB: np.ascontiguousarray promotes 0-d to 1-d, so only call
+            # it when actually needed (0-d is always contiguous).
+            a = np.ascontiguousarray(a)
+        dt = a.dtype.str.encode("ascii")
+        parts.append(struct.pack("<H", len(dt)))
+        parts.append(dt)
+        parts.append(struct.pack("<B", a.ndim))
+        parts.append(struct.pack(f"<{a.ndim}Q", *a.shape))
+        data = a.tobytes()
+        parts.append(struct.pack("<Q", len(data)))
+        parts.append(data)
+    return b"".join(parts)
+
+
+def decode_arrays(buf: bytes) -> Tuple[List[np.ndarray], bytes, Optional[str]]:
+    """Decode a framed message -> (arrays, uuid, error)."""
+    try:
+        magic, version, flags, uuid, n = struct.unpack_from("<4sBB16sI", buf, 0)
+    except struct.error as e:
+        raise WireError(f"truncated header: {e}") from None
+    if magic != MAGIC:
+        raise WireError(f"bad magic {magic!r}")
+    if version != 1:
+        raise WireError(f"unsupported version {version}")
+    off = struct.calcsize("<4sBB16sI")
+    error = None
+    if flags & _FLAG_ERROR:
+        (elen,) = struct.unpack_from("<I", buf, off)
+        off += 4
+        error = buf[off : off + elen].decode("utf-8")
+        off += elen
+    arrays: List[np.ndarray] = []
+    for _ in range(n):
+        try:
+            (dtlen,) = struct.unpack_from("<H", buf, off)
+            off += 2
+            dt = np.dtype(buf[off : off + dtlen].decode("ascii"))
+            off += dtlen
+            (ndim,) = struct.unpack_from("<B", buf, off)
+            off += 1
+            shape = struct.unpack_from(f"<{ndim}Q", buf, off)
+            off += 8 * ndim
+            (dlen,) = struct.unpack_from("<Q", buf, off)
+            off += 8
+            data = buf[off : off + dlen]
+            if len(data) != dlen:
+                raise WireError("truncated array payload")
+            off += dlen
+        except struct.error as e:
+            raise WireError(f"truncated message: {e}") from None
+        try:
+            arrays.append(np.frombuffer(data, dtype=dt).reshape(shape).copy())
+        except ValueError as e:
+            # e.g. data_len inconsistent with shape * itemsize
+            raise WireError(f"corrupt array payload: {e}") from None
+    return arrays, uuid, error

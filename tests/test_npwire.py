@@ -1,0 +1,67 @@
+"""Wire-format round-trip tests (reference: test_npproto.py:11-31)."""
+
+import numpy as np
+import pytest
+
+from pytensor_federated_tpu.service.npwire import (
+    WireError,
+    decode_arrays,
+    encode_arrays,
+)
+
+CASES = [
+    np.float32(4.5),  # 0-d
+    np.array([1, 2, 3], dtype=np.int64),
+    np.random.default_rng(0).normal(size=(4, 5)),  # 2-D float64
+    np.array(["hello", "wire"]),  # unicode
+    np.array([np.datetime64("2026-07-29"), np.datetime64("2000-01-01")]),
+    np.arange(20, dtype=np.float32).reshape(4, 5)[:, ::2],  # non-contiguous
+    np.zeros((0, 3), dtype=np.float32),  # empty
+    np.array(True),  # bool scalar
+]
+
+
+@pytest.mark.parametrize("arr", CASES, ids=lambda a: f"{a.dtype}-{a.shape}")
+def test_roundtrip(arr):
+    buf = encode_arrays([arr], uuid=b"u" * 16)
+    out, uuid, error = decode_arrays(buf)
+    assert uuid == b"u" * 16
+    assert error is None
+    np.testing.assert_array_equal(out[0], arr)
+    assert out[0].dtype == arr.dtype
+    assert out[0].shape == np.shape(arr)  # 0-d must stay 0-d
+
+
+def test_multiple_arrays_one_message():
+    arrays = [np.ones(3), np.int32(7), np.zeros((2, 2))]
+    out, _, _ = decode_arrays(encode_arrays(arrays))
+    assert len(out) == 3
+    for a, b in zip(arrays, out):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_error_message_roundtrip():
+    buf = encode_arrays([], error="boom: bad input")
+    out, _, error = decode_arrays(buf)
+    assert out == []
+    assert error == "boom: bad input"
+
+
+def test_object_dtype_rejected():
+    """The reference admits object dtype 'doesn't work' but serializes
+    pointers anyway (reference: README.md:30); here it's a hard error."""
+    with pytest.raises(WireError, match="object"):
+        encode_arrays([np.array([object()])])
+
+
+def test_truncated_rejected():
+    buf = encode_arrays([np.ones(100)])
+    with pytest.raises(WireError):
+        decode_arrays(buf[: len(buf) // 2])
+    with pytest.raises(WireError, match="magic"):
+        decode_arrays(b"XXXX" + buf[4:])
+
+
+def test_bad_uuid_length():
+    with pytest.raises(WireError, match="uuid"):
+        encode_arrays([], uuid=b"short")

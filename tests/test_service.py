@@ -1,0 +1,242 @@
+"""Transport tests: multi-process localhost servers, balancing, failover.
+
+Same strategy as the reference (reference: test_service.py:109-283):
+"multi-node" is multiprocessing servers on localhost ports, so the
+distributed path runs on one machine.
+"""
+
+import asyncio
+import multiprocessing as mp
+import time
+
+import numpy as np
+import pytest
+
+from pytensor_federated_tpu.service import (
+    ArraysToArraysService,
+    ArraysToArraysServiceClient,
+    LogpGradServiceClient,
+    get_loads_async,
+)
+from pytensor_federated_tpu.service.client import _privates, thread_pid_id
+
+BASE_PORT = 29500
+
+
+def _quad_compute(x):
+    """logp+grad of -(x-3)^2 — flat [logp, grad] convention."""
+    x = np.asarray(x)
+    return [
+        np.asarray(-np.sum((x - 3.0) ** 2)),
+        (-2.0 * (x - 3.0)).astype(x.dtype),
+    ]
+
+
+def _serve_node(port, delay=0.0):
+    import logging
+
+    logging.basicConfig(level=logging.WARNING)
+
+    def compute(*arrays):
+        if delay:
+            time.sleep(delay)
+        return _quad_compute(*arrays)
+
+    from pytensor_federated_tpu.service import run_node
+
+    run_node(compute, "127.0.0.1", port)
+
+
+def _spawn_nodes(ports):
+    """Start one server process per port with a scrubbed environment.
+
+    Children must not initialize any TPU plugin (sitecustomize keys off
+    PALLAS_AXON_POOL_IPS; the chip may be held by the parent) — they are
+    pure-CPU gRPC nodes, like the reference's worker pool
+    (reference: run_node_pool, demo_node.py:98-108).
+    """
+    import os
+
+    ctx = mp.get_context("spawn")
+    saved = {
+        k: os.environ.get(k) for k in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS")
+    }
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        procs = [
+            ctx.Process(target=_serve_node, args=(p,), daemon=True)
+            for p in ports
+        ]
+        for p in procs:
+            p.start()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return procs
+
+
+@pytest.fixture(scope="module")
+def node_pool():
+    """Three server processes (reference: run_node_pool, demo_node.py:98-108)."""
+    ports = [BASE_PORT, BASE_PORT + 1, BASE_PORT + 2]
+    procs = _spawn_nodes(ports)
+    deadline = time.time() + 30
+
+    async def wait_up():
+        while time.time() < deadline:
+            loads = await get_loads_async(
+                [("127.0.0.1", p) for p in ports], timeout=1.0
+            )
+            if all(l is not None for l in loads):
+                return
+            await asyncio.sleep(0.2)
+        raise TimeoutError("node pool failed to start")
+
+    asyncio.run(wait_up())
+    yield ports, procs
+    for p in procs:
+        p.terminate()
+    for p in procs:
+        p.join(timeout=5)
+
+
+def test_evaluate_roundtrip(node_pool):
+    ports, _ = node_pool
+    client = ArraysToArraysServiceClient("127.0.0.1", ports[0])
+    x = np.array([1.0, 5.0])
+    logp, grad = client.evaluate(x)
+    np.testing.assert_allclose(logp, -8.0)
+    np.testing.assert_allclose(grad, [4.0, -4.0])
+    # Stream reuse: second call over the same bidi stream.
+    logp2, _ = client.evaluate(x + 1.0)
+    np.testing.assert_allclose(logp2, -(1.0 + 9.0))
+
+
+def test_unary_mode(node_pool):
+    ports, _ = node_pool
+    client = ArraysToArraysServiceClient(
+        "127.0.0.1", ports[0], use_stream=False
+    )
+    logp, _ = client.evaluate(np.array([3.0]))
+    np.testing.assert_allclose(logp, 0.0)
+
+
+def test_get_loads_with_offline_port(node_pool):
+    """Offline server maps to None (reference: test_service.py:109-141)."""
+    ports, _ = node_pool
+    loads = asyncio.run(
+        get_loads_async(
+            [("127.0.0.1", ports[0]), ("127.0.0.1", 59999)], timeout=2.0
+        )
+    )
+    assert loads[0] is not None
+    assert {"n_clients", "percent_cpu", "percent_ram"} <= set(loads[0])
+    assert loads[1] is None
+
+
+def test_balanced_connect_picks_idle_server(node_pool):
+    """With a client camped on one server, a new client must connect to
+    another (reference: test_service.py:144-177)."""
+    ports, _ = node_pool
+    hp = [("127.0.0.1", p) for p in ports]
+    busy = ArraysToArraysServiceClient("127.0.0.1", ports[0])
+    busy.evaluate(np.zeros(2))  # opens a stream -> n_clients=1 on ports[0]
+    fresh = ArraysToArraysServiceClient(hosts_and_ports=hp)
+    fresh.evaluate(np.zeros(2))
+    connected_port = _privates[thread_pid_id(fresh)].port
+    assert connected_port in ports[1:], (
+        f"balanced connect chose the busy server {connected_port}"
+    )
+
+
+def test_logp_grad_service_client(node_pool):
+    ports, _ = node_pool
+    client = LogpGradServiceClient("127.0.0.1", ports[0])
+    logp, grads = client(np.array([2.0]))
+    np.testing.assert_allclose(logp, -1.0)
+    np.testing.assert_allclose(grads[0], [2.0])
+
+
+def test_server_error_propagates(node_pool):
+    """compute errors come back in-band, stream survives."""
+    ports, _ = node_pool
+    client = ArraysToArraysServiceClient("127.0.0.1", ports[0])
+    with pytest.raises(RuntimeError, match="server error"):
+        client.evaluate(np.zeros(1), np.zeros(1))  # arity mismatch in node
+    # The same client still works after the error.
+    logp, _ = client.evaluate(np.array([3.0]))
+    np.testing.assert_allclose(logp, 0.0)
+
+
+def test_failover_to_surviving_server(node_pool):
+    """Kill the connected server; retry must rebalance to a survivor
+    (reference: test_service.py:234-283)."""
+    ports, procs = node_pool
+    hp = [("127.0.0.1", p) for p in ports]
+    client = ArraysToArraysServiceClient(hosts_and_ports=hp, retries=3)
+    client.evaluate(np.zeros(2))
+    first_port = _privates[thread_pid_id(client)].port
+    idx = ports.index(first_port)
+    victim = procs[idx]
+    victim.terminate()
+    victim.join(timeout=5)
+    try:
+        logp, _ = client.evaluate(np.array([3.0]))  # must failover
+        np.testing.assert_allclose(logp, 0.0)
+        second_port = _privates[thread_pid_id(client)].port
+        assert second_port != first_port
+    finally:
+        # Respawn the victim: the pool is module-scoped.
+        procs[idx] = _spawn_nodes([first_port])[0]
+
+
+def test_client_picklable_across_processes(node_pool):
+    """The client must survive pickling into worker processes
+    (reference: test_service.py:180-224)."""
+    import os
+
+    ports, _ = node_pool
+    client = ArraysToArraysServiceClient("127.0.0.1", ports[0])
+    saved = {
+        k: os.environ.get(k) for k in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS")
+    }
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        ctx = mp.get_context("spawn")
+        with ctx.Pool(2) as pool:
+            results = pool.map(_eval_in_worker, [client, client])
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    for logp in results:
+        np.testing.assert_allclose(logp, -8.0)
+
+
+def _eval_in_worker(client):
+    logp, _ = client.evaluate(np.array([1.0, 5.0]))
+    return float(logp)
+
+
+def test_all_servers_dead_raises():
+    client = ArraysToArraysServiceClient(
+        hosts_and_ports=[("127.0.0.1", 59997), ("127.0.0.1", 59998)]
+    )
+    with pytest.raises(TimeoutError):
+        client.evaluate(np.zeros(1))
+
+
+def test_arg_validation():
+    with pytest.raises(ValueError, match="host"):
+        ArraysToArraysServiceClient()
+    with pytest.raises(ValueError, match="not both"):
+        ArraysToArraysServiceClient(
+            "h", 1, hosts_and_ports=[("h", 1)]
+        )
