@@ -1,0 +1,35 @@
+"""PyTensor/PyMC bridge — the reference's front door, made JAX-compilable.
+
+The reference *is* a PyTensor extension: its ops embed remote logp/grad
+calls into PyMC graphs (reference: wrapper_ops.py:14-146).  This bridge
+provides the same Op surface for users coming from PyMC, with one
+TPU-critical addition: every op registers a ``jax_funcify`` dispatch, so
+when PyMC compiles the model through the PyTensor->JAX linker
+(``pm.sample(..., nuts_sampler="numpyro")`` or ``mode="JAX"``) the
+*entire* step function — federated likelihood included — jits into one
+XLA program with zero host callbacks in the loop (SURVEY §7 step 4).
+
+Import-gated exactly like the reference's ``__init__`` (reference:
+pytensor_federated/__init__.py:1-12): the rest of the framework is fully
+usable without PyTensor installed.
+"""
+
+try:
+    from .pytensor_ops import (
+        FederatedArraysToArraysOp,
+        FederatedLogpGradOp,
+        FederatedLogpOp,
+        federated_potential,
+    )
+
+    HAS_PYTENSOR = True
+except ModuleNotFoundError:  # pragma: no cover - exercised when pytensor absent
+    HAS_PYTENSOR = False
+
+__all__ = [
+    "HAS_PYTENSOR",
+    "FederatedArraysToArraysOp",
+    "FederatedLogpGradOp",
+    "FederatedLogpOp",
+    "federated_potential",
+]

@@ -1,0 +1,237 @@
+"""PyTensor Ops wrapping framework compute functions, JAX-dispatchable.
+
+Parity map (all citations into /root/reference):
+
+- :class:`FederatedArraysToArraysOp` — generic arrays->arrays Op
+  (reference: wrapper_ops.py:14-33).
+- :class:`FederatedLogpOp` — scalar log-potential Op
+  (reference: wrapper_ops.py:44-69).
+- :class:`FederatedLogpGradOp` — ``[logp, *grads]`` outputs with the
+  symbolic ``.grad()`` bridge (reference: wrapper_ops.py:84-132),
+  including the "no second-order autodiff through the federated
+  boundary" restriction (reference: wrapper_ops.py:123-125).
+
+The reference needs ``Async*`` twins of each op plus a global graph
+rewrite to fan independent applies out concurrently
+(reference: wrapper_ops.py:36-41, op_async.py:68-234).  Here the ops
+carry an optional ``jax_fn``; when PyMC compiles via the PyTensor->JAX
+linker, the registered ``jax_funcify`` dispatch inlines that function
+into the traced program, and XLA schedules independent calls
+concurrently on its own — the rewrite pass has no work left to do
+(SURVEY §7 table, ``ParallelAsyncOp`` row).  The ``perform`` path (C/py
+linkers) still works for host compute functions, so non-JAX "blackbox"
+nodes keep first-class support (reference: README.md:34-35).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+import pytensor.tensor as pt
+from pytensor.gradient import DisconnectedType
+from pytensor.graph.basic import Apply
+from pytensor.graph.op import Op
+
+from ..signatures import ComputeFn, LogpFn, LogpGradFn
+
+__all__ = [
+    "FederatedArraysToArraysOp",
+    "FederatedLogpGradOp",
+    "FederatedLogpOp",
+    "federated_potential",
+]
+
+
+def _as_tensors(inputs) -> list:
+    # Coerce raw python ints/floats too — the reference's "issue #24"
+    # regression (reference: wrapper_ops.py:25-31, test_wrapper_ops.py:284-289).
+    return [pt.as_tensor_variable(i) for i in inputs]
+
+
+class FederatedArraysToArraysOp(Op):
+    """Generic arrays->arrays blackbox Op (reference: wrapper_ops.py:14-33).
+
+    ``output_types`` gives the PyTensor types of the outputs (the
+    reference infers them from ``FromFunctionOp`` construction args).
+
+    No ``__props__``: op identity is instance identity, so two ops
+    wrapping *different* node functions never compare equal and the merge
+    optimizer cannot collapse distinct federated nodes into one apply
+    (the reference keys identity on the wrapped function for the same
+    reason, reference: wrapper_ops.py:20-23).  Re-applying the *same*
+    instance on the same inputs (the ``grad()`` pattern below) still
+    merges, because identity equality holds.
+    """
+
+    def __init__(
+        self,
+        compute_fn: ComputeFn,
+        output_types: Sequence,
+        *,
+        jax_fn: Optional[Callable] = None,
+    ):
+        self.compute_fn = compute_fn
+        self.output_types = list(output_types)
+        self.jax_fn = jax_fn
+
+    def make_node(self, *inputs):
+        inputs = _as_tensors(inputs)
+        outputs = [t() for t in self.output_types]
+        return Apply(self, inputs, outputs)
+
+    def perform(self, node, inputs, output_storage):
+        results = self.compute_fn(*[np.asarray(i) for i in inputs])
+        if len(results) != len(output_storage):
+            raise ValueError(
+                f"compute_fn returned {len(results)} outputs, "
+                f"expected {len(output_storage)}"
+            )
+        for storage, res, var in zip(output_storage, results, node.outputs):
+            storage[0] = np.asarray(res, dtype=var.type.dtype)
+
+
+class FederatedLogpOp(Op):
+    """Inputs -> scalar log-potential (reference: wrapper_ops.py:44-69).
+
+    No ``__props__`` — see :class:`FederatedArraysToArraysOp`.
+    """
+
+    def __init__(self, logp_fn: LogpFn, *, jax_fn: Optional[Callable] = None):
+        self.logp_fn = logp_fn
+        self.jax_fn = jax_fn
+
+    def make_node(self, *inputs):
+        inputs = _as_tensors(inputs)
+        # Scalar output typed like the reference's ``at.scalar()``
+        # (reference: wrapper_ops.py:54).
+        return Apply(self, inputs, [pt.scalar()])
+
+    def perform(self, node, inputs, output_storage):
+        logp = self.logp_fn(*[np.asarray(i) for i in inputs])
+        logp = np.asarray(logp, dtype=node.outputs[0].type.dtype)
+        if logp.ndim != 0:
+            raise ValueError(f"logp must be scalar, got shape {logp.shape}")
+        output_storage[0][0] = logp
+
+
+class FederatedLogpGradOp(Op):
+    """Inputs -> ``[logp, *grads]`` with the symbolic grad bridge.
+
+    Mirrors the reference op exactly (reference: wrapper_ops.py:84-132):
+    node outputs are ``[scalar logp]`` plus one grad per input typed
+    ``i.type()``; ``.grad()`` re-applies self on the same inputs (CSE
+    dedups the double apply) and returns ``g_logp * grad_i``; connected
+    gradients w.r.t. the grad outputs raise — no second-order autodiff
+    through the federated boundary (reference: wrapper_ops.py:123-125).
+
+    No ``__props__`` — see :class:`FederatedArraysToArraysOp`; instance
+    identity keeps distinct nodes un-mergeable while ``grad()``'s
+    re-apply of the same instance still CSEs.
+    """
+
+    def __init__(
+        self, logp_grad_fn: LogpGradFn, *, jax_fn: Optional[Callable] = None
+    ):
+        self.logp_grad_fn = logp_grad_fn
+        self.jax_fn = jax_fn
+
+    def make_node(self, *inputs):
+        inputs = _as_tensors(inputs)
+        outputs = [pt.scalar()] + [i.type() for i in inputs]
+        return Apply(self, inputs, outputs)
+
+    def perform(self, node, inputs, output_storage):
+        logp, grads = self.logp_grad_fn(*[np.asarray(i) for i in inputs])
+        if len(grads) != len(inputs):
+            raise ValueError(
+                f"logp_grad_fn returned {len(grads)} grads for "
+                f"{len(inputs)} inputs"
+            )
+        output_storage[0][0] = np.asarray(
+            logp, dtype=node.outputs[0].type.dtype
+        )
+        for storage, g, var in zip(output_storage[1:], grads, node.outputs[1:]):
+            storage[0] = np.asarray(g, dtype=var.type.dtype)
+
+    def grad(self, inputs, output_grads):
+        g_logp, *g_grads = output_grads
+        for gg in g_grads:
+            if not isinstance(gg.type, DisconnectedType):
+                raise NotImplementedError(
+                    "gradients with respect to the gradient outputs are not "
+                    "supported (no second-order autodiff through the "
+                    "federated boundary)"
+                )
+        outputs = self(*inputs)
+        grads = outputs[1:]
+        return [g_logp * g for g in grads]
+
+    def connection_pattern(self, node):
+        # logp depends on every input; each grad output is treated as
+        # disconnected for further differentiation (first-order-only
+        # contract, reference: wrapper_ops.py:119-132).
+        n_in = len(node.inputs)
+        return [[True] + [False] * n_in for _ in range(n_in)]
+
+
+def federated_potential(logp_grad_fn: LogpGradFn, *inputs, jax_fn=None):
+    """Apply a :class:`FederatedLogpGradOp` and return just the logp
+    variable — ready for ``pm.Potential`` (reference: demo_model.py:33-36)."""
+    op = FederatedLogpGradOp(logp_grad_fn, jax_fn=jax_fn)
+    return op(*inputs)[0]
+
+
+# -- PyTensor->JAX linker dispatch ------------------------------------------
+# Registering here (import side effect, like the reference's optdb
+# registration at import, reference: op_async.py:228-234) means any
+# PyMC/PyTensor compile with mode="JAX" inlines the op's jax_fn into the
+# traced program: the whole NUTS step becomes one XLA executable.
+try:  # pragma: no cover - depends on pytensor version layout
+    from pytensor.link.jax.dispatch import jax_funcify
+
+    @jax_funcify.register(FederatedArraysToArraysOp)
+    def _jax_funcify_arrays(op, **kwargs):
+        if op.jax_fn is None:
+            raise NotImplementedError(
+                "FederatedArraysToArraysOp has no jax_fn; pass jax_fn= to "
+                "compile through the JAX linker"
+            )
+        fn = op.jax_fn
+
+        def arrays_to_arrays(*inputs):
+            return tuple(fn(*inputs))
+
+        return arrays_to_arrays
+
+    @jax_funcify.register(FederatedLogpOp)
+    def _jax_funcify_logp(op, **kwargs):
+        if op.jax_fn is None:
+            raise NotImplementedError(
+                "FederatedLogpOp has no jax_fn; pass jax_fn= to compile "
+                "through the JAX linker"
+            )
+        fn = op.jax_fn
+
+        def logp(*inputs):
+            return fn(*inputs)
+
+        return logp
+
+    @jax_funcify.register(FederatedLogpGradOp)
+    def _jax_funcify_logp_grad(op, **kwargs):
+        if op.jax_fn is None:
+            raise NotImplementedError(
+                "FederatedLogpGradOp has no jax_fn; pass jax_fn= to compile "
+                "through the JAX linker"
+            )
+        fn = op.jax_fn
+
+        def logp_grad(*inputs):
+            logp, grads = fn(*inputs)
+            return (logp, *tuple(grads))
+
+        return logp_grad
+
+except ModuleNotFoundError:  # pragma: no cover
+    pass

@@ -133,7 +133,7 @@ def main(argv=None):
     )
     parser.add_argument("--draws", type=int, default=300)
     parser.add_argument("--sequential", action="store_true")
-    args, _ = parser.parse_known_args(argv)
+    args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     if args.remote:
         run_remote(

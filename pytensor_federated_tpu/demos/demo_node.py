@@ -92,7 +92,7 @@ def main(argv=None):
         "--ports", type=int, nargs="+", default=list(range(50000, 50003))
     )
     parser.add_argument("--delay", type=float, default=0.0)
-    args, _ = parser.parse_known_args(argv)
+    args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     run_node_pool(args.bind, args.ports, args.delay)
 

@@ -83,6 +83,10 @@ def parallel_host_call(
     # thread a cached channel bound to a dead thread's event loop.
     executors = [ThreadPoolExecutor(max_workers=1) for _ in host_fns]
 
+    def close():
+        for ex in executors:
+            ex.shutdown(wait=False)
+
     def fn(*args_per_child) -> List[List[Array]]:
         if len(args_per_child) != len(host_fns):
             raise ValueError(
@@ -123,6 +127,7 @@ def parallel_host_call(
             i += k
         return out
 
+    fn.close = close
     return fn
 
 
@@ -165,6 +170,7 @@ class ParallelLogpGrad:
             return host
 
         fanout = parallel_host_call([flat_node(i) for i in range(self.n_nodes)], out_specs)
+        self._fanout = fanout
         arities = [len(s) for s in self.in_specs]
 
         @jax.custom_vjp
@@ -209,3 +215,15 @@ class ParallelLogpGrad:
         reference expresses in-graph (reference: demo_model.py:34-36)."""
         results = self(inputs_per_node)
         return jnp.sum(jnp.stack([lp for lp, _ in results]))
+
+    def close(self) -> None:
+        """Shut down the per-node executor threads.  Mirrors the
+        reference client's stream teardown in ``__del__``
+        (reference: service.py:355-365)."""
+        self._fanout.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -99,10 +99,15 @@ def decode_arrays(buf: bytes) -> Tuple[List[np.ndarray], bytes, Optional[str]]:
     off = struct.calcsize("<4sBB16sI")
     error = None
     if flags & _FLAG_ERROR:
-        (elen,) = struct.unpack_from("<I", buf, off)
-        off += 4
-        error = buf[off : off + elen].decode("utf-8")
-        off += elen
+        try:
+            (elen,) = struct.unpack_from("<I", buf, off)
+            off += 4
+            if off + elen > len(buf):
+                raise WireError("truncated error block")
+            error = buf[off : off + elen].decode("utf-8")
+            off += elen
+        except (struct.error, UnicodeDecodeError) as e:
+            raise WireError(f"truncated error block: {e}") from None
     arrays: List[np.ndarray] = []
     for _ in range(n):
         try:

@@ -13,8 +13,11 @@ virtual CPU devices via XLA_FLAGS and (b) route all un-placed
 computation to CPU via ``jax_default_device`` — no re-exec needed.
 """
 
+import contextlib
+import multiprocessing as mp
 import os
 import sys
+import time
 
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
@@ -30,6 +33,61 @@ jax.config.update("jax_default_device", _CPUS[0])
 
 # Make the repo root importable regardless of cwd.
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@contextlib.contextmanager
+def scrubbed_child_env():
+    """Env scrub for child processes: children must not initialize any
+    TPU plugin (sitecustomize keys off PALLAS_AXON_POOL_IPS; the chip may
+    be held by the parent) — they are pure-CPU gRPC nodes, like the
+    reference's worker pool (reference: demo_node.py:98-108)."""
+    saved = {
+        k: os.environ.get(k) for k in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS")
+    }
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        yield
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def spawn_node_procs(target, args_per_proc):
+    """Start one daemon process per args tuple under a scrubbed env."""
+    with scrubbed_child_env():
+        ctx = mp.get_context("spawn")
+        procs = [
+            ctx.Process(target=target, args=a, daemon=True)
+            for a in args_per_proc
+        ]
+        for p in procs:
+            p.start()
+    return procs
+
+
+def wait_nodes_up(ports, *, timeout=60.0, host="127.0.0.1"):
+    """Poll GetLoad until every port answers (server readiness barrier)."""
+    import asyncio
+
+    from pytensor_federated_tpu.service import get_loads_async
+
+    deadline = time.time() + timeout
+
+    async def wait_up():
+        while time.time() < deadline:
+            loads = await get_loads_async(
+                [(host, p) for p in ports], timeout=1.0
+            )
+            if all(l is not None for l in loads):
+                return
+            await asyncio.sleep(0.2)
+        raise TimeoutError(f"nodes on ports {ports} failed to start")
+
+    asyncio.run(wait_up())
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,132 @@
+"""PyTensor bridge tests — skip cleanly when pytensor is not installed.
+
+Mirrors the reference's Op contract tests (reference:
+test_wrapper_ops.py:174-237): make_node arity/coercion, perform into
+output storage, symbolic eval, and ``at.grad`` through the federated op
+matching hand-derived gradients of the closed-form quadratic model.
+"""
+
+import numpy as np
+import pytest
+
+pytensor = pytest.importorskip("pytensor")
+
+import pytensor.tensor as pt  # noqa: E402
+
+from pytensor_federated_tpu.bridge import (  # noqa: E402
+    FederatedArraysToArraysOp,
+    FederatedLogpGradOp,
+    FederatedLogpOp,
+    federated_potential,
+)
+
+
+def quadratic_logp_grad(a, b):
+    # Closed-form model with hand gradients (pattern from reference
+    # test_wrapper_ops.py:34-45).
+    logp = -((a - 1.0) ** 2) - 2.0 * np.sum((b - 3.0) ** 2)
+    grads = [-2.0 * (a - 1.0), -4.0 * (b - 3.0)]
+    return np.asarray(logp), grads
+
+
+def quadratic_logp(a, b):
+    return quadratic_logp_grad(a, b)[0]
+
+
+class TestLogpGradOp:
+    def test_make_node_arity_and_coercion(self):
+        op = FederatedLogpGradOp(quadratic_logp_grad)
+        # Raw int input must coerce (reference "issue #24",
+        # test_wrapper_ops.py:284-289).
+        apply = op.make_node(2, pt.dvector("b"))
+        assert len(apply.inputs) == 2
+        assert len(apply.outputs) == 3
+        assert apply.outputs[0].ndim == 0
+
+    def test_perform_and_eval(self):
+        op = FederatedLogpGradOp(quadratic_logp_grad)
+        a = pt.dscalar("a")
+        b = pt.dvector("b")
+        logp, ga, gb = op(a, b)
+        f = pytensor.function([a, b], [logp, ga, gb])
+        av, bv = 2.0, np.array([1.0, 5.0])
+        out_logp, out_ga, out_gb = f(av, bv)
+        exp_logp, (exp_ga, exp_gb) = quadratic_logp_grad(av, bv)
+        np.testing.assert_allclose(out_logp, exp_logp)
+        np.testing.assert_allclose(out_ga, exp_ga)
+        np.testing.assert_allclose(out_gb, exp_gb)
+
+    def test_symbolic_grad_matches_hand_grads(self):
+        op = FederatedLogpGradOp(quadratic_logp_grad)
+        a = pt.dscalar("a")
+        b = pt.dvector("b")
+        logp = op(a, b)[0]
+        ga, gb = pt.grad(logp, [a, b])
+        f = pytensor.function([a, b], [ga, gb])
+        av, bv = 0.5, np.array([2.0, 4.0])
+        out_ga, out_gb = f(av, bv)
+        _, (exp_ga, exp_gb) = quadratic_logp_grad(av, bv)
+        np.testing.assert_allclose(out_ga, exp_ga)
+        np.testing.assert_allclose(out_gb, exp_gb)
+
+    def test_potential_helper(self):
+        a = pt.dscalar("a")
+        b = pt.dvector("b")
+        logp = federated_potential(quadratic_logp_grad, a, b)
+        assert logp.ndim == 0
+
+
+class TestLogpOp:
+    def test_eval(self):
+        op = FederatedLogpOp(quadratic_logp)
+        a = pt.dscalar("a")
+        b = pt.dvector("b")
+        f = pytensor.function([a, b], op(a, b))
+        np.testing.assert_allclose(
+            f(2.0, np.array([3.0])), quadratic_logp(2.0, np.array([3.0]))
+        )
+
+
+class TestArraysToArraysOp:
+    def test_eval(self):
+        def compute(x, y):
+            return [x + y, x * y]
+
+        op = FederatedArraysToArraysOp(
+            compute, output_types=[pt.dvector, pt.dvector]
+        )
+        x = pt.dvector("x")
+        y = pt.dvector("y")
+        s, p = op(x, y)
+        f = pytensor.function([x, y], [s, p])
+        xv = np.array([1.0, 2.0])
+        yv = np.array([3.0, 4.0])
+        out_s, out_p = f(xv, yv)
+        np.testing.assert_allclose(out_s, xv + yv)
+        np.testing.assert_allclose(out_p, xv * yv)
+
+
+@pytest.mark.skipif(
+    not hasattr(pytensor, "function"), reason="pytensor too old"
+)
+def test_jax_linker_compiles_through_op():
+    """mode="JAX" must inline jax_fn — the TPU-critical path (SURVEY §7.4)."""
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    def jax_logp_grad(a, b):
+        logp = -((a - 1.0) ** 2) - 2.0 * jnp.sum((b - 3.0) ** 2)
+        return logp, (-2.0 * (a - 1.0), -4.0 * (b - 3.0))
+
+    op = FederatedLogpGradOp(quadratic_logp_grad, jax_fn=jax_logp_grad)
+    a = pt.dscalar("a")
+    b = pt.dvector("b")
+    logp = op(a, b)[0]
+    try:
+        f = pytensor.function([a, b], logp, mode="JAX")
+    except Exception as e:  # pragma: no cover - jax linker availability
+        pytest.skip(f"JAX linker unavailable: {e}")
+    av, bv = 2.0, np.array([1.0, 5.0])
+    np.testing.assert_allclose(
+        np.asarray(f(av, bv)), quadratic_logp_grad(av, bv)[0], rtol=1e-6
+    )

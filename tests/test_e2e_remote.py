@@ -7,13 +7,8 @@ this framework's stack: node pool -> LogpGradServiceClient ->
 blackbox/fan-out op -> all-JAX sampler.
 """
 
-import multiprocessing as mp
-import time
-
 import numpy as np
 import pytest
-
-from pytensor_federated_tpu.service import get_loads_async
 
 PORTS = [29600, 29601]
 
@@ -26,42 +21,10 @@ def _serve_demo_node(port):
 
 @pytest.fixture(scope="module")
 def demo_pool():
-    import asyncio
-    import os
+    from conftest import spawn_node_procs, wait_nodes_up
 
-    saved = {
-        k: os.environ.get(k) for k in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS")
-    }
-    os.environ["PALLAS_AXON_POOL_IPS"] = ""
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    try:
-        ctx = mp.get_context("spawn")
-        procs = [
-            ctx.Process(target=_serve_demo_node, args=(p,), daemon=True)
-            for p in PORTS
-        ]
-        for p in procs:
-            p.start()
-    finally:
-        for k, v in saved.items():
-            if v is None:
-                os.environ.pop(k, None)
-            else:
-                os.environ[k] = v
-
-    deadline = time.time() + 60
-
-    async def wait_up():
-        while time.time() < deadline:
-            loads = await get_loads_async(
-                [("127.0.0.1", p) for p in PORTS], timeout=1.0
-            )
-            if all(l is not None for l in loads):
-                return
-            await asyncio.sleep(0.3)
-        raise TimeoutError("demo pool failed to start")
-
-    asyncio.run(wait_up())
+    procs = spawn_node_procs(_serve_demo_node, [(p,) for p in PORTS])
+    wait_nodes_up(PORTS, timeout=60)
     yield PORTS
     for p in procs:
         p.terminate()

@@ -11,6 +11,7 @@ import time
 
 import numpy as np
 import pytest
+from conftest import scrubbed_child_env, wait_nodes_up
 
 from pytensor_federated_tpu.service import (
     ArraysToArraysService,
@@ -48,35 +49,9 @@ def _serve_node(port, delay=0.0):
 
 
 def _spawn_nodes(ports):
-    """Start one server process per port with a scrubbed environment.
+    from conftest import spawn_node_procs
 
-    Children must not initialize any TPU plugin (sitecustomize keys off
-    PALLAS_AXON_POOL_IPS; the chip may be held by the parent) — they are
-    pure-CPU gRPC nodes, like the reference's worker pool
-    (reference: run_node_pool, demo_node.py:98-108).
-    """
-    import os
-
-    ctx = mp.get_context("spawn")
-    saved = {
-        k: os.environ.get(k) for k in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS")
-    }
-    os.environ["PALLAS_AXON_POOL_IPS"] = ""
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    try:
-        procs = [
-            ctx.Process(target=_serve_node, args=(p,), daemon=True)
-            for p in ports
-        ]
-        for p in procs:
-            p.start()
-    finally:
-        for k, v in saved.items():
-            if v is None:
-                os.environ.pop(k, None)
-            else:
-                os.environ[k] = v
-    return procs
+    return spawn_node_procs(_serve_node, [(p,) for p in ports])
 
 
 @pytest.fixture(scope="module")
@@ -84,19 +59,7 @@ def node_pool():
     """Three server processes (reference: run_node_pool, demo_node.py:98-108)."""
     ports = [BASE_PORT, BASE_PORT + 1, BASE_PORT + 2]
     procs = _spawn_nodes(ports)
-    deadline = time.time() + 30
-
-    async def wait_up():
-        while time.time() < deadline:
-            loads = await get_loads_async(
-                [("127.0.0.1", p) for p in ports], timeout=1.0
-            )
-            if all(l is not None for l in loads):
-                return
-            await asyncio.sleep(0.2)
-        raise TimeoutError("node pool failed to start")
-
-    asyncio.run(wait_up())
+    wait_nodes_up(ports, timeout=30)
     yield ports, procs
     for p in procs:
         p.terminate()
@@ -190,32 +153,21 @@ def test_failover_to_surviving_server(node_pool):
         second_port = _privates[thread_pid_id(client)].port
         assert second_port != first_port
     finally:
-        # Respawn the victim: the pool is module-scoped.
+        # Respawn the victim and wait for readiness: the pool is
+        # module-scoped, so later tests connect to this port directly.
         procs[idx] = _spawn_nodes([first_port])[0]
+        wait_nodes_up([first_port], timeout=30)
 
 
 def test_client_picklable_across_processes(node_pool):
     """The client must survive pickling into worker processes
     (reference: test_service.py:180-224)."""
-    import os
-
     ports, _ = node_pool
     client = ArraysToArraysServiceClient("127.0.0.1", ports[0])
-    saved = {
-        k: os.environ.get(k) for k in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS")
-    }
-    os.environ["PALLAS_AXON_POOL_IPS"] = ""
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    try:
+    with scrubbed_child_env():
         ctx = mp.get_context("spawn")
         with ctx.Pool(2) as pool:
             results = pool.map(_eval_in_worker, [client, client])
-    finally:
-        for k, v in saved.items():
-            if v is None:
-                os.environ.pop(k, None)
-            else:
-                os.environ[k] = v
     for logp in results:
         np.testing.assert_allclose(logp, -8.0)
 
