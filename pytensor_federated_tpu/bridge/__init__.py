@@ -23,13 +23,26 @@ try:
     )
 
     HAS_PYTENSOR = True
+    __all__ = [
+        "HAS_PYTENSOR",
+        "FederatedArraysToArraysOp",
+        "FederatedLogpGradOp",
+        "FederatedLogpOp",
+        "federated_potential",
+    ]
 except ModuleNotFoundError:  # pragma: no cover - exercised when pytensor absent
     HAS_PYTENSOR = False
+    __all__ = ["HAS_PYTENSOR"]
 
-__all__ = [
-    "HAS_PYTENSOR",
-    "FederatedArraysToArraysOp",
-    "FederatedLogpGradOp",
-    "FederatedLogpOp",
-    "federated_potential",
-]
+    def __getattr__(name):
+        if name in (
+            "FederatedArraysToArraysOp",
+            "FederatedLogpGradOp",
+            "FederatedLogpOp",
+            "federated_potential",
+        ):
+            raise ImportError(
+                f"{name} requires PyTensor; install the 'pytensor' extra "
+                "(pip install pytensor-federated-tpu[pytensor])"
+            )
+        raise AttributeError(name)

@@ -28,6 +28,7 @@ from __future__ import annotations
 from typing import Callable, Optional, Sequence
 
 import numpy as np
+import pytensor
 import pytensor.tensor as pt
 from pytensor.gradient import DisconnectedType
 from pytensor.graph.basic import Apply
@@ -138,7 +139,20 @@ class FederatedLogpGradOp(Op):
 
     def make_node(self, *inputs):
         inputs = _as_tensors(inputs)
-        outputs = [pt.scalar()] + [i.type() for i in inputs]
+        # Grad outputs follow each input's type — except integer inputs
+        # (the raw-int coercion path): an int-typed grad output would
+        # silently truncate the float gradient in perform, so those are
+        # upcast to floatX.  (The reference types them ``i.type()``
+        # unconditionally, reference: wrapper_ops.py:97-105 — a silent-
+        # truncation trap this framework does not replicate.)
+        outputs = [pt.scalar()]
+        for i in inputs:
+            if i.type.dtype.startswith(("int", "uint", "bool")):
+                outputs.append(
+                    pt.TensorType(pytensor.config.floatX, i.type.shape)()
+                )
+            else:
+                outputs.append(i.type())
         return Apply(self, inputs, outputs)
 
     def perform(self, node, inputs, output_storage):

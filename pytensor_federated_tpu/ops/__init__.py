@@ -2,6 +2,7 @@
 
 from .blackbox import blackbox_compute, blackbox_logp_grad
 from .fanout import ParallelLogpGrad, fuse, parallel_host_call
+from .pallas_kernels import linreg_logp_grad_fn, linreg_reductions
 from .ops import (
     ArraysToArraysOp,
     AsyncArraysToArraysOp,
@@ -24,5 +25,7 @@ __all__ = [
     "blackbox_logp_grad",
     "from_logp_fn",
     "fuse",
+    "linreg_logp_grad_fn",
+    "linreg_reductions",
     "parallel_host_call",
 ]

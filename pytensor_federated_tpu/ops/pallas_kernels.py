@@ -1,0 +1,226 @@
+"""Pallas TPU kernels for the hot federated-likelihood ops.
+
+The reference's per-node hot path is a compiled PyTensor C function
+evaluating a Gaussian linear-regression logp and its gradients
+(reference: demo_node.py:30-43 builds the graph; demo_node.py:39-42
+compiles ``[logp, dlogp/dintercept, dlogp/dslope]``).  Here the same
+computation is a hand-written Pallas kernel that makes ONE fused pass
+over each shard's ``(x, y, mask)`` block and produces the log-likelihood
+*and* every sufficient gradient reduction simultaneously:
+
+    ll_i        = sum_n m (-0.5 z^2 - log_sigma - 0.5 log 2pi)
+    gmu_i       = sum_n m r / sigma^2          (d ll / d(intercept+offset_i))
+    gx_i        = sum_n m r x / sigma^2        (d ll / d slope, per shard)
+    gz_i        = sum_n m (z^2 - 1)            (d ll / d log_sigma, per shard)
+
+with ``r = y - mu``, ``z = r / sigma``.  ``jax.value_and_grad`` on the
+plain-JAX likelihood stages a forward pass plus a transposed backward
+pass; this kernel reads the data exactly once and keeps every reduction
+in VMEM, so the bytes moved from HBM are halved — the op is
+bandwidth-bound, which makes that the ceiling that matters
+(see /opt/skills/guides/pallas_guide.md, "HBM bandwidth").
+
+Everything is wired up as a ``jax.custom_vjp`` so the kernel drops into
+``jax.value_and_grad`` / NUTS unchanged.  On non-TPU backends the kernel
+runs in Pallas interpreter mode, so CPU tests exercise the identical
+code path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LOG_2PI = float(np.log(2.0 * np.pi))
+
+# Lane layout of the per-shard reduction tile (see _linreg_kernel).
+_LANE_LL, _LANE_GMU, _LANE_GX, _LANE_GZ = 0, 1, 2, 3
+_N_LANES = 128  # one float32 register lane row
+
+
+def _interpret_default() -> bool:
+    """Interpreter mode unless compiled Mosaic is explicitly requested.
+
+    Compiled Pallas needs a direct Mosaic-capable TPU runtime; tunneled
+    single-chip dev environments (PJRT proxy plugins) may accept XLA
+    programs but wedge on Mosaic payloads, so the compiled path is
+    opt-in via ``PFTPU_PALLAS_COMPILED=1`` rather than keyed off
+    ``jax.default_backend()``.
+    """
+    import os
+
+    if os.environ.get("PFTPU_PALLAS_COMPILED") == "1":
+        return False
+    return True
+
+
+def _linreg_kernel(scal_ref, off_ref, x_ref, y_ref, m_ref, out_ref):
+    """One (BS, BN) block: fused logp + gradient reductions.
+
+    ``scal_ref`` (SMEM): ``[intercept, slope, log_sigma]``.
+    ``off_ref``: per-shard intercept offsets, block ``(BS, 1)``.
+    ``x/y/m_ref``: data blocks ``(BS, BN)``.
+    ``out_ref``: ``(BS, 128)`` accumulator tile; lanes 0..3 hold
+    ``ll, gmu, gx, gz`` (lane layout keeps the store a single aligned
+    (8,128) vector write instead of four sub-lane scatters).
+    """
+    j = pl.program_id(1)
+
+    intercept = scal_ref[0]
+    slope = scal_ref[1]
+    log_sigma = scal_ref[2]
+    inv_s2 = jnp.exp(-2.0 * log_sigma)
+
+    x = x_ref[:]
+    y = y_ref[:]
+    m = m_ref[:]
+    mu = (intercept + off_ref[:]) + slope * x  # off broadcasts (BS,1)->(BS,BN)
+    r = y - mu
+    z2 = r * r * inv_s2
+
+    ll = jnp.sum(m * (-0.5 * z2 - log_sigma - 0.5 * LOG_2PI), axis=1)
+    gmu = jnp.sum(m * r, axis=1) * inv_s2
+    gx = jnp.sum(m * r * x, axis=1) * inv_s2
+    gz = jnp.sum(m * (z2 - 1.0), axis=1)
+
+    lane = jax.lax.broadcasted_iota(jnp.int32, out_ref.shape, 1)
+    tile = (
+        jnp.where(lane == _LANE_LL, ll[:, None], 0.0)
+        + jnp.where(lane == _LANE_GMU, gmu[:, None], 0.0)
+        + jnp.where(lane == _LANE_GX, gx[:, None], 0.0)
+        + jnp.where(lane == _LANE_GZ, gz[:, None], 0.0)
+    )
+
+    @pl.when(j == 0)
+    def _():
+        out_ref[:] = tile
+
+    @pl.when(j != 0)
+    def _():
+        out_ref[:] = out_ref[:] + tile
+
+
+def _pad_axis(a: jax.Array, axis: int, to_multiple: int) -> jax.Array:
+    size = a.shape[axis]
+    pad = (-size) % to_multiple
+    if pad == 0:
+        return a
+    widths = [(0, 0)] * a.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(a, widths)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_shards", "block_obs", "interpret")
+)
+def linreg_reductions(
+    scalars: jax.Array,
+    offsets: jax.Array,
+    x: jax.Array,
+    y: jax.Array,
+    mask: jax.Array,
+    *,
+    block_shards: int = 8,
+    block_obs: int = 512,
+    interpret: bool | None = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Per-shard ``(ll, gmu, gx, gz)`` reductions, one fused data pass.
+
+    ``scalars = [intercept, slope, log_sigma]``; ``offsets``: ``(S,)``;
+    ``x, y, mask``: ``(S, N)`` float32.  Returns four ``(S,)`` vectors.
+    Shards/observations are zero-padded to the block grid; padded rows
+    and columns carry ``mask == 0`` so they contribute nothing.
+    """
+    if interpret is None:
+        interpret = _interpret_default()
+    S, N = x.shape
+
+    bs = min(block_shards, max(S, 1))
+    bn = min(block_obs, max(N, 1))
+    x = _pad_axis(_pad_axis(x, 0, bs), 1, bn)
+    y = _pad_axis(_pad_axis(y, 0, bs), 1, bn)
+    mask = _pad_axis(_pad_axis(mask, 0, bs), 1, bn)
+    offs = _pad_axis(offsets[:, None], 0, bs)
+    Sp, Np = x.shape
+
+    grid = (Sp // bs, Np // bn)
+    out = pl.pallas_call(
+        _linreg_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((3,), lambda i, j: (0,), memory_space=pltpu.SMEM),
+            pl.BlockSpec((bs, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((bs, bn), lambda i, j: (i, j)),
+            pl.BlockSpec((bs, bn), lambda i, j: (i, j)),
+            pl.BlockSpec((bs, bn), lambda i, j: (i, j)),
+        ],
+        out_specs=pl.BlockSpec((bs, _N_LANES), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((Sp, _N_LANES), jnp.float32),
+        interpret=interpret,
+    )(scalars, offs, x, y, mask)
+
+    out = out[:S]
+    return (
+        out[:, _LANE_LL],
+        out[:, _LANE_GMU],
+        out[:, _LANE_GX],
+        out[:, _LANE_GZ],
+    )
+
+
+def linreg_logp_grad_fn(x, y, mask, *, interpret: bool | None = None):
+    """Build ``logp_and_grad(params) -> (logp, grads)`` on the kernel.
+
+    ``params`` pytree matches
+    :class:`..models.linear.FederatedLinearRegression`:
+    ``{intercept, slope, log_sigma, offsets}``.  The returned function is
+    differentiable (``jax.custom_vjp``): the VJP replays the reductions
+    already produced by the single forward pass, so ``value_and_grad``
+    costs ONE data pass total.  Second-order autodiff through the kernel
+    is unsupported — same boundary contract as the reference's
+    ``LogpGradOp.grad`` (reference: wrapper_ops.py:123-125).
+    """
+    x = jnp.asarray(x, jnp.float32)
+    y = jnp.asarray(y, jnp.float32)
+    mask = jnp.asarray(mask, jnp.float32)
+
+    def reductions(params):
+        scalars = jnp.stack(
+            [params["intercept"], params["slope"], params["log_sigma"]]
+        ).astype(jnp.float32)
+        return linreg_reductions(
+            scalars, params["offsets"].astype(jnp.float32), x, y, mask,
+            interpret=interpret,
+        )
+
+    @jax.custom_vjp
+    def data_logp(params):
+        ll, _, _, _ = reductions(params)
+        return jnp.sum(ll)
+
+    def fwd(params):
+        ll, gmu, gx, gz = reductions(params)
+        grads = {
+            "intercept": jnp.sum(gmu),
+            "slope": jnp.sum(gx),
+            "log_sigma": jnp.sum(gz),
+            "offsets": gmu.astype(params["offsets"].dtype),
+        }
+        return jnp.sum(ll), grads
+
+    def bwd(grads, g):
+        return (jax.tree_util.tree_map(lambda t: g * t, grads),)
+
+    data_logp.defvjp(fwd, bwd)
+
+    def logp_and_grad(params):
+        return jax.value_and_grad(data_logp)(params)
+
+    logp_and_grad.data_logp = data_logp
+    return logp_and_grad

@@ -43,6 +43,20 @@ class TestLogpGradOp:
         assert len(apply.outputs) == 3
         assert apply.outputs[0].ndim == 0
 
+    def test_int_input_grad_is_float_typed(self):
+        """Grad output for an int-coerced input must be float-typed —
+        an int-typed grad output would silently truncate the gradient
+        in perform (the reference's ``i.type()`` typing replicates the
+        trap, reference: wrapper_ops.py:97-105; we upcast instead)."""
+        op = FederatedLogpGradOp(quadratic_logp_grad)
+        b = pt.dvector("b")
+        apply = op.make_node(2, b)
+        assert apply.outputs[1].type.dtype.startswith("float")
+        g = pytensor.function([b], apply.outputs[1])
+        # a=2 -> d logp/da = -2*(2-1) = -2.0 (not truncated to -2 int,
+        # and not rounded away on a non-integer value either)
+        np.testing.assert_allclose(g(np.array([1.0, 5.0])), -2.0)
+
     def test_perform_and_eval(self):
         op = FederatedLogpGradOp(quadratic_logp_grad)
         a = pt.dscalar("a")
