@@ -4,8 +4,11 @@ from .glm import HierarchicalRadonGLM, generate_radon_data
 from .linear import FederatedLinearRegression, generate_node_data
 from .logistic import FederatedLogisticRegression, generate_logistic_data
 from .ode import LotkaVolterraModel, generate_lv_data, make_lv_model, rk4_integrate
+from .timeseries import SeqShardedAR1, generate_ar1_data
 
 __all__ = [
+    "SeqShardedAR1",
+    "generate_ar1_data",
     "FederatedLinearRegression",
     "FederatedLogisticRegression",
     "HierarchicalRadonGLM",

@@ -34,7 +34,7 @@ from jax.sharding import Mesh
 from ..parallel.packing import ShardedData, pack_shards
 from ..parallel.sharded import FederatedLogp
 
-LOG_2PI = float(np.log(2.0 * np.pi))
+from ..utils import LOG_2PI  # single shared definition (re-exported here)
 
 
 def generate_node_data(

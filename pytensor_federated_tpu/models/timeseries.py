@@ -1,0 +1,131 @@
+"""Sequence-parallel time-series models (long-context likelihoods).
+
+Net-new model family: the reference has no sequence models (SURVEY §5 —
+its only scale axis is shard count).  Here the *sequence* is the scale
+axis: an AR(1) observation chain of length T is sharded along the
+``"seq"`` mesh axis and its Markov-factored log-likelihood is computed
+with one boundary ``ppermute`` per evaluation
+(:func:`..parallel.ring.seq_sharded_markov_logp`) — communication is one
+element per device per eval, regardless of T.
+
+Model:
+
+    y_0 ~ Normal(mu, sigma / sqrt(1 - phi^2))          (stationary init)
+    y_t ~ Normal(mu + phi * (y_{t-1} - mu), sigma)     t >= 1
+
+Parameters: ``mu``, ``arctanh_phi`` (unconstrained; phi = tanh), and
+``log_sigma`` (unconstrained; sigma = exp), so samplers work in R^3.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from ..parallel.mesh import SEQ_AXIS
+from ..parallel.ring import seq_sharded_markov_logp
+from ..utils import LOG_2PI
+
+
+def generate_ar1_data(
+    n_steps: int = 4096,
+    *,
+    mu: float = 0.5,
+    phi: float = 0.8,
+    sigma: float = 0.3,
+    seed: int = 7,
+) -> np.ndarray:
+    """Simulate one AR(1) path (float32, stationary start)."""
+    rng = np.random.default_rng(seed)
+    y = np.empty(n_steps, dtype=np.float32)
+    y[0] = mu + rng.normal() * sigma / np.sqrt(1.0 - phi**2)
+    eps = rng.normal(size=n_steps).astype(np.float32) * sigma
+    for t in range(1, n_steps):
+        y[t] = mu + phi * (y[t - 1] - mu) + eps[t]
+    return y
+
+
+def _unpack(params: Any):
+    mu = params["mu"]
+    phi = jnp.tanh(params["arctanh_phi"])
+    sigma = jnp.exp(params["log_sigma"])
+    return mu, phi, sigma
+
+
+def _trans_logp(params, y_prev, y_curr):
+    """Vectorized transition density log N(y_t | mu + phi (y_{t-1}-mu), sigma)."""
+    mu, phi, sigma = _unpack(params)
+    resid = y_curr - (mu + phi * (y_prev - mu))
+    return -0.5 * (resid / sigma) ** 2 - jnp.log(sigma) - 0.5 * LOG_2PI
+
+
+def _init_logp(params, y0):
+    mu, phi, sigma = _unpack(params)
+    s0 = sigma / jnp.sqrt(1.0 - phi**2)
+    return -0.5 * ((y0 - mu) / s0) ** 2 - jnp.log(s0) - 0.5 * LOG_2PI
+
+
+def _prior_logp(params):
+    """Weak priors keeping the posterior proper: mu,arctanh_phi,log_sigma ~ N(0, 10)."""
+    return sum(
+        -0.5 * (params[k] / 10.0) ** 2 for k in ("mu", "arctanh_phi", "log_sigma")
+    )
+
+
+class SeqShardedAR1:
+    """AR(1) likelihood with the sequence sharded across the mesh.
+
+    With ``mesh=None`` the same model evaluates single-device via
+    ``lax.scan``-free vectorized form (the ground-truth path used by the
+    equivalence tests, mirroring the reference's golden-model pattern,
+    reference: test_demo_node.py:29-65).
+    """
+
+    def __init__(
+        self,
+        y: np.ndarray,
+        *,
+        mesh: Optional[Mesh] = None,
+        axis: str = SEQ_AXIS,
+    ):
+        self.y = jnp.asarray(y)
+        self.mesh = mesh
+        self.axis = axis
+
+        if mesh is not None:
+            like = seq_sharded_markov_logp(
+                _trans_logp, _init_logp, self.y, mesh=mesh, axis=axis
+            )
+
+            def logp(params):
+                return like(params) + _prior_logp(params)
+
+        else:
+            y_ = self.y
+
+            def logp(params):
+                lp = _init_logp(params, y_[0])
+                lp = lp + jnp.sum(_trans_logp(params, y_[:-1], y_[1:]))
+                return lp + _prior_logp(params)
+
+        self._logp = jax.jit(logp)
+        self._logp_and_grad = jax.jit(jax.value_and_grad(logp))
+
+    def init_params(self) -> dict:
+        return {
+            "mu": jnp.zeros(()),
+            "arctanh_phi": jnp.zeros(()),
+            "log_sigma": jnp.zeros(()),
+        }
+
+    def logp(self, params: Any) -> jax.Array:
+        return self._logp(params)
+
+    def logp_and_grad(self, params: Any):
+        return self._logp_and_grad(params)
+
+    __call__ = logp

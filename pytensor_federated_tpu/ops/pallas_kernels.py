@@ -37,7 +37,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-LOG_2PI = float(np.log(2.0 * np.pi))
+from ..utils import LOG_2PI
 
 # Lane layout of the per-shard reduction tile (see _linreg_kernel).
 _LANE_LL, _LANE_GMU, _LANE_GX, _LANE_GZ = 0, 1, 2, 3
@@ -116,9 +116,6 @@ def _pad_axis(a: jax.Array, axis: int, to_multiple: int) -> jax.Array:
     return jnp.pad(a, widths)
 
 
-@functools.partial(
-    jax.jit, static_argnames=("block_shards", "block_obs", "interpret")
-)
 def linreg_reductions(
     scalars: jax.Array,
     offsets: jax.Array,
@@ -132,13 +129,45 @@ def linreg_reductions(
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Per-shard ``(ll, gmu, gx, gz)`` reductions, one fused data pass.
 
+    Resolves ``interpret=None`` from the environment *outside* jit so the
+    jit cache keys on the resolved value (an env change between calls
+    must not be masked by a stale cached trace).
+    """
+    if interpret is None:
+        interpret = _interpret_default()
+    return _linreg_reductions_jit(
+        scalars,
+        offsets,
+        x,
+        y,
+        mask,
+        block_shards=block_shards,
+        block_obs=block_obs,
+        interpret=bool(interpret),
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_shards", "block_obs", "interpret")
+)
+def _linreg_reductions_jit(
+    scalars: jax.Array,
+    offsets: jax.Array,
+    x: jax.Array,
+    y: jax.Array,
+    mask: jax.Array,
+    *,
+    block_shards: int,
+    block_obs: int,
+    interpret: bool,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Per-shard ``(ll, gmu, gx, gz)`` reductions, one fused data pass.
+
     ``scalars = [intercept, slope, log_sigma]``; ``offsets``: ``(S,)``;
     ``x, y, mask``: ``(S, N)`` float32.  Returns four ``(S,)`` vectors.
     Shards/observations are zero-padded to the block grid; padded rows
     and columns carry ``mask == 0`` so they contribute nothing.
     """
-    if interpret is None:
-        interpret = _interpret_default()
     S, N = x.shape
 
     bs = min(block_shards, max(S, 1))

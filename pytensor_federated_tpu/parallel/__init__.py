@@ -11,6 +11,13 @@ from .mesh import (
     single_device_mesh,
 )
 from .packing import ShardedData, pack_shards
+from .ring import (
+    ring_all_pairs_sum,
+    ring_attention,
+    ring_shift,
+    seq_sharded_markov_logp,
+    shift_right_across_shards,
+)
 from .sharded import FederatedLogp, sharded_compute
 
 __all__ = [
@@ -20,6 +27,11 @@ __all__ = [
     "DeviceLoad",
     "FederatedLogp",
     "ShardedData",
+    "ring_all_pairs_sum",
+    "ring_attention",
+    "ring_shift",
+    "seq_sharded_markov_logp",
+    "shift_right_across_shards",
     "get_load",
     "healthy_devices",
     "make_mesh",

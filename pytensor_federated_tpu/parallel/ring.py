@@ -1,0 +1,301 @@
+"""Ring collectives: sequence/context parallelism over the device mesh.
+
+Net-new capability relative to the reference (SURVEY §5 records "long
+context / sequence parallelism: N/A" — the reference has no sequences at
+all; its only scale axis is the shard count, reference: demo_model.py:34-36).
+This module makes long sequences a first-class scale axis: a sequence is
+sharded along the ``"seq"`` mesh axis, and cross-shard coupling is
+computed with ``lax.ppermute`` rings over ICI — no host round-trips, no
+all-gather of the full sequence on any single device.
+
+Three layers of generality:
+
+- :func:`ring_shift` / :func:`shift_right_across_shards` — boundary
+  passing for Markov-factored likelihoods (state-space, AR): each device
+  only needs its left neighbour's last element.
+- :func:`ring_all_pairs_sum` — all-pairs block reductions for densely
+  coupled likelihoods (pairwise potentials, GP-style kernels): every
+  block visits every device once around the ring; memory stays
+  O(local block), compute is overlapped with ICI transfers by XLA.
+- :func:`ring_attention` — blockwise-softmax attention over the ring
+  (the ring-attention pattern: online max/normalizer update per incoming
+  key/value block), for attention-based sequence likelihoods.
+
+All three are written to be used *inside* ``shard_map`` (they take an
+axis name), with jittable wrappers that build the ``shard_map`` for you.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .mesh import SEQ_AXIS
+
+
+def _mark_varying(x: Any, axis_name: str) -> Any:
+    """Mark a replicated value as device-varying over ``axis_name``.
+
+    shard_map tracks which values vary across a mesh axis; loop carries
+    that *become* varying (e.g. accumulators fed by ppermute'd data) must
+    start varying or the scan carry types mismatch.
+    """
+    if hasattr(lax, "pcast"):
+        f = lambda l: lax.pcast(l, axis_name, to="varying")
+    else:  # older jax
+        f = lambda l: lax.pvary(l, axis_name)
+    return jax.tree_util.tree_map(f, x)
+
+
+def _ring_perm(n: int, *, reverse: bool = False) -> list:
+    """Permutation sending block j -> j+1 (mod n); device i ends up
+    holding block (i - step) mod n after each application."""
+    if reverse:
+        return [(j, (j - 1) % n) for j in range(n)]
+    return [(j, (j + 1) % n) for j in range(n)]
+
+
+def ring_shift(x: Any, axis_name: str, n: int, *, reverse: bool = False) -> Any:
+    """One ring step: pass the local value to the next device on the ring.
+
+    Must be called inside ``shard_map`` over ``axis_name``; ``n`` is the
+    static ring size (``mesh.shape[axis_name]``).
+    """
+    perm = _ring_perm(n, reverse=reverse)
+    return jax.tree_util.tree_map(
+        lambda l: lax.ppermute(l, axis_name, perm), x
+    )
+
+
+def shift_right_across_shards(x: jax.Array, axis_name: str, n: int) -> jax.Array:
+    """Shift a sequence-sharded array right by one *global* position.
+
+    Local view: device i holds a contiguous chunk ``x[i*Tb:(i+1)*Tb]``.
+    The returned chunk is the same slice of the globally right-shifted
+    sequence: element 0 is the left neighbour's last element (zero on
+    device 0 — ``ppermute`` leaves unaddressed destinations zero-filled).
+
+    This is the entire communication cost of a Markov-factored
+    sequence likelihood: one scalar-row exchange per step, riding ICI.
+    """
+    boundary = x[-1:]
+    # Send each device's last element to its right neighbour; device 0
+    # receives nothing and keeps zeros.
+    prev_last = lax.ppermute(
+        boundary, axis_name, [(j, j + 1) for j in range(n - 1)]
+    )
+    return jnp.concatenate([prev_last, x[:-1]], axis=0)
+
+
+def seq_sharded_markov_logp(
+    trans_logp: Callable[[Any, jax.Array, jax.Array], jax.Array],
+    init_logp: Callable[[Any, jax.Array], jax.Array],
+    y: jax.Array,
+    *,
+    mesh: Mesh,
+    axis: str = SEQ_AXIS,
+) -> Callable[[Any], jax.Array]:
+    """Sequence-parallel log-likelihood of a Markov-factored model.
+
+    ``logp(params) = init_logp(params, y[0]) + Σ_{t>=1} trans_logp(params,
+    y[t-1], y[t])`` with ``y`` (length T, optionally trailing feature
+    dims) sharded along ``axis``.  ``trans_logp`` is vectorized over
+    time (inputs ``y_prev``, ``y_curr`` of shape ``(Tb, ...)`` -> per-step
+    logps ``(Tb,)``).
+
+    The reference's federated sum-of-potentials (reference:
+    demo_model.py:34-36) has independent terms; a Markov chain's terms
+    couple neighbouring positions, which is exactly what
+    :func:`shift_right_across_shards` provides.  Differentiable: the
+    whole thing is ``ppermute`` + elementwise, so ``jax.grad`` flows
+    through the collective.
+    """
+    if axis not in mesh.axis_names:
+        raise ValueError(f"mesh has no axis {axis!r}: {mesh.axis_names}")
+    n = mesh.shape[axis]
+    if y.shape[0] % n != 0:
+        raise ValueError(f"sequence length {y.shape[0]} not divisible by {n}")
+
+    def local(params, y_local):
+        idx = lax.axis_index(axis)
+        y_prev = shift_right_across_shards(y_local, axis, n)
+        step_lp = trans_logp(params, y_prev, y_local)
+        # Global position of each local element:
+        tb = y_local.shape[0]
+        pos = idx * tb + jnp.arange(tb)
+        # t=0 contributes init_logp instead of a transition term.
+        first = init_logp(params, y_local[0])
+        lp = jnp.sum(jnp.where(pos > 0, step_lp, 0.0))
+        lp = lp + jnp.where(idx == 0, first, 0.0)
+        return lax.psum(lp, axis)
+
+    def logp(params):
+        return shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(jax.tree_util.tree_map(lambda _: P(), params), P(axis)),
+            out_specs=P(),
+        )(params, y)
+
+    return jax.jit(logp)
+
+
+def ring_all_pairs_sum(
+    pair_fn: Callable[[Any, Any], jax.Array],
+    data: Any,
+    *,
+    mesh: Mesh,
+    axis: str = SEQ_AXIS,
+    include_self: bool = True,
+) -> jax.Array:
+    """Σ over all *ordered* block pairs ``pair_fn(my_block, other_block)``.
+
+    ``data`` is a pytree sharded along ``axis`` (leading dim).  Each
+    device keeps its resident block and receives every other block once
+    as it travels around the ring — the classic systolic all-pairs
+    pattern (memory O(block), ``n`` ring steps).  With
+    ``include_self=False`` the diagonal (r=0) term is skipped.
+
+    For a symmetric ``pair_fn`` this evaluates each unordered pair twice;
+    divide by 2 at the call site if needed.  Differentiable end-to-end.
+    """
+    treedef = jax.tree_util.tree_structure(data)
+    fn = _all_pairs_jitted(pair_fn, mesh, axis, include_self, treedef)
+    return fn(data)
+
+
+# Cache of jitted all-pairs/attention programs so repeated calls (e.g.
+# one per sampler step) hit XLA's executable cache instead of re-tracing
+# a fresh closure every time.
+_RING_CACHE: dict = {}
+
+
+def _all_pairs_jitted(pair_fn, mesh, axis, include_self, treedef):
+    key = ("all_pairs", pair_fn, mesh, axis, include_self, treedef)
+    if key in _RING_CACHE:
+        return _RING_CACHE[key]
+    n = mesh.shape[axis]
+
+    def local(my):
+        def body(r, carry):
+            acc, travelling = carry
+            term = pair_fn(my, travelling)
+            acc = acc + jnp.where(
+                jnp.logical_or(include_self, r > 0), term, 0.0
+            )
+            travelling = ring_shift(travelling, axis, n)
+            return acc, travelling
+
+        acc0 = _mark_varying(jnp.zeros(()), axis)
+        acc, _ = lax.fori_loop(0, n, body, (acc0, my))
+        return lax.psum(acc, axis)
+
+    specs = jax.tree_util.tree_unflatten(
+        treedef, [P(axis)] * treedef.num_leaves
+    )
+    fn = jax.jit(
+        shard_map(local, mesh=mesh, in_specs=(specs,), out_specs=P())
+    )
+    _RING_CACHE[key] = fn
+    return fn
+
+
+def _online_softmax_block(q, k, v, m, l, o, valid_mask):
+    """One incoming (k, v) block's contribution, flash-attention style.
+
+    ``q``: (Tq, d); ``k``/``v``: (Tk, d); running max ``m`` (Tq,),
+    normalizer ``l`` (Tq,), output accumulator ``o`` (Tq, d).
+    ``valid_mask`` (Tq, Tk) — True where attention is allowed.
+    """
+    d = q.shape[-1]
+    s = (q @ k.T) / jnp.sqrt(jnp.asarray(d, q.dtype))
+    s = jnp.where(valid_mask, s, -jnp.inf)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    # exp(-inf - -inf) guard: rows with no valid key yet keep m=-inf.
+    safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
+    p = jnp.where(valid_mask, jnp.exp(s - safe_m[:, None]), 0.0)
+    l_new = alpha * l + jnp.sum(p, axis=-1)
+    o_new = alpha[:, None] * o + p.astype(v.dtype) @ v
+    return m_new, l_new, o_new
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mesh: Mesh,
+    axis: str = SEQ_AXIS,
+    causal: bool = False,
+) -> jax.Array:
+    """Exact attention over a sequence sharded along ``axis``.
+
+    ``q, k, v``: shape ``(T, d)`` global, partitioned on ``T``.  Key/value
+    blocks circulate the ring; each device folds every incoming block
+    into a running (max, normalizer, accumulator) triple — the blockwise
+    online softmax — so no device ever materializes the full ``T×T``
+    score matrix or the full K/V.  Compute per step is a ``(Tb, d) @
+    (d, Tb)`` matmul (MXU-shaped); communication is the K/V block on ICI,
+    overlapped with compute by XLA's latency-hiding scheduler.
+
+    Numerically exact (same result as full softmax attention), and
+    differentiable — the VJP of ``ppermute`` is the reverse ring, which
+    XLA derives automatically.
+
+    For multi-head / batched attention, ``jax.vmap`` this function over
+    the leading axes.
+    """
+    n = mesh.shape[axis]
+    if q.shape[0] % n != 0:
+        raise ValueError(f"sequence length {q.shape[0]} not divisible by {n}")
+    return _ring_attention_jitted(mesh, axis, causal)(q, k, v)
+
+
+def _ring_attention_jitted(mesh, axis, causal):
+    key = ("attention", mesh, axis, causal)
+    if key in _RING_CACHE:
+        return _RING_CACHE[key]
+    n = mesh.shape[axis]
+
+    def local(q_local, k_local, v_local):
+        idx = lax.axis_index(axis)
+        tb = q_local.shape[0]
+        q_pos = idx * tb + jnp.arange(tb)
+
+        m0 = _mark_varying(jnp.full((tb,), -jnp.inf, dtype=q_local.dtype), axis)
+        l0 = _mark_varying(jnp.zeros((tb,), dtype=q_local.dtype), axis)
+        o0 = jnp.zeros_like(q_local)
+
+        def body(r, carry):
+            m, l, o, kb, vb = carry
+            # After r ring steps, this device holds block (idx - r) mod n.
+            src = (idx - r) % n
+            k_pos = src * tb + jnp.arange(tb)
+            if causal:
+                valid = q_pos[:, None] >= k_pos[None, :]
+            else:
+                valid = jnp.ones((tb, tb), dtype=bool)
+            m, l, o = _online_softmax_block(q_local, kb, vb, m, l, o, valid)
+            kb, vb = ring_shift((kb, vb), axis, n)
+            return m, l, o, kb, vb
+
+        m, l, o, _, _ = lax.fori_loop(
+            0, n, body, (m0, l0, o0, k_local, v_local)
+        )
+        return o / jnp.maximum(l, jnp.finfo(l.dtype).tiny)[:, None]
+
+    fn = jax.jit(
+        shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(axis), P(axis), P(axis)),
+            out_specs=P(axis),
+        )
+    )
+    _RING_CACHE[key] = fn
+    return fn

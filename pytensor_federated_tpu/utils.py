@@ -13,9 +13,13 @@ host-federation transport (:mod:`pytensor_federated_tpu.service`).
 from __future__ import annotations
 
 import asyncio
+import math
 from typing import Callable, Optional, Sequence, TypeVar
 
 T = TypeVar("T")
+
+# Shared Gaussian constant — single definition for every model/kernel.
+LOG_2PI = math.log(2.0 * math.pi)
 
 
 def argmin_none_or_func(
