@@ -27,6 +27,7 @@ axis name), with jittable wrappers that build the ``shard_map`` for you.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable
 
 import jax
@@ -168,40 +169,43 @@ def ring_all_pairs_sum(
     return fn(data)
 
 
-# Cache of jitted all-pairs/attention programs so repeated calls (e.g.
-# one per sampler step) hit XLA's executable cache instead of re-tracing
-# a fresh closure every time.
-_RING_CACHE: dict = {}
+# The jitted-program builders below are lru_cached so repeated calls
+# (e.g. one per sampler step) reuse the compiled executable instead of
+# re-tracing a fresh closure every time.  NOTE: the cache keys on
+# ``pair_fn`` *identity* — pass a module-level function (or hold on to
+# one closure), not a fresh lambda per call, to get cache hits.  The
+# maxsize bounds retained executables/Mesh references.
 
 
+@functools.lru_cache(maxsize=64)
 def _all_pairs_jitted(pair_fn, mesh, axis, include_self, treedef):
-    key = ("all_pairs", pair_fn, mesh, axis, include_self, treedef)
-    if key in _RING_CACHE:
-        return _RING_CACHE[key]
     n = mesh.shape[axis]
 
     def local(my):
-        def body(r, carry):
-            acc, travelling = carry
+        def fold(r, acc, travelling):
             term = pair_fn(my, travelling)
-            acc = acc + jnp.where(
+            return acc + jnp.where(
                 jnp.logical_or(include_self, r > 0), term, 0.0
             )
-            travelling = ring_shift(travelling, axis, n)
-            return acc, travelling
+
+        def body(r, carry):
+            acc, travelling = carry
+            acc = fold(r, acc, travelling)
+            return acc, ring_shift(travelling, axis, n)
 
         acc0 = _mark_varying(jnp.zeros(()), axis)
-        acc, _ = lax.fori_loop(0, n, body, (acc0, my))
+        # n-1 shift-and-fold steps, then fold the final block without
+        # the (dead) last ring shift — n folds, n-1 ICI transfers.
+        acc, travelling = lax.fori_loop(0, n - 1, body, (acc0, my))
+        acc = fold(n - 1, acc, travelling)
         return lax.psum(acc, axis)
 
     specs = jax.tree_util.tree_unflatten(
         treedef, [P(axis)] * treedef.num_leaves
     )
-    fn = jax.jit(
+    return jax.jit(
         shard_map(local, mesh=mesh, in_specs=(specs,), out_specs=P())
     )
-    _RING_CACHE[key] = fn
-    return fn
 
 
 def _online_softmax_block(q, k, v, m, l, o, valid_mask):
@@ -256,10 +260,8 @@ def ring_attention(
     return _ring_attention_jitted(mesh, axis, causal)(q, k, v)
 
 
+@functools.lru_cache(maxsize=64)
 def _ring_attention_jitted(mesh, axis, causal):
-    key = ("attention", mesh, axis, causal)
-    if key in _RING_CACHE:
-        return _RING_CACHE[key]
     n = mesh.shape[axis]
 
     def local(q_local, k_local, v_local):
@@ -267,12 +269,7 @@ def _ring_attention_jitted(mesh, axis, causal):
         tb = q_local.shape[0]
         q_pos = idx * tb + jnp.arange(tb)
 
-        m0 = _mark_varying(jnp.full((tb,), -jnp.inf, dtype=q_local.dtype), axis)
-        l0 = _mark_varying(jnp.zeros((tb,), dtype=q_local.dtype), axis)
-        o0 = jnp.zeros_like(q_local)
-
-        def body(r, carry):
-            m, l, o, kb, vb = carry
+        def fold(r, m, l, o, kb, vb):
             # After r ring steps, this device holds block (idx - r) mod n.
             src = (idx - r) % n
             k_pos = src * tb + jnp.arange(tb)
@@ -280,16 +277,27 @@ def _ring_attention_jitted(mesh, axis, causal):
                 valid = q_pos[:, None] >= k_pos[None, :]
             else:
                 valid = jnp.ones((tb, tb), dtype=bool)
-            m, l, o = _online_softmax_block(q_local, kb, vb, m, l, o, valid)
+            return _online_softmax_block(q_local, kb, vb, m, l, o, valid)
+
+        m0 = _mark_varying(jnp.full((tb,), -jnp.inf, dtype=q_local.dtype), axis)
+        l0 = _mark_varying(jnp.zeros((tb,), dtype=q_local.dtype), axis)
+        o0 = jnp.zeros_like(q_local)
+
+        def body(r, carry):
+            m, l, o, kb, vb = carry
+            m, l, o = fold(r, m, l, o, kb, vb)
             kb, vb = ring_shift((kb, vb), axis, n)
             return m, l, o, kb, vb
 
-        m, l, o, _, _ = lax.fori_loop(
-            0, n, body, (m0, l0, o0, k_local, v_local)
+        # n-1 fold+shift steps, then the final fold with no trailing
+        # (dead) ring shift — n folds, n-1 K/V block transfers on ICI.
+        m, l, o, kb, vb = lax.fori_loop(
+            0, n - 1, body, (m0, l0, o0, k_local, v_local)
         )
+        m, l, o = fold(n - 1, m, l, o, kb, vb)
         return o / jnp.maximum(l, jnp.finfo(l.dtype).tiny)[:, None]
 
-    fn = jax.jit(
+    return jax.jit(
         shard_map(
             local,
             mesh=mesh,
@@ -297,5 +305,3 @@ def _ring_attention_jitted(mesh, axis, causal):
             out_specs=P(axis),
         )
     )
-    _RING_CACHE[key] = fn
-    return fn

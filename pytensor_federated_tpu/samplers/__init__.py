@@ -1,13 +1,22 @@
 """On-device samplers (replaces the reference's PyMC driver dependency)."""
 
+from .advi import ADVIResult, advi_fit
+from .ensemble import EnsembleResult, ensemble_sample
 from .hmc import HMCState, find_reasonable_step_size, hmc_init, hmc_step, leapfrog
 from .mcmc import SampleResult, find_map, sample
 from .metropolis import metropolis_init, metropolis_step
 from .nuts import NUTSInfo, nuts_step
+from .smc import SMCResult, smc_sample
 from .util import AdaptSchedule, flatten_logp
 
 __all__ = [
+    "ADVIResult",
     "AdaptSchedule",
+    "EnsembleResult",
+    "SMCResult",
+    "advi_fit",
+    "ensemble_sample",
+    "smc_sample",
     "HMCState",
     "NUTSInfo",
     "SampleResult",

@@ -1,0 +1,107 @@
+"""Mean-field ADVI — stochastic variational inference on the federated logp.
+
+Net-new capability: the reference's only point-estimate tool is
+``pm.find_MAP`` (reference: demo_model.py:38-39); ADVI adds a calibrated
+posterior *approximation* at a fraction of MCMC cost.  TPU-shaped by
+construction: each optimization step draws ``n_mc`` reparameterized
+samples and evaluates the (sharded, psum-reduced) logp as one batched
+call, so the gradient of the ELBO is a single fused XLA program.
+
+Approximation family: fully factorized Gaussian
+``q(x) = N(mu, diag(exp(log_sd)^2))``; ELBO via the reparameterization
+trick, entropy in closed form.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..utils import LOG_2PI
+from .util import flatten_logp
+
+try:
+    import optax
+
+    _HAS_OPTAX = True
+except ModuleNotFoundError:  # pragma: no cover
+    _HAS_OPTAX = False
+
+
+class ADVIResult(NamedTuple):
+    mean: Any  # user pytree — posterior mean of q
+    sd: Any  # user pytree — posterior sd of q
+    elbo_trace: jax.Array  # (num_steps,)
+    flat_mean: jax.Array
+    flat_log_sd: jax.Array
+
+    def sample(self, key: jax.Array, n: int, unravel) -> Any:
+        eps = jax.random.normal(
+            key, (n, self.flat_mean.shape[0]), self.flat_mean.dtype
+        )
+        flat = self.flat_mean[None, :] + jnp.exp(self.flat_log_sd)[None, :] * eps
+        return jax.vmap(unravel)(flat)
+
+
+def advi_fit(
+    logp_fn: Callable[[Any], jax.Array],
+    init_params: Any,
+    *,
+    key: jax.Array,
+    num_steps: int = 2000,
+    n_mc: int = 8,
+    learning_rate: float = 1e-2,
+    init_log_sd: float = -2.0,
+) -> tuple[ADVIResult, Callable]:
+    """Fit mean-field ADVI to ``logp_fn``; returns ``(result, unravel)``.
+
+    The whole optimization (all steps) runs in one ``lax.scan`` under
+    jit.  ``result.sample(key, n, unravel)`` draws from the fitted
+    approximation in user pytree structure.
+    """
+    if not _HAS_OPTAX:
+        raise ModuleNotFoundError("advi_fit requires optax")
+    flat_logp, flat_init, unravel = flatten_logp(logp_fn, init_params)
+    dim = flat_init.shape[0]
+    dtype = flat_init.dtype
+    batch_logp = jax.vmap(flat_logp)
+
+    opt = optax.adam(learning_rate)
+
+    def neg_elbo(var_params, key):
+        mu, log_sd = var_params
+        eps = jax.random.normal(key, (n_mc, dim), dtype)
+        x = mu[None, :] + jnp.exp(log_sd)[None, :] * eps
+        # E_q[logp] (MC) + entropy of q (closed form).
+        e_logp = jnp.mean(batch_logp(x))
+        entropy = jnp.sum(log_sd) + 0.5 * dim * (1.0 + LOG_2PI)
+        return -(e_logp + entropy)
+
+    @jax.jit
+    def run(key):
+        var0 = (flat_init, jnp.full((dim,), init_log_sd, dtype))
+        opt0 = opt.init(var0)
+
+        def step(carry, key):
+            var, opt_state = carry
+            loss, g = jax.value_and_grad(neg_elbo)(var, key)
+            updates, opt_state = opt.update(g, opt_state)
+            var = optax.apply_updates(var, updates)
+            return (var, opt_state), -loss
+
+        (var, _), elbos = jax.lax.scan(
+            step, (var0, opt0), jax.random.split(key, num_steps)
+        )
+        return var, elbos
+
+    (mu, log_sd), elbos = run(key)
+    result = ADVIResult(
+        mean=unravel(mu),
+        sd=unravel(jnp.exp(log_sd)),
+        elbo_trace=elbos,
+        flat_mean=mu,
+        flat_log_sd=log_sd,
+    )
+    return result, unravel

@@ -1,0 +1,169 @@
+"""SMC / ensemble / ADVI samplers — accuracy against closed forms.
+
+Pattern: posterior-accuracy assertions with fixed seeds (reference:
+test_wrapper_ops.py:105-117 asserts posterior median slope = 2 ± 0.1).
+Ground truth here is analytic (Gaussian conjugacy), which is stronger.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytensor_federated_tpu.samplers import (
+    advi_fit,
+    ensemble_sample,
+    smc_sample,
+)
+
+
+def make_gaussian_target(dim=3, seed=0):
+    """Correlated Gaussian: logp = -0.5 (x-m)^T P (x-m); known mean/cov."""
+    rng = np.random.default_rng(seed)
+    m = rng.normal(size=dim).astype(np.float32)
+    a = rng.normal(size=(dim, dim)).astype(np.float32)
+    cov = a @ a.T + dim * np.eye(dim, dtype=np.float32)
+    prec = np.linalg.inv(cov)
+    m_j, prec_j = jnp.asarray(m), jnp.asarray(prec)
+
+    def logp(params):
+        d = params["x"] - m_j
+        return -0.5 * d @ prec_j @ d
+
+    return logp, m, cov
+
+
+class TestSMC:
+    def test_gaussian_moments_and_evidence(self):
+        logp, m, cov = make_gaussian_target(dim=3, seed=1)
+        res = smc_sample(
+            logp,
+            {"x": jnp.zeros(3)},
+            key=jax.random.PRNGKey(0),
+            n_particles=4096,
+            n_mutations=8,
+            init_jitter=3.0,
+        )
+        assert float(res.final_beta) == 1.0
+        assert int(res.n_stages) < 50
+        xs = np.asarray(res.samples["x"])
+        np.testing.assert_allclose(xs.mean(0), m, atol=0.25)
+        np.testing.assert_allclose(
+            np.cov(xs.T), cov, atol=0.2 * np.abs(cov).max() + 0.3
+        )
+        # Normalizing constant of exp(-0.5 d^T P d) is (2pi)^{d/2}|cov|^{1/2}.
+        want_log_z = 0.5 * 3 * np.log(2 * np.pi) + 0.5 * np.linalg.slogdet(cov)[1]
+        assert abs(float(res.log_evidence) - want_log_z) < 0.5
+
+    def test_accept_rate_sane(self):
+        logp, _, _ = make_gaussian_target(dim=2, seed=2)
+        res = smc_sample(
+            logp,
+            {"x": jnp.zeros(2)},
+            key=jax.random.PRNGKey(1),
+            n_particles=1024,
+        )
+        assert 0.05 < float(res.accept_rate) <= 1.0
+
+
+class TestEnsemble:
+    def test_gaussian_moments(self):
+        logp, m, cov = make_gaussian_target(dim=3, seed=3)
+        res = ensemble_sample(
+            logp,
+            {"x": jnp.zeros(3)},
+            key=jax.random.PRNGKey(2),
+            n_walkers=64,
+            num_warmup=1500,
+            num_samples=1500,
+            init_jitter=1.0,
+        )
+        xs = np.asarray(res.samples["x"]).reshape(-1, 3)
+        np.testing.assert_allclose(xs.mean(0), m, atol=0.3)
+        sd_want = np.sqrt(np.diag(cov))
+        np.testing.assert_allclose(xs.std(0), sd_want, rtol=0.35)
+        assert 0.1 < float(res.accept_rate) < 0.9
+
+    def test_validation(self):
+        logp, _, _ = make_gaussian_target(dim=4)
+        with pytest.raises(ValueError, match="even"):
+            ensemble_sample(
+                logp, {"x": jnp.zeros(4)}, key=jax.random.PRNGKey(0), n_walkers=7
+            )
+        with pytest.raises(ValueError, match="2\\*dim"):
+            ensemble_sample(
+                logp, {"x": jnp.zeros(4)}, key=jax.random.PRNGKey(0), n_walkers=6
+            )
+
+    def test_gradient_free(self):
+        """Works on a logp JAX cannot differentiate (uses stop_gradient +
+        rounding) — the capability NUTS lacks."""
+
+        def logp(params):
+            x = params["x"]
+            return -0.5 * jnp.sum(jax.lax.stop_gradient(x) ** 2)
+
+        res = ensemble_sample(
+            logp,
+            {"x": jnp.zeros(2)},
+            key=jax.random.PRNGKey(3),
+            n_walkers=32,
+            num_warmup=500,
+            num_samples=500,
+        )
+        xs = np.asarray(res.samples["x"]).reshape(-1, 2)
+        np.testing.assert_allclose(xs.mean(0), 0.0, atol=0.3)
+
+
+class TestADVI:
+    def test_gaussian_recovery(self):
+        logp, m, cov = make_gaussian_target(dim=3, seed=4)
+        res, unravel = advi_fit(
+            logp,
+            {"x": jnp.zeros(3)},
+            key=jax.random.PRNGKey(4),
+            num_steps=3000,
+            n_mc=16,
+            learning_rate=2e-2,
+        )
+        np.testing.assert_allclose(np.asarray(res.mean["x"]), m, atol=0.15)
+        # Mean-field sd underestimates marginal sd for correlated targets;
+        # it matches 1/sqrt(diag(precision)).
+        want_sd = 1.0 / np.sqrt(np.diag(np.linalg.inv(cov)))
+        np.testing.assert_allclose(
+            np.asarray(res.sd["x"]), want_sd, rtol=0.25
+        )
+        # ELBO improved and converged.
+        elbo = np.asarray(res.elbo_trace)
+        assert elbo[-100:].mean() > elbo[:100].mean()
+
+    def test_sample_shapes(self):
+        logp, _, _ = make_gaussian_target(dim=2, seed=5)
+        res, unravel = advi_fit(
+            logp, {"x": jnp.zeros(2)}, key=jax.random.PRNGKey(5), num_steps=200
+        )
+        draws = res.sample(jax.random.PRNGKey(6), 128, unravel)
+        assert draws["x"].shape == (128, 2)
+
+
+class TestFederatedIntegration:
+    def test_smc_on_federated_logp(self, mesh8):
+        """SMC over the sharded psum evaluator — sampler and collective
+        compose in one program."""
+        from pytensor_federated_tpu.models.linear import (
+            FederatedLinearRegression,
+            generate_node_data,
+        )
+
+        data, _offsets = generate_node_data(8, n_obs=32, seed=9, slope=2.0)
+        model = FederatedLinearRegression(data, mesh=mesh8)
+        res = smc_sample(
+            model.logp,
+            model.init_params(),
+            key=jax.random.PRNGKey(7),
+            n_particles=512,
+            n_mutations=5,
+            init_jitter=0.5,
+        )
+        slope = float(np.median(np.asarray(res.samples["slope"])))
+        assert abs(slope - 2.0) < 0.25, slope
