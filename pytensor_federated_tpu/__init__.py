@@ -39,6 +39,7 @@ from .parallel import (
     sharded_compute,
     single_device_mesh,
 )
+from .checkpoint import load_pytree, sample_checkpointed, save_pytree
 from .signatures import ArraysSpec, ComputeFn, LogpFn, LogpGradFn, spec_of
 from .version import __version__
 from .wrappers import logp_grad_from_logp, wrap_logp_fn, wrap_logp_grad_fn
@@ -67,10 +68,13 @@ __all__ = [
     "fuse",
     "get_load",
     "healthy_devices",
+    "load_pytree",
     "logp_grad_from_logp",
     "make_mesh",
     "pack_shards",
     "parallel_host_call",
+    "sample_checkpointed",
+    "save_pytree",
     "sharded_compute",
     "single_device_mesh",
     "spec_of",

@@ -1,0 +1,157 @@
+"""Checkpoint/resume subsystem (checkpoint.py).
+
+Key property: a run interrupted at any chunk boundary and resumed
+produces BIT-IDENTICAL draws to an uninterrupted run (the durability
+analog of the reference's stateless-retry semantics, reference:
+service.py:408-416 — there a lost call is simply re-sent; here a lost
+process is re-started from disk).
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytensor_federated_tpu.checkpoint import (
+    load_pytree,
+    sample_checkpointed,
+    save_pytree,
+)
+
+
+class TestPytreeSnapshot:
+    def test_roundtrip(self, tmp_path):
+        tree = {
+            "a": jnp.arange(6, dtype=jnp.float32).reshape(2, 3),
+            "b": (jnp.zeros(()), jnp.ones((4,), jnp.int32)),
+        }
+        p = str(tmp_path / "ck.npz")
+        save_pytree(p, tree, {"step": 7})
+        got, meta = load_pytree(p, tree)
+        assert meta == {"step": 7}
+        for a, b in zip(jax.tree_util.tree_leaves(tree), jax.tree_util.tree_leaves(got)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_structure_mismatch_raises(self, tmp_path):
+        p = str(tmp_path / "ck.npz")
+        save_pytree(p, {"a": jnp.zeros(2), "b": jnp.zeros(3)})
+        with pytest.raises(ValueError, match="structure mismatch"):
+            load_pytree(p, {"a": jnp.zeros(2)})
+
+    def test_atomic_overwrite(self, tmp_path):
+        p = str(tmp_path / "ck.npz")
+        save_pytree(p, {"a": jnp.zeros(2)}, {"v": 1})
+        save_pytree(p, {"a": jnp.ones(2)}, {"v": 2})
+        got, meta = load_pytree(p, {"a": jnp.zeros(2)})
+        assert meta["v"] == 2
+        np.testing.assert_array_equal(np.asarray(got["a"]), np.ones(2))
+        # no stray temp files
+        assert os.listdir(tmp_path) == ["ck.npz"]
+
+
+def _logp(params):
+    x = params["x"]
+    return -0.5 * jnp.sum(x**2)
+
+
+class TestSampleCheckpointed:
+    def test_resume_bit_identical(self, tmp_path):
+        kwargs = dict(
+            key=jax.random.PRNGKey(0),
+            num_warmup=100,
+            num_samples=60,
+            num_chains=2,
+            checkpoint_every=20,
+            kernel="nuts",
+            max_depth=5,
+        )
+        init = {"x": jnp.zeros(3)}
+
+        # Uninterrupted run.
+        p1 = str(tmp_path / "run1.npz")
+        res_full = sample_checkpointed(
+            _logp, init, checkpoint_path=p1, **kwargs
+        )
+
+        # Interrupted run: stop after chunk 1 by monkeypatching range?
+        # Simpler: run once with num_samples=20 config... instead simulate
+        # interruption by copying the chunk-1 checkpoint: run full into p2,
+        # capturing the intermediate file after the first chunk.
+        p2 = str(tmp_path / "run2.npz")
+        import pytensor_federated_tpu.checkpoint as ck
+
+        saved_states = []
+        orig_save = ck.save_pytree
+
+        def spy_save(path, tree, metadata=None):
+            orig_save(path, tree, metadata)
+            if path == p2:
+                saved_states.append(metadata["chunks_done"])
+            # Simulate a crash right after chunk 1 persists.
+            if path == p2 and metadata and metadata.get("chunks_done") == 1:
+                raise KeyboardInterrupt
+
+        ck.save_pytree = spy_save
+        try:
+            with pytest.raises(KeyboardInterrupt):
+                sample_checkpointed(_logp, init, checkpoint_path=p2, **kwargs)
+        finally:
+            ck.save_pytree = orig_save
+
+        assert saved_states[-1] == 1  # crashed after first chunk
+        # Resume: same call, same args.
+        res_resumed = sample_checkpointed(
+            _logp, init, checkpoint_path=p2, **kwargs
+        )
+        np.testing.assert_array_equal(
+            np.asarray(res_full.samples["x"]),
+            np.asarray(res_resumed.samples["x"]),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(res_full.stats["accept_prob"]),
+            np.asarray(res_resumed.stats["accept_prob"]),
+        )
+
+    def test_config_mismatch_restarts(self, tmp_path):
+        p = str(tmp_path / "run.npz")
+        init = {"x": jnp.zeros(2)}
+        sample_checkpointed(
+            _logp,
+            init,
+            key=jax.random.PRNGKey(1),
+            num_warmup=50,
+            num_samples=20,
+            num_chains=2,
+            checkpoint_every=10,
+            checkpoint_path=p,
+        )
+        # Different config: stale checkpoint must be ignored, not crash.
+        res = sample_checkpointed(
+            _logp,
+            init,
+            key=jax.random.PRNGKey(1),
+            num_warmup=50,
+            num_samples=30,
+            num_chains=2,
+            checkpoint_every=10,
+            checkpoint_path=p,
+        )
+        assert res.samples["x"].shape == (2, 30, 2)
+
+    def test_posterior_accuracy(self, tmp_path):
+        """Std-normal target: moments correct through the chunked path."""
+        res = sample_checkpointed(
+            _logp,
+            {"x": jnp.zeros(2)},
+            key=jax.random.PRNGKey(2),
+            num_warmup=200,
+            num_samples=400,
+            num_chains=2,
+            checkpoint_every=100,
+            checkpoint_path=str(tmp_path / "acc.npz"),
+        )
+        xs = np.asarray(res.samples["x"]).reshape(-1, 2)
+        np.testing.assert_allclose(xs.mean(0), 0.0, atol=0.15)
+        np.testing.assert_allclose(xs.std(0), 1.0, atol=0.2)
