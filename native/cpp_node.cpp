@@ -1,0 +1,352 @@
+// Standalone C++ federated worker node.
+//
+// Proves the framework's cross-language federation boundary: the
+// reference's README states the node "model implementation could be
+// C++, while MCMC/optimization run in Python" (reference:
+// README.md:34-35) but ships no native node; this is that node, built
+// on the framework's npwire format (service/npwire.py docstring defines
+// the layout) over a plain TCP length-prefixed transport
+// (service/tcp.py is the Python peer).
+//
+// Protocol, little-endian throughout:
+//   frame:   u32 payload_len, then payload
+//   payload: "NPW1" ver(u8) flags(u8) uuid(16B) n_arrays(u32)
+//            [flags&1: err_len(u32) + utf8]   then per array:
+//            dtype_len(u16) dtype_str ndim(u8) shape(u64*ndim)
+//            data_len(u64) raw bytes
+//
+// Compute contract (stateless, mirrors the linear-model blackbox of the
+// Python demos): inputs [intercept(), slope(), sigma(), x(n), y(n)] as
+// float64; outputs [logp(), dlogp/dintercept(), dlogp/dslope()].
+//
+// Build: make -C native   (-> native/cpp_node)
+// Run:   ./cpp_node <port>
+//
+// Single-threaded accept loop; connections served sequentially, each
+// connection handles a stream of evaluate frames (the lock-step
+// request/reply pattern of the reference's bidirectional stream,
+// reference: service.py:150-158).
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cmath>
+#include <csignal>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr char kMagic[4] = {'N', 'P', 'W', '1'};
+constexpr uint8_t kVersion = 1;
+constexpr uint8_t kFlagError = 1;
+
+struct Array {
+  std::string dtype;
+  std::vector<uint64_t> shape;
+  std::vector<uint8_t> data;
+
+  size_t nelem() const {
+    size_t n = 1;
+    for (uint64_t s : shape) n *= static_cast<size_t>(s);
+    return n;
+  }
+};
+
+struct Message {
+  uint8_t uuid[16];
+  std::string error;  // empty = no error
+  std::vector<Array> arrays;
+};
+
+// ---- low-level IO -------------------------------------------------------
+
+bool read_exact(int fd, void* buf, size_t n) {
+  auto* p = static_cast<uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::read(fd, p, n);
+    if (r == 0) return false;  // clean EOF
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_exact(int fd, const void* buf, size_t n) {
+  auto* p = static_cast<const uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::write(fd, p, n);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+// ---- npwire codec -------------------------------------------------------
+
+class Reader {
+ public:
+  Reader(const uint8_t* p, size_t n) : p_(p), n_(n) {}
+
+  bool bytes(void* out, size_t k) {
+    if (off_ + k > n_) return false;
+    std::memcpy(out, p_ + off_, k);
+    off_ += k;
+    return true;
+  }
+  template <typename T>
+  bool le(T* out) {  // all wire ints are little-endian; assume LE host
+    return bytes(out, sizeof(T));
+  }
+  const uint8_t* cursor() const { return p_ + off_; }
+  bool skip(size_t k) {
+    if (off_ + k > n_) return false;
+    off_ += k;
+    return true;
+  }
+
+ private:
+  const uint8_t* p_;
+  size_t n_;
+  size_t off_ = 0;
+};
+
+bool decode(const std::vector<uint8_t>& buf, Message* msg, std::string* why) {
+  Reader r(buf.data(), buf.size());
+  char magic[4];
+  uint8_t ver = 0, flags = 0;
+  uint32_t n_arrays = 0;
+  if (!r.bytes(magic, 4) || std::memcmp(magic, kMagic, 4) != 0) {
+    *why = "bad magic";
+    return false;
+  }
+  if (!r.le(&ver) || ver != kVersion) {
+    *why = "unsupported version";
+    return false;
+  }
+  if (!r.le(&flags) || !r.bytes(msg->uuid, 16) || !r.le(&n_arrays)) {
+    *why = "truncated header";
+    return false;
+  }
+  if (flags & kFlagError) {
+    uint32_t elen = 0;
+    if (!r.le(&elen)) {
+      *why = "truncated error block";
+      return false;
+    }
+    msg->error.assign(reinterpret_cast<const char*>(r.cursor()), elen);
+    if (!r.skip(elen)) {
+      *why = "truncated error block";
+      return false;
+    }
+  }
+  msg->arrays.resize(n_arrays);
+  for (auto& a : msg->arrays) {
+    uint16_t dtlen = 0;
+    uint8_t ndim = 0;
+    uint64_t dlen = 0;
+    if (!r.le(&dtlen)) {
+      *why = "truncated dtype";
+      return false;
+    }
+    a.dtype.assign(reinterpret_cast<const char*>(r.cursor()), dtlen);
+    if (!r.skip(dtlen) || !r.le(&ndim)) {
+      *why = "truncated dtype/ndim";
+      return false;
+    }
+    a.shape.resize(ndim);
+    for (auto& s : a.shape)
+      if (!r.le(&s)) {
+        *why = "truncated shape";
+        return false;
+      }
+    if (!r.le(&dlen)) {
+      *why = "truncated data length";
+      return false;
+    }
+    a.data.resize(static_cast<size_t>(dlen));
+    if (!r.bytes(a.data.data(), a.data.size())) {
+      *why = "truncated data";
+      return false;
+    }
+  }
+  return true;
+}
+
+void put(std::vector<uint8_t>* out, const void* p, size_t n) {
+  const auto* b = static_cast<const uint8_t*>(p);
+  out->insert(out->end(), b, b + n);
+}
+template <typename T>
+void put_le(std::vector<uint8_t>* out, T v) {
+  put(out, &v, sizeof(T));
+}
+
+std::vector<uint8_t> encode(const Message& msg) {
+  std::vector<uint8_t> out;
+  put(&out, kMagic, 4);
+  put_le<uint8_t>(&out, kVersion);
+  put_le<uint8_t>(&out, msg.error.empty() ? 0 : kFlagError);
+  put(&out, msg.uuid, 16);
+  put_le<uint32_t>(&out, static_cast<uint32_t>(msg.arrays.size()));
+  if (!msg.error.empty()) {
+    put_le<uint32_t>(&out, static_cast<uint32_t>(msg.error.size()));
+    put(&out, msg.error.data(), msg.error.size());
+  }
+  for (const auto& a : msg.arrays) {
+    put_le<uint16_t>(&out, static_cast<uint16_t>(a.dtype.size()));
+    put(&out, a.dtype.data(), a.dtype.size());
+    put_le<uint8_t>(&out, static_cast<uint8_t>(a.shape.size()));
+    for (uint64_t s : a.shape) put_le<uint64_t>(&out, s);
+    put_le<uint64_t>(&out, static_cast<uint64_t>(a.data.size()));
+    put(&out, a.data.data(), a.data.size());
+  }
+  return out;
+}
+
+Array scalar_f8(double v) {
+  Array a;
+  a.dtype = "<f8";
+  a.data.resize(8);
+  std::memcpy(a.data.data(), &v, 8);
+  return a;
+}
+
+// ---- the model: Gaussian linear-regression logp + grad ------------------
+
+bool is_f8(const Array& a) { return a.dtype == "<f8" || a.dtype == "float64"; }
+
+const double* f8(const Array& a) {
+  return reinterpret_cast<const double*>(a.data.data());
+}
+
+Message compute(const Message& in) {
+  Message out;
+  std::memcpy(out.uuid, in.uuid, 16);
+  if (in.arrays.size() != 5) {
+    out.error = "expected 5 inputs: intercept, slope, sigma, x, y";
+    return out;
+  }
+  for (const auto& a : in.arrays)
+    if (!is_f8(a)) {
+      out.error = "all inputs must be float64 (<f8), got " + a.dtype;
+      return out;
+    }
+  const Array &ai = in.arrays[0], &as = in.arrays[1], &asig = in.arrays[2],
+              &ax = in.arrays[3], &ay = in.arrays[4];
+  if (ai.nelem() != 1 || as.nelem() != 1 || asig.nelem() != 1) {
+    out.error = "intercept/slope/sigma must be scalars";
+    return out;
+  }
+  if (ax.nelem() != ay.nelem()) {
+    out.error = "x and y must have equal length";
+    return out;
+  }
+  const double a = f8(ai)[0], b = f8(as)[0], sigma = f8(asig)[0];
+  const double* x = f8(ax);
+  const double* y = f8(ay);
+  const size_t n = ax.nelem();
+  if (sigma <= 0.0) {
+    out.error = "sigma must be positive";
+    return out;
+  }
+  const double inv_var = 1.0 / (sigma * sigma);
+  const double log_norm = -std::log(sigma) - 0.5 * std::log(2.0 * M_PI);
+  double logp = 0.0, g_a = 0.0, g_b = 0.0;
+  for (size_t i = 0; i < n; ++i) {
+    const double resid = y[i] - (a + b * x[i]);
+    logp += -0.5 * resid * resid * inv_var + log_norm;
+    const double w = resid * inv_var;
+    g_a += w;
+    g_b += w * x[i];
+  }
+  out.arrays.push_back(scalar_f8(logp));
+  out.arrays.push_back(scalar_f8(g_a));
+  out.arrays.push_back(scalar_f8(g_b));
+  return out;
+}
+
+// ---- server loop --------------------------------------------------------
+
+void serve_connection(int fd) {
+  for (;;) {
+    uint32_t len = 0;
+    if (!read_exact(fd, &len, 4)) return;  // peer closed
+    std::vector<uint8_t> buf(len);
+    if (!read_exact(fd, buf.data(), len)) return;
+    Message in, reply;
+    std::string why;
+    if (decode(buf, &in, &why)) {
+      reply = compute(in);
+    } else {
+      std::memset(reply.uuid, 0, 16);
+      reply.error = "decode failed: " + why;
+    }
+    std::vector<uint8_t> payload = encode(reply);
+    uint32_t plen = static_cast<uint32_t>(payload.size());
+    if (!write_exact(fd, &plen, 4) ||
+        !write_exact(fd, payload.data(), payload.size()))
+      return;
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc != 2) {
+    std::fprintf(stderr, "usage: %s <port>\n", argv[0]);
+    return 2;
+  }
+  const int port = std::atoi(argv[1]);
+  ::signal(SIGPIPE, SIG_IGN);
+
+  int srv = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (srv < 0) {
+    std::perror("socket");
+    return 1;
+  }
+  int one = 1;
+  ::setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(srv, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+    std::perror("bind");
+    return 1;
+  }
+  if (::listen(srv, 16) < 0) {
+    std::perror("listen");
+    return 1;
+  }
+  // Readiness line on stdout — the Python test waits for it.
+  std::printf("cpp_node listening on 127.0.0.1:%d\n", port);
+  std::fflush(stdout);
+
+  for (;;) {
+    int fd = ::accept(srv, nullptr, nullptr);
+    if (fd < 0) {
+      if (errno == EINTR) continue;
+      std::perror("accept");
+      return 1;
+    }
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    serve_connection(fd);
+    ::close(fd);
+  }
+}

@@ -12,17 +12,21 @@ adaptation results), so this module provides:
   checkpoint).
 - :func:`sample_checkpointed` — the chunked, resumable front door:
   warmup runs once, then sampling proceeds in chunks of
-  ``checkpoint_every`` draws, persisting (kernel state, RNG position,
-  draws-so-far, adaptation results) after every chunk.  Killing the
-  process at any point and calling the same function again resumes from
-  the last chunk boundary and produces **bit-identical draws** to an
-  uninterrupted run (chunk keys are ``fold_in(key, chunk_index)``, so
-  the stream does not depend on where the interruption happened).
+  ``checkpoint_every`` draws.  After every chunk the small kernel state
+  is re-persisted and that chunk's draws are written to their own file
+  (``<path>.chunk0000.npz``, ...) — total I/O is O(total draws), not
+  O(chunks x total draws).  Killing the process at any point and
+  calling the same function again resumes after the last completed
+  chunk and produces **bit-identical draws** to an uninterrupted run
+  (chunk keys are ``fold_in(key, chunk_index)``, so the stream does not
+  depend on where the interruption happened).  A checkpoint whose
+  recorded config (including the RNG key and kernel settings) does not
+  match the call is ignored and sampling restarts fresh.
 
 Orbax is the right tool for multi-host sharded checkpoints of huge
-states; for the sampler-state scale (KBs-MBs, single host) a plain
-npz keeps zero non-baked dependencies.  The layout is
-orbax-compatible in spirit: one directory per run, one file per step.
+states; for the sampler-state scale (KBs-MBs, single host) a plain npz
+keeps zero non-baked dependencies, with the same one-file-per-step
+layout in spirit.
 """
 
 from __future__ import annotations
@@ -65,20 +69,32 @@ def save_pytree(path: str, tree: Any, metadata: Optional[dict] = None) -> None:
 def load_pytree(path: str, like: Any) -> Tuple[Any, dict]:
     """Load a :func:`save_pytree` snapshot into the structure of ``like``.
 
-    Returns ``(tree, metadata)``.  Leaf count must match ``like``;
-    dtypes/shapes come from the file.
+    Returns ``(tree, metadata)``.  Raises ``ValueError`` on leaf-count
+    mismatch in either direction (structure mismatch); dtypes/shapes
+    come from the file.
     """
     with np.load(path) as data:
         metadata = json.loads(bytes(data[_META_KEY].tobytes()).decode())
         leaves, treedef = jax.tree_util.tree_flatten(like)
         n = len(leaves)
-        stored = [data[f"leaf_{i}"] for i in range(n)]
-        if f"leaf_{n}" in data.files:
+        n_stored = sum(1 for f in data.files if f.startswith("leaf_"))
+        if n_stored != n:
             raise ValueError(
-                f"checkpoint {path} has more leaves than `like` "
+                f"checkpoint {path} has {n_stored} leaves, `like` has {n} "
                 f"(structure mismatch)"
             )
+        stored = [data[f"leaf_{i}"] for i in range(n)]
     return jax.tree_util.tree_unflatten(treedef, stored), metadata
+
+
+def _chunk_path(checkpoint_path: str, i: int) -> str:
+    return f"{checkpoint_path}.chunk{i:04d}.npz"
+
+
+def _key_fingerprint(key: jax.Array) -> list:
+    """JSON-serializable identity of a PRNG key (part of the resume
+    config: resuming under a different key must restart, not stitch)."""
+    return np.asarray(jax.random.key_data(key)).ravel().tolist()
 
 
 def sample_checkpointed(
@@ -93,6 +109,7 @@ def sample_checkpointed(
     checkpoint_every: int = 100,
     kernel: str = "nuts",
     max_depth: int = 8,
+    num_hmc_steps: int = 16,
     target_accept: float = 0.8,
     jitter: float = 1.0,
     logp_and_grad_fn: Optional[Callable] = None,
@@ -100,81 +117,82 @@ def sample_checkpointed(
     """Resumable NUTS/HMC sampling with periodic on-disk checkpoints.
 
     Same posterior contract as :func:`~pytensor_federated_tpu.samplers.sample`
-    but the draw loop is chunked: after every ``checkpoint_every`` draws
-    the full sampler state is persisted to ``checkpoint_path``.  If that
-    file already exists (and its config hash matches), sampling resumes
-    after the last completed chunk instead of starting over.  The
-    resulting draws are bit-identical to an uninterrupted run.
+    (gradient kernels only — "nuts"/"hmc") but the draw loop is chunked:
+    after every ``checkpoint_every`` draws the kernel state is persisted
+    to ``checkpoint_path`` and the chunk's draws to a per-chunk sidecar
+    file.  If a matching checkpoint exists, sampling resumes after the
+    last completed chunk; the result is bit-identical to an
+    uninterrupted run.
 
     Returns a :class:`~pytensor_federated_tpu.samplers.mcmc.SampleResult`.
     """
-    from functools import partial
+    from .samplers.hmc import HMCState
+    from .samplers.mcmc import (
+        SampleResult,
+        _warmup,
+        make_flat_logp_and_grad,
+        make_kernel_step,
+    )
 
-    from .samplers.hmc import HMCState, hmc_step
-    from .samplers.mcmc import SampleResult, _warmup
-    from .samplers.nuts import nuts_step
-    from .samplers.util import flatten_logp
-
-    flat_logp, flat_init, unravel = flatten_logp(logp_fn, init_params)
+    _, flat_init, unravel, lg = make_flat_logp_and_grad(
+        logp_fn, init_params, logp_and_grad_fn
+    )
     dtype = flat_init.dtype
     dim = flat_init.shape[0]
-
-    if logp_and_grad_fn is not None:
-        from jax.flatten_util import ravel_pytree
-
-        def lg(x):
-            v, g = logp_and_grad_fn(unravel(x))
-            return v, ravel_pytree(g)[0]
-
-    else:
-
-        def lg(x):
-            return jax.value_and_grad(flat_logp)(x)
-
-    if kernel == "nuts":
-        kernel_step = partial(nuts_step, lg, max_depth=max_depth)
-    elif kernel == "hmc":
-        kernel_step = partial(hmc_step, lg, num_steps=16)
-    else:
-        raise ValueError(f"unknown kernel {kernel!r} (nuts or hmc)")
+    kernel_step = make_kernel_step(
+        lg, kernel, max_depth=max_depth, num_hmc_steps=num_hmc_steps
+    )
+    if kernel not in ("nuts", "hmc"):  # pragma: no cover (make_kernel_step raises)
+        raise ValueError(kernel)
 
     n_chunks = -(-num_samples // checkpoint_every)  # ceil
     config = {
+        "key": _key_fingerprint(key),
         "num_warmup": num_warmup,
         "num_samples": num_samples,
         "num_chains": num_chains,
         "checkpoint_every": checkpoint_every,
         "kernel": kernel,
+        "max_depth": max_depth,
+        "num_hmc_steps": num_hmc_steps,
+        "target_accept": target_accept,
+        "jitter": jitter,
         "dim": dim,
     }
 
     k_jit, k_warm, k_base = jax.random.split(key, 3)
 
-    # ---- state template (for load_pytree structure) ----
-    def template():
+    def state_template():
         return {
             "x": jnp.zeros((num_chains, dim), dtype),
             "logp": jnp.zeros((num_chains,), dtype),
             "grad": jnp.zeros((num_chains, dim), dtype),
             "step_size": jnp.zeros((num_chains,), dtype),
             "inv_mass": jnp.zeros((num_chains, dim), dtype),
-            "draws": jnp.zeros(
-                (num_chains, n_chunks * checkpoint_every, dim), dtype
-            ),
-            "accept_prob": jnp.zeros(
-                (num_chains, n_chunks * checkpoint_every), dtype
-            ),
-            "diverging": jnp.zeros(
-                (num_chains, n_chunks * checkpoint_every), bool
-            ),
         }
 
+    def chunk_template():
+        return {
+            "draws": jnp.zeros((num_chains, checkpoint_every, dim), dtype),
+            "accept_prob": jnp.zeros((num_chains, checkpoint_every), dtype),
+            "diverging": jnp.zeros((num_chains, checkpoint_every), bool),
+        }
+
+    # ---- resume or fresh start ----
     resumed = None
     if os.path.exists(checkpoint_path):
-        state, meta = load_pytree(checkpoint_path, template())
-        if meta.get("config") == config:
-            resumed = (state, int(meta["chunks_done"]))
-        # Config mismatch: ignore the stale file and start fresh.
+        try:
+            state, meta = load_pytree(checkpoint_path, state_template())
+            if meta.get("config") == config:
+                chunks_done = int(meta["chunks_done"])
+                chunks = [
+                    load_pytree(_chunk_path(checkpoint_path, i), chunk_template())[0]
+                    for i in range(chunks_done)
+                ]
+                resumed = (state, chunks_done, chunks)
+        except (ValueError, KeyError, OSError):
+            # Stale/foreign/partial checkpoint: restart fresh.
+            resumed = None
 
     if resumed is None:
         init_flat = jnp.broadcast_to(flat_init, (num_chains, dim))
@@ -182,7 +200,6 @@ def sample_checkpointed(
             init_flat = init_flat + jitter * jax.random.normal(
                 k_jit, init_flat.shape, dtype
             )
-
         warm = jax.jit(
             jax.vmap(
                 lambda x0, k: _warmup(
@@ -195,20 +212,19 @@ def sample_checkpointed(
                 )
             )
         )(init_flat, jax.random.split(k_warm, num_chains))
-        state = template()
-        state["x"] = warm.state.x
-        state["logp"] = warm.state.logp
-        state["grad"] = warm.state.grad
-        state["step_size"] = warm.step_size
-        state["inv_mass"] = warm.inv_mass
-        chunks_done = 0
+        state = {
+            "x": warm.state.x,
+            "logp": warm.state.logp,
+            "grad": warm.state.grad,
+            "step_size": warm.step_size,
+            "inv_mass": warm.inv_mass,
+        }
+        chunks_done, chunks = 0, []
         save_pytree(
-            checkpoint_path,
-            state,
-            {"config": config, "chunks_done": 0},
+            checkpoint_path, state, {"config": config, "chunks_done": 0}
         )
     else:
-        state, chunks_done = resumed
+        state, chunks_done, chunks = resumed
 
     @jax.jit
     def run_chunk(state, chunk_idx):
@@ -225,43 +241,41 @@ def sample_checkpointed(
             return jax.lax.scan(body, hmc, keys)
 
         chunk_key = jax.random.fold_in(k_base, chunk_idx)
-        keys = jax.random.split(
-            chunk_key, (num_chains, checkpoint_every)
-        )
+        keys = jax.random.split(chunk_key, (num_chains, checkpoint_every))
         hmc = HMCState(state["x"], state["logp"], state["grad"])
         hmc, (xs, aps, divs) = jax.vmap(one_chain)(
             hmc, state["step_size"], state["inv_mass"], keys
         )
-        lo = chunk_idx * checkpoint_every
-        out = dict(state)
-        out["x"], out["logp"], out["grad"] = hmc.x, hmc.logp, hmc.grad
-        # xs: (chains, chunk, dim) — scan gives (chunk, dim), vmap prepends chains.
-        out["draws"] = jax.lax.dynamic_update_slice(
-            state["draws"], xs, (0, lo, 0)
+        new_state = dict(state)
+        new_state["x"], new_state["logp"], new_state["grad"] = (
+            hmc.x,
+            hmc.logp,
+            hmc.grad,
         )
-        out["accept_prob"] = jax.lax.dynamic_update_slice(
-            state["accept_prob"], aps, (0, lo)
-        )
-        out["diverging"] = jax.lax.dynamic_update_slice(
-            state["diverging"], divs, (0, lo)
-        )
-        return out
+        # xs: (chains, chunk, dim) — scan yields (chunk, ...), vmap prepends.
+        return new_state, {"draws": xs, "accept_prob": aps, "diverging": divs}
 
-    for chunk in range(chunks_done, n_chunks):
-        state = jax.device_get(run_chunk(state, chunk))
+    for i in range(chunks_done, n_chunks):
+        state, chunk = jax.device_get(run_chunk(state, i))
+        save_pytree(_chunk_path(checkpoint_path, i), chunk)
         save_pytree(
-            checkpoint_path,
-            state,
-            {"config": config, "chunks_done": chunk + 1},
+            checkpoint_path, state, {"config": config, "chunks_done": i + 1}
         )
+        chunks.append(chunk)
 
-    draws = jnp.asarray(state["draws"])[:, :num_samples]
+    draws = jnp.concatenate([c["draws"] for c in chunks], axis=1)[
+        :, :num_samples
+    ]
     samples = jax.vmap(jax.vmap(unravel))(draws)
     return SampleResult(
         samples=samples,
         stats={
-            "accept_prob": jnp.asarray(state["accept_prob"])[:, :num_samples],
-            "diverging": jnp.asarray(state["diverging"])[:, :num_samples],
+            "accept_prob": jnp.concatenate(
+                [c["accept_prob"] for c in chunks], axis=1
+            )[:, :num_samples],
+            "diverging": jnp.concatenate(
+                [c["diverging"] for c in chunks], axis=1
+            )[:, :num_samples],
         },
         step_size=jnp.asarray(state["step_size"]),
         inv_mass=jnp.asarray(state["inv_mass"]),

@@ -40,6 +40,46 @@ class WarmupResult(NamedTuple):
     inv_mass: jax.Array
 
 
+def make_flat_logp_and_grad(
+    logp_fn: Callable[[Any], jax.Array],
+    init_params: Any,
+    logp_and_grad_fn: Optional[Callable] = None,
+):
+    """Flatten the target and build its fused value+grad over the flat
+    vector — shared by :func:`sample` and ``checkpoint.sample_checkpointed``.
+
+    Returns ``(flat_logp, flat_init, unravel, lg)`` where ``lg(x) ->
+    (logp, grad)``; with ``logp_and_grad_fn`` the gradient is the
+    forward-supplied one (the federated node contract), else autodiff.
+    """
+    flat_logp, flat_init, unravel = flatten_logp(logp_fn, init_params)
+
+    if logp_and_grad_fn is not None:
+        from jax.flatten_util import ravel_pytree
+
+        def lg(x):
+            v, g = logp_and_grad_fn(unravel(x))
+            return v, ravel_pytree(g)[0]
+
+    else:
+
+        def lg(x):
+            return jax.value_and_grad(flat_logp)(x)
+
+    return flat_logp, flat_init, unravel, lg
+
+
+def make_kernel_step(
+    lg: Callable, kernel: str, *, max_depth: int = 8, num_hmc_steps: int = 16
+):
+    """Gradient-based transition kernel by name ("nuts" or "hmc")."""
+    if kernel == "nuts":
+        return partial(nuts_step, lg, max_depth=max_depth)
+    if kernel == "hmc":
+        return partial(hmc_step, lg, num_steps=num_hmc_steps)
+    raise ValueError(f"unknown kernel {kernel!r}")
+
+
 def _warmup(
     logp_and_grad,
     x0,
@@ -134,20 +174,10 @@ def sample(
     Everything (warmup + sampling, all chains) runs in one jitted
     program; chains are a vmap axis.
     """
-    flat_logp, flat_init, unravel = flatten_logp(logp_fn, init_params)
+    flat_logp, flat_init, unravel, lg = make_flat_logp_and_grad(
+        logp_fn, init_params, logp_and_grad_fn
+    )
     dtype = flat_init.dtype
-
-    if logp_and_grad_fn is not None:
-        from jax.flatten_util import ravel_pytree
-
-        def lg(x):
-            v, g = logp_and_grad_fn(unravel(x))
-            return v, ravel_pytree(g)[0]
-
-    else:
-
-        def lg(x):
-            return jax.value_and_grad(flat_logp)(x)
 
     k_jit, k_run = jax.random.split(key)
     init_flat = jnp.broadcast_to(flat_init, (num_chains,) + flat_init.shape)
@@ -161,12 +191,9 @@ def sample(
             flat_logp, unravel, init_flat, k_run, num_warmup, num_samples
         )
 
-    if kernel == "nuts":
-        kernel_step = partial(nuts_step, lg, max_depth=max_depth)
-    elif kernel == "hmc":
-        kernel_step = partial(hmc_step, lg, num_steps=num_hmc_steps)
-    else:
-        raise ValueError(f"unknown kernel {kernel!r}")
+    kernel_step = make_kernel_step(
+        lg, kernel, max_depth=max_depth, num_hmc_steps=num_hmc_steps
+    )
 
     def one_chain(x0, key):
         k_warm, k_samp = jax.random.split(key)

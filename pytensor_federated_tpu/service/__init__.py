@@ -9,6 +9,7 @@ from .client import (
 )
 from .clients import LogpGradServiceClient, LogpServiceClient
 from .npwire import WireError, decode_arrays, encode_arrays
+from .tcp import RemoteComputeError, TcpArraysClient, serve_tcp_once
 from .server import (
     ArraysToArraysService,
     device_compute_fn,
@@ -26,9 +27,12 @@ __all__ = [
     "decode_arrays",
     "device_compute_fn",
     "encode_arrays",
+    "RemoteComputeError",
+    "TcpArraysClient",
     "get_load_async",
     "get_loads_async",
     "run_node",
     "serve",
+    "serve_tcp_once",
     "thread_pid_id",
 ]

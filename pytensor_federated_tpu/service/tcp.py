@@ -1,0 +1,159 @@
+"""Plain-TCP transport for the npwire format — the cross-language lane.
+
+The gRPC service (:mod:`.server`/:mod:`.client`) is the batteries-
+included host-federation transport; this module is the *minimal* one: a
+u32-length-prefixed npwire frame over a TCP socket.  Its purpose is the
+capability the reference only gestures at — "the model implementation
+could be C++" (reference: README.md:34-35): ``native/cpp_node.cpp``
+implements this exact protocol with zero Python, and
+:class:`TcpArraysClient` drives it from the driver process.
+
+Frame layout: ``u32 little-endian payload length`` + npwire payload
+(see :mod:`.npwire` for the payload layout).  Requests and replies are
+lock-step per connection — the same one-in-flight pattern the reference
+uses on its bidirectional streams (reference: service.py:150-158).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import uuid as uuid_mod
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from .npwire import decode_arrays, encode_arrays
+
+__all__ = ["TcpArraysClient", "serve_tcp_once", "RemoteComputeError"]
+
+
+class RemoteComputeError(RuntimeError):
+    """The remote node replied with an error payload."""
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n > 0:
+        b = sock.recv(n)
+        if not b:
+            raise ConnectionError("peer closed mid-frame")
+        chunks.append(b)
+        n -= len(b)
+    return b"".join(chunks)
+
+
+def _send_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(struct.pack("<I", len(payload)) + payload)
+
+
+def _recv_frame(sock: socket.socket) -> bytes:
+    (n,) = struct.unpack("<I", _recv_exact(sock, 4))
+    return _recv_exact(sock, n)
+
+
+class TcpArraysClient:
+    """Arrays-in → arrays-out over one persistent TCP connection.
+
+    API parity with :class:`.client.ArraysToArraysServiceClient`'s sync
+    surface: ``evaluate(*arrays) -> [arrays]`` with uuid correlation
+    checking and lazy (re)connection.  ``retries`` reconnects on a dead
+    socket — the failover analog for a single fixed peer (reference:
+    service.py:408-416 rebalances across a pool; a TCP peer is pinned).
+    """
+
+    def __init__(self, host: str, port: int, *, retries: int = 2):
+        self.host = host
+        self.port = int(port)
+        self.retries = retries
+        self._sock: Optional[socket.socket] = None
+
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            s = socket.create_connection((self.host, self.port), timeout=30.0)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._sock = s
+        return self._sock
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+    def __del__(self):  # best-effort, mirrors client.py teardown
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def evaluate(self, *arrays: np.ndarray) -> List[np.ndarray]:
+        uid = uuid_mod.uuid4().bytes
+        request = encode_arrays([np.asarray(a) for a in arrays], uuid=uid)
+        last_err: Optional[Exception] = None
+        for _ in range(self.retries + 1):
+            try:
+                sock = self._connect()
+                _send_frame(sock, request)
+                reply = _recv_frame(sock)
+                break
+            except (ConnectionError, OSError) as e:
+                last_err = e
+                self.close()
+        else:
+            raise ConnectionError(
+                f"node {self.host}:{self.port} unreachable after "
+                f"{self.retries + 1} attempts"
+            ) from last_err
+        outputs, reply_uid, error = decode_arrays(reply)
+        if error is not None:
+            raise RemoteComputeError(error)
+        if reply_uid != uid:
+            raise RuntimeError("uuid mismatch: reply does not match request")
+        return outputs
+
+    __call__ = evaluate
+
+
+def serve_tcp_once(
+    compute_fn: Callable[..., Sequence[np.ndarray]],
+    host: str = "127.0.0.1",
+    port: int = 0,
+    *,
+    ready_callback: Optional[Callable[[int], None]] = None,
+    max_connections: Optional[int] = None,
+) -> None:
+    """Blocking pure-Python server for the same protocol.
+
+    The in-language peer of ``native/cpp_node.cpp`` — used to test the
+    client without a compiler, and as a template for third-language
+    nodes.  Serves connections sequentially; each connection processes
+    lock-step frames until the peer disconnects.  ``port=0`` binds an
+    ephemeral port reported through ``ready_callback``.
+    ``max_connections`` bounds the accept loop (None = forever).
+    """
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as srv:
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((host, port))
+        srv.listen(16)
+        if ready_callback is not None:
+            ready_callback(srv.getsockname()[1])
+        served = 0
+        while max_connections is None or served < max_connections:
+            conn, _ = srv.accept()
+            served += 1
+            with conn:
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                while True:
+                    try:
+                        payload = _recv_frame(conn)
+                    except (ConnectionError, OSError):
+                        break
+                    arrays, uid, _ = decode_arrays(payload)
+                    try:
+                        outputs = [np.asarray(o) for o in compute_fn(*arrays)]
+                        reply = encode_arrays(outputs, uuid=uid)
+                    except Exception as e:  # error -> error payload
+                        reply = encode_arrays([], uuid=uid, error=str(e))
+                    _send_frame(conn, reply)

@@ -140,6 +140,24 @@ class TestSampleCheckpointed:
         )
         assert res.samples["x"].shape == (2, 30, 2)
 
+    def test_different_key_restarts(self, tmp_path):
+        """Resuming under a different RNG key must NOT stitch runs."""
+        p = str(tmp_path / "run.npz")
+        init = {"x": jnp.zeros(2)}
+        kw = dict(
+            num_warmup=50,
+            num_samples=20,
+            num_chains=2,
+            checkpoint_every=10,
+            checkpoint_path=p,
+        )
+        r1 = sample_checkpointed(_logp, init, key=jax.random.PRNGKey(0), **kw)
+        r2 = sample_checkpointed(_logp, init, key=jax.random.PRNGKey(1), **kw)
+        # Different keys -> fully re-run -> different draws.
+        assert not np.array_equal(
+            np.asarray(r1.samples["x"]), np.asarray(r2.samples["x"])
+        )
+
     def test_posterior_accuracy(self, tmp_path):
         """Std-normal target: moments correct through the chunked path."""
         res = sample_checkpointed(
