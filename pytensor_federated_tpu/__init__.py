@@ -39,7 +39,9 @@ from .parallel import (
     sharded_compute,
     single_device_mesh,
 )
+from . import diagnostics
 from .checkpoint import load_pytree, sample_checkpointed, save_pytree
+from .diagnostics import instrument_logp, profile_trace
 from .signatures import ArraysSpec, ComputeFn, LogpFn, LogpGradFn, spec_of
 from .version import __version__
 from .wrappers import logp_grad_from_logp, wrap_logp_fn, wrap_logp_grad_fn
@@ -64,15 +66,18 @@ __all__ = [
     "__version__",
     "blackbox_compute",
     "blackbox_logp_grad",
+    "diagnostics",
     "from_logp_fn",
     "fuse",
     "get_load",
     "healthy_devices",
+    "instrument_logp",
     "load_pytree",
     "logp_grad_from_logp",
     "make_mesh",
     "pack_shards",
     "parallel_host_call",
+    "profile_trace",
     "sample_checkpointed",
     "save_pytree",
     "sharded_compute",
