@@ -1,14 +1,18 @@
 """Model families covering the BASELINE.json benchmark configs."""
 
 from .glm import HierarchicalRadonGLM, generate_radon_data
+from .gp import FederatedSparseGP, dense_vfe_logp, generate_gp_data
 from .linear import FederatedLinearRegression, generate_node_data
 from .logistic import FederatedLogisticRegression, generate_logistic_data
 from .ode import LotkaVolterraModel, generate_lv_data, make_lv_model, rk4_integrate
 from .timeseries import SeqShardedAR1, generate_ar1_data
 
 __all__ = [
+    "FederatedSparseGP",
     "SeqShardedAR1",
+    "dense_vfe_logp",
     "generate_ar1_data",
+    "generate_gp_data",
     "FederatedLinearRegression",
     "FederatedLogisticRegression",
     "HierarchicalRadonGLM",
