@@ -102,7 +102,7 @@ class FederatedSparseGP:
 
     The per-shard statistic computation is one ``(M, n_i) @ (n_i, M)``
     matmul per shard — large, batched, MXU-shaped — and the only
-    cross-shard communication is the psum of ``(M², M, 4)`` scalars per
+    cross-shard communication is the psum of ``M² + M + 3`` scalars per
     evaluation, independent of the number of observations.
     """
 

@@ -58,6 +58,27 @@ class TestEquivalence:
                 float(g_s[k]), float(g_l[k]), rtol=1e-3, atol=1e-4
             )
 
+    def test_ragged_shards_match_dense(self):
+        """Unequal shard sizes: the mask/padding path must reproduce the
+        dense bound exactly (the federation-specific subtlety)."""
+        rng = np.random.default_rng(11)
+        n = 128
+        x = rng.uniform(-2, 2, size=n).astype(np.float32)
+        y = np.sin(2 * x).astype(np.float32) + 0.1 * rng.normal(size=n).astype(
+            np.float32
+        )
+        splits = np.split(np.arange(n), [40, 80, 110])  # 40/40/30/18
+        from pytensor_federated_tpu.parallel import pack_shards
+
+        packed = pack_shards([(x[s], y[s]) for s in splits])
+        assert packed.mask.sum() == n and (packed.mask == 0).any()
+        inducing = np.linspace(-2, 2, 12).astype(np.float32)
+        model = FederatedSparseGP(packed, inducing)
+        p = params_at(0.2, -0.4, -1.5)
+        got = float(model.logp(p))
+        want = float(dense_vfe_logp(p, x, y, inducing))
+        np.testing.assert_allclose(got, want, rtol=5e-4)
+
     def test_gradients_match_dense(self, gp_data):
         packed, dense, inducing = gp_data
         model = FederatedSparseGP(packed, inducing)
