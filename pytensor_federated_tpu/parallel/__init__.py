@@ -10,6 +10,11 @@ from .mesh import (
     make_mesh,
     single_device_mesh,
 )
+from .multihost import (
+    initialize_multihost,
+    make_multihost_mesh,
+    remesh_after_failure,
+)
 from .packing import ShardedData, pack_shards
 from .ring import (
     ring_all_pairs_sum,
@@ -34,7 +39,10 @@ __all__ = [
     "shift_right_across_shards",
     "get_load",
     "healthy_devices",
+    "initialize_multihost",
     "make_mesh",
+    "make_multihost_mesh",
+    "remesh_after_failure",
     "pack_shards",
     "sharded_compute",
     "single_device_mesh",

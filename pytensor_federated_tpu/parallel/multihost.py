@@ -1,0 +1,158 @@
+"""Multi-host (DCN) scaling and elastic mesh recovery.
+
+The reference scales by adding worker processes and recovers from node
+death by client-side failover to surviving servers (reference:
+demo_node.py:98-108 pool; service.py:408-416 retry+rebalance).  The
+TPU-native equivalents:
+
+- **Scale-out**: one process per host, joined into a single logical
+  device set via ``jax.distributed`` — collectives ride ICI inside a
+  slice and DCN across hosts.  :func:`initialize_multihost` wraps the
+  init; :func:`make_multihost_mesh` lays out a mesh whose *outer* axis
+  spans hosts (DCN-friendly: only the reduction crosses DCN, exactly
+  like the reference's sum of per-node replies crossing the network)
+  while inner axes stay within a slice on ICI.
+- **Elastic recovery**: the reference's per-call failover becomes mesh
+  reconstruction — drop dead devices, rebuild the mesh at the largest
+  size the surviving devices support, re-place the data, re-jit
+  (SURVEY §7 step 5).  :func:`remesh_after_failure` implements the
+  policy; re-placement is just constructing a new evaluator (host
+  copies of shard data are the recovery source, like the reference's
+  stateless nodes re-serving their static private data).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Mapping, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from .mesh import SHARDS_AXIS, healthy_devices, make_mesh
+
+_log = logging.getLogger("pytensor_federated_tpu")
+
+
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    *,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> int:
+    """Join this process into the multi-host runtime; returns process count.
+
+    With no arguments, ``jax.distributed.initialize()`` auto-detects the
+    cluster environment (TPU pod metadata / SLURM / coordinator env
+    vars); if there is no cluster to join — or the launcher already
+    initialized the runtime, or JAX is already in use single-host — the
+    failure is swallowed and the current process count is returned.
+    With *explicit* arguments a failure re-raises: the caller asked for
+    a specific cluster, and must call this before any other JAX use
+    (``jax.distributed.initialize`` has to run before the XLA backend
+    comes up).  This replaces the reference's manual "start N servers on
+    N ports, point the client at the list" bootstrap (reference:
+    demo_node.py:111-134, demo_model.py:17).
+    """
+    explicit = coordinator_address is not None or num_processes is not None
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except (RuntimeError, ValueError) as e:
+        if explicit:
+            raise
+        _log.debug("multihost auto-init skipped: %s", e)
+    return jax.process_count()
+
+
+def make_multihost_mesh(
+    inner: Optional[Mapping[str, int]] = None,
+    *,
+    host_axis: str = SHARDS_AXIS,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Mesh whose leading ``host_axis`` spans hosts (DCN), inner axes ICI.
+
+    Devices are ordered host-major (``process_index`` first), so
+    positions along ``host_axis`` map to hosts: the psum over
+    ``host_axis`` does one cross-host reduction — the exact traffic
+    pattern of the reference's sum-of-node-replies, but over DCN
+    collectives instead of gRPC.  ``inner`` axes (e.g. ``{"chains": 4}``)
+    subdivide each host's local devices.  On a single host this
+    degrades gracefully to a normal mesh with ``host_axis`` over all
+    local devices (inner axes must then divide the device count).
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    devices = sorted(devices, key=lambda d: (d.process_index, d.id))
+    n_hosts = len({d.process_index for d in devices})
+    inner = dict(inner or {})
+    inner_size = int(np.prod(list(inner.values()))) if inner else 1
+    if len(devices) % inner_size != 0:
+        raise ValueError(
+            f"inner axes {inner} (size {inner_size}) do not divide "
+            f"{len(devices)} devices"
+        )
+    outer = len(devices) // inner_size
+    if n_hosts > 1 and outer % n_hosts != 0:
+        raise ValueError(
+            f"outer axis size {outer} not divisible by {n_hosts} hosts"
+        )
+    shape = {host_axis: outer, **inner}
+    return make_mesh(shape, devices=devices)
+
+
+def remesh_after_failure(
+    mesh: Mesh,
+    *,
+    axis: Optional[str] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Rebuild a mesh over the devices that still respond.
+
+    The TPU failover analog (reference: service.py:408-416 drops the
+    dead connection and rebalances): probe ``mesh``'s devices (or the
+    given candidate list), keep the healthy ones, and rebuild the same
+    axis layout at the largest size they support — the ``axis``
+    dimension shrinks, other axes keep their extent.  Raises if no
+    healthy devices remain (parity with the reference's ``TimeoutError``
+    when every server is dead, reference: service.py:257-260).
+
+    The caller then re-places data and re-jits by constructing a new
+    evaluator over the returned mesh — state lives on the host, so no
+    migration is needed (the reference's nodes are stateless for the
+    same reason).
+    """
+    axis = axis or mesh.axis_names[0]
+    candidates = (
+        list(mesh.devices.flat) if devices is None else list(devices)
+    )
+    alive = healthy_devices(candidates)
+    if not alive:
+        raise TimeoutError("no healthy devices remain")
+    other = {
+        name: size for name, size in mesh.shape.items() if name != axis
+    }
+    other_size = int(np.prod(list(other.values()))) if other else 1
+    new_axis_size = len(alive) // other_size
+    if new_axis_size < 1:
+        raise TimeoutError(
+            f"{len(alive)} healthy devices cannot fill axes {other}"
+        )
+    if new_axis_size < mesh.shape[axis]:
+        _log.warning(
+            "remesh: axis %r shrinking %d -> %d after device failure",
+            axis,
+            mesh.shape[axis],
+            new_axis_size,
+        )
+    # Preserve the original axis ORDER (it encodes the ICI/DCN layout —
+    # make_multihost_mesh puts the host axis first on purpose).
+    shape = {
+        name: (new_axis_size if name == axis else size)
+        for name, size in mesh.shape.items()
+    }
+    return make_mesh(shape, devices=alive)

@@ -1,0 +1,83 @@
+"""Multi-host mesh layout + elastic recovery (parallel/multihost.py).
+
+Single-process tests: multi-host init itself needs a cluster, but the
+layout policy, degradation to one host, and the failover-by-remesh path
+(reference analog: service.py:408-416 retry/rebalance; all-dead ->
+TimeoutError, reference: service.py:257-260) are all testable on the
+virtual CPU mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytensor_federated_tpu.parallel import make_mesh
+from pytensor_federated_tpu.parallel.multihost import (
+    initialize_multihost,
+    make_multihost_mesh,
+    remesh_after_failure,
+)
+
+
+class TestInitialize:
+    def test_single_process_noop(self):
+        assert initialize_multihost() == jax.process_count() == 1
+
+
+class TestMultihostMesh:
+    def test_single_host_degrades(self, devices8):
+        mesh = make_multihost_mesh(devices=devices8)
+        assert mesh.shape == {"shards": 8}
+
+    def test_inner_axes(self, devices8):
+        mesh = make_multihost_mesh({"chains": 2}, devices=devices8)
+        assert mesh.shape == {"shards": 4, "chains": 2}
+        assert mesh.axis_names == ("shards", "chains")
+
+    def test_indivisible_inner_raises(self, devices8):
+        with pytest.raises(ValueError, match="do not divide"):
+            make_multihost_mesh({"chains": 3}, devices=devices8)
+
+
+class TestRemeshAfterFailure:
+    def test_shrinks_to_survivors(self, devices8):
+        mesh = make_mesh({"shards": 8}, devices=devices8)
+        # Simulate 3 dead devices by offering only 5 candidates.
+        new = remesh_after_failure(mesh, devices=devices8[:5])
+        assert new.shape == {"shards": 5}
+
+    def test_preserves_other_axes_and_order(self, devices8):
+        mesh = make_mesh({"chains": 2, "shards": 4}, devices=devices8)
+        new = remesh_after_failure(mesh, axis="shards", devices=devices8[:6])
+        assert new.shape["chains"] == 2
+        assert new.shape["shards"] == 3
+        # Axis order encodes the DCN/ICI layout — must survive recovery.
+        assert new.axis_names == mesh.axis_names
+
+    def test_all_dead_raises(self, devices8):
+        mesh = make_mesh({"shards": 8}, devices=devices8)
+        with pytest.raises(TimeoutError, match="no healthy devices"):
+            remesh_after_failure(mesh, devices=[])
+
+    def test_end_to_end_recovery(self, devices8):
+        """The full failover story: evaluate on 8 devices, 'lose' 4,
+        remesh, rebuild the evaluator from host data, same answer."""
+        from pytensor_federated_tpu.models.linear import (
+            FederatedLinearRegression,
+            generate_node_data,
+        )
+
+        data, _ = generate_node_data(8, n_obs=32, seed=5)
+        mesh8 = make_mesh({"shards": 8}, devices=devices8)
+        model8 = FederatedLinearRegression(data, mesh=mesh8)
+        p = model8.init_params()
+        before = float(model8.logp(p))
+
+        mesh_new = remesh_after_failure(mesh8, devices=devices8[:4])
+        assert mesh_new.shape == {"shards": 4}
+        # Re-place + re-jit from host-resident shard data (nodes are
+        # stateless; 8 shards now live 2-per-device).
+        model4 = FederatedLinearRegression(data, mesh=mesh_new)
+        after = float(model4.logp(p))
+        np.testing.assert_allclose(after, before, rtol=1e-6)
