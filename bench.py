@@ -8,6 +8,12 @@ dependent* evaluations (the way NUTS consumes them: each leapfrog step
 feeds the previous gradient forward), chained inside a ``lax.scan`` with
 zero host round-trips.
 
+Two implementations of the same posterior logp+grad are raced — XLA
+autodiff of the model, and the hand-fused Pallas kernel
+(ops/pallas_kernels.py) — on a short calibration chain; the faster one
+runs the full measurement.  Both are asserted to agree numerically
+before racing.
+
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "evals/s", "vs_baseline": N}
 ``vs_baseline`` is value / 50_000 — the driver-set north-star target for
@@ -21,29 +27,12 @@ import time
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 NORTH_STAR = 50_000.0
 
 
-def main():
-    from jax.flatten_util import ravel_pytree
-
-    from pytensor_federated_tpu.models.linear import (
-        FederatedLinearRegression,
-        generate_node_data,
-    )
-
-    data, _ = generate_node_data(8, n_obs=64, seed=123)
-    model = FederatedLinearRegression(data)
-    params = model.init_params()
-    flat0, unravel = ravel_pytree(params)
-
-    def logp_and_grad_flat(x):
-        v, g = jax.value_and_grad(lambda x: model.logp(unravel(x)))(x)
-        return v, g
-
-    n_evals = 20_000
-
+def make_chained(logp_and_grad_flat, n_evals):
     @jax.jit
     def chained(x0):
         """Sequential dependent evals — no pipelining tricks: each step
@@ -59,16 +48,83 @@ def main():
         (x, acc), _ = jax.lax.scan(body, (x0, 0.0), None, length=n_evals)
         return x, acc
 
-    # Warm up / compile.
-    out = chained(flat0)
-    jax.block_until_ready(out)
+    return chained
 
+
+def time_chain(fn, x0):
+    out = fn(x0)  # compile + warm
+    jax.block_until_ready(out)
     t0 = time.perf_counter()
-    out = chained(flat0)
+    out = fn(x0)
     jax.block_until_ready(out)
-    wall = time.perf_counter() - t0
+    return time.perf_counter() - t0
 
+
+def main():
+    from jax.flatten_util import ravel_pytree
+
+    from pytensor_federated_tpu.models.linear import (
+        FederatedLinearRegression,
+        generate_node_data,
+    )
+
+    data, _ = generate_node_data(8, n_obs=64, seed=123)
+    model = FederatedLinearRegression(data)
+    params = model.init_params()
+    flat0, unravel = ravel_pytree(params)
+
+    def autodiff_flat(x):
+        return jax.value_and_grad(lambda x: model.logp(unravel(x)))(x)
+
+    candidates = {"xla-autodiff": autodiff_flat}
+
+    # Fused Pallas kernel path (same posterior: kernel data-logp with
+    # forward-supplied VJP + autodiff prior).  interpret=None defers to
+    # the module's PFTPU_PALLAS_COMPILED opt-in — compiled Mosaic is NOT
+    # forced just because the backend says "tpu" (tunneled/PJRT-proxy
+    # runtimes can wedge on Mosaic payloads; see pallas_kernels).
+    pallas_flat = None
+    try:
+        from pytensor_federated_tpu.ops.pallas_kernels import linreg_logp_grad_fn
+
+        (x_d, y_d), mask_d = model.data.tree()
+        kern = linreg_logp_grad_fn(x_d, y_d, mask_d, interpret=None)
+
+        def pallas_flat(x):
+            def full(v):
+                p = unravel(v)
+                return model.prior_logp(p) + kern.data_logp(p)
+
+            return jax.value_and_grad(full)(x)
+
+    except Exception as e:  # pragma: no cover - backend-dependent build
+        print(f"# pallas path unavailable: {e}", file=sys.stderr)
+
+    if pallas_flat is not None:
+        # Correctness gate before racing — a kernel that builds but
+        # disagrees numerically must FAIL the bench, not be skipped.
+        va, ga = autodiff_flat(flat0)
+        vp, gp = pallas_flat(flat0)
+        np.testing.assert_allclose(float(va), float(vp), rtol=2e-4)
+        np.testing.assert_allclose(
+            np.asarray(ga), np.asarray(gp), rtol=2e-3, atol=1e-3
+        )
+        candidates["pallas-fused"] = pallas_flat
+
+    # Calibrate on a short chain, pick the winner.
+    n_cal = 2_000
+    cal = {
+        name: time_chain(make_chained(fn, n_cal), flat0)
+        for name, fn in candidates.items()
+    }
+    best = min(cal, key=cal.get)
+    for name, t in cal.items():
+        print(f"# calib {name}: {n_cal / t:,.0f} evals/s", file=sys.stderr)
+
+    n_evals = 20_000
+    wall = time_chain(make_chained(candidates[best], n_evals), flat0)
     evals_per_sec = n_evals / wall
+
     print(
         json.dumps(
             {
@@ -81,7 +137,8 @@ def main():
         )
     )
     print(
-        f"# backend={jax.default_backend()} wall={wall:.3f}s n={n_evals}",
+        f"# backend={jax.default_backend()} impl={best} wall={wall:.3f}s "
+        f"n={n_evals}",
         file=sys.stderr,
     )
 

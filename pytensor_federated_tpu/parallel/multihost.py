@@ -90,6 +90,10 @@ def make_multihost_mesh(
     devices = sorted(devices, key=lambda d: (d.process_index, d.id))
     n_hosts = len({d.process_index for d in devices})
     inner = dict(inner or {})
+    if host_axis in inner:
+        raise ValueError(
+            f"inner axes must not include the host axis {host_axis!r}"
+        )
     inner_size = int(np.prod(list(inner.values()))) if inner else 1
     if len(devices) % inner_size != 0:
         raise ValueError(

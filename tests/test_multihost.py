@@ -39,6 +39,10 @@ class TestMultihostMesh:
         with pytest.raises(ValueError, match="do not divide"):
             make_multihost_mesh({"chains": 3}, devices=devices8)
 
+    def test_host_axis_collision_raises(self, devices8):
+        with pytest.raises(ValueError, match="host axis"):
+            make_multihost_mesh({"shards": 2}, devices=devices8)
+
 
 class TestRemeshAfterFailure:
     def test_shrinks_to_survivors(self, devices8):
