@@ -10,6 +10,13 @@ from .mesh import (
     make_mesh,
     single_device_mesh,
 )
+from .federated import (
+    fedavg,
+    federated_broadcast,
+    federated_map,
+    federated_mean,
+    federated_sum,
+)
 from .multihost import (
     initialize_multihost,
     make_multihost_mesh,
@@ -37,6 +44,11 @@ __all__ = [
     "ring_shift",
     "seq_sharded_markov_logp",
     "shift_right_across_shards",
+    "fedavg",
+    "federated_broadcast",
+    "federated_map",
+    "federated_mean",
+    "federated_sum",
     "get_load",
     "healthy_devices",
     "initialize_multihost",
