@@ -31,6 +31,26 @@ CHAINS_AXIS = "chains"
 SEQ_AXIS = "seq"
 
 
+def mark_varying(x, axis_name: str):
+    """Mark a replicated pytree as device-varying over ``axis_name``.
+
+    shard_map tracks which values vary across a mesh axis.  Two places
+    need an explicit mark: (a) loop carries that *become* varying (e.g.
+    accumulators fed by ppermute'd data) must start varying or the scan
+    carry types mismatch; (b) replicated params that user code will
+    ``jax.grad`` *inside* the body — an implicit pvary inserted inside
+    the differentiated region transposes to a psum over the axis,
+    silently summing all shards' gradients into each local result.
+    """
+    from jax import lax  # local import: keep mesh.py import-light
+
+    if hasattr(lax, "pcast"):
+        f = lambda l: lax.pcast(l, axis_name, to="varying")
+    else:  # older jax
+        f = lambda l: lax.pvary(l, axis_name)
+    return jax.tree_util.tree_map(f, x)
+
+
 def make_mesh(
     shape: Optional[Mapping[str, int]] = None,
     *,

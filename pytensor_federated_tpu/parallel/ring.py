@@ -35,21 +35,7 @@ import jax.numpy as jnp
 from jax import lax, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-from .mesh import SEQ_AXIS
-
-
-def _mark_varying(x: Any, axis_name: str) -> Any:
-    """Mark a replicated value as device-varying over ``axis_name``.
-
-    shard_map tracks which values vary across a mesh axis; loop carries
-    that *become* varying (e.g. accumulators fed by ppermute'd data) must
-    start varying or the scan carry types mismatch.
-    """
-    if hasattr(lax, "pcast"):
-        f = lambda l: lax.pcast(l, axis_name, to="varying")
-    else:  # older jax
-        f = lambda l: lax.pvary(l, axis_name)
-    return jax.tree_util.tree_map(f, x)
+from .mesh import SEQ_AXIS, mark_varying as _mark_varying
 
 
 def _ring_perm(n: int, *, reverse: bool = False) -> list:

@@ -23,7 +23,7 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .mesh import SHARDS_AXIS
+from .mesh import SHARDS_AXIS, mark_varying as _mark_varying
 
 # per_shard_logp(params, shard_data) -> scalar logp contribution of one shard.
 PerShardLogpFn = Callable[[Any, Any], jax.Array]
@@ -198,6 +198,14 @@ def sharded_compute(
 
     def fn(params):
         def local(params, local_data):
+            # Mark the replicated params device-varying BEFORE any user
+            # code runs: per_shard_fn may call jax.grad internally, and a
+            # pvary inserted inside the differentiated region transposes
+            # to a psum over the axis — silently summing every shard's
+            # gradient into each local update.  Varying params keep the
+            # whole body axis-local, which is the semantics of one node
+            # computing on its own private data.
+            params = _mark_varying(params, axis)
             return jax.vmap(lambda d: per_shard_fn(params, d))(local_data)
 
         return shard_map(
