@@ -22,6 +22,31 @@ T = TypeVar("T")
 LOG_2PI = math.log(2.0 * math.pi)
 
 
+def force_cpu_backend(plugin: str = "axon") -> None:
+    """Restrict this process to the CPU backend without dialing ``plugin``.
+
+    Tunneled single-chip environments pre-register a PJRT plugin whose
+    client *init* dials a relay — and a wedged relay blocks forever, so
+    merely enumerating devices can hang the process.  CPU-only work
+    (tests, virtual-mesh dry runs, fallback benchmarking) must therefore
+    both restrict ``jax_platforms`` AND drop the plugin's backend
+    factory before the first device query.  Call before any jax API
+    that initializes backends; no-op (beyond the platform restriction)
+    if the plugin isn't registered.  The factory pop uses a private
+    jax API, so it is best-effort — a jax upgrade degrades to the
+    platform restriction alone rather than an ImportError.
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        from jax._src import xla_bridge as _xb
+
+        _xb._backend_factories.pop(plugin, None)
+    except Exception:
+        pass
+
+
 def argmin_none_or_func(
     items: Sequence[Optional[T]], func: Callable[[T], float]
 ) -> Optional[int]:

@@ -7,10 +7,15 @@ test_service.py:180-224; SURVEY §4) — so the full sharded path executes
 without TPU hardware.
 
 This environment may pre-register a TPU PJRT plugin at interpreter
-startup (sitecustomize), before pytest loads this file.  JAX's *CPU*
-backend initializes lazily, so it is still possible to (a) request 8
-virtual CPU devices via XLA_FLAGS and (b) route all un-placed
-computation to CPU via ``jax_default_device`` — no re-exec needed.
+startup (sitecustomize), before pytest loads this file.  Backends
+initialize lazily, so this file can still force a pure-CPU session: it
+restricts ``jax_platforms`` to cpu AND drops the plugin's backend
+factory before the first device query.  Both steps matter — the suite
+must never *dial* the TPU plugin: tests are CPU-only, and a test
+process that opens (or merely half-opens, e.g. when killed by a
+timeout) a tunneled-chip session can orphan its claim and wedge the
+chip for every later process on the machine, including the real
+benchmark run.
 """
 
 import contextlib
@@ -28,11 +33,14 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 import pytest  # noqa: E402
 
-_CPUS = jax.devices("cpu")
-jax.config.update("jax_default_device", _CPUS[0])
-
 # Make the repo root importable regardless of cwd.
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pytensor_federated_tpu.utils import force_cpu_backend  # noqa: E402
+
+force_cpu_backend()
+_CPUS = jax.devices("cpu")
+jax.config.update("jax_default_device", _CPUS[0])
 
 
 @contextlib.contextmanager
