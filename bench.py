@@ -23,7 +23,6 @@ compare against (the reference publishes none, BASELINE.md).
 
 import json
 import os
-import subprocess
 import sys
 import time
 
@@ -34,37 +33,35 @@ import numpy as np
 NORTH_STAR = 50_000.0
 
 
-def ensure_live_backend(probe_timeout_s: float = 150.0) -> None:
-    """Fall back to CPU if the default (tunneled-TPU) backend is wedged.
+def preflight() -> bool:
+    """One subprocess probe BEFORE this process initializes jax.
 
-    A tunneled chip session can wedge such that PJRT client *init*
-    blocks forever — which would hang this benchmark at the first
-    device query.  Probe liveness in a subprocess under a wall-clock
-    timeout; on failure, restrict this process to the CPU backend (drop
-    the plugin factory before anything dials it) so the bench still
-    reports a number instead of hanging the harness.
+    Returns whether compiled Mosaic may be used for the Pallas path.
+    Two decisions come out of the single probe (one child, one backend
+    bring-up — single-host TPU runtimes are exclusive per process, so
+    the child must run before the parent holds the chip):
+
+    - dead/wedged backend (tunneled relays block PJRT client init
+      forever) -> restrict this process to CPU so the bench reports a
+      number instead of hanging the harness;
+    - Mosaic support.  On tunneled runtimes the Mosaic attempt itself
+      can wedge the chip for every later process — including the rest
+      of this benchmark — so there it stays opt-in
+      (PFTPU_PALLAS_COMPILED=1); on direct TPU runtimes it is probed by
+      default.
     """
-    if not os.environ.get("PALLAS_AXON_POOL_IPS"):
-        return  # no tunneled plugin registered; nothing to probe
-    probe = (
-        "import jax, jax.numpy as jnp\n"
-        "jax.devices()\n"
-        "print(float(jnp.ones(()).sum()))\n"
-    )
-    try:
-        res = subprocess.run(
-            [sys.executable, "-c", probe],
-            timeout=probe_timeout_s,
-            capture_output=True,
-        )
-        if res.returncode == 0:
-            return
-    except (subprocess.TimeoutExpired, OSError):
-        pass
-    print("# TPU backend unresponsive -> CPU fallback", file=sys.stderr)
-    from pytensor_federated_tpu.utils import force_cpu_backend
+    from pytensor_federated_tpu.utils import force_cpu_backend, probe_backend
 
-    force_cpu_backend()
+    tunneled = bool(os.environ.get("PALLAS_AXON_POOL_IPS"))
+    try_mosaic = (not tunneled) or (
+        os.environ.get("PFTPU_PALLAS_COMPILED") == "1"
+    )
+    live, mosaic_ok = probe_backend(try_mosaic=try_mosaic)
+    if not live:
+        print("# backend unresponsive -> CPU fallback", file=sys.stderr)
+        force_cpu_backend()
+        return False
+    return mosaic_ok
 
 
 def make_chained(logp_and_grad_flat, n_evals):
@@ -96,7 +93,7 @@ def time_chain(fn, x0):
 
 
 def main():
-    ensure_live_backend()
+    mosaic_ok = preflight()
 
     from jax.flatten_util import ravel_pytree
 
@@ -126,25 +123,17 @@ def main():
     candidates["suffstats"] = suffstat_flat
 
     # Fused Pallas kernel path (same posterior: kernel data-logp with
-    # forward-supplied VJP + autodiff prior).  Compiled Mosaic is probed
-    # in a subprocess first — tunneled/PJRT-proxy runtimes can wedge on
-    # Mosaic payloads (see pallas_kernels.probe_compiled_mosaic), so a
-    # bad runtime degrades to interpreter mode instead of hanging.
+    # forward-supplied VJP + autodiff prior).  Compiled Mosaic was
+    # decided by the preflight probe; the pin works both ways — a
+    # failed probe forces interpreter mode even if
+    # PFTPU_PALLAS_COMPILED=1 is set, otherwise the opt-in env var
+    # would re-select the compiled path the probe just found wedged,
+    # and the first kernel call would hang.
     pallas_flat = None
     try:
-        from pytensor_federated_tpu.ops.pallas_kernels import (
-            linreg_logp_grad_fn,
-            probe_compiled_mosaic,
-        )
+        from pytensor_federated_tpu.ops.pallas_kernels import linreg_logp_grad_fn
 
-        # Pin the outcome both ways: a failed probe must force
-        # interpreter mode even if PFTPU_PALLAS_COMPILED=1 is set —
-        # otherwise the opt-in env var re-selects the compiled path the
-        # probe just found wedged, and the first kernel call hangs.
-        if jax.default_backend() == "tpu":
-            interpret = not probe_compiled_mosaic()
-        else:
-            interpret = True
+        interpret = not (mosaic_ok and jax.default_backend() == "tpu")
         print(f"# pallas interpret={interpret}", file=sys.stderr)
 
         (x_d, y_d), mask_d = model.data.tree()

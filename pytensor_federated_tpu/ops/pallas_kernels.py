@@ -65,33 +65,14 @@ def probe_compiled_mosaic(timeout_s: float = 180.0) -> bool:
 
     Tunneled/PJRT-proxy single-chip environments can *hang* (not raise)
     on Mosaic payloads, so the probe runs a tiny compiled kernel in a
-    subprocess under a wall-clock timeout.  Returns True only on a clean
-    numerically-correct run; use it to decide ``interpret=False``
-    eagerly (e.g. the benchmark) without risking a wedged process.
+    subprocess under a wall-clock timeout (see ``utils.probe_backend``).
+    Run it BEFORE this process initializes jax — single-host TPU
+    runtimes are exclusive per process.  Returns True only on a clean
+    numerically-correct run.
     """
-    import subprocess
-    import sys
+    from ..utils import probe_backend
 
-    code = (
-        "import numpy as np, jax.numpy as jnp\n"
-        "from pytensor_federated_tpu.ops.pallas_kernels import linreg_reductions\n"
-        "S, N = 8, 64\n"
-        "x = jnp.ones((S, N)); y = 2.0 * jnp.ones((S, N))\n"
-        "m = jnp.ones((S, N))\n"
-        "sc = jnp.asarray([0.0, 0.0, 0.0], jnp.float32)\n"
-        "off = jnp.zeros((S,), jnp.float32)\n"
-        "ll, gmu, gx, gz = linreg_reductions(sc, off, x, y, m, interpret=False)\n"
-        "assert np.allclose(np.asarray(gmu), 2.0 * N), np.asarray(gmu)\n"
-    )
-    try:
-        res = subprocess.run(
-            [sys.executable, "-c", code],
-            timeout=timeout_s,
-            capture_output=True,
-        )
-        return res.returncode == 0
-    except (subprocess.TimeoutExpired, OSError):
-        return False
+    return probe_backend(try_mosaic=True, timeout_s=timeout_s)[1]
 
 
 def _linreg_kernel(scal_ref, off_ref, x_ref, y_ref, m_ref, out_ref):

@@ -70,6 +70,19 @@ class FederatedLogp:
 
     With ``mesh=None`` the same model runs single-device (vmap + sum),
     which is also the fastest single-chip layout.
+
+    ``remat=True`` wraps the per-shard logp in ``jax.checkpoint``: the
+    backward pass recomputes shard activations instead of holding them
+    in HBM — the standard TPU trade of MXU FLOPs for HBM residency when
+    shards are large.
+
+    Unlike the reference's federated boundary — which hard-rejects
+    gradients of its gradient outputs (reference: wrapper_ops.py:123-125),
+    so no second-order autodiff crosses it — this evaluator is a pure
+    JAX function of ``params``: ``jax.hessian`` / HVPs differentiate
+    straight through the vmap, ``shard_map``, and psum (tested in
+    test_sharded.py).  The forward-supplied-gradient ops keep the
+    reference's one-order contract (see ops/ops.py:LogpGradOp).
     """
 
     def __init__(
@@ -79,7 +92,10 @@ class FederatedLogp:
         *,
         mesh: Optional[Mesh] = None,
         axis: str = SHARDS_AXIS,
+        remat: bool = False,
     ):
+        if remat:
+            per_shard_logp = jax.checkpoint(per_shard_logp)
         self.per_shard_logp = per_shard_logp
         self.axis = axis
         self.mesh = mesh

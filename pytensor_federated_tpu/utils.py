@@ -14,12 +14,79 @@ from __future__ import annotations
 
 import asyncio
 import math
+import os
 from typing import Callable, Optional, Sequence, TypeVar
 
 T = TypeVar("T")
 
 # Shared Gaussian constant — single definition for every model/kernel.
 LOG_2PI = math.log(2.0 * math.pi)
+
+
+def probe_backend(
+    *, try_mosaic: bool = False, timeout_s: float = 180.0
+) -> tuple[bool, bool]:
+    """Probe the default jax backend in a subprocess: ``(live, mosaic_ok)``.
+
+    One child process, run BEFORE the caller initializes jax itself:
+    single-host TPU runtimes are exclusive per process, so a child
+    spawned after the parent holds the chip could never attach and a
+    healthy runtime would mis-probe as dead.  The child prints ``LIVE``
+    after a tiny on-device op and — only with ``try_mosaic`` —
+    ``MOSAIC_OK`` after running a compiled Pallas kernel.  A hang (the
+    tunneled-relay wedge mode) is cut off by the timeout, and the
+    partial output still distinguishes dead-backend from
+    wedged-on-Mosaic.  The child imports this package, so PYTHONPATH is
+    set explicitly (the repo may not be pip-installed).
+    """
+    import subprocess
+    import sys
+
+    code = (
+        "import jax, jax.numpy as jnp\n"
+        "jax.devices()\n"
+        "assert float(jnp.ones(()).sum()) == 1.0\n"
+        "print('LIVE', flush=True)\n"
+    )
+    if try_mosaic:
+        code += (
+            "import numpy as np\n"
+            "from pytensor_federated_tpu.ops.pallas_kernels import"
+            " linreg_reductions\n"
+            "S, N = 8, 64\n"
+            "x = jnp.ones((S, N)); y = 2.0 * jnp.ones((S, N))\n"
+            "m = jnp.ones((S, N))\n"
+            "sc = jnp.zeros((3,), jnp.float32)\n"
+            "off = jnp.zeros((S,), jnp.float32)\n"
+            "ll, gmu, gx, gz = linreg_reductions("
+            "sc, off, x, y, m, interpret=False)\n"
+            "assert np.allclose(np.asarray(gmu), 2.0 * N), np.asarray(gmu)\n"
+            "print('MOSAIC_OK', flush=True)\n"
+        )
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    try:
+        res = subprocess.run(
+            [sys.executable, "-c", code],
+            timeout=timeout_s,
+            capture_output=True,
+            env=env,
+        )
+        out = (res.stdout or b"").decode("utf-8", "replace")
+        if res.returncode != 0:
+            print(
+                "# backend probe failed:\n"
+                + (res.stderr or b"").decode("utf-8", "replace")[-2000:],
+                file=sys.stderr,
+            )
+    except subprocess.TimeoutExpired as e:
+        out = (e.stdout or b"").decode("utf-8", "replace")
+        print(f"# backend probe timed out after {timeout_s}s", file=sys.stderr)
+    except OSError as e:
+        print(f"# backend probe could not run: {e}", file=sys.stderr)
+        return False, False
+    return "LIVE" in out, "MOSAIC_OK" in out
 
 
 def force_cpu_backend(plugin: str = "axon") -> None:

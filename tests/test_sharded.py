@@ -133,3 +133,80 @@ def test_sharded_compute_generic(mesh8):
     out = fn(jnp.float32(2.0))
     np.testing.assert_allclose(out["scaled"], 2.0 * data)
     np.testing.assert_allclose(out["sum"], jnp.sum(data, axis=1))
+
+
+def test_second_order_through_federated_boundary(mesh8):
+    """jax.hessian differentiates straight through vmap/shard_map/psum —
+    the capability the reference's boundary forbids (reference:
+    wrapper_ops.py:123-125 rejects grads of its grad outputs)."""
+    data = (jnp.arange(8.0).reshape(8, 1),)
+
+    def per_shard(p, d):
+        return -jnp.sum((d[0] - p["mu"]) ** 2) * p["scale"]
+
+    p = {"mu": jnp.asarray(0.5), "scale": jnp.asarray(1.2)}
+    single = FederatedLogp(per_shard, data)
+    h1 = jax.hessian(single.logp)(p)
+    # d2/dmu2 = -2 * n * scale
+    np.testing.assert_allclose(float(h1["mu"]["mu"]), -2 * 8 * 1.2, rtol=1e-5)
+    # mixed partial d2/dmu dscale = -2 * sum(mu - x)
+    np.testing.assert_allclose(
+        float(h1["mu"]["scale"]), float(-2 * jnp.sum(0.5 - jnp.arange(8.0))),
+        rtol=1e-5,
+    )
+    on_mesh = FederatedLogp(per_shard, data, mesh=mesh8)
+    h2 = jax.hessian(on_mesh.logp)(p)
+    for k1 in h1:
+        for k2 in h1[k1]:
+            np.testing.assert_allclose(
+                float(h2[k1][k2]), float(h1[k1][k2]), rtol=1e-5
+            )
+
+
+def test_forward_supplied_grads_keep_one_order_contract():
+    """LogpGradOp (forward-supplied VJP) preserves the reference's
+    no-second-order contract: hessian attempts fail loudly rather than
+    silently returning wrong curvature."""
+    from pytensor_federated_tpu.ops.ops import LogpGradOp
+
+    op = LogpGradOp(lambda a: (-(a**2), (-2 * a,)))
+    with pytest.raises(TypeError, match="custom_vjp"):
+        jax.hessian(lambda a: op.logp(a))(jnp.asarray(2.0))
+
+
+def test_remat_equivalence(mesh8):
+    """remat=True recomputes activations in the backward pass without
+    changing values or gradients."""
+    data = (jnp.arange(16.0).reshape(8, 2),)
+
+    def per_shard(p, d):
+        return -jnp.sum(jnp.tanh((d[0] - p) ** 2))
+
+    p = jnp.asarray(0.3)
+    plain = FederatedLogp(per_shard, data, mesh=mesh8)
+    remat = FederatedLogp(per_shard, data, mesh=mesh8, remat=True)
+    v1, g1 = plain.logp_and_grad(p)
+    v2, g2 = remat.logp_and_grad(p)
+    np.testing.assert_allclose(float(v1), float(v2), rtol=1e-6)
+    np.testing.assert_allclose(float(g1), float(g2), rtol=1e-6)
+
+
+def test_x64_opt_in():
+    """Exchange-dtype policy: float32 native by default (TPU-first),
+    float64 via jax's own x64 switch — the explicit decision SURVEY §5
+    calls for (the reference's de-facto wire dtype is float64)."""
+    data = (jnp.arange(8.0).reshape(8, 1),)
+
+    def per_shard(p, d):
+        return -jnp.sum((d[0] - p) ** 2)
+
+    fed32 = FederatedLogp(per_shard, data)
+    assert fed32.logp(jnp.asarray(0.5)).dtype == jnp.float32
+    with jax.enable_x64():
+        data64 = (jnp.arange(8.0, dtype=jnp.float64).reshape(8, 1),)
+        fed64 = FederatedLogp(per_shard, data64)
+        out = fed64.logp(jnp.asarray(0.5, dtype=jnp.float64))
+        assert out.dtype == jnp.float64
+        np.testing.assert_allclose(
+            float(out), float(fed32.logp(jnp.asarray(0.5))), rtol=1e-6
+        )
