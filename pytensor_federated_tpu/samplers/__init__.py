@@ -1,6 +1,7 @@
 """On-device samplers (replaces the reference's PyMC driver dependency)."""
 
 from .advi import ADVIResult, advi_fit
+from .convergence import effective_sample_size, split_rhat, summary
 from .ensemble import EnsembleResult, ensemble_sample
 from .hmc import HMCState, find_reasonable_step_size, hmc_init, hmc_step, leapfrog
 from .mcmc import SampleResult, find_map, sample
@@ -20,9 +21,12 @@ __all__ = [
     "HMCState",
     "NUTSInfo",
     "SampleResult",
+    "effective_sample_size",
     "find_map",
     "find_reasonable_step_size",
     "flatten_logp",
+    "split_rhat",
+    "summary",
     "hmc_init",
     "hmc_step",
     "leapfrog",

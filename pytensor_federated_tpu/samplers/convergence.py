@@ -1,0 +1,133 @@
+"""Convergence diagnostics: split-R̂, effective sample size, summaries.
+
+The reference delegates posterior-quality checks to arviz (reference:
+test_wrapper_ops.py:112-117 asserts a posterior median from an
+``arviz.InferenceData``; requirements-dev.txt pulls arviz via pymc).
+This framework samples on-device without PyMC, so the standard
+diagnostics live here as pure-jnp functions — jit/vmap-friendly, and
+they run on the draws wherever they already are (device HBM) instead
+of round-tripping through host DataFrames.
+
+Definitions follow Vehtari, Gelman, Simpson, Carpenter, Bürkner (2021)
+"Rank-normalization, folding, and localization: An improved R̂":
+split-chain R̂ and the Geyer initial-monotone-sequence ESS (the same
+estimators Stan and arviz report, minus rank-normalization).
+Computation promotes to at least float32 but preserves float64 inputs
+(the x64 opt-in policy) — diagnostics of large-location/small-scale
+parameters would quantize to garbage if downcast.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["split_rhat", "effective_sample_size", "summary"]
+
+
+def _split_chains(draws: jax.Array) -> jax.Array:
+    """(chains, n, ...) -> (2*chains, n//2, ...), dropping an odd tail."""
+    c, n = draws.shape[0], draws.shape[1]
+    half = n // 2
+    first = draws[:, :half]
+    second = draws[:, half : 2 * half]
+    return jnp.concatenate([first, second], axis=0)
+
+
+def _compute_dtype(d):
+    return jnp.promote_types(d.dtype, jnp.float32)
+
+
+def _rhat_scalar(draws: jax.Array) -> jax.Array:
+    """Split-R̂ for one scalar parameter; ``draws``: (chains, n)."""
+    x = _split_chains(draws.astype(_compute_dtype(draws)))
+    m, n = x.shape
+    chain_means = jnp.mean(x, axis=1)
+    w = jnp.mean(jnp.var(x, axis=1, ddof=1))
+    b = n * jnp.var(chain_means, ddof=1)
+    var_plus = (n - 1) / n * w + b / n
+    return jnp.sqrt(var_plus / w)
+
+
+def _autocov(x: jax.Array) -> jax.Array:
+    """Per-chain autocovariance via FFT; ``x``: (chains, n) -> (chains, n)."""
+    n = x.shape[1]
+    xc = x - jnp.mean(x, axis=1, keepdims=True)
+    size = 2 * n  # zero-pad to avoid circular wrap
+    f = jnp.fft.rfft(xc, n=size, axis=1)
+    acov = jnp.fft.irfft(f * jnp.conj(f), n=size, axis=1)[:, :n]
+    return acov / n
+
+
+def _ess_scalar(draws: jax.Array) -> jax.Array:
+    """Geyer initial-monotone-sequence ESS; ``draws``: (chains, n)."""
+    x = _split_chains(draws.astype(_compute_dtype(draws)))
+    m, n = x.shape
+    acov = _autocov(x)
+    chain_var = acov[:, 0] * n / (n - 1.0)
+    w = jnp.mean(chain_var)
+    chain_means = jnp.mean(x, axis=1)
+    var_plus = (n - 1) / n * w + jnp.var(chain_means, ddof=1)
+
+    rho = 1.0 - (w - jnp.mean(acov, axis=0)) / var_plus  # (n,)
+    # Geyer: sum consecutive-lag pairs while the pair sums stay
+    # positive (initial positive sequence), with a running minimum so
+    # the used sequence is also non-increasing (initial monotone
+    # sequence) — a noisy upward fluctuation in the tail must not
+    # inflate tau.
+    n_pairs = n // 2
+    pair = rho[: 2 * n_pairs].reshape(n_pairs, 2).sum(axis=1)
+    positive = jnp.cumprod(pair > 0.0)  # 1 until the first non-positive pair
+    pair_mono = jax.lax.cummin(pair)
+    # rho_0 = 1 is part of pair[0]; subtract it back out of tau below.
+    tau = -1.0 + 2.0 * jnp.sum(pair_mono * positive)
+    tau = jnp.maximum(tau, 1.0 / jnp.log10(jnp.asarray(float(m * n))))
+    return m * n / tau
+
+
+def _per_param(fn, samples: Any) -> Any:
+    """Apply a (chains, n)->scalar diagnostic over every scalar component
+    of every leaf; leaves have shape (chains, draws, *event)."""
+
+    def leaf(d):
+        d = jnp.asarray(d)
+        c, n = d.shape[0], d.shape[1]
+        flat = d.reshape(c, n, -1)
+        out = jax.vmap(fn, in_axes=2)(flat)  # (prod(event),)
+        return out.reshape(d.shape[2:]) if d.ndim > 2 else out.reshape(())
+
+    return jax.tree_util.tree_map(leaf, samples)
+
+
+def split_rhat(samples: Any) -> Any:
+    """Split-chain potential-scale-reduction R̂ per scalar component.
+
+    ``samples``: pytree of arrays shaped (chains, draws, *event) — e.g.
+    ``SampleResult.samples``.  Values near 1 (< 1.01) indicate the
+    chains agree; mixing failures show up as R̂ >> 1.
+    """
+    return _per_param(_rhat_scalar, samples)
+
+
+def effective_sample_size(samples: Any) -> Any:
+    """Bulk effective sample size per scalar component (Geyer/Stan
+    estimator on split chains)."""
+    return _per_param(_ess_scalar, samples)
+
+
+def summary(samples: Any) -> Dict[str, Any]:
+    """Posterior summary: mean, sd, split-R̂, and ESS per component.
+
+    The on-device counterpart of the ``arviz.summary`` table the
+    reference's workflow ends with.
+    """
+    mean = jax.tree_util.tree_map(lambda d: jnp.mean(d, axis=(0, 1)), samples)
+    sd = jax.tree_util.tree_map(lambda d: jnp.std(d, axis=(0, 1)), samples)
+    return {
+        "mean": mean,
+        "sd": sd,
+        "rhat": split_rhat(samples),
+        "ess": effective_sample_size(samples),
+    }

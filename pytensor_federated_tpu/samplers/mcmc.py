@@ -145,6 +145,12 @@ class SampleResult:
     step_size: jax.Array  # (chains,)
     inv_mass: jax.Array  # (chains, dim)
 
+    def summary(self) -> dict:
+        """mean/sd/split-R̂/ESS per component (see samplers.convergence)."""
+        from .convergence import summary as _summary
+
+        return _summary(self.samples)
+
 
 def sample(
     logp_fn: Callable[[Any], jax.Array],

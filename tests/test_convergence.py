@@ -1,0 +1,101 @@
+"""Convergence-diagnostic known-answer tests.
+
+The reference's workflow ends in an arviz summary over PyMC draws
+(reference: test_wrapper_ops.py:112-117); these pin our on-device
+split-R̂ / ESS / summary against cases with known behavior: iid draws
+(R̂≈1, ESS≈N), an AR(1) chain with strong autocorrelation (ESS ≪ N,
+near the closed-form N(1-ρ)/(1+ρ)), and separated chains (R̂ ≫ 1).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytensor_federated_tpu.samplers import (
+    effective_sample_size,
+    split_rhat,
+    summary,
+)
+
+C, N = 4, 2000
+
+
+def test_iid_draws_rhat_one_ess_full():
+    rng = np.random.default_rng(0)
+    draws = jnp.asarray(rng.normal(size=(C, N)), jnp.float32)
+    r = float(split_rhat(draws))
+    ess = float(effective_sample_size(draws))
+    assert abs(r - 1.0) < 0.01, r
+    # iid: ESS within ~25% of the true sample count.
+    assert 0.75 * C * N < ess < 1.3 * C * N, ess
+
+
+def test_ar1_ess_matches_closed_form():
+    rho = 0.9
+    rng = np.random.default_rng(1)
+    x = np.zeros((C, N))
+    eps = rng.normal(size=(C, N)) * np.sqrt(1 - rho**2)
+    for t in range(1, N):
+        x[:, t] = rho * x[:, t - 1] + eps[:, t]
+    ess = float(effective_sample_size(jnp.asarray(x, jnp.float32)))
+    expected = C * N * (1 - rho) / (1 + rho)  # ≈ 421
+    assert 0.5 * expected < ess < 2.0 * expected, (ess, expected)
+    assert ess < 0.15 * C * N  # far below the nominal count
+
+
+def test_separated_chains_rhat_large():
+    rng = np.random.default_rng(2)
+    draws = rng.normal(size=(C, N)) + np.arange(C)[:, None] * 5.0
+    r = float(split_rhat(jnp.asarray(draws, jnp.float32)))
+    assert r > 2.0, r
+
+
+def test_pytree_and_event_shapes():
+    rng = np.random.default_rng(3)
+    samples = {
+        "scalar": jnp.asarray(rng.normal(size=(C, N)), jnp.float32),
+        "vec": jnp.asarray(rng.normal(size=(C, N, 3)), jnp.float32),
+    }
+    s = summary(samples)
+    assert s["rhat"]["scalar"].shape == ()
+    assert s["rhat"]["vec"].shape == (3,)
+    assert s["ess"]["vec"].shape == (3,)
+    np.testing.assert_allclose(np.asarray(s["mean"]["scalar"]), 0.0, atol=0.05)
+    for r in np.asarray(s["rhat"]["vec"]):
+        assert abs(r - 1.0) < 0.02
+
+
+def test_diagnostics_on_real_sampler_output():
+    """End of the pipeline: NUTS draws from a correct sampler over a
+    simple posterior should pass the standard thresholds."""
+    from pytensor_federated_tpu.samplers import sample
+
+    logp = lambda p: -0.5 * jnp.sum(p["x"] ** 2)
+    res = sample(
+        logp,
+        {"x": jnp.zeros((2,))},
+        key=jax.random.PRNGKey(0),
+        num_warmup=300,
+        num_samples=500,
+        num_chains=4,
+        jitter=0.5,
+    )
+    s = summary(res.samples)
+    rhat = np.asarray(s["rhat"]["x"])
+    ess = np.asarray(s["ess"]["x"])
+    assert (rhat < 1.05).all(), rhat
+    assert (ess > 200).all(), ess
+
+
+def test_x64_large_location_small_scale():
+    """Under enable_x64, diagnostics must not downcast: location ~1e5
+    with sd ~1e-3 quantizes to garbage in float32."""
+    with jax.enable_x64():
+        rng = np.random.default_rng(4)
+        draws = jnp.asarray(
+            1e5 + 1e-3 * rng.normal(size=(C, N)), jnp.float64
+        )
+        r = float(split_rhat(draws))
+        ess = float(effective_sample_size(draws))
+        assert abs(r - 1.0) < 0.01, r
+        assert 0.75 * C * N < ess < 1.3 * C * N, ess
