@@ -31,6 +31,7 @@ from .ring import (
     shift_right_across_shards,
 )
 from .sharded import FederatedLogp, sharded_compute
+from .zero import ScatteredGrads, ZeroShardedLogpGrad
 
 __all__ = [
     "CHAINS_AXIS",
@@ -38,7 +39,9 @@ __all__ = [
     "SHARDS_AXIS",
     "DeviceLoad",
     "FederatedLogp",
+    "ScatteredGrads",
     "ShardedData",
+    "ZeroShardedLogpGrad",
     "ring_all_pairs_sum",
     "ring_attention",
     "ring_shift",

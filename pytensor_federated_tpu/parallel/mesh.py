@@ -44,10 +44,18 @@ def mark_varying(x, axis_name: str):
     """
     from jax import lax  # local import: keep mesh.py import-light
 
-    if hasattr(lax, "pcast"):
-        f = lambda l: lax.pcast(l, axis_name, to="varying")
-    else:  # older jax
-        f = lambda l: lax.pvary(l, axis_name)
+    def f(l):
+        # Idempotent: pcast rejects varying->varying, so skip values
+        # already varying over this axis.  (Under check_vma=False the
+        # vma set stays empty and pcast is a harmless no-op.)  Real
+        # errors — e.g. an axis name not bound by the enclosing
+        # shard_map — still raise loudly.
+        if axis_name in getattr(jax.typeof(l), "vma", frozenset()):
+            return l
+        if hasattr(lax, "pcast"):
+            return lax.pcast(l, axis_name, to="varying")
+        return lax.pvary(l, axis_name)
+
     return jax.tree_util.tree_map(f, x)
 
 
