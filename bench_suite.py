@@ -1,0 +1,147 @@
+"""Benchmark suite: every BASELINE.json config, one JSON line each.
+
+``bench.py`` is the driver-facing single-metric benchmark (the 8-shard
+flagship); this suite covers the full config list for the record:
+
+1. single-node linear regression (the collapsed demo pair);
+2. 8-shard federated linear regression, psum-aggregated logp+grad;
+3. hierarchical radon GLM, one shard per county group;
+4. Lotka-Volterra ODE param estimation, [theta] -> [LL, dLL] per shard;
+5. 64-shard federated logistic regression + a full NUTS posterior.
+
+Each config measures sequential dependent logp+grad evals/s (the NUTS
+consumption pattern, chained in one lax.scan, like bench.py); config 5
+also reports end-to-end NUTS samples/s. Run: ``python bench_suite.py``.
+"""
+
+import json
+import sys
+import time
+
+from bench import NORTH_STAR, make_chained, preflight, time_chain
+
+
+def _rate(fn_flat, flat0, *, n_target_s: float = 0.3):
+    n_cal = 500
+    t = time_chain(make_chained(fn_flat, n_cal), flat0)
+    n = max(2_000, int(n_target_s / max(t / n_cal, 1e-9)))
+    wall = time_chain(make_chained(fn_flat, n), flat0)
+    return n / wall, n
+
+
+def _flat(model):
+    import jax
+    from jax.flatten_util import ravel_pytree
+
+    params = model.init_params()
+    flat0, unravel = ravel_pytree(params)
+
+    def fn(x):
+        return jax.value_and_grad(lambda v: model.logp(unravel(v)))(x)
+
+    return fn, flat0
+
+
+def main():
+    preflight()
+    import jax
+    import numpy as np
+
+    from pytensor_federated_tpu.models.glm import (
+        HierarchicalRadonGLM,
+        generate_radon_data,
+    )
+    from pytensor_federated_tpu.models.linear import (
+        FederatedLinearRegression,
+        generate_node_data,
+    )
+    from pytensor_federated_tpu.models.logistic import (
+        FederatedLogisticRegression,
+        generate_logistic_data,
+    )
+    from pytensor_federated_tpu.models.ode import make_lv_model
+
+    results = []
+
+    def record(config, value, unit="evals/s", **extra):
+        line = {
+            "config": config,
+            "value": round(value, 1),
+            "unit": unit,
+            # The 50k north star is an evals/s target; other units have
+            # no baseline to compare against.
+            "vs_baseline": (
+                round(value / NORTH_STAR, 3) if unit == "evals/s" else None
+            ),
+            "backend": jax.default_backend(),
+            **extra,
+        }
+        results.append(line)
+        print(json.dumps(line))
+
+    # 1. single-node linear regression (demo pair collapsed; one shard).
+    data1, _ = generate_node_data(1, n_obs=64, seed=11)
+    fn, x0 = _flat(FederatedLinearRegression(data1))
+    r, n = _rate(fn, x0)
+    record("single-node linear regression (demo pair)", r, n=n)
+
+    # 2. 8-shard federated linear regression (the bench.py flagship).
+    data8, _ = generate_node_data(8, n_obs=64, seed=123)
+    fn, x0 = _flat(FederatedLinearRegression(data8))
+    r, n = _rate(fn, x0)
+    record("8-shard federated linear regression (psum logp+grad)", r, n=n)
+
+    # 3. hierarchical radon GLM, one shard per county group.
+    datag, _ = generate_radon_data(16, seed=12)
+    fn, x0 = _flat(HierarchicalRadonGLM(datag))
+    r, n = _rate(fn, x0)
+    record("hierarchical radon GLM (16 county shards)", r, n=n)
+
+    # 4. Lotka-Volterra ODE: [theta] -> [LL, dLL] per shard.
+    lv, _ = make_lv_model(8)
+    fn, x0 = _flat(lv)
+    r, n = _rate(fn, x0)
+    record("Lotka-Volterra ODE param estimation (8 shards)", r, n=n)
+
+    # 5. 64-shard federated logistic regression; evals/s + NUTS samples/s.
+    datal, _ = generate_logistic_data(n_shards=64, n_obs=64, n_features=8)
+    model5 = FederatedLogisticRegression(datal)
+    fn, x0 = _flat(model5)
+    r, n = _rate(fn, x0)
+    record("64-shard federated logistic regression (logp+grad)", r, n=n)
+
+    from pytensor_federated_tpu.samplers import sample
+
+    t0 = time.perf_counter()
+    res = sample(
+        model5.logp,
+        model5.init_params(),
+        key=jax.random.PRNGKey(0),
+        num_warmup=200,
+        num_samples=200,
+        num_chains=4,
+        jitter=0.1,
+    )
+    jax.block_until_ready(res.samples)
+    wall = time.perf_counter() - t0
+    n_draws = 4 * 200
+    record(
+        "64-shard logistic: full NUTS posterior",
+        n_draws / wall,
+        unit="samples/s",
+        wall_s=round(wall, 2),
+        note="includes warmup+compile",
+    )
+    rhat = float(np.asarray(res.summary()["rhat"]["w"]).max())
+    results[-1]["max_rhat"] = round(rhat, 4)
+
+    # Persist all measurements BEFORE any convergence assertion — a
+    # flaky chain must not discard minutes of completed configs.
+    with open("BENCH_SUITE.json", "w") as f:
+        json.dump(results, f, indent=1)
+    print(f"# wrote BENCH_SUITE.json ({len(results)} configs)", file=sys.stderr)
+    assert rhat < 1.2, f"NUTS did not converge: max rhat {rhat}"
+
+
+if __name__ == "__main__":
+    main()

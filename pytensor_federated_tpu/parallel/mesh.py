@@ -49,8 +49,12 @@ def mark_varying(x, axis_name: str):
         # already varying over this axis.  (Under check_vma=False the
         # vma set stays empty and pcast is a harmless no-op.)  Real
         # errors — e.g. an axis name not bound by the enclosing
-        # shard_map — still raise loudly.
-        if axis_name in getattr(jax.typeof(l), "vma", frozenset()):
+        # shard_map — still raise loudly.  jax.typeof is newer than the
+        # pvary fallback below, so resolve it defensively.
+        typeof = getattr(jax, "typeof", None)
+        if typeof is not None and axis_name in getattr(
+            typeof(l), "vma", frozenset()
+        ):
             return l
         if hasattr(lax, "pcast"):
             return lax.pcast(l, axis_name, to="varying")
