@@ -125,15 +125,15 @@ def main():
     jax.block_until_ready(res.samples)
     wall = time.perf_counter() - t0
     n_draws = 4 * 200
+    rhat = float(np.asarray(res.summary()["rhat"]["w"]).max())
     record(
         "64-shard logistic: full NUTS posterior",
         n_draws / wall,
         unit="samples/s",
         wall_s=round(wall, 2),
         note="includes warmup+compile",
+        max_rhat=round(rhat, 4),
     )
-    rhat = float(np.asarray(res.summary()["rhat"]["w"]).max())
-    results[-1]["max_rhat"] = round(rhat, 4)
 
     # Persist all measurements BEFORE any convergence assertion — a
     # flaky chain must not discard minutes of completed configs.

@@ -170,24 +170,68 @@ class ZeroShardedLogpGrad:
         )
         return self.unravel(vec[: self.dim]), logps
 
-    def _build_sgd(self, num_steps: int):
+    def adam_steps(
+        self,
+        params: Any,
+        *,
+        learning_rate: float,
+        num_steps: int,
+        b1: float = 0.9,
+        b2: float = 0.999,
+        eps: float = 1e-8,
+    ) -> Tuple[Any, jax.Array]:
+        """Adam ascent with FULLY sharded optimizer state.
+
+        The first/second-moment vectors never exist whole anywhere:
+        each device carries only its 1/N slices, updated from its
+        psum_scatter'd gradient slice — the optimizer-state half of the
+        ZeRO recipe.  Returns ``(final_params, logp_trace)``.
+        """
+        fn = self._sgd_cache.get(("adam", num_steps, b1, b2, eps))
+        if fn is None:
+            fn = self._build_adam(num_steps, b1, b2, eps)
+            self._sgd_cache[("adam", num_steps, b1, b2, eps)] = fn
+        vec, logps = fn(
+            self.flatten(params), jnp.float32(learning_rate), self.data
+        )
+        return self.unravel(vec[: self.dim]), logps
+
+    def _build_loop(self, num_steps: int, init_opt_state, update_rule):
+        """Shared sharded-optimizer scaffold.
+
+        ``init_opt_state(slice_len, dtype) -> opt_state`` (per-device
+        slices); ``update_rule(opt_state, g_slice, my_slice, lr, t) ->
+        (new_opt_state, new_slice)`` runs purely on this device's 1/N
+        slices — the optimizer never sees a full vector.  ``t`` is a
+        1-indexed float32 step counter, independent of the parameter
+        dtype (a bf16 counter would stop representing integers past
+        256 and corrupt e.g. Adam's bias correction).
+        """
         axis = self.axis
         local_body = self._local_body
         slice_len = self.padded_dim // self.axis_size
 
         def local(vec0, lr, local_data):
-            def step(vec, _):
+            def step(carry, t):
+                vec, opt_state = carry
                 logp, g_slice = local_body(vec, local_data)
                 i = lax.axis_index(axis)
                 my_slice = lax.dynamic_slice_in_dim(
                     vec, i * slice_len, slice_len
                 )
-                new_slice = my_slice + lr * g_slice
-                vec = lax.all_gather(new_slice, axis, tiled=True)
-                return vec, logp
+                opt_state, new_slice = update_rule(
+                    opt_state, g_slice, my_slice, lr, t
+                )
+                vec = lax.all_gather(
+                    new_slice.astype(vec.dtype), axis, tiled=True
+                )
+                return (vec, opt_state), logp
 
             vec0 = mark_varying(vec0, axis)
-            vec, logps = lax.scan(step, vec0, None, length=num_steps)
+            ts = jnp.arange(1, num_steps + 1, dtype=jnp.float32)
+            (vec, _), logps = lax.scan(
+                step, (vec0, init_opt_state(slice_len, vec0.dtype)), ts
+            )
             return vec, logps
 
         # check_vma=False: the carried vec is rebuilt by all_gather each
@@ -196,7 +240,7 @@ class ZeroShardedLogpGrad:
         # situation as parallel/multichain.py).  Correctness of the
         # cross-shard reduction is carried by the explicit psum /
         # psum_scatter / all_gather collectives, and pinned by the
-        # equality-with-replicated-path test.
+        # equality-with-replicated-path tests.
         return jax.jit(
             shard_map(
                 local,
@@ -206,3 +250,25 @@ class ZeroShardedLogpGrad:
                 check_vma=False,
             )
         )
+
+    def _build_sgd(self, num_steps: int):
+        def update(state, g, my_slice, lr, t):
+            return state, my_slice + lr * g
+
+        return self._build_loop(num_steps, lambda n, dt: (), update)
+
+    def _build_adam(self, num_steps: int, b1: float, b2: float, eps: float):
+        def init(slice_len, dtype):
+            z = jnp.zeros((slice_len,), jnp.float32)
+            return (z, z)
+
+        def update(state, g, my_slice, lr, t):
+            m, v = state
+            g = g.astype(jnp.float32)
+            m = b1 * m + (1.0 - b1) * g
+            v = b2 * v + (1.0 - b2) * g * g
+            mhat = m / (1.0 - b1**t)  # t: float32, 1-indexed
+            vhat = v / (1.0 - b2**t)
+            return (m, v), my_slice + lr * mhat / (jnp.sqrt(vhat) + eps)
+
+        return self._build_loop(num_steps, init, update)
