@@ -158,6 +158,26 @@ class FederatedLogp:
 
     __call__ = logp
 
+    def logp_batch(self, params_batch: Any) -> jax.Array:
+        """Evaluate B parameter sets in ONE program: leaves carry a
+        leading batch axis; returns ``(B,)`` logps.
+
+        The reference serves many concurrent clients by multiplexing
+        streams over the connection pool (reference: service.py:104-112,
+        test_service.py:180-224); on-mesh the same fan-in is a vmap over
+        the parameter batch — one executable, MXU-batched.  (The SMC and
+        ensemble samplers batch the same way over their own flattened
+        evaluators; this method is the public entry for user-driven
+        particle/population sweeps.)
+        """
+        fn = getattr(self, "_logp_batch", None)
+        if fn is None:
+            fn = jax.jit(
+                jax.vmap(lambda p: self._total_logp(p, self.data))
+            )
+            self._logp_batch = fn
+        return fn(params_batch)
+
     def per_shard_logps(self, params: Any) -> jax.Array:
         """Vector of per-shard contributions (diagnostic; the reference
         exposes these as individual node replies)."""

@@ -258,17 +258,18 @@ class ZeroShardedLogpGrad:
         return self._build_loop(num_steps, lambda n, dt: (), update)
 
     def _build_adam(self, num_steps: int, b1: float, b2: float, eps: float):
+        import optax  # lazy, like samplers.find_map (the [vi] extra)
+
+        # The library transform supplies the moment/bias-correction
+        # math; its state is a plain per-slice pytree, so it shards the
+        # same way the hand-rolled version did.
+        tx = optax.scale_by_adam(b1=b1, b2=b2, eps=eps, mu_dtype=jnp.float32)
+
         def init(slice_len, dtype):
-            z = jnp.zeros((slice_len,), jnp.float32)
-            return (z, z)
+            return tx.init(jnp.zeros((slice_len,), jnp.float32))
 
         def update(state, g, my_slice, lr, t):
-            m, v = state
-            g = g.astype(jnp.float32)
-            m = b1 * m + (1.0 - b1) * g
-            v = b2 * v + (1.0 - b2) * g * g
-            mhat = m / (1.0 - b1**t)  # t: float32, 1-indexed
-            vhat = v / (1.0 - b2**t)
-            return (m, v), my_slice + lr * mhat / (jnp.sqrt(vhat) + eps)
+            u, state = tx.update(g.astype(jnp.float32), state)
+            return state, my_slice + lr * u
 
         return self._build_loop(num_steps, init, update)

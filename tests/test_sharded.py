@@ -210,3 +210,26 @@ def test_x64_opt_in():
         np.testing.assert_allclose(
             float(out), float(fed32.logp(jnp.asarray(0.5))), rtol=1e-6
         )
+
+
+def test_logp_batch_matches_loop(mesh8):
+    """Batched parameter evaluation (the many-concurrent-clients analog,
+    reference: test_service.py:180-224) equals one-at-a-time evals."""
+    data = (jnp.arange(16.0).reshape(8, 2),)
+
+    def per_shard(p, d):
+        return -jnp.sum((d[0] - p["mu"]) ** 2) * p["s"]
+
+    batch = {
+        "mu": jnp.linspace(-1.0, 1.0, 5),
+        "s": jnp.linspace(0.5, 1.5, 5),
+    }
+    for mesh in (None, mesh8):
+        fed = FederatedLogp(per_shard, data, mesh=mesh)
+        got = fed.logp_batch(batch)
+        assert got.shape == (5,)
+        for i in range(5):
+            p = jax.tree_util.tree_map(lambda l: l[i], batch)
+            np.testing.assert_allclose(
+                float(got[i]), float(fed.logp(p)), rtol=1e-5
+            )
