@@ -200,19 +200,18 @@ class ZeroShardedLogpGrad:
         """Shared sharded-optimizer scaffold.
 
         ``init_opt_state(slice_len, dtype) -> opt_state`` (per-device
-        slices); ``update_rule(opt_state, g_slice, my_slice, lr, t) ->
+        slices); ``update_rule(opt_state, g_slice, my_slice, lr) ->
         (new_opt_state, new_slice)`` runs purely on this device's 1/N
-        slices — the optimizer never sees a full vector.  ``t`` is a
-        1-indexed float32 step counter, independent of the parameter
-        dtype (a bf16 counter would stop representing integers past
-        256 and corrupt e.g. Adam's bias correction).
+        slices — the optimizer never sees a full vector.  Step counts
+        (e.g. Adam bias correction) live inside opt_state (optax keeps
+        its own integer count there).
         """
         axis = self.axis
         local_body = self._local_body
         slice_len = self.padded_dim // self.axis_size
 
         def local(vec0, lr, local_data):
-            def step(carry, t):
+            def step(carry, _):
                 vec, opt_state = carry
                 logp, g_slice = local_body(vec, local_data)
                 i = lax.axis_index(axis)
@@ -220,7 +219,7 @@ class ZeroShardedLogpGrad:
                     vec, i * slice_len, slice_len
                 )
                 opt_state, new_slice = update_rule(
-                    opt_state, g_slice, my_slice, lr, t
+                    opt_state, g_slice, my_slice, lr
                 )
                 vec = lax.all_gather(
                     new_slice.astype(vec.dtype), axis, tiled=True
@@ -228,9 +227,11 @@ class ZeroShardedLogpGrad:
                 return (vec, opt_state), logp
 
             vec0 = mark_varying(vec0, axis)
-            ts = jnp.arange(1, num_steps + 1, dtype=jnp.float32)
             (vec, _), logps = lax.scan(
-                step, (vec0, init_opt_state(slice_len, vec0.dtype)), ts
+                step,
+                (vec0, init_opt_state(slice_len, vec0.dtype)),
+                None,
+                length=num_steps,
             )
             return vec, logps
 
@@ -252,13 +253,19 @@ class ZeroShardedLogpGrad:
         )
 
     def _build_sgd(self, num_steps: int):
-        def update(state, g, my_slice, lr, t):
+        def update(state, g, my_slice, lr):
             return state, my_slice + lr * g
 
         return self._build_loop(num_steps, lambda n, dt: (), update)
 
     def _build_adam(self, num_steps: int, b1: float, b2: float, eps: float):
-        import optax  # lazy, like samplers.find_map (the [vi] extra)
+        try:
+            import optax  # lazy, like samplers.find_map
+        except ModuleNotFoundError as e:  # pragma: no cover - env-dependent
+            raise ModuleNotFoundError(
+                "adam_steps requires optax (pip install "
+                "pytensor-federated-tpu[vi]); sgd_steps has no extra deps"
+            ) from e
 
         # The library transform supplies the moment/bias-correction
         # math; its state is a plain per-slice pytree, so it shards the
@@ -268,7 +275,7 @@ class ZeroShardedLogpGrad:
         def init(slice_len, dtype):
             return tx.init(jnp.zeros((slice_len,), jnp.float32))
 
-        def update(state, g, my_slice, lr, t):
+        def update(state, g, my_slice, lr):
             u, state = tx.update(g.astype(jnp.float32), state)
             return state, my_slice + lr * u
 

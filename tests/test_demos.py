@@ -27,15 +27,10 @@ def test_demo_model_main_local():
 def test_demo_node_main_parses():
     """Node CLI parses args without binding (smoke for the entry point:
     run_node_pool is exercised for real by test_e2e_remote's pool)."""
-    import argparse
+    import pytest
 
     from pytensor_federated_tpu.demos import demo_node
 
-    parser_main = demo_node.main
-    # argparse failure raises SystemExit != 0; bad flags must be caught.
-    try:
-        parser_main(["--ports"])  # missing value
-    except SystemExit as e:
-        assert e.code != 0
-    else:  # pragma: no cover
-        raise AssertionError("expected SystemExit for missing --ports value")
+    with pytest.raises(SystemExit) as e:
+        demo_node.main(["--ports"])  # missing value
+    assert e.value.code != 0

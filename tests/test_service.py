@@ -192,3 +192,34 @@ def test_arg_validation():
         ArraysToArraysServiceClient(
             "h", 1, hosts_and_ports=[("h", 1)]
         )
+
+
+def test_many_threads_one_client(node_pool):
+    """Concurrent evaluate() from many threads on ONE client object.
+
+    The connection cache keys on (client token, pid, thread id), so
+    every thread gets a private lock-step stream — interleaving two
+    threads on one stream would desynchronize the uuid correlation.
+    The reference guarantees this by the same construction
+    (reference: service.py:266-275); this hammers it for real.
+    (fork-context pools are deliberately not tested: grpcio's C core
+    is not fork-safe with live channels in the parent, unlike the
+    reference's pure-Python grpclib.)
+    """
+    import concurrent.futures
+
+    ports, _ = node_pool
+    client = ArraysToArraysServiceClient("127.0.0.1", ports[0])
+
+    def hammer(i):
+        x = np.array([1.0, float(i)])
+        logp, grad = client.evaluate(x)
+        # node computes -(x-3)^2 summed (see _quad_compute)
+        want = -(4.0 + (float(i) - 3.0) ** 2)
+        np.testing.assert_allclose(grad, -2.0 * (x - 3.0), rtol=1e-6)
+        return float(logp), want
+
+    with concurrent.futures.ThreadPoolExecutor(max_workers=8) as ex:
+        results = list(ex.map(hammer, range(32)))
+    for got, want in results:
+        np.testing.assert_allclose(got, want, rtol=1e-6)
