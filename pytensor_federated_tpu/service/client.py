@@ -135,18 +135,29 @@ class ClientPrivates:
 
 
 # Module-global cache so client objects survive pickling into worker
-# processes and reconnect lazily per process/thread
+# processes and reconnect lazily per process/thread/loop
 # (reference: _privates + thread_pid_id, service.py:266-275).
 # Keyed by a per-instance token rather than id(obj): CPython recycles
 # object addresses, so an id-keyed cache could hand a new client a dead
 # client's connection.  The token survives pickling, so a client copied
 # into a worker process keys the same logical identity there.
-_privates: Dict[Tuple[str, int, int], ClientPrivates] = {}
+# The key ALSO includes the driving event loop: a grpc.aio channel is
+# bound to the loop it was created on, and one thread can legally run
+# several loops over its lifetime (sync wrapper's cached loop, then
+# asyncio.run(...)) — reusing a channel across loops errors or hangs,
+# so each (client, process, thread, loop) owns its own connection.
+_privates: Dict[Tuple[str, int, int, int], ClientPrivates] = {}
 
 
 def thread_pid_id(obj) -> Tuple[str, int, int]:
     token = getattr(obj, "_cache_token", None) or str(id(obj))
     return (token, os.getpid(), threading.get_ident())
+
+
+def _conn_key(obj) -> Tuple[str, int, int, int]:
+    """Full cache key; must be computed inside the driving loop."""
+    loop_id = id(asyncio.get_running_loop())
+    return (*thread_pid_id(obj), loop_id)
 
 
 class ArraysToArraysServiceClient:
@@ -178,7 +189,7 @@ class ArraysToArraysServiceClient:
     # -- connection management -------------------------------------------
 
     async def _get_privates(self) -> ClientPrivates:
-        cid = thread_pid_id(self)
+        cid = _conn_key(self)
         privates = _privates.get(cid)
         if privates is None:
             privates = await ClientPrivates.connect_balanced(
@@ -188,7 +199,7 @@ class ArraysToArraysServiceClient:
         return privates
 
     async def _drop_privates(self) -> None:
-        cid = thread_pid_id(self)
+        cid = _conn_key(self)
         privates = _privates.pop(cid, None)
         if privates is not None:
             _log.warning(
@@ -198,13 +209,16 @@ class ArraysToArraysServiceClient:
 
     def __del__(self):
         # Best-effort stream teardown (reference: service.py:355-365).
-        cid = thread_pid_id(self)
-        privates = _privates.pop(cid, None)
-        if privates is not None and privates.stream is not None:
-            try:
-                privates.stream.cancel()
-            except Exception:
-                pass
+        # No loop is running here, so sweep every loop's entry for this
+        # (client, process, thread) identity.
+        prefix = thread_pid_id(self)
+        for cid in [k for k in _privates if k[:3] == prefix]:
+            privates = _privates.pop(cid, None)
+            if privates is not None and privates.stream is not None:
+                try:
+                    privates.stream.cancel()
+                except Exception:
+                    pass
 
     # -- evaluation -------------------------------------------------------
 

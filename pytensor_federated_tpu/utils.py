@@ -15,6 +15,7 @@ from __future__ import annotations
 import asyncio
 import math
 import os
+import threading
 from typing import Callable, Optional, Sequence, TypeVar
 
 T = TypeVar("T")
@@ -134,24 +135,33 @@ def argmin_none_or_func(
     return best_i
 
 
+_thread_loops = threading.local()
+
+
 def get_event_loop() -> asyncio.AbstractEventLoop:
-    """Return a usable asyncio event loop (create one if necessary).
+    """Return THIS thread's event loop (create and cache if necessary).
 
     Slimmed-down analog of reference utils.py:37-61.  The reference needs
     ``nest_asyncio`` because PyTensor's sync executor re-enters a running
     loop; our executor is XLA, so re-entrancy never happens on the compute
     path and this helper only serves the host transport's sync wrappers.
+
+    The cache is thread-local and stable across calls (the policy-based
+    lookup this replaces warns on 3.12+ and raises in non-main threads,
+    which previously made this helper mint a fresh loop per call
+    there).  Loop identity still matters for connection reuse — an aio
+    channel is bound to the loop it was created on — so the service
+    connection cache keys on (client, process, thread, loop)
+    (service/client.py: _conn_key); this helper only guarantees the
+    sync wrappers a stable private loop per thread.
     """
     try:
         return asyncio.get_running_loop()
     except RuntimeError:
         pass
-    try:
-        loop = asyncio.get_event_loop_policy().get_event_loop()
-        if loop.is_closed():
-            raise RuntimeError
-        return loop
-    except RuntimeError:
+    loop = getattr(_thread_loops, "loop", None)
+    if loop is None or loop.is_closed():
         loop = asyncio.new_event_loop()
         asyncio.set_event_loop(loop)
-        return loop
+        _thread_loops.loop = loop
+    return loop

@@ -21,6 +21,15 @@ from pytensor_federated_tpu.service import (
 )
 from pytensor_federated_tpu.service.client import _privates, thread_pid_id
 
+
+def _conn_of(client):
+    """The (sole) live connection for this client identity; the full
+    cache key also carries the driving loop id, so scan by prefix."""
+    prefix = thread_pid_id(client)
+    matches = [v for k, v in _privates.items() if k[:3] == prefix]
+    assert len(matches) == 1, f"expected one connection, got {len(matches)}"
+    return matches[0]
+
 BASE_PORT = 29500
 
 
@@ -110,7 +119,7 @@ def test_balanced_connect_picks_idle_server(node_pool):
     busy.evaluate(np.zeros(2))  # opens a stream -> n_clients=1 on ports[0]
     fresh = ArraysToArraysServiceClient(hosts_and_ports=hp)
     fresh.evaluate(np.zeros(2))
-    connected_port = _privates[thread_pid_id(fresh)].port
+    connected_port = _conn_of(fresh).port
     assert connected_port in ports[1:], (
         f"balanced connect chose the busy server {connected_port}"
     )
@@ -142,7 +151,7 @@ def test_failover_to_surviving_server(node_pool):
     hp = [("127.0.0.1", p) for p in ports]
     client = ArraysToArraysServiceClient(hosts_and_ports=hp, retries=3)
     client.evaluate(np.zeros(2))
-    first_port = _privates[thread_pid_id(client)].port
+    first_port = _conn_of(client).port
     idx = ports.index(first_port)
     victim = procs[idx]
     victim.terminate()
@@ -150,7 +159,7 @@ def test_failover_to_surviving_server(node_pool):
     try:
         logp, _ = client.evaluate(np.array([3.0]))  # must failover
         np.testing.assert_allclose(logp, 0.0)
-        second_port = _privates[thread_pid_id(client)].port
+        second_port = _conn_of(client).port
         assert second_port != first_port
     finally:
         # Respawn the victim and wait for readiness: the pool is
@@ -223,3 +232,25 @@ def test_many_threads_one_client(node_pool):
         results = list(ex.map(hammer, range(32)))
     for got, want in results:
         np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_channel_never_crosses_loops(node_pool):
+    """Mixed sync/async use on one thread: the sync wrapper's cached
+    loop and an asyncio.run loop must each get their OWN connection —
+    a grpc.aio channel driven from a foreign loop errors or hangs."""
+    import asyncio
+
+    from pytensor_federated_tpu.service.client import thread_pid_id
+
+    ports, _ = node_pool
+    client = ArraysToArraysServiceClient("127.0.0.1", ports[0])
+    logp1, _ = client.evaluate(np.array([1.0, 2.0]))  # sync (cached loop)
+
+    async def go():
+        return await client.evaluate_async(np.array([1.0, 2.0]))
+
+    logp2, _ = asyncio.run(go())  # fresh loop, same thread
+    np.testing.assert_allclose(logp1, logp2)
+    prefix = thread_pid_id(client)
+    keys = [k for k in _privates if k[:3] == prefix]
+    assert len(keys) == 2, keys  # one connection per loop

@@ -1,0 +1,118 @@
+"""L0 utility tests — mirrors the reference's test_utils.py
+(reference: test_utils.py:7-48 covers argmin-over-optionals and
+event-loop acquisition semantics)."""
+
+import asyncio
+
+import jax
+import numpy as np
+
+from pytensor_federated_tpu.utils import (
+    argmin_none_or_func,
+    force_cpu_backend,
+    get_event_loop,
+)
+
+
+class TestArgminNoneOrFunc:
+    def test_all_none(self):
+        assert argmin_none_or_func([None, None, None], lambda x: x) is None
+
+    def test_empty(self):
+        assert argmin_none_or_func([], lambda x: x) is None
+
+    def test_mixed(self):
+        # None entries are skipped, not treated as zero.
+        assert argmin_none_or_func([None, 5.0, 2.0, None, 9.0], lambda x: x) == 2
+
+    def test_key_function(self):
+        loads = [{"n": 3}, None, {"n": 1}, {"n": 2}]
+        assert argmin_none_or_func(loads, lambda l: l["n"]) == 2
+
+    def test_first_wins_ties(self):
+        assert argmin_none_or_func([1.0, 1.0], lambda x: x) == 0
+
+
+class TestGetEventLoop:
+    def test_returns_usable_loop(self):
+        loop = get_event_loop()
+        assert loop.run_until_complete(_answer()) == 42
+
+    def test_survives_closed_loop(self):
+        loop = get_event_loop()
+        loop.close()
+        loop2 = get_event_loop()
+        assert not loop2.is_closed()
+        assert loop2.run_until_complete(_answer()) == 42
+
+    def test_inside_running_loop_returns_it(self):
+        async def inner():
+            return get_event_loop() is asyncio.get_running_loop()
+
+        assert asyncio.run(inner())
+
+
+async def _answer():
+    return 42
+
+
+def test_force_cpu_backend_idempotent():
+    """Safe to call repeatedly; the session is already CPU-pinned
+    (conftest), so this must not disturb the running backend."""
+    force_cpu_backend()
+    force_cpu_backend()
+    assert jax.default_backend() == "cpu"
+    assert float(jax.numpy.ones(()).sum()) == 1.0
+
+
+def test_healthy_devices_and_get_load():
+    """Mesh-plane control surface: all virtual CPU devices are healthy
+    and report load stats (the GetLoad analog, reference:
+    service.py:88-96)."""
+    from pytensor_federated_tpu.parallel import get_load, healthy_devices
+
+    cpus = jax.devices("cpu")
+    alive = healthy_devices(cpus)
+    assert alive == list(cpus)
+    loads = get_load(cpus)
+    assert len(loads) == len(cpus)
+    for d, l in zip(cpus, loads):
+        assert l.device_id == d.id
+        assert l.platform == "cpu" 
+
+
+def test_find_reasonable_step_size_gaussian():
+    """On a standard Gaussian the heuristic lands in a sane bracket."""
+    import jax.numpy as jnp
+
+    from pytensor_federated_tpu.samplers import find_reasonable_step_size
+
+    lg = jax.value_and_grad(lambda x: -0.5 * jnp.sum(x**2))
+    eps = find_reasonable_step_size(
+        lambda x: lg(x),
+        jnp.zeros((4,)),
+        jax.random.PRNGKey(0),
+        jnp.ones((4,)),
+    )
+    assert 0.01 < float(eps) < 10.0
+
+
+def test_event_loop_stable_per_thread():
+    """The same thread must get the same loop across calls (an aio
+    channel is bound to its creation loop), and different threads must
+    get different loops."""
+    import threading
+
+    loops = {}
+
+    def grab(name):
+        l1 = get_event_loop()
+        l2 = get_event_loop()
+        loops[name] = (l1, l2)
+
+    t1 = threading.Thread(target=grab, args=("a",))
+    t2 = threading.Thread(target=grab, args=("b",))
+    t1.start(); t2.start(); t1.join(); t2.join()
+    assert loops["a"][0] is loops["a"][1]
+    assert loops["b"][0] is loops["b"][1]
+    assert loops["a"][0] is not loops["b"][0]
