@@ -78,18 +78,27 @@ async def get_loads_async(
 
 @dataclasses.dataclass
 class ClientPrivates:
-    """Non-picklable per-(client,process,thread) connection state
-    (reference: ClientPrivates, service.py:214-263)."""
+    """Non-picklable per-(client,process,thread,loop) connection state
+    (reference: ClientPrivates, service.py:214-263).  ``loop`` records
+    the aio loop the channel is bound to, so a cache hit can verify the
+    entry really belongs to the currently running loop (id(loop) in the
+    cache key can collide after a dead loop's address is recycled)."""
 
     host: str
     port: int
     channel: grpc.aio.Channel
     stream: Optional[grpc.aio.StreamStreamCall] = None
+    loop: Optional[asyncio.AbstractEventLoop] = None
 
     @staticmethod
     async def connect(host: str, port: int, *, use_stream: bool) -> "ClientPrivates":
         channel = grpc.aio.insecure_channel(f"{host}:{port}")
-        privates = ClientPrivates(host=host, port=port, channel=channel)
+        privates = ClientPrivates(
+            host=host,
+            port=port,
+            channel=channel,
+            loop=asyncio.get_running_loop(),
+        )
         if use_stream:
             method = channel.stream_stream(
                 EVALUATE_STREAM,
@@ -160,6 +169,30 @@ def _conn_key(obj) -> Tuple[str, int, int, int]:
     return (*thread_pid_id(obj), loop_id)
 
 
+def _cancel_stream(privates: Optional[ClientPrivates]) -> None:
+    """Best-effort teardown usable from any context: stream.cancel() is
+    loop-safe-ish; channel close must run on its own (possibly dead)
+    loop, so the channel is left to GC."""
+    if privates is not None and privates.stream is not None:
+        try:
+            privates.stream.cancel()
+        except Exception:
+            pass
+
+
+def _purge_dead_loop_entries() -> None:
+    """Evict entries whose loop has closed — each asyncio.run() leaves
+    its connections behind, and unbounded entries both leak channels
+    and set up id(loop) collisions.  Snapshot keys first (list() is
+    C-atomic) so concurrent threads mutating the dict can't break the
+    sweep."""
+    for cid in list(_privates):
+        privates = _privates.get(cid)
+        if privates is not None and privates.loop is not None and privates.loop.is_closed():
+            _privates.pop(cid, None)
+            _cancel_stream(privates)
+
+
 class ArraysToArraysServiceClient:
     """Sync+async evaluation client with balancing and failover
     (reference: ArraysToArraysServiceClient, service.py:326-423)."""
@@ -189,8 +222,15 @@ class ArraysToArraysServiceClient:
     # -- connection management -------------------------------------------
 
     async def _get_privates(self) -> ClientPrivates:
+        _purge_dead_loop_entries()
         cid = _conn_key(self)
         privates = _privates.get(cid)
+        if privates is not None and privates.loop is not asyncio.get_running_loop():
+            # id(loop) collision: a recycled address matched a dead
+            # loop's entry.  Never drive that channel from this loop.
+            _privates.pop(cid, None)
+            _cancel_stream(privates)
+            privates = None
         if privates is None:
             privates = await ClientPrivates.connect_balanced(
                 self.hosts_and_ports, use_stream=self.use_stream
@@ -210,15 +250,13 @@ class ArraysToArraysServiceClient:
     def __del__(self):
         # Best-effort stream teardown (reference: service.py:355-365).
         # No loop is running here, so sweep every loop's entry for this
-        # (client, process, thread) identity.
+        # (client, process, thread) identity.  Snapshot keys first:
+        # other threads may be inserting concurrently, and iterating
+        # the live dict from __del__ could raise mid-sweep.
         prefix = thread_pid_id(self)
-        for cid in [k for k in _privates if k[:3] == prefix]:
-            privates = _privates.pop(cid, None)
-            if privates is not None and privates.stream is not None:
-                try:
-                    privates.stream.cancel()
-                except Exception:
-                    pass
+        for cid in list(_privates):
+            if cid[:3] == prefix:
+                _cancel_stream(_privates.pop(cid, None))
 
     # -- evaluation -------------------------------------------------------
 

@@ -31,11 +31,13 @@ Layout (little-endian):
 
 from __future__ import annotations
 
+import json
 import struct
 import uuid as uuid_mod
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
+from numpy.lib.format import descr_to_dtype, dtype_to_descr
 
 MAGIC = b"NPW1"
 _FLAG_ERROR = 1
@@ -43,6 +45,29 @@ _FLAG_ERROR = 1
 
 class WireError(ValueError):
     """Malformed or unsupported wire payload."""
+
+
+def _tupleize(descr):
+    """JSON round-trip turns descr tuples into lists; restore them
+    recursively (field entries are tuples, nested shapes too)."""
+    if isinstance(descr, list):
+        if descr and isinstance(descr[0], (list, tuple)):
+            return [tuple(_tupleize(x) for x in f) for f in descr]
+        return tuple(_tupleize(x) for x in descr)
+    return descr
+
+
+def _parse_dtype(dt_bytes: bytes) -> np.dtype:
+    try:
+        dt_str = dt_bytes.decode("utf-8")
+        if dt_str.startswith("["):
+            # JSON-array descr = structured dtype; plain string otherwise.
+            return descr_to_dtype(_tupleize(json.loads(dt_str)))
+        return np.dtype(dt_str)
+    except (ValueError, TypeError, KeyError) as e:
+        # ValueError covers UnicodeDecodeError and json errors too —
+        # every corrupt-descriptor shape must surface as WireError.
+        raise WireError(f"bad dtype descriptor {dt_bytes!r}: {e}") from None
 
 
 def encode_arrays(
@@ -75,7 +100,16 @@ def encode_arrays(
             # NB: np.ascontiguousarray promotes 0-d to 1-d, so only call
             # it when actually needed (0-d is always contiguous).
             a = np.ascontiguousarray(a)
-        dt = a.dtype.str.encode("ascii")
+        # dtype_to_descr/descr_to_dtype are the official npy-format
+        # helpers: plain dtypes serialize as their ".str" (e.g. "<f4"),
+        # structured dtypes as their field descr (JSON-encoded here) —
+        # ".str" alone collapses records to opaque void ("|V15").
+        descr = dtype_to_descr(a.dtype)
+        dt = (
+            descr.encode("ascii")
+            if isinstance(descr, str)
+            else json.dumps(descr).encode("utf-8")
+        )
         parts.append(struct.pack("<H", len(dt)))
         parts.append(dt)
         parts.append(struct.pack("<B", a.ndim))
@@ -113,7 +147,7 @@ def decode_arrays(buf: bytes) -> Tuple[List[np.ndarray], bytes, Optional[str]]:
         try:
             (dtlen,) = struct.unpack_from("<H", buf, off)
             off += 2
-            dt = np.dtype(buf[off : off + dtlen].decode("ascii"))
+            dt = _parse_dtype(buf[off : off + dtlen])
             off += dtlen
             (ndim,) = struct.unpack_from("<B", buf, off)
             off += 1

@@ -162,6 +162,8 @@ def get_event_loop() -> asyncio.AbstractEventLoop:
     loop = getattr(_thread_loops, "loop", None)
     if loop is None or loop.is_closed():
         loop = asyncio.new_event_loop()
-        asyncio.set_event_loop(loop)
+        # Deliberately NOT asyncio.set_event_loop: this loop is private
+        # to the sync wrappers; installing it in the policy slot would
+        # clobber a loop the application registered for its own use.
         _thread_loops.loop = loop
     return loop

@@ -65,3 +65,23 @@ def test_truncated_rejected():
 def test_bad_uuid_length():
     with pytest.raises(WireError, match="uuid"):
         encode_arrays([], uuid=b"short")
+
+
+def test_invalid_utf8_dtype_is_wire_error():
+    """A bit-flipped dtype descriptor must fail as WireError, not leak
+    UnicodeDecodeError."""
+    import numpy as np
+    import pytest
+
+    from pytensor_federated_tpu.service.npwire import (
+        WireError,
+        decode_arrays,
+        encode_arrays,
+    )
+
+    enc = bytearray(encode_arrays([np.zeros(3, np.float32)]))
+    # dtype string starts right after header(26) + dtlen(2).
+    enc[28] = 0xFF
+    enc[29] = 0xFE
+    with pytest.raises(WireError):
+        decode_arrays(bytes(enc))

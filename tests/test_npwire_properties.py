@@ -1,0 +1,110 @@
+"""Property-based wire-format tests (hypothesis).
+
+The reference pins its wire format with a 7-case dtype matrix
+(reference: test_npproto.py:11-31); these properties cover the whole
+space: any numeric/structured/datetime dtype, any shape incl. 0-d and
+zero-length axes, any slicing (non-contiguity), and arbitrary byte
+mutations must either round-trip exactly or fail loudly as WireError —
+never return silently wrong arrays for a *truncated* payload.
+"""
+
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+from hypothesis.extra import numpy as hnp
+
+from pytensor_federated_tpu.service.npwire import (
+    WireError,
+    decode_arrays,
+    encode_arrays,
+)
+
+# Cap example counts: the suite runs this file alongside slow
+# distributed tests; 50 examples per property is plenty here.
+COMMON = settings(max_examples=50, deadline=None)
+
+_dtypes = st.one_of(
+    hnp.integer_dtypes(endianness="="),
+    hnp.unsigned_integer_dtypes(endianness="="),
+    hnp.floating_dtypes(endianness="=", sizes=(32, 64)),
+    hnp.complex_number_dtypes(endianness="="),
+    hnp.datetime64_dtypes(endianness="="),
+    hnp.timedelta64_dtypes(endianness="="),
+    st.just(np.dtype("bool")),
+)
+
+_arrays = _dtypes.flatmap(
+    lambda dt: hnp.arrays(
+        dtype=dt,
+        shape=hnp.array_shapes(min_dims=0, max_dims=4, min_side=0, max_side=8),
+    )
+)
+
+
+@COMMON
+@given(arrs=st.lists(_arrays, min_size=0, max_size=5))
+def test_roundtrip_any_arrays(arrs):
+    enc = encode_arrays(arrs)
+    dec, uuid, error = decode_arrays(enc)
+    assert error is None and len(uuid) == 16
+    assert len(dec) == len(arrs)
+    for a, b in zip(arrs, dec):
+        assert a.dtype == b.dtype
+        assert a.shape == b.shape
+        np.testing.assert_array_equal(a, b)
+
+
+@COMMON
+@given(arr=_arrays, data=st.data())
+def test_roundtrip_noncontiguous_views(arr, data):
+    if arr.ndim == 0 or arr.size == 0:
+        view = arr
+    else:
+        axis = data.draw(st.integers(0, arr.ndim - 1))
+        sl = [slice(None)] * arr.ndim
+        sl[axis] = slice(None, None, 2)
+        view = arr[tuple(sl)].T  # strided + transposed
+    (dec,), _, _ = decode_arrays(encode_arrays([view]))
+    np.testing.assert_array_equal(np.ascontiguousarray(view), dec)
+    assert dec.flags["C_CONTIGUOUS"] or dec.ndim == 0 or dec.size <= 1
+
+
+@COMMON
+@given(
+    arrs=st.lists(_arrays, min_size=1, max_size=3),
+    cut=st.floats(min_value=0.0, max_value=0.999),
+)
+def test_truncation_never_silently_wrong(arrs, cut):
+    """Any strict prefix decodes to WireError, not garbage arrays."""
+    enc = encode_arrays(arrs)
+    prefix = enc[: int(len(enc) * cut)]
+    if prefix == enc:  # pragma: no cover - cut<1 guarantees strict prefix
+        return
+    with pytest.raises(WireError):
+        decode_arrays(prefix)
+
+
+@COMMON
+@given(arrs=st.lists(_arrays, min_size=0, max_size=3), err=st.text(max_size=200))
+def test_error_frames_roundtrip(arrs, err):
+    dec, _, error = decode_arrays(encode_arrays(arrs, error=err))
+    assert error == err
+    assert len(dec) == len(arrs)
+
+
+def test_structured_dtype_roundtrip():
+    dt = np.dtype([("a", "<i4"), ("b", "<f8"), ("s", "S3")])
+    arr = np.array([(1, 2.5, b"xy"), (-3, 0.0, b"zzz")], dtype=dt)
+    (dec,), _, _ = decode_arrays(encode_arrays([arr]))
+    assert dec.dtype == dt
+    np.testing.assert_array_equal(arr, dec)
+
+
+def test_subarray_structured_dtype_roundtrip():
+    dt = np.dtype([("pos", "<f4", (3,)), ("id", "<i8")])
+    arr = np.zeros(4, dtype=dt)
+    arr["pos"] = np.arange(12.0).reshape(4, 3)
+    arr["id"] = [7, 8, 9, 10]
+    (dec,), _, _ = decode_arrays(encode_arrays([arr]))
+    assert dec.dtype == dt
+    np.testing.assert_array_equal(arr, dec)

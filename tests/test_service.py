@@ -254,3 +254,23 @@ def test_channel_never_crosses_loops(node_pool):
     prefix = thread_pid_id(client)
     keys = [k for k in _privates if k[:3] == prefix]
     assert len(keys) == 2, keys  # one connection per loop
+
+
+def test_closed_loop_entries_are_purged(node_pool):
+    """Each asyncio.run leaves a dead loop behind; its cache entry must
+    be evicted on the next connect instead of accumulating (and risking
+    an id(loop) collision handing a new loop a dead channel)."""
+    import asyncio
+
+    from pytensor_federated_tpu.service.client import thread_pid_id
+
+    ports, _ = node_pool
+    client = ArraysToArraysServiceClient("127.0.0.1", ports[0])
+    for _ in range(3):
+        asyncio.run(client.evaluate_async(np.array([1.0])))
+    # One more call triggers the purge sweep before connecting.
+    logp, _ = client.evaluate(np.array([2.0]))
+    np.testing.assert_allclose(float(logp), -1.0)
+    prefix = thread_pid_id(client)
+    live = [k for k in _privates if k[:3] == prefix]
+    assert len(live) == 1, live  # only the (live) sync-wrapper loop entry
