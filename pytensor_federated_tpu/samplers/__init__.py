@@ -2,6 +2,7 @@
 
 from .advi import ADVIResult, advi_fit
 from .convergence import effective_sample_size, split_rhat, summary
+from .predictive import posterior_predictive, prior_predictive
 from .ensemble import EnsembleResult, ensemble_sample
 from .hmc import HMCState, find_reasonable_step_size, hmc_init, hmc_step, leapfrog
 from .mcmc import SampleResult, find_map, sample
@@ -33,5 +34,7 @@ __all__ = [
     "metropolis_init",
     "metropolis_step",
     "nuts_step",
+    "posterior_predictive",
+    "prior_predictive",
     "sample",
 ]

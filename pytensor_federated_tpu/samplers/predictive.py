@@ -1,0 +1,74 @@
+"""Posterior/prior predictive sampling over on-device draws.
+
+The reference's users finish a PyMC workflow with
+``pm.sample_posterior_predictive`` over the trace their federated model
+produced; this is the on-device counterpart operating directly on
+:class:`~pytensor_federated_tpu.samplers.mcmc.SampleResult` pytrees
+(leading ``(chains, draws)`` axes).  The whole sweep is one vmapped
+executable: a per-draw simulator ``predictive_fn(params, key) -> data``
+runs across all (sub)sampled draws with split PRNG keys.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["posterior_predictive", "prior_predictive"]
+
+
+def _flatten_chain_draws(samples: Any) -> Any:
+    """(chains, draws, *event) -> (chains*draws, *event) per leaf."""
+    return jax.tree_util.tree_map(
+        lambda l: jnp.reshape(l, (-1,) + l.shape[2:]), samples
+    )
+
+
+def posterior_predictive(
+    predictive_fn: Callable[[Any, jax.Array], Any],
+    samples: Any,
+    key: jax.Array,
+    *,
+    num_draws: Optional[int] = None,
+) -> Any:
+    """Simulate data from every (or ``num_draws`` subsampled) posterior
+    draw.
+
+    ``predictive_fn(params, key)`` receives ONE parameter pytree (no
+    chain/draw axes) and a PRNG key, and returns simulated data;
+    ``samples`` is a pytree with leading ``(chains, draws)`` axes
+    (``SampleResult.samples``).  Returns the simulator output with a
+    single leading draws axis.  Subsampling (``num_draws``) picks
+    evenly spaced draws — cheaper than the full sweep and unbiased for
+    stationary chains.
+    """
+    flat = _flatten_chain_draws(samples)
+    total = jax.tree_util.tree_leaves(flat)[0].shape[0]
+    if num_draws is not None and num_draws < total:
+        idx = jnp.linspace(0, total - 1, num_draws).astype(jnp.int32)
+        flat = jax.tree_util.tree_map(lambda l: l[idx], flat)
+        total = num_draws
+    keys = jax.random.split(key, total)
+    # vmap only — a fresh jit wrapper here would re-trace on every call
+    # (each call makes a new closure); callers jit their outer step if
+    # they want one compiled sweep.
+    return jax.vmap(predictive_fn)(flat, keys)
+
+
+def prior_predictive(
+    sample_prior_fn: Callable[[jax.Array], Any],
+    predictive_fn: Callable[[Any, jax.Array], Any],
+    key: jax.Array,
+    *,
+    num_draws: int = 500,
+) -> Any:
+    """Simulate data from the prior: draw ``num_draws`` parameter sets
+    with ``sample_prior_fn(key) -> params`` and push each through
+    ``predictive_fn`` — one vmapped executable."""
+    def one(k):
+        kp, kd = jax.random.split(k)
+        return predictive_fn(sample_prior_fn(kp), kd)
+
+    return jax.vmap(one)(jax.random.split(key, num_draws))
