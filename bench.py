@@ -34,34 +34,13 @@ NORTH_STAR = 50_000.0
 
 
 def preflight() -> bool:
-    """One subprocess probe BEFORE this process initializes jax.
+    """One subprocess probe BEFORE this process initializes jax; falls
+    back to CPU on a wedged backend so the bench always reports a
+    number (see utils.ensure_live_backend for the full policy).
+    Returns whether compiled Mosaic may be used for the Pallas path."""
+    from pytensor_federated_tpu.utils import ensure_live_backend
 
-    Returns whether compiled Mosaic may be used for the Pallas path.
-    Two decisions come out of the single probe (one child, one backend
-    bring-up — single-host TPU runtimes are exclusive per process, so
-    the child must run before the parent holds the chip):
-
-    - dead/wedged backend (tunneled relays block PJRT client init
-      forever) -> restrict this process to CPU so the bench reports a
-      number instead of hanging the harness;
-    - Mosaic support.  On tunneled runtimes the Mosaic attempt itself
-      can wedge the chip for every later process — including the rest
-      of this benchmark — so there it stays opt-in
-      (PFTPU_PALLAS_COMPILED=1); on direct TPU runtimes it is probed by
-      default.
-    """
-    from pytensor_federated_tpu.utils import force_cpu_backend, probe_backend
-
-    tunneled = bool(os.environ.get("PALLAS_AXON_POOL_IPS"))
-    try_mosaic = (not tunneled) or (
-        os.environ.get("PFTPU_PALLAS_COMPILED") == "1"
-    )
-    live, mosaic_ok = probe_backend(try_mosaic=try_mosaic)
-    if not live:
-        print("# backend unresponsive -> CPU fallback", file=sys.stderr)
-        force_cpu_backend()
-        return False
-    return mosaic_ok
+    return ensure_live_backend()
 
 
 def make_chained(logp_and_grad_flat, n_evals):

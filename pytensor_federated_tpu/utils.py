@@ -90,6 +90,51 @@ def probe_backend(
     return "LIVE" in out, "MOSAIC_OK" in out
 
 
+def ensure_live_backend(
+    *, try_mosaic: Optional[bool] = None, timeout_s: float = 150.0
+) -> bool:
+    """Preflight the default backend; fall back to CPU if it is wedged.
+
+    Call BEFORE this process initializes jax.  Returns whether compiled
+    Mosaic may be used for Pallas kernels.  Behavior:
+
+    - ``JAX_PLATFORMS=cpu`` (the documented CPU dry-run env): force the
+      CPU backend directly — probing a CPU child reports LIVE
+      regardless of TPU state, so it would cost a subprocess jax import
+      to learn nothing.
+    - Tunneled runtimes (``PALLAS_AXON_POOL_IPS`` set): probe liveness
+      in a subprocess under a timeout (a wedged relay blocks PJRT
+      client init forever); the Mosaic attempt itself can wedge the
+      relay for later processes, so there it defaults to opt-in via
+      ``PFTPU_PALLAS_COMPILED=1``.
+    - Direct runtimes: probe, attempting Mosaic by default.
+
+    On a dead backend, prints a diagnostic to stderr and restricts this
+    process to CPU so the caller still runs instead of hanging.
+    """
+    import sys
+
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        force_cpu_backend()
+        return False
+    tunneled = bool(os.environ.get("PALLAS_AXON_POOL_IPS"))
+    if try_mosaic is None:
+        try_mosaic = (not tunneled) or (
+            os.environ.get("PFTPU_PALLAS_COMPILED") == "1"
+        )
+    if not tunneled and not try_mosaic:
+        # Nothing to learn: only tunneled runtimes wedge at client
+        # init, and the caller doesn't want the Mosaic answer — skip
+        # the subprocess jax bring-up entirely.
+        return False
+    live, mosaic_ok = probe_backend(try_mosaic=try_mosaic, timeout_s=timeout_s)
+    if not live:
+        print("# backend unresponsive -> CPU fallback", file=sys.stderr)
+        force_cpu_backend()
+        return False
+    return mosaic_ok
+
+
 def force_cpu_backend(plugin: str = "axon") -> None:
     """Restrict this process to the CPU backend without dialing ``plugin``.
 
