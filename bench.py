@@ -22,7 +22,6 @@ compare against (the reference publishes none, BASELINE.md).
 """
 
 import json
-import os
 import sys
 import time
 
@@ -43,32 +42,79 @@ def preflight() -> bool:
     return ensure_live_backend()
 
 
-def make_chained(logp_and_grad_flat, n_evals):
+def make_chained(logp_and_grad_flat):
+    """Dynamic-length sequential chain: ``chained(x0, n)`` runs ``n``
+    dependent evals.  The trip count is a *traced* argument (fori_loop
+    lowers to while_loop), so ONE compile serves every chain length —
+    on the TPU each distinct static length would otherwise cost a
+    20-40 s remote compile per sizing stage."""
+
     @jax.jit
-    def chained(x0):
+    def chained(x0, n):
         """Sequential dependent evals — no pipelining tricks: each step
         consumes the previous gradient, like a leapfrog integrator."""
 
-        def body(carry, _):
+        def body(_i, carry):
             x, acc = carry
             v, g = logp_and_grad_flat(x)
             # tiny dependent update keeps the chain honest (not DCE-able)
-            x = x + 1e-6 * g
-            return (x, acc + v), None
+            return (x + 1e-6 * g, acc + v)
 
-        (x, acc), _ = jax.lax.scan(body, (x0, 0.0), None, length=n_evals)
-        return x, acc
+        return jax.lax.fori_loop(0, n, body, (x0, 0.0))
 
     return chained
 
 
-def time_chain(fn, x0):
-    out = fn(x0)  # compile + warm
-    jax.block_until_ready(out)
+def time_chain(chained, x0, n, *, warm=True):
+    """Wall time of one ``chained(x0, n)`` run.  ``warm=True`` runs once
+    first (compile + cache warm); pass ``warm=False`` when the runner's
+    executable is already warm from a previous stage."""
+    if warm:
+        jax.block_until_ready(chained(x0, jnp.asarray(n, jnp.int32)))
     t0 = time.perf_counter()
-    out = fn(x0)
+    out = chained(x0, jnp.asarray(n, jnp.int32))
     jax.block_until_ready(out)
     return time.perf_counter() - t0
+
+
+def measure_rate(
+    chained,
+    flat0,
+    *,
+    per_eval0: float = None,
+    n_cal: int = 2_000,
+    floor: int = 20_000,
+    mid_wall: float = 1.0,
+    target_wall: float = 3.5,
+):
+    """Steady-state evals/s of a ``make_chained`` runner, with two-stage
+    sizing: the short calibration chain is dominated by dispatch/launch
+    overhead (on TPU a 2k-step chain reads ~3x slower than steady
+    state), so re-measure at ``mid_wall`` seconds using the calibrated
+    rate, then size the final chain from the *measured* rate to a
+    ``target_wall`` wall — long enough that the loop's amortized
+    per-iteration cost, not host dispatch, is what's rated.  Every stage
+    reuses the runner's one compiled executable (dynamic trip count).
+
+    ``per_eval0``: optional pre-measured seconds/eval from an earlier
+    calibration (bench.py's candidate race) — skips the internal
+    calibration stage; the caller must have already run ``chained``
+    once (its executable is assumed compiled and warm).
+
+    Shared by bench.py (driver metric) and bench_suite.py so the two
+    benchmarks can never diverge in sizing methodology.  Returns
+    ``(evals_per_sec, n_evals, wall_seconds)``.
+    """
+    if per_eval0 is None:
+        per_eval0 = time_chain(chained, flat0, n_cal) / n_cal
+    n_mid = max(floor, int(mid_wall / max(per_eval0, 1e-9)))
+    wall_mid = time_chain(chained, flat0, n_mid, warm=False)
+    per_eval = wall_mid / n_mid
+    n = max(n_mid, int(target_wall / max(per_eval, 1e-9)))
+    if n == n_mid:  # target already met; a re-run would add no information
+        return n_mid / wall_mid, n_mid, wall_mid
+    wall = time_chain(chained, flat0, n, warm=False)
+    return n / wall, n, wall
 
 
 def main():
@@ -148,21 +194,18 @@ def main():
 
     # Calibrate on a short chain, pick the winner.
     n_cal = 2_000
+    runners = {name: make_chained(fn) for name, fn in candidates.items()}
     cal = {
-        name: time_chain(make_chained(fn, n_cal), flat0)
-        for name, fn in candidates.items()
+        name: time_chain(runner, flat0, n_cal)
+        for name, runner in runners.items()
     }
     best = min(cal, key=cal.get)
     for name, t in cal.items():
         print(f"# calib {name}: {n_cal / t:,.0f} evals/s", file=sys.stderr)
 
-    # Size the measured chain so the wall clock is long enough to trust
-    # (>= ~0.5 s): with a fast impl a fixed 20k-step chain finishes in
-    # milliseconds and the number is all timer noise.
-    per_eval = cal[best] / n_cal
-    n_evals = max(20_000, int(0.5 / max(per_eval, 1e-9)))
-    wall = time_chain(make_chained(candidates[best], n_evals), flat0)
-    evals_per_sec = n_evals / wall
+    evals_per_sec, n_evals, wall = measure_rate(
+        runners[best], flat0, per_eval0=cal[best] / n_cal
+    )
 
     print(
         json.dumps(

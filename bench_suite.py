@@ -18,15 +18,22 @@ import json
 import sys
 import time
 
-from bench import NORTH_STAR, make_chained, preflight, time_chain
+from bench import NORTH_STAR, make_chained, measure_rate, preflight
 
 
-def _rate(fn_flat, flat0, *, n_target_s: float = 0.3):
-    n_cal = 500
-    t = time_chain(make_chained(fn_flat, n_cal), flat0)
-    n = max(2_000, int(n_target_s / max(t / n_cal, 1e-9)))
-    wall = time_chain(make_chained(fn_flat, n), flat0)
-    return n / wall, n
+def _rate(fn_flat, flat0):
+    # Same two-stage sizing as the driver metric (bench.measure_rate),
+    # with lighter floors/targets so five configs stay quick.  One
+    # compile per config (dynamic trip count serves all three stages).
+    r, n, _wall = measure_rate(
+        make_chained(fn_flat),
+        flat0,
+        n_cal=500,
+        floor=2_000,
+        mid_wall=0.3,
+        target_wall=1.0,
+    )
+    return r, n
 
 
 def _flat(model):
