@@ -31,6 +31,7 @@ from .ring import (
     shift_right_across_shards,
 )
 from .sharded import FederatedLogp, sharded_compute
+from .ulysses import heads_to_seq, seq_to_heads, ulysses_attention
 from .zero import ScatteredGrads, ZeroShardedLogpGrad
 
 __all__ = [
@@ -47,6 +48,9 @@ __all__ = [
     "ring_shift",
     "seq_sharded_markov_logp",
     "shift_right_across_shards",
+    "heads_to_seq",
+    "seq_to_heads",
+    "ulysses_attention",
     "fedavg",
     "federated_broadcast",
     "federated_map",

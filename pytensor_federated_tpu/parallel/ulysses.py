@@ -1,0 +1,131 @@
+"""All-to-all (Ulysses-style) sequence parallelism.
+
+The second of the two standard sequence/context-parallel schemes (the
+first, ring attention, lives in :mod:`.ring`).  Net-new relative to the
+reference, which has no sequence axis at all (SURVEY §5: "long context /
+sequence parallelism: N/A"); together the two modules make long
+sequences a first-class scale axis of this framework.
+
+Scheme: ``q, k, v`` of global shape ``(T, H, d)`` arrive sharded along
+the sequence axis (each device holds ``(T/n, H, d)``).  One
+``lax.all_to_all`` re-shards them to *head* sharding — every device now
+holds the FULL sequence for ``H/n`` heads — so each head's attention is
+an ordinary dense (or blockwise) local computation with no further
+communication.  A second ``all_to_all`` moves the output back to
+sequence sharding.
+
+Trade-off vs. ring attention (when to use which):
+
+- **Communication**: Ulysses does 2 all-to-alls moving ``O(T·H·d / n)``
+  per device regardless of ring size; ring attention does ``n-1``
+  neighbour hops moving the K/V block each step.  All-to-all rides the
+  ICI torus in one fused collective and usually wins at moderate ``n``.
+- **Memory**: Ulysses materializes per-head ``T×T`` scores locally (or
+  needs a local flash kernel); ring attention never holds more than a
+  ``(T/n)²`` block.  For very long ``T``, ring wins.
+- **Constraint**: Ulysses needs ``H % n == 0`` (heads are the second
+  shard axis); ring attention has no head-count constraint.
+
+Both are exact (same numbers as dense softmax attention) and
+differentiable — the VJP of ``all_to_all`` is the inverse ``all_to_all``,
+which XLA derives automatically.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .mesh import SEQ_AXIS
+
+
+def seq_to_heads(x: jax.Array, axis_name: str) -> jax.Array:
+    """Re-shard ``(T/n, H, d)``-local (sequence-sharded) to
+    ``(T, H/n, d)``-local (head-sharded) with one ``all_to_all``.
+
+    Must be called inside ``shard_map`` over ``axis_name``.  Head chunk
+    ``j`` of every device travels to device ``j``; received sequence
+    blocks concatenate in source-device order, which is global sequence
+    order because device ``i`` owns contiguous block ``i``.
+    """
+    return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=0, tiled=True)
+
+
+def heads_to_seq(x: jax.Array, axis_name: str) -> jax.Array:
+    """Inverse of :func:`seq_to_heads`: ``(T, H/n, d)``-local back to
+    ``(T/n, H, d)``-local.  Heads concatenate in source-device order,
+    restoring the global head order."""
+    return lax.all_to_all(x, axis_name, split_axis=0, concat_axis=1, tiled=True)
+
+
+def _dense_heads_attention(q, k, v, *, causal: bool):
+    """Per-head dense softmax attention; ``q, k, v``: (T, Hl, d)."""
+    t = q.shape[0]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], q.dtype))
+    # (Hl, T, T) scores; heads moved to front for the matmul batch dim.
+    s = jnp.einsum("thd,shd->hts", q, k) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((t, t), dtype=bool))
+        s = jnp.where(mask[None, :, :], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("hts,shd->thd", p, v)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mesh: Mesh,
+    axis: str = SEQ_AXIS,
+    causal: bool = False,
+) -> jax.Array:
+    """Exact multi-head attention over a sequence sharded along ``axis``.
+
+    ``q, k, v``: global shape ``(T, H, d)``, partitioned on ``T``.
+    Requires ``T % n == 0`` and ``H % n == 0`` for ``n`` devices on the
+    axis.  Returns the attention output, same shape/sharding as ``q``.
+
+    See the module docstring for the communication/memory trade-off
+    against :func:`..ring.ring_attention` (which handles the
+    single-head / head-count-indivisible cases).
+    """
+    if axis not in mesh.axis_names:
+        raise ValueError(f"mesh has no axis {axis!r}: {mesh.axis_names}")
+    n = mesh.shape[axis]
+    if q.shape != k.shape or q.shape != v.shape:
+        raise ValueError(f"q/k/v shapes differ: {q.shape} {k.shape} {v.shape}")
+    if q.ndim != 3:
+        raise ValueError(f"expected (T, H, d) inputs, got shape {q.shape}")
+    t, h = q.shape[0], q.shape[1]
+    if t % n != 0:
+        raise ValueError(f"sequence length {t} not divisible by {n} devices")
+    if h % n != 0:
+        raise ValueError(
+            f"head count {h} not divisible by {n} devices "
+            "(use ring_attention for head-count-indivisible layouts)"
+        )
+    return _ulysses_jitted(mesh, axis, causal)(q, k, v)
+
+
+@functools.lru_cache(maxsize=64)
+def _ulysses_jitted(mesh, axis, causal):
+    def local(q_local, k_local, v_local):
+        qh = seq_to_heads(q_local, axis)
+        kh = seq_to_heads(k_local, axis)
+        vh = seq_to_heads(v_local, axis)
+        o = _dense_heads_attention(qh, kh, vh, causal=causal)
+        return heads_to_seq(o, axis)
+
+    return jax.jit(
+        shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(axis), P(axis), P(axis)),
+            out_specs=P(axis),
+        )
+    )
