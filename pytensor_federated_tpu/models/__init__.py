@@ -5,11 +5,21 @@ from .gp import FederatedSparseGP, dense_vfe_logp, generate_gp_data
 from .linear import FederatedLinearRegression, generate_node_data
 from .logistic import FederatedLogisticRegression, generate_logistic_data
 from .ode import LotkaVolterraModel, generate_lv_data, make_lv_model, rk4_integrate
+from .statespace import (
+    SeqShardedLGSSM,
+    generate_lgssm_data,
+    kalman_logp_parallel,
+    kalman_logp_seq,
+)
 from .timeseries import SeqShardedAR1, generate_ar1_data
 
 __all__ = [
     "FederatedSparseGP",
     "SeqShardedAR1",
+    "SeqShardedLGSSM",
+    "generate_lgssm_data",
+    "kalman_logp_parallel",
+    "kalman_logp_seq",
     "dense_vfe_logp",
     "generate_ar1_data",
     "generate_gp_data",

@@ -1,0 +1,137 @@
+"""Linear-Gaussian state-space models (models/statespace.py).
+
+Golden model at two levels (pattern from test_demo_node.py:29-65 in the
+reference): (1) the exact joint-Gaussian marginal likelihood computed by
+building the full TxT observation covariance — ground truth for the
+sequential filter; (2) the sequential filter — ground truth for the
+associative-scan and sequence-sharded paths.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytensor_federated_tpu.models.statespace import (
+    SeqShardedLGSSM,
+    generate_lgssm_data,
+    kalman_logp_parallel,
+    kalman_logp_seq,
+)
+from pytensor_federated_tpu.parallel import make_mesh
+
+
+def dense_joint_logp(params, y):
+    """Exact marginal: y ~ N(mu, Sigma) with the joint Gaussian built
+    densely — O(T^2 d^2) memory, only viable for tiny T."""
+    F = np.asarray(params["F"], np.float64)
+    H = np.asarray(params["H"], np.float64)
+    d = F.shape[0]
+    k = H.shape[0]
+    Q = np.exp(float(params["log_q"])) * np.eye(d)
+    R = np.exp(float(params["log_r"])) * np.eye(k)
+    m0 = np.asarray(params["m0"], np.float64)
+    P0 = np.eye(d)
+    T = y.shape[0]
+    # Latent joint moments via the recursion z_t = F z_{t-1} + w_t.
+    means = []
+    m = m0
+    for _ in range(T):
+        m = F @ m
+        means.append(m)
+    # Cov[z_s, z_t] built forward.
+    covz = np.zeros((T, T, d, d))
+    Pprev = P0
+    for t in range(T):
+        Pt = F @ Pprev @ F.T + Q
+        covz[t, t] = Pt
+        for s in range(t + 1, T):
+            covz[t, s] = covz[t, s - 1] @ F.T
+            covz[s, t] = covz[t, s].T
+        Pprev = Pt
+    mu = np.concatenate([H @ mi for mi in means])
+    Sigma = np.zeros((T * k, T * k))
+    for s in range(T):
+        for t in range(T):
+            Sigma[s * k : (s + 1) * k, t * k : (t + 1) * k] = (
+                H @ covz[s, t] @ H.T
+            )
+    Sigma[np.diag_indices(T * k)] += np.exp(float(params["log_r"]))
+    yf = np.asarray(y, np.float64).reshape(-1)
+    diff = yf - mu
+    sign, logdet = np.linalg.slogdet(Sigma)
+    assert sign > 0
+    return float(
+        -0.5 * diff @ np.linalg.solve(Sigma, diff)
+        - 0.5 * logdet
+        - 0.5 * T * k * np.log(2 * np.pi)
+    )
+
+
+class TestKalmanSequential:
+    def test_matches_dense_joint(self):
+        y, params = generate_lgssm_data(T=6)
+        lp = float(kalman_logp_seq(params, y))
+        ref = dense_joint_logp(params, y)
+        np.testing.assert_allclose(lp, ref, rtol=1e-4)
+
+
+class TestKalmanParallel:
+    def test_matches_sequential(self):
+        y, params = generate_lgssm_data(T=64)
+        lp_seq = float(kalman_logp_seq(params, y))
+        lp_par = float(kalman_logp_parallel(params, y))
+        np.testing.assert_allclose(lp_par, lp_seq, rtol=1e-4)
+
+    def test_gradients_match(self):
+        y, params = generate_lgssm_data(T=32)
+        g_seq = jax.grad(lambda p: kalman_logp_seq(p, y))(params)
+        g_par = jax.grad(lambda p: kalman_logp_parallel(p, y))(params)
+        for key in params:
+            np.testing.assert_allclose(
+                np.asarray(g_par[key]),
+                np.asarray(g_seq[key]),
+                rtol=1e-3,
+                atol=1e-4,
+                err_msg=key,
+            )
+
+
+class TestSeqSharded:
+    @pytest.fixture(scope="class")
+    def seq_mesh(self, devices8):
+        return make_mesh({"seq": 4}, devices=devices8[:4])
+
+    def test_matches_sequential(self, seq_mesh):
+        y, params = generate_lgssm_data(T=64)
+        model = SeqShardedLGSSM(y, mesh=seq_mesh, axis="seq")
+        lp = float(model.logp(params))
+        ref = float(kalman_logp_seq(params, y))
+        np.testing.assert_allclose(lp, ref, rtol=1e-4)
+
+    def test_logp_and_grad(self, seq_mesh):
+        y, params = generate_lgssm_data(T=64)
+        model = SeqShardedLGSSM(y, mesh=seq_mesh, axis="seq")
+        v, g = model.logp_and_grad(params)
+        ref_g = jax.grad(lambda p: kalman_logp_seq(p, y))(params)
+        np.testing.assert_allclose(
+            float(v), float(kalman_logp_seq(params, y)), rtol=1e-4
+        )
+        for key in params:
+            np.testing.assert_allclose(
+                np.asarray(g[key]),
+                np.asarray(ref_g[key]),
+                rtol=1e-3,
+                atol=1e-4,
+                err_msg=key,
+            )
+
+    def test_indivisible_raises(self, seq_mesh):
+        y, _ = generate_lgssm_data(T=30)
+        with pytest.raises(ValueError, match="not divisible"):
+            SeqShardedLGSSM(y, mesh=seq_mesh, axis="seq")
+
+    def test_bad_axis_raises(self, seq_mesh):
+        y, _ = generate_lgssm_data(T=64)
+        with pytest.raises(ValueError, match="no axis"):
+            SeqShardedLGSSM(y, mesh=seq_mesh, axis="nope")
