@@ -10,6 +10,8 @@ from .statespace import (
     generate_lgssm_data,
     kalman_logp_parallel,
     kalman_logp_seq,
+    kalman_smoother_parallel,
+    kalman_smoother_seq,
 )
 from .timeseries import SeqShardedAR1, generate_ar1_data
 
@@ -20,6 +22,8 @@ __all__ = [
     "generate_lgssm_data",
     "kalman_logp_parallel",
     "kalman_logp_seq",
+    "kalman_smoother_parallel",
+    "kalman_smoother_seq",
     "dense_vfe_logp",
     "generate_ar1_data",
     "generate_gp_data",

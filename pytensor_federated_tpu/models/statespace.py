@@ -226,9 +226,91 @@ def _predictive_logp(F, H, Q, R, m0, P0, y, means, covs):
 def kalman_logp_parallel(params: Any, y: jax.Array) -> jax.Array:
     """Marginal log-likelihood with O(log T)-depth associative scan."""
     F, H, Q, R, m0, P0 = _unpack(params)
+    means, covs = _filtered_moments(params, y)
+    return _predictive_logp(F, H, Q, R, m0, P0, y, means, covs)
+
+
+# ---------------------------------------------------------------------------
+# Smoothing (RTS): sequential golden + parallel associative scan
+# ---------------------------------------------------------------------------
+
+
+def _filtered_moments(params, y):
+    """All filtered means/covs via the associative scan."""
+    F, H, Q, R, m0, P0 = _unpack(params)
     elems = _filter_elements(F, H, Q, R, m0, P0, y)
     _, means, covs, _, _ = lax.associative_scan(_combine, elems)
-    return _predictive_logp(F, H, Q, R, m0, P0, y, means, covs)
+    return means, covs
+
+
+def kalman_smoother_seq(params: Any, y: jax.Array):
+    """Smoothed marginals ``(means, covs)`` via the classic backward
+    Rauch-Tung-Striebel recursion (golden reference; O(T) depth)."""
+    F, H, Q, R, m0, P0 = _unpack(params)
+    means, covs = _filtered_moments(params, y)
+
+    def back(carry, mc):
+        ms_next, Ps_next = carry
+        m, Pcov = mc
+        Pp = F @ Pcov @ F.T + Q
+        G = jnp.linalg.solve(Pp, F @ Pcov).T
+        ms = m + G @ (ms_next - F @ m)
+        Ps = Pcov + G @ (Ps_next - Pp) @ G.T
+        return (ms, Ps), (ms, Ps)
+
+    last = (means[-1], covs[-1])
+    _, (sm, sP) = lax.scan(
+        back, last, (means[:-1], covs[:-1]), reverse=True
+    )
+    sm = jnp.concatenate([sm, means[-1:]], axis=0)
+    sP = jnp.concatenate([sP, covs[-1:]], axis=0)
+    return sm, sP
+
+
+def _smooth_elements(F, Q, means, covs):
+    """Per-step smoothing elements ``(E, g, L)``: the backward kernel
+    ``z_t | z_{t+1} ~ N(E_t z_{t+1} + g_t, L_t)`` for t < T, and the
+    filtered terminal ``(0, m_T, P_T)`` at T."""
+
+    def one(m, Pcov):
+        Pp = F @ Pcov @ F.T + Q
+        G = jnp.linalg.solve(Pp, F @ Pcov).T
+        E = G
+        g = m - G @ (F @ m)
+        L = Pcov - G @ Pp @ G.T
+        return E, g, L
+
+    E, g, L = jax.vmap(one)(means, covs)
+    d = F.shape[0]
+    E = E.at[-1].set(jnp.zeros((d, d), F.dtype))
+    g = g.at[-1].set(means[-1])
+    L = L.at[-1].set(covs[-1])
+    return E, g, L
+
+
+def _smooth_combine(e1, e2):
+    """Associative composition of backward kernels (e1 earlier)."""
+    E1, g1, L1 = e1
+    E2, g2, L2 = e2
+    E = E1 @ E2
+    g = (E1 @ g2[..., None])[..., 0] + g1
+    L = E1 @ L2 @ jnp.swapaxes(E1, -1, -2) + L1
+    return E, g, L
+
+
+def kalman_smoother_parallel(params: Any, y: jax.Array):
+    """Smoothed marginals with O(log T)-depth associative scans (one
+    forward for filtering, one reverse for smoothing)."""
+    F, H, Q, R, m0, P0 = _unpack(params)
+    means, covs = _filtered_moments(params, y)
+    elems = _smooth_elements(F, Q, means, covs)
+    # reverse=True passes the accumulated *suffix* (the later
+    # composition) as the first argument; _smooth_combine expects
+    # (earlier, later), so flip.
+    _, sm, sP = lax.associative_scan(
+        lambda a, b: _smooth_combine(b, a), elems, reverse=True
+    )
+    return sm, sP
 
 
 # ---------------------------------------------------------------------------
@@ -236,7 +318,7 @@ def kalman_logp_parallel(params: Any, y: jax.Array) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(eq=False)
 class SeqShardedLGSSM:
     """LGSSM likelihood with the time axis sharded over ``axis``.
 
@@ -270,12 +352,18 @@ class SeqShardedLGSSM:
                 f"sequence length {self.y.shape[0]} not divisible by {n}"
             )
         self._logp = _sharded_lgssm_logp(self.mesh, self.axis)
+        # Cache the fused pair once (pattern from timeseries.SeqShardedAR1)
+        # so per-step sampler/optimizer calls hit a compiled executable
+        # instead of re-tracing the distributed filter.
+        self._logp_and_grad = jax.jit(
+            jax.value_and_grad(lambda p, y: self._logp(p, y))
+        )
 
     def logp(self, params: Any) -> jax.Array:
         return self._logp(params, self.y)
 
     def logp_and_grad(self, params: Any):
-        return jax.value_and_grad(self._logp)(params, self.y)
+        return self._logp_and_grad(params, self.y)
 
     def init_params(self, d: int = 2) -> Any:
         k = self.y.shape[-1]
