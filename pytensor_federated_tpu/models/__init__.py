@@ -12,6 +12,7 @@ from .statespace import (
     kalman_logp_seq,
     kalman_smoother_parallel,
     kalman_smoother_seq,
+    sample_latents,
 )
 from .timeseries import SeqShardedAR1, generate_ar1_data
 
@@ -24,6 +25,7 @@ __all__ = [
     "kalman_logp_seq",
     "kalman_smoother_parallel",
     "kalman_smoother_seq",
+    "sample_latents",
     "dense_vfe_logp",
     "generate_ar1_data",
     "generate_gp_data",

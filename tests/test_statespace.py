@@ -148,6 +148,32 @@ class TestSmoother:
             )
 
 
+class TestSimulationSmoother:
+    def test_moments_match_smoother(self):
+        """Posterior draws must reproduce the smoothed mean and the
+        marginal smoothed variances (exactness of Durbin-Koopman for
+        linear-Gaussian models), up to Monte Carlo error."""
+        from pytensor_federated_tpu.models.statespace import sample_latents
+
+        y, params = generate_lgssm_data(T=24)
+        sm, sP = kalman_smoother_parallel(params, y)
+        draws = jax.jit(
+            lambda k: sample_latents(params, y, k, num_draws=4000)
+        )(jax.random.PRNGKey(0))
+        assert draws.shape == (4000, 24, 2)
+        emp_mean = jnp.mean(draws, axis=0)
+        emp_var = jnp.var(draws, axis=0)
+        np.testing.assert_allclose(
+            np.asarray(emp_mean), np.asarray(sm), atol=0.05
+        )
+        np.testing.assert_allclose(
+            np.asarray(emp_var),
+            np.asarray(jax.vmap(jnp.diag)(sP)),
+            rtol=0.15,
+            atol=0.01,
+        )
+
+
 class TestSeqSharded:
     @pytest.fixture(scope="class")
     def seq_mesh(self, devices8):
