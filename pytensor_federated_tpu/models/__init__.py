@@ -6,6 +6,7 @@ from .linear import FederatedLinearRegression, generate_node_data
 from .logistic import FederatedLogisticRegression, generate_logistic_data
 from .ode import LotkaVolterraModel, generate_lv_data, make_lv_model, rk4_integrate
 from .statespace import (
+    FederatedLGSSMPanel,
     SeqShardedLGSSM,
     generate_lgssm_data,
     kalman_logp_parallel,
@@ -19,6 +20,7 @@ from .timeseries import SeqShardedAR1, generate_ar1_data
 __all__ = [
     "FederatedSparseGP",
     "SeqShardedAR1",
+    "FederatedLGSSMPanel",
     "SeqShardedLGSSM",
     "generate_lgssm_data",
     "kalman_logp_parallel",

@@ -92,6 +92,17 @@ def generate_lgssm_data(
     return jnp.asarray(np.stack(ys), jnp.float32), params
 
 
+def default_lgssm_params(d: int = 2, k: int = 1) -> dict:
+    """Default parameter pytree (the keys ``_unpack`` expects)."""
+    return {
+        "F": 0.9 * jnp.eye(d),
+        "H": jnp.ones((k, d)) / d,
+        "log_q": jnp.asarray(-1.0),
+        "log_r": jnp.asarray(-0.5),
+        "m0": jnp.zeros((d,)),
+    }
+
+
 def _unpack(params):
     F = params["F"]
     H = params["H"]
@@ -314,6 +325,56 @@ def kalman_smoother_parallel(params: Any, y: jax.Array):
 
 
 # ---------------------------------------------------------------------------
+# Federated panel of time series (shards axis x parallel-in-time filter)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(eq=False)
+class FederatedLGSSMPanel:
+    """A panel of time series: each federated shard owns one private
+    series, all sharing the LGSSM parameters.
+
+    ``logp(params) = Σ_shards kalman_logp(params, y_shard)`` — the
+    federated sum-of-potentials contract (reference: demo_model.py:34-36)
+    with a sequence model inside each node: the ``shards`` mesh axis
+    carries the panel, and within every shard the filter itself is the
+    O(log T)-depth associative scan.  Composes the two scale axes this
+    framework adds (shard count x sequence length).
+
+    ``ys``: ``(n_series, T)`` or ``(n_series, T, k)``.
+    """
+
+    ys: jax.Array
+    mesh: Any = None
+    axis: str = "shards"
+
+    def __post_init__(self):
+        from ..parallel.sharded import FederatedLogp
+
+        ys = jnp.asarray(self.ys)
+        if ys.ndim not in (2, 3):
+            raise ValueError(
+                f"expected ys of shape (n_series, T) or (n_series, T, k), "
+                f"got {ys.shape}"
+            )
+        if ys.ndim == 2:
+            ys = ys[..., None]
+        self.ys = ys
+        self.fed = FederatedLogp(
+            kalman_logp_parallel, self.ys, mesh=self.mesh, axis=self.axis
+        )
+
+    def logp(self, params: Any) -> jax.Array:
+        return self.fed.logp(params)
+
+    def logp_and_grad(self, params: Any):
+        return self.fed.logp_and_grad(params)
+
+    def init_params(self, d: int = 2) -> Any:
+        return default_lgssm_params(d, self.ys.shape[-1])
+
+
+# ---------------------------------------------------------------------------
 # Posterior latent sampling (Durbin-Koopman simulation smoother)
 # ---------------------------------------------------------------------------
 
@@ -421,14 +482,7 @@ class SeqShardedLGSSM:
         return self._logp_and_grad(params, self.y)
 
     def init_params(self, d: int = 2) -> Any:
-        k = self.y.shape[-1]
-        return {
-            "F": 0.9 * jnp.eye(d),
-            "H": jnp.ones((k, d)) / d,
-            "log_q": jnp.asarray(-1.0),
-            "log_r": jnp.asarray(-0.5),
-            "m0": jnp.zeros((d,)),
-        }
+        return default_lgssm_params(d, self.y.shape[-1])
 
 
 @functools.lru_cache(maxsize=64)

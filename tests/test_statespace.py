@@ -214,6 +214,42 @@ class TestSeqSharded:
             SeqShardedLGSSM(y, mesh=seq_mesh, axis="nope")
 
 
+class TestFederatedPanel:
+    def test_matches_sum_of_individual_logps(self, devices8):
+        from pytensor_federated_tpu.models.statespace import (
+            FederatedLGSSMPanel,
+        )
+
+        mesh = make_mesh({"shards": 4}, devices=devices8[:4])
+        series = []
+        for i in range(8):  # 2 local series per device
+            y_i, params = generate_lgssm_data(T=32, seed=100 + i)
+            series.append(np.asarray(y_i))
+        ys = jnp.asarray(np.stack(series))
+        panel = FederatedLGSSMPanel(ys, mesh=mesh)
+        lp = float(panel.logp(params))
+        ref = sum(
+            float(kalman_logp_seq(params, ys[i])) for i in range(8)
+        )
+        np.testing.assert_allclose(lp, ref, rtol=1e-4)
+
+        v, g = panel.logp_and_grad(params)
+        np.testing.assert_allclose(float(v), ref, rtol=1e-4)
+        ref_g = jax.grad(
+            lambda p: sum(
+                kalman_logp_seq(p, ys[i]) for i in range(8)
+            )
+        )(params)
+        for key in params:
+            np.testing.assert_allclose(
+                np.asarray(g[key]),
+                np.asarray(ref_g[key]),
+                rtol=1e-3,
+                atol=1e-3,
+                err_msg=key,
+            )
+
+
 class TestSamplerIntegration:
     def test_nuts_recovers_noise_scales(self):
         """End-to-end: NUTS over (log_q, log_r) with the Kalman filter
