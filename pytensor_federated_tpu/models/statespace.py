@@ -120,11 +120,38 @@ def _unpack(params):
 # ---------------------------------------------------------------------------
 
 
-def kalman_logp_seq(params: Any, y: jax.Array) -> jax.Array:
-    """Marginal log-likelihood via the classic sequential Kalman filter."""
-    F, H, Q, R, m0, P0 = _unpack(params)
+def _as_mask(mask, T, dtype):
+    """Normalize an optional observation mask to a float (T,) array
+    (1 = observed, 0 = missing)."""
+    if mask is None:
+        return jnp.ones((T,), dtype)
+    return jnp.asarray(mask, dtype)
 
-    def step(carry, y_t):
+
+def _sanitize(y, mask):
+    """Zero out masked rows so NaN-encoded missing observations (the
+    canonical pandas form) cannot poison the filter: 0 * NaN = NaN, so
+    masked values must be *replaced*, not just weight-zeroed."""
+    return jnp.where(mask[:, None] > 0, y, jnp.zeros_like(y))
+
+
+def kalman_logp_seq(
+    params: Any, y: jax.Array, mask: Any = None
+) -> jax.Array:
+    """Marginal log-likelihood via the classic sequential Kalman filter.
+
+    ``mask`` (optional, shape ``(T,)``): 1 where ``y_t`` is observed,
+    0 where missing.  Missing steps contribute no likelihood term and
+    perform a pure prediction (no measurement update) — the standard
+    missing-data treatment, which also serves ragged/padded series.
+    Masked rows of ``y`` may hold any value, including NaN.
+    """
+    F, H, Q, R, m0, P0 = _unpack(params)
+    mask = _as_mask(mask, y.shape[0], F.dtype)
+    y = _sanitize(y, mask)
+
+    def step(carry, inp):
+        y_t, obs = inp
         m, Pcov = carry
         # predict
         mp = F @ m
@@ -134,11 +161,11 @@ def kalman_logp_seq(params: Any, y: jax.Array) -> jax.Array:
         v = y_t - H @ mp
         ll = _mvn_logpdf(v, jnp.zeros_like(v), S)
         K = jnp.linalg.solve(S, H @ Pp).T
-        m_new = mp + K @ v
-        P_new = Pp - K @ S @ K.T
-        return (m_new, P_new), ll
+        m_new = jnp.where(obs > 0, mp + K @ v, mp)
+        P_new = jnp.where(obs > 0, Pp - K @ S @ K.T, Pp)
+        return (m_new, P_new), obs * ll
 
-    (_, _), lls = lax.scan(step, (m0, P0), y)
+    (_, _), lls = lax.scan(step, (m0, P0), (y, mask))
     return jnp.sum(lls)
 
 
@@ -147,46 +174,59 @@ def kalman_logp_seq(params: Any, y: jax.Array) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 
-def _generic_elements(F, H, Q, R, y):
+def _generic_elements(F, H, Q, R, y, mask):
     """Generic (non-prior) elements for every row of ``y``: the
-    conditioning of one transition on its observation."""
+    conditioning of one transition on its observation.  Masked-out rows
+    degrade to the pure prediction element ``(F, 0, Q, 0, 0)``.
+    ``mask`` must be a normalized float array and ``y`` sanitized."""
     d = F.shape[0]
     eye = jnp.eye(d, dtype=F.dtype)
 
-    def generic(y_t):
+    def generic(y_t, obs):
         S = H @ Q @ H.T + R  # innovation cov given exact previous state
         K = jnp.linalg.solve(S, H @ Q).T
-        A = (eye - K @ H) @ F
-        b = K @ y_t
-        C = (eye - K @ H) @ Q
+        A = jnp.where(obs > 0, (eye - K @ H) @ F, F)
+        b = jnp.where(obs > 0, K @ y_t, jnp.zeros((d,), F.dtype))
+        C = jnp.where(obs > 0, (eye - K @ H) @ Q, Q)
         HF = H @ F
-        J = HF.T @ jnp.linalg.solve(S, HF)
-        eta = HF.T @ jnp.linalg.solve(S, y_t)
+        zero = jnp.zeros((d, d), F.dtype)
+        J = jnp.where(obs > 0, HF.T @ jnp.linalg.solve(S, HF), zero)
+        eta = jnp.where(
+            obs > 0,
+            HF.T @ jnp.linalg.solve(S, y_t),
+            jnp.zeros((d,), F.dtype),
+        )
         return A, b, C, J, eta
 
-    return jax.vmap(generic)(y)
+    return jax.vmap(generic)(y, mask)
 
 
-def _prior_element(F, H, Q, R, m0, P0, y1):
+def _prior_element(F, H, Q, R, m0, P0, y1, obs1):
     """Element for global t=1: condition the prior predictive
-    ``N(F m0, F P0 F' + Q)`` on ``y_1`` directly.  Its ``A`` is zero, so
-    composition discards the dependence on the non-existent state 0."""
+    ``N(F m0, F P0 F' + Q)`` on ``y_1`` directly (or, when ``y_1`` is
+    masked out, keep the prior predictive unconditioned).  Its ``A`` is
+    zero, so composition discards the dependence on the non-existent
+    state 0."""
     d = F.shape[0]
     Pp = F @ P0 @ F.T + Q
     mp = F @ m0
     S1 = H @ Pp @ H.T + R
     K1 = jnp.linalg.solve(S1, H @ Pp).T
-    b1 = mp + K1 @ (y1 - H @ mp)
-    C1 = Pp - K1 @ S1 @ K1.T
+    b1 = jnp.where(obs1 > 0, mp + K1 @ (y1 - H @ mp), mp)
+    C1 = jnp.where(obs1 > 0, Pp - K1 @ S1 @ K1.T, Pp)
     zero = jnp.zeros((d, d), F.dtype)
     return zero, b1, C1, zero, jnp.zeros((d,), F.dtype)
 
 
-def _filter_elements(F, H, Q, R, m0, P0, y):
+def _filter_elements(F, H, Q, R, m0, P0, y, mask=None):
     """Per-step elements ``(A, b, C, J, eta)`` such that composing
-    elements 1..t yields the filtered mean/cov at t in ``(b, C)``."""
-    elems = _generic_elements(F, H, Q, R, y)
-    prior = _prior_element(F, H, Q, R, m0, P0, y[0])
+    elements 1..t yields the filtered mean/cov at t in ``(b, C)``.
+    Normalizes the mask and sanitizes ``y`` (single entry point for the
+    parallel paths)."""
+    mask = _as_mask(mask, y.shape[0], F.dtype)
+    y = _sanitize(y, mask)
+    elems = _generic_elements(F, H, Q, R, y, mask)
+    prior = _prior_element(F, H, Q, R, m0, P0, y[0], mask[0])
     return jax.tree_util.tree_map(
         lambda g, p: g.at[0].set(p), elems, prior
     )
@@ -226,19 +266,25 @@ def _predictive_one(F, H, Q, R, y_t, m, Pcov):
     return _mvn_logpdf(y_t - H @ mp, jnp.zeros(y_t.shape[-1]), S)
 
 
-def _predictive_logp(F, H, Q, R, m0, P0, y, means, covs):
-    """Σ_t log p(y_t | y_{1:t-1}) from filtered moments at t-1."""
+def _predictive_logp(F, H, Q, R, m0, P0, y, means, covs, mask=None):
+    """Σ_t log p(y_t | y_{1:t-1}) from filtered moments at t-1 (masked
+    steps contribute nothing)."""
+    mask = _as_mask(mask, y.shape[0], F.dtype)
+    y = _sanitize(y, mask)
     prev_m = jnp.concatenate([m0[None], means[:-1]], axis=0)
     prev_P = jnp.concatenate([P0[None], covs[:-1]], axis=0)
     one = functools.partial(_predictive_one, F, H, Q, R)
-    return jnp.sum(jax.vmap(one)(y, prev_m, prev_P))
+    return jnp.sum(mask * jax.vmap(one)(y, prev_m, prev_P))
 
 
-def kalman_logp_parallel(params: Any, y: jax.Array) -> jax.Array:
-    """Marginal log-likelihood with O(log T)-depth associative scan."""
+def kalman_logp_parallel(
+    params: Any, y: jax.Array, mask: Any = None
+) -> jax.Array:
+    """Marginal log-likelihood with O(log T)-depth associative scan.
+    ``mask`` as in :func:`kalman_logp_seq`."""
     F, H, Q, R, m0, P0 = _unpack(params)
-    means, covs = _filtered_moments(params, y)
-    return _predictive_logp(F, H, Q, R, m0, P0, y, means, covs)
+    means, covs = _filtered_moments(params, y, mask)
+    return _predictive_logp(F, H, Q, R, m0, P0, y, means, covs, mask)
 
 
 # ---------------------------------------------------------------------------
@@ -246,19 +292,19 @@ def kalman_logp_parallel(params: Any, y: jax.Array) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 
-def _filtered_moments(params, y):
+def _filtered_moments(params, y, mask=None):
     """All filtered means/covs via the associative scan."""
     F, H, Q, R, m0, P0 = _unpack(params)
-    elems = _filter_elements(F, H, Q, R, m0, P0, y)
+    elems = _filter_elements(F, H, Q, R, m0, P0, y, mask)
     _, means, covs, _, _ = lax.associative_scan(_combine, elems)
     return means, covs
 
 
-def kalman_smoother_seq(params: Any, y: jax.Array):
+def kalman_smoother_seq(params: Any, y: jax.Array, mask: Any = None):
     """Smoothed marginals ``(means, covs)`` via the classic backward
     Rauch-Tung-Striebel recursion (golden reference; O(T) depth)."""
     F, H, Q, R, m0, P0 = _unpack(params)
-    means, covs = _filtered_moments(params, y)
+    means, covs = _filtered_moments(params, y, mask)
 
     def back(carry, mc):
         ms_next, Ps_next = carry
@@ -309,11 +355,13 @@ def _smooth_combine(e1, e2):
     return E, g, L
 
 
-def kalman_smoother_parallel(params: Any, y: jax.Array):
+def kalman_smoother_parallel(params: Any, y: jax.Array, mask: Any = None):
     """Smoothed marginals with O(log T)-depth associative scans (one
-    forward for filtering, one reverse for smoothing)."""
+    forward for filtering, one reverse for smoothing).  The backward
+    kernels depend on observations only through the filtered moments,
+    so masking enters via the filter alone."""
     F, H, Q, R, m0, P0 = _unpack(params)
-    means, covs = _filtered_moments(params, y)
+    means, covs = _filtered_moments(params, y, mask)
     elems = _smooth_elements(F, Q, means, covs)
     # reverse=True passes the accumulated *suffix* (the later
     # composition) as the first argument; _smooth_combine expects
@@ -341,12 +389,16 @@ class FederatedLGSSMPanel:
     O(log T)-depth associative scan.  Composes the two scale axes this
     framework adds (shard count x sequence length).
 
-    ``ys``: ``(n_series, T)`` or ``(n_series, T, k)``.
+    ``ys``: ``(n_series, T)`` or ``(n_series, T, k)``.  ``masks``
+    (optional, ``(n_series, T)``): 1 = observed — supports ragged
+    panels (pad shorter series and mask the padding) and irregular
+    sampling, the same convention as ``parallel.packing.pack_shards``.
     """
 
     ys: jax.Array
     mesh: Any = None
     axis: str = "shards"
+    masks: Any = None
 
     def __post_init__(self):
         from ..parallel.sharded import FederatedLogp
@@ -360,8 +412,25 @@ class FederatedLGSSMPanel:
         if ys.ndim == 2:
             ys = ys[..., None]
         self.ys = ys
+        if self.masks is None:
+            self.masks = jnp.ones(ys.shape[:2], ys.dtype)
+        else:
+            self.masks = jnp.asarray(self.masks, ys.dtype)
+            if self.masks.shape != ys.shape[:2]:
+                raise ValueError(
+                    f"masks shape {self.masks.shape} != (n_series, T) "
+                    f"{ys.shape[:2]}"
+                )
+
+        def per_shard_logp(params, shard):
+            y_shard, mask_shard = shard
+            return kalman_logp_parallel(params, y_shard, mask_shard)
+
         self.fed = FederatedLogp(
-            kalman_logp_parallel, self.ys, mesh=self.mesh, axis=self.axis
+            per_shard_logp,
+            (self.ys, self.masks),
+            mesh=self.mesh,
+            axis=self.axis,
         )
 
     def logp(self, params: Any) -> jax.Array:
@@ -404,7 +473,11 @@ def _simulate(params, key, T):
 
 
 def sample_latents(
-    params: Any, y: jax.Array, key: jax.Array, num_draws: int = 1
+    params: Any,
+    y: jax.Array,
+    key: jax.Array,
+    num_draws: int = 1,
+    mask: Any = None,
 ) -> jax.Array:
     """Joint posterior draws of the latent path ``z_{1:T} | y_{1:T}``.
 
@@ -419,11 +492,12 @@ def sample_latents(
     if y.ndim == 1:
         y = y[:, None]
     T = y.shape[0]
-    sm_y, _ = kalman_smoother_parallel(params, y)
+    # The synthetic draw conditions on the SAME observation pattern.
+    sm_y, _ = kalman_smoother_parallel(params, y, mask)
 
     def one(k):
         z_star, y_star = _simulate(params, k, T)
-        sm_star, _ = kalman_smoother_parallel(params, y_star)
+        sm_star, _ = kalman_smoother_parallel(params, y_star, mask)
         return sm_y + z_star - sm_star
 
     return jax.vmap(one)(jax.random.split(key, num_draws))
@@ -453,6 +527,7 @@ class SeqShardedLGSSM:
     y: jax.Array
     mesh: Mesh
     axis: str = SEQ_AXIS
+    mask: Any = None
 
     def __post_init__(self):
         if self.axis not in self.mesh.axis_names:
@@ -467,19 +542,20 @@ class SeqShardedLGSSM:
             raise ValueError(
                 f"sequence length {self.y.shape[0]} not divisible by {n}"
             )
+        self.mask = _as_mask(self.mask, self.y.shape[0], self.y.dtype)
         self._logp = _sharded_lgssm_logp(self.mesh, self.axis)
         # Cache the fused pair once (pattern from timeseries.SeqShardedAR1)
         # so per-step sampler/optimizer calls hit a compiled executable
         # instead of re-tracing the distributed filter.
         self._logp_and_grad = jax.jit(
-            jax.value_and_grad(lambda p, y: self._logp(p, y))
+            jax.value_and_grad(lambda p, y, m: self._logp(p, y, m))
         )
 
     def logp(self, params: Any) -> jax.Array:
-        return self._logp(params, self.y)
+        return self._logp(params, self.y, self.mask)
 
     def logp_and_grad(self, params: Any):
-        return self._logp_and_grad(params, self.y)
+        return self._logp_and_grad(params, self.y, self.mask)
 
     def init_params(self, d: int = 2) -> Any:
         return default_lgssm_params(d, self.y.shape[-1])
@@ -489,13 +565,14 @@ class SeqShardedLGSSM:
 def _sharded_lgssm_logp(mesh, axis):
     n = mesh.shape[axis]
 
-    def local(params, y_local):
+    def local(params, y_local, mask_local):
         F, H, Q, R, m0, P0 = _unpack(params)
+        y_local = _sanitize(y_local, mask_local)
         idx = lax.axis_index(axis)
         # Generic elements everywhere; the prior-conditioned element
         # only exists at global t=1, i.e. row 0 of device 0.
-        elems = _generic_elements(F, H, Q, R, y_local)
-        prior = _prior_element(F, H, Q, R, m0, P0, y_local[0])
+        elems = _generic_elements(F, H, Q, R, y_local, mask_local)
+        prior = _prior_element(F, H, Q, R, m0, P0, y_local[0], mask_local[0])
         elems = jax.tree_util.tree_map(
             lambda g, p: g.at[0].set(jnp.where(idx == 0, p, g[0])),
             elems,
@@ -555,15 +632,19 @@ def _sharded_lgssm_logp(mesh, axis):
         )
 
         one = functools.partial(_predictive_one, F, H, Q, R)
-        lp = jnp.sum(jax.vmap(one)(y_local, prev_m, prev_P))
+        lp = jnp.sum(mask_local * jax.vmap(one)(y_local, prev_m, prev_P))
         return lax.psum(lp, axis)
 
-    def logp(params, y):
+    def logp(params, y, mask):
         return shard_map(
             local,
             mesh=mesh,
-            in_specs=(jax.tree_util.tree_map(lambda _: P(), params), P(axis)),
+            in_specs=(
+                jax.tree_util.tree_map(lambda _: P(), params),
+                P(axis),
+                P(axis),
+            ),
             out_specs=P(),
-        )(params, y)
+        )(params, y, mask)
 
     return jax.jit(logp)

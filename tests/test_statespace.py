@@ -214,6 +214,176 @@ class TestSeqSharded:
             SeqShardedLGSSM(y, mesh=seq_mesh, axis="nope")
 
 
+class TestMissingData:
+    def test_masked_logp_matches_dense_subset(self):
+        """Masked marginal == exact joint-Gaussian marginal over only
+        the observed rows (the defining property of missing-data
+        filtering)."""
+        y, params = generate_lgssm_data(T=8)
+        mask = np.array([1, 1, 0, 1, 0, 0, 1, 1], np.float32)
+        H = np.asarray(params["H"], np.float64)
+        k = H.shape[0]
+        means, covz = dense_joint_moments(params, 8)
+        mu = np.concatenate([H @ mi for mi in means])
+        Sigma = np.zeros((8 * k, 8 * k))
+        for s in range(8):
+            for t in range(8):
+                Sigma[s * k : (s + 1) * k, t * k : (t + 1) * k] = (
+                    H @ covz[s, t] @ H.T
+                )
+        Sigma[np.diag_indices(8 * k)] += np.exp(float(params["log_r"]))
+        obs = np.where(np.repeat(mask, k) > 0)[0]
+        So = Sigma[np.ix_(obs, obs)]
+        yo = np.asarray(y, np.float64).reshape(-1)[obs] - mu[obs]
+        sign, logdet = np.linalg.slogdet(So)
+        ref = float(
+            -0.5 * yo @ np.linalg.solve(So, yo)
+            - 0.5 * logdet
+            - 0.5 * len(obs) * np.log(2 * np.pi)
+        )
+        lp_seq = float(kalman_logp_seq(params, y, mask))
+        lp_par = float(kalman_logp_parallel(params, y, mask))
+        np.testing.assert_allclose(lp_seq, ref, rtol=1e-4)
+        np.testing.assert_allclose(lp_par, ref, rtol=1e-4)
+
+    def test_all_observed_equals_unmasked(self):
+        y, params = generate_lgssm_data(T=16)
+        lp = float(kalman_logp_parallel(params, y))
+        lp_m = float(
+            kalman_logp_parallel(params, y, jnp.ones(16))
+        )
+        np.testing.assert_allclose(lp_m, lp, rtol=1e-6)
+
+    def test_sharded_masked_matches(self, devices8):
+        mesh = make_mesh({"seq": 4}, devices=devices8[:4])
+        y, params = generate_lgssm_data(T=32)
+        rng = np.random.default_rng(5)
+        mask = (rng.uniform(size=32) > 0.3).astype(np.float32)
+        mask[0] = 0.0  # masked global t=1 exercises the prior element
+        model = SeqShardedLGSSM(y, mesh=mesh, axis="seq", mask=mask)
+        lp = float(model.logp(params))
+        ref = float(kalman_logp_seq(params, y, mask))
+        np.testing.assert_allclose(lp, ref, rtol=1e-4)
+        v, g = model.logp_and_grad(params)
+        ref_g = jax.grad(lambda p: kalman_logp_seq(p, y, mask))(params)
+        for key in params:
+            np.testing.assert_allclose(
+                np.asarray(g[key]),
+                np.asarray(ref_g[key]),
+                rtol=1e-3,
+                atol=1e-4,
+                err_msg=key,
+            )
+
+    def test_nan_encoded_missing(self):
+        """Masked rows may hold NaN (pandas convention) without
+        poisoning the logp or its gradient."""
+        y, params = generate_lgssm_data(T=16)
+        mask = np.ones(16, np.float32)
+        mask[[3, 7, 8]] = 0.0
+        y_nan = np.asarray(y).copy()
+        y_nan[[3, 7, 8]] = np.nan
+        ref = float(kalman_logp_seq(params, y, mask))
+        for fn in (kalman_logp_seq, kalman_logp_parallel):
+            lp = float(fn(params, jnp.asarray(y_nan), mask))
+            np.testing.assert_allclose(lp, ref, rtol=1e-5)
+            g = jax.grad(lambda p: fn(p, jnp.asarray(y_nan), mask))(params)
+            assert all(
+                bool(jnp.all(jnp.isfinite(leaf)))
+                for leaf in jax.tree_util.tree_leaves(g)
+            )
+
+    def test_masked_smoother_matches_dense_conditional(self):
+        """Smoothed marginals under a mask == exact conditional
+        E[z_t | observed y] from the dense joint."""
+        y, params = generate_lgssm_data(T=6)
+        T = 6
+        mask = np.array([1, 0, 1, 1, 0, 1], np.float32)
+        H = np.asarray(params["H"], np.float64)
+        d, k = np.asarray(params["F"]).shape[0], H.shape[0]
+        means, covz = dense_joint_moments(params, T)
+        mu_z = np.concatenate(means)
+        bigH = np.kron(np.eye(T), H)
+        Sz = covz.transpose(0, 2, 1, 3).reshape(T * d, T * d)
+        Syy = bigH @ Sz @ bigH.T + np.exp(
+            float(params["log_r"])
+        ) * np.eye(T * k)
+        Szy = Sz @ bigH.T
+        obs = np.where(np.repeat(mask, k) > 0)[0]
+        yf = np.asarray(y, np.float64).reshape(-1)
+        resid = (yf - bigH @ mu_z)[obs]
+        So = Syy[np.ix_(obs, obs)]
+        post_mean = mu_z + Szy[:, obs] @ np.linalg.solve(So, resid)
+        post_cov = Sz - Szy[:, obs] @ np.linalg.solve(
+            So, Szy[:, obs].T
+        )
+        sm_s, sP_s = kalman_smoother_seq(params, y, mask)
+        sm_p, sP_p = kalman_smoother_parallel(params, y, mask)
+        for sm, sP in ((sm_s, sP_s), (sm_p, sP_p)):
+            for t in range(T):
+                np.testing.assert_allclose(
+                    np.asarray(sm[t]),
+                    post_mean[t * d : (t + 1) * d],
+                    rtol=1e-3,
+                    atol=1e-4,
+                )
+                np.testing.assert_allclose(
+                    np.asarray(sP[t]),
+                    post_cov[t * d : (t + 1) * d, t * d : (t + 1) * d],
+                    rtol=1e-3,
+                    atol=1e-4,
+                )
+
+    def test_masked_sample_latents_moments(self):
+        from pytensor_federated_tpu.models.statespace import sample_latents
+
+        y, params = generate_lgssm_data(T=12)
+        mask = np.ones(12, np.float32)
+        mask[[2, 5, 9]] = 0.0
+        sm, sP = kalman_smoother_parallel(params, y, mask)
+        draws = jax.jit(
+            lambda k: sample_latents(params, y, k, num_draws=4000, mask=mask)
+        )(jax.random.PRNGKey(1))
+        np.testing.assert_allclose(
+            np.asarray(jnp.mean(draws, axis=0)), np.asarray(sm), atol=0.08
+        )
+        np.testing.assert_allclose(
+            np.asarray(jnp.var(draws, axis=0)),
+            np.asarray(jax.vmap(jnp.diag)(sP)),
+            rtol=0.15,
+            atol=0.02,
+        )
+
+    def test_ragged_panel(self, devices8):
+        """Padded + masked panel == sum of per-series logps at their
+        true lengths."""
+        from pytensor_federated_tpu.models.statespace import (
+            FederatedLGSSMPanel,
+        )
+
+        mesh = make_mesh({"shards": 4}, devices=devices8[:4])
+        lengths = [32, 24, 16, 8]
+        T = 32
+        series, masks = [], []
+        for i, L in enumerate(lengths):
+            y_i, params = generate_lgssm_data(T=L, seed=300 + i)
+            pad = np.zeros((T, 1), np.float32)
+            pad[:L] = np.asarray(y_i)
+            series.append(pad)
+            m = np.zeros(T, np.float32)
+            m[:L] = 1.0
+            masks.append(m)
+        ys = jnp.asarray(np.stack(series))
+        panel = FederatedLGSSMPanel(
+            ys, mesh=mesh, masks=jnp.asarray(np.stack(masks))
+        )
+        lp = float(panel.logp(params))
+        ref = 0.0
+        for i, L in enumerate(lengths):
+            ref += float(kalman_logp_seq(params, ys[i, :L]))
+        np.testing.assert_allclose(lp, ref, rtol=1e-4)
+
+
 class TestFederatedPanel:
     def test_matches_sum_of_individual_logps(self, devices8):
         from pytensor_federated_tpu.models.statespace import (
