@@ -9,6 +9,7 @@ from .statespace import (
     FederatedLGSSMPanel,
     SeqShardedLGSSM,
     generate_lgssm_data,
+    kalman_forecast,
     kalman_logp_parallel,
     kalman_logp_seq,
     kalman_smoother_parallel,
@@ -23,6 +24,7 @@ __all__ = [
     "FederatedLGSSMPanel",
     "SeqShardedLGSSM",
     "generate_lgssm_data",
+    "kalman_forecast",
     "kalman_logp_parallel",
     "kalman_logp_seq",
     "kalman_smoother_parallel",

@@ -372,6 +372,44 @@ def kalman_smoother_parallel(params: Any, y: jax.Array, mask: Any = None):
     return sm, sP
 
 
+def kalman_forecast(
+    params: Any, y: jax.Array, horizon: int, mask: Any = None
+):
+    """h-step-ahead predictive moments of future observations.
+
+    Returns ``(means, covs)`` with shapes ``(horizon, k)`` and
+    ``(horizon, k, k)``: the Gaussian moments of
+    ``y_{T+h} | y_{1:T}`` for h = 1..horizon.  One filter pass (the
+    O(log T) associative scan) plus an affine associative scan over the
+    horizon — no sequential propagation anywhere.
+    """
+    y = jnp.asarray(y)
+    if y.ndim == 1:
+        y = y[:, None]
+    F, H, Q, R, m0, P0 = _unpack(params)
+    means, covs = _filtered_moments(params, y, mask)
+    m_T, P_T = means[-1], covs[-1]
+
+    # Latent moments at T+h: m = F^h m_T; P = F^h P_T (F^h)' + Σ F^j Q F^j'.
+    # Both are prefix compositions of the affine-moment element (F, Q):
+    # compose((A1,B1),(A2,B2)) = (A2 A1, A2 B1 A2' + B2).
+    d = F.shape[0]
+    A = jnp.broadcast_to(F, (horizon, d, d))
+    B = jnp.broadcast_to(Q, (horizon, d, d))
+
+    def moment(e1, e2):
+        A1, B1 = e1
+        A2, B2 = e2
+        return A2 @ A1, A2 @ B1 @ jnp.swapaxes(A2, -1, -2) + B2
+
+    Fh, Vh = lax.associative_scan(moment, (A, B))
+    mz = (Fh @ m_T[..., None])[..., 0]
+    Pz = Fh @ P_T @ jnp.swapaxes(Fh, -1, -2) + Vh
+    my = mz @ H.T
+    Py = jnp.einsum("ij,hjk,lk->hil", H, Pz, H) + R
+    return my, Py
+
+
 # ---------------------------------------------------------------------------
 # Federated panel of time series (shards axis x parallel-in-time filter)
 # ---------------------------------------------------------------------------

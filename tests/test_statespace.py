@@ -384,6 +384,51 @@ class TestMissingData:
         np.testing.assert_allclose(lp, ref, rtol=1e-4)
 
 
+class TestForecast:
+    def test_matches_dense_joint_conditional(self):
+        """Forecast moments == conditional moments of future y rows in
+        the dense joint Gaussian built over T+h steps."""
+        from pytensor_federated_tpu.models.statespace import kalman_forecast
+
+        T, h = 6, 3
+        y_full, params = generate_lgssm_data(T=T + h)
+        y = y_full[:T]
+        H = np.asarray(params["H"], np.float64)
+        d, k = np.asarray(params["F"]).shape[0], H.shape[0]
+        means, covz = dense_joint_moments(params, T + h)
+        mu_z = np.concatenate(means)
+        bigH = np.kron(np.eye(T + h), H)
+        Sz = covz.transpose(0, 2, 1, 3).reshape((T + h) * d, (T + h) * d)
+        Syy = bigH @ Sz @ bigH.T + np.exp(
+            float(params["log_r"])
+        ) * np.eye((T + h) * k)
+        mu_y = bigH @ mu_z
+        past = np.arange(T * k)
+        fut = np.arange(T * k, (T + h) * k)
+        Spp = Syy[np.ix_(past, past)]
+        Sfp = Syy[np.ix_(fut, past)]
+        resid = np.asarray(y, np.float64).reshape(-1) - mu_y[past]
+        cond_mean = mu_y[fut] + Sfp @ np.linalg.solve(Spp, resid)
+        cond_cov = Syy[np.ix_(fut, fut)] - Sfp @ np.linalg.solve(
+            Spp, Sfp.T
+        )
+        my, Py = kalman_forecast(params, y, h)
+        assert my.shape == (h, k) and Py.shape == (h, k, k)
+        for i in range(h):
+            np.testing.assert_allclose(
+                np.asarray(my[i]),
+                cond_mean[i * k : (i + 1) * k],
+                rtol=1e-3,
+                atol=1e-4,
+            )
+            np.testing.assert_allclose(
+                np.asarray(Py[i]),
+                cond_cov[i * k : (i + 1) * k, i * k : (i + 1) * k],
+                rtol=1e-3,
+                atol=1e-4,
+            )
+
+
 class TestFederatedPanel:
     def test_matches_sum_of_individual_logps(self, devices8):
         from pytensor_federated_tpu.models.statespace import (
