@@ -8,6 +8,7 @@ from .ode import LotkaVolterraModel, generate_lv_data, make_lv_model, rk4_integr
 from .statespace import (
     FederatedLGSSMPanel,
     SeqShardedLGSSM,
+    ekf_logp,
     generate_lgssm_data,
     kalman_forecast,
     kalman_logp_parallel,
@@ -24,6 +25,7 @@ __all__ = [
     "FederatedLGSSMPanel",
     "SeqShardedLGSSM",
     "generate_lgssm_data",
+    "ekf_logp",
     "kalman_forecast",
     "kalman_logp_parallel",
     "kalman_logp_seq",
