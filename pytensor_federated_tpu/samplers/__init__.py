@@ -4,6 +4,7 @@ from .advi import ADVIResult, advi_fit
 from .convergence import effective_sample_size, split_rhat, summary
 from .predictive import posterior_predictive, prior_predictive
 from .ensemble import EnsembleResult, ensemble_sample
+from .laplace import LaplaceResult, laplace_approximation
 from .hmc import HMCState, find_reasonable_step_size, hmc_init, hmc_step, leapfrog
 from .mcmc import SampleResult, find_map, sample
 from .metropolis import metropolis_init, metropolis_step
@@ -15,6 +16,7 @@ __all__ = [
     "ADVIResult",
     "AdaptSchedule",
     "EnsembleResult",
+    "LaplaceResult",
     "SMCResult",
     "advi_fit",
     "ensemble_sample",
@@ -25,6 +27,7 @@ __all__ = [
     "effective_sample_size",
     "find_map",
     "find_reasonable_step_size",
+    "laplace_approximation",
     "flatten_logp",
     "split_rhat",
     "summary",
