@@ -20,9 +20,12 @@
 // float64; outputs [logp(), dlogp/dintercept(), dlogp/dslope()].
 //
 // Build: make -C native   (-> native/cpp_node)
-// Run:   ./cpp_node <port>
+// Run:   ./cpp_node <port> [<port> ...]
 //
-// Single-threaded accept loop; connections served sequentially, each
+// One listener thread per port (the in-process analog of the
+// reference's one-process-per-port worker pool,
+// reference: demo_node.py:98-108) and one thread per accepted
+// connection, so concurrent clients are served concurrently; each
 // connection handles a stream of evaluate frames (the lock-step
 // request/reply pattern of the reference's bidirectional stream,
 // reference: service.py:150-158).
@@ -40,6 +43,8 @@
 #include <cstdio>
 #include <cstring>
 #include <string>
+#include <system_error>
+#include <thread>
 #include <vector>
 
 namespace {
@@ -305,20 +310,11 @@ void serve_connection(int fd) {
   }
 }
 
-}  // namespace
-
-int main(int argc, char** argv) {
-  if (argc != 2) {
-    std::fprintf(stderr, "usage: %s <port>\n", argv[0]);
-    return 2;
-  }
-  const int port = std::atoi(argv[1]);
-  ::signal(SIGPIPE, SIG_IGN);
-
+int listen_on(int port) {
   int srv = ::socket(AF_INET, SOCK_STREAM, 0);
   if (srv < 0) {
     std::perror("socket");
-    return 1;
+    return -1;
   }
   int one = 1;
   ::setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
@@ -328,25 +324,72 @@ int main(int argc, char** argv) {
   addr.sin_port = htons(static_cast<uint16_t>(port));
   if (::bind(srv, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
     std::perror("bind");
-    return 1;
+    return -1;
   }
-  if (::listen(srv, 16) < 0) {
+  if (::listen(srv, 64) < 0) {
     std::perror("listen");
-    return 1;
+    return -1;
   }
-  // Readiness line on stdout — the Python test waits for it.
-  std::printf("cpp_node listening on 127.0.0.1:%d\n", port);
-  std::fflush(stdout);
+  return srv;
+}
 
+void accept_loop(int srv) {
+  int one = 1;
   for (;;) {
     int fd = ::accept(srv, nullptr, nullptr);
     if (fd < 0) {
-      if (errno == EINTR) continue;
+      // Transient conditions (reset-in-backlog, fd/thread pressure)
+      // must not kill the listener: the port would keep accepting TCP
+      // connections from its backlog while serving no frames, hanging
+      // clients silently.
+      if (errno == EINTR || errno == ECONNABORTED) continue;
+      if (errno == EMFILE || errno == ENFILE || errno == EAGAIN) {
+        std::perror("accept (transient, retrying)");
+        ::usleep(10 * 1000);
+        continue;
+      }
+      // Anything else is fatal: exit loudly (pre-pool behavior) so a
+      // supervisor notices, instead of degrading one port silently.
       std::perror("accept");
-      return 1;
+      std::exit(1);
     }
     ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-    serve_connection(fd);
-    ::close(fd);
+    try {
+      std::thread([fd]() {
+        serve_connection(fd);
+        ::close(fd);
+      }).detach();
+    } catch (const std::system_error&) {
+      // Thread limit hit: serve this connection inline (serial but
+      // correct) rather than aborting the whole process.
+      serve_connection(fd);
+      ::close(fd);
+    }
   }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    std::fprintf(stderr, "usage: %s <port> [<port> ...]\n", argv[0]);
+    return 2;
+  }
+  ::signal(SIGPIPE, SIG_IGN);
+
+  std::vector<int> socks;
+  for (int i = 1; i < argc; ++i) {
+    int srv = listen_on(std::atoi(argv[i]));
+    if (srv < 0) return 1;
+    socks.push_back(srv);
+  }
+  // Readiness lines on stdout — the Python test waits for the first.
+  for (int i = 1; i < argc; ++i)
+    std::printf("cpp_node listening on 127.0.0.1:%d\n", std::atoi(argv[i]));
+  std::fflush(stdout);
+
+  std::vector<std::thread> listeners;
+  for (int srv : socks) listeners.emplace_back(accept_loop, srv);
+  for (auto& t : listeners) t.join();
+  return 0;
 }

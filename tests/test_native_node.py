@@ -42,14 +42,26 @@ def cpp_node_bin():
     return str(binary)
 
 
-@pytest.fixture()
-def cpp_node(cpp_node_bin):
+def _free_ports(n):
+    """n distinct free ports; all probe sockets stay open until every
+    port is collected, so the kernel can't hand back a duplicate."""
     import socket
 
-    # Pick a free port, then hand it to the node.
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
+    socks = []
+    try:
+        for _ in range(n):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
+@pytest.fixture()
+def cpp_node(cpp_node_bin):
+    (port,) = _free_ports(1)
     proc = subprocess.Popen(
         [cpp_node_bin, str(port)],
         stdout=subprocess.PIPE,
@@ -187,6 +199,66 @@ class TestCppNode:
         _, ga, gb = ref_logp_grad(1.0, 2.0, 0.3, x, y)
         np.testing.assert_allclose(np.asarray(g), [ga, gb], rtol=1e-4)
         client.close()
+
+
+class TestCppNodePool:
+    def test_multiport_pool_and_concurrent_clients(self, cpp_node_bin):
+        """One process, several ports (the reference's worker pool,
+        reference: demo_node.py:98-108, collapsed into threads), with
+        concurrent clients hammering every port at once — every reply
+        must carry the right numbers for its own request."""
+        from pytensor_federated_tpu.service import TcpArraysClient
+
+        ports = _free_ports(3)
+        proc = subprocess.Popen(
+            [cpp_node_bin] + [str(p) for p in ports],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        try:
+            for _ in ports:  # one readiness line per port
+                line = proc.stdout.readline()
+                assert "listening" in line, line
+
+            rng = np.random.default_rng(3)
+            x = rng.normal(size=64)
+            y = 2.0 * x
+            errors = []
+
+            def hammer(port, slope_base):
+                try:
+                    client = TcpArraysClient("127.0.0.1", port)
+                    for i in range(20):
+                        slope = slope_base + i * 0.01
+                        out = client.evaluate(
+                            np.float64(0.0),
+                            np.float64(slope),
+                            np.float64(1.0),
+                            x,
+                            y,
+                        )
+                        want, _, _ = ref_logp_grad(0.0, slope, 1.0, x, y)
+                        np.testing.assert_allclose(
+                            float(out[0]), want, rtol=1e-12
+                        )
+                    client.close()
+                except Exception as e:  # surfaced after join
+                    errors.append(e)
+
+            threads = [
+                threading.Thread(target=hammer, args=(p, 0.1 * j))
+                for j, p in enumerate(ports)
+                for _ in range(2)  # two concurrent clients per port
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert not errors, errors
+        finally:
+            proc.kill()
+            proc.wait()
 
 
 class TestPythonTcpServer:
