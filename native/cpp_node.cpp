@@ -119,8 +119,18 @@ class Reader {
     return bytes(out, sizeof(T));
   }
   const uint8_t* cursor() const { return p_ + off_; }
+  size_t remaining() const { return n_ - off_; }
   bool skip(size_t k) {
     if (off_ + k > n_) return false;
+    off_ += k;
+    return true;
+  }
+  // Bounds-check BEFORE copying: assigning from cursor() with an
+  // attacker-controlled length and checking afterwards is a heap
+  // overread.
+  bool str(std::string* out, size_t k) {
+    if (off_ + k > n_) return false;
+    out->assign(reinterpret_cast<const char*>(p_ + off_), k);
     off_ += k;
     return true;
   }
@@ -150,27 +160,28 @@ bool decode(const std::vector<uint8_t>& buf, Message* msg, std::string* why) {
   }
   if (flags & kFlagError) {
     uint32_t elen = 0;
-    if (!r.le(&elen)) {
+    if (!r.le(&elen) || !r.str(&msg->error, elen)) {
       *why = "truncated error block";
       return false;
     }
-    msg->error.assign(reinterpret_cast<const char*>(r.cursor()), elen);
-    if (!r.skip(elen)) {
-      *why = "truncated error block";
-      return false;
-    }
+  }
+  // Each array needs >= 11 bytes of headers; an n_arrays larger than
+  // the remaining payload is garbage and would otherwise drive a
+  // multi-GiB resize before any per-array read fails.
+  if (n_arrays > r.remaining()) {
+    *why = "array count exceeds payload";
+    return false;
   }
   msg->arrays.resize(n_arrays);
   for (auto& a : msg->arrays) {
     uint16_t dtlen = 0;
     uint8_t ndim = 0;
     uint64_t dlen = 0;
-    if (!r.le(&dtlen)) {
+    if (!r.le(&dtlen) || !r.str(&a.dtype, dtlen)) {
       *why = "truncated dtype";
       return false;
     }
-    a.dtype.assign(reinterpret_cast<const char*>(r.cursor()), dtlen);
-    if (!r.skip(dtlen) || !r.le(&ndim)) {
+    if (!r.le(&ndim)) {
       *why = "truncated dtype/ndim";
       return false;
     }
@@ -182,6 +193,10 @@ bool decode(const std::vector<uint8_t>& buf, Message* msg, std::string* why) {
       }
     if (!r.le(&dlen)) {
       *why = "truncated data length";
+      return false;
+    }
+    if (dlen > r.remaining()) {  // reject before the resize allocates
+      *why = "truncated data";
       return false;
     }
     a.data.resize(static_cast<size_t>(dlen));

@@ -5,6 +5,7 @@ from .convergence import effective_sample_size, split_rhat, summary
 from .predictive import posterior_predictive, prior_predictive
 from .ensemble import EnsembleResult, ensemble_sample
 from .laplace import LaplaceResult, laplace_approximation
+from .pathfinder import PathfinderResult, multipath_pathfinder, pathfinder
 from .hmc import HMCState, find_reasonable_step_size, hmc_init, hmc_step, leapfrog
 from .mcmc import SampleResult, find_map, sample
 from .metropolis import metropolis_init, metropolis_step
@@ -17,6 +18,7 @@ __all__ = [
     "AdaptSchedule",
     "EnsembleResult",
     "LaplaceResult",
+    "PathfinderResult",
     "SMCResult",
     "advi_fit",
     "ensemble_sample",
@@ -28,6 +30,8 @@ __all__ = [
     "find_map",
     "find_reasonable_step_size",
     "laplace_approximation",
+    "multipath_pathfinder",
+    "pathfinder",
     "flatten_logp",
     "split_rhat",
     "summary",

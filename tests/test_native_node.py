@@ -261,6 +261,74 @@ class TestCppNodePool:
             proc.wait()
 
 
+class TestCppNodeHostileFrames:
+    def _send_raw(self, port, payload):
+        import socket as socket_mod
+        import struct
+
+        with socket_mod.create_connection(("127.0.0.1", port), 5) as s:
+            s.sendall(struct.pack("<I", len(payload)) + payload)
+            s.settimeout(5)
+            try:
+                hdr = s.recv(4)
+            except (ConnectionResetError, TimeoutError):
+                return None
+            if len(hdr) < 4:
+                return None
+            (n,) = struct.unpack("<I", hdr)
+            buf = b""
+            while len(buf) < n:
+                chunk = s.recv(n - len(buf))
+                if not chunk:
+                    return None
+                buf += chunk
+            return buf
+
+    def test_truncated_lengths_fail_loudly_not_crash(self, cpp_node):
+        """Attacker-controlled length fields (err_len, dtype_len,
+        n_arrays, data_len) far beyond the payload must produce a
+        decode-error reply or a closed connection — never a crash or
+        multi-GiB allocation — and the node must keep serving."""
+        import struct
+
+        import numpy as np  # noqa: F811 (clarity)
+
+        from pytensor_federated_tpu.service import TcpArraysClient
+
+        uuid = b"\x00" * 16
+        base = b"NPW1" + bytes([1])  # magic + version
+        hostile = [
+            # flags=1, err_len=0xFFFFFFFF, no error bytes
+            base + bytes([1]) + uuid + struct.pack("<I", 0)
+            + struct.pack("<I", 0xFFFFFFFF),
+            # n_arrays=0xFFFFFFFF (allocation bomb)
+            base + bytes([0]) + uuid + struct.pack("<I", 0xFFFFFFFF),
+            # one array, dtype_len=0xFFFF beyond payload
+            base + bytes([0]) + uuid + struct.pack("<I", 1)
+            + struct.pack("<H", 0xFFFF),
+            # one array, valid dtype, data_len=2^62
+            base + bytes([0]) + uuid + struct.pack("<I", 1)
+            + struct.pack("<H", 3) + b"<f8" + bytes([0])
+            + struct.pack("<Q", 1 << 62),
+        ]
+        for payload in hostile:
+            reply = self._send_raw(cpp_node, payload)
+            if reply is not None:  # error reply is fine; crash is not
+                assert b"truncated" in reply or b"exceeds" in reply, reply
+
+        # The node survived all of it and still serves real requests.
+        client = TcpArraysClient("127.0.0.1", cpp_node)
+        out = client.evaluate(
+            np.float64(0.0),
+            np.float64(1.0),
+            np.float64(1.0),
+            np.zeros(4),
+            np.zeros(4),
+        )
+        assert len(out) == 3
+        client.close()
+
+
 class TestPythonTcpServer:
     """The pure-Python peer (serve_tcp_once) speaks the same protocol."""
 

@@ -1,0 +1,140 @@
+"""Pathfinder VI (samplers/pathfinder.py).
+
+Oracle 1: Gaussian targets, where the BFGS curvature recovers the exact
+covariance and the ELBO-best fit must match the true moments.  Oracle 2:
+the federated linear-regression posterior, cross-checked against the
+Laplace approximation (itself NUTS-checked in test_laplace.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytensor_federated_tpu.samplers import (
+    laplace_approximation,
+    multipath_pathfinder,
+    pathfinder,
+)
+
+
+class TestGaussianTarget:
+    def test_recovers_moments(self):
+        A = jnp.asarray([[2.0, 0.6], [0.6, 1.5]])
+        mu = jnp.asarray([1.0, -1.0])
+
+        def logp(p):
+            d = p["x"] - mu
+            return -0.5 * d @ A @ d
+
+        res = pathfinder(
+            logp,
+            {"x": jnp.zeros(2)},
+            jax.random.PRNGKey(0),
+            num_steps=300,
+            num_draws=4000,
+        )
+        np.testing.assert_allclose(
+            np.asarray(res.mean_flat), np.asarray(mu), atol=0.05
+        )
+        # VI-grade covariance accuracy (the windowed-BFGS fit is an
+        # approximation, not the exact Hessian inverse).
+        np.testing.assert_allclose(
+            np.asarray(res.cov_flat),
+            np.linalg.inv(np.asarray(A)),
+            atol=0.25,
+        )
+        emp_mean = jnp.mean(res.samples["x"], axis=0)
+        np.testing.assert_allclose(
+            np.asarray(emp_mean), np.asarray(mu), atol=0.1
+        )
+        assert float(res.elbo) > -2.0  # ~ -H[q] for a near-exact fit
+
+    def test_isotropic_converges_in_one_linesearch(self):
+        """On N(0, I) the very first L-BFGS line-search step lands on
+        the optimum (H0 = I is exact), so the selected fit — whichever
+        iterate wins — must already be the exact posterior."""
+
+        def logp(p):
+            return -0.5 * jnp.sum(p["x"] ** 2)
+
+        res = pathfinder(
+            logp,
+            {"x": 3.0 * jnp.ones(3)},
+            jax.random.PRNGKey(1),
+            num_steps=200,
+        )
+        np.testing.assert_allclose(
+            np.asarray(res.mean_flat), np.zeros(3), atol=1e-3
+        )
+        np.testing.assert_allclose(
+            np.asarray(res.cov_flat), np.eye(3), atol=0.1
+        )
+        assert np.isfinite(float(res.elbo))
+
+
+class TestDegenerate:
+    def test_stationary_start_raises(self):
+        """Starting exactly at the mode gives a zero-length path with
+        no curvature pairs — must fail loudly, not return N(mode, I)."""
+
+        def logp(p):
+            return -0.5 * jnp.sum(p["x"] ** 2)
+
+        with np.testing.assert_raises(ValueError):
+            pathfinder(
+                logp,
+                {"x": jnp.zeros(3)},
+                jax.random.PRNGKey(7),
+                num_steps=50,
+            )
+
+
+class TestFederatedPosterior:
+    def test_agrees_with_laplace(self):
+        from pytensor_federated_tpu.models.linear import (
+            FederatedLinearRegression,
+            generate_node_data,
+        )
+
+        data, _ = generate_node_data(4, n_obs=64, seed=3)
+        model = FederatedLinearRegression(data)
+        lap = laplace_approximation(
+            model.logp, model.init_params(), num_steps=1500
+        )
+        res = pathfinder(
+            model.logp,
+            model.init_params(),
+            jax.random.PRNGKey(2),
+            num_steps=400,
+            num_draws=2000,
+        )
+        # Means agree tightly; marginal sds within 30%.
+        np.testing.assert_allclose(
+            np.asarray(res.mean_flat),
+            np.asarray(lap.mean_flat),
+            atol=0.05,
+        )
+        np.testing.assert_allclose(
+            np.sqrt(np.diag(np.asarray(res.cov_flat))),
+            np.sqrt(np.diag(np.asarray(lap.cov_flat))),
+            rtol=0.3,
+        )
+
+    def test_multipath(self):
+        def logp(p):
+            return -0.5 * jnp.sum((p["x"] - 2.0) ** 2)
+
+        res = multipath_pathfinder(
+            logp,
+            {"x": jnp.zeros(2)},
+            jax.random.PRNGKey(4),
+            num_paths=3,
+            num_steps=150,
+            num_draws=900,
+        )
+        assert res.samples["x"].shape == (900, 2)
+        np.testing.assert_allclose(
+            np.asarray(jnp.mean(res.samples["x"], axis=0)),
+            [2.0, 2.0],
+            atol=0.15,
+        )
