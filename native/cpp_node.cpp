@@ -118,16 +118,10 @@ class Reader {
   bool le(T* out) {  // all wire ints are little-endian; assume LE host
     return bytes(out, sizeof(T));
   }
-  const uint8_t* cursor() const { return p_ + off_; }
   size_t remaining() const { return n_ - off_; }
-  bool skip(size_t k) {
-    if (off_ + k > n_) return false;
-    off_ += k;
-    return true;
-  }
-  // Bounds-check BEFORE copying: assigning from cursor() with an
-  // attacker-controlled length and checking afterwards is a heap
-  // overread.
+  // Bounds-check BEFORE copying: assigning from a raw cursor with an
+  // attacker-controlled length and checking afterwards would be a
+  // heap overread, so no unchecked cursor accessor exists.
   bool str(std::string* out, size_t k) {
     if (off_ + k > n_) return false;
     out->assign(reinterpret_cast<const char*>(p_ + off_), k);
@@ -303,10 +297,16 @@ Message compute(const Message& in) {
 
 // ---- server loop --------------------------------------------------------
 
+// Upper bound on one frame's payload.  Big enough for any realistic
+// array batch, small enough that a hostile 0xFFFFFFFF length prefix
+// cannot drive a 4 GiB allocation per connection thread.
+constexpr uint32_t kMaxFrameBytes = 256u * 1024 * 1024;
+
 void serve_connection(int fd) {
   for (;;) {
     uint32_t len = 0;
     if (!read_exact(fd, &len, 4)) return;  // peer closed
+    if (len > kMaxFrameBytes) return;      // hostile length prefix
     std::vector<uint8_t> buf(len);
     if (!read_exact(fd, buf.data(), len)) return;
     Message in, reply;

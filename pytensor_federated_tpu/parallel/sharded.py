@@ -178,6 +178,97 @@ class FederatedLogp:
             self._logp_batch = fn
         return fn(params_batch)
 
+    def logp_minibatch(
+        self, params: Any, key: jax.Array, num_shards: int
+    ) -> jax.Array:
+        """Unbiased minibatch estimate of :meth:`logp` from a random
+        subset of ``num_shards`` shards (scaled by ``S/k``).
+
+        The subsample is a *gather*, not a mask, so compute really
+        drops to ``k/S`` of the full pass — the federated-scale analog
+        of data subsampling for stochastic-gradient samplers (see
+        ``samplers.sgld``).  On a mesh each device subsamples its own
+        local block (``num_shards`` must be divisible by the axis
+        size), so no shard data ever moves between devices.
+        """
+        return self._minibatch_fns(num_shards)[0](params, key)
+
+    def logp_and_grad_minibatch(
+        self, params: Any, key: jax.Array, num_shards: int
+    ):
+        """(estimate, grad-estimate) of the minibatch logp — the
+        stochastic gradient for SGLD/SGHMC-style samplers."""
+        return self._minibatch_fns(num_shards)[1](params, key)
+
+    def _minibatch_fns(self, num_shards: int):
+        cache = getattr(self, "_minibatch_cache", None)
+        if cache is None:
+            cache = self._minibatch_cache = {}
+        if num_shards in cache:
+            return cache[num_shards]
+        if not (0 < num_shards <= self.n_shards):
+            raise ValueError(
+                f"num_shards must be in 1..{self.n_shards}, got {num_shards}"
+            )
+        scale = self.n_shards / num_shards
+
+        if self.mesh is not None:
+            axis, mesh = self.axis, self.mesh
+            axis_size = mesh.shape[axis]
+            if num_shards % axis_size != 0:
+                raise ValueError(
+                    f"num_shards={num_shards} not divisible by mesh axis "
+                    f"{axis!r} of size {axis_size}"
+                )
+            k_local = num_shards // axis_size
+            data_specs = jax.tree_util.tree_map(lambda _: P(axis), self.data)
+
+            def estimate(params, data, key):
+                def local(params, local_data, key):
+                    s_local = _leading_dim(local_data)
+                    dev_key = jax.random.fold_in(
+                        key, jax.lax.axis_index(axis)
+                    )
+                    idx = jax.random.choice(
+                        dev_key, s_local, (k_local,), replace=False
+                    )
+                    sub = jax.tree_util.tree_map(
+                        lambda a: jnp.take(a, idx, axis=0), local_data
+                    )
+                    lp = jax.vmap(
+                        lambda d: self.per_shard_logp(params, d)
+                    )(sub)
+                    return jax.lax.psum(jnp.sum(lp), axis) * scale
+
+                return shard_map(
+                    local,
+                    mesh=mesh,
+                    in_specs=(
+                        jax.tree_util.tree_map(lambda _: P(), params),
+                        data_specs,
+                        P(),
+                    ),
+                    out_specs=P(),
+                )(params, data, key)
+
+        else:
+
+            def estimate(params, data, key):
+                idx = jax.random.choice(
+                    key, self.n_shards, (num_shards,), replace=False
+                )
+                sub = jax.tree_util.tree_map(
+                    lambda a: jnp.take(a, idx, axis=0), data
+                )
+                lp = jax.vmap(lambda d: self.per_shard_logp(params, d))(sub)
+                return jnp.sum(lp) * scale
+
+        logp_mb = jax.jit(lambda p, k: estimate(p, self.data, k))
+        vg = jax.value_and_grad(lambda p, k: estimate(p, self.data, k))
+        fns = (logp_mb, jax.jit(vg))
+        cache[num_shards] = fns
+        return fns
+
     def per_shard_logps(self, params: Any) -> jax.Array:
         """Vector of per-shard contributions (diagnostic; the reference
         exposes these as individual node replies)."""

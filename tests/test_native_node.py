@@ -316,6 +316,17 @@ class TestCppNodeHostileFrames:
             if reply is not None:  # error reply is fine; crash is not
                 assert b"truncated" in reply or b"exceeds" in reply, reply
 
+        # Hostile FRAME length prefix (the outermost allocation bomb):
+        # the node must drop the connection without allocating 4 GiB.
+        import socket as socket_mod
+
+        with socket_mod.create_connection(
+            ("127.0.0.1", cpp_node), 5
+        ) as s:
+            s.sendall(struct.pack("<I", 0xFFFFFFFF))
+            s.settimeout(5)
+            assert s.recv(4) == b""  # server closed the connection
+
         # The node survived all of it and still serves real requests.
         client = TcpArraysClient("127.0.0.1", cpp_node)
         out = client.evaluate(
