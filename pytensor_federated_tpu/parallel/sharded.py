@@ -112,7 +112,13 @@ class FederatedLogp:
                 )
             self.data = _shard_data_to_mesh(data, mesh, axis)
 
-            data_specs = jax.tree_util.tree_map(lambda _: P(axis), self.data)
+            # Stored once: the minibatch path reuses the same specs, so
+            # a future layout change can't silently diverge between the
+            # full and subsampled evaluators.
+            self._data_specs = jax.tree_util.tree_map(
+                lambda _: P(axis), self.data
+            )
+            data_specs = self._data_specs
 
             def total_logp(params, data):
                 def local(params, local_data):
@@ -221,7 +227,7 @@ class FederatedLogp:
                     f"{axis!r} of size {axis_size}"
                 )
             k_local = num_shards // axis_size
-            data_specs = jax.tree_util.tree_map(lambda _: P(axis), self.data)
+            data_specs = self._data_specs
 
             def estimate(params, data, key):
                 def local(params, local_data, key):

@@ -17,7 +17,7 @@ constant step.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Optional
+from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
@@ -40,6 +40,27 @@ def polynomial_decay(
         return a * (b + t) ** (-gamma)
 
     return schedule
+
+
+def _run_chain(step_fn, carry0, *, num_samples, num_burnin, thin, unravel):
+    """Scan a Langevin chain and slice out the kept draws (shared by
+    SGLD and SGHMC so the thinning/packaging can never diverge).
+
+    ``step_fn(carry, t) -> (carry, (x_flat, logp_estimate))`` where the
+    emitted pair refers to the SAME pre-update state."""
+    total = num_burnin + num_samples * thin
+    _, (xs, lps) = jax.lax.scan(step_fn, carry0, jnp.arange(total))
+    keep = xs[num_burnin::thin][:num_samples]
+    lps = lps[num_burnin::thin][:num_samples]
+    return SGLDResult(
+        samples=jax.vmap(unravel)(keep), logps=lps, unravel=unravel
+    )
+
+
+def _as_schedule(step_size):
+    """Float-or-callable step size -> ``t -> eps_t`` callable (shared
+    contract for both samplers)."""
+    return step_size if callable(step_size) else (lambda t: step_size)
 
 
 def sgld_sample(
@@ -68,9 +89,7 @@ def sgld_sample(
     from jax.flatten_util import ravel_pytree
 
     flat_init, unravel = ravel_pytree(init_params)
-
-    eps_fn = step_size if callable(step_size) else (lambda t: step_size)
-    total = num_burnin + num_samples * thin
+    eps_fn = _as_schedule(step_size)
 
     def step(carry, t):
         x, k = carry
@@ -86,11 +105,66 @@ def sgld_sample(
         # pre-update x, so that's the iterate recorded with it.
         return (x_new, k), (x, lp)
 
-    (_, _), (xs, lps) = jax.lax.scan(
-        step, (flat_init, key), jnp.arange(total)
+    return _run_chain(
+        step,
+        (flat_init, key),
+        num_samples=num_samples,
+        num_burnin=num_burnin,
+        thin=thin,
+        unravel=unravel,
     )
-    keep = xs[num_burnin::thin][:num_samples]
-    lps = lps[num_burnin::thin][:num_samples]
-    return SGLDResult(
-        samples=jax.vmap(unravel)(keep), logps=lps, unravel=unravel
+
+
+def sghmc_sample(
+    logp_and_grad_fn: Callable[[Any, jax.Array], tuple],
+    init_params: Any,
+    key: jax.Array,
+    *,
+    num_samples: int = 1000,
+    num_burnin: int = 500,
+    step_size: Any = 1e-3,
+    friction: float = 1.0,
+    thin: int = 1,
+) -> SGLDResult:
+    """Stochastic-gradient Hamiltonian Monte Carlo (Chen et al. 2014).
+
+    Same oracle and ``step_size`` (float or ``t -> eps_t`` schedule)
+    contract as :func:`sgld_sample`, but with a momentum variable and
+    friction: per step,
+
+        v <- (1 - eps*C) v + eps * grad + N(0, 2*C*eps)
+        theta <- theta + eps * v
+
+    — underdamped Langevin whose friction ``C`` dissipates the
+    stochastic-gradient noise, typically mixing faster than SGLD on
+    correlated posteriors.  Identity mass, no Metropolis correction.
+    """
+    from jax.flatten_util import ravel_pytree
+
+    flat_init, unravel = ravel_pytree(init_params)
+    eps_fn = _as_schedule(step_size)
+
+    def step(carry, t):
+        x, v, k = carry
+        k, k_grad, k_noise = jax.random.split(k, 3)
+        lp, g = logp_and_grad_fn(unravel(x), k_grad)
+        g_flat = ravel_pytree(g)[0]
+        eps = eps_fn(t)
+        noise_sd = jnp.sqrt(2.0 * friction * eps)
+        v = (
+            (1.0 - eps * friction) * v
+            + eps * g_flat
+            + noise_sd * jax.random.normal(k_noise, x.shape, x.dtype)
+        )
+        x_new = x + eps * v
+        return (x_new, v, k), (x, lp)
+
+    v0 = jnp.zeros_like(flat_init)
+    return _run_chain(
+        step,
+        (flat_init, v0, key),
+        num_samples=num_samples,
+        num_burnin=num_burnin,
+        thin=thin,
+        unravel=unravel,
     )

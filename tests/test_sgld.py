@@ -8,6 +8,7 @@ import pytest
 from pytensor_federated_tpu.parallel import FederatedLogp, make_mesh
 from pytensor_federated_tpu.samplers.sgld import (
     polynomial_decay,
+    sghmc_sample,
     sgld_sample,
 )
 
@@ -103,6 +104,33 @@ class TestSGLD:
         # Langevin with eps=0.01 inflates variance by ~eps/4 only.
         np.testing.assert_allclose(
             np.asarray(jnp.var(xs, axis=0)), [0.25, 0.25], rtol=0.25
+        )
+
+    def test_sghmc_gaussian_target(self):
+        def oracle(params, _key):
+            return jax.value_and_grad(
+                lambda p: -0.5 * jnp.sum((p["x"] + 1.0) ** 2 / 0.5)
+            )(params)
+
+        # Near-critical damping (C ~ sqrt(curvature)) mixes fastest:
+        # more friction pushes into the slow overdamped regime, less
+        # into underdamped oscillation.
+        res = sghmc_sample(
+            oracle,
+            {"x": jnp.zeros(2)},
+            jax.random.PRNGKey(5),
+            num_samples=3000,
+            num_burnin=500,
+            step_size=0.05,
+            friction=2.0,
+            thin=3,
+        )
+        xs = res.samples["x"]
+        np.testing.assert_allclose(
+            np.asarray(jnp.mean(xs, axis=0)), [-1.0, -1.0], atol=0.1
+        )
+        np.testing.assert_allclose(
+            np.asarray(jnp.var(xs, axis=0)), [0.5, 0.5], rtol=0.25
         )
 
     def test_federated_minibatch_sgld(self):
