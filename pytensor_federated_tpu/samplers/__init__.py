@@ -6,7 +6,13 @@ from .predictive import posterior_predictive, prior_predictive
 from .ensemble import EnsembleResult, ensemble_sample
 from .laplace import LaplaceResult, laplace_approximation
 from .pathfinder import PathfinderResult, multipath_pathfinder, pathfinder
-from .sgld import SGLDResult, polynomial_decay, sghmc_sample, sgld_sample
+from .sgld import (
+    SGLDResult,
+    polynomial_decay,
+    psgld_sample,
+    sghmc_sample,
+    sgld_sample,
+)
 from .hmc import HMCState, find_reasonable_step_size, hmc_init, hmc_step, leapfrog
 from .mcmc import SampleResult, find_map, sample
 from .metropolis import metropolis_init, metropolis_step
@@ -35,6 +41,7 @@ __all__ = [
     "multipath_pathfinder",
     "pathfinder",
     "polynomial_decay",
+    "psgld_sample",
     "sghmc_sample",
     "sgld_sample",
     "flatten_logp",
