@@ -215,6 +215,11 @@ def main():
                 "value": round(evals_per_sec, 1),
                 "unit": "evals/s",
                 "vs_baseline": round(evals_per_sec / NORTH_STAR, 3),
+                # Honesty fields: which device actually ran (the
+                # preflight falls back to CPU on a wedged tunnel) and
+                # which racing implementation won.
+                "backend": jax.default_backend(),
+                "impl": best,
             }
         )
     )

@@ -106,3 +106,35 @@ class TestUlyssesAttention:
         q, k, v = _qkv(7, t=16, h=4, d=8)
         with pytest.raises(ValueError, match="shapes differ"):
             ulysses_attention(q, k[:, :2], v, mesh=seq_mesh, axis="seq")
+
+    @pytest.mark.parametrize(
+        "t,h,d,causal",
+        [
+            (4, 4, 1, False),  # one position per device, scalar head dim
+            (4, 4, 1, True),
+            (8, 8, 2, True),  # head count > mesh, minimal blocks
+            (64, 4, 4, True),  # long sequence, few heads
+            (16, 12, 3, False),  # non-power-of-two head count (12 % 4 == 0)
+        ],
+    )
+    def test_dimension_corners(self, seq_mesh, t, h, d, causal):
+        """Both SP schemes == dense MHA across shape corners (the
+        degenerate block sizes are where index arithmetic breaks)."""
+        q, k, v = _qkv(hash((t, h, d, causal)) % 2**31, t=t, h=h, d=d)
+        ref = dense_mha(q, k, v, causal=causal)
+        out_u = ulysses_attention(
+            q, k, v, mesh=seq_mesh, axis="seq", causal=causal
+        )
+        np.testing.assert_allclose(
+            np.asarray(out_u), np.asarray(ref), atol=2e-5
+        )
+        out_r = jax.vmap(
+            lambda qh, kh, vh: ring_attention(
+                qh, kh, vh, mesh=seq_mesh, axis="seq", causal=causal
+            ),
+            in_axes=1,
+            out_axes=1,
+        )(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(out_r), np.asarray(ref), atol=2e-5
+        )
