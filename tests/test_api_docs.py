@@ -1,0 +1,24 @@
+"""docs/api.md stays in sync with the live public surface."""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_api_docs_fresh():
+    # Scrub the tunneled-TPU env vars: the child must never dial the
+    # plugin (conftest's in-process force_cpu_backend does not protect
+    # subprocesses), and a wedged relay must fail the test, not hang it.
+    env = {**os.environ, "PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "gen_api_docs.py"), "--check"],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        env=env,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
