@@ -15,6 +15,8 @@ from .statespace import (
     kalman_logp_seq,
     kalman_smoother_parallel,
     kalman_smoother_seq,
+    kalman_smoother_with_lag1,
+    lgssm_em,
     sample_latents,
 )
 from .timeseries import SeqShardedAR1, generate_ar1_data
@@ -31,6 +33,8 @@ __all__ = [
     "kalman_logp_seq",
     "kalman_smoother_parallel",
     "kalman_smoother_seq",
+    "kalman_smoother_with_lag1",
+    "lgssm_em",
     "sample_latents",
     "dense_vfe_logp",
     "generate_ar1_data",

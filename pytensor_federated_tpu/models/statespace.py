@@ -300,6 +300,13 @@ def _filtered_moments(params, y, mask=None):
     return means, covs
 
 
+def _smoother_gain(F, Q, Pf):
+    """RTS smoother gain ``G = Pf F' (F Pf F' + Q)^{-1}`` — the single
+    definition shared by every smoothing path."""
+    Pp = F @ Pf @ F.T + Q
+    return jnp.linalg.solve(Pp, F @ Pf).T, Pp
+
+
 def kalman_smoother_seq(params: Any, y: jax.Array, mask: Any = None):
     """Smoothed marginals ``(means, covs)`` via the classic backward
     Rauch-Tung-Striebel recursion (golden reference; O(T) depth)."""
@@ -309,8 +316,7 @@ def kalman_smoother_seq(params: Any, y: jax.Array, mask: Any = None):
     def back(carry, mc):
         ms_next, Ps_next = carry
         m, Pcov = mc
-        Pp = F @ Pcov @ F.T + Q
-        G = jnp.linalg.solve(Pp, F @ Pcov).T
+        G, Pp = _smoother_gain(F, Q, Pcov)
         ms = m + G @ (ms_next - F @ m)
         Ps = Pcov + G @ (Ps_next - Pp) @ G.T
         return (ms, Ps), (ms, Ps)
@@ -330,8 +336,7 @@ def _smooth_elements(F, Q, means, covs):
     filtered terminal ``(0, m_T, P_T)`` at T."""
 
     def one(m, Pcov):
-        Pp = F @ Pcov @ F.T + Q
-        G = jnp.linalg.solve(Pp, F @ Pcov).T
+        G, Pp = _smoother_gain(F, Q, Pcov)
         E = G
         g = m - G @ (F @ m)
         L = Pcov - G @ Pp @ G.T
@@ -355,13 +360,9 @@ def _smooth_combine(e1, e2):
     return E, g, L
 
 
-def kalman_smoother_parallel(params: Any, y: jax.Array, mask: Any = None):
-    """Smoothed marginals with O(log T)-depth associative scans (one
-    forward for filtering, one reverse for smoothing).  The backward
-    kernels depend on observations only through the filtered moments,
-    so masking enters via the filter alone."""
-    F, H, Q, R, m0, P0 = _unpack(params)
-    means, covs = _filtered_moments(params, y, mask)
+def _smooth_from_filtered(F, Q, means, covs):
+    """Smoothed marginals from precomputed filtered moments (one
+    reverse associative scan; no second filter pass)."""
     elems = _smooth_elements(F, Q, means, covs)
     # reverse=True passes the accumulated *suffix* (the later
     # composition) as the first argument; _smooth_combine expects
@@ -370,6 +371,128 @@ def kalman_smoother_parallel(params: Any, y: jax.Array, mask: Any = None):
         lambda a, b: _smooth_combine(b, a), elems, reverse=True
     )
     return sm, sP
+
+
+def kalman_smoother_parallel(params: Any, y: jax.Array, mask: Any = None):
+    """Smoothed marginals with O(log T)-depth associative scans (one
+    forward for filtering, one reverse for smoothing).  The backward
+    kernels depend on observations only through the filtered moments,
+    so masking enters via the filter alone."""
+    F, H, Q, R, m0, P0 = _unpack(params)
+    means, covs = _filtered_moments(params, y, mask)
+    return _smooth_from_filtered(F, Q, means, covs)
+
+
+def _lag1_from_moments(F, Q, f_covs, sP):
+    """Lag-one smoothed cross-covs: ``P^s_{t+1,t} = P^s_{t+1} G_t'``."""
+    Gs = jax.vmap(lambda Pf: _smoother_gain(F, Q, Pf)[0])(f_covs[:-1])
+    return sP[1:] @ jnp.swapaxes(Gs, -1, -2)
+
+
+def kalman_smoother_with_lag1(params: Any, y: jax.Array, mask: Any = None):
+    """Smoothed marginals plus lag-one smoothed cross-covariances.
+
+    Returns ``(means, covs, lag1)`` with ``lag1[t] =
+    Cov(z_{t+2}, z_{t+1} | y_{1:T})`` for ``t = 0..T-2`` — the standard
+    RTS identity ``P^s_{t+1,t} = P^s_{t+1} G_t'``.  These are exactly
+    the cross-moments the EM M-step needs (see :func:`lgssm_em`);
+    verified against the dense joint-Gaussian conditional in tests.
+    """
+    F, H, Q, R, m0, P0 = _unpack(params)
+    f_means, f_covs = _filtered_moments(params, y, mask)
+    sm, sP = _smooth_from_filtered(F, Q, f_means, f_covs)
+    return sm, sP, _lag1_from_moments(F, Q, f_covs, sP)
+
+
+def lgssm_em(
+    params: Any,
+    y: jax.Array,
+    *,
+    num_iters: int = 20,
+    mask: Any = None,
+    fit_H: bool = False,
+):
+    """Closed-form EM for the LGSSM (Shumway-Stoffer): each iteration
+    runs the O(log T)-depth smoother as the E-step and updates
+    ``F`` (and optionally ``H``) plus the isotropic noise scales
+    ``log_q``/``log_r`` in closed form.
+
+    Conventions matching :func:`_unpack`: ``Q = exp(log_q) I`` and
+    ``R = exp(log_r) I`` (full matrix M-step solutions are projected to
+    their isotropic part via the trace); the prior ``(m0, P0)`` is held
+    fixed and the transition sum runs over ``t = 2..T`` (the first
+    transition involves the unsmoothed ``z_0``, so the update maximizes
+    the expected complete-data likelihood of transitions 2..T — the
+    exact-EM monotonicity guarantee therefore holds up to that one
+    excluded term, i.e. monotone in practice for moderate ``T`` but not
+    a theorem for tiny series).  Masked steps drop out of the emission
+    update; the transition update uses all smoothed states (exact —
+    smoothing already accounts for missingness).
+
+    Returns ``(params, loglik_history)`` where the history is the exact
+    marginal log-likelihood BEFORE each iteration's update.
+    """
+    y = jnp.asarray(y)
+    if y.ndim == 1:
+        y = y[:, None]
+    T, k = y.shape
+    mask_arr = _as_mask(mask, T, y.dtype)
+    y_s = _sanitize(y, mask_arr)
+
+    def one_iter(params, _):
+        F, H, Q, R, m0, P0 = _unpack(params)
+        d = F.shape[0]
+        # ONE filter pass feeds the loglik, the smoother, and the
+        # lag-one moments (three separate associative-scan filters
+        # would not reliably CSE inside the scan body).
+        f_means, f_covs = _filtered_moments(params, y_s, mask_arr)
+        ll = _predictive_logp(
+            F, H, Q, R, m0, P0, y_s, f_means, f_covs, mask_arr
+        )
+        sm, sP = _smooth_from_filtered(F, Q, f_means, f_covs)
+        lag1 = _lag1_from_moments(F, Q, f_covs, sP)
+        # Joint second moments.
+        Ezz = sP + sm[:, :, None] * sm[:, None, :]  # E[z_t z_t']
+        Ezz1 = lag1 + sm[1:, :, None] * sm[:-1, None, :]  # E[z_t z_{t-1}']
+        A = jnp.sum(Ezz[:-1], axis=0)  # Σ E[z_{t-1} z_{t-1}']
+        B = jnp.sum(Ezz1, axis=0)  # Σ E[z_t z_{t-1}']
+        C = jnp.sum(Ezz[1:], axis=0)  # Σ E[z_t z_t']
+        F_new = jnp.linalg.solve(A.T, B.T).T  # B A^{-1}
+        # Q* = (C - B A^{-1} B') / (T-1), projected to q I.
+        Q_full = (C - F_new @ B.T) / (T - 1)
+        q_new = jnp.trace(Q_full) / d
+        # Emission update over observed steps only.
+        if fit_H:
+            Syz = jnp.sum(
+                mask_arr[:, None, None] * (y_s[:, :, None] * sm[:, None, :]),
+                axis=0,
+            )
+            Szz_obs = jnp.sum(mask_arr[:, None, None] * Ezz, axis=0)
+            H_new = jnp.linalg.solve(Szz_obs.T, Syz.T).T
+        else:
+            H_new = H
+        resid = y_s - sm @ H_new.T
+        n_obs = jnp.sum(mask_arr) * k
+        r_new = (
+            jnp.sum(mask_arr * jnp.sum(resid**2, axis=-1))
+            + jnp.sum(
+                mask_arr
+                * jnp.trace(
+                    H_new @ sP @ H_new.T, axis1=-2, axis2=-1
+                )
+            )
+        ) / jnp.maximum(n_obs, 1.0)
+        new = dict(
+            params,
+            F=F_new,
+            H=H_new,
+            log_q=jnp.log(jnp.maximum(q_new, 1e-12)),
+            log_r=jnp.log(jnp.maximum(r_new, 1e-12)),
+        )
+        return new, ll
+
+    params_out, lls = lax.scan(one_iter, params, None, length=num_iters)
+    return params_out, lls
 
 
 def kalman_forecast(

@@ -477,6 +477,80 @@ class TestEKF:
         np.testing.assert_allclose(lp, ref, rtol=1e-4)
 
 
+class TestLag1Smoother:
+    def test_matches_dense_cross_covariance(self):
+        """Lag-one smoothed cross-covs vs the exact joint conditional."""
+        from pytensor_federated_tpu.models.statespace import (
+            kalman_smoother_with_lag1,
+        )
+
+        y, params = generate_lgssm_data(T=5)
+        T = 5
+        H = np.asarray(params["H"], np.float64)
+        d, k = np.asarray(params["F"]).shape[0], H.shape[0]
+        means, covz = dense_joint_moments(params, T)
+        mu_z = np.concatenate(means)
+        bigH = np.kron(np.eye(T), H)
+        Sz = covz.transpose(0, 2, 1, 3).reshape(T * d, T * d)
+        Syy = bigH @ Sz @ bigH.T + np.exp(
+            float(params["log_r"])
+        ) * np.eye(T * k)
+        Szy = Sz @ bigH.T
+        post_cov = Sz - Szy @ np.linalg.solve(Syy, Szy.T)
+        _, _, lag1 = kalman_smoother_with_lag1(params, y)
+        for t in range(T - 1):
+            want = post_cov[
+                (t + 1) * d : (t + 2) * d, t * d : (t + 1) * d
+            ]
+            np.testing.assert_allclose(
+                np.asarray(lag1[t]), want, rtol=1e-3, atol=1e-4
+            )
+
+
+class TestEM:
+    def test_monotone_and_recovers_scales(self):
+        from pytensor_federated_tpu.models.statespace import lgssm_em
+
+        y, true = generate_lgssm_data(T=512)
+        init = dict(
+            true,
+            F=0.5 * jnp.eye(2),
+            log_q=jnp.asarray(-3.0),
+            log_r=jnp.asarray(0.5),
+        )
+        fitted, lls = lgssm_em(init, y, num_iters=30)
+        lls = np.asarray(lls)
+        # EM invariant: the marginal loglik is monotone non-decreasing.
+        assert np.all(np.diff(lls) > -1e-2), np.diff(lls).min()
+        # Substantial improvement over the perturbed start...
+        assert lls[-1] > lls[0] + 10.0
+        # ...and the noise scales land near the generating values.
+        assert abs(float(fitted["log_q"]) - float(true["log_q"])) < 0.7
+        assert abs(float(fitted["log_r"]) - float(true["log_r"])) < 0.7
+        # No assertion against the generating F or the truth's
+        # likelihood: F is only weakly identified from 1-D observations
+        # of a 2-D latent (similarity transforms leave the likelihood
+        # nearly flat), and EM famously crawls along that manifold —
+        # finite-iteration proximity to the truth is not an EM
+        # guarantee.  Monotonicity, the large improvement, and the
+        # recovered noise scales above are.
+        assert np.isfinite(np.asarray(fitted["F"])).all()
+
+    def test_fit_H_and_masked(self):
+        from pytensor_federated_tpu.models.statespace import lgssm_em
+
+        y, true = generate_lgssm_data(T=256)
+        rng = np.random.default_rng(9)
+        mask = (rng.uniform(size=256) > 0.2).astype(np.float32)
+        init = dict(true, log_r=jnp.asarray(0.3))
+        fitted, lls = lgssm_em(
+            init, y, num_iters=15, mask=mask, fit_H=True
+        )
+        lls = np.asarray(lls)
+        assert np.all(np.diff(lls) > -1e-2), np.diff(lls).min()
+        assert np.isfinite(np.asarray(fitted["H"])).all()
+
+
 class TestForecast:
     def test_matches_dense_joint_conditional(self):
         """Forecast moments == conditional moments of future y rows in
