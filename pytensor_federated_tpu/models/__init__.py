@@ -17,6 +17,7 @@ from .statespace import (
     kalman_smoother_seq,
     kalman_smoother_with_lag1,
     lgssm_em,
+    panel_em,
     sample_latents,
 )
 from .timeseries import SeqShardedAR1, generate_ar1_data
@@ -35,6 +36,7 @@ __all__ = [
     "kalman_smoother_seq",
     "kalman_smoother_with_lag1",
     "lgssm_em",
+    "panel_em",
     "sample_latents",
     "dense_vfe_logp",
     "generate_ar1_data",

@@ -431,56 +431,112 @@ def lgssm_em(
 
     Returns ``(params, loglik_history)`` where the history is the exact
     marginal log-likelihood BEFORE each iteration's update.
+
+    (Implemented as the single-series case of :func:`panel_em` — the
+    pooled M-step over one series IS the classic update.)
     """
     y = jnp.asarray(y)
     if y.ndim == 1:
         y = y[:, None]
-    T, k = y.shape
-    mask_arr = _as_mask(mask, T, y.dtype)
-    y_s = _sanitize(y, mask_arr)
+    return panel_em(
+        params,
+        y[None],
+        num_iters=num_iters,
+        masks=None if mask is None else jnp.asarray(mask)[None],
+        fit_H=fit_H,
+    )
+
+
+def panel_em(
+    params: Any,
+    ys: jax.Array,
+    *,
+    num_iters: int = 20,
+    masks: Any = None,
+    fit_H: bool = False,
+):
+    """Federated EM: one set of LGSSM parameters fit to a whole panel
+    of series (the :class:`FederatedLGSSMPanel` layout).
+
+    The E-step smooths every series independently (vmapped — each an
+    O(log T) scan); the M-step *pools* the sufficient statistics
+    (A, B, C, emission moments) across series before the closed-form
+    update — the federated-analytics shape: every node contributes a
+    handful of d x d matrices, never its raw series.  Same conventions
+    and caveats as :func:`lgssm_em`.
+
+    ``ys``: ``(n_series, T)`` or ``(n_series, T, k)``; ``masks``
+    (optional) ``(n_series, T)``.  Returns ``(params, loglik_history)``
+    with the pooled marginal loglik before each update.
+    """
+    ys = jnp.asarray(ys)
+    if ys.ndim == 2:
+        ys = ys[..., None]
+    S, T, k = ys.shape
+    if masks is None:
+        masks = jnp.ones((S, T), ys.dtype)
+    else:
+        masks = jnp.asarray(masks, ys.dtype)
+    ys = jax.vmap(_sanitize)(ys, masks)
 
     def one_iter(params, _):
         F, H, Q, R, m0, P0 = _unpack(params)
         d = F.shape[0]
-        # ONE filter pass feeds the loglik, the smoother, and the
-        # lag-one moments (three separate associative-scan filters
-        # would not reliably CSE inside the scan body).
-        f_means, f_covs = _filtered_moments(params, y_s, mask_arr)
-        ll = _predictive_logp(
-            F, H, Q, R, m0, P0, y_s, f_means, f_covs, mask_arr
-        )
-        sm, sP = _smooth_from_filtered(F, Q, f_means, f_covs)
-        lag1 = _lag1_from_moments(F, Q, f_covs, sP)
-        # Joint second moments.
-        Ezz = sP + sm[:, :, None] * sm[:, None, :]  # E[z_t z_t']
-        Ezz1 = lag1 + sm[1:, :, None] * sm[:-1, None, :]  # E[z_t z_{t-1}']
-        A = jnp.sum(Ezz[:-1], axis=0)  # Σ E[z_{t-1} z_{t-1}']
-        B = jnp.sum(Ezz1, axis=0)  # Σ E[z_t z_{t-1}']
-        C = jnp.sum(Ezz[1:], axis=0)  # Σ E[z_t z_t']
-        F_new = jnp.linalg.solve(A.T, B.T).T  # B A^{-1}
-        # Q* = (C - B A^{-1} B') / (T-1), projected to q I.
-        Q_full = (C - F_new @ B.T) / (T - 1)
-        q_new = jnp.trace(Q_full) / d
-        # Emission update over observed steps only.
-        if fit_H:
-            Syz = jnp.sum(
-                mask_arr[:, None, None] * (y_s[:, :, None] * sm[:, None, :]),
+
+        def estep(y_i, mask_i):
+            f_means, f_covs = _filtered_moments(params, y_i, mask_i)
+            ll = _predictive_logp(
+                F, H, Q, R, m0, P0, y_i, f_means, f_covs, mask_i
+            )
+            sm, sP = _smooth_from_filtered(F, Q, f_means, f_covs)
+            lag1 = _lag1_from_moments(F, Q, f_covs, sP)
+            Ezz = sP + sm[:, :, None] * sm[:, None, :]
+            Ezz1 = lag1 + sm[1:, :, None] * sm[:-1, None, :]
+            A = jnp.sum(Ezz[:-1], axis=0)
+            B = jnp.sum(Ezz1, axis=0)
+            C = jnp.sum(Ezz[1:], axis=0)
+            # Emission statistics in RESIDUAL form (against the current
+            # H): the raw-moment identity yy - 2tr(H Syz') + tr(H Szz H')
+            # cancels catastrophically in float32 when |y| is large
+            # relative to the noise — residuals and sP traces stay at
+            # noise scale.
+            resid = y_i - sm @ H.T
+            rr = jnp.sum(mask_i * jnp.sum(resid**2, axis=-1))
+            Rz = jnp.sum(
+                mask_i[:, None, None]
+                * (resid[:, :, None] * sm[:, None, :]),
                 axis=0,
             )
-            Szz_obs = jnp.sum(mask_arr[:, None, None] * Ezz, axis=0)
-            H_new = jnp.linalg.solve(Szz_obs.T, Syz.T).T
+            Mzz = jnp.sum(
+                mask_i[:, None, None] * (sm[:, :, None] * sm[:, None, :]),
+                axis=0,
+            )
+            SP_obs = jnp.sum(mask_i[:, None, None] * sP, axis=0)
+            return ll, A, B, C, (rr, Rz, Mzz, SP_obs, jnp.sum(mask_i) * k)
+
+        lls, As, Bs, Cs, rs = jax.vmap(estep)(ys, masks)
+        ll = jnp.sum(lls)
+        A, B, C = jnp.sum(As, 0), jnp.sum(Bs, 0), jnp.sum(Cs, 0)
+        rr, Rz, Mzz, SP_obs, n_obs = (jnp.sum(r, 0) for r in rs)
+        F_new = jnp.linalg.solve(A.T, B.T).T
+        q_new = jnp.trace((C - F_new @ B.T) / (S * (T - 1))) / d
+        if fit_H:
+            # Σ y sm' = Rz + H Mzz;  Σ E[z z']|obs = Mzz + SP_obs.
+            H_new = jnp.linalg.solve(
+                (Mzz + SP_obs).T, (Rz + H @ Mzz).T
+            ).T
         else:
             H_new = H
-        resid = y_s - sm @ H_new.T
-        n_obs = jnp.sum(mask_arr) * k
+        # E Σ||y - H_new z||^2 via the residual stats and dH = H_new - H:
+        # Σ||y - H_new sm||^2 = rr - 2 tr(dH Rz') + tr(dH Mzz dH'),
+        # plus the covariance term tr(H_new SP_obs H_new') — every term
+        # stays at noise/update scale, no large-moment cancellation.
+        dH = H_new - H
         r_new = (
-            jnp.sum(mask_arr * jnp.sum(resid**2, axis=-1))
-            + jnp.sum(
-                mask_arr
-                * jnp.trace(
-                    H_new @ sP @ H_new.T, axis1=-2, axis2=-1
-                )
-            )
+            rr
+            - 2.0 * jnp.trace(dH @ Rz.T)
+            + jnp.trace(dH @ Mzz @ dH.T)
+            + jnp.trace(H_new @ SP_obs @ H_new.T)
         ) / jnp.maximum(n_obs, 1.0)
         new = dict(
             params,

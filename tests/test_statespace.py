@@ -536,6 +536,104 @@ class TestEM:
         # recovered noise scales above are.
         assert np.isfinite(np.asarray(fitted["F"])).all()
 
+    def test_panel_duplicate_series_equals_single(self):
+        """Pooled statistics over two copies of one series must give
+        exactly the single-series update (numerators and denominators
+        both double)."""
+        from pytensor_federated_tpu.models.statespace import (
+            lgssm_em,
+            panel_em,
+        )
+
+        y, true = generate_lgssm_data(T=128)
+        init = dict(true, log_q=jnp.asarray(-2.0), log_r=jnp.asarray(0.2))
+        single, lls1 = lgssm_em(init, y, num_iters=5)
+        panel, lls2 = panel_em(
+            init, jnp.stack([y, y]), num_iters=5
+        )
+        for key in single:
+            np.testing.assert_allclose(
+                np.asarray(panel[key]),
+                np.asarray(single[key]),
+                rtol=1e-4,
+                atol=1e-5,
+                err_msg=key,
+            )
+        np.testing.assert_allclose(
+            np.asarray(lls2), 2.0 * np.asarray(lls1), rtol=1e-5
+        )
+
+    @staticmethod
+    def _simulate_under(params, rng, T):
+        """Simulate one series under the GIVEN shared parameters (the
+        panel contract generate_lgssm_data cannot honor — it draws a
+        fresh H per call)."""
+        F = np.asarray(params["F"], np.float64)
+        H = np.asarray(params["H"], np.float64)
+        d, k = F.shape[0], H.shape[0]
+        q = np.exp(float(params["log_q"]))
+        r = np.exp(float(params["log_r"]))
+        z = rng.normal(size=d)
+        ys = []
+        for _ in range(T):
+            z = F @ z + np.sqrt(q) * rng.normal(size=d)
+            ys.append(H @ z + np.sqrt(r) * rng.normal(size=k))
+        return np.stack(ys).astype(np.float32)
+
+    def test_panel_em_monotone_ragged(self):
+        from pytensor_federated_tpu.models.statespace import panel_em
+
+        _, params = generate_lgssm_data(T=8, seed=404)
+        rng = np.random.default_rng(13)
+        series, masks = [], []
+        for L in [96, 64, 32]:
+            y_i = self._simulate_under(params, rng, L)
+            pad = np.zeros((96, 1), np.float32)
+            pad[:L] = y_i
+            m = np.zeros(96, np.float32)
+            m[:L] = 1.0
+            series.append(pad)
+            masks.append(m)
+        init = dict(params, log_q=jnp.asarray(-2.5), log_r=jnp.asarray(0.4))
+        fitted, lls = panel_em(
+            init,
+            jnp.asarray(np.stack(series)),
+            masks=jnp.asarray(np.stack(masks)),
+            num_iters=12,
+        )
+        lls = np.asarray(lls)
+        assert np.all(np.diff(lls) > -1e-2), np.diff(lls).min()
+        assert lls[-1] > lls[0]
+        # Shared-parameter data: the pooled noise scales must land near
+        # the generating values (log 0.1 / log 0.5).
+        assert abs(float(fitted["log_q"]) - float(params["log_q"])) < 0.6
+        assert abs(float(fitted["log_r"]) - float(params["log_r"])) < 0.6
+
+    def test_large_magnitude_data_stable_in_float32(self):
+        """Unstandardized data (|y| ~ 100, noise ~ 0.1): the residual-
+        form emission update must keep r positive — the raw-moment form
+        yy - 2tr(H Syz') + tr(H Szz H') cancels catastrophically here,
+        clamps R to ~0, and destabilizes every later iteration."""
+        from pytensor_federated_tpu.models.statespace import lgssm_em
+
+        _, params = generate_lgssm_data(T=8, seed=77)
+        big = dict(
+            params,
+            H=100.0 * params["H"],
+            log_r=jnp.asarray(np.log(0.01), jnp.float32),
+        )
+        rng = np.random.default_rng(21)
+        y = self._simulate_under(big, rng, 512)
+        init = dict(big, log_r=jnp.asarray(np.log(0.05), jnp.float32))
+        fitted, lls = lgssm_em(init, jnp.asarray(y), num_iters=8)
+        lls = np.asarray(lls)
+        assert np.isfinite(lls).all(), lls
+        assert np.all(np.diff(lls) > -1e-1), np.diff(lls).min()
+        # r stays at noise scale, never clamped toward zero.
+        assert float(fitted["log_r"]) > np.log(1e-4), float(
+            fitted["log_r"]
+        )
+
     def test_fit_H_and_masked(self):
         from pytensor_federated_tpu.models.statespace import lgssm_em
 
