@@ -330,10 +330,13 @@ def kalman_smoother_seq(params: Any, y: jax.Array, mask: Any = None):
     return sm, sP
 
 
-def _smooth_elements(F, Q, means, covs):
+def _smooth_elements(F, Q, means, covs, *, terminal: bool = True):
     """Per-step smoothing elements ``(E, g, L)``: the backward kernel
-    ``z_t | z_{t+1} ~ N(E_t z_{t+1} + g_t, L_t)`` for t < T, and the
-    filtered terminal ``(0, m_T, P_T)`` at T."""
+    ``z_t | z_{t+1} ~ N(E_t z_{t+1} + g_t, L_t)`` for t < T, and (with
+    ``terminal=True``) the filtered terminal ``(0, m_T, P_T)`` at T.
+    The distributed smoother passes ``terminal=False`` — its last local
+    row is only the global terminal on the last device, selected there
+    per-device rather than re-deriving the kernel."""
 
     def one(m, Pcov):
         G, Pp = _smoother_gain(F, Q, Pcov)
@@ -343,6 +346,8 @@ def _smooth_elements(F, Q, means, covs):
         return E, g, L
 
     E, g, L = jax.vmap(one)(means, covs)
+    if not terminal:
+        return E, g, L
     d = F.shape[0]
     E = E.at[-1].set(jnp.zeros((d, d), F.dtype))
     g = g.at[-1].set(means[-1])
@@ -842,8 +847,72 @@ class SeqShardedLGSSM:
     def logp_and_grad(self, params: Any):
         return self._logp_and_grad(params, self.y, self.mask)
 
+    def smoothed_moments(self, params: Any):
+        """Distributed smoothed marginals ``(means, covs)``, sharded
+        along ``axis`` like ``y`` — the reverse segment-summary scan
+        mirroring the filter (see :func:`_sharded_lgssm_smoother`)."""
+        return _sharded_lgssm_smoother(self.mesh, self.axis)(
+            params, self.y, self.mask
+        )
+
     def init_params(self, d: int = 2) -> Any:
         return default_lgssm_params(d, self.y.shape[-1])
+
+
+def _local_filtered(F, H, Q, R, m0, P0, y_local, mask_local, axis, n):
+    """Distributed filtered moments inside ``shard_map``: local
+    associative scan + all_gather of segment summaries + exclusive
+    prefix composition.  Returns ``(means, covs, prefix)`` where
+    ``prefix`` is the composed element of every segment strictly before
+    this device (identity on device 0).  Shared by the distributed logp
+    and the distributed smoother."""
+    idx = lax.axis_index(axis)
+    # Generic elements everywhere; the prior-conditioned element
+    # only exists at global t=1, i.e. row 0 of device 0.
+    elems = _generic_elements(F, H, Q, R, y_local, mask_local)
+    prior = _prior_element(F, H, Q, R, m0, P0, y_local[0], mask_local[0])
+    elems = jax.tree_util.tree_map(
+        lambda g, p: g.at[0].set(jnp.where(idx == 0, p, g[0])),
+        elems,
+        prior,
+    )
+    local_scan = lax.associative_scan(_combine, elems)
+    # Segment summary = last element of the local scan.
+    summary = jax.tree_util.tree_map(lambda a: a[-1], local_scan)
+    # Gather all n summaries; compose the exclusive prefix of the
+    # segments strictly before this device.
+    gathered = jax.tree_util.tree_map(
+        lambda a: lax.all_gather(a, axis), summary
+    )
+
+    def fold_prefix(r, acc):
+        seg = jax.tree_util.tree_map(lambda a: a[r], gathered)
+        take = r < idx
+        comp = _combine(acc, seg)
+        return jax.tree_util.tree_map(
+            lambda c, a: jnp.where(take, c, a), comp, acc
+        )
+
+    d = F.shape[0]
+    identity = _mark_varying(
+        (
+            jnp.eye(d, dtype=F.dtype),
+            jnp.zeros((d,), F.dtype),
+            jnp.zeros((d, d), F.dtype),
+            jnp.zeros((d, d), F.dtype),
+            jnp.zeros((d,), F.dtype),
+        ),
+        axis,
+    )
+    prefix = lax.fori_loop(0, n - 1, fold_prefix, identity)
+    # Fold the prefix into every local result.
+    pref_b = jax.tree_util.tree_map(
+        lambda a: jnp.broadcast_to(a, (y_local.shape[0],) + a.shape),
+        prefix,
+    )
+    full = _combine(pref_b, local_scan)
+    _, means, covs, _, _ = full
+    return means, covs, prefix
 
 
 @functools.lru_cache(maxsize=64)
@@ -854,51 +923,9 @@ def _sharded_lgssm_logp(mesh, axis):
         F, H, Q, R, m0, P0 = _unpack(params)
         y_local = _sanitize(y_local, mask_local)
         idx = lax.axis_index(axis)
-        # Generic elements everywhere; the prior-conditioned element
-        # only exists at global t=1, i.e. row 0 of device 0.
-        elems = _generic_elements(F, H, Q, R, y_local, mask_local)
-        prior = _prior_element(F, H, Q, R, m0, P0, y_local[0], mask_local[0])
-        elems = jax.tree_util.tree_map(
-            lambda g, p: g.at[0].set(jnp.where(idx == 0, p, g[0])),
-            elems,
-            prior,
+        means, covs, prefix = _local_filtered(
+            F, H, Q, R, m0, P0, y_local, mask_local, axis, n
         )
-        local_scan = lax.associative_scan(_combine, elems)
-        # Segment summary = last element of the local scan.
-        summary = jax.tree_util.tree_map(lambda a: a[-1], local_scan)
-        # Gather all n summaries; compose the exclusive prefix of the
-        # segments strictly before this device.
-        gathered = jax.tree_util.tree_map(
-            lambda a: lax.all_gather(a, axis), summary
-        )
-
-        def fold_prefix(r, acc):
-            seg = jax.tree_util.tree_map(lambda a: a[r], gathered)
-            take = r < idx
-            comp = _combine(acc, seg)
-            return jax.tree_util.tree_map(
-                lambda c, a: jnp.where(take, c, a), comp, acc
-            )
-
-        d = F.shape[0]
-        identity = _mark_varying(
-            (
-                jnp.eye(d, dtype=F.dtype),
-                jnp.zeros((d,), F.dtype),
-                jnp.zeros((d, d), F.dtype),
-                jnp.zeros((d, d), F.dtype),
-                jnp.zeros((d,), F.dtype),
-            ),
-            axis,
-        )
-        prefix = lax.fori_loop(0, n - 1, fold_prefix, identity)
-        # Fold the prefix into every local result.
-        pref_b = jax.tree_util.tree_map(
-            lambda a: jnp.broadcast_to(a, (y_local.shape[0],) + a.shape),
-            prefix,
-        )
-        full = _combine(pref_b, local_scan)
-        _, means, covs, _, _ = full
         # Predictive terms need the filtered state at t-1: element 0 of
         # this segment uses the prefix itself (last filtered state of
         # the previous segment; the prior on device 0).
@@ -933,3 +960,82 @@ def _sharded_lgssm_logp(mesh, axis):
         )(params, y, mask)
 
     return jax.jit(logp)
+
+
+@functools.lru_cache(maxsize=64)
+def _sharded_lgssm_smoother(mesh, axis):
+    """Distributed RTS smoother: the reverse mirror of the filter's
+    segment-summary prefix scan.  Each device builds backward-kernel
+    elements from its (distributed) filtered moments, reverse-scans its
+    segment, all_gathers the per-segment suffix summaries, composes the
+    exclusive suffix of the segments strictly AFTER itself, and folds
+    it into each local result."""
+    n = mesh.shape[axis]
+
+    def local(params, y_local, mask_local):
+        F, H, Q, R, m0, P0 = _unpack(params)
+        y_local = _sanitize(y_local, mask_local)
+        idx = lax.axis_index(axis)
+        means, covs, _ = _local_filtered(
+            F, H, Q, R, m0, P0, y_local, mask_local, axis, n
+        )
+        # Backward-kernel elements everywhere; the terminal (global T)
+        # element only exists on the last row of the LAST device — swap
+        # it in per-device instead of re-deriving any kernel.
+        E, g, L = _smooth_elements(F, Q, means, covs, terminal=False)
+        is_last = idx == n - 1
+        d = F.shape[0]
+        E = E.at[-1].set(
+            jnp.where(is_last, jnp.zeros((d, d), F.dtype), E[-1])
+        )
+        g = g.at[-1].set(jnp.where(is_last, means[-1], g[-1]))
+        L = L.at[-1].set(jnp.where(is_last, covs[-1], L[-1]))
+        elems = (E, g, L)
+        # Local suffix scan: row t holds elems[t] ∘ ... ∘ elems[last].
+        local_scan = lax.associative_scan(
+            lambda a, b: _smooth_combine(b, a), elems, reverse=True
+        )
+        summary = jax.tree_util.tree_map(lambda a: a[0], local_scan)
+        gathered = jax.tree_util.tree_map(
+            lambda a: lax.all_gather(a, axis), summary
+        )
+
+        def fold_suffix(r, acc):
+            seg = jax.tree_util.tree_map(lambda a: a[r], gathered)
+            take = r > idx
+            # acc is the composition of segments idx+1..r-1 (earlier in
+            # time than seg), so acc composes on the left.
+            comp = _smooth_combine(acc, seg)
+            return jax.tree_util.tree_map(
+                lambda c, a: jnp.where(take, c, a), comp, acc
+            )
+
+        identity = _mark_varying(
+            (
+                jnp.eye(d, dtype=F.dtype),
+                jnp.zeros((d,), F.dtype),
+                jnp.zeros((d, d), F.dtype),
+            ),
+            axis,
+        )
+        suffix = lax.fori_loop(1, n, fold_suffix, identity)
+        suf_b = jax.tree_util.tree_map(
+            lambda a: jnp.broadcast_to(a, (y_local.shape[0],) + a.shape),
+            suffix,
+        )
+        _, sm, sP = _smooth_combine(local_scan, suf_b)
+        return sm, sP
+
+    def smooth(params, y, mask):
+        return shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(
+                jax.tree_util.tree_map(lambda _: P(), params),
+                P(axis),
+                P(axis),
+            ),
+            out_specs=(P(axis), P(axis)),
+        )(params, y, mask)
+
+    return jax.jit(smooth)

@@ -205,6 +205,30 @@ class TestSeqSharded:
                 err_msg=key,
             )
 
+    def test_distributed_smoother_matches(self, seq_mesh):
+        """Distributed reverse segment-summary scan == single-device
+        parallel smoother, with and without a mask."""
+        y, params = generate_lgssm_data(T=64)
+        rng = np.random.default_rng(17)
+        mask = (rng.uniform(size=64) > 0.25).astype(np.float32)
+        # Deterministically hit the special-cased rows: global first
+        # and last observations, plus one full device segment (rows
+        # 16..31 on the 4-device mesh) so segment-boundary composition
+        # under total missingness is exercised.
+        mask[0] = 0.0
+        mask[-1] = 0.0
+        mask[16:32] = 0.0
+        for m in (None, mask):
+            model = SeqShardedLGSSM(y, mesh=seq_mesh, axis="seq", mask=m)
+            sm_d, sP_d = model.smoothed_moments(params)
+            sm_ref, sP_ref = kalman_smoother_parallel(params, y, m)
+            np.testing.assert_allclose(
+                np.asarray(sm_d), np.asarray(sm_ref), rtol=1e-3, atol=1e-4
+            )
+            np.testing.assert_allclose(
+                np.asarray(sP_d), np.asarray(sP_ref), rtol=1e-3, atol=1e-4
+            )
+
     def test_indivisible_raises(self, seq_mesh):
         y, _ = generate_lgssm_data(T=30)
         with pytest.raises(ValueError, match="not divisible"):
