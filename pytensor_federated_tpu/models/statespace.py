@@ -833,13 +833,12 @@ class SeqShardedLGSSM:
                 f"sequence length {self.y.shape[0]} not divisible by {n}"
             )
         self.mask = _as_mask(self.mask, self.y.shape[0], self.y.dtype)
+        # Both builders are mesh-keyed lru_caches (Mesh hashes by
+        # devices+axes), so every instance on an equal mesh shares ONE
+        # compiled executable — the distributed VJP compile is the
+        # expensive one.
         self._logp = _sharded_lgssm_logp(self.mesh, self.axis)
-        # Cache the fused pair once (pattern from timeseries.SeqShardedAR1)
-        # so per-step sampler/optimizer calls hit a compiled executable
-        # instead of re-tracing the distributed filter.
-        self._logp_and_grad = jax.jit(
-            jax.value_and_grad(lambda p, y, m: self._logp(p, y, m))
-        )
+        self._logp_and_grad = _sharded_lgssm_vg(self.mesh, self.axis)
 
     def logp(self, params: Any) -> jax.Array:
         return self._logp(params, self.y, self.mask)
@@ -857,6 +856,34 @@ class SeqShardedLGSSM:
 
     def init_params(self, d: int = 2) -> Any:
         return default_lgssm_params(d, self.y.shape[-1])
+
+
+def _exclusive_segment_fold(summary, combine, identity, axis, n, *, suffix):
+    """Inside ``shard_map``: all_gather per-segment summaries and
+    compose, for each device, the exclusive combination of the segments
+    strictly BEFORE it (``suffix=False``) or strictly AFTER it
+    (``suffix=True``).  ``combine(earlier, later)`` composes in time
+    order either way — the fold always walks segments left to right, so
+    ``acc`` is the earlier operand; only the take-predicate and bounds
+    differ.  ``identity`` must already be ``mark_varying``'d over
+    ``axis``.  This is the one copy of the trickiest SPMD logic in the
+    file (uniform-control-flow exclusive scan), shared by the
+    distributed filter and smoother."""
+    idx = lax.axis_index(axis)
+    gathered = jax.tree_util.tree_map(
+        lambda a: lax.all_gather(a, axis), summary
+    )
+
+    def fold(r, acc):
+        seg = jax.tree_util.tree_map(lambda a: a[r], gathered)
+        take = (r > idx) if suffix else (r < idx)
+        comp = combine(acc, seg)
+        return jax.tree_util.tree_map(
+            lambda c, a: jnp.where(take, c, a), comp, acc
+        )
+
+    start, stop = (1, n) if suffix else (0, n - 1)
+    return lax.fori_loop(start, stop, fold, identity)
 
 
 def _local_filtered(F, H, Q, R, m0, P0, y_local, mask_local, axis, n):
@@ -877,22 +904,9 @@ def _local_filtered(F, H, Q, R, m0, P0, y_local, mask_local, axis, n):
         prior,
     )
     local_scan = lax.associative_scan(_combine, elems)
-    # Segment summary = last element of the local scan.
+    # Segment summary = last element of the local scan; compose the
+    # exclusive prefix of the segments strictly before this device.
     summary = jax.tree_util.tree_map(lambda a: a[-1], local_scan)
-    # Gather all n summaries; compose the exclusive prefix of the
-    # segments strictly before this device.
-    gathered = jax.tree_util.tree_map(
-        lambda a: lax.all_gather(a, axis), summary
-    )
-
-    def fold_prefix(r, acc):
-        seg = jax.tree_util.tree_map(lambda a: a[r], gathered)
-        take = r < idx
-        comp = _combine(acc, seg)
-        return jax.tree_util.tree_map(
-            lambda c, a: jnp.where(take, c, a), comp, acc
-        )
-
     d = F.shape[0]
     identity = _mark_varying(
         (
@@ -904,7 +918,9 @@ def _local_filtered(F, H, Q, R, m0, P0, y_local, mask_local, axis, n):
         ),
         axis,
     )
-    prefix = lax.fori_loop(0, n - 1, fold_prefix, identity)
+    prefix = _exclusive_segment_fold(
+        summary, _combine, identity, axis, n, suffix=False
+    )
     # Fold the prefix into every local result.
     pref_b = jax.tree_util.tree_map(
         lambda a: jnp.broadcast_to(a, (y_local.shape[0],) + a.shape),
@@ -963,6 +979,14 @@ def _sharded_lgssm_logp(mesh, axis):
 
 
 @functools.lru_cache(maxsize=64)
+def _sharded_lgssm_vg(mesh, axis):
+    """Fused (logp, grad) of the distributed filter, one compile per
+    (mesh, axis)."""
+    logp = _sharded_lgssm_logp(mesh, axis)
+    return jax.jit(jax.value_and_grad(lambda p, y, m: logp(p, y, m)))
+
+
+@functools.lru_cache(maxsize=64)
 def _sharded_lgssm_smoother(mesh, axis):
     """Distributed RTS smoother: the reverse mirror of the filter's
     segment-summary prefix scan.  Each device builds backward-kernel
@@ -991,25 +1015,13 @@ def _sharded_lgssm_smoother(mesh, axis):
         g = g.at[-1].set(jnp.where(is_last, means[-1], g[-1]))
         L = L.at[-1].set(jnp.where(is_last, covs[-1], L[-1]))
         elems = (E, g, L)
-        # Local suffix scan: row t holds elems[t] ∘ ... ∘ elems[last].
+        # Local suffix scan: row t holds elems[t] ∘ ... ∘ elems[last];
+        # then compose the exclusive suffix of the segments strictly
+        # after this device.
         local_scan = lax.associative_scan(
             lambda a, b: _smooth_combine(b, a), elems, reverse=True
         )
         summary = jax.tree_util.tree_map(lambda a: a[0], local_scan)
-        gathered = jax.tree_util.tree_map(
-            lambda a: lax.all_gather(a, axis), summary
-        )
-
-        def fold_suffix(r, acc):
-            seg = jax.tree_util.tree_map(lambda a: a[r], gathered)
-            take = r > idx
-            # acc is the composition of segments idx+1..r-1 (earlier in
-            # time than seg), so acc composes on the left.
-            comp = _smooth_combine(acc, seg)
-            return jax.tree_util.tree_map(
-                lambda c, a: jnp.where(take, c, a), comp, acc
-            )
-
         identity = _mark_varying(
             (
                 jnp.eye(d, dtype=F.dtype),
@@ -1018,7 +1030,9 @@ def _sharded_lgssm_smoother(mesh, axis):
             ),
             axis,
         )
-        suffix = lax.fori_loop(1, n, fold_suffix, identity)
+        suffix = _exclusive_segment_fold(
+            summary, _smooth_combine, identity, axis, n, suffix=True
+        )
         suf_b = jax.tree_util.tree_map(
             lambda a: jnp.broadcast_to(a, (y_local.shape[0],) + a.shape),
             suffix,
