@@ -738,26 +738,38 @@ class FederatedLGSSMPanel:
 # ---------------------------------------------------------------------------
 
 
+def _affine_combine(e1, e2):
+    """Composition of affine recurrence elements (e1 earlier):
+    ``z -> A2(A1 z + b1) + b2``."""
+    A1, b1 = e1
+    A2, b2 = e2
+    return A2 @ A1, (A2 @ b1[..., None])[..., 0] + b2
+
+
+def _draw_noise(params, key, T):
+    """The model's noise draws — shared by the single-device and
+    distributed simulation smoothers so their generative conventions
+    can never diverge.  Returns ``(z0, w, v)``."""
+    F, H, Q, R, m0, P0 = _unpack(params)
+    d, k = F.shape[0], H.shape[0]
+    kz, kw, kv = jax.random.split(key, 3)
+    z0 = m0 + jnp.linalg.cholesky(P0) @ jax.random.normal(kz, (d,), F.dtype)
+    w = jax.random.normal(kw, (T, d), F.dtype) @ jnp.linalg.cholesky(Q).T
+    v = jax.random.normal(kv, (T, k), F.dtype) @ jnp.linalg.cholesky(R).T
+    return z0, w, v
+
+
 def _simulate(params, key, T):
     """One unconditional draw ``(z*, y*)`` from the model.  The latent
     recurrence ``z_t = F z_{t-1} + w_t`` is itself evaluated with an
     associative scan over affine elements ``(A, b)`` — O(log T) depth,
     keeping the whole simulation smoother parallel-in-time."""
     F, H, Q, R, m0, P0 = _unpack(params)
-    d, k = F.shape[0], H.shape[0]
-    kz, kw, kv = jax.random.split(key, 3)
-    z0 = m0 + jnp.linalg.cholesky(P0) @ jax.random.normal(kz, (d,), F.dtype)
-    w = jax.random.normal(kw, (T, d), F.dtype) @ jnp.linalg.cholesky(Q).T
+    d = F.shape[0]
+    z0, w, v = _draw_noise(params, key, T)
     b = w.at[0].add(F @ z0)
     A = jnp.broadcast_to(F, (T, d, d))
-
-    def affine(e1, e2):
-        A1, b1 = e1
-        A2, b2 = e2
-        return A2 @ A1, (A2 @ b1[..., None])[..., 0] + b2
-
-    _, z = lax.associative_scan(affine, (A, b))
-    v = jax.random.normal(kv, (T, k), F.dtype) @ jnp.linalg.cholesky(R).T
+    _, z = lax.associative_scan(_affine_combine, (A, b))
     y = z @ H.T + v
     return z, y
 
@@ -852,6 +864,19 @@ class SeqShardedLGSSM:
         mirroring the filter (see :func:`_sharded_lgssm_smoother`)."""
         return _sharded_lgssm_smoother(self.mesh, self.axis)(
             params, self.y, self.mask
+        )
+
+    def sample_latents(
+        self, params: Any, key: jax.Array, num_draws: int = 1
+    ) -> jax.Array:
+        """Distributed Durbin-Koopman simulation smoother: joint
+        posterior draws of ``z_{1:T} | y``, sharded along ``axis``.
+        The unconditional simulation is an affine prefix scan over the
+        mesh (same exclusive segment-fold as the filter); each draw
+        costs two distributed smoother passes.  Returns
+        ``(num_draws, T, d)``."""
+        return _sharded_lgssm_sampler(self.mesh, self.axis)(
+            params, self.y, self.mask, key, num_draws
         )
 
     def init_params(self, d: int = 2) -> Any:
@@ -984,6 +1009,68 @@ def _sharded_lgssm_vg(mesh, axis):
     (mesh, axis)."""
     logp = _sharded_lgssm_logp(mesh, axis)
     return jax.jit(jax.value_and_grad(lambda p, y, m: logp(p, y, m)))
+
+
+@functools.lru_cache(maxsize=64)
+def _sharded_lgssm_simulate(mesh, axis):
+    """Distributed unconditional simulation: the latent affine
+    recurrence as a local scan + exclusive segment prefix fold."""
+    n = mesh.shape[axis]
+
+    def local(F, z0, w_local):
+        idx = lax.axis_index(axis)
+        d = F.shape[0]
+        b = w_local.at[0].add(
+            jnp.where(idx == 0, F @ z0, jnp.zeros((d,), F.dtype))
+        )
+        A = jnp.broadcast_to(F, (w_local.shape[0], d, d))
+        local_scan = lax.associative_scan(_affine_combine, (A, b))
+        summary = jax.tree_util.tree_map(lambda a: a[-1], local_scan)
+        identity = _mark_varying(
+            (jnp.eye(d, dtype=F.dtype), jnp.zeros((d,), F.dtype)), axis
+        )
+        prefix = _exclusive_segment_fold(
+            summary, _affine_combine, identity, axis, n, suffix=False
+        )
+        pref_b = jax.tree_util.tree_map(
+            lambda a: jnp.broadcast_to(a, (w_local.shape[0],) + a.shape),
+            prefix,
+        )
+        _, z = _affine_combine(pref_b, local_scan)
+        return z
+
+    def simulate(F, z0, w):
+        return shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(), P(), P(axis)),
+            out_specs=P(axis),
+        )(F, z0, w)
+
+    return simulate
+
+
+@functools.lru_cache(maxsize=64)
+def _sharded_lgssm_sampler(mesh, axis):
+    smooth = _sharded_lgssm_smoother(mesh, axis)
+    simulate = _sharded_lgssm_simulate(mesh, axis)
+
+    def sample(params, y, mask, key, num_draws):
+        F, H, Q, R, m0, P0 = _unpack(params)
+        T = y.shape[0]
+        d, k = F.shape[0], H.shape[0]
+        sm_y, _ = smooth(params, y, mask)
+
+        def one(dk):
+            z0, w, v = _draw_noise(params, dk, T)
+            z_star = simulate(F, z0, w)
+            y_star = z_star @ H.T + v
+            sm_star, _ = smooth(params, y_star, mask)
+            return sm_y + z_star - sm_star
+
+        return jax.vmap(one)(jax.random.split(key, num_draws))
+
+    return jax.jit(sample, static_argnums=4)
 
 
 @functools.lru_cache(maxsize=64)

@@ -229,6 +229,28 @@ class TestSeqSharded:
                 np.asarray(sP_d), np.asarray(sP_ref), rtol=1e-3, atol=1e-4
             )
 
+    def test_distributed_sample_latents_moments(self, seq_mesh):
+        """Distributed simulation-smoother draws reproduce the
+        (distributed) smoothed mean and marginal variances."""
+        y, params = generate_lgssm_data(T=16)
+        model = SeqShardedLGSSM(y, mesh=seq_mesh, axis="seq")
+        sm, sP = model.smoothed_moments(params)
+        draws = model.sample_latents(
+            params, jax.random.PRNGKey(8), num_draws=3000
+        )
+        assert draws.shape == (3000, 16, 2)
+        np.testing.assert_allclose(
+            np.asarray(jnp.mean(draws, axis=0)),
+            np.asarray(sm),
+            atol=0.06,
+        )
+        np.testing.assert_allclose(
+            np.asarray(jnp.var(draws, axis=0)),
+            np.asarray(jax.vmap(jnp.diag)(sP)),
+            rtol=0.2,
+            atol=0.01,
+        )
+
     def test_indivisible_raises(self, seq_mesh):
         y, _ = generate_lgssm_data(T=30)
         with pytest.raises(ValueError, match="not divisible"):
