@@ -3,7 +3,12 @@
 from .glm import HierarchicalRadonGLM, generate_radon_data
 from .gp import FederatedSparseGP, dense_vfe_logp, generate_gp_data
 from .linear import FederatedLinearRegression, generate_node_data
-from .logistic import FederatedLogisticRegression, generate_logistic_data
+from .logistic import (
+    FederatedLogisticRegression,
+    HierarchicalLogisticRegression,
+    generate_hier_logistic_data,
+    generate_logistic_data,
+)
 from .ode import LotkaVolterraModel, generate_lv_data, make_lv_model, rk4_integrate
 from .statespace import (
     FederatedLGSSMPanel,
@@ -43,8 +48,10 @@ __all__ = [
     "generate_gp_data",
     "FederatedLinearRegression",
     "FederatedLogisticRegression",
+    "HierarchicalLogisticRegression",
     "HierarchicalRadonGLM",
     "LotkaVolterraModel",
+    "generate_hier_logistic_data",
     "generate_logistic_data",
     "generate_lv_data",
     "generate_node_data",

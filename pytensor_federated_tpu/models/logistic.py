@@ -28,6 +28,22 @@ from ..parallel.sharded import FederatedLogp
 from .linear import _normal_logpdf
 
 
+def _simulate_logistic_shards(rng, n_shards, n_obs, n_features, intercepts):
+    """Shared simulator: Bernoulli(sigmoid(X w + b_i)) with a per-shard
+    intercept array (a broadcast scalar for the flat model)."""
+    w_true = rng.normal(0, 1.0, size=n_features)
+    intercepts = np.broadcast_to(intercepts, (n_shards,))
+    shards = []
+    for i in range(n_shards):
+        X = rng.normal(size=(n_obs, n_features)).astype(np.float32)
+        logits = X @ w_true + intercepts[i]
+        y = (rng.uniform(size=n_obs) < 1.0 / (1.0 + np.exp(-logits))).astype(
+            np.float32
+        )
+        shards.append((X, y))
+    return pack_shards(shards), w_true
+
+
 def generate_logistic_data(
     n_shards: int = 64,
     *,
@@ -36,17 +52,115 @@ def generate_logistic_data(
     seed: int = 21,
 ):
     rng = np.random.default_rng(seed)
-    w_true = rng.normal(0, 1.0, size=n_features)
-    b_true = 0.5
-    shards = []
-    for _ in range(n_shards):
-        X = rng.normal(size=(n_obs, n_features)).astype(np.float32)
-        logits = X @ w_true + b_true
-        y = (rng.uniform(size=n_obs) < 1.0 / (1.0 + np.exp(-logits))).astype(
-            np.float32
+    packed, w_true = _simulate_logistic_shards(
+        rng, n_shards, n_obs, n_features, 0.5
+    )
+    return packed, {"w": w_true, "b": 0.5}
+
+
+def generate_hier_logistic_data(
+    n_shards: int = 16,
+    *,
+    n_obs: int = 64,
+    n_features: int = 4,
+    tau: float = 0.8,
+    seed: int = 31,
+):
+    """Per-shard data with shard-specific intercepts b_i ~ N(0.5, tau)."""
+    rng = np.random.default_rng(seed)
+    b_true = 0.5 + tau * rng.normal(size=n_shards)
+    # NOTE: intercepts drawn before the shared simulator so w_true uses
+    # the same stream position regardless of n_shards.
+    packed, w_true = _simulate_logistic_shards(
+        rng, n_shards, n_obs, n_features, b_true
+    )
+    return packed, {"w": w_true, "b": b_true}
+
+
+@dataclasses.dataclass
+class HierarchicalLogisticRegression:
+    """Mixed-effects logistic regression: shared slopes, one random
+    intercept per federated shard with a learned group scale.
+
+    Model (NON-CENTERED, like :class:`..glm.HierarchicalRadonGLM` —
+    the centered form ``b_i ~ N(b0, tau)`` has an unbounded
+    log-posterior as ``tau -> 0`` with all ``b_i -> b0``, so its MAP is
+    ill-defined and NUTS meets funnel geometry)::
+
+        w ~ Normal(0, prior_scale)^d      (shared)
+        b0 ~ Normal(0, prior_scale)
+        tau ~ HalfNormal(1)               (via log_tau + Jacobian)
+        b_raw_i ~ Normal(0, 1)            per shard i
+        y_ij ~ Bernoulli(sigmoid(X_i w + b0 + tau * b_raw_i))
+
+    The hierarchical analog of :class:`FederatedLogisticRegression`
+    (whose single intercept it generalizes), completing the GLM grid:
+    radon = hierarchical linear, this = hierarchical logistic.  Each
+    shard picks out its own intercept via the shard id carried in the
+    data tree — SPMD-friendly, no cross-device gather.
+    """
+
+    data: ShardedData
+    mesh: Optional[Mesh] = None
+    prior_scale: float = 5.0
+
+    def __post_init__(self):
+        n = self.data.n_shards
+        shard_ids = jnp.arange(n, dtype=jnp.int32)
+        (X, y), mask = self.data.tree()
+
+        def per_shard_logp(params, shard):
+            (X, y), mask, sid = shard
+            tau = jnp.exp(params["log_tau"])
+            b = params["b0"] + tau * jnp.take(params["b_raw"], sid)
+            logits = X @ params["w"] + b
+            ll = y * logits - jnp.logaddexp(0.0, logits)
+            return jnp.sum(ll * mask)
+
+        self.fed = FederatedLogp(
+            per_shard_logp, ((X, y), mask, shard_ids), mesh=self.mesh
         )
-        shards.append((X, y))
-    return pack_shards(shards), {"w": w_true, "b": b_true}
+        self.n_shards = n
+        self.n_features = X.shape[-1]
+
+    def prior_logp(self, params: Any) -> jax.Array:
+        lp = jnp.sum(_normal_logpdf(params["w"], 0.0, self.prior_scale))
+        lp += _normal_logpdf(params["b0"], 0.0, self.prior_scale)
+        tau = jnp.exp(params["log_tau"])
+        # HalfNormal(1) on tau with the log-transform Jacobian.
+        lp += -0.5 * tau**2 + params["log_tau"]
+        lp += jnp.sum(_normal_logpdf(params["b_raw"], 0.0, 1.0))
+        return lp
+
+    def intercepts(self, params: Any) -> jax.Array:
+        """The implied per-shard intercepts ``b0 + tau * b_raw``."""
+        return params["b0"] + jnp.exp(params["log_tau"]) * params["b_raw"]
+
+    def logp(self, params: Any) -> jax.Array:
+        return self.prior_logp(params) + self.fed.logp(params)
+
+    def logp_and_grad(self, params: Any):
+        return jax.value_and_grad(self.logp)(params)
+
+    def init_params(self) -> Any:
+        return {
+            "w": jnp.zeros((self.n_features,)),
+            "b0": jnp.zeros(()),
+            "log_tau": jnp.zeros(()),
+            "b_raw": jnp.zeros((self.n_shards,)),
+        }
+
+    def find_map(self, **kwargs):
+        from ..samplers import find_map
+
+        return find_map(self.logp, self.init_params(), **kwargs)
+
+    def sample(self, *, key=None, **kwargs):
+        from ..samplers import sample
+
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        return sample(self.logp, self.init_params(), key=key, **kwargs)
 
 
 @dataclasses.dataclass

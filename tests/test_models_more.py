@@ -72,6 +72,68 @@ def test_logistic_64_shards_single_device():
     assert g["w"].shape == (4,)
 
 
+# ---- hierarchical logistic regression ----
+
+
+def test_hier_logistic_golden_logp():
+    """Hand-computed log-posterior on a tiny case (golden-model
+    pattern, reference: test_demo_node.py:29-65)."""
+    from pytensor_federated_tpu.models.logistic import (
+        HierarchicalLogisticRegression,
+        generate_hier_logistic_data,
+    )
+
+    data, _ = generate_hier_logistic_data(n_shards=4, n_obs=8, n_features=2)
+    model = HierarchicalLogisticRegression(data)
+    rng = np.random.default_rng(0)
+    params = {
+        "w": jnp.asarray(rng.normal(size=2).astype(np.float32)),
+        "b0": jnp.asarray(0.3, jnp.float32),
+        "log_tau": jnp.asarray(-0.2, jnp.float32),
+        "b_raw": jnp.asarray(rng.normal(size=4).astype(np.float32)),
+    }
+    (X, y), mask = data.tree()
+    Xn, yn, mn = (np.asarray(a, np.float64) for a in (X, y, mask))
+    w = np.asarray(params["w"], np.float64)
+    b0, log_tau = 0.3, -0.2
+    tau = np.exp(log_tau)
+    b_raw = np.asarray(params["b_raw"], np.float64)
+    b = b0 + tau * b_raw
+    want = 0.0
+    for i in range(4):
+        logits = Xn[i] @ w + b[i]
+        want += np.sum(
+            mn[i] * (yn[i] * logits - np.logaddexp(0.0, logits))
+        )
+    s = 5.0
+    want += np.sum(
+        -0.5 * (w / s) ** 2 - np.log(s) - 0.5 * np.log(2 * np.pi)
+    )
+    want += -0.5 * (b0 / s) ** 2 - np.log(s) - 0.5 * np.log(2 * np.pi)
+    want += -0.5 * tau**2 + log_tau
+    want += np.sum(-0.5 * b_raw**2 - 0.5 * np.log(2 * np.pi))
+    np.testing.assert_allclose(float(model.logp(params)), want, rtol=1e-5)
+
+
+def test_hier_logistic_map_recovers(mesh8):
+    from pytensor_federated_tpu.models.logistic import (
+        HierarchicalLogisticRegression,
+        generate_hier_logistic_data,
+    )
+
+    data, true = generate_hier_logistic_data(
+        n_shards=16, n_obs=128, n_features=4, tau=0.8
+    )
+    model = HierarchicalLogisticRegression(data, mesh=mesh8)
+    est = model.find_map(num_steps=2500, learning_rate=0.05)
+    np.testing.assert_allclose(est["w"], true["w"], atol=0.3)
+    # Per-shard intercepts track the generating ones (partial pooling
+    # shrinks them, so correlation is the right check, not closeness).
+    b_est = np.asarray(model.intercepts(est))
+    r = np.corrcoef(b_est, true["b"])[0, 1]
+    assert r > 0.8, r
+
+
 # ---- Lotka-Volterra ODE ----
 
 
