@@ -572,11 +572,20 @@ def kalman_forecast(
         y = y[:, None]
     F, H, Q, R, m0, P0 = _unpack(params)
     means, covs = _filtered_moments(params, y, mask)
-    m_T, P_T = means[-1], covs[-1]
+    return _forecast_from_terminal(
+        F, H, Q, R, means[-1], covs[-1], horizon
+    )
 
-    # Latent moments at T+h: m = F^h m_T; P = F^h P_T (F^h)' + Σ F^j Q F^j'.
-    # Both are prefix compositions of the affine-moment element (F, Q):
-    # compose((A1,B1),(A2,B2)) = (A2 A1, A2 B1 A2' + B2).
+
+def _forecast_from_terminal(F, H, Q, R, m_T, P_T, horizon):
+    """Predictive observation moments for h = 1..horizon given the
+    terminal filtered state — shared by the single-device and
+    distributed forecasters.
+
+    Latent moments at T+h: ``m = F^h m_T``; ``P = F^h P_T (F^h)' +
+    Σ F^j Q F^j'`` — both prefix compositions of the affine-moment
+    element ``(F, Q)``: compose((A1,B1),(A2,B2)) = (A2 A1,
+    A2 B1 A2' + B2)."""
     d = F.shape[0]
     A = jnp.broadcast_to(F, (horizon, d, d))
     B = jnp.broadcast_to(Q, (horizon, d, d))
@@ -879,6 +888,17 @@ class SeqShardedLGSSM:
             params, self.y, self.mask, key, num_draws
         )
 
+    def forecast(self, params: Any, horizon: int):
+        """h-step-ahead predictive observation moments from the
+        distributed filter: only the terminal filtered state crosses
+        the mesh (one psum), then the affine-moment horizon scan runs
+        replicated.  Matches :func:`kalman_forecast` exactly."""
+        m_T, P_T = _sharded_lgssm_terminal_filtered(self.mesh, self.axis)(
+            params, self.y, self.mask
+        )
+        F, H, Q, R, _, _ = _unpack(params)
+        return _forecast_from_terminal(F, H, Q, R, m_T, P_T, horizon)
+
     def init_params(self, d: int = 2) -> Any:
         return default_lgssm_params(d, self.y.shape[-1])
 
@@ -909,6 +929,19 @@ def _exclusive_segment_fold(summary, combine, identity, axis, n, *, suffix):
 
     start, stop = (1, n) if suffix else (0, n - 1)
     return lax.fori_loop(start, stop, fold, identity)
+
+
+def _local_filter_prologue(params, y_local, mask_local, axis, n):
+    """Shared first act of every distributed-LGSSM local body: unpack,
+    sanitize, and run the distributed filter.  Returns
+    ``(unpacked, y_local, means, covs, prefix)``."""
+    unpacked = _unpack(params)
+    F, H, Q, R, m0, P0 = unpacked
+    y_local = _sanitize(y_local, mask_local)
+    means, covs, prefix = _local_filtered(
+        F, H, Q, R, m0, P0, y_local, mask_local, axis, n
+    )
+    return unpacked, y_local, means, covs, prefix
 
 
 def _local_filtered(F, H, Q, R, m0, P0, y_local, mask_local, axis, n):
@@ -961,12 +994,10 @@ def _sharded_lgssm_logp(mesh, axis):
     n = mesh.shape[axis]
 
     def local(params, y_local, mask_local):
-        F, H, Q, R, m0, P0 = _unpack(params)
-        y_local = _sanitize(y_local, mask_local)
-        idx = lax.axis_index(axis)
-        means, covs, prefix = _local_filtered(
-            F, H, Q, R, m0, P0, y_local, mask_local, axis, n
+        (F, H, Q, R, m0, P0), y_local, means, covs, prefix = (
+            _local_filter_prologue(params, y_local, mask_local, axis, n)
         )
+        idx = lax.axis_index(axis)
         # Predictive terms need the filtered state at t-1: element 0 of
         # this segment uses the prefix itself (last filtered state of
         # the previous segment; the prior on device 0).
@@ -1009,6 +1040,37 @@ def _sharded_lgssm_vg(mesh, axis):
     (mesh, axis)."""
     logp = _sharded_lgssm_logp(mesh, axis)
     return jax.jit(jax.value_and_grad(lambda p, y, m: logp(p, y, m)))
+
+
+@functools.lru_cache(maxsize=64)
+def _sharded_lgssm_terminal_filtered(mesh, axis):
+    """Terminal filtered moments ``(m_T, P_T)`` of the distributed
+    filter — the only state forecasting needs.  The last device's last
+    row is selected with a where+psum (uniform control flow)."""
+    n = mesh.shape[axis]
+
+    def local(params, y_local, mask_local):
+        _, _, means, covs, _ = _local_filter_prologue(
+            params, y_local, mask_local, axis, n
+        )
+        is_last = (lax.axis_index(axis) == n - 1).astype(means.dtype)
+        m_T = lax.psum(is_last * means[-1], axis)
+        P_T = lax.psum(is_last * covs[-1], axis)
+        return m_T, P_T
+
+    def terminal(params, y, mask):
+        return shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(
+                jax.tree_util.tree_map(lambda _: P(), params),
+                P(axis),
+                P(axis),
+            ),
+            out_specs=(P(), P()),
+        )(params, y, mask)
+
+    return jax.jit(terminal)
 
 
 @functools.lru_cache(maxsize=64)
@@ -1084,12 +1146,10 @@ def _sharded_lgssm_smoother(mesh, axis):
     n = mesh.shape[axis]
 
     def local(params, y_local, mask_local):
-        F, H, Q, R, m0, P0 = _unpack(params)
-        y_local = _sanitize(y_local, mask_local)
-        idx = lax.axis_index(axis)
-        means, covs, _ = _local_filtered(
-            F, H, Q, R, m0, P0, y_local, mask_local, axis, n
+        (F, H, Q, R, m0, P0), y_local, means, covs, _ = (
+            _local_filter_prologue(params, y_local, mask_local, axis, n)
         )
+        idx = lax.axis_index(axis)
         # Backward-kernel elements everywhere; the terminal (global T)
         # element only exists on the last row of the LAST device — swap
         # it in per-device instead of re-deriving any kernel.

@@ -251,6 +251,23 @@ class TestSeqSharded:
             atol=0.01,
         )
 
+    def test_distributed_forecast_matches(self, seq_mesh):
+        from pytensor_federated_tpu.models.statespace import kalman_forecast
+
+        y, params = generate_lgssm_data(T=32)
+        rng = np.random.default_rng(23)
+        mask = (rng.uniform(size=32) > 0.3).astype(np.float32)
+        for m in (None, mask):
+            model = SeqShardedLGSSM(y, mesh=seq_mesh, axis="seq", mask=m)
+            my_d, Py_d = model.forecast(params, 4)
+            my_r, Py_r = kalman_forecast(params, y, 4, mask=m)
+            np.testing.assert_allclose(
+                np.asarray(my_d), np.asarray(my_r), rtol=1e-4, atol=1e-6
+            )
+            np.testing.assert_allclose(
+                np.asarray(Py_d), np.asarray(Py_r), rtol=1e-4, atol=1e-6
+            )
+
     def test_indivisible_raises(self, seq_mesh):
         y, _ = generate_lgssm_data(T=30)
         with pytest.raises(ValueError, match="not divisible"):
