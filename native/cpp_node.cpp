@@ -159,10 +159,11 @@ bool decode(const std::vector<uint8_t>& buf, Message* msg, std::string* why) {
       return false;
     }
   }
-  // Each array needs >= 11 bytes of headers; an n_arrays larger than
-  // the remaining payload is garbage and would otherwise drive a
+  // Each array needs >= 11 bytes of headers (2 dtype-len + 1 ndim +
+  // 8 data-len), so any frame can hold at most remaining/11 arrays;
+  // enforcing that bound here keeps a hostile count from driving a
   // multi-GiB resize before any per-array read fails.
-  if (n_arrays > r.remaining()) {
+  if (n_arrays > r.remaining() / 11) {
     *why = "array count exceeds payload";
     return false;
   }
@@ -302,7 +303,7 @@ Message compute(const Message& in) {
 // cannot drive a 4 GiB allocation per connection thread.
 constexpr uint32_t kMaxFrameBytes = 256u * 1024 * 1024;
 
-void serve_connection(int fd) {
+void serve_connection(int fd) try {
   for (;;) {
     uint32_t len = 0;
     if (!read_exact(fd, &len, 4)) return;  // peer closed
@@ -323,6 +324,11 @@ void serve_connection(int fd) {
         !write_exact(fd, payload.data(), payload.size()))
       return;
   }
+} catch (const std::exception& e) {
+  // A bad_alloc (or anything else) from one connection's decode or
+  // compute must close that connection, not std::terminate the whole
+  // multi-port process from a detached thread.
+  std::fprintf(stderr, "connection dropped: %s\n", e.what());
 }
 
 int listen_on(int port) {

@@ -69,8 +69,8 @@ def generate_hier_logistic_data(
     """Per-shard data with shard-specific intercepts b_i ~ N(0.5, tau)."""
     rng = np.random.default_rng(seed)
     b_true = 0.5 + tau * rng.normal(size=n_shards)
-    # NOTE: intercepts drawn before the shared simulator so w_true uses
-    # the same stream position regardless of n_shards.
+    # Intercepts consume n_shards draws before w_true is sampled, so
+    # the simulated w_true (and all shard data) depends on n_shards.
     packed, w_true = _simulate_logistic_shards(
         rng, n_shards, n_obs, n_features, b_true
     )

@@ -10,11 +10,12 @@ temporal-parallelization construction of Särkkä & García-Fernández
 depth on one device — and, combined with a segment-summary exclusive
 scan over the ``"seq"`` mesh axis, across devices.
 
-Model::
+Model (``m0``/``P0`` are the moments of a *time-0* latent, so the
+first observed state is ``z_1 ~ N(F m0, F P0 Fᵀ + Q)``)::
 
-    z_1 ~ N(m0, P0)            latent, dim d
-    z_t = F z_{t-1} + N(0, Q)  t = 2..T
-    y_t = H z_t     + N(0, R)  observed, dim k
+    z_0 ~ N(m0, P0)            latent, dim d
+    z_t = F z_{t-1} + N(0, Q)  t = 1..T
+    y_t = H z_t     + N(0, R)  observed, dim k, t = 1..T
 
 Three evaluation paths, all exact and mutually equivalent (tested):
 
