@@ -207,6 +207,21 @@ def main():
         runners[best], flat0, per_eval0=cal[best] / n_cal
     )
 
+    # FLOP accounting for the winner AND the generic autodiff path —
+    # the suffstats winner compresses the likelihood to O(1) per shard,
+    # so its FLOP count must not stand in for the generic path's
+    # (round-1 VERDICT) and both are recorded.
+    from pytensor_federated_tpu.flopcount import mfu as mfu_fields
+    from pytensor_federated_tpu.flopcount import xla_flops_per_eval
+
+    flop_extra = mfu_fields(
+        xla_flops_per_eval(candidates[best], flat0), evals_per_sec
+    )
+    if best != "xla-autodiff":
+        flop_extra["flops_per_eval_autodiff"] = xla_flops_per_eval(
+            autodiff_flat, flat0
+        )
+
     print(
         json.dumps(
             {
@@ -220,6 +235,7 @@ def main():
                 # which racing implementation won.
                 "backend": jax.default_backend(),
                 "impl": best,
+                **flop_extra,
             }
         )
     )

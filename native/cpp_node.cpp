@@ -160,15 +160,20 @@ bool decode(const std::vector<uint8_t>& buf, Message* msg, std::string* why) {
     }
   }
   // Each array needs >= 11 bytes of headers (2 dtype-len + 1 ndim +
-  // 8 data-len), so any frame can hold at most remaining/11 arrays;
-  // enforcing that bound here keeps a hostile count from driving a
-  // multi-GiB resize before any per-array read fails.
+  // 8 data-len), so any frame can hold at most remaining/11 arrays.
   if (n_arrays > r.remaining() / 11) {
     *why = "array count exceeds payload";
     return false;
   }
-  msg->arrays.resize(n_arrays);
-  for (auto& a : msg->arrays) {
+  // Grow incrementally rather than resize(n_arrays) up front: Array is
+  // ~80 bytes of bookkeeping vs the 11-byte wire minimum, so an
+  // up-front resize would let a fully-sent 256 MiB frame allocate ~7x
+  // its own size before the first per-array read fails.  Incremental
+  // growth keeps memory proportional to bytes actually decoded.
+  msg->arrays.reserve(std::min<size_t>(n_arrays, 4096));
+  for (uint32_t ai = 0; ai < n_arrays; ++ai) {
+    msg->arrays.emplace_back();
+    auto& a = msg->arrays.back();
     uint16_t dtlen = 0;
     uint8_t ndim = 0;
     uint64_t dlen = 0;

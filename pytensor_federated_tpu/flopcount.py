@@ -1,0 +1,166 @@
+"""FLOP accounting for the benchmarks: exact per-eval counts + MFU.
+
+The reference publishes no performance numbers at all (BASELINE.md), so
+round 1 reported raw evals/s — which says nothing about how much of the
+accelerator each eval actually uses (a few-kFLOP eval at 100k/s is
+launch-bound, not compute-bound).  This module adds the two numbers that
+make evals/s interpretable:
+
+- ``flops_per_eval``: the FLOP count of the *actual compiled
+  executable*, read from XLA's own cost model
+  (``Compiled.cost_analysis()["flops"]``) rather than a hand-derived
+  formula.  Hand counts drift from what the compiler really emits
+  (fusion, algebraic simplification, rematerialization); XLA's count is
+  exact for the HLO that runs.  Lowering happens on the CPU backend —
+  the FLOP count is a property of the program, not the device, and CPU
+  compiles are instant (a TPU lowering would cost a 20-40 s remote
+  compile per config, CLAUDE.md).
+- ``mfu``: model FLOP utilization = achieved FLOP/s over the chip's
+  peak.  Peak comes from a device-kind table for TPUs (bf16 dense
+  peak, the standard MFU convention — e.g. the PaLM paper's appendix B
+  and jax-ml.github.io/scaling-book) and from a *measured* dense-matmul
+  roofline on CPU, where no meaningful vendor peak exists.  The basis
+  is always recorded alongside the number (``mfu_basis``) so an MFU is
+  never quoted without saying what "peak" meant.
+
+Sanity guarantee: tests/test_flopcount.py cross-checks the XLA count
+against closed-form analytic counts for programs simple enough to count
+by hand (dense matmul, linear-regression logp+grad).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "xla_flops_per_eval",
+    "peak_flops",
+    "mfu",
+    "measured_matmul_peak",
+    "TPU_BF16_PEAK_FLOPS",
+]
+
+
+# Dense bf16 peak FLOP/s by PJRT device_kind substring.  Sources are the
+# public TPU system specs (cloud.google.com/tpu/docs/system-architecture);
+# matching is by substring because device_kind strings vary across PJRT
+# plugin versions ("TPU v5 lite", "TPU v5e", ...).
+TPU_BF16_PEAK_FLOPS = {
+    "v6": 918e12,  # Trillium / v6e
+    "v5p": 459e12,
+    "v5": 197e12,  # v5e / "v5 lite" (after the v5p check)
+    "v4": 275e12,
+    "v3": 123e12,
+    "v2": 45e12,
+}
+
+
+def xla_flops_per_eval(fn, *args) -> Optional[float]:
+    """Exact FLOP count of one ``fn(*args)`` call, from XLA's cost model.
+
+    Lowers and compiles ``fn`` for the CPU backend (fast, never dials
+    the TPU tunnel) and reads ``cost_analysis()["flops"]``.  Returns
+    None if the cost model is unavailable in this runtime rather than
+    guessing.  Note XLA counts a fused multiply-add as 2 FLOPs and
+    reports transcendentals (exp/log/erf) separately — this is the
+    matmul-convention count that MFU is defined over.
+    """
+    try:
+        cpu = jax.devices("cpu")[0]
+        with jax.default_device(cpu):
+            compiled = jax.jit(fn).lower(*args).compile()
+            ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):  # older jaxlibs wrap in a list
+            ca = ca[0] if ca else {}
+        flops = ca.get("flops")
+        if flops is None or flops < 0:
+            return None
+        return float(flops)
+    except Exception:  # pragma: no cover - runtime-dependent
+        return None
+
+
+_MEASURED_PEAK_CACHE: dict = {}
+
+
+def measured_matmul_peak(backend: Optional[str] = None, n: int = 1536) -> float:
+    """Practical dense-matmul roofline of ``backend`` in FLOP/s.
+
+    Times ``n x n @ n x n`` (f32 on CPU, bf16 on TPU — each backend's
+    native MXU/FMA format) and returns the best of a few repeats.  This
+    is what "peak" means on hosts where no vendor dense-peak number is
+    defensible; cached per backend per process.
+    """
+    backend = backend or jax.default_backend()
+    key = (backend, n)
+    if key in _MEASURED_PEAK_CACHE:
+        return _MEASURED_PEAK_CACHE[key]
+    dtype = jnp.bfloat16 if backend == "tpu" else jnp.float32
+    dev = jax.devices(backend)[0]
+    with jax.default_device(dev):
+        a = jnp.ones((n, n), dtype)
+        mm = jax.jit(lambda a: a @ a)
+        jax.block_until_ready(mm(a))  # compile + warm
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            jax.block_until_ready(mm(a))
+            best = min(best, time.perf_counter() - t0)
+    peak = 2.0 * n**3 / best
+    _MEASURED_PEAK_CACHE[key] = peak
+    return peak
+
+
+def peak_flops(backend: Optional[str] = None) -> tuple[float, str]:
+    """``(peak_flops, basis_string)`` for ``backend``.
+
+    TPU: vendor bf16 dense peak looked up by device_kind (the standard
+    MFU denominator).  Anything else: measured dense f32 matmul
+    roofline, explicitly labelled as such.
+    """
+    backend = backend or jax.default_backend()
+    if backend == "tpu":
+        kind = jax.devices("tpu")[0].device_kind
+        norm = kind.lower().replace(" ", "").replace("lite", "")
+        for sub, peak in TPU_BF16_PEAK_FLOPS.items():
+            if sub in norm:
+                return peak, f"{kind} bf16 dense peak {peak:.3g} FLOP/s"
+        # Unknown TPU generation: fall through to the measured roofline.
+    peak = measured_matmul_peak(backend)
+    return peak, (
+        f"measured dense-matmul roofline on {backend} ({peak:.3g} FLOP/s)"
+    )
+
+
+def mfu(
+    flops_per_eval: Optional[float],
+    evals_per_sec: float,
+    backend: Optional[str] = None,
+) -> dict[str, Any]:
+    """Benchmark-record fields: achieved FLOP/s and model FLOP
+    utilization, plus the basis string that defines "peak".
+
+    Returns ``{"flops_per_eval", "flops_per_sec", "mfu", "mfu_basis"}``
+    with Nones when the FLOP count is unavailable — a record must say
+    "unknown" rather than omit the field (VERDICT round 1: unlabelled
+    evals/s are unfalsifiable).
+    """
+    if flops_per_eval is None:
+        return {
+            "flops_per_eval": None,
+            "flops_per_sec": None,
+            "mfu": None,
+            "mfu_basis": "flop count unavailable",
+        }
+    peak, basis = peak_flops(backend)
+    achieved = flops_per_eval * evals_per_sec
+    return {
+        "flops_per_eval": round(flops_per_eval),
+        "flops_per_sec": round(achieved),
+        "mfu": round(achieved / peak, 6),
+        "mfu_basis": basis,
+    }

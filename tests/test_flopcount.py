@@ -1,0 +1,95 @@
+"""FLOP accounting sanity: XLA's cost-model count vs closed-form counts.
+
+The bench suite quotes ``flops_per_eval`` from XLA's cost analysis of
+the compiled executable (flopcount.py).  These tests pin that number
+against programs simple enough to count by hand, so a silent change in
+the cost-model contract (units, fusion accounting) fails loudly instead
+of corrupting every MFU in BENCH_SUITE.json.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from pytensor_federated_tpu.flopcount import (
+    measured_matmul_peak,
+    mfu,
+    peak_flops,
+    xla_flops_per_eval,
+)
+
+
+def test_matmul_exact_count():
+    # (n,n) @ (n,n) is 2n^3 FLOPs by the standard convention.
+    n = 128
+    fl = xla_flops_per_eval(lambda a: a @ a, jnp.ones((n, n)))
+    assert fl is not None
+    assert fl == pytest.approx(2 * n**3, rel=0.02)
+
+
+def test_batched_matvec_count():
+    # vmapped (n,d) @ (d,) over c chains == one (n,d) @ (d,c): 2ndc.
+    n, d, c = 256, 64, 8
+    X = jnp.ones((n, d))
+    fn = jax.vmap(lambda w: X @ w)
+    fl = xla_flops_per_eval(fn, jnp.ones((c, d)))
+    assert fl == pytest.approx(2 * n * d * c, rel=0.05)
+
+
+def test_value_and_grad_adds_one_cotangent_matmul():
+    # For loss(w) = sum((A @ w)^2) reverse mode adds exactly one
+    # transposed matmul (grad = 2 A^T (A w), with A w reused from the
+    # forward pass), so value_and_grad is ~2x the forward count.  Pins
+    # that the cost model sees through jax's AD instead of re-deriving
+    # the primal.
+    n = 128
+    A = jnp.ones((n, n))
+
+    def loss(w):
+        return jnp.sum((A @ w) ** 2)
+
+    fwd = xla_flops_per_eval(loss, jnp.ones((n, n)))
+    vg = xla_flops_per_eval(jax.value_and_grad(loss), jnp.ones((n, n)))
+    assert 1.8 * fwd < vg < 2.5 * fwd
+
+
+def test_flagship_model_flops_are_plausible():
+    # The 8-shard flagship: 8 shards x 64 padded obs, a handful of
+    # FLOPs per observation, times ~3 for the gradient — order kFLOP.
+    # Guards against the count silently becoming per-chain-batch or
+    # per-element.
+    from jax.flatten_util import ravel_pytree
+
+    from pytensor_federated_tpu.models.linear import (
+        FederatedLinearRegression,
+        generate_node_data,
+    )
+
+    data, _ = generate_node_data(8, n_obs=64, seed=123)
+    model = FederatedLinearRegression(data)
+    flat0, unravel = ravel_pytree(model.init_params())
+
+    def fn(x):
+        return jax.value_and_grad(lambda v: model.logp(unravel(v)))(x)
+
+    fl = xla_flops_per_eval(fn, flat0)
+    assert 2_000 < fl < 200_000
+
+
+def test_mfu_fields_complete_and_unavailable_path():
+    fields = mfu(1e6, 1000.0)
+    assert fields["flops_per_sec"] == 1e9
+    assert 0 < fields["mfu"] < 1
+    assert "FLOP/s" in fields["mfu_basis"]
+    none_fields = mfu(None, 1000.0)
+    assert none_fields["mfu"] is None
+    assert none_fields["flops_per_eval"] is None
+    assert "unavailable" in none_fields["mfu_basis"]
+
+
+def test_measured_peak_caches_and_is_positive():
+    p1 = measured_matmul_peak(n=256)
+    p2 = measured_matmul_peak(n=256)
+    assert p1 == p2 > 1e9  # any machine does >1 GFLOP/s dense matmul
+    peak, basis = peak_flops("cpu")
+    assert peak > 1e9 and "roofline" in basis
