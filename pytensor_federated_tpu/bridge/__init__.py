@@ -22,12 +22,19 @@ try:
         federated_potential,
     )
 
+    # Importing fusion registers the automatic fan-out rewrite in
+    # PyTensor's optdb (reference: op_async.py:228-234 registers its
+    # AsyncFusionOptimizer the same way, at import).
+    from .fusion import FederatedFusionRewriter, ParallelFederatedOp
+
     HAS_PYTENSOR = True
     __all__ = [
         "HAS_PYTENSOR",
         "FederatedArraysToArraysOp",
+        "FederatedFusionRewriter",
         "FederatedLogpGradOp",
         "FederatedLogpOp",
+        "ParallelFederatedOp",
         "federated_potential",
     ]
 except ModuleNotFoundError:  # pragma: no cover - exercised when pytensor absent
@@ -37,8 +44,10 @@ except ModuleNotFoundError:  # pragma: no cover - exercised when pytensor absent
     def __getattr__(name):
         if name in (
             "FederatedArraysToArraysOp",
+            "FederatedFusionRewriter",
             "FederatedLogpGradOp",
             "FederatedLogpOp",
+            "ParallelFederatedOp",
             "federated_potential",
         ):
             raise ImportError(

@@ -201,51 +201,47 @@ def federated_potential(logp_grad_fn: LogpGradFn, *inputs, jax_fn=None):
 # registration at import, reference: op_async.py:228-234) means any
 # PyMC/PyTensor compile with mode="JAX" inlines the op's jax_fn into the
 # traced program: the whole NUTS step becomes one XLA executable.
-try:  # pragma: no cover - depends on pytensor version layout
-    from pytensor.link.jax.dispatch import jax_funcify
 
-    @jax_funcify.register(FederatedArraysToArraysOp)
-    def _jax_funcify_arrays(op, **kwargs):
-        if op.jax_fn is None:
-            raise NotImplementedError(
-                "FederatedArraysToArraysOp has no jax_fn; pass jax_fn= to "
-                "compile through the JAX linker"
-            )
-        fn = op.jax_fn
 
-        def arrays_to_arrays(*inputs):
-            return tuple(fn(*inputs))
-
-        return arrays_to_arrays
-
-    @jax_funcify.register(FederatedLogpOp)
-    def _jax_funcify_logp(op, **kwargs):
-        if op.jax_fn is None:
-            raise NotImplementedError(
-                "FederatedLogpOp has no jax_fn; pass jax_fn= to compile "
-                "through the JAX linker"
-            )
-        fn = op.jax_fn
-
-        def logp(*inputs):
-            return fn(*inputs)
-
-        return logp
-
-    @jax_funcify.register(FederatedLogpGradOp)
-    def _jax_funcify_logp_grad(op, **kwargs):
-        if op.jax_fn is None:
-            raise NotImplementedError(
-                "FederatedLogpGradOp has no jax_fn; pass jax_fn= to compile "
-                "through the JAX linker"
-            )
-        fn = op.jax_fn
+def _jax_funcify_for_member(op):
+    """The jax callable for one federated op, with node-shaped output
+    (a tuple matching the op's apply outputs).  Shared by the three
+    ``jax_funcify`` registrations below and by the fused op's dispatch
+    (fusion.py)."""
+    if op.jax_fn is None:
+        raise NotImplementedError(
+            f"{type(op).__name__} has no jax_fn; pass jax_fn= to compile "
+            "through the JAX linker"
+        )
+    fn = op.jax_fn
+    if isinstance(op, FederatedLogpGradOp):
 
         def logp_grad(*inputs):
             logp, grads = fn(*inputs)
             return (logp, *tuple(grads))
 
         return logp_grad
+    if isinstance(op, FederatedLogpOp):
+
+        def logp(*inputs):
+            return fn(*inputs)
+
+        return logp
+
+    def arrays_to_arrays(*inputs):
+        return tuple(fn(*inputs))
+
+    return arrays_to_arrays
+
+
+try:  # pragma: no cover - depends on pytensor version layout
+    from pytensor.link.jax.dispatch import jax_funcify
+
+    @jax_funcify.register(FederatedArraysToArraysOp)
+    @jax_funcify.register(FederatedLogpOp)
+    @jax_funcify.register(FederatedLogpGradOp)
+    def _jax_funcify_federated(op, **kwargs):
+        return _jax_funcify_for_member(op)
 
 except ModuleNotFoundError:  # pragma: no cover
     pass

@@ -1,0 +1,203 @@
+"""PyMC driver demo — the reference's headline workflow, end-to-end.
+
+The reference's flagship demo is a PyMC model whose likelihood is a
+federated op: ``pm.Potential`` over a ``LogpGradOp`` fanning out to
+worker processes, then ``pm.find_MAP`` + NUTS (reference:
+demo_model.py:15-45).  This demo builds the same hierarchical linear
+regression as a ``pm.Model`` whose data likelihood is this framework's
+federated evaluation:
+
+- priors live in PyMC (so transforms/Jacobians are PyMC's business,
+  identical between the federated and natively-built models);
+- the per-shard data log-likelihood is one jitted SPMD evaluation over
+  the packed shards (models/linear.py machinery), exposed to PyTensor
+  through :func:`bridge.federated_potential` both as a host callable
+  (C/py linkers — ``perform``) and as a ``jax_fn`` (PyTensor->JAX
+  linker: the whole NUTS step compiles to one XLA program, SURVEY §7
+  step 4).
+
+Run: ``pft-demo-pymc`` or ``python -m pytensor_federated_tpu.demos.demo_pymc``
+(requires pymc; the package deliberately does not depend on it —
+reference pyproject.toml keeps pymc a test/demo extra too).
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional
+
+import numpy as np
+
+from ..models.linear import generate_node_data
+from ..parallel.packing import ShardedData
+from ..utils import LOG_2PI
+
+
+def make_federated_data_logp(data: ShardedData):
+    """``(jax_fn, host_fn)`` computing the shard-summed data
+    log-likelihood ``sum_i logN(y_i | A_i + slope * x_i, sigma)`` and
+    its gradients w.r.t. ``(A, slope, sigma)``.
+
+    ``A`` is the per-shard intercept vector (global intercept + shard
+    offset), matching the reference demo's per-worker intercept design
+    (reference: demo_model.py:26-36).  All shards evaluate in one
+    vmapped (shard-batched) program; the host variant jits it and
+    crosses the numpy boundary (the C/py-linker ``perform`` path).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    (x, y), mask = data.tree()
+
+    def data_logp(A, slope, sigma):
+        def shard_ll(xi, yi, mi, Ai):
+            z = (yi - (Ai + slope * xi)) / sigma
+            ll = -0.5 * z * z - jnp.log(sigma) - 0.5 * LOG_2PI
+            return jnp.sum(ll * mi)
+
+        return jnp.sum(jax.vmap(shard_ll)(x, y, mask, A))
+
+    def jax_value_and_grads(A, slope, sigma):
+        val, grads = jax.value_and_grad(data_logp, argnums=(0, 1, 2))(
+            A, slope, sigma
+        )
+        return val, list(grads)
+
+    jitted = None
+
+    def host_fn(A, slope, sigma):
+        nonlocal jitted
+        import jax as _jax
+
+        if jitted is None:
+            jitted = _jax.jit(jax_value_and_grads)
+        val, grads = jitted(
+            _jax.numpy.asarray(A),
+            _jax.numpy.asarray(slope),
+            _jax.numpy.asarray(sigma),
+        )
+        return np.asarray(val), [np.asarray(g) for g in grads]
+
+    return jax_value_and_grads, host_fn
+
+
+def build_model(
+    data: ShardedData,
+    *,
+    use_jax_fn: bool = True,
+    prior_scale: float = 10.0,
+    offset_scale: float = 0.3,
+):
+    """A ``pm.Model`` with the federated data likelihood as a Potential.
+
+    Matches the reference driver model shape (reference:
+    demo_model.py:26-42): global intercept + per-shard offsets + shared
+    slope + noise scale, likelihood behind the federated boundary.
+    """
+    import pymc as pm
+
+    from ..bridge import federated_potential
+
+    jax_fn, host_fn = make_federated_data_logp(data)
+    n_shards = data.tree()[1].shape[0]
+
+    def logp_grad_fn(A, slope, sigma):
+        return host_fn(A, slope, sigma)
+
+    with pm.Model() as model:
+        intercept = pm.Normal("intercept", 0.0, prior_scale)
+        offsets = pm.Normal("offsets", 0.0, offset_scale, shape=n_shards)
+        slope = pm.Normal("slope", 0.0, prior_scale)
+        sigma = pm.HalfNormal("sigma", 1.0)
+        pm.Potential(
+            "federated_loglik",
+            federated_potential(
+                logp_grad_fn,
+                intercept + offsets,
+                slope,
+                sigma,
+                jax_fn=jax_fn if use_jax_fn else None,
+            ),
+        )
+    return model
+
+
+def build_native_model(
+    data: ShardedData,
+    *,
+    prior_scale: float = 10.0,
+    offset_scale: float = 0.3,
+):
+    """The SAME posterior built natively in PyMC (no federated op) —
+    the parity oracle, like the reference's natively-built comparison
+    model (reference: test_demo_node.py:68-110)."""
+    import pymc as pm
+
+    (x, y), mask = data.tree()
+    x = np.asarray(x)
+    y = np.asarray(y)
+    mask = np.asarray(mask).astype(bool)
+    n_shards = x.shape[0]
+
+    with pm.Model() as model:
+        intercept = pm.Normal("intercept", 0.0, prior_scale)
+        offsets = pm.Normal("offsets", 0.0, offset_scale, shape=n_shards)
+        slope = pm.Normal("slope", 0.0, prior_scale)
+        sigma = pm.HalfNormal("sigma", 1.0)
+        for i in range(n_shards):
+            pm.Normal(
+                f"y_{i}",
+                mu=(intercept + offsets[i]) + slope * x[i][mask[i]],
+                sigma=sigma,
+                observed=y[i][mask[i]],
+            )
+    return model
+
+
+def main(argv: Optional[list] = None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--n-shards", type=int, default=8)
+    parser.add_argument("--n-obs", type=int, default=64)
+    parser.add_argument("--draws", type=int, default=200)
+    parser.add_argument("--tune", type=int, default=200)
+    parser.add_argument("--chains", type=int, default=2)
+    parser.add_argument(
+        "--perform-path",
+        action="store_true",
+        help="use the host-callable perform path instead of jax_fn",
+    )
+    args = parser.parse_args(argv)
+
+    import pymc as pm
+
+    data, offsets_true = generate_node_data(
+        args.n_shards, n_obs=args.n_obs, seed=123
+    )
+    model = build_model(data, use_jax_fn=not args.perform_path)
+    with model:
+        map_est = pm.find_MAP(progressbar=False)
+        print(
+            "MAP: intercept=%.3f slope=%.3f sigma=%.3f"
+            % (map_est["intercept"], map_est["slope"], map_est["sigma"])
+        )
+        idata = pm.sample(
+            draws=args.draws,
+            tune=args.tune,
+            chains=args.chains,
+            cores=1,
+            progressbar=False,
+            random_seed=42,
+        )
+    post = idata.posterior
+    print(
+        "posterior: slope median=%.3f intercept median=%.3f"
+        % (
+            float(post["slope"].median()),
+            float(post["intercept"].median()),
+        )
+    )
+    return idata
+
+
+if __name__ == "__main__":
+    main()

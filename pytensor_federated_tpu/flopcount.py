@@ -124,11 +124,20 @@ def peak_flops(backend: Optional[str] = None) -> tuple[float, str]:
     """
     backend = backend or jax.default_backend()
     if backend == "tpu":
-        kind = jax.devices("tpu")[0].device_kind
+        devices = jax.devices("tpu")
+        kind = devices[0].device_kind
         norm = kind.lower().replace(" ", "").replace("lite", "")
         for sub, peak in TPU_BF16_PEAK_FLOPS.items():
             if sub in norm:
-                return peak, f"{kind} bf16 dense peak {peak:.3g} FLOP/s"
+                # flops_per_eval counts the WHOLE program's work, so on
+                # a multi-chip run the denominator must be the peak of
+                # every chip the program can use — a single-chip peak
+                # would overstate MFU by n_devices.
+                total = peak * len(devices)
+                return total, (
+                    f"{len(devices)}x {kind} bf16 dense peak "
+                    f"{total:.3g} FLOP/s"
+                )
         # Unknown TPU generation: fall through to the measured roofline.
     peak = measured_matmul_peak(backend)
     return peak, (
