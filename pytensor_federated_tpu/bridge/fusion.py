@@ -56,29 +56,6 @@ __all__ = ["ParallelFederatedOp", "FederatedFusionRewriter"]
 
 _FUSABLE = (FederatedArraysToArraysOp, FederatedLogpOp, FederatedLogpGradOp)
 
-# One process-wide pool, sized lazily to the largest fused group.  The
-# members' compute functions block on IO, so oversubscription relative
-# to cores is correct here.  All submits happen under _POOL_LOCK so a
-# concurrent grow-and-replace can never invalidate a pool reference
-# between acquisition and submit; shutdown(wait=False) still lets the
-# retired pool finish everything already submitted to it.
-_POOL: ThreadPoolExecutor | None = None
-_POOL_SIZE = 0
-_POOL_LOCK = threading.Lock()
-
-
-def _submit_all(tasks):
-    global _POOL, _POOL_SIZE
-    with _POOL_LOCK:
-        n = len(tasks)
-        if _POOL is None or _POOL_SIZE < n:
-            if _POOL is not None:
-                _POOL.shutdown(wait=False)
-            _POOL_SIZE = max(n, 4)
-            _POOL = ThreadPoolExecutor(
-                max_workers=_POOL_SIZE, thread_name_prefix="pft-fused"
-            )
-        return [_POOL.submit(t) for t in tasks]
 
 
 class ParallelFederatedOp(Op):
@@ -131,15 +108,47 @@ class ParallelFederatedOp(Op):
         return nodes
 
     def __getstate__(self):
-        # Template applies reference graph variables; shipping them
-        # with the op would bloat cross-process pickles.  _templates
-        # rebuilds them lazily on the other side.
+        # Template applies reference graph variables, and executors are
+        # not picklable; both rebuild lazily on the other side.
         state = self.__dict__.copy()
         state.pop("_member_nodes", None)
+        state.pop("_executors", None)
+        state.pop("_exec_lock_obj", None)
         return state
+
+    def _member_executors(self):
+        # One PERSISTENT single-thread executor per member (the
+        # ops/fanout.py pattern): gRPC/asyncio client state caches per
+        # (token, pid, thread, loop) (service/client.py), so member i
+        # must land on the same thread every evaluation or each call
+        # re-dials its channels.
+        execs = getattr(self, "_executors", None)
+        if execs is None:
+            with self._exec_lock:
+                execs = getattr(self, "_executors", None)
+                if execs is None:
+                    execs = [
+                        ThreadPoolExecutor(
+                            max_workers=1,
+                            thread_name_prefix=f"pft-fused-{i}",
+                        )
+                        for i in range(len(self.members))
+                    ]
+                    self._executors = execs
+        return execs
+
+    @property
+    def _exec_lock(self):
+        lock = getattr(self, "_exec_lock_obj", None)
+        if lock is None:
+            lock = self.__dict__.setdefault(
+                "_exec_lock_obj", threading.Lock()
+            )
+        return lock
 
     def perform(self, node, inputs, output_storage):
         templates = self._templates(node)
+        execs = self._member_executors()
 
         def make_run(idx):
             def run():
@@ -152,7 +161,9 @@ class ParallelFederatedOp(Op):
 
             return run
 
-        futures = _submit_all([make_run(i) for i in range(len(self.members))])
+        futures = [
+            execs[i].submit(make_run(i)) for i in range(len(self.members))
+        ]
         # Surface the FIRST member failure loudly (fail-loud contract,
         # CLAUDE.md wire-format invariant) after all members settle —
         # cancelling mid-flight would leave sibling storages half-set.
@@ -198,15 +209,14 @@ class FederatedFusionRewriter(GraphRewriter):
         for c in candidates:
             placed = False
             for g in groups:
+                # Only the forward direction needs checking: group
+                # members precede c in topo order, so c can never be an
+                # ancestor of a member.
                 if any(m in deps[c] for m in g):
                     continue  # c consumes a member's output
-                # (members later in topo order than c cannot be c's
-                # dependants yet; dependants are checked when added)
-                groups_ok = all(c not in deps[m] for m in g)
-                if groups_ok:
-                    g.append(c)
-                    placed = True
-                    break
+                g.append(c)
+                placed = True
+                break
             if not placed:
                 groups.append([c])
         for g in groups:

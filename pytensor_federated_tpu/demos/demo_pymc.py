@@ -63,18 +63,11 @@ def make_federated_data_logp(data: ShardedData):
         )
         return val, list(grads)
 
-    jitted = None
+    jitted = jax.jit(jax_value_and_grads)  # lazy: compiles on first call
 
     def host_fn(A, slope, sigma):
-        nonlocal jitted
-        import jax as _jax
-
-        if jitted is None:
-            jitted = _jax.jit(jax_value_and_grads)
         val, grads = jitted(
-            _jax.numpy.asarray(A),
-            _jax.numpy.asarray(slope),
-            _jax.numpy.asarray(sigma),
+            jnp.asarray(A), jnp.asarray(slope), jnp.asarray(sigma)
         )
         return np.asarray(val), [np.asarray(g) for g in grads]
 
@@ -101,9 +94,6 @@ def build_model(
     jax_fn, host_fn = make_federated_data_logp(data)
     n_shards = data.tree()[1].shape[0]
 
-    def logp_grad_fn(A, slope, sigma):
-        return host_fn(A, slope, sigma)
-
     with pm.Model() as model:
         intercept = pm.Normal("intercept", 0.0, prior_scale)
         offsets = pm.Normal("offsets", 0.0, offset_scale, shape=n_shards)
@@ -112,7 +102,7 @@ def build_model(
         pm.Potential(
             "federated_loglik",
             federated_potential(
-                logp_grad_fn,
+                host_fn,
                 intercept + offsets,
                 slope,
                 sigma,

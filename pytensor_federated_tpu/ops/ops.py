@@ -78,8 +78,9 @@ def _make_logp_grad_call(logp_grad_fn: LogpGradFn) -> Callable:
             )
         return (logp, grads)
 
-    def fwd(*inputs):
-        out = call(*inputs)
+    def fwd(*primals):
+        # symbolic_zeros=True wraps each primal in CustomVJPPrimal.
+        out = call(*(p.value for p in primals))
         _, grads = out
         return out, grads
 
@@ -87,19 +88,29 @@ def _make_logp_grad_call(logp_grad_fn: LogpGradFn) -> Callable:
         g_logp, g_grads = cotangents
         # Reject connected gradients w.r.t. the grad outputs — the same
         # "no second-order autodiff through the federated boundary"
-        # contract as reference wrapper_ops.py:123-125.  Under JAX the
-        # cotangent for unused outputs is a symbolic zero mapped to
-        # concrete zeros; a *connected* non-zero cotangent cannot be
-        # detected at trace time, so second-order use instead produces
-        # the documented first-order-only semantics: d(grads)/d(inputs)
-        # is treated as disconnected (zero contribution).
-        del g_grads
+        # contract as the reference (wrapper_ops.py:123-125) and this
+        # repo's bridge op (bridge/pytensor_ops.py).  With
+        # ``symbolic_zeros=True`` an output that nothing differentiates
+        # arrives as a SymbolicZero, so a *connected* cotangent on a
+        # grad output is detectable at trace time and fails loudly here
+        # instead of silently contributing zero to a Hessian.
+        SymbolicZero = jax.custom_derivatives.SymbolicZero
+        if any(not isinstance(g, SymbolicZero) for g in g_grads):
+            raise NotImplementedError(
+                "gradients with respect to LogpGradOp's grad outputs are "
+                "not supported: the federated boundary is first-order "
+                "only (nodes supply logp and first grads; second-order "
+                "information never crosses the wire). Use the grads "
+                "output as data (lax.stop_gradient) if that is intended."
+            )
+        if isinstance(g_logp, SymbolicZero):
+            return tuple(jnp.zeros_like(g) for g in residual_grads)
         return tuple(
             jnp.asarray(g_logp, dtype=jnp.result_type(g)) * g
             for g in residual_grads
         )
 
-    call.defvjp(fwd, bwd)
+    call.defvjp(fwd, bwd, symbolic_zeros=True)
     return call
 
 

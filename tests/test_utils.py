@@ -78,7 +78,7 @@ def test_healthy_devices_and_get_load():
     assert len(loads) == len(cpus)
     for d, l in zip(cpus, loads):
         assert l.device_id == d.id
-        assert l.platform == "cpu" 
+        assert l.platform == "cpu"
 
 
 def test_find_reasonable_step_size_gaussian():
