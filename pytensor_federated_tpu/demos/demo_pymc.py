@@ -16,6 +16,12 @@ federated evaluation:
   linker: the whole NUTS step compiles to one XLA program, SURVEY §7
   step 4).
 
+Dtype seam (SURVEY §7 "hard parts"): PyMC computes in float64; the
+federated boundary is float32 by TPU-first design.  Values cross the
+boundary as float32 and are cast back — parity with a native float64
+PyMC model holds to ~1e-5 relative on O(100) log-densities
+(tests/test_pymc_e2e.py pins the tolerances).
+
 Run: ``pft-demo-pymc`` or ``python -m pytensor_federated_tpu.demos.demo_pymc``
 (requires pymc; the package deliberately does not depend on it —
 reference pyproject.toml keeps pymc a test/demo extra too).

@@ -169,7 +169,9 @@ class ParallelLogpGrad:
 
             return host
 
-        fanout = parallel_host_call([flat_node(i) for i in range(self.n_nodes)], out_specs)
+        fanout = parallel_host_call(
+            [flat_node(i) for i in range(self.n_nodes)], out_specs
+        )
         self._fanout = fanout
         arities = [len(s) for s in self.in_specs]
 

@@ -188,7 +188,11 @@ def _purge_dead_loop_entries() -> None:
     sweep."""
     for cid in list(_privates):
         privates = _privates.get(cid)
-        if privates is not None and privates.loop is not None and privates.loop.is_closed():
+        if (
+            privates is not None
+            and privates.loop is not None
+            and privates.loop.is_closed()
+        ):
             _privates.pop(cid, None)
             _cancel_stream(privates)
 

@@ -1,0 +1,88 @@
+"""The driver metric's sizing/chaining machinery, unit-tested.
+
+bench.py is the one artifact the driver captures every round; a silent
+regression in `make_chained` (e.g. the chain becoming DCE-able) or in
+`measure_rate`'s two-stage sizing would corrupt the headline number
+without failing any test.  These tests pin the contracts:
+
+- the chained runner really performs n *dependent* evaluations;
+- one compiled executable serves every chain length (the dynamic trip
+  count exists because each static length would cost a 20-40 s remote
+  TPU compile, CLAUDE.md);
+- measure_rate returns a rate consistent with its own measured wall.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bench import make_chained, measure_rate
+
+
+def _counting_logp_grad():
+    # value = -x.x/2, grad = -x ; the chained update x + 1e-6*g decays
+    # toward 0, so the final carry encodes how many steps really ran.
+    def fn(x):
+        return -0.5 * jnp.sum(x * x), -x
+
+    return fn
+
+
+def test_chained_runs_n_dependent_evals():
+    chained = make_chained(_counting_logp_grad())
+    x0 = jnp.ones((4,))
+    (x_out, acc), _ = (
+        chained(x0, jnp.asarray(1000, jnp.int32)),
+        None,
+    )
+    # each step multiplies x by (1 - 1e-6): after n steps, norm shrinks
+    # by (1 - 1e-6)^n — detectably different from 0 or 1 steps.
+    expected = (1.0 - 1e-6) ** 1000
+    np.testing.assert_allclose(float(x_out[0]), expected, rtol=1e-4)
+    # the accumulated value must be ~ -0.5*4 per step x 1000 steps
+    assert acc < -1000.0
+
+
+def test_one_executable_serves_all_lengths():
+    chained = make_chained(_counting_logp_grad())
+    x0 = jnp.ones((4,))
+    jax.block_until_ready(chained(x0, jnp.asarray(10, jnp.int32)))
+    # Different trip counts must not retrace/recompile: jit cache size 1.
+    sizes = chained._cache_size() if hasattr(chained, "_cache_size") else None
+    jax.block_until_ready(chained(x0, jnp.asarray(1000, jnp.int32)))
+    if sizes is not None:
+        assert chained._cache_size() == sizes
+
+
+def test_measure_rate_consistent():
+    chained = make_chained(_counting_logp_grad())
+    x0 = jnp.ones((4,))
+    rate, n, wall = measure_rate(
+        chained, x0, n_cal=100, floor=500, mid_wall=0.05, target_wall=0.15
+    )
+    assert n >= 500
+    assert rate > 0
+    np.testing.assert_allclose(rate, n / wall, rtol=1e-6)
+
+
+def test_bench_json_contract_fields():
+    # The driver parses ONE json line with these fields; pin the schema
+    # without paying a full bench run (bench.main is exercised by the
+    # driver itself every round).
+    import bench
+
+    assert bench.NORTH_STAR == 50_000.0
+
+
+def test_unroll_numerics_identical():
+    # The unrolled chain must be bit-identical to unroll=1 for any n,
+    # including n not divisible by the unroll factor.
+    fn = _counting_logp_grad()
+    c1 = make_chained(fn, unroll=1)
+    c8 = make_chained(fn, unroll=8)
+    x0 = jnp.arange(1.0, 5.0)
+    for n in (0, 1, 7, 8, 9, 1003):
+        a = c1(x0, jnp.asarray(n, jnp.int32))
+        b = c8(x0, jnp.asarray(n, jnp.int32))
+        np.testing.assert_array_equal(np.asarray(a[0]), np.asarray(b[0]))
+        np.testing.assert_array_equal(np.asarray(a[1]), np.asarray(b[1]))

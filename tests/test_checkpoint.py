@@ -31,7 +31,8 @@ class TestPytreeSnapshot:
         save_pytree(p, tree, {"step": 7})
         got, meta = load_pytree(p, tree)
         assert meta == {"step": 7}
-        for a, b in zip(jax.tree_util.tree_leaves(tree), jax.tree_util.tree_leaves(got)):
+        leaves = jax.tree_util.tree_leaves
+        for a, b in zip(leaves(tree), leaves(got)):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
     def test_structure_mismatch_raises(self, tmp_path):

@@ -60,12 +60,17 @@ def _test_points(model, n=4, seed=7):
 
 
 class TestLogpParity:
+    # Tolerances: PyMC computes in float64; the federated boundary is
+    # float32 by TPU-first design (SURVEY §7 "hard parts" names this
+    # dtype seam).  |logp| is O(100) here, so float32 gives ~1e-5
+    # relative — tolerances sit an order of magnitude above that.
+
     def test_logp_matches_native(self, fed_model, native_model):
         f_logp = fed_model.compile_logp()
         n_logp = native_model.compile_logp()
         for pt_ in _test_points(fed_model):
             np.testing.assert_allclose(
-                f_logp(pt_), n_logp(pt_), rtol=1e-5, atol=1e-5
+                f_logp(pt_), n_logp(pt_), rtol=2e-4, atol=1e-3
             )
 
     def test_dlogp_matches_native(self, fed_model, native_model):
@@ -73,7 +78,7 @@ class TestLogpParity:
         n_dlogp = native_model.compile_dlogp()
         for pt_ in _test_points(fed_model):
             np.testing.assert_allclose(
-                f_dlogp(pt_), n_dlogp(pt_), rtol=1e-4, atol=1e-4
+                f_dlogp(pt_), n_dlogp(pt_), rtol=1e-3, atol=1e-2
             )
 
 
@@ -84,8 +89,10 @@ class TestFindMAP:
         with native_model:
             nat_map = pm.find_MAP(progressbar=False)
         for name in ("intercept", "slope", "sigma"):
+            # float32 gradients shift the optimizer's stopping point a
+            # little; parameter-scale agreement is what parity means.
             np.testing.assert_allclose(
-                fed_map[name], nat_map[name], rtol=1e-3, atol=1e-3
+                fed_map[name], nat_map[name], rtol=5e-3, atol=5e-3
             )
 
     def test_find_map_recovers_truth(self, fed_model):
