@@ -46,6 +46,7 @@ from pytensor.graph.features import ReplaceValidate
 from pytensor.graph.op import Op
 from pytensor.graph.rewriting.basic import GraphRewriter
 
+from .grouping import group_independent
 from .pytensor_ops import (
     FederatedArraysToArraysOp,
     FederatedLogpGradOp,
@@ -187,38 +188,13 @@ class FederatedFusionRewriter(GraphRewriter):
 
     def apply(self, fgraph):
         order = fgraph.toposort()
-        candidates = [
-            n for n in order if isinstance(n.op, _FUSABLE)
-        ]
-        if len(candidates) < 2:
-            return
-        cand_set = set(candidates)
-        # deps[n] = the candidate applies n transitively depends on.
-        deps: dict = {}
-        for n in order:
-            d = set()
-            for inp in n.inputs:
-                owner = inp.owner
-                if owner is None:
-                    continue
-                d |= deps.get(owner, set())
-                if owner in cand_set:
-                    d.add(owner)
-            deps[n] = d
-        groups: list[list] = []
-        for c in candidates:
-            placed = False
-            for g in groups:
-                # Only the forward direction needs checking: group
-                # members precede c in topo order, so c can never be an
-                # ancestor of a member.
-                if any(m in deps[c] for m in g):
-                    continue  # c consumes a member's output
-                g.append(c)
-                placed = True
-                break
-            if not placed:
-                groups.append([c])
+        groups = group_independent(
+            order,
+            parents=lambda n: (
+                inp.owner for inp in n.inputs if inp.owner is not None
+            ),
+            is_candidate=lambda n: isinstance(n.op, _FUSABLE),
+        )
         for g in groups:
             if len(g) < 2:
                 continue

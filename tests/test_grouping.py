@@ -1,0 +1,135 @@
+"""Property tests for the fusion grouping algorithm (no pytensor needed).
+
+The fusion rewrite (bridge/fusion.py) can only execute where PyTensor
+is installed; its core risk — grouping two applies whose fusion would
+create a graph cycle — lives entirely in ``group_independent``, which
+is pure and tested here on randomized DAGs.
+"""
+
+import random
+
+import pytest
+
+from pytensor_federated_tpu.bridge.grouping import group_independent
+
+
+def random_dag(rng, n_nodes, p_edge, p_candidate):
+    """Nodes 0..n-1 in topological order; edges only point forward."""
+    parents = {i: set() for i in range(n_nodes)}
+    for j in range(n_nodes):
+        for i in range(j):
+            if rng.random() < p_edge:
+                parents[j].add(i)
+    candidates = {i for i in range(n_nodes) if rng.random() < p_candidate}
+    return parents, candidates
+
+
+def transitive_ancestors(parents, n):
+    seen = set()
+    stack = list(parents[n])
+    while stack:
+        m = stack.pop()
+        if m in seen:
+            continue
+        seen.add(m)
+        stack.extend(parents[m])
+    return seen
+
+
+def run(parents, candidates, n_nodes):
+    return group_independent(
+        range(n_nodes),
+        parents=lambda n: parents[n],
+        is_candidate=lambda n: n in candidates,
+    )
+
+
+@pytest.mark.parametrize("seed", range(25))
+def test_random_dags(seed):
+    rng = random.Random(seed)
+    n = rng.randrange(2, 40)
+    parents, candidates = random_dag(rng, n, rng.uniform(0.05, 0.4),
+                                     rng.uniform(0.2, 0.8))
+    groups = run(parents, candidates, n)
+
+    # every candidate appears in exactly one group
+    flat = [c for g in groups for c in g]
+    assert sorted(flat) == sorted(candidates)
+
+    anc = {i: transitive_ancestors(parents, i) for i in range(n)}
+    for g in groups:
+        # members pairwise independent: no member is an ancestor of
+        # another (fusing them can then never create a cycle)
+        for a in g:
+            for b in g:
+                if a != b:
+                    assert a not in anc[b] and b not in anc[a]
+        # members listed in topological order
+        assert g == sorted(g)
+
+
+@pytest.mark.parametrize("seed", range(25))
+def test_fused_graph_is_acyclic(seed):
+    # Simulate the actual fusion: contract each group to one node and
+    # check the contracted graph has no cycle (the property that
+    # ReplaceValidate would enforce at runtime).
+    rng = random.Random(seed + 1000)
+    n = rng.randrange(2, 40)
+    parents, candidates = random_dag(rng, n, rng.uniform(0.05, 0.4),
+                                     rng.uniform(0.2, 0.8))
+    groups = [g for g in run(parents, candidates, n) if len(g) > 1]
+    rep = {}
+    for gi, g in enumerate(groups):
+        for m in g:
+            rep[m] = ("fused", gi)
+    contracted = {}
+    for j in range(n):
+        src = rep.get(j, j)
+        contracted.setdefault(src, set())
+        for i in parents[j]:
+            pi = rep.get(i, i)
+            if pi != src:
+                contracted[src].add(pi)
+                contracted.setdefault(pi, set())
+    # cycle check via DFS with colors
+    WHITE, GREY, BLACK = 0, 1, 2
+    color = {v: WHITE for v in contracted}
+
+    def visit(v):
+        color[v] = GREY
+        for u in contracted[v]:
+            if color[u] == GREY:
+                raise AssertionError(f"cycle through {v} and {u}")
+            if color[u] == WHITE:
+                visit(u)
+        color[v] = BLACK
+
+    for v in list(contracted):
+        if color[v] == WHITE:
+            visit(v)
+
+
+def test_layered_graph_fuses_per_layer():
+    # Two independent layer-1 nodes feeding one layer-2 node: the
+    # classic reference topology (test_op_async.py:153-195).
+    parents = {0: set(), 1: set(), 2: {0, 1}}
+    groups = run(parents, {0, 1, 2}, 3)
+    assert [0, 1] in groups and [2] in groups
+
+
+def test_chain_never_groups():
+    parents = {0: set(), 1: {0}, 2: {1}}
+    groups = run(parents, {0, 1, 2}, 3)
+    assert groups == [[0], [1], [2]]
+
+
+def test_independence_through_noncandidate_intermediary():
+    # 0 -> (non-candidate 1) -> 2: 2 transitively depends on 0 and must
+    # not group with it even though no direct edge exists.
+    parents = {0: set(), 1: {0}, 2: {1}}
+    groups = run(parents, {0, 2}, 3)
+    assert groups == [[0], [2]]
+
+
+def test_no_candidates():
+    assert run({0: set()}, set(), 1) == []
