@@ -1,5 +1,10 @@
 """Model families covering the BASELINE.json benchmark configs."""
 
+from .countdata import (
+    FederatedNegBinGLM,
+    FederatedPoissonGLM,
+    generate_count_data,
+)
 from .glm import HierarchicalRadonGLM, generate_radon_data
 from .gp import FederatedSparseGP, dense_vfe_logp, generate_gp_data
 from .linear import FederatedLinearRegression, generate_node_data
@@ -28,7 +33,10 @@ from .statespace import (
 from .timeseries import SeqShardedAR1, generate_ar1_data
 
 __all__ = [
+    "FederatedNegBinGLM",
+    "FederatedPoissonGLM",
     "FederatedSparseGP",
+    "generate_count_data",
     "SeqShardedAR1",
     "FederatedLGSSMPanel",
     "SeqShardedLGSSM",

@@ -25,6 +25,7 @@ from jax.sharding import Mesh
 
 from ..parallel.packing import ShardedData, pack_shards
 from ..parallel.sharded import FederatedLogp
+from .hierbase import HierarchicalGLMBase
 from .linear import _normal_logpdf
 
 
@@ -78,7 +79,7 @@ def generate_hier_logistic_data(
 
 
 @dataclasses.dataclass
-class HierarchicalLogisticRegression:
+class HierarchicalLogisticRegression(HierarchicalGLMBase):
     """Mixed-effects logistic regression: shared slopes, one random
     intercept per federated shard with a learned group scale.
 
@@ -105,62 +106,11 @@ class HierarchicalLogisticRegression:
     prior_scale: float = 5.0
 
     def __post_init__(self):
-        n = self.data.n_shards
-        shard_ids = jnp.arange(n, dtype=jnp.int32)
-        (X, y), mask = self.data.tree()
+        self._post_init()
 
-        def per_shard_logp(params, shard):
-            (X, y), mask, sid = shard
-            tau = jnp.exp(params["log_tau"])
-            b = params["b0"] + tau * jnp.take(params["b_raw"], sid)
-            logits = X @ params["w"] + b
-            ll = y * logits - jnp.logaddexp(0.0, logits)
-            return jnp.sum(ll * mask)
-
-        self.fed = FederatedLogp(
-            per_shard_logp, ((X, y), mask, shard_ids), mesh=self.mesh
-        )
-        self.n_shards = n
-        self.n_features = X.shape[-1]
-
-    def prior_logp(self, params: Any) -> jax.Array:
-        lp = jnp.sum(_normal_logpdf(params["w"], 0.0, self.prior_scale))
-        lp += _normal_logpdf(params["b0"], 0.0, self.prior_scale)
-        tau = jnp.exp(params["log_tau"])
-        # HalfNormal(1) on tau with the log-transform Jacobian.
-        lp += -0.5 * tau**2 + params["log_tau"]
-        lp += jnp.sum(_normal_logpdf(params["b_raw"], 0.0, 1.0))
-        return lp
-
-    def intercepts(self, params: Any) -> jax.Array:
-        """The implied per-shard intercepts ``b0 + tau * b_raw``."""
-        return params["b0"] + jnp.exp(params["log_tau"]) * params["b_raw"]
-
-    def logp(self, params: Any) -> jax.Array:
-        return self.prior_logp(params) + self.fed.logp(params)
-
-    def logp_and_grad(self, params: Any):
-        return jax.value_and_grad(self.logp)(params)
-
-    def init_params(self) -> Any:
-        return {
-            "w": jnp.zeros((self.n_features,)),
-            "b0": jnp.zeros(()),
-            "log_tau": jnp.zeros(()),
-            "b_raw": jnp.zeros((self.n_shards,)),
-        }
-
-    def find_map(self, **kwargs):
-        from ..samplers import find_map
-
-        return find_map(self.logp, self.init_params(), **kwargs)
-
-    def sample(self, *, key=None, **kwargs):
-        from ..samplers import sample
-
-        if key is None:
-            key = jax.random.PRNGKey(0)
-        return sample(self.logp, self.init_params(), key=key, **kwargs)
+    def _obs_logpmf(self, params, y, eta):
+        # Bernoulli: y*eta - log(1 + e^eta), stable via logaddexp.
+        return y * eta - jnp.logaddexp(0.0, eta)
 
 
 @dataclasses.dataclass

@@ -1,0 +1,154 @@
+"""Count-data GLM family: golden-model equivalence + inference accuracy.
+
+Same strategy as the other families (SURVEY §4): scipy is the golden
+oracle for the observation logpmfs, a hand-built dense jnp expression
+is the oracle for the full posterior, MAP must recover the simulation
+truth, and a short NUTS run must converge with calibrated posteriors.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.stats
+
+from pytensor_federated_tpu.models.countdata import (
+    FederatedNegBinGLM,
+    FederatedPoissonGLM,
+    generate_count_data,
+    negbin_logpmf,
+    poisson_logpmf,
+)
+
+
+class TestLogpmfGolden:
+    def test_poisson_matches_scipy(self):
+        rng = np.random.default_rng(0)
+        y = rng.poisson(3.0, size=50).astype(np.float32)
+        eta = rng.normal(0.5, 1.0, size=50).astype(np.float32)
+        ours = np.asarray(poisson_logpmf(jnp.asarray(y), jnp.asarray(eta)))
+        golden = scipy.stats.poisson.logpmf(y, np.exp(eta))
+        np.testing.assert_allclose(ours, golden, rtol=2e-4, atol=2e-4)
+
+    def test_negbin_matches_scipy(self):
+        rng = np.random.default_rng(1)
+        y = rng.poisson(3.0, size=50).astype(np.float32)
+        eta = rng.normal(0.5, 0.8, size=50).astype(np.float32)
+        phi = 3.5
+        ours = np.asarray(
+            negbin_logpmf(jnp.asarray(y), jnp.asarray(eta), phi)
+        )
+        # scipy nbinom: n=phi, p=phi/(phi+mu)
+        mu = np.exp(eta)
+        golden = scipy.stats.nbinom.logpmf(y, phi, phi / (phi + mu))
+        np.testing.assert_allclose(ours, golden, rtol=2e-4, atol=2e-4)
+
+    def test_negbin_limits_to_poisson(self):
+        # phi large enough that NB2 ~ Poisson (truncation error
+        # O(y^2/phi) ~ 8e-3) but small enough that f32
+        # gammaln(y+phi) - gammaln(phi) has not yet lost all precision
+        # to cancellation (gammaln(1e4) ~ 8e4, f32 abs err ~ 5e-3).
+        y = jnp.asarray([0.0, 1.0, 4.0, 9.0])
+        eta = jnp.asarray([-1.0, 0.0, 1.0, 2.0])
+        nb = negbin_logpmf(y, eta, 1e4)
+        po = poisson_logpmf(y, eta)
+        np.testing.assert_allclose(np.asarray(nb), np.asarray(po), atol=5e-2)
+
+
+class TestPosteriorGolden:
+    def test_federated_logp_equals_dense_expression(self):
+        data, _ = generate_count_data(4, n_obs=24, n_features=3)
+        m = FederatedPoissonGLM(data)
+        params = {
+            "w": jnp.asarray([0.1, -0.2, 0.3]),
+            "b0": jnp.asarray(0.5),
+            "log_tau": jnp.asarray(-0.5),
+            "b_raw": jnp.asarray([0.3, -0.1, 0.2, 0.0]),
+        }
+        (X, y), mask = data.tree()
+        tau = jnp.exp(params["log_tau"])
+        b = params["b0"] + tau * params["b_raw"]
+        eta = jnp.einsum("snd,d->sn", X, params["w"]) + b[:, None]
+        dense = jnp.sum(poisson_logpmf(y, eta) * mask) + m.prior_logp(params)
+        np.testing.assert_allclose(
+            float(m.logp(params)), float(dense), rtol=1e-5
+        )
+
+    def test_grads_against_dense_autodiff(self):
+        data, _ = generate_count_data(4, n_obs=24, n_features=3)
+        m = FederatedPoissonGLM(data)
+        p0 = m.init_params()
+        v, g = m.logp_and_grad(p0)
+        (X, y), mask = data.tree()
+
+        def dense(params):
+            tau = jnp.exp(params["log_tau"])
+            b = params["b0"] + tau * params["b_raw"]
+            eta = jnp.einsum("snd,d->sn", X, params["w"]) + b[:, None]
+            return jnp.sum(poisson_logpmf(y, eta) * mask) + m.prior_logp(
+                params
+            )
+
+        vd, gd = jax.value_and_grad(dense)(p0)
+        np.testing.assert_allclose(float(v), float(vd), rtol=1e-5)
+        for k in g:
+            np.testing.assert_allclose(
+                np.asarray(g[k]), np.asarray(gd[k]), rtol=1e-4, atol=1e-5
+            )
+
+
+class TestInference:
+    def test_poisson_map_recovers_truth(self):
+        data, truth = generate_count_data(8, n_obs=96, n_features=3, seed=5)
+        m = FederatedPoissonGLM(data)
+        est = m.find_map()
+        np.testing.assert_allclose(
+            np.asarray(est["w"]), truth["w"], atol=0.15
+        )
+        assert abs(float(est["b0"]) - truth["b0"]) < 0.3
+
+    def test_negbin_map_recovers_truth(self):
+        data, truth = generate_count_data(
+            8, n_obs=128, n_features=3, dispersion=4.0, seed=6
+        )
+        m = FederatedNegBinGLM(data)
+        est = m.find_map()
+        np.testing.assert_allclose(
+            np.asarray(est["w"]), truth["w"], atol=0.2
+        )
+
+    def test_poisson_nuts_converges(self):
+        data, truth = generate_count_data(4, n_obs=64, n_features=2, seed=7)
+        m = FederatedPoissonGLM(data)
+        res = m.sample(
+            key=jax.random.PRNGKey(2),
+            num_warmup=300,
+            num_samples=300,
+            num_chains=2,
+        )
+        summ = res.summary()
+        assert float(np.max(np.asarray(summ["rhat"]["w"]))) < 1.05
+        w_mean = np.asarray(res.samples["w"]).mean(axis=(0, 1))
+        np.testing.assert_allclose(w_mean, truth["w"], atol=0.2)
+
+
+@pytest.mark.parametrize("cls", [FederatedPoissonGLM, FederatedNegBinGLM])
+def test_on_mesh(cls, devices8):
+    from pytensor_federated_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh({"shards": 8}, devices=devices8)
+    data, _ = generate_count_data(8, n_obs=32, n_features=2, seed=9)
+    m_mesh = cls(data, mesh=mesh)
+    m_local = cls(data)
+    p0 = m_local.init_params()
+    # psum reduction order differs from the single-device flat sum;
+    # with gammaln-sized terms the f32 divergence can reach ~1e-4 rel.
+    np.testing.assert_allclose(
+        float(m_mesh.logp(p0)), float(m_local.logp(p0)), rtol=5e-4
+    )
+    v1, g1 = m_mesh.logp_and_grad(p0)
+    v2, g2 = m_local.logp_and_grad(p0)
+    for k in g1:
+        np.testing.assert_allclose(
+            np.asarray(g1[k]), np.asarray(g2[k]), rtol=1e-3, atol=1e-4
+        )
