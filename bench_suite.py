@@ -120,6 +120,10 @@ def main():
         }
         results.append(line)
         print(json.dumps(line))
+        # Persist INCREMENTALLY: any later assertion failure (accuracy
+        # gates, convergence) must not discard completed configs.
+        with open("BENCH_SUITE.json", "w") as f:
+            json.dump(results, f, indent=1)
 
     def bench_config(config, fn, x0):
         fl = xla_flops_per_eval(fn, x0)
@@ -190,35 +194,70 @@ def main():
     dataw, _ = generate_logistic_data(
         n_shards=8, n_obs=4096, n_features=512, seed=77
     )
-    modelw = FederatedLogisticRegression(dataw)
-    fnw1, xw1 = _flat(modelw)
-    _fnw_batched = jax.vmap(fnw1)
+    def batched_flat(model):
+        fn1, x1 = _flat(model)
+        vm = jax.vmap(fn1)
 
-    def fnw(x):
-        # Sum the per-chain values so the chained runner's scalar
-        # accumulator type-checks; the gradient stays (chains, d).
-        v, g = _fnw_batched(x)
-        return v.sum(), g
+        def fn(x):
+            # Sum the per-chain values so the chained runner's scalar
+            # accumulator type-checks; the gradient stays (chains, d).
+            v, g = vm(x)
+            return v.sum(), g
+
+        return fn, vm, x1
+
+    import jax.numpy as jnp
+
+    fnw, vm32, xw1 = batched_flat(FederatedLogisticRegression(dataw))
+    fnw16, vm16, _ = batched_flat(
+        FederatedLogisticRegression(dataw, compute_dtype=jnp.bfloat16)
+    )
     key = jax.random.PRNGKey(3)
     xw = xw1[None, :] + 0.01 * jax.random.normal(
         key, (n_chains, xw1.shape[0]), xw1.dtype
     )
-    flw = xla_flops_per_eval(fnw, xw)
+    # bf16 races f32 behind an explicit looser gate (bf16 has 8
+    # mantissa bits: ~1e-2 relative is its accuracy contract, pinned in
+    # tests/test_mixed_precision.py — NOT the exact-impl 2e-4 gate).
+    # Checked PER CHAIN (no cross-chain cancellation) and on the
+    # gradients, since the raced function's gradient drives the chained
+    # trajectory — the bench.py gate convention.
+    val32, grad32 = vm32(xw)
+    val16, grad16 = vm16(xw)
+    np.testing.assert_allclose(
+        np.asarray(val16), np.asarray(val32), rtol=2e-2
+    )
+    np.testing.assert_allclose(
+        np.asarray(grad16),
+        np.asarray(grad32),
+        rtol=5e-2,
+        atol=5e-2 * float(jnp.max(jnp.abs(grad32))),
+    )
+    best = {"rate": -1.0}
+    for name, fn in {"f32": fnw, "bf16-matmul": fnw16}.items():
+        fl = xla_flops_per_eval(fn, xw)
+        r, n = _rate(fn, xw, n_cal=5, floor=10, mid_wall=0.5, target_wall=1.5)
+        print(
+            f"# wide-logistic impl {name}: {r:,.1f} batched evals/s",
+            file=sys.stderr,
+        )
+        if r > best["rate"]:
+            best = {"name": name, "rate": r, "n": n, "fl": fl}
     peak_rate = None
-    if flw:
+    if best["fl"]:
         from pytensor_federated_tpu.flopcount import peak_flops
 
         peak, _basis = peak_flops()
-        peak_rate = COMPUTE_BOUND_TARGET_MFU * peak / flw
-    rw, nw = _rate(fnw, xw, n_cal=5, floor=10, mid_wall=0.5, target_wall=1.5)
+        peak_rate = COMPUTE_BOUND_TARGET_MFU * peak / best["fl"]
     record(
         "wide logistic 8x4096x512, 64 vectorized chains (compute-bound)",
-        rw,
+        best["rate"],
         unit="batched evals/s",
         baseline_rate=peak_rate,
         baseline_desc=f"{COMPUTE_BOUND_TARGET_MFU:.0%} MFU",
-        flops_per_eval=flw,
-        n=nw,
+        flops_per_eval=best["fl"],
+        n=best["n"],
+        impl=best["name"],
     )
 
     # 8. Full NUTS posterior on config 5, against an explicit target.
@@ -237,7 +276,8 @@ def main():
     jax.block_until_ready(res.samples)
     wall = time.perf_counter() - t0
     n_draws = 4 * 200
-    rhat = float(np.asarray(res.summary()["rhat"]["w"]).max())
+    summ = res.summary()
+    rhat = float(np.asarray(summ["rhat"]["w"]).max())
     # Leapfrog-eval lower bound from the kept draws' tree depths (a
     # depth-k NUTS tree costs 2^k - 1 gradient evals); warmup evals are
     # not tracked, so the MFU here is an explicit lower bound.
@@ -246,6 +286,11 @@ def main():
     if fl_eval5 is not None and depth_raw is not None:
         n_evals_lb = float(np.sum(2.0 ** np.asarray(depth_raw) - 1.0))
         fl_sample = fl_eval5 * n_evals_lb / n_draws
+    # Effective samples per second: raw samples/s can hide an
+    # autocorrelated chain; min-ESS/wall cannot.
+    ess_min = float(
+        min(np.min(np.asarray(v)) for v in summ["ess"].values())
+    )
     record(
         "64-shard logistic: full NUTS posterior",
         n_draws / wall,
@@ -259,12 +304,9 @@ def main():
         wall_s=round(wall, 2),
         note="includes warmup+compile; flops/mfu are draw-phase lower bounds",
         max_rhat=round(rhat, 4),
+        min_ess_per_sec=round(ess_min / wall, 1),
     )
 
-    # Persist all measurements BEFORE any convergence assertion — a
-    # flaky chain must not discard minutes of completed configs.
-    with open("BENCH_SUITE.json", "w") as f:
-        json.dump(results, f, indent=1)
     print(f"# wrote BENCH_SUITE.json ({len(results)} configs)", file=sys.stderr)
     assert rhat < 1.2, f"NUTS did not converge: max rhat {rhat}"
 

@@ -73,8 +73,13 @@ def generate_count_data(
 
 
 def poisson_logpmf(y, eta):
-    """log Poisson(y | mu=exp(eta)) with eta the linear predictor —
-    evaluated in log space (no overflow for large eta)."""
+    """log Poisson(y | mu=exp(eta)) with eta the linear predictor.
+
+    The ``y * eta`` term works in log space, but the mean term
+    ``-exp(eta)`` is irreducible: for eta beyond f32 exp range (~88)
+    it overflows to ``-inf`` logp / ``-inf`` gradient — a *rejected
+    proposal* under MH/NUTS (non-finite energies count as divergences),
+    never NaN, because no ``0 * inf`` product can form here."""
     return y * eta - jnp.exp(eta) - gammaln(y + 1.0)
 
 
@@ -106,6 +111,7 @@ class FederatedPoissonGLM(HierarchicalGLMBase):
     data: ShardedData
     mesh: Optional[Mesh] = None
     prior_scale: float = 5.0
+    compute_dtype: Optional[Any] = None  # see HierarchicalGLMBase
     _init_log_tau = -1.0
 
     def __post_init__(self):
@@ -123,6 +129,7 @@ class FederatedNegBinGLM(HierarchicalGLMBase):
     data: ShardedData
     mesh: Optional[Mesh] = None
     prior_scale: float = 5.0
+    compute_dtype: Optional[Any] = None  # see HierarchicalGLMBase
     _init_log_tau = -1.0
 
     def __post_init__(self):

@@ -25,7 +25,7 @@ from jax.sharding import Mesh
 
 from ..parallel.packing import ShardedData, pack_shards
 from ..parallel.sharded import FederatedLogp
-from .hierbase import HierarchicalGLMBase
+from .hierbase import HierarchicalGLMBase, linear_predictor
 from .linear import _normal_logpdf
 
 
@@ -104,6 +104,7 @@ class HierarchicalLogisticRegression(HierarchicalGLMBase):
     data: ShardedData
     mesh: Optional[Mesh] = None
     prior_scale: float = 5.0
+    compute_dtype: Optional[Any] = None  # see HierarchicalGLMBase
 
     def __post_init__(self):
         self._post_init()
@@ -118,11 +119,16 @@ class FederatedLogisticRegression:
     data: ShardedData
     mesh: Optional[Mesh] = None
     prior_scale: float = 5.0
+    #: see HierarchicalGLMBase.compute_dtype — bf16 matmul w/ f32
+    #: accumulation when set; the MXU mixed-precision recipe.
+    compute_dtype: Optional[Any] = None
 
     def __post_init__(self):
         def per_shard_logp(params, shard):
             (X, y), mask = shard
-            logits = X @ params["w"] + params["b"]
+            logits = linear_predictor(
+                X, params["w"], params["b"], self.compute_dtype
+            )
             # Numerically stable Bernoulli log-likelihood.
             ll = y * logits - jnp.logaddexp(0.0, logits)
             return jnp.sum(ll * mask)
