@@ -34,6 +34,7 @@ target.  Run: ``python bench_suite.py``.
 """
 
 import json
+import os
 import sys
 import time
 
@@ -120,10 +121,13 @@ def main():
         }
         results.append(line)
         print(json.dumps(line))
-        # Persist INCREMENTALLY: any later assertion failure (accuracy
-        # gates, convergence) must not discard completed configs.
-        with open("BENCH_SUITE.json", "w") as f:
+        # Persist INCREMENTALLY and ATOMICALLY: a later assertion
+        # failure must not discard completed configs, and a crash
+        # mid-write must not clobber the previous complete file.
+        tmp = "BENCH_SUITE.json.tmp"
+        with open(tmp, "w") as f:
             json.dump(results, f, indent=1)
+        os.replace(tmp, "BENCH_SUITE.json")
 
     def bench_config(config, fn, x0):
         fl = xla_flops_per_eval(fn, x0)

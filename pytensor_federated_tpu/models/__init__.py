@@ -14,7 +14,17 @@ from .logistic import (
     generate_hier_logistic_data,
     generate_logistic_data,
 )
-from .ode import LotkaVolterraModel, generate_lv_data, make_lv_model, rk4_integrate
+from .ode import (
+    LotkaVolterraModel,
+    generate_lv_data,
+    make_lv_model,
+    rk4_integrate,
+)
+from .robust import (
+    FederatedRobustRegression,
+    generate_robust_data,
+    student_t_logpdf,
+)
 from .statespace import (
     FederatedLGSSMPanel,
     SeqShardedLGSSM,
@@ -35,8 +45,11 @@ from .timeseries import SeqShardedAR1, generate_ar1_data
 __all__ = [
     "FederatedNegBinGLM",
     "FederatedPoissonGLM",
+    "FederatedRobustRegression",
     "FederatedSparseGP",
     "generate_count_data",
+    "generate_robust_data",
+    "student_t_logpdf",
     "SeqShardedAR1",
     "FederatedLGSSMPanel",
     "SeqShardedLGSSM",

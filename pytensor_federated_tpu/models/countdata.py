@@ -75,12 +75,15 @@ def generate_count_data(
 def poisson_logpmf(y, eta):
     """log Poisson(y | mu=exp(eta)) with eta the linear predictor.
 
-    The ``y * eta`` term works in log space, but the mean term
-    ``-exp(eta)`` is irreducible: for eta beyond f32 exp range (~88)
-    it overflows to ``-inf`` logp / ``-inf`` gradient — a *rejected
-    proposal* under MH/NUTS (non-finite energies count as divergences),
-    never NaN, because no ``0 * inf`` product can form here."""
-    return y * eta - jnp.exp(eta) - gammaln(y + 1.0)
+    The mean term ``-exp(eta)`` is evaluated with eta clamped to 80
+    (exp(80) ~ 5.5e34, comfortably inside f32): beyond that the true
+    logp is astronomically negative anyway, and the clamp keeps both
+    the value and the gradient FINITE.  Unclamped, an overflowing
+    proposal yields ``-inf`` whose chain rule forms ``0 * -inf = NaN``
+    against exact-zero design entries or padded (mask=0) rows, and one
+    NaN poisons the whole shard sum; a huge-but-finite negative logp is
+    an ordinary rejected proposal instead."""
+    return y * eta - jnp.exp(jnp.minimum(eta, 80.0)) - gammaln(y + 1.0)
 
 
 def negbin_logpmf(y, eta, phi):

@@ -1,0 +1,121 @@
+"""Federated robust (Student-t) regression.
+
+Gaussian likelihoods hand outliers quadratic influence; a Student-t
+observation model caps it, so a few corrupted observations on one
+federated shard cannot drag the shared slopes (a real failure mode for
+federation: one node's bad sensor poisons everyone's posterior).
+
+Model: the shared hierarchical-GLM structure (models/hierbase.py) with
+
+    y_ij ~ StudentT(nu, loc=eta_ij, scale=sigma)
+
+and log-parameterized ``sigma`` (HalfNormal(1) prior) and ``nu``
+(shifted so nu > 1; Exponential(1/10) prior on nu - 1 keeps the mean
+defined while letting the data choose tail weight — nu -> large
+recovers the Gaussian model).
+
+TPU notes: identical hot shape to the other families (batched
+``X @ w`` matvec on the MXU); the t-density needs only ``log1p`` and
+``gammaln`` — VPU transcendentals, no branches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.scipy.special import gammaln
+from jax.sharding import Mesh
+
+from ..parallel.packing import ShardedData, pack_shards
+from .hierbase import HierarchicalGLMBase
+
+__all__ = [
+    "FederatedRobustRegression",
+    "generate_robust_data",
+    "student_t_logpdf",
+]
+
+
+def generate_robust_data(
+    n_shards: int = 8,
+    *,
+    n_obs: int = 64,
+    n_features: int = 4,
+    tau: float = 0.3,
+    outlier_frac: float = 0.1,
+    outlier_scale: float = 10.0,
+    seed: int = 23,
+):
+    """Per-shard Gaussian data with a fraction of gross outliers —
+    the scenario the t-likelihood exists for."""
+    rng = np.random.default_rng(seed)
+    w_true = rng.normal(0.0, 0.5, size=n_features)
+    b0_true = 0.5
+    b_true = b0_true + tau * rng.normal(size=n_shards)
+    shards = []
+    for i in range(n_shards):
+        X = rng.normal(0.0, 1.0, size=(n_obs, n_features)).astype(np.float32)
+        y = b_true[i] + X @ w_true + 0.5 * rng.normal(size=n_obs)
+        n_out = int(outlier_frac * n_obs)
+        if n_out:
+            idx = rng.choice(n_obs, size=n_out, replace=False)
+            y[idx] += outlier_scale * rng.standard_cauchy(size=n_out)
+        shards.append((X, y.astype(np.float32)))
+    truth = {"w": w_true, "b0": b0_true, "b": b_true}
+    return pack_shards(shards, pad_to_multiple=8), truth
+
+
+def student_t_logpdf(y, loc, scale, nu):
+    """log StudentT(y | nu, loc, scale), branch-free."""
+    z = (y - loc) / scale
+    half_nu = 0.5 * nu
+    return (
+        gammaln(half_nu + 0.5)
+        - gammaln(half_nu)
+        - 0.5 * jnp.log(nu * jnp.pi)
+        - jnp.log(scale)
+        - (half_nu + 0.5) * jnp.log1p(z * z / nu)
+    )
+
+
+@dataclasses.dataclass
+class FederatedRobustRegression(HierarchicalGLMBase):
+    """Hierarchical Student-t regression over federated shards."""
+
+    data: ShardedData
+    mesh: Optional[Mesh] = None
+    prior_scale: float = 5.0
+    compute_dtype: Optional[Any] = None  # see HierarchicalGLMBase
+
+    def __post_init__(self):
+        self._post_init()
+
+    def _obs_logpmf(self, params, y, eta):
+        sigma = jnp.exp(params["log_sigma"])
+        nu = 1.0 + jnp.exp(params["log_numinus1"])
+        return student_t_logpdf(y, eta, sigma, nu)
+
+    def prior_logp(self, params: Any) -> jax.Array:
+        lp = super().prior_logp(params)
+        # HalfNormal(1) on sigma (log-param + Jacobian).
+        sigma = jnp.exp(params["log_sigma"])
+        lp += -0.5 * sigma**2 + params["log_sigma"]
+        # Exponential(rate=1/10) on nu - 1 (log-param + Jacobian):
+        # weakly favors heavy tails but lets nu grow if data are clean.
+        numinus1 = jnp.exp(params["log_numinus1"])
+        lp += -numinus1 / 10.0 + params["log_numinus1"]
+        return lp
+
+    def init_params(self) -> Any:
+        p = super().init_params()
+        p["log_sigma"] = jnp.zeros(())
+        p["log_numinus1"] = jnp.array(1.0)
+        return p
+
+    def nu(self, params: Any) -> jax.Array:
+        """The implied degrees of freedom."""
+        return 1.0 + jnp.exp(params["log_numinus1"])

@@ -152,3 +152,19 @@ def test_on_mesh(cls, devices8):
         np.testing.assert_allclose(
             np.asarray(g1[k]), np.asarray(g2[k]), rtol=1e-3, atol=1e-4
         )
+
+
+def test_poisson_overflow_stays_finite():
+    # Extreme proposals (eta >> f32 exp range) must give a huge negative
+    # logp with FINITE gradients — not -inf/NaN that poisons the shard
+    # sum through 0 * inf against zero design entries or padded rows.
+    X = jnp.asarray([[0.0, 1.0], [1.0, 0.0]])
+    y = jnp.asarray([0.0, 3.0])
+
+    def lp(w):
+        return jnp.sum(poisson_logpmf(y, X @ w))
+
+    w_extreme = jnp.asarray([200.0, 200.0])
+    v, g = jax.value_and_grad(lp)(w_extreme)
+    assert np.isfinite(float(v)) and float(v) < -1e30
+    assert np.all(np.isfinite(np.asarray(g)))

@@ -1,0 +1,108 @@
+"""Robust regression: scipy golden, outlier resistance, inference.
+
+The headline property test: on shards with 10% gross (Cauchy-scaled)
+outliers, the t-likelihood recovers the true slopes where the Gaussian
+model is dragged away — the reason the family exists.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import scipy.stats
+
+from pytensor_federated_tpu.models.robust import (
+    FederatedRobustRegression,
+    generate_robust_data,
+    student_t_logpdf,
+)
+
+
+def test_logpdf_matches_scipy():
+    rng = np.random.default_rng(0)
+    y = rng.normal(0, 3, size=60).astype(np.float32)
+    loc = rng.normal(0, 1, size=60).astype(np.float32)
+    ours = np.asarray(
+        student_t_logpdf(jnp.asarray(y), jnp.asarray(loc), 0.7, 4.5)
+    )
+    golden = scipy.stats.t.logpdf(y, df=4.5, loc=loc, scale=0.7)
+    np.testing.assert_allclose(ours, golden, rtol=2e-4, atol=2e-4)
+
+
+def test_large_nu_approaches_gaussian():
+    y = jnp.linspace(-3, 3, 13)
+    t_ll = student_t_logpdf(y, 0.0, 1.0, 1e4)
+    g_ll = -0.5 * y**2 - 0.5 * jnp.log(2 * jnp.pi)
+    np.testing.assert_allclose(np.asarray(t_ll), np.asarray(g_ll), atol=2e-3)
+
+
+def test_map_resists_outliers_where_gaussian_fails():
+    data, truth = generate_robust_data(
+        8, n_obs=96, n_features=3, outlier_frac=0.1, outlier_scale=20.0,
+        seed=42,
+    )
+    robust = FederatedRobustRegression(data)
+    est = robust.find_map()
+    err_robust = float(np.abs(np.asarray(est["w"]) - truth["w"]).max())
+
+    # Gaussian comparator: the SAME model with nu pinned huge (the
+    # t-density at nu=1e4 is Gaussian to 4 decimals, pinned above).
+    from pytensor_federated_tpu.samplers import find_map
+
+    gauss = FederatedRobustRegression(data)
+
+    def gauss_logp(p):
+        q = dict(p)
+        q["log_numinus1"] = jnp.asarray(float(np.log(1e4)))
+        return gauss.logp(q)
+
+    p_g = find_map(gauss_logp, gauss.init_params())
+    err_gauss = float(np.abs(np.asarray(p_g["w"]) - truth["w"]).max())
+
+    assert err_robust < 0.15, f"robust MAP err {err_robust}"
+    # The Gaussian fit must be measurably worse — this is the point.
+    assert err_gauss > 1.5 * err_robust, (err_gauss, err_robust)
+
+
+def test_nu_learns_tails():
+    # Clean data -> large nu; contaminated data -> small nu.
+    clean, _ = generate_robust_data(4, n_obs=96, outlier_frac=0.0, seed=1)
+    dirty, _ = generate_robust_data(4, n_obs=96, outlier_frac=0.15, seed=1)
+    nu_clean = float(
+        FederatedRobustRegression(clean).nu(
+            FederatedRobustRegression(clean).find_map()
+        )
+    )
+    nu_dirty = float(
+        FederatedRobustRegression(dirty).nu(
+            FederatedRobustRegression(dirty).find_map()
+        )
+    )
+    assert nu_dirty < nu_clean
+
+
+def test_nuts_converges():
+    data, truth = generate_robust_data(4, n_obs=64, n_features=2, seed=3)
+    m = FederatedRobustRegression(data)
+    res = m.sample(
+        key=jax.random.PRNGKey(4),
+        num_warmup=300,
+        num_samples=300,
+        num_chains=2,
+    )
+    summ = res.summary()
+    assert float(np.max(np.asarray(summ["rhat"]["w"]))) < 1.06
+    w_mean = np.asarray(res.samples["w"]).mean(axis=(0, 1))
+    np.testing.assert_allclose(w_mean, truth["w"], atol=0.2)
+
+
+def test_on_mesh(devices8):
+    from pytensor_federated_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh({"shards": 8}, devices=devices8)
+    data, _ = generate_robust_data(8, n_obs=32, n_features=2, seed=9)
+    m_mesh = FederatedRobustRegression(data, mesh=mesh)
+    m_local = FederatedRobustRegression(data)
+    p0 = m_local.init_params()
+    np.testing.assert_allclose(
+        float(m_mesh.logp(p0)), float(m_local.logp(p0)), rtol=5e-4
+    )
