@@ -22,6 +22,10 @@ from pytensor_federated_tpu.models.logistic import (
     generate_hier_logistic_data,
     generate_logistic_data,
 )
+from pytensor_federated_tpu.models.robust import (
+    FederatedRobustRegression,
+    generate_robust_data,
+)
 
 
 def _perturbed(params, seed=3, scale=0.3):
@@ -49,6 +53,10 @@ CASES = [
     (
         FederatedPoissonGLM,
         lambda: generate_count_data(8, n_obs=64, n_features=8),
+    ),
+    (
+        FederatedRobustRegression,
+        lambda: generate_robust_data(8, n_obs=64, n_features=8),
     ),
 ]
 

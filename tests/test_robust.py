@@ -48,14 +48,12 @@ def test_map_resists_outliers_where_gaussian_fails():
     # t-density at nu=1e4 is Gaussian to 4 decimals, pinned above).
     from pytensor_federated_tpu.samplers import find_map
 
-    gauss = FederatedRobustRegression(data)
-
     def gauss_logp(p):
         q = dict(p)
         q["log_numinus1"] = jnp.asarray(float(np.log(1e4)))
-        return gauss.logp(q)
+        return robust.logp(q)
 
-    p_g = find_map(gauss_logp, gauss.init_params())
+    p_g = find_map(gauss_logp, robust.init_params())
     err_gauss = float(np.abs(np.asarray(p_g["w"]) - truth["w"]).max())
 
     assert err_robust < 0.15, f"robust MAP err {err_robust}"
@@ -67,16 +65,10 @@ def test_nu_learns_tails():
     # Clean data -> large nu; contaminated data -> small nu.
     clean, _ = generate_robust_data(4, n_obs=96, outlier_frac=0.0, seed=1)
     dirty, _ = generate_robust_data(4, n_obs=96, outlier_frac=0.15, seed=1)
-    nu_clean = float(
-        FederatedRobustRegression(clean).nu(
-            FederatedRobustRegression(clean).find_map()
-        )
-    )
-    nu_dirty = float(
-        FederatedRobustRegression(dirty).nu(
-            FederatedRobustRegression(dirty).find_map()
-        )
-    )
+    m_clean = FederatedRobustRegression(clean)
+    m_dirty = FederatedRobustRegression(dirty)
+    nu_clean = float(m_clean.nu(m_clean.find_map()))
+    nu_dirty = float(m_dirty.nu(m_dirty.find_map()))
     assert nu_dirty < nu_clean
 
 
