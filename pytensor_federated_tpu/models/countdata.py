@@ -123,6 +123,9 @@ class FederatedPoissonGLM(HierarchicalGLMBase):
     def _obs_logpmf(self, params, y, eta):
         return poisson_logpmf(y, eta)
 
+    def _sample_obs(self, params, key, eta):
+        return jax.random.poisson(key, jnp.exp(eta)).astype(eta.dtype)
+
 
 @dataclasses.dataclass
 class FederatedNegBinGLM(HierarchicalGLMBase):
@@ -140,6 +143,15 @@ class FederatedNegBinGLM(HierarchicalGLMBase):
 
     def _obs_logpmf(self, params, y, eta):
         return negbin_logpmf(y, eta, jnp.exp(params["log_phi"]))
+
+    def _sample_obs(self, params, key, eta):
+        # NB2 as its Gamma-Poisson mixture: lam ~ Gamma(phi, mu/phi).
+        phi = jnp.exp(params["log_phi"])
+        k_g, k_p = jax.random.split(key)
+        lam = jax.random.gamma(k_g, phi, eta.shape) * (
+            jnp.exp(eta) / phi
+        )
+        return jax.random.poisson(k_p, lam).astype(eta.dtype)
 
     def prior_logp(self, params: Any) -> jax.Array:
         lp = super().prior_logp(params)

@@ -129,6 +129,24 @@ class HierarchicalGLMBase:
             "b_raw": jnp.zeros((self.n_shards,)),
         }
 
+    def _sample_obs(self, params, key, eta):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def predictive(self, params: Any, key) -> jax.Array:
+        """Simulate one replicated dataset ``(n_shards, n_obs)`` from
+        the observation model at ``params`` (padded slots zeroed).
+
+        Shaped for :func:`..samplers.predictive.posterior_predictive`:
+        ``posterior_predictive(model.predictive, res.samples, key)``
+        sweeps it over every kept draw — the ``pm.sample_posterior_
+        predictive`` workflow (reference consumers end with arviz
+        predictive checks; here it is one vmapped executable).
+        """
+        (X, _y), mask = self.data.tree()
+        b = self.intercepts(params)
+        eta = self._linear_predictor(X, params["w"], b[:, None])
+        return self._sample_obs(params, key, eta) * mask
+
     def find_map(self, **kwargs):
         from ..samplers import find_map
 

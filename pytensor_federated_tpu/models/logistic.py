@@ -113,6 +113,11 @@ class HierarchicalLogisticRegression(HierarchicalGLMBase):
         # Bernoulli: y*eta - log(1 + e^eta), stable via logaddexp.
         return y * eta - jnp.logaddexp(0.0, eta)
 
+    def _sample_obs(self, params, key, eta):
+        return jax.random.bernoulli(key, jax.nn.sigmoid(eta)).astype(
+            eta.dtype
+        )
+
 
 @dataclasses.dataclass
 class FederatedLogisticRegression:

@@ -99,6 +99,11 @@ class FederatedRobustRegression(HierarchicalGLMBase):
         nu = 1.0 + jnp.exp(params["log_numinus1"])
         return student_t_logpdf(y, eta, sigma, nu)
 
+    def _sample_obs(self, params, key, eta):
+        sigma = jnp.exp(params["log_sigma"])
+        nu = 1.0 + jnp.exp(params["log_numinus1"])
+        return eta + sigma * jax.random.t(key, nu, eta.shape)
+
     def prior_logp(self, params: Any) -> jax.Array:
         lp = super().prior_logp(params)
         # HalfNormal(1) on sigma (log-param + Jacobian).

@@ -1,0 +1,147 @@
+"""One-command TPU benchmark capture with the wedge policy built in.
+
+Usage: ``python tools/tpu_capture.py [--skip-suite]``
+
+The axon-tunneled chip WEDGES if a process dies mid-TPU-call (see
+CLAUDE.md): a half-open claim blocks every later PJRT init, and the
+wedge can last many hours.  This script encodes the safe procedure so
+a capture can never be fumbled:
+
+1. probe liveness in a subprocess under a timeout (never dials the
+   plugin in-process) — exit non-zero immediately if wedged;
+2. refuse to run if the machine is busy (concurrent load halves CPU
+   numbers and slows TPU host dispatch);
+3. run ``bench.py`` then ``bench_suite.py`` with NO timeout — a
+   timeout that fires mid-TPU-call is exactly how the chip wedged in
+   round 1 — letting every call complete;
+4. verify the artifacts really say ``"backend": "tpu"`` and report.
+
+Compiled Mosaic (Pallas) stays opt-in: pass ``--try-mosaic`` to let
+the preflight probe it (in its own subprocess) and, if it survives,
+export ``PFTPU_PALLAS_COMPILED=1`` for the bench.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def machine_busy(threshold: float = 1.0) -> bool:
+    load1 = os.getloadavg()[0]
+    return load1 > threshold
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--skip-suite", action="store_true")
+    parser.add_argument("--try-mosaic", action="store_true")
+    parser.add_argument(
+        "--force-busy",
+        action="store_true",
+        help="run even if load average says the machine is busy",
+    )
+    args = parser.parse_args()
+
+    sys.path.insert(0, REPO)
+    from pytensor_federated_tpu.utils import probe_backend
+
+    live, mosaic_ok = probe_backend(try_mosaic=args.try_mosaic)
+    if not live:
+        print("TPU NOT live (probe timed out) — not capturing.", file=sys.stderr)
+        return 1
+    print(f"TPU live (mosaic_ok={mosaic_ok})", file=sys.stderr)
+
+    if machine_busy() and not args.force_busy:
+        print(
+            "machine busy (load > 1) — refusing to capture skewed numbers; "
+            "re-run when idle or pass --force-busy",
+            file=sys.stderr,
+        )
+        return 2
+
+    env = dict(os.environ)
+    if args.try_mosaic and mosaic_ok:
+        env["PFTPU_PALLAS_COMPILED"] = "1"
+
+    # NO timeout on the bench runs: killing a process mid-TPU-call is
+    # how the chip wedges for hours.  Worst case is bounded by the
+    # bench's own sizing (a few minutes).
+    print("== bench.py ==", file=sys.stderr)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+    )
+    sys.stderr.write(out.stderr)
+    print(out.stdout.strip())
+    line = None
+    for ln in out.stdout.splitlines():
+        try:
+            line = json.loads(ln)
+            break
+        except json.JSONDecodeError:
+            continue
+    if not line:
+        print("bench.py printed no JSON line!", file=sys.stderr)
+        return 3
+    if line.get("backend") != "tpu":
+        print(
+            f"bench ran on {line.get('backend')!r}, not tpu — probe raced a "
+            "re-wedge?",
+            file=sys.stderr,
+        )
+        return 4
+
+    if not args.skip_suite:
+        print("== bench_suite.py ==", file=sys.stderr)
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench_suite.py")],
+            cwd=REPO,
+            env=env,
+            capture_output=True,
+            text=True,
+        )
+        sys.stderr.write(out.stderr)
+        print(out.stdout.strip())
+        if out.returncode != 0:
+            # The suite's writes are atomic, so BENCH_SUITE.json on
+            # disk may be STALE — reading it now would report success
+            # on numbers this run never produced.
+            print(
+                f"bench_suite.py failed (exit {out.returncode}) — "
+                "artifact not refreshed",
+                file=sys.stderr,
+            )
+            return 5
+        with open(os.path.join(REPO, "BENCH_SUITE.json")) as f:
+            suite = json.load(f)
+        backends = {r.get("backend") for r in suite}
+        if backends != {"tpu"}:
+            print(
+                f"suite ran on {backends}, not all-tpu (re-wedge "
+                "mid-capture?) — rejecting",
+                file=sys.stderr,
+            )
+            return 6
+        below = [
+            r["config"]
+            for r in suite
+            if r.get("vs_baseline") is not None and r["vs_baseline"] < 1.0
+        ]
+        if below:
+            print(f"configs below baseline: {below}", file=sys.stderr)
+
+    print("capture complete", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
