@@ -5,6 +5,7 @@ from .countdata import (
     FederatedPoissonGLM,
     generate_count_data,
 )
+from .gamma import FederatedGammaGLM, gamma_logpdf, generate_gamma_data
 from .glm import HierarchicalRadonGLM, generate_radon_data
 from .gp import FederatedSparseGP, dense_vfe_logp, generate_gp_data
 from .linear import FederatedLinearRegression, generate_node_data
@@ -43,11 +44,14 @@ from .statespace import (
 from .timeseries import SeqShardedAR1, generate_ar1_data
 
 __all__ = [
+    "FederatedGammaGLM",
     "FederatedNegBinGLM",
     "FederatedPoissonGLM",
     "FederatedRobustRegression",
     "FederatedSparseGP",
+    "gamma_logpdf",
     "generate_count_data",
+    "generate_gamma_data",
     "generate_robust_data",
     "student_t_logpdf",
     "SeqShardedAR1",

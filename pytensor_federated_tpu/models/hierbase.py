@@ -132,6 +132,16 @@ class HierarchicalGLMBase:
     def _sample_obs(self, params, key, eta):  # pragma: no cover - abstract
         raise NotImplementedError
 
+    def pointwise_loglik(self, params: Any) -> jax.Array:
+        """``(n_shards, n_obs)`` per-observation data log-likelihoods
+        (padded slots zeroed).  Feed to
+        :func:`..samplers.model_comparison.pointwise_loglik_matrix`
+        with ``mask=model.data.tree()[1]`` for WAIC / PSIS-LOO."""
+        (X, y), mask = self.data.tree()
+        b = self.intercepts(params)
+        eta = self._linear_predictor(X, params["w"], b[:, None])
+        return self._obs_logpmf(params, y, eta) * mask
+
     def predictive(self, params: Any, key) -> jax.Array:
         """Simulate one replicated dataset ``(n_shards, n_obs)`` from
         the observation model at ``params`` (padded slots zeroed).

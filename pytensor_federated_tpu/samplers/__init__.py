@@ -2,6 +2,12 @@
 
 from .advi import ADVIResult, advi_fit
 from .convergence import effective_sample_size, split_rhat, summary
+from .model_comparison import (
+    compare,
+    pointwise_loglik_matrix,
+    psis_loo,
+    waic,
+)
 from .predictive import posterior_predictive, prior_predictive
 from .ensemble import EnsembleResult, ensemble_sample
 from .laplace import LaplaceResult, laplace_approximation
@@ -53,7 +59,11 @@ __all__ = [
     "metropolis_init",
     "metropolis_step",
     "nuts_step",
+    "compare",
+    "pointwise_loglik_matrix",
     "posterior_predictive",
+    "psis_loo",
+    "waic",
     "prior_predictive",
     "sample",
 ]

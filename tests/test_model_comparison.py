@@ -1,0 +1,160 @@
+"""WAIC / PSIS-LOO: analytic golden, diagnostics, and model ranking.
+
+Golden: for iid Normal(mu, s2_known) data with a conjugate
+Normal(mu0, t2) prior, the posterior and every leave-one-out posterior
+are analytic, so the EXACT loo elpd is computable in closed form and
+the PSIS estimate (from exact posterior draws) must match it.
+"""
+
+import jax
+import numpy as np
+import scipy.stats
+
+from pytensor_federated_tpu.samplers.model_comparison import (
+    compare,
+    pointwise_loglik_matrix,
+    psis_loo,
+    waic,
+)
+
+S2 = 1.0  # known obs variance
+T2 = 4.0  # prior variance
+MU0 = 0.0
+
+
+def _posterior(y):
+    n = y.size
+    prec = 1.0 / T2 + n / S2
+    mean = (MU0 / T2 + y.sum() / S2) / prec
+    return mean, 1.0 / prec
+
+
+def _exact_loo_elpd(y):
+    # leave-one-out posterior predictive of y_i is Normal with
+    # moments from the posterior computed WITHOUT y_i.
+    total = 0.0
+    for i in range(y.size):
+        y_rest = np.delete(y, i)
+        m, v = _posterior(y_rest)
+        total += scipy.stats.norm.logpdf(y[i], m, np.sqrt(v + S2))
+    return total
+
+
+def _draws_and_ll(y, n_draws=4000, seed=0):
+    rng = np.random.default_rng(seed)
+    m, v = _posterior(y)
+    mus = rng.normal(m, np.sqrt(v), size=n_draws)
+    # (n_draws, n_points) pointwise log-likelihoods
+    ll = scipy.stats.norm.logpdf(
+        y[None, :], mus[:, None], np.sqrt(S2)
+    )
+    return ll
+
+
+def test_psis_loo_matches_exact_loo():
+    rng = np.random.default_rng(42)
+    y = rng.normal(1.2, np.sqrt(S2), size=40)
+    ll = _draws_and_ll(y)
+    res = psis_loo(ll)
+    exact = _exact_loo_elpd(y)
+    assert abs(res["elpd_loo"] - exact) < 0.3, (res["elpd_loo"], exact)
+    # well-specified conjugate model: every Pareto k comfortably small
+    assert res["n_bad_k"] == 0
+    assert np.all(res["pareto_k"] < 0.5)
+
+
+def test_waic_close_to_loo_for_regular_model():
+    rng = np.random.default_rng(3)
+    y = rng.normal(0.5, 1.0, size=60)
+    ll = _draws_and_ll(y, seed=1)
+    w = waic(ll)
+    l_ = psis_loo(ll)
+    # asymptotically equivalent; tight here because the model is iid
+    assert abs(w["elpd_waic"] - l_["elpd_loo"]) < 0.3
+    # effective parameter count ~ 1 (one scalar mean)
+    assert 0.5 < w["p_waic"] < 1.8
+    assert 0.5 < l_["p_loo"] < 1.8
+
+
+def test_compare_ranks_true_model_first():
+    rng = np.random.default_rng(7)
+    y = rng.normal(0.8, 1.0, size=50)
+    ll_good = _draws_and_ll(y, seed=2)
+    # a deliberately wrong model: fixed mu = -3 (no posterior spread)
+    mus_bad = np.full(4000, -3.0) + rng.normal(0, 0.01, size=4000)
+    ll_bad = scipy.stats.norm.logpdf(y[None, :], mus_bad[:, None], 1.0)
+    rows = compare({"true": ll_good, "wrong": ll_bad})
+    assert rows[0]["model"] == "true"
+    assert rows[1]["d_elpd"] < -5.0  # decisively worse
+    assert rows[1]["d_se"] > 0
+
+
+def test_end_to_end_on_a_family():
+    from pytensor_federated_tpu.models.countdata import (
+        FederatedNegBinGLM,
+        FederatedPoissonGLM,
+        generate_count_data,
+    )
+
+    data, _ = generate_count_data(4, n_obs=48, n_features=2, seed=5)
+    mask = data.tree()[1]
+    models = {}
+    for name, cls in (
+        ("poisson", FederatedPoissonGLM),
+        ("negbin", FederatedNegBinGLM),
+    ):
+        m = cls(data)
+        res = m.sample(
+            key=jax.random.PRNGKey(1),
+            num_warmup=150,
+            num_samples=150,
+            num_chains=2,
+        )
+        models[name] = pointwise_loglik_matrix(
+            m.pointwise_loglik, res.samples, mask=mask
+        )
+    rows = compare(models)
+    # Poisson data: Poisson must win or tie (NB nests it, so the elpd
+    # difference must be small either way — well within 3 SEs).
+    by_name = {r["model"]: r for r in rows}
+    assert abs(by_name["negbin"]["d_elpd"]) < max(
+        3.0 * by_name["negbin"]["d_se"], 3.0 * by_name["poisson"]["d_se"], 4.0
+    )
+    # point counts consistent: every kept point, no padding
+    assert models["poisson"].shape[1] == int(np.asarray(mask).sum())
+
+
+def test_gpd_fit_recovers_known_shape():
+    # The smoothing and the k>0.7 diagnostic both live or die on this
+    # fit being in the xi convention and weighted by +likelihood
+    # (round-2 review caught a transposed weight matrix producing
+    # k ~ -2.4 on data with true shape +0.4).
+    from pytensor_federated_tpu.samplers.model_comparison import _gpd_fit
+
+    rng = np.random.default_rng(0)
+    for true_xi in (0.1, 0.4, 0.7):
+        x = np.sort(
+            scipy.stats.genpareto.rvs(
+                true_xi, scale=1.0, size=4000, random_state=rng
+            )
+        )
+        xi, sigma = _gpd_fit(x)
+        assert abs(xi - true_xi) < 0.12, (true_xi, xi)
+        assert abs(sigma - 1.0) < 0.25
+
+
+def test_pareto_k_flags_heavy_tails():
+    # A point whose importance ratios are genuinely heavy-tailed must
+    # produce a large k — the diagnostic must be able to fire (the
+    # round-2 review found the sign/weight bugs made that impossible).
+    rng = np.random.default_rng(5)
+    y = rng.normal(1.0, 1.0, size=30)
+    ll = _draws_and_ll(y, n_draws=2000, seed=9)  # well-behaved points
+    # one pathological point: log-ratios with a Cauchy right tail
+    t = rng.standard_cauchy(size=2000)
+    ll[:, 0] = -np.abs(t) * 3.0
+    res = psis_loo(ll)
+    assert res["pareto_k"][0] > 0.7
+    assert res["n_bad_k"] >= 1
+    # conjugate-model points stay comfortably reliable
+    assert np.median(res["pareto_k"][1:]) < 0.5
