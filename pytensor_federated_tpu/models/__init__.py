@@ -41,6 +41,11 @@ from .statespace import (
     panel_em,
     sample_latents,
 )
+from .survival import (
+    FederatedWeibullAFT,
+    generate_survival_data,
+    weibull_censored_loglik,
+)
 from .timeseries import SeqShardedAR1, generate_ar1_data
 
 __all__ = [
@@ -49,10 +54,13 @@ __all__ = [
     "FederatedPoissonGLM",
     "FederatedRobustRegression",
     "FederatedSparseGP",
+    "FederatedWeibullAFT",
     "gamma_logpdf",
     "generate_count_data",
     "generate_gamma_data",
     "generate_robust_data",
+    "generate_survival_data",
+    "weibull_censored_loglik",
     "student_t_logpdf",
     "SeqShardedAR1",
     "FederatedLGSSMPanel",

@@ -1,0 +1,139 @@
+"""Federated survival analysis: censored Weibull regression (AFT).
+
+Time-to-event data split across institutions that cannot pool patient
+records is the canonical real-world federated-inference setting; this
+family gives it first-class support.  Accelerated-failure-time (AFT)
+Weibull model with right censoring:
+
+    T_ij ~ Weibull(shape=k, scale=exp(eta_ij))
+    eta_ij = x_ij . w + b0 + tau * b_raw_i       (per-shard frailty)
+    observed: (t_ij, delta_ij),  delta = 1 event, 0 right-censored
+
+With ``z = k (log t - eta)`` the per-observation log-likelihood is
+
+    event    (delta=1):  log k - log t + z - e^z
+    censored (delta=0):  -e^z                      (log survival)
+
+(the event term expands to the Weibull logpdf
+``log k - eta + (k-1)(log t - eta) - (t/e^eta)^k``).
+
+Built on the shared hierarchical base (models/hierbase.py) with the
+observation pytree ``y = (t, delta)``: the per-shard frailty term is
+the non-centered shared-frailty analog, and ``compute_dtype`` /
+``pointwise_loglik`` / ``predictive`` come from the base like every
+sibling family.
+
+TPU notes: identical hot shape (batched ``X @ w`` via the shared
+``linear_predictor``); the density needs ``log``/``exp`` only, and
+censoring is a multiply by ``delta`` — no branches, so the whole
+posterior jits clean under vmap/shard_map.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from ..parallel.packing import ShardedData, pack_shards
+from .hierbase import HierarchicalGLMBase
+from .linear import _normal_logpdf
+
+__all__ = [
+    "FederatedWeibullAFT",
+    "generate_survival_data",
+    "weibull_censored_loglik",
+]
+
+
+def generate_survival_data(
+    n_shards: int = 8,
+    *,
+    n_obs: int = 64,
+    n_features: int = 3,
+    tau: float = 0.3,
+    shape_k: float = 1.5,
+    censor_frac: float = 0.3,
+    seed: int = 37,
+):
+    """Per-shard ``(X, (t, delta))`` with administrative right
+    censoring tuned to hit ``censor_frac`` on average."""
+    rng = np.random.default_rng(seed)
+    w_true = rng.normal(0.0, 0.4, size=n_features)
+    b0_true = 0.5
+    b_true = b0_true + tau * rng.normal(size=n_shards)
+    shards = []
+    for i in range(n_shards):
+        X = rng.normal(0.0, 1.0, size=(n_obs, n_features)).astype(np.float32)
+        scale = np.exp(b_true[i] + X @ w_true)
+        t_event = scale * rng.weibull(shape_k, size=n_obs)
+        # censor times drawn so ~censor_frac of events are cut off
+        c = np.quantile(t_event, 1.0 - censor_frac) * rng.uniform(
+            0.5, 1.5, size=n_obs
+        )
+        delta = (t_event <= c).astype(np.float32)
+        t = np.minimum(t_event, c).astype(np.float32)
+        # padded-slot safety: keep times strictly positive
+        t = np.maximum(t, 1e-6)
+        shards.append((X, (t, delta)))
+    truth = {"w": w_true, "b0": b0_true, "b": b_true, "k": shape_k}
+    return pack_shards(shards, pad_to_multiple=8), truth
+
+
+def weibull_censored_loglik(t, delta, eta, k):
+    """Censored Weibull AFT log-likelihood per observation.
+
+    ``z = k * (log t - eta)`` so the density term is
+    ``log k - log t + z - exp(z)`` and the survival term is ``-exp(z)``
+    — one shared ``exp(z)`` (clamped like the siblings so extreme
+    proposals stay finite with finite gradients), censoring as a
+    multiply, no branches.
+    """
+    log_t = jnp.log(jnp.maximum(t, jnp.finfo(jnp.result_type(t)).tiny))
+    z = k * (log_t - eta)
+    ez = jnp.exp(jnp.minimum(z, 80.0))
+    event_term = jnp.log(k) - log_t + z - ez
+    censor_term = -ez
+    return delta * event_term + (1.0 - delta) * censor_term
+
+
+@dataclasses.dataclass
+class FederatedWeibullAFT(HierarchicalGLMBase):
+    """Hierarchical Weibull AFT over federated shards."""
+
+    data: ShardedData
+    mesh: Optional[Mesh] = None
+    prior_scale: float = 5.0
+    compute_dtype: Optional[Any] = None  # see HierarchicalGLMBase
+    _init_log_tau = -1.0
+
+    def __post_init__(self):
+        self._post_init()
+
+    def _obs_logpmf(self, params, y, eta):
+        t, delta = y
+        k = jnp.exp(params["log_k"])
+        return weibull_censored_loglik(t, delta, eta, k)
+
+    def _sample_obs(self, params, key, eta):
+        # UNCENSORED event times by inverse cdf: T = scale*(-log u)^(1/k)
+        k = jnp.exp(params["log_k"])
+        u = jax.random.uniform(
+            key, eta.shape, minval=1e-7, maxval=1.0 - 1e-7
+        )
+        return jnp.exp(eta) * jnp.power(-jnp.log(u), 1.0 / k)
+
+    def prior_logp(self, params: Any) -> jax.Array:
+        lp = super().prior_logp(params)
+        # LogNormal(0, 1)-ish prior on the Weibull shape via log_k.
+        lp += _normal_logpdf(params["log_k"], 0.0, 1.0)
+        return lp
+
+    def init_params(self) -> Any:
+        p = super().init_params()
+        p["log_k"] = jnp.zeros(())
+        return p

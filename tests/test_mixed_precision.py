@@ -26,6 +26,10 @@ from pytensor_federated_tpu.models.robust import (
     FederatedRobustRegression,
     generate_robust_data,
 )
+from pytensor_federated_tpu.models.survival import (
+    FederatedWeibullAFT,
+    generate_survival_data,
+)
 
 
 def _perturbed(params, seed=3, scale=0.3):
@@ -57,6 +61,10 @@ CASES = [
     (
         FederatedRobustRegression,
         lambda: generate_robust_data(8, n_obs=64, n_features=8),
+    ),
+    (
+        FederatedWeibullAFT,
+        lambda: generate_survival_data(8, n_obs=64, n_features=8),
     ),
 ]
 
