@@ -2,6 +2,7 @@
 
 from .advi import ADVIResult, advi_fit
 from .convergence import effective_sample_size, split_rhat, summary
+from .arviz_export import to_dataset_dict, to_inference_data
 from .model_comparison import (
     compare,
     pointwise_loglik_matrix,
@@ -60,6 +61,8 @@ __all__ = [
     "metropolis_step",
     "nuts_step",
     "compare",
+    "to_dataset_dict",
+    "to_inference_data",
     "pointwise_loglik_matrix",
     "posterior_predictive",
     "psis_loo",

@@ -1,0 +1,111 @@
+"""Export draws to arviz's InferenceData (or its plain-dict shape).
+
+The reference's demo workflow ends in arviz (``pm.sample`` returns an
+InferenceData; reference demo_model.py prints an az summary).  This
+module gives the native samplers the same exit ramp:
+
+- :func:`to_dataset_dict` — always available: the draws, sample stats,
+  and (optionally) pointwise log-likelihoods as plain
+  ``{group: {var: ndarray(chains, draws, ...)}}`` dicts in arviz's
+  exact layout.
+- :func:`to_inference_data` — the same content as a real
+  ``az.InferenceData`` when arviz is installed (import-gated like the
+  PyTensor bridge; the package does not depend on arviz).
+
+Variable naming matches PyMC conventions (``log_likelihood`` group,
+``sample_stats`` with ``diverging``/``energy``/``tree_depth``) so
+``az.loo``, ``az.summary``, ``az.plot_trace`` work unmodified.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+__all__ = ["to_dataset_dict", "to_inference_data"]
+
+_STAT_RENAMES = {
+    "accept_prob": "acceptance_rate",
+    "diverging": "diverging",
+    "depth": "tree_depth",
+    "energy": "energy",
+}
+
+
+def to_dataset_dict(
+    result: Any,
+    *,
+    pointwise_fn: Optional[Any] = None,
+    mask: Optional[Any] = None,
+) -> Dict[str, Dict[str, np.ndarray]]:
+    """arviz-layout dict-of-groups from a ``SampleResult``.
+
+    ``pointwise_fn(params)`` (e.g. ``model.pointwise_loglik``) adds a
+    ``log_likelihood`` group evaluated over every kept draw in one
+    vmapped executable; ``mask`` drops padded observation slots.
+    """
+    posterior = {
+        k: np.asarray(v) for k, v in _as_mapping(result.samples).items()
+    }
+    groups: Dict[str, Dict[str, np.ndarray]] = {"posterior": posterior}
+    stats = getattr(result, "stats", None)
+    if stats:
+        groups["sample_stats"] = {
+            _STAT_RENAMES.get(k, k): np.asarray(v) for k, v in stats.items()
+        }
+    if pointwise_fn is not None:
+        from .model_comparison import pointwise_loglik_matrix
+
+        leaves = jax.tree_util.tree_leaves(result.samples)
+        c, d = leaves[0].shape[:2]
+        ll = pointwise_loglik_matrix(pointwise_fn, result.samples, mask=mask)
+        groups["log_likelihood"] = {"obs": ll.reshape((c, d, -1))}
+    return groups
+
+
+def to_inference_data(
+    result: Any,
+    *,
+    pointwise_fn: Optional[Any] = None,
+    mask: Optional[Any] = None,
+):
+    """``az.InferenceData`` built from :func:`to_dataset_dict`.
+
+    Raises ImportError when arviz is not installed (install the
+    ``arviz`` extra); use :func:`to_dataset_dict` for the dependency-
+    free layout.
+    """
+    try:
+        import arviz as az
+    except ModuleNotFoundError as e:  # pragma: no cover - env-dependent
+        raise ImportError(
+            "to_inference_data requires arviz (pip install "
+            "pytensor-federated-tpu[arviz]); to_dataset_dict gives the "
+            "same content as plain dicts"
+        ) from e
+
+    groups = to_dataset_dict(result, pointwise_fn=pointwise_fn, mask=mask)
+    kwargs = {"posterior": groups["posterior"]}
+    if "sample_stats" in groups:
+        kwargs["sample_stats"] = groups["sample_stats"]
+    if "log_likelihood" in groups:
+        kwargs["log_likelihood"] = groups["log_likelihood"]
+    return az.from_dict(**kwargs)
+
+
+def _as_mapping(samples: Any) -> Dict[str, Any]:
+    """Param pytree -> flat name->array mapping (dicts pass through;
+    other pytrees get positional names)."""
+    if isinstance(samples, dict):
+        out = {}
+        for k, v in samples.items():
+            if isinstance(v, dict):
+                for k2, v2 in _as_mapping(v).items():
+                    out[f"{k}.{k2}"] = v2
+            else:
+                out[k] = v
+        return out
+    leaves = jax.tree_util.tree_leaves(samples)
+    return {f"param_{i}": leaf for i, leaf in enumerate(leaves)}

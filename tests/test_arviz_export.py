@@ -1,0 +1,73 @@
+"""arviz-layout export: dict path always, real InferenceData if arviz."""
+
+import jax
+import numpy as np
+import pytest
+
+from pytensor_federated_tpu.models.logistic import (
+    FederatedLogisticRegression,
+    generate_logistic_data,
+)
+from pytensor_federated_tpu.samplers import to_dataset_dict
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    data, _ = generate_logistic_data(n_shards=4, n_obs=32, n_features=3)
+    m = FederatedLogisticRegression(data)
+    res = m.sample(
+        key=jax.random.PRNGKey(0),
+        num_warmup=100,
+        num_samples=80,
+        num_chains=2,
+    )
+    return m, res, data
+
+
+def test_dataset_dict_layout(fitted):
+    m, res, data = fitted
+    groups = to_dataset_dict(res)
+    post = groups["posterior"]
+    assert set(post) == {"w", "b"}
+    assert post["w"].shape == (2, 80, 3)
+    stats = groups["sample_stats"]
+    assert "diverging" in stats and "energy" in stats
+    assert "tree_depth" in stats  # renamed from 'depth'
+    assert stats["diverging"].shape == (2, 80)
+
+
+def test_log_likelihood_group(fitted):
+    m, res, data = fitted
+    mask = data.tree()[1]
+
+    def pointwise(params):
+        (X, y), mk = data.tree()
+        import jax.numpy as jnp
+
+        logits = jnp.einsum("snd,d->sn", X, params["w"]) + params["b"]
+        return (y * logits - jnp.logaddexp(0.0, logits)) * mk
+
+    groups = to_dataset_dict(res, pointwise_fn=pointwise, mask=mask)
+    ll = groups["log_likelihood"]["obs"]
+    n_real = int(np.asarray(mask).sum())
+    assert ll.shape == (2, 80, n_real)
+    assert np.all(np.isfinite(ll))
+    # log-likelihoods of Bernoulli outcomes are <= 0
+    assert np.all(ll <= 0.0)
+
+
+def test_nested_param_trees_flatten():
+    from pytensor_federated_tpu.samplers.arviz_export import _as_mapping
+
+    m = _as_mapping({"a": 1, "nest": {"b": 2, "c": 3}})
+    assert set(m) == {"a", "nest.b", "nest.c"}
+
+
+def test_inference_data_when_arviz_present(fitted):
+    az = pytest.importorskip("arviz")
+    from pytensor_federated_tpu.samplers import to_inference_data
+
+    m, res, data = fitted
+    idata = to_inference_data(res)
+    assert hasattr(idata, "posterior")
+    assert float(az.summary(idata)["r_hat"].max()) < 1.2
