@@ -21,6 +21,11 @@ from .ode import (
     make_lv_model,
     rk4_integrate,
 )
+from .ordinal import (
+    FederatedOrdinalRegression,
+    cumulative_logit_loglik,
+    generate_ordinal_data,
+)
 from .robust import (
     FederatedRobustRegression,
     generate_robust_data,
@@ -51,13 +56,16 @@ from .timeseries import SeqShardedAR1, generate_ar1_data
 __all__ = [
     "FederatedGammaGLM",
     "FederatedNegBinGLM",
+    "FederatedOrdinalRegression",
     "FederatedPoissonGLM",
     "FederatedRobustRegression",
     "FederatedSparseGP",
     "FederatedWeibullAFT",
+    "cumulative_logit_loglik",
     "gamma_logpdf",
     "generate_count_data",
     "generate_gamma_data",
+    "generate_ordinal_data",
     "generate_robust_data",
     "generate_survival_data",
     "weibull_censored_loglik",

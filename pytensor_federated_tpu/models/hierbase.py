@@ -64,6 +64,14 @@ class HierarchicalGLMBase:
     #: initial value for log_tau (families tune their own warm start)
     _init_log_tau: float = 0.0
 
+    #: families whose global intercept is absorbed elsewhere (ordinal:
+    #: the cutpoints) set this False — ``b0`` then vanishes from the
+    #: param tree, the prior, and the implied intercepts.
+    _has_global_intercept: bool = True
+
+    def _intercept_base(self, params):
+        return params["b0"] if self._has_global_intercept else 0.0
+
     #: optional matmul compute dtype (e.g. ``jnp.bfloat16``): the
     #: X @ w contraction — where the FLOPs are — runs in this dtype
     #: with float32 accumulation (``preferred_element_type``), the
@@ -85,7 +93,9 @@ class HierarchicalGLMBase:
         def per_shard_logp(params, shard):
             (X, y), mask, sid = shard
             tau = jnp.exp(params["log_tau"])
-            b = params["b0"] + tau * jnp.take(params["b_raw"], sid)
+            b = self._intercept_base(params) + tau * jnp.take(
+                params["b_raw"], sid
+            )
             eta = self._linear_predictor(X, params["w"], b)
             ll = self._obs_logpmf(params, y, eta)
             return jnp.sum(ll * mask)
@@ -104,7 +114,8 @@ class HierarchicalGLMBase:
     def prior_logp(self, params: Any) -> jax.Array:
         s = self.prior_scale
         lp = jnp.sum(_normal_logpdf(params["w"], 0.0, s))
-        lp += _normal_logpdf(params["b0"], 0.0, s)
+        if self._has_global_intercept:
+            lp += _normal_logpdf(params["b0"], 0.0, s)
         lp += jnp.sum(_normal_logpdf(params["b_raw"], 0.0, 1.0))
         # HalfNormal(1) on tau via the log-transform + Jacobian.
         tau = jnp.exp(params["log_tau"])
@@ -113,7 +124,10 @@ class HierarchicalGLMBase:
 
     def intercepts(self, params: Any) -> jax.Array:
         """The implied per-shard intercepts ``b0 + tau * b_raw``."""
-        return params["b0"] + jnp.exp(params["log_tau"]) * params["b_raw"]
+        return (
+            self._intercept_base(params)
+            + jnp.exp(params["log_tau"]) * params["b_raw"]
+        )
 
     def logp(self, params: Any) -> jax.Array:
         return self.prior_logp(params) + self.fed.logp(params)
@@ -122,12 +136,14 @@ class HierarchicalGLMBase:
         return jax.value_and_grad(self.logp)(params)
 
     def init_params(self) -> Any:
-        return {
+        p = {
             "w": jnp.zeros((self.n_features,)),
-            "b0": jnp.zeros(()),
             "log_tau": jnp.array(self._init_log_tau),
             "b_raw": jnp.zeros((self.n_shards,)),
         }
+        if self._has_global_intercept:
+            p["b0"] = jnp.zeros(())
+        return p
 
     def _sample_obs(self, params, key, eta):  # pragma: no cover - abstract
         raise NotImplementedError

@@ -1,0 +1,126 @@
+"""Ordinal (cumulative-logit) regression: golden, ordering, inference."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import scipy.stats
+
+from pytensor_federated_tpu.models.ordinal import (
+    FederatedOrdinalRegression,
+    cumulative_logit_loglik,
+    generate_ordinal_data,
+)
+
+
+def _probs(eta, kappa):
+    cdf = scipy.stats.logistic.cdf(np.concatenate([kappa, [np.inf]]) - eta)
+    cdf = np.concatenate([[0.0], cdf])
+    return np.diff(cdf)
+
+
+def test_loglik_matches_direct_probability():
+    rng = np.random.default_rng(0)
+    kappa = np.array([-1.0, 0.2, 1.3], dtype=np.float32)
+    for _ in range(5):
+        eta = float(rng.normal(0, 1.5))
+        p = _probs(eta, kappa)
+        for c in range(4):
+            ours = float(
+                cumulative_logit_loglik(
+                    jnp.asarray([float(c)]),
+                    jnp.asarray([eta]),
+                    jnp.asarray(kappa),
+                )[0]
+            )
+            np.testing.assert_allclose(ours, np.log(p[c]), rtol=2e-4)
+
+
+def test_probabilities_normalize():
+    kappa = jnp.asarray([-0.5, 0.7])
+    eta = jnp.linspace(-3, 3, 7)
+    ll = jnp.stack(
+        [
+            cumulative_logit_loglik(jnp.full(7, float(c)), eta, kappa)
+            for c in range(3)
+        ]
+    )
+    np.testing.assert_allclose(
+        np.exp(np.asarray(ll)).sum(axis=0), 1.0, rtol=1e-5
+    )
+
+
+def test_cutpoints_always_ordered():
+    data, _ = generate_ordinal_data(4, n_obs=32, n_categories=5)
+    m = FederatedOrdinalRegression(data, n_categories=5)
+    rng = np.random.default_rng(3)
+    for _ in range(5):
+        p = m.init_params()
+        p = jax.tree_util.tree_map(
+            lambda a: a + rng.normal(0, 2.0, np.shape(a)), p
+        )
+        kappa = np.asarray(m._kappa(p))
+        assert np.all(np.diff(kappa) > 0)
+
+
+def test_map_recovers_truth():
+    data, truth = generate_ordinal_data(
+        8, n_obs=128, n_features=3, n_categories=4, seed=5
+    )
+    m = FederatedOrdinalRegression(data, n_categories=4)
+    est = m.find_map()
+    np.testing.assert_allclose(np.asarray(est["w"]), truth["w"], atol=0.25)
+    kappa_est = np.asarray(m._kappa(est))
+    np.testing.assert_allclose(kappa_est, truth["kappa"], atol=0.35)
+
+
+def test_nuts_converges():
+    data, truth = generate_ordinal_data(
+        4, n_obs=96, n_features=2, n_categories=3, seed=7
+    )
+    m = FederatedOrdinalRegression(data, n_categories=3)
+    res = m.sample(
+        key=jax.random.PRNGKey(2),
+        num_warmup=300,
+        num_samples=300,
+        num_chains=2,
+    )
+    summ = res.summary()
+    assert float(np.max(np.asarray(summ["rhat"]["w"]))) < 1.1
+    w_mean = np.asarray(res.samples["w"]).mean(axis=(0, 1))
+    np.testing.assert_allclose(w_mean, truth["w"], atol=0.25)
+
+
+def test_predictive_and_pointwise_contracts():
+    data, _ = generate_ordinal_data(4, n_obs=48, n_categories=4, seed=9)
+    m = FederatedOrdinalRegression(data, n_categories=4)
+    p0 = m.init_params()
+    (X, y), mask = data.tree()
+    sim = m.predictive(p0, jax.random.PRNGKey(0))
+    assert sim.shape == y.shape
+    s = np.asarray(sim)
+    assert np.all((s >= 0) & (s <= 3))
+    assert np.all(s[np.asarray(mask) == 0] == 0.0)
+    ll = m.pointwise_loglik(p0)
+    assert np.all(np.asarray(ll)[np.asarray(mask) == 1] < 0.0)
+    assert np.all(np.asarray(ll)[np.asarray(mask) == 0] == 0.0)
+
+
+def test_on_mesh(devices8):
+    from pytensor_federated_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh({"shards": 8}, devices=devices8)
+    data, _ = generate_ordinal_data(8, n_obs=32, n_categories=3, seed=11)
+    m_mesh = FederatedOrdinalRegression(data, n_categories=3, mesh=mesh)
+    m_local = FederatedOrdinalRegression(data, n_categories=3)
+    p0 = m_local.init_params()
+    np.testing.assert_allclose(
+        float(m_mesh.logp(p0)), float(m_local.logp(p0)), rtol=5e-4
+    )
+
+
+def test_out_of_range_category_fails_loudly():
+    data, _ = generate_ordinal_data(4, n_obs=32, n_categories=5, seed=13)
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="n_categories"):
+        FederatedOrdinalRegression(data, n_categories=4)
