@@ -7,7 +7,12 @@ from .countdata import (
 )
 from .gamma import FederatedGammaGLM, gamma_logpdf, generate_gamma_data
 from .glm import HierarchicalRadonGLM, generate_radon_data
-from .gp import FederatedSparseGP, dense_vfe_logp, generate_gp_data
+from .gp import (
+    FederatedExactGP,
+    FederatedSparseGP,
+    dense_vfe_logp,
+    generate_gp_data,
+)
 from .linear import FederatedLinearRegression, generate_node_data
 from .logistic import (
     FederatedLogisticRegression,
@@ -55,6 +60,7 @@ from .timeseries import SeqShardedAR1, generate_ar1_data
 
 __all__ = [
     "FederatedGammaGLM",
+    "FederatedExactGP",
     "FederatedNegBinGLM",
     "FederatedOrdinalRegression",
     "FederatedPoissonGLM",

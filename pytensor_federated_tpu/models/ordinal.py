@@ -120,14 +120,19 @@ class FederatedOrdinalRegression(HierarchicalGLMBase):
 
     def __post_init__(self):
         (_X, y), mask = self.data.tree()
-        y_max = int(np.asarray(y)[np.asarray(mask) > 0].max())
-        if y_max >= self.n_categories:
-            # jnp.take would silently CLAMP out-of-range categories to
-            # the top cutpoint, fitting a confidently wrong model.
+        y_real = np.asarray(y)[np.asarray(mask) > 0]
+        # jnp.take silently CLAMPS out-of-range indices, fitting a
+        # confidently wrong model — validate the whole coding up front.
+        if y_real.size and (
+            y_real.max() >= self.n_categories or y_real.min() < 0
+        ):
             raise ValueError(
-                f"observed category {y_max} >= n_categories="
-                f"{self.n_categories}"
+                f"observed categories span [{y_real.min():.0f}, "
+                f"{y_real.max():.0f}]; need 0..n_categories-1 with "
+                f"n_categories={self.n_categories}"
             )
+        if y_real.size and np.any(y_real != np.round(y_real)):
+            raise ValueError("ordinal outcomes must be integer-coded")
         self._post_init()
 
     def _obs_logpmf(self, params, y, eta):

@@ -130,3 +130,95 @@ class TestInference:
         )
         assert res.samples["log_noise"].shape == (2, 100)
         assert float(jnp.mean(res.stats["accept_prob"])) > 0.5
+
+
+class TestExactGP:
+    """FederatedExactGP: padding exactness, golden, hyperparam MAP."""
+
+    def _data(self, n_shards=4, n_obs=(24, 32, 17, 40), seed=2):
+        from pytensor_federated_tpu.models.gp import generate_gp_data
+
+        rng = np.random.default_rng(seed)
+        shards = []
+        for n in n_obs[:n_shards]:
+            x = np.sort(rng.uniform(-3, 3, size=n)).astype(np.float32)
+            f = np.sin(1.3 * x) * 1.5
+            y = (f + 0.1 * rng.normal(size=n)).astype(np.float32)
+            shards.append((x, y))
+        from pytensor_federated_tpu.parallel.packing import pack_shards
+
+        return pack_shards(shards, pad_to_multiple=8), shards
+
+    def test_masked_logp_equals_unpadded_dense(self):
+        from pytensor_federated_tpu.models.gp import (
+            FederatedExactGP,
+            _sqexp,
+            _unpack,
+            _JITTER,
+        )
+        from pytensor_federated_tpu.utils import LOG_2PI
+
+        packed, shards = self._data()
+        m = FederatedExactGP(packed)
+        params = {
+            "log_variance": jnp.asarray(0.3),
+            "log_lengthscale": jnp.asarray(-0.2),
+            "log_noise": jnp.asarray(-1.5),
+        }
+        variance, lengthscale, noise = _unpack(params)
+        dense = 0.0
+        for x, y in shards:
+            n = x.shape[0]
+            k = np.asarray(
+                _sqexp(jnp.asarray(x), jnp.asarray(x), variance, lengthscale)
+            ) + (float(noise) ** 2 + _JITTER * float(variance)) * np.eye(n)
+            sign, logdet = np.linalg.slogdet(k)
+            alpha = np.linalg.solve(k, y)
+            dense += -0.5 * (y @ alpha + logdet + n * LOG_2PI)
+        # compare the data part: logp minus the hyperparameter prior
+        from pytensor_federated_tpu.models.gp import FederatedSparseGP
+
+        data_ll = float(m.logp(params)) - float(
+            FederatedSparseGP._prior_logp(params)
+        )
+        np.testing.assert_allclose(data_ll, dense, rtol=5e-4)
+
+    def test_map_recovers_lengthscale_order(self):
+        from pytensor_federated_tpu.models.gp import FederatedExactGP
+
+        packed, _ = self._data()
+        m = FederatedExactGP(packed)
+        est = m.find_map()
+        ls = float(jnp.exp(est["log_lengthscale"]))
+        noise = float(jnp.exp(est["log_noise"]))
+        assert 0.2 < ls < 3.0  # sin(1.3x) wiggles on O(1) scale
+        assert noise < 0.4
+
+    def test_posterior_interpolates(self):
+        from pytensor_federated_tpu.models.gp import FederatedExactGP
+
+        packed, shards = self._data()
+        m = FederatedExactGP(packed)
+        est = m.find_map()
+        xs = jnp.linspace(-2.5, 2.5, 21)
+        mean, var = m.posterior(est, xs)
+        assert mean.shape == (4, 21) and var.shape == (4, 21)
+        # posterior mean tracks the true function on observed support
+        truth = np.sin(1.3 * np.asarray(xs)) * 1.5
+        err = np.abs(np.asarray(mean) - truth[None, :]).mean()
+        assert err < 0.25
+        assert np.all(np.asarray(var) > -1e-4)
+
+    def test_on_mesh(self, devices8):
+        from pytensor_federated_tpu.models.gp import FederatedExactGP
+        from pytensor_federated_tpu.parallel.mesh import make_mesh
+
+        packed, _ = self._data(n_shards=4)
+        # 4 shards over a 4-device submesh
+        mesh = make_mesh({"shards": 4}, devices=devices8[:4])
+        m_mesh = FederatedExactGP(packed, mesh=mesh)
+        m_local = FederatedExactGP(packed)
+        p0 = m_local.init_params()
+        np.testing.assert_allclose(
+            float(m_mesh.logp(p0)), float(m_local.logp(p0)), rtol=5e-4
+        )

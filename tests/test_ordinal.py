@@ -124,3 +124,20 @@ def test_out_of_range_category_fails_loudly():
 
     with _pytest.raises(ValueError, match="n_categories"):
         FederatedOrdinalRegression(data, n_categories=4)
+
+
+def test_negative_or_fractional_categories_fail_loudly():
+    import pytest as _pytest
+    from pytensor_federated_tpu.parallel.packing import ShardedData
+
+    data, _ = generate_ordinal_data(4, n_obs=32, n_categories=4, seed=13)
+    (X, y), mask = data.tree()
+    with _pytest.raises(ValueError, match="0..n_categories-1"):
+        FederatedOrdinalRegression(
+            ShardedData(data=(X, y - 1.0), mask=mask), n_categories=4
+        )
+    with _pytest.raises(ValueError, match="integer-coded"):
+        FederatedOrdinalRegression(
+            ShardedData(data=(X, y + 0.5 * np.asarray(mask)), mask=mask),
+            n_categories=5,
+        )
