@@ -127,18 +127,49 @@ class FederatedLogisticRegression:
     #: see HierarchicalGLMBase.compute_dtype — bf16 matmul w/ f32
     #: accumulation when set; the MXU mixed-precision recipe.
     compute_dtype: Optional[Any] = None
+    #: partial sufficient statistics: the Bernoulli loglik's
+    #: y-interaction term is LINEAR in (w, b), so its coefficients
+    #: ``(Σ y x, Σ y)`` fold into per-shard constants at build time and
+    #: the hot loop evaluates only the softplus normalizer —
+    #: ``Σ y·logits - Σ softplus(logits) = Syx·w + Sy·b - Σ sp`` —
+    #: the logistic analog of the linear model's ``use_suffstats``
+    #: (full compression is impossible: softplus still needs raw X).
+    #: Exact same posterior; equality-tested.
+    use_suffstats: bool = False
 
     def __post_init__(self):
-        def per_shard_logp(params, shard):
-            (X, y), mask = shard
-            logits = linear_predictor(
-                X, params["w"], params["b"], self.compute_dtype
-            )
-            # Numerically stable Bernoulli log-likelihood.
-            ll = y * logits - jnp.logaddexp(0.0, logits)
-            return jnp.sum(ll * mask)
+        if self.use_suffstats:
+            (X, y), mask = self.data.tree()
+            ym = y * mask
+            syx = jnp.einsum("snd,sn->sd", X, ym)  # (S, D), build-time
+            sy = jnp.sum(ym, axis=1)  # (S,)
+            tree = ((X, syx, sy), mask)
 
-        self.fed = FederatedLogp(per_shard_logp, self.data.tree(), mesh=self.mesh)
+            def per_shard_logp(params, shard):
+                (X, syx, sy), mask = shard
+                logits = linear_predictor(
+                    X, params["w"], params["b"], self.compute_dtype
+                )
+                sp = jnp.sum(
+                    jnp.logaddexp(0.0, logits) * mask
+                )
+                return syx @ params["w"] + sy * params["b"] - sp
+
+            self.fed = FederatedLogp(per_shard_logp, tree, mesh=self.mesh)
+        else:
+
+            def per_shard_logp(params, shard):
+                (X, y), mask = shard
+                logits = linear_predictor(
+                    X, params["w"], params["b"], self.compute_dtype
+                )
+                # Numerically stable Bernoulli log-likelihood.
+                ll = y * logits - jnp.logaddexp(0.0, logits)
+                return jnp.sum(ll * mask)
+
+            self.fed = FederatedLogp(
+                per_shard_logp, self.data.tree(), mesh=self.mesh
+            )
         self.n_features = jax.tree_util.tree_leaves(self.data.data)[0].shape[-1]
 
     def prior_logp(self, params: Any) -> jax.Array:

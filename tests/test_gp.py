@@ -136,8 +136,8 @@ class TestExactGP:
     """FederatedExactGP: padding exactness, golden, hyperparam MAP."""
 
     def _data(self, n_shards=4, n_obs=(24, 32, 17, 40), seed=2):
-        from pytensor_federated_tpu.models.gp import generate_gp_data
-
+        # hand-built (not generate_gp_data): unequal per-shard sizes
+        # exercise the padding-exactness correction
         rng = np.random.default_rng(seed)
         shards = []
         for n in n_obs[:n_shards]:

@@ -161,3 +161,49 @@ def test_lv_map_recovers_theta():
     est = model.find_map(num_steps=3000, learning_rate=0.02)
     theta_est = np.exp(np.asarray(est["log_theta"]))
     np.testing.assert_allclose(theta_est, meta["theta"], rtol=0.2)
+
+
+class TestLogisticSuffstats:
+    """use_suffstats folds the y-linear term into build-time constants;
+    the posterior must be EXACTLY the same (logp and grads), on and off
+    a mesh."""
+
+    def test_equality_single_device(self):
+        from pytensor_federated_tpu.models.logistic import (
+            FederatedLogisticRegression,
+            generate_logistic_data,
+        )
+
+        data, _ = generate_logistic_data(n_shards=8, n_obs=48, n_features=5)
+        base = FederatedLogisticRegression(data)
+        fast = FederatedLogisticRegression(data, use_suffstats=True)
+        for shift in (0.0, 0.3):
+            p = jax.tree_util.tree_map(
+                lambda a: a + shift, base.init_params()
+            )
+            np.testing.assert_allclose(
+                float(base.logp(p)), float(fast.logp(p)), rtol=2e-4
+            )
+            _, g1 = base.logp_and_grad(p)
+            _, g2 = fast.logp_and_grad(p)
+            for k in g1:
+                np.testing.assert_allclose(
+                    np.asarray(g1[k]), np.asarray(g2[k]),
+                    rtol=2e-3, atol=1e-3,
+                )
+
+    def test_equality_on_mesh(self, devices8):
+        from pytensor_federated_tpu.models.logistic import (
+            FederatedLogisticRegression,
+            generate_logistic_data,
+        )
+        from pytensor_federated_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh({"shards": 8}, devices=devices8)
+        data, _ = generate_logistic_data(n_shards=8, n_obs=32, n_features=4)
+        base = FederatedLogisticRegression(data)
+        fast = FederatedLogisticRegression(data, mesh=mesh, use_suffstats=True)
+        p = base.init_params()
+        np.testing.assert_allclose(
+            float(base.logp(p)), float(fast.logp(p)), rtol=5e-4
+        )
