@@ -20,6 +20,11 @@ from .logistic import (
     generate_hier_logistic_data,
     generate_logistic_data,
 )
+from .mixture import (
+    FederatedGaussianMixture,
+    generate_mixture_data,
+    mixture_loglik,
+)
 from .ode import (
     LotkaVolterraModel,
     generate_lv_data,
@@ -60,6 +65,7 @@ from .timeseries import SeqShardedAR1, generate_ar1_data
 
 __all__ = [
     "FederatedGammaGLM",
+    "FederatedGaussianMixture",
     "FederatedExactGP",
     "FederatedNegBinGLM",
     "FederatedOrdinalRegression",
@@ -71,6 +77,8 @@ __all__ = [
     "gamma_logpdf",
     "generate_count_data",
     "generate_gamma_data",
+    "generate_mixture_data",
+    "mixture_loglik",
     "generate_ordinal_data",
     "generate_robust_data",
     "generate_survival_data",

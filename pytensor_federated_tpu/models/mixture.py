@@ -1,0 +1,187 @@
+"""Federated Gaussian mixtures: shared components, per-site weights.
+
+Density estimation across sites whose populations mix the SAME latent
+subgroups in DIFFERENT proportions — the canonical cross-site
+heterogeneity structure (e.g. patient subtypes with site-specific
+case mixes):
+
+    y_ij ~ Σ_k  π_ik  N(mu_k, sigma_k)      (k = 1..K components)
+    π_i  = softmax(logits_i)                 per shard i
+    mu, sigma shared across shards
+
+Component labels are marginalized (one ``logsumexp`` per observation —
+no discrete latents, so NUTS applies directly), and the component
+means are ORDERED by construction (``mu_0`` + positive increments,
+the models/ordinal.py cutpoint device) which removes label-switching:
+every point of the unconstrained state space is one identifiable
+mixture.
+
+Priors: ``mu_0 ~ N(0, prior_scale)``, increments LogNormal(0,1) (their
+log-Jacobian joins the prior), ``log_sigma_k ~ N(0,1)`` (LogNormal
+scales), per-shard weight logits ``~ N(0,1)`` (a proper prior directly
+on the unconstrained parameterization, so no transform Jacobian is
+involved).
+
+TPU notes: the per-observation work is a ``(n, K)`` broadcast +
+``logsumexp`` — pure VPU elementwise/reduction, batched over shards
+under vmap/shard_map; no data-dependent control flow.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from ..parallel.packing import ShardedData, pack_shards
+from ..parallel.sharded import FederatedLogp
+from .linear import _normal_logpdf
+
+__all__ = [
+    "FederatedGaussianMixture",
+    "generate_mixture_data",
+    "mixture_loglik",
+]
+
+
+def generate_mixture_data(
+    n_shards: int = 8,
+    *,
+    n_obs: int = 128,
+    mus=(-2.0, 0.5, 3.0),
+    sigmas=(0.5, 0.7, 0.6),
+    concentration: float = 2.0,
+    seed: int = 47,
+):
+    """Per-shard draws from shared components with Dirichlet per-shard
+    weights."""
+    rng = np.random.default_rng(seed)
+    mus = np.asarray(mus, np.float64)
+    sigmas = np.asarray(sigmas, np.float64)
+    K = mus.size
+    weights = rng.dirichlet(np.full(K, concentration), size=n_shards)
+    shards = []
+    for i in range(n_shards):
+        z = rng.choice(K, size=n_obs, p=weights[i])
+        y = (mus[z] + sigmas[z] * rng.normal(size=n_obs)).astype(np.float32)
+        shards.append((y,))
+    truth = {"mu": mus, "sigma": sigmas, "weights": weights}
+    return pack_shards(shards, pad_to_multiple=8), truth
+
+
+def mixture_loglik(y, log_w, mu, sigma):
+    """Marginalized per-observation mixture log-density.
+
+    ``y``: (n,), ``log_w``: (K,) normalized log-weights, ``mu``/
+    ``sigma``: (K,).  One (n, K) broadcast + logsumexp."""
+    comp = (
+        _normal_logpdf(y[:, None], mu[None, :], sigma[None, :])
+        + log_w[None, :]
+    )
+    return jax.scipy.special.logsumexp(comp, axis=1)
+
+
+@dataclasses.dataclass
+class FederatedGaussianMixture:
+    """K shared Gaussian components, per-shard mixing weights."""
+
+    data: ShardedData
+    n_components: int
+    mesh: Optional[Mesh] = None
+    prior_scale: float = 5.0
+
+    def __post_init__(self):
+        (y,), mask = self.data.tree()
+        n = y.shape[0]
+        shard_ids = jnp.arange(n, dtype=jnp.int32)
+
+        def per_shard_logp(params, shard):
+            (y,), mask, sid = shard
+            mu, sigma = self._components(params)
+            logits = jnp.take(params["weight_logits"], sid, axis=0)
+            log_w = jax.nn.log_softmax(logits)
+            ll = mixture_loglik(y, log_w, mu, sigma)
+            return jnp.sum(ll * mask)
+
+        self.fed = FederatedLogp(
+            per_shard_logp, ((y,), mask, shard_ids), mesh=self.mesh
+        )
+        self.n_shards = n
+
+    @staticmethod
+    def _components(params):
+        """Ordered means (mu0 + positive increments) and scales."""
+        mu0 = params["mu0"]
+        incr = jnp.exp(params["log_incr"])
+        mu = jnp.concatenate([mu0[None], mu0 + jnp.cumsum(incr)])
+        return mu, jnp.exp(params["log_sigma"])
+
+    def prior_logp(self, params: Any) -> jax.Array:
+        lp = _normal_logpdf(params["mu0"], 0.0, self.prior_scale)
+        # LogNormal(0,1) increments: N(0,1) density on log_incr IS the
+        # prior on the unconstrained coordinate (no extra Jacobian).
+        lp += jnp.sum(_normal_logpdf(params["log_incr"], 0.0, 1.0))
+        lp += jnp.sum(_normal_logpdf(params["log_sigma"], 0.0, 1.0))
+        lp += jnp.sum(_normal_logpdf(params["weight_logits"], 0.0, 1.0))
+        return lp
+
+    def logp(self, params: Any) -> jax.Array:
+        return self.prior_logp(params) + self.fed.logp(params)
+
+    def logp_and_grad(self, params: Any):
+        return jax.value_and_grad(self.logp)(params)
+
+    def weights(self, params: Any) -> jax.Array:
+        """Implied per-shard mixing proportions ``(n_shards, K)``."""
+        return jax.nn.softmax(params["weight_logits"], axis=-1)
+
+    def pointwise_loglik(self, params: Any) -> jax.Array:
+        (y,), mask = self.data.tree()
+        mu, sigma = self._components(params)
+        log_w = jax.nn.log_softmax(params["weight_logits"], axis=-1)
+
+        def one(y_i, lw_i):
+            return mixture_loglik(y_i, lw_i, mu, sigma)
+
+        return jax.vmap(one)(y, log_w) * mask
+
+    def predictive(self, params: Any, key) -> jax.Array:
+        """Simulate replicated data (padded slots zeroed)."""
+        (y,), mask = self.data.tree()
+        mu, sigma = self._components(params)
+        k_z, k_e = jax.random.split(key)
+        logits = params["weight_logits"]  # (S, K)
+        z = jax.random.categorical(
+            k_z, logits[:, None, :], axis=-1, shape=y.shape
+        )
+        eps = jax.random.normal(k_e, y.shape)
+        return (jnp.take(mu, z) + jnp.take(sigma, z) * eps) * mask
+
+    def init_params(self) -> Any:
+        K = self.n_components
+        (y,), mask = self.data.tree()
+        spread = float(np.std(np.asarray(y)[np.asarray(mask) > 0]) + 1e-3)
+        return {
+            "mu0": jnp.asarray(
+                float(np.min(np.asarray(y)[np.asarray(mask) > 0]))
+            ),
+            "log_incr": jnp.full((K - 1,), float(np.log(spread))),
+            "log_sigma": jnp.full((K,), float(np.log(0.5 * spread))),
+            "weight_logits": jnp.zeros((self.n_shards, K)),
+        }
+
+    def find_map(self, **kwargs):
+        from ..samplers import find_map
+
+        return find_map(self.logp, self.init_params(), **kwargs)
+
+    def sample(self, *, key=None, **kwargs):
+        from ..samplers import sample
+
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        return sample(self.logp, self.init_params(), key=key, **kwargs)
