@@ -1,7 +1,7 @@
 """On-device samplers (replaces the reference's PyMC driver dependency)."""
 
 from .advi import ADVIResult, advi_fit
-from .convergence import effective_sample_size, split_rhat, summary
+from .convergence import effective_sample_size, hdi, split_rhat, summary
 from .arviz_export import to_dataset_dict, to_inference_data
 from .model_comparison import (
     compare,
@@ -53,6 +53,7 @@ __all__ = [
     "sgld_sample",
     "flatten_logp",
     "split_rhat",
+    "hdi",
     "summary",
     "hmc_init",
     "hmc_step",

@@ -24,7 +24,7 @@ from typing import Any, Dict
 import jax
 import jax.numpy as jnp
 
-__all__ = ["split_rhat", "effective_sample_size", "summary"]
+__all__ = ["split_rhat", "effective_sample_size", "hdi", "summary"]
 
 
 def _split_chains(draws: jax.Array) -> jax.Array:
@@ -117,17 +117,43 @@ def effective_sample_size(samples: Any) -> Any:
     return _per_param(_ess_scalar, samples)
 
 
-def summary(samples: Any) -> Dict[str, Any]:
-    """Posterior summary: mean, sd, split-R̂, and ESS per component.
+def hdi(samples: Any, prob: float = 0.94) -> Any:
+    """Highest-density interval per scalar component.
+
+    Returns a pytree matching ``samples`` (minus chain/draw axes) with
+    a trailing axis of 2: ``[lower, upper]``.  Computed the standard
+    way (arviz's default): the narrowest window containing ``prob`` of
+    the pooled sorted draws — exact for unimodal posteriors.
+    """
+    if not 0.0 < prob < 1.0:
+        raise ValueError(f"prob must be in (0, 1), got {prob}")
+
+    def leaf(d):
+        flat = d.reshape((-1,) + d.shape[2:])
+        s = jnp.sort(flat, axis=0)
+        n = s.shape[0]
+        k = max(int(jnp.floor(prob * n)), 1)
+        widths = s[k:] - s[: n - k]
+        i = jnp.argmin(widths, axis=0)
+        lower = jnp.take_along_axis(s, i[None], axis=0)[0]
+        upper = jnp.take_along_axis(s, (i + k)[None], axis=0)[0]
+        return jnp.stack([lower, upper], axis=-1)
+
+    return jax.tree_util.tree_map(leaf, samples)
+
+
+def summary(samples: Any, *, hdi_prob: float = 0.94) -> Dict[str, Any]:
+    """Posterior summary: mean, sd, HDI, split-R̂, ESS per component.
 
     The on-device counterpart of the ``arviz.summary`` table the
-    reference's workflow ends with.
+    reference's workflow ends with (same default 94% HDI).
     """
     mean = jax.tree_util.tree_map(lambda d: jnp.mean(d, axis=(0, 1)), samples)
     sd = jax.tree_util.tree_map(lambda d: jnp.std(d, axis=(0, 1)), samples)
     return {
         "mean": mean,
         "sd": sd,
+        "hdi": hdi(samples, hdi_prob),
         "rhat": split_rhat(samples),
         "ess": effective_sample_size(samples),
     }

@@ -145,11 +145,11 @@ class SampleResult:
     step_size: jax.Array  # (chains,)
     inv_mass: jax.Array  # (chains, dim)
 
-    def summary(self) -> dict:
-        """mean/sd/split-R̂/ESS per component (see samplers.convergence)."""
+    def summary(self, *, hdi_prob: float = 0.94) -> dict:
+        """mean/sd/HDI/split-R̂/ESS per component (samplers.convergence)."""
         from .convergence import summary as _summary
 
-        return _summary(self.samples)
+        return _summary(self.samples, hdi_prob=hdi_prob)
 
 
 def sample(

@@ -99,3 +99,44 @@ def test_x64_large_location_small_scale():
         ess = float(effective_sample_size(draws))
         assert abs(r - 1.0) < 0.01, r
         assert 0.75 * C * N < ess < 1.3 * C * N, ess
+
+
+class TestHDI:
+    def test_matches_normal_quantiles(self):
+        # For a symmetric unimodal sample the HDI ~ central interval.
+        rng = np.random.default_rng(0)
+        draws = rng.normal(2.0, 1.0, size=(4, 5000))
+        from pytensor_federated_tpu.samplers import hdi
+
+        lo, hi = np.asarray(hdi(jnp.asarray(draws), 0.94))
+        assert abs(lo - (2.0 - 1.881)) < 0.1   # z_{0.03} = 1.881
+        assert abs(hi - (2.0 + 1.881)) < 0.1
+
+    def test_skewed_hdi_narrower_than_central(self):
+        rng = np.random.default_rng(1)
+        draws = rng.gamma(2.0, 1.0, size=(2, 8000))
+        from pytensor_federated_tpu.samplers import hdi
+
+        lo, hi = np.asarray(hdi(jnp.asarray(draws), 0.9))
+        q_lo, q_hi = np.quantile(draws, [0.05, 0.95])
+        assert (hi - lo) < (q_hi - q_lo)
+        assert lo >= 0.0 - 1e-6
+
+    def test_vector_components_and_summary_key(self):
+        rng = np.random.default_rng(2)
+        samples = {"w": jnp.asarray(rng.normal(size=(2, 500, 3)))}
+        from pytensor_federated_tpu.samplers import hdi, summary
+
+        h = hdi(samples)
+        assert h["w"].shape == (3, 2)
+        s = summary(samples)
+        assert "hdi" in s and s["hdi"]["w"].shape == (3, 2)
+        assert np.all(np.asarray(h["w"][:, 0]) < np.asarray(h["w"][:, 1]))
+
+    def test_invalid_prob_raises(self):
+        import pytest as _pytest
+
+        from pytensor_federated_tpu.samplers import hdi
+
+        with _pytest.raises(ValueError):
+            hdi({"x": jnp.zeros((2, 10))}, prob=1.5)
