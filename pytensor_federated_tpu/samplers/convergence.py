@@ -11,7 +11,11 @@ of round-tripping through host DataFrames.
 Definitions follow Vehtari, Gelman, Simpson, Carpenter, Bürkner (2021)
 "Rank-normalization, folding, and localization: An improved R̂":
 split-chain R̂ and the Geyer initial-monotone-sequence ESS (the same
-estimators Stan and arviz report, minus rank-normalization).
+estimators Stan and arviz report), with optional rank-normalization
+(``rank_normalized=True``: pooled draws are replaced by normal
+quantiles of their Blom-adjusted ranks, making the diagnostics robust
+to heavy tails and nonlinear transformations — the paper's "bulk"
+variants).
 Computation promotes to at least float32 but preserves float64 inputs
 (the x64 opt-in policy) — diagnostics of large-location/small-scale
 parameters would quantize to garbage if downcast.
@@ -87,34 +91,66 @@ def _ess_scalar(draws: jax.Array) -> jax.Array:
     return m * n / tau
 
 
-def _per_param(fn, samples: Any) -> Any:
+def _rank_normalize(x: jax.Array) -> jax.Array:
+    """Replace (chains, n) draws by normal quantiles of their pooled
+    Blom-adjusted AVERAGE ranks (Vehtari et al. 2021, eq. 14).
+
+    Average ranks (via two searchsorteds) match the paper/Stan/arviz
+    tie handling: duplicated draws — routine under Metropolis
+    rejections or SMC resampling — get identical z-scores instead of
+    chain-ordered distinct ranks that would fabricate between-chain
+    variance.  NaN draws stay NaN so a diverged chain still alarms
+    instead of being laundered into large finite z-scores.
+    """
+    c, n = x.shape
+    flat = x.reshape(-1)
+    s = jnp.sort(flat)
+    lo = jnp.searchsorted(s, flat, side="left")
+    hi = jnp.searchsorted(s, flat, side="right")
+    ranks = 0.5 * (lo + hi + 1).astype(x.dtype)  # 1-based average rank
+    z = jax.scipy.special.ndtri((ranks - 0.375) / (flat.size + 0.25))
+    z = jnp.where(jnp.isnan(flat), jnp.nan, z)
+    return z.reshape(c, n)
+
+
+def _per_param(fn, samples: Any, *, rank_normalized: bool = False) -> Any:
     """Apply a (chains, n)->scalar diagnostic over every scalar component
     of every leaf; leaves have shape (chains, draws, *event)."""
+
+    def scalar_fn(d2):
+        if rank_normalized:
+            d2 = _rank_normalize(d2.astype(_compute_dtype(d2)))
+        return fn(d2)
 
     def leaf(d):
         d = jnp.asarray(d)
         c, n = d.shape[0], d.shape[1]
         flat = d.reshape(c, n, -1)
-        out = jax.vmap(fn, in_axes=2)(flat)  # (prod(event),)
+        out = jax.vmap(scalar_fn, in_axes=2)(flat)  # (prod(event),)
         return out.reshape(d.shape[2:]) if d.ndim > 2 else out.reshape(())
 
     return jax.tree_util.tree_map(leaf, samples)
 
 
-def split_rhat(samples: Any) -> Any:
+def split_rhat(samples: Any, *, rank_normalized: bool = False) -> Any:
     """Split-chain potential-scale-reduction R̂ per scalar component.
 
     ``samples``: pytree of arrays shaped (chains, draws, *event) — e.g.
     ``SampleResult.samples``.  Values near 1 (< 1.01) indicate the
     chains agree; mixing failures show up as R̂ >> 1.
+    ``rank_normalized=True`` gives the 2021 bulk-R̂ (robust to heavy
+    tails/infinite variance).
     """
-    return _per_param(_rhat_scalar, samples)
+    return _per_param(_rhat_scalar, samples, rank_normalized=rank_normalized)
 
 
-def effective_sample_size(samples: Any) -> Any:
+def effective_sample_size(
+    samples: Any, *, rank_normalized: bool = False
+) -> Any:
     """Bulk effective sample size per scalar component (Geyer/Stan
-    estimator on split chains)."""
-    return _per_param(_ess_scalar, samples)
+    estimator on split chains); ``rank_normalized=True`` gives the
+    2021 bulk-ESS."""
+    return _per_param(_ess_scalar, samples, rank_normalized=rank_normalized)
 
 
 def hdi(samples: Any, prob: float = 0.94) -> Any:
@@ -142,11 +178,18 @@ def hdi(samples: Any, prob: float = 0.94) -> Any:
     return jax.tree_util.tree_map(leaf, samples)
 
 
-def summary(samples: Any, *, hdi_prob: float = 0.94) -> Dict[str, Any]:
+def summary(
+    samples: Any,
+    *,
+    hdi_prob: float = 0.94,
+    rank_normalized: bool = False,
+) -> Dict[str, Any]:
     """Posterior summary: mean, sd, HDI, split-R̂, ESS per component.
 
     The on-device counterpart of the ``arviz.summary`` table the
-    reference's workflow ends with (same default 94% HDI).
+    reference's workflow ends with (same default 94% HDI);
+    ``rank_normalized=True`` switches R̂/ESS to the 2021 bulk variants
+    arviz reports by default.
     """
     mean = jax.tree_util.tree_map(lambda d: jnp.mean(d, axis=(0, 1)), samples)
     sd = jax.tree_util.tree_map(lambda d: jnp.std(d, axis=(0, 1)), samples)
@@ -154,6 +197,8 @@ def summary(samples: Any, *, hdi_prob: float = 0.94) -> Dict[str, Any]:
         "mean": mean,
         "sd": sd,
         "hdi": hdi(samples, hdi_prob),
-        "rhat": split_rhat(samples),
-        "ess": effective_sample_size(samples),
+        "rhat": split_rhat(samples, rank_normalized=rank_normalized),
+        "ess": effective_sample_size(
+            samples, rank_normalized=rank_normalized
+        ),
     }

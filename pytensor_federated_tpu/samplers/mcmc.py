@@ -145,11 +145,17 @@ class SampleResult:
     step_size: jax.Array  # (chains,)
     inv_mass: jax.Array  # (chains, dim)
 
-    def summary(self, *, hdi_prob: float = 0.94) -> dict:
+    def summary(
+        self, *, hdi_prob: float = 0.94, rank_normalized: bool = False
+    ) -> dict:
         """mean/sd/HDI/split-R̂/ESS per component (samplers.convergence)."""
         from .convergence import summary as _summary
 
-        return _summary(self.samples, hdi_prob=hdi_prob)
+        return _summary(
+            self.samples,
+            hdi_prob=hdi_prob,
+            rank_normalized=rank_normalized,
+        )
 
 
 def sample(

@@ -140,3 +140,82 @@ class TestHDI:
 
         with _pytest.raises(ValueError):
             hdi({"x": jnp.zeros((2, 10))}, prob=1.5)
+
+
+class TestRankNormalized:
+    def test_agrees_on_wellbehaved_chains(self):
+        rng = np.random.default_rng(0)
+        samples = {"x": jnp.asarray(rng.normal(size=(4, 1000)))}
+        from pytensor_federated_tpu.samplers import split_rhat
+
+        plain = float(np.asarray(split_rhat(samples)["x"]))
+        ranked = float(
+            np.asarray(split_rhat(samples, rank_normalized=True)["x"])
+        )
+        assert abs(plain - ranked) < 0.01
+        assert abs(ranked - 1.0) < 0.02
+
+    def test_robust_to_infinite_variance(self):
+        # Cauchy draws: plain R-hat is dominated by tail noise; the
+        # rank-normalized version must still read "converged" for
+        # well-mixed chains and detect a genuinely stuck chain.
+        rng = np.random.default_rng(1)
+        good = rng.standard_cauchy(size=(4, 2000))
+        from pytensor_federated_tpu.samplers import split_rhat
+
+        r_good = float(
+            np.asarray(
+                split_rhat({"x": jnp.asarray(good)}, rank_normalized=True)[
+                    "x"
+                ]
+            )
+        )
+        assert r_good < 1.02
+
+        bad = good.copy()
+        bad[0] = bad[0] * 0.01 + 50.0  # one chain stuck far away
+        r_bad = float(
+            np.asarray(
+                split_rhat({"x": jnp.asarray(bad)}, rank_normalized=True)[
+                    "x"
+                ]
+            )
+        )
+        assert r_bad > 1.2  # far above the ~1.01 convergence line
+
+    def test_rank_normalized_ess_positive(self):
+        rng = np.random.default_rng(2)
+        samples = {"x": jnp.asarray(rng.standard_cauchy(size=(2, 1000)))}
+        from pytensor_federated_tpu.samplers import effective_sample_size
+
+        ess = float(
+            np.asarray(
+                effective_sample_size(samples, rank_normalized=True)["x"]
+            )
+        )
+        assert 100 < ess <= 2200
+
+
+def test_tied_draws_do_not_inflate_rank_rhat():
+    # Metropolis-style duplicated draws: average ranks keep z-scores
+    # identical across chains; ordinal ranks would fabricate
+    # between-chain variance.
+    rng = np.random.default_rng(3)
+    base = np.round(rng.normal(size=(1, 800)), 1)  # many ties
+    samples = {"x": jnp.asarray(np.concatenate([base, base, base, base]))}
+    from pytensor_federated_tpu.samplers import split_rhat
+
+    r = float(np.asarray(split_rhat(samples, rank_normalized=True)["x"]))
+    assert r < 1.01
+
+
+def test_nan_draws_still_alarm_when_rank_normalized():
+    rng = np.random.default_rng(4)
+    draws = rng.normal(size=(4, 500))
+    draws[2, 100:] = np.nan
+    from pytensor_federated_tpu.samplers import split_rhat
+
+    r = np.asarray(
+        split_rhat({"x": jnp.asarray(draws)}, rank_normalized=True)["x"]
+    )
+    assert np.isnan(r)
