@@ -1,7 +1,13 @@
 """On-device samplers (replaces the reference's PyMC driver dependency)."""
 
 from .advi import ADVIResult, advi_fit
-from .convergence import effective_sample_size, hdi, split_rhat, summary
+from .convergence import (
+    effective_sample_size,
+    hdi,
+    split_rhat,
+    summary,
+    tail_ess,
+)
 from .arviz_export import to_dataset_dict, to_inference_data
 from .model_comparison import (
     compare,
@@ -55,6 +61,7 @@ __all__ = [
     "split_rhat",
     "hdi",
     "summary",
+    "tail_ess",
     "hmc_init",
     "hmc_step",
     "leapfrog",

@@ -28,7 +28,13 @@ from typing import Any, Dict
 import jax
 import jax.numpy as jnp
 
-__all__ = ["split_rhat", "effective_sample_size", "hdi", "summary"]
+__all__ = [
+    "split_rhat",
+    "effective_sample_size",
+    "hdi",
+    "summary",
+    "tail_ess",
+]
 
 
 def _split_chains(draws: jax.Array) -> jax.Array:
@@ -113,6 +119,19 @@ def _rank_normalize(x: jax.Array) -> jax.Array:
     return z.reshape(c, n)
 
 
+def _rank_normalize_tree(samples: Any) -> Any:
+    """Rank-normalize every scalar component of every leaf once."""
+
+    def leaf(d):
+        d = jnp.asarray(d)
+        c, n = d.shape[0], d.shape[1]
+        flat = d.reshape(c, n, -1).astype(_compute_dtype(d))
+        z = jax.vmap(_rank_normalize, in_axes=2, out_axes=2)(flat)
+        return z.reshape((c, n) + d.shape[2:])
+
+    return jax.tree_util.tree_map(leaf, samples)
+
+
 def _per_param(fn, samples: Any, *, rank_normalized: bool = False) -> Any:
     """Apply a (chains, n)->scalar diagnostic over every scalar component
     of every leaf; leaves have shape (chains, draws, *event)."""
@@ -151,6 +170,29 @@ def effective_sample_size(
     estimator on split chains); ``rank_normalized=True`` gives the
     2021 bulk-ESS."""
     return _per_param(_ess_scalar, samples, rank_normalized=rank_normalized)
+
+
+def _tail_ess_scalar(draws: jax.Array) -> jax.Array:
+    x = draws.astype(_compute_dtype(draws))
+    q05 = jnp.nanquantile(x, 0.05)
+    q95 = jnp.nanquantile(x, 0.95)
+    e05 = _ess_scalar((x <= q05).astype(x.dtype))
+    e95 = _ess_scalar((x <= q95).astype(x.dtype))
+    # (nan <= q) is False, which would launder diverged draws into
+    # healthy-looking indicator chains — propagate the alarm instead
+    # (the module-wide NaN policy, see _rank_normalize).
+    return jnp.where(
+        jnp.any(jnp.isnan(x)), jnp.nan, jnp.minimum(e05, e95)
+    )
+
+
+def tail_ess(samples: Any) -> Any:
+    """Tail effective sample size (Vehtari et al. 2021): the minimum
+    ESS of the 5% / 95% quantile-exceedance indicators — how reliably
+    the chain resolves its own tails.  A chain can have healthy bulk
+    ESS while its intervals are garbage; this is the diagnostic that
+    notices (arviz's ``ess_tail``)."""
+    return _per_param(_tail_ess_scalar, samples)
 
 
 def hdi(samples: Any, prob: float = 0.94) -> Any:
@@ -193,12 +235,16 @@ def summary(
     """
     mean = jax.tree_util.tree_map(lambda d: jnp.mean(d, axis=(0, 1)), samples)
     sd = jax.tree_util.tree_map(lambda d: jnp.std(d, axis=(0, 1)), samples)
+    # Rank-normalize ONCE and feed the plain estimators — calling each
+    # with rank_normalized=True would redo the sort per diagnostic.
+    diag_samples = (
+        _rank_normalize_tree(samples) if rank_normalized else samples
+    )
     return {
         "mean": mean,
         "sd": sd,
         "hdi": hdi(samples, hdi_prob),
-        "rhat": split_rhat(samples, rank_normalized=rank_normalized),
-        "ess": effective_sample_size(
-            samples, rank_normalized=rank_normalized
-        ),
+        "rhat": split_rhat(diag_samples),
+        "ess": effective_sample_size(diag_samples),
+        "ess_tail": tail_ess(samples),
     }

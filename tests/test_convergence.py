@@ -219,3 +219,63 @@ def test_nan_draws_still_alarm_when_rank_normalized():
         split_rhat({"x": jnp.asarray(draws)}, rank_normalized=True)["x"]
     )
     assert np.isnan(r)
+
+
+class TestTailESS:
+    def test_iid_chains_have_healthy_tail_ess(self):
+        rng = np.random.default_rng(5)
+        samples = {"x": jnp.asarray(rng.normal(size=(4, 1000)))}
+        from pytensor_federated_tpu.samplers import tail_ess
+
+        t = float(np.asarray(tail_ess(samples)["x"]))
+        assert t > 1000  # iid: ESS ~ total draws
+
+    def test_sticky_tails_detected(self):
+        # Bulk mixes fine but tail excursions are long-lived: an AR(1)
+        # process whose extremes persist. Tail ESS must be far below
+        # the bulk ESS.
+        rng = np.random.default_rng(6)
+        n, c, rho = 4000, 4, 0.99
+        eps = rng.normal(size=(c, n))
+        x = np.zeros((c, n))
+        for t_ in range(1, n):
+            x[:, t_] = rho * x[:, t_ - 1] + np.sqrt(1 - rho**2) * eps[:, t_]
+        from pytensor_federated_tpu.samplers import (
+            effective_sample_size,
+            tail_ess,
+        )
+
+        samples = {"x": jnp.asarray(x)}
+        te = float(np.asarray(tail_ess(samples)["x"]))
+        total = c * n
+        assert te < 0.05 * total  # strongly autocorrelated tails
+
+    def test_summary_includes_ess_tail(self):
+        rng = np.random.default_rng(7)
+        samples = {"x": jnp.asarray(rng.normal(size=(2, 400)))}
+        from pytensor_federated_tpu.samplers import summary
+
+        s = summary(samples)
+        assert "ess_tail" in s and float(np.asarray(s["ess_tail"]["x"])) > 0
+
+
+def test_tail_ess_nan_alarm():
+    rng = np.random.default_rng(8)
+    draws = rng.normal(size=(4, 500))
+    draws[1, 300:] = np.nan
+    from pytensor_federated_tpu.samplers import tail_ess
+
+    t = np.asarray(tail_ess({"x": jnp.asarray(draws)})["x"])
+    assert np.isnan(t)
+
+
+def test_summary_rank_normalized_consistent_with_direct():
+    rng = np.random.default_rng(9)
+    samples = {"x": jnp.asarray(rng.standard_cauchy(size=(2, 600)))}
+    from pytensor_federated_tpu.samplers import split_rhat, summary
+
+    s = summary(samples, rank_normalized=True)
+    direct = split_rhat(samples, rank_normalized=True)
+    np.testing.assert_allclose(
+        np.asarray(s["rhat"]["x"]), np.asarray(direct["x"]), rtol=1e-6
+    )
