@@ -164,3 +164,9 @@ class FederatedNegBinGLM(HierarchicalGLMBase):
         p = super().init_params()
         p["log_phi"] = jnp.array(1.0)
         return p
+
+    def _sample_extra_params(self, key) -> dict:
+        from .hierbase import log_halfnormal_draw
+
+        # HalfNormal(10) on phi, matching prior_logp.
+        return {"log_phi": log_halfnormal_draw(key, 10.0)}

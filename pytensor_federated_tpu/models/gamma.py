@@ -120,3 +120,9 @@ class FederatedGammaGLM(HierarchicalGLMBase):
         p = super().init_params()
         p["log_alpha"] = jnp.array(0.5)
         return p
+
+    def _sample_extra_params(self, key) -> dict:
+        from .hierbase import log_halfnormal_draw
+
+        # HalfNormal(10) on alpha, matching prior_logp.
+        return {"log_alpha": log_halfnormal_draw(key, 10.0)}

@@ -39,6 +39,15 @@ from .linear import _normal_logpdf
 __all__ = ["HierarchicalGLMBase", "linear_predictor"]
 
 
+def log_halfnormal_draw(key, scale=1.0):
+    """log of one HalfNormal(scale) draw — THE one implementation for
+    log-parameterized scale priors in sample_prior overrides."""
+    return jnp.log(
+        scale * jnp.abs(jax.random.normal(key))
+        + jnp.finfo(jnp.float32).tiny
+    )
+
+
 def linear_predictor(X, w, b, compute_dtype=None):
     """``X @ w + b``, optionally with the matmul in ``compute_dtype``
     (e.g. bf16) and float32 accumulation — the MXU mixed-precision
@@ -172,6 +181,28 @@ class HierarchicalGLMBase:
         b = self.intercepts(params)
         eta = self._linear_predictor(X, params["w"], b[:, None])
         return self._sample_obs(params, key, eta) * mask
+
+    def _sample_extra_params(self, key) -> dict:
+        """Family-specific extra parameter draws (override to match any
+        extra ``prior_logp`` terms, e.g. NB dispersion)."""
+        return {}
+
+    def sample_prior(self, key) -> Any:
+        """One draw from the prior, shaped like :meth:`init_params` —
+        plugs into :func:`..samplers.predictive.prior_predictive`
+        together with :meth:`predictive`."""
+        ks = jax.random.split(key, 5)
+        p = {
+            "w": self.prior_scale * jax.random.normal(
+                ks[0], (self.n_features,)
+            ),
+            "log_tau": log_halfnormal_draw(ks[1]),  # HalfNormal(1)
+            "b_raw": jax.random.normal(ks[2], (self.n_shards,)),
+        }
+        if self._has_global_intercept:
+            p["b0"] = self.prior_scale * jax.random.normal(ks[3])
+        p.update(self._sample_extra_params(ks[4]))
+        return p
 
     def find_map(self, **kwargs):
         from ..samplers import find_map

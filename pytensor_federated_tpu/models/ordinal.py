@@ -166,3 +166,18 @@ class FederatedOrdinalRegression(HierarchicalGLMBase):
         p["kappa0"] = jnp.array(-1.0)
         p["log_incr"] = jnp.zeros((self.n_categories - 2,))
         return p
+
+    def _sample_extra_params(self, key) -> dict:
+        # prior_logp scores Normal(0,3) on each ORDERED cutpoint plus
+        # the transform Jacobian: the induced prior on kappa is iid
+        # N(0,3) conditioned on being sorted, so the exact draw is
+        # sort(iid draws) mapped back to (kappa0, log increments).
+        k = jnp.sort(
+            3.0 * jax.random.normal(key, (self.n_categories - 1,))
+        )
+        return {
+            "kappa0": k[0],
+            "log_incr": jnp.log(
+                jnp.diff(k) + jnp.finfo(jnp.float32).tiny
+            ),
+        }

@@ -124,3 +124,16 @@ class FederatedRobustRegression(HierarchicalGLMBase):
     def nu(self, params: Any) -> jax.Array:
         """The implied degrees of freedom."""
         return 1.0 + jnp.exp(params["log_numinus1"])
+
+    def _sample_extra_params(self, key) -> dict:
+        from .hierbase import log_halfnormal_draw
+
+        k1, k2 = jax.random.split(key)
+        return {
+            # HalfNormal(1) sigma; Exponential(1/10) on nu - 1.
+            "log_sigma": log_halfnormal_draw(k1),
+            "log_numinus1": jnp.log(
+                10.0 * jax.random.exponential(k2)
+                + jnp.finfo(jnp.float32).tiny
+            ),
+        }

@@ -137,3 +137,7 @@ class FederatedWeibullAFT(HierarchicalGLMBase):
         p = super().init_params()
         p["log_k"] = jnp.zeros(())
         return p
+
+    def _sample_extra_params(self, key) -> dict:
+        # LogNormal(0, 1) shape, matching prior_logp.
+        return {"log_k": jax.random.normal(key)}

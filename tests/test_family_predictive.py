@@ -21,10 +21,13 @@ from pytensor_federated_tpu.models.logistic import (
     HierarchicalLogisticRegression,
     generate_hier_logistic_data,
 )
+from pytensor_federated_tpu.models.gamma import FederatedGammaGLM
+from pytensor_federated_tpu.models.ordinal import FederatedOrdinalRegression
 from pytensor_federated_tpu.models.robust import (
     FederatedRobustRegression,
     generate_robust_data,
 )
+from pytensor_federated_tpu.models.survival import FederatedWeibullAFT
 from pytensor_federated_tpu.samplers.predictive import posterior_predictive
 
 
@@ -108,3 +111,58 @@ def test_posterior_predictive_sweep_over_chain():
     )
     obs_mean = float(jnp.sum(y * mask) / jnp.sum(mask))
     assert means.min() - 0.5 < obs_mean < means.max() + 0.5
+
+
+class TestPriorPredictive:
+    @pytest.mark.parametrize(
+        "cls,kwargs,gen",
+        [
+            (HierarchicalLogisticRegression, {},
+             lambda: generate_hier_logistic_data(4, n_obs=32, n_features=2)),
+            (FederatedPoissonGLM, {},
+             lambda: generate_count_data(4, n_obs=32, n_features=2)),
+            (FederatedNegBinGLM, {},
+             lambda: generate_count_data(
+                 4, n_obs=32, n_features=2, dispersion=4.0)),
+            (FederatedRobustRegression, {},
+             lambda: generate_robust_data(4, n_obs=32, n_features=2)),
+            (FederatedGammaGLM, {},
+             lambda: __import__(
+                 "pytensor_federated_tpu.models.gamma", fromlist=["g"]
+             ).generate_gamma_data(4, n_obs=32, n_features=2)),
+            (FederatedWeibullAFT, {},
+             lambda: __import__(
+                 "pytensor_federated_tpu.models.survival", fromlist=["g"]
+             ).generate_survival_data(4, n_obs=32, n_features=2)),
+            (FederatedOrdinalRegression, {"n_categories": 4},
+             lambda: __import__(
+                 "pytensor_federated_tpu.models.ordinal", fromlist=["g"]
+             ).generate_ordinal_data(4, n_obs=32, n_categories=4)),
+        ],
+        ids=lambda c: getattr(c, "__name__", ""),
+    )
+    def test_prior_predictive_runs(self, cls, kwargs, gen):
+        from pytensor_federated_tpu.samplers import prior_predictive
+
+        data, _ = gen()
+        m = cls(data, **kwargs)
+        sims = prior_predictive(
+            m.sample_prior, m.predictive, jax.random.PRNGKey(0),
+            num_draws=20,
+        )
+        (X, y), mask = data.tree()
+        assert sims.shape == (20,) + np.shape(np.asarray(mask))
+        # prior draws must score finite under the prior
+        p = m.sample_prior(jax.random.PRNGKey(1))
+        assert np.isfinite(float(m.prior_logp(p)))
+
+    def test_prior_draw_shapes_match_init(self):
+        data, _ = generate_count_data(4, n_obs=32, n_features=2)
+        from pytensor_federated_tpu.models.countdata import FederatedNegBinGLM
+
+        m = FederatedNegBinGLM(data)
+        p0 = m.init_params()
+        p1 = m.sample_prior(jax.random.PRNGKey(2))
+        assert set(p0) == set(p1)
+        for k in p0:
+            assert np.shape(np.asarray(p0[k])) == np.shape(np.asarray(p1[k]))
