@@ -38,7 +38,7 @@ from jax.scipy.special import gammaln
 from jax.sharding import Mesh
 
 from ..parallel.packing import ShardedData, pack_shards
-from .hierbase import HierarchicalGLMBase
+from .hierbase import HierarchicalGLMBase, log_halfnormal_draw
 
 
 def generate_count_data(
@@ -123,8 +123,15 @@ class FederatedPoissonGLM(HierarchicalGLMBase):
     def _obs_logpmf(self, params, y, eta):
         return poisson_logpmf(y, eta)
 
+    # Simulated-count ceiling: jax.random.poisson silently CLAMPS to
+    # INT32_MAX (and maps lam=inf to 0), so wide-prior draws with
+    # exp(eta) > 2^31 would corrupt prior-predictive moments with
+    # sentinel garbage.  1e8 keeps every draw exact int32 Poisson.
+    _MAX_SIM_MEAN = 1e8
+
     def _sample_obs(self, params, key, eta):
-        return jax.random.poisson(key, jnp.exp(eta)).astype(eta.dtype)
+        lam = jnp.minimum(jnp.exp(eta), self._MAX_SIM_MEAN)
+        return jax.random.poisson(key, lam).astype(eta.dtype)
 
 
 @dataclasses.dataclass
@@ -145,12 +152,15 @@ class FederatedNegBinGLM(HierarchicalGLMBase):
         return negbin_logpmf(y, eta, jnp.exp(params["log_phi"]))
 
     def _sample_obs(self, params, key, eta):
-        # NB2 as its Gamma-Poisson mixture: lam ~ Gamma(phi, mu/phi).
+        # NB2 as its Gamma-Poisson mixture: lam ~ Gamma(phi, mu/phi);
+        # same INT32 clamp hazard as the Poisson family (see
+        # FederatedPoissonGLM._MAX_SIM_MEAN).
         phi = jnp.exp(params["log_phi"])
         k_g, k_p = jax.random.split(key)
         lam = jax.random.gamma(k_g, phi, eta.shape) * (
             jnp.exp(eta) / phi
         )
+        lam = jnp.minimum(lam, FederatedPoissonGLM._MAX_SIM_MEAN)
         return jax.random.poisson(k_p, lam).astype(eta.dtype)
 
     def prior_logp(self, params: Any) -> jax.Array:
@@ -166,7 +176,5 @@ class FederatedNegBinGLM(HierarchicalGLMBase):
         return p
 
     def _sample_extra_params(self, key) -> dict:
-        from .hierbase import log_halfnormal_draw
-
         # HalfNormal(10) on phi, matching prior_logp.
         return {"log_phi": log_halfnormal_draw(key, 10.0)}

@@ -28,7 +28,7 @@ from jax.scipy.special import gammaln
 from jax.sharding import Mesh
 
 from ..parallel.packing import ShardedData, pack_shards
-from .hierbase import HierarchicalGLMBase
+from .hierbase import HierarchicalGLMBase, log_halfnormal_draw
 
 __all__ = [
     "FederatedGammaGLM",
@@ -122,7 +122,5 @@ class FederatedGammaGLM(HierarchicalGLMBase):
         return p
 
     def _sample_extra_params(self, key) -> dict:
-        from .hierbase import log_halfnormal_draw
-
         # HalfNormal(10) on alpha, matching prior_logp.
         return {"log_alpha": log_halfnormal_draw(key, 10.0)}

@@ -36,7 +36,11 @@ import jax.numpy as jnp
 
 from .linear import _normal_logpdf
 
-__all__ = ["HierarchicalGLMBase", "linear_predictor"]
+__all__ = [
+    "HierarchicalGLMBase",
+    "linear_predictor",
+    "log_halfnormal_draw",
+]
 
 
 def log_halfnormal_draw(key, scale=1.0):

@@ -31,7 +31,7 @@ from jax.scipy.special import gammaln
 from jax.sharding import Mesh
 
 from ..parallel.packing import ShardedData, pack_shards
-from .hierbase import HierarchicalGLMBase
+from .hierbase import HierarchicalGLMBase, log_halfnormal_draw
 
 __all__ = [
     "FederatedRobustRegression",
@@ -126,8 +126,6 @@ class FederatedRobustRegression(HierarchicalGLMBase):
         return 1.0 + jnp.exp(params["log_numinus1"])
 
     def _sample_extra_params(self, key) -> dict:
-        from .hierbase import log_halfnormal_draw
-
         k1, k2 = jax.random.split(key)
         return {
             # HalfNormal(1) sigma; Exponential(1/10) on nu - 1.
