@@ -9,6 +9,7 @@ from .convergence import (
     tail_ess,
 )
 from .arviz_export import to_dataset_dict, to_inference_data
+from .chees import chees_sample
 from .model_comparison import (
     compare,
     pointwise_loglik_matrix,
@@ -68,6 +69,7 @@ __all__ = [
     "metropolis_init",
     "metropolis_step",
     "nuts_step",
+    "chees_sample",
     "compare",
     "to_dataset_dict",
     "to_inference_data",
