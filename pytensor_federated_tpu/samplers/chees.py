@@ -160,13 +160,19 @@ def chees_sample(
         # weight 0 — but 0 * NaN = NaN, so non-finite contributions
         # must be ZEROED or one early divergence would poison the Adam
         # state (and hence log_traj) for the whole run.
+        # nan-aware centering: jnp.mean over chains would go NaN if
+        # ANY chain diverged, zeroing every chain's contribution below
+        # — one bad chain must not erase 15 healthy ones.
+        end_ok = jnp.all(jnp.isfinite(end.x), axis=1, keepdims=True)
+        n_ok = jnp.maximum(jnp.sum(end_ok), 1.0)
+        end_safe = jnp.where(end_ok, end.x, 0.0)
         xc = x - jnp.mean(x, axis=0)
-        pc = end.x - jnp.mean(end.x, axis=0)
+        pc = end_safe - jnp.sum(end_safe, axis=0) / n_ok
         dsq = jnp.sum(pc**2, axis=1) - jnp.sum(xc**2, axis=1)
         v_end = end.r * inv_mass[None, :]  # final velocity
         proj = jnp.sum(pc * v_end, axis=1)
         contrib = dsq * proj
-        finite = jnp.isfinite(contrib)
+        finite = jnp.isfinite(contrib) & end_ok[:, 0]
         w = jnp.where(finite, accept_prob, 0.0)
         contrib = jnp.where(finite, contrib, 0.0)
         chees_grad = h * jnp.sum(w * contrib) / (jnp.sum(w) + 1e-10)
@@ -217,7 +223,12 @@ def chees_sample(
         (x0, logp0, grad0, da, adam, log_traj, inv_mass0),
         (its, keys),
     )
-    step_size = jnp.exp(da.log_step_avg)
+    # num_warmup=0: no da_update ever ran, log_step_avg is still its
+    # zero init — fall back to the probed initial step (mcmc.py's
+    # _warmup carries the same guard).
+    step_size = jnp.exp(
+        jnp.where(da.count > 0, da.log_step_avg, da.log_step)
+    )
     traj_len = jnp.exp(log_traj)
 
     # ---- sampling: frozen (eps, T, mass), jitter continues ----------
