@@ -9,7 +9,7 @@ flagship); this suite covers the full config list for the record:
 4. Lotka-Volterra ODE param estimation, [theta] -> [LL, dLL] per shard;
 5. 64-shard federated logistic regression + a full NUTS posterior.
 
-Plus two net-new configs with no reference or BASELINE analog:
+Plus three net-new configs with no reference or BASELINE analog:
 
 6. T=4096 LGSSM logp+grad via the O(log T) parallel-in-time Kalman
    filter — baselined against the classic O(T) sequential scan filter
@@ -19,7 +19,10 @@ Plus two net-new configs with no reference or BASELINE analog:
    vectorized chains, so the hot op is a real (n, d) @ (d, chains)
    MXU matmul instead of a launch-bound matvec — baselined at 5% MFU
    (an eval rate below that means the chip is idling, whatever the
-   evals/s says).
+   evals/s says);
+9. ChEES-HMC at 16 lockstep chains — baselined against the SAME run's
+   NUTS min-ESS/s (the cross-chain sampler must beat tree-doubling in
+   its intended many-chains regime).
 
 Every record carries ``flops_per_eval`` (XLA's exact cost-model count
 of the compiled executable — flopcount.py), achieved ``flops_per_sec``,
