@@ -97,9 +97,37 @@ def generate_gp_data(
 
 
 def _sqexp(x1, x2, variance, lengthscale):
-    """Squared-exponential kernel matrix, MXU-friendly distance form."""
-    d2 = (x1[:, None] - x2[None, :]) ** 2
-    return variance * jnp.exp(-0.5 * d2 / lengthscale**2)
+    """Squared-exponential kernel matrix, MXU-friendly distance form.
+
+    Inputs may be 1-D ``(n,)`` (scalar covariate, the demo shape) or
+    2-D ``(n, d)``; with 2-D inputs a ``(d,)`` ``lengthscale`` gives
+    ARD — one learned scale per input dimension, so irrelevant
+    covariates are pruned by their lengthscales growing.  The 2-D
+    branch uses the ``|a-b|^2 = |a|^2 + |b|^2 - 2ab`` expansion: the
+    cross term is one (n1, d) @ (d, n2) MXU matmul instead of an
+    (n1, n2, d) broadcast living in memory.
+    """
+    if x1.ndim != x2.ndim:
+        raise ValueError(
+            f"kernel inputs must have matching ndim, got {x1.ndim} and "
+            f"{x2.ndim} — for ARD both must be (n, d); for scalar "
+            "covariates both must be (n,)"
+        )
+    if x1.ndim == 1:
+        ls = jnp.asarray(lengthscale)
+        if ls.ndim != 0:
+            raise ValueError(
+                "1-D inputs take a scalar lengthscale; a vector "
+                "lengthscale (ARD) needs (n, d) inputs"
+            )
+        d2 = ((x1[:, None] - x2[None, :]) / ls) ** 2
+        return variance * jnp.exp(-0.5 * d2)
+    s1 = x1 / lengthscale  # (n1, d) with (d,) or scalar lengthscale
+    s2 = x2 / lengthscale
+    sq1 = jnp.sum(s1**2, axis=1)
+    sq2 = jnp.sum(s2**2, axis=1)
+    d2 = sq1[:, None] + sq2[None, :] - 2.0 * (s1 @ s2.T)
+    return variance * jnp.exp(-0.5 * jnp.maximum(d2, 0.0))
 
 
 def _unpack(params):
@@ -204,9 +232,10 @@ class FederatedSparseGP:
 
     @staticmethod
     def _prior_logp(params):
-        """Weak N(0, 3²) priors on the three log-hyperparameters."""
+        """Weak N(0, 3²) priors on the log-hyperparameters (summed, so
+        ARD's vector ``log_lengthscale`` reduces to a scalar too)."""
         return sum(
-            -0.5 * (params[k] / 3.0) ** 2
+            jnp.sum(-0.5 * (params[k] / 3.0) ** 2)
             for k in ("log_variance", "log_lengthscale", "log_noise")
         )
 
@@ -321,9 +350,12 @@ class FederatedExactGP:
         return find_map(self.logp, self.init_params(), **kwargs)
 
     def posterior(self, params: Any, x_star) -> tuple:
-        """Per-shard posterior mean and variance at ``x_star``
-        (``(n_star,)`` shared query points): returns ``(mean, var)``
-        each ``(n_shards, n_star)`` — one batched solve per shard."""
+        """Per-shard posterior mean and variance at ``x_star`` —
+        ``(n_star,)`` shared query points for scalar-covariate data,
+        ``(n_star, d)`` when the training inputs are ``(n, d)`` (ARD):
+        query ndim must match the training inputs'.  Returns
+        ``(mean, var)`` each ``(n_shards, n_star)`` — one batched
+        solve per shard."""
         (x, y), mask = self.data.tree()
         variance, lengthscale, noise = _unpack(params)
         xs = jnp.asarray(x_star, jnp.float32)

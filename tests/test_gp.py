@@ -222,3 +222,60 @@ class TestExactGP:
         np.testing.assert_allclose(
             float(m_mesh.logp(p0)), float(m_local.logp(p0)), rtol=5e-4
         )
+
+
+class TestARD:
+    """Multi-dimensional inputs + per-dimension lengthscales."""
+
+    def test_2d_kernel_matches_broadcast_form(self):
+        from pytensor_federated_tpu.models.gp import _sqexp
+
+        rng = np.random.default_rng(0)
+        x1 = jnp.asarray(rng.normal(size=(12, 3)).astype(np.float32))
+        x2 = jnp.asarray(rng.normal(size=(9, 3)).astype(np.float32))
+        ls = jnp.asarray([0.5, 1.0, 2.0])
+        k = np.asarray(_sqexp(x1, x2, 1.3, ls))
+        d2 = np.sum(
+            ((np.asarray(x1)[:, None, :] - np.asarray(x2)[None, :, :])
+             / np.asarray(ls)) ** 2,
+            axis=2,
+        )
+        golden = 1.3 * np.exp(-0.5 * d2)
+        np.testing.assert_allclose(k, golden, rtol=1e-4, atol=1e-5)
+
+    def test_ard_prunes_irrelevant_dimension(self):
+        # f depends only on dim 0; the fitted lengthscale for dim 1
+        # must grow far beyond dim 0's.
+        from pytensor_federated_tpu.models.gp import FederatedExactGP
+        from pytensor_federated_tpu.parallel.packing import pack_shards
+        from pytensor_federated_tpu.samplers import find_map
+
+        rng = np.random.default_rng(1)
+        shards = []
+        for _ in range(4):
+            x = rng.uniform(-2, 2, size=(40, 2)).astype(np.float32)
+            y = (np.sin(2.0 * x[:, 0]) + 0.05 * rng.normal(size=40)).astype(
+                np.float32
+            )
+            shards.append((x, y))
+        packed = pack_shards(shards, pad_to_multiple=8)
+        m = FederatedExactGP(packed)
+        init = {
+            "log_variance": jnp.zeros(()),
+            "log_lengthscale": jnp.zeros((2,)),  # ARD: one per dim
+            "log_noise": jnp.asarray(-1.5),
+        }
+        est = find_map(m.logp, init)
+        ls = np.exp(np.asarray(est["log_lengthscale"]))
+        assert ls[1] > 3.0 * ls[0], ls
+
+
+def test_kernel_shape_mismatches_fail_loudly():
+    import pytest as _pytest
+
+    from pytensor_federated_tpu.models.gp import _sqexp
+
+    with _pytest.raises(ValueError, match="matching ndim"):
+        _sqexp(jnp.zeros(5), jnp.zeros((5, 2)), 1.0, 1.0)
+    with _pytest.raises(ValueError, match="scalar lengthscale"):
+        _sqexp(jnp.zeros(4), jnp.zeros(3), 1.0, jnp.ones(3))
