@@ -12,6 +12,7 @@ from .gp import (
     FederatedSparseGP,
     dense_vfe_logp,
     generate_gp_data,
+    get_kernel,
 )
 from .linear import FederatedLinearRegression, generate_node_data
 from .logistic import (
@@ -76,6 +77,7 @@ __all__ = [
     "cumulative_logit_loglik",
     "gamma_logpdf",
     "generate_count_data",
+    "get_kernel",
     "generate_gamma_data",
     "generate_mixture_data",
     "mixture_loglik",

@@ -23,7 +23,10 @@ the exact marginal of the Nyström approximation plus trace correction):
                + log|B| - log|K_zz| + trace_term ]
     B = K_zz + A/σ²,  β = b/σ,  trace_term = (c - tr(K_zz^{-1} A))/σ²
 
-Kernel: squared-exponential with learned ``log_variance``,
+Kernels: squared-exponential (default), Matérn 3/2 and 5/2 — all
+stationary with ``k(x, x) = variance`` (which the VFE trace residual
+relies on), selected by ``kernel=`` and supporting 1-D or (n, d)
+inputs with ARD lengthscales.  Learned ``log_variance``,
 ``log_lengthscale``, ``log_noise`` (unconstrained).  All math float32,
 jitter-stabilized Choleskys.
 """
@@ -45,7 +48,7 @@ from ..utils import LOG_2PI
 _JITTER = 1e-4  # float32 Cholesky needs real jitter (relative to variance)
 
 
-def _masked_cov(x, mask, variance, lengthscale, noise):
+def _masked_cov(x, mask, variance, lengthscale, noise, kern=None):
     """Masked exact-GP covariance with identity rows on padded slots.
 
     Real block: K + (noise^2 + jitter*var) I; padded rows/cols become
@@ -56,7 +59,8 @@ def _masked_cov(x, mask, variance, lengthscale, noise):
     hyperparameters."""
     n = x.shape[0]
     mm = mask[:, None] * mask[None, :]
-    k = _sqexp(x, x, variance, lengthscale) * mm
+    kern = kern or _sqexp
+    k = kern(x, x, variance, lengthscale) * mm
     k = k + (noise**2 + _JITTER * variance) * jnp.eye(n)
     return k + (1.0 - mask) * (
         1.0 - noise**2 - _JITTER * variance
@@ -96,17 +100,9 @@ def generate_gp_data(
     return packed, np.stack([x, y])
 
 
-def _sqexp(x1, x2, variance, lengthscale):
-    """Squared-exponential kernel matrix, MXU-friendly distance form.
-
-    Inputs may be 1-D ``(n,)`` (scalar covariate, the demo shape) or
-    2-D ``(n, d)``; with 2-D inputs a ``(d,)`` ``lengthscale`` gives
-    ARD — one learned scale per input dimension, so irrelevant
-    covariates are pruned by their lengthscales growing.  The 2-D
-    branch uses the ``|a-b|^2 = |a|^2 + |b|^2 - 2ab`` expansion: the
-    cross term is one (n1, d) @ (d, n2) MXU matmul instead of an
-    (n1, n2, d) broadcast living in memory.
-    """
+def _sq_dist(x1, x2, lengthscale):
+    """Pairwise SQUARED scaled distance — the one ndim-dispatch +
+    validation + MXU-expansion implementation every kernel shares."""
     if x1.ndim != x2.ndim:
         raise ValueError(
             f"kernel inputs must have matching ndim, got {x1.ndim} and "
@@ -120,14 +116,27 @@ def _sqexp(x1, x2, variance, lengthscale):
                 "1-D inputs take a scalar lengthscale; a vector "
                 "lengthscale (ARD) needs (n, d) inputs"
             )
-        d2 = ((x1[:, None] - x2[None, :]) / ls) ** 2
-        return variance * jnp.exp(-0.5 * d2)
+        return ((x1[:, None] - x2[None, :]) / ls) ** 2
     s1 = x1 / lengthscale  # (n1, d) with (d,) or scalar lengthscale
     s2 = x2 / lengthscale
     sq1 = jnp.sum(s1**2, axis=1)
     sq2 = jnp.sum(s2**2, axis=1)
     d2 = sq1[:, None] + sq2[None, :] - 2.0 * (s1 @ s2.T)
-    return variance * jnp.exp(-0.5 * jnp.maximum(d2, 0.0))
+    return jnp.maximum(d2, 0.0)
+
+
+def _sqexp(x1, x2, variance, lengthscale):
+    """Squared-exponential kernel matrix, MXU-friendly distance form.
+
+    Inputs may be 1-D ``(n,)`` (scalar covariate, the demo shape) or
+    2-D ``(n, d)``; with 2-D inputs a ``(d,)`` ``lengthscale`` gives
+    ARD — one learned scale per input dimension, so irrelevant
+    covariates are pruned by their lengthscales growing.  The 2-D
+    branch uses the ``|a-b|^2 = |a|^2 + |b|^2 - 2ab`` expansion: the
+    cross term is one (n1, d) @ (d, n2) MXU matmul instead of an
+    (n1, n2, d) broadcast living in memory.
+    """
+    return variance * jnp.exp(-0.5 * _sq_dist(x1, x2, lengthscale))
 
 
 def _unpack(params):
@@ -136,6 +145,41 @@ def _unpack(params):
         jnp.exp(params["log_lengthscale"]),
         jnp.exp(params["log_noise"]),
     )
+
+
+def _scaled_dist(x1, x2, lengthscale):
+    """Pairwise scaled Euclidean distance (shared by the Matérn
+    kernels).  sqrt'(0) = inf, so the argument is nudged to keep
+    zero-distance gradients finite (kernel value error ~1e-6 * ls)."""
+    return jnp.sqrt(_sq_dist(x1, x2, lengthscale) + 1e-12)
+
+
+def _matern32(x1, x2, variance, lengthscale):
+    """Matérn 3/2: once-differentiable sample paths."""
+    r = jnp.sqrt(3.0) * _scaled_dist(x1, x2, lengthscale)
+    return variance * (1.0 + r) * jnp.exp(-r)
+
+
+def _matern52(x1, x2, variance, lengthscale):
+    """Matérn 5/2: twice-differentiable sample paths."""
+    r = jnp.sqrt(5.0) * _scaled_dist(x1, x2, lengthscale)
+    return variance * (1.0 + r + r**2 / 3.0) * jnp.exp(-r)
+
+
+_KERNELS = {
+    "sqexp": _sqexp,
+    "matern32": _matern32,
+    "matern52": _matern52,
+}
+
+
+def get_kernel(name: str):
+    """Kernel function by name: "sqexp", "matern32", "matern52"."""
+    if name not in _KERNELS:
+        raise ValueError(
+            f"unknown kernel {name!r}; choose from {sorted(_KERNELS)}"
+        )
+    return _KERNELS[name]
 
 
 class FederatedSparseGP:
@@ -160,12 +204,14 @@ class FederatedSparseGP:
         *,
         mesh: Optional[Mesh] = None,
         axis: str = SHARDS_AXIS,
+        kernel: str = "sqexp",
     ):
         self.inducing = jnp.asarray(inducing, jnp.float32)
         self.m = int(self.inducing.shape[0])
         self.mesh = mesh
         m = self.m
         z = self.inducing
+        kern = get_kernel(kernel)
 
         def per_shard_stats(params, shard):
             """Whitened statistics — float32-stable by construction.
@@ -180,11 +226,11 @@ class FederatedSparseGP:
             """
             (x, y), mask = shard
             variance, lengthscale, _ = _unpack(params)
-            kzz = _sqexp(z, z, variance, lengthscale) + _JITTER * variance * jnp.eye(m)
+            kzz = kern(z, z, variance, lengthscale) + _JITTER * variance * jnp.eye(m)
             l_kzz = jnp.linalg.cholesky(kzz)
             # Masked (padding) columns are zeroed, so the matmuls below
             # exclude them without any gather/ragged handling.
-            kzf = _sqexp(z, x, variance, lengthscale) * mask[None, :]
+            kzf = kern(z, x, variance, lengthscale) * mask[None, :]
             v = jax.scipy.linalg.solve_triangular(l_kzz, kzf, lower=True)
             a = v @ v.T
             b = v @ (y * mask)
@@ -255,13 +301,15 @@ class FederatedSparseGP:
     __call__ = logp
 
 
-def dense_vfe_logp(params, x, y, inducing):
+def dense_vfe_logp(params, x, y, inducing, kernel: str = "sqexp"):
     """Single-device dense VFE bound — golden-model ground truth.
 
     Computed directly from the textbook expression
     ``N(y | 0, Q + σ²I)`` with ``Q = K_fz K_zz^{-1} K_zf`` plus the
     ``-tr(K - Q)/(2σ²)`` VFE correction, using full n x n algebra.
+    ``kernel`` selects the same covariance as the sparse class.
     """
+    kern = get_kernel(kernel)
     variance, lengthscale, noise = _unpack(params)
     x = jnp.asarray(x, jnp.float32)
     y = jnp.asarray(y, jnp.float32)
@@ -269,8 +317,8 @@ def dense_vfe_logp(params, x, y, inducing):
     n = x.shape[0]
     m = z.shape[0]
     s2 = noise**2
-    kzz = _sqexp(z, z, variance, lengthscale) + _JITTER * variance * jnp.eye(m)
-    kzf = _sqexp(z, x, variance, lengthscale)
+    kzz = kern(z, z, variance, lengthscale) + _JITTER * variance * jnp.eye(m)
+    kzf = kern(z, x, variance, lengthscale)
     q = kzf.T @ jnp.linalg.solve(kzz, kzf)
     cov = q + s2 * jnp.eye(n)
     l = jnp.linalg.cholesky(cov)
@@ -286,8 +334,8 @@ class FederatedExactGP:
     """Exact GP marginal likelihood per shard, shared hyperparameters.
 
     Multi-site GP regression: each federated shard owns an independent
-    GP over its private ``(x, y)`` with the SAME squared-exponential
-    hyperparameters — the exact-inference counterpart of
+    GP over its private ``(x, y)`` with the SAME kernel (``kernel=``:
+    sqexp/matern32/matern52) and hyperparameters — the exact-inference counterpart of
     :class:`FederatedSparseGP` for shard sizes where an n x n Cholesky
     is affordable.  Per-shard compute is one batched ``(n, n)``
     Cholesky + triangular solves (vmapped over shards; the heaviest
@@ -307,14 +355,17 @@ class FederatedExactGP:
         *,
         mesh: Optional[Mesh] = None,
         axis: str = SHARDS_AXIS,
+        kernel: str = "sqexp",
     ):
         self.mesh = mesh
+        self._kern = get_kernel(kernel)
+        kern = self._kern
 
         def per_shard_logp(params, shard):
             (x, y), mask = shard
             variance, lengthscale, noise = _unpack(params)
             n = x.shape[0]
-            k = _masked_cov(x, mask, variance, lengthscale, noise)
+            k = _masked_cov(x, mask, variance, lengthscale, noise, kern)
             ym = y * mask
             l = jnp.linalg.cholesky(k)
             alpha = jax.scipy.linalg.cho_solve((l, True), ym)
@@ -361,8 +412,10 @@ class FederatedExactGP:
         xs = jnp.asarray(x_star, jnp.float32)
 
         def one(x_i, y_i, m_i):
-            k = _masked_cov(x_i, m_i, variance, lengthscale, noise)
-            ks = _sqexp(x_i, xs, variance, lengthscale) * m_i[:, None]
+            k = _masked_cov(
+                x_i, m_i, variance, lengthscale, noise, self._kern
+            )
+            ks = self._kern(x_i, xs, variance, lengthscale) * m_i[:, None]
             l = jnp.linalg.cholesky(k)
             alpha = jax.scipy.linalg.cho_solve((l, True), y_i * m_i)
             mean = ks.T @ alpha

@@ -279,3 +279,85 @@ def test_kernel_shape_mismatches_fail_loudly():
         _sqexp(jnp.zeros(5), jnp.zeros((5, 2)), 1.0, 1.0)
     with _pytest.raises(ValueError, match="scalar lengthscale"):
         _sqexp(jnp.zeros(4), jnp.zeros(3), 1.0, jnp.ones(3))
+
+
+class TestMaternKernels:
+    def test_matern_closed_forms(self):
+        from pytensor_federated_tpu.models.gp import _matern32, _matern52
+
+        x1 = jnp.asarray([0.0, 1.0])
+        x2 = jnp.asarray([0.0, 2.5])
+        r = np.abs(
+            np.asarray(x1)[:, None] - np.asarray(x2)[None, :]
+        ) / 0.7
+        for fn, nu_fn in (
+            (_matern32, lambda r: (1 + np.sqrt(3) * r) * np.exp(-np.sqrt(3) * r)),
+            (_matern52, lambda r: (1 + np.sqrt(5) * r + 5 * r**2 / 3)
+             * np.exp(-np.sqrt(5) * r)),
+        ):
+            k = np.asarray(fn(x1, x2, 2.0, 0.7))
+            np.testing.assert_allclose(k, 2.0 * nu_fn(r), rtol=1e-5)
+
+    def test_exact_gp_with_matern_fits(self):
+        from pytensor_federated_tpu.models.gp import FederatedExactGP
+        from pytensor_federated_tpu.parallel.packing import pack_shards
+
+        rng = np.random.default_rng(3)
+        shards = []
+        for _ in range(4):
+            x = np.sort(rng.uniform(-2, 2, size=30)).astype(np.float32)
+            y = (np.sin(2 * x) + 0.1 * rng.normal(size=30)).astype(np.float32)
+            shards.append((x, y))
+        packed = pack_shards(shards, pad_to_multiple=8)
+        m = FederatedExactGP(packed, kernel="matern52")
+        est = m.find_map()
+        # posterior with the SAME kernel must track the function
+        xs = jnp.linspace(-1.5, 1.5, 15)
+        mean, var = m.posterior(est, xs)
+        err = np.abs(
+            np.asarray(mean) - np.sin(2 * np.asarray(xs))[None, :]
+        ).mean()
+        assert err < 0.3
+        assert np.all(np.asarray(var) > -1e-4)
+
+    def test_unknown_kernel_fails_loudly(self):
+        import pytest as _pytest
+
+        from pytensor_federated_tpu.models.gp import get_kernel
+
+        with _pytest.raises(ValueError, match="unknown kernel"):
+            get_kernel("rbf")
+
+    def test_matern_zero_distance_gradients_finite(self):
+        from pytensor_federated_tpu.models.gp import _matern32
+
+        x = jnp.asarray([[0.5, 0.5], [0.5, 0.5]])  # duplicate points
+
+        def total(ls):
+            return jnp.sum(_matern32(x, x, 1.0, ls))
+
+        g = jax.grad(total)(jnp.ones(2))
+        assert np.all(np.isfinite(np.asarray(g)))
+
+
+def test_sparse_gp_matern_matches_dense_vfe():
+    from pytensor_federated_tpu.models.gp import (
+        FederatedSparseGP,
+        dense_vfe_logp,
+        generate_gp_data,
+    )
+
+    packed, pool = generate_gp_data(4, n_obs=32, seed=7)
+    inducing = np.linspace(-1.8, 1.8, 12).astype(np.float32)
+    m = FederatedSparseGP(packed, inducing, kernel="matern52")
+    params = {
+        "log_variance": jnp.asarray(0.2),
+        "log_lengthscale": jnp.asarray(-0.5),
+        "log_noise": jnp.asarray(-1.2),
+    }
+    golden = float(
+        dense_vfe_logp(
+            params, pool[0], pool[1], inducing, kernel="matern52"
+        )
+    )
+    np.testing.assert_allclose(float(m.logp(params)), golden, rtol=5e-4)
