@@ -35,6 +35,7 @@ from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 __all__ = [
     "xla_flops_per_eval",
@@ -71,6 +72,20 @@ def xla_flops_per_eval(fn, *args) -> Optional[float]:
     """
     try:
         cpu = jax.devices("cpu")[0]
+        # Lower on abstract shapes: jax.default_device only steers
+        # UNcommitted arrays, so a TPU-committed arg would drag .compile()
+        # onto the tunnel (20-40s remote compile) during a live capture —
+        # and device_put'ing it to CPU would pull its bytes through the
+        # tunnel instead.  ShapeDtypeStruct gives the identical count
+        # with zero data movement and zero tunnel contact.
+        args = jax.tree_util.tree_map(
+            lambda a: (
+                jax.ShapeDtypeStruct(a.shape, a.dtype)
+                if isinstance(a, (jax.Array, np.ndarray))
+                else a
+            ),
+            args,
+        )
         with jax.default_device(cpu):
             compiled = jax.jit(fn).lower(*args).compile()
             ca = compiled.cost_analysis()

@@ -100,11 +100,21 @@ def _gpd_fit(x: np.ndarray) -> tuple[float, float]:
     ``k = -ξ``; the sign flip happens at the return."""
     n = x.size
     prior_bs = 3.0
+    q25 = float(np.quantile(x, 0.25))
+    if not np.isfinite(q25) or q25 <= 1e-20:
+        # Tie-heavy exceedances (routine with duplicated Metropolis draws):
+        # >=25% of the tail sits at the cutoff, the quartile collapses to
+        # the clamp, bs explodes and log1p(-bs*x) goes NaN — and a NaN k
+        # silently PASSES the k > 0.7 bad-point check (NaN > 0.7 is
+        # False).  Flag the point unreliable instead.
+        return np.inf, np.nan
     m = 30 + int(np.sqrt(n))
     bs = 1.0 - np.sqrt(m / (np.arange(1, m + 1) - 0.5))
-    bs = bs / (prior_bs * np.quantile(x, 0.25)) + 1.0 / x[-1]
+    bs = bs / (prior_bs * q25) + 1.0 / x[-1]
     ks = -np.mean(np.log1p(-bs[:, None] * x[None, :]), axis=1)
     L = n * (np.log(bs / ks) + ks - 1.0)
+    if not np.all(np.isfinite(ks)) or not np.all(np.isfinite(L)):
+        return np.inf, np.nan
     # posterior weights w_j ∝ exp(L_j), computed as a stable softmax
     e = np.exp(L - L.max())
     w = e / e.sum()
@@ -130,6 +140,10 @@ def _psis_smooth_tail(log_ratios_i: np.ndarray) -> tuple[np.ndarray, float]:
     if not np.all(np.isfinite(exceed)) or exceed[-1] <= 0:
         return log_ratios_i, np.inf
     k, sigma = _gpd_fit(np.maximum(exceed, 1e-30))
+    if not (np.isfinite(k) and np.isfinite(sigma)):
+        # degenerate fit (see _gpd_fit guards): leave the ratios raw and
+        # report k = inf so psis_loo flags the point, never NaN-cascades
+        return log_ratios_i, np.inf
     # expected order statistics of the fitted gPd
     p = (np.arange(1, m + 1) - 0.5) / m
     if abs(k) < 1e-8:

@@ -143,6 +143,36 @@ def test_gpd_fit_recovers_known_shape():
         assert abs(sigma - 1.0) < 0.25
 
 
+def test_tie_heavy_tail_flags_instead_of_nan():
+    # Duplicated draws (routine under Metropolis rejection) put >=25% of
+    # the tail exceedances exactly at the cutoff; the Zhang-Stephens fit
+    # then divides by a ~0 quartile, bs explodes and log1p(-bs*x) goes
+    # NaN — and NaN pareto_k silently PASSES the k>0.7 check (NaN > 0.7
+    # is False).  The guard must return k=inf so the point is flagged
+    # and elpd_loo stays finite (round-2 advisor finding).
+    from pytensor_federated_tpu.samplers.model_comparison import (
+        _gpd_fit,
+        _psis_smooth_tail,
+    )
+
+    # exceedances clamped at the floor in the lower quartile, a few real
+    xi, sigma = _gpd_fit(
+        np.sort(np.concatenate([np.full(60, 1e-30), [0.5, 1.0, 2.0]]))
+    )
+    assert np.isinf(xi)
+
+    rng = np.random.default_rng(3)
+    y = rng.normal(1.0, 1.0, size=20)
+    ll = _draws_and_ll(y, n_draws=500, seed=4)
+    # Metropolis-style duplication: one point's ratios take only 3 values
+    ll[:, 0] = np.repeat([-0.3, -0.2, 2.5], [300, 195, 5])[:500]
+    smoothed, k = _psis_smooth_tail(np.ascontiguousarray(ll[:, 0]))
+    assert np.all(np.isfinite(smoothed))
+    res = psis_loo(ll)
+    assert np.isfinite(res["elpd_loo"])
+    assert not np.any(np.isnan(res["pareto_k"]))
+
+
 def test_pareto_k_flags_heavy_tails():
     # A point whose importance ratios are genuinely heavy-tailed must
     # produce a large k — the diagnostic must be able to fire (the

@@ -46,12 +46,20 @@ def main() -> int:
         action="store_true",
         help="run even if load average says the machine is busy",
     )
+    parser.add_argument(
+        "--probe-timeout-s",
+        type=float,
+        default=180.0,
+        help="liveness-probe timeout (size generously for a slow tunnel)",
+    )
     args = parser.parse_args()
 
     sys.path.insert(0, REPO)
     from pytensor_federated_tpu.utils import probe_backend
 
-    live, mosaic_ok = probe_backend(try_mosaic=args.try_mosaic)
+    live, mosaic_ok = probe_backend(
+        try_mosaic=args.try_mosaic, timeout_s=args.probe_timeout_s
+    )
     if not live:
         print("TPU NOT live (probe timed out) — not capturing.", file=sys.stderr)
         return 1
