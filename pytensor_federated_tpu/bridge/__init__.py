@@ -37,7 +37,13 @@ try:
         "ParallelFederatedOp",
         "federated_potential",
     ]
-except ModuleNotFoundError:  # pragma: no cover - exercised when pytensor absent
+except ModuleNotFoundError as e:
+    # Only a missing THIRD-PARTY dep may soft-disable the bridge.  A
+    # missing module of our own (e.g. a file dropped from a wheel) must
+    # stay loud — swallowing it here would silently stub out every Op
+    # in an environment where pytensor IS installed.
+    if e.name is not None and e.name.split(".")[0] == "pytensor_federated_tpu":
+        raise
     HAS_PYTENSOR = False
     __all__ = ["HAS_PYTENSOR"]
 

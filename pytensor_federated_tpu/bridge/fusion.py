@@ -37,15 +37,13 @@ the original applies for CSE/merge) and after the inplace passes
 
 from __future__ import annotations
 
-import threading
-from concurrent.futures import ThreadPoolExecutor
-
 from pytensor.compile import optdb
 from pytensor.graph.basic import Apply
 from pytensor.graph.features import ReplaceValidate
 from pytensor.graph.op import Op
 from pytensor.graph.rewriting.basic import GraphRewriter
 
+from .fanout_exec import MemberExecutorPool, run_members
 from .grouping import group_independent
 from .pytensor_ops import (
     FederatedArraysToArraysOp,
@@ -109,69 +107,48 @@ class ParallelFederatedOp(Op):
         return nodes
 
     def __getstate__(self):
-        # Template applies reference graph variables, and executors are
-        # not picklable; both rebuild lazily on the other side.
+        # Template applies reference graph variables, and executor pools
+        # are not picklable; both rebuild lazily on the other side.
         state = self.__dict__.copy()
         state.pop("_member_nodes", None)
-        state.pop("_executors", None)
-        state.pop("_exec_lock_obj", None)
+        state.pop("_pool", None)
         return state
 
-    def _member_executors(self):
-        # One PERSISTENT single-thread executor per member (the
-        # ops/fanout.py pattern): gRPC/asyncio client state caches per
-        # (token, pid, thread, loop) (service/client.py), so member i
-        # must land on the same thread every evaluation or each call
-        # re-dials its channels.
-        execs = getattr(self, "_executors", None)
-        if execs is None:
-            with self._exec_lock:
-                execs = getattr(self, "_executors", None)
-                if execs is None:
-                    execs = [
-                        ThreadPoolExecutor(
-                            max_workers=1,
-                            thread_name_prefix=f"pft-fused-{i}",
-                        )
-                        for i in range(len(self.members))
-                    ]
-                    self._executors = execs
-        return execs
-
-    @property
-    def _exec_lock(self):
-        lock = getattr(self, "_exec_lock_obj", None)
-        if lock is None:
-            lock = self.__dict__.setdefault(
-                "_exec_lock_obj", threading.Lock()
+    def _member_pool(self) -> MemberExecutorPool:
+        # One PERSISTENT single-thread executor per member, shut down by
+        # weakref.finalize when this op is collected (fanout_exec docs
+        # explain the thread-pinning requirement).  Creation is lazy and
+        # the pool's own lock makes the executor bring-up race-free; the
+        # attribute write below is GIL-atomic, and a lost race merely
+        # creates a pool whose threads start lazily too, so no leak.
+        pool = getattr(self, "_pool", None)
+        if pool is None:
+            # setdefault is GIL-atomic: concurrent first calls agree on
+            # one pool, preserving the thread-pinning contract; a losing
+            # pool never starts threads (they are lazy) so nothing leaks.
+            pool = self.__dict__.setdefault(
+                "_pool", MemberExecutorPool(len(self.members))
             )
-        return lock
+        return pool
 
     def perform(self, node, inputs, output_storage):
+        # The scheduling contract (pinned threads, max-not-sum wall
+        # clock, settle-all-then-raise-first, storage slicing) lives in
+        # the pure, pytensor-free fanout_exec.run_members, where it is
+        # tested directly (tests/test_fanout_exec.py).
         templates = self._templates(node)
-        execs = self._member_executors()
-
-        def make_run(idx):
-            def run():
-                op = self.members[idx]
-                lo = sum(self.in_counts[:idx])
-                sub_in = inputs[lo : lo + self.in_counts[idx]]
-                olo = sum(self.out_counts[:idx])
-                sub_storage = output_storage[olo : olo + self.out_counts[idx]]
-                op.perform(templates[idx], sub_in, sub_storage)
-
-            return run
-
-        futures = [
-            execs[i].submit(make_run(i)) for i in range(len(self.members))
+        member_fns = [
+            (lambda sub_in, sub_st, op=op, t=t: op.perform(t, sub_in, sub_st))
+            for op, t in zip(self.members, templates)
         ]
-        # Surface the FIRST member failure loudly (fail-loud contract,
-        # CLAUDE.md wire-format invariant) after all members settle —
-        # cancelling mid-flight would leave sibling storages half-set.
-        errs = [f.exception() for f in futures]
-        for e in errs:
-            if e is not None:
-                raise e
+        run_members(
+            member_fns,
+            self.in_counts,
+            self.out_counts,
+            inputs,
+            output_storage,
+            self._member_pool(),
+        )
 
 
 class FederatedFusionRewriter(GraphRewriter):

@@ -1,0 +1,253 @@
+"""Direct tests of the fused-apply scheduling core (no pytensor).
+
+VERDICT r2 item 5a: the parts of ``ParallelFederatedOp.perform`` most
+likely to be wrong — threading, error propagation, storage slicing —
+must be testable without pytensor.  ``bridge/fanout_exec.py`` is that
+extraction; these tests pin its contracts (which mirror the reference's
+``ParallelAsyncOp.perform``, reference: op_async.py:107-132, and its
+wall-clock overlap proof, reference: test_op_async.py:75-106).
+"""
+
+import gc
+import threading
+import time
+
+import pytest
+
+from pytensor_federated_tpu.bridge.fanout_exec import (
+    MemberExecutorPool,
+    member_spans,
+    run_members,
+)
+
+
+def _writer(value):
+    def fn(sub_in, sub_storage):
+        for j, cell in enumerate(sub_storage):
+            cell[0] = (value, j, list(sub_in))
+
+    return fn
+
+
+def _storage(n):
+    return [[None] for _ in range(n)]
+
+
+def test_member_spans():
+    assert member_spans([2, 1, 3]) == [(0, 2), (2, 3), (3, 6)]
+    assert member_spans([]) == []
+
+
+def test_slicing_routes_inputs_and_storage():
+    # members with ragged in/out arity: slicing must route member i's
+    # inputs and land its writes in exactly its own storage cells.
+    pool = MemberExecutorPool(3)
+    inputs = ["a", "b", "c", "d"]  # member arities 2, 1, 1
+    storage = _storage(4)  # member out arities 1, 2, 1
+    run_members(
+        [_writer("m0"), _writer("m1"), _writer("m2")],
+        [2, 1, 1],
+        [1, 2, 1],
+        inputs,
+        storage,
+        pool,
+    )
+    assert storage[0][0] == ("m0", 0, ["a", "b"])
+    assert storage[1][0] == ("m1", 0, ["c"])
+    assert storage[2][0] == ("m1", 1, ["c"])
+    assert storage[3][0] == ("m2", 0, ["d"])
+
+
+def test_arity_mismatches_raise():
+    pool = MemberExecutorPool(1)
+    with pytest.raises(ValueError, match="arity mismatch"):
+        run_members([_writer(0)], [1, 1], [1], ["x"], _storage(1), pool)
+    with pytest.raises(ValueError, match="consume"):
+        run_members([_writer(0)], [2], [1], ["x"], _storage(1), pool)
+    with pytest.raises(ValueError, match="storage has"):
+        run_members([_writer(0)], [1], [2], ["x"], _storage(1), pool)
+
+
+def test_members_overlap_not_sum():
+    # Two 0.3 s members must take ~max not ~sum: the latency-hiding
+    # contract the reference proves at test_op_async.py:98-105.
+    pool = MemberExecutorPool(2)
+
+    def sleeper(sub_in, sub_storage):
+        time.sleep(0.3)
+        sub_storage[0][0] = "done"
+
+    t0 = time.perf_counter()
+    run_members([sleeper, sleeper], [0, 0], [1, 1], [], _storage(2), pool)
+    wall = time.perf_counter() - t0
+    assert wall < 0.55, wall  # sum would be >= 0.6
+    assert wall >= 0.3
+
+
+def test_member_thread_pinning():
+    # member i must see the SAME thread every evaluation (client caches
+    # key on thread identity), and distinct members distinct threads.
+    pool = MemberExecutorPool(2)
+    seen = {0: set(), 1: set()}
+
+    def make(idx):
+        def fn(sub_in, sub_storage):
+            seen[idx].add(threading.get_ident())
+            sub_storage[0][0] = idx
+
+        return fn
+
+    for _ in range(5):
+        run_members(
+            [make(0), make(1)], [0, 0], [1, 1], [], _storage(2), pool
+        )
+    assert len(seen[0]) == 1
+    assert len(seen[1]) == 1
+    assert seen[0] != seen[1]
+
+
+def test_first_error_raised_after_all_settle():
+    # Member 1 fails fast, member 2 fails slow, member 0 is slow+ok: the
+    # FIRST (member-order) failure is raised, and every member settled
+    # first — no half-set sibling storage.
+    pool = MemberExecutorPool(3)
+    settled = []
+
+    def ok_slow(sub_in, sub_storage):
+        time.sleep(0.25)
+        sub_storage[0][0] = "ok"
+        settled.append("ok_slow")
+
+    def boom_fast(sub_in, sub_storage):
+        settled.append("boom_fast")
+        raise RuntimeError("member-1 failure")
+
+    def boom_slow(sub_in, sub_storage):
+        time.sleep(0.15)
+        settled.append("boom_slow")
+        raise ValueError("member-2 failure")
+
+    storage = _storage(3)
+    with pytest.raises(RuntimeError, match="member-1 failure"):
+        run_members(
+            [ok_slow, boom_fast, boom_slow],
+            [0, 0, 0],
+            [1, 1, 1],
+            [],
+            storage,
+            pool,
+        )
+    assert sorted(settled) == ["boom_fast", "boom_slow", "ok_slow"]
+    assert storage[0][0] == "ok"  # the healthy member's write survived
+
+
+def test_rebinding_storage_cell_is_loud():
+    # The pytensor convention is cell[0] = value; a member REBINDING the
+    # cell would silently lose its output through the slice aliasing —
+    # the runner must turn that into a loud error.
+    pool = MemberExecutorPool(1)
+
+    def rebinder(sub_in, sub_storage):
+        sub_storage[0] = ["lost"]
+
+    with pytest.raises(RuntimeError, match="rebound storage cell"):
+        run_members([rebinder], [0], [1], [], _storage(1), pool)
+
+
+def test_pool_finalizer_stops_threads():
+    # Round-2 advisor finding: persistent executors leaked threads for
+    # the process lifetime.  The pool must shut its threads down when
+    # collected (weakref.finalize) and on explicit shutdown().
+    pool = MemberExecutorPool(2, name="pft-finalize-test")
+    run_members(
+        [_writer(0), _writer(1)], [0, 0], [1, 1], [], _storage(2), pool
+    )
+    assert pool.alive
+
+    def our_threads():
+        return [
+            t
+            for t in threading.enumerate()
+            if t.name.startswith("pft-finalize-test")
+        ]
+
+    assert len(our_threads()) == 2
+    del pool
+    gc.collect()
+    deadline = time.time() + 5.0
+    while our_threads() and time.time() < deadline:
+        time.sleep(0.01)
+    assert not our_threads()
+
+    # explicit shutdown is idempotent and also stops threads
+    pool2 = MemberExecutorPool(1, name="pft-finalize-test")
+    pool2.submit(0, lambda: None).result()
+    pool2.shutdown()
+    pool2.shutdown()
+    assert not pool2.alive
+    deadline = time.time() + 5.0
+    while our_threads() and time.time() < deadline:
+        time.sleep(0.01)
+    assert not our_threads()
+
+
+def test_import_gate_only_swallows_third_party_loss():
+    # Only a missing THIRD-PARTY dep (pytensor/pymc) may soft-disable
+    # the bridge; losing one of our OWN modules (file dropped from a
+    # wheel) must stay loud — otherwise a packaging mistake silently
+    # stubs out every Op even where pytensor IS installed.
+    import subprocess
+    import sys
+
+    code = """
+import sys, builtins
+orig = builtins.__import__
+def fake(name, *a, **k):
+    if name.endswith('pytensor_ops') or name == 'pytensor':
+        raise ModuleNotFoundError(
+            "No module named %r" % (RAISE_NAME,), name=RAISE_NAME)
+    return orig(name, *a, **k)
+builtins.__import__ = fake
+try:
+    import pytensor_federated_tpu.bridge as b
+    print('SOFT', b.HAS_PYTENSOR)
+except ModuleNotFoundError as e:
+    print('RAISED', e.name)
+"""
+    def run(raise_name):
+        return subprocess.run(
+            [sys.executable, "-c",
+             f"RAISE_NAME = {raise_name!r}\n" + code],
+            capture_output=True,
+            text=True,
+            check=True,
+        ).stdout.strip()
+
+    assert run("pytensor") == "SOFT False"
+    own = "pytensor_federated_tpu.bridge.pytensor_ops"
+    assert run(own) == f"RAISED {own}"
+
+
+def test_import_guard_without_pytensor():
+    # VERDICT r2 item 5c: the package must import cleanly without
+    # pytensor and the bridge must raise a HELPFUL error, not an
+    # AttributeError or a deep traceback.  (In an env WITH pytensor the
+    # second half is vacuous; the xfail-style gate keeps it honest.)
+    import pytensor_federated_tpu  # noqa: F401  (must not raise)
+    from pytensor_federated_tpu import bridge
+
+    try:
+        import pytensor  # noqa: F401
+
+        has_pt = True
+    except ModuleNotFoundError:
+        has_pt = False
+
+    assert bridge.HAS_PYTENSOR is has_pt
+    if not has_pt:
+        with pytest.raises(ImportError, match="pytensor"):
+            bridge.FederatedLogpOp
+        with pytest.raises(ImportError, match="extra"):
+            bridge.ParallelFederatedOp
+        with pytest.raises(AttributeError):
+            bridge.not_a_real_name

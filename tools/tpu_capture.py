@@ -31,6 +31,18 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# Single source of truth for this script's exit codes (tools/tpu_poll.py
+# imports this to label its attempt log; keep `return` sites in sync).
+EXIT_MEANINGS = {
+    0: "OK — artifacts captured with backend: tpu",
+    1: "DEAD (probe timed out)",
+    2: "LIVE but machine busy — not capturing",
+    3: "bench.py printed no JSON line",
+    4: "bench ran on non-tpu backend (re-wedge?)",
+    5: "bench_suite.py failed",
+    6: "suite backends not all-tpu (re-wedge mid-capture?)",
+}
+
 
 def machine_busy(threshold: float = 1.0) -> bool:
     load1 = os.getloadavg()[0]

@@ -3,10 +3,13 @@
 VERDICT r2 item 7: the chip was wedged for two full rounds and a manual
 "run it when live" step keeps missing the window.  This script is the
 automation: every invocation appends one line to
-``tools/capture_attempts.log`` recording the probe outcome, and — on the
-first live window with an idle machine — runs
-``tools/tpu_capture.py --try-mosaic`` (which re-probes, refuses a busy
-machine, and verifies the artifacts really say ``backend: tpu``).
+``tools/capture_attempts.log`` (git-tracked on purpose — it IS the
+"log showing attempts" evidence the verdict asks for), and — on the
+first live window with an idle machine — runs ``tools/tpu_capture.py``
+(which probes liveness itself, refuses a busy machine, and verifies the
+artifacts really say ``backend: tpu``).  Compiled Mosaic, the suspected
+relay-wedge trigger (CLAUDE.md), only runs AFTER a successful plain
+capture, as a bench-only second pass (``--no-mosaic-after`` disables).
 
 Safe by construction (CLAUDE.md wedge policy):
 
@@ -45,26 +48,26 @@ def _log(line: str) -> None:
         fh.write(entry + "\n")
 
 
-# tpu_capture.py's exit codes, for legible attempt logs.
-_CAPTURE_EXITS = {
-    0: "OK — artifacts captured with backend: tpu",
-    1: "DEAD (probe timed out)",
-    2: "LIVE but machine busy — not capturing",
-    3: "bench.py printed no JSON line",
-    4: "bench ran on non-tpu backend (re-wedge?)",
-    5: "bench_suite.py failed",
-    6: "suite backends not all-tpu (re-wedge mid-capture?)",
-}
-
-
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--dry-run", action="store_true")
     ap.add_argument("--timeout-s", type=float, default=150.0)
+    ap.add_argument(
+        "--mosaic-after",
+        action=argparse.BooleanOptionalAction,
+        default=True,
+        help="after a SUCCESSFUL plain capture, re-run bench.py once "
+        "with compiled Mosaic probed (--try-mosaic --skip-suite) to "
+        "settle the Pallas question; never on the first pass — Mosaic "
+        "is the suspected wedge trigger, so the safe artifacts land "
+        "before the experiment runs",
+    )
     args = ap.parse_args(argv)
 
+    sys.path.insert(0, REPO)
+    from tpu_capture import EXIT_MEANINGS  # sibling module, single source
+
     if args.dry_run:
-        sys.path.insert(0, REPO)
         from pytensor_federated_tpu.utils import probe_backend
 
         live, _ = probe_backend(timeout_s=args.timeout_s)
@@ -74,15 +77,41 @@ def main(argv: list[str] | None = None) -> int:
     # One probe total: tpu_capture does its own liveness/busy preflight,
     # so the poller just invokes it and logs the outcome (a poll-side
     # probe would dial the tunnel a second time for no information).
-    # No timeout on purpose — see module docstring.
+    # No timeout on purpose — see module docstring.  Compiled Mosaic is
+    # deliberately NOT probed here: CLAUDE.md marks it a suspected relay
+    # wedge trigger, so the unattended path secures the plain artifacts
+    # first and only then (below) runs the Mosaic experiment.
+    capture = os.path.join(REPO, "tools", "tpu_capture.py")
     res = subprocess.run(
-        [sys.executable, os.path.join(REPO, "tools", "tpu_capture.py"),
-         "--try-mosaic", "--probe-timeout-s", str(args.timeout_s)],
+        [sys.executable, capture, "--probe-timeout-s", str(args.timeout_s)],
         cwd=REPO,
     )
-    why = _CAPTURE_EXITS.get(res.returncode, "unknown failure")
+    why = EXIT_MEANINGS.get(res.returncode, "unknown failure")
     _log(f"capture attempt: exit={res.returncode} ({why})")
-    return res.returncode
+    if res.returncode != 0 or not args.mosaic_after:
+        return res.returncode
+
+    # Artifacts are safe on disk — now settle VERDICT item 2 (Pallas
+    # compiled-Mosaic: win, lose, or wedge) with the bench-only pass.
+    _log("mosaic settle: starting tpu_capture.py --try-mosaic --skip-suite")
+    mres = subprocess.run(
+        [sys.executable, capture, "--try-mosaic", "--skip-suite",
+         "--probe-timeout-s", str(args.timeout_s)],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+    )
+    out_path = os.path.join(REPO, "tools", "mosaic_settle.out")
+    with open(out_path, "w", encoding="utf-8") as fh:
+        fh.write(mres.stdout)
+        fh.write("\n--- stderr ---\n")
+        fh.write(mres.stderr)
+    _log(
+        f"mosaic settle: exit={mres.returncode} "
+        f"({EXIT_MEANINGS.get(mres.returncode, 'unknown failure')}); "
+        f"output -> {os.path.relpath(out_path, REPO)}"
+    )
+    return 0  # plain capture succeeded; the settle result is advisory
 
 
 if __name__ == "__main__":
