@@ -43,7 +43,7 @@ from pytensor.graph.features import ReplaceValidate
 from pytensor.graph.op import Op
 from pytensor.graph.rewriting.basic import GraphRewriter
 
-from .fanout_exec import MemberExecutorPool, run_members
+from ..fanout_exec import MemberExecutorPool, run_members
 from .grouping import group_independent
 from .pytensor_ops import (
     FederatedArraysToArraysOp,

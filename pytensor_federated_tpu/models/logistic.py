@@ -136,9 +136,47 @@ class FederatedLogisticRegression:
     #: (full compression is impossible: softplus still needs raw X).
     #: Exact same posterior; equality-tested.
     use_suffstats: bool = False
+    #: collapse the shard axis at build time: with shared ``(w, b)`` the
+    #: likelihood is invariant to which shard a row lives in, so the S
+    #: batched ``(n, d)`` matvecs become ONE ``(S*n, d)`` matvec + one
+    #: flat softplus reduction — a single fused loop instead of a
+    #: batched one (measurably faster on small shards, where the batch
+    #: dimension defeats fusion).  Single-program only: requires
+    #: ``mesh=None`` (an SPMD run needs the shard axis to shard over).
+    #: Exact same posterior; raced behind the bench equality gate.
+    flatten: bool = False
 
     def __post_init__(self):
-        if self.use_suffstats:
+        if self.flatten:
+            if self.mesh is not None:
+                raise ValueError(
+                    "flatten=True collapses the shard axis and cannot "
+                    "be sharded over a mesh; use use_suffstats instead"
+                )
+            if self.use_suffstats:
+                raise ValueError(
+                    "flatten=True and use_suffstats=True are distinct "
+                    "implementations of the same posterior — pick one "
+                    "(flatten already folds the suffstats terms)"
+                )
+            (X, y), mask = self.data.tree()
+            d = X.shape[-1]
+            Xf = jnp.reshape(X, (-1, d))
+            mf = jnp.reshape(mask, (-1,))
+            ymf = jnp.reshape(y, (-1,)) * mf
+            syx = ymf @ Xf  # (d,), build-time constant
+            sy = jnp.sum(ymf)
+
+            def flat_loglik(params):
+                logits = linear_predictor(
+                    Xf, params["w"], params["b"], self.compute_dtype
+                )
+                sp = jnp.sum(jnp.logaddexp(0.0, logits) * mf)
+                return syx @ params["w"] + sy * params["b"] - sp
+
+            self._loglik = flat_loglik
+            self.fed = None
+        elif self.use_suffstats:
             (X, y), mask = self.data.tree()
             ym = y * mask
             syx = jnp.einsum("snd,sn->sd", X, ym)  # (S, D), build-time
@@ -156,6 +194,7 @@ class FederatedLogisticRegression:
                 return syx @ params["w"] + sy * params["b"] - sp
 
             self.fed = FederatedLogp(per_shard_logp, tree, mesh=self.mesh)
+            self._loglik = self.fed.logp
         else:
 
             def per_shard_logp(params, shard):
@@ -170,6 +209,7 @@ class FederatedLogisticRegression:
             self.fed = FederatedLogp(
                 per_shard_logp, self.data.tree(), mesh=self.mesh
             )
+            self._loglik = self.fed.logp
         self.n_features = jax.tree_util.tree_leaves(self.data.data)[0].shape[-1]
 
     def prior_logp(self, params: Any) -> jax.Array:
@@ -178,7 +218,7 @@ class FederatedLogisticRegression:
         return lp
 
     def logp(self, params: Any) -> jax.Array:
-        return self.prior_logp(params) + self.fed.logp(params)
+        return self.prior_logp(params) + self._loglik(params)
 
     def logp_and_grad(self, params: Any):
         return jax.value_and_grad(self.logp)(params)

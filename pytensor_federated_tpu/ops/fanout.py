@@ -26,13 +26,13 @@ proves by timing (reference: test_op_async.py:98-105, 180-194).
 
 from __future__ import annotations
 
-from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..fanout_exec import MemberExecutorPool
 from ..signatures import Array, ArraysSpec
 
 
@@ -81,11 +81,13 @@ def parallel_host_call(
     # run node i on a different thread each call (N x N connections); a
     # fresh pool per call would recycle thread idents, handing a new
     # thread a cached channel bound to a dead thread's event loop.
-    executors = [ThreadPoolExecutor(max_workers=1) for _ in host_fns]
+    # MemberExecutorPool adds lazy creation + GC finalization, so a
+    # dropped callable cannot leak its threads for the process lifetime
+    # (the round-2 advisor finding on fusion.py applied here too).
+    pool = MemberExecutorPool(len(host_fns), name="pft-fanout")
 
     def close():
-        for ex in executors:
-            ex.shutdown(wait=False)
+        pool.shutdown()
 
     def fn(*args_per_child) -> List[List[Array]]:
         if len(args_per_child) != len(host_fns):
@@ -104,8 +106,8 @@ def parallel_host_call(
                 chunks.append(flat_arrays[i : i + k])
                 i += k
             futures = [
-                ex.submit(lambda f=f, c=c: list(f(*c)))
-                for ex, f, c in zip(executors, host_fns, chunks)
+                pool.submit(i, lambda f=f, c=c: list(f(*c)))
+                for i, (f, c) in enumerate(zip(host_fns, chunks))
             ]
             results = [fut.result() for fut in futures]
             flat = [

@@ -96,12 +96,22 @@ def chees_sample(
     jitter: float = 1.0,
     max_leapfrogs: int = 1024,
     logp_and_grad_fn: Optional[Callable] = None,
+    chain_sharding: Optional[Any] = None,
 ) -> SampleResult:
     """Cross-chain adaptive HMC; more chains = better adaptation.
 
     ``max_leapfrogs`` bounds the per-iteration trajectory (the scan is
     masked beyond the active length, so the bound costs nothing when
-    the adapted length is short)."""
+    the adapted length is short).
+
+    ``chain_sharding`` (a ``NamedSharding`` whose spec partitions the
+    leading axis, e.g. ``NamedSharding(mesh, P("chains"))``) places the
+    chain batch across a device mesh.  Computation follows sharding:
+    the per-chain transitions run data-parallel on their devices and
+    the cross-chain adaptation reductions (mean accept-stat, ChEES
+    gradient, cross-chain variance mass) lower to XLA collectives over
+    the mesh — the lockstep design needs no other change to scale past
+    one device.  ``num_chains`` must be divisible by the mesh axis."""
     flat_logp, flat_init, unravel, lg = make_flat_logp_and_grad(
         logp_fn, init_params, logp_and_grad_fn
     )
@@ -113,6 +123,17 @@ def chees_sample(
     x0 = flat_init[None, :] + jitter * jax.random.normal(
         k_init, (C, dim), dtype
     )
+    if chain_sharding is not None:
+        try:
+            chain_sharding.shard_shape((C, dim))
+        except Exception as e:
+            raise ValueError(
+                f"num_chains={C} is not shardable by chain_sharding="
+                f"{chain_sharding}: {e} — num_chains must be divisible "
+                "by the mesh axis the spec partitions the leading "
+                "(chains) dimension over"
+            ) from None
+        x0 = jax.device_put(x0, chain_sharding)
     logp0, grad0 = jax.vmap(lg)(x0)
 
     def one_iteration(x, logp, grad, inv_mass, step_size, traj_len, it, key):

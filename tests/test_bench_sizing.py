@@ -86,3 +86,30 @@ def test_unroll_numerics_identical():
         b = c8(x0, jnp.asarray(n, jnp.int32))
         np.testing.assert_array_equal(np.asarray(a[0]), np.asarray(b[0]))
         np.testing.assert_array_equal(np.asarray(a[1]), np.asarray(b[1]))
+
+
+def test_measure_rate_rejects_nan_chain():
+    # A NaN-producing eval degenerates the chain into a constant loop
+    # (round-3: the first live TPU capture recorded 6.8e11 "evals/s");
+    # measure_rate must refuse loudly, not produce a number.
+    import pytest
+
+    def bad(x):
+        return jnp.nan * jnp.sum(x), x * jnp.nan
+
+    chained = make_chained(bad)
+    with pytest.raises(RuntimeError, match="degenerate"):
+        measure_rate(chained, jnp.ones((4,)), n_cal=10, floor=20,
+                     mid_wall=0.01, target_wall=0.02)
+
+
+def test_measure_rate_rejects_zero_gradient_chain():
+    import pytest
+
+    def frozen(x):
+        return jnp.sum(x), jnp.zeros_like(x)
+
+    chained = make_chained(frozen)
+    with pytest.raises(RuntimeError, match="degenerate"):
+        measure_rate(chained, jnp.ones((4,)), n_cal=10, floor=20,
+                     mid_wall=0.01, target_wall=0.02)

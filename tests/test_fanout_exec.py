@@ -14,7 +14,7 @@ import time
 
 import pytest
 
-from pytensor_federated_tpu.bridge.fanout_exec import (
+from pytensor_federated_tpu.fanout_exec import (
     MemberExecutorPool,
     member_spans,
     run_members,
@@ -251,3 +251,12 @@ def test_import_guard_without_pytensor():
             bridge.ParallelFederatedOp
         with pytest.raises(AttributeError):
             bridge.not_a_real_name
+
+
+def test_pool_shutdown_before_use_stays_shut():
+    # shutdown() before lazy creation must not be a silent no-op that a
+    # later submit resurrects (round-3 review): closed means closed.
+    pool = MemberExecutorPool(2)
+    pool.shutdown()
+    with pytest.raises(RuntimeError, match="shut down"):
+        pool.submit(0, lambda: 1)

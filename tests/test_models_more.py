@@ -207,3 +207,71 @@ class TestLogisticSuffstats:
         np.testing.assert_allclose(
             float(base.logp(p)), float(fast.logp(p)), rtol=5e-4
         )
+
+    def test_flatten_equality(self):
+        """flatten=True collapses the shard axis into one matvec; the
+        posterior (logp AND grads) must be exactly the vmapped one."""
+        from pytensor_federated_tpu.models.logistic import (
+            FederatedLogisticRegression,
+            generate_logistic_data,
+        )
+
+        data, _ = generate_logistic_data(n_shards=8, n_obs=48, n_features=5)
+        base = FederatedLogisticRegression(data)
+        flat = FederatedLogisticRegression(data, flatten=True)
+        for shift in (0.0, 0.3):
+            p = jax.tree_util.tree_map(
+                lambda a: a + shift, base.init_params()
+            )
+            np.testing.assert_allclose(
+                float(base.logp(p)), float(flat.logp(p)), rtol=2e-4
+            )
+            _, g1 = base.logp_and_grad(p)
+            _, g2 = flat.logp_and_grad(p)
+            for k in g1:
+                np.testing.assert_allclose(
+                    np.asarray(g1[k]), np.asarray(g2[k]),
+                    rtol=2e-3, atol=1e-3,
+                )
+
+    def test_flatten_respects_padding_mask(self):
+        """Ragged shards: flatten must drop padded rows exactly like the
+        masked vmapped path does."""
+        from pytensor_federated_tpu.models.logistic import (
+            FederatedLogisticRegression,
+        )
+        from pytensor_federated_tpu.parallel.packing import pack_shards
+
+        rng = np.random.default_rng(5)
+        shards = []
+        for n in (7, 12, 3):
+            X = rng.normal(size=(n, 4)).astype(np.float32)
+            y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+            shards.append((X, y))
+        data = pack_shards(shards)
+        base = FederatedLogisticRegression(data)
+        flat = FederatedLogisticRegression(data, flatten=True)
+        p = jax.tree_util.tree_map(lambda a: a + 0.2, base.init_params())
+        np.testing.assert_allclose(
+            float(base.logp(p)), float(flat.logp(p)), rtol=2e-4
+        )
+        _, g1 = base.logp_and_grad(p)
+        _, g2 = flat.logp_and_grad(p)
+        for k in g1:
+            np.testing.assert_allclose(
+                np.asarray(g1[k]), np.asarray(g2[k]), rtol=2e-3, atol=1e-3
+            )
+
+    def test_flatten_rejects_mesh(self, devices8):
+        import pytest
+
+        from pytensor_federated_tpu.models.logistic import (
+            FederatedLogisticRegression,
+            generate_logistic_data,
+        )
+        from pytensor_federated_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh({"shards": 8}, devices=devices8)
+        data, _ = generate_logistic_data(n_shards=8, n_obs=16, n_features=3)
+        with pytest.raises(ValueError, match="flatten"):
+            FederatedLogisticRegression(data, mesh=mesh, flatten=True)

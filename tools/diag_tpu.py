@@ -1,0 +1,153 @@
+"""One-shot TPU numerics/perf diagnostic (round 3).
+
+Evidence-gathering for three TPU-only anomalies in the first live
+capture (tools/capture_attempts.log 2026-07-31T03:56:42Z, exit=5):
+
+1. BENCH_SUITE config 6 (parallel-in-time Kalman) recorded an
+   impossible 6.8e11 evals/s — hypothesis: default-precision f32
+   matmuls on TPU degrade the scan compositions until the chain state
+   degenerates (NaN or zero gradient), letting XLA hoist the eval out
+   of the timing loop.
+2. The suite then died (exit 1) — hypothesis: config 7's bf16-vs-f32
+   equality gate fails because the "f32" reference itself ran at
+   reduced matmul precision.
+3. Config 4 (Lotka-Volterra ODE) fell from 62k evals/s (CPU) to 181
+   (TPU) — sequential integrator latency; measure the per-eval wall to
+   size the fix.
+
+Run on a LIVE chip only, to completion (killing a process mid-TPU-call
+wedges the relay, CLAUDE.md): ``python tools/diag_tpu.py > out 2>&1``.
+"""
+
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    print(f"backend={jax.default_backend()} kind={dev.device_kind}",
+          flush=True)
+
+    # --- 1. what does a default-precision f32 matmul actually do? ----
+    rng = np.random.default_rng(0)
+    A = rng.normal(size=(2048, 512)).astype(np.float32)
+    w = rng.normal(size=(512,)).astype(np.float32)
+    ref = A.astype(np.float64) @ w.astype(np.float64)
+
+    for prec in ("default", "highest"):
+        with jax.default_matmul_precision(prec):
+            out = jax.jit(lambda a, b: a @ b)(jnp.asarray(A), jnp.asarray(w))
+        err = np.max(
+            np.abs(np.asarray(out, np.float64) - ref) / np.abs(ref)
+        )
+        print(f"f32 matmul precision={prec}: max relerr {err:.3e}",
+              flush=True)
+
+    # --- 2. parallel Kalman: finiteness + honest single-eval wall ----
+    import sys
+
+    sys.path.insert(0, "/root/repo")
+    from jax.flatten_util import ravel_pytree
+
+    from pytensor_federated_tpu.models.statespace import (
+        generate_lgssm_data,
+        kalman_logp_parallel,
+    )
+
+    y_ss, p_ss = generate_lgssm_data(T=4096)
+    flat0, unravel = ravel_pytree(p_ss)
+
+    for prec in ("default", "highest"):
+        with jax.default_matmul_precision(prec):
+            fn = jax.jit(
+                lambda x: jax.value_and_grad(
+                    lambda v: kalman_logp_parallel(unravel(v), y_ss)
+                )(x)
+            )
+            v, g = fn(flat0)
+            jax.block_until_ready(g)
+            t0 = time.perf_counter()
+            for _ in range(5):
+                v, g = fn(flat0)
+            jax.block_until_ready(g)
+            wall = (time.perf_counter() - t0) / 5
+        g = np.asarray(g)
+        print(
+            f"kalman_parallel precision={prec}: v={float(v):.6g} "
+            f"grad_finite={np.isfinite(g).all()} "
+            f"grad_absmax={np.abs(g).max():.3g} wall={wall * 1e3:.2f}ms",
+            flush=True,
+        )
+
+    # --- 3. LV ODE per-eval wall -------------------------------------
+    from pytensor_federated_tpu.models.ode import make_lv_model
+
+    lv, _ = make_lv_model(8)
+    p0 = lv.init_params()
+    flat_lv, unr_lv = ravel_pytree(p0)
+    fn_lv = jax.jit(
+        lambda x: jax.value_and_grad(lambda v: lv.logp(unr_lv(v)))(x)
+    )
+    v, g = fn_lv(flat_lv)
+    jax.block_until_ready(g)
+    t0 = time.perf_counter()
+    for _ in range(10):
+        v, g = fn_lv(flat_lv)
+    jax.block_until_ready(g)
+    wall = (time.perf_counter() - t0) / 10
+    print(
+        f"lv_ode: v={float(v):.6g} grad_finite="
+        f"{np.isfinite(np.asarray(g)).all()} wall={wall * 1e3:.2f}ms",
+        flush=True,
+    )
+
+    # --- 4. config 7 gate: f32-vs-bf16 on the wide logistic ----------
+    from pytensor_federated_tpu.models.logistic import (
+        FederatedLogisticRegression,
+        generate_logistic_data,
+    )
+
+    dataw, _ = generate_logistic_data(
+        n_shards=8, n_obs=4096, n_features=512, seed=77
+    )
+    m32 = FederatedLogisticRegression(dataw)
+    m16 = FederatedLogisticRegression(dataw, compute_dtype=jnp.bfloat16)
+    f32, x1 = None, None
+    fl0, unr = ravel_pytree(m32.init_params())
+    key = jax.random.PRNGKey(3)
+    xw = fl0[None, :] + 0.01 * jax.random.normal(key, (4, fl0.shape[0]))
+
+    def vg(model):
+        return jax.jit(
+            jax.vmap(
+                lambda x: jax.value_and_grad(
+                    lambda v: model.logp(unr(v))
+                )(x)
+            )
+        )
+
+    for prec in ("default", "highest"):
+        with jax.default_matmul_precision(prec):
+            v32, g32 = vg(m32)(xw)
+            v16, g16 = vg(m16)(xw)
+            jax.block_until_ready(g16)
+        v32, v16 = np.asarray(v32, np.float64), np.asarray(v16, np.float64)
+        relv = np.max(np.abs(v16 - v32) / np.abs(v32))
+        relg = np.max(
+            np.abs(np.asarray(g16, np.float64) - np.asarray(g32, np.float64))
+        ) / np.max(np.abs(np.asarray(g32)))
+        print(
+            f"wide-logistic f32-prec={prec}: value relerr {relv:.3e} "
+            f"(gate 2e-2), grad relerr {relg:.3e} (gate 5e-2)",
+            flush=True,
+        )
+
+    print("diag complete", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -122,6 +122,11 @@ def main() -> int:
 
     if not args.skip_suite:
         print("== bench_suite.py ==", file=sys.stderr)
+        suite_path = os.path.join(REPO, "BENCH_SUITE.json")
+        try:
+            mtime_before = os.path.getmtime(suite_path)
+        except OSError:
+            mtime_before = None
         out = subprocess.run(
             [sys.executable, os.path.join(REPO, "bench_suite.py")],
             cwd=REPO,
@@ -132,14 +137,31 @@ def main() -> int:
         sys.stderr.write(out.stderr)
         print(out.stdout.strip())
         if out.returncode != 0:
-            # The suite's writes are atomic, so BENCH_SUITE.json on
-            # disk may be STALE — reading it now would report success
-            # on numbers this run never produced.
-            print(
-                f"bench_suite.py failed (exit {out.returncode}) — "
-                "artifact not refreshed",
-                file=sys.stderr,
-            )
+            # The suite guards each config and persists incrementally,
+            # so the artifact holds every config that DID succeed in
+            # THIS run — unless nothing recorded at all, in which case
+            # the file on disk is a previous run's (mtime unchanged)
+            # and must be reported as stale, not as this run's output.
+            try:
+                refreshed = os.path.getmtime(suite_path) != mtime_before
+            except OSError:
+                refreshed = False
+            if refreshed:
+                with open(suite_path) as f:
+                    kept = [r.get("config") for r in json.load(f)]
+                print(
+                    f"bench_suite.py failed (exit {out.returncode}); "
+                    f"artifact holds this run's successful configs: "
+                    f"{kept}",
+                    file=sys.stderr,
+                )
+            else:
+                print(
+                    f"bench_suite.py failed (exit {out.returncode}) "
+                    "before recording anything — BENCH_SUITE.json on "
+                    "disk is a PREVIOUS run's artifact",
+                    file=sys.stderr,
+                )
             return 5
         with open(os.path.join(REPO, "BENCH_SUITE.json")) as f:
             suite = json.load(f)

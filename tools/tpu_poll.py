@@ -65,6 +65,9 @@ def main(argv: list[str] | None = None) -> int:
     args = ap.parse_args(argv)
 
     sys.path.insert(0, REPO)
+    # Explicit tools/ entry: the implicit script-dir path only exists
+    # when invoked as `python tools/tpu_poll.py`, not under -m or import.
+    sys.path.insert(0, os.path.join(REPO, "tools"))
     from tpu_capture import EXIT_MEANINGS  # sibling module, single source
 
     if args.dry_run:
