@@ -47,6 +47,42 @@ def main():
         print(f"f32 matmul precision={prec}: max relerr {err:.3e}",
               flush=True)
 
+    # 1b. which mechanism, if any, recovers true-f32 accuracy?  (The
+    # first run showed the context manager changes dot_generals inside
+    # the Kalman but NOT a plain a @ b — pin down what does.)
+    from jax import lax
+
+    def dot_prec(a, b):
+        return jnp.dot(a, b, precision=lax.Precision.HIGHEST)
+
+    def dot_pref(a, b):
+        return lax.dot_general(
+            a, b, (((1,), (0,)), ((), ())),
+            precision=lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32,
+        )
+
+    def dot_split(a, b):
+        # 3-pass bf16 split: f32 = hi + lo with hi = bf16(x).
+        ahi = a.astype(jnp.bfloat16).astype(jnp.float32)
+        alo = a - ahi
+        bhi = b.astype(jnp.bfloat16).astype(jnp.float32)
+        blo = b - bhi
+        return (
+            jnp.dot(ahi, bhi) + jnp.dot(ahi, blo) + jnp.dot(alo, bhi)
+        )
+
+    for name, fn in (
+        ("dot(precision=HIGHEST)", dot_prec),
+        ("dot_general(HIGHEST, pref=f32)", dot_pref),
+        ("3-pass bf16 split", dot_split),
+    ):
+        out = jax.jit(fn)(jnp.asarray(A), jnp.asarray(w))
+        err = np.max(
+            np.abs(np.asarray(out, np.float64) - ref) / np.abs(ref)
+        )
+        print(f"f32 matvec via {name}: max relerr {err:.3e}", flush=True)
+
     # --- 2. parallel Kalman: finiteness + honest single-eval wall ----
     import sys
 
