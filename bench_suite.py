@@ -204,8 +204,17 @@ def main():
     def _c4():
         lv, _ = make_lv_model(8)
         fn, x0 = _flat(lv)
-        bench_config(
-            "Lotka-Volterra ODE param estimation (8 shards)", fn, x0
+        fl = xla_flops_per_eval(fn, x0)
+        r, n = _rate(fn, x0)
+        record(
+            "Lotka-Volterra ODE param estimation (8 shards)",
+            r,
+            flops_per_eval=fl,
+            n=n,
+            note="each eval is 128 SEQUENTIAL RK4 steps (fwd+bwd), so "
+            "the rate is loop-latency-bound, not compute-bound — "
+            "structurally ~100x deeper than the linear configs sharing "
+            "this 50k baseline",
         )
 
     guard("LV ODE", _c4)

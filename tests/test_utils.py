@@ -116,3 +116,16 @@ def test_event_loop_stable_per_thread():
     assert loops["a"][0] is loops["a"][1]
     assert loops["b"][0] is loops["b"][1]
     assert loops["a"][0] is not loops["b"][0]
+
+
+def test_enable_compilation_cache_sets_config(tmp_path):
+    import jax
+
+    from pytensor_federated_tpu.utils import enable_compilation_cache
+
+    target = str(tmp_path / "xla_cache")
+    enable_compilation_cache(target)
+    assert jax.config.jax_compilation_cache_dir == target
+    import os
+
+    assert os.path.isdir(target)

@@ -182,6 +182,38 @@ def main():
             flush=True,
         )
 
+    # --- 5. exact GP on TPU: is the Cholesky bf16-poisoned? ----------
+    # (The chip computes f32 contractions at bf16 accuracy — section
+    # 1; a Cholesky built on such dots could corrupt the marginal
+    # likelihood.  Compare against the same build on CPU.)
+    from pytensor_federated_tpu.models.gp import (
+        FederatedExactGP,
+        generate_gp_data,
+    )
+
+    data_gp, _ = generate_gp_data(8, n_obs=256, seed=9)
+    gp = FederatedExactGP(data_gp)
+    p_gp = gp.init_params()
+    v_tpu, g_tpu = gp.logp_and_grad(p_gp)
+    cpu = jax.devices("cpu")[0]
+    with jax.default_device(cpu):
+        v_cpu, g_cpu = jax.jit(gp.logp_and_grad)(
+            jax.device_put(p_gp, cpu)
+        )
+    rel = abs(float(v_tpu) - float(v_cpu)) / abs(float(v_cpu))
+    gflat = np.concatenate(
+        [np.ravel(np.asarray(x)) for x in jax.tree_util.tree_leaves(g_tpu)]
+    )
+    gflat_c = np.concatenate(
+        [np.ravel(np.asarray(x)) for x in jax.tree_util.tree_leaves(g_cpu)]
+    )
+    grel = np.max(np.abs(gflat - gflat_c)) / np.max(np.abs(gflat_c))
+    print(
+        f"exact_gp 8x256: v_tpu={float(v_tpu):.6g} v_cpu={float(v_cpu):.6g} "
+        f"relerr {rel:.3e}, grad relerr {grel:.3e}",
+        flush=True,
+    )
+
     print("diag complete", flush=True)
 
 
