@@ -26,7 +26,10 @@ Plus three net-new configs with no reference or BASELINE analog:
 10. federated exact GP, 8 shards x 256 points — the heaviest dense
     linear algebra in the package (batched 256x256 Cholesky +
     triangular solves per eval), baselined at 5% MFU like the other
-    compute-bound config.
+    compute-bound config;
+11. the HOST-federation lane: real gRPC + npwire round-trips/s against
+    a spawned localhost worker — the surface that is the reference's
+    entire hot path, baselined at its structural ~1 ms/call floor.
 
 Every record carries ``flops_per_eval`` (XLA's exact cost-model count
 of the compiled executable — flopcount.py), achieved ``flops_per_sec``,
@@ -80,6 +83,31 @@ def _flat_fn(logp_fn, params):
 
 def _flat(model):
     return _flat_fn(model.logp, model.init_params())
+
+
+# Module-level (multiprocessing-spawn needs a picklable target): one
+# worker node serving the reference demo's logp+grad shape over the
+# host lane (gRPC + npwire).
+def _bench_serve_node(port):
+    import logging
+
+    import numpy as np
+
+    logging.basicConfig(level=logging.WARNING)
+    from pytensor_federated_tpu.utils import force_cpu_backend
+
+    force_cpu_backend()
+
+    def compute(x):
+        x = np.asarray(x)
+        return [
+            np.asarray(-np.sum((x - 3.0) ** 2)),
+            (-2.0 * (x - 3.0)).astype(x.dtype),
+        ]
+
+    from pytensor_federated_tpu.service import run_node
+
+    run_node(compute, "127.0.0.1", port)
 
 
 def main():
@@ -544,6 +572,78 @@ def main():
         )
 
     guard("exact GP", _c10)
+
+    # 11. Host-federation lane: logp+grad round-trips/s over the real
+    # gRPC + npwire transport on localhost — the surface that IS the
+    # reference's entire hot path (serialize -> HTTP/2 -> compute ->
+    # serialize back per call, reference: service.py:150-158).  The
+    # baseline is the reference's structural per-call floor: ~1 ms of
+    # serialize + two network legs + Python dispatch => 1,000 calls/s
+    # (driver-set; the reference publishes no number, BASELINE.md).
+    # This lane is host-side by design — the TPU never appears — so the
+    # record says so instead of carrying meaningless FLOP fields.
+    def _c11():
+        import multiprocessing as mp
+        import time as _time
+
+        port = 53211
+        ctx = mp.get_context("spawn")
+        proc = ctx.Process(
+            target=_bench_serve_node, args=(port,), daemon=True
+        )
+        proc.start()
+        try:
+            import asyncio
+
+            from pytensor_federated_tpu.service import (
+                ArraysToArraysServiceClient,
+                get_loads_async,
+            )
+
+            deadline = _time.time() + 30.0
+
+            async def wait_up():
+                while _time.time() < deadline:
+                    loads = await get_loads_async(
+                        [("127.0.0.1", port)], timeout=1.0
+                    )
+                    if loads[0] is not None:
+                        return
+                    await asyncio.sleep(0.2)
+                raise TimeoutError("bench node did not come up")
+
+            asyncio.run(wait_up())
+            client = ArraysToArraysServiceClient("127.0.0.1", port)
+            x = np.zeros(3, np.float32)
+            client.evaluate(x)  # connect + warm
+            t0 = _time.perf_counter()
+            n = 0
+            while _time.perf_counter() - t0 < 1.5:
+                client.evaluate(x)
+                n += 1
+            wall = _time.perf_counter() - t0
+            record(
+                "host-lane logp+grad round-trips (gRPC + npwire, "
+                "localhost)",
+                n / wall,
+                unit="round-trips/s",
+                baseline_rate=1000.0,
+                baseline_desc=(
+                    "reference's structural per-call floor: ~1 ms "
+                    "serialize + 2 network legs + dispatch (driver-set; "
+                    "reference publishes no number)"
+                ),
+                n=n,
+                note="host-transport lane: the chip never appears, so "
+                "FLOP/MFU fields do not apply (lock-step bidi stream, "
+                "one in-flight message, like reference service.py:150-"
+                "158)",
+            )
+        finally:
+            proc.terminate()
+            proc.join(timeout=5)
+
+    guard("host transport lane", _c11)
 
     print(
         f"# wrote BENCH_SUITE.json ({len(results)} configs)",

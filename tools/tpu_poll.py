@@ -53,6 +53,15 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--dry-run", action="store_true")
     ap.add_argument("--timeout-s", type=float, default=150.0)
     ap.add_argument(
+        "--loop-every-s",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="poll forever at this interval in ONE long-lived process "
+        "(for environments without cron); exits 0 after the first "
+        "successful capture so the operator notices",
+    )
+    ap.add_argument(
         "--mosaic-after",
         action=argparse.BooleanOptionalAction,
         default=True,
@@ -66,9 +75,9 @@ def main(argv: list[str] | None = None) -> int:
 
     sys.path.insert(0, REPO)
     # Explicit tools/ entry: the implicit script-dir path only exists
-    # when invoked as `python tools/tpu_poll.py`, not under -m or import.
+    # when invoked as `python tools/tpu_poll.py`, not under -m or import
+    # (_attempt imports the sibling tpu_capture module through it).
     sys.path.insert(0, os.path.join(REPO, "tools"))
-    from tpu_capture import EXIT_MEANINGS  # sibling module, single source
 
     if args.dry_run:
         from pytensor_federated_tpu.utils import probe_backend
@@ -76,6 +85,22 @@ def main(argv: list[str] | None = None) -> int:
         live, _ = probe_backend(timeout_s=args.timeout_s)
         _log(f"probe: {'LIVE' if live else 'DEAD'} (dry run)")
         return 0 if live else 1
+
+    if args.loop_every_s is not None:
+        import time
+
+        while True:
+            rc = _attempt(args)
+            if rc == 0:
+                _log("loop: capture succeeded — exiting so it is noticed")
+                return 0
+            time.sleep(args.loop_every_s)
+
+    return _attempt(args)
+
+
+def _attempt(args) -> int:
+    from tpu_capture import EXIT_MEANINGS
 
     # One probe total: tpu_capture does its own liveness/busy preflight,
     # so the poller just invokes it and logs the outcome (a poll-side

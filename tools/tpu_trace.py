@@ -1,0 +1,75 @@
+"""Capture a JAX profiler trace of the flagship chain on a live chip.
+
+Produces the "profile, iterate" artifact the sharding/collective
+workflow calls for: a perfetto/xplane trace of the warm flagship
+logp+grad chain (plus one cold dispatch), written under
+``tools/trace/<timestamp>/``.  Run only on a LIVE chip during an idle
+window (probe first; never under a timeout):
+
+    python tools/tpu_trace.py [--n 20000]
+
+View with ui.perfetto.dev or xprof.  The trace answers the questions a
+rate alone cannot: per-iteration loop overhead vs compute, transfer
+stalls, and fusion boundaries of the chained executable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--n", type=int, default=20_000,
+                    help="chain length to trace (warm executable)")
+    ap.add_argument("--probe-timeout-s", type=float, default=150.0)
+    args = ap.parse_args()
+
+    sys.path.insert(0, REPO)
+    from pytensor_federated_tpu.utils import probe_backend
+
+    live, _ = probe_backend(timeout_s=args.probe_timeout_s)
+    if not live:
+        print("TPU not live — not tracing.", file=sys.stderr)
+        return 1
+
+    import jax
+    import jax.numpy as jnp
+    from jax.flatten_util import ravel_pytree
+
+    from bench import make_chained
+    from pytensor_federated_tpu.models.linear import (
+        FederatedLinearRegression,
+        generate_node_data,
+    )
+
+    data, _ = generate_node_data(8, n_obs=64, seed=123)
+    model = FederatedLinearRegression(data)
+    flat0, unravel = ravel_pytree(model.init_params())
+
+    def fn(x):
+        return jax.value_and_grad(lambda v: model.logp(unravel(v)))(x)
+
+    chained = make_chained(fn)
+    # Warm (compile) OUTSIDE the trace so the trace shows steady state.
+    jax.block_until_ready(chained(flat0, jnp.asarray(100, jnp.int32)))
+
+    stamp = datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y%m%dT%H%M%SZ"
+    )
+    out_dir = os.path.join(REPO, "tools", "trace", stamp)
+    os.makedirs(out_dir, exist_ok=True)
+    with jax.profiler.trace(out_dir):
+        out = chained(flat0, jnp.asarray(args.n, jnp.int32))
+        jax.block_until_ready(out)
+    print(f"trace written to {out_dir}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
