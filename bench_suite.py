@@ -622,10 +622,60 @@ def main():
                 client.evaluate(x)
                 n += 1
             wall = _time.perf_counter() - t0
+            rate_grpc = n / wall
+
+            # Second lane: the native C++ worker over the raw-TCP
+            # npwire framing (native/cpp_node.cpp) — the transport the
+            # native runtime ships; raced for the record like the
+            # on-device impl races (compute is trivial in both lanes,
+            # so the number is transport cost either way).
+            rate_cpp, n_cpp = None, None
+            import shutil
+            import subprocess as sp
+
+            native = os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "native")
+            binary = os.path.join(native, "cpp_node")
+            if shutil.which("make") and shutil.which("g++"):
+                sp.run(["make", "-C", native], check=True,
+                       capture_output=True)
+            if os.path.exists(binary):
+                from pytensor_federated_tpu.service import TcpArraysClient
+
+                cport = 53212
+                cproc = sp.Popen(
+                    [binary, str(cport)], stdout=sp.PIPE,
+                    stderr=sp.STDOUT, text=True,
+                )
+                try:
+                    line = cproc.stdout.readline()
+                    if "listening" not in line:
+                        raise RuntimeError(f"cpp_node: {line!r}")
+                    tclient = TcpArraysClient("127.0.0.1", cport)
+                    args = (
+                        np.float64(0.7), np.float64(1.9), np.float64(0.5),
+                        np.zeros(64), np.zeros(64),
+                    )
+                    tclient.evaluate(*args)  # connect + warm
+                    t0 = _time.perf_counter()
+                    n_cpp = 0
+                    while _time.perf_counter() - t0 < 1.5:
+                        tclient.evaluate(*args)
+                        n_cpp += 1
+                    rate_cpp = n_cpp / (_time.perf_counter() - t0)
+                    tclient.close()
+                finally:
+                    cproc.kill()
+                    cproc.wait()
+            for lane, r in (("python-grpc", rate_grpc),
+                            ("cpp-tcp", rate_cpp)):
+                if r is not None:
+                    print(f"# host lane {lane}: {r:,.1f} round-trips/s",
+                          file=sys.stderr)
+            best_rate = max(rate_grpc, rate_cpp or 0.0)
             record(
-                "host-lane logp+grad round-trips (gRPC + npwire, "
-                "localhost)",
-                n / wall,
+                "host-lane logp+grad round-trips (localhost worker)",
+                best_rate,
                 unit="round-trips/s",
                 baseline_rate=1000.0,
                 baseline_desc=(
@@ -634,10 +684,13 @@ def main():
                     "reference publishes no number)"
                 ),
                 n=n,
+                impl="cpp-tcp" if (rate_cpp or 0.0) > rate_grpc
+                else "python-grpc",
+                python_grpc_rps=round(rate_grpc, 1),
+                cpp_tcp_rps=None if rate_cpp is None else round(rate_cpp, 1),
                 note="host-transport lane: the chip never appears, so "
-                "FLOP/MFU fields do not apply (lock-step bidi stream, "
-                "one in-flight message, like reference service.py:150-"
-                "158)",
+                "FLOP/MFU fields do not apply (lock-step stream, one "
+                "in-flight message, like reference service.py:150-158)",
             )
         finally:
             proc.terminate()
