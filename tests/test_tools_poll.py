@@ -1,0 +1,47 @@
+"""Unit tests for the capture poller's loop mode (tools/tpu_poll.py).
+
+The loop must keep attempting while captures fail, exit 0 on the first
+success, and log each attempt — pinned here with a mocked attempt so
+no TPU (or subprocess) is involved.
+"""
+
+import importlib
+import os
+import sys
+
+import pytest
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(__file__)), "tools")
+
+
+@pytest.fixture()
+def tpu_poll(monkeypatch):
+    monkeypatch.syspath_prepend(TOOLS)
+    mod = importlib.import_module("tpu_poll")
+    return mod
+
+
+def test_loop_exits_zero_on_first_success(tpu_poll, monkeypatch, tmp_path):
+    attempts = []
+    sleeps = []
+
+    def fake_attempt(args):
+        attempts.append(1)
+        return 1 if len(attempts) < 3 else 0
+
+    monkeypatch.setattr(tpu_poll, "_attempt", fake_attempt)
+    monkeypatch.setattr(tpu_poll, "LOG", str(tmp_path / "log"))
+    import time as time_mod
+
+    monkeypatch.setattr(time_mod, "sleep", lambda s: sleeps.append(s))
+    rc = tpu_poll.main(["--loop-every-s", "123"])
+    assert rc == 0
+    assert len(attempts) == 3
+    assert sleeps == [123.0, 123.0]
+
+
+def test_single_attempt_mode_returns_attempt_code(tpu_poll, monkeypatch,
+                                                  tmp_path):
+    monkeypatch.setattr(tpu_poll, "_attempt", lambda args: 4)
+    monkeypatch.setattr(tpu_poll, "LOG", str(tmp_path / "log"))
+    assert tpu_poll.main([]) == 4

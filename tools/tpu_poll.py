@@ -139,7 +139,33 @@ def _attempt(args) -> int:
         f"({EXIT_MEANINGS.get(mres.returncode, 'unknown failure')}); "
         f"output -> {os.path.relpath(out_path, REPO)}"
     )
-    return 0  # plain capture succeeded; the settle result is advisory
+
+    # Harvest the rest of the live window: the numerics diagnostic
+    # (f32-precision experiments, GP-vs-CPU check) and a profiler
+    # trace of the flagship chain.  Both are advisory — logged, never
+    # allowed to fail the poll — and each runs to completion
+    # (no timeout: killing mid-TPU-call is the wedge trigger).
+    for name, script, out_name in (
+        ("diag", "diag_tpu.py", "diag_tpu_live.out"),
+        ("trace", "tpu_trace.py", "tpu_trace_live.out"),
+    ):
+        spath = os.path.join(REPO, "tools", script)
+        dres = subprocess.run(
+            [sys.executable, spath],
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+        )
+        dout = os.path.join(REPO, "tools", out_name)
+        with open(dout, "w", encoding="utf-8") as fh:
+            fh.write(dres.stdout)
+            fh.write("\n--- stderr ---\n")
+            fh.write(dres.stderr)
+        _log(
+            f"{name}: exit={dres.returncode}; "
+            f"output -> {os.path.relpath(dout, REPO)}"
+        )
+    return 0  # plain capture succeeded; the rest is advisory
 
 
 if __name__ == "__main__":
