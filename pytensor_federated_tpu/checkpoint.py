@@ -113,6 +113,7 @@ def sample_checkpointed(
     target_accept: float = 0.8,
     jitter: float = 1.0,
     logp_and_grad_fn: Optional[Callable] = None,
+    dense_mass: bool = False,
 ):
     """Resumable NUTS/HMC sampling with periodic on-disk checkpoints.
 
@@ -158,6 +159,10 @@ def sample_checkpointed(
         "target_accept": target_accept,
         "jitter": jitter,
         "dim": dim,
+        # Part of the resume identity: a diagonal-mass checkpoint must
+        # not be stitched into a dense-mass run (the state shapes and
+        # the kernel differ).
+        "dense_mass": dense_mass,
     }
 
     k_jit, k_warm, k_base = jax.random.split(key, 3)
@@ -168,7 +173,10 @@ def sample_checkpointed(
             "logp": jnp.zeros((num_chains,), dtype),
             "grad": jnp.zeros((num_chains, dim), dtype),
             "step_size": jnp.zeros((num_chains,), dtype),
-            "inv_mass": jnp.zeros((num_chains, dim), dtype),
+            "inv_mass": jnp.zeros(
+                (num_chains, dim, dim) if dense_mass else (num_chains, dim),
+                dtype,
+            ),
         }
 
     def chunk_template():
@@ -209,6 +217,7 @@ def sample_checkpointed(
                     num_warmup=num_warmup,
                     kernel_step=kernel_step,
                     target_accept=target_accept,
+                    dense_mass=dense_mass,
                 )
             )
         )(init_flat, jax.random.split(k_warm, num_chains))

@@ -28,6 +28,7 @@ from .util import (
     da_init,
     da_update,
     flatten_logp,
+    welford_covariance,
     welford_init,
     welford_update,
     welford_variance,
@@ -88,17 +89,21 @@ def _warmup(
     num_warmup: int,
     kernel_step,
     target_accept: float = 0.8,
+    dense_mass: bool = False,
 ) -> WarmupResult:
-    """Stan-style three-stage warmup: step size + diagonal mass."""
+    """Stan-style three-stage warmup: step size + diagonal (or, with
+    ``dense_mass``, full-covariance) mass adaptation."""
     dtype = x0.dtype
     dim = x0.shape[0]
     sched = AdaptSchedule.make(num_warmup)
     k_init, k_scan = jax.random.split(key)
 
-    inv_mass = jnp.ones((dim,), dtype)
+    inv_mass = jnp.eye(dim, dtype=dtype) if dense_mass else jnp.ones(
+        (dim,), dtype
+    )
     step0 = find_reasonable_step_size(logp_and_grad, x0, k_init, inv_mass)
     da = da_init(step0)
-    wf = welford_init(dim, dtype)
+    wf = welford_init(dim, dtype, dense=dense_mass)
     state = hmc_init(logp_and_grad, x0)
 
     def body(carry, inputs):
@@ -114,10 +119,14 @@ def _warmup(
         )
 
         def refresh(da, wf, inv_mass):
-            new_inv_mass = welford_variance(wf)
+            new_inv_mass = (
+                welford_covariance(wf) if dense_mass else welford_variance(wf)
+            )
             # Restart step-size search around the current averaged value.
             new_da = da_init(jnp.exp(da.log_step_avg))
-            return new_da, welford_init(dim, dtype), new_inv_mass
+            return new_da, welford_init(dim, dtype, dense=dense_mass), (
+                new_inv_mass
+            )
 
         da, wf, inv_mass = jax.tree_util.tree_map(
             partial(jnp.where, update_mass),
@@ -143,7 +152,7 @@ class SampleResult:
     samples: Any  # user pytree with leading (chains, draws)
     stats: dict  # accept_prob / diverging / depth / energy, (chains, draws)
     step_size: jax.Array  # (chains,)
-    inv_mass: jax.Array  # (chains, dim)
+    inv_mass: jax.Array  # (chains, dim) — or (chains, dim, dim) dense
 
     def summary(
         self, *, hdi_prob: float = 0.94, rank_normalized: bool = False
@@ -172,6 +181,7 @@ def sample(
     target_accept: float = 0.8,
     jitter: float = 1.0,
     logp_and_grad_fn: Optional[Callable] = None,
+    dense_mass: bool = False,
 ) -> SampleResult:
     """Run adaptive MCMC against ``logp_fn`` (params pytree -> scalar).
 
@@ -182,6 +192,11 @@ def sample(
     fused value+grad (e.g. ``FederatedLogp.logp_and_grad`` or a
     forward-supplied-gradient :class:`~pytensor_federated_tpu.LogpGradOp`);
     otherwise gradients come from autodiff of ``logp_fn``.
+
+    ``dense_mass=True`` adapts a full covariance mass matrix during
+    warmup (Stan-style shrunk Welford covariance) instead of the
+    diagonal — worth it for strongly correlated posteriors; every
+    momentum/velocity op becomes a small matvec (MXU-friendly).
 
     Everything (warmup + sampling, all chains) runs in one jitted
     program; chains are a vmap axis.
@@ -216,6 +231,7 @@ def sample(
             num_warmup=num_warmup,
             kernel_step=kernel_step,
             target_accept=target_accept,
+            dense_mass=dense_mass,
         )
 
         def body(state, key):

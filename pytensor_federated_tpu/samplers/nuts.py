@@ -13,8 +13,9 @@ detection (Hoffman & Gelman 2014; Betancourt 2017 "A conceptual
 introduction to HMC" appendix A.4; iterative formulation as popularized
 by the NumPyro authors, Phan et al. 2019 — see PAPERS.md).  Implemented
 from the published algorithm, TPU-first: flat state vectors (one fused
-VPU update per leapfrog), diagonal mass matrix, generalized U-turn
-criterion with half-leaf correction.
+VPU update per leapfrog), diagonal OR dense mass matrix (the hmc
+helpers branch on ``inv_mass.ndim``; dense velocities are matvecs),
+generalized U-turn criterion with half-leaf correction.
 """
 
 from __future__ import annotations
@@ -24,7 +25,14 @@ from typing import Callable, NamedTuple
 import jax
 import jax.numpy as jnp
 
-from .hmc import HMCState, IntegratorState, kinetic_energy, leapfrog, sample_momentum
+from .hmc import (
+    HMCState,
+    IntegratorState,
+    kinetic_energy,
+    leapfrog,
+    mass_velocity,
+    sample_momentum,
+)
 
 
 class NUTSInfo(NamedTuple):
@@ -59,8 +67,8 @@ class _Tree(NamedTuple):
 
 def _is_turning(inv_mass, r_left, r_right, r_sum):
     """Generalized U-turn criterion with half-leaf correction."""
-    v_left = inv_mass * r_left
-    v_right = inv_mass * r_right
+    v_left = mass_velocity(inv_mass, r_left)
+    v_right = mass_velocity(inv_mass, r_right)
     r_c = r_sum - 0.5 * (r_left + r_right)
     return (jnp.dot(v_left, r_c) <= 0.0) | (jnp.dot(v_right, r_c) <= 0.0)
 

@@ -41,10 +41,15 @@ class WelfordState(NamedTuple):
     count: jax.Array
 
 
-def welford_init(dim: int, dtype=jnp.float32) -> WelfordState:
+def welford_init(
+    dim: int, dtype=jnp.float32, *, dense: bool = False
+) -> WelfordState:
+    """``dense=True`` accumulates the full ``(dim, dim)`` second-moment
+    matrix (for dense-mass adaptation) instead of the diagonal."""
+    m2_shape = (dim, dim) if dense else (dim,)
     return WelfordState(
         mean=jnp.zeros((dim,), dtype),
-        m2=jnp.zeros((dim,), dtype),
+        m2=jnp.zeros(m2_shape, dtype),
         count=jnp.zeros((), dtype),
     )
 
@@ -53,7 +58,10 @@ def welford_update(state: WelfordState, x: jax.Array) -> WelfordState:
     count = state.count + 1.0
     delta = x - state.mean
     mean = state.mean + delta / count
-    m2 = state.m2 + delta * (x - mean)
+    if state.m2.ndim == 2:
+        m2 = state.m2 + jnp.outer(delta, x - mean)
+    else:
+        m2 = state.m2 + delta * (x - mean)
     return WelfordState(mean, m2, count)
 
 
@@ -64,6 +72,22 @@ def welford_variance(state: WelfordState, *, regularize: bool = True) -> jax.Arr
         n = state.count
         var = (n / (n + 5.0)) * var + 1e-3 * (5.0 / (n + 5.0))
     return var
+
+
+def welford_covariance(
+    state: WelfordState, *, regularize: bool = True
+) -> jax.Array:
+    """Full covariance estimate from a ``dense=True`` accumulator,
+    Stan-style shrunk toward (a small multiple of) the identity — the
+    same ``n/(n+5)`` schedule as :func:`welford_variance`, which also
+    keeps the estimate positive-definite at low counts."""
+    cov = state.m2 / jnp.maximum(state.count - 1.0, 1.0)
+    if regularize:
+        n = state.count
+        dim = state.mean.shape[0]
+        eye = jnp.eye(dim, dtype=state.mean.dtype)
+        cov = (n / (n + 5.0)) * cov + 1e-3 * (5.0 / (n + 5.0)) * eye
+    return cov
 
 
 class DualAveragingState(NamedTuple):

@@ -1,0 +1,173 @@
+"""Dense mass-matrix adaptation (samplers: hmc helpers + warmup).
+
+A full covariance mass matrix is the standard cure for strongly
+correlated posteriors (Stan's ``metric=dense_e``); the reference has no
+sampler of its own, so this is net-new capability.  Pinned here:
+
+- the polymorphic helpers reduce EXACTLY to the diagonal path when the
+  matrix is diagonal;
+- ``sample_momentum`` draws with covariance ``inv(inv_mass)``;
+- dense warmup learns the correlation (off-diagonal mass) and the
+  posterior moments match the closed form;
+- dense beats diagonal on min-ESS for a high-correlation Gaussian —
+  the reason the feature exists.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytensor_federated_tpu.samplers.hmc import (
+    IntegratorState,
+    kinetic_energy,
+    leapfrog,
+    mass_velocity,
+    sample_momentum,
+)
+from pytensor_federated_tpu.samplers.mcmc import sample
+from pytensor_federated_tpu.samplers.util import (
+    welford_covariance,
+    welford_init,
+    welford_update,
+)
+
+
+def test_helpers_match_diagonal_path():
+    d = 4
+    diag = jnp.asarray([0.5, 2.0, 1.0, 3.0])
+    mat = jnp.diag(diag)
+    r = jnp.asarray([0.3, -1.2, 0.7, 0.1])
+    np.testing.assert_allclose(
+        np.asarray(mass_velocity(mat, r)),
+        np.asarray(mass_velocity(diag, r)),
+        rtol=1e-6,
+    )
+    np.testing.assert_allclose(
+        float(kinetic_energy(r, mat)),
+        float(kinetic_energy(r, diag)),
+        rtol=1e-6,
+    )
+    # same key => identical momentum draws for diag-matrix vs vector
+    key = jax.random.PRNGKey(0)
+    x = jnp.zeros((d,))
+    np.testing.assert_allclose(
+        np.asarray(sample_momentum(key, x, mat)),
+        np.asarray(sample_momentum(key, x, diag)),
+        rtol=1e-5,
+    )
+
+    def lg(x):
+        return -0.5 * jnp.sum(x**2), -x
+
+    st = IntegratorState(x + 1.0, r, *lg(x + 1.0))
+    end_m = leapfrog(lg, st, 0.1, mat)
+    end_d = leapfrog(lg, st, 0.1, diag)
+    np.testing.assert_allclose(
+        np.asarray(end_m.x), np.asarray(end_d.x), rtol=1e-6
+    )
+
+
+def test_sample_momentum_covariance_dense():
+    # r ~ N(0, M) with M = inv(inv_mass): check empirically.
+    inv_mass = jnp.asarray([[2.0, 0.6], [0.6, 1.0]])
+    want = np.linalg.inv(np.asarray(inv_mass))
+    keys = jax.random.split(jax.random.PRNGKey(1), 20_000)
+    draws = jax.vmap(
+        lambda k: sample_momentum(k, jnp.zeros(2), inv_mass)
+    )(keys)
+    got = np.cov(np.asarray(draws).T)
+    np.testing.assert_allclose(got, want, atol=0.05)
+
+
+def _correlated_gaussian(rho=0.95):
+    cov = jnp.asarray([[1.0, rho], [rho, 1.0]])
+    prec = jnp.linalg.inv(cov)
+
+    def logp(p):
+        return -0.5 * p["x"] @ prec @ p["x"]
+
+    return logp, np.asarray(cov)
+
+
+def test_dense_warmup_learns_correlation_and_moments():
+    logp, cov = _correlated_gaussian(0.95)
+    res = sample(
+        logp,
+        {"x": jnp.zeros(2)},
+        key=jax.random.PRNGKey(3),
+        num_warmup=400,
+        num_samples=400,
+        num_chains=2,
+        dense_mass=True,
+    )
+    assert res.inv_mass.shape == (2, 2, 2)
+    # adapted inv_mass ~ posterior covariance: off-diagonal present
+    # with the right sign and a sane magnitude.
+    im = np.asarray(res.inv_mass).mean(axis=0)
+    assert im[0, 1] > 0.3 * np.sqrt(im[0, 0] * im[1, 1])
+    draws = np.asarray(res.samples["x"]).reshape(-1, 2)
+    np.testing.assert_allclose(draws.mean(axis=0), 0.0, atol=0.15)
+    got_cov = np.cov(draws.T)
+    np.testing.assert_allclose(got_cov, cov, atol=0.25)
+    summ = res.summary()
+    assert float(np.max(np.asarray(summ["rhat"]["x"]))) < 1.1
+
+
+def test_dense_beats_diag_on_min_ess():
+    logp, _ = _correlated_gaussian(0.99)
+    kw = dict(
+        key=jax.random.PRNGKey(7),
+        num_warmup=500,
+        num_samples=500,
+        num_chains=2,
+    )
+    res_dense = sample(logp, {"x": jnp.zeros(2)}, dense_mass=True, **kw)
+    res_diag = sample(logp, {"x": jnp.zeros(2)}, **kw)
+
+    def min_ess(res):
+        return float(np.min(np.asarray(res.summary()["ess"]["x"])))
+
+    assert min_ess(res_dense) > min_ess(res_diag)
+
+
+def test_welford_dense_covariance():
+    rng = np.random.default_rng(0)
+    cov = np.array([[2.0, -0.8], [-0.8, 1.0]])
+    xs = rng.multivariate_normal([1.0, -2.0], cov, size=4000).astype(
+        np.float32
+    )
+    st = welford_init(2, dense=True)
+    for x in xs[:500]:
+        st = welford_update(st, jnp.asarray(x))
+    got = np.asarray(welford_covariance(st, regularize=False))
+    np.testing.assert_allclose(got, cov, atol=0.35)
+
+
+def test_checkpointed_dense_mass_resume(tmp_path):
+    # The resumable path supports dense mass too, and a dense run is
+    # bit-identical across an interrupt/resume (the checkpoint carries
+    # the (chains, dim, dim) mass).
+    from pytensor_federated_tpu.checkpoint import sample_checkpointed
+
+    logp, _ = _correlated_gaussian(0.9)
+    kw = dict(
+        key=jax.random.PRNGKey(11),
+        num_warmup=100,
+        num_samples=60,
+        num_chains=2,
+        checkpoint_every=20,
+        dense_mass=True,
+    )
+    path = str(tmp_path / "ck.npz")
+    res_full = sample_checkpointed(
+        logp, {"x": jnp.zeros(2)}, checkpoint_path=path, **kw
+    )
+    assert res_full.inv_mass.shape == (2, 2, 2)
+    # Resume from the final checkpoint: must reproduce bit-identically.
+    res_resumed = sample_checkpointed(
+        logp, {"x": jnp.zeros(2)}, checkpoint_path=path, **kw
+    )
+    np.testing.assert_array_equal(
+        np.asarray(res_full.samples["x"]),
+        np.asarray(res_resumed.samples["x"]),
+    )
