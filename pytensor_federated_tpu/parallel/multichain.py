@@ -42,10 +42,12 @@ def multichain_sample(
     mesh: Mesh,
     key: jax.Array,
     num_samples: int = 100,
+    num_warmup: int = 0,
     step_size: float = 0.1,
     kernel: str = "nuts",
     max_depth: int = 6,
     num_hmc_steps: int = 16,
+    target_accept: float = 0.8,
     prior_logp: Optional[Callable[[Any], jax.Array]] = None,
     chains_axis: str = CHAINS_AXIS,
     shards_axis: str = SHARDS_AXIS,
@@ -56,6 +58,15 @@ def multichain_sample(
     ``init_params`` is a single params pytree; each chain starts from a
     jittered copy.  Returns ``(draws, accept, unravel)`` where ``draws``
     has shape ``(chains, num_samples, dim)`` (flat parameter vectors).
+
+    ``num_warmup > 0`` runs the same Stan-style warmup as
+    :func:`pytensor_federated_tpu.samplers.sample` (dual-averaged step
+    size + diagonal mass) per chain, INSIDE the shard_map: the
+    adaptation statistics are per-chain (no cross-chain traffic), and
+    every rank of a chain row sees bit-identical deterministic-sum
+    logp values, so the data-dependent warmup loops stay in lockstep
+    exactly like the NUTS tree itself.  With ``num_warmup=0`` the given
+    ``step_size`` and a unit mass are used as before.
 
     This is the scale path — for single-host convenience sampling use
     :func:`pytensor_federated_tpu.samplers.sample` (vmap chains).
@@ -115,18 +126,17 @@ def multichain_sample(
             g = g + pg
         return v, g
 
-    inv_mass = jnp.ones((dim,), dtype)
+    inv_mass0 = jnp.ones((dim,), dtype)
 
     def chain_block(x0_block, keys_block, local_data):
         """Runs this device's chains (block of the chains axis)."""
 
         def one_chain(x0, key):
             lg = lambda x: local_logp_and_grad(x, local_data)
-            state = hmc_init(lg, x0)
 
-            def body(state, key):
+            def kernel_step(state, key, *, step_size, inv_mass):
                 if kernel == "nuts":
-                    state, info = nuts_step(
+                    return nuts_step(
                         lg,
                         state,
                         key,
@@ -134,15 +144,37 @@ def multichain_sample(
                         inv_mass=inv_mass,
                         max_depth=max_depth,
                     )
-                else:
-                    state, info = hmc_step(
-                        lg,
-                        state,
-                        key,
-                        step_size=step_size,
-                        inv_mass=inv_mass,
-                        num_steps=num_hmc_steps,
-                    )
+                return hmc_step(
+                    lg,
+                    state,
+                    key,
+                    step_size=step_size,
+                    inv_mass=inv_mass,
+                    num_steps=num_hmc_steps,
+                )
+
+            if num_warmup > 0:
+                from ..samplers.mcmc import _warmup
+
+                k_warm, key = jax.random.split(key)
+                warm = _warmup(
+                    lg,
+                    x0,
+                    k_warm,
+                    num_warmup=num_warmup,
+                    kernel_step=kernel_step,
+                    target_accept=target_accept,
+                )
+                state = warm.state
+                eps, inv_mass = warm.step_size, warm.inv_mass
+            else:
+                state = hmc_init(lg, x0)
+                eps, inv_mass = step_size, inv_mass0
+
+            def body(state, key):
+                state, info = kernel_step(
+                    state, key, step_size=eps, inv_mass=inv_mass
+                )
                 return state, (state.x, info.accept_prob)
 
             keys = jax.random.split(key, num_samples)
