@@ -182,6 +182,7 @@ def sample(
     jitter: float = 1.0,
     logp_and_grad_fn: Optional[Callable] = None,
     dense_mass: bool = False,
+    chain_sharding: Optional[Any] = None,
 ) -> SampleResult:
     """Run adaptive MCMC against ``logp_fn`` (params pytree -> scalar).
 
@@ -198,6 +199,13 @@ def sample(
     diagonal — worth it for strongly correlated posteriors; every
     momentum/velocity op becomes a small matvec (MXU-friendly).
 
+    ``chain_sharding`` (e.g. ``NamedSharding(mesh, P("chains"))``)
+    places the chain batch across a device mesh; chains are
+    independent, so the vmapped program partitions with zero
+    collectives — the single-host path to device-parallel chains
+    (``num_chains`` must be divisible by the mesh axis; for
+    data-sharded logp use ``parallel.multichain_sample``).
+
     Everything (warmup + sampling, all chains) runs in one jitted
     program; chains are a vmap axis.
     """
@@ -212,6 +220,16 @@ def sample(
         init_flat = init_flat + jitter * jax.random.normal(
             k_jit, init_flat.shape, dtype
         )
+
+    if chain_sharding is not None:
+        try:
+            chain_sharding.shard_shape(init_flat.shape)
+        except Exception as e:
+            raise ValueError(
+                f"num_chains={num_chains} is not shardable by "
+                f"chain_sharding={chain_sharding}: {e}"
+            ) from None
+        init_flat = jax.device_put(init_flat, chain_sharding)
 
     if kernel == "metropolis":
         return _sample_metropolis(
