@@ -30,7 +30,9 @@ from .ring import (
     seq_sharded_markov_logp,
     shift_right_across_shards,
 )
+from .expert import EXPERTS_AXIS, ExpertShardedMixture
 from .sharded import FederatedLogp, sharded_compute
+from .tensor import TP_AXIS, TensorParallelLogistic
 from .ulysses import heads_to_seq, seq_to_heads, ulysses_attention
 from .zero import ScatteredGrads, ZeroShardedLogpGrad
 
@@ -51,6 +53,10 @@ __all__ = [
     "heads_to_seq",
     "seq_to_heads",
     "ulysses_attention",
+    "EXPERTS_AXIS",
+    "ExpertShardedMixture",
+    "TP_AXIS",
+    "TensorParallelLogistic",
     "fedavg",
     "federated_broadcast",
     "federated_map",

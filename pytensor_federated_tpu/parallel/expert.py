@@ -1,0 +1,182 @@
+"""Expert parallelism: mixture components sharded over a mesh axis.
+
+The reference has no expert-parallel concept (SURVEY.md §2: EP is
+"not present — design fresh").  The Bayesian analog of MoE expert
+sharding is a mixture likelihood whose COMPONENT set outgrows a
+device: each device owns a block of components (its "experts") and
+evaluates their densities for every observation; the per-observation
+mixture loglik is then a cross-device ``logsumexp`` — implemented as
+the max-shift trick over collectives (``pmax`` for the shift, ``psum``
+for the sum), with the shift under ``stop_gradient`` so the gradient
+flows only through the (smooth) sum term, exactly as in the one-device
+logsumexp identity.
+
+Unlike token-routing MoE there is no all_to_all: every observation
+"visits" every expert, but each device only ever materializes its own
+component block — the memory/compute win EP exists for.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.linear import _normal_logpdf
+
+EXPERTS_AXIS = "experts"
+
+__all__ = ["EXPERTS_AXIS", "ExpertShardedMixture"]
+
+
+def _local_terms(y, log_w_block, mu_block, log_sigma_block):
+    """(n, K_local) component log-terms: log w_k + logN(y | mu_k, s_k)."""
+    sigma = jnp.exp(log_sigma_block)
+    return log_w_block[None, :] + _normal_logpdf(
+        y[:, None], mu_block[None, :], sigma[None, :]
+    )
+
+
+class ExpertShardedMixture:
+    """Gaussian mixture with components sharded over ``"experts"``.
+
+    ``params``: ``mu`` (K,), ``log_sigma`` (K,), ``weight_logits``
+    (K,) — each sharded ``P(axis)`` on a mesh, replicated otherwise.
+    The softmax over component weights is itself a cross-device
+    logsumexp (same max-shift construction).
+
+    Free (unordered) means with Normal priors: label switching is the
+    user's concern exactly as in
+    :class:`~pytensor_federated_tpu.models.mixture.FederatedGaussianMixture`'s
+    docstring discussion — this class is about the PARALLELISM of the
+    component axis, and its logp equals the unsharded mixture's
+    bit-for-bit modulo reduction order (equality-tested).
+    """
+
+    def __init__(
+        self,
+        y,
+        n_components: int,
+        *,
+        mesh: Optional[Mesh] = None,
+        axis: str = EXPERTS_AXIS,
+        prior_scale: float = 3.0,
+    ):
+        self.mesh = mesh
+        self.axis = axis
+        self.k = int(n_components)
+        self.prior_scale = prior_scale
+        y = jnp.asarray(y, jnp.float32)
+        self.y = y
+
+        if mesh is not None:
+            n_dev = mesh.shape[axis]
+            if self.k % n_dev != 0:
+                raise ValueError(
+                    f"n_components={self.k} not divisible by mesh axis "
+                    f"{axis!r} of size {n_dev}"
+                )
+            self._p_sharding = NamedSharding(mesh, P(axis))
+
+            def loglik(params):
+                def _axis_max(local):
+                    # Cross-device max for the logsumexp SHIFT.  pmax
+                    # has no differentiation rule (even stop_gradient
+                    # still traces its JVP), so gather the per-device
+                    # maxes — the shift is gradient-neutral anyway and
+                    # stop_gradient makes that explicit.
+                    return jax.lax.stop_gradient(
+                        jnp.max(jax.lax.all_gather(local, axis), axis=0)
+                    )
+
+                def body(y_rep, mu_b, ls_b, wl_b):
+                    # log-softmax over ALL experts, computed blockwise:
+                    # a cross-device logsumexp of the weight logits.
+                    m_w = _axis_max(jnp.max(wl_b))
+                    z = jax.lax.psum(jnp.sum(jnp.exp(wl_b - m_w)), axis)
+                    log_w_b = wl_b - m_w - jnp.log(z)
+                    t = _local_terms(y_rep, log_w_b, mu_b, ls_b)
+                    m = _axis_max(jnp.max(t, axis=1))
+                    s = jax.lax.psum(
+                        jnp.sum(jnp.exp(t - m[:, None]), axis=1), axis
+                    )
+                    return jnp.sum(m + jnp.log(s))
+
+                fn = shard_map(
+                    body,
+                    mesh=mesh,
+                    in_specs=(P(), P(axis), P(axis), P(axis)),
+                    out_specs=P(),
+                    check_vma=False,
+                )
+                return fn(
+                    y,
+                    params["mu"],
+                    params["log_sigma"],
+                    params["weight_logits"],
+                )
+
+        else:
+
+            def loglik(params):
+                log_w = jax.nn.log_softmax(params["weight_logits"])
+                t = _local_terms(
+                    y, log_w, params["mu"], params["log_sigma"]
+                )
+                return jnp.sum(jax.scipy.special.logsumexp(t, axis=1))
+
+        self._loglik = loglik
+
+    def prior_logp(self, params: Any) -> jax.Array:
+        lp = jnp.sum(_normal_logpdf(params["mu"], 0.0, self.prior_scale))
+        lp += jnp.sum(_normal_logpdf(params["log_sigma"], 0.0, 1.0))
+        lp += jnp.sum(_normal_logpdf(params["weight_logits"], 0.0, 1.0))
+        return lp
+
+    def logp(self, params: Any) -> jax.Array:
+        return self.prior_logp(params) + self._loglik(params)
+
+    def logp_and_grad(self, params: Any):
+        return jax.value_and_grad(self.logp)(params)
+
+    def init_params(self) -> Any:
+        # Spread initial means over the data range so components
+        # separate; deterministic (no RNG) for reproducible tests.
+        lo = float(jnp.min(self.y))
+        hi = float(jnp.max(self.y))
+        mu = jnp.linspace(lo, hi, self.k)
+        params = {
+            "mu": mu,
+            "log_sigma": jnp.zeros((self.k,)),
+            "weight_logits": jnp.zeros((self.k,)),
+        }
+        if self.mesh is not None:
+            params = {
+                k: jax.device_put(v, self._p_sharding)
+                for k, v in params.items()
+            }
+        return params
+
+    def find_map(self, **kwargs):
+        from ..samplers import find_map
+
+        return find_map(self.logp, self.init_params(), **kwargs)
+
+
+def generate_expert_mixture_data(
+    n_obs: int = 512,
+    mus=(-4.0, -1.0, 1.5, 4.0),
+    sigmas=(0.5, 0.4, 0.6, 0.5),
+    *,
+    seed: int = 23,
+):
+    rng = np.random.default_rng(seed)
+    mus = np.asarray(mus)
+    sigmas = np.asarray(sigmas)
+    z = rng.integers(0, mus.size, size=n_obs)
+    y = (mus[z] + sigmas[z] * rng.normal(size=n_obs)).astype(np.float32)
+    return y, {"mu": mus, "sigma": sigmas}
