@@ -41,9 +41,11 @@ class TensorParallelLogistic:
     Same posterior as
     :class:`~pytensor_federated_tpu.models.logistic.FederatedLogisticRegression`
     on a single un-split shard — the parallel axis here is the FEATURE
-    dimension, complementary to the federated shard axis (rows).  For
-    both at once, compose meshes: rows over ``"shards"``, columns over
-    ``"tp"``.
+    dimension, complementary to the federated shard axis (rows).  Pass
+    ``rows_axis`` to compose both on a 2-D mesh: ``X`` is then
+    row-AND-column sharded ``P(rows_axis, axis)`` (each device holds
+    one tile), ``y`` row-sharded, ``w`` column-sharded — GSPMD reduces
+    the contraction over the ``tp`` axis and the loglik sum over both.
     """
 
     def __init__(
@@ -53,6 +55,7 @@ class TensorParallelLogistic:
         *,
         mesh: Optional[Mesh] = None,
         axis: str = TP_AXIS,
+        rows_axis: Optional[str] = None,
         prior_scale: float = 5.0,
     ):
         self.mesh = mesh
@@ -68,10 +71,15 @@ class TensorParallelLogistic:
                     f"d={self.d} not divisible by mesh axis {axis!r} "
                     f"of size {k}"
                 )
-            self._x_sharding = NamedSharding(mesh, P(None, axis))
+            if rows_axis is not None and self.n % mesh.shape[rows_axis]:
+                raise ValueError(
+                    f"n={self.n} not divisible by mesh axis "
+                    f"{rows_axis!r} of size {mesh.shape[rows_axis]}"
+                )
+            self._x_sharding = NamedSharding(mesh, P(rows_axis, axis))
             self._w_sharding = NamedSharding(mesh, P(axis))
             X = jax.device_put(X, self._x_sharding)
-            y = jax.device_put(y, NamedSharding(mesh, P()))
+            y = jax.device_put(y, NamedSharding(mesh, P(rows_axis)))
         else:
             self._x_sharding = self._w_sharding = None
         self.X, self.y = X, y

@@ -118,3 +118,34 @@ class TestExpertParallel:
         np.testing.assert_allclose(
             mu_est, np.sort(truth["mu"]), atol=0.5
         )
+
+
+class TestTensorParallel2D:
+    def test_rows_and_columns_composed(self, devices8):
+        """2-D {shards x tp} mesh: X tiled over BOTH axes (each device
+        holds one (n/2, d/4) tile), y row-sharded, w column-sharded —
+        and the posterior still matches the unsharded build."""
+        mesh = make_mesh({"shards": 2, "tp": 4}, devices=devices8)
+        X, y, _ = generate_wide_logistic_data(128, 64, seed=3)
+        tp2 = TensorParallelLogistic(
+            X, y, mesh=mesh, rows_axis="shards"
+        )
+        ref = TensorParallelLogistic(X, y)
+        pt = jax.tree_util.tree_map(
+            lambda a: a + 0.2, tp2.init_params()
+        )
+        pr = jax.tree_util.tree_map(
+            lambda a: a + 0.2, ref.init_params()
+        )
+        np.testing.assert_allclose(
+            float(tp2.logp(pt)), float(ref.logp(pr)), rtol=2e-5
+        )
+        _, g2 = tp2.logp_and_grad(pt)
+        _, gr = ref.logp_and_grad(pr)
+        np.testing.assert_allclose(
+            np.asarray(g2["w"]), np.asarray(gr["w"]), rtol=1e-4,
+            atol=1e-5,
+        )
+        # X is tiled over both axes, not just one
+        assert not tp2.X.sharding.is_fully_replicated
+        assert tp2.X.sharding.shard_shape(tp2.X.shape) == (64, 16)
