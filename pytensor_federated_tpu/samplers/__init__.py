@@ -1,6 +1,6 @@
 """On-device samplers (replaces the reference's PyMC driver dependency)."""
 
-from .advi import ADVIResult, advi_fit
+from .advi import ADVIResult, FullRankADVIResult, advi_fit, fullrank_advi_fit
 from .convergence import (
     effective_sample_size,
     hdi,
@@ -43,6 +43,8 @@ __all__ = [
     "SGLDResult",
     "SMCResult",
     "advi_fit",
+    "fullrank_advi_fit",
+    "FullRankADVIResult",
     "ensemble_sample",
     "smc_sample",
     "HMCState",
