@@ -1,6 +1,7 @@
 """On-device samplers (replaces the reference's PyMC driver dependency)."""
 
 from .advi import ADVIResult, FullRankADVIResult, advi_fit, fullrank_advi_fit
+from .flows import FlowADVIResult, realnvp_advi_fit
 from .convergence import (
     effective_sample_size,
     hdi,
@@ -45,6 +46,8 @@ __all__ = [
     "advi_fit",
     "fullrank_advi_fit",
     "FullRankADVIResult",
+    "FlowADVIResult",
+    "realnvp_advi_fit",
     "ensemble_sample",
     "smc_sample",
     "HMCState",

@@ -1,0 +1,190 @@
+"""Normalizing-flow variational inference (RealNVP couplings).
+
+The top rung of the VI ladder (mean-field → full-rank → flow): a
+RealNVP flow pushes ``N(0, I)`` through alternating affine coupling
+layers, so ``q`` can fit curved, non-Gaussian posteriors (bananas,
+funnels) that no Gaussian family can.  Pure JAX — the coupling nets
+are two-layer tanh MLPs stored as plain pytrees, optimized by optax
+exactly like :mod:`.advi`; the whole fit is one ``lax.scan`` under
+jit, and a flow draw is a stack of small matmuls (MXU work).
+
+ELBO with the reparameterization trick through the flow::
+
+    x = f(z),  z ~ N(0, I)
+    ELBO = E_z[ logp(x) + logdet Jf(z) ] + H[N(0, I)]
+
+(the base entropy is closed-form; the log-determinant of an affine
+coupling is the sum of its scale outputs).
+
+Dimension-1 targets have nothing to couple; ``realnvp_advi_fit``
+requires ``d >= 2`` and points dim-1 users at :func:`.advi.advi_fit`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..utils import LOG_2PI
+from .util import flatten_logp
+
+try:
+    import optax
+
+    _HAS_OPTAX = True
+except ModuleNotFoundError:  # pragma: no cover
+    _HAS_OPTAX = False
+
+__all__ = ["FlowADVIResult", "realnvp_advi_fit"]
+
+
+def _mlp_init(key, in_dim, hidden, out_dim, dtype):
+    k1, k2 = jax.random.split(key)
+    s1 = 1.0 / jnp.sqrt(in_dim)
+    s2 = 1.0 / jnp.sqrt(hidden)
+    return {
+        "w1": s1 * jax.random.normal(k1, (in_dim, hidden), dtype),
+        "b1": jnp.zeros((hidden,), dtype),
+        # zero-init output layer: the flow starts as the identity,
+        # which keeps early ELBO gradients sane (standard RealNVP
+        # practice).
+        "w2": jnp.zeros((hidden, 2 * out_dim), dtype),
+        "b2": jnp.zeros((2 * out_dim,), dtype),
+        "s2_scale": s2,  # kept for shape bookkeeping only
+    }
+
+
+def _mlp_apply(p, x):
+    h = jnp.tanh(x @ p["w1"] + p["b1"])
+    return h @ p["w2"] + p["b2"]
+
+
+def _coupling_forward(p, x, mask):
+    """One affine coupling: the masked half parameterizes an affine
+    map of the complement.  Returns ``(y, logdet)``."""
+    xm = x * mask
+    st = _mlp_apply(p, xm)
+    d = x.shape[-1]
+    s, t = st[..., :d], st[..., d:]
+    # soft-clamp the log-scale so one bad step cannot explode the flow
+    s = jnp.tanh(s) * 2.0
+    free = 1.0 - mask
+    y = xm + free * (x * jnp.exp(s) + t)
+    logdet = jnp.sum(free * s, axis=-1)
+    return y, logdet
+
+
+class FlowADVIResult(NamedTuple):
+    flow_params: Any  # list of coupling-net pytrees
+    masks: jax.Array  # (num_layers, d) binary masks
+    shift: jax.Array  # (d,) base-distribution shift (the init point)
+    elbo_trace: jax.Array  # (num_steps,)
+    dim: int
+
+    def _forward(self, z):
+        """The SAME map the ELBO optimized: shifted base through the
+        coupling stack.  The shift is volume-preserving (logdet 0)."""
+        logdet = jnp.zeros(z.shape[:-1], z.dtype)
+        x = z + self.shift
+        for p, mask in zip(self.flow_params, self.masks):
+            x, ld = _coupling_forward(p, x, mask)
+            logdet = logdet + ld
+        return x, logdet
+
+    def sample(self, key: jax.Array, n: int, unravel) -> Any:
+        z = jax.random.normal(key, (n, self.dim))
+        x, _ = self._forward(z)
+        return jax.vmap(unravel)(x)
+
+    def sample_with_logq(self, key: jax.Array, n: int):
+        """Flat draws and their variational log-density (for
+        importance reweighting / PSIS diagnostics)."""
+        z = jax.random.normal(key, (n, self.dim))
+        x, logdet = self._forward(z)
+        log_base = -0.5 * jnp.sum(z**2, axis=-1) - 0.5 * self.dim * LOG_2PI
+        return x, log_base - logdet
+
+
+def realnvp_advi_fit(
+    logp_fn: Callable[[Any], jax.Array],
+    init_params: Any,
+    *,
+    key: jax.Array,
+    num_layers: int = 6,
+    hidden: int = 32,
+    num_steps: int = 3000,
+    n_mc: int = 16,
+    learning_rate: float = 3e-3,
+) -> tuple[FlowADVIResult, Callable]:
+    """Fit a RealNVP flow posterior to ``logp_fn``.
+
+    Same contract as :func:`.advi.advi_fit`: returns ``(result,
+    unravel)``; ``result.sample(key, n, unravel)`` draws in the user's
+    pytree structure.
+    """
+    if not _HAS_OPTAX:
+        raise ModuleNotFoundError("realnvp_advi_fit requires optax")
+    flat_logp, flat_init, unravel = flatten_logp(logp_fn, init_params)
+    dim = flat_init.shape[0]
+    if dim < 2:
+        raise ValueError(
+            "RealNVP couplings need d >= 2; use advi_fit for scalars"
+        )
+    dtype = flat_init.dtype
+    batch_logp = jax.vmap(flat_logp)
+
+    # alternating even/odd masks
+    base_mask = (jnp.arange(dim) % 2).astype(dtype)
+    masks = jnp.stack(
+        [base_mask if i % 2 == 0 else 1.0 - base_mask
+         for i in range(num_layers)]
+    )
+
+    k_init, k_fit = jax.random.split(key)
+    flow0 = [
+        _mlp_init(k, dim, hidden, dim, dtype)
+        for k in jax.random.split(k_init, num_layers)
+    ]
+
+    opt = optax.adam(learning_rate)
+    base_entropy = 0.5 * dim * (1.0 + LOG_2PI)
+
+    def neg_elbo(flow, key):
+        z = jax.random.normal(key, (n_mc, dim), dtype)
+        # shift the base by the MAP-ish init so the identity-init flow
+        # starts centered where the user's init_params point
+        x = z + flat_init[None, :]
+        logdet = jnp.zeros((n_mc,), dtype)
+        for p, mask in zip(flow, masks):
+            x, ld = _coupling_forward(p, x, mask)
+            logdet = logdet + ld
+        elbo = jnp.mean(batch_logp(x) + logdet) + base_entropy
+        return -elbo
+
+    @jax.jit
+    def run(key):
+        opt0 = opt.init(flow0)
+
+        def step(carry, key):
+            flow, opt_state = carry
+            loss, g = jax.value_and_grad(neg_elbo)(flow, key)
+            updates, opt_state = opt.update(g, opt_state)
+            flow = optax.apply_updates(flow, updates)
+            return (flow, opt_state), -loss
+
+        (flow, _), elbos = jax.lax.scan(
+            step, (flow0, opt0), jax.random.split(key, num_steps)
+        )
+        return flow, elbos
+
+    flow, elbos = run(k_fit)
+    result = FlowADVIResult(
+        flow_params=flow,
+        masks=masks,
+        shift=flat_init,
+        elbo_trace=elbos,
+        dim=dim,
+    )
+    return result, unravel

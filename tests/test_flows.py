@@ -1,0 +1,67 @@
+"""RealNVP flow VI — non-Gaussian posteriors beyond any Gaussian family.
+
+The banana (Rosenbrock-style) target is the standard demonstration: a
+curved ridge no Gaussian q can follow.  Pinned: the flow's ELBO beats
+the full-rank Gaussian's on the banana, flow samples follow the curve
+(E[x2 | x1] ≈ x1²), and sample_with_logq's density is consistent with
+the change-of-variables (checked against a long-run importance
+identity).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytensor_federated_tpu.samplers import fullrank_advi_fit
+from pytensor_federated_tpu.samplers.flows import realnvp_advi_fit
+
+
+def banana_logp(p):
+    x = p["x"]
+    return -0.5 * x[0] ** 2 - 0.5 * ((x[1] - x[0] ** 2) / 0.5) ** 2
+
+
+def test_flow_fits_banana_better_than_gaussian():
+    kw = dict(key=jax.random.PRNGKey(0), num_steps=2500)
+    res_flow, unravel = realnvp_advi_fit(
+        banana_logp, {"x": jnp.zeros(2)}, **kw
+    )
+    res_fr, _ = fullrank_advi_fit(banana_logp, {"x": jnp.zeros(2)}, **kw)
+    tail = lambda r: float(jnp.mean(r.elbo_trace[-200:]))
+    assert tail(res_flow) > tail(res_fr)
+
+    # flow samples follow the curved ridge: E[x2 | x1] ~ x1^2
+    draws = res_flow.sample(jax.random.PRNGKey(1), 4000, unravel)
+    xs = np.asarray(draws["x"])
+    resid = xs[:, 1] - xs[:, 0] ** 2
+    assert abs(resid.mean()) < 0.2
+    assert resid.std() < 1.0  # conditional sd is 0.5; Gaussian q can't
+    assert abs(xs[:, 0].mean()) < 0.25
+
+
+def test_sample_with_logq_is_a_density():
+    # Importance identity: E_q[exp(logp - logq)] = Z (here the banana's
+    # normalizer, a finite constant) — a WRONG logq (e.g. missing
+    # logdet) makes the weights blow up or collapse by orders of
+    # magnitude.  Check the log-weights are tight around a constant.
+    res, _ = realnvp_advi_fit(
+        banana_logp,
+        {"x": jnp.zeros(2)},
+        key=jax.random.PRNGKey(3),
+        num_steps=2500,
+    )
+    x, logq = res.sample_with_logq(jax.random.PRNGKey(4), 4000)
+    logp = jax.vmap(lambda v: banana_logp({"x": v}))(x)
+    lw = np.asarray(logp - logq)
+    # a well-fit flow keeps the weights in a narrow band
+    assert np.std(lw) < 1.0
+
+
+def test_dim1_rejected():
+    with pytest.raises(ValueError, match="d >= 2"):
+        realnvp_advi_fit(
+            lambda p: -0.5 * p["x"] ** 2,
+            {"x": jnp.zeros(())},
+            key=jax.random.PRNGKey(0),
+        )
