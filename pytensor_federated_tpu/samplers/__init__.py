@@ -2,6 +2,7 @@
 
 from .advi import ADVIResult, FullRankADVIResult, advi_fit, fullrank_advi_fit
 from .flows import FlowADVIResult, realnvp_advi_fit
+from .sbc import SBCResult, sbc_ranks, sbc_uniformity
 from .convergence import (
     effective_sample_size,
     hdi,
@@ -48,6 +49,9 @@ __all__ = [
     "FullRankADVIResult",
     "FlowADVIResult",
     "realnvp_advi_fit",
+    "SBCResult",
+    "sbc_ranks",
+    "sbc_uniformity",
     "ensemble_sample",
     "smc_sample",
     "HMCState",
