@@ -23,7 +23,7 @@ Both run the entire optimization in one ``lax.scan`` under jit.
 
 from __future__ import annotations
 
-from typing import Any, Callable, NamedTuple
+from typing import Any, Callable, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -63,28 +63,51 @@ def advi_fit(
     n_mc: int = 8,
     learning_rate: float = 1e-2,
     init_log_sd: float = -2.0,
+    stochastic_logp_fn: Optional[Callable[[Any, jax.Array], jax.Array]] = None,
 ) -> tuple[ADVIResult, Callable]:
     """Fit mean-field ADVI to ``logp_fn``; returns ``(result, unravel)``.
 
     The whole optimization (all steps) runs in one ``lax.scan`` under
     jit.  ``result.sample(key, n, unravel)`` draws from the fitted
     approximation in user pytree structure.
+
+    ``stochastic_logp_fn(params, key) -> scalar`` switches to DOUBLY
+    stochastic VI: the MC expectation over q AND an unbiased minibatch
+    estimate of the logp itself — e.g.
+    ``lambda p, k: fed.logp_minibatch(p, k, num_shards=m)`` subsamples
+    federated shards per optimization step, so per-step cost drops
+    with the subsample while the ELBO gradient stays unbiased.
+    ``logp_fn`` is still used to fix the parameter pytree structure.
     """
     if not _HAS_OPTAX:
         raise ModuleNotFoundError("advi_fit requires optax")
     flat_logp, flat_init, unravel = flatten_logp(logp_fn, init_params)
     dim = flat_init.shape[0]
     dtype = flat_init.dtype
-    batch_logp = jax.vmap(flat_logp)
+    if stochastic_logp_fn is None:
+        batch_logp = jax.vmap(flat_logp)
+
+        def e_logp_fn(x, key):
+            return jnp.mean(batch_logp(x))
+
+    else:
+
+        def e_logp_fn(x, key):
+            keys = jax.random.split(key, x.shape[0])
+            vals = jax.vmap(
+                lambda xi, ki: stochastic_logp_fn(unravel(xi), ki)
+            )(x, keys)
+            return jnp.mean(vals)
 
     opt = optax.adam(learning_rate)
 
     def neg_elbo(var_params, key):
         mu, log_sd = var_params
-        eps = jax.random.normal(key, (n_mc, dim), dtype)
+        k_eps, k_mb = jax.random.split(key)
+        eps = jax.random.normal(k_eps, (n_mc, dim), dtype)
         x = mu[None, :] + jnp.exp(log_sd)[None, :] * eps
-        # E_q[logp] (MC) + entropy of q (closed form).
-        e_logp = jnp.mean(batch_logp(x))
+        # E_q[logp] (MC; optionally minibatched) + entropy (closed form).
+        e_logp = e_logp_fn(x, k_mb)
         entropy = jnp.sum(log_sd) + 0.5 * dim * (1.0 + LOG_2PI)
         return -(e_logp + entropy)
 
