@@ -103,7 +103,12 @@ def advi_fit(
 
     def neg_elbo(var_params, key):
         mu, log_sd = var_params
-        k_eps, k_mb = jax.random.split(key)
+        if stochastic_logp_fn is None:
+            # keep the non-stochastic RNG stream EXACTLY as before the
+            # stochastic option existed (seeded tests pin it)
+            k_eps, k_mb = key, key
+        else:
+            k_eps, k_mb = jax.random.split(key)
         eps = jax.random.normal(k_eps, (n_mc, dim), dtype)
         x = mu[None, :] + jnp.exp(log_sd)[None, :] * eps
         # E_q[logp] (MC; optionally minibatched) + entropy (closed form).
