@@ -584,9 +584,15 @@ def main():
     # record says so instead of carrying meaningless FLOP fields.
     def _c11():
         import multiprocessing as mp
+        import socket
         import time as _time
 
-        port = 53211
+        def free_port():
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                return s.getsockname()[1]
+
+        port = free_port()
         ctx = mp.get_context("spawn")
         proc = ctx.Process(
             target=_bench_serve_node, args=(port,), daemon=True
@@ -642,7 +648,7 @@ def main():
             if os.path.exists(binary):
                 from pytensor_federated_tpu.service import TcpArraysClient
 
-                cport = 53212
+                cport = free_port()
                 cproc = sp.Popen(
                     [binary, str(cport)], stdout=sp.PIPE,
                     stderr=sp.STDOUT, text=True,
