@@ -149,3 +149,55 @@ class TestTensorParallel2D:
         # X is tiled over both axes, not just one
         assert not tp2.X.sharding.is_fully_replicated
         assert tp2.X.sharding.shard_shape(tp2.X.shape) == (64, 16)
+
+
+class TestParallelProperties:
+    """Property-style sweeps: equality with the unsharded build must
+    hold across the shape space, not just the hand-picked cases."""
+
+    @pytest.mark.parametrize("n,d", [(8, 8), (33, 16), (64, 24), (5, 48)])
+    def test_tp_equality_across_shapes(self, devices8, n, d):
+        mesh = make_mesh({"tp": 8}, devices=devices8)
+        X, y, _ = generate_wide_logistic_data(n, d, seed=n * d)
+        tp = TensorParallelLogistic(X, y, mesh=mesh)
+        ref = TensorParallelLogistic(X, y)
+        pt = jax.tree_util.tree_map(
+            lambda a: a + 0.1, tp.init_params()
+        )
+        pr = jax.tree_util.tree_map(
+            lambda a: a + 0.1, ref.init_params()
+        )
+        np.testing.assert_allclose(
+            float(tp.logp(pt)), float(ref.logp(pr)), rtol=5e-5
+        )
+        _, gt = tp.logp_and_grad(pt)
+        _, gr = ref.logp_and_grad(pr)
+        np.testing.assert_allclose(
+            np.asarray(gt["w"]), np.asarray(gr["w"]), rtol=2e-4,
+            atol=1e-5,
+        )
+
+    @pytest.mark.parametrize("n_obs,k,n_dev", [
+        (17, 8, 2), (64, 12, 4), (9, 16, 8), (128, 8, 8),
+    ])
+    def test_ep_equality_across_shapes(self, devices8, n_obs, k, n_dev):
+        mesh = make_mesh({"experts": n_dev}, devices=devices8[:n_dev])
+        y, _ = generate_expert_mixture_data(n_obs, seed=n_obs + k)
+        ep = ExpertShardedMixture(y, k, mesh=mesh)
+        ref = ExpertShardedMixture(y, k)
+        pe = jax.tree_util.tree_map(
+            lambda a: a + 0.05, ep.init_params()
+        )
+        pr = jax.tree_util.tree_map(
+            lambda a: a + 0.05, ref.init_params()
+        )
+        np.testing.assert_allclose(
+            float(ep.logp(pe)), float(ref.logp(pr)), rtol=5e-5
+        )
+        _, ge = ep.logp_and_grad(pe)
+        _, gr2 = ref.logp_and_grad(pr)
+        for key_ in gr2:
+            np.testing.assert_allclose(
+                np.asarray(ge[key_]), np.asarray(gr2[key_]),
+                rtol=2e-4, atol=1e-5,
+            )
