@@ -26,6 +26,10 @@ from .mixture import (
     generate_mixture_data,
     mixture_loglik,
 )
+from .multinomial import (
+    FederatedSoftmaxRegression,
+    generate_multinomial_data,
+)
 from .ode import (
     LotkaVolterraModel,
     generate_lv_data,
@@ -67,6 +71,8 @@ from .timeseries import SeqShardedAR1, generate_ar1_data
 __all__ = [
     "FederatedGammaGLM",
     "FederatedGaussianMixture",
+    "FederatedSoftmaxRegression",
+    "generate_multinomial_data",
     "FederatedExactGP",
     "FederatedNegBinGLM",
     "FederatedOrdinalRegression",
