@@ -28,6 +28,8 @@ from .mixture import (
 )
 from .multinomial import (
     FederatedSoftmaxRegression,
+    HierarchicalSoftmaxRegression,
+    generate_hier_multinomial_data,
     generate_multinomial_data,
 )
 from .ode import (
@@ -72,6 +74,8 @@ __all__ = [
     "FederatedGammaGLM",
     "FederatedGaussianMixture",
     "FederatedSoftmaxRegression",
+    "HierarchicalSoftmaxRegression",
+    "generate_hier_multinomial_data",
     "generate_multinomial_data",
     "FederatedExactGP",
     "FederatedNegBinGLM",

@@ -32,6 +32,8 @@ from .linear import _normal_logpdf
 
 __all__ = [
     "FederatedSoftmaxRegression",
+    "HierarchicalSoftmaxRegression",
+    "generate_hier_multinomial_data",
     "generate_multinomial_data",
 ]
 
@@ -141,6 +143,124 @@ class FederatedSoftmaxRegression:
 
         keys = jax.random.split(key, X.shape[0])
         return jax.vmap(one)(X, keys)
+
+    def find_map(self, **kwargs):
+        from ..samplers import find_map
+
+        return find_map(self.logp, self.init_params(), **kwargs)
+
+    def sample(self, *, key=None, **kwargs):
+        from ..samplers import sample
+
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        return sample(self.logp, self.init_params(), key=key, **kwargs)
+
+
+def generate_hier_multinomial_data(
+    n_shards: int = 8,
+    *,
+    n_obs: int = 64,
+    n_features: int = 3,
+    n_classes: int = 3,
+    tau: float = 0.8,
+    seed: int = 47,
+):
+    """Per-shard data with shard-specific class intercepts
+    ``b_s ~ N(b0, tau)`` (one per free class)."""
+    rng = np.random.default_rng(seed)
+    W = rng.normal(0, 1.0, size=(n_features, n_classes - 1))
+    b0 = rng.normal(0, 0.5, size=(n_classes - 1,))
+    b_s = b0[None, :] + tau * rng.normal(
+        size=(n_shards, n_classes - 1)
+    )
+    shards = []
+    for s in range(n_shards):
+        X = rng.normal(size=(n_obs, n_features)).astype(np.float32)
+        logits = np.concatenate(
+            [np.zeros((n_obs, 1)), X @ W + b_s[s]], axis=1
+        )
+        p = np.exp(logits - logits.max(axis=1, keepdims=True))
+        p /= p.sum(axis=1, keepdims=True)
+        y = np.array(
+            [rng.choice(n_classes, p=pi) for pi in p], dtype=np.float32
+        )
+        shards.append((X, y))
+    return pack_shards(shards), {"W": W, "b0": b0, "tau": tau}
+
+
+@dataclasses.dataclass
+class HierarchicalSoftmaxRegression:
+    """Mixed-effects softmax: shared slopes, per-site class intercepts.
+
+    Non-centered like the other hierarchical families
+    (:class:`.logistic.HierarchicalLogisticRegression`)::
+
+        W ~ Normal(0, prior_scale)          (d, K-1), shared
+        b0 ~ Normal(0, prior_scale)         (K-1,)
+        tau ~ HalfNormal(1)                 via log_tau + Jacobian
+        b_raw_s ~ Normal(0, 1)              (S, K-1) per site
+        logits = [0, X_s W + b0 + tau * b_raw_s]
+    """
+
+    data: ShardedData
+    n_classes: int
+    mesh: Optional[Mesh] = None
+    prior_scale: float = 5.0
+
+    def __post_init__(self):
+        K = int(self.n_classes)
+        if K < 2:
+            raise ValueError(f"n_classes must be >= 2, got {K}")
+        self._k = K
+        (X, y), mask = self.data.tree()
+        n = X.shape[0]
+        shard_ids = jnp.arange(n, dtype=jnp.int32)
+
+        def per_shard_logp(params, shard):
+            (X_s, y_s), m_s, sid = shard
+            tau = jnp.exp(params["log_tau"])
+            b = params["b0"] + tau * jnp.take(
+                params["b_raw"], sid, axis=0
+            )
+            free = X_s @ params["W"] + b
+            eta = jnp.concatenate(
+                [jnp.zeros(free.shape[:-1] + (1,), free.dtype), free],
+                axis=-1,
+            )
+            ll = jnp.take_along_axis(
+                eta, y_s.astype(jnp.int32)[:, None], axis=1
+            )[:, 0] - jax.scipy.special.logsumexp(eta, axis=1)
+            return jnp.sum(ll * m_s)
+
+        self.fed = FederatedLogp(
+            per_shard_logp, ((X, y), mask, shard_ids), mesh=self.mesh
+        )
+        self.n_shards = n
+        self.n_features = X.shape[-1]
+
+    def prior_logp(self, params: Any) -> jax.Array:
+        lp = jnp.sum(_normal_logpdf(params["W"], 0.0, self.prior_scale))
+        lp += jnp.sum(_normal_logpdf(params["b0"], 0.0, self.prior_scale))
+        # HalfNormal(1) on tau via log_tau with the log|J| = log_tau
+        tau = jnp.exp(params["log_tau"])
+        lp += -0.5 * tau**2 + params["log_tau"]
+        lp += jnp.sum(_normal_logpdf(params["b_raw"], 0.0, 1.0))
+        return lp
+
+    def logp(self, params: Any) -> jax.Array:
+        return self.prior_logp(params) + self.fed.logp(params)
+
+    def logp_and_grad(self, params: Any):
+        return jax.value_and_grad(self.logp)(params)
+
+    def init_params(self) -> Any:
+        return {
+            "W": jnp.zeros((self.n_features, self._k - 1)),
+            "b0": jnp.zeros((self._k - 1,)),
+            "log_tau": jnp.zeros(()),
+            "b_raw": jnp.zeros((self.n_shards, self._k - 1)),
+        }
 
     def find_map(self, **kwargs):
         from ..samplers import find_map

@@ -118,3 +118,43 @@ def test_posterior_sampling_converges():
     )
     summ = res.summary()
     assert float(np.max(np.asarray(summ["rhat"]["W"]))) < 1.1
+
+
+class TestHierarchicalSoftmax:
+    def test_truth_recovery_and_shrinkage(self):
+        from pytensor_federated_tpu.models.multinomial import (
+            HierarchicalSoftmaxRegression,
+            generate_hier_multinomial_data,
+        )
+
+        data, truth = generate_hier_multinomial_data(
+            12, n_obs=96, n_features=2, n_classes=3, tau=0.8, seed=51
+        )
+        model = HierarchicalSoftmaxRegression(data, n_classes=3)
+        est = model.find_map(num_steps=2500, learning_rate=0.05)
+        np.testing.assert_allclose(
+            np.asarray(est["W"]), truth["W"], atol=0.6
+        )
+        # the group scale is estimated in a sane band around 0.8
+        tau_hat = float(np.exp(np.asarray(est["log_tau"])))
+        assert 0.2 < tau_hat < 2.5
+
+    def test_mesh_matches_local(self, devices8):
+        from pytensor_federated_tpu.models.multinomial import (
+            HierarchicalSoftmaxRegression,
+            generate_hier_multinomial_data,
+        )
+        from pytensor_federated_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh({"shards": 8}, devices=devices8)
+        data, _ = generate_hier_multinomial_data(8, n_obs=16)
+        local = HierarchicalSoftmaxRegression(data, n_classes=3)
+        sharded = HierarchicalSoftmaxRegression(
+            data, n_classes=3, mesh=mesh
+        )
+        p = jax.tree_util.tree_map(
+            lambda a: a + 0.1, local.init_params()
+        )
+        np.testing.assert_allclose(
+            float(local.logp(p)), float(sharded.logp(p)), rtol=5e-5
+        )
