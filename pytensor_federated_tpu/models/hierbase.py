@@ -82,6 +82,14 @@ class HierarchicalGLMBase:
     #: param tree, the prior, and the implied intercepts.
     _has_global_intercept: bool = True
 
+    #: None: scalar linear predictor (one eta per observation — the
+    #: Bernoulli/Poisson/... families).  An int ``m``: VECTOR predictor
+    #: with ``m`` columns (``w``: (d, m), ``b0``: (m,), ``b_raw``:
+    #: (S, m), eta: (..., m)) — the multinomial family sets
+    #: ``m = K - 1``.  Every broadcasting expression below works for
+    #: both cases unchanged; only the parameter shapes differ.
+    _coef_cols = None
+
     def _intercept_base(self, params):
         return params["b0"] if self._has_global_intercept else 0.0
 
@@ -107,7 +115,7 @@ class HierarchicalGLMBase:
             (X, y), mask, sid = shard
             tau = jnp.exp(params["log_tau"])
             b = self._intercept_base(params) + tau * jnp.take(
-                params["b_raw"], sid
+                params["b_raw"], sid, axis=0
             )
             eta = self._linear_predictor(X, params["w"], b)
             ll = self._obs_logpmf(params, y, eta)
@@ -128,7 +136,7 @@ class HierarchicalGLMBase:
         s = self.prior_scale
         lp = jnp.sum(_normal_logpdf(params["w"], 0.0, s))
         if self._has_global_intercept:
-            lp += _normal_logpdf(params["b0"], 0.0, s)
+            lp += jnp.sum(_normal_logpdf(params["b0"], 0.0, s))
         lp += jnp.sum(_normal_logpdf(params["b_raw"], 0.0, 1.0))
         # HalfNormal(1) on tau via the log-transform + Jacobian.
         tau = jnp.exp(params["log_tau"])
@@ -148,14 +156,18 @@ class HierarchicalGLMBase:
     def logp_and_grad(self, params: Any):
         return jax.value_and_grad(self.logp)(params)
 
+    def _shape(self, *lead):
+        m = self._coef_cols
+        return lead if m is None else lead + (m,)
+
     def init_params(self) -> Any:
         p = {
-            "w": jnp.zeros((self.n_features,)),
+            "w": jnp.zeros(self._shape(self.n_features)),
             "log_tau": jnp.array(self._init_log_tau),
-            "b_raw": jnp.zeros((self.n_shards,)),
+            "b_raw": jnp.zeros(self._shape(self.n_shards)),
         }
         if self._has_global_intercept:
-            p["b0"] = jnp.zeros(())
+            p["b0"] = jnp.zeros(self._shape())
         return p
 
     def _sample_obs(self, params, key, eta):  # pragma: no cover - abstract
@@ -198,13 +210,15 @@ class HierarchicalGLMBase:
         ks = jax.random.split(key, 5)
         p = {
             "w": self.prior_scale * jax.random.normal(
-                ks[0], (self.n_features,)
+                ks[0], self._shape(self.n_features)
             ),
             "log_tau": log_halfnormal_draw(ks[1]),  # HalfNormal(1)
-            "b_raw": jax.random.normal(ks[2], (self.n_shards,)),
+            "b_raw": jax.random.normal(ks[2], self._shape(self.n_shards)),
         }
         if self._has_global_intercept:
-            p["b0"] = self.prior_scale * jax.random.normal(ks[3])
+            p["b0"] = self.prior_scale * jax.random.normal(
+                ks[3], self._shape()
+            )
         p.update(self._sample_extra_params(ks[4]))
         return p
 

@@ -14,6 +14,12 @@ Reference-class parameterization (class 0's logits pinned to zero)
 keeps the model identifiable without constraints.  Per-shard compute
 is one ``(n, d) @ (d, K-1)`` matmul — batched over shards, exactly the
 MXU shape — and the normalizer is one logsumexp over K.
+
+The hierarchical variant (:class:`HierarchicalSoftmaxRegression`) sits
+on :class:`.hierbase.HierarchicalGLMBase` with ``_coef_cols = K - 1``:
+the non-centered construction, HalfNormal Jacobian, and the
+pointwise/predictive/prior machinery are the base's single
+implementations, shared with every other hierarchical family.
 """
 
 from __future__ import annotations
@@ -28,6 +34,7 @@ from jax.sharding import Mesh
 
 from ..parallel.packing import ShardedData, pack_shards
 from ..parallel.sharded import FederatedLogp
+from .hierbase import HierarchicalGLMBase
 from .linear import _normal_logpdf
 
 __all__ = [
@@ -36,6 +43,51 @@ __all__ = [
     "generate_hier_multinomial_data",
     "generate_multinomial_data",
 ]
+
+
+def _pinned_logits(free):
+    """(…, K) logits from (…, K-1) free columns; class 0 pinned to 0."""
+    zero = jnp.zeros(free.shape[:-1] + (1,), free.dtype)
+    return jnp.concatenate([zero, free], axis=-1)
+
+
+def _categorical_loglik(y, free):
+    """Per-observation categorical log-likelihood from the free
+    (unpinned) logit columns — THE one implementation, shared by the
+    flat and hierarchical models (logp, pointwise, predictive all
+    route here or through :func:`_pinned_logits`)."""
+    eta = _pinned_logits(free)
+    y_idx = y.astype(jnp.int32)
+    picked = jnp.take_along_axis(eta, y_idx[..., None], axis=-1)[..., 0]
+    return picked - jax.scipy.special.logsumexp(eta, axis=-1)
+
+
+def _sample_categorical(key, free):
+    return jax.random.categorical(
+        key, _pinned_logits(free), axis=-1
+    ).astype(jnp.float32)
+
+
+def _simulate_softmax_shards(rng, n_shards, n_obs, n_features,
+                             n_classes, W, intercepts):
+    """Shared simulator: per-shard intercept rows (broadcast for the
+    flat model), zero-pinned softmax draws."""
+    intercepts = np.broadcast_to(
+        intercepts, (n_shards, n_classes - 1)
+    )
+    shards = []
+    for s in range(n_shards):
+        X = rng.normal(size=(n_obs, n_features)).astype(np.float32)
+        logits = np.concatenate(
+            [np.zeros((n_obs, 1)), X @ W + intercepts[s]], axis=1
+        )
+        p = np.exp(logits - logits.max(axis=1, keepdims=True))
+        p /= p.sum(axis=1, keepdims=True)
+        y = np.array(
+            [rng.choice(n_classes, p=pi) for pi in p], dtype=np.float32
+        )
+        shards.append((X, y))
+    return pack_shards(shards)
 
 
 def generate_multinomial_data(
@@ -49,19 +101,33 @@ def generate_multinomial_data(
     rng = np.random.default_rng(seed)
     W = rng.normal(0, 1.0, size=(n_features, n_classes - 1))
     b = rng.normal(0, 0.5, size=(n_classes - 1,))
-    shards = []
-    for _ in range(n_shards):
-        X = rng.normal(size=(n_obs, n_features)).astype(np.float32)
-        logits = np.concatenate(
-            [np.zeros((n_obs, 1)), X @ W + b], axis=1
-        )
-        p = np.exp(logits - logits.max(axis=1, keepdims=True))
-        p /= p.sum(axis=1, keepdims=True)
-        y = np.array(
-            [rng.choice(n_classes, p=pi) for pi in p], dtype=np.float32
-        )
-        shards.append((X, y))
-    return pack_shards(shards), {"W": W, "b": b}
+    packed = _simulate_softmax_shards(
+        rng, n_shards, n_obs, n_features, n_classes, W, b
+    )
+    return packed, {"W": W, "b": b}
+
+
+def generate_hier_multinomial_data(
+    n_shards: int = 8,
+    *,
+    n_obs: int = 64,
+    n_features: int = 3,
+    n_classes: int = 3,
+    tau: float = 0.8,
+    seed: int = 47,
+):
+    """Per-shard data with shard-specific class intercepts
+    ``b_s ~ N(b0, tau)`` (one per free class)."""
+    rng = np.random.default_rng(seed)
+    W = rng.normal(0, 1.0, size=(n_features, n_classes - 1))
+    b0 = rng.normal(0, 0.5, size=(n_classes - 1,))
+    b_s = b0[None, :] + tau * rng.normal(
+        size=(n_shards, n_classes - 1)
+    )
+    packed = _simulate_softmax_shards(
+        rng, n_shards, n_obs, n_features, n_classes, W, b_s
+    )
+    return packed, {"W": W, "b0": b0, "tau": tau}
 
 
 @dataclasses.dataclass
@@ -79,11 +145,7 @@ class FederatedSoftmaxRegression:
 
         def per_shard_logp(params, shard):
             (X, y), mask = shard
-            eta = self._logits(params, X)  # (n, K)
-            y_idx = y.astype(jnp.int32)
-            ll = jnp.take_along_axis(
-                eta, y_idx[:, None], axis=1
-            )[:, 0] - jax.scipy.special.logsumexp(eta, axis=1)
+            ll = _categorical_loglik(y, X @ params["W"] + params["b"])
             return jnp.sum(ll * mask)
 
         self.fed = FederatedLogp(
@@ -92,12 +154,6 @@ class FederatedSoftmaxRegression:
         self.n_features = jax.tree_util.tree_leaves(self.data.data)[
             0
         ].shape[-1]
-
-    def _logits(self, params, X):
-        """(n, K) logits with class 0 pinned to zero."""
-        free = X @ params["W"] + params["b"]  # (n, K-1)
-        zero = jnp.zeros(free.shape[:-1] + (1,), free.dtype)
-        return jnp.concatenate([zero, free], axis=-1)
 
     def prior_logp(self, params: Any) -> jax.Array:
         lp = jnp.sum(_normal_logpdf(params["W"], 0.0, self.prior_scale))
@@ -120,15 +176,8 @@ class FederatedSoftmaxRegression:
         """Flat per-observation log-likelihoods (masked slots -> 0),
         for PSIS-LOO / WAIC (samplers.model_comparison)."""
         (X, y), mask = self.data.tree()
-
-        def one(X_s, y_s, m_s):
-            eta = self._logits(params, X_s)
-            ll = jnp.take_along_axis(
-                eta, y_s.astype(jnp.int32)[:, None], axis=1
-            )[:, 0] - jax.scipy.special.logsumexp(eta, axis=1)
-            return ll * m_s
-
-        return jax.vmap(one)(X, y, mask).reshape(-1)
+        ll = _categorical_loglik(y, X @ params["W"] + params["b"])
+        return (ll * mask).reshape(-1)
 
     def predictive(self, params: Any, key) -> jax.Array:
         """Simulate class labels for every design row (padded slots
@@ -136,10 +185,7 @@ class FederatedSoftmaxRegression:
         (X, _y), _mask = self.data.tree()
 
         def one(X_s, k):
-            eta = self._logits(params, X_s)
-            return jax.random.categorical(k, eta, axis=-1).astype(
-                jnp.float32
-            )
+            return _sample_categorical(k, X_s @ params["W"] + params["b"])
 
         keys = jax.random.split(key, X.shape[0])
         return jax.vmap(one)(X, keys)
@@ -157,54 +203,30 @@ class FederatedSoftmaxRegression:
         return sample(self.logp, self.init_params(), key=key, **kwargs)
 
 
-def generate_hier_multinomial_data(
-    n_shards: int = 8,
-    *,
-    n_obs: int = 64,
-    n_features: int = 3,
-    n_classes: int = 3,
-    tau: float = 0.8,
-    seed: int = 47,
-):
-    """Per-shard data with shard-specific class intercepts
-    ``b_s ~ N(b0, tau)`` (one per free class)."""
-    rng = np.random.default_rng(seed)
-    W = rng.normal(0, 1.0, size=(n_features, n_classes - 1))
-    b0 = rng.normal(0, 0.5, size=(n_classes - 1,))
-    b_s = b0[None, :] + tau * rng.normal(
-        size=(n_shards, n_classes - 1)
-    )
-    shards = []
-    for s in range(n_shards):
-        X = rng.normal(size=(n_obs, n_features)).astype(np.float32)
-        logits = np.concatenate(
-            [np.zeros((n_obs, 1)), X @ W + b_s[s]], axis=1
-        )
-        p = np.exp(logits - logits.max(axis=1, keepdims=True))
-        p /= p.sum(axis=1, keepdims=True)
-        y = np.array(
-            [rng.choice(n_classes, p=pi) for pi in p], dtype=np.float32
-        )
-        shards.append((X, y))
-    return pack_shards(shards), {"W": W, "b0": b0, "tau": tau}
-
-
 @dataclasses.dataclass
-class HierarchicalSoftmaxRegression:
+class HierarchicalSoftmaxRegression(HierarchicalGLMBase):
     """Mixed-effects softmax: shared slopes, per-site class intercepts.
 
-    Non-centered like the other hierarchical families
-    (:class:`.logistic.HierarchicalLogisticRegression`)::
+    On :class:`.hierbase.HierarchicalGLMBase` with vector coefficient
+    columns (``_coef_cols = K - 1``)::
 
-        W ~ Normal(0, prior_scale)          (d, K-1), shared
+        w ~ Normal(0, prior_scale)          (d, K-1), shared
         b0 ~ Normal(0, prior_scale)         (K-1,)
         tau ~ HalfNormal(1)                 via log_tau + Jacobian
         b_raw_s ~ Normal(0, 1)              (S, K-1) per site
-        logits = [0, X_s W + b0 + tau * b_raw_s]
+        logits = [0, X_s w + b0 + tau * b_raw_s]
+
+    The base supplies the non-centered hierarchy, the HalfNormal
+    Jacobian, pointwise_loglik, predictive, sample_prior, intercepts,
+    and the MAP/NUTS front doors; this class supplies only the
+    categorical observation family.  (Round-3 review: a standalone
+    first version re-implemented the scaffolding the base exists to
+    centralize; the base's lowercase ``w`` param name is kept for
+    cross-family consistency.)
     """
 
-    data: ShardedData
-    n_classes: int
+    data: ShardedData = None
+    n_classes: int = 2
     mesh: Optional[Mesh] = None
     prior_scale: float = 5.0
 
@@ -212,64 +234,12 @@ class HierarchicalSoftmaxRegression:
         K = int(self.n_classes)
         if K < 2:
             raise ValueError(f"n_classes must be >= 2, got {K}")
-        self._k = K
-        (X, y), mask = self.data.tree()
-        n = X.shape[0]
-        shard_ids = jnp.arange(n, dtype=jnp.int32)
+        self._coef_cols = K - 1
+        self._post_init()
 
-        def per_shard_logp(params, shard):
-            (X_s, y_s), m_s, sid = shard
-            tau = jnp.exp(params["log_tau"])
-            b = params["b0"] + tau * jnp.take(
-                params["b_raw"], sid, axis=0
-            )
-            free = X_s @ params["W"] + b
-            eta = jnp.concatenate(
-                [jnp.zeros(free.shape[:-1] + (1,), free.dtype), free],
-                axis=-1,
-            )
-            ll = jnp.take_along_axis(
-                eta, y_s.astype(jnp.int32)[:, None], axis=1
-            )[:, 0] - jax.scipy.special.logsumexp(eta, axis=1)
-            return jnp.sum(ll * m_s)
+    def _obs_logpmf(self, params, y, eta):
+        # eta: (..., K-1) free logit columns from the base's X @ w + b
+        return _categorical_loglik(y, eta)
 
-        self.fed = FederatedLogp(
-            per_shard_logp, ((X, y), mask, shard_ids), mesh=self.mesh
-        )
-        self.n_shards = n
-        self.n_features = X.shape[-1]
-
-    def prior_logp(self, params: Any) -> jax.Array:
-        lp = jnp.sum(_normal_logpdf(params["W"], 0.0, self.prior_scale))
-        lp += jnp.sum(_normal_logpdf(params["b0"], 0.0, self.prior_scale))
-        # HalfNormal(1) on tau via log_tau with the log|J| = log_tau
-        tau = jnp.exp(params["log_tau"])
-        lp += -0.5 * tau**2 + params["log_tau"]
-        lp += jnp.sum(_normal_logpdf(params["b_raw"], 0.0, 1.0))
-        return lp
-
-    def logp(self, params: Any) -> jax.Array:
-        return self.prior_logp(params) + self.fed.logp(params)
-
-    def logp_and_grad(self, params: Any):
-        return jax.value_and_grad(self.logp)(params)
-
-    def init_params(self) -> Any:
-        return {
-            "W": jnp.zeros((self.n_features, self._k - 1)),
-            "b0": jnp.zeros((self._k - 1,)),
-            "log_tau": jnp.zeros(()),
-            "b_raw": jnp.zeros((self.n_shards, self._k - 1)),
-        }
-
-    def find_map(self, **kwargs):
-        from ..samplers import find_map
-
-        return find_map(self.logp, self.init_params(), **kwargs)
-
-    def sample(self, *, key=None, **kwargs):
-        from ..samplers import sample
-
-        if key is None:
-            key = jax.random.PRNGKey(0)
-        return sample(self.logp, self.init_params(), key=key, **kwargs)
+    def _sample_obs(self, params, key, eta):
+        return _sample_categorical(key, eta)

@@ -133,7 +133,7 @@ class TestHierarchicalSoftmax:
         model = HierarchicalSoftmaxRegression(data, n_classes=3)
         est = model.find_map(num_steps=2500, learning_rate=0.05)
         np.testing.assert_allclose(
-            np.asarray(est["W"]), truth["W"], atol=0.6
+            np.asarray(est["w"]), truth["W"], atol=0.6
         )
         # the group scale is estimated in a sane band around 0.8
         tau_hat = float(np.exp(np.asarray(est["log_tau"])))
@@ -158,3 +158,32 @@ class TestHierarchicalSoftmax:
         np.testing.assert_allclose(
             float(local.logp(p)), float(sharded.logp(p)), rtol=5e-5
         )
+
+    def test_base_machinery_works_for_vector_columns(self):
+        """pointwise/predictive/sample_prior come from the generalized
+        base; pin their shapes and init-value semantics for the vector
+        (_coef_cols = K-1) case."""
+        from pytensor_federated_tpu.models.multinomial import (
+            HierarchicalSoftmaxRegression,
+            generate_hier_multinomial_data,
+        )
+
+        data, _ = generate_hier_multinomial_data(
+            4, n_obs=12, n_classes=3
+        )
+        model = HierarchicalSoftmaxRegression(data, n_classes=3)
+        p = model.init_params()
+        assert p["w"].shape == (3, 2)
+        assert p["b0"].shape == (2,)
+        assert p["b_raw"].shape == (4, 2)
+        ll = np.asarray(model.pointwise_loglik(p))
+        (X, y), mask = model.data.tree()
+        assert ll.shape == np.asarray(y).shape
+        real = np.asarray(mask) > 0
+        np.testing.assert_allclose(ll[real], -np.log(3.0), rtol=1e-5)
+        sims = model.predictive(p, jax.random.PRNGKey(0))
+        assert sims.shape == np.asarray(y).shape
+        prior = model.sample_prior(jax.random.PRNGKey(1))
+        assert prior["w"].shape == (3, 2)
+        assert prior["b0"].shape == (2,)
+        assert np.isfinite(float(model.logp(prior)))
