@@ -63,6 +63,11 @@ def sbc_ranks(
     The kept draws are thinned by ``thin``; ranks take values in
     ``{0, ..., num_samples // thin}``.
     """
+    if num_samples < thin:
+        raise ValueError(
+            f"num_samples={num_samples} < thin={thin}: no draws would "
+            "be kept and every rank would be 0"
+        )
     k_prior, k_sim, k_mcmc = jax.random.split(key, 3)
     thetas = jax.vmap(prior_sample)(jax.random.split(k_prior, n_sims))
     datas = jax.vmap(simulate)(jax.random.split(k_sim, n_sims), thetas)

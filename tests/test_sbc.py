@@ -68,3 +68,18 @@ def test_negative_control_fails_uniformity():
     )
     stats, dof = sbc_uniformity(res)
     assert stats[0] > dof + 4.0 * np.sqrt(2.0 * dof)
+
+
+def test_thin_larger_than_samples_rejected():
+    import pytest
+
+    with pytest.raises(ValueError, match="no draws"):
+        sbc_ranks(
+            prior_sample,
+            simulate,
+            logp,
+            key=jax.random.PRNGKey(0),
+            n_sims=2,
+            num_samples=2,
+            thin=4,
+        )
