@@ -41,9 +41,8 @@ __all__ = ["FlowADVIResult", "realnvp_advi_fit"]
 
 
 def _mlp_init(key, in_dim, hidden, out_dim, dtype):
-    k1, k2 = jax.random.split(key)
+    k1, _ = jax.random.split(key)
     s1 = 1.0 / jnp.sqrt(in_dim)
-    s2 = 1.0 / jnp.sqrt(hidden)
     return {
         "w1": s1 * jax.random.normal(k1, (in_dim, hidden), dtype),
         "b1": jnp.zeros((hidden,), dtype),
@@ -52,7 +51,6 @@ def _mlp_init(key, in_dim, hidden, out_dim, dtype):
         # practice).
         "w2": jnp.zeros((hidden, 2 * out_dim), dtype),
         "b2": jnp.zeros((2 * out_dim,), dtype),
-        "s2_scale": s2,  # kept for shape bookkeeping only
     }
 
 
