@@ -48,6 +48,7 @@ def multichain_sample(
     max_depth: int = 6,
     num_hmc_steps: int = 16,
     target_accept: float = 0.8,
+    dense_mass: bool = False,
     prior_logp: Optional[Callable[[Any], jax.Array]] = None,
     chains_axis: str = CHAINS_AXIS,
     shards_axis: str = SHARDS_AXIS,
@@ -65,8 +66,9 @@ def multichain_sample(
     adaptation statistics are per-chain (no cross-chain traffic), and
     every rank of a chain row sees bit-identical deterministic-sum
     logp values, so the data-dependent warmup loops stay in lockstep
-    exactly like the NUTS tree itself.  With ``num_warmup=0`` the given
-    ``step_size`` and a unit mass are used as before.
+    exactly like the NUTS tree itself.  ``dense_mass=True`` adapts the
+    full covariance (see ``samplers.sample``).  With ``num_warmup=0``
+    the given ``step_size`` and a unit mass are used as before.
 
     This is the scale path — for single-host convenience sampling use
     :func:`pytensor_federated_tpu.samplers.sample` (vmap chains).
@@ -164,6 +166,7 @@ def multichain_sample(
                     num_warmup=num_warmup,
                     kernel_step=kernel_step,
                     target_accept=target_accept,
+                    dense_mass=dense_mass,
                 )
                 state = warm.state
                 eps, inv_mass = warm.step_size, warm.inv_mass
