@@ -136,6 +136,13 @@ class FederatedSoftmaxRegression:
     n_classes: int
     mesh: Optional[Mesh] = None
     prior_scale: float = 5.0
+    #: partial sufficient statistics, the softmax analog of the
+    #: logistic family's fold: the picked-logit term is LINEAR in
+    #: (W, b) — Σ_i eta[y_i] = Σ_k (Σ_{i: y_i=k} x_i)·w_k + n_k b_k —
+    #: so its coefficients (per-shard per-class Σx and counts) fold
+    #: into build-time constants and the hot loop evaluates only the
+    #: logsumexp normalizer.  Exact same posterior; equality-tested.
+    use_suffstats: bool = False
 
     def __post_init__(self):
         K = int(self.n_classes)
@@ -143,14 +150,40 @@ class FederatedSoftmaxRegression:
             raise ValueError(f"n_classes must be >= 2, got {K}")
         self._k = K
 
-        def per_shard_logp(params, shard):
-            (X, y), mask = shard
-            ll = _categorical_loglik(y, X @ params["W"] + params["b"])
-            return jnp.sum(ll * mask)
+        if self.use_suffstats:
+            (X, y), mask = self.data.tree()
+            # one-hot over the K-1 FREE classes (class 0 contributes a
+            # pinned-zero logit, so it needs no linear term)
+            onehot = (
+                jnp.asarray(y)[..., None]
+                == jnp.arange(1, K, dtype=jnp.float32)
+            ).astype(jnp.float32) * jnp.asarray(mask)[..., None]
+            sx = jnp.einsum("snd,snk->sdk", jnp.asarray(X), onehot)
+            sn = jnp.sum(onehot, axis=1)  # (S, K-1)
+            tree = ((X, sx, sn), mask)
 
-        self.fed = FederatedLogp(
-            per_shard_logp, self.data.tree(), mesh=self.mesh
-        )
+            def per_shard_logp(params, shard):
+                (X_s, sx_s, sn_s), m_s = shard
+                free = X_s @ params["W"] + params["b"]
+                lse = jax.scipy.special.logsumexp(
+                    _pinned_logits(free), axis=-1
+                )
+                picked = jnp.sum(sx_s * params["W"]) + jnp.sum(
+                    sn_s * params["b"]
+                )
+                return picked - jnp.sum(lse * m_s)
+
+            self.fed = FederatedLogp(per_shard_logp, tree, mesh=self.mesh)
+        else:
+
+            def per_shard_logp(params, shard):
+                (X, y), mask = shard
+                ll = _categorical_loglik(y, X @ params["W"] + params["b"])
+                return jnp.sum(ll * mask)
+
+            self.fed = FederatedLogp(
+                per_shard_logp, self.data.tree(), mesh=self.mesh
+            )
         self.n_features = jax.tree_util.tree_leaves(self.data.data)[
             0
         ].shape[-1]

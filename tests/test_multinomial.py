@@ -187,3 +187,35 @@ class TestHierarchicalSoftmax:
         assert prior["w"].shape == (3, 2)
         assert prior["b0"].shape == (2,)
         assert np.isfinite(float(model.logp(prior)))
+
+
+def test_suffstats_equality():
+    """use_suffstats folds the picked-logit term to build-time
+    constants; logp and grads must match the plain path exactly,
+    including with ragged (masked) shards."""
+    from pytensor_federated_tpu.parallel.packing import pack_shards
+
+    rng = np.random.default_rng(9)
+    shards = []
+    for n in (11, 7, 16):
+        X = rng.normal(size=(n, 4)).astype(np.float32)
+        y = rng.integers(0, 3, size=n).astype(np.float32)
+        shards.append((X, y))
+    data = pack_shards(shards)
+    base = FederatedSoftmaxRegression(data, n_classes=3)
+    fast = FederatedSoftmaxRegression(data, n_classes=3,
+                                      use_suffstats=True)
+    for shift in (0.0, 0.3):
+        p = jax.tree_util.tree_map(
+            lambda a: a + shift, base.init_params()
+        )
+        np.testing.assert_allclose(
+            float(base.logp(p)), float(fast.logp(p)), rtol=2e-5
+        )
+        _, g1 = base.logp_and_grad(p)
+        _, g2 = fast.logp_and_grad(p)
+        for k in g1:
+            np.testing.assert_allclose(
+                np.asarray(g1[k]), np.asarray(g2[k]),
+                rtol=1e-4, atol=1e-5,
+            )
