@@ -32,6 +32,7 @@ layout in spirit.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import tempfile
 from typing import Any, Callable, Optional, Tuple
@@ -165,6 +166,29 @@ def sample_checkpointed(
         "dense_mass": dense_mass,
     }
 
+    # Config keys added after a release, with the default value older
+    # checkpoints implicitly ran with.  A stored config lacking one of
+    # these keys is still compatible iff the current run uses the
+    # default — otherwise a routine version upgrade would silently
+    # discard every pre-existing checkpoint.
+    _added_config_defaults = {"dense_mass": False}
+
+    def _config_compatible(stored) -> bool:
+        if stored == config:
+            return True
+        if not isinstance(stored, dict):
+            return False
+        for k, cur in config.items():
+            if k in stored:
+                if stored[k] != cur:
+                    return False
+            elif (
+                k not in _added_config_defaults
+                or cur != _added_config_defaults[k]
+            ):
+                return False
+        return all(k in config for k in stored)
+
     k_jit, k_warm, k_base = jax.random.split(key, 3)
 
     def state_template():
@@ -191,13 +215,19 @@ def sample_checkpointed(
     if os.path.exists(checkpoint_path):
         try:
             state, meta = load_pytree(checkpoint_path, state_template())
-            if meta.get("config") == config:
+            if _config_compatible(meta.get("config")):
                 chunks_done = int(meta["chunks_done"])
                 chunks = [
                     load_pytree(_chunk_path(checkpoint_path, i), chunk_template())[0]
                     for i in range(chunks_done)
                 ]
                 resumed = (state, chunks_done, chunks)
+            else:
+                logging.getLogger(__name__).warning(
+                    "discarding checkpoint %s: stored sampling config does "
+                    "not match the current run; restarting from scratch",
+                    checkpoint_path,
+                )
         except (ValueError, KeyError, OSError):
             # Stale/foreign/partial checkpoint: restart fresh.
             resumed = None

@@ -24,7 +24,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from ..parallel.packing import ShardedData, pack_shards
-from ..parallel.sharded import FederatedLogp
+from ..parallel.sharded import FederatedLogp, NoFederatedShards
 from .hierbase import HierarchicalGLMBase, linear_predictor
 from .linear import _normal_logpdf
 
@@ -175,7 +175,7 @@ class FederatedLogisticRegression:
                 return syx @ params["w"] + sy * params["b"] - sp
 
             self._loglik = flat_loglik
-            self.fed = None
+            self.fed = NoFederatedShards("flatten=True folds all shards")
         elif self.use_suffstats:
             (X, y), mask = self.data.tree()
             ym = y * mask

@@ -52,6 +52,30 @@ def _shard_data_to_mesh(data: Any, mesh: Mesh, axis: str) -> Any:
     return jax.tree_util.tree_map(lambda l: jax.device_put(l, sharding), data)
 
 
+class NoFederatedShards:
+    """Sentinel for models built without a federated shard axis.
+
+    Assigned to ``model.fed`` when a construction option (e.g.
+    ``flatten=True``) collapses the shard axis, so that any attempt to
+    use a ``.fed``-dependent API (``logp_minibatch``, mesh placement,
+    the doubly-stochastic ADVI hook) fails with a targeted message
+    instead of an opaque ``AttributeError`` on ``None``.
+    """
+
+    def __init__(self, reason: str):
+        self._reason = reason
+
+    def __bool__(self) -> bool:
+        return False
+
+    def __getattr__(self, name: str):
+        raise AttributeError(
+            f"this model has no federated shard axis ({self._reason}); "
+            f"'.fed.{name}' is unavailable — construct the model without "
+            "that option to use federated/minibatch/mesh APIs"
+        )
+
+
 class FederatedLogp:
     """Sharded log-potential: ``logp(params) = Σ_shards per_shard_logp``.
 

@@ -142,9 +142,18 @@ def sbc_uniformity(result: SBCResult, *, n_bins: int = 8):
     ranks = np.asarray(result.ranks)
     n_sims, dim = ranks.shape
     edges = np.linspace(0, result.n_levels, n_bins + 1)
-    expected = n_sims / n_bins
+    # Ranks are integers in [0, n_levels); when n_bins does not divide
+    # n_levels the bins cover unequal numbers of integer levels, so the
+    # expected count must be proportional to each bin's level coverage.
+    levels = np.arange(result.n_levels)
+    levels_per_bin, _ = np.histogram(levels, bins=edges)
+    # n_levels < n_bins leaves some bins covering no integer level at
+    # all; those contribute 0 observed and 0 expected — drop them (and
+    # shrink the dof to the bins that remain) instead of dividing 0/0.
+    keep = levels_per_bin > 0
+    expected = n_sims * levels_per_bin[keep] / result.n_levels
     stats = np.empty((dim,))
     for j in range(dim):
         hist, _ = np.histogram(ranks[:, j], bins=edges)
-        stats[j] = np.sum((hist - expected) ** 2 / expected)
-    return stats, n_bins - 1
+        stats[j] = np.sum((hist[keep] - expected) ** 2 / expected)
+    return stats, int(keep.sum()) - 1

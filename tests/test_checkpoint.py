@@ -174,3 +174,106 @@ class TestSampleCheckpointed:
         xs = np.asarray(res.samples["x"]).reshape(-1, 2)
         np.testing.assert_allclose(xs.mean(0), 0.0, atol=0.15)
         np.testing.assert_allclose(xs.std(0), 1.0, atol=0.2)
+
+
+class TestConfigVersionUpgrade:
+    """A checkpoint written before a config key existed must still
+    resume when the current run uses that key's default (round-3
+    ADVICE: the silent version-upgrade discard)."""
+
+    KW = dict(
+        num_warmup=50,
+        num_samples=20,
+        num_chains=2,
+        checkpoint_every=10,
+    )
+
+    def _strip_key(self, path, drop="dense_mass"):
+        """Rewrite the stored meta as a pre-upgrade checkpoint would
+        have written it: config lacking ``drop``."""
+        # The state template here matches the run in these tests
+        # (2 chains, dim=2, diagonal mass).
+        like = {
+            "x": jnp.zeros((2, 2)),
+            "logp": jnp.zeros((2,)),
+            "grad": jnp.zeros((2, 2)),
+            "step_size": jnp.zeros((2,)),
+            "inv_mass": jnp.zeros((2, 2)),
+        }
+        state, meta = load_pytree(path, like)
+        assert drop in meta["config"]
+        del meta["config"][drop]
+        save_pytree(path, state, meta)
+
+    def test_missing_defaulted_key_resumes(self, tmp_path):
+        p = str(tmp_path / "run.npz")
+        init = {"x": jnp.zeros(2)}
+        sample_checkpointed(
+            _logp, init, key=jax.random.PRNGKey(3), checkpoint_path=p,
+            **self.KW,
+        )
+        self._strip_key(p)
+        # Tamper a chunk's draws with a sentinel: if the rerun resumes
+        # (as it must), the sentinel shows up in its output; if it
+        # silently restarted, it would not.
+        cp = p + ".chunk0000.npz"
+        chunk_like = {
+            "draws": jnp.zeros((2, 10, 2)),
+            "accept_prob": jnp.zeros((2, 10)),
+            "diverging": jnp.zeros((2, 10), bool),
+        }
+        chunk, cmeta = load_pytree(cp, chunk_like)
+        chunk["draws"] = jnp.full_like(chunk["draws"], 1234.5)
+        save_pytree(cp, chunk, cmeta)
+        res = sample_checkpointed(
+            _logp, init, key=jax.random.PRNGKey(3), checkpoint_path=p,
+            **self.KW,
+        )
+        assert np.all(np.asarray(res.samples["x"])[:, :10] == 1234.5)
+
+    def test_missing_key_nondefault_run_restarts(self, tmp_path, caplog):
+        import logging
+
+        p = str(tmp_path / "run.npz")
+        init = {"x": jnp.zeros(2)}
+        sample_checkpointed(
+            _logp, init, key=jax.random.PRNGKey(3), checkpoint_path=p,
+            **self.KW,
+        )
+        self._strip_key(p)
+        # Current run wants dense mass: the old checkpoint is NOT
+        # compatible, and the discard must be logged, not silent.
+        with caplog.at_level(
+            logging.WARNING, logger="pytensor_federated_tpu.checkpoint"
+        ):
+            res = sample_checkpointed(
+                _logp, init, key=jax.random.PRNGKey(3), checkpoint_path=p,
+                dense_mass=True, **self.KW,
+            )
+        assert res.samples["x"].shape == (2, 20, 2)
+        assert any("discarding checkpoint" in r.message for r in caplog.records)
+
+    def test_extra_stored_key_restarts(self, tmp_path):
+        """A checkpoint from a NEWER version (stored config has a key
+        this version does not know) must restart, not resume."""
+        p = str(tmp_path / "run.npz")
+        init = {"x": jnp.zeros(2)}
+        sample_checkpointed(
+            _logp, init, key=jax.random.PRNGKey(3), checkpoint_path=p,
+            **self.KW,
+        )
+        like = {
+            "x": jnp.zeros((2, 2)),
+            "logp": jnp.zeros((2,)),
+            "grad": jnp.zeros((2, 2)),
+            "step_size": jnp.zeros((2,)),
+            "inv_mass": jnp.zeros((2, 2)),
+        }
+        state, meta = load_pytree(p, like)
+        meta["config"]["from_the_future"] = 1
+        save_pytree(p, state, meta)
+        res = sample_checkpointed(
+            _logp, init, key=jax.random.PRNGKey(3), checkpoint_path=p,
+            **self.KW,
+        )
+        assert res.samples["x"].shape == (2, 20, 2)

@@ -275,3 +275,24 @@ class TestLogisticSuffstats:
         data, _ = generate_logistic_data(n_shards=8, n_obs=16, n_features=3)
         with pytest.raises(ValueError, match="flatten"):
             FederatedLogisticRegression(data, mesh=mesh, flatten=True)
+
+
+class TestNoFederatedShardsSentinel:
+    def test_flatten_fed_access_raises_targeted_message(self):
+        import pytest
+
+        from pytensor_federated_tpu.models.logistic import (
+            FederatedLogisticRegression,
+            generate_logistic_data,
+        )
+
+        data, _ = generate_logistic_data(n_shards=4, n_obs=8, n_features=3)
+        flat = FederatedLogisticRegression(data, flatten=True)
+        # Falsy, so `if model.fed:` guards keep working...
+        assert not flat.fed
+        # ...but any attribute use fails with a targeted message, not
+        # an opaque AttributeError on None (round-3 ADVICE finding).
+        with pytest.raises(
+            AttributeError, match="no federated shard axis"
+        ):
+            flat.fed.logp_minibatch
