@@ -40,8 +40,10 @@ from .parallel import (
     single_device_mesh,
 )
 from . import diagnostics
+from . import precision
 from .checkpoint import load_pytree, sample_checkpointed, save_pytree
 from .diagnostics import instrument_logp, profile_trace
+from .precision import pdot, split_dot, wrap_policy
 from .signatures import ArraysSpec, ComputeFn, LogpFn, LogpGradFn, spec_of
 from .version import __version__
 from .wrappers import logp_grad_from_logp, wrap_logp_fn, wrap_logp_grad_fn
@@ -77,12 +79,16 @@ __all__ = [
     "make_mesh",
     "pack_shards",
     "parallel_host_call",
+    "pdot",
+    "precision",
     "profile_trace",
     "sample_checkpointed",
     "save_pytree",
     "sharded_compute",
     "single_device_mesh",
     "spec_of",
+    "split_dot",
+    "wrap_policy",
     "wrap_logp_fn",
     "wrap_logp_grad_fn",
 ]

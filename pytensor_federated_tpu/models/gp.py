@@ -100,9 +100,11 @@ def generate_gp_data(
     return packed, np.stack([x, y])
 
 
-def _sq_dist(x1, x2, lengthscale):
+def _sq_dist(x1, x2, lengthscale, policy=None):
     """Pairwise SQUARED scaled distance — the one ndim-dispatch +
-    validation + MXU-expansion implementation every kernel shares."""
+    validation + MXU-expansion implementation every kernel shares.
+    ``policy``: f32 contraction policy (:mod:`..precision`) for the
+    2-D branch's cross-term matmul (the 1-D branch has none)."""
     if x1.ndim != x2.ndim:
         raise ValueError(
             f"kernel inputs must have matching ndim, got {x1.ndim} and "
@@ -117,15 +119,17 @@ def _sq_dist(x1, x2, lengthscale):
                 "lengthscale (ARD) needs (n, d) inputs"
             )
         return ((x1[:, None] - x2[None, :]) / ls) ** 2
+    from ..precision import pdot
+
     s1 = x1 / lengthscale  # (n1, d) with (d,) or scalar lengthscale
     s2 = x2 / lengthscale
     sq1 = jnp.sum(s1**2, axis=1)
     sq2 = jnp.sum(s2**2, axis=1)
-    d2 = sq1[:, None] + sq2[None, :] - 2.0 * (s1 @ s2.T)
+    d2 = sq1[:, None] + sq2[None, :] - 2.0 * pdot(s1, s2.T, policy)
     return jnp.maximum(d2, 0.0)
 
 
-def _sqexp(x1, x2, variance, lengthscale):
+def _sqexp(x1, x2, variance, lengthscale, policy=None):
     """Squared-exponential kernel matrix, MXU-friendly distance form.
 
     Inputs may be 1-D ``(n,)`` (scalar covariate, the demo shape) or
@@ -136,7 +140,7 @@ def _sqexp(x1, x2, variance, lengthscale):
     cross term is one (n1, d) @ (d, n2) MXU matmul instead of an
     (n1, n2, d) broadcast living in memory.
     """
-    return variance * jnp.exp(-0.5 * _sq_dist(x1, x2, lengthscale))
+    return variance * jnp.exp(-0.5 * _sq_dist(x1, x2, lengthscale, policy))
 
 
 def _unpack(params):
@@ -147,22 +151,22 @@ def _unpack(params):
     )
 
 
-def _scaled_dist(x1, x2, lengthscale):
+def _scaled_dist(x1, x2, lengthscale, policy=None):
     """Pairwise scaled Euclidean distance (shared by the Matérn
     kernels).  sqrt'(0) = inf, so the argument is nudged to keep
     zero-distance gradients finite (kernel value error ~1e-6 * ls)."""
-    return jnp.sqrt(_sq_dist(x1, x2, lengthscale) + 1e-12)
+    return jnp.sqrt(_sq_dist(x1, x2, lengthscale, policy) + 1e-12)
 
 
-def _matern32(x1, x2, variance, lengthscale):
+def _matern32(x1, x2, variance, lengthscale, policy=None):
     """Matérn 3/2: once-differentiable sample paths."""
-    r = jnp.sqrt(3.0) * _scaled_dist(x1, x2, lengthscale)
+    r = jnp.sqrt(3.0) * _scaled_dist(x1, x2, lengthscale, policy)
     return variance * (1.0 + r) * jnp.exp(-r)
 
 
-def _matern52(x1, x2, variance, lengthscale):
+def _matern52(x1, x2, variance, lengthscale, policy=None):
     """Matérn 5/2: twice-differentiable sample paths."""
-    r = jnp.sqrt(5.0) * _scaled_dist(x1, x2, lengthscale)
+    r = jnp.sqrt(5.0) * _scaled_dist(x1, x2, lengthscale, policy)
     return variance * (1.0 + r + r**2 / 3.0) * jnp.exp(-r)
 
 
@@ -173,13 +177,26 @@ _KERNELS = {
 }
 
 
-def get_kernel(name: str):
-    """Kernel function by name: "sqexp", "matern32", "matern52"."""
+def get_kernel(name: str, policy: str = None):
+    """Kernel function by name: "sqexp", "matern32", "matern52".
+
+    ``policy`` (optional): bind an f32 contraction policy
+    (:mod:`..precision`) into the kernel's cross-term matmul; the
+    returned callable keeps the 4-arg kernel signature either way.
+    A CONCRETE policy (including "default") is bound as-is so the
+    kernel never re-consults the env at trace time — models resolve
+    the env exactly once, at construction.
+    """
     if name not in _KERNELS:
         raise ValueError(
             f"unknown kernel {name!r}; choose from {sorted(_KERNELS)}"
         )
-    return _KERNELS[name]
+    kern = _KERNELS[name]
+    if policy is None:
+        return kern
+    import functools
+
+    return functools.partial(kern, policy=policy)
 
 
 class FederatedSparseGP:
@@ -205,13 +222,21 @@ class FederatedSparseGP:
         mesh: Optional[Mesh] = None,
         axis: str = SHARDS_AXIS,
         kernel: str = "sqexp",
+        f32_policy: Optional[str] = None,
     ):
+        from ..precision import pdot, resolve_policy, wrap_policy
+
+        # None consults PFTPU_F32_POLICY exactly ONCE, here — one
+        # concrete policy string then flows to every contraction site
+        # (kernel cross term, quadratic forms, decomposition context).
+        policy = resolve_policy(f32_policy)
+        self.f32_policy = policy
         self.inducing = jnp.asarray(inducing, jnp.float32)
         self.m = int(self.inducing.shape[0])
         self.mesh = mesh
         m = self.m
         z = self.inducing
-        kern = get_kernel(kernel)
+        kern = get_kernel(kernel, policy=policy)
 
         def per_shard_stats(params, shard):
             """Whitened statistics — float32-stable by construction.
@@ -232,8 +257,8 @@ class FederatedSparseGP:
             # exclude them without any gather/ragged handling.
             kzf = kern(z, x, variance, lengthscale) * mask[None, :]
             v = jax.scipy.linalg.solve_triangular(l_kzz, kzf, lower=True)
-            a = v @ v.T
-            b = v @ (y * mask)
+            a = pdot(v, v.T, policy)
+            b = pdot(v, y * mask, policy)
             q_diag = jnp.sum(v**2, axis=0)  # Nyström diag, per point
             resid = jnp.sum(mask * (variance - q_diag))
             y2 = jnp.sum((y * mask) ** 2)
@@ -265,7 +290,11 @@ class FederatedSparseGP:
             bprime = jnp.eye(m) + a / s2
             l_b = jnp.linalg.cholesky(bprime)
             # Woodbury quadratic: y'Σ^{-1}y = (y'y - b' B'^{-1} b / σ²)/σ²
-            quad = (y2 - b @ jax.scipy.linalg.cho_solve((l_b, True), b) / s2) / s2
+            quad = (
+                y2
+                - pdot(b, jax.scipy.linalg.cho_solve((l_b, True), b), policy)
+                / s2
+            ) / s2
             logdet = 2.0 * jnp.sum(jnp.log(jnp.diag(l_b)))
             trace_term = resid / s2
 
@@ -273,8 +302,12 @@ class FederatedSparseGP:
                 n * (LOG_2PI + jnp.log(s2)) + quad + logdet + trace_term
             ) + self._prior_logp(params)
 
-        self._logp = jax.jit(logp)
-        self._logp_and_grad = jax.jit(jax.value_and_grad(logp))
+        # "highest"/"strict": the precision context must be active at
+        # TRACE time so Cholesky/triangular-solve internals pick it up.
+        self._logp = jax.jit(wrap_policy(logp, policy))
+        self._logp_and_grad = jax.jit(
+            wrap_policy(jax.value_and_grad(logp), policy)
+        )
 
     @staticmethod
     def _prior_logp(params):
@@ -356,9 +389,15 @@ class FederatedExactGP:
         mesh: Optional[Mesh] = None,
         axis: str = SHARDS_AXIS,
         kernel: str = "sqexp",
+        f32_policy: Optional[str] = None,
     ):
+        from ..precision import pdot, resolve_policy, wrap_policy
+
+        # One env consultation at construction; see FederatedSparseGP.
+        policy = resolve_policy(f32_policy)
+        self.f32_policy = policy
         self.mesh = mesh
-        self._kern = get_kernel(kernel)
+        self._kern = get_kernel(kernel, policy=policy)
         kern = self._kern
 
         def per_shard_logp(params, shard):
@@ -369,16 +408,24 @@ class FederatedExactGP:
             ym = y * mask
             l = jnp.linalg.cholesky(k)
             alpha = jax.scipy.linalg.cho_solve((l, True), ym)
+            # The n-term quadratic form is exactly the contraction size
+            # the chip degrades (tools/diag_tpu.out: relerr ~1.4e-3 at
+            # n=512) — policy-route it.
             ll = -0.5 * (
-                ym @ alpha
+                pdot(ym, alpha, policy)
                 + 2.0 * jnp.sum(jnp.log(jnp.diag(l)))
                 + n * LOG_2PI
             )
             # remove the padded slots' logN(0|0,1) contributions
             return ll + 0.5 * LOG_2PI * jnp.sum(1.0 - mask)
 
+        # The precision context must be live while the Cholesky /
+        # cho_solve internals are traced ("highest"/"strict" policies).
         self.fed = FederatedLogp(
-            per_shard_logp, data.tree(), mesh=mesh, axis=axis
+            wrap_policy(per_shard_logp, policy),
+            data.tree(),
+            mesh=mesh,
+            axis=axis,
         )
         self.data = data
 
@@ -411,6 +458,8 @@ class FederatedExactGP:
         variance, lengthscale, noise = _unpack(params)
         xs = jnp.asarray(x_star, jnp.float32)
 
+        from ..precision import pdot, wrap_policy
+
         def one(x_i, y_i, m_i):
             k = _masked_cov(
                 x_i, m_i, variance, lengthscale, noise, self._kern
@@ -418,9 +467,9 @@ class FederatedExactGP:
             ks = self._kern(x_i, xs, variance, lengthscale) * m_i[:, None]
             l = jnp.linalg.cholesky(k)
             alpha = jax.scipy.linalg.cho_solve((l, True), y_i * m_i)
-            mean = ks.T @ alpha
+            mean = pdot(ks.T, alpha, self.f32_policy)
             v = jax.scipy.linalg.solve_triangular(l, ks, lower=True)
             var = variance - jnp.sum(v**2, axis=0)
             return mean, var
 
-        return jax.vmap(one)(x, y, mask)
+        return jax.vmap(wrap_policy(one, self.f32_policy))(x, y, mask)

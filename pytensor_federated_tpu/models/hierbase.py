@@ -56,9 +56,19 @@ def linear_predictor(X, w, b, compute_dtype=None):
     """``X @ w + b``, optionally with the matmul in ``compute_dtype``
     (e.g. bf16) and float32 accumulation — the MXU mixed-precision
     recipe.  THE one implementation; every model option routes here so
-    the contraction recipe cannot drift between families."""
+    the contraction recipe cannot drift between families.
+
+    ``compute_dtype="float32_strict"`` goes the OTHER direction: a
+    guaranteed true-f32 contraction via the 6-pass bf16x3 split
+    (:mod:`..precision`) for chips whose plain-f32 matmul is silently
+    bf16-accurate (tools/diag_tpu.out; ~6x the matmul FLOPs).
+    """
     if compute_dtype is None:
         return X @ w + b
+    if compute_dtype == "float32_strict":
+        from ..precision import pdot
+
+        return pdot(X, w, "strict") + b
     return (
         jnp.dot(
             X.astype(compute_dtype),
@@ -101,6 +111,9 @@ class HierarchicalGLMBase:
     #: None = pure float32.  Subclass dataclasses may expose it as a
     #: field; expect ~1e-2 relative logp divergence from f32 (bf16 has
     #: 8 mantissa bits), tested in tests/test_mixed_precision.py.
+    #: The string ``"float32_strict"`` instead FORCES true-f32
+    #: contractions via the 6-pass bf16x3 split (:mod:`..precision`) on
+    #: chips whose plain f32 matmul is bf16-accurate.
     compute_dtype = None
 
     def _linear_predictor(self, X, w, b):

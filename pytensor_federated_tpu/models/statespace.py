@@ -137,7 +137,7 @@ def _sanitize(y, mask):
 
 
 def kalman_logp_seq(
-    params: Any, y: jax.Array, mask: Any = None
+    params: Any, y: jax.Array, mask: Any = None, *, precision: Any = None
 ) -> jax.Array:
     """Marginal log-likelihood via the classic sequential Kalman filter.
 
@@ -146,7 +146,22 @@ def kalman_logp_seq(
     perform a pure prediction (no measurement update) — the standard
     missing-data treatment, which also serves ragged/padded series.
     Masked rows of ``y`` may hold any value, including NaN.
+
+    ``precision``: f32 contraction policy name (:mod:`..precision`).
+    ``"highest"``/``"strict"`` trace every internal matmul and solve at
+    ``Precision.HIGHEST`` — the verified TPU mitigation for the chip's
+    bf16-accurate plain-f32 contractions (the context demonstrably
+    engages for this filter's dot_generals: 15.5 -> 220 ms on chip,
+    tools/diag_tpu.out).  The filter's matrices are tiny (d x d), so
+    the split-dot mechanism does not apply here.
     """
+    from ..precision import matmul_precision_ctx
+
+    with matmul_precision_ctx(precision):
+        return _kalman_logp_seq_body(params, y, mask)
+
+
+def _kalman_logp_seq_body(params, y, mask):
     F, H, Q, R, m0, P0 = _unpack(params)
     mask = _as_mask(mask, y.shape[0], F.dtype)
     y = _sanitize(y, mask)
@@ -279,13 +294,19 @@ def _predictive_logp(F, H, Q, R, m0, P0, y, means, covs, mask=None):
 
 
 def kalman_logp_parallel(
-    params: Any, y: jax.Array, mask: Any = None
+    params: Any, y: jax.Array, mask: Any = None, *, precision: Any = None
 ) -> jax.Array:
     """Marginal log-likelihood with O(log T)-depth associative scan.
-    ``mask`` as in :func:`kalman_logp_seq`."""
-    F, H, Q, R, m0, P0 = _unpack(params)
-    means, covs = _filtered_moments(params, y, mask)
-    return _predictive_logp(F, H, Q, R, m0, P0, y, means, covs, mask)
+    ``mask`` and ``precision`` as in :func:`kalman_logp_seq` (the scan
+    COMPOSES d x d products over T steps, so reduced-precision error
+    compounds — the associative form is the one that degenerated on
+    chip, tools/diag_tpu.out)."""
+    from ..precision import matmul_precision_ctx
+
+    with matmul_precision_ctx(precision):
+        F, H, Q, R, m0, P0 = _unpack(params)
+        means, covs = _filtered_moments(params, y, mask)
+        return _predictive_logp(F, H, Q, R, m0, P0, y, means, covs, mask)
 
 
 # ---------------------------------------------------------------------------

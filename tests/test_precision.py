@@ -1,0 +1,293 @@
+"""True-f32 contraction policy (precision.py).
+
+The target hardware computes plain f32 contractions at bf16-level
+accuracy (measured ~1.4e-3 relerr on a 512-term dot, tools/diag_tpu.out
+— the reference never faces this: its exchange dtype is de-facto
+float64, reference common.py).  These tests verify the mitigation
+MECHANISM on CPU by simulating the chip: a base_dot that rounds
+operands to bf16 before multiplying (f32 accumulate) reproduces the
+measured error; the 6-pass bf16x3 split over that same degraded primitive must
+recover true-f32 accuracy.  On-chip verification of the same recipe is
+tools/diag_tpu.py section 1b.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pytensor_federated_tpu.precision import (
+    POLICIES,
+    matmul_precision_ctx,
+    pdot,
+    resolve_policy,
+    split_dot,
+    wrap_policy,
+)
+
+
+def _sim_bf16_dot(a, b):
+    """The chip's measured behavior: operands rounded to bf16, products
+    accumulated in f32."""
+    return jnp.matmul(
+        a.astype(jnp.bfloat16),
+        b.astype(jnp.bfloat16),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _relerr(x, ref):
+    """Norm-relative error.  Elementwise max-relerr is the WRONG gate
+    here: individual outputs of a random 512-dot can nearly cancel
+    (measured: plain f32 CPU maxes at 6e-4 relerr on an output whose
+    |ref| is 1.6e-3) — the L2 ratio separates honest f32 (~1e-7) from
+    bf16-degraded (~1e-3) unambiguously."""
+    x = np.asarray(x, np.float64)
+    return float(np.linalg.norm(x - ref) / np.linalg.norm(ref))
+
+
+@pytest.fixture(scope="module")
+def mat_vec():
+    rng = np.random.default_rng(0)
+    A = rng.normal(size=(2048, 512)).astype(np.float32)
+    w = rng.normal(size=(512,)).astype(np.float32)
+    ref = A.astype(np.float64) @ w.astype(np.float64)
+    return jnp.asarray(A), jnp.asarray(w), ref
+
+
+class TestSplitDot:
+    def test_simulated_chip_reproduces_the_trap(self, mat_vec):
+        """The simulated bf16 backend must actually be broken (~1e-3),
+        else the recovery test below tests nothing."""
+        A, w, ref = mat_vec
+        err = _relerr(jax.jit(_sim_bf16_dot)(A, w), ref)
+        assert err > 1e-4, f"bf16 sim unexpectedly accurate: {err:.3e}"
+
+    def test_split_recovers_true_f32_on_simulated_chip(self, mat_vec):
+        """The acceptance line from the round-3 verdict: relerr <= 1e-5
+        on the dot that measures ~1.4e-3 un-mitigated — demonstrated
+        against the SAME degraded primitive the chip implements."""
+        A, w, ref = mat_vec
+        out = jax.jit(
+            lambda a, b: split_dot(a, b, base_dot=_sim_bf16_dot)
+        )(A, w)
+        assert _relerr(out, ref) <= 1e-5
+
+    def test_split_matches_plain_f32_on_cpu(self, mat_vec):
+        A, w, ref = mat_vec
+        out = jax.jit(split_dot)(A, w)
+        assert _relerr(out, ref) <= 1e-5
+
+    def test_split_matmul_shapes(self):
+        rng = np.random.default_rng(1)
+        a = jnp.asarray(rng.normal(size=(8, 16, 32)).astype(np.float32))
+        b = jnp.asarray(rng.normal(size=(8, 32, 4)).astype(np.float32))
+        out = split_dot(a, b)
+        ref = np.asarray(a, np.float64) @ np.asarray(b, np.float64)
+        assert out.shape == (8, 16, 4)
+        assert _relerr(out, ref) <= 1e-5
+
+    def test_gradients_flow(self, mat_vec):
+        A, w, _ = mat_vec
+
+        def loss(w_):
+            return jnp.sum(split_dot(A, w_) ** 2)
+
+        g = jax.jit(jax.grad(loss))(w)
+        g_ref = jax.jit(jax.grad(lambda w_: jnp.sum((A @ w_) ** 2)))(w)
+        assert np.isfinite(np.asarray(g)).all()
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(g_ref), rtol=1e-4, atol=1e-2
+        )
+
+
+class TestPolicyRouting:
+    def test_resolve_rejects_unknown(self):
+        with pytest.raises(ValueError, match="unknown f32 policy"):
+            resolve_policy("fastest")
+
+    def test_env_override(self, monkeypatch):
+        monkeypatch.setenv("PFTPU_F32_POLICY", "split")
+        assert resolve_policy(None) == "split"
+        monkeypatch.setenv("PFTPU_F32_POLICY", "bogus")
+        with pytest.raises(ValueError):
+            resolve_policy(None)
+        monkeypatch.delenv("PFTPU_F32_POLICY")
+        assert resolve_policy(None) == "default"
+
+    @pytest.mark.parametrize("policy", POLICIES)
+    def test_all_policies_accurate_on_cpu(self, policy, mat_vec):
+        A, w, ref = mat_vec
+        out = jax.jit(lambda a, b: pdot(a, b, policy))(A, w)
+        assert _relerr(out, ref) <= 1e-5
+
+    def test_env_governs_model_construction(self, monkeypatch):
+        """PFTPU_F32_POLICY must flip a whole model coherently: the
+        constructor consults the env ONCE and one concrete policy
+        flows to every contraction site (review finding: a "default"
+        string default left kernel-internal sites re-reading the env
+        per trace while the rest stayed plain)."""
+        from pytensor_federated_tpu.models.gp import (
+            FederatedExactGP,
+            generate_gp_data,
+        )
+
+        data, _ = generate_gp_data(2, n_obs=16, seed=7)
+        monkeypatch.setenv("PFTPU_F32_POLICY", "strict")
+        m = FederatedExactGP(data)
+        assert m.f32_policy == "strict"
+        monkeypatch.delenv("PFTPU_F32_POLICY")
+        # ...and the already-built model keeps its resolved policy.
+        assert m.f32_policy == "strict"
+        assert FederatedExactGP(data).f32_policy == "default"
+
+    def test_wrap_policy_identity_for_default(self):
+        fn = lambda x: x  # noqa: E731
+        assert wrap_policy(fn, "default") is fn
+        assert wrap_policy(fn, "split") is fn
+        assert wrap_policy(fn, "strict") is not fn
+
+    def test_ctx_types(self):
+        from contextlib import nullcontext
+
+        assert isinstance(matmul_precision_ctx("default"), nullcontext)
+        assert isinstance(matmul_precision_ctx("split"), nullcontext)
+        assert not isinstance(matmul_precision_ctx("strict"), nullcontext)
+
+
+class TestModelWiring:
+    """On CPU every policy must agree with the default (f32 is true f32
+    here); the point is that the strict paths trace, run, differentiate,
+    and change nothing when the hardware is honest."""
+
+    def test_exact_gp_strict(self):
+        from pytensor_federated_tpu.models.gp import (
+            FederatedExactGP,
+            generate_gp_data,
+        )
+
+        data, _ = generate_gp_data(4, n_obs=64, seed=2)
+        base = FederatedExactGP(data)
+        strict = FederatedExactGP(data, f32_policy="strict")
+        p = base.init_params()
+        v0, g0 = base.logp_and_grad(p)
+        v1, g1 = strict.logp_and_grad(p)
+        np.testing.assert_allclose(float(v0), float(v1), rtol=1e-5)
+        for k in g0:
+            np.testing.assert_allclose(
+                np.asarray(g0[k]), np.asarray(g1[k]), rtol=1e-3, atol=1e-5
+            )
+
+    def test_exact_gp_strict_ard(self):
+        """2-D ARD inputs exercise the kernel cross-term pdot branch."""
+        from pytensor_federated_tpu.models.gp import FederatedExactGP
+        from pytensor_federated_tpu.parallel.packing import pack_shards
+
+        rng = np.random.default_rng(3)
+        shards = [
+            (
+                rng.normal(size=(32, 3)).astype(np.float32),
+                rng.normal(size=32).astype(np.float32),
+            )
+            for _ in range(4)
+        ]
+        data = pack_shards(shards)
+        base = FederatedExactGP(data)
+        strict = FederatedExactGP(data, f32_policy="strict")
+        p = {
+            "log_variance": jnp.zeros(()),
+            "log_lengthscale": jnp.zeros(3),
+            "log_noise": jnp.asarray(-1.0),
+        }
+        np.testing.assert_allclose(
+            float(base.logp(p)), float(strict.logp(p)), rtol=1e-5
+        )
+
+    def test_sparse_gp_strict(self):
+        from pytensor_federated_tpu.models.gp import (
+            FederatedSparseGP,
+            generate_gp_data,
+        )
+
+        data, pool = generate_gp_data(4, n_obs=64, seed=4)
+        z = np.linspace(-2, 2, 16).astype(np.float32)
+        base = FederatedSparseGP(data, z)
+        strict = FederatedSparseGP(data, z, f32_policy="strict")
+        p = base.init_params()
+        v0, g0 = base.logp_and_grad(p)
+        v1, g1 = strict.logp_and_grad(p)
+        np.testing.assert_allclose(float(v0), float(v1), rtol=1e-5)
+        for k in g0:
+            # Looser than the exact-GP case: the VFE trace residual is
+            # a cancellation of two O(n·var) quantities, so ~1e-6
+            # relative reordering differences in v amplify to ~1e-3 in
+            # the lengthscale gradient — conditioning, not mechanism.
+            np.testing.assert_allclose(
+                np.asarray(g0[k]), np.asarray(g1[k]), rtol=5e-3, atol=1e-5
+            )
+
+    def test_gp_posterior_strict(self):
+        from pytensor_federated_tpu.models.gp import (
+            FederatedExactGP,
+            generate_gp_data,
+        )
+
+        data, _ = generate_gp_data(4, n_obs=32, seed=5)
+        base = FederatedExactGP(data)
+        strict = FederatedExactGP(data, f32_policy="strict")
+        p = base.init_params()
+        xs = np.linspace(-2, 2, 7).astype(np.float32)
+        m0, v0 = base.posterior(p, xs)
+        m1, v1 = strict.posterior(p, xs)
+        np.testing.assert_allclose(
+            np.asarray(m0), np.asarray(m1), rtol=1e-4, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(v0), np.asarray(v1), rtol=1e-4, atol=1e-5
+        )
+
+    def test_kalman_strict(self):
+        from pytensor_federated_tpu.models.statespace import (
+            generate_lgssm_data,
+            kalman_logp_parallel,
+            kalman_logp_seq,
+        )
+
+        y, p = generate_lgssm_data(T=256)
+        for fn in (kalman_logp_seq, kalman_logp_parallel):
+            v0 = float(jax.jit(lambda q: fn(q, y))(p))
+            v1 = float(
+                jax.jit(lambda q: fn(q, y, precision="strict"))(p)
+            )
+            np.testing.assert_allclose(v0, v1, rtol=1e-5)
+
+    def test_linear_predictor_strict(self):
+        from pytensor_federated_tpu.models.hierbase import linear_predictor
+
+        rng = np.random.default_rng(6)
+        X = jnp.asarray(rng.normal(size=(128, 16)).astype(np.float32))
+        w = jnp.asarray(rng.normal(size=16).astype(np.float32))
+        out0 = linear_predictor(X, w, 0.5)
+        out1 = linear_predictor(X, w, 0.5, compute_dtype="float32_strict")
+        np.testing.assert_allclose(
+            np.asarray(out0), np.asarray(out1), rtol=1e-5, atol=1e-6
+        )
+
+    def test_logistic_model_strict_dtype(self):
+        from pytensor_federated_tpu.models.logistic import (
+            FederatedLogisticRegression,
+            generate_logistic_data,
+        )
+
+        data, _ = generate_logistic_data(
+            n_shards=4, n_obs=32, n_features=8
+        )
+        base = FederatedLogisticRegression(data)
+        strict = FederatedLogisticRegression(
+            data, compute_dtype="float32_strict"
+        )
+        p = base.init_params()
+        np.testing.assert_allclose(
+            float(base.logp(p)), float(strict.logp(p)), rtol=1e-5
+        )

@@ -47,13 +47,21 @@ def main():
         print(f"f32 matmul precision={prec}: max relerr {err:.3e}",
               flush=True)
 
-    # 1b. which mechanism, if any, recovers true-f32 accuracy?  (The
-    # first run showed the context manager changes dot_generals inside
-    # the Kalman but NOT a plain a @ b — pin down what does.)
+    # 1b. which mechanism recovers true-f32 accuracy?  Tests the
+    # SHIPPED mechanisms (pytensor_federated_tpu.precision): the
+    # per-site HIGHEST request and the 6-pass bf16x3 split behind
+    # pdot/f32_policy.  ACCEPTANCE (round-3 verdict item 4): at least
+    # one mechanism's norm-relative error <= 1e-5 on this 512-dot.
+    # (Norm-relative, not elementwise max: individual outputs can
+    # nearly cancel — plain f32 CPU maxes at 6e-4 elementwise on an
+    # output with |ref| ~ 1.6e-3; the L2 ratio separates honest f32
+    # ~1e-7 from bf16-degraded ~1e-3 unambiguously.)
+    import sys
+
+    sys.path.insert(0, "/root/repo")
     from jax import lax
 
-    def dot_prec(a, b):
-        return jnp.dot(a, b, precision=lax.Precision.HIGHEST)
+    from pytensor_federated_tpu.precision import pdot, split_dot
 
     def dot_pref(a, b):
         return lax.dot_general(
@@ -62,31 +70,24 @@ def main():
             preferred_element_type=jnp.float32,
         )
 
-    def dot_split(a, b):
-        # 3-pass bf16 split: f32 = hi + lo with hi = bf16(x).
-        ahi = a.astype(jnp.bfloat16).astype(jnp.float32)
-        alo = a - ahi
-        bhi = b.astype(jnp.bfloat16).astype(jnp.float32)
-        blo = b - bhi
-        return (
-            jnp.dot(ahi, bhi) + jnp.dot(ahi, blo) + jnp.dot(alo, bhi)
-        )
-
+    refnorm = np.linalg.norm(ref)
     for name, fn in (
-        ("dot(precision=HIGHEST)", dot_prec),
+        ("pdot(highest)", lambda a, b: pdot(a, b, "highest")),
         ("dot_general(HIGHEST, pref=f32)", dot_pref),
-        ("3-pass bf16 split", dot_split),
+        ("split_dot (6-pass bf16x3)", split_dot),
     ):
         out = jax.jit(fn)(jnp.asarray(A), jnp.asarray(w))
-        err = np.max(
-            np.abs(np.asarray(out, np.float64) - ref) / np.abs(ref)
+        d = np.asarray(out, np.float64) - ref
+        err = np.max(np.abs(d) / np.abs(ref))
+        nerr = np.linalg.norm(d) / refnorm
+        verdict = "PASS" if nerr <= 1e-5 else "FAIL"
+        print(
+            f"f32 matvec via {name}: max relerr {err:.3e} "
+            f"norm-rel {nerr:.3e} [{verdict} @1e-5]",
+            flush=True,
         )
-        print(f"f32 matvec via {name}: max relerr {err:.3e}", flush=True)
 
     # --- 2. parallel Kalman: finiteness + honest single-eval wall ----
-    import sys
-
-    sys.path.insert(0, "/root/repo")
     from jax.flatten_util import ravel_pytree
 
     from pytensor_federated_tpu.models.statespace import (
@@ -97,23 +98,41 @@ def main():
     y_ss, p_ss = generate_lgssm_data(T=4096)
     flat0, unravel = ravel_pytree(p_ss)
 
-    for prec in ("default", "highest"):
-        with jax.default_matmul_precision(prec):
-            fn = jax.jit(
-                lambda x: jax.value_and_grad(
-                    lambda v: kalman_logp_parallel(unravel(v), y_ss)
-                )(x)
+    # CPU float64-ish reference (CPU f32 is honest) for the acceptance
+    # line: strict on chip must match CPU within 1e-4 relative.
+    cpu0 = jax.devices("cpu")[0]
+    with jax.default_device(cpu0):
+        v_ref = float(
+            jax.jit(lambda x: kalman_logp_parallel(unravel(x), y_ss))(
+                jax.device_put(flat0, cpu0)
             )
+        )
+
+    # Every row passes its policy EXPLICITLY: precision=None would
+    # re-resolve PFTPU_F32_POLICY at trace time, and a set env var
+    # would silently contaminate the baseline rows this section exists
+    # to measure.
+    for prec in ("default", "highest", "strict"):
+        fn = jax.jit(
+            lambda x, _p=prec: jax.value_and_grad(
+                lambda v: kalman_logp_parallel(
+                    unravel(v), y_ss, precision=_p
+                )
+            )(x)
+        )
+        v, g = fn(flat0)
+        jax.block_until_ready(g)
+        t0 = time.perf_counter()
+        for _ in range(5):
             v, g = fn(flat0)
-            jax.block_until_ready(g)
-            t0 = time.perf_counter()
-            for _ in range(5):
-                v, g = fn(flat0)
-            jax.block_until_ready(g)
-            wall = (time.perf_counter() - t0) / 5
+        jax.block_until_ready(g)
+        wall = (time.perf_counter() - t0) / 5
         g = np.asarray(g)
+        rel = abs(float(v) - v_ref) / max(abs(v_ref), 1e-30)
+        verdict = "PASS" if rel <= 1e-4 else "FAIL"
         print(
             f"kalman_parallel precision={prec}: v={float(v):.6g} "
+            f"relerr_vs_cpu={rel:.3e} [{verdict} @1e-4] "
             f"grad_finite={np.isfinite(g).all()} "
             f"grad_absmax={np.abs(g).max():.3g} wall={wall * 1e3:.2f}ms",
             flush=True,
@@ -192,27 +211,33 @@ def main():
     )
 
     data_gp, _ = generate_gp_data(8, n_obs=256, seed=9)
-    gp = FederatedExactGP(data_gp)
-    p_gp = gp.init_params()
-    v_tpu, g_tpu = gp.logp_and_grad(p_gp)
     cpu = jax.devices("cpu")[0]
-    with jax.default_device(cpu):
-        v_cpu, g_cpu = jax.jit(gp.logp_and_grad)(
-            jax.device_put(p_gp, cpu)
+    # 5b acceptance (round-3 verdict item 4): the STRICT policy's
+    # on-chip logp must match CPU within 1e-4 relative even if the
+    # default policy is bf16-poisoned.
+    for pol in ("default", "strict"):
+        gp = FederatedExactGP(data_gp, f32_policy=pol)
+        p_gp = gp.init_params()
+        v_tpu, g_tpu = gp.logp_and_grad(p_gp)
+        with jax.default_device(cpu):
+            v_cpu, g_cpu = jax.jit(gp.logp_and_grad)(
+                jax.device_put(p_gp, cpu)
+            )
+        rel = abs(float(v_tpu) - float(v_cpu)) / abs(float(v_cpu))
+        gflat = np.concatenate(
+            [np.ravel(np.asarray(x)) for x in jax.tree_util.tree_leaves(g_tpu)]
         )
-    rel = abs(float(v_tpu) - float(v_cpu)) / abs(float(v_cpu))
-    gflat = np.concatenate(
-        [np.ravel(np.asarray(x)) for x in jax.tree_util.tree_leaves(g_tpu)]
-    )
-    gflat_c = np.concatenate(
-        [np.ravel(np.asarray(x)) for x in jax.tree_util.tree_leaves(g_cpu)]
-    )
-    grel = np.max(np.abs(gflat - gflat_c)) / np.max(np.abs(gflat_c))
-    print(
-        f"exact_gp 8x256: v_tpu={float(v_tpu):.6g} v_cpu={float(v_cpu):.6g} "
-        f"relerr {rel:.3e}, grad relerr {grel:.3e}",
-        flush=True,
-    )
+        gflat_c = np.concatenate(
+            [np.ravel(np.asarray(x)) for x in jax.tree_util.tree_leaves(g_cpu)]
+        )
+        grel = np.max(np.abs(gflat - gflat_c)) / np.max(np.abs(gflat_c))
+        verdict = "PASS" if rel <= 1e-4 else "FAIL"
+        print(
+            f"exact_gp 8x256 policy={pol}: v_tpu={float(v_tpu):.6g} "
+            f"v_cpu={float(v_cpu):.6g} relerr {rel:.3e} "
+            f"[{verdict} @1e-4], grad relerr {grel:.3e}",
+            flush=True,
+        )
 
     print("diag complete", flush=True)
 
