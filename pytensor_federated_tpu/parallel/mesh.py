@@ -148,16 +148,27 @@ def get_load(devices: Optional[Sequence[jax.Device]] = None) -> list[DeviceLoad]
 def healthy_devices(
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> list[jax.Device]:
-    """Devices that respond to a trivial computation.
+    """Devices this process can drive that respond to a trivial
+    computation.
 
     The failover analog: the reference excludes unresponsive servers at
     connect time (reference: service.py:181-184, 257-260); on TPU, a dead
     device is excluded at mesh-construction time and the caller re-jits
     over the surviving mesh (SURVEY §7 step 5).
+
+    Scope: the probe is LOCAL-VIEW by construction.  A peer process's
+    devices are never addressable from here, so on a multi-process mesh
+    they are filtered out whether that peer is alive or dead — this
+    function answers "what can THIS process compute on right now", not
+    "which hosts are up" (cross-host liveness needs out-of-band
+    agreement; cf. the reference's per-server GetLoad probe,
+    service.py:181-184, which is likewise a local client's view).
     """
     devices = list(jax.devices()) if devices is None else list(devices)
     alive = []
     for d in devices:
+        if d.process_index != jax.process_index():
+            continue  # non-addressable: cannot be probed, let alone used
         try:
             x = jax.device_put(np.float32(1.0), d)
             if float(x) == 1.0:

@@ -129,11 +129,32 @@ def remesh_after_failure(
     evaluator over the returned mesh — state lives on the host, so no
     migration is needed (the reference's nodes are stateless for the
     same reason).
+
+    Multi-process scope: recovery is LOCAL-VIEW.  A peer's devices are
+    never addressable from this process, so on a mesh spanning several
+    processes the rebuilt mesh keeps only THIS process's healthy
+    devices — correct in the survivor-after-host-death scenario
+    (tests/test_multihost_procs.py), but it means calling this on a
+    fully healthy multi-process mesh also drops the other hosts; a
+    warning is logged whenever non-addressable devices are discarded.
+    Rebuilding a new multi-HOST mesh requires the surviving processes
+    to agree out-of-band and re-run :func:`initialize_multihost` +
+    :func:`make_multihost_mesh` with the new process set.
     """
     axis = axis or mesh.axis_names[0]
     candidates = (
         list(mesh.devices.flat) if devices is None else list(devices)
     )
+    n_remote = sum(
+        1 for d in candidates if d.process_index != jax.process_index()
+    )
+    if n_remote:
+        _log.warning(
+            "remesh: dropping %d non-addressable device(s) from other "
+            "processes (local-view recovery; see remesh_after_failure "
+            "docstring)",
+            n_remote,
+        )
     alive = healthy_devices(candidates)
     if not alive:
         raise TimeoutError("no healthy devices remain")

@@ -170,21 +170,24 @@ class FederatedLogp:
                 return jnp.sum(lp)
 
         self._total_logp = total_logp
-        self._logp = jax.jit(lambda params: total_logp(params, self.data))
-        self._logp_and_grad = jax.jit(
-            jax.value_and_grad(lambda params: total_logp(params, self.data))
-        )
+        # Data is a jit ARGUMENT, not a closure constant: its sharding
+        # flows in with the array (zero-copy — it is already placed),
+        # and multi-process meshes REQUIRE it (closing over an array
+        # spanning non-addressable devices is an error; exercised by
+        # tests/test_multihost_procs.py).
+        self._logp = jax.jit(total_logp)
+        self._logp_and_grad = jax.jit(jax.value_and_grad(total_logp))
 
     # -- the public evaluation surface (reference: common.py:52-161) --
 
     def logp(self, params: Any) -> jax.Array:
         """Scalar total log-potential (``LogpServiceClient.evaluate`` analog)."""
-        return self._logp(params)
+        return self._logp(params, self.data)
 
     def logp_and_grad(self, params: Any):
         """(logp, grads) in one fused executable
         (``LogpGradServiceClient.evaluate`` analog, reference: common.py:134-155)."""
-        return self._logp_and_grad(params)
+        return self._logp_and_grad(params, self.data)
 
     __call__ = logp
 
@@ -203,10 +206,10 @@ class FederatedLogp:
         fn = getattr(self, "_logp_batch", None)
         if fn is None:
             fn = jax.jit(
-                jax.vmap(lambda p: self._total_logp(p, self.data))
+                jax.vmap(self._total_logp, in_axes=(0, None))
             )
             self._logp_batch = fn
-        return fn(params_batch)
+        return fn(params_batch, self.data)
 
     def logp_minibatch(
         self, params: Any, key: jax.Array, num_shards: int
@@ -293,9 +296,17 @@ class FederatedLogp:
                 lp = jax.vmap(lambda d: self.per_shard_logp(params, d))(sub)
                 return jnp.sum(lp) * scale
 
-        logp_mb = jax.jit(lambda p, k: estimate(p, self.data, k))
-        vg = jax.value_and_grad(lambda p, k: estimate(p, self.data, k))
-        fns = (logp_mb, jax.jit(vg))
+        # Data as a jit argument (not a traced-in constant) for the
+        # same multi-process reason as the full evaluators above, and
+        # read from self.data at CALL time like logp/logp_and_grad —
+        # a cached snapshot would silently diverge if data is ever
+        # re-placed (e.g. after a remesh).
+        logp_mb_full = jax.jit(estimate)
+        vg_full = jax.jit(jax.value_and_grad(estimate, argnums=0))
+        fns = (
+            lambda p, k: logp_mb_full(p, self.data, k),
+            lambda p, k: vg_full(p, self.data, k),
+        )
         cache[num_shards] = fns
         return fns
 
@@ -353,7 +364,7 @@ def sharded_compute(
     placed = _shard_data_to_mesh(data, mesh, axis)
     data_specs = jax.tree_util.tree_map(lambda _: P(axis), placed)
 
-    def fn(params):
+    def fn(params, data_arg):
         def local(params, local_data):
             # Mark the replicated params device-varying BEFORE any user
             # code runs: per_shard_fn may call jax.grad internally, and a
@@ -370,6 +381,10 @@ def sharded_compute(
             mesh=mesh,
             in_specs=(jax.tree_util.tree_map(lambda _: P(), params), data_specs),
             out_specs=P(axis),
-        )(params, placed)
+        )(params, data_arg)
 
-    return jax.jit(fn)
+    # Data rides in as a jit ARGUMENT, not a closure constant — a
+    # constant spanning non-addressable devices is an error on
+    # multi-process meshes (same fix as FederatedLogp above).
+    jitted = jax.jit(fn)
+    return lambda params: jitted(params, placed)

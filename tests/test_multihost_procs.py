@@ -1,0 +1,73 @@
+"""REAL multi-process ``jax.distributed`` run on CPU (round-3 verdict
+item 5: the in-process virtual mesh never crossed the process boundary
+``parallel/multihost.py`` exists for).
+
+Two OS processes x 4 virtual CPU devices join one distributed runtime
+(gloo collectives over localhost — the DCN stand-in), run a psum'd
+federated logp+grad spanning both, then one process is confirmed dead
+and the survivor exercises ``remesh_after_failure`` + re-jit.  The
+pytest process itself never touches ``jax.distributed`` (children are
+spawned from a real script file; CLAUDE.md heredoc/spawn pitfall).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+DRIVER = os.path.join(HERE, "multihost_proc.py")
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_distributed_logp_and_failover(tmp_path):
+    coord = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ)
+    # The children force the CPU backend themselves; scrub anything
+    # that could point them at the tunneled TPU plugin, and give each
+    # 4 virtual devices (2 procs x 4 = 8 global).
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("JAX_PLATFORMS", None)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, DRIVER, str(i), "2", coord, str(tmp_path)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    try:
+        # Process 1 runs phase A then exits on its own ("dies").
+        out1, _ = procs[1].communicate(timeout=240)
+        assert procs[1].returncode == 0, out1
+        assert "PHASE-A OK" in out1, out1
+        # Only once it is REALLY dead, let the survivor recover.
+        (tmp_path / "peer_dead").write_text("1")
+        out0, _ = procs[0].communicate(timeout=240)
+        assert procs[0].returncode == 0, out0
+        assert "PHASE-A OK" in out0, out0
+        assert "PHASE-B OK" in out0, out0
+        # Both processes computed the same distributed value...
+        a0 = [l for l in out0.splitlines() if "PHASE-A OK" in l][0]
+        a1 = [l for l in out1.splitlines() if "PHASE-A OK" in l][0]
+        assert a0.split("logp=")[1] == a1.split("logp=")[1]
+        # ...and the survivor reproduced it after the remesh.
+        b0 = [l for l in out0.splitlines() if "PHASE-B OK" in l][0]
+        assert a0.split("logp=")[1] == b0.split("logp=")[1]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
