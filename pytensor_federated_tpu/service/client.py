@@ -52,16 +52,39 @@ _identity = lambda b: b  # noqa: E731
 async def get_load_async(
     host: str, port: int, *, timeout: float = 5.0
 ) -> Optional[dict]:
-    """Query one server's load; ``None`` if unreachable/slow
-    (reference: get_load_async, service.py:161-186)."""
+    """Query one server's load; ``None`` if unreachable/slow/garbled
+    (reference: get_load_async, service.py:161-186).
+
+    The reply format is AUTO-DETECTED: this package's nodes answer
+    JSON (always starts with ``{``); an unmodified reference node —
+    or a node started with ``getload_wire="npproto"`` — answers the
+    reference's protobuf ``GetLoadResult`` (service.proto:24-31),
+    which can never start with ``{`` (0x7B = field 15 with illegal
+    wire type 3).  Either way the same dict comes back, so ANY client
+    can balance over ANY pool.
+    """
     try:
         async with grpc.aio.insecure_channel(f"{host}:{port}") as channel:
             method = channel.unary_unary(
                 GET_LOAD, request_serializer=_identity, response_deserializer=_identity
             )
             reply = await asyncio.wait_for(method(b""), timeout=timeout)
-            return json.loads(reply.decode("utf-8"))
-    except (asyncio.TimeoutError, grpc.aio.AioRpcError, OSError, ConnectionError):
+            if reply[:1] == b"{":
+                return json.loads(reply.decode("utf-8"))
+            from .npwire import WireError
+            from .npproto_codec import decode_get_load_result
+
+            try:
+                return decode_get_load_result(reply)
+            except WireError:
+                return None
+    except (
+        asyncio.TimeoutError,
+        grpc.aio.AioRpcError,
+        OSError,
+        ConnectionError,
+        ValueError,  # garbled JSON / undecodable bytes
+    ):
         return None
 
 
@@ -209,7 +232,20 @@ class ArraysToArraysServiceClient:
         hosts_and_ports: Optional[Sequence[HostPort]] = None,
         use_stream: bool = True,
         retries: int = 2,
+        codec: str = "npwire",
     ):
+        """``codec``: "npwire" (this package's native framing, default)
+        or "npproto" — the REFERENCE's protobuf wire
+        (protobufs/service.proto:6-19), letting this client talk to an
+        unmodified reference node pool.  Method paths are identical in
+        both stacks (``/ArraysToArraysService/...``), so only Evaluate
+        payload bytes differ; GetLoad balancing auto-detects the reply
+        format and needs no codec choice.
+        """
+        if codec not in ("npwire", "npproto"):
+            raise ValueError(
+                f"codec must be 'npwire' or 'npproto', got {codec!r}"
+            )
         if hosts_and_ports is None:
             if host is None or port is None:
                 raise ValueError("pass host+port or hosts_and_ports")
@@ -221,6 +257,7 @@ class ArraysToArraysServiceClient:
         ]
         self.use_stream = use_stream
         self.retries = retries
+        self.codec = codec
         self._cache_token = uuid_mod.uuid4().hex
 
     # -- connection management -------------------------------------------
@@ -282,8 +319,20 @@ class ArraysToArraysServiceClient:
     async def evaluate_async(self, *arrays: np.ndarray) -> List[np.ndarray]:
         """Evaluate with retry-and-rebalance failover
         (reference: evaluate_async, service.py:376-423)."""
-        uuid = uuid_mod.uuid4().bytes
-        request = encode_arrays([np.asarray(a) for a in arrays], uuid=uuid)
+        arrays = [np.asarray(a) for a in arrays]
+        if self.codec == "npproto":
+            from . import npproto_codec
+
+            uuid = str(uuid_mod.uuid4())
+            request = npproto_codec.encode_arrays_msg(arrays, uuid=uuid)
+            decode = lambda reply: (  # noqa: E731
+                *npproto_codec.decode_arrays_msg(reply),
+                None,
+            )
+        else:
+            uuid = uuid_mod.uuid4().bytes
+            request = encode_arrays(arrays, uuid=uuid)
+            decode = decode_arrays
         last_exc: Optional[BaseException] = None
         for _ in range(self.retries + 1):
             try:
@@ -292,7 +341,7 @@ class ArraysToArraysServiceClient:
                 last_exc = e
                 await self._drop_privates()
                 continue
-            outputs, reply_uuid, error = decode_arrays(reply)
+            outputs, reply_uuid, error = decode(reply)
             if error is not None:
                 raise RuntimeError(f"server error: {error}")
             if reply_uuid != uuid:

@@ -1,0 +1,358 @@
+"""Hand-rolled proto3 codec for the REFERENCE wire format.
+
+The reference speaks protobuf over gRPC (reference:
+protobufs/npproto/ndarray.proto:7-12, protobufs/service.proto:6-19,
+rpc.py:31-72 via betterproto/grpclib).  npwire (this package's native
+framing) is deliberately different — but a migrating user must be able
+to point THIS client at an unmodified reference node pool, and a
+reference client at this package's nodes.  This module implements
+exactly the four message types those two .proto files define, as plain
+proto3 wire-format encode/decode with no codegen and no protobuf
+dependency:
+
+    npproto.ndarray   data(1: bytes) dtype(2: string)
+                      shape(3: repeated int64) strides(4: repeated int64)
+    InputArrays       items(1: repeated ndarray) uuid(2: string)
+    OutputArrays      items(1: repeated ndarray) uuid(2: string)
+    GetLoadParams     (empty)
+    GetLoadResult     n_clients(1: int32) percent_cpu(2: float)
+                      percent_ram(3: float)
+
+Wire-format notes (proto3 spec):
+
+- varints are little-endian base-128; int32/int64 negatives are
+  10-byte two's-complement varints (NOT zigzag — that is sint*).
+- repeated int64 accepts BOTH packed (len-delimited, the proto3
+  default emitted here) and unpacked (one varint per element) forms on
+  decode, as the spec requires of parsers.
+- unknown fields are skipped by wire type (forward compatibility);
+  truncated/overlong/invalid payloads raise :class:`~.npwire.WireError`
+  loudly — same failure contract as npwire (property-tested).
+- encoding is canonical: fields in ascending number order, packed
+  repeats, nothing emitted for empty/default scalars — byte-identical
+  to the official protobuf encoder for these messages (cross-checked
+  against the google.protobuf runtime in tests when available).
+
+ndarray conversion semantics match the reference helpers
+(reference: npproto/utils.py:9-24): ``dtype=str(arr.dtype)``,
+``data=bytes(arr.data)``, shape and strides in element/byte units; on
+decode the array is materialized from (buffer, dtype, shape, strides).
+``dtype=object`` is rejected loudly — the reference ships pointers that
+only round-trip in-process (reference: README.md:30, test_npproto.py:20);
+here it is the same hard error npwire raises.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .npwire import WireError
+
+__all__ = [
+    "encode_ndarray",
+    "decode_ndarray",
+    "encode_arrays_msg",
+    "decode_arrays_msg",
+    "encode_get_load_result",
+    "decode_get_load_result",
+    "GETLOAD_PARAMS",
+]
+
+# GetLoadParams has no fields: its canonical encoding is empty.
+GETLOAD_PARAMS = b""
+
+_WT_VARINT = 0
+_WT_I64 = 1
+_WT_LEN = 2
+_WT_I32 = 5
+
+
+# ---------------------------------------------------------------------------
+# primitives
+# ---------------------------------------------------------------------------
+
+
+def _encode_varint(value: int) -> bytes:
+    """Unsigned base-128 varint (callers pre-map negatives)."""
+    if value < 0:
+        raise WireError(f"varint must be non-negative, got {value}")
+    out = bytearray()
+    while True:
+        b = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _encode_int64(value: int) -> bytes:
+    """int32/int64 field encoding: negatives as 64-bit two's complement."""
+    if not -(1 << 63) <= value < (1 << 64):
+        raise WireError(f"int64 out of range: {value}")
+    return _encode_varint(value & 0xFFFFFFFFFFFFFFFF)
+
+
+def _decode_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    result = 0
+    shift = 0
+    start = pos
+    while True:
+        if pos >= len(buf):
+            raise WireError(f"truncated varint at byte {start}")
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 63:
+            raise WireError(f"overlong varint at byte {start}")
+
+
+def _to_int64(raw: int) -> int:
+    """Interpret a decoded varint as a signed 64-bit value."""
+    return raw - (1 << 64) if raw >= (1 << 63) else raw
+
+
+def _tag(field: int, wire_type: int) -> bytes:
+    return _encode_varint((field << 3) | wire_type)
+
+
+def _len_field(field: int, payload: bytes) -> bytes:
+    return _tag(field, _WT_LEN) + _encode_varint(len(payload)) + payload
+
+
+def _decode_tag(buf: bytes, pos: int) -> Tuple[int, int, int]:
+    raw, pos = _decode_varint(buf, pos)
+    field, wire_type = raw >> 3, raw & 0x7
+    if field == 0:
+        raise WireError(f"illegal field number 0 at byte {pos}")
+    return field, wire_type, pos
+
+
+def _decode_len(buf: bytes, pos: int) -> Tuple[bytes, int]:
+    n, pos = _decode_varint(buf, pos)
+    end = pos + n
+    if end > len(buf):
+        raise WireError(
+            f"length-delimited field overruns buffer ({end} > {len(buf)})"
+        )
+    return buf[pos:end], end
+
+
+def _skip(buf: bytes, pos: int, wire_type: int) -> int:
+    """Skip an unknown field's payload (forward compatibility)."""
+    if wire_type == _WT_VARINT:
+        _, pos = _decode_varint(buf, pos)
+        return pos
+    if wire_type == _WT_I64:
+        if pos + 8 > len(buf):
+            raise WireError("truncated fixed64 field")
+        return pos + 8
+    if wire_type == _WT_LEN:
+        _, pos = _decode_len(buf, pos)
+        return pos
+    if wire_type == _WT_I32:
+        if pos + 4 > len(buf):
+            raise WireError("truncated fixed32 field")
+        return pos + 4
+    raise WireError(f"unsupported wire type {wire_type}")
+
+
+def _decode_repeated_int64(
+    buf: bytes, pos: int, wire_type: int, into: List[int]
+) -> int:
+    """One occurrence of a repeated int64 field: packed or unpacked."""
+    if wire_type == _WT_LEN:  # packed
+        payload, pos = _decode_len(buf, pos)
+        p = 0
+        while p < len(payload):
+            raw, p = _decode_varint(payload, p)
+            into.append(_to_int64(raw))
+        return pos
+    if wire_type == _WT_VARINT:  # unpacked
+        raw, pos = _decode_varint(buf, pos)
+        into.append(_to_int64(raw))
+        return pos
+    raise WireError(f"repeated int64 field with wire type {wire_type}")
+
+
+# ---------------------------------------------------------------------------
+# npproto.ndarray
+# ---------------------------------------------------------------------------
+
+
+def encode_ndarray(arr: np.ndarray) -> bytes:
+    """numpy -> npproto.ndarray bytes (reference: npproto/utils.py:9-16)."""
+    arr = np.asarray(arr)
+    if arr.dtype.hasobject:
+        raise WireError(
+            "dtype=object cannot cross the wire (the reference serializes "
+            "in-process pointers here; this codec rejects it loudly)"
+        )
+    # The reference wire carries dtype as str(dtype) and reconstructs
+    # with np.dtype(s) (reference: npproto/utils.py:12,22) — structured
+    # dtypes don't survive that round trip (str() gives a repr np.dtype
+    # rejects), on EITHER end.  Fail here, loudly, not remotely.
+    try:
+        if np.dtype(str(arr.dtype)) != arr.dtype:
+            raise TypeError("round-trip changed the dtype")
+    except TypeError as e:
+        raise WireError(
+            f"dtype {arr.dtype!r} does not survive the reference wire's "
+            f"str()/np.dtype() round trip ({e}); the native npwire codec "
+            "ships structured dtypes via their full descr instead"
+        ) from None
+    out = bytearray()
+    # NOT np.ascontiguousarray: that promotes 0-d arrays to 1-d, and
+    # the strides field must stay consistent with the true shape.
+    contig = arr if arr.flags.c_contiguous else arr.copy(order="C")
+    data = contig.tobytes()
+    # proto3 canonical: default-valued (empty) scalar fields are not
+    # serialized — matches the official encoder byte for byte.
+    if data:
+        out += _len_field(1, data)
+    out += _len_field(2, str(arr.dtype).encode("utf-8"))
+    # contiguous data => contiguous strides, consistent with the shape
+    if arr.shape:
+        packed = b"".join(_encode_int64(s) for s in arr.shape)
+        out += _len_field(3, packed)
+    if contig.strides:
+        packed = b"".join(_encode_int64(s) for s in contig.strides)
+        out += _len_field(4, packed)
+    return bytes(out)
+
+
+def decode_ndarray(buf: bytes) -> np.ndarray:
+    """npproto.ndarray bytes -> numpy (reference: npproto/utils.py:19-24)."""
+    data: Optional[bytes] = None
+    dtype_str = ""
+    shape: List[int] = []
+    strides: List[int] = []
+    pos = 0
+    while pos < len(buf):
+        field, wt, pos = _decode_tag(buf, pos)
+        if field == 1 and wt == _WT_LEN:
+            data, pos = _decode_len(buf, pos)
+        elif field == 2 and wt == _WT_LEN:
+            raw, pos = _decode_len(buf, pos)
+            try:
+                dtype_str = raw.decode("utf-8")
+            except UnicodeDecodeError as e:
+                raise WireError(f"bad dtype string: {e}") from None
+        elif field == 3:
+            pos = _decode_repeated_int64(buf, pos, wt, shape)
+        elif field == 4:
+            pos = _decode_repeated_int64(buf, pos, wt, strides)
+        else:
+            pos = _skip(buf, pos, wt)
+    try:
+        dtype = np.dtype(dtype_str or "float64")
+    except TypeError as e:
+        raise WireError(f"bad dtype {dtype_str!r}: {e}") from None
+    if dtype.hasobject:
+        raise WireError("dtype=object cannot cross the wire")
+    if any(s < 0 for s in shape):
+        raise WireError(f"negative dimension in shape {shape}")
+    try:
+        return np.ndarray(
+            buffer=data if data is not None else b"",
+            shape=shape,
+            dtype=dtype,
+            strides=strides or None,
+        ).copy()  # own the memory; the input buffer may be reused
+    except (ValueError, TypeError) as e:
+        raise WireError(
+            f"inconsistent ndarray (shape={shape}, dtype={dtype_str!r}, "
+            f"strides={strides}, {len(data or b'')} data bytes): {e}"
+        ) from None
+
+
+# ---------------------------------------------------------------------------
+# InputArrays / OutputArrays (identical layout)
+# ---------------------------------------------------------------------------
+
+
+def encode_arrays_msg(arrays: Sequence[np.ndarray], uuid: str) -> bytes:
+    """InputArrays/OutputArrays: repeated ndarray items + string uuid
+    (reference: service.proto:6-19; uuid is the correlation id the
+    reference's client checks, rpc.py:37-39)."""
+    out = bytearray()
+    for a in arrays:
+        out += _len_field(1, encode_ndarray(a))
+    if uuid:
+        out += _len_field(2, uuid.encode("utf-8"))
+    return bytes(out)
+
+
+def decode_arrays_msg(buf: bytes) -> Tuple[List[np.ndarray], str]:
+    arrays: List[np.ndarray] = []
+    uuid = ""
+    pos = 0
+    while pos < len(buf):
+        field, wt, pos = _decode_tag(buf, pos)
+        if field == 1 and wt == _WT_LEN:
+            item, pos = _decode_len(buf, pos)
+            arrays.append(decode_ndarray(item))
+        elif field == 2 and wt == _WT_LEN:
+            raw, pos = _decode_len(buf, pos)
+            try:
+                uuid = raw.decode("utf-8")
+            except UnicodeDecodeError as e:
+                raise WireError(f"bad uuid string: {e}") from None
+        else:
+            pos = _skip(buf, pos, wt)
+    return arrays, uuid
+
+
+# ---------------------------------------------------------------------------
+# GetLoadResult
+# ---------------------------------------------------------------------------
+
+
+def encode_get_load_result(
+    n_clients: int, percent_cpu: float, percent_ram: float
+) -> bytes:
+    out = bytearray()
+    if n_clients:
+        out += _tag(1, _WT_VARINT) + _encode_int64(n_clients)
+    if percent_cpu:
+        out += _tag(2, _WT_I32) + struct.pack("<f", percent_cpu)
+    if percent_ram:
+        out += _tag(3, _WT_I32) + struct.pack("<f", percent_ram)
+    return bytes(out)
+
+
+def decode_get_load_result(buf: bytes) -> dict:
+    n_clients, percent_cpu, percent_ram = 0, 0.0, 0.0
+    pos = 0
+    while pos < len(buf):
+        field, wt, pos = _decode_tag(buf, pos)
+        if field == 1 and wt == _WT_VARINT:
+            raw, pos = _decode_varint(buf, pos)
+            val = _to_int64(raw)
+            if not -(1 << 31) <= val < (1 << 31):
+                raise WireError(f"n_clients out of int32 range: {val}")
+            n_clients = val
+        elif field == 2 and wt == _WT_I32:
+            if pos + 4 > len(buf):
+                raise WireError("truncated percent_cpu")
+            (percent_cpu,) = struct.unpack_from("<f", buf, pos)
+            pos += 4
+        elif field == 3 and wt == _WT_I32:
+            if pos + 4 > len(buf):
+                raise WireError("truncated percent_ram")
+            (percent_ram,) = struct.unpack_from("<f", buf, pos)
+            pos += 4
+        else:
+            pos = _skip(buf, pos, wt)
+    return {
+        "n_clients": n_clients,
+        "percent_cpu": percent_cpu,
+        "percent_ram": percent_ram,
+    }

@@ -72,7 +72,25 @@ class ArraysToArraysService:
     ``GetLoad`` control-plane query.
     """
 
-    def __init__(self, compute_fn: Callable[..., Sequence[np.ndarray]]):
+    def __init__(
+        self,
+        compute_fn: Callable[..., Sequence[np.ndarray]],
+        *,
+        getload_wire: str = "npwire",
+    ):
+        """``getload_wire``: "npwire" (JSON reply, this package's
+        native clients) or "npproto" (reference ``GetLoadResult``
+        protobuf, for serving unmodified reference clients).  Evaluate
+        and the stream need no such switch — their request payload
+        identifies the wire and the reply mirrors it — but GetLoad's
+        request is EMPTY in both schemas, so the reply format is a
+        node-level choice."""
+        if getload_wire not in ("npwire", "npproto"):
+            raise ValueError(
+                f"getload_wire must be 'npwire' or 'npproto', "
+                f"got {getload_wire!r}"
+            )
+        self.getload_wire = getload_wire
         self.compute_fn = compute_fn
         self._n_clients = 0
         # Start psutil's interval-based CPU accounting early so the
@@ -91,22 +109,48 @@ class ArraysToArraysService:
 
         Errors are encoded into the reply instead of tearing down the
         stream (reference: _run_compute_func, service.py:45-72).
+
+        WIRE AUTO-DETECTION: a request starting with the npwire magic
+        is npwire (this package's native client); anything else is
+        decoded as the reference's protobuf ``InputArrays``
+        (npproto_codec — an npwire frame can never parse as proto:
+        ``N`` = tag with illegal wire type 6, and a proto payload can
+        never carry the magic).  The reply uses the SAME format, so an
+        unmodified reference client gets reference-wire replies.  The
+        reference schema has NO error field — its server re-raises into
+        the gRPC layer (reference: service.py:45-72) — so npproto
+        decode/compute errors raise here too and surface to the peer as
+        a gRPC error, exactly what a reference client expects.
         """
-        try:
-            inputs, uuid, _ = decode_arrays(request)
-        except Exception as e:
-            return encode_arrays([], uuid=b"\0" * 16, error=f"decode error: {e}")
+        from . import npproto_codec
+        from .npwire import MAGIC
+
+        is_npwire = request[:4] == MAGIC
+        if is_npwire:
+            try:
+                inputs, uuid, _ = decode_arrays(request)
+            except Exception as e:
+                return encode_arrays(
+                    [], uuid=b"\0" * 16, error=f"decode error: {e}"
+                )
+        else:
+            inputs, proto_uuid = npproto_codec.decode_arrays_msg(request)
         try:
             loop = asyncio.get_running_loop()
             outputs = await loop.run_in_executor(
                 None, lambda: list(self.compute_fn(*inputs))
             )
-            return encode_arrays(
-                [np.asarray(o) for o in outputs], uuid=uuid
-            )
+            outputs = [np.asarray(o) for o in outputs]
         except Exception as e:
             _log.exception("compute_fn failed")
-            return encode_arrays([], uuid=uuid, error=f"compute error: {e}")
+            if is_npwire:
+                return encode_arrays(
+                    [], uuid=uuid, error=f"compute error: {e}"
+                )
+            raise
+        if is_npwire:
+            return encode_arrays(outputs, uuid=uuid)
+        return npproto_codec.encode_arrays_msg(outputs, uuid=proto_uuid)
 
     # -- RPC methods ------------------------------------------------------
 
@@ -141,7 +185,14 @@ class ArraysToArraysService:
         }
 
     async def get_load(self, request: bytes, context) -> bytes:
-        return json.dumps(self.determine_load()).encode("utf-8")
+        load = self.determine_load()
+        if self.getload_wire == "npproto":
+            from . import npproto_codec
+
+            return npproto_codec.encode_get_load_result(
+                load["n_clients"], load["percent_cpu"], load["percent_ram"]
+            )
+        return json.dumps(load).encode("utf-8")
 
     # -- wiring -----------------------------------------------------------
 
@@ -167,15 +218,31 @@ class ArraysToArraysService:
 
 
 async def serve(
-    compute_fn: Callable[..., Sequence[np.ndarray]],
+    compute_fn: Optional[Callable[..., Sequence[np.ndarray]]],
     bind: str = "127.0.0.1",
     port: int = 50000,
     *,
+    getload_wire: str = "npwire",
     service: Optional[ArraysToArraysService] = None,
 ) -> grpc.aio.Server:
     """Start a node server (reference: demo_node.py:76-79).  Returns the
-    started ``grpc.aio.Server``; await ``server.wait_for_termination()``."""
-    service = service or ArraysToArraysService(compute_fn)
+    started ``grpc.aio.Server``; await ``server.wait_for_termination()``.
+
+    Pass EITHER ``compute_fn`` (+ optional ``getload_wire``) — the
+    service is constructed here — or a pre-built ``service`` with
+    ``compute_fn=None``; both at once would be two sources of truth for
+    what the node computes."""
+    if service is None:
+        if compute_fn is None:
+            raise ValueError("pass compute_fn or a pre-built service")
+        service = ArraysToArraysService(
+            compute_fn, getload_wire=getload_wire
+        )
+    elif compute_fn is not None:
+        raise ValueError(
+            "pass either compute_fn or a pre-built service, not both "
+            "(the service already owns its compute_fn)"
+        )
     server = grpc.aio.server()
     server.add_generic_rpc_handlers((service.generic_handler(),))
     server.add_insecure_port(f"{bind}:{port}")
@@ -188,11 +255,19 @@ def run_node(
     compute_fn: Callable[..., Sequence[np.ndarray]],
     bind: str = "127.0.0.1",
     port: int = 50000,
+    *,
+    getload_wire: str = "npwire",
 ) -> None:
-    """Blocking single-node entry point (reference: demo_node.py:83-95)."""
+    """Blocking single-node entry point (reference: demo_node.py:83-95).
+
+    ``getload_wire="npproto"`` serves reference-format GetLoad replies
+    so UNMODIFIED reference clients can balance over this node
+    (Evaluate/EvaluateStream auto-detect per request either way)."""
 
     async def main():
-        server = await serve(compute_fn, bind, port)
+        server = await serve(
+            compute_fn, bind, port, getload_wire=getload_wire
+        )
         await server.wait_for_termination()
 
     asyncio.run(main())
