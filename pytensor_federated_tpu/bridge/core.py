@@ -1,0 +1,217 @@
+"""Pure, pytensor-free cores of the bridge layer — tested DIRECTLY.
+
+pytensor/pymc are not installable in this build environment, so the
+Apply/optdb glue in :mod:`.pytensor_ops` / :mod:`.fusion` cannot
+execute here (tests/test_bridge.py, test_fusion.py skip at import).
+Everything with actual LOGIC is therefore factored out where it runs
+under test without pytensor — the same policy that produced
+:mod:`..fanout_exec` (the fused perform's scheduling contract) and
+:mod:`.grouping` (the rewrite's independence planning).  This module
+holds the rest:
+
+- the ``perform``-layer output coercion/validation contracts
+  (reference: the implicit contracts of wrapper_ops.py:26-33, 57-69,
+  106-118 — output arity, scalar logp, one grad per input, dtype cast);
+- the grad-output dtype policy (int inputs upcast to floatX so the
+  gradient is not silently truncated — the reference types them
+  ``i.type()`` unconditionally, wrapper_ops.py:97-105, a trap this
+  framework does not replicate);
+- the JAX-dispatch composition: node-shaped output wrapping per op
+  kind and the fused-op member inliner (what ``jax_funcify`` returns).
+
+What remains pytensor-ONLY after this extraction is enumerated, with
+measured line counts, in docs/migrating.md ("Unexecuted bridge
+surface") — kept to thin Apply/optdb adapter code whose failure mode
+is an import/signature error on first use, not silent wrong numbers.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "coerce_outputs",
+    "coerce_logp",
+    "coerce_logp_grads",
+    "grad_output_dtype",
+    "plan_fusion",
+    "member_jax_callable",
+    "fused_jax_callable",
+]
+
+
+# ---------------------------------------------------------------------------
+# perform-layer contracts
+# ---------------------------------------------------------------------------
+
+
+def coerce_outputs(
+    results: Sequence, dtypes: Sequence[str]
+) -> List[np.ndarray]:
+    """Arrays->arrays output contract: arity must match, each output is
+    cast to its declared dtype (reference: FromFunctionOp semantics,
+    wrapper_ops.py:26-33)."""
+    results = list(results)
+    if len(results) != len(dtypes):
+        raise ValueError(
+            f"compute_fn returned {len(results)} outputs, "
+            f"expected {len(dtypes)}"
+        )
+    return [np.asarray(r, dtype=d) for r, d in zip(results, dtypes)]
+
+
+def coerce_logp(logp, dtype: str) -> np.ndarray:
+    """Scalar log-potential contract (reference: wrapper_ops.py:57-69)."""
+    out = np.asarray(logp, dtype=dtype)
+    if out.ndim != 0:
+        raise ValueError(f"logp must be scalar, got shape {out.shape}")
+    return out
+
+
+def coerce_logp_grads(
+    logp, grads: Sequence, logp_dtype: str, grad_dtypes: Sequence[str]
+) -> Tuple[np.ndarray, List[np.ndarray]]:
+    """``[logp, *grads]`` contract: one grad per input, each cast to its
+    declared (possibly upcast — see :func:`grad_output_dtype`) dtype
+    (reference: wrapper_ops.py:106-118)."""
+    if len(grads) != len(grad_dtypes):
+        raise ValueError(
+            f"logp_grad_fn returned {len(grads)} grads for "
+            f"{len(grad_dtypes)} inputs"
+        )
+    return (
+        coerce_logp(logp, logp_dtype),
+        [np.asarray(g, dtype=d) for g, d in zip(grads, grad_dtypes)],
+    )
+
+
+def grad_output_dtype(input_dtype: str, floatX: str = "float64") -> str:
+    """Dtype of the grad output for an input of ``input_dtype``.
+
+    Integer/bool inputs (the raw-python-int coercion path) get floatX:
+    an int-typed grad output would silently truncate the float gradient
+    at the cast in ``coerce_logp_grads``.
+    """
+    if str(input_dtype).startswith(("int", "uint", "bool")):
+        return floatX
+    return str(input_dtype)
+
+
+# ---------------------------------------------------------------------------
+# rewrite replacement planning
+# ---------------------------------------------------------------------------
+
+
+def plan_fusion(
+    group: Sequence,
+    *,
+    op_of: Callable,
+    inputs_of: Callable,
+    outputs_of: Callable,
+):
+    """Plan one group's fused replacement: the constructor arguments of
+    the fused op plus the (old_output -> fused_output_index) pairing.
+
+    Pure bookkeeping extracted from the rewriter (the part that decides
+    WHAT replaces what; the two-line ``fgraph.replace_all_validate``
+    call is the only pytensor left).  Returns a dict with:
+
+    - ``members``: each node's op, in group order;
+    - ``in_counts`` / ``out_counts``: per-member arities;
+    - ``all_inputs``: concatenated member inputs (fused apply inputs);
+    - ``replacements``: ``[(old_output, fused_output_position), ...]``
+      — every member output paired with its index into the fused
+      apply's outputs, order-preserving.
+    """
+    members = [op_of(n) for n in group]
+    in_counts = [len(inputs_of(n)) for n in group]
+    out_counts = [len(outputs_of(n)) for n in group]
+    all_inputs = [i for n in group for i in inputs_of(n)]
+    old_outputs = [o for n in group for o in outputs_of(n)]
+    replacements = list(zip(old_outputs, range(len(old_outputs))))
+    return {
+        "members": members,
+        "in_counts": in_counts,
+        "out_counts": out_counts,
+        "all_inputs": all_inputs,
+        "replacements": replacements,
+    }
+
+
+# ---------------------------------------------------------------------------
+# JAX-dispatch composition
+# ---------------------------------------------------------------------------
+
+
+def member_jax_callable(
+    kind: str, fn: Callable, *, name: str = "op"
+) -> Callable:
+    """Node-shaped JAX callable for one federated op.
+
+    ``kind``: ``"logp_grad"`` (fn returns ``(logp, [grads])`` ->
+    flattened ``(logp, *grads)``), ``"logp"`` (scalar through), or
+    ``"arrays"`` (sequence -> tuple).  This is exactly what the
+    ``jax_funcify`` registrations return; dispatching on an explicit
+    kind keeps it testable without pytensor op classes.  ``name`` goes
+    into the missing-``jax_fn`` error so a fused graph with several
+    federated ops points at the unconfigured one.
+    """
+    if fn is None:
+        raise NotImplementedError(
+            f"{name} has no jax_fn; pass jax_fn= to compile through "
+            "the JAX linker"
+        )
+    if kind == "logp_grad":
+
+        def logp_grad(*inputs):
+            logp, grads = fn(*inputs)
+            return (logp, *tuple(grads))
+
+        return logp_grad
+    if kind == "logp":
+
+        def logp(*inputs):
+            return fn(*inputs)
+
+        return logp
+    if kind == "arrays":
+
+        def arrays_to_arrays(*inputs):
+            return tuple(fn(*inputs))
+
+        return arrays_to_arrays
+    raise ValueError(f"unknown member kind {kind!r}")
+
+
+def fused_jax_callable(
+    member_fns: Sequence[Callable], in_counts: Sequence[int]
+) -> Callable:
+    """Inline N node-shaped member callables into one flat callable —
+    the fused op's JAX dispatch (XLA overlaps the members on its own).
+    Input/output flattening mirrors ``fanout_exec.run_members``'s
+    storage slicing, so the jit path and the perform path cannot
+    disagree about order."""
+    member_fns = list(member_fns)
+    in_counts = list(in_counts)
+    if len(member_fns) != len(in_counts):
+        raise ValueError(
+            f"{len(member_fns)} member fns but {len(in_counts)} in_counts"
+        )
+
+    def parallel(*inputs):
+        if len(inputs) != sum(in_counts):
+            raise ValueError(
+                f"fused callable got {len(inputs)} inputs, members "
+                f"consume {sum(in_counts)}"
+            )
+        outs = []
+        i = 0
+        for fn, n_in in zip(member_fns, in_counts):
+            res = fn(*inputs[i : i + n_in])
+            outs.extend(res if isinstance(res, tuple) else (res,))
+            i += n_in
+        return tuple(outs)
+
+    return parallel

@@ -44,6 +44,7 @@ from pytensor.graph.op import Op
 from pytensor.graph.rewriting.basic import GraphRewriter
 
 from ..fanout_exec import MemberExecutorPool, run_members
+from .core import fused_jax_callable, plan_fusion
 from .grouping import group_independent
 from .pytensor_ops import (
     FederatedArraysToArraysOp,
@@ -179,14 +180,23 @@ class FederatedFusionRewriter(GraphRewriter):
 
     @staticmethod
     def _fuse_group(fgraph, group):
-        members = [n.op for n in group]
-        in_counts = [len(n.inputs) for n in group]
-        out_counts = [len(n.outputs) for n in group]
-        fused_op = ParallelFederatedOp(members, in_counts, out_counts)
-        all_inputs = [i for n in group for i in n.inputs]
-        fused_node = fused_op.make_node(*all_inputs)
-        old_outputs = [o for n in group for o in n.outputs]
-        repl = list(zip(old_outputs, fused_node.outputs))
+        # WHAT replaces what is planned in core.plan_fusion (pure,
+        # tested without pytensor); only the Apply construction and the
+        # validated replace remain here.
+        plan = plan_fusion(
+            group,
+            op_of=lambda n: n.op,
+            inputs_of=lambda n: n.inputs,
+            outputs_of=lambda n: n.outputs,
+        )
+        fused_op = ParallelFederatedOp(
+            plan["members"], plan["in_counts"], plan["out_counts"]
+        )
+        fused_node = fused_op.make_node(*plan["all_inputs"])
+        repl = [
+            (old, fused_node.outputs[pos])
+            for old, pos in plan["replacements"]
+        ]
         fgraph.replace_all_validate(
             repl, reason="federated_parallel_fusion"
         )
@@ -200,18 +210,12 @@ try:  # pragma: no cover - depends on pytensor version layout
 
     @jax_funcify.register(ParallelFederatedOp)
     def _jax_funcify_parallel(op, **kwargs):
-        member_fns = [_jax_funcify_for_member(m) for m in op.members]
-
-        def parallel(*inputs):
-            outs = []
-            i = 0
-            for fn, n_in in zip(member_fns, op.in_counts):
-                res = fn(*inputs[i : i + n_in])
-                outs.extend(res if isinstance(res, tuple) else (res,))
-                i += n_in
-            return tuple(outs)
-
-        return parallel
+        # Inlining order/flattening lives in core.fused_jax_callable,
+        # tested without pytensor against real jax functions.
+        return fused_jax_callable(
+            [_jax_funcify_for_member(m) for m in op.members],
+            op.in_counts,
+        )
 
 except ModuleNotFoundError:  # pragma: no cover
     pass

@@ -35,6 +35,7 @@ from pytensor.graph.basic import Apply
 from pytensor.graph.op import Op
 
 from ..signatures import ComputeFn, LogpFn, LogpGradFn
+from . import core
 
 __all__ = [
     "FederatedArraysToArraysOp",
@@ -83,13 +84,11 @@ class FederatedArraysToArraysOp(Op):
 
     def perform(self, node, inputs, output_storage):
         results = self.compute_fn(*[np.asarray(i) for i in inputs])
-        if len(results) != len(output_storage):
-            raise ValueError(
-                f"compute_fn returned {len(results)} outputs, "
-                f"expected {len(output_storage)}"
-            )
-        for storage, res, var in zip(output_storage, results, node.outputs):
-            storage[0] = np.asarray(res, dtype=var.type.dtype)
+        outs = core.coerce_outputs(
+            results, [v.type.dtype for v in node.outputs]
+        )
+        for storage, out in zip(output_storage, outs):
+            storage[0] = out
 
 
 class FederatedLogpOp(Op):
@@ -110,10 +109,9 @@ class FederatedLogpOp(Op):
 
     def perform(self, node, inputs, output_storage):
         logp = self.logp_fn(*[np.asarray(i) for i in inputs])
-        logp = np.asarray(logp, dtype=node.outputs[0].type.dtype)
-        if logp.ndim != 0:
-            raise ValueError(f"logp must be scalar, got shape {logp.shape}")
-        output_storage[0][0] = logp
+        output_storage[0][0] = core.coerce_logp(
+            logp, node.outputs[0].type.dtype
+        )
 
 
 class FederatedLogpGradOp(Op):
@@ -139,34 +137,32 @@ class FederatedLogpGradOp(Op):
 
     def make_node(self, *inputs):
         inputs = _as_tensors(inputs)
-        # Grad outputs follow each input's type — except integer inputs
-        # (the raw-int coercion path): an int-typed grad output would
-        # silently truncate the float gradient in perform, so those are
-        # upcast to floatX.  (The reference types them ``i.type()``
-        # unconditionally, reference: wrapper_ops.py:97-105 — a silent-
-        # truncation trap this framework does not replicate.)
+        # Grad-output dtype policy (int inputs upcast to floatX so the
+        # gradient is not silently truncated) lives in core.py where it
+        # is tested without pytensor.
         outputs = [pt.scalar()]
         for i in inputs:
-            if i.type.dtype.startswith(("int", "uint", "bool")):
-                outputs.append(
-                    pt.TensorType(pytensor.config.floatX, i.type.shape)()
-                )
-            else:
-                outputs.append(i.type())
+            dt = core.grad_output_dtype(
+                i.type.dtype, pytensor.config.floatX
+            )
+            outputs.append(
+                i.type()
+                if dt == i.type.dtype
+                else pt.TensorType(dt, i.type.shape)()
+            )
         return Apply(self, inputs, outputs)
 
     def perform(self, node, inputs, output_storage):
         logp, grads = self.logp_grad_fn(*[np.asarray(i) for i in inputs])
-        if len(grads) != len(inputs):
-            raise ValueError(
-                f"logp_grad_fn returned {len(grads)} grads for "
-                f"{len(inputs)} inputs"
-            )
-        output_storage[0][0] = np.asarray(
-            logp, dtype=node.outputs[0].type.dtype
+        logp, grads = core.coerce_logp_grads(
+            logp,
+            grads,
+            node.outputs[0].type.dtype,
+            [v.type.dtype for v in node.outputs[1:]],
         )
-        for storage, g, var in zip(output_storage[1:], grads, node.outputs[1:]):
-            storage[0] = np.asarray(g, dtype=var.type.dtype)
+        output_storage[0][0] = logp
+        for storage, g in zip(output_storage[1:], grads):
+            storage[0] = g
 
     def grad(self, inputs, output_grads):
         g_logp, *g_grads = output_grads
@@ -203,35 +199,24 @@ def federated_potential(logp_grad_fn: LogpGradFn, *inputs, jax_fn=None):
 # traced program: the whole NUTS step becomes one XLA executable.
 
 
+def _member_kind(op) -> str:
+    """Kind tag for :func:`..bridge.core.member_jax_callable`."""
+    if isinstance(op, FederatedLogpGradOp):
+        return "logp_grad"
+    if isinstance(op, FederatedLogpOp):
+        return "logp"
+    return "arrays"
+
+
 def _jax_funcify_for_member(op):
     """The jax callable for one federated op, with node-shaped output
     (a tuple matching the op's apply outputs).  Shared by the three
     ``jax_funcify`` registrations below and by the fused op's dispatch
-    (fusion.py)."""
-    if op.jax_fn is None:
-        raise NotImplementedError(
-            f"{type(op).__name__} has no jax_fn; pass jax_fn= to compile "
-            "through the JAX linker"
-        )
-    fn = op.jax_fn
-    if isinstance(op, FederatedLogpGradOp):
-
-        def logp_grad(*inputs):
-            logp, grads = fn(*inputs)
-            return (logp, *tuple(grads))
-
-        return logp_grad
-    if isinstance(op, FederatedLogpOp):
-
-        def logp(*inputs):
-            return fn(*inputs)
-
-        return logp
-
-    def arrays_to_arrays(*inputs):
-        return tuple(fn(*inputs))
-
-    return arrays_to_arrays
+    (fusion.py).  The wrapping itself lives in core.py, tested without
+    pytensor."""
+    return core.member_jax_callable(
+        _member_kind(op), op.jax_fn, name=type(op).__name__
+    )
 
 
 try:  # pragma: no cover - depends on pytensor version layout

@@ -1,0 +1,137 @@
+"""Pure bridge cores (bridge/core.py) — executed WITHOUT pytensor.
+
+pytensor/pymc cannot be installed here, so tests/test_bridge.py and
+test_fusion.py skip; this file covers everything those modules'
+skipped code DELEGATES to: perform-layer coercion contracts, the
+grad-dtype policy, fusion replacement planning, and the JAX-dispatch
+composition (run against real jax functions, jitted)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytensor_federated_tpu.bridge.core import (
+    coerce_logp,
+    coerce_logp_grads,
+    coerce_outputs,
+    fused_jax_callable,
+    grad_output_dtype,
+    member_jax_callable,
+    plan_fusion,
+)
+
+
+class TestPerformContracts:
+    def test_coerce_outputs_casts(self):
+        out = coerce_outputs([np.float64(1.5), [1, 2]], ["float32", "int64"])
+        assert out[0].dtype == np.float32 and out[1].dtype == np.int64
+
+    def test_coerce_outputs_arity(self):
+        with pytest.raises(ValueError, match="returned 1 outputs, expected 2"):
+            coerce_outputs([np.zeros(2)], ["float32", "float32"])
+
+    def test_coerce_logp_scalar_contract(self):
+        assert coerce_logp(2.5, "float64").shape == ()
+        with pytest.raises(ValueError, match="scalar"):
+            coerce_logp(np.zeros(3), "float64")
+
+    def test_coerce_logp_grads(self):
+        logp, grads = coerce_logp_grads(
+            1.0, [np.ones(2), 3.0], "float32", ["float32", "float64"]
+        )
+        assert logp.dtype == np.float32
+        assert grads[1].dtype == np.float64
+        with pytest.raises(ValueError, match="1 grads for 2"):
+            coerce_logp_grads(1.0, [np.ones(2)], "f", ["float32", "float32"])
+
+    def test_grad_dtype_policy(self):
+        """Int/bool inputs upcast to floatX (the reference types the
+        grad ``i.type()`` unconditionally — silent truncation,
+        reference: wrapper_ops.py:97-105); floats keep their dtype."""
+        assert grad_output_dtype("int64", "float32") == "float32"
+        assert grad_output_dtype("uint8", "float64") == "float64"
+        assert grad_output_dtype("bool", "float32") == "float32"
+        assert grad_output_dtype("float32", "float64") == "float32"
+
+    def test_int_grad_truncation_prevented_end_to_end(self):
+        """The actual trap: a 0.7 gradient through an int-typed output
+        would cast to 0.  The policy + coercion together keep it 0.7."""
+        dt = grad_output_dtype("int64", "float32")
+        _, grads = coerce_logp_grads(0.0, [0.7], "float32", [dt])
+        assert float(grads[0]) == pytest.approx(0.7)
+
+
+class _Node:
+    """Minimal stand-in for a pytensor Apply in planning tests."""
+
+    def __init__(self, op, inputs, outputs):
+        self.op, self.inputs, self.outputs = op, inputs, outputs
+
+
+class TestPlanFusion:
+    def test_plan_shapes_and_order(self):
+        a = _Node("opA", ["x", "y"], ["a0"])
+        b = _Node("opB", ["z"], ["b0", "b1"])
+        plan = plan_fusion(
+            [a, b],
+            op_of=lambda n: n.op,
+            inputs_of=lambda n: n.inputs,
+            outputs_of=lambda n: n.outputs,
+        )
+        assert plan["members"] == ["opA", "opB"]
+        assert plan["in_counts"] == [2, 1]
+        assert plan["out_counts"] == [1, 2]
+        assert plan["all_inputs"] == ["x", "y", "z"]
+        assert plan["replacements"] == [("a0", 0), ("b0", 1), ("b1", 2)]
+
+
+class TestJaxDispatch:
+    def test_member_logp_grad_flattens(self):
+        fn = member_jax_callable(
+            "logp_grad", lambda x: (jnp.sum(x), [2.0 * x])
+        )
+        out = jax.jit(fn)(jnp.arange(3.0))
+        assert len(out) == 2
+        np.testing.assert_allclose(out[1], [0.0, 2.0, 4.0])
+
+    def test_member_logp_passthrough(self):
+        fn = member_jax_callable("logp", lambda x: -jnp.sum(x**2))
+        assert float(jax.jit(fn)(jnp.ones(2))) == -2.0
+
+    def test_member_arrays_tuples(self):
+        fn = member_jax_callable("arrays", lambda x: [x + 1, x - 1])
+        out = jax.jit(fn)(jnp.zeros(2))
+        assert isinstance(out, tuple) and len(out) == 2
+
+    def test_missing_jax_fn_raises(self):
+        with pytest.raises(NotImplementedError, match="jax_fn"):
+            member_jax_callable("logp", None)
+
+    def test_unknown_kind_raises(self):
+        with pytest.raises(ValueError, match="unknown member kind"):
+            member_jax_callable("weird", lambda x: x)
+
+    def test_fused_inlines_in_order(self):
+        m1 = member_jax_callable(
+            "logp_grad", lambda x: (jnp.sum(x), [jnp.ones_like(x)])
+        )
+        m2 = member_jax_callable("logp", lambda a, b: a * b)
+        m3 = member_jax_callable("arrays", lambda x: [x * 10])
+        fused = fused_jax_callable([m1, m2, m3], [1, 2, 1])
+        out = jax.jit(fused)(
+            jnp.arange(2.0), jnp.float32(3.0), jnp.float32(4.0),
+            jnp.float32(5.0),
+        )
+        # m1 -> (sum, grad), m2 -> scalar, m3 -> (x*10,): 4 outputs flat
+        assert len(out) == 4
+        assert float(out[0]) == 1.0
+        assert float(out[2]) == 12.0
+        assert float(out[3]) == 50.0
+
+    def test_fused_arity_validated(self):
+        fused = fused_jax_callable([lambda x: (x,)], [1])
+        with pytest.raises(ValueError, match="members consume 1"):
+            fused(1.0, 2.0)
+        with pytest.raises(ValueError, match="in_counts"):
+            fused_jax_callable([lambda x: (x,)], [1, 2])

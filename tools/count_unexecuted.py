@@ -1,0 +1,88 @@
+"""Measure the bridge layer's unexecuted-LoC surface.
+
+"Unexecuted" = lines of CODE (not blanks/comments/docstrings) in
+modules that cannot import in this environment because pytensor/pymc
+are uninstallable — i.e. exactly what only executes review-time here.
+Prints one line per file plus totals; publish the numbers in
+docs/migrating.md when they change.
+"""
+
+import io
+import sys
+import tokenize
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+UNEXECUTED = [
+    "pytensor_federated_tpu/bridge/pytensor_ops.py",
+    "pytensor_federated_tpu/bridge/fusion.py",
+    "pytensor_federated_tpu/demos/demo_pymc.py",
+]
+EXECUTED_CORES = [
+    "pytensor_federated_tpu/bridge/core.py",
+    "pytensor_federated_tpu/bridge/grouping.py",
+    "pytensor_federated_tpu/fanout_exec.py",
+]
+
+
+def code_lines(path: Path) -> int:
+    """Count lines holding at least one real token (no comments,
+    docstrings/bare string statements, or blank lines).
+
+    A STRING token is a docstring (or bare string statement) exactly
+    when it starts a LOGICAL line — i.e. the last significant token
+    before it was NEWLINE/INDENT/DEDENT or the file start.  (A prefix-
+    whitespace check is NOT enough: wrapped string arguments inside a
+    call also start physical lines — review finding.)
+    """
+    src = path.read_text()
+    lines = set()
+    structural = (
+        tokenize.COMMENT,
+        tokenize.NL,
+        tokenize.NEWLINE,
+        tokenize.INDENT,
+        tokenize.DEDENT,
+        tokenize.ENCODING,
+        tokenize.ENDMARKER,
+    )
+    at_logical_start = True
+    for tok in tokenize.generate_tokens(io.StringIO(src).readline):
+        if tok.type in structural:
+            if tok.type in (
+                tokenize.NEWLINE,
+                tokenize.INDENT,
+                tokenize.DEDENT,
+            ):
+                at_logical_start = True
+            continue
+        is_docstring = tok.type == tokenize.STRING and at_logical_start
+        at_logical_start = False
+        if is_docstring:
+            continue
+        for ln in range(tok.start[0], tok.end[0] + 1):
+            lines.add(ln)
+    return len(lines)
+
+
+def main():
+    total_un = 0
+    print("# unexecuted (pytensor/pymc-gated) code lines")
+    for rel in UNEXECUTED:
+        n = code_lines(REPO / rel)
+        total_un += n
+        print(f"{rel}: {n}")
+    print(f"TOTAL unexecuted: {total_un}")
+    print("# executed pure cores they delegate to")
+    total_core = 0
+    for rel in EXECUTED_CORES:
+        n = code_lines(REPO / rel)
+        total_core += n
+        print(f"{rel}: {n}")
+    print(f"TOTAL executed cores: {total_core}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
