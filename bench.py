@@ -92,6 +92,16 @@ def time_chain(chained, x0, n, *, warm=True):
     return time.perf_counter() - t0
 
 
+class MeasurementIntegrityError(RuntimeError):
+    """A timing the integrity guards refuse to trust (degenerate chain,
+    inconsistent stages, physics-impossible rate).  A DEDICATED type so
+    callers can distinguish "the measurement is untrustworthy" from a
+    real XLA/runtime failure (jax.errors.JaxRuntimeError also
+    subclasses RuntimeError; catching that as an integrity trip would
+    misdiagnose e.g. a remote-compile outage and retry a fresh compile
+    into it)."""
+
+
 def measure_rate(
     chained,
     flat0,
@@ -134,13 +144,13 @@ def measure_rate(
     x2, _acc2 = chained(flat0, jnp.asarray(2, jnp.int32))
     x2 = np.asarray(jax.block_until_ready(x2))
     if not np.all(np.isfinite(x2)):
-        raise RuntimeError(
+        raise MeasurementIntegrityError(
             "degenerate chain: state is non-finite after 2 evals — "
             "the eval NaNs on this backend; rating it would time a "
             "constant loop, not the computation"
         )
     if np.array_equal(x2, np.asarray(flat0)):
-        raise RuntimeError(
+        raise MeasurementIntegrityError(
             "degenerate chain: state identical to x0 after 2 evals "
             "(zero gradient) — XLA hoists the loop-invariant body and "
             "the 'rate' would be meaningless"
@@ -160,7 +170,7 @@ def measure_rate(
     # only applies to slow evals (fast ones are covered by the MFU
     # physics gate and the degenerate-chain check).
     if per_eval0 > 1e-3 and per_eval < per_eval0 / 100.0:
-        raise RuntimeError(
+        raise MeasurementIntegrityError(
             f"inconsistent timing: {per_eval0 * 1e6:.3g} us/eval at "
             f"calibration but {per_eval * 1e6:.3g} us/eval at the mid "
             "stage — the runtime is returning without executing "
@@ -174,7 +184,7 @@ def measure_rate(
     wall = time_chain(chained, flat0, n, warm=False)
     rate = n / wall
     if wall < (n * per_eval) / 100.0:
-        raise RuntimeError(
+        raise MeasurementIntegrityError(
             f"inconsistent timing: final chain of {n} evals finished "
             f"{100 * wall / (n * per_eval):.2g}% faster than the mid-"
             "stage rate predicts — runtime returned without executing; "

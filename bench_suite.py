@@ -48,7 +48,13 @@ import os
 import sys
 import time
 
-from bench import NORTH_STAR, make_chained, measure_rate, preflight
+from bench import (
+    NORTH_STAR,
+    MeasurementIntegrityError,
+    make_chained,
+    measure_rate,
+    preflight,
+)
 
 # Driver-set explicit targets for the configs the north star does not
 # cover (round-1 VERDICT: a null vs_baseline makes "fast enough"
@@ -315,21 +321,68 @@ def main():
         fn_seq, flat_seq = _flat_fn(lambda p: kalman_logp_seq(p, y_ss), p_ss)
         sizing6 = dict(n_cal=20, floor=50, mid_wall=0.5, target_wall=1.5)
         r_seq, _ = _rate(fn_seq, flat_seq, **sizing6)
+        # Default precision first; if the measurement trips an
+        # INTEGRITY guard (the first TPU capture: reduced-precision
+        # matmul compositions degenerated the chain until XLA hoisted
+        # the eval — a physically impossible 6.8e11 evals/s), fall back
+        # to the verified-engaging strict policy and record THAT, with
+        # the impl field saying so (tools/diag_tpu.out; precision.py).
+        # ONLY MeasurementIntegrityError routes to the fallback: a
+        # JaxRuntimeError (also a RuntimeError) means the backend
+        # itself failed — retrying with a FRESH strict compile into
+        # e.g. a remote-compile outage would double the cost the
+        # per-config guard bounds.
+        def physics_gate(fl, rate):
+            # The record()-level mfu>1.5 backstop, applied INSIDE the
+            # fallback scope so an impossible default-precision rate
+            # still engages strict instead of failing the config.
+            m = mfu_fields(fl, rate).get("mfu")
+            if m is not None and m > 1.5:
+                raise MeasurementIntegrityError(
+                    f"implausible mfu {m} — rate above hardware peak"
+                )
+
+        impl6 = "default-precision"
         fn_ss, flat_ss = _flat_fn(
             lambda p: kalman_logp_parallel(p, y_ss), p_ss
         )
-        fl6 = xla_flops_per_eval(fn_ss, flat_ss)
-        r6, n6 = _rate(fn_ss, flat_ss, **sizing6)
+        try:
+            fl6 = xla_flops_per_eval(fn_ss, flat_ss)
+            r6, n6 = _rate(fn_ss, flat_ss, **sizing6)
+            physics_gate(fl6, r6)
+        except MeasurementIntegrityError as e:
+            print(
+                f"# kalman default-precision refused ({e}); "
+                "re-measuring under precision='strict'",
+                file=sys.stderr,
+            )
+            impl6 = "f32-strict"
+            fn_ss, flat_ss = _flat_fn(
+                lambda p: kalman_logp_parallel(p, y_ss, precision="strict"),
+                p_ss,
+            )
+            fl6 = xla_flops_per_eval(fn_ss, flat_ss)
+            r6, n6 = _rate(fn_ss, flat_ss, **sizing6)
+            physics_gate(fl6, r6)
+            # Matched-conditions baseline: the seq filter re-measured
+            # under the SAME precision, else "parallel-in-time pays"
+            # would be confounded with the precision ladder.
+            fn_seq_s, flat_seq_s = _flat_fn(
+                lambda p: kalman_logp_seq(p, y_ss, precision="strict"),
+                p_ss,
+            )
+            r_seq, _ = _rate(fn_seq_s, flat_seq_s, **sizing6)
         record(
             "LGSSM T=4096 logp+grad (parallel-in-time Kalman)",
             r6,
             baseline_rate=r_seq,
             baseline_desc=(
-                f"sequential-scan Kalman filter, same run "
-                f"({r_seq:.1f} evals/s)"
+                f"sequential-scan Kalman filter, same run, same "
+                f"precision ({r_seq:.1f} evals/s)"
             ),
             flops_per_eval=fl6,
             n=n6,
+            impl=impl6,
         )
 
     guard("LGSSM parallel Kalman", _c6)
@@ -362,29 +415,48 @@ def main():
         fnw16, vm16, _ = batched_flat(
             FederatedLogisticRegression(dataw, compute_dtype=jnp.bfloat16)
         )
+        # The GUARANTEED-accurate reference: the 6-pass bf16x3 split
+        # (precision.py) — true-f32 on any backend, including the chip
+        # whose plain f32 matmul is bf16-accurate (the first capture's
+        # gate failure was the "f32" reference itself being degraded,
+        # tools/diag_tpu.out).  It also RACES below: the measured cost
+        # of guaranteed accuracy is part of the record.
+        fnws, vms, _ = batched_flat(
+            FederatedLogisticRegression(
+                dataw, compute_dtype="float32_strict"
+            )
+        )
         key = jax.random.PRNGKey(3)
         xw = xw1[None, :] + 0.01 * jax.random.normal(
             key, (n_chains, xw1.shape[0]), xw1.dtype
         )
-        # bf16 races f32 behind an explicit looser gate (bf16 has 8
-        # mantissa bits: ~1e-2 relative is its accuracy contract, pinned
-        # in tests/test_mixed_precision.py — NOT the exact-impl 2e-4
-        # gate).  Checked PER CHAIN (no cross-chain cancellation) and on
-        # the gradients, since the raced function's gradient drives the
-        # chained trajectory — the bench.py gate convention.
-        val32, grad32 = vm32(xw)
-        val16, grad16 = vm16(xw)
-        np.testing.assert_allclose(
-            np.asarray(val16), np.asarray(val32), rtol=2e-2
-        )
-        np.testing.assert_allclose(
-            np.asarray(grad16),
-            np.asarray(grad32),
-            rtol=5e-2,
-            atol=5e-2 * float(jnp.max(jnp.abs(grad32))),
-        )
+        # Accuracy gates, all anchored on the STRICT reference.  bf16
+        # gets its accuracy contract (8 mantissa bits ~ 1e-2, pinned in
+        # tests/test_mixed_precision.py); plain f32 gets the same loose
+        # gate, NOT the exact 2e-4 one, because on this TPU plain f32
+        # IS bf16-level — the gate must hold on both backends.  Checked
+        # PER CHAIN (no cross-chain cancellation) and on the gradients,
+        # since the raced function's gradient drives the chained
+        # trajectory — the bench.py gate convention.
+        val_s, grad_s = vms(xw)
+        for other_vm in (vm32, vm16):
+            val_o, grad_o = other_vm(xw)
+            np.testing.assert_allclose(
+                np.asarray(val_o), np.asarray(val_s), rtol=2e-2
+            )
+            np.testing.assert_allclose(
+                np.asarray(grad_o),
+                np.asarray(grad_s),
+                rtol=5e-2,
+                atol=5e-2 * float(jnp.max(jnp.abs(grad_s))),
+            )
         best = {"rate": -1.0}
-        for name, fn in {"f32": fnw, "bf16-matmul": fnw16}.items():
+        impl_rates = {}
+        for name, fn in {
+            "f32": fnw,
+            "bf16-matmul": fnw16,
+            "f32-strict": fnws,
+        }.items():
             fl = xla_flops_per_eval(fn, xw)
             r, n = _rate(
                 fn, xw, n_cal=5, floor=10, mid_wall=0.5, target_wall=1.5
@@ -393,6 +465,7 @@ def main():
                 f"# wide-logistic impl {name}: {r:,.1f} batched evals/s",
                 file=sys.stderr,
             )
+            impl_rates[name] = round(r, 1)
             if r > best["rate"]:
                 best = {"name": name, "rate": r, "n": n, "fl": fl}
         peak_rate = None
@@ -410,6 +483,10 @@ def main():
             flops_per_eval=best["fl"],
             n=best["n"],
             impl=best["name"],
+            impl_rates=impl_rates,
+            note="gates anchored on the f32-strict (bf16x3 split) "
+            "reference; impl_rates carries the accuracy-vs-speed "
+            "ladder measured in this run",
         )
 
     guard("wide logistic compute-bound", _c7)
