@@ -64,6 +64,22 @@ NUTS_TARGET_SAMPLES_PER_SEC = 50.0  # 4x200 draws, warm executable, < 16 s
 COMPUTE_BOUND_TARGET_MFU = 0.05  # below 5% MFU the chip is idling
 
 
+def physics_gate(flops_per_eval, rate):
+    """The shared mfu>1.5 physics gate: >150% of hardware peak means
+    the MEASUREMENT, not the machine, is broken (first live capture: a
+    degenerate chain recorded mfu=25685).  Raises the integrity type so
+    callers can route to a fallback instead of misreading it as a
+    backend failure."""
+    from pytensor_federated_tpu.flopcount import mfu as _mfu_fields
+
+    m = _mfu_fields(flops_per_eval, rate).get("mfu")
+    if m is not None and m > 1.5:
+        raise MeasurementIntegrityError(
+            f"implausible mfu {m} — refusing to record a rate above "
+            "hardware peak"
+        )
+
+
 def _rate(fn_flat, flat0, **sizing):
     # Same two-stage sizing as the driver metric (bench.measure_rate),
     # with lighter floors/targets so the suite stays quick.  One
@@ -161,14 +177,9 @@ def main():
             **mfu_fields(flops_per_eval, value),
             **extra,
         }
-        # Physics gate: >150% of peak means the measurement, not the
-        # machine, is broken (first live capture: a degenerate chain
-        # recorded mfu=25685).  Fail the suite rather than persist it.
-        if line.get("mfu") is not None and line["mfu"] > 1.5:
-            raise RuntimeError(
-                f"implausible mfu {line['mfu']} for {config!r} — "
-                "refusing to record a rate above hardware peak"
-            )
+        # Backstop physics gate (shared implementation; configs with a
+        # fallback path call it earlier, inside their own try scope).
+        physics_gate(flops_per_eval, value)
         results.append(line)
         print(json.dumps(line))
         # Persist INCREMENTALLY and ATOMICALLY: a later assertion
@@ -318,38 +329,39 @@ def main():
         )
 
         y_ss, p_ss = generate_lgssm_data(T=4096)
-        fn_seq, flat_seq = _flat_fn(lambda p: kalman_logp_seq(p, y_ss), p_ss)
         sizing6 = dict(n_cal=20, floor=50, mid_wall=0.5, target_wall=1.5)
-        r_seq, _ = _rate(fn_seq, flat_seq, **sizing6)
-        # Default precision first; if the measurement trips an
+
+        def measure_pair(precision):
+            """Seq baseline AND parallel filter, SAME precision — the
+            config's meaning ('parallel-in-time pays') must never be
+            confounded with the precision ladder."""
+            kwp = {} if precision is None else {"precision": precision}
+            fn_seq, flat_seq = _flat_fn(
+                lambda p: kalman_logp_seq(p, y_ss, **kwp), p_ss
+            )
+            r_seq, _ = _rate(fn_seq, flat_seq, **sizing6)
+            fn_ss, flat_ss = _flat_fn(
+                lambda p: kalman_logp_parallel(p, y_ss, **kwp), p_ss
+            )
+            fl6 = xla_flops_per_eval(fn_ss, flat_ss)
+            r6, n6 = _rate(fn_ss, flat_ss, **sizing6)
+            physics_gate(fl6, r6)
+            return r_seq, fl6, r6, n6
+
+        # Default precision first; if EITHER measurement trips an
         # INTEGRITY guard (the first TPU capture: reduced-precision
         # matmul compositions degenerated the chain until XLA hoisted
-        # the eval — a physically impossible 6.8e11 evals/s), fall back
-        # to the verified-engaging strict policy and record THAT, with
+        # the eval — a physically impossible 6.8e11 evals/s), redo the
+        # whole pair under the verified-engaging strict policy, with
         # the impl field saying so (tools/diag_tpu.out; precision.py).
         # ONLY MeasurementIntegrityError routes to the fallback: a
         # JaxRuntimeError (also a RuntimeError) means the backend
         # itself failed — retrying with a FRESH strict compile into
         # e.g. a remote-compile outage would double the cost the
         # per-config guard bounds.
-        def physics_gate(fl, rate):
-            # The record()-level mfu>1.5 backstop, applied INSIDE the
-            # fallback scope so an impossible default-precision rate
-            # still engages strict instead of failing the config.
-            m = mfu_fields(fl, rate).get("mfu")
-            if m is not None and m > 1.5:
-                raise MeasurementIntegrityError(
-                    f"implausible mfu {m} — rate above hardware peak"
-                )
-
-        impl6 = "default-precision"
-        fn_ss, flat_ss = _flat_fn(
-            lambda p: kalman_logp_parallel(p, y_ss), p_ss
-        )
         try:
-            fl6 = xla_flops_per_eval(fn_ss, flat_ss)
-            r6, n6 = _rate(fn_ss, flat_ss, **sizing6)
-            physics_gate(fl6, r6)
+            impl6 = "default-precision"
+            r_seq, fl6, r6, n6 = measure_pair(None)
         except MeasurementIntegrityError as e:
             print(
                 f"# kalman default-precision refused ({e}); "
@@ -357,21 +369,7 @@ def main():
                 file=sys.stderr,
             )
             impl6 = "f32-strict"
-            fn_ss, flat_ss = _flat_fn(
-                lambda p: kalman_logp_parallel(p, y_ss, precision="strict"),
-                p_ss,
-            )
-            fl6 = xla_flops_per_eval(fn_ss, flat_ss)
-            r6, n6 = _rate(fn_ss, flat_ss, **sizing6)
-            physics_gate(fl6, r6)
-            # Matched-conditions baseline: the seq filter re-measured
-            # under the SAME precision, else "parallel-in-time pays"
-            # would be confounded with the precision ladder.
-            fn_seq_s, flat_seq_s = _flat_fn(
-                lambda p: kalman_logp_seq(p, y_ss, precision="strict"),
-                p_ss,
-            )
-            r_seq, _ = _rate(fn_seq_s, flat_seq_s, **sizing6)
+            r_seq, fl6, r6, n6 = measure_pair("strict")
         record(
             "LGSSM T=4096 logp+grad (parallel-in-time Kalman)",
             r6,

@@ -270,6 +270,10 @@ class FederatedSparseGP:
         stats_fn = sharded_compute(
             per_shard_stats, data.tree(), mesh=mesh, axis=axis
         )
+        # Kept for the posterior-prediction path (which reuses the same
+        # psum-reducible statistics the likelihood consumes).
+        self._stats_fn = stats_fn
+        self._kern = kern
 
         def logp(params):
             stats = stats_fn(params)
@@ -332,6 +336,52 @@ class FederatedSparseGP:
         return self._logp_and_grad(params)
 
     __call__ = logp
+
+    def posterior(self, params: Any, x_star) -> tuple:
+        """GLOBAL sparse-GP posterior mean and variance at ``x_star``
+        (collapsed SGPR predictive, Titsias 2009): unlike
+        :meth:`FederatedExactGP.posterior` — independent per-shard GPs
+        — every shard's data informs ONE latent function through the
+        shared inducing statistics, so prediction needs only the same
+        psum-reduced ``(a, b)`` the likelihood consumes; no shard's raw
+        data leaves its device.
+
+        With ``L = chol(K_zz)``, ``B' = I + a/σ²``, ``L_B = chol(B')``:
+
+            μ* = K_*z L^{-T} B'^{-1} b / σ²
+            v* = k** − ‖L^{-1}K_z*‖² + ‖L_B^{-1}L^{-1}K_z*‖²
+
+        (the Nyström shrinkage plus the information recovered through
+        the inducing posterior).  Returns ``(mean, var)``, each
+        ``(n_star,)``; ``x_star`` ndim must match the training inputs'.
+        """
+        from ..precision import matmul_precision_ctx, pdot
+
+        # The SAME policy context as the logp path, live for the whole
+        # computation — including the _stats_fn call, whose jitted
+        # executable re-traces under this context (the precision config
+        # is part of jax's trace cache key), so the statistics cannot
+        # silently come back bf16-level while logp is strict.
+        with matmul_precision_ctx(self.f32_policy):
+            variance, lengthscale, noise = _unpack(params)
+            s2 = noise**2
+            stats = self._stats_fn(params)
+            a = jnp.sum(stats["a"], axis=0)
+            b = jnp.sum(stats["b"], axis=0)
+            z = self.inducing
+            m = self.m
+            kzz = self._kern(z, z, variance, lengthscale) + _JITTER * variance * jnp.eye(m)
+            l = jnp.linalg.cholesky(kzz)
+            l_b = jnp.linalg.cholesky(jnp.eye(m) + a / s2)
+            c = jax.scipy.linalg.cho_solve((l_b, True), b)
+            beta = jax.scipy.linalg.solve_triangular(l.T, c, lower=False)
+            xs = jnp.asarray(x_star, jnp.float32)
+            ks = self._kern(z, xs, variance, lengthscale)  # (M, n_star)
+            mean = pdot(ks.T, beta, self.f32_policy) / s2
+            v = jax.scipy.linalg.solve_triangular(l, ks, lower=True)
+            w = jax.scipy.linalg.solve_triangular(l_b, v, lower=True)
+            var = variance - jnp.sum(v**2, axis=0) + jnp.sum(w**2, axis=0)
+            return mean, var
 
 
 def dense_vfe_logp(params, x, y, inducing, kernel: str = "sqexp"):

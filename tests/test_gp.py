@@ -361,3 +361,106 @@ def test_sparse_gp_matern_matches_dense_vfe():
         )
     )
     np.testing.assert_allclose(float(m.logp(params)), golden, rtol=5e-4)
+
+
+class TestSparsePosterior:
+    def test_matches_dense_sgpr_predictive(self):
+        """Golden model: the federated whitened-statistics posterior
+        must equal the textbook dense SGPR predictive computed on the
+        pooled data with full n x n algebra."""
+        import jax.numpy as jnp
+        import jax.scipy.linalg as jsl
+
+        from pytensor_federated_tpu.models.gp import (
+            _JITTER,
+            FederatedSparseGP,
+            _sqexp,
+            generate_gp_data,
+        )
+
+        data, pool = generate_gp_data(4, n_obs=48, seed=11)
+        x_all, y_all = pool[0], pool[1]
+        z = np.linspace(-2.0, 2.0, 12).astype(np.float32)
+        sgp = FederatedSparseGP(data, z)
+        params = {
+            "log_variance": jnp.asarray(0.2),
+            "log_lengthscale": jnp.asarray(-0.5),
+            "log_noise": jnp.asarray(-1.2),
+        }
+        xs = np.linspace(-1.8, 1.8, 9).astype(np.float32)
+        mean, var = sgp.posterior(params, xs)
+
+        # dense reference: mu* = k*z (s2 Kzz + Kzf Kfz)^-1 Kzf y
+        variance = float(jnp.exp(params["log_variance"]))
+        ls = float(jnp.exp(params["log_lengthscale"]))
+        s2 = float(jnp.exp(params["log_noise"])) ** 2
+        kzz = np.asarray(
+            _sqexp(jnp.asarray(z), jnp.asarray(z), variance, ls)
+        ) + _JITTER * variance * np.eye(len(z))
+        kzf = np.asarray(
+            _sqexp(jnp.asarray(z), jnp.asarray(x_all), variance, ls)
+        )
+        ksz = np.asarray(
+            _sqexp(jnp.asarray(xs), jnp.asarray(z), variance, ls)
+        )
+        sigma = np.linalg.inv(kzz + kzf @ kzf.T / s2)
+        mean_ref = ksz @ sigma @ (kzf @ y_all) / s2
+        var_ref = (
+            variance
+            - np.einsum("ij,jk,ik->i", ksz, np.linalg.inv(kzz), ksz)
+            + np.einsum("ij,jk,ik->i", ksz, sigma, ksz)
+        )
+        np.testing.assert_allclose(np.asarray(mean), mean_ref, rtol=2e-3,
+                                   atol=2e-3)
+        np.testing.assert_allclose(np.asarray(var), var_ref, rtol=2e-3,
+                                   atol=2e-3)
+        # posterior variance is a variance
+        assert np.all(np.asarray(var) > 0)
+
+    def test_posterior_tracks_latent(self):
+        """Near the data, the global sparse posterior mean must track
+        the pooled observations far better than the prior does."""
+        import jax.numpy as jnp
+
+        from pytensor_federated_tpu.models.gp import (
+            FederatedSparseGP,
+            generate_gp_data,
+        )
+
+        data, pool = generate_gp_data(6, n_obs=64, seed=3)
+        x_all, y_all = pool[0], pool[1]
+        z = np.linspace(-2.0, 2.0, 24).astype(np.float32)
+        sgp = FederatedSparseGP(data, z)
+        params = {
+            "log_variance": jnp.zeros(()),
+            "log_lengthscale": jnp.asarray(-1.0),
+            "log_noise": jnp.asarray(-1.5),
+        }
+        mean, var = sgp.posterior(params, x_all[::8])
+        resid = np.asarray(mean) - y_all[::8]
+        assert np.sqrt(np.mean(resid**2)) < 0.5 * np.std(y_all)
+
+    def test_posterior_on_mesh(self, devices8):
+        """Same numbers when the statistics reduce over a mesh."""
+        import jax.numpy as jnp
+
+        from pytensor_federated_tpu.models.gp import (
+            FederatedSparseGP,
+            generate_gp_data,
+        )
+        from pytensor_federated_tpu.parallel.mesh import make_mesh
+
+        data, _ = generate_gp_data(8, n_obs=16, seed=5)
+        z = np.linspace(-2.0, 2.0, 8).astype(np.float32)
+        xs = np.linspace(-1.0, 1.0, 5).astype(np.float32)
+        single = FederatedSparseGP(data, z)
+        meshed = FederatedSparseGP(
+            data, z, mesh=make_mesh({"shards": 8}, devices=devices8)
+        )
+        p = single.init_params()
+        m0, v0 = single.posterior(p, xs)
+        m1, v1 = meshed.posterior(p, xs)
+        np.testing.assert_allclose(np.asarray(m0), np.asarray(m1), rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(v0), np.asarray(v1), rtol=1e-4,
+                                   atol=1e-5)
