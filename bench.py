@@ -277,6 +277,16 @@ def main():
     try:
         n_cal = 2_000
         runners = {name: make_chained(fn) for name, fn in candidates.items()}
+        # On chip the flagship is launch/loop-bound (~11 us/eval at
+        # unroll=8), so the while-loop's per-iteration overhead is a
+        # live candidate for the cap: race a 32x-unrolled chain of the
+        # historically fastest impl too.  make_chained's unroll is
+        # numerics-identical for any n (remainder loop), so no extra
+        # equality gate is needed — only one extra compile.
+        if "suffstats" in candidates:
+            runners["suffstats-u32"] = make_chained(
+                candidates["suffstats"], unroll=32
+            )
         cal = {
             name: time_chain(runner, flat0, n_cal)
             for name, runner in runners.items()
@@ -313,8 +323,11 @@ def main():
     from pytensor_federated_tpu.flopcount import mfu as mfu_fields
     from pytensor_federated_tpu.flopcount import xla_flops_per_eval
 
+    # Unroll variants (e.g. "suffstats-u32") are the SAME eval fn as
+    # their base candidate — account FLOPs via the base name.
+    base = best.split("-u")[0] if best not in candidates else best
     flop_extra = mfu_fields(
-        xla_flops_per_eval(candidates[best], flat0), evals_per_sec
+        xla_flops_per_eval(candidates[base], flat0), evals_per_sec
     )
     if best != "xla-autodiff":
         flop_extra["flops_per_eval_autodiff"] = xla_flops_per_eval(
