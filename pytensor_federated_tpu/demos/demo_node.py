@@ -56,24 +56,65 @@ def make_node_compute(port: int, *, delay: float = 0.0, seed: int = 123):
     return compute
 
 
-def _run_one(bind: str, port: int, delay: float) -> None:
+def _run_one(
+    bind: str, port: int, delay: float, getload_wire: str = "npwire"
+) -> None:
     logging.basicConfig(level=logging.INFO)
     from ..service import run_node
 
-    run_node(make_node_compute(port, delay=delay), bind, port)
+    run_node(
+        make_node_compute(port, delay=delay),
+        bind,
+        port,
+        getload_wire=getload_wire,
+    )
 
 
 def run_node_pool(
     bind: str = "127.0.0.1",
     ports: Sequence[int] = tuple(range(50000, 50003)),
     delay: float = 0.0,
+    *,
+    getload_wire: str = "npwire",
 ) -> None:
-    """One server process per port (reference: demo_node.py:98-108)."""
+    """One server process per port (reference: demo_node.py:98-108).
+
+    ``getload_wire="npproto"`` serves reference-protobuf GetLoad
+    replies, so UNMODIFIED reference clients can balance over this
+    pool (Evaluate auto-detects the wire per request either way).
+    """
     ctx = mp.get_context("spawn")
+    # daemon=True: node servers must die WITH the pool manager.  A
+    # killed manager otherwise orphans live servers that keep ports
+    # bound and inherited pipes open (observed: a test harness hanging
+    # on the orphans' stdout after pytest itself had finished).
     procs = [
-        ctx.Process(target=_run_one, args=(bind, p, delay), daemon=False)
+        ctx.Process(
+            target=_run_one, args=(bind, p, delay, getload_wire),
+            daemon=True,
+        )
         for p in ports
     ]
+    # SIGTERM must tear the whole pool down, not just this manager:
+    # the daemon flag is only honored at a GRACEFUL parent exit, so a
+    # signal-killed manager would orphan live servers holding ports
+    # and inherited pipes.  Converting the signal to SystemExit runs
+    # the terminations and multiprocessing's atexit cleanup.
+    # Installed BEFORE the first start() so no child can outlive a
+    # signal landing mid-startup; exits 128+signum, the conventional
+    # killed-by-signal status (a supervisor must not read a SIGTERM'd
+    # pool as a clean run).
+    import signal
+
+    def _terminate_pool(signum, frame):
+        for p in procs:
+            p.terminate()
+        raise SystemExit(128 + signum)
+
+    try:
+        signal.signal(signal.SIGTERM, _terminate_pool)
+    except ValueError:  # pragma: no cover - non-main-thread caller
+        pass
     for p in procs:
         p.start()
     _log.info("node pool: %d servers on %s:%s", len(procs), bind, list(ports))
@@ -92,9 +133,18 @@ def main(argv=None):
         "--ports", type=int, nargs="+", default=list(range(50000, 50003))
     )
     parser.add_argument("--delay", type=float, default=0.0)
+    parser.add_argument(
+        "--getload-wire",
+        choices=("npwire", "npproto"),
+        default="npwire",
+        help="GetLoad reply format: npproto serves unmodified "
+        "reference clients (service.proto GetLoadResult)",
+    )
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
-    run_node_pool(args.bind, args.ports, args.delay)
+    run_node_pool(
+        args.bind, args.ports, args.delay, getload_wire=args.getload_wire
+    )
 
 
 if __name__ == "__main__":

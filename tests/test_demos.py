@@ -34,3 +34,51 @@ def test_demo_node_main_parses():
     with pytest.raises(SystemExit) as e:
         demo_node.main(["--ports"])  # missing value
     assert e.value.code != 0
+
+
+def test_node_pool_npproto_wire():
+    """pft-demo-node --getload-wire npproto: the pool serves
+    reference-format GetLoad AND a reference-wire client evaluates
+    against it (balancing included)."""
+    import multiprocessing as mp
+    import socket
+
+    import numpy as np
+    from conftest import scrubbed_child_env
+
+    from pytensor_federated_tpu.demos.demo_node import run_node_pool
+    from pytensor_federated_tpu.service import ArraysToArraysServiceClient
+
+    # Both probe sockets stay open until BOTH ports are drawn, else the
+    # kernel can hand the second bind the port the first just released
+    # (the test_native_node._free_ports pattern).
+    socks = [socket.socket(), socket.socket()]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    with scrubbed_child_env():
+        ctx = mp.get_context("spawn")
+        proc = ctx.Process(
+            target=run_node_pool,
+            args=("127.0.0.1", ports),
+            kwargs={"getload_wire": "npproto"},
+            daemon=False,
+        )
+        proc.start()
+    try:
+        from conftest import wait_nodes_up
+
+        wait_nodes_up(ports, timeout=60)
+        client = ArraysToArraysServiceClient(
+            hosts_and_ports=[("127.0.0.1", p) for p in ports],
+            codec="npproto",
+        )
+        out = client.evaluate(np.float64(1.5), np.float64(2.0))
+        # [logp, dlogp/dintercept, dlogp/dslope] at the true params
+        assert len(out) == 3 and np.shape(out[0]) == ()
+        assert np.isfinite(float(out[0]))
+    finally:
+        proc.terminate()
+        proc.join(timeout=10)
