@@ -329,9 +329,19 @@ def _smoother_gain(F, Q, Pf):
     return jnp.linalg.solve(Pp, F @ Pf).T, Pp
 
 
-def kalman_smoother_seq(params: Any, y: jax.Array, mask: Any = None):
+def kalman_smoother_seq(
+    params: Any, y: jax.Array, mask: Any = None, *, precision: Any = None
+):
     """Smoothed marginals ``(means, covs)`` via the classic backward
-    Rauch-Tung-Striebel recursion (golden reference; O(T) depth)."""
+    Rauch-Tung-Striebel recursion (golden reference; O(T) depth).
+    ``precision`` as in :func:`kalman_logp_seq`."""
+    from ..precision import matmul_precision_ctx
+
+    with matmul_precision_ctx(precision):
+        return _kalman_smoother_seq_body(params, y, mask)
+
+
+def _kalman_smoother_seq_body(params, y, mask):
     F, H, Q, R, m0, P0 = _unpack(params)
     means, covs = _filtered_moments(params, y, mask)
 
@@ -400,14 +410,20 @@ def _smooth_from_filtered(F, Q, means, covs):
     return sm, sP
 
 
-def kalman_smoother_parallel(params: Any, y: jax.Array, mask: Any = None):
+def kalman_smoother_parallel(
+    params: Any, y: jax.Array, mask: Any = None, *, precision: Any = None
+):
     """Smoothed marginals with O(log T)-depth associative scans (one
     forward for filtering, one reverse for smoothing).  The backward
     kernels depend on observations only through the filtered moments,
-    so masking enters via the filter alone."""
-    F, H, Q, R, m0, P0 = _unpack(params)
-    means, covs = _filtered_moments(params, y, mask)
-    return _smooth_from_filtered(F, Q, means, covs)
+    so masking enters via the filter alone.  ``precision`` as in
+    :func:`kalman_logp_seq`."""
+    from ..precision import matmul_precision_ctx
+
+    with matmul_precision_ctx(precision):
+        F, H, Q, R, m0, P0 = _unpack(params)
+        means, covs = _filtered_moments(params, y, mask)
+        return _smooth_from_filtered(F, Q, means, covs)
 
 
 def _lag1_from_moments(F, Q, f_covs, sP):
@@ -416,7 +432,9 @@ def _lag1_from_moments(F, Q, f_covs, sP):
     return sP[1:] @ jnp.swapaxes(Gs, -1, -2)
 
 
-def kalman_smoother_with_lag1(params: Any, y: jax.Array, mask: Any = None):
+def kalman_smoother_with_lag1(
+    params: Any, y: jax.Array, mask: Any = None, *, precision: Any = None
+):
     """Smoothed marginals plus lag-one smoothed cross-covariances.
 
     Returns ``(means, covs, lag1)`` with ``lag1[t] =
@@ -424,11 +442,15 @@ def kalman_smoother_with_lag1(params: Any, y: jax.Array, mask: Any = None):
     RTS identity ``P^s_{t+1,t} = P^s_{t+1} G_t'``.  These are exactly
     the cross-moments the EM M-step needs (see :func:`lgssm_em`);
     verified against the dense joint-Gaussian conditional in tests.
+    ``precision`` as in :func:`kalman_logp_seq`.
     """
-    F, H, Q, R, m0, P0 = _unpack(params)
-    f_means, f_covs = _filtered_moments(params, y, mask)
-    sm, sP = _smooth_from_filtered(F, Q, f_means, f_covs)
-    return sm, sP, _lag1_from_moments(F, Q, f_covs, sP)
+    from ..precision import matmul_precision_ctx
+
+    with matmul_precision_ctx(precision):
+        F, H, Q, R, m0, P0 = _unpack(params)
+        f_means, f_covs = _filtered_moments(params, y, mask)
+        sm, sP = _smooth_from_filtered(F, Q, f_means, f_covs)
+        return sm, sP, _lag1_from_moments(F, Q, f_covs, sP)
 
 
 def lgssm_em(
@@ -438,6 +460,7 @@ def lgssm_em(
     num_iters: int = 20,
     mask: Any = None,
     fit_H: bool = False,
+    precision: Any = None,
 ):
     """Closed-form EM for the LGSSM (Shumway-Stoffer): each iteration
     runs the O(log T)-depth smoother as the E-step and updates
@@ -471,6 +494,7 @@ def lgssm_em(
         num_iters=num_iters,
         masks=None if mask is None else jnp.asarray(mask)[None],
         fit_H=fit_H,
+        precision=precision,
     )
 
 
@@ -481,6 +505,7 @@ def panel_em(
     num_iters: int = 20,
     masks: Any = None,
     fit_H: bool = False,
+    precision: Any = None,
 ):
     """Federated EM: one set of LGSSM parameters fit to a whole panel
     of series (the :class:`FederatedLGSSMPanel` layout).
@@ -495,7 +520,18 @@ def panel_em(
     ``ys``: ``(n_series, T)`` or ``(n_series, T, k)``; ``masks``
     (optional) ``(n_series, T)``.  Returns ``(params, loglik_history)``
     with the pooled marginal loglik before each update.
+    ``precision`` as in :func:`kalman_logp_seq` (the E-step runs the
+    same smoother compositions).
     """
+    from ..precision import matmul_precision_ctx
+
+    with matmul_precision_ctx(precision):
+        return _panel_em_body(
+            params, ys, num_iters=num_iters, masks=masks, fit_H=fit_H
+        )
+
+
+def _panel_em_body(params, ys, *, num_iters, masks, fit_H):
     ys = jnp.asarray(ys)
     if ys.ndim == 2:
         ys = ys[..., None]
@@ -579,7 +615,12 @@ def panel_em(
 
 
 def kalman_forecast(
-    params: Any, y: jax.Array, horizon: int, mask: Any = None
+    params: Any,
+    y: jax.Array,
+    horizon: int,
+    mask: Any = None,
+    *,
+    precision: Any = None,
 ):
     """h-step-ahead predictive moments of future observations.
 
@@ -587,8 +628,16 @@ def kalman_forecast(
     ``(horizon, k, k)``: the Gaussian moments of
     ``y_{T+h} | y_{1:T}`` for h = 1..horizon.  One filter pass (the
     O(log T) associative scan) plus an affine associative scan over the
-    horizon — no sequential propagation anywhere.
+    horizon — no sequential propagation anywhere.  ``precision`` as in
+    :func:`kalman_logp_seq`.
     """
+    from ..precision import matmul_precision_ctx
+
+    with matmul_precision_ctx(precision):
+        return _kalman_forecast_body(params, y, horizon, mask)
+
+
+def _kalman_forecast_body(params, y, horizon, mask):
     y = jnp.asarray(y)
     if y.ndim == 1:
         y = y[:, None]

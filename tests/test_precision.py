@@ -262,6 +262,51 @@ class TestModelWiring:
             )
             np.testing.assert_allclose(v0, v1, rtol=1e-5)
 
+    def test_kalman_smoothers_forecast_em_strict(self):
+        """Every state-space entry point honors precision= (review
+        finding: the smoothers/forecast/EM run the same scan
+        compositions that degenerated on chip)."""
+        from pytensor_federated_tpu.models.statespace import (
+            generate_lgssm_data,
+            kalman_forecast,
+            kalman_smoother_parallel,
+            kalman_smoother_seq,
+            kalman_smoother_with_lag1,
+            lgssm_em,
+            panel_em,
+        )
+
+        y, p = generate_lgssm_data(T=64)
+        for fn in (kalman_smoother_seq, kalman_smoother_parallel):
+            m0_, P0_ = fn(p, y)
+            m1_, P1_ = fn(p, y, precision="strict")
+            np.testing.assert_allclose(
+                np.asarray(m0_), np.asarray(m1_), rtol=1e-5, atol=1e-6
+            )
+            np.testing.assert_allclose(
+                np.asarray(P0_), np.asarray(P1_), rtol=1e-5, atol=1e-6
+            )
+        a = kalman_smoother_with_lag1(p, y, precision="strict")
+        b = kalman_smoother_with_lag1(p, y)
+        for x0, x1 in zip(a, b):
+            np.testing.assert_allclose(
+                np.asarray(x0), np.asarray(x1), rtol=1e-5, atol=1e-6
+            )
+        f0 = kalman_forecast(p, y, 4)
+        f1 = kalman_forecast(p, y, 4, precision="strict")
+        for x0, x1 in zip(f0, f1):
+            np.testing.assert_allclose(
+                np.asarray(x0), np.asarray(x1), rtol=1e-5, atol=1e-6
+            )
+        p0, h0 = lgssm_em(p, y, num_iters=2)
+        p1, h1 = lgssm_em(p, y, num_iters=2, precision="strict")
+        np.testing.assert_allclose(
+            np.asarray(h0), np.asarray(h1), rtol=1e-4
+        )
+        ys = np.stack([np.asarray(y), np.asarray(y) * 0.9])
+        _, hp = panel_em(p, ys, num_iters=2, precision="strict")
+        assert np.isfinite(np.asarray(hp)).all()
+
     def test_linear_predictor_strict(self):
         from pytensor_federated_tpu.models.hierbase import linear_predictor
 
