@@ -23,12 +23,18 @@ the exact marginal of the Nyström approximation plus trace correction):
                + log|B| - log|K_zz| + trace_term ]
     B = K_zz + A/σ²,  β = b/σ,  trace_term = (c - tr(K_zz^{-1} A))/σ²
 
-Kernels: squared-exponential (default), Matérn 3/2 and 5/2 — all
-stationary with ``k(x, x) = variance`` (which the VFE trace residual
-relies on), selected by ``kernel=`` and supporting 1-D or (n, d)
-inputs with ARD lengthscales.  Learned ``log_variance``,
-``log_lengthscale``, ``log_noise`` (unconstrained).  All math float32,
-jitter-stabilized Choleskys.
+Kernels: squared-exponential (default), Matérn 3/2 and 5/2 (all
+stationary with ``k(x, x) = variance``, which the VFE trace residual
+relies on) plus the non-stationary ``linear`` trend kernel and
+composite specs — ``"sqexp+linear"`` (sum), ``"sqexp*matern32"``
+(product) with per-component hyperparameter slots (see
+:func:`get_kernel`).  Single kernels support 1-D or (n, d) inputs with
+ARD lengthscales.  Learned ``log_variance``, ``log_lengthscale``,
+``log_noise`` (unconstrained; vector-shaped for composites, see
+:func:`kernel_hyper_shape`).  All math float32, jitter-stabilized
+Choleskys.  The sparse family rejects ``linear``-containing specs at
+construction (non-constant prior diagonal breaks the VFE residual);
+the exact family accepts every spec.
 """
 
 from __future__ import annotations
@@ -48,6 +54,20 @@ from ..utils import LOG_2PI
 _JITTER = 1e-4  # float32 Cholesky needs real jitter (relative to variance)
 
 
+def _jitter_scale(variance):
+    """Scalar magnitude for jitter terms: composite kernels carry a
+    VECTOR variance (one slot per component).  The jitter needs a
+    positive scale AT LEAST the kernel diagonal's order: sum bounds
+    sum-composites, product bounds product-composites (whose diagonal
+    scales multiplicatively — summing alone under-jitters them), and
+    max(sum, prod) covers both without knowing the spec; a slightly
+    generous jitter is harmless, an undersized one NaNs the f32
+    Cholesky.  Single kernels: sum == prod == variance, bit-identical
+    to the scalar case."""
+    v = jnp.atleast_1d(variance)
+    return jnp.maximum(jnp.sum(v), jnp.prod(v))
+
+
 def _masked_cov(x, mask, variance, lengthscale, noise, kern=None):
     """Masked exact-GP covariance with identity rows on padded slots.
 
@@ -60,11 +80,10 @@ def _masked_cov(x, mask, variance, lengthscale, noise, kern=None):
     n = x.shape[0]
     mm = mask[:, None] * mask[None, :]
     kern = kern or _sqexp
+    vjit = _JITTER * _jitter_scale(variance)
     k = kern(x, x, variance, lengthscale) * mm
-    k = k + (noise**2 + _JITTER * variance) * jnp.eye(n)
-    return k + (1.0 - mask) * (
-        1.0 - noise**2 - _JITTER * variance
-    ) * jnp.eye(n)
+    k = k + (noise**2 + vjit) * jnp.eye(n)
+    return k + (1.0 - mask) * (1.0 - noise**2 - vjit) * jnp.eye(n)
 
 
 def generate_gp_data(
@@ -170,33 +189,144 @@ def _matern52(x1, x2, variance, lengthscale, policy=None):
     return variance * (1.0 + r + r**2 / 3.0) * jnp.exp(-r)
 
 
+def _linear(x1, x2, variance, lengthscale, policy=None):
+    """(Non-stationary) linear kernel ``variance * (x1/ls)·(x2/ls)`` —
+    the trend component for composite kernels.  NOTE its diagonal is
+    ``variance * |x/ls|²``, not ``variance``, so the VFE trace residual
+    of :class:`FederatedSparseGP` (which assumes ``k(x,x) = variance``)
+    does not admit it; composites containing "linear" are for the
+    exact-GP family (enforced in FederatedSparseGP).
+    """
+    if x1.ndim == 1:
+        ls = jnp.asarray(lengthscale)
+        if ls.ndim != 0:
+            # Same contract (and message) as _sq_dist: silently
+            # broadcasting a vector lengthscale over 1-D inputs would
+            # compute a wrong kernel.
+            raise ValueError(
+                "1-D inputs take a scalar lengthscale; a vector "
+                "lengthscale (ARD) needs (n, d) inputs"
+            )
+        s1 = (x1 / ls)[:, None]
+        s2 = (x2 / ls)[:, None]
+    else:
+        s1 = x1 / lengthscale
+        s2 = x2 / lengthscale
+    from ..precision import pdot
+
+    return variance * pdot(s1, s2.T, policy)
+
+
 _KERNELS = {
     "sqexp": _sqexp,
     "matern32": _matern32,
     "matern52": _matern52,
+    "linear": _linear,
 }
 
 
+def kernel_components(name: str) -> list:
+    """Component names of a (possibly composite) kernel spec.
+
+    Specs are ``"a"``, ``"a+b[+c...]"`` (sum) or ``"a*b[*c...]"``
+    (product); mixing ``+`` and ``*`` in one spec is rejected — compose
+    in one algebra per model (nesting would need a real expression
+    grammar for little modeling gain).
+    """
+    if "+" in name and "*" in name:
+        raise ValueError(
+            f"kernel spec {name!r} mixes '+' and '*'; use one combinator"
+        )
+    parts = name.split("+") if "+" in name else name.split("*")
+    for p in parts:
+        if p not in _KERNELS:
+            raise ValueError(
+                f"unknown kernel {p!r} in spec {name!r}; choose from "
+                f"{sorted(_KERNELS)}"
+            )
+    return parts
+
+
+def kernel_hyper_shape(name: str) -> tuple:
+    """Shape of ``log_variance``/``log_lengthscale`` for this spec:
+    ``()`` for a single kernel, ``(C,)`` for a C-component composite
+    (component i reads hyper slot i)."""
+    c = len(kernel_components(name))
+    return () if c == 1 else (c,)
+
+
+def stationary_prior_diag(name: str, variance):
+    """The constant ``k(x, x)`` of a STATIONARY kernel spec: the single
+    variance, the sum of slots (sum composite) or their product
+    (product composite).  Raises for specs containing "linear" — its
+    diagonal varies with x, so callers relying on a constant prior
+    diagonal (the VFE trace residual) must reject it instead of
+    silently computing a wrong correction."""
+    parts = kernel_components(name)
+    if "linear" in parts:
+        raise ValueError(
+            f"kernel spec {name!r} contains the non-stationary 'linear' "
+            "component: k(x,x) is not constant"
+        )
+    v = jnp.broadcast_to(jnp.asarray(variance), (len(parts),))
+    return jnp.sum(v) if ("+" in name or len(parts) == 1) else jnp.prod(v)
+
+
 def get_kernel(name: str, policy: str = None):
-    """Kernel function by name: "sqexp", "matern32", "matern52".
+    """Kernel by spec — single name or "+"/"*" composite.
+
+    Singles: "sqexp", "matern32", "matern52", "linear"; composites:
+    "sqexp+linear" (sum), "sqexp*matern32" (product).
+
+    Composite kernels take VECTOR hyperparameters: ``variance`` and
+    ``lengthscale`` of shape ``(C,)``, component ``i`` consuming slot
+    ``i`` (scalars broadcast to all components).  Composites are
+    limited to scalar per-component lengthscales — ARD's per-dimension
+    vector lengthscale and per-component slots would collide in one
+    array.  Sum composites model additive structure (e.g.
+    ``linear+sqexp``: trend plus wiggle); product composites modulate
+    one kernel by another.
 
     ``policy`` (optional): bind an f32 contraction policy
-    (:mod:`..precision`) into the kernel's cross-term matmul; the
+    (:mod:`..precision`) into the kernels' cross-term matmuls; the
     returned callable keeps the 4-arg kernel signature either way.
     A CONCRETE policy (including "default") is bound as-is so the
     kernel never re-consults the env at trace time — models resolve
     the env exactly once, at construction.
     """
-    if name not in _KERNELS:
-        raise ValueError(
-            f"unknown kernel {name!r}; choose from {sorted(_KERNELS)}"
-        )
-    kern = _KERNELS[name]
-    if policy is None:
-        return kern
     import functools
 
-    return functools.partial(kern, policy=policy)
+    parts = kernel_components(name)
+    if len(parts) == 1:
+        kern = _KERNELS[name]
+        if policy is None:
+            return kern
+        return functools.partial(kern, policy=policy)
+
+    members = [
+        _KERNELS[p]
+        if policy is None
+        else functools.partial(_KERNELS[p], policy=policy)
+        for p in parts
+    ]
+    is_sum = "+" in name
+    n = len(members)
+
+    def composite(x1, x2, variance, lengthscale, **kw):
+        v = jnp.broadcast_to(jnp.asarray(variance), (n,))
+        ls = jnp.broadcast_to(jnp.asarray(lengthscale), (n,))
+        out = None
+        for i, member in enumerate(members):
+            k_i = member(x1, x2, v[i], ls[i], **kw)
+            if out is None:
+                out = k_i
+            elif is_sum:
+                out = out + k_i
+            else:
+                out = out * k_i
+        return out
+
+    return composite
 
 
 class FederatedSparseGP:
@@ -236,6 +366,11 @@ class FederatedSparseGP:
         self.mesh = mesh
         m = self.m
         z = self.inducing
+        self.kernel = kernel
+        # The VFE trace residual needs a constant prior diagonal —
+        # raises here (at construction, loudly) for "linear"-containing
+        # specs; the exact-GP family accepts those.
+        stationary_prior_diag(kernel, 1.0)
         kern = get_kernel(kernel, policy=policy)
 
         def per_shard_stats(params, shard):
@@ -251,7 +386,9 @@ class FederatedSparseGP:
             """
             (x, y), mask = shard
             variance, lengthscale, _ = _unpack(params)
-            kzz = kern(z, z, variance, lengthscale) + _JITTER * variance * jnp.eye(m)
+            kzz = kern(z, z, variance, lengthscale) + _JITTER * _jitter_scale(
+                variance
+            ) * jnp.eye(m)
             l_kzz = jnp.linalg.cholesky(kzz)
             # Masked (padding) columns are zeroed, so the matmuls below
             # exclude them without any gather/ragged handling.
@@ -260,7 +397,8 @@ class FederatedSparseGP:
             a = pdot(v, v.T, policy)
             b = pdot(v, y * mask, policy)
             q_diag = jnp.sum(v**2, axis=0)  # Nyström diag, per point
-            resid = jnp.sum(mask * (variance - q_diag))
+            kxx = stationary_prior_diag(kernel, variance)
+            resid = jnp.sum(mask * (kxx - q_diag))
             y2 = jnp.sum((y * mask) ** 2)
             n = jnp.sum(mask)
             return {"a": a, "b": b, "resid": resid, "y2": y2, "n": n}
@@ -323,9 +461,10 @@ class FederatedSparseGP:
         )
 
     def init_params(self) -> dict:
+        shape = kernel_hyper_shape(self.kernel)
         return {
-            "log_variance": jnp.zeros(()),
-            "log_lengthscale": jnp.zeros(()),
+            "log_variance": jnp.zeros(shape),
+            "log_lengthscale": jnp.zeros(shape),
             "log_noise": jnp.asarray(-1.0),
         }
 
@@ -370,7 +509,9 @@ class FederatedSparseGP:
             b = jnp.sum(stats["b"], axis=0)
             z = self.inducing
             m = self.m
-            kzz = self._kern(z, z, variance, lengthscale) + _JITTER * variance * jnp.eye(m)
+            kzz = self._kern(z, z, variance, lengthscale) + _JITTER * _jitter_scale(
+                variance
+            ) * jnp.eye(m)
             l = jnp.linalg.cholesky(kzz)
             l_b = jnp.linalg.cholesky(jnp.eye(m) + a / s2)
             c = jax.scipy.linalg.cho_solve((l_b, True), b)
@@ -380,7 +521,10 @@ class FederatedSparseGP:
             mean = pdot(ks.T, beta, self.f32_policy) / s2
             v = jax.scipy.linalg.solve_triangular(l, ks, lower=True)
             w = jax.scipy.linalg.solve_triangular(l_b, v, lower=True)
-            var = variance - jnp.sum(v**2, axis=0) + jnp.sum(w**2, axis=0)
+            # k** from the spec's constant prior diagonal (composite
+            # sums/products included; linear rejected at construction)
+            kss = stationary_prior_diag(self.kernel, variance)
+            var = kss - jnp.sum(v**2, axis=0) + jnp.sum(w**2, axis=0)
             return mean, var
 
 
@@ -400,7 +544,9 @@ def dense_vfe_logp(params, x, y, inducing, kernel: str = "sqexp"):
     n = x.shape[0]
     m = z.shape[0]
     s2 = noise**2
-    kzz = kern(z, z, variance, lengthscale) + _JITTER * variance * jnp.eye(m)
+    kzz = kern(z, z, variance, lengthscale) + _JITTER * _jitter_scale(
+        variance
+    ) * jnp.eye(m)
     kzf = kern(z, x, variance, lengthscale)
     q = kzf.T @ jnp.linalg.solve(kzz, kzf)
     cov = q + s2 * jnp.eye(n)
@@ -409,7 +555,8 @@ def dense_vfe_logp(params, x, y, inducing, kernel: str = "sqexp"):
     marginal = -0.5 * (
         y @ alpha + 2.0 * jnp.sum(jnp.log(jnp.diag(l))) + n * LOG_2PI
     )
-    trace_corr = -0.5 * (jnp.sum(variance * jnp.ones(n)) - jnp.trace(q)) / s2
+    kxx = stationary_prior_diag(kernel, variance)
+    trace_corr = -0.5 * (n * kxx - jnp.trace(q)) / s2
     return marginal + trace_corr + FederatedSparseGP._prior_logp(params)
 
 
@@ -418,9 +565,11 @@ class FederatedExactGP:
 
     Multi-site GP regression: each federated shard owns an independent
     GP over its private ``(x, y)`` with the SAME kernel (``kernel=``:
-    sqexp/matern32/matern52) and hyperparameters — the exact-inference counterpart of
-    :class:`FederatedSparseGP` for shard sizes where an n x n Cholesky
-    is affordable.  Per-shard compute is one batched ``(n, n)``
+    any :func:`get_kernel` spec — sqexp/matern32/matern52/linear and
+    "+"/"*" composites; this is the family that accepts the
+    non-stationary ``linear``) and hyperparameters — the
+    exact-inference counterpart of :class:`FederatedSparseGP` for
+    shard sizes where an n x n Cholesky is affordable.  Per-shard compute is one batched ``(n, n)``
     Cholesky + triangular solves (vmapped over shards; the heaviest
     dense-linear-algebra family in the package).
 
@@ -447,6 +596,7 @@ class FederatedExactGP:
         policy = resolve_policy(f32_policy)
         self.f32_policy = policy
         self.mesh = mesh
+        self.kernel = kernel
         self._kern = get_kernel(kernel, policy=policy)
         kern = self._kern
 
@@ -486,9 +636,10 @@ class FederatedExactGP:
         return jax.value_and_grad(self.logp)(params)
 
     def init_params(self) -> dict:
+        shape = kernel_hyper_shape(self.kernel)
         return {
-            "log_variance": jnp.zeros(()),
-            "log_lengthscale": jnp.zeros(()),
+            "log_variance": jnp.zeros(shape),
+            "log_lengthscale": jnp.zeros(shape),
             "log_noise": jnp.asarray(-1.0),
         }
 
@@ -519,7 +670,15 @@ class FederatedExactGP:
             alpha = jax.scipy.linalg.cho_solve((l, True), y_i * m_i)
             mean = pdot(ks.T, alpha, self.f32_policy)
             v = jax.scipy.linalg.solve_triangular(l, ks, lower=True)
-            var = variance - jnp.sum(v**2, axis=0)
+            var = kss_diag - jnp.sum(v**2, axis=0)
             return mean, var
 
+        # k(x*, x*) per query point, valid for EVERY kernel spec
+        # (composites and the non-stationary linear included) — the
+        # old ``variance - Σv²`` hardcoded stationarity.
+        kss_diag = jax.vmap(
+            lambda q: jnp.squeeze(
+                self._kern(q[None], q[None], variance, lengthscale)
+            )
+        )(xs)
         return jax.vmap(wrap_policy(one, self.f32_policy))(x, y, mask)

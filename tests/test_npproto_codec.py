@@ -410,6 +410,10 @@ _simple_dtypes = st.one_of(
     hnp.unsigned_integer_dtypes(endianness="="),
     hnp.floating_dtypes(endianness="=", sizes=(32, 64)),
     hnp.complex_number_dtypes(endianness="="),
+    # str(dtype)/np.dtype round-trips datetime64/timedelta64, so the
+    # reference wire carries them (unlike structured dtypes).
+    hnp.datetime64_dtypes(endianness="="),
+    hnp.timedelta64_dtypes(endianness="="),
     st.just(np.dtype("bool")),
 )
 
