@@ -476,9 +476,9 @@ class FederatedSparseGP:
 
     __call__ = logp
 
-    def posterior(self, params: Any, x_star) -> tuple:
-        """GLOBAL sparse-GP posterior mean and variance at ``x_star``
-        (collapsed SGPR predictive, Titsias 2009): unlike
+    def posterior(self, params: Any, x_star, *, return_cov: bool = False):
+        """GLOBAL sparse-GP posterior at ``x_star`` (collapsed SGPR
+        predictive, Titsias 2009): unlike
         :meth:`FederatedExactGP.posterior` — independent per-shard GPs
         — every shard's data informs ONE latent function through the
         shared inducing statistics, so prediction needs only the same
@@ -488,11 +488,14 @@ class FederatedSparseGP:
         With ``L = chol(K_zz)``, ``B' = I + a/σ²``, ``L_B = chol(B')``:
 
             μ* = K_*z L^{-T} B'^{-1} b / σ²
-            v* = k** − ‖L^{-1}K_z*‖² + ‖L_B^{-1}L^{-1}K_z*‖²
+            Σ* = K** − V'V + W'W,  V = L^{-1}K_z*, W = L_B^{-1}V
 
         (the Nyström shrinkage plus the information recovered through
-        the inducing posterior).  Returns ``(mean, var)``, each
-        ``(n_star,)``; ``x_star`` ndim must match the training inputs'.
+        the inducing posterior).  Returns ``(mean, var)`` with diagonal
+        variance by default, or ``(mean, cov)`` with the FULL predictive
+        covariance when ``return_cov=True`` (what coherent joint draws
+        need — see :meth:`posterior_sample`).  ``x_star`` ndim must
+        match the training inputs'.
         """
         from ..precision import matmul_precision_ctx, pdot
 
@@ -521,11 +524,42 @@ class FederatedSparseGP:
             mean = pdot(ks.T, beta, self.f32_policy) / s2
             v = jax.scipy.linalg.solve_triangular(l, ks, lower=True)
             w = jax.scipy.linalg.solve_triangular(l_b, v, lower=True)
+            if return_cov:
+                kss = self._kern(xs, xs, variance, lengthscale)
+                cov = (
+                    kss
+                    - pdot(v.T, v, self.f32_policy)
+                    + pdot(w.T, w, self.f32_policy)
+                )
+                return mean, cov
             # k** from the spec's constant prior diagonal (composite
             # sums/products included; linear rejected at construction)
             kss = stationary_prior_diag(self.kernel, variance)
             var = kss - jnp.sum(v**2, axis=0) + jnp.sum(w**2, axis=0)
             return mean, var
+
+    def posterior_sample(
+        self, params: Any, key, x_star, *, num_draws: int = 1
+    ) -> jax.Array:
+        """Coherent joint draws ``(num_draws, n_star)`` from the global
+        sparse-GP posterior over the LATENT function at ``x_star``
+        (jitter-stabilized Cholesky of the full predictive covariance;
+        add ``exp(log_noise)``-scaled white noise for observation
+        draws)."""
+        from ..precision import matmul_precision_ctx, pdot
+
+        mean, cov = self.posterior(params, x_star, return_cov=True)
+        # Same policy context as posterior(): the draw's Cholesky and
+        # matmul must not silently drop to bf16 when the model is
+        # strict.
+        with matmul_precision_ctx(self.f32_policy):
+            n = cov.shape[0]
+            variance, _, _ = _unpack(params)
+            chol = jnp.linalg.cholesky(
+                cov + _JITTER * _jitter_scale(variance) * jnp.eye(n)
+            )
+            eps = jax.random.normal(key, (num_draws, n), mean.dtype)
+            return mean[None, :] + pdot(eps, chol.T, self.f32_policy)
 
 
 def dense_vfe_logp(params, x, y, inducing, kernel: str = "sqexp"):
@@ -648,13 +682,16 @@ class FederatedExactGP:
 
         return find_map(self.logp, self.init_params(), **kwargs)
 
-    def posterior(self, params: Any, x_star) -> tuple:
-        """Per-shard posterior mean and variance at ``x_star`` —
-        ``(n_star,)`` shared query points for scalar-covariate data,
-        ``(n_star, d)`` when the training inputs are ``(n, d)`` (ARD):
-        query ndim must match the training inputs'.  Returns
-        ``(mean, var)`` each ``(n_shards, n_star)`` — one batched
-        solve per shard."""
+    def posterior(self, params: Any, x_star, *, return_cov: bool = False):
+        """Per-shard posterior at ``x_star`` — ``(n_star,)`` shared
+        query points for scalar-covariate data, ``(n_star, d)`` when
+        the training inputs are ``(n, d)`` (ARD): query ndim must match
+        the training inputs'.  Returns ``(mean, var)`` each
+        ``(n_shards, n_star)`` — one batched solve per shard — or,
+        with ``return_cov=True``, ``(mean, cov)`` where ``cov`` is the
+        FULL per-shard predictive covariance
+        ``(n_shards, n_star, n_star)`` (what coherent joint draws
+        need — see :meth:`posterior_sample`)."""
         (x, y), mask = self.data.tree()
         variance, lengthscale, noise = _unpack(params)
         xs = jnp.asarray(x_star, jnp.float32)
@@ -670,15 +707,43 @@ class FederatedExactGP:
             alpha = jax.scipy.linalg.cho_solve((l, True), y_i * m_i)
             mean = pdot(ks.T, alpha, self.f32_policy)
             v = jax.scipy.linalg.solve_triangular(l, ks, lower=True)
-            var = kss_diag - jnp.sum(v**2, axis=0)
-            return mean, var
+            if return_cov:
+                return mean, kss_full - pdot(v.T, v, self.f32_policy)
+            return mean, kss_diag - jnp.sum(v**2, axis=0)
 
-        # k(x*, x*) per query point, valid for EVERY kernel spec
-        # (composites and the non-stationary linear included) — the
-        # old ``variance - Σv²`` hardcoded stationarity.
-        kss_diag = jax.vmap(
-            lambda q: jnp.squeeze(
-                self._kern(q[None], q[None], variance, lengthscale)
-            )
-        )(xs)
+        # k(x*, x*), valid for EVERY kernel spec (composites and the
+        # non-stationary linear included) — the old ``variance - Σv²``
+        # hardcoded stationarity.
+        if return_cov:
+            kss_full = self._kern(xs, xs, variance, lengthscale)
+            kss_diag = None
+        else:
+            kss_full = None
+            kss_diag = jax.vmap(
+                lambda q: jnp.squeeze(
+                    self._kern(q[None], q[None], variance, lengthscale)
+                )
+            )(xs)
         return jax.vmap(wrap_policy(one, self.f32_policy))(x, y, mask)
+
+    def posterior_sample(
+        self, params: Any, key, x_star, *, num_draws: int = 1
+    ) -> jax.Array:
+        """Coherent joint draws ``(num_draws, n_shards, n_star)`` from
+        each shard's latent-function posterior at ``x_star``
+        (jitter-stabilized; add ``exp(log_noise)``-scaled white noise
+        for observation draws)."""
+        from ..precision import matmul_precision_ctx
+
+        mean, cov = self.posterior(params, x_star, return_cov=True)
+        # Same policy context as posterior() — see FederatedSparseGP.
+        with matmul_precision_ctx(self.f32_policy):
+            variance, _, _ = _unpack(params)
+            n = cov.shape[-1]
+            chol = jnp.linalg.cholesky(
+                cov + _JITTER * _jitter_scale(variance) * jnp.eye(n)
+            )
+            eps = jax.random.normal(
+                key, (num_draws, mean.shape[0], n), mean.dtype
+            )
+            return mean[None] + jnp.einsum("dsn,smn->dsm", eps, chol)

@@ -611,3 +611,78 @@ def test_jitter_scale_covers_product_composites():
     assert float(_jitter_scale(2.0)) == 2.0
     # sum-composites with sub-unit slots keep the sum bound
     assert float(_jitter_scale(jnp.asarray([0.5, 0.25]))) == 0.75
+
+
+class TestPosteriorCovAndSampling:
+    def test_exact_cov_diag_matches_var(self):
+        from pytensor_federated_tpu.models.gp import (
+            FederatedExactGP,
+            generate_gp_data,
+        )
+
+        data, _ = generate_gp_data(3, n_obs=24, seed=6)
+        gp = FederatedExactGP(data)
+        p = gp.init_params()
+        xs = np.linspace(-1.5, 1.5, 6).astype(np.float32)
+        mean_d, var = gp.posterior(p, xs)
+        mean_c, cov = gp.posterior(p, xs, return_cov=True)
+        np.testing.assert_allclose(np.asarray(mean_d), np.asarray(mean_c),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(var),
+            np.diagonal(np.asarray(cov), axis1=1, axis2=2),
+            rtol=1e-3, atol=1e-5,
+        )
+        # PSD: every shard's covariance has nonnegative eigenvalues
+        eig = np.linalg.eigvalsh(np.asarray(cov))
+        assert eig.min() > -1e-4
+
+    def test_exact_sample_moments(self):
+        from pytensor_federated_tpu.models.gp import (
+            FederatedExactGP,
+            generate_gp_data,
+        )
+
+        data, _ = generate_gp_data(2, n_obs=32, seed=9)
+        gp = FederatedExactGP(data)
+        p = gp.init_params()
+        xs = np.linspace(-1, 1, 4).astype(np.float32)
+        draws = gp.posterior_sample(
+            p, jax.random.PRNGKey(0), xs, num_draws=4000
+        )
+        assert draws.shape == (4000, 2, 4)
+        mean, var = gp.posterior(p, xs)
+        np.testing.assert_allclose(
+            draws.mean(axis=0), np.asarray(mean), atol=0.05
+        )
+        np.testing.assert_allclose(
+            draws.var(axis=0), np.asarray(var), rtol=0.15, atol=0.01
+        )
+
+    def test_sparse_cov_diag_and_sampling(self):
+        from pytensor_federated_tpu.models.gp import (
+            FederatedSparseGP,
+            generate_gp_data,
+        )
+
+        data, _ = generate_gp_data(4, n_obs=32, seed=4)
+        z = np.linspace(-2, 2, 12).astype(np.float32)
+        sgp = FederatedSparseGP(data, z)
+        p = sgp.init_params()
+        xs = np.linspace(-1.5, 1.5, 5).astype(np.float32)
+        mean_d, var = sgp.posterior(p, xs)
+        mean_c, cov = sgp.posterior(p, xs, return_cov=True)
+        np.testing.assert_allclose(np.asarray(mean_d), np.asarray(mean_c),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(var), np.diag(np.asarray(cov)), rtol=1e-3,
+            atol=1e-5,
+        )
+        assert np.linalg.eigvalsh(np.asarray(cov)).min() > -1e-4
+        draws = sgp.posterior_sample(
+            p, jax.random.PRNGKey(1), xs, num_draws=3000
+        )
+        assert draws.shape == (3000, 5)
+        np.testing.assert_allclose(
+            draws.mean(axis=0), np.asarray(mean_d), atol=0.05
+        )
