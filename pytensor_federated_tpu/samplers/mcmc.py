@@ -153,6 +153,11 @@ class SampleResult:
     stats: dict  # accept_prob / diverging / depth / energy, (chains, draws)
     step_size: jax.Array  # (chains,)
     inv_mass: jax.Array  # (chains, dim) — or (chains, dim, dim) dense
+    #: sampler-specific NON-per-draw diagnostics (e.g. pt_sample's
+    #: temperature ladder).  Kept OUT of ``stats`` on purpose: every
+    #: ``stats`` entry must be (chains, draws) because the arviz
+    #: exporters forward stats verbatim as sample_stats.
+    extra: Optional[dict] = None
 
     def summary(
         self, *, hdi_prob: float = 0.94, rank_normalized: bool = False
