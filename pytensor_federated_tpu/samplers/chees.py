@@ -123,17 +123,11 @@ def chees_sample(
     x0 = flat_init[None, :] + jitter * jax.random.normal(
         k_init, (C, dim), dtype
     )
-    if chain_sharding is not None:
-        try:
-            chain_sharding.shard_shape((C, dim))
-        except Exception as e:
-            raise ValueError(
-                f"num_chains={C} is not shardable by chain_sharding="
-                f"{chain_sharding}: {e} — num_chains must be divisible "
-                "by the mesh axis the spec partitions the leading "
-                "(chains) dimension over"
-            ) from None
-        x0 = jax.device_put(x0, chain_sharding)
+    from .mcmc import place_with_sharding
+
+    x0 = place_with_sharding(
+        x0, chain_sharding, axis_desc=f"num_chains={C}"
+    )
     logp0, grad0 = jax.vmap(lg)(x0)
 
     def one_iteration(x, logp, grad, inv_mass, step_size, traj_len, it, key):

@@ -70,6 +70,24 @@ def make_flat_logp_and_grad(
     return flat_logp, flat_init, unravel, lg
 
 
+def place_with_sharding(x, sharding, *, axis_desc: str):
+    """Validate that ``sharding`` partitions ``x``'s leading axis and
+    place ``x`` — THE one shard-validate-then-device_put implementation
+    shared by sample/chees_sample/pt_sample (a fix to the validation
+    or the error hint must not have to land in three copies)."""
+    if sharding is None:
+        return x
+    try:
+        sharding.shard_shape(x.shape)
+    except Exception as e:
+        raise ValueError(
+            f"{axis_desc} is not shardable by sharding={sharding}: {e} "
+            "— the leading dimension must be divisible by the mesh "
+            "axis the spec partitions it over"
+        ) from None
+    return jax.device_put(x, sharding)
+
+
 def make_kernel_step(
     lg: Callable, kernel: str, *, max_depth: int = 8, num_hmc_steps: int = 16
 ):
@@ -226,15 +244,9 @@ def sample(
             k_jit, init_flat.shape, dtype
         )
 
-    if chain_sharding is not None:
-        try:
-            chain_sharding.shard_shape(init_flat.shape)
-        except Exception as e:
-            raise ValueError(
-                f"num_chains={num_chains} is not shardable by "
-                f"chain_sharding={chain_sharding}: {e}"
-            ) from None
-        init_flat = jax.device_put(init_flat, chain_sharding)
+    init_flat = place_with_sharding(
+        init_flat, chain_sharding, axis_desc=f"num_chains={num_chains}"
+    )
 
     if kernel == "metropolis":
         return _sample_metropolis(

@@ -38,7 +38,11 @@ from typing import Any, Callable, Optional
 import jax
 import jax.numpy as jnp
 
-from .mcmc import SampleResult, make_flat_logp_and_grad
+from .mcmc import (
+    SampleResult,
+    make_flat_logp_and_grad,
+    place_with_sharding,
+)
 
 __all__ = ["pt_sample"]
 
@@ -116,6 +120,7 @@ def pt_sample(
     target_accept: float = 0.7,
     jitter: float = 1.0,
     logp_and_grad_fn: Optional[Callable] = None,
+    temp_sharding: Optional[Any] = None,
 ) -> SampleResult:
     """Replica-exchange HMC; returns the COLD (beta = 1) chain's draws
     as a :class:`SampleResult` with ``chains = 1``.
@@ -136,6 +141,14 @@ def pt_sample(
     each rung's acceptance rate over the draw phase (rungs near zero
     mean the ladder has a gap; add temperatures or raise ``beta_min``),
     and ``betas``.
+
+    ``temp_sharding`` (a ``NamedSharding`` partitioning the leading
+    axis, e.g. ``NamedSharding(mesh, P("temps"))``) places the replica
+    block across a device mesh — computation follows sharding: each
+    device advances its rungs' leapfrogs data-parallel and the swap
+    pass's O(K) permutation lowers to a collective gather of (dim,)
+    states, the only cross-device traffic per iteration (the
+    :func:`.chees.chees_sample` ``chain_sharding`` pattern).
     """
     if num_temps < 2:
         raise ValueError(
@@ -157,6 +170,9 @@ def pt_sample(
     k_init, k_warm, k_draw = jax.random.split(jnp.asarray(key), 3)
     x0 = flat_init[None, :] + jitter * jax.random.normal(
         k_init, (num_temps, dim), dtype
+    )
+    x0 = place_with_sharding(
+        x0, temp_sharding, axis_desc=f"num_temps={num_temps}"
     )
     u0, g0 = jax.vmap(lg)(x0)
     # NaN-safe start: a hot replica jittered into a -inf region would

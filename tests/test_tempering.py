@@ -122,3 +122,45 @@ def test_rejects_bad_beta_min():
                 key=jax.random.PRNGKey(0),
                 beta_min=bad,
             )
+
+
+def test_temp_sharding_on_mesh(devices8):
+    """Temperatures across an 8-device mesh: computation follows
+    sharding (the chees chain_sharding pattern); moments stay exact."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from pytensor_federated_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh({"temps": 8}, devices=devices8)
+
+    def logp(p):
+        return -0.5 * jnp.sum((p["mu"] - 1.5) ** 2 / 0.25)
+
+    res = pt_sample(
+        logp,
+        {"mu": jnp.zeros(2)},
+        key=jax.random.PRNGKey(3),
+        num_warmup=400,
+        num_samples=1500,
+        num_temps=8,
+        temp_sharding=NamedSharding(mesh, P("temps")),
+    )
+    draws = np.asarray(res.samples["mu"])[0]
+    np.testing.assert_allclose(draws.mean(axis=0), 1.5, atol=0.1)
+    np.testing.assert_allclose(draws.std(axis=0), 0.5, atol=0.1)
+
+
+def test_temp_sharding_indivisible_raises(devices8):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from pytensor_federated_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh({"temps": 8}, devices=devices8)
+    with pytest.raises(ValueError, match="not shardable"):
+        pt_sample(
+            bimodal_logp,
+            {"x": jnp.zeros(1)},
+            key=jax.random.PRNGKey(0),
+            num_temps=6,
+            temp_sharding=NamedSharding(mesh, P("temps")),
+        )
