@@ -88,12 +88,15 @@ def _swap_pass(u, betas, key, parity):
     """Even/odd adjacent swap proposals (all pairs of the given parity
     at once).  Exact Metropolis: ``log alpha = (b_i - b_{i+1}) *
     (u_{i+1} - u_i)``.  Returns the induced replica PERMUTATION plus
-    per-pair (accept, propose) flags (K-1,); the caller applies the
-    permutation to every per-replica array."""
+    per-pair (accept, propose, alpha) (K-1,) — ``alpha`` is the swap
+    PROBABILITY min(1, e^{log alpha}), what the ladder adaptation
+    regresses on; the caller applies the permutation to every
+    per-replica array."""
     K = u.shape[0]
     i = jnp.arange(K - 1)
     propose = (i % 2) == parity
     log_alpha = (betas[:-1] - betas[1:]) * (u[1:] - u[:-1])
+    alpha = jnp.exp(jnp.minimum(log_alpha, 0.0))
     accept = (
         jnp.log(jax.random.uniform(key, (K - 1,))) < log_alpha
     ) & propose
@@ -104,7 +107,7 @@ def _swap_pass(u, betas, key, parity):
     perm = perm.at[1:].set(
         jnp.where(accept, jnp.arange(K - 1), perm[1:])
     )
-    return perm, accept, propose
+    return perm, accept, propose, alpha
 
 
 def pt_sample(
@@ -121,6 +124,8 @@ def pt_sample(
     jitter: float = 1.0,
     logp_and_grad_fn: Optional[Callable] = None,
     temp_sharding: Optional[Any] = None,
+    adapt_ladder: bool = False,
+    target_swap: float = 0.4,
 ) -> SampleResult:
     """Replica-exchange HMC; returns the COLD (beta = 1) chain's draws
     as a :class:`SampleResult` with ``chains = 1``.
@@ -141,6 +146,16 @@ def pt_sample(
     each rung's acceptance rate over the draw phase (rungs near zero
     mean the ladder has a gap; add temperatures or raise ``beta_min``),
     and ``betas``.
+
+    ``adapt_ladder=True`` tunes the ladder SPACING during warmup by
+    stochastic approximation (Miasojedow-Moulines-Vihola style): each
+    rung's log-gap ``rho_i = log beta_i - log beta_{i+1}`` moves with
+    the proposed pairs' swap PROBABILITY toward ``target_swap`` —
+    too-easy rungs widen, dead rungs shrink — with ``beta_1`` pinned
+    at 1 so the cold chain stays exact.  The ladder freezes for the
+    draw phase (adaptation during draws would bias the chain); the
+    FINAL ladder is reported in ``extra["betas"]``.  Off by default:
+    the geometric ladder is reproducible and usually adequate.
 
     ``temp_sharding`` (a ``NamedSharding`` partitioning the leading
     axis, e.g. ``NamedSharding(mesh, P("temps"))``) places the replica
@@ -165,7 +180,17 @@ def pt_sample(
     )
     dim = flat_init.shape[0]
     dtype = flat_init.dtype
-    betas = jnp.geomspace(1.0, beta_min, num_temps).astype(dtype)
+    betas0 = jnp.geomspace(1.0, beta_min, num_temps).astype(dtype)
+    # Ladder parameterization for adaptation: positive log-beta gaps
+    # rho with beta_1 == 1 pinned; log beta_i = -sum_{j<i} rho_j.
+    log_rho0 = jnp.log(jnp.diff(-jnp.log(betas0)))
+
+    def _betas_of(log_rho):
+        return jnp.exp(
+            -jnp.concatenate(
+                [jnp.zeros((1,), dtype), jnp.cumsum(jnp.exp(log_rho))]
+            )
+        )
 
     k_init, k_warm, k_draw = jax.random.split(jnp.asarray(key), 3)
     x0 = flat_init[None, :] + jitter * jax.random.normal(
@@ -187,8 +212,12 @@ def pt_sample(
     )
 
     def iteration(carry, inp):
-        x, u, g, log_step, t = carry
+        x, u, g, log_step, log_rho, t = carry
         k_iter, adapt = inp
+        # Without adaptation the ladder is the EXACT geomspace constant
+        # (bitwise — no log/exp round trip perturbing seeded runs, no
+        # per-iteration rebuild of a loop invariant).
+        betas = _betas_of(log_rho) if adapt_ladder else betas0
         k_hmc, k_swap = jax.random.split(k_iter)
         xs, us, gs, acc = vmapped_hmc(
             lg, x, u, g, betas, jnp.exp(log_step),
@@ -199,20 +228,38 @@ def pt_sample(
         eta = adapt * 2.0 / (t + 10.0) ** 0.6
         log_step = log_step + eta * (acc - target_accept)
         parity = (t % 2).astype(jnp.int32)
-        perm, accept, propose = _swap_pass(us, betas, k_swap, parity)
+        perm, accept, propose, alpha = _swap_pass(
+            us, betas, k_swap, parity
+        )
+        if adapt_ladder:
+            # Widen rungs that swap too easily, shrink dead ones —
+            # only the pairs actually proposed this parity move.  A
+            # non-finite alpha (two replicas stuck at -inf logp) must
+            # not poison the ladder: treat it as a dead rung (0).
+            alpha = jnp.where(jnp.isfinite(alpha), alpha, 0.0)
+            # Clamp RELATIVE to the requested ladder so a deliberately
+            # tight (or wide) geomspace is never snapped to absolute
+            # bounds on step one: each gap may shrink/grow by at most
+            # e^3 (~20x) from its requested value, which also keeps the
+            # ladder from collapsing or blowing past float range.
+            log_rho = jnp.clip(
+                log_rho + eta * propose * (alpha - target_swap),
+                log_rho0 - 3.0,
+                log_rho0 + 3.0,
+            )
         # a swap exchanges WHOLE states: x, u and g permute together
         # (no re-evaluation — the swap kernel touches no new points)
         xs, us, gs = xs[perm], us[perm], gs[perm]
         n_prop = jnp.maximum(jnp.sum(propose), 1)
         swap_frac = jnp.sum(accept) / n_prop
         out = (xs[0], acc[0], swap_frac, accept, propose)
-        return (xs, us, gs, log_step, t + 1), out
+        return (xs, us, gs, log_step, log_rho, t + 1), out
 
     # find a crude initial step size: 0.1 / dim^0.25, per temperature
     log_step0 = jnp.full(
         (num_temps,), jnp.log(0.1 / dim**0.25), dtype
     )
-    carry = (x0, u0, g0, log_step0, jnp.asarray(0, jnp.int32))
+    carry = (x0, u0, g0, log_step0, log_rho0, jnp.asarray(0, jnp.int32))
     warm_keys = jax.random.split(k_warm, num_warmup)
     carry, _ = jax.lax.scan(
         iteration, carry, (warm_keys, jnp.ones((num_warmup,), dtype))
@@ -242,5 +289,8 @@ def pt_sample(
         },
         step_size=jnp.exp(carry[3][:1]),
         inv_mass=jnp.ones((1, dim), dtype),
-        extra={"swap_rate_per_pair": per_pair, "betas": betas},
+        extra={
+            "swap_rate_per_pair": per_pair,
+            "betas": _betas_of(carry[4]),
+        },
     )
