@@ -3,7 +3,10 @@
 from .countdata import (
     FederatedNegBinGLM,
     FederatedPoissonGLM,
+    FederatedZeroInflNegBinGLM,
+    FederatedZeroInflPoissonGLM,
     generate_count_data,
+    generate_zi_count_data,
 )
 from .gamma import FederatedGammaGLM, gamma_logpdf, generate_gamma_data
 from .glm import HierarchicalRadonGLM, generate_radon_data
@@ -81,12 +84,15 @@ __all__ = [
     "FederatedNegBinGLM",
     "FederatedOrdinalRegression",
     "FederatedPoissonGLM",
+    "FederatedZeroInflNegBinGLM",
+    "FederatedZeroInflPoissonGLM",
     "FederatedRobustRegression",
     "FederatedSparseGP",
     "FederatedWeibullAFT",
     "cumulative_logit_loglik",
     "gamma_logpdf",
     "generate_count_data",
+    "generate_zi_count_data",
     "get_kernel",
     "generate_gamma_data",
     "generate_mixture_data",

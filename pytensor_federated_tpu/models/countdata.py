@@ -48,10 +48,14 @@ def generate_count_data(
     n_features: int = 4,
     tau: float = 0.3,
     dispersion: Optional[float] = None,
+    pi: float = 0.0,
     seed: int = 19,
 ):
     """Per-shard count data; ``dispersion=None`` draws Poisson, a float
-    draws NB2 with that dispersion."""
+    draws NB2 with that dispersion.  ``pi > 0`` mixes in that fraction
+    of structural zeros (the zero-inflated DGP; the extra uniform draw
+    happens only then, so ``pi=0`` streams stay bit-identical to the
+    pre-ZI generator)."""
     rng = np.random.default_rng(seed)
     w_true = rng.normal(0.0, 0.4, size=n_features)
     b0_true = 0.8
@@ -67,8 +71,12 @@ def generate_count_data(
             # NB2 as Gamma-Poisson mixture: rate ~ Gamma(phi, phi/mu)
             lam = rng.gamma(dispersion, mu / dispersion)
             y = rng.poisson(lam)
+        if pi > 0:
+            y = np.where(rng.uniform(size=n_obs) < pi, 0, y)
         shards.append((X, y.astype(np.float32)))
     truth = {"w": w_true, "b0": b0_true, "b": b_true}
+    if pi > 0:
+        truth["pi"] = pi
     return pack_shards(shards, pad_to_multiple=8), truth
 
 
@@ -178,3 +186,103 @@ class FederatedNegBinGLM(HierarchicalGLMBase):
     def _sample_extra_params(self, key) -> dict:
         # HalfNormal(10) on phi, matching prior_logp.
         return {"log_phi": log_halfnormal_draw(key, 10.0)}
+
+
+def zero_inflate_logpmf(y, base_logpmf, logit_pi):
+    """Zero-inflated observation log-pmf from any count base family.
+
+    A structural-zero component with probability ``pi = sigmoid(
+    logit_pi)`` mixes with the base pmf:
+
+        y = 0:  log(pi + (1 - pi) * base(0))
+        y > 0:  log(1 - pi) + log base(y)
+
+    computed entirely in log space (``log_sigmoid`` both ways — no
+    catastrophic ``log(1 - sigmoid)``), elementwise and branch-free
+    (``where``, not ``cond``), so the vmapped/shard_mapped posterior
+    stays one fused program.  THE one implementation shared by the ZIP
+    and ZINB families below.
+    """
+    log_pi = jax.nn.log_sigmoid(logit_pi)
+    log1m_pi = jax.nn.log_sigmoid(-logit_pi)
+    with_base = log1m_pi + base_logpmf
+    return jnp.where(y == 0, jnp.logaddexp(log_pi, with_base), with_base)
+
+
+def generate_zi_count_data(
+    n_shards: int = 8,
+    *,
+    n_obs: int = 64,
+    n_features: int = 4,
+    tau: float = 0.3,
+    pi: float = 0.3,
+    dispersion: Optional[float] = None,
+    seed: int = 23,
+):
+    """Thin wrapper: :func:`generate_count_data` with ``pi`` structural
+    zeros (one DGP implementation — a fix there propagates here).
+    ``dispersion=None`` -> ZIP, a float -> ZINB."""
+    if not 0.0 < pi < 1.0:
+        raise ValueError(f"pi must be in (0, 1), got {pi}")
+    return generate_count_data(
+        n_shards,
+        n_obs=n_obs,
+        n_features=n_features,
+        tau=tau,
+        dispersion=dispersion,
+        pi=pi,
+        seed=seed,
+    )
+
+
+class _ZeroInflatedMixin:
+    """The zero-inflation overlay (learned logit-parameterized
+    structural-zero probability): wraps the BASE family's pmf and
+    simulator via ``super()``, so ZIP/ZINB cannot drift from their
+    base families or from each other — one implementation of the
+    logit prior, the warm start, and the structural-zero mask."""
+
+    def _obs_logpmf(self, params, y, eta):
+        return zero_inflate_logpmf(
+            y, super()._obs_logpmf(params, y, eta), params["logit_pi"]
+        )
+
+    def _sample_obs(self, params, key, eta):
+        k_z, k_y = jax.random.split(key)
+        y = super()._sample_obs(params, k_y, eta)
+        pi = jax.nn.sigmoid(params["logit_pi"])
+        structural = jax.random.uniform(k_z, eta.shape) < pi
+        return jnp.where(structural, 0.0, y)
+
+    def prior_logp(self, params: Any) -> jax.Array:
+        # Normal(0, 1.5) on the logit keeps pi away from the 0/1
+        # boundaries a priori without forbidding them.
+        lp = super().prior_logp(params)
+        return lp + jnp.sum(-0.5 * (params["logit_pi"] / 1.5) ** 2)
+
+    def init_params(self) -> Any:
+        p = super().init_params()
+        p["logit_pi"] = jnp.array(-1.0)  # pi ~ 0.27 warm start
+        return p
+
+    def _sample_extra_params(self, key) -> dict:
+        k_base, k_pi = jax.random.split(key)
+        extra = super()._sample_extra_params(k_base)
+        extra["logit_pi"] = 1.5 * jax.random.normal(k_pi)
+        return extra
+
+
+@dataclasses.dataclass
+class FederatedZeroInflPoissonGLM(_ZeroInflatedMixin, FederatedPoissonGLM):
+    """Hierarchical zero-inflated Poisson (ZIP) regression: excess
+    zeros beyond what the Poisson rate explains get a learned
+    structural-zero probability ``pi`` (global, logit-parameterized) —
+    the standard fix when count data has more zeros than any
+    log-linear rate can produce."""
+
+
+@dataclasses.dataclass
+class FederatedZeroInflNegBinGLM(_ZeroInflatedMixin, FederatedNegBinGLM):
+    """Hierarchical zero-inflated NB2 regression: overdispersion AND
+    excess zeros, each with its own learned parameter (``log_phi``,
+    ``logit_pi``)."""

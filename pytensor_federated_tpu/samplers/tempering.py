@@ -291,6 +291,8 @@ def pt_sample(
         inv_mass=jnp.ones((1, dim), dtype),
         extra={
             "swap_rate_per_pair": per_pair,
-            "betas": _betas_of(carry[4]),
+            # EXACTLY the ladder the iterations used: the geomspace
+            # constant when fixed (bitwise), the adapted one otherwise.
+            "betas": _betas_of(carry[4]) if adapt_ladder else betas0,
         },
     )

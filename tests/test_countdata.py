@@ -168,3 +168,109 @@ def test_poisson_overflow_stays_finite():
     v, g = jax.value_and_grad(lp)(w_extreme)
     assert np.isfinite(float(v)) and float(v) < -1e30
     assert np.all(np.isfinite(np.asarray(g)))
+
+
+class TestZeroInflated:
+    def test_pi_zero_reduces_to_base(self):
+        """logit_pi -> -inf turns ZIP into exactly Poisson (and ZINB
+        into NB) — the mixture must vanish cleanly in log space."""
+        import jax.numpy as jnp
+
+        from pytensor_federated_tpu.models.countdata import (
+            negbin_logpmf,
+            poisson_logpmf,
+            zero_inflate_logpmf,
+        )
+
+        y = jnp.asarray([0.0, 1.0, 3.0, 7.0])
+        eta = jnp.asarray([0.2, -0.5, 1.0, 0.3])
+        base = poisson_logpmf(y, eta)
+        np.testing.assert_allclose(
+            np.asarray(zero_inflate_logpmf(y, base, -40.0)),
+            np.asarray(base), rtol=1e-6,
+        )
+        base_nb = negbin_logpmf(y, eta, 3.0)
+        np.testing.assert_allclose(
+            np.asarray(zero_inflate_logpmf(y, base_nb, -40.0)),
+            np.asarray(base_nb), rtol=1e-6,
+        )
+
+    def test_zero_probability_mixture(self):
+        """At y=0 the pmf must be exactly pi + (1-pi)*base(0)."""
+        import jax.numpy as jnp
+
+        from pytensor_federated_tpu.models.countdata import (
+            poisson_logpmf,
+            zero_inflate_logpmf,
+        )
+
+        eta = jnp.asarray(0.7)
+        logit = jnp.asarray(0.4)
+        pi = float(jax.nn.sigmoid(logit))
+        base0 = float(jnp.exp(poisson_logpmf(jnp.asarray(0.0), eta)))
+        got = float(
+            jnp.exp(
+                zero_inflate_logpmf(
+                    jnp.asarray(0.0), poisson_logpmf(jnp.asarray(0.0), eta),
+                    logit,
+                )
+            )
+        )
+        np.testing.assert_allclose(got, pi + (1 - pi) * base0, rtol=1e-6)
+
+    def test_zip_map_recovers_truth(self):
+        from pytensor_federated_tpu.models.countdata import (
+            FederatedZeroInflPoissonGLM,
+            generate_zi_count_data,
+        )
+
+        data, truth = generate_zi_count_data(
+            8, n_obs=256, n_features=3, pi=0.35, seed=5
+        )
+        model = FederatedZeroInflPoissonGLM(data)
+        m = model.find_map(num_steps=600)
+        pi_hat = float(jax.nn.sigmoid(m["logit_pi"]))
+        assert abs(pi_hat - truth["pi"]) < 0.08, pi_hat
+        np.testing.assert_allclose(
+            np.asarray(m["w"]), truth["w"], atol=0.15
+        )
+        # ZIP must out-fit plain Poisson on zero-inflated data
+        from pytensor_federated_tpu.models.countdata import (
+            FederatedPoissonGLM,
+        )
+
+        base = FederatedPoissonGLM(data)
+        mb = base.find_map(num_steps=600)
+        assert float(model.logp(m)) > float(base.logp(mb))
+
+    def test_zinb_runs_and_predictive_zero_fraction(self):
+        from pytensor_federated_tpu.models.countdata import (
+            FederatedZeroInflNegBinGLM,
+            generate_zi_count_data,
+        )
+
+        data, truth = generate_zi_count_data(
+            4, n_obs=128, n_features=3, pi=0.4, dispersion=3.0, seed=9
+        )
+        model = FederatedZeroInflNegBinGLM(data)
+        m = model.find_map(num_steps=500)
+        assert np.isfinite(float(model.logp(m)))
+        rep = model.predictive(m, jax.random.PRNGKey(0))
+        (X, y), mask = model.data.tree()
+        frac_rep = float(np.sum((np.asarray(rep) == 0) * np.asarray(mask))
+                         / np.sum(np.asarray(mask)))
+        frac_obs = float(np.sum((np.asarray(y) == 0) * np.asarray(mask))
+                         / np.sum(np.asarray(mask)))
+        assert abs(frac_rep - frac_obs) < 0.1, (frac_rep, frac_obs)
+
+    def test_prior_predictive_plumbing(self):
+        from pytensor_federated_tpu.models.countdata import (
+            FederatedZeroInflPoissonGLM,
+            generate_zi_count_data,
+        )
+
+        data, _ = generate_zi_count_data(4, n_obs=16, n_features=2)
+        model = FederatedZeroInflPoissonGLM(data)
+        p = model.sample_prior(jax.random.PRNGKey(1))
+        assert "logit_pi" in p
+        assert np.isfinite(float(model.logp(p)))
