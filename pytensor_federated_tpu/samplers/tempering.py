@@ -43,22 +43,26 @@ from .mcmc import (
     make_flat_logp_and_grad,
     place_with_sharding,
 )
+from .util import welford_init, welford_update, welford_variance
 
 __all__ = ["pt_sample"]
 
 
-def _hmc_step(lg, x, u, g, beta, step, key, num_leapfrog):
+def _hmc_step(lg, x, u, g, beta, step, inv_mass, key, num_leapfrog):
     """One HMC transition for a single replica of the TEMPERED target
     ``beta * logp`` (u, g are the UNTEMPERED logp and gradient, so the
-    swap ratio can reuse them).  Returns (x', u', g', accept_prob)."""
+    swap ratio can reuse them).  ``inv_mass`` is this rung's diagonal
+    of M⁻¹ (hmc.py conventions: momentum ~ N(0, M), kinetic
+    ``0.5 pᵀM⁻¹p``, position update ``step * inv_mass * p``).
+    Returns (x', u', g', accept_prob)."""
     dim = x.shape[0]
     k_mom, k_acc = jax.random.split(key)
-    p0 = jax.random.normal(k_mom, (dim,), x.dtype)
+    p0 = jax.random.normal(k_mom, (dim,), x.dtype) / jnp.sqrt(inv_mass)
 
     def leap(carry, _):
         xq, pq, _uq, gq = carry
         pq = pq + 0.5 * step * beta * gq
-        xq = xq + step * pq
+        xq = xq + step * inv_mass * pq
         uq2, gq2 = lg(xq)
         pq = pq + 0.5 * step * beta * gq2
         return (xq, pq, uq2, gq2), None
@@ -70,8 +74,8 @@ def _hmc_step(lg, x, u, g, beta, step, key, num_leapfrog):
     )
     # Hamiltonian of the tempered target; divergences (non-finite
     # energies) fall out as accept_prob 0 via the where below.
-    h0 = -beta * u + 0.5 * jnp.sum(p0**2)
-    h1 = -beta * u1 + 0.5 * jnp.sum(p1**2)
+    h0 = -beta * u + 0.5 * jnp.sum(p0**2 * inv_mass)
+    h1 = -beta * u1 + 0.5 * jnp.sum(p1**2 * inv_mass)
     log_alpha = h0 - h1
     log_alpha = jnp.where(jnp.isfinite(log_alpha), log_alpha, -jnp.inf)
     accept_prob = jnp.minimum(1.0, jnp.exp(log_alpha))
@@ -126,6 +130,7 @@ def pt_sample(
     temp_sharding: Optional[Any] = None,
     adapt_ladder: bool = False,
     target_swap: float = 0.4,
+    adapt_mass: bool = True,
 ) -> SampleResult:
     """Replica-exchange HMC; returns the COLD (beta = 1) chain's draws
     as a :class:`SampleResult` with ``chains = 1``.
@@ -146,6 +151,12 @@ def pt_sample(
     each rung's acceptance rate over the draw phase (rungs near zero
     mean the ladder has a gap; add temperatures or raise ``beta_min``),
     and ``betas``.
+
+    ``adapt_mass=True`` (default) adapts a per-rung DIAGONAL mass from
+    each rung's own warmup samples: Welford variance accumulated over
+    the first warmup half (per temperature — hot rungs see flatter,
+    wider tempered targets and get their own scale), applied for the
+    second half and the draw phase.  Identity mass otherwise.
 
     ``adapt_ladder=True`` tunes the ladder SPACING during warmup by
     stochastic approximation (Miasojedow-Moulines-Vihola style): each
@@ -208,65 +219,121 @@ def pt_sample(
     u0, g0 = jax.vmap(lg)(x0)
 
     vmapped_hmc = jax.vmap(
-        _hmc_step, in_axes=(None, 0, 0, 0, 0, 0, 0, None)
+        _hmc_step, in_axes=(None, 0, 0, 0, 0, 0, 0, 0, None)
     )
 
-    def iteration(carry, inp):
-        x, u, g, log_step, log_rho, t = carry
-        k_iter, adapt = inp
-        # Without adaptation the ladder is the EXACT geomspace constant
-        # (bitwise — no log/exp round trip perturbing seeded runs, no
-        # per-iteration rebuild of a loop invariant).
-        betas = _betas_of(log_rho) if adapt_ladder else betas0
-        k_hmc, k_swap = jax.random.split(k_iter)
-        xs, us, gs, acc = vmapped_hmc(
-            lg, x, u, g, betas, jnp.exp(log_step),
-            jax.random.split(k_hmc, num_temps), num_leapfrog,
-        )
-        # Robbins-Monro per-temperature step-size adaptation (warmup
-        # only): eta_t ~ t^-0.6 like the Metropolis warmup in mcmc.py.
-        eta = adapt * 2.0 / (t + 10.0) ** 0.6
-        log_step = log_step + eta * (acc - target_accept)
-        parity = (t % 2).astype(jnp.int32)
-        perm, accept, propose, alpha = _swap_pass(
-            us, betas, k_swap, parity
-        )
-        if adapt_ladder:
-            # Widen rungs that swap too easily, shrink dead ones —
-            # only the pairs actually proposed this parity move.  A
-            # non-finite alpha (two replicas stuck at -inf logp) must
-            # not poison the ladder: treat it as a dead rung (0).
-            alpha = jnp.where(jnp.isfinite(alpha), alpha, 0.0)
-            # Clamp RELATIVE to the requested ladder so a deliberately
-            # tight (or wide) geomspace is never snapped to absolute
-            # bounds on step one: each gap may shrink/grow by at most
-            # e^3 (~20x) from its requested value, which also keeps the
-            # ladder from collapsing or blowing past float range.
-            log_rho = jnp.clip(
-                log_rho + eta * propose * (alpha - target_swap),
-                log_rho0 - 3.0,
-                log_rho0 + 3.0,
+    def make_iteration(adapt: bool, collect: bool):
+        """Scan body with the phase flags baked in as PYTHON constants
+        (each phase is its own scan, so a traced flag would only force
+        dead Welford/adaptation arithmetic through every iteration)."""
+
+        def iteration(carry, inp):
+            x, u, g, log_step, log_rho, inv_mass, wf, t = carry
+            k_iter = inp
+            # Without adaptation the ladder is the EXACT geomspace
+            # constant (bitwise — no log/exp round trip perturbing
+            # seeded runs, no per-iteration rebuild of a loop
+            # invariant).
+            betas = _betas_of(log_rho) if adapt_ladder else betas0
+            k_hmc, k_swap = jax.random.split(k_iter)
+            xs, us, gs, acc = vmapped_hmc(
+                lg, x, u, g, betas, jnp.exp(log_step), inv_mass,
+                jax.random.split(k_hmc, num_temps), num_leapfrog,
             )
-        # a swap exchanges WHOLE states: x, u and g permute together
-        # (no re-evaluation — the swap kernel touches no new points)
-        xs, us, gs = xs[perm], us[perm], gs[perm]
-        n_prop = jnp.maximum(jnp.sum(propose), 1)
-        swap_frac = jnp.sum(accept) / n_prop
-        out = (xs[0], acc[0], swap_frac, accept, propose)
-        return (xs, us, gs, log_step, log_rho, t + 1), out
+            if collect:
+                # Per-rung Welford (mass window only): each temperature
+                # estimates ITS OWN tempered target's scale — the
+                # shared util.welford accumulator, vmapped over rungs.
+                wf = jax.vmap(welford_update)(wf, xs)
+            # Robbins-Monro per-temperature step-size adaptation
+            # (warmup only): eta_t ~ t^-0.6 like the Metropolis warmup
+            # in mcmc.py.
+            eta = (2.0 if adapt else 0.0) / (t + 10.0) ** 0.6
+            log_step = log_step + eta * (acc - target_accept)
+            parity = (t % 2).astype(jnp.int32)
+            perm, accept, propose, alpha = _swap_pass(
+                us, betas, k_swap, parity
+            )
+            if adapt_ladder and adapt:
+                # Widen rungs that swap too easily, shrink dead
+                # ones — only the pairs actually proposed this parity
+                # move.  A non-finite alpha (two replicas stuck at
+                # -inf logp) must not poison the ladder: treat it as a
+                # dead rung (0).
+                alpha = jnp.where(jnp.isfinite(alpha), alpha, 0.0)
+                # Clamp RELATIVE to the requested ladder so a
+                # deliberately tight (or wide) geomspace is never
+                # snapped to absolute bounds on step one: each gap may
+                # shrink/grow by at most e^3 (~20x) from its requested
+                # value, which also keeps the ladder from collapsing
+                # or blowing past float range.
+                log_rho = jnp.clip(
+                    log_rho + eta * propose * (alpha - target_swap),
+                    log_rho0 - 3.0,
+                    log_rho0 + 3.0,
+                )
+            # a swap exchanges WHOLE states: x, u and g permute
+            # together (no re-evaluation — the swap kernel touches no
+            # new points)
+            xs, us, gs = xs[perm], us[perm], gs[perm]
+            n_prop = jnp.maximum(jnp.sum(propose), 1)
+            swap_frac = jnp.sum(accept) / n_prop
+            out = (xs[0], acc[0], swap_frac, accept, propose)
+            return (
+                (xs, us, gs, log_step, log_rho, inv_mass, wf, t + 1),
+                out,
+            )
+
+        return iteration
 
     # find a crude initial step size: 0.1 / dim^0.25, per temperature
     log_step0 = jnp.full(
         (num_temps,), jnp.log(0.1 / dim**0.25), dtype
     )
-    carry = (x0, u0, g0, log_step0, log_rho0, jnp.asarray(0, jnp.int32))
+    wf0 = jax.vmap(lambda _: welford_init(dim, dtype))(
+        jnp.arange(num_temps)
+    )
+    inv_mass0 = jnp.ones((num_temps, dim), dtype)
+    carry = (
+        x0, u0, g0, log_step0, log_rho0, inv_mass0, wf0,
+        jnp.asarray(0, jnp.int32),
+    )
+    # Warmup phases: [init buffer: discard the jittered-start
+    # transient, like AdaptSchedule's init_buffer] -> [mass window:
+    # collect per-rung variance] -> [phase 2: adapted mass, step sizes
+    # re-adapt to it].  A contaminated transient would bake a
+    # direction-dependent overestimate into the mass for the whole run.
+    w1 = num_warmup // 2
+    w_buf = min(75, w1 // 3) if adapt_mass else 0
     warm_keys = jax.random.split(k_warm, num_warmup)
     carry, _ = jax.lax.scan(
-        iteration, carry, (warm_keys, jnp.ones((num_warmup,), dtype))
+        make_iteration(adapt=True, collect=False),
+        carry,
+        warm_keys[:w_buf],
+    )
+    carry, _ = jax.lax.scan(
+        make_iteration(adapt=True, collect=adapt_mass),
+        carry,
+        warm_keys[w_buf:w1],
+    )
+    if adapt_mass and num_warmup >= 8:
+        x_c, u_c, g_c, log_step_c, log_rho_c, _, wf_c, t_c = carry
+        # The shared Stan-schedule regularization (decaying unit
+        # shrinkage), vmapped per rung.
+        inv_mass1 = jax.vmap(welford_variance)(wf_c)
+        carry = (
+            x_c, u_c, g_c, log_step_c, log_rho_c, inv_mass1, wf0, t_c
+        )
+    carry, _ = jax.lax.scan(
+        make_iteration(adapt=True, collect=False),
+        carry,
+        warm_keys[w1:],
     )
     draw_keys = jax.random.split(k_draw, num_samples)
     carry, (draws, acc0, swap_frac, accepts, proposes) = jax.lax.scan(
-        iteration, carry, (draw_keys, jnp.zeros((num_samples,), dtype))
+        make_iteration(adapt=False, collect=False),
+        carry,
+        draw_keys,
     )
 
     samples = jax.vmap(unravel)(draws)
@@ -288,7 +355,7 @@ def pt_sample(
             "swap_accept": swap_frac[None],
         },
         step_size=jnp.exp(carry[3][:1]),
-        inv_mass=jnp.ones((1, dim), dtype),
+        inv_mass=carry[5][:1],
         extra={
             "swap_rate_per_pair": per_pair,
             # EXACTLY the ladder the iterations used: the geomspace
