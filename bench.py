@@ -8,10 +8,11 @@ dependent* evaluations (the way NUTS consumes them: each leapfrog step
 feeds the previous gradient forward), chained inside a ``lax.scan`` with
 zero host round-trips.
 
-Two implementations of the same posterior logp+grad are raced — XLA
-autodiff of the model, and the hand-fused Pallas kernel
-(ops/pallas_kernels.py) — on a short calibration chain; the faster one
-runs the full measurement.  Both are asserted to agree numerically
+Several implementations of the same posterior logp+grad are raced —
+XLA autodiff of the model, the sufficient-statistics form (plus a
+32x-unrolled chain variant of it), and the hand-fused Pallas kernel
+(ops/pallas_kernels.py) — on a short calibration chain; the fastest
+runs the full measurement.  All are asserted to agree numerically
 before racing.
 
 Prints ONE JSON line:
