@@ -119,6 +119,7 @@ def pt_sample(
     init_params: Any,
     *,
     key,
+    num_chains: int = 1,
     num_warmup: int = 500,
     num_samples: int = 500,
     num_temps: int = 8,
@@ -133,7 +134,14 @@ def pt_sample(
     adapt_mass: bool = True,
 ) -> SampleResult:
     """Replica-exchange HMC; returns the COLD (beta = 1) chain's draws
-    as a :class:`SampleResult` with ``chains = 1``.
+    as a :class:`SampleResult` with ``chains = num_chains``.
+
+    ``num_chains > 1`` runs that many INDEPENDENT tempering stacks
+    (vmapped — each with its own ladder, masses and step sizes), which
+    is what makes ``res.summary()``'s split-R̂ meaningful: cross-chain
+    disagreement exposes a stack that never found the second mode.
+    Incompatible with ``temp_sharding`` (shard one stack's ladder OR
+    replicate stacks, not both at once).
 
     ``betas`` form a geometric ladder from 1 to ``beta_min`` (the
     standard choice: constant acceptance needs geometric spacing when
@@ -150,7 +158,7 @@ def pt_sample(
     diagnostics live in ``extra`` — ``swap_rate_per_pair`` ``(K-1,)``,
     each rung's acceptance rate over the draw phase (rungs near zero
     mean the ladder has a gap; add temperatures or raise ``beta_min``),
-    and ``betas``.
+    and ``betas`` — both with a leading ``(chains, ...)`` axis.
 
     ``adapt_mass=True`` (default) adapts a per-rung DIAGONAL mass from
     each rung's own warmup samples: Welford variance accumulated over
@@ -186,6 +194,14 @@ def pt_sample(
             f"beta_min must be in (0, 1), got {beta_min} (0 or negative "
             "makes the geometric ladder NaN)"
         )
+    if num_chains < 1:
+        raise ValueError(f"num_chains must be >= 1, got {num_chains}")
+    if num_chains > 1 and temp_sharding is not None:
+        raise ValueError(
+            "num_chains > 1 is incompatible with temp_sharding: shard "
+            "one stack's temperature ladder OR run replicated stacks "
+            "(vmapped), not both"
+        )
     _, flat_init, unravel, lg = make_flat_logp_and_grad(
         logp_fn, init_params, logp_and_grad_fn
     )
@@ -203,163 +219,187 @@ def pt_sample(
             )
         )
 
-    k_init, k_warm, k_draw = jax.random.split(jnp.asarray(key), 3)
-    x0 = flat_init[None, :] + jitter * jax.random.normal(
-        k_init, (num_temps, dim), dtype
-    )
-    x0 = place_with_sharding(
-        x0, temp_sharding, axis_desc=f"num_temps={num_temps}"
-    )
-    u0, g0 = jax.vmap(lg)(x0)
-    # NaN-safe start: a hot replica jittered into a -inf region would
-    # freeze (every proposal from -inf accepts, but gradients NaN);
-    # fall back to the unjittered start for those replicas.
-    bad = ~jnp.isfinite(u0)
-    x0 = jnp.where(bad[:, None], flat_init[None, :], x0)
-    u0, g0 = jax.vmap(lg)(x0)
-
-    vmapped_hmc = jax.vmap(
-        _hmc_step, in_axes=(None, 0, 0, 0, 0, 0, 0, 0, None)
-    )
-
-    def make_iteration(adapt: bool, collect: bool):
-        """Scan body with the phase flags baked in as PYTHON constants
-        (each phase is its own scan, so a traced flag would only force
-        dead Welford/adaptation arithmetic through every iteration)."""
-
-        def iteration(carry, inp):
-            x, u, g, log_step, log_rho, inv_mass, wf, t = carry
-            k_iter = inp
-            # Without adaptation the ladder is the EXACT geomspace
-            # constant (bitwise — no log/exp round trip perturbing
-            # seeded runs, no per-iteration rebuild of a loop
-            # invariant).
-            betas = _betas_of(log_rho) if adapt_ladder else betas0
-            k_hmc, k_swap = jax.random.split(k_iter)
-            xs, us, gs, acc = vmapped_hmc(
-                lg, x, u, g, betas, jnp.exp(log_step), inv_mass,
-                jax.random.split(k_hmc, num_temps), num_leapfrog,
-            )
-            if collect:
-                # Per-rung Welford (mass window only): each temperature
-                # estimates ITS OWN tempered target's scale — the
-                # shared util.welford accumulator, vmapped over rungs.
-                wf = jax.vmap(welford_update)(wf, xs)
-            # Robbins-Monro per-temperature step-size adaptation
-            # (warmup only): eta_t ~ t^-0.6 like the Metropolis warmup
-            # in mcmc.py.
-            eta = (2.0 if adapt else 0.0) / (t + 10.0) ** 0.6
-            log_step = log_step + eta * (acc - target_accept)
-            parity = (t % 2).astype(jnp.int32)
-            perm, accept, propose, alpha = _swap_pass(
-                us, betas, k_swap, parity
-            )
-            if adapt_ladder and adapt:
-                # Widen rungs that swap too easily, shrink dead
-                # ones — only the pairs actually proposed this parity
-                # move.  A non-finite alpha (two replicas stuck at
-                # -inf logp) must not poison the ladder: treat it as a
-                # dead rung (0).
-                alpha = jnp.where(jnp.isfinite(alpha), alpha, 0.0)
-                # Clamp RELATIVE to the requested ladder so a
-                # deliberately tight (or wide) geomspace is never
-                # snapped to absolute bounds on step one: each gap may
-                # shrink/grow by at most e^3 (~20x) from its requested
-                # value, which also keeps the ladder from collapsing
-                # or blowing past float range.
-                log_rho = jnp.clip(
-                    log_rho + eta * propose * (alpha - target_swap),
-                    log_rho0 - 3.0,
-                    log_rho0 + 3.0,
-                )
-            # a swap exchanges WHOLE states: x, u and g permute
-            # together (no re-evaluation — the swap kernel touches no
-            # new points)
-            xs, us, gs = xs[perm], us[perm], gs[perm]
-            n_prop = jnp.maximum(jnp.sum(propose), 1)
-            swap_frac = jnp.sum(accept) / n_prop
-            out = (xs[0], acc[0], swap_frac, accept, propose)
-            return (
-                (xs, us, gs, log_step, log_rho, inv_mass, wf, t + 1),
-                out,
-            )
-
-        return iteration
-
-    # find a crude initial step size: 0.1 / dim^0.25, per temperature
-    log_step0 = jnp.full(
-        (num_temps,), jnp.log(0.1 / dim**0.25), dtype
-    )
-    wf0 = jax.vmap(lambda _: welford_init(dim, dtype))(
-        jnp.arange(num_temps)
-    )
-    inv_mass0 = jnp.ones((num_temps, dim), dtype)
-    carry = (
-        x0, u0, g0, log_step0, log_rho0, inv_mass0, wf0,
-        jnp.asarray(0, jnp.int32),
-    )
-    # Warmup phases: [init buffer: discard the jittered-start
-    # transient, like AdaptSchedule's init_buffer] -> [mass window:
-    # collect per-rung variance] -> [phase 2: adapted mass, step sizes
-    # re-adapt to it].  A contaminated transient would bake a
-    # direction-dependent overestimate into the mass for the whole run.
-    w1 = num_warmup // 2
-    w_buf = min(75, w1 // 3) if adapt_mass else 0
-    warm_keys = jax.random.split(k_warm, num_warmup)
-    carry, _ = jax.lax.scan(
-        make_iteration(adapt=True, collect=False),
-        carry,
-        warm_keys[:w_buf],
-    )
-    carry, _ = jax.lax.scan(
-        make_iteration(adapt=True, collect=adapt_mass),
-        carry,
-        warm_keys[w_buf:w1],
-    )
-    if adapt_mass and num_warmup >= 8:
-        x_c, u_c, g_c, log_step_c, log_rho_c, _, wf_c, t_c = carry
-        # The shared Stan-schedule regularization (decaying unit
-        # shrinkage), vmapped per rung.
-        inv_mass1 = jax.vmap(welford_variance)(wf_c)
-        carry = (
-            x_c, u_c, g_c, log_step_c, log_rho_c, inv_mass1, wf0, t_c
+    def _run(key):
+        """One full tempering stack (warmup + draws) for one chain."""
+        k_init, k_warm, k_draw = jax.random.split(jnp.asarray(key), 3)
+        x0 = flat_init[None, :] + jitter * jax.random.normal(
+            k_init, (num_temps, dim), dtype
         )
-    carry, _ = jax.lax.scan(
-        make_iteration(adapt=True, collect=False),
-        carry,
-        warm_keys[w1:],
-    )
-    draw_keys = jax.random.split(k_draw, num_samples)
-    carry, (draws, acc0, swap_frac, accepts, proposes) = jax.lax.scan(
-        make_iteration(adapt=False, collect=False),
-        carry,
-        draw_keys,
-    )
+        x0 = place_with_sharding(
+            x0, temp_sharding, axis_desc=f"num_temps={num_temps}"
+        )
+        u0, g0 = jax.vmap(lg)(x0)
+        # NaN-safe start: a hot replica jittered into a -inf region would
+        # freeze (every proposal from -inf accepts, but gradients NaN);
+        # fall back to the unjittered start for those replicas.
+        bad = ~jnp.isfinite(u0)
+        x0 = jnp.where(bad[:, None], flat_init[None, :], x0)
+        u0, g0 = jax.vmap(lg)(x0)
 
-    samples = jax.vmap(unravel)(draws)
-    samples = jax.tree_util.tree_map(lambda l: l[None], samples)
+        vmapped_hmc = jax.vmap(
+            _hmc_step, in_axes=(None, 0, 0, 0, 0, 0, 0, 0, None)
+        )
+
+        def make_iteration(adapt: bool, collect: bool):
+            """Scan body with the phase flags baked in as PYTHON constants
+            (each phase is its own scan, so a traced flag would only force
+            dead Welford/adaptation arithmetic through every iteration)."""
+
+            def iteration(carry, inp):
+                x, u, g, log_step, log_rho, inv_mass, wf, t = carry
+                k_iter = inp
+                # Without adaptation the ladder is the EXACT geomspace
+                # constant (bitwise — no log/exp round trip perturbing
+                # seeded runs, no per-iteration rebuild of a loop
+                # invariant).
+                betas = _betas_of(log_rho) if adapt_ladder else betas0
+                k_hmc, k_swap = jax.random.split(k_iter)
+                xs, us, gs, acc = vmapped_hmc(
+                    lg, x, u, g, betas, jnp.exp(log_step), inv_mass,
+                    jax.random.split(k_hmc, num_temps), num_leapfrog,
+                )
+                if collect:
+                    # Per-rung Welford (mass window only): each temperature
+                    # estimates ITS OWN tempered target's scale — the
+                    # shared util.welford accumulator, vmapped over rungs.
+                    wf = jax.vmap(welford_update)(wf, xs)
+                # Robbins-Monro per-temperature step-size adaptation
+                # (warmup only): eta_t ~ t^-0.6 like the Metropolis warmup
+                # in mcmc.py.
+                eta = (2.0 if adapt else 0.0) / (t + 10.0) ** 0.6
+                log_step = log_step + eta * (acc - target_accept)
+                parity = (t % 2).astype(jnp.int32)
+                perm, accept, propose, alpha = _swap_pass(
+                    us, betas, k_swap, parity
+                )
+                if adapt_ladder and adapt:
+                    # Widen rungs that swap too easily, shrink dead
+                    # ones — only the pairs actually proposed this parity
+                    # move.  A non-finite alpha (two replicas stuck at
+                    # -inf logp) must not poison the ladder: treat it as a
+                    # dead rung (0).
+                    alpha = jnp.where(jnp.isfinite(alpha), alpha, 0.0)
+                    # Clamp RELATIVE to the requested ladder so a
+                    # deliberately tight (or wide) geomspace is never
+                    # snapped to absolute bounds on step one: each gap may
+                    # shrink/grow by at most e^3 (~20x) from its requested
+                    # value, which also keeps the ladder from collapsing
+                    # or blowing past float range.
+                    log_rho = jnp.clip(
+                        log_rho + eta * propose * (alpha - target_swap),
+                        log_rho0 - 3.0,
+                        log_rho0 + 3.0,
+                    )
+                # a swap exchanges WHOLE states: x, u and g permute
+                # together (no re-evaluation — the swap kernel touches no
+                # new points)
+                xs, us, gs = xs[perm], us[perm], gs[perm]
+                n_prop = jnp.maximum(jnp.sum(propose), 1)
+                swap_frac = jnp.sum(accept) / n_prop
+                out = (xs[0], acc[0], swap_frac, accept, propose)
+                return (
+                    (xs, us, gs, log_step, log_rho, inv_mass, wf, t + 1),
+                    out,
+                )
+
+            return iteration
+
+        # find a crude initial step size: 0.1 / dim^0.25, per temperature
+        log_step0 = jnp.full(
+            (num_temps,), jnp.log(0.1 / dim**0.25), dtype
+        )
+        wf0 = jax.vmap(lambda _: welford_init(dim, dtype))(
+            jnp.arange(num_temps)
+        )
+        inv_mass0 = jnp.ones((num_temps, dim), dtype)
+        carry = (
+            x0, u0, g0, log_step0, log_rho0, inv_mass0, wf0,
+            jnp.asarray(0, jnp.int32),
+        )
+        # Warmup phases: [init buffer: discard the jittered-start
+        # transient, like AdaptSchedule's init_buffer] -> [mass window:
+        # collect per-rung variance] -> [phase 2: adapted mass, step sizes
+        # re-adapt to it].  A contaminated transient would bake a
+        # direction-dependent overestimate into the mass for the whole run.
+        w1 = num_warmup // 2
+        w_buf = min(75, w1 // 3) if adapt_mass else 0
+        warm_keys = jax.random.split(k_warm, num_warmup)
+        carry, _ = jax.lax.scan(
+            make_iteration(adapt=True, collect=False),
+            carry,
+            warm_keys[:w_buf],
+        )
+        carry, _ = jax.lax.scan(
+            make_iteration(adapt=True, collect=adapt_mass),
+            carry,
+            warm_keys[w_buf:w1],
+        )
+        if adapt_mass and num_warmup >= 8:
+            x_c, u_c, g_c, log_step_c, log_rho_c, _, wf_c, t_c = carry
+            # The shared Stan-schedule regularization (decaying unit
+            # shrinkage), vmapped per rung.
+            inv_mass1 = jax.vmap(welford_variance)(wf_c)
+            carry = (
+                x_c, u_c, g_c, log_step_c, log_rho_c, inv_mass1, wf0, t_c
+            )
+        carry, _ = jax.lax.scan(
+            make_iteration(adapt=True, collect=False),
+            carry,
+            warm_keys[w1:],
+        )
+        draw_keys = jax.random.split(k_draw, num_samples)
+        carry, (draws, acc0, swap_frac, accepts, proposes) = jax.lax.scan(
+            make_iteration(adapt=False, collect=False),
+            carry,
+            draw_keys,
+        )
+
+        return (
+            draws, acc0, swap_frac, accepts, proposes,
+            jnp.exp(carry[3][0]), carry[5][0],
+            _betas_of(carry[4]) if adapt_ladder else betas0,
+        )
+
+    # Independent stacks vmap over chain keys.  num_chains == 1 calls
+    # _run DIRECTLY (same seeding as ever, and temp_sharding's
+    # device_put cannot run under vmap) and prepends the chains axis.
+    if num_chains == 1:
+        outs = jax.tree_util.tree_map(
+            lambda a: a[None], _run(jnp.asarray(key))
+        )
+    else:
+        outs = jax.vmap(_run)(
+            jax.random.split(jnp.asarray(key), num_chains)
+        )
+    (
+        draws, acc0, swap_frac, accepts, proposes,
+        cold_step, cold_inv_mass, final_betas,
+    ) = outs
+
+    samples = jax.vmap(jax.vmap(unravel))(draws)
     # honest per-rung rate: accepted / actually-proposed (parity
     # alternation makes proposal counts differ by one for odd
-    # num_samples — no n/2 assumption)
+    # num_samples — no n/2 assumption); per chain.
     n_prop_pair = jnp.maximum(
-        jnp.sum(proposes.astype(dtype), axis=0), 1.0
+        jnp.sum(proposes.astype(dtype), axis=1), 1.0
     )
-    per_pair = jnp.sum(accepts.astype(dtype), axis=0) / n_prop_pair
+    per_pair = jnp.sum(accepts.astype(dtype), axis=1) / n_prop_pair
     # Ladder diagnostics go in ``extra``, NOT ``stats``: stats entries
     # must be (chains, draws) — the arviz exporters forward them
     # verbatim as sample_stats.
     return SampleResult(
         samples=samples,
         stats={
-            "accept_prob": acc0[None],
-            "swap_accept": swap_frac[None],
+            "accept_prob": acc0,
+            "swap_accept": swap_frac,
         },
-        step_size=jnp.exp(carry[3][:1]),
-        inv_mass=carry[5][:1],
+        step_size=cold_step,
+        inv_mass=cold_inv_mass,
         extra={
             "swap_rate_per_pair": per_pair,
-            # EXACTLY the ladder the iterations used: the geomspace
-            # constant when fixed (bitwise), the adapted one otherwise.
-            "betas": _betas_of(carry[4]) if adapt_ladder else betas0,
+            # EXACTLY the ladder each chain's iterations used: the
+            # geomspace constant when fixed (bitwise), adapted
+            # otherwise; leading axis = chains.
+            "betas": final_betas,
         },
     )

@@ -70,12 +70,12 @@ class TestBimodal:
             beta_min=0.02,
         )
         per_pair = np.asarray(res.extra["swap_rate_per_pair"])
-        assert per_pair.shape == (5,)
+        assert per_pair.shape == (1, 5)  # leading chains axis
         assert np.all(per_pair >= 0) and np.all(per_pair <= 1.0)
         # a geometric ladder on this target must actually exchange
         assert per_pair.min() > 0.05
-        assert res.extra["betas"].shape == (6,)
-        assert float(res.extra["betas"][0]) == 1.0
+        assert res.extra["betas"].shape == (1, 6)
+        assert float(res.extra["betas"][0, 0]) == 1.0
         # stats stays strictly (chains, draws): the arviz export must
         # accept a pt_sample result unmodified
         from pytensor_federated_tpu.samplers import to_dataset_dict
@@ -183,7 +183,7 @@ class TestAdaptiveLadder:
         draws = np.asarray(res.samples["mu"])[0]
         np.testing.assert_allclose(draws.mean(axis=0), 1.5, atol=0.1)
         np.testing.assert_allclose(draws.std(axis=0), 0.5, atol=0.1)
-        betas = np.asarray(res.extra["betas"])
+        betas = np.asarray(res.extra["betas"])[0]
         assert betas[0] == 1.0 and np.all(np.diff(betas) < 0)
 
     def test_rescues_a_disconnected_ladder(self):
@@ -214,7 +214,7 @@ class TestAdaptiveLadder:
             np.asarray(adapted.extra["swap_rate_per_pair"]).min()
         ) > 0.2  # every adapted rung exchanges
         # beta_1 stays pinned; the ladder stays ordered
-        betas = np.asarray(adapted.extra["betas"])
+        betas = np.asarray(adapted.extra["betas"])[0]
         assert betas[0] == 1.0 and np.all(np.diff(betas) < 0)
 
 
@@ -262,3 +262,54 @@ def test_mass_adaptation_learns_anisotropy():
     )
     draws_id = np.asarray(res_id.samples["x"])[0]
     assert draws_id[:, 1].std() < draws[:, 1].std()
+
+
+def test_num_chains_independent_stacks():
+    """num_chains=2: two independent tempering stacks make split-R-hat
+    meaningful; on a well-behaved target both converge and agree."""
+
+    def logp(p):
+        return -0.5 * jnp.sum((p["mu"] - 1.5) ** 2 / 0.25)
+
+    res = pt_sample(
+        logp,
+        {"mu": jnp.zeros(2)},
+        key=jax.random.PRNGKey(7),
+        num_chains=2,
+        num_warmup=500,
+        num_samples=1000,
+        num_temps=4,
+    )
+    assert res.samples["mu"].shape == (2, 1000, 2)
+    assert res.stats["accept_prob"].shape == (2, 1000)
+    assert res.extra["swap_rate_per_pair"].shape == (2, 3)
+    summ = res.summary()
+    assert float(np.asarray(summ["rhat"]["mu"]).max()) < 1.05
+    draws = np.asarray(res.samples["mu"]).reshape(-1, 2)
+    np.testing.assert_allclose(draws.mean(axis=0), 1.5, atol=0.1)
+
+
+def test_num_chains_rejects_temp_sharding(devices8):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from pytensor_federated_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh({"temps": 8}, devices=devices8)
+    with pytest.raises(ValueError, match="incompatible"):
+        pt_sample(
+            bimodal_logp,
+            {"x": jnp.zeros(1)},
+            key=jax.random.PRNGKey(0),
+            num_chains=2,
+            temp_sharding=NamedSharding(mesh, P("temps")),
+        )
+
+
+def test_rejects_zero_chains():
+    with pytest.raises(ValueError, match="num_chains"):
+        pt_sample(
+            bimodal_logp,
+            {"x": jnp.zeros(1)},
+            key=jax.random.PRNGKey(0),
+            num_chains=0,
+        )
