@@ -13,6 +13,10 @@ protobufs/service.proto:6-19):
    detects an npproto request and replies in kind; the client with
    ``codec="npproto"`` (including GetLoad balancing) gets the same
    numbers the npwire client gets.
+4. STAND-IN REFERENCE NODE — a grpc.aio server whose wire handling is
+   purely the official google.protobuf runtime (no code from this
+   package's codecs on the server side); our npproto client balances,
+   streams, and evaluates against it.
 """
 
 import numpy as np
@@ -161,9 +165,12 @@ class TestWireCompat:
 official = pytest.importorskip("google.protobuf", reason="cross-check")
 
 
-def _official_messages():
+def _official_schema(package="xcheck"):
     """The reference schema rebuilt in the OFFICIAL runtime at runtime
-    (no codegen), as an independent encoder/decoder to diff against."""
+    (no codegen) — THE one schema definition shared by the byte-diff
+    cross-check and the stand-in reference node (a drift between two
+    copies would let them disagree about what 'the reference wire'
+    is).  Returns a name -> message-class getter."""
     from google.protobuf import (
         descriptor_pb2,
         descriptor_pool,
@@ -172,8 +179,8 @@ def _official_messages():
 
     pool = descriptor_pool.DescriptorPool()
     fdp = descriptor_pb2.FileDescriptorProto()
-    fdp.name = "xcheck.proto"
-    fdp.package = "xcheck"
+    fdp.name = f"{package}.proto"
+    fdp.package = package
     fdp.syntax = "proto3"
     F = descriptor_pb2.FieldDescriptorProto
 
@@ -188,17 +195,18 @@ def _official_messages():
         f = nd.field.add()
         f.name, f.number, f.type, f.label = name, num, ftype, label
 
-    arrs = fdp.message_type.add()
-    arrs.name = "InputArrays"
-    f = arrs.field.add()
-    f.name, f.number, f.type, f.label = (
-        "items", 1, F.TYPE_MESSAGE, F.LABEL_REPEATED,
-    )
-    f.type_name = ".xcheck.ndarray"
-    f = arrs.field.add()
-    f.name, f.number, f.type, f.label = (
-        "uuid", 2, F.TYPE_STRING, F.LABEL_OPTIONAL,
-    )
+    for msg_name in ("InputArrays", "OutputArrays"):
+        m = fdp.message_type.add()
+        m.name = msg_name
+        f = m.field.add()
+        f.name, f.number, f.type, f.label = (
+            "items", 1, F.TYPE_MESSAGE, F.LABEL_REPEATED,
+        )
+        f.type_name = f".{package}.ndarray"
+        f = m.field.add()
+        f.name, f.number, f.type, f.label = (
+            "uuid", 2, F.TYPE_STRING, F.LABEL_OPTIONAL,
+        )
 
     gl = fdp.message_type.add()
     gl.name = "GetLoadResult"
@@ -211,9 +219,13 @@ def _official_messages():
         f.name, f.number, f.type, f.label = name, num, ftype, F.LABEL_OPTIONAL
 
     pool.Add(fdp)
-    get = lambda n: message_factory.GetMessageClass(  # noqa: E731
-        pool.FindMessageTypeByName(f"xcheck.{n}")
+    return lambda n: message_factory.GetMessageClass(
+        pool.FindMessageTypeByName(f"{package}.{n}")
     )
+
+
+def _official_messages():
+    get = _official_schema()
     return get("ndarray"), get("InputArrays"), get("GetLoadResult")
 
 
@@ -297,6 +309,27 @@ def _serve_npproto_node(port):
     run_node(compute, "127.0.0.1", port, getload_wire="npproto")
 
 
+def _wait_node_up(port, *, deadline_s=30.0):
+    """Poll GetLoad (reply wire auto-detected) until the node answers;
+    returns the load dict.  THE one readiness loop for this file."""
+    import asyncio
+    import time
+
+    from pytensor_federated_tpu.service.client import get_load_async
+
+    deadline = time.time() + deadline_s
+
+    async def up():
+        while time.time() < deadline:
+            load = await get_load_async("127.0.0.1", port, timeout=1.0)
+            if load is not None:
+                return load
+            await asyncio.sleep(0.2)
+        raise TimeoutError(f"node on port {port} did not come up")
+
+    return asyncio.run(up())
+
+
 class TestEndToEnd:
     @pytest.fixture(scope="class")
     def npproto_node(self):
@@ -310,23 +343,7 @@ class TestEndToEnd:
             p.join(timeout=5)
 
     def _wait_up(self, port):
-        import asyncio
-        import time
-
-        from pytensor_federated_tpu.service.client import get_load_async
-
-        deadline = time.time() + 30
-
-        async def up():
-            while time.time() < deadline:
-                # No codec choice: the reply wire is auto-detected.
-                load = await get_load_async("127.0.0.1", port, timeout=1.0)
-                if load is not None:
-                    return load
-                await asyncio.sleep(0.2)
-            raise TimeoutError("npproto node did not come up")
-
-        return asyncio.run(up())
+        return _wait_node_up(port)
 
     def test_npproto_client_roundtrip(self, npproto_node):
         from pytensor_federated_tpu.service import (
@@ -503,3 +520,130 @@ def test_property_junk_loud_or_valid(junk):
         again = decode_ndarray(encode_ndarray(a))
         assert again.dtype == a.dtype and again.shape == a.shape
         np.testing.assert_array_equal(again, a)
+
+
+# ---------------------------------------------------------------------------
+# Interop against an INDEPENDENT stand-in reference node: a grpc.aio
+# server whose wire handling is entirely the OFFICIAL google.protobuf
+# runtime (messages built from the reference schema at runtime) — none
+# of this package's codecs on the server side.  Our codec="npproto"
+# client must interoperate over real gRPC.
+# ---------------------------------------------------------------------------
+
+
+def _serve_official_proto_node(port):
+    """A minimal reference-like worker: official-protobuf messages,
+    /ArraysToArraysService method paths, unary + lock-step stream +
+    GetLoad — independent reimplementation for interop testing."""
+    import asyncio
+
+    import grpc
+    import numpy as _np
+
+    get = _official_schema("standin")
+    Nd, In, Out, GL = (
+        get("ndarray"), get("InputArrays"), get("OutputArrays"),
+        get("GetLoadResult"),
+    )
+
+    def nd_to_np(m):
+        return _np.ndarray(
+            buffer=m.data, dtype=_np.dtype(m.dtype),
+            shape=tuple(m.shape), strides=tuple(m.strides) or None,
+        ).copy()
+
+    def np_to_nd(a):
+        a = _np.ascontiguousarray(a)
+        return Nd(
+            data=a.tobytes(), dtype=str(a.dtype),
+            shape=list(a.shape), strides=list(a.strides),
+        )
+
+    def compute_reply(req_bytes):
+        req = In.FromString(req_bytes)
+        x = nd_to_np(req.items[0])
+        out = Out(uuid=req.uuid)
+        o1 = out.items.add()
+        o1.CopyFrom(np_to_nd(_np.asarray(-_np.sum((x - 3.0) ** 2))))
+        o2 = out.items.add()
+        o2.CopyFrom(np_to_nd((-2.0 * (x - 3.0)).astype(x.dtype)))
+        return out.SerializeToString()
+
+    async def evaluate(request, context):
+        return compute_reply(request)
+
+    async def evaluate_stream(request_iterator, context):
+        async for request in request_iterator:
+            yield compute_reply(request)
+
+    async def get_load(request, context):
+        return GL(n_clients=0, percent_cpu=1.0,
+                  percent_ram=2.0).SerializeToString()
+
+    async def main():
+        ident = lambda b: b  # noqa: E731
+        server = grpc.aio.server()
+        handlers = {
+            "Evaluate": grpc.unary_unary_rpc_method_handler(
+                evaluate, request_deserializer=ident,
+                response_serializer=ident,
+            ),
+            "EvaluateStream": grpc.stream_stream_rpc_method_handler(
+                evaluate_stream, request_deserializer=ident,
+                response_serializer=ident,
+            ),
+            "GetLoad": grpc.unary_unary_rpc_method_handler(
+                get_load, request_deserializer=ident,
+                response_serializer=ident,
+            ),
+        }
+        server.add_generic_rpc_handlers((
+            grpc.method_handlers_generic_handler(
+                "ArraysToArraysService", handlers
+            ),
+        ))
+        server.add_insecure_port(f"127.0.0.1:{port}")
+        await server.start()
+        await server.wait_for_termination()
+
+    asyncio.run(main())
+
+
+class TestAgainstOfficialProtoServer:
+    @pytest.fixture(scope="class")
+    def standin_node(self):
+        import socket
+
+        from conftest import spawn_node_procs
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        procs = spawn_node_procs(_serve_official_proto_node, [(port,)])
+        yield port
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            p.join(timeout=5)
+
+    def test_client_drives_official_proto_node(self, standin_node):
+        """The full interop claim in one test: our npproto client —
+        balancing (proto GetLoad auto-detect), lock-step stream, uuid
+        correlation — against a server whose wire is purely the
+        official protobuf runtime."""
+        from pytensor_federated_tpu.service import (
+            ArraysToArraysServiceClient,
+        )
+
+        load = _wait_node_up(standin_node)
+        assert load["percent_ram"] == 2.0  # parsed from official bytes
+
+        for use_stream in (True, False):
+            client = ArraysToArraysServiceClient(
+                "127.0.0.1", standin_node, codec="npproto",
+                use_stream=use_stream,
+            )
+            x = np.array([1.0, 5.0])
+            logp, grad = client.evaluate(x)
+            np.testing.assert_allclose(float(logp), -8.0)
+            np.testing.assert_allclose(grad, [4.0, -4.0])
