@@ -110,3 +110,21 @@ def mesh8(devices8):
     from pytensor_federated_tpu.parallel import make_mesh
 
     return make_mesh({"shards": 8}, devices=devices8)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _clear_jax_caches_between_modules():
+    """Bound in-process compile-state accumulation.
+
+    A full-suite run compiles thousands of distinct XLA programs in one
+    process; after ~500 tests the CPU backend_compile was observed
+    SEGFAULTING non-deterministically (fullsuite_final*.log: 'Fatal
+    Python error' inside backend_compile_and_load, twice, at different
+    tests ~80% in — while every module passes standalone and an
+    11-file tail subset passes together).  Dropping the jit/pjit
+    caches after each module releases the accumulated executables;
+    per-module recompiles cost a little wall time and remove the
+    unbounded growth.
+    """
+    yield
+    jax.clear_caches()
