@@ -337,8 +337,17 @@ def test_forward_supplied_gradients_federated():
         return -0.5 * jnp.sum(r * r * mask)
 
     fed = pft.FederatedLogp(per_shard, packed.tree(), mesh=None)
+
+    def logp_no_autodiff(params):
+        # Same VALUES as fed.logp, but autodiff through it yields zero
+        # gradients — so this test passes ONLY if pt_sample actually
+        # consumes the supplied fused callable (a refactor that falls
+        # back to autodiffing logp_fn leaves the chains stuck at their
+        # init and the OLS assertion fails loudly).
+        return fed.logp(jax.lax.stop_gradient(params))
+
     res = pt_sample(
-        fed.logp,
+        logp_no_autodiff,
         {"w": jnp.zeros(2)},
         key=jax.random.PRNGKey(9),
         num_warmup=300,
