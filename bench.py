@@ -314,6 +314,10 @@ def main():
     try:
         n_cal = 2_000
         runners = {name: make_chained(fn) for name, fn in candidates.items()}
+        # Explicit variant -> candidate mapping for FLOP attribution;
+        # parsing the label (e.g. splitting on "-u") would silently
+        # mis-attribute any future hyphenated impl name.
+        variant_base = {name: name for name in candidates}
         # On chip the flagship is launch/loop-bound (~11 us/eval at
         # unroll=8), so the while-loop's per-iteration overhead is a
         # live candidate for the cap: race a 32x-unrolled chain of the
@@ -324,6 +328,7 @@ def main():
             runners["suffstats-u32"] = make_chained(
                 candidates["suffstats"], unroll=32
             )
+            variant_base["suffstats-u32"] = "suffstats"
         cal = {
             name: time_chain(runner, flat0, n_cal)
             for name, runner in runners.items()
@@ -361,8 +366,8 @@ def main():
     from pytensor_federated_tpu.flopcount import xla_flops_per_eval
 
     # Unroll variants (e.g. "suffstats-u32") are the SAME eval fn as
-    # their base candidate — account FLOPs via the base name.
-    base = best.split("-u")[0] if best not in candidates else best
+    # their base candidate — account FLOPs via the explicit mapping.
+    base = variant_base[best]
     flop_extra = mfu_fields(
         xla_flops_per_eval(candidates[base], flat0), evals_per_sec
     )

@@ -82,6 +82,12 @@ def run_node_pool(
     ``getload_wire="npproto"`` serves reference-protobuf GetLoad
     replies, so UNMODIFIED reference clients can balance over this
     pool (Evaluate auto-detects the wire per request either way).
+
+    Side effect: installs a process-wide SIGTERM handler for the
+    lifetime of the pool so a signal tears down every child.  A
+    previously installed callable handler is chained (called after the
+    children are terminated) and the original disposition is restored
+    when the pool shuts down normally.
     """
     ctx = mp.get_context("spawn")
     # daemon=True: node servers must die WITH the pool manager.  A
@@ -106,24 +112,45 @@ def run_node_pool(
     # pool as a clean run).
     import signal
 
+    prev_handler = signal.getsignal(signal.SIGTERM)
+
     def _terminate_pool(signum, frame):
         for p in procs:
             p.terminate()
+        # A host application's own SIGTERM cleanup must not be silently
+        # discarded by this API: chain to it before exiting.
+        if callable(prev_handler):
+            prev_handler(signum, frame)
         raise SystemExit(128 + signum)
 
+    installed = False
     try:
         signal.signal(signal.SIGTERM, _terminate_pool)
+        installed = True
     except ValueError:  # pragma: no cover - non-main-thread caller
         pass
-    for p in procs:
-        p.start()
-    _log.info("node pool: %d servers on %s:%s", len(procs), bind, list(ports))
     try:
         for p in procs:
-            p.join()
-    except KeyboardInterrupt:
-        for p in procs:
-            p.terminate()
+            p.start()
+        _log.info(
+            "node pool: %d servers on %s:%s", len(procs), bind, list(ports)
+        )
+        try:
+            for p in procs:
+                p.join()
+        except KeyboardInterrupt:
+            for p in procs:
+                p.terminate()
+    finally:
+        # getsignal() returns None for a handler installed from outside
+        # Python (C extension / embedding host); signal.signal(...,
+        # None) would raise, so in that case leave ours in place.
+        if (
+            installed
+            and prev_handler is not None
+            and signal.getsignal(signal.SIGTERM) is _terminate_pool
+        ):
+            signal.signal(signal.SIGTERM, prev_handler)
 
 
 def main(argv=None):

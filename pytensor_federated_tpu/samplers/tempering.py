@@ -296,7 +296,11 @@ def pt_sample(
                 xs, us, gs = xs[perm], us[perm], gs[perm]
                 n_prop = jnp.maximum(jnp.sum(propose), 1)
                 swap_frac = jnp.sum(accept) / n_prop
-                out = (xs[0], acc[0], swap_frac, accept, propose)
+                # acc permutes with the state so the recorded accept_prob
+                # belongs to the SAME transition as the emitted (post-swap)
+                # cold draw — acc[0] alone would describe a different
+                # replica whenever the cold swap fired.
+                out = (xs[0], acc[perm][0], swap_frac, accept, propose)
                 return (
                     (xs, us, gs, log_step, log_rho, inv_mass, wf, t + 1),
                     out,

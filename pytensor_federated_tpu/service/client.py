@@ -71,6 +71,12 @@ async def get_load_async(
             reply = await asyncio.wait_for(method(b""), timeout=timeout)
             if reply[:1] == b"{":
                 return json.loads(reply.decode("utf-8"))
+            # The decoder accepts b"" (the legitimate all-defaults
+            # encoding an idle proto-wire server sends) and schema-
+            # evolved replies, but raises WireError on garbage that
+            # proto3 leniency would otherwise decode to the all-zero —
+            # i.e. maximally attractive — load (unknown-fields-only
+            # buffers).
             from .npwire import WireError
             from .npproto_codec import decode_get_load_result
 

@@ -329,7 +329,19 @@ def encode_get_load_result(
 
 
 def decode_get_load_result(buf: bytes) -> dict:
+    """Decode a ``GetLoadResult`` (service.proto:24-31).
+
+    The empty buffer is the legitimate all-defaults encoding (proto3
+    writers omit default fields) and decodes to the zero load.  A
+    NON-empty buffer containing no known field, however, is rejected as
+    :class:`WireError`: proto3's unknown-field leniency would otherwise
+    decode arbitrary garbage to the all-zero — i.e. maximally
+    attractive — load and silently skew pool balancing.  Schema-evolved
+    replies (new fields alongside at least one known field, at any byte
+    position) still decode fine.
+    """
     n_clients, percent_cpu, percent_ram = 0, 0.0, 0.0
+    known = False
     pos = 0
     while pos < len(buf):
         field, wt, pos = _decode_tag(buf, pos)
@@ -339,18 +351,23 @@ def decode_get_load_result(buf: bytes) -> dict:
             if not -(1 << 31) <= val < (1 << 31):
                 raise WireError(f"n_clients out of int32 range: {val}")
             n_clients = val
+            known = True
         elif field == 2 and wt == _WT_I32:
             if pos + 4 > len(buf):
                 raise WireError("truncated percent_cpu")
             (percent_cpu,) = struct.unpack_from("<f", buf, pos)
             pos += 4
+            known = True
         elif field == 3 and wt == _WT_I32:
             if pos + 4 > len(buf):
                 raise WireError("truncated percent_ram")
             (percent_ram,) = struct.unpack_from("<f", buf, pos)
             pos += 4
+            known = True
         else:
             pos = _skip(buf, pos, wt)
+    if buf and not known:
+        raise WireError("GetLoadResult decoded to unknown fields only")
     return {
         "n_clients": n_clients,
         "percent_cpu": percent_cpu,

@@ -110,6 +110,69 @@ def test_get_loads_with_offline_port(node_pool):
     assert loads[1] is None
 
 
+def test_get_load_rejects_garbled_replies():
+    """Garbage from a misbehaving server must map to None, never to a
+    load dict.  proto3 decoding is lenient — the empty buffer and any
+    unknown-fields-only buffer decode to the all-zero (i.e. maximally
+    attractive) load — so the client only attempts the proto path when
+    the reply leads with a tag GetLoadResult can actually emit
+    (round-4 advisor finding)."""
+    import grpc
+
+    from pytensor_federated_tpu.service.client import get_load_async
+
+    garbled = [
+        b"\x20\x01",  # unknown field 4 ONLY: lenient decode would yield zeros
+        b"\xff\xff\xff",  # outright garbage
+        b"not json",
+    ]
+    # NOT garbage: b"" is the legitimate proto3 encoding of an
+    # all-defaults GetLoadResult (writers omit default fields, so this
+    # is what a genuinely idle proto-wire server replies), and a
+    # schema-evolved reply may lead with an unknown field as long as a
+    # known one follows (forward compatibility).
+    valid = [b"", b"\x20\x01\x08\x02"]
+    payloads = garbled + valid
+    replies = iter(payloads)
+
+    async def get_load(request, context):
+        return next(replies)
+
+    async def main():
+        ident = lambda b: b  # noqa: E731
+        server = grpc.aio.server()
+        handlers = {
+            "GetLoad": grpc.unary_unary_rpc_method_handler(
+                get_load,
+                request_deserializer=ident,
+                response_serializer=ident,
+            ),
+        }
+        server.add_generic_rpc_handlers((
+            grpc.method_handlers_generic_handler(
+                "ArraysToArraysService", handlers
+            ),
+        ))
+        port = server.add_insecure_port("127.0.0.1:0")
+        await server.start()
+        try:
+            return [
+                await get_load_async("127.0.0.1", port, timeout=5.0)
+                for _ in payloads
+            ]
+        finally:
+            await server.stop(None)
+
+    loads = asyncio.run(main())
+    assert loads[: len(garbled)] == [None] * len(garbled)
+    assert loads[len(garbled)] == {
+        "n_clients": 0,
+        "percent_cpu": 0.0,
+        "percent_ram": 0.0,
+    }
+    assert loads[len(garbled) + 1]["n_clients"] == 2
+
+
 def test_balanced_connect_picks_idle_server(node_pool):
     """With a client camped on one server, a new client must connect to
     another (reference: test_service.py:144-177)."""
