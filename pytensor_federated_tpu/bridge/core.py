@@ -20,9 +20,12 @@ holds the rest:
   kind and the fused-op member inliner (what ``jax_funcify`` returns).
 
 What remains pytensor-ONLY after this extraction is enumerated, with
-measured line counts, in docs/migrating.md ("Unexecuted bridge
-surface") — kept to thin Apply/optdb adapter code whose failure mode
-is an import/signature error on first use, not silent wrong numbers.
+measured line counts, in docs/migrating.md ("Pytensor-gated bridge
+surface") — thin Apply/optdb adapter code whose failure mode is an
+import/signature error on first use, not silent wrong numbers; since
+round 5 it executes under the in-repo API shim
+(tests/pytensor_shim.py), leaving only real-pytensor compatibility
+unproven here.
 """
 
 from __future__ import annotations

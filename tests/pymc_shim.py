@@ -1,0 +1,303 @@
+"""Test-only fake ``pymc`` — executes demo_pymc.py without pymc.
+
+Builds on :mod:`pytensor_shim`.  The fake implements exactly the pymc
+surface ``demos/demo_pymc.py`` touches — ``Model`` (context manager),
+``Normal`` / ``HalfNormal`` free RVs, observed ``Normal`` likelihoods,
+``Potential``, ``find_MAP``, ``sample`` — by RECORDING the model and
+delegating the actual numerics to this framework's own machinery:
+
+- graphs lower to JAX through the shim's ``compile_graph_to_jax``,
+  which consumes the bridge's REAL ``jax_funcify`` registrations the
+  same way pytensor's JAX linker would (what ``pm.sample(...,
+  nuts_sampler="numpyro")`` exercises in the real stack);
+- ``find_MAP`` delegates to ``samplers.mcmc.find_map`` (Adam);
+- ``sample`` delegates to ``samplers.mcmc.sample`` (NUTS) in
+  unconstrained space — HalfNormal RVs get the log transform with its
+  Jacobian term, the same reparameterization pymc applies.
+
+WHAT THIS PROVES: that demo_pymc's model-building and driver code
+executes and yields the right posterior against the framework's own
+samplers.  It does NOT prove real-pymc compatibility (transform
+conventions, RV naming, idata layout are all simplified here).
+"""
+
+from __future__ import annotations
+
+import math
+import sys
+import types
+from contextlib import contextmanager
+
+import numpy as np
+
+import pytensor_shim as pts
+
+_LOG_2PI = math.log(2.0 * math.pi)
+
+# ---------------------------------------------------------------------------
+# model recording
+# ---------------------------------------------------------------------------
+
+_MODEL_STACK: list = []
+
+
+def _current_model():
+    if not _MODEL_STACK:
+        raise TypeError("No model on context stack")
+    return _MODEL_STACK[-1]
+
+
+class _FreeRV:
+    def __init__(self, name, var, shape, transform, logprior):
+        self.name = name
+        self.var = var  # shim Variable, CONSTRAINED value
+        self.shape = shape
+        self.transform = transform  # "identity" | "log"
+        self.logprior = logprior  # constrained value -> scalar (jnp)
+
+
+class Model:
+    def __init__(self):
+        self.free_rvs: list[_FreeRV] = []
+        self.potentials: list = []  # shim Variables (scalar)
+        self.observed: list = []  # (mu_var, sigma_var, data)
+
+    def __enter__(self):
+        _MODEL_STACK.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _MODEL_STACK.pop()
+        return False
+
+    # -- lowering to a JAX logp over the unconstrained space ----------------
+
+    def _compiled_graph_parts(self):
+        """One compile of every graph output the logp needs:
+        [*potentials, *observed mu, *observed sigma], as a function of
+        the free RVs' CONSTRAINED values."""
+        jax_funcify = sys.modules["pytensor.link.jax.dispatch"].jax_funcify
+        inputs = [rv.var for rv in self.free_rvs]
+        outputs = list(self.potentials)
+        for mu, sigma, _ in self.observed:
+            outputs.append(pts.as_tensor_variable(mu))
+            outputs.append(pts.as_tensor_variable(sigma))
+        return pts.compile_graph_to_jax(outputs, inputs, jax_funcify)
+
+    def logp_fn(self):
+        """Unconstrained param dict -> total model logp (jax scalar)."""
+        import jax.numpy as jnp
+
+        graph_fn = self._compiled_graph_parts()
+        free_rvs = list(self.free_rvs)
+        observed = list(self.observed)
+        n_pot = len(self.potentials)
+
+        def logp(u):
+            total = 0.0
+            constrained = []
+            for rv in free_rvs:
+                val = u[rv.name]
+                if rv.transform == "log":
+                    x = jnp.exp(val)
+                    # |dx/du| = e^u: the standard log-transform Jacobian
+                    total = total + jnp.sum(val)
+                else:
+                    x = val
+                constrained.append(x)
+                total = total + rv.logprior(x)
+            parts = graph_fn(*constrained)
+            for p in parts[:n_pot]:
+                total = total + jnp.sum(p)
+            for k, (_, _, data) in enumerate(observed):
+                mu = parts[n_pot + 2 * k]
+                sigma = parts[n_pot + 2 * k + 1]
+                z = (jnp.asarray(data) - mu) / sigma
+                total = total + jnp.sum(
+                    -0.5 * z * z - jnp.log(sigma) - 0.5 * _LOG_2PI
+                )
+            return total
+
+        return logp
+
+    def initial_unconstrained(self):
+        init = {}
+        for rv in self.free_rvs:
+            init[rv.name] = np.zeros(rv.shape, dtype=np.float32)
+        return init
+
+    def constrain(self, u):
+        """Map an unconstrained draw dict to constrained values."""
+        out = {}
+        for rv in self.free_rvs:
+            val = np.asarray(u[rv.name])
+            out[rv.name] = np.exp(val) if rv.transform == "log" else val
+        return out
+
+
+# ---------------------------------------------------------------------------
+# distributions
+# ---------------------------------------------------------------------------
+
+
+def _shape_tuple(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(shape)
+
+
+def Normal(name, mu=0.0, sigma=1.0, shape=None, observed=None):
+    model = _current_model()
+    if observed is not None:
+        # Observed likelihood: mu/sigma may be graph expressions over
+        # the free RVs (build_native_model's per-shard likelihoods).
+        model.observed.append(
+            (pts.as_tensor_variable(mu), pts.as_tensor_variable(sigma),
+             np.asarray(observed))
+        )
+        return None
+    if not isinstance(mu, (int, float)) or not isinstance(sigma, (int, float)):
+        raise NotImplementedError("shim prior params must be scalars")
+    shp = _shape_tuple(shape)
+    var = pts.TensorType("float32", shp)(name=name)
+
+    def logprior(x, mu=float(mu), sigma=float(sigma)):
+        import jax.numpy as jnp
+
+        z = (x - mu) / sigma
+        return jnp.sum(-0.5 * z * z - jnp.log(sigma) - 0.5 * _LOG_2PI)
+
+    model.free_rvs.append(_FreeRV(name, var, shp, "identity", logprior))
+    return var
+
+
+def HalfNormal(name, sigma=1.0, shape=None):
+    model = _current_model()
+    shp = _shape_tuple(shape)
+    var = pts.TensorType("float32", shp)(name=name)
+
+    def logprior(x, sigma=float(sigma)):
+        import jax.numpy as jnp
+
+        # HalfNormal(sigma) on the CONSTRAINED value x > 0.
+        return jnp.sum(
+            0.5 * math.log(2.0 / math.pi)
+            - jnp.log(sigma)
+            - 0.5 * (x / sigma) ** 2
+        )
+
+    model.free_rvs.append(_FreeRV(name, var, shp, "log", logprior))
+    return var
+
+
+def Potential(name, var):
+    model = _current_model()
+    model.potentials.append(var)
+    return var
+
+
+# ---------------------------------------------------------------------------
+# drivers
+# ---------------------------------------------------------------------------
+
+
+def find_MAP(progressbar=True, model=None):
+    model = model or _current_model()
+    from pytensor_federated_tpu.samplers.mcmc import find_map
+
+    logp = model.logp_fn()
+    u = find_map(
+        logp, model.initial_unconstrained(), num_steps=600,
+        learning_rate=0.05,
+    )
+    out = {}
+    for name, val in model.constrain(u).items():
+        val = np.asarray(val)
+        out[name] = float(val) if val.ndim == 0 else val
+    return out
+
+
+class _PostArray:
+    def __init__(self, arr):
+        self.arr = np.asarray(arr)  # (chains, draws, *shape)
+
+    def median(self):
+        return np.median(self.arr)
+
+    def mean(self):
+        return np.mean(self.arr)
+
+    def __array__(self, dtype=None):
+        a = self.arr
+        return a.astype(dtype) if dtype is not None else a
+
+
+class _InferenceData:
+    def __init__(self, posterior):
+        self.posterior = posterior
+
+
+def sample(
+    draws=1000,
+    tune=1000,
+    chains=4,
+    cores=None,
+    progressbar=True,
+    random_seed=None,
+    model=None,
+    **kwargs,
+):
+    model = model or _current_model()
+    import jax
+
+    from pytensor_federated_tpu.samplers.mcmc import sample as pft_sample
+
+    logp = model.logp_fn()
+    key = jax.random.PRNGKey(0 if random_seed is None else int(random_seed))
+    res = pft_sample(
+        logp,
+        model.initial_unconstrained(),
+        key=key,
+        num_warmup=int(tune),
+        num_samples=int(draws),
+        num_chains=int(chains),
+        kernel="nuts",
+    )
+    posterior = {}
+    for rv in model.free_rvs:
+        arr = np.asarray(res.samples[rv.name])  # (chains, draws, *shape)
+        if rv.transform == "log":
+            arr = np.exp(arr)
+        posterior[rv.name] = _PostArray(arr)
+    return _InferenceData(posterior)
+
+
+# ---------------------------------------------------------------------------
+# installation
+# ---------------------------------------------------------------------------
+
+
+@contextmanager
+def demo_pymc_under_shims():
+    """pytensor shim + fake pymc + a fresh import of the REAL
+    demos/demo_pymc.py; yields (demo module, bridge namespace)."""
+    import importlib
+
+    with pts.bridge_under_shim() as ns:
+        pymc = types.ModuleType("pymc")
+        pymc.Model = Model
+        pymc.Normal = Normal
+        pymc.HalfNormal = HalfNormal
+        pymc.Potential = Potential
+        pymc.find_MAP = find_MAP
+        pymc.sample = sample
+        sys.modules["pymc"] = pymc
+        try:
+            demo = importlib.import_module(
+                "pytensor_federated_tpu.demos.demo_pymc"
+            )
+            yield types.SimpleNamespace(demo=demo, pymc=pymc, bridge=ns)
+        finally:
+            sys.modules.pop("pymc", None)

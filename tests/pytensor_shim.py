@@ -1,0 +1,624 @@
+"""Test-only fake ``pytensor`` — executes the bridge glue without pytensor.
+
+pytensor/pymc are uninstallable in this environment (no package index),
+so the Apply/optdb adapter code in ``bridge/pytensor_ops.py`` and
+``bridge/fusion.py`` could never run here — four rounds of "written,
+never executed" (docs/migrating.md "Pytensor-gated bridge surface").  This
+module is the next-best evidence: a minimal in-repo implementation of
+exactly the pytensor API surface that glue touches, injected via
+``sys.modules`` so the REAL bridge modules import and execute.
+
+WHAT THIS PROVES — and what it does not.  Tests running under this shim
+prove *our-side* logic: that the glue's make_node/perform/grad/rewrite
+code paths execute, agree with the pure cores they delegate to, and
+honor the reference's behavioral contracts.  They do NOT prove
+compatibility with real pytensor (a signature drift in pytensor itself
+would be invisible here).  The API shapes below are pinned from the
+reference's OWN usage so that drift is at least anchored:
+
+- ``Apply(op=..., inputs=..., outputs=...)`` keyword construction and
+  ``Op.__call__ -> make_node -> outputs`` (reference:
+  wrapper_ops.py:97-105, op_async.py:186-188);
+- ``Op.perform(node, inputs, output_storage)`` with per-output
+  ``storage[0] = value`` slots (reference: wrapper_ops.py:107-117);
+- ``Op.grad`` returning symbolic ``g_logp * grad`` products and
+  ``DisconnectedType`` checks (reference: wrapper_ops.py:119-132);
+- ``FunctionGraph.replace_all_validate(pairs)`` guarded by an attached
+  ``ReplaceValidate`` feature (reference: op_async.py:189-194,
+  AsyncFusionOptimizer.add_requirements at op_async.py:219-226);
+- ``optdb.register(name, rewriter, "fast_run", position=90)`` and the
+  ``"name" in optdb`` idempotence check (reference: op_async.py:228-234);
+- ``jax_funcify.register(OpClass)`` single-dispatch registration
+  (pytensor.link.jax.dispatch, used by bridge/pytensor_ops.py:222-232).
+
+The shim also provides what pytensor's backends would: a tiny
+``eval_graph`` interpreter (the C/py linker stand-in, driving
+``perform``) and a ``compile_graph_to_jax`` compiler (the JAX linker
+stand-in, driving the ``jax_funcify`` registry) — so tests execute the
+glue end-to-end instead of merely importing it.
+"""
+
+from __future__ import annotations
+
+import functools
+import importlib
+import sys
+import types
+from contextlib import contextmanager
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# Types and variables
+# ---------------------------------------------------------------------------
+
+
+class TensorType:
+    """dtype + shape pair; calling an instance makes a fresh variable
+    (pytensor: ``i.type()``, used at reference wrapper_ops.py:98)."""
+
+    def __init__(self, dtype, shape=()):
+        self.dtype = str(dtype)
+        self.shape = tuple(shape)
+
+    def __call__(self, name=None):
+        return Variable(self, name=name)
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, TensorType)
+            and self.dtype == other.dtype
+            and len(self.shape) == len(other.shape)
+        )
+
+    def __hash__(self):
+        return hash((self.dtype, len(self.shape)))
+
+    def __repr__(self):
+        return f"TensorType({self.dtype}, shape={self.shape})"
+
+
+class DisconnectedType:
+    """Marker type of disconnected gradient variables (pytensor:
+    pytensor.gradient.DisconnectedType; isinstance-checked at reference
+    wrapper_ops.py:125)."""
+
+    def __call__(self, name=None):
+        return Variable(self, name=name)
+
+    def __eq__(self, other):
+        return isinstance(other, DisconnectedType)
+
+    def __hash__(self):
+        return hash(DisconnectedType)
+
+
+class Variable:
+    """Graph variable: a type plus its producing apply (owner/index).
+
+    Supports the arithmetic the bridge's ``grad`` emits (``g_logp *
+    grad``, reference wrapper_ops.py:132) and what the pymc-shim demo
+    graphs need (add/sub/getitem)."""
+
+    def __init__(self, type, name=None):
+        self.type = type
+        self.name = name
+        self.owner = None  # Apply that produces this variable
+        self.index = None  # position among owner's outputs
+
+    # -- arithmetic builds small elemwise applies ---------------------------
+    def __mul__(self, other):
+        return _elemwise(Mul, self, other)
+
+    def __rmul__(self, other):
+        return _elemwise(Mul, other, self)
+
+    def __add__(self, other):
+        return _elemwise(Add, self, other)
+
+    def __radd__(self, other):
+        return _elemwise(Add, other, self)
+
+    def __sub__(self, other):
+        return _elemwise(Sub, self, other)
+
+    def __rsub__(self, other):
+        return _elemwise(Sub, other, self)
+
+    def __getitem__(self, idx):
+        return Subtensor(idx)(self)
+
+    def __repr__(self):
+        nm = self.name or "var"
+        return f"<{nm}:{self.type!r}>"
+
+
+class Constant(Variable):
+    def __init__(self, type, data, name=None):
+        super().__init__(type, name=name)
+        self.data = data
+
+
+def as_tensor_variable(x):
+    """pytensor.tensor.as_tensor_variable — accepts variables and raw
+    python/numpy values (the reference's issue-#24 coercion path,
+    reference wrapper_ops.py:25-31 / test_wrapper_ops.py:284-289)."""
+    if isinstance(x, Variable):
+        return x
+    arr = np.asarray(x)
+    return Constant(TensorType(arr.dtype, arr.shape), arr)
+
+
+as_tensor = as_tensor_variable  # reference spells it at.as_tensor
+
+
+class Apply:
+    """One op application; wires ``owner``/``index`` into its outputs
+    (constructed with keywords at reference wrapper_ops.py:100-104)."""
+
+    def __init__(self, op=None, inputs=None, outputs=None):
+        self.op = op
+        self.inputs = list(inputs)
+        self.outputs = list(outputs)
+        for i, out in enumerate(self.outputs):
+            out.owner = self
+            out.index = i
+
+
+class Op:
+    """Base op: ``__call__`` -> ``make_node`` -> outputs (single var for
+    one output, list otherwise — pytensor's convention, relied on by
+    ``self(*inputs)`` re-application at reference wrapper_ops.py:129)."""
+
+    def make_node(self, *inputs):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def perform(self, node, inputs, output_storage):  # pragma: no cover
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        node = self.make_node(*inputs, **kwargs)
+        if len(node.outputs) == 1:
+            return node.outputs[0]
+        return list(node.outputs)
+
+
+# -- tiny elemwise ops the shim graphs need ---------------------------------
+
+
+def _result_type(a, b):
+    return TensorType(
+        np.result_type(a.type.dtype, b.type.dtype),
+        a.type.shape if len(a.type.shape) >= len(b.type.shape) else b.type.shape,
+    )
+
+
+def _elemwise(op_cls, a, b):
+    return op_cls()(as_tensor_variable(a), as_tensor_variable(b))
+
+
+class Mul(Op):
+    def make_node(self, a, b):
+        return Apply(self, [a, b], [_result_type(a, b)()])
+
+    def perform(self, node, inputs, output_storage):
+        output_storage[0][0] = np.asarray(inputs[0] * inputs[1])
+
+
+class Add(Op):
+    def make_node(self, a, b):
+        return Apply(self, [a, b], [_result_type(a, b)()])
+
+    def perform(self, node, inputs, output_storage):
+        output_storage[0][0] = np.asarray(inputs[0] + inputs[1])
+
+
+class Sub(Op):
+    def make_node(self, a, b):
+        return Apply(self, [a, b], [_result_type(a, b)()])
+
+    def perform(self, node, inputs, output_storage):
+        output_storage[0][0] = np.asarray(inputs[0] - inputs[1])
+
+
+class Subtensor(Op):
+    def __init__(self, idx):
+        self.idx = idx
+
+    def make_node(self, x):
+        x = as_tensor_variable(x)
+        # Shape inference: index a dummy of the input's shape.
+        dummy = np.empty(x.type.shape)[self.idx]
+        return Apply(self, [x], [TensorType(x.type.dtype, dummy.shape)()])
+
+    def perform(self, node, inputs, output_storage):
+        output_storage[0][0] = np.asarray(inputs[0][self.idx])
+
+
+def scalar(name=None):
+    """pytensor.tensor.scalar() — floatX 0-d variable (reference
+    wrapper_ops.py:97)."""
+    return TensorType(config.floatX, ())(name=name)
+
+
+# ---------------------------------------------------------------------------
+# FunctionGraph + rewriting machinery
+# ---------------------------------------------------------------------------
+
+
+class ReplaceValidate:
+    """Feature whose presence licenses ``replace_all_validate``
+    (attached by rewriters' add_requirements, reference
+    op_async.py:221-223)."""
+
+
+class GraphRewriter:
+    """Base rewriter: ``rewrite`` = add_requirements then apply
+    (pytensor.graph.rewriting.basic.GraphRewriter)."""
+
+    def add_requirements(self, fgraph):
+        pass
+
+    def apply(self, fgraph):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def rewrite(self, fgraph):
+        self.add_requirements(fgraph)
+        return self.apply(fgraph)
+
+
+def _walk_applies(outputs):
+    """All applies reachable from ``outputs``, topologically ordered
+    (parents first)."""
+    order, seen = [], set()
+
+    def visit(var):
+        node = var.owner
+        if node is None or id(node) in seen:
+            return
+        seen.add(id(node))
+        for inp in node.inputs:
+            visit(inp)
+        order.append(node)
+
+    for out in outputs:
+        visit(out)
+    return order
+
+
+class FunctionGraph:
+    """Just enough of pytensor.graph.fg.FunctionGraph for the fusion
+    rewriter: toposort, feature attachment, validated replacement."""
+
+    def __init__(self, inputs, outputs, clone=False):
+        if clone:  # keep the shim honest about what it implements
+            raise NotImplementedError("shim FunctionGraph does not clone")
+        self.inputs = list(inputs)
+        self.outputs = list(outputs)
+        self._features = []
+
+    def attach_feature(self, feature):
+        self._features.append(feature)
+
+    def toposort(self):
+        return _walk_applies(self.outputs)
+
+    def replace_all_validate(self, pairs, reason=None):
+        """Swap each (old, new) variable throughout the graph, validating
+        type compatibility first — mismatches raise and nothing is
+        replaced (the safety contract the reference opts into via
+        ReplaceValidate, op_async.py:189-194)."""
+        if not any(isinstance(f, ReplaceValidate) for f in self._features):
+            raise RuntimeError(
+                "replace_all_validate requires the ReplaceValidate feature "
+                "(rewriter.add_requirements not run?)"
+            )
+        for old, new in pairs:
+            if not (
+                isinstance(old.type, type(new.type))
+                and old.type == new.type
+            ):
+                raise TypeError(
+                    f"replacement type mismatch: {old.type!r} vs {new.type!r} "
+                    f"(reason={reason})"
+                )
+        mapping = {id(old): new for old, new in pairs}
+        for node in self.toposort():
+            node.inputs = [
+                mapping.get(id(i), i) for i in node.inputs
+            ]
+        self.outputs = [mapping.get(id(o), o) for o in self.outputs]
+
+
+# ---------------------------------------------------------------------------
+# optdb
+# ---------------------------------------------------------------------------
+
+
+class _OptDB:
+    """pytensor.compile.optdb stand-in: named registration with tags and
+    a position, duplicate names rejected; ``in`` checks registration
+    (the reference's idempotence guard, op_async.py:228)."""
+
+    def __init__(self):
+        self._db = {}
+
+    def __contains__(self, name):
+        return name in self._db
+
+    def register(self, name, obj, *tags, position=None, **kwargs):
+        if name in self._db:
+            raise ValueError(f"{name!r} already registered")
+        self._db[name] = {
+            "obj": obj,
+            "tags": tags,
+            "position": position,
+            **kwargs,
+        }
+
+    def query(self, name):
+        return self._db[name]
+
+
+# ---------------------------------------------------------------------------
+# JAX dispatch registry (pytensor.link.jax.dispatch.jax_funcify)
+# ---------------------------------------------------------------------------
+
+
+def _make_jax_funcify():
+    @functools.singledispatch
+    def jax_funcify(op, **kwargs):
+        raise NotImplementedError(f"no jax_funcify for {type(op).__name__}")
+
+    return jax_funcify
+
+
+# ---------------------------------------------------------------------------
+# Backend stand-ins: graph interpreter (py linker) and JAX compiler
+# ---------------------------------------------------------------------------
+
+
+def eval_graph(outputs, givens):
+    """Evaluate variables by running ``perform`` in topological order —
+    the py-linker stand-in.  ``givens`` maps input Variables to values."""
+    values = {id(v): np.asarray(val) for v, val in givens.items()}
+
+    def value_of(var):
+        if id(var) in values:
+            return values[id(var)]
+        if isinstance(var, Constant):
+            return np.asarray(var.data)
+        raise KeyError(f"no value for {var!r}")
+
+    for node in _walk_applies(outputs):
+        in_vals = [value_of(i) for i in node.inputs]
+        storage = [[None] for _ in node.outputs]
+        node.op.perform(node, in_vals, storage)
+        for out, st in zip(node.outputs, storage):
+            values[id(out)] = st[0]
+    return [value_of(o) for o in outputs]
+
+
+def compile_graph_to_jax(outputs, inputs, jax_funcify):
+    """Compile variables into one jax-traceable python callable of
+    ``inputs`` — the JAX-linker stand-in.  Each apply is lowered through
+    the ``jax_funcify`` registry, exactly how pytensor's JAX backend
+    consumes the bridge's registrations (bridge/pytensor_ops.py:222-232,
+    bridge/fusion.py:206-221)."""
+
+    def fn(*args):
+        values = {id(v): a for v, a in zip(inputs, args)}
+
+        def value_of(var):
+            if id(var) in values:
+                return values[id(var)]
+            if isinstance(var, Constant):
+                return var.data
+            raise KeyError(f"no value for {var!r}")
+
+        for node in _walk_applies(outputs):
+            member = jax_funcify(node.op)
+            res = member(*[value_of(i) for i in node.inputs])
+            if not isinstance(res, (tuple, list)):
+                res = (res,)
+            if len(res) != len(node.outputs):
+                raise ValueError(
+                    f"{type(node.op).__name__} jax callable returned "
+                    f"{len(res)} outputs for {len(node.outputs)} vars"
+                )
+            for out, r in zip(node.outputs, res):
+                values[id(out)] = r
+        return [value_of(o) for o in outputs]
+
+    return fn
+
+
+# Elemwise lowering for the shim's own ops so mixed graphs (federated op
+# products, demo models) compile through the same registry.
+def _register_shim_elemwise(jax_funcify):
+    import jax.numpy as jnp
+
+    @jax_funcify.register(Mul)
+    def _(op, **kw):
+        return lambda a, b: jnp.multiply(a, b)
+
+    @jax_funcify.register(Add)
+    def _(op, **kw):
+        return lambda a, b: jnp.add(a, b)
+
+    @jax_funcify.register(Sub)
+    def _(op, **kw):
+        return lambda a, b: jnp.subtract(a, b)
+
+    @jax_funcify.register(Subtensor)
+    def _(op, **kw):
+        return lambda x, _idx=None: x[op.idx]
+
+
+# ---------------------------------------------------------------------------
+# sys.modules injection
+# ---------------------------------------------------------------------------
+
+config = types.SimpleNamespace(floatX="float64")
+
+_SHIM_MODULES = [
+    "pytensor",
+    "pytensor.tensor",
+    "pytensor.gradient",
+    "pytensor.graph",
+    "pytensor.graph.basic",
+    "pytensor.graph.op",
+    "pytensor.graph.features",
+    "pytensor.graph.fg",
+    "pytensor.graph.rewriting",
+    "pytensor.graph.rewriting.basic",
+    "pytensor.compile",
+    "pytensor.link",
+    "pytensor.link.jax",
+    "pytensor.link.jax.dispatch",
+]
+
+_BRIDGE_MODULES = [
+    "pytensor_federated_tpu.bridge.pytensor_ops",
+    "pytensor_federated_tpu.bridge.fusion",
+]
+
+# The bridge PACKAGE may already be imported with HAS_PYTENSOR=False
+# (its import gate ran without pytensor).  Under the shim it must
+# re-import so the gate flips and ``from ..bridge import
+# federated_potential`` works (demo_pymc.py:98) — saved and restored so
+# the rest of the session sees the original module object again.
+_REIMPORT_MODULES = [
+    "pytensor_federated_tpu.bridge",
+    "pytensor_federated_tpu.demos.demo_pymc",
+]
+
+
+def build_modules():
+    """Fresh fake-module tree (new optdb and jax_funcify registry each
+    install, so repeated test runs never see stale registrations)."""
+    mods = {name: types.ModuleType(name) for name in _SHIM_MODULES}
+    jax_funcify = _make_jax_funcify()
+    _register_shim_elemwise(jax_funcify)
+    optdb = _OptDB()
+
+    pt = mods["pytensor"]
+    pt.config = config
+    pt.tensor = mods["pytensor.tensor"]
+    pt.gradient = mods["pytensor.gradient"]
+    pt.graph = mods["pytensor.graph"]
+    pt.compile = mods["pytensor.compile"]
+    pt.link = mods["pytensor.link"]
+    pt.__path__ = []  # mark as package for "import pytensor.tensor"
+
+    t = mods["pytensor.tensor"]
+    t.as_tensor_variable = as_tensor_variable
+    t.as_tensor = as_tensor
+    t.scalar = scalar
+    t.TensorType = TensorType
+
+    mods["pytensor.gradient"].DisconnectedType = DisconnectedType
+
+    g = mods["pytensor.graph"]
+    g.__path__ = []
+    g.basic = mods["pytensor.graph.basic"]
+    g.op = mods["pytensor.graph.op"]
+    g.features = mods["pytensor.graph.features"]
+    g.fg = mods["pytensor.graph.fg"]
+    g.rewriting = mods["pytensor.graph.rewriting"]
+    g.FunctionGraph = FunctionGraph
+    mods["pytensor.graph.basic"].Apply = Apply
+    mods["pytensor.graph.basic"].Variable = Variable
+    mods["pytensor.graph.basic"].Constant = Constant
+    mods["pytensor.graph.op"].Op = Op
+    mods["pytensor.graph.features"].ReplaceValidate = ReplaceValidate
+    mods["pytensor.graph.fg"].FunctionGraph = FunctionGraph
+    mods["pytensor.graph.rewriting"].__path__ = []
+    mods["pytensor.graph.rewriting"].basic = mods[
+        "pytensor.graph.rewriting.basic"
+    ]
+    mods["pytensor.graph.rewriting.basic"].GraphRewriter = GraphRewriter
+
+    mods["pytensor.compile"].optdb = optdb
+
+    mods["pytensor.link"].__path__ = []
+    mods["pytensor.link"].jax = mods["pytensor.link.jax"]
+    mods["pytensor.link.jax"].__path__ = []
+    mods["pytensor.link.jax"].dispatch = mods["pytensor.link.jax.dispatch"]
+    mods["pytensor.link.jax.dispatch"].jax_funcify = jax_funcify
+
+    return mods, optdb, jax_funcify
+
+
+@contextmanager
+def bridge_under_shim():
+    """Install the shim, import the REAL bridge glue modules under it,
+    yield a namespace, then remove shim + glue from ``sys.modules`` so
+    no other test can observe a fake pytensor."""
+    present = [
+        name
+        for name in _SHIM_MODULES + _BRIDGE_MODULES + ["pymc"]
+        if name in sys.modules
+    ]
+    if present:
+        # Real pytensor/pymc imported in this process (e.g. the
+        # real-dependency suites ran first after an install finally
+        # succeeds): the shim must NOT shadow it — defer to the real
+        # tests instead of turning a green suite into errors.
+        import pytest
+
+        pytest.skip(
+            f"real modules already imported ({present[0]}…); shim tests "
+            "defer to the real-dependency suite"
+        )
+    saved = {
+        name: sys.modules.pop(name)
+        for name in _REIMPORT_MODULES
+        if name in sys.modules
+    }
+    mods, optdb, jax_funcify = build_modules()
+    sys.modules.update(mods)
+    try:
+        bridge = importlib.import_module("pytensor_federated_tpu.bridge")
+        assert bridge.HAS_PYTENSOR, "shim failed to satisfy the import gate"
+        pytensor_ops = sys.modules[
+            "pytensor_federated_tpu.bridge.pytensor_ops"
+        ]
+        fusion = sys.modules["pytensor_federated_tpu.bridge.fusion"]
+        yield types.SimpleNamespace(
+            bridge=bridge,
+            pytensor_ops=pytensor_ops,
+            fusion=fusion,
+            optdb=optdb,
+            jax_funcify=jax_funcify,
+            # shim surface handed to tests
+            Apply=Apply,
+            Op=Op,
+            Variable=Variable,
+            Constant=Constant,
+            TensorType=TensorType,
+            DisconnectedType=DisconnectedType,
+            FunctionGraph=FunctionGraph,
+            ReplaceValidate=ReplaceValidate,
+            as_tensor_variable=as_tensor_variable,
+            scalar=scalar,
+            config=config,
+            eval_graph=eval_graph,
+            compile_graph_to_jax=compile_graph_to_jax,
+        )
+    finally:
+        for name in _SHIM_MODULES + _BRIDGE_MODULES + _REIMPORT_MODULES:
+            sys.modules.pop(name, None)
+        sys.modules.update(saved)
+        # Re-point (or clear) parent-package attributes so
+        # ``pytensor_federated_tpu.bridge`` keeps meaning the original —
+        # a stale attribute would satisfy ``from pkg import bridge``
+        # without consulting sys.modules.
+        for name in _REIMPORT_MODULES:
+            parent, _, child = name.rpartition(".")
+            if parent not in sys.modules:
+                continue
+            if name in saved:
+                setattr(sys.modules[parent], child, saved[name])
+            elif hasattr(sys.modules[parent], child):
+                delattr(sys.modules[parent], child)

@@ -1,0 +1,421 @@
+"""Execute the bridge glue (pytensor_ops.py + fusion.py) under the shim.
+
+These tests drive the REAL glue modules — imported under the in-repo
+fake pytensor (tests/pytensor_shim.py) — through the flows the
+reference exercises in its own CI:
+
+- Op construction / perform numerics / raw-scalar coercion
+  (reference: test_wrapper_ops.py:80-118, 284-289);
+- the symbolic ``.grad`` bridge incl. the second-order rejection
+  (reference: wrapper_ops.py:119-132);
+- the fusion rewrite end-to-end on a function graph, with graph-shape
+  assertions and numeric equality (reference: test_op_async.py:122-150)
+  and the wall-clock max-not-sum contract (test_op_async.py:153-195);
+- the pickle/rebuild path of the fused op;
+- the optdb and jax_funcify registrations.
+
+They prove OUR-side logic executes correctly against the pinned API
+shapes — NOT compatibility with real pytensor (see the shim docstring
+for exactly what is pinned from the reference's usage).
+"""
+
+import pickle
+import time
+
+import numpy as np
+import pytest
+
+from pytensor_shim import bridge_under_shim
+
+
+@pytest.fixture()
+def env():
+    with bridge_under_shim() as ns:
+        yield ns
+
+
+def _quad_logp_grad(target):
+    """logp(x) = -sum((x-target)^2), grad = -2(x-target) — closed-form
+    oracle used throughout."""
+
+    def fn(*inputs):
+        logp = 0.0
+        grads = []
+        for x in inputs:
+            x = np.asarray(x, dtype=np.float64)
+            logp -= np.sum((x - target) ** 2)
+            grads.append(-2.0 * (x - target))
+        return np.asarray(logp), grads
+
+    return fn
+
+
+def _quad_at_zero(*inputs):
+    return _quad_logp_grad(0.0)(*inputs)
+
+
+def _quad_at_one(*inputs):
+    return _quad_logp_grad(1.0)(*inputs)
+
+
+# ---------------------------------------------------------------------------
+# FederatedLogpGradOp
+# ---------------------------------------------------------------------------
+
+
+class TestLogpGradOp:
+    def test_make_node_shapes_and_dtypes(self, env):
+        op = env.pytensor_ops.FederatedLogpGradOp(_quad_logp_grad(0.0))
+        x = env.TensorType("float32", (3,))()
+        node = op.make_node(x, 2)  # raw python int coerces (issue #24)
+        assert len(node.inputs) == 2
+        assert len(node.outputs) == 3  # logp + one grad per input
+        assert node.outputs[0].type.shape == ()
+        assert node.outputs[1].type.dtype == "float32"
+        # int input's grad upcasts to floatX, not int (core policy)
+        assert node.outputs[2].type.dtype == env.config.floatX
+
+    def test_perform_numerics(self, env):
+        op = env.pytensor_ops.FederatedLogpGradOp(_quad_logp_grad(1.0))
+        x = env.TensorType("float64", (3,))()
+        logp, g = op(x)
+        xv = np.array([0.0, 1.0, 3.0])
+        lv, gv = env.eval_graph([logp, g], {x: xv})
+        np.testing.assert_allclose(lv, -(1.0 + 0.0 + 4.0))
+        np.testing.assert_allclose(gv, -2.0 * (xv - 1.0))
+
+    def test_grad_is_scaled_product(self, env):
+        """``.grad`` returns ``g_logp * grad_i`` evaluated through the
+        re-applied op (reference wrapper_ops.py:119-132)."""
+        op = env.pytensor_ops.FederatedLogpGradOp(_quad_logp_grad(0.0))
+        x = env.TensorType("float64", (2,))()
+        outputs = op(x)
+        g_logp = env.scalar()
+        disconnected = env.DisconnectedType()()
+        (gx,) = op.grad([x], [g_logp, disconnected])
+        xv = np.array([1.0, -2.0])
+        (gxv,) = env.eval_graph([gx], {x: xv, g_logp: np.asarray(3.0)})
+        np.testing.assert_allclose(gxv, 3.0 * (-2.0 * xv))
+
+    def test_second_order_rejected(self, env):
+        op = env.pytensor_ops.FederatedLogpGradOp(_quad_logp_grad(0.0))
+        x = env.TensorType("float64", (2,))()
+        op(x)
+        g_logp = env.scalar()
+        connected = env.TensorType("float64", (2,))()  # NOT disconnected
+        with pytest.raises(NotImplementedError, match="second-order"):
+            op.grad([x], [g_logp, connected])
+
+    def test_connection_pattern(self, env):
+        op = env.pytensor_ops.FederatedLogpGradOp(_quad_logp_grad(0.0))
+        x = env.TensorType("float64", (2,))()
+        y = env.TensorType("float64", ())()
+        node = op.make_node(x, y)
+        assert op.connection_pattern(node) == [
+            [True, False, False],
+            [True, False, False],
+        ]
+
+    def test_scalar_logp_contract(self, env):
+        def bad(*inputs):
+            return np.ones(3), [np.zeros_like(i) for i in inputs]
+
+        op = env.pytensor_ops.FederatedLogpGradOp(bad)
+        x = env.TensorType("float64", (2,))()
+        logp, _ = op(x)
+        with pytest.raises(ValueError, match="scalar"):
+            env.eval_graph([logp], {x: np.zeros(2)})
+
+    def test_grad_arity_contract(self, env):
+        def bad(*inputs):
+            return np.asarray(0.0), []  # no grads for one input
+
+        op = env.pytensor_ops.FederatedLogpGradOp(bad)
+        x = env.TensorType("float64", (2,))()
+        logp, _ = op(x)
+        with pytest.raises(ValueError, match="grads"):
+            env.eval_graph([logp], {x: np.zeros(2)})
+
+    def test_federated_potential_front_door(self, env):
+        x = env.TensorType("float64", (2,))()
+        logp = env.pytensor_ops.federated_potential(
+            _quad_logp_grad(0.0), x
+        )
+        assert isinstance(
+            logp.owner.op, env.pytensor_ops.FederatedLogpGradOp
+        )
+        assert logp.index == 0
+
+
+# ---------------------------------------------------------------------------
+# FederatedLogpOp / FederatedArraysToArraysOp
+# ---------------------------------------------------------------------------
+
+
+class TestOtherOps:
+    def test_logp_op(self, env):
+        op = env.pytensor_ops.FederatedLogpOp(
+            lambda x: np.asarray(-np.sum(x**2))
+        )
+        x = env.TensorType("float64", (3,))()
+        logp = op(x)
+        (lv,) = env.eval_graph([logp], {x: np.array([1.0, 2.0, 3.0])})
+        np.testing.assert_allclose(lv, -14.0)
+
+    def test_arrays_op_output_types_and_arity(self, env):
+        op = env.pytensor_ops.FederatedArraysToArraysOp(
+            lambda a, b: [a + b, a * b],
+            [env.TensorType("float64", (2,)), env.TensorType("float64", (2,))],
+        )
+        a = env.TensorType("float64", (2,))()
+        b = env.TensorType("float64", (2,))()
+        s, p = op(a, b)
+        sv, pv = env.eval_graph(
+            [s, p], {a: np.array([1.0, 2.0]), b: np.array([3.0, 4.0])}
+        )
+        np.testing.assert_allclose(sv, [4.0, 6.0])
+        np.testing.assert_allclose(pv, [3.0, 8.0])
+
+        bad = env.pytensor_ops.FederatedArraysToArraysOp(
+            lambda a: [a, a, a],
+            [env.TensorType("float64", (2,))],
+        )
+        out = bad(a)
+        with pytest.raises(ValueError, match="outputs"):
+            env.eval_graph([out], {a: np.zeros(2)})
+
+    def test_distinct_instances_never_equal(self, env):
+        """No __props__: two ops over different fns must not compare
+        equal (merge-optimizer safety, reference wrapper_ops.py:20-23)."""
+        mk = env.pytensor_ops.FederatedLogpOp
+        assert mk(lambda x: x) != mk(lambda x: x)
+
+
+# ---------------------------------------------------------------------------
+# jax_funcify dispatch
+# ---------------------------------------------------------------------------
+
+
+class TestJaxDispatch:
+    def test_member_dispatch_matches_perform(self, env):
+        import jax.numpy as jnp
+
+        def jax_fn(x):
+            return -jnp.sum((x - 1.0) ** 2), [-2.0 * (x - 1.0)]
+
+        op = env.pytensor_ops.FederatedLogpGradOp(
+            _quad_logp_grad(1.0), jax_fn=jax_fn
+        )
+        x = env.TensorType("float64", (3,))()
+        logp, g = op(x)
+        fn = env.compile_graph_to_jax([logp, g], [x], env.jax_funcify)
+        xv = np.array([0.0, 1.0, 3.0])
+        lv, gv = fn(jnp.asarray(xv))
+        pl, pg = env.eval_graph([logp, g], {x: xv})
+        np.testing.assert_allclose(np.asarray(lv), pl)
+        np.testing.assert_allclose(np.asarray(gv), pg)
+
+    def test_missing_jax_fn_is_loud(self, env):
+        op = env.pytensor_ops.FederatedLogpOp(lambda x: np.asarray(0.0))
+        with pytest.raises(NotImplementedError, match="FederatedLogpOp"):
+            env.jax_funcify(op)
+
+    def test_jittable_end_to_end(self, env):
+        import jax
+        import jax.numpy as jnp
+
+        def jax_fn(x):
+            return -jnp.sum(x**2)
+
+        op = env.pytensor_ops.FederatedLogpOp(
+            lambda x: np.asarray(-np.sum(x**2)), jax_fn=jax_fn
+        )
+        x = env.TensorType("float64", (3,))()
+        logp = op(x)
+        fn = env.compile_graph_to_jax([logp], [x], env.jax_funcify)
+        jitted = jax.jit(lambda xv: fn(xv)[0])
+        np.testing.assert_allclose(
+            float(jitted(jnp.array([1.0, 2.0, 3.0]))), -14.0
+        )
+
+
+# ---------------------------------------------------------------------------
+# The fusion rewrite, end-to-end on a FunctionGraph
+# ---------------------------------------------------------------------------
+
+
+def _build_two_member_graph(env, delay=0.0):
+    """Two INDEPENDENT federated applies + a downstream consumer
+    combining their logps — the reference's manual-rewrite test graph
+    shape (test_op_async.py:122-150)."""
+
+    def slow(target):
+        base = _quad_logp_grad(target)
+
+        def fn(*inputs):
+            if delay:
+                time.sleep(delay)
+            return base(*inputs)
+
+        return fn
+
+    opA = env.pytensor_ops.FederatedLogpGradOp(slow(0.0))
+    opB = env.pytensor_ops.FederatedLogpGradOp(slow(1.0))
+    x = env.TensorType("float64", (2,))()
+    y = env.TensorType("float64", (2,))()
+    logpA, gA = opA(x)
+    logpB, gB = opB(y)
+    total = logpA + logpB
+    fg = env.FunctionGraph([x, y], [total, gA, gB])
+    return fg, (x, y)
+
+
+class TestFusionRewrite:
+    def test_rewrite_fuses_independent_applies(self, env):
+        fg, (x, y) = _build_two_member_graph(env)
+        xv, yv = np.array([1.0, 2.0]), np.array([3.0, 4.0])
+        before = env.eval_graph(fg.outputs, {x: xv, y: yv})
+
+        env.fusion.FederatedFusionRewriter().rewrite(fg)
+
+        fused = [
+            n
+            for n in fg.toposort()
+            if isinstance(n.op, env.fusion.ParallelFederatedOp)
+        ]
+        assert len(fused) == 1, "expected exactly one fused apply"
+        assert len(fused[0].op.members) == 2
+        # No federated member applies survive outside the fused one.
+        leftovers = [
+            n
+            for n in fg.toposort()
+            if isinstance(
+                n.op, env.pytensor_ops.FederatedLogpGradOp
+            )
+        ]
+        assert not leftovers
+        after = env.eval_graph(fg.outputs, {x: xv, y: yv})
+        for b, a in zip(before, after):
+            np.testing.assert_allclose(a, b)
+
+    def test_rewrite_leaves_dependent_chain_alone(self, env):
+        """B consumes A's output: fusing would deadlock/cycle — the
+        grouping must keep them separate applies."""
+        opA = env.pytensor_ops.FederatedLogpGradOp(_quad_logp_grad(0.0))
+        opB = env.pytensor_ops.FederatedLogpGradOp(_quad_logp_grad(1.0))
+        x = env.TensorType("float64", (2,))()
+        logpA, gA = opA(x)
+        logpB, gB = opB(gA)  # dependent!
+        fg = env.FunctionGraph([x], [logpB])
+        env.fusion.FederatedFusionRewriter().rewrite(fg)
+        fused = [
+            n
+            for n in fg.toposort()
+            if isinstance(n.op, env.fusion.ParallelFederatedOp)
+        ]
+        assert not fused
+        xv = np.array([0.5, -0.5])
+        (lv,) = env.eval_graph([fg.outputs[0]], {x: xv})
+        gAv = -2.0 * xv
+        np.testing.assert_allclose(lv, -np.sum((gAv - 1.0) ** 2))
+
+    def test_fused_wallclock_is_max_not_sum(self, env):
+        """The reference's load-bearing proof (test_op_async.py:153-195):
+        two 0.35 s members through the fused perform must take ~0.35 s,
+        not ~0.7 s."""
+        fg, (x, y) = _build_two_member_graph(env, delay=0.35)
+        env.fusion.FederatedFusionRewriter().rewrite(fg)
+        xv, yv = np.zeros(2), np.zeros(2)
+        env.eval_graph(fg.outputs, {x: xv, y: yv})  # warm the pool
+        t0 = time.perf_counter()
+        env.eval_graph(fg.outputs, {x: xv, y: yv})
+        wall = time.perf_counter() - t0
+        assert wall < 0.6, f"members ran sequentially: {wall:.3f}s"
+
+    def test_replace_requires_validate_feature(self, env):
+        """add_requirements is load-bearing: replacement without the
+        ReplaceValidate feature must refuse."""
+        fg, (x, y) = _build_two_member_graph(env)
+        rewriter = env.fusion.FederatedFusionRewriter()
+        with pytest.raises(RuntimeError, match="ReplaceValidate"):
+            rewriter.apply(fg)  # no add_requirements first
+
+    def test_fused_jax_path_matches_perform(self, env):
+        import jax.numpy as jnp
+
+        def jax_fn(target):
+            def fn(x):
+                return -jnp.sum((x - target) ** 2), [-2.0 * (x - target)]
+
+            return fn
+
+        opA = env.pytensor_ops.FederatedLogpGradOp(
+            _quad_logp_grad(0.0), jax_fn=jax_fn(0.0)
+        )
+        opB = env.pytensor_ops.FederatedLogpGradOp(
+            _quad_logp_grad(1.0), jax_fn=jax_fn(1.0)
+        )
+        x = env.TensorType("float64", (2,))()
+        y = env.TensorType("float64", (2,))()
+        logpA, gA = opA(x)
+        logpB, gB = opB(y)
+        fg = env.FunctionGraph([x, y], [logpA + logpB, gA, gB])
+        env.fusion.FederatedFusionRewriter().rewrite(fg)
+        xv, yv = np.array([1.0, 2.0]), np.array([3.0, 4.0])
+        perform_vals = env.eval_graph(fg.outputs, {x: xv, y: yv})
+        fn = env.compile_graph_to_jax(fg.outputs, [x, y], env.jax_funcify)
+        jax_vals = fn(jnp.asarray(xv), jnp.asarray(yv))
+        for p, j in zip(perform_vals, jax_vals):
+            np.testing.assert_allclose(np.asarray(j), p)
+
+    def test_fused_pickle_roundtrip(self, env):
+        """__getstate__ drops the member templates and executor pool;
+        both must rebuild lazily on the unpickled op (the cross-process
+        compile-cache path).  Members wrap MODULE-LEVEL compute fns —
+        closures don't pickle, and real deployments ship importable
+        fns for exactly this reason."""
+        opA = env.pytensor_ops.FederatedLogpGradOp(_quad_at_zero)
+        opB = env.pytensor_ops.FederatedLogpGradOp(_quad_at_one)
+        x = env.TensorType("float64", (2,))()
+        y = env.TensorType("float64", (2,))()
+        logpA, gA = opA(x)
+        logpB, gB = opB(y)
+        fg = env.FunctionGraph([x, y], [logpA + logpB, gA, gB])
+        env.fusion.FederatedFusionRewriter().rewrite(fg)
+        (fused_node,) = [
+            n
+            for n in fg.toposort()
+            if isinstance(n.op, env.fusion.ParallelFederatedOp)
+        ]
+        op2 = pickle.loads(pickle.dumps(fused_node.op))
+        assert not hasattr(op2, "_member_nodes")
+        assert not hasattr(op2, "_pool")
+        x2 = env.TensorType("float64", (2,))()
+        y2 = env.TensorType("float64", (2,))()
+        outs = op2(x2, y2)
+        xv, yv = np.array([1.0, 2.0]), np.array([3.0, 4.0])
+        vals = env.eval_graph(outs, {x2: xv, y2: yv})
+        np.testing.assert_allclose(vals[0], -np.sum(xv**2))
+        np.testing.assert_allclose(vals[2], -np.sum((yv - 1.0) ** 2))
+
+    def test_fused_input_arity_check(self, env):
+        op = env.fusion.ParallelFederatedOp(
+            [env.pytensor_ops.FederatedLogpOp(lambda x: np.asarray(0.0))],
+            [1],
+            [1],
+        )
+        a = env.TensorType("float64", (2,))()
+        b = env.TensorType("float64", (2,))()
+        with pytest.raises(ValueError, match="inputs"):
+            op.make_node(a, b)
+
+    def test_optdb_registration_matches_reference_slot(self, env):
+        """Importing fusion registers at the reference's optdb slot
+        (op_async.py:228-234): fast_run tag, position 90, idempotent."""
+        assert "federated_parallel_fusion" in env.optdb
+        rec = env.optdb.query("federated_parallel_fusion")
+        assert "fast_run" in rec["tags"]
+        assert rec["position"] == 90
+        assert isinstance(
+            rec["obj"], env.fusion.FederatedFusionRewriter
+        )

@@ -1,0 +1,146 @@
+"""Execute demos/demo_pymc.py under the pytensor + pymc shims.
+
+The reference's flagship workflow (PyMC model, federated likelihood,
+find_MAP + NUTS — reference demo_model.py:15-45) runs here end-to-end
+with the REAL demo module: model building through
+``bridge.federated_potential``, the JAX-linker lowering via the
+bridge's ``jax_funcify`` registrations, the host ``perform`` path, and
+the ``main()`` driver with posterior assertions against the generating
+truth (intercept 1.5, slope 2.0 — models/linear.py:44-45).
+
+Shim caveat (see tests/pymc_shim.py): this proves OUR-side logic — the
+demo's 124 previously-unexecuted lines now run under test — not
+real-pymc compatibility.
+"""
+
+import numpy as np
+import pytest
+
+from pymc_shim import demo_pymc_under_shims
+import pytensor_shim as pts
+
+
+@pytest.fixture(scope="module")
+def shims():
+    with demo_pymc_under_shims() as ns:
+        yield ns
+
+
+def _unconstrained(model, *, intercept, offsets, slope, log_sigma):
+    u = {
+        "intercept": np.float32(intercept),
+        "offsets": np.asarray(offsets, np.float32),
+        "slope": np.float32(slope),
+        "sigma": np.float32(log_sigma),  # unconstrained = log sigma
+    }
+    # keep only names the model actually has, in its own order
+    names = {rv.name for rv in model.free_rvs}
+    assert names == set(u)
+    return u
+
+
+class TestModelParity:
+    def test_federated_matches_native_logp(self, shims):
+        """The dtype-seam parity claim in demo_pymc's docstring: the
+        federated Potential model and the natively built model are the
+        SAME posterior (reference: test_demo_node.py:68-110 compares a
+        federated model against a native one the same way)."""
+        demo = shims.demo
+        data, _ = demo.generate_node_data(4, n_obs=32, seed=7)
+        fed = demo.build_model(data)
+        native = demo.build_native_model(data)
+
+        fed_logp = fed.logp_fn()
+        native_logp = native.logp_fn()
+        rng = np.random.default_rng(0)
+        for _ in range(3):
+            point = _unconstrained(
+                fed,
+                intercept=rng.normal(1.5, 0.3),
+                offsets=rng.normal(0.0, 0.2, size=4),
+                slope=rng.normal(2.0, 0.3),
+                log_sigma=rng.normal(-0.5, 0.2),
+            )
+            a = float(fed_logp(point))
+            b = float(native_logp(point))
+            assert np.isfinite(a) and np.isfinite(b)
+            # f32 evaluation over ~128 observations: 1e-4 relative
+            # (demo docstring pins ~1e-5 at float64-vs-float32 seam;
+            # here BOTH sides are f32 so the gap is summation order).
+            assert abs(a - b) <= 1e-4 * max(1.0, abs(a)), (a, b)
+
+    def test_perform_path_matches_jax_path(self, shims):
+        """build_model(use_jax_fn=False) routes the same likelihood
+        through the host callable + op.perform (the C/py-linker path);
+        both paths must agree numerically."""
+        demo = shims.demo
+        data, _ = demo.generate_node_data(4, n_obs=32, seed=7)
+        host_model = demo.build_model(data, use_jax_fn=False)
+        jax_model = demo.build_model(data, use_jax_fn=True)
+
+        point = dict(
+            intercept=np.float32(1.4),
+            offsets=np.zeros(4, np.float32),
+            slope=np.float32(2.1),
+            sigma=np.float32(0.6),
+        )
+        # host path: evaluate the recorded Potential graph via perform
+        (pot_host,) = pts.eval_graph(
+            [host_model.potentials[0]],
+            {rv.var: point[rv.name] for rv in host_model.free_rvs},
+        )
+        # jax path: full potential through the jax_funcify lowering
+        jax_logp = jax_model.logp_fn()
+        # isolate the potential on the jax side by rebuilding with the
+        # same point through the compiled graph parts
+        parts_fn = jax_model._compiled_graph_parts()
+        (pot_jax,) = parts_fn(
+            *[point[rv.name] for rv in jax_model.free_rvs]
+        )
+        np.testing.assert_allclose(
+            np.asarray(pot_host), np.asarray(pot_jax), rtol=1e-5
+        )
+        assert np.isfinite(float(jax_logp(
+            _unconstrained(
+                jax_model,
+                intercept=1.4,
+                offsets=np.zeros(4),
+                slope=2.1,
+                log_sigma=np.log(0.6),
+            )
+        )))
+
+
+class TestDriver:
+    def test_main_end_to_end(self, shims):
+        """The full driver: generate data, build the federated model,
+        find_MAP, NUTS — posterior must recover the generating truth
+        (slope 2.0, intercept 1.5)."""
+        idata = shims.demo.main(
+            [
+                "--n-shards", "4",
+                "--n-obs", "48",
+                "--draws", "200",
+                "--tune", "200",
+                "--chains", "2",
+            ]
+        )
+        post = idata.posterior
+        slope = float(post["slope"].median())
+        intercept = float(post["intercept"].median())
+        sigma = float(post["sigma"].median())
+        assert abs(slope - 2.0) < 0.15, slope
+        assert abs(intercept - 1.5) < 0.4, intercept
+        assert 0.3 < sigma < 0.8, sigma
+
+    def test_find_map_recovers_truth(self, shims):
+        demo = shims.demo
+        data, _ = demo.generate_node_data(6, n_obs=64, seed=3)
+        model = demo.build_model(data)
+        with model:
+            import pymc as pm  # the shim, installed by the fixture
+
+            map_est = pm.find_MAP(progressbar=False)
+        assert abs(map_est["slope"] - 2.0) < 0.1, map_est
+        assert abs(map_est["intercept"] - 1.5) < 0.4, map_est
+        assert 0.3 < map_est["sigma"] < 0.8, map_est

@@ -1,10 +1,14 @@
-"""Measure the bridge layer's unexecuted-LoC surface.
+"""Measure the bridge layer's pytensor-gated LoC surface.
 
-"Unexecuted" = lines of CODE (not blanks/comments/docstrings) in
-modules that cannot import in this environment because pytensor/pymc
-are uninstallable — i.e. exactly what only executes review-time here.
-Prints one line per file plus totals; publish the numbers in
-docs/migrating.md when they change.
+These are lines of CODE (not blanks/comments/docstrings) in modules
+that cannot import in this environment because pytensor/pymc are
+uninstallable.  Since round 5 they all EXECUTE under the in-repo API
+shim (tests/pytensor_shim.py + pymc_shim.py inject a minimal fake
+pytensor/pymc and import the real modules) — the "shim-executed by"
+column names the suite.  Shim execution proves our-side logic, not
+real-pytensor compatibility; the distinction is documented in the shim
+docstrings and docs/migrating.md.  Prints one line per file plus
+totals; publish the numbers in docs/migrating.md when they change.
 """
 
 import io
@@ -14,10 +18,20 @@ from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
 
-UNEXECUTED = [
-    "pytensor_federated_tpu/bridge/pytensor_ops.py",
-    "pytensor_federated_tpu/bridge/fusion.py",
-    "pytensor_federated_tpu/demos/demo_pymc.py",
+# (path, shim-executed-by) — empty string = not executed anywhere.
+PYTENSOR_GATED = [
+    (
+        "pytensor_federated_tpu/bridge/pytensor_ops.py",
+        "tests/test_bridge_shim.py",
+    ),
+    (
+        "pytensor_federated_tpu/bridge/fusion.py",
+        "tests/test_bridge_shim.py",
+    ),
+    (
+        "pytensor_federated_tpu/demos/demo_pymc.py",
+        "tests/test_demo_pymc_shim.py",
+    ),
 ]
 EXECUTED_CORES = [
     "pytensor_federated_tpu/bridge/core.py",
@@ -68,11 +82,17 @@ def code_lines(path: Path) -> int:
 
 def main():
     total_un = 0
-    print("# unexecuted (pytensor/pymc-gated) code lines")
-    for rel in UNEXECUTED:
+    total_shim = 0
+    print("# pytensor/pymc-gated code lines (real packages uninstallable)")
+    for rel, shim_suite in PYTENSOR_GATED:
         n = code_lines(REPO / rel)
-        total_un += n
-        print(f"{rel}: {n}")
+        if shim_suite:
+            total_shim += n
+            print(f"{rel}: {n}  [shim-executed by {shim_suite}]")
+        else:
+            total_un += n
+            print(f"{rel}: {n}  [UNEXECUTED]")
+    print(f"TOTAL shim-executed: {total_shim}")
     print(f"TOTAL unexecuted: {total_un}")
     print("# executed pure cores they delegate to")
     total_core = 0
