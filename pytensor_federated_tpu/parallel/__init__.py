@@ -18,8 +18,11 @@ from .federated import (
     federated_sum,
 )
 from .multihost import (
+    HeartbeatServer,
+    detect_dead_peers,
     initialize_multihost,
     make_multihost_mesh,
+    probe_peer,
     remesh_after_failure,
 )
 from .packing import ShardedData, pack_shards
@@ -64,6 +67,9 @@ __all__ = [
     "federated_sum",
     "get_load",
     "healthy_devices",
+    "HeartbeatServer",
+    "detect_dead_peers",
+    "probe_peer",
     "initialize_multihost",
     "make_mesh",
     "make_multihost_mesh",

@@ -19,12 +19,27 @@ TPU-native equivalents:
   policy; re-placement is just constructing a new evaluator (host
   copies of shard data are the recovery source, like the reference's
   stateless nodes re-serving their static private data).
+- **Failure detection**: the reference detects node death IN-BAND — a
+  dropped gRPC stream raises ``StreamTerminatedError`` and the client
+  rebalances (reference: service.py:407-416).  The mesh-level analog is
+  :class:`HeartbeatServer` + :func:`detect_dead_peers`: every process
+  answers a trivial TCP liveness probe, survivors poll their peers,
+  and a peer that refuses N consecutive probes is declared dead — the
+  verdict feeds ``remesh_after_failure(dead_process_ids=...)``.  (The
+  ``jax.distributed`` coordination service has its own missed-heartbeat
+  detector, but surfaces it by SHUTTING THE RUNTIME DOWN, and its
+  client handle is private API — a framework-owned probe keeps
+  detection observable and the survivor alive.)
 """
 
 from __future__ import annotations
 
 import logging
-from typing import Mapping, Optional, Sequence
+import os
+import socket
+import threading
+import time
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -109,11 +124,172 @@ def make_multihost_mesh(
     return make_mesh(shape, devices=devices)
 
 
+class HeartbeatServer:
+    """Answer peer liveness probes: one daemon thread, one TCP accept
+    loop, replies ``alive:<process_index>:<pid>`` and closes.
+
+    The in-band half of the mesh failure-detection story (module
+    docstring): a process that dies — SIGKILL included — stops
+    accepting, and its peers' :func:`detect_dead_peers` probes turn
+    connection-refused within one kernel RST, no launcher or operator
+    in the loop.  Start one per process, before the work loop:
+
+        hb = HeartbeatServer(port=base_port + idx, process_index=idx)
+
+    ``port=0`` picks a free port (then share ``hb.address`` out-of-band
+    or over the coordination KV); a fixed convention like
+    ``base + process_index`` needs no exchange at all.  The default
+    bind is all interfaces — peers on OTHER hosts must be able to
+    reach the probe; pass ``host="127.0.0.1"`` to scope a single-host
+    deployment down.
+
+    ``process_index`` goes into the reply banner so probers can verify
+    they reached the RIGHT peer (a recycled port after a supervisor
+    restart must not impersonate the old incarnation).  It is a plain
+    argument — deliberately NOT read via ``jax.process_index()``,
+    which would force backend initialization from inside a liveness
+    utility (and on a wedged PJRT plugin, hang it; CLAUDE.md).
+    """
+
+    def __init__(
+        self,
+        host: str = "0.0.0.0",
+        port: int = 0,
+        *,
+        process_index: Optional[int] = None,
+    ):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(16)
+        self._sock.settimeout(0.25)  # lets the serve loop see _stop
+        self._stop = threading.Event()
+        idx = -1 if process_index is None else int(process_index)
+        self._reply = f"alive:{idx}:{os.getpid()}".encode()
+        self._thread = threading.Thread(
+            target=self._serve, name="pftpu-heartbeat", daemon=True
+        )
+        self._thread.start()
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        host, port = self._sock.getsockname()[:2]
+        return host, port
+
+    def _serve(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # socket closed under us during stop()
+            try:
+                conn.sendall(self._reply)
+            except OSError:
+                pass  # prober vanished mid-reply: its problem, not ours
+            finally:
+                conn.close()
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+        self._sock.close()
+
+
+def probe_peer(
+    address: Tuple[str, int],
+    *,
+    timeout: float = 1.0,
+    expect_process_index: Optional[int] = None,
+) -> bool:
+    """One liveness probe: connect, read the banner, verdict.
+
+    With ``expect_process_index``, a banner that carries a DIFFERENT
+    index fails the probe: an unrelated service (or another mesh's
+    heartbeat) recycling the port must not impersonate the peer.  A
+    banner index of -1 (server started without ``process_index``)
+    cannot be identity-checked and is accepted on prefix alone.
+    """
+    try:
+        with socket.create_connection(address, timeout=timeout) as s:
+            s.settimeout(timeout)
+            banner = s.recv(64)
+    except OSError:
+        return False
+    if not banner.startswith(b"alive:"):
+        return False
+    if expect_process_index is None:
+        return True
+    try:
+        idx = int(banner.split(b":")[1])
+    except (IndexError, ValueError):
+        return False
+    return idx == -1 or idx == int(expect_process_index)
+
+
+def detect_dead_peers(
+    peers: Mapping[int, Tuple[str, int]],
+    *,
+    timeout: float = 1.0,
+    retries: int = 3,
+    retry_wait: float = 0.5,
+) -> List[int]:
+    """Probe each peer's :class:`HeartbeatServer` CONCURRENTLY; return
+    the process ids that failed ``retries`` consecutive probes (or
+    answered with the wrong identity).
+
+    The reference's failure detection is in-band and per-call
+    (StreamTerminatedError -> rebalance, reference service.py:407-416);
+    here detection is an explicit poll because XLA collectives have no
+    per-call error channel a survivor can observe — a dead peer just
+    hangs the collective.  So the pattern is: probe BETWEEN collective
+    steps, and only enter a collective with peers that answered.
+    Retries absorb transient refusals (a peer mid-restart, a SYN
+    dropped under load); one failed probe is suspicion, ``retries``
+    failures are a verdict.  Peers are probed on separate threads so
+    the sweep costs one worst-case peer, not the sum over dead peers
+    — detection latency must not itself stall the step loop.
+    """
+
+    def verdict(item):
+        pid, addr = item
+        for attempt in range(retries):
+            if probe_peer(
+                addr, timeout=timeout, expect_process_index=pid
+            ):
+                return None
+            if attempt + 1 < retries:
+                time.sleep(retry_wait)
+        _log.warning(
+            "peer %d at %s:%d failed %d consecutive liveness probes: "
+            "declaring dead",
+            pid,
+            addr[0],
+            addr[1],
+            retries,
+        )
+        return pid
+
+    items = sorted(peers.items())
+    if not items:
+        return []
+    if len(items) == 1:
+        results = [verdict(items[0])]
+    else:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=len(items)) as pool:
+            results = list(pool.map(verdict, items))
+    return [pid for pid in results if pid is not None]
+
+
 def remesh_after_failure(
     mesh: Mesh,
     *,
     axis: Optional[str] = None,
     devices: Optional[Sequence[jax.Device]] = None,
+    dead_process_ids: Optional[Sequence[int]] = None,
 ) -> Mesh:
     """Rebuild a mesh over the devices that still respond.
 
@@ -130,29 +306,42 @@ def remesh_after_failure(
     migration is needed (the reference's nodes are stateless for the
     same reason).
 
+    ``dead_process_ids`` carries a DETECTION verdict (from
+    :func:`detect_dead_peers`): those processes' devices are dropped
+    knowingly and silently.  Remaining non-addressable devices — other
+    processes nobody declared dead — still get dropped (local-view
+    recovery, below) but with a warning, because dropping a live peer's
+    devices is only correct if that peer independently rebuilds its own
+    side.
+
     Multi-process scope: recovery is LOCAL-VIEW.  A peer's devices are
     never addressable from this process, so on a mesh spanning several
     processes the rebuilt mesh keeps only THIS process's healthy
     devices — correct in the survivor-after-host-death scenario
     (tests/test_multihost_procs.py), but it means calling this on a
     fully healthy multi-process mesh also drops the other hosts; a
-    warning is logged whenever non-addressable devices are discarded.
-    Rebuilding a new multi-HOST mesh requires the surviving processes
-    to agree out-of-band and re-run :func:`initialize_multihost` +
-    :func:`make_multihost_mesh` with the new process set.
+    warning is logged whenever non-addressable devices are discarded
+    without a detection verdict.  Rebuilding a new multi-HOST mesh
+    requires the surviving processes to agree out-of-band and re-run
+    :func:`initialize_multihost` + :func:`make_multihost_mesh` with the
+    new process set.
     """
     axis = axis or mesh.axis_names[0]
     candidates = (
         list(mesh.devices.flat) if devices is None else list(devices)
     )
+    dead_set = set(dead_process_ids or ())
+    candidates = [
+        d for d in candidates if d.process_index not in dead_set
+    ]
     n_remote = sum(
         1 for d in candidates if d.process_index != jax.process_index()
     )
     if n_remote:
         _log.warning(
             "remesh: dropping %d non-addressable device(s) from other "
-            "processes (local-view recovery; see remesh_after_failure "
-            "docstring)",
+            "processes NOT declared dead (local-view recovery; see "
+            "remesh_after_failure docstring)",
             n_remote,
         )
     alive = healthy_devices(candidates)

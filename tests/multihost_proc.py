@@ -1,33 +1,36 @@
 """Per-process driver for the REAL 2-process ``jax.distributed`` test.
 
 Launched as ``python multihost_proc.py <proc_id> <nprocs> <coord>
-<flag_dir>`` by tests/test_multihost_procs.py (a FILE on purpose:
+<hb_base_port>`` by tests/test_multihost_procs.py (a FILE on purpose:
 spawned children need a ``__main__`` file, and the pytest process must
 never itself call ``jax.distributed.initialize`` — CLAUDE.md).
 
-Phase A (both processes): join the distributed runtime, build the
+Phase A (both processes): join the distributed runtime, start a
+:class:`HeartbeatServer` on ``hb_base_port + proc_id``, build the
 host-spanning mesh (``make_multihost_mesh``), evaluate one psum'd
 federated logp+grad whose shards live on BOTH processes' devices, and
 print the value — the reference's sum-of-node-replies crossing the
 network (reference: service.py:75-115), here a gloo all-reduce over the
 process boundary.
 
-Phase B (survivor only): process 1 exits; the launcher confirms it is
-dead and drops a flag file; process 0 then exercises
-``remesh_after_failure`` on the now half-dead mesh and rebuilds the
+Phase B: process 1 enters a work loop (serving its heartbeat, running
+local evaluations) and the LAUNCHER SIGKILLs it mid-loop — a hard
+kill, no shutdown handshake, no exit path.  Process 0 gets NO hint:
+it first confirms the peer answers liveness probes (``PEER-ALIVE``),
+then polls :func:`detect_dead_peers` until the peer fails three
+consecutive probes (``PEER-DEAD``), and only then exercises
+``remesh_after_failure(dead_process_ids=...)`` and rebuilds the
 federated evaluator over the shrunken mesh from host-resident data,
-checking the SAME logp value comes back (reference failover analog:
-service.py:408-416 drops the dead server and re-sends; SURVEY §7
-step 5).
+checking the SAME logp value comes back.
 
-What phase B proves — precisely: SURVIVOR CONTINUITY.  After a real
-peer death the surviving process's distributed runtime stays usable,
-remesh returns promptly (no hang probing the dead half), and local
-re-jit reproduces the value.  It does NOT prove dead-peer *detection*:
-remesh is local-view (a peer's devices are never addressable from
-here, dead or alive — see ``remesh_after_failure``'s docstring), so
-the same 4-device mesh would come back with the peer still up.  The
-kill is load-bearing for the continuity claim only.
+What this proves: in-band dead-peer DETECTION (the survivor discovers
+the death through the framework's own liveness probes — the mesh
+analog of the reference's StreamTerminatedError -> rebalance,
+service.py:407-416) plus SURVIVOR CONTINUITY (the surviving process's
+runtime stays usable, remesh returns promptly, local re-jit reproduces
+the value).  Still LOCAL-VIEW recovery: the rebuilt mesh holds only
+the survivor's addressable devices (see ``remesh_after_failure``'s
+docstring).
 
 Exits via ``os._exit`` so a dead-peer distributed shutdown barrier in
 atexit cannot hang the test.
@@ -46,14 +49,17 @@ def log(proc_id, msg):
 
 def main():
     proc_id, nprocs = int(sys.argv[1]), int(sys.argv[2])
-    coord, flag_dir = sys.argv[3], sys.argv[4]
+    coord, hb_base = sys.argv[3], int(sys.argv[4])
     sys.path.insert(0, REPO)
     from pytensor_federated_tpu.utils import force_cpu_backend
 
     force_cpu_backend()
     from pytensor_federated_tpu.parallel.multihost import (
+        HeartbeatServer,
+        detect_dead_peers,
         initialize_multihost,
         make_multihost_mesh,
+        probe_peer,
         remesh_after_failure,
     )
 
@@ -66,6 +72,11 @@ def main():
 
     assert n == nprocs, n
     assert len(jax.devices()) == 8 and len(jax.local_devices()) == 4
+
+    hb = HeartbeatServer(
+        "127.0.0.1", hb_base + proc_id, process_index=proc_id
+    )
+    log(proc_id, f"heartbeat on {hb.address[0]}:{hb.address[1]}")
 
     from pytensor_federated_tpu.parallel.packing import pack_shards
     from pytensor_federated_tpu.parallel.sharded import FederatedLogp
@@ -112,20 +123,42 @@ def main():
     log(proc_id, f"PHASE-A OK logp={v:.6f}")
 
     if proc_id != 0:
-        # "Die": hard-exit without any distributed shutdown handshake.
-        os._exit(0)
+        # Work loop: keep computing until the launcher's SIGKILL lands
+        # mid-run.  No exit path exists on purpose — only the kill ends
+        # this process.
+        log(proc_id, "SERVING")
+        while True:
+            fed_local.logp(params)
+            time.sleep(0.1)
 
-    # --- Phase B: survivor. Wait for the launcher to confirm the peer
-    # is dead, then recover on what remains.
+    # --- Phase B: survivor. NO launcher hint — discover the death
+    # through the framework's own liveness probes.
+    peer = {1: ("127.0.0.1", hb_base + 1)}
+
     deadline = time.time() + 60.0
-    flag = os.path.join(flag_dir, "peer_dead")
-    while not os.path.exists(flag):
+    while not probe_peer(peer[1], timeout=0.5):
         if time.time() > deadline:
-            log(0, "FAIL: peer-death flag never appeared")
+            log(0, "FAIL: peer heartbeat never came up")
             os._exit(2)
-        time.sleep(0.1)
+        time.sleep(0.2)
+    log(0, "PEER-ALIVE")
 
-    survivors_mesh = remesh_after_failure(mesh, axis="shards")
+    deadline = time.time() + 120.0
+    while True:
+        dead = detect_dead_peers(
+            peer, timeout=0.5, retries=3, retry_wait=0.3
+        )
+        if dead == [1]:
+            break
+        if time.time() > deadline:
+            log(0, "FAIL: peer death never detected")
+            os._exit(2)
+        time.sleep(0.2)
+    log(0, "PEER-DEAD")
+
+    survivors_mesh = remesh_after_failure(
+        mesh, axis="shards", dead_process_ids=dead
+    )
     n_dev = len(list(survivors_mesh.devices.flat))
     assert n_dev == 4, f"expected the 4 local survivors, got {n_dev}"
     assert survivors_mesh.shape["shards"] == 4
@@ -135,6 +168,7 @@ def main():
     fed2 = FederatedLogp(per_shard_logp, data.tree(), mesh=survivors_mesh)
     v2 = float(fed2.logp(params))
     assert abs(v2 - v_ref) <= 1e-4 * abs(v_ref), (v2, v_ref)
+    hb.stop()
     log(0, f"PHASE-B OK logp={v2:.6f}")
     os._exit(0)
 

