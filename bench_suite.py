@@ -30,6 +30,15 @@ Plus three net-new configs with no reference or BASELINE analog:
 11. the HOST-federation lane: real gRPC + npwire round-trips/s against
     a spawned localhost worker — the surface that is the reference's
     entire hot path, baselined at its structural ~1 ms/call floor.
+12. parallel tempering on a 16-sigma bimodal (the round-4 flagship
+    sampler, previously unbenchmarked): RANK-NORMALIZED cold-chain
+    min-ESS/s + per-chain mode-balance error, baselined against NUTS
+    with overdispersed inits measured in the same run — NUTS provably
+    cannot cross between modes, its rank-normalized ESS collapses when
+    its chains disagree, and its per-chain balance error (~0.5: every
+    chain stuck) is the negative control saying whose ESS to believe
+    (nominal non-rank ESS is deliberately not the metric: a mode-stuck
+    chain fakes it).
 
 Every record carries ``flops_per_eval`` (XLA's exact cost-model count
 of the compiled executable — flopcount.py), achieved ``flops_per_sec``,
@@ -129,7 +138,10 @@ def _bench_serve_node(port):
 
     from pytensor_federated_tpu.service import run_node
 
-    run_node(compute, "127.0.0.1", port)
+    # inline_compute: this compute is ~6 us of numpy — exactly the
+    # documented fast-compute case where the executor handoff would
+    # dominate (docs/performance.md "host lane budget").
+    run_node(compute, "127.0.0.1", port, inline_compute=True)
 
 
 def main():
@@ -184,11 +196,17 @@ def main():
         print(json.dumps(line))
         # Persist INCREMENTALLY and ATOMICALLY: a later assertion
         # failure must not discard completed configs, and a crash
-        # mid-write must not clobber the previous complete file.
-        tmp = "BENCH_SUITE.json.tmp"
+        # mid-write must not clobber the previous complete file.  A
+        # --only run writes a .partial file: a filtered run must never
+        # replace the full record.
+        out = (
+            "BENCH_SUITE.json" if only is None
+            else "BENCH_SUITE.partial.json"
+        )
+        tmp = out + ".tmp"
         with open(tmp, "w") as f:
             json.dump(results, f, indent=1)
-        os.replace(tmp, "BENCH_SUITE.json")
+        os.replace(tmp, out)
 
     def bench_config(config, fn, x0):
         fl = xla_flops_per_eval(fn, x0)
@@ -203,8 +221,18 @@ def main():
     # only after every config's device work has settled, never
     # mid-TPU-call (the wedge scenario, CLAUDE.md).
     failures = []
+    only = None
+    if "--only" in sys.argv:
+        try:
+            only = sys.argv[sys.argv.index("--only") + 1].lower()
+        except IndexError:
+            print("usage: bench_suite.py [--only <config-substring>]",
+                  file=sys.stderr)
+            return 2
 
     def guard(name, fn):
+        if only is not None and only not in name.lower():
+            return
         try:
             fn()
         except Exception:
@@ -791,10 +819,163 @@ def main():
 
     guard("host transport lane", _c11)
 
-    print(
-        f"# wrote BENCH_SUITE.json ({len(results)} configs)",
-        file=sys.stderr,
-    )
+    # 12. Parallel tempering vs NUTS on a well-separated bimodal
+    # (round-4's flagship sampler, round-4 verdict item 5: it had
+    # correctness tests but zero perf artifacts).  Target: an 8-dim
+    # equal mixture of N(-4*1, 0.5^2 I) and N(+4*1, 0.5^2 I) — the
+    # tempering test suite's 16-sigma positive control scaled up
+    # (tests/test_tempering.py:20-27).
+    #
+    # Metric design: a mode-stuck sampler's NOMINAL ESS is a lie — a
+    # chain that never leaves one mode looks beautifully mixed to a
+    # plain ESS estimator.  So the rated quantity is RANK-NORMALIZED
+    # split min-ESS/s (the standard multimodality-aware diagnostic:
+    # chains stuck in different modes collapse it toward zero), NUTS
+    # gets the textbook-correct setup (overdispersed inits covering
+    # both modes, 4 chains), and the per-chain mode-balance error —
+    # each chain's own |P(right mode) - 1/2|, max over chains — is the
+    # negative control proving WHY its rank-ESS collapses: every NUTS
+    # chain is stuck (~0.5) while every PT cold chain mixes (~0).
+    # ESS/s normalizes by wall time, so the budget comparison is
+    # inherent in the unit.
+    def _c12():
+        from pytensor_federated_tpu.samplers import sample
+        from pytensor_federated_tpu.samplers.tempering import pt_sample
+
+        dim = 8
+        sep, width = 4.0, 0.5
+
+        def mix_logp(params):
+            x = params["x"]
+            la = -0.5 * jnp.sum(((x + sep) / width) ** 2)
+            lb = -0.5 * jnp.sum(((x - sep) / width) ** 2)
+            return jnp.logaddexp(la, lb)
+
+        n_warm, n_draws = 500, 1000
+        n_leapfrog, n_temps, n_stacks = 8, 8, 2  # shared w/ FLOP count
+        init = {"x": jnp.zeros(dim)}
+
+        def run_pt(seed):
+            return pt_sample(
+                mix_logp,
+                init,
+                key=jax.random.PRNGKey(seed),
+                num_warmup=n_warm,
+                num_samples=n_draws,
+                num_temps=n_temps,
+                beta_min=0.01,
+                num_chains=n_stacks,
+                num_leapfrog=n_leapfrog,
+            )
+
+        def run_nuts(seed):
+            # jitter=5: inits overdispersed across both basins — the
+            # best practice a migrating user would follow.  NUTS still
+            # cannot CROSS between modes; overdispersion just ensures
+            # the chains disagree so rank-normalized ESS exposes it.
+            return sample(
+                mix_logp,
+                init,
+                key=jax.random.PRNGKey(seed),
+                num_warmup=n_warm,
+                num_samples=n_draws,
+                num_chains=4,
+                jitter=5.0,
+            )
+
+        def per_chain_balance_error(draws):
+            # draws: (chains, draws, dim); a draw's mode is the sign of
+            # its mean coordinate (modes sit at +/- sep * ones).  Max
+            # over chains: ONE stuck chain is a failed sampler.
+            side = np.asarray(draws).mean(axis=-1) > 0  # (chains, draws)
+            per_chain = np.abs(side.mean(axis=1) - 0.5)
+            return float(per_chain.max())
+
+        def rank_min_ess_rate(res, wall):
+            summ = res.summary(rank_normalized=True)
+            ess = float(
+                min(np.min(np.asarray(v)) for v in summ["ess"].values())
+            )
+            return ess / wall, float(np.asarray(summ["rhat"]["x"]).max())
+
+        # cold (compile) then warm (rated) — the suite convention.
+        res_pt = run_pt(0)
+        jax.block_until_ready(res_pt.samples)
+        t0 = time.perf_counter()
+        res_pt = run_pt(1)
+        jax.block_until_ready(res_pt.samples)
+        wall_pt = time.perf_counter() - t0
+        pt_ess_rate, pt_rhat = rank_min_ess_rate(res_pt, wall_pt)
+        pt_balance = per_chain_balance_error(res_pt.samples["x"])
+
+        res_n = run_nuts(0)
+        jax.block_until_ready(res_n.samples)
+        t0 = time.perf_counter()
+        res_n = run_nuts(1)
+        jax.block_until_ready(res_n.samples)
+        wall_n = time.perf_counter() - t0
+        nuts_ess_rate, nuts_rhat = rank_min_ess_rate(res_n, wall_n)
+        nuts_balance = per_chain_balance_error(res_n.samples["x"])
+
+        # FLOP accounting: each tempering iteration costs num_leapfrog
+        # HMC gradients per rung per stack; grads/s is a draw-phase
+        # lower bound (warmup excluded from the count, included in
+        # wall — same convention as configs 8/9).
+        fn12, x12 = _flat_fn(mix_logp, init)
+        fl12 = xla_flops_per_eval(fn12, x12)
+        grads = float(n_leapfrog * n_temps * n_stacks * n_draws)
+        # Integrity guard on the hand-rolled timing (CLAUDE.md: every
+        # rate must carry one — the chip can return without executing,
+        # collapsing wall_pt to an impossible rate).
+        physics_gate(fl12, grads / wall_pt)
+        pt_mfu = mfu_fields(fl12, grads / wall_pt)
+        record(
+            "16-sigma bimodal: parallel tempering vs NUTS",
+            pt_ess_rate,
+            unit="rank-normalized min-ESS/s",
+            baseline_rate=nuts_ess_rate,
+            baseline_desc=(
+                f"NUTS rank-normalized min-ESS/s, same run, "
+                f"overdispersed inits ({nuts_ess_rate:.2f}; its "
+                f"max rhat {nuts_rhat:.2f}) — mode-stuck by "
+                f"construction: per-chain balance error "
+                f"{nuts_balance:.3f} vs PT's {pt_balance:.3f} "
+                "(the negative control)"
+            ),
+            wall_s=round(wall_pt, 2),
+            mode_balance_error=round(pt_balance, 4),
+            nuts_mode_balance_error=round(nuts_balance, 4),
+            max_rhat=round(pt_rhat, 4),
+            note="flops_per_eval is per leapfrog GRADIENT (value is "
+            "ESS/s); grads/s and mfu are draw-phase lower bounds; "
+            "nominal (non-rank) ESS is deliberately NOT the metric — "
+            "a mode-stuck chain fakes it",
+            **pt_mfu,
+        )
+        # The claims the config exists to make, enforced: every PT
+        # cold chain visits both modes near 50/50; every NUTS chain is
+        # stuck in one.
+        assert pt_balance < 0.15, f"PT mode balance off: {pt_balance}"
+        assert nuts_balance > 0.35, (
+            f"negative control failed: NUTS balance {nuts_balance}"
+        )
+
+    guard("parallel tempering bimodal", _c12)
+
+    if results:
+        print(
+            "# wrote "
+            + ("BENCH_SUITE.json" if only is None
+               else "BENCH_SUITE.partial.json")
+            + f" ({len(results)} configs)",
+            file=sys.stderr,
+        )
+    else:
+        print(
+            f"# NO configs matched --only {only!r}: nothing written",
+            file=sys.stderr,
+        )
+        return 2
     if failures:
         print(
             f"# {len(failures)} config(s) FAILED: {failures}",

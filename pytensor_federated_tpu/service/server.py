@@ -77,6 +77,7 @@ class ArraysToArraysService:
         compute_fn: Callable[..., Sequence[np.ndarray]],
         *,
         getload_wire: str = "npwire",
+        inline_compute: bool = False,
     ):
         """``getload_wire``: "npwire" (JSON reply, this package's
         native clients) or "npproto" (reference ``GetLoadResult``
@@ -84,13 +85,26 @@ class ArraysToArraysService:
         and the stream need no such switch — their request payload
         identifies the wire and the reply mirrors it — but GetLoad's
         request is EMPTY in both schemas, so the reply format is a
-        node-level choice."""
+        node-level choice.
+
+        ``inline_compute``: run ``compute_fn`` directly on the event
+        loop instead of in a thread executor.  The executor exists so
+        a SLOW compute cannot stall GetLoad and other streams (the
+        reference pays the same structure via its event loop +
+        ``run_in_executor``-free design, but it is single-stream); for
+        a sub-millisecond compute the two thread handoffs cost more
+        than the compute — measured ~1.4x sync-client and up to ~2x
+        async-client round-trip throughput on the localhost lane
+        (docs/performance.md "Host lane budget") — so nodes serving
+        fast jitted evals should pass True.  A compute that blocks for
+        long stretches must keep the default."""
         if getload_wire not in ("npwire", "npproto"):
             raise ValueError(
                 f"getload_wire must be 'npwire' or 'npproto', "
                 f"got {getload_wire!r}"
             )
         self.getload_wire = getload_wire
+        self.inline_compute = bool(inline_compute)
         self.compute_fn = compute_fn
         self._n_clients = 0
         # Start psutil's interval-based CPU accounting early so the
@@ -136,10 +150,15 @@ class ArraysToArraysService:
         else:
             inputs, proto_uuid = npproto_codec.decode_arrays_msg(request)
         try:
-            loop = asyncio.get_running_loop()
-            outputs = await loop.run_in_executor(
-                None, lambda: list(self.compute_fn(*inputs))
-            )
+            if self.inline_compute:
+                # Fast-compute path: the two thread handoffs of the
+                # executor dominate a sub-ms compute (docs/performance.md).
+                outputs = list(self.compute_fn(*inputs))
+            else:
+                loop = asyncio.get_running_loop()
+                outputs = await loop.run_in_executor(
+                    None, lambda: list(self.compute_fn(*inputs))
+                )
             outputs = [np.asarray(o) for o in outputs]
         except Exception as e:
             _log.exception("compute_fn failed")
@@ -223,6 +242,7 @@ async def serve(
     port: int = 50000,
     *,
     getload_wire: str = "npwire",
+    inline_compute: bool = False,
     service: Optional[ArraysToArraysService] = None,
 ) -> grpc.aio.Server:
     """Start a node server (reference: demo_node.py:76-79).  Returns the
@@ -236,7 +256,9 @@ async def serve(
         if compute_fn is None:
             raise ValueError("pass compute_fn or a pre-built service")
         service = ArraysToArraysService(
-            compute_fn, getload_wire=getload_wire
+            compute_fn,
+            getload_wire=getload_wire,
+            inline_compute=inline_compute,
         )
     elif compute_fn is not None:
         raise ValueError(
@@ -257,16 +279,21 @@ def run_node(
     port: int = 50000,
     *,
     getload_wire: str = "npwire",
+    inline_compute: bool = False,
 ) -> None:
     """Blocking single-node entry point (reference: demo_node.py:83-95).
 
     ``getload_wire="npproto"`` serves reference-format GetLoad replies
     so UNMODIFIED reference clients can balance over this node
-    (Evaluate/EvaluateStream auto-detect per request either way)."""
+    (Evaluate/EvaluateStream auto-detect per request either way).
+    ``inline_compute=True`` skips the per-call thread-executor handoff
+    for sub-ms compute fns (see ArraysToArraysService)."""
 
     async def main():
         server = await serve(
-            compute_fn, bind, port, getload_wire=getload_wire
+            compute_fn, bind, port,
+            getload_wire=getload_wire,
+            inline_compute=inline_compute,
         )
         await server.wait_for_termination()
 

@@ -173,6 +173,50 @@ def test_get_load_rejects_garbled_replies():
     assert loads[len(garbled) + 1]["n_clients"] == 2
 
 
+def test_inline_compute_roundtrip_and_error():
+    """inline_compute=True serves the same contract as the executor
+    path — results AND the error-in-reply encoding (a failing compute
+    must not tear down the stream)."""
+    import grpc
+
+    from pytensor_federated_tpu.service import ArraysToArraysServiceClient
+    from pytensor_federated_tpu.service.server import (
+        ArraysToArraysService,
+        serve,
+    )
+
+    calls = {"n": 0}
+
+    def compute(x):
+        calls["n"] += 1
+        if np.asarray(x).shape == (1,):
+            raise ValueError("shard refused")
+        return [np.asarray(-np.sum(np.asarray(x) ** 2))]
+
+    async def main():
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        service = ArraysToArraysService(compute, inline_compute=True)
+        server = await serve(None, "127.0.0.1", port, service=service)
+        try:
+            client = ArraysToArraysServiceClient("127.0.0.1", port)
+            out = await client.evaluate_async(np.array([1.0, 2.0]))
+            np.testing.assert_allclose(float(np.asarray(out[0])), -5.0)
+            with pytest.raises(RuntimeError, match="shard refused"):
+                await client.evaluate_async(np.zeros(1))
+            # stream survived the error: next call still works
+            out = await client.evaluate_async(np.array([3.0, 0.0]))
+            np.testing.assert_allclose(float(np.asarray(out[0])), -9.0)
+        finally:
+            await server.stop(None)
+
+    asyncio.run(main())
+    assert calls["n"] >= 3  # compute really ran inline in-process
+
+
 def test_balanced_connect_picks_idle_server(node_pool):
     """With a client camped on one server, a new client must connect to
     another (reference: test_service.py:144-177)."""
