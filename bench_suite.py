@@ -89,13 +89,15 @@ def physics_gate(flops_per_eval, rate):
         )
 
 
-def _rate(fn_flat, flat0, **sizing):
+def _rate(fn_flat, flat0, *, unroll=8, **sizing):
     # Same two-stage sizing as the driver metric (bench.measure_rate),
     # with lighter floors/targets so the suite stays quick.  One
     # compile per config (dynamic trip count serves all three stages).
     kw = dict(n_cal=500, floor=2_000, mid_wall=0.3, target_wall=1.0)
     kw.update(sizing)
-    r, n, _wall = measure_rate(make_chained(fn_flat), flat0, **kw)
+    r, n, _wall = measure_rate(
+        make_chained(fn_flat, unroll=unroll), flat0, **kw
+    )
     return r, n
 
 
@@ -320,17 +322,35 @@ def main():
                 )
         fl_eval5 = xla_flops_per_eval(fn5, x5)
         best5 = {"rate": -1.0}
-        for name, fn in {
-            "vmapped": fn5,
-            "suffstats": fn5s,
-            "flat": fn5f,
-        }.items():
+        impls5 = {"vmapped": fn5, "suffstats": fn5s, "flat": fn5f}
+        for name, fn in impls5.items():
             fl = fl_eval5 if fn is fn5 else xla_flops_per_eval(fn, x5)
             r, n = _rate(fn, x5)
             print(f"# 64-shard logistic impl {name}: {r:,.1f} evals/s",
                   file=sys.stderr)
             if r > best5["rate"]:
                 best5 = {"name": name, "rate": r, "n": n, "fl": fl}
+        # The while-loop's per-iteration overhead is a live candidate
+        # for this config's cap (bench.py's flagship u32 reasoning):
+        # race a 32x-unrolled chain of the u8 WINNER only — one extra
+        # fresh compile, not three; on the tunneled TPU each fresh
+        # compile costs 20-40 s of capture window and one more
+        # exposure to a remote-compile outage (CLAUDE.md round-3
+        # findings).  Numerics identical by make_chained's contract;
+        # FLOPs accounted via the same base fn.
+        r32, n32 = _rate(impls5[best5["name"]], x5, unroll=32)
+        print(
+            f"# 64-shard logistic impl {best5['name']}-u32: "
+            f"{r32:,.1f} evals/s",
+            file=sys.stderr,
+        )
+        if r32 > best5["rate"]:
+            best5 = {
+                "name": best5["name"] + "-u32",
+                "rate": r32,
+                "n": n32,
+                "fl": best5["fl"],
+            }
         record(
             "64-shard federated logistic regression (logp+grad)",
             best5["rate"],
