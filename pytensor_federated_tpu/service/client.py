@@ -322,9 +322,9 @@ class ArraysToArraysServiceClient:
         )
         return await method(request)
 
-    async def evaluate_async(self, *arrays: np.ndarray) -> List[np.ndarray]:
-        """Evaluate with retry-and-rebalance failover
-        (reference: evaluate_async, service.py:376-423)."""
+    def _encode_request(self, arrays):
+        """(request_bytes, uuid, decode) for one call under the active
+        codec; ``decode`` returns ``(outputs, uuid, error)``."""
         arrays = [np.asarray(a) for a in arrays]
         if self.codec == "npproto":
             from . import npproto_codec
@@ -339,6 +339,27 @@ class ArraysToArraysServiceClient:
             uuid = uuid_mod.uuid4().bytes
             request = encode_arrays(arrays, uuid=uuid)
             decode = decode_arrays
+        return request, uuid, decode
+
+    async def _validate_reply(self, reply, uuid, decode):
+        """Single-sourced reply validation: returns ``(outputs,
+        error_msg)``.  The error check runs FIRST (error replies carry a
+        zero uuid); a uuid mismatch — a desynchronized lock-step stream
+        (e.g. a previous call cancelled between write and read) stays
+        off-by-one forever — drops the connection so the next call
+        reconnects cleanly, then raises."""
+        outputs, reply_uuid, error = decode(reply)
+        if error is None and reply_uuid != uuid:
+            await self._drop_privates()
+            raise RuntimeError(
+                "uuid mismatch: response does not correlate with request"
+            )
+        return outputs, error
+
+    async def evaluate_async(self, *arrays: np.ndarray) -> List[np.ndarray]:
+        """Evaluate with retry-and-rebalance failover
+        (reference: evaluate_async, service.py:376-423)."""
+        request, uuid, decode = self._encode_request(arrays)
         last_exc: Optional[BaseException] = None
         for _ in range(self.retries + 1):
             try:
@@ -347,17 +368,9 @@ class ArraysToArraysServiceClient:
                 last_exc = e
                 await self._drop_privates()
                 continue
-            outputs, reply_uuid, error = decode(reply)
+            outputs, error = await self._validate_reply(reply, uuid, decode)
             if error is not None:
                 raise RuntimeError(f"server error: {error}")
-            if reply_uuid != uuid:
-                # A desynchronized lock-step stream (e.g. a previous call
-                # cancelled between write and read) stays off-by-one
-                # forever — drop it so the next call reconnects cleanly.
-                await self._drop_privates()
-                raise RuntimeError(
-                    "uuid mismatch: response does not correlate with request"
-                )
             return outputs
         raise (
             last_exc
@@ -369,3 +382,148 @@ class ArraysToArraysServiceClient:
         """Sync wrapper (reference: evaluate, service.py:371-374)."""
         loop = get_event_loop()
         return loop.run_until_complete(self.evaluate_async(*arrays))
+
+    # -- pipelined batch evaluation --------------------------------------
+
+    async def _evaluate_many_once(
+        self, encoded, window: int
+    ) -> List[List[np.ndarray]]:
+        """One pipelined pass over the current connection.
+
+        Stream mode: keep up to ``window`` requests in flight on the
+        lock-step stream and read replies in order — the server
+        guarantees FIFO (one reply per request, in order,
+        server.py:evaluate_stream), so client serialize, both network
+        legs, and server decode/compute overlap instead of paying the
+        full round-trip per call.  Unary mode: ``window``-sized
+        ``asyncio.gather`` chunks over HTTP/2 multiplexing.
+
+        A SERVER-SIDE error reply must not poison the stream for later
+        calls: the remaining in-flight replies are drained (count-only)
+        before the error raises, so the lock-step correlation survives.
+        """
+        privates = await self._get_privates()
+        n = len(encoded)
+        results: List[Optional[List[np.ndarray]]] = [None] * n
+        if privates.stream is None:
+            method = privates.channel.unary_unary(
+                EVALUATE,
+                request_serializer=_identity,
+                response_deserializer=_identity,
+            )
+            for start in range(0, n, window):
+                chunk = encoded[start : start + window]
+                replies = await asyncio.gather(
+                    *(method(req) for req, _u, _d in chunk)
+                )
+                for k, (reply, (_req, uuid, decode)) in enumerate(
+                    zip(replies, chunk)
+                ):
+                    outputs, error = await self._validate_reply(
+                        reply, uuid, decode
+                    )
+                    if error is not None:
+                        raise RuntimeError(f"server error: {error}")
+                    results[start + k] = outputs
+            return results  # type: ignore[return-value]
+
+        stream = privates.stream
+        # Flow-control guard: a client that keeps WRITING while never
+        # reading can deadlock against HTTP/2 stream windows when the
+        # in-flight bytes exceed the transport's credit (client stuck
+        # in write -> never reads -> server's replies never drain ->
+        # server never reads the next request).  Capping in-flight
+        # REQUEST bytes well under the 64 KiB minimum initial stream
+        # window keeps every write completable, so the loop always
+        # reaches read(); a single oversized request still proceeds
+        # alone (the write_idx == read_idx disjunct) in plain lock-step,
+        # which is the proven-safe per-call mode.
+        max_inflight_bytes = 32 * 1024
+        write_idx = read_idx = 0
+        inflight_bytes = 0
+        try:
+            while read_idx < n:
+                while write_idx < n and (
+                    write_idx == read_idx
+                    or (
+                        write_idx - read_idx < window
+                        and inflight_bytes + len(encoded[write_idx][0])
+                        <= max_inflight_bytes
+                    )
+                ):
+                    await stream.write(encoded[write_idx][0])
+                    inflight_bytes += len(encoded[write_idx][0])
+                    write_idx += 1
+                reply = await stream.read()
+                if reply is grpc.aio.EOF:
+                    raise ConnectionError("stream closed by server")
+                _req, uuid, decode = encoded[read_idx]
+                inflight_bytes -= len(_req)
+                outputs, error = await self._validate_reply(
+                    reply, uuid, decode
+                )
+                if error is not None:
+                    # Drain in-flight replies so the stream stays
+                    # correlated for the NEXT call, then surface the
+                    # deterministic server error (no retry — same
+                    # policy as evaluate_async).
+                    for _ in range(write_idx - read_idx - 1):
+                        drained = await stream.read()
+                        if drained is grpc.aio.EOF:
+                            break
+                    raise RuntimeError(f"server error: {error}")
+                results[read_idx] = outputs
+                read_idx += 1
+        except (grpc.aio.AioRpcError, ConnectionError, OSError):
+            await self._drop_privates()
+            raise
+        return results  # type: ignore[return-value]
+
+    async def evaluate_many_async(
+        self, requests: Sequence[Sequence[np.ndarray]], *, window: int = 8
+    ) -> List[List[np.ndarray]]:
+        """Pipelined evaluation of MANY argument tuples on one node.
+
+        The reference's stream protocol is strictly one-in-flight
+        (lock-step write/read per call, reference: service.py:150-158),
+        which prices every call at a full round-trip.  The wire itself
+        is FIFO, so this client keeps ``window`` requests in flight and
+        overlaps the pipeline stages — a throughput mode the
+        reference's design cannot express, measured 1.7-3x the per-call
+        rate on the localhost lane depending on machine throttle state
+        (the suite artifact and an idle-machine sweep; docs/
+        performance.md "Host lane budget").
+
+        All-or-nothing TRANSPORT failover: on connection failure the
+        whole batch retries on a freshly balanced connection
+        (per-result partial retry would reorder effects on a stateful
+        node).  Server-side compute errors raise without retry, like
+        :meth:`evaluate_async`, and leave the connection usable.
+        """
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        encoded = [self._encode_request(args) for args in requests]
+        if not encoded:
+            return []
+        last_exc: Optional[BaseException] = None
+        for _ in range(self.retries + 1):
+            try:
+                return await self._evaluate_many_once(encoded, window)
+            except (grpc.aio.AioRpcError, ConnectionError, OSError) as e:
+                last_exc = e
+                await self._drop_privates()
+                continue
+        raise (
+            last_exc
+            if last_exc is not None
+            else ConnectionError("batch evaluation failed")
+        )
+
+    def evaluate_many(
+        self, requests: Sequence[Sequence[np.ndarray]], *, window: int = 8
+    ) -> List[List[np.ndarray]]:
+        """Sync wrapper over :meth:`evaluate_many_async`."""
+        loop = get_event_loop()
+        return loop.run_until_complete(
+            self.evaluate_many_async(requests, window=window)
+        )

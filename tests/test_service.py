@@ -173,6 +173,124 @@ def test_get_load_rejects_garbled_replies():
     assert loads[len(garbled) + 1]["n_clients"] == 2
 
 
+class TestEvaluateMany:
+    """Pipelined batch evaluation: the windowed throughput mode the
+    reference's one-in-flight lock-step design cannot express
+    (reference: service.py:150-158)."""
+
+    def test_matches_sequential(self, node_pool):
+        ports, _ = node_pool
+        client = ArraysToArraysServiceClient("127.0.0.1", ports[0])
+        reqs = [(np.array([float(i), float(2 * i)]),) for i in range(23)]
+        batch = client.evaluate_many(reqs, window=7)
+        assert len(batch) == 23
+        for args, out in zip(reqs, batch):
+            seq = client.evaluate(*args)
+            for a, b in zip(seq, out):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+    def test_empty_batch(self, node_pool):
+        ports, _ = node_pool
+        client = ArraysToArraysServiceClient("127.0.0.1", ports[0])
+        assert client.evaluate_many([]) == []
+        with pytest.raises(ValueError, match="window"):
+            client.evaluate_many([(np.zeros(1),)], window=0)
+
+    def test_unary_mode_batch(self, node_pool):
+        ports, _ = node_pool
+        client = ArraysToArraysServiceClient(
+            "127.0.0.1", ports[0], use_stream=False
+        )
+        reqs = [(np.array([float(i)]),) for i in range(9)]
+        batch = client.evaluate_many(reqs, window=4)
+        assert len(batch) == 9
+        ref = client.evaluate(*reqs[3])
+        np.testing.assert_allclose(
+            np.asarray(batch[3][0]), np.asarray(ref[0])
+        )
+
+    def test_large_messages_degrade_to_lockstep(self, node_pool):
+        """Requests bigger than the in-flight byte cap must still
+        complete (one at a time) — the cap exists so HTTP/2 flow
+        control can never deadlock a write-only burst."""
+        ports, _ = node_pool
+        client = ArraysToArraysServiceClient("127.0.0.1", ports[0])
+        big = np.linspace(0.0, 1.0, 50_000).astype(np.float32)  # 200 KB
+        reqs = [(big + i,) for i in range(3)]
+        batch = client.evaluate_many(reqs, window=8)
+        assert len(batch) == 3
+        ref = client.evaluate(*reqs[1])
+        np.testing.assert_allclose(
+            np.asarray(batch[1][0]), np.asarray(ref[0])
+        )
+
+    def test_midbatch_server_error_leaves_stream_usable(self):
+        """A compute error inside a pipelined batch raises, but the
+        drained stream stays correlated: the NEXT call still works."""
+        import socket
+
+        import grpc
+
+        from pytensor_federated_tpu.service.server import (
+            ArraysToArraysService,
+            serve,
+        )
+
+        def compute(x):
+            x = np.asarray(x)
+            if x.shape == (2,):
+                raise ValueError("poison shape")
+            return [np.asarray(float(np.sum(x)))]
+
+        async def main():
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                port = s.getsockname()[1]
+            service = ArraysToArraysService(compute, inline_compute=True)
+            server = await serve(None, "127.0.0.1", port, service=service)
+            try:
+                client = ArraysToArraysServiceClient("127.0.0.1", port)
+                reqs = [
+                    (np.ones(1),),
+                    (np.ones(2),),  # poison: mid-batch error
+                    (np.ones(3),),
+                    (np.ones(4),),
+                ]
+                with pytest.raises(RuntimeError, match="poison shape"):
+                    await client.evaluate_many_async(reqs, window=4)
+                # stream survived and stayed correlated
+                out = await client.evaluate_async(np.ones(5))
+                np.testing.assert_allclose(float(np.asarray(out[0])), 5.0)
+                # and a clean batch works end-to-end afterwards
+                ok = await client.evaluate_many_async(
+                    [(np.ones(1),), (np.ones(3),)], window=2
+                )
+                np.testing.assert_allclose(float(np.asarray(ok[1][0])), 3.0)
+            finally:
+                await server.stop(None)
+
+        asyncio.run(main())
+
+    def test_batch_failover_to_surviving_server(self, node_pool):
+        """Transport failover is all-or-nothing: kill the connected
+        server mid-session; the next batch lands on a survivor."""
+        ports, procs = node_pool
+        client = ArraysToArraysServiceClient(
+            hosts_and_ports=[("127.0.0.1", p) for p in ports]
+        )
+        first = client.evaluate_many([(np.zeros(2),)])
+        assert len(first) == 1
+        victim_port = _conn_of(client).port
+        victim = procs[ports.index(victim_port)]
+        victim.terminate()
+        victim.join(timeout=10)
+        batch = client.evaluate_many(
+            [(np.array([1.0, 2.0]),) for _ in range(5)], window=3
+        )
+        assert len(batch) == 5
+        assert _conn_of(client).port != victim_port
+
+
 def test_inline_compute_roundtrip_and_error():
     """inline_compute=True serves the same contract as the executor
     path — results AND the error-in-reply encoding (a failing compute
