@@ -413,9 +413,17 @@ class ArraysToArraysServiceClient:
             )
             for start in range(0, n, window):
                 chunk = encoded[start : start + window]
+                # return_exceptions: every sibling RPC settles before we
+                # raise, so a failing chunk never leaves orphan tasks
+                # whose channel _drop_privates then closes under them
+                # ("Task exception was never retrieved" spam).
                 replies = await asyncio.gather(
-                    *(method(req) for req, _u, _d in chunk)
+                    *(method(req) for req, _u, _d in chunk),
+                    return_exceptions=True,
                 )
+                for reply in replies:
+                    if isinstance(reply, BaseException):
+                        raise reply
                 for k, (reply, (_req, uuid, decode)) in enumerate(
                     zip(replies, chunk)
                 ):

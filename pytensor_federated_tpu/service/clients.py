@@ -39,6 +39,36 @@ class LogpServiceClient:
 
         return get_event_loop().run_until_complete(self.evaluate_async(*inputs))
 
+    async def evaluate_many_async(
+        self, requests: Sequence[Sequence[np.ndarray]], *, window: int = 8
+    ) -> List[np.ndarray]:
+        """Pipelined batch of logp evaluations (one scalar each) —
+        :meth:`ArraysToArraysServiceClient.evaluate_many_async` with
+        this adapter's shape contract applied per reply.  The batch
+        shape fits vectorized consumers (SMC particle weights, ensemble
+        proposals) that score many points against one node."""
+        requests = list(requests)  # a one-shot iterable must survive
+        batches = await self._client.evaluate_many_async(
+            requests, window=window
+        )
+        out = []
+        for outputs in batches:
+            if len(outputs) != 1 or np.shape(outputs[0]) != ():
+                raise RuntimeError(
+                    "logp node must return exactly one scalar array"
+                )
+            out.append(outputs[0])
+        return out
+
+    def evaluate_many(
+        self, requests: Sequence[Sequence[np.ndarray]], *, window: int = 8
+    ) -> List[np.ndarray]:
+        from ..utils import get_event_loop
+
+        return get_event_loop().run_until_complete(
+            self.evaluate_many_async(requests, window=window)
+        )
+
     __call__ = evaluate
 
 
@@ -66,5 +96,41 @@ class LogpGradServiceClient:
         from ..utils import get_event_loop
 
         return get_event_loop().run_until_complete(self.evaluate_async(*inputs))
+
+    async def evaluate_many_async(
+        self, requests: Sequence[Sequence[np.ndarray]], *, window: int = 8
+    ) -> List[Tuple[np.ndarray, List[np.ndarray]]]:
+        """Pipelined batch of (logp, grads) evaluations — see
+        :meth:`LogpServiceClient.evaluate_many_async`."""
+        # Materialize BEFORE forwarding: a one-shot iterable would be
+        # consumed by the inner client's encode pass and the zip below
+        # would silently drop every result.
+        requests = list(requests)
+        batches = await self._client.evaluate_many_async(
+            requests, window=window
+        )
+        out = []
+        for args, outputs in zip(requests, batches):
+            if len(outputs) != 1 + len(args):
+                raise RuntimeError(
+                    f"logp+grad node must return 1 + {len(args)} arrays, "
+                    f"got {len(outputs)}"
+                )
+            logp, *grads = outputs
+            if np.shape(logp) != ():
+                raise RuntimeError(
+                    f"logp must be scalar, got shape {np.shape(logp)}"
+                )
+            out.append((logp, grads))
+        return out
+
+    def evaluate_many(
+        self, requests: Sequence[Sequence[np.ndarray]], *, window: int = 8
+    ) -> List[Tuple[np.ndarray, List[np.ndarray]]]:
+        from ..utils import get_event_loop
+
+        return get_event_loop().run_until_complete(
+            self.evaluate_many_async(requests, window=window)
+        )
 
     __call__ = evaluate

@@ -271,6 +271,23 @@ class TestEvaluateMany:
 
         asyncio.run(main())
 
+    def test_adapter_batch_shapes(self, node_pool):
+        """The typed adapters apply their shape contracts per batched
+        reply (vectorized SMC/ensemble consumers)."""
+        ports, _ = node_pool
+        client = LogpGradServiceClient("127.0.0.1", ports[0])
+        reqs = [(np.array([float(i), 1.0]),) for i in range(7)]
+        batch = client.evaluate_many(reqs, window=3)
+        assert len(batch) == 7
+        for (args,), (logp, grads) in zip(reqs, batch):
+            assert np.shape(logp) == ()
+            assert len(grads) == 1
+            ref_logp, ref_grads = -np.sum((args - 3.0) ** 2), -2.0 * (
+                args - 3.0
+            )
+            np.testing.assert_allclose(float(logp), ref_logp)
+            np.testing.assert_allclose(np.asarray(grads[0]), ref_grads)
+
     def test_batch_failover_to_surviving_server(self, node_pool):
         """Transport failover is all-or-nothing: kill the connected
         server mid-session; the next batch lands on a survivor."""
@@ -281,14 +298,21 @@ class TestEvaluateMany:
         first = client.evaluate_many([(np.zeros(2),)])
         assert len(first) == 1
         victim_port = _conn_of(client).port
-        victim = procs[ports.index(victim_port)]
+        idx = ports.index(victim_port)
+        victim = procs[idx]
         victim.terminate()
         victim.join(timeout=10)
-        batch = client.evaluate_many(
-            [(np.array([1.0, 2.0]),) for _ in range(5)], window=3
-        )
-        assert len(batch) == 5
-        assert _conn_of(client).port != victim_port
+        try:
+            batch = client.evaluate_many(
+                [(np.array([1.0, 2.0]),) for _ in range(5)], window=3
+            )
+            assert len(batch) == 5
+            assert _conn_of(client).port != victim_port
+        finally:
+            # Respawn the victim: the pool is module-scoped and later
+            # tests connect to this port directly.
+            procs[idx] = _spawn_nodes([victim_port])[0]
+            wait_nodes_up([victim_port], timeout=30)
 
 
 def test_inline_compute_roundtrip_and_error():
