@@ -12,6 +12,7 @@ from .convergence import (
 )
 from .arviz_export import to_dataset_dict, to_inference_data
 from .chees import chees_sample
+from .elastic import elastic_sample
 from .tempering import pt_sample
 from .model_comparison import (
     compare,
@@ -80,6 +81,7 @@ __all__ = [
     "metropolis_step",
     "nuts_step",
     "chees_sample",
+    "elastic_sample",
     "pt_sample",
     "compare",
     "to_dataset_dict",

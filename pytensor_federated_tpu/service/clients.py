@@ -23,8 +23,10 @@ class LogpServiceClient:
     def __init__(self, *args, **kwargs):
         self._client = ArraysToArraysServiceClient(*args, **kwargs)
 
-    async def evaluate_async(self, *inputs: np.ndarray) -> np.ndarray:
-        outputs = await self._client.evaluate_async(*inputs)
+    @staticmethod
+    def _check_reply(outputs) -> np.ndarray:
+        """The node's shape contract, single-sourced for the sync and
+        batch paths."""
         if len(outputs) != 1:
             raise RuntimeError(
                 f"logp node must return exactly one array, got {len(outputs)}"
@@ -33,6 +35,11 @@ class LogpServiceClient:
         if np.shape(logp) != ():
             raise RuntimeError(f"logp must be scalar, got shape {np.shape(logp)}")
         return logp
+
+    async def evaluate_async(self, *inputs: np.ndarray) -> np.ndarray:
+        return self._check_reply(
+            await self._client.evaluate_async(*inputs)
+        )
 
     def evaluate(self, *inputs: np.ndarray) -> np.ndarray:
         from ..utils import get_event_loop
@@ -47,18 +54,10 @@ class LogpServiceClient:
         this adapter's shape contract applied per reply.  The batch
         shape fits vectorized consumers (SMC particle weights, ensemble
         proposals) that score many points against one node."""
-        requests = list(requests)  # a one-shot iterable must survive
         batches = await self._client.evaluate_many_async(
             requests, window=window
         )
-        out = []
-        for outputs in batches:
-            if len(outputs) != 1 or np.shape(outputs[0]) != ():
-                raise RuntimeError(
-                    "logp node must return exactly one scalar array"
-                )
-            out.append(outputs[0])
-        return out
+        return [self._check_reply(outputs) for outputs in batches]
 
     def evaluate_many(
         self, requests: Sequence[Sequence[np.ndarray]], *, window: int = 8
@@ -78,19 +77,26 @@ class LogpGradServiceClient:
     def __init__(self, *args, **kwargs):
         self._client = ArraysToArraysServiceClient(*args, **kwargs)
 
-    async def evaluate_async(
-        self, *inputs: np.ndarray
-    ) -> Tuple[np.ndarray, List[np.ndarray]]:
-        outputs = await self._client.evaluate_async(*inputs)
-        if len(outputs) != 1 + len(inputs):
+    @staticmethod
+    def _check_reply(outputs, n_inputs) -> Tuple[np.ndarray, List[np.ndarray]]:
+        """The node's shape contract, single-sourced for the sync and
+        batch paths."""
+        if len(outputs) != 1 + n_inputs:
             raise RuntimeError(
-                f"logp+grad node must return 1 + {len(inputs)} arrays, "
+                f"logp+grad node must return 1 + {n_inputs} arrays, "
                 f"got {len(outputs)}"
             )
         logp, *grads = outputs
         if np.shape(logp) != ():
             raise RuntimeError(f"logp must be scalar, got shape {np.shape(logp)}")
         return logp, grads
+
+    async def evaluate_async(
+        self, *inputs: np.ndarray
+    ) -> Tuple[np.ndarray, List[np.ndarray]]:
+        return self._check_reply(
+            await self._client.evaluate_async(*inputs), len(inputs)
+        )
 
     def evaluate(self, *inputs):
         from ..utils import get_event_loop
@@ -109,20 +115,10 @@ class LogpGradServiceClient:
         batches = await self._client.evaluate_many_async(
             requests, window=window
         )
-        out = []
-        for args, outputs in zip(requests, batches):
-            if len(outputs) != 1 + len(args):
-                raise RuntimeError(
-                    f"logp+grad node must return 1 + {len(args)} arrays, "
-                    f"got {len(outputs)}"
-                )
-            logp, *grads = outputs
-            if np.shape(logp) != ():
-                raise RuntimeError(
-                    f"logp must be scalar, got shape {np.shape(logp)}"
-                )
-            out.append((logp, grads))
-        return out
+        return [
+            self._check_reply(outputs, len(args))
+            for args, outputs in zip(requests, batches)
+        ]
 
     def evaluate_many(
         self, requests: Sequence[Sequence[np.ndarray]], *, window: int = 8

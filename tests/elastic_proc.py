@@ -1,0 +1,94 @@
+"""Child driver for the PROCESS-RESTART tier of elastic sampling.
+
+Launched as ``python elastic_proc.py <ckpt> <out_npz> <mode>`` by
+tests/test_elastic.py (a FILE on purpose: CLAUDE.md spawn pitfall).
+``mode``:
+
+- ``crash``  — the blackbox host node hard-kills the PROCESS
+  (``os._exit(42)``) as soon as chunk 0's sidecar exists: the abrupt
+  death stands in for the collective-wedge abort, whose recovery
+  contract is identical (nothing graceful runs either way).
+- ``run``    — no bomb: runs to completion (a fresh process resumes
+  from whatever checkpoint exists) and saves the draws to out_npz.
+
+The logp spans a REAL 8-virtual-device mesh psum (FederatedLogp) plus
+a blackbox host term — the composition whose in-process recovery is
+impossible (a failing participant wedges the collective), i.e. exactly
+the case the restart tier exists for.
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    ckpt, out_npz, mode = sys.argv[1], sys.argv[2], sys.argv[3]
+    sys.path.insert(0, REPO)
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+    from pytensor_federated_tpu.utils import force_cpu_backend
+
+    force_cpu_backend()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytensor_federated_tpu import blackbox_logp_grad, pack_shards
+    from pytensor_federated_tpu.parallel import make_mesh
+    from pytensor_federated_tpu.parallel.sharded import FederatedLogp
+    from pytensor_federated_tpu.samplers import elastic_sample
+
+    rng = np.random.default_rng(0)
+    shards = []
+    for _ in range(8):
+        x = rng.normal(size=(32,)).astype(np.float32)
+        shards.append((x, (1.5 * x + 0.1).astype(np.float32)))
+    data = pack_shards(shards)
+
+    def bomb_host(x):
+        if mode == "crash" and os.path.exists(ckpt + ".chunk0000.npz"):
+            os._exit(42)  # the process dies; nothing graceful runs
+        return np.float32(0.0), [np.zeros_like(x)]
+
+    bomb = blackbox_logp_grad(
+        bomb_host, (jax.ShapeDtypeStruct((1,), jnp.float32),)
+    )
+
+    def build_logp(mesh):
+        fed = FederatedLogp(
+            lambda p, shard: -0.5
+            * jnp.sum((shard[0][1] - p["w"] * shard[0][0]) ** 2 * shard[1]),
+            data.tree(),
+            mesh=mesh,
+        )
+
+        def logp(params):
+            return fed.logp(params) + bomb(params["w"][None])[0]
+
+        return logp
+
+    res = elastic_sample(
+        build_logp,
+        {"w": jnp.asarray(0.0)},
+        key=jax.random.PRNGKey(3),
+        checkpoint_path=ckpt,
+        mesh=make_mesh({"shards": 8}),
+        num_warmup=100,
+        num_samples=90,
+        num_chains=2,
+        checkpoint_every=30,
+    )
+    np.savez(out_npz, w=np.asarray(res.samples["w"]))
+    print(f"DONE w_mean={float(np.mean(np.asarray(res.samples['w']))):.4f}")
+    # os._exit: a dead-collective thread in atexit must not hang a
+    # SUCCESSFUL run's exit (same policy as multihost_proc.py).
+    sys.stdout.flush()
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

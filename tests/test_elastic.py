@@ -1,0 +1,209 @@
+"""Elastic sampling: mid-run failure -> detect -> remesh -> resume.
+
+The integration the subsystems exist for: a blackbox host node (the
+reference's true federated case) DIES mid-sampling — the in-band
+signal, like the reference's dropped stream (service.py:407-416) —
+and ``elastic_sample`` recovers: optional heartbeat detection, mesh
+rebuild, ``build_logp`` re-placement, and a checkpoint resume whose
+draws are BIT-IDENTICAL to a never-interrupted run.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytensor_federated_tpu import blackbox_logp_grad
+from pytensor_federated_tpu.checkpoint import sample_checkpointed
+from pytensor_federated_tpu.samplers import elastic_sample
+
+DIM = 3
+
+
+def _make_bomb_logp(fail_state, chunk0_path):
+    """logp via the blackbox host path whose host fn raises ONCE, as
+    soon as chunk 0's sidecar exists on disk — i.e. deterministically
+    after at least one completed checkpoint chunk, wherever the eval
+    count happens to land."""
+
+    def host(x):
+        x = np.asarray(x)
+        if fail_state["armed"] and os.path.exists(chunk0_path):
+            fail_state["armed"] = False
+            fail_state["fired"] = True
+            raise RuntimeError("injected node death")
+        return -0.5 * np.sum((x - 2.0) ** 2), [-(x - 2.0)]
+
+    spec = (jax.ShapeDtypeStruct((DIM,), jnp.float32),)
+    op = blackbox_logp_grad(host, spec)
+
+    def logp(params):
+        return op(params["x"])[0]
+
+    return logp
+
+
+def _clean_blackbox_logp():
+    """The SAME blackbox host math as the bomb logp, never armed — the
+    bit-identical oracle must share the eval path exactly (the host
+    computes grads in float64 numpy; f32 autodiff of the same formula
+    differs in the last bits and the trajectories diverge)."""
+    return _make_bomb_logp(
+        {"armed": False, "fired": False}, "/nonexistent"
+    )
+
+
+SAMPLE_KW = dict(
+    num_warmup=100,
+    num_samples=90,
+    num_chains=2,
+    checkpoint_every=30,
+    jitter=0.5,
+)
+
+
+class TestElasticSample:
+    def test_failure_recovery_bit_identical(self, tmp_path):
+        """Kill the node mid-draws; the elastic run's draws must equal
+        an uninterrupted clean run's exactly (same key discipline)."""
+        key = jax.random.PRNGKey(7)
+        init = {"x": jnp.zeros(DIM)}
+
+        clean_path = str(tmp_path / "clean.ckpt")
+        res_clean = sample_checkpointed(
+            _clean_blackbox_logp(),
+            init,
+            key=key,
+            checkpoint_path=clean_path,
+            **SAMPLE_KW,
+        )
+
+        el_path = str(tmp_path / "elastic.ckpt")
+        fail_state = {"armed": True, "fired": False}
+        meshes_seen = []
+
+        def build_logp(mesh):
+            meshes_seen.append(mesh)
+            return _make_bomb_logp(
+                fail_state, el_path + ".chunk0000.npz"
+            )
+
+        res = elastic_sample(
+            build_logp,
+            init,
+            key=key,
+            checkpoint_path=el_path,
+            **SAMPLE_KW,
+        )
+        assert fail_state["fired"], "the injected failure never fired"
+        assert len(meshes_seen) == 2  # initial build + one recovery
+        np.testing.assert_array_equal(
+            np.asarray(res.samples["x"]),
+            np.asarray(res_clean.samples["x"]),
+        )
+
+    def test_mesh_policy_and_detection_feed_recovery(self, tmp_path):
+        """On failure the heartbeat verdict reaches the recovery policy
+        and the rebuilt mesh reaches build_logp."""
+        from pytensor_federated_tpu.parallel import make_mesh
+
+        devices = jax.devices("cpu")[:8]
+        mesh8 = make_mesh({"shards": 8}, devices=devices)
+        mesh4 = make_mesh({"shards": 4}, devices=devices[:4])
+        el_path = str(tmp_path / "mesh.ckpt")
+        fail_state = {"armed": True, "fired": False}
+        meshes_seen = []
+        policy_calls = []
+
+        def build_logp(mesh):
+            meshes_seen.append(mesh)
+            return _make_bomb_logp(
+                fail_state, el_path + ".chunk0000.npz"
+            )
+
+        def on_failure(mesh, dead):
+            policy_calls.append((mesh, tuple(dead)))
+            return mesh4
+
+        res = elastic_sample(
+            build_logp,
+            {"x": jnp.zeros(DIM)},
+            key=jax.random.PRNGKey(1),
+            checkpoint_path=el_path,
+            mesh=mesh8,
+            peers={7: ("127.0.0.1", 1)},  # port 1: provably dead
+            on_failure=on_failure,
+            **SAMPLE_KW,
+        )
+        assert fail_state["fired"]
+        assert policy_calls == [(mesh8, (7,))]
+        assert meshes_seen == [mesh8, mesh4]
+        assert np.asarray(res.samples["x"]).shape == (2, 90, DIM)
+
+    def test_process_restart_resumes_bit_identical(self, tmp_path):
+        """The PROCESS-RESTART tier (see elastic.py docstring): a
+        failure wedging a cross-device collective aborts the process —
+        nothing in-process can catch it — so recovery is re-running the
+        same call.  Child 1 hard-dies mid-draws (os._exit from the
+        blackbox host, after chunk 0 persisted); child 2 resumes from
+        the checkpoint and must produce draws bit-identical to an
+        uninterrupted run in a third, clean process."""
+        import subprocess
+        import sys as _sys
+
+        driver = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "elastic_proc.py"
+        )
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.pop("JAX_PLATFORMS", None)
+
+        def run(ckpt, out, mode, expect):
+            proc = subprocess.run(
+                [_sys.executable, driver, ckpt, out, mode],
+                env=env,
+                capture_output=True,
+                text=True,
+                timeout=600,
+            )
+            assert proc.returncode == expect, (
+                mode,
+                proc.returncode,
+                proc.stdout + proc.stderr,
+            )
+            return proc
+
+        ckpt = str(tmp_path / "restart.ckpt")
+        out = str(tmp_path / "restart.npz")
+        run(ckpt, out, "crash", expect=42)
+        assert os.path.exists(ckpt + ".chunk0000.npz")
+        assert not os.path.exists(out)
+        run(ckpt, out, "run", expect=0)  # fresh process resumes
+
+        clean_ckpt = str(tmp_path / "clean.ckpt")
+        clean_out = str(tmp_path / "clean.npz")
+        run(clean_ckpt, clean_out, "run", expect=0)
+
+        w_resumed = np.load(out)["w"]
+        w_clean = np.load(clean_out)["w"]
+        np.testing.assert_array_equal(w_resumed, w_clean)
+        assert abs(float(np.mean(w_clean)) - 1.5) < 0.05
+
+    def test_failure_budget_exhausted_reraises(self, tmp_path):
+        def build_logp(mesh):
+            def logp(params):
+                raise RuntimeError("always broken")
+
+            return logp
+
+        with pytest.raises(RuntimeError, match="always broken"):
+            elastic_sample(
+                build_logp,
+                {"x": jnp.zeros(DIM)},
+                key=jax.random.PRNGKey(0),
+                checkpoint_path=str(tmp_path / "x.ckpt"),
+                max_failures=2,
+                **SAMPLE_KW,
+            )
