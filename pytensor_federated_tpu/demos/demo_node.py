@@ -118,9 +118,17 @@ def run_node_pool(
         for p in procs:
             p.terminate()
         # A host application's own SIGTERM cleanup must not be silently
-        # discarded by this API: chain to it before exiting.
+        # discarded by this API: chain to it before exiting — but its
+        # exit path must not either REPLACE the killed-by-signal status
+        # (a chained handler calling sys.exit(0) would otherwise make a
+        # supervisor read a SIGTERM'd pool as a clean run).
         if callable(prev_handler):
-            prev_handler(signum, frame)
+            try:
+                prev_handler(signum, frame)
+            except SystemExit:
+                pass
+            except Exception:
+                _log.exception("chained SIGTERM handler failed")
         raise SystemExit(128 + signum)
 
     installed = False

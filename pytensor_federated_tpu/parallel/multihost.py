@@ -136,12 +136,15 @@ class HeartbeatServer:
 
         hb = HeartbeatServer(port=base_port + idx, process_index=idx)
 
-    ``port=0`` picks a free port (then share ``hb.address`` out-of-band
-    or over the coordination KV); a fixed convention like
+    ``port=0`` picks a free port; a fixed convention like
     ``base + process_index`` needs no exchange at all.  The default
     bind is all interfaces — peers on OTHER hosts must be able to
     reach the probe; pass ``host="127.0.0.1"`` to scope a single-host
-    deployment down.
+    deployment down.  NOTE when sharing the endpoint: with the
+    wildcard bind, ``address[0]`` is ``"0.0.0.0"``, which is NOT
+    routable from another host (a remote peer connecting to it reaches
+    its own loopback) — share ``(this_host_ip, hb.port)``, pairing the
+    port with an address peers can actually route to.
 
     ``process_index`` goes into the reply banner so probers can verify
     they reached the RIGHT peer (a recycled port after a supervisor
@@ -173,8 +176,15 @@ class HeartbeatServer:
 
     @property
     def address(self) -> Tuple[str, int]:
+        """The BOUND (host, port) — under the default wildcard bind the
+        host is ``"0.0.0.0"``; see the class docstring before sharing
+        it with remote peers."""
         host, port = self._sock.getsockname()[:2]
         return host, port
+
+    @property
+    def port(self) -> int:
+        return self._sock.getsockname()[1]
 
     def _serve(self):
         while not self._stop.is_set():
@@ -214,7 +224,19 @@ def probe_peer(
     try:
         with socket.create_connection(address, timeout=timeout) as s:
             s.settimeout(timeout)
-            banner = s.recv(64)
+            # Read to EOF: the server closes after its sendall, and a
+            # single recv may deliver a PARTIAL banner (TCP gives no
+            # message boundaries) — a truncated b"aliv" must not turn
+            # into a false dead/wrong-identity verdict.
+            chunks = []
+            total = 0
+            while total < 64:
+                chunk = s.recv(64 - total)
+                if not chunk:
+                    break
+                chunks.append(chunk)
+                total += len(chunk)
+            banner = b"".join(chunks)
     except OSError:
         return False
     if not banner.startswith(b"alive:"):

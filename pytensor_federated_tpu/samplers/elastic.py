@@ -29,11 +29,12 @@ from the collective or evaluation), then:
 3. ``build_logp(new_mesh)`` re-places data and re-jits — state lives
    on the host (the reference's nodes are stateless for the same
    reason);
-4. sampling RESUMES from the last completed chunk — draws are
-   bit-identical to an uninterrupted run by
+4. sampling RESUMES from the last completed chunk —
    :func:`~pytensor_federated_tpu.checkpoint.sample_checkpointed`'s
-   fold_in-per-chunk key discipline (the draw stream cannot depend on
-   where the failure happened).
+   fold_in-per-chunk key discipline means the draw stream cannot
+   depend on where the failure happened; see ``elastic_sample``'s
+   docstring for the precise bit-identical-vs-exact-in-distribution
+   continuation guarantee.
 
 TWO RECOVERY TIERS — be honest about which one a failure lands in:
 
@@ -103,8 +104,19 @@ def elastic_sample(
     Remaining ``sample_kwargs`` go to
     :func:`~pytensor_federated_tpu.checkpoint.sample_checkpointed`
     (num_warmup/num_samples/num_chains/checkpoint_every/kernel/...).
-    Returns its :class:`SampleResult`; draws are bit-identical to an
-    uninterrupted run regardless of how many failures interrupted it.
+    Returns its :class:`SampleResult`.
+
+    Continuation guarantee, stated precisely: the resumed run uses the
+    checkpointed kernel state and the same fold_in-per-chunk key
+    stream, so when the rebuilt logp is NUMERICALLY IDENTICAL to the
+    original (same mesh layout — restarts, host-node recovery, or a
+    rebuild over the same devices) the draws are BIT-identical to an
+    uninterrupted run (tested).  When recovery SHRINKS the mesh, data
+    re-placement changes the partial-sum order of the federated
+    reduction, which can perturb logp values in the final float bits —
+    the continuation is then exact in distribution (same posterior,
+    same kernel, checkpointed state) but not bit-reproducible against
+    the uninterrupted counterfactual.
     """
     from ..checkpoint import sample_checkpointed
 
