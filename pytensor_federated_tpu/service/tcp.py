@@ -67,20 +67,40 @@ class TcpArraysClient:
         self.port = int(port)
         self.retries = retries
         self._sock: Optional[socket.socket] = None
+        self._rfile = None  # buffered reader over _sock
 
     def _connect(self) -> socket.socket:
         if self._sock is None:
             s = socket.create_connection((self.host, self.port), timeout=30.0)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._sock = s
+            # Buffered reads: a frame costs one length + one payload
+            # read from the buffer instead of 2+ raw recv syscalls.
+            self._rfile = s.makefile("rb")
         return self._sock
+
+    def _read_exact(self, n: int) -> bytes:
+        buf = self._rfile.read(n)
+        if buf is None or len(buf) < n:
+            raise ConnectionError("peer closed mid-frame")
+        return buf
+
+    def _read_frame(self) -> bytes:
+        (n,) = struct.unpack("<I", self._read_exact(4))
+        return self._read_exact(n)
 
     def close(self) -> None:
         if self._sock is not None:
             try:
+                if self._rfile is not None:
+                    try:
+                        self._rfile.close()
+                    except OSError:
+                        pass
                 self._sock.close()
             finally:
                 self._sock = None
+                self._rfile = None
 
     def __del__(self):  # best-effort, mirrors client.py teardown
         try:
@@ -96,7 +116,7 @@ class TcpArraysClient:
             try:
                 sock = self._connect()
                 _send_frame(sock, request)
-                reply = _recv_frame(sock)
+                reply = self._read_frame()
                 break
             except (ConnectionError, OSError) as e:
                 last_err = e
@@ -114,6 +134,117 @@ class TcpArraysClient:
         return outputs
 
     __call__ = evaluate
+
+    # in-flight REQUEST bytes cap: keeps every sendall completable so
+    # the pipelining loop always reaches its read — without it, a
+    # write-only burst can fill both sockets' buffers against a server
+    # blocked sending replies nobody reads (the same deadlock geometry
+    # as HTTP/2 flow control on the gRPC lane, client.py).
+    _MAX_INFLIGHT_BYTES = 32 * 1024
+
+    def evaluate_many(
+        self,
+        requests: Sequence[Sequence[np.ndarray]],
+        *,
+        window: int = 8,
+    ) -> List[List[np.ndarray]]:
+        """Pipelined batch over the SAME lock-step connection.
+
+        The frame protocol is strictly FIFO per connection (the C++
+        node's loop is recv -> compute -> send, native/cpp_node.cpp),
+        so up to ``window`` requests stay in flight and replies
+        correlate by order + per-frame uuid — client encode, both
+        network legs, and node compute overlap.  Oversized requests
+        degrade to lock-step via the byte cap (one in flight, the
+        proven-safe per-call mode).
+
+        Same semantics as the gRPC lane's ``evaluate_many``:
+        all-or-nothing TRANSPORT retry (reconnect, re-run the whole
+        batch); a server error reply raises
+        :class:`RemoteComputeError` without retry after draining the
+        in-flight replies so the connection stays correlated.
+        """
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        encoded = []
+        for args in requests:
+            uid = uuid_mod.uuid4().bytes
+            encoded.append(
+                (encode_arrays([np.asarray(a) for a in args], uuid=uid),
+                 uid)
+            )
+        if not encoded:
+            return []
+        last_err: Optional[Exception] = None
+        for _ in range(self.retries + 1):
+            try:
+                return self._evaluate_many_once(encoded, window)
+            except (ConnectionError, OSError) as e:
+                last_err = e
+                self.close()
+        raise ConnectionError(
+            f"node {self.host}:{self.port} unreachable after "
+            f"{self.retries + 1} attempts"
+        ) from last_err
+
+    def _evaluate_many_once(self, encoded, window):
+        sock = self._connect()
+        n = len(encoded)
+        results: List[Optional[List[np.ndarray]]] = [None] * n
+        write_idx = read_idx = 0
+        inflight_bytes = 0
+        while read_idx < n:
+            # Coalesce every writable frame into ONE sendall: on
+            # localhost the per-call cost is syscall-dominated, so a
+            # window of small frames should pay one write, not window.
+            burst = []
+            while write_idx < n and (
+                write_idx == read_idx
+                or (
+                    write_idx - read_idx < window
+                    and inflight_bytes + len(encoded[write_idx][0])
+                    <= self._MAX_INFLIGHT_BYTES
+                )
+            ):
+                payload = encoded[write_idx][0]
+                burst.append(struct.pack("<I", len(payload)))
+                burst.append(payload)
+                inflight_bytes += len(payload)
+                write_idx += 1
+            if burst:
+                sock.sendall(b"".join(burst))
+            reply = self._read_frame()
+            request, uid = encoded[read_idx]
+            inflight_bytes -= len(request)
+            try:
+                outputs, reply_uid, error = decode_arrays(reply)
+            except Exception:
+                # Corrupt payload with replies still in flight: the
+                # connection cannot be trusted to stay correlated —
+                # close so the NEXT call reconnects cleanly, and let
+                # the WireError surface loudly (CLAUDE.md invariant).
+                self.close()
+                raise
+            if error is not None:
+                # Drain so the connection stays correlated for the
+                # NEXT call, then surface the deterministic error.  If
+                # the drain itself fails, the leftover in-flight
+                # replies would poison later calls with stale frames —
+                # close instead of leaving a desynchronized socket.
+                try:
+                    for _ in range(write_idx - read_idx - 1):
+                        self._read_frame()
+                except (ConnectionError, OSError):
+                    self.close()
+                raise RemoteComputeError(error)
+            if reply_uid != uid:
+                self.close()
+                raise RuntimeError(
+                    "uuid mismatch: reply does not match request"
+                )
+            results[read_idx] = outputs
+            read_idx += 1
+        return results
 
 
 def serve_tcp_once(

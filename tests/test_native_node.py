@@ -121,6 +121,50 @@ class TestCppNode:
             np.testing.assert_allclose(float(out[0]), want, rtol=1e-12)
         client.close()
 
+    def test_pipelined_batch_matches_sequential(self, cpp_node):
+        """evaluate_many keeps `window` frames in flight on the same
+        connection; results must equal per-call evaluation exactly."""
+        from pytensor_federated_tpu.service import TcpArraysClient
+
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=64)
+        y = 2.0 * x
+        client = TcpArraysClient("127.0.0.1", cpp_node)
+        reqs = [
+            (np.float64(0.0), np.float64(i * 0.1), np.float64(1.0), x, y)
+            for i in range(21)
+        ]
+        batch = client.evaluate_many(reqs, window=6)
+        assert len(batch) == 21
+        for args, out in zip(reqs, batch):
+            seq = client.evaluate(*args)
+            for a, b in zip(seq, out):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert client.evaluate_many([]) == []
+        client.close()
+
+    def test_pipelined_midbatch_error_keeps_connection(self, cpp_node):
+        """A bad-request error reply mid-batch raises, and the SAME
+        connection still serves the next call (drain keeps the
+        lock-step correlation)."""
+        from pytensor_federated_tpu.service import (
+            RemoteComputeError,
+            TcpArraysClient,
+        )
+
+        rng = np.random.default_rng(3)
+        x = rng.normal(size=8)
+        y = 2.0 * x
+        good = (np.float64(0.0), np.float64(2.0), np.float64(1.0), x, y)
+        bad = (np.float64(0.0),)  # wrong arity -> error reply
+        client = TcpArraysClient("127.0.0.1", cpp_node)
+        with pytest.raises(RemoteComputeError):
+            client.evaluate_many([good, bad, good, good], window=4)
+        out = client.evaluate(*good)  # connection survived, correlated
+        want, _, _ = ref_logp_grad(0.0, 2.0, 1.0, x, y)
+        np.testing.assert_allclose(float(out[0]), want, rtol=1e-12)
+        client.close()
+
     def test_error_reply(self, cpp_node):
         from pytensor_federated_tpu.service import (
             RemoteComputeError,
