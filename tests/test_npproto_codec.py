@@ -647,3 +647,30 @@ class TestAgainstOfficialProtoServer:
             logp, grad = client.evaluate(x)
             np.testing.assert_allclose(float(logp), -8.0)
             np.testing.assert_allclose(grad, [4.0, -4.0])
+
+    def test_pipelined_batch_over_reference_wire(self, standin_node):
+        """evaluate_many speaks the reference's protobuf bytes too:
+        window-pipelined frames against the official-runtime node,
+        replies correlated by the reference's string uuid."""
+        from pytensor_federated_tpu.service import (
+            ArraysToArraysServiceClient,
+        )
+
+        _wait_node_up(standin_node)
+        client = ArraysToArraysServiceClient(
+            "127.0.0.1", standin_node, codec="npproto"
+        )
+        reqs = [
+            (np.array([1.0 + i, 5.0 - i]),) for i in range(9)
+        ]
+        batch = client.evaluate_many(reqs, window=4)
+        assert len(batch) == 9
+        for (x,), (logp, grad) in zip(
+            reqs, [(o[0], o[1]) for o in batch]
+        ):
+            np.testing.assert_allclose(
+                float(np.asarray(logp)), -np.sum((x - 3.0) ** 2)
+            )
+            np.testing.assert_allclose(
+                np.asarray(grad), -2.0 * (x - 3.0)
+            )
