@@ -103,6 +103,83 @@ class _SkipPallas(Exception):
     """Deliberate skip of the demoted Pallas race — NOT a failure."""
 
 
+def telemetry_overhead(
+    runner, flat0, per_eval_s: float, *, target_wall: float = 0.8,
+    n_micro: int = 100_000,
+) -> dict:
+    """The telemetry subsystem's overhead gate (ISSUE 1 acceptance:
+    telemetry-disabled overhead < 2% on the bench driver metric).
+
+    Two measurements:
+
+    - The DRIVER-METRIC delta: the winner's warm chained executable is
+      re-timed with telemetry enabled and again disabled, and the gate
+      is the relative rate difference.  The fused XLA chain makes no
+      telemetry calls, so this delta is the true cost the subsystem
+      imposes on the headline number — near-zero by construction, and
+      this measurement PROVES it stays that way (an instrument leaking
+      into the hot path, e.g. via a future jit-boundary callback, would
+      trip it).
+    - Micro per-op costs of the instrumented-path pattern every RPC
+      pays (one span + one histogram observe), both states, reported
+      for the RPC-lane budget in docs/observability.md — NOT gated
+      against the XLA per-eval time, which is three orders of magnitude
+      below the ms-scale RPCs the instruments actually ride.
+    """
+    from pytensor_federated_tpu.telemetry import metrics, spans
+
+    probe = metrics.histogram(
+        "pftpu_bench_overhead_probe_seconds",
+        "bench.py telemetry-overhead gate probe (not a real latency)",
+    )
+
+    def micro_loop() -> float:
+        t0 = time.perf_counter()
+        for _ in range(n_micro):
+            with spans.span("bench.probe"):
+                probe.observe(1e-3)
+        return (time.perf_counter() - t0) / n_micro
+
+    n_gate = min(
+        max(int(target_wall / max(per_eval_s, 1e-9)), 1_000), 2**31 - 64
+    )
+    # Alternate ON/OFF repetitions and keep each state's BEST rate: a
+    # one-shot A-then-B comparison folds machine-load drift (anything
+    # else running in the container) and warmth ordering into the
+    # delta; best-of-k of interleaved runs cancels both, leaving only
+    # a sustained one-sided slowdown — i.e. actual telemetry cost — to
+    # trip the gate.
+    prev = spans.set_enabled(True)
+    rate_on = rate_off = 0.0
+    micro_on = micro_off = float("inf")
+    try:
+        for _ in range(3):
+            spans.set_enabled(True)
+            rate_on = max(
+                rate_on, n_gate / time_chain(runner, flat0, n_gate, warm=False)
+            )
+            micro_on = min(micro_on, micro_loop())
+            spans.set_enabled(False)
+            rate_off = max(
+                rate_off, n_gate / time_chain(runner, flat0, n_gate, warm=False)
+            )
+            micro_off = min(micro_off, micro_loop())
+    finally:
+        spans.set_enabled(prev)
+        spans.clear_traces()
+    # Fraction of the disabled-telemetry rate lost when telemetry is
+    # on; clamped at 0 (enabled measuring faster is timing noise).
+    delta_frac = max(0.0, 1.0 - rate_on / rate_off)
+    return {
+        "evals_per_s_enabled": round(rate_on, 1),
+        "evals_per_s_disabled": round(rate_off, 1),
+        "driver_delta_frac": round(delta_frac, 6),
+        "span_ns_enabled": round(micro_on * 1e9, 1),
+        "span_ns_disabled": round(micro_off * 1e9, 1),
+        "pass": bool(delta_frac < 0.02),
+    }
+
+
 class MeasurementIntegrityError(RuntimeError):
     """A timing the integrity guards refuse to trust (degenerate chain,
     inconsistent stages, physics-impossible rate).  A DEDICATED type so
@@ -376,6 +453,11 @@ def main():
             autodiff_flat, flat0
         )
 
+    try:
+        overhead = telemetry_overhead(runners[best], flat0, wall / n_evals)
+    except Exception as e:  # the one-JSON-line invariant outranks the gate
+        overhead = {"error": f"{type(e).__name__}: {e}", "pass": False}
+
     print(
         json.dumps(
             {
@@ -389,6 +471,7 @@ def main():
                 # which racing implementation won.
                 "backend": jax.default_backend(),
                 "impl": best,
+                "telemetry_overhead": overhead,
                 **flop_extra,
             }
         )

@@ -11,7 +11,9 @@
 // Protocol, little-endian throughout:
 //   frame:   u32 payload_len, then payload
 //   payload: "NPW1" ver(u8) flags(u8) uuid(16B) n_arrays(u32)
-//            [flags&1: err_len(u32) + utf8]   then per array:
+//            [flags&1: err_len(u32) + utf8]
+//            [flags&2: trace_id(16B), telemetry correlation — read and
+//             dropped here; replies never carry it]   then per array:
 //            dtype_len(u16) dtype_str ndim(u8) shape(u64*ndim)
 //            data_len(u64) raw bytes
 //
@@ -52,6 +54,7 @@ namespace {
 constexpr char kMagic[4] = {'N', 'P', 'W', '1'};
 constexpr uint8_t kVersion = 1;
 constexpr uint8_t kFlagError = 1;
+constexpr uint8_t kFlagTrace = 2;
 
 struct Array {
   std::string dtype;
@@ -158,6 +161,15 @@ bool decode(const std::vector<uint8_t>& buf, Message* msg, std::string* why) {
       *why = "truncated error block";
       return false;
     }
+  }
+  if (flags & kFlagTrace) {
+    uint8_t trace_id[16];
+    if (!r.bytes(trace_id, 16)) {
+      *why = "truncated trace block";
+      return false;
+    }
+    // Telemetry correlation id — a Python driver's span tree key.  A
+    // native node keeps no spans, so the id is consumed and dropped.
   }
   // Each array needs >= 11 bytes of headers (2 dtype-len + 1 ndim +
   // 8 data-len), so any frame can hold at most remaining/11 arrays.

@@ -1,0 +1,66 @@
+"""Version-portability shims for the jax API surface.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to a
+top-level ``jax.shard_map`` export, and its replication-checking kwarg
+was renamed ``check_rep`` -> ``check_vma`` when the varying-manual-axes
+system landed; this package must import cleanly and run on both sides
+of those moves (the CPU test container and the TPU capture container
+have carried different jax versions).  Import from here — one fallback,
+not one per module.
+
+Exports:
+
+- ``shard_map``: top-level-or-experimental, always accepting the modern
+  ``check_vma=`` spelling (translated to ``check_rep=`` on older jax).
+- ``mark_varying_supported``: True when the running jax has the
+  ``pvary``/``pcast`` primitives that :func:`parallel.mesh.mark_varying`
+  rides; on older jax the vma system does not exist and marking is an
+  identity (the check_rep machinery handles replicated operands itself).
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:  # jax >= 0.5 exports shard_map at top level
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover - jax-version-dependent
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+if "check_vma" in inspect.signature(_shard_map).parameters:
+    shard_map = _shard_map
+else:  # pragma: no cover - jax-version-dependent
+
+    def shard_map(*args, **kwargs):
+        """``shard_map`` accepting ``check_vma=`` on pre-vma jax.
+
+        ``check_vma`` maps onto the old ``check_rep``, and when the
+        caller says nothing the OLD checker is disabled: without
+        ``pvary`` there is no way to annotate loop carries that become
+        device-varying (ring/ulysses accumulators, user ODE scans), so
+        the pre-vma replication tracker rejects valid programs with
+        "Scan carry ... mismatched replication types".  Numerical
+        parity under the disabled checker is pinned by the golden-model
+        gradient tests (test_sharded, test_federated_primitives,
+        test_statespace, ...)."""
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        else:
+            kwargs.setdefault("check_rep", False)
+        return _shard_map(*args, **kwargs)
+
+
+def _probe_mark_varying() -> bool:
+    from jax import lax
+
+    return hasattr(lax, "pcast") or hasattr(lax, "pvary")
+
+
+mark_varying_supported = _probe_mark_varying()
+
+try:  # graduated out of jax.experimental
+    from jax import enable_x64
+except (ImportError, AttributeError):  # pragma: no cover
+    from jax.experimental import enable_x64
+
+__all__ = ["shard_map", "mark_varying_supported", "enable_x64"]

@@ -24,12 +24,35 @@ reference: op_async.py:107-132):
 
 from __future__ import annotations
 
+import contextvars
 import threading
+import time
 import weakref
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, List, Sequence, Tuple
 
+from .telemetry import metrics as _metrics
+from .telemetry import spans as _tspans
+
 __all__ = ["MemberExecutorPool", "member_spans", "run_members"]
+
+# Fanout instrumentation (metric catalog: docs/observability.md).  The
+# straggler gap — max minus min member latency within one fanout — is
+# THE number that says how much of the "wall-clock = max member" budget
+# is lost to imbalance (the per-stage accounting DrJAX-style MapReduce
+# analyses center on).
+_FANOUT_WIDTH = _metrics.histogram(
+    "pftpu_fanout_width",
+    "Members per fused fanout evaluation",
+    buckets=_metrics.DEFAULT_COUNT_BUCKETS,
+)
+_MEMBER_S = _metrics.histogram(
+    "pftpu_fanout_member_seconds", "Per-member latency within a fanout"
+)
+_STRAGGLER_S = _metrics.histogram(
+    "pftpu_fanout_straggler_seconds",
+    "Straggler gap per fanout: slowest member minus fastest",
+)
 
 
 def _shutdown_all(executors: List[ThreadPoolExecutor]) -> None:
@@ -150,13 +173,24 @@ def run_members(
         )
     in_spans = member_spans(in_counts)
     out_spans = member_spans(out_counts)
+    telemetry_on = _tspans.enabled()
+    durations: List[float] = [0.0] * n if telemetry_on else []
 
     def make_run(idx: int):
         def run():
             ilo, ihi = in_spans[idx]
             olo, ohi = out_spans[idx]
             sub_storage = output_storage[olo:ohi]
-            member_fns[idx](list(inputs[ilo:ihi]), sub_storage)
+            if telemetry_on:
+                t0 = time.perf_counter()
+            with _tspans.span("fanout.member", idx=idx):
+                member_fns[idx](list(inputs[ilo:ihi]), sub_storage)
+            if telemetry_on:
+                # Written pre-settle, read post-settle: the futures
+                # barrier below orders the write before the read, so no
+                # lock is needed despite the cross-thread handoff.
+                durations[idx] = time.perf_counter() - t0
+                _MEMBER_S.observe(durations[idx])
             # output_storage cells are single-element lists in the
             # pytensor calling convention; the slice above aliases those
             # inner lists, so member writes of sub_storage[j][0] are
@@ -172,8 +206,30 @@ def run_members(
 
         return run
 
-    futures = [pool.submit(i, make_run(i)) for i in range(n)]
-    errs = [f.exception() for f in futures]
-    for e in errs:
-        if e is not None:
-            raise e
+    with _tspans.span("fanout", width=n) as f_span:
+        _FANOUT_WIDTH.observe(n)
+        if telemetry_on:
+            # ContextVars do NOT cross thread-pool boundaries on their
+            # own; each member runs under a COPY of the caller's
+            # context (one copy per member — a Context is not
+            # re-entrant across concurrent threads), so member spans
+            # parent under this fanout span and inherit its trace id.
+            futures = [
+                pool.submit(
+                    i, contextvars.copy_context().run, make_run(i)
+                )
+                for i in range(n)
+            ]
+        else:
+            futures = [pool.submit(i, make_run(i)) for i in range(n)]
+        errs = [f.exception() for f in futures]
+        if telemetry_on and n and not any(e is not None for e in errs):
+            # Only clean fanouts rate the gap: a failed member's slot
+            # never got its duration written, and max-minus-0.0 would
+            # pollute exactly the imbalance histogram this feeds.
+            gap = max(durations) - min(durations)
+            _STRAGGLER_S.observe(gap)
+            f_span.set_attr("straggler_gap_s", gap)
+        for e in errs:
+            if e is not None:
+                raise e

@@ -45,7 +45,9 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax, shard_map
+from jax import lax
+
+from .._compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..parallel.mesh import SEQ_AXIS, mark_varying as _mark_varying

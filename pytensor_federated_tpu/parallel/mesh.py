@@ -58,7 +58,12 @@ def mark_varying(x, axis_name: str):
             return l
         if hasattr(lax, "pcast"):
             return lax.pcast(l, axis_name, to="varying")
-        return lax.pvary(l, axis_name)
+        if hasattr(lax, "pvary"):
+            return lax.pvary(l, axis_name)
+        # Pre-vma jax: no varying-manual-axes tracking exists, so there
+        # is nothing to mark — check_rep's rewrite machinery handles
+        # replicated operands itself and the identity is correct.
+        return l
 
     return jax.tree_util.tree_map(f, x)
 

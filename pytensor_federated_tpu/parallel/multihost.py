@@ -138,13 +138,18 @@ class HeartbeatServer:
 
     ``port=0`` picks a free port; a fixed convention like
     ``base + process_index`` needs no exchange at all.  The default
-    bind is all interfaces — peers on OTHER hosts must be able to
-    reach the probe; pass ``host="127.0.0.1"`` to scope a single-host
-    deployment down.  NOTE when sharing the endpoint: with the
-    wildcard bind, ``address[0]`` is ``"0.0.0.0"``, which is NOT
-    routable from another host (a remote peer connecting to it reaches
-    its own loopback) — share ``(this_host_ip, hb.port)``, pairing the
-    port with an address peers can actually route to.
+    bind is LOOPBACK: the reply leaks the pid and process identity, so
+    answering liveness probes from arbitrary interfaces is an explicit
+    deployment decision, not a default (same posture as the telemetry
+    exporter, :mod:`...telemetry.export`).  A real multi-host mesh —
+    where peers on OTHER hosts must reach the probe — opts in with
+    ``allow_external=True`` (binds the given ``host``, default then
+    ``"0.0.0.0"``); passing a non-loopback ``host`` without the opt-in
+    raises.  NOTE when sharing the endpoint: with the wildcard bind,
+    ``address[0]`` is ``"0.0.0.0"``, which is NOT routable from
+    another host (a remote peer connecting to it reaches its own
+    loopback) — share ``(this_host_ip, hb.port)``, pairing the port
+    with an address peers can actually route to.
 
     ``process_index`` goes into the reply banner so probers can verify
     they reached the RIGHT peer (a recycled port after a supervisor
@@ -156,11 +161,25 @@ class HeartbeatServer:
 
     def __init__(
         self,
-        host: str = "0.0.0.0",
+        host: Optional[str] = None,
         port: int = 0,
         *,
         process_index: Optional[int] = None,
+        allow_external: bool = False,
     ):
+        if host is None:
+            host = "0.0.0.0" if allow_external else "127.0.0.1"
+        elif not allow_external and host not in (
+            # AF_INET loopback spellings only ("::1" would pass the
+            # guard and then fail at the IPv4 socket's bind with a
+            # confusing address-family error).
+            "127.0.0.1", "localhost",
+        ):
+            raise ValueError(
+                f"refusing to bind heartbeat to {host!r} without "
+                "allow_external=True — an externally routable liveness "
+                "endpoint is an explicit deployment decision"
+            )
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -176,9 +195,9 @@ class HeartbeatServer:
 
     @property
     def address(self) -> Tuple[str, int]:
-        """The BOUND (host, port) — under the default wildcard bind the
-        host is ``"0.0.0.0"``; see the class docstring before sharing
-        it with remote peers."""
+        """The BOUND (host, port) — under the ``allow_external=True``
+        wildcard bind the host is ``"0.0.0.0"``; see the class
+        docstring before sharing it with remote peers."""
         host, port = self._sock.getsockname()[:2]
         return host, port
 

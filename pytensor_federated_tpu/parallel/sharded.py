@@ -20,7 +20,7 @@ from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from .._compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .mesh import SHARDS_AXIS, mark_varying as _mark_varying

@@ -37,7 +37,9 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+from .._compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .mesh import SEQ_AXIS

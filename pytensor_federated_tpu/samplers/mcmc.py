@@ -14,12 +14,15 @@ Returned samples keep the user's params-pytree structure with leading
 from __future__ import annotations
 
 import dataclasses
+import time
 from functools import partial
 from typing import Any, Callable, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 
+from ..telemetry import metrics as _metrics
+from ..telemetry import spans as _tspans
 from .hmc import HMCState, find_reasonable_step_size, hmc_init, hmc_step
 from .metropolis import MetropolisState, metropolis_init, metropolis_step
 from .nuts import nuts_step
@@ -33,6 +36,42 @@ from .util import (
     welford_update,
     welford_variance,
 )
+
+
+# Sampler step timing (metric catalog: docs/observability.md).  The
+# whole warmup+sampling program is ONE jitted scan, so per-step times
+# are derived host-side: device wall / total transitions.  That is the
+# number to line up against the RPC histograms — a federated logp makes
+# every step an evaluate() fanout, and step_seconds vs
+# pftpu_client_call_seconds says how much of a step is the wire.
+_SAMPLE_RUN_S = _metrics.histogram(
+    "pftpu_sampler_run_seconds",
+    "Device wall time of one sample() run (all chains, warmup+draws)",
+    ("kernel",),
+)
+_STEP_S = _metrics.histogram(
+    "pftpu_sampler_step_seconds",
+    "Derived per-transition time: run wall / (chains * (warmup+draws))",
+    ("kernel",),
+)
+_DRAWS = _metrics.counter(
+    "pftpu_sampler_draws_total",
+    "Posterior draws produced (chains * num_samples)",
+    ("kernel",),
+)
+
+
+def _record_run(kernel, out, t0, num_chains, num_warmup, num_samples):
+    """Telemetry-on path only: block on ``out`` (jit dispatch is async;
+    an un-synced wall time would rate the dispatch, not the run), then
+    record run wall, derived per-transition time, and draws."""
+    jax.block_until_ready(out)
+    wall = time.perf_counter() - t0
+    _SAMPLE_RUN_S.labels(kernel=kernel).observe(wall)
+    transitions = num_chains * (num_warmup + num_samples)
+    if transitions:
+        _STEP_S.labels(kernel=kernel).observe(wall / transitions)
+    _DRAWS.labels(kernel=kernel).inc(num_chains * num_samples)
 
 
 class WarmupResult(NamedTuple):
@@ -249,9 +288,20 @@ def sample(
     )
 
     if kernel == "metropolis":
-        return _sample_metropolis(
-            flat_logp, unravel, init_flat, k_run, num_warmup, num_samples
-        )
+        with _tspans.span(
+            "mcmc.sample", kernel="metropolis", chains=num_chains
+        ):
+            t0 = time.perf_counter()
+            result = _sample_metropolis(
+                flat_logp, unravel, init_flat, k_run, num_warmup,
+                num_samples,
+            )
+            if _tspans.enabled():
+                _record_run(
+                    "metropolis", result.samples, t0,
+                    num_chains, num_warmup, num_samples,
+                )
+        return result
 
     kernel_step = make_kernel_step(
         lg, kernel, max_depth=max_depth, num_hmc_steps=num_hmc_steps
@@ -290,9 +340,21 @@ def sample(
         return draws, stats, warm.step_size, warm.inv_mass
 
     chain_keys = jax.random.split(k_run, num_chains)
-    draws, stats, step_sizes, inv_masses = jax.jit(jax.vmap(one_chain))(
-        init_flat, chain_keys
-    )
+    with _tspans.span(
+        "mcmc.sample",
+        kernel=kernel,
+        chains=num_chains,
+        warmup=num_warmup,
+        draws=num_samples,
+    ):
+        t0 = time.perf_counter()
+        draws, stats, step_sizes, inv_masses = jax.jit(jax.vmap(one_chain))(
+            init_flat, chain_keys
+        )
+        if _tspans.enabled():
+            _record_run(
+                kernel, draws, t0, num_chains, num_warmup, num_samples
+            )
     samples = jax.vmap(jax.vmap(unravel))(draws)
     return SampleResult(
         samples=samples, stats=stats, step_size=step_sizes, inv_mass=inv_masses

@@ -33,13 +33,16 @@ import logging
 import os
 import random
 import threading
+import time
 import uuid as uuid_mod
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import grpc
 import numpy as np
 
+from ..telemetry import spans as _spans
 from ..utils import argmin_none_or_func, get_event_loop
+from . import _rpc_metrics
 from .npwire import decode_arrays, encode_arrays
 from .server import EVALUATE, EVALUATE_STREAM, GET_LOAD
 
@@ -47,6 +50,40 @@ _log = logging.getLogger(__name__)
 
 HostPort = Tuple[str, int]
 _identity = lambda b: b  # noqa: E731
+
+# Driver-side RPC instrumentation, shared with the TCP lane
+# (transport="grpc" here, "tcp" in .tcp) so dashboards aggregate
+# across lanes (metric catalog: docs/observability.md).
+_CALL_S = _rpc_metrics.CALL_S
+_RETRIES = _rpc_metrics.RETRIES
+_DROPS = _rpc_metrics.DROPS
+_BATCH_S = _rpc_metrics.BATCH_S
+_WINDOW_DEPTH = _rpc_metrics.WINDOW_DEPTH
+
+
+# gRPC status codes that mark a DETERMINISTIC server-side failure: the
+# npproto path has no in-band error field, so a compute error surfaces
+# as a stream abort — re-running it retries+1 times would re-execute
+# the whole batch into the same exception (ADVICE r5 #2).  Transport
+# trouble (UNAVAILABLE, DEADLINE_EXCEEDED, ...) stays retryable.
+_NO_RETRY_STATUS = frozenset(
+    {
+        grpc.StatusCode.UNKNOWN,  # server handler raised
+        grpc.StatusCode.INVALID_ARGUMENT,
+        grpc.StatusCode.OUT_OF_RANGE,
+        grpc.StatusCode.FAILED_PRECONDITION,
+        grpc.StatusCode.UNIMPLEMENTED,
+    }
+)
+
+
+def _is_retryable(exc: BaseException) -> bool:
+    """Whether the retry-and-rebalance loop should re-attempt after
+    ``exc`` — AioRpcError is classified by status code; raw socket
+    trouble (ConnectionError/OSError) is always transport."""
+    if isinstance(exc, grpc.aio.AioRpcError):
+        return exc.code() not in _NO_RETRY_STATUS
+    return True
 
 
 async def get_load_async(
@@ -289,6 +326,7 @@ class ArraysToArraysServiceClient:
         cid = _conn_key(self)
         privates = _privates.pop(cid, None)
         if privates is not None:
+            _DROPS.labels(transport="grpc").inc()
             _log.warning(
                 "dropping connection to %s:%d", privates.host, privates.port
             )
@@ -324,20 +362,35 @@ class ArraysToArraysServiceClient:
 
     def _encode_request(self, arrays):
         """(request_bytes, uuid, decode) for one call under the active
-        codec; ``decode`` returns ``(outputs, uuid, error)``."""
+        codec; ``decode`` returns ``(outputs, uuid, error)``.
+
+        The ACTIVE telemetry trace id (if any) is embedded in the
+        request — npwire flag block or npproto field 15 — so the node's
+        span tree correlates with the driver's.  npproto field 15 is
+        genuinely ignorable by peers that predate it (proto3 skips
+        unknown fields; property-tested against the official runtime) —
+        use that codec toward reference nodes.  The npwire flag block
+        is only understood by this package's own nodes (which ship in
+        lockstep with this client); a PRE-telemetry npwire node would
+        reject a flagged frame, so toward one either disable telemetry
+        or upgrade the node.  With telemetry disabled the request is
+        byte-identical to the uninstrumented wire either way."""
         arrays = [np.asarray(a) for a in arrays]
+        trace_id = _spans.current_trace_id() if _spans.enabled() else None
         if self.codec == "npproto":
             from . import npproto_codec
 
             uuid = str(uuid_mod.uuid4())
-            request = npproto_codec.encode_arrays_msg(arrays, uuid=uuid)
+            request = npproto_codec.encode_arrays_msg(
+                arrays, uuid=uuid, trace_id=trace_id
+            )
             decode = lambda reply: (  # noqa: E731
                 *npproto_codec.decode_arrays_msg(reply),
                 None,
             )
         else:
             uuid = uuid_mod.uuid4().bytes
-            request = encode_arrays(arrays, uuid=uuid)
+            request = encode_arrays(arrays, uuid=uuid, trace_id=trace_id)
             decode = decode_arrays
         return request, uuid, decode
 
@@ -358,25 +411,52 @@ class ArraysToArraysServiceClient:
 
     async def evaluate_async(self, *arrays: np.ndarray) -> List[np.ndarray]:
         """Evaluate with retry-and-rebalance failover
-        (reference: evaluate_async, service.py:376-423)."""
-        request, uuid, decode = self._encode_request(arrays)
-        last_exc: Optional[BaseException] = None
-        for _ in range(self.retries + 1):
-            try:
-                reply = await self._evaluate_once(request)
-            except (grpc.aio.AioRpcError, ConnectionError, OSError) as e:
-                last_exc = e
-                await self._drop_privates()
-                continue
-            outputs, error = await self._validate_reply(reply, uuid, decode)
-            if error is not None:
-                raise RuntimeError(f"server error: {error}")
-            return outputs
-        raise (
-            last_exc
-            if last_exc is not None
-            else ConnectionError("evaluation failed")
-        )
+        (reference: evaluate_async, service.py:376-423).
+
+        Deterministic server failures do not burn retries: in-band
+        error replies (npwire) and non-retryable gRPC status codes
+        (npproto compute errors abort the RPC as UNKNOWN) raise
+        immediately; only transport trouble rebalances."""
+        with _spans.span(
+            "rpc.evaluate", transport="grpc", codec=self.codec
+        ) as root:
+            # The span (entered above) binds the trace id the encode
+            # step stamps into the request.
+            with _spans.span("encode"):
+                request, uuid, decode = self._encode_request(arrays)
+            mode = "stream" if self.use_stream else "unary"
+            last_exc: Optional[BaseException] = None
+            for attempt in range(self.retries + 1):
+                if attempt:
+                    _RETRIES.labels(transport="grpc").inc()
+                t0 = time.perf_counter()
+                try:
+                    with _spans.span("call"):
+                        reply = await self._evaluate_once(request)
+                except (grpc.aio.AioRpcError, ConnectionError, OSError) as e:
+                    last_exc = e
+                    await self._drop_privates()
+                    if not _is_retryable(e):
+                        root.set_attr("error", "server")
+                        raise
+                    continue
+                with _spans.span("decode"):
+                    outputs, error = await self._validate_reply(
+                        reply, uuid, decode
+                    )
+                _CALL_S.labels(transport="grpc", mode=mode).observe(
+                    time.perf_counter() - t0
+                )
+                if error is not None:
+                    root.set_attr("error", "server")
+                    raise RuntimeError(f"server error: {error}")
+                return outputs
+            root.set_attr("error", "transport")
+            raise (
+                last_exc
+                if last_exc is not None
+                else ConnectionError("evaluation failed")
+            )
 
     def evaluate(self, *arrays: np.ndarray) -> List[np.ndarray]:
         """Sync wrapper (reference: evaluate, service.py:371-374)."""
@@ -462,14 +542,31 @@ class ArraysToArraysServiceClient:
                     await stream.write(encoded[write_idx][0])
                     inflight_bytes += len(encoded[write_idx][0])
                     write_idx += 1
+                _WINDOW_DEPTH.labels(transport="grpc").observe(
+                    write_idx - read_idx
+                )
                 reply = await stream.read()
                 if reply is grpc.aio.EOF:
                     raise ConnectionError("stream closed by server")
                 _req, uuid, decode = encoded[read_idx]
                 inflight_bytes -= len(_req)
-                outputs, error = await self._validate_reply(
-                    reply, uuid, decode
-                )
+                try:
+                    outputs, error = await self._validate_reply(
+                        reply, uuid, decode
+                    )
+                except (grpc.aio.AioRpcError, ConnectionError, OSError):
+                    raise  # transport trouble: the outer except drops
+                except RuntimeError:
+                    raise  # uuid mismatch: _validate_reply already dropped
+                except BaseException:
+                    # Corrupt reply (e.g. WireError) with replies still
+                    # in flight: the lock-step correlation cannot be
+                    # trusted any more — drop the cached connection so
+                    # the NEXT call reconnects cleanly, mirroring the
+                    # TCP lane (tcp.py _evaluate_many_once), then let
+                    # the decode error surface loudly (ADVICE r5 #1).
+                    await self._drop_privates()
+                    raise
                 if error is not None:
                     # Drain in-flight replies so the stream stays
                     # correlated for the NEXT call, then surface the
@@ -506,26 +603,48 @@ class ArraysToArraysServiceClient:
         whole batch retries on a freshly balanced connection
         (per-result partial retry would reorder effects on a stateful
         node).  Server-side compute errors raise without retry, like
-        :meth:`evaluate_async`, and leave the connection usable.
+        :meth:`evaluate_async`, and leave the connection usable: as
+        in-band error replies with ``codec="npwire"``, and as
+        non-retryable gRPC status aborts with ``codec="npproto"`` (the
+        reference schema has no error field, so the server re-raises
+        into the RPC layer — classified by status code here so a
+        deterministic compute error is NOT re-executed retries+1
+        times; npproto stream aborts do tear down that connection).
         """
         if window < 1:
             raise ValueError(f"window must be >= 1, got {window}")
-        encoded = [self._encode_request(args) for args in requests]
-        if not encoded:
-            return []
-        last_exc: Optional[BaseException] = None
-        for _ in range(self.retries + 1):
-            try:
-                return await self._evaluate_many_once(encoded, window)
-            except (grpc.aio.AioRpcError, ConnectionError, OSError) as e:
-                last_exc = e
-                await self._drop_privates()
-                continue
-        raise (
-            last_exc
-            if last_exc is not None
-            else ConnectionError("batch evaluation failed")
-        )
+        with _spans.span(
+            "rpc.evaluate_many",
+            transport="grpc",
+            n=len(requests),
+            window=window,
+        ):
+            with _spans.span("encode"):
+                encoded = [self._encode_request(args) for args in requests]
+            if not encoded:
+                return []
+            t0 = time.perf_counter()
+            last_exc: Optional[BaseException] = None
+            for attempt in range(self.retries + 1):
+                if attempt:
+                    _RETRIES.labels(transport="grpc").inc()
+                try:
+                    results = await self._evaluate_many_once(encoded, window)
+                except (grpc.aio.AioRpcError, ConnectionError, OSError) as e:
+                    last_exc = e
+                    await self._drop_privates()
+                    if not _is_retryable(e):
+                        raise
+                    continue
+                _BATCH_S.labels(transport="grpc").observe(
+                    time.perf_counter() - t0
+                )
+                return results
+            raise (
+                last_exc
+                if last_exc is not None
+                else ConnectionError("batch evaluation failed")
+            )
 
     def evaluate_many(
         self, requests: Sequence[Sequence[np.ndarray]], *, window: int = 8

@@ -14,6 +14,13 @@ dependency:
                       shape(3: repeated int64) strides(4: repeated int64)
     InputArrays       items(1: repeated ndarray) uuid(2: string)
     OutputArrays      items(1: repeated ndarray) uuid(2: string)
+
+plus ONE extension field this package emits and understands:
+``trace_id(15: bytes)`` on InputArrays — the 16-byte telemetry
+correlation id (:mod:`..telemetry.spans`).  Field 15 is unknown to the
+reference schema, so an unmodified reference node skips it by wire
+type (the standard proto3 forward-compatibility rule, property-tested
+against the official runtime); it costs nothing when absent.
     GetLoadParams     (empty)
     GetLoadResult     n_clients(1: int32) percent_cpu(2: float)
                       percent_ram(3: float)
@@ -56,6 +63,7 @@ __all__ = [
     "decode_ndarray",
     "encode_arrays_msg",
     "decode_arrays_msg",
+    "decode_arrays_msg_ex",
     "encode_get_load_result",
     "decode_get_load_result",
     "GETLOAD_PARAMS",
@@ -278,21 +286,45 @@ def decode_ndarray(buf: bytes) -> np.ndarray:
 # ---------------------------------------------------------------------------
 
 
-def encode_arrays_msg(arrays: Sequence[np.ndarray], uuid: str) -> bytes:
+def encode_arrays_msg(
+    arrays: Sequence[np.ndarray],
+    uuid: str,
+    *,
+    trace_id: Optional[bytes] = None,
+) -> bytes:
     """InputArrays/OutputArrays: repeated ndarray items + string uuid
     (reference: service.proto:6-19; uuid is the correlation id the
-    reference's client checks, rpc.py:37-39)."""
+    reference's client checks, rpc.py:37-39).  ``trace_id`` emits the
+    telemetry extension field 15 (module docstring); ``None`` keeps the
+    message byte-identical to the official encoder's output."""
     out = bytearray()
     for a in arrays:
         out += _len_field(1, encode_ndarray(a))
     if uuid:
         out += _len_field(2, uuid.encode("utf-8"))
+    if trace_id is not None:
+        if len(trace_id) != 16:
+            raise WireError(
+                f"trace_id must be 16 bytes, got {len(trace_id)}"
+            )
+        out += _len_field(15, trace_id)
     return bytes(out)
 
 
 def decode_arrays_msg(buf: bytes) -> Tuple[List[np.ndarray], str]:
+    """The historical 2-tuple shape — a trace id (field 15) is skipped
+    like any unknown field.  Use :func:`decode_arrays_msg_ex` to read it."""
+    arrays, uuid, _ = decode_arrays_msg_ex(buf)
+    return arrays, uuid
+
+
+def decode_arrays_msg_ex(
+    buf: bytes,
+) -> Tuple[List[np.ndarray], str, Optional[bytes]]:
+    """Decode InputArrays/OutputArrays -> (arrays, uuid, trace_id)."""
     arrays: List[np.ndarray] = []
     uuid = ""
+    trace_id: Optional[bytes] = None
     pos = 0
     while pos < len(buf):
         field, wt, pos = _decode_tag(buf, pos)
@@ -305,9 +337,14 @@ def decode_arrays_msg(buf: bytes) -> Tuple[List[np.ndarray], str]:
                 uuid = raw.decode("utf-8")
             except UnicodeDecodeError as e:
                 raise WireError(f"bad uuid string: {e}") from None
+        elif field == 15 and wt == _WT_LEN:
+            raw, pos = _decode_len(buf, pos)
+            # Tolerant on length: a future sender might widen the id;
+            # only the exact 16-byte form correlates spans here.
+            trace_id = raw if len(raw) == 16 else None
         else:
             pos = _skip(buf, pos, wt)
-    return arrays, uuid
+    return arrays, uuid, trace_id
 
 
 # ---------------------------------------------------------------------------

@@ -19,12 +19,21 @@ the reference, on purpose:
   test_npproto.py:20) — here it is a hard error.
 
 A message frames N arrays plus a 16-byte correlation uuid (parity with
-the reference's uuid field, reference: rpc.py:37-39) and an optional
-error string.
+the reference's uuid field, reference: rpc.py:37-39), an optional
+error string, and an optional 16-byte telemetry trace id (flag bit 2)
+that correlates driver-side and node-side spans of the same call
+(:mod:`..telemetry.spans`).  Absent, the frame is byte-identical to
+the pre-telemetry format; PRESENT, it requires a decoder that knows
+flag bit 2 — npwire peers all live in this repo and ship in lockstep
+(a pre-telemetry build would reject the flagged frame as corrupt,
+which is this format's loud-failure contract, not silent skipping).
+Cross-implementation forward compatibility is the npproto codec's job
+(its field-15 trace id IS skipped by unknown-field rules).
 
 Layout (little-endian):
   message: MAGIC(4s) version(u8) flags(u8) uuid(16s) n_arrays(u32)
-           [error: len(u32) utf8]  then per array:
+           [flags&1 error: len(u32) utf8]
+           [flags&2 trace: trace_id(16s)]  then per array:
   array:   dtype_len(u16) dtype_str shape_ndim(u8) shape(u64*ndim)
            data_len(u64) data_bytes
 """
@@ -41,6 +50,7 @@ from numpy.lib.format import descr_to_dtype, dtype_to_descr
 
 MAGIC = b"NPW1"
 _FLAG_ERROR = 1
+_FLAG_TRACE = 2
 
 
 class WireError(ValueError):
@@ -75,13 +85,24 @@ def encode_arrays(
     *,
     uuid: Optional[bytes] = None,
     error: Optional[str] = None,
+    trace_id: Optional[bytes] = None,
 ) -> bytes:
-    """Encode arrays (+uuid, +optional error) into one framed message."""
+    """Encode arrays (+uuid, +optional error/trace_id) into one framed
+    message.  ``trace_id`` (16 bytes) is the telemetry correlation id;
+    ``None`` emits the exact pre-telemetry frame."""
     if uuid is None:
         uuid = uuid_mod.uuid4().bytes
     if len(uuid) != 16:
         raise WireError(f"uuid must be 16 bytes, got {len(uuid)}")
-    flags = _FLAG_ERROR if error is not None else 0
+    flags = 0
+    if error is not None:
+        flags |= _FLAG_ERROR
+    if trace_id is not None:
+        if len(trace_id) != 16:
+            raise WireError(
+                f"trace_id must be 16 bytes, got {len(trace_id)}"
+            )
+        flags |= _FLAG_TRACE
     parts: List[bytes] = [
         struct.pack("<4sBB16sI", MAGIC, 1, flags, uuid, len(arrays))
     ]
@@ -89,6 +110,8 @@ def encode_arrays(
         err = error.encode("utf-8")
         parts.append(struct.pack("<I", len(err)))
         parts.append(err)
+    if trace_id is not None:
+        parts.append(trace_id)
     for a in arrays:
         a = np.asarray(a)
         if a.dtype == object:
@@ -121,7 +144,19 @@ def encode_arrays(
 
 
 def decode_arrays(buf: bytes) -> Tuple[List[np.ndarray], bytes, Optional[str]]:
-    """Decode a framed message -> (arrays, uuid, error)."""
+    """Decode a framed message -> (arrays, uuid, error).
+
+    The historical 3-tuple shape; a frame carrying a trace id decodes
+    fine (the id is consumed and dropped).  Use :func:`decode_arrays_ex`
+    to also read the trace id."""
+    arrays, uuid, error, _ = decode_arrays_ex(buf)
+    return arrays, uuid, error
+
+
+def decode_arrays_ex(
+    buf: bytes,
+) -> Tuple[List[np.ndarray], bytes, Optional[str], Optional[bytes]]:
+    """Decode a framed message -> (arrays, uuid, error, trace_id)."""
     try:
         magic, version, flags, uuid, n = struct.unpack_from("<4sBB16sI", buf, 0)
     except struct.error as e:
@@ -142,6 +177,12 @@ def decode_arrays(buf: bytes) -> Tuple[List[np.ndarray], bytes, Optional[str]]:
             off += elen
         except (struct.error, UnicodeDecodeError) as e:
             raise WireError(f"truncated error block: {e}") from None
+    trace_id = None
+    if flags & _FLAG_TRACE:
+        if off + 16 > len(buf):
+            raise WireError("truncated trace block")
+        trace_id = buf[off : off + 16]
+        off += 16
     arrays: List[np.ndarray] = []
     for _ in range(n):
         try:
@@ -166,4 +207,4 @@ def decode_arrays(buf: bytes) -> Tuple[List[np.ndarray], bytes, Optional[str]]:
         except ValueError as e:
             # e.g. data_len inconsistent with shape * itemsize
             raise WireError(f"corrupt array payload: {e}") from None
-    return arrays, uuid, error
+    return arrays, uuid, error, trace_id

@@ -27,15 +27,50 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import math
+import time
 from typing import Callable, Optional, Sequence
 
 import grpc
 import numpy as np
 
 from ..signatures import ComputeFn
-from .npwire import decode_arrays, encode_arrays
+from ..telemetry import metrics as _metrics
+from ..telemetry import spans as _spans
+from .npwire import decode_arrays_ex, encode_arrays
 
 _log = logging.getLogger(__name__)
+
+# Node-side RPC instrumentation (metric catalog: docs/observability.md).
+# Declared at import time; every mutator is a no-op while telemetry is
+# disabled, so an uninstrumented deployment pays one branch per call.
+_REQUESTS = _metrics.counter(
+    "pftpu_server_requests_total",
+    "RPCs served by the node, by method",
+    ("method",),
+)
+_ERRORS = _metrics.counter(
+    "pftpu_server_errors_total",
+    "Node-side failures, by kind (decode or compute)",
+    ("kind",),
+)
+_INFLIGHT = _metrics.gauge(
+    "pftpu_server_inflight_requests",
+    "Evaluate RPCs currently being served",
+)
+_DECODE_S = _metrics.histogram(
+    "pftpu_server_decode_seconds", "Request wire-decode latency"
+)
+_QUEUE_S = _metrics.histogram(
+    "pftpu_server_queue_wait_seconds",
+    "Wait between RPC decode and compute start (thread-executor queue)",
+)
+_COMPUTE_S = _metrics.histogram(
+    "pftpu_server_compute_seconds", "compute_fn latency"
+)
+_ENCODE_S = _metrics.histogram(
+    "pftpu_server_encode_seconds", "Reply wire-encode latency"
+)
 
 SERVICE_NAME = "ArraysToArraysService"
 EVALUATE = f"/{SERVICE_NAME}/Evaluate"
@@ -139,42 +174,89 @@ class ArraysToArraysService:
         from . import npproto_codec
         from .npwire import MAGIC
 
+        t_arrive = time.perf_counter()
         is_npwire = request[:4] == MAGIC
+        trace_id = None
         if is_npwire:
             try:
-                inputs, uuid, _ = decode_arrays(request)
+                inputs, uuid, _, trace_id = decode_arrays_ex(request)
             except Exception as e:
+                _ERRORS.labels(kind="decode").inc()
                 return encode_arrays(
                     [], uuid=b"\0" * 16, error=f"decode error: {e}"
                 )
         else:
-            inputs, proto_uuid = npproto_codec.decode_arrays_msg(request)
-        try:
-            if self.inline_compute:
-                # Fast-compute path: the two thread handoffs of the
-                # executor dominate a sub-ms compute (docs/performance.md).
-                outputs = list(self.compute_fn(*inputs))
-            else:
-                loop = asyncio.get_running_loop()
-                outputs = await loop.run_in_executor(
-                    None, lambda: list(self.compute_fn(*inputs))
+            try:
+                inputs, proto_uuid, trace_id = (
+                    npproto_codec.decode_arrays_msg_ex(request)
                 )
-            outputs = [np.asarray(o) for o in outputs]
-        except Exception as e:
-            _log.exception("compute_fn failed")
-            if is_npwire:
-                return encode_arrays(
-                    [], uuid=uuid, error=f"compute error: {e}"
-                )
-            raise
-        if is_npwire:
-            return encode_arrays(outputs, uuid=uuid)
-        return npproto_codec.encode_arrays_msg(outputs, uuid=proto_uuid)
+            except Exception:
+                _ERRORS.labels(kind="decode").inc()
+                raise
+        t_decoded = time.perf_counter()
+        _DECODE_S.observe(t_decoded - t_arrive)
+        # Adopt the DRIVER's trace id off the wire (None is a no-op):
+        # the node-side span tree lands in this process's telemetry
+        # under the same 16-byte id as the driver-side tree.
+        with _spans.trace_context(trace_id), _spans.span(
+            "node.evaluate",
+            wire="npwire" if is_npwire else "npproto",
+            n_inputs=len(inputs),
+        ) as root:
+            root.set_attr("decode_s", t_decoded - t_arrive)
+            try:
+                with _spans.span("compute") as c_span:
+                    if self.inline_compute:
+                        # Fast-compute path: the two thread handoffs of
+                        # the executor dominate a sub-ms compute
+                        # (docs/performance.md).
+                        t_c0 = time.perf_counter()
+                        outputs = list(self.compute_fn(*inputs))
+                        t_c1 = time.perf_counter()
+                    else:
+                        loop = asyncio.get_running_loop()
+
+                        def timed_compute():
+                            t0 = time.perf_counter()
+                            out = list(self.compute_fn(*inputs))
+                            return out, t0, time.perf_counter()
+
+                        outputs, t_c0, t_c1 = await loop.run_in_executor(
+                            None, timed_compute
+                        )
+                    queue_wait = max(0.0, t_c0 - t_decoded)
+                    _QUEUE_S.observe(queue_wait)
+                    _COMPUTE_S.observe(t_c1 - t_c0)
+                    c_span.set_attr("queue_wait_s", queue_wait)
+                    outputs = [np.asarray(o) for o in outputs]
+            except Exception as e:
+                _log.exception("compute_fn failed")
+                _ERRORS.labels(kind="compute").inc()
+                if is_npwire:
+                    return encode_arrays(
+                        [], uuid=uuid, error=f"compute error: {e}"
+                    )
+                raise
+            with _spans.span("encode"):
+                t_e0 = time.perf_counter()
+                if is_npwire:
+                    reply = encode_arrays(outputs, uuid=uuid)
+                else:
+                    reply = npproto_codec.encode_arrays_msg(
+                        outputs, uuid=proto_uuid
+                    )
+                _ENCODE_S.observe(time.perf_counter() - t_e0)
+        return reply
 
     # -- RPC methods ------------------------------------------------------
 
     async def evaluate(self, request: bytes, context) -> bytes:
-        return await self._run_compute(request)
+        _REQUESTS.labels(method="evaluate").inc()
+        _INFLIGHT.inc()
+        try:
+            return await self._run_compute(request)
+        finally:
+            _INFLIGHT.dec()
 
     async def evaluate_stream(self, request_iterator, context):
         """Lock-step bidi stream: one reply per request, in order
@@ -183,13 +265,28 @@ class ArraysToArraysService:
         _log.info("stream opened (n_clients=%d)", self._n_clients)
         try:
             async for request in request_iterator:
-                yield await self._run_compute(request)
+                _REQUESTS.labels(method="evaluate_stream").inc()
+                _INFLIGHT.inc()
+                try:
+                    reply = await self._run_compute(request)
+                finally:
+                    _INFLIGHT.dec()
+                yield reply
         finally:
             self._n_clients -= 1
             _log.info("stream closed (n_clients=%d)", self._n_clients)
 
     def determine_load(self) -> dict:
-        """Load snapshot (reference: service.py:88-96 GetLoadResult)."""
+        """Load snapshot (reference: service.py:88-96 GetLoadResult).
+
+        With telemetry enabled, an ``"rpc"`` sub-dict folds the node's
+        live RPC picture into the reply — request counts, in-flight
+        depth, and compute/queue latency quantiles from the server
+        histograms — so a driver polling GetLoad sees WHY a node is
+        slow, not just that it is busy.  The three reference fields
+        stay top-level, so balancing (and the npproto reply, which has
+        no room for more) is unaffected.
+        """
         try:
             import psutil
 
@@ -197,13 +294,30 @@ class ArraysToArraysService:
             percent_ram = psutil.virtual_memory().percent
         except Exception:
             percent_cpu = percent_ram = -1.0
-        return {
+        load = {
             "n_clients": self._n_clients,
             "percent_cpu": percent_cpu,
             "percent_ram": percent_ram,
         }
+        if _spans.enabled():
+
+            def _q(hist, q):
+                v = hist.approx_quantile(q)
+                return None if math.isnan(v) or math.isinf(v) else v
+
+            load["rpc"] = {
+                "requests_total": sum(
+                    v for _n, _l, v in _REQUESTS.samples()
+                ),
+                "inflight": _INFLIGHT.value,
+                "compute_p50_s": _q(_COMPUTE_S, 0.5),
+                "compute_p99_s": _q(_COMPUTE_S, 0.99),
+                "queue_p99_s": _q(_QUEUE_S, 0.99),
+            }
+        return load
 
     async def get_load(self, request: bytes, context) -> bytes:
+        _REQUESTS.labels(method="get_load").inc()
         load = self.determine_load()
         if self.getload_wire == "npproto":
             from . import npproto_codec
@@ -244,6 +358,8 @@ async def serve(
     getload_wire: str = "npwire",
     inline_compute: bool = False,
     service: Optional[ArraysToArraysService] = None,
+    metrics_port: Optional[int] = None,
+    metrics_host: str = "127.0.0.1",
 ) -> grpc.aio.Server:
     """Start a node server (reference: demo_node.py:76-79).  Returns the
     started ``grpc.aio.Server``; await ``server.wait_for_termination()``.
@@ -251,7 +367,15 @@ async def serve(
     Pass EITHER ``compute_fn`` (+ optional ``getload_wire``) — the
     service is constructed here — or a pre-built ``service`` with
     ``compute_fn=None``; both at once would be two sources of truth for
-    what the node computes."""
+    what the node computes.
+
+    ``metrics_port`` (opt-in) starts a Prometheus-style exposition
+    endpoint (:mod:`..telemetry.export`) alongside the node — ``0``
+    binds an ephemeral port.  Loopback-bound by default: a node's RPC
+    telemetry can leak workload shape, so scraping across hosts is an
+    explicit ``metrics_host`` decision.  The running exporter hangs off
+    the returned server as ``server.metrics_exporter`` (``.port``,
+    ``.close()``); it stops with the daemon thread at process exit."""
     if service is None:
         if compute_fn is None:
             raise ValueError("pass compute_fn or a pre-built service")
@@ -268,6 +392,14 @@ async def serve(
     server = grpc.aio.server()
     server.add_generic_rpc_handlers((service.generic_handler(),))
     server.add_insecure_port(f"{bind}:{port}")
+    server.metrics_exporter = None
+    if metrics_port is not None:
+        from ..telemetry.export import start_exporter
+
+        # Before server.start(): if the exposition port is taken, this
+        # raises while nothing is listening yet, instead of leaking a
+        # started gRPC server the caller never received a handle to.
+        server.metrics_exporter = start_exporter(metrics_host, metrics_port)
     await server.start()
     _log.info("node listening on %s:%d", bind, port)
     return server
@@ -280,6 +412,8 @@ def run_node(
     *,
     getload_wire: str = "npwire",
     inline_compute: bool = False,
+    metrics_port: Optional[int] = None,
+    metrics_host: str = "127.0.0.1",
 ) -> None:
     """Blocking single-node entry point (reference: demo_node.py:83-95).
 
@@ -287,13 +421,17 @@ def run_node(
     so UNMODIFIED reference clients can balance over this node
     (Evaluate/EvaluateStream auto-detect per request either way).
     ``inline_compute=True`` skips the per-call thread-executor handoff
-    for sub-ms compute fns (see ArraysToArraysService)."""
+    for sub-ms compute fns (see ArraysToArraysService).
+    ``metrics_port`` opts into the telemetry exposition endpoint
+    (see :func:`serve`)."""
 
     async def main():
         server = await serve(
             compute_fn, bind, port,
             getload_wire=getload_wire,
             inline_compute=inline_compute,
+            metrics_port=metrics_port,
+            metrics_host=metrics_host,
         )
         await server.wait_for_termination()
 

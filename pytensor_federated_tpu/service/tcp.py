@@ -18,14 +18,26 @@ from __future__ import annotations
 
 import socket
 import struct
+import time
 import uuid as uuid_mod
 from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
-from .npwire import decode_arrays, encode_arrays
+from ..telemetry import spans as _spans
+from . import _rpc_metrics
+from .npwire import decode_arrays, decode_arrays_ex, encode_arrays
 
 __all__ = ["TcpArraysClient", "serve_tcp_once", "RemoteComputeError"]
+
+# Same metric families as the gRPC lane (client.py), labeled
+# transport="tcp" so both lanes aggregate on one dashboard
+# (metric catalog: docs/observability.md).
+_CALL_S = _rpc_metrics.CALL_S
+_RETRIES = _rpc_metrics.RETRIES
+_DROPS = _rpc_metrics.DROPS
+_BATCH_S = _rpc_metrics.BATCH_S
+_WINDOW_DEPTH = _rpc_metrics.WINDOW_DEPTH
 
 
 class RemoteComputeError(RuntimeError):
@@ -109,29 +121,56 @@ class TcpArraysClient:
             pass
 
     def evaluate(self, *arrays: np.ndarray) -> List[np.ndarray]:
-        uid = uuid_mod.uuid4().bytes
-        request = encode_arrays([np.asarray(a) for a in arrays], uuid=uid)
-        last_err: Optional[Exception] = None
-        for _ in range(self.retries + 1):
-            try:
-                sock = self._connect()
-                _send_frame(sock, request)
-                reply = self._read_frame()
-                break
-            except (ConnectionError, OSError) as e:
-                last_err = e
+        with _spans.span("rpc.evaluate", transport="tcp"):
+            with _spans.span("encode"):
+                uid = uuid_mod.uuid4().bytes
+                trace_id = (
+                    _spans.current_trace_id() if _spans.enabled() else None
+                )
+                request = encode_arrays(
+                    [np.asarray(a) for a in arrays],
+                    uuid=uid,
+                    trace_id=trace_id,
+                )
+            last_err: Optional[Exception] = None
+            for attempt in range(self.retries + 1):
+                if attempt:
+                    _RETRIES.labels(transport="tcp").inc()
+                t0 = time.perf_counter()
+                try:
+                    with _spans.span("call"):
+                        sock = self._connect()
+                        _send_frame(sock, request)
+                        reply = self._read_frame()
+                    break
+                except (ConnectionError, OSError) as e:
+                    last_err = e
+                    _DROPS.labels(transport="tcp").inc()
+                    self.close()
+            else:
+                raise ConnectionError(
+                    f"node {self.host}:{self.port} unreachable after "
+                    f"{self.retries + 1} attempts"
+                ) from last_err
+            with _spans.span("decode"):
+                outputs, reply_uid, error = decode_arrays(reply)
+            _CALL_S.labels(transport="tcp", mode="lockstep").observe(
+                time.perf_counter() - t0
+            )
+            if error is not None:
+                raise RemoteComputeError(error)
+            if reply_uid != uid:
+                # A mismatched reply means this connection is
+                # desynchronized (e.g. stale frames left by an aborted
+                # batch) — close it so the NEXT call reconnects cleanly
+                # instead of reading stale frames forever, matching
+                # _evaluate_many_once (ADVICE r5 #3).
+                _DROPS.labels(transport="tcp").inc()
                 self.close()
-        else:
-            raise ConnectionError(
-                f"node {self.host}:{self.port} unreachable after "
-                f"{self.retries + 1} attempts"
-            ) from last_err
-        outputs, reply_uid, error = decode_arrays(reply)
-        if error is not None:
-            raise RemoteComputeError(error)
-        if reply_uid != uid:
-            raise RuntimeError("uuid mismatch: reply does not match request")
-        return outputs
+                raise RuntimeError(
+                    "uuid mismatch: reply does not match request"
+                )
+            return outputs
 
     __call__ = evaluate
 
@@ -166,26 +205,51 @@ class TcpArraysClient:
         """
         if window < 1:
             raise ValueError(f"window must be >= 1, got {window}")
-        encoded = []
-        for args in requests:
-            uid = uuid_mod.uuid4().bytes
-            encoded.append(
-                (encode_arrays([np.asarray(a) for a in args], uuid=uid),
-                 uid)
-            )
-        if not encoded:
-            return []
-        last_err: Optional[Exception] = None
-        for _ in range(self.retries + 1):
-            try:
-                return self._evaluate_many_once(encoded, window)
-            except (ConnectionError, OSError) as e:
-                last_err = e
-                self.close()
-        raise ConnectionError(
-            f"node {self.host}:{self.port} unreachable after "
-            f"{self.retries + 1} attempts"
-        ) from last_err
+        with _spans.span(
+            "rpc.evaluate_many",
+            transport="tcp",
+            n=len(requests),
+            window=window,
+        ):
+            with _spans.span("encode"):
+                trace_id = (
+                    _spans.current_trace_id() if _spans.enabled() else None
+                )
+                encoded = []
+                for args in requests:
+                    uid = uuid_mod.uuid4().bytes
+                    encoded.append(
+                        (
+                            encode_arrays(
+                                [np.asarray(a) for a in args],
+                                uuid=uid,
+                                trace_id=trace_id,
+                            ),
+                            uid,
+                        )
+                    )
+            if not encoded:
+                return []
+            t0 = time.perf_counter()
+            last_err: Optional[Exception] = None
+            for attempt in range(self.retries + 1):
+                if attempt:
+                    _RETRIES.labels(transport="tcp").inc()
+                try:
+                    results = self._evaluate_many_once(encoded, window)
+                except (ConnectionError, OSError) as e:
+                    last_err = e
+                    _DROPS.labels(transport="tcp").inc()
+                    self.close()
+                    continue
+                _BATCH_S.labels(transport="tcp").observe(
+                    time.perf_counter() - t0
+                )
+                return results
+            raise ConnectionError(
+                f"node {self.host}:{self.port} unreachable after "
+                f"{self.retries + 1} attempts"
+            ) from last_err
 
     def _evaluate_many_once(self, encoded, window):
         sock = self._connect()
@@ -213,6 +277,9 @@ class TcpArraysClient:
                 write_idx += 1
             if burst:
                 sock.sendall(b"".join(burst))
+            _WINDOW_DEPTH.labels(transport="tcp").observe(
+                write_idx - read_idx
+            )
             reply = self._read_frame()
             request, uid = encoded[read_idx]
             inflight_bytes -= len(request)
@@ -223,6 +290,7 @@ class TcpArraysClient:
                 # connection cannot be trusted to stay correlated —
                 # close so the NEXT call reconnects cleanly, and let
                 # the WireError surface loudly (CLAUDE.md invariant).
+                _DROPS.labels(transport="tcp").inc()
                 self.close()
                 raise
             if error is not None:
@@ -235,9 +303,11 @@ class TcpArraysClient:
                     for _ in range(write_idx - read_idx - 1):
                         self._read_frame()
                 except (ConnectionError, OSError):
+                    _DROPS.labels(transport="tcp").inc()
                     self.close()
                 raise RemoteComputeError(error)
             if reply_uid != uid:
+                _DROPS.labels(transport="tcp").inc()
                 self.close()
                 raise RuntimeError(
                     "uuid mismatch: reply does not match request"
@@ -281,10 +351,20 @@ def serve_tcp_once(
                         payload = _recv_frame(conn)
                     except (ConnectionError, OSError):
                         break
-                    arrays, uid, _ = decode_arrays(payload)
-                    try:
-                        outputs = [np.asarray(o) for o in compute_fn(*arrays)]
-                        reply = encode_arrays(outputs, uuid=uid)
-                    except Exception as e:  # error -> error payload
-                        reply = encode_arrays([], uuid=uid, error=str(e))
+                    arrays, uid, _, trace_id = decode_arrays_ex(payload)
+                    # Node-side spans adopt the driver's wire trace id,
+                    # same contract as the gRPC server (server.py).
+                    with _spans.trace_context(trace_id), _spans.span(
+                        "node.evaluate", wire="npwire", transport="tcp"
+                    ):
+                        try:
+                            with _spans.span("compute"):
+                                outputs = [
+                                    np.asarray(o)
+                                    for o in compute_fn(*arrays)
+                                ]
+                            with _spans.span("encode"):
+                                reply = encode_arrays(outputs, uuid=uid)
+                        except Exception as e:  # error -> error payload
+                            reply = encode_arrays([], uuid=uid, error=str(e))
                     _send_frame(conn, reply)

@@ -1,0 +1,73 @@
+"""Telemetry: spans, metrics, and a Prometheus-style exposition lane.
+
+The system's cost structure is a pipeline of invisible stages — wire
+encode, queue wait, compute, decode, fanout overlap — and this package
+makes them always-on observable (the per-stage accounting DrJAX
+arXiv:2403.07128 and the TPU scaling study arXiv:2112.09017 lean on to
+find where MapReduce-style fanout loses hardware efficiency):
+
+- :mod:`.spans` — contextvar-propagated span trees with 16-byte trace
+  ids that ride the wire, correlating driver-side and node-side timing
+  of the same RPC.
+- :mod:`.metrics` — thread/asyncio-safe counters, gauges and
+  fixed-bucket histograms in a process-global registry, rendered in
+  classic Prometheus text format.
+- :mod:`.export` — opt-in HTTP exposition endpoint + snapshot()/JSONL
+  dump for pull-based collection.
+
+Dependency-free, and near-zero cost when disabled
+(``PFTPU_TELEMETRY=0`` or :func:`set_enabled`; bench.py's overhead
+gate measures the disabled path).  Metric names are catalogued in
+docs/observability.md.
+"""
+
+from .export import MetricsExporter, dump_jsonl, snapshot, start_exporter
+from .metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    REGISTRY,
+    Registry,
+    counter,
+    gauge,
+    histogram,
+    render_prometheus,
+)
+from .spans import (
+    Span,
+    clear_traces,
+    current_span,
+    current_trace_id,
+    enabled,
+    new_trace_id,
+    recent_traces,
+    set_enabled,
+    span,
+    trace_context,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsExporter",
+    "REGISTRY",
+    "Registry",
+    "Span",
+    "clear_traces",
+    "counter",
+    "current_span",
+    "current_trace_id",
+    "dump_jsonl",
+    "enabled",
+    "gauge",
+    "histogram",
+    "new_trace_id",
+    "recent_traces",
+    "render_prometheus",
+    "set_enabled",
+    "snapshot",
+    "span",
+    "start_exporter",
+    "trace_context",
+]

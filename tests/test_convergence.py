@@ -8,6 +8,8 @@ near the closed-form N(1-ρ)/(1+ρ)), and separated chains (R̂ ≫ 1).
 """
 
 import jax
+
+from pytensor_federated_tpu._compat import enable_x64
 import jax.numpy as jnp
 import numpy as np
 
@@ -90,7 +92,7 @@ def test_diagnostics_on_real_sampler_output():
 def test_x64_large_location_small_scale():
     """Under enable_x64, diagnostics must not downcast: location ~1e5
     with sd ~1e-3 quantizes to garbage in float32."""
-    with jax.enable_x64():
+    with enable_x64():
         rng = np.random.default_rng(4)
         draws = jnp.asarray(
             1e5 + 1e-3 * rng.normal(size=(C, N)), jnp.float64

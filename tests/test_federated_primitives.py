@@ -37,7 +37,10 @@ class TestPrimitives:
         mesh = make_mesh({"shards": 8}, devices=devices8)
         a = federated_map(lambda d: jnp.mean(d[0]), (x, y), mesh=mesh)
         b = federated_map(lambda d: jnp.mean(d[0]), (x, y))
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+        # rtol ~25x f32 eps: the mesh path's reduction order differs
+        # from vmap's, and where it lands within a few ulp varies by
+        # XLA version.
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=3e-6)
 
     def test_weighted_mean(self):
         vals = jnp.asarray([[1.0], [3.0]])

@@ -1,0 +1,160 @@
+"""Property-based reference-wire codec tests (hypothesis).
+
+Split out of test_npproto_codec.py so the example-based and interop
+suites there stay collectable on containers without hypothesis; this
+module skips itself instead.  The loud-WireError invariant (CLAUDE.md
+design invariants) over the npproto lane: any truncation, bit flip, or
+junk must raise WireError or decode self-consistently — and the
+telemetry trace id (field 15) must be ignorable by the reference
+schema under the official protobuf runtime.
+"""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
+from hypothesis.extra import numpy as hnp  # noqa: E402
+
+from pytensor_federated_tpu.service.npproto_codec import (  # noqa: E402
+    decode_arrays_msg,
+    decode_arrays_msg_ex,
+    decode_ndarray,
+    encode_arrays_msg,
+    encode_ndarray,
+)
+from pytensor_federated_tpu.service.npwire import WireError  # noqa: E402
+
+from test_npproto_codec import _official_messages  # noqa: E402
+
+_PROP = settings(max_examples=50, deadline=None)
+
+_simple_dtypes = st.one_of(
+    hnp.integer_dtypes(endianness="="),
+    hnp.unsigned_integer_dtypes(endianness="="),
+    hnp.floating_dtypes(endianness="=", sizes=(32, 64)),
+    hnp.complex_number_dtypes(endianness="="),
+    # str(dtype)/np.dtype round-trips datetime64/timedelta64, so the
+    # reference wire carries them (unlike structured dtypes).
+    hnp.datetime64_dtypes(endianness="="),
+    hnp.timedelta64_dtypes(endianness="="),
+    st.just(np.dtype("bool")),
+)
+
+_prop_arrays = _simple_dtypes.flatmap(
+    lambda dt: hnp.arrays(
+        dtype=dt,
+        shape=hnp.array_shapes(
+            min_dims=0, max_dims=3, min_side=0, max_side=6
+        ),
+    )
+)
+
+
+@_PROP
+@given(arr=_prop_arrays, uuid=st.text(max_size=24))
+def test_property_roundtrip(arr, uuid):
+    out, u = decode_arrays_msg(encode_arrays_msg([arr], uuid=uuid))
+    assert u == uuid
+    assert out[0].dtype == arr.dtype and out[0].shape == arr.shape
+    np.testing.assert_array_equal(out[0], arr)
+
+
+@_PROP
+@given(
+    arr=_prop_arrays,
+    uuid=st.text(max_size=24),
+    trace=st.binary(min_size=16, max_size=16),
+)
+def test_property_trace_id_ignorable_by_reference_schema(arr, uuid, trace):
+    """Telemetry extension field 15 must round-trip through
+    decode_arrays_msg_ex, be skipped by this codec's historical
+    2-tuple decoder, AND be skipped by the OFFICIAL protobuf runtime
+    parsing under the reference schema (which has no field 15) — for
+    any array, any uuid, any 16-byte id."""
+    buf = encode_arrays_msg([arr], uuid=uuid, trace_id=trace)
+    out, u, tid = decode_arrays_msg_ex(buf)
+    assert u == uuid and tid == trace
+    np.testing.assert_array_equal(out[0], arr)
+    out2, u2 = decode_arrays_msg(buf)
+    assert u2 == uuid
+    np.testing.assert_array_equal(out2[0], arr)
+    _nd, InputArrays, _gl = _official_messages()
+    msg = InputArrays()
+    msg.ParseFromString(buf)  # unknown field skipped by wire type
+    assert msg.uuid == uuid
+    assert len(msg.items) == 1
+    # and with NO trace id the bytes are identical to the pre-telemetry
+    # encoder's output (byte-level reference parity preserved)
+    assert encode_arrays_msg([arr], uuid=uuid) == encode_arrays_msg(
+        [arr], uuid=uuid, trace_id=None
+    )
+
+
+@_PROP
+@given(
+    arr=_prop_arrays,
+    cut=st.integers(min_value=0, max_value=200),
+)
+def test_property_truncation_never_silently_wrong(arr, cut):
+    """Any prefix of a valid single-item message must either raise
+    WireError or decode to a PREFIX of the truth: cutting at a field
+    boundary legitimately drops tail fields (proto3), so the only legal
+    successful decodes are ([], "") — cut before the item — or
+    ([exactly arr], "" or "u"); a cut INSIDE the item's length-framed
+    payload must overrun and raise.  Never another exception type,
+    never a corrupted array."""
+    buf = encode_arrays_msg([arr], uuid="u")
+    prefix = buf[: min(cut, len(buf))]
+    try:
+        out, uuid = decode_arrays_msg(prefix)
+    except WireError:
+        return
+    assert uuid in ("", "u")
+    assert len(out) in (0, 1)
+    for a in out:
+        assert a.dtype == arr.dtype and a.shape == arr.shape
+        np.testing.assert_array_equal(a, arr)
+
+
+@_PROP
+@given(
+    arr=_prop_arrays,
+    pos=st.integers(min_value=0),
+    bit=st.integers(min_value=0, max_value=7),
+)
+def test_property_bitflip_loud_or_consistent(arr, pos, bit):
+    """A single bit flip must produce WireError or a SELF-CONSISTENT
+    decode — no other exception type escapes (the npwire contract,
+    CLAUDE.md design invariants).  proto3 carries no payload checksum,
+    so a flip inside the data bytes legitimately decodes to different
+    VALUES; what must still hold is codec self-consistency: the result
+    re-encodes and round-trips to an identical array."""
+    buf = bytearray(encode_arrays_msg([arr], uuid="u"))
+    if not buf:
+        return
+    buf[pos % len(buf)] ^= 1 << bit
+    try:
+        out, _ = decode_arrays_msg(bytes(buf))
+    except WireError:
+        return
+    for a in out:
+        again = decode_ndarray(encode_ndarray(a))
+        assert again.dtype == a.dtype and again.shape == a.shape
+        np.testing.assert_array_equal(again, a)
+
+
+@_PROP
+@given(junk=st.binary(max_size=160))
+def test_property_junk_loud_or_valid(junk):
+    """Arbitrary bytes: WireError or a decode whose arrays survive this
+    codec's own round trip — never any other exception type."""
+    try:
+        out, u = decode_arrays_msg(junk)
+    except WireError:
+        return
+    assert isinstance(u, str)
+    for a in out:
+        again = decode_ndarray(encode_ndarray(a))
+        assert again.dtype == a.dtype and again.shape == a.shape
+        np.testing.assert_array_equal(again, a)

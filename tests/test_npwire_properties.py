@@ -10,12 +10,15 @@ never return silently wrong arrays for a *truncated* payload.
 
 import numpy as np
 import pytest
-from hypothesis import given, settings, strategies as st
-from hypothesis.extra import numpy as hnp
+
+pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
+from hypothesis.extra import numpy as hnp  # noqa: E402
 
 from pytensor_federated_tpu.service.npwire import (
     WireError,
     decode_arrays,
+    decode_arrays_ex,
     encode_arrays,
 )
 
@@ -90,6 +93,47 @@ def test_error_frames_roundtrip(arrs, err):
     dec, _, error = decode_arrays(encode_arrays(arrs, error=err))
     assert error == err
     assert len(dec) == len(arrs)
+
+
+@COMMON
+@given(
+    arrs=st.lists(_arrays, min_size=0, max_size=3),
+    trace=st.binary(min_size=16, max_size=16),
+    err=st.none() | st.text(max_size=100),
+)
+def test_trace_id_rides_and_is_ignorable(arrs, trace, err):
+    """The telemetry trace block (flag bit 2) must round-trip through
+    the extended decoder AND be consumed-and-dropped by the historical
+    3-tuple decoder — for any arrays, any 16-byte id, with or without
+    a coexisting error block."""
+    enc = encode_arrays(arrs, error=err, trace_id=trace)
+    dec, uuid, error, tid = decode_arrays_ex(enc)
+    assert tid == trace and error == err and len(dec) == len(arrs)
+    legacy_dec, legacy_uuid, legacy_err = decode_arrays(enc)
+    assert legacy_uuid == uuid and legacy_err == err
+    for a, b in zip(arrs, legacy_dec):
+        np.testing.assert_array_equal(a, b)
+    # absent trace id -> byte-identical pre-telemetry frame
+    assert encode_arrays(arrs, uuid=uuid, error=err) == encode_arrays(
+        arrs, uuid=uuid, error=err, trace_id=None
+    )
+
+
+@COMMON
+@given(
+    arrs=st.lists(_arrays, min_size=0, max_size=2),
+    trace=st.binary(min_size=16, max_size=16),
+    cut=st.floats(min_value=0.0, max_value=0.999),
+)
+def test_traced_truncation_never_silently_wrong(arrs, trace, cut):
+    """Truncation anywhere in a trace-bearing frame — including inside
+    the trace block itself — stays a loud WireError."""
+    enc = encode_arrays(arrs, trace_id=trace)
+    prefix = enc[: int(len(enc) * cut)]
+    if prefix == enc:  # pragma: no cover - cut<1 guarantees strict prefix
+        return
+    with pytest.raises(WireError):
+        decode_arrays_ex(prefix)
 
 
 def test_structured_dtype_roundtrip():

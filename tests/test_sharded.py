@@ -6,6 +6,8 @@ match a natively-built single-device model exactly
 """
 
 import jax
+
+from pytensor_federated_tpu._compat import enable_x64
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -202,7 +204,7 @@ def test_x64_opt_in():
 
     fed32 = FederatedLogp(per_shard, data)
     assert fed32.logp(jnp.asarray(0.5)).dtype == jnp.float32
-    with jax.enable_x64():
+    with enable_x64():
         data64 = (jnp.arange(8.0, dtype=jnp.float64).reshape(8, 1),)
         fed64 = FederatedLogp(per_shard, data64)
         out = fed64.logp(jnp.asarray(0.5, dtype=jnp.float64))

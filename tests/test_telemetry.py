@@ -1,0 +1,1069 @@
+"""Telemetry subsystem (pytensor_federated_tpu/telemetry/): span trees,
+metrics registry, Prometheus exposition, and end-to-end driver<->node
+trace correlation over the in-repo gRPC and TCP services.
+
+Covers the ISSUE 1 acceptance path explicitly: a federated evaluation
+over the real service produces a correlated driver+node span tree and
+nonzero RPC histograms, renderable as valid Prometheus text format
+(golden-file + structural validation), with the trace id ignorable by
+the OFFICIAL protobuf runtime (reference-codec compatibility).
+"""
+
+import asyncio
+import json
+import socket
+import struct
+import threading
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from pytensor_federated_tpu import telemetry
+from pytensor_federated_tpu.telemetry import metrics as tmetrics
+from pytensor_federated_tpu.telemetry import spans as tspans
+
+GOLDEN = Path(__file__).resolve().parent / "data" / "telemetry_exposition.txt"
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    """Telemetry is process-global; every test starts zeroed + enabled."""
+    prev = tspans.set_enabled(True)
+    telemetry.REGISTRY.reset()
+    telemetry.clear_traces()
+    yield
+    tspans.set_enabled(prev)
+    telemetry.REGISTRY.reset()
+    telemetry.clear_traces()
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+
+class TestSpans:
+    def test_nesting_builds_tree(self):
+        with telemetry.span("root", kind="demo") as r:
+            with telemetry.span("child_a"):
+                with telemetry.span("leaf"):
+                    pass
+            with telemetry.span("child_b") as b:
+                b.set_attr("note", "late")
+        assert r.span.duration > 0
+        (tree,) = telemetry.recent_traces()
+        assert tree["name"] == "root"
+        assert tree["attrs"] == {"kind": "demo"}
+        names = [c["name"] for c in tree["children"]]
+        assert names == ["child_a", "child_b"]
+        assert tree["children"][0]["children"][0]["name"] == "leaf"
+        assert tree["children"][1]["attrs"]["note"] == "late"
+        # one trace id threads the whole tree
+        assert {tree["trace_id"]} == {
+            c["trace_id"] for c in tree["children"]
+        }
+
+    def test_exception_recorded_never_swallowed(self):
+        with pytest.raises(ValueError, match="boom"):
+            with telemetry.span("failing"):
+                raise ValueError("boom")
+        (tree,) = telemetry.recent_traces()
+        assert tree["error"] == "ValueError: boom"
+
+    def test_trace_context_adopts_wire_id(self):
+        """The node-side correlation primitive: spans opened under an
+        adopted trace id form a SEPARATE root carrying the driver's id."""
+        wire_id = telemetry.new_trace_id()
+        with telemetry.trace_context(wire_id):
+            with telemetry.span("node.evaluate"):
+                pass
+        (tree,) = telemetry.recent_traces()
+        assert tree["trace_id"] == wire_id.hex()
+        # None (no id on the wire) is a no-op
+        with telemetry.trace_context(None):
+            with telemetry.span("solo"):
+                pass
+        assert telemetry.recent_traces()[-1]["name"] == "solo"
+
+    def test_disabled_is_shared_noop(self):
+        tspans.set_enabled(False)
+        cm1, cm2 = telemetry.span("a"), telemetry.span("b", x=1)
+        assert cm1 is cm2  # no allocation on the disabled path
+        with cm1 as s:
+            assert s.span is None
+            s.set_attr("ignored", True)
+        assert telemetry.recent_traces() == []
+
+    def test_ring_buffer_capacity(self):
+        tspans.set_trace_capacity(4)
+        try:
+            for i in range(7):
+                with telemetry.span(f"s{i}"):
+                    pass
+            names = [t["name"] for t in telemetry.recent_traces()]
+            assert names == ["s3", "s4", "s5", "s6"]  # newest kept
+            with pytest.raises(ValueError):
+                tspans.set_trace_capacity(0)
+        finally:
+            tspans.set_trace_capacity(64)
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+
+class TestMetrics:
+    def test_counter(self):
+        c = telemetry.counter("t_requests_total", "demo", ("method",))
+        c.labels(method="a").inc()
+        c.labels(method="a").inc(2.5)
+        c.labels(method="b").inc()
+        assert c.labels(method="a").value == 3.5
+        with pytest.raises(ValueError, match="increase"):
+            c.labels(method="a").inc(-1)
+        with pytest.raises(ValueError, match="expected labels"):
+            c.labels(wrong="a")
+
+    def test_gauge(self):
+        g = telemetry.gauge("t_inflight", "demo")
+        g.set(5)
+        g.inc()
+        g.dec(2)
+        assert g.value == 4.0
+
+    def test_histogram_buckets_and_quantile(self):
+        h = telemetry.histogram(
+            "t_latency_seconds", "demo", buckets=(0.01, 0.1, 1.0)
+        )
+        for v in (0.005, 0.05, 0.05, 0.5, 5.0):
+            h.observe(v)
+        assert h.count == 5
+        assert h.sum == pytest.approx(5.605)
+        assert h.approx_quantile(0.5) == 0.1  # 3rd of 5 lands in le=0.1
+        assert h.approx_quantile(1.0) == float("inf")
+        import math
+
+        assert math.isnan(
+            telemetry.histogram(
+                "t_empty_seconds", "demo"
+            ).approx_quantile(0.5)
+        )
+
+    def test_reregistration_conflicts_raise(self):
+        telemetry.counter("t_conflict_total", "demo")
+        # same declaration merges
+        telemetry.counter("t_conflict_total", "demo")
+        with pytest.raises(ValueError, match="already registered"):
+            telemetry.gauge("t_conflict_total", "demo")
+        with pytest.raises(ValueError, match="already registered"):
+            telemetry.counter("t_conflict_total", "demo", ("extra",))
+        telemetry.histogram("t_conflict_seconds", "demo", buckets=(1.0,))
+        with pytest.raises(ValueError, match="buckets"):
+            telemetry.histogram(
+                "t_conflict_seconds", "demo", buckets=(2.0,)
+            )
+
+    def test_invalid_names_raise(self):
+        with pytest.raises(ValueError, match="invalid"):
+            telemetry.counter("bad name", "demo")
+        with pytest.raises(ValueError, match="invalid"):
+            telemetry.counter("1leading", "demo")
+
+    def test_disabled_mutators_are_noops(self):
+        c = telemetry.counter("t_gate_total", "demo")
+        h = telemetry.histogram("t_gate_seconds", "demo")
+        tspans.set_enabled(False)
+        c.inc()
+        h.observe(1.0)
+        tspans.set_enabled(True)
+        assert c.value == 0.0 and h.count == 0
+
+    def test_reset_zeroes_but_keeps_registrations(self):
+        c = telemetry.counter("t_reset_total", "demo")
+        c.inc(7)
+        telemetry.REGISTRY.reset()
+        assert c.value == 0.0  # the SAME object an instrumented
+        c.inc()  # module still holds keeps working
+        assert telemetry.REGISTRY.get("t_reset_total").value == 1.0
+
+    def test_exemplar_links_to_trace(self):
+        h = telemetry.histogram("t_exemplar_seconds", "demo")
+        with telemetry.span("op"):
+            h.observe(0.25)
+            tid = tspans.current_trace_id().hex()
+        snap = tmetrics.snapshot()["t_exemplar_seconds"]["children"][0]
+        assert snap["exemplar"] == {"value": 0.25, "trace_id": tid}
+
+
+# ---------------------------------------------------------------------------
+# Prometheus rendering: golden file + structural validation
+# ---------------------------------------------------------------------------
+
+
+def _golden_registry() -> telemetry.Registry:
+    """A FIXED observation sequence (fresh registry, no global state)."""
+    reg = telemetry.Registry()
+    c = reg.counter("demo_requests_total", "RPCs served", ("method",))
+    c.labels(method="evaluate").inc(3)
+    c.labels(method="get_load").inc()
+    g = reg.gauge("demo_inflight_requests", "Evaluate RPCs in flight")
+    g.set(2)
+    h = reg.histogram(
+        "demo_latency_seconds",
+        'Latency with "quoted" help and a \\ backslash',
+        ("transport",),
+        buckets=(0.001, 0.01, 0.1),
+    )
+    for v in (0.0005, 0.005, 0.005, 0.05, 1.5):
+        h.labels(transport="grpc").observe(v)
+    return reg
+
+
+def validate_prometheus_text(text: str) -> dict:
+    """Structural check of classic exposition format 0.0.4; returns
+    {family: [(name, labels_str, value)]}."""
+    families, current = {}, None
+    for line in text.splitlines():
+        assert line.strip() == line and line, f"bad line framing: {line!r}"
+        if line.startswith("# HELP "):
+            current = line.split()[2]
+            families[current] = []
+        elif line.startswith("# TYPE "):
+            parts = line.split()
+            assert parts[2] == current, "TYPE must follow its HELP"
+            assert parts[3] in ("counter", "gauge", "histogram", "untyped")
+        else:
+            name, _, rest = line.partition("{")
+            if rest:
+                labels, _, value = rest.rpartition("} ")
+            else:
+                name, _, value = line.rpartition(" ")
+                labels = ""
+            float(value)  # must parse (+Inf/NaN are valid spellings)
+            assert name.startswith(current), (
+                f"sample {name!r} outside its family {current!r}"
+            )
+            families[current].append((name, labels, value))
+    return families
+
+
+class TestPrometheusText:
+    def test_golden_file(self):
+        text = telemetry.render_prometheus(_golden_registry())
+        assert text == GOLDEN.read_text(), (
+            "exposition text drifted from the golden file; if the "
+            "change is intentional, regenerate tests/data/"
+            "telemetry_exposition.txt"
+        )
+
+    def test_structure_and_histogram_invariants(self):
+        text = telemetry.render_prometheus(_golden_registry())
+        fams = validate_prometheus_text(text)
+        rows = fams["demo_latency_seconds"]
+        buckets = [r for r in rows if r[0].endswith("_bucket")]
+        counts = [float(v) for _, _, v in buckets]
+        assert counts == sorted(counts), "buckets must be cumulative"
+        assert buckets[-1][1].endswith('le="+Inf"')
+        (count_row,) = [
+            r for r in rows if r[0] == "demo_latency_seconds_count"
+        ]
+        assert float(count_row[2]) == counts[-1] == 5.0
+        (sum_row,) = [r for r in rows if r[0] == "demo_latency_seconds_sum"]
+        assert float(sum_row[2]) == pytest.approx(1.5605)
+        # label escaping survived
+        assert 'transport="grpc"' in buckets[0][1]
+
+    def test_deterministic(self):
+        a = telemetry.render_prometheus(_golden_registry())
+        b = telemetry.render_prometheus(_golden_registry())
+        assert a == b
+
+
+# ---------------------------------------------------------------------------
+# exposition lane: snapshot / JSONL / HTTP exporter
+# ---------------------------------------------------------------------------
+
+
+class TestExport:
+    def test_snapshot_shape(self):
+        telemetry.counter("t_snap_total", "demo").inc()
+        with telemetry.span("snap.op"):
+            pass
+        snap = telemetry.snapshot()
+        assert snap["enabled"] is True
+        assert snap["metrics"]["t_snap_total"]["children"][0]["value"] == 1
+        assert snap["traces"][-1]["name"] == "snap.op"
+
+    def test_dump_jsonl_appends(self, tmp_path):
+        path = tmp_path / "t.jsonl"
+        telemetry.dump_jsonl(str(path))
+        telemetry.dump_jsonl(str(path))
+        lines = path.read_text().splitlines()
+        assert len(lines) == 2
+        rec = json.loads(lines[1])
+        assert rec["ts"] > 0 and "metrics" in rec
+
+    def test_http_exporter_serves_all_routes(self):
+        telemetry.counter("t_http_total", "demo").inc(2)
+        with telemetry.span("http.op"):
+            pass
+        with telemetry.start_exporter(port=0) as exporter:
+            base = f"http://127.0.0.1:{exporter.port}"
+
+            def get(path):
+                with urllib.request.urlopen(base + path, timeout=5) as r:
+                    return r.headers.get("Content-Type"), r.read()
+
+            ctype, body = get("/metrics")
+            assert ctype.startswith("text/plain; version=0.0.4")
+            assert b"t_http_total 2" in body
+            validate_prometheus_text(body.decode())
+
+            ctype, body = get("/snapshot")
+            assert ctype == "application/json"
+            assert json.loads(body)["enabled"] is True
+
+            _, body = get("/traces")
+            assert any(t["name"] == "http.op" for t in json.loads(body))
+
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                get("/nope")
+            assert exc.value.code == 404
+        # closed: the port no longer answers
+        with pytest.raises((ConnectionError, urllib.error.URLError, OSError)):
+            urllib.request.urlopen(base + "/metrics", timeout=1)
+
+    def test_metrics_dump_tool_roundtrip(self, tmp_path, capsys):
+        import sys
+
+        sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+        try:
+            from tools import metrics_dump
+        except ImportError:  # tools/ has no __init__; import by path
+            import importlib.util
+
+            spec = importlib.util.spec_from_file_location(
+                "metrics_dump",
+                Path(__file__).resolve().parent.parent
+                / "tools"
+                / "metrics_dump.py",
+            )
+            metrics_dump = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(metrics_dump)
+        finally:
+            sys.path.pop(0)
+
+        telemetry.counter("t_tool_total", "demo").inc(5)
+        out = tmp_path / "scrape.jsonl"
+        with telemetry.start_exporter(port=0) as exporter:
+            rc = metrics_dump.main(
+                ["--port", str(exporter.port), "--out", str(out)]
+            )
+            assert rc == 0
+            rc = metrics_dump.main(["--port", str(exporter.port), "--text"])
+            assert rc == 0
+        rec = json.loads(out.read_text())
+        assert (
+            rec["metrics"]["t_tool_total"]["children"][0]["value"] == 5
+        )
+        assert "t_tool_total 5" in capsys.readouterr().out
+        # unreachable endpoint: exit 1, not a traceback
+        assert metrics_dump.main(["--port", str(_free_port())]) == 1
+
+
+# ---------------------------------------------------------------------------
+# trace id on the wire
+# ---------------------------------------------------------------------------
+
+
+class TestWireTraceId:
+    def test_npwire_roundtrip_and_legacy_decode(self):
+        from pytensor_federated_tpu.service.npwire import (
+            WireError,
+            decode_arrays,
+            decode_arrays_ex,
+            encode_arrays,
+        )
+
+        tid = telemetry.new_trace_id()
+        uid = b"u" * 16
+        arrs = [np.arange(3.0), np.float64(7.0)]
+        enc = encode_arrays(arrs, uuid=uid, trace_id=tid)
+        dec, ruid, err, rtid = decode_arrays_ex(enc)
+        assert (ruid, err, rtid) == (uid, None, tid)
+        np.testing.assert_array_equal(dec[0], arrs[0])
+        # the historical 3-tuple decoder consumes-and-drops the block
+        dec2, ruid2, err2 = decode_arrays(enc)
+        assert ruid2 == uid and err2 is None and len(dec2) == 2
+        # error + trace coexist
+        enc_e = encode_arrays([], uuid=uid, error="boom", trace_id=tid)
+        _, _, err_e, tid_e = decode_arrays_ex(enc_e)
+        assert err_e == "boom" and tid_e == tid
+        # no trace -> byte-identical pre-telemetry frame
+        assert encode_arrays(arrs, uuid=uid) == encode_arrays(
+            arrs, uuid=uid, trace_id=None
+        )
+        # malformed inputs fail loudly
+        with pytest.raises(WireError, match="16 bytes"):
+            encode_arrays(arrs, uuid=uid, trace_id=b"short")
+        with pytest.raises(WireError, match="trace block"):
+            decode_arrays_ex(enc[: 4 + 1 + 1 + 16 + 4 + 8])
+
+    def test_npproto_field15_roundtrip_and_skip(self):
+        from pytensor_federated_tpu.service.npproto_codec import (
+            WireError,
+            decode_arrays_msg,
+            decode_arrays_msg_ex,
+            encode_arrays_msg,
+        )
+
+        tid = telemetry.new_trace_id()
+        arrs = [np.arange(4, dtype=np.int32)]
+        enc = encode_arrays_msg(arrs, uuid="abc", trace_id=tid)
+        dec, uuid, rtid = decode_arrays_msg_ex(enc)
+        assert uuid == "abc" and rtid == tid
+        np.testing.assert_array_equal(dec[0], arrs[0])
+        # the historical 2-tuple decoder skips field 15 like any
+        # unknown field
+        dec2, uuid2 = decode_arrays_msg(enc)
+        assert uuid2 == "abc" and len(dec2) == 1
+        assert encode_arrays_msg(arrs, uuid="abc") == encode_arrays_msg(
+            arrs, uuid="abc", trace_id=None
+        )
+        with pytest.raises(WireError, match="16 bytes"):
+            encode_arrays_msg(arrs, uuid="abc", trace_id=b"xy")
+
+    def test_npproto_trace_ignorable_by_official_runtime(self):
+        """THE reference-codec compatibility property: the OFFICIAL
+        protobuf runtime, built against the reference schema (which
+        has no field 15), must parse a trace-bearing InputArrays to
+        the same arrays+uuid — unknown field skipped by wire type."""
+        pytest.importorskip("google.protobuf", reason="cross-check")
+        from google.protobuf import (
+            descriptor_pb2,
+            descriptor_pool,
+            message_factory,
+        )
+
+        from pytensor_federated_tpu.service.npproto_codec import (
+            encode_arrays_msg,
+        )
+
+        pool = descriptor_pool.DescriptorPool()
+        fdp = descriptor_pb2.FileDescriptorProto()
+        fdp.name = "tel.proto"
+        fdp.package = "tel"
+        fdp.syntax = "proto3"
+        F = descriptor_pb2.FieldDescriptorProto
+        nd = fdp.message_type.add()
+        nd.name = "ndarray"
+        for name, num, ftype, label in [
+            ("data", 1, F.TYPE_BYTES, F.LABEL_OPTIONAL),
+            ("dtype", 2, F.TYPE_STRING, F.LABEL_OPTIONAL),
+            ("shape", 3, F.TYPE_INT64, F.LABEL_REPEATED),
+            ("strides", 4, F.TYPE_INT64, F.LABEL_REPEATED),
+        ]:
+            f = nd.field.add()
+            f.name, f.number, f.type, f.label = name, num, ftype, label
+        m = fdp.message_type.add()
+        m.name = "InputArrays"
+        f = m.field.add()
+        f.name, f.number, f.type, f.label = (
+            "items", 1, F.TYPE_MESSAGE, F.LABEL_REPEATED,
+        )
+        f.type_name = ".tel.ndarray"
+        f = m.field.add()
+        f.name, f.number, f.type, f.label = (
+            "uuid", 2, F.TYPE_STRING, F.LABEL_OPTIONAL,
+        )
+        pool.Add(fdp)
+        InputArrays = message_factory.GetMessageClass(
+            pool.FindMessageTypeByName("tel.InputArrays")
+        )
+
+        arr = np.linspace(0, 1, 5)
+        enc = encode_arrays_msg(
+            [arr], uuid="ref-uuid", trace_id=telemetry.new_trace_id()
+        )
+        msg = InputArrays()
+        msg.ParseFromString(enc)  # must not choke on field 15
+        assert msg.uuid == "ref-uuid"
+        assert len(msg.items) == 1
+        got = np.frombuffer(
+            msg.items[0].data, dtype=np.dtype(msg.items[0].dtype)
+        ).reshape(tuple(msg.items[0].shape))
+        np.testing.assert_array_equal(got, arr)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end correlation over the real services (acceptance criteria)
+# ---------------------------------------------------------------------------
+
+
+def _server_histogram_counts():
+    reg = telemetry.REGISTRY
+    return {
+        name: sum(
+            c._count for c in reg.get(name)._children.values()
+        )
+        for name in (
+            "pftpu_server_decode_seconds",
+            "pftpu_server_queue_wait_seconds",
+            "pftpu_server_compute_seconds",
+            "pftpu_server_encode_seconds",
+        )
+    }
+
+
+class TestEndToEndCorrelation:
+    def _roots_by_name(self, name):
+        return [t for t in telemetry.recent_traces() if t["name"] == name]
+
+    @pytest.mark.parametrize("codec", ["npwire", "npproto"])
+    def test_grpc_driver_and_node_spans_correlate(self, codec):
+        from pytensor_federated_tpu.service import (
+            ArraysToArraysServiceClient,
+        )
+        from pytensor_federated_tpu.service.server import (
+            ArraysToArraysService,
+            serve,
+        )
+
+        def compute(x):
+            return [np.asarray(-np.sum(np.asarray(x) ** 2))]
+
+        async def main():
+            port = _free_port()
+            service = ArraysToArraysService(compute)
+            server = await serve(None, "127.0.0.1", port, service=service)
+            try:
+                client = ArraysToArraysServiceClient(
+                    "127.0.0.1", port, codec=codec
+                )
+                out = await client.evaluate_async(np.array([1.0, 2.0]))
+                np.testing.assert_allclose(float(np.asarray(out[0])), -5.0)
+            finally:
+                await server.stop(None)
+
+        asyncio.run(main())
+
+        # Driver root + node root share ONE wire-carried trace id.
+        (drv,) = self._roots_by_name("rpc.evaluate")
+        (node,) = self._roots_by_name("node.evaluate")
+        assert drv["trace_id"] == node["trace_id"]
+        assert drv["attrs"]["transport"] == "grpc"
+        assert node["attrs"]["wire"] == codec
+        drv_children = [c["name"] for c in drv["children"]]
+        assert drv_children == ["encode", "call", "decode"]
+        node_children = [c["name"] for c in node["children"]]
+        assert node_children == ["compute", "encode"]
+        # the driver's call envelope covers the node's whole service time
+        call_s = drv["children"][drv_children.index("call")]["duration_s"]
+        assert call_s >= node["duration_s"] * 0.5
+
+        # Nonzero RPC histograms on both sides…
+        for name, count in _server_histogram_counts().items():
+            assert count >= 1, f"{name} never observed"
+        call_hist = telemetry.REGISTRY.get("pftpu_client_call_seconds")
+        assert call_hist.labels(transport="grpc", mode="stream").count >= 1
+        # …renderable as valid Prometheus text.
+        validate_prometheus_text(telemetry.render_prometheus())
+
+    def test_tcp_lane_correlates_too(self):
+        from pytensor_federated_tpu.service import (
+            TcpArraysClient,
+            serve_tcp_once,
+        )
+
+        port_box, ready = {}, threading.Event()
+
+        def ready_cb(port):
+            port_box["port"] = port
+            ready.set()
+
+        t = threading.Thread(
+            target=serve_tcp_once,
+            args=(lambda *a: [2.0 * x for x in a],),
+            kwargs={"ready_callback": ready_cb, "max_connections": 1},
+            daemon=True,
+        )
+        t.start()
+        assert ready.wait(10)
+        client = TcpArraysClient("127.0.0.1", port_box["port"])
+        out = client.evaluate(np.arange(3.0))
+        np.testing.assert_array_equal(out[0], 2.0 * np.arange(3.0))
+        client.close()
+        t.join(timeout=10)
+
+        (drv,) = self._roots_by_name("rpc.evaluate")
+        (node,) = self._roots_by_name("node.evaluate")
+        assert drv["trace_id"] == node["trace_id"]
+        assert drv["attrs"]["transport"] == "tcp"
+        assert node["attrs"]["transport"] == "tcp"
+        call_hist = telemetry.REGISTRY.get("pftpu_client_call_seconds")
+        assert (
+            call_hist.labels(transport="tcp", mode="lockstep").count == 1
+        )
+
+    def test_disabled_means_no_trace_on_wire_and_no_metrics(self):
+        from pytensor_federated_tpu.service.npwire import decode_arrays_ex
+        from pytensor_federated_tpu.service.tcp import TcpArraysClient
+
+        seen = {}
+
+        def server():
+            from pytensor_federated_tpu.service.npwire import encode_arrays
+            from pytensor_federated_tpu.service.tcp import (
+                _recv_frame,
+                _send_frame,
+            )
+
+            srv = socket.socket()
+            srv.bind(("127.0.0.1", 0))
+            srv.listen(1)
+            seen["port"] = srv.getsockname()[1]
+            ready.set()
+            conn, _ = srv.accept()
+            with conn, srv:
+                payload = _recv_frame(conn)
+                arrays, uid, _err, tid = decode_arrays_ex(payload)
+                seen["trace_id"] = tid
+                _send_frame(conn, encode_arrays(arrays, uuid=uid))
+
+        ready = threading.Event()
+        t = threading.Thread(target=server, daemon=True)
+        t.start()
+        assert ready.wait(10)
+        tspans.set_enabled(False)
+        client = TcpArraysClient("127.0.0.1", seen["port"])
+        client.evaluate(np.ones(2))
+        client.close()
+        t.join(timeout=10)
+        assert seen["trace_id"] is None  # telemetry off -> bare wire
+        tspans.set_enabled(True)
+        assert telemetry.recent_traces() == []
+        call_hist = telemetry.REGISTRY.get("pftpu_client_call_seconds")
+        assert call_hist.labels(transport="tcp", mode="lockstep").count == 0
+
+
+# determine_load needs served traffic to have quantiles; probe helper
+async def _probe(service):
+    from pytensor_federated_tpu.service.npwire import encode_arrays
+
+    req = encode_arrays([np.ones(2)], uuid=b"p" * 16)
+    await service.evaluate(req, None)
+
+
+def test_getload_rpc_summary_and_npproto_reply_unchanged():
+    from pytensor_federated_tpu.service.npproto_codec import (
+        decode_get_load_result,
+        encode_get_load_result,
+    )
+    from pytensor_federated_tpu.service.server import ArraysToArraysService
+
+    service = ArraysToArraysService(lambda x: [x], inline_compute=True)
+    asyncio.run(_probe(service))
+    load = service.determine_load()
+    assert load["rpc"]["requests_total"] >= 1
+    assert load["rpc"]["inflight"] == 0
+    assert load["rpc"]["compute_p50_s"] is not None
+    # reference fields stay top-level for balancing
+    assert {"n_clients", "percent_cpu", "percent_ram"} <= set(load)
+    # the npproto GetLoad reply carries ONLY the three reference fields
+    wire = encode_get_load_result(load["n_clients"], 12.5, 37.5)
+    assert set(decode_get_load_result(wire)) == {
+        "n_clients", "percent_cpu", "percent_ram",
+    }
+    # disabled -> the rpc sub-dict disappears entirely
+    tspans.set_enabled(False)
+    assert "rpc" not in service.determine_load()
+    tspans.set_enabled(True)
+
+
+# ---------------------------------------------------------------------------
+# fanout + sampler instrumentation
+# ---------------------------------------------------------------------------
+
+
+def test_fanout_span_tree_and_straggler_gap():
+    import time as time_mod
+
+    from pytensor_federated_tpu.fanout_exec import (
+        MemberExecutorPool,
+        run_members,
+    )
+
+    delays = [0.0, 0.05, 0.0]
+
+    def make_member(i):
+        def member(sub_inputs, sub_storage):
+            time_mod.sleep(delays[i])
+            sub_storage[0][0] = sub_inputs[0] + i
+
+        return member
+
+    pool = MemberExecutorPool(3)
+    storage = [[None], [None], [None]]
+    run_members(
+        [make_member(i) for i in range(3)],
+        [1, 1, 1], [1, 1, 1], [10, 20, 30], storage, pool,
+    )
+    pool.shutdown()
+    assert [c[0] for c in storage] == [10, 21, 32]
+
+    (tree,) = [
+        t for t in telemetry.recent_traces() if t["name"] == "fanout"
+    ]
+    assert tree["attrs"]["width"] == 3
+    # members crossed the thread pool but parent under the fanout span
+    members = [c for c in tree["children"] if c["name"] == "fanout.member"]
+    assert sorted(m["attrs"]["idx"] for m in members) == [0, 1, 2]
+    assert tree["attrs"]["straggler_gap_s"] >= 0.03
+    width = telemetry.REGISTRY.get("pftpu_fanout_width")
+    assert width.count == 1
+    gap = telemetry.REGISTRY.get("pftpu_fanout_straggler_seconds")
+    assert gap.sum >= 0.03
+    assert telemetry.REGISTRY.get("pftpu_fanout_member_seconds").count == 3
+
+
+def test_fanout_disabled_path_unchanged():
+    from pytensor_federated_tpu.fanout_exec import (
+        MemberExecutorPool,
+        run_members,
+    )
+
+    tspans.set_enabled(False)
+    pool = MemberExecutorPool(2)
+    storage = [[None], [None]]
+    run_members(
+        [
+            lambda i, s: s[0].__setitem__(0, i[0]),
+            lambda i, s: s[0].__setitem__(0, i[0]),
+        ],
+        [1, 1], [1, 1], [1, 2], storage, pool,
+    )
+    pool.shutdown()
+    assert [c[0] for c in storage] == [1, 2]
+    tspans.set_enabled(True)
+    assert telemetry.recent_traces() == []
+    assert telemetry.REGISTRY.get("pftpu_fanout_width").count == 0
+
+
+def test_mcmc_sample_records_step_timing():
+    import jax
+    import jax.numpy as jnp
+
+    from pytensor_federated_tpu.samplers.mcmc import sample
+
+    res = sample(
+        lambda p: -0.5 * jnp.sum(p["x"] ** 2),
+        {"x": jnp.zeros(2)},
+        key=jax.random.PRNGKey(0),
+        num_warmup=10,
+        num_samples=5,
+        num_chains=2,
+        kernel="metropolis",
+    )
+    assert res.samples["x"].shape == (2, 5, 2)
+    draws = telemetry.REGISTRY.get("pftpu_sampler_draws_total")
+    assert draws.labels(kernel="metropolis").value == 10  # 2 chains x 5
+    run_h = telemetry.REGISTRY.get("pftpu_sampler_run_seconds")
+    assert run_h.labels(kernel="metropolis").count == 1
+    step_h = telemetry.REGISTRY.get("pftpu_sampler_step_seconds")
+    child = step_h.labels(kernel="metropolis")
+    assert child.count == 1
+    # derived per-transition time: wall / (2 chains * 15 transitions)
+    assert 0 < child.sum < run_h.labels(kernel="metropolis").sum
+    (tree,) = [
+        t
+        for t in telemetry.recent_traces()
+        if t["name"] == "mcmc.sample"
+    ]
+    assert tree["attrs"]["kernel"] == "metropolis"
+
+
+# ---------------------------------------------------------------------------
+# satellites: connection hygiene + retry classification + heartbeat bind
+# ---------------------------------------------------------------------------
+
+
+class TestTcpUuidMismatchHygiene:
+    """ADVICE r5 #3: a mismatched per-call reply must close the socket
+    BEFORE raising, so the cached connection cannot stay desynchronized."""
+
+    def test_mismatch_closes_then_next_call_reconnects_clean(self):
+        from pytensor_federated_tpu.service.npwire import (
+            decode_arrays_ex,
+            encode_arrays,
+        )
+        from pytensor_federated_tpu.service.tcp import (
+            TcpArraysClient,
+            _recv_frame,
+            _send_frame,
+        )
+
+        state = {"n": 0}
+        ready = threading.Event()
+
+        def server():
+            srv = socket.socket()
+            srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            srv.bind(("127.0.0.1", 0))
+            srv.listen(4)
+            state["port"] = srv.getsockname()[1]
+            ready.set()
+            with srv:
+                for _ in range(2):  # original + post-mismatch reconnect
+                    conn, _ = srv.accept()
+                    with conn:
+                        while True:
+                            try:
+                                payload = _recv_frame(conn)
+                            except (ConnectionError, OSError):
+                                break
+                            arrays, uid, _e, _t = decode_arrays_ex(payload)
+                            state["n"] += 1
+                            reply_uid = (
+                                b"\xff" * 16 if state["n"] == 1 else uid
+                            )
+                            _send_frame(
+                                conn,
+                                encode_arrays(arrays, uuid=reply_uid),
+                            )
+
+        t = threading.Thread(target=server, daemon=True)
+        t.start()
+        assert ready.wait(10)
+        client = TcpArraysClient("127.0.0.1", state["port"], retries=0)
+        with pytest.raises(RuntimeError, match="uuid mismatch"):
+            client.evaluate(np.arange(2.0))
+        # the poisoned connection was dropped, not cached
+        assert client._sock is None
+        drops = telemetry.REGISTRY.get(
+            "pftpu_client_connection_drops_total"
+        )
+        assert drops.labels(transport="tcp").value >= 1
+        # and the next call reconnects and succeeds
+        out = client.evaluate(np.arange(2.0))
+        np.testing.assert_array_equal(out[0], np.arange(2.0))
+        client.close()
+        t.join(timeout=10)
+
+
+class TestStreamDecodeFailureHygiene:
+    """ADVICE r5 #1: a corrupt reply mid-batch (replies still in
+    flight) must drop the cached gRPC connection before re-raising."""
+
+    def test_corrupt_midbatch_reply_drops_connection(self):
+        import grpc
+
+        from pytensor_federated_tpu.service import (
+            ArraysToArraysServiceClient,
+        )
+        from pytensor_federated_tpu.service.client import (
+            _privates,
+            thread_pid_id,
+        )
+        from pytensor_federated_tpu.service.npwire import (
+            WireError,
+            decode_arrays_ex,
+            encode_arrays,
+        )
+
+        async def evaluate_stream(request_iterator, context):
+            i = 0
+            async for req in request_iterator:
+                _arrs, uid, _e, _t = decode_arrays_ex(req)
+                i += 1
+                if i == 2:
+                    yield b"NPW1\x01"  # truncated header -> WireError
+                else:
+                    yield encode_arrays([np.zeros(1)], uuid=uid)
+
+        async def get_load(request, context):
+            return b""
+
+        async def main():
+            ident = lambda b: b  # noqa: E731
+            server = grpc.aio.server()
+            handlers = {
+                "EvaluateStream": grpc.stream_stream_rpc_method_handler(
+                    evaluate_stream,
+                    request_deserializer=ident,
+                    response_serializer=ident,
+                ),
+                "GetLoad": grpc.unary_unary_rpc_method_handler(
+                    get_load,
+                    request_deserializer=ident,
+                    response_serializer=ident,
+                ),
+            }
+            server.add_generic_rpc_handlers((
+                grpc.method_handlers_generic_handler(
+                    "ArraysToArraysService", handlers
+                ),
+            ))
+            port = server.add_insecure_port("127.0.0.1:0")
+            await server.start()
+            try:
+                client = ArraysToArraysServiceClient(
+                    "127.0.0.1", port, retries=0
+                )
+                reqs = [(np.ones(1),) for _ in range(4)]
+                with pytest.raises(WireError):
+                    await client.evaluate_many_async(reqs, window=4)
+                prefix = thread_pid_id(client)
+                live = [k for k in _privates if k[:3] == prefix]
+                assert live == [], (
+                    "corrupt mid-batch reply left the desynchronized "
+                    "connection cached"
+                )
+            finally:
+                await server.stop(None)
+
+        asyncio.run(main())
+        drops = telemetry.REGISTRY.get(
+            "pftpu_client_connection_drops_total"
+        )
+        assert drops.labels(transport="grpc").value >= 1
+
+
+class TestDeterministicErrorsNotRetried:
+    """ADVICE r5 #2: a deterministic server compute error must raise
+    after ONE server execution, both codecs, instead of re-running the
+    failing compute retries+1 times."""
+
+    def _serve_and_call(self, codec, use_stream):
+        import grpc
+
+        from pytensor_federated_tpu.service import (
+            ArraysToArraysServiceClient,
+        )
+        from pytensor_federated_tpu.service.server import (
+            ArraysToArraysService,
+            serve,
+        )
+
+        calls = {"n": 0}
+
+        def compute(x):
+            calls["n"] += 1
+            raise ValueError("deterministic failure")
+
+        async def main():
+            port = _free_port()
+            service = ArraysToArraysService(compute, inline_compute=True)
+            server = await serve(None, "127.0.0.1", port, service=service)
+            try:
+                client = ArraysToArraysServiceClient(
+                    "127.0.0.1",
+                    port,
+                    codec=codec,
+                    use_stream=use_stream,
+                    retries=3,
+                )
+                with pytest.raises(
+                    (RuntimeError, grpc.aio.AioRpcError)
+                ) as exc:
+                    await client.evaluate_async(np.ones(2))
+                return exc
+            finally:
+                await server.stop(None)
+
+        exc = asyncio.run(main())
+        return calls["n"], exc
+
+    def test_npwire_inband_error_single_execution(self):
+        n_calls, exc = self._serve_and_call("npwire", use_stream=True)
+        assert n_calls == 1
+        assert "deterministic failure" in str(exc.value)
+        retries = telemetry.REGISTRY.get("pftpu_client_retries_total")
+        assert retries.labels(transport="grpc").value == 0
+
+    def test_npproto_status_abort_single_execution(self):
+        import grpc
+
+        n_calls, exc = self._serve_and_call("npproto", use_stream=False)
+        assert n_calls == 1
+        assert isinstance(exc.value, grpc.aio.AioRpcError)
+        assert exc.value.code() not in (
+            grpc.StatusCode.UNAVAILABLE,
+            grpc.StatusCode.DEADLINE_EXCEEDED,
+        )
+        retries = telemetry.REGISTRY.get("pftpu_client_retries_total")
+        assert retries.labels(transport="grpc").value == 0
+
+    def test_transport_errors_stay_retryable(self):
+        import grpc
+
+        from pytensor_federated_tpu.service.client import _is_retryable
+
+        assert _is_retryable(ConnectionResetError("peer gone"))
+        assert _is_retryable(OSError("network unreachable"))
+
+        class _FakeRpcError(grpc.aio.AioRpcError):
+            def __init__(self, code):
+                self._fake_code = code
+
+            def code(self):
+                return self._fake_code
+
+        assert _is_retryable(_FakeRpcError(grpc.StatusCode.UNAVAILABLE))
+        assert not _is_retryable(_FakeRpcError(grpc.StatusCode.UNKNOWN))
+        assert not _is_retryable(
+            _FakeRpcError(grpc.StatusCode.INVALID_ARGUMENT)
+        )
+
+
+class TestHeartbeatBindPosture:
+    """ADVICE r5 #4: loopback by default; externally routable binds are
+    an explicit opt-in."""
+
+    def test_default_is_loopback(self):
+        from pytensor_federated_tpu.parallel.multihost import (
+            HeartbeatServer,
+            probe_peer,
+        )
+
+        hb = HeartbeatServer(process_index=1)
+        try:
+            assert hb.address[0] == "127.0.0.1"
+            assert probe_peer(
+                ("127.0.0.1", hb.port), expect_process_index=1
+            )
+        finally:
+            hb.stop()
+
+    def test_external_requires_opt_in(self):
+        from pytensor_federated_tpu.parallel.multihost import (
+            HeartbeatServer,
+        )
+
+        with pytest.raises(ValueError, match="allow_external"):
+            HeartbeatServer("0.0.0.0")
+        hb = HeartbeatServer(allow_external=True)
+        try:
+            assert hb.address[0] == "0.0.0.0"
+        finally:
+            hb.stop()
+
+    def test_explicit_loopback_still_fine(self):
+        from pytensor_federated_tpu.parallel.multihost import (
+            HeartbeatServer,
+        )
+
+        hb = HeartbeatServer("127.0.0.1", process_index=0)
+        try:
+            assert hb.port > 0
+        finally:
+            hb.stop()

@@ -14,7 +14,11 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from pytensor_federated_tpu.samplers import pt_sample, sample
+from pytensor_federated_tpu.samplers import (
+    effective_sample_size,
+    pt_sample,
+    sample,
+)
 
 
 def bimodal_logp(params):
@@ -261,7 +265,13 @@ def test_mass_adaptation_learns_anisotropy():
         adapt_mass=False,
     )
     draws_id = np.asarray(res_id.samples["x"])[0]
-    assert draws_id[:, 1].std() < draws[:, 1].std()
+    # "Mixes worse" measured as ESS, not raw std: a random-walking
+    # wide coordinate can land over- or under-dispersed depending on
+    # seed/XLA version, but its autocorrelation (hence ESS) is
+    # robustly far worse than the adapted chain's.
+    ess_id = float(np.asarray(effective_sample_size(draws_id[None, :, 1])))
+    ess_ad = float(np.asarray(effective_sample_size(draws[None, :, 1])))
+    assert ess_id < 0.5 * ess_ad, (ess_id, ess_ad)
 
 
 def test_num_chains_independent_stacks():

@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""One-shot telemetry scraper for live-TPU capture sessions.
+
+A node (or driver) started with a telemetry exposition endpoint —
+``serve(..., metrics_port=...)``, or
+``telemetry.start_exporter(port=...)`` — serves ``/metrics``
+(Prometheus text), ``/snapshot`` and ``/traces`` (JSON).  This tool
+pulls ONE sample and either prints it or appends a timestamped JSON
+line to a .jsonl file, the same shape ``telemetry.dump_jsonl`` writes
+in-process — so a capture session (tools/tpu_poll.py between configs)
+can log the RPC/span picture of a live window without importing jax or
+touching the PJRT plugin: it is pure stdlib HTTP against loopback.
+
+Usage:
+    python tools/metrics_dump.py --port 9100                 # snapshot JSON
+    python tools/metrics_dump.py --port 9100 --text          # /metrics text
+    python tools/metrics_dump.py --port 9100 --out tools/telemetry.jsonl
+
+Exit status 0 on a successful scrape, 1 on an unreachable/failed
+endpoint (so capture scripts can `|| true` it without masking other
+errors).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+
+
+def scrape(url: str, timeout: float) -> bytes:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument(
+        "--text",
+        action="store_true",
+        help="print GET /metrics (Prometheus text) instead of the "
+        "JSON snapshot",
+    )
+    ap.add_argument(
+        "--out",
+        default=None,
+        help="append the snapshot as one JSON line to this file "
+        "(default: pretty-print to stdout; ignored with --text)",
+    )
+    ap.add_argument("--timeout", type=float, default=5.0)
+    args = ap.parse_args(argv)
+
+    base = f"http://{args.host}:{args.port}"
+    try:
+        if args.text:
+            sys.stdout.write(
+                scrape(f"{base}/metrics", args.timeout).decode("utf-8")
+            )
+            return 0
+        body = scrape(f"{base}/snapshot", args.timeout)
+    except (urllib.error.URLError, OSError, TimeoutError) as e:
+        print(f"metrics_dump: {base} unreachable: {e}", file=sys.stderr)
+        return 1
+
+    rec = {"ts": time.time(), "endpoint": base, **json.loads(body)}
+    if args.out:
+        with open(args.out, "a", encoding="utf-8") as fh:
+            fh.write(json.dumps(rec) + "\n")
+        print(f"metrics_dump: appended 1 line to {args.out}", file=sys.stderr)
+    else:
+        json.dump(rec, sys.stdout, indent=2)
+        sys.stdout.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
