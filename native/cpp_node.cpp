@@ -16,6 +16,11 @@
 //             dropped here; replies never carry it]   then per array:
 //            dtype_len(u16) dtype_str ndim(u8) shape(u64*ndim)
 //            data_len(u64) raw bytes
+//            [flags&4 TAIL: spans_len(u32) + JSON — node-side span
+//             trees piggybacked on replies (telemetry/reunion.py).
+//             A native node keeps no spans, so replies never carry
+//             the block; on requests it is validated and dropped,
+//             keeping the decoder symmetric with the Python codec]
 //
 // Compute contract (stateless, mirrors the linear-model blackbox of the
 // Python demos): inputs [intercept(), slope(), sigma(), x(n), y(n)] as
@@ -55,6 +60,7 @@ constexpr char kMagic[4] = {'N', 'P', 'W', '1'};
 constexpr uint8_t kVersion = 1;
 constexpr uint8_t kFlagError = 1;
 constexpr uint8_t kFlagTrace = 2;
+constexpr uint8_t kFlagSpans = 4;
 
 struct Array {
   std::string dtype;
@@ -214,6 +220,21 @@ bool decode(const std::vector<uint8_t>& buf, Message* msg, std::string* why) {
     a.data.resize(static_cast<size_t>(dlen));
     if (!r.bytes(a.data.data(), a.data.size())) {
       *why = "truncated data";
+      return false;
+    }
+  }
+  if (flags & kFlagSpans) {
+    // Telemetry sidecar (JSON span trees, tail block).  A native node
+    // has no span store, so the block is framing-validated and
+    // dropped — same posture as the trace id above.
+    uint32_t slen = 0;
+    if (!r.le(&slen) || slen > r.remaining()) {
+      *why = "truncated spans block";
+      return false;
+    }
+    std::string spans_json;
+    if (!r.str(&spans_json, slen)) {
+      *why = "truncated spans block";
       return false;
     }
   }

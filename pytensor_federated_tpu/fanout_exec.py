@@ -31,6 +31,7 @@ import weakref
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, List, Sequence, Tuple
 
+from .telemetry import flightrec as _flightrec
 from .telemetry import metrics as _metrics
 from .telemetry import spans as _tspans
 
@@ -230,6 +231,15 @@ def run_members(
             gap = max(durations) - min(durations)
             _STRAGGLER_S.observe(gap)
             f_span.set_attr("straggler_gap_s", gap)
-        for e in errs:
+        for idx, e in enumerate(errs):
             if e is not None:
+                # Black-box note BEFORE the raise: which member of how
+                # wide a fanout failed, with siblings already settled
+                # (flight-record taxonomy: fanout.member_error).
+                _flightrec.record(
+                    "fanout.member_error",
+                    idx=idx,
+                    width=n,
+                    error=f"{type(e).__name__}: {e}"[:200],
+                )
                 raise e

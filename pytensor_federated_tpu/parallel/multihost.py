@@ -45,6 +45,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
+from ..telemetry import flightrec as _flightrec
 from .mesh import SHARDS_AXIS, healthy_devices, make_mesh
 
 _log = logging.getLogger("pytensor_federated_tpu")
@@ -310,6 +311,15 @@ def detect_dead_peers(
             addr[1],
             retries,
         )
+        # A death verdict is exactly the kind of pre-incident breadcrumb
+        # the flight recorder exists for: the remesh/abort that follows
+        # reads back to this moment.
+        _flightrec.record(
+            "mesh.peer_dead",
+            peer=pid,
+            addr=f"{addr[0]}:{addr[1]}",
+            retries=retries,
+        )
         return pid
 
     items = sorted(peers.items())
@@ -410,4 +420,12 @@ def remesh_after_failure(
         name: (new_axis_size if name == axis else size)
         for name, size in mesh.shape.items()
     }
+    _flightrec.record(
+        "mesh.remesh",
+        axis=axis,
+        old_size=mesh.shape[axis],
+        new_size=new_axis_size,
+        dead_process_ids=sorted(dead_set),
+        n_alive=len(alive),
+    )
     return make_mesh(shape, devices=alive)

@@ -69,9 +69,22 @@ from typing import Any, Callable, Mapping, Optional, Tuple
 
 import jax
 
+from ..telemetry import flightrec as _flightrec
+from ..telemetry import watchdog as _watchdog
+
 __all__ = ["elastic_sample"]
 
 _log = logging.getLogger("pytensor_federated_tpu")
+
+
+def _segment_watchdog_s(value: Optional[float]) -> float:
+    """The sampling-segment arm deadline: explicit arg, else
+    ``PFTPU_WATCHDOG_SAMPLE_S``, else 0 (disarmed).  Disarmed by
+    default because a legitimate segment can run for hours — the env
+    knob is for deployments that know their segment budget."""
+    if value is not None:
+        return float(value)
+    return _watchdog.env_timeout_s("PFTPU_WATCHDOG_SAMPLE_S", 0.0)
 
 
 def elastic_sample(
@@ -84,6 +97,7 @@ def elastic_sample(
     peers: Optional[Mapping[int, Tuple[str, int]]] = None,
     max_failures: int = 2,
     on_failure: Optional[Callable[[Optional[Any], list], Optional[Any]]] = None,
+    watchdog_s: Optional[float] = None,
     **sample_kwargs,
 ):
     """Checkpointed sampling with failure-triggered mesh recovery.
@@ -100,6 +114,17 @@ def elastic_sample(
     multi-host mesh after out-of-band agreement).  ``max_failures``
     bounds recovery attempts — a failure with no surviving devices
     re-raises.
+
+    ``watchdog_s`` arms the hang watchdog around each sampling
+    segment — THE psum-rendezvous wedge point: a participant dying
+    mid-collective leaves the survivors blocked at the rendezvous
+    until XLA aborts the process, and nothing in-process can catch it
+    (module docstring, tier 2).  An armed deadline turns that silent
+    wait into an incident bundle (all-thread dump + flight record +
+    trace reunion, :mod:`~pytensor_federated_tpu.telemetry.watchdog`)
+    written BEFORE the abort, so the restart tier has forensics.
+    Default: ``PFTPU_WATCHDOG_SAMPLE_S`` env, else disarmed (a
+    legitimate segment can run for hours).
 
     Remaining ``sample_kwargs`` go to
     :func:`~pytensor_federated_tpu.checkpoint.sample_checkpointed`
@@ -120,20 +145,30 @@ def elastic_sample(
     """
     from ..checkpoint import sample_checkpointed
 
+    arm_s = _segment_watchdog_s(watchdog_s)
     failures = 0
     current_mesh = mesh
     while True:
         logp_fn = build_logp(current_mesh)
         try:
-            return sample_checkpointed(
-                logp_fn,
-                init_params,
-                key=key,
-                checkpoint_path=checkpoint_path,
-                **sample_kwargs,
-            )
+            with _watchdog.armed(
+                "elastic.sample_segment", arm_s, attempt=failures
+            ):
+                return sample_checkpointed(
+                    logp_fn,
+                    init_params,
+                    key=key,
+                    checkpoint_path=checkpoint_path,
+                    **sample_kwargs,
+                )
         except Exception as e:  # noqa: BLE001 — any device/runtime loss
             failures += 1
+            _flightrec.record(
+                "sampler.segment_failed",
+                attempt=failures,
+                max_failures=max_failures,
+                error=f"{type(e).__name__}: {e}"[:200],
+            )
             if failures > max_failures:
                 raise
             _log.warning(
@@ -157,6 +192,11 @@ def elastic_sample(
                 current_mesh = remesh_after_failure(
                     current_mesh, dead_process_ids=dead
                 )
+            _flightrec.record(
+                "sampler.recovered",
+                attempt=failures,
+                dead_process_ids=sorted(dead),
+            )
             # loop: rebuild logp over the recovered mesh and RESUME
             # from the last completed chunk (sample_checkpointed finds
             # the matching checkpoint on disk).

@@ -21,6 +21,7 @@ from typing import Any, Callable, NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
+from ..telemetry import flightrec as _flightrec
 from ..telemetry import metrics as _metrics
 from ..telemetry import spans as _tspans
 from .hmc import HMCState, find_reasonable_step_size, hmc_init, hmc_step
@@ -64,7 +65,10 @@ _DRAWS = _metrics.counter(
 def _record_run(kernel, out, t0, num_chains, num_warmup, num_samples):
     """Telemetry-on path only: block on ``out`` (jit dispatch is async;
     an un-synced wall time would rate the dispatch, not the run), then
-    record run wall, derived per-transition time, and draws."""
+    record run wall, derived per-transition time, and draws.  The run
+    settling is also a sampler phase transition for the flight record
+    — an incident dump shows whether the process died inside or
+    between sampling runs."""
     jax.block_until_ready(out)
     wall = time.perf_counter() - t0
     _SAMPLE_RUN_S.labels(kernel=kernel).observe(wall)
@@ -72,6 +76,14 @@ def _record_run(kernel, out, t0, num_chains, num_warmup, num_samples):
     if transitions:
         _STEP_S.labels(kernel=kernel).observe(wall / transitions)
     _DRAWS.labels(kernel=kernel).inc(num_chains * num_samples)
+    _flightrec.record(
+        "sampler.run",
+        kernel=kernel,
+        chains=num_chains,
+        warmup=num_warmup,
+        draws=num_samples,
+        wall_s=wall,
+    )
 
 
 class WarmupResult(NamedTuple):

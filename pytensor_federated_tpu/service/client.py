@@ -40,10 +40,13 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import grpc
 import numpy as np
 
+from ..telemetry import flightrec as _flightrec
+from ..telemetry import reunion as _reunion
 from ..telemetry import spans as _spans
+from ..telemetry import watchdog as _watchdog
 from ..utils import argmin_none_or_func, get_event_loop
 from . import _rpc_metrics
-from .npwire import decode_arrays, encode_arrays
+from .npwire import decode_arrays_all, encode_arrays
 from .server import EVALUATE, EVALUATE_STREAM, GET_LOAD
 
 _log = logging.getLogger(__name__)
@@ -139,6 +142,54 @@ async def get_loads_async(
         await asyncio.gather(
             *(get_load_async(h, p, timeout=timeout) for h, p in hosts_and_ports)
         )
+    )
+
+
+async def get_node_traces_async(
+    host: str, port: int, *, timeout: float = 5.0
+) -> List[dict]:
+    """PULL a node's recent completed span trees over the enriched
+    GetLoad lane (request payload ``b"traces"``; server.py get_load)
+    and ingest them into the trace-reunion store.  Returns the trees.
+
+    The forensics complement to the reply piggyback: spans whose own
+    reply never arrived (the call that wedged or died) are still in
+    the node's ring — if the node survives, this fetches them.
+    npwire-JSON nodes only; an npproto-wire or unreachable node yields
+    ``[]`` (the fixed reference GetLoad schema has no room for traces).
+    """
+    try:
+        async with grpc.aio.insecure_channel(f"{host}:{port}") as channel:
+            method = channel.unary_unary(
+                GET_LOAD,
+                request_serializer=_identity,
+                response_deserializer=_identity,
+            )
+            reply = await asyncio.wait_for(method(b"traces"), timeout=timeout)
+            if reply[:1] != b"{":
+                return []
+            traces = json.loads(reply.decode("utf-8")).get("traces") or []
+    except (
+        asyncio.TimeoutError,
+        grpc.aio.AioRpcError,
+        OSError,
+        ConnectionError,
+        ValueError,
+    ):
+        return []
+    if isinstance(traces, list):
+        _reunion.ingest(traces)
+        return traces
+    return []
+
+
+def get_node_traces(
+    host: str, port: int, *, timeout: float = 5.0
+) -> List[dict]:
+    """Sync wrapper over :func:`get_node_traces_async`."""
+    loop = get_event_loop()
+    return loop.run_until_complete(
+        get_node_traces_async(host, port, timeout=timeout)
     )
 
 
@@ -327,6 +378,10 @@ class ArraysToArraysServiceClient:
         privates = _privates.pop(cid, None)
         if privates is not None:
             _DROPS.labels(transport="grpc").inc()
+            _flightrec.record(
+                "rpc.drop", transport="grpc",
+                peer=f"{privates.host}:{privates.port}",
+            )
             _log.warning(
                 "dropping connection to %s:%d", privates.host, privates.port
             )
@@ -374,7 +429,12 @@ class ArraysToArraysServiceClient:
         lockstep with this client); a PRE-telemetry npwire node would
         reject a flagged frame, so toward one either disable telemetry
         or upgrade the node.  With telemetry disabled the request is
-        byte-identical to the uninstrumented wire either way."""
+        byte-identical to the uninstrumented wire either way.
+
+        Both decoders also harvest the reply's piggybacked node-side
+        span trees (npwire flag 4 / npproto field 16) into the trace-
+        reunion store (:mod:`..telemetry.reunion`) — how the driver
+        gets the other half of a correlated trace."""
         arrays = [np.asarray(a) for a in arrays]
         trace_id = _spans.current_trace_id() if _spans.enabled() else None
         if self.codec == "npproto":
@@ -384,14 +444,25 @@ class ArraysToArraysServiceClient:
             request = npproto_codec.encode_arrays_msg(
                 arrays, uuid=uuid, trace_id=trace_id
             )
-            decode = lambda reply: (  # noqa: E731
-                *npproto_codec.decode_arrays_msg(reply),
-                None,
-            )
+
+            def decode(reply):
+                outputs, ruuid, _tid, spans = (
+                    npproto_codec.decode_arrays_msg_all(reply)
+                )
+                if spans:
+                    _reunion.ingest(spans)
+                return outputs, ruuid, None
+
         else:
             uuid = uuid_mod.uuid4().bytes
             request = encode_arrays(arrays, uuid=uuid, trace_id=trace_id)
-            decode = decode_arrays
+
+            def decode(reply):
+                outputs, ruuid, error, _tid, spans = decode_arrays_all(reply)
+                if spans:
+                    _reunion.ingest(spans)
+                return outputs, ruuid, error
+
         return request, uuid, decode
 
     async def _validate_reply(self, reply, uuid, decode):
@@ -429,6 +500,9 @@ class ArraysToArraysServiceClient:
             for attempt in range(self.retries + 1):
                 if attempt:
                     _RETRIES.labels(transport="grpc").inc()
+                    _flightrec.record(
+                        "rpc.retry", transport="grpc", attempt=attempt
+                    )
                 t0 = time.perf_counter()
                 try:
                     with _spans.span("call"):
@@ -449,6 +523,9 @@ class ArraysToArraysServiceClient:
                 )
                 if error is not None:
                     root.set_attr("error", "server")
+                    _flightrec.record(
+                        "rpc.error", transport="grpc", error=error[:200]
+                    )
                     raise RuntimeError(f"server error: {error}")
                 return outputs
             root.set_attr("error", "transport")
@@ -628,8 +705,21 @@ class ArraysToArraysServiceClient:
             for attempt in range(self.retries + 1):
                 if attempt:
                     _RETRIES.labels(transport="grpc").inc()
+                    _flightrec.record(
+                        "rpc.retry", transport="grpc", attempt=attempt,
+                        batch=len(encoded),
+                    )
                 try:
-                    results = await self._evaluate_many_once(encoded, window)
+                    # Known wedge point (CLAUDE.md): an HTTP/2 batch
+                    # window can deadlock against flow control — armed
+                    # so a hang leaves an incident bundle, not a blank.
+                    with _watchdog.armed(
+                        "grpc.batch_window",
+                        n=len(encoded), window=window,
+                    ):
+                        results = await self._evaluate_many_once(
+                            encoded, window
+                        )
                 except (grpc.aio.AioRpcError, ConnectionError, OSError) as e:
                     last_exc = e
                     await self._drop_privates()

@@ -15,12 +15,16 @@ dependency:
     InputArrays       items(1: repeated ndarray) uuid(2: string)
     OutputArrays      items(1: repeated ndarray) uuid(2: string)
 
-plus ONE extension field this package emits and understands:
+plus TWO extension fields this package emits and understands:
 ``trace_id(15: bytes)`` on InputArrays — the 16-byte telemetry
-correlation id (:mod:`..telemetry.spans`).  Field 15 is unknown to the
-reference schema, so an unmodified reference node skips it by wire
-type (the standard proto3 forward-compatibility rule, property-tested
-against the official runtime); it costs nothing when absent.
+correlation id (:mod:`..telemetry.spans`) — and ``spans(16: bytes)``
+on OutputArrays — a JSON list of the node's completed span trees for
+that call, piggybacked on the reply so the driver can reunite both
+halves of the trace (:mod:`..telemetry.reunion`).  Fields 15/16 are
+unknown to the reference schema, so an unmodified reference peer skips
+them by wire type (the standard proto3 forward-compatibility rule,
+property-tested against the official runtime); they cost nothing when
+absent.
     GetLoadParams     (empty)
     GetLoadResult     n_clients(1: int32) percent_cpu(2: float)
                       percent_ram(3: float)
@@ -51,6 +55,7 @@ here it is the same hard error npwire raises.
 
 from __future__ import annotations
 
+import json
 import struct
 from typing import List, Optional, Sequence, Tuple
 
@@ -64,6 +69,8 @@ __all__ = [
     "encode_arrays_msg",
     "decode_arrays_msg",
     "decode_arrays_msg_ex",
+    "decode_arrays_msg_all",
+    "append_spans_msg",
     "encode_get_load_result",
     "decode_get_load_result",
     "GETLOAD_PARAMS",
@@ -311,9 +318,24 @@ def encode_arrays_msg(
     return bytes(out)
 
 
+def append_spans_msg(buf: bytes, spans: Sequence[dict]) -> bytes:
+    """Attach the spans extension (field 16, JSON) to an already-encoded
+    OutputArrays message.  Proto3 fields may appear in any order, so
+    appending a length-delimited field to valid message bytes yields a
+    valid message — the node-side piggyback needs no re-encode (mirror
+    of :func:`..npwire.append_spans`)."""
+    # default=str: free-form span attrs (numpy scalars included) must
+    # degrade to their repr, never fail the reply (npwire.append_spans
+    # has the same posture).
+    return buf + _len_field(
+        16, json.dumps(list(spans), default=str).encode("utf-8")
+    )
+
+
 def decode_arrays_msg(buf: bytes) -> Tuple[List[np.ndarray], str]:
-    """The historical 2-tuple shape — a trace id (field 15) is skipped
-    like any unknown field.  Use :func:`decode_arrays_msg_ex` to read it."""
+    """The historical 2-tuple shape — a trace id (field 15) or spans
+    (field 16) is skipped like any unknown field.  Use
+    :func:`decode_arrays_msg_ex` / :func:`decode_arrays_msg_all`."""
     arrays, uuid, _ = decode_arrays_msg_ex(buf)
     return arrays, uuid
 
@@ -321,10 +343,23 @@ def decode_arrays_msg(buf: bytes) -> Tuple[List[np.ndarray], str]:
 def decode_arrays_msg_ex(
     buf: bytes,
 ) -> Tuple[List[np.ndarray], str, Optional[bytes]]:
-    """Decode InputArrays/OutputArrays -> (arrays, uuid, trace_id)."""
+    """Decode InputArrays/OutputArrays -> (arrays, uuid, trace_id);
+    a spans field is consumed and dropped."""
+    arrays, uuid, trace_id, _ = decode_arrays_msg_all(buf)
+    return arrays, uuid, trace_id
+
+
+def decode_arrays_msg_all(
+    buf: bytes,
+) -> Tuple[List[np.ndarray], str, Optional[bytes], Optional[list]]:
+    """Full decode -> (arrays, uuid, trace_id, spans) where ``spans``
+    is the piggybacked span-tree list (field 16; ``None`` when absent
+    or unparseable — a garbled instrumentation sidecar must not fail
+    the RPC that carried real results)."""
     arrays: List[np.ndarray] = []
     uuid = ""
     trace_id: Optional[bytes] = None
+    spans: Optional[list] = None
     pos = 0
     while pos < len(buf):
         field, wt, pos = _decode_tag(buf, pos)
@@ -342,9 +377,16 @@ def decode_arrays_msg_ex(
             # Tolerant on length: a future sender might widen the id;
             # only the exact 16-byte form correlates spans here.
             trace_id = raw if len(raw) == 16 else None
+        elif field == 16 and wt == _WT_LEN:
+            raw, pos = _decode_len(buf, pos)
+            try:
+                parsed = json.loads(raw.decode("utf-8"))
+            except (UnicodeDecodeError, ValueError):
+                parsed = None  # tolerant: sidecar only, never the payload
+            spans = parsed if isinstance(parsed, list) else None
         else:
             pos = _skip(buf, pos, wt)
-    return arrays, uuid, trace_id
+    return arrays, uuid, trace_id, spans
 
 
 # ---------------------------------------------------------------------------

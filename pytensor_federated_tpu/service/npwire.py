@@ -20,15 +20,22 @@ the reference, on purpose:
 
 A message frames N arrays plus a 16-byte correlation uuid (parity with
 the reference's uuid field, reference: rpc.py:37-39), an optional
-error string, and an optional 16-byte telemetry trace id (flag bit 2)
+error string, an optional 16-byte telemetry trace id (flag bit 2)
 that correlates driver-side and node-side spans of the same call
-(:mod:`..telemetry.spans`).  Absent, the frame is byte-identical to
-the pre-telemetry format; PRESENT, it requires a decoder that knows
-flag bit 2 — npwire peers all live in this repo and ship in lockstep
-(a pre-telemetry build would reject the flagged frame as corrupt,
-which is this format's loud-failure contract, not silent skipping).
-Cross-implementation forward compatibility is the npproto codec's job
-(its field-15 trace id IS skipped by unknown-field rules).
+(:mod:`..telemetry.spans`), and an optional trailing SPANS block (flag
+bit 4): a JSON list of completed node-side span trees, piggybacked on
+REPLIES so the node's half of a correlated trace travels home on the
+very RPC it describes (:mod:`..telemetry.reunion`).  The spans block
+sits at the TAIL — after the arrays — so a server can attach it to an
+already-encoded reply with :func:`append_spans` (one flag-byte patch +
+one append) instead of re-encoding array payloads.  Absent all three,
+the frame is byte-identical to the pre-telemetry format; PRESENT, they
+require a decoder that knows the flag — npwire peers all live in this
+repo and ship in lockstep (a pre-telemetry build would reject a
+flagged frame as corrupt, which is this format's loud-failure
+contract, not silent skipping).  Cross-implementation forward
+compatibility is the npproto codec's job (its field-15 trace id and
+field-16 spans ARE skipped by unknown-field rules).
 
 Layout (little-endian):
   message: MAGIC(4s) version(u8) flags(u8) uuid(16s) n_arrays(u32)
@@ -36,6 +43,7 @@ Layout (little-endian):
            [flags&2 trace: trace_id(16s)]  then per array:
   array:   dtype_len(u16) dtype_str shape_ndim(u8) shape(u64*ndim)
            data_len(u64) data_bytes
+  tail:    [flags&4 spans: len(u32) utf8-JSON]
 """
 
 from __future__ import annotations
@@ -51,6 +59,9 @@ from numpy.lib.format import descr_to_dtype, dtype_to_descr
 MAGIC = b"NPW1"
 _FLAG_ERROR = 1
 _FLAG_TRACE = 2
+_FLAG_SPANS = 4
+# flags byte offset in the header ("<4sBB...": magic, version, flags)
+_FLAGS_OFF = 5
 
 
 class WireError(ValueError):
@@ -143,12 +154,40 @@ def encode_arrays(
     return b"".join(parts)
 
 
+def append_spans(frame: bytes, spans: Sequence[dict]) -> bytes:
+    """Attach a spans tail to an ALREADY-ENCODED frame (flag bit 4).
+
+    The node-side piggyback path: the ``node.evaluate`` span tree only
+    finishes after the reply's arrays are encoded (encoding is itself a
+    timed stage), so the tree is appended post-hoc — one flag-byte
+    patch plus one tail append, no array re-encode.  ``spans`` is a
+    list of JSON-friendly span-tree dicts (``Span.to_dict`` shape).
+    Raises :class:`WireError` on a frame that is not a bare header or
+    already carries a spans tail."""
+    if frame[:4] != MAGIC or len(frame) < _FLAGS_OFF + 1:
+        raise WireError("append_spans: not an npwire frame")
+    flags = frame[_FLAGS_OFF]
+    if flags & _FLAG_SPANS:
+        raise WireError("append_spans: frame already carries a spans tail")
+    # default=str: span ATTRS are free-form user values (numpy scalars
+    # included) — a non-JSON-native attr must degrade to its repr, not
+    # fail the reply that carries real results.
+    payload = json.dumps(list(spans), default=str).encode("utf-8")
+    return (
+        frame[:_FLAGS_OFF]
+        + bytes([flags | _FLAG_SPANS])
+        + frame[_FLAGS_OFF + 1 :]
+        + struct.pack("<I", len(payload))
+        + payload
+    )
+
+
 def decode_arrays(buf: bytes) -> Tuple[List[np.ndarray], bytes, Optional[str]]:
     """Decode a framed message -> (arrays, uuid, error).
 
-    The historical 3-tuple shape; a frame carrying a trace id decodes
-    fine (the id is consumed and dropped).  Use :func:`decode_arrays_ex`
-    to also read the trace id."""
+    The historical 3-tuple shape; a frame carrying a trace id or spans
+    tail decodes fine (both consumed and dropped).  Use
+    :func:`decode_arrays_ex` / :func:`decode_arrays_all` to read them."""
     arrays, uuid, error, _ = decode_arrays_ex(buf)
     return arrays, uuid, error
 
@@ -156,7 +195,24 @@ def decode_arrays(buf: bytes) -> Tuple[List[np.ndarray], bytes, Optional[str]]:
 def decode_arrays_ex(
     buf: bytes,
 ) -> Tuple[List[np.ndarray], bytes, Optional[str], Optional[bytes]]:
-    """Decode a framed message -> (arrays, uuid, error, trace_id)."""
+    """Decode a framed message -> (arrays, uuid, error, trace_id); a
+    spans tail (flag bit 4) is consumed and dropped."""
+    arrays, uuid, error, trace_id, _ = decode_arrays_all(buf)
+    return arrays, uuid, error, trace_id
+
+
+def decode_arrays_all(
+    buf: bytes,
+) -> Tuple[
+    List[np.ndarray],
+    bytes,
+    Optional[str],
+    Optional[bytes],
+    Optional[list],
+]:
+    """Full decode -> (arrays, uuid, error, trace_id, spans) where
+    ``spans`` is the piggybacked span-tree list (``None`` when the flag
+    is unset)."""
     try:
         magic, version, flags, uuid, n = struct.unpack_from("<4sBB16sI", buf, 0)
     except struct.error as e:
@@ -207,4 +263,19 @@ def decode_arrays_ex(
         except ValueError as e:
             # e.g. data_len inconsistent with shape * itemsize
             raise WireError(f"corrupt array payload: {e}") from None
-    return arrays, uuid, error, trace_id
+    spans = None
+    if flags & _FLAG_SPANS:
+        try:
+            (slen,) = struct.unpack_from("<I", buf, off)
+            off += 4
+            if off + slen > len(buf):
+                raise WireError("truncated spans block")
+            spans = json.loads(buf[off : off + slen].decode("utf-8"))
+            off += slen
+        except (struct.error, UnicodeDecodeError, ValueError) as e:
+            raise WireError(f"corrupt spans block: {e}") from None
+        if not isinstance(spans, list):
+            raise WireError(
+                f"spans block must be a JSON list, got {type(spans).__name__}"
+            )
+    return arrays, uuid, error, trace_id, spans

@@ -35,9 +35,10 @@ import grpc
 import numpy as np
 
 from ..signatures import ComputeFn
+from ..telemetry import flightrec as _flightrec
 from ..telemetry import metrics as _metrics
 from ..telemetry import spans as _spans
-from .npwire import decode_arrays_ex, encode_arrays
+from .npwire import append_spans, decode_arrays_ex, encode_arrays
 
 _log = logging.getLogger(__name__)
 
@@ -113,6 +114,7 @@ class ArraysToArraysService:
         *,
         getload_wire: str = "npwire",
         inline_compute: bool = False,
+        ship_spans: bool = True,
     ):
         """``getload_wire``: "npwire" (JSON reply, this package's
         native clients) or "npproto" (reference ``GetLoadResult``
@@ -132,7 +134,14 @@ class ArraysToArraysService:
         async-client round-trip throughput on the localhost lane
         (docs/performance.md "Host lane budget") — so nodes serving
         fast jitted evals should pass True.  A compute that blocks for
-        long stretches must keep the default."""
+        long stretches must keep the default.
+
+        ``ship_spans``: piggyback this node's completed span tree on
+        each reply whose request carried a trace id (npwire flag 4 /
+        npproto field 16), so the driver reunites both halves of the
+        trace (:mod:`..telemetry.reunion`).  Costs a few hundred bytes
+        of JSON per traced reply; False keeps replies span-free (the
+        driver can still pull via GetLoad ``b"traces"``)."""
         if getload_wire not in ("npwire", "npproto"):
             raise ValueError(
                 f"getload_wire must be 'npwire' or 'npproto', "
@@ -140,6 +149,7 @@ class ArraysToArraysService:
             )
         self.getload_wire = getload_wire
         self.inline_compute = bool(inline_compute)
+        self.ship_spans = bool(ship_spans)
         self.compute_fn = compute_fn
         self._n_clients = 0
         # Start psutil's interval-based CPU accounting early so the
@@ -182,6 +192,10 @@ class ArraysToArraysService:
                 inputs, uuid, _, trace_id = decode_arrays_ex(request)
             except Exception as e:
                 _ERRORS.labels(kind="decode").inc()
+                _flightrec.record(
+                    "server.error", stage="decode", wire="npwire",
+                    error=str(e)[:200],
+                )
                 return encode_arrays(
                     [], uuid=b"\0" * 16, error=f"decode error: {e}"
                 )
@@ -190,20 +204,28 @@ class ArraysToArraysService:
                 inputs, proto_uuid, trace_id = (
                     npproto_codec.decode_arrays_msg_ex(request)
                 )
-            except Exception:
+            except Exception as e:
                 _ERRORS.labels(kind="decode").inc()
+                _flightrec.record(
+                    "server.error", stage="decode", wire="npproto",
+                    error=str(e)[:200],
+                )
                 raise
         t_decoded = time.perf_counter()
         _DECODE_S.observe(t_decoded - t_arrive)
         # Adopt the DRIVER's trace id off the wire (None is a no-op):
         # the node-side span tree lands in this process's telemetry
-        # under the same 16-byte id as the driver-side tree.
+        # under the same 16-byte id as the driver-side tree.  The reply
+        # is BUILT inside the span (encode is a timed stage) and the
+        # finished tree attached after the span closes — the tree's
+        # duration only exists then (npwire.append_spans docstring).
         with _spans.trace_context(trace_id), _spans.span(
             "node.evaluate",
             wire="npwire" if is_npwire else "npproto",
             n_inputs=len(inputs),
         ) as root:
             root.set_attr("decode_s", t_decoded - t_arrive)
+            err_reply = None
             try:
                 with _spans.span("compute") as c_span:
                     if self.inline_compute:
@@ -232,20 +254,42 @@ class ArraysToArraysService:
             except Exception as e:
                 _log.exception("compute_fn failed")
                 _ERRORS.labels(kind="compute").inc()
-                if is_npwire:
-                    return encode_arrays(
-                        [], uuid=uuid, error=f"compute error: {e}"
-                    )
-                raise
-            with _spans.span("encode"):
-                t_e0 = time.perf_counter()
-                if is_npwire:
-                    reply = encode_arrays(outputs, uuid=uuid)
-                else:
-                    reply = npproto_codec.encode_arrays_msg(
-                        outputs, uuid=proto_uuid
-                    )
-                _ENCODE_S.observe(time.perf_counter() - t_e0)
+                _flightrec.record(
+                    "server.error", stage="compute",
+                    wire="npwire" if is_npwire else "npproto",
+                    error=str(e)[:200],
+                )
+                if not is_npwire:
+                    raise
+                err_reply = encode_arrays(
+                    [], uuid=uuid, error=f"compute error: {e}"
+                )
+            if err_reply is not None:
+                reply = err_reply
+            else:
+                with _spans.span("encode"):
+                    t_e0 = time.perf_counter()
+                    if is_npwire:
+                        reply = encode_arrays(outputs, uuid=uuid)
+                    else:
+                        reply = npproto_codec.encode_arrays_msg(
+                            outputs, uuid=proto_uuid
+                        )
+                    _ENCODE_S.observe(time.perf_counter() - t_e0)
+        # Trace reunion piggyback: the request carried a trace id, so
+        # the driver is correlating — ship the node's half home on this
+        # very reply.  Untraced requests get the PR-1 byte-identical
+        # frame (the acceptance invariant).
+        if (
+            self.ship_spans
+            and trace_id is not None
+            and root.span is not None
+        ):
+            tree = root.span.to_dict()
+            if is_npwire:
+                reply = append_spans(reply, [tree])
+            else:
+                reply = npproto_codec.append_spans_msg(reply, [tree])
         return reply
 
     # -- RPC methods ------------------------------------------------------
@@ -317,6 +361,15 @@ class ArraysToArraysService:
         return load
 
     async def get_load(self, request: bytes, context) -> bytes:
+        """GetLoad; the npwire-JSON reply doubles as the trace PULL
+        lane: a request payload of ``b"traces"`` adds this node's
+        recent completed span trees (``"traces"`` key) to the reply —
+        the reunion path for spans whose own reply never made it back
+        (:func:`.client.get_node_traces`).  Both schemas define an
+        EMPTY GetLoad request, so any non-empty payload is an in-repo
+        extension; unknown payloads are ignored (plain load reply).
+        The npproto reply schema is fixed — no room for traces there.
+        """
         _REQUESTS.labels(method="get_load").inc()
         load = self.determine_load()
         if self.getload_wire == "npproto":
@@ -325,7 +378,11 @@ class ArraysToArraysService:
             return npproto_codec.encode_get_load_result(
                 load["n_clients"], load["percent_cpu"], load["percent_ram"]
             )
-        return json.dumps(load).encode("utf-8")
+        if request == b"traces" and _spans.enabled():
+            load["traces"] = _spans.recent_traces(16)
+        # default=str: the traces lane carries free-form span attrs
+        # (numpy scalars included) — degrade, never fail the query.
+        return json.dumps(load, default=str).encode("utf-8")
 
     # -- wiring -----------------------------------------------------------
 
@@ -357,6 +414,7 @@ async def serve(
     *,
     getload_wire: str = "npwire",
     inline_compute: bool = False,
+    ship_spans: bool = True,
     service: Optional[ArraysToArraysService] = None,
     metrics_port: Optional[int] = None,
     metrics_host: str = "127.0.0.1",
@@ -383,6 +441,7 @@ async def serve(
             compute_fn,
             getload_wire=getload_wire,
             inline_compute=inline_compute,
+            ship_spans=ship_spans,
         )
     elif compute_fn is not None:
         raise ValueError(

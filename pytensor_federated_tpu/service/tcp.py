@@ -24,9 +24,17 @@ from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
+from ..telemetry import flightrec as _flightrec
+from ..telemetry import reunion as _reunion
 from ..telemetry import spans as _spans
+from ..telemetry import watchdog as _watchdog
 from . import _rpc_metrics
-from .npwire import decode_arrays, decode_arrays_ex, encode_arrays
+from .npwire import (
+    append_spans,
+    decode_arrays_all,
+    decode_arrays_ex,
+    encode_arrays,
+)
 
 __all__ = ["TcpArraysClient", "serve_tcp_once", "RemoteComputeError"]
 
@@ -136,6 +144,9 @@ class TcpArraysClient:
             for attempt in range(self.retries + 1):
                 if attempt:
                     _RETRIES.labels(transport="tcp").inc()
+                    _flightrec.record(
+                        "rpc.retry", transport="tcp", attempt=attempt
+                    )
                 t0 = time.perf_counter()
                 try:
                     with _spans.span("call"):
@@ -146,6 +157,10 @@ class TcpArraysClient:
                 except (ConnectionError, OSError) as e:
                     last_err = e
                     _DROPS.labels(transport="tcp").inc()
+                    _flightrec.record(
+                        "rpc.drop", transport="tcp",
+                        peer=f"{self.host}:{self.port}",
+                    )
                     self.close()
             else:
                 raise ConnectionError(
@@ -153,11 +168,18 @@ class TcpArraysClient:
                     f"{self.retries + 1} attempts"
                 ) from last_err
             with _spans.span("decode"):
-                outputs, reply_uid, error = decode_arrays(reply)
+                outputs, reply_uid, error, _tid, node_spans = (
+                    decode_arrays_all(reply)
+                )
+                if node_spans:
+                    _reunion.ingest(node_spans)
             _CALL_S.labels(transport="tcp", mode="lockstep").observe(
                 time.perf_counter() - t0
             )
             if error is not None:
+                _flightrec.record(
+                    "rpc.error", transport="tcp", error=error[:200]
+                )
                 raise RemoteComputeError(error)
             if reply_uid != uid:
                 # A mismatched reply means this connection is
@@ -235,11 +257,25 @@ class TcpArraysClient:
             for attempt in range(self.retries + 1):
                 if attempt:
                     _RETRIES.labels(transport="tcp").inc()
+                    _flightrec.record(
+                        "rpc.retry", transport="tcp", attempt=attempt,
+                        batch=len(encoded),
+                    )
                 try:
-                    results = self._evaluate_many_once(encoded, window)
+                    # Known wedge point: a pipelined window against a
+                    # stalled peer can block in read — armed so a hang
+                    # leaves an incident bundle (telemetry.watchdog).
+                    with _watchdog.armed(
+                        "tcp.batch_window", n=len(encoded), window=window
+                    ):
+                        results = self._evaluate_many_once(encoded, window)
                 except (ConnectionError, OSError) as e:
                     last_err = e
                     _DROPS.labels(transport="tcp").inc()
+                    _flightrec.record(
+                        "rpc.drop", transport="tcp",
+                        peer=f"{self.host}:{self.port}",
+                    )
                     self.close()
                     continue
                 _BATCH_S.labels(transport="tcp").observe(
@@ -284,7 +320,11 @@ class TcpArraysClient:
             request, uid = encoded[read_idx]
             inflight_bytes -= len(request)
             try:
-                outputs, reply_uid, error = decode_arrays(reply)
+                outputs, reply_uid, error, _tid, node_spans = (
+                    decode_arrays_all(reply)
+                )
+                if node_spans:
+                    _reunion.ingest(node_spans)
             except Exception:
                 # Corrupt payload with replies still in flight: the
                 # connection cannot be trusted to stay correlated —
@@ -356,7 +396,7 @@ def serve_tcp_once(
                     # same contract as the gRPC server (server.py).
                     with _spans.trace_context(trace_id), _spans.span(
                         "node.evaluate", wire="npwire", transport="tcp"
-                    ):
+                    ) as root:
                         try:
                             with _spans.span("compute"):
                                 outputs = [
@@ -366,5 +406,15 @@ def serve_tcp_once(
                             with _spans.span("encode"):
                                 reply = encode_arrays(outputs, uuid=uid)
                         except Exception as e:  # error -> error payload
+                            _flightrec.record(
+                                "server.error", stage="compute",
+                                wire="npwire", transport="tcp",
+                                error=str(e)[:200],
+                            )
                             reply = encode_arrays([], uuid=uid, error=str(e))
+                    # Reunion piggyback: traced requests get this
+                    # node's span tree on the reply tail (untraced
+                    # frames stay byte-identical to the PR-1 wire).
+                    if trace_id is not None and root.span is not None:
+                        reply = append_spans(reply, [root.span.to_dict()])
                     _send_frame(conn, reply)

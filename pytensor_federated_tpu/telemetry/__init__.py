@@ -14,14 +14,26 @@ find where MapReduce-style fanout loses hardware efficiency):
   classic Prometheus text format.
 - :mod:`.export` — opt-in HTTP exposition endpoint + snapshot()/JSONL
   dump for pull-based collection.
+- :mod:`.flightrec` — always-on black-box flight recorder: a bounded
+  ring of structured events (span open/close, RPC retries/drops,
+  integrity-gate verdicts, heartbeat/remesh decisions) dumpable on
+  demand, at exit, on signal, and on crash.
+- :mod:`.watchdog` — armed-deadline hang watchdog over the known wedge
+  points (PJRT init, batch windows, psum rendezvous); an expired
+  deadline writes a self-contained incident bundle instead of leaving
+  a silent hang.
+- :mod:`.reunion` — driver-side merge of node span trees (piggybacked
+  on replies / pulled via GetLoad) with local spans, per trace id.
 
 Dependency-free, and near-zero cost when disabled
 (``PFTPU_TELEMETRY=0`` or :func:`set_enabled`; bench.py's overhead
-gate measures the disabled path).  Metric names are catalogued in
-docs/observability.md.
+gate measures the disabled path).  Metric names and the flight-record
+event taxonomy are catalogued in docs/observability.md.
 """
 
+from . import flightrec, reunion, watchdog
 from .export import MetricsExporter, dump_jsonl, snapshot, start_exporter
+from .watchdog import write_incident_bundle
 from .metrics import (
     Counter,
     Gauge,
@@ -60,14 +72,18 @@ __all__ = [
     "current_trace_id",
     "dump_jsonl",
     "enabled",
+    "flightrec",
     "gauge",
     "histogram",
     "new_trace_id",
     "recent_traces",
     "render_prometheus",
+    "reunion",
     "set_enabled",
     "snapshot",
     "span",
     "start_exporter",
     "trace_context",
+    "watchdog",
+    "write_incident_bundle",
 ]

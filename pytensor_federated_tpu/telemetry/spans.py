@@ -74,6 +74,21 @@ _recent: Deque["Span"] = deque(maxlen=_TRACE_CAP)
 _recent_lock = threading.Lock()
 _span_counter = itertools.count(1)
 
+# Flight-recorder hooks (set once by .flightrec at its import): called
+# with the Span on enter/exit of every ACTIVE span.  Plain module
+# globals checked with one load — spans must stay importable without
+# flightrec (no cycle), and the disabled path must not pay a registry.
+_on_span_open = None
+_on_span_close = None
+
+
+def _set_span_hooks(on_open, on_close) -> None:
+    """Install the span open/close listeners (flightrec's registration
+    point; ``None`` uninstalls)."""
+    global _on_span_open, _on_span_close
+    _on_span_open = on_open
+    _on_span_close = on_close
+
 
 def enabled() -> bool:
     """Whether telemetry (spans AND metrics) is recording."""
@@ -168,6 +183,8 @@ class _ActiveSpan:
         s._tok_span = _current_span.set(s)
         if _current_trace.get() != s.trace_id:
             s._tok_trace = _current_trace.set(s.trace_id)
+        if _on_span_open is not None:
+            _on_span_open(s)
         s.t_start = time.perf_counter()
         return self
 
@@ -185,6 +202,8 @@ class _ActiveSpan:
         else:
             with _recent_lock:
                 _recent.append(s)
+        if _on_span_close is not None:
+            _on_span_close(s)
         return False  # never swallow
 
 

@@ -1,0 +1,336 @@
+"""Armed-deadline hang watchdog + self-contained incident bundles.
+
+The live-chip failure modes are SILENT (CLAUDE.md): a wedged PJRT
+plugin blocks the first device query forever, compiled Mosaic can wedge
+the tunnel relay, an HTTP/2 batch window can deadlock, and a psum whose
+participant died just hangs until XLA aborts the process.  Every one of
+those used to cost a capture window and a round of hand forensics with
+``faulthandler.dump_traceback_later``.  This module makes the forensics
+automatic:
+
+- :func:`armed` — a context manager wrapping a known wedge point with a
+  deadline.  If the body has not exited when the deadline passes, a
+  monitor thread writes an **incident bundle** (below) and keeps going;
+  the hang itself is untouched — safely interrupting a wedged PJRT call
+  is not possible, but a silent hang becomes an artifact.
+- :func:`write_incident_bundle` — one self-contained JSON file:
+  all-thread tracebacks (the ``faulthandler.dump_traceback_later``
+  readout, taken via ``sys._current_frames`` so it lands in structured
+  JSON instead of stderr), the flight-recorder tail
+  (:mod:`.flightrec`), the metrics+traces snapshot (:mod:`.export`),
+  and the driver↔node merged call trees (:mod:`.reunion`).
+  ``tools/incident_report.py`` renders a bundle as a markdown
+  postmortem.
+
+Arm points wired in this package (each env-tunable, ``0`` disables):
+
+====================================  ==============================  =======
+wedge point                           env knob                        default
+====================================  ==============================  =======
+gRPC/TCP pipelined batch windows      ``PFTPU_WATCHDOG_RPC_S``        300 s
+backend/Pallas liveness probe         (probe timeout + margin)        —
+elastic sampling segment (psum        ``PFTPU_WATCHDOG_SAMPLE_S``     off
+rendezvous wedge)
+bench measurement phase               ``PFTPU_WATCHDOG_BENCH_S``      off
+====================================  ==============================  =======
+
+One daemon monitor thread for the whole process, started lazily on the
+first arm; arming costs a heap push + condition notify, disarming a
+lazy-delete flag — invisible next to the ms-scale operations being
+guarded.  The watchdog never arms while telemetry is disabled.
+Bundle writes are rate-limited per arm-point name
+(``PFTPU_WATCHDOG_MIN_BUNDLE_GAP_S``, default 60): a deadline set
+below a workload's legitimate wall, re-armed every batch, must not
+fill the disk — throttled fires are still flight-recorded.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import json
+import logging
+import os
+import sys
+import tempfile
+import threading
+import time
+import traceback
+from typing import Any, Dict, List, Optional
+
+from . import spans as _spans
+
+__all__ = [
+    "armed",
+    "write_incident_bundle",
+    "incident_dir",
+    "last_incident_path",
+    "rpc_timeout_s",
+    "env_timeout_s",
+    "thread_dump",
+]
+
+_log = logging.getLogger(__name__)
+
+
+def incident_dir() -> str:
+    """Where bundles land: ``$PFTPU_INCIDENT_DIR`` or
+    ``<tmp>/pftpu-incidents`` (created on demand)."""
+    path = os.environ.get("PFTPU_INCIDENT_DIR") or os.path.join(
+        tempfile.gettempdir(), "pftpu-incidents"
+    )
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+def env_timeout_s(var: str, default: float) -> float:
+    """THE env-knob parser for every watchdog deadline: float seconds,
+    garbage or empty degrades to ``default`` (a misspelt knob must
+    never crash the operation it guards — bench.py's one-JSON-line
+    invariant depends on it)."""
+    try:
+        return float(os.environ.get(var, "") or default)
+    except ValueError:
+        return default
+
+
+def rpc_timeout_s() -> float:
+    """The batch-window arm deadline (``PFTPU_WATCHDOG_RPC_S``,
+    default 300; ``0`` disables)."""
+    return env_timeout_s("PFTPU_WATCHDOG_RPC_S", 300.0)
+
+
+def thread_dump() -> List[dict]:
+    """All-thread tracebacks as structured data — the
+    ``faulthandler.dump_traceback_later`` readout, JSON-friendly."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for ident, frame in sys._current_frames().items():
+        out.append(
+            {
+                "thread_id": ident,
+                "name": names.get(ident, "?"),
+                "stack": [
+                    line.rstrip("\n")
+                    for line in traceback.format_stack(frame)
+                ],
+            }
+        )
+    return out
+
+
+_last_incident: Optional[str] = None
+_last_lock = threading.Lock()
+_bundle_seq = itertools.count(1)
+
+
+def last_incident_path() -> Optional[str]:
+    """Path of the most recent bundle this process wrote, or ``None``."""
+    with _last_lock:
+        return _last_incident
+
+
+def write_incident_bundle(
+    reason: str,
+    *,
+    attrs: Optional[Dict[str, Any]] = None,
+    dir: Optional[str] = None,  # noqa: A002 - CLI-ish keyword
+    flightrec_tail: int = 256,
+) -> str:
+    """Write one self-contained incident bundle; returns its path.
+
+    Contents (one JSON object): ``reason``, ``ts``, ``pid``/``argv``,
+    caller ``attrs``, ``threads`` (all-thread tracebacks),
+    ``flightrec`` (last ``flightrec_tail`` events), ``telemetry``
+    (metrics + recent span trees, :func:`.export.snapshot`), and
+    ``trace_reunion`` (driver-side and node-side span trees merged per
+    trace id, :func:`.reunion.merge_all`).  Everything is read
+    best-effort: a half-wedged process must still get SOME bundle out,
+    so each section degrades to an ``"error"`` string instead of
+    aborting the write.
+    """
+    from . import export as _export
+    from . import flightrec as _flightrec
+    from . import reunion as _reunion
+
+    bundle: dict = {
+        "reason": reason,
+        "ts": time.time(),
+        "pid": os.getpid(),
+        "argv": list(sys.argv),
+        "attrs": dict(attrs or {}),
+    }
+    for key, build in (
+        ("threads", thread_dump),
+        ("flightrec", lambda: _flightrec.events(flightrec_tail)),
+        ("telemetry", _export.snapshot),
+        ("trace_reunion", _reunion.merge_all),
+    ):
+        try:
+            bundle[key] = build()
+        except Exception as e:  # best-effort: never lose the bundle
+            bundle[key] = {"error": f"{type(e).__name__}: {e}"}
+
+    slug = "".join(c if c.isalnum() or c in "-_" else "-" for c in reason)
+    stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    # Per-process sequence number: two bundles in the same SECOND (e.g.
+    # concurrent batch windows expiring together) must not clobber
+    # each other.
+    path = os.path.join(
+        dir or incident_dir(),
+        f"incident-{stamp}-{slug}-{os.getpid()}-{next(_bundle_seq)}.json",
+    )
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(bundle, fh, default=str)
+    global _last_incident
+    with _last_lock:
+        _last_incident = path
+    _flightrec.record("incident.bundle", reason=reason, path=path)
+    _log.warning("incident bundle written: %s (%s)", path, reason)
+    return path
+
+
+# -- the monitor ------------------------------------------------------------
+
+
+class _Armed:
+    """One armed deadline; also the context manager token."""
+
+    __slots__ = ("name", "deadline", "attrs", "active", "fired", "bundle")
+
+    def __init__(self, name: str, deadline: float, attrs: dict):
+        self.name = name
+        self.deadline = deadline
+        self.attrs = attrs
+        self.active = True  # lazy delete: disarm flips this
+        self.fired = False
+        self.bundle: Optional[str] = None
+
+    def __enter__(self) -> "_Armed":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        disarm(self)
+
+
+class _NoopArmed:
+    __slots__ = ()
+    name = None
+    fired = False
+    bundle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+
+_NOOP = _NoopArmed()
+
+_mon_lock = threading.Lock()
+_mon_cond = threading.Condition(_mon_lock)
+_heap: List[tuple] = []  # (deadline, seq, _Armed)
+_heap_seq = itertools.count()
+_mon_thread: Optional[threading.Thread] = None
+# name -> monotonic time of that arm point's last bundle write.  A
+# repeatedly-firing arm point (a deadline set below a workload's
+# legitimate wall, re-armed per batch) must not fill the disk with
+# near-identical bundles or bury a real incident: within the gap the
+# fire is still flight-recorded, only the bundle write is suppressed.
+_last_bundle_at: Dict[str, float] = {}
+
+
+def _bundle_gap_s() -> float:
+    return env_timeout_s("PFTPU_WATCHDOG_MIN_BUNDLE_GAP_S", 60.0)
+
+
+def _monitor() -> None:
+    with _mon_cond:
+        while True:
+            while _heap and (
+                not _heap[0][2].active or _heap[0][0] <= time.monotonic()
+            ):
+                _, _, entry = heapq.heappop(_heap)
+                if not entry.active:
+                    continue  # lazily-deleted disarm
+                entry.active = False
+                entry.fired = True
+                # Release the lock while writing: the bundle dump is
+                # slow I/O and arm/disarm must not stall behind it.
+                _mon_cond.release()
+                try:
+                    from . import flightrec as _flightrec
+
+                    now = time.monotonic()
+                    last = _last_bundle_at.get(entry.name)
+                    throttled = (
+                        last is not None and now - last < _bundle_gap_s()
+                    )
+                    if not throttled:
+                        entry.bundle = write_incident_bundle(
+                            f"watchdog:{entry.name}", attrs=entry.attrs
+                        )
+                        # Timestamp only a SUCCESSFUL write: a failed
+                        # write (disk full, unwritable dir) must not
+                        # throttle the next fire into writing nothing.
+                        _last_bundle_at[entry.name] = now
+                    _flightrec.record(
+                        "watchdog.fired",
+                        name=entry.name,
+                        bundle=entry.bundle,
+                        throttled=throttled,
+                        attrs=dict(entry.attrs),
+                    )
+                    _log.warning(
+                        "watchdog %r fired after its deadline — %s (the "
+                        "wedged operation is still wedged; this thread "
+                        "only reports)",
+                        entry.name,
+                        f"incident bundle at {entry.bundle}"
+                        if entry.bundle
+                        else "bundle write throttled "
+                        "(PFTPU_WATCHDOG_MIN_BUNDLE_GAP_S)",
+                    )
+                except Exception:
+                    _log.exception("watchdog bundle write failed")
+                finally:
+                    _mon_cond.acquire()
+            if _heap:
+                _mon_cond.wait(max(0.0, _heap[0][0] - time.monotonic()))
+            else:
+                _mon_cond.wait()
+
+
+def arm(name: str, timeout_s: float, **attrs: Any):
+    """Arm a deadline ``timeout_s`` from now; returns a token for
+    :func:`disarm` (also a context manager).  ``timeout_s <= 0`` or
+    telemetry disabled returns a shared no-op token."""
+    if timeout_s is None or timeout_s <= 0 or not _spans.enabled():
+        return _NOOP
+    entry = _Armed(name, time.monotonic() + timeout_s, attrs)
+    global _mon_thread
+    with _mon_cond:
+        if _mon_thread is None or not _mon_thread.is_alive():
+            _mon_thread = threading.Thread(
+                target=_monitor, name="pftpu-watchdog", daemon=True
+            )
+            _mon_thread.start()
+        heapq.heappush(_heap, (entry.deadline, next(_heap_seq), entry))
+        _mon_cond.notify()
+    return entry
+
+
+def disarm(token) -> None:
+    """Cancel an armed deadline (idempotent; no-op token accepted)."""
+    if isinstance(token, _Armed):
+        token.active = False  # lazy delete; monitor skips it
+
+
+def armed(name: str, timeout_s: Optional[float] = None, **attrs: Any):
+    """Context manager form: ``with watchdog.armed("tcp.batch", 300):``.
+    ``timeout_s=None`` uses the RPC default (:func:`rpc_timeout_s`);
+    the yielded token's ``.fired``/``.bundle`` report what happened."""
+    if timeout_s is None:
+        timeout_s = rpc_timeout_s()
+    return arm(name, timeout_s, **attrs)

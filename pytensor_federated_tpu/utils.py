@@ -64,9 +64,12 @@ def probe_backend(
             "assert np.allclose(np.asarray(gmu), 2.0 * N), np.asarray(gmu)\n"
             "print('MOSAIC_OK', flush=True)\n"
         )
+    from .telemetry import flightrec as _flightrec
+
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ)
     env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    timed_out = False
     try:
         res = subprocess.run(
             [sys.executable, "-c", code],
@@ -83,11 +86,24 @@ def probe_backend(
             )
     except subprocess.TimeoutExpired as e:
         out = (e.stdout or b"").decode("utf-8", "replace")
+        timed_out = True
         print(f"# backend probe timed out after {timeout_s}s", file=sys.stderr)
     except OSError as e:
         print(f"# backend probe could not run: {e}", file=sys.stderr)
+        _flightrec.record("probe.backend", verdict="unrunnable", error=str(e))
         return False, False
-    return "LIVE" in out, "MOSAIC_OK" in out
+    live, mosaic_ok = "LIVE" in out, "MOSAIC_OK" in out
+    # Probe verdicts are the canonical pre-incident breadcrumb for a
+    # wedged PJRT tunnel: a DEAD verdict's timestamp bounds when the
+    # wedge happened (flight-record taxonomy: probe.backend).
+    _flightrec.record(
+        "probe.backend",
+        verdict="live" if live else ("timeout" if timed_out else "dead"),
+        try_mosaic=try_mosaic,
+        mosaic_ok=mosaic_ok,
+        timeout_s=timeout_s,
+    )
+    return live, mosaic_ok
 
 
 def ensure_live_backend(

@@ -1,0 +1,325 @@
+"""Flight recorder (telemetry/flightrec.py): ring semantics, the
+open-span pinning/eviction contract (property-tested), span hooks,
+dump lanes (demand / signal / crash), and the disabled fast path.
+"""
+
+import json
+import os
+import signal
+import time
+
+import pytest
+
+from pytensor_federated_tpu import telemetry
+from pytensor_federated_tpu.telemetry import flightrec
+from pytensor_federated_tpu.telemetry import spans as tspans
+
+
+@pytest.fixture(autouse=True)
+def _clean(tmp_path, monkeypatch):
+    monkeypatch.setenv("PFTPU_INCIDENT_DIR", str(tmp_path / "incidents"))
+    prev = tspans.set_enabled(True)
+    prev_rec = flightrec.set_enabled(True)
+    flightrec.clear()
+    flightrec.set_capacity(512)
+    telemetry.clear_traces()
+    yield
+    tspans.set_enabled(prev)
+    flightrec.set_enabled(prev_rec)
+    flightrec.clear()
+    flightrec.set_capacity(512)
+    telemetry.clear_traces()
+
+
+class TestRecord:
+    def test_events_carry_seq_ts_kind_and_attrs(self):
+        flightrec.record("unit.demo", a=1, b="x")
+        (ev,) = flightrec.events()
+        assert ev["kind"] == "unit.demo" and ev["a"] == 1 and ev["b"] == "x"
+        assert ev["seq"] >= 1 and ev["ts"] > 0
+
+    def test_active_trace_id_is_stamped(self):
+        with telemetry.span("op"):
+            tid = tspans.current_trace_id().hex()
+            flightrec.record("unit.traced")
+        traced = [
+            e for e in flightrec.events() if e["kind"] == "unit.traced"
+        ]
+        assert traced[0]["trace_id"] == tid
+
+    def test_ring_caps_and_keeps_newest(self):
+        flightrec.set_capacity(8)
+        for i in range(50):
+            flightrec.record("unit.n", i=i)
+        evs = flightrec.events()
+        assert len(evs) == 8
+        assert [e["i"] for e in evs] == list(range(42, 50))
+
+    def test_disabled_records_nothing(self):
+        flightrec.set_enabled(False)
+        flightrec.record("unit.gone")
+        with telemetry.span("op"):  # span hooks must also stand down
+            pass
+        assert flightrec.events() == []
+        flightrec.set_enabled(True)
+        # master telemetry switch wins even with the recorder on
+        tspans.set_enabled(False)
+        flightrec.record("unit.gone2")
+        assert flightrec.events() == []
+
+    def test_reserved_keys_survive_attr_collision(self):
+        flightrec.record("unit.a")
+        flightrec.record("unit.forged", seq=-1, ts=0.0, trace_id="spoof")
+        with telemetry.span("op"):
+            flightrec.record("unit.forged2", seq=-2, trace_id="spoof")
+            real_tid = tspans.current_trace_id().hex()
+        a, forged, forged2 = flightrec.events()[:3]
+        assert forged["kind"] == "unit.forged"
+        assert forged["seq"] == a["seq"] + 1  # monotonic, not -1
+        assert forged["ts"] > 0
+        # no ambient trace: the forged trace_id attr survives as data,
+        # but under a live trace the AMBIENT id wins
+        assert forged2["trace_id"] == real_tid
+
+    def test_events_n_tail(self):
+        for i in range(10):
+            flightrec.record("unit.n", i=i)
+        assert [e["i"] for e in flightrec.events(3)] == [7, 8, 9]
+
+
+class TestSpanHooks:
+    def test_open_close_pairs_in_order(self):
+        with telemetry.span("outer"):
+            with telemetry.span("inner"):
+                pass
+        kinds_names = [
+            (e["kind"], e.get("name")) for e in flightrec.events()
+        ]
+        assert kinds_names == [
+            ("span.open", "outer"),
+            ("span.open", "inner"),
+            ("span.close", "inner"),
+            ("span.close", "outer"),
+        ]
+
+    def test_close_event_carries_duration_and_error(self):
+        with pytest.raises(ValueError):
+            with telemetry.span("boom"):
+                raise ValueError("pop")
+        (close,) = [
+            e for e in flightrec.events() if e["kind"] == "span.close"
+        ]
+        assert close["duration_s"] >= 0
+        assert close["error"] == "ValueError: pop"
+
+    def test_still_open_span_visible_in_events(self):
+        cm = telemetry.span("held")
+        cm.__enter__()
+        try:
+            opens = [
+                e
+                for e in flightrec.events()
+                if e["kind"] == "span.open" and e["name"] == "held"
+            ]
+            assert opens, "open event of a live span must be readable"
+        finally:
+            cm.__exit__(None, None, None)
+
+
+class TestEvictionPinning:
+    """The eviction contract: the span.open events of every still-open
+    span — hence of a still-open span's whole ancestor chain — survive
+    any amount of ring pressure."""
+
+    def test_open_ancestry_survives_heavy_eviction(self):
+        flightrec.set_capacity(4)
+        outer = telemetry.span("anc.outer")
+        outer.__enter__()
+        mid = telemetry.span("anc.mid")
+        mid.__enter__()
+        try:
+            for i in range(100):  # 100 noise events through a 4-ring
+                flightrec.record("noise", i=i)
+            open_names = {
+                e["name"]
+                for e in flightrec.events()
+                if e["kind"] == "span.open"
+            }
+            assert {"anc.outer", "anc.mid"} <= open_names
+        finally:
+            mid.__exit__(None, None, None)
+            outer.__exit__(None, None, None)
+
+    def test_tail_trim_keeps_pinned_opens(self):
+        """events(n) trims the RING tail but never the pinned opens —
+        the incident-bundle path (flightrec_tail=256) must still show
+        how a long-stuck operation started."""
+        cm = telemetry.span("tail.open")
+        cm.__enter__()
+        try:
+            for i in range(50):
+                flightrec.record("noise", i=i)
+            evs = flightrec.events(5)
+            assert any(
+                e["kind"] == "span.open" and e["name"] == "tail.open"
+                for e in evs
+            ), "tail-trim dropped the still-open span's start"
+            # and the newest ring events are the trimmed tail
+            noise = [e["i"] for e in evs if e["kind"] == "noise"]
+            assert noise == list(range(45, 50))
+        finally:
+            cm.__exit__(None, None, None)
+
+    def test_disable_while_open_still_unpins_on_close(self):
+        """set_enabled(False) mid-span must not strand the pinned open
+        event (it would report a closed span as open forever)."""
+        cm = telemetry.span("leak.probe")
+        cm.__enter__()
+        flightrec.set_enabled(False)
+        cm.__exit__(None, None, None)
+        flightrec.set_enabled(True)
+        flightrec.record("after")
+        names = {
+            e.get("name")
+            for e in flightrec.events()
+            if e["kind"] == "span.open"
+        }
+        assert "leak.probe" not in names
+
+    def test_closed_spans_lose_pinning(self):
+        flightrec.set_capacity(4)
+        with telemetry.span("short"):
+            pass
+        for i in range(50):
+            flightrec.record("noise", i=i)
+        names = {
+            e.get("name")
+            for e in flightrec.events()
+            if e["kind"] == "span.open"
+        }
+        assert "short" not in names  # evicted like any ring event
+
+    @staticmethod
+    def _check_interleaving(ops, cap):
+        """Drive one open/close/noise interleaving and assert the
+        invariant: every still-open span's open event (ancestors
+        included — they are by construction still open) is present in
+        events(), whatever the ring pressure."""
+        flightrec.clear()
+        flightrec.set_capacity(cap)
+        stack = []  # the open-span chain (innermost last)
+        counter = [0]
+        try:
+            for op in ops:
+                if op == "open":
+                    counter[0] += 1
+                    cm = telemetry.span(f"p{counter[0]}")
+                    cm.__enter__()
+                    stack.append(cm)
+                elif op == "close" and stack:
+                    stack.pop().__exit__(None, None, None)
+                else:
+                    flightrec.record("noise")
+            open_ids = {cm.span.span_id for cm in stack}
+            seen_ids = {
+                e["span_id"]
+                for e in flightrec.events()
+                if e["kind"] == "span.open"
+            }
+            assert open_ids <= seen_ids, (
+                f"evicted open events of live spans (cap={cap}, "
+                f"ops={ops}): {open_ids - seen_ids}"
+            )
+        finally:
+            while stack:
+                stack.pop().__exit__(None, None, None)
+
+    def test_property_open_ancestors_never_evicted_seeded(self):
+        """Seeded-random interleavings — runs in every environment
+        (hypothesis is importorskip-gated in this container, same as
+        tests/test_npwire_properties.py)."""
+        import random
+
+        rng = random.Random(20260802)
+        for _ in range(120):
+            cap = rng.randint(1, 6)
+            ops = rng.choices(
+                ["open", "close", "noise"],
+                weights=[2, 1, 4],
+                k=rng.randint(1, 120),
+            )
+            self._check_interleaving(ops, cap)
+
+    def test_property_open_ancestors_never_evicted_hypothesis(self):
+        """The same invariant under hypothesis shrinking, where
+        available."""
+        pytest.importorskip("hypothesis")
+        from hypothesis import given, settings
+        from hypothesis import strategies as st
+
+        ops = st.lists(
+            st.sampled_from(["open", "close", "noise"]),
+            min_size=1,
+            max_size=120,
+        )
+
+        @settings(max_examples=60, deadline=None)
+        @given(ops=ops, cap=st.integers(min_value=1, max_value=6))
+        def run(ops, cap):
+            self._check_interleaving(ops, cap)
+
+        run()
+
+
+class TestDumpLanes:
+    def test_dump_degrades_non_json_attrs(self, tmp_path):
+        import numpy as np
+
+        flightrec.record("unit.np", accept=np.float32(0.61))
+        path = tmp_path / "np.jsonl"
+        assert flightrec.dump_jsonl(str(path)) == 1
+        (rec,) = [json.loads(l) for l in path.read_text().splitlines()]
+        assert rec["accept"] == "0.61"  # default=str, never TypeError
+
+    def test_dump_jsonl_appends_and_roundtrips(self, tmp_path):
+        flightrec.record("unit.a", x=1)
+        flightrec.record("unit.b")
+        path = tmp_path / "rec.jsonl"
+        n = flightrec.dump_jsonl(str(path))
+        assert n == 2
+        lines = [json.loads(l) for l in path.read_text().splitlines()]
+        assert [l["kind"] for l in lines] == ["unit.a", "unit.b"]
+        flightrec.dump_jsonl(str(path))  # append-mode
+        assert len(path.read_text().splitlines()) == 4
+
+    def test_signal_and_crash_handlers(self, tmp_path):
+        import sys
+
+        dump = tmp_path / "sig.jsonl"
+        got = flightrec.install_handlers(str(dump), on_exit=False)
+        assert got == str(dump)
+        flightrec.record("unit.sig")
+        os.kill(os.getpid(), signal.SIGUSR2)
+        # The handler only SPAWNS the dumping thread (taking the
+        # recorder lock in the handler frame could deadlock) — wait.
+        deadline = time.time() + 10
+        while not dump.exists() and time.time() < deadline:
+            time.sleep(0.05)
+        assert dump.exists(), "SIGUSR2 did not dump the flight record"
+        assert any(
+            json.loads(l)["kind"] == "unit.sig"
+            for l in dump.read_text().splitlines()
+        )
+        # crash lane: the chained excepthook writes an incident bundle
+        from pytensor_federated_tpu.telemetry import watchdog
+
+        before = watchdog.last_incident_path()
+        sys.excepthook(ValueError, ValueError("boom"), None)
+        after = watchdog.last_incident_path()
+        assert after and after != before
+        with open(after) as fh:
+            bundle = json.load(fh)
+        assert bundle["reason"] == "crash"
+        assert bundle["attrs"]["exc_type"] == "ValueError"
+        # idempotent: a second install is a no-op returning a path
+        assert flightrec.install_handlers(str(dump)) == str(dump)

@@ -1,0 +1,351 @@
+"""ISSUE 2 acceptance: a node wedges mid-batch across a REAL process
+boundary and the driver — with zero manual steps — produces a merged
+incident bundle containing BOTH sides' spans for the same trace id,
+the flight-recorder tail, and an all-thread traceback; plus the wire
+invariant that an untraced frame stays byte-identical to the PR-1
+format under both codecs.
+
+The child (tests/wedge_node_proc.py) is a plain npwire TCP node whose
+compute blocks forever on a poison request — the stand-in for the
+tunneled runtime's silent-wedge mode.  The driver's pipelined batch
+arms the hang watchdog (service/tcp.py), so the wedge fires an
+incident bundle while the batch is still stuck; the test then SIGKILLs
+the node to unblock and assert the bundle's contents.
+"""
+
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pytensor_federated_tpu import telemetry
+from pytensor_federated_tpu.telemetry import flightrec, reunion, watchdog
+from pytensor_federated_tpu.telemetry import spans as tspans
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+NODE = os.path.join(HERE, "wedge_node_proc.py")
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry(tmp_path, monkeypatch):
+    """Telemetry is process-global; isolate and point incidents at
+    tmp_path so bundles never leak between tests."""
+    monkeypatch.setenv("PFTPU_INCIDENT_DIR", str(tmp_path / "incidents"))
+    prev = tspans.set_enabled(True)
+    prev_rec = flightrec.set_enabled(True)
+    telemetry.REGISTRY.reset()
+    telemetry.clear_traces()
+    flightrec.clear()
+    reunion.clear()
+    yield
+    tspans.set_enabled(prev)
+    flightrec.set_enabled(prev_rec)
+    telemetry.REGISTRY.reset()
+    telemetry.clear_traces()
+    flightrec.clear()
+    reunion.clear()
+
+
+def _spawn_node():
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"  # the child imports the package, not jax
+    proc = subprocess.Popen(
+        [sys.executable, NODE],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    line = proc.stdout.readline().strip()
+    assert line.startswith("PORT "), line
+    return proc, int(line.split()[1])
+
+
+@pytest.mark.slow
+def test_wedged_node_midbatch_yields_merged_incident_bundle(
+    tmp_path, monkeypatch
+):
+    from pytensor_federated_tpu.service.tcp import TcpArraysClient
+
+    # A test-scale deadline: the watchdog must fire while the batch is
+    # still wedged (the node sleeps 3600 s, the client socket times out
+    # after 30 s — 1.5 s sits far below both).
+    monkeypatch.setenv("PFTPU_WATCHDOG_RPC_S", "1.5")
+
+    proc, port = _spawn_node()
+    try:
+        client = TcpArraysClient("127.0.0.1", port, retries=0)
+
+        # 1) One HEALTHY call: its reply piggybacks the node's span
+        #    tree, so the reunion store holds both halves of this trace
+        #    BEFORE the incident — what the bundle must contain.
+        out = client.evaluate(np.arange(3.0))
+        np.testing.assert_array_equal(out[0], 2.0 * np.arange(3.0))
+        (drv,) = [
+            t
+            for t in telemetry.recent_traces()
+            if t["name"] == "rpc.evaluate"
+        ]
+        tid = drv["trace_id"]
+        remote = reunion.remote_traces(tid)
+        assert remote, "reply piggyback never reached the reunion store"
+        assert remote[0]["name"] == "node.evaluate"
+
+        # 2) Mid-batch WEDGE: request 2 of the pipelined window carries
+        #    the poison value; the node blocks forever and the driver's
+        #    batch read hangs inside the armed window.
+        batch_err = {}
+
+        def run_batch():
+            try:
+                client.evaluate_many(
+                    [
+                        (np.ones(2),),
+                        (np.array([-1.0, 0.0]),),
+                        (np.ones(2),),
+                    ],
+                    window=3,
+                )
+            except Exception as e:  # noqa: BLE001 - recorded for assert
+                batch_err["exc"] = e
+
+        # last_incident_path is process-global — wait for it to CHANGE
+        # (an earlier test in the same process may have written one).
+        before = watchdog.last_incident_path()
+        t = threading.Thread(target=run_batch, daemon=True)
+        t.start()
+
+        # 3) ZERO manual steps: the incident bundle appears on its own.
+        deadline = time.time() + 15
+        bundle_path = None
+        while time.time() < deadline:
+            bundle_path = watchdog.last_incident_path()
+            if bundle_path and bundle_path != before:
+                break
+            time.sleep(0.1)
+        assert bundle_path and bundle_path != before, (
+            "watchdog never produced an incident bundle"
+        )
+    finally:
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+    t.join(timeout=30)
+    assert not t.is_alive(), "batch thread still stuck after node kill"
+    assert isinstance(
+        batch_err.get("exc"), (ConnectionError, OSError)
+    ), batch_err
+
+    with open(bundle_path, "r", encoding="utf-8") as fh:
+        bundle = json.load(fh)
+
+    # -- the acceptance assertions -------------------------------------
+    assert bundle["reason"] == "watchdog:tcp.batch_window"
+
+    # all-thread traceback, including the thread stuck in the batch read
+    stacks = [
+        "\n".join(th["stack"]) for th in bundle["threads"]
+    ]
+    assert any(
+        "_evaluate_many_once" in s or "_read_frame" in s for s in stacks
+    ), "no thread dump shows the wedged batch window"
+
+    # the last N flight-recorder events, ending at the incident
+    events = bundle["flightrec"]
+    assert isinstance(events, list) and events
+    kinds = {e["kind"] for e in events}
+    assert "span.open" in kinds  # the still-open batch span is pinned
+    open_names = {
+        e.get("name") for e in events if e["kind"] == "span.open"
+    }
+    assert "rpc.evaluate_many" in open_names
+
+    # merged driver+node spans for the SAME trace id
+    merged = {
+        tr["trace_id"]: tr for tr in bundle["trace_reunion"]
+    }
+    assert tid in merged, "healthy call's trace id missing from reunion"
+    assert merged[tid]["driver"], "driver-side spans missing"
+    assert merged[tid]["remote"], "node-side spans missing"
+    assert merged[tid]["remote"][0]["name"] == "node.evaluate"
+    assert merged[tid]["driver"][0]["name"] == "rpc.evaluate"
+
+    # and the metrics snapshot rode along
+    assert "metrics" in bundle["telemetry"]
+
+
+class TestUntracedFramesByteIdentical:
+    """Acceptance: with no active trace, request AND reply bytes are
+    identical to the PR-1 wire format under both codecs — the reunion
+    piggyback must be invisible until a trace asks for it."""
+
+    def _serve_once(self, request: bytes) -> bytes:
+        from pytensor_federated_tpu.service.server import (
+            ArraysToArraysService,
+        )
+
+        service = ArraysToArraysService(lambda x: [2.0 * x])
+        return asyncio.run(service.evaluate(request, None))
+
+    def test_npwire_untraced_bytes_unchanged(self):
+        from pytensor_federated_tpu.service.npwire import encode_arrays
+
+        x = np.arange(4.0)
+        uid = b"u" * 16
+        request = encode_arrays([x], uuid=uid)  # no trace_id: PR-1 frame
+        # telemetry fully ON — absence of a trace alone must keep the
+        # wire clean...
+        reply = self._serve_once(request)
+        assert reply == encode_arrays([2.0 * x], uuid=uid)
+        # ...and with telemetry OFF, byte-for-byte the same again.
+        prev = tspans.set_enabled(False)
+        try:
+            reply_off = self._serve_once(request)
+        finally:
+            tspans.set_enabled(prev)
+        assert reply_off == reply
+
+    def test_npproto_untraced_bytes_unchanged(self):
+        from pytensor_federated_tpu.service import npproto_codec as npc
+
+        x = np.arange(4.0)
+        request = npc.encode_arrays_msg([x], uuid="corr-1")
+        reply = self._serve_once(request)
+        assert reply == npc.encode_arrays_msg([2.0 * x], uuid="corr-1")
+        prev = tspans.set_enabled(False)
+        try:
+            reply_off = self._serve_once(request)
+        finally:
+            tspans.set_enabled(prev)
+        assert reply_off == reply
+
+    def test_traced_npwire_reply_carries_spans_and_correlates(self):
+        """The flip side: a TRACED request gets the piggyback, and the
+        ingested node tree carries the driver's trace id."""
+        from pytensor_federated_tpu.service.npwire import (
+            decode_arrays_all,
+            encode_arrays,
+        )
+
+        x = np.arange(4.0)
+        tid = tspans.new_trace_id()
+        request = encode_arrays([x], uuid=b"v" * 16, trace_id=tid)
+        reply = self._serve_once(request)
+        _arr, _uuid, _err, _rt, spans = decode_arrays_all(reply)
+        assert spans and spans[0]["name"] == "node.evaluate"
+        assert spans[0]["trace_id"] == tid.hex()
+
+    def test_numpy_span_attrs_do_not_fail_the_reply(self):
+        """The sidecar must never fail the RPC that carried results: a
+        compute_fn opening its own span with a numpy attr (documented
+        public API) still gets its reply through — the attr degrades
+        to its string form in the piggybacked JSON."""
+        from pytensor_federated_tpu.service.npwire import (
+            decode_arrays_all,
+            encode_arrays,
+        )
+        from pytensor_federated_tpu.service.server import (
+            ArraysToArraysService,
+        )
+
+        def compute(x):
+            with tspans.span("user.step", val=np.float32(0.5)):
+                return [2.0 * x]
+
+        x = np.arange(4.0)
+        tid = tspans.new_trace_id()
+        request = encode_arrays([x], uuid=b"n" * 16, trace_id=tid)
+        # inline_compute: the user span must PARENT under the node tree
+        # (the thread executor would not propagate the contextvars).
+        service = ArraysToArraysService(compute, inline_compute=True)
+        reply = asyncio.run(service.evaluate(request, None))
+        arr, _u, _e, _t, spans = decode_arrays_all(reply)
+        np.testing.assert_array_equal(arr[0], 2.0 * x)
+        (tree,) = spans
+
+        def find(node, name):
+            if node.get("name") == name:
+                return node
+            for c in node.get("children", ()):
+                got = find(c, name)
+                if got is not None:
+                    return got
+            return None
+
+        user = find(tree, "user.step")
+        assert user is not None, tree
+        assert user["attrs"]["val"] == "0.5"  # default=str degraded
+
+    def test_ship_spans_false_keeps_traced_reply_clean(self):
+        from pytensor_federated_tpu.service.npwire import (
+            decode_arrays_all,
+            encode_arrays,
+        )
+        from pytensor_federated_tpu.service.server import (
+            ArraysToArraysService,
+        )
+
+        x = np.arange(4.0)
+        tid = tspans.new_trace_id()
+        request = encode_arrays([x], uuid=b"w" * 16, trace_id=tid)
+        service = ArraysToArraysService(
+            lambda a: [2.0 * a], ship_spans=False
+        )
+        reply = asyncio.run(service.evaluate(request, None))
+        assert decode_arrays_all(reply)[4] is None
+        assert reply == encode_arrays([2.0 * x], uuid=b"w" * 16)
+
+
+def test_getload_traces_pull_reaches_reunion():
+    """The PULL half of reunion: spans stranded on a live node (their
+    reply already consumed without a trace... or lost) come home via
+    GetLoad b"traces"."""
+    from pytensor_federated_tpu.service import get_node_traces
+    from pytensor_federated_tpu.service.npwire import encode_arrays
+    from pytensor_federated_tpu.service.server import (
+        ArraysToArraysService,
+        serve,
+    )
+
+    import socket
+
+    def _free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    tid = tspans.new_trace_id()
+
+    async def main():
+        port = _free_port()
+        service = ArraysToArraysService(lambda x: [x + 1.0])
+        server = await serve(None, "127.0.0.1", port, service=service)
+        try:
+            # Seed one traced node-side span WITHOUT a driver-side
+            # decode of the reply (simulate a stranded trace).
+            req = encode_arrays(
+                [np.ones(2)], uuid=b"z" * 16, trace_id=tid
+            )
+            await service.evaluate(req, None)
+            reunion.clear()  # the piggyback never reached any driver
+            from pytensor_federated_tpu.service.client import (
+                get_node_traces_async,
+            )
+
+            return await get_node_traces_async("127.0.0.1", port)
+        finally:
+            await server.stop(None)
+
+    traces = asyncio.run(main())
+    assert any(t["trace_id"] == tid.hex() for t in traces)
+    assert reunion.remote_traces(tid.hex()), (
+        "pulled traces were not ingested into the reunion store"
+    )
+    assert callable(get_node_traces)  # sync wrapper exported

@@ -1067,3 +1067,173 @@ class TestHeartbeatBindPosture:
             assert hb.port > 0
         finally:
             hb.stop()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 2: concurrent scrapes + metrics_dump --traces/--snapshot modes
+# ---------------------------------------------------------------------------
+
+
+def _load_metrics_dump():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "metrics_dump",
+        Path(__file__).resolve().parent.parent / "tools" / "metrics_dump.py",
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_concurrent_scrapes_mid_workload():
+    """Two clients hammer /metrics + /snapshot WHILE a workload mutates
+    the registry and span ring: every response must be well-formed (no
+    torn renders, no 500s) — the exporter reads live shared state under
+    the instrument locks, and this pins that down."""
+    stop = threading.Event()
+    errors = []
+
+    c = telemetry.counter("t_conc_total", "concurrency probe")
+    h = telemetry.histogram("t_conc_seconds", "concurrency probe")
+
+    def workload():
+        i = 0
+        while not stop.is_set():
+            with telemetry.span("conc.op", i=i):
+                c.inc()
+                h.observe(0.001 * (i % 7))
+            i += 1
+
+    def scraper(base, route):
+        try:
+            for _ in range(25):
+                with urllib.request.urlopen(base + route, timeout=10) as r:
+                    body = r.read()
+                    assert r.status == 200
+                if route == "/metrics":
+                    validate_prometheus_text(body.decode())
+                else:
+                    snap = json.loads(body)
+                    assert "metrics" in snap and "traces" in snap
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            errors.append((route, e))
+
+    with telemetry.start_exporter(port=0) as exporter:
+        base = f"http://127.0.0.1:{exporter.port}"
+        w = threading.Thread(target=workload, daemon=True)
+        w.start()
+        scrapers = [
+            threading.Thread(target=scraper, args=(base, "/metrics")),
+            threading.Thread(target=scraper, args=(base, "/snapshot")),
+        ]
+        for t in scrapers:
+            t.start()
+        for t in scrapers:
+            t.join(timeout=60)
+            assert not t.is_alive(), "scraper wedged"
+        stop.set()
+        w.join(timeout=10)
+    assert not errors, errors
+
+
+class TestMetricsDumpModes:
+    def test_traces_mode_scrapes_span_trees(self, tmp_path, capsys):
+        metrics_dump = _load_metrics_dump()
+        with telemetry.span("md.traced"):
+            pass
+        out = tmp_path / "traces.jsonl"
+        with telemetry.start_exporter(port=0) as exporter:
+            rc = metrics_dump.main(
+                ["--port", str(exporter.port), "--traces",
+                 "--out", str(out)]
+            )
+            assert rc == 0
+            rc = metrics_dump.main(["--port", str(exporter.port), "--traces"])
+            assert rc == 0
+        rec = json.loads(out.read_text())
+        assert any(t["name"] == "md.traced" for t in rec["traces"])
+        assert '"md.traced"' in capsys.readouterr().out
+
+    def test_snapshot_mode_explicit(self, tmp_path):
+        metrics_dump = _load_metrics_dump()
+        telemetry.counter("t_md_total", "demo").inc(7)
+        out = tmp_path / "snap.jsonl"
+        with telemetry.start_exporter(port=0) as exporter:
+            rc = metrics_dump.main(
+                ["--port", str(exporter.port), "--snapshot",
+                 "--out", str(out)]
+            )
+            assert rc == 0
+        rec = json.loads(out.read_text())
+        assert rec["metrics"]["t_md_total"]["children"][0]["value"] == 7
+
+    def test_modes_are_mutually_exclusive(self, capsys):
+        metrics_dump = _load_metrics_dump()
+        with pytest.raises(SystemExit):
+            metrics_dump.main(["--port", "1", "--traces", "--text"])
+        capsys.readouterr()
+
+    def test_unreachable_and_malformed_exit_nonzero(self, capsys):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        metrics_dump = _load_metrics_dump()
+        # unreachable
+        port = _free_port()
+        assert metrics_dump.main(["--port", str(port), "--traces"]) == 1
+        assert metrics_dump.main(["--port", str(port), "--snapshot"]) == 1
+
+        # malformed: an endpoint answering garbage on every route
+        class Garbage(BaseHTTPRequestHandler):
+            def do_GET(self):
+                body = b"<html>not telemetry</html>"
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), Garbage)
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        try:
+            gport = str(httpd.server_address[1])
+            assert metrics_dump.main(["--port", gport, "--traces"]) == 1
+            assert metrics_dump.main(["--port", gport, "--snapshot"]) == 1
+            assert metrics_dump.main(["--port", gport, "--text"]) == 1
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+        capsys.readouterr()
+
+    def test_wrong_shape_json_exits_nonzero(self, capsys):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        metrics_dump = _load_metrics_dump()
+
+        class WrongShape(BaseHTTPRequestHandler):
+            def do_GET(self):
+                # valid JSON, wrong shape for BOTH routes: /traces gets
+                # a dict, /snapshot a metrics-less dict
+                body = b'{"oops": true}'
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), WrongShape)
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        try:
+            gport = str(httpd.server_address[1])
+            assert metrics_dump.main(["--port", gport, "--traces"]) == 1
+            assert metrics_dump.main(["--port", gport, "--snapshot"]) == 1
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+        capsys.readouterr()

@@ -45,3 +45,66 @@ def test_single_attempt_mode_returns_attempt_code(tpu_poll, monkeypatch,
     monkeypatch.setattr(tpu_poll, "_attempt", lambda args: 4)
     monkeypatch.setattr(tpu_poll, "LOG", str(tmp_path / "log"))
     assert tpu_poll.main([]) == 4
+
+
+def test_dry_run_dead_probe_logs_incident_bundle(tpu_poll, monkeypatch,
+                                                 tmp_path):
+    """ISSUE 2 satellite: a liveness-probe timeout must leave FORENSICS
+    — the incident bundle's path lands in capture_attempts.log."""
+    import pytensor_federated_tpu.utils as utils
+
+    monkeypatch.setattr(utils, "probe_backend",
+                        lambda **kw: (False, False))
+    monkeypatch.setattr(tpu_poll, "REPO", str(tmp_path))
+    log = tmp_path / "capture_attempts.log"
+    monkeypatch.setattr(tpu_poll, "LOG", str(log))
+    rc = tpu_poll.main(["--dry-run"])
+    assert rc == 1
+    text = log.read_text()
+    assert "probe: DEAD" in text and "incident bundle -> " in text
+    rel = text.split("incident bundle -> ")[1].split()[0]
+    bundle = tmp_path / rel
+    assert bundle.exists()
+    import json
+
+    data = json.loads(bundle.read_text())
+    assert data["reason"] == "tpu-liveness-probe-timeout"
+    assert data["attrs"]["probe_timeout_s"] == 150.0
+    assert "threads" in data and "flightrec" in data
+
+
+def test_dry_run_live_probe_logs_no_incident(tpu_poll, monkeypatch,
+                                             tmp_path):
+    import pytensor_federated_tpu.utils as utils
+
+    monkeypatch.setattr(utils, "probe_backend", lambda **kw: (True, False))
+    monkeypatch.setattr(tpu_poll, "REPO", str(tmp_path))
+    log = tmp_path / "capture_attempts.log"
+    monkeypatch.setattr(tpu_poll, "LOG", str(log))
+    assert tpu_poll.main(["--dry-run"]) == 0
+    assert "incident" not in log.read_text()
+
+
+def test_attempt_probe_timeout_exit_logs_incident(tpu_poll, monkeypatch,
+                                                  tmp_path):
+    """Capture exit code 1 (= DEAD, probe timed out) in the real
+    attempt path also writes the bundle path into the log."""
+    import subprocess as subprocess_mod
+    import types
+
+    monkeypatch.setattr(tpu_poll, "REPO", str(tmp_path))
+    log = tmp_path / "capture_attempts.log"
+    monkeypatch.setattr(tpu_poll, "LOG", str(log))
+    # tools/ is already on sys.path via the fixture; fake the capture
+    # subprocess so no TPU (or bench) is involved.
+    monkeypatch.setattr(
+        subprocess_mod,
+        "run",
+        lambda *a, **kw: types.SimpleNamespace(returncode=1),
+    )
+    args = tpu_poll.main([])  # single-attempt mode returns attempt code
+    assert args == 1
+    text = log.read_text()
+    assert "exit=1" in text and "incident bundle -> " in text
+    rel = text.split("incident bundle -> ")[1].split()[0]
+    assert (tmp_path / rel).exists()

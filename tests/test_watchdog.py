@@ -1,0 +1,246 @@
+"""Hang watchdog + incident bundles (telemetry/watchdog.py) and the
+postmortem renderer (tools/incident_report.py)."""
+
+import importlib.util
+import json
+import os
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from pytensor_federated_tpu import telemetry
+from pytensor_federated_tpu.telemetry import flightrec, reunion, watchdog
+from pytensor_federated_tpu.telemetry import spans as tspans
+
+TOOLS = Path(__file__).resolve().parent.parent / "tools"
+
+
+@pytest.fixture(autouse=True)
+def _clean(tmp_path, monkeypatch):
+    monkeypatch.setenv("PFTPU_INCIDENT_DIR", str(tmp_path / "incidents"))
+    prev = tspans.set_enabled(True)
+    prev_rec = flightrec.set_enabled(True)
+    flightrec.clear()
+    reunion.clear()
+    telemetry.clear_traces()
+    yield
+    tspans.set_enabled(prev)
+    flightrec.set_enabled(prev_rec)
+    flightrec.clear()
+    reunion.clear()
+    telemetry.clear_traces()
+
+
+def _load_incident_report():
+    spec = importlib.util.spec_from_file_location(
+        "incident_report", TOOLS / "incident_report.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestArming:
+    def test_disarm_before_deadline_never_fires(self):
+        with watchdog.armed("unit.fast", 0.25) as tok:
+            pass  # exits (disarms) immediately
+        time.sleep(0.6)
+        assert not tok.fired and tok.bundle is None
+
+    def test_expiry_fires_and_writes_bundle(self):
+        tok = watchdog.arm("unit.hang", 0.2, site="test")
+        time.sleep(0.8)
+        assert tok.fired
+        assert tok.bundle and os.path.exists(tok.bundle)
+        assert watchdog.last_incident_path() == tok.bundle
+        with open(tok.bundle) as fh:
+            bundle = json.load(fh)
+        assert bundle["reason"] == "watchdog:unit.hang"
+        assert bundle["attrs"] == {"site": "test"}
+        # the firing itself is flight-recorded
+        kinds = [e["kind"] for e in flightrec.events()]
+        assert "watchdog.fired" in kinds and "incident.bundle" in kinds
+
+    def test_zero_timeout_and_disabled_telemetry_are_noops(self):
+        tok = watchdog.arm("unit.off", 0.0)
+        assert tok is not None and not tok.fired
+        tspans.set_enabled(False)
+        try:
+            tok2 = watchdog.arm("unit.off2", 0.05)
+        finally:
+            tspans.set_enabled(True)
+        time.sleep(0.2)
+        assert not tok2.fired
+
+    def test_same_name_refires_throttled(self, monkeypatch):
+        """A re-armed point firing again within the bundle gap is
+        flight-recorded but must NOT write a second bundle."""
+        monkeypatch.setenv("PFTPU_WATCHDOG_MIN_BUNDLE_GAP_S", "60")
+        first = watchdog.arm("unit.refire", 0.15)
+        time.sleep(0.6)
+        assert first.fired and first.bundle
+        second = watchdog.arm("unit.refire", 0.15)
+        time.sleep(0.6)
+        assert second.fired and second.bundle is None
+        fires = [
+            e for e in flightrec.events()
+            if e["kind"] == "watchdog.fired" and e["name"] == "unit.refire"
+        ]
+        assert [f["throttled"] for f in fires] == [False, True]
+
+    def test_same_second_bundles_get_distinct_paths(self):
+        p1 = watchdog.write_incident_bundle("same-sec")
+        p2 = watchdog.write_incident_bundle("same-sec")
+        assert p1 != p2 and os.path.exists(p1) and os.path.exists(p2)
+
+    def test_nested_arms_fire_independently(self):
+        slow = watchdog.arm("unit.slow", 30.0)
+        fast = watchdog.arm("unit.fast", 0.2)
+        time.sleep(0.8)
+        assert fast.fired and not slow.fired
+        watchdog.disarm(slow)
+
+    def test_armed_default_reads_env(self, monkeypatch):
+        monkeypatch.setenv("PFTPU_WATCHDOG_RPC_S", "123.5")
+        assert watchdog.rpc_timeout_s() == 123.5
+        monkeypatch.setenv("PFTPU_WATCHDOG_RPC_S", "0")
+        with watchdog.armed("unit.env") as tok:
+            pass
+        assert not tok.fired  # 0 = disarmed -> noop token
+
+
+class TestBundleContents:
+    def test_bundle_sections(self):
+        with telemetry.span("bundle.op"):
+            flightrec.record("unit.pre_incident", hint=1)
+            path = watchdog.write_incident_bundle(
+                "unit-test", attrs={"k": "v"}
+            )
+        with open(path) as fh:
+            bundle = json.load(fh)
+        assert bundle["reason"] == "unit-test"
+        assert bundle["pid"] == os.getpid()
+        # all-thread dump includes THIS thread, by name
+        me = threading.current_thread().name
+        assert any(t["name"] == me for t in bundle["threads"])
+        assert any(t["stack"] for t in bundle["threads"])
+        # flight record tail rode along (the open span is pinned)
+        kinds = {e["kind"] for e in bundle["flightrec"]}
+        assert {"unit.pre_incident", "span.open"} <= kinds
+        # metrics + reunion sections exist
+        assert "metrics" in bundle["telemetry"]
+        assert isinstance(bundle["trace_reunion"], list)
+
+    def test_bundle_merges_reunion_traces(self):
+        with telemetry.span("merge.op"):
+            tid = tspans.current_trace_id().hex()
+        reunion.ingest(
+            [{"name": "node.evaluate", "trace_id": tid, "duration_s": 1.0}]
+        )
+        path = watchdog.write_incident_bundle("unit-merge")
+        with open(path) as fh:
+            bundle = json.load(fh)
+        merged = {t["trace_id"]: t for t in bundle["trace_reunion"]}
+        assert tid in merged
+        assert merged[tid]["driver"] and merged[tid]["remote"]
+
+
+class TestIncidentReportTool:
+    def _bundle(self):
+        with telemetry.span("report.op"):
+            flightrec.record("unit.ev", n=3)
+            tid = tspans.current_trace_id().hex()
+        reunion.ingest([{"name": "node.evaluate", "trace_id": tid}])
+        telemetry.counter("t_report_total", "demo").inc(2)
+        return watchdog.write_incident_bundle("render-me")
+
+    def test_markdown_render(self, tmp_path, capsys):
+        mod = _load_incident_report()
+        path = self._bundle()
+        assert mod.main([path]) == 0
+        out = capsys.readouterr().out
+        assert "# Incident: render-me" in out
+        assert "## All-thread traceback" in out
+        assert "`unit.ev`" in out
+        assert "node.evaluate" in out
+        assert "t_report_total" in out
+
+    def test_jsonl_render_and_outfile(self, tmp_path):
+        mod = _load_incident_report()
+        path = self._bundle()
+        out = tmp_path / "post.jsonl"
+        assert mod.main([path, "--jsonl", "-o", str(out)]) == 0
+        lines = [json.loads(l) for l in out.read_text().splitlines()]
+        assert lines[0]["record"] == "incident"
+        assert lines[0]["reason"] == "render-me"
+        assert any(
+            l["record"] == "event" and l["kind"] == "unit.ev"
+            for l in lines[1:]
+        )
+
+    def test_bad_inputs_exit_nonzero(self, tmp_path, capsys):
+        mod = _load_incident_report()
+        assert mod.main([str(tmp_path / "missing.json")]) == 1
+        bad = tmp_path / "bad.json"
+        bad.write_text("{\"not\": \"a bundle\"}")
+        assert mod.main([str(bad)]) == 1
+        capsys.readouterr()
+
+
+class TestReunionStore:
+    def test_ingest_bounds_and_filters(self):
+        assert reunion.ingest([{"no_trace": 1}, "garbage", None]) == 0
+        n = reunion.ingest(
+            [{"name": "a", "trace_id": "t1"}, {"name": "b", "trace_id": "t1"}]
+        )
+        assert n == 2
+        assert len(reunion.remote_traces("t1")) == 2
+        assert reunion.remote_traces("t1")[0]["source"] == "node"
+
+    def test_merged_lines_up_both_sides(self):
+        with telemetry.span("pair.op"):
+            tid = tspans.current_trace_id().hex()
+        reunion.ingest([{"name": "node.evaluate", "trace_id": tid}])
+        m = reunion.merged(tid)
+        assert m["driver"][0]["name"] == "pair.op"
+        assert m["remote"][0]["name"] == "node.evaluate"
+
+    def test_disabled_telemetry_ingests_nothing(self):
+        tspans.set_enabled(False)
+        try:
+            assert reunion.ingest([{"name": "x", "trace_id": "t"}]) == 0
+        finally:
+            tspans.set_enabled(True)
+        assert reunion.remote_traces("t") == []
+
+    def test_capacity_evicts_oldest_trace(self, monkeypatch):
+        # cap applies per trace-id bucket creation
+        monkeypatch.setattr(reunion, "_CAP", 3)
+        for i in range(5):
+            reunion.ingest([{"name": "n", "trace_id": f"cap{i}"}])
+        assert reunion.remote_traces("cap0") == []
+        assert reunion.remote_traces("cap4")
+
+    def test_repeated_ingest_dedups(self):
+        """The GetLoad pull lane re-delivers the same trees every poll;
+        re-ingesting identical content must be a no-op (the store's
+        bounded claim depends on it)."""
+        tree = {"name": "node.evaluate", "trace_id": "dup1",
+                "duration_s": 0.5}
+        assert reunion.ingest([tree]) == 1
+        for _ in range(10):
+            assert reunion.ingest([tree]) == 0
+        assert len(reunion.remote_traces("dup1")) == 1
+        # distinct content still accumulates (bounded per bucket)
+        assert reunion.ingest([{**tree, "duration_s": 0.7}]) == 1
+        assert len(reunion.remote_traces("dup1")) == 2
+
+    def test_bucket_cap_bounds_per_trace_growth(self, monkeypatch):
+        monkeypatch.setattr(reunion, "_BUCKET_CAP", 4)
+        for i in range(10):
+            reunion.ingest(
+                [{"name": "n", "trace_id": "bcap", "i": i}]
+            )
+        assert len(reunion.remote_traces("bcap")) == 4

@@ -1,0 +1,45 @@
+"""Child process for tests/test_incident_e2e.py: a TCP node that WEDGES.
+
+A real process boundary (same pattern as multihost_proc.py /
+elastic_proc.py — a script FILE, not a heredoc: CLAUDE.md spawn
+pitfall) serving the npwire TCP protocol.  Computes ``2*x`` normally;
+the first request whose leading element is negative blocks forever —
+the stand-in for the tunneled runtime's silent-wedge failure mode,
+which is precisely what the driver-side watchdog must turn into an
+incident bundle.
+
+stdout protocol: ``PORT <n>`` once listening, ``WEDGING`` when the
+poison request arrives.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from pytensor_federated_tpu.service.tcp import serve_tcp_once  # noqa: E402
+
+
+def compute(*arrays):
+    x = np.asarray(arrays[0], dtype=np.float64)
+    if x.ravel()[0] < 0:
+        print("WEDGING", flush=True)
+        time.sleep(3600)  # the silent hang; the parent SIGKILLs us
+    return [2.0 * x]
+
+
+def main() -> int:
+    serve_tcp_once(
+        compute,
+        ready_callback=lambda port: print(f"PORT {port}", flush=True),
+        max_connections=None,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,231 @@
+#!/usr/bin/env python
+"""Render a watchdog/crash incident bundle as a readable postmortem.
+
+An incident bundle (``telemetry.write_incident_bundle`` — written by
+the hang watchdog, the crash excepthook, or tools/tpu_poll.py on a
+dead liveness probe) is one self-contained JSON file: all-thread
+tracebacks, the flight-recorder tail, the metrics snapshot, and the
+driver↔node trace reunion.  This tool turns it into the two formats a
+postmortem actually gets read in:
+
+- **markdown** (default): sections for the hang site (thread dump),
+  the last N flight-recorder events as a table, the merged end-to-end
+  call trees (driver encode → call → node decode/queue/compute/encode,
+  indented per span), and a metrics digest.
+- **JSONL** (``--jsonl``): one line per flight-recorder event plus one
+  ``incident`` header line — greppable, and concatenates across
+  incidents into a timeline.
+
+Pure stdlib, never imports jax (safe on a machine whose TPU plugin is
+the thing being debugged).
+
+Usage:
+    python tools/incident_report.py <bundle.json>             # markdown
+    python tools/incident_report.py <bundle.json> --jsonl
+    python tools/incident_report.py <bundle.json> -o out.md
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import sys
+from typing import List
+
+
+def _ts(epoch: float) -> str:
+    try:
+        return datetime.datetime.fromtimestamp(
+            epoch, datetime.timezone.utc
+        ).strftime("%Y-%m-%dT%H:%M:%S.%fZ")
+    except (OverflowError, OSError, ValueError, TypeError):
+        return str(epoch)
+
+
+def _span_tree_lines(tree: dict, indent: int = 0) -> List[str]:
+    pad = "  " * indent
+    dur = tree.get("duration_s")
+    dur_s = f" — {dur * 1e3:.3f} ms" if isinstance(dur, (int, float)) else ""
+    err = tree.get("error")
+    err_s = f"  **error: {err}**" if err else ""
+    attrs = tree.get("attrs") or {}
+    attr_s = (
+        " (" + ", ".join(f"{k}={v}" for k, v in attrs.items()) + ")"
+        if attrs
+        else ""
+    )
+    lines = [f"{pad}- `{tree.get('name', '?')}`{attr_s}{dur_s}{err_s}"]
+    for child in tree.get("children", ()):
+        lines.extend(_span_tree_lines(child, indent + 1))
+    return lines
+
+
+def render_markdown(bundle: dict) -> str:
+    out: List[str] = []
+    out.append(f"# Incident: {bundle.get('reason', '?')}")
+    out.append("")
+    out.append(
+        f"- **when:** {_ts(bundle.get('ts', 0))}  "
+        f"**pid:** {bundle.get('pid', '?')}"
+    )
+    argv = bundle.get("argv")
+    if argv:
+        out.append(f"- **argv:** `{' '.join(map(str, argv))}`")
+    attrs = bundle.get("attrs") or {}
+    if attrs:
+        out.append(
+            "- **attrs:** "
+            + ", ".join(f"`{k}={v}`" for k, v in attrs.items())
+        )
+    out.append("")
+
+    threads = bundle.get("threads")
+    out.append("## All-thread traceback (at incident time)")
+    out.append("")
+    if isinstance(threads, list):
+        for th in threads:
+            out.append(
+                f"### thread `{th.get('name', '?')}` "
+                f"(id {th.get('thread_id', '?')})"
+            )
+            out.append("")
+            out.append("```")
+            out.extend(th.get("stack", ()))
+            out.append("```")
+            out.append("")
+    else:
+        out.append(f"_unavailable: {threads}_")
+        out.append("")
+
+    events = bundle.get("flightrec")
+    out.append("## Flight recorder (oldest first)")
+    out.append("")
+    if isinstance(events, list) and events:
+        out.append("| seq | time | kind | trace | detail |")
+        out.append("|---|---|---|---|---|")
+        for ev in events:
+            detail = {
+                k: v
+                for k, v in ev.items()
+                if k not in ("seq", "ts", "kind", "trace_id")
+            }
+            out.append(
+                f"| {ev.get('seq', '')} | {_ts(ev.get('ts', 0))} "
+                f"| `{ev.get('kind', '?')}` "
+                f"| {ev.get('trace_id', '')[:8]} "
+                f"| {json.dumps(detail, default=str)} |"
+            )
+    else:
+        out.append(f"_no events ({events!r})_")
+    out.append("")
+
+    reunion = bundle.get("trace_reunion")
+    out.append("## Trace reunion (driver + node span trees per call)")
+    out.append("")
+    if isinstance(reunion, list) and reunion:
+        for tr in reunion:
+            out.append(f"### trace `{tr.get('trace_id', '?')}`")
+            out.append("")
+            for side in ("driver", "remote"):
+                trees = tr.get(side) or []
+                out.append(f"**{side}** ({len(trees)} tree(s))")
+                out.append("")
+                for tree in trees:
+                    out.extend(_span_tree_lines(tree))
+                out.append("")
+    else:
+        out.append(f"_no correlated traces ({reunion!r})_")
+        out.append("")
+
+    telem = bundle.get("telemetry")
+    out.append("## Metrics digest")
+    out.append("")
+    metrics = telem.get("metrics") if isinstance(telem, dict) else None
+    if isinstance(metrics, dict) and metrics:
+        out.append("| metric | labels | value |")
+        out.append("|---|---|---|")
+        for name in sorted(metrics):
+            fam = metrics[name]
+            for child in fam.get("children", ()):
+                labels = child.get("labels") or {}
+                label_s = ",".join(f"{k}={v}" for k, v in labels.items())
+                if "count" in child:
+                    val = (
+                        f"count={child['count']} "
+                        f"sum={child.get('sum', 0):.6g}"
+                    )
+                else:
+                    val = f"{child.get('value', '')}"
+                out.append(f"| `{name}` | {label_s} | {val} |")
+    else:
+        out.append(f"_unavailable ({metrics!r})_")
+    out.append("")
+    return "\n".join(out)
+
+
+def render_jsonl(bundle: dict) -> str:
+    lines = [
+        json.dumps(
+            {
+                "record": "incident",
+                "reason": bundle.get("reason"),
+                "ts": bundle.get("ts"),
+                "pid": bundle.get("pid"),
+                "attrs": bundle.get("attrs"),
+                "n_threads": len(bundle.get("threads") or ())
+                if isinstance(bundle.get("threads"), list)
+                else None,
+                "n_traces": len(bundle.get("trace_reunion") or ())
+                if isinstance(bundle.get("trace_reunion"), list)
+                else None,
+            },
+            default=str,
+        )
+    ]
+    events = bundle.get("flightrec")
+    if isinstance(events, list):
+        for ev in events:
+            lines.append(json.dumps({"record": "event", **ev}, default=str))
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("bundle", help="path to an incident-*.json bundle")
+    ap.add_argument(
+        "--jsonl", action="store_true",
+        help="emit JSONL (one line per flight-recorder event) instead "
+        "of markdown",
+    )
+    ap.add_argument("-o", "--out", default=None, help="write here "
+                    "instead of stdout")
+    args = ap.parse_args(argv)
+
+    try:
+        with open(args.bundle, "r", encoding="utf-8") as fh:
+            bundle = json.load(fh)
+    except (OSError, ValueError) as e:
+        print(f"incident_report: cannot read {args.bundle}: {e}",
+              file=sys.stderr)
+        return 1
+    if not isinstance(bundle, dict) or "reason" not in bundle:
+        print(
+            f"incident_report: {args.bundle} is not an incident bundle "
+            "(no 'reason' key)",
+            file=sys.stderr,
+        )
+        return 1
+
+    text = render_jsonl(bundle) if args.jsonl else render_markdown(bundle)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            fh.write(text)
+        print(f"incident_report: wrote {args.out}", file=sys.stderr)
+    else:
+        sys.stdout.write(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,12 +13,15 @@ touching the PJRT plugin: it is pure stdlib HTTP against loopback.
 
 Usage:
     python tools/metrics_dump.py --port 9100                 # snapshot JSON
+    python tools/metrics_dump.py --port 9100 --snapshot      # same, explicit
+    python tools/metrics_dump.py --port 9100 --traces        # /traces JSON
     python tools/metrics_dump.py --port 9100 --text          # /metrics text
     python tools/metrics_dump.py --port 9100 --out tools/telemetry.jsonl
 
-Exit status 0 on a successful scrape, 1 on an unreachable/failed
-endpoint (so capture scripts can `|| true` it without masking other
-errors).
+Exit status 0 on a successful scrape, 1 on an unreachable endpoint OR
+a malformed response (wrong JSON shape, non-exposition text) — so
+capture scripts can `|| true` it without masking other errors, and a
+half-up endpoint cannot masquerade as a good sample.
 """
 
 from __future__ import annotations
@@ -40,34 +43,86 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, required=True)
-    ap.add_argument(
+    mode = ap.add_mutually_exclusive_group()
+    mode.add_argument(
         "--text",
         action="store_true",
         help="print GET /metrics (Prometheus text) instead of the "
         "JSON snapshot",
     )
+    mode.add_argument(
+        "--snapshot",
+        action="store_true",
+        help="GET /snapshot (the default mode, made explicit)",
+    )
+    mode.add_argument(
+        "--traces",
+        action="store_true",
+        help="GET /traces — recent completed span trees only",
+    )
     ap.add_argument(
         "--out",
         default=None,
-        help="append the snapshot as one JSON line to this file "
+        help="append the scrape as one JSON line to this file "
         "(default: pretty-print to stdout; ignored with --text)",
     )
     ap.add_argument("--timeout", type=float, default=5.0)
     args = ap.parse_args(argv)
 
     base = f"http://{args.host}:{args.port}"
+    route = "/traces" if args.traces else "/snapshot"
     try:
         if args.text:
-            sys.stdout.write(
-                scrape(f"{base}/metrics", args.timeout).decode("utf-8")
+            text = scrape(f"{base}/metrics", args.timeout).decode(
+                "utf-8", "replace"
             )
+            # An endpoint that answers but serves something other than
+            # exposition text (a proxy error page, a different service
+            # on the port) must not count as a good scrape.  An EMPTY
+            # registry legitimately renders "", anything else starts
+            # with a HELP header.
+            if text and not text.startswith("# HELP "):
+                print(
+                    f"metrics_dump: {base}/metrics returned non-"
+                    "exposition text",
+                    file=sys.stderr,
+                )
+                return 1
+            sys.stdout.write(text)
             return 0
-        body = scrape(f"{base}/snapshot", args.timeout)
+        body = scrape(base + route, args.timeout)
     except (urllib.error.URLError, OSError, TimeoutError) as e:
         print(f"metrics_dump: {base} unreachable: {e}", file=sys.stderr)
         return 1
 
-    rec = {"ts": time.time(), "endpoint": base, **json.loads(body)}
+    try:
+        payload = json.loads(body)
+    except ValueError as e:
+        print(
+            f"metrics_dump: {base}{route} returned malformed JSON: {e}",
+            file=sys.stderr,
+        )
+        return 1
+    # Shape check per route: /snapshot is a dict with a metrics map,
+    # /traces a list of span trees.  A well-formed-but-wrong payload is
+    # the same operational failure as garbage.
+    if args.traces:
+        if not isinstance(payload, list):
+            print(
+                f"metrics_dump: {base}/traces is not a JSON list",
+                file=sys.stderr,
+            )
+            return 1
+        rec = {"ts": time.time(), "endpoint": base, "traces": payload}
+    else:
+        if not isinstance(payload, dict) or "metrics" not in payload:
+            print(
+                f"metrics_dump: {base}/snapshot has no 'metrics' key",
+                file=sys.stderr,
+            )
+            return 1
+        rec = {"ts": time.time(), "endpoint": base, **payload}
+
     if args.out:
         with open(args.out, "a", encoding="utf-8") as fh:
             fh.write(json.dumps(rec) + "\n")

@@ -83,8 +83,17 @@ def main(argv: list[str] | None = None) -> int:
         from pytensor_federated_tpu.utils import probe_backend
 
         live, _ = probe_backend(timeout_s=args.timeout_s)
-        _log(f"probe: {'LIVE' if live else 'DEAD'} (dry run)")
-        return 0 if live else 1
+        if not live:
+            # A dead/wedged window must leave FORENSICS, not just a log
+            # line: the bundle carries the probe verdict's flight-
+            # record tail + this process's state (ISSUE 2 satellite).
+            _log(
+                "probe: DEAD (dry run); incident bundle -> "
+                + _probe_incident(args.timeout_s)
+            )
+            return 1
+        _log("probe: LIVE (dry run)")
+        return 0
 
     if args.loop_every_s is not None:
         import time
@@ -97,6 +106,25 @@ def main(argv: list[str] | None = None) -> int:
             time.sleep(args.loop_every_s)
 
     return _attempt(args)
+
+
+def _probe_incident(timeout_s: float) -> str:
+    """Write a watchdog incident bundle for a failed liveness probe;
+    returns its path (logged into capture_attempts.log by callers so a
+    wedged window leaves an artifact, not just a line).  Bundles land
+    in tools/incidents/ — next to the log they are referenced from."""
+    from pytensor_federated_tpu.telemetry.watchdog import (
+        write_incident_bundle,
+    )
+
+    inc_dir = os.path.join(REPO, "tools", "incidents")
+    os.makedirs(inc_dir, exist_ok=True)
+    path = write_incident_bundle(
+        "tpu-liveness-probe-timeout",
+        attrs={"probe_timeout_s": timeout_s},
+        dir=inc_dir,
+    )
+    return os.path.relpath(path, REPO)
 
 
 def _attempt(args) -> int:
@@ -116,6 +144,11 @@ def _attempt(args) -> int:
     )
     why = EXIT_MEANINGS.get(res.returncode, "unknown failure")
     _log(f"capture attempt: exit={res.returncode} ({why})")
+    if res.returncode == 1:
+        # Exit 1 = the capture's own liveness probe timed out (a
+        # wedged tunnel) — leave the incident bundle's path in the
+        # attempts log so the window's forensics are findable later.
+        _log("incident bundle -> " + _probe_incident(args.timeout_s))
     if res.returncode != 0 or not args.mosaic_after:
         return res.returncode
 
