@@ -204,6 +204,67 @@ def telemetry_overhead(
     }
 
 
+def batcher_overhead(n_calls: int = 3_000) -> dict:
+    """Idle-latency gate for the server-side micro-batcher (ISSUE 3
+    acceptance: a lone request must dispatch immediately — zero
+    coalescing wait when idle).
+
+    Measures the per-call cost of routing a single sequential request
+    through ``MicroBatcher.submit`` on an otherwise-idle batcher
+    against calling the compute directly on the loop (the pre-batching
+    ``inline_compute`` path it replaced).  Sequential single calls are
+    exactly the idle case: the queue is empty at every submit, so the
+    adaptive policy must never sleep.  Interleaved best-of-3, like the
+    telemetry gate, so machine-load drift cancels.
+
+    The gate passes while the added latency stays under 75 us/call —
+    well under one unit of the ~110-120 us grpc.aio transport floor
+    (docs/performance.md), i.e. invisible behind a single real RPC.
+    The batched-throughput side is gated in bench_suite config 11
+    (batched lane >= 2x the non-batched pipelined lane).
+    """
+    import asyncio
+
+    from pytensor_federated_tpu.service.batching import MicroBatcher
+
+    x = np.zeros(4, np.float32)
+
+    def compute(a):
+        return [a]
+
+    batcher = MicroBatcher(
+        compute, None, max_batch=32, max_wait_us=200.0, inline=True
+    )
+
+    async def batched_per_call() -> float:
+        t0 = time.perf_counter()
+        for _ in range(n_calls):
+            await batcher.submit((x,))
+        return (time.perf_counter() - t0) / n_calls
+
+    async def direct_per_call() -> float:
+        t0 = time.perf_counter()
+        for _ in range(n_calls):
+            compute(x)
+        return (time.perf_counter() - t0) / n_calls
+
+    async def measure():
+        batched = direct = float("inf")
+        for _ in range(3):
+            batched = min(batched, await batched_per_call())
+            direct = min(direct, await direct_per_call())
+        return batched, direct
+
+    batched_s, direct_s = asyncio.run(measure())
+    delta_us = max(0.0, (batched_s - direct_s) * 1e6)
+    return {
+        "idle_submit_us": round(batched_s * 1e6, 2),
+        "direct_call_us": round(direct_s * 1e6, 2),
+        "idle_delta_us": round(delta_us, 2),
+        "pass": bool(delta_us < 75.0),
+    }
+
+
 class MeasurementIntegrityError(RuntimeError):
     """A timing the integrity guards refuse to trust (degenerate chain,
     inconsistent stages, physics-impossible rate).  A DEDICATED type so
@@ -516,6 +577,11 @@ def main():
     except Exception as e:  # the one-JSON-line invariant outranks the gate
         overhead = {"error": f"{type(e).__name__}: {e}", "pass": False}
 
+    try:
+        batcher = batcher_overhead()
+    except Exception as e:  # same invariant
+        batcher = {"error": f"{type(e).__name__}: {e}", "pass": False}
+
     print(
         json.dumps(
             {
@@ -530,6 +596,7 @@ def main():
                 "backend": jax.default_backend(),
                 "impl": best,
                 "telemetry_overhead": overhead,
+                "batcher_overhead": batcher,
                 **flop_extra,
             }
         )

@@ -21,6 +21,14 @@
 //             A native node keeps no spans, so replies never carry
 //             the block; on requests it is validated and dropped,
 //             keeping the decoder symmetric with the Python codec]
+//   batch:   flags&8 reinterprets the count as n_items and the body as
+//            item_len(u32) + item_bytes per item, each a complete
+//            payload as above (service/npwire.py encode_batch).  The
+//            reply is a batch frame of per-item replies in order —
+//            one syscall per pipelined window instead of per call,
+//            with error isolation per item.  A ZERO-item batch is the
+//            client's capability probe; the empty batch reply echoed
+//            here is the "yes".
 //
 // Compute contract (stateless, mirrors the linear-model blackbox of the
 // Python demos): inputs [intercept(), slope(), sigma(), x(n), y(n)] as
@@ -61,6 +69,9 @@ constexpr uint8_t kVersion = 1;
 constexpr uint8_t kFlagError = 1;
 constexpr uint8_t kFlagTrace = 2;
 constexpr uint8_t kFlagSpans = 4;
+constexpr uint8_t kFlagBatch = 8;
+// flags byte offset in the payload: magic(4) + version(1)
+constexpr size_t kFlagsOff = 5;
 
 struct Array {
   std::string dtype;
@@ -272,6 +283,100 @@ std::vector<uint8_t> encode(const Message& msg) {
   return out;
 }
 
+// ---- batch frames (flag 8) ----------------------------------------------
+
+Message compute(const Message& in);  // fwd decl (model below)
+
+// One plain payload -> one reply payload (shared by the lock-step loop
+// and the per-item path inside a batch frame).
+std::vector<uint8_t> serve_plain(const std::vector<uint8_t>& buf) {
+  Message in, reply;
+  std::string why;
+  if (decode(buf, &in, &why)) {
+    reply = compute(in);
+  } else {
+    std::memset(reply.uuid, 0, 16);
+    reply.error = "decode failed: " + why;
+  }
+  return encode(reply);
+}
+
+// Outer-level batch failure: a batch frame whose own framing is broken
+// answers a zero-item batch reply carrying the error block (layout
+// mirrors npwire.encode_batch with error=...).
+std::vector<uint8_t> batch_error_reply(const std::string& err) {
+  std::vector<uint8_t> out;
+  put(&out, kMagic, 4);
+  put_le<uint8_t>(&out, kVersion);
+  put_le<uint8_t>(&out, static_cast<uint8_t>(kFlagBatch | kFlagError));
+  uint8_t zero[16] = {0};
+  put(&out, zero, 16);
+  put_le<uint32_t>(&out, 0);  // n_items
+  put_le<uint32_t>(&out, static_cast<uint32_t>(err.size()));
+  put(&out, err.data(), err.size());
+  return out;
+}
+
+// A batch frame (flag 8): K nested complete payloads behind one outer
+// header.  Each item decodes and computes independently — one poisoned
+// item yields an error reply in ITS slot only.  Zero items = the
+// capability probe; the empty batch reply is the affirmative answer.
+std::vector<uint8_t> serve_batch(const std::vector<uint8_t>& buf) {
+  Reader r(buf.data(), buf.size());
+  char magic[4];
+  uint8_t ver = 0, flags = 0;
+  uint8_t uuid[16];
+  uint32_t n_items = 0;
+  if (!r.bytes(magic, 4) || std::memcmp(magic, kMagic, 4) != 0 ||
+      !r.le(&ver) || ver != kVersion || !r.le(&flags) ||
+      !r.bytes(uuid, 16) || !r.le(&n_items))
+    return batch_error_reply("decode failed: truncated batch header");
+  if (flags & kFlagError) {
+    uint32_t elen = 0;
+    std::string e;
+    if (!r.le(&elen) || !r.str(&e, elen))
+      return batch_error_reply("decode failed: truncated error block");
+  }
+  if (flags & kFlagTrace) {
+    uint8_t trace_id[16];
+    if (!r.bytes(trace_id, 16))
+      return batch_error_reply("decode failed: truncated trace block");
+  }
+  // Each item needs >= 4 bytes (its length prefix), so any frame holds
+  // at most remaining/4 items — reject hostile counts before looping.
+  if (n_items > r.remaining() / 4)
+    return batch_error_reply("decode failed: item count exceeds payload");
+  std::vector<std::vector<uint8_t>> replies;
+  replies.reserve(std::min<size_t>(n_items, 4096));
+  for (uint32_t i = 0; i < n_items; ++i) {
+    uint32_t ilen = 0;
+    if (!r.le(&ilen) || ilen > r.remaining())
+      return batch_error_reply("decode failed: truncated batch item");
+    std::vector<uint8_t> item(ilen);
+    if (!r.bytes(item.data(), item.size()))
+      return batch_error_reply("decode failed: truncated batch item");
+    replies.push_back(serve_plain(item));
+  }
+  if (flags & kFlagSpans) {  // validated and dropped, like plain frames
+    uint32_t slen = 0;
+    std::string spans_json;
+    if (!r.le(&slen) || slen > r.remaining() ||
+        !r.str(&spans_json, slen))
+      return batch_error_reply("decode failed: truncated spans block");
+  }
+  std::vector<uint8_t> out;
+  put(&out, kMagic, 4);
+  put_le<uint8_t>(&out, kVersion);
+  put_le<uint8_t>(&out, kFlagBatch);
+  put(&out, uuid, 16);
+  put_le<uint32_t>(&out, static_cast<uint32_t>(replies.size()));
+  for (const auto& rp : replies) {
+    put_le<uint32_t>(&out, static_cast<uint32_t>(rp.size()));
+    put(&out, rp.data(), rp.size());
+  }
+  return out;
+}
+
 Array scalar_f8(double v) {
   Array a;
   a.dtype = "<f8";
@@ -348,15 +453,12 @@ void serve_connection(int fd) try {
     if (len > kMaxFrameBytes) return;      // hostile length prefix
     std::vector<uint8_t> buf(len);
     if (!read_exact(fd, buf.data(), len)) return;
-    Message in, reply;
-    std::string why;
-    if (decode(buf, &in, &why)) {
-      reply = compute(in);
-    } else {
-      std::memset(reply.uuid, 0, 16);
-      reply.error = "decode failed: " + why;
-    }
-    std::vector<uint8_t> payload = encode(reply);
+    // Batch frames (flag 8) take the per-item path; everything else is
+    // the classic lock-step single evaluate.
+    std::vector<uint8_t> payload =
+        (buf.size() > kFlagsOff && (buf[kFlagsOff] & kFlagBatch))
+            ? serve_batch(buf)
+            : serve_plain(buf);
     uint32_t plen = static_cast<uint32_t>(payload.size());
     if (!write_exact(fd, &plen, 4) ||
         !write_exact(fd, payload.data(), payload.size()))

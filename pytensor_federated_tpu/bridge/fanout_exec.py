@@ -6,9 +6,15 @@ stay importable without paying for — or mutating the optdb of — an
 installed PyTensor)."""
 
 from ..fanout_exec import (  # noqa: F401
+    CoalescingCaller,
     MemberExecutorPool,
     member_spans,
     run_members,
 )
 
-__all__ = ["MemberExecutorPool", "member_spans", "run_members"]
+__all__ = [
+    "CoalescingCaller",
+    "MemberExecutorPool",
+    "member_spans",
+    "run_members",
+]

@@ -35,7 +35,12 @@ from .telemetry import flightrec as _flightrec
 from .telemetry import metrics as _metrics
 from .telemetry import spans as _tspans
 
-__all__ = ["MemberExecutorPool", "member_spans", "run_members"]
+__all__ = [
+    "CoalescingCaller",
+    "MemberExecutorPool",
+    "member_spans",
+    "run_members",
+]
 
 # Fanout instrumentation (metric catalog: docs/observability.md).  The
 # straggler gap — max minus min member latency within one fanout — is
@@ -121,6 +126,133 @@ class MemberExecutorPool:
     @property
     def alive(self) -> bool:
         return self._finalizer is not None and self._finalizer.alive
+
+
+_COALESCED_CALLS = _metrics.histogram(
+    "pftpu_fanout_coalesced_calls",
+    "Member evaluations coalesced into one batched node call",
+    buckets=_metrics.DEFAULT_COUNT_BUCKETS,
+)
+
+
+class CoalescingCaller:
+    """Coalesce concurrent single evaluations into one batched call.
+
+    The driver-side twin of the server's micro-batcher, for the fanout
+    geometry: when several fanout members target the SAME node, each
+    member thread's ``evaluate(*arrays)`` lands here, the first
+    arrival becomes the window leader, and the whole group goes out as
+    ONE ``evaluate_many`` — which the transport packs into one wire
+    batch frame when the node advertises support (client.py / tcp.py),
+    so W same-node members pay one round-trip instead of W.
+
+    ``evaluate_many``: a callable taking a list of request tuples and
+    returning one result per request, in order — e.g.
+    ``lambda reqs: client.evaluate_many(reqs, window=w)`` for any of
+    the transport clients or typed adapters.  ``width`` is the
+    expected group size (the number of members sharing the node): the
+    leader dispatches the moment the window is full, so a complete
+    fanout pays ZERO added wait; ``max_wait_s`` bounds the wait when
+    the group arrives ragged (a straggler past it simply leads the
+    next window — correctness is unaffected, only coalescing width).
+
+    Error semantics: the window is one transport call, so a failure
+    raises in EVERY coalesced member (the per-member isolation lives
+    server-side: a poisoned input fails only its own reply item, and
+    ``evaluate_many`` surfaces the first error without retry).
+    """
+
+    def __init__(
+        self,
+        evaluate_many: Callable[[list], list],
+        *,
+        width: int,
+        max_wait_s: float = 0.002,
+    ):
+        if width < 1:
+            raise ValueError(f"width must be >= 1, got {width}")
+        self._evaluate_many = evaluate_many
+        self._width = int(width)
+        self._max_wait_s = float(max_wait_s)
+        self._cond = threading.Condition()
+        self._pending: List[dict] = []  # {"args", "event", "result", "error"}
+        # One window in flight at a time: a straggler that became the
+        # NEXT window's leader must not drive ``evaluate_many``
+        # concurrently with the previous leader — the transport
+        # clients are single-connection lock-step objects, not
+        # thread-safe (tcp.py), so overlapping windows would
+        # interleave frames on one socket.
+        self._dispatch_lock = threading.Lock()
+
+    def evaluate(self, *arrays) -> list:
+        slot = {
+            "args": tuple(arrays),
+            "event": threading.Event(),
+            "result": None,
+            "error": None,
+        }
+        with self._cond:
+            self._pending.append(slot)
+            leader = len(self._pending) == 1
+            if not leader:
+                self._cond.notify_all()
+        if leader:
+            self._lead()
+        # Followers (and the leader, whose own slot _lead() filled)
+        # wait for their slot to settle.
+        slot["event"].wait()
+        if slot["error"] is not None:
+            raise slot["error"]
+        return slot["result"]
+
+    __call__ = evaluate
+
+    def _lead(self) -> None:
+        deadline = time.perf_counter() + self._max_wait_s
+        with self._cond:
+            while len(self._pending) < self._width:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                self._cond.wait(remaining)
+            group, self._pending = self._pending, []
+        # try/finally around EVERYTHING after the group pop: if the
+        # events were not guaranteed to set, a leader failure (even a
+        # BaseException like KeyboardInterrupt delivered to its
+        # thread) would leave every follower blocked forever in
+        # event.wait() — a silent wedge, the exact failure class this
+        # codebase's watchdog exists to prevent.
+        try:
+            with self._dispatch_lock:
+                _COALESCED_CALLS.observe(len(group))
+                with _tspans.span(
+                    "fanout.coalesced_call", width=len(group)
+                ):
+                    results = self._evaluate_many(
+                        [s["args"] for s in group]
+                    )
+                    if len(results) != len(group):
+                        raise RuntimeError(
+                            f"evaluate_many returned {len(results)} "
+                            f"results for {len(group)} coalesced requests"
+                        )
+                    for s, r in zip(group, results):
+                        s["result"] = r
+        except BaseException as e:
+            for s in group:
+                if s["result"] is None:
+                    s["error"] = (
+                        e
+                        if isinstance(e, Exception)
+                        else RuntimeError(
+                            f"coalesced window leader aborted: {e!r}"
+                        )
+                    )
+            if not isinstance(e, Exception):
+                raise  # KeyboardInterrupt & co. still surface in the leader
+        finally:
+            for s in group:
+                s["event"].set()
 
 
 def member_spans(counts: Sequence[int]) -> List[Tuple[int, int]]:

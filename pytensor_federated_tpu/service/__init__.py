@@ -9,8 +9,15 @@ from .client import (
     get_node_traces_async,
     thread_pid_id,
 )
+from .batching import MicroBatcher, batched_compute_fn
 from .clients import LogpGradServiceClient, LogpServiceClient
-from .npwire import WireError, decode_arrays, encode_arrays
+from .npwire import (
+    WireError,
+    decode_arrays,
+    decode_batch,
+    encode_arrays,
+    encode_batch,
+)
 from .tcp import RemoteComputeError, TcpArraysClient, serve_tcp_once
 from .server import (
     ArraysToArraysService,
@@ -25,10 +32,14 @@ __all__ = [
     "ClientPrivates",
     "LogpGradServiceClient",
     "LogpServiceClient",
+    "MicroBatcher",
     "WireError",
+    "batched_compute_fn",
     "decode_arrays",
+    "decode_batch",
     "device_compute_fn",
     "encode_arrays",
+    "encode_batch",
     "RemoteComputeError",
     "TcpArraysClient",
     "get_load_async",

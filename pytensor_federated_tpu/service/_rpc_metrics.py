@@ -37,5 +37,18 @@ WINDOW_DEPTH = _metrics.histogram(
     ("transport",),
     buckets=_metrics.DEFAULT_COUNT_BUCKETS,
 )
+BATCH_FRAME_REQS = _metrics.histogram(
+    "pftpu_client_batch_frame_requests",
+    "Requests coalesced into each wire batch frame sent by evaluate_many",
+    ("transport",),
+    buckets=_metrics.DEFAULT_COUNT_BUCKETS,
+)
 
-__all__ = ["CALL_S", "RETRIES", "DROPS", "BATCH_S", "WINDOW_DEPTH"]
+__all__ = [
+    "CALL_S",
+    "RETRIES",
+    "DROPS",
+    "BATCH_S",
+    "WINDOW_DEPTH",
+    "BATCH_FRAME_REQS",
+]

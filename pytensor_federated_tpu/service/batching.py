@@ -1,0 +1,485 @@
+"""Adaptive server-side micro-batching for the host-federation lane.
+
+The host lane's per-call budget is dominated by fixed costs — decode,
+jitted dispatch, encode, and the grpc.aio floor (docs/performance.md
+"Host lane budget") — while the compute itself is microseconds.  DrJAX
+(PAPERS.md) makes the federated-map point structurally: per-client work
+should vectorize into ONE XLA program; NumPyro's vectorized chains make
+the same point for probabilistic evaluation.  This module is that idea
+applied to the serving path: requests that arrive while a device call
+is in flight are coalesced and executed as one ``jax.vmap``-batched
+call, so K pipelined requests pay one dispatch instead of K.
+
+Policy (the "adaptive" in the name):
+
+- **Idle: zero added latency.**  A lone request dispatches immediately
+  — the drain loop starts on the submit and pops a single-entry group.
+  There is no timer in front of the first request.
+- **Under load: coalesce.**  Requests arriving while a call is in
+  flight stack in the queue; when the call finishes the whole stack
+  (same signature, up to ``max_batch``) dispatches as one batched
+  call.  ``max_wait_us`` adds an optional post-batch pause to let a
+  partially-filled next window top up — only ever paid when the queue
+  is non-empty, i.e. when the lane is already saturated and latency is
+  queue-bound anyway.
+
+Error isolation is per request: a batched execution that fails falls
+back to scalar re-execution of its window, so one poisoned input fails
+only its own reply (``server.batch_fallback`` in the flight record).
+Requests whose signatures differ are grouped — each signature group
+dispatches as its own batch (XLA compiles one executable per static
+signature, signatures.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from collections import deque
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..telemetry import flightrec as _flightrec
+from ..telemetry import metrics as _metrics
+from ..telemetry import spans as _spans
+
+__all__ = ["MicroBatcher", "batched_compute_fn", "execute_window_sync"]
+
+# Batcher instrumentation (metric catalog: docs/observability.md).
+# Queue-wait/compute reuse the SERVER's families by name — the registry
+# returns the same instrument, so the node's latency picture stays on
+# one dashboard whether or not requests flowed through the batcher.
+_BATCH_SIZE = _metrics.histogram(
+    "pftpu_server_batch_size",
+    "Requests coalesced per dispatched micro-batch",
+    ("kind",),
+    buckets=_metrics.DEFAULT_COUNT_BUCKETS,
+)
+_BATCH_WAIT_S = _metrics.histogram(
+    "pftpu_server_batch_wait_seconds",
+    "Coalesce wait: request enqueue to batch dispatch",
+)
+_BATCHES = _metrics.counter(
+    "pftpu_server_batches_total",
+    "Micro-batches dispatched, by execution kind",
+    ("kind",),
+)
+_QUEUE_S = _metrics.histogram(
+    "pftpu_server_queue_wait_seconds",
+    "Wait between RPC decode and compute start (thread-executor queue)",
+)
+_COMPUTE_S = _metrics.histogram(
+    "pftpu_server_compute_seconds", "compute_fn latency"
+)
+
+
+def _signature(inputs: Sequence[np.ndarray]) -> Tuple:
+    """Static signature of one request — the coalescing key.  Same
+    notion as :func:`..signatures.spec_of` (XLA compiles per static
+    signature) without materializing ShapeDtypeStructs per request."""
+    return tuple((a.shape, a.dtype.str) for a in inputs)
+
+
+def _bucket(k: int, cap: int) -> int:
+    """Next power-of-two >= k, clamped to ``cap`` — the padded-bucket
+    ladder that keeps the number of compiled batched executables
+    logarithmic in ``max_batch`` instead of linear in every ragged
+    window size the wire happens to produce."""
+    b = 1
+    while b < k:
+        b <<= 1
+    return min(b, max(cap, k))
+
+
+def batched_compute_fn(
+    fn: Callable, *, jit: bool = True, max_batch: int = 32
+) -> Callable:
+    """Vectorize a JAX compute fn over a leading batch axis with a
+    padded-bucket jit cache.
+
+    Returns ``batch(requests) -> [outputs_per_request]`` where
+    ``requests`` is a list of same-signature argument tuples.  The
+    stack is padded to the next power-of-two bucket (repeating the
+    first row — a value the fn provably accepts, so padding cannot
+    manufacture a domain error a real input didn't) and evaluated as
+    one ``jax.vmap`` call; ``jax.jit`` caches per padded shape, so
+    ragged window sizes compile at most ``log2(max_batch)+1``
+    executables per signature instead of one per size.
+    """
+    import jax
+
+    vfn = jax.vmap(fn)
+    if jit:
+        vfn = jax.jit(vfn)
+
+    def batch(
+        requests: Sequence[Sequence[np.ndarray]],
+    ) -> List[List[np.ndarray]]:
+        k = len(requests)
+        if k == 0:
+            return []
+        if k > max_batch:
+            # A caller with a larger window (e.g. a service configured
+            # with a bigger max_batch than this fn was built with)
+            # must not leak non-power-of-two padded shapes into the
+            # jit cache — chunk to this fn's own cap instead.
+            out: List[List[np.ndarray]] = []
+            for s in range(0, k, max_batch):
+                out.extend(batch(requests[s : s + max_batch]))
+            return out
+        n_args = len(requests[0])
+        stacked = [
+            np.stack([np.asarray(req[i]) for req in requests])
+            for i in range(n_args)
+        ]
+        b = _bucket(k, max_batch)
+        if b > k:
+            pad = b - k
+            stacked = [
+                np.concatenate([s, np.repeat(s[:1], pad, axis=0)])
+                for s in stacked
+            ]
+        outs = vfn(*stacked)
+        return [[np.asarray(o[j]) for o in outs] for j in range(k)]
+
+    return batch
+
+
+def execute_window_sync(
+    compute_fn: Callable,
+    batch_fn: Optional[Callable],
+    requests: Sequence[Sequence[np.ndarray]],
+) -> List[object]:
+    """Synchronous window execution: one outcome (output list or
+    exception) per request — per-item error isolation.  A >= 2
+    same-signature window with a ``batch_fn`` runs vectorized, with
+    scalar re-execution fallback on failure; everything else runs
+    scalar-wise.  The synchronous twin of :class:`MicroBatcher`'s
+    dispatch (single source for the fallback semantics and the batch
+    metrics), used by the TCP server (:func:`..tcp.serve_tcp_once`).
+    """
+    k = len(requests)
+    if k == 0:
+        return []
+    outcomes: Optional[List[object]] = None
+    vmapped_ok = False
+    use_batch = (
+        batch_fn is not None
+        and k > 1
+        and len({_signature(r) for r in requests}) == 1
+    )
+    if use_batch:
+        try:
+            outs = batch_fn(list(requests))
+            if len(outs) != k:
+                raise RuntimeError(
+                    f"batch_fn returned {len(outs)} results for "
+                    f"{k} requests"
+                )
+            outcomes = [list(o) for o in outs]
+            vmapped_ok = True
+        except Exception as e:
+            _BATCHES.labels(kind="fallback").inc()
+            _flightrec.record(
+                "server.batch_fallback", size=k,
+                error=f"{type(e).__name__}: {e}"[:200],
+            )
+            outcomes = None
+    if outcomes is None:
+        outcomes = []
+        for req in requests:
+            try:
+                outcomes.append(
+                    [np.asarray(o) for o in compute_fn(*req)]
+                )
+            except Exception as e:
+                outcomes.append(e)
+    kind = "vmapped" if vmapped_ok else ("single" if k == 1 else "serial")
+    _BATCH_SIZE.labels(kind=kind).observe(k)
+    _BATCHES.labels(kind=kind).inc()
+    if k > 1:
+        _flightrec.record("server.batch", size=k, exec_kind=kind)
+    return outcomes
+
+
+class _Pending:
+    __slots__ = ("inputs", "sig", "future", "t_enqueue")
+
+    def __init__(self, inputs, sig, future, t_enqueue):
+        self.inputs = inputs
+        self.sig = sig
+        self.future = future
+        self.t_enqueue = t_enqueue
+
+
+class MicroBatcher:
+    """Asyncio coalescing queue in front of a node's ``compute_fn``.
+
+    ``compute_fn(*arrays) -> [arrays]`` is the scalar path;
+    ``batch_fn(requests) -> [outputs_per_request]`` (e.g. from
+    :func:`batched_compute_fn`, or the ``.batch`` attribute
+    :func:`..server.device_compute_fn` attaches with ``batched=True``)
+    is the vectorized path used whenever >= 2 same-signature requests
+    coalesce.  Without a ``batch_fn`` the group runs scalar-wise —
+    inline on the loop (one trip for the whole group, amortizing the
+    handoffs that dominate sub-ms computes), or fanned out over the
+    executor's workers so slow GIL-releasing computes keep the
+    concurrency the pre-batching server had.
+
+    ``inline=True`` executes on the event loop (the
+    ``inline_compute`` contract of the service: sub-ms computes only);
+    the default runs each group in the thread executor so a slow batch
+    cannot stall GetLoad.
+    """
+
+    def __init__(
+        self,
+        compute_fn: Callable,
+        batch_fn: Optional[Callable] = None,
+        *,
+        max_batch: int = 32,
+        max_wait_us: float = 200.0,
+        inline: bool = False,
+    ):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self.compute_fn = compute_fn
+        self.batch_fn = batch_fn
+        self.max_batch = int(max_batch)
+        self.max_wait_us = float(max_wait_us)
+        self.inline = bool(inline)
+        self._pending: deque[_Pending] = deque()
+        self._worker: Optional[asyncio.Task] = None
+        # Plain always-on tallies (telemetry histograms are no-ops when
+        # spans are disabled; GetLoad still wants the basic picture).
+        self.n_dispatched = 0
+        self.n_batches = 0
+        self.n_fallbacks = 0
+        self.max_seen = 0
+
+    # -- submission -------------------------------------------------------
+
+    async def submit(self, inputs: Sequence[np.ndarray]) -> List[np.ndarray]:
+        """Enqueue one request; returns its outputs (or raises ITS
+        error).  A lone request on an idle batcher dispatches
+        immediately — no timer, no added latency."""
+        return await self._enqueue(inputs)
+
+    async def submit_many(
+        self, inputs_list: Sequence[Sequence[np.ndarray]]
+    ) -> List[object]:
+        """Enqueue a whole window at once (the server side of a wire
+        batch frame) and gather per-request outcomes: each slot is the
+        request's output list OR its exception (never raises for a
+        single poisoned item — the per-item error isolation contract).
+        """
+        futures = [
+            self._enqueue(inputs, start=False) for inputs in inputs_list
+        ]
+        # Enqueue-all-then-start: the window must be visible to the
+        # drain loop as ONE stack, not trickle in one dispatch each.
+        tasks = [asyncio.ensure_future(f) for f in futures]
+        self._start()
+        return await asyncio.gather(*tasks, return_exceptions=True)
+
+    def _enqueue(self, inputs, *, start: bool = True):
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        arrays = [np.asarray(a) for a in inputs]
+        self._pending.append(
+            _Pending(arrays, _signature(arrays), fut, time.perf_counter())
+        )
+        self.max_seen = max(self.max_seen, len(self._pending))
+        if start:
+            self._start()
+        return fut
+
+    def _start(self) -> None:
+        if self._worker is None and self._pending:
+            self._worker = asyncio.ensure_future(self._drain())
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._pending)
+
+    def stats(self) -> dict:
+        """Live batcher picture for GetLoad (:meth:`..server
+        .ArraysToArraysService.determine_load`): always-on counts plus
+        batch-size quantiles when telemetry is enabled."""
+        out = {
+            "max_batch": self.max_batch,
+            "max_wait_us": self.max_wait_us,
+            "queue_depth": self.queue_depth,
+            "dispatched_total": self.n_dispatched,
+            "batches_total": self.n_batches,
+            "fallbacks_total": self.n_fallbacks,
+            "max_queue_seen": self.max_seen,
+        }
+        if _spans.enabled():
+            import math
+
+            def _q(hist, q):
+                v = hist.approx_quantile(q)
+                return None if math.isnan(v) or math.isinf(v) else v
+
+            vmapped = _BATCH_SIZE.labels(kind="vmapped")
+            out["size_p50"] = _q(vmapped, 0.5)
+            out["size_p99"] = _q(vmapped, 0.99)
+            out["wait_p99_s"] = _q(_BATCH_WAIT_S, 0.99)
+        return out
+
+    # -- the drain loop ---------------------------------------------------
+
+    def _pop_group(self) -> List[_Pending]:
+        """Pop the head request plus every queued same-signature
+        sibling (stable order), up to ``max_batch``.  Mixed signatures
+        stay queued and form their own group next iteration."""
+        if not self._pending:
+            return []
+        head_sig = self._pending[0].sig
+        group: List[_Pending] = []
+        rest: List[_Pending] = []
+        for p in self._pending:
+            if p.sig == head_sig and len(group) < self.max_batch:
+                group.append(p)
+            else:
+                rest.append(p)
+        self._pending = deque(rest)
+        return group
+
+    async def _drain(self) -> None:
+        try:
+            under_load = False
+            while self._pending:
+                if (
+                    under_load
+                    and self.max_wait_us > 0
+                    and len(self._pending) < self.max_batch
+                ):
+                    # Saturated lane: a short top-up pause fills the
+                    # next window.  Never reached by a lone idle
+                    # request (under_load is False on the first pass).
+                    await asyncio.sleep(self.max_wait_us / 1e6)
+                group = self._pop_group()
+                await self._execute(group)
+                under_load = True
+        finally:
+            self._worker = None
+            if self._pending:
+                # A submit raced the loop's exit check; reschedule so
+                # nothing is stranded.
+                self._start()
+
+    async def _execute(self, group: List[_Pending]) -> None:
+        k = len(group)
+        if k == 0:
+            return
+        t_dispatch = time.perf_counter()
+        for p in group:
+            _BATCH_WAIT_S.observe(t_dispatch - p.t_enqueue)
+            _QUEUE_S.observe(t_dispatch - p.t_enqueue)
+        self.n_dispatched += k
+        self.n_batches += 1
+        use_batch = k > 1 and self.batch_fn is not None
+
+        def scalar_one(p: _Pending) -> object:
+            try:
+                return list(self.compute_fn(*p.inputs))
+            except Exception as e:
+                return e
+
+        def batch_job() -> Optional[List[object]]:
+            """One trip through the vectorized path; None on failure —
+            the caller then re-runs the window scalar-wise, so one
+            poisoned input fails only ITS reply."""
+            t0 = time.perf_counter()
+            try:
+                outs = self.batch_fn([p.inputs for p in group])
+                if len(outs) != k:
+                    raise RuntimeError(
+                        f"batch_fn returned {len(outs)} results "
+                        f"for {k} requests"
+                    )
+                _COMPUTE_S.observe(time.perf_counter() - t0)
+                return [list(o) for o in outs]
+            except Exception as e:
+                self.n_fallbacks += 1
+                _BATCHES.labels(kind="fallback").inc()
+                _flightrec.record(
+                    "server.batch_fallback", size=k,
+                    error=f"{type(e).__name__}: {e}"[:200],
+                )
+                return None
+
+        try:
+            loop = asyncio.get_running_loop()
+            results: Optional[List[object]] = None
+            vmapped_ok = False
+            if use_batch:
+                results = (
+                    batch_job()
+                    if self.inline
+                    else await loop.run_in_executor(None, batch_job)
+                )
+                vmapped_ok = results is not None
+            if results is None:
+                # Scalar path: no batch_fn, a lone request, or the
+                # vectorized call failed.  Inline runs on the loop;
+                # executor mode fans the group out CONCURRENTLY, so a
+                # slow GIL-releasing compute keeps the multi-worker
+                # overlap the pre-batching executor server had.
+                t0 = time.perf_counter()
+                if self.inline:
+                    results = [scalar_one(p) for p in group]
+                else:
+                    results = list(
+                        await asyncio.gather(
+                            *(
+                                loop.run_in_executor(None, scalar_one, p)
+                                for p in group
+                            )
+                        )
+                    )
+                _COMPUTE_S.observe(time.perf_counter() - t0)
+            # Recorded AFTER execution with the kind that actually
+            # ran: a window whose vmapped call failed and re-ran
+            # scalar-wise must not inflate the vmapped histograms an
+            # operator reads off GetLoad.
+            kind = (
+                "vmapped"
+                if vmapped_ok
+                else ("single" if k == 1 else "serial")
+            )
+            _BATCH_SIZE.labels(kind=kind).observe(k)
+            _BATCHES.labels(kind=kind).inc()
+            if k > 1:
+                _flightrec.record("server.batch", size=k, exec_kind=kind)
+        except BaseException as e:
+            # Engine failure (not a compute failure — those are caught
+            # per request): fail the whole group loudly rather than
+            # strand its futures.  BaseException matters: a cancelled
+            # drain task (server shutdown) or a KeyboardInterrupt
+            # escaping an inline compute would otherwise leave every
+            # awaiting RPC handler blocked forever — the silent-wedge
+            # class the watchdog exists for.
+            err = (
+                e
+                if isinstance(e, Exception)
+                else RuntimeError(f"batch execution aborted: {e!r}")
+            )
+            for p in group:
+                if not p.future.done():
+                    p.future.set_exception(err)
+            if not isinstance(e, Exception):
+                raise  # cancellation/KeyboardInterrupt still propagate
+            return
+        for p, res in zip(group, results):
+            if p.future.done():  # cancelled caller; nothing to deliver
+                continue
+            if isinstance(res, Exception):
+                p.future.set_exception(res)
+            else:
+                p.future.set_result(
+                    [np.asarray(o) for o in res]  # type: ignore[union-attr]
+                )

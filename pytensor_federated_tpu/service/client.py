@@ -46,7 +46,12 @@ from ..telemetry import spans as _spans
 from ..telemetry import watchdog as _watchdog
 from ..utils import argmin_none_or_func, get_event_loop
 from . import _rpc_metrics
-from .npwire import decode_arrays_all, encode_arrays
+from .npwire import (
+    decode_arrays_all,
+    decode_batch,
+    encode_arrays,
+    encode_batch,
+)
 from .server import EVALUATE, EVALUATE_STREAM, GET_LOAD
 
 _log = logging.getLogger(__name__)
@@ -62,6 +67,7 @@ _RETRIES = _rpc_metrics.RETRIES
 _DROPS = _rpc_metrics.DROPS
 _BATCH_S = _rpc_metrics.BATCH_S
 _WINDOW_DEPTH = _rpc_metrics.WINDOW_DEPTH
+_FRAME_REQS = _rpc_metrics.BATCH_FRAME_REQS
 
 
 # gRPC status codes that mark a DETERMINISTIC server-side failure: the
@@ -206,6 +212,10 @@ class ClientPrivates:
     channel: grpc.aio.Channel
     stream: Optional[grpc.aio.StreamStreamCall] = None
     loop: Optional[asyncio.AbstractEventLoop] = None
+    # Per-connection batch capability: None = not yet probed; {} = the
+    # server does not advertise wire batch frames; a dict with
+    # "max_batch" = it does (GetLoad "batch" field, server.py).
+    batch_caps: Optional[dict] = None
 
     @staticmethod
     async def connect(host: str, port: int, *, use_stream: bool) -> "ClientPrivates":
@@ -372,6 +382,38 @@ class ArraysToArraysServiceClient:
             )
             _privates[cid] = privates
         return privates
+
+    async def _batch_caps(self, privates: ClientPrivates) -> dict:
+        """Read (once per connection) whether the peer advertises wire
+        batch frames via its GetLoad ``batch`` field.  A reference
+        node answers protobuf GetLoad (no such field) and an
+        unreachable/garbled reply degrades to {} — either way the
+        client never coalesces toward a peer that did not opt in, which
+        is the negotiation contract batch frames depend on."""
+        if privates.batch_caps is None:
+            caps: dict = {}
+            try:
+                method = privates.channel.unary_unary(
+                    GET_LOAD,
+                    request_serializer=_identity,
+                    response_deserializer=_identity,
+                )
+                reply = await asyncio.wait_for(method(b""), timeout=5.0)
+                if reply[:1] == b"{":
+                    b = json.loads(reply.decode("utf-8")).get("batch")
+                    if isinstance(b, dict) and int(b.get("max_batch", 0)) > 1:
+                        caps = {"max_batch": int(b["max_batch"])}
+            except (
+                asyncio.TimeoutError,
+                grpc.aio.AioRpcError,
+                OSError,
+                ConnectionError,
+                ValueError,
+                TypeError,
+            ):
+                caps = {}
+            privates.batch_caps = caps
+        return privates.batch_caps
 
     async def _drop_privates(self) -> None:
         cid = _conn_key(self)
@@ -661,8 +703,224 @@ class ArraysToArraysServiceClient:
             raise
         return results  # type: ignore[return-value]
 
+    def _decode_batch_item(self, item: bytes):
+        """Decode one reply item out of a wire batch frame under the
+        active codec -> (outputs, uuid, error); piggybacked node spans
+        are harvested like any reply's."""
+        if self.codec == "npproto":
+            from . import npproto_codec
+
+            outputs, ruuid, error, _tid, spans = (
+                npproto_codec.decode_arrays_msg_full(item)
+            )
+        else:
+            outputs, ruuid, error, _tid, spans = decode_arrays_all(item)
+        if spans:
+            _reunion.ingest(spans)
+        return outputs, ruuid, error
+
+    def _encode_batch_frame(self, part, trace_id):
+        """One outer batch frame for a window slice of encoded
+        requests -> (frame_bytes, outer_uuid)."""
+        if self.codec == "npproto":
+            from . import npproto_codec
+
+            outer_uuid = str(uuid_mod.uuid4())
+            frame = npproto_codec.encode_batch_msg(
+                [req for req, _u, _d in part],
+                uuid=outer_uuid,
+                trace_id=trace_id,
+            )
+        else:
+            outer_uuid = uuid_mod.uuid4().bytes
+            frame = encode_batch(
+                [req for req, _u, _d in part],
+                uuid=outer_uuid,
+                trace_id=trace_id,
+            )
+        return frame, outer_uuid
+
+    def _decode_batch_frame(self, reply: bytes):
+        """Outer batch reply -> (items, outer_uuid, outer_error);
+        outer spans (the node's whole-window tree) are harvested."""
+        if self.codec == "npproto":
+            from . import npproto_codec
+
+            items, ruuid, _tid, spans = npproto_codec.decode_batch_msg(
+                reply
+            )
+            error = None
+        else:
+            items, ruuid, error, _tid, spans = decode_batch(reply)
+        if spans:
+            _reunion.ingest(spans)
+        return items, ruuid, error
+
+    async def _evaluate_many_batched_once(
+        self, encoded, window: int, max_batch: int
+    ) -> List[List[np.ndarray]]:
+        """One pipelined pass using WIRE BATCH FRAMES: the window is
+        packed ``min(window, max_batch)`` requests per frame, so K
+        requests pay one transport message, one server decode loop and
+        one (vmapped) dispatch per frame instead of per call.  Frames
+        pipeline on the stream under the same in-flight byte cap as
+        the unbatched path; per-item uuids still correlate inside each
+        frame and the outer uuid correlates the frame itself.  Error
+        semantics match the unbatched pass: the first item error
+        drains the in-flight frames and raises without retry."""
+        privates = await self._get_privates()
+        n = len(encoded)
+        chunk = max(1, min(window, max_batch))
+        trace_id = _spans.current_trace_id() if _spans.enabled() else None
+        frames = []  # (frame_bytes, outer_uuid, start, part)
+        for start in range(0, n, chunk):
+            part = encoded[start : start + chunk]
+            frame, outer_uuid = self._encode_batch_frame(part, trace_id)
+            _FRAME_REQS.labels(transport="grpc").observe(len(part))
+            frames.append((frame, outer_uuid, start, part))
+        results: List[Optional[List[np.ndarray]]] = [None] * n
+
+        async def consume(reply, frame_idx, *, inflight_after: int):
+            """Validate one outer reply; fills results or raises.
+            ``inflight_after`` = frames still undrained after this one
+            (for the error-drain path)."""
+            _frame, outer_uuid, start, part = frames[frame_idx]
+            try:
+                items, ruuid, outer_error = self._decode_batch_frame(reply)
+            except (grpc.aio.AioRpcError, ConnectionError, OSError):
+                raise
+            except BaseException:
+                # Corrupt reply mid-pipeline: correlation is gone —
+                # drop so the NEXT call reconnects cleanly (same
+                # posture as the unbatched pass).
+                await self._drop_privates()
+                raise
+            # Outer error FIRST: an outer-level batch failure is
+            # encoded with a zeroed uuid (server.py / cpp_node), so
+            # checking correlation first would mask the real error as
+            # a phantom uuid mismatch.
+            if outer_error is not None:
+                await self._drain_frames(inflight_after)
+                raise RuntimeError(f"server error: {outer_error}")
+            if ruuid != outer_uuid:
+                await self._drop_privates()
+                raise RuntimeError(
+                    "uuid mismatch: batch reply does not correlate "
+                    "with its frame"
+                )
+            if len(items) != len(part):
+                await self._drop_privates()
+                raise RuntimeError(
+                    f"batch reply carries {len(items)} items for a "
+                    f"{len(part)}-request frame"
+                )
+            for j, (item, (_req, uuid, _dec)) in enumerate(
+                zip(items, part)
+            ):
+                try:
+                    outputs, ruuid_j, error_j = self._decode_batch_item(
+                        item
+                    )
+                except (grpc.aio.AioRpcError, ConnectionError, OSError):
+                    raise
+                except BaseException:
+                    # Corrupt nested item with frames still in flight:
+                    # the stream's undrained replies would poison the
+                    # NEXT call — drop, like the unbatched pass does
+                    # for a corrupt reply.
+                    await self._drop_privates()
+                    raise
+                if error_j is not None:
+                    await self._drain_frames(inflight_after)
+                    raise RuntimeError(f"server error: {error_j}")
+                if ruuid_j != uuid:
+                    await self._drop_privates()
+                    raise RuntimeError(
+                        "uuid mismatch: batch item does not correlate "
+                        "with its request"
+                    )
+                results[start + j] = outputs
+
+        if privates.stream is None:
+            method = privates.channel.unary_unary(
+                EVALUATE,
+                request_serializer=_identity,
+                response_deserializer=_identity,
+            )
+            # Bounded like the unbatched unary pass: ~window REQUESTS
+            # in flight, i.e. window//chunk frames per gather — a huge
+            # request list must not explode into thousands of
+            # simultaneous RPCs just because frames are big.
+            frames_per_gather = max(1, window // chunk)
+            for start_f in range(0, len(frames), frames_per_gather):
+                part_f = frames[start_f : start_f + frames_per_gather]
+                replies = await asyncio.gather(
+                    *(method(frame) for frame, _u, _s, _p in part_f),
+                    return_exceptions=True,
+                )
+                for reply in replies:
+                    if isinstance(reply, BaseException):
+                        raise reply
+                for k, reply in enumerate(replies):
+                    await consume(reply, start_f + k, inflight_after=0)
+            return results  # type: ignore[return-value]
+
+        stream = privates.stream
+        # Same flow-control geometry as the unbatched pass: cap
+        # in-flight frame bytes under the HTTP/2 stream window, with
+        # the lone-frame disjunct for oversized frames.
+        max_inflight_bytes = 32 * 1024
+        nf = len(frames)
+        write_idx = read_idx = 0
+        inflight_bytes = 0
+        try:
+            while read_idx < nf:
+                while write_idx < nf and (
+                    write_idx == read_idx
+                    or inflight_bytes + len(frames[write_idx][0])
+                    <= max_inflight_bytes
+                ):
+                    await stream.write(frames[write_idx][0])
+                    inflight_bytes += len(frames[write_idx][0])
+                    write_idx += 1
+                _WINDOW_DEPTH.labels(transport="grpc").observe(
+                    write_idx - read_idx
+                )
+                reply = await stream.read()
+                if reply is grpc.aio.EOF:
+                    raise ConnectionError("stream closed by server")
+                inflight_bytes -= len(frames[read_idx][0])
+                await consume(
+                    reply,
+                    read_idx,
+                    inflight_after=write_idx - read_idx - 1,
+                )
+                read_idx += 1
+        except (grpc.aio.AioRpcError, ConnectionError, OSError):
+            await self._drop_privates()
+            raise
+        return results  # type: ignore[return-value]
+
+    async def _drain_frames(self, n_frames: int) -> None:
+        """Count-only drain of in-flight stream replies so the
+        lock-step correlation survives a deterministic server error
+        (mirror of the unbatched drain)."""
+        if n_frames <= 0:
+            return
+        privates = await self._get_privates()
+        if privates.stream is None:
+            return
+        for _ in range(n_frames):
+            drained = await privates.stream.read()
+            if drained is grpc.aio.EOF:
+                break
+
     async def evaluate_many_async(
-        self, requests: Sequence[Sequence[np.ndarray]], *, window: int = 8
+        self,
+        requests: Sequence[Sequence[np.ndarray]],
+        *,
+        window: int = 8,
+        batch: object = "auto",
     ) -> List[List[np.ndarray]]:
         """Pipelined evaluation of MANY argument tuples on one node.
 
@@ -676,6 +934,18 @@ class ArraysToArraysServiceClient:
         (the suite artifact and an idle-machine sweep; docs/
         performance.md "Host lane budget").
 
+        ``batch``: "auto" (default) additionally packs the window into
+        WIRE BATCH FRAMES — ``min(window, server max_batch)`` requests
+        per transport message — when the connected server advertises
+        the capability in its GetLoad reply, so the whole window pays
+        one encode/decode and one syscall each way and the server can
+        execute it as one vmapped call (docs/performance.md "Host lane
+        budget", batched rows).  ``False`` forces the plain pipelined
+        pass (per-call frames); ``True`` requires batch support and
+        raises if the server does not advertise it.  Reference-wire
+        peers never advertise, so "auto" degrades to the plain pass —
+        a reference runtime never sees a batch frame.
+
         All-or-nothing TRANSPORT failover: on connection failure the
         whole batch retries on a freshly balanced connection
         (per-result partial retry would reorder effects on a stateful
@@ -687,15 +957,25 @@ class ArraysToArraysServiceClient:
         into the RPC layer — classified by status code here so a
         deterministic compute error is NOT re-executed retries+1
         times; npproto stream aborts do tear down that connection).
+        In batched mode both codecs carry per-item in-band errors
+        (npwire item error block / npproto field 14), same no-retry
+        raise.
         """
         if window < 1:
             raise ValueError(f"window must be >= 1, got {window}")
+        # Identity checks, not equality: 0/1 would pass an `in` test
+        # (0 == False) yet route down the WRONG branch below, so they
+        # are rejected outright.
+        if batch != "auto" and batch is not True and batch is not False:
+            raise ValueError(
+                f"batch must be 'auto', True or False, got {batch!r}"
+            )
         with _spans.span(
             "rpc.evaluate_many",
             transport="grpc",
             n=len(requests),
             window=window,
-        ):
+        ) as root:
             with _spans.span("encode"):
                 encoded = [self._encode_request(args) for args in requests]
             if not encoded:
@@ -710,6 +990,20 @@ class ArraysToArraysServiceClient:
                         batch=len(encoded),
                     )
                 try:
+                    # Capability is per CONNECTION (a retry may land on
+                    # a different pool member): read it after connect,
+                    # before deciding how to pack the window.
+                    max_batch = 0
+                    if batch is not False:
+                        privates = await self._get_privates()
+                        caps = await self._batch_caps(privates)
+                        max_batch = int(caps.get("max_batch", 0))
+                        if batch is True and max_batch < 2:
+                            raise RuntimeError(
+                                f"server {privates.host}:{privates.port} "
+                                "does not advertise wire batch frames "
+                                "(GetLoad carries no usable 'batch' field)"
+                            )
                     # Known wedge point (CLAUDE.md): an HTTP/2 batch
                     # window can deadlock against flow control — armed
                     # so a hang leaves an incident bundle, not a blank.
@@ -717,9 +1011,15 @@ class ArraysToArraysServiceClient:
                         "grpc.batch_window",
                         n=len(encoded), window=window,
                     ):
-                        results = await self._evaluate_many_once(
-                            encoded, window
-                        )
+                        if max_batch >= 2:
+                            root.set_attr("batched", True)
+                            results = await self._evaluate_many_batched_once(
+                                encoded, window, max_batch
+                            )
+                        else:
+                            results = await self._evaluate_many_once(
+                                encoded, window
+                            )
                 except (grpc.aio.AioRpcError, ConnectionError, OSError) as e:
                     last_exc = e
                     await self._drop_privates()
@@ -737,10 +1037,14 @@ class ArraysToArraysServiceClient:
             )
 
     def evaluate_many(
-        self, requests: Sequence[Sequence[np.ndarray]], *, window: int = 8
+        self,
+        requests: Sequence[Sequence[np.ndarray]],
+        *,
+        window: int = 8,
+        batch: object = "auto",
     ) -> List[List[np.ndarray]]:
         """Sync wrapper over :meth:`evaluate_many_async`."""
         loop = get_event_loop()
         return loop.run_until_complete(
-            self.evaluate_many_async(requests, window=window)
+            self.evaluate_many_async(requests, window=window, batch=batch)
         )

@@ -47,25 +47,35 @@ class LogpServiceClient:
         return get_event_loop().run_until_complete(self.evaluate_async(*inputs))
 
     async def evaluate_many_async(
-        self, requests: Sequence[Sequence[np.ndarray]], *, window: int = 8
+        self,
+        requests: Sequence[Sequence[np.ndarray]],
+        *,
+        window: int = 8,
+        batch: object = "auto",
     ) -> List[np.ndarray]:
         """Pipelined batch of logp evaluations (one scalar each) —
         :meth:`ArraysToArraysServiceClient.evaluate_many_async` with
         this adapter's shape contract applied per reply.  The batch
         shape fits vectorized consumers (SMC particle weights, ensemble
-        proposals) that score many points against one node."""
+        proposals) that score many points against one node.  ``batch``
+        forwards to the transport client: "auto" coalesces the window
+        into wire batch frames when the server advertises support."""
         batches = await self._client.evaluate_many_async(
-            requests, window=window
+            requests, window=window, batch=batch
         )
         return [self._check_reply(outputs) for outputs in batches]
 
     def evaluate_many(
-        self, requests: Sequence[Sequence[np.ndarray]], *, window: int = 8
+        self,
+        requests: Sequence[Sequence[np.ndarray]],
+        *,
+        window: int = 8,
+        batch: object = "auto",
     ) -> List[np.ndarray]:
         from ..utils import get_event_loop
 
         return get_event_loop().run_until_complete(
-            self.evaluate_many_async(requests, window=window)
+            self.evaluate_many_async(requests, window=window, batch=batch)
         )
 
     __call__ = evaluate
@@ -104,7 +114,11 @@ class LogpGradServiceClient:
         return get_event_loop().run_until_complete(self.evaluate_async(*inputs))
 
     async def evaluate_many_async(
-        self, requests: Sequence[Sequence[np.ndarray]], *, window: int = 8
+        self,
+        requests: Sequence[Sequence[np.ndarray]],
+        *,
+        window: int = 8,
+        batch: object = "auto",
     ) -> List[Tuple[np.ndarray, List[np.ndarray]]]:
         """Pipelined batch of (logp, grads) evaluations — see
         :meth:`LogpServiceClient.evaluate_many_async`."""
@@ -113,7 +127,7 @@ class LogpGradServiceClient:
         # would silently drop every result.
         requests = list(requests)
         batches = await self._client.evaluate_many_async(
-            requests, window=window
+            requests, window=window, batch=batch
         )
         return [
             self._check_reply(outputs, len(args))
@@ -121,12 +135,16 @@ class LogpGradServiceClient:
         ]
 
     def evaluate_many(
-        self, requests: Sequence[Sequence[np.ndarray]], *, window: int = 8
+        self,
+        requests: Sequence[Sequence[np.ndarray]],
+        *,
+        window: int = 8,
+        batch: object = "auto",
     ) -> List[Tuple[np.ndarray, List[np.ndarray]]]:
         from ..utils import get_event_loop
 
         return get_event_loop().run_until_complete(
-            self.evaluate_many_async(requests, window=window)
+            self.evaluate_many_async(requests, window=window, batch=batch)
         )
 
     __call__ = evaluate

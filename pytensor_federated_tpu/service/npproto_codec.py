@@ -15,16 +15,24 @@ dependency:
     InputArrays       items(1: repeated ndarray) uuid(2: string)
     OutputArrays      items(1: repeated ndarray) uuid(2: string)
 
-plus TWO extension fields this package emits and understands:
+plus FOUR extension fields this package emits and understands:
 ``trace_id(15: bytes)`` on InputArrays — the 16-byte telemetry
-correlation id (:mod:`..telemetry.spans`) — and ``spans(16: bytes)``
-on OutputArrays — a JSON list of the node's completed span trees for
+correlation id (:mod:`..telemetry.spans`); ``spans(16: bytes)`` on
+OutputArrays — a JSON list of the node's completed span trees for
 that call, piggybacked on the reply so the driver can reunite both
-halves of the trace (:mod:`..telemetry.reunion`).  Fields 15/16 are
-unknown to the reference schema, so an unmodified reference peer skips
-them by wire type (the standard proto3 forward-compatibility rule,
-property-tested against the official runtime); they cost nothing when
-absent.
+halves of the trace (:mod:`..telemetry.reunion`);
+``batch_items(17: repeated bytes)`` — K nested InputArrays/
+OutputArrays messages making the message a BATCH frame (one RPC
+message per pipelined window, the npproto twin of npwire flag bit 8;
+:func:`encode_batch_msg`); and ``error(14: string)`` — a per-item
+compute/decode error INSIDE a batch reply item, the isolation channel
+the reference schema lacks (outside batches npproto errors still
+surface as gRPC aborts, unchanged).  Fields 14-17 are unknown to the
+reference schema, so an unmodified reference peer skips them by wire
+type (the standard proto3 forward-compatibility rule, property-tested
+against the official runtime); they cost nothing when absent — and a
+reference peer never RECEIVES a batch frame at all: clients only
+coalesce toward a server whose GetLoad advertised the capability.
     GetLoadParams     (empty)
     GetLoadResult     n_clients(1: int32) percent_cpu(2: float)
                       percent_ram(3: float)
@@ -70,6 +78,10 @@ __all__ = [
     "decode_arrays_msg",
     "decode_arrays_msg_ex",
     "decode_arrays_msg_all",
+    "decode_arrays_msg_full",
+    "encode_batch_msg",
+    "decode_batch_msg",
+    "has_batch_items",
     "append_spans_msg",
     "encode_get_load_result",
     "decode_get_load_result",
@@ -298,15 +310,47 @@ def encode_arrays_msg(
     uuid: str,
     *,
     trace_id: Optional[bytes] = None,
+    error: Optional[str] = None,
 ) -> bytes:
     """InputArrays/OutputArrays: repeated ndarray items + string uuid
     (reference: service.proto:6-19; uuid is the correlation id the
     reference's client checks, rpc.py:37-39).  ``trace_id`` emits the
-    telemetry extension field 15 (module docstring); ``None`` keeps the
-    message byte-identical to the official encoder's output."""
+    telemetry extension field 15 (module docstring); ``error`` emits
+    the per-item error extension field 14 — only used on items INSIDE
+    a batch reply, where the gRPC-abort channel cannot isolate one
+    poisoned request.  Both ``None`` keeps the message byte-identical
+    to the official encoder's output."""
     out = bytearray()
     for a in arrays:
         out += _len_field(1, encode_ndarray(a))
+    if uuid:
+        out += _len_field(2, uuid.encode("utf-8"))
+    if error is not None:
+        out += _len_field(14, error.encode("utf-8"))
+    if trace_id is not None:
+        if len(trace_id) != 16:
+            raise WireError(
+                f"trace_id must be 16 bytes, got {len(trace_id)}"
+            )
+        out += _len_field(15, trace_id)
+    return bytes(out)
+
+
+def encode_batch_msg(
+    items: Sequence[bytes],
+    uuid: str,
+    *,
+    trace_id: Optional[bytes] = None,
+) -> bytes:
+    """Frame K already-encoded InputArrays/OutputArrays messages as ONE
+    batch message (extension field 17) — the npproto twin of
+    :func:`..npwire.encode_batch`.  The outer uuid correlates the
+    window; each nested item keeps its own uuid (and, on replies, its
+    own field-14 error), so failure isolation is per item.  Only sent
+    to peers that advertised the capability via GetLoad — a reference
+    runtime would skip field 17 and see an empty message, which is why
+    negotiation gates the send."""
+    out = bytearray()
     if uuid:
         out += _len_field(2, uuid.encode("utf-8"))
     if trace_id is not None:
@@ -315,7 +359,62 @@ def encode_arrays_msg(
                 f"trace_id must be 16 bytes, got {len(trace_id)}"
             )
         out += _len_field(15, trace_id)
+    for item in items:
+        out += _len_field(17, item)
     return bytes(out)
+
+
+def has_batch_items(buf: bytes) -> bool:
+    """Whether a message carries batch items (field 17) at top level —
+    the server's cheap batch-vs-plain dispatch (tags are skipped, no
+    ndarray decode happens)."""
+    pos = 0
+    try:
+        while pos < len(buf):
+            field, wt, pos = _decode_tag(buf, pos)
+            if field == 17 and wt == _WT_LEN:
+                return True
+            pos = _skip(buf, pos, wt)
+    except WireError:
+        return False
+    return False
+
+
+def decode_batch_msg(
+    buf: bytes,
+) -> Tuple[List[bytes], str, Optional[bytes], Optional[list]]:
+    """Decode a batch message -> (items, uuid, trace_id, spans);
+    ``items`` are the nested messages still encoded (decode each with
+    :func:`decode_arrays_msg_full`)."""
+    items: List[bytes] = []
+    uuid = ""
+    trace_id: Optional[bytes] = None
+    spans: Optional[list] = None
+    pos = 0
+    while pos < len(buf):
+        field, wt, pos = _decode_tag(buf, pos)
+        if field == 17 and wt == _WT_LEN:
+            item, pos = _decode_len(buf, pos)
+            items.append(item)
+        elif field == 2 and wt == _WT_LEN:
+            raw, pos = _decode_len(buf, pos)
+            try:
+                uuid = raw.decode("utf-8")
+            except UnicodeDecodeError as e:
+                raise WireError(f"bad uuid string: {e}") from None
+        elif field == 15 and wt == _WT_LEN:
+            raw, pos = _decode_len(buf, pos)
+            trace_id = raw if len(raw) == 16 else None
+        elif field == 16 and wt == _WT_LEN:
+            raw, pos = _decode_len(buf, pos)
+            try:
+                parsed = json.loads(raw.decode("utf-8"))
+            except (UnicodeDecodeError, ValueError):
+                parsed = None  # tolerant: sidecar only, never the payload
+            spans = parsed if isinstance(parsed, list) else None
+        else:
+            pos = _skip(buf, pos, wt)
+    return items, uuid, trace_id, spans
 
 
 def append_spans_msg(buf: bytes, spans: Sequence[dict]) -> bytes:
@@ -352,12 +451,23 @@ def decode_arrays_msg_ex(
 def decode_arrays_msg_all(
     buf: bytes,
 ) -> Tuple[List[np.ndarray], str, Optional[bytes], Optional[list]]:
-    """Full decode -> (arrays, uuid, trace_id, spans) where ``spans``
+    """The historical 4-tuple -> (arrays, uuid, trace_id, spans); a
+    per-item error field (14, batch items only) is dropped."""
+    arrays, uuid, _error, trace_id, spans = decode_arrays_msg_full(buf)
+    return arrays, uuid, trace_id, spans
+
+
+def decode_arrays_msg_full(
+    buf: bytes,
+) -> Tuple[List[np.ndarray], str, Optional[str], Optional[bytes], Optional[list]]:
+    """Full decode -> (arrays, uuid, error, trace_id, spans): ``spans``
     is the piggybacked span-tree list (field 16; ``None`` when absent
     or unparseable — a garbled instrumentation sidecar must not fail
-    the RPC that carried real results)."""
+    the RPC that carried real results); ``error`` is the per-item
+    failure channel (field 14) batch reply items carry."""
     arrays: List[np.ndarray] = []
     uuid = ""
+    error: Optional[str] = None
     trace_id: Optional[bytes] = None
     spans: Optional[list] = None
     pos = 0
@@ -372,6 +482,12 @@ def decode_arrays_msg_all(
                 uuid = raw.decode("utf-8")
             except UnicodeDecodeError as e:
                 raise WireError(f"bad uuid string: {e}") from None
+        elif field == 14 and wt == _WT_LEN:
+            raw, pos = _decode_len(buf, pos)
+            try:
+                error = raw.decode("utf-8")
+            except UnicodeDecodeError as e:
+                raise WireError(f"bad error string: {e}") from None
         elif field == 15 and wt == _WT_LEN:
             raw, pos = _decode_len(buf, pos)
             # Tolerant on length: a future sender might widen the id;
@@ -386,7 +502,7 @@ def decode_arrays_msg_all(
             spans = parsed if isinstance(parsed, list) else None
         else:
             pos = _skip(buf, pos, wt)
-    return arrays, uuid, trace_id, spans
+    return arrays, uuid, error, trace_id, spans
 
 
 # ---------------------------------------------------------------------------

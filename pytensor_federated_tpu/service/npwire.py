@@ -37,6 +37,26 @@ contract, not silent skipping).  Cross-implementation forward
 compatibility is the npproto codec's job (its field-15 trace id and
 field-16 spans ARE skipped by unknown-field rules).
 
+BATCH frames (flag bit 8): one wire message carrying K complete
+sub-frames, so a pipelined window pays one transport message and one
+syscall each way instead of K (:mod:`.batching` is the server half).
+The outer header is the SAME layout as a plain frame — the count field
+holds ``n_items`` instead of ``n_arrays`` and the body is
+``item_len(u32) + item_bytes`` per item, each item a full npwire frame
+with its own uuid/arrays/error.  Error isolation is per item: a
+poisoned request fails only its own reply frame.  The outer uuid
+correlates the window; the outer trace id (flag 2) is the
+AUTHORITATIVE one for the node's span context (items are complete
+frames and may redundantly carry their own trace block — this repo's
+clients reuse their per-call encodings — which decoders simply
+consume and drop); the spans tail (flag 4) attaches to the outer
+frame exactly as on a plain reply.  A batch frame is only
+ever sent to a peer that advertised the capability (GetLoad ``batch``
+field / the TCP probe), so the loud :class:`WireError` a pre-batch
+decoder raises on flag 8 is a negotiation bug surfacing, not a
+compatibility hazard.  Plain frames are byte-identical with or without
+this feature compiled in.
+
 Layout (little-endian):
   message: MAGIC(4s) version(u8) flags(u8) uuid(16s) n_arrays(u32)
            [flags&1 error: len(u32) utf8]
@@ -44,6 +64,9 @@ Layout (little-endian):
   array:   dtype_len(u16) dtype_str shape_ndim(u8) shape(u64*ndim)
            data_len(u64) data_bytes
   tail:    [flags&4 spans: len(u32) utf8-JSON]
+  batch:   same header with flags&8; count = n_items; body is
+           item_len(u32) + item_bytes per item (each a full frame);
+           same optional error/trace blocks and spans tail
 """
 
 from __future__ import annotations
@@ -60,6 +83,7 @@ MAGIC = b"NPW1"
 _FLAG_ERROR = 1
 _FLAG_TRACE = 2
 _FLAG_SPANS = 4
+_FLAG_BATCH = 8
 # flags byte offset in the header ("<4sBB...": magic, version, flags)
 _FLAGS_OFF = 5
 
@@ -154,6 +178,130 @@ def encode_arrays(
     return b"".join(parts)
 
 
+def encode_batch(
+    items: Sequence[bytes],
+    *,
+    uuid: Optional[bytes] = None,
+    error: Optional[str] = None,
+    trace_id: Optional[bytes] = None,
+) -> bytes:
+    """Frame K already-encoded npwire messages as ONE batch message
+    (flag bit 8).  ``items`` are complete frames — each keeps its own
+    uuid/arrays/error, so replies stay correlated and error-isolated
+    per item.  The outer ``uuid`` correlates the window as a whole;
+    the outer ``trace_id`` is the authoritative span-context id for
+    the batch (an item's own trace block, if present, is consumed and
+    dropped by the server); a zero-item batch is legal — it is the
+    TCP capability probe.  The result accepts :func:`append_spans`
+    like any reply frame."""
+    if uuid is None:
+        uuid = uuid_mod.uuid4().bytes
+    if len(uuid) != 16:
+        raise WireError(f"uuid must be 16 bytes, got {len(uuid)}")
+    flags = _FLAG_BATCH
+    if error is not None:
+        flags |= _FLAG_ERROR
+    if trace_id is not None:
+        if len(trace_id) != 16:
+            raise WireError(
+                f"trace_id must be 16 bytes, got {len(trace_id)}"
+            )
+        flags |= _FLAG_TRACE
+    parts: List[bytes] = [
+        struct.pack("<4sBB16sI", MAGIC, 1, flags, uuid, len(items))
+    ]
+    if error is not None:
+        err = error.encode("utf-8")
+        parts.append(struct.pack("<I", len(err)))
+        parts.append(err)
+    if trace_id is not None:
+        parts.append(trace_id)
+    for item in items:
+        if item[:4] != MAGIC:
+            raise WireError("batch items must be complete npwire frames")
+        parts.append(struct.pack("<I", len(item)))
+        parts.append(item)
+    return b"".join(parts)
+
+
+def is_batch_frame(buf: bytes) -> bool:
+    """Whether ``buf`` leads with an npwire batch header (flag bit 8).
+    A cheap dispatch predicate — full validation happens in
+    :func:`decode_batch`."""
+    return (
+        len(buf) > _FLAGS_OFF
+        and buf[:4] == MAGIC
+        and bool(buf[_FLAGS_OFF] & _FLAG_BATCH)
+    )
+
+
+def decode_batch(
+    buf: bytes,
+) -> Tuple[List[bytes], bytes, Optional[str], Optional[bytes], Optional[list]]:
+    """Decode a batch message -> (items, uuid, error, trace_id, spans).
+    ``items`` are the K framed sub-messages, still encoded — decode
+    each with :func:`decode_arrays_all` (they may individually carry
+    error blocks: per-item failure isolation)."""
+    try:
+        magic, version, flags, uuid, n = struct.unpack_from("<4sBB16sI", buf, 0)
+    except struct.error as e:
+        raise WireError(f"truncated header: {e}") from None
+    if magic != MAGIC:
+        raise WireError(f"bad magic {magic!r}")
+    if version != 1:
+        raise WireError(f"unsupported version {version}")
+    if not flags & _FLAG_BATCH:
+        raise WireError("not a batch frame (flag bit 8 unset)")
+    off = struct.calcsize("<4sBB16sI")
+    error = None
+    if flags & _FLAG_ERROR:
+        try:
+            (elen,) = struct.unpack_from("<I", buf, off)
+            off += 4
+            if off + elen > len(buf):
+                raise WireError("truncated error block")
+            error = buf[off : off + elen].decode("utf-8")
+            off += elen
+        except (struct.error, UnicodeDecodeError) as e:
+            raise WireError(f"truncated error block: {e}") from None
+    trace_id = None
+    if flags & _FLAG_TRACE:
+        if off + 16 > len(buf):
+            raise WireError("truncated trace block")
+        trace_id = buf[off : off + 16]
+        off += 16
+    items: List[bytes] = []
+    for _ in range(n):
+        try:
+            (ilen,) = struct.unpack_from("<I", buf, off)
+        except struct.error as e:
+            raise WireError(f"truncated batch item length: {e}") from None
+        off += 4
+        item = buf[off : off + ilen]
+        if len(item) != ilen:
+            raise WireError("truncated batch item")
+        if item[:4] != MAGIC:
+            raise WireError("batch item is not an npwire frame")
+        items.append(item)
+        off += ilen
+    spans = None
+    if flags & _FLAG_SPANS:
+        try:
+            (slen,) = struct.unpack_from("<I", buf, off)
+            off += 4
+            if off + slen > len(buf):
+                raise WireError("truncated spans block")
+            spans = json.loads(buf[off : off + slen].decode("utf-8"))
+            off += slen
+        except (struct.error, UnicodeDecodeError, ValueError) as e:
+            raise WireError(f"corrupt spans block: {e}") from None
+        if not isinstance(spans, list):
+            raise WireError(
+                f"spans block must be a JSON list, got {type(spans).__name__}"
+            )
+    return items, uuid, error, trace_id, spans
+
+
 def append_spans(frame: bytes, spans: Sequence[dict]) -> bytes:
     """Attach a spans tail to an ALREADY-ENCODED frame (flag bit 4).
 
@@ -221,6 +369,13 @@ def decode_arrays_all(
         raise WireError(f"bad magic {magic!r}")
     if version != 1:
         raise WireError(f"unsupported version {version}")
+    if flags & _FLAG_BATCH:
+        # Loud, not silent: parsing K framed items as arrays would
+        # yield garbage.  Batch frames only reach negotiated peers
+        # (module docstring), so landing here is a dispatch bug.
+        raise WireError(
+            "batch frame (flag bit 8); decode with decode_batch"
+        )
     off = struct.calcsize("<4sBB16sI")
     error = None
     if flags & _FLAG_ERROR:

@@ -38,7 +38,15 @@ from ..signatures import ComputeFn
 from ..telemetry import flightrec as _flightrec
 from ..telemetry import metrics as _metrics
 from ..telemetry import spans as _spans
-from .npwire import append_spans, decode_arrays_ex, encode_arrays
+from .batching import MicroBatcher, batched_compute_fn
+from .npwire import (
+    append_spans,
+    decode_arrays_ex,
+    decode_batch,
+    encode_arrays,
+    encode_batch,
+    is_batch_frame,
+)
 
 _log = logging.getLogger(__name__)
 
@@ -81,12 +89,25 @@ GET_LOAD = f"/{SERVICE_NAME}/GetLoad"
 _identity = lambda b: b  # noqa: E731  (raw-bytes (de)serializer)
 
 
-def device_compute_fn(fn: ComputeFn, *, jit: bool = True) -> Callable:
+def device_compute_fn(
+    fn: ComputeFn,
+    *,
+    jit: bool = True,
+    batched: bool = False,
+    max_batch: int = 32,
+) -> Callable:
     """Adapt a JAX function into the host compute contract.
 
     The node-side analog of the reference compiling its model with
     PyTensor before serving it (reference: demo_node.py:39-42): ``fn``
     is jitted once, inputs arrive as NumPy, outputs return as NumPy.
+
+    ``batched=True`` additionally attaches a ``.batch`` attribute — a
+    ``jax.vmap``-vectorized variant with a padded-bucket jit cache
+    (:func:`.batching.batched_compute_fn`) — which the service's
+    micro-batcher uses to execute a coalesced window of same-signature
+    requests as ONE device call instead of K.  ``max_batch`` bounds the
+    bucket ladder; keep it in sync with the service's ``max_batch``.
     """
     import jax
 
@@ -96,6 +117,8 @@ def device_compute_fn(fn: ComputeFn, *, jit: bool = True) -> Callable:
         out = jfn(*arrays)
         return [np.asarray(o) for o in out]
 
+    if batched:
+        compute.batch = batched_compute_fn(fn, jit=jit, max_batch=max_batch)
     return compute
 
 
@@ -115,6 +138,9 @@ class ArraysToArraysService:
         getload_wire: str = "npwire",
         inline_compute: bool = False,
         ship_spans: bool = True,
+        max_batch: int = 32,
+        max_wait_us: float = 200.0,
+        batch_fn: Optional[Callable] = None,
     ):
         """``getload_wire``: "npwire" (JSON reply, this package's
         native clients) or "npproto" (reference ``GetLoadResult``
@@ -141,7 +167,27 @@ class ArraysToArraysService:
         npproto field 16), so the driver reunites both halves of the
         trace (:mod:`..telemetry.reunion`).  Costs a few hundred bytes
         of JSON per traced reply; False keeps replies span-free (the
-        driver can still pull via GetLoad ``b"traces"``)."""
+        driver can still pull via GetLoad ``b"traces"``).
+
+        ``max_batch``/``max_wait_us``: the micro-batching engine
+        (:mod:`.batching`).  Requests that arrive while a device call
+        is in flight — concurrent RPCs, concurrent streams, or the K
+        items of one wire batch frame — coalesce and execute together
+        as one ``jax.vmap``-batched call when the compute exposes a
+        vectorized variant (``batch_fn`` here, or the ``.batch``
+        attribute ``device_compute_fn(..., batched=True)`` attaches).
+        A lone request on an idle node dispatches immediately (zero
+        added latency); ``max_wait_us`` is only ever paid while the
+        queue is non-empty.  The coalescing queue serializes dispatch
+        (that is what creates the batches), so it only ENGAGES where
+        that trade wins: a vectorized compute, or an inline (sub-ms)
+        one.  A slow executor-mode compute WITHOUT a vectorized
+        variant keeps the classic per-request executor concurrency —
+        wire batch frames are still served (decoded once, executed
+        concurrently, replied as one frame) and the capability is
+        still advertised, since the frame itself is a transport win
+        regardless.  ``max_batch=1`` disables batch frames and the
+        engine entirely."""
         if getload_wire not in ("npwire", "npproto"):
             raise ValueError(
                 f"getload_wire must be 'npwire' or 'npproto', "
@@ -151,6 +197,17 @@ class ArraysToArraysService:
         self.inline_compute = bool(inline_compute)
         self.ship_spans = bool(ship_spans)
         self.compute_fn = compute_fn
+        self.max_batch = int(max_batch)
+        batch_fn = batch_fn or getattr(compute_fn, "batch", None)
+        self._batcher: Optional[MicroBatcher] = None
+        if max_batch > 1 and (batch_fn is not None or inline_compute):
+            self._batcher = MicroBatcher(
+                compute_fn,
+                batch_fn,
+                max_batch=max_batch,
+                max_wait_us=max_wait_us,
+                inline=inline_compute,
+            )
         self._n_clients = 0
         # Start psutil's interval-based CPU accounting early so the
         # first real query is meaningful (reference: service.py:84-85).
@@ -186,6 +243,13 @@ class ArraysToArraysService:
 
         t_arrive = time.perf_counter()
         is_npwire = request[:4] == MAGIC
+        # Wire batch frames (npwire flag bit 8 / npproto field 17): one
+        # message carrying a whole pipelined window; handled on their
+        # own path so error isolation stays per item.
+        if is_npwire and is_batch_frame(request):
+            return await self._run_batch_npwire(request, t_arrive)
+        if not is_npwire and npproto_codec.has_batch_items(request):
+            return await self._run_batch_npproto(request, t_arrive)
         trace_id = None
         if is_npwire:
             try:
@@ -228,13 +292,25 @@ class ArraysToArraysService:
             err_reply = None
             try:
                 with _spans.span("compute") as c_span:
-                    if self.inline_compute:
+                    if self._batcher is not None:
+                        # Micro-batching engine: this request coalesces
+                        # with any concurrently in-flight siblings (the
+                        # batcher records queue-wait/compute metrics).
+                        outputs = await self._batcher.submit(inputs)
+                        c_span.set_attr(
+                            "queue_depth", self._batcher.queue_depth
+                        )
+                    elif self.inline_compute:
                         # Fast-compute path: the two thread handoffs of
                         # the executor dominate a sub-ms compute
                         # (docs/performance.md).
                         t_c0 = time.perf_counter()
                         outputs = list(self.compute_fn(*inputs))
                         t_c1 = time.perf_counter()
+                        queue_wait = max(0.0, t_c0 - t_decoded)
+                        _QUEUE_S.observe(queue_wait)
+                        _COMPUTE_S.observe(t_c1 - t_c0)
+                        c_span.set_attr("queue_wait_s", queue_wait)
                     else:
                         loop = asyncio.get_running_loop()
 
@@ -246,10 +322,10 @@ class ArraysToArraysService:
                         outputs, t_c0, t_c1 = await loop.run_in_executor(
                             None, timed_compute
                         )
-                    queue_wait = max(0.0, t_c0 - t_decoded)
-                    _QUEUE_S.observe(queue_wait)
-                    _COMPUTE_S.observe(t_c1 - t_c0)
-                    c_span.set_attr("queue_wait_s", queue_wait)
+                        queue_wait = max(0.0, t_c0 - t_decoded)
+                        _QUEUE_S.observe(queue_wait)
+                        _COMPUTE_S.observe(t_c1 - t_c0)
+                        c_span.set_attr("queue_wait_s", queue_wait)
                     outputs = [np.asarray(o) for o in outputs]
             except Exception as e:
                 _log.exception("compute_fn failed")
@@ -292,6 +368,172 @@ class ArraysToArraysService:
                 reply = npproto_codec.append_spans_msg(reply, [tree])
         return reply
 
+    async def _compute_window(
+        self, to_compute: Sequence[Sequence[np.ndarray]]
+    ) -> list:
+        """Execute a decoded wire-batch window; one outcome (output
+        list or exception) per request — per-item error isolation,
+        whether or not the batching engine is engaged.  Without the
+        engine (slow executor compute, no vectorized variant) the
+        window fans out over the executor's workers, preserving the
+        concurrency the per-RPC path has."""
+        if self._batcher is not None:
+            return await self._batcher.submit_many(to_compute)
+
+        def one(inputs) -> object:
+            try:
+                return [np.asarray(o) for o in self.compute_fn(*inputs)]
+            except Exception as e:
+                return e
+
+        if self.inline_compute:
+            return [one(inputs) for inputs in to_compute]
+        loop = asyncio.get_running_loop()
+        return list(
+            await asyncio.gather(
+                *(
+                    loop.run_in_executor(None, one, inputs)
+                    for inputs in to_compute
+                )
+            )
+        )
+
+    async def _run_batch_npwire(
+        self, request: bytes, t_arrive: float
+    ) -> bytes:
+        """One npwire batch frame in -> one batch frame out, item
+        replies in item order, each with its own uuid and its own
+        error channel (a poisoned item fails only its own reply)."""
+        try:
+            items, outer_uuid, _err, trace_id, _spans_in = decode_batch(
+                request
+            )
+        except Exception as e:
+            _ERRORS.labels(kind="decode").inc()
+            _flightrec.record(
+                "server.error", stage="decode", wire="npwire-batch",
+                error=str(e)[:200],
+            )
+            return encode_batch(
+                [], uuid=b"\0" * 16, error=f"decode error: {e}"
+            )
+        _DECODE_S.observe(time.perf_counter() - t_arrive)
+        with _spans.trace_context(trace_id), _spans.span(
+            "node.evaluate_batch", wire="npwire", n_items=len(items)
+        ) as root:
+            replies: list = [None] * len(items)
+            to_compute = []  # (slot, inputs, uuid)
+            for i, item in enumerate(items):
+                try:
+                    inputs, uuid, _, _ = decode_arrays_ex(item)
+                except Exception as e:
+                    _ERRORS.labels(kind="decode").inc()
+                    _flightrec.record(
+                        "server.error", stage="decode", wire="npwire",
+                        error=str(e)[:200],
+                    )
+                    replies[i] = encode_arrays(
+                        [], uuid=b"\0" * 16, error=f"decode error: {e}"
+                    )
+                    continue
+                to_compute.append((i, inputs, uuid))
+            outcomes = await self._compute_window(
+                [inputs for _, inputs, _ in to_compute]
+            )
+            with _spans.span("encode"):
+                t_e0 = time.perf_counter()
+                for (i, _inputs, uuid), res in zip(to_compute, outcomes):
+                    if isinstance(res, BaseException):
+                        _ERRORS.labels(kind="compute").inc()
+                        _flightrec.record(
+                            "server.error", stage="compute", wire="npwire",
+                            error=str(res)[:200],
+                        )
+                        replies[i] = encode_arrays(
+                            [], uuid=uuid, error=f"compute error: {res}"
+                        )
+                    else:
+                        replies[i] = encode_arrays(res, uuid=uuid)
+                reply = encode_batch(replies, uuid=outer_uuid)
+                _ENCODE_S.observe(time.perf_counter() - t_e0)
+        if (
+            self.ship_spans
+            and trace_id is not None
+            and root.span is not None
+        ):
+            reply = append_spans(reply, [root.span.to_dict()])
+        return reply
+
+    async def _run_batch_npproto(
+        self, request: bytes, t_arrive: float
+    ) -> bytes:
+        """npproto batch message (field 17) in -> batch message out.
+        Per-item failures use the field-14 error extension — the
+        isolation channel the reference schema lacks; only this
+        package's clients send batch messages (capability-gated), so
+        no reference peer ever sees field 14/17."""
+        from . import npproto_codec
+
+        # Outer decode errors raise -> gRPC abort, exactly like a
+        # malformed plain npproto request (reference contract).
+        items, outer_uuid, trace_id, _spans_in = (
+            npproto_codec.decode_batch_msg(request)
+        )
+        _DECODE_S.observe(time.perf_counter() - t_arrive)
+        with _spans.trace_context(trace_id), _spans.span(
+            "node.evaluate_batch", wire="npproto", n_items=len(items)
+        ) as root:
+            replies: list = [None] * len(items)
+            to_compute = []
+            for i, item in enumerate(items):
+                try:
+                    inputs, uuid, _ = npproto_codec.decode_arrays_msg_ex(
+                        item
+                    )
+                except Exception as e:
+                    _ERRORS.labels(kind="decode").inc()
+                    _flightrec.record(
+                        "server.error", stage="decode", wire="npproto",
+                        error=str(e)[:200],
+                    )
+                    replies[i] = npproto_codec.encode_arrays_msg(
+                        [], uuid="", error=f"decode error: {e}"
+                    )
+                    continue
+                to_compute.append((i, inputs, uuid))
+            outcomes = await self._compute_window(
+                [inputs for _, inputs, _ in to_compute]
+            )
+            with _spans.span("encode"):
+                t_e0 = time.perf_counter()
+                for (i, _inputs, uuid), res in zip(to_compute, outcomes):
+                    if isinstance(res, BaseException):
+                        _ERRORS.labels(kind="compute").inc()
+                        _flightrec.record(
+                            "server.error", stage="compute",
+                            wire="npproto", error=str(res)[:200],
+                        )
+                        replies[i] = npproto_codec.encode_arrays_msg(
+                            [], uuid=uuid, error=f"compute error: {res}"
+                        )
+                    else:
+                        replies[i] = npproto_codec.encode_arrays_msg(
+                            res, uuid=uuid
+                        )
+                reply = npproto_codec.encode_batch_msg(
+                    replies, uuid=outer_uuid
+                )
+                _ENCODE_S.observe(time.perf_counter() - t_e0)
+        if (
+            self.ship_spans
+            and trace_id is not None
+            and root.span is not None
+        ):
+            reply = npproto_codec.append_spans_msg(
+                reply, [root.span.to_dict()]
+            )
+        return reply
+
     # -- RPC methods ------------------------------------------------------
 
     async def evaluate(self, request: bytes, context) -> bytes:
@@ -330,6 +572,15 @@ class ArraysToArraysService:
         slow, not just that it is busy.  The three reference fields
         stay top-level, so balancing (and the npproto reply, which has
         no room for more) is unaffected.
+
+        With the micro-batching engine enabled, a ``"batch"`` sub-dict
+        carries BOTH the capability advertisement clients key on
+        before sending wire batch frames (``max_batch`` > 1 is the
+        signal) AND the live batcher picture: queue depth, dispatch
+        tallies, and — telemetry on — batch-size/coalesce-wait
+        quantiles.  npwire-JSON lane only; the reference-format
+        GetLoad reply is fixed at its three fields, which is exactly
+        why a reference peer can never be lured into batch frames.
         """
         try:
             import psutil
@@ -358,6 +609,16 @@ class ArraysToArraysService:
                 "compute_p99_s": _q(_COMPUTE_S, 0.99),
                 "queue_p99_s": _q(_QUEUE_S, 0.99),
             }
+        if self.max_batch > 1:
+            # Capability advertisement: batch frames are served (and a
+            # transport win) even when the coalescing engine itself is
+            # not engaged for this compute, so max_batch>1 is the
+            # signal; live engine stats ride along when it is.
+            load["batch"] = (
+                self._batcher.stats()
+                if self._batcher is not None
+                else {"max_batch": self.max_batch}
+            )
         return load
 
     async def get_load(self, request: bytes, context) -> bytes:
@@ -415,6 +676,8 @@ async def serve(
     getload_wire: str = "npwire",
     inline_compute: bool = False,
     ship_spans: bool = True,
+    max_batch: int = 32,
+    max_wait_us: float = 200.0,
     service: Optional[ArraysToArraysService] = None,
     metrics_port: Optional[int] = None,
     metrics_host: str = "127.0.0.1",
@@ -442,6 +705,8 @@ async def serve(
             getload_wire=getload_wire,
             inline_compute=inline_compute,
             ship_spans=ship_spans,
+            max_batch=max_batch,
+            max_wait_us=max_wait_us,
         )
     elif compute_fn is not None:
         raise ValueError(
@@ -471,6 +736,8 @@ def run_node(
     *,
     getload_wire: str = "npwire",
     inline_compute: bool = False,
+    max_batch: int = 32,
+    max_wait_us: float = 200.0,
     metrics_port: Optional[int] = None,
     metrics_host: str = "127.0.0.1",
 ) -> None:
@@ -481,6 +748,10 @@ def run_node(
     (Evaluate/EvaluateStream auto-detect per request either way).
     ``inline_compute=True`` skips the per-call thread-executor handoff
     for sub-ms compute fns (see ArraysToArraysService).
+    ``max_batch``/``max_wait_us`` tune the micro-batching engine — a
+    ``compute_fn`` with a ``.batch`` attribute (see
+    :func:`device_compute_fn` ``batched=True``) executes coalesced
+    windows as one vmapped call (``max_batch=1`` disables).
     ``metrics_port`` opts into the telemetry exposition endpoint
     (see :func:`serve`)."""
 
@@ -489,6 +760,8 @@ def run_node(
             compute_fn, bind, port,
             getload_wire=getload_wire,
             inline_compute=inline_compute,
+            max_batch=max_batch,
+            max_wait_us=max_wait_us,
             metrics_port=metrics_port,
             metrics_host=metrics_host,
         )

@@ -29,11 +29,15 @@ from ..telemetry import reunion as _reunion
 from ..telemetry import spans as _spans
 from ..telemetry import watchdog as _watchdog
 from . import _rpc_metrics
+from .batching import execute_window_sync as _execute_window_sync
 from .npwire import (
     append_spans,
     decode_arrays_all,
     decode_arrays_ex,
+    decode_batch,
     encode_arrays,
+    encode_batch,
+    is_batch_frame,
 )
 
 __all__ = ["TcpArraysClient", "serve_tcp_once", "RemoteComputeError"]
@@ -46,6 +50,7 @@ _RETRIES = _rpc_metrics.RETRIES
 _DROPS = _rpc_metrics.DROPS
 _BATCH_S = _rpc_metrics.BATCH_S
 _WINDOW_DEPTH = _rpc_metrics.WINDOW_DEPTH
+_FRAME_REQS = _rpc_metrics.BATCH_FRAME_REQS
 
 
 class RemoteComputeError(RuntimeError):
@@ -82,12 +87,29 @@ class TcpArraysClient:
     service.py:408-416 rebalances across a pool; a TCP peer is pinned).
     """
 
-    def __init__(self, host: str, port: int, *, retries: int = 2):
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        *,
+        retries: int = 2,
+        max_inflight_bytes: Optional[int] = None,
+    ):
+        """``max_inflight_bytes`` caps the pipelined window's in-flight
+        REQUEST bytes (deadlock guard, see ``evaluate_many``).  The
+        default (None) is ADAPTIVE: at least the classic 32 KiB, grown
+        to fit a few copies of the first encoded request — so a
+        workload whose single request exceeds 32 KiB does not silently
+        degrade to lock-step — and clamped to the socket's send-buffer
+        size (the actual deadlock boundary)."""
         self.host = host
         self.port = int(port)
         self.retries = retries
+        self.max_inflight_bytes = max_inflight_bytes
         self._sock: Optional[socket.socket] = None
         self._rfile = None  # buffered reader over _sock
+        # Per-connection batch-frame capability (None = not probed).
+        self._batch_ok: Optional[bool] = None
 
     def _connect(self) -> socket.socket:
         if self._sock is None:
@@ -121,6 +143,9 @@ class TcpArraysClient:
             finally:
                 self._sock = None
                 self._rfile = None
+                # Re-probe after reconnect: the peer may have been
+                # replaced by a build without (or with) batch support.
+                self._batch_ok = None
 
     def __del__(self):  # best-effort, mirrors client.py teardown
         try:
@@ -196,18 +221,74 @@ class TcpArraysClient:
 
     __call__ = evaluate
 
-    # in-flight REQUEST bytes cap: keeps every sendall completable so
-    # the pipelining loop always reaches its read — without it, a
-    # write-only burst can fill both sockets' buffers against a server
-    # blocked sending replies nobody reads (the same deadlock geometry
-    # as HTTP/2 flow control on the gRPC lane, client.py).
-    _MAX_INFLIGHT_BYTES = 32 * 1024
+    # Default in-flight REQUEST bytes cap: keeps every sendall
+    # completable so the pipelining loop always reaches its read —
+    # without it, a write-only burst can fill both sockets' buffers
+    # against a server blocked sending replies nobody reads (the same
+    # deadlock geometry as HTTP/2 flow control on the gRPC lane,
+    # client.py).  The EFFECTIVE cap is _inflight_cap(): constructor
+    # knob, else adaptively sized from the first encoded request.
+    _DEFAULT_INFLIGHT_BYTES = 32 * 1024
+
+    def _inflight_cap(self, first_frame_len: int) -> int:
+        """Effective in-flight byte cap for one pipelined pass."""
+        if self.max_inflight_bytes is not None:
+            return int(self.max_inflight_bytes)
+        # Adaptive default: room for ~4 copies of the first request so
+        # large-array workloads still overlap, clamped to HALF the
+        # reported socket send buffer — Linux getsockopt(SO_SNDBUF)
+        # returns the doubled bookkeeping value with only about half
+        # usable for payload, and the cap's whole job is "every
+        # sendall completable", so the clamp must undershoot.
+        cap = max(self._DEFAULT_INFLIGHT_BYTES, 4 * first_frame_len)
+        try:
+            sndbuf = self._connect().getsockopt(
+                socket.SOL_SOCKET, socket.SO_SNDBUF
+            )
+            if sndbuf > 1:
+                # No floor after this clamp: an operator-shrunk send
+                # buffer must WIN (a cap above it re-opens the
+                # deadlock); a cap below one frame just degrades to
+                # the proven-safe lock-step mode via the lone-frame
+                # disjunct.
+                cap = min(cap, sndbuf // 2)
+        except OSError:
+            pass
+        return max(cap, 1)
+
+    def _probe_batch(self) -> bool:
+        """One-shot capability negotiation: a ZERO-item batch frame is
+        the probe.  A batch-aware peer echoes an empty batch reply
+        with the probe's uuid; a pre-batch peer (old C++ node) parses
+        the frame as zero arrays or answers a decode-error frame —
+        either way not a batch frame, so the answer is False and the
+        client never coalesces toward it.  Cached per connection
+        (``close()`` resets it)."""
+        if self._batch_ok is None:
+            sock = self._connect()
+            uid = uuid_mod.uuid4().bytes
+            _send_frame(sock, encode_batch([], uuid=uid))
+            reply = self._read_frame()
+            ok = False
+            if is_batch_frame(reply):
+                try:
+                    items, ruid, err, _tid, _sp = decode_batch(reply)
+                    ok = ruid == uid and err is None and not items
+                except Exception:
+                    ok = False
+            self._batch_ok = ok
+            _flightrec.record(
+                "rpc.batch_capability", transport="tcp", ok=ok,
+                peer=f"{self.host}:{self.port}",
+            )
+        return self._batch_ok
 
     def evaluate_many(
         self,
         requests: Sequence[Sequence[np.ndarray]],
         *,
         window: int = 8,
+        batch: object = "auto",
     ) -> List[List[np.ndarray]]:
         """Pipelined batch over the SAME lock-step connection.
 
@@ -224,9 +305,22 @@ class TcpArraysClient:
         batch); a server error reply raises
         :class:`RemoteComputeError` without retry after draining the
         in-flight replies so the connection stays correlated.
+
+        ``batch``: "auto" (default) packs the window into wire BATCH
+        FRAMES — ``min(window, 32)`` requests per frame — when the
+        peer answers the zero-item probe frame (:meth:`_probe_batch`);
+        the TCP protocol has no GetLoad, so the probe IS the
+        capability negotiation.  ``False`` forces per-call frames;
+        ``True`` requires support and raises if the peer lacks it.
         """
         if window < 1:
             raise ValueError(f"window must be >= 1, got {window}")
+        # Identity checks, not equality: 0/1 would pass an `in` test
+        # (0 == False) yet route down the WRONG branch below.
+        if batch != "auto" and batch is not True and batch is not False:
+            raise ValueError(
+                f"batch must be 'auto', True or False, got {batch!r}"
+            )
         with _spans.span(
             "rpc.evaluate_many",
             transport="tcp",
@@ -262,13 +356,28 @@ class TcpArraysClient:
                         batch=len(encoded),
                     )
                 try:
+                    use_batch = False
+                    if batch is not False:
+                        use_batch = self._probe_batch()
+                        if batch is True and not use_batch:
+                            raise RuntimeError(
+                                f"node {self.host}:{self.port} does not "
+                                "answer the batch-frame probe"
+                            )
                     # Known wedge point: a pipelined window against a
                     # stalled peer can block in read — armed so a hang
                     # leaves an incident bundle (telemetry.watchdog).
                     with _watchdog.armed(
                         "tcp.batch_window", n=len(encoded), window=window
                     ):
-                        results = self._evaluate_many_once(encoded, window)
+                        if use_batch:
+                            results = self._evaluate_many_batched_once(
+                                encoded, window, trace_id
+                            )
+                        else:
+                            results = self._evaluate_many_once(
+                                encoded, window
+                            )
                 except (ConnectionError, OSError) as e:
                     last_err = e
                     _DROPS.labels(transport="tcp").inc()
@@ -290,6 +399,7 @@ class TcpArraysClient:
     def _evaluate_many_once(self, encoded, window):
         sock = self._connect()
         n = len(encoded)
+        max_inflight = self._inflight_cap(len(encoded[0][0]))
         results: List[Optional[List[np.ndarray]]] = [None] * n
         write_idx = read_idx = 0
         inflight_bytes = 0
@@ -303,7 +413,7 @@ class TcpArraysClient:
                 or (
                     write_idx - read_idx < window
                     and inflight_bytes + len(encoded[write_idx][0])
-                    <= self._MAX_INFLIGHT_BYTES
+                    <= max_inflight
                 )
             ):
                 payload = encoded[write_idx][0]
@@ -356,6 +466,174 @@ class TcpArraysClient:
             read_idx += 1
         return results
 
+    _BATCH_CHUNK = 32  # requests per batch frame (server-side max_batch)
+
+    def _evaluate_many_batched_once(self, encoded, window, trace_id):
+        """One pipelined pass using wire batch frames: the window is
+        packed ``min(window, 32)`` requests per frame — one syscall,
+        one node decode loop, one (possibly vmapped) dispatch per
+        frame.  Per-item uuids still correlate; the first item error
+        drains the in-flight frames and raises RemoteComputeError
+        without retry (same semantics as the unbatched pass)."""
+        sock = self._connect()
+        n = len(encoded)
+        chunk = max(1, min(window, self._BATCH_CHUNK))
+        frames = []  # (frame_bytes, outer_uuid, start, part)
+        for start in range(0, n, chunk):
+            part = encoded[start : start + chunk]
+            outer_uuid = uuid_mod.uuid4().bytes
+            frame = encode_batch(
+                [req for req, _u in part],
+                uuid=outer_uuid,
+                trace_id=trace_id,
+            )
+            _FRAME_REQS.labels(transport="tcp").observe(len(part))
+            frames.append((frame, outer_uuid, start, part))
+        results: List[Optional[List[np.ndarray]]] = [None] * n
+        nf = len(frames)
+        max_inflight = self._inflight_cap(len(frames[0][0]))
+        write_idx = read_idx = 0
+        inflight_bytes = 0
+        while read_idx < nf:
+            burst = []
+            while write_idx < nf and (
+                write_idx == read_idx
+                or inflight_bytes + len(frames[write_idx][0])
+                <= max_inflight
+            ):
+                payload = frames[write_idx][0]
+                burst.append(struct.pack("<I", len(payload)))
+                burst.append(payload)
+                inflight_bytes += len(payload)
+                write_idx += 1
+            if burst:
+                sock.sendall(b"".join(burst))
+            _WINDOW_DEPTH.labels(transport="tcp").observe(
+                write_idx - read_idx
+            )
+            reply = self._read_frame()
+            frame, outer_uuid, start, part = frames[read_idx]
+            inflight_bytes -= len(frame)
+            try:
+                items, ruid, outer_err, _tid, node_spans = decode_batch(
+                    reply
+                )
+                if node_spans:
+                    _reunion.ingest(node_spans)
+            except Exception:
+                # Corrupt reply with frames still in flight: close so
+                # the NEXT call reconnects cleanly; the WireError
+                # surfaces loudly (CLAUDE.md invariant).
+                _DROPS.labels(transport="tcp").inc()
+                self.close()
+                raise
+            # Outer error FIRST: outer-level failures carry a zeroed
+            # uuid (serve_tcp_once / cpp_node batch_error_reply), so a
+            # uuid-first check would misreport them as correlation
+            # failures.
+            first_error = outer_err
+            if first_error is None and (
+                ruid != outer_uuid or len(items) != len(part)
+            ):
+                _DROPS.labels(transport="tcp").inc()
+                self.close()
+                raise RuntimeError(
+                    "batch reply does not correlate with its frame"
+                )
+            if first_error is None:
+                for j, (item, (_req, uid)) in enumerate(zip(items, part)):
+                    try:
+                        outputs, reply_uid, error, _t, item_spans = (
+                            decode_arrays_all(item)
+                        )
+                    except Exception:
+                        # Corrupt nested item with frames still in
+                        # flight: same posture as a corrupt reply —
+                        # close so the NEXT call reconnects cleanly.
+                        _DROPS.labels(transport="tcp").inc()
+                        self.close()
+                        raise
+                    if item_spans:
+                        _reunion.ingest(item_spans)
+                    if error is not None:
+                        first_error = error
+                        break
+                    if reply_uid != uid:
+                        _DROPS.labels(transport="tcp").inc()
+                        self.close()
+                        raise RuntimeError(
+                            "uuid mismatch: batch item does not match "
+                            "its request"
+                        )
+                    results[start + j] = outputs
+            if first_error is not None:
+                # Drain in-flight frames so the connection stays
+                # correlated for the NEXT call, then surface the
+                # deterministic error (no retry).
+                try:
+                    for _ in range(write_idx - read_idx - 1):
+                        self._read_frame()
+                except (ConnectionError, OSError):
+                    _DROPS.labels(transport="tcp").inc()
+                    self.close()
+                raise RemoteComputeError(first_error)
+            read_idx += 1
+        return results
+
+
+def _serve_batch_payload(
+    compute_fn: Callable[..., Sequence[np.ndarray]], payload: bytes
+) -> bytes:
+    """One npwire batch frame in -> one batch frame out, per-item
+    error isolation — the TCP server twin of the gRPC service's
+    ``_run_batch_npwire`` (a zero-item frame is the capability probe
+    and echoes an empty batch reply).  A same-signature window runs
+    through the compute's ``.batch`` variant when present (one vmapped
+    call), with scalar fallback on failure."""
+    try:
+        items, outer_uuid, _err, trace_id, _sp = decode_batch(payload)
+    except Exception as e:
+        return encode_batch(
+            [], uuid=b"\0" * 16, error=f"decode error: {e}"
+        )
+    batch_fn = getattr(compute_fn, "batch", None)
+    with _spans.trace_context(trace_id), _spans.span(
+        "node.evaluate_batch", wire="npwire", transport="tcp",
+        n_items=len(items),
+    ) as root:
+        replies: List[Optional[bytes]] = [None] * len(items)
+        decoded = []  # (slot, arrays, uuid)
+        for i, item in enumerate(items):
+            try:
+                arrays, uid, _, _ = decode_arrays_ex(item)
+                decoded.append((i, arrays, uid))
+            except Exception as e:
+                replies[i] = encode_arrays(
+                    [], uuid=b"\0" * 16, error=f"decode error: {e}"
+                )
+        # Single source for dispatch semantics (vmapped-first, result
+        # count validation, scalar fallback, per-item isolation):
+        # batching.execute_window_sync — the sync twin of the gRPC
+        # service's MicroBatcher path.
+        outcomes = _execute_window_sync(
+            compute_fn, batch_fn, [arrs for _, arrs, _ in decoded]
+        )
+        for (i, _arrs, uid), res in zip(decoded, outcomes):
+            if isinstance(res, Exception):
+                _flightrec.record(
+                    "server.error", stage="compute", wire="npwire",
+                    transport="tcp", error=str(res)[:200],
+                )
+                replies[i] = encode_arrays([], uuid=uid, error=str(res))
+            else:
+                replies[i] = encode_arrays(
+                    [np.asarray(o) for o in res], uuid=uid
+                )
+        reply = encode_batch(replies, uuid=outer_uuid)
+    if trace_id is not None and root.span is not None:
+        reply = append_spans(reply, [root.span.to_dict()])
+    return reply
+
 
 def serve_tcp_once(
     compute_fn: Callable[..., Sequence[np.ndarray]],
@@ -370,9 +648,13 @@ def serve_tcp_once(
     The in-language peer of ``native/cpp_node.cpp`` — used to test the
     client without a compiler, and as a template for third-language
     nodes.  Serves connections sequentially; each connection processes
-    lock-step frames until the peer disconnects.  ``port=0`` binds an
-    ephemeral port reported through ``ready_callback``.
-    ``max_connections`` bounds the accept loop (None = forever).
+    lock-step frames until the peer disconnects.  Batch frames (npwire
+    flag bit 8) are served with per-item error isolation; a compute_fn
+    carrying a ``.batch`` attribute (``device_compute_fn(...,
+    batched=True)``) executes same-signature windows as one vmapped
+    call.  ``port=0`` binds an ephemeral port reported through
+    ``ready_callback``.  ``max_connections`` bounds the accept loop
+    (None = forever).
     """
     with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as srv:
         srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -391,6 +673,11 @@ def serve_tcp_once(
                         payload = _recv_frame(conn)
                     except (ConnectionError, OSError):
                         break
+                    if is_batch_frame(payload):
+                        _send_frame(
+                            conn, _serve_batch_payload(compute_fn, payload)
+                        )
+                        continue
                     arrays, uid, _, trace_id = decode_arrays_ex(payload)
                     # Node-side spans adopt the driver's wire trace id,
                     # same contract as the gRPC server (server.py).

@@ -1,0 +1,99 @@
+"""Property-based batch-frame tests (hypothesis; own file so the
+importorskip cannot skip the non-hypothesis batching suite —
+tests/test_batching.py — alongside it, mirroring the
+test_npwire_properties.py split)."""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
+from hypothesis.extra import numpy as hnp  # noqa: E402
+
+from pytensor_federated_tpu.service import npproto_codec
+from pytensor_federated_tpu.service.npwire import (
+    WireError,
+    decode_arrays_all,
+    decode_batch,
+    encode_arrays,
+    encode_batch,
+)
+
+
+COMMON = settings(max_examples=50, deadline=None)
+
+_dtypes = st.one_of(
+    hnp.integer_dtypes(endianness="="),
+    hnp.floating_dtypes(endianness="=", sizes=(32, 64)),
+    hnp.complex_number_dtypes(endianness="="),
+    st.just(np.dtype("bool")),
+)
+_arrays = _dtypes.flatmap(
+    lambda dt: hnp.arrays(
+        dtype=dt,
+        shape=hnp.array_shapes(min_dims=0, max_dims=3, min_side=0,
+                               max_side=6),
+    )
+)
+_requests = st.lists(st.lists(_arrays, min_size=0, max_size=3),
+                     min_size=0, max_size=5)
+
+
+@COMMON
+@given(reqs=_requests, err=st.none() | st.text(max_size=80))
+def test_batch_frames_roundtrip_ragged_mixes(reqs, err):
+    """(a) of the interop checklist: any mix of shapes/dtypes across
+    items — including zero-size and 0-d arrays — round-trips item-
+    and byte-exactly through a batch frame."""
+    items = [
+        encode_arrays(arrs, uuid=bytes([i]) * 16)
+        for i, arrs in enumerate(reqs)
+    ]
+    frame = encode_batch(items, uuid=b"o" * 16, error=err)
+    dec_items, uuid, error, _tid, _spans = decode_batch(frame)
+    assert dec_items == items and uuid == b"o" * 16 and error == err
+    for arrs, item in zip(reqs, dec_items):
+        dec, _u, _e, _t, _s = decode_arrays_all(item)
+        assert len(dec) == len(arrs)
+        for a, b in zip(arrs, dec):
+            assert a.dtype == b.dtype and a.shape == b.shape
+            np.testing.assert_array_equal(a, b)
+
+
+@COMMON
+@given(
+    reqs=_requests,
+    trace=st.none() | st.binary(min_size=16, max_size=16),
+    cut=st.floats(min_value=0.0, max_value=0.999),
+)
+def test_batch_truncation_never_silently_wrong(reqs, trace, cut):
+    items = [encode_arrays(arrs) for arrs in reqs]
+    frame = encode_batch(items, trace_id=trace)
+    prefix = frame[: int(len(frame) * cut)]
+    if prefix == frame:  # pragma: no cover - cut<1 guarantees strictness
+        return
+    with pytest.raises(WireError):
+        decode_batch(prefix)
+
+
+@COMMON
+@given(arrs=st.lists(_arrays, min_size=0, max_size=3))
+def test_unbatched_encode_unchanged_by_feature(arrs):
+    """(b): the plain frame under BOTH codecs is byte-identical to the
+    PR-2 format — encode with every new knob at its default equals the
+    layout-spec manual encoding (npwire) / the no-extension proto
+    encoding (npproto)."""
+    uuid = b"q" * 16
+    frame = encode_arrays(arrs, uuid=uuid)
+    assert frame[5] == 0  # no flag bits: no error/trace/spans/batch
+    # npproto: error=None emits nothing new
+    try:
+        msg = npproto_codec.encode_arrays_msg(arrs, uuid="qq")
+    except WireError:
+        return  # dtype outside the reference wire's str() round trip
+    assert msg == npproto_codec.encode_arrays_msg(
+        arrs, uuid="qq", trace_id=None, error=None
+    )
+    assert not npproto_codec.has_batch_items(msg)
+
+

@@ -1,0 +1,799 @@
+"""Micro-batching engine + batched wire frames (ISSUE 3).
+
+Three layers under test, mirroring the feature's structure:
+
+- the :class:`MicroBatcher` coalescing engine and the vmapped
+  padded-bucket compute variant (pure in-process);
+- the batch frame formats (npwire flag bit 8 / npproto field 17) —
+  round trips, loud failure, and the PR-2 byte-identity invariant for
+  unbatched frames;
+- end-to-end over real transports: a spawned gRPC node (capability
+  advertisement, batched evaluate_many, per-item error isolation for a
+  corrupt request inside a batch) and the in-thread TCP server (probe
+  negotiation, adaptive in-flight cap regression).
+"""
+
+import asyncio
+import socket
+import struct
+import threading
+import time
+
+import grpc
+import numpy as np
+import pytest
+from conftest import spawn_node_procs, wait_nodes_up
+
+from pytensor_federated_tpu.service import npproto_codec
+from pytensor_federated_tpu.service.batching import (
+    MicroBatcher,
+    _bucket,
+    batched_compute_fn,
+)
+from pytensor_federated_tpu.service.npwire import (
+    WireError,
+    decode_arrays_all,
+    decode_arrays_ex,
+    decode_batch,
+    encode_arrays,
+    encode_batch,
+    is_batch_frame,
+)
+
+BASE_PORT = 29700
+
+
+# ---------------------------------------------------------------------------
+# MicroBatcher engine
+# ---------------------------------------------------------------------------
+
+
+def _quad(x):
+    x = np.asarray(x)
+    if np.any(x > 1e6):
+        raise ValueError("poisoned input")
+    return [
+        np.asarray(-np.sum((x - 3.0) ** 2)),
+        (-2.0 * (x - 3.0)).astype(x.dtype),
+    ]
+
+
+class _CountingBatch:
+    """Vectorized twin of _quad that counts its invocations."""
+
+    def __init__(self):
+        self.calls = 0
+        self.sizes = []
+
+    def __call__(self, requests):
+        self.calls += 1
+        self.sizes.append(len(requests))
+        xs = np.stack([np.asarray(r[0]) for r in requests])
+        if np.any(xs > 1e6):
+            raise ValueError("poisoned batch")
+        return [
+            [np.asarray(-np.sum((x - 3.0) ** 2)),
+             (-2.0 * (x - 3.0)).astype(x.dtype)]
+            for x in xs
+        ]
+
+
+def test_idle_single_request_dispatches_immediately():
+    """A lone request must not wait for max_wait_us: with a huge
+    configured wait, the submit still returns in a fraction of it."""
+    batch_fn = _CountingBatch()
+    mb = MicroBatcher(
+        _quad, batch_fn, max_batch=8, max_wait_us=200_000.0, inline=True
+    )
+
+    async def run():
+        t0 = time.perf_counter()
+        out = await mb.submit((np.array([1.0, 5.0]),))
+        return time.perf_counter() - t0, out
+
+    elapsed, out = asyncio.run(run())
+    np.testing.assert_allclose(out[0], -8.0)
+    assert elapsed < 0.05  # 200 ms wait would trip this 40x over
+    assert batch_fn.calls == 0  # single request takes the scalar path
+
+
+def test_window_coalesces_into_one_vmapped_call():
+    batch_fn = _CountingBatch()
+    mb = MicroBatcher(_quad, batch_fn, max_batch=32, inline=True)
+    reqs = [(np.array([float(i), 5.0]),) for i in range(6)]
+
+    async def run():
+        return await mb.submit_many(reqs)
+
+    res = asyncio.run(run())
+    assert batch_fn.calls == 1 and batch_fn.sizes == [6]
+    for i, out in enumerate(res):
+        np.testing.assert_allclose(out[0], -((i - 3.0) ** 2 + 4.0))
+
+
+def test_poisoned_item_fails_only_its_own_slot():
+    """Batched execution fails -> scalar re-execution isolates the
+    poison: siblings get results, the poisoned slot gets ITS error."""
+    batch_fn = _CountingBatch()
+    mb = MicroBatcher(_quad, batch_fn, max_batch=32, inline=True)
+    reqs = [(np.array([float(i), 5.0]),) for i in range(5)]
+    reqs[2] = (np.array([np.inf, 5.0]) * 1e7,)
+
+    async def run():
+        return await mb.submit_many(reqs)
+
+    res = asyncio.run(run())
+    assert isinstance(res[2], ValueError)
+    assert mb.n_fallbacks == 1
+    for i in (0, 1, 3, 4):
+        np.testing.assert_allclose(
+            res[i][0], -((i - 3.0) ** 2 + 4.0)
+        )
+
+
+def test_mixed_signatures_group_separately():
+    batch_fn = _CountingBatch()
+    mb = MicroBatcher(_quad, batch_fn, max_batch=32, max_wait_us=0.0,
+                      inline=True)
+    reqs = [
+        (np.array([0.0, 5.0]),),
+        (np.array([1.0, 2.0, 3.0]),),
+        (np.array([1.0, 5.0]),),
+        (np.array([4.0, 5.0, 6.0]),),
+    ]
+
+    async def run():
+        return await mb.submit_many(reqs)
+
+    res = asyncio.run(run())
+    # Two signature groups of two -> two vmapped calls, results in
+    # the ORIGINAL order despite the regrouping.
+    assert batch_fn.sizes == [2, 2]
+    np.testing.assert_allclose(res[1][0], _quad(reqs[1][0])[0])
+    np.testing.assert_allclose(res[3][0], _quad(reqs[3][0])[0])
+
+
+def test_max_batch_splits_oversized_windows():
+    batch_fn = _CountingBatch()
+    mb = MicroBatcher(_quad, batch_fn, max_batch=4, max_wait_us=0.0,
+                      inline=True)
+    reqs = [(np.array([float(i), 5.0]),) for i in range(10)]
+    asyncio.run(mb.submit_many(reqs))
+    assert all(s <= 4 for s in batch_fn.sizes)
+    assert sum(batch_fn.sizes) + (mb.n_dispatched - sum(batch_fn.sizes)) == 10
+
+
+def test_stats_shape():
+    mb = MicroBatcher(_quad, None, max_batch=16, inline=True)
+    asyncio.run(mb.submit((np.zeros(2),)))
+    stats = mb.stats()
+    assert stats["max_batch"] == 16
+    assert stats["dispatched_total"] == 1
+    assert stats["queue_depth"] == 0
+
+
+def test_bucket_ladder():
+    assert [_bucket(k, 32) for k in (1, 2, 3, 5, 9, 31, 32)] == [
+        1, 2, 4, 8, 16, 32, 32,
+    ]
+    # cap below k: never shrinks below k itself
+    assert _bucket(7, 4) == 7
+
+
+def test_batched_compute_fn_matches_scalar():
+    import jax.numpy as jnp
+
+    def fn(x):
+        return [jnp.sum((x - 3.0) ** 2), x * 2.0]
+
+    bfn = batched_compute_fn(fn, max_batch=16)
+    for k in (1, 2, 3, 5, 8):  # ragged sizes across bucket boundaries
+        reqs = [(np.arange(4.0) + i,) for i in range(k)]
+        outs = bfn(reqs)
+        assert len(outs) == k
+        for i, out in enumerate(outs):
+            np.testing.assert_allclose(
+                out[0], np.sum((np.arange(4.0) + i - 3.0) ** 2)
+            )
+            np.testing.assert_allclose(out[1], (np.arange(4.0) + i) * 2)
+
+
+def test_batched_compute_fn_chunks_oversized_windows():
+    """A window larger than the fn's own max_batch (e.g. a service
+    configured with a bigger cap) chunks instead of leaking
+    non-power-of-two padded shapes into the jit cache."""
+    import jax.numpy as jnp
+
+    bfn = batched_compute_fn(lambda x: [x * 2.0], max_batch=4)
+    reqs = [(np.arange(3.0) + i,) for i in range(10)]
+    outs = bfn(reqs)
+    assert len(outs) == 10
+    for i, out in enumerate(outs):
+        np.testing.assert_allclose(out[0], (np.arange(3.0) + i) * 2)
+
+
+def test_tcp_server_survives_wrong_count_batch_fn():
+    """A user batch_fn returning the wrong result count must trigger
+    the scalar fallback (correct per-item replies), not crash the
+    node."""
+
+    def compute(x):
+        return _quad(x)
+
+    def bad_batch(requests):  # returns padded-bucket count, not k
+        xs = np.stack([np.asarray(r[0]) for r in requests])
+        return [[np.asarray(0.0)]] * (len(requests) + 3)
+
+    compute.batch = bad_batch
+    port, _t = _tcp_server(compute)
+    from pytensor_federated_tpu.service import TcpArraysClient
+
+    client = TcpArraysClient("127.0.0.1", port)
+    reqs = [(np.array([float(i), 5.0]),) for i in range(5)]
+    res = client.evaluate_many(reqs, window=8, batch=True)
+    for i in range(5):  # fallback produced the SCALAR path's results
+        np.testing.assert_allclose(res[i][0], -((i - 3.0) ** 2 + 4.0))
+    client.close()
+
+
+# ---------------------------------------------------------------------------
+# Wire formats
+# ---------------------------------------------------------------------------
+
+
+def test_batch_frame_roundtrip_and_plain_decoder_rejects():
+    items = [
+        encode_arrays([np.arange(3.0)], uuid=b"a" * 16),
+        encode_arrays([], uuid=b"b" * 16, error="boom"),
+    ]
+    frame = encode_batch(items, uuid=b"o" * 16, trace_id=b"t" * 16)
+    assert is_batch_frame(frame) and not is_batch_frame(items[0])
+    dec, uuid, err, tid, spans = decode_batch(frame)
+    assert dec == items and uuid == b"o" * 16 and err is None
+    assert tid == b"t" * 16 and spans is None
+    with pytest.raises(WireError, match="batch frame"):
+        decode_arrays_all(frame)
+    with pytest.raises(WireError):
+        decode_batch(items[0])  # a plain frame is not a batch
+
+
+def test_zero_item_batch_is_legal_probe():
+    frame = encode_batch([], uuid=b"p" * 16)
+    items, uuid, err, tid, spans = decode_batch(frame)
+    assert items == [] and uuid == b"p" * 16 and err is None
+
+
+def test_unbatched_frame_byte_identical_to_pr2_layout():
+    """The PR-2 wire, re-derived from its documented layout by hand:
+    an encode_arrays frame with no error/trace/spans must be byte-
+    identical — growing batch support cannot have moved a single byte
+    of the plain format."""
+    arrays = [np.arange(6, dtype=np.float32).reshape(2, 3),
+              np.asarray(3.5)]
+    uuid = b"u" * 16
+    manual = [struct.pack("<4sBB16sI", b"NPW1", 1, 0, uuid, len(arrays))]
+    for a in arrays:
+        dt = a.dtype.str.encode("ascii")
+        manual.append(struct.pack("<H", len(dt)))
+        manual.append(dt)
+        manual.append(struct.pack("<B", a.ndim))
+        manual.append(struct.pack(f"<{a.ndim}Q", *a.shape))
+        data = a.tobytes()
+        manual.append(struct.pack("<Q", len(data)))
+        manual.append(data)
+    assert encode_arrays(arrays, uuid=uuid) == b"".join(manual)
+
+
+def test_npproto_plain_msg_byte_identical_without_batch_fields():
+    """encode_arrays_msg with error=None must emit the exact pre-batch
+    bytes (no field 14/17 anywhere)."""
+    arrays = [np.arange(4.0)]
+    enc = npproto_codec.encode_arrays_msg(arrays, uuid="u-1")
+    # No field-14 (tag 0x72) / field-17 (tag 0x8a 0x01) headers appear:
+    # decode sees no error and no batch items.
+    _a, _u, err, _t, _s = npproto_codec.decode_arrays_msg_full(enc)
+    assert err is None
+    assert not npproto_codec.has_batch_items(enc)
+
+
+def test_npproto_batch_msg_roundtrip():
+    items = [
+        npproto_codec.encode_arrays_msg([np.arange(3.0)], uuid="i0"),
+        npproto_codec.encode_arrays_msg([], uuid="i1", error="bad"),
+    ]
+    msg = npproto_codec.encode_batch_msg(items, uuid="outer",
+                                         trace_id=b"t" * 16)
+    assert npproto_codec.has_batch_items(msg)
+    dec, uuid, tid, spans = npproto_codec.decode_batch_msg(msg)
+    assert dec == items and uuid == "outer" and tid == b"t" * 16
+    _arrs, u1, err1, _t, _s = npproto_codec.decode_arrays_msg_full(
+        items[1]
+    )
+    assert u1 == "i1" and err1 == "bad"
+
+
+# ---------------------------------------------------------------------------
+# Official protobuf runtime interop while batching is active
+# ---------------------------------------------------------------------------
+
+official = pytest.importorskip("google.protobuf", reason="cross-check")
+
+
+def _official_output_arrays():
+    from google.protobuf import (
+        descriptor_pb2,
+        descriptor_pool,
+        message_factory,
+    )
+
+    pool = descriptor_pool.DescriptorPool()
+    fdp = descriptor_pb2.FileDescriptorProto()
+    fdp.name = "batchx.proto"
+    fdp.package = "batchx"
+    fdp.syntax = "proto3"
+    F = descriptor_pb2.FieldDescriptorProto
+    nd = fdp.message_type.add()
+    nd.name = "ndarray"
+    for name, num, ftype, label in [
+        ("data", 1, F.TYPE_BYTES, F.LABEL_OPTIONAL),
+        ("dtype", 2, F.TYPE_STRING, F.LABEL_OPTIONAL),
+        ("shape", 3, F.TYPE_INT64, F.LABEL_REPEATED),
+        ("strides", 4, F.TYPE_INT64, F.LABEL_REPEATED),
+    ]:
+        f = nd.field.add()
+        f.name, f.number, f.type, f.label = name, num, ftype, label
+    m = fdp.message_type.add()
+    m.name = "OutputArrays"
+    f = m.field.add()
+    f.name, f.number, f.type, f.label = (
+        "items", 1, F.TYPE_MESSAGE, F.LABEL_REPEATED,
+    )
+    f.type_name = ".batchx.ndarray"
+    f = m.field.add()
+    f.name, f.number, f.type, f.label = (
+        "uuid", 2, F.TYPE_STRING, F.LABEL_OPTIONAL,
+    )
+    pool.Add(fdp)
+    return message_factory.GetMessageClass(
+        pool.FindMessageTypeByName("batchx.OutputArrays")
+    )
+
+
+def test_official_runtime_parses_replies_with_batching_active():
+    """(c): while batching is active, every npproto artifact a
+    reference runtime could see still parses under the OFFICIAL
+    protobuf runtime with the known fields intact — per-item error
+    (14), trace (15), spans (16) and batch items (17) are all skipped
+    as unknown fields."""
+    Out = _official_output_arrays()
+    # A batch reply item carrying results + the error extension.
+    item = npproto_codec.encode_arrays_msg(
+        [np.arange(3.0)], uuid="item-0", error="err text"
+    )
+    msg = Out()
+    msg.ParseFromString(item)
+    assert msg.uuid == "item-0" and len(msg.items) == 1
+    # A whole batch reply: unknown field 17 only + uuid.
+    batch = npproto_codec.encode_batch_msg(
+        [item, item], uuid="outer-1", trace_id=b"t" * 16
+    )
+    msg2 = Out()
+    msg2.ParseFromString(batch)
+    assert msg2.uuid == "outer-1" and len(msg2.items) == 0
+    # With piggybacked spans appended (field 16), still parseable.
+    with_spans = npproto_codec.append_spans_msg(batch, [{"name": "s"}])
+    msg3 = Out()
+    msg3.ParseFromString(with_spans)
+    assert msg3.uuid == "outer-1"
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: gRPC node
+# ---------------------------------------------------------------------------
+
+
+def _serve_batched_node(port):
+    import logging
+
+    logging.basicConfig(level=logging.WARNING)
+    import numpy as np  # noqa: F811 (spawned child)
+
+    def compute(x):
+        x = np.asarray(x)
+        if np.any(x < -1e6):
+            raise ValueError("poisoned input")
+        return [
+            np.asarray(-np.sum((x - 3.0) ** 2)),
+            (-2.0 * (x - 3.0)).astype(x.dtype),
+        ]
+
+    def compute_batch(requests):
+        xs = np.stack([np.asarray(r[0]) for r in requests])
+        if np.any(xs < -1e6):
+            raise ValueError("poisoned batch")
+        logps = -np.sum((xs - 3.0) ** 2, axis=1)
+        grads = (-2.0 * (xs - 3.0)).astype(xs.dtype)
+        return [[np.asarray(lp), g] for lp, g in zip(logps, grads)]
+
+    compute.batch = compute_batch
+
+    from pytensor_federated_tpu.service import run_node
+
+    run_node(compute, "127.0.0.1", port, inline_compute=True)
+
+
+@pytest.fixture(scope="module")
+def batched_node():
+    port = BASE_PORT
+    procs = spawn_node_procs(_serve_batched_node, [(port,)])
+    wait_nodes_up([port], timeout=60)
+    yield port
+    for p in procs:
+        p.terminate()
+    for p in procs:
+        p.join(timeout=5)
+
+
+def test_server_advertises_batch_capability(batched_node):
+    from pytensor_federated_tpu.service import get_load_async
+
+    load = asyncio.run(get_load_async("127.0.0.1", batched_node))
+    assert isinstance(load.get("batch"), dict)
+    assert load["batch"]["max_batch"] == 32
+    assert "queue_depth" in load["batch"]
+    assert "dispatched_total" in load["batch"]
+
+
+def test_batched_evaluate_many_matches_per_call(batched_node):
+    from pytensor_federated_tpu.service import ArraysToArraysServiceClient
+
+    client = ArraysToArraysServiceClient("127.0.0.1", batched_node)
+    reqs = [(np.array([float(i), 5.0]),) for i in range(20)]
+    per_call = [client.evaluate(*args) for args in reqs[:3]]
+    batched = client.evaluate_many(reqs, window=8, batch=True)
+    plain = client.evaluate_many(reqs, window=8, batch=False)
+    for i in range(3):
+        np.testing.assert_allclose(batched[i][0], per_call[i][0])
+    for b, p in zip(batched, plain):
+        np.testing.assert_allclose(b[0], p[0])
+        np.testing.assert_allclose(b[1], p[1])
+
+
+def test_auto_mode_batches_and_connection_survives_compute_error(
+    batched_node,
+):
+    from pytensor_federated_tpu.service import ArraysToArraysServiceClient
+
+    client = ArraysToArraysServiceClient("127.0.0.1", batched_node)
+    reqs = [(np.array([float(i), 5.0]),) for i in range(6)]
+    ok = client.evaluate_many(reqs, window=4)  # auto -> batched
+    np.testing.assert_allclose(ok[5][0], -(4.0 + 4.0))
+    poisoned = list(reqs)
+    poisoned[2] = (np.array([-1e9, 5.0]),)
+    with pytest.raises(RuntimeError, match="server error"):
+        client.evaluate_many(poisoned, window=4)
+    # The connection stays correlated for the NEXT call.
+    again = client.evaluate_many(reqs, window=4)
+    np.testing.assert_allclose(again[0][0], -(9.0 + 4.0))
+
+
+def test_npproto_codec_batches_toward_own_node(batched_node):
+    from pytensor_federated_tpu.service import ArraysToArraysServiceClient
+
+    client = ArraysToArraysServiceClient(
+        "127.0.0.1", batched_node, codec="npproto"
+    )
+    reqs = [(np.array([float(i), 5.0]),) for i in range(7)]
+    res = client.evaluate_many(reqs, window=4, batch=True)
+    np.testing.assert_allclose(res[6][0], -(9.0 + 4.0))
+
+
+def test_corrupt_item_in_batch_fails_only_its_own_reply(batched_node):
+    """The e2e isolation acceptance: a batch frame with one CORRUPT
+    item (truncated npwire bytes) comes back with that slot carrying a
+    decode error and every sibling carrying real results."""
+    good0 = encode_arrays([np.array([0.0, 5.0])], uuid=b"0" * 16)
+    good1 = encode_arrays([np.array([1.0, 5.0])], uuid=b"1" * 16)
+    corrupt = good0[: len(good0) - 3]  # truncated mid-payload
+    frame = encode_batch([good0, corrupt, good1], uuid=b"o" * 16)
+
+    async def call():
+        async with grpc.aio.insecure_channel(
+            f"127.0.0.1:{batched_node}"
+        ) as channel:
+            method = channel.unary_unary(
+                "/ArraysToArraysService/Evaluate",
+                request_serializer=lambda b: b,
+                response_deserializer=lambda b: b,
+            )
+            return await method(frame)
+
+    reply = asyncio.run(call())
+    items, uuid, err, _tid, _spans = decode_batch(reply)
+    assert uuid == b"o" * 16 and err is None and len(items) == 3
+    out0, u0, e0, _, _ = decode_arrays_all(items[0])
+    out1, u1, e1, _, _ = decode_arrays_all(items[1])
+    out2, u2, e2, _, _ = decode_arrays_all(items[2])
+    assert e0 is None and u0 == b"0" * 16
+    np.testing.assert_allclose(out0[0], -(9.0 + 4.0))
+    assert e1 is not None and "decode error" in e1
+    assert e2 is None and u2 == b"1" * 16
+    np.testing.assert_allclose(out2[0], -(4.0 + 4.0))
+
+
+def test_reference_wire_client_interops_unchanged(batched_node):
+    """Acceptance: an official-runtime-style plain npproto request
+    against a batching-enabled server gets a plain npproto reply (no
+    batch fields), exactly as before the feature."""
+    Out = _official_output_arrays()
+    request = npproto_codec.encode_arrays_msg(
+        [np.array([1.0, 5.0])], uuid="ref-1"
+    )
+
+    async def call():
+        async with grpc.aio.insecure_channel(
+            f"127.0.0.1:{batched_node}"
+        ) as channel:
+            method = channel.unary_unary(
+                "/ArraysToArraysService/Evaluate",
+                request_serializer=lambda b: b,
+                response_deserializer=lambda b: b,
+            )
+            return await method(request)
+
+    reply = asyncio.run(call())
+    msg = Out()
+    msg.ParseFromString(reply)
+    assert msg.uuid == "ref-1" and len(msg.items) == 2
+    logp = np.ndarray(buffer=msg.items[0].data, shape=(),
+                      dtype=msg.items[0].dtype)
+    np.testing.assert_allclose(logp, -8.0)
+
+
+def _serve_plain_executor_node(port):
+    """A node with NO vectorized variant and NO inline_compute: the
+    coalescing engine does not engage (slow computes keep per-request
+    executor concurrency), but batch frames are still advertised and
+    served."""
+    import logging
+
+    logging.basicConfig(level=logging.WARNING)
+    import numpy as np  # noqa: F811 (spawned child)
+
+    def compute(x):
+        x = np.asarray(x)
+        return [np.asarray(-np.sum((x - 3.0) ** 2)),
+                (-2.0 * (x - 3.0)).astype(x.dtype)]
+
+    from pytensor_federated_tpu.service import run_node
+
+    run_node(compute, "127.0.0.1", port)
+
+
+@pytest.fixture(scope="module")
+def plain_executor_node():
+    port = BASE_PORT + 1
+    procs = spawn_node_procs(_serve_plain_executor_node, [(port,)])
+    wait_nodes_up([port], timeout=60)
+    yield port
+    for p in procs:
+        p.terminate()
+    for p in procs:
+        p.join(timeout=5)
+
+
+def test_unengaged_engine_still_serves_batch_frames(plain_executor_node):
+    from pytensor_federated_tpu.service import (
+        ArraysToArraysServiceClient,
+        get_load_async,
+    )
+
+    load = asyncio.run(get_load_async("127.0.0.1", plain_executor_node))
+    assert load["batch"]["max_batch"] == 32  # capability advertised
+    assert "dispatched_total" not in load["batch"]  # engine not engaged
+    client = ArraysToArraysServiceClient("127.0.0.1", plain_executor_node)
+    reqs = [(np.array([float(i), 5.0]),) for i in range(9)]
+    res = client.evaluate_many(reqs, window=4, batch=True)
+    for i in range(9):
+        np.testing.assert_allclose(res[i][0], -((i - 3.0) ** 2 + 4.0))
+
+
+# ---------------------------------------------------------------------------
+# TCP lane: probe negotiation + adaptive in-flight cap
+# ---------------------------------------------------------------------------
+
+
+def _tcp_server(compute, n_conn=1):
+    from pytensor_federated_tpu.service import serve_tcp_once
+
+    ready = {}
+    ev = threading.Event()
+
+    def cb(p):
+        ready["port"] = p
+        ev.set()
+
+    t = threading.Thread(
+        target=serve_tcp_once,
+        args=(compute,),
+        kwargs=dict(ready_callback=cb, max_connections=n_conn),
+        daemon=True,
+    )
+    t.start()
+    assert ev.wait(10)
+    return ready["port"], t
+
+
+def test_tcp_probe_and_batched_window():
+    from pytensor_federated_tpu.service import TcpArraysClient
+
+    port, _t = _tcp_server(_quad)
+    client = TcpArraysClient("127.0.0.1", port)
+    reqs = [(np.array([float(i), 5.0]),) for i in range(9)]
+    res = client.evaluate_many(reqs, window=4)  # auto -> probe -> batch
+    assert client._batch_ok is True
+    np.testing.assert_allclose(res[8][0], -(25.0 + 4.0))
+    client.close()
+    assert client._batch_ok is None  # re-probed after reconnect
+
+
+def test_tcp_vmapped_batch_on_server_side():
+    """serve_tcp_once drives the compute's .batch variant for a same-
+    signature window (counted), with results identical to scalar."""
+    batch_fn = _CountingBatch()
+
+    def compute(x):
+        return _quad(x)
+
+    compute.batch = batch_fn
+    port, _t = _tcp_server(compute)
+    from pytensor_federated_tpu.service import TcpArraysClient
+
+    client = TcpArraysClient("127.0.0.1", port)
+    reqs = [(np.array([float(i), 5.0]),) for i in range(6)]
+    res = client.evaluate_many(reqs, window=8, batch=True)
+    np.testing.assert_allclose(res[3][0], -4.0)
+    assert batch_fn.calls >= 1 and max(batch_fn.sizes) > 1
+    client.close()
+
+
+def test_tcp_large_requests_still_overlap():
+    """Regression for the hardcoded 32 KiB cap: a window of requests
+    each LARGER than 32 KiB must still pipeline (>1 frame in flight).
+    The server reads TWO frames before sending the first reply — a
+    lock-stepped client (old cap) can never satisfy that and would
+    time out; the adaptive cap ships both frames up front."""
+    result = {}
+
+    def server(sock_ready):
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        sock_ready(srv.getsockname()[1])
+        conn, _ = srv.accept()
+        conn.settimeout(10.0)
+
+        def read_frame():
+            hdr = b""
+            while len(hdr) < 4:
+                hdr += conn.recv(4 - len(hdr))
+            (n,) = struct.unpack("<I", hdr)
+            buf = b""
+            while len(buf) < n:
+                buf += conn.recv(min(65536, n - len(buf)))
+            return buf
+
+        try:
+            frames = [read_frame(), read_frame()]  # BOTH before reply
+            result["overlapped"] = True
+        except socket.timeout:  # pragma: no cover - the failure mode
+            result["overlapped"] = False
+            conn.close()
+            srv.close()
+            return
+        for payload in frames:
+            _arrays, uid, _e, _t = decode_arrays_ex(payload)
+            reply = encode_arrays([np.asarray(0.0)], uuid=uid)
+            conn.sendall(struct.pack("<I", len(reply)) + reply)
+        conn.close()
+        srv.close()
+
+    ready = {}
+    ev = threading.Event()
+    t = threading.Thread(
+        target=server,
+        args=(lambda p: (ready.update(p=p), ev.set()),),
+        daemon=True,
+    )
+    t.start()
+    assert ev.wait(10)
+    from pytensor_federated_tpu.service import TcpArraysClient
+
+    client = TcpArraysClient("127.0.0.1", ready["p"])
+    big = np.zeros(20_000, dtype=np.float64)  # ~160 KiB per request
+    res = client.evaluate_many([(big,), (big,)], window=2, batch=False)
+    t.join(timeout=10)
+    assert result.get("overlapped") is True
+    assert len(res) == 2
+    client.close()
+
+
+def test_tcp_explicit_inflight_knob_restores_lockstep():
+    """max_inflight_bytes as a constructor knob: pinning it small
+    forces the proven-safe lock-step mode (one frame in flight)."""
+    from pytensor_federated_tpu.service import TcpArraysClient
+
+    port, _t = _tcp_server(_quad)
+    client = TcpArraysClient(
+        "127.0.0.1", port, max_inflight_bytes=1
+    )
+    reqs = [(np.array([float(i), 5.0]),) for i in range(4)]
+    res = client.evaluate_many(reqs, window=4, batch=False)
+    np.testing.assert_allclose(res[3][0], -4.0)
+    client.close()
+
+
+# ---------------------------------------------------------------------------
+# Fanout coalescing
+# ---------------------------------------------------------------------------
+
+
+def test_coalescing_caller_merges_member_threads():
+    from pytensor_federated_tpu.fanout_exec import CoalescingCaller
+
+    calls = []
+
+    def evaluate_many(reqs):
+        calls.append(len(reqs))
+        return [np.sum(args[0]) for args in reqs]
+
+    caller = CoalescingCaller(evaluate_many, width=4, max_wait_s=2.0)
+    results = [None] * 4
+
+    def member(i):
+        results[i] = caller.evaluate(np.full(3, float(i)))
+
+    threads = [
+        threading.Thread(target=member, args=(i,)) for i in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert calls == [4]  # ONE batched call for four member threads
+    for i in range(4):
+        np.testing.assert_allclose(results[i], 3.0 * i)
+
+
+def test_coalescing_caller_propagates_errors_to_all_members():
+    from pytensor_federated_tpu.fanout_exec import CoalescingCaller
+
+    def evaluate_many(reqs):
+        raise RuntimeError("node down")
+
+    caller = CoalescingCaller(evaluate_many, width=2, max_wait_s=0.5)
+    errors = []
+
+    def member():
+        try:
+            caller.evaluate(np.zeros(2))
+        except RuntimeError as e:
+            errors.append(str(e))
+
+    threads = [threading.Thread(target=member) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert errors == ["node down", "node down"]
+
+
+def test_coalescing_caller_lone_call_after_timeout():
+    from pytensor_federated_tpu.fanout_exec import CoalescingCaller
+
+    caller = CoalescingCaller(
+        lambda reqs: [len(r) for r in reqs], width=8, max_wait_s=0.01
+    )
+    t0 = time.perf_counter()
+    assert caller.evaluate(np.zeros(1), np.zeros(1)) == 2
+    assert time.perf_counter() - t0 < 5.0  # timed out the window, ran solo

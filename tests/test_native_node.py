@@ -13,6 +13,7 @@ Requires g++ (skips otherwise); builds via make -C native.
 
 import math
 import shutil
+import struct
 import subprocess
 import sys
 import threading
@@ -164,6 +165,71 @@ class TestCppNode:
         want, _, _ = ref_logp_grad(0.0, 2.0, 1.0, x, y)
         np.testing.assert_allclose(float(out[0]), want, rtol=1e-12)
         client.close()
+
+    def test_batch_frames_negotiated_and_match_sequential(self, cpp_node):
+        """The node answers the zero-item probe (capability yes) and a
+        batched window — K requests in ONE wire frame — returns
+        exactly the per-call results."""
+        from pytensor_federated_tpu.service import TcpArraysClient
+
+        rng = np.random.default_rng(7)
+        x = rng.normal(size=32)
+        y = 2.0 * x
+        client = TcpArraysClient("127.0.0.1", cpp_node)
+        assert client._probe_batch() is True
+        reqs = [
+            (np.float64(0.1), np.float64(i * 0.2), np.float64(1.0), x, y)
+            for i in range(11)
+        ]
+        batched = client.evaluate_many(reqs, window=4, batch=True)
+        plain = client.evaluate_many(reqs, window=4, batch=False)
+        for b, p in zip(batched, plain):
+            for ab, ap in zip(b, p):
+                np.testing.assert_array_equal(
+                    np.asarray(ab), np.asarray(ap)
+                )
+        client.close()
+
+    def test_batch_poisoned_item_isolated_on_the_wire(self, cpp_node):
+        """One wrong-arity item inside a batch frame fails only ITS
+        reply slot; siblings carry real results (raw-frame check, so
+        the per-item isolation is proven at the wire, not masked by
+        the client's first-error raise)."""
+        import socket as socket_mod
+
+        from pytensor_federated_tpu.service.npwire import (
+            decode_arrays_all,
+            decode_batch,
+            encode_arrays,
+            encode_batch,
+        )
+
+        rng = np.random.default_rng(8)
+        x = rng.normal(size=16)
+        y = 2.0 * x
+        args = [np.float64(0.0), np.float64(2.0), np.float64(1.0), x, y]
+        good = encode_arrays([np.asarray(a) for a in args], uuid=b"g" * 16)
+        bad = encode_arrays([np.zeros(2)], uuid=b"b" * 16)  # wrong arity
+        frame = encode_batch([good, bad, good], uuid=b"o" * 16)
+        with socket_mod.create_connection(("127.0.0.1", cpp_node)) as s:
+            s.sendall(struct.pack("<I", len(frame)) + frame)
+            hdr = b""
+            while len(hdr) < 4:
+                hdr += s.recv(4 - len(hdr))
+            (rlen,) = struct.unpack("<I", hdr)
+            reply = b""
+            while len(reply) < rlen:
+                reply += s.recv(min(65536, rlen - len(reply)))
+        items, ruid, err, _tid, _sp = decode_batch(reply)
+        assert ruid == b"o" * 16 and err is None and len(items) == 3
+        out0, u0, e0, _, _ = decode_arrays_all(items[0])
+        _o1, u1, e1, _, _ = decode_arrays_all(items[1])
+        out2, _u2, e2, _, _ = decode_arrays_all(items[2])
+        assert e0 is None and e2 is None
+        assert e1 is not None and u1 == b"b" * 16
+        want, _, _ = ref_logp_grad(0.0, 2.0, 1.0, x, y)
+        np.testing.assert_allclose(float(out0[0]), want, rtol=1e-12)
+        np.testing.assert_allclose(float(out2[0]), want, rtol=1e-12)
 
     def test_error_reply(self, cpp_node):
         from pytensor_federated_tpu.service import (

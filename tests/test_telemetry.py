@@ -380,6 +380,50 @@ class TestExport:
         # unreachable endpoint: exit 1, not a traceback
         assert metrics_dump.main(["--port", str(_free_port())]) == 1
 
+    def test_metrics_dump_grep_prints_batcher_families(
+        self, tmp_path, capsys
+    ):
+        """--grep batch narrows both output modes to the micro-batcher
+        families (ISSUE 3 tooling satellite)."""
+        import importlib.util
+        import sys
+
+        spec = importlib.util.spec_from_file_location(
+            "metrics_dump",
+            Path(__file__).resolve().parent.parent
+            / "tools"
+            / "metrics_dump.py",
+        )
+        metrics_dump = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(metrics_dump)
+
+        # Register + populate the batcher families.
+        from pytensor_federated_tpu.service.batching import MicroBatcher
+
+        mb = MicroBatcher(lambda x: [x], None, max_batch=4, inline=True)
+        asyncio.run(mb.submit((np.zeros(2),)))
+        with telemetry.start_exporter(port=0) as exporter:
+            rc = metrics_dump.main(
+                ["--port", str(exporter.port), "--text", "--grep", "batch"]
+            )
+            assert rc == 0
+            text = capsys.readouterr().out
+            assert "pftpu_server_batch_size" in text
+            assert "pftpu_server_batches_total" in text
+            # the filter really filters: unrelated families are gone
+            assert "pftpu_server_requests_total" not in text
+            out = tmp_path / "batch.jsonl"
+            rc = metrics_dump.main(
+                [
+                    "--port", str(exporter.port),
+                    "--grep", "batch", "--out", str(out),
+                ]
+            )
+            assert rc == 0
+        rec = json.loads(out.read_text())
+        assert all("batch" in k for k in rec["metrics"])
+        assert "pftpu_server_batch_size" in rec["metrics"]
+
 
 # ---------------------------------------------------------------------------
 # trace id on the wire

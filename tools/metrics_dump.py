@@ -17,6 +17,14 @@ Usage:
     python tools/metrics_dump.py --port 9100 --traces        # /traces JSON
     python tools/metrics_dump.py --port 9100 --text          # /metrics text
     python tools/metrics_dump.py --port 9100 --out tools/telemetry.jsonl
+    python tools/metrics_dump.py --port 9100 --grep batch    # batcher families
+
+``--grep SUBSTR`` filters to metric families whose name contains
+SUBSTR — e.g. ``--grep batch`` prints the micro-batcher picture
+(``pftpu_server_batch_size``, ``pftpu_server_batch_wait_seconds``,
+``pftpu_server_batches_total``, ``pftpu_client_batch_frame_requests``)
+without the rest of the registry.  Works on both the text exposition
+and the JSON snapshot's ``metrics`` map.
 
 Exit status 0 on a successful scrape, 1 on an unreachable endpoint OR
 a malformed response (wrong JSON shape, non-exposition text) — so
@@ -37,6 +45,22 @@ import urllib.request
 def scrape(url: str, timeout: float) -> bytes:
     with urllib.request.urlopen(url, timeout=timeout) as resp:
         return resp.read()
+
+
+def _filter_exposition(text: str, substr: str) -> str:
+    """Keep only the exposition blocks of families whose name contains
+    ``substr``.  A block is the ``# HELP``/``# TYPE`` pair plus its
+    sample lines; family tracking keys off the HELP header so suffixed
+    sample names (_bucket/_sum/_count) follow their family."""
+    out = []
+    keep = False
+    for line in text.splitlines():
+        if line.startswith("# HELP "):
+            family = line.split(" ", 3)[2]
+            keep = substr in family
+        if keep:
+            out.append(line)
+    return "\n".join(out) + "\n" if out else ""
 
 
 def main(argv=None) -> int:
@@ -66,6 +90,14 @@ def main(argv=None) -> int:
         help="append the scrape as one JSON line to this file "
         "(default: pretty-print to stdout; ignored with --text)",
     )
+    ap.add_argument(
+        "--grep",
+        default=None,
+        metavar="SUBSTR",
+        help="only metric families whose name contains SUBSTR "
+        "(e.g. 'batch' for the micro-batcher families); applies to "
+        "--text and the snapshot's metrics map",
+    )
     ap.add_argument("--timeout", type=float, default=5.0)
     args = ap.parse_args(argv)
 
@@ -88,6 +120,8 @@ def main(argv=None) -> int:
                     file=sys.stderr,
                 )
                 return 1
+            if args.grep:
+                text = _filter_exposition(text, args.grep)
             sys.stdout.write(text)
             return 0
         body = scrape(base + route, args.timeout)
@@ -121,6 +155,15 @@ def main(argv=None) -> int:
                 file=sys.stderr,
             )
             return 1
+        if args.grep and isinstance(payload["metrics"], dict):
+            payload = {
+                **payload,
+                "metrics": {
+                    k: v
+                    for k, v in payload["metrics"].items()
+                    if args.grep in k
+                },
+            }
         rec = {"ts": time.time(), "endpoint": base, **payload}
 
     if args.out:
