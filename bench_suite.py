@@ -159,6 +159,35 @@ def _bench_serve_node(port):
     run_node(compute, "127.0.0.1", port, inline_compute=True)
 
 
+def _bench_serve_slow_node(port, delay_s):
+    """The DEGRADED pool member for config 13: same logp+grad shape,
+    but every compute blocks the event loop for ``delay_s`` (inline +
+    sleep, no vectorized variant) — the stand-in for a node that is
+    wedged-ish/overloaded: serial, ~1/delay_s req/s, and its GetLoad
+    replies queue behind the sleeps."""
+    import logging
+    import time as _time
+
+    import numpy as np
+
+    logging.basicConfig(level=logging.WARNING)
+    from pytensor_federated_tpu.utils import force_cpu_backend
+
+    force_cpu_backend()
+
+    def compute(x):
+        _time.sleep(delay_s)
+        x = np.asarray(x)
+        return [
+            np.asarray(-np.sum((x - 3.0) ** 2)),
+            (-2.0 * (x - 3.0)).astype(x.dtype),
+        ]
+
+    from pytensor_federated_tpu.service import run_node
+
+    run_node(compute, "127.0.0.1", port, inline_compute=True)
+
+
 def main():
     preflight()
     import jax
@@ -1130,6 +1159,194 @@ def main():
         )
 
     guard("parallel tempering bimodal", _c12)
+
+    # 13. Replica-POOL routing lane (ISSUE 4): the host lane served by
+    # a 3-replica pool with one member degraded to ~20 req/s (50 ms
+    # serial compute — the slow/wedged-node failure mode).  The rated
+    # quantity is DEGRADED-pool throughput vs the same run's
+    # all-healthy pool (acceptance: >= 0.7 — routing must shift work
+    # off the slow member), with two control lanes in the record: a
+    # client PINNED to the slow node (the pre-pool architecture, which
+    # collapses to the slow node's serial rate) and per-call tail
+    # latency with hedging off vs on (the hedge must cut the p99 that
+    # the slow member injects).
+    def _c13():
+        import multiprocessing as mp
+        import socket
+        import time as _time
+
+        import asyncio
+
+        from pytensor_federated_tpu.routing import (
+            NodePool,
+            PooledArraysClient,
+        )
+        from pytensor_federated_tpu.service import (
+            ArraysToArraysServiceClient,
+            get_loads_async,
+        )
+
+        def free_port():
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                return s.getsockname()[1]
+
+        slow_delay_s = 0.05
+        fast_ports = [free_port() for _ in range(3)]
+        slow_port = free_port()
+        ctx = mp.get_context("spawn")
+        procs = [
+            ctx.Process(
+                target=_bench_serve_node, args=(p,), daemon=True
+            )
+            for p in fast_ports
+        ] + [
+            ctx.Process(
+                target=_bench_serve_slow_node,
+                args=(slow_port, slow_delay_s),
+                daemon=True,
+            )
+        ]
+        for p in procs:
+            p.start()
+        try:
+            deadline = _time.time() + 60.0
+
+            async def wait_up():
+                ports = fast_ports + [slow_port]
+                while _time.time() < deadline:
+                    loads = await get_loads_async(
+                        [("127.0.0.1", p) for p in ports], timeout=1.0
+                    )
+                    if all(l is not None for l in loads):
+                        return
+                    await asyncio.sleep(0.2)
+                raise TimeoutError("pool bench nodes did not come up")
+
+            asyncio.run(wait_up())
+            x = np.zeros(3, np.float32)
+            reqs = [(x,)] * 256
+
+            def pooled_rps(ports):
+                pool = NodePool(
+                    [("127.0.0.1", p) for p in ports],
+                    breaker_kwargs=dict(
+                        failure_threshold=2, backoff_s=0.5
+                    ),
+                )
+                client = PooledArraysClient(pool)
+                # Warm: connect + teach the EWMA partitioner who is
+                # slow (the first window pays the slow shard once).
+                client.evaluate_many(reqs, window=32)
+                client.evaluate_many(reqs, window=32)
+                t0 = _time.perf_counter()
+                n = 0
+                while _time.perf_counter() - t0 < 1.5:
+                    client.evaluate_many(reqs, window=32)
+                    n += len(reqs)
+                return n / (_time.perf_counter() - t0)
+
+            rate_healthy = pooled_rps(fast_ports)
+            rate_degraded = pooled_rps(fast_ports[:2] + [slow_port])
+
+            # Control lane: the pre-pool architecture — one client
+            # pinned to the slow node (reference: pinned-after-connect,
+            # service.py:240-263) collapses to its serial rate.
+            pinned = ArraysToArraysServiceClient("127.0.0.1", slow_port)
+            pinned.evaluate(x)  # connect + warm
+            t0 = _time.perf_counter()
+            n_pin = 0
+            while _time.perf_counter() - t0 < 1.5:
+                pinned.evaluate(x)
+                n_pin += 1
+            rate_pinned = n_pin / (_time.perf_counter() - t0)
+
+            # Tail-latency lanes: round-robin over [fast, fast, slow]
+            # so every third call hits the slow member; the hedge must
+            # cut the p99 that member injects.  hedge_quantile=0.5:
+            # the latency mix is bimodal (~1 ms vs ~50 ms), so the
+            # median is the honest "usual call" deadline.
+            def percall_p99_ms(hedge):
+                pool = NodePool(
+                    [
+                        ("127.0.0.1", fast_ports[0]),
+                        ("127.0.0.1", fast_ports[1]),
+                        ("127.0.0.1", slow_port),
+                    ],
+                    policy="round_robin",
+                )
+                client = PooledArraysClient(
+                    pool, hedge=hedge, hedge_quantile=0.5
+                )
+                # Warmup OUTSIDE the measurement: the hedge deadline is
+                # estimated from observed latencies, so the first few
+                # calls of a fresh client are structurally unhedged —
+                # rating them would measure the estimator's fill time,
+                # not the steady-state tail.
+                for _ in range(12):
+                    client.evaluate(x)
+                lat = []
+                for i in range(150):
+                    t0 = _time.perf_counter()
+                    client.evaluate(x)
+                    lat.append(_time.perf_counter() - t0)
+                lat.sort()
+                return 1e3 * lat[int(0.99 * len(lat)) - 1]
+
+            p99_unhedged_ms = percall_p99_ms(False)
+            p99_hedged_ms = percall_p99_ms(True)
+
+            for lane, r in (
+                ("pool-3-healthy", rate_healthy),
+                ("pool-1-of-3-degraded", rate_degraded),
+                ("pinned-to-degraded", rate_pinned),
+            ):
+                print(f"# pool lane {lane}: {r:,.1f} round-trips/s",
+                      file=sys.stderr)
+            print(
+                f"# pool tail p99: unhedged {p99_unhedged_ms:.1f} ms, "
+                f"hedged {p99_hedged_ms:.1f} ms",
+                file=sys.stderr,
+            )
+            record(
+                "replica-pool routing (3 replicas, 1 slow/degraded)",
+                rate_degraded,
+                unit="round-trips/s",
+                baseline_rate=rate_healthy,
+                baseline_desc=(
+                    f"all-healthy 3-replica pool, same run "
+                    f"({rate_healthy:,.1f} rps); acceptance line: "
+                    "degraded >= 0.7x healthy"
+                ),
+                pool_healthy_rps=round(rate_healthy, 1),
+                pool_degraded_rps=round(rate_degraded, 1),
+                pinned_to_degraded_rps=round(rate_pinned, 1),
+                p99_unhedged_ms=round(p99_unhedged_ms, 2),
+                p99_hedged_ms=round(p99_hedged_ms, 2),
+                hedge_tail_cut=round(
+                    p99_unhedged_ms / max(p99_hedged_ms, 1e-9), 2
+                ),
+                note="host-transport lane (no FLOP fields); degraded "
+                "member serves ~20 req/s serial; the pinned lane is "
+                "the pre-pool architecture collapsing onto it, the "
+                "hedged lane fires a second replica at the observed "
+                "median-latency deadline",
+            )
+            assert rate_degraded >= 0.7 * rate_healthy, (
+                f"degraded pool {rate_degraded:.1f} rps < 70% of "
+                f"healthy {rate_healthy:.1f} rps"
+            )
+            assert p99_hedged_ms < p99_unhedged_ms, (
+                f"hedging did not cut tail latency "
+                f"({p99_hedged_ms:.1f} vs {p99_unhedged_ms:.1f} ms)"
+            )
+        finally:
+            for p in procs:
+                p.terminate()
+            for p in procs:
+                p.join(timeout=5)
+
+    guard("replica pool routing", _c13)
 
     if results:
         print(

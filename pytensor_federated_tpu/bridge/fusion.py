@@ -69,10 +69,16 @@ class ParallelFederatedOp(Op):
     ``__props__``: like the member ops, identity is instance identity.
     """
 
-    def __init__(self, members, in_counts, out_counts):
+    def __init__(self, members, in_counts, out_counts, node_pool=None):
         self.members = list(members)
         self.in_counts = list(in_counts)
         self.out_counts = list(out_counts)
+        # Optional routing.NodePool: members whose clients ride the
+        # pool fail over between retry attempts instead of surfacing
+        # the first transient error (fanout_exec.run_members).  Not
+        # picklable (locks/threads) — dropped with the executor pool on
+        # pickle; a worker-process copy falls back to no-retry.
+        self.node_pool = node_pool
 
     def make_node(self, *inputs):
         outputs = []
@@ -113,7 +119,12 @@ class ParallelFederatedOp(Op):
         state = self.__dict__.copy()
         state.pop("_member_nodes", None)
         state.pop("_pool", None)
+        state.pop("node_pool", None)
         return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self.node_pool = None
 
     def _member_pool(self) -> MemberExecutorPool:
         # One PERSISTENT single-thread executor per member, shut down by
@@ -149,6 +160,7 @@ class ParallelFederatedOp(Op):
             inputs,
             output_storage,
             self._member_pool(),
+            node_pool=getattr(self, "node_pool", None),
         )
 
 

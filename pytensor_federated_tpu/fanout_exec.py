@@ -272,6 +272,7 @@ def run_members(
     inputs: Sequence,
     output_storage: list,
     pool: MemberExecutorPool,
+    node_pool=None,
 ) -> None:
     """Fan the members out; write results through ``output_storage``.
 
@@ -280,6 +281,22 @@ def run_members(
     ``output_storage`` — members write results into their own cells and
     never see a sibling's.  All members settle before the first failure
     (in member order) is raised.
+
+    ``node_pool`` (a :class:`~pytensor_federated_tpu.routing.NodePool`,
+    optional) routes member failures through the pool's retry/failover
+    policy: a member raising a TRANSIENT error
+    (``node_pool.is_transient`` — transport trouble, never a
+    deterministic compute error) is re-run up to
+    ``node_pool.member_retries`` times with the pool's jittered
+    backoff between attempts.  Members built over that pool's
+    :class:`~pytensor_federated_tpu.routing.PooledArraysClient` pick a
+    DIFFERENT healthy replica on the re-run (the failed one's breaker
+    just recorded the failure), so the retry is a failover, not an
+    instant replay against the dead node.  Member storage writes are
+    idempotent (each attempt overwrites the member's own cells), so a
+    retried member cannot corrupt a sibling's slice.  Without a pool
+    the round-1 contract stands: the first member error surfaces
+    immediately after all members settle.
     """
     n = len(member_fns)
     if not (n == len(in_counts) == len(out_counts)):
@@ -309,6 +326,32 @@ def run_members(
     telemetry_on = _tspans.enabled()
     durations: List[float] = [0.0] * n if telemetry_on else []
 
+    max_attempts = 1 + (
+        max(0, int(node_pool.member_retries)) if node_pool is not None else 0
+    )
+
+    def call_member(idx: int, sub_inputs: list, sub_storage: list) -> None:
+        """One member evaluation, re-run through the pool's retry
+        policy on transient failures (no pool: exactly one attempt)."""
+        for attempt in range(max_attempts):
+            try:
+                member_fns[idx](sub_inputs, sub_storage)
+                return
+            except Exception as e:
+                if (
+                    attempt + 1 >= max_attempts
+                    or node_pool is None
+                    or not node_pool.is_transient(e)
+                ):
+                    raise
+                _flightrec.record(
+                    "fanout.member_retry",
+                    idx=idx,
+                    attempt=attempt + 1,
+                    error=f"{type(e).__name__}: {e}"[:200],
+                )
+                node_pool.backoff_sleep(attempt)
+
     def make_run(idx: int):
         def run():
             ilo, ihi = in_spans[idx]
@@ -317,7 +360,7 @@ def run_members(
             if telemetry_on:
                 t0 = time.perf_counter()
             with _tspans.span("fanout.member", idx=idx):
-                member_fns[idx](list(inputs[ilo:ihi]), sub_storage)
+                call_member(idx, list(inputs[ilo:ihi]), sub_storage)
             if telemetry_on:
                 # Written pre-settle, read post-settle: the futures
                 # barrier below orders the write before the read, so no

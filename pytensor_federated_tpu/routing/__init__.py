@@ -1,0 +1,49 @@
+"""Replica-pool routing for the host-federation lane.
+
+The reference balances once at connect time (GetLoad poll + least-
+loaded pick, reference: service.py:240-263) and then pins: every call
+rides whichever server the client first connected to, so one slow or
+dead node stalls the whole graph.  This subsystem sits ABOVE both
+transports (gRPC `service.client` and TCP `service.tcp`) and routes
+every call:
+
+- :class:`NodePool` — the replica registry: static list plus late
+  add/remove, background health/load probing over the existing
+  GetLoad / zero-item-TCP-probe lanes, stale-load eviction, and one
+  :class:`CircuitBreaker` per replica (half-open probing, jittered
+  exponential backoff).
+- :mod:`.policies` — pluggable pick policies: round-robin, EWMA
+  latency, and power-of-two-choices over advertised queue depth
+  (the default).
+- :class:`PooledArraysClient` — the drop-in client facade: the same
+  ``evaluate`` / ``evaluate_many`` surface as the pinned clients,
+  plus hedged requests for idempotent computes and mid-window
+  failover that re-queues the un-replied tail of a pipelined window
+  onto a healthy replica.
+
+Everything is observable: ``pftpu_pool_*`` metric families (catalog:
+docs/observability.md), ``pool.*`` flight-recorder events, and
+``pool.evaluate``/``pool.window`` spans that keep a failed-over
+call's full replica itinerary in one trace.
+"""
+
+from .breaker import CircuitBreaker
+from .policies import (
+    EwmaLatencyPolicy,
+    PowerOfTwoChoicesPolicy,
+    RoundRobinPolicy,
+    get_policy,
+)
+from .pool import NodePool, Replica
+from .pooled_client import PooledArraysClient
+
+__all__ = [
+    "CircuitBreaker",
+    "EwmaLatencyPolicy",
+    "NodePool",
+    "PooledArraysClient",
+    "PowerOfTwoChoicesPolicy",
+    "Replica",
+    "RoundRobinPolicy",
+    "get_policy",
+]

@@ -95,6 +95,7 @@ def elastic_sample(
     checkpoint_path: str,
     mesh: Optional[Any] = None,
     peers: Optional[Mapping[int, Tuple[str, int]]] = None,
+    node_pool: Optional[Any] = None,
     max_failures: int = 2,
     on_failure: Optional[Callable[[Optional[Any], list], Optional[Any]]] = None,
     watchdog_s: Optional[float] = None,
@@ -109,6 +110,19 @@ def elastic_sample(
 
     ``peers`` (process id -> heartbeat address) feeds dead-peer
     DETECTION into recovery; without it, recovery is local-view only.
+
+    ``node_pool`` (a :class:`~pytensor_federated_tpu.routing.NodePool`,
+    optional) adds a HOST-LANE recovery tier ahead of the mesh one:
+    when the failed segment's logp rides a replica pool
+    (:class:`~pytensor_federated_tpu.routing.PooledArraysClient`
+    inside ``build_logp``), recovery probes the pool NOW — the dead
+    replica's breaker trips, the pool shrinks around it, and the
+    rebuilt logp routes over the survivors without touching the mesh
+    at all (pool GROWTH is the operator's move: ``add_replica`` on a
+    live pool is picked up by the same rebuild).  A segment failure
+    with zero admitted replicas left still falls through to the mesh
+    tiers (remesh, then process restart), so the tier ordering is:
+    pool shrink → remesh → checkpoint-resume restart.
     ``on_failure(mesh, dead_process_ids) -> new_mesh`` overrides the
     default :func:`remesh_after_failure` policy (e.g. to rebuild a
     multi-host mesh after out-of-band agreement).  ``max_failures``
@@ -179,6 +193,27 @@ def elastic_sample(
                 failures,
                 max_failures,
             )
+            if node_pool is not None:
+                # Tier 0, host lane: probe the replica pool so dead
+                # nodes are quarantined (their breakers trip on the
+                # failed probe) before the logp is rebuilt over the
+                # survivors.  Cheap, side-effect-bounded, and enough
+                # on its own when the failure was a host-federation
+                # node dying — the mesh tiers below then find nothing
+                # to do (dead stays empty without heartbeat peers).
+                healthy = node_pool.recover()
+                _flightrec.record(
+                    "sampler.pool_recovered",
+                    attempt=failures,
+                    healthy_replicas=healthy,
+                    total_replicas=len(node_pool.replicas),
+                )
+                _log.warning(
+                    "elastic_sample: pool recovery — %d/%d replicas "
+                    "admit traffic",
+                    healthy,
+                    len(node_pool.replicas),
+                )
             dead: list = []
             if peers:
                 from ..parallel.multihost import detect_dead_peers

@@ -585,7 +585,7 @@ class ArraysToArraysServiceClient:
     # -- pipelined batch evaluation --------------------------------------
 
     async def _evaluate_many_once(
-        self, encoded, window: int
+        self, encoded, window: int, out: Optional[list] = None
     ) -> List[List[np.ndarray]]:
         """One pipelined pass over the current connection.
 
@@ -600,10 +600,18 @@ class ArraysToArraysServiceClient:
         A SERVER-SIDE error reply must not poison the stream for later
         calls: the remaining in-flight replies are drained (count-only)
         before the error raises, so the lock-step correlation survives.
+
+        ``out`` (optional, len(encoded) of ``None``) is filled IN
+        PLACE as replies validate, so a caller supplying it observes
+        the partial results of a pass that died mid-window — the
+        replica-pool failover lane (routing/) re-queues exactly the
+        still-``None`` tail.
         """
         privates = await self._get_privates()
         n = len(encoded)
-        results: List[Optional[List[np.ndarray]]] = [None] * n
+        results: List[Optional[List[np.ndarray]]] = (
+            out if out is not None else [None] * n
+        )
         if privates.stream is None:
             method = privates.channel.unary_unary(
                 EVALUATE,
@@ -757,7 +765,8 @@ class ArraysToArraysServiceClient:
         return items, ruuid, error
 
     async def _evaluate_many_batched_once(
-        self, encoded, window: int, max_batch: int
+        self, encoded, window: int, max_batch: int,
+        out: Optional[list] = None,
     ) -> List[List[np.ndarray]]:
         """One pipelined pass using WIRE BATCH FRAMES: the window is
         packed ``min(window, max_batch)`` requests per frame, so K
@@ -767,7 +776,10 @@ class ArraysToArraysServiceClient:
         the unbatched path; per-item uuids still correlate inside each
         frame and the outer uuid correlates the frame itself.  Error
         semantics match the unbatched pass: the first item error
-        drains the in-flight frames and raises without retry."""
+        drains the in-flight frames and raises without retry.
+        ``out`` is the same in-place partial-results channel as
+        :meth:`_evaluate_many_once` (frame-granular here: a frame's
+        items land together when its reply validates)."""
         privates = await self._get_privates()
         n = len(encoded)
         chunk = max(1, min(window, max_batch))
@@ -778,7 +790,9 @@ class ArraysToArraysServiceClient:
             frame, outer_uuid = self._encode_batch_frame(part, trace_id)
             _FRAME_REQS.labels(transport="grpc").observe(len(part))
             frames.append((frame, outer_uuid, start, part))
-        results: List[Optional[List[np.ndarray]]] = [None] * n
+        results: List[Optional[List[np.ndarray]]] = (
+            out if out is not None else [None] * n
+        )
 
         async def consume(reply, frame_idx, *, inflight_after: int):
             """Validate one outer reply; fills results or raises.
@@ -1048,3 +1062,85 @@ class ArraysToArraysServiceClient:
         return loop.run_until_complete(
             self.evaluate_many_async(requests, window=window, batch=batch)
         )
+
+    async def evaluate_many_partial_async(
+        self,
+        requests: Sequence[Sequence[np.ndarray]],
+        *,
+        window: int = 8,
+        batch: object = "auto",
+    ) -> Tuple[List[Optional[List[np.ndarray]]], Optional[BaseException]]:
+        """ONE pipelined pass with no internal retry, surfacing partial
+        progress: returns ``(results, transport_exc)`` where
+        ``results`` holds each request's outputs in order with ``None``
+        for every request whose reply never arrived, and
+        ``transport_exc`` is the connection failure that ended the
+        pass (``None`` on a complete pass).
+
+        This is the failover primitive the replica pool
+        (:mod:`pytensor_federated_tpu.routing`) builds on: the caller
+        re-queues exactly the ``None`` tail onto another replica
+        instead of re-running the whole batch (the all-or-nothing
+        contract :meth:`evaluate_many_async` keeps for single-node
+        callers).  Batch-frame packing, the in-flight byte cap, and
+        the capability negotiation all behave exactly as in
+        :meth:`evaluate_many_async`; deterministic server errors
+        (in-band error replies, non-retryable status codes, corrupt
+        frames) RAISE instead of being returned — the same inputs
+        would fail identically on any replica, so failover must not
+        swallow them.
+        """
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        if batch != "auto" and batch is not True and batch is not False:
+            raise ValueError(
+                f"batch must be 'auto', True or False, got {batch!r}"
+            )
+        with _spans.span(
+            "rpc.evaluate_many",
+            transport="grpc",
+            n=len(requests),
+            window=window,
+            partial=True,
+        ):
+            with _spans.span("encode"):
+                encoded = [self._encode_request(args) for args in requests]
+            if not encoded:
+                return [], None
+            out: List[Optional[List[np.ndarray]]] = [None] * len(encoded)
+            t0 = time.perf_counter()
+            try:
+                max_batch = 0
+                if batch is not False:
+                    privates = await self._get_privates()
+                    caps = await self._batch_caps(privates)
+                    max_batch = int(caps.get("max_batch", 0))
+                    if batch is True and max_batch < 2:
+                        raise RuntimeError(
+                            f"server {privates.host}:{privates.port} "
+                            "does not advertise wire batch frames "
+                            "(GetLoad carries no usable 'batch' field)"
+                        )
+                with _watchdog.armed(
+                    "grpc.batch_window", n=len(encoded), window=window
+                ):
+                    if max_batch >= 2:
+                        await self._evaluate_many_batched_once(
+                            encoded, window, max_batch, out=out
+                        )
+                    else:
+                        await self._evaluate_many_once(
+                            encoded, window, out=out
+                        )
+            except (grpc.aio.AioRpcError, ConnectionError, OSError) as e:
+                # Drop the connection (idempotent when the *_once pass
+                # already did) and classify like the retry loop does —
+                # only transport trouble is failover-worthy.
+                await self._drop_privates()
+                if not _is_retryable(e):
+                    raise
+                return out, e
+            _BATCH_S.labels(transport="grpc").observe(
+                time.perf_counter() - t0
+            )
+            return out, None

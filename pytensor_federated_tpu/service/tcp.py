@@ -396,11 +396,96 @@ class TcpArraysClient:
                 f"{self.retries + 1} attempts"
             ) from last_err
 
-    def _evaluate_many_once(self, encoded, window):
+    def evaluate_many_partial(
+        self,
+        requests: Sequence[Sequence[np.ndarray]],
+        *,
+        window: int = 8,
+        batch: object = "auto",
+    ):
+        """ONE pipelined pass with no reconnect-retry, surfacing
+        partial progress: ``(results, transport_exc)`` with ``None``
+        holes for requests whose reply never arrived — the failover
+        primitive the replica pool (routing/) re-queues from, mirror
+        of the gRPC client's ``evaluate_many_partial_async``.
+        Deterministic server errors (:class:`RemoteComputeError`,
+        corrupt frames, uuid desync) raise; only a dead/failed socket
+        is returned as ``transport_exc``."""
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        if batch != "auto" and batch is not True and batch is not False:
+            raise ValueError(
+                f"batch must be 'auto', True or False, got {batch!r}"
+            )
+        with _spans.span(
+            "rpc.evaluate_many",
+            transport="tcp",
+            n=len(requests),
+            window=window,
+            partial=True,
+        ):
+            with _spans.span("encode"):
+                trace_id = (
+                    _spans.current_trace_id() if _spans.enabled() else None
+                )
+                encoded = []
+                for args in requests:
+                    uid = uuid_mod.uuid4().bytes
+                    encoded.append(
+                        (
+                            encode_arrays(
+                                [np.asarray(a) for a in args],
+                                uuid=uid,
+                                trace_id=trace_id,
+                            ),
+                            uid,
+                        )
+                    )
+            if not encoded:
+                return [], None
+            out: List[Optional[List[np.ndarray]]] = [None] * len(encoded)
+            t0 = time.perf_counter()
+            try:
+                use_batch = False
+                if batch is not False:
+                    use_batch = self._probe_batch()
+                    if batch is True and not use_batch:
+                        raise RuntimeError(
+                            f"node {self.host}:{self.port} does not "
+                            "answer the batch-frame probe"
+                        )
+                with _watchdog.armed(
+                    "tcp.batch_window", n=len(encoded), window=window
+                ):
+                    if use_batch:
+                        self._evaluate_many_batched_once(
+                            encoded, window, trace_id, out=out
+                        )
+                    else:
+                        self._evaluate_many_once(encoded, window, out=out)
+            except (ConnectionError, OSError) as e:
+                _DROPS.labels(transport="tcp").inc()
+                _flightrec.record(
+                    "rpc.drop", transport="tcp",
+                    peer=f"{self.host}:{self.port}",
+                )
+                self.close()
+                return out, e
+            _BATCH_S.labels(transport="tcp").observe(
+                time.perf_counter() - t0
+            )
+            return out, None
+
+    def _evaluate_many_once(self, encoded, window, out=None):
+        # ``out`` (optional, len(encoded) of None) is filled in place
+        # as replies validate — the partial-progress channel
+        # evaluate_many_partial / the replica pool's failover build on.
         sock = self._connect()
         n = len(encoded)
         max_inflight = self._inflight_cap(len(encoded[0][0]))
-        results: List[Optional[List[np.ndarray]]] = [None] * n
+        results: List[Optional[List[np.ndarray]]] = (
+            out if out is not None else [None] * n
+        )
         write_idx = read_idx = 0
         inflight_bytes = 0
         while read_idx < n:
@@ -468,13 +553,16 @@ class TcpArraysClient:
 
     _BATCH_CHUNK = 32  # requests per batch frame (server-side max_batch)
 
-    def _evaluate_many_batched_once(self, encoded, window, trace_id):
+    def _evaluate_many_batched_once(self, encoded, window, trace_id,
+                                    out=None):
         """One pipelined pass using wire batch frames: the window is
         packed ``min(window, 32)`` requests per frame — one syscall,
         one node decode loop, one (possibly vmapped) dispatch per
         frame.  Per-item uuids still correlate; the first item error
         drains the in-flight frames and raises RemoteComputeError
-        without retry (same semantics as the unbatched pass)."""
+        without retry (same semantics as the unbatched pass).
+        ``out`` is the in-place partial-progress channel (frame-
+        granular), as in ``_evaluate_many_once``."""
         sock = self._connect()
         n = len(encoded)
         chunk = max(1, min(window, self._BATCH_CHUNK))
@@ -489,7 +577,9 @@ class TcpArraysClient:
             )
             _FRAME_REQS.labels(transport="tcp").observe(len(part))
             frames.append((frame, outer_uuid, start, part))
-        results: List[Optional[List[np.ndarray]]] = [None] * n
+        results: List[Optional[List[np.ndarray]]] = (
+            out if out is not None else [None] * n
+        )
         nf = len(frames)
         max_inflight = self._inflight_cap(len(frames[0][0]))
         write_idx = read_idx = 0

@@ -16,6 +16,13 @@ kind                      emitted by
 ``rpc.error``                 in-band server error replies at the driver
 ``server.error``              node-side decode/compute failures (server.py)
 ``fanout.member_error``       a fused-fanout member raising (fanout_exec.py)
+``fanout.member_retry``       a transient member failure re-run via a pool
+``pool.breaker_*``            replica breaker transitions (routing/pool.py)
+``pool.failover``             a call/window tail moving onto another replica
+``pool.hedge``                a hedged request firing at a second replica
+``pool.probe_failed``         a background replica probe failing
+``pool.replica_added``/``_removed``  live pool registry changes
+``sampler.pool_recovered``    the elastic pool-recovery tier (elastic.py)
 ``mesh.peer_dead``            a heartbeat death verdict (parallel/multihost.py)
 ``mesh.remesh``               mesh rebuilt after failure (parallel/multihost.py)
 ``sampler.run``               one sample() run settling (samplers/mcmc.py)

@@ -34,6 +34,14 @@ docstring).
 
 Exits via ``os._exit`` so a dead-peer distributed shutdown barrier in
 atexit cannot hang the test.
+
+Backend-capability escape hatch: some container jaxlibs reject
+cross-process collectives outright ("Multiprocess computations aren't
+implemented on the CPU backend" out of the phase-A device_put — the
+gloo/DCN path simply is not compiled in).  That is an environment
+limitation, not a code failure, so the child prints
+``SKIP-UNSUPPORTED: <reason>`` and exits 3; the launcher turns it into
+a pytest skip instead of a red.
 """
 
 import os
@@ -173,5 +181,24 @@ def main():
     os._exit(0)
 
 
+# Substrings that mark "this jaxlib cannot run cross-process
+# collectives at all" — the documented environment drift this container
+# exhibits, not any bug in the code under test.
+_UNSUPPORTED_MARKERS = (
+    "Multiprocess computations aren't implemented",
+    "multiprocess computations aren't implemented",
+)
+
+
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # noqa: BLE001 — capability triage, then re-raise
+        msg = f"{type(e).__name__}: {e}"
+        if any(m in msg for m in _UNSUPPORTED_MARKERS):
+            log(
+                sys.argv[1] if len(sys.argv) > 1 else "?",
+                f"SKIP-UNSUPPORTED: {msg.splitlines()[0][:300]}",
+            )
+            os._exit(3)
+        raise

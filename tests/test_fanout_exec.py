@@ -260,3 +260,100 @@ def test_pool_shutdown_before_use_stays_shut():
     pool.shutdown()
     with pytest.raises(RuntimeError, match="shut down"):
         pool.submit(0, lambda: 1)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 4 satellite: member failures route through the replica pool's
+# retry/failover policy when a pool is supplied (previously the FIRST
+# member error surfaced with no retry at all).
+# ---------------------------------------------------------------------------
+
+
+def _make_node_pool(**breaker_kwargs):
+    from pytensor_federated_tpu.routing import NodePool
+
+    return NodePool(
+        [("127.0.0.1", 1)],
+        member_retries=2,
+        breaker_kwargs=dict(failure_threshold=1, **breaker_kwargs),
+    )
+
+
+def test_transient_then_healthy_member_retries_through_pool():
+    # Regression (fanout_exec surfaced the first member error without
+    # retry): a member that raises ONE transient transport error and
+    # then succeeds must not fail the fanout when a pool is supplied.
+    from pytensor_federated_tpu.telemetry import flightrec
+
+    flightrec.clear()
+    pool = MemberExecutorPool(2)
+    attempts = {"n": 0}
+
+    def flaky(sub_in, sub_storage):
+        attempts["n"] += 1
+        if attempts["n"] == 1:
+            raise ConnectionError("replica vanished mid-call")
+        sub_storage[0][0] = "recovered"
+
+    storage = _storage(2)
+    run_members(
+        [flaky, _writer("ok")],
+        [0, 0],
+        [1, 1],
+        [],
+        storage,
+        pool,
+        node_pool=_make_node_pool(),
+    )
+    assert attempts["n"] == 2
+    assert storage[0][0] == "recovered"
+    assert storage[1][0] == ("ok", 0, [])
+    kinds = [e["kind"] for e in flightrec.events()]
+    assert "fanout.member_retry" in kinds
+
+
+def test_member_retries_exhaust_then_raise():
+    pool = MemberExecutorPool(1)
+    attempts = {"n": 0}
+
+    def always_down(sub_in, sub_storage):
+        attempts["n"] += 1
+        raise ConnectionError("still down")
+
+    with pytest.raises(ConnectionError, match="still down"):
+        run_members(
+            [always_down], [0], [1], [], _storage(1), pool,
+            node_pool=_make_node_pool(),
+        )
+    assert attempts["n"] == 3  # 1 + member_retries
+
+
+def test_deterministic_member_error_is_not_retried():
+    # A compute error is the request's own fault: retrying would
+    # re-execute a failure that cannot succeed anywhere.
+    pool = MemberExecutorPool(1)
+    attempts = {"n": 0}
+
+    def poison(sub_in, sub_storage):
+        attempts["n"] += 1
+        raise RuntimeError("server error: poison input")
+
+    with pytest.raises(RuntimeError, match="poison"):
+        run_members(
+            [poison], [0], [1], [], _storage(1), pool,
+            node_pool=_make_node_pool(),
+        )
+    assert attempts["n"] == 1
+
+
+def test_no_pool_keeps_no_retry_contract():
+    pool = MemberExecutorPool(1)
+    attempts = {"n": 0}
+
+    def flaky(sub_in, sub_storage):
+        attempts["n"] += 1
+        raise ConnectionError("transient")
+
+    with pytest.raises(ConnectionError):
+        run_members([flaky], [0], [1], [], _storage(1), pool)
+    assert attempts["n"] == 1

@@ -63,16 +63,21 @@ class _LineReader:
                 self._cond.notify_all()
 
     def wait_for(self, needle, timeout):
+        self.wait_for_any((needle,), timeout)
+
+    def wait_for_any(self, needles, timeout):
+        """Block until ANY needle appears; returns the matched one."""
         deadline = time.time() + timeout
         with self._cond:
             while True:
-                if any(needle in l for l in self.lines):
-                    return
+                for needle in needles:
+                    if any(needle in l for l in self.lines):
+                        return needle
                 remaining = deadline - time.time()
                 if remaining <= 0:
                     raise AssertionError(
-                        f"timed out waiting for {needle!r}; output so "
-                        f"far:\n" + "\n".join(self.lines)
+                        f"timed out waiting for any of {needles!r}; "
+                        f"output so far:\n" + "\n".join(self.lines)
                     )
                 self._cond.wait(remaining)
 
@@ -105,9 +110,35 @@ def test_two_process_distributed_logp_and_sigkill_failover():
     ]
     readers = [_LineReader(p) for p in procs]
     try:
-        # Both processes finish the distributed phase A...
-        readers[1].wait_for("PHASE-A OK", timeout=240)
-        readers[0].wait_for("PHASE-A OK", timeout=240)
+        # Both processes finish the distributed phase A — unless the
+        # container's jaxlib rejects cross-process collectives outright
+        # (environment drift, CHANGES.md PR 3): the children detect
+        # that capability gap themselves and report SKIP-UNSUPPORTED,
+        # which is a skip with the backend's own reason, not a red.
+        sentinels = ("PHASE-A OK", "SKIP-UNSUPPORTED")
+
+        def skip_with_reason():
+            # Skip the moment EITHER child reports the capability gap:
+            # the sibling may be wedged inside the collective waiting
+            # for its now-dead peer, so it must not be waited on.
+            out = readers[0].text() + "\n" + readers[1].text()
+            reason = next(
+                (
+                    l.split("SKIP-UNSUPPORTED:", 1)[1].strip()
+                    for l in out.splitlines()
+                    if "SKIP-UNSUPPORTED:" in l
+                ),
+                "unknown",
+            )
+            pytest.skip(
+                "jax.distributed multiprocess collectives unsupported "
+                f"by this container's backend: {reason}"
+            )
+
+        if readers[1].wait_for_any(sentinels, timeout=240) != "PHASE-A OK":
+            skip_with_reason()
+        if readers[0].wait_for_any(sentinels, timeout=240) != "PHASE-A OK":
+            skip_with_reason()
         # ...the peer enters its work loop, and the survivor confirms
         # it is probe-ably alive (so the later death verdict can only
         # come from the kill, not from a server that never started).

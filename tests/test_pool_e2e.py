@@ -1,0 +1,196 @@
+"""ISSUE 4 acceptance e2e: 3-replica pool, one replica SIGKILLed
+MID-pipelined-window.
+
+Real process boundaries (multiprocessing-spawn server nodes, the
+test_service.py pattern): a :class:`PooledArraysClient` spreads a
+pipelined ``evaluate_many`` over three localhost replicas, the
+launcher SIGKILLs one while its shard is in flight, and the contract
+under test is
+
+- **exactly one reply per request** — the un-replied tail of the dead
+  replica's window re-queues onto the survivors; nothing is lost,
+  nothing double-assigned, nothing hangs;
+- **the breaker trips** on the killed replica and — once a
+  replacement node is back on the same port — **half-open-recovers**
+  through a single probe call;
+- **the trace of the failed-over call shows both replicas' spans**:
+  the driver's ``pool.evaluate_many`` root holds ``pool.window``
+  children for the killed replica AND the survivors that absorbed its
+  tail.
+"""
+
+import asyncio
+import signal
+import time
+
+import numpy as np
+import pytest
+from conftest import spawn_node_procs, wait_nodes_up
+
+from pytensor_federated_tpu import telemetry
+from pytensor_federated_tpu.routing import NodePool, PooledArraysClient
+from pytensor_federated_tpu.telemetry import flightrec
+
+BASE_PORT = 29560
+COMPUTE_DELAY_S = 0.005
+
+
+def _serve_slow_node(port, delay):
+    """Module-level (spawn needs a picklable target): the quad compute
+    with a per-call delay so a pipelined window is genuinely in flight
+    for a while — the kill must land MID window."""
+    import logging
+    import time as _time
+
+    import numpy as _np
+
+    logging.basicConfig(level=logging.WARNING)
+
+    def compute(x):
+        _time.sleep(delay)
+        x = _np.asarray(x)
+        return [
+            _np.asarray(-_np.sum((x - 3.0) ** 2)),
+            (-2.0 * (x - 3.0)).astype(x.dtype),
+        ]
+
+    from pytensor_federated_tpu.service import run_node
+
+    run_node(compute, "127.0.0.1", port)
+
+
+def _expected(i):
+    return -((i - 3.0) ** 2 + 4.0)
+
+
+@pytest.mark.slow
+def test_sigkill_mid_window_failover_breaker_and_trace():
+    ports = [BASE_PORT, BASE_PORT + 1, BASE_PORT + 2]
+    procs = spawn_node_procs(
+        _serve_slow_node, [(p, COMPUTE_DELAY_S) for p in ports]
+    )
+    telemetry.clear_traces()
+    flightrec.clear()
+    pool = NodePool(
+        [("127.0.0.1", p) for p in ports],
+        policy="round_robin",
+        breaker_kwargs=dict(
+            failure_threshold=1, backoff_s=0.5, jitter_frac=0.1
+        ),
+    )
+    client = PooledArraysClient(pool)
+    victim_port = ports[2]
+    victim_addr = f"127.0.0.1:{victim_port}"
+    try:
+        wait_nodes_up(ports, timeout=60)
+
+        n = 240
+        reqs = [(np.array([float(i), 5.0], np.float32),) for i in range(n)]
+
+        async def run_with_kill():
+            # Fire the kill while the spread window is mid-flight:
+            # every replica owns an ~80-request shard at ~5 ms/call,
+            # so 0.15 s in, the victim's shard is far from drained.
+            loop = asyncio.get_running_loop()
+            loop.call_later(
+                0.15, lambda: procs[2].kill()  # SIGKILL, no shutdown
+            )
+            return await asyncio.wait_for(
+                client.evaluate_many_async(reqs, window=8, batch=False),
+                timeout=120,
+            )
+
+        results = asyncio.run(run_with_kill())
+        procs[2].join(timeout=30)
+        assert procs[2].exitcode == -signal.SIGKILL
+
+        # -- every request got exactly one, correct reply (positional
+        # assignment makes duplicates structurally impossible; holes
+        # would be None; correlation is uuid-checked per transport).
+        assert len(results) == n
+        for i, out in enumerate(results):
+            assert out is not None, f"request {i} never got a reply"
+            np.testing.assert_allclose(
+                float(np.asarray(out[0])), _expected(i), rtol=1e-6
+            )
+
+        # -- the breaker tripped on the killed replica, and the
+        # failover landed in the flight record.
+        victim = pool.replica_at("127.0.0.1", victim_port)
+        assert victim.breaker.state == "open"
+        events = flightrec.events()
+        failovers = [
+            e for e in events
+            if e["kind"] == "pool.failover"
+            and e.get("replica") == victim_addr
+        ]
+        assert failovers, "no pool.failover event for the killed replica"
+        assert any(e.get("requeued", 0) > 0 for e in failovers), (
+            "the failover should have re-queued an un-replied tail"
+        )
+        assert any(
+            e["kind"] == "pool.breaker_open"
+            and e.get("replica") == victim_addr
+            for e in events
+        )
+
+        # -- the failed-over call's trace shows BOTH replicas' spans:
+        # pool.window children for the victim and for survivors.
+        traces = telemetry.recent_traces()
+        root = next(
+            t for t in reversed(traces)
+            if t["name"] == "pool.evaluate_many"
+        )
+
+        def windows(tree, out):
+            for child in tree.get("children", []):
+                if child["name"] == "pool.window":
+                    out.append(child["attrs"]["replica"])
+                windows(child, out)
+            return out
+
+        replicas_in_trace = set(windows(root, []))
+        assert victim_addr in replicas_in_trace
+        assert len(replicas_in_trace) >= 2, (
+            f"expected spans from the victim AND a survivor, got "
+            f"{replicas_in_trace}"
+        )
+
+        # -- half-open recovery: a replacement node on the SAME port;
+        # once the backoff expires the breaker reads half_open, and the
+        # single admitted probe call closes it again.
+        procs[2] = spawn_node_procs(
+            _serve_slow_node, [(victim_port, COMPUTE_DELAY_S)]
+        )[0]
+        wait_nodes_up([victim_port], timeout=60)
+        deadline = time.time() + 10
+        while victim.breaker.state == "open":
+            assert time.time() < deadline, "backoff never expired"
+            time.sleep(0.05)
+        assert victim.breaker.state == "half_open"
+
+        async def drive_until_closed():
+            deadline = time.time() + 30
+            while victim.breaker.state != "closed":
+                assert time.time() < deadline, (
+                    "half-open probe never closed the breaker"
+                )
+                out = await client.evaluate_async(
+                    np.array([1.0, 5.0], np.float32)
+                )
+                np.testing.assert_allclose(
+                    float(np.asarray(out[0])), -8.0
+                )
+
+        asyncio.run(drive_until_closed())
+        assert any(
+            e["kind"] == "pool.breaker_closed"
+            and e.get("replica") == victim_addr
+            for e in flightrec.events()
+        )
+    finally:
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+        for p in procs:
+            p.join(timeout=10)

@@ -1,0 +1,855 @@
+"""Replica-pool routing: breakers, policies, probing, failover, hedging.
+
+Unit coverage for `pytensor_federated_tpu.routing` plus the satellite
+contracts ISSUE 4 names: concurrent GetLoad probing with npwire AND
+npproto replies parsed under parallel probes, stale-load eviction, the
+zero-item TCP probe frame reused as the TCP health check, and the
+elastic-sampling pool-recovery tier.  The SIGKILL-mid-window e2e lives
+in tests/test_pool_e2e.py (real process boundaries).
+"""
+
+import asyncio
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pytensor_federated_tpu.routing import (
+    CircuitBreaker,
+    EwmaLatencyPolicy,
+    NodePool,
+    PooledArraysClient,
+    PowerOfTwoChoicesPolicy,
+    RoundRobinPolicy,
+    get_policy,
+)
+from pytensor_federated_tpu.routing.pool import _tcp_probe
+from pytensor_federated_tpu.telemetry import flightrec
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _dead_port():
+    """A port that refuses connections (bound then released)."""
+    return _free_port()
+
+
+def _quad(x):
+    x = np.asarray(x)
+    return [
+        np.asarray(-np.sum((x - 3.0) ** 2)),
+        (-2.0 * (x - 3.0)).astype(x.dtype),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker
+# ---------------------------------------------------------------------------
+
+
+class TestCircuitBreaker:
+    def test_trips_after_threshold_and_half_open_recovers(self):
+        b = CircuitBreaker(
+            failure_threshold=3, backoff_s=0.05, jitter_frac=0.0
+        )
+        for _ in range(2):
+            b.record_failure()
+        assert b.state == "closed" and b.available()
+        b.record_failure()
+        assert b.state == "open" and not b.available()
+        assert not b.acquire()
+        time.sleep(0.06)
+        # deadline passed: half-open with exactly ONE probe token
+        assert b.state == "half_open"
+        assert b.acquire()
+        assert not b.acquire(), "second half-open claimant must lose"
+        b.record_success()
+        assert b.state == "closed"
+        assert b.consecutive_failures == 0
+
+    def test_failed_probe_doubles_backoff_with_cap(self):
+        b = CircuitBreaker(
+            failure_threshold=1,
+            backoff_s=0.02,
+            max_backoff_s=0.05,
+            jitter_frac=0.0,
+        )
+        b.record_failure()  # trip: deadline armed with 0.02
+        assert b.backoff_s == pytest.approx(0.02)
+        time.sleep(0.025)
+        assert b.acquire()
+        b.record_failure()  # failed probe: escalate
+        assert b.backoff_s == pytest.approx(0.04)
+        time.sleep(0.05)
+        assert b.acquire()
+        b.record_failure()  # escalate again, capped
+        assert b.backoff_s == pytest.approx(0.05)
+
+    def test_jittered_deadline_stays_in_band(self):
+        import random
+
+        for seed in range(20):
+            b = CircuitBreaker(
+                failure_threshold=1,
+                backoff_s=1.0,
+                jitter_frac=0.2,
+                clock=lambda: 0.0,
+                rng=random.Random(seed),
+            )
+            b.record_failure()
+            assert 0.8 <= b._open_until <= 1.2
+
+    def test_success_resets_backoff_ladder(self):
+        b = CircuitBreaker(
+            failure_threshold=1, backoff_s=0.01, jitter_frac=0.0
+        )
+        b.record_failure()
+        time.sleep(0.015)
+        assert b.acquire()
+        b.record_failure()  # escalated to 0.02
+        time.sleep(0.03)
+        assert b.acquire()
+        b.record_success()
+        assert b.backoff_s == pytest.approx(0.01), "ladder must reset"
+
+    def test_transition_hook_fires(self):
+        seen = []
+        b = CircuitBreaker(
+            failure_threshold=1,
+            backoff_s=0.01,
+            jitter_frac=0.0,
+            on_transition=lambda old, new: seen.append((old, new)),
+        )
+        b.record_failure()
+        time.sleep(0.015)
+        b.acquire()
+        b.record_success()
+        assert ("closed", "open") in seen
+        assert ("open", "half_open") in seen
+        assert ("half_open", "closed") in seen
+
+
+# ---------------------------------------------------------------------------
+# Policies
+# ---------------------------------------------------------------------------
+
+
+class _FakeReplica:
+    def __init__(self, name, depth=None, ewma=None):
+        self.address = name
+        self._depth = depth
+        self.ewma_latency_s = ewma
+        self.inflight = 0
+
+    def queue_depth(self):
+        return self._depth
+
+
+class TestPolicies:
+    def test_round_robin_cycles(self):
+        rr = RoundRobinPolicy()
+        cands = [_FakeReplica(n) for n in "abc"]
+        picks = [rr.pick(cands, 1)[0].address for _ in range(6)]
+        assert picks == ["a", "b", "c", "a", "b", "c"]
+
+    def test_round_robin_k_distinct(self):
+        rr = RoundRobinPolicy()
+        cands = [_FakeReplica(n) for n in "abc"]
+        assert [r.address for r in rr.pick(cands, 2)] == ["a", "b"]
+        assert [r.address for r in rr.pick(cands, 5)] == ["b", "c", "a"]
+
+    def test_ewma_ranks_unmeasured_first_then_fastest(self):
+        ew = EwmaLatencyPolicy()
+        cands = [
+            _FakeReplica("slow", ewma=0.5),
+            _FakeReplica("fast", ewma=0.1),
+            _FakeReplica("new"),
+        ]
+        assert [r.address for r in ew.pick(cands, 3)] == [
+            "new",
+            "fast",
+            "slow",
+        ]
+
+    def test_p2c_prefers_lower_advertised_depth(self):
+        import random
+
+        p2c = PowerOfTwoChoicesPolicy(rng=random.Random(0))
+        busy = _FakeReplica("busy", depth=10)
+        idle = _FakeReplica("idle", depth=0)
+        picks = [p2c.pick([busy, idle], 1)[0].address for _ in range(25)]
+        assert all(p == "idle" for p in picks)
+
+    def test_p2c_falls_back_to_ewma_on_ties(self):
+        import random
+
+        p2c = PowerOfTwoChoicesPolicy(rng=random.Random(0))
+        a = _FakeReplica("a", depth=2, ewma=0.5)
+        b = _FakeReplica("b", depth=2, ewma=0.1)
+        picks = [p2c.pick([a, b], 1)[0].address for _ in range(25)]
+        assert all(p == "b" for p in picks)
+
+    def test_get_policy_validates(self):
+        assert isinstance(get_policy("p2c"), PowerOfTwoChoicesPolicy)
+        with pytest.raises(ValueError, match="unknown routing policy"):
+            get_policy("fifo")
+        with pytest.raises(TypeError, match="pick"):
+            get_policy(object())
+
+
+# ---------------------------------------------------------------------------
+# NodePool probing — the GetLoad / TCP-probe lanes
+# ---------------------------------------------------------------------------
+
+
+class TestNodePoolProbing:
+    def test_concurrent_getload_probing_npwire_and_npproto(self):
+        """One probe sweep over a MIXED pool — an npwire-JSON node, a
+        reference-protobuf GetLoad node, and a dead port — probed in
+        parallel: both reply formats parse into load dicts, the dead
+        replica records a breaker failure, live ones stay closed."""
+        from pytensor_federated_tpu.service.server import (
+            ArraysToArraysService,
+            serve,
+        )
+
+        async def main():
+            p_npwire, p_npproto = _free_port(), _free_port()
+            dead = _dead_port()
+            s1 = await serve(
+                None,
+                "127.0.0.1",
+                p_npwire,
+                service=ArraysToArraysService(_quad, getload_wire="npwire"),
+            )
+            s2 = await serve(
+                None,
+                "127.0.0.1",
+                p_npproto,
+                service=ArraysToArraysService(
+                    _quad, getload_wire="npproto"
+                ),
+            )
+            pool = NodePool(
+                [
+                    ("127.0.0.1", p_npwire),
+                    ("127.0.0.1", p_npproto),
+                    ("127.0.0.1", dead),
+                ],
+                probe_timeout_s=2.0,
+                breaker_kwargs=dict(failure_threshold=1, backoff_s=5.0),
+            )
+            try:
+                up = await pool.probe_once_async()
+                assert up == 2
+                r_wire = pool.replica_at("127.0.0.1", p_npwire)
+                r_proto = pool.replica_at("127.0.0.1", p_npproto)
+                r_dead = pool.replica_at("127.0.0.1", dead)
+                # npwire JSON reply: full enriched load
+                assert r_wire.load["n_clients"] == 0
+                assert "batch" in r_wire.load  # capability advertised
+                # npproto reply: the reference's three fields
+                assert r_proto.load["n_clients"] == 0
+                assert "percent_cpu" in r_proto.load
+                # the dead replica tripped on its failed probe
+                assert r_dead.load is None
+                assert r_dead.breaker.state == "open"
+                assert r_wire.breaker.state == "closed"
+                assert r_proto.breaker.state == "closed"
+                # availability reflects the sweep
+                avail = {r.address for r in pool.available_replicas()}
+                assert avail == {r_wire.address, r_proto.address}
+            finally:
+                await s1.stop(None)
+                await s2.stop(None)
+
+        asyncio.run(main())
+
+    def test_parallel_probe_sweeps_are_thread_safe(self):
+        """Several concurrent sweeps against one live npwire node must
+        all parse (regression: the pool's replica/load state is shared
+        across the probing thread and callers)."""
+        from pytensor_federated_tpu.service.server import serve
+
+        async def main():
+            port = _free_port()
+            server = await serve(_quad, "127.0.0.1", port)
+            pool = NodePool([("127.0.0.1", port)], probe_timeout_s=2.0)
+            try:
+                ups = await asyncio.gather(
+                    *(pool.probe_once_async() for _ in range(8))
+                )
+                assert all(u == 1 for u in ups)
+                assert pool.replicas[0].load["n_clients"] == 0
+            finally:
+                await server.stop(None)
+
+        asyncio.run(main())
+
+    def test_stale_load_eviction(self):
+        replica = NodePool(
+            [("127.0.0.1", 1)], load_stale_s=0.05
+        ).replicas[0]
+        replica.record_load({"n_clients": 3})
+        assert replica.queue_depth() == 3.0
+        time.sleep(0.06)
+        # stale: the advertised load stops informing routing AND the
+        # snapshot is evicted, so a later read cannot resurrect it
+        assert replica.queue_depth() is None
+        assert replica.load is None
+
+    def test_queue_depth_prefers_batcher_then_rpc_then_clients(self):
+        replica = NodePool([("127.0.0.1", 1)]).replicas[0]
+        replica.record_load(
+            {"n_clients": 9, "rpc": {"inflight": 4},
+             "batch": {"queue_depth": 2, "max_batch": 32}}
+        )
+        assert replica.queue_depth() == 2.0
+        replica.record_load({"n_clients": 9, "rpc": {"inflight": 4}})
+        assert replica.queue_depth() == 4.0
+        replica.record_load({"n_clients": 9})
+        assert replica.queue_depth() == 9.0
+
+    def test_tcp_zero_item_probe_is_the_health_check(self):
+        """The zero-item batch frame (the PR-3 capability handshake)
+        doubles as the TCP liveness probe: a live node passes, a dead
+        port fails, and a pool on transport="tcp" routes the verdicts
+        into its breakers."""
+        from pytensor_federated_tpu.service import serve_tcp_once
+
+        started = threading.Event()
+        box = {}
+        threading.Thread(
+            target=serve_tcp_once,
+            args=(_quad,),
+            daemon=True,
+            kwargs=dict(
+                ready_callback=lambda p: (box.update(p=p), started.set()),
+                max_connections=4,
+            ),
+        ).start()
+        assert started.wait(10)
+        live, dead = box["p"], _dead_port()
+        assert _tcp_probe("127.0.0.1", live, timeout=2.0)
+        assert not _tcp_probe("127.0.0.1", dead, timeout=0.5)
+
+        pool = NodePool(
+            [("127.0.0.1", live), ("127.0.0.1", dead)],
+            transport="tcp",
+            probe_timeout_s=1.0,
+            breaker_kwargs=dict(failure_threshold=1, backoff_s=5.0),
+        )
+        assert pool.probe_once() == 1
+        assert pool.replica_at("127.0.0.1", live).breaker.state == "closed"
+        assert pool.replica_at("127.0.0.1", dead).breaker.state == "open"
+        # TCP advertises liveness only: no load schema on this lane
+        assert pool.replica_at("127.0.0.1", live).load == {}
+        assert pool.replica_at("127.0.0.1", live).queue_depth() is None
+
+    def test_probe_success_restores_tripped_breaker(self):
+        """Background probing is the recovery lane: a replica that died
+        (breaker open) and came back is restored by the next sweep."""
+        from pytensor_federated_tpu.service.server import serve
+
+        async def main():
+            port = _free_port()
+            pool = NodePool(
+                [("127.0.0.1", port)],
+                probe_timeout_s=2.0,
+                breaker_kwargs=dict(failure_threshold=1, backoff_s=30.0),
+            )
+            assert await pool.probe_once_async() == 0
+            assert pool.replicas[0].breaker.state == "open"
+            server = await serve(_quad, "127.0.0.1", port)
+            try:
+                # Retry under a deadline: one probe can time out on a
+                # loaded machine while the fresh server warms up.
+                deadline = time.time() + 30
+                while await pool.probe_once_async() != 1:
+                    assert time.time() < deadline, "server never probed up"
+                    await asyncio.sleep(0.2)
+                assert pool.replicas[0].breaker.state == "closed"
+            finally:
+                await server.stop(None)
+
+        asyncio.run(main())
+
+    def test_background_probe_thread_and_late_add_remove(self):
+        from pytensor_federated_tpu.service.server import serve
+
+        async def main():
+            port = _free_port()
+            server = await serve(_quad, "127.0.0.1", port)
+            pool = NodePool(probe_interval_s=0.05, probe_timeout_s=1.0)
+            try:
+                assert len(pool) == 0
+                pool.add_replica("127.0.0.1", port)  # late add
+                pool.start()
+                deadline = time.time() + 10
+                while pool.replicas[0].load is None:
+                    assert time.time() < deadline, "probe loop never ran"
+                    await asyncio.sleep(0.05)
+                assert pool.replicas[0].breaker.state == "closed"
+                pool.remove_replica("127.0.0.1", port)  # late remove
+                assert len(pool) == 0
+            finally:
+                pool.close()
+                await server.stop(None)
+
+        asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# PooledArraysClient — routing, failover, hedging
+# ---------------------------------------------------------------------------
+
+
+class TestPooledClient:
+    def test_failover_exactly_once_and_breaker_trip(self):
+        """2 live + 1 dead replica: every request of a spread window
+        gets exactly one correct reply; the dead replica's breaker
+        trips; a repeat batch avoids it entirely."""
+        from pytensor_federated_tpu.service.server import serve
+
+        async def main():
+            p1, p2, dead = _free_port(), _free_port(), _dead_port()
+            s1 = await serve(_quad, "127.0.0.1", p1)
+            s2 = await serve(_quad, "127.0.0.1", p2)
+            pool = NodePool(
+                [
+                    ("127.0.0.1", p1),
+                    ("127.0.0.1", p2),
+                    ("127.0.0.1", dead),
+                ],
+                breaker_kwargs=dict(failure_threshold=1, backoff_s=30.0),
+            )
+            client = PooledArraysClient(pool)
+            try:
+                reqs = [
+                    (np.array([float(i), 5.0], np.float32),)
+                    for i in range(48)
+                ]
+                res = await client.evaluate_many_async(reqs, window=8)
+                assert len(res) == len(reqs)
+                for i, out in enumerate(res):
+                    assert out is not None
+                    np.testing.assert_allclose(
+                        float(np.asarray(out[0])),
+                        -((i - 3.0) ** 2 + 4.0),
+                        rtol=1e-6,
+                    )
+                assert (
+                    pool.replica_at("127.0.0.1", dead).breaker.state
+                    == "open"
+                )
+                # Second pass: dead replica no longer admitted
+                res2 = await client.evaluate_many_async(reqs, window=8)
+                assert all(r is not None for r in res2)
+            finally:
+                await s1.stop(None)
+                await s2.stop(None)
+
+        asyncio.run(main())
+
+    def test_single_evaluate_failover(self):
+        from pytensor_federated_tpu.service.server import serve
+
+        async def main():
+            live, dead = _free_port(), _dead_port()
+            server = await serve(_quad, "127.0.0.1", live)
+            pool = NodePool(
+                [("127.0.0.1", dead), ("127.0.0.1", live)],
+                policy="round_robin",  # first pick = dead, forcing failover
+                breaker_kwargs=dict(failure_threshold=1, backoff_s=30.0),
+            )
+            client = PooledArraysClient(pool)
+            try:
+                out = await client.evaluate_async(
+                    np.array([1.0, 5.0], np.float32)
+                )
+                np.testing.assert_allclose(float(np.asarray(out[0])), -8.0)
+                assert (
+                    pool.replica_at("127.0.0.1", dead).breaker.state
+                    == "open"
+                )
+            finally:
+                await server.stop(None)
+
+        asyncio.run(main())
+
+    def test_all_replicas_down_raises(self):
+        async def main():
+            pool = NodePool(
+                [("127.0.0.1", _dead_port())],
+                breaker_kwargs=dict(failure_threshold=1, backoff_s=30.0),
+            )
+            client = PooledArraysClient(pool)
+            with pytest.raises((ConnectionError, OSError)):
+                await client.evaluate_async(np.zeros(2, np.float32))
+            # pool exhausted on a later call with the breaker open
+            with pytest.raises(ConnectionError, match="no available"):
+                await client.evaluate_async(np.zeros(2, np.float32))
+
+        asyncio.run(main())
+
+    def test_server_error_raises_without_breaker_hit(self):
+        """A deterministic compute error must surface unchanged and
+        must NOT trip the (healthy) replica's breaker or fail over."""
+        from pytensor_federated_tpu.service.server import serve
+
+        def poison(x):
+            raise ValueError("poison input")
+
+        async def main():
+            port = _free_port()
+            server = await serve(poison, "127.0.0.1", port)
+            pool = NodePool(
+                [("127.0.0.1", port)],
+                breaker_kwargs=dict(failure_threshold=1, backoff_s=30.0),
+            )
+            client = PooledArraysClient(pool)
+            try:
+                for _ in range(3):
+                    with pytest.raises(RuntimeError, match="poison"):
+                        await client.evaluate_async(
+                            np.zeros(2, np.float32)
+                        )
+                assert pool.replicas[0].breaker.state == "closed"
+            finally:
+                await server.stop(None)
+
+        asyncio.run(main())
+
+    def test_hedged_request_cuts_past_a_slow_replica(self):
+        """Slow primary + fast sibling: the hedge fires at the latency
+        quantile deadline, the fast replica's reply wins, wall time
+        stays far below the slow compute."""
+        from pytensor_federated_tpu.routing.pool import _POOL_HEDGES
+        from pytensor_federated_tpu.service.server import serve
+
+        slow_delay = 0.8
+
+        def slow_quad(x):
+            time.sleep(slow_delay)
+            return _quad(x)
+
+        async def main():
+            p_slow, p_fast = _free_port(), _free_port()
+            s1 = await serve(slow_quad, "127.0.0.1", p_slow)
+            s2 = await serve(_quad, "127.0.0.1", p_fast)
+            pool = NodePool(
+                [("127.0.0.1", p_slow), ("127.0.0.1", p_fast)],
+                policy="round_robin",  # deterministic: first pick = slow
+            )
+            client = PooledArraysClient(
+                pool, hedge=True, hedge_quantile=0.5
+            )
+            # Arm the hedge deadline estimator with observed-fast calls
+            for _ in range(16):
+                client._latency.record(0.02)
+            won0 = _POOL_HEDGES.labels(outcome="won").value
+            try:
+                t0 = time.perf_counter()
+                out = await client.evaluate_async(
+                    np.array([1.0, 5.0], np.float32)
+                )
+                wall = time.perf_counter() - t0
+                np.testing.assert_allclose(float(np.asarray(out[0])), -8.0)
+                assert wall < slow_delay / 2, (
+                    f"hedge did not cut past the slow replica: {wall}s"
+                )
+                assert _POOL_HEDGES.labels(outcome="won").value == won0 + 1
+                kinds = [e["kind"] for e in flightrec.events()]
+                assert "pool.hedge" in kinds
+            finally:
+                await s1.stop(None)
+                await s2.stop(None)
+
+        asyncio.run(main())
+
+    def test_partial_pass_full_window_and_server_error(self):
+        """evaluate_many_partial_async on a healthy node: complete
+        results + no exc; a mid-window deterministic error raises out
+        of the partial pass (failover must not swallow it)."""
+        from pytensor_federated_tpu.service.client import (
+            ArraysToArraysServiceClient,
+        )
+        from pytensor_federated_tpu.service.server import serve
+
+        def compute(x):
+            x = np.asarray(x)
+            if x.shape == (2,):
+                raise ValueError("poison shape")
+            return [np.asarray(float(np.sum(x)))]
+
+        async def main():
+            port = _free_port()
+            server = await serve(compute, "127.0.0.1", port)
+            client = ArraysToArraysServiceClient(
+                "127.0.0.1", port, retries=0
+            )
+            try:
+                results, exc = await client.evaluate_many_partial_async(
+                    [(np.ones(i),) for i in (1, 3, 4)], window=4
+                )
+                assert exc is None
+                assert [float(np.asarray(r[0])) for r in results] == [
+                    1.0,
+                    3.0,
+                    4.0,
+                ]
+                with pytest.raises(RuntimeError, match="poison shape"):
+                    await client.evaluate_many_partial_async(
+                        [(np.ones(1),), (np.ones(2),), (np.ones(3),)],
+                        window=4,
+                    )
+            finally:
+                await server.stop(None)
+
+        asyncio.run(main())
+
+    def test_tcp_pool_end_to_end(self):
+        """The pool above the TCP transport: spread + failover against
+        one live serve_tcp_once node and one dead port, sync surface."""
+        from pytensor_federated_tpu.service import serve_tcp_once
+
+        started = threading.Event()
+        box = {}
+        threading.Thread(
+            target=serve_tcp_once,
+            args=(_quad,),
+            daemon=True,
+            kwargs=dict(
+                ready_callback=lambda p: (box.update(p=p), started.set()),
+                max_connections=2,
+            ),
+        ).start()
+        assert started.wait(10)
+        dead = _dead_port()
+        pool = NodePool(
+            [("127.0.0.1", box["p"]), ("127.0.0.1", dead)],
+            transport="tcp",
+            breaker_kwargs=dict(failure_threshold=1, backoff_s=30.0),
+        )
+        client = PooledArraysClient(pool)
+        try:
+            reqs = [
+                (np.array([float(i), 5.0]),) for i in range(24)
+            ]
+            res = client.evaluate_many(reqs, window=6)
+            for i, out in enumerate(res):
+                np.testing.assert_allclose(
+                    float(np.asarray(out[0])), -((i - 3.0) ** 2 + 4.0)
+                )
+            out = client.evaluate(np.array([1.0, 5.0]))
+            np.testing.assert_allclose(float(np.asarray(out[0])), -8.0)
+            assert (
+                pool.replica_at("127.0.0.1", dead).breaker.state == "open"
+            )
+        finally:
+            client.close() if client._owns_pool else pool.close()
+
+    def test_owned_pool_from_addresses(self):
+        client = PooledArraysClient(
+            [("127.0.0.1", 1), ("127.0.0.1", 2)],
+            breaker_kwargs=dict(failure_threshold=1),
+        )
+        assert client._owns_pool and len(client.pool) == 2
+        client.close()
+        assert len(client.pool) == 0
+        with pytest.raises(ValueError, match="pool_kwargs"):
+            PooledArraysClient(NodePool(), probe_interval_s=1.0)
+
+
+# ---------------------------------------------------------------------------
+# Elastic sampling: pool shrink as a recovery tier
+# ---------------------------------------------------------------------------
+
+
+class TestElasticPoolTier:
+    def test_pool_recovery_tier_runs_before_remesh(self, tmp_path):
+        """A segment failing with a transport error triggers the pool
+        recovery tier: the pool is probed NOW, the dead replica's
+        breaker trips, and sampling resumes over the rebuilt logp —
+        no mesh involved, no process restart."""
+        import jax
+        import jax.numpy as jnp
+
+        from pytensor_federated_tpu.samplers import elastic_sample
+
+        flightrec.clear()
+        pool = NodePool(
+            [("127.0.0.1", _dead_port())],
+            probe_timeout_s=0.5,
+            breaker_kwargs=dict(failure_threshold=1, backoff_s=30.0),
+        )
+        builds = []
+
+        def build_logp(mesh):
+            builds.append(mesh)
+            if len(builds) == 1:
+                def dead_node_logp(params):
+                    raise ConnectionError("replica gone mid-segment")
+
+                return dead_node_logp
+            return lambda params: -0.5 * jnp.sum(params["x"] ** 2)
+
+        res = elastic_sample(
+            build_logp,
+            {"x": jnp.zeros(2)},
+            key=jax.random.PRNGKey(0),
+            checkpoint_path=str(tmp_path / "run.ckpt"),
+            node_pool=pool,
+            num_warmup=20,
+            num_samples=20,
+            num_chains=1,
+            checkpoint_every=10,
+        )
+        assert np.asarray(res.samples["x"]).shape[1] == 20
+        assert len(builds) == 2  # initial + one post-recovery rebuild
+        assert pool.replicas[0].breaker.state == "open"
+        kinds = [e["kind"] for e in flightrec.events()]
+        assert "sampler.pool_recovered" in kinds
+        rec = next(
+            e for e in flightrec.events()
+            if e["kind"] == "sampler.pool_recovered"
+        )
+        assert rec["healthy_replicas"] == 0
+        assert rec["total_replicas"] == 1
+
+
+# ---------------------------------------------------------------------------
+# tools/metrics_dump.py --pool: per-replica health from the exposition lane
+# ---------------------------------------------------------------------------
+
+
+class TestMetricsDumpPoolView:
+    def test_pool_view_renders_replica_rows(self, capsys):
+        import importlib.util
+        import pathlib
+
+        from pytensor_federated_tpu.telemetry.export import start_exporter
+
+        spec = importlib.util.spec_from_file_location(
+            "metrics_dump",
+            pathlib.Path(__file__).resolve().parent.parent
+            / "tools"
+            / "metrics_dump.py",
+        )
+        metrics_dump = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(metrics_dump)
+
+        # Populate the pool gauges the way a live pool does.
+        pool = NodePool(
+            [("127.0.0.1", 41001), ("127.0.0.1", 41002)],
+            breaker_kwargs=dict(failure_threshold=1, backoff_s=30.0),
+        )
+        pool.replicas[0].record_load(
+            {"n_clients": 0, "batch": {"queue_depth": 2, "max_batch": 32}}
+        )
+        pool.replicas[0].record_latency(0.0042)
+        pool.replicas[1].breaker.record_failure()  # trips: threshold 1
+        pool._refresh_state_gauges()
+
+        exporter = start_exporter("127.0.0.1", 0)
+        try:
+            rc = metrics_dump.main(
+                ["--port", str(exporter.port), "--pool"]
+            )
+            out = capsys.readouterr().out
+            assert rc == 0
+            assert "127.0.0.1:41001" in out and "127.0.0.1:41002" in out
+            row1 = next(
+                l for l in out.splitlines() if "127.0.0.1:41001" in l
+            )
+            row2 = next(
+                l for l in out.splitlines() if "127.0.0.1:41002" in l
+            )
+            assert "yes" in row1 and "2" in row1 and "4.20" in row1
+            assert "NO" in row2
+            assert "breakers:" in out
+        finally:
+            exporter.close()
+
+
+# ---------------------------------------------------------------------------
+# Review regressions: half-open token hygiene, p2c local-inflight fallback
+# ---------------------------------------------------------------------------
+
+
+class TestReviewRegressions:
+    def test_p2c_falls_back_to_local_inflight(self):
+        import random
+
+        p2c = PowerOfTwoChoicesPolicy(rng=random.Random(0))
+        busy = _FakeReplica("busy")   # no advertised load (TCP lane)
+        idle = _FakeReplica("idle")
+        busy.inflight, idle.inflight = 6, 0
+        picks = [p2c.pick([busy, idle], 1)[0].address for _ in range(25)]
+        assert all(p == "idle" for p in picks)
+
+    def test_p2c_known_zero_depth_beats_unknown_with_inflight(self):
+        import random
+
+        p2c = PowerOfTwoChoicesPolicy(rng=random.Random(0))
+        known = _FakeReplica("known", depth=0)
+        unknown = _FakeReplica("unknown")  # stale/no load, 1 in flight
+        unknown.inflight = 1
+        picks = [
+            p2c.pick([known, unknown], 1)[0].address for _ in range(25)
+        ]
+        assert all(p == "known" for p in picks)
+
+    def test_breaker_release_returns_probe_token(self):
+        b = CircuitBreaker(
+            failure_threshold=1, backoff_s=0.01, jitter_frac=0.0
+        )
+        b.record_failure()
+        time.sleep(0.015)
+        assert b.acquire()      # claims the half-open token
+        assert not b.available()
+        b.release()             # abandoned call gives it back
+        assert b.available() and b.acquire()
+
+    def test_half_open_probe_serving_a_server_error_closes_breaker(self):
+        """A deterministic compute error on the half-open probe call
+        proves the replica is SERVING: the breaker must close (token
+        resolved), not stay parked in half-open forever — the leak a
+        pool without a background probe loop could never recover from."""
+        from pytensor_federated_tpu.service.server import serve
+
+        def poison(x):
+            raise ValueError("poison input")
+
+        async def main():
+            port = _free_port()
+            server = await serve(poison, "127.0.0.1", port)
+            pool = NodePool(
+                [("127.0.0.1", port)],
+                breaker_kwargs=dict(
+                    failure_threshold=1, backoff_s=0.05, jitter_frac=0.0
+                ),
+            )
+            client = PooledArraysClient(pool)
+            replica = pool.replicas[0]
+            try:
+                replica.breaker.record_failure()  # trip (threshold 1)
+                assert replica.breaker.state == "open"
+                await asyncio.sleep(0.08)
+                assert replica.breaker.state == "half_open"
+                with pytest.raises(RuntimeError, match="poison"):
+                    await client.evaluate_async(np.zeros(2, np.float32))
+                assert replica.breaker.state == "closed"
+                # and the pool keeps serving (no parked token)
+                with pytest.raises(RuntimeError, match="poison"):
+                    await client.evaluate_async(np.zeros(2, np.float32))
+            finally:
+                await server.stop(None)
+
+        asyncio.run(main())

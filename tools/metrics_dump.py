@@ -18,6 +18,13 @@ Usage:
     python tools/metrics_dump.py --port 9100 --text          # /metrics text
     python tools/metrics_dump.py --port 9100 --out tools/telemetry.jsonl
     python tools/metrics_dump.py --port 9100 --grep batch    # batcher families
+    python tools/metrics_dump.py --port 9100 --pool          # replica health
+
+``--pool`` renders the replica-pool picture from the ``pftpu_pool_*``
+families (routing/NodePool): one row per replica — breaker-admitted
+(up), last advertised queue depth, observed EWMA latency — plus the
+breaker-state counts and failover/hedge totals.  Exit 1 when the
+endpoint carries no pool families (the process isn't running a pool).
 
 ``--grep SUBSTR`` filters to metric families whose name contains
 SUBSTR — e.g. ``--grep batch`` prints the micro-batcher picture
@@ -45,6 +52,69 @@ import urllib.request
 def scrape(url: str, timeout: float) -> bytes:
     with urllib.request.urlopen(url, timeout=timeout) as resp:
         return resp.read()
+
+
+def _children(metrics: dict, family: str):
+    return (metrics.get(family) or {}).get("children") or []
+
+
+def render_pool_view(metrics: dict) -> str:
+    """Per-replica health/load table from the ``pftpu_pool_*`` gauges
+    in a /snapshot metrics map; '' when no pool families are present."""
+    up = {
+        c["labels"]["replica"]: c["value"]
+        for c in _children(metrics, "pftpu_pool_replica_up")
+    }
+    if not up:
+        return ""
+    depth = {
+        c["labels"]["replica"]: c["value"]
+        for c in _children(metrics, "pftpu_pool_replica_queue_depth")
+    }
+    ewma = {
+        c["labels"]["replica"]: c["value"]
+        for c in _children(metrics, "pftpu_pool_replica_ewma_seconds")
+    }
+    rows = [("replica", "up", "queue_depth", "ewma_ms")]
+    for replica in sorted(up):
+        d = depth.get(replica)
+        e = ewma.get(replica)
+        rows.append(
+            (
+                replica,
+                "yes" if up[replica] else "NO",
+                "-" if d is None or d < 0 else str(int(d)),
+                "-" if not e else f"{1e3 * e:.2f}",
+            )
+        )
+    widths = [max(len(r[i]) for r in rows) for i in range(4)]
+    out = [
+        "  ".join(cell.ljust(w) for cell, w in zip(row, widths)).rstrip()
+        for row in rows
+    ]
+    states = {
+        c["labels"]["state"]: int(c["value"])
+        for c in _children(metrics, "pftpu_pool_replicas")
+    }
+    if states:
+        out.append(
+            "breakers: "
+            + " / ".join(
+                f"{states.get(s, 0)} {s}"
+                for s in ("closed", "half_open", "open")
+            )
+        )
+    totals = []
+    for fam, label in (
+        ("pftpu_pool_failovers_total", "failovers"),
+        ("pftpu_pool_hedges_total", "hedges"),
+    ):
+        n = sum(c["value"] for c in _children(metrics, fam))
+        if n:
+            totals.append(f"{label}: {int(n)}")
+    if totals:
+        out.append("  ".join(totals))
+    return "\n".join(out) + "\n"
 
 
 def _filter_exposition(text: str, substr: str) -> str:
@@ -83,6 +153,12 @@ def main(argv=None) -> int:
         "--traces",
         action="store_true",
         help="GET /traces — recent completed span trees only",
+    )
+    mode.add_argument(
+        "--pool",
+        action="store_true",
+        help="render per-replica pool health/load from the "
+        "pftpu_pool_* families of the /snapshot metrics map",
     )
     ap.add_argument(
         "--out",
@@ -140,6 +216,25 @@ def main(argv=None) -> int:
     # Shape check per route: /snapshot is a dict with a metrics map,
     # /traces a list of span trees.  A well-formed-but-wrong payload is
     # the same operational failure as garbage.
+    if args.pool:
+        if not isinstance(payload, dict) or not isinstance(
+            payload.get("metrics"), dict
+        ):
+            print(
+                f"metrics_dump: {base}/snapshot has no 'metrics' map",
+                file=sys.stderr,
+            )
+            return 1
+        view = render_pool_view(payload["metrics"])
+        if not view:
+            print(
+                f"metrics_dump: {base} exposes no pftpu_pool_* "
+                "families (no replica pool in that process)",
+                file=sys.stderr,
+            )
+            return 1
+        sys.stdout.write(view)
+        return 0
     if args.traces:
         if not isinstance(payload, list):
             print(
