@@ -265,6 +265,69 @@ def batcher_overhead(n_calls: int = 3_000) -> dict:
     }
 
 
+def faultinject_overhead(n_guard: int = 200_000, n_wire: int = 4_000) -> dict:
+    """Disabled-path cost gate for the fault-injection shims (ISSUE 5
+    acceptance: with no plan installed, the shims must be
+    indistinguishable from the pre-chaos build).
+
+    With no plan, every shim is ``if runtime.active_plan is not None``
+    — one module-attribute load.  Two measurements, best-of-3
+    interleaved like the other gates:
+
+    - ``guard_ns``: the no-plan check itself, measured in a tight loop
+      (the exact expression the shims execute).
+    - ``wire_roundtrip_us``: one npwire encode+decode of a small frame
+      (the hot path that carries the most shims), with the shims in
+      place and no plan.
+
+    The gate PASSES when the projected per-RPC shim cost — the guard
+    executed at every wired-in choke point an RPC crosses (client
+    encode/send/recv/decode + server recv/decode/compute/encode/send
+    ≈ 10 sites) — stays under 1% of the ~110 us grpc.aio transport
+    floor every real RPC pays (docs/performance.md "Host lane
+    budget"); the codec round-trip is reported alongside for scale.
+    An if-check that got accidentally expensive (e.g. a property call
+    or an import in the hot path) trips it.
+    """
+    from pytensor_federated_tpu.faultinject import runtime as fi_rt
+    from pytensor_federated_tpu.service.npwire import (
+        decode_arrays_all,
+        encode_arrays,
+    )
+
+    if fi_rt.active_plan is not None:  # the gate measures the OFF path
+        fi_rt.uninstall()
+    x = np.zeros(8, np.float32)
+
+    def guard_loop() -> float:
+        t0 = time.perf_counter()
+        for _ in range(n_guard):
+            if fi_rt.active_plan is not None:  # the shims' exact guard
+                raise AssertionError("unreachable")
+        return (time.perf_counter() - t0) / n_guard
+
+    def wire_loop() -> float:
+        t0 = time.perf_counter()
+        for _ in range(n_wire):
+            decode_arrays_all(encode_arrays([x], uuid=b"b" * 16))
+        return (time.perf_counter() - t0) / n_wire
+
+    guard_s = wire_s = float("inf")
+    for _ in range(3):
+        guard_s = min(guard_s, guard_loop())
+        wire_s = min(wire_s, wire_loop())
+    shim_sites_per_rpc = 10
+    rpc_floor_s = 110e-6  # grpc.aio per-call floor, docs/performance.md
+    overhead_frac = (guard_s * shim_sites_per_rpc) / rpc_floor_s
+    return {
+        "guard_ns": round(guard_s * 1e9, 2),
+        "wire_roundtrip_us": round(wire_s * 1e6, 2),
+        "shim_sites_per_rpc": shim_sites_per_rpc,
+        "overhead_frac_of_rpc_floor": round(overhead_frac, 6),
+        "pass": bool(overhead_frac < 0.01 and guard_s < 1e-6),
+    }
+
+
 class MeasurementIntegrityError(RuntimeError):
     """A timing the integrity guards refuse to trust (degenerate chain,
     inconsistent stages, physics-impossible rate).  A DEDICATED type so
@@ -582,6 +645,11 @@ def main():
     except Exception as e:  # same invariant
         batcher = {"error": f"{type(e).__name__}: {e}", "pass": False}
 
+    try:
+        fault_shims = faultinject_overhead()
+    except Exception as e:  # same invariant
+        fault_shims = {"error": f"{type(e).__name__}: {e}", "pass": False}
+
     print(
         json.dumps(
             {
@@ -597,6 +665,7 @@ def main():
                 "impl": best,
                 "telemetry_overhead": overhead,
                 "batcher_overhead": batcher,
+                "faultinject_overhead": fault_shims,
                 **flop_extra,
             }
         )

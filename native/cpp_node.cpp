@@ -35,7 +35,20 @@
 // float64; outputs [logp(), dlogp/dintercept(), dlogp/dslope()].
 //
 // Build: make -C native   (-> native/cpp_node)
-// Run:   ./cpp_node <port> [<port> ...]
+// Run:   ./cpp_node <port> [<port> ...] [--fault-plan <spec-or-file>]
+//
+// Fault injection (the cross-language slice of the chaos subsystem,
+// pytensor_federated_tpu/faultinject — FaultPlan.native_spec() emits
+// this format): comma-separated rules, each anchored to the nth frame
+// this process serves (process-wide counter, batch frames count once):
+//   delay:<nth>:<ms>        sleep <ms> before sending the nth reply
+//   disconnect:<nth>        close the connection instead of replying
+//   truncate:<nth>:<pct>    send the length prefix plus only <pct>% of
+//                           the nth reply's bytes, then close — the
+//                           mid-frame kill (peer reads a short frame)
+// The spec is taken literally, or — if it names a readable file — read
+// from that file.  A malformed spec exits 2 loudly: a chaos run whose
+// plan silently failed to parse would test nothing.
 //
 // One listener thread per port (the in-process analog of the
 // reference's one-process-per-port worker pool,
@@ -51,12 +64,16 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <atomic>
 #include <cerrno>
 #include <cmath>
 #include <csignal>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
+#include <fstream>
+#include <sstream>
 #include <string>
 #include <system_error>
 #include <thread>
@@ -439,6 +456,61 @@ Message compute(const Message& in) {
   return out;
 }
 
+// ---- fault injection (--fault-plan) -------------------------------------
+
+struct FaultRule {
+  enum Kind { kDelay, kDisconnect, kTruncate } kind;
+  uint64_t nth;    // 1-based frame number this rule fires on
+  uint64_t param;  // delay: milliseconds; truncate: percent kept
+};
+
+std::vector<FaultRule> g_fault_rules;
+std::atomic<uint64_t> g_frames{0};
+
+const FaultRule* fault_for(uint64_t frame_no) {
+  for (const auto& r : g_fault_rules)
+    if (r.nth == frame_no) return &r;
+  return nullptr;
+}
+
+// "delay:2:50,disconnect:4,truncate:6:50" (or a file holding it) ->
+// g_fault_rules; false on any malformed entry.
+bool parse_fault_plan(const std::string& arg) {
+  std::string spec = arg;
+  std::ifstream f(arg);
+  if (f.good()) {
+    std::stringstream ss;
+    ss << f.rdbuf();
+    spec = ss.str();
+  }
+  std::stringstream entries(spec);
+  std::string entry;
+  while (std::getline(entries, entry, ',')) {
+    // Trim whitespace/newlines a file-sourced spec may carry.
+    while (!entry.empty() && std::isspace(entry.back())) entry.pop_back();
+    while (!entry.empty() && std::isspace(entry.front())) entry.erase(0, 1);
+    if (entry.empty()) continue;
+    FaultRule r{};
+    unsigned long long nth = 0, param = 0;
+    if (std::sscanf(entry.c_str(), "delay:%llu:%llu", &nth, &param) == 2) {
+      r.kind = FaultRule::kDelay;
+    } else if (std::sscanf(entry.c_str(), "disconnect:%llu", &nth) == 1) {
+      r.kind = FaultRule::kDisconnect;
+    } else if (std::sscanf(entry.c_str(), "truncate:%llu:%llu", &nth,
+                           &param) == 2) {
+      r.kind = FaultRule::kTruncate;
+      if (param > 100) return false;
+    } else {
+      return false;
+    }
+    if (nth == 0) return false;  // 1-based, like the Python plan
+    r.nth = nth;
+    r.param = param;
+    g_fault_rules.push_back(r);
+  }
+  return true;
+}
+
 // ---- server loop --------------------------------------------------------
 
 // Upper bound on one frame's payload.  Big enough for any realistic
@@ -453,6 +525,13 @@ void serve_connection(int fd) try {
     if (len > kMaxFrameBytes) return;      // hostile length prefix
     std::vector<uint8_t> buf(len);
     if (!read_exact(fd, buf.data(), len)) return;
+    const FaultRule* fault =
+        g_fault_rules.empty() ? nullptr : fault_for(++g_frames);
+    if (fault && fault->kind == FaultRule::kDisconnect) {
+      std::fprintf(stderr, "faultinject[disconnect] frame %llu\n",
+                   static_cast<unsigned long long>(fault->nth));
+      return;  // close without replying — the peer sees a dead socket
+    }
     // Batch frames (flag 8) take the per-item path; everything else is
     // the classic lock-step single evaluate.
     std::vector<uint8_t> payload =
@@ -460,6 +539,22 @@ void serve_connection(int fd) try {
             ? serve_batch(buf)
             : serve_plain(buf);
     uint32_t plen = static_cast<uint32_t>(payload.size());
+    if (fault && fault->kind == FaultRule::kDelay)
+      ::usleep(static_cast<useconds_t>(fault->param) * 1000);
+    if (fault && fault->kind == FaultRule::kTruncate) {
+      // Mid-frame kill: the prefix promises plen bytes, fewer arrive,
+      // then the connection closes — the peer's framed read fails
+      // loudly ("peer closed mid-frame"), never a silent short frame.
+      size_t keep = payload.size() * fault->param / 100;
+      if (payload.size() > 1)
+        keep = std::min(std::max<size_t>(keep, 1), payload.size() - 1);
+      std::fprintf(stderr, "faultinject[truncate] frame %llu (%zu/%zu)\n",
+                   static_cast<unsigned long long>(fault->nth), keep,
+                   payload.size());
+      write_exact(fd, &plen, 4);
+      write_exact(fd, payload.data(), keep);
+      return;
+    }
     if (!write_exact(fd, &plen, 4) ||
         !write_exact(fd, payload.data(), payload.size()))
       return;
@@ -532,21 +627,34 @@ void accept_loop(int srv) {
 }  // namespace
 
 int main(int argc, char** argv) {
-  if (argc < 2) {
-    std::fprintf(stderr, "usage: %s <port> [<port> ...]\n", argv[0]);
+  std::vector<int> ports;
+  for (int i = 1; i < argc; ++i) {
+    if (std::strcmp(argv[i], "--fault-plan") == 0) {
+      if (i + 1 >= argc || !parse_fault_plan(argv[++i])) {
+        std::fprintf(stderr, "bad --fault-plan spec\n");
+        return 2;
+      }
+      continue;
+    }
+    ports.push_back(std::atoi(argv[i]));
+  }
+  if (ports.empty()) {
+    std::fprintf(stderr,
+                 "usage: %s <port> [<port> ...] [--fault-plan <spec>]\n",
+                 argv[0]);
     return 2;
   }
   ::signal(SIGPIPE, SIG_IGN);
 
   std::vector<int> socks;
-  for (int i = 1; i < argc; ++i) {
-    int srv = listen_on(std::atoi(argv[i]));
+  for (int port : ports) {
+    int srv = listen_on(port);
     if (srv < 0) return 1;
     socks.push_back(srv);
   }
   // Readiness lines on stdout — the Python test waits for the first.
-  for (int i = 1; i < argc; ++i)
-    std::printf("cpp_node listening on 127.0.0.1:%d\n", std::atoi(argv[i]));
+  for (int port : ports)
+    std::printf("cpp_node listening on 127.0.0.1:%d\n", port);
   std::fflush(stdout);
 
   std::vector<std::thread> listeners;

@@ -52,6 +52,7 @@ import time
 import uuid as uuid_mod
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from ..faultinject import runtime as _fi
 from ..telemetry import flightrec as _flightrec
 from ..telemetry import metrics as _metrics
 from .breaker import CircuitBreaker
@@ -377,6 +378,10 @@ class NodePool:
     async def _probe_replica_grpc(self, replica: Replica) -> bool:
         from ..service.client import get_load_async
 
+        if _fi.active_plan is not None:  # chaos seam: probe lane
+            if not _fi.probe_filter(replica.address):
+                replica.record_load(None)
+                return False
         t0 = time.perf_counter()
         load = await get_load_async(
             replica.host, replica.port, timeout=self.probe_timeout_s
@@ -400,6 +405,10 @@ class NodePool:
             loop = asyncio.get_running_loop()
 
             def one(r: Replica) -> bool:
+                if _fi.active_plan is not None:  # chaos seam: probe lane
+                    if not _fi.probe_filter(r.address):
+                        r.record_load(None)
+                        return False
                 t0 = time.perf_counter()
                 ok = _tcp_probe(
                     r.host, r.port, timeout=self.probe_timeout_s

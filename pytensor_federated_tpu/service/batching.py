@@ -40,6 +40,7 @@ from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..faultinject import runtime as _fi
 from ..telemetry import flightrec as _flightrec
 from ..telemetry import metrics as _metrics
 from ..telemetry import spans as _spans
@@ -172,6 +173,10 @@ def execute_window_sync(
     if use_batch:
         try:
             outs = batch_fn(list(requests))
+            if _fi.active_plan is not None:  # chaos: vectorized seam
+                outs = _fi.mangle_batch_result(
+                    "server.compute_batch", outs
+                )
             if len(outs) != k:
                 raise RuntimeError(
                     f"batch_fn returned {len(outs)} results for "
@@ -396,6 +401,10 @@ class MicroBatcher:
             t0 = time.perf_counter()
             try:
                 outs = self.batch_fn([p.inputs for p in group])
+                if _fi.active_plan is not None:  # chaos: vectorized seam
+                    outs = _fi.mangle_batch_result(
+                        "server.compute_batch", outs
+                    )
                 if len(outs) != k:
                     raise RuntimeError(
                         f"batch_fn returned {len(outs)} results "

@@ -40,6 +40,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import grpc
 import numpy as np
 
+from ..faultinject import runtime as _fi
 from ..telemetry import flightrec as _flightrec
 from ..telemetry import reunion as _reunion
 from ..telemetry import spans as _spans
@@ -93,6 +94,27 @@ def _is_retryable(exc: BaseException) -> bool:
     if isinstance(exc, grpc.aio.AioRpcError):
         return exc.code() not in _NO_RETRY_STATUS
     return True
+
+
+async def _stream_write(stream, payload: bytes) -> None:
+    """``stream.write`` with dead-stream translation: writing to an RPC
+    the server already aborted raises ``asyncio.InvalidStateError``
+    ("RPC already finished"), which is TRANSPORT trouble — without the
+    translation it would escape the retry/failover classification and
+    surface as an unclassified crash (found by tools/chaos_run.py:
+    a server aborting mid-window left the next write unclassified)."""
+    try:
+        await stream.write(payload)
+    except asyncio.InvalidStateError as e:
+        raise ConnectionError(f"stream already finished: {e}") from e
+
+
+async def _stream_read(stream):
+    """``stream.read`` with the same dead-stream translation."""
+    try:
+        return await stream.read()
+    except asyncio.InvalidStateError as e:
+        raise ConnectionError(f"stream already finished: {e}") from e
 
 
 async def get_load_async(
@@ -444,18 +466,26 @@ class ArraysToArraysServiceClient:
 
     async def _evaluate_once(self, request: bytes) -> bytes:
         privates = await self._get_privates()
+        peer = f"{privates.host}:{privates.port}"
+        if _fi.active_plan is not None:  # chaos seam (faultinject)
+            request = await _fi.filter_bytes_async("grpc.send", request, peer)
         if privates.stream is not None:
             # Lock-step bidi hot loop (reference: _streamed_evaluate,
             # service.py:150-158).
-            await privates.stream.write(request)
-            reply = await privates.stream.read()
+            await _stream_write(privates.stream, request)
+            reply = await _stream_read(privates.stream)
             if reply is grpc.aio.EOF:
                 raise ConnectionError("stream closed by server")
+            if _fi.active_plan is not None:  # chaos seam
+                reply = await _fi.filter_bytes_async("grpc.recv", reply, peer)
             return reply
         method = privates.channel.unary_unary(
             EVALUATE, request_serializer=_identity, response_deserializer=_identity
         )
-        return await method(request)
+        reply = await method(request)
+        if _fi.active_plan is not None:  # chaos seam
+            reply = await _fi.filter_bytes_async("grpc.recv", reply, peer)
+        return reply
 
     def _encode_request(self, arrays):
         """(request_bytes, uuid, decode) for one call under the active
@@ -608,6 +638,7 @@ class ArraysToArraysServiceClient:
         still-``None`` tail.
         """
         privates = await self._get_privates()
+        peer = f"{privates.host}:{privates.port}"
         n = len(encoded)
         results: List[Optional[List[np.ndarray]]] = (
             out if out is not None else [None] * n
@@ -620,12 +651,18 @@ class ArraysToArraysServiceClient:
             )
             for start in range(0, n, window):
                 chunk = encoded[start : start + window]
+                reqs = [req for req, _u, _d in chunk]
+                if _fi.active_plan is not None:  # chaos seam
+                    reqs = [
+                        await _fi.filter_bytes_async("grpc.send", r, peer)
+                        for r in reqs
+                    ]
                 # return_exceptions: every sibling RPC settles before we
                 # raise, so a failing chunk never leaves orphan tasks
                 # whose channel _drop_privates then closes under them
                 # ("Task exception was never retrieved" spam).
                 replies = await asyncio.gather(
-                    *(method(req) for req, _u, _d in chunk),
+                    *(method(req) for req in reqs),
                     return_exceptions=True,
                 )
                 for reply in replies:
@@ -666,15 +703,22 @@ class ArraysToArraysServiceClient:
                         <= max_inflight_bytes
                     )
                 ):
-                    await stream.write(encoded[write_idx][0])
+                    payload = encoded[write_idx][0]
+                    if _fi.active_plan is not None:  # chaos seam
+                        payload = await _fi.filter_bytes_async(
+                            "grpc.send", payload, peer
+                        )
+                    await _stream_write(stream, payload)
                     inflight_bytes += len(encoded[write_idx][0])
                     write_idx += 1
                 _WINDOW_DEPTH.labels(transport="grpc").observe(
                     write_idx - read_idx
                 )
-                reply = await stream.read()
+                reply = await _stream_read(stream)
                 if reply is grpc.aio.EOF:
                     raise ConnectionError("stream closed by server")
+                if _fi.active_plan is not None:  # chaos seam
+                    reply = await _fi.filter_bytes_async("grpc.recv", reply, peer)
                 _req, uuid, decode = encoded[read_idx]
                 inflight_bytes -= len(_req)
                 try:
@@ -700,7 +744,7 @@ class ArraysToArraysServiceClient:
                     # deterministic server error (no retry — same
                     # policy as evaluate_async).
                     for _ in range(write_idx - read_idx - 1):
-                        drained = await stream.read()
+                        drained = await _stream_read(stream)
                         if drained is grpc.aio.EOF:
                             break
                     raise RuntimeError(f"server error: {error}")
@@ -781,6 +825,7 @@ class ArraysToArraysServiceClient:
         :meth:`_evaluate_many_once` (frame-granular here: a frame's
         items land together when its reply validates)."""
         privates = await self._get_privates()
+        peer = f"{privates.host}:{privates.port}"
         n = len(encoded)
         chunk = max(1, min(window, max_batch))
         trace_id = _spans.current_trace_id() if _spans.enabled() else None
@@ -868,8 +913,14 @@ class ArraysToArraysServiceClient:
             frames_per_gather = max(1, window // chunk)
             for start_f in range(0, len(frames), frames_per_gather):
                 part_f = frames[start_f : start_f + frames_per_gather]
+                payloads = [frame for frame, _u, _s, _p in part_f]
+                if _fi.active_plan is not None:  # chaos seam
+                    payloads = [
+                        await _fi.filter_bytes_async("grpc.send", p, peer)
+                        for p in payloads
+                    ]
                 replies = await asyncio.gather(
-                    *(method(frame) for frame, _u, _s, _p in part_f),
+                    *(method(frame) for frame in payloads),
                     return_exceptions=True,
                 )
                 for reply in replies:
@@ -894,15 +945,22 @@ class ArraysToArraysServiceClient:
                     or inflight_bytes + len(frames[write_idx][0])
                     <= max_inflight_bytes
                 ):
-                    await stream.write(frames[write_idx][0])
+                    payload = frames[write_idx][0]
+                    if _fi.active_plan is not None:  # chaos seam
+                        payload = await _fi.filter_bytes_async(
+                            "grpc.send", payload, peer
+                        )
+                    await _stream_write(stream, payload)
                     inflight_bytes += len(frames[write_idx][0])
                     write_idx += 1
                 _WINDOW_DEPTH.labels(transport="grpc").observe(
                     write_idx - read_idx
                 )
-                reply = await stream.read()
+                reply = await _stream_read(stream)
                 if reply is grpc.aio.EOF:
                     raise ConnectionError("stream closed by server")
+                if _fi.active_plan is not None:  # chaos seam
+                    reply = await _fi.filter_bytes_async("grpc.recv", reply, peer)
                 inflight_bytes -= len(frames[read_idx][0])
                 await consume(
                     reply,
@@ -925,7 +983,7 @@ class ArraysToArraysServiceClient:
         if privates.stream is None:
             return
         for _ in range(n_frames):
-            drained = await privates.stream.read()
+            drained = await _stream_read(privates.stream)
             if drained is grpc.aio.EOF:
                 break
 

@@ -69,6 +69,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..faultinject import runtime as _fi
 from .npwire import WireError
 
 __all__ = [
@@ -333,6 +334,8 @@ def encode_arrays_msg(
                 f"trace_id must be 16 bytes, got {len(trace_id)}"
             )
         out += _len_field(15, trace_id)
+    if _fi.active_plan is not None:  # chaos seam (faultinject.runtime)
+        return _fi.filter_bytes("npproto.encode", bytes(out))
     return bytes(out)
 
 
@@ -361,6 +364,8 @@ def encode_batch_msg(
         out += _len_field(15, trace_id)
     for item in items:
         out += _len_field(17, item)
+    if _fi.active_plan is not None:  # chaos seam (faultinject.runtime)
+        return _fi.filter_bytes("npproto.encode_batch", bytes(out))
     return bytes(out)
 
 
@@ -386,6 +391,8 @@ def decode_batch_msg(
     """Decode a batch message -> (items, uuid, trace_id, spans);
     ``items`` are the nested messages still encoded (decode each with
     :func:`decode_arrays_msg_full`)."""
+    if _fi.active_plan is not None:  # chaos seam (faultinject.runtime)
+        buf = _fi.filter_bytes("npproto.decode_batch", buf)
     items: List[bytes] = []
     uuid = ""
     trace_id: Optional[bytes] = None
@@ -465,6 +472,8 @@ def decode_arrays_msg_full(
     or unparseable — a garbled instrumentation sidecar must not fail
     the RPC that carried real results); ``error`` is the per-item
     failure channel (field 14) batch reply items carry."""
+    if _fi.active_plan is not None:  # chaos seam (faultinject.runtime)
+        buf = _fi.filter_bytes("npproto.decode", buf)
     arrays: List[np.ndarray] = []
     uuid = ""
     error: Optional[str] = None

@@ -79,6 +79,8 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 from numpy.lib.format import descr_to_dtype, dtype_to_descr
 
+from ..faultinject import runtime as _fi
+
 MAGIC = b"NPW1"
 _FLAG_ERROR = 1
 _FLAG_TRACE = 2
@@ -175,7 +177,10 @@ def encode_arrays(
         data = a.tobytes()
         parts.append(struct.pack("<Q", len(data)))
         parts.append(data)
-    return b"".join(parts)
+    out = b"".join(parts)
+    if _fi.active_plan is not None:  # chaos seam (faultinject.runtime)
+        out = _fi.filter_bytes("npwire.encode", out)
+    return out
 
 
 def encode_batch(
@@ -221,7 +226,10 @@ def encode_batch(
             raise WireError("batch items must be complete npwire frames")
         parts.append(struct.pack("<I", len(item)))
         parts.append(item)
-    return b"".join(parts)
+    out = b"".join(parts)
+    if _fi.active_plan is not None:  # chaos seam (faultinject.runtime)
+        out = _fi.filter_bytes("npwire.encode_batch", out)
+    return out
 
 
 def is_batch_frame(buf: bytes) -> bool:
@@ -242,6 +250,8 @@ def decode_batch(
     ``items`` are the K framed sub-messages, still encoded — decode
     each with :func:`decode_arrays_all` (they may individually carry
     error blocks: per-item failure isolation)."""
+    if _fi.active_plan is not None:  # chaos seam (faultinject.runtime)
+        buf = _fi.filter_bytes("npwire.decode_batch", buf)
     try:
         magic, version, flags, uuid, n = struct.unpack_from("<4sBB16sI", buf, 0)
     except struct.error as e:
@@ -361,6 +371,8 @@ def decode_arrays_all(
     """Full decode -> (arrays, uuid, error, trace_id, spans) where
     ``spans`` is the piggybacked span-tree list (``None`` when the flag
     is unset)."""
+    if _fi.active_plan is not None:  # chaos seam (faultinject.runtime)
+        buf = _fi.filter_bytes("npwire.decode", buf)
     try:
         magic, version, flags, uuid, n = struct.unpack_from("<4sBB16sI", buf, 0)
     except struct.error as e:

@@ -34,6 +34,7 @@ from typing import Callable, Optional, Sequence
 import grpc
 import numpy as np
 
+from ..faultinject import runtime as _fi
 from ..signatures import ComputeFn
 from ..telemetry import flightrec as _flightrec
 from ..telemetry import metrics as _metrics
@@ -87,6 +88,43 @@ EVALUATE_STREAM = f"/{SERVICE_NAME}/EvaluateStream"
 GET_LOAD = f"/{SERVICE_NAME}/GetLoad"
 
 _identity = lambda b: b  # noqa: E731  (raw-bytes (de)serializer)
+
+
+async def _fi_reply_filter(reply: bytes, context, *, unary: bool = False) -> tuple:
+    """``grpc.server.reply`` chaos seam -> ``(reply_bytes, n_copies)``.
+
+    Async on purpose: delay/stall are awaited so a chaos-slowed reply
+    behaves like a genuinely slow node (GetLoad and sibling streams
+    keep serving).  ``drop``/``disconnect`` abort the RPC with
+    UNAVAILABLE — the transient classification, so a pooled client
+    fails over instead of burning a no-retry error.  ``duplicate_reply``
+    returns ``n_copies=2`` for the stream lane to yield twice; on the
+    unary lane (one reply per RPC by construction) it is a plan-
+    authoring bug and raises, rather than booking a fire that injected
+    nothing."""
+    rule = _fi.decide("grpc.server.reply")
+    if rule is None:
+        return reply, 1
+    kind = rule.kind
+    if kind in ("delay", "stall"):
+        await asyncio.sleep(rule.delay_s if kind == "delay" else rule.stall_s)
+        return reply, 1
+    if kind in ("drop", "disconnect"):
+        if context is not None:
+            await context.abort(
+                grpc.StatusCode.UNAVAILABLE,
+                f"faultinject[{kind}]: reply withheld",
+            )
+        raise ConnectionError(f"faultinject[{kind}] at grpc.server.reply")
+    if kind == "duplicate_reply":
+        if unary:
+            raise _fi.FaultPlanError(
+                "duplicate_reply cannot be expressed on the unary lane"
+            )
+        return reply, 2
+    # truncate_frame / corrupt_bytes / kill_process share the byte-lane
+    # semantics (an inapplicable kind raises FaultPlanError, loudly).
+    return _fi.apply_to_bytes(rule, reply, "grpc.server.reply"), 1
 
 
 def device_compute_fn(
@@ -209,6 +247,11 @@ class ArraysToArraysService:
                 inline=inline_compute,
             )
         self._n_clients = 0
+        # Graceful-drain state: while draining, NEW work is rejected
+        # with a retryable UNAVAILABLE (the pool fails over cleanly)
+        # and :meth:`drain` waits for in-flight work to settle.
+        self._draining = False
+        self._inflight_rpcs = 0
         # Start psutil's interval-based CPU accounting early so the
         # first real query is meaningful (reference: service.py:84-85).
         try:
@@ -292,6 +335,8 @@ class ArraysToArraysService:
             err_reply = None
             try:
                 with _spans.span("compute") as c_span:
+                    if _fi.active_plan is not None:  # chaos seam
+                        await _fi.compute_filter_async()
                     if self._batcher is not None:
                         # Micro-batching engine: this request coalesces
                         # with any concurrently in-flight siblings (the
@@ -377,6 +422,16 @@ class ArraysToArraysService:
         engine (slow executor compute, no vectorized variant) the
         window fans out over the executor's workers, preserving the
         concurrency the per-RPC path has."""
+        if _fi.active_plan is not None:  # chaos seam: compute path
+            try:
+                await _fi.compute_filter_async()
+            except _fi.FaultPlanError:
+                raise  # a plan-authoring bug stays LOUD, never in-band
+            except RuntimeError as e:
+                # Injected compute failure covers the whole window,
+                # per item and in-band — exactly like a real pre-
+                # dispatch failure would.
+                return [e for _ in to_compute]
         if self._batcher is not None:
             return await self._batcher.submit_many(to_compute)
 
@@ -534,15 +589,74 @@ class ArraysToArraysService:
             )
         return reply
 
+    # -- graceful drain ---------------------------------------------------
+
+    async def _reject_if_draining(self, context) -> None:
+        """While draining, NEW work is refused with a retryable status:
+        UNAVAILABLE is outside the client's no-retry set (client.py
+        ``_NO_RETRY_STATUS``), so pinned clients retry-and-rebalance and
+        the replica pool books a transient failure and fails the work
+        over — the clean half of a rolling restart."""
+        if self._draining:
+            _flightrec.record("server.drain_reject")
+            if context is not None:
+                await context.abort(
+                    grpc.StatusCode.UNAVAILABLE, "node draining"
+                )
+            raise ConnectionError("node draining")
+
+    async def drain(self, timeout_s: float = 30.0) -> bool:
+        """Begin a graceful drain: reject new work (see
+        :meth:`_reject_if_draining`), then wait for every in-flight RPC
+        — including requests parked in the micro-batcher's coalescing
+        queue — to finish.  Returns ``True`` when the node went idle
+        within ``timeout_s`` (``False`` = timed out with work still in
+        flight; the caller may stop the server anyway or keep waiting).
+        Idempotent; :meth:`undrain` re-opens the node."""
+        self._draining = True
+        _flightrec.record("server.drain_begin", inflight=self._inflight_rpcs)
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout_s
+
+        def busy() -> bool:
+            if self._inflight_rpcs > 0:
+                return True
+            b = self._batcher
+            return b is not None and (
+                b.queue_depth > 0 or b._worker is not None
+            )
+
+        while busy() and loop.time() < deadline:
+            await asyncio.sleep(0.01)
+        clean = not busy()
+        _flightrec.record(
+            "server.drained", clean=clean, inflight=self._inflight_rpcs
+        )
+        return clean
+
+    def undrain(self) -> None:
+        """Re-open a draining/drained node for new work."""
+        self._draining = False
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
     # -- RPC methods ------------------------------------------------------
 
     async def evaluate(self, request: bytes, context) -> bytes:
+        await self._reject_if_draining(context)
         _REQUESTS.labels(method="evaluate").inc()
         _INFLIGHT.inc()
+        self._inflight_rpcs += 1
         try:
-            return await self._run_compute(request)
+            reply = await self._run_compute(request)
         finally:
             _INFLIGHT.dec()
+            self._inflight_rpcs -= 1
+        if _fi.active_plan is not None:  # chaos seam: reply lane
+            reply, _n = await _fi_reply_filter(reply, context, unary=True)
+        return reply
 
     async def evaluate_stream(self, request_iterator, context):
         """Lock-step bidi stream: one reply per request, in order
@@ -551,13 +665,24 @@ class ArraysToArraysService:
         _log.info("stream opened (n_clients=%d)", self._n_clients)
         try:
             async for request in request_iterator:
+                # Per request, not per stream: a drain beginning mid-
+                # stream rejects the stream's NEXT request (retryable),
+                # while requests already being served run to completion.
+                await self._reject_if_draining(context)
                 _REQUESTS.labels(method="evaluate_stream").inc()
                 _INFLIGHT.inc()
+                self._inflight_rpcs += 1
                 try:
                     reply = await self._run_compute(request)
                 finally:
                     _INFLIGHT.dec()
-                yield reply
+                    self._inflight_rpcs -= 1
+                if _fi.active_plan is not None:  # chaos seam: reply lane
+                    reply, n_copies = await _fi_reply_filter(reply, context)
+                    for _ in range(n_copies):
+                        yield reply
+                else:
+                    yield reply
         finally:
             self._n_clients -= 1
             _log.info("stream closed (n_clients=%d)", self._n_clients)
@@ -632,6 +757,10 @@ class ArraysToArraysService:
         The npproto reply schema is fixed — no room for traces there.
         """
         _REQUESTS.labels(method="get_load").inc()
+        if _fi.active_plan is not None:  # chaos seam: probe lane
+            garbage = _fi.getload_filter()
+            if garbage is not None:
+                return garbage
         load = self.determine_load()
         if self.getload_wire == "npproto":
             from . import npproto_codec

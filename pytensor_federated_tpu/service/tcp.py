@@ -24,6 +24,7 @@ from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
+from ..faultinject import runtime as _fi
 from ..telemetry import flightrec as _flightrec
 from ..telemetry import reunion as _reunion
 from ..telemetry import spans as _spans
@@ -77,6 +78,15 @@ def _recv_frame(sock: socket.socket) -> bytes:
     return _recv_exact(sock, n)
 
 
+def _serve_send(conn: socket.socket, payload: bytes) -> None:
+    """Server-side frame send, routed through the chaos seam
+    (``tcp.server.send``) when a fault plan is installed."""
+    if _fi.active_plan is not None:
+        _fi.send_frame_through("tcp.server.send", conn.sendall, payload)
+    else:
+        _send_frame(conn, payload)
+
+
 class TcpArraysClient:
     """Arrays-in → arrays-out over one persistent TCP connection.
 
@@ -94,6 +104,9 @@ class TcpArraysClient:
         *,
         retries: int = 2,
         max_inflight_bytes: Optional[int] = None,
+        connect_timeout_s: float = 30.0,
+        connect_retries: int = 1,
+        connect_backoff_s: float = 0.05,
     ):
         """``max_inflight_bytes`` caps the pipelined window's in-flight
         REQUEST bytes (deadlock guard, see ``evaluate_many``).  The
@@ -101,19 +114,51 @@ class TcpArraysClient:
         to fit a few copies of the first encoded request — so a
         workload whose single request exceeds 32 KiB does not silently
         degrade to lock-step — and clamped to the socket's send-buffer
-        size (the actual deadlock boundary)."""
+        size (the actual deadlock boundary).
+
+        ``connect_timeout_s`` bounds each initial-connect attempt (the
+        old hard-coded 30 s, now a knob: a pool sweeping replicas wants
+        sub-second verdicts); ``connect_retries`` re-attempts a failed
+        connect with a ``connect_backoff_s`` pause between tries —
+        exhaustion raises :class:`ConnectionError`, which every caller
+        (the retry loop here, the replica pool's ``is_transient``)
+        classifies as transport trouble, so failover proceeds cleanly."""
         self.host = host
         self.port = int(port)
         self.retries = retries
         self.max_inflight_bytes = max_inflight_bytes
+        self.connect_timeout_s = float(connect_timeout_s)
+        self.connect_retries = int(connect_retries)
+        self.connect_backoff_s = float(connect_backoff_s)
         self._sock: Optional[socket.socket] = None
         self._rfile = None  # buffered reader over _sock
         # Per-connection batch-frame capability (None = not probed).
         self._batch_ok: Optional[bool] = None
 
+    @property
+    def _peer(self) -> str:
+        return f"{self.host}:{self.port}"
+
     def _connect(self) -> socket.socket:
         if self._sock is None:
-            s = socket.create_connection((self.host, self.port), timeout=30.0)
+            last_err: Optional[Exception] = None
+            for attempt in range(self.connect_retries + 1):
+                if attempt:
+                    time.sleep(self.connect_backoff_s)
+                try:
+                    s = socket.create_connection(
+                        (self.host, self.port),
+                        timeout=self.connect_timeout_s,
+                    )
+                    break
+                except (ConnectionError, OSError) as e:
+                    last_err = e
+            else:
+                raise ConnectionError(
+                    f"connect to {self._peer} failed after "
+                    f"{self.connect_retries + 1} attempts "
+                    f"(timeout {self.connect_timeout_s}s)"
+                ) from last_err
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._sock = s
             # Buffered reads: a frame costs one length + one payload
@@ -176,8 +221,18 @@ class TcpArraysClient:
                 try:
                     with _spans.span("call"):
                         sock = self._connect()
-                        _send_frame(sock, request)
+                        if _fi.active_plan is not None:  # chaos seam
+                            _fi.send_frame_through(
+                                "tcp.send", sock.sendall, request,
+                                peer=self._peer,
+                            )
+                        else:
+                            _send_frame(sock, request)
                         reply = self._read_frame()
+                        if _fi.active_plan is not None:  # chaos seam
+                            reply = _fi.filter_bytes(
+                                "tcp.recv", reply, self._peer
+                            )
                     break
                 except (ConnectionError, OSError) as e:
                     last_err = e
@@ -193,9 +248,18 @@ class TcpArraysClient:
                     f"{self.retries + 1} attempts"
                 ) from last_err
             with _spans.span("decode"):
-                outputs, reply_uid, error, _tid, node_spans = (
-                    decode_arrays_all(reply)
-                )
+                try:
+                    outputs, reply_uid, error, _tid, node_spans = (
+                        decode_arrays_all(reply)
+                    )
+                except Exception:
+                    # Corrupt reply: close so the NEXT call reconnects
+                    # cleanly instead of trusting a connection whose
+                    # framing already lied once — same posture as the
+                    # pipelined pass; the WireError surfaces loudly.
+                    _DROPS.labels(transport="tcp").inc()
+                    self.close()
+                    raise
                 if node_spans:
                     _reunion.ingest(node_spans)
             _CALL_S.labels(transport="tcp", mode="lockstep").observe(
@@ -502,16 +566,30 @@ class TcpArraysClient:
                 )
             ):
                 payload = encoded[write_idx][0]
-                burst.append(struct.pack("<I", len(payload)))
                 burst.append(payload)
                 inflight_bytes += len(payload)
                 write_idx += 1
             if burst:
-                sock.sendall(b"".join(burst))
+                if _fi.active_plan is not None:  # chaos seam: per frame
+                    for payload in burst:
+                        _fi.send_frame_through(
+                            "tcp.send", sock.sendall, payload,
+                            peer=self._peer,
+                        )
+                else:
+                    # One join, no per-frame concat copy: the hot path
+                    # must not pay chaos's plumbing (ISSUE 5 gate).
+                    parts = []
+                    for p in burst:
+                        parts.append(struct.pack("<I", len(p)))
+                        parts.append(p)
+                    sock.sendall(b"".join(parts))
             _WINDOW_DEPTH.labels(transport="tcp").observe(
                 write_idx - read_idx
             )
             reply = self._read_frame()
+            if _fi.active_plan is not None:  # chaos seam
+                reply = _fi.filter_bytes("tcp.recv", reply, self._peer)
             request, uid = encoded[read_idx]
             inflight_bytes -= len(request)
             try:
@@ -592,16 +670,30 @@ class TcpArraysClient:
                 <= max_inflight
             ):
                 payload = frames[write_idx][0]
-                burst.append(struct.pack("<I", len(payload)))
                 burst.append(payload)
                 inflight_bytes += len(payload)
                 write_idx += 1
             if burst:
-                sock.sendall(b"".join(burst))
+                if _fi.active_plan is not None:  # chaos seam: per frame
+                    for payload in burst:
+                        _fi.send_frame_through(
+                            "tcp.send", sock.sendall, payload,
+                            peer=self._peer,
+                        )
+                else:
+                    # One join, no per-frame concat copy: the hot path
+                    # must not pay chaos's plumbing (ISSUE 5 gate).
+                    parts = []
+                    for p in burst:
+                        parts.append(struct.pack("<I", len(p)))
+                        parts.append(p)
+                    sock.sendall(b"".join(parts))
             _WINDOW_DEPTH.labels(transport="tcp").observe(
                 write_idx - read_idx
             )
             reply = self._read_frame()
+            if _fi.active_plan is not None:  # chaos seam
+                reply = _fi.filter_bytes("tcp.recv", reply, self._peer)
             frame, outer_uuid, start, part = frames[read_idx]
             inflight_bytes -= len(frame)
             try:
@@ -691,6 +783,18 @@ def _serve_batch_payload(
         "node.evaluate_batch", wire="npwire", transport="tcp",
         n_items=len(items),
     ) as root:
+        if _fi.active_plan is not None:  # chaos seam: compute path
+            try:
+                _fi.compute_filter()
+            except _fi.FaultPlanError:
+                raise  # a plan-authoring bug stays LOUD, never in-band
+            except Exception as e:
+                # In-band, frame-level: the injected compute failure
+                # covers the whole window, exactly like a real one
+                # raised before per-item dispatch.
+                return encode_batch(
+                    [], uuid=outer_uuid, error=str(e)
+                )
         replies: List[Optional[bytes]] = [None] * len(items)
         decoded = []  # (slot, arrays, uuid)
         for i, item in enumerate(items):
@@ -725,6 +829,93 @@ def _serve_batch_payload(
     return reply
 
 
+def _serve_tcp_connection(
+    conn: socket.socket,
+    compute_fn: Callable[..., Sequence[np.ndarray]],
+) -> None:
+    """One connection's lock-step frame loop (shared by the sequential
+    and ``concurrent=True`` accept modes of :func:`serve_tcp_once`)."""
+    with conn:
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        while True:
+            try:
+                payload = _recv_frame(conn)
+            except (ConnectionError, OSError):
+                break
+            if _fi.active_plan is not None:  # chaos seam
+                try:
+                    payload = _fi.filter_bytes(
+                        "tcp.server.recv", payload
+                    )
+                except (ConnectionError, OSError):
+                    break
+            if is_batch_frame(payload):
+                try:
+                    _serve_send(
+                        conn,
+                        _serve_batch_payload(compute_fn, payload),
+                    )
+                except (ConnectionError, OSError):
+                    break
+                continue
+            try:
+                arrays, uid, _, trace_id = decode_arrays_ex(payload)
+            except Exception as e:
+                # A corrupt request fails ITS reply in-band and
+                # the connection keeps serving — a hostile or
+                # chaos-mangled frame must not tear down the
+                # node (mirror of cpp_node's serve_plain).
+                _flightrec.record(
+                    "server.error", stage="decode",
+                    wire="npwire", transport="tcp",
+                    error=str(e)[:200],
+                )
+                try:
+                    _serve_send(
+                        conn,
+                        encode_arrays(
+                            [], uuid=b"\0" * 16,
+                            error=f"decode error: {e}",
+                        ),
+                    )
+                except (ConnectionError, OSError):
+                    break
+                continue
+            # Node-side spans adopt the driver's wire trace id,
+            # same contract as the gRPC server (server.py).
+            with _spans.trace_context(trace_id), _spans.span(
+                "node.evaluate", wire="npwire", transport="tcp"
+            ) as root:
+                try:
+                    if _fi.active_plan is not None:  # chaos seam
+                        _fi.compute_filter()
+                    with _spans.span("compute"):
+                        outputs = [
+                            np.asarray(o)
+                            for o in compute_fn(*arrays)
+                        ]
+                    with _spans.span("encode"):
+                        reply = encode_arrays(outputs, uuid=uid)
+                except _fi.FaultPlanError:
+                    raise  # plan-authoring bug: LOUD, never in-band
+                except Exception as e:  # error -> error payload
+                    _flightrec.record(
+                        "server.error", stage="compute",
+                        wire="npwire", transport="tcp",
+                        error=str(e)[:200],
+                    )
+                    reply = encode_arrays([], uuid=uid, error=str(e))
+            # Reunion piggyback: traced requests get this
+            # node's span tree on the reply tail (untraced
+            # frames stay byte-identical to the PR-1 wire).
+            if trace_id is not None and root.span is not None:
+                reply = append_spans(reply, [root.span.to_dict()])
+            try:
+                _serve_send(conn, reply)
+            except (ConnectionError, OSError):
+                break
+
+
 def serve_tcp_once(
     compute_fn: Callable[..., Sequence[np.ndarray]],
     host: str = "127.0.0.1",
@@ -732,20 +923,29 @@ def serve_tcp_once(
     *,
     ready_callback: Optional[Callable[[int], None]] = None,
     max_connections: Optional[int] = None,
+    concurrent: bool = False,
 ) -> None:
     """Blocking pure-Python server for the same protocol.
 
     The in-language peer of ``native/cpp_node.cpp`` — used to test the
     client without a compiler, and as a template for third-language
-    nodes.  Serves connections sequentially; each connection processes
-    lock-step frames until the peer disconnects.  Batch frames (npwire
-    flag bit 8) are served with per-item error isolation; a compute_fn
-    carrying a ``.batch`` attribute (``device_compute_fn(...,
-    batched=True)``) executes same-signature windows as one vmapped
-    call.  ``port=0`` binds an ephemeral port reported through
-    ``ready_callback``.  ``max_connections`` bounds the accept loop
-    (None = forever).
+    nodes.  Serves connections sequentially by default;
+    ``concurrent=True`` serves each accepted connection on its own
+    daemon thread (the cpp_node accept model) so a held client
+    connection cannot starve health probes — what a replica pool
+    (routing/) needs from a pure-Python TCP node.  Each connection
+    processes lock-step frames until the peer disconnects; corrupt
+    frames are answered with in-band error replies, never a server
+    crash.  Batch frames (npwire flag bit 8) are served with per-item
+    error isolation; a compute_fn carrying a ``.batch`` attribute
+    (``device_compute_fn(..., batched=True)``) executes same-signature
+    windows as one vmapped call.  ``port=0`` binds an ephemeral port
+    reported through ``ready_callback``.  ``max_connections`` bounds
+    the accept loop (None = forever; in concurrent mode it bounds
+    accepts, not completions).
     """
+    import threading
+
     with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as srv:
         srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         srv.bind((host, port))
@@ -756,42 +956,11 @@ def serve_tcp_once(
         while max_connections is None or served < max_connections:
             conn, _ = srv.accept()
             served += 1
-            with conn:
-                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                while True:
-                    try:
-                        payload = _recv_frame(conn)
-                    except (ConnectionError, OSError):
-                        break
-                    if is_batch_frame(payload):
-                        _send_frame(
-                            conn, _serve_batch_payload(compute_fn, payload)
-                        )
-                        continue
-                    arrays, uid, _, trace_id = decode_arrays_ex(payload)
-                    # Node-side spans adopt the driver's wire trace id,
-                    # same contract as the gRPC server (server.py).
-                    with _spans.trace_context(trace_id), _spans.span(
-                        "node.evaluate", wire="npwire", transport="tcp"
-                    ) as root:
-                        try:
-                            with _spans.span("compute"):
-                                outputs = [
-                                    np.asarray(o)
-                                    for o in compute_fn(*arrays)
-                                ]
-                            with _spans.span("encode"):
-                                reply = encode_arrays(outputs, uuid=uid)
-                        except Exception as e:  # error -> error payload
-                            _flightrec.record(
-                                "server.error", stage="compute",
-                                wire="npwire", transport="tcp",
-                                error=str(e)[:200],
-                            )
-                            reply = encode_arrays([], uuid=uid, error=str(e))
-                    # Reunion piggyback: traced requests get this
-                    # node's span tree on the reply tail (untraced
-                    # frames stay byte-identical to the PR-1 wire).
-                    if trace_id is not None and root.span is not None:
-                        reply = append_spans(reply, [root.span.to_dict()])
-                    _send_frame(conn, reply)
+            if concurrent:
+                threading.Thread(
+                    target=_serve_tcp_connection,
+                    args=(conn, compute_fn),
+                    daemon=True,
+                ).start()
+            else:
+                _serve_tcp_connection(conn, compute_fn)

@@ -32,6 +32,10 @@ kind                      emitted by
 ``probe.backend``             subprocess backend-liveness probe verdicts (utils)
 ``watchdog.fired``            an armed deadline expiring (:mod:`.watchdog`)
 ``incident.bundle``           an incident bundle hitting disk
+``fault.<kind>``              every injected fault (faultinject.runtime) with
+                              plan id / rule / point / trace id
+``fault.plan_*``              fault-plan install/uninstall lifecycle
+``server.drain_*``            graceful-drain lifecycle (server.py)
 ========================  ====================================================
 
 plus anything user code passes to :func:`record`.

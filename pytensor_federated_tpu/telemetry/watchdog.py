@@ -144,14 +144,22 @@ def write_incident_bundle(
     ``flightrec`` (last ``flightrec_tail`` events), ``telemetry``
     (metrics + recent span trees, :func:`.export.snapshot`), and
     ``trace_reunion`` (driver-side and node-side span trees merged per
-    trace id, :func:`.reunion.merge_all`).  Everything is read
-    best-effort: a half-wedged process must still get SOME bundle out,
-    so each section degrades to an ``"error"`` string instead of
-    aborting the write.
+    trace id, :func:`.reunion.merge_all`).  When a fault-injection plan
+    is installed (:mod:`..faultinject`), a ``fault_plan`` section
+    embeds its id, rules, and live fire counters, so a chaos-triggered
+    bundle is self-describing — *what chaos did* sits next to *how the
+    system reacted*.  Everything is read best-effort: a half-wedged
+    process must still get SOME bundle out, so each section degrades to
+    an ``"error"`` string instead of aborting the write.
     """
     from . import export as _export
     from . import flightrec as _flightrec
     from . import reunion as _reunion
+
+    def _fault_plan():
+        from ..faultinject import runtime as _fi_runtime
+
+        return _fi_runtime.snapshot()
 
     bundle: dict = {
         "reason": reason,
@@ -165,11 +173,15 @@ def write_incident_bundle(
         ("flightrec", lambda: _flightrec.events(flightrec_tail)),
         ("telemetry", _export.snapshot),
         ("trace_reunion", _reunion.merge_all),
+        ("fault_plan", _fault_plan),
     ):
         try:
-            bundle[key] = build()
+            value = build()
         except Exception as e:  # best-effort: never lose the bundle
-            bundle[key] = {"error": f"{type(e).__name__}: {e}"}
+            value = {"error": f"{type(e).__name__}: {e}"}
+        if key == "fault_plan" and value is None:
+            continue  # no plan installed: keep ordinary bundles clean
+        bundle[key] = value
 
     slug = "".join(c if c.isalnum() or c in "-_" else "-" for c in reason)
     stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())

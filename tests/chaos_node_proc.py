@@ -1,0 +1,35 @@
+"""Child process for tests/test_chaos_e2e.py: a plain npwire TCP node
+(a script FILE, not a heredoc — CLAUDE.md spawn pitfall) computing
+``2*x``.  Fault plans reach it ONLY via ``PFTPU_FAULT_PLAN`` in its
+environment — the cross-process activation lane under test.
+
+stdout protocol: ``PORT <n>`` once listening.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from pytensor_federated_tpu.service.tcp import serve_tcp_once  # noqa: E402
+
+
+def compute(*arrays):
+    x = np.asarray(arrays[0], dtype=np.float64)
+    return [2.0 * x]
+
+
+def main() -> int:
+    serve_tcp_once(
+        compute,
+        ready_callback=lambda port: print(f"PORT {port}", flush=True),
+        concurrent=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

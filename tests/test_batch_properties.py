@@ -76,6 +76,109 @@ def test_batch_truncation_never_silently_wrong(reqs, trace, cut):
         decode_batch(prefix)
 
 
+# npwire batch header: magic(4) version(1) flags(1) uuid(16) count(4)
+_NPW_BATCH_HDR = 26
+
+
+def _npwire_item_offsets(frame, n_items):
+    """Byte offsets of each item's u32 length field in a batch frame
+    encoded with no error/trace blocks."""
+    import struct
+
+    offs, off = [], _NPW_BATCH_HDR
+    for _ in range(n_items):
+        offs.append(off)
+        (ln,) = struct.unpack_from("<I", frame, off)
+        off += 4 + ln
+    return offs
+
+
+@COMMON
+@given(reqs=_requests, cut=st.integers(min_value=1,
+                                       max_value=_NPW_BATCH_HDR - 1))
+def test_batch_header_truncation_raises_wire_error(reqs, cut):
+    """Mid-stream HEADER truncation (flag bit 8): any prefix that ends
+    inside the outer batch header must raise WireError — never a
+    partial decode."""
+    frame = encode_batch([encode_arrays(arrs) for arrs in reqs])
+    with pytest.raises(WireError):
+        decode_batch(frame[:cut])
+
+
+@COMMON
+@given(
+    reqs=st.lists(st.lists(_arrays, min_size=0, max_size=3),
+                  min_size=1, max_size=5),
+    data=st.data(),
+)
+def test_batch_item_length_overflow_raises_wire_error(reqs, data):
+    """Per-item length overflow: an item length field promising more
+    bytes than the frame holds must raise WireError, never partial-
+    decode the items before it as a shorter batch."""
+    import struct
+
+    items = [
+        encode_arrays(arrs, uuid=bytes([i]) * 16)
+        for i, arrs in enumerate(reqs)
+    ]
+    frame = encode_batch(items, uuid=b"o" * 16)
+    idx = data.draw(st.integers(0, len(items) - 1), label="item")
+    extra = data.draw(st.integers(1, 2**31), label="extra")
+    off = _npwire_item_offsets(frame, len(items))[idx]
+    (ln,) = struct.unpack_from("<I", frame, off)
+    bad = (
+        frame[:off]
+        + struct.pack("<I", min(ln + extra, 0xFFFFFFFF))
+        + frame[off + 4:]
+    )
+    with pytest.raises(WireError):
+        decode_batch(bad)
+
+
+@COMMON
+@given(
+    reqs=st.lists(st.lists(_arrays, min_size=0, max_size=2),
+                  min_size=1, max_size=4),
+    data=st.data(),
+)
+def test_npproto_batch_item_overflow_and_truncation(reqs, data):
+    """The npproto twin (field 17): an inflated item-length varint, and
+    a truncation landing INSIDE an item's payload, must both raise
+    WireError.  (Truncation at an exact field boundary is proto3-
+    indistinguishable from a shorter message — the uuid correlation
+    and item-count checks own that case at the transport layer.)"""
+    try:
+        items = [
+            npproto_codec.encode_arrays_msg(arrs, uuid=f"u{i}")
+            for i, arrs in enumerate(reqs)
+        ]
+    except WireError:
+        return  # dtype outside the reference wire's str() round trip
+    frame = npproto_codec.encode_batch_msg(items, uuid="outer")
+
+    # (a) per-item length overflow: re-emit the last item with a
+    # length varint promising more bytes than follow.
+    extra = data.draw(st.integers(1, 2**31), label="extra")
+    head = npproto_codec.encode_batch_msg(items[:-1], uuid="outer")
+    last = items[-1]
+    bad = (
+        head
+        + npproto_codec._tag(17, 2)
+        + npproto_codec._encode_varint(len(last) + extra)
+        + last
+    )
+    with pytest.raises(WireError):
+        npproto_codec.decode_batch_msg(bad)
+
+    # (b) truncation inside the LAST item's payload (field 17 is the
+    # final field emitted, so chopping 1..len-1 of its bytes leaves
+    # its length header lying about the remainder).
+    if len(last) >= 2:
+        cut = data.draw(st.integers(1, len(last) - 1), label="cut")
+        with pytest.raises(WireError):
+            npproto_codec.decode_batch_msg(frame[:-cut])
+
+
 @COMMON
 @given(arrs=st.lists(_arrays, min_size=0, max_size=3))
 def test_unbatched_encode_unchanged_by_feature(arrs):

@@ -1,0 +1,222 @@
+"""ISSUE 5 acceptance e2e: chaos across a REAL process boundary.
+
+1. A mid-frame ``stall`` injected into a live driver↔node exchange
+   makes the watchdog produce an incident bundle that is fully
+   self-describing: the matching ``fault.stall`` flight-recorder event
+   (plan id + trace id), the driver-side span of the stalled operation
+   and the node-side spans of the SAME trace id, and the embedded
+   :class:`FaultPlan` with live counters — while the system itself
+   survives (every request still gets its correct reply once the
+   bounded stall ends).
+2. ``PFTPU_FAULT_PLAN`` activates a plan in a subprocess node with
+   zero code changes — the cross-process lane.
+3. A short ``tools/chaos_run.py`` sweep (the invariant checker the
+   nightly job runs at ``--seeds 25``) passes end to end.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from pytensor_federated_tpu import faultinject as fi
+from pytensor_federated_tpu import telemetry
+from pytensor_federated_tpu.telemetry import flightrec, reunion, watchdog
+from pytensor_federated_tpu.telemetry import spans as tspans
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+NODE = os.path.join(HERE, "chaos_node_proc.py")
+REPO = os.path.dirname(HERE)
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry(tmp_path, monkeypatch):
+    monkeypatch.setenv("PFTPU_INCIDENT_DIR", str(tmp_path / "incidents"))
+    monkeypatch.setenv("PFTPU_WATCHDOG_MIN_BUNDLE_GAP_S", "0")
+    prev = tspans.set_enabled(True)
+    prev_rec = flightrec.set_enabled(True)
+    telemetry.clear_traces()
+    flightrec.clear()
+    reunion.clear()
+    fi.uninstall()
+    yield
+    fi.uninstall()
+    tspans.set_enabled(prev)
+    flightrec.set_enabled(prev_rec)
+    telemetry.clear_traces()
+    flightrec.clear()
+    reunion.clear()
+
+
+def _spawn_node(extra_env=None):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(extra_env or {})
+    proc = subprocess.Popen(
+        [sys.executable, NODE],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    line = proc.stdout.readline().strip()
+    assert line.startswith("PORT "), line
+    return proc, int(line.split()[1])
+
+
+@pytest.mark.slow
+def test_midframe_stall_yields_self_describing_bundle(monkeypatch):
+    """The acceptance scenario: request 2 of a pipelined window stalls
+    MID-FRAME (half its bytes sent, then a 4 s pause) while crossing
+    the process boundary to a live node; the armed watchdog fires at
+    1 s and the bundle it writes must show what chaos did AND how the
+    system reacted — then the stall ends and every reply arrives."""
+    from pytensor_federated_tpu.service.tcp import TcpArraysClient
+
+    monkeypatch.setenv("PFTPU_WATCHDOG_RPC_S", "1.0")
+    plan = fi.FaultPlan(
+        [
+            fi.FaultRule(
+                "stall", point="tcp.send", nth=2, stall_s=4.0,
+                cut_frac=0.5,
+            )
+        ],
+        seed=42,
+        plan_id="e2e-stall",
+    )
+    proc, port = _spawn_node()
+    try:
+        fi.install(plan)
+        client = TcpArraysClient("127.0.0.1", port, retries=0)
+        before = watchdog.last_incident_path()
+        t0 = time.perf_counter()
+        # window=1: request 1's reply (carrying the node's span tree
+        # for THIS trace) is consumed before request 2's frame stalls.
+        results = client.evaluate_many(
+            [(np.full(2, float(i)),) for i in range(3)],
+            window=1,
+            batch=False,
+        )
+        wall = time.perf_counter() - t0
+    finally:
+        fi.uninstall()
+        proc.kill()
+        proc.wait(timeout=30)
+
+    # The system SURVIVED the stall: bounded, and every reply correct.
+    assert wall >= 4.0
+    for i, out in enumerate(results):
+        np.testing.assert_array_equal(out[0], 2.0 * np.full(2, float(i)))
+
+    # The driver's trace id for the stalled operation.
+    root = next(
+        t
+        for t in reversed(telemetry.recent_traces())
+        if t["name"] == "rpc.evaluate_many"
+    )
+    tid = root["trace_id"]
+
+    # The watchdog fired DURING the stall and wrote the bundle.
+    bundle_path = watchdog.last_incident_path()
+    assert bundle_path and bundle_path != before, (
+        "watchdog never produced an incident bundle mid-stall"
+    )
+    with open(bundle_path, "r", encoding="utf-8") as fh:
+        bundle = json.load(fh)
+    assert bundle["reason"] == "watchdog:tcp.batch_window"
+
+    # 1) the matching fault.* event, carrying plan id AND trace id
+    fault_events = [
+        e for e in bundle["flightrec"] if e["kind"] == "fault.stall"
+    ]
+    assert fault_events, "the injected stall left no fault.* event"
+    assert fault_events[0]["plan"] == "e2e-stall"
+    assert fault_events[0]["point"] == "tcp.send"
+    assert fault_events[0]["trace_id"] == tid
+
+    # 2) driver + node spans for the SAME trace id: the driver's
+    # still-open rpc.evaluate_many span is pinned into the flight
+    # record; the node's completed node.evaluate tree (request 1's
+    # piggyback, across the process boundary) sits in the reunion.
+    opens = [
+        e
+        for e in bundle["flightrec"]
+        if e["kind"] == "span.open"
+        and e.get("name") == "rpc.evaluate_many"
+        and e.get("trace_id") == tid
+    ]
+    assert opens, "driver-side span of the stalled operation missing"
+    merged = {tr["trace_id"]: tr for tr in bundle["trace_reunion"]}
+    assert tid in merged, "stalled trace missing from the reunion"
+    remote_names = {t["name"] for t in merged[tid]["remote"]}
+    assert "node.evaluate" in remote_names, (
+        "node-side spans for the stalled trace missing from the bundle"
+    )
+
+    # 3) the embedded fault plan with live counters
+    assert bundle["fault_plan"]["plan_id"] == "e2e-stall"
+    (rule,) = bundle["fault_plan"]["rules"]
+    assert rule["kind"] == "stall" and rule["fires"] == 1
+
+
+def test_env_plan_reaches_subprocess_node():
+    """Cross-process activation: the node's rules fire in the NODE
+    process (its 2nd compute errors in-band), with zero code changes —
+    only PFTPU_FAULT_PLAN in its environment."""
+    from pytensor_federated_tpu.service.tcp import (
+        RemoteComputeError,
+        TcpArraysClient,
+    )
+
+    node_plan = fi.FaultPlan(
+        [
+            fi.FaultRule(
+                "compute_error", point="server.compute", nth=2,
+                error="chaos crossed the boundary",
+            )
+        ],
+        seed=7,
+    )
+    proc, port = _spawn_node({"PFTPU_FAULT_PLAN": node_plan.to_json()})
+    try:
+        client = TcpArraysClient("127.0.0.1", port, retries=0)
+        out = client.evaluate(np.arange(3.0))
+        np.testing.assert_array_equal(out[0], 2.0 * np.arange(3.0))
+        with pytest.raises(
+            RemoteComputeError, match="chaos crossed the boundary"
+        ):
+            client.evaluate(np.arange(3.0))
+        out = client.evaluate(np.ones(2))  # nth=2 exhausted
+        np.testing.assert_array_equal(out[0], 2.0 * np.ones(2))
+        client.close()
+    finally:
+        proc.kill()
+        proc.wait(timeout=30)
+
+
+@pytest.mark.slow
+def test_chaos_run_smoke_slice():
+    """The CI smoke slice of the nightly invariant sweep: a few seeds
+    on each transport must satisfy every invariant."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    for extra in (["--seeds", "2", "--base-seed", "100"],
+                  ["--seeds", "1", "--transport", "tcp"]):
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "chaos_run.py"),
+             *extra],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=420,
+        )
+        assert out.returncode == 0, (
+            f"chaos_run {extra} failed:\n{out.stdout}\n{out.stderr}"
+        )
+        assert '"failures": 0' in out.stdout

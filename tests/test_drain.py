@@ -1,0 +1,201 @@
+"""Graceful server drain: in-flight work finishes, new work is refused
+with a RETRYABLE status, and a replica pool fails over cleanly — the
+clean half of a rolling restart (the chaotic half lives in
+tests/test_chaos_e2e.py / tools/chaos_run.py)."""
+
+import asyncio
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from pytensor_federated_tpu.service.server import (
+    ArraysToArraysService,
+    serve,
+)
+from pytensor_federated_tpu.service.npwire import (
+    decode_arrays_all,
+    encode_arrays,
+)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _slow_compute(delay=0.1):
+    def compute(x):
+        time.sleep(delay)
+        return [2.0 * np.asarray(x)]
+
+    return compute
+
+
+class TestDrainDirect:
+    def test_inflight_completes_new_work_rejected_then_undrain(self):
+        service = ArraysToArraysService(_slow_compute(0.15))
+        x = np.arange(3.0)
+        request = encode_arrays([x], uuid=b"d" * 16)
+
+        async def main():
+            inflight = asyncio.ensure_future(
+                service.evaluate(request, None)
+            )
+            await asyncio.sleep(0.03)  # the request is genuinely in flight
+            drain_task = asyncio.ensure_future(service.drain(timeout_s=10))
+            await asyncio.sleep(0.01)
+            assert service.draining
+            # NEW work is refused loudly (context=None direct-call path
+            # raises; over real gRPC this is an UNAVAILABLE abort).
+            with pytest.raises(ConnectionError, match="draining"):
+                await service.evaluate(request, None)
+            # ... while the in-flight request runs to completion.
+            reply = await inflight
+            arrays, uuid, error, _t, _s = decode_arrays_all(reply)
+            assert error is None and uuid == b"d" * 16
+            np.testing.assert_array_equal(arrays[0], 2.0 * x)
+            assert await drain_task is True  # went idle within timeout
+            service.undrain()
+            reply = await service.evaluate(request, None)
+            assert decode_arrays_all(reply)[2] is None
+
+        asyncio.run(main())
+
+    def test_drain_timeout_reports_dirty(self):
+        service = ArraysToArraysService(_slow_compute(0.5))
+        request = encode_arrays([np.ones(2)], uuid=b"e" * 16)
+
+        async def main():
+            inflight = asyncio.ensure_future(
+                service.evaluate(request, None)
+            )
+            await asyncio.sleep(0.03)
+            assert await service.drain(timeout_s=0.05) is False
+            await inflight  # still completes; drain only reported
+
+        asyncio.run(main())
+
+
+class TestDrainOverGrpc:
+    def test_drain_racing_a_pipelined_window_is_retryable(self):
+        """A drain landing MID pipelined window: requests already
+        accepted complete; the rejected tail surfaces as UNAVAILABLE —
+        the transient classification failover keys on — and the
+        partial-pass results that did arrive are correct."""
+        from pytensor_federated_tpu.service.client import (
+            ArraysToArraysServiceClient,
+            _is_retryable,
+        )
+
+        service = ArraysToArraysService(_slow_compute(0.05))
+        port = _free_port()
+
+        async def main():
+            server = await serve(None, "127.0.0.1", port, service=service)
+            try:
+                client = ArraysToArraysServiceClient(
+                    "127.0.0.1", port, retries=0
+                )
+                reqs = [(np.full(2, float(i)),) for i in range(8)]
+
+                async def drain_soon():
+                    await asyncio.sleep(0.12)
+                    await service.drain(timeout_s=10)
+
+                drainer = asyncio.ensure_future(drain_soon())
+                results, exc = await client.evaluate_many_partial_async(
+                    reqs, window=2, batch=False
+                )
+                await drainer
+                served = [i for i, r in enumerate(results) if r is not None]
+                for i in served:
+                    np.testing.assert_array_equal(
+                        results[i][0], 2.0 * np.full(2, float(i))
+                    )
+                if exc is None:
+                    assert len(served) == len(reqs)
+                else:
+                    # the drain cut the window: the error must be the
+                    # RETRYABLE kind (a pool would fail the tail over)
+                    assert _is_retryable(exc), exc
+                    assert len(served) < len(reqs)
+                    import grpc
+
+                    if isinstance(exc, grpc.aio.AioRpcError):
+                        assert exc.code() == grpc.StatusCode.UNAVAILABLE
+            finally:
+                await server.stop(None)
+
+        asyncio.run(main())
+
+    def test_pool_fails_over_cleanly_across_drain(self):
+        """Two replicas; one drains mid-window: every request still
+        gets exactly one correct reply (the tail re-queues onto the
+        survivor), and the drained node refuses direct work until
+        undrained."""
+        from pytensor_federated_tpu.routing import (
+            NodePool,
+            PooledArraysClient,
+        )
+
+        service_a = ArraysToArraysService(_slow_compute(0.02))
+        service_b = ArraysToArraysService(_slow_compute(0.02))
+        port_a, port_b = _free_port(), _free_port()
+
+        async def main():
+            server_a = await serve(
+                None, "127.0.0.1", port_a, service=service_a
+            )
+            server_b = await serve(
+                None, "127.0.0.1", port_b, service=service_b
+            )
+            pool = NodePool(
+                [("127.0.0.1", port_a), ("127.0.0.1", port_b)],
+                breaker_kwargs=dict(failure_threshold=3, backoff_s=0.2),
+            )
+            client = PooledArraysClient(pool)
+            try:
+                n = 24
+                reqs = [(np.full(2, float(i)),) for i in range(n)]
+
+                async def drain_soon():
+                    await asyncio.sleep(0.05)
+                    await service_a.drain(timeout_s=10)
+
+                drainer = asyncio.ensure_future(drain_soon())
+                results = await asyncio.wait_for(
+                    client.evaluate_many_async(reqs, window=4),
+                    timeout=60,
+                )
+                await drainer
+                assert len(results) == n
+                for i, out in enumerate(results):
+                    assert out is not None, f"request {i} lost in drain"
+                    np.testing.assert_array_equal(
+                        out[0], 2.0 * np.full(2, float(i))
+                    )
+                # the drained node refuses new work...
+                from pytensor_federated_tpu.service.client import (
+                    ArraysToArraysServiceClient,
+                )
+                import grpc
+
+                pinned = ArraysToArraysServiceClient(
+                    "127.0.0.1", port_a, retries=0, use_stream=False
+                )
+                with pytest.raises(grpc.aio.AioRpcError) as ei:
+                    await pinned.evaluate_async(np.ones(2))
+                assert ei.value.code() == grpc.StatusCode.UNAVAILABLE
+                # ...and serves again after undrain.
+                service_a.undrain()
+                out = await pinned.evaluate_async(np.ones(2))
+                np.testing.assert_array_equal(out[0], 2.0 * np.ones(2))
+            finally:
+                pool.close()
+                await server_a.stop(None)
+                await server_b.stop(None)
+
+        asyncio.run(main())

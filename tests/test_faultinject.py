@@ -1,0 +1,532 @@
+"""Fault-injection subsystem: plans, predicates, determinism, and the
+in-process halves of every shim (the cross-process lanes are covered by
+tests/test_chaos_e2e.py and test_native_node.py)."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pytensor_federated_tpu import faultinject as fi
+from pytensor_federated_tpu import telemetry
+from pytensor_federated_tpu.telemetry import flightrec
+from pytensor_federated_tpu.telemetry import spans as tspans
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    prev = tspans.set_enabled(True)
+    prev_rec = flightrec.set_enabled(True)
+    flightrec.clear()
+    fi.uninstall()
+    yield
+    fi.uninstall()
+    tspans.set_enabled(prev)
+    flightrec.set_enabled(prev_rec)
+    flightrec.clear()
+
+
+# -- FaultPlan / FaultRule --------------------------------------------------
+
+
+class TestPlan:
+    def test_json_roundtrip(self):
+        plan = fi.FaultPlan(
+            [
+                fi.FaultRule("delay", point="tcp.send", nth=3, delay_s=0.1),
+                fi.FaultRule(
+                    "corrupt_bytes", point="grpc.*", prob=0.5,
+                    max_fires=2, peer="127.0.0.1:9",
+                ),
+            ],
+            seed=11,
+        )
+        clone = fi.FaultPlan.from_json(plan.to_json())
+        assert clone.to_dict() == plan.to_dict()
+        assert clone.plan_id == plan.plan_id and clone.seed == 11
+
+    def test_from_spec_file(self, tmp_path):
+        plan = fi.FaultPlan([fi.FaultRule("disconnect", nth=1)], seed=2)
+        path = tmp_path / "plan.json"
+        path.write_text(plan.to_json())
+        assert fi.FaultPlan.from_spec(str(path)).to_dict() == plan.to_dict()
+        assert (
+            fi.FaultPlan.from_spec(plan.to_json()).to_dict() == plan.to_dict()
+        )
+
+    def test_unknown_kind_and_field_are_loud(self):
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            fi.FaultRule("meteor_strike")
+        with pytest.raises(ValueError, match="unknown FaultRule fields"):
+            fi.FaultRule.from_dict({"kind": "delay", "sneaky": 1})
+        with pytest.raises(ValueError, match="rules"):
+            fi.FaultPlan.from_json("{}")
+
+    def test_nth_fires_exactly_once(self):
+        plan = fi.FaultPlan([fi.FaultRule("delay", point="p", nth=3)])
+        hits = [plan.decide("p") for _ in range(6)]
+        assert [h is not None for h in hits] == [
+            False, False, True, False, False, False,
+        ]
+
+    def test_every_and_max_fires(self):
+        plan = fi.FaultPlan(
+            [fi.FaultRule("delay", point="p", every=2, max_fires=2)]
+        )
+        hits = [plan.decide("p") is not None for _ in range(8)]
+        assert hits == [False, True, False, True, False, False, False, False]
+
+    def test_prob_is_seed_deterministic(self):
+        def fires(seed):
+            plan = fi.FaultPlan(
+                [fi.FaultRule("delay", point="p", prob=0.5, max_fires=None)],
+                seed=seed,
+            )
+            return [plan.decide("p") is not None for _ in range(32)]
+
+        a, b, c = fires(7), fires(7), fires(8)
+        assert a == b  # same seed, same schedule
+        assert a != c  # different seed, different schedule
+        assert any(a) and not all(a)
+
+    def test_peer_and_point_patterns(self):
+        rule = fi.FaultRule("delay", point="tcp.*", peer="127.0.0.1:90")
+        plan = fi.FaultPlan([rule])
+        assert plan.decide("grpc.send", "127.0.0.1:9000") is None
+        assert plan.decide("tcp.send", "10.0.0.1:9000") is None
+        assert plan.decide("tcp.send", "127.0.0.1:9000") is rule
+
+    def test_one_fault_per_call_and_accounting(self):
+        """Two rules covering the same call: only one APPLIES (earlier
+        rules take priority), and ``fires`` counts applied faults —
+        the invariant the chaos harness reconciles against fault.*
+        events."""
+        third = fi.FaultRule("disconnect", point="p", nth=3)
+        always = fi.FaultRule("delay", point="p", max_fires=3)
+        plan = fi.FaultPlan([third, always])
+        fired = [plan.decide("p") for _ in range(6)]
+        assert [f.kind if f else None for f in fired] == [
+            "delay", "delay", "disconnect", "delay", None, None,
+        ]
+        assert plan.total_fires == 4
+
+    def test_snapshot_counters(self):
+        plan = fi.FaultPlan([fi.FaultRule("delay", point="p", nth=2)])
+        plan.decide("p")
+        plan.decide("p")
+        snap = plan.snapshot()
+        assert snap["total_fires"] == 1
+        (r,) = snap["rules"]
+        assert r["matches"] == 2 and r["fires"] == 1 and r["remaining"] == 0
+
+    def test_native_spec_subset(self):
+        plan = fi.FaultPlan(
+            [
+                fi.FaultRule("delay", nth=2, delay_s=0.05),
+                fi.FaultRule("disconnect", nth=4),
+                fi.FaultRule("truncate_frame", nth=6, cut_frac=0.25),
+                fi.FaultRule("compute_error", nth=1),  # not native
+                fi.FaultRule("delay", every=3),  # no nth anchor
+            ]
+        )
+        assert plan.native_spec() == "delay:2:50,disconnect:4,truncate:6:25"
+
+
+# -- runtime install / events ----------------------------------------------
+
+
+class TestRuntime:
+    def test_install_uninstall_and_events(self):
+        plan = fi.FaultPlan([fi.FaultRule("delay", point="p", nth=1,
+                                          delay_s=0.0)])
+        assert fi.runtime.active_plan is None
+        fi.install(plan)
+        assert fi.runtime.active_plan is plan
+        assert fi.decide("p") is not None
+        fi.uninstall()
+        assert fi.runtime.active_plan is None
+        kinds = [e["kind"] for e in flightrec.events()]
+        assert "fault.plan_installed" in kinds
+        assert "fault.delay" in kinds
+        assert "fault.plan_uninstalled" in kinds
+        ev = next(
+            e for e in flightrec.events() if e["kind"] == "fault.delay"
+        )
+        assert ev["plan"] == plan.plan_id and ev["point"] == "p"
+
+    def test_env_activation_in_subprocess(self):
+        """The cross-process lane: a child process importing the
+        package with PFTPU_FAULT_PLAN set runs the plan."""
+        plan = fi.FaultPlan(
+            [fi.FaultRule("compute_error", point="server.compute", nth=1)],
+            seed=5,
+            plan_id="env-test",
+        )
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PFTPU_FAULT_PLAN"] = plan.to_json()
+        out = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "from pytensor_federated_tpu.faultinject import runtime;"
+                "print(runtime.active_plan.plan_id)",
+            ],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert out.returncode == 0, out.stderr
+        assert out.stdout.strip() == "env-test"
+
+    def test_malformed_env_plan_is_loud(self):
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PFTPU_FAULT_PLAN"] = "{not json"
+        out = subprocess.run(
+            [sys.executable, "-c", "import pytensor_federated_tpu.faultinject"],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert out.returncode != 0  # testing nothing must not look green
+
+    def test_inapplicable_kind_is_loud(self):
+        fi.install(
+            fi.FaultPlan([fi.FaultRule("getload_garbage", point="p")])
+        )
+        with pytest.raises(fi.FaultPlanError):
+            fi.runtime.filter_bytes("p", b"x")
+
+    def test_getload_garbage_is_rejected_by_the_probe_decoder(self):
+        """The injected GetLoad garbage is exactly the shape the PR-4
+        guard exists for: unknown-fields-only proto that leniency would
+        decode to the all-zero load."""
+        from pytensor_federated_tpu.service.npproto_codec import (
+            decode_get_load_result,
+        )
+        from pytensor_federated_tpu.service.npwire import WireError
+
+        with pytest.raises(WireError):
+            decode_get_load_result(fi.runtime.GETLOAD_GARBAGE)
+
+    def test_probe_filter_forces_failed_probe_without_dialing(self):
+        from pytensor_federated_tpu.routing import NodePool
+
+        fi.install(
+            fi.FaultPlan(
+                [fi.FaultRule("drop", point="pool.probe", max_fires=4)]
+            )
+        )
+        # A port nobody listens on: with the shim the probe fails FAST
+        # (no dial, no timeout) and still feeds the breaker.
+        pool = NodePool(
+            [("127.0.0.1", 1)],
+            breaker_kwargs=dict(failure_threshold=1, backoff_s=30.0),
+            probe_timeout_s=30.0,
+        )
+        t0 = time.perf_counter()
+        up = pool.probe_once()
+        assert time.perf_counter() - t0 < 5.0  # never dialed
+        assert up == 0
+        (replica,) = pool.replicas
+        assert replica.breaker.state == "open"
+        assert any(
+            e["kind"] == "fault.drop" for e in flightrec.events()
+        )
+        pool.close()
+
+
+# -- TCP lane shims (in-process server thread) ------------------------------
+
+
+def _start_tcp_server(compute=None, **kw):
+    from pytensor_federated_tpu.service.tcp import serve_tcp_once
+
+    if compute is None:
+        def compute(x):
+            return [2.0 * np.asarray(x)]
+
+    holder = {}
+    ready = threading.Event()
+
+    def cb(p):
+        holder["port"] = p
+        ready.set()
+
+    t = threading.Thread(
+        target=serve_tcp_once,
+        args=(compute,),
+        kwargs=dict(ready_callback=cb, **kw),
+        daemon=True,
+    )
+    t.start()
+    assert ready.wait(10)
+    return holder["port"]
+
+
+class TestTcpShims:
+    def test_delay_and_stall_are_bounded_and_recorded(self):
+        port = _start_tcp_server(max_connections=1)
+        from pytensor_federated_tpu.service.tcp import TcpArraysClient
+
+        fi.install(
+            fi.FaultPlan(
+                [
+                    fi.FaultRule("delay", point="tcp.send", nth=1,
+                                 delay_s=0.05),
+                    fi.FaultRule("stall", point="tcp.send", nth=2,
+                                 stall_s=0.3),
+                ]
+            )
+        )
+        client = TcpArraysClient("127.0.0.1", port, retries=0)
+        t0 = time.perf_counter()
+        out = client.evaluate(np.arange(3.0))  # delayed
+        np.testing.assert_array_equal(out[0], 2.0 * np.arange(3.0))
+        assert time.perf_counter() - t0 >= 0.05
+        t0 = time.perf_counter()
+        out = client.evaluate(np.arange(3.0))  # mid-frame stall
+        np.testing.assert_array_equal(out[0], 2.0 * np.arange(3.0))
+        assert time.perf_counter() - t0 >= 0.3
+        kinds = [e["kind"] for e in flightrec.events()]
+        assert "fault.delay" in kinds and "fault.stall" in kinds
+        client.close()
+
+    def test_disconnect_fails_over_to_reconnect(self):
+        port = _start_tcp_server(max_connections=2)
+        from pytensor_federated_tpu.service.tcp import TcpArraysClient
+
+        fi.install(
+            fi.FaultPlan(
+                [fi.FaultRule("disconnect", point="tcp.send", nth=1)]
+            )
+        )
+        client = TcpArraysClient("127.0.0.1", port, retries=1)
+        out = client.evaluate(np.arange(3.0))  # retry reconnects
+        np.testing.assert_array_equal(out[0], 2.0 * np.arange(3.0))
+        assert any(
+            e["kind"] == "rpc.drop" for e in flightrec.events()
+        ), "the injected disconnect should surface as a transport drop"
+        client.close()
+
+    def test_corrupt_request_header_yields_loud_error_reply(self):
+        port = _start_tcp_server(max_connections=1)
+        from pytensor_federated_tpu.service.tcp import (
+            RemoteComputeError,
+            TcpArraysClient,
+        )
+
+        fi.install(
+            fi.FaultPlan(
+                [fi.FaultRule("corrupt_bytes", point="tcp.send", nth=1)],
+                seed=3,
+            )
+        )
+        client = TcpArraysClient("127.0.0.1", port, retries=0)
+        # Corrupted header region: either the server answers an in-band
+        # decode-error reply (RemoteComputeError) or the uuid no longer
+        # correlates (RuntimeError) — LOUD either way, never silence.
+        with pytest.raises((RemoteComputeError, RuntimeError)):
+            client.evaluate(np.arange(3.0))
+        client.close()
+
+    def test_truncated_reply_raises_wire_error(self):
+        port = _start_tcp_server(max_connections=1)
+        from pytensor_federated_tpu.service.npwire import WireError
+        from pytensor_federated_tpu.service.tcp import TcpArraysClient
+
+        fi.install(
+            fi.FaultPlan(
+                [fi.FaultRule("truncate_frame", point="tcp.recv", nth=1)]
+            )
+        )
+        client = TcpArraysClient("127.0.0.1", port, retries=0)
+        with pytest.raises(WireError):
+            client.evaluate(np.arange(3.0))
+        assert client._sock is None, (
+            "a corrupt reply must close the connection (stale frames)"
+        )
+        client.close()
+
+    def test_server_compute_error_is_in_band(self):
+        port = _start_tcp_server(max_connections=1)
+        from pytensor_federated_tpu.service.tcp import (
+            RemoteComputeError,
+            TcpArraysClient,
+        )
+
+        fi.install(
+            fi.FaultPlan(
+                [
+                    fi.FaultRule(
+                        "compute_error", point="server.compute", nth=1,
+                        error="chaos says no",
+                    )
+                ]
+            )
+        )
+        client = TcpArraysClient("127.0.0.1", port, retries=0)
+        with pytest.raises(RemoteComputeError, match="chaos says no"):
+            client.evaluate(np.arange(3.0))
+        # The connection survives an in-band error:
+        out = client.evaluate(np.arange(3.0))
+        np.testing.assert_array_equal(out[0], 2.0 * np.arange(3.0))
+        client.close()
+
+    def test_duplicate_reply_desync_is_caught_by_correlation(self):
+        port = _start_tcp_server(max_connections=1)
+        from pytensor_federated_tpu.service.tcp import TcpArraysClient
+
+        fi.install(
+            fi.FaultPlan(
+                [
+                    fi.FaultRule(
+                        "duplicate_reply", point="tcp.server.send", nth=1
+                    )
+                ]
+            )
+        )
+        client = TcpArraysClient("127.0.0.1", port, retries=0)
+        out = client.evaluate(np.arange(3.0))  # first copy correlates
+        np.testing.assert_array_equal(out[0], 2.0 * np.arange(3.0))
+        # The duplicate is now a stale frame ahead of the next reply:
+        # the uuid check must refuse it and reset the connection.
+        with pytest.raises(RuntimeError, match="uuid mismatch"):
+            client.evaluate(np.ones(2))
+        assert client._sock is None
+        client.close()
+
+    def test_corrupt_request_does_not_crash_the_server(self):
+        """Robustness hardening that chaos forced: a mangled frame gets
+        an in-band decode-error reply and the SAME connection keeps
+        serving (previously the pure-Python server crashed)."""
+        port = _start_tcp_server(max_connections=1)
+        import socket as sk
+        import struct
+        import uuid as uuid_mod
+
+        from pytensor_federated_tpu.service.npwire import (
+            decode_arrays_all,
+            encode_arrays,
+        )
+
+        with sk.create_connection(("127.0.0.1", port), timeout=10) as s:
+            garbage = b"NOTAFRAME-at-all"
+            s.sendall(struct.pack("<I", len(garbage)) + garbage)
+            hdr = s.recv(4)
+            (n,) = struct.unpack("<I", hdr)
+            reply = b""
+            while len(reply) < n:
+                reply += s.recv(n - len(reply))
+            _arr, _uuid, error, _t, _sp = decode_arrays_all(reply)
+            assert error and "decode error" in error
+            # same connection still serves real work
+            uid = uuid_mod.uuid4().bytes
+            req = encode_arrays([np.arange(3.0)], uuid=uid)
+            s.sendall(struct.pack("<I", len(req)) + req)
+            hdr = s.recv(4)
+            (n,) = struct.unpack("<I", hdr)
+            reply = b""
+            while len(reply) < n:
+                reply += s.recv(n - len(reply))
+            arr, ruid, error, _t, _sp = decode_arrays_all(reply)
+            assert error is None and ruid == uid
+            np.testing.assert_array_equal(arr[0], 2.0 * np.arange(3.0))
+
+
+# -- batcher seam -----------------------------------------------------------
+
+
+class TestBatchSeam:
+    def test_wrong_shape_falls_back_to_scalar_isolation(self):
+        from pytensor_federated_tpu.service.batching import (
+            execute_window_sync,
+        )
+
+        calls = {"batch": 0}
+
+        def compute(x):
+            return [2.0 * np.asarray(x)]
+
+        def batch_fn(reqs):
+            calls["batch"] += 1
+            return [[2.0 * np.asarray(r[0])] for r in reqs]
+
+        fi.install(
+            fi.FaultPlan(
+                [
+                    fi.FaultRule(
+                        "compute_wrong_shape",
+                        point="server.compute_batch",
+                        nth=1,
+                    )
+                ]
+            )
+        )
+        reqs = [(np.full(2, float(i)),) for i in range(4)]
+        outcomes = execute_window_sync(compute, batch_fn, reqs)
+        assert calls["batch"] == 1  # the vectorized path ran (and lied)
+        for i, out in enumerate(outcomes):
+            assert not isinstance(out, Exception)
+            np.testing.assert_array_equal(out[0], 2.0 * np.full(2, float(i)))
+        kinds = [e["kind"] for e in flightrec.events()]
+        assert "fault.compute_wrong_shape" in kinds
+        assert "server.batch_fallback" in kinds, (
+            "the wrong-count batch must take the scalar-fallback path"
+        )
+
+
+# -- incident bundle embedding ----------------------------------------------
+
+
+class TestBundleEmbedding:
+    def test_bundle_embeds_plan_and_report_renders_it(self, tmp_path):
+        from pytensor_federated_tpu.telemetry.watchdog import (
+            write_incident_bundle,
+        )
+
+        sys.path.insert(0, os.path.join(HERE, os.pardir, "tools"))
+        try:
+            import incident_report
+        finally:
+            sys.path.pop(0)
+
+        plan = fi.FaultPlan(
+            [fi.FaultRule("stall", point="tcp.send", nth=2, stall_s=1.0)],
+            seed=9,
+            plan_id="bundle-test",
+        )
+        fi.install(plan)
+        plan.decide("tcp.send")
+        plan.decide("tcp.send")  # fires
+        path = write_incident_bundle("unit-test", dir=str(tmp_path))
+        bundle = json.load(open(path))
+        assert bundle["fault_plan"]["plan_id"] == "bundle-test"
+        (rule,) = bundle["fault_plan"]["rules"]
+        assert rule["fires"] == 1 and rule["remaining"] == 0
+
+        md = incident_report.render_markdown(bundle)
+        assert "Fault plan" in md and "bundle-test" in md and "stall" in md
+        jl = incident_report.render_jsonl(bundle)
+        first = json.loads(jl.splitlines()[0])
+        assert first["fault_plan"]["plan_id"] == "bundle-test"
+
+    def test_no_plan_keeps_bundles_clean(self, tmp_path):
+        from pytensor_federated_tpu.telemetry.watchdog import (
+            write_incident_bundle,
+        )
+
+        path = write_incident_bundle("unit-test", dir=str(tmp_path))
+        assert "fault_plan" not in json.load(open(path))

@@ -38,6 +38,11 @@ def _clean_telemetry(tmp_path, monkeypatch):
     """Telemetry is process-global; isolate and point incidents at
     tmp_path so bundles never leak between tests."""
     monkeypatch.setenv("PFTPU_INCIDENT_DIR", str(tmp_path / "incidents"))
+    # The per-arm-point bundle throttle is process-global state: an
+    # earlier test in the same session (e.g. the chaos e2e) may have
+    # fired the SAME arm point within the default 60 s gap, which would
+    # silently suppress this test's bundle.
+    monkeypatch.setenv("PFTPU_WATCHDOG_MIN_BUNDLE_GAP_S", "0")
     prev = tspans.set_enabled(True)
     prev_rec = flightrec.set_enabled(True)
     telemetry.REGISTRY.reset()

@@ -483,3 +483,110 @@ class TestPythonTcpServer:
         np.testing.assert_array_equal(out[1], 6.0)
         client.close()
         t.join(timeout=10)
+
+
+class TestFaultPlanFlag:
+    """--fault-plan: the cross-language slice of the chaos subsystem
+    (faultinject.FaultPlan.native_spec emits the spec format)."""
+
+    def _spawn(self, cpp_node_bin, spec):
+        (port,) = _free_ports(1)
+        proc = subprocess.Popen(
+            [cpp_node_bin, str(port), "--fault-plan", spec],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        line = proc.stdout.readline()
+        assert "listening" in line, line
+        return proc, port
+
+    def _args(self, slope=2.0):
+        x = np.arange(8.0)
+        return (
+            np.float64(0.0), np.float64(slope), np.float64(1.0),
+            x, 2.0 * x,
+        )
+
+    def test_delay_then_disconnect_then_truncate(self, cpp_node_bin):
+        from pytensor_federated_tpu import faultinject as fi
+        from pytensor_federated_tpu.service import TcpArraysClient
+
+        plan = fi.FaultPlan(
+            [
+                fi.FaultRule("delay", nth=2, delay_s=0.25),
+                fi.FaultRule("disconnect", nth=4),
+                fi.FaultRule("truncate_frame", nth=6, cut_frac=0.5),
+            ]
+        )
+        spec = plan.native_spec()
+        assert spec == "delay:2:250,disconnect:4,truncate:6:50"
+        proc, port = self._spawn(cpp_node_bin, spec)
+        try:
+            client = TcpArraysClient(
+                "127.0.0.1", port, retries=0, connect_retries=2
+            )
+            want, _, _ = ref_logp_grad(0.0, 2.0, 1.0, np.arange(8.0),
+                                       2.0 * np.arange(8.0))
+            # frame 1: clean
+            out = client.evaluate(*self._args())
+            np.testing.assert_allclose(float(out[0]), want, rtol=1e-12)
+            # frame 2: delayed but correct
+            t0 = time.perf_counter()
+            out = client.evaluate(*self._args())
+            assert time.perf_counter() - t0 >= 0.25
+            np.testing.assert_allclose(float(out[0]), want, rtol=1e-12)
+            # frame 3: clean
+            client.evaluate(*self._args())
+            # frame 4: the node closes the connection without replying —
+            # a LOUD transport error, and a retries=1 client recovers.
+            with pytest.raises((ConnectionError, OSError)):
+                client.evaluate(*self._args())
+            client.close()
+            client = TcpArraysClient("127.0.0.1", port, retries=0)
+            # frame 5: clean on a fresh connection
+            client.evaluate(*self._args())
+            # frame 6: reply truncated MID-frame -> the framed read
+            # fails loudly ("peer closed mid-frame"), never a silent
+            # short frame.
+            with pytest.raises((ConnectionError, OSError)):
+                client.evaluate(*self._args())
+            client.close()
+            # frame 7: the plan is exhausted; service is healthy.
+            client = TcpArraysClient("127.0.0.1", port, retries=0)
+            out = client.evaluate(*self._args())
+            np.testing.assert_allclose(float(out[0]), want, rtol=1e-12)
+            client.close()
+        finally:
+            proc.kill()
+            proc.wait()
+
+    def test_spec_from_file(self, cpp_node_bin, tmp_path):
+        from pytensor_federated_tpu.service import TcpArraysClient
+
+        spec_file = tmp_path / "plan.txt"
+        spec_file.write_text("disconnect:1\n")
+        proc, port = self._spawn(cpp_node_bin, str(spec_file))
+        try:
+            client = TcpArraysClient("127.0.0.1", port, retries=0)
+            with pytest.raises((ConnectionError, OSError)):
+                client.evaluate(*self._args())
+            client.close()
+            client = TcpArraysClient("127.0.0.1", port, retries=0)
+            out = client.evaluate(*self._args())
+            assert len(out) == 3
+            client.close()
+        finally:
+            proc.kill()
+            proc.wait()
+
+    def test_malformed_spec_exits_loudly(self, cpp_node_bin):
+        (port,) = _free_ports(1)
+        out = subprocess.run(
+            [cpp_node_bin, str(port), "--fault-plan", "meteor:xyz"],
+            capture_output=True,
+            text=True,
+            timeout=30,
+        )
+        assert out.returncode == 2
+        assert "fault-plan" in out.stderr

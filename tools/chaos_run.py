@@ -1,0 +1,609 @@
+#!/usr/bin/env python
+"""Chaos harness: sweep seeded fault plans, assert the system invariants.
+
+For each seed, a :class:`~pytensor_federated_tpu.faultinject.FaultPlan`
+is generated (driver-side rules installed in this process; node-side
+rules shipped to one subprocess replica via ``PFTPU_FAULT_PLAN`` — the
+cross-process activation lane) and a pooled driver runs a realistic
+workload against 2-3 subprocess replicas: pipelined windows, single
+evaluations, hedged requests, then a recovery phase.  The invariants —
+the claims the recovery machinery (watchdog, breakers, hedging,
+mid-window failover) makes — are checked every seed:
+
+1. **Exactly one reply** — every request either returns the CORRECT
+   value exactly once, or the call fails with a loud, classified error
+   (``RemoteComputeError`` / ``WireError`` / uuid-mismatch
+   ``RuntimeError`` / transport error).  Never silence, never a wrong
+   value, never a duplicate applied twice (positional assignment makes
+   duplicates structurally impossible; values are checked against the
+   known compute).
+2. **No hang** — every call completes within a deadline derived from
+   the armed watchdog window; a stall is watchdog-visible and bounded,
+   not an open-ended wedge.
+3. **Breakers reconverge** — once faults stop (driver plan
+   uninstalled, node rules exhausted, killed replicas respawned),
+   probe sweeps must return every breaker to ``closed``, and a final
+   clean window must deliver every value correctly (a hedged loser or
+   chaos-mangled frame that desynchronized a stream would fail this).
+4. **Telemetry accounting** — every driver-side fired fault left its
+   ``fault.*`` event in the flight recorder (fired counters == event
+   count), so incident bundles can always show what chaos did.
+
+A violated invariant writes an incident bundle (with the fault plan
+embedded — see ``tools/incident_report.py``), prints the seed and
+bundle path, and exits nonzero.  Replay one seed with
+``python tools/chaos_run.py --seed N``.
+
+Usage:
+    python tools/chaos_run.py --seeds 25          # the nightly sweep
+    python tools/chaos_run.py --seeds 3           # the CI smoke slice
+    python tools/chaos_run.py --seed 17 -v        # replay one failure
+    python tools/chaos_run.py --seeds 5 --transport tcp
+"""
+
+from __future__ import annotations
+
+import os
+
+# Environment guards BEFORE any package import (CLAUDE.md: ad-hoc
+# drivers must never dial the TPU plugin), inherited by node children.
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("PFTPU_WATCHDOG_RPC_S", "2.0")
+os.environ.setdefault("PFTPU_WATCHDOG_MIN_BUNDLE_GAP_S", "0")
+
+import argparse  # noqa: E402
+import asyncio  # noqa: E402
+import json  # noqa: E402
+import multiprocessing as mp  # noqa: E402
+import random  # noqa: E402
+import socket  # noqa: E402
+import sys  # noqa: E402
+import time  # noqa: E402
+
+# Runnable from any cwd (and importable by spawn children).
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from pytensor_federated_tpu import faultinject as fi  # noqa: E402
+from pytensor_federated_tpu import telemetry  # noqa: E402
+from pytensor_federated_tpu.telemetry import flightrec  # noqa: E402
+from pytensor_federated_tpu.telemetry import reunion  # noqa: E402
+from pytensor_federated_tpu.telemetry import spans as tspans  # noqa: E402
+from pytensor_federated_tpu.telemetry.watchdog import (  # noqa: E402
+    write_incident_bundle,
+)
+
+COMPUTE_DELAY_S = 0.004
+#: Per-call deadline: the watchdog window plus the largest bounded
+#: fault (stall_s) plus generous slack — crossing it means a real hang.
+CALL_DEADLINE_S = 60.0
+
+
+def _expected(i: float) -> float:
+    """The node compute's known value for input [i, 5.0]."""
+    return -((i - 3.0) ** 2 + 4.0)
+
+
+# -- subprocess replicas ----------------------------------------------------
+
+
+def _serve_grpc_node(port: int, delay: float) -> None:
+    """Module-level (spawn needs an importable target): the quad
+    compute with a small per-call delay so windows are genuinely in
+    flight when faults land.  A PFTPU_FAULT_PLAN inherited from the
+    parent's env was already activated at package import."""
+    import logging
+    import time as _time
+
+    import numpy as _np
+
+    logging.disable(logging.ERROR)  # chaos makes nodes loud on purpose
+
+    def compute(x):
+        _time.sleep(COMPUTE_DELAY_S if delay is None else delay)
+        x = _np.asarray(x)
+        return [
+            _np.asarray(-_np.sum((x - 3.0) ** 2)),
+            (-2.0 * (x - 3.0)).astype(x.dtype),
+        ]
+
+    from pytensor_federated_tpu.service import run_node
+
+    run_node(compute, "127.0.0.1", port)
+
+
+def _serve_tcp_node(port: int, delay: float) -> None:
+    import time as _time
+
+    import numpy as _np
+
+    def compute(x):
+        _time.sleep(COMPUTE_DELAY_S if delay is None else delay)
+        x = _np.asarray(x)
+        return [
+            _np.asarray(-_np.sum((x - 3.0) ** 2)),
+            (-2.0 * (x - 3.0)).astype(x.dtype),
+        ]
+
+    from pytensor_federated_tpu.service.tcp import serve_tcp_once
+
+    # concurrent=True: the pool's health probes open their own
+    # connections alongside the driver's held one.
+    serve_tcp_once(compute, "127.0.0.1", port, concurrent=True)
+
+
+def _free_ports(n: int) -> list:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _spawn_node(transport: str, port: int, plan_json=None):
+    """Start one replica subprocess; node-side fault plans ride the
+    environment (PFTPU_FAULT_PLAN) into the child — the cross-process
+    activation lane under test."""
+    target = _serve_grpc_node if transport == "grpc" else _serve_tcp_node
+    saved = os.environ.get(fi.runtime.ENV_VAR)
+    if plan_json is not None:
+        os.environ[fi.runtime.ENV_VAR] = plan_json
+    else:
+        os.environ.pop(fi.runtime.ENV_VAR, None)
+    try:
+        ctx = mp.get_context("spawn")
+        proc = ctx.Process(target=target, args=(port, None), daemon=True)
+        proc.start()
+    finally:
+        if saved is None:
+            os.environ.pop(fi.runtime.ENV_VAR, None)
+        else:
+            os.environ[fi.runtime.ENV_VAR] = saved
+    return proc
+
+
+async def _wait_nodes_up_async(
+    transport: str, ports, timeout: float = 90.0
+) -> None:
+    if transport == "grpc":
+        from pytensor_federated_tpu.service import get_loads_async
+
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            loads = await get_loads_async(
+                [("127.0.0.1", p) for p in ports], timeout=1.0
+            )
+            if all(ld is not None for ld in loads):
+                return
+            await asyncio.sleep(0.2)
+        raise TimeoutError(f"nodes on {ports} failed to start")
+    # TCP lane: a fresh connection proves liveness.
+    deadline = time.time() + timeout
+    pending = set(ports)
+    while pending and time.time() < deadline:
+        for p in list(pending):
+            try:
+                with socket.create_connection(("127.0.0.1", p), timeout=1.0):
+                    pending.discard(p)
+            except OSError:
+                await asyncio.sleep(0.2)
+    if pending:
+        raise TimeoutError(f"nodes on {sorted(pending)} failed to start")
+
+
+def _wait_nodes_up(transport: str, ports, timeout: float = 90.0) -> None:
+    asyncio.run(_wait_nodes_up_async(transport, ports, timeout))
+
+
+# -- plan generation --------------------------------------------------------
+
+# (kind, kwargs) templates; nth anchors are drawn per seed.  Every
+# template is BOUNDED: stalls are finite, drops reset the connection,
+# and every rule carries max_fires — chaos that cannot terminate would
+# make the no-hang invariant untestable.
+def _driver_templates(transport: str):
+    send = "tcp.send" if transport == "tcp" else "grpc.send"
+    recv = "tcp.recv" if transport == "tcp" else "grpc.recv"
+    return [
+        ("delay", dict(point=send, delay_s=0.02, max_fires=3)),
+        ("disconnect", dict(point=send, max_fires=2)),
+        ("drop", dict(point=send, max_fires=2)),
+        ("corrupt_bytes", dict(point=send, max_fires=1)),
+        ("truncate_frame", dict(point=send, max_fires=1)),
+        ("disconnect", dict(point=recv, max_fires=1)),
+        ("truncate_frame", dict(point="npwire.decode", max_fires=1)),
+        ("corrupt_bytes", dict(point="npwire.decode", max_fires=1)),
+        ("stall", dict(point=send, stall_s=1.0, max_fires=1)),
+        ("drop", dict(point="pool.probe", max_fires=2)),
+    ]
+
+
+def _node_templates(transport: str):
+    reply = "tcp.server.send" if transport == "tcp" else "grpc.server.reply"
+    rules = [
+        ("compute_error", dict(point="server.compute", max_fires=1)),
+        ("delay", dict(point="server.compute", delay_s=0.05, max_fires=2)),
+        ("stall", dict(point="server.compute", stall_s=3.0, max_fires=1)),
+        ("drop", dict(point=reply, max_fires=1)),
+        ("duplicate_reply", dict(point=reply, max_fires=1)),
+        ("truncate_frame", dict(point=reply, max_fires=1)),
+        ("kill_process", dict(point="server.compute", max_fires=1)),
+    ]
+    if transport == "grpc":
+        rules.append(
+            ("getload_garbage", dict(point="server.getload", max_fires=2))
+        )
+    return rules
+
+
+def generate_plans(seed: int, transport: str, n_requests: int):
+    """Seeded (driver_plan, node_plan_json, n_replicas): 1-3 driver
+    rules in this process, 0-2 node rules shipped to ONE replica."""
+    rng = random.Random(seed)
+    n_replicas = rng.choice([2, 3])
+    driver_rules = []
+    for kind, kw in rng.sample(_driver_templates(transport), rng.randint(1, 3)):
+        kw = dict(kw)
+        if rng.random() < 0.7:
+            kw["nth"] = rng.randint(1, max(2, n_requests // n_replicas))
+            kw.pop("max_fires", None)  # nth defaults to one fire
+        driver_rules.append(fi.FaultRule(kind, **kw))
+    driver_plan = fi.FaultPlan(
+        driver_rules, seed=seed, plan_id=f"chaos-{seed}-driver"
+    )
+    node_plan_json = None
+    if rng.random() < 0.8:
+        node_rules = []
+        for kind, kw in rng.sample(
+            _node_templates(transport), rng.randint(1, 2)
+        ):
+            kw = dict(kw)
+            if kind != "getload_garbage" and rng.random() < 0.7:
+                kw["nth"] = rng.randint(2, max(3, n_requests))
+                kw.pop("max_fires", None)
+            node_rules.append(fi.FaultRule(kind, **kw))
+        node_plan_json = fi.FaultPlan(
+            node_rules, seed=seed, plan_id=f"chaos-{seed}-node"
+        ).to_json()
+    return driver_plan, node_plan_json, n_replicas
+
+
+# -- one seed ---------------------------------------------------------------
+
+#: RuntimeError messages the transports raise as their KNOWN loud
+#: verdicts (bare RuntimeError is also what an unclassified internal
+#: bug looks like — the asyncio.InvalidStateError escape this harness
+#: caught was exactly that class — so only these phrasings count).
+_LOUD_RUNTIME_MARKERS = (
+    "server error:",
+    "uuid mismatch",
+    "batch reply",
+    "does not advertise",
+    "does not answer",
+    "faultinject[",
+)
+
+
+def _is_loud(exc: BaseException) -> bool:
+    """Whether ``exc`` is one of the system's CLASSIFIED loud outcomes.
+    Anything else escaping a call is an invariant violation, even if it
+    happens to be an exception — silence and unclassified internals
+    both fail the seed."""
+    import grpc
+
+    from pytensor_federated_tpu.service.npwire import WireError
+    from pytensor_federated_tpu.service.tcp import RemoteComputeError
+
+    if isinstance(
+        exc,
+        (
+            RemoteComputeError,
+            WireError,
+            ConnectionError,
+            OSError,
+            TimeoutError,
+            grpc.aio.AioRpcError,
+        ),
+    ):
+        return True
+    if isinstance(exc, RuntimeError):
+        msg = str(exc)
+        return any(m in msg for m in _LOUD_RUNTIME_MARKERS)
+    return False
+
+
+class Violation(Exception):
+    pass
+
+
+async def _run_seed_async(
+    seed, transport, procs, ports, driver_plan, victim, has_node_plan, log
+):
+    from pytensor_federated_tpu.routing import NodePool, PooledArraysClient
+
+    pool = NodePool(
+        [("127.0.0.1", p) for p in ports],
+        transport=transport,
+        breaker_kwargs=dict(
+            failure_threshold=2, backoff_s=0.2, jitter_frac=0.1
+        ),
+        probe_timeout_s=2.0,
+    )
+    client = PooledArraysClient(pool)
+    n_loud = 0
+
+    async def deadline(coro):
+        return await asyncio.wait_for(coro, timeout=CALL_DEADLINE_S)
+
+    def check(i, out, where):
+        if out is None:
+            raise Violation(f"{where}: request {i} silently unreplied")
+        got = float(np.asarray(out[0]))
+        want = _expected(float(i))
+        if not np.isclose(got, want, rtol=1e-6):
+            raise Violation(
+                f"{where}: request {i} returned {got}, expected {want} "
+                "(silent corruption)"
+            )
+
+    try:
+        # Phase A: pipelined windows under chaos.
+        for w in range(3):
+            reqs = [
+                (np.array([float(i), 5.0], np.float64),) for i in range(12)
+            ]
+            try:
+                results = await deadline(
+                    client.evaluate_many_async(reqs, window=6)
+                )
+            except asyncio.TimeoutError:
+                raise Violation(f"window {w}: hang past {CALL_DEADLINE_S}s")
+            except Exception as e:
+                if not _is_loud(e):
+                    raise Violation(
+                        f"window {w}: UNCLASSIFIED error escaped "
+                        f"({type(e).__name__}: {str(e)[:200]})"
+                    )
+                n_loud += 1
+                log(f"  window {w}: loud error ({type(e).__name__}: "
+                    f"{str(e)[:80]})")
+            else:
+                for i, out in enumerate(results):
+                    check(i, out, f"window {w}")
+
+        # Phase B: singles (warm the hedge estimator), then hedged calls.
+        for i in range(10):
+            try:
+                out = await deadline(
+                    client.evaluate_async(np.array([float(i), 5.0]))
+                )
+            except asyncio.TimeoutError:
+                raise Violation(f"single {i}: hang past {CALL_DEADLINE_S}s")
+            except Exception as e:
+                if not _is_loud(e):
+                    raise Violation(
+                        f"single {i}: UNCLASSIFIED error escaped "
+                        f"({type(e).__name__}: {str(e)[:200]})"
+                    )
+                n_loud += 1
+                log(f"  single {i}: loud error ({type(e).__name__})")
+            else:
+                check(i, out, "single")
+        hedged = PooledArraysClient(
+            pool, hedge=True, hedge_min_wait_s=0.001
+        )
+        for i in range(8):
+            try:
+                out = await deadline(
+                    hedged.evaluate_async(np.array([float(i), 5.0]))
+                )
+            except asyncio.TimeoutError:
+                raise Violation(f"hedged {i}: hang past {CALL_DEADLINE_S}s")
+            except Exception as e:
+                if not _is_loud(e):
+                    raise Violation(
+                        f"hedged {i}: UNCLASSIFIED error escaped "
+                        f"({type(e).__name__}: {str(e)[:200]})"
+                    )
+                n_loud += 1
+                log(f"  hedged {i}: loud error ({type(e).__name__})")
+            else:
+                check(i, out, "hedged")
+
+        # Phase C: faults stop -> the system must reconverge.  The
+        # driver plan is uninstalled; the replica carrying a node-side
+        # plan is restarted PLAN-FREE (a rolling restart — its rules
+        # may hold un-hit nth anchors that would otherwise fire during
+        # the clean phase); killed replicas are respawned.
+        fi.uninstall()
+        for k, proc in enumerate(procs):
+            restart = not proc.is_alive() or (k == victim and has_node_plan)
+            if restart:
+                if proc.is_alive():
+                    proc.terminate()
+                    proc.join(timeout=10)
+                else:
+                    log(f"  replica {k} died (kill_process?): respawning")
+                procs[k] = _spawn_node(transport, ports[k], None)
+        await _wait_nodes_up_async(transport, ports)
+        deadline_t = time.time() + 30.0
+        while time.time() < deadline_t:
+            await pool.probe_once_async()
+            if all(r.breaker.state == "closed" for r in pool.replicas):
+                break
+            await asyncio.sleep(0.1)
+        bad = [
+            (r.address, r.breaker.state)
+            for r in pool.replicas
+            if r.breaker.state != "closed"
+        ]
+        if bad:
+            raise Violation(
+                f"breakers never reconverged after faults stopped: {bad}"
+            )
+
+        # The clean window: every value correct — a stream desynchronized
+        # by a hedged loser or a chaos-mangled frame would fail here.
+        reqs = [(np.array([float(i), 5.0], np.float64),) for i in range(12)]
+        results = await deadline(client.evaluate_many_async(reqs, window=6))
+        for i, out in enumerate(results):
+            check(i, out, "clean window")
+    finally:
+        fi.uninstall()
+        pool.close()
+    return n_loud
+
+
+def run_seed(seed: int, transport: str, verbose: bool) -> dict:
+    """One full chaos scenario; returns a result dict, raising nothing —
+    violations land in the dict with an incident-bundle path."""
+
+    def log(msg):
+        if verbose:
+            print(msg, flush=True)
+
+    n_requests = 12
+    driver_plan, node_plan_json, n_replicas = generate_plans(
+        seed, transport, n_requests
+    )
+    log(
+        f"seed {seed}: {n_replicas} replicas, driver rules "
+        f"{[r.to_dict() for r in driver_plan.rules]}, node plan "
+        f"{'yes' if node_plan_json else 'no'}"
+    )
+    tspans.set_enabled(True)
+    flightrec.set_enabled(True)
+    # The accounting invariant counts fault.* events across the whole
+    # seed; the default 512-event ring would evict early faults under
+    # a span-event flood, making the check lie.
+    if flightrec.capacity() < 16384:
+        flightrec.set_capacity(16384)
+    telemetry.clear_traces()
+    flightrec.clear()
+    reunion.clear()
+
+    ports = _free_ports(n_replicas)
+    victim = random.Random(seed ^ 0x5EED).randrange(n_replicas)
+    procs = [
+        _spawn_node(
+            transport, p, node_plan_json if k == victim else None
+        )
+        for k, p in enumerate(ports)
+    ]
+    result = {"seed": seed, "transport": transport, "ok": True}
+    try:
+        _wait_nodes_up(transport, ports)
+        fi.install(driver_plan)
+        n_loud = asyncio.run(
+            _run_seed_async(
+                seed, transport, procs, ports, driver_plan,
+                victim, node_plan_json is not None, log,
+            )
+        )
+        result["loud_errors"] = n_loud
+
+        # Invariant 4: telemetry accounting — every driver-side fired
+        # fault left its flight event.
+        fault_events = [
+            e
+            for e in flightrec.events()
+            if e["kind"].startswith("fault.")
+            and e["kind"][6:] in fi.FAULT_KINDS
+        ]
+        fired = driver_plan.total_fires
+        if len(fault_events) != fired:
+            raise Violation(
+                f"telemetry accounting: plan fired {fired} faults but "
+                f"{len(fault_events)} fault.* events were recorded"
+            )
+        result["faults_fired"] = fired
+    except Exception as e:  # noqa: BLE001 - every failure becomes a record
+        result["ok"] = False
+        result["error"] = f"{type(e).__name__}: {e}"
+        try:
+            result["bundle"] = write_incident_bundle(
+                "chaos-violation",
+                attrs={"seed": seed, "violation": str(e)[:500]},
+            )
+        except Exception as be:  # pragma: no cover - disk trouble
+            result["bundle"] = f"<bundle write failed: {be}>"
+    finally:
+        fi.uninstall()
+        for proc in procs:
+            if proc.is_alive():
+                proc.terminate()
+        for proc in procs:
+            proc.join(timeout=10)
+        telemetry.clear_traces()
+        flightrec.clear()
+        reunion.clear()
+    return result
+
+
+def main(argv=None) -> int:
+    import logging
+
+    # Chaos makes the transports loud by design (drop warnings, failed
+    # compute tracebacks); the per-seed verdict lines are the signal.
+    logging.disable(logging.WARNING)
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seeds", type=int, default=25,
+                    help="sweep seeds base..base+N-1 (default 25)")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="run exactly one seed (replay a failure)")
+    ap.add_argument("--base-seed", type=int, default=0)
+    ap.add_argument("--transport", choices=("grpc", "tcp"), default="grpc")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    seeds = (
+        [args.seed]
+        if args.seed is not None
+        else list(range(args.base_seed, args.base_seed + args.seeds))
+    )
+    t0 = time.time()
+    failures = []
+    for seed in seeds:
+        res = run_seed(seed, args.transport, args.verbose)
+        status = "ok" if res["ok"] else "FAIL"
+        extra = (
+            f"faults={res.get('faults_fired')} loud={res.get('loud_errors')}"
+            if res["ok"]
+            else f"{res['error']} bundle={res.get('bundle')}"
+        )
+        print(f"chaos seed {seed}: {status} ({extra})", flush=True)
+        if not res["ok"]:
+            failures.append(res)
+    wall = time.time() - t0
+    print(
+        json.dumps(
+            {
+                "chaos": {
+                    "seeds": len(seeds),
+                    "failures": len(failures),
+                    "transport": args.transport,
+                    "wall_s": round(wall, 1),
+                }
+            }
+        )
+    )
+    if failures:
+        print(
+            f"\n{len(failures)} seed(s) violated invariants; replay with "
+            f"`python tools/chaos_run.py --seed {failures[0]['seed']}"
+            + (" --transport tcp" if args.transport == "tcp" else "")
+            + "`",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

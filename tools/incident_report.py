@@ -120,6 +120,36 @@ def render_markdown(bundle: dict) -> str:
         out.append(f"_no events ({events!r})_")
     out.append("")
 
+    plan = bundle.get("fault_plan")
+    if isinstance(plan, dict):
+        out.append("## Fault plan (chaos active at incident time)")
+        out.append("")
+        out.append(
+            f"- **plan:** `{plan.get('plan_id', '?')}`  "
+            f"**seed:** {plan.get('seed', '?')}  "
+            f"**total fires:** {plan.get('total_fires', '?')}"
+        )
+        out.append("")
+        rules = plan.get("rules")
+        if isinstance(rules, list) and rules:
+            out.append(
+                "| # | kind | point | when | matches | fires | remaining |"
+            )
+            out.append("|---|---|---|---|---|---|---|")
+            for r in rules:
+                when = ", ".join(
+                    f"{k}={r[k]}"
+                    for k in ("nth", "every", "prob", "peer")
+                    if r.get(k) is not None
+                ) or "always"
+                out.append(
+                    f"| {r.get('index', '')} | `{r.get('kind', '?')}` "
+                    f"| `{r.get('point', '*')}` | {when} "
+                    f"| {r.get('matches', '')} | {r.get('fires', '')} "
+                    f"| {r.get('remaining', '∞')} |"
+                )
+        out.append("")
+
     reunion = bundle.get("trace_reunion")
     out.append("## Trace reunion (driver + node span trees per call)")
     out.append("")
@@ -179,6 +209,7 @@ def render_jsonl(bundle: dict) -> str:
                 "n_traces": len(bundle.get("trace_reunion") or ())
                 if isinstance(bundle.get("trace_reunion"), list)
                 else None,
+                "fault_plan": bundle.get("fault_plan"),
             },
             default=str,
         )
