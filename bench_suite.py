@@ -188,6 +188,37 @@ def _bench_serve_slow_node(port, delay_s):
     run_node(compute, "127.0.0.1", port, inline_compute=True)
 
 
+def _bench_serve_fed_node(port):
+    """Config 14's node: the fed wire contract ``(p, x, y) ->
+    [logp, grad_p, grad_x, grad_y]`` as pure numpy (no per-request jax
+    dispatch), so both lanes measure transport+driver overhead, not
+    node-side compute variance."""
+    import logging
+
+    import numpy as np
+
+    logging.basicConfig(level=logging.WARNING)
+    from pytensor_federated_tpu.utils import force_cpu_backend
+
+    force_cpu_backend()
+
+    def compute(p, x, y):
+        p = np.asarray(p)
+        x = np.asarray(x)
+        y = np.asarray(y)
+        r = y - p[0] - p[1] * x
+        return [
+            np.asarray(-np.sum(r * r), np.float32),
+            np.asarray([2.0 * np.sum(r), 2.0 * np.sum(r * x)], np.float32),
+            (2.0 * p[1] * r).astype(np.float32),
+            (-2.0 * r).astype(np.float32),
+        ]
+
+    from pytensor_federated_tpu.service import run_node
+
+    run_node(compute, "127.0.0.1", port)
+
+
 def main():
     preflight()
     import jax
@@ -1347,6 +1378,156 @@ def main():
                 p.join(timeout=5)
 
     guard("replica pool routing", _c13)
+
+    # 14. Fed primitive lane (ISSUE 6): the SAME per-shard logp+grad
+    # round driven through fed.program(PoolPlacement) — trace, window
+    # plan, interpreter, pure_callback — vs the direct evaluate_many
+    # fan-out it lowers to.  Rated: primitive-lane shard evals/s;
+    # baseline: the direct lane, same pool, same requests, same node
+    # compute.  Acceptance: >= 0.9x, i.e. the unified IR costs < 10%.
+    def _c14():
+        import asyncio
+        import multiprocessing as mp
+        import socket
+        import time as _time
+
+        from pytensor_federated_tpu import fed
+        from pytensor_federated_tpu.routing import (
+            NodePool,
+            PooledArraysClient,
+        )
+        from pytensor_federated_tpu.service import get_loads_async
+
+        def free_port():
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                return s.getsockname()[1]
+
+        ports = [free_port() for _ in range(2)]
+        ctx = mp.get_context("spawn")
+        procs = [
+            ctx.Process(
+                target=_bench_serve_fed_node, args=(p,), daemon=True
+            )
+            for p in ports
+        ]
+        for p in procs:
+            p.start()
+        try:
+            deadline = _time.time() + 60.0
+
+            async def wait_up():
+                while _time.time() < deadline:
+                    loads = await get_loads_async(
+                        [("127.0.0.1", p) for p in ports], timeout=1.0
+                    )
+                    if all(l is not None for l in loads):
+                        return
+                    await asyncio.sleep(0.2)
+                raise TimeoutError("fed bench nodes did not come up")
+
+            asyncio.run(wait_up())
+
+            n_shards, dim, window = 64, 16, 32
+            rng = np.random.default_rng(14)
+            x = jnp.asarray(rng.normal(size=(n_shards, dim)).astype(np.float32))
+            y = jnp.asarray(rng.normal(size=(n_shards, dim)).astype(np.float32))
+            params = jnp.asarray(np.float32([0.3, -0.8]))
+
+            def shard_logp(p, xs, ys):
+                return -jnp.sum((ys - p[0] - p[1] * xs) ** 2)
+
+            def model(p):
+                pb = fed.fed_broadcast(p, n_shards)
+                lps = fed.fed_map(
+                    lambda s: shard_logp(s[0], s[1], s[2]), (pb, x, y)
+                )
+                return fed.fed_sum(lps)
+
+            pool = NodePool([("127.0.0.1", p) for p in ports])
+            client = PooledArraysClient(pool)
+            run = fed.program(
+                model, fed.PoolPlacement(client, window=window)
+            )
+
+            p_np = np.asarray(params)
+            requests = [
+                (p_np, np.asarray(x[i]), np.asarray(y[i]))
+                for i in range(n_shards)
+            ]
+
+            def direct_eval():
+                replies = client.evaluate_many(requests, window=window)
+                return float(np.sum([r[0] for r in replies]))
+
+            # Warm both lanes: connections, EWMA, the program's traced
+            # jaxpr cache.
+            v_prim = float(run(params))
+            v_direct = direct_eval()
+            # Equality gate: the IR must compute the SAME number the
+            # direct lane does (bench convention: a lane that drifts
+            # numerically is measuring a different computation).
+            assert abs(v_prim - v_direct) <= 1e-4 * max(
+                1.0, abs(v_direct)
+            ), (v_prim, v_direct)
+
+            def rate_once(fn, budget_s=1.0):
+                t0 = _time.perf_counter()
+                n = 0
+                while _time.perf_counter() - t0 < budget_s:
+                    fn()
+                    n += n_shards
+                return n / (_time.perf_counter() - t0)
+
+            # Interleaved best-of-3 per lane: the two lanes differ by
+            # well under the run-to-run drift of a loaded container,
+            # so a single back-to-back pass can swing the ratio either
+            # way; alternating passes and taking each lane's best
+            # cancels the drift (same max-over-candidates convention
+            # as the impl-race configs).
+            prim_eval = lambda: run(params)
+            rates_d, rates_p = [], []
+            for _ in range(3):
+                rates_d.append(rate_once(direct_eval))
+                rates_p.append(rate_once(prim_eval))
+            rate_direct = max(rates_d)
+            rate_prim = max(rates_p)
+            overhead = 1.0 - rate_prim / rate_direct
+            print(
+                f"# fed primitive lane: {rate_prim:,.1f} shard evals/s "
+                f"vs direct {rate_direct:,.1f} "
+                f"(IR overhead {100 * overhead:.1f}%)",
+                file=sys.stderr,
+            )
+            record(
+                "fed primitive lane vs direct fanout (pool, 64 shards)",
+                rate_prim,
+                unit="shard evals/s",
+                baseline_rate=rate_direct,
+                baseline_desc=(
+                    f"direct evaluate_many over the same 2-replica "
+                    f"pool, same requests ({rate_direct:,.1f}); "
+                    "acceptance line: primitive lane >= 0.9x"
+                ),
+                primitive_lane_rps=round(rate_prim, 1),
+                direct_lane_rps=round(rate_direct, 1),
+                ir_overhead_frac=round(overhead, 4),
+                note="host-transport lane (no FLOP fields); the "
+                "primitive lane pays trace-cache lookup, window "
+                "planning, interpreter walk, and pure_callback per "
+                "evaluation on top of the identical wire round",
+            )
+            assert rate_prim >= 0.9 * rate_direct, (
+                f"primitive lane {rate_prim:.1f} < 90% of direct "
+                f"{rate_direct:.1f} shard evals/s"
+            )
+        finally:
+            for p in procs:
+                p.terminate()
+            for p in procs:
+                p.join(timeout=5)
+
+    guard("fed primitive lane", _c14)
 
     if results:
         print(

@@ -40,6 +40,7 @@ from .parallel import (
     single_device_mesh,
 )
 from . import diagnostics
+from . import fed
 from . import precision
 from .checkpoint import load_pytree, sample_checkpointed, save_pytree
 from .diagnostics import instrument_logp, profile_trace
@@ -69,6 +70,7 @@ __all__ = [
     "blackbox_compute",
     "blackbox_logp_grad",
     "diagnostics",
+    "fed",
     "from_logp_fn",
     "fuse",
     "get_load",

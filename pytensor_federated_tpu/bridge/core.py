@@ -172,6 +172,18 @@ def member_jax_callable(
             logp, grads = fn(*inputs)
             return (logp, *tuple(grads))
 
+        # A fed.FederatedLogpGrad's bound ``jax_fn`` carries the whole
+        # placement-lowered program; tag the wrapper so a FUSED apply
+        # (fused_jax_callable) can compose several such members into
+        # one fed.program and let the window-fusion pass coalesce
+        # their independent fed_maps.
+        ev = getattr(fn, "__self__", None)
+        if (
+            ev is not None
+            and callable(getattr(ev, "fed_model", None))
+            and getattr(ev, "placement", None) is not None
+        ):
+            logp_grad._fed_evaluator = ev
         return logp_grad
     if kind == "logp":
 
@@ -195,13 +207,33 @@ def fused_jax_callable(
     the fused op's JAX dispatch (XLA overlaps the members on its own).
     Input/output flattening mirrors ``fanout_exec.run_members``'s
     storage slicing, so the jit path and the perform path cannot
-    disagree about order."""
+    disagree about order.
+
+    When every member is a ``fed.FederatedLogpGrad`` potential sharing
+    one placement (the tag :func:`member_jax_callable` attaches), the
+    members compose into ONE ``fed.program`` instead: the fed batching
+    pass then fuses their independent ``fed_map`` calls into a single
+    pipelined pool window — the AsyncFusionOptimizer rewrite landing
+    at the primitive level (docs/migrating.md)."""
     member_fns = list(member_fns)
     in_counts = list(in_counts)
     if len(member_fns) != len(in_counts):
         raise ValueError(
             f"{len(member_fns)} member fns but {len(in_counts)} in_counts"
         )
+    evs = [getattr(f, "_fed_evaluator", None) for f in member_fns]
+    if len(evs) >= 2 and all(e is not None for e in evs):
+        # Placement EQUIVALENCE, not object identity: each potential is
+        # typically built with its own PoolPlacement over the shared
+        # client, and those must still fuse into one window.
+        keys = {
+            getattr(
+                e.placement, "fusion_key", lambda p=e.placement: id(p)
+            )()
+            for e in evs
+        }
+        if len(keys) == 1:
+            return _fused_fed_callable(evs, in_counts)
 
     def parallel(*inputs):
         if len(inputs) != sum(in_counts):
@@ -214,6 +246,59 @@ def fused_jax_callable(
         for fn, n_in in zip(member_fns, in_counts):
             res = fn(*inputs[i : i + n_in])
             outs.extend(res if isinstance(res, tuple) else (res,))
+            i += n_in
+        return tuple(outs)
+
+    return parallel
+
+
+def _fused_fed_callable(evaluators, in_counts) -> Callable:
+    """Compose N fed logp+grad potentials into ONE placement-lowered
+    program.  One ``value_and_grad`` over the joint program is one
+    forward execution — on a pool placement that is ONE fused window
+    for every member's shards, where per-member programs would each pay
+    their own round trip.  Output layout per member is ``(logp,
+    *grads)``, identical to the inlined path, so the perform lane and
+    this lane cannot disagree."""
+    import jax
+
+    from ..fed import program as fed_program
+
+    evaluators = list(evaluators)
+    placement = evaluators[0].placement
+    n_total = sum(in_counts)
+
+    def joint_model(*inputs):
+        lps, i = [], 0
+        for ev, n_in in zip(evaluators, in_counts):
+            lps.append(ev.fed_model(*inputs[i : i + n_in]))
+            i += n_in
+        return tuple(lps)
+
+    prog = fed_program(joint_model, placement)
+
+    def total_and_logps(*inputs):
+        lps = prog(*inputs)
+        total = lps[0]
+        for lp in lps[1:]:
+            total = total + lp
+        return total, lps
+
+    def parallel(*inputs):
+        if len(inputs) != n_total:
+            raise ValueError(
+                f"fused fed callable got {len(inputs)} inputs, members "
+                f"consume {n_total}"
+            )
+        (_, lps), grads = jax.value_and_grad(
+            total_and_logps,
+            argnums=tuple(range(n_total)),
+            has_aux=True,
+        )(*inputs)
+        outs, i = [], 0
+        for lp, n_in in zip(lps, in_counts):
+            outs.append(lp)
+            outs.extend(grads[i : i + n_in])
             i += n_in
         return tuple(outs)
 

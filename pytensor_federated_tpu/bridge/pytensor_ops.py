@@ -187,7 +187,17 @@ class FederatedLogpGradOp(Op):
 
 def federated_potential(logp_grad_fn: LogpGradFn, *inputs, jax_fn=None):
     """Apply a :class:`FederatedLogpGradOp` and return just the logp
-    variable — ready for ``pm.Potential`` (reference: demo_model.py:33-36)."""
+    variable — ready for ``pm.Potential`` (reference: demo_model.py:33-36).
+
+    A :class:`~pytensor_federated_tpu.fed.FederatedLogpGrad` evaluator
+    routes BOTH lanes through its one ``fed.program``: it is itself the
+    host ``LogpGradFn`` (perform path), and its ``.jax_fn`` — picked up
+    automatically here — is the placement-lowered traced program for
+    JAX-linker compiles, so mesh/pool/mixed execution and the window
+    fusion pass apply without any per-op wiring.
+    """
+    if jax_fn is None:
+        jax_fn = getattr(logp_grad_fn, "jax_fn", None)
     op = FederatedLogpGradOp(logp_grad_fn, jax_fn=jax_fn)
     return op(*inputs)[0]
 

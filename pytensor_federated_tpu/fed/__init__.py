@@ -1,0 +1,64 @@
+"""``fed`` — differentiable federated MapReduce with placement-aware
+lowering: ONE IR for the device and host lanes.
+
+The DrJAX-style unification (ROADMAP open item 1): ``fed_map`` /
+``fed_sum`` / ``fed_broadcast`` are real JAX primitives
+(:mod:`.primitives`) whose JVP/transpose rules encode the federated
+autodiff identities, so one traced model runs and ``jax.grad``\\s end
+to end whether its shards live on mesh devices
+(:class:`MeshPlacement`), RPC node pools (:class:`PoolPlacement`), or
+a mix (:class:`MixedPlacement`) — and the AsyncFusionOptimizer-style
+rewrite is a primitive-level batching pass (:mod:`.batching`) instead
+of graph surgery.
+
+Quick shape::
+
+    from pytensor_federated_tpu import fed
+
+    def model(params):
+        pb = fed.fed_broadcast(params, n_shards)
+        lps = fed.fed_map(lambda s: shard_logp(s[0], s[1]), (pb, data))
+        return fed.fed_sum(lps)
+
+    run = fed.program(model, fed.MeshPlacement(mesh))   # or Pool/Mixed
+    value, grads = jax.value_and_grad(run)(params)
+"""
+
+from .batching import plan_windows
+from .lowering import FederatedLogpGrad, program
+from .placements import (
+    MapSpec,
+    MeshPlacement,
+    MixedPlacement,
+    Placement,
+    PoolPlacement,
+    make_node_compute,
+)
+from .primitives import (
+    fed_broadcast,
+    fed_broadcast_p,
+    fed_map,
+    fed_map_p,
+    fed_mean,
+    fed_sum,
+    fed_sum_p,
+)
+
+__all__ = [
+    "FederatedLogpGrad",
+    "MapSpec",
+    "MeshPlacement",
+    "MixedPlacement",
+    "Placement",
+    "PoolPlacement",
+    "fed_broadcast",
+    "fed_broadcast_p",
+    "fed_map",
+    "fed_map_p",
+    "fed_mean",
+    "fed_sum",
+    "fed_sum_p",
+    "make_node_compute",
+    "plan_windows",
+    "program",
+]

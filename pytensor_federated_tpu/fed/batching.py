@@ -1,0 +1,60 @@
+"""Trace-time fusion pass: independent ``fed_map`` calls share a window.
+
+The reference needs a global PyTensor graph rewrite
+(``AsyncFusionOptimizer``, reference: op_async.py:216-234) to overlap
+independent remote applies.  Here the model is ALREADY a jaxpr with
+``fed_map`` equations in it, so the rewrite collapses to a planning
+pass over equations: find groups of ``fed_map`` eqns with no
+(transitive) data dependence between them, and hand each multi-member
+group to the placement as ONE ``lower_map_group`` call — which the
+pool lane turns into a single pipelined ``evaluate_many`` window
+(placements.py).  The independence algorithm is the same one the
+PyTensor rewriter uses (``bridge/grouping.group_independent`` — pure,
+shared, tested without either framework).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from jax.extend.core import Var
+
+from ..bridge.grouping import group_independent
+from .primitives import fed_map_p
+
+__all__ = ["plan_windows"]
+
+
+def plan_windows(jaxpr) -> Dict[int, List[int]]:
+    """Map each fused ``fed_map`` equation index to its group (a list
+    of mutually independent eqn indices, topo order).  Only groups of
+    two or more appear — singletons lower one call at a time.  Safety
+    is inherited from ``group_independent``: dependence is a transitive
+    closure over ALL equations, so members of one group can never reach
+    each other through intermediate non-``fed_map`` equations."""
+    eqns = list(jaxpr.eqns)
+    producer: Dict[Var, int] = {}
+    for i, eqn in enumerate(eqns):
+        for v in eqn.outvars:
+            producer[v] = i
+
+    def parents(i: int):
+        seen = set()
+        for v in eqns[i].invars:
+            if isinstance(v, Var) and v in producer:
+                j = producer[v]
+                if j not in seen:
+                    seen.add(j)
+                    yield j
+
+    groups = group_independent(
+        range(len(eqns)),
+        parents=parents,
+        is_candidate=lambda i: eqns[i].primitive is fed_map_p,
+    )
+    plan: Dict[int, List[int]] = {}
+    for g in groups:
+        if len(g) >= 2:
+            for i in g:
+                plan[i] = g
+    return plan

@@ -1,0 +1,302 @@
+"""``fed.program``: trace once, lower per placement, fuse windows.
+
+``program(fn, placement)`` returns a callable with ``fn``'s signature
+that (1) traces ``fn`` — whose body uses :mod:`.primitives` — to a
+jaxpr, (2) plans the window-fusion groups (:mod:`.batching`) and
+builds one persistent placement EXECUTOR per ``fed_map``
+equation/group, and (3) interprets the jaxpr, handing ``fed_map``
+equations to their executors and executing everything else with its
+normal JAX binding.  Because the interpreter runs under whatever trace
+is ambient, the SAME program object works eagerly, under ``jax.jit``,
+and under ``jax.grad``/``jax.vjp`` — the mesh lane differentiates
+through ``shard_map``/psum, the pool lane through the custom-VJP
+logp+grad contract, the mixed lane through both.
+
+With ``placement=None`` the wrapper is the identity: the primitives'
+dense semantics (vmap / sum / broadcast) execute directly, which is
+also the fastest single-chip layout.
+
+Trace + plan + executors are cached per argument-shape/dtype signature
+(a hot pool program pays the interpreter walk and one cached callback
+dispatch per call, not a retrace — bench_suite config 14 holds the IR
+overhead under 10%), unless the trace lifted closure TRACERS from an
+enclosing transformation — those are call-specific and must not leak
+into a cache.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import tree_util
+from jax.extend.core import Literal
+
+from .batching import plan_windows
+from .placements import MapSpec, Placement
+from .primitives import (
+    fed_broadcast,
+    fed_map,
+    fed_map_p,
+    fed_sum,
+    is_tracer as _is_tracer,
+)
+
+__all__ = ["FederatedLogpGrad", "program"]
+
+
+def _build_executors(
+    closed, placement: Placement, plan
+) -> Dict[int, tuple]:
+    """One persistent executor per ``fed_map`` equation: fused groups
+    share a group executor keyed at every member index.  Outer
+    constvars holding CONCRETE values are trace-time-baked constants
+    (``MapSpec`` uses this to tell a baked function constant from
+    driver-varying closure capture, which pool lanes must refuse)."""
+    jaxpr = closed.jaxpr
+    baked = frozenset(
+        v
+        for v, c in zip(jaxpr.constvars, closed.consts)
+        if not _is_tracer(c)
+    )
+    executors: Dict[int, tuple] = {}
+    done_groups: Dict[tuple, Any] = {}
+    for i, eqn in enumerate(jaxpr.eqns):
+        if eqn.primitive is not fed_map_p:
+            continue
+        group = plan.get(i)
+        if group is None:
+            executors[i] = ("single", placement.map_executor(
+                MapSpec.from_eqn(eqn, baked)
+            ))
+            continue
+        key = tuple(group)
+        if key not in done_groups:
+            done_groups[key] = placement.group_executor(
+                [MapSpec.from_eqn(jaxpr.eqns[j], baked) for j in group]
+            )
+        executors[i] = ("group", key, done_groups[key])
+    return executors
+
+
+def program(
+    fn: Callable,
+    placement: Optional[Placement] = None,
+    *,
+    fuse: bool = True,
+) -> Callable:
+    """Placement-aware executable form of a ``fed``-primitive model.
+
+    ``fn`` takes/returns pytrees of arrays; its body expresses the
+    federated algebra with :func:`fed_map` / :func:`fed_sum` /
+    :func:`fed_broadcast` / :func:`fed_mean`.  ``fuse=True`` coalesces
+    independent ``fed_map`` calls into one window where the placement
+    supports it (pool lanes).
+    """
+    if placement is None:
+        return fn
+    cache: dict = {}
+
+    def wrapped(*args):
+        flat, in_tree = tree_util.tree_flatten(args)
+        flat = [jnp.asarray(x) for x in flat]
+        key = (
+            in_tree,
+            tuple((jnp.shape(x), str(jnp.result_type(x))) for x in flat),
+        )
+        entry = cache.get(key)
+        if entry is None:
+            out_store: list = []
+
+            def flat_fn(*leaves):
+                a = tree_util.tree_unflatten(in_tree, leaves)
+                out_flat, out_tree = tree_util.tree_flatten(fn(*a))
+                out_store.append(out_tree)
+                return out_flat
+
+            closed = jax.make_jaxpr(flat_fn)(*flat)
+            plan = plan_windows(closed.jaxpr) if fuse else {}
+            executors = _build_executors(closed, placement, plan)
+            entry = (closed, out_store[0], plan, executors)
+            if not any(_is_tracer(c) for c in closed.consts):
+                cache[key] = entry
+        closed, out_tree, plan, executors = entry
+        outs = _interpret(closed, flat, plan, executors)
+        return tree_util.tree_unflatten(out_tree, outs)
+
+    wrapped.__name__ = getattr(fn, "__name__", "fed_program")
+    return wrapped
+
+
+def _interpret(closed, args: List[Any], plan, executors) -> list:
+    jaxpr = closed.jaxpr
+    env: dict = {}
+
+    def read(v):
+        return v.val if isinstance(v, Literal) else env[v]
+
+    def write(vs, vals):
+        for v, val in zip(vs, vals):
+            env[v] = val
+
+    write(jaxpr.constvars, closed.consts)
+    write(jaxpr.invars, args)
+
+    def ready(i) -> bool:
+        return all(
+            isinstance(v, Literal) or v in env
+            for v in jaxpr.eqns[i].invars
+        )
+
+    def consts_xs(eqn) -> Tuple[tuple, tuple]:
+        invals = [read(v) for v in eqn.invars]
+        n_consts = eqn.params["n_consts"]
+        return tuple(invals[:n_consts]), tuple(invals[n_consts:])
+
+    def run_eqn(eqn, i):
+        if eqn.primitive is fed_map_p:
+            _, executor = executors[i]
+            outs = executor(*consts_xs(eqn))
+        else:
+            invals = [read(v) for v in eqn.invars]
+            subfuns, bind_params = eqn.primitive.get_bind_params(eqn.params)
+            outs = eqn.primitive.bind(*subfuns, *invals, **bind_params)
+            if not eqn.primitive.multiple_results:
+                outs = [outs]
+        write(eqn.outvars, outs)
+
+    remaining = set(range(len(jaxpr.eqns)))
+    while remaining:
+        progressed = False
+        for i in sorted(remaining):
+            if i not in remaining:
+                continue
+            group = plan.get(i)
+            if group is not None:
+                if not all(j in remaining and ready(j) for j in group):
+                    continue
+                _, _, executor = executors[i]
+                group_outs = executor(
+                    [consts_xs(jaxpr.eqns[j]) for j in group]
+                )
+                for j, outs in zip(group, group_outs):
+                    write(jaxpr.eqns[j].outvars, outs)
+                remaining -= set(group)
+                progressed = True
+                continue
+            if not ready(i):
+                continue
+            run_eqn(jaxpr.eqns[i], i)
+            remaining.discard(i)
+            progressed = True
+        if not progressed:  # pragma: no cover - grouping guarantees progress
+            raise RuntimeError(
+                "fed program scheduling wedged: remaining equations "
+                f"{sorted(remaining)} have unmet inputs"
+            )
+    return [read(v) for v in jaxpr.outvars]
+
+
+class FederatedLogpGrad:
+    """One federated log-potential, every lane: the ``fed.program``
+    evaluator the bridge routes through.
+
+    ``per_shard_fn(*params, shard_data)`` is the per-shard
+    log-potential; ``data`` is the stacked shard pytree.  The model it
+    programs is the canonical broadcast→map→sum round::
+
+        logp(params) = fed_sum(fed_map(f, (fed_broadcast(params), data)))
+
+    Surfaces:
+
+    - :meth:`logp` / :meth:`logp_and_grad` — JAX-side evaluation under
+      the placement (``jax.grad`` works through all lanes).
+    - ``__call__(*arrays) -> (logp, [grads])`` — the host
+      ``LogpGradFn`` signature, directly usable as
+      ``bridge.federated_potential``'s compute (perform path).
+    - :attr:`jax_fn` — the ``(logp, grads)`` callable the bridge's
+      ``jax_funcify`` dispatch inlines; ``federated_potential`` picks
+      it up automatically, and ``bridge.core.fused_jax_callable``
+      composes several of these into ONE fused program whose
+      independent ``fed_map`` calls share a window.
+    - :meth:`node_compute` — the matching node-side deployment
+      (``service.run_node(ev.node_compute(), ...)``) for pool lanes.
+    """
+
+    def __init__(
+        self,
+        per_shard_fn: Callable,
+        data: Any,
+        *,
+        placement: Optional[Placement] = None,
+        fuse: bool = True,
+    ):
+        self.per_shard_fn = per_shard_fn
+        self.data = data
+        self.placement = placement
+        leaves = tree_util.tree_leaves(data)
+        dims = {jnp.shape(l)[0] for l in leaves}
+        if len(dims) != 1:
+            raise ValueError(
+                f"data leaves must share a leading shard axis, got {dims}"
+            )
+        self.n_shards = int(dims.pop())
+        self._data_treedef = tree_util.tree_structure(data)
+        self._program = program(
+            self._model, placement=placement, fuse=fuse
+        )
+
+    # The canonical round, in primitives (placement-free: `program`
+    # owns the lowering).
+    def _model(self, *params):
+        pb = fed_broadcast(tuple(params), self.n_shards)
+        lps = fed_map(
+            lambda shard: self.per_shard_fn(*shard[0], shard[1]),
+            (pb, self.data),
+        )
+        return fed_sum(lps)
+
+    def fed_model(self, *params):
+        """The raw primitive-level model (no placement) — what
+        ``fused_jax_callable`` composes across potentials so the fused
+        program's batching pass sees every member's ``fed_map``."""
+        return self._model(*params)
+
+    def logp(self, *params) -> jax.Array:
+        return self._program(*params)
+
+    def logp_and_grad(self, *params):
+        argnums = tuple(range(len(params)))
+        return jax.value_and_grad(self._program, argnums=argnums)(*params)
+
+    def jax_fn(self, *params):
+        """``(logp, grads)`` for the bridge's ``jax_funcify`` lane."""
+        logp, grads = self.logp_and_grad(*params)
+        return logp, list(grads)
+
+    def __call__(self, *arrays):
+        """Host ``LogpGradFn``: numpy in, ``(logp, [grads])`` out."""
+        logp, grads = self.logp_and_grad(
+            *[jnp.asarray(a) for a in arrays]
+        )
+        return np.asarray(logp), [np.asarray(g) for g in grads]
+
+    def node_compute(self, *, grads: bool = True):
+        """Node-side compute matching this evaluator's wire contract:
+        requests carry ``(params leaves..., data leaves...)``."""
+        from .placements import make_node_compute
+
+        treedef = self._data_treedef
+        n_data = treedef.num_leaves
+        per_shard = self.per_shard_fn
+
+        def flat(*arrays):
+            params = arrays[: len(arrays) - n_data]
+            dleaves = arrays[len(arrays) - n_data :]
+            return per_shard(
+                *params, tree_util.tree_unflatten(treedef, list(dleaves))
+            )
+
+        return make_node_compute(flat, grads=grads)

@@ -1,0 +1,605 @@
+"""Placement-aware lowering of the ``fed`` primitives.
+
+A :class:`Placement` decides WHERE the shards of a ``fed_map`` live
+and HOW the per-shard program executes there:
+
+- :class:`MeshPlacement` — shards are positions along a named mesh
+  axis; ``fed_map`` lowers to the existing ``shard_map`` + vmap
+  machinery (``parallel/sharded.py``), with unmapped closure constants
+  replicated and ``mark_varying``-ed before any user code runs (the
+  CLAUDE.md pvary/psum invariant).
+- :class:`PoolPlacement` — shards are requests over an RPC node pool;
+  ``fed_map`` lowers to ONE pipelined ``evaluate_many`` window through
+  ``jax.pure_callback``, differentiable via the reference's
+  forward-supplied-gradient contract (nodes reply ``[logp, *grads]``;
+  the custom VJP applies ``g · grads``).  A *group* of independent
+  ``fed_map`` calls lowers to a single fused window — the
+  AsyncFusionOptimizer rewrite (SURVEY L4) as a primitive-level pass.
+- :class:`MixedPlacement` — splits the shard range: the leading shards
+  ride a mesh, the trailing shards a pool, outputs concatenate (and
+  gradients flow through both lanes).
+
+Lowerings are built as PERSISTENT **executors**: ``map_executor(spec)``
+/ ``group_executor(specs)`` construct the callback closures, custom
+VJPs, and shard_map programs ONCE per traced program (``lowering.py``
+caches them alongside the jaxpr), so repeated evaluations hit JAX's
+dispatch caches instead of re-tracing — the primitive lane's overhead
+budget (bench_suite config 14: IR must cost < 10% over the direct
+fan-out).
+
+The wire contract of a pool-placed ``fed_map``: each request carries
+exactly the shard's MAPPED leaves, in ``tree_flatten`` order.  Closure
+constants never leave the driver — driver state a node needs must
+arrive via ``fed_broadcast`` (which makes it a mapped operand), and
+the node's deployed compute must be the same per-shard function
+(:func:`make_node_compute` builds it from the identical Python
+callable, so driver and node cannot disagree).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from .._compat import shard_map
+from ..parallel.mesh import SHARDS_AXIS, mark_varying
+from ..telemetry import flightrec as _flightrec
+from ..telemetry import spans as _spans
+from .primitives import _per_shard_fun, is_tracer as _is_tracer
+
+__all__ = [
+    "MapSpec",
+    "MeshPlacement",
+    "MixedPlacement",
+    "Placement",
+    "PoolPlacement",
+    "make_node_compute",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class MapSpec:
+    """The static shape of one ``fed_map`` equation: everything an
+    executor needs besides the runtime operand values."""
+
+    jaxpr: Any
+    n_consts: int
+    n_shards: int
+    x_avals: Tuple[Any, ...]  # stacked mapped operands (shape incl. shards)
+    out_avals: Tuple[Any, ...]  # per-shard outputs
+    # How many unmapped operands are DRIVER-VARYING — fed by program
+    # inputs or upstream equations rather than concrete trace-time
+    # constants.  A node cannot know such values, so pool lanes (which
+    # ship only mapped leaves) must refuse them loudly; concrete baked
+    # constants are fine — the node's deployed copy of the same
+    # function carries them.
+    n_varying_consts: int = 0
+
+    @classmethod
+    def from_eqn(cls, eqn, baked_vars=frozenset()) -> "MapSpec":
+        from jax.extend.core import Literal as _Literal
+
+        jaxpr = eqn.params["jaxpr"]
+        n_consts = eqn.params["n_consts"]
+        varying = sum(
+            1
+            for v in eqn.invars[:n_consts]
+            if not isinstance(v, _Literal) and v not in baked_vars
+        )
+        return cls(
+            jaxpr=jaxpr,
+            n_consts=n_consts,
+            n_shards=eqn.params["n_shards"],
+            x_avals=tuple(v.aval for v in eqn.invars[n_consts:]),
+            out_avals=tuple(v.aval for v in jaxpr.outvars),
+            n_varying_consts=varying,
+        )
+
+    @property
+    def grad_contract(self) -> bool:
+        """Whether this call fits the logp+grad wire contract: exactly
+        one scalar inexact output per shard."""
+        return (
+            len(self.out_avals) == 1
+            and tuple(self.out_avals[0].shape) == ()
+            and jnp.issubdtype(self.out_avals[0].dtype, jnp.inexact)
+        )
+
+    def sliced(self, lo: int, hi: int) -> "MapSpec":
+        return dataclasses.replace(
+            self,
+            n_shards=hi - lo,
+            x_avals=tuple(
+                jax.ShapeDtypeStruct(
+                    (hi - lo,) + tuple(av.shape)[1:], av.dtype
+                )
+                for av in self.x_avals
+            ),
+        )
+
+
+# An executor takes (consts, xs) value tuples and returns the stacked
+# outputs; a group executor takes one (consts, xs) pair per member.
+MapExecutor = Callable[[Tuple[Any, ...], Tuple[Any, ...]], List[Any]]
+
+
+class Placement:
+    """Where/how ``fed_map`` shards execute.  Subclasses implement
+    :meth:`map_executor`; :meth:`group_executor` fuses a group of
+    independent calls when the lane can (pool windows)."""
+
+    def map_executor(self, spec: MapSpec) -> MapExecutor:
+        raise NotImplementedError
+
+    def fusion_key(self) -> tuple:
+        """Equivalence key for cross-potential fusion
+        (``bridge.core.fused_jax_callable``): two placements with the
+        same key lower identically, so members built with distinct but
+        equivalent placement OBJECTS still compose into one program."""
+        return ("placement", id(self))
+
+    def group_executor(self, specs: Sequence[MapSpec]) -> Callable:
+        members = [self.map_executor(s) for s in specs]
+
+        def run(args: Sequence[Tuple[tuple, tuple]]) -> List[List[Any]]:
+            return [ex(c, x) for ex, (c, x) in zip(members, args)]
+
+        return run
+
+    # Convenience single-shot lowering (wrappers, tests): build an
+    # executor and run it once.
+    def lower_map(self, spec: MapSpec, consts, xs) -> List[Any]:
+        return self.map_executor(spec)(tuple(consts), tuple(xs))
+
+
+class MeshPlacement(Placement):
+    """Shards along a named mesh axis: the current shard_map/psum
+    lowering (``parallel/sharded.py``) behind the primitive IR.
+
+    ``n_shards`` may exceed the axis size (each device vmaps its local
+    block) but must divide evenly.  Closure constants are replicated
+    (``P()``) and marked varying before the per-shard program runs, so
+    a ``jax.grad`` inside the body cannot hit the implicit-pvary psum
+    trap (CLAUDE.md design invariants).  The shard_map program is built
+    once per executor and jitted, so repeat evaluations dispatch from
+    cache.
+    """
+
+    def __init__(self, mesh, axis: str = SHARDS_AXIS):
+        if axis not in mesh.axis_names:
+            raise ValueError(
+                f"mesh has no axis {axis!r}: {mesh.axis_names}"
+            )
+        self.mesh = mesh
+        self.axis = axis
+
+    def fusion_key(self) -> tuple:
+        return ("mesh", id(self.mesh), self.axis)
+
+    def map_executor(self, spec: MapSpec) -> MapExecutor:
+        axis, mesh = self.axis, self.mesh
+        axis_size = mesh.shape[axis]
+        if spec.n_shards % axis_size != 0:
+            raise ValueError(
+                f"n_shards={spec.n_shards} not divisible by mesh axis "
+                f"{axis!r} of size {axis_size}"
+            )
+        fun = _per_shard_fun(spec.jaxpr)
+
+        def local(consts, local_xs):
+            consts = mark_varying(consts, axis)
+            return jax.vmap(lambda *s: tuple(fun(*consts, *s)))(*local_xs)
+
+        sm = shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(), P(axis)),
+            out_specs=P(axis),
+        )
+        jitted = jax.jit(lambda consts, xs: tuple(sm(consts, xs)))
+        return lambda consts, xs: list(jitted(tuple(consts), tuple(xs)))
+
+
+class PoolPlacement(Placement):
+    """Shards as requests over a replica pool (or any transport client
+    with ``evaluate_many(requests, window=)`` — ``PooledArraysClient``,
+    the gRPC/TCP clients, or their typed adapters).
+
+    Differentiation uses the reference's logp+grad contract: for a
+    ``fed_map`` whose per-shard program returns one scalar, the node
+    replies ``[logp, *grads]`` (one grad per mapped leaf — deploy with
+    :func:`make_node_compute`), and a ``jax.custom_vjp`` applies the
+    forward-supplied gradients.  Non-scalar maps execute forward-only
+    (``grads=False`` node deployments); differentiating through one
+    raises like any ``pure_callback``.
+
+    A group of independent ``fed_map`` calls lowers to ONE pipelined
+    window: requests from every call ride a single ``evaluate_many``
+    (span ``fed.window`` / flightrec ``fed.fused_window`` carry the
+    evidence).  All calls in a fused window hit the same client, so the
+    deployed node compute must serve every member's request shape —
+    the reference's one-service-fn-per-node topology.
+    """
+
+    def __init__(self, client, *, window: int = 8, logp_dtype=None):
+        self.client = client
+        self.window = int(window)
+        self.logp_dtype = logp_dtype
+
+    def fusion_key(self) -> tuple:
+        return ("pool", id(self.client), self.window, self.logp_dtype)
+
+    # -- host side ---------------------------------------------------------
+
+    def _run_window(self, metas, flat_np):
+        """One fused evaluate_many over every call's shards.  Returns
+        the raw reply list per request, sliced per call."""
+        requests: list = []
+        slices = []
+        i = 0
+        for n_shards, arity in metas:
+            xs = [np.asarray(x) for x in flat_np[i : i + arity]]
+            i += arity
+            lo = len(requests)
+            for s in range(n_shards):
+                requests.append(tuple(x[s] for x in xs))
+            slices.append((lo, len(requests)))
+        with _spans.span(
+            "fed.window",
+            lane="pool",
+            calls=len(metas),
+            requests=len(requests),
+        ):
+            _flightrec.record(
+                "fed.fused_window",
+                lane="pool",
+                calls=len(metas),
+                requests=len(requests),
+                window=self.window,
+            )
+            replies = self.client.evaluate_many(
+                requests, window=self.window
+            )
+        return [replies[lo:hi] for lo, hi in slices]
+
+    # -- executors ---------------------------------------------------------
+
+    def map_executor(self, spec: MapSpec) -> MapExecutor:
+        group = self.group_executor([spec])
+
+        def run(consts, xs):
+            return group([(consts, xs)])[0]
+
+        return run
+
+    def group_executor(self, specs: Sequence[MapSpec]) -> Callable:
+        specs = list(specs)
+        for s in specs:
+            if s.n_varying_consts:
+                # Computing anyway would be SILENTLY wrong: the node
+                # would use whatever it baked at deploy time and the
+                # gradient of the dropped operand would be zero.
+                raise ValueError(
+                    f"a pool-placed fed_map closes over "
+                    f"{s.n_varying_consts} driver-varying value(s); "
+                    "pool placements ship only MAPPED operands, so "
+                    "route driver state through fed_broadcast (making "
+                    "it a mapped operand) instead of closure capture"
+                )
+        grad_idx = [i for i, s in enumerate(specs) if s.grad_contract]
+        fwd_idx = [i for i, s in enumerate(specs) if not s.grad_contract]
+        grad_exec = (
+            self._grad_window_executor([specs[i] for i in grad_idx])
+            if grad_idx
+            else None
+        )
+        fwd_exec = (
+            self._forward_group_executor([specs[i] for i in fwd_idx])
+            if fwd_idx
+            else None
+        )
+
+        def run(args: Sequence[Tuple[tuple, tuple]]) -> List[List[Any]]:
+            results: dict = {}
+            if grad_exec is not None:
+                outs = grad_exec([args[i][1] for i in grad_idx])
+                for i, o in zip(grad_idx, outs):
+                    results[i] = o
+            if fwd_exec is not None:
+                outs = fwd_exec([args[i][1] for i in fwd_idx])
+                for i, o in zip(fwd_idx, outs):
+                    results[i] = o
+            return [results[i] for i in range(len(specs))]
+
+        return run
+
+    def _grad_window_executor(self, specs: Sequence[MapSpec]) -> Callable:
+        """Fused differentiable window, built ONCE: primal outputs are
+        each call's stacked per-shard logps; the VJP applies the
+        node-supplied per-shard gradients (mapped cotangent =
+        ``g_s · grad_s``; an unmapped/broadcast operand's cotangent
+        then falls out of the ``fed_broadcast`` transpose upstream)."""
+        metas = [(s.n_shards, len(s.x_avals)) for s in specs]
+        # Per MEMBER dtype: fused members need not share one (a bf16
+        # and an f32 logp can ride the same window).
+        logp_dts = [
+            self.logp_dtype or s.out_avals[0].dtype for s in specs
+        ]
+        arity = [len(s.x_avals) for s in specs]
+
+        logp_specs = tuple(
+            jax.ShapeDtypeStruct((s.n_shards,), dt)
+            for s, dt in zip(specs, logp_dts)
+        )
+        x_specs = [av for s in specs for av in s.x_avals]
+        grad_specs = tuple(
+            jax.ShapeDtypeStruct(tuple(av.shape), _grad_dtype(av.dtype))
+            for av in x_specs
+        )
+
+        def host_logps(*arrays):
+            per_call = self._run_window(metas, arrays)
+            return tuple(
+                np.asarray([r[0] for r in replies], dtype=dt)
+                for replies, dt in zip(per_call, logp_dts)
+            )
+
+        def host_logps_grads(*arrays):
+            per_call = self._run_window(metas, arrays)
+            out = [
+                np.asarray([r[0] for r in replies], dt)
+                for replies, dt in zip(per_call, logp_dts)
+            ]
+            k = 0
+            for (n_shards, n_in), replies in zip(metas, per_call):
+                for j in range(n_in):
+                    out.append(
+                        np.stack(
+                            [np.asarray(r[1 + j]) for r in replies]
+                        ).astype(grad_specs[k].dtype)
+                    )
+                    k += 1
+            return tuple(out)
+
+        n_calls = len(specs)
+
+        @jax.custom_vjp
+        def window_call(*flat):
+            return jax.pure_callback(
+                host_logps, logp_specs, *flat, vmap_method="sequential"
+            )
+
+        def fwd(*flat):
+            outs = jax.pure_callback(
+                host_logps_grads,
+                logp_specs + grad_specs,
+                *flat,
+                vmap_method="sequential",
+            )
+            return tuple(outs[:n_calls]), tuple(outs[n_calls:])
+
+        def bwd(residual_grads, cts):
+            flat_ct = []
+            k = 0
+            for ci in range(n_calls):
+                g = cts[ci]  # (n_shards,) cotangent of the stacked logps
+                for _ in range(arity[ci]):
+                    grad = residual_grads[k]
+                    av = x_specs[k]
+                    k += 1
+                    if not jnp.issubdtype(av.dtype, jnp.inexact):
+                        flat_ct.append(
+                            np.zeros(tuple(av.shape), jax.dtypes.float0)
+                        )
+                        continue
+                    gb = g.reshape((-1,) + (1,) * (grad.ndim - 1))
+                    flat_ct.append((gb * grad).astype(av.dtype))
+            return tuple(flat_ct)
+
+        window_call.defvjp(fwd, bwd)
+
+        def run(xs_per_call: Sequence[tuple]) -> List[List[Any]]:
+            flat = [x for xs in xs_per_call for x in xs]
+            if not any(map(_is_tracer, flat)):
+                # No ambient trace: skip the callback/custom-vjp
+                # dispatch machinery entirely (measured ~0.5 ms/eval —
+                # the bulk of the IR's fixed cost, bench_suite config
+                # 14) and run the host window directly.  Transformed
+                # calls (grad/jit/vmap) carry tracers and take the
+                # callback path below.
+                logps = host_logps(*flat)
+            else:
+                logps = window_call(*flat)
+            return [[lp] for lp in logps]
+
+        return run
+
+    def _forward_group_executor(self, specs: Sequence[MapSpec]) -> Callable:
+        """Fused forward-only window (no grad contract): every member's
+        shards ride one ``evaluate_many``; replies slice back per call.
+        Differentiating through it raises like any ``pure_callback``."""
+        metas = [(s.n_shards, len(s.x_avals)) for s in specs]
+        out_specs_per_call = [
+            tuple(
+                jax.ShapeDtypeStruct(
+                    (s.n_shards,) + tuple(av.shape), av.dtype
+                )
+                for av in s.out_avals
+            )
+            for s in specs
+        ]
+        flat_specs = tuple(
+            sp for call in out_specs_per_call for sp in call
+        )
+
+        def host(*arrays):
+            per_call = self._run_window(metas, arrays)
+            out = []
+            for replies, call_specs in zip(per_call, out_specs_per_call):
+                for k, sp in enumerate(call_specs):
+                    out.append(
+                        np.stack(
+                            [np.asarray(r[k]) for r in replies]
+                        ).astype(sp.dtype)
+                    )
+            return tuple(out)
+
+        def run(xs_per_call: Sequence[tuple]) -> List[List[Any]]:
+            flat = [x for xs in xs_per_call for x in xs]
+            if not any(map(_is_tracer, flat)):
+                outs = host(*flat)  # eager fast path (see grad twin)
+            else:
+                outs = jax.pure_callback(
+                    host, flat_specs, *flat, vmap_method="sequential"
+                )
+            result, k = [], 0
+            for call_specs in out_specs_per_call:
+                result.append(list(outs[k : k + len(call_specs)]))
+                k += len(call_specs)
+            return result
+
+        return run
+
+
+def _grad_dtype(dt):
+    return dt if jnp.issubdtype(dt, jnp.inexact) else jnp.float32
+
+
+class MixedPlacement(Placement):
+    """Shard range split across two lanes: the first ``n - pool_shards``
+    shards execute on ``mesh``, the trailing ``pool_shards`` on
+    ``pool``; stacked outputs concatenate in shard order, and gradients
+    flow through both lanes (slice/concat transposes are exact)."""
+
+    def __init__(
+        self,
+        mesh: MeshPlacement,
+        pool: PoolPlacement,
+        *,
+        pool_shards: int,
+    ):
+        self.mesh = mesh
+        self.pool = pool
+        self.pool_shards = int(pool_shards)
+        if self.pool_shards < 1:
+            raise ValueError("pool_shards must be >= 1")
+
+    def fusion_key(self) -> tuple:
+        return (
+            "mixed",
+            self.mesh.fusion_key(),
+            self.pool.fusion_key(),
+            self.pool_shards,
+        )
+
+    def _cut(self, spec: MapSpec) -> int:
+        k = self.pool_shards
+        if not (0 < k < spec.n_shards):
+            raise ValueError(
+                f"pool_shards={k} must be in 1..{spec.n_shards - 1} "
+                f"(got a {spec.n_shards}-shard fed_map)"
+            )
+        return spec.n_shards - k
+
+    def map_executor(self, spec: MapSpec) -> MapExecutor:
+        group = self.group_executor([spec])
+
+        def run(consts, xs):
+            return group([(consts, xs)])[0]
+
+        return run
+
+    def group_executor(self, specs: Sequence[MapSpec]) -> Callable:
+        specs = list(specs)
+        cuts = [self._cut(s) for s in specs]
+        mesh_execs = [
+            self.mesh.map_executor(s.sliced(0, cut))
+            for s, cut in zip(specs, cuts)
+        ]
+        pool_group = self.pool.group_executor(
+            [s.sliced(cut, s.n_shards) for s, cut in zip(specs, cuts)]
+        )
+
+        def run(args: Sequence[Tuple[tuple, tuple]]) -> List[List[Any]]:
+            mesh_outs = [
+                ex(c, tuple(x[:cut] for x in xs))
+                for ex, cut, (c, xs) in zip(mesh_execs, cuts, args)
+            ]
+            pool_outs = pool_group(
+                [
+                    (c, tuple(x[cut:] for x in xs))
+                    for cut, (c, xs) in zip(cuts, args)
+                ]
+            )
+            return [
+                [
+                    jnp.concatenate([m, p], axis=0)
+                    for m, p in zip(m_out, p_out)
+                ]
+                for m_out, p_out in zip(mesh_outs, pool_outs)
+            ]
+
+        return run
+
+
+def make_node_compute(
+    per_shard_fn: Callable[..., Any], *, grads: bool = True
+) -> Callable[..., list]:
+    """Node-side compute for a pool-placed ``fed_map``.
+
+    ``per_shard_fn(*leaves)`` takes one shard's mapped leaves (the
+    request arrays, ``tree_flatten`` order — broadcast driver state
+    first if the program broadcasts it before the data).  With
+    ``grads=True`` (the differentiable logp contract) it must return a
+    scalar, and the node replies ``[logp, *grads]`` with one gradient
+    per request array (zeros for integer leaves).  With ``grads=False``
+    the reply is the flat output list.
+
+    Built from the SAME Python callable the driver's ``fed_map`` maps,
+    so the two sides cannot drift apart.
+    """
+
+    if grads:
+
+        def compute(*arrays):
+            args = [jnp.asarray(a) for a in arrays]
+            diff_idx = [
+                i
+                for i, a in enumerate(args)
+                if jnp.issubdtype(a.dtype, jnp.inexact)
+            ]
+
+            def f(diff_args):
+                full = list(args)
+                for i, v in zip(diff_idx, diff_args):
+                    full[i] = v
+                return per_shard_fn(*full)
+
+            val, dgrads = jax.value_and_grad(f)(
+                [args[i] for i in diff_idx]
+            )
+            by_idx = dict(zip(diff_idx, dgrads))
+            out = [np.asarray(val)]
+            for i, a in enumerate(args):
+                g = by_idx.get(i)
+                out.append(
+                    np.asarray(g)
+                    if g is not None
+                    else np.zeros(np.shape(a), np.float32)
+                )
+            return out
+
+        return compute
+
+    def compute_fwd(*arrays):
+        out = per_shard_fn(*[jnp.asarray(a) for a in arrays])
+        import jax.tree_util as tu
+
+        return [np.asarray(o) for o in tu.tree_leaves(out)]
+
+    return compute_fwd

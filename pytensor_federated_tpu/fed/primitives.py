@@ -1,0 +1,531 @@
+"""Differentiable federated MapReduce as first-class JAX primitives.
+
+DrJAX-style (PAPERS.md: "DrJAX: Scalable and Differentiable MapReduce
+Primitives in JAX"): the federated algebra — *map* a function over
+every shard's placed values, *sum* shard-placed values back to the
+driver, *broadcast* driver state out to the shards — is expressed as
+real ``jax.extend.core.Primitive``\\s with their own abstract-eval,
+JVP, transpose, and batching rules, so ONE traced model differentiates
+end to end and every placement (mesh devices, RPC node pools, a mix)
+lowers the same IR instead of owning a parallel codepath.
+
+The autodiff identities (the reason these are primitives, not helper
+functions):
+
+- ``fed_broadcast`` is linear; its transpose is ``fed_sum`` — the
+  gradient of replicated driver state is the SUM of the shard
+  cotangents (the psum that ``parallel.mesh.mark_varying`` exists to
+  keep explicit).
+- ``fed_sum`` is linear; its transpose is ``fed_broadcast``.
+- ``fed_map`` transposes to a ``fed_map`` of the per-shard transposed
+  function; cotangents of *unmapped* operands (closure constants —
+  replicated values) are additionally ``fed_sum``-reduced, which is
+  exactly the implicit-pvary-transposes-to-psum rule from CLAUDE.md,
+  now a structural property of the IR instead of a trap.
+
+Calling convention of ``fed_map_p``: the first ``n_consts`` operands
+are UNMAPPED (closure constants lifted by tracing — identical on every
+shard), the rest are MAPPED (leading ``n_shards`` axis).  ``jaxpr`` is
+the per-shard program over ``(consts..., shard_leaves...)``.
+
+With no placement active, the primitives carry dense semantics
+(``vmap`` / ``sum(axis=0)`` / ``broadcast_to``) through impl, MLIR
+lowering, and batching — so a ``fed`` program jits, vmaps, and grads
+like plain JAX.  Placement-aware execution is :func:`..fed.program`
+(lowering.py), which interprets the SAME jaxpr.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import tree_util
+from jax.extend import core as jex_core
+from jax.interpreters import ad, batching, mlir
+from jax.interpreters import partial_eval as pe
+
+__all__ = [
+    "fed_broadcast",
+    "fed_broadcast_p",
+    "fed_map",
+    "fed_map_p",
+    "fed_mean",
+    "fed_sum",
+    "fed_sum_p",
+]
+
+# ShapedArray/Tracer moved around across jax versions; resolve once.
+try:  # pragma: no cover - version layout
+    from jax.core import ShapedArray, Tracer as _Tracer
+except ImportError:  # pragma: no cover
+    from jax._src.core import ShapedArray, Tracer as _Tracer
+
+
+def is_tracer(x) -> bool:
+    """Whether ``x`` belongs to an ambient trace (vs a concrete value) —
+    the eager-fast-path / cache-safety discriminator shared by the
+    placement executors and ``fed.program``."""
+    return isinstance(x, _Tracer)
+
+
+def _leading_dim(leaves) -> int:
+    dims = {jnp.shape(l)[0] for l in leaves}
+    if len(dims) != 1:
+        raise ValueError(
+            f"all mapped leaves must share a leading shard axis, got {dims}"
+        )
+    return int(dims.pop())
+
+
+def _aval(x) -> jax.ShapeDtypeStruct:
+    return jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x))
+
+
+def _shard_aval(x) -> jax.ShapeDtypeStruct:
+    return jax.ShapeDtypeStruct(jnp.shape(x)[1:], jnp.result_type(x))
+
+
+def _closed(jaxpr) -> jex_core.ClosedJaxpr:
+    return jex_core.ClosedJaxpr(jaxpr, ())
+
+
+def _per_shard_fun(jaxpr) -> Callable:
+    """The per-shard function ``(consts..., shard_leaves...) -> outs``."""
+    return jex_core.jaxpr_as_fun(_closed(jaxpr))
+
+
+def _trace_flat(fn, avals):
+    """``make_jaxpr`` + closure conversion: constants the trace lifts
+    (including tracers from an enclosing trace) become leading invars,
+    returned separately so the caller binds them as operands."""
+    closed = jax.make_jaxpr(fn)(*avals)
+    jaxpr = pe.convert_constvars_jaxpr(closed.jaxpr)
+    return jaxpr, list(closed.consts)
+
+
+# ---------------------------------------------------------------------------
+# fed_map
+# ---------------------------------------------------------------------------
+
+fed_map_p = jex_core.Primitive("fed_map")
+fed_map_p.multiple_results = True
+
+
+def fed_map(fn: Callable[[Any], Any], data: Any) -> Any:
+    """Apply ``fn`` to every shard of ``data``; outputs stacked along a
+    leading shards axis.
+
+    ``data`` is a pytree whose leaves carry a leading ``n_shards``
+    axis; ``fn(shard_pytree) -> pytree``.  Values ``fn`` closes over
+    become UNMAPPED operands of the primitive (replicated — their
+    cotangents are ``fed_sum``-reduced by the transpose rule).  For
+    placements that ship work over the wire (``PoolPlacement``), pass
+    everything varying as *mapped* data via :func:`fed_broadcast`
+    instead of closing over it — closure constants never leave the
+    driver.
+    """
+    flat, in_tree = tree_util.tree_flatten(data)
+    if not flat:
+        raise ValueError("fed_map data pytree has no leaves")
+    flat = [jnp.asarray(l) for l in flat]
+    n_shards = _leading_dim(flat)
+    out_store = []
+
+    def per_shard(*shard_leaves):
+        shard = tree_util.tree_unflatten(in_tree, shard_leaves)
+        out_flat, out_tree = tree_util.tree_flatten(fn(shard))
+        out_store.append(out_tree)
+        return out_flat
+
+    jaxpr, consts = _trace_flat(per_shard, [_shard_aval(l) for l in flat])
+    outs = fed_map_p.bind(
+        *consts,
+        *flat,
+        jaxpr=jaxpr,
+        n_consts=len(consts),
+        n_shards=n_shards,
+    )
+    return tree_util.tree_unflatten(out_store[0], outs)
+
+
+def _fed_map_dense(args, *, jaxpr, n_consts, n_shards):
+    fun = _per_shard_fun(jaxpr)
+    in_axes = (None,) * n_consts + (0,) * (len(args) - n_consts)
+    outs = jax.vmap(lambda *a: tuple(fun(*a)), in_axes=in_axes)(*args)
+    return list(outs)
+
+
+fed_map_p.def_impl(lambda *args, **params: _fed_map_dense(args, **params))
+mlir.register_lowering(
+    fed_map_p,
+    mlir.lower_fun(
+        lambda *args, **params: _fed_map_dense(args, **params),
+        multiple_results=True,
+    ),
+)
+
+
+@fed_map_p.def_abstract_eval
+def _fed_map_abstract(*in_avals, jaxpr, n_consts, n_shards):
+    return [
+        ShapedArray((n_shards,) + tuple(v.aval.shape), v.aval.dtype)
+        for v in jaxpr.outvars
+    ]
+
+
+def _inexact(x) -> bool:
+    return jnp.issubdtype(jnp.result_type(x), jnp.inexact)
+
+
+def _zero_tangent_like(x):
+    # Integer/bool primals take float0 tangents (the jax.jvp contract).
+    return np.zeros(jnp.shape(x), jax.dtypes.float0)
+
+
+def _fed_map_jvp(primals, tangents, *, jaxpr, n_consts, n_shards):
+    """Primal bind plus a SEPARATE tangent ``fed_map`` bind.
+
+    Two binds (rather than one jvp-of-fn bind returning both) keep
+    linearize clean: the primal equation has all-known inputs, and the
+    tangent equation is linear in the tangent operands, so the
+    transpose rule below sees exactly the mixed known/undefined operand
+    pattern it expects.  The tangent program re-derives the primal
+    internally (``jax.jvp``); XLA CSE collapses the duplicate work when
+    both binds land in one compiled program.
+    """
+    fun = _per_shard_fun(jaxpr)
+    n_in = len(primals)
+    # Only inexact operands with non-symbolic-zero tangents become
+    # tangent operands; int/bool operands take float0 tangents which
+    # cannot ride as primitive operands and are rebuilt inside.
+    lin_idx = [
+        i
+        for i in range(n_in)
+        if _inexact(primals[i]) and type(tangents[i]) is not ad.Zero
+    ]
+
+    primal_out = fed_map_p.bind(
+        *primals, jaxpr=jaxpr, n_consts=n_consts, n_shards=n_shards
+    )
+
+    out_inexact = [_inexact_var(v) for v in jaxpr.outvars]
+    if not lin_idx or not any(out_inexact):
+        return primal_out, [
+            _symbolic_zero_for(v, n_shards) for v in jaxpr.outvars
+        ]
+
+    lin_consts = [i for i in lin_idx if i < n_consts]
+    lin_mapped = [i for i in lin_idx if i >= n_consts]
+    # Argument order mirrors the bind below EXACTLY (unmapped operands
+    # first): primal consts, tangent consts, mapped primals, mapped
+    # tangents.
+    def tangent_fn(*a):
+        pc = a[:n_consts]
+        tc = dict(zip(lin_consts, a[n_consts : n_consts + len(lin_consts)]))
+        off = n_consts + len(lin_consts)
+        px = a[off : off + (n_in - n_consts)]
+        tx = dict(zip(lin_mapped, a[off + (n_in - n_consts) :]))
+        p = tuple(pc) + tuple(px)
+        full_t = []
+        for i in range(n_in):
+            if i in tc:
+                full_t.append(tc[i])
+            elif i in tx:
+                full_t.append(tx[i])
+            elif _inexact(p[i]):
+                full_t.append(
+                    jnp.zeros(jnp.shape(p[i]), jnp.result_type(p[i]))
+                )
+            else:
+                full_t.append(_zero_tangent_like(p[i]))
+        _, t_out = jax.jvp(lambda *x: tuple(fun(*x)), p, tuple(full_t))
+        return [o for o, ok in zip(t_out, out_inexact) if ok]
+
+    avals = (
+        [_aval(x) for x in primals[:n_consts]]
+        + [_aval(tangents[i]) for i in lin_consts]
+        + [_shard_aval(x) for x in primals[n_consts:]]
+        + [_shard_aval(tangents[i]) for i in lin_mapped]
+    )
+    t_jaxpr, t_consts = _trace_flat(tangent_fn, avals)
+    t_outs = fed_map_p.bind(
+        *t_consts,
+        *primals[:n_consts],
+        *[tangents[i] for i in lin_consts],
+        *primals[n_consts:],
+        *[tangents[i] for i in lin_mapped],
+        jaxpr=t_jaxpr,
+        n_consts=len(t_consts) + n_consts + len(lin_consts),
+        n_shards=n_shards,
+    )
+    t_iter = iter(t_outs)
+    tangents_out = [
+        next(t_iter) if ok else _symbolic_zero_for(v, n_shards)
+        for v, ok in zip(jaxpr.outvars, out_inexact)
+    ]
+    return primal_out, tangents_out
+
+
+def _inexact_var(v) -> bool:
+    return jnp.issubdtype(v.aval.dtype, jnp.inexact)
+
+
+def _symbolic_zero_for(v, n_shards):
+    aval = ShapedArray((n_shards,) + tuple(v.aval.shape), v.aval.dtype)
+    try:
+        return ad.Zero(aval.to_tangent_aval())
+    except AttributeError:  # pragma: no cover - older jax spelling
+        return ad.Zero(aval.at_least_vspace())
+
+
+ad.primitive_jvps[fed_map_p] = _fed_map_jvp
+
+
+def _fed_map_transpose(cts, *args, jaxpr, n_consts, n_shards):
+    fun = _per_shard_fun(jaxpr)
+    n_in = len(args)
+    lin_idx = [i for i in range(n_in) if ad.is_undefined_primal(args[i])]
+    nl_un = [i for i in range(n_consts) if i not in lin_idx]
+    nl_mapped = [i for i in range(n_consts, n_in) if i not in lin_idx]
+    used = [i for i, c in enumerate(cts) if type(c) is not ad.Zero]
+    if not used:
+        return [
+            ad.Zero(args[i].aval) if i in lin_idx else None
+            for i in range(n_in)
+        ]
+
+    def lin_shard_aval(i):
+        av = args[i].aval
+        shape = tuple(av.shape) if i < n_consts else tuple(av.shape)[1:]
+        return jax.ShapeDtypeStruct(shape, av.dtype)
+
+    lin_avals = [lin_shard_aval(i) for i in lin_idx]
+
+    def transposed_shard(*ops):
+        k1, k2 = len(nl_un), len(nl_mapped)
+        vals = dict(zip(nl_un + nl_mapped, ops[: k1 + k2]))
+        ct_shard = list(ops[k1 + k2 :])
+
+        def lin(*lin_vals):
+            full = [None] * n_in
+            for i, v in vals.items():
+                full[i] = v
+            for i, v in zip(lin_idx, lin_vals):
+                full[i] = v
+            outs = fun(*full)
+            return [outs[i] for i in used]
+
+        return jax.linear_transpose(lin, *lin_avals)(ct_shard)
+
+    ct_vals = [cts[i] for i in used]
+    avals = (
+        [_aval(args[i]) for i in nl_un]
+        + [_shard_aval(args[i]) for i in nl_mapped]
+        + [_shard_aval(c) for c in ct_vals]
+    )
+    t_jaxpr, t_consts = _trace_flat(transposed_shard, avals)
+    outs = fed_map_p.bind(
+        *t_consts,
+        *[args[i] for i in nl_un],
+        *[args[i] for i in nl_mapped],
+        *ct_vals,
+        jaxpr=t_jaxpr,
+        n_consts=len(t_consts) + len(nl_un),
+        n_shards=n_shards,
+    )
+    result = [None] * n_in
+    for k, i in enumerate(lin_idx):
+        stacked = outs[k]
+        result[i] = (
+            fed_sum_p.bind(stacked) if i < n_consts else stacked
+        )
+    return result
+
+
+ad.primitive_transposes[fed_map_p] = _fed_map_transpose
+
+
+def _fed_map_batching(args, dims, *, jaxpr, n_consts, n_shards):
+    fun = _per_shard_fun(jaxpr)
+    new_args, inner_axes = [], []
+    for i, (a, d) in enumerate(zip(args, dims)):
+        if d is batching.not_mapped:
+            new_args.append(a)
+            inner_axes.append(None)
+        elif i < n_consts:
+            new_args.append(jnp.moveaxis(a, d, 0))
+            inner_axes.append(0)
+        else:
+            # Mapped operand: shard axis must stay leading; batch rides
+            # axis 1, so the per-shard view is batched at axis 0.
+            new_args.append(jnp.moveaxis(a, d, 1))
+            inner_axes.append(0)
+
+    def batched_shard(*shard_args):
+        return tuple(
+            jax.vmap(lambda *x: tuple(fun(*x)), in_axes=tuple(inner_axes))(
+                *shard_args
+            )
+        )
+
+    avals = [
+        _aval(a) if i < n_consts else _shard_aval(a)
+        for i, a in enumerate(new_args)
+    ]
+    b_jaxpr, b_consts = _trace_flat(batched_shard, avals)
+    outs = fed_map_p.bind(
+        *b_consts,
+        *new_args[:n_consts],
+        *new_args[n_consts:],
+        jaxpr=b_jaxpr,
+        n_consts=len(b_consts) + n_consts,
+        n_shards=n_shards,
+    )
+    return outs, (1,) * len(outs)
+
+
+batching.primitive_batchers[fed_map_p] = _fed_map_batching
+
+
+# ---------------------------------------------------------------------------
+# fed_sum
+# ---------------------------------------------------------------------------
+
+fed_sum_p = jex_core.Primitive("fed_sum")
+
+
+def fed_sum(values: Any) -> Any:
+    """Reduce shard-stacked values (leading shards axis) by summation —
+    the driver's sum-of-potentials, transpose of :func:`fed_broadcast`."""
+    return tree_util.tree_map(
+        lambda l: fed_sum_p.bind(jnp.asarray(l)), values
+    )
+
+
+def _fed_sum_impl(x):
+    return jnp.sum(x, axis=0)
+
+
+fed_sum_p.def_impl(_fed_sum_impl)
+mlir.register_lowering(
+    fed_sum_p, mlir.lower_fun(_fed_sum_impl, multiple_results=False)
+)
+
+
+@fed_sum_p.def_abstract_eval
+def _fed_sum_abstract(x):
+    if not x.shape:
+        raise ValueError("fed_sum operand must carry a leading shards axis")
+    return ShapedArray(tuple(x.shape)[1:], x.dtype)
+
+
+def _fed_sum_transpose(ct, x):
+    if type(ct) is ad.Zero:
+        return [ad.Zero(x.aval)]
+    return [fed_broadcast_p.bind(ct, n_shards=int(x.aval.shape[0]))]
+
+
+ad.deflinear2(fed_sum_p, _fed_sum_transpose)
+
+
+def _fed_sum_batching(args, dims):
+    (x,), (d,) = args, dims
+    out = fed_sum_p.bind(jnp.moveaxis(x, d, -1))
+    return out, out.ndim - 1
+
+
+batching.primitive_batchers[fed_sum_p] = _fed_sum_batching
+
+
+# ---------------------------------------------------------------------------
+# fed_broadcast
+# ---------------------------------------------------------------------------
+
+fed_broadcast_p = jex_core.Primitive("fed_broadcast")
+
+
+def fed_broadcast(value: Any, n_shards: int) -> Any:
+    """Replicate driver state to every shard (stacked along shards) —
+    the placement move whose transpose is :func:`fed_sum`.  Pool
+    placements ship ONLY mapped operands, so driver state a pool-placed
+    ``fed_map`` needs must arrive through this, not through closure."""
+    n = int(n_shards)
+    if n < 1:
+        raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+    return tree_util.tree_map(
+        lambda l: fed_broadcast_p.bind(jnp.asarray(l), n_shards=n), value
+    )
+
+
+def _fed_broadcast_impl(x, *, n_shards):
+    return jnp.broadcast_to(x, (n_shards,) + jnp.shape(x))
+
+
+fed_broadcast_p.def_impl(_fed_broadcast_impl)
+mlir.register_lowering(
+    fed_broadcast_p,
+    mlir.lower_fun(_fed_broadcast_impl, multiple_results=False),
+)
+
+
+@fed_broadcast_p.def_abstract_eval
+def _fed_broadcast_abstract(x, *, n_shards):
+    return ShapedArray((n_shards,) + tuple(x.shape), x.dtype)
+
+
+def _fed_broadcast_transpose(ct, x, *, n_shards):
+    if type(ct) is ad.Zero:
+        return [ad.Zero(x.aval)]
+    return [fed_sum_p.bind(ct)]
+
+
+ad.deflinear2(fed_broadcast_p, _fed_broadcast_transpose)
+
+
+def _fed_broadcast_batching(args, dims, *, n_shards):
+    (x,), (d,) = args, dims
+    out = fed_broadcast_p.bind(x, n_shards=n_shards)
+    return out, d + 1
+
+
+batching.primitive_batchers[fed_broadcast_p] = _fed_broadcast_batching
+
+
+# ---------------------------------------------------------------------------
+# fed_mean (composite)
+# ---------------------------------------------------------------------------
+
+
+def fed_mean(values: Any, weights: Optional[jax.Array] = None) -> Any:
+    """(Weighted) mean across shards of shard-stacked values.
+
+    ``weights`` must be a 1-D vector with EXACTLY one entry per shard:
+    a wrong-length vector that happens to broadcast against trailing
+    dimensions would silently weight the wrong axis, so the length is
+    validated against the leading shard axis and raises ``ValueError``.
+    """
+    flat = tree_util.tree_leaves(values)
+    if not flat:
+        return values
+    n = _leading_dim(flat)
+    if weights is None:
+        return tree_util.tree_map(
+            lambda l: fed_sum_p.bind(jnp.asarray(l) / n), values
+        )
+    w = jnp.asarray(weights)
+    if w.ndim != 1 or int(w.shape[0]) != n:
+        raise ValueError(
+            f"weights must be a length-{n} vector (one weight per "
+            f"shard), got shape {tuple(w.shape)}"
+        )
+    w = w / jnp.sum(w)
+
+    def wmean(l):
+        l = jnp.asarray(l)
+        wb = w.reshape((-1,) + (1,) * (l.ndim - 1))
+        return fed_sum_p.bind(l * wb)
+
+    return tree_util.tree_map(wmean, values)

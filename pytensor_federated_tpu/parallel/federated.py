@@ -1,4 +1,4 @@
-"""Federated MapReduce primitives + federated averaging (FedAvg).
+"""Federated MapReduce API + federated averaging (FedAvg).
 
 The reference frames everything as "arrays in -> arrays out per node,
 summed by the driver's graph" (reference: README.md:27-35,
@@ -6,9 +6,16 @@ demo_model.py:34-36).  This module names that algebra directly, in the
 style of DrJAX's MapReduce primitives (PAPERS.md): ``federated_map``
 runs a function over every shard's private data, ``federated_sum`` /
 ``federated_mean`` reduce across shards, ``federated_broadcast``
-replicates driver state.  On a mesh the reduce lowers to the psum
-collective; single-device it is a plain axis reduction — same program
-shape either way.
+replicates driver state.
+
+Since the ``fed`` subsystem landed, these are thin wrappers over the
+REAL JAX primitives in :mod:`pytensor_federated_tpu.fed.primitives`
+(``fed_map_p`` / ``fed_sum_p`` / ``fed_broadcast_p``, with their own
+JVP/transpose rules): single-device calls carry the primitives' dense
+semantics, and ``mesh=`` routes through
+:class:`~pytensor_federated_tpu.fed.MeshPlacement` — the same shard_map
+/psum lowering, now shared with the pool and mixed placements.  The
+public signatures are unchanged.
 
 On top of them, :func:`fedavg` implements federated averaging
 (McMahan et al.): per round, every shard takes ``local_steps`` SGD
@@ -45,10 +52,16 @@ def federated_map(
     ``fn(shard_data) -> pytree``.  The data-parallel "map" primitive:
     the TPU-native form of one RPC round over the node pool (reference:
     op_async.py:107-132 fans N calls out concurrently; here it is one
-    SPMD program).
+    SPMD program).  Binds :data:`fed.fed_map_p`; with ``mesh=`` the
+    call lowers through :class:`fed.MeshPlacement` (shard_map + vmap,
+    closure constants replicated and marked varying).
     """
-    run = sharded_compute(lambda _, d: fn(d), data, mesh=mesh, axis=axis)
-    return run(None)
+    from .. import fed
+
+    if mesh is None:
+        return fed.fed_map(fn, data)
+    placement = fed.MeshPlacement(mesh, axis=axis)
+    return fed.program(lambda d: fed.fed_map(fn, d), placement)(data)
 
 
 def federated_sum(values: Any) -> Any:
@@ -57,28 +70,34 @@ def federated_sum(values: Any) -> Any:
     Under a mesh the leading axis is device-sharded, so XLA lowers this
     to the psum collective — the driver-side "sum of potentials"
     (reference: demo_model.py:34-36) without a graph in the middle.
+    Binds :data:`fed.fed_sum_p`, whose transpose is
+    :func:`federated_broadcast` (the DrJAX identity).
     """
-    return jax.tree_util.tree_map(lambda l: jnp.sum(l, axis=0), values)
+    from ..fed import fed_sum
+
+    return fed_sum(values)
 
 
 def federated_mean(values: Any, weights: Optional[jax.Array] = None) -> Any:
-    """(Weighted) mean across shards of shard-stacked values."""
-    if weights is None:
-        return jax.tree_util.tree_map(lambda l: jnp.mean(l, axis=0), values)
-    w = weights / jnp.sum(weights)
+    """(Weighted) mean across shards of shard-stacked values.
 
-    def wmean(l):
-        wb = w.reshape((-1,) + (1,) * (l.ndim - 1))
-        return jnp.sum(l * wb, axis=0)
+    ``weights`` must have exactly one entry per shard; a wrong-length
+    vector that merely broadcasts raises ``ValueError`` (it would
+    silently weight the wrong axis).
+    """
+    from ..fed import fed_mean
 
-    return jax.tree_util.tree_map(wmean, values)
+    return fed_mean(values, weights)
 
 
 def federated_broadcast(value: Any, n_shards: int) -> Any:
-    """Replicate driver state to every shard (stacked along shards)."""
-    return jax.tree_util.tree_map(
-        lambda l: jnp.broadcast_to(l, (n_shards,) + jnp.shape(l)), value
-    )
+    """Replicate driver state to every shard (stacked along shards).
+    Binds :data:`fed.fed_broadcast_p`, whose transpose is
+    :func:`federated_sum` — the gradient of replicated state is the sum
+    of shard cotangents."""
+    from ..fed import fed_broadcast
+
+    return fed_broadcast(value, n_shards)
 
 
 def fedavg(

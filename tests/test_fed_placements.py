@@ -1,0 +1,341 @@
+"""ISSUE 6 acceptance: ONE model definition runs and ``jax.grad``\\s
+identically (f32-strict tolerance) under MeshPlacement, PoolPlacement,
+and MixedPlacement — and the fusion pass provably coalesces two
+independent ``fed_map`` calls into one pipelined window (flightrec /
+span evidence).
+
+The pool lane here is REAL transport: in-process TCP nodes (the
+tutorial §16 pattern) deployed with ``make_node_compute`` from the
+SAME per-shard function the mesh lane maps, behind a routed
+``PooledArraysClient``.
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytensor_federated_tpu import fed
+from pytensor_federated_tpu.bridge import core as bridge_core
+from pytensor_federated_tpu.parallel import make_mesh
+from pytensor_federated_tpu.routing import NodePool, PooledArraysClient
+from pytensor_federated_tpu.service import serve_tcp_once
+from pytensor_federated_tpu.telemetry import flightrec
+
+N = 8
+RTOL = 1e-5  # f32-strict: identical math, differing reduction orders
+GTOL = 1e-4
+
+
+def _shard_logp(p, xs, ys):
+    pred = p[0] + p[1] * xs
+    return -jnp.sum((ys - pred) ** 2)
+
+
+def _node_fn(p, d):
+    # FederatedLogpGrad-style signature: (*params, shard_data_pytree).
+    return _shard_logp(p, d[0], d[1])
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(N, 12)).astype(np.float32)
+    y = (1.0 - 2.0 * x + 0.1 * rng.normal(size=(N, 12))).astype(np.float32)
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return jnp.asarray(np.float32([0.4, -1.1]))
+
+
+@pytest.fixture(scope="module")
+def pool_client(data):
+    """Two TCP replicas serving the node-side twin of the per-shard
+    logp, behind a routed pool client."""
+    compute = fed.make_node_compute(_shard_logp)
+    ports = {}
+    for name in ("a", "b"):
+        ready = threading.Event()
+        threading.Thread(
+            target=serve_tcp_once,
+            args=(compute,),
+            daemon=True,
+            kwargs=dict(
+                ready_callback=lambda p, r=ready, n=name: (
+                    ports.update({n: p}),
+                    r.set(),
+                ),
+                concurrent=True,
+            ),
+        ).start()
+        assert ready.wait(30)
+    pool = NodePool(
+        [("127.0.0.1", ports["a"]), ("127.0.0.1", ports["b"])],
+        transport="tcp",
+        breaker_kwargs=dict(failure_threshold=1, backoff_s=30.0),
+    )
+    client = PooledArraysClient(pool)
+    yield client
+    client.close()
+    pool.close()
+
+
+def _model_for(x, y):
+    def model(p):
+        pb = fed.fed_broadcast(p, N)
+        lps = fed.fed_map(
+            lambda s: _shard_logp(s[0], s[1], s[2]), (pb, x, y)
+        )
+        return fed.fed_sum(lps)
+
+    return model
+
+
+class TestEquivalenceGate:
+    def test_one_model_three_placements(
+        self, data, params, devices8, pool_client
+    ):
+        x, y = data
+        model = _model_for(x, y)
+        ref_v = float(model(params))
+        ref_g = np.asarray(jax.grad(model)(params))
+
+        mesh8 = fed.MeshPlacement(make_mesh({"shards": 8}, devices=devices8))
+        mesh4 = fed.MeshPlacement(make_mesh({"shards": 4}, devices=devices8[:4]))
+        placements = {
+            "mesh": mesh8,
+            "pool": fed.PoolPlacement(pool_client, window=8),
+            "mixed": fed.MixedPlacement(
+                mesh4,
+                fed.PoolPlacement(pool_client, window=8),
+                pool_shards=4,
+            ),
+        }
+        for name, placement in placements.items():
+            run = fed.program(model, placement)
+            v = float(run(params))
+            g = np.asarray(jax.grad(run)(params))
+            np.testing.assert_allclose(v, ref_v, rtol=RTOL, err_msg=name)
+            np.testing.assert_allclose(g, ref_g, rtol=GTOL, err_msg=name)
+
+    def test_value_and_grad_through_pool(self, data, params, pool_client):
+        x, y = data
+        run = fed.program(
+            _model_for(x, y), fed.PoolPlacement(pool_client, window=4)
+        )
+        v, g = jax.value_and_grad(run)(params)
+        model = _model_for(x, y)
+        np.testing.assert_allclose(float(v), float(model(params)), rtol=RTOL)
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(jax.grad(model)(params)), rtol=GTOL
+        )
+
+
+class TestFusionEvidence:
+    def test_two_maps_one_window(self, data, params, pool_client):
+        """Two independent fed_maps fuse into ONE pipelined window —
+        the flight record shows a single fed.fused_window carrying both
+        calls' requests, and the span tree one fed.window."""
+        x, y = data
+        x2 = x + 0.5
+
+        def model(p):
+            pb = fed.fed_broadcast(p, N)
+            a = fed.fed_sum(
+                fed.fed_map(lambda s: _shard_logp(*s), (pb, x, y))
+            )
+            b = fed.fed_sum(
+                fed.fed_map(lambda s: _shard_logp(*s), (pb, x2, y))
+            )
+            return a + b
+
+        run = fed.program(model, fed.PoolPlacement(pool_client, window=8))
+        flightrec.clear()
+        v = float(run(params))
+        np.testing.assert_allclose(v, float(model(params)), rtol=RTOL)
+
+        fused = [
+            e for e in flightrec.events() if e["kind"] == "fed.fused_window"
+        ]
+        assert len(fused) == 1, fused
+        assert fused[0]["calls"] == 2
+        assert fused[0]["requests"] == 2 * N
+        window_spans = [
+            e
+            for e in flightrec.events()
+            if e["kind"] == "span.close" and e.get("name") == "fed.window"
+        ]
+        assert len(window_spans) == 1
+
+        # grad flows through the fused window and stays correct
+        np.testing.assert_allclose(
+            np.asarray(jax.grad(run)(params)),
+            np.asarray(jax.grad(model)(params)),
+            rtol=GTOL,
+        )
+
+    def test_fuse_off_pays_two_windows(self, data, params, pool_client):
+        x, y = data
+
+        def model(p):
+            pb = fed.fed_broadcast(p, N)
+            a = fed.fed_sum(
+                fed.fed_map(lambda s: _shard_logp(*s), (pb, x, y))
+            )
+            b = fed.fed_sum(
+                fed.fed_map(lambda s: _shard_logp(*s), (pb, x, y))
+            )
+            return a + b
+
+        run = fed.program(
+            model, fed.PoolPlacement(pool_client, window=8), fuse=False
+        )
+        flightrec.clear()
+        run(params)
+        fused = [
+            e for e in flightrec.events() if e["kind"] == "fed.fused_window"
+        ]
+        assert len(fused) == 2
+        assert all(e["calls"] == 1 for e in fused)
+
+
+class TestPoolContractEnforcement:
+    def test_varying_closure_const_raises(self, data, params, pool_client):
+        """A pool-placed fed_map that CLOSES over driver state (instead
+        of broadcasting it) must fail loudly at lowering: the node
+        cannot know the value, so computing would be silently wrong
+        (wrong forward, zero gradient)."""
+        x, y = data
+
+        def model(p):
+            # p captured by closure — varying, but unmapped.
+            lps = fed.fed_map(
+                lambda s: _shard_logp(p, s[0], s[1]), (x, y)
+            )
+            return fed.fed_sum(lps)
+
+        run = fed.program(model, fed.PoolPlacement(pool_client, window=8))
+        with pytest.raises(ValueError, match="fed_broadcast"):
+            run(params)
+
+    def test_baked_function_constants_are_fine(self, data, params, pool_client):
+        """Concrete trace-time constants inside the per-shard function
+        are NOT driver state: the node's deployed copy of the same
+        function carries them, so they lower fine."""
+        x, y = data
+
+        def shard_fn(p, xs, ys):
+            # the array literal is lifted as a trace-time CONST — baked
+            # into both the driver's jaxpr and the node's deployment.
+            prior_scale = jnp.asarray([0.25, 0.5], jnp.float32)
+            return _shard_logp(p, xs, ys) - jnp.sum((p * prior_scale) ** 2)
+
+        import threading as _threading
+
+        from pytensor_federated_tpu.service import (
+            TcpArraysClient,
+            serve_tcp_once,
+        )
+
+        ready = _threading.Event()
+        box = {}
+        _threading.Thread(
+            target=serve_tcp_once,
+            args=(fed.make_node_compute(shard_fn),),
+            daemon=True,
+            kwargs=dict(
+                ready_callback=lambda p: (box.update(p=p), ready.set()),
+                max_connections=1,
+            ),
+        ).start()
+        assert ready.wait(30)
+        client = TcpArraysClient("127.0.0.1", box["p"])
+
+        def model(p):
+            pb = fed.fed_broadcast(p, N)
+            lps = fed.fed_map(
+                lambda s: shard_fn(s[0], s[1], s[2]), (pb, x, y)
+            )
+            return fed.fed_sum(lps)
+
+        run = fed.program(model, fed.PoolPlacement(client, window=8))
+        np.testing.assert_allclose(
+            float(run(params)), float(model(params)), rtol=RTOL
+        )
+        client.close()
+
+
+class TestBridgeRouting:
+    """federated_potential / ParallelFederatedOp route through
+    fed.program: the evaluator is the host LogpGradFn AND carries the
+    traced jax_fn, and the fused JAX dispatch composes N potentials
+    into one program whose maps share a window."""
+
+    def test_evaluator_host_and_jax_surfaces(self, data, params, pool_client):
+        x, y = data
+        ev = fed.FederatedLogpGrad(
+            _node_fn,
+            (x, y),
+            placement=fed.PoolPlacement(pool_client, window=8),
+        )
+        model = _model_for(x, y)
+        lp, (g,) = ev(np.asarray(params))
+        np.testing.assert_allclose(float(lp), float(model(params)), rtol=RTOL)
+        np.testing.assert_allclose(
+            g, np.asarray(jax.grad(model)(params)), rtol=GTOL
+        )
+        lp2, grads2 = ev.jax_fn(params)
+        np.testing.assert_allclose(float(lp2), float(lp), rtol=RTOL)
+        np.testing.assert_allclose(np.asarray(grads2[0]), g, rtol=GTOL)
+
+    def test_fused_jax_callable_one_window(self, data, params, pool_client):
+        x, y = data
+        # Deliberately DISTINCT placement objects: fusion keys on
+        # equivalence (same client/window), since each potential is
+        # naturally built with its own PoolPlacement.
+        ev_a = fed.FederatedLogpGrad(
+            _node_fn,
+            (x, y),
+            placement=fed.PoolPlacement(pool_client, window=8),
+        )
+        ev_b = fed.FederatedLogpGrad(
+            _node_fn,
+            (x + 0.5, y),
+            placement=fed.PoolPlacement(pool_client, window=8),
+        )
+        m_a = bridge_core.member_jax_callable(
+            "logp_grad", ev_a.jax_fn, name="a"
+        )
+        m_b = bridge_core.member_jax_callable(
+            "logp_grad", ev_b.jax_fn, name="b"
+        )
+        assert getattr(m_a, "_fed_evaluator", None) is ev_a
+        fused = bridge_core.fused_jax_callable([m_a, m_b], [1, 1])
+        flightrec.clear()
+        lp_a, g_a, lp_b, g_b = fused(params, params)
+        windows = [
+            e for e in flightrec.events() if e["kind"] == "fed.fused_window"
+        ]
+        assert len(windows) == 1 and windows[0]["calls"] == 2
+        model_a = _model_for(x, y)
+        model_b = _model_for(x + 0.5, y)
+        np.testing.assert_allclose(
+            float(lp_a), float(model_a(params)), rtol=RTOL
+        )
+        np.testing.assert_allclose(
+            float(lp_b), float(model_b(params)), rtol=RTOL
+        )
+        np.testing.assert_allclose(
+            np.asarray(g_a),
+            np.asarray(jax.grad(model_a)(params)),
+            rtol=GTOL,
+        )
+        np.testing.assert_allclose(
+            np.asarray(g_b),
+            np.asarray(jax.grad(model_b)(params)),
+            rtol=GTOL,
+        )

@@ -1,0 +1,141 @@
+"""Property-based fed autodiff gate (hypothesis; CI-gated like
+test_npproto_properties.py — skips where hypothesis is not installed).
+
+The invariant (ISSUE 6 satellite): for random pytrees,
+``jax.grad`` through ``fed_sum(fed_map(f, x))`` equals the unsharded
+``jax.grad(lambda x: sum_i f(x_i))`` — on one device AND the 8-device
+virtual mesh, including the replicated-params case (params reach the
+shard body as closure constants, the configuration that requires
+``mark_varying`` / the fed_sum-of-cotangents transpose).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+from pytensor_federated_tpu import fed  # noqa: E402
+from pytensor_federated_tpu.parallel import make_mesh  # noqa: E402
+
+N = 8  # fixed shard count: divides the virtual mesh axis
+_PROP = settings(max_examples=15, deadline=None)
+
+_dims = st.integers(min_value=1, max_value=4)
+_param_shapes = st.lists(
+    st.lists(_dims, min_size=0, max_size=2).map(tuple),
+    min_size=1,
+    max_size=2,
+)
+_data_shapes = st.lists(
+    st.lists(_dims, min_size=1, max_size=2).map(tuple),
+    min_size=1,
+    max_size=3,
+)
+
+
+def _make_case(seed, param_shapes, data_shapes):
+    rng = np.random.default_rng(seed)
+    params = tuple(
+        jnp.asarray(rng.normal(size=s).astype(np.float32))
+        for s in param_shapes
+    )
+    data = {
+        f"d{i}": jnp.asarray(
+            rng.normal(size=(N,) + s).astype(np.float32)
+        )
+        for i, s in enumerate(data_shapes)
+    }
+    return params, data
+
+
+def _per_shard(params, shard):
+    acc = jnp.float32(0.0)
+    scale = jnp.float32(1.0)
+    for p in params:
+        scale = scale + jnp.sum(jnp.tanh(p))
+    for leaf in shard.values():
+        acc = acc + jnp.sum(jnp.sin(leaf) * scale + 0.1 * leaf**2)
+    return acc
+
+
+def _reference(params, data):
+    return sum(
+        _per_shard(params, {k: v[i] for k, v in data.items()})
+        for i in range(N)
+    )
+
+
+def _assert_grads_match(fed_fn, ref_fn, params):
+    v, g = jax.value_and_grad(fed_fn, argnums=tuple(range(len(params))))(
+        *params
+    )
+    v_ref, g_ref = jax.value_and_grad(
+        ref_fn, argnums=tuple(range(len(params)))
+    )(*params)
+    np.testing.assert_allclose(float(v), float(v_ref), rtol=2e-4, atol=1e-4)
+    for a, b in zip(g, g_ref):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-4
+        )
+
+
+@_PROP
+@given(
+    seed=st.integers(min_value=0, max_value=2**31 - 1),
+    param_shapes=_param_shapes,
+    data_shapes=_data_shapes,
+)
+def test_grad_map_sum_matches_unsharded_single_device(
+    seed, param_shapes, data_shapes
+):
+    params, data = _make_case(seed, param_shapes, data_shapes)
+
+    def fed_broadcast_form(*ps):
+        pb = fed.fed_broadcast(tuple(ps), N)
+        lps = fed.fed_map(lambda s: _per_shard(s[0], s[1]), (pb, data))
+        return fed.fed_sum(lps)
+
+    def fed_closure_form(*ps):
+        lps = fed.fed_map(lambda s: _per_shard(ps, s), data)
+        return fed.fed_sum(lps)
+
+    ref = lambda *ps: _reference(ps, data)
+    _assert_grads_match(fed_broadcast_form, ref, params)
+    _assert_grads_match(fed_closure_form, ref, params)
+
+
+@_PROP
+@given(
+    seed=st.integers(min_value=0, max_value=2**31 - 1),
+    param_shapes=_param_shapes,
+    data_shapes=_data_shapes,
+)
+def test_grad_map_sum_matches_unsharded_mesh8(
+    devices8, seed, param_shapes, data_shapes
+):
+    params, data = _make_case(seed, param_shapes, data_shapes)
+    placement = fed.MeshPlacement(
+        make_mesh({"shards": 8}, devices=devices8)
+    )
+
+    def model_broadcast(*ps):
+        pb = fed.fed_broadcast(tuple(ps), N)
+        lps = fed.fed_map(lambda s: _per_shard(s[0], s[1]), (pb, data))
+        return fed.fed_sum(lps)
+
+    def model_closure(*ps):
+        # Replicated params as closure constants: the mark_varying /
+        # summed-cotangent configuration.
+        lps = fed.fed_map(lambda s: _per_shard(ps, s), data)
+        return fed.fed_sum(lps)
+
+    ref = lambda *ps: _reference(ps, data)
+    _assert_grads_match(
+        fed.program(model_broadcast, placement), ref, params
+    )
+    _assert_grads_match(
+        fed.program(model_closure, placement), ref, params
+    )

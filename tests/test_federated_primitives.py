@@ -48,6 +48,22 @@ class TestPrimitives:
         got = federated_mean(vals, w)
         np.testing.assert_allclose(np.asarray(got), [1.5])
 
+    def test_weighted_mean_rejects_wrong_length_weights(self):
+        """Regression (ISSUE 6): a wrong-length weights vector that is
+        compatible-by-broadcast used to silently weight the wrong axis;
+        it must raise instead."""
+        vals = jnp.zeros((4, 2))
+        # length-1 broadcasts against anything; length-2 broadcasts
+        # against the trailing axis after the old reshape — both wrong.
+        for bad in (jnp.ones((1,)), jnp.ones((2,)), jnp.ones((4, 1))):
+            with pytest.raises(ValueError, match="one weight per shard"):
+                federated_mean(vals, bad)
+        # the correct length still works
+        np.testing.assert_allclose(
+            np.asarray(federated_mean(vals, jnp.ones((4,)))),
+            np.zeros((2,)),
+        )
+
     def test_broadcast(self):
         out = federated_broadcast({"a": jnp.ones((2,))}, 4)
         assert out["a"].shape == (4, 2)
