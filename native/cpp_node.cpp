@@ -87,6 +87,13 @@ constexpr uint8_t kFlagError = 1;
 constexpr uint8_t kFlagTrace = 2;
 constexpr uint8_t kFlagSpans = 4;
 constexpr uint8_t kFlagBatch = 8;
+// Every known flag bit, mirrored from service/wire_registry.py (the
+// declared source; graftlint's wire-registry rule cross-checks this
+// file).  Decoders reject any bit outside the mask: an unknown flag
+// means blocks this build cannot place, and skipping them would be
+// silent mis-parsing of everything after (loud-failure contract).
+constexpr uint8_t kKnownFlags =
+    kFlagError | kFlagTrace | kFlagSpans | kFlagBatch;
 // flags byte offset in the payload: magic(4) + version(1)
 constexpr size_t kFlagsOff = 5;
 
@@ -187,6 +194,10 @@ bool decode(const std::vector<uint8_t>& buf, Message* msg, std::string* why) {
   }
   if (!r.le(&flags) || !r.bytes(msg->uuid, 16) || !r.le(&n_arrays)) {
     *why = "truncated header";
+    return false;
+  }
+  if (flags & ~kKnownFlags) {
+    *why = "unknown flag bits (version-skewed peer?)";
     return false;
   }
   if (flags & kFlagError) {
@@ -348,6 +359,9 @@ std::vector<uint8_t> serve_batch(const std::vector<uint8_t>& buf) {
       !r.le(&ver) || ver != kVersion || !r.le(&flags) ||
       !r.bytes(uuid, 16) || !r.le(&n_items))
     return batch_error_reply("decode failed: truncated batch header");
+  if (flags & ~kKnownFlags)
+    return batch_error_reply(
+        "decode failed: unknown flag bits (version-skewed peer?)");
   if (flags & kFlagError) {
     uint32_t elen = 0;
     std::string e;

@@ -1,0 +1,69 @@
+"""graftlint — the repo's design invariants as machine-checked rules.
+
+Every hard-won invariant in this codebase used to live in CLAUDE.md
+prose and reviewer vigilance; the PR-5 chaos harness then caught two
+regressions (an event-loop-blocking fault shim, an unclassified
+asyncio error) that a static checker could have rejected at commit
+time.  This package is that checker: one AST-based rule per invariant,
+a driver (``python -m pytensor_federated_tpu.analysis`` /
+``tools/graftlint.py``) that walks the package plus
+``native/cpp_node.cpp``, per-rule inline suppressions
+(``# graftlint: disable=<rule> -- why``), human and ``--json`` output,
+and a nonzero exit on findings — wired in front of the CI test matrix
+so new I/O lanes inherit the invariants automatically.
+
+Rule catalog (docs/static-analysis.md maps each rule to the incident
+or invariant that motivated it; the meta-test keeps the two in sync):
+
+- ``async-blocking`` — no blocking calls / sync fault shims inside
+  ``async def`` (:mod:`.rules_async`)
+- ``loop-affinity`` — grpc.aio channels flow through the
+  (token,pid,thread,loop)-keyed cache (:mod:`.rules_loop`)
+- ``wire-registry`` — flag bits and field numbers match
+  :mod:`..service.wire_registry` across all three wire
+  implementations (:mod:`.rules_wire`)
+- ``wire-loudness`` — WireError propagates; no swallowed decode
+  failures (:mod:`.rules_wire`)
+- ``fault-shim-coverage`` — chaos reaches every owned I/O seam
+  (:mod:`.rules_shim`)
+- ``fed-rule-completeness`` — every fed primitive has
+  abstract-eval/JVP/transpose/batching rules (:mod:`.rules_fed`)
+- ``observability-drift`` — metric families and flightrec events match
+  docs/observability.md both ways (:mod:`.rules_obs`)
+"""
+
+from .core import (
+    Finding,
+    RULES,
+    Rule,
+    SourceFile,
+    default_targets,
+    load_sources,
+    render_human,
+    render_json,
+    repo_root,
+    rule,
+    run,
+)
+
+# Importing the rules modules registers them into RULES.
+from . import rules_async  # noqa: F401
+from . import rules_fed  # noqa: F401
+from . import rules_loop  # noqa: F401
+from . import rules_obs  # noqa: F401
+from . import rules_shim  # noqa: F401
+from . import rules_wire  # noqa: F401
+
+__all__ = [
+    "Finding",
+    "RULES",
+    "Rule",
+    "SourceFile",
+    "default_targets",
+    "load_sources",
+    "render_human",
+    "render_json",
+    "repo_root",
+    "rule",
+    "run",
+]

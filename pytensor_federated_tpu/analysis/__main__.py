@@ -1,0 +1,75 @@
+"""graftlint CLI: ``python -m pytensor_federated_tpu.analysis``.
+
+Exit status 0 = clean, 1 = findings, 2 = usage error.  ``--json``
+emits a machine-readable report (CI annotation lane); default output
+is one ``path:line: [rule] message`` per finding.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+from typing import List, Optional
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    # Lint runs are CPU-only by definition: never let the fed
+    # introspection rule (or the package import above it) dial the
+    # tunneled TPU plugin (CLAUDE.md environment pitfalls).
+    from ..utils import force_cpu_backend
+
+    force_cpu_backend()
+
+    from . import RULES, default_targets, render_human, render_json, run
+
+    parser = argparse.ArgumentParser(
+        prog="python -m pytensor_federated_tpu.analysis",
+        description="graftlint: the repo's design invariants as "
+        "machine-checked static-analysis rules",
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        type=Path,
+        help="files to check (default: the package, native/cpp_node.cpp, "
+        "bench drivers and tools)",
+    )
+    parser.add_argument(
+        "--rule",
+        action="append",
+        dest="rules",
+        metavar="NAME",
+        help="run only this rule (repeatable)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for name in sorted(RULES):
+            r = RULES[name]
+            print(f"{name} [{r.scope}]: {r.summary}")
+        return 0
+
+    unknown = [n for n in (args.rules or []) if n not in RULES]
+    if unknown:
+        print(
+            f"unknown rule(s): {', '.join(unknown)} "
+            f"(known: {', '.join(sorted(RULES))})",
+            file=sys.stderr,
+        )
+        return 2
+
+    paths = [p.resolve() for p in args.paths] or default_targets()
+    findings = run(rules=args.rules, paths=paths)
+    print(render_json(findings) if args.json else render_human(findings))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

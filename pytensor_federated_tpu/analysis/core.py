@@ -1,0 +1,259 @@
+"""graftlint core: sources, rule registry, suppressions, runner.
+
+The shape of the thing: a :class:`Rule` is a named checker function
+over either one :class:`SourceFile` (``scope="file"``) or the whole
+collected source set (``scope="repo"`` — cross-file registries,
+introspective checks).  Rules register themselves into :data:`RULES`
+via the :func:`rule` decorator at import time
+(:mod:`..analysis` imports every ``rules_*`` module), produce
+:class:`Finding` records, and the runner filters findings through
+inline suppressions before reporting.
+
+Suppressions are per-line and per-rule::
+
+    do_risky_thing()  # graftlint: disable=wire-loudness -- probe verdict lane
+
+The directive is honored on the finding's own line or the line
+immediately above it (so a comment can sit on its own line above a
+long statement); ``disable=all`` silences every rule for that line.
+Everything after ``--`` is a human justification, encouraged and
+ignored by the parser.  The same syntax works in C++ sources behind
+``//`` comments — the scanner matches the directive text, not the
+comment lexer.
+
+Design constraints honored here:
+
+- No third-party dependencies (the container cannot grow any) — the
+  Python rules are :mod:`ast`/:mod:`tokenize` walks, the C++ rule is
+  line/regex parsing.
+- File rules must not import the package under analysis; only the two
+  explicitly introspective repo rules (``fed-rule-completeness``,
+  which needs jax's registries, and nothing else) may import, and they
+  call :func:`~..utils.force_cpu_backend` first so a wire check can
+  never dial the tunneled TPU plugin (CLAUDE.md environment pitfalls).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable, Dict, Iterable, List, Optional, Sequence
+
+__all__ = [
+    "Finding",
+    "SourceFile",
+    "Rule",
+    "RULES",
+    "rule",
+    "repo_root",
+    "default_targets",
+    "load_sources",
+    "run",
+    "render_human",
+    "render_json",
+]
+
+#: ``# graftlint: disable=rule-a,rule-b [-- justification]`` (also
+#: behind ``//`` in C++).  The justification tail is free text.
+_SUPPRESS_RE = re.compile(
+    r"(?:#|//)\s*graftlint:\s*disable=([A-Za-z0-9_,\- ]+)"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location."""
+
+    rule: str
+    path: str  # repo-relative, posix separators
+    line: int
+    message: str
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+        }
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+class SourceFile:
+    """One target file: text, line-indexed suppressions, lazy AST."""
+
+    def __init__(self, path: Path, root: Path):
+        self.path = path
+        self.root = root
+        self.rel = path.relative_to(root).as_posix()
+        self.text = path.read_text(encoding="utf-8")
+        self.lines = self.text.splitlines()
+        self.is_python = path.suffix == ".py"
+        self._tree: Optional[ast.Module] = None
+        # line number (1-based) -> set of rule names disabled there
+        self.suppressions: Dict[int, set] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                # Everything after `--` is the human justification.
+                spec = m.group(1).split("--", 1)[0]
+                names = {
+                    part.strip()
+                    for part in spec.split(",")
+                    if part.strip()
+                }
+                self.suppressions[i] = names
+
+    @property
+    def tree(self) -> ast.Module:
+        if self._tree is None:
+            if not self.is_python:
+                raise ValueError(f"{self.rel} is not a Python source")
+            self._tree = ast.parse(self.text, filename=self.rel)
+        return self._tree
+
+    def suppressed(self, rule_name: str, line: int) -> bool:
+        """Whether ``rule_name`` is disabled on ``line`` (same line or
+        the line directly above)."""
+        for ln in (line, line - 1):
+            names = self.suppressions.get(ln)
+            if names and (rule_name in names or "all" in names):
+                return True
+        return False
+
+    def finding(self, rule_name: str, line: int, message: str) -> Finding:
+        return Finding(rule_name, self.rel, line, message)
+
+
+@dataclass(frozen=True)
+class Rule:
+    """A registered checker.  ``func`` yields/returns Findings; file
+    rules receive one :class:`SourceFile`, repo rules the full list."""
+
+    name: str
+    summary: str
+    scope: str  # "file" | "repo"
+    func: Callable = field(compare=False)
+
+
+#: name -> Rule; populated by the :func:`rule` decorator when
+#: :mod:`..analysis` imports the rules modules.
+RULES: Dict[str, Rule] = {}
+
+
+def rule(name: str, summary: str, scope: str = "file"):
+    """Register a checker under ``name`` (kebab-case, the suppression
+    and CLI handle)."""
+    if scope not in ("file", "repo"):
+        raise ValueError(f"scope must be 'file' or 'repo', got {scope!r}")
+
+    def deco(func: Callable) -> Callable:
+        if name in RULES:
+            raise ValueError(f"duplicate rule name {name!r}")
+        RULES[name] = Rule(name=name, summary=summary, scope=scope, func=func)
+        return func
+
+    return deco
+
+
+def repo_root() -> Path:
+    """The repository root: parent of the ``pytensor_federated_tpu``
+    package directory this module lives in."""
+    return Path(__file__).resolve().parent.parent.parent
+
+
+def default_targets(root: Optional[Path] = None) -> List[Path]:
+    """The full-repo target set: every package ``.py`` file, the C++
+    node, and the top-level bench drivers + tools scripts the
+    observability rule must see (they register metrics and record
+    flight events too)."""
+    root = root or repo_root()
+    pkg = root / "pytensor_federated_tpu"
+    targets = sorted(
+        p for p in pkg.rglob("*.py") if "__pycache__" not in p.parts
+    )
+    for extra in ("bench.py", "bench_suite.py"):
+        p = root / extra
+        if p.exists():
+            targets.append(p)
+    tools = root / "tools"
+    if tools.is_dir():
+        targets.extend(sorted(tools.glob("*.py")))
+    cpp = root / "native" / "cpp_node.cpp"
+    if cpp.exists():
+        targets.append(cpp)
+    return targets
+
+
+def load_sources(
+    paths: Iterable[Path], root: Optional[Path] = None
+) -> List[SourceFile]:
+    root = root or repo_root()
+    return [SourceFile(Path(p), root) for p in paths]
+
+
+def run(
+    rules: Optional[Sequence[str]] = None,
+    paths: Optional[Iterable[Path]] = None,
+    root: Optional[Path] = None,
+) -> List[Finding]:
+    """Run the selected rules (default: all registered) over ``paths``
+    (default: the full-repo target set); returns unsuppressed findings
+    sorted by location.
+
+    Explicit ``paths`` select a SUBSET: file rules run over just those
+    files, while repo-scope rules (cross-file registries, code-vs-docs
+    diffs) still see the full target set — comparing the docs against
+    three files would report everything else as missing — and only
+    their findings that land inside the subset are reported."""
+    root = root or repo_root()
+    sources = load_sources(paths or default_targets(root), root)
+    by_rel = {s.rel: s for s in sources}
+    if paths is None:
+        subset_rels = None
+        repo_sources = sources
+    else:
+        subset_rels = set(by_rel)
+        repo_sources = load_sources(default_targets(root), root)
+        by_rel.update({s.rel: s for s in repo_sources})
+    selected = [RULES[n] for n in (rules or sorted(RULES))]
+    findings: List[Finding] = []
+    for r in selected:
+        if r.scope == "file":
+            for src in sources:
+                findings.extend(r.func(src) or [])
+        else:
+            for f in r.func(repo_sources) or []:
+                if subset_rels is None or f.path in subset_rels:
+                    findings.append(f)
+    kept = []
+    for f in findings:
+        src = by_rel.get(f.path)
+        if src is not None and src.suppressed(f.rule, f.line):
+            continue
+        kept.append(f)
+    kept.sort(key=lambda f: (f.path, f.line, f.rule))
+    return kept
+
+
+def render_human(findings: Sequence[Finding]) -> str:
+    if not findings:
+        return "graftlint: clean (0 findings)"
+    lines = [str(f) for f in findings]
+    lines.append(f"graftlint: {len(findings)} finding(s)")
+    return "\n".join(lines)
+
+
+def render_json(findings: Sequence[Finding]) -> str:
+    return json.dumps(
+        {
+            "findings": [f.to_dict() for f in findings],
+            "count": len(findings),
+        },
+        indent=2,
+    )

@@ -1,0 +1,140 @@
+"""async-blocking: no event-loop-blocking calls inside ``async def``.
+
+The PR-5 chaos harness found exactly this bug class live — a fault
+shim calling ``time.sleep`` on the grpc.aio event loop froze every
+concurrent RPC, the hedge timer included.  The invariant (CLAUDE.md,
+:mod:`..faultinject.runtime` docstrings): async bodies in the I/O
+stack must await their delays and must call the ``*_async`` twins of
+the sync fault-shim primitives; sync-socket/subprocess work belongs in
+an executor.
+
+Scope: ``service/``, ``routing/``, ``faultinject/`` — the packages
+whose async defs run on the serving event loop.  Nested *sync* ``def``
+bodies inside an async function are skipped: a sync closure is
+routinely handed to ``run_in_executor`` / ``ctx.run`` and blocks a
+worker thread, not the loop.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List
+
+from .core import Finding, SourceFile, rule
+
+_SCOPE_PREFIXES = (
+    "pytensor_federated_tpu/service/",
+    "pytensor_federated_tpu/routing/",
+    "pytensor_federated_tpu/faultinject/",
+)
+
+#: Exact dotted calls that block the calling thread.
+_BLOCKING_DOTTED = {
+    "time.sleep": "use `await asyncio.sleep(...)`",
+    "socket.create_connection": "sync connect blocks the loop; use "
+    "asyncio streams or an executor",
+    "socket.socket": "sync socket I/O belongs in an executor or "
+    "asyncio transport",
+    "os.system": "use `asyncio.create_subprocess_*`",
+    "os.popen": "use `asyncio.create_subprocess_*`",
+}
+
+#: Any attribute call on the ``subprocess`` module blocks (Popen's
+#: construction includes a blocking fork/exec handshake).
+_SUBPROCESS_MODULE = "subprocess"
+
+#: Sync fault-shim primitives with async twins (faultinject.runtime):
+#: their delay/stall kinds ``time.sleep`` — the exact PR-5 bug class.
+_SYNC_SHIMS = {
+    "filter_bytes": "filter_bytes_async",
+    "compute_filter": "compute_filter_async",
+    "getload_filter": "getload_filter_async",
+    "probe_filter": "probe_filter_async",
+    "mangle_batch_result": "mangle_batch_result_async",
+}
+
+#: Sync-socket method names: calling these on anything inside an async
+#: body is a blocking syscall on the loop.
+_SOCKET_METHODS = {"sendall", "recv", "recv_into", "accept"}
+
+_RULE = "async-blocking"
+
+
+def _call_name(func: ast.expr) -> str:
+    try:
+        return ast.unparse(func)
+    except Exception:  # pragma: no cover - unparse is total on exprs
+        return ""
+
+
+def _iter_async_body(fn: ast.AsyncFunctionDef) -> Iterator[ast.AST]:
+    """Walk an async function's own body, not descending into nested
+    function definitions (sync closures run in executors; nested async
+    defs are visited as roots in their own right)."""
+    stack: List[ast.AST] = list(fn.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _check_call(
+    src: SourceFile, fn: ast.AsyncFunctionDef, call: ast.Call
+) -> Iterator[Finding]:
+    dotted = _call_name(call.func)
+    where = f"inside `async def {fn.name}`"
+    if dotted in _BLOCKING_DOTTED:
+        yield src.finding(
+            _RULE,
+            call.lineno,
+            f"blocking call `{dotted}(...)` {where} — "
+            f"{_BLOCKING_DOTTED[dotted]}",
+        )
+        return
+    head, _, tail = dotted.rpartition(".")
+    if head == _SUBPROCESS_MODULE:
+        yield src.finding(
+            _RULE,
+            call.lineno,
+            f"blocking call `{dotted}(...)` {where} — use "
+            "`asyncio.create_subprocess_*` or an executor",
+        )
+        return
+    name = tail or dotted
+    if name in _SYNC_SHIMS and (
+        head in ("", "_fi", "runtime") or "faultinject" in head
+    ):
+        yield src.finding(
+            _RULE,
+            call.lineno,
+            f"sync fault shim `{dotted}(...)` {where} — its delay/stall "
+            f"kinds block the event loop; use `{_SYNC_SHIMS[name]}` "
+            "(the PR-5 chaos bug class)",
+        )
+        return
+    if isinstance(call.func, ast.Attribute) and name in _SOCKET_METHODS:
+        yield src.finding(
+            _RULE,
+            call.lineno,
+            f"sync socket call `{dotted}(...)` {where} — blocking "
+            "syscall on the event loop; use asyncio streams or an "
+            "executor",
+        )
+
+
+@rule(
+    _RULE,
+    "no time.sleep / sync sockets / subprocess / sync fault shims "
+    "inside async def bodies in service/, routing/, faultinject/",
+)
+def check_async_blocking(src: SourceFile) -> Iterator[Finding]:
+    if not src.is_python or not src.rel.startswith(_SCOPE_PREFIXES):
+        return
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.AsyncFunctionDef):
+            continue
+        for sub in _iter_async_body(node):
+            if isinstance(sub, ast.Call):
+                yield from _check_call(src, node, sub)

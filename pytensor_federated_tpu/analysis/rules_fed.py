@@ -1,0 +1,106 @@
+"""fed-rule-completeness: every fed primitive carries its full rule set.
+
+The DrJAX-style contract from PR 6 (:mod:`..fed.primitives`): a
+federated primitive is only a primitive — rather than a trap — if it
+participates in EVERY transformation a model author will reach for.
+A primitive missing its transpose silently fails at ``jax.grad``; one
+missing batching fails at ``vmap`` inside NUTS; and the failure
+surfaces far from the registration site.  This rule is
+*introspective*, not textual: it imports the module and asks jax's own
+registries, so a rule registered through any helper
+(``ad.deflinear2``, direct dict assignment, decorators) counts.
+
+Required per primitive: abstract-eval, JVP, transpose, batching.
+(Impl and MLIR lowering are exercised by the tier-1 suite directly —
+a primitive with no impl cannot pass a single test — so they are not
+re-checked here.)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Sequence, Tuple
+
+from .core import Finding, SourceFile, rule
+
+_RULE = "fed-rule-completeness"
+_FED = "pytensor_federated_tpu/fed/primitives.py"
+
+_REQUIRED = ("abstract_eval", "jvp", "transpose", "batching")
+
+
+def missing_rules(module) -> List[Tuple[str, object, List[str]]]:
+    """Introspect ``module`` for jax primitives with incomplete rule
+    sets -> ``[(attr_name, primitive, [missing...])]``.  Separated from
+    the Rule wrapper so tests can run it against fixture modules."""
+    from jax.extend import core as jex_core
+    from jax.interpreters import ad, batching
+
+    out: List[Tuple[str, object, List[str]]] = []
+    for attr, prim in sorted(vars(module).items()):
+        if not isinstance(prim, jex_core.Primitive):
+            continue
+        missing: List[str] = []
+        # def_abstract_eval sets an instance attribute; the class
+        # default is a bound method that raises NotImplementedError,
+        # so presence must be checked on the instance dict.
+        if "abstract_eval" not in vars(prim):
+            missing.append("abstract_eval")
+        if prim not in ad.primitive_jvps:
+            missing.append("jvp")
+        if prim not in ad.primitive_transposes:
+            missing.append("transpose")
+        if prim not in batching.primitive_batchers:
+            missing.append("batching")
+        if missing:
+            out.append((attr, prim, missing))
+    return out
+
+
+def _definition_lines(src: SourceFile) -> Dict[str, int]:
+    """attr name -> line of its ``X = ...Primitive(...)`` assignment."""
+    out: Dict[str, int] = {}
+    for node in src.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+            if isinstance(tgt, ast.Name) and isinstance(
+                node.value, ast.Call
+            ):
+                callee = getattr(node.value.func, "attr", "") or getattr(
+                    node.value.func, "id", ""
+                )
+                if callee == "Primitive":
+                    out[tgt.id] = node.lineno
+    return out
+
+
+@rule(
+    _RULE,
+    "every registered primitive in fed/primitives.py has abstract-eval, "
+    "JVP, transpose, and batching rules (introspected via jax "
+    "registries, not text)",
+    scope="repo",
+)
+def check_fed_rule_completeness(
+    sources: Sequence[SourceFile],
+) -> Iterator[Finding]:
+    by_rel = {s.rel: s for s in sources}
+    src = by_rel.get(_FED)
+    if src is None:
+        return
+    # CPU-only introspection: never let a lint run dial the tunneled
+    # TPU plugin (CLAUDE.md environment pitfalls).
+    from ..utils import force_cpu_backend
+
+    force_cpu_backend()
+    from ..fed import primitives as fed_primitives
+
+    lines = _definition_lines(src)
+    for attr, prim, missing in missing_rules(fed_primitives):
+        yield src.finding(
+            _RULE,
+            lines.get(attr, 1),
+            f"primitive `{prim}` ({attr}) is missing "
+            f"{', '.join(missing)} rule(s) — it will fail inside "
+            "grad/vmap far from this registration site",
+        )

@@ -1,0 +1,111 @@
+"""loop-affinity: grpc.aio channels flow through the connection cache.
+
+A ``grpc.aio`` channel is bound to the event loop it was created on;
+driving it from another loop errors or hangs, and one thread legally
+runs several loops over its lifetime (CLAUDE.md design invariants).
+The service layer therefore keys every cached connection on
+``(client token, pid, thread, loop)`` and purges closed-loop entries
+(:mod:`..service.client`, ``ClientPrivates``).  A channel created
+anywhere else and stored on a long-lived object silently resurrects
+the bug the cache exists to kill.
+
+Allowed channel-creation sites:
+
+1. the cache constructor itself — ``ClientPrivates.connect`` in
+   ``service/client.py``;
+2. a scoped ``async with grpc.aio.*_channel(...) as ch:`` — the
+   channel provably dies on the loop that made it.
+
+Everything else is a finding, wherever it appears in the package.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Tuple
+
+from .core import Finding, SourceFile, rule
+
+_RULE = "loop-affinity"
+
+_CACHE_FILE = "pytensor_federated_tpu/service/client.py"
+_CACHE_SITE = ("ClientPrivates", "connect")
+
+
+def _is_channel_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    try:
+        dotted = ast.unparse(node.func)
+    except Exception:  # pragma: no cover
+        return False
+    return dotted.endswith(
+        ("aio.insecure_channel", "aio.secure_channel")
+    ) or dotted in ("insecure_channel", "secure_channel")
+
+
+def _scoped_channel_calls(tree: ast.Module) -> set:
+    """ids of channel calls appearing as an ``async with`` context
+    expression (directly, or behind an immediate Await — not expected
+    for channel constructors but harmless to accept)."""
+    scoped = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.AsyncWith, ast.With)):
+            # Plain `with` is also accepted: grpc sync channels are
+            # loop-free and a scoped aio channel in a `with` would
+            # fail at runtime long before loop affinity matters.
+            for item in node.items:
+                expr = item.context_expr
+                if isinstance(expr, ast.Await):
+                    expr = expr.value
+                if _is_channel_call(expr):
+                    scoped.add(id(expr))
+    return scoped
+
+
+def _enclosing_stack(
+    tree: ast.Module,
+) -> List[Tuple[ast.AST, Optional[str], Optional[str]]]:
+    """Flatten (node, enclosing-class, enclosing-function) for calls."""
+    out: List[Tuple[ast.AST, Optional[str], Optional[str]]] = []
+
+    def visit(node: ast.AST, cls: Optional[str], fn: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                visit(child, child.name, fn)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                visit(child, cls, child.name)
+            else:
+                if isinstance(child, ast.Call):
+                    out.append((child, cls, fn))
+                visit(child, cls, fn)
+
+    visit(tree, None, None)
+    return out
+
+
+@rule(
+    _RULE,
+    "grpc.aio channels must come from the (token,pid,thread,loop)-keyed "
+    "connection cache or a scoped `async with`, never be stored directly",
+)
+def check_loop_affinity(src: SourceFile) -> Iterator[Finding]:
+    if not src.is_python:
+        return
+    scoped = _scoped_channel_calls(src.tree)
+    for call, cls, fn in _enclosing_stack(src.tree):
+        if not _is_channel_call(call):
+            continue
+        if id(call) in scoped:
+            continue
+        if src.rel == _CACHE_FILE and (cls, fn) == _CACHE_SITE:
+            continue
+        yield src.finding(
+            _RULE,
+            call.lineno,
+            "grpc.aio channel created outside the connection cache "
+            f"(in {cls or '<module>'}.{fn or '<module>'}) — channels are "
+            "bound to their creation loop; go through "
+            "service.client.ClientPrivates (the (token,pid,thread,loop)-"
+            "keyed cache) or use a scoped `async with`",
+        )

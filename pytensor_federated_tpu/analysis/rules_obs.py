@@ -1,0 +1,228 @@
+"""observability-drift: code and docs/observability.md agree.
+
+The metric catalog and flight-recorder event taxonomy in
+``docs/observability.md`` are the operator's contract — dashboards and
+incident tooling are built against them.  A metric registered in code
+but absent from the doc is invisible operational surface; a documented
+event no code path emits is a dashboard that can never fire.  This
+rule extracts both vocabularies from the code (AST, literal-first-arg
+calls) and the doc (backticked tokens) and fails on drift in either
+direction.
+
+Dynamic names are matched by prefix: an f-string event like
+``f"fault.{rule.kind}"`` covers every documented name under
+``fault.``, and a documented wildcard like ``fault.<kind>`` covers any
+code emission with that prefix.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Sequence, Tuple
+
+from .core import Finding, SourceFile, rule
+
+_RULE = "observability-drift"
+_DOC = "docs/observability.md"
+
+_METRIC_FACTORIES = {"counter", "gauge", "histogram"}
+_METRIC_RE = re.compile(r"`(pftpu_[a-z0-9_]+)`")
+_EVENT_TOKEN_RE = re.compile(r"`([a-z][a-z0-9_]*\.[a-z0-9_.<>]+)`")
+
+_FLIGHTREC_HEADING = "### `telemetry.flightrec`"
+
+
+def _doc_metrics(text: str) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for i, line in enumerate(text.splitlines(), start=1):
+        for m in _METRIC_RE.finditer(line):
+            out.setdefault(m.group(1), i)
+    return out
+
+
+def _doc_events(text: str) -> Dict[str, int]:
+    """Event names from the flight-recorder taxonomy table: the first
+    cell of each row, split on `` / `` for multi-name rows."""
+    out: Dict[str, int] = {}
+    lines = text.splitlines()
+    in_section = False
+    for i, line in enumerate(lines, start=1):
+        if line.startswith(_FLIGHTREC_HEADING):
+            in_section = True
+            continue
+        if in_section and line.startswith("### "):
+            break
+        if not in_section or not line.startswith("|"):
+            continue
+        cells = [c.strip() for c in line.strip("|").split("|")]
+        if not cells or cells[0] in ("kind", "---", ""):
+            continue
+        for m in _EVENT_TOKEN_RE.finditer(cells[0]):
+            out.setdefault(m.group(1), i)
+    return out
+
+
+def _literal_or_prefix(arg: ast.expr) -> Tuple[str, bool]:
+    """A string constant -> (name, False); an f-string with a literal
+    head -> (prefix, True); anything else -> ("", ...) = unanalyzable."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value, False
+    if isinstance(arg, ast.JoinedStr) and arg.values:
+        head = arg.values[0]
+        if isinstance(head, ast.Constant) and isinstance(head.value, str):
+            return head.value, True
+    return "", False
+
+
+def _code_vocab(
+    sources: Sequence[SourceFile],
+) -> Tuple[
+    Dict[str, Tuple[str, int]],
+    Dict[str, Tuple[str, int]],
+    Dict[str, Tuple[str, int]],
+]:
+    """-> (metrics, exact events, prefix events), name -> (rel, line)."""
+    metrics: Dict[str, Tuple[str, int]] = {}
+    events: Dict[str, Tuple[str, int]] = {}
+    prefixes: Dict[str, Tuple[str, int]] = {}
+    for src in sources:
+        if not src.is_python:
+            continue
+        is_flightrec = src.rel.endswith("telemetry/flightrec.py")
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            fname = (
+                node.func.id
+                if isinstance(node.func, ast.Name)
+                else getattr(node.func, "attr", "")
+            )
+            loc = (src.rel, node.lineno)
+            if fname in _METRIC_FACTORIES:
+                name, is_prefix = _literal_or_prefix(node.args[0])
+                if name.startswith("pftpu_") and not is_prefix:
+                    metrics.setdefault(name, loc)
+                continue
+            # flightrec.record("kind", ...) everywhere; flightrec.py
+            # itself builds events through its private _event helper
+            # (the span hooks bypass record()).
+            is_record = fname == "record" and isinstance(
+                node.func, ast.Attribute
+            ) and "flightrec" in ast.unparse(node.func.value)
+            is_internal = is_flightrec and fname in ("record", "_event")
+            if not (is_record or is_internal):
+                continue
+            name, is_prefix = _literal_or_prefix(node.args[0])
+            if not name:
+                continue
+            if is_prefix:
+                prefixes.setdefault(name, loc)
+            else:
+                events.setdefault(name, loc)
+    return metrics, events, prefixes
+
+
+@rule(
+    _RULE,
+    "every pftpu_* metric family and flightrec event name in code "
+    "appears in docs/observability.md, and vice versa",
+    scope="repo",
+)
+def check_observability_drift(
+    sources: Sequence[SourceFile],
+) -> Iterator[Finding]:
+    root = sources[0].root if sources else None
+    if root is None:
+        return
+    doc_path = root / _DOC
+    if not doc_path.exists():
+        yield Finding(_RULE, _DOC, 1, "docs/observability.md is missing")
+        return
+    text = doc_path.read_text(encoding="utf-8")
+    doc_metrics = _doc_metrics(text)
+    doc_events_all = _doc_events(text)
+    doc_events = {n: l for n, l in doc_events_all.items() if "<" not in n}
+    doc_wildcards = {
+        n.split("<", 1)[0]: l for n, l in doc_events_all.items() if "<" in n
+    }
+    code_metrics, code_events, code_prefixes = _code_vocab(sources)
+
+    for name, (rel, line) in sorted(code_metrics.items()):
+        if name not in doc_metrics:
+            yield Finding(
+                _RULE,
+                rel,
+                line,
+                f"metric family `{name}` is registered here but not "
+                "documented in docs/observability.md",
+            )
+    for name, line in sorted(doc_metrics.items()):
+        if name not in code_metrics:
+            yield Finding(
+                _RULE,
+                _DOC,
+                line,
+                f"metric family `{name}` is documented but never "
+                "registered in code",
+            )
+
+    def doc_covers(name: str) -> bool:
+        return name in doc_events or any(
+            name.startswith(w) for w in doc_wildcards
+        )
+
+    for name, (rel, line) in sorted(code_events.items()):
+        if not doc_covers(name):
+            yield Finding(
+                _RULE,
+                rel,
+                line,
+                f"flightrec event `{name}` is emitted here but missing "
+                "from the docs/observability.md event taxonomy",
+            )
+    for prefix, (rel, line) in sorted(code_prefixes.items()):
+        covered = any(
+            d.startswith(prefix) for d in doc_events
+        ) or any(
+            w.startswith(prefix) or prefix.startswith(w)
+            for w in doc_wildcards
+        )
+        if not covered:
+            yield Finding(
+                _RULE,
+                rel,
+                line,
+                f"dynamic flightrec event `{prefix}…` has no matching "
+                "entry in the docs/observability.md event taxonomy",
+            )
+
+    def code_covers(doc_name: str) -> bool:
+        return doc_name in code_events or any(
+            doc_name.startswith(p) for p in code_prefixes
+        )
+
+    for name, line in sorted(doc_events.items()):
+        if not code_covers(name):
+            yield Finding(
+                _RULE,
+                _DOC,
+                line,
+                f"documented flightrec event `{name}` is never emitted "
+                "by any code path",
+            )
+    for prefix, line in sorted(doc_wildcards.items()):
+        covered = any(
+            e.startswith(prefix) for e in code_events
+        ) or any(
+            p.startswith(prefix) or prefix.startswith(p)
+            for p in code_prefixes
+        )
+        if not covered:
+            yield Finding(
+                _RULE,
+                _DOC,
+                line,
+                f"documented wildcard event `{prefix}<…>` has no "
+                "emitting code path",
+            )

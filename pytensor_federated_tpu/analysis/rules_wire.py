@@ -1,0 +1,441 @@
+"""wire-registry + wire-loudness: the wire formats stay declared and loud.
+
+**wire-registry** — :mod:`..service.wire_registry` is the single
+declared source of npwire flag bits and npproto field numbers.  Three
+implementations carry their own literals (``service/npwire.py``,
+``service/npproto_codec.py``, ``native/cpp_node.cpp`` — the C++ file
+cannot import Python); this rule cross-parses all three and fails on:
+
+- an implementation flag/field the registry does not declare
+  (undeclared), or a declared one no implementation carries (drift);
+- two declarations sharing a bit/number (collision);
+- a flag without decoder-side rejection: every npwire decoder must
+  enforce the known-flags mask (``flags & ~KNOWN`` raises WireError /
+  returns a decode error — silent skipping of an unknown flag is
+  exactly the version-skew mis-parse the loud-failure contract
+  forbids);
+- an npproto extension field without a decode dispatch arm — we must
+  never emit a field we cannot read back (plain proto3 unknown fields
+  are *skipped* by design; that posture difference is documented in
+  the registry module).
+
+**wire-loudness** — corrupt payloads surface as ``WireError``, never
+vanish (property-tested contract, CLAUDE.md).  Findings: a bare
+``except:`` anywhere in the wire stack, and an ``except`` around a
+decode call that neither re-raises nor uses the caught exception (an
+error reply built from ``e`` IS in-band propagation; a probe lane
+converting corrupt bytes into a failed-probe verdict suppresses this
+rule inline with a justification).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Sequence
+
+from ..service import wire_registry as REG
+from .core import Finding, SourceFile, rule
+
+_NPWIRE = "pytensor_federated_tpu/service/npwire.py"
+_NPPROTO = "pytensor_federated_tpu/service/npproto_codec.py"
+_CPP = "native/cpp_node.cpp"
+
+#: npwire decode entry points that must enforce the known-flags mask.
+_NPWIRE_DECODERS = ("decode_arrays_all", "decode_batch")
+
+_LOUDNESS_SCOPE = (
+    "pytensor_federated_tpu/service/",
+    "pytensor_federated_tpu/routing/",
+    "pytensor_federated_tpu/faultinject/",
+)
+
+
+# ---------------------------------------------------------------------------
+# wire-registry
+# ---------------------------------------------------------------------------
+
+
+def _eval_int(node: ast.expr, env: Dict[str, int]) -> Optional[int]:
+    """Evaluate a constant int expression over ``env`` (names, |, +)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return node.value
+    if isinstance(node, ast.Name):
+        return env.get(node.id)
+    if isinstance(node, ast.BinOp) and isinstance(
+        node.op, (ast.BitOr, ast.Add)
+    ):
+        left = _eval_int(node.left, env)
+        right = _eval_int(node.right, env)
+        if left is None or right is None:
+            return None
+        return left | right if isinstance(node.op, ast.BitOr) else left + right
+    return None
+
+
+def _collect_assignments(tree: ast.Module) -> Dict[str, ast.expr]:
+    out: Dict[str, ast.expr] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+            if isinstance(tgt, ast.Name):
+                out[tgt.id] = node.value
+    return out
+
+
+def _check_flag_map(
+    src: SourceFile,
+    impl: Dict[str, int],
+    known_mask: Optional[int],
+    decoders_guarded: Dict[str, bool],
+    line_of: Dict[str, int],
+) -> Iterator[Finding]:
+    """Shared flag validation for one implementation (python or C++)."""
+    declared = REG.NPWIRE_FLAGS
+    seen_bits: Dict[int, str] = {}
+    for name, bit in impl.items():
+        line = line_of.get(name, 1)
+        if name not in declared:
+            yield src.finding(
+                "wire-registry",
+                line,
+                f"flag {name!r} (bit {bit}) is not declared in "
+                "service/wire_registry.py NPWIRE_FLAGS",
+            )
+        elif declared[name] != bit:
+            yield src.finding(
+                "wire-registry",
+                line,
+                f"flag {name!r} is bit {bit} here but declared as "
+                f"{declared[name]} in service/wire_registry.py",
+            )
+        if bit in seen_bits:
+            yield src.finding(
+                "wire-registry",
+                line,
+                f"flag bit {bit} collides: {seen_bits[bit]!r} and {name!r}",
+            )
+        seen_bits[bit] = name
+    for name, bit in declared.items():
+        if name not in impl:
+            yield src.finding(
+                "wire-registry",
+                1,
+                f"declared flag {name!r} (bit {bit}) is missing from "
+                f"{src.rel}",
+            )
+    if known_mask is None:
+        yield src.finding(
+            "wire-registry",
+            1,
+            f"{src.rel} has no known-flags mask — decoders cannot "
+            "reject undeclared flag bits (loud-failure contract)",
+        )
+    elif known_mask != REG.NPWIRE_KNOWN_FLAGS:
+        yield src.finding(
+            "wire-registry",
+            1,
+            f"known-flags mask is {known_mask:#x} but the registry "
+            f"declares {REG.NPWIRE_KNOWN_FLAGS:#x}",
+        )
+    for decoder, guarded in decoders_guarded.items():
+        if not guarded:
+            yield src.finding(
+                "wire-registry",
+                line_of.get(decoder, 1),
+                f"decoder {decoder} does not reject unknown flag bits "
+                "(must check flags against the known-flags mask and "
+                "fail loudly)",
+            )
+
+
+def _npwire_findings(src: SourceFile) -> Iterator[Finding]:
+    tree = src.tree
+    assigns = _collect_assignments(tree)
+    env: Dict[str, int] = {}
+    impl: Dict[str, int] = {}
+    line_of: Dict[str, int] = {}
+    for name, value in assigns.items():
+        v = _eval_int(value, env)
+        if v is not None:
+            env[name] = v
+        if name.startswith("_FLAG_") and v is not None:
+            impl[name[len("_FLAG_"):]] = v
+            line_of[name[len("_FLAG_"):]] = value.lineno
+    known_mask = env.get("_KNOWN_FLAGS")
+    guarded: Dict[str, bool] = {}
+    for node in tree.body:
+        if (
+            isinstance(node, ast.FunctionDef)
+            and node.name in _NPWIRE_DECODERS
+        ):
+            line_of[node.name] = node.lineno
+            refs = {
+                n.id
+                for n in ast.walk(node)
+                if isinstance(n, ast.Name)
+            }
+            # The guard is a call to the shared _check_flags helper (or
+            # a direct mask reference inside the decoder body).
+            guarded[node.name] = bool(
+                refs & {"_check_flags", "_KNOWN_FLAGS"}
+            )
+    for name in _NPWIRE_DECODERS:
+        guarded.setdefault(name, False)
+    yield from _check_flag_map(src, impl, known_mask, guarded, line_of)
+
+
+_CPP_FLAG_RE = re.compile(
+    r"constexpr\s+uint8_t\s+kFlag(\w+)\s*=\s*(\d+)\s*;"
+)
+_CPP_KNOWN_RE = re.compile(
+    r"constexpr\s+uint8_t\s+kKnownFlags\s*=\s*([^;]+);"
+)
+#: A column-0 C++ function definition: return type, name, open paren.
+_CPP_FUNC_RE = re.compile(
+    r"^[A-Za-z_][\w:<>,&*\s]*?\b(\w+)\s*\("
+)
+#: Both frame parsers in cpp_node.cpp must apply the mask themselves —
+#: a guard in one does not protect frames entering through the other.
+_CPP_PARSERS = ("decode", "serve_batch")
+
+
+def _cpp_function_spans(src: SourceFile) -> Dict[str, tuple]:
+    """name -> (start_line, end_line) for column-0 function defs, each
+    span ending where the next one starts (text-level, good enough for
+    "does this parser contain its own guard")."""
+    starts = []
+    for i, line in enumerate(src.lines, start=1):
+        if line[:1].isspace() or not line:
+            continue
+        m = _CPP_FUNC_RE.match(line)
+        if m and "(" in line and ";" not in line.split("(")[0]:
+            starts.append((m.group(1), i))
+    spans: Dict[str, tuple] = {}
+    for idx, (name, start) in enumerate(starts):
+        end = (
+            starts[idx + 1][1] - 1 if idx + 1 < len(starts) else len(src.lines)
+        )
+        spans.setdefault(name, (start, end))
+    return spans
+
+
+def _cpp_findings(src: SourceFile) -> Iterator[Finding]:
+    impl: Dict[str, int] = {}
+    line_of: Dict[str, int] = {}
+    for i, line in enumerate(src.lines, start=1):
+        m = _CPP_FLAG_RE.search(line)
+        if m:
+            name = m.group(1).upper()
+            impl[name] = int(m.group(2))
+            line_of[name] = i
+    known_mask: Optional[int] = None
+    m = _CPP_KNOWN_RE.search(src.text)
+    if m:
+        expr = m.group(1)
+        mask = 0
+        ok = True
+        for part in expr.split("|"):
+            part = part.strip()
+            fm = re.fullmatch(r"kFlag(\w+)", part)
+            if fm and fm.group(1).upper() in impl:
+                mask |= impl[fm.group(1).upper()]
+            elif part.isdigit():
+                mask |= int(part)
+            else:
+                ok = False
+        if ok:
+            known_mask = mask
+    # The rejection must be applied PER PARSER — a file-global search
+    # would let serve_batch's guard mask a removed guard in decode().
+    spans = _cpp_function_spans(src)
+    guarded: Dict[str, bool] = {}
+    for parser in _CPP_PARSERS:
+        span = spans.get(parser)
+        if span is None:
+            guarded[parser] = False
+            line_of[parser] = 1
+            continue
+        start, end = span
+        body = "\n".join(src.lines[start - 1 : end])
+        guarded[parser] = "~kKnownFlags" in body
+        line_of[parser] = start
+    yield from _check_flag_map(src, impl, known_mask, guarded, line_of)
+
+
+def _npproto_message_of(func_name: str) -> str:
+    """Which registry message a codec function's literals belong to —
+    by the naming convention the codec module keeps."""
+    if "ndarray" in func_name:
+        return "ndarray"
+    if "get_load" in func_name:
+        return "get_load_result"
+    return "arrays_msg"
+
+
+def _npproto_findings(src: SourceFile) -> Iterator[Finding]:
+    for msg, fields in REG.NPPROTO_FIELDS.items():
+        seen: Dict[int, str] = {}
+        for fname, num in fields.items():
+            if num in seen:
+                yield src.finding(
+                    "wire-registry",
+                    1,
+                    f"registry collision in {msg}: field {num} is both "
+                    f"{seen[num]!r} and {fname!r}",
+                )
+            seen[num] = fname
+    # Field-number usage tracked PER MESSAGE (ndarray's field 2 is a
+    # different declaration than get_load_result's field 2 — a flat
+    # union would let drift in one message hide behind the other).
+    used: Dict[str, Dict[int, int]] = {m: {} for m in REG.NPPROTO_FIELDS}
+    dispatch: Dict[str, set] = {m: set() for m in REG.NPPROTO_FIELDS}
+    for fn in src.tree.body:
+        if not isinstance(fn, ast.FunctionDef):
+            continue
+        msg = _npproto_message_of(fn.name)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                fname = (
+                    node.func.id
+                    if isinstance(node.func, ast.Name)
+                    else getattr(node.func, "attr", "")
+                )
+                if fname in ("_len_field", "_tag") and node.args:
+                    arg = node.args[0]
+                    if isinstance(arg, ast.Constant) and isinstance(
+                        arg.value, int
+                    ):
+                        used[msg].setdefault(arg.value, node.lineno)
+            elif isinstance(node, ast.Compare):
+                # `field == N` decode dispatch arms
+                if (
+                    isinstance(node.left, ast.Name)
+                    and node.left.id == "field"
+                    and len(node.ops) == 1
+                    and isinstance(node.ops[0], ast.Eq)
+                    and isinstance(node.comparators[0], ast.Constant)
+                    and isinstance(node.comparators[0].value, int)
+                ):
+                    num = node.comparators[0].value
+                    if num == 0:
+                        # `field == 0` is the illegal-field-number
+                        # guard (proto3 reserves 0), not a field.
+                        continue
+                    used[msg].setdefault(num, node.lineno)
+                    dispatch[msg].add(num)
+    for msg, nums in used.items():
+        declared = set(REG.NPPROTO_FIELDS[msg].values())
+        for num, line in sorted(nums.items()):
+            if num not in declared:
+                yield src.finding(
+                    "wire-registry",
+                    line,
+                    f"npproto field number {num} is used in a {msg} "
+                    "function but not declared for that message in "
+                    "service/wire_registry.py NPPROTO_FIELDS",
+                )
+    for msg, fields in REG.NPPROTO_FIELDS.items():
+        for fname, num in sorted(fields.items(), key=lambda kv: kv[1]):
+            if num not in used[msg]:
+                yield src.finding(
+                    "wire-registry",
+                    1,
+                    f"declared npproto field {msg}.{fname} ({num}) is "
+                    "never encoded or dispatched in npproto_codec.py",
+                )
+    for num in sorted(REG.NPPROTO_EXTENSION_FIELDS):
+        if num not in dispatch["arrays_msg"]:
+            yield src.finding(
+                "wire-registry",
+                1,
+                f"extension field {num} has no decode dispatch arm "
+                f"(`field == {num}`) — we would emit a field we cannot "
+                "read back",
+            )
+
+
+@rule(
+    "wire-registry",
+    "npwire flag bits and npproto field numbers must match "
+    "service/wire_registry.py across npwire.py, npproto_codec.py and "
+    "native/cpp_node.cpp, with decoder-side rejection/dispatch",
+    scope="repo",
+)
+def check_wire_registry(sources: Sequence[SourceFile]) -> Iterator[Finding]:
+    by_rel = {s.rel: s for s in sources}
+    npwire = by_rel.get(_NPWIRE)
+    if npwire is not None:
+        yield from _npwire_findings(npwire)
+    cpp = by_rel.get(_CPP)
+    if cpp is not None:
+        yield from _cpp_findings(cpp)
+    npproto = by_rel.get(_NPPROTO)
+    if npproto is not None:
+        yield from _npproto_findings(npproto)
+
+
+# ---------------------------------------------------------------------------
+# wire-loudness
+# ---------------------------------------------------------------------------
+
+
+def _body_walk(stmts: Sequence[ast.stmt]) -> Iterator[ast.AST]:
+    for stmt in stmts:
+        yield from ast.walk(stmt)
+
+
+def _decode_calls(stmts: Sequence[ast.stmt]) -> List[str]:
+    out = []
+    for node in _body_walk(stmts):
+        if isinstance(node, ast.Call):
+            name = (
+                node.func.id
+                if isinstance(node.func, ast.Name)
+                else getattr(node.func, "attr", "")
+            )
+            if name.startswith("decode_") or name == "_parse_dtype":
+                out.append(name)
+    return out
+
+
+@rule(
+    "wire-loudness",
+    "no bare except in the wire stack; an except around a decode call "
+    "must re-raise or use the caught exception (WireError stays loud)",
+)
+def check_wire_loudness(src: SourceFile) -> Iterator[Finding]:
+    if not src.is_python or not src.rel.startswith(_LOUDNESS_SCOPE):
+        return
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Try):
+            continue
+        decodes = _decode_calls(node.body)
+        for handler in node.handlers:
+            if handler.type is None:
+                yield src.finding(
+                    "wire-loudness",
+                    handler.lineno,
+                    "bare `except:` in the wire stack — catches "
+                    "everything including WireError and KeyboardInterrupt; "
+                    "name the exceptions",
+                )
+                continue
+            if not decodes:
+                continue
+            raises = any(
+                isinstance(n, ast.Raise) for n in _body_walk(handler.body)
+            )
+            uses_exc = bool(handler.name) and any(
+                isinstance(n, ast.Name) and n.id == handler.name
+                for n in _body_walk(handler.body)
+            )
+            if not raises and not uses_exc:
+                yield src.finding(
+                    "wire-loudness",
+                    handler.lineno,
+                    f"`except {ast.unparse(handler.type)}` swallows a "
+                    f"decode failure ({', '.join(sorted(set(decodes)))}) "
+                    "— re-raise, or bind and propagate the error in-band "
+                    "(WireError must stay loud)",
+                )

@@ -124,7 +124,7 @@ class FaultRule:
         stall_s: float = 2.0,
         cut_frac: float = 0.5,
         error: Optional[str] = None,
-    ):
+    ) -> None:
         if kind not in FAULT_KINDS:
             raise ValueError(
                 f"unknown fault kind {kind!r}; known: {sorted(FAULT_KINDS)}"
@@ -253,7 +253,7 @@ class FaultPlan:
         *,
         seed: int = 0,
         plan_id: Optional[str] = None,
-    ):
+    ) -> None:
         self.seed = int(seed)
         self.plan_id = plan_id or f"plan-{self.seed}-{uuid_mod.uuid4().hex[:6]}"
         self.rules: List[FaultRule] = list(rules)

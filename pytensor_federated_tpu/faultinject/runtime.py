@@ -73,7 +73,9 @@ __all__ = [
     "compute_filter_async",
     "mangle_batch_result",
     "getload_filter",
+    "getload_filter_async",
     "probe_filter",
+    "probe_filter_async",
     "snapshot",
 ]
 
@@ -364,6 +366,25 @@ def getload_filter(point: str = "server.getload") -> Optional[bytes]:
     raise FaultPlanError(f"fault kind {rule.kind!r} not applicable at {point}")
 
 
+async def getload_filter_async(point: str = "server.getload") -> Optional[bytes]:
+    """Async twin of :func:`getload_filter` for the grpc.aio GetLoad
+    handler: a ``delay`` rule is awaited, so a chaos-slowed load reply
+    behaves like a slow node — concurrent Evaluate streams on the same
+    event loop keep serving (the PR-5 event-loop-blocking bug class,
+    caught by the ``async-blocking`` graftlint rule)."""
+    rule = decide(point)
+    if rule is None:
+        return None
+    if rule.kind == "getload_garbage":
+        return GETLOAD_GARBAGE
+    if rule.kind == "delay":
+        import asyncio
+
+        await asyncio.sleep(rule.delay_s)
+        return None
+    raise FaultPlanError(f"fault kind {rule.kind!r} not applicable at {point}")
+
+
 def probe_filter(peer: str, point: str = "pool.probe") -> bool:
     """Pool probe-lane shim: ``False`` forces the probe to be recorded
     as failed without dialing (``drop``/``disconnect``); ``delay``
@@ -375,5 +396,24 @@ def probe_filter(peer: str, point: str = "pool.probe") -> bool:
         return False
     if rule.kind == "delay":
         time.sleep(rule.delay_s)
+        return True
+    raise FaultPlanError(f"fault kind {rule.kind!r} not applicable at {point}")
+
+
+async def probe_filter_async(peer: str, point: str = "pool.probe") -> bool:
+    """Async twin of :func:`probe_filter` for the gRPC probe lane:
+    a ``delay`` rule is awaited so a chaos-slowed probe does not freeze
+    the pool's event loop — sibling probes in the same ``gather`` and
+    in-flight calls keep running (the PR-5 event-loop-blocking bug
+    class, caught by the ``async-blocking`` graftlint rule)."""
+    rule = decide(point, peer)
+    if rule is None:
+        return True
+    if rule.kind in ("drop", "disconnect"):
+        return False
+    if rule.kind == "delay":
+        import asyncio
+
+        await asyncio.sleep(rule.delay_s)
         return True
     raise FaultPlanError(f"fault kind {rule.kind!r} not applicable at {point}")

@@ -63,7 +63,7 @@ class CircuitBreaker:
         clock: Callable[[], float] = time.monotonic,
         rng: Optional[random.Random] = None,
         on_transition: Optional[Callable[[str, str], None]] = None,
-    ):
+    ) -> None:
         if failure_threshold < 1:
             raise ValueError(
                 f"failure_threshold must be >= 1, got {failure_threshold}"

@@ -35,7 +35,10 @@ from __future__ import annotations
 
 import random
 import threading
-from typing import List, Optional, Sequence
+from typing import TYPE_CHECKING, List, Optional, Sequence
+
+if TYPE_CHECKING:  # import cycle: pool imports get_policy
+    from .pool import Replica
 
 __all__ = [
     "EwmaLatencyPolicy",
@@ -45,7 +48,7 @@ __all__ = [
 ]
 
 
-def _depth(replica) -> float:
+def _depth(replica: "Replica") -> float:
     """Advertised queue depth from a fresh load reply, else this
     driver's OWN in-flight count toward the replica — the local
     fallback signal for lanes that advertise liveness only (TCP) or
@@ -63,7 +66,7 @@ class RoundRobinPolicy:
 
     name = "round_robin"
 
-    def __init__(self):
+    def __init__(self) -> None:
         self._lock = threading.Lock()
         self._counter = 0
 
@@ -101,10 +104,10 @@ class PowerOfTwoChoicesPolicy:
 
     name = "p2c"
 
-    def __init__(self, rng: Optional[random.Random] = None):
+    def __init__(self, rng: Optional[random.Random] = None) -> None:
         self._rng = rng or random.Random()
 
-    def _better(self, a, b):
+    def _better(self, a: "Replica", b: "Replica") -> "Replica":
         da, db = _depth(a), _depth(b)
         if da != db:
             return a if da < db else b
@@ -134,7 +137,7 @@ _POLICIES = {
 }
 
 
-def get_policy(policy) -> object:
+def get_policy(policy: object) -> object:
     """A policy instance from a name ("p2c" default, "round_robin",
     "ewma") or a pre-built object exposing ``pick(candidates, k)``."""
     if isinstance(policy, str):

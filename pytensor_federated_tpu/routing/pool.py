@@ -50,7 +50,10 @@ import struct
 import threading
 import time
 import uuid as uuid_mod
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import TYPE_CHECKING, Any, Dict, List, Optional, Sequence, Tuple
+
+if TYPE_CHECKING:
+    from concurrent.futures import ThreadPoolExecutor
 
 from ..faultinject import runtime as _fi
 from ..telemetry import flightrec as _flightrec
@@ -120,7 +123,7 @@ class Replica:
     connection cache; tcp.py's single-socket lock-step contract).
     """
 
-    def __init__(self, host: str, port: int, breaker: CircuitBreaker):
+    def __init__(self, host: str, port: int, breaker: CircuitBreaker) -> None:
         self.host = host
         self.port = int(port)
         self.breaker = breaker
@@ -128,8 +131,8 @@ class Replica:
         self.load: Optional[dict] = None
         self.load_ts: Optional[float] = None
         self.inflight = 0
-        self.client = None  # created by NodePool.client_for
-        self._executor = None  # TCP lane: per-replica worker thread
+        self.client: Optional[Any] = None  # created by NodePool.client_for
+        self._executor: Optional["ThreadPoolExecutor"] = None  # TCP worker
         self._lock = threading.Lock()
         self._load_stale_s = 10.0  # overwritten by the owning pool
 
@@ -217,7 +220,9 @@ def _tcp_probe(host: str, port: int, *, timeout: float) -> bool:
         # Pre-batch peer: any decodable npwire reply proves liveness.
         decode_arrays_all(payload)
         return True
-    except Exception:
+    # A garbled reply is a FAILED PROBE — False is this lane's loud
+    # in-band verdict (the breaker records it), not a swallowed error.
+    except Exception:  # graftlint: disable=wire-loudness -- probe verdict lane
         return False
 
 
@@ -239,14 +244,14 @@ class NodePool:
         replicas: Sequence[HostPort] = (),
         *,
         transport: str = "grpc",
-        policy="p2c",
+        policy: object = "p2c",
         client_kwargs: Optional[dict] = None,
         probe_interval_s: float = 1.0,
         probe_timeout_s: float = 2.0,
         load_stale_s: float = 10.0,
         breaker_kwargs: Optional[dict] = None,
         member_retries: int = 2,
-    ):
+    ) -> None:
         if transport not in ("grpc", "tcp"):
             raise ValueError(
                 f"transport must be 'grpc' or 'tcp', got {transport!r}"
@@ -277,7 +282,7 @@ class NodePool:
     def _make_replica(self, host: str, port: int) -> Replica:
         addr = f"{host}:{int(port)}"
 
-        def on_transition(old: str, new: str, _addr=addr) -> None:
+        def on_transition(old: str, new: str, _addr: str = addr) -> None:
             _POOL_BREAKER_TRANSITIONS.labels(to=new).inc()
             _flightrec.record(f"pool.breaker_{new}", replica=_addr)
             self._refresh_state_gauges()
@@ -334,7 +339,7 @@ class NodePool:
 
     # -- transport clients ------------------------------------------------
 
-    def client_for(self, replica: Replica):
+    def client_for(self, replica: Replica) -> Any:
         """The replica's lazily-created transport client.  ``retries=0``
         on purpose: the POOL owns retry/failover — an inner retry loop
         would replay against the very replica being failed away from."""
@@ -359,7 +364,7 @@ class NodePool:
                 )
         return replica.client
 
-    def executor_for(self, replica: Replica):
+    def executor_for(self, replica: Replica) -> "ThreadPoolExecutor":
         """TCP lane: the replica's single worker thread (the sync
         socket client is driven off the event loop via
         ``run_in_executor``; one dedicated thread preserves the
@@ -379,7 +384,9 @@ class NodePool:
         from ..service.client import get_load_async
 
         if _fi.active_plan is not None:  # chaos seam: probe lane
-            if not _fi.probe_filter(replica.address):
+            # The async twin: a delay rule must not block the event
+            # loop (graftlint async-blocking, the PR-5 bug class).
+            if not await _fi.probe_filter_async(replica.address):
                 replica.record_load(None)
                 return False
         t0 = time.perf_counter()
@@ -486,7 +493,9 @@ class NodePool:
 
     # -- routing ----------------------------------------------------------
 
-    def available_replicas(self, exclude=()) -> List[Replica]:
+    def available_replicas(
+        self, exclude: Sequence = ()
+    ) -> List[Replica]:
         excluded = {
             e if isinstance(e, str) else e.address for e in exclude
         }
@@ -496,7 +505,9 @@ class NodePool:
             if r.address not in excluded and r.breaker.available()
         ]
 
-    def pick(self, k: int = 1, *, exclude=()) -> List[Replica]:
+    def pick(
+        self, k: int = 1, *, exclude: Sequence = ()
+    ) -> List[Replica]:
         """Up to ``k`` distinct admitted replicas, policy-ranked.  Each
         returned replica passed ``breaker.acquire()`` — in half-open
         that claims the single probe token, so a recovering replica

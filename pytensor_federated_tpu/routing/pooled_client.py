@@ -71,7 +71,7 @@ class _LatencyRing:
     quantile — the hedge-deadline estimator.  Tiny (128 floats) and
     lock-guarded; a sort per hedge decision is noise next to an RPC."""
 
-    def __init__(self, capacity: int = 128):
+    def __init__(self, capacity: int = 128) -> None:
         self._cap = capacity
         self._values: List[float] = []
         self._idx = 0
@@ -129,13 +129,13 @@ class PooledArraysClient:
 
     def __init__(
         self,
-        pool,
+        pool: object,
         *,
         hedge: bool = False,
         hedge_quantile: float = 0.95,
         hedge_min_wait_s: float = 0.001,
-        **pool_kwargs,
-    ):
+        **pool_kwargs: object,
+    ) -> None:
         if isinstance(pool, NodePool):
             if pool_kwargs:
                 raise ValueError(
@@ -160,7 +160,9 @@ class PooledArraysClient:
 
     # -- per-replica calls ------------------------------------------------
 
-    async def _call_replica(self, replica: Replica, arrays) -> list:
+    async def _call_replica(
+        self, replica: Replica, arrays: Sequence
+    ) -> list:
         client = self.pool.client_for(replica)
         replica.inflight += 1  # the local load signal (policies.py)
         try:
@@ -176,7 +178,7 @@ class PooledArraysClient:
             replica.inflight -= 1
 
     async def _window_replica(
-        self, replica: Replica, reqs, window: int, batch
+        self, replica: Replica, reqs: Sequence, window: int, batch: object
     ) -> Tuple[list, Optional[BaseException], float]:
         """One partial pipelined pass on one replica ->
         ``(results_with_None_tail, transport_exc_or_None, wall_s)``.
@@ -220,7 +222,7 @@ class PooledArraysClient:
             return None
         return max(q, self.hedge_min_wait_s)
 
-    async def _cancel_loser(self, task: asyncio.Task, replica: Replica):
+    async def _cancel_loser(self, task: asyncio.Task, replica: Replica) -> None:
         task.cancel()
         with contextlib.suppress(BaseException):
             await task
@@ -239,7 +241,7 @@ class PooledArraysClient:
                 await replica.client._drop_privates()
 
     async def _attempt(
-        self, replica: Replica, arrays, exclude
+        self, replica: Replica, arrays: Sequence, exclude: Sequence
     ) -> Tuple[list, float, Replica]:
         """One (possibly hedged) attempt: returns
         ``(outputs, wall_s, serving_replica)``; transport errors and
@@ -416,7 +418,7 @@ class PooledArraysClient:
         default_w = (sum(measured) / len(measured)) if measured else 1.0
         n = len(pending)
 
-        def weights_of(group):
+        def weights_of(group: Sequence[Replica]) -> List[float]:
             return [
                 (1.0 / r.ewma_latency_s) if r.ewma_latency_s else default_w
                 for r in group

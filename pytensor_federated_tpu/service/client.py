@@ -150,9 +150,12 @@ async def get_load_async(
 
             try:
                 return decode_get_load_result(reply)
-            except WireError:
+            # A garbled load reply is a failed PROBE, not a failed call:
+            # None feeds the balancer's "replica unknown" path, which is
+            # the loud in-band verdict for this lane.
+            except WireError:  # graftlint: disable=wire-loudness -- probe verdict lane
                 return None
-    except (
+    except (  # graftlint: disable=wire-loudness -- probe verdict lane (None = failed probe)
         asyncio.TimeoutError,
         grpc.aio.AioRpcError,
         OSError,

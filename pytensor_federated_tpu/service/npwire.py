@@ -86,6 +86,13 @@ _FLAG_ERROR = 1
 _FLAG_TRACE = 2
 _FLAG_SPANS = 4
 _FLAG_BATCH = 8
+# Every known flag bit, mirrored from service/wire_registry.py (the
+# declared source; the graftlint wire-registry rule cross-checks the
+# two).  Decoders REJECT any bit outside this mask: an unknown flag
+# means the frame carries blocks this build cannot place, and parsing
+# around them would be silent mis-parsing — the exact version-skew
+# hazard the module docstring's loud-failure contract forbids.
+_KNOWN_FLAGS = _FLAG_ERROR | _FLAG_TRACE | _FLAG_SPANS | _FLAG_BATCH
 # flags byte offset in the header ("<4sBB...": magic, version, flags)
 _FLAGS_OFF = 5
 
@@ -94,7 +101,18 @@ class WireError(ValueError):
     """Malformed or unsupported wire payload."""
 
 
-def _tupleize(descr):
+def _check_flags(flags: int) -> None:
+    """Reject undeclared flag bits loudly (loud-failure contract)."""
+    unknown = flags & ~_KNOWN_FLAGS
+    if unknown:
+        raise WireError(
+            f"unknown flag bits 0x{unknown:02x} "
+            f"(known mask 0x{_KNOWN_FLAGS:02x}) — version-skewed peer? "
+            "npwire peers must ship in lockstep"
+        )
+
+
+def _tupleize(descr: object) -> object:
     """JSON round-trip turns descr tuples into lists; restore them
     recursively (field entries are tuples, nested shapes too)."""
     if isinstance(descr, list):
@@ -260,6 +278,7 @@ def decode_batch(
         raise WireError(f"bad magic {magic!r}")
     if version != 1:
         raise WireError(f"unsupported version {version}")
+    _check_flags(flags)
     if not flags & _FLAG_BATCH:
         raise WireError("not a batch frame (flag bit 8 unset)")
     off = struct.calcsize("<4sBB16sI")
@@ -381,6 +400,7 @@ def decode_arrays_all(
         raise WireError(f"bad magic {magic!r}")
     if version != 1:
         raise WireError(f"unsupported version {version}")
+    _check_flags(flags)
     if flags & _FLAG_BATCH:
         # Loud, not silent: parsing K framed items as arrays would
         # yield garbage.  Batch frames only reach negotiated peers

@@ -758,7 +758,9 @@ class ArraysToArraysService:
         """
         _REQUESTS.labels(method="get_load").inc()
         if _fi.active_plan is not None:  # chaos seam: probe lane
-            garbage = _fi.getload_filter()
+            # The async twin: a delay rule must not block the event
+            # loop (graftlint async-blocking, the PR-5 bug class).
+            garbage = await _fi.getload_filter_async()
             if garbage is not None:
                 return garbage
         load = self.determine_load()

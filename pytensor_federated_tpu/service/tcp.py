@@ -338,7 +338,10 @@ class TcpArraysClient:
                 try:
                     items, ruid, err, _tid, _sp = decode_batch(reply)
                     ok = ruid == uid and err is None and not items
-                except Exception:
+                # Capability NEGOTIATION: an undecodable echo means the
+                # peer is pre-batch — the loud in-band verdict is
+                # "capability absent", never an exception.
+                except Exception:  # graftlint: disable=wire-loudness -- negotiation verdict lane
                     ok = False
             self._batch_ok = ok
             _flightrec.record(

@@ -1,0 +1,94 @@
+"""The single declared source of wire-format constants.
+
+Three implementations speak this repo's wire formats — the npwire
+codec (:mod:`.npwire`), the hand-rolled proto3 codec
+(:mod:`.npproto_codec`), and the C++ node (``native/cpp_node.cpp``) —
+and each necessarily carries its own literals (the C++ file cannot
+import Python).  This module is the DECLARATION those literals are
+checked against: the ``wire-registry`` graftlint rule
+(:mod:`..analysis.rules_wire`) cross-parses all three implementations
+and fails CI on any flag bit or field number that is undeclared here,
+collides with another declaration, or lacks its decoder-side
+rejection/dispatch arm.  Adding a wire feature therefore starts HERE;
+the checker then points at every implementation that has not caught
+up.
+
+Nothing in this module imports anything, so any layer (including the
+analysis package, which must not drag jax in for a wire check) can
+read it.
+
+npwire flag bits
+----------------
+One byte of flags in the frame header (npwire.py module docstring has
+the full layout).  Every bit must be listed: the decoders reject a
+frame whose flags contain any bit outside :data:`NPWIRE_KNOWN_FLAGS`
+— the loud-failure contract that makes a version-skewed peer an error
+instead of silent mis-parsing.
+
+npproto field numbers
+---------------------
+Field numbers per proto3 message, including the four extension fields
+(14-17) this package adds to the reference schema.  Unknown fields are
+SKIPPED on decode (proto3 forward compatibility — deliberately the
+opposite posture of npwire flags), so the decoder-side obligation
+checked for each declared field is a dispatch arm, not a rejection.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "NPWIRE_FLAGS",
+    "NPWIRE_KNOWN_FLAGS",
+    "NPPROTO_FIELDS",
+    "NPPROTO_EXTENSION_FIELDS",
+]
+
+#: npwire frame flag bits, by canonical name.  npwire.py spells these
+#: ``_FLAG_<NAME>``; native/cpp_node.cpp spells them ``kFlag<Name>``.
+NPWIRE_FLAGS = {
+    "ERROR": 1,   # in-band error string block follows the header
+    "TRACE": 2,   # 16-byte telemetry trace id block
+    "SPANS": 4,   # JSON span-tree tail (reply piggyback)
+    "BATCH": 8,   # count field is n_items; body is nested frames
+}
+
+#: The full known-flags mask every npwire decoder must enforce
+#: (``flags & ~KNOWN`` is a WireError, not a skip).
+NPWIRE_KNOWN_FLAGS = 0
+for _bit in NPWIRE_FLAGS.values():
+    NPWIRE_KNOWN_FLAGS |= _bit
+del _bit
+
+#: proto3 field numbers by message.  ``arrays_msg`` covers InputArrays
+#: and OutputArrays (identical layout, reference service.proto:6-19);
+#: fields >= 14 are this package's extensions (npproto_codec.py module
+#: docstring documents each).
+NPPROTO_FIELDS = {
+    "ndarray": {
+        "data": 1,
+        "dtype": 2,
+        "shape": 3,
+        "strides": 4,
+    },
+    "arrays_msg": {
+        "items": 1,
+        "uuid": 2,
+        "error": 14,        # per-item error inside a batch reply
+        "trace_id": 15,     # 16-byte telemetry correlation id
+        "spans": 16,        # JSON span trees, reply piggyback
+        "batch_items": 17,  # nested messages: the batch frame marker
+    },
+    "get_load_result": {
+        "n_clients": 1,
+        "percent_cpu": 2,
+        "percent_ram": 3,
+    },
+}
+
+#: The extension field numbers (unknown to the reference schema; a
+#: reference peer skips them by wire type).  Kept as an explicit set so
+#: the checker can demand a decode dispatch arm for each — we must
+#: never emit a field we cannot read back.
+NPPROTO_EXTENSION_FIELDS = frozenset(
+    n for n in NPPROTO_FIELDS["arrays_msg"].values() if n >= 14
+)

@@ -530,3 +530,75 @@ class TestBundleEmbedding:
 
         path = write_incident_bundle("unit-test", dir=str(tmp_path))
         assert "fault_plan" not in json.load(open(path))
+
+
+class TestAsyncShimTwins:
+    """Regression for the graftlint ``async-blocking`` findings: the
+    GetLoad and probe shims used to be called SYNC from grpc.aio
+    handlers, so a chaos ``delay`` rule slept on the event loop and
+    froze every concurrent RPC (the PR-5 bug class).  The async twins
+    must (a) match the sync shims' semantics and (b) actually yield."""
+
+    def test_getload_filter_async_parity(self):
+        import asyncio
+
+        plan = fi.FaultPlan(
+            [fi.FaultRule("getload_garbage", point="server.getload")],
+            seed=0,
+        )
+        fi.install(plan)
+        out = asyncio.run(fi.runtime.getload_filter_async())
+        assert out == fi.runtime.GETLOAD_GARBAGE
+        fi.uninstall()
+        assert asyncio.run(fi.runtime.getload_filter_async()) is None
+
+    def test_probe_filter_async_parity(self):
+        import asyncio
+
+        plan = fi.FaultPlan(
+            [fi.FaultRule("drop", point="pool.probe")], seed=0
+        )
+        fi.install(plan)
+        assert asyncio.run(fi.runtime.probe_filter_async("h:1")) is False
+        fi.uninstall()
+        assert asyncio.run(fi.runtime.probe_filter_async("h:1")) is True
+
+    def test_async_twins_keep_the_loop_alive_through_delay(self):
+        """A concurrent ticker must keep running WHILE the chaos delay
+        is pending — the sync shims provably froze it (time.sleep)."""
+        import asyncio
+
+        plan = fi.FaultPlan(
+            [
+                fi.FaultRule(
+                    "delay", point="server.getload", nth=1, delay_s=0.2
+                ),
+                fi.FaultRule(
+                    "delay", point="pool.probe", nth=1, delay_s=0.2
+                ),
+            ],
+            seed=1,
+        )
+        fi.install(plan)
+
+        async def main():
+            ticks = 0
+            done = False
+
+            async def ticker():
+                nonlocal ticks
+                while not done:
+                    ticks += 1
+                    await asyncio.sleep(0.01)
+
+            t = asyncio.ensure_future(ticker())
+            assert await fi.runtime.getload_filter_async() is None
+            assert await fi.runtime.probe_filter_async("h:1") is True
+            done = True
+            await t
+            return ticks
+
+        ticks = asyncio.run(main())
+        # two 0.2 s awaited delays -> the 10 ms ticker gets dozens of
+        # turns; the old sync path would have allowed ~0.
+        assert ticks >= 10

@@ -1,0 +1,691 @@
+"""graftlint: fixture pairs per rule (bad flagged / good clean /
+suppression honored), the registry meta-test, and the full-repo gate.
+
+Fixtures are synthesized mini-repos under ``tmp_path`` so each rule is
+exercised against code written to violate exactly one invariant —
+independent of the real package, which the final gate test requires to
+be CLEAN (the same invocation CI runs)."""
+
+import textwrap
+
+import pytest
+
+from pytensor_federated_tpu import analysis
+from pytensor_federated_tpu.analysis import core
+from pytensor_federated_tpu.analysis.rules_fed import missing_rules
+from pytensor_federated_tpu.analysis.__main__ import main as cli_main
+
+
+def run_on(tmp_path, files, rules):
+    """Materialize ``files`` (rel -> source) under a synthetic repo
+    root and run the selected rules over it (default discovery, so
+    repo-scope rules see the whole synthetic repo)."""
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text))
+    return core.run(rules=rules, paths=None, root=tmp_path)
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# -- async-blocking ---------------------------------------------------------
+
+
+class TestAsyncBlocking:
+    REL = "pytensor_federated_tpu/service/fixture_mod.py"
+
+    def test_bad_blocking_calls_flagged(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {
+                self.REL: """
+                import time, subprocess
+                async def f(sock):
+                    time.sleep(1)
+                    subprocess.run(["x"])
+                    sock.sendall(b"")
+                    _fi.filter_bytes("p", b"")
+                """
+            },
+            ["async-blocking"],
+        )
+        assert len(findings) == 4
+        assert rules_of(findings) == {"async-blocking"}
+        messages = " ".join(f.message for f in findings)
+        assert "time.sleep" in messages
+        assert "filter_bytes_async" in messages  # names the async twin
+
+    def test_good_async_and_executor_closure_clean(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {
+                self.REL: """
+                import asyncio, time
+                async def g(loop):
+                    await asyncio.sleep(0)
+                    await _fi.filter_bytes_async("p", b"")
+                    def worker():
+                        time.sleep(1)  # runs on an executor thread
+                    await loop.run_in_executor(None, worker)
+                def sync_path():
+                    time.sleep(1)  # not async: out of scope
+                """
+            },
+            ["async-blocking"],
+        )
+        assert findings == []
+
+    def test_out_of_scope_package_is_clean(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {
+                "pytensor_federated_tpu/samplers/fixture_mod.py": """
+                import time
+                async def f():
+                    time.sleep(1)
+                """
+            },
+            ["async-blocking"],
+        )
+        assert findings == []
+
+    def test_suppression_honored(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {
+                self.REL: """
+                import time
+                async def f():
+                    time.sleep(1)  # graftlint: disable=async-blocking -- fixture
+                """
+            },
+            ["async-blocking"],
+        )
+        assert findings == []
+
+
+# -- loop-affinity ----------------------------------------------------------
+
+
+class TestLoopAffinity:
+    def test_stored_channel_flagged(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {
+                "pytensor_federated_tpu/routing/fixture_mod.py": """
+                import grpc
+                class C:
+                    def __init__(self):
+                        self.ch = grpc.aio.insecure_channel("a:1")
+                """
+            },
+            ["loop-affinity"],
+        )
+        assert len(findings) == 1
+        assert "connection cache" in findings[0].message
+
+    def test_scoped_async_with_clean(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {
+                "pytensor_federated_tpu/routing/fixture_mod.py": """
+                import grpc
+                async def ok():
+                    async with grpc.aio.insecure_channel("a:1") as ch:
+                        return ch
+                """
+            },
+            ["loop-affinity"],
+        )
+        assert findings == []
+
+    def test_cache_constructor_site_allowed(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {
+                "pytensor_federated_tpu/service/client.py": """
+                import grpc
+                class ClientPrivates:
+                    @staticmethod
+                    async def connect(host, port):
+                        return grpc.aio.insecure_channel(f"{host}:{port}")
+                """
+            },
+            ["loop-affinity"],
+        )
+        assert findings == []
+
+    def test_suppression_honored(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {
+                "pytensor_federated_tpu/routing/fixture_mod.py": """
+                import grpc
+                def make():
+                    # graftlint: disable=loop-affinity -- fixture
+                    return grpc.aio.insecure_channel("a:1")
+                """
+            },
+            ["loop-affinity"],
+        )
+        assert findings == []
+
+
+# -- wire-registry ----------------------------------------------------------
+
+NPWIRE_CLEAN = """
+_FLAG_ERROR = 1
+_FLAG_TRACE = 2
+_FLAG_SPANS = 4
+_FLAG_BATCH = 8
+_KNOWN_FLAGS = _FLAG_ERROR | _FLAG_TRACE | _FLAG_SPANS | _FLAG_BATCH
+
+
+def _check_flags(flags):
+    pass
+
+
+def decode_arrays_all(buf):
+    _check_flags(0)
+
+
+def decode_batch(buf):
+    _check_flags(0)
+"""
+
+NPWIRE_REL = "pytensor_federated_tpu/service/npwire.py"
+CPP_REL = "native/cpp_node.cpp"
+
+CPP_CLEAN = """
+constexpr uint8_t kFlagError = 1;
+constexpr uint8_t kFlagTrace = 2;
+constexpr uint8_t kFlagSpans = 4;
+constexpr uint8_t kFlagBatch = 8;
+constexpr uint8_t kKnownFlags =
+    kFlagError | kFlagTrace | kFlagSpans | kFlagBatch;
+bool decode(const Buf& b) {
+  if (flags & ~kKnownFlags) return false;
+  return true;
+}
+std::vector<uint8_t> serve_batch(const Buf& b) {
+  if (flags & ~kKnownFlags) return batch_error_reply("unknown flags");
+  return {};
+}
+"""
+
+
+class TestWireRegistry:
+    def test_clean_fixture(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {NPWIRE_REL: NPWIRE_CLEAN, CPP_REL: CPP_CLEAN},
+            ["wire-registry"],
+        )
+        assert findings == []
+
+    def test_undeclared_flag_flagged(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {NPWIRE_REL: NPWIRE_CLEAN + "_FLAG_ZSTD = 16\n"},
+            ["wire-registry"],
+        )
+        assert any("ZSTD" in f.message for f in findings)
+
+    def test_value_mismatch_flagged(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {NPWIRE_REL: NPWIRE_CLEAN.replace("_FLAG_TRACE = 2", "_FLAG_TRACE = 3")},
+            ["wire-registry"],
+        )
+        assert any(
+            "TRACE" in f.message and "declared as 2" in f.message
+            for f in findings
+        )
+
+    def test_missing_known_mask_flagged(self, tmp_path):
+        src = NPWIRE_CLEAN.replace(
+            "_KNOWN_FLAGS = _FLAG_ERROR | _FLAG_TRACE | _FLAG_SPANS | _FLAG_BATCH",
+            "",
+        )
+        findings = run_on(tmp_path, {NPWIRE_REL: src}, ["wire-registry"])
+        assert any("known-flags mask" in f.message for f in findings)
+
+    def test_unguarded_decoder_flagged(self, tmp_path):
+        src = NPWIRE_CLEAN.replace(
+            "def decode_batch(buf):\n    _check_flags(0)",
+            "def decode_batch(buf):\n    return buf",
+        )
+        findings = run_on(tmp_path, {NPWIRE_REL: src}, ["wire-registry"])
+        assert any(
+            "decode_batch" in f.message and "reject" in f.message
+            for f in findings
+        )
+
+    def test_cpp_without_mask_flagged(self, tmp_path):
+        src = CPP_CLEAN.replace("constexpr uint8_t kKnownFlags =\n", "// ")
+        findings = run_on(tmp_path, {CPP_REL: src}, ["wire-registry"])
+        assert any(
+            f.path == CPP_REL and "known-flags mask" in f.message
+            for f in findings
+        )
+
+    def test_cpp_guard_checked_per_parser(self, tmp_path):
+        """Removing the guard from ONE C++ parser must be flagged even
+        while the other parser's guard keeps the mask string present
+        in the file (regression: the check was file-global)."""
+        src = CPP_CLEAN.replace(
+            "bool decode(const Buf& b) {\n"
+            "  if (flags & ~kKnownFlags) return false;\n",
+            "bool decode(const Buf& b) {\n",
+        )
+        findings = run_on(tmp_path, {CPP_REL: src}, ["wire-registry"])
+        assert any(
+            f.path == CPP_REL
+            and "decode" in f.message
+            and "reject" in f.message
+            for f in findings
+        ), findings
+
+    def test_undeclared_npproto_field_flagged(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {
+                "pytensor_federated_tpu/service/npproto_codec.py": """
+                def encode(x):
+                    return _len_field(99, x)
+                """
+            },
+            ["wire-registry"],
+        )
+        assert any(
+            "field number 99" in f.message and "not declared" in f.message
+            for f in findings
+        )
+
+
+# -- wire-loudness ----------------------------------------------------------
+
+
+class TestWireLoudness:
+    REL = "pytensor_federated_tpu/service/fixture_mod.py"
+
+    def test_swallowed_decode_flagged(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {
+                self.REL: """
+                def f(buf):
+                    try:
+                        return decode_arrays(buf)
+                    except Exception:
+                        return None
+                """
+            },
+            ["wire-loudness"],
+        )
+        assert len(findings) == 1
+        assert "swallows a decode failure" in findings[0].message
+
+    def test_bare_except_flagged(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {
+                self.REL: """
+                def f(buf):
+                    try:
+                        return int(buf)
+                    except:
+                        return None
+                """
+            },
+            ["wire-loudness"],
+        )
+        assert len(findings) == 1
+        assert "bare" in findings[0].message
+
+    def test_reraise_and_inband_use_clean(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {
+                self.REL: """
+                def f(buf):
+                    try:
+                        return decode_arrays(buf)
+                    except WireError as e:
+                        return error_reply(str(e))
+                def g(buf):
+                    try:
+                        return decode_arrays(buf)
+                    except ValueError:
+                        raise
+                """
+            },
+            ["wire-loudness"],
+        )
+        assert findings == []
+
+    def test_suppression_honored(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {
+                self.REL: """
+                def probe(buf):
+                    try:
+                        return decode_arrays(buf)
+                    except Exception:  # graftlint: disable=wire-loudness -- verdict lane
+                        return None
+                """
+            },
+            ["wire-loudness"],
+        )
+        assert findings == []
+
+
+# -- fault-shim-coverage ----------------------------------------------------
+
+
+class TestFaultShimCoverage:
+    REL = "pytensor_federated_tpu/service/fixture_mod.py"
+
+    def test_unshimmed_raw_socket_flagged(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {
+                self.REL: """
+                def send(sock, b):
+                    sock.sendall(b)
+                """
+            },
+            ["fault-shim-coverage"],
+        )
+        assert len(findings) == 1
+        assert "faultinject" in findings[0].message
+
+    def test_shimmed_and_transitively_covered_clean(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {
+                self.REL: """
+                from ..faultinject import runtime as _fi
+                def send(sock, b):
+                    if _fi.active_plan is not None:
+                        _fi.send_frame_through("p", sock.sendall, b)
+                    else:
+                        sock.sendall(b)
+                def _helper(sock, n):
+                    return sock.recv(n)
+                def recv_shimmed(sock, n):
+                    data = _helper(sock, n)
+                    return _fi.filter_bytes("p", data)
+                """
+            },
+            ["fault-shim-coverage"],
+        )
+        assert findings == []
+
+    def test_codec_without_seam_flagged(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {
+                "pytensor_federated_tpu/service/npwire.py": """
+                def encode_arrays(arrays):
+                    return b"x"
+                """
+            },
+            ["fault-shim-coverage"],
+        )
+        assert len(findings) == 1
+        assert "encode_arrays" in findings[0].message
+
+    def test_codec_delegation_clean(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {
+                "pytensor_federated_tpu/service/npwire.py": """
+                from ..faultinject import runtime as _fi
+                def decode_arrays_all(buf):
+                    if _fi.active_plan is not None:
+                        buf = _fi.filter_bytes("npwire.decode", buf)
+                    return buf
+                def decode_arrays(buf):
+                    return decode_arrays_all(buf)
+                """
+            },
+            ["fault-shim-coverage"],
+        )
+        assert findings == []
+
+    def test_suppression_honored(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {
+                self.REL: """
+                def send(sock, b):
+                    sock.sendall(b)  # graftlint: disable=fault-shim-coverage -- fixture
+                """
+            },
+            ["fault-shim-coverage"],
+        )
+        assert findings == []
+
+
+# -- fed-rule-completeness --------------------------------------------------
+
+
+class TestFedRuleCompleteness:
+    def test_incomplete_primitive_reported(self):
+        import types
+
+        from jax.extend import core as jex_core
+
+        mod = types.SimpleNamespace(
+            incomplete_p=jex_core.Primitive("graftlint_test_incomplete")
+        )
+        out = missing_rules(mod)
+        assert len(out) == 1
+        attr, _prim, missing = out[0]
+        assert attr == "incomplete_p"
+        assert set(missing) == {
+            "abstract_eval",
+            "jvp",
+            "transpose",
+            "batching",
+        }
+
+    def test_real_fed_primitives_complete(self):
+        from pytensor_federated_tpu.fed import primitives as fed_primitives
+
+        assert missing_rules(fed_primitives) == []
+
+
+# -- observability-drift ----------------------------------------------------
+
+OBS_DOC = """
+# Observability
+
+| `pftpu_good_total` | counter | a documented family |
+
+### `telemetry.flightrec` — the black box
+
+| kind | emitted by |
+|---|---|
+| `good.event` | the fixture |
+| `dyn.<kind>` | the fixture's dynamic emitter |
+"""
+
+OBS_CODE_CLEAN = """
+from .telemetry import metrics, flightrec as _flightrec
+
+_C = metrics.counter("pftpu_good_total", "help")
+
+
+def f(kind):
+    _flightrec.record("good.event", a=1)
+    _flightrec.record(f"dyn.{kind}", b=2)
+"""
+
+
+class TestObservabilityDrift:
+    REL = "pytensor_federated_tpu/fixture_mod.py"
+    DOC = "docs/observability.md"
+
+    def _run(self, tmp_path, code, doc=OBS_DOC):
+        (tmp_path / "docs").mkdir(parents=True, exist_ok=True)
+        (tmp_path / self.DOC).write_text(textwrap.dedent(doc))
+        return run_on(tmp_path, {self.REL: code}, ["observability-drift"])
+
+    def test_clean_fixture(self, tmp_path):
+        assert self._run(tmp_path, OBS_CODE_CLEAN) == []
+
+    def test_unregistered_metric_and_event_flagged(self, tmp_path):
+        code = OBS_CODE_CLEAN + (
+            '\n_B = metrics.gauge("pftpu_rogue_depth", "h")\n'
+            '\ndef g():\n    _flightrec.record("rogue.event")\n'
+        )
+        findings = self._run(tmp_path, code)
+        assert any("pftpu_rogue_depth" in f.message for f in findings)
+        assert any("rogue.event" in f.message for f in findings)
+        assert all(f.path == self.REL for f in findings)
+
+    def test_documented_but_dead_flagged(self, tmp_path):
+        doc = OBS_DOC + (
+            "| `ghost.event` | nothing emits this |\n"
+        ) + "\nprose mention of `pftpu_ghost_total` counts as documented\n"
+        findings = self._run(tmp_path, OBS_CODE_CLEAN, doc)
+        assert any(
+            f.path == self.DOC and "ghost.event" in f.message
+            for f in findings
+        )
+        assert any(
+            f.path == self.DOC and "pftpu_ghost_total" in f.message
+            for f in findings
+        )
+
+    def test_dynamic_prefix_covers_wildcard(self, tmp_path):
+        # remove the dynamic emitter -> the documented wildcard is dead
+        code = OBS_CODE_CLEAN.replace(
+            '    _flightrec.record(f"dyn.{kind}", b=2)\n', ""
+        )
+        findings = self._run(tmp_path, code)
+        assert any("dyn.<" in f.message for f in findings)
+
+
+# -- suppression mechanics --------------------------------------------------
+
+
+class TestSuppressions:
+    def test_line_above_and_all_keyword(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {
+                "pytensor_federated_tpu/service/fixture_mod.py": """
+                import time
+                async def f():
+                    # graftlint: disable=all -- fixture: directive on the line above
+                    time.sleep(1)
+                """
+            },
+            ["async-blocking"],
+        )
+        assert findings == []
+
+    def test_wrong_rule_name_does_not_suppress(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {
+                "pytensor_federated_tpu/service/fixture_mod.py": """
+                import time
+                async def f():
+                    time.sleep(1)  # graftlint: disable=wire-loudness -- wrong rule
+                """
+            },
+            ["async-blocking"],
+        )
+        assert len(findings) == 1
+
+
+# -- driver + registry ------------------------------------------------------
+
+
+class TestDriver:
+    def test_list_rules_exits_zero(self, capsys):
+        assert cli_main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for name in analysis.RULES:
+            assert name in out
+
+    def test_unknown_rule_is_usage_error(self, capsys):
+        assert cli_main(["--rule", "no-such-rule"]) == 2
+
+    def test_json_output_shape(self, tmp_path, capsys):
+        bad = tmp_path / "pytensor_federated_tpu" / "service" / "m.py"
+        bad.parent.mkdir(parents=True)
+        bad.write_text(
+            "import time\nasync def f():\n    time.sleep(1)\n"
+        )
+        findings = core.run(
+            rules=["async-blocking"], paths=[bad], root=tmp_path
+        )
+        import json
+
+        payload = json.loads(core.render_json(findings))
+        assert payload["count"] == 1
+        assert payload["findings"][0]["rule"] == "async-blocking"
+        assert payload["findings"][0]["line"] == 3
+
+    def test_rule_catalog_shape(self):
+        assert set(analysis.RULES) == {
+            "async-blocking",
+            "loop-affinity",
+            "wire-registry",
+            "wire-loudness",
+            "fault-shim-coverage",
+            "fed-rule-completeness",
+            "observability-drift",
+        }
+        for r in analysis.RULES.values():
+            assert r.scope in ("file", "repo")
+            assert r.summary
+
+
+class TestDocsCatalogMetaTest:
+    def test_docs_rule_catalog_matches_registry(self):
+        """docs/static-analysis.md documents exactly the registered
+        rules — a new checker lands with its catalog entry, a removed
+        one takes its entry along."""
+        import re
+
+        doc = (core.repo_root() / "docs" / "static-analysis.md").read_text()
+        documented = set(re.findall(r"^###\s+`([a-z-]+)`", doc, re.M))
+        assert documented == set(analysis.RULES)
+
+
+class TestSubsetRuns:
+    def test_explicit_path_subset_has_no_repo_rule_false_positives(self):
+        """`tools/graftlint.py <one file>` must not report the rest of
+        the repo as missing: repo-scope rules still see the full target
+        set and only subset-local findings are reported (regression —
+        a single-file run used to emit ~70 bogus observability-drift
+        findings)."""
+        target = (
+            core.repo_root()
+            / "pytensor_federated_tpu"
+            / "routing"
+            / "policies.py"
+        )
+        findings = core.run(paths=[target])
+        assert findings == [], "\n" + core.render_human(findings)
+
+
+# -- the gate: the real repo is clean --------------------------------------
+
+
+class TestFullRepo:
+    def test_full_repo_is_clean(self):
+        """The exact check CI runs: every rule over the real package,
+        the C++ node, the bench drivers, and tools."""
+        findings = core.run()
+        assert findings == [], "\n" + core.render_human(findings)

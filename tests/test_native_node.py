@@ -590,3 +590,35 @@ class TestFaultPlanFlag:
         )
         assert out.returncode == 2
         assert "fault-plan" in out.stderr
+
+
+def test_unknown_flag_bits_rejected_loudly(cpp_node):
+    """Regression (graftlint wire-registry): the native node must
+    REJECT a frame carrying an undeclared flag bit — same loud-failure
+    posture as the Python decoders (npwire `_check_flags`)."""
+    import socket as socket_mod
+    import struct as struct_mod
+
+    from pytensor_federated_tpu.service.npwire import (
+        _FLAGS_OFF,
+        decode_arrays,
+        encode_arrays,
+    )
+
+    frame = bytearray(
+        encode_arrays([np.zeros(3, np.float64)])
+    )
+    frame[_FLAGS_OFF] |= 0x10  # undeclared bit 16
+    with socket_mod.create_connection(("127.0.0.1", cpp_node), 5) as s:
+        s.sendall(struct_mod.pack("<I", len(frame)) + bytes(frame))
+        s.settimeout(5)
+        hdr = s.recv(4)
+        assert len(hdr) == 4
+        (n,) = struct_mod.unpack("<I", hdr)
+        buf = b""
+        while len(buf) < n:
+            chunk = s.recv(n - len(buf))
+            assert chunk, "node closed mid-reply"
+            buf += chunk
+    _arrays, _uuid, error = decode_arrays(buf)
+    assert error is not None and "unknown flag" in error

@@ -85,3 +85,43 @@ def test_invalid_utf8_dtype_is_wire_error():
     enc[29] = 0xFE
     with pytest.raises(WireError):
         decode_arrays(bytes(enc))
+
+
+def test_unknown_flag_bits_rejected():
+    """Regression (graftlint wire-registry): a frame carrying a flag
+    bit outside the declared mask must fail LOUDLY — parsing around an
+    unknown block would silently mis-read everything after it (the
+    version-skew hazard the loud-failure contract exists for)."""
+    from pytensor_federated_tpu.service.npwire import (
+        _FLAGS_OFF,
+        decode_arrays,
+        decode_batch,
+        encode_arrays,
+        encode_batch,
+    )
+
+    enc = bytearray(encode_arrays([np.zeros(3, np.float32)]))
+    enc[_FLAGS_OFF] |= 0x10  # undeclared bit 16
+    with pytest.raises(WireError, match="unknown flag bits"):
+        decode_arrays(bytes(enc))
+
+    batch = bytearray(encode_batch([encode_arrays([np.ones(2)])]))
+    batch[_FLAGS_OFF] |= 0x20  # undeclared bit 32 (batch bit stays set)
+    with pytest.raises(WireError, match="unknown flag bits"):
+        decode_batch(bytes(batch))
+
+
+def test_known_flag_combinations_still_decode():
+    """The rejection must not over-reach: every declared flag
+    combination keeps decoding (error + trace on a plain frame)."""
+    from pytensor_federated_tpu.service.npwire import (
+        decode_arrays_ex,
+        encode_arrays,
+    )
+
+    enc = encode_arrays(
+        [np.ones(2)], error="boom", trace_id=b"t" * 16
+    )
+    arrays, _uuid, error, trace_id = decode_arrays_ex(enc)
+    assert error == "boom" and trace_id == b"t" * 16
+    np.testing.assert_array_equal(arrays[0], np.ones(2))

@@ -1,0 +1,31 @@
+"""Run graftlint from a checkout: ``python tools/graftlint.py [...]``.
+
+Thin wrapper over ``python -m pytensor_federated_tpu.analysis`` that
+(1) puts the repo root on ``sys.path`` so it works without an
+installed package, and (2) restricts jax to the CPU backend via the
+environment BEFORE the package import, so a lint run can never dial a
+wedged tunneled-TPU plugin (CLAUDE.md environment pitfalls).  All
+arguments pass through (``--json``, ``--rule``, ``--list-rules``,
+paths).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    sys.path.insert(0, str(REPO))
+    from pytensor_federated_tpu.analysis.__main__ import main as cli
+
+    return cli(sys.argv[1:])
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
