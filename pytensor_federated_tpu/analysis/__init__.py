@@ -12,22 +12,41 @@ a driver (``python -m pytensor_federated_tpu.analysis`` /
 and a nonzero exit on findings — wired in front of the CI test matrix
 so new I/O lanes inherit the invariants automatically.
 
+Since PR 8 the per-function rules share **graftflow**, an
+interprocedural engine: :mod:`.graph` builds one whole-package call
+graph (heuristic method resolution + concurrency-entrypoint
+discovery: ``Thread(target=…)``, ``run_in_executor``, ``create_task``,
+daemon probe loops) and :mod:`.dataflow` propagates contexts along it
+(async-ness, thread/loop/executor membership, held locks).  Findings
+from the graftflow rules carry the propagation chain.
+
 Rule catalog (docs/static-analysis.md maps each rule to the incident
 or invariant that motivated it; the meta-test keeps the two in sync):
 
-- ``async-blocking`` — no blocking calls / sync fault shims inside
-  ``async def`` (:mod:`.rules_async`)
+- ``async-blocking`` — no blocking primitive *reachable* from an async
+  context in service//routing//faultinject/ — transitive over the call
+  graph (:mod:`.rules_async`)
 - ``loop-affinity`` — grpc.aio channels flow through the
   (token,pid,thread,loop)-keyed cache (:mod:`.rules_loop`)
+- ``loop-escape`` — grpc.aio values must not flow into globals,
+  instance attributes, or cross-thread containers
+  (:mod:`.rules_flow`)
+- ``shared-state-lock`` — attributes mutated from >=2 concurrency
+  contexts need a lock on every write path (:mod:`.rules_race`)
+- ``resource-leak`` — no opened-and-dropped sockets/channels/files
+  (:mod:`.rules_resource`)
 - ``wire-registry`` — flag bits and field numbers match
   :mod:`..service.wire_registry` across all three wire
   implementations (:mod:`.rules_wire`)
 - ``wire-loudness`` — WireError propagates; no swallowed decode
   failures (:mod:`.rules_wire`)
 - ``fault-shim-coverage`` — chaos reaches every owned I/O seam
-  (:mod:`.rules_shim`)
+  (:mod:`.rules_shim`; reachability on the shared graph)
 - ``fed-rule-completeness`` — every fed primitive has
   abstract-eval/JVP/transpose/batching rules (:mod:`.rules_fed`)
+- ``fed-placement`` — pool-lane fed.program fixtures must not capture
+  driver-varying operands (jaxpr introspection,
+  :mod:`.rules_fedflow`)
 - ``observability-drift`` — metric families and flightrec events match
   docs/observability.md both ways (:mod:`.rules_obs`)
 """
@@ -35,12 +54,14 @@ or invariant that motivated it; the meta-test keeps the two in sync):
 from .core import (
     Finding,
     RULES,
+    RepoContext,
     Rule,
     SourceFile,
     default_targets,
     load_sources,
     render_human,
     render_json,
+    render_sarif,
     repo_root,
     rule,
     run,
@@ -49,20 +70,26 @@ from .core import (
 # Importing the rules modules registers them into RULES.
 from . import rules_async  # noqa: F401
 from . import rules_fed  # noqa: F401
+from . import rules_fedflow  # noqa: F401
+from . import rules_flow  # noqa: F401
 from . import rules_loop  # noqa: F401
 from . import rules_obs  # noqa: F401
+from . import rules_race  # noqa: F401
+from . import rules_resource  # noqa: F401
 from . import rules_shim  # noqa: F401
 from . import rules_wire  # noqa: F401
 
 __all__ = [
     "Finding",
     "RULES",
+    "RepoContext",
     "Rule",
     "SourceFile",
     "default_targets",
     "load_sources",
     "render_human",
     "render_json",
+    "render_sarif",
     "repo_root",
     "rule",
     "run",

@@ -1,32 +1,80 @@
 """graftlint CLI: ``python -m pytensor_federated_tpu.analysis``.
 
 Exit status 0 = clean, 1 = findings, 2 = usage error.  ``--json``
-emits a machine-readable report (CI annotation lane); default output
-is one ``path:line: [rule] message`` per finding.
+emits the machine-readable report (schema documented and pinned in
+docs/static-analysis.md / tests/test_graftlint.py); ``--sarif`` emits
+SARIF 2.1.0 for the CI ``upload-sarif`` annotation lane; default
+output is one ``path:line: [rule] message`` per finding (graftflow
+findings append their propagation chain).  ``--changed-only`` scopes
+file rules to the files git reports as changed against HEAD (repo
+rules still see the full target set; only subset findings are
+reported).  A one-line timing summary always goes to stderr, so both
+JSON lanes stay pure on stdout.
 """
 
 from __future__ import annotations
 
 import argparse
+import subprocess
 import sys
 from pathlib import Path
-from typing import List, Optional
+from typing import Dict, List, Optional
+
+
+def _changed_paths(root: Path) -> List[Path]:
+    """Files changed vs HEAD (worktree + index) plus untracked — the
+    pre-commit iteration loop's target set."""
+    out: List[Path] = []
+    seen = set()
+    for args in (
+        ["git", "-C", str(root), "diff", "--name-only", "HEAD"],
+        [
+            "git",
+            "-C",
+            str(root),
+            "ls-files",
+            "--others",
+            "--exclude-standard",
+        ],
+    ):
+        try:
+            text = subprocess.run(
+                args, capture_output=True, text=True, check=True
+            ).stdout
+        except (OSError, subprocess.CalledProcessError) as e:
+            print(f"graftlint: --changed-only needs git ({e})", file=sys.stderr)
+            raise SystemExit(2)
+        for line in text.splitlines():
+            p = (root / line.strip()).resolve()
+            if line.strip() and p not in seen and p.exists():
+                seen.add(p)
+                out.append(p)
+    return out
 
 
 def main(argv: Optional[List[str]] = None) -> int:
     # Lint runs are CPU-only by definition: never let the fed
-    # introspection rule (or the package import above it) dial the
+    # introspection rules (or the package import above them) dial the
     # tunneled TPU plugin (CLAUDE.md environment pitfalls).
     from ..utils import force_cpu_backend
 
     force_cpu_backend()
 
-    from . import RULES, default_targets, render_human, render_json, run
+    from . import (
+        RULES,
+        default_targets,
+        render_human,
+        render_json,
+        render_sarif,
+        repo_root,
+        run,
+    )
 
     parser = argparse.ArgumentParser(
         prog="python -m pytensor_federated_tpu.analysis",
         description="graftlint: the repo's design invariants as "
-        "machine-checked static-analysis rules",
+        "machine-checked static-analysis rules (graftflow engine: "
+        "interprocedural dataflow over the async/thread/loop seams)",
     )
     parser.add_argument(
         "paths",
@@ -46,6 +94,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--json", action="store_true", help="machine-readable output"
     )
     parser.add_argument(
+        "--sarif",
+        action="store_true",
+        help="SARIF 2.1.0 output (CI inline-annotation lane)",
+    )
+    parser.add_argument(
+        "--changed-only",
+        action="store_true",
+        help="check only files changed vs HEAD (git-scoped subset run)",
+    )
+    parser.add_argument(
         "--list-rules", action="store_true", help="print the rule catalog"
     )
     args = parser.parse_args(argv)
@@ -56,6 +114,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"{name} [{r.scope}]: {r.summary}")
         return 0
 
+    if args.json and args.sarif:
+        print("pick one of --json / --sarif", file=sys.stderr)
+        return 2
+
     unknown = [n for n in (args.rules or []) if n not in RULES]
     if unknown:
         print(
@@ -65,9 +127,43 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
         return 2
 
-    paths = [p.resolve() for p in args.paths] or default_targets()
-    findings = run(rules=args.rules, paths=paths)
-    print(render_json(findings) if args.json else render_human(findings))
+    paths = [p.resolve() for p in args.paths]
+    if args.changed_only:
+        if paths:
+            print(
+                "--changed-only and explicit paths are exclusive",
+                file=sys.stderr,
+            )
+            return 2
+        targets = set(default_targets())
+        paths = [p for p in _changed_paths(repo_root()) if p in targets]
+        if not paths:
+            print(
+                "graftlint: no changed target files — clean by vacuity",
+                file=sys.stderr,
+            )
+            print(
+                render_json([])
+                if args.json
+                else render_sarif([])
+                if args.sarif
+                else "graftlint: clean (0 findings)"
+            )
+            return 0
+
+    stats: Dict[str, float] = {}
+    findings = run(rules=args.rules, paths=paths or None, stats=stats)
+    if args.sarif:
+        print(render_sarif(findings))
+    elif args.json:
+        print(render_json(findings))
+    else:
+        print(render_human(findings))
+    print(
+        "graftlint: {rules:.0f} rule(s) over {files:.0f} file(s) "
+        "in {seconds:.2f}s".format(**stats),
+        file=sys.stderr,
+    )
     return 1 if findings else 0
 
 

@@ -38,13 +38,28 @@ from __future__ import annotations
 import ast
 import json
 import re
+import time
 from dataclasses import dataclass, field
 from pathlib import Path
-from typing import Callable, Dict, Iterable, List, Optional, Sequence
+from typing import (
+    TYPE_CHECKING,
+    Callable,
+    Dict,
+    Iterable,
+    List,
+    Optional,
+    Sequence,
+    Tuple,
+    Type,
+)
+
+if TYPE_CHECKING:  # graph imports core; the cycle exists only for types
+    from .graph import CallGraph
 
 __all__ = [
     "Finding",
     "SourceFile",
+    "RepoContext",
     "Rule",
     "RULES",
     "rule",
@@ -54,6 +69,7 @@ __all__ = [
     "run",
     "render_human",
     "render_json",
+    "render_sarif",
 ]
 
 #: ``# graftlint: disable=rule-a,rule-b [-- justification]`` (also
@@ -65,12 +81,16 @@ _SUPPRESS_RE = re.compile(
 
 @dataclass(frozen=True)
 class Finding:
-    """One rule violation at one source location."""
+    """One rule violation at one source location.  ``chain`` is the
+    interprocedural propagation path (root context first) for graftflow
+    rules; per-function rules leave it empty.  The JSON/SARIF schema
+    always carries the key (pinned by tests/test_graftlint.py)."""
 
     rule: str
     path: str  # repo-relative, posix separators
     line: int
     message: str
+    chain: Tuple[str, ...] = ()
 
     def to_dict(self) -> dict:
         return {
@@ -78,16 +98,21 @@ class Finding:
             "path": self.path,
             "line": self.line,
             "message": self.message,
+            "chain": list(self.chain),
         }
 
     def __str__(self) -> str:
-        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+        base = f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+        if self.chain:
+            hops = "\n".join(f"    {i}. {hop}" for i, hop in enumerate(self.chain))
+            base = f"{base}\n{hops}"
+        return base
 
 
 class SourceFile:
     """One target file: text, line-indexed suppressions, lazy AST."""
 
-    def __init__(self, path: Path, root: Path):
+    def __init__(self, path: Path, root: Path) -> None:
         self.path = path
         self.root = root
         self.rel = path.relative_to(root).as_posix()
@@ -95,6 +120,7 @@ class SourceFile:
         self.lines = self.text.splitlines()
         self.is_python = path.suffix == ".py"
         self._tree: Optional[ast.Module] = None
+        self._walked: Optional[List[ast.AST]] = None
         # line number (1-based) -> set of rule names disabled there
         self.suppressions: Dict[int, set] = {}
         for i, line in enumerate(self.lines, start=1):
@@ -117,6 +143,18 @@ class SourceFile:
             self._tree = ast.parse(self.text, filename=self.rel)
         return self._tree
 
+    def walk(self) -> List[ast.AST]:
+        """The full node walk, computed ONCE and shared by every rule
+        (the single-pass contract: core parses and walks each file one
+        time per run; rules filter with :meth:`nodes`)."""
+        if self._walked is None:
+            self._walked = list(ast.walk(self.tree))
+        return self._walked
+
+    def nodes(self, *types: Type[ast.AST]) -> List[ast.AST]:
+        """All AST nodes of the given types, from the shared walk."""
+        return [n for n in self.walk() if isinstance(n, types)]
+
     def suppressed(self, rule_name: str, line: int) -> bool:
         """Whether ``rule_name`` is disabled on ``line`` (same line or
         the line directly above)."""
@@ -130,10 +168,39 @@ class SourceFile:
         return Finding(rule_name, self.rel, line, message)
 
 
+class RepoContext(List[SourceFile]):
+    """What a repo-scope rule receives: the full source list (it IS a
+    list, so pre-graftflow rules iterate it unchanged) plus the shared
+    analysis state — the call graph is built lazily ONCE per run and
+    reused by every interprocedural rule, so all rules agree on one
+    call-graph semantics."""
+
+    def __init__(self, sources: Iterable[SourceFile]) -> None:
+        super().__init__(sources)
+        self._graph: Optional["CallGraph"] = None
+        self._by_rel: Optional[Dict[str, SourceFile]] = None
+
+    @property
+    def by_rel(self) -> Dict[str, SourceFile]:
+        if self._by_rel is None:
+            self._by_rel = {s.rel: s for s in self}
+        return self._by_rel
+
+    @property
+    def graph(self) -> "CallGraph":  # late import: graph imports core
+        if self._graph is None:
+            from .graph import build_graph
+
+            self._graph = build_graph(self)
+        return self._graph
+
+
 @dataclass(frozen=True)
 class Rule:
     """A registered checker.  ``func`` yields/returns Findings; file
-    rules receive one :class:`SourceFile`, repo rules the full list."""
+    rules receive one :class:`SourceFile`, repo rules a
+    :class:`RepoContext` (a list of every SourceFile, carrying the
+    shared call graph)."""
 
     name: str
     summary: str
@@ -146,7 +213,9 @@ class Rule:
 RULES: Dict[str, Rule] = {}
 
 
-def rule(name: str, summary: str, scope: str = "file"):
+def rule(
+    name: str, summary: str, scope: str = "file"
+) -> Callable[[Callable], Callable]:
     """Register a checker under ``name`` (kebab-case, the suppression
     and CLI handle)."""
     if scope not in ("file", "repo"):
@@ -201,6 +270,7 @@ def run(
     rules: Optional[Sequence[str]] = None,
     paths: Optional[Iterable[Path]] = None,
     root: Optional[Path] = None,
+    stats: Optional[Dict[str, float]] = None,
 ) -> List[Finding]:
     """Run the selected rules (default: all registered) over ``paths``
     (default: the full-repo target set); returns unsuppressed findings
@@ -208,19 +278,43 @@ def run(
 
     Explicit ``paths`` select a SUBSET: file rules run over just those
     files, while repo-scope rules (cross-file registries, code-vs-docs
-    diffs) still see the full target set — comparing the docs against
-    three files would report everything else as missing — and only
-    their findings that land inside the subset are reported."""
+    diffs, the call graph) still see the full target set — comparing
+    the docs against three files would report everything else as
+    missing — and only their findings that land inside the subset are
+    reported.
+
+    Single-pass contract: every file is read and parsed ONCE per run —
+    subset sources are reused inside the full repo set, the AST walk is
+    cached on the SourceFile, and the call graph is built once on the
+    shared :class:`RepoContext`.  ``stats``, when given, receives
+    ``files``/``rules``/``seconds`` for the driver's timing line."""
+    t0 = time.perf_counter()
     root = root or repo_root()
-    sources = load_sources(paths or default_targets(root), root)
-    by_rel = {s.rel: s for s in sources}
     if paths is None:
+        sources = load_sources(default_targets(root), root)
         subset_rels = None
-        repo_sources = sources
+        repo_sources = RepoContext(sources)
+        by_rel = repo_sources.by_rel
     else:
-        subset_rels = set(by_rel)
-        repo_sources = load_sources(default_targets(root), root)
-        by_rel.update({s.rel: s for s in repo_sources})
+        sources = load_sources(paths, root)
+        subset_rels = {s.rel for s in sources}
+        # Reuse the subset's SourceFile objects (and their cached
+        # trees) inside the full repo set: one parse per file per run.
+        subset_by_rel = {s.rel: s for s in sources}
+        repo_sources = RepoContext(
+            subset_by_rel.get(s.rel, s)
+            for s in load_sources(
+                [
+                    p
+                    for p in default_targets(root)
+                    if Path(p).relative_to(root).as_posix()
+                    not in subset_by_rel
+                ],
+                root,
+            )
+        )
+        repo_sources.extend(sources)
+        by_rel = repo_sources.by_rel
     selected = [RULES[n] for n in (rules or sorted(RULES))]
     findings: List[Finding] = []
     for r in selected:
@@ -238,6 +332,10 @@ def run(
             continue
         kept.append(f)
     kept.sort(key=lambda f: (f.path, f.line, f.rule))
+    if stats is not None:
+        stats["files"] = float(len(repo_sources))
+        stats["rules"] = float(len(selected))
+        stats["seconds"] = time.perf_counter() - t0
     return kept
 
 
@@ -257,3 +355,71 @@ def render_json(findings: Sequence[Finding]) -> str:
         },
         indent=2,
     )
+
+
+def render_sarif(findings: Sequence[Finding]) -> str:
+    """SARIF 2.1.0 for the CI annotation lane
+    (``github/codeql-action/upload-sarif`` renders results as inline
+    PR comments).  One result per finding; the propagation chain rides
+    both the message text and ``relatedLocations`` — codeFlows would
+    need per-hop file/line pairs the chain strings only carry
+    textually."""
+    rules_meta = [
+        {
+            "id": name,
+            "shortDescription": {"text": RULES[name].summary},
+            "helpUri": (
+                "https://github.com/pytensor-federated-tpu/"
+                "pytensor-federated-tpu/blob/main/docs/static-analysis.md"
+            ),
+        }
+        for name in sorted(RULES)
+    ]
+    results = []
+    for f in findings:
+        text = f.message
+        if f.chain:
+            text += "\n\ncall chain:\n" + "\n".join(
+                f"  {i}. {hop}" for i, hop in enumerate(f.chain)
+            )
+        results.append(
+            {
+                "ruleId": f.rule,
+                "level": "error",
+                "message": {"text": text},
+                "locations": [
+                    {
+                        "physicalLocation": {
+                            "artifactLocation": {
+                                "uri": f.path,
+                                "uriBaseId": "%SRCROOT%",
+                            },
+                            "region": {"startLine": max(f.line, 1)},
+                        }
+                    }
+                ],
+            }
+        )
+    doc = {
+        "$schema": (
+            "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+            "master/Schemata/sarif-schema-2.1.0.json"
+        ),
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "graftlint",
+                        "informationUri": (
+                            "https://github.com/pytensor-federated-tpu/"
+                            "pytensor-federated-tpu"
+                        ),
+                        "rules": rules_meta,
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }
+    return json.dumps(doc, indent=2)

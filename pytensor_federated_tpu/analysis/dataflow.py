@@ -1,0 +1,355 @@
+"""graftflow context propagation along the call graph.
+
+The :mod:`.graph` half answers "who calls whom"; this module answers
+"in what context does a function run" — the property the transitive
+rules check:
+
+- :func:`async_reachable` — every function reachable from an async
+  context (async defs + ``create_task`` targets) through plain call
+  edges.  A sync function called from a coroutine still runs ON the
+  event loop; the only escapes are the spawn seams
+  (``run_in_executor`` / ``Thread(target=...)`` / ``submit``), which
+  produce entrypoints, not call edges — so reachability here is
+  exactly "code whose blocking blocks a loop", with the edge chain
+  preserved for the finding.
+- :func:`concurrency_contexts` — the context sets the race rule
+  compares: ``loop`` (async defs + task targets and everything they
+  call), ``thread:<target>`` per Thread entrypoint, ``executor`` for
+  executor/submit targets, each propagated along call edges.  A
+  function reachable from two contexts runs in both — that is the
+  point, not a conflict.
+- :func:`lock_regions` / :func:`WriteSite` — which attribute/global
+  mutations happen under which inferred locks.  Lock inference is
+  textual-by-design: a ``with`` item whose expression mentions a name
+  containing ``lock`` (``self._lock``, ``_mon_lock``, …) counts; a
+  bare blocking ``lock.acquire()`` does not create a region (the
+  async-blocking rule flags those separately).  One interprocedural
+  refinement: a function whose EVERY in-package caller calls it from
+  inside a lock region is itself treated as lock-held (fixpoint), so
+  ``with self._lock: self._refresh()`` covers the helper's writes.
+
+Limits (docs/static-analysis.md "Engine"): contexts flow only along
+resolved edges — an unresolved indirection (callbacks in data
+structures, ``getattr`` dispatch) drops the chain, which makes these
+rules under-approximate, never spuriously precise.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from .graph import CallEdge, CallGraph, own_body
+
+__all__ = [
+    "WriteSite",
+    "async_reachable",
+    "concurrency_contexts",
+    "context_chains",
+    "lock_held_functions",
+    "mutation_sites",
+]
+
+_LOCKISH = re.compile(r"lock", re.IGNORECASE)
+
+#: Mutator method names that count as writes to the receiver —
+#: registries mutate dicts/deques through these, not assignments.
+_MUTATOR_METHODS: FrozenSet[str] = frozenset(
+    {
+        "append",
+        "appendleft",
+        "add",
+        "clear",
+        "discard",
+        "extend",
+        "insert",
+        "pop",
+        "popleft",
+        "popitem",
+        "remove",
+        "setdefault",
+        "update",
+    }
+)
+
+
+def async_reachable(
+    graph: CallGraph, root_prefixes: Sequence[str]
+) -> Dict[str, Tuple[CallEdge, ...]]:
+    """Functions that run on an event loop: every ``async def`` whose
+    file matches ``root_prefixes`` plus every ``create_task`` /
+    ``ensure_future`` target, and everything transitively called from
+    them.  Returns qname -> the call chain from its nearest root."""
+    roots = list(graph.async_defs(root_prefixes))
+    roots += [
+        e.target
+        for e in graph.entrypoints
+        if e.kind == "task" and e.rel.startswith(tuple(root_prefixes))
+    ]
+    return graph.reachable_from(roots)
+
+
+def concurrency_contexts(graph: CallGraph) -> Dict[str, Set[str]]:
+    """qname -> the set of concurrency contexts the function can run
+    in: ``"loop"``, ``"thread:<target bare name>"``, ``"executor"``.
+    Purely-main-thread code gets an empty set."""
+    contexts: Dict[str, Set[str]] = {}
+
+    def paint(roots: List[str], label: str) -> None:
+        for qname in graph.reachable_from(roots):
+            contexts.setdefault(qname, set()).add(label)
+
+    paint(graph.async_defs(), "loop")
+    paint(
+        [e.target for e in graph.entrypoints if e.kind == "task"], "loop"
+    )
+    for entry in graph.entrypoints:
+        if entry.kind == "thread":
+            target = graph.functions.get(entry.target)
+            name = target.name if target is not None else entry.target
+            paint([entry.target], f"thread:{name}")
+        elif entry.kind == "executor":
+            paint([entry.target], "executor")
+    return contexts
+
+
+def context_chains(
+    graph: CallGraph,
+) -> Dict[str, Dict[str, Tuple[str, Tuple[CallEdge, ...]]]]:
+    """Like :func:`concurrency_contexts` but keeping, per (function,
+    context), one (root, edge chain) witness — the provenance the race
+    rule prints."""
+    witness: Dict[str, Dict[str, Tuple[str, Tuple[CallEdge, ...]]]] = {}
+
+    def paint(roots: List[str], label: str) -> None:
+        for qname, chain in graph.reachable_from(roots).items():
+            per = witness.setdefault(qname, {})
+            if label not in per:
+                root = chain[0].caller if chain else qname
+                per[label] = (root, chain)
+
+    paint(
+        graph.async_defs()
+        + [e.target for e in graph.entrypoints if e.kind == "task"],
+        "loop",
+    )
+    for entry in graph.entrypoints:
+        if entry.kind == "thread":
+            target = graph.functions.get(entry.target)
+            name = target.name if target is not None else entry.target
+            paint([entry.target], f"thread:{name}")
+        elif entry.kind == "executor":
+            paint([entry.target], "executor")
+    return witness
+
+
+@dataclass(frozen=True)
+class WriteSite:
+    """One mutation of shared state."""
+
+    qname: str  # enclosing function
+    rel: str
+    lineno: int
+    target: str  # "self.<attr>" or "<module global>"
+    attr: str  # bare attribute / global name
+    is_self: bool
+    locked: bool  # lexically under a with-lock region
+    via: str  # "assign" | "augassign" | "subscript" | "del" | mutator name
+
+
+def _with_lock_spans(fn: ast.AST) -> List[Tuple[int, int]]:
+    """(start, end) line spans of ``with <something lock-ish>:``
+    bodies inside ``fn`` (nested defs excluded — their regions belong
+    to them; shared own-body walk from :mod:`.graph`)."""
+    spans: List[Tuple[int, int]] = []
+    for node in own_body(fn):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                try:
+                    text = ast.unparse(item.context_expr)
+                except Exception:  # pragma: no cover
+                    text = ""
+                if _LOCKISH.search(text):
+                    spans.append(
+                        (node.lineno, int(getattr(node, "end_lineno", node.lineno)))
+                    )
+                    break
+    return spans
+
+
+def _module_globals(tree: ast.Module) -> Set[str]:
+    out: Set[str] = set()
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign):
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name):
+                    out.add(tgt.id)
+        elif isinstance(stmt, ast.AnnAssign) and isinstance(
+            stmt.target, ast.Name
+        ):
+            out.add(stmt.target.id)
+    return out
+
+
+def _self_attr(node: ast.expr) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def mutation_sites(
+    graph: CallGraph, tree: ast.Module, rel: str
+) -> List[WriteSite]:
+    """Every shared-state mutation in module ``rel``:
+
+    - ``self.x = …`` / ``self.x += …`` / ``self.x[k] = …`` /
+      ``del self.x[k]`` in methods (``__init__``/``__post_init__``
+      excluded: construction precedes sharing);
+    - stores to declared module globals (``global x; x = …``) and
+      subscript stores / mutator-method calls on module-global
+      containers (the registry pattern: ``_pinned[sid] = ev``,
+      ``_ring.append(…)``).
+    """
+    module_globals = _module_globals(tree)
+    sites: List[WriteSite] = []
+    for fn in [
+        f for f in graph.functions.values() if f.rel == rel
+    ]:
+        if fn.name in ("__init__", "__post_init__", "__new__"):
+            continue
+        spans = _with_lock_spans(fn.node)
+
+        def locked(lineno: int) -> bool:
+            return any(lo <= lineno <= hi for lo, hi in spans)
+
+        declared_global: Set[str] = set()
+        body_nodes = own_body(fn.node)
+        for node in body_nodes:
+            if isinstance(node, ast.Global):
+                declared_global.update(node.names)
+
+        def record(
+            lineno: int, attr: str, is_self: bool, via: str
+        ) -> None:
+            sites.append(
+                WriteSite(
+                    qname=fn.qname,
+                    rel=rel,
+                    lineno=lineno,
+                    target=f"self.{attr}" if is_self else attr,
+                    attr=attr,
+                    is_self=is_self,
+                    locked=locked(lineno),
+                    via=via,
+                )
+            )
+
+        for node in body_nodes:
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                via = (
+                    "augassign"
+                    if isinstance(node, ast.AugAssign)
+                    else "assign"
+                )
+                for tgt in targets:
+                    attr = _self_attr(tgt)
+                    if attr is not None:
+                        record(node.lineno, attr, True, via)
+                        continue
+                    if isinstance(tgt, ast.Name) and (
+                        tgt.id in declared_global
+                        and tgt.id in module_globals
+                    ):
+                        record(node.lineno, tgt.id, False, via)
+                        continue
+                    if isinstance(tgt, ast.Subscript):
+                        attr = _self_attr(tgt.value)
+                        if attr is not None:
+                            record(node.lineno, attr, True, "subscript")
+                        elif (
+                            isinstance(tgt.value, ast.Name)
+                            and tgt.value.id in module_globals
+                        ):
+                            record(
+                                node.lineno, tgt.value.id, False, "subscript"
+                            )
+            elif isinstance(node, ast.Delete):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Subscript):
+                        attr = _self_attr(tgt.value)
+                        if attr is not None:
+                            record(node.lineno, attr, True, "del")
+                        elif (
+                            isinstance(tgt.value, ast.Name)
+                            and tgt.value.id in module_globals
+                        ):
+                            record(node.lineno, tgt.value.id, False, "del")
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _MUTATOR_METHODS
+            ):
+                attr = _self_attr(node.func.value)
+                if attr is not None:
+                    record(node.lineno, attr, True, node.func.attr)
+                elif (
+                    isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in module_globals
+                ):
+                    record(
+                        node.lineno,
+                        node.func.value.id,
+                        False,
+                        node.func.attr,
+                    )
+    return sites
+
+
+def lock_held_functions(graph: CallGraph) -> Set[str]:
+    """Functions whose every in-package call site sits inside a caller
+    lock region (or inside another wholly lock-held function) — the
+    ``with self._lock: self._helper()`` pattern.  Fixpoint over the
+    call graph; functions with no in-package callers are NOT lock-held
+    (an entrypoint can reach them bare)."""
+    span_cache: Dict[str, List[Tuple[int, int]]] = {}
+
+    def spans_of(qname: str) -> List[Tuple[int, int]]:
+        if qname not in span_cache:
+            span_cache[qname] = _with_lock_spans(graph.functions[qname].node)
+        return span_cache[qname]
+
+    held: Set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for qname, fn in graph.functions.items():
+            if qname in held:
+                continue
+            callers = graph.callers_of(qname)
+            if not callers:
+                continue
+            ok = True
+            for edge in callers:
+                caller_held = edge.caller in held
+                under_with = any(
+                    lo <= edge.lineno <= hi
+                    for lo, hi in spans_of(edge.caller)
+                )
+                if not (caller_held or under_with):
+                    ok = False
+                    break
+            if ok:
+                held.add(qname)
+                changed = True
+    return held

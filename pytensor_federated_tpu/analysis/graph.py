@@ -1,0 +1,721 @@
+"""graftflow call graph: whole-package edges + concurrency entrypoints.
+
+The interprocedural half of the analysis engine (the intraprocedural
+context propagation lives in :mod:`.dataflow`).  One
+:class:`CallGraph` is built per lint run from the already-parsed
+:class:`~.core.SourceFile` set and shared by every graftflow rule, so
+all rules agree on a single call-graph semantics — the PR-7 rules each
+carried a private hand-rolled reachability and could (and did)
+disagree about what "reachable" meant.
+
+Resolution is HEURISTIC, tuned for a linter (prefer a useful edge over
+a provable one, but never guess into noise):
+
+- ``f(...)`` — enclosing function's nested defs, then module-level
+  functions, then ``from .mod import f`` symbol imports.
+- ``self.m(...)`` / ``cls.m(...)`` — the enclosing class, then its
+  in-package bases (one level of name resolution per base).
+- ``mod.f(...)`` where ``mod`` is an imported package module — that
+  module's ``f``.
+- ``obj.m(...)`` on an arbitrary value — resolved only when the
+  package defines exactly ONE function/method named ``m`` and the name
+  is not in :data:`AMBIENT_METHOD_NAMES` (``close``, ``get``, ``run``,
+  … — names shared with stdlib objects, where a unique in-package
+  match is usually coincidence).  These edges carry ``kind="unique"``
+  so rules can weigh them.
+- ``SomeClass(...)`` — an edge to ``SomeClass.__init__`` when the
+  class is defined in the package.
+
+Unresolvable calls produce no edge: graftflow can report false
+negatives through an unresolved indirection (documented in
+docs/static-analysis.md "limits"), never a false path.
+
+Concurrency entrypoints are discovered while the edges are built:
+``threading.Thread(target=f)``, ``loop.run_in_executor(None, f)`` /
+``executor.submit(f)``, ``asyncio.create_task(coro())`` /
+``ensure_future`` / ``loop.create_task``, and
+``asyncio.run(...)`` / ``run_until_complete(...)`` loop roots — the
+seams the transitive rules root their contexts at.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .core import SourceFile
+
+__all__ = [
+    "AMBIENT_METHOD_NAMES",
+    "CallEdge",
+    "CallGraph",
+    "Entrypoint",
+    "FuncNode",
+    "build_graph",
+    "own_body",
+]
+
+
+def own_body(fn: ast.AST) -> List[ast.AST]:
+    """A function's OWN statements: the subtree minus nested
+    defs/lambdas (they are their own call-graph nodes / opaque values,
+    analyzed only when actually reached).  The one body-walk every
+    graftflow rule shares, so "own" means the same thing everywhere."""
+    out: List[ast.AST] = []
+    stack: List[ast.AST] = list(fn.body)  # type: ignore[attr-defined]
+    while stack:
+        node = stack.pop()
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        out.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+_PKG = "pytensor_federated_tpu"
+
+#: Method names too generic for the unique-bare-name fallback: the
+#: package defining a single ``close`` does not make ``sock.close()``
+#: a call to it.
+AMBIENT_METHOD_NAMES: FrozenSet[str] = frozenset(
+    {
+        "acquire",
+        "add",
+        "append",
+        "cancel",
+        "clear",
+        "close",
+        "copy",
+        "count",
+        "decode",
+        "discard",
+        "done",
+        "encode",
+        "extend",
+        "get",
+        "items",
+        "join",
+        "keys",
+        "pop",
+        "put",
+        "read",
+        "recv",
+        "release",
+        "remove",
+        "result",
+        "run",
+        "send",
+        "set",
+        "shutdown",
+        "start",
+        "stop",
+        "submit",
+        "update",
+        "values",
+        "wait",
+        "write",
+    }
+)
+
+#: Call-wrapper names whose first function-valued argument runs in a
+#: NEW concurrency context rather than inline (no plain call edge).
+_EXECUTOR_METHODS = frozenset({"run_in_executor"})
+_SUBMIT_METHODS = frozenset({"submit"})
+_TASK_METHODS = frozenset({"create_task", "ensure_future"})
+_LOOP_ROOT_METHODS = frozenset({"run_until_complete"})
+
+
+@dataclass(frozen=True)
+class FuncNode:
+    """One function/method definition in the package."""
+
+    qname: str  # "<rel>::<Dotted.Path>" — unique per definition
+    rel: str
+    name: str  # bare name
+    cls: Optional[str]  # immediate enclosing class, if any
+    is_async: bool
+    lineno: int
+    end_lineno: int
+    node: ast.AST = field(compare=False, repr=False)
+    #: bare identifiers loaded anywhere in the body (full subtree,
+    #: nested defs included) — cheap fuel for marker checks
+    #: (e.g. "does this function reference ``_fi``").
+    refs: FrozenSet[str] = field(compare=False, default=frozenset())
+    #: bare names of every call in the body (``f(...)`` -> ``f``,
+    #: ``x.m(...)`` -> ``m``; full subtree) — the name-level call
+    #: relation rules_shim's conservative reachability runs on, where
+    #: an unresolvable ``obj.m()`` must still count as possibly
+    #: calling any same-module ``m``.
+    called_names: FrozenSet[str] = field(compare=False, default=frozenset())
+
+    @property
+    def display(self) -> str:
+        kind = "async def" if self.is_async else "def"
+        short = self.qname.split("::", 1)[1]
+        return f"{kind} {short} ({self.rel}:{self.lineno})"
+
+
+@dataclass(frozen=True)
+class CallEdge:
+    """caller --(callsite line)--> callee.  ``kind`` records how the
+    callee was resolved: "local" (nested def), "module", "self",
+    "import", "class" (constructor), "unique" (package-wide bare-name
+    heuristic)."""
+
+    caller: str
+    callee: str
+    lineno: int
+    kind: str
+
+
+@dataclass(frozen=True)
+class Entrypoint:
+    """A discovered concurrency seam: ``target`` (a FuncNode qname)
+    starts executing in a new context of ``kind`` ("thread",
+    "executor", "task", "loop_root")."""
+
+    kind: str
+    target: str
+    rel: str
+    lineno: int
+    #: the spawning function's qname (None at module level)
+    spawner: Optional[str]
+    #: thread name= literal when one was given (daemon probe loops
+    #: carry their names; useful in findings)
+    label: Optional[str] = None
+
+
+class _ModuleIndex:
+    """Per-module symbol tables used during resolution."""
+
+    def __init__(self, rel: str) -> None:
+        self.rel = rel
+        # bare name -> qname of a module-level function
+        self.functions: Dict[str, str] = {}
+        # class name -> {method name -> qname}
+        self.classes: Dict[str, Dict[str, str]] = {}
+        # class name -> base-class name expressions (unparsed)
+        self.bases: Dict[str, List[str]] = {}
+        # import alias -> ("module", rel) | ("symbol", rel, name)
+        self.imports: Dict[str, Tuple[str, ...]] = {}
+
+
+def _module_name(rel: str) -> str:
+    mod = rel[: -len(".py")] if rel.endswith(".py") else rel
+    if mod.endswith("/__init__"):
+        mod = mod[: -len("/__init__")]
+    return mod.replace("/", ".")
+
+
+def _rel_for_module(dotted: str, known: Set[str]) -> Optional[str]:
+    for cand in (
+        dotted.replace(".", "/") + ".py",
+        dotted.replace(".", "/") + "/__init__.py",
+    ):
+        if cand in known:
+            return cand
+    return None
+
+
+class CallGraph:
+    """The package call graph + entrypoints.  Build with
+    :func:`build_graph`; one instance is shared per lint run
+    (``RepoContext.graph``)."""
+
+    def __init__(self) -> None:
+        self.functions: Dict[str, FuncNode] = {}
+        self.edges: Dict[str, List[CallEdge]] = {}
+        self.in_edges: Dict[str, List[CallEdge]] = {}
+        self.entrypoints: List[Entrypoint] = []
+        # (rel, bare name) -> [qnames]; bare name -> [qnames]
+        self._by_module_name: Dict[Tuple[str, str], List[str]] = {}
+        self._by_bare_name: Dict[str, List[str]] = {}
+
+    # -- queries ----------------------------------------------------------
+
+    def node(self, qname: str) -> FuncNode:
+        return self.functions[qname]
+
+    def callees_of(self, qname: str) -> List[CallEdge]:
+        return self.edges.get(qname, [])
+
+    def callers_of(self, qname: str) -> List[CallEdge]:
+        return self.in_edges.get(qname, [])
+
+    def by_name(self, rel: str, bare: str) -> List[str]:
+        """qnames of every function named ``bare`` in module ``rel``."""
+        return self._by_module_name.get((rel, bare), [])
+
+    def named(self, bare: str) -> List[str]:
+        """qnames of every function named ``bare`` package-wide."""
+        return self._by_bare_name.get(bare, [])
+
+    def async_defs(self, rel_prefixes: Sequence[str] = ()) -> List[str]:
+        return [
+            q
+            for q, f in self.functions.items()
+            if f.is_async
+            and (not rel_prefixes or f.rel.startswith(tuple(rel_prefixes)))
+        ]
+
+    def reachable_from(
+        self,
+        roots: Iterable[str],
+        *,
+        same_module: bool = False,
+        follow_kinds: Optional[FrozenSet[str]] = None,
+    ) -> Dict[str, Tuple[CallEdge, ...]]:
+        """BFS over call edges from ``roots``; returns, for every
+        reached function (roots included), the edge chain that reached
+        it — the propagation path findings print.  True breadth-first
+        (deque, not a stack): the stored chain is a SHORTEST path from
+        the nearest root, so "reachable in N call(s)" in a finding is
+        the tightest claim, not an arbitrary walk.  ``same_module``
+        restricts edges to the root's file (the rules_shim semantics);
+        ``follow_kinds`` filters edge resolution kinds."""
+        from collections import deque
+
+        chains: Dict[str, Tuple[CallEdge, ...]] = {}
+        frontier: deque = deque()
+        for root in roots:
+            if root in self.functions and root not in chains:
+                chains[root] = ()
+                frontier.append(root)
+        while frontier:
+            qname = frontier.popleft()
+            chain = chains[qname]
+            for edge in self.edges.get(qname, ()):
+                if edge.callee in chains:
+                    continue
+                if follow_kinds is not None and edge.kind not in follow_kinds:
+                    continue
+                if (
+                    same_module
+                    and self.functions[edge.callee].rel
+                    != self.functions[qname].rel
+                ):
+                    continue
+                chains[edge.callee] = chain + (edge,)
+                frontier.append(edge.callee)
+        return chains
+
+    def enclosing(self, rel: str, lineno: int) -> Optional[FuncNode]:
+        """The innermost function containing ``lineno`` in ``rel``."""
+        best: Optional[FuncNode] = None
+        for f in self.functions.values():
+            if f.rel != rel or not (f.lineno <= lineno <= f.end_lineno):
+                continue
+            if best is None or f.lineno >= best.lineno:
+                best = f
+        return best
+
+    def render_chain(self, chain: Sequence[CallEdge]) -> Tuple[str, ...]:
+        """Human chain hops for a Finding: root first, callsite lines
+        attached to each jump."""
+        if not chain:
+            return ()
+        hops = [self.functions[chain[0].caller].display]
+        for edge in chain:
+            callee = self.functions[edge.callee]
+            hops.append(
+                f"{callee.qname.split('::', 1)[1]} "
+                f"(called at {self.functions[edge.caller].rel}:{edge.lineno})"
+            )
+        return tuple(hops)
+
+
+def build_graph(sources: Sequence[SourceFile]) -> CallGraph:
+    """Index every in-package Python source and resolve its calls.
+    Non-package files (tools/, bench drivers, C++) are skipped — the
+    interprocedural rules reason about the package's runtime seams."""
+    graph = CallGraph()
+    pkg_sources = [
+        s
+        for s in sources
+        if s.is_python and s.rel.startswith(_PKG + "/")
+    ]
+    known_rels = {s.rel for s in pkg_sources}
+    indexes: Dict[str, _ModuleIndex] = {}
+
+    # Pass 1: definitions + imports.
+    for src in pkg_sources:
+        idx = _ModuleIndex(src.rel)
+        indexes[src.rel] = idx
+        _index_module(graph, idx, src, known_rels)
+
+    # Pass 2: calls + entrypoints.
+    for src in pkg_sources:
+        _Resolver(graph, indexes, src).resolve()
+
+    for edge in (e for edges in graph.edges.values() for e in edges):
+        graph.in_edges.setdefault(edge.callee, []).append(edge)
+    return graph
+
+
+def _index_module(
+    graph: CallGraph,
+    idx: _ModuleIndex,
+    src: SourceFile,
+    known_rels: Set[str],
+) -> None:
+    module = _module_name(src.rel)
+    # The package relative imports resolve against: an __init__.py IS
+    # its package; a plain module's package is its parent.
+    if src.rel.endswith("/__init__.py"):
+        pkg_parts = module.split(".")
+    else:
+        pkg_parts = module.split(".")[:-1]
+
+    def register(fn: ast.AST, scope: Tuple[str, ...], cls: Optional[str]) -> None:
+        name = fn.name  # type: ignore[attr-defined]
+        dotted = ".".join(scope + (name,))
+        qname = f"{src.rel}::{dotted}"
+        refs = frozenset(
+            n.id
+            for n in ast.walk(fn)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+        )
+        called = frozenset(
+            n.func.id
+            if isinstance(n.func, ast.Name)
+            else n.func.attr
+            for n in ast.walk(fn)
+            if isinstance(n, ast.Call)
+            and isinstance(n.func, (ast.Name, ast.Attribute))
+        )
+        node = FuncNode(
+            qname=qname,
+            rel=src.rel,
+            name=name,
+            cls=cls,
+            is_async=isinstance(fn, ast.AsyncFunctionDef),
+            lineno=fn.lineno,  # type: ignore[attr-defined]
+            end_lineno=int(getattr(fn, "end_lineno", fn.lineno)),  # type: ignore[attr-defined]
+            node=fn,
+            refs=refs,
+            called_names=called,
+        )
+        graph.functions[qname] = node
+        graph._by_module_name.setdefault((src.rel, name), []).append(qname)
+        graph._by_bare_name.setdefault(name, []).append(qname)
+        if cls is not None and len(scope) == 1:
+            idx.classes.setdefault(cls, {})[name] = qname
+        elif not scope:
+            idx.functions[name] = qname
+
+    def visit(node: ast.AST, scope: Tuple[str, ...], cls: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                idx.bases[child.name] = [
+                    _safe_unparse(b) for b in child.bases
+                ]
+                visit(child, scope + (child.name,), child.name)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                register(child, scope, cls)
+                visit(child, scope + (child.name,), None)
+            else:
+                visit(child, scope, cls)
+
+    visit(src.tree, (), None)
+
+    for stmt in ast.walk(src.tree):
+        if isinstance(stmt, ast.Import):
+            for alias in stmt.names:
+                if not alias.name.startswith(_PKG):
+                    continue
+                rel = _rel_for_module(alias.name, known_rels)
+                if rel is not None:
+                    idx.imports[alias.asname or alias.name.split(".")[0]] = (
+                        "module",
+                        rel,
+                    )
+        elif isinstance(stmt, ast.ImportFrom):
+            base: List[str]
+            if stmt.level:
+                if stmt.level > len(pkg_parts):
+                    continue
+                base = pkg_parts[: len(pkg_parts) - (stmt.level - 1)]
+            elif stmt.module and stmt.module.startswith(_PKG):
+                base = []
+            else:
+                continue
+            mod_dotted = ".".join(base + (stmt.module.split(".") if stmt.module else []))
+            for alias in stmt.names:
+                bound = alias.asname or alias.name
+                sub_rel = _rel_for_module(
+                    f"{mod_dotted}.{alias.name}" if mod_dotted else alias.name,
+                    known_rels,
+                )
+                if sub_rel is not None:
+                    idx.imports[bound] = ("module", sub_rel)
+                    continue
+                mod_rel = _rel_for_module(mod_dotted, known_rels)
+                if mod_rel is not None:
+                    idx.imports[bound] = ("symbol", mod_rel, alias.name)
+
+
+def _safe_unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse is total on exprs
+        return ""
+
+
+class _Resolver:
+    """Pass 2 over one module: emit edges + entrypoints."""
+
+    def __init__(
+        self,
+        graph: CallGraph,
+        indexes: Dict[str, _ModuleIndex],
+        src: SourceFile,
+    ) -> None:
+        self.graph = graph
+        self.indexes = indexes
+        self.idx = indexes[src.rel]
+        self.src = src
+
+    def resolve(self) -> None:
+        self._visit(self.src.tree, scope=(), cls=None)
+
+    # -- scope walk -------------------------------------------------------
+
+    def _visit(
+        self,
+        node: ast.AST,
+        scope: Tuple[str, ...],
+        cls: Optional[str],
+        in_function: bool = False,
+    ) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                self._visit(
+                    child, scope + (child.name,), child.name, in_function
+                )
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_body(child, scope + (child.name,), cls)
+                self._visit(child, scope + (child.name,), None, True)
+            else:
+                # Calls inside function bodies belong to _scan_body
+                # (which attributes them to their caller); only
+                # module/class-level calls are handled here.
+                if not in_function and isinstance(child, ast.Call):
+                    self._handle_call(child, caller=None, cls=cls, scope=scope)
+                self._visit(child, scope, cls, in_function)
+
+    def _scan_body(
+        self, fn: ast.AST, scope: Tuple[str, ...], cls: Optional[str]
+    ) -> None:
+        """Walk one function's own statements (nested defs excluded —
+        they are their own nodes, reached only via an actual call)."""
+        caller = f"{self.src.rel}::{'.'.join(scope)}"
+        nested = {
+            child.name
+            for stmt in fn.body  # type: ignore[attr-defined]
+            for child in ast.walk(stmt)
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        stack: List[ast.AST] = list(fn.body)  # type: ignore[attr-defined]
+        while stack:
+            node = stack.pop()
+            # Nested defs are their own graph nodes; a Lambda is a
+            # VALUE (handed to executors / shim wrappers), not inline
+            # code — neither body belongs to this caller.  (An
+            # immediately-invoked lambda is therefore invisible: the
+            # documented under-approximation direction.)
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            if isinstance(node, ast.Call):
+                self._handle_call(
+                    node, caller=caller, cls=cls, scope=scope, nested=nested
+                )
+            stack.extend(ast.iter_child_nodes(node))
+
+    # -- call handling ----------------------------------------------------
+
+    def _handle_call(
+        self,
+        call: ast.Call,
+        caller: Optional[str],
+        cls: Optional[str],
+        scope: Tuple[str, ...],
+        nested: Optional[Set[str]] = None,
+    ) -> None:
+        self._maybe_entrypoint(call, caller, cls, scope, nested)
+        resolved = self._resolve_callee(call.func, cls, scope, nested)
+        if resolved is None or caller is None:
+            return
+        callee, kind = resolved
+        self.graph.edges.setdefault(caller, []).append(
+            CallEdge(caller=caller, callee=callee, lineno=call.lineno, kind=kind)
+        )
+
+    def _resolve_callee(
+        self,
+        func: ast.expr,
+        cls: Optional[str],
+        scope: Tuple[str, ...],
+        nested: Optional[Set[str]] = None,
+    ) -> Optional[Tuple[str, str]]:
+        rel = self.src.rel
+        if isinstance(func, ast.Name):
+            name = func.id
+            if nested and name in nested:
+                # Nested def in the current function: qname is
+                # scope + name (immediate nesting only).
+                qname = f"{rel}::{'.'.join(scope + (name,))}"
+                if qname in self.graph.functions:
+                    return qname, "local"
+                cands = [
+                    q
+                    for q in self.graph.by_name(rel, name)
+                    if q.startswith(f"{rel}::{'.'.join(scope)}.")
+                ]
+                if len(cands) == 1:
+                    return cands[0], "local"
+            if name in self.idx.functions:
+                return self.idx.functions[name], "module"
+            imp = self.idx.imports.get(name)
+            if imp is not None and imp[0] == "symbol":
+                target = self._symbol_in(imp[1], imp[2])
+                if target is not None:
+                    return target
+            # In-module class constructor: Pool() -> Pool.__init__.
+            init = self.idx.classes.get(name, {}).get("__init__")
+            if init is not None:
+                return init, "class"
+            return None
+        if isinstance(func, ast.Attribute):
+            attr = func.attr
+            value = func.value
+            if isinstance(value, ast.Name) and value.id in ("self", "cls"):
+                if cls is not None:
+                    found = self._method_on(rel, cls, attr, set())
+                    if found is not None:
+                        return found, "self"
+                return self._unique_method(attr)
+            if isinstance(value, ast.Name):
+                imp = self.idx.imports.get(value.id)
+                if imp is not None and imp[0] == "module":
+                    target = self._symbol_in(imp[1], attr)
+                    if target is not None:
+                        return target[0], "import"
+                    return None
+            return self._unique_method(attr)
+        return None
+
+    def _symbol_in(self, rel: str, name: str) -> Optional[Tuple[str, str]]:
+        idx = self.indexes.get(rel)
+        if idx is None:
+            return None
+        if name in idx.functions:
+            return idx.functions[name], "import"
+        init = idx.classes.get(name, {}).get("__init__")
+        if init is not None:
+            return init, "class"
+        # Re-exported through this module's own imports (one level).
+        imp = idx.imports.get(name)
+        if imp is not None and imp[0] == "symbol" and imp[1] != rel:
+            return self._symbol_in(imp[1], imp[2])
+        return None
+
+    def _method_on(
+        self, rel: str, cls: str, attr: str, seen: Set[Tuple[str, str]]
+    ) -> Optional[str]:
+        """Method lookup on a class, following in-package bases."""
+        if (rel, cls) in seen:
+            return None
+        seen.add((rel, cls))
+        idx = self.indexes.get(rel)
+        if idx is None:
+            return None
+        found = idx.classes.get(cls, {}).get(attr)
+        if found is not None:
+            return found
+        for base in idx.bases.get(cls, ()):  # one name-resolution hop
+            base_name = base.split(".")[-1]
+            if base_name in idx.classes:
+                hit = self._method_on(rel, base_name, attr, seen)
+                if hit is not None:
+                    return hit
+            imp = idx.imports.get(base_name) or idx.imports.get(
+                base.split(".")[0]
+            )
+            if imp is not None and imp[0] == "symbol":
+                hit = self._method_on(imp[1], imp[2], attr, seen)
+                if hit is not None:
+                    return hit
+        return None
+
+    def _unique_method(self, attr: str) -> Optional[Tuple[str, str]]:
+        if attr in AMBIENT_METHOD_NAMES or attr.startswith("__"):
+            return None
+        cands = self.graph.named(attr)
+        if len(cands) == 1:
+            return cands[0], "unique"
+        return None
+
+    # -- entrypoints ------------------------------------------------------
+
+    def _maybe_entrypoint(
+        self,
+        call: ast.Call,
+        caller: Optional[str],
+        cls: Optional[str],
+        scope: Tuple[str, ...],
+        nested: Optional[Set[str]],
+    ) -> None:
+        func = call.func
+        dotted = _safe_unparse(func)
+        tail = dotted.rsplit(".", 1)[-1]
+
+        def resolve_expr(expr: ast.expr) -> Optional[str]:
+            r = self._resolve_callee(expr, cls, scope, nested)
+            return r[0] if r is not None else None
+
+        def add(kind: str, target: Optional[str], label: Optional[str] = None) -> None:
+            if target is None:
+                return
+            self.graph.entrypoints.append(
+                Entrypoint(
+                    kind=kind,
+                    target=target,
+                    rel=self.src.rel,
+                    lineno=call.lineno,
+                    spawner=caller,
+                    label=label,
+                )
+            )
+
+        if tail == "Thread":
+            target_expr = None
+            label = None
+            for kw in call.keywords:
+                if kw.arg == "target":
+                    target_expr = kw.value
+                elif kw.arg == "name" and isinstance(kw.value, ast.Constant):
+                    label = str(kw.value.value)
+            if target_expr is not None:
+                add("thread", resolve_expr(target_expr), label)
+            return
+        if tail in _EXECUTOR_METHODS and len(call.args) >= 2:
+            add("executor", resolve_expr(call.args[1]))
+            return
+        if tail in _SUBMIT_METHODS and call.args:
+            add("executor", resolve_expr(call.args[0]))
+            return
+        if tail in _TASK_METHODS and call.args:
+            inner = call.args[0]
+            if isinstance(inner, ast.Call):
+                add("task", resolve_expr(inner.func))
+            else:
+                add("task", resolve_expr(inner))
+            return
+        if (tail in _LOOP_ROOT_METHODS or dotted == "asyncio.run") and call.args:
+            inner = call.args[0]
+            if isinstance(inner, ast.Call):
+                add("loop_root", resolve_expr(inner.func))

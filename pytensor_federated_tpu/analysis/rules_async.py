@@ -1,26 +1,42 @@
-"""async-blocking: no event-loop-blocking calls inside ``async def``.
+"""async-blocking: nothing blocking is REACHABLE from the event loop.
 
-The PR-5 chaos harness found exactly this bug class live — a fault
-shim calling ``time.sleep`` on the grpc.aio event loop froze every
-concurrent RPC, the hedge timer included.  The invariant (CLAUDE.md,
-:mod:`..faultinject.runtime` docstrings): async bodies in the I/O
-stack must await their delays and must call the ``*_async`` twins of
-the sync fault-shim primitives; sync-socket/subprocess work belongs in
-an executor.
+The PR-5 chaos harness found the direct form of this bug class live —
+a fault shim calling ``time.sleep`` on the grpc.aio event loop froze
+every concurrent RPC, the hedge timer included.  PR 7's rule caught
+exactly that shape: a blocking primitive written lexically inside an
+``async def``.  graftflow makes it transitive: a blocking call three
+frames down a sync helper chain blocks the loop just as hard, and the
+old rule provably missed it (tests/test_graftflow.py seeds that
+defect).
 
-Scope: ``service/``, ``routing/``, ``faultinject/`` — the packages
-whose async defs run on the serving event loop.  Nested *sync* ``def``
-bodies inside an async function are skipped: a sync closure is
-routinely handed to ``run_in_executor`` / ``ctx.run`` and blocks a
-worker thread, not the loop.
+Semantics: roots are the async contexts of the I/O stack — every
+``async def`` in ``service/``, ``routing/``, ``faultinject/`` plus
+``create_task``/``ensure_future`` targets spawned there — and the rule
+follows the shared call graph (:mod:`.graph`) through plain call
+edges; a sync function called from a coroutine still runs ON the loop.
+The spawn seams (``run_in_executor`` / ``Thread(target=…)`` /
+``submit``) produce no call edge, so the executor-closure pattern
+(sync ``def`` handed to a worker thread) stays exempt exactly as
+before.  Findings land at the blocking call site — wherever in the
+package it lives — and carry the full propagation chain from the async
+root.
+
+Blocking primitives: ``time.sleep``, sync socket construction and
+socket method calls, anything on the ``subprocess`` module, the sync
+fault-shim twins (their delay/stall kinds ``time.sleep`` — the PR-5
+class), and a bare ``lock.acquire()`` with neither a timeout nor
+``blocking=False`` (``with lock:`` for a short critical section is
+idiomatic and exempt).
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Iterator, List
+from typing import Iterator, List, Tuple
 
-from .core import Finding, SourceFile, rule
+from .core import Finding, RepoContext, SourceFile, rule
+from .dataflow import _LOCKISH, async_reachable
+from .graph import FuncNode, own_body
 
 _SCOPE_PREFIXES = (
     "pytensor_federated_tpu/service/",
@@ -53,8 +69,8 @@ _SYNC_SHIMS = {
     "mangle_batch_result": "mangle_batch_result_async",
 }
 
-#: Sync-socket method names: calling these on anything inside an async
-#: body is a blocking syscall on the loop.
+#: Sync-socket method names: calling these on anything on a loop path
+#: is a blocking syscall on the loop.
 _SOCKET_METHODS = {"sendall", "recv", "recv_into", "accept"}
 
 _RULE = "async-blocking"
@@ -67,74 +83,117 @@ def _call_name(func: ast.expr) -> str:
         return ""
 
 
-def _iter_async_body(fn: ast.AsyncFunctionDef) -> Iterator[ast.AST]:
-    """Walk an async function's own body, not descending into nested
-    function definitions (sync closures run in executors; nested async
-    defs are visited as roots in their own right)."""
-    stack: List[ast.AST] = list(fn.body)
-    while stack:
-        node = stack.pop()
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
-        yield node
-        stack.extend(ast.iter_child_nodes(node))
-
-
-def _check_call(
-    src: SourceFile, fn: ast.AsyncFunctionDef, call: ast.Call
-) -> Iterator[Finding]:
-    dotted = _call_name(call.func)
-    where = f"inside `async def {fn.name}`"
-    if dotted in _BLOCKING_DOTTED:
-        yield src.finding(
-            _RULE,
-            call.lineno,
-            f"blocking call `{dotted}(...)` {where} — "
-            f"{_BLOCKING_DOTTED[dotted]}",
-        )
-        return
-    head, _, tail = dotted.rpartition(".")
-    if head == _SUBPROCESS_MODULE:
-        yield src.finding(
-            _RULE,
-            call.lineno,
-            f"blocking call `{dotted}(...)` {where} — use "
-            "`asyncio.create_subprocess_*` or an executor",
-        )
-        return
-    name = tail or dotted
-    if name in _SYNC_SHIMS and (
-        head in ("", "_fi", "runtime") or "faultinject" in head
+def _is_bare_lock_acquire(call: ast.Call, dotted: str) -> bool:
+    """``lock.acquire()`` with no timeout and blocking semantics: the
+    caller parks its thread — on a loop path, the whole loop."""
+    if not (
+        isinstance(call.func, ast.Attribute) and call.func.attr == "acquire"
     ):
-        yield src.finding(
-            _RULE,
-            call.lineno,
-            f"sync fault shim `{dotted}(...)` {where} — its delay/stall "
-            f"kinds block the event loop; use `{_SYNC_SHIMS[name]}` "
-            "(the PR-5 chaos bug class)",
-        )
-        return
-    if isinstance(call.func, ast.Attribute) and name in _SOCKET_METHODS:
-        yield src.finding(
-            _RULE,
-            call.lineno,
-            f"sync socket call `{dotted}(...)` {where} — blocking "
-            "syscall on the event loop; use asyncio streams or an "
-            "executor",
-        )
+        return False
+    receiver = _call_name(call.func.value)
+    if not _LOCKISH.search(receiver):
+        return False
+    for kw in call.keywords:
+        if kw.arg in ("timeout", "blocking"):
+            return False
+    return not call.args  # positional blocking/timeout also opt out
+
+
+def blocking_call_sites(fn_node: ast.AST) -> Iterator[Tuple[ast.Call, str]]:
+    """(call, advice) for every blocking primitive in the function's
+    own body.  Shared by the transitive rule and the legacy direct scan
+    the regression tests compare against."""
+    for node in own_body(fn_node):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _call_name(node.func)
+        if dotted in _BLOCKING_DOTTED:
+            yield node, (
+                f"blocking call `{dotted}(...)` — {_BLOCKING_DOTTED[dotted]}"
+            )
+            continue
+        head, _, tail = dotted.rpartition(".")
+        if head == _SUBPROCESS_MODULE:
+            yield node, (
+                f"blocking call `{dotted}(...)` — use "
+                "`asyncio.create_subprocess_*` or an executor"
+            )
+            continue
+        name = tail or dotted
+        if name in _SYNC_SHIMS and (
+            head in ("", "_fi", "runtime") or "faultinject" in head
+        ):
+            yield node, (
+                f"sync fault shim `{dotted}(...)` — its delay/stall "
+                f"kinds block the event loop; use `{_SYNC_SHIMS[name]}` "
+                "(the PR-5 chaos bug class)"
+            )
+            continue
+        if isinstance(node.func, ast.Attribute) and name in _SOCKET_METHODS:
+            yield node, (
+                f"sync socket call `{dotted}(...)` — blocking syscall "
+                "on the event loop; use asyncio streams or an executor"
+            )
+            continue
+        if _is_bare_lock_acquire(node, dotted):
+            yield node, (
+                f"bare `{dotted}(...)` — an untimed blocking acquire "
+                "parks the event loop behind whoever holds the lock; "
+                "pass a timeout or keep the critical section under "
+                "`with lock:`"
+            )
+
+
+def direct_blocking_sites(src: SourceFile) -> List[Finding]:
+    """The PR-7 per-function semantics: blocking primitives lexically
+    inside an ``async def`` in the scoped packages.  Kept (not
+    registered) so the engine tests can prove the transitive rule's
+    reach exceeds it on multi-hop chains."""
+    out: List[Finding] = []
+    if not src.is_python or not src.rel.startswith(_SCOPE_PREFIXES):
+        return out
+    for node in src.nodes(ast.AsyncFunctionDef):
+        for call, advice in blocking_call_sites(node):
+            out.append(
+                src.finding(
+                    _RULE,
+                    call.lineno,
+                    f"{advice} (inside `async def {node.name}`)",  # type: ignore[attr-defined]
+                )
+            )
+    return out
 
 
 @rule(
     _RULE,
-    "no time.sleep / sync sockets / subprocess / sync fault shims "
-    "inside async def bodies in service/, routing/, faultinject/",
+    "no blocking primitive (time.sleep, sync sockets, subprocess, sync "
+    "fault shims, bare lock.acquire) reachable from an async context in "
+    "service/, routing/, faultinject/ — transitive over the call graph, "
+    "finding carries the chain",
+    scope="repo",
 )
-def check_async_blocking(src: SourceFile) -> Iterator[Finding]:
-    if not src.is_python or not src.rel.startswith(_SCOPE_PREFIXES):
-        return
-    for node in ast.walk(src.tree):
-        if not isinstance(node, ast.AsyncFunctionDef):
+def check_async_blocking(ctx: RepoContext) -> Iterator[Finding]:
+    graph = ctx.graph
+    reach = async_reachable(graph, _SCOPE_PREFIXES)
+    for qname, chain in sorted(reach.items()):
+        fn: FuncNode = graph.functions[qname]
+        src = ctx.by_rel.get(fn.rel)
+        if src is None:
             continue
-        for sub in _iter_async_body(node):
-            if isinstance(sub, ast.Call):
-                yield from _check_call(src, node, sub)
+        root = graph.functions[chain[0].caller] if chain else fn
+        for call, advice in blocking_call_sites(fn.node):
+            hops = graph.render_chain(chain) or (fn.display,)
+            where = (
+                f"inside `async def {fn.name}`"
+                if not chain
+                else f"reachable from `async def {root.name}` "
+                f"({root.rel}:{root.lineno}) in {len(chain)} call(s)"
+            )
+            yield Finding(
+                rule=_RULE,
+                path=fn.rel,
+                line=call.lineno,
+                message=f"{advice} ({where})",
+                chain=hops
+                + (f"blocking call at {fn.rel}:{call.lineno}",),
+            )

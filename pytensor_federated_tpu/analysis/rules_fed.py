@@ -29,7 +29,7 @@ _FED = "pytensor_federated_tpu/fed/primitives.py"
 _REQUIRED = ("abstract_eval", "jvp", "transpose", "batching")
 
 
-def missing_rules(module) -> List[Tuple[str, object, List[str]]]:
+def missing_rules(module: object) -> List[Tuple[str, object, List[str]]]:
     """Introspect ``module`` for jax primitives with incomplete rule
     sets -> ``[(attr_name, primitive, [missing...])]``.  Separated from
     the Rule wrapper so tests can run it against fixture modules."""

@@ -1,0 +1,193 @@
+"""fed-placement: pool-placed fed_maps must not capture driver state.
+
+The PR-6 incident class: a pool-placed ``fed_map`` whose closure
+captures a DRIVER-VARYING value (a program input, or an upstream
+equation's output) cannot ship it — pool lanes send only mapped
+leaves — so ``PoolPlacement.group_executor`` refuses at runtime with a
+ValueError, far from the model code that caused it.  Per DrJAX
+(PAPERS.md), placement invariants like this are checkable from the
+jaxpr without running anything: this rule traces the pool-lane
+fixtures registered in :mod:`..fed.lint_fixtures` under the CPU
+backend, replays the exact varying-const computation the lowering
+performs (``MapSpec.from_eqn`` + the baked-constvar logic of
+``lowering._build_executors``), and flags offending equations at CI
+time — with the captured operand's provenance chain in the finding.
+
+Introspective, like ``fed-rule-completeness``: it imports jax and the
+fed package, so it must (and does) force the CPU backend first — a
+lint run can never dial the tunneled TPU plugin (CLAUDE.md environment
+pitfalls).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from .core import Finding, RepoContext, SourceFile, rule
+
+_RULE = "fed-placement"
+_FIXTURES = "pytensor_federated_tpu/fed/lint_fixtures.py"
+
+
+@dataclass(frozen=True)
+class CaptureFinding:
+    """One driver-varying operand captured by one fed_map equation."""
+
+    fixture: str
+    eqn_index: int
+    const_index: int
+    provenance: Tuple[str, ...]
+    lineno: Optional[int]  # user line from jax source_info, if known
+
+
+def _user_lineno(eqn: Any, rel_hint: str) -> Optional[int]:
+    """Best-effort source line for an equation: the innermost traceback
+    frame inside the fixture module.  jax's source_info shape is not a
+    stable API, so every access is defensive."""
+    tb = getattr(getattr(eqn, "source_info", None), "traceback", None)
+    if tb is None:
+        return None
+    try:
+        frames = list(tb.frames)
+    except Exception:
+        return None
+    tail = rel_hint.rsplit("/", 1)[-1]
+    for frame in frames:
+        fname = getattr(frame, "file_name", "") or ""
+        if fname.endswith(tail):
+            line = getattr(frame, "line_num", None)
+            if isinstance(line, int) and line > 0:
+                return line
+    return None
+
+
+def placement_findings(
+    fn: Any, example_args: Tuple[Any, ...], *, fixture: str = "<fixture>"
+) -> List[CaptureFinding]:
+    """Trace ``fn`` and report every pool-refusable fed_map operand.
+    Separated from the Rule wrapper so tests can run it against
+    deliberately-broken programs without a synthetic repo."""
+    import jax
+    from jax.extend.core import Literal
+
+    from ..fed.primitives import fed_map_p
+
+    closed = jax.make_jaxpr(fn)(*example_args)
+    jaxpr = closed.jaxpr
+    # Top-level consts are concrete -> baked; under an enclosing trace
+    # (not the lint's case) tracer consts would be driver-varying.
+    from ..fed.primitives import is_tracer as _is_tracer
+
+    baked = frozenset(
+        v
+        for v, c in zip(jaxpr.constvars, closed.consts)
+        if not _is_tracer(c)
+    )
+    invar_pos = {v: i for i, v in enumerate(jaxpr.invars)}
+    producers: Dict[Any, Tuple[int, Any]] = {}
+    for i, eqn in enumerate(jaxpr.eqns):
+        for v in eqn.outvars:
+            producers[v] = (i, eqn)
+
+    def provenance(var: Any) -> Tuple[str, ...]:
+        chain: List[str] = []
+        cur = var
+        for _hop in range(5):  # bounded backward walk
+            if cur in invar_pos:
+                chain.append(f"program input #{invar_pos[cur]}")
+                return tuple(chain)
+            if cur in baked:  # pragma: no cover - baked is not varying
+                chain.append("baked trace-time constant")
+                return tuple(chain)
+            prod = producers.get(cur)
+            if prod is None:
+                chain.append("enclosing-trace value (closure tracer)")
+                return tuple(chain)
+            idx, eqn = prod
+            chain.append(f"output of `{eqn.primitive.name}` (eqn {idx})")
+            nxt = next(
+                (v for v in eqn.invars if not isinstance(v, Literal)),
+                None,
+            )
+            if nxt is None:
+                return tuple(chain)
+            cur = nxt
+        chain.append("...")
+        return tuple(chain)
+
+    out: List[CaptureFinding] = []
+    for i, eqn in enumerate(jaxpr.eqns):
+        if eqn.primitive is not fed_map_p:
+            continue
+        n_consts = eqn.params["n_consts"]
+        for k, v in enumerate(eqn.invars[:n_consts]):
+            if isinstance(v, Literal) or v in baked:
+                continue
+            out.append(
+                CaptureFinding(
+                    fixture=fixture,
+                    eqn_index=i,
+                    const_index=k,
+                    provenance=provenance(v),
+                    lineno=_user_lineno(eqn, _FIXTURES),
+                )
+            )
+    return out
+
+
+def _fixture_lines(src: SourceFile) -> Dict[str, int]:
+    """fixture name -> line of its ``LintFixture(name=...)`` call."""
+    out: Dict[str, int] = {}
+    for node in src.nodes(ast.Call):
+        callee = getattr(node.func, "id", "") or getattr(
+            node.func, "attr", ""
+        )
+        if callee != "LintFixture":
+            continue
+        for kw in node.keywords:
+            if kw.arg == "name" and isinstance(kw.value, ast.Constant):
+                out[str(kw.value.value)] = node.lineno
+    return out
+
+
+@rule(
+    _RULE,
+    "pool-lane fed.program fixtures (fed/lint_fixtures.py) must not "
+    "capture driver-varying operands in fed_map closures — traced from "
+    "the jaxpr CPU-only, provenance chain in the finding",
+    scope="repo",
+)
+def check_fed_placement(ctx: RepoContext) -> Iterator[Finding]:
+    src = ctx.by_rel.get(_FIXTURES)
+    if src is None:
+        return
+    # CPU-only introspection: never let a lint run dial the tunneled
+    # TPU plugin (CLAUDE.md environment pitfalls).
+    from ..utils import force_cpu_backend
+
+    force_cpu_backend()
+    from ..fed import lint_fixtures
+
+    lines = _fixture_lines(src)
+    for fixture in lint_fixtures.FIXTURES:
+        fn, args = fixture.build()
+        for cap in placement_findings(fn, args, fixture=fixture.name):
+            prov = " <- ".join(cap.provenance)
+            yield Finding(
+                rule=_RULE,
+                path=_FIXTURES,
+                line=cap.lineno or lines.get(fixture.name, 1),
+                message=(
+                    f"fixture `{fixture.name}`: fed_map (eqn "
+                    f"{cap.eqn_index}) closes over driver-varying "
+                    f"operand #{cap.const_index} ({prov}) — a pool "
+                    "placement ships only MAPPED leaves, so this "
+                    "raises PoolPlacement's ValueError at runtime; "
+                    "route driver state through fed_broadcast instead "
+                    "of closure capture"
+                ),
+                chain=(f"fed_map eqn {cap.eqn_index}, captured operand "
+                       f"#{cap.const_index}",) + cap.provenance,
+            )

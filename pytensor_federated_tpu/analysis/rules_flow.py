@@ -1,0 +1,260 @@
+"""loop-escape: grpc.aio values must not outlive their event loop.
+
+``loop-affinity`` (PR 7, :mod:`.rules_loop`) polices where channels
+are CREATED.  This rule polices where their values FLOW: a grpc.aio
+channel, multicallable, or stream stashed in a module global, an
+instance attribute, or a cross-thread container is readable from
+another loop — exactly the resurrection of the bug the
+(token,pid,thread,loop)-keyed connection cache exists to kill
+(CLAUDE.md design invariants; the cache and its purge live in
+``service/client.py``, which is therefore the one exempt file).
+
+Dataflow, per function, over the shared graph:
+
+- *taint seeds*: ``grpc.aio.*_channel(...)`` calls; ``.unary_unary`` /
+  ``.unary_stream`` / ``.stream_unary`` / ``.stream_stream`` on a
+  tainted value (multicallables hold their channel); CALLS of a
+  tainted value (the resulting call/stream object is loop-bound);
+  ``await`` of a tainted expression; calls to in-package functions
+  that RETURN tainted values (computed as a fixpoint over the call
+  graph — the interprocedural hop that catches
+  ``self.ch = self._make_channel()``).
+- *escapes*: assignment to any attribute (``self.x = ch`` /
+  ``obj.x = ch``), to a module global, into a subscript of either, or
+  handed to a container mutator (``.append`` / ``.put`` / …) whose
+  receiver is an attribute or module global.
+
+A scoped ``async with`` channel never escapes by construction and
+needs no special case here — its value is consumed by the ``with``
+item, and storing it FROM the with body is still flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set, Tuple
+
+from .core import Finding, RepoContext, rule
+from .dataflow import _MUTATOR_METHODS  # shared container-write table
+from .graph import CallGraph, FuncNode, own_body
+
+_RULE = "loop-escape"
+
+_CACHE_FILE = "pytensor_federated_tpu/service/client.py"
+
+_MULTICALLABLE_METHODS = {
+    "unary_unary",
+    "unary_stream",
+    "stream_unary",
+    "stream_stream",
+}
+
+
+def _unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover
+        return ""
+
+
+def _is_channel_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    dotted = _unparse(node.func)
+    return dotted.endswith(("aio.insecure_channel", "aio.secure_channel"))
+
+
+class _FnFlow:
+    """One function's forward taint pass (order-insensitive fixpoint:
+    two sweeps over simple assignments cover the straight-line flows a
+    linter should chase)."""
+
+    def __init__(
+        self,
+        fn: FuncNode,
+        graph: CallGraph,
+        sources: Set[str],
+    ) -> None:
+        self.fn = fn
+        self.graph = graph
+        self.source_fns = sources  # qnames returning tainted values
+        self.tainted_names: Set[str] = set()
+        self.returns_tainted = False
+        self.escapes: List[Tuple[int, str, str]] = []  # (line, target, why)
+        self._globals: Optional[Set[str]] = None
+
+    # -- taint ------------------------------------------------------------
+
+    def _call_returns_tainted(self, call: ast.Call) -> bool:
+        if _is_channel_call(call):
+            return True
+        func = call.func
+        if isinstance(func, ast.Attribute):
+            if func.attr in _MULTICALLABLE_METHODS and self._tainted(
+                func.value
+            ):
+                return True
+            # stream = method(); resp = stub(req): call OF a tainted
+            # value yields a loop-bound call object.
+        if self._tainted(func):
+            return True
+        edges = [
+            e
+            for e in self.graph.callees_of(self.fn.qname)
+            if e.lineno == call.lineno and e.callee in self.source_fns
+        ]
+        return bool(edges)
+
+    def _tainted(self, expr: ast.expr) -> bool:
+        if isinstance(expr, ast.Name):
+            return expr.id in self.tainted_names
+        if isinstance(expr, ast.Await):
+            return self._tainted(expr.value)
+        if isinstance(expr, ast.Call):
+            return self._call_returns_tainted(expr)
+        return False
+
+    # -- walk -------------------------------------------------------------
+
+    def run(self) -> None:
+        body = own_body(self.fn.node)  # shared walk (no nested defs)
+        for _sweep in range(2):
+            for node in body:
+                if isinstance(node, ast.Assign) and self._tainted(node.value):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            self.tainted_names.add(tgt.id)
+        for node in body:
+            self._check(node)
+
+    def _escape(self, lineno: int, target: ast.expr, why: str) -> None:
+        self.escapes.append((lineno, _unparse(target), why))
+
+    def _check(self, node: ast.AST) -> None:
+        if isinstance(node, ast.Assign):
+            if not self._tainted(node.value):
+                return
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Attribute):
+                    self._escape(
+                        node.lineno, tgt, "stored on an instance/object "
+                        "attribute readable from another loop"
+                    )
+                elif isinstance(tgt, ast.Subscript):
+                    self._escape(
+                        node.lineno,
+                        tgt,
+                        "stored into a container another loop/thread "
+                        "can read",
+                    )
+                elif isinstance(tgt, ast.Name) and self._is_global(tgt.id):
+                    self._escape(
+                        node.lineno, tgt, "stored in a module global"
+                    )
+        elif isinstance(node, ast.Return):
+            if node.value is not None and self._tainted(node.value):
+                self.returns_tainted = True
+        elif (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _MUTATOR_METHODS | {"put", "put_nowait"}
+            and any(self._tainted(a) for a in node.args)
+            and isinstance(node.func.value, (ast.Attribute, ast.Name))
+        ):
+            receiver = node.func.value
+            if isinstance(receiver, ast.Attribute) or (
+                isinstance(receiver, ast.Name)
+                and self._is_global(receiver.id)
+            ):
+                self._escape(
+                    node.lineno,
+                    receiver,
+                    f"handed to `.{node.func.attr}(...)` on a shared "
+                    "container",
+                )
+
+    def _is_global(self, name: str) -> bool:
+        if self._globals is None:
+            decls: Set[str] = set()
+            for n in ast.walk(self.fn.node):
+                if isinstance(n, ast.Global):
+                    decls.update(n.names)
+            self._globals = decls
+        return name in self._globals
+
+
+def _channel_flows(
+    graph: CallGraph, skip_rel: str
+) -> "dict[str, _FnFlow]":
+    """One taint pass per function, fixpoint over channel-RETURNING
+    functions driven by a worklist: when a function is discovered to
+    be a source, only its CALLERS can change, so only they re-run —
+    the full-package pass happens once, not once per round.  Returns
+    the final per-function flows so the rule consumes them directly
+    instead of re-analyzing."""
+    sources: Set[str] = set()
+    flows: dict = {}
+    pending = {
+        q for q, f in graph.functions.items() if f.rel != skip_rel
+    }
+    while pending:
+        new_sources: List[str] = []
+        for qname in pending:
+            flow = _FnFlow(graph.functions[qname], graph, sources)
+            flow.run()
+            flows[qname] = flow
+            if flow.returns_tainted and qname not in sources:
+                sources.add(qname)
+                new_sources.append(qname)
+        pending = set()
+        for src_q in new_sources:
+            for edge in graph.callers_of(src_q):
+                if graph.functions[edge.caller].rel != skip_rel:
+                    pending.add(edge.caller)
+    return flows
+
+
+@rule(
+    _RULE,
+    "grpc.aio channels/multicallables/streams must not flow into module "
+    "globals, instance attributes, or cross-thread containers outside "
+    "the (token,pid,thread,loop)-keyed cache (service/client.py)",
+    scope="repo",
+)
+def check_loop_escape(ctx: RepoContext) -> Iterator[Finding]:
+    graph = ctx.graph
+    flows = _channel_flows(graph, _CACHE_FILE)
+    sources = {q for q, fl in flows.items() if fl.returns_tainted}
+    for qname in sorted(flows):
+        fn = graph.functions[qname]
+        flow = flows[qname]
+        for lineno, target, why in flow.escapes:
+            chain: Tuple[str, ...] = (fn.display,)
+            # If the taint arrived through a channel-source call, name
+            # the producer in the chain — the interprocedural hop.
+            producers = [
+                e
+                for e in graph.callees_of(qname)
+                if e.callee in sources
+            ]
+            if producers:
+                prod = graph.functions[producers[0].callee]
+                chain = (
+                    prod.display,
+                    f"returns a loop-bound grpc.aio value to "
+                    f"{fn.rel}:{producers[0].lineno}",
+                ) + chain
+            yield Finding(
+                rule=_RULE,
+                path=fn.rel,
+                line=lineno,
+                message=(
+                    f"loop-bound grpc.aio value escapes into `{target}` "
+                    f"— {why}; channels are bound to their creation "
+                    "loop, so route connections through "
+                    "service.client.ClientPrivates (the "
+                    "(token,pid,thread,loop)-keyed cache) or keep them "
+                    "scoped to one coroutine"
+                ),
+                chain=chain,
+            )

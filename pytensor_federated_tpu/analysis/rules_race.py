@@ -1,0 +1,120 @@
+"""shared-state-lock: cross-context mutation requires a lock.
+
+The concurrency census that motivated graftflow: 38 thread / lock /
+executor sites across 19 files, and the two incidents the repo has
+already paid for (the PR-5 loop-blocking shim, the PR-7 sync-shim
+lanes) were both "code ran in a context its author didn't picture".
+This rule checks the mutation half of that hazard: an instance or
+module attribute written from TWO OR MORE concurrency contexts —
+thread entrypoint (``threading.Thread(target=…)``), event loop
+(``async def`` / ``create_task``), executor (``run_in_executor`` /
+``submit``) — where at least one write site holds no inferred lock.
+
+Machinery (:mod:`.dataflow`): contexts propagate along the shared call
+graph from the discovered entrypoints; write sites are assignments /
+augassigns / subscript stores / ``del`` / container-mutator calls on
+``self`` attributes and declared module globals (``__init__`` exempt —
+construction precedes sharing); a write is locked when it sits in a
+``with <lock-ish>:`` region or in a helper whose every in-package
+caller is lock-held (one-level fixpoint).  Findings carry a witness
+chain per context — how the probe daemon and the serving loop each
+reach the write.
+
+Scope: ``routing/``, ``service/``, ``telemetry/``, ``faultinject/`` —
+the packages the census counted (seeded against routing/pool.py's
+probe daemon and telemetry's registries).  Single-context writes and
+everywhere-locked attributes are fine; GIL-atomicity arguments are
+deliberately NOT modeled (a `+=` is already two bytecodes), so a
+deliberate lock-free design suppresses inline with its justification.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, Iterator, List, Set, Tuple
+
+from .core import Finding, RepoContext, rule
+from .dataflow import (
+    WriteSite,
+    context_chains,
+    lock_held_functions,
+    mutation_sites,
+)
+
+_RULE = "shared-state-lock"
+
+_SCOPE_PREFIXES = (
+    "pytensor_federated_tpu/routing/",
+    "pytensor_federated_tpu/service/",
+    "pytensor_federated_tpu/telemetry/",
+    "pytensor_federated_tpu/faultinject/",
+)
+
+
+@rule(
+    _RULE,
+    "instance/module attributes mutated from >=2 concurrency contexts "
+    "(thread / event loop / executor) need a lock on every write path "
+    "(routing/, service/, telemetry/, faultinject/)",
+    scope="repo",
+)
+def check_shared_state_lock(ctx: RepoContext) -> Iterator[Finding]:
+    graph = ctx.graph
+    witness = context_chains(graph)
+    lock_held = lock_held_functions(graph)
+
+    # (rel, owner class or "<module>", attr) -> write sites
+    groups: Dict[Tuple[str, str, str], List[WriteSite]] = defaultdict(list)
+    for src in ctx:
+        if not src.is_python or not src.rel.startswith(_SCOPE_PREFIXES):
+            continue
+        for site in mutation_sites(graph, src.tree, src.rel):
+            fn = graph.functions[site.qname]
+            owner = (fn.cls or "<module>") if site.is_self else "<module>"
+            groups[(site.rel, owner, site.target)].append(site)
+
+    for (rel, owner, target), sites in sorted(groups.items()):
+        contexts: Set[str] = set()
+        per_site_ctx: List[Tuple[WriteSite, Set[str]]] = []
+        for site in sites:
+            ctxs = set(witness.get(site.qname, {}))
+            per_site_ctx.append((site, ctxs))
+            contexts |= ctxs
+        if len(contexts) < 2:
+            continue
+        unlocked = [
+            site
+            for site, ctxs in per_site_ctx
+            if ctxs and not site.locked and site.qname not in lock_held
+        ]
+        if not unlocked:
+            continue
+        # One finding per unlocked write site (suppressions are
+        # per-line); the chain shows one witness path per context.
+        chain_hops: List[str] = []
+        for label in sorted(contexts):
+            for site, ctxs in per_site_ctx:
+                if label in ctxs:
+                    root, chain = witness[site.qname][label]
+                    root_fn = graph.functions[root]
+                    hops = graph.render_chain(chain) or (root_fn.display,)
+                    chain_hops.append(
+                        f"[{label}] " + " -> ".join(hops)
+                        + f" -> writes `{target}` at {site.rel}:{site.lineno}"
+                    )
+                    break
+        for site in unlocked:
+            fn = graph.functions[site.qname]
+            yield Finding(
+                rule=_RULE,
+                path=rel,
+                line=site.lineno,
+                message=(
+                    f"`{target}` (owner {owner}) is mutated from "
+                    f"{len(contexts)} concurrency contexts "
+                    f"({', '.join(sorted(contexts))}) but this write in "
+                    f"`{fn.name}` holds no lock — take the owner's lock "
+                    "or make the attribute context-private"
+                ),
+                chain=tuple(chain_hops),
+            )

@@ -1,0 +1,219 @@
+"""resource-leak: handles must close on every exit path.
+
+Sockets, grpc channels, and files opened in a function must either be
+scoped (``with`` / ``async with``), be closed in that function, or
+visibly transfer ownership (returned, yielded, stored on an object,
+or handed to another call).  A handle that does none of these leaks on
+EVERY path; the chip-side incidents make this worse than a fd leak —
+a leaked half-open TCP connection to a wedged node holds its frame
+lock forever (service/tcp.py's lock-step contract), and channels
+additionally pin their event loop (``loop-escape``).
+
+Per-function and deliberately modest (no CFG): the rule flags the
+"opened and dropped" shape —
+
+- an open call whose result is never bound (``socket.socket().connect``
+  chains, probe one-liners);
+- a local handle that is never ``close()``-d / ``shutdown()``-d,
+  never returned or yielded, never stored, and never passed on.
+
+What it does NOT try to prove: that a present ``close()`` executes on
+the exception path (try/finally discipline) — exception-safety of
+close is a CFG property; the fixture tests document the gap and
+``with`` remains the recommended fix.  Scope: the whole package except
+tests (C++ sources are out of scope; the npwire C++ node manages its
+fds RAII-style).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set
+
+from .core import Finding, SourceFile, rule
+from .graph import own_body
+
+_RULE = "resource-leak"
+
+_SCOPE_PREFIX = "pytensor_federated_tpu/"
+
+#: dotted-call suffixes that allocate a closeable handle.
+_OPEN_SUFFIXES = (
+    "socket.socket",
+    "socket.create_connection",
+    "socket.socketpair",
+    "aio.insecure_channel",
+    "aio.secure_channel",
+    "grpc.insecure_channel",
+    "grpc.secure_channel",
+)
+_OPEN_EXACT = {"open", "create_connection", "socketpair"}
+
+_CLOSE_METHODS = {"close", "shutdown", "terminate", "aclose"}
+
+
+def _unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover
+        return ""
+
+
+def _is_open_call(node: ast.AST) -> Optional[str]:
+    if not isinstance(node, ast.Call):
+        return None
+    dotted = _unparse(node.func)
+    if dotted.endswith(_OPEN_SUFFIXES) or dotted in _OPEN_EXACT:
+        return dotted
+    return None
+
+
+def _function_findings(
+    src: SourceFile, fn: ast.AST
+) -> Iterator[Finding]:
+    nodes = own_body(fn)  # shared walk: nested defs/lambdas excluded
+    scoped: Set[int] = set()  # id() of with-item open calls
+    for node in nodes:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                expr = item.context_expr
+                if isinstance(expr, ast.Await):
+                    expr = expr.value
+                if _is_open_call(expr) is not None:
+                    scoped.add(id(expr))
+
+    # local name -> (open call, dotted) for `h = open(...)` bindings
+    bound: dict = {}
+    bound_ids: Set[int] = set()
+    for node in nodes:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+            value = node.value
+            if isinstance(value, ast.Await):
+                value = value.value
+            dotted = _is_open_call(value)
+            if dotted is not None and id(value) not in scoped:
+                if isinstance(tgt, ast.Name):
+                    bound[tgt.id] = (node, dotted)
+                    bound_ids.add(id(value))
+                else:
+                    # h.attr = open(...) / d[k] = open(...): ownership
+                    # stored — lifecycle belongs to the container.
+                    bound_ids.add(id(value))
+
+    # Inline open calls that are neither scoped nor bound anywhere.
+    for node in nodes:
+        dotted = _is_open_call(node)
+        if (
+            dotted is not None
+            and id(node) not in scoped
+            and id(node) not in bound_ids
+            and not _is_consumed(node, nodes)
+        ):
+            yield src.finding(
+                _RULE,
+                node.lineno,
+                f"`{dotted}(...)` opens a handle that is never bound — "
+                "no path can close it; use `with` (or bind and close)",
+            )
+
+    for name, (assign, dotted) in bound.items():
+        if _name_released(name, nodes):
+            continue
+        yield src.finding(
+            _RULE,
+            assign.lineno,
+            f"`{name} = {dotted}(...)` is never closed, returned, "
+            "stored, or handed off on any path out of this function — "
+            "wrap it in `with {name} ...` or close it in a `finally`".replace(
+                "{name}", name
+            ),
+        )
+
+
+def _is_consumed(call: ast.AST, nodes: List[ast.AST]) -> bool:
+    """An unbound open call is consumed when some enclosing expression
+    uses its value: returned, awaited into a with, passed as an
+    argument, or the receiver of an attribute access that is NOT a
+    plain method-chain leak (`socket.socket().connect(...)` still
+    leaks — attribute access alone does not count)."""
+    for node in nodes:
+        if isinstance(node, ast.Return) and _contains(node.value, call):
+            return True
+        if isinstance(node, ast.Call):
+            if any(_contains(a, call) for a in node.args) or any(
+                _contains(kw.value, call) for kw in node.keywords
+            ):
+                return True
+        if isinstance(node, (ast.Assign, ast.AnnAssign)) and _contains(
+            getattr(node, "value", None), call
+        ):
+            # bound through a wrapper expression: treated as handed off
+            return True
+        if isinstance(node, ast.Yield) and _contains(node.value, call):
+            return True
+    return False
+
+
+def _contains(tree: Optional[ast.AST], needle: ast.AST) -> bool:
+    if tree is None:
+        return False
+    return any(n is needle for n in ast.walk(tree))
+
+
+def _name_released(name: str, nodes: List[ast.AST]) -> bool:
+    for node in nodes:
+        # h.close() / h.shutdown(...)
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _CLOSE_METHODS
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == name
+        ):
+            return True
+        # used as a with context later: `with h:` / contextlib stacks
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if (
+                    isinstance(item.context_expr, ast.Name)
+                    and item.context_expr.id == name
+                ):
+                    return True
+        # escapes: returned / yielded / stored / passed along
+        if isinstance(node, (ast.Return, ast.Yield)) and _mentions(
+            node.value, name
+        ):
+            return True
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(
+                    tgt, (ast.Attribute, ast.Subscript)
+                ) and _mentions(node.value, name):
+                    return True
+        if isinstance(node, ast.Call):
+            if any(_mentions(a, name) for a in node.args) or any(
+                _mentions(kw.value, name) for kw in node.keywords
+            ):
+                return True
+    return False
+
+
+def _mentions(tree: Optional[ast.AST], name: str) -> bool:
+    if tree is None:
+        return False
+    return any(
+        isinstance(n, ast.Name) and n.id == name for n in ast.walk(tree)
+    )
+
+
+@rule(
+    _RULE,
+    "sockets/grpc channels/files must be scoped with `with`, closed, or "
+    "visibly hand off ownership — no opened-and-dropped handles",
+)
+def check_resource_leak(src: SourceFile) -> Iterator[Finding]:
+    if not src.is_python or not src.rel.startswith(_SCOPE_PREFIX):
+        return
+    for fn in src.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
+        yield from _function_findings(src, fn)

@@ -6,6 +6,16 @@ actually exercises the failure paths it claims to.  A raw socket
 send/recv added without its shim silently shrinks chaos coverage —
 nothing fails, the harness just stops testing that seam.
 
+Since PR 8 the reachability behind both checks runs on the shared
+graftflow call graph (:mod:`.graph`) instead of a module-private
+index, so "reachable" means the same thing here as in every other
+rule.  This rule deliberately uses the graph's NAME-LEVEL call
+relation (``FuncNode.called_names``) rather than resolved edges: an
+unresolvable ``obj.m()`` must still count as possibly calling any
+same-module ``m`` — merging same-named functions is the conservative
+direction for a coverage check (a shimmed method never loses its seam
+to a name collision).
+
 Two checks, scoped to ``service/`` and ``routing/`` (the owned
 transport stack; :mod:`..faultinject` itself is the shim layer and is
 exempt):
@@ -13,21 +23,21 @@ exempt):
 1. every raw socket ``sendall``/``recv`` callsite must be reachable
    from a function that references the fault runtime (``_fi.…``) in
    the same module — either the enclosing function holds the seam, or
-   a shim-bearing function (transitively) calls it.  Pure transport
-   helpers (`_recv_exact`, `_send_frame`) pass because their callers
-   shim; a NEW raw I/O path with no shimmed caller fails.
+   a shim-bearing function (transitively) calls it.
 2. every public ``encode_*``/``decode_*`` function in the codec
-   modules must contain the chaos seam itself or delegate to a
-   same-module sibling that does (the codecs are the byte-lane
-   injection points: ``npwire.encode``, ``npproto.decode``, …).
+   modules must contain the chaos seam itself, delegate to a
+   same-module sibling that does, or be (transitively) called by a
+   seam-bearing sibling — the fault fires one frame up and still
+   corrupts these bytes.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Dict, Iterator, List, Set
+from typing import Dict, Iterator, List, Sequence, Set
 
-from .core import Finding, SourceFile, rule
+from .core import Finding, RepoContext, rule
+from .graph import CallGraph, FuncNode
 
 _RULE = "fault-shim-coverage"
 
@@ -47,101 +57,56 @@ _RAW_SOCKET_METHODS = {"sendall", "recv", "recv_into"}
 _FI_MARKERS = {"_fi", "faultinject"}
 
 
-class _FuncInfo:
-    __slots__ = ("name", "node", "refs_fi", "calls")
-
-    def __init__(self, name: str, node: ast.AST):
-        self.name = name
-        self.node = node
-        self.refs_fi = False
-        self.calls: Set[str] = set()
+def _module_nodes(graph: CallGraph, rel: str) -> List[FuncNode]:
+    return [f for f in graph.functions.values() if f.rel == rel]
 
 
-def _index_functions(tree: ast.Module) -> Dict[str, _FuncInfo]:
-    """Flat function index by bare name (methods included — intra-module
-    calls are matched by name, `self.x(...)` counts as calling `x`).
-    Same-named functions in different classes MERGE: refs_fi is OR-ed
-    and call sets union, so a shimmed method never loses its seam to a
-    name collision (the conservative direction for a linter)."""
-    out: Dict[str, _FuncInfo] = {}
-
-    def walk_fn(fn: ast.AST) -> None:
-        name = fn.name  # type: ignore[attr-defined]
-        prev = out.get(name)
-        info = _FuncInfo(name, fn)
-        if prev is not None:
-            info.refs_fi = prev.refs_fi
-            info.calls |= prev.calls
-        out[name] = info
-        for node in ast.walk(fn):
-            if isinstance(node, ast.Name) and node.id in _FI_MARKERS:
-                info.refs_fi = True
-            if isinstance(node, ast.Call):
-                f = node.func
-                if isinstance(f, ast.Name):
-                    info.calls.add(f.id)
-                elif isinstance(f, ast.Attribute):
-                    info.calls.add(f.attr)
-                    if (
-                        isinstance(f.value, ast.Name)
-                        and f.value.id in _FI_MARKERS
-                    ):
-                        info.refs_fi = True
-
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            walk_fn(node)
-    return out
-
-
-def _shim_reachable(funcs: Dict[str, _FuncInfo]) -> Set[str]:
-    """Function names reachable (as callees, transitively) from any
-    function that references the fault runtime."""
-    reached: Set[str] = set()
-    frontier: List[str] = [n for n, f in funcs.items() if f.refs_fi]
-    reached.update(frontier)
+def _name_reachable(
+    nodes: Sequence[FuncNode], roots: Set[str]
+) -> Set[str]:
+    """Bare names reachable from ``roots`` over the name-level call
+    relation, same-named functions merged (call sets union)."""
+    calls: Dict[str, Set[str]] = {}
+    defined: Set[str] = set()
+    for f in nodes:
+        defined.add(f.name)
+        calls.setdefault(f.name, set()).update(f.called_names)
+    reached = set(roots)
+    frontier = list(roots)
     while frontier:
         name = frontier.pop()
-        for callee in funcs[name].calls:
-            if callee in funcs and callee not in reached:
+        for callee in calls.get(name, ()):
+            if callee in defined and callee not in reached:
                 reached.add(callee)
                 frontier.append(callee)
     return reached
 
 
-def _enclosing_function(
-    tree: ast.Module, target: ast.AST
-) -> str:
-    best = "<module>"
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            if (
-                node.lineno <= target.lineno
-                and target.lineno <= max(
-                    getattr(node, "end_lineno", node.lineno), node.lineno
-                )
-            ):
-                best = node.name
-    return best
+def _fi_roots(nodes: Sequence[FuncNode]) -> Set[str]:
+    return {f.name for f in nodes if f.refs & _FI_MARKERS}
 
 
-def _raw_socket_findings(src: SourceFile) -> Iterator[Finding]:
-    funcs = _index_functions(src.tree)
-    covered = _shim_reachable(funcs)
-    for node in ast.walk(src.tree):
+def _raw_socket_findings(
+    ctx: RepoContext, rel: str
+) -> Iterator[Finding]:
+    graph = ctx.graph
+    src = ctx.by_rel[rel]
+    nodes = _module_nodes(graph, rel)
+    covered = _name_reachable(nodes, _fi_roots(nodes))
+    for node in src.nodes(ast.Call):
         if not (
-            isinstance(node, ast.Call)
-            and isinstance(node.func, ast.Attribute)
+            isinstance(node.func, ast.Attribute)
             and node.func.attr in _RAW_SOCKET_METHODS
         ):
             continue
-        fn = _enclosing_function(src.tree, node)
-        if fn in covered:
+        enclosing = graph.enclosing(rel, node.lineno)
+        fn_name = enclosing.name if enclosing is not None else "<module>"
+        if fn_name in covered:
             continue
         yield src.finding(
             _RULE,
             node.lineno,
-            f"raw socket `.{node.func.attr}(...)` in `{fn}` is not "
+            f"raw socket `.{node.func.attr}(...)` in `{fn_name}` is not "
             "reachable from any faultinject-shimmed function in this "
             "module — route it through a faultinject.runtime point "
             "(filter_bytes / send_frame_through) so chaos coverage "
@@ -149,93 +114,70 @@ def _raw_socket_findings(src: SourceFile) -> Iterator[Finding]:
         )
 
 
-def _codec_findings(src: SourceFile) -> Iterator[Finding]:
-    funcs: Dict[str, ast.FunctionDef] = {
-        node.name: node
-        for node in src.tree.body
-        if isinstance(node, ast.FunctionDef)
-    }
+def _codec_findings(ctx: RepoContext, rel: str) -> Iterator[Finding]:
+    graph = ctx.graph
+    src = ctx.by_rel[rel]
+    # Module-level codec functions only (methods are helpers of their
+    # classes, not the public byte lanes).
+    nodes = [f for f in _module_nodes(graph, rel) if f.cls is None]
+    by_name = {f.name: f for f in nodes}
 
-    def has_seam_or_delegates(fn: ast.FunctionDef, seen: Set[str]) -> bool:
-        if fn.name in seen:
-            return False
-        seen.add(fn.name)
-        for node in ast.walk(fn):
-            if (
-                isinstance(node, ast.Attribute)
-                and isinstance(node.value, ast.Name)
-                and node.value.id in _FI_MARKERS
+    # Seam-bearing: references _fi directly, or delegates (transitively)
+    # to a same-module encode_*/decode_* sibling that does.
+    seam: Set[str] = _fi_roots(nodes)
+    changed = True
+    while changed:
+        changed = False
+        for f in nodes:
+            if f.name in seam:
+                continue
+            if any(
+                callee in seam
+                and callee != f.name
+                and callee.startswith(("encode_", "decode_"))
+                and callee in by_name
+                for callee in f.called_names
             ):
-                return True
-            if isinstance(node, ast.Call):
-                name = (
-                    node.func.id
-                    if isinstance(node.func, ast.Name)
-                    else getattr(node.func, "attr", "")
-                )
-                if (
-                    name != fn.name
-                    and name.startswith(("encode_", "decode_"))
-                    and name in funcs
-                    and has_seam_or_delegates(funcs[name], seen)
-                ):
-                    return True
-        return False
+                seam.add(f.name)
+                changed = True
 
     # A sub-message helper (encode_ndarray inside encode_arrays_msg)
     # is covered when a seam-bearing sibling transitively CALLS it —
     # the fault fires one frame up and still corrupts these bytes.
-    covered_by_caller: Set[str] = set()
-    frontier = [
-        name
-        for name, fn in funcs.items()
-        if has_seam_or_delegates(fn, set())
-    ]
-    seen_callers: Set[str] = set(frontier)
-    while frontier:
-        caller = frontier.pop()
-        for node in ast.walk(funcs[caller]):
-            if isinstance(node, ast.Call):
-                name = (
-                    node.func.id
-                    if isinstance(node.func, ast.Name)
-                    else getattr(node.func, "attr", "")
-                )
-                if name in funcs and name not in seen_callers:
-                    covered_by_caller.add(name)
-                    seen_callers.add(name)
-                    frontier.append(name)
+    covered_by_caller = _name_reachable(nodes, seam)
 
-    for name, fn in sorted(funcs.items()):
+    for f in sorted(nodes, key=lambda f: f.name):
+        name = f.name
         if not name.startswith(("encode_", "decode_")):
             continue
         if name.startswith("_"):
             continue
-        if name in covered_by_caller:
+        if name in seam or name in covered_by_caller:
             continue
-        if not has_seam_or_delegates(fn, set()):
-            yield src.finding(
-                _RULE,
-                fn.lineno,
-                f"codec function `{name}` has no faultinject seam, does "
-                "not delegate to one, and no seam-bearing sibling calls "
-                "it — byte-lane chaos (corrupt/truncate/delay) cannot "
-                "reach it",
-            )
+        yield src.finding(
+            _RULE,
+            f.lineno,
+            f"codec function `{name}` has no faultinject seam, does "
+            "not delegate to one, and no seam-bearing sibling calls "
+            "it — byte-lane chaos (corrupt/truncate/delay) cannot "
+            "reach it",
+        )
 
 
 @rule(
     _RULE,
     "raw socket send/recv and codec encode/decode paths in service/ and "
-    "routing/ must route through a faultinject.runtime injection point",
+    "routing/ must route through a faultinject.runtime injection point "
+    "(reachability on the shared graftflow call graph)",
+    scope="repo",
 )
-def check_fault_shim_coverage(src: SourceFile) -> Iterator[Finding]:
-    if not src.is_python:
-        return
-    if src.rel in _CODEC_FILES:
-        yield from _codec_findings(src)
-        yield from _raw_socket_findings(src)
-        return
-    if not src.rel.startswith(_SCOPE_PREFIXES):
-        return
-    yield from _raw_socket_findings(src)
+def check_fault_shim_coverage(ctx: RepoContext) -> Iterator[Finding]:
+    for src in ctx:
+        if not src.is_python:
+            continue
+        if src.rel in _CODEC_FILES:
+            yield from _codec_findings(ctx, src.rel)
+            yield from _raw_socket_findings(ctx, src.rel)
+            continue
+        if src.rel.startswith(_SCOPE_PREFIXES):
+            yield from _raw_socket_findings(ctx, src.rel)

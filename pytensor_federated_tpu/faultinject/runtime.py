@@ -68,6 +68,8 @@ __all__ = [
     "install_from_env",
     "decide",
     "filter_bytes",
+    "filter_bytes_async",
+    "call_shimmed_async",
     "send_frame_through",
     "compute_filter",
     "compute_filter_async",
@@ -185,7 +187,14 @@ def _kill_now(point: str) -> None:
 def apply_to_bytes(rule: FaultRule, buf: bytes, point: str) -> bytes:
     """Apply a byte-lane fault to an in-hand buffer (codec seams and
     recv paths, where "mid-frame" has no transport meaning): may sleep,
-    mutate, raise :class:`ConnectionError`, or kill the process."""
+    mutate, raise :class:`ConnectionError`, or kill the process.
+
+    SYNC callers only — the delay/stall kinds ``time.sleep``.  Async
+    callers handle those kinds with ``await asyncio.sleep`` first
+    (:func:`filter_bytes_async`, ``server._fi_reply_filter``) and
+    delegate the rest to :func:`transform_bytes`, which never sleeps —
+    the split keeps every blocking primitive off loop-reachable paths
+    (graftflow ``async-blocking``)."""
     kind = rule.kind
     if kind == "delay":
         time.sleep(rule.delay_s)
@@ -193,6 +202,13 @@ def apply_to_bytes(rule: FaultRule, buf: bytes, point: str) -> bytes:
     if kind == "stall":
         time.sleep(rule.stall_s)
         return buf
+    return transform_bytes(rule, buf, point)
+
+
+def transform_bytes(rule: FaultRule, buf: bytes, point: str) -> bytes:
+    """The sleep-free byte-lane kinds: mutate, raise, or kill — safe
+    from any context, event loop included."""
+    kind = rule.kind
     if kind in ("drop", "disconnect"):
         raise ConnectionError(f"faultinject[{kind}] at {point}")
     if kind == "truncate_frame":
@@ -231,7 +247,44 @@ async def filter_bytes_async(
             rule.delay_s if rule.kind == "delay" else rule.stall_s
         )
         return buf
-    return apply_to_bytes(rule, buf, point)
+    return transform_bytes(rule, buf, point)
+
+
+async def call_shimmed_async(fn, *args, inline: bool = True, **kwargs):
+    """Call a sync function that HOLDS chaos seams (codec
+    ``filter_bytes`` points, the vectorized ``mangle_batch_result``
+    seam) from a coroutine without ever blocking the event loop.
+
+    ``inline=True`` is the production fast path: a direct call, taken
+    only while NO fault plan is active.  With a plan installed — or
+    with ``inline=False`` (callers that always want the executor
+    handoff, e.g. the non-inline batcher) — the call runs in the
+    loop's default executor, so a sync shim's delay/stall sleeps a
+    worker thread and a chaos-slowed frame behaves like a slow
+    network, not a frozen driver.
+
+    This exists because graftflow's transitive ``async-blocking`` rule
+    found the PR-5 bug class again, three frames down: async handlers
+    call the sync codecs inline, and the codecs hold ``filter_bytes``
+    seams whose delay kinds ``time.sleep`` (tests:
+    test_faultinject.py ``TestCallShimmedAsync``).
+
+    The executor call carries the CALLER's contextvars
+    (``copy_context``): the codecs read the ambient telemetry trace id
+    (``spans.current_trace_id``), and a bare worker thread would
+    silently encode ``trace_id=None`` exactly during chaos runs —
+    the same convention as routing/pooled_client's executor hops."""
+    if inline and active_plan is None:
+        return fn(*args, **kwargs)
+    import asyncio
+    import contextvars
+    from functools import partial
+
+    loop = asyncio.get_running_loop()
+    ctx = contextvars.copy_context()
+    return await loop.run_in_executor(
+        None, ctx.run, partial(fn, *args, **kwargs)
+    )
 
 
 def send_frame_through(

@@ -15,7 +15,7 @@ shared, tested without either framework).
 
 from __future__ import annotations
 
-from typing import Dict, List
+from typing import Any, Dict, List, Set
 
 from jax.extend.core import Var
 
@@ -25,7 +25,7 @@ from .primitives import fed_map_p
 __all__ = ["plan_windows"]
 
 
-def plan_windows(jaxpr) -> Dict[int, List[int]]:
+def plan_windows(jaxpr: Any) -> Dict[int, List[int]]:
     """Map each fused ``fed_map`` equation index to its group (a list
     of mutually independent eqn indices, topo order).  Only groups of
     two or more appear — singletons lower one call at a time.  Safety
@@ -38,7 +38,7 @@ def plan_windows(jaxpr) -> Dict[int, List[int]]:
         for v in eqn.outvars:
             producer[v] = i
 
-    def parents(i: int):
+    def parents(i: int) -> Set[int]:
         seen = set()
         for v in eqns[i].invars:
             if isinstance(v, Var) and v in producer:

@@ -1,0 +1,94 @@
+"""Pool-lane ``fed.program`` fixtures for the ``fed-placement`` lint.
+
+The PR-6 contract (:mod:`.placements`): a pool-placed ``fed_map``
+ships ONLY its mapped leaves — a closure that captures a
+driver-varying value (a program input, or the output of an upstream
+equation) would silently compute with whatever the node baked at
+deploy time, so ``PoolPlacement.group_executor`` refuses it with a
+``ValueError`` at runtime.  graftflow's ``fed-placement`` rule
+(:mod:`..analysis.rules_fedflow`) moves that refusal to CI: it traces
+every fixture registered here CPU-only, walks the jaxpr, and flags any
+``fed_map`` equation whose closure captures a driver-varying operand —
+with the operand's provenance chain in the finding.
+
+Register a fixture for every fed program shape the repo ships on the
+pool lane.  A fixture is the *placement-free* model (tracing needs no
+transport); pool intent is what registration here asserts.  Keep the
+example arguments tiny — the lint traces, it never executes shards.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Sequence, Tuple
+
+__all__ = ["LintFixture", "FIXTURES"]
+
+FixtureProgram = Tuple[Callable[..., Any], Tuple[Any, ...]]
+
+
+@dataclasses.dataclass(frozen=True)
+class LintFixture:
+    """One traceable pool-lane program: ``build()`` returns
+    ``(fn, example_args)``; the lint calls ``jax.make_jaxpr(fn)(*args)``
+    under the CPU backend."""
+
+    name: str
+    build: Callable[[], FixtureProgram]
+
+
+def _canonical_round() -> FixtureProgram:
+    """The FederatedLogpGrad model: broadcast -> map -> sum.  Params
+    reach the shards through ``fed_broadcast`` (making them MAPPED
+    operands), so the closure captures nothing driver-varying — the
+    clean shape every pool deployment should follow."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from .primitives import fed_broadcast, fed_map, fed_sum
+
+    data = jnp.asarray(np.arange(12.0, dtype=np.float32).reshape(4, 3))
+
+    def model(params: Any) -> Any:
+        pb = fed_broadcast((params,), 4)
+        lps = fed_map(
+            lambda shard: jnp.sum(shard[0][0] * shard[1]), (pb, data)
+        )
+        return fed_sum(lps)
+
+    return model, (jnp.ones((3,), jnp.float32),)
+
+
+def _two_potential_window() -> FixtureProgram:
+    """Two independent fed_maps (the fused-window shape,
+    ``bridge.core.fused_jax_callable``): both members must stay free of
+    driver-varying closure capture for the fused pool window to ship."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from .primitives import fed_broadcast, fed_map, fed_sum
+
+    data_a = jnp.asarray(np.ones((4, 3), np.float32))
+    data_b = jnp.asarray(np.full((2, 5), 2.0, np.float32))
+
+    def model(pa: Any, pb_: Any) -> Any:
+        ba = fed_broadcast((pa,), 4)
+        bb = fed_broadcast((pb_,), 2)
+        la = fed_map(
+            lambda shard: jnp.sum(shard[0][0] * shard[1]), (ba, data_a)
+        )
+        lb = fed_map(
+            lambda shard: jnp.sum(shard[0][0][:5] + shard[1]), (bb, data_b)
+        )
+        return fed_sum(la) + fed_sum(lb)
+
+    return model, (
+        jnp.ones((3,), jnp.float32),
+        jnp.ones((5,), jnp.float32),
+    )
+
+
+FIXTURES: Sequence[LintFixture] = (
+    LintFixture(name="canonical-round", build=_canonical_round),
+    LintFixture(name="two-potential-window", build=_two_potential_window),
+)

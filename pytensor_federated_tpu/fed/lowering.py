@@ -48,7 +48,7 @@ __all__ = ["FederatedLogpGrad", "program"]
 
 
 def _build_executors(
-    closed, placement: Placement, plan
+    closed: Any, placement: Placement, plan: Dict[int, List[int]]
 ) -> Dict[int, tuple]:
     """One persistent executor per ``fed_map`` equation: fused groups
     share a group executor keyed at every member index.  Outer
@@ -99,7 +99,7 @@ def program(
         return fn
     cache: dict = {}
 
-    def wrapped(*args):
+    def wrapped(*args: Any) -> Any:
         flat, in_tree = tree_util.tree_flatten(args)
         flat = [jnp.asarray(x) for x in flat]
         key = (
@@ -110,7 +110,7 @@ def program(
         if entry is None:
             out_store: list = []
 
-            def flat_fn(*leaves):
+            def flat_fn(*leaves: Any) -> List[Any]:
                 a = tree_util.tree_unflatten(in_tree, leaves)
                 out_flat, out_tree = tree_util.tree_flatten(fn(*a))
                 out_store.append(out_tree)
@@ -130,32 +130,37 @@ def program(
     return wrapped
 
 
-def _interpret(closed, args: List[Any], plan, executors) -> list:
+def _interpret(
+    closed: Any,
+    args: List[Any],
+    plan: Dict[int, List[int]],
+    executors: Dict[int, tuple],
+) -> list:
     jaxpr = closed.jaxpr
     env: dict = {}
 
-    def read(v):
+    def read(v: Any) -> Any:
         return v.val if isinstance(v, Literal) else env[v]
 
-    def write(vs, vals):
+    def write(vs: Any, vals: Any) -> None:
         for v, val in zip(vs, vals):
             env[v] = val
 
     write(jaxpr.constvars, closed.consts)
     write(jaxpr.invars, args)
 
-    def ready(i) -> bool:
+    def ready(i: int) -> bool:
         return all(
             isinstance(v, Literal) or v in env
             for v in jaxpr.eqns[i].invars
         )
 
-    def consts_xs(eqn) -> Tuple[tuple, tuple]:
+    def consts_xs(eqn: Any) -> Tuple[tuple, tuple]:
         invals = [read(v) for v in eqn.invars]
         n_consts = eqn.params["n_consts"]
         return tuple(invals[:n_consts]), tuple(invals[n_consts:])
 
-    def run_eqn(eqn, i):
+    def run_eqn(eqn: Any, i: int) -> None:
         if eqn.primitive is fed_map_p:
             _, executor = executors[i]
             outs = executor(*consts_xs(eqn))
@@ -232,7 +237,7 @@ class FederatedLogpGrad:
         *,
         placement: Optional[Placement] = None,
         fuse: bool = True,
-    ):
+    ) -> None:
         self.per_shard_fn = per_shard_fn
         self.data = data
         self.placement = placement
@@ -250,7 +255,7 @@ class FederatedLogpGrad:
 
     # The canonical round, in primitives (placement-free: `program`
     # owns the lowering).
-    def _model(self, *params):
+    def _model(self, *params: Any) -> Any:
         pb = fed_broadcast(tuple(params), self.n_shards)
         lps = fed_map(
             lambda shard: self.per_shard_fn(*shard[0], shard[1]),
@@ -258,32 +263,32 @@ class FederatedLogpGrad:
         )
         return fed_sum(lps)
 
-    def fed_model(self, *params):
+    def fed_model(self, *params: Any) -> Any:
         """The raw primitive-level model (no placement) — what
         ``fused_jax_callable`` composes across potentials so the fused
         program's batching pass sees every member's ``fed_map``."""
         return self._model(*params)
 
-    def logp(self, *params) -> jax.Array:
+    def logp(self, *params: Any) -> jax.Array:
         return self._program(*params)
 
-    def logp_and_grad(self, *params):
+    def logp_and_grad(self, *params: Any) -> Tuple[Any, Any]:
         argnums = tuple(range(len(params)))
         return jax.value_and_grad(self._program, argnums=argnums)(*params)
 
-    def jax_fn(self, *params):
+    def jax_fn(self, *params: Any) -> Tuple[Any, List[Any]]:
         """``(logp, grads)`` for the bridge's ``jax_funcify`` lane."""
         logp, grads = self.logp_and_grad(*params)
         return logp, list(grads)
 
-    def __call__(self, *arrays):
+    def __call__(self, *arrays: Any) -> Tuple[Any, List[Any]]:
         """Host ``LogpGradFn``: numpy in, ``(logp, [grads])`` out."""
         logp, grads = self.logp_and_grad(
             *[jnp.asarray(a) for a in arrays]
         )
         return np.asarray(logp), [np.asarray(g) for g in grads]
 
-    def node_compute(self, *, grads: bool = True):
+    def node_compute(self, *, grads: bool = True) -> Callable[..., list]:
         """Node-side compute matching this evaluator's wire contract:
         requests carry ``(params leaves..., data leaves...)``."""
         from .placements import make_node_compute
@@ -292,7 +297,7 @@ class FederatedLogpGrad:
         n_data = treedef.num_leaves
         per_shard = self.per_shard_fn
 
-        def flat(*arrays):
+        def flat(*arrays: Any) -> Any:
             params = arrays[: len(arrays) - n_data]
             dleaves = arrays[len(arrays) - n_data :]
             return per_shard(

@@ -81,7 +81,9 @@ class MapSpec:
     n_varying_consts: int = 0
 
     @classmethod
-    def from_eqn(cls, eqn, baked_vars=frozenset()) -> "MapSpec":
+    def from_eqn(
+        cls, eqn: Any, baked_vars: frozenset = frozenset()
+    ) -> "MapSpec":
         from jax.extend.core import Literal as _Literal
 
         jaxpr = eqn.params["jaxpr"]
@@ -153,7 +155,7 @@ class Placement:
 
     # Convenience single-shot lowering (wrappers, tests): build an
     # executor and run it once.
-    def lower_map(self, spec: MapSpec, consts, xs) -> List[Any]:
+    def lower_map(self, spec: MapSpec, consts: Any, xs: Any) -> List[Any]:
         return self.map_executor(spec)(tuple(consts), tuple(xs))
 
 
@@ -170,7 +172,7 @@ class MeshPlacement(Placement):
     cache.
     """
 
-    def __init__(self, mesh, axis: str = SHARDS_AXIS):
+    def __init__(self, mesh: Any, axis: str = SHARDS_AXIS) -> None:
         if axis not in mesh.axis_names:
             raise ValueError(
                 f"mesh has no axis {axis!r}: {mesh.axis_names}"
@@ -191,7 +193,7 @@ class MeshPlacement(Placement):
             )
         fun = _per_shard_fun(spec.jaxpr)
 
-        def local(consts, local_xs):
+        def local(consts: Any, local_xs: Any) -> Any:
             consts = mark_varying(consts, axis)
             return jax.vmap(lambda *s: tuple(fun(*consts, *s)))(*local_xs)
 
@@ -226,7 +228,9 @@ class PoolPlacement(Placement):
     the reference's one-service-fn-per-node topology.
     """
 
-    def __init__(self, client, *, window: int = 8, logp_dtype=None):
+    def __init__(
+        self, client: Any, *, window: int = 8, logp_dtype: Any = None
+    ) -> None:
         self.client = client
         self.window = int(window)
         self.logp_dtype = logp_dtype
@@ -236,7 +240,9 @@ class PoolPlacement(Placement):
 
     # -- host side ---------------------------------------------------------
 
-    def _run_window(self, metas, flat_np):
+    def _run_window(
+        self, metas: Sequence[Tuple[int, int]], flat_np: Sequence[Any]
+    ) -> List[list]:
         """One fused evaluate_many over every call's shards.  Returns
         the raw reply list per request, sliced per call."""
         requests: list = []
@@ -272,7 +278,7 @@ class PoolPlacement(Placement):
     def map_executor(self, spec: MapSpec) -> MapExecutor:
         group = self.group_executor([spec])
 
-        def run(consts, xs):
+        def run(consts: Any, xs: Any) -> List[Any]:
             return group([(consts, xs)])[0]
 
         return run
@@ -342,14 +348,14 @@ class PoolPlacement(Placement):
             for av in x_specs
         )
 
-        def host_logps(*arrays):
+        def host_logps(*arrays: Any) -> tuple:
             per_call = self._run_window(metas, arrays)
             return tuple(
                 np.asarray([r[0] for r in replies], dtype=dt)
                 for replies, dt in zip(per_call, logp_dts)
             )
 
-        def host_logps_grads(*arrays):
+        def host_logps_grads(*arrays: Any) -> tuple:
             per_call = self._run_window(metas, arrays)
             out = [
                 np.asarray([r[0] for r in replies], dt)
@@ -369,12 +375,12 @@ class PoolPlacement(Placement):
         n_calls = len(specs)
 
         @jax.custom_vjp
-        def window_call(*flat):
+        def window_call(*flat: Any) -> tuple:
             return jax.pure_callback(
                 host_logps, logp_specs, *flat, vmap_method="sequential"
             )
 
-        def fwd(*flat):
+        def fwd(*flat: Any) -> Tuple[tuple, tuple]:
             outs = jax.pure_callback(
                 host_logps_grads,
                 logp_specs + grad_specs,
@@ -383,7 +389,7 @@ class PoolPlacement(Placement):
             )
             return tuple(outs[:n_calls]), tuple(outs[n_calls:])
 
-        def bwd(residual_grads, cts):
+        def bwd(residual_grads: Any, cts: Any) -> tuple:
             flat_ct = []
             k = 0
             for ci in range(n_calls):
@@ -437,7 +443,7 @@ class PoolPlacement(Placement):
             sp for call in out_specs_per_call for sp in call
         )
 
-        def host(*arrays):
+        def host(*arrays: Any) -> tuple:
             per_call = self._run_window(metas, arrays)
             out = []
             for replies, call_specs in zip(per_call, out_specs_per_call):
@@ -466,7 +472,7 @@ class PoolPlacement(Placement):
         return run
 
 
-def _grad_dtype(dt):
+def _grad_dtype(dt: Any) -> Any:
     return dt if jnp.issubdtype(dt, jnp.inexact) else jnp.float32
 
 
@@ -482,7 +488,7 @@ class MixedPlacement(Placement):
         pool: PoolPlacement,
         *,
         pool_shards: int,
-    ):
+    ) -> None:
         self.mesh = mesh
         self.pool = pool
         self.pool_shards = int(pool_shards)
@@ -509,7 +515,7 @@ class MixedPlacement(Placement):
     def map_executor(self, spec: MapSpec) -> MapExecutor:
         group = self.group_executor([spec])
 
-        def run(consts, xs):
+        def run(consts: Any, xs: Any) -> List[Any]:
             return group([(consts, xs)])[0]
 
         return run
@@ -566,7 +572,7 @@ def make_node_compute(
 
     if grads:
 
-        def compute(*arrays):
+        def compute(*arrays: Any) -> list:
             args = [jnp.asarray(a) for a in arrays]
             diff_idx = [
                 i
@@ -574,7 +580,7 @@ def make_node_compute(
                 if jnp.issubdtype(a.dtype, jnp.inexact)
             ]
 
-            def f(diff_args):
+            def f(diff_args: Any) -> Any:
                 full = list(args)
                 for i, v in zip(diff_idx, diff_args):
                     full[i] = v
@@ -596,7 +602,7 @@ def make_node_compute(
 
         return compute
 
-    def compute_fwd(*arrays):
+    def compute_fwd(*arrays: Any) -> list:
         out = per_shard_fn(*[jnp.asarray(a) for a in arrays])
         import jax.tree_util as tu
 

@@ -37,7 +37,7 @@ like plain JAX.  Placement-aware execution is :func:`..fed.program`
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional
+from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -64,14 +64,14 @@ except ImportError:  # pragma: no cover
     from jax._src.core import ShapedArray, Tracer as _Tracer
 
 
-def is_tracer(x) -> bool:
+def is_tracer(x: Any) -> bool:
     """Whether ``x`` belongs to an ambient trace (vs a concrete value) —
     the eager-fast-path / cache-safety discriminator shared by the
     placement executors and ``fed.program``."""
     return isinstance(x, _Tracer)
 
 
-def _leading_dim(leaves) -> int:
+def _leading_dim(leaves: Sequence[Any]) -> int:
     dims = {jnp.shape(l)[0] for l in leaves}
     if len(dims) != 1:
         raise ValueError(
@@ -80,24 +80,24 @@ def _leading_dim(leaves) -> int:
     return int(dims.pop())
 
 
-def _aval(x) -> jax.ShapeDtypeStruct:
+def _aval(x: Any) -> jax.ShapeDtypeStruct:
     return jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x))
 
 
-def _shard_aval(x) -> jax.ShapeDtypeStruct:
+def _shard_aval(x: Any) -> jax.ShapeDtypeStruct:
     return jax.ShapeDtypeStruct(jnp.shape(x)[1:], jnp.result_type(x))
 
 
-def _closed(jaxpr) -> jex_core.ClosedJaxpr:
+def _closed(jaxpr: Any) -> jex_core.ClosedJaxpr:
     return jex_core.ClosedJaxpr(jaxpr, ())
 
 
-def _per_shard_fun(jaxpr) -> Callable:
+def _per_shard_fun(jaxpr: Any) -> Callable:
     """The per-shard function ``(consts..., shard_leaves...) -> outs``."""
     return jex_core.jaxpr_as_fun(_closed(jaxpr))
 
 
-def _trace_flat(fn, avals):
+def _trace_flat(fn: Callable, avals: Sequence[Any]) -> Tuple[Any, List[Any]]:
     """``make_jaxpr`` + closure conversion: constants the trace lifts
     (including tracers from an enclosing trace) become leading invars,
     returned separately so the caller binds them as operands."""
@@ -134,7 +134,7 @@ def fed_map(fn: Callable[[Any], Any], data: Any) -> Any:
     n_shards = _leading_dim(flat)
     out_store = []
 
-    def per_shard(*shard_leaves):
+    def per_shard(*shard_leaves: Any) -> List[Any]:
         shard = tree_util.tree_unflatten(in_tree, shard_leaves)
         out_flat, out_tree = tree_util.tree_flatten(fn(shard))
         out_store.append(out_tree)
@@ -151,7 +151,7 @@ def fed_map(fn: Callable[[Any], Any], data: Any) -> Any:
     return tree_util.tree_unflatten(out_store[0], outs)
 
 
-def _fed_map_dense(args, *, jaxpr, n_consts, n_shards):
+def _fed_map_dense(args: Sequence[Any], *, jaxpr: Any, n_consts: int, n_shards: int) -> List[Any]:
     fun = _per_shard_fun(jaxpr)
     in_axes = (None,) * n_consts + (0,) * (len(args) - n_consts)
     outs = jax.vmap(lambda *a: tuple(fun(*a)), in_axes=in_axes)(*args)
@@ -169,23 +169,23 @@ mlir.register_lowering(
 
 
 @fed_map_p.def_abstract_eval
-def _fed_map_abstract(*in_avals, jaxpr, n_consts, n_shards):
+def _fed_map_abstract(*in_avals: Any, jaxpr: Any, n_consts: int, n_shards: int) -> List[Any]:
     return [
         ShapedArray((n_shards,) + tuple(v.aval.shape), v.aval.dtype)
         for v in jaxpr.outvars
     ]
 
 
-def _inexact(x) -> bool:
+def _inexact(x: Any) -> bool:
     return jnp.issubdtype(jnp.result_type(x), jnp.inexact)
 
 
-def _zero_tangent_like(x):
+def _zero_tangent_like(x: Any) -> Any:
     # Integer/bool primals take float0 tangents (the jax.jvp contract).
     return np.zeros(jnp.shape(x), jax.dtypes.float0)
 
 
-def _fed_map_jvp(primals, tangents, *, jaxpr, n_consts, n_shards):
+def _fed_map_jvp(primals: Sequence[Any], tangents: Sequence[Any], *, jaxpr: Any, n_consts: int, n_shards: int) -> Tuple[List[Any], List[Any]]:
     """Primal bind plus a SEPARATE tangent ``fed_map`` bind.
 
     Two binds (rather than one jvp-of-fn bind returning both) keep
@@ -222,7 +222,7 @@ def _fed_map_jvp(primals, tangents, *, jaxpr, n_consts, n_shards):
     # Argument order mirrors the bind below EXACTLY (unmapped operands
     # first): primal consts, tangent consts, mapped primals, mapped
     # tangents.
-    def tangent_fn(*a):
+    def tangent_fn(*a: Any) -> List[Any]:
         pc = a[:n_consts]
         tc = dict(zip(lin_consts, a[n_consts : n_consts + len(lin_consts)]))
         off = n_consts + len(lin_consts)
@@ -269,11 +269,11 @@ def _fed_map_jvp(primals, tangents, *, jaxpr, n_consts, n_shards):
     return primal_out, tangents_out
 
 
-def _inexact_var(v) -> bool:
+def _inexact_var(v: Any) -> bool:
     return jnp.issubdtype(v.aval.dtype, jnp.inexact)
 
 
-def _symbolic_zero_for(v, n_shards):
+def _symbolic_zero_for(v: Any, n_shards: int) -> Any:
     aval = ShapedArray((n_shards,) + tuple(v.aval.shape), v.aval.dtype)
     try:
         return ad.Zero(aval.to_tangent_aval())
@@ -284,7 +284,7 @@ def _symbolic_zero_for(v, n_shards):
 ad.primitive_jvps[fed_map_p] = _fed_map_jvp
 
 
-def _fed_map_transpose(cts, *args, jaxpr, n_consts, n_shards):
+def _fed_map_transpose(cts: Sequence[Any], *args: Any, jaxpr: Any, n_consts: int, n_shards: int) -> List[Any]:
     fun = _per_shard_fun(jaxpr)
     n_in = len(args)
     lin_idx = [i for i in range(n_in) if ad.is_undefined_primal(args[i])]
@@ -297,19 +297,19 @@ def _fed_map_transpose(cts, *args, jaxpr, n_consts, n_shards):
             for i in range(n_in)
         ]
 
-    def lin_shard_aval(i):
+    def lin_shard_aval(i: int) -> jax.ShapeDtypeStruct:
         av = args[i].aval
         shape = tuple(av.shape) if i < n_consts else tuple(av.shape)[1:]
         return jax.ShapeDtypeStruct(shape, av.dtype)
 
     lin_avals = [lin_shard_aval(i) for i in lin_idx]
 
-    def transposed_shard(*ops):
+    def transposed_shard(*ops: Any) -> List[Any]:
         k1, k2 = len(nl_un), len(nl_mapped)
         vals = dict(zip(nl_un + nl_mapped, ops[: k1 + k2]))
         ct_shard = list(ops[k1 + k2 :])
 
-        def lin(*lin_vals):
+        def lin(*lin_vals: Any) -> List[Any]:
             full = [None] * n_in
             for i, v in vals.items():
                 full[i] = v
@@ -348,7 +348,7 @@ def _fed_map_transpose(cts, *args, jaxpr, n_consts, n_shards):
 ad.primitive_transposes[fed_map_p] = _fed_map_transpose
 
 
-def _fed_map_batching(args, dims, *, jaxpr, n_consts, n_shards):
+def _fed_map_batching(args: Sequence[Any], dims: Sequence[Any], *, jaxpr: Any, n_consts: int, n_shards: int) -> Tuple[List[Any], List[Any]]:
     fun = _per_shard_fun(jaxpr)
     new_args, inner_axes = [], []
     for i, (a, d) in enumerate(zip(args, dims)):
@@ -364,7 +364,7 @@ def _fed_map_batching(args, dims, *, jaxpr, n_consts, n_shards):
             new_args.append(jnp.moveaxis(a, d, 1))
             inner_axes.append(0)
 
-    def batched_shard(*shard_args):
+    def batched_shard(*shard_args: Any) -> List[Any]:
         return tuple(
             jax.vmap(lambda *x: tuple(fun(*x)), in_axes=tuple(inner_axes))(
                 *shard_args
@@ -405,7 +405,7 @@ def fed_sum(values: Any) -> Any:
     )
 
 
-def _fed_sum_impl(x):
+def _fed_sum_impl(x: Any) -> Any:
     return jnp.sum(x, axis=0)
 
 
@@ -416,13 +416,13 @@ mlir.register_lowering(
 
 
 @fed_sum_p.def_abstract_eval
-def _fed_sum_abstract(x):
+def _fed_sum_abstract(x: Any) -> Any:
     if not x.shape:
         raise ValueError("fed_sum operand must carry a leading shards axis")
     return ShapedArray(tuple(x.shape)[1:], x.dtype)
 
 
-def _fed_sum_transpose(ct, x):
+def _fed_sum_transpose(ct: Any, x: Any) -> List[Any]:
     if type(ct) is ad.Zero:
         return [ad.Zero(x.aval)]
     return [fed_broadcast_p.bind(ct, n_shards=int(x.aval.shape[0]))]
@@ -431,7 +431,7 @@ def _fed_sum_transpose(ct, x):
 ad.deflinear2(fed_sum_p, _fed_sum_transpose)
 
 
-def _fed_sum_batching(args, dims):
+def _fed_sum_batching(args: Sequence[Any], dims: Sequence[Any]) -> Tuple[Any, Any]:
     (x,), (d,) = args, dims
     out = fed_sum_p.bind(jnp.moveaxis(x, d, -1))
     return out, out.ndim - 1
@@ -460,7 +460,7 @@ def fed_broadcast(value: Any, n_shards: int) -> Any:
     )
 
 
-def _fed_broadcast_impl(x, *, n_shards):
+def _fed_broadcast_impl(x: Any, *, n_shards: int) -> Any:
     return jnp.broadcast_to(x, (n_shards,) + jnp.shape(x))
 
 
@@ -472,11 +472,11 @@ mlir.register_lowering(
 
 
 @fed_broadcast_p.def_abstract_eval
-def _fed_broadcast_abstract(x, *, n_shards):
+def _fed_broadcast_abstract(x: Any, *, n_shards: int) -> Any:
     return ShapedArray((n_shards,) + tuple(x.shape), x.dtype)
 
 
-def _fed_broadcast_transpose(ct, x, *, n_shards):
+def _fed_broadcast_transpose(ct: Any, x: Any, *, n_shards: int) -> List[Any]:
     if type(ct) is ad.Zero:
         return [ad.Zero(x.aval)]
     return [fed_sum_p.bind(ct)]
@@ -485,7 +485,7 @@ def _fed_broadcast_transpose(ct, x, *, n_shards):
 ad.deflinear2(fed_broadcast_p, _fed_broadcast_transpose)
 
 
-def _fed_broadcast_batching(args, dims, *, n_shards):
+def _fed_broadcast_batching(args: Sequence[Any], dims: Sequence[Any], *, n_shards: int) -> Tuple[Any, Any]:
     (x,), (d,) = args, dims
     out = fed_broadcast_p.bind(x, n_shards=n_shards)
     return out, d + 1
@@ -523,7 +523,7 @@ def fed_mean(values: Any, weights: Optional[jax.Array] = None) -> Any:
         )
     w = w / jnp.sum(w)
 
-    def wmean(l):
+    def wmean(l: Any) -> Any:
         l = jnp.asarray(l)
         wb = w.reshape((-1,) + (1,) * (l.ndim - 1))
         return fed_sum_p.bind(l * wb)

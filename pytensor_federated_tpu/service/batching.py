@@ -426,10 +426,13 @@ class MicroBatcher:
             results: Optional[List[object]] = None
             vmapped_ok = False
             if use_batch:
-                results = (
-                    batch_job()
-                    if self.inline
-                    else await loop.run_in_executor(None, batch_job)
+                # call_shimmed_async: the inline fast path runs
+                # batch_job on the loop ONLY while no fault plan is
+                # active — batch_job holds the sync vectorized chaos
+                # seam (mangle_batch_result), whose delay kinds sleep
+                # (graftflow async-blocking; the PR-5 bug class).
+                results = await _fi.call_shimmed_async(
+                    batch_job, inline=self.inline
                 )
                 vmapped_ok = results is not None
             if results is None:

@@ -547,7 +547,12 @@ class ArraysToArraysServiceClient:
         (e.g. a previous call cancelled between write and read) stays
         off-by-one forever — drops the connection so the next call
         reconnects cleanly, then raises."""
-        outputs, reply_uuid, error = decode(reply)
+        # Off-loop when chaos is active: the decoder holds sync
+        # byte-lane seams whose delay kinds sleep (graftflow
+        # async-blocking; the PR-5 bug class).
+        outputs, reply_uuid, error = await _fi.call_shimmed_async(
+            decode, reply
+        )
         if error is None and reply_uuid != uuid:
             await self._drop_privates()
             raise RuntimeError(
@@ -569,7 +574,9 @@ class ArraysToArraysServiceClient:
             # The span (entered above) binds the trace id the encode
             # step stamps into the request.
             with _spans.span("encode"):
-                request, uuid, decode = self._encode_request(arrays)
+                request, uuid, decode = await _fi.call_shimmed_async(
+                    self._encode_request, arrays
+                )
             mode = "stream" if self.use_stream else "unary"
             last_exc: Optional[BaseException] = None
             for attempt in range(self.retries + 1):
@@ -835,7 +842,9 @@ class ArraysToArraysServiceClient:
         frames = []  # (frame_bytes, outer_uuid, start, part)
         for start in range(0, n, chunk):
             part = encoded[start : start + chunk]
-            frame, outer_uuid = self._encode_batch_frame(part, trace_id)
+            frame, outer_uuid = await _fi.call_shimmed_async(
+                self._encode_batch_frame, part, trace_id
+            )
             _FRAME_REQS.labels(transport="grpc").observe(len(part))
             frames.append((frame, outer_uuid, start, part))
         results: List[Optional[List[np.ndarray]]] = (
@@ -848,7 +857,9 @@ class ArraysToArraysServiceClient:
             (for the error-drain path)."""
             _frame, outer_uuid, start, part = frames[frame_idx]
             try:
-                items, ruuid, outer_error = self._decode_batch_frame(reply)
+                items, ruuid, outer_error = await _fi.call_shimmed_async(
+                    self._decode_batch_frame, reply
+                )
             except (grpc.aio.AioRpcError, ConnectionError, OSError):
                 raise
             except BaseException:
@@ -880,8 +891,8 @@ class ArraysToArraysServiceClient:
                 zip(items, part)
             ):
                 try:
-                    outputs, ruuid_j, error_j = self._decode_batch_item(
-                        item
+                    outputs, ruuid_j, error_j = await _fi.call_shimmed_async(
+                        self._decode_batch_item, item
                     )
                 except (grpc.aio.AioRpcError, ConnectionError, OSError):
                     raise
@@ -1052,7 +1063,11 @@ class ArraysToArraysServiceClient:
             window=window,
         ) as root:
             with _spans.span("encode"):
-                encoded = [self._encode_request(args) for args in requests]
+                encoded = await _fi.call_shimmed_async(
+                    lambda: [
+                        self._encode_request(args) for args in requests
+                    ]
+                )
             if not encoded:
                 return []
             t0 = time.perf_counter()
@@ -1165,7 +1180,11 @@ class ArraysToArraysServiceClient:
             partial=True,
         ):
             with _spans.span("encode"):
-                encoded = [self._encode_request(args) for args in requests]
+                encoded = await _fi.call_shimmed_async(
+                    lambda: [
+                        self._encode_request(args) for args in requests
+                    ]
+                )
             if not encoded:
                 return [], None
             out: List[Optional[List[np.ndarray]]] = [None] * len(encoded)

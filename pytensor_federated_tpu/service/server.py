@@ -123,8 +123,9 @@ async def _fi_reply_filter(reply: bytes, context, *, unary: bool = False) -> tup
             )
         return reply, 2
     # truncate_frame / corrupt_bytes / kill_process share the byte-lane
-    # semantics (an inapplicable kind raises FaultPlanError, loudly).
-    return _fi.apply_to_bytes(rule, reply, "grpc.server.reply"), 1
+    # semantics (an inapplicable kind raises FaultPlanError, loudly);
+    # transform_bytes is the sleep-free half, safe on the loop.
+    return _fi.transform_bytes(rule, reply, "grpc.server.reply"), 1
 
 
 def device_compute_fn(
@@ -294,22 +295,29 @@ class ArraysToArraysService:
         if not is_npwire and npproto_codec.has_batch_items(request):
             return await self._run_batch_npproto(request, t_arrive)
         trace_id = None
+        # Codec calls go through _fi.call_shimmed_async: the codecs
+        # hold sync byte-lane chaos seams whose delay kinds sleep, so
+        # with a fault plan active they run in the executor instead of
+        # on the loop (graftflow async-blocking; the PR-5 bug class).
         if is_npwire:
             try:
-                inputs, uuid, _, trace_id = decode_arrays_ex(request)
+                inputs, uuid, _, trace_id = await _fi.call_shimmed_async(
+                    decode_arrays_ex, request
+                )
             except Exception as e:
                 _ERRORS.labels(kind="decode").inc()
                 _flightrec.record(
                     "server.error", stage="decode", wire="npwire",
                     error=str(e)[:200],
                 )
-                return encode_arrays(
-                    [], uuid=b"\0" * 16, error=f"decode error: {e}"
+                return await _fi.call_shimmed_async(
+                    encode_arrays,
+                    [], uuid=b"\0" * 16, error=f"decode error: {e}",
                 )
         else:
             try:
-                inputs, proto_uuid, trace_id = (
-                    npproto_codec.decode_arrays_msg_ex(request)
+                inputs, proto_uuid, trace_id = await _fi.call_shimmed_async(
+                    npproto_codec.decode_arrays_msg_ex, request
                 )
             except Exception as e:
                 _ERRORS.labels(kind="decode").inc()
@@ -382,8 +390,9 @@ class ArraysToArraysService:
                 )
                 if not is_npwire:
                     raise
-                err_reply = encode_arrays(
-                    [], uuid=uuid, error=f"compute error: {e}"
+                err_reply = await _fi.call_shimmed_async(
+                    encode_arrays,
+                    [], uuid=uuid, error=f"compute error: {e}",
                 )
             if err_reply is not None:
                 reply = err_reply
@@ -391,10 +400,13 @@ class ArraysToArraysService:
                 with _spans.span("encode"):
                     t_e0 = time.perf_counter()
                     if is_npwire:
-                        reply = encode_arrays(outputs, uuid=uuid)
+                        reply = await _fi.call_shimmed_async(
+                            encode_arrays, outputs, uuid=uuid
+                        )
                     else:
-                        reply = npproto_codec.encode_arrays_msg(
-                            outputs, uuid=proto_uuid
+                        reply = await _fi.call_shimmed_async(
+                            npproto_codec.encode_arrays_msg,
+                            outputs, uuid=proto_uuid,
                         )
                     _ENCODE_S.observe(time.perf_counter() - t_e0)
         # Trace reunion piggyback: the request carried a trace id, so
@@ -460,8 +472,8 @@ class ArraysToArraysService:
         replies in item order, each with its own uuid and its own
         error channel (a poisoned item fails only its own reply)."""
         try:
-            items, outer_uuid, _err, trace_id, _spans_in = decode_batch(
-                request
+            items, outer_uuid, _err, trace_id, _spans_in = (
+                await _fi.call_shimmed_async(decode_batch, request)
             )
         except Exception as e:
             _ERRORS.labels(kind="decode").inc()
@@ -469,8 +481,9 @@ class ArraysToArraysService:
                 "server.error", stage="decode", wire="npwire-batch",
                 error=str(e)[:200],
             )
-            return encode_batch(
-                [], uuid=b"\0" * 16, error=f"decode error: {e}"
+            return await _fi.call_shimmed_async(
+                encode_batch,
+                [], uuid=b"\0" * 16, error=f"decode error: {e}",
             )
         _DECODE_S.observe(time.perf_counter() - t_arrive)
         with _spans.trace_context(trace_id), _spans.span(
@@ -480,15 +493,18 @@ class ArraysToArraysService:
             to_compute = []  # (slot, inputs, uuid)
             for i, item in enumerate(items):
                 try:
-                    inputs, uuid, _, _ = decode_arrays_ex(item)
+                    inputs, uuid, _, _ = await _fi.call_shimmed_async(
+                        decode_arrays_ex, item
+                    )
                 except Exception as e:
                     _ERRORS.labels(kind="decode").inc()
                     _flightrec.record(
                         "server.error", stage="decode", wire="npwire",
                         error=str(e)[:200],
                     )
-                    replies[i] = encode_arrays(
-                        [], uuid=b"\0" * 16, error=f"decode error: {e}"
+                    replies[i] = await _fi.call_shimmed_async(
+                        encode_arrays,
+                        [], uuid=b"\0" * 16, error=f"decode error: {e}",
                     )
                     continue
                 to_compute.append((i, inputs, uuid))
@@ -504,12 +520,17 @@ class ArraysToArraysService:
                             "server.error", stage="compute", wire="npwire",
                             error=str(res)[:200],
                         )
-                        replies[i] = encode_arrays(
-                            [], uuid=uuid, error=f"compute error: {res}"
+                        replies[i] = await _fi.call_shimmed_async(
+                            encode_arrays,
+                            [], uuid=uuid, error=f"compute error: {res}",
                         )
                     else:
-                        replies[i] = encode_arrays(res, uuid=uuid)
-                reply = encode_batch(replies, uuid=outer_uuid)
+                        replies[i] = await _fi.call_shimmed_async(
+                            encode_arrays, res, uuid=uuid
+                        )
+                reply = await _fi.call_shimmed_async(
+                    encode_batch, replies, uuid=outer_uuid
+                )
                 _ENCODE_S.observe(time.perf_counter() - t_e0)
         if (
             self.ship_spans
@@ -532,7 +553,9 @@ class ArraysToArraysService:
         # Outer decode errors raise -> gRPC abort, exactly like a
         # malformed plain npproto request (reference contract).
         items, outer_uuid, trace_id, _spans_in = (
-            npproto_codec.decode_batch_msg(request)
+            await _fi.call_shimmed_async(
+                npproto_codec.decode_batch_msg, request
+            )
         )
         _DECODE_S.observe(time.perf_counter() - t_arrive)
         with _spans.trace_context(trace_id), _spans.span(
@@ -542,8 +565,8 @@ class ArraysToArraysService:
             to_compute = []
             for i, item in enumerate(items):
                 try:
-                    inputs, uuid, _ = npproto_codec.decode_arrays_msg_ex(
-                        item
+                    inputs, uuid, _ = await _fi.call_shimmed_async(
+                        npproto_codec.decode_arrays_msg_ex, item
                     )
                 except Exception as e:
                     _ERRORS.labels(kind="decode").inc()
@@ -551,8 +574,9 @@ class ArraysToArraysService:
                         "server.error", stage="decode", wire="npproto",
                         error=str(e)[:200],
                     )
-                    replies[i] = npproto_codec.encode_arrays_msg(
-                        [], uuid="", error=f"decode error: {e}"
+                    replies[i] = await _fi.call_shimmed_async(
+                        npproto_codec.encode_arrays_msg,
+                        [], uuid="", error=f"decode error: {e}",
                     )
                     continue
                 to_compute.append((i, inputs, uuid))
@@ -568,15 +592,17 @@ class ArraysToArraysService:
                             "server.error", stage="compute",
                             wire="npproto", error=str(res)[:200],
                         )
-                        replies[i] = npproto_codec.encode_arrays_msg(
-                            [], uuid=uuid, error=f"compute error: {res}"
+                        replies[i] = await _fi.call_shimmed_async(
+                            npproto_codec.encode_arrays_msg,
+                            [], uuid=uuid, error=f"compute error: {res}",
                         )
                     else:
-                        replies[i] = npproto_codec.encode_arrays_msg(
-                            res, uuid=uuid
+                        replies[i] = await _fi.call_shimmed_async(
+                            npproto_codec.encode_arrays_msg, res, uuid=uuid
                         )
-                reply = npproto_codec.encode_batch_msg(
-                    replies, uuid=outer_uuid
+                reply = await _fi.call_shimmed_async(
+                    npproto_codec.encode_batch_msg,
+                    replies, uuid=outer_uuid,
                 )
                 _ENCODE_S.observe(time.perf_counter() - t_e0)
         if (

@@ -602,3 +602,154 @@ class TestAsyncShimTwins:
         # two 0.2 s awaited delays -> the 10 ms ticker gets dozens of
         # turns; the old sync path would have allowed ~0.
         assert ticks >= 10
+
+
+class TestCallShimmedAsync:
+    """Regression for the graftflow transitive ``async-blocking``
+    findings (PR 8): async handlers called the sync codecs inline, and
+    the codecs hold ``filter_bytes`` seams whose delay kinds
+    ``time.sleep`` — the PR-5 bug class, three frames down.
+    ``call_shimmed_async`` is the fix: direct call on the production
+    path, executor handoff whenever a plan is active (or the caller
+    asks for the executor explicitly)."""
+
+    def test_inline_fast_path_runs_in_caller_thread(self):
+        import asyncio
+
+        assert fi.runtime.active_plan is None
+
+        async def main():
+            return await fi.runtime.call_shimmed_async(
+                threading.get_ident
+            )
+
+        assert asyncio.run(main()) == threading.get_ident()
+
+    def test_active_plan_routes_to_executor(self):
+        import asyncio
+
+        plan = fi.FaultPlan(
+            [fi.FaultRule("delay", point="nowhere", delay_s=0.0)], seed=0
+        )
+        fi.install(plan)
+        try:
+
+            async def main():
+                return await fi.runtime.call_shimmed_async(
+                    threading.get_ident
+                )
+
+            assert asyncio.run(main()) != threading.get_ident()
+        finally:
+            fi.uninstall()
+
+    def test_inline_false_always_uses_executor(self):
+        import asyncio
+
+        async def main():
+            return await fi.runtime.call_shimmed_async(
+                threading.get_ident, inline=False
+            )
+
+        assert asyncio.run(main()) != threading.get_ident()
+
+    def test_args_kwargs_and_exceptions_propagate(self):
+        import asyncio
+
+        def f(a, b=0):
+            if b:
+                raise ValueError("boom")
+            return a + 1
+
+        async def main():
+            assert await fi.runtime.call_shimmed_async(f, 1) == 2
+            with pytest.raises(ValueError, match="boom"):
+                await fi.runtime.call_shimmed_async(f, 1, b=2)
+
+        asyncio.run(main())
+
+    def test_executor_hop_carries_contextvars(self):
+        """The executor handoff must run under the caller's context
+        (copy_context): the codecs read the ambient telemetry trace id
+        (`_encode_request` -> spans.current_trace_id), and a bare
+        worker thread would silently encode trace_id=None exactly
+        during chaos runs — killing trace reunion when it matters
+        most."""
+        import asyncio
+
+        plan = fi.FaultPlan(
+            [fi.FaultRule("delay", point="nowhere", delay_s=0.0)], seed=0
+        )
+        fi.install(plan)
+        try:
+
+            async def main():
+                with tspans.span("rpc.ctx_test"):
+                    tid = tspans.current_trace_id()
+                    hop = await fi.runtime.call_shimmed_async(
+                        tspans.current_trace_id
+                    )
+                    return tid, hop
+
+            tid, hop = asyncio.run(main())
+            assert tid is not None
+            assert hop == tid
+        finally:
+            fi.uninstall()
+
+    def test_codec_delay_keeps_the_loop_alive(self):
+        """The end-to-end shape of the fixed bug: a chaos delay at a
+        codec byte seam must not freeze a concurrent ticker on the
+        same loop."""
+        import asyncio
+
+        from pytensor_federated_tpu.service.npwire import encode_arrays
+
+        plan = fi.FaultPlan(
+            [
+                fi.FaultRule(
+                    "delay", point="npwire.encode", nth=1, delay_s=0.2
+                )
+            ],
+            seed=2,
+        )
+        fi.install(plan)
+        try:
+
+            async def main():
+                ticks = 0
+                done = False
+
+                async def ticker():
+                    nonlocal ticks
+                    while not done:
+                        ticks += 1
+                        await asyncio.sleep(0.01)
+
+                t = asyncio.ensure_future(ticker())
+                reply = await fi.runtime.call_shimmed_async(
+                    encode_arrays, [np.zeros(2, np.float32)]
+                )
+                assert isinstance(reply, bytes)
+                done = True
+                await t
+                return ticks
+
+            assert asyncio.run(main()) >= 10
+        finally:
+            fi.uninstall()
+
+    def test_transform_bytes_is_the_sleep_free_half(self):
+        """The apply_to_bytes split: transform_bytes handles every
+        non-sleeping kind identically and rejects delay/stall (those
+        belong to sync apply_to_bytes / the awaited twins)."""
+        rule = fi.FaultRule("truncate_frame", point="p", cut_frac=0.5)
+        plan = fi.FaultPlan([rule], seed=0)
+        (r,) = plan.rules
+        out = fi.runtime.transform_bytes(r, b"abcdefgh", "p")
+        assert out == b"abcdefgh"[: r.cut_at(8)]
+        delay = fi.FaultPlan(
+            [fi.FaultRule("delay", point="p", delay_s=9.0)], seed=0
+        ).rules[0]
+        with pytest.raises(fi.FaultPlanError):
+            fi.runtime.transform_bytes(delay, b"x", "p")

@@ -1,0 +1,750 @@
+"""graftflow: engine unit tests (call graph, entrypoints, contexts)
+and fixture pairs for the interprocedural rules.
+
+The seeded-defect fixtures here are the PR's acceptance criteria: a
+3-hop transitive blocking call from an async handler (which the old
+per-function scan provably misses), a cross-loop channel escape, an
+unlocked cross-thread mutation, and a driver-varying pool-placed
+fed_map — each flagged WITH its propagation chain."""
+
+import textwrap
+
+import pytest
+
+from pytensor_federated_tpu.analysis import core
+from pytensor_federated_tpu.analysis.graph import build_graph
+from pytensor_federated_tpu.analysis import dataflow
+from pytensor_federated_tpu.analysis.rules_async import (
+    direct_blocking_sites,
+)
+from pytensor_federated_tpu.analysis.rules_fedflow import (
+    placement_findings,
+)
+
+
+def make_repo(tmp_path, files):
+    """Materialize ``files`` (rel -> source) under a synthetic root."""
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text))
+    return tmp_path
+
+
+def run_on(tmp_path, files, rules):
+    make_repo(tmp_path, files)
+    return core.run(rules=rules, paths=None, root=tmp_path)
+
+
+def ctx_of(tmp_path, files):
+    make_repo(tmp_path, files)
+    return core.RepoContext(
+        core.load_sources(core.default_targets(tmp_path), tmp_path)
+    )
+
+
+# -- engine: call graph -----------------------------------------------------
+
+
+GRAPH_MOD = """
+import threading
+from .helpers import imported_fn
+from . import helpers
+
+class Pool:
+    def __init__(self):
+        self.x = 1
+
+    def start(self):
+        threading.Thread(
+            target=self._loop, name="pool-probe", daemon=True
+        ).start()
+
+    def _loop(self):
+        self.step()
+
+    def step(self):
+        local_helper()
+        imported_fn()
+        helpers.other_fn()
+        unique_method_target()
+
+def local_helper():
+    def inner():
+        pass
+    inner()
+
+def unique_method_target():
+    pass
+
+def spawn(loop, executor, obj):
+    loop.run_in_executor(None, local_helper)
+    executor.submit(unique_method_target)
+    obj.unique_method_target()
+
+async def task_root():
+    import asyncio
+    asyncio.create_task(coro_child())
+
+async def coro_child():
+    pass
+
+def build():
+    return Pool()
+"""
+
+GRAPH_HELPERS = """
+def imported_fn():
+    pass
+
+def other_fn():
+    pass
+"""
+
+
+class TestCallGraph:
+    REL = "pytensor_federated_tpu/routing/mod.py"
+    HELPERS = "pytensor_federated_tpu/routing/helpers.py"
+
+    @pytest.fixture()
+    def graph(self, tmp_path):
+        ctx = ctx_of(
+            tmp_path, {self.REL: GRAPH_MOD, self.HELPERS: GRAPH_HELPERS}
+        )
+        return ctx.graph
+
+    def edge_kinds(self, graph, caller_q):
+        return {
+            (graph.functions[e.callee].name, e.kind)
+            for e in graph.callees_of(caller_q)
+        }
+
+    def test_edge_resolution_kinds(self, graph):
+        step = f"{self.REL}::Pool.step"
+        kinds = self.edge_kinds(graph, step)
+        assert ("local_helper", "module") in kinds
+        assert ("imported_fn", "import") in kinds  # from .helpers import
+        assert ("other_fn", "import") in kinds  # helpers.other_fn(...)
+        assert ("unique_method_target", "module") in kinds
+
+    def test_self_method_and_nested_and_unique(self, graph):
+        loop_q = f"{self.REL}::Pool._loop"
+        assert ("step", "self") in self.edge_kinds(graph, loop_q)
+        lh = f"{self.REL}::local_helper"
+        assert ("inner", "local") in self.edge_kinds(graph, lh)
+        spawn = f"{self.REL}::spawn"
+        # obj.unique_method_target(): exactly one in-package match.
+        assert ("unique_method_target", "unique") in self.edge_kinds(
+            graph, spawn
+        )
+
+    def test_constructor_edge(self, graph):
+        build = f"{self.REL}::build"
+        assert ("__init__", "class") in self.edge_kinds(graph, build)
+
+    def test_thread_entrypoint_discovery(self, graph):
+        threads = [e for e in graph.entrypoints if e.kind == "thread"]
+        assert len(threads) == 1
+        e = threads[0]
+        assert e.target == f"{self.REL}::Pool._loop"
+        assert e.label == "pool-probe"
+        assert e.spawner == f"{self.REL}::Pool.start"
+
+    def test_executor_and_task_entrypoints(self, graph):
+        kinds = {
+            (e.kind, graph.functions[e.target].name)
+            for e in graph.entrypoints
+        }
+        assert ("executor", "local_helper") in kinds  # run_in_executor
+        assert ("executor", "unique_method_target") in kinds  # submit
+        assert ("task", "coro_child") in kinds  # create_task
+
+    def test_reachability_chain(self, graph):
+        chains = graph.reachable_from([f"{self.REL}::Pool._loop"])
+        inner = f"{self.REL}::local_helper.inner"
+        assert inner in chains  # _loop -> step -> local_helper -> inner
+        assert [e.callee for e in chains[inner]] == [
+            f"{self.REL}::Pool.step",
+            f"{self.REL}::local_helper",
+            inner,
+        ]
+
+    def test_concurrency_contexts(self, graph):
+        contexts = dataflow.concurrency_contexts(graph)
+        step = contexts[f"{self.REL}::Pool.step"]
+        assert "thread:_loop" in step  # via the Thread entrypoint
+        assert contexts[f"{self.REL}::local_helper"] >= {
+            "thread:_loop",
+            "executor",
+        }
+        assert "loop" in contexts[f"{self.REL}::coro_child"]
+
+
+# -- async-blocking: transitive -------------------------------------------
+
+
+THREE_HOP = """
+import time
+
+async def handler():
+    a()
+
+def a():
+    b()
+
+def b():
+    c()
+
+def c():
+    time.sleep(1)
+"""
+
+
+class TestTransitiveAsyncBlocking:
+    REL = "pytensor_federated_tpu/service/mod.py"
+
+    def test_three_hop_chain_flagged_and_direct_scan_misses(
+        self, tmp_path
+    ):
+        """The acceptance fixture: the PR-7 per-function rule provably
+        misses a blocking call three frames down; graftflow flags it
+        with the full propagation chain."""
+        root = make_repo(tmp_path, {self.REL: THREE_HOP})
+        findings = core.run(
+            rules=["async-blocking"], paths=None, root=root
+        )
+        assert len(findings) == 1
+        f = findings[0]
+        assert f.path == self.REL
+        assert "time.sleep" in f.message
+        assert "reachable from `async def handler`" in f.message
+        # chain: handler -> a -> b -> c -> the blocking line
+        assert len(f.chain) == 5
+        assert "handler" in f.chain[0]
+        assert f.chain[-1].endswith(f"{self.REL}:{f.line}")
+        # ... and the legacy direct-pattern scan sees nothing.
+        src = core.SourceFile(root / self.REL, root)
+        assert direct_blocking_sites(src) == []
+
+    def test_executor_seam_breaks_the_chain(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {
+                self.REL: """
+                import time
+
+                async def handler(loop):
+                    await loop.run_in_executor(None, worker)
+
+                def worker():
+                    time.sleep(1)  # runs on a worker thread: fine
+                """
+            },
+            ["async-blocking"],
+        )
+        assert findings == []
+
+    def test_lambda_is_a_value_not_inline_code(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {
+                self.REL: """
+                import time
+
+                async def handler(shim):
+                    await shim(lambda: slow())
+
+                def slow():
+                    time.sleep(1)
+                """
+            },
+            ["async-blocking"],
+        )
+        assert findings == []
+
+    def test_bare_lock_acquire_flagged_with_lock_exempt(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {
+                self.REL: """
+                async def handler(obj):
+                    obj._lock.acquire()
+                    with obj._lock:
+                        pass
+                    obj._lock.acquire(timeout=1.0)
+                """
+            },
+            ["async-blocking"],
+        )
+        assert len(findings) == 1
+        assert "untimed blocking acquire" in findings[0].message
+
+    def test_suppression_honored_at_blocking_site(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {
+                self.REL: """
+                import time
+
+                async def handler():
+                    helper()
+
+                def helper():
+                    time.sleep(1)  # graftlint: disable=async-blocking -- fixture
+                """
+            },
+            ["async-blocking"],
+        )
+        assert findings == []
+
+
+# -- loop-escape ------------------------------------------------------------
+
+
+class TestLoopEscape:
+    REL = "pytensor_federated_tpu/routing/mod.py"
+
+    def test_direct_attribute_escape_flagged(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {
+                self.REL: """
+                import grpc
+
+                class C:
+                    async def connect(self):
+                        self.ch = grpc.aio.insecure_channel("a:1")
+                """
+            },
+            ["loop-escape"],
+        )
+        assert len(findings) == 1
+        assert "self.ch" in findings[0].message
+
+    def test_interprocedural_source_escape_flagged_with_chain(
+        self, tmp_path
+    ):
+        """The acceptance fixture: the channel is created two calls
+        away; the escape carries the producer in its chain."""
+        findings = run_on(
+            tmp_path,
+            {
+                self.REL: """
+                import grpc
+
+                def _make():
+                    return grpc.aio.insecure_channel("a:1")
+
+                def _indirect():
+                    return _make()
+
+                class C:
+                    async def connect(self):
+                        self.ch = _indirect()
+                """
+            },
+            ["loop-escape"],
+        )
+        assert len(findings) == 1
+        f = findings[0]
+        assert "self.ch" in f.message
+        assert any("_indirect" in hop for hop in f.chain)
+
+    def test_multicallable_and_global_and_container(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {
+                self.REL: """
+                import grpc
+
+                _CACHE = {}
+
+                async def stash(registry):
+                    ch = grpc.aio.insecure_channel("a:1")
+                    stub = ch.unary_unary("/svc/Do")
+                    registry["k"] = stub
+                    global _CH
+                    _CH = ch
+
+                async def enqueue(q):
+                    ch = grpc.aio.insecure_channel("a:1")
+                    q.put(ch)
+                """
+            },
+            ["loop-escape"],
+        )
+        # subscript store of the stub, global store of the channel
+        assert len(findings) >= 2
+        msgs = " ".join(f.message for f in findings)
+        assert "registry" in msgs
+
+    def test_scoped_and_local_use_clean(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {
+                self.REL: """
+                import grpc
+
+                async def ok():
+                    async with grpc.aio.insecure_channel("a:1") as ch:
+                        method = ch.unary_unary("/svc/Do")
+                        return await method(b"")
+                """
+            },
+            ["loop-escape"],
+        )
+        assert findings == []
+
+    def test_cache_file_exempt(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {
+                "pytensor_federated_tpu/service/client.py": """
+                import grpc
+
+                class ClientPrivates:
+                    async def connect(self):
+                        self.channel = grpc.aio.insecure_channel("a:1")
+                """
+            },
+            ["loop-escape"],
+        )
+        assert findings == []
+
+    def test_suppression_honored(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {
+                self.REL: """
+                import grpc
+
+                class C:
+                    async def connect(self):
+                        self.ch = grpc.aio.insecure_channel("a:1")  # graftlint: disable=loop-escape -- fixture
+                """
+            },
+            ["loop-escape"],
+        )
+        assert findings == []
+
+
+# -- shared-state-lock ------------------------------------------------------
+
+
+RACE_BAD = """
+import threading
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def start(self):
+        threading.Thread(
+            target=self._probe_loop, name="probe", daemon=True
+        ).start()
+
+    def _probe_loop(self):
+        self.count += 1
+
+    async def handle(self):
+        self.count += 1
+"""
+
+
+class TestSharedStateLock:
+    REL = "pytensor_federated_tpu/telemetry/mod.py"
+
+    def test_unlocked_cross_context_mutation_flagged_with_witness(
+        self, tmp_path
+    ):
+        """The acceptance fixture: one attribute written from the
+        probe daemon thread AND the event loop, no lock anywhere —
+        both writes flagged, each carrying a witness chain per
+        context."""
+        findings = run_on(tmp_path, {self.REL: RACE_BAD}, ["shared-state-lock"])
+        assert len(findings) == 2
+        for f in findings:
+            assert "self.count" in f.message
+            joined = " ".join(f.chain)
+            assert "[loop]" in joined
+            assert "[thread:_probe_loop]" in joined
+
+    def test_locked_writes_clean(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {
+                self.REL: RACE_BAD.replace(
+                    "        self.count += 1",
+                    "        with self._lock:\n"
+                    "            self.count += 1",
+                )
+            },
+            ["shared-state-lock"],
+        )
+        assert findings == []
+
+    def test_lock_held_helper_covers_callee_writes(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {
+                self.REL: """
+                import threading
+
+                class Registry:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self.count = 0
+
+                    def start(self):
+                        threading.Thread(target=self._loop).start()
+
+                    def _loop(self):
+                        with self._lock:
+                            self._bump()
+
+                    async def handle(self):
+                        with self._lock:
+                            self._bump()
+
+                    def _bump(self):
+                        self.count += 1
+                """
+            },
+            ["shared-state-lock"],
+        )
+        assert findings == []
+
+    def test_single_context_writes_clean(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {
+                self.REL: """
+                import threading
+
+                class OnlyThread:
+                    def start(self):
+                        threading.Thread(target=self._loop).start()
+
+                    def _loop(self):
+                        self.n = 1
+                """
+            },
+            ["shared-state-lock"],
+        )
+        assert findings == []
+
+    def test_module_global_registry_mutation(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {
+                self.REL: """
+                import threading
+
+                _REGISTRY = {}
+
+                def start():
+                    threading.Thread(target=_loop).start()
+
+                def _loop():
+                    _REGISTRY["k"] = 1
+
+                async def handle():
+                    _REGISTRY["k"] = 2
+                """
+            },
+            ["shared-state-lock"],
+        )
+        assert len(findings) == 2
+        assert all("_REGISTRY" in f.message for f in findings)
+
+    def test_suppression_honored(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {
+                self.REL: RACE_BAD.replace(
+                    "    def _probe_loop(self):\n        self.count += 1",
+                    "    def _probe_loop(self):\n"
+                    "        self.count += 1  # graftlint: disable=shared-state-lock -- fixture",
+                ).replace(
+                    "    async def handle(self):\n        self.count += 1",
+                    "    async def handle(self):\n"
+                    "        self.count += 1  # graftlint: disable=shared-state-lock -- fixture",
+                )
+            },
+            ["shared-state-lock"],
+        )
+        assert findings == []
+
+
+# -- resource-leak ----------------------------------------------------------
+
+
+class TestResourceLeak:
+    REL = "pytensor_federated_tpu/service/mod.py"
+
+    def test_dropped_and_unbound_handles_flagged(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {
+                self.REL: """
+                import socket
+
+                def probe(host):
+                    s = socket.create_connection((host, 1), timeout=1)
+                    return True
+
+                def chain(host):
+                    socket.socket().connect((host, 1))
+                """
+            },
+            ["resource-leak"],
+        )
+        assert len(findings) == 2
+        msgs = " ".join(f.message for f in findings)
+        assert "never closed" in msgs
+        assert "never bound" in msgs
+
+    def test_scoped_closed_and_escaping_clean(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {
+                self.REL: """
+                import socket
+
+                def scoped(host):
+                    with socket.create_connection((host, 1)) as s:
+                        return s.recv(1)
+
+                def closed(host):
+                    s = socket.create_connection((host, 1))
+                    try:
+                        return s.recv(1)
+                    finally:
+                        s.close()
+
+                def escapes(host):
+                    s = socket.create_connection((host, 1))
+                    return s
+
+                def stored(self_like, host):
+                    s = socket.create_connection((host, 1))
+                    self_like.sock = s
+
+                def handed_off(host, pool):
+                    s = socket.create_connection((host, 1))
+                    pool.adopt(s)
+                """
+            },
+            ["resource-leak"],
+        )
+        assert findings == []
+
+    def test_suppression_honored(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {
+                self.REL: """
+                import socket
+
+                def probe(host):
+                    s = socket.create_connection((host, 1))  # graftlint: disable=resource-leak -- fixture
+                    return True
+                """
+            },
+            ["resource-leak"],
+        )
+        assert findings == []
+
+
+# -- fed-placement ----------------------------------------------------------
+
+
+class TestFedPlacement:
+    def test_driver_varying_capture_flagged_with_provenance(self):
+        """The acceptance fixture: a pool-refusable fed_map (closure
+        captures an upstream product of a program input) is caught
+        from the jaxpr with the operand's provenance chain."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from pytensor_federated_tpu.fed.primitives import (
+            fed_map,
+            fed_sum,
+        )
+
+        data = jnp.asarray(np.ones((4, 3), np.float32))
+
+        def bad(params):
+            scale = params * 2.0  # upstream eqn output
+            lps = fed_map(
+                lambda shard: jnp.sum(shard[0] * scale), (data,)
+            )
+            return fed_sum(lps)
+
+        caps = placement_findings(
+            bad, (jnp.ones((3,), jnp.float32),), fixture="bad"
+        )
+        assert len(caps) == 1
+        cap = caps[0]
+        assert cap.fixture == "bad"
+        prov = " ".join(cap.provenance)
+        assert "output of `mul`" in prov
+        assert "program input #0" in prov
+
+    def test_broadcast_routed_program_clean(self):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from pytensor_federated_tpu.fed.primitives import (
+            fed_broadcast,
+            fed_map,
+            fed_sum,
+        )
+
+        data = jnp.asarray(np.ones((4, 3), np.float32))
+
+        def good(params):
+            pb = fed_broadcast((params * 2.0,), 4)
+            lps = fed_map(
+                lambda shard: jnp.sum(shard[0][0] * shard[1]), (pb, data)
+            )
+            return fed_sum(lps)
+
+        assert placement_findings(good, (jnp.ones((3,), jnp.float32),)) == []
+
+    def test_shipped_fixtures_are_clean(self):
+        from pytensor_federated_tpu.fed import lint_fixtures
+
+        for fixture in lint_fixtures.FIXTURES:
+            fn, args = fixture.build()
+            assert placement_findings(fn, args, fixture=fixture.name) == []
+
+
+# -- the migrated shim reachability matches the old semantics ---------------
+
+
+class TestShimOnSharedGraph:
+    def test_conservative_name_merge_preserved(self, tmp_path):
+        """Two same-named methods in different classes: the shimmed
+        one keeps its seam coverage for both (the conservative
+        direction the old module-private index guaranteed)."""
+        findings = run_on(
+            tmp_path,
+            {
+                "pytensor_federated_tpu/service/mod.py": """
+                class A:
+                    def send(self, sock, b):
+                        if _fi.active_plan is not None:
+                            _fi.send_frame_through("p", sock.sendall, b)
+                        else:
+                            sock.sendall(b)
+
+                class B:
+                    def send(self, sock, b):
+                        sock.sendall(b)
+                """
+            },
+            ["fault-shim-coverage"],
+        )
+        assert findings == []

@@ -621,6 +621,11 @@ class TestDriver:
         assert cli_main(["--rule", "no-such-rule"]) == 2
 
     def test_json_output_shape(self, tmp_path, capsys):
+        """Pins the --json schema (documented in
+        docs/static-analysis.md): top level {findings, count}, each
+        finding exactly {rule, path, line, message, chain} — chain
+        always present (empty list for per-function rules), so SARIF
+        conversion and CI annotation scripts can rely on it."""
         bad = tmp_path / "pytensor_federated_tpu" / "service" / "m.py"
         bad.parent.mkdir(parents=True)
         bad.write_text(
@@ -632,18 +637,26 @@ class TestDriver:
         import json
 
         payload = json.loads(core.render_json(findings))
+        assert set(payload) == {"findings", "count"}
         assert payload["count"] == 1
-        assert payload["findings"][0]["rule"] == "async-blocking"
-        assert payload["findings"][0]["line"] == 3
+        record = payload["findings"][0]
+        assert set(record) == {"rule", "path", "line", "message", "chain"}
+        assert record["rule"] == "async-blocking"
+        assert record["line"] == 3
+        assert isinstance(record["chain"], list) and record["chain"]
 
     def test_rule_catalog_shape(self):
         assert set(analysis.RULES) == {
             "async-blocking",
             "loop-affinity",
+            "loop-escape",
+            "shared-state-lock",
+            "resource-leak",
             "wire-registry",
             "wire-loudness",
             "fault-shim-coverage",
             "fed-rule-completeness",
+            "fed-placement",
             "observability-drift",
         }
         for r in analysis.RULES.values():
@@ -678,6 +691,101 @@ class TestSubsetRuns:
         )
         findings = core.run(paths=[target])
         assert findings == [], "\n" + core.render_human(findings)
+
+
+class TestSarif:
+    def _findings(self, tmp_path):
+        bad = tmp_path / "pytensor_federated_tpu" / "service" / "m.py"
+        bad.parent.mkdir(parents=True)
+        bad.write_text("import time\nasync def f():\n    time.sleep(1)\n")
+        return core.run(
+            rules=["async-blocking"], paths=[bad], root=tmp_path
+        )
+
+    def test_sarif_2_1_0_shape(self, tmp_path):
+        import json
+
+        doc = json.loads(core.render_sarif(self._findings(tmp_path)))
+        assert doc["version"] == "2.1.0"
+        (run,) = doc["runs"]
+        driver = run["tool"]["driver"]
+        assert driver["name"] == "graftlint"
+        assert {r["id"] for r in driver["rules"]} == set(analysis.RULES)
+        (result,) = run["results"]
+        assert result["ruleId"] == "async-blocking"
+        loc = result["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uriBaseId"] == "%SRCROOT%"
+        assert loc["artifactLocation"]["uri"].endswith("m.py")
+        assert loc["region"]["startLine"] == 3
+        assert "call chain" in result["message"]["text"]
+
+    def test_empty_sarif_still_valid(self):
+        import json
+
+        doc = json.loads(core.render_sarif([]))
+        assert doc["runs"][0]["results"] == []
+
+    def test_cli_sarif_and_json_exclusive(self, capsys):
+        assert cli_main(["--sarif", "--json"]) == 2
+
+
+class TestSinglePassAndTiming:
+    def test_stats_reported(self, tmp_path):
+        bad = tmp_path / "pytensor_federated_tpu" / "m.py"
+        bad.parent.mkdir(parents=True)
+        bad.write_text("x = 1\n")
+        stats = {}
+        core.run(paths=None, root=tmp_path, stats=stats)
+        assert stats["files"] >= 1
+        assert stats["rules"] == len(analysis.RULES)
+        assert stats["seconds"] > 0
+
+    def test_subset_run_reuses_parsed_sources(self, monkeypatch):
+        """Single-pass contract: an explicit-path run must not parse
+        any file twice (the subset sources are reused inside the full
+        repo set)."""
+        import ast as ast_mod
+
+        parsed = []
+        real_parse = ast_mod.parse
+
+        def counting_parse(source, filename="<unknown>", *a, **kw):
+            parsed.append(filename)
+            return real_parse(source, filename, *a, **kw)
+
+        monkeypatch.setattr(ast_mod, "parse", counting_parse)
+        target = (
+            core.repo_root()
+            / "pytensor_federated_tpu"
+            / "routing"
+            / "policies.py"
+        )
+        core.run(rules=["loop-affinity"], paths=[target])
+        dupes = {f for f in parsed if parsed.count(f) > 1}
+        assert dupes == set()
+
+    def test_full_repo_run_stays_under_budget(self):
+        """The CI graftlint gate must not creep: the whole-repo run
+        (every rule, call graph, fed trace) stays well under a minute.
+        Local measurements sit around 2-3 s; the budget leaves a wide
+        margin for slow CI machines while still catching an accidental
+        O(files^2) regression."""
+        stats = {}
+        core.run(stats=stats)
+        assert stats["seconds"] < 30.0, stats
+
+
+class TestChangedOnly:
+    def test_changed_only_runs_clean(self, capsys):
+        """--changed-only lints the git-changed subset of the default
+        targets (empty diff = clean by vacuity).  At HEAD the repo is
+        clean, so either way this exits 0."""
+        assert cli_main(["--changed-only"]) == 0
+        out = capsys.readouterr()
+        assert "graftlint" in out.out or "graftlint" in out.err
+
+    def test_changed_only_rejects_explicit_paths(self, capsys):
+        assert cli_main(["--changed-only", "bench.py"]) == 2
 
 
 # -- the gate: the real repo is clean --------------------------------------

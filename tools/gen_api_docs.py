@@ -68,6 +68,11 @@ MODULES = [
     # public so tests and tools can run single rules programmatically;
     # the rule catalog itself lives in docs/static-analysis.md.
     "pytensor_federated_tpu.analysis",
+    # graftflow engine (ISSUE 8): the shared call graph and the
+    # dataflow context propagation the interprocedural rules run on.
+    "pytensor_federated_tpu.analysis.graph",
+    "pytensor_federated_tpu.analysis.dataflow",
+    "pytensor_federated_tpu.fed.lint_fixtures",
     "pytensor_federated_tpu.utils",
 ]
 
