@@ -328,6 +328,96 @@ def faultinject_overhead(n_guard: int = 200_000, n_wire: int = 4_000) -> dict:
     }
 
 
+def shm_overhead(n_pings: int = 300) -> dict:
+    """Idle gate for the zero-copy shm transport (ISSUE 9): one
+    doorbell round-trip with an EMPTY arena write — slot allocate +
+    generation stamp + descriptor frame + node-side slot validation +
+    reply, no compute.  This is the fixed overhead every shm call pays
+    on top of payload copies (which are the lane's whole saving), so
+    it must stay bounded and the probe must never hang (in-process
+    node thread, bounded connect, socket timeout inherited from
+    ``connect_timeout_s``).  Best-of-3 batches like the other gates.
+
+    Pass line: under 1.5 ms — an order of magnitude under the ~15-30
+    ms/eval a real federated logp round pays, and generous enough for
+    a loaded container (measured ~0.1-0.2 ms idle)."""
+    import threading
+
+    from pytensor_federated_tpu.service.shm import (
+        ShmArraysClient,
+        serve_shm,
+    )
+
+    def compute(*arrays):
+        return [np.zeros(1, np.float32)]
+
+    ports = []
+    threading.Thread(
+        target=serve_shm,
+        args=(compute,),
+        kwargs=dict(ready_callback=ports.append, max_connections=1),
+        daemon=True,
+    ).start()
+    deadline = time.time() + 10.0
+    while not ports and time.time() < deadline:
+        time.sleep(0.005)
+    if not ports:
+        raise RuntimeError("shm gate node did not come up")
+    client = ShmArraysClient(
+        "127.0.0.1", ports[0], connect_timeout_s=5.0
+    )
+    try:
+        client.ping()  # connect + attach + warm
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(n_pings):
+                client.ping()
+            best = min(best, (time.perf_counter() - t0) / n_pings)
+    finally:
+        client.close()
+    rtt_us = best * 1e6
+    return {
+        "doorbell_rtt_us": round(rtt_us, 2),
+        "pass": bool(rtt_us < 1500.0),
+    }
+
+
+# Module-level (multiprocessing-spawn needs an importable target): the
+# shm-lane node serving THIS benchmark's exact logp+grad — same model,
+# same data seed, so the race's numerical-equality gate applies to the
+# transport lane unchanged.
+def _bench_shm_node(port):
+    import logging
+
+    logging.disable(logging.ERROR)
+    from pytensor_federated_tpu.utils import force_cpu_backend
+
+    force_cpu_backend()
+    import jax as _jax
+    from jax.flatten_util import ravel_pytree as _ravel
+
+    from pytensor_federated_tpu.models.linear import (
+        FederatedLinearRegression,
+        generate_node_data,
+    )
+
+    data, _ = generate_node_data(8, n_obs=64, seed=123)
+    model = FederatedLinearRegression(data)
+    _flat0, unravel = _ravel(model.init_params())
+    fn = _jax.jit(
+        lambda x: _jax.value_and_grad(lambda v: model.logp(unravel(v)))(x)
+    )
+
+    def compute(x):
+        v, g = fn(x)
+        return [np.asarray(v), np.asarray(g)]
+
+    from pytensor_federated_tpu.service.shm import serve_shm
+
+    serve_shm(compute, "127.0.0.1", port)
+
+
 class MeasurementIntegrityError(RuntimeError):
     """A timing the integrity guards refuse to trust (degenerate chain,
     inconsistent stages, physics-impossible rate).  A DEDICATED type so
@@ -535,6 +625,78 @@ def main():
     if pallas_flat is not None:
         candidates["pallas-fused"] = pallas_flat
 
+    # Zero-copy shm transport lane (ISSUE 9): the SAME posterior
+    # evaluated on a colocated subprocess node over the shared-memory
+    # arena transport, raced behind the same equality gate via
+    # jax.pure_callback.  It documents what the host lane costs next
+    # to the fused on-device chain — it is not expected to win.  CPU
+    # backend only: a host callback inside the chain on the tunneled
+    # TPU is a wedge risk nothing here needs to take, and the lane it
+    # measures is host-side by definition.  Own try: a failure costs
+    # only this candidate, never the JSON line.
+    shm_client = None
+    shm_proc = None
+    if jax.default_backend() == "cpu":
+        try:
+            import multiprocessing as mp
+            import socket as _socket
+
+            from pytensor_federated_tpu.service.shm import ShmArraysClient
+
+            with _socket.socket() as _s:
+                _s.bind(("127.0.0.1", 0))
+                shm_port = _s.getsockname()[1]
+            ctx = mp.get_context("spawn")
+            shm_proc = ctx.Process(
+                target=_bench_shm_node, args=(shm_port,), daemon=True
+            )
+            shm_proc.start()
+            shm_client = ShmArraysClient(
+                "127.0.0.1", shm_port,
+                connect_timeout_s=2.0, connect_retries=60,
+                connect_backoff_s=0.5,
+            )
+            x0_np = np.asarray(flat0)
+            deadline = time.time() + 120.0
+            while True:  # node warms (jit compile) behind the connect
+                try:
+                    shm_client.evaluate(x0_np)
+                    break
+                except (ConnectionError, OSError):
+                    if time.time() > deadline or not shm_proc.is_alive():
+                        raise RuntimeError("shm bench node never came up")
+                    time.sleep(0.5)
+
+            _shm_out_shapes = (
+                jax.ShapeDtypeStruct((), flat0.dtype),
+                jax.ShapeDtypeStruct(flat0.shape, flat0.dtype),
+            )
+
+            def _shm_cb(xv):
+                v, g = shm_client.evaluate(np.asarray(xv))
+                return (
+                    np.asarray(v, dtype=flat0.dtype),
+                    np.asarray(g, dtype=flat0.dtype),
+                )
+
+            def shm_flat(x):
+                return jax.pure_callback(_shm_cb, _shm_out_shapes, x)
+
+            candidates["shm-node"] = shm_flat
+        except Exception as e:
+            print(f"# shm lane unavailable: {e}", file=sys.stderr)
+            if shm_client is not None:
+                shm_client.close()
+                shm_client = None
+            if shm_proc is not None:
+                shm_proc.terminate()
+                shm_proc = None
+    else:
+        print(
+            "# shm lane skipped (host lane raced on CPU backend only)",
+            file=sys.stderr,
+        )
+
     # Correctness gate before racing — an impl that builds but disagrees
     # numerically must FAIL the bench, not be skipped.  Checked at the
     # origin and at a perturbed point (origin-only can hide slope terms).
@@ -650,6 +812,22 @@ def main():
     except Exception as e:  # same invariant
         fault_shims = {"error": f"{type(e).__name__}: {e}", "pass": False}
 
+    try:
+        shm_gate = shm_overhead()
+    except Exception as e:  # same invariant
+        shm_gate = {"error": f"{type(e).__name__}: {e}", "pass": False}
+
+    # The shm race lane's node is no longer needed once measurement
+    # and gates are done (the gates spin their own in-process node).
+    if shm_client is not None:
+        try:
+            shm_client.close()
+        except Exception:
+            pass
+    if shm_proc is not None:
+        shm_proc.terminate()
+        shm_proc.join(timeout=5)
+
     print(
         json.dumps(
             {
@@ -666,6 +844,7 @@ def main():
                 "telemetry_overhead": overhead,
                 "batcher_overhead": batcher,
                 "faultinject_overhead": fault_shims,
+                "shm_overhead": shm_gate,
                 **flop_extra,
             }
         )

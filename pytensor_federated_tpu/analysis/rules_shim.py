@@ -49,6 +49,7 @@ _SCOPE_PREFIXES = (
 _CODEC_FILES = (
     "pytensor_federated_tpu/service/npwire.py",
     "pytensor_federated_tpu/service/npproto_codec.py",
+    "pytensor_federated_tpu/service/shm.py",
 )
 
 _RAW_SOCKET_METHODS = {"sendall", "recv", "recv_into"}

@@ -40,6 +40,7 @@ from .core import Finding, SourceFile, rule
 _NPWIRE = "pytensor_federated_tpu/service/npwire.py"
 _NPPROTO = "pytensor_federated_tpu/service/npproto_codec.py"
 _CPP = "native/cpp_node.cpp"
+_SHM = "pytensor_federated_tpu/service/shm.py"
 
 #: npwire decode entry points that must enforce the known-flags mask.
 _NPWIRE_DECODERS = ("decode_arrays_all", "decode_batch")
@@ -263,6 +264,145 @@ def _cpp_findings(src: SourceFile) -> Iterator[Finding]:
     yield from _check_flag_map(src, impl, known_mask, guarded, line_of)
 
 
+def _shm_findings(src: SourceFile) -> Iterator[Finding]:
+    """The shm doorbell's declarations: frame kinds, flag bits, and
+    the arena DESCRIPTOR struct must match service/wire_registry.py,
+    and the frame decoder must reject unknown kinds AND flag bits."""
+    tree = src.tree
+    assigns = _collect_assignments(tree)
+    env: Dict[str, int] = {}
+    kinds: Dict[str, int] = {}
+    flags: Dict[str, int] = {}
+    line_of: Dict[str, int] = {}
+    for name, value in assigns.items():
+        v = _eval_int(value, env)
+        if v is not None:
+            env[name] = v
+        if name.startswith("_KIND_") and v is not None:
+            kinds[name[len("_KIND_"):]] = v
+            line_of["KIND_" + name[len("_KIND_"):]] = value.lineno
+        if name.startswith("_FLAG_") and v is not None:
+            flags[name[len("_FLAG_"):]] = v
+            line_of["FLAG_" + name[len("_FLAG_"):]] = value.lineno
+
+    def check_table(
+        impl: Dict[str, int], declared: Dict[str, int], what: str,
+        prefix: str,
+    ) -> Iterator[Finding]:
+        seen: Dict[int, str] = {}
+        for name, num in impl.items():
+            line = line_of.get(prefix + name, 1)
+            if name not in declared:
+                yield src.finding(
+                    "wire-registry",
+                    line,
+                    f"shm {what} {name!r} ({num}) is not declared in "
+                    f"service/wire_registry.py",
+                )
+            elif declared[name] != num:
+                yield src.finding(
+                    "wire-registry",
+                    line,
+                    f"shm {what} {name!r} is {num} here but declared "
+                    f"as {declared[name]} in service/wire_registry.py",
+                )
+            if num in seen:
+                yield src.finding(
+                    "wire-registry",
+                    line,
+                    f"shm {what} value {num} collides: "
+                    f"{seen[num]!r} and {name!r}",
+                )
+            seen[num] = name
+        for name, num in declared.items():
+            if name not in impl:
+                yield src.finding(
+                    "wire-registry",
+                    1,
+                    f"declared shm {what} {name!r} ({num}) is missing "
+                    f"from {src.rel}",
+                )
+
+    yield from check_table(kinds, REG.SHMWIRE_KINDS, "frame kind", "KIND_")
+    yield from check_table(flags, REG.SHMWIRE_FLAGS, "flag", "FLAG_")
+    known_mask = env.get("_KNOWN_FLAGS")
+    if known_mask is None:
+        yield src.finding(
+            "wire-registry",
+            1,
+            f"{src.rel} has no known-flags mask — the doorbell decoder "
+            "cannot reject undeclared flag bits (loud-failure contract)",
+        )
+    elif known_mask != REG.SHMWIRE_KNOWN_FLAGS:
+        yield src.finding(
+            "wire-registry",
+            1,
+            f"shm known-flags mask is {known_mask:#x} but the registry "
+            f"declares {REG.SHMWIRE_KNOWN_FLAGS:#x}",
+        )
+    # The arena descriptor struct: the one fixed layout descriptors
+    # are packed/unpacked with, pinned to the registry declaration.
+    desc_fmt: Optional[str] = None
+    desc_line = 1
+    value = assigns.get("_DESC_STRUCT")
+    if value is not None:
+        desc_line = value.lineno
+        if (
+            isinstance(value, ast.Call)
+            and value.args
+            and isinstance(value.args[0], ast.Constant)
+            and isinstance(value.args[0].value, str)
+        ):
+            desc_fmt = value.args[0].value
+    if desc_fmt is None:
+        yield src.finding(
+            "wire-registry",
+            desc_line,
+            f"{src.rel} does not define _DESC_STRUCT as a "
+            "struct.Struct with a literal format — the arena "
+            "descriptor layout must be pinned to "
+            "service/wire_registry.py SHM_DESC_STRUCT",
+        )
+    elif desc_fmt != REG.SHM_DESC_STRUCT:
+        yield src.finding(
+            "wire-registry",
+            desc_line,
+            f"arena descriptor struct is {desc_fmt!r} here but "
+            f"declared as {REG.SHM_DESC_STRUCT!r} in "
+            "service/wire_registry.py "
+            f"(field order: {', '.join(REG.SHM_DESC_FIELD_ORDER)})",
+        )
+    # Decoder-side rejection: decode_frame must enforce both the
+    # known-kinds set and the known-flags mask.
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef) and node.name == "decode_frame":
+            refs = {
+                n.id for n in ast.walk(node) if isinstance(n, ast.Name)
+            }
+            if not refs & {"_check_flags", "_KNOWN_FLAGS"}:
+                yield src.finding(
+                    "wire-registry",
+                    node.lineno,
+                    "decode_frame does not reject unknown flag bits "
+                    "(must check flags against the known-flags mask)",
+                )
+            if "_KNOWN_KINDS" not in refs:
+                yield src.finding(
+                    "wire-registry",
+                    node.lineno,
+                    "decode_frame does not reject unknown frame kinds "
+                    "(must check the kind against _KNOWN_KINDS)",
+                )
+            break
+    else:
+        yield src.finding(
+            "wire-registry",
+            1,
+            f"{src.rel} has no decode_frame — the doorbell wire has "
+            "no guarded decoder",
+        )
+
+
 def _npproto_message_of(func_name: str) -> str:
     """Which registry message a codec function's literals belong to —
     by the naming convention the codec module keeps."""
@@ -357,9 +497,10 @@ def _npproto_findings(src: SourceFile) -> Iterator[Finding]:
 
 @rule(
     "wire-registry",
-    "npwire flag bits and npproto field numbers must match "
-    "service/wire_registry.py across npwire.py, npproto_codec.py and "
-    "native/cpp_node.cpp, with decoder-side rejection/dispatch",
+    "npwire flag bits, npproto field numbers, and shm doorbell "
+    "kinds/flags/descriptor layout must match service/wire_registry.py "
+    "across npwire.py, npproto_codec.py, shm.py and native/cpp_node.cpp, "
+    "with decoder-side rejection/dispatch",
     scope="repo",
 )
 def check_wire_registry(sources: Sequence[SourceFile]) -> Iterator[Finding]:
@@ -373,6 +514,9 @@ def check_wire_registry(sources: Sequence[SourceFile]) -> Iterator[Finding]:
     npproto = by_rel.get(_NPPROTO)
     if npproto is not None:
         yield from _npproto_findings(npproto)
+    shm = by_rel.get(_SHM)
+    if shm is not None:
+        yield from _shm_findings(shm)
 
 
 # ---------------------------------------------------------------------------

@@ -51,6 +51,16 @@ getload_garbage     GetLoad answers undecodable bytes (the probe lane
                     must fail the probe, not balance toward zero load)
 kill_process        ``SIGKILL`` the current process at the injection
                     point (mid-frame process death)
+corrupt_descriptor  flip bytes inside a shm doorbell frame's descriptor
+                    block (offset/len/generation/dtype bits) — the
+                    arena reader must fail loudly, never read a wrong
+                    or torn slot (shm lane only)
+truncate_slot       scribble the arena slot's tail generation after the
+                    payload write — the slot reads as a write that
+                    never completed (shm lane only)
+stale_generation    age the descriptor's generation so it no longer
+                    matches the slot — the recycled-slot race, forced
+                    (shm lane only)
 ==================  =======================================================
 """
 
@@ -78,6 +88,9 @@ FAULT_KINDS = frozenset(
         "compute_wrong_shape",
         "getload_garbage",
         "kill_process",
+        "corrupt_descriptor",
+        "truncate_slot",
+        "stale_generation",
     }
 )
 

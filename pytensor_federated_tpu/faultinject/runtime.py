@@ -44,8 +44,23 @@ point                     primitive / applicable kinds
                           compute_wrong_shape
 ``server.getload``        :func:`getload_filter` — getload_garbage,
                           delay
+``shm.server.getload``    :func:`getload_filter` (shm doorbell LOAD
+                          lane; garbage must fail the probe loudly)
 ``pool.probe``            :func:`probe_filter` — drop/disconnect (force
                           a failed probe), delay
+``shm.send``/``recv``     :func:`send_frame_through` /
+``shm.server.send``       :func:`filter_bytes` — the doorbell channel:
+``shm.server.recv``       all byte + process kinds, plus
+                          ``corrupt_descriptor`` via
+                          :func:`corrupt_descriptor_bytes` at the
+                          ``shm.descriptor`` point
+``shm.arena.write``       :func:`arena_fault` — truncate_slot,
+``shm.arena.reply``       stale_generation, delay, kill_process (the
+                          arena-side kinds; :mod:`..service.shm`
+                          applies the returned kind to the slot it
+                          just wrote)
+``shm.compute``           :func:`compute_filter` (same kinds as
+                          ``server.compute``)
 ========================  ==============================================
 """
 
@@ -78,6 +93,8 @@ __all__ = [
     "getload_filter_async",
     "probe_filter",
     "probe_filter_async",
+    "arena_fault",
+    "corrupt_descriptor_bytes",
     "snapshot",
 ]
 
@@ -436,6 +453,56 @@ async def getload_filter_async(point: str = "server.getload") -> Optional[bytes]
         await asyncio.sleep(rule.delay_s)
         return None
     raise FaultPlanError(f"fault kind {rule.kind!r} not applicable at {point}")
+
+
+def arena_fault(point: str, peer: Optional[str] = None) -> Optional[str]:
+    """SHM arena-side shim: returns the fired arena kind
+    (``truncate_slot`` / ``stale_generation``) for the caller to apply
+    to the slot it controls — the fault needs arena knowledge the
+    runtime does not have, so :mod:`..service.shm` executes it at the
+    write site.  ``delay`` sleeps here (sync lane); ``kill_process``
+    kills; ``None`` = no fault."""
+    rule = decide(point, peer)
+    if rule is None:
+        return None
+    kind = rule.kind
+    if kind in ("truncate_slot", "stale_generation"):
+        return kind
+    if kind == "delay":
+        time.sleep(rule.delay_s)
+        return None
+    if kind in ("drop", "disconnect"):
+        raise ConnectionError(f"faultinject[{kind}] at {point}")
+    if kind == "kill_process":
+        _kill_now(point)
+    raise FaultPlanError(f"fault kind {kind!r} not applicable at {point}")
+
+
+def corrupt_descriptor_bytes(
+    point: str, buf: bytes, desc_off: int, peer: Optional[str] = None
+) -> bytes:
+    """``corrupt_descriptor`` shim for shm doorbell frames: flips 1-3
+    bytes INSIDE the descriptor block (``desc_off`` onward — offsets,
+    lengths, generations, dtype bits), which is exactly the damage the
+    arena reader's generation/bounds validation must classify as
+    :class:`~..service.npwire.WireError`, never a torn or silently
+    wrong array.  Frame-header kinds stay with :func:`filter_bytes`."""
+    rule = decide(point, peer)
+    if rule is None:
+        return buf
+    if rule.kind != "corrupt_descriptor":
+        raise FaultPlanError(
+            f"fault kind {rule.kind!r} not applicable at {point}"
+        )
+    if desc_off >= len(buf):
+        return buf
+    rng = rule._rng
+    out = bytearray(buf)
+    span = len(buf) - desc_off
+    for _ in range(min(3, span)):
+        i = desc_off + (rng.randrange(span) if rng is not None else 0)
+        out[i] ^= 0xFF
+    return bytes(out)
 
 
 def probe_filter(peer: str, point: str = "pool.probe") -> bool:

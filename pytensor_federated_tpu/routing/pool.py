@@ -117,16 +117,28 @@ _EWMA_ALPHA = 0.3
 class Replica:
     """One pool member: address + breaker + routing signals.
 
-    The lazily-created transport client and (TCP lane) its dedicated
+    The lazily-created transport client and (sync lanes) its dedicated
     single worker thread hang off the replica so connection state keeps
     the thread/loop affinity the transports require (service/client.py
     connection cache; tcp.py's single-socket lock-step contract).
+    ``transport`` is PER REPLICA (default: the pool's), so one pool can
+    mix shm replicas (colocated, zero-copy) with grpc/tcp ones — the
+    policies, breakers, and failover machinery are transport-blind.
     """
 
-    def __init__(self, host: str, port: int, breaker: CircuitBreaker) -> None:
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        breaker: CircuitBreaker,
+        transport: str = "grpc",
+        client_kwargs: Optional[dict] = None,
+    ) -> None:
         self.host = host
         self.port = int(port)
         self.breaker = breaker
+        self.transport = transport
+        self.client_kwargs = client_kwargs
         self.ewma_latency_s: Optional[float] = None
         self.load: Optional[dict] = None
         self.load_ts: Optional[float] = None
@@ -252,9 +264,10 @@ class NodePool:
         breaker_kwargs: Optional[dict] = None,
         member_retries: int = 2,
     ) -> None:
-        if transport not in ("grpc", "tcp"):
+        if transport not in ("grpc", "tcp", "shm"):
             raise ValueError(
-                f"transport must be 'grpc' or 'tcp', got {transport!r}"
+                f"transport must be 'grpc', 'tcp' or 'shm', "
+                f"got {transport!r}"
             )
         self.transport = transport
         self.policy = get_policy(policy)
@@ -279,7 +292,13 @@ class NodePool:
 
     # -- registry ---------------------------------------------------------
 
-    def _make_replica(self, host: str, port: int) -> Replica:
+    def _make_replica(
+        self,
+        host: str,
+        port: int,
+        transport: Optional[str] = None,
+        client_kwargs: Optional[dict] = None,
+    ) -> Replica:
         addr = f"{host}:{int(port)}"
 
         def on_transition(old: str, new: str, _addr: str = addr) -> None:
@@ -291,15 +310,52 @@ class NodePool:
             host,
             port,
             CircuitBreaker(on_transition=on_transition, **self.breaker_kwargs),
+            transport or self.transport,
+            client_kwargs,
         )
         replica._load_stale_s = self.load_stale_s
         return replica
 
-    def add_replica(self, host: str, port: int) -> Replica:
-        replica = self._make_replica(host, port)
+    def add_replica(
+        self,
+        host: str,
+        port: int,
+        *,
+        transport: Optional[str] = None,
+        client_kwargs: Optional[dict] = None,
+    ) -> Replica:
+        """Register one replica; ``transport`` overrides the pool
+        default for THIS replica (``"shm"`` mixes a colocated
+        zero-copy node into a grpc/tcp pool).  ``client_kwargs``
+        overrides the pool-level kwargs for this replica — a replica
+        of a DIFFERENT transport never inherits the pool default's
+        kwargs (they target another client class)."""
+        if transport is not None and transport not in ("grpc", "tcp", "shm"):
+            raise ValueError(
+                f"transport must be 'grpc', 'tcp' or 'shm', "
+                f"got {transport!r}"
+            )
+        replica = self._make_replica(host, port, transport, client_kwargs)
         with self._lock:
-            if replica.address in self._replicas:
-                return self._replicas[replica.address]
+            existing = self._replicas.get(replica.address)
+            if existing is not None:
+                # Idempotent re-add is fine; a CONFLICTING override is
+                # not — silently keeping the old transport would route
+                # every call down a lane the caller believes replaced.
+                if (
+                    transport is not None
+                    and existing.transport != transport
+                ) or (
+                    client_kwargs is not None
+                    and existing.client_kwargs != client_kwargs
+                ):
+                    raise ValueError(
+                        f"replica {replica.address} is already "
+                        f"registered as transport="
+                        f"{existing.transport!r}; remove_replica() "
+                        "first to re-register with different settings"
+                    )
+                return existing
             self._replicas[replica.address] = replica
         _flightrec.record("pool.replica_added", replica=replica.address)
         self._refresh_state_gauges()
@@ -340,18 +396,39 @@ class NodePool:
     # -- transport clients ------------------------------------------------
 
     def client_for(self, replica: Replica) -> Any:
-        """The replica's lazily-created transport client.  ``retries=0``
-        on purpose: the POOL owns retry/failover — an inner retry loop
-        would replay against the very replica being failed away from."""
+        """The replica's lazily-created transport client (dispatched on
+        the REPLICA's transport — mixed pools construct per kind).
+        ``retries=0`` on purpose: the POOL owns retry/failover — an
+        inner retry loop would replay against the very replica being
+        failed away from."""
         if replica.client is None:
-            if self.transport == "grpc":
+            # Per-replica kwargs win; pool-level kwargs apply only to
+            # replicas of the pool's own transport (they target one
+            # specific client class — a codec= meant for grpc must not
+            # reach the shm constructor in a mixed pool).
+            if replica.client_kwargs is not None:
+                kwargs = dict(replica.client_kwargs)
+            elif replica.transport == self.transport:
+                kwargs = dict(self.client_kwargs)
+            else:
+                kwargs = {}
+            if replica.transport == "grpc":
                 from ..service.client import ArraysToArraysServiceClient
 
                 replica.client = ArraysToArraysServiceClient(
                     replica.host,
                     replica.port,
                     retries=0,
-                    **self.client_kwargs,
+                    **kwargs,
+                )
+            elif replica.transport == "shm":
+                from ..service.shm import ShmArraysClient
+
+                replica.client = ShmArraysClient(
+                    replica.host,
+                    replica.port,
+                    retries=0,
+                    **kwargs,
                 )
             else:
                 from ..service.tcp import TcpArraysClient
@@ -360,13 +437,13 @@ class NodePool:
                     replica.host,
                     replica.port,
                     retries=0,
-                    **self.client_kwargs,
+                    **kwargs,
                 )
         return replica.client
 
     def executor_for(self, replica: Replica) -> "ThreadPoolExecutor":
-        """TCP lane: the replica's single worker thread (the sync
-        socket client is driven off the event loop via
+        """Sync lanes (tcp/shm): the replica's single worker thread
+        (the sync socket client is driven off the event loop via
         ``run_in_executor``; one dedicated thread preserves the
         lock-step single-caller contract)."""
         if replica._executor is None:
@@ -398,36 +475,40 @@ class NodePool:
         return load is not None
 
     async def probe_once_async(self) -> int:
-        """One concurrent probe sweep (gRPC lane); returns the number
+        """One concurrent probe sweep, dispatched PER REPLICA (mixed
+        pools probe each member over its own lane); returns the number
         of replicas that answered.  Success/failure feeds each
         replica's breaker exactly like call outcomes do."""
         import asyncio
 
         replicas = self.replicas
-        if self.transport == "grpc":
-            results = await asyncio.gather(
-                *(self._probe_replica_grpc(r) for r in replicas)
-            )
-        else:
-            loop = asyncio.get_running_loop()
+        loop = asyncio.get_running_loop()
 
-            def one(r: Replica) -> bool:
-                if _fi.active_plan is not None:  # chaos seam: probe lane
-                    if not _fi.probe_filter(r.address):
-                        r.record_load(None)
-                        return False
-                t0 = time.perf_counter()
-                ok = _tcp_probe(
-                    r.host, r.port, timeout=self.probe_timeout_s
-                )
-                _POOL_PROBE_S.observe(time.perf_counter() - t0)
-                # No load schema on the TCP lane: liveness only.
-                r.record_load({} if ok else None)
-                return ok
-
-            results = await asyncio.gather(
-                *(loop.run_in_executor(None, one, r) for r in replicas)
+        def one(r: Replica) -> bool:
+            if _fi.active_plan is not None:  # chaos seam: probe lane
+                if not _fi.probe_filter(r.address):
+                    r.record_load(None)
+                    return False
+            t0 = time.perf_counter()
+            # The zero-item batch probe frame: the TCP health check,
+            # which the shm doorbell answers too (its npwire fallback
+            # lane) — one probe shape for both sync transports.
+            ok = _tcp_probe(
+                r.host, r.port, timeout=self.probe_timeout_s
             )
+            _POOL_PROBE_S.observe(time.perf_counter() - t0)
+            # No load schema on the sync probe: liveness only.
+            r.record_load({} if ok else None)
+            return ok
+
+        results = await asyncio.gather(
+            *(
+                self._probe_replica_grpc(r)
+                if r.transport == "grpc"
+                else loop.run_in_executor(None, one, r)
+                for r in replicas
+            )
+        )
         up = 0
         for replica, ok in zip(replicas, results):
             if ok:

@@ -166,7 +166,7 @@ class PooledArraysClient:
         client = self.pool.client_for(replica)
         replica.inflight += 1  # the local load signal (policies.py)
         try:
-            if self.pool.transport == "grpc":
+            if replica.transport == "grpc":
                 return await client.evaluate_async(*arrays)
             loop = asyncio.get_running_loop()
             ctx = contextvars.copy_context()  # spans cross the worker
@@ -190,7 +190,7 @@ class PooledArraysClient:
             with _spans.span(
                 "pool.window", replica=replica.address, n=len(reqs)
             ):
-                if self.pool.transport == "grpc":
+                if replica.transport == "grpc":
                     partial, exc = (
                         await client.evaluate_many_partial_async(
                             reqs, window=window, batch=batch
@@ -231,7 +231,7 @@ class PooledArraysClient:
         # verdict — leaving it claimed would park the breaker in
         # half-open forever when no probe loop runs.
         replica.breaker.release()
-        if self.pool.transport == "grpc" and replica.client is not None:
+        if replica.transport == "grpc" and replica.client is not None:
             # A cancelled lock-step stream call may have written its
             # request without reading the reply — the connection is
             # desynchronized.  Drop it so the replica's next call

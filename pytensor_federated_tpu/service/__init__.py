@@ -18,6 +18,7 @@ from .npwire import (
     encode_arrays,
     encode_batch,
 )
+from .shm import ShmArraysClient, serve_shm
 from .tcp import RemoteComputeError, TcpArraysClient, serve_tcp_once
 from .server import (
     ArraysToArraysService,
@@ -41,6 +42,7 @@ __all__ = [
     "encode_arrays",
     "encode_batch",
     "RemoteComputeError",
+    "ShmArraysClient",
     "TcpArraysClient",
     "get_load_async",
     "get_loads_async",
@@ -48,6 +50,7 @@ __all__ = [
     "get_node_traces_async",
     "run_node",
     "serve",
+    "serve_shm",
     "serve_tcp_once",
     "thread_pid_id",
 ]

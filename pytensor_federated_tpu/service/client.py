@@ -52,6 +52,7 @@ from .npwire import (
     decode_batch,
     encode_arrays,
     encode_batch,
+    fast_uuid,
 )
 from .server import EVALUATE, EVALUATE_STREAM, GET_LOAD
 
@@ -529,7 +530,7 @@ class ArraysToArraysServiceClient:
                 return outputs, ruuid, None
 
         else:
-            uuid = uuid_mod.uuid4().bytes
+            uuid = fast_uuid()
             request = encode_arrays(arrays, uuid=uuid, trace_id=trace_id)
 
             def decode(reply):
@@ -794,7 +795,7 @@ class ArraysToArraysServiceClient:
                 trace_id=trace_id,
             )
         else:
-            outer_uuid = uuid_mod.uuid4().bytes
+            outer_uuid = fast_uuid()
             frame = encode_batch(
                 [req for req, _u, _d in part],
                 uuid=outer_uuid,

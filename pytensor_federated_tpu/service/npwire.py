@@ -71,15 +71,41 @@ Layout (little-endian):
 
 from __future__ import annotations
 
+import itertools
 import json
+import os
 import struct
+import threading
 import uuid as uuid_mod
-from typing import List, Optional, Sequence, Tuple
+from functools import lru_cache
+from typing import List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 from numpy.lib.format import descr_to_dtype, dtype_to_descr
 
 from ..faultinject import runtime as _fi
+from ..telemetry import metrics as _metrics
+
+#: One buffer of a scatter/gather frame: header/metadata bytes, or a
+#: zero-copy view of a source array's payload.
+Buffer = Union[bytes, memoryview]
+
+#: Payload bytes the transport stack memcpy's, by lane and stage — the
+#: instrument behind docs/performance.md's "Zero-copy budget" table.
+#: Stages: ``encode_layout`` (non-contiguous input normalized),
+#: ``encode_join`` (payload flattened into one contiguous frame),
+#: ``decode_copy`` (frame bytes copied out into result arrays),
+#: ``arena_write`` (bytes written into a shared-memory arena slot —
+#: the shm lane's single copy).  Zero-copy paths (sendmsg vectors,
+#: ``copy=False`` decode, arena read views) inc nothing.
+WIRE_BYTES_COPIED = _metrics.counter(
+    "pftpu_wire_bytes_copied_total",
+    "Payload bytes memcpy'd by the transport stack, by lane and stage",
+    ("lane", "stage"),
+)
+_LAYOUT_COPIED = WIRE_BYTES_COPIED.labels(lane="npwire", stage="encode_layout")
+_JOIN_COPIED = WIRE_BYTES_COPIED.labels(lane="npwire", stage="encode_join")
+_DECODE_COPIED = WIRE_BYTES_COPIED.labels(lane="npwire", stage="decode_copy")
 
 MAGIC = b"NPW1"
 _FLAG_ERROR = 1
@@ -99,6 +125,39 @@ _FLAGS_OFF = 5
 
 class WireError(ValueError):
     """Malformed or unsupported wire payload."""
+
+
+# Correlation ids need per-process uniqueness, not cryptographic
+# randomness — but ``uuid4()`` draws 16 bytes of real entropy, a
+# getrandom(2) syscall that costs tens of microseconds on some hosts
+# (measured 37 us in the round-9 container: 38% of the shm lane's hot
+# path).  A random 10-byte process prefix + pid + 4-byte counter keeps
+# ids unique across processes, connections, and 4 billion calls.
+_UUID_PREFIX = os.urandom(10) + struct.pack("<H", os.getpid() & 0xFFFF)
+_uuid_counter = itertools.count()
+_uuid_lock = threading.Lock()
+
+
+def _reseed_uuid_prefix() -> None:
+    """Fork hook: a fork-started worker inherits the parent's prefix
+    AND counter, so without reseeding every child would emit the
+    parent's exact id stream — re-derive both in the child."""
+    global _UUID_PREFIX, _uuid_counter
+    _UUID_PREFIX = os.urandom(10) + struct.pack("<H", os.getpid() & 0xFFFF)
+    _uuid_counter = itertools.count()
+
+
+if hasattr(os, "register_at_fork"):  # POSIX only; spawn needs nothing
+    os.register_at_fork(after_in_child=_reseed_uuid_prefix)
+
+
+def fast_uuid() -> bytes:
+    """A 16-byte correlation id without the per-call entropy syscall
+    (module comment above).  Wire-compatible with ``uuid4().bytes`` —
+    every peer treats uuids as opaque 16-byte tokens."""
+    with _uuid_lock:
+        n = next(_uuid_counter)
+    return _UUID_PREFIX + struct.pack("<I", n & 0xFFFFFFFF)
 
 
 def _check_flags(flags: int) -> None:
@@ -122,51 +181,36 @@ def _tupleize(descr: object) -> object:
     return descr
 
 
+@lru_cache(maxsize=256)
 def _parse_dtype(dt_bytes: bytes) -> np.dtype:
+    # Pure bytes -> dtype, cached: a window of same-typed arrays pays
+    # one parse, not one per array (failures are not cached, so every
+    # corrupt descriptor stays loud).
     try:
         dt_str = dt_bytes.decode("utf-8")
         if dt_str.startswith("["):
             # JSON-array descr = structured dtype; plain string otherwise.
             return descr_to_dtype(_tupleize(json.loads(dt_str)))
         return np.dtype(dt_str)
-    except (ValueError, TypeError, KeyError) as e:
+    except (ValueError, TypeError, KeyError, SyntaxError) as e:
         # ValueError covers UnicodeDecodeError and json errors too —
         # every corrupt-descriptor shape must surface as WireError.
+        # SyntaxError: numpy parses some malformed dtype strings as
+        # Python literals (e.g. b"08f" -> "leading zeros..."), found
+        # by the ISSUE-9 descriptor fuzz — without this arm a flipped
+        # dtype byte escaped the loud-failure classification.
         raise WireError(f"bad dtype descriptor {dt_bytes!r}: {e}") from None
 
 
-def encode_arrays(
-    arrays: Sequence[np.ndarray],
-    *,
-    uuid: Optional[bytes] = None,
-    error: Optional[str] = None,
-    trace_id: Optional[bytes] = None,
-) -> bytes:
-    """Encode arrays (+uuid, +optional error/trace_id) into one framed
-    message.  ``trace_id`` (16 bytes) is the telemetry correlation id;
-    ``None`` emits the exact pre-telemetry frame."""
-    if uuid is None:
-        uuid = uuid_mod.uuid4().bytes
-    if len(uuid) != 16:
-        raise WireError(f"uuid must be 16 bytes, got {len(uuid)}")
-    flags = 0
-    if error is not None:
-        flags |= _FLAG_ERROR
-    if trace_id is not None:
-        if len(trace_id) != 16:
-            raise WireError(
-                f"trace_id must be 16 bytes, got {len(trace_id)}"
-            )
-        flags |= _FLAG_TRACE
-    parts: List[bytes] = [
-        struct.pack("<4sBB16sI", MAGIC, 1, flags, uuid, len(arrays))
-    ]
-    if error is not None:
-        err = error.encode("utf-8")
-        parts.append(struct.pack("<I", len(err)))
-        parts.append(err)
-    if trace_id is not None:
-        parts.append(trace_id)
+def normalize_arrays(arrays: Sequence[np.ndarray]) -> List[np.ndarray]:
+    """Layout normalization, ONCE at encode entry (shared by the
+    contiguous and scatter/gather encoders): every array comes out
+    C-contiguous with a wire-legal dtype, so the payload bytes are a
+    straight memory image and the scatter/gather path can ship a view
+    instead of ``a.tobytes()``.  Fortran-ordered and sliced inputs pay
+    exactly one copy here (counted under ``encode_layout``);
+    already-contiguous inputs pay none."""
+    out: List[np.ndarray] = []
     for a in arrays:
         a = np.asarray(a)
         if a.dtype == object:
@@ -178,27 +222,123 @@ def encode_arrays(
             # NB: np.ascontiguousarray promotes 0-d to 1-d, so only call
             # it when actually needed (0-d is always contiguous).
             a = np.ascontiguousarray(a)
-        # dtype_to_descr/descr_to_dtype are the official npy-format
-        # helpers: plain dtypes serialize as their ".str" (e.g. "<f4"),
-        # structured dtypes as their field descr (JSON-encoded here) —
-        # ".str" alone collapses records to opaque void ("|V15").
-        descr = dtype_to_descr(a.dtype)
-        dt = (
-            descr.encode("ascii")
-            if isinstance(descr, str)
-            else json.dumps(descr).encode("utf-8")
-        )
+            _LAYOUT_COPIED.inc(a.nbytes)
+        out.append(a)
+    return out
+
+
+def payload_view(a: np.ndarray) -> Buffer:
+    """A zero-copy byte view of a (C-contiguous) array's payload, or a
+    ``tobytes()`` copy for the few dtypes that refuse the buffer
+    protocol (datetime64/timedelta64) — counted as a layout copy."""
+    try:
+        mv = memoryview(a)
+        return mv if mv.format == "B" and mv.ndim == 1 else mv.cast("B")
+    except (ValueError, TypeError, BufferError):
+        data = a.tobytes()
+        _LAYOUT_COPIED.inc(len(data))
+        return data
+
+
+@lru_cache(maxsize=256)
+def _encode_dtype(dtype: np.dtype) -> bytes:
+    # dtype_to_descr/descr_to_dtype are the official npy-format
+    # helpers: plain dtypes serialize as their ".str" (e.g. "<f4"),
+    # structured dtypes as their field descr (JSON-encoded here) —
+    # ".str" alone collapses records to opaque void ("|V15").
+    # Cached: dtypes are hashable and a workload reuses a handful.
+    descr = dtype_to_descr(dtype)
+    return (
+        descr.encode("ascii")
+        if isinstance(descr, str)
+        else json.dumps(descr).encode("utf-8")
+    )
+
+
+def encode_arrays_sg(
+    arrays: Sequence[np.ndarray],
+    *,
+    uuid: Optional[bytes] = None,
+    error: Optional[str] = None,
+    trace_id: Optional[bytes] = None,
+) -> List[Buffer]:
+    """Scatter/gather encode: the same frame as :func:`encode_arrays`
+    as a BUFFER VECTOR — header/metadata ``bytes`` interleaved with
+    zero-copy ``memoryview`` s of the (normalized) source arrays'
+    payloads.  ``b"".join(vector)`` is byte-identical to the
+    contiguous encoder's output; a vectored send
+    (``socket.sendmsg``, :func:`..service.tcp._sendmsg_all`) skips
+    that join entirely, so array bytes go source → kernel with no
+    intermediate frame copy.  The caller must keep the source arrays
+    alive until the vector is consumed (the views borrow their
+    memory).  With a fault plan installed the vector collapses to one
+    filtered contiguous buffer — byte-lane chaos needs the whole
+    frame in hand."""
+    if uuid is None:
+        uuid = uuid_mod.uuid4().bytes
+    if len(uuid) != 16:
+        raise WireError(f"uuid must be 16 bytes, got {len(uuid)}")
+    arrays = normalize_arrays(arrays)
+    flags = 0
+    if error is not None:
+        flags |= _FLAG_ERROR
+    if trace_id is not None:
+        if len(trace_id) != 16:
+            raise WireError(
+                f"trace_id must be 16 bytes, got {len(trace_id)}"
+            )
+        flags |= _FLAG_TRACE
+    parts: List[Buffer] = [
+        struct.pack("<4sBB16sI", MAGIC, 1, flags, uuid, len(arrays))
+    ]
+    if error is not None:
+        err = error.encode("utf-8")
+        parts.append(struct.pack("<I", len(err)))
+        parts.append(err)
+    if trace_id is not None:
+        parts.append(trace_id)
+    for a in arrays:
+        dt = _encode_dtype(a.dtype)
         parts.append(struct.pack("<H", len(dt)))
         parts.append(dt)
         parts.append(struct.pack("<B", a.ndim))
         parts.append(struct.pack(f"<{a.ndim}Q", *a.shape))
-        data = a.tobytes()
-        parts.append(struct.pack("<Q", len(data)))
-        parts.append(data)
-    out = b"".join(parts)
+        parts.append(struct.pack("<Q", a.nbytes))
+        if a.nbytes:
+            parts.append(payload_view(a))
     if _fi.active_plan is not None:  # chaos seam (faultinject.runtime)
-        out = _fi.filter_bytes("npwire.encode", out)
-    return out
+        return [_fi.filter_bytes("npwire.encode", b"".join(parts))]
+    return parts
+
+
+def sg_nbytes(parts: Sequence[Buffer]) -> int:
+    """Total byte length of a scatter/gather buffer vector."""
+    return sum(
+        p.nbytes if isinstance(p, memoryview) else len(p) for p in parts
+    )
+
+
+def encode_arrays(
+    arrays: Sequence[np.ndarray],
+    *,
+    uuid: Optional[bytes] = None,
+    error: Optional[str] = None,
+    trace_id: Optional[bytes] = None,
+) -> bytes:
+    """Encode arrays (+uuid, +optional error/trace_id) into one framed
+    message.  ``trace_id`` (16 bytes) is the telemetry correlation id;
+    ``None`` emits the exact pre-telemetry frame.  The contiguous form
+    of :func:`encode_arrays_sg` — one flattening join, counted under
+    the ``encode_join`` copy stage."""
+    parts = encode_arrays_sg(
+        arrays, uuid=uuid, error=error, trace_id=trace_id
+    )
+    if len(parts) == 1 and isinstance(parts[0], bytes):
+        return parts[0]  # chaos path: already joined and filtered
+    _JOIN_COPIED.inc(
+        sum(p.nbytes for p in parts if isinstance(p, memoryview))
+    )
+    return b"".join(parts)
 
 
 def encode_batch(
@@ -359,27 +499,31 @@ def append_spans(frame: bytes, spans: Sequence[dict]) -> bytes:
     )
 
 
-def decode_arrays(buf: bytes) -> Tuple[List[np.ndarray], bytes, Optional[str]]:
+def decode_arrays(
+    buf: bytes, *, copy: bool = True
+) -> Tuple[List[np.ndarray], bytes, Optional[str]]:
     """Decode a framed message -> (arrays, uuid, error).
 
     The historical 3-tuple shape; a frame carrying a trace id or spans
     tail decodes fine (both consumed and dropped).  Use
     :func:`decode_arrays_ex` / :func:`decode_arrays_all` to read them."""
-    arrays, uuid, error, _ = decode_arrays_ex(buf)
+    arrays, uuid, error, _ = decode_arrays_ex(buf, copy=copy)
     return arrays, uuid, error
 
 
 def decode_arrays_ex(
-    buf: bytes,
+    buf: bytes, *, copy: bool = True
 ) -> Tuple[List[np.ndarray], bytes, Optional[str], Optional[bytes]]:
     """Decode a framed message -> (arrays, uuid, error, trace_id); a
     spans tail (flag bit 4) is consumed and dropped."""
-    arrays, uuid, error, trace_id, _ = decode_arrays_all(buf)
+    arrays, uuid, error, trace_id, _ = decode_arrays_all(buf, copy=copy)
     return arrays, uuid, error, trace_id
 
 
 def decode_arrays_all(
     buf: bytes,
+    *,
+    copy: bool = True,
 ) -> Tuple[
     List[np.ndarray],
     bytes,
@@ -389,7 +533,14 @@ def decode_arrays_all(
 ]:
     """Full decode -> (arrays, uuid, error, trace_id, spans) where
     ``spans`` is the piggybacked span-tree list (``None`` when the flag
-    is unset)."""
+    is unset).
+
+    ``copy=True`` (the default, and the historical behavior) returns
+    owned writable arrays.  ``copy=False`` returns READ-ONLY
+    ``frombuffer`` views into ``buf`` itself — zero payload copies;
+    the views keep the whole frame alive, so opt in where the frame is
+    short-lived anyway (a server decoding a request it computes on and
+    drops) rather than where results are retained."""
     if _fi.active_plan is not None:  # chaos seam (faultinject.runtime)
         buf = _fi.filter_bytes("npwire.decode", buf)
     try:
@@ -439,14 +590,28 @@ def decode_arrays_all(
             off += 8 * ndim
             (dlen,) = struct.unpack_from("<Q", buf, off)
             off += 8
-            data = buf[off : off + dlen]
-            if len(data) != dlen:
+            data_off = off
+            if data_off + dlen > len(buf):
                 raise WireError("truncated array payload")
             off += dlen
         except struct.error as e:
             raise WireError(f"truncated message: {e}") from None
         try:
-            arrays.append(np.frombuffer(data, dtype=dt).reshape(shape).copy())
+            # frombuffer with an explicit offset/count reads the frame
+            # in place — no slice copy; ``copy=True`` then pays exactly
+            # ONE copy (the historical path paid two: slice + .copy()).
+            if dt.itemsize == 0 or dlen % dt.itemsize:
+                raise ValueError(
+                    f"payload length {dlen} is not a multiple of "
+                    f"itemsize {dt.itemsize}"
+                )
+            arr = np.frombuffer(
+                buf, dtype=dt, count=dlen // dt.itemsize, offset=data_off
+            ).reshape(shape)
+            if copy:
+                arr = arr.copy()
+                _DECODE_COPIED.inc(dlen)
+            arrays.append(arr)
         except ValueError as e:
             # e.g. data_len inconsistent with shape * itemsize
             raise WireError(f"corrupt array payload: {e}") from None

@@ -1,0 +1,1685 @@
+"""Shared-memory arena transport — the zero-copy lane for colocated nodes.
+
+The host-federation transports so far all MOVE the payload: npwire
+frames concatenate array bytes, sockets copy them through the kernel,
+decoders copy them back out.  For replicas on the SAME host that is
+pure waste — the bytes end up in the same physical memory they started
+next to.  This module keeps payloads in a shared mmap arena
+(:mod:`.arena`) and sends only DESCRIPTORS — ``(slot, delta, length,
+generation)`` pointers plus dtype/shape — over a lightweight TCP
+"doorbell" channel:
+
+- the driver writes request arrays into its half of the arena pair
+  exactly once (arrays it passes repeatedly by identity — per-node
+  data constants — are PINNED after their second appearance and never
+  copied again: steady-state requests move descriptor bytes only);
+- the node builds ``frombuffer`` views straight onto the shared pages
+  (zero read copies), computes, writes replies into its half, and
+  doorbells the reply descriptors back;
+- generation-counted slots (:mod:`.arena`) make every stale, torn, or
+  corrupt descriptor a loud :class:`~.npwire.WireError`, never torn
+  data — the CLAUDE.md wire invariant, extended to shared memory.
+
+The doorbell speaks u32-length-prefixed frames like the TCP lane, and
+ALSO answers plain npwire frames (:func:`~.tcp.serve_npwire_payload`),
+so the replica pool's existing zero-item batch probe — and therefore
+`routing.NodePool` health checks, breakers, and failover — work
+against an shm node unchanged.  :class:`ShmArraysClient` carries the
+full pinned-client surface (``evaluate``, pipelined/batched
+``evaluate_many``, ``evaluate_many_partial``, ``get_load``) so a pool
+can mix shm replicas with grpc/tcp ones.
+
+Frame layout (little-endian; constants declared in
+:mod:`.wire_registry`, cross-checked by the graftlint wire-registry
+rule)::
+
+  header: MAGIC("SHM1") version(u8) kind(u8) flags(u8) pad(u8) uuid(16s)
+          [flags&1 error: len(u32) utf8]
+          [flags&2 trace: trace_id(16s)]
+  descriptor (per array): slot(u64) delta(u32) length(u64) gen(u64)
+          dtype_len(u16) dtype ndim(u8) shape(u64*ndim)
+  bodies: ATTACH ()                    ATTACH_OK (json: req/rep/size/id)
+          EVAL (ack_gen u64, n u32, n descriptors)
+          REPLY (n u32, n descriptors)        [error rides flags&1]
+          EVAL_BATCH (ack_gen u64, k u32, k × [uuid 16s, n u32, descs])
+          REPLY_BATCH (k u32, k × [uuid 16s, elen u32, err? | n u32, descs])
+          ACK (ack_gen u64)            GETLOAD ()    LOAD (json)
+          PING (n u32, descs)          PONG ()       ERROR (flags&1)
+
+Reclamation: request slots are freed by the client when their reply
+arrives (the doorbell is lock-step FIFO per connection, so the node is
+provably done with them); reply slots are freed by the node when the
+client's next frame acknowledges their generations (``ack_gen``
+watermark — acks piggyback on every EVAL, and a trailing ACK frame at
+the end of each pipelined window releases its final replies without
+waiting for the next call).  Telemetry trace ids ride flag bit 2; the spans piggyback
+lane is not implemented on this transport (the gRPC/TCP lanes carry
+reunion; an shm node is by definition colocated and observable).
+
+No reference-runtime analog: the reference wire is untouched — this is
+a driver-local extension (docs/migrating.md).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+import time
+import uuid as uuid_mod
+import weakref
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..faultinject import runtime as _fi
+from ..telemetry import flightrec as _flightrec
+from ..telemetry import spans as _spans
+from ..telemetry import watchdog as _watchdog
+from . import _rpc_metrics
+from .arena import DEFAULT_ARENA_BYTES, Arena
+from .batching import execute_window_sync as _execute_window_sync
+from .npwire import (
+    WIRE_BYTES_COPIED,
+    WireError,
+    _encode_dtype,
+    _parse_dtype,
+    fast_uuid,
+    normalize_arrays,
+    payload_view,
+)
+from .tcp import (
+    RemoteComputeError,
+    _recv_frame,
+    _send_frame,
+    serve_npwire_payload,
+)
+
+__all__ = ["ShmArraysClient", "serve_shm"]
+
+MAGIC = b"SHM1"
+
+# Frame kinds — mirrored from service/wire_registry.py SHMWIRE_KINDS
+# (the declared source; graftlint cross-checks).  Decoders REJECT an
+# unknown kind: a doorbell peer must ship in lockstep.
+_KIND_ATTACH = 1
+_KIND_ATTACH_OK = 2
+_KIND_EVAL = 3
+_KIND_REPLY = 4
+_KIND_EVAL_BATCH = 5
+_KIND_REPLY_BATCH = 6
+_KIND_ACK = 7
+_KIND_GETLOAD = 8
+_KIND_LOAD = 9
+_KIND_PING = 10
+_KIND_PONG = 11
+_KIND_ERROR = 12
+
+_KNOWN_KINDS = frozenset(range(_KIND_ATTACH, _KIND_ERROR + 1))
+
+# Flag bits — mirrored from service/wire_registry.py SHMWIRE_FLAGS.
+_FLAG_ERROR = 1
+_FLAG_TRACE = 2
+_KNOWN_FLAGS = _FLAG_ERROR | _FLAG_TRACE
+
+_HEADER = struct.Struct("<4sBBBB16s")
+#: The arena descriptor — layout declared as SHM_DESC_STRUCT in
+#: service/wire_registry.py (field order: slot, delta, length,
+#: generation).
+_DESC_STRUCT = struct.Struct("<QIQQ")
+
+_BATCH_CHUNK = 32  # requests per EVAL_BATCH frame (tcp.py parity)
+
+_CALL_S = _rpc_metrics.CALL_S
+_RETRIES = _rpc_metrics.RETRIES
+_DROPS = _rpc_metrics.DROPS
+_BATCH_S = _rpc_metrics.BATCH_S
+_WINDOW_DEPTH = _rpc_metrics.WINDOW_DEPTH
+_FRAME_REQS = _rpc_metrics.BATCH_FRAME_REQS
+_SHM_DECODE_COPIED = WIRE_BYTES_COPIED.labels(lane="shm", stage="decode_copy")
+
+
+def _check_flags(flags: int) -> None:
+    """Reject undeclared flag bits loudly (loud-failure contract)."""
+    unknown = flags & ~_KNOWN_FLAGS
+    if unknown:
+        raise WireError(
+            f"unknown shm flag bits 0x{unknown:02x} "
+            f"(known mask 0x{_KNOWN_FLAGS:02x}) — version-skewed peer?"
+        )
+
+
+def encode_frame(
+    kind: int,
+    uuid: bytes,
+    body: bytes = b"",
+    *,
+    error: Optional[str] = None,
+    trace_id: Optional[bytes] = None,
+) -> bytes:
+    """One doorbell frame.  Descriptor-only — payload bytes NEVER ride
+    the doorbell; they live in the arena."""
+    if len(uuid) != 16:
+        raise WireError(f"uuid must be 16 bytes, got {len(uuid)}")
+    flags = 0
+    parts: List[bytes] = []
+    if error is not None:
+        flags |= _FLAG_ERROR
+    if trace_id is not None:
+        if len(trace_id) != 16:
+            raise WireError(
+                f"trace_id must be 16 bytes, got {len(trace_id)}"
+            )
+        flags |= _FLAG_TRACE
+    parts.append(_HEADER.pack(MAGIC, 1, kind, flags, 0, uuid))
+    if error is not None:
+        err = error.encode("utf-8")
+        parts.append(struct.pack("<I", len(err)))
+        parts.append(err)
+    if trace_id is not None:
+        parts.append(trace_id)
+    parts.append(body)
+    out = b"".join(parts)
+    if _fi.active_plan is not None:  # chaos seam (faultinject.runtime)
+        out = _fi.filter_bytes("shm.encode", out)
+    return out
+
+
+def decode_frame(
+    buf: bytes,
+) -> Tuple[int, bytes, Optional[str], Optional[bytes], int, bytes]:
+    """Decode a doorbell frame header ->
+    ``(kind, uuid, error, trace_id, body_offset, frame)``; kind-
+    specific body parsing is the caller's, offset-based against the
+    RETURNED ``frame`` (which is ``buf`` unless the chaos seam
+    transformed it — parsing the original after a filtered header
+    would silently mix two byte streams)."""
+    if _fi.active_plan is not None:  # chaos seam (faultinject.runtime)
+        buf = _fi.filter_bytes("shm.decode", buf)
+    try:
+        magic, version, kind, flags, _pad, uuid = _HEADER.unpack_from(buf, 0)
+    except struct.error as e:
+        raise WireError(f"truncated shm header: {e}") from None
+    if magic != MAGIC:
+        raise WireError(f"bad shm magic {magic!r}")
+    if version != 1:
+        raise WireError(f"unsupported shm version {version}")
+    if kind not in _KNOWN_KINDS:
+        raise WireError(f"unknown shm frame kind {kind}")
+    _check_flags(flags)
+    off = _HEADER.size
+    error = None
+    if flags & _FLAG_ERROR:
+        try:
+            (elen,) = struct.unpack_from("<I", buf, off)
+            off += 4
+            if off + elen > len(buf):
+                raise WireError("truncated shm error block")
+            error = buf[off : off + elen].decode("utf-8")
+            off += elen
+        except (struct.error, UnicodeDecodeError) as e:
+            raise WireError(f"truncated shm error block: {e}") from None
+    trace_id = None
+    if flags & _FLAG_TRACE:
+        if off + 16 > len(buf):
+            raise WireError("truncated shm trace block")
+        trace_id = buf[off : off + 16]
+        off += 16
+    return kind, uuid, error, trace_id, off, buf
+
+
+#: One decoded descriptor: (slot, delta, length, generation, dtype, shape).
+Desc = Tuple[int, int, int, int, np.dtype, Tuple[int, ...]]
+
+
+# graftlint: disable=fault-shim-coverage -- sub-frame helper: chaos reaches these bytes one frame up (encode_frame's shm.encode filter + the shm.descriptor seam)
+def encode_descs(descs: Sequence[Desc]) -> bytes:
+    """Descriptor block: ``n(u32)`` + one fixed struct + dtype/shape
+    per array."""
+    parts: List[bytes] = [struct.pack("<I", len(descs))]
+    for slot, delta, length, gen, dtype, shape in descs:
+        parts.append(_DESC_STRUCT.pack(slot, delta, length, gen))
+        dt = _encode_dtype(dtype)
+        parts.append(struct.pack("<H", len(dt)))
+        parts.append(dt)
+        parts.append(struct.pack("<B", len(shape)))
+        parts.append(struct.pack(f"<{len(shape)}Q", *shape))
+    return b"".join(parts)
+
+
+# graftlint: disable=fault-shim-coverage -- sub-frame helper: chaos reaches these bytes one frame up (decode_frame's shm.decode filter + the shm.descriptor seam)
+def decode_descs(buf: bytes, off: int) -> Tuple[List[Desc], int]:
+    """Parse a descriptor block at ``off`` -> (descs, new_offset)."""
+    try:
+        (n,) = struct.unpack_from("<I", buf, off)
+        off += 4
+        descs: List[Desc] = []
+        for _ in range(n):
+            slot, delta, length, gen = _DESC_STRUCT.unpack_from(buf, off)
+            off += _DESC_STRUCT.size
+            (dtlen,) = struct.unpack_from("<H", buf, off)
+            off += 2
+            dtype = _parse_dtype(buf[off : off + dtlen])
+            off += dtlen
+            (ndim,) = struct.unpack_from("<B", buf, off)
+            off += 1
+            shape = struct.unpack_from(f"<{ndim}Q", buf, off)
+            off += 8 * ndim
+            descs.append((slot, delta, length, gen, dtype, shape))
+    except struct.error as e:
+        raise WireError(f"truncated shm descriptor block: {e}") from None
+    return descs, off
+
+
+def _desc_region_offset(kind: int, trace_id: Optional[bytes]) -> int:
+    """Byte offset where an OUTGOING EVAL/EVAL_BATCH frame's
+    descriptor region starts (ack watermark preserved — corrupting it
+    would fault the RECLAMATION protocol, a different seam) — where
+    the ``corrupt_descriptor`` chaos shim starts flipping."""
+    off = _HEADER.size + (16 if trace_id is not None else 0)
+    if kind == _KIND_EVAL:
+        return off + 8  # past ack_gen
+    if kind == _KIND_EVAL_BATCH:
+        return off + 12  # past ack_gen + item count
+    return off
+
+
+def _read_arena_array(
+    arena: Arena, desc: Desc, *, copy: bool
+) -> np.ndarray:
+    """One descriptor -> numpy array.  ``copy=False`` is a READ-ONLY
+    view straight onto the shared pages (validated head+tail before
+    return); ``copy=True`` re-validates after the copy so a recycle
+    landing mid-copy is detected before the bytes are believed."""
+    slot, delta, length, gen, dtype, shape = desc
+    view = arena.read_view(slot, delta, length, gen)
+    if dtype.itemsize == 0 or length % dtype.itemsize:
+        raise WireError(
+            f"descriptor length {length} is not a multiple of "
+            f"itemsize {dtype.itemsize}"
+        )
+    try:
+        arr = np.frombuffer(
+            view, dtype=dtype, count=length // dtype.itemsize
+        ).reshape(shape)
+    except ValueError as e:
+        raise WireError(f"corrupt descriptor shape: {e}") from None
+    if copy:
+        arr = arr.copy()
+        arena.read_view(slot, delta, length, gen)  # no recycle mid-copy
+        _SHM_DECODE_COPIED.inc(length)
+    else:
+        # The mmap is writable; the VIEW must not be — a consumer
+        # scribbling on shared pages would corrupt the peer's slot.
+        arr.flags.writeable = False
+    return arr
+
+
+def _write_arrays(
+    arena: Arena, arrays: Sequence[np.ndarray], *, pinned: bool = False
+) -> Tuple[Optional[int], List[Desc]]:
+    """Pack arrays into ONE fresh slot -> (slot, descriptors).  The
+    single arena write is each payload byte's only copy."""
+    if not arrays:
+        return None, []
+    arrays = normalize_arrays(arrays)
+    slot, gen, deltas = arena.write_many(
+        [payload_view(a) for a in arrays], pinned=pinned
+    )
+    descs: List[Desc] = [
+        (slot, delta, a.nbytes, gen, a.dtype, tuple(a.shape))
+        for a, delta in zip(arrays, deltas)
+    ]
+    return slot, descs
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+
+
+class ShmArraysClient:
+    """Arrays-in → arrays-out over a shared-memory arena pair plus one
+    persistent doorbell connection.  Sync surface parity with
+    :class:`~.tcp.TcpArraysClient` (``evaluate``, pipelined/batched
+    ``evaluate_many``, ``evaluate_many_partial``, plus ``get_load`` and
+    ``ping``), so the replica pool drives both interchangeably.
+
+    ``pin_arrays`` (default True): an ndarray passed by the SAME object
+    identity a second time is promoted to the arena's pinned region —
+    written once, referenced by descriptor forever after (per-node data
+    constants stop moving bytes entirely).  The contract is the jax
+    one: arrays you pass repeatedly are treated as immutable; disable
+    with ``pin_arrays=False`` if you mutate request arrays in place.
+
+    ``copy`` (default True): reply arrays are copied out of the arena
+    (writable, owned).  ``copy=False`` returns read-only views onto the
+    shared pages for SINGLE ``evaluate`` calls — zero-copy, valid until
+    the node recycles the reply slot, which the ack watermark defers
+    until your NEXT call on this client.  ``evaluate_many`` always
+    copies its replies: within a window, acks ride later frames of the
+    same call, so a view of an early reply could be recycled before
+    the call even returns."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        *,
+        retries: int = 2,
+        copy: bool = True,
+        pin_arrays: bool = True,
+        max_inflight_bytes: Optional[int] = None,
+        connect_timeout_s: float = 30.0,
+        connect_retries: int = 1,
+        connect_backoff_s: float = 0.05,
+    ) -> None:
+        self.host = host
+        self.port = int(port)
+        self.retries = int(retries)
+        self.copy = bool(copy)
+        self.pin_arrays = bool(pin_arrays)
+        self.max_inflight_bytes = max_inflight_bytes
+        self.connect_timeout_s = float(connect_timeout_s)
+        self.connect_retries = int(connect_retries)
+        self.connect_backoff_s = float(connect_backoff_s)
+        self._sock: Optional[socket.socket] = None
+        self._rfile: Optional[Any] = None
+        self._req_arena: Optional[Arena] = None
+        self._rep_arena: Optional[Arena] = None
+        self._consumed_gen = 0  # ack watermark piggybacked on sends
+        # id(array) -> (hit count, weakref) — the promotion trigger.
+        # The weakref VERIFIES identity across calls: CPython recycles
+        # ids of freed per-call arrays constantly (the fresh-params-
+        # every-step pattern), and a bare id counter would promote
+        # unrelated arrays into the never-reclaimed pinned region.
+        # id(array) -> (descs-per-array entry, strong ref).
+        self._pin_hits: Dict[int, Tuple[int, "weakref.ref[np.ndarray]"]] = {}
+        self._pinned: Dict[int, Tuple[Desc, np.ndarray]] = {}
+        # All-pinned request signature -> (array refs, encoded
+        # descriptor block): the steady-state fast path — one dict hit
+        # and identity checks instead of re-encoding the same
+        # descriptors every item (refs keep ids stable).
+        self._block_cache: Dict[
+            Tuple[int, ...], Tuple[Tuple[np.ndarray, ...], bytes]
+        ] = {}
+
+    @property
+    def _peer(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    # -- connection / attach ----------------------------------------------
+
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            last_err: Optional[Exception] = None
+            for attempt in range(self.connect_retries + 1):
+                if attempt:
+                    time.sleep(self.connect_backoff_s)
+                try:
+                    s = socket.create_connection(
+                        (self.host, self.port),
+                        timeout=self.connect_timeout_s,
+                    )
+                    break
+                except (ConnectionError, OSError) as e:
+                    last_err = e
+            else:
+                raise ConnectionError(
+                    f"connect to {self._peer} failed after "
+                    f"{self.connect_retries + 1} attempts "
+                    f"(timeout {self.connect_timeout_s}s)"
+                ) from last_err
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._sock = s
+            self._rfile = s.makefile("rb")
+            try:
+                self._attach()
+            except BaseException:
+                self.close()
+                raise
+        return self._sock
+
+    def _attach(self) -> None:
+        assert self._sock is not None
+        uid = fast_uuid()
+        self._send(encode_frame(_KIND_ATTACH, uid))
+        kind, ruid, error, _tid, off, frame = decode_frame(
+            self._read_frame()
+        )
+        if error is not None:
+            raise WireError(f"shm attach refused: {error}")
+        if kind != _KIND_ATTACH_OK or ruid != uid:
+            raise WireError("shm attach: unexpected reply")
+        try:
+            (jlen,) = struct.unpack_from("<I", frame, off)
+            spec = json.loads(
+                frame[off + 4 : off + 4 + jlen].decode("utf-8")
+            )
+            req_path, rep_path = spec["req"], spec["rep"]
+        except (struct.error, ValueError, KeyError, UnicodeDecodeError) as e:
+            raise WireError(f"corrupt shm attach reply: {e}") from None
+        self._req_arena = Arena.attach(req_path, writer=True)
+        self._rep_arena = Arena.attach(rep_path)
+        self._consumed_gen = 0
+        _flightrec.record(
+            "shm.attach", peer=self._peer, req=req_path, rep=rep_path,
+            size=self._req_arena.capacity,
+        )
+
+    def _send(self, frame: bytes) -> None:
+        assert self._sock is not None
+        if _fi.active_plan is not None:  # chaos seam
+            _fi.send_frame_through(
+                "shm.send", self._sock.sendall, frame, peer=self._peer
+            )
+        else:
+            _send_frame(self._sock, frame)
+
+    def _read_frame(self) -> bytes:
+        assert self._rfile is not None
+        hdr = self._rfile.read(4)
+        if hdr is None or len(hdr) < 4:
+            raise ConnectionError("peer closed mid-frame")
+        (n,) = struct.unpack("<I", hdr)
+        buf = self._rfile.read(n)
+        if buf is None or len(buf) < n:
+            raise ConnectionError("peer closed mid-frame")
+        if _fi.active_plan is not None:  # chaos seam
+            buf = _fi.filter_bytes("shm.recv", buf, self._peer)
+        return buf
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                if self._rfile is not None:
+                    try:
+                        self._rfile.close()
+                    except OSError:
+                        pass
+                self._sock.close()
+            finally:
+                self._sock = None
+                self._rfile = None
+        for arena in (self._req_arena, self._rep_arena):
+            if arena is not None:
+                arena.close()
+        self._req_arena = None
+        self._rep_arena = None
+        self._pin_hits.clear()
+        self._pinned.clear()
+        self._block_cache.clear()
+        self._consumed_gen = 0
+
+    def __del__(self) -> None:  # best-effort, mirrors tcp teardown
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    # -- request encoding --------------------------------------------------
+
+    def _maybe_pinned_desc(self, a: np.ndarray) -> Optional[Desc]:
+        """The pin cache: returns the array's pinned descriptor when
+        the SAME object was written before (zero bytes moved), promotes
+        an array on its second sighting, and returns ``None`` for the
+        transient path.  A full pinned region degrades gracefully —
+        correctness never depends on pinning."""
+        if not self.pin_arrays:
+            return None
+        key = id(a)
+        cached = self._pinned.get(key)
+        if cached is not None:
+            desc, ref = cached
+            # An ndarray's data pointer is fixed for the object's
+            # lifetime, so identity + size is the whole hit check.
+            if ref is a and a.nbytes == desc[2]:
+                return desc
+            del self._pinned[key]  # invalidated: falls through
+        entry = self._pin_hits.get(key)
+        # Same id AND same (still-alive) object = a real repeat; a
+        # dead/mismatched weakref is id reuse and resets the count.
+        hits = 1 if entry is None or entry[1]() is not a else entry[0] + 1
+        if hits < 2:
+            if len(self._pin_hits) >= 4096:
+                # Fresh-array-per-call workloads churn ids without
+                # ever repeating: bound the tracker (cheap reset — a
+                # genuine constant re-earns its two sightings).
+                self._pin_hits.clear()
+            self._pin_hits[key] = (hits, weakref.ref(a))
+            return None
+        self._pin_hits.pop(key, None)
+        assert self._req_arena is not None
+        try:
+            _slot, (desc,) = _write_arrays(
+                self._req_arena, [a], pinned=True
+            )
+        except WireError:
+            return None
+        self._pinned[key] = (desc, a)
+        return desc
+
+    def _encode_request(
+        self, arrays: Sequence[np.ndarray]
+    ) -> Tuple[List[Desc], Optional[int], int]:
+        """Write one request's arrays -> (descriptors in order,
+        transient slot to free on reply or None, TRANSIENT payload
+        bytes — the in-flight byte-cap contribution; pinned arrays
+        consume no ring space and count nothing).  Pinned arrays (same
+        object seen before) reuse their existing descriptors — zero
+        bytes moved; the rest pack into one fresh transient slot."""
+        assert self._req_arena is not None
+        descs: List[Optional[Desc]] = [None] * len(arrays)
+        transient: List[Tuple[int, np.ndarray]] = []
+        for i, raw in enumerate(arrays):
+            a = np.asarray(raw)
+            pinned = self._maybe_pinned_desc(a)
+            if pinned is not None:
+                descs[i] = pinned
+            else:
+                transient.append((i, a))
+        slot: Optional[int] = None
+        nbytes = 0
+        if transient:
+            slot, tdescs = _write_arrays(
+                self._req_arena, [a for _i, a in transient]
+            )
+            tdescs = self._request_write_chaos(slot, tdescs)
+            for (i, _a), desc in zip(transient, tdescs):
+                descs[i] = desc
+            nbytes = sum(d[2] for d in tdescs)
+        return [d for d in descs if d is not None], slot, nbytes
+
+    def _request_write_chaos(
+        self, slot: Optional[int], descs: List[Desc]
+    ) -> List[Desc]:
+        """The ``shm.arena.write`` chaos seam — the CLIENT-side twin of
+        the node's ``shm.arena.reply``: ``truncate_slot`` scribbles the
+        request slot's tail (the node's read fails loudly, answered
+        in-band), ``stale_generation`` ages the descriptors."""
+        if _fi.active_plan is None:
+            return descs
+        fault = _fi.arena_fault("shm.arena.write", self._peer)
+        if fault == "truncate_slot" and slot is not None:
+            assert self._req_arena is not None
+            self._req_arena.scribble_tail(slot)
+        elif fault == "stale_generation":
+            descs = [
+                (s, d, ln, g + 1, dt, sh)
+                for s, d, ln, g, dt, sh in descs
+            ]
+        return descs
+
+    def _eval_body(self, descs: Sequence[Desc]) -> bytes:
+        return struct.pack("<Q", self._consumed_gen) + encode_descs(descs)
+
+    def _apply_descriptor_chaos(
+        self, frame: bytes, kind: int, trace_id: Optional[bytes]
+    ) -> bytes:
+        """The ``corrupt_descriptor`` chaos seam: flip bytes inside the
+        descriptor block only (header corruption is ``corrupt_bytes``
+        at the byte-lane points)."""
+        if _fi.active_plan is None:
+            return frame
+        return _fi.corrupt_descriptor_bytes(
+            "shm.descriptor", frame,
+            _desc_region_offset(kind, trace_id),
+            peer=self._peer,
+        )
+
+    # -- reply decoding ----------------------------------------------------
+
+    def _decode_reply_arrays(
+        self, descs: Sequence[Desc], *, force_copy: bool = False
+    ) -> List[np.ndarray]:
+        """``force_copy`` overrides ``copy=False`` inside pipelined
+        windows: acks piggybacked on LATER frames of the same window
+        let the node recycle reply slots that earlier results still
+        view — zero-copy replies are only safe on the lock-step
+        single-evaluate path, whose ack defers to the next call."""
+        assert self._rep_arena is not None
+        copy = self.copy or force_copy
+        out = [
+            _read_arena_array(self._rep_arena, d, copy=copy)
+            for d in descs
+        ]
+        if descs:
+            self._consumed_gen = max(
+                self._consumed_gen, max(d[3] for d in descs)
+            )
+        return out
+
+    def _free_transient(self, slot: Optional[int]) -> None:
+        if slot is not None and self._req_arena is not None:
+            self._req_arena.free(slot)
+
+    # -- single evaluation -------------------------------------------------
+
+    def evaluate(self, *arrays: np.ndarray) -> List[np.ndarray]:
+        with _spans.span("rpc.evaluate", transport="shm"):
+            last_err: Optional[Exception] = None
+            for attempt in range(self.retries + 1):
+                if attempt:
+                    _RETRIES.labels(transport="shm").inc()
+                    _flightrec.record(
+                        "rpc.retry", transport="shm", attempt=attempt
+                    )
+                t0 = time.perf_counter()
+                try:
+                    with _spans.span("call"):
+                        self._connect()
+                        with _spans.span("encode"):
+                            uid = fast_uuid()
+                            trace_id = (
+                                _spans.current_trace_id()
+                                if _spans.enabled()
+                                else None
+                            )
+                            descs, slot, _nb = self._encode_request(
+                                arrays
+                            )
+                            frame = encode_frame(
+                                _KIND_EVAL,
+                                uid,
+                                self._eval_body(descs),
+                                trace_id=trace_id,
+                            )
+                            frame = self._apply_descriptor_chaos(
+                                frame, _KIND_EVAL, trace_id
+                            )
+                        self._send(frame)
+                        reply = self._read_frame()
+                    break
+                except (ConnectionError, OSError) as e:
+                    last_err = e
+                    _DROPS.labels(transport="shm").inc()
+                    _flightrec.record(
+                        "rpc.drop", transport="shm", peer=self._peer
+                    )
+                    self.close()
+            else:
+                raise ConnectionError(
+                    f"shm node {self._peer} unreachable after "
+                    f"{self.retries + 1} attempts"
+                ) from last_err
+            with _spans.span("decode"):
+                try:
+                    outputs = self._consume_reply(reply, uid)
+                except RemoteComputeError:
+                    # In-band server error: the connection is still
+                    # correlated — free the request slot (the node is
+                    # done with it) and surface the error, no close.
+                    self._free_transient(slot)
+                    raise
+                except (WireError, RuntimeError):
+                    # Corrupt or desynchronized reply: close so the
+                    # NEXT call re-attaches cleanly; the error
+                    # surfaces loudly (CLAUDE.md invariant).
+                    _DROPS.labels(transport="shm").inc()
+                    self.close()
+                    raise
+            self._free_transient(slot)
+            _CALL_S.labels(transport="shm", mode="lockstep").observe(
+                time.perf_counter() - t0
+            )
+            return outputs
+
+    __call__ = evaluate
+
+    def _consume_reply(
+        self, reply: bytes, uid: bytes, *, force_copy: bool = False
+    ) -> List[np.ndarray]:
+        kind, ruid, error, _tid, off, reply = decode_frame(reply)
+        if kind == _KIND_ERROR:
+            raise WireError(f"shm protocol error from node: {error}")
+        if kind != _KIND_REPLY:
+            raise WireError(
+                f"unexpected shm frame kind {kind} (wanted REPLY)"
+            )
+        if error is not None:
+            _flightrec.record(
+                "rpc.error", transport="shm", error=error[:200]
+            )
+            raise RemoteComputeError(error)
+        if ruid != uid:
+            raise RuntimeError(
+                "uuid mismatch: reply does not match request"
+            )
+        descs, _off = decode_descs(reply, off)
+        return self._decode_reply_arrays(descs, force_copy=force_copy)
+
+    # -- pipelined / batched windows ---------------------------------------
+
+    def _inflight_cap(self) -> int:
+        if self.max_inflight_bytes is not None:
+            return int(self.max_inflight_bytes)
+        assert self._req_arena is not None
+        # The doorbell cannot deadlock on payload bytes (they do not
+        # ride it); the cap guards the ARENA — keep in-flight request
+        # bytes under half the free transient region so the ring never
+        # exhausts mid-window.
+        return max(self._req_arena.transient_bytes_free() // 2, 1)
+
+    def evaluate_many(
+        self,
+        requests: Sequence[Sequence[np.ndarray]],
+        *,
+        window: int = 8,
+        batch: object = "auto",
+    ) -> List[List[np.ndarray]]:
+        """Pipelined evaluation over the lock-step doorbell; same
+        semantics as the TCP lane (FIFO correlation, all-or-nothing
+        transport retry, deterministic errors raise after a drain).
+        ``batch`` "auto"/True packs ``min(window, 32)`` requests per
+        EVAL_BATCH frame — the shm lane always supports batch frames,
+        so "auto" and True are equivalent; False sends per-request
+        EVAL frames."""
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        if batch != "auto" and batch is not True and batch is not False:
+            raise ValueError(
+                f"batch must be 'auto', True or False, got {batch!r}"
+            )
+        requests = list(requests)
+        if not requests:
+            return []
+        with _spans.span(
+            "rpc.evaluate_many",
+            transport="shm",
+            n=len(requests),
+            window=window,
+        ):
+            t0 = time.perf_counter()
+            last_err: Optional[Exception] = None
+            for attempt in range(self.retries + 1):
+                if attempt:
+                    _RETRIES.labels(transport="shm").inc()
+                    _flightrec.record(
+                        "rpc.retry", transport="shm", attempt=attempt,
+                        batch=len(requests),
+                    )
+                try:
+                    with _watchdog.armed(
+                        "shm.batch_window", n=len(requests), window=window
+                    ):
+                        if batch is False:
+                            results = self._evaluate_many_once(
+                                requests, window
+                            )
+                        else:
+                            results = self._evaluate_many_batched_once(
+                                requests, window
+                            )
+                except (ConnectionError, OSError) as e:
+                    last_err = e
+                    _DROPS.labels(transport="shm").inc()
+                    _flightrec.record(
+                        "rpc.drop", transport="shm", peer=self._peer
+                    )
+                    self.close()
+                    continue
+                except WireError:
+                    # Client-side arena failure (e.g. exhaustion) with
+                    # frames possibly in flight: the doorbell is
+                    # desynchronized — close so the NEXT call starts
+                    # clean, and surface the error (deterministic, no
+                    # retry).  Reply-decode WireErrors already closed;
+                    # close() is idempotent.
+                    _DROPS.labels(transport="shm").inc()
+                    self.close()
+                    raise
+                _BATCH_S.labels(transport="shm").observe(
+                    time.perf_counter() - t0
+                )
+                return results
+            raise ConnectionError(
+                f"shm node {self._peer} unreachable after "
+                f"{self.retries + 1} attempts"
+            ) from last_err
+
+    def evaluate_many_partial(
+        self,
+        requests: Sequence[Sequence[np.ndarray]],
+        *,
+        window: int = 8,
+        batch: object = "auto",
+    ) -> Tuple[List[Optional[List[np.ndarray]]], Optional[BaseException]]:
+        """ONE pipelined pass, no reconnect-retry, partial progress:
+        ``(results_with_None_holes, transport_exc_or_None)`` — the
+        replica pool's mid-window failover primitive (tcp.py parity)."""
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        if batch != "auto" and batch is not True and batch is not False:
+            raise ValueError(
+                f"batch must be 'auto', True or False, got {batch!r}"
+            )
+        requests = list(requests)
+        if not requests:
+            return [], None
+        out: List[Optional[List[np.ndarray]]] = [None] * len(requests)
+        with _spans.span(
+            "rpc.evaluate_many",
+            transport="shm",
+            n=len(requests),
+            window=window,
+            partial=True,
+        ):
+            t0 = time.perf_counter()
+            try:
+                with _watchdog.armed(
+                    "shm.batch_window", n=len(requests), window=window
+                ):
+                    if batch is False:
+                        self._evaluate_many_once(requests, window, out=out)
+                    else:
+                        self._evaluate_many_batched_once(
+                            requests, window, out=out
+                        )
+            except (ConnectionError, OSError) as e:
+                _DROPS.labels(transport="shm").inc()
+                _flightrec.record(
+                    "rpc.drop", transport="shm", peer=self._peer
+                )
+                self.close()
+                return out, e
+            except WireError:
+                _DROPS.labels(transport="shm").inc()
+                self.close()  # desynchronized mid-window: start clean
+                raise
+            _BATCH_S.labels(transport="shm").observe(
+                time.perf_counter() - t0
+            )
+            return out, None
+
+    def _evaluate_many_once(
+        self,
+        requests: Sequence[Sequence[np.ndarray]],
+        window: int,
+        out: Optional[List[Optional[List[np.ndarray]]]] = None,
+    ) -> List[Optional[List[np.ndarray]]]:
+        self._connect()
+        trace_id = _spans.current_trace_id() if _spans.enabled() else None
+        n = len(requests)
+        results: List[Optional[List[np.ndarray]]] = (
+            out if out is not None else [None] * n
+        )
+        max_inflight = self._inflight_cap()
+        pending: List[Tuple[bytes, Optional[int], int]] = []  # uid, slot, bytes
+        write_idx = read_idx = 0
+        inflight_bytes = 0
+
+        def write_one(i: int) -> int:
+            uid = fast_uuid()
+            # Transient bytes only: pinned descriptors consume no ring
+            # space, and counting them would throttle a pinned
+            # workload to lock-step depth (the byte cap guards the
+            # ARENA, which only transient slots occupy).
+            descs, slot, nbytes = self._encode_request(requests[i])
+            frame = encode_frame(
+                _KIND_EVAL, uid, self._eval_body(descs), trace_id=trace_id
+            )
+            frame = self._apply_descriptor_chaos(
+                frame, _KIND_EVAL, trace_id
+            )
+            self._send(frame)
+            pending.append((uid, slot, nbytes))
+            return nbytes
+
+        while read_idx < n:
+            while write_idx < n and (
+                write_idx == read_idx
+                or (
+                    write_idx - read_idx < window
+                    and inflight_bytes < max_inflight
+                )
+            ):
+                inflight_bytes += write_one(write_idx)
+                write_idx += 1
+            _WINDOW_DEPTH.labels(transport="shm").observe(
+                write_idx - read_idx
+            )
+            reply = self._read_frame()
+            uid, slot, nbytes = pending[read_idx]
+            inflight_bytes -= nbytes
+            try:
+                outputs = self._consume_reply(reply, uid, force_copy=True)
+            except RemoteComputeError:
+                # Drain in-flight replies so the connection stays
+                # correlated for the NEXT call, then surface the
+                # deterministic error (no retry) — tcp.py semantics.
+                try:
+                    for _ in range(write_idx - read_idx - 1):
+                        self._read_frame()
+                except (ConnectionError, OSError):
+                    _DROPS.labels(transport="shm").inc()
+                    self.close()
+                else:
+                    self._drain_free(pending, read_idx, write_idx)
+                raise
+            except (WireError, RuntimeError):
+                _DROPS.labels(transport="shm").inc()
+                self.close()
+                raise
+            self._free_transient(slot)
+            results[read_idx] = outputs
+            read_idx += 1
+        self._send_ack()
+        return results
+
+    def _send_ack(self) -> None:
+        """Fire-and-forget ACK of the consumed-generation watermark —
+        sent at the end of a pipelined window so the node reclaims the
+        window's final reply slots NOW instead of at this client's
+        next call (window replies are always copied, so no view
+        outlives the release).  Best-effort: a dead socket surfaces on
+        the next call's own path."""
+        if self._sock is None:
+            return
+        try:
+            self._send(
+                encode_frame(
+                    _KIND_ACK,
+                    fast_uuid(),
+                    struct.pack("<Q", self._consumed_gen),
+                )
+            )
+        except (ConnectionError, OSError):
+            self.close()
+
+    def _drain_free(
+        self,
+        pending: Sequence[Tuple[bytes, Optional[int], int]],
+        read_idx: int,
+        write_idx: int,
+    ) -> None:
+        """After a drain, free the transient slots of the drained
+        requests (FIFO order: the erroring one first, then the rest)."""
+        for k in range(read_idx, write_idx):
+            self._free_transient(pending[k][1])
+
+    def _evaluate_many_batched_once(
+        self,
+        requests: Sequence[Sequence[np.ndarray]],
+        window: int,
+        out: Optional[List[Optional[List[np.ndarray]]]] = None,
+    ) -> List[Optional[List[np.ndarray]]]:
+        self._connect()
+        trace_id = _spans.current_trace_id() if _spans.enabled() else None
+        n = len(requests)
+        chunk = max(1, min(window, _BATCH_CHUNK))
+        results: List[Optional[List[np.ndarray]]] = (
+            out if out is not None else [None] * n
+        )
+        # (outer_uuid, start, item_uids, transient_slot)
+        frames: List[Tuple[bytes, int, List[bytes], Optional[int]]] = []
+        max_inflight = self._inflight_cap()
+        starts = list(range(0, n, chunk))
+        write_idx = read_idx = 0
+        inflight: List[int] = []  # request bytes per in-flight frame
+        # Frames in flight are capped too: replies consume the NODE's
+        # arena until the next frame's ack reclaims them, and an
+        # all-pinned workload carries zero in-flight request bytes —
+        # the byte cap alone would let the whole request list launch
+        # and the unacked replies exhaust the reply arena.
+        max_frames = max(2, window // chunk)
+        while read_idx < len(starts):
+            while write_idx < len(starts) and (
+                write_idx == read_idx
+                or (
+                    write_idx - read_idx < max_frames
+                    and sum(inflight) < max_inflight
+                )
+            ):
+                start = starts[write_idx]
+                part = requests[start : start + chunk]
+                outer_uuid = fast_uuid()
+                item_uids: List[bytes] = []
+                item_blocks: List[Optional[bytes]] = []
+                hole_descs: Dict[int, List[Optional[Desc]]] = {}
+                # All transient arrays of the whole frame pack into ONE
+                # slot: per-frame FIFO reclamation, one arena write;
+                # pinned (repeat-identity) arrays move zero bytes, and
+                # an all-pinned request reuses its whole encoded
+                # descriptor block from the signature cache.
+                flat: List[np.ndarray] = []
+                holes: List[Tuple[int, int, int]] = []  # item, pos, flat
+                for req in part:
+                    item_uids.append(fast_uuid())
+                    arrays = [np.asarray(raw) for raw in req]
+                    key: Optional[Tuple[int, ...]] = None
+                    if self.pin_arrays:
+                        key = tuple(map(id, arrays))
+                        hit = self._block_cache.get(key)
+                        if hit is not None and all(
+                            r is a for r, a in zip(hit[0], arrays)
+                        ):
+                            item_blocks.append(hit[1])
+                            continue
+                    descs: List[Optional[Desc]] = []
+                    has_hole = False
+                    for a in arrays:
+                        pinned = self._maybe_pinned_desc(a)
+                        if pinned is None:
+                            holes.append(
+                                (len(item_blocks), len(descs), len(flat))
+                            )
+                            flat.append(a)
+                            descs.append(None)
+                            has_hole = True
+                        else:
+                            descs.append(pinned)
+                    if has_hole:
+                        hole_descs[len(item_blocks)] = descs
+                        item_blocks.append(None)
+                    else:
+                        block = encode_descs(descs)
+                        if key is not None:
+                            if len(self._block_cache) >= 512:
+                                # id-tuple churn from fresh-array
+                                # workloads: bound the cache.
+                                self._block_cache.clear()
+                            self._block_cache[key] = (
+                                tuple(arrays), block
+                            )
+                        item_blocks.append(block)
+                slot: Optional[int] = None
+                nbytes = 0
+                if flat:
+                    assert self._req_arena is not None
+                    slot, tdescs = _write_arrays(self._req_arena, flat)
+                    tdescs = self._request_write_chaos(slot, tdescs)
+                    nbytes = sum(d[2] for d in tdescs)
+                    for item_i, pos, flat_i in holes:
+                        hole_descs[item_i][pos] = tdescs[flat_i]
+                for item_i, descs in hole_descs.items():
+                    item_blocks[item_i] = encode_descs(
+                        [d for d in descs if d is not None]
+                    )
+                body = (
+                    struct.pack("<QI", self._consumed_gen, len(part))
+                    + b"".join(
+                        uid + block
+                        for uid, block in zip(item_uids, item_blocks)
+                    )
+                )
+                frame = encode_frame(
+                    _KIND_EVAL_BATCH, outer_uuid, body, trace_id=trace_id
+                )
+                frame = self._apply_descriptor_chaos(
+                    frame, _KIND_EVAL_BATCH, trace_id
+                )
+                _FRAME_REQS.labels(transport="shm").observe(len(part))
+                self._send(frame)
+                frames.append((outer_uuid, start, item_uids, slot))
+                inflight.append(nbytes)
+                write_idx += 1
+            _WINDOW_DEPTH.labels(transport="shm").observe(
+                write_idx - read_idx
+            )
+            reply = self._read_frame()
+            outer_uuid, start, item_uids, slot = frames[read_idx]
+            inflight.pop(0)
+            first_error: Optional[str] = None
+            try:
+                kind, ruid, outer_err, _tid, off, reply = decode_frame(
+                    reply
+                )
+                if kind == _KIND_ERROR:
+                    raise WireError(
+                        f"shm protocol error from node: {outer_err}"
+                    )
+                if kind != _KIND_REPLY_BATCH:
+                    raise WireError(
+                        f"unexpected shm frame kind {kind} "
+                        "(wanted REPLY_BATCH)"
+                    )
+                first_error = outer_err
+                if first_error is None and ruid != outer_uuid:
+                    raise RuntimeError(
+                        "batch reply does not correlate with its frame"
+                    )
+                if first_error is None:
+                    (k,) = struct.unpack_from("<I", reply, off)
+                    off += 4
+                    if k != len(item_uids):
+                        raise RuntimeError(
+                            "batch reply does not correlate with its "
+                            "frame"
+                        )
+                    for j in range(k):
+                        iuid = reply[off : off + 16]
+                        if len(iuid) != 16:
+                            raise WireError("truncated shm batch item")
+                        off += 16
+                        try:
+                            (elen,) = struct.unpack_from(
+                                "<I", reply, off
+                            )
+                        except struct.error as e:
+                            raise WireError(
+                                f"truncated shm batch item: {e}"
+                            ) from None
+                        off += 4
+                        if elen:
+                            if off + elen > len(reply):
+                                raise WireError(
+                                    "truncated shm batch item error"
+                                )
+                            err = reply[off : off + elen].decode(
+                                "utf-8", "replace"
+                            )
+                            off += elen
+                            if first_error is None:
+                                first_error = err
+                            continue
+                        descs, off = decode_descs(reply, off)
+                        if iuid != item_uids[j]:
+                            raise RuntimeError(
+                                "uuid mismatch: batch item does not "
+                                "match its request"
+                            )
+                        if first_error is None:
+                            results[start + j] = (
+                                self._decode_reply_arrays(
+                                    descs, force_copy=True
+                                )
+                            )
+            except struct.error as e:
+                # Truncated batch reply: classify as WireError (the
+                # loud-failure contract) — a raw struct.error would
+                # escape every handler and leave the doorbell
+                # desynchronized (round-9 review finding).
+                _DROPS.labels(transport="shm").inc()
+                self.close()
+                raise WireError(
+                    f"truncated shm batch reply: {e}"
+                ) from None
+            except (WireError, RuntimeError):
+                _DROPS.labels(transport="shm").inc()
+                self.close()
+                raise
+            if first_error is not None:
+                try:
+                    for _ in range(write_idx - read_idx - 1):
+                        self._read_frame()
+                except (ConnectionError, OSError):
+                    _DROPS.labels(transport="shm").inc()
+                    self.close()
+                else:
+                    for k2 in range(read_idx, write_idx):
+                        self._free_transient(frames[k2][3])
+                raise RemoteComputeError(first_error)
+            self._free_transient(slot)
+            read_idx += 1
+        self._send_ack()
+        return results
+
+    # -- control lanes ------------------------------------------------------
+
+    def get_load(self) -> Optional[dict]:
+        """The node's load dict over the doorbell (GETLOAD/LOAD) —
+        ``None`` on an undecodable reply (probe-lane verdict)."""
+        self._connect()
+        uid = fast_uuid()
+        self._send(encode_frame(_KIND_GETLOAD, uid))
+        reply = self._read_frame()
+        try:
+            kind, ruid, error, _tid, off, reply = decode_frame(reply)
+            if kind != _KIND_LOAD or ruid != uid or error is not None:
+                return None
+            (jlen,) = struct.unpack_from("<I", reply, off)
+            load = json.loads(
+                reply[off + 4 : off + 4 + jlen].decode("utf-8")
+            )
+            return load if isinstance(load, dict) else None
+        # A garbled LOAD reply is a FAILED PROBE — None is this lane's
+        # loud in-band verdict, same posture as the GetLoad probe.
+        except Exception:  # graftlint: disable=wire-loudness -- probe verdict lane
+            return None
+
+    def ping(self) -> float:
+        """Doorbell round-trip seconds with one EMPTY arena write —
+        the shm lane's idle-overhead probe (bench.py ``shm_overhead``
+        gate): arena slot write + descriptor frame + node-side slot
+        validation + reply, no compute."""
+        self._connect()
+        assert self._req_arena is not None
+        t0 = time.perf_counter()
+        slot, descs = _write_arrays(
+            self._req_arena, [np.empty(0, np.uint8)]
+        )
+        uid = fast_uuid()
+        self._send(
+            encode_frame(_KIND_PING, uid, encode_descs(descs))
+        )
+        try:
+            kind, ruid, error, _tid, _off, _frame = decode_frame(
+                self._read_frame()
+            )
+            if kind != _KIND_PONG or ruid != uid:
+                raise WireError("shm ping: unexpected reply")
+        except (WireError, RuntimeError):
+            # Undecodable/desynchronized reply: close so the NEXT call
+            # re-attaches cleanly — leaving the ping's transient slot
+            # live would poison the FIFO free order forever.
+            _DROPS.labels(transport="shm").inc()
+            self.close()
+            raise
+        self._free_transient(slot)
+        if error is not None:
+            raise WireError(f"shm ping failed on the node: {error}")
+        return time.perf_counter() - t0
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+
+def _load_dict(n_connections: int) -> dict:
+    return {
+        "n_clients": n_connections,
+        "transport": "shm",
+        "batch": {"max_batch": _BATCH_CHUNK, "queue_depth": 0},
+    }
+
+
+class _ShmConnection:
+    """Server half of one doorbell connection: the arena pair, the
+    reply-slot reclamation watermark, and the frame dispatch loop."""
+
+    def __init__(
+        self,
+        conn: socket.socket,
+        compute_fn: Callable[..., Sequence[np.ndarray]],
+        arena_bytes: int,
+        n_connections: Callable[[], int],
+    ) -> None:
+        self.conn = conn
+        self.compute_fn = compute_fn
+        self.arena_bytes = arena_bytes
+        self.n_connections = n_connections
+        self.req_arena: Optional[Arena] = None
+        self.rep_arena: Optional[Arena] = None
+        self._unlinked = False
+        self._live_replies: List[Tuple[int, int]] = []  # (gen, slot)
+
+    # -- arena plumbing ----------------------------------------------------
+
+    def _attach_reply(self, uid: bytes) -> bytes:
+        if self.req_arena is None:
+            self.req_arena = Arena.create(self.arena_bytes, writer=False)
+            self.rep_arena = Arena.create(self.arena_bytes, writer=True)
+        spec = json.dumps(
+            {
+                "req": self.req_arena.path,
+                "rep": self.rep_arena.path,
+                "size": self.req_arena.capacity,
+                "arena_id": uuid_mod.uuid4().hex,
+            }
+        ).encode("utf-8")
+        return encode_frame(
+            _KIND_ATTACH_OK, uid, struct.pack("<I", len(spec)) + spec
+        )
+
+    def _unlink_arenas(self) -> None:
+        """The peer has proven it mapped the files (it sent a
+        post-attach frame): unlink NOW so a SIGKILL'd process leaks
+        nothing in /dev/shm."""
+        if not self._unlinked and self.req_arena is not None:
+            import os as _os
+
+            for arena in (self.req_arena, self.rep_arena):
+                if arena is not None:
+                    try:
+                        _os.unlink(arena.path)
+                    except OSError:
+                        pass
+            self._unlinked = True
+
+    def _reclaim(self, ack_gen: int) -> None:
+        """Free reply slots the client acknowledged (FIFO: generations
+        are allocation-ordered)."""
+        assert self.rep_arena is not None
+        while self._live_replies and self._live_replies[0][0] <= ack_gen:
+            _gen, slot = self._live_replies.pop(0)
+            self.rep_arena.free(slot)
+
+    def _write_reply_arrays(
+        self, arrays: Sequence[np.ndarray]
+    ) -> List[Desc]:
+        assert self.rep_arena is not None
+        slot, descs = _write_arrays(self.rep_arena, arrays)
+        if _fi.active_plan is not None:  # chaos seam: arena write
+            fault = _fi.arena_fault("shm.arena.reply")
+            if fault == "truncate_slot" and slot is not None:
+                self.rep_arena.scribble_tail(slot)
+            elif fault == "stale_generation":
+                descs = [
+                    (s, d, ln, g + 1, dt, sh)
+                    for s, d, ln, g, dt, sh in descs
+                ]
+        if descs:
+            self._live_replies.append((descs[0][3], descs[0][0]))
+        elif slot is not None:
+            self._live_replies.append((0, slot))
+        return descs
+
+    def _request_arrays(self, descs: Sequence[Desc]) -> List[np.ndarray]:
+        assert self.req_arena is not None
+        # copy=False: the node computes straight on the shared pages —
+        # the zero-copy read that is this lane's whole point; the
+        # client must not recycle until the reply (FIFO protocol), and
+        # if it does anyway the generation check above fails loudly.
+        return [
+            _read_arena_array(self.req_arena, d, copy=False)
+            for d in descs
+        ]
+
+    # -- dispatch ----------------------------------------------------------
+
+    def serve(self) -> None:
+        conn = self.conn
+        try:
+            with conn:
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                while True:
+                    try:
+                        payload = _recv_frame(conn)
+                    except (ConnectionError, OSError):
+                        break
+                    if _fi.active_plan is not None:  # chaos seam
+                        try:
+                            payload = _fi.filter_bytes(
+                                "shm.server.recv", payload
+                            )
+                        except (ConnectionError, OSError):
+                            break
+                    try:
+                        reply = self._one_frame(payload)
+                    except _fi.FaultPlanError:
+                        raise  # plan-authoring bug: LOUD, not in-band
+                    except Exception as e:
+                        # An undecodable doorbell frame fails ITS reply
+                        # in-band; the connection keeps serving.
+                        _flightrec.record(
+                            "server.error", stage="decode",
+                            wire="shm", transport="shm",
+                            error=str(e)[:200],
+                        )
+                        reply = encode_frame(
+                            _KIND_ERROR, b"\0" * 16, error=str(e)
+                        )
+                    if reply is None:
+                        continue
+                    try:
+                        if _fi.active_plan is not None:  # chaos seam
+                            _fi.send_frame_through(
+                                "shm.server.send", conn.sendall, reply
+                            )
+                        else:
+                            _send_frame(conn, reply)
+                    except (ConnectionError, OSError):
+                        break
+        finally:
+            for arena in (self.req_arena, self.rep_arena):
+                if arena is not None:
+                    arena.close(unlink=not self._unlinked)
+
+    def _one_frame(self, payload: bytes) -> Optional[bytes]:
+        if payload[:4] != MAGIC:
+            # npwire fallback lane: the pool's zero-item batch probe,
+            # or a plain-frame peer — served with full parity.
+            return serve_npwire_payload(
+                self.compute_fn, payload, transport="shm"
+            )
+        kind, uid, _err, trace_id, off, payload = decode_frame(payload)
+        if kind == _KIND_ATTACH:
+            return self._attach_reply(uid)
+        if self.req_arena is None:
+            return encode_frame(
+                _KIND_ERROR, uid, error="shm frame before ATTACH"
+            )
+        self._unlink_arenas()
+        if kind == _KIND_EVAL:
+            return self._serve_eval(payload, uid, trace_id, off)
+        if kind == _KIND_EVAL_BATCH:
+            return self._serve_eval_batch(payload, uid, trace_id, off)
+        if kind == _KIND_ACK:
+            try:
+                (ack,) = struct.unpack_from("<Q", payload, off)
+            except struct.error as e:
+                raise WireError(f"truncated shm ack: {e}") from None
+            self._reclaim(ack)
+            return None
+        if kind == _KIND_GETLOAD:
+            if _fi.active_plan is not None:  # chaos seam: getload lane
+                garbage = _fi.getload_filter("shm.server.getload")
+                if garbage is not None:
+                    return encode_frame(
+                        _KIND_LOAD, uid,
+                        struct.pack("<I", len(garbage)) + garbage,
+                    )
+            spec = json.dumps(
+                _load_dict(self.n_connections())
+            ).encode("utf-8")
+            return encode_frame(
+                _KIND_LOAD, uid, struct.pack("<I", len(spec)) + spec
+            )
+        if kind == _KIND_PING:
+            try:
+                descs, _off = decode_descs(payload, off)
+                for d in descs:
+                    _read_arena_array(self.req_arena, d, copy=False)
+            except WireError as e:
+                return encode_frame(_KIND_PONG, uid, error=str(e))
+            return encode_frame(_KIND_PONG, uid)
+        return encode_frame(
+            _KIND_ERROR, uid, error=f"unexpected shm frame kind {kind}"
+        )
+
+    def _serve_eval(
+        self,
+        payload: bytes,
+        uid: bytes,
+        trace_id: Optional[bytes],
+        off: int,
+    ) -> bytes:
+        try:
+            (ack,) = struct.unpack_from("<Q", payload, off)
+            self._reclaim(ack)
+            descs, _off = decode_descs(payload, off + 8)
+            arrays = self._request_arrays(descs)
+        except WireError as e:
+            _flightrec.record(
+                "server.error", stage="decode", wire="shm",
+                transport="shm", error=str(e)[:200],
+            )
+            return encode_frame(
+                _KIND_REPLY, uid, encode_descs([]),
+                error=f"decode error: {e}",
+            )
+        with _spans.trace_context(trace_id), _spans.span(
+            "node.evaluate", wire="shm", transport="shm"
+        ):
+            try:
+                if _fi.active_plan is not None:  # chaos seam
+                    _fi.compute_filter("shm.compute")
+                with _spans.span("compute"):
+                    outputs = [
+                        np.asarray(o) for o in self.compute_fn(*arrays)
+                    ]
+                with _spans.span("encode"):
+                    rdescs = self._write_reply_arrays(outputs)
+            except _fi.FaultPlanError:
+                raise  # plan-authoring bug: LOUD, never in-band
+            except Exception as e:
+                _flightrec.record(
+                    "server.error", stage="compute", wire="shm",
+                    transport="shm", error=str(e)[:200],
+                )
+                return encode_frame(
+                    _KIND_REPLY, uid, encode_descs([]), error=str(e)
+                )
+        return encode_frame(_KIND_REPLY, uid, encode_descs(rdescs))
+
+    def _serve_eval_batch(
+        self,
+        payload: bytes,
+        uid: bytes,
+        trace_id: Optional[bytes],
+        off: int,
+    ) -> bytes:
+        try:
+            ack, k = struct.unpack_from("<QI", payload, off)
+            self._reclaim(ack)
+            off += 12
+            items: List[Tuple[bytes, Optional[List[Desc]], Optional[str]]] = []
+            for _ in range(k):
+                iuid = payload[off : off + 16]
+                if len(iuid) != 16:
+                    raise WireError("truncated shm batch item")
+                off += 16
+                try:
+                    descs, off = decode_descs(payload, off)
+                except WireError as e:
+                    # Frame-structure damage: cannot resync to later
+                    # items — the whole frame fails (outer error).
+                    raise WireError(f"batch item: {e}") from None
+                items.append((iuid, descs, None))
+        except (WireError, struct.error) as e:
+            return encode_frame(
+                _KIND_REPLY_BATCH, b"\0" * 16,
+                struct.pack("<I", 0),
+                error=f"decode error: {e}",
+            )
+        with _spans.trace_context(trace_id), _spans.span(
+            "node.evaluate_batch", wire="shm", transport="shm", n_items=k
+        ):
+            if _fi.active_plan is not None:  # chaos seam: compute path
+                try:
+                    _fi.compute_filter("shm.compute")
+                except _fi.FaultPlanError:
+                    raise
+                except Exception as e:
+                    return encode_frame(
+                        _KIND_REPLY_BATCH, uid,
+                        struct.pack("<I", 0), error=str(e),
+                    )
+            decoded: List[Tuple[int, List[np.ndarray], bytes]] = []
+            item_errors: List[Optional[str]] = [None] * k
+            for i, (iuid, descs, _e) in enumerate(items):
+                try:
+                    arrays = self._request_arrays(descs or [])
+                    decoded.append((i, arrays, iuid))
+                except WireError as e:
+                    item_errors[i] = f"decode error: {e}"
+            batch_fn = getattr(self.compute_fn, "batch", None)
+            outcomes = _execute_window_sync(
+                self.compute_fn,
+                batch_fn,
+                [arrs for _i, arrs, _u in decoded],
+            )
+            item_replies: List[bytes] = []
+            outcome_by_slot: Dict[int, object] = {
+                i: res for (i, _a, _u), res in zip(decoded, outcomes)
+            }
+            # All reply arrays of the whole batch pack into ONE arena
+            # slot — one write, per-item descriptors carve it up.
+            flat_outputs: List[np.ndarray] = []
+            flat_plan: List[Tuple[int, int, List[np.ndarray]]] = []
+            for i in range(k):
+                res = outcome_by_slot.get(i)
+                if item_errors[i] is not None or res is None:
+                    continue
+                if isinstance(res, Exception):
+                    _flightrec.record(
+                        "server.error", stage="compute", wire="shm",
+                        transport="shm", error=str(res)[:200],
+                    )
+                    item_errors[i] = str(res)
+                    continue
+                outs = [np.asarray(o) for o in res]
+                flat_plan.append((i, len(flat_outputs), outs))
+                flat_outputs.extend(outs)
+            all_descs: List[Desc] = []
+            if flat_outputs:
+                all_descs = self._write_reply_arrays(flat_outputs)
+            descs_by_item: Dict[int, List[Desc]] = {}
+            for i, begin, outs in flat_plan:
+                descs_by_item[i] = all_descs[begin : begin + len(outs)]
+            for i, (iuid, _d, _e) in enumerate(items):
+                err = item_errors[i]
+                if err is not None:
+                    eb = err.encode("utf-8")
+                    item_replies.append(
+                        iuid + struct.pack("<I", len(eb)) + eb
+                    )
+                else:
+                    item_replies.append(
+                        iuid
+                        + struct.pack("<I", 0)
+                        + encode_descs(descs_by_item.get(i, []))
+                    )
+        body = struct.pack("<I", k) + b"".join(item_replies)
+        return encode_frame(_KIND_REPLY_BATCH, uid, body)
+
+
+def serve_shm(
+    compute_fn: Callable[..., Sequence[np.ndarray]],
+    host: str = "127.0.0.1",
+    port: int = 0,
+    *,
+    ready_callback: Optional[Callable[[int], None]] = None,
+    max_connections: Optional[int] = None,
+    arena_bytes: int = DEFAULT_ARENA_BYTES,
+    concurrent: bool = True,
+) -> None:
+    """Blocking shm-lane node: doorbell accept loop + one arena pair
+    per connection.  Mirrors :func:`~.tcp.serve_tcp_once`'s surface
+    (``port=0`` + ``ready_callback``, ``max_connections``,
+    ``concurrent``); also answers plain npwire frames, so pool probes
+    and npwire peers work without knowing about arenas.  Corrupt
+    frames and bad descriptors are answered in-band — a hostile or
+    chaos-mangled request must never tear down the node.
+
+    Contract: ``compute_fn`` receives READ-ONLY zero-copy views of the
+    request arrays (they ARE the shared pages — that is the lane); a
+    compute that mutates its inputs in place must copy first (or serve
+    over :func:`~.tcp.serve_tcp_once`, whose default decodes owned
+    copies)."""
+    active = [0]
+    lock = threading.Lock()
+
+    def n_connections() -> int:
+        with lock:
+            return active[0]
+
+    def run(conn: socket.socket) -> None:
+        with lock:
+            active[0] += 1
+        try:
+            _ShmConnection(
+                conn, compute_fn, arena_bytes, n_connections
+            ).serve()
+        finally:
+            with lock:
+                active[0] -= 1
+
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as srv:
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((host, port))
+        srv.listen(16)
+        if ready_callback is not None:
+            ready_callback(srv.getsockname()[1])
+        served = 0
+        while max_connections is None or served < max_connections:
+            conn, _ = srv.accept()
+            served += 1
+            if concurrent:
+                threading.Thread(
+                    target=run, args=(conn,), daemon=True
+                ).start()
+            else:
+                run(conn)

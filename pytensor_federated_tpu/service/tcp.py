@@ -19,7 +19,6 @@ from __future__ import annotations
 import socket
 import struct
 import time
-import uuid as uuid_mod
 from typing import Callable, List, Optional, Sequence
 
 import numpy as np
@@ -33,12 +32,15 @@ from . import _rpc_metrics
 from .batching import execute_window_sync as _execute_window_sync
 from .npwire import (
     append_spans,
+    fast_uuid,
     decode_arrays_all,
     decode_arrays_ex,
     decode_batch,
     encode_arrays,
+    encode_arrays_sg,
     encode_batch,
     is_batch_frame,
+    sg_nbytes,
 )
 
 __all__ = ["TcpArraysClient", "serve_tcp_once", "RemoteComputeError"]
@@ -69,8 +71,49 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return b"".join(chunks)
 
 
+# Linux IOV_MAX is 1024; stay under it so one sendmsg never fails
+# with EMSGSIZE however many frames a burst coalesces.
+_IOV_CHUNK = 512
+
+
+def _sendmsg_all(sock: socket.socket, parts) -> None:
+    """Send a buffer vector with ``socket.sendmsg`` — the scatter/
+    gather syscall: the kernel gathers the views directly, so nothing
+    is concatenated in userspace (the copy ``b"".join`` used to pay).
+    Handles partial sends (a filled send buffer can accept any byte
+    count) and chunks the vector under IOV_MAX."""
+    mvs = []
+    for p in parts:
+        mv = p if isinstance(p, memoryview) else memoryview(p)
+        if mv.format != "B" or mv.ndim != 1:
+            # Byte-format views only: the partial-send arithmetic below
+            # slices by BYTES, and a typed view slices by elements.
+            mv = mv.cast("B")
+        mvs.append(mv)
+    start = 0
+    while start < len(mvs):
+        chunk = mvs[start : start + _IOV_CHUNK]
+        while chunk:
+            sent = sock.sendmsg(chunk)
+            while chunk and sent >= chunk[0].nbytes:
+                sent -= chunk[0].nbytes
+                chunk.pop(0)
+            if sent:
+                chunk[0] = chunk[0][sent:]
+        start += _IOV_CHUNK
+
+
 def _send_frame(sock: socket.socket, payload: bytes) -> None:
-    sock.sendall(struct.pack("<I", len(payload)) + payload)
+    # Header + payload as one sendmsg vector: no copy-to-prepend.
+    _sendmsg_all(sock, (struct.pack("<I", len(payload)), payload))
+
+
+def _send_frame_vec(sock: socket.socket, parts, nbytes: int) -> None:
+    """One length-prefixed frame from a scatter/gather buffer vector
+    (``encode_arrays_sg`` output): the u32 header and every piece ride
+    a single ``sendmsg``, so array payloads go source → kernel with no
+    intermediate frame copy."""
+    _sendmsg_all(sock, [struct.pack("<I", nbytes), *parts])
 
 
 def _recv_frame(sock: socket.socket) -> bytes:
@@ -201,15 +244,21 @@ class TcpArraysClient:
     def evaluate(self, *arrays: np.ndarray) -> List[np.ndarray]:
         with _spans.span("rpc.evaluate", transport="tcp"):
             with _spans.span("encode"):
-                uid = uuid_mod.uuid4().bytes
+                uid = fast_uuid()
                 trace_id = (
                     _spans.current_trace_id() if _spans.enabled() else None
                 )
-                request = encode_arrays(
+                # Scatter/gather encode: the frame stays a buffer
+                # vector (header bytes + views of the input arrays)
+                # until sendmsg hands the pieces to the kernel — no
+                # contiguous-frame copy.  ``arrays`` outlives the send,
+                # so the views stay valid across retries.
+                request = encode_arrays_sg(
                     [np.asarray(a) for a in arrays],
                     uuid=uid,
                     trace_id=trace_id,
                 )
+                request_len = sg_nbytes(request)
             last_err: Optional[Exception] = None
             for attempt in range(self.retries + 1):
                 if attempt:
@@ -223,11 +272,12 @@ class TcpArraysClient:
                         sock = self._connect()
                         if _fi.active_plan is not None:  # chaos seam
                             _fi.send_frame_through(
-                                "tcp.send", sock.sendall, request,
+                                "tcp.send", sock.sendall,
+                                b"".join(request),
                                 peer=self._peer,
                             )
                         else:
-                            _send_frame(sock, request)
+                            _send_frame_vec(sock, request, request_len)
                         reply = self._read_frame()
                         if _fi.active_plan is not None:  # chaos seam
                             reply = _fi.filter_bytes(
@@ -330,7 +380,7 @@ class TcpArraysClient:
         (``close()`` resets it)."""
         if self._batch_ok is None:
             sock = self._connect()
-            uid = uuid_mod.uuid4().bytes
+            uid = fast_uuid()
             _send_frame(sock, encode_batch([], uuid=uid))
             reply = self._read_frame()
             ok = False
@@ -398,19 +448,18 @@ class TcpArraysClient:
                 trace_id = (
                     _spans.current_trace_id() if _spans.enabled() else None
                 )
+                # (buffer-vector, frame length, uuid) per request: the
+                # scatter/gather form survives until sendmsg (or, on
+                # the batch-frame path, until the frames are packed).
                 encoded = []
                 for args in requests:
-                    uid = uuid_mod.uuid4().bytes
-                    encoded.append(
-                        (
-                            encode_arrays(
-                                [np.asarray(a) for a in args],
-                                uuid=uid,
-                                trace_id=trace_id,
-                            ),
-                            uid,
-                        )
+                    uid = fast_uuid()
+                    parts = encode_arrays_sg(
+                        [np.asarray(a) for a in args],
+                        uuid=uid,
+                        trace_id=trace_id,
                     )
+                    encoded.append((parts, sg_nbytes(parts), uid))
             if not encoded:
                 return []
             t0 = time.perf_counter()
@@ -495,19 +544,18 @@ class TcpArraysClient:
                 trace_id = (
                     _spans.current_trace_id() if _spans.enabled() else None
                 )
+                # (buffer-vector, frame length, uuid) per request: the
+                # scatter/gather form survives until sendmsg (or, on
+                # the batch-frame path, until the frames are packed).
                 encoded = []
                 for args in requests:
-                    uid = uuid_mod.uuid4().bytes
-                    encoded.append(
-                        (
-                            encode_arrays(
-                                [np.asarray(a) for a in args],
-                                uuid=uid,
-                                trace_id=trace_id,
-                            ),
-                            uid,
-                        )
+                    uid = fast_uuid()
+                    parts = encode_arrays_sg(
+                        [np.asarray(a) for a in args],
+                        uuid=uid,
+                        trace_id=trace_id,
                     )
+                    encoded.append((parts, sg_nbytes(parts), uid))
             if not encoded:
                 return [], None
             out: List[Optional[List[np.ndarray]]] = [None] * len(encoded)
@@ -547,54 +595,54 @@ class TcpArraysClient:
         # ``out`` (optional, len(encoded) of None) is filled in place
         # as replies validate — the partial-progress channel
         # evaluate_many_partial / the replica pool's failover build on.
+        # ``encoded`` entries are (buffer-vector, nbytes, uuid).
         sock = self._connect()
         n = len(encoded)
-        max_inflight = self._inflight_cap(len(encoded[0][0]))
+        max_inflight = self._inflight_cap(encoded[0][1])
         results: List[Optional[List[np.ndarray]]] = (
             out if out is not None else [None] * n
         )
         write_idx = read_idx = 0
         inflight_bytes = 0
         while read_idx < n:
-            # Coalesce every writable frame into ONE sendall: on
+            # Coalesce every writable frame into ONE sendmsg vector: on
             # localhost the per-call cost is syscall-dominated, so a
-            # window of small frames should pay one write, not window.
+            # window of small frames pays one gather syscall — and the
+            # array payloads ride as views, never joined in userspace.
             burst = []
             while write_idx < n and (
                 write_idx == read_idx
                 or (
                     write_idx - read_idx < window
-                    and inflight_bytes + len(encoded[write_idx][0])
+                    and inflight_bytes + encoded[write_idx][1]
                     <= max_inflight
                 )
             ):
-                payload = encoded[write_idx][0]
-                burst.append(payload)
-                inflight_bytes += len(payload)
+                parts, nbytes, _uid = encoded[write_idx]
+                burst.append((parts, nbytes))
+                inflight_bytes += nbytes
                 write_idx += 1
             if burst:
                 if _fi.active_plan is not None:  # chaos seam: per frame
-                    for payload in burst:
+                    for parts, _nb in burst:
                         _fi.send_frame_through(
-                            "tcp.send", sock.sendall, payload,
+                            "tcp.send", sock.sendall, b"".join(parts),
                             peer=self._peer,
                         )
                 else:
-                    # One join, no per-frame concat copy: the hot path
-                    # must not pay chaos's plumbing (ISSUE 5 gate).
-                    parts = []
-                    for p in burst:
-                        parts.append(struct.pack("<I", len(p)))
-                        parts.append(p)
-                    sock.sendall(b"".join(parts))
+                    vec = []
+                    for parts, nbytes in burst:
+                        vec.append(struct.pack("<I", nbytes))
+                        vec.extend(parts)
+                    _sendmsg_all(sock, vec)
             _WINDOW_DEPTH.labels(transport="tcp").observe(
                 write_idx - read_idx
             )
             reply = self._read_frame()
             if _fi.active_plan is not None:  # chaos seam
                 reply = _fi.filter_bytes("tcp.recv", reply, self._peer)
-            request, uid = encoded[read_idx]
-            inflight_bytes -= len(request)
+            _parts, request_len, uid = encoded[read_idx]
+            inflight_bytes -= request_len
             try:
                 outputs, reply_uid, error, _tid, node_spans = (
                     decode_arrays_all(reply)
@@ -650,9 +698,16 @@ class TcpArraysClient:
         frames = []  # (frame_bytes, outer_uuid, start, part)
         for start in range(0, n, chunk):
             part = encoded[start : start + chunk]
-            outer_uuid = uuid_mod.uuid4().bytes
+            outer_uuid = fast_uuid()
+            # Batch frames nest COMPLETE item frames, so the
+            # scatter/gather vectors are joined here — one flattening
+            # per item, same count as the pre-sendmsg wire.
             frame = encode_batch(
-                [req for req, _u in part],
+                [
+                    req[0] if len(req) == 1 and isinstance(req[0], bytes)
+                    else b"".join(req)
+                    for req, _nb, _u in part
+                ],
                 uuid=outer_uuid,
                 trace_id=trace_id,
             )
@@ -684,13 +739,12 @@ class TcpArraysClient:
                             peer=self._peer,
                         )
                 else:
-                    # One join, no per-frame concat copy: the hot path
-                    # must not pay chaos's plumbing (ISSUE 5 gate).
-                    parts = []
+                    # One gather syscall, no userspace concat copy.
+                    vec = []
                     for p in burst:
-                        parts.append(struct.pack("<I", len(p)))
-                        parts.append(p)
-                    sock.sendall(b"".join(parts))
+                        vec.append(struct.pack("<I", len(p)))
+                        vec.append(p)
+                    _sendmsg_all(sock, vec)
             _WINDOW_DEPTH.labels(transport="tcp").observe(
                 write_idx - read_idx
             )
@@ -726,7 +780,9 @@ class TcpArraysClient:
                     "batch reply does not correlate with its frame"
                 )
             if first_error is None:
-                for j, (item, (_req, uid)) in enumerate(zip(items, part)):
+                for j, (item, (_req, _nb, uid)) in enumerate(
+                    zip(items, part)
+                ):
                     try:
                         outputs, reply_uid, error, _t, item_spans = (
                             decode_arrays_all(item)
@@ -767,7 +823,11 @@ class TcpArraysClient:
 
 
 def _serve_batch_payload(
-    compute_fn: Callable[..., Sequence[np.ndarray]], payload: bytes
+    compute_fn: Callable[..., Sequence[np.ndarray]],
+    payload: bytes,
+    *,
+    transport: str = "tcp",
+    request_views: bool = False,
 ) -> bytes:
     """One npwire batch frame in -> one batch frame out, per-item
     error isolation — the TCP server twin of the gRPC service's
@@ -783,7 +843,7 @@ def _serve_batch_payload(
         )
     batch_fn = getattr(compute_fn, "batch", None)
     with _spans.trace_context(trace_id), _spans.span(
-        "node.evaluate_batch", wire="npwire", transport="tcp",
+        "node.evaluate_batch", wire="npwire", transport=transport,
         n_items=len(items),
     ) as root:
         if _fi.active_plan is not None:  # chaos seam: compute path
@@ -802,7 +862,9 @@ def _serve_batch_payload(
         decoded = []  # (slot, arrays, uuid)
         for i, item in enumerate(items):
             try:
-                arrays, uid, _, _ = decode_arrays_ex(item)
+                arrays, uid, _, _ = decode_arrays_ex(
+                    item, copy=not request_views
+                )
                 decoded.append((i, arrays, uid))
             except Exception as e:
                 replies[i] = encode_arrays(
@@ -819,7 +881,7 @@ def _serve_batch_payload(
             if isinstance(res, Exception):
                 _flightrec.record(
                     "server.error", stage="compute", wire="npwire",
-                    transport="tcp", error=str(res)[:200],
+                    transport=transport, error=str(res)[:200],
                 )
                 replies[i] = encode_arrays([], uuid=uid, error=str(res))
             else:
@@ -832,9 +894,96 @@ def _serve_batch_payload(
     return reply
 
 
+def _serve_plain_payload(
+    compute_fn: Callable[..., Sequence[np.ndarray]],
+    payload: bytes,
+    *,
+    transport: str = "tcp",
+    request_views: bool = False,
+) -> bytes:
+    """One plain npwire frame in -> one reply frame out: decode,
+    compute, encode, with in-band error replies and the reunion spans
+    piggyback.  Shared by the TCP accept loop and the shm doorbell's
+    npwire fallback lane (probes from a mixed pool).
+
+    ``request_views=True`` decodes request arrays as READ-ONLY
+    frombuffer views into the frame — one payload copy saved per
+    request, at the cost of breaking compute_fns that mutate their
+    inputs in place; the historical owned-copy semantics stay the
+    default."""
+    try:
+        arrays, uid, _, trace_id = decode_arrays_ex(
+            payload, copy=not request_views
+        )
+    except Exception as e:
+        # A corrupt request fails ITS reply in-band and the connection
+        # keeps serving — a hostile or chaos-mangled frame must not
+        # tear down the node (mirror of cpp_node's serve_plain).
+        _flightrec.record(
+            "server.error", stage="decode",
+            wire="npwire", transport=transport,
+            error=str(e)[:200],
+        )
+        return encode_arrays(
+            [], uuid=b"\0" * 16, error=f"decode error: {e}"
+        )
+    # Node-side spans adopt the driver's wire trace id,
+    # same contract as the gRPC server (server.py).
+    with _spans.trace_context(trace_id), _spans.span(
+        "node.evaluate", wire="npwire", transport=transport
+    ) as root:
+        try:
+            if _fi.active_plan is not None:  # chaos seam
+                _fi.compute_filter()
+            with _spans.span("compute"):
+                outputs = [
+                    np.asarray(o) for o in compute_fn(*arrays)
+                ]
+            with _spans.span("encode"):
+                reply = encode_arrays(outputs, uuid=uid)
+        except _fi.FaultPlanError:
+            raise  # plan-authoring bug: LOUD, never in-band
+        except Exception as e:  # error -> error payload
+            _flightrec.record(
+                "server.error", stage="compute",
+                wire="npwire", transport=transport,
+                error=str(e)[:200],
+            )
+            reply = encode_arrays([], uuid=uid, error=str(e))
+    # Reunion piggyback: traced requests get this node's span tree on
+    # the reply tail (untraced frames stay byte-identical).
+    if trace_id is not None and root.span is not None:
+        reply = append_spans(reply, [root.span.to_dict()])
+    return reply
+
+
+def serve_npwire_payload(
+    compute_fn: Callable[..., Sequence[np.ndarray]],
+    payload: bytes,
+    *,
+    transport: str = "tcp",
+    request_views: bool = False,
+) -> bytes:
+    """One npwire frame (plain OR batch) in -> one reply frame out —
+    the whole node-side npwire contract as a function, so any framed
+    byte channel (TCP accept loop, shm doorbell) serves identically.
+    ``request_views`` opts the request decode into zero-copy read-only
+    views (see :func:`_serve_plain_payload`)."""
+    if is_batch_frame(payload):
+        return _serve_batch_payload(
+            compute_fn, payload, transport=transport,
+            request_views=request_views,
+        )
+    return _serve_plain_payload(
+        compute_fn, payload, transport=transport,
+        request_views=request_views,
+    )
+
+
 def _serve_tcp_connection(
     conn: socket.socket,
     compute_fn: Callable[..., Sequence[np.ndarray]],
+    request_views: bool = False,
 ) -> None:
     """One connection's lock-step frame loop (shared by the sequential
     and ``concurrent=True`` accept modes of :func:`serve_tcp_once`)."""
@@ -852,69 +1001,14 @@ def _serve_tcp_connection(
                     )
                 except (ConnectionError, OSError):
                     break
-            if is_batch_frame(payload):
-                try:
-                    _serve_send(
-                        conn,
-                        _serve_batch_payload(compute_fn, payload),
-                    )
-                except (ConnectionError, OSError):
-                    break
-                continue
             try:
-                arrays, uid, _, trace_id = decode_arrays_ex(payload)
-            except Exception as e:
-                # A corrupt request fails ITS reply in-band and
-                # the connection keeps serving — a hostile or
-                # chaos-mangled frame must not tear down the
-                # node (mirror of cpp_node's serve_plain).
-                _flightrec.record(
-                    "server.error", stage="decode",
-                    wire="npwire", transport="tcp",
-                    error=str(e)[:200],
+                _serve_send(
+                    conn,
+                    serve_npwire_payload(
+                        compute_fn, payload,
+                        request_views=request_views,
+                    ),
                 )
-                try:
-                    _serve_send(
-                        conn,
-                        encode_arrays(
-                            [], uuid=b"\0" * 16,
-                            error=f"decode error: {e}",
-                        ),
-                    )
-                except (ConnectionError, OSError):
-                    break
-                continue
-            # Node-side spans adopt the driver's wire trace id,
-            # same contract as the gRPC server (server.py).
-            with _spans.trace_context(trace_id), _spans.span(
-                "node.evaluate", wire="npwire", transport="tcp"
-            ) as root:
-                try:
-                    if _fi.active_plan is not None:  # chaos seam
-                        _fi.compute_filter()
-                    with _spans.span("compute"):
-                        outputs = [
-                            np.asarray(o)
-                            for o in compute_fn(*arrays)
-                        ]
-                    with _spans.span("encode"):
-                        reply = encode_arrays(outputs, uuid=uid)
-                except _fi.FaultPlanError:
-                    raise  # plan-authoring bug: LOUD, never in-band
-                except Exception as e:  # error -> error payload
-                    _flightrec.record(
-                        "server.error", stage="compute",
-                        wire="npwire", transport="tcp",
-                        error=str(e)[:200],
-                    )
-                    reply = encode_arrays([], uuid=uid, error=str(e))
-            # Reunion piggyback: traced requests get this
-            # node's span tree on the reply tail (untraced
-            # frames stay byte-identical to the PR-1 wire).
-            if trace_id is not None and root.span is not None:
-                reply = append_spans(reply, [root.span.to_dict()])
-            try:
-                _serve_send(conn, reply)
             except (ConnectionError, OSError):
                 break
 
@@ -927,6 +1021,7 @@ def serve_tcp_once(
     ready_callback: Optional[Callable[[int], None]] = None,
     max_connections: Optional[int] = None,
     concurrent: bool = False,
+    request_views: bool = False,
 ) -> None:
     """Blocking pure-Python server for the same protocol.
 
@@ -945,7 +1040,10 @@ def serve_tcp_once(
     windows as one vmapped call.  ``port=0`` binds an ephemeral port
     reported through ``ready_callback``.  ``max_connections`` bounds
     the accept loop (None = forever; in concurrent mode it bounds
-    accepts, not completions).
+    accepts, not completions).  ``request_views=True`` hands
+    compute_fn READ-ONLY zero-copy views of request arrays instead of
+    owned copies — one payload copy saved per request; leave it off
+    for compute_fns that mutate their inputs in place.
     """
     import threading
 
@@ -962,8 +1060,8 @@ def serve_tcp_once(
             if concurrent:
                 threading.Thread(
                     target=_serve_tcp_connection,
-                    args=(conn, compute_fn),
+                    args=(conn, compute_fn, request_views),
                     daemon=True,
                 ).start()
             else:
-                _serve_tcp_connection(conn, compute_fn)
+                _serve_tcp_connection(conn, compute_fn, request_views)

@@ -41,6 +41,11 @@ __all__ = [
     "NPWIRE_KNOWN_FLAGS",
     "NPPROTO_FIELDS",
     "NPPROTO_EXTENSION_FIELDS",
+    "SHMWIRE_KINDS",
+    "SHMWIRE_FLAGS",
+    "SHMWIRE_KNOWN_FLAGS",
+    "SHM_DESC_STRUCT",
+    "SHM_DESC_FIELD_ORDER",
 ]
 
 #: npwire frame flag bits, by canonical name.  npwire.py spells these
@@ -92,3 +97,55 @@ NPPROTO_FIELDS = {
 NPPROTO_EXTENSION_FIELDS = frozenset(
     n for n in NPPROTO_FIELDS["arrays_msg"].values() if n >= 14
 )
+
+#: shm doorbell frame kinds (``service/shm.py`` spells these
+#: ``_KIND_<NAME>``).  The zero-copy lane's doorbell channel carries
+#: DESCRIPTOR frames — ``(slot, delta, length, generation)`` pointers
+#: into a mmap arena — instead of payload bytes; this table is the one
+#: declared source of the frame-kind byte, cross-checked against the
+#: implementation by the graftlint wire-registry rule.  Decoders REJECT
+#: an unknown kind (same loud-failure posture as npwire flags: the
+#: doorbell peers ship in lockstep).
+SHMWIRE_KINDS = {
+    "ATTACH": 1,       # client -> server: open the arena pair
+    "ATTACH_OK": 2,    # server -> client: JSON {req,rep,size,arena_id}
+    "EVAL": 3,         # one request: descriptor list into the req arena
+    "REPLY": 4,        # one reply: descriptor list into the rep arena
+    "EVAL_BATCH": 5,   # K requests in one doorbell frame (PR-3 analog)
+    "REPLY_BATCH": 6,  # K replies, per-item error isolation
+    "ACK": 7,          # reply-arena reclamation watermark (generation)
+    "GETLOAD": 8,      # load probe request
+    "LOAD": 9,         # JSON load reply
+    "PING": 10,        # empty-arena-write doorbell round-trip probe
+    "PONG": 11,        # ping reply
+    "ERROR": 12,       # frame-level in-band error (undecodable frame)
+}
+
+#: shm doorbell frame flag bits (``service/shm.py`` spells these
+#: ``_FLAG_<NAME>``).  Deliberately a SUBSET of the npwire flags with
+#: the same bit assignments; the spans/batch features ride dedicated
+#: frame kinds instead of flag bits on this lane.
+SHMWIRE_FLAGS = {
+    "ERROR": 1,  # in-band error string block follows the uuid
+    "TRACE": 2,  # 16-byte telemetry trace id block
+}
+
+#: The full known-flags mask every shm decoder must enforce
+#: (``flags & ~KNOWN`` is a WireError, not a skip).
+SHMWIRE_KNOWN_FLAGS = 0
+for _bit in SHMWIRE_FLAGS.values():
+    SHMWIRE_KNOWN_FLAGS |= _bit
+del _bit
+
+#: The arena descriptor: one fixed-layout struct per array, pointing at
+#: bytes that never ride the doorbell.  ``slot`` is the arena offset of
+#: the slot HEADER (whose generation the reader validates before and
+#: after touching payload bytes), ``delta`` the array's byte offset
+#: inside the slot's payload (several arrays may share one slot — the
+#: scatter/gather packing), ``length`` the payload byte length, and
+#: ``generation`` the slot generation the descriptor was minted
+#: against — a recycled or torn slot fails loudly as WireError.  The
+#: struct format and field order are declared here so the graftlint
+#: wire-registry rule can pin the implementation's literals to them.
+SHM_DESC_STRUCT = "<QIQQ"
+SHM_DESC_FIELD_ORDER = ("slot", "delta", "length", "generation")

@@ -217,6 +217,41 @@ std::vector<uint8_t> serve_batch(const Buf& b) {
 """
 
 
+SHM_REL = "pytensor_federated_tpu/service/shm.py"
+
+SHM_CLEAN = """
+import struct
+
+_KIND_ATTACH = 1
+_KIND_ATTACH_OK = 2
+_KIND_EVAL = 3
+_KIND_REPLY = 4
+_KIND_EVAL_BATCH = 5
+_KIND_REPLY_BATCH = 6
+_KIND_ACK = 7
+_KIND_GETLOAD = 8
+_KIND_LOAD = 9
+_KIND_PING = 10
+_KIND_PONG = 11
+_KIND_ERROR = 12
+_KNOWN_KINDS = frozenset(range(1, 13))
+_FLAG_ERROR = 1
+_FLAG_TRACE = 2
+_KNOWN_FLAGS = _FLAG_ERROR | _FLAG_TRACE
+_DESC_STRUCT = struct.Struct("<QIQQ")
+
+
+def _check_flags(flags):
+    pass
+
+
+def decode_frame(buf):
+    _check_flags(0)
+    if 0 not in _KNOWN_KINDS:
+        raise ValueError
+"""
+
+
 class TestWireRegistry:
     def test_clean_fixture(self, tmp_path):
         findings = run_on(
@@ -305,6 +340,55 @@ class TestWireRegistry:
             for f in findings
         )
 
+
+    # -- shm doorbell / arena descriptor table (ISSUE 9) ------------------
+
+    def test_shm_clean_fixture(self, tmp_path):
+        findings = run_on(tmp_path, {SHM_REL: SHM_CLEAN}, ["wire-registry"])
+        assert findings == []
+
+    def test_shm_undeclared_kind_flagged(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {SHM_REL: SHM_CLEAN + "_KIND_STREAM = 13\n"},
+            ["wire-registry"],
+        )
+        assert any("STREAM" in f.message for f in findings)
+
+    def test_shm_kind_value_drift_flagged(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {SHM_REL: SHM_CLEAN.replace("_KIND_EVAL = 3", "_KIND_EVAL = 9")},
+            ["wire-registry"],
+        )
+        assert any(
+            "EVAL" in f.message and "declared as 3" in f.message
+            for f in findings
+        )
+
+    def test_shm_desc_struct_drift_flagged(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {
+                SHM_REL: SHM_CLEAN.replace(
+                    'struct.Struct("<QIQQ")', 'struct.Struct("<QQQQ")'
+                )
+            },
+            ["wire-registry"],
+        )
+        assert any("descriptor struct" in f.message for f in findings)
+
+    def test_shm_unguarded_decoder_flagged(self, tmp_path):
+        src = SHM_CLEAN.replace(
+            "def decode_frame(buf):\n"
+            "    _check_flags(0)\n"
+            "    if 0 not in _KNOWN_KINDS:\n"
+            "        raise ValueError",
+            "def decode_frame(buf):\n    return buf",
+        )
+        findings = run_on(tmp_path, {SHM_REL: src}, ["wire-registry"])
+        assert any("unknown flag bits" in f.message for f in findings)
+        assert any("unknown frame kinds" in f.message for f in findings)
 
 # -- wire-loudness ----------------------------------------------------------
 

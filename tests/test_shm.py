@@ -1,0 +1,827 @@
+"""Shared-memory arena transport (service/arena.py + service/shm.py).
+
+Covers the ISSUE-9 tentpole surface: the arena's generation protocol
+(stale/torn/recycled slots fail loudly), the doorbell client/server
+pair (evaluate, pipelined + batched windows, partial progress,
+GetLoad, ping), pinned-array promotion (repeat-identity arrays move
+zero bytes), the npwire fallback lane (pool probes), pool mixing, and
+the fault-injection seams for the four shm-specific fault scenarios.
+"""
+
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pytensor_federated_tpu import faultinject as fi
+from pytensor_federated_tpu.service.arena import Arena
+from pytensor_federated_tpu.service.npwire import (
+    WireError,
+    decode_batch,
+    encode_batch,
+    is_batch_frame,
+)
+from pytensor_federated_tpu.service.shm import (
+    ShmArraysClient,
+    decode_descs,
+    decode_frame,
+    encode_descs,
+    encode_frame,
+    serve_shm,
+    _KIND_EVAL,
+    _KIND_REPLY,
+)
+from pytensor_federated_tpu.service.tcp import RemoteComputeError
+
+
+def quad_compute(x):
+    x = np.asarray(x)
+    return [
+        np.asarray(-np.sum((x - 3.0) ** 2)),
+        (-2.0 * (x - 3.0)).astype(x.dtype),
+    ]
+
+
+def expected(i):
+    return -((i - 3.0) ** 2 + 4.0)
+
+
+@pytest.fixture()
+def shm_node():
+    """One in-process shm node (daemon thread) -> (host, port)."""
+    ports = []
+    thread = threading.Thread(
+        target=serve_shm,
+        args=(quad_compute,),
+        kwargs=dict(ready_callback=ports.append),
+        daemon=True,
+    )
+    thread.start()
+    deadline = time.time() + 10
+    while not ports and time.time() < deadline:
+        time.sleep(0.01)
+    assert ports, "shm node did not come up"
+    yield "127.0.0.1", ports[0]
+
+
+@pytest.fixture()
+def client(shm_node):
+    c = ShmArraysClient(*shm_node)
+    yield c
+    c.close()
+
+
+# ---------------------------------------------------------------------------
+# arena: the generation protocol
+# ---------------------------------------------------------------------------
+
+
+class TestArena:
+    def test_write_read_roundtrip(self, tmp_path):
+        arena = Arena.create(1 << 20, path=str(tmp_path / "a.shm"))
+        payload = np.arange(32, dtype=np.float64)
+        slot, gen, deltas = arena.write_many([memoryview(payload).cast("B")])
+        view = arena.read_view(slot, deltas[0], payload.nbytes, gen)
+        assert np.array_equal(
+            np.frombuffer(view, np.float64), payload
+        )
+        data = arena.read_bytes(slot, deltas[0], payload.nbytes, gen)
+        assert data == payload.tobytes()
+        arena.close(unlink=True)
+
+    def test_packing_deltas_are_aligned(self, tmp_path):
+        arena = Arena.create(1 << 20, path=str(tmp_path / "a.shm"))
+        slot, gen, deltas = arena.write_many([b"abc", b"defgh", b""])
+        assert deltas == [0, 8, 16]  # 8-aligned array starts
+        assert arena.read_bytes(slot, deltas[1], 5, gen) == b"defgh"
+        assert arena.read_bytes(slot, deltas[2], 0, gen) == b""
+        arena.close(unlink=True)
+
+    def test_stale_generation_is_loud(self, tmp_path):
+        arena = Arena.create(1 << 20, path=str(tmp_path / "a.shm"))
+        slot, gen, deltas = arena.write_many([b"x" * 64])
+        with pytest.raises(WireError, match="stale descriptor"):
+            arena.read_view(slot, 0, 64, gen + 1)
+        arena.close(unlink=True)
+
+    def test_recycled_slot_is_loud(self, tmp_path):
+        """A descriptor held across a free + rewrite sees the NEW
+        generation and fails — never torn data."""
+        arena = Arena.create(4096, path=str(tmp_path / "a.shm"))
+        slot, gen, _d = arena.write_many([b"old" * 100])
+        arena.free(slot)
+        # Fill until the ring reuses the freed region.
+        for _ in range(16):
+            s2, g2, _ = arena.write_many([b"new" * 100])
+            arena.free(s2)
+        with pytest.raises(WireError, match="stale|torn"):
+            arena.read_view(slot, 0, 300, gen)
+        arena.close(unlink=True)
+
+    def test_torn_write_is_loud(self, tmp_path):
+        arena = Arena.create(1 << 20, path=str(tmp_path / "a.shm"))
+        slot, gen, _d = arena.write_many([b"y" * 128])
+        arena.scribble_tail(slot)  # the truncate_slot chaos primitive
+        with pytest.raises(WireError, match="torn slot"):
+            arena.read_view(slot, 0, 128, gen)
+        arena.close(unlink=True)
+
+    def test_out_of_bounds_descriptor_is_loud(self, tmp_path):
+        arena = Arena.create(1 << 20, path=str(tmp_path / "a.shm"))
+        slot, gen, _d = arena.write_many([b"z" * 16])
+        with pytest.raises(WireError, match="out of arena bounds"):
+            arena.read_view(arena.capacity + 64, 0, 16, gen)
+        with pytest.raises(WireError, match="exceeds"):
+            arena.read_view(slot, 0, 17, gen)  # past the payload
+        with pytest.raises(WireError, match="misaligned"):
+            arena.read_view(slot + 4, 0, 8, gen)
+        arena.close(unlink=True)
+
+    def test_exhaustion_is_loud_never_overwrites(self, tmp_path):
+        arena = Arena.create(4096, path=str(tmp_path / "a.shm"))
+        slot, gen, _d = arena.write_many([b"a" * 1024])
+        with pytest.raises(WireError, match="arena exhausted"):
+            arena.write_many([b"b" * 4096])
+        # The live slot is intact after the refused allocation.
+        assert arena.read_bytes(slot, 0, 1024, gen) == b"a" * 1024
+        arena.close(unlink=True)
+
+    def test_exactly_full_ring_refuses(self, tmp_path):
+        """head == tail with live slots means FULL, not empty: an
+        exact-fit wrap must not let the next allocation overwrite the
+        oldest in-flight slot (round-9 review finding)."""
+        arena = Arena.create(64 + 320, path=str(tmp_path / "a.shm"))
+        sA, _gA, _ = arena.write_many([b"x" * 60])  # slots are 128 B
+        sB, gB, _ = arena.write_many([b"y" * 60])
+        arena.free(sA)
+        sC, gC, _ = arena.write_many([b"z" * 60])  # wraps: head == tail
+        assert arena._head == arena._tail and len(arena._live) == 2
+        with pytest.raises(WireError, match="exactly full"):
+            arena.write_many([b"w" * 60])
+        assert arena.read_bytes(sB, 0, 60, gB) == b"y" * 60  # intact
+        assert arena.read_bytes(sC, 0, 60, gC) == b"z" * 60
+        arena.free(sB)
+        s2, g2, _ = arena.write_many([b"k" * 60])  # frees reopen it
+        assert arena.read_bytes(s2, 0, 60, g2) == b"k" * 60
+        arena.close(unlink=True)
+
+    def test_pinned_alloc_clears_wrapped_live_slots(self, tmp_path):
+        """A pinned allocation while the ring is WRAPPED must clear the
+        highest live byte, not just the tail pointer — a mid-window pin
+        promotion previously landed inside an in-flight slot (round-9
+        review finding, reproduced)."""
+        arena = Arena.create(4096, path=str(tmp_path / "a.shm"))
+        s1, _g1, _ = arena.write_many([b"a" * 900])
+        s2, g2, _ = arena.write_many([b"b" * 2800])  # extends to ~3968
+        arena.free(s1)
+        s3, g3, _ = arena.write_many([b"c" * 500])  # wraps: tail > head
+        assert arena._tail > arena._head
+        with pytest.raises(WireError, match="pinned region"):
+            arena.write_many([b"p" * 600], pinned=True)
+        # Both in-flight slots are untouched.
+        assert arena.read_bytes(s2, 0, 2800, g2) == b"b" * 2800
+        assert arena.read_bytes(s3, 0, 500, g3) == b"c" * 500
+        arena.close(unlink=True)
+
+    def test_full_ring_reports_zero_free(self, tmp_path):
+        arena = Arena.create(64 + 320, path=str(tmp_path / "a.shm"))
+        sA, _gA, _ = arena.write_many([b"x" * 60])
+        sB, _gB, _ = arena.write_many([b"y" * 60])
+        arena.free(sA)
+        arena.write_many([b"z" * 60])  # wraps; head == tail, full
+        assert arena.transient_bytes_free() == 0
+        arena.close(unlink=True)
+
+    def test_fifo_free_enforced(self, tmp_path):
+        arena = Arena.create(1 << 20, path=str(tmp_path / "a.shm"))
+        s1, _g1, _ = arena.write_many([b"1"])
+        s2, _g2, _ = arena.write_many([b"2"])
+        with pytest.raises(WireError, match="out of order"):
+            arena.free(s2)
+        arena.free(s1)
+        arena.free(s2)
+        arena.close(unlink=True)
+
+    def test_ring_wraps_and_reuses(self, tmp_path):
+        """Many write/free cycles in a small arena: the ring wraps
+        without exhaustion and every read validates."""
+        arena = Arena.create(8192, path=str(tmp_path / "a.shm"))
+        for i in range(200):
+            payload = bytes([i % 256]) * 1000
+            slot, gen, deltas = arena.write_many([payload])
+            assert arena.read_bytes(slot, 0, 1000, gen) == payload
+            arena.free(slot)
+        arena.close(unlink=True)
+
+    def test_pinned_region_separate_from_ring(self, tmp_path):
+        arena = Arena.create(1 << 16, path=str(tmp_path / "a.shm"))
+        pslot, pgen, _ = arena.write_many([b"pin" * 10], pinned=True)
+        for _ in range(50):  # ring churn must not disturb the pin
+            s, g, _ = arena.write_many([b"t" * 500])
+            arena.free(s)
+        assert arena.read_bytes(pslot, 0, 30, pgen) == b"pin" * 10
+        arena.close(unlink=True)
+
+    def test_attach_validates_header(self, tmp_path):
+        bad = tmp_path / "bad.shm"
+        bad.write_bytes(b"NOPE" + b"\0" * 100)
+        with pytest.raises(WireError, match="bad arena magic"):
+            Arena.attach(str(bad))
+
+    def test_reader_cannot_allocate(self, tmp_path):
+        arena = Arena.create(1 << 16, path=str(tmp_path / "a.shm"))
+        reader = Arena.attach(arena.path)
+        with pytest.raises(WireError, match="owner"):
+            reader.write_many([b"nope"])
+        reader.close()
+        arena.close(unlink=True)
+
+
+# ---------------------------------------------------------------------------
+# doorbell frames
+# ---------------------------------------------------------------------------
+
+
+class TestDoorbellWire:
+    def test_frame_roundtrip(self):
+        uid = b"u" * 16
+        frame = encode_frame(_KIND_EVAL, uid, b"body", trace_id=b"t" * 16)
+        kind, ruid, err, tid, off, eff = decode_frame(frame)
+        assert (kind, ruid, err, tid) == (_KIND_EVAL, uid, None, b"t" * 16)
+        assert eff is frame  # no chaos plan: the effective frame IS buf
+        assert frame[off:] == b"body"
+
+    def test_error_block_roundtrip(self):
+        frame = encode_frame(_KIND_REPLY, b"u" * 16, error="boom")
+        _k, _u, err, _t, _o, _f = decode_frame(frame)
+        assert err == "boom"
+
+    def test_unknown_kind_rejected(self):
+        frame = bytearray(encode_frame(_KIND_EVAL, b"u" * 16))
+        frame[5] = 200  # kind byte
+        with pytest.raises(WireError, match="unknown shm frame kind"):
+            decode_frame(bytes(frame))
+
+    def test_unknown_flag_rejected(self):
+        frame = bytearray(encode_frame(_KIND_EVAL, b"u" * 16))
+        frame[6] |= 0x40  # undeclared flag bit
+        with pytest.raises(WireError, match="unknown shm flag bits"):
+            decode_frame(bytes(frame))
+
+    def test_bad_magic_rejected(self):
+        with pytest.raises(WireError, match="bad shm magic"):
+            decode_frame(b"XXXX" + b"\0" * 30)
+
+    def test_desc_block_roundtrip(self):
+        descs = [
+            (64, 0, 1024, 7, np.dtype("<f8"), (128,)),
+            (64, 1024, 8, 7, np.dtype("<i4"), (2, 1)),
+        ]
+        buf = encode_descs(descs)
+        out, off = decode_descs(buf, 0)
+        assert off == len(buf)
+        assert out == descs
+
+    def test_truncated_desc_block_is_loud(self):
+        buf = encode_descs([(64, 0, 8, 1, np.dtype("<f8"), (1,))])
+        with pytest.raises(WireError, match="truncated"):
+            decode_descs(buf[:-3], 0)
+
+
+# ---------------------------------------------------------------------------
+# client/server e2e
+# ---------------------------------------------------------------------------
+
+
+class TestShmE2E:
+    def test_evaluate(self, client):
+        out = client.evaluate(np.array([1.0, 5.0]))
+        assert float(out[0]) == expected(1.0)
+        assert np.array_equal(out[1], np.array([4.0, -4.0]))
+        # Default copy=True returns owned, writable arrays.
+        out[1][0] = 99.0
+
+    def test_copy_false_returns_views(self, shm_node):
+        c = ShmArraysClient(*shm_node, copy=False)
+        try:
+            out = c.evaluate(np.array([1.0, 5.0]))
+            assert float(out[0]) == expected(1.0)
+            assert not out[1].flags.writeable
+        finally:
+            c.close()
+
+    def test_dtype_shape_layout_fidelity(self):
+        """An echo node proves byte-exact round-trips for 0-d arrays,
+        empty arrays, non-float dtypes, and non-contiguous (Fortran /
+        sliced) inputs — layout normalized once at encode entry."""
+
+        def echo(*arrays):
+            return [np.asarray(a) for a in arrays]
+
+        ports = []
+        threading.Thread(
+            target=serve_shm, args=(echo,),
+            kwargs=dict(ready_callback=ports.append), daemon=True,
+        ).start()
+        while not ports:
+            time.sleep(0.01)
+        c = ShmArraysClient("127.0.0.1", ports[0])
+        try:
+            cases = [
+                np.arange(6, dtype=np.float32).reshape(2, 3),
+                np.asarray(np.float64(2.5)),
+                np.array([], dtype=np.int32),
+                np.asfortranarray(
+                    np.arange(12, dtype=np.int64).reshape(3, 4)
+                ),
+                np.arange(20, dtype=np.float64)[::2],  # sliced view
+                np.zeros(3, dtype=[("a", "<f4"), ("b", "<i8")]),
+            ]
+            outs = c.evaluate(*cases)
+            for x, out in zip(cases, outs):
+                assert out.dtype == x.dtype
+                assert out.shape == x.shape
+                assert np.array_equal(out, x)
+        finally:
+            c.close()
+
+    def test_evaluate_many_pipelined_and_batched(self, client):
+        reqs = [(np.array([float(i), 5.0]),) for i in range(40)]
+        for batch in (False, True, "auto"):
+            res = client.evaluate_many(reqs, window=8, batch=batch)
+            for i in range(40):
+                assert float(res[i][0]) == expected(float(i))
+
+    def test_copy_false_windows_still_copy(self, shm_node):
+        """``copy=False`` is a single-evaluate contract: inside a
+        pipelined window, acks on later frames let the node recycle
+        reply slots earlier results still view — so window replies are
+        force-copied (round-9 review finding).  All values must stay
+        correct after the whole window settles."""
+        c = ShmArraysClient(*shm_node, copy=False)
+        try:
+            reqs = [(np.array([float(i), 5.0]),) for i in range(64)]
+            for batch in (False, True):
+                res = c.evaluate_many(reqs, window=4, batch=batch)
+                for i in range(64):
+                    assert float(res[i][0]) == expected(float(i))
+                    assert res[i][1].flags.owndata  # copied, not a view
+        finally:
+            c.close()
+
+    def test_truncated_batch_reply_is_wire_error(self, shm_node):
+        """A reply frame truncated past the header must classify as
+        WireError and close the connection — never a raw struct.error
+        (round-9 review finding)."""
+        plan = fi.FaultPlan(
+            [fi.FaultRule("truncate_frame", point="shm.recv", nth=2,
+                          cut_frac=0.2)],
+            seed=9,
+        )  # frame 1 is the ATTACH reply; frame 2 is the batch reply
+        c = ShmArraysClient(*shm_node, retries=0)
+        fi.install(plan)
+        try:
+            reqs = [(np.array([float(i), 5.0]),) for i in range(8)]
+            with pytest.raises(WireError):
+                c.evaluate_many(reqs, window=8, batch=True)
+            assert c._sock is None  # closed, not desynchronized
+        finally:
+            fi.uninstall()
+            c.close()
+
+    def test_pinned_arrays_move_zero_bytes(self, client):
+        """The second-and-later appearances of the SAME array object
+        ride pinned descriptors: the arena write counter stops
+        moving."""
+        from pytensor_federated_tpu.service.npwire import (
+            WIRE_BYTES_COPIED,
+        )
+
+        counter = WIRE_BYTES_COPIED.labels(lane="shm", stage="arena_write")
+        x = np.zeros(4096, np.float64)
+        client.evaluate(x)  # 1st: transient write
+        client.evaluate(x)  # 2nd: promotion write (pinned region)
+        before = counter.value
+        for _ in range(5):
+            out = client.evaluate(x)
+        assert float(out[0]) == float(-np.sum((x - 3.0) ** 2))
+        # Steady state: no request payload bytes moved at all (the
+        # reply side still writes its scalars server-side).
+        reply_bytes = 5 * (8 + x.nbytes)  # server reply writes
+        assert counter.value - before <= reply_bytes
+
+    def test_fresh_arrays_never_spuriously_pin(self, client):
+        """CPython recycles ids of freed per-call arrays constantly;
+        the weakref-verified hit counter must not promote UNRELATED
+        arrays that merely reuse an id (round-9 review finding) — a
+        fresh-params-every-call workload pins nothing."""
+        for i in range(200):
+            out = client.evaluate(np.array([float(i % 7), 5.0]))
+            assert float(out[0]) == expected(float(i % 7))
+        assert not client._pinned
+        assert len(client._pin_hits) <= 4096
+
+    def test_pin_arrays_false_disables_cache(self, shm_node):
+        c = ShmArraysClient(*shm_node, pin_arrays=False)
+        try:
+            x = np.zeros(16)
+            for _ in range(3):
+                c.evaluate(x)
+            assert not c._pinned
+        finally:
+            c.close()
+
+    def test_remote_error_no_retry_connection_survives(self, shm_node):
+        calls = []
+
+        def flaky(x):
+            calls.append(1)
+            if len(calls) == 1:
+                raise ValueError("poisoned input")
+            return quad_compute(x)
+
+        ports = []
+        threading.Thread(
+            target=serve_shm, args=(flaky,),
+            kwargs=dict(ready_callback=ports.append), daemon=True,
+        ).start()
+        while not ports:
+            time.sleep(0.01)
+        c = ShmArraysClient("127.0.0.1", ports[0])
+        try:
+            with pytest.raises(RemoteComputeError, match="poisoned"):
+                c.evaluate(np.array([1.0, 5.0]))
+            assert len(calls) == 1  # deterministic: no retry
+            out = c.evaluate(np.array([1.0, 5.0]))  # same connection
+            assert float(out[0]) == expected(1.0)
+        finally:
+            c.close()
+
+    def test_batch_per_item_error_isolation(self, shm_node):
+        def picky(x):
+            x = np.asarray(x)
+            if float(x[0]) == 7.0:
+                raise ValueError("item poisoned")
+            return quad_compute(x)
+
+        ports = []
+        threading.Thread(
+            target=serve_shm, args=(picky,),
+            kwargs=dict(ready_callback=ports.append), daemon=True,
+        ).start()
+        while not ports:
+            time.sleep(0.01)
+        c = ShmArraysClient("127.0.0.1", ports[0])
+        try:
+            reqs = [(np.array([float(i), 5.0]),) for i in range(12)]
+            with pytest.raises(RemoteComputeError, match="item poisoned"):
+                c.evaluate_many(reqs, window=12, batch=True)
+            # The connection stays correlated for the next window.
+            ok = c.evaluate_many(reqs[:6], window=6, batch=True)
+            for i in range(6):
+                assert float(ok[i][0]) == expected(float(i))
+        finally:
+            c.close()
+
+    def test_evaluate_many_partial_dead_node(self):
+        """SIGKILL mid-window: partial results + a transport exc, the
+        pool failover contract."""
+        import multiprocessing as mp
+
+        ctx = mp.get_context("spawn")
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        proc = ctx.Process(
+            target=_serve_shm_slow_node, args=(port,), daemon=True
+        )
+        proc.start()
+        try:
+            deadline = time.time() + 60
+            c = ShmArraysClient(
+                "127.0.0.1", port, retries=0,
+                connect_timeout_s=2.0, connect_retries=20,
+                connect_backoff_s=0.2,
+            )
+            reqs = [(np.array([float(i), 5.0]),) for i in range(16)]
+            # Warm one call so the node is definitely serving.
+            while time.time() < deadline:
+                try:
+                    c.evaluate(np.array([0.0, 5.0]))
+                    break
+                except (ConnectionError, OSError):
+                    time.sleep(0.2)
+            killer = threading.Timer(0.15, proc.kill)
+            killer.start()
+            res, exc = c.evaluate_many_partial(reqs, window=4)
+            killer.cancel()
+            assert exc is not None  # the kill surfaced as transport
+            served = [r for r in res if r is not None]
+            for i, r in enumerate(res):
+                if r is not None:
+                    assert float(r[0]) == expected(float(i))
+            assert len(served) < len(reqs)
+            c.close()
+        finally:
+            if proc.is_alive():
+                proc.kill()
+            proc.join(timeout=10)
+
+    def test_get_load_and_ping(self, client):
+        load = client.get_load()
+        assert load is not None and load["transport"] == "shm"
+        assert load["batch"]["max_batch"] >= 1
+        rtt = client.ping()
+        assert 0 < rtt < 5.0
+
+    def test_ping_corrupt_reply_closes_not_leaks(self, shm_node):
+        """An undecodable PONG closes the connection instead of
+        leaking the ping's transient slot into the FIFO free order
+        (round-9 review finding): the next call works cleanly."""
+        plan = fi.FaultPlan(
+            [fi.FaultRule("corrupt_bytes", point="shm.recv", nth=2)],
+            seed=8,
+        )  # nth=2: the ATTACH reply is frame 1, the PONG is frame 2
+        c = ShmArraysClient(*shm_node, retries=0)
+        fi.install(plan)
+        try:
+            with pytest.raises((WireError, RuntimeError)):
+                c.ping()
+            assert c._sock is None  # closed, not desynchronized
+        finally:
+            fi.uninstall()
+        out = c.evaluate(np.array([1.0, 5.0]))  # fresh attach, clean
+        assert float(out[0]) == expected(1.0)
+        c.close()
+
+    def test_npwire_probe_fallback(self, shm_node):
+        """The pool's zero-item batch probe works against the doorbell
+        (the mixed-pool health-check lane)."""
+        host, port = shm_node
+        uid = b"p" * 16
+        frame = encode_batch([], uuid=uid)
+        with socket.create_connection((host, port), timeout=5) as s:
+            s.sendall(struct.pack("<I", len(frame)) + frame)
+            hdr = s.recv(4)
+            (n,) = struct.unpack("<I", hdr)
+            payload = b""
+            while len(payload) < n:
+                payload += s.recv(n - len(payload))
+        assert is_batch_frame(payload)
+        items, ruid, err, _t, _sp = decode_batch(payload)
+        assert ruid == uid and err is None and items == []
+
+
+def _serve_shm_slow_node(port):
+    """Module-level (spawn target): an shm node whose compute sleeps,
+    so a SIGKILL lands mid-window."""
+    import time as _time
+
+    import numpy as _np
+
+    from pytensor_federated_tpu.service.shm import serve_shm as _serve
+
+    def compute(x):
+        _time.sleep(0.05)
+        x = _np.asarray(x)
+        return [
+            _np.asarray(-_np.sum((x - 3.0) ** 2)),
+            (-2.0 * (x - 3.0)).astype(x.dtype),
+        ]
+
+    _serve(compute, "127.0.0.1", port)
+
+
+# ---------------------------------------------------------------------------
+# pool integration
+# ---------------------------------------------------------------------------
+
+
+class TestShmPool:
+    def test_mixed_pool_probe_route_failover(self, shm_node):
+        from pytensor_federated_tpu.routing import (
+            NodePool,
+            PooledArraysClient,
+        )
+        from pytensor_federated_tpu.service.tcp import serve_tcp_once
+
+        tports = []
+        threading.Thread(
+            target=serve_tcp_once, args=(quad_compute,),
+            kwargs=dict(ready_callback=tports.append, concurrent=True),
+            daemon=True,
+        ).start()
+        while not tports:
+            time.sleep(0.01)
+        pool = NodePool(transport="tcp", probe_timeout_s=2.0)
+        pool.add_replica(*shm_node, transport="shm")
+        pool.add_replica("127.0.0.1", tports[0])
+        try:
+            assert pool.probe_once() == 2
+            kinds = {r.transport for r in pool.replicas}
+            assert kinds == {"shm", "tcp"}
+            client = PooledArraysClient(pool)
+            reqs = [(np.array([float(i), 5.0]),) for i in range(24)]
+            res = client.evaluate_many(reqs, window=6)
+            for i in range(24):
+                assert float(res[i][0]) == expected(float(i))
+        finally:
+            pool.close()
+
+
+    def test_mixed_pool_kwargs_stay_per_transport(self, shm_node):
+        """Pool-level client_kwargs target the pool's OWN transport
+        class; a mixed-in replica of another transport must not
+        inherit them (round-9 review finding: a grpc codec= kwarg
+        crashed the shm constructor)."""
+        from pytensor_federated_tpu.routing import NodePool
+
+        pool = NodePool(
+            transport="grpc", client_kwargs={"codec": "npproto"}
+        )
+        replica = pool.add_replica(*shm_node, transport="shm")
+        try:
+            client = pool.client_for(replica)  # must not TypeError
+            assert type(client).__name__ == "ShmArraysClient"
+            out = client.evaluate(np.array([1.0, 5.0]))
+            assert float(out[0]) == expected(1.0)
+        finally:
+            pool.close()
+        # Per-replica kwargs override explicitly when wanted.
+        pool2 = NodePool(transport="tcp")
+        try:
+            r2 = pool2.add_replica(
+                *shm_node, transport="shm",
+                client_kwargs={"pin_arrays": False},
+            )
+            assert pool2.client_for(r2).pin_arrays is False
+        finally:
+            pool2.close()
+
+    def test_conflicting_reregistration_raises(self, shm_node):
+        from pytensor_federated_tpu.routing import NodePool
+
+        pool = NodePool(transport="tcp")
+        try:
+            pool.add_replica(*shm_node, transport="shm")
+            pool.add_replica(*shm_node, transport="shm")  # idempotent
+            with pytest.raises(ValueError, match="already registered"):
+                pool.add_replica(*shm_node, transport="tcp")
+        finally:
+            pool.close()
+
+    def test_raw_ack_frame_lane(self, shm_node):
+        """The ACK doorbell kind at the wire level: the server
+        processes it with NO reply, and the connection stays
+        correlated for the next EVAL (windows send one at their
+        end)."""
+        host, port = shm_node
+        c = ShmArraysClient(host, port)
+        try:
+            reqs = [(np.array([float(i), 5.0]),) for i in range(6)]
+            c.evaluate_many(reqs, window=3, batch=False)  # ends in ACK
+            out = c.evaluate(np.array([2.0, 5.0]))  # still correlated
+            assert float(out[0]) == expected(2.0)
+        finally:
+            c.close()
+
+
+def test_fast_uuid_reseeds_after_fork():
+    """A fork-started worker must not replay the parent's id stream
+    (round-9 review finding): the prefix and counter re-derive in the
+    child via os.register_at_fork."""
+    import os as _os
+
+    if not hasattr(_os, "fork"):
+        pytest.skip("no fork on this platform")
+    from pytensor_federated_tpu.service.npwire import fast_uuid
+
+    fast_uuid()  # advance the parent counter
+    r, w = _os.pipe()
+    pid = _os.fork()
+    if pid == 0:  # child
+        try:
+            _os.write(w, fast_uuid())
+        finally:
+            _os._exit(0)
+    child_uuid = _os.read(r, 16)
+    _os.close(r)
+    _os.close(w)
+    _os.waitpid(pid, 0)
+    parent_next = fast_uuid()
+    assert len(child_uuid) == 16
+    assert child_uuid[:12] != parent_next[:12]  # fresh child prefix
+
+
+# ---------------------------------------------------------------------------
+# fault-injection seams (the four shm fault scenarios, classified loud)
+# ---------------------------------------------------------------------------
+
+
+class TestShmChaos:
+    def _client(self, shm_node, **kw):
+        return ShmArraysClient(*shm_node, retries=0, **kw)
+
+    def test_corrupt_descriptor_classified(self, shm_node):
+        plan = fi.FaultPlan(
+            [fi.FaultRule("corrupt_descriptor", point="shm.descriptor",
+                          nth=1)],
+            seed=3,
+        )
+        fi.install(plan)
+        c = self._client(shm_node)
+        try:
+            with pytest.raises(
+                (RemoteComputeError, WireError, RuntimeError,
+                 ConnectionError)
+            ):
+                c.evaluate(np.array([1.0, 5.0]))
+            assert plan.total_fires == 1
+        finally:
+            fi.uninstall()
+            c.close()
+
+    def test_client_side_truncated_request_slot_classified(self, shm_node):
+        """The shm.arena.write point (client request-arena writes):
+        a torn REQUEST slot is answered with an in-band decode error
+        — classified loud, connection survives."""
+        plan = fi.FaultPlan(
+            [fi.FaultRule("truncate_slot", point="shm.arena.write",
+                          nth=1)],
+            seed=11,
+        )
+        fi.install(plan)
+        c = self._client(shm_node)
+        try:
+            with pytest.raises(RemoteComputeError, match="torn slot"):
+                c.evaluate(np.array([1.0, 5.0]))
+            assert plan.total_fires == 1
+        finally:
+            fi.uninstall()
+        out = c.evaluate(np.array([1.0, 5.0]))  # same connection
+        assert float(out[0]) == expected(1.0)
+        c.close()
+
+    def test_truncated_slot_classified(self, shm_node):
+        plan = fi.FaultPlan(
+            [fi.FaultRule("truncate_slot", point="shm.arena.reply",
+                          nth=1)],
+            seed=4,
+        )
+        fi.install(plan)
+        c = self._client(shm_node)
+        try:
+            with pytest.raises(WireError, match="torn slot"):
+                c.evaluate(np.array([1.0, 5.0]))
+        finally:
+            fi.uninstall()
+            c.close()
+
+    def test_stale_generation_classified(self, shm_node):
+        plan = fi.FaultPlan(
+            [fi.FaultRule("stale_generation", point="shm.arena.reply",
+                          nth=1)],
+            seed=5,
+        )
+        fi.install(plan)
+        c = self._client(shm_node)
+        try:
+            with pytest.raises(WireError, match="stale descriptor"):
+                c.evaluate(np.array([1.0, 5.0]))
+        finally:
+            fi.uninstall()
+            c.close()
+
+    def test_doorbell_disconnect_classified(self, shm_node):
+        plan = fi.FaultPlan(
+            [fi.FaultRule("disconnect", point="shm.send", nth=1)],
+            seed=6,
+        )
+        fi.install(plan)
+        c = self._client(shm_node)
+        try:
+            with pytest.raises(ConnectionError):
+                c.evaluate(np.array([1.0, 5.0]))
+        finally:
+            fi.uninstall()
+            c.close()
+
+    def test_recovery_after_chaos(self, shm_node):
+        """After a doorbell disconnect, the retrying client re-attaches
+        a fresh arena pair and the value is correct."""
+        plan = fi.FaultPlan(
+            [fi.FaultRule("disconnect", point="shm.send", nth=1)],
+            seed=7,
+        )
+        fi.install(plan)
+        c = ShmArraysClient(*shm_node, retries=2)
+        try:
+            out = c.evaluate(np.array([1.0, 5.0]))
+            assert float(out[0]) == expected(1.0)
+            assert plan.total_fires == 1
+        finally:
+            fi.uninstall()
+            c.close()

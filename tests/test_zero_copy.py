@@ -1,0 +1,232 @@
+"""Tier B of ISSUE 9: scatter/gather npwire + sendmsg TCP paths.
+
+Satellites pinned here:
+
+- ``_send_frame`` no longer copies the payload to prepend its length —
+  header and payload ride one ``sendmsg`` vector.  Frame integrity is
+  regression-tested for small frames AND frames far beyond SO_SNDBUF
+  (where ``sendmsg`` returns partial counts and the resend arithmetic
+  must slice buffers by BYTES), plus vectors longer than the IOV_MAX
+  chunk.
+- layout normalization happens ONCE at encode entry: Fortran-ordered
+  and sliced inputs round-trip byte-identically to their contiguous
+  copies on BOTH codecs (npwire and npproto).
+- ``encode_arrays_sg``'s buffer vector joins byte-identical to the
+  contiguous encoder, and ``copy=False`` decode returns read-only
+  views into the frame with zero payload copies.
+"""
+
+import socket
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from pytensor_federated_tpu.service import npproto_codec
+from pytensor_federated_tpu.service.npwire import (
+    WIRE_BYTES_COPIED,
+    decode_arrays,
+    decode_arrays_all,
+    encode_arrays,
+    encode_arrays_sg,
+    fast_uuid,
+    sg_nbytes,
+)
+from pytensor_federated_tpu.service.tcp import (
+    _IOV_CHUNK,
+    _recv_frame,
+    _send_frame,
+    _send_frame_vec,
+    _sendmsg_all,
+)
+
+
+def _recv_thread(sock, out):
+    try:
+        out.append(_recv_frame(sock))
+    except Exception as e:  # surfaced by the asserting test thread
+        out.append(e)
+
+
+def _roundtrip_frame(payload_parts, nbytes=None):
+    """Send one frame through a socketpair with a SMALL send buffer so
+    partial sends genuinely happen; return the received frame."""
+    a, b = socket.socketpair()
+    try:
+        # Shrink the send buffer as far as the kernel allows: the
+        # >SO_SNDBUF case is the partial-send regression this guards.
+        a.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 4096)
+        out = []
+        t = threading.Thread(target=_recv_thread, args=(b, out))
+        t.start()
+        if isinstance(payload_parts, bytes):
+            _send_frame(a, payload_parts)
+        else:
+            _send_frame_vec(a, payload_parts, nbytes)
+        t.join(timeout=30)
+        assert not t.is_alive(), "receiver hung"
+        (got,) = out
+        if isinstance(got, Exception):
+            raise got
+        return got
+    finally:
+        a.close()
+        b.close()
+
+
+class TestSendmsgFrames:
+    def test_small_frame_integrity(self):
+        payload = b"tiny"
+        assert _roundtrip_frame(payload) == payload
+
+    def test_beyond_sndbuf_frame_integrity(self):
+        # Far past the 4 KiB send buffer: many partial sendmsg returns.
+        rng = np.random.default_rng(0)
+        payload = rng.integers(0, 256, 3_000_000, np.uint8).tobytes()
+        assert _roundtrip_frame(payload) == payload
+
+    def test_vectored_frame_matches_joined(self):
+        arrays = [
+            np.arange(100_000, dtype=np.float64),
+            np.arange(7, dtype=np.int32),
+            np.asarray(np.float32(3.5)),
+        ]
+        uid = fast_uuid()
+        parts = encode_arrays_sg(arrays, uuid=uid)
+        joined = encode_arrays(arrays, uuid=uid)
+        assert b"".join(parts) == joined
+        got = _roundtrip_frame(parts, sg_nbytes(parts))
+        assert got == joined
+        outs, ruid, err = decode_arrays(got)
+        assert ruid == uid and err is None
+        for x, o in zip(arrays, outs):
+            assert np.array_equal(x, o) and o.dtype == x.dtype
+
+    def test_more_buffers_than_iov_chunk(self):
+        parts = [bytes([i % 256]) * 3 for i in range(_IOV_CHUNK * 2 + 5)]
+        a, b = socket.socketpair()
+        try:
+            out = []
+
+            def read_all(n):
+                buf = b""
+                while len(buf) < n:
+                    chunk = b.recv(n - len(buf))
+                    assert chunk
+                    buf += chunk
+                out.append(buf)
+
+            total = sum(len(p) for p in parts)
+            t = threading.Thread(target=read_all, args=(total,))
+            t.start()
+            _sendmsg_all(a, parts)
+            t.join(timeout=30)
+            assert out[0] == b"".join(parts)
+        finally:
+            a.close()
+            b.close()
+
+
+class TestLayoutNormalization:
+    """Satellite: non-contiguous inputs normalize once at encode entry
+    and round-trip byte-identically on BOTH codecs."""
+
+    CASES = [
+        np.asfortranarray(np.arange(24, dtype=np.float64).reshape(4, 6)),
+        np.arange(40, dtype=np.float32)[::2],  # strided slice
+        np.arange(60, dtype=np.int64).reshape(5, 12)[1:4, 2:9],
+        np.asfortranarray(
+            np.arange(8, dtype=np.complex128).reshape(2, 4)
+        ),
+    ]
+
+    @pytest.mark.parametrize("case", range(len(CASES)))
+    def test_npwire_roundtrip(self, case):
+        x = self.CASES[case]
+        contig = np.ascontiguousarray(x)
+        enc_view = encode_arrays([x], uuid=b"u" * 16)
+        enc_contig = encode_arrays([contig], uuid=b"u" * 16)
+        assert enc_view == enc_contig  # byte-identical frames
+        (out,), _u, _e = decode_arrays(enc_view)
+        assert np.array_equal(out, x) and out.dtype == x.dtype
+
+    @pytest.mark.parametrize("case", range(len(CASES)))
+    def test_npproto_roundtrip(self, case):
+        x = self.CASES[case]
+        enc = npproto_codec.encode_arrays_msg([x], uuid="u" * 16)
+        arrays, _uuid = npproto_codec.decode_arrays_msg(enc)
+        assert np.array_equal(arrays[0], x)
+        assert arrays[0].dtype == x.dtype
+
+    def test_sg_keeps_contiguous_inputs_as_views(self):
+        """An already-contiguous array ships as a zero-copy view (no
+        layout copy counted); a strided one pays exactly one."""
+        layout = WIRE_BYTES_COPIED.labels(
+            lane="npwire", stage="encode_layout"
+        )
+        contig = np.arange(1024, dtype=np.float64)
+        before = layout.value
+        parts = encode_arrays_sg([contig], uuid=b"u" * 16)
+        assert layout.value == before
+        views = [p for p in parts if isinstance(p, memoryview)]
+        assert views and views[0].obj is contig
+        strided = contig[::2]
+        before = layout.value
+        encode_arrays_sg([strided], uuid=b"u" * 16)
+        assert layout.value - before == strided.nbytes
+
+
+class TestDecodeViews:
+    def test_copy_false_returns_readonly_views(self):
+        x = np.arange(256, dtype=np.float64)
+        frame = encode_arrays([x], uuid=b"u" * 16)
+        (out,), _u, _e, _t, _s = decode_arrays_all(frame, copy=False)
+        assert np.array_equal(out, x)
+        assert not out.flags.writeable
+        assert not out.flags.owndata  # a view into the frame
+
+    def test_copy_true_is_owned_single_copy(self):
+        counter = WIRE_BYTES_COPIED.labels(
+            lane="npwire", stage="decode_copy"
+        )
+        x = np.arange(256, dtype=np.float64)
+        frame = encode_arrays([x], uuid=b"u" * 16)
+        before = counter.value
+        (out,), _u, _e, _t, _s = decode_arrays_all(frame, copy=True)
+        assert counter.value - before == x.nbytes  # ONE copy, not two
+        assert out.flags.writeable
+        out[0] = 1e9  # owned: mutation cannot touch the frame
+        (again,), _u2, _e2 = decode_arrays(frame)
+        assert again[0] == 0.0
+
+    def test_copy_false_truncation_still_loud(self):
+        from pytensor_federated_tpu.service.npwire import WireError
+
+        x = np.arange(64, dtype=np.float64)
+        frame = encode_arrays([x], uuid=b"u" * 16)
+        with pytest.raises(WireError):
+            decode_arrays_all(frame[:-8], copy=False)
+
+
+class TestFastUuid:
+    def test_unique_and_16_bytes(self):
+        ids = {fast_uuid() for _ in range(10_000)}
+        assert len(ids) == 10_000
+        assert all(len(u) == 16 for u in ids)
+
+    def test_thread_safety(self):
+        out = []
+        lock = threading.Lock()
+
+        def mint():
+            local = [fast_uuid() for _ in range(2_000)]
+            with lock:
+                out.extend(local)
+
+        threads = [threading.Thread(target=mint) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(set(out)) == len(out)

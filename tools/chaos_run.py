@@ -134,6 +134,27 @@ def _serve_tcp_node(port: int, delay: float) -> None:
     serve_tcp_once(compute, "127.0.0.1", port, concurrent=True)
 
 
+def _serve_shm_node(port: int, delay: float) -> None:
+    """The zero-copy lane's replica: shm doorbell + arena pair
+    (concurrent by default, so pool probes coexist with the driver's
+    held connection)."""
+    import time as _time
+
+    import numpy as _np
+
+    def compute(x):
+        _time.sleep(COMPUTE_DELAY_S if delay is None else delay)
+        x = _np.asarray(x)
+        return [
+            _np.asarray(-_np.sum((x - 3.0) ** 2)),
+            (-2.0 * (x - 3.0)).astype(x.dtype),
+        ]
+
+    from pytensor_federated_tpu.service.shm import serve_shm
+
+    serve_shm(compute, "127.0.0.1", port)
+
+
 def _free_ports(n: int) -> list:
     socks, ports = [], []
     for _ in range(n):
@@ -150,7 +171,11 @@ def _spawn_node(transport: str, port: int, plan_json=None):
     """Start one replica subprocess; node-side fault plans ride the
     environment (PFTPU_FAULT_PLAN) into the child — the cross-process
     activation lane under test."""
-    target = _serve_grpc_node if transport == "grpc" else _serve_tcp_node
+    target = {
+        "grpc": _serve_grpc_node,
+        "tcp": _serve_tcp_node,
+        "shm": _serve_shm_node,
+    }[transport]
     saved = os.environ.get(fi.runtime.ENV_VAR)
     if plan_json is not None:
         os.environ[fi.runtime.ENV_VAR] = plan_json
@@ -183,7 +208,8 @@ async def _wait_nodes_up_async(
                 return
             await asyncio.sleep(0.2)
         raise TimeoutError(f"nodes on {ports} failed to start")
-    # TCP lane: a fresh connection proves liveness.
+    # TCP/shm lanes: a fresh connection proves liveness (the shm
+    # doorbell is a TCP accept loop too).
     deadline = time.time() + timeout
     pending = set(ports)
     while pending and time.time() < deadline:
@@ -208,6 +234,27 @@ def _wait_nodes_up(transport: str, ports, timeout: float = 90.0) -> None:
 # and every rule carries max_fires — chaos that cannot terminate would
 # make the no-hang invariant untestable.
 def _driver_templates(transport: str):
+    if transport == "shm":
+        # The zero-copy lane: doorbell byte faults plus the four
+        # arena-specific scenarios (ISSUE 9 — corrupt descriptor,
+        # truncated slot, stale generation, doorbell disconnect).
+        return [
+            ("delay", dict(point="shm.send", delay_s=0.02, max_fires=3)),
+            ("disconnect", dict(point="shm.send", max_fires=2)),
+            ("drop", dict(point="shm.send", max_fires=2)),
+            ("corrupt_bytes", dict(point="shm.send", max_fires=1)),
+            ("truncate_frame", dict(point="shm.send", max_fires=1)),
+            ("disconnect", dict(point="shm.recv", max_fires=1)),
+            ("corrupt_bytes", dict(point="shm.decode", max_fires=1)),
+            ("corrupt_descriptor",
+             dict(point="shm.descriptor", max_fires=1)),
+            ("truncate_slot",
+             dict(point="shm.arena.write", max_fires=1)),
+            ("stale_generation",
+             dict(point="shm.arena.write", max_fires=1)),
+            ("stall", dict(point="shm.send", stall_s=1.0, max_fires=1)),
+            ("drop", dict(point="pool.probe", max_fires=2)),
+        ]
     send = "tcp.send" if transport == "tcp" else "grpc.send"
     recv = "tcp.recv" if transport == "tcp" else "grpc.recv"
     return [
@@ -225,6 +272,27 @@ def _driver_templates(transport: str):
 
 
 def _node_templates(transport: str):
+    if transport == "shm":
+        # Node-side arena faults: the torn-slot and recycled-slot
+        # scenarios land on the REPLY write, where only the node can
+        # reach the slot it controls.
+        return [
+            ("compute_error", dict(point="shm.compute", max_fires=1)),
+            ("delay", dict(point="shm.compute", delay_s=0.05,
+                           max_fires=2)),
+            ("stall", dict(point="shm.compute", stall_s=3.0,
+                           max_fires=1)),
+            ("drop", dict(point="shm.server.send", max_fires=1)),
+            ("duplicate_reply", dict(point="shm.server.send",
+                                     max_fires=1)),
+            ("truncate_frame", dict(point="shm.server.send",
+                                    max_fires=1)),
+            ("truncate_slot", dict(point="shm.arena.reply",
+                                   max_fires=1)),
+            ("stale_generation", dict(point="shm.arena.reply",
+                                      max_fires=1)),
+            ("kill_process", dict(point="shm.compute", max_fires=1)),
+        ]
     reply = "tcp.server.send" if transport == "tcp" else "grpc.server.reply"
     rules = [
         ("compute_error", dict(point="server.compute", max_fires=1)),
@@ -558,7 +626,10 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=None,
                     help="run exactly one seed (replay a failure)")
     ap.add_argument("--base-seed", type=int, default=0)
-    ap.add_argument("--transport", choices=("grpc", "tcp"), default="grpc")
+    ap.add_argument("--transport", "--lane", dest="transport",
+                    choices=("grpc", "tcp", "shm"), default="grpc",
+                    help="transport lane under chaos (--lane is an "
+                    "alias; 'shm' runs the zero-copy arena lane)")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
 

@@ -42,6 +42,11 @@ MODULES = [
     # Wire-constant registry (ISSUE 7): the declared source graftlint's
     # wire-registry rule checks every implementation against.
     "pytensor_federated_tpu.service.wire_registry",
+    # Zero-copy shm transport (ISSUE 9): the arena's slot/generation
+    # protocol and the doorbell client/server pair are public surface
+    # — a colocated deployment reads both.
+    "pytensor_federated_tpu.service.arena",
+    "pytensor_federated_tpu.service.shm",
     # Replica-pool routing (ISSUE 4): the package __init__ re-exports
     # the whole public surface, and the per-module docs cover the
     # pieces a deployment tunes (breaker knobs, policies).
