@@ -328,6 +328,74 @@ def faultinject_overhead(n_guard: int = 200_000, n_wire: int = 4_000) -> dict:
     }
 
 
+def deadline_overhead(n_check: int = 200_000, n_wire: int = 4_000) -> dict:
+    """Disabled-path cost gate for deadline propagation (ISSUE 10
+    acceptance: with no deadline bound, the machinery must be
+    indistinguishable from the pre-deadline build — same shape as the
+    ``faultinject_overhead`` gate).
+
+    With no ambient deadline, the whole per-call cost is ONE
+    contextvar read on the encode path (``deadline.wire_budget``) plus
+    a flag test per decode; the wire stays byte-identical.  Two
+    measurements, best-of-3 interleaved like the other gates:
+
+    - ``check_ns``: ``wire_budget()`` with no deadline bound — the
+      exact expression every client encode executes.
+    - ``wire_roundtrip_us`` / ``wire_deadline_us``: one npwire
+      encode+decode of a small frame without and WITH a deadline
+      stamped, so the enabled-path field cost is visible alongside.
+
+    PASSES when the projected per-RPC cost of the disabled path — the
+    check at the ~4 deadline-aware choke points an RPC crosses
+    (client encode + bounded read, server admission peek + scope
+    bind) — stays under 1% of the ~110 us grpc.aio floor
+    (docs/performance.md "Host lane budget").
+    """
+    from pytensor_federated_tpu.service import deadline as dl
+    from pytensor_federated_tpu.service.npwire import (
+        decode_arrays_all,
+        encode_arrays,
+        peek_deadline,
+    )
+
+    assert dl.remaining_s() is None  # the gate measures the OFF path
+    x = np.zeros(8, np.float32)
+
+    def check_loop() -> float:
+        t0 = time.perf_counter()
+        for _ in range(n_check):
+            if dl.wire_budget() is not None:  # the clients' exact guard
+                raise AssertionError("unreachable")
+        return (time.perf_counter() - t0) / n_check
+
+    def wire_loop(deadline_s) -> float:
+        t0 = time.perf_counter()
+        for _ in range(n_wire):
+            buf = encode_arrays(
+                [x], uuid=b"b" * 16, deadline_s=deadline_s
+            )
+            peek_deadline(buf)
+            decode_arrays_all(buf)
+        return (time.perf_counter() - t0) / n_wire
+
+    check_s = wire_s = wire_dl_s = float("inf")
+    for _ in range(3):
+        check_s = min(check_s, check_loop())
+        wire_s = min(wire_s, wire_loop(None))
+        wire_dl_s = min(wire_dl_s, wire_loop(5.0))
+    check_sites_per_rpc = 4
+    rpc_floor_s = 110e-6  # grpc.aio per-call floor, docs/performance.md
+    overhead_frac = (check_s * check_sites_per_rpc) / rpc_floor_s
+    return {
+        "check_ns": round(check_s * 1e9, 2),
+        "wire_roundtrip_us": round(wire_s * 1e6, 2),
+        "wire_deadline_us": round(wire_dl_s * 1e6, 2),
+        "check_sites_per_rpc": check_sites_per_rpc,
+        "overhead_frac_of_rpc_floor": round(overhead_frac, 6),
+        "pass": bool(overhead_frac < 0.01 and check_s < 2e-6),
+    }
+
+
 def shm_overhead(n_pings: int = 300) -> dict:
     """Idle gate for the zero-copy shm transport (ISSUE 9): one
     doorbell round-trip with an EMPTY arena write — slot allocate +
@@ -817,6 +885,11 @@ def main():
     except Exception as e:  # same invariant
         shm_gate = {"error": f"{type(e).__name__}: {e}", "pass": False}
 
+    try:
+        deadline_gate = deadline_overhead()
+    except Exception as e:  # same invariant
+        deadline_gate = {"error": f"{type(e).__name__}: {e}", "pass": False}
+
     # The shm race lane's node is no longer needed once measurement
     # and gates are done (the gates spin their own in-process node).
     if shm_client is not None:
@@ -845,6 +918,7 @@ def main():
                 "batcher_overhead": batcher,
                 "faultinject_overhead": fault_shims,
                 "shm_overhead": shm_gate,
+                "deadline_overhead": deadline_gate,
                 **flop_extra,
             }
         )

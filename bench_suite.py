@@ -1835,6 +1835,195 @@ def main():
 
     guard("colocated shm vs cpp-tcp-batched", _c15)
 
+    # 16. Overload-protected serving (ISSUE 10): 2x-oversubscribed
+    # concurrent callers against a 2-replica pool whose second member
+    # is WEDGED-ish (serial node, multi-second compute — the "one slow
+    # replica pins the whole window" failure the deadline machinery
+    # exists for).  The PROTECTED lane binds a per-call deadline, so a
+    # call that lands on the stalled replica is shed inside its budget
+    # and the caller keeps going; the UNPROTECTED control is the exact
+    # same load with no deadline — callers block behind the stalled
+    # replica's growing queue, and goodput collapses.  Acceptance:
+    # protected goodput >= 2x the unprotected control AND the
+    # protected lane's successful-call p99 holds the SLO.
+    def _c16():
+        import asyncio
+        import multiprocessing as mp
+        import socket
+        import time as _time
+
+        from pytensor_federated_tpu.routing import (
+            NodePool,
+            PooledArraysClient,
+        )
+        from pytensor_federated_tpu.service import get_loads_async
+        from pytensor_federated_tpu.service.deadline import (
+            DeadlineExceeded,
+            deadline_scope,
+        )
+
+        def free_port():
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                return s.getsockname()[1]
+
+        slow_s = 2.0          # the stalled replica's serial compute
+        deadline_s = 0.12     # per-call budget (the SLO)
+        p99_slo_s = 0.10      # successful calls must stay under this
+        n_clients = 8         # 2x the live capacity (1 healthy node)
+        window_s = 5.0
+        fast_port, slow_port = free_port(), free_port()
+        ctx = mp.get_context("spawn")
+        procs = [
+            ctx.Process(
+                target=_bench_serve_node, args=(fast_port,), daemon=True
+            ),
+            ctx.Process(
+                target=_bench_serve_slow_node,
+                args=(slow_port, slow_s),
+                daemon=True,
+            ),
+        ]
+        for p in procs:
+            p.start()
+        try:
+            deadline_up = _time.time() + 60.0
+
+            async def wait_up():
+                while _time.time() < deadline_up:
+                    loads = await get_loads_async(
+                        [("127.0.0.1", fast_port),
+                         ("127.0.0.1", slow_port)],
+                        timeout=1.0,
+                    )
+                    if all(l is not None for l in loads):
+                        return
+                    await asyncio.sleep(0.2)
+                raise TimeoutError("overload bench nodes did not come up")
+
+            asyncio.run(wait_up())
+            x = np.zeros(3, np.float32)
+
+            async def drive(protected):
+                # round_robin on purpose: the config measures the
+                # PROTECTION, so both lanes are forced to keep facing
+                # the stalled replica instead of letting EWMA routing
+                # hide it (config 13 already rates the routing).
+                pool = NodePool(
+                    [("127.0.0.1", fast_port),
+                     ("127.0.0.1", slow_port)],
+                    policy="round_robin",
+                    client_kwargs=dict(use_stream=False),
+                )
+                client = PooledArraysClient(pool)
+                stop = _time.monotonic() + window_s
+                ok_lat = []
+                n_shed = 0
+
+                async def one():
+                    nonlocal n_shed
+                    t0 = _time.perf_counter()
+                    try:
+                        if protected:
+                            with deadline_scope(deadline_s):
+                                await client.evaluate_async(x)
+                        else:
+                            await client.evaluate_async(x)
+                    except DeadlineExceeded:
+                        n_shed += 1
+                    else:
+                        ok_lat.append(_time.perf_counter() - t0)
+
+                async def task():
+                    while _time.monotonic() < stop:
+                        await one()
+
+                t0 = _time.perf_counter()
+                jobs = [
+                    asyncio.ensure_future(task())
+                    for _ in range(n_clients)
+                ]
+                # Bounded drain: unprotected callers can sit in multi-
+                # second queues past the window; give them one queue
+                # depth of slack, then cancel (client-side cancel of a
+                # unary RPC — the collapse is already measured).
+                done, pending = await asyncio.wait(
+                    jobs, timeout=window_s + n_clients * slow_s + 10.0
+                )
+                for j in pending:
+                    j.cancel()
+                if pending:
+                    await asyncio.wait(pending, timeout=10.0)
+                wall = _time.perf_counter() - t0
+                pool.close()
+                goodput = len(ok_lat) / wall
+                ok_lat.sort()
+                p99 = (
+                    ok_lat[max(0, int(0.99 * len(ok_lat)) - 1)]
+                    if ok_lat
+                    else float("inf")
+                )
+                return goodput, p99, n_shed, len(ok_lat)
+
+            async def both():
+                prot = await drive(True)
+                unprot = await drive(False)
+                return prot, unprot
+
+            (
+                (rate_prot, p99_prot, n_shed, n_ok_prot),
+                (rate_unprot, p99_unprot, _sh, n_ok_unprot),
+            ) = asyncio.run(both())
+            print(
+                f"# overload lanes: protected {rate_prot:,.1f} ok/s "
+                f"(p99 {1e3 * p99_prot:.1f} ms, {n_shed} shed), "
+                f"unprotected control {rate_unprot:,.1f} ok/s "
+                f"(p99 {1e3 * p99_unprot:.1f} ms)",
+                file=sys.stderr,
+            )
+            record(
+                "overload-protected serving (2x oversubscribed, "
+                "1 of 2 replicas stalled)",
+                rate_prot,
+                unit="goodput ok-calls/s",
+                baseline_rate=max(rate_unprot, 1e-9),
+                baseline_desc=(
+                    f"UNPROTECTED control, same load/pool "
+                    f"({rate_unprot:,.1f} ok/s) — must measurably "
+                    "collapse; acceptance: protected >= 2x control "
+                    f"and protected p99 <= {1e3 * p99_slo_s:.0f} ms"
+                ),
+                protected_goodput_rps=round(rate_prot, 1),
+                unprotected_goodput_rps=round(rate_unprot, 1),
+                protected_p99_ms=round(1e3 * p99_prot, 2),
+                unprotected_p99_ms=round(1e3 * p99_unprot, 2),
+                deadline_ms=round(1e3 * deadline_s, 1),
+                shed_calls=n_shed,
+                note=(
+                    "host-transport lane (no FLOP fields); round_robin "
+                    "pins both lanes to the stalled replica half the "
+                    "time so the DEADLINE does the protecting, not the "
+                    "router; sheds are loud DeadlineExceeded failures, "
+                    "never silence"
+                ),
+            )
+            assert rate_prot >= 2.0 * rate_unprot, (
+                f"protected goodput {rate_prot:.1f} ok/s is not >= 2x "
+                f"the unprotected control {rate_unprot:.1f} ok/s"
+            )
+            assert p99_prot <= p99_slo_s, (
+                f"protected successful-call p99 {1e3 * p99_prot:.1f} ms "
+                f"breaks the {1e3 * p99_slo_s:.0f} ms SLO"
+            )
+            assert n_shed > 0, "overload lane never shed — not oversubscribed"
+        finally:
+            for p in procs:
+                p.terminate()
+            for p in procs:
+                p.join(timeout=5)
+
+    guard("overload-protected serving", _c16)
+
     if results:
         print(
             "# wrote "

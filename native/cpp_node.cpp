@@ -13,7 +13,12 @@
 //   payload: "NPW1" ver(u8) flags(u8) uuid(16B) n_arrays(u32)
 //            [flags&1: err_len(u32) + utf8]
 //            [flags&2: trace_id(16B), telemetry correlation — read and
-//             dropped here; replies never carry it]   then per array:
+//             dropped here; replies never carry it]
+//            [flags&16: deadline_s(f64), the request's remaining
+//             deadline budget in relative seconds — enforced at
+//             admission: an expired budget is answered with the
+//             in-band "deadline exceeded" classification, never
+//             computed]                               then per array:
 //            dtype_len(u16) dtype_str ndim(u8) shape(u64*ndim)
 //            data_len(u64) raw bytes
 //            [flags&4 TAIL: spans_len(u32) + JSON — node-side span
@@ -87,13 +92,14 @@ constexpr uint8_t kFlagError = 1;
 constexpr uint8_t kFlagTrace = 2;
 constexpr uint8_t kFlagSpans = 4;
 constexpr uint8_t kFlagBatch = 8;
+constexpr uint8_t kFlagDeadline = 16;
 // Every known flag bit, mirrored from service/wire_registry.py (the
 // declared source; graftlint's wire-registry rule cross-checks this
 // file).  Decoders reject any bit outside the mask: an unknown flag
 // means blocks this build cannot place, and skipping them would be
 // silent mis-parsing of everything after (loud-failure contract).
 constexpr uint8_t kKnownFlags =
-    kFlagError | kFlagTrace | kFlagSpans | kFlagBatch;
+    kFlagError | kFlagTrace | kFlagSpans | kFlagBatch | kFlagDeadline;
 // flags byte offset in the payload: magic(4) + version(1)
 constexpr size_t kFlagsOff = 5;
 
@@ -113,6 +119,10 @@ struct Message {
   uint8_t uuid[16];
   std::string error;  // empty = no error
   std::vector<Array> arrays;
+  // Remaining deadline budget (flag 16), relative seconds off the
+  // wire.  has_deadline=false = unbounded (the pre-deadline wire).
+  bool has_deadline = false;
+  double deadline_s = 0.0;
 };
 
 // ---- low-level IO -------------------------------------------------------
@@ -215,6 +225,15 @@ bool decode(const std::vector<uint8_t>& buf, Message* msg, std::string* why) {
     }
     // Telemetry correlation id — a Python driver's span tree key.  A
     // native node keeps no spans, so the id is consumed and dropped.
+  }
+  if (flags & kFlagDeadline) {
+    // Remaining deadline budget, f64 relative seconds (the sender
+    // computed "time left" at encode; clocks never cross the wire).
+    if (!r.le(&msg->deadline_s)) {
+      *why = "truncated deadline block";
+      return false;
+    }
+    msg->has_deadline = true;
   }
   // Each array needs >= 11 bytes of headers (2 dtype-len + 1 ndim +
   // 8 data-len), so any frame can hold at most remaining/11 arrays.
@@ -321,7 +340,15 @@ std::vector<uint8_t> serve_plain(const std::vector<uint8_t>& buf) {
   Message in, reply;
   std::string why;
   if (decode(buf, &in, &why)) {
-    reply = compute(in);
+    if (in.has_deadline && in.deadline_s <= 0.0) {
+      // Admission enforcement (service/deadline.py vocabulary): an
+      // already-expired request is answered, never computed — the
+      // Python client maps this marker to its DeadlineExceeded class.
+      std::memcpy(reply.uuid, in.uuid, 16);
+      reply.error = "deadline exceeded: budget spent before admission";
+    } else {
+      reply = compute(in);
+    }
   } else {
     std::memset(reply.uuid, 0, 16);
     reply.error = "decode failed: " + why;
@@ -372,6 +399,17 @@ std::vector<uint8_t> serve_batch(const std::vector<uint8_t>& buf) {
     uint8_t trace_id[16];
     if (!r.bytes(trace_id, 16))
       return batch_error_reply("decode failed: truncated trace block");
+  }
+  if (flags & kFlagDeadline) {
+    double deadline_s = 0.0;
+    if (!r.le(&deadline_s))
+      return batch_error_reply("decode failed: truncated deadline block");
+    if (deadline_s <= 0.0)
+      // The outer budget covers the whole window: expired at admission
+      // means no item is computed (the in-band deadline classification
+      // the Python client maps to DeadlineExceeded).
+      return batch_error_reply(
+          "deadline exceeded: budget spent before admission");
   }
   // Each item needs >= 4 bytes (its length prefix), so any frame holds
   // at most remaining/4 items — reject hostile counts before looping.

@@ -49,6 +49,9 @@ or invariant that motivated it; the meta-test keeps the two in sync):
   :mod:`.rules_fedflow`)
 - ``observability-drift`` — metric families and flightrec events match
   docs/observability.md both ways (:mod:`.rules_obs`)
+- ``unbounded-wait`` — recv/readexactly/stream-read calls in
+  service//routing/ arm a timeout or sit under an armed watchdog
+  deadline on every path (:mod:`.rules_wait`)
 """
 
 from .core import (
@@ -77,6 +80,7 @@ from . import rules_obs  # noqa: F401
 from . import rules_race  # noqa: F401
 from . import rules_resource  # noqa: F401
 from . import rules_shim  # noqa: F401
+from . import rules_wait  # noqa: F401
 from . import rules_wire  # noqa: F401
 
 __all__ = [

@@ -1,0 +1,245 @@
+"""unbounded-wait: every reply wait in the I/O stack can be bounded.
+
+ISSUE 10's motivating hole: a server that ACCEPTS a request and then
+never replies.  Every failure the transports classified until now —
+refused connects, resets, corrupt frames — is an event; silence is
+not, so a bare ``recv``/``readexactly``/``stream.read`` blocked until
+the process-wide watchdog fired instead of failing over inside the
+caller's deadline.  The deadline-aware reads added by ISSUE 10
+(``settimeout`` derived from the ambient budget on the sync lanes,
+``asyncio.wait_for`` on the stream lane) close the hole; this rule
+keeps it closed: a NEW wait primitive in ``service/`` or ``routing/``
+must either arm a bound itself or sit under an armed watchdog deadline
+on every call path.
+
+Semantics, over the shared graftflow call graph:
+
+- *wait sites*: calls to ``.recv`` / ``.recv_into`` / ``.readexactly``,
+  and ``.read`` on a stream-ish receiver (name matching
+  ``stream``/``_rfile``/``reader`` — socket-backed readers, not plain
+  files).
+- *locally bounded*: the enclosing function's own body arms a bound —
+  a ``settimeout(...)`` call, an ``asyncio.wait_for(...)`` wrapper,
+  the shared ``bounded_reader(...)`` helper (service/deadline.py — it
+  re-arms ``settimeout`` from the ambient budget before every chunk),
+  or a ``with …armed(…)`` watchdog span.  (Function-granular on
+  purpose:
+  a function that derives a timeout for SOME paths owns the decision
+  for all of them; the deadline tests pin the behavior.)
+- *covered by callers* (the interprocedural half, same fixpoint shape
+  as graftflow's lock inference): a function whose EVERY in-package
+  call edge comes from a bounded/covered caller — or lexically from
+  inside a caller's ``with …armed(…)`` span — inherits the bound.
+  Functions no in-package caller reaches are entrypoints and inherit
+  nothing.
+
+A deliberate exception needs an inline suppression with a reason —
+the one shipped case is the SERVER's frame loop, whose idle state IS
+an unbounded wait for the next request
+(``service/tcp.py::_recv_exact``).  Findings carry the uncovered call
+chain from an entrypoint, rendered by the graftflow engine.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from .core import Finding, RepoContext, rule
+from .graph import CallGraph, own_body
+
+_RULE = "unbounded-wait"
+
+_SCOPE_PREFIXES = (
+    "pytensor_federated_tpu/service/",
+    "pytensor_federated_tpu/routing/",
+)
+
+#: Attribute calls that park the caller until the peer says otherwise.
+_WAIT_METHODS = {"recv", "recv_into", "readexactly"}
+
+#: ``.read`` only counts on receivers that look like socket-backed
+#: readers — a plain file read terminates on its own.
+_STREAMISH = re.compile(r"stream|_rfile|reader", re.IGNORECASE)
+
+#: Body calls that arm a bound for the whole function.
+#: ``bounded_reader`` is the shared client-lane helper
+#: (service/deadline.py): it re-arms ``settimeout`` from the ambient
+#: budget before every chunk — the TCP socket lane and the shm
+#: doorbell both read through it, so the arming call the rule used to
+#: see inline now lives there.
+_ARMING_CALLS = {"settimeout", "wait_for", "bounded_reader"}
+
+#: ``with …armed(…)`` — the watchdog deadline span.
+_ARMED_ATTR = "armed"
+
+
+def _unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse is total on exprs
+        return ""
+
+
+def wait_sites(fn_node: ast.AST) -> Iterator[Tuple[ast.Call, str]]:
+    """(call, description) for every wait primitive in the function's
+    own body."""
+    for node in own_body(fn_node):
+        if not isinstance(node, ast.Call) or not isinstance(
+            node.func, ast.Attribute
+        ):
+            continue
+        name = node.func.attr
+        if name in _WAIT_METHODS:
+            yield node, f"`{_unparse(node.func)}(...)`"
+        elif name == "read" and _STREAMISH.search(
+            _unparse(node.func.value)
+        ):
+            yield node, f"`{_unparse(node.func)}(...)`"
+
+
+def _armed_spans(fn_node: ast.AST) -> List[Tuple[int, int]]:
+    """(start, end) line spans of ``with …armed(…):`` bodies."""
+    spans: List[Tuple[int, int]] = []
+    for node in own_body(fn_node):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                expr = item.context_expr
+                if (
+                    isinstance(expr, ast.Call)
+                    and isinstance(expr.func, ast.Attribute)
+                    and expr.func.attr == _ARMED_ATTR
+                ):
+                    spans.append(
+                        (
+                            node.lineno,
+                            int(getattr(node, "end_lineno", node.lineno)),
+                        )
+                    )
+                    break
+    return spans
+
+
+def _locally_bounded(fn_node: ast.AST) -> bool:
+    """Whether the function's own body arms a bound (settimeout /
+    wait_for / an armed watchdog span)."""
+    for node in own_body(fn_node):
+        if isinstance(node, ast.Call):
+            func = node.func
+            name = (
+                func.attr
+                if isinstance(func, ast.Attribute)
+                else func.id if isinstance(func, ast.Name) else ""
+            )
+            if name in _ARMING_CALLS:
+                return True
+    return bool(_armed_spans(fn_node))
+
+
+def _covered_functions(graph: CallGraph) -> Set[str]:
+    """Functions every in-package call path reaches with a bound armed
+    — bounded callers, or call sites inside armed watchdog spans —
+    fixpoint over the call graph (the lock-inference shape)."""
+    bounded = {
+        q for q, f in graph.functions.items() if _locally_bounded(f.node)
+    }
+    span_cache: Dict[str, List[Tuple[int, int]]] = {}
+
+    def spans_of(qname: str) -> List[Tuple[int, int]]:
+        if qname not in span_cache:
+            span_cache[qname] = _armed_spans(graph.functions[qname].node)
+        return span_cache[qname]
+
+    covered: Set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for qname in graph.functions:
+            if qname in covered or qname in bounded:
+                continue
+            callers = graph.callers_of(qname)
+            if not callers:
+                continue
+            ok = True
+            for edge in callers:
+                caller_ok = (
+                    edge.caller in covered or edge.caller in bounded
+                )
+                under_armed = any(
+                    lo <= edge.lineno <= hi
+                    for lo, hi in spans_of(edge.caller)
+                )
+                if not (caller_ok or under_armed):
+                    ok = False
+                    break
+            if ok:
+                covered.add(qname)
+                changed = True
+    return covered | bounded
+
+
+def _witness_chain(
+    graph: CallGraph, qname: str, safe: Set[str], limit: int = 8
+) -> Tuple[str, ...]:
+    """One uncovered caller chain up from ``qname`` toward an
+    entrypoint (callers outside ``safe``), for the finding's hops."""
+    hops: List[str] = []
+    seen = {qname}
+    cur = qname
+    for _ in range(limit):
+        unsafe = [
+            e
+            for e in graph.callers_of(cur)
+            if e.caller not in safe and e.caller not in seen
+        ]
+        if not unsafe:
+            break
+        edge = unsafe[0]
+        caller = graph.functions[edge.caller]
+        hops.append(
+            f"{caller.display} (calls {graph.functions[cur].name} at "
+            f"{caller.rel}:{edge.lineno})"
+        )
+        seen.add(edge.caller)
+        cur = edge.caller
+    hops.reverse()
+    return tuple(hops)
+
+
+@rule(
+    _RULE,
+    "recv/readexactly/stream-read calls in service/ and routing/ must "
+    "arm a timeout (settimeout / wait_for) or sit under an armed "
+    "watchdog deadline on every call path — a peer that accepts then "
+    "never replies must fail inside the caller's budget",
+    scope="repo",
+)
+def check_unbounded_wait(ctx: RepoContext) -> Iterator[Finding]:
+    graph = ctx.graph
+    safe = _covered_functions(graph)
+    for qname in sorted(graph.functions):
+        fn = graph.functions[qname]
+        if not fn.rel.startswith(_SCOPE_PREFIXES):
+            continue
+        if qname in safe:
+            continue
+        for call, desc in wait_sites(fn.node):
+            chain = _witness_chain(graph, qname, safe)
+            yield Finding(
+                rule=_RULE,
+                path=fn.rel,
+                line=call.lineno,
+                message=(
+                    f"unbounded wait {desc} in {fn.name}: no timeout "
+                    "armed on the path (settimeout / asyncio.wait_for "
+                    "/ a `with watchdog.armed(...)` span) — a peer "
+                    "that accepts and never replies blocks this call "
+                    "forever; derive a bound from the ambient "
+                    "deadline (service/deadline.py) or arm the "
+                    "watchdog, or suppress with a reason if waiting "
+                    "IS the idle state (server frame loops)"
+                ),
+                chain=chain
+                + (f"unbounded wait at {fn.rel}:{call.lineno}",),
+            )

@@ -332,7 +332,10 @@ def run_members(
 
     def call_member(idx: int, sub_inputs: list, sub_storage: list) -> None:
         """One member evaluation, re-run through the pool's retry
-        policy on transient failures (no pool: exactly one attempt)."""
+        policy on transient failures (no pool: exactly one attempt).
+        Each re-run is amplification and spends from the pool's retry
+        budget (``allow_retry``): a window that fans W members into a
+        sick pool must degrade to W attempts, not W × retries."""
         for attempt in range(max_attempts):
             try:
                 member_fns[idx](sub_inputs, sub_storage)
@@ -342,6 +345,7 @@ def run_members(
                     attempt + 1 >= max_attempts
                     or node_pool is None
                     or not node_pool.is_transient(e)
+                    or not node_pool.allow_retry("member_retry")
                 ):
                     raise
                 _flightrec.record(

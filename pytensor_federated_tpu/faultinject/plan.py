@@ -44,6 +44,12 @@ duplicate_reply     send the reply twice (desynchronizes a lock-step
 compute_error       the node's compute raises (in-band error reply /
                     non-retryable status — the deterministic-failure
                     classification path)
+slow_compute        the node's compute takes a SEEDED per-call delay,
+                    drawn uniformly from ``[0, delay_s]`` by the
+                    rule's own RNG — the degraded-replica model the
+                    overload chaos lane stalls a pool with (every
+                    call slower, none failing: deadlines and
+                    admission control must do the shedding)
 compute_wrong_shape the node's VECTORIZED batch compute returns the
                     wrong result count (the scalar-fallback isolation
                     path must absorb it)
@@ -85,6 +91,7 @@ FAULT_KINDS = frozenset(
         "stall",
         "duplicate_reply",
         "compute_error",
+        "slow_compute",
         "compute_wrong_shape",
         "getload_garbage",
         "kill_process",
@@ -201,6 +208,15 @@ class FaultRule:
                 return False
         self.fires += 1
         return True
+
+    def draw_delay_s(self) -> float:
+        """``slow_compute``'s per-call delay: uniform over
+        ``[0, delay_s]`` from the rule's seeded RNG, so the SAME plan
+        replays the same latency profile while individual calls still
+        vary (a constant-delay replica is `delay`; this models a
+        degraded one)."""
+        rng = self._rng or random.Random(self.index)
+        return rng.random() * self.delay_s
 
     def cut_at(self, length: int) -> int:
         """Byte offset for truncate/stall splits: at least 1 byte in,

@@ -39,7 +39,8 @@ point                     primitive / applicable kinds
                           delay, stall, drop→UNAVAILABLE abort,
                           duplicate_reply, truncate, corrupt, kill)
 ``server.compute``        :func:`compute_filter` (+ ``_async``) —
-                          delay, stall, compute_error, kill_process
+                          delay, slow_compute (seeded per-call delay),
+                          stall, compute_error, kill_process
 ``server.compute_batch``  :func:`mangle_batch_result` —
                           compute_wrong_shape
 ``server.getload``        :func:`getload_filter` — getload_garbage,
@@ -355,13 +356,16 @@ def send_frame_through(
 def compute_filter(point: str = "server.compute", peer: Optional[str] = None) -> None:
     """Node compute-path shim (sync lanes): ``compute_error`` raises —
     the caller's normal error handling turns it into an in-band error
-    reply / status abort; delay/stall sleep; kill kills."""
+    reply / status abort; delay/stall sleep (``slow_compute`` draws a
+    seeded per-call delay — the degraded-replica model); kill kills."""
     rule = decide(point, peer)
     if rule is None:
         return
     kind = rule.kind
     if kind == "delay":
         time.sleep(rule.delay_s)
+    elif kind == "slow_compute":
+        time.sleep(rule.draw_delay_s())
     elif kind == "stall":
         time.sleep(rule.stall_s)
     elif kind == "compute_error":
@@ -385,10 +389,14 @@ async def compute_filter_async(
     if rule is None:
         return
     kind = rule.kind
-    if kind in ("delay", "stall"):
+    if kind in ("delay", "stall", "slow_compute"):
         import asyncio
 
-        await asyncio.sleep(rule.delay_s if kind == "delay" else rule.stall_s)
+        await asyncio.sleep(
+            rule.draw_delay_s()
+            if kind == "slow_compute"
+            else (rule.delay_s if kind == "delay" else rule.stall_s)
+        )
     elif kind == "compute_error":
         raise RuntimeError(
             rule.error or f"faultinject[compute_error] at {point}"

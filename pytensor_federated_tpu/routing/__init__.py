@@ -20,6 +20,10 @@ every call:
   plus hedged requests for idempotent computes and mid-window
   failover that re-queues the un-replied tail of a pipelined window
   onto a healthy replica.
+- :class:`RetryBudget` — the per-pool token bucket every amplifying
+  recovery path (retries, hedges, mid-window failover, fanout member
+  re-runs) spends from, so a sick pool degrades to one attempt per
+  call instead of multiplying its own load (:mod:`.budget`).
 
 Everything is observable: ``pftpu_pool_*`` metric families (catalog:
 docs/observability.md), ``pool.*`` flight-recorder events, and
@@ -28,6 +32,7 @@ call's full replica itinerary in one trace.
 """
 
 from .breaker import CircuitBreaker
+from .budget import RetryBudget
 from .policies import (
     EwmaLatencyPolicy,
     PowerOfTwoChoicesPolicy,
@@ -44,6 +49,7 @@ __all__ = [
     "PooledArraysClient",
     "PowerOfTwoChoicesPolicy",
     "Replica",
+    "RetryBudget",
     "RoundRobinPolicy",
     "get_policy",
 ]

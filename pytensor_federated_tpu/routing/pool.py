@@ -59,6 +59,7 @@ from ..faultinject import runtime as _fi
 from ..telemetry import flightrec as _flightrec
 from ..telemetry import metrics as _metrics
 from .breaker import CircuitBreaker
+from .budget import RetryBudget
 from .policies import get_policy
 
 __all__ = ["NodePool", "Replica"]
@@ -263,6 +264,7 @@ class NodePool:
         load_stale_s: float = 10.0,
         breaker_kwargs: Optional[dict] = None,
         member_retries: int = 2,
+        retry_budget: Optional[RetryBudget] = None,
     ) -> None:
         if transport not in ("grpc", "tcp", "shm"):
             raise ValueError(
@@ -283,6 +285,17 @@ class NodePool:
         # attempts, so a retry is a different replica, not an instant
         # replay against the dead one).
         self.member_retries = int(member_retries)
+        # Retry budget (ISSUE 10): every amplifying recovery attempt —
+        # hedges, failover re-picks, member re-runs — spends from this
+        # token bucket via allow_retry(), so a sick pool degrades to
+        # one attempt per call instead of multiplying its own load.
+        # Always present by default; pass an explicit RetryBudget to
+        # tune rate/burst (there is deliberately no "unlimited" knob:
+        # unbounded amplification is the overload-collapse mode this
+        # subsystem exists to remove).
+        self.retry_budget = (
+            retry_budget if retry_budget is not None else RetryBudget()
+        )
         self._lock = threading.Lock()
         self._replicas: Dict[str, Replica] = {}
         self._probe_thread: Optional[threading.Thread] = None
@@ -654,6 +667,14 @@ class NodePool:
             pass
         return isinstance(exc, (ConnectionError, OSError, TimeoutError))
 
+    def allow_retry(self, what: str = "retry") -> bool:
+        """Charge one amplifying recovery attempt to the pool's retry
+        budget (:mod:`.budget`).  ``False`` = exhausted: the caller
+        must degrade to single-attempt behavior — skip the hedge, stop
+        the failover loop, surface the member failure.  First attempts
+        are never charged; only the MULTIPLIER is rationed."""
+        return self.retry_budget.try_spend(what=what)
+
     def backoff_sleep(self, attempt: int) -> None:
         """Jittered exponential pause between member retries."""
         import random
@@ -683,6 +704,7 @@ class NodePool:
         return {
             "transport": self.transport,
             "policy": self.policy_name,
+            "retry_budget": self.retry_budget.snapshot(),
             "replicas": [
                 {
                     "replica": r.address,

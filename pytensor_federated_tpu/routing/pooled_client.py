@@ -111,6 +111,16 @@ def _is_transport_error(exc: BaseException) -> bool:
     return isinstance(exc, (ConnectionError, OSError))
 
 
+def _is_deadline(exc: BaseException) -> bool:
+    """Whether the failure is the CALLER's spent deadline budget —
+    which says nothing about the replica's health either way (the
+    fail-fast guard can fire before a single byte is sent), so routing
+    must book NEITHER a success nor a failure for it."""
+    from ..service.deadline import DeadlineExceeded
+
+    return isinstance(exc, DeadlineExceeded)
+
+
 class PooledArraysClient:
     """Pool-routed evaluation client (module docstring for semantics).
 
@@ -258,10 +268,23 @@ class PooledArraysClient:
             done, _ = await asyncio.wait({primary}, timeout=deadline)
             if primary in done:
                 return primary.result(), time.perf_counter() - t0, replica
+            # A hedge re-executes the compute: it spends from the
+            # pool's retry budget FIRST, so a sick pool stops hedging
+            # before hedges become half its traffic (budget checked
+            # before pick — a denied hedge must not burn a half-open
+            # probe token).
+            if not self.pool.allow_retry("hedge"):
+                return await primary, time.perf_counter() - t0, replica
             hedged = self.pool.pick(
                 1, exclude=set(exclude) | {replica.address}
             )
             if not hedged:
+                # No replica to hedge onto (single-replica pool, or
+                # everything else excluded/breaker-open): nothing
+                # amplified, so give the token back — otherwise a
+                # sustained slow patch drains the bucket with zero
+                # hedges fired and later denies REAL failovers.
+                self.pool.retry_budget.refund()
                 return await primary, time.perf_counter() - t0, replica
             hedge_replica = hedged[0]
             _POOL_HEDGES.labels(outcome="fired").inc()
@@ -293,7 +316,11 @@ class PooledArraysClient:
                             # request's own fault and would fail
                             # identically on a healthy replica (which
                             # DID serve it — a success for routing).
-                            if _is_transport_error(e):
+                            # A spent DEADLINE is neither: give back
+                            # the probe token without an outcome.
+                            if _is_deadline(e):
+                                task_replica.breaker.release()
+                            elif _is_transport_error(e):
                                 self.pool.record_result(task_replica, False)
                             else:
                                 self.pool.record_result(task_replica, True)
@@ -334,10 +361,17 @@ class PooledArraysClient:
         ) as root:
             exclude: set = set()
             last_exc: Optional[BaseException] = None
+            charged = False
             while True:
                 picked = self.pool.pick(1, exclude=exclude)
                 if not picked:
+                    if charged:
+                        # The granted token bought a re-pick that
+                        # found no replica: nothing amplified — give
+                        # it back (the hedge no-replica posture).
+                        self.pool.retry_budget.refund()
                     break
+                charged = False
                 replica = picked[0]
                 try:
                     result, wall, served_by = await self._attempt(
@@ -345,6 +379,16 @@ class PooledArraysClient:
                     )
                 except BaseException as e:  # noqa: BLE001
                     recorded = getattr(e, "_pftpu_recorded", False)
+                    if _is_deadline(e):
+                        # The CALLER's budget died — says nothing
+                        # about the replica (the fail-fast guard can
+                        # fire before a byte is sent): book neither
+                        # outcome, just give back the breaker/probe
+                        # token pick() acquired.
+                        if not recorded:
+                            replica.breaker.release()
+                        root.set_attr("error", "deadline")
+                        raise
                     if not _is_transport_error(e):
                         # Deterministic server failure: the request's
                         # own fault — no failover (it would fail
@@ -368,6 +412,14 @@ class PooledArraysClient:
                         replica=replica.address,
                         error=f"{type(e).__name__}: {e}"[:200],
                     )
+                    # Each failover re-pick is amplification and spends
+                    # from the retry budget: exhausted = this call gets
+                    # no further attempts (degrade to one-attempt-per-
+                    # call instead of multiplying a sick pool's load).
+                    if not self.pool.allow_retry("failover"):
+                        root.set_attr("error", "transport")
+                        raise
+                    charged = True
                     continue
                 self.pool.record_result(served_by, True, latency_s=wall)
                 self._latency.record(wall)
@@ -521,6 +573,8 @@ class PooledArraysClient:
                 )
                 new_pending: List[int] = []
                 server_exc: Optional[BaseException] = None
+                budget_spent = False
+                granted = 0
                 for (replica, shard), out in zip(shards, outcomes):
                     if isinstance(out, BaseException):
                         # evaluate_many_partial returns transport
@@ -529,10 +583,16 @@ class PooledArraysClient:
                         # replica is healthy (it served the request),
                         # so routing books a SUCCESS — which also
                         # resolves a half-open probe instead of
-                        # leaking its token.  Every sibling shard has
-                        # settled (gather with return_exceptions), so
-                        # raising is orphan-free.
-                        self.pool.record_result(replica, True)
+                        # leaking its token.  A spent DEADLINE is
+                        # neither outcome (the guard can fire before a
+                        # byte is sent): release the token instead.
+                        # Every sibling shard has settled (gather with
+                        # return_exceptions), so raising is
+                        # orphan-free.
+                        if _is_deadline(out):
+                            replica.breaker.release()
+                        else:
+                            self.pool.record_result(replica, True)
                         server_exc = server_exc or out
                         continue
                     partial, exc, wall = out
@@ -563,9 +623,38 @@ class PooledArraysClient:
                             requeued=len(shard) - served,
                             error=f"{type(exc).__name__}: {exc}"[:200],
                         )
+                        # Re-queuing a failed shard's tail is
+                        # amplification: one budget spend per failed
+                        # replica WITH a tail to re-queue (a replica
+                        # that failed after serving its whole shard
+                        # amplifies nothing); exhausted = the tail
+                        # surfaces its transport error instead of
+                        # another round.
+                        if served < len(shard):
+                            if self.pool.allow_retry("failover"):
+                                granted += 1
+                            else:
+                                budget_spent = True
                 if server_exc is not None:
+                    if granted:
+                        # The round aborts: tokens granted to sibling
+                        # shards bought no re-queue — give them back
+                        # (the hedge no-replica path's posture).
+                        self.pool.retry_budget.refund(granted)
                     root.set_attr("error", "server")
                     raise server_exc
+                if budget_spent and new_pending:
+                    if granted:
+                        self.pool.retry_budget.refund(granted)
+                    root.set_attr("error", "transport")
+                    raise (
+                        last_exc
+                        if last_exc is not None
+                        else ConnectionError(
+                            "retry budget exhausted with "
+                            f"{len(new_pending)} requests un-replied"
+                        )
+                    )
                 new_pending.sort()
                 pending = new_pending
             return results  # type: ignore[return-value]

@@ -44,6 +44,7 @@ from ..faultinject import runtime as _fi
 from ..telemetry import flightrec as _flightrec
 from ..telemetry import metrics as _metrics
 from ..telemetry import spans as _spans
+from . import deadline as _deadline
 
 __all__ = ["MicroBatcher", "batched_compute_fn", "execute_window_sync"]
 
@@ -72,6 +73,13 @@ _QUEUE_S = _metrics.histogram(
 )
 _COMPUTE_S = _metrics.histogram(
     "pftpu_server_compute_seconds", "compute_fn latency"
+)
+# Shared with the admission path in server.py (same family by name):
+# work the node refused or abandoned instead of computing.
+_ADMISSION_SHED = _metrics.counter(
+    "pftpu_admission_shed_total",
+    "Requests shed by server-side admission control, by reason",
+    ("reason",),
 )
 
 
@@ -209,13 +217,16 @@ def execute_window_sync(
 
 
 class _Pending:
-    __slots__ = ("inputs", "sig", "future", "t_enqueue")
+    __slots__ = ("inputs", "sig", "future", "t_enqueue", "deadline")
 
-    def __init__(self, inputs, sig, future, t_enqueue):
+    def __init__(self, inputs, sig, future, t_enqueue, deadline=None):
         self.inputs = inputs
         self.sig = sig
         self.future = future
         self.t_enqueue = t_enqueue
+        # Absolute monotonic deadline captured at enqueue from the
+        # ambient contextvar (None = unbounded): the shed key.
+        self.deadline = deadline
 
 
 class MicroBatcher:
@@ -261,6 +272,7 @@ class MicroBatcher:
         self.n_dispatched = 0
         self.n_batches = 0
         self.n_fallbacks = 0
+        self.n_shed = 0
         self.max_seen = 0
 
     # -- submission -------------------------------------------------------
@@ -293,7 +305,13 @@ class MicroBatcher:
         fut = loop.create_future()
         arrays = [np.asarray(a) for a in inputs]
         self._pending.append(
-            _Pending(arrays, _signature(arrays), fut, time.perf_counter())
+            _Pending(
+                arrays,
+                _signature(arrays),
+                fut,
+                time.perf_counter(),
+                _deadline.current_deadline(),
+            )
         )
         self.max_seen = max(self.max_seen, len(self._pending))
         if start:
@@ -308,6 +326,41 @@ class MicroBatcher:
     def queue_depth(self) -> int:
         return len(self._pending)
 
+    def _shed_one(self, p: _Pending, where: str) -> None:
+        """Fail one expired entry with the deadline classification —
+        its reply races nothing downstream (never vmap'd in)."""
+        self.n_shed += 1
+        _ADMISSION_SHED.labels(reason="expired").inc()
+        _deadline.DEADLINE_EXPIRED.labels(stage="queue").inc()
+        _flightrec.record("admission.shed", reason="expired", where=where)
+        if not p.future.done():
+            p.future.set_exception(
+                _deadline.DeadlineExceeded(
+                    _deadline.deadline_error(f"shed in {where}")
+                )
+            )
+
+    def shed_expired(self) -> int:
+        """Drop every queued entry whose deadline is already spent
+        (their callers stopped waiting: computing them is pure load)
+        and fail their futures with the deadline classification.
+        Returns how many were shed.  The admission path calls this
+        BEFORE refusing new work — shedding the oldest-past-deadline
+        first is how a full queue makes room for live requests."""
+        if not self._pending:
+            return 0
+        now = time.monotonic()
+        live: deque = deque()
+        shed = 0
+        for p in self._pending:
+            if p.deadline is not None and now >= p.deadline:
+                self._shed_one(p, "micro-batcher queue")
+                shed += 1
+            else:
+                live.append(p)
+        self._pending = live
+        return shed
+
     def stats(self) -> dict:
         """Live batcher picture for GetLoad (:meth:`..server
         .ArraysToArraysService.determine_load`): always-on counts plus
@@ -319,6 +372,7 @@ class MicroBatcher:
             "dispatched_total": self.n_dispatched,
             "batches_total": self.n_batches,
             "fallbacks_total": self.n_fallbacks,
+            "shed_total": self.n_shed,
             "max_queue_seen": self.max_seen,
         }
         if _spans.enabled():
@@ -377,6 +431,18 @@ class MicroBatcher:
                 self._start()
 
     async def _execute(self, group: List[_Pending]) -> None:
+        # Shed expired entries AT DISPATCH: their callers are gone, so
+        # stacking them into the vmapped call would spend device time
+        # on replies nobody reads — the queue must never launder dead
+        # work into compute (ISSUE 10 tentpole).
+        now = time.monotonic()
+        live: List[_Pending] = []
+        for p in group:
+            if p.deadline is not None and now >= p.deadline:
+                self._shed_one(p, "micro-batcher dispatch")
+            else:
+                live.append(p)
+        group = live
         k = len(group)
         if k == 0:
             return

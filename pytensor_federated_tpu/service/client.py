@@ -27,6 +27,7 @@ All the behavioral contracts survive:
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import dataclasses
 import json
 import logging
@@ -47,6 +48,7 @@ from ..telemetry import spans as _spans
 from ..telemetry import watchdog as _watchdog
 from ..utils import argmin_none_or_func, get_event_loop
 from . import _rpc_metrics
+from . import deadline as _deadline
 from .npwire import (
     decode_arrays_all,
     decode_batch,
@@ -76,7 +78,12 @@ _FRAME_REQS = _rpc_metrics.BATCH_FRAME_REQS
 # npproto path has no in-band error field, so a compute error surfaces
 # as a stream abort — re-running it retries+1 times would re-execute
 # the whole batch into the same exception (ADVICE r5 #2).  Transport
-# trouble (UNAVAILABLE, DEADLINE_EXCEEDED, ...) stays retryable.
+# trouble (UNAVAILABLE, ...) stays retryable.  DEADLINE_EXCEEDED is in
+# the NO-RETRY set since ISSUE 10: a spent deadline is spent on every
+# replica at once, so a retry can only add load for a caller that
+# already gave up — the retry-storm amplification the deadline
+# machinery exists to remove (it is also the status the server aborts
+# with for an npproto request whose wire budget expired).
 _NO_RETRY_STATUS = frozenset(
     {
         grpc.StatusCode.UNKNOWN,  # server handler raised
@@ -84,6 +91,7 @@ _NO_RETRY_STATUS = frozenset(
         grpc.StatusCode.OUT_OF_RANGE,
         grpc.StatusCode.FAILED_PRECONDITION,
         grpc.StatusCode.UNIMPLEMENTED,
+        grpc.StatusCode.DEADLINE_EXCEEDED,
     }
 )
 
@@ -111,9 +119,51 @@ async def _stream_write(stream, payload: bytes) -> None:
 
 
 async def _stream_read(stream):
-    """``stream.read`` with the same dead-stream translation."""
+    """``stream.read`` with the same dead-stream translation, bounded
+    by the ambient deadline when one is set: a server that accepted
+    the write but never replies must fail the call inside the caller's
+    budget, not block until the watchdog fires.  The timeout cancels
+    the read, desynchronizing the lock-step stream — the TimeoutError
+    (an OSError since 3.10) lands in the callers' transport-error
+    handlers, which drop the cached connection."""
+    remaining = _deadline.remaining_s()
     try:
-        return await stream.read()
+        if remaining is None:
+            return await stream.read()
+        if remaining <= 0:
+            _deadline.DEADLINE_EXPIRED.labels(stage="client").inc()
+            # The request was already written (lock-step): raising
+            # without reading leaves the cached stream one reply
+            # ahead, failing the NEXT healthy call with a uuid
+            # mismatch.  DeadlineExceeded is a RuntimeError, so the
+            # callers' transport handlers never drop the connection —
+            # cancel the RPC here so the next use raises
+            # InvalidStateError -> ConnectionError and reconnects.
+            with contextlib.suppress(Exception):
+                stream.cancel()
+            raise _deadline.DeadlineExceeded(
+                _deadline.deadline_error("budget spent awaiting reply")
+            )
+        return await asyncio.wait_for(stream.read(), timeout=remaining)
+    except asyncio.CancelledError:
+        # grpc.aio raises CancelledError from read() on an RPC that
+        # was itself cancelled (e.g. by a previous timed-out read
+        # tearing the call down) — that is a DEAD STREAM, transport
+        # trouble, not our task being cancelled.  A genuine task
+        # cancellation leaves the RPC alive and must propagate.
+        done = getattr(stream, "done", None)
+        if done is not None and done():
+            raise ConnectionError("stream cancelled mid-read") from None
+        raise
+    except asyncio.TimeoutError:
+        # Translate to the transport classification (asyncio's
+        # TimeoutError is not an OSError on 3.10): the callers drop
+        # the now-desynchronized connection and fail over; the next
+        # attempt's own deadline check then raises DeadlineExceeded.
+        _deadline.DEADLINE_EXPIRED.labels(stage="client").inc()
+        raise ConnectionError(
+            "reply deadline elapsed on the lock-step stream"
+        ) from None
     except asyncio.InvalidStateError as e:
         raise ConnectionError(f"stream already finished: {e}") from e
 
@@ -486,7 +536,25 @@ class ArraysToArraysServiceClient:
         method = privates.channel.unary_unary(
             EVALUATE, request_serializer=_identity, response_deserializer=_identity
         )
-        reply = await method(request)
+        # The ambient deadline bounds the RPC itself too, via OUR
+        # timer rather than grpc's ``timeout=``: grpc.aio's client-side
+        # deadline can race into a local cancellation that surfaces as
+        # a bare CancelledError instead of DEADLINE_EXCEEDED (observed
+        # under the overload chaos lane), while wait_for converts the
+        # same cancellation into a deterministic TimeoutError here.
+        remaining = _deadline.remaining_s()
+        if remaining is None:
+            reply = await method(request)
+        else:
+            try:
+                reply = await asyncio.wait_for(
+                    method(request), timeout=max(remaining, 1e-3)
+                )
+            except asyncio.TimeoutError:
+                _deadline.DEADLINE_EXPIRED.labels(stage="client").inc()
+                raise _deadline.DeadlineExceeded(
+                    _deadline.deadline_error("budget spent awaiting reply")
+                ) from None
         if _fi.active_plan is not None:  # chaos seam
             reply = await _fi.filter_bytes_async("grpc.recv", reply, peer)
         return reply
@@ -513,12 +581,17 @@ class ArraysToArraysServiceClient:
         gets the other half of a correlated trace."""
         arrays = [np.asarray(a) for a in arrays]
         trace_id = _spans.current_trace_id() if _spans.enabled() else None
+        # Deadline propagation: the remaining budget rides the request
+        # (npwire flag 16 / npproto field 18); None — the default —
+        # keeps the frame byte-identical to the deadline-free wire.
+        deadline_s = _deadline.wire_budget()
         if self.codec == "npproto":
             from . import npproto_codec
 
             uuid = str(uuid_mod.uuid4())
             request = npproto_codec.encode_arrays_msg(
-                arrays, uuid=uuid, trace_id=trace_id
+                arrays, uuid=uuid, trace_id=trace_id,
+                deadline_s=deadline_s,
             )
 
             def decode(reply):
@@ -531,7 +604,10 @@ class ArraysToArraysServiceClient:
 
         else:
             uuid = fast_uuid()
-            request = encode_arrays(arrays, uuid=uuid, trace_id=trace_id)
+            request = encode_arrays(
+                arrays, uuid=uuid, trace_id=trace_id,
+                deadline_s=deadline_s,
+            )
 
             def decode(reply):
                 outputs, ruuid, error, _tid, spans = decode_arrays_all(reply)
@@ -575,6 +651,11 @@ class ArraysToArraysServiceClient:
             # The span (entered above) binds the trace id the encode
             # step stamps into the request.
             with _spans.span("encode"):
+                # Fail fast on a spent budget BEFORE paying encode or
+                # transport: the pool's failover loop re-enters here,
+                # so this is also what stops failover once the
+                # caller's deadline is gone.
+                _deadline.check_remaining("grpc evaluate")
                 request, uuid, decode = await _fi.call_shimmed_async(
                     self._encode_request, arrays
                 )
@@ -586,6 +667,22 @@ class ArraysToArraysServiceClient:
                     _flightrec.record(
                         "rpc.retry", transport="grpc", attempt=attempt
                     )
+                    # A spent budget stops the rebalance loop: the
+                    # retry would arrive at a replica only to be shed
+                    # at its admission check.
+                    _deadline.check_remaining("grpc retry")
+                    # Restamp the REMAINING budget: re-sending the
+                    # attempt-0 request would advertise the budget as
+                    # it stood before the failed attempts burned wall
+                    # time, so the replica would admit work whose
+                    # caller is closer to giving up than the wire
+                    # claims.  (A fresh uuid per attempt is fine: each
+                    # attempt is its own RPC, validated against its
+                    # own decode closure.)
+                    if _deadline.current_deadline() is not None:
+                        request, uuid, decode = await _fi.call_shimmed_async(
+                            self._encode_request, arrays
+                        )
                 t0 = time.perf_counter()
                 try:
                     with _spans.span("call"):
@@ -609,6 +706,8 @@ class ArraysToArraysServiceClient:
                     _flightrec.record(
                         "rpc.error", transport="grpc", error=error[:200]
                     )
+                    if _deadline.is_deadline_error(error):
+                        raise _deadline.DeadlineExceeded(error)
                     raise RuntimeError(f"server error: {error}")
                 return outputs
             root.set_attr("error", "transport")
@@ -686,6 +785,8 @@ class ArraysToArraysServiceClient:
                         reply, uuid, decode
                     )
                     if error is not None:
+                        if _deadline.is_deadline_error(error):
+                            raise _deadline.DeadlineExceeded(error)
                         raise RuntimeError(f"server error: {error}")
                     results[start + k] = outputs
             return results  # type: ignore[return-value]
@@ -758,6 +859,8 @@ class ArraysToArraysServiceClient:
                         drained = await _stream_read(stream)
                         if drained is grpc.aio.EOF:
                             break
+                    if _deadline.is_deadline_error(error):
+                        raise _deadline.DeadlineExceeded(error)
                     raise RuntimeError(f"server error: {error}")
                 results[read_idx] = outputs
                 read_idx += 1
@@ -785,6 +888,7 @@ class ArraysToArraysServiceClient:
     def _encode_batch_frame(self, part, trace_id):
         """One outer batch frame for a window slice of encoded
         requests -> (frame_bytes, outer_uuid)."""
+        deadline_s = _deadline.wire_budget()
         if self.codec == "npproto":
             from . import npproto_codec
 
@@ -793,6 +897,7 @@ class ArraysToArraysServiceClient:
                 [req for req, _u, _d in part],
                 uuid=outer_uuid,
                 trace_id=trace_id,
+                deadline_s=deadline_s,
             )
         else:
             outer_uuid = fast_uuid()
@@ -800,6 +905,7 @@ class ArraysToArraysServiceClient:
                 [req for req, _u, _d in part],
                 uuid=outer_uuid,
                 trace_id=trace_id,
+                deadline_s=deadline_s,
             )
         return frame, outer_uuid
 
@@ -875,6 +981,8 @@ class ArraysToArraysServiceClient:
             # a phantom uuid mismatch.
             if outer_error is not None:
                 await self._drain_frames(inflight_after)
+                if _deadline.is_deadline_error(outer_error):
+                    raise _deadline.DeadlineExceeded(outer_error)
                 raise RuntimeError(f"server error: {outer_error}")
             if ruuid != outer_uuid:
                 await self._drop_privates()
@@ -906,6 +1014,8 @@ class ArraysToArraysServiceClient:
                     raise
                 if error_j is not None:
                     await self._drain_frames(inflight_after)
+                    if _deadline.is_deadline_error(error_j):
+                        raise _deadline.DeadlineExceeded(error_j)
                     raise RuntimeError(f"server error: {error_j}")
                 if ruuid_j != uuid:
                     await self._drop_privates()

@@ -24,10 +24,13 @@ halves of the trace (:mod:`..telemetry.reunion`);
 ``batch_items(17: repeated bytes)`` — K nested InputArrays/
 OutputArrays messages making the message a BATCH frame (one RPC
 message per pipelined window, the npproto twin of npwire flag bit 8;
-:func:`encode_batch_msg`); and ``error(14: string)`` — a per-item
+:func:`encode_batch_msg`); ``error(14: string)`` — a per-item
 compute/decode error INSIDE a batch reply item, the isolation channel
 the reference schema lacks (outside batches npproto errors still
-surface as gRPC aborts, unchanged).  Fields 14-17 are unknown to the
+surface as gRPC aborts, unchanged); and ``deadline_s(18: double)`` —
+the request's remaining deadline budget in relative seconds
+(:mod:`.deadline`; the npproto twin of npwire flag bit 16, enforced at
+server admission).  Fields 14-18 are unknown to the
 reference schema, so an unmodified reference peer skips them by wire
 type (the standard proto3 forward-compatibility rule, property-tested
 against the official runtime); they cost nothing when absent — and a
@@ -83,6 +86,7 @@ __all__ = [
     "encode_batch_msg",
     "decode_batch_msg",
     "has_batch_items",
+    "peek_deadline_msg",
     "append_spans_msg",
     "encode_get_load_result",
     "decode_get_load_result",
@@ -312,6 +316,7 @@ def encode_arrays_msg(
     *,
     trace_id: Optional[bytes] = None,
     error: Optional[str] = None,
+    deadline_s: Optional[float] = None,
 ) -> bytes:
     """InputArrays/OutputArrays: repeated ndarray items + string uuid
     (reference: service.proto:6-19; uuid is the correlation id the
@@ -319,8 +324,10 @@ def encode_arrays_msg(
     telemetry extension field 15 (module docstring); ``error`` emits
     the per-item error extension field 14 — only used on items INSIDE
     a batch reply, where the gRPC-abort channel cannot isolate one
-    poisoned request.  Both ``None`` keeps the message byte-identical
-    to the official encoder's output."""
+    poisoned request; ``deadline_s`` emits the remaining-deadline
+    extension field 18 (fixed64 double, relative seconds).  All
+    ``None`` keeps the message byte-identical to the official
+    encoder's output."""
     out = bytearray()
     for a in arrays:
         out += _len_field(1, encode_ndarray(a))
@@ -334,6 +341,8 @@ def encode_arrays_msg(
                 f"trace_id must be 16 bytes, got {len(trace_id)}"
             )
         out += _len_field(15, trace_id)
+    if deadline_s is not None:
+        out += _tag(18, _WT_I64) + struct.pack("<d", float(deadline_s))
     if _fi.active_plan is not None:  # chaos seam (faultinject.runtime)
         return _fi.filter_bytes("npproto.encode", bytes(out))
     return bytes(out)
@@ -344,6 +353,7 @@ def encode_batch_msg(
     uuid: str,
     *,
     trace_id: Optional[bytes] = None,
+    deadline_s: Optional[float] = None,
 ) -> bytes:
     """Frame K already-encoded InputArrays/OutputArrays messages as ONE
     batch message (extension field 17) — the npproto twin of
@@ -362,6 +372,8 @@ def encode_batch_msg(
                 f"trace_id must be 16 bytes, got {len(trace_id)}"
             )
         out += _len_field(15, trace_id)
+    if deadline_s is not None:
+        out += _tag(18, _WT_I64) + struct.pack("<d", float(deadline_s))
     for item in items:
         out += _len_field(17, item)
     if _fi.active_plan is not None:  # chaos seam (faultinject.runtime)
@@ -383,6 +395,25 @@ def has_batch_items(buf: bytes) -> bool:
     except WireError:
         return False
     return False
+
+
+def peek_deadline_msg(buf: bytes) -> Optional[float]:
+    """The message's remaining-deadline budget (field 18, fixed64
+    double, relative seconds), or ``None`` when absent — a skip-walk
+    like :func:`has_batch_items`, so server admission can enforce the
+    deadline before paying any ndarray decode.  Raises
+    :class:`~.npwire.WireError` on structurally broken messages (the
+    full decoder would reject them identically)."""
+    pos = 0
+    while pos < len(buf):
+        field, wt, pos = _decode_tag(buf, pos)
+        if field == 18 and wt == _WT_I64:
+            if pos + 8 > len(buf):
+                raise WireError("truncated deadline_s field")
+            (budget,) = struct.unpack_from("<d", buf, pos)
+            return budget
+        pos = _skip(buf, pos, wt)
+    return None
 
 
 def decode_batch_msg(
@@ -419,6 +450,13 @@ def decode_batch_msg(
             except (UnicodeDecodeError, ValueError):
                 parsed = None  # tolerant: sidecar only, never the payload
             spans = parsed if isinstance(parsed, list) else None
+        elif field == 18 and wt == _WT_I64:
+            # deadline_s: consumed and dropped here — admission reads
+            # it pre-decode via peek_deadline_msg, keeping this tuple
+            # shape stable for every existing caller.
+            if pos + 8 > len(buf):
+                raise WireError("truncated deadline_s field")
+            pos += 8
         else:
             pos = _skip(buf, pos, wt)
     return items, uuid, trace_id, spans
@@ -509,6 +547,12 @@ def decode_arrays_msg_full(
             except (UnicodeDecodeError, ValueError):
                 parsed = None  # tolerant: sidecar only, never the payload
             spans = parsed if isinstance(parsed, list) else None
+        elif field == 18 and wt == _WT_I64:
+            # deadline_s: consumed and dropped (peek_deadline_msg is
+            # the admission-side reader; see decode_batch_msg).
+            if pos + 8 > len(buf):
+                raise WireError("truncated deadline_s field")
+            pos += 8
         else:
             pos = _skip(buf, pos, wt)
     return arrays, uuid, error, trace_id, spans

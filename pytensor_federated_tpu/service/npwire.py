@@ -57,16 +57,26 @@ decoder raises on flag 8 is a negotiation bug surfacing, not a
 compatibility hazard.  Plain frames are byte-identical with or without
 this feature compiled in.
 
+DEADLINE frames (flag bit 16): an 8-byte little-endian float64 after
+the trace block carrying the request's REMAINING deadline budget in
+seconds (relative, never an absolute timestamp — peer clocks are not
+ours; :mod:`.deadline` is the contextvar source and the enforcement
+vocabulary).  Servers enforce it at admission: an expired budget is
+answered with a :data:`~.deadline.DEADLINE_ERROR_PREFIX` in-band error
+and never computed.  Absent a bound deadline the flag stays clear and
+the frame is byte-identical to the pre-deadline wire (property-tested).
+
 Layout (little-endian):
   message: MAGIC(4s) version(u8) flags(u8) uuid(16s) n_arrays(u32)
            [flags&1 error: len(u32) utf8]
-           [flags&2 trace: trace_id(16s)]  then per array:
+           [flags&2 trace: trace_id(16s)]
+           [flags&16 deadline: budget_s(f64)]  then per array:
   array:   dtype_len(u16) dtype_str shape_ndim(u8) shape(u64*ndim)
            data_len(u64) data_bytes
   tail:    [flags&4 spans: len(u32) utf8-JSON]
   batch:   same header with flags&8; count = n_items; body is
            item_len(u32) + item_bytes per item (each a full frame);
-           same optional error/trace blocks and spans tail
+           same optional error/trace/deadline blocks and spans tail
 """
 
 from __future__ import annotations
@@ -112,13 +122,16 @@ _FLAG_ERROR = 1
 _FLAG_TRACE = 2
 _FLAG_SPANS = 4
 _FLAG_BATCH = 8
+_FLAG_DEADLINE = 16
 # Every known flag bit, mirrored from service/wire_registry.py (the
 # declared source; the graftlint wire-registry rule cross-checks the
 # two).  Decoders REJECT any bit outside this mask: an unknown flag
 # means the frame carries blocks this build cannot place, and parsing
 # around them would be silent mis-parsing — the exact version-skew
 # hazard the module docstring's loud-failure contract forbids.
-_KNOWN_FLAGS = _FLAG_ERROR | _FLAG_TRACE | _FLAG_SPANS | _FLAG_BATCH
+_KNOWN_FLAGS = (
+    _FLAG_ERROR | _FLAG_TRACE | _FLAG_SPANS | _FLAG_BATCH | _FLAG_DEADLINE
+)
 # flags byte offset in the header ("<4sBB...": magic, version, flags)
 _FLAGS_OFF = 5
 
@@ -261,6 +274,7 @@ def encode_arrays_sg(
     uuid: Optional[bytes] = None,
     error: Optional[str] = None,
     trace_id: Optional[bytes] = None,
+    deadline_s: Optional[float] = None,
 ) -> List[Buffer]:
     """Scatter/gather encode: the same frame as :func:`encode_arrays`
     as a BUFFER VECTOR — header/metadata ``bytes`` interleaved with
@@ -288,6 +302,8 @@ def encode_arrays_sg(
                 f"trace_id must be 16 bytes, got {len(trace_id)}"
             )
         flags |= _FLAG_TRACE
+    if deadline_s is not None:
+        flags |= _FLAG_DEADLINE
     parts: List[Buffer] = [
         struct.pack("<4sBB16sI", MAGIC, 1, flags, uuid, len(arrays))
     ]
@@ -297,6 +313,8 @@ def encode_arrays_sg(
         parts.append(err)
     if trace_id is not None:
         parts.append(trace_id)
+    if deadline_s is not None:
+        parts.append(struct.pack("<d", float(deadline_s)))
     for a in arrays:
         dt = _encode_dtype(a.dtype)
         parts.append(struct.pack("<H", len(dt)))
@@ -324,14 +342,17 @@ def encode_arrays(
     uuid: Optional[bytes] = None,
     error: Optional[str] = None,
     trace_id: Optional[bytes] = None,
+    deadline_s: Optional[float] = None,
 ) -> bytes:
-    """Encode arrays (+uuid, +optional error/trace_id) into one framed
-    message.  ``trace_id`` (16 bytes) is the telemetry correlation id;
-    ``None`` emits the exact pre-telemetry frame.  The contiguous form
-    of :func:`encode_arrays_sg` — one flattening join, counted under
-    the ``encode_join`` copy stage."""
+    """Encode arrays (+uuid, +optional error/trace_id/deadline_s) into
+    one framed message.  ``trace_id`` (16 bytes) is the telemetry
+    correlation id; ``deadline_s`` the remaining deadline budget (flag
+    bit 16); every optional ``None`` emits the exact pre-feature frame.
+    The contiguous form of :func:`encode_arrays_sg` — one flattening
+    join, counted under the ``encode_join`` copy stage."""
     parts = encode_arrays_sg(
-        arrays, uuid=uuid, error=error, trace_id=trace_id
+        arrays, uuid=uuid, error=error, trace_id=trace_id,
+        deadline_s=deadline_s,
     )
     if len(parts) == 1 and isinstance(parts[0], bytes):
         return parts[0]  # chaos path: already joined and filtered
@@ -347,6 +368,7 @@ def encode_batch(
     uuid: Optional[bytes] = None,
     error: Optional[str] = None,
     trace_id: Optional[bytes] = None,
+    deadline_s: Optional[float] = None,
 ) -> bytes:
     """Frame K already-encoded npwire messages as ONE batch message
     (flag bit 8).  ``items`` are complete frames — each keeps its own
@@ -370,6 +392,8 @@ def encode_batch(
                 f"trace_id must be 16 bytes, got {len(trace_id)}"
             )
         flags |= _FLAG_TRACE
+    if deadline_s is not None:
+        flags |= _FLAG_DEADLINE
     parts: List[bytes] = [
         struct.pack("<4sBB16sI", MAGIC, 1, flags, uuid, len(items))
     ]
@@ -379,6 +403,8 @@ def encode_batch(
         parts.append(err)
     if trace_id is not None:
         parts.append(trace_id)
+    if deadline_s is not None:
+        parts.append(struct.pack("<d", float(deadline_s)))
     for item in items:
         if item[:4] != MAGIC:
             raise WireError("batch items must be complete npwire frames")
@@ -399,6 +425,50 @@ def is_batch_frame(buf: bytes) -> bool:
         and buf[:4] == MAGIC
         and bool(buf[_FLAGS_OFF] & _FLAG_BATCH)
     )
+
+
+def frame_uuid(buf: bytes) -> bytes:
+    """The 16-byte correlation uuid at its fixed header offset — the
+    cheap read admission rejections need to answer in-band without
+    paying a full decode.  Raises :class:`WireError` on a frame too
+    short to carry one."""
+    if len(buf) < 22 or buf[:4] != MAGIC:
+        raise WireError("not an npwire frame")
+    return buf[6:22]
+
+
+def peek_deadline(buf: bytes) -> Optional[float]:
+    """The frame's remaining-deadline budget (flag bit 16) in seconds,
+    or ``None`` when the flag is clear — WITHOUT decoding arrays.  The
+    server-side admission reader: an expired budget must be rejected
+    before any decode/compute cost is paid.  Walks only the fixed-
+    offset blocks in front of the payload (error, trace), so the cost
+    is a handful of bounds checks.  Raises :class:`WireError` on a
+    frame whose leading blocks are truncated (the full decoder would
+    reject it identically)."""
+    try:
+        magic, version, flags = struct.unpack_from("<4sBB", buf, 0)
+    except struct.error as e:
+        raise WireError(f"truncated header: {e}") from None
+    if magic != MAGIC:
+        raise WireError(f"bad magic {magic!r}")
+    _check_flags(flags)
+    if not flags & _FLAG_DEADLINE:
+        return None
+    off = struct.calcsize("<4sBB16sI")
+    if flags & _FLAG_ERROR:
+        try:
+            (elen,) = struct.unpack_from("<I", buf, off)
+        except struct.error as e:
+            raise WireError(f"truncated error block: {e}") from None
+        off += 4 + elen
+    if flags & _FLAG_TRACE:
+        off += 16
+    try:
+        (budget,) = struct.unpack_from("<d", buf, off)
+    except struct.error as e:
+        raise WireError(f"truncated deadline block: {e}") from None
+    return budget
 
 
 def decode_batch(
@@ -439,6 +509,13 @@ def decode_batch(
             raise WireError("truncated trace block")
         trace_id = buf[off : off + 16]
         off += 16
+    if flags & _FLAG_DEADLINE:
+        # Consumed and dropped here: admission reads it pre-decode via
+        # peek_deadline (the enforcement point), so the tuple shapes
+        # every existing caller depends on stay stable.
+        if off + 8 > len(buf):
+            raise WireError("truncated deadline block")
+        off += 8
     items: List[bytes] = []
     for _ in range(n):
         try:
@@ -577,6 +654,12 @@ def decode_arrays_all(
             raise WireError("truncated trace block")
         trace_id = buf[off : off + 16]
         off += 16
+    if flags & _FLAG_DEADLINE:
+        # Consumed and dropped (peek_deadline is the admission-side
+        # reader; see decode_batch for the rationale).
+        if off + 8 > len(buf):
+            raise WireError("truncated deadline block")
+        off += 8
     arrays: List[np.ndarray] = []
     for _ in range(n):
         try:

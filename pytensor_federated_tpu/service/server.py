@@ -39,14 +39,20 @@ from ..signatures import ComputeFn
 from ..telemetry import flightrec as _flightrec
 from ..telemetry import metrics as _metrics
 from ..telemetry import spans as _spans
+from . import deadline as _deadline
+from . import npproto_codec
 from .batching import MicroBatcher, batched_compute_fn
 from .npwire import (
+    MAGIC,
+    WireError,
     append_spans,
     decode_arrays_ex,
     decode_batch,
     encode_arrays,
     encode_batch,
+    frame_uuid,
     is_batch_frame,
+    peek_deadline,
 )
 
 _log = logging.getLogger(__name__)
@@ -80,6 +86,11 @@ _COMPUTE_S = _metrics.histogram(
 )
 _ENCODE_S = _metrics.histogram(
     "pftpu_server_encode_seconds", "Reply wire-encode latency"
+)
+_ADMISSION_SHED = _metrics.counter(
+    "pftpu_admission_shed_total",
+    "Requests shed by server-side admission control, by reason",
+    ("reason",),
 )
 
 SERVICE_NAME = "ArraysToArraysService"
@@ -180,6 +191,8 @@ class ArraysToArraysService:
         max_batch: int = 32,
         max_wait_us: float = 200.0,
         batch_fn: Optional[Callable] = None,
+        max_queue: Optional[int] = None,
+        max_inflight_bytes: Optional[int] = None,
     ):
         """``getload_wire``: "npwire" (JSON reply, this package's
         native clients) or "npproto" (reference ``GetLoadResult``
@@ -226,7 +239,21 @@ class ArraysToArraysService:
         concurrently, replied as one frame) and the capability is
         still advertised, since the frame itself is a transport win
         regardless.  ``max_batch=1`` disables batch frames and the
-        engine entirely."""
+        engine entirely.
+
+        ``max_queue``/``max_inflight_bytes``: ADMISSION CONTROL — the
+        overload-protection half of ROADMAP item 3.  ``max_queue``
+        bounds the node's backlog (the larger of in-flight RPCs and
+        the micro-batcher's coalescing queue — a queued request is
+        also an in-flight RPC, counted once); ``max_inflight_bytes``
+        bounds the request bytes being served at once.  A full node
+        first sheds queued work whose deadline is already spent
+        (oldest-past-deadline first — those callers stopped waiting,
+        so computing them is pure load), then refuses the NEW request
+        with a retryable UNAVAILABLE so pinned clients rebalance and
+        pools fail over, composing with the graceful-drain rejection
+        below.  ``None`` (the default) keeps the historical unbounded
+        queues."""
         if getload_wire not in ("npwire", "npproto"):
             raise ValueError(
                 f"getload_wire must be 'npwire' or 'npproto', "
@@ -253,6 +280,12 @@ class ArraysToArraysService:
         # and :meth:`drain` waits for in-flight work to settle.
         self._draining = False
         self._inflight_rpcs = 0
+        # Admission-control state (constructor docstring).
+        self.max_queue = None if max_queue is None else int(max_queue)
+        self.max_inflight_bytes = (
+            None if max_inflight_bytes is None else int(max_inflight_bytes)
+        )
+        self._inflight_bytes = 0
         # Start psutil's interval-based CPU accounting early so the
         # first real query is meaningful (reference: service.py:84-85).
         try:
@@ -265,6 +298,44 @@ class ArraysToArraysService:
     # -- compute plumbing -------------------------------------------------
 
     async def _run_compute(self, request: bytes) -> bytes:
+        """Deadline admission, then dispatch (:meth:`_run_compute_inner`).
+
+        The request's remaining-budget field (npwire flag 16 / npproto
+        field 18, :mod:`.deadline`) is peeked BEFORE any decode cost:
+        an expired budget is answered with the in-band deadline
+        classification (npwire) or raised as
+        :class:`~.deadline.DeadlineExceeded` (npproto — the caller
+        aborts the RPC as DEADLINE_EXCEEDED, the status the reference
+        schema's error-field-free wire must use); a live one is bound
+        as the handler's ambient deadline so the micro-batcher queue
+        and the compute handoff inherit it."""
+        is_npwire = request[:4] == MAGIC
+        try:
+            budget = (
+                peek_deadline(request)
+                if is_npwire
+                else npproto_codec.peek_deadline_msg(request)
+            )
+        except WireError:
+            budget = None  # the codec path below rejects it loudly
+        err = _deadline.shed_expired_admission(budget, transport="grpc")
+        if err is not None:
+            if not is_npwire:
+                raise _deadline.DeadlineExceeded(err)
+            uid = frame_uuid(request)
+            # call_shimmed_async: the encoders hold sync chaos
+            # seams whose delay kinds sleep (the PR-5 bug class).
+            if is_batch_frame(request):
+                return await _fi.call_shimmed_async(
+                    encode_batch, [], uuid=uid, error=err
+                )
+            return await _fi.call_shimmed_async(
+                encode_arrays, [], uuid=uid, error=err
+            )
+        with _deadline.budget_scope(budget):
+            return await self._run_compute_inner(request)
+
+    async def _run_compute_inner(self, request: bytes) -> bytes:
         """decode -> compute (in executor) -> encode, echoing the uuid.
 
         Errors are encoded into the reply instead of tearing down the
@@ -282,9 +353,6 @@ class ArraysToArraysService:
         decode/compute errors raise here too and surface to the peer as
         a gRPC error, exactly what a reference client expects.
         """
-        from . import npproto_codec
-        from .npwire import MAGIC
-
         t_arrive = time.perf_counter()
         is_npwire = request[:4] == MAGIC
         # Wire batch frames (npwire flag bit 8 / npproto field 17): one
@@ -380,6 +448,17 @@ class ArraysToArraysService:
                         _COMPUTE_S.observe(t_c1 - t_c0)
                         c_span.set_attr("queue_wait_s", queue_wait)
                     outputs = [np.asarray(o) for o in outputs]
+            except _deadline.DeadlineExceeded as e:
+                # Shed, not failed: the batcher (or a nested client)
+                # abandoned work whose budget was spent — answer with
+                # the bare deadline classification (no "compute error"
+                # wrap, no traceback noise); npproto aborts the RPC as
+                # DEADLINE_EXCEEDED via the handler's catch.
+                if not is_npwire:
+                    raise
+                err_reply = await _fi.call_shimmed_async(
+                    encode_arrays, [], uuid=uuid, error=str(e)
+                )
             except Exception as e:
                 _log.exception("compute_fn failed")
                 _ERRORS.labels(kind="compute").inc()
@@ -548,8 +627,6 @@ class ArraysToArraysService:
         isolation channel the reference schema lacks; only this
         package's clients send batch messages (capability-gated), so
         no reference peer ever sees field 14/17."""
-        from . import npproto_codec
-
         # Outer decode errors raise -> gRPC abort, exactly like a
         # malformed plain npproto request (reference contract).
         items, outer_uuid, trace_id, _spans_in = (
@@ -668,18 +745,98 @@ class ArraysToArraysService:
     def draining(self) -> bool:
         return self._draining
 
+    # -- admission control ------------------------------------------------
+
+    async def _reject_overloaded(self, context, reason: str) -> None:
+        """Refuse one request at the door with a RETRYABLE status —
+        UNAVAILABLE is outside the clients' no-retry set, so a pinned
+        client rebalances and a pool books a transient failure and
+        fails over, exactly like the drain rejection.  The refusal is
+        the cheap outcome by design: under overload the work a node
+        does NOT accept is what keeps the work it did accept inside
+        its SLO."""
+        _ADMISSION_SHED.labels(reason=reason).inc()
+        _flightrec.record(
+            "admission.shed", transport="grpc", reason=reason
+        )
+        if context is not None:
+            await context.abort(
+                grpc.StatusCode.UNAVAILABLE,
+                f"node overloaded ({reason})",
+            )
+        raise ConnectionError(f"node overloaded ({reason})")
+
+    async def _admit(self, request: bytes, context) -> None:
+        """Bounded-queue admission (constructor docstring): drain
+        check, then queue-depth and in-flight-byte caps, shedding
+        already-expired batcher entries before refusing new work."""
+        await self._reject_if_draining(context)
+        if self.max_queue is not None:
+            def depth() -> int:
+                # A queued request is ALSO an in-flight RPC (its
+                # handler awaits the batcher), so summing the two
+                # would double-count every queued single and halve
+                # the effective cap.  max() counts each waiting
+                # request once and still sees a one-RPC batch window
+                # whose items outnumber its RPC.
+                b = self._batcher
+                return max(
+                    self._inflight_rpcs,
+                    b.queue_depth if b is not None else 0,
+                )
+
+            shed = 0
+            if depth() >= self.max_queue and self._batcher is not None:
+                # Shed oldest-past-deadline first: dead queue entries
+                # must not crowd out live callers.
+                shed = self._batcher.shed_expired()
+            # A shed entry's handler is still counted by
+            # _inflight_rpcs until its loop tick delivers the failed
+            # future through the RPC's finally block, so recheck
+            # against the depth the shed actually freed: exact for
+            # unary traffic (one queued entry == one RPC); batch
+            # windows already show the drop synchronously through
+            # queue_depth, which stays the floor of the max().
+            b = self._batcher
+            if max(
+                self._inflight_rpcs - shed,
+                b.queue_depth if b is not None else 0,
+            ) >= self.max_queue:
+                await self._reject_overloaded(context, "queue_full")
+        if (
+            self.max_inflight_bytes is not None
+            and self._inflight_rpcs > 0
+            and self._inflight_bytes + len(request)
+            > self.max_inflight_bytes
+        ):
+            # The idle-node exemption (_inflight_rpcs > 0): one
+            # request larger than the cap must degrade to serial
+            # service, not be refused forever.
+            await self._reject_overloaded(context, "inflight_bytes")
+
     # -- RPC methods ------------------------------------------------------
 
     async def evaluate(self, request: bytes, context) -> bytes:
-        await self._reject_if_draining(context)
+        await self._admit(request, context)
         _REQUESTS.labels(method="evaluate").inc()
         _INFLIGHT.inc()
         self._inflight_rpcs += 1
+        self._inflight_bytes += len(request)
         try:
             reply = await self._run_compute(request)
+        except _deadline.DeadlineExceeded as e:
+            # npproto lane (no in-band error field): the RPC aborts as
+            # DEADLINE_EXCEEDED — non-retryable in the client table,
+            # because the budget is spent everywhere at once.
+            if context is not None:
+                await context.abort(
+                    grpc.StatusCode.DEADLINE_EXCEEDED, str(e)
+                )
+            raise
         finally:
             _INFLIGHT.dec()
             self._inflight_rpcs -= 1
+            self._inflight_bytes -= len(request)
         if _fi.active_plan is not None:  # chaos seam: reply lane
             reply, _n = await _fi_reply_filter(reply, context, unary=True)
         return reply
@@ -691,18 +848,27 @@ class ArraysToArraysService:
         _log.info("stream opened (n_clients=%d)", self._n_clients)
         try:
             async for request in request_iterator:
-                # Per request, not per stream: a drain beginning mid-
-                # stream rejects the stream's NEXT request (retryable),
-                # while requests already being served run to completion.
-                await self._reject_if_draining(context)
+                # Per request, not per stream: a drain (or overload)
+                # beginning mid-stream rejects the stream's NEXT
+                # request (retryable), while requests already being
+                # served run to completion.
+                await self._admit(request, context)
                 _REQUESTS.labels(method="evaluate_stream").inc()
                 _INFLIGHT.inc()
                 self._inflight_rpcs += 1
+                self._inflight_bytes += len(request)
                 try:
                     reply = await self._run_compute(request)
+                except _deadline.DeadlineExceeded as e:
+                    if context is not None:
+                        await context.abort(
+                            grpc.StatusCode.DEADLINE_EXCEEDED, str(e)
+                        )
+                    raise
                 finally:
                     _INFLIGHT.dec()
                     self._inflight_rpcs -= 1
+                    self._inflight_bytes -= len(request)
                 if _fi.active_plan is not None:  # chaos seam: reply lane
                     reply, n_copies = await _fi_reply_filter(reply, context)
                     for _ in range(n_copies):
@@ -791,8 +957,6 @@ class ArraysToArraysService:
                 return garbage
         load = self.determine_load()
         if self.getload_wire == "npproto":
-            from . import npproto_codec
-
             return npproto_codec.encode_get_load_result(
                 load["n_clients"], load["percent_cpu"], load["percent_ram"]
             )
@@ -835,6 +999,8 @@ async def serve(
     ship_spans: bool = True,
     max_batch: int = 32,
     max_wait_us: float = 200.0,
+    max_queue: Optional[int] = None,
+    max_inflight_bytes: Optional[int] = None,
     service: Optional[ArraysToArraysService] = None,
     metrics_port: Optional[int] = None,
     metrics_host: str = "127.0.0.1",
@@ -864,6 +1030,8 @@ async def serve(
             ship_spans=ship_spans,
             max_batch=max_batch,
             max_wait_us=max_wait_us,
+            max_queue=max_queue,
+            max_inflight_bytes=max_inflight_bytes,
         )
     elif compute_fn is not None:
         raise ValueError(

@@ -78,6 +78,7 @@ from ..telemetry import flightrec as _flightrec
 from ..telemetry import spans as _spans
 from ..telemetry import watchdog as _watchdog
 from . import _rpc_metrics
+from . import deadline as _deadline
 from .arena import DEFAULT_ARENA_BYTES, Arena
 from .batching import execute_window_sync as _execute_window_sync
 from .npwire import (
@@ -121,7 +122,8 @@ _KNOWN_KINDS = frozenset(range(_KIND_ATTACH, _KIND_ERROR + 1))
 # Flag bits — mirrored from service/wire_registry.py SHMWIRE_FLAGS.
 _FLAG_ERROR = 1
 _FLAG_TRACE = 2
-_KNOWN_FLAGS = _FLAG_ERROR | _FLAG_TRACE
+_FLAG_DEADLINE = 4
+_KNOWN_FLAGS = _FLAG_ERROR | _FLAG_TRACE | _FLAG_DEADLINE
 
 _HEADER = struct.Struct("<4sBBBB16s")
 #: The arena descriptor — layout declared as SHM_DESC_STRUCT in
@@ -157,9 +159,13 @@ def encode_frame(
     *,
     error: Optional[str] = None,
     trace_id: Optional[bytes] = None,
+    deadline_s: Optional[float] = None,
 ) -> bytes:
     """One doorbell frame.  Descriptor-only — payload bytes NEVER ride
-    the doorbell; they live in the arena."""
+    the doorbell; they live in the arena.  ``deadline_s`` (flag bit 4)
+    carries the request's remaining deadline budget in relative
+    seconds (:mod:`.deadline`); ``None`` emits the pre-deadline
+    byte-identical frame."""
     if len(uuid) != 16:
         raise WireError(f"uuid must be 16 bytes, got {len(uuid)}")
     flags = 0
@@ -172,6 +178,8 @@ def encode_frame(
                 f"trace_id must be 16 bytes, got {len(trace_id)}"
             )
         flags |= _FLAG_TRACE
+    if deadline_s is not None:
+        flags |= _FLAG_DEADLINE
     parts.append(_HEADER.pack(MAGIC, 1, kind, flags, 0, uuid))
     if error is not None:
         err = error.encode("utf-8")
@@ -179,6 +187,8 @@ def encode_frame(
         parts.append(err)
     if trace_id is not None:
         parts.append(trace_id)
+    if deadline_s is not None:
+        parts.append(struct.pack("<d", float(deadline_s)))
     parts.append(body)
     out = b"".join(parts)
     if _fi.active_plan is not None:  # chaos seam (faultinject.runtime)
@@ -188,13 +198,17 @@ def encode_frame(
 
 def decode_frame(
     buf: bytes,
-) -> Tuple[int, bytes, Optional[str], Optional[bytes], int, bytes]:
+) -> Tuple[
+    int, bytes, Optional[str], Optional[bytes], Optional[float], int, bytes
+]:
     """Decode a doorbell frame header ->
-    ``(kind, uuid, error, trace_id, body_offset, frame)``; kind-
-    specific body parsing is the caller's, offset-based against the
-    RETURNED ``frame`` (which is ``buf`` unless the chaos seam
+    ``(kind, uuid, error, trace_id, deadline_s, body_offset, frame)``;
+    kind-specific body parsing is the caller's, offset-based against
+    the RETURNED ``frame`` (which is ``buf`` unless the chaos seam
     transformed it — parsing the original after a filtered header
-    would silently mix two byte streams)."""
+    would silently mix two byte streams).  ``deadline_s`` is the
+    remaining deadline budget off the wire (flag bit 4), ``None`` when
+    unbounded."""
     if _fi.active_plan is not None:  # chaos seam (faultinject.runtime)
         buf = _fi.filter_bytes("shm.decode", buf)
     try:
@@ -226,7 +240,14 @@ def decode_frame(
             raise WireError("truncated shm trace block")
         trace_id = buf[off : off + 16]
         off += 16
-    return kind, uuid, error, trace_id, off, buf
+    deadline_s = None
+    if flags & _FLAG_DEADLINE:
+        try:
+            (deadline_s,) = struct.unpack_from("<d", buf, off)
+        except struct.error as e:
+            raise WireError(f"truncated shm deadline block: {e}") from None
+        off += 8
+    return kind, uuid, error, trace_id, deadline_s, off, buf
 
 
 #: One decoded descriptor: (slot, delta, length, generation, dtype, shape).
@@ -272,12 +293,20 @@ def decode_descs(buf: bytes, off: int) -> Tuple[List[Desc], int]:
     return descs, off
 
 
-def _desc_region_offset(kind: int, trace_id: Optional[bytes]) -> int:
+def _desc_region_offset(
+    kind: int,
+    trace_id: Optional[bytes],
+    deadline_s: Optional[float] = None,
+) -> int:
     """Byte offset where an OUTGOING EVAL/EVAL_BATCH frame's
     descriptor region starts (ack watermark preserved — corrupting it
     would fault the RECLAMATION protocol, a different seam) — where
     the ``corrupt_descriptor`` chaos shim starts flipping."""
-    off = _HEADER.size + (16 if trace_id is not None else 0)
+    off = (
+        _HEADER.size
+        + (16 if trace_id is not None else 0)
+        + (8 if deadline_s is not None else 0)
+    )
     if kind == _KIND_EVAL:
         return off + 8  # past ack_gen
     if kind == _KIND_EVAL_BATCH:
@@ -374,13 +403,22 @@ class ShmArraysClient:
         connect_timeout_s: float = 30.0,
         connect_retries: int = 1,
         connect_backoff_s: float = 0.05,
+        timeout_s: Optional[float] = None,
     ) -> None:
+        """``timeout_s`` bounds each reply read; with an ambient
+        deadline bound (:mod:`.deadline`) the read is capped at the
+        REMAINING budget regardless, so a node that accepts then never
+        replies fails over within the caller's deadline instead of
+        blocking until the watchdog fires.  A fired bound closes the
+        (desynchronized) doorbell and surfaces as ``TimeoutError`` —
+        the transient classification, so pools fail the work over."""
         self.host = host
         self.port = int(port)
         self.retries = int(retries)
         self.copy = bool(copy)
         self.pin_arrays = bool(pin_arrays)
         self.max_inflight_bytes = max_inflight_bytes
+        self.timeout_s = None if timeout_s is None else float(timeout_s)
         self.connect_timeout_s = float(connect_timeout_s)
         self.connect_retries = int(connect_retries)
         self.connect_backoff_s = float(connect_backoff_s)
@@ -445,7 +483,7 @@ class ShmArraysClient:
         assert self._sock is not None
         uid = fast_uuid()
         self._send(encode_frame(_KIND_ATTACH, uid))
-        kind, ruid, error, _tid, off, frame = decode_frame(
+        kind, ruid, error, _tid, _dl, off, frame = decode_frame(
             self._read_frame()
         )
         if error is not None:
@@ -478,14 +516,22 @@ class ShmArraysClient:
             _send_frame(self._sock, frame)
 
     def _read_frame(self) -> bytes:
+        # Bounded read: the per-call timeout_s knob and the ambient
+        # deadline, whichever is tighter, as a TOTAL bound across the
+        # header+payload chunks; posture (expired-budget close,
+        # TimeoutError close, socket-timeout restore) is the shared
+        # _deadline.bounded_reader so the doorbell and the TCP socket
+        # lane cannot diverge.
         assert self._rfile is not None
-        hdr = self._rfile.read(4)
-        if hdr is None or len(hdr) < 4:
-            raise ConnectionError("peer closed mid-frame")
-        (n,) = struct.unpack("<I", hdr)
-        buf = self._rfile.read(n)
-        if buf is None or len(buf) < n:
-            raise ConnectionError("peer closed mid-frame")
+        assert self._sock is not None
+        with _deadline.bounded_reader(
+            self._sock,
+            self._rfile,
+            _deadline.recv_budget_s(self.timeout_s),
+            self.close,
+        ) as read_exact:
+            (n,) = struct.unpack("<I", read_exact(4))
+            buf = read_exact(n)
         if _fi.active_plan is not None:  # chaos seam
             buf = _fi.filter_bytes("shm.recv", buf, self._peer)
         return buf
@@ -615,7 +661,11 @@ class ShmArraysClient:
         return struct.pack("<Q", self._consumed_gen) + encode_descs(descs)
 
     def _apply_descriptor_chaos(
-        self, frame: bytes, kind: int, trace_id: Optional[bytes]
+        self,
+        frame: bytes,
+        kind: int,
+        trace_id: Optional[bytes],
+        deadline_s: Optional[float] = None,
     ) -> bytes:
         """The ``corrupt_descriptor`` chaos seam: flip bytes inside the
         descriptor block only (header corruption is ``corrupt_bytes``
@@ -624,7 +674,7 @@ class ShmArraysClient:
             return frame
         return _fi.corrupt_descriptor_bytes(
             "shm.descriptor", frame,
-            _desc_region_offset(kind, trace_id),
+            _desc_region_offset(kind, trace_id, deadline_s),
             peer=self._peer,
         )
 
@@ -668,6 +718,7 @@ class ShmArraysClient:
                 t0 = time.perf_counter()
                 try:
                     with _spans.span("call"):
+                        _deadline.check_remaining("shm evaluate")
                         self._connect()
                         with _spans.span("encode"):
                             uid = fast_uuid()
@@ -676,6 +727,7 @@ class ShmArraysClient:
                                 if _spans.enabled()
                                 else None
                             )
+                            budget = _deadline.wire_budget()
                             descs, slot, _nb = self._encode_request(
                                 arrays
                             )
@@ -684,9 +736,10 @@ class ShmArraysClient:
                                 uid,
                                 self._eval_body(descs),
                                 trace_id=trace_id,
+                                deadline_s=budget,
                             )
                             frame = self._apply_descriptor_chaos(
-                                frame, _KIND_EVAL, trace_id
+                                frame, _KIND_EVAL, trace_id, budget
                             )
                         self._send(frame)
                         reply = self._read_frame()
@@ -706,10 +759,11 @@ class ShmArraysClient:
             with _spans.span("decode"):
                 try:
                     outputs = self._consume_reply(reply, uid)
-                except RemoteComputeError:
-                    # In-band server error: the connection is still
-                    # correlated — free the request slot (the node is
-                    # done with it) and surface the error, no close.
+                except (RemoteComputeError, _deadline.DeadlineExceeded):
+                    # In-band server error (deadline sheds included):
+                    # the connection is still correlated — free the
+                    # request slot (the node is done with it) and
+                    # surface the error, no close.
                     self._free_transient(slot)
                     raise
                 except (WireError, RuntimeError):
@@ -730,7 +784,7 @@ class ShmArraysClient:
     def _consume_reply(
         self, reply: bytes, uid: bytes, *, force_copy: bool = False
     ) -> List[np.ndarray]:
-        kind, ruid, error, _tid, off, reply = decode_frame(reply)
+        kind, ruid, error, _tid, _dl, off, reply = decode_frame(reply)
         if kind == _KIND_ERROR:
             raise WireError(f"shm protocol error from node: {error}")
         if kind != _KIND_REPLY:
@@ -741,6 +795,8 @@ class ShmArraysClient:
             _flightrec.record(
                 "rpc.error", transport="shm", error=error[:200]
             )
+            if _deadline.is_deadline_error(error):
+                raise _deadline.DeadlineExceeded(error)
             raise RemoteComputeError(error)
         if ruid != uid:
             raise RuntimeError(
@@ -915,12 +971,14 @@ class ShmArraysClient:
             # space, and counting them would throttle a pinned
             # workload to lock-step depth (the byte cap guards the
             # ARENA, which only transient slots occupy).
+            budget = _deadline.wire_budget()
             descs, slot, nbytes = self._encode_request(requests[i])
             frame = encode_frame(
-                _KIND_EVAL, uid, self._eval_body(descs), trace_id=trace_id
+                _KIND_EVAL, uid, self._eval_body(descs),
+                trace_id=trace_id, deadline_s=budget,
             )
             frame = self._apply_descriptor_chaos(
-                frame, _KIND_EVAL, trace_id
+                frame, _KIND_EVAL, trace_id, budget
             )
             self._send(frame)
             pending.append((uid, slot, nbytes))
@@ -944,10 +1002,12 @@ class ShmArraysClient:
             inflight_bytes -= nbytes
             try:
                 outputs = self._consume_reply(reply, uid, force_copy=True)
-            except RemoteComputeError:
+            except (RemoteComputeError, _deadline.DeadlineExceeded):
                 # Drain in-flight replies so the connection stays
                 # correlated for the NEXT call, then surface the
                 # deterministic error (no retry) — tcp.py semantics.
+                # Deadline sheds are in-band too: the node answered,
+                # the connection is healthy.
                 try:
                     for _ in range(write_idx - read_idx - 1):
                         self._read_frame()
@@ -1103,11 +1163,13 @@ class ShmArraysClient:
                         for uid, block in zip(item_uids, item_blocks)
                     )
                 )
+                budget = _deadline.wire_budget()
                 frame = encode_frame(
-                    _KIND_EVAL_BATCH, outer_uuid, body, trace_id=trace_id
+                    _KIND_EVAL_BATCH, outer_uuid, body,
+                    trace_id=trace_id, deadline_s=budget,
                 )
                 frame = self._apply_descriptor_chaos(
-                    frame, _KIND_EVAL_BATCH, trace_id
+                    frame, _KIND_EVAL_BATCH, trace_id, budget
                 )
                 _FRAME_REQS.labels(transport="shm").observe(len(part))
                 self._send(frame)
@@ -1122,7 +1184,7 @@ class ShmArraysClient:
             inflight.pop(0)
             first_error: Optional[str] = None
             try:
-                kind, ruid, outer_err, _tid, off, reply = decode_frame(
+                kind, ruid, outer_err, _tid, _dl, off, reply = decode_frame(
                     reply
                 )
                 if kind == _KIND_ERROR:
@@ -1209,6 +1271,8 @@ class ShmArraysClient:
                 else:
                     for k2 in range(read_idx, write_idx):
                         self._free_transient(frames[k2][3])
+                if _deadline.is_deadline_error(first_error):
+                    raise _deadline.DeadlineExceeded(first_error)
                 raise RemoteComputeError(first_error)
             self._free_transient(slot)
             read_idx += 1
@@ -1225,7 +1289,7 @@ class ShmArraysClient:
         self._send(encode_frame(_KIND_GETLOAD, uid))
         reply = self._read_frame()
         try:
-            kind, ruid, error, _tid, off, reply = decode_frame(reply)
+            kind, ruid, error, _tid, _dl, off, reply = decode_frame(reply)
             if kind != _KIND_LOAD or ruid != uid or error is not None:
                 return None
             (jlen,) = struct.unpack_from("<I", reply, off)
@@ -1254,7 +1318,7 @@ class ShmArraysClient:
             encode_frame(_KIND_PING, uid, encode_descs(descs))
         )
         try:
-            kind, ruid, error, _tid, _off, _frame = decode_frame(
+            kind, ruid, error, _tid, _dl, _off, _frame = decode_frame(
                 self._read_frame()
             )
             if kind != _KIND_PONG or ruid != uid:
@@ -1434,7 +1498,9 @@ class _ShmConnection:
             return serve_npwire_payload(
                 self.compute_fn, payload, transport="shm"
             )
-        kind, uid, _err, trace_id, off, payload = decode_frame(payload)
+        kind, uid, _err, trace_id, deadline_s, off, payload = decode_frame(
+            payload
+        )
         if kind == _KIND_ATTACH:
             return self._attach_reply(uid)
         if self.req_arena is None:
@@ -1442,10 +1508,25 @@ class _ShmConnection:
                 _KIND_ERROR, uid, error="shm frame before ATTACH"
             )
         self._unlink_arenas()
-        if kind == _KIND_EVAL:
-            return self._serve_eval(payload, uid, trace_id, off)
-        if kind == _KIND_EVAL_BATCH:
-            return self._serve_eval_batch(payload, uid, trace_id, off)
+        if kind in (_KIND_EVAL, _KIND_EVAL_BATCH):
+            # Admission enforcement: an expired budget is answered in
+            # band and never computed (service/deadline.py vocabulary).
+            err = _deadline.shed_expired_admission(
+                deadline_s, transport="shm"
+            )
+            if err is not None:
+                if kind == _KIND_EVAL:
+                    return encode_frame(
+                        _KIND_REPLY, uid, encode_descs([]), error=err
+                    )
+                return encode_frame(
+                    _KIND_REPLY_BATCH, uid,
+                    struct.pack("<I", 0), error=err,
+                )
+            with _deadline.budget_scope(deadline_s):
+                if kind == _KIND_EVAL:
+                    return self._serve_eval(payload, uid, trace_id, off)
+                return self._serve_eval_batch(payload, uid, trace_id, off)
         if kind == _KIND_ACK:
             try:
                 (ack,) = struct.unpack_from("<Q", payload, off)

@@ -29,8 +29,10 @@ from ..telemetry import reunion as _reunion
 from ..telemetry import spans as _spans
 from ..telemetry import watchdog as _watchdog
 from . import _rpc_metrics
+from . import deadline as _deadline
 from .batching import execute_window_sync as _execute_window_sync
 from .npwire import (
+    WireError,
     append_spans,
     fast_uuid,
     decode_arrays_all,
@@ -39,7 +41,9 @@ from .npwire import (
     encode_arrays,
     encode_arrays_sg,
     encode_batch,
+    frame_uuid,
     is_batch_frame,
+    peek_deadline,
     sg_nbytes,
 )
 
@@ -63,6 +67,7 @@ class RemoteComputeError(RuntimeError):
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
     chunks = []
     while n > 0:
+        # graftlint: disable=unbounded-wait -- server frame loop: waiting for the NEXT request is the node's idle state, bounded only by the peer disconnecting
         b = sock.recv(n)
         if not b:
             raise ConnectionError("peer closed mid-frame")
@@ -150,6 +155,7 @@ class TcpArraysClient:
         connect_timeout_s: float = 30.0,
         connect_retries: int = 1,
         connect_backoff_s: float = 0.05,
+        timeout_s: Optional[float] = None,
     ):
         """``max_inflight_bytes`` caps the pipelined window's in-flight
         REQUEST bytes (deadlock guard, see ``evaluate_many``).  The
@@ -165,11 +171,21 @@ class TcpArraysClient:
         connect with a ``connect_backoff_s`` pause between tries —
         exhaustion raises :class:`ConnectionError`, which every caller
         (the retry loop here, the replica pool's ``is_transient``)
-        classifies as transport trouble, so failover proceeds cleanly."""
+        classifies as transport trouble, so failover proceeds cleanly.
+
+        ``timeout_s`` bounds each reply read; with an ambient deadline
+        bound (:mod:`.deadline`) the read is ALSO capped at the
+        remaining budget, so a node that accepts then never replies
+        fails over within the caller's deadline instead of blocking
+        until the watchdog fires.  A fired bound closes the
+        (desynchronized) connection and surfaces as ``TimeoutError`` —
+        an ``OSError``, i.e. the transient classification every retry
+        loop and pool already fails over on."""
         self.host = host
         self.port = int(port)
         self.retries = retries
         self.max_inflight_bytes = max_inflight_bytes
+        self.timeout_s = None if timeout_s is None else float(timeout_s)
         self.connect_timeout_s = float(connect_timeout_s)
         self.connect_retries = int(connect_retries)
         self.connect_backoff_s = float(connect_backoff_s)
@@ -209,15 +225,21 @@ class TcpArraysClient:
             self._rfile = s.makefile("rb")
         return self._sock
 
-    def _read_exact(self, n: int) -> bytes:
-        buf = self._rfile.read(n)
-        if buf is None or len(buf) < n:
-            raise ConnectionError("peer closed mid-frame")
-        return buf
-
     def _read_frame(self) -> bytes:
-        (n,) = struct.unpack("<I", self._read_exact(4))
-        return self._read_exact(n)
+        # Bounded read: the per-call timeout_s knob and the ambient
+        # deadline, whichever is tighter, as a TOTAL bound across the
+        # header+payload chunks; posture (expired-budget close,
+        # TimeoutError close, socket-timeout restore) is the shared
+        # _deadline.bounded_reader so the shm doorbell cannot diverge.
+        assert self._sock is not None and self._rfile is not None
+        with _deadline.bounded_reader(
+            self._sock,
+            self._rfile,
+            _deadline.recv_budget_s(self.timeout_s),
+            self.close,
+        ) as read_exact:
+            (n,) = struct.unpack("<I", read_exact(4))
+            return read_exact(n)
 
     def close(self) -> None:
         if self._sock is not None:
@@ -248,15 +270,18 @@ class TcpArraysClient:
                 trace_id = (
                     _spans.current_trace_id() if _spans.enabled() else None
                 )
+                _deadline.check_remaining("tcp evaluate")
                 # Scatter/gather encode: the frame stays a buffer
                 # vector (header bytes + views of the input arrays)
                 # until sendmsg hands the pieces to the kernel — no
-                # contiguous-frame copy.  ``arrays`` outlives the send,
+                # contiguous-frame copy.  ``norm`` outlives the send,
                 # so the views stay valid across retries.
+                norm = [np.asarray(a) for a in arrays]
                 request = encode_arrays_sg(
-                    [np.asarray(a) for a in arrays],
+                    norm,
                     uuid=uid,
                     trace_id=trace_id,
+                    deadline_s=_deadline.wire_budget(),
                 )
                 request_len = sg_nbytes(request)
             last_err: Optional[Exception] = None
@@ -266,6 +291,25 @@ class TcpArraysClient:
                     _flightrec.record(
                         "rpc.retry", transport="tcp", attempt=attempt
                     )
+                    # A spent budget must stop the reconnect loop: a
+                    # retry past it can only add load, never an answer
+                    # the caller is still waiting for.
+                    _deadline.check_remaining("tcp retry")
+                    # Restamp the REMAINING budget: re-sending the
+                    # attempt-0 frame would advertise the budget as it
+                    # stood before the failed attempts burned wall
+                    # time, so the server would admit (and the batcher
+                    # keep) work whose caller is closer to giving up
+                    # than the wire claims.
+                    budget = _deadline.wire_budget()
+                    if budget is not None:
+                        request = encode_arrays_sg(
+                            norm,
+                            uuid=uid,
+                            trace_id=trace_id,
+                            deadline_s=budget,
+                        )
+                        request_len = sg_nbytes(request)
                 t0 = time.perf_counter()
                 try:
                     with _spans.span("call"):
@@ -319,6 +363,8 @@ class TcpArraysClient:
                 _flightrec.record(
                     "rpc.error", transport="tcp", error=error[:200]
                 )
+                if _deadline.is_deadline_error(error):
+                    raise _deadline.DeadlineExceeded(error)
                 raise RemoteComputeError(error)
             if reply_uid != uid:
                 # A mismatched reply means this connection is
@@ -451,6 +497,7 @@ class TcpArraysClient:
                 # (buffer-vector, frame length, uuid) per request: the
                 # scatter/gather form survives until sendmsg (or, on
                 # the batch-frame path, until the frames are packed).
+                budget = _deadline.wire_budget()
                 encoded = []
                 for args in requests:
                     uid = fast_uuid()
@@ -458,6 +505,7 @@ class TcpArraysClient:
                         [np.asarray(a) for a in args],
                         uuid=uid,
                         trace_id=trace_id,
+                        deadline_s=budget,
                     )
                     encoded.append((parts, sg_nbytes(parts), uid))
             if not encoded:
@@ -547,6 +595,7 @@ class TcpArraysClient:
                 # (buffer-vector, frame length, uuid) per request: the
                 # scatter/gather form survives until sendmsg (or, on
                 # the batch-frame path, until the frames are packed).
+                budget = _deadline.wire_budget()
                 encoded = []
                 for args in requests:
                     uid = fast_uuid()
@@ -554,6 +603,7 @@ class TcpArraysClient:
                         [np.asarray(a) for a in args],
                         uuid=uid,
                         trace_id=trace_id,
+                        deadline_s=budget,
                     )
                     encoded.append((parts, sg_nbytes(parts), uid))
             if not encoded:
@@ -669,6 +719,8 @@ class TcpArraysClient:
                 except (ConnectionError, OSError):
                     _DROPS.labels(transport="tcp").inc()
                     self.close()
+                if _deadline.is_deadline_error(error):
+                    raise _deadline.DeadlineExceeded(error)
                 raise RemoteComputeError(error)
             if reply_uid != uid:
                 _DROPS.labels(transport="tcp").inc()
@@ -702,6 +754,10 @@ class TcpArraysClient:
             # Batch frames nest COMPLETE item frames, so the
             # scatter/gather vectors are joined here — one flattening
             # per item, same count as the pre-sendmsg wire.
+            # The server peeks the OUTER frame only (serve_npwire
+            # _payload), so admission and the ambient budget ride the
+            # batch frame's deadline — same contract as the gRPC
+            # lane's _encode_batch_frame and the shm doorbell.
             frame = encode_batch(
                 [
                     req[0] if len(req) == 1 and isinstance(req[0], bytes)
@@ -710,6 +766,7 @@ class TcpArraysClient:
                 ],
                 uuid=outer_uuid,
                 trace_id=trace_id,
+                deadline_s=_deadline.wire_budget(),
             )
             _FRAME_REQS.labels(transport="tcp").observe(len(part))
             frames.append((frame, outer_uuid, start, part))
@@ -817,6 +874,8 @@ class TcpArraysClient:
                 except (ConnectionError, OSError):
                     _DROPS.labels(transport="tcp").inc()
                     self.close()
+                if _deadline.is_deadline_error(first_error):
+                    raise _deadline.DeadlineExceeded(first_error)
                 raise RemoteComputeError(first_error)
             read_idx += 1
         return results
@@ -968,16 +1027,33 @@ def serve_npwire_payload(
     the whole node-side npwire contract as a function, so any framed
     byte channel (TCP accept loop, shm doorbell) serves identically.
     ``request_views`` opts the request decode into zero-copy read-only
-    views (see :func:`_serve_plain_payload`)."""
-    if is_batch_frame(payload):
-        return _serve_batch_payload(
+    views (see :func:`_serve_plain_payload`).
+
+    Deadline admission (flag bit 16, :mod:`.deadline`): an expired
+    budget is answered with the in-band deadline classification BEFORE
+    any decode or compute cost is paid; a live one is re-bound as the
+    handler's ambient deadline so the compute inherits it."""
+    batch = is_batch_frame(payload)
+    try:
+        budget = peek_deadline(payload)
+    except WireError:
+        budget = None  # the full decoder will reject it loudly below
+    err = _deadline.shed_expired_admission(budget, transport=transport)
+    if err is not None:
+        uid = frame_uuid(payload)
+        if batch:
+            return encode_batch([], uuid=uid, error=err)
+        return encode_arrays([], uuid=uid, error=err)
+    with _deadline.budget_scope(budget):
+        if batch:
+            return _serve_batch_payload(
+                compute_fn, payload, transport=transport,
+                request_views=request_views,
+            )
+        return _serve_plain_payload(
             compute_fn, payload, transport=transport,
             request_views=request_views,
         )
-    return _serve_plain_payload(
-        compute_fn, payload, transport=transport,
-        request_views=request_views,
-    )
 
 
 def _serve_tcp_connection(

@@ -51,10 +51,11 @@ __all__ = [
 #: npwire frame flag bits, by canonical name.  npwire.py spells these
 #: ``_FLAG_<NAME>``; native/cpp_node.cpp spells them ``kFlag<Name>``.
 NPWIRE_FLAGS = {
-    "ERROR": 1,   # in-band error string block follows the header
-    "TRACE": 2,   # 16-byte telemetry trace id block
-    "SPANS": 4,   # JSON span-tree tail (reply piggyback)
-    "BATCH": 8,   # count field is n_items; body is nested frames
+    "ERROR": 1,     # in-band error string block follows the header
+    "TRACE": 2,     # 16-byte telemetry trace id block
+    "SPANS": 4,     # JSON span-tree tail (reply piggyback)
+    "BATCH": 8,     # count field is n_items; body is nested frames
+    "DEADLINE": 16,  # f64 remaining-budget block (service/deadline.py)
 }
 
 #: The full known-flags mask every npwire decoder must enforce
@@ -82,6 +83,7 @@ NPPROTO_FIELDS = {
         "trace_id": 15,     # 16-byte telemetry correlation id
         "spans": 16,        # JSON span trees, reply piggyback
         "batch_items": 17,  # nested messages: the batch frame marker
+        "deadline_s": 18,   # fixed64 double: remaining deadline budget
     },
     "get_load_result": {
         "n_clients": 1,
@@ -126,8 +128,9 @@ SHMWIRE_KINDS = {
 #: the same bit assignments; the spans/batch features ride dedicated
 #: frame kinds instead of flag bits on this lane.
 SHMWIRE_FLAGS = {
-    "ERROR": 1,  # in-band error string block follows the uuid
-    "TRACE": 2,  # 16-byte telemetry trace id block
+    "ERROR": 1,     # in-band error string block follows the uuid
+    "TRACE": 2,     # 16-byte telemetry trace id block
+    "DEADLINE": 4,  # f64 remaining-budget block (service/deadline.py)
 }
 
 #: The full known-flags mask every shm decoder must enforce

@@ -1,0 +1,1355 @@
+"""ISSUE 10 — overload-safe serving: end-to-end deadlines, retry
+budgets, and admission control.
+
+Covers the tentpole and its satellites:
+
+- the contextvar budget (`service/deadline.py`): scope tightening,
+  expiry, the fail-fast guard, wire form;
+- the wire field on every codec — npwire flag 16, npproto field 18,
+  shm doorbell flag 4 — with the BYTE-IDENTICAL contract for
+  deadline-free frames and the reference-protobuf-runtime-ignores-it
+  contract for field 18;
+- server enforcement: admission rejection of expired work (in-band
+  npwire error / npproto DEADLINE_EXCEEDED abort), micro-batcher queue
+  shedding, bounded-queue admission control (`max_queue` /
+  `max_inflight_bytes` + retryable UNAVAILABLE);
+- client classification: in-band deadline errors raise
+  `DeadlineExceeded`; gRPC `DEADLINE_EXCEEDED` is NON-retryable on
+  both codecs (the PR-1 status-table satellite); bounded reads against
+  a server that accepts then never replies (TCP + shm) surface as the
+  TRANSIENT classification inside the budget;
+- the per-pool retry budget (`routing/budget.py`): token-bucket
+  semantics, hedges/failover/fanout member re-runs charging it, refill
+  reconvergence;
+- the `slow_compute` fault kind: seeded, bounded, replayable.
+"""
+
+import asyncio
+import socket
+import struct
+import threading
+import time
+
+import grpc
+import numpy as np
+import pytest
+
+from pytensor_federated_tpu.service import deadline as dl
+from pytensor_federated_tpu.service import npproto_codec as npp
+from pytensor_federated_tpu.service import npwire
+from pytensor_federated_tpu.service.npwire import WireError
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _double(x):
+    return [2.0 * np.asarray(x)]
+
+
+# ---------------------------------------------------------------------------
+# the budget itself
+# ---------------------------------------------------------------------------
+
+
+class TestDeadlineModule:
+    def test_unbounded_default(self):
+        assert dl.current_deadline() is None
+        assert dl.remaining_s() is None
+        assert not dl.expired()
+        assert dl.wire_budget() is None
+        assert dl.check_remaining("here") is None  # no-op, no raise
+
+    def test_scope_binds_and_restores(self):
+        with dl.deadline_scope(5.0):
+            r = dl.remaining_s()
+            assert r is not None and 4.0 < r <= 5.0
+            assert dl.wire_budget() is not None
+        assert dl.remaining_s() is None
+
+    def test_nested_scopes_only_tighten(self):
+        with dl.deadline_scope(0.5):
+            outer = dl.current_deadline()
+            with dl.deadline_scope(60.0):
+                # An inner retry loop cannot mint itself fresh budget.
+                assert dl.current_deadline() == outer
+            with dl.deadline_scope(0.01):
+                assert dl.current_deadline() < outer
+
+    def test_none_scope_is_a_no_op(self):
+        with dl.deadline_scope(None):
+            assert dl.remaining_s() is None
+
+    def test_expiry_and_fail_fast(self):
+        with dl.deadline_scope(0.0):
+            time.sleep(0.002)
+            assert dl.expired()
+            with pytest.raises(dl.DeadlineExceeded) as ei:
+                dl.check_remaining("encode")
+            assert dl.is_deadline_error(str(ei.value))
+
+    def test_classification_is_substring_not_prefix(self):
+        # Servers wrap shed messages in their own stage prefixes.
+        assert dl.is_deadline_error(
+            "compute error: deadline exceeded: shed in queue"
+        )
+        assert not dl.is_deadline_error("sigma must be positive")
+        assert not dl.is_deadline_error(None)
+
+    def test_deadline_exceeded_is_deterministic_for_pools(self):
+        """RuntimeError subclass on purpose: every lane classifies it
+        as non-transient, so failover/retry never amplify a spent
+        budget."""
+        from pytensor_federated_tpu.routing import NodePool
+
+        exc = dl.DeadlineExceeded(dl.deadline_error("x"))
+        assert isinstance(exc, RuntimeError)
+        assert not NodePool().is_transient(exc)
+
+    def test_crosses_executor_with_copy_context(self):
+        import contextvars
+        from concurrent.futures import ThreadPoolExecutor
+
+        with dl.deadline_scope(5.0):
+            ctx = contextvars.copy_context()
+            with ThreadPoolExecutor(1) as ex:
+                r = ex.submit(ctx.run, dl.remaining_s).result()
+        assert r is not None and r > 4.0
+
+
+# ---------------------------------------------------------------------------
+# the wire field, all three codecs
+# ---------------------------------------------------------------------------
+
+
+class TestNpwireDeadlineField:
+    def test_deadline_free_frame_is_byte_identical(self):
+        """The acceptance invariant: no deadline bound -> the exact
+        pre-deadline frame (flag clear, no block)."""
+        a = [np.arange(6, dtype=np.float32)]
+        frame = npwire.encode_arrays(a, uuid=b"u" * 16)
+        assert not frame[npwire._FLAGS_OFF] & npwire._FLAG_DEADLINE
+        # Hand-assembled pre-ISSUE-10 layout for this exact frame.
+        payload = a[0].tobytes()
+        expected = (
+            struct.pack("<4sBB16sI", b"NPW1", 1, 0, b"u" * 16, 1)
+            + struct.pack("<H", 3) + b"<f4"
+            + struct.pack("<B", 1) + struct.pack("<Q", 6)
+            + struct.pack("<Q", len(payload)) + payload
+        )
+        assert frame == expected
+        assert npwire.peek_deadline(frame) is None
+
+    def test_roundtrip_with_deadline(self):
+        a = [np.arange(4.0)]
+        frame = npwire.encode_arrays(
+            a, uuid=b"u" * 16, trace_id=b"t" * 16, deadline_s=1.25
+        )
+        assert npwire.peek_deadline(frame) == 1.25
+        arrays, uuid, error, trace_id, _sp = npwire.decode_arrays_all(
+            frame
+        )
+        np.testing.assert_array_equal(arrays[0], a[0])
+        assert (uuid, error, trace_id) == (b"u" * 16, None, b"t" * 16)
+
+    def test_batch_frame_carries_outer_deadline(self):
+        item = npwire.encode_arrays([np.ones(2)], uuid=b"i" * 16)
+        frame = npwire.encode_batch(
+            [item], uuid=b"w" * 16, deadline_s=0.5
+        )
+        assert npwire.peek_deadline(frame) == 0.5
+        items, uuid, _e, _t, _s = npwire.decode_batch(frame)
+        assert items == [item] and uuid == b"w" * 16
+
+    def test_truncated_deadline_block_is_loud(self):
+        frame = npwire.encode_arrays(
+            [np.ones(1)], uuid=b"u" * 16, deadline_s=1.0
+        )
+        off = struct.calcsize("<4sBB16sI")
+        with pytest.raises(WireError):
+            npwire.decode_arrays_all(frame[: off + 4])
+        with pytest.raises(WireError):
+            npwire.peek_deadline(frame[: off + 4])
+
+    def test_sg_encoder_matches_contiguous(self):
+        a = [np.arange(8, dtype=np.float64)]
+        vec = npwire.encode_arrays_sg(
+            a, uuid=b"u" * 16, deadline_s=2.0
+        )
+        assert b"".join(
+            bytes(p) for p in vec
+        ) == npwire.encode_arrays(a, uuid=b"u" * 16, deadline_s=2.0)
+
+    def test_frame_uuid_fixed_offset(self):
+        frame = npwire.encode_arrays(
+            [], uuid=b"q" * 16, deadline_s=-1.0
+        )
+        assert npwire.frame_uuid(frame) == b"q" * 16
+        with pytest.raises(WireError):
+            npwire.frame_uuid(b"NPW1\x01")
+
+
+class TestNpprotoDeadlineField:
+    def test_deadline_free_message_unchanged(self):
+        a = [np.arange(3.0)]
+        msg = npp.encode_arrays_msg(a, uuid="abc")
+        assert npp.peek_deadline_msg(msg) is None
+        # Field 18 never appears without a deadline.
+        assert npp._tag(18, npp._WT_I64) not in msg
+
+    def test_roundtrip_and_peek(self):
+        a = [np.arange(3.0)]
+        msg = npp.encode_arrays_msg(a, uuid="abc", deadline_s=3.5)
+        assert npp.peek_deadline_msg(msg) == 3.5
+        arrays, uuid, error, _t, _s = npp.decode_arrays_msg_full(msg)
+        np.testing.assert_array_equal(arrays[0], a[0])
+        assert (uuid, error) == ("abc", None)
+
+    def test_batch_message_carries_outer_deadline(self):
+        item = npp.encode_arrays_msg([np.ones(2)], uuid="i")
+        msg = npp.encode_batch_msg([item], uuid="w", deadline_s=0.25)
+        assert npp.peek_deadline_msg(msg) == 0.25
+        items, uuid, _t, _s = npp.decode_batch_msg(msg)
+        assert items == [item] and uuid == "w"
+
+    def test_reference_protobuf_runtime_skips_field_18(self):
+        """The forward-compatibility acceptance: an unmodified
+        reference peer (official protobuf runtime) parses a message
+        carrying field 18 and sees the same items/uuid."""
+        protobuf = pytest.importorskip("google.protobuf")
+        from google.protobuf import descriptor_pb2, descriptor_pool
+        from google.protobuf import message_factory
+
+        del protobuf
+        fdp = descriptor_pb2.FileDescriptorProto()
+        fdp.name = "ref_deadline.proto"
+        fdp.syntax = "proto3"
+        msg_t = fdp.message_type.add()
+        msg_t.name = "InputArrays"
+        item_f = msg_t.field.add()
+        item_f.name = "items"
+        item_f.number = 1
+        item_f.type = descriptor_pb2.FieldDescriptorProto.TYPE_BYTES
+        item_f.label = descriptor_pb2.FieldDescriptorProto.LABEL_REPEATED
+        uuid_f = msg_t.field.add()
+        uuid_f.name = "uuid"
+        uuid_f.number = 2
+        uuid_f.type = descriptor_pb2.FieldDescriptorProto.TYPE_STRING
+        uuid_f.label = descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL
+        pool = descriptor_pool.DescriptorPool()
+        pool.Add(fdp)
+        desc = pool.FindMessageTypeByName("InputArrays")
+        cls = message_factory.GetMessageClass(desc)
+        wire = npp.encode_arrays_msg(
+            [np.ones(2)], uuid="ref-check", deadline_s=9.75
+        )
+        parsed = cls.FromString(wire)
+        assert parsed.uuid == "ref-check"
+        assert len(parsed.items) == 1  # field 18 skipped by wire type
+
+
+class TestShmDeadlineField:
+    def test_frame_flag_and_roundtrip(self):
+        from pytensor_federated_tpu.service import shm
+
+        bare = shm.encode_frame(shm._KIND_EVAL, b"u" * 16, b"body")
+        assert not bare[6] & shm._FLAG_DEADLINE  # flags byte offset 6
+        k, u, e, t, d, off, frame = shm.decode_frame(bare)
+        assert d is None and frame[off:] == b"body"
+        stamped = shm.encode_frame(
+            shm._KIND_EVAL, b"u" * 16, b"body", deadline_s=0.75
+        )
+        k, u, e, t, d, off, frame = shm.decode_frame(stamped)
+        assert d == 0.75 and frame[off:] == b"body"
+        # The deadline block is exactly the 8-byte delta.
+        assert len(stamped) == len(bare) + 8
+
+    def test_truncated_deadline_block_is_loud(self):
+        from pytensor_federated_tpu.service import shm
+
+        stamped = shm.encode_frame(
+            shm._KIND_EVAL, b"u" * 16, deadline_s=0.75
+        )
+        with pytest.raises(WireError):
+            shm.decode_frame(stamped[:-4])
+
+
+# ---------------------------------------------------------------------------
+# server enforcement
+# ---------------------------------------------------------------------------
+
+
+class TestServeNpwirePayloadAdmission:
+    """The TCP/shm shared serving path (`tcp.serve_npwire_payload`)."""
+
+    def test_expired_plain_frame_rejected_in_band(self):
+        from pytensor_federated_tpu.service.tcp import serve_npwire_payload
+
+        req = npwire.encode_arrays(
+            [np.ones(2)], uuid=b"q" * 16, deadline_s=-0.5
+        )
+        reply = serve_npwire_payload(_double, req)
+        arrays, uuid, error = npwire.decode_arrays(reply)
+        assert uuid == b"q" * 16 and arrays == []
+        assert dl.is_deadline_error(error)
+
+    def test_expired_batch_frame_rejected_in_band(self):
+        from pytensor_federated_tpu.service.tcp import serve_npwire_payload
+
+        item = npwire.encode_arrays([np.ones(2)], uuid=b"i" * 16)
+        req = npwire.encode_batch(
+            [item], uuid=b"w" * 16, deadline_s=-0.5
+        )
+        reply = serve_npwire_payload(_double, req)
+        items, uuid, error, _t, _s = npwire.decode_batch(reply)
+        assert uuid == b"w" * 16 and items == []
+        assert dl.is_deadline_error(error)
+
+    def test_live_budget_is_served_and_bound(self):
+        from pytensor_federated_tpu.service.tcp import serve_npwire_payload
+
+        seen = {}
+
+        def compute(x):
+            seen["remaining"] = dl.remaining_s()
+            return [2.0 * np.asarray(x)]
+
+        req = npwire.encode_arrays(
+            [np.arange(3.0)], uuid=b"q" * 16, deadline_s=5.0
+        )
+        reply = serve_npwire_payload(compute, req)
+        arrays, _u, error = npwire.decode_arrays(reply)
+        assert error is None
+        np.testing.assert_array_equal(arrays[0], 2.0 * np.arange(3.0))
+        # The compute ran under the adopted budget.
+        assert seen["remaining"] is not None and 0 < seen["remaining"] <= 5.0
+
+
+class TestTcpBatchedWindowDeadline:
+    def test_outer_batch_frame_carries_budget_to_server(self):
+        """Regression (round-10 review): the TCP batched-window path
+        must stamp the deadline on the OUTER batch frame — the server
+        peeks only that frame, so an unstamped outer frame silently
+        skipped admission and compute never ran under the budget
+        (the gRPC `_encode_batch_frame` and shm doorbell lanes always
+        stamped theirs)."""
+        from pytensor_federated_tpu.service import serve_tcp_once
+        from pytensor_federated_tpu.service.tcp import TcpArraysClient
+
+        seen = []
+
+        def compute(x):
+            seen.append(dl.remaining_s())
+            return [2.0 * np.asarray(x)]
+
+        ready = {}
+        ev = threading.Event()
+
+        def cb(p):
+            ready["port"] = p
+            ev.set()
+
+        threading.Thread(
+            target=serve_tcp_once,
+            args=(compute,),
+            kwargs=dict(ready_callback=cb, max_connections=1),
+            daemon=True,
+        ).start()
+        assert ev.wait(10)
+        client = TcpArraysClient("127.0.0.1", ready["port"])
+        try:
+            reqs = [(np.array([float(i)]),) for i in range(4)]
+            with dl.deadline_scope(5.0):
+                res = client.evaluate_many(reqs, window=4, batch=True)
+            for i, out in enumerate(res):
+                np.testing.assert_array_equal(out[0], [2.0 * i])
+            assert len(seen) == 4
+            # Every item computed under the adopted wire budget.
+            assert all(r is not None and 0 < r <= 5.0 for r in seen)
+        finally:
+            client.close()
+
+
+class TestGrpcServiceAdmission:
+    def test_expired_npwire_request_is_in_band_deadline_error(self):
+        from pytensor_federated_tpu.service.server import (
+            ArraysToArraysService,
+        )
+
+        service = ArraysToArraysService(_double)
+        req = npwire.encode_arrays(
+            [np.ones(2)], uuid=b"q" * 16, deadline_s=-1.0
+        )
+        reply = asyncio.run(service.evaluate(req, None))
+        arrays, uuid, error = npwire.decode_arrays(reply)
+        assert uuid == b"q" * 16 and dl.is_deadline_error(error)
+
+    def test_expired_npproto_request_raises_deadline_exceeded(self):
+        """No in-band error field on the reference wire: the handler
+        raises and the RPC layer aborts as DEADLINE_EXCEEDED."""
+        from pytensor_federated_tpu.service.server import (
+            ArraysToArraysService,
+        )
+
+        service = ArraysToArraysService(_double)
+        req = npp.encode_arrays_msg(
+            [np.ones(2)], uuid="q", deadline_s=-1.0
+        )
+        with pytest.raises(dl.DeadlineExceeded):
+            asyncio.run(service.evaluate(req, None))
+
+
+class TestMicroBatcherShed:
+    def test_expired_entry_shed_never_computed(self):
+        from pytensor_federated_tpu.service.batching import MicroBatcher
+
+        computed = []
+
+        def compute(x):
+            computed.append(float(np.asarray(x)[0]))
+            return [np.asarray(x)]
+
+        async def main():
+            b = MicroBatcher(compute, inline=True)
+            with dl.deadline_scope(0.0):
+                expired = asyncio.ensure_future(
+                    b.submit([np.array([1.0])])
+                )
+            live = asyncio.ensure_future(b.submit([np.array([2.0])]))
+            with pytest.raises(dl.DeadlineExceeded):
+                await expired
+            out = await live
+            np.testing.assert_array_equal(out[0], [2.0])
+
+        asyncio.run(main())
+        # The expired entry was shed BEFORE compute, never vmap'd in.
+        assert computed == [2.0]
+
+    def test_shed_expired_clears_queue_and_counts(self):
+        from pytensor_federated_tpu.service.batching import MicroBatcher
+
+        async def main():
+            b = MicroBatcher(_double, inline=True)
+            with dl.deadline_scope(0.0):
+                dead = [
+                    b._enqueue([np.array([float(i)])], start=False)
+                    for i in range(3)
+                ]
+            live = b._enqueue([np.array([9.0])], start=False)
+            assert b.queue_depth == 4
+            assert b.shed_expired() == 3
+            assert b.queue_depth == 1
+            assert b.stats()["shed_total"] == 3
+            for fut in dead:
+                with pytest.raises(dl.DeadlineExceeded):
+                    await fut
+            b._start()
+            out = await live
+            np.testing.assert_array_equal(out[0], [18.0])
+
+        asyncio.run(main())
+
+
+class TestAdmissionControl:
+    def _service(self, **kw):
+        from pytensor_federated_tpu.service.server import (
+            ArraysToArraysService,
+        )
+
+        release = threading.Event()
+
+        def compute(x):
+            release.wait(5.0)
+            return [2.0 * np.asarray(x)]
+
+        return ArraysToArraysService(compute, max_batch=1, **kw), release
+
+    def test_full_queue_refused_retryably(self):
+        service, release = self._service(max_queue=1)
+        req = npwire.encode_arrays([np.ones(1)], uuid=b"a" * 16)
+
+        async def main():
+            inflight = asyncio.ensure_future(service.evaluate(req, None))
+            await asyncio.sleep(0.05)  # genuinely in flight
+            # context=None direct-call path raises ConnectionError;
+            # over real gRPC this is an UNAVAILABLE abort — the
+            # RETRYABLE classification, like the drain rejection.
+            with pytest.raises(ConnectionError, match="overloaded"):
+                await service.evaluate(req, None)
+            release.set()
+            reply = await inflight
+            _arrays, _u, error = npwire.decode_arrays(reply)
+            assert error is None
+
+        asyncio.run(main())
+
+    def test_inflight_bytes_cap_with_idle_exemption(self):
+        service, release = self._service(max_inflight_bytes=64)
+        big = npwire.encode_arrays(
+            [np.zeros(64, np.float64)], uuid=b"b" * 16
+        )
+
+        async def main():
+            # Idle exemption: one oversized request is served, not
+            # refused forever.
+            first = asyncio.ensure_future(service.evaluate(big, None))
+            await asyncio.sleep(0.05)
+            with pytest.raises(ConnectionError, match="overloaded"):
+                await service.evaluate(big, None)
+            release.set()
+            await first
+
+        asyncio.run(main())
+
+    def test_unbounded_by_default(self):
+        from pytensor_federated_tpu.service.server import (
+            ArraysToArraysService,
+        )
+
+        service = ArraysToArraysService(_double)
+        assert service.max_queue is None
+        assert service.max_inflight_bytes is None
+
+    def test_shed_makes_room_for_unary_traffic(self):
+        """Regression (round-10 review): a shed entry's handler keeps
+        _inflight_rpcs inflated until a later loop tick, so the
+        admission recheck must count the room the shed freed — else
+        shedding can never admit a live unary request and the
+        shed-then-recheck is dead code on that lane."""
+        from pytensor_federated_tpu.service.server import (
+            ArraysToArraysService,
+        )
+
+        release = threading.Event()
+
+        def compute(x):
+            release.wait(10.0)
+            return [2.0 * np.asarray(x)]
+
+        def batch(reqs):
+            return [compute(*r) for r in reqs]
+
+        service = ArraysToArraysService(
+            compute, batch_fn=batch, max_batch=4, max_queue=3
+        )
+        live = npwire.encode_arrays([np.ones(1)], uuid=b"l" * 16)
+
+        async def main():
+            first = asyncio.ensure_future(service.evaluate(live, None))
+            await asyncio.sleep(0.05)  # occupies the compute thread
+            doomed = [
+                asyncio.ensure_future(
+                    service.evaluate(
+                        npwire.encode_arrays(
+                            [np.ones(1)],
+                            uuid=bytes([65 + i]) * 16,
+                            deadline_s=0.05,
+                        ),
+                        None,
+                    )
+                )
+                for i in range(2)
+            ]
+            await asyncio.sleep(0.2)  # both parked + expired in queue
+            assert service._inflight_rpcs == 3
+            assert service._batcher.queue_depth == 2
+            # depth == max_queue: the live request triggers the shed
+            # and must be ADMITTED on the spot.
+            fourth = asyncio.ensure_future(service.evaluate(live, None))
+            await asyncio.sleep(0.05)
+            release.set()
+            reply = await fourth
+            _a, _u, error = npwire.decode_arrays(reply)
+            assert error is None
+            for fut in doomed:
+                _a, _u, err = npwire.decode_arrays(await fut)
+                assert dl.is_deadline_error(err)
+            await first
+
+        asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# client classification (PR-1 satellite: the gRPC status table)
+# ---------------------------------------------------------------------------
+
+
+class _FakeRpcError(grpc.aio.AioRpcError):
+    def __init__(self, code):
+        self._fake_code = code
+
+    def code(self):
+        return self._fake_code
+
+
+class TestStatusClassification:
+    def test_deadline_exceeded_is_non_retryable(self):
+        from pytensor_federated_tpu.service.client import (
+            _NO_RETRY_STATUS,
+            _is_retryable,
+        )
+
+        assert grpc.StatusCode.DEADLINE_EXCEEDED in _NO_RETRY_STATUS
+        assert not _is_retryable(
+            _FakeRpcError(grpc.StatusCode.DEADLINE_EXCEEDED)
+        )
+        assert _is_retryable(_FakeRpcError(grpc.StatusCode.UNAVAILABLE))
+
+    def test_pool_classification_matches(self):
+        from pytensor_federated_tpu.routing import NodePool
+        from pytensor_federated_tpu.routing.pooled_client import (
+            _is_transport_error,
+        )
+
+        exc = _FakeRpcError(grpc.StatusCode.DEADLINE_EXCEEDED)
+        assert not NodePool().is_transient(exc)
+        assert not _is_transport_error(exc)
+        ok = _FakeRpcError(grpc.StatusCode.UNAVAILABLE)
+        assert NodePool().is_transient(ok)
+        assert _is_transport_error(ok)
+
+
+class TestGrpcClientDeadlineE2E:
+    @pytest.mark.parametrize("codec", ["npwire", "npproto"])
+    def test_expired_budget_fails_fast_both_codecs(self, codec):
+        from pytensor_federated_tpu.service import (
+            ArraysToArraysServiceClient,
+        )
+
+        # No server needed: the fail-fast guard fires before connect.
+        client = ArraysToArraysServiceClient(
+            "127.0.0.1", 1, codec=codec, use_stream=False
+        )
+
+        async def main():
+            with dl.deadline_scope(0.0):
+                await asyncio.sleep(0.002)
+                with pytest.raises(dl.DeadlineExceeded):
+                    await client.evaluate_async(np.ones(2))
+
+        asyncio.run(main())
+
+    @pytest.mark.parametrize("codec", ["npwire", "npproto"])
+    @pytest.mark.parametrize("use_stream", [False, True])
+    def test_roundtrip_under_deadline_both_codecs(self, codec, use_stream):
+        from pytensor_federated_tpu.service import (
+            ArraysToArraysServiceClient,
+        )
+        from pytensor_federated_tpu.service.server import serve
+
+        port = _free_port()
+
+        async def main():
+            server = await serve(_double, port=port)
+            try:
+                client = ArraysToArraysServiceClient(
+                    "127.0.0.1", port, codec=codec,
+                    use_stream=use_stream,
+                )
+                with dl.deadline_scope(10.0):
+                    out = await client.evaluate_async(np.arange(3.0))
+                np.testing.assert_array_equal(
+                    out[0], 2.0 * np.arange(3.0)
+                )
+            finally:
+                await server.stop(None)
+
+        asyncio.run(main())
+
+    @pytest.mark.parametrize("codec", ["npwire", "npproto"])
+    def test_slow_server_sheds_inside_budget_both_codecs(self, codec):
+        """A compute slower than the budget: the npwire lane sheds via
+        the deadline classification; the npproto lane surfaces the
+        non-retryable DEADLINE_EXCEEDED status — both inside ~the
+        budget, never the watchdog."""
+        from pytensor_federated_tpu.service import (
+            ArraysToArraysServiceClient,
+        )
+        from pytensor_federated_tpu.service.server import serve
+
+        def slow(x):
+            time.sleep(1.0)
+            return [np.asarray(x)]
+
+        port = _free_port()
+
+        async def main():
+            server = await serve(slow, port=port)
+            try:
+                client = ArraysToArraysServiceClient(
+                    "127.0.0.1", port, codec=codec, use_stream=False
+                )
+                t0 = time.monotonic()
+                with dl.deadline_scope(0.2):
+                    with pytest.raises(
+                        (dl.DeadlineExceeded, grpc.aio.AioRpcError)
+                    ) as ei:
+                        await client.evaluate_async(np.ones(2))
+                assert time.monotonic() - t0 < 1.0
+                if isinstance(ei.value, grpc.aio.AioRpcError):
+                    assert (
+                        ei.value.code()
+                        == grpc.StatusCode.DEADLINE_EXCEEDED
+                    )
+            finally:
+                await server.stop(None)
+
+        asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# shared admission telemetry + retry restamping (round-10 review)
+# ---------------------------------------------------------------------------
+
+
+class TestAdmissionShedTelemetryUnified:
+    def test_tcp_expired_admission_bumps_shared_counter(self):
+        """Regression (round-10 review): the tcp/shm admission paths
+        recorded the flightrec shed but never bumped
+        ``pftpu_admission_shed_total`` — only the grpc lane did.  All
+        three now go through ``deadline.shed_expired_admission``."""
+        from pytensor_federated_tpu.service.tcp import serve_npwire_payload
+        from pytensor_federated_tpu.telemetry import spans as tspans
+
+        prev = tspans.set_enabled(True)
+        try:
+            before = dl.ADMISSION_SHED.labels(reason="expired").value
+            req = npwire.encode_arrays(
+                [np.ones(2)], uuid=b"q" * 16, deadline_s=-0.5
+            )
+            reply = serve_npwire_payload(_double, req)
+            _arrays, _uuid, error = npwire.decode_arrays(reply)
+            assert dl.is_deadline_error(error)
+            assert (
+                dl.ADMISSION_SHED.labels(reason="expired").value
+                == before + 1
+            )
+        finally:
+            tspans.set_enabled(prev)
+
+
+def _recv_exact_raw(conn, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+class TestRetryRestampsBudget:
+    """Regression (round-10 review): the tcp and grpc clients encoded
+    the deadline once and re-sent the identical frame on every retry,
+    so a retried request advertised the budget as it stood BEFORE the
+    failed attempts burned wall time — the server would admit (and the
+    batcher keep) work whose caller was closer to giving up than the
+    wire claimed.  The retry loops now restamp the remaining budget
+    (the shm lane always recomputed it per attempt)."""
+
+    def test_tcp_retry_frame_carries_fresh_budget(self):
+        from pytensor_federated_tpu.service.tcp import TcpArraysClient
+
+        frames = []
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(4)
+        port = srv.getsockname()[1]
+
+        def run():
+            # Attempt 0: read the frame, burn 0.3 s of the caller's
+            # budget, close without replying -> the client retries.
+            conn, _ = srv.accept()
+            (n,) = struct.unpack("<I", _recv_exact_raw(conn, 4))
+            frames.append(_recv_exact_raw(conn, n))
+            time.sleep(0.3)
+            conn.close()
+            # Attempt 1: read the frame, answer properly.
+            conn, _ = srv.accept()
+            (n,) = struct.unpack("<I", _recv_exact_raw(conn, 4))
+            frames.append(_recv_exact_raw(conn, n))
+            reply = npwire.encode_arrays(
+                [np.zeros(1)], uuid=npwire.frame_uuid(frames[-1])
+            )
+            conn.sendall(struct.pack("<I", len(reply)) + reply)
+            conn.close()
+
+        threading.Thread(target=run, daemon=True).start()
+        client = TcpArraysClient("127.0.0.1", port, retries=2)
+        try:
+            with dl.deadline_scope(10.0):
+                client.evaluate(np.ones(2))
+        finally:
+            client.close()
+            srv.close()
+        assert len(frames) == 2
+        b0 = npwire.peek_deadline(frames[0])
+        b1 = npwire.peek_deadline(frames[1])
+        assert b0 is not None and b1 is not None
+        assert b1 <= b0 - 0.25  # the burned wall time is on the wire
+
+    def test_grpc_retry_request_carries_fresh_budget(self):
+        from pytensor_federated_tpu.service import (
+            ArraysToArraysServiceClient,
+        )
+
+        # No server needed: intercept the encoded request per attempt.
+        client = ArraysToArraysServiceClient(
+            "127.0.0.1", 1, codec="npwire", use_stream=False
+        )
+        captured = []
+
+        async def fake_evaluate_once(request):
+            captured.append(bytes(request))
+            await asyncio.sleep(0.25)  # burn budget between attempts
+            raise ConnectionError("synthetic transport failure")
+
+        client._evaluate_once = fake_evaluate_once
+
+        async def main():
+            with dl.deadline_scope(10.0):
+                with pytest.raises((ConnectionError, RuntimeError)):
+                    await client.evaluate_async(np.ones(2))
+
+        asyncio.run(main())
+        assert len(captured) >= 2
+        b0 = npwire.peek_deadline(captured[0])
+        b1 = npwire.peek_deadline(captured[1])
+        assert b0 is not None and b1 is not None
+        assert b1 <= b0 - 0.2
+
+
+# ---------------------------------------------------------------------------
+# bounded reads against a stalling server (TCP + shm satellite)
+# ---------------------------------------------------------------------------
+
+
+def _stalling_server():
+    """Accepts, reads the request, never replies — the silent-peer
+    hole the bounded reads exist for.  Returns (port, server_socket)."""
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+
+    def run():
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=lambda c: (c.recv(1 << 16), time.sleep(60)),
+                args=(conn,),
+                daemon=True,
+            ).start()
+
+    threading.Thread(target=run, daemon=True).start()
+    return srv.getsockname()[1], srv
+
+
+def _dripping_server(drip_s=0.15, total=64):
+    """Accepts, reads the request, then replies a long frame ONE BYTE
+    at a time with gaps just under any per-recv timeout — the
+    slow-drip evasion the TOTAL bound exists for.  Returns
+    (port, server_socket)."""
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+
+    def serve(conn):
+        try:
+            conn.recv(1 << 16)
+            conn.sendall(struct.pack("<I", total))
+            for _ in range(total):
+                conn.sendall(b"x")
+                time.sleep(drip_s)
+        except OSError:
+            pass
+
+    def run():
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=serve, args=(conn,), daemon=True
+            ).start()
+
+    threading.Thread(target=run, daemon=True).start()
+    return srv.getsockname()[1], srv
+
+
+class TestTcpBoundedRecv:
+    def test_dripping_server_cannot_evade_total_budget(self):
+        """Regression (round-10 review): `settimeout` bounds ONE recv,
+        so a peer dripping bytes just under it stretched a multi-recv
+        frame read ~drip_interval*bytes past the budget; the shared
+        bounded_reader re-arms the REMAINING budget before each chunk,
+        keeping the TOTAL read inside it."""
+        from pytensor_federated_tpu.service.tcp import TcpArraysClient
+
+        port, srv = _dripping_server(drip_s=0.15, total=64)
+        try:
+            client = TcpArraysClient("127.0.0.1", port, retries=0)
+            t0 = time.monotonic()
+            with dl.deadline_scope(0.5):
+                with pytest.raises((ConnectionError, OSError)):
+                    client.evaluate(np.ones(2))
+            wall = time.monotonic() - t0
+            # Old per-recv semantics would block ~64*0.15 = 9.6 s.
+            assert wall < 2.0, f"drip evaded the budget: {wall:.2f}s"
+        finally:
+            srv.close()
+
+    def test_stalling_server_classified_transient_inside_budget(self):
+        from pytensor_federated_tpu.routing import NodePool
+        from pytensor_federated_tpu.service.tcp import TcpArraysClient
+
+        port, srv = _stalling_server()
+        try:
+            client = TcpArraysClient("127.0.0.1", port, retries=0)
+            t0 = time.monotonic()
+            with dl.deadline_scope(0.3):
+                with pytest.raises((ConnectionError, OSError)) as ei:
+                    client.evaluate(np.ones(2))
+            assert time.monotonic() - t0 < 2.0
+            # The transient classification: pools fail this over.
+            assert NodePool().is_transient(ei.value)
+        finally:
+            srv.close()
+
+    def test_explicit_timeout_s_without_ambient_deadline(self):
+        from pytensor_federated_tpu.service.tcp import TcpArraysClient
+
+        port, srv = _stalling_server()
+        try:
+            client = TcpArraysClient(
+                "127.0.0.1", port, retries=0, timeout_s=0.2
+            )
+            t0 = time.monotonic()
+            with pytest.raises((ConnectionError, OSError)):
+                client.evaluate(np.ones(2))
+            assert time.monotonic() - t0 < 2.0
+        finally:
+            srv.close()
+
+    def test_no_timeout_no_deadline_keeps_blocking_semantics(self):
+        from pytensor_federated_tpu.service.tcp import TcpArraysClient
+
+        client = TcpArraysClient("127.0.0.1", 1)
+        assert dl.recv_budget_s(client.timeout_s) is None
+        with dl.deadline_scope(1.0):
+            t = dl.recv_budget_s(client.timeout_s)
+            assert t is not None and 0 < t <= 1.0
+
+    def test_deadline_spent_midwindow_raises_deadline_class(self):
+        from pytensor_federated_tpu.service.tcp import TcpArraysClient
+
+        port, srv = _stalling_server()
+        try:
+            client = TcpArraysClient("127.0.0.1", port, retries=2)
+            # Retries are stopped by the spent budget (check_remaining
+            # in the retry loop), so the whole call stays inside ~one
+            # budget instead of 3x.
+            t0 = time.monotonic()
+            with dl.deadline_scope(0.3):
+                with pytest.raises(
+                    (dl.DeadlineExceeded, ConnectionError, OSError)
+                ):
+                    client.evaluate(np.ones(2))
+            assert time.monotonic() - t0 < 1.5
+        finally:
+            srv.close()
+
+
+class TestShmBoundedRecv:
+    def test_stalling_doorbell_classified_inside_budget(self):
+        from pytensor_federated_tpu.service.shm import ShmArraysClient
+
+        port, srv = _stalling_server()
+        try:
+            client = ShmArraysClient("127.0.0.1", port, retries=0)
+            t0 = time.monotonic()
+            with dl.deadline_scope(0.3):
+                with pytest.raises((ConnectionError, OSError)):
+                    client.evaluate(np.ones(2))
+            assert time.monotonic() - t0 < 2.0
+        finally:
+            srv.close()
+
+
+class TestShmDeadlineE2E:
+    def test_expired_wire_budget_rejected_at_shm_admission(
+        self, monkeypatch
+    ):
+        """Server-side enforcement on the doorbell: a frame whose
+        stamped budget is spent is answered in band, never computed.
+        The client-side fail-fast is disarmed so the SERVER is the
+        judge (the real race this guards: budget dies in flight)."""
+        from pytensor_federated_tpu.service.shm import (
+            ShmArraysClient,
+            serve_shm,
+        )
+
+        computed = []
+
+        def compute(x):
+            computed.append(1)
+            return [2.0 * np.asarray(x)]
+
+        ports = []
+        threading.Thread(
+            target=serve_shm,
+            args=(compute,),
+            kwargs=dict(ready_callback=ports.append, max_connections=1),
+            daemon=True,
+        ).start()
+        deadline_t = time.time() + 10.0
+        while not ports and time.time() < deadline_t:
+            time.sleep(0.005)
+        assert ports, "shm node did not come up"
+        client = ShmArraysClient(
+            "127.0.0.1", ports[0], connect_timeout_s=5.0
+        )
+        try:
+            out = client.evaluate(np.arange(3.0))
+            np.testing.assert_array_equal(out[0], 2.0 * np.arange(3.0))
+            monkeypatch.setattr(dl, "wire_budget", lambda: -1.0)
+            monkeypatch.setattr(dl, "check_remaining", lambda where: None)
+            monkeypatch.setattr(dl, "remaining_s", lambda: None)
+            from pytensor_federated_tpu.telemetry import spans as tspans
+
+            prev = tspans.set_enabled(True)
+            try:
+                before = dl.ADMISSION_SHED.labels(reason="expired").value
+                with pytest.raises(dl.DeadlineExceeded):
+                    client.evaluate(np.arange(3.0))
+                # Regression (round-10 review): the shm admission path
+                # recorded the flightrec shed but never bumped the
+                # shared counter — only the grpc lane did.
+                assert (
+                    dl.ADMISSION_SHED.labels(reason="expired").value
+                    == before + 1
+                )
+            finally:
+                tspans.set_enabled(prev)
+            assert len(computed) == 1  # the expired call never computed
+        finally:
+            client.close()
+
+
+# ---------------------------------------------------------------------------
+# the retry budget
+# ---------------------------------------------------------------------------
+
+
+class TestRetryBudget:
+    def test_token_bucket_semantics(self):
+        from pytensor_federated_tpu.routing import RetryBudget
+
+        b = RetryBudget(rate_per_s=1000.0, burst=2.0)
+        assert b.try_spend()
+        assert b.try_spend()
+        # Burst gone; at 1000/s it refills almost immediately.
+        time.sleep(0.01)
+        assert b.try_spend()
+
+    def test_denial_is_loud_and_refills(self):
+        from pytensor_federated_tpu.routing import RetryBudget
+
+        b = RetryBudget(rate_per_s=50.0, burst=1.0)
+        assert b.try_spend(what="hedge")
+        assert not b.try_spend(what="hedge")
+        assert b.n_denied == 1
+        time.sleep(0.05)  # 50/s refill: > 1 token back
+        assert b.try_spend(what="hedge")
+        snap = b.snapshot()
+        assert snap["granted_total"] == 2 and snap["denied_total"] == 1
+
+    def test_validation(self):
+        from pytensor_federated_tpu.routing import RetryBudget
+
+        with pytest.raises(ValueError):
+            RetryBudget(rate_per_s=0.0)
+        with pytest.raises(ValueError):
+            RetryBudget(burst=0.5)
+
+    def test_pool_always_has_a_budget(self):
+        from pytensor_federated_tpu.routing import NodePool, RetryBudget
+
+        pool = NodePool()
+        assert isinstance(pool.retry_budget, RetryBudget)
+        assert pool.allow_retry("failover")
+        assert "retry_budget" in pool.snapshot()
+
+    def test_exhausted_budget_stops_failover(self):
+        """Two dead replicas, burst 1: exactly one failover re-pick is
+        granted, then the transport error surfaces — one call never
+        sweeps the whole pool once the budget is gone."""
+        from pytensor_federated_tpu.routing import (
+            NodePool,
+            PooledArraysClient,
+            RetryBudget,
+        )
+
+        pool = NodePool(
+            [("127.0.0.1", 1), ("127.0.0.1", 2), ("127.0.0.1", 3)],
+            transport="tcp",
+            client_kwargs=dict(
+                connect_timeout_s=0.1, connect_retries=0
+            ),
+            retry_budget=RetryBudget(rate_per_s=0.001, burst=1.0),
+        )
+        client = PooledArraysClient(pool)
+        try:
+            with pytest.raises((ConnectionError, OSError)):
+                client.evaluate(np.ones(2))
+            b = pool.retry_budget
+            assert b.n_granted == 1 and b.n_denied == 1
+        finally:
+            pool.close()
+
+    def test_fanout_member_retry_charges_budget(self):
+        from pytensor_federated_tpu.fanout_exec import (
+            MemberExecutorPool,
+            run_members,
+        )
+        from pytensor_federated_tpu.routing import NodePool, RetryBudget
+
+        node_pool = NodePool(
+            retry_budget=RetryBudget(rate_per_s=0.001, burst=1.0)
+        )
+        node_pool.member_retries = 5
+        calls = []
+
+        def member(sub_inputs, sub_storage):
+            calls.append(1)
+            raise ConnectionError("transient")
+
+        pool = MemberExecutorPool(1)
+        try:
+            with pytest.raises(ConnectionError):
+                run_members(
+                    [member], [0], [1], [], [[None]], pool,
+                    node_pool=node_pool,
+                )
+        finally:
+            pool.shutdown()
+        # 1 first attempt + exactly 1 budget-granted retry (burst 1),
+        # NOT member_retries+1 = 6 attempts.
+        assert len(calls) == 2
+
+    def test_spent_deadline_books_neither_success_nor_failure(self):
+        """Regression (round-10 review): a pre-send DeadlineExceeded
+        from the fail-fast guard says nothing about the replica — it
+        was booked as a routing SUCCESS, re-closing half-open breakers
+        with phantom traffic under short-deadline overload."""
+        from pytensor_federated_tpu.routing import (
+            NodePool,
+            PooledArraysClient,
+        )
+
+        pool = NodePool([("127.0.0.1", 1)], transport="tcp")
+        client = PooledArraysClient(pool)
+        booked = []
+        orig = pool.record_result
+        pool.record_result = (  # type: ignore[method-assign]
+            lambda *a, **k: (booked.append(a), orig(*a, **k))
+        )
+        try:
+            with dl.deadline_scope(0.0):
+                with pytest.raises(dl.DeadlineExceeded):
+                    client.evaluate(np.ones(1))
+            assert booked == []
+            # The breaker/probe token went back: still pickable.
+            assert pool.pick(1)
+        finally:
+            pool.close()
+
+    def test_failover_grant_refunded_when_no_replica_remains(self):
+        """Regression (round-10 review): a failover token spent just
+        before pick() comes back empty amplified nothing — it flows
+        back instead of draining the bucket one token per call on a
+        single-replica pool."""
+        from pytensor_federated_tpu.routing import (
+            NodePool,
+            PooledArraysClient,
+            RetryBudget,
+        )
+
+        pool = NodePool(
+            [("127.0.0.1", 1)],
+            transport="tcp",
+            client_kwargs=dict(
+                connect_timeout_s=0.1, connect_retries=0
+            ),
+            retry_budget=RetryBudget(rate_per_s=0.001, burst=1.0),
+        )
+        client = PooledArraysClient(pool)
+        try:
+            with pytest.raises((ConnectionError, OSError)):
+                client.evaluate(np.ones(1))
+            b = pool.retry_budget
+            # Granted (the tally stays) but refunded (the token back).
+            assert b.n_granted == 1
+            assert b.tokens() >= 0.99
+        finally:
+            pool.close()
+
+    def test_no_charge_when_failure_requeues_nothing(self, monkeypatch):
+        """Regression (round-10 review): a replica that fails AFTER
+        serving its whole shard amplifies nothing — charging the
+        budget for it drains the bucket faster than actual
+        amplification and denies later real failovers early."""
+        from pytensor_federated_tpu.routing import (
+            NodePool,
+            PooledArraysClient,
+            RetryBudget,
+        )
+
+        pool = NodePool(
+            [("127.0.0.1", 1)],
+            transport="tcp",
+            retry_budget=RetryBudget(rate_per_s=0.001, burst=1.0),
+        )
+        client = PooledArraysClient(pool)
+
+        async def fake_window(replica, reqs, window, batch):
+            # Every item served, then the transport died late:
+            # nothing left to re-queue.
+            return (
+                [[np.ones(1)] for _ in reqs],
+                ConnectionError("late"),
+                0.01,
+            )
+
+        monkeypatch.setattr(client, "_window_replica", fake_window)
+        try:
+            res = client.evaluate_many(
+                [(np.ones(1),), (np.ones(1),)], window=2
+            )
+            assert len(res) == 2
+            assert pool.retry_budget.n_granted == 0
+        finally:
+            pool.close()
+
+    def test_round_abort_refunds_granted_tokens(self, monkeypatch):
+        """Regression (round-10 review): when a sibling shard's budget
+        denial aborts the whole round, tokens granted to the OTHER
+        failed shards bought no re-queue — they must flow back (the
+        hedge lane's no-replica refund posture)."""
+        from pytensor_federated_tpu.routing import (
+            NodePool,
+            PooledArraysClient,
+            RetryBudget,
+        )
+
+        pool = NodePool(
+            [("127.0.0.1", 1), ("127.0.0.1", 2)],
+            transport="tcp",
+            retry_budget=RetryBudget(rate_per_s=0.001, burst=1.0),
+        )
+        client = PooledArraysClient(pool)
+
+        async def fake_window(replica, reqs, window, batch):
+            return [None for _ in reqs], ConnectionError("dead"), 0.01
+
+        monkeypatch.setattr(client, "_window_replica", fake_window)
+        try:
+            # 4 requests, window 2 -> k=2: BOTH replicas fail with
+            # tails in ONE round; the first grant spends the burst,
+            # the second is denied and aborts the round.
+            with pytest.raises((ConnectionError, OSError)):
+                client.evaluate_many([(np.ones(1),)] * 4, window=2)
+            b = pool.retry_budget
+            assert b.n_granted == 1 and b.n_denied == 1
+            # The tallies stay as booked, but the token flowed back.
+            assert b.tokens() >= 0.99
+        finally:
+            pool.close()
+
+    def test_hedge_skipped_when_budget_exhausted(self):
+        """An exhausted budget suppresses the hedge instead of firing
+        it — checked through the pool's own allow_retry gate."""
+        from pytensor_federated_tpu.routing import NodePool, RetryBudget
+
+        pool = NodePool(
+            retry_budget=RetryBudget(rate_per_s=0.001, burst=1.0)
+        )
+        assert pool.allow_retry("hedge")
+        assert not pool.allow_retry("hedge")
+        assert pool.retry_budget.n_denied == 1
+
+
+# ---------------------------------------------------------------------------
+# the slow_compute fault kind
+# ---------------------------------------------------------------------------
+
+
+class TestSlowComputeKind:
+    def test_seeded_bounded_and_replayable(self):
+        from pytensor_federated_tpu import faultinject as fi
+
+        def draws(seed):
+            plan = fi.FaultPlan(
+                [
+                    fi.FaultRule(
+                        "slow_compute", point="server.compute",
+                        every=1, delay_s=0.5,
+                    )
+                ],
+                seed=seed,
+            )
+            rule = plan.rules[0]
+            return [rule.draw_delay_s() for _ in range(5)]
+
+        a, b, c = draws(7), draws(7), draws(8)
+        assert a == b  # replayable
+        assert a != c  # seeded
+        assert all(0.0 <= d <= 0.5 for d in a)  # bounded
+
+    def test_compute_filter_applies_it(self):
+        from pytensor_federated_tpu import faultinject as fi
+        from pytensor_federated_tpu.faultinject import runtime as fi_rt
+
+        plan = fi.FaultPlan(
+            [
+                fi.FaultRule(
+                    "slow_compute", point="server.compute",
+                    nth=1, delay_s=0.05,
+                )
+            ],
+            seed=3,
+        )
+        fi.install(plan)
+        try:
+            t0 = time.perf_counter()
+            fi_rt.compute_filter()
+            assert time.perf_counter() - t0 < 0.2
+            assert plan.total_fires == 1
+        finally:
+            fi.uninstall()
+
+    def test_async_twin_applies_it(self):
+        from pytensor_federated_tpu import faultinject as fi
+        from pytensor_federated_tpu.faultinject import runtime as fi_rt
+
+        plan = fi.FaultPlan(
+            [
+                fi.FaultRule(
+                    "slow_compute", point="server.compute",
+                    nth=1, delay_s=0.05,
+                )
+            ],
+            seed=3,
+        )
+        fi.install(plan)
+        try:
+            asyncio.run(fi_rt.compute_filter_async())
+            assert plan.total_fires == 1
+        finally:
+            fi.uninstall()

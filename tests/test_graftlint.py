@@ -181,7 +181,10 @@ _FLAG_ERROR = 1
 _FLAG_TRACE = 2
 _FLAG_SPANS = 4
 _FLAG_BATCH = 8
-_KNOWN_FLAGS = _FLAG_ERROR | _FLAG_TRACE | _FLAG_SPANS | _FLAG_BATCH
+_FLAG_DEADLINE = 16
+_KNOWN_FLAGS = (
+    _FLAG_ERROR | _FLAG_TRACE | _FLAG_SPANS | _FLAG_BATCH | _FLAG_DEADLINE
+)
 
 
 def _check_flags(flags):
@@ -204,8 +207,9 @@ constexpr uint8_t kFlagError = 1;
 constexpr uint8_t kFlagTrace = 2;
 constexpr uint8_t kFlagSpans = 4;
 constexpr uint8_t kFlagBatch = 8;
+constexpr uint8_t kFlagDeadline = 16;
 constexpr uint8_t kKnownFlags =
-    kFlagError | kFlagTrace | kFlagSpans | kFlagBatch;
+    kFlagError | kFlagTrace | kFlagSpans | kFlagBatch | kFlagDeadline;
 bool decode(const Buf& b) {
   if (flags & ~kKnownFlags) return false;
   return true;
@@ -237,7 +241,8 @@ _KIND_ERROR = 12
 _KNOWN_KINDS = frozenset(range(1, 13))
 _FLAG_ERROR = 1
 _FLAG_TRACE = 2
-_KNOWN_FLAGS = _FLAG_ERROR | _FLAG_TRACE
+_FLAG_DEADLINE = 4
+_KNOWN_FLAGS = _FLAG_ERROR | _FLAG_TRACE | _FLAG_DEADLINE
 _DESC_STRUCT = struct.Struct("<QIQQ")
 
 
@@ -282,7 +287,9 @@ class TestWireRegistry:
 
     def test_missing_known_mask_flagged(self, tmp_path):
         src = NPWIRE_CLEAN.replace(
-            "_KNOWN_FLAGS = _FLAG_ERROR | _FLAG_TRACE | _FLAG_SPANS | _FLAG_BATCH",
+            "_KNOWN_FLAGS = (\n"
+            "    _FLAG_ERROR | _FLAG_TRACE | _FLAG_SPANS | _FLAG_BATCH"
+            " | _FLAG_DEADLINE\n)",
             "",
         )
         findings = run_on(tmp_path, {NPWIRE_REL: src}, ["wire-registry"])
@@ -660,6 +667,124 @@ class TestObservabilityDrift:
 # -- suppression mechanics --------------------------------------------------
 
 
+class TestUnboundedWait:
+    REL = "pytensor_federated_tpu/service/fixture_mod.py"
+
+    def test_bare_recv_flagged_with_chain(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {
+                self.REL: """
+                def read_reply(sock):
+                    return sock.recv(4)
+
+                def evaluate(sock):
+                    return read_reply(sock)
+                """
+            },
+            ["unbounded-wait"],
+        )
+        assert rules_of(findings) == {"unbounded-wait"}
+        assert len(findings) == 1
+        assert "sock.recv" in findings[0].message
+        # The graftflow chain names the uncovered caller.
+        assert any("evaluate" in hop for hop in findings[0].chain)
+
+    def test_settimeout_wait_for_and_armed_watchdog_clean(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {
+                self.REL: """
+                import asyncio
+
+                def bounded_recv(sock, timeout):
+                    sock.settimeout(timeout)
+                    return sock.recv(4)
+
+                async def bounded_stream(stream, remaining):
+                    return await asyncio.wait_for(
+                        stream.read(), timeout=remaining
+                    )
+
+                def raw_recv(sock):
+                    return sock.recv(4)
+
+                def window(sock, _watchdog):
+                    with _watchdog.armed("batch_window"):
+                        return raw_recv(sock)
+                """
+            },
+            ["unbounded-wait"],
+        )
+        assert findings == []
+
+    def test_caller_fixpoint_covers_helper(self, tmp_path):
+        """A helper whose EVERY caller arms a bound inherits it — the
+        read-helper-under-a-bounded-caller shape."""
+        findings = run_on(
+            tmp_path,
+            {
+                self.REL: """
+                def read_exact(sock, n):
+                    return sock.recv(n)
+
+                def read_frame(sock, timeout):
+                    sock.settimeout(timeout)
+                    return read_exact(sock, 4)
+                """
+            },
+            ["unbounded-wait"],
+        )
+        assert findings == []
+
+    def test_shared_bounded_reader_helper_counts_as_arming(self, tmp_path):
+        """The deadline.bounded_reader with-helper is the canonical
+        bounded read on the client lanes — a body reading under it is
+        locally bounded even though the settimeout re-arming lives in
+        the helper."""
+        findings = run_on(
+            tmp_path,
+            {
+                self.REL: """
+                def read_frame(sock, rfile, deadline):
+                    with deadline.bounded_reader(
+                        sock, rfile, 0.5, sock.close
+                    ) as read_exact:
+                        header = rfile.read(4)
+                        return header + read_exact(16)
+                """
+            },
+            ["unbounded-wait"],
+        )
+        assert findings == []
+
+    def test_plain_file_read_out_of_scope(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {
+                self.REL: """
+                def load(fh):
+                    return fh.read()
+                """
+            },
+            ["unbounded-wait"],
+        )
+        assert findings == []
+
+    def test_suppression_honored(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {
+                self.REL: """
+                def serve_loop(sock):
+                    return sock.recv(4)  # graftlint: disable=unbounded-wait -- fixture: server idle state
+                """
+            },
+            ["unbounded-wait"],
+        )
+        assert findings == []
+
+
 class TestSuppressions:
     def test_line_above_and_all_keyword(self, tmp_path):
         findings = run_on(
@@ -742,6 +867,7 @@ class TestDriver:
             "fed-rule-completeness",
             "fed-placement",
             "observability-drift",
+            "unbounded-wait",
         }
         for r in analysis.RULES.values():
             assert r.scope in ("file", "repo")

@@ -608,7 +608,7 @@ def test_unknown_flag_bits_rejected_loudly(cpp_node):
     frame = bytearray(
         encode_arrays([np.zeros(3, np.float64)])
     )
-    frame[_FLAGS_OFF] |= 0x10  # undeclared bit 16
+    frame[_FLAGS_OFF] |= 0x20  # undeclared bit 32 (16 is DEADLINE now)
     with socket_mod.create_connection(("127.0.0.1", cpp_node), 5) as s:
         s.sendall(struct_mod.pack("<I", len(frame)) + bytes(frame))
         s.settimeout(5)

@@ -355,6 +355,8 @@ _LOUD_RUNTIME_MARKERS = (
     "does not advertise",
     "does not answer",
     "faultinject[",
+    "deadline exceeded",  # DeadlineExceeded: the ISSUE-10 shed class
+    "retry budget exhausted",
 )
 
 
@@ -528,6 +530,259 @@ async def _run_seed_async(
     return n_loud
 
 
+# -- the overload lane (ISSUE 10) -------------------------------------------
+
+
+def _is_deadline_loud(exc: BaseException) -> bool:
+    """Whether ``exc`` is the DEADLINE/shed classification: the in-band
+    DeadlineExceeded class, a gRPC DEADLINE_EXCEEDED abort, or the
+    client-side bounded-read TimeoutError."""
+    import grpc
+
+    from pytensor_federated_tpu.service.deadline import DeadlineExceeded
+
+    if isinstance(exc, DeadlineExceeded):
+        return True
+    if isinstance(exc, grpc.aio.AioRpcError):
+        return exc.code() == grpc.StatusCode.DEADLINE_EXCEEDED
+    return isinstance(exc, TimeoutError)
+
+
+async def _run_overload_async(seed, procs, ports, victim, params, log):
+    """2x-oversubscribed clients against a pool with one stalling
+    replica.  Invariants (ISSUE 10 acceptance):
+
+    O1 goodput  — at least ``goodput_floor`` of the calls return the
+                  known-correct value (the healthy replica plus
+                  routing/failover must keep serving under overload);
+    O2 loudness — every non-successful call fails with the deadline/
+                  shed classification or a classified transport error,
+                  inside its budget (no unclassified escapes);
+    O3 no hang  — every call settles within CALL_DEADLINE_S;
+    O4 budget   — retry/hedge amplification never exceeds the token
+                  bucket's contract (granted <= burst + rate x wall);
+    O5 reconverge — after the stalling replica is restarted clean,
+                  breakers close, the budget refills, and a clean
+                  deadline-free window returns every value correctly.
+    """
+    from pytensor_federated_tpu.routing import (
+        NodePool,
+        PooledArraysClient,
+        RetryBudget,
+    )
+    from pytensor_federated_tpu.service.deadline import deadline_scope
+
+    budget = RetryBudget(
+        rate_per_s=params["budget_rate"], burst=params["budget_burst"],
+        name=f"overload-{seed}",
+    )
+    pool = NodePool(
+        [("127.0.0.1", p) for p in ports],
+        transport="grpc",
+        # Unary lane: N concurrent callers multiplex over HTTP/2.  The
+        # lock-step STREAM lane serializes one call at a time per
+        # connection by construction, which is the opposite of an
+        # oversubscription scenario.
+        client_kwargs=dict(use_stream=False),
+        breaker_kwargs=dict(
+            failure_threshold=3, backoff_s=0.2, jitter_frac=0.1
+        ),
+        probe_timeout_s=2.0,
+        retry_budget=budget,
+    )
+    client = PooledArraysClient(pool)
+    pool.start()  # live probes: routing must see the slow replica's load
+
+    n_ok = 0
+    n_deadline = 0
+    n_transient = 0
+    lock = asyncio.Lock()
+
+    async def one_call(i: float) -> None:
+        nonlocal n_ok, n_deadline, n_transient
+        try:
+            with deadline_scope(params["deadline_s"]):
+                out = await asyncio.wait_for(
+                    client.evaluate_async(np.array([i, 5.0])),
+                    timeout=CALL_DEADLINE_S,
+                )
+        except asyncio.TimeoutError:
+            raise Violation(
+                f"overload call {i}: hang past {CALL_DEADLINE_S}s"
+            )
+        except Exception as e:  # noqa: BLE001 - classified below
+            if _is_deadline_loud(e):
+                async with lock:
+                    n_deadline += 1
+            elif _is_loud(e):
+                async with lock:
+                    n_transient += 1
+            else:
+                raise Violation(
+                    f"overload call {i}: UNCLASSIFIED error escaped "
+                    f"({type(e).__name__}: {str(e)[:200]})"
+                )
+        else:
+            got = float(np.asarray(out[0]))
+            want = _expected(float(i))
+            if not np.isclose(got, want, rtol=1e-6):
+                raise Violation(
+                    f"overload call {i}: returned {got}, expected "
+                    f"{want} (silent corruption)"
+                )
+            async with lock:
+                n_ok += 1
+
+    async def client_task(k: int) -> None:
+        for r in range(params["calls_per_client"]):
+            await one_call(float((k * 31 + r) % 12))
+
+    t0 = time.time()
+    try:
+        await asyncio.gather(
+            *(client_task(k) for k in range(params["n_clients"]))
+        )
+        wall = time.time() - t0
+        total = params["n_clients"] * params["calls_per_client"]
+        goodput = n_ok / total
+        log(
+            f"  overload: {n_ok}/{total} ok ({goodput:.0%}), "
+            f"{n_deadline} deadline-shed, {n_transient} transient, "
+            f"wall {wall:.1f}s, budget {budget.snapshot()}"
+        )
+        # O1: goodput floor.
+        if goodput < params["goodput_floor"]:
+            raise Violation(
+                f"goodput collapsed under overload: {n_ok}/{total} "
+                f"({goodput:.0%}) < floor {params['goodput_floor']:.0%}"
+            )
+        # O4: amplification stayed inside the token bucket's contract.
+        max_granted = budget.burst + budget.rate_per_s * wall + 1.0
+        if budget.n_granted > max_granted:
+            raise Violation(
+                f"retry budget overspent: {budget.n_granted} grants > "
+                f"{max_granted:.1f} (burst {budget.burst} + "
+                f"{budget.rate_per_s}/s x {wall:.1f}s)"
+            )
+
+        # O5: load drops, the stalling replica restarts clean ->
+        # breakers close, the budget refills, a clean window is exact.
+        procs[victim].terminate()
+        procs[victim].join(timeout=10)
+        procs[victim] = _spawn_node("grpc", ports[victim], None)
+        await _wait_nodes_up_async("grpc", ports)
+        deadline_t = time.time() + 30.0
+        while time.time() < deadline_t:
+            await pool.probe_once_async()
+            if (
+                all(r.breaker.state == "closed" for r in pool.replicas)
+                and budget.tokens() >= budget.burst * 0.9
+            ):
+                break
+            await asyncio.sleep(0.1)
+        bad = [
+            (r.address, r.breaker.state)
+            for r in pool.replicas
+            if r.breaker.state != "closed"
+        ]
+        if bad:
+            raise Violation(
+                f"breakers never reconverged after load dropped: {bad}"
+            )
+        if budget.tokens() < budget.burst * 0.9:
+            raise Violation(
+                f"retry budget never refilled after load dropped "
+                f"(tokens {budget.tokens():.1f} / burst {budget.burst})"
+            )
+        reqs = [(np.array([float(i), 5.0], np.float64),) for i in range(12)]
+        results = await asyncio.wait_for(
+            client.evaluate_many_async(reqs, window=6),
+            timeout=CALL_DEADLINE_S,
+        )
+        for i, out in enumerate(results):
+            if out is None:
+                raise Violation(f"clean window: request {i} unreplied")
+            got = float(np.asarray(out[0]))
+            if not np.isclose(got, _expected(float(i)), rtol=1e-6):
+                raise Violation(
+                    f"clean window: request {i} returned {got}"
+                )
+    finally:
+        pool.close()
+    return {
+        "ok_calls": n_ok,
+        "deadline_shed": n_deadline,
+        "transient": n_transient,
+    }
+
+
+def run_overload_seed(seed: int, verbose: bool) -> dict:
+    """One overload scenario (``--lane overload``); same result-dict
+    contract as :func:`run_seed`."""
+
+    def log(msg):
+        if verbose:
+            print(msg, flush=True)
+
+    rng = random.Random(seed ^ 0x10AD)
+    params = {
+        # The stalling replica: every compute takes a seeded
+        # uniform[0, slow_s) delay — mostly past the callers' budget.
+        "slow_s": rng.uniform(1.5, 2.5),
+        "deadline_s": rng.uniform(0.6, 0.9),
+        # 2x oversubscription: two replicas, one effectively stalled,
+        # and twice as many concurrent clients as live capacity.
+        "n_clients": 8,
+        "calls_per_client": rng.choice([6, 8]),
+        "budget_rate": 4.0,
+        "budget_burst": rng.choice([8.0, 12.0]),
+        "goodput_floor": 0.4,
+    }
+    node_plan_json = fi.FaultPlan(
+        [
+            fi.FaultRule(
+                "slow_compute",
+                point="server.compute",
+                every=1,
+                delay_s=params["slow_s"],
+            )
+        ],
+        seed=seed,
+        plan_id=f"overload-{seed}-node",
+    ).to_json()
+    log(f"overload seed {seed}: {params}")
+    ports = _free_ports(2)
+    victim = random.Random(seed ^ 0x5EED).randrange(2)
+    procs = [
+        _spawn_node("grpc", p, node_plan_json if k == victim else None)
+        for k, p in enumerate(ports)
+    ]
+    result = {"seed": seed, "transport": "overload", "ok": True}
+    try:
+        _wait_nodes_up("grpc", ports)
+        stats = asyncio.run(
+            _run_overload_async(seed, procs, ports, victim, params, log)
+        )
+        result.update(stats)
+    except Exception as e:  # noqa: BLE001 - every failure becomes a record
+        result["ok"] = False
+        result["error"] = f"{type(e).__name__}: {e}"
+        try:
+            result["bundle"] = write_incident_bundle(
+                "chaos-overload-violation",
+                attrs={"seed": seed, "violation": str(e)[:500]},
+            )
+        except Exception as be:  # pragma: no cover - disk trouble
+            result["bundle"] = f"<bundle write failed: {be}>"
+    finally:
+        for proc in procs:
+            if proc.is_alive():
+                proc.terminate()
+        for proc in procs:
+            proc.join(timeout=10)
+    return result
+
+
 def run_seed(seed: int, transport: str, verbose: bool) -> dict:
     """One full chaos scenario; returns a result dict, raising nothing —
     violations land in the dict with an incident-bundle path."""
@@ -627,9 +882,13 @@ def main(argv=None) -> int:
                     help="run exactly one seed (replay a failure)")
     ap.add_argument("--base-seed", type=int, default=0)
     ap.add_argument("--transport", "--lane", dest="transport",
-                    choices=("grpc", "tcp", "shm"), default="grpc",
+                    choices=("grpc", "tcp", "shm", "overload"),
+                    default="grpc",
                     help="transport lane under chaos (--lane is an "
-                    "alias; 'shm' runs the zero-copy arena lane)")
+                    "alias; 'shm' runs the zero-copy arena lane; "
+                    "'overload' runs the ISSUE-10 scenario: 2x-"
+                    "oversubscribed clients, one stalling replica, "
+                    "deadline/shed/budget invariants)")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
 
@@ -641,13 +900,23 @@ def main(argv=None) -> int:
     t0 = time.time()
     failures = []
     for seed in seeds:
-        res = run_seed(seed, args.transport, args.verbose)
+        if args.transport == "overload":
+            res = run_overload_seed(seed, args.verbose)
+        else:
+            res = run_seed(seed, args.transport, args.verbose)
         status = "ok" if res["ok"] else "FAIL"
-        extra = (
-            f"faults={res.get('faults_fired')} loud={res.get('loud_errors')}"
-            if res["ok"]
-            else f"{res['error']} bundle={res.get('bundle')}"
-        )
+        if not res["ok"]:
+            extra = f"{res['error']} bundle={res.get('bundle')}"
+        elif args.transport == "overload":
+            extra = (
+                f"ok={res.get('ok_calls')} shed={res.get('deadline_shed')} "
+                f"transient={res.get('transient')}"
+            )
+        else:
+            extra = (
+                f"faults={res.get('faults_fired')} "
+                f"loud={res.get('loud_errors')}"
+            )
         print(f"chaos seed {seed}: {status} ({extra})", flush=True)
         if not res["ok"]:
             failures.append(res)

@@ -47,6 +47,10 @@ MODULES = [
     # — a colocated deployment reads both.
     "pytensor_federated_tpu.service.arena",
     "pytensor_federated_tpu.service.shm",
+    # Deadline budgets (ISSUE 10): the contextvar surface every lane
+    # propagates and enforces — a deployment binds deadline_scope and
+    # classifies DeadlineExceeded.
+    "pytensor_federated_tpu.service.deadline",
     # Replica-pool routing (ISSUE 4): the package __init__ re-exports
     # the whole public surface, and the per-module docs cover the
     # pieces a deployment tunes (breaker knobs, policies).
@@ -54,6 +58,9 @@ MODULES = [
     "pytensor_federated_tpu.routing.pool",
     "pytensor_federated_tpu.routing.policies",
     "pytensor_federated_tpu.routing.breaker",
+    # Retry budgets (ISSUE 10): the token bucket every amplifying
+    # recovery path spends from.
+    "pytensor_federated_tpu.routing.budget",
     "pytensor_federated_tpu.telemetry",
     # Incident subsystem (ISSUE 2): flat functional surfaces, so each
     # module's __all__ is documented directly rather than only the
