@@ -204,6 +204,71 @@ def telemetry_overhead(
     }
 
 
+def collector_overhead(
+    runner, flat0, per_eval_s: float, *, target_wall: float = 0.8,
+    cadence_s: float = 0.25,
+) -> dict:
+    """Driver-metric gate for the fleet collector (ISSUE 11
+    acceptance: a background fleet-scrape cadence must cost < 2% on
+    the bench driver metric — same posture as the telemetry/flightrec
+    gates).
+
+    The winner's warm chained executable is re-timed with a
+    :class:`~pytensor_federated_tpu.telemetry.collector.FleetCollector`
+    sweeping a LIVE exposition endpoint of this very process at a
+    250 ms cadence (4-8x the 1-2 s production cadence) versus no
+    collector at all.  The cadence is picked from the measured sweep
+    cost, not hope: one loopback HTTP self-scrape costs ~2.4 ms of
+    GIL time in this container (snapshot JSON both ways), so the
+    honest steady-state driver tax is ~1% at 250 ms — a pathological
+    regression (a sweep that balloons or blocks the driver) blows the
+    2% line, while a 20 ms cadence would fail the gate STRUCTURALLY
+    (2.4/20 = 12%) on any machine and measure nothing but itself.
+    Interleaved best-of-3 like the sibling gates so machine-load
+    drift cancels; the gate also demands the collector actually swept
+    (a collector that silently never ran would pass vacuously).
+    Never hangs: the scrape lane is loopback HTTP with a bounded
+    timeout, and stop() joins with a deadline.
+    """
+    from pytensor_federated_tpu.telemetry import start_exporter
+    from pytensor_federated_tpu.telemetry.collector import FleetCollector
+
+    n_gate = min(
+        max(int(target_wall / max(per_eval_s, 1e-9)), 1_000), 2**31 - 64
+    )
+
+    def rate() -> float:
+        return n_gate / time_chain(runner, flat0, n_gate, warm=False)
+
+    exporter = start_exporter("127.0.0.1", 0)
+    rate_on = rate_off = 0.0
+    n_sweeps = 0
+    try:
+        for _ in range(3):
+            collector = FleetCollector(
+                http_targets=[("127.0.0.1", exporter.port)],
+                interval_s=cadence_s,
+                timeout_s=1.0,
+            ).start()
+            try:
+                rate_on = max(rate_on, rate())
+            finally:
+                collector.stop()
+            n_sweeps += len(collector.history)
+            rate_off = max(rate_off, rate())
+    finally:
+        exporter.close()
+    delta_frac = max(0.0, 1.0 - rate_on / rate_off)
+    return {
+        "evals_per_s_collector_on": round(rate_on, 1),
+        "evals_per_s_collector_off": round(rate_off, 1),
+        "driver_delta_frac": round(delta_frac, 6),
+        "sweeps_during_gate": n_sweeps,
+        "cadence_s": cadence_s,
+        "pass": bool(delta_frac < 0.02 and n_sweeps > 0),
+    }
+
+
 def batcher_overhead(n_calls: int = 3_000) -> dict:
     """Idle-latency gate for the server-side micro-batcher (ISSUE 3
     acceptance: a lone request must dispatch immediately — zero
@@ -890,6 +955,15 @@ def main():
     except Exception as e:  # same invariant
         deadline_gate = {"error": f"{type(e).__name__}: {e}", "pass": False}
 
+    try:
+        collector_gate = collector_overhead(
+            runners[best], flat0, wall / n_evals
+        )
+    except Exception as e:  # same invariant
+        collector_gate = {
+            "error": f"{type(e).__name__}: {e}", "pass": False,
+        }
+
     # The shm race lane's node is no longer needed once measurement
     # and gates are done (the gates spin their own in-process node).
     if shm_client is not None:
@@ -919,6 +993,7 @@ def main():
                 "faultinject_overhead": fault_shims,
                 "shm_overhead": shm_gate,
                 "deadline_overhead": deadline_gate,
+                "collector_overhead": collector_gate,
                 **flop_extra,
             }
         )

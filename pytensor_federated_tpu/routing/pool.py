@@ -632,6 +632,23 @@ class NodePool:
             replica.breaker.record_failure()
         self._refresh_state_gauges()
 
+    def start_collector(self, **kwargs: Any) -> Any:
+        """Start a fleet collector riding THIS pool's live replica
+        registry (:class:`~..telemetry.collector.FleetCollector` with
+        ``pool=self``, started): every sweep re-reads the registry, so
+        replicas added, removed, or failed over mid-run are followed
+        automatically; grpc replicas are scraped over the GetLoad
+        ``b"telemetry"`` lane, other transports are reported
+        ``unscraped`` unless an ``http_targets=`` exporter mapping is
+        passed through.  ``interval_s`` defaults to this pool's probe
+        cadence — the fleet view refreshes as often as the health
+        view.  The caller owns the returned collector
+        (``stop()``/context manager)."""
+        from ..telemetry.collector import FleetCollector
+
+        kwargs.setdefault("interval_s", self.probe_interval_s)
+        return FleetCollector(pool=self, **kwargs).start()
+
     # -- recovery + introspection -----------------------------------------
 
     def recover(self) -> int:

@@ -5,6 +5,8 @@ from .client import (
     ClientPrivates,
     get_load_async,
     get_loads_async,
+    get_node_telemetry,
+    get_node_telemetry_async,
     get_node_traces,
     get_node_traces_async,
     thread_pid_id,
@@ -46,6 +48,8 @@ __all__ = [
     "TcpArraysClient",
     "get_load_async",
     "get_loads_async",
+    "get_node_telemetry",
+    "get_node_telemetry_async",
     "get_node_traces",
     "get_node_traces_async",
     "run_node",

@@ -275,6 +275,59 @@ def get_node_traces(
     )
 
 
+async def get_node_telemetry_async(
+    host: str, port: int, *, timeout: float = 5.0
+) -> Optional[dict]:
+    """PULL a node's full telemetry snapshot over the enriched GetLoad
+    lane (request payload ``b"telemetry"``, declared in
+    :data:`.wire_registry.GETLOAD_PAYLOADS`; server.py ``get_load``).
+    Returns the whole load dict — whose ``"telemetry"`` key carries the
+    node's metric families, recent span trees, flight-record tail, and
+    wall-clock ``ts`` — or ``None`` if the node is unreachable, slow,
+    garbled, or answers without the key (an npproto-wire or
+    pre-telemetry node).  The fleet collector
+    (:mod:`...telemetry.collector`) is the consumer; unlike
+    :func:`get_node_traces_async` nothing is ingested here — the
+    collector owns merge/staleness semantics.
+    """
+    try:
+        async with grpc.aio.insecure_channel(f"{host}:{port}") as channel:
+            method = channel.unary_unary(
+                GET_LOAD,
+                request_serializer=_identity,
+                response_deserializer=_identity,
+            )
+            reply = await asyncio.wait_for(
+                method(b"telemetry"), timeout=timeout
+            )
+            if reply[:1] != b"{":
+                return None
+            load = json.loads(reply.decode("utf-8"))
+    except (  # graftlint: disable=wire-loudness -- probe verdict lane (None = failed scrape)
+        asyncio.TimeoutError,
+        grpc.aio.AioRpcError,
+        OSError,
+        ConnectionError,
+        ValueError,
+    ):
+        return None
+    if not isinstance(load, dict) or not isinstance(
+        load.get("telemetry"), dict
+    ):
+        return None
+    return load
+
+
+def get_node_telemetry(
+    host: str, port: int, *, timeout: float = 5.0
+) -> Optional[dict]:
+    """Sync wrapper over :func:`get_node_telemetry_async`."""
+    loop = get_event_loop()
+    return loop.run_until_complete(
+        get_node_telemetry_async(host, port, timeout=timeout)
+    )
+
+
 @dataclasses.dataclass
 class ClientPrivates:
     """Non-picklable per-(client,process,thread,loop) connection state

@@ -37,7 +37,6 @@ import numpy as np
 from ..faultinject import runtime as _fi
 from ..signatures import ComputeFn
 from ..telemetry import flightrec as _flightrec
-from ..telemetry import metrics as _metrics
 from ..telemetry import spans as _spans
 from . import deadline as _deadline
 from . import npproto_codec
@@ -58,39 +57,20 @@ from .npwire import (
 _log = logging.getLogger(__name__)
 
 # Node-side RPC instrumentation (metric catalog: docs/observability.md).
-# Declared at import time; every mutator is a no-op while telemetry is
-# disabled, so an uninstrumented deployment pays one branch per call.
-_REQUESTS = _metrics.counter(
-    "pftpu_server_requests_total",
-    "RPCs served by the node, by method",
-    ("method",),
-)
-_ERRORS = _metrics.counter(
-    "pftpu_server_errors_total",
-    "Node-side failures, by kind (decode or compute)",
-    ("kind",),
-)
-_INFLIGHT = _metrics.gauge(
-    "pftpu_server_inflight_requests",
-    "Evaluate RPCs currently being served",
-)
-_DECODE_S = _metrics.histogram(
-    "pftpu_server_decode_seconds", "Request wire-decode latency"
-)
-_QUEUE_S = _metrics.histogram(
-    "pftpu_server_queue_wait_seconds",
-    "Wait between RPC decode and compute start (thread-executor queue)",
-)
-_COMPUTE_S = _metrics.histogram(
-    "pftpu_server_compute_seconds", "compute_fn latency"
-)
-_ENCODE_S = _metrics.histogram(
-    "pftpu_server_encode_seconds", "Reply wire-encode latency"
-)
-_ADMISSION_SHED = _metrics.counter(
-    "pftpu_admission_shed_total",
-    "Requests shed by server-side admission control, by reason",
-    ("reason",),
+# Declared at import time in the shared ``_node_metrics`` module — the
+# TCP/shm template nodes record into the SAME families, so every lane
+# aggregates in the fleet view; every mutator is a no-op while
+# telemetry is disabled, so an uninstrumented deployment pays one
+# branch per call.
+from ._node_metrics import (
+    ADMISSION_SHED as _ADMISSION_SHED,
+    COMPUTE_S as _COMPUTE_S,
+    DECODE_S as _DECODE_S,
+    ENCODE_S as _ENCODE_S,
+    ERRORS as _ERRORS,
+    INFLIGHT as _INFLIGHT,
+    QUEUE_S as _QUEUE_S,
+    REQUESTS as _REQUESTS,
 )
 
 SERVICE_NAME = "ArraysToArraysService"
@@ -939,14 +919,21 @@ class ArraysToArraysService:
         return load
 
     async def get_load(self, request: bytes, context) -> bytes:
-        """GetLoad; the npwire-JSON reply doubles as the trace PULL
-        lane: a request payload of ``b"traces"`` adds this node's
-        recent completed span trees (``"traces"`` key) to the reply —
-        the reunion path for spans whose own reply never made it back
-        (:func:`.client.get_node_traces`).  Both schemas define an
-        EMPTY GetLoad request, so any non-empty payload is an in-repo
-        extension; unknown payloads are ignored (plain load reply).
-        The npproto reply schema is fixed — no room for traces there.
+        """GetLoad; the npwire-JSON reply doubles as the telemetry
+        PULL lanes: a request payload of ``b"traces"`` adds this
+        node's recent completed span trees (``"traces"`` key) to the
+        reply — the reunion path for spans whose own reply never made
+        it back (:func:`.client.get_node_traces`) — and ``b"telemetry"``
+        adds the FULL telemetry snapshot (``"telemetry"`` key: metric
+        families, recent traces, the flight-record tail, and the
+        node's wall-clock ``ts`` for Cristian-style clock alignment)
+        — the fleet-collector scrape lane
+        (:mod:`..telemetry.collector`).  Both schemas define an EMPTY
+        GetLoad request, so any non-empty payload is an in-repo
+        extension (the recognized payloads are declared in
+        :data:`.wire_registry.GETLOAD_PAYLOADS`); unknown payloads are
+        ignored (plain load reply).  The npproto reply schema is fixed
+        — no room for traces or telemetry there.
         """
         _REQUESTS.labels(method="get_load").inc()
         if _fi.active_plan is not None:  # chaos seam: probe lane
@@ -962,6 +949,13 @@ class ArraysToArraysService:
             )
         if request == b"traces" and _spans.enabled():
             load["traces"] = _spans.recent_traces(16)
+        if request == b"telemetry" and _spans.enabled():
+            from ..telemetry import export as _export
+
+            load["telemetry"] = {
+                **_export.snapshot(),
+                "flightrec": _flightrec.events(128),
+            }
         # default=str: the traces lane carries free-form span attrs
         # (numpy scalars included) — degrade, never fail the query.
         return json.dumps(load, default=str).encode("utf-8")

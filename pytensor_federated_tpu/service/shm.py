@@ -77,6 +77,7 @@ from ..faultinject import runtime as _fi
 from ..telemetry import flightrec as _flightrec
 from ..telemetry import spans as _spans
 from ..telemetry import watchdog as _watchdog
+from . import _node_metrics
 from . import _rpc_metrics
 from . import deadline as _deadline
 from .arena import DEFAULT_ARENA_BYTES, Arena
@@ -1523,10 +1524,18 @@ class _ShmConnection:
                     _KIND_REPLY_BATCH, uid,
                     struct.pack("<I", 0), error=err,
                 )
-            with _deadline.budget_scope(deadline_s):
-                if kind == _KIND_EVAL:
-                    return self._serve_eval(payload, uid, trace_id, off)
-                return self._serve_eval_batch(payload, uid, trace_id, off)
+            _node_metrics.INFLIGHT.inc()
+            try:
+                with _deadline.budget_scope(deadline_s):
+                    if kind == _KIND_EVAL:
+                        return self._serve_eval(
+                            payload, uid, trace_id, off
+                        )
+                    return self._serve_eval_batch(
+                        payload, uid, trace_id, off
+                    )
+            finally:
+                _node_metrics.INFLIGHT.dec()
         if kind == _KIND_ACK:
             try:
                 (ack,) = struct.unpack_from("<Q", payload, off)
@@ -1567,12 +1576,17 @@ class _ShmConnection:
         trace_id: Optional[bytes],
         off: int,
     ) -> bytes:
+        # Same pftpu_server_* families as the gRPC/TCP lanes
+        # (_node_metrics) so an shm node aggregates in the fleet view.
+        _node_metrics.REQUESTS.labels(method="evaluate").inc()
+        t_arrive = time.perf_counter()
         try:
             (ack,) = struct.unpack_from("<Q", payload, off)
             self._reclaim(ack)
             descs, _off = decode_descs(payload, off + 8)
             arrays = self._request_arrays(descs)
         except WireError as e:
+            _node_metrics.ERRORS.labels(kind="decode").inc()
             _flightrec.record(
                 "server.error", stage="decode", wire="shm",
                 transport="shm", error=str(e)[:200],
@@ -1581,21 +1595,36 @@ class _ShmConnection:
                 _KIND_REPLY, uid, encode_descs([]),
                 error=f"decode error: {e}",
             )
+        t_decoded = time.perf_counter()
+        _node_metrics.DECODE_S.observe(t_decoded - t_arrive)
         with _spans.trace_context(trace_id), _spans.span(
             "node.evaluate", wire="shm", transport="shm"
-        ):
+        ) as root:
+            root.set_attr("decode_s", t_decoded - t_arrive)
             try:
                 if _fi.active_plan is not None:  # chaos seam
                     _fi.compute_filter("shm.compute")
-                with _spans.span("compute"):
+                with _spans.span("compute") as c_span:
+                    t_c0 = time.perf_counter()
+                    queue_wait = max(0.0, t_c0 - t_decoded)
+                    _node_metrics.QUEUE_S.observe(queue_wait)
+                    c_span.set_attr("queue_wait_s", queue_wait)
                     outputs = [
                         np.asarray(o) for o in self.compute_fn(*arrays)
                     ]
+                    _node_metrics.COMPUTE_S.observe(
+                        time.perf_counter() - t_c0
+                    )
                 with _spans.span("encode"):
+                    t_e0 = time.perf_counter()
                     rdescs = self._write_reply_arrays(outputs)
+                    _node_metrics.ENCODE_S.observe(
+                        time.perf_counter() - t_e0
+                    )
             except _fi.FaultPlanError:
                 raise  # plan-authoring bug: LOUD, never in-band
             except Exception as e:
+                _node_metrics.ERRORS.labels(kind="compute").inc()
                 _flightrec.record(
                     "server.error", stage="compute", wire="shm",
                     transport="shm", error=str(e)[:200],
@@ -1612,6 +1641,8 @@ class _ShmConnection:
         trace_id: Optional[bytes],
         off: int,
     ) -> bytes:
+        _node_metrics.REQUESTS.labels(method="evaluate_batch").inc()
+        t_arrive = time.perf_counter()
         try:
             ack, k = struct.unpack_from("<QI", payload, off)
             self._reclaim(ack)
@@ -1630,11 +1661,14 @@ class _ShmConnection:
                     raise WireError(f"batch item: {e}") from None
                 items.append((iuid, descs, None))
         except (WireError, struct.error) as e:
+            _node_metrics.ERRORS.labels(kind="decode").inc()
             return encode_frame(
                 _KIND_REPLY_BATCH, b"\0" * 16,
                 struct.pack("<I", 0),
                 error=f"decode error: {e}",
             )
+        t_decoded = time.perf_counter()
+        _node_metrics.DECODE_S.observe(t_decoded - t_arrive)
         with _spans.trace_context(trace_id), _spans.span(
             "node.evaluate_batch", wire="shm", transport="shm", n_items=k
         ):
@@ -1650,18 +1684,31 @@ class _ShmConnection:
                     )
             decoded: List[Tuple[int, List[np.ndarray], bytes]] = []
             item_errors: List[Optional[str]] = [None] * k
+            t_i0 = time.perf_counter()
             for i, (iuid, descs, _e) in enumerate(items):
                 try:
                     arrays = self._request_arrays(descs or [])
                     decoded.append((i, arrays, iuid))
                 except WireError as e:
+                    _node_metrics.ERRORS.labels(kind="decode").inc()
                     item_errors[i] = f"decode error: {e}"
+            # Per-item arena reads are decode, not queue wait — same
+            # attribution rule as the TCP batch lane, so the fleet
+            # view names the right stage.
+            item_decode_s = time.perf_counter() - t_i0
+            _node_metrics.DECODE_S.observe(item_decode_s)
             batch_fn = getattr(self.compute_fn, "batch", None)
+            t_c0 = time.perf_counter()
+            _node_metrics.QUEUE_S.observe(
+                max(0.0, t_c0 - t_decoded - item_decode_s)
+            )
             outcomes = _execute_window_sync(
                 self.compute_fn,
                 batch_fn,
                 [arrs for _i, arrs, _u in decoded],
             )
+            _node_metrics.COMPUTE_S.observe(time.perf_counter() - t_c0)
+            t_e0 = time.perf_counter()
             item_replies: List[bytes] = []
             outcome_by_slot: Dict[int, object] = {
                 i: res for (i, _a, _u), res in zip(decoded, outcomes)
@@ -1675,6 +1722,7 @@ class _ShmConnection:
                 if item_errors[i] is not None or res is None:
                     continue
                 if isinstance(res, Exception):
+                    _node_metrics.ERRORS.labels(kind="compute").inc()
                     _flightrec.record(
                         "server.error", stage="compute", wire="shm",
                         transport="shm", error=str(res)[:200],
@@ -1704,6 +1752,7 @@ class _ShmConnection:
                         + encode_descs(descs_by_item.get(i, []))
                     )
         body = struct.pack("<I", k) + b"".join(item_replies)
+        _node_metrics.ENCODE_S.observe(time.perf_counter() - t_e0)
         return encode_frame(_KIND_REPLY_BATCH, uid, body)
 
 

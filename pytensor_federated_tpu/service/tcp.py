@@ -28,6 +28,7 @@ from ..telemetry import flightrec as _flightrec
 from ..telemetry import reunion as _reunion
 from ..telemetry import spans as _spans
 from ..telemetry import watchdog as _watchdog
+from . import _node_metrics
 from . import _rpc_metrics
 from . import deadline as _deadline
 from .batching import execute_window_sync as _execute_window_sync
@@ -894,17 +895,27 @@ def _serve_batch_payload(
     and echoes an empty batch reply).  A same-signature window runs
     through the compute's ``.batch`` variant when present (one vmapped
     call), with scalar fallback on failure."""
+    t_arrive = time.perf_counter()
     try:
         items, outer_uuid, _err, trace_id, _sp = decode_batch(payload)
     except Exception as e:
+        _node_metrics.ERRORS.labels(kind="decode").inc()
         return encode_batch(
             [], uuid=b"\0" * 16, error=f"decode error: {e}"
         )
+    t_decoded = time.perf_counter()
+    # Zero-item frames are the pool's capability/health probe: they
+    # must not feed the latency histograms the fleet plane merges, or
+    # 1/s probe cadence dilutes every quantile toward the probe floor.
+    is_probe = not items
+    if not is_probe:
+        _node_metrics.DECODE_S.observe(t_decoded - t_arrive)
     batch_fn = getattr(compute_fn, "batch", None)
     with _spans.trace_context(trace_id), _spans.span(
         "node.evaluate_batch", wire="npwire", transport=transport,
         n_items=len(items),
     ) as root:
+        root.set_attr("decode_s", t_decoded - t_arrive)
         if _fi.active_plan is not None:  # chaos seam: compute path
             try:
                 _fi.compute_filter()
@@ -919,6 +930,7 @@ def _serve_batch_payload(
                 )
         replies: List[Optional[bytes]] = [None] * len(items)
         decoded = []  # (slot, arrays, uuid)
+        t_i0 = time.perf_counter()
         for i, item in enumerate(items):
             try:
                 arrays, uid, _, _ = decode_arrays_ex(
@@ -926,18 +938,34 @@ def _serve_batch_payload(
                 )
                 decoded.append((i, arrays, uid))
             except Exception as e:
+                _node_metrics.ERRORS.labels(kind="decode").inc()
                 replies[i] = encode_arrays(
                     [], uuid=b"\0" * 16, error=f"decode error: {e}"
                 )
+        # Per-item decode is decode, not queue wait — book it in the
+        # decode family so a decode-bound batch node shows up in the
+        # fleet view as decode-bound, not queue-bound.
+        item_decode_s = time.perf_counter() - t_i0
+        if not is_probe:
+            _node_metrics.DECODE_S.observe(item_decode_s)
         # Single source for dispatch semantics (vmapped-first, result
         # count validation, scalar fallback, per-item isolation):
         # batching.execute_window_sync — the sync twin of the gRPC
         # service's MicroBatcher path.
+        t_c0 = time.perf_counter()
+        if not is_probe:
+            _node_metrics.QUEUE_S.observe(
+                max(0.0, t_c0 - t_decoded - item_decode_s)
+            )
         outcomes = _execute_window_sync(
             compute_fn, batch_fn, [arrs for _, arrs, _ in decoded]
         )
+        if not is_probe:
+            _node_metrics.COMPUTE_S.observe(time.perf_counter() - t_c0)
+        t_e0 = time.perf_counter()
         for (i, _arrs, uid), res in zip(decoded, outcomes):
             if isinstance(res, Exception):
+                _node_metrics.ERRORS.labels(kind="compute").inc()
                 _flightrec.record(
                     "server.error", stage="compute", wire="npwire",
                     transport=transport, error=str(res)[:200],
@@ -948,6 +976,8 @@ def _serve_batch_payload(
                     [np.asarray(o) for o in res], uuid=uid
                 )
         reply = encode_batch(replies, uuid=outer_uuid)
+        if not is_probe:
+            _node_metrics.ENCODE_S.observe(time.perf_counter() - t_e0)
     if trace_id is not None and root.span is not None:
         reply = append_spans(reply, [root.span.to_dict()])
     return reply
@@ -970,6 +1000,7 @@ def _serve_plain_payload(
     request, at the cost of breaking compute_fns that mutate their
     inputs in place; the historical owned-copy semantics stay the
     default."""
+    t_arrive = time.perf_counter()
     try:
         arrays, uid, _, trace_id = decode_arrays_ex(
             payload, copy=not request_views
@@ -978,6 +1009,7 @@ def _serve_plain_payload(
         # A corrupt request fails ITS reply in-band and the connection
         # keeps serving — a hostile or chaos-mangled frame must not
         # tear down the node (mirror of cpp_node's serve_plain).
+        _node_metrics.ERRORS.labels(kind="decode").inc()
         _flightrec.record(
             "server.error", stage="decode",
             wire="npwire", transport=transport,
@@ -986,23 +1018,38 @@ def _serve_plain_payload(
         return encode_arrays(
             [], uuid=b"\0" * 16, error=f"decode error: {e}"
         )
+    t_decoded = time.perf_counter()
+    _node_metrics.DECODE_S.observe(t_decoded - t_arrive)
     # Node-side spans adopt the driver's wire trace id,
     # same contract as the gRPC server (server.py).
     with _spans.trace_context(trace_id), _spans.span(
         "node.evaluate", wire="npwire", transport=transport
     ) as root:
+        root.set_attr("decode_s", t_decoded - t_arrive)
         try:
             if _fi.active_plan is not None:  # chaos seam
                 _fi.compute_filter()
-            with _spans.span("compute"):
+            with _spans.span("compute") as c_span:
+                t_c0 = time.perf_counter()
+                queue_wait = max(0.0, t_c0 - t_decoded)
+                _node_metrics.QUEUE_S.observe(queue_wait)
+                c_span.set_attr("queue_wait_s", queue_wait)
                 outputs = [
                     np.asarray(o) for o in compute_fn(*arrays)
                 ]
+                _node_metrics.COMPUTE_S.observe(
+                    time.perf_counter() - t_c0
+                )
             with _spans.span("encode"):
+                t_e0 = time.perf_counter()
                 reply = encode_arrays(outputs, uuid=uid)
+                _node_metrics.ENCODE_S.observe(
+                    time.perf_counter() - t_e0
+                )
         except _fi.FaultPlanError:
             raise  # plan-authoring bug: LOUD, never in-band
         except Exception as e:  # error -> error payload
+            _node_metrics.ERRORS.labels(kind="compute").inc()
             _flightrec.record(
                 "server.error", stage="compute",
                 wire="npwire", transport=transport,
@@ -1032,28 +1079,54 @@ def serve_npwire_payload(
     Deadline admission (flag bit 16, :mod:`.deadline`): an expired
     budget is answered with the in-band deadline classification BEFORE
     any decode or compute cost is paid; a live one is re-bound as the
-    handler's ambient deadline so the compute inherits it."""
+    handler's ambient deadline so the compute inherits it.
+
+    Instrumented with the same ``pftpu_server_*`` families as the gRPC
+    service (:mod:`._node_metrics`) so TCP and shm template nodes
+    aggregate into the fleet view like gRPC nodes (``method`` is
+    ``evaluate`` for plain frames, ``evaluate_batch`` for batch
+    frames; a zero-item batch frame is the pool's capability/health
+    probe and counts as ``probe`` — keeping probe cadence OUT of the
+    SLO engine's goodput objective, the gRPC lane's GetLoad posture,
+    so an idle-but-probed fleet never pages on a goodput floor)."""
     batch = is_batch_frame(payload)
+    if batch:
+        # n_items sits at the fixed header offset (<4sBB16sI then
+        # <I count) — the same cheap peek posture as peek_deadline.
+        try:
+            (n_items,) = struct.unpack_from("<I", payload, 22)
+        except struct.error:
+            n_items = None  # truncated: the full decoder rejects it
+        method = "probe" if n_items == 0 else "evaluate_batch"
+    else:
+        method = "evaluate"
+    _node_metrics.REQUESTS.labels(method=method).inc()
+    _node_metrics.INFLIGHT.inc()
     try:
-        budget = peek_deadline(payload)
-    except WireError:
-        budget = None  # the full decoder will reject it loudly below
-    err = _deadline.shed_expired_admission(budget, transport=transport)
-    if err is not None:
-        uid = frame_uuid(payload)
-        if batch:
-            return encode_batch([], uuid=uid, error=err)
-        return encode_arrays([], uuid=uid, error=err)
-    with _deadline.budget_scope(budget):
-        if batch:
-            return _serve_batch_payload(
+        try:
+            budget = peek_deadline(payload)
+        except WireError:
+            budget = None  # the full decoder rejects it loudly below
+        err = _deadline.shed_expired_admission(
+            budget, transport=transport
+        )
+        if err is not None:
+            uid = frame_uuid(payload)
+            if batch:
+                return encode_batch([], uuid=uid, error=err)
+            return encode_arrays([], uuid=uid, error=err)
+        with _deadline.budget_scope(budget):
+            if batch:
+                return _serve_batch_payload(
+                    compute_fn, payload, transport=transport,
+                    request_views=request_views,
+                )
+            return _serve_plain_payload(
                 compute_fn, payload, transport=transport,
                 request_views=request_views,
             )
-        return _serve_plain_payload(
-            compute_fn, payload, transport=transport,
-            request_views=request_views,
-        )
+    finally:
+        _node_metrics.INFLIGHT.dec()
 
 
 def _serve_tcp_connection(

@@ -46,6 +46,7 @@ __all__ = [
     "SHMWIRE_KNOWN_FLAGS",
     "SHM_DESC_STRUCT",
     "SHM_DESC_FIELD_ORDER",
+    "GETLOAD_PAYLOADS",
 ]
 
 #: npwire frame flag bits, by canonical name.  npwire.py spells these
@@ -152,3 +153,19 @@ del _bit
 #: wire-registry rule can pin the implementation's literals to them.
 SHM_DESC_STRUCT = "<QIQQ"
 SHM_DESC_FIELD_ORDER = ("slot", "delta", "length", "generation")
+
+#: GetLoad request payloads.  Both wire schemas define an EMPTY
+#: GetLoad request, so every non-empty payload is an in-repo extension
+#: riding the npwire-JSON GetLoad lane (server.py ``get_load``; the
+#: npproto reply schema is fixed at its three fields and ignores
+#: these).  Unknown payloads degrade to the plain load reply —
+#: deliberately the proto3 skip posture, not the npwire flag-rejection
+#: posture, because the payload selects reply ENRICHMENT, never a
+#: different decode of the request.  Declared here so a new pull lane
+#: starts in the registry like every other wire feature.
+GETLOAD_PAYLOADS = {
+    "LOAD": b"",            # plain load reply (both schemas)
+    "TRACES": b"traces",    # + recent span trees (trace reunion pull)
+    "TELEMETRY": b"telemetry",  # + full telemetry snapshot + flightrec
+                                # tail + node wall clock (fleet collector)
+}

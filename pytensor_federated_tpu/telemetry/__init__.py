@@ -24,6 +24,17 @@ find where MapReduce-style fanout loses hardware efficiency):
   a silent hang.
 - :mod:`.reunion` — driver-side merge of node span trees (piggybacked
   on replies / pulled via GetLoad) with local spans, per trace id.
+- :mod:`.collector` — the FLEET plane: harvest every replica's
+  snapshot over the GetLoad ``b"telemetry"`` / HTTP ``/snapshot``
+  lanes, merge (counters summed, histograms bucket-wise, gauges
+  per-replica) with loud staleness marking, estimate per-replica
+  clock offsets, and interleave all flight records into one ordered
+  incident timeline.
+- :mod:`.critpath` — critical-path analysis over reunion-merged span
+  trees: per-stage p50/p99 decomposition of end-to-end latency,
+  dominant-stage counts, fanout straggler diagnosis.
+- :mod:`.slo` — declarative SLOs + a multi-window burn-rate engine
+  over successive fleet snapshots (the autoscaler's signal bus).
 
 Dependency-free, and near-zero cost when disabled
 (``PFTPU_TELEMETRY=0`` or :func:`set_enabled`; bench.py's overhead
@@ -31,8 +42,10 @@ gate measures the disabled path).  Metric names and the flight-record
 event taxonomy are catalogued in docs/observability.md.
 """
 
-from . import flightrec, reunion, watchdog
+from . import collector, critpath, flightrec, reunion, slo, watchdog
+from .collector import FleetCollector, FleetSnapshot
 from .export import MetricsExporter, dump_jsonl, snapshot, start_exporter
+from .slo import BurnRateEngine, Slo
 from .watchdog import write_incident_bundle
 from .metrics import (
     Counter,
@@ -59,15 +72,21 @@ from .spans import (
 )
 
 __all__ = [
+    "BurnRateEngine",
     "Counter",
+    "FleetCollector",
+    "FleetSnapshot",
     "Gauge",
     "Histogram",
     "MetricsExporter",
     "REGISTRY",
     "Registry",
+    "Slo",
     "Span",
     "clear_traces",
+    "collector",
     "counter",
+    "critpath",
     "current_span",
     "current_trace_id",
     "dump_jsonl",
@@ -80,6 +99,7 @@ __all__ = [
     "render_prometheus",
     "reunion",
     "set_enabled",
+    "slo",
     "snapshot",
     "span",
     "start_exporter",

@@ -28,6 +28,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from . import flightrec as _flightrec
 from . import metrics as _metrics
 from . import spans as _spans
 
@@ -41,8 +42,12 @@ PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 def snapshot(*, traces: int = 16) -> dict:
     """Full telemetry state: metric families + the last ``traces``
-    completed span trees + whether recording is on."""
+    completed span trees + whether recording is on.  ``ts`` is this
+    process's wall clock at snapshot build — the anchor a remote
+    scraper (:mod:`.collector`) uses for Cristian-style clock-offset
+    estimation."""
     return {
+        "ts": time.time(),
         "enabled": _spans.enabled(),
         "metrics": _metrics.snapshot(),
         "traces": _spans.recent_traces(traces),
@@ -70,10 +75,23 @@ class _Handler(BaseHTTPRequestHandler):
             body = _metrics.render_prometheus(self.registry).encode("utf-8")
             ctype = PROMETHEUS_CONTENT_TYPE
         elif path == "/snapshot":
-            body = json.dumps(snapshot()).encode("utf-8")
+            # The flight-record tail rides along so HTTP-lane replicas
+            # (TCP/shm template nodes) contribute events to the fleet
+            # timeline exactly like the GetLoad b"telemetry" lane —
+            # same composition as server.py's get_load reply.
+            # default=str: span/flightrec attrs are free-form (numpy
+            # scalars included) — degrade to strings rather than fail
+            # the scrape, the same posture as server.py's get_load
+            # reply and the watchdog's bundle writer.
+            body = json.dumps(
+                {**snapshot(), "flightrec": _flightrec.events(128)},
+                default=str,
+            ).encode("utf-8")
             ctype = "application/json"
         elif path == "/traces":
-            body = json.dumps(_spans.recent_traces()).encode("utf-8")
+            body = json.dumps(
+                _spans.recent_traces(), default=str
+            ).encode("utf-8")
             ctype = "application/json"
         else:
             self.send_error(404, "try /metrics, /snapshot or /traces")

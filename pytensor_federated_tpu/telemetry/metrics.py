@@ -133,6 +133,26 @@ class _Family:
     def _default_child(self):
         return self._children[()]
 
+    def remove(self, **kv: str) -> None:
+        """Drop one label-set's child (no-op when absent).  For gauges
+        whose label values name transient identities — replicas, peers
+        — so a long-running process with churn does not grow the label
+        set without bound or keep exporting values for hosts that no
+        longer exist."""
+        if not self.labelnames:
+            raise ValueError(
+                f"{self.name}: remove() is for labeled families — the "
+                "unlabeled default child is permanent"
+            )
+        if set(kv) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name}: expected labels {self.labelnames}, "
+                f"got {tuple(sorted(kv))}"
+            )
+        key = tuple(str(kv[ln]) for ln in self.labelnames)
+        with self._lock:
+            self._children.pop(key, None)
+
     def samples(self) -> List[Tuple[str, Dict[str, str], float]]:
         """``(suffixed_name, labels, value)`` rows for rendering."""
         out = []

@@ -1,0 +1,732 @@
+"""Fleet observability plane (ISSUE 11): collector merge semantics,
+loud staleness, clock-aligned timelines, critical-path attribution,
+and the SLO burn-rate engine.
+
+The pure halves (merge math, stage decomposition, burn windows) run on
+synthetic data; the e2e half spawns real gRPC nodes (the
+test_service.py spawn pattern) and exercises the GetLoad
+``b"telemetry"`` pull lane, the HTTP ``/snapshot`` fallback lane, and
+the SIGKILL-mid-collection staleness contract across real process
+boundaries.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+from conftest import spawn_node_procs, wait_nodes_up
+
+from pytensor_federated_tpu import telemetry
+from pytensor_federated_tpu.telemetry import (
+    collector as coll_mod,
+    critpath,
+    flightrec,
+    metrics as metrics_mod,
+    reunion,
+    slo as slo_mod,
+)
+from pytensor_federated_tpu.telemetry.collector import (
+    LOCAL_REPLICA,
+    FleetCollector,
+    FleetMergeError,
+    merge_metric_snapshots,
+    merged_quantile,
+)
+from pytensor_federated_tpu.telemetry.slo import BurnRateEngine, Slo
+
+BASE_PORT = 29720
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_isolation():
+    prev = telemetry.set_enabled(True)
+    telemetry.REGISTRY.reset()
+    telemetry.clear_traces()
+    flightrec.clear()
+    reunion.clear()
+    yield
+    telemetry.REGISTRY.reset()
+    telemetry.clear_traces()
+    flightrec.clear()
+    reunion.clear()
+    telemetry.set_enabled(prev)
+
+
+# ---------------------------------------------------------------------------
+# merge semantics (pure)
+# ---------------------------------------------------------------------------
+
+
+def _mk_registry():
+    return metrics_mod.Registry()
+
+
+class TestMergeSnapshots:
+    def test_counters_sum_histograms_merge_gauges_split(self):
+        r1, r2 = _mk_registry(), _mk_registry()
+        for r, inc, obs in ((r1, 3, [0.002, 0.3]), (r2, 2, [0.004])):
+            c = r.counter("pftpu_t_total", "t", ("k",))
+            c.labels(k="a").inc(inc)
+            h = r.histogram("pftpu_t_seconds", "t")
+            for v in obs:
+                h.observe(v)
+            g = r.gauge("pftpu_t_inflight", "t")
+            g.set(inc)
+        merged = merge_metric_snapshots(
+            {
+                "n1": metrics_mod.snapshot(r1),
+                "n2": metrics_mod.snapshot(r2),
+            }
+        )
+        (counter_child,) = merged["pftpu_t_total"]["children"]
+        assert counter_child == {"labels": {"k": "a"}, "value": 5.0}
+        (hist_child,) = merged["pftpu_t_seconds"]["children"]
+        assert hist_child["count"] == 3
+        assert hist_child["sum"] == pytest.approx(0.306)
+        assert sum(hist_child["buckets"].values()) == 3
+        gauges = {
+            child["labels"]["replica"]: child["value"]
+            for child in merged["pftpu_t_inflight"]["children"]
+        }
+        assert gauges == {"n1": 3.0, "n2": 2.0}
+
+    def test_gauge_with_existing_replica_label_keeps_it(self):
+        r = _mk_registry()
+        g = r.gauge("pftpu_t_up", "t", ("replica",))
+        g.labels(replica="10.0.0.1:50052").set(1)
+        merged = merge_metric_snapshots(
+            {"driver": metrics_mod.snapshot(r)}
+        )
+        (child,) = merged["pftpu_t_up"]["children"]
+        assert child["labels"]["replica"] == "10.0.0.1:50052"
+        assert child["labels"]["source"] == "driver"
+
+    def test_bucket_ladder_mismatch_is_loud(self):
+        r1, r2 = _mk_registry(), _mk_registry()
+        r1.histogram("pftpu_t_seconds", "t", buckets=(0.1, 1.0)).observe(
+            0.5
+        )
+        r2.histogram("pftpu_t_seconds", "t", buckets=(0.2, 2.0)).observe(
+            0.5
+        )
+        with pytest.raises(FleetMergeError, match="bucket ladder"):
+            merge_metric_snapshots(
+                {
+                    "n1": metrics_mod.snapshot(r1),
+                    "n2": metrics_mod.snapshot(r2),
+                }
+            )
+
+    def test_type_conflict_is_loud(self):
+        r1, r2 = _mk_registry(), _mk_registry()
+        r1.counter("pftpu_t_thing", "t").inc()
+        r2.gauge("pftpu_t_thing", "t").set(1)
+        with pytest.raises(FleetMergeError, match="type"):
+            merge_metric_snapshots(
+                {
+                    "n1": metrics_mod.snapshot(r1),
+                    "n2": metrics_mod.snapshot(r2),
+                }
+            )
+
+    def test_merged_quantile(self):
+        r1, r2 = _mk_registry(), _mk_registry()
+        for _ in range(99):
+            r1.histogram("pftpu_t_seconds", "t").observe(0.002)
+        r2.histogram("pftpu_t_seconds", "t").observe(0.3)
+        merged = merge_metric_snapshots(
+            {
+                "n1": metrics_mod.snapshot(r1),
+                "n2": metrics_mod.snapshot(r2),
+            }
+        )
+        fam = merged["pftpu_t_seconds"]
+        assert merged_quantile(fam, 0.5) == pytest.approx(0.0025)
+        assert merged_quantile(fam, 0.999) == pytest.approx(0.5)
+        assert np.isnan(merged_quantile(None, 0.5))
+
+
+# ---------------------------------------------------------------------------
+# critical-path decomposition (pure)
+# ---------------------------------------------------------------------------
+
+
+def _span(name, dur, children=(), **attrs):
+    d = {"name": name, "duration_s": dur, "trace_id": "aa" * 16}
+    if attrs:
+        d["attrs"] = attrs
+    if children:
+        d["children"] = list(children)
+    return d
+
+
+def _merged_trace(queue_wait=0.004):
+    node = _span(
+        "node.evaluate",
+        0.0061,
+        [
+            _span("compute", 0.005 + queue_wait, queue_wait_s=queue_wait),
+            _span("encode", 0.001),
+        ],
+        decode_s=0.0004,
+    )
+    driver = _span(
+        "rpc.evaluate",
+        0.0105,
+        [
+            _span("encode", 0.001),
+            _span("call", 0.008),
+            _span("decode", 0.001),
+        ],
+    )
+    return {"trace_id": "aa" * 16, "driver": [driver], "remote": [node]}
+
+
+class TestCritpath:
+    def test_stage_attribution(self):
+        rec = critpath.decompose_trace(_merged_trace())
+        assert rec["driver_encode"] == pytest.approx(0.001)
+        assert rec["driver_decode"] == pytest.approx(0.001)
+        assert rec["node_decode"] == pytest.approx(0.0004)
+        assert rec["node_queue"] == pytest.approx(0.004)
+        assert rec["node_compute"] == pytest.approx(0.005)
+        assert rec["node_encode"] == pytest.approx(0.001)
+        # wire = call (0.008) - node total (0.0061 + 0.0004)
+        assert rec["wire"] == pytest.approx(0.0015)
+        assert rec["dominant"] == "node_compute"
+        assert rec["coverage_frac"] > 0.9
+
+    def test_pool_wrapped_trace_uses_innermost_call(self):
+        inner = _span(
+            "rpc.evaluate",
+            0.009,
+            [_span("encode", 0.001), _span("call", 0.007),
+             _span("decode", 0.0005)],
+        )
+        attempt = _span(
+            "pool.attempt", 0.0095, [inner], replica="127.0.0.1:1"
+        )
+        driver = _span("pool.evaluate", 0.01, [attempt])
+        merged = {"trace_id": "bb", "driver": [driver], "remote": []}
+        rec = critpath.decompose_trace(merged)
+        # No node tree came home: the whole call interval stays wire.
+        assert rec["wire"] == pytest.approx(0.007)
+        assert rec["replicas"] == {
+            "127.0.0.1:1": pytest.approx(0.0095)
+        }
+
+    def test_node_only_trace_is_skipped_not_invented(self):
+        merged = {
+            "trace_id": "cc",
+            "driver": [],
+            "remote": [_span("node.evaluate", 0.005)],
+        }
+        assert critpath.decompose_trace(merged) is None
+        report = critpath.analyze([merged, _merged_trace()])
+        assert report["n_skipped"] == 1
+        assert report["n_traces"] == 1
+
+    def test_report_aggregation_and_format(self):
+        traces = [_merged_trace(queue_wait=q) for q in
+                  (0.001, 0.002, 0.02)]
+        report = critpath.analyze(traces)
+        assert report["dominant_stage"]  # non-empty
+        assert 0.0 < report["coverage_frac"] <= 1.0
+        text = critpath.format_report(report)
+        assert "node_queue" in text and "coverage" in text
+
+    def test_fanout_straggler_diagnosis(self):
+        members = [
+            _span("fanout.member", d, idx=i)
+            for i, d in enumerate((0.001, 0.001, 0.009))
+        ]
+        fan = _span(
+            "fanout", 0.0095, members, width=3, straggler_gap_s=0.008
+        )
+        driver = _span("rpc.evaluate", 0.01,
+                       [_span("call", 0.0096), fan])
+        report = critpath.analyze(
+            [{"trace_id": "dd", "driver": [driver], "remote": []}]
+        )
+        fanout = report["fanout"]
+        assert fanout["n_fanouts"] == 1
+        assert fanout["straggler_gap_p99_s"] == pytest.approx(0.008)
+        assert fanout["slowest_member_counts"] == {"2": 1}
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate engine (pure)
+# ---------------------------------------------------------------------------
+
+
+class _FakeScrape:
+    def __init__(self, m):
+        self.ok = True
+        self.metrics = m
+
+
+class _FakeSnapshot:
+    def __init__(self, ts, per_replica):
+        self.ts = ts
+        self.replicas = {
+            a: _FakeScrape(m) for a, m in per_replica.items()
+        }
+
+
+def _node_metrics_snapshot(requests, bad, total, sheds=0):
+    r = _mk_registry()
+    c = r.counter("pftpu_server_requests_total", "x", ("method",))
+    c.labels(method="evaluate").inc(requests)
+    s = r.counter("pftpu_admission_shed_total", "x", ("reason",))
+    if sheds:
+        s.labels(reason="expired").inc(sheds)
+    h = r.histogram(
+        "pftpu_client_call_seconds", "x", ("transport", "mode")
+    )
+    child = h.labels(transport="grpc", mode="unary")
+    for _ in range(bad):
+        child.observe(0.3)
+    for _ in range(total - bad):
+        child.observe(0.002)
+    return metrics_mod.snapshot(r)
+
+
+class TestBurnRateEngine:
+    def test_requires_an_objective(self):
+        with pytest.raises(ValueError, match="objective"):
+            Slo()
+
+    def test_burn_spikes_then_reconverges(self):
+        engine = BurnRateEngine(
+            Slo(p99_s=0.05, goodput_min=1.0), windows_s=(10.0,)
+        )
+        engine.observe(
+            _FakeSnapshot(100.0, {"n": _node_metrics_snapshot(10, 0, 10)})
+        )
+        degraded = engine.observe(
+            _FakeSnapshot(
+                105.0, {"n": _node_metrics_snapshot(30, 10, 30)}
+            )
+        )
+        assert degraded["burn_rate"] > 1.0
+        assert degraded["violating"]
+        assert degraded["windows"]["10s"]["objectives"]["p99"] > 1.0
+        # slo.burn is flight-recorded on violation
+        assert any(
+            e["kind"] == "slo.burn" for e in flightrec.events()
+        )
+        healed = engine.observe(
+            _FakeSnapshot(
+                114.0, {"n": _node_metrics_snapshot(90, 10, 90)}
+            )
+        )
+        assert healed["burn_rate"] is not None
+        assert healed["burn_rate"] <= 1.0
+        assert not healed["violating"]
+
+    def test_shed_objective(self):
+        engine = BurnRateEngine(
+            Slo(shed_frac_max=0.05), windows_s=(10.0,)
+        )
+        engine.observe(
+            _FakeSnapshot(0.0, {"n": _node_metrics_snapshot(10, 0, 10)})
+        )
+        rep = engine.observe(
+            _FakeSnapshot(
+                5.0,
+                {"n": _node_metrics_snapshot(30, 0, 30, sheds=10)},
+            )
+        )
+        # 10 sheds / 20 requests = 0.5 shed frac over a 0.05 budget
+        assert rep["windows"]["10s"]["objectives"]["shed"] == (
+            pytest.approx(10.0)
+        )
+
+    def test_replica_death_cannot_go_negative(self):
+        engine = BurnRateEngine(Slo(goodput_min=1.0), windows_s=(10.0,))
+        engine.observe(
+            _FakeSnapshot(
+                0.0,
+                {
+                    "a": _node_metrics_snapshot(100, 0, 100),
+                    "b": _node_metrics_snapshot(100, 0, 100),
+                },
+            )
+        )
+        # replica b died: only a remains, and its counter moved on
+        rep = engine.observe(
+            _FakeSnapshot(
+                5.0, {"a": _node_metrics_snapshot(110, 0, 110)}
+            )
+        )
+        window = rep["windows"]["10s"]
+        assert window["requests"] == pytest.approx(10.0)
+        assert window["goodput_rps"] == pytest.approx(2.0)
+
+    def test_counter_reset_counts_new_history(self):
+        engine = BurnRateEngine(Slo(goodput_min=1.0), windows_s=(10.0,))
+        engine.observe(
+            _FakeSnapshot(0.0, {"a": _node_metrics_snapshot(100, 0, 100)})
+        )
+        rep = engine.observe(
+            _FakeSnapshot(5.0, {"a": _node_metrics_snapshot(4, 0, 4)})
+        )
+        # restart: the new process's whole history (4) is the window's
+        # increase — never a negative delta
+        assert rep["windows"]["10s"]["requests"] == pytest.approx(4.0)
+        assert rep["windows"]["10s"]["burn_rate"] is not None
+
+    def test_p99_line_inside_a_bucket_counts_straddlers_bad(self):
+        # bounds 0.1 / 0.25 / 0.5; every call lands in (0.1, 0.25]
+        hist = (10, {0.1: 0, 0.25: 10, 0.5: 0})
+        # a line ON a bucket bound: that bucket's calls are good
+        assert slo_mod._frac_over(hist, 0.25) == 0.0
+        # a line INSIDE a bucket: conservative — the whole straddling
+        # bucket counts against the budget (0.24 s calls violate a
+        # 0.2 s line; rounding the line up instead would report zero
+        # burn for a fleet that is 100% out of SLO)
+        assert slo_mod._frac_over(hist, 0.2) == 1.0
+
+    def test_single_sample_has_no_burn(self):
+        engine = BurnRateEngine(Slo(goodput_min=1.0), windows_s=(10.0,))
+        rep = engine.observe(
+            _FakeSnapshot(0.0, {"a": _node_metrics_snapshot(1, 0, 1)})
+        )
+        assert rep["burn_rate"] is None
+        assert not rep["violating"]
+
+
+# ---------------------------------------------------------------------------
+# e2e: real nodes, real lanes, real SIGKILL
+# ---------------------------------------------------------------------------
+
+
+def _serve_plain_node(port):
+    import logging
+
+    import numpy as _np
+
+    logging.basicConfig(level=logging.WARNING)
+
+    def compute(x):
+        x = _np.asarray(x)
+        return [
+            _np.asarray(-_np.sum((x - 3.0) ** 2)),
+            (-2.0 * (x - 3.0)).astype(x.dtype),
+        ]
+
+    from pytensor_federated_tpu.service import run_node
+
+    run_node(compute, "127.0.0.1", port, inline_compute=True)
+
+
+@pytest.mark.slow
+def test_fleet_collector_e2e_scrape_merge_timeline_and_sigkill():
+    from pytensor_federated_tpu.routing import (
+        NodePool,
+        PooledArraysClient,
+    )
+
+    ports = [BASE_PORT, BASE_PORT + 1]
+    procs = spawn_node_procs(_serve_plain_node, [(p,) for p in ports])
+    pool = None
+    collector = None
+    try:
+        wait_nodes_up(ports)
+        pool = NodePool(
+            [("127.0.0.1", p) for p in ports],
+            policy="round_robin",
+            client_kwargs=dict(use_stream=False),
+        )
+        client = PooledArraysClient(pool)
+        x = np.zeros(3, np.float32)
+        for _ in range(10):
+            client.evaluate(x)
+
+        engine = BurnRateEngine(
+            Slo(p99_s=0.05, goodput_min=0.1), windows_s=(5.0,)
+        )
+        collector = pool.start_collector(
+            interval_s=0.2, observers=[engine.observe]
+        )
+        deadline = time.time() + 30.0
+        while collector.latest() is None and time.time() < deadline:
+            time.sleep(0.05)
+        snap = collector.latest()
+        assert snap is not None and snap.complete, (
+            None if snap is None else (snap.stale, snap.unscraped)
+        )
+        addrs = {f"127.0.0.1:{p}" for p in ports}
+        assert addrs | {LOCAL_REPLICA} == set(snap.replicas)
+
+        # merged: node counters summed across both replicas, and the
+        # driver's own client families present via the local replica
+        req = snap.merged["pftpu_server_requests_total"]
+        total = sum(
+            c["value"]
+            for c in req["children"]
+            if c["labels"].get("method") == "evaluate"
+        )
+        assert total >= 10
+        assert "pftpu_client_call_seconds" in snap.merged
+
+        # clock offsets estimated, loopback-small
+        for addr in addrs:
+            offset = snap.replicas[addr].clock_offset_s
+            assert offset is not None and abs(offset) < 1.0
+
+        # the timeline interleaves node events with driver events
+        timeline = snap.timeline()
+        sources = {e["replica"] for e in timeline}
+        assert LOCAL_REPLICA in sources
+        assert sources & addrs, sources
+        fleet_ts = [e["ts_fleet"] for e in timeline]
+        assert fleet_ts == sorted(fleet_ts)
+
+        # critical-path over the reunion store: ≥ 90% attributed
+        report = critpath.analyze_recent()
+        assert report["n_traces"] >= 10
+        assert report["coverage_frac"] >= 0.9, report
+
+        # incident bundles embed the fleet picture while a collector
+        # is live, and the renderer shows it
+        bundle_path = telemetry.write_incident_bundle(
+            "test-fleet", dir=str(_tmp_incident_dir())
+        )
+        import json
+
+        with open(bundle_path) as fh:
+            bundle = json.load(fh)
+        assert "fleet" in bundle
+        # Always a list (one entry per live collector), so bundle
+        # consumers never shape-switch on collector count.
+        (fleet,) = bundle["fleet"]
+        assert fleet["timeline"], "bundle timeline empty"
+        import subprocess
+        import sys
+
+        proc = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(
+                    os.path.dirname(__file__), "..", "tools",
+                    "incident_report.py",
+                ),
+                bundle_path,
+            ],
+            capture_output=True,
+            text=True,
+            timeout=60,
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "Fleet (clock-aligned cross-process timeline)" in (
+            proc.stdout
+        )
+
+        # SIGKILL one replica, then sweep: loud staleness, merged view
+        # excludes the corpse, flightrec records the verdict
+        procs[0].kill()
+        procs[0].join(timeout=10)
+        dead = f"127.0.0.1:{ports[0]}"
+        flightrec.clear()
+        snap2 = collector.scrape_once()
+        assert dead in snap2.stale
+        assert not snap2.complete
+        assert not snap2.replicas[dead].ok
+        assert snap2.replicas[dead].error
+        stale_events = [
+            e
+            for e in flightrec.events()
+            if e["kind"] == "collector.replica_stale"
+        ]
+        assert any(e.get("replica") == dead for e in stale_events)
+        # the dead replica contributes nothing to the merged registry
+        for child in snap2.merged.get(
+            "pftpu_collector_clock_offset_seconds", {}
+        ).get("children", ()):
+            assert child["labels"].get("replica") != dead or (
+                child["labels"].get("source") == LOCAL_REPLICA
+            )
+        # the engine keeps observing without torn aggregates
+        report2 = engine.observe(snap2)
+        for window in report2["windows"].values():
+            if window.get("requests") is not None:
+                assert window["requests"] >= 0.0
+    finally:
+        if collector is not None:
+            collector.stop()
+        if pool is not None:
+            pool.close()
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            p.join(timeout=10)
+
+
+def _tmp_incident_dir():
+    import tempfile
+
+    d = os.path.join(tempfile.gettempdir(), "pftpu-test-fleet")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def test_http_fallback_lane_scrapes_snapshot_endpoint():
+    from pytensor_federated_tpu.service import _node_metrics
+
+    _node_metrics.REQUESTS.labels(method="evaluate").inc(7)
+    exporter = telemetry.start_exporter(port=0)
+    try:
+        collector = FleetCollector(
+            http_targets=[("127.0.0.1", exporter.port)],
+            include_local=False,
+        )
+        snap = collector.scrape_once()
+        addr = f"127.0.0.1:{exporter.port}"
+        assert snap.complete
+        scrape = snap.replicas[addr]
+        assert scrape.ok and scrape.lane == "http"
+        assert scrape.clock_offset_s is not None
+        # /snapshot carries the flight-record tail (same composition
+        # as the GetLoad lane) so http-scraped replicas contribute
+        # events to the fleet timeline, not an empty list
+        flightrec.record("unit.http_lane", hint=1)
+        # free-form attrs (numpy scalars included) must degrade to
+        # strings in the /snapshot JSON, never fail the scrape
+        with telemetry.span("http.numpy", value=np.float32(1.5)):
+            pass
+        snap2 = collector.scrape_once()
+        assert snap2.replicas[addr].ok, snap2.replicas[addr].error
+        events = snap2.replicas[addr].flightrec
+        assert any(e["kind"] == "unit.http_lane" for e in events)
+        total = sum(
+            c["value"]
+            for c in snap.merged["pftpu_server_requests_total"][
+                "children"
+            ]
+        )
+        assert total >= 7
+    finally:
+        exporter.close()
+
+
+def test_http_alias_records_under_serving_address():
+    """The mapping form of http_targets: a tcp/shm pool replica's
+    exporter (necessarily a different socket) is scraped but recorded
+    under the replica's SERVING address — joining the fleet view under
+    its own name instead of sitting in unscraped forever."""
+    exporter = telemetry.start_exporter(port=0)
+    try:
+        serving = "127.0.0.1:5000"  # never dialed: only the exporter is
+        collector = FleetCollector(
+            http_targets={serving: ("127.0.0.1", exporter.port)},
+            include_local=False,
+        )
+        snap = collector.scrape_once()
+        assert serving in snap.replicas
+        assert snap.replicas[serving].ok
+        assert snap.replicas[serving].lane == "http"
+        assert snap.complete
+    finally:
+        exporter.close()
+
+
+def test_collector_unreachable_http_target_is_stale_not_hung():
+    collector = FleetCollector(
+        http_targets=[("127.0.0.1", 1)],
+        include_local=False,
+        timeout_s=1.0,
+    )
+    t0 = time.monotonic()
+    snap = collector.scrape_once()
+    assert time.monotonic() - t0 < 10.0
+    assert snap.stale == ["127.0.0.1:1"]
+    assert not snap.complete
+
+
+def test_zero_item_probe_frames_count_as_probe_not_goodput():
+    """A zero-item batch frame is the pool's capability/health probe:
+    it must count under method="probe" (excluded from the SLO engine's
+    goodput objective) so an idle-but-probed tcp/shm fleet never
+    pages on a goodput floor."""
+    from pytensor_federated_tpu.service import _node_metrics
+    from pytensor_federated_tpu.service.npwire import encode_batch
+    from pytensor_federated_tpu.service.tcp import serve_npwire_payload
+
+    def compute(x):
+        return [np.asarray(x)]
+
+    def count(method):
+        return sum(
+            v
+            for _n, labels, v in _node_metrics.REQUESTS.samples()
+            if labels.get("method") == method
+        )
+
+    before_probe = count("probe")
+    before_batch = count("evaluate_batch")
+    before_hist = (
+        _node_metrics.DECODE_S.count,
+        _node_metrics.QUEUE_S.count,
+        _node_metrics.COMPUTE_S.count,
+        _node_metrics.ENCODE_S.count,
+    )
+    serve_npwire_payload(compute, encode_batch([], uuid=b"\0" * 16))
+    assert count("probe") == before_probe + 1
+    assert count("evaluate_batch") == before_batch
+    assert "probe" not in slo_mod._EVALUATE_METHODS
+    # probes must not dilute the latency quantiles the fleet merges
+    assert before_hist == (
+        _node_metrics.DECODE_S.count,
+        _node_metrics.QUEUE_S.count,
+        _node_metrics.COMPUTE_S.count,
+        _node_metrics.ENCODE_S.count,
+    )
+
+
+def test_tcp_template_node_emits_server_histograms():
+    """Satellite: serve_tcp_once now records the shared pftpu_server_*
+    families (previously a documented gap), so TCP/shm template nodes
+    aggregate into the fleet view like gRPC nodes."""
+    import threading
+
+    from pytensor_federated_tpu.service import (
+        TcpArraysClient,
+        serve_tcp_once,
+    )
+    from pytensor_federated_tpu.service import _node_metrics
+
+    def compute(x):
+        return [np.asarray(x) * 2.0]
+
+    before_req = sum(
+        v for _n, _l, v in _node_metrics.REQUESTS.samples()
+    )
+    before_compute = _node_metrics.COMPUTE_S.count
+    ports = []
+    thread = threading.Thread(
+        target=serve_tcp_once,
+        args=(compute,),
+        kwargs=dict(ready_callback=ports.append, max_connections=1),
+        daemon=True,
+    )
+    thread.start()
+    deadline = time.time() + 15.0
+    while not ports and time.time() < deadline:
+        time.sleep(0.01)
+    assert ports, "tcp node did not come up"
+    client = TcpArraysClient("127.0.0.1", ports[0])
+    try:
+        (out,) = client.evaluate(np.ones(4, np.float32))
+        np.testing.assert_allclose(out, 2.0 * np.ones(4))
+    finally:
+        client.close()
+    thread.join(timeout=10)
+    after_req = sum(
+        v for _n, _l, v in _node_metrics.REQUESTS.samples()
+    )
+    assert after_req > before_req
+    assert _node_metrics.COMPUTE_S.count > before_compute
+    assert _node_metrics.DECODE_S.count > 0
+    assert _node_metrics.ENCODE_S.count > 0

@@ -1,0 +1,132 @@
+"""Property-based fleet-merge correctness (hypothesis) — ISSUE 11
+satellite.
+
+THE property the fleet plane rests on: merging N disjoint per-replica
+snapshots (each replica observed its own slice of the traffic into its
+own registry) is EXACTLY what one registry would have recorded had it
+observed the union.  Counters must sum and histograms must merge
+bucket-wise with no observation lost, double-counted, or re-bucketed —
+for arbitrary label sets, arbitrary observation values (including
+bucket-boundary-exact ones, where a bisect off-by-one would silently
+shift a count), and arbitrary splits of the traffic across replicas.
+"""
+
+import pytest
+
+pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+from pytensor_federated_tpu.telemetry import metrics as m  # noqa: E402
+from pytensor_federated_tpu.telemetry.collector import (  # noqa: E402
+    FleetMergeError,
+    merge_metric_snapshots,
+)
+
+COMMON = settings(max_examples=60, deadline=None)
+
+_LABELS = ("a", "b", "c")
+_BUCKETS = (1e-3, 1e-2, 1e-1, 1.0)
+
+# One observation: (kind, label, value).  Values deliberately include
+# the exact bucket bounds (bisect edge) and out-of-ladder extremes.
+_obs = st.tuples(
+    st.sampled_from(("counter", "histogram")),
+    st.sampled_from(_LABELS),
+    st.sampled_from(
+        (0.0, 1e-4, 1e-3, 5e-3, 1e-2, 9e-2, 1e-1, 0.5, 1.0, 7.5)
+    ),
+)
+
+
+def _observe(registry: m.Registry, kind: str, label: str, value: float):
+    if kind == "counter":
+        registry.counter(
+            "pftpu_prop_total", "p", ("k",)
+        ).labels(k=label).inc(value)
+    else:
+        registry.histogram(
+            "pftpu_prop_seconds", "p", ("k",), buckets=_BUCKETS
+        ).labels(k=label).observe(value)
+
+
+def _canon(snapshot: dict) -> dict:
+    """Label-keyed children, exemplars dropped (per-process by
+    design), insertion order ignored."""
+    out = {}
+    for name, fam in snapshot.items():
+        children = {}
+        for child in fam["children"]:
+            key = tuple(sorted((child.get("labels") or {}).items()))
+            children[key] = {
+                k: v
+                for k, v in child.items()
+                if k not in ("labels", "exemplar")
+            }
+        out[name] = {"type": fam["type"], "children": children}
+    return out
+
+
+@COMMON
+@given(
+    per_replica=st.lists(
+        st.lists(_obs, min_size=0, max_size=20),
+        min_size=1,
+        max_size=4,
+    )
+)
+def test_merge_of_disjoint_snapshots_equals_union_registry(per_replica):
+    union = m.Registry()
+    snapshots = {}
+    for i, observations in enumerate(per_replica):
+        replica = m.Registry()
+        for kind, label, value in observations:
+            _observe(replica, kind, label, value)
+            _observe(union, kind, label, value)
+        snapshots[f"replica-{i}"] = m.snapshot(replica)
+    merged = merge_metric_snapshots(snapshots)
+    assert _canon(merged) == _canon(m.snapshot(union))
+
+
+@COMMON
+@given(
+    split=st.lists(
+        st.integers(min_value=0, max_value=30), min_size=2, max_size=5
+    )
+)
+def test_histogram_count_and_sum_are_conserved(split):
+    snapshots = {}
+    for i, n in enumerate(split):
+        registry = m.Registry()
+        h = registry.histogram(
+            "pftpu_prop_seconds", "p", buckets=_BUCKETS
+        )
+        for j in range(n):
+            h.observe(0.003 * (j + 1))
+        snapshots[f"r{i}"] = m.snapshot(registry)
+    merged = merge_metric_snapshots(snapshots)
+    fam = merged.get("pftpu_prop_seconds")
+    if sum(split) == 0:
+        (child,) = fam["children"]
+        assert child["count"] == 0
+        return
+    (child,) = fam["children"]
+    assert child["count"] == sum(split)
+    # every observation landed in exactly one bucket or past the ladder
+    assert sum(child["buckets"].values()) <= child["count"]
+    assert child["sum"] == pytest.approx(
+        sum(
+            0.003 * (j + 1)
+            for n in split
+            for j in range(n)
+        )
+    )
+
+
+def test_ladder_mismatch_always_raises():
+    r1, r2 = m.Registry(), m.Registry()
+    r1.histogram("pftpu_prop_seconds", "p", buckets=(0.1,)).observe(0.05)
+    r2.histogram("pftpu_prop_seconds", "p", buckets=(0.2,)).observe(0.05)
+    with pytest.raises(FleetMergeError):
+        merge_metric_snapshots(
+            {"a": m.snapshot(r1), "b": m.snapshot(r2)}
+        )

@@ -380,6 +380,39 @@ class TestExport:
         # unreachable endpoint: exit 1, not a traceback
         assert metrics_dump.main(["--port", str(_free_port())]) == 1
 
+    def test_metrics_dump_fleet_merges_and_fails_loud(self, capsys):
+        """--fleet renders the merged multi-replica table; ANY
+        unreachable replica makes the exit nonzero (the --pool
+        semantics — a half-scraped fleet is a loud failure, never a
+        silently partial table) (ISSUE 11 tooling satellite)."""
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "metrics_dump",
+            Path(__file__).resolve().parent.parent
+            / "tools"
+            / "metrics_dump.py",
+        )
+        metrics_dump = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(metrics_dump)
+
+        from pytensor_federated_tpu.service import _node_metrics
+
+        _node_metrics.REQUESTS.labels(method="evaluate").inc(7)
+        with telemetry.start_exporter(port=0) as exporter:
+            live = f"127.0.0.1:{exporter.port}"
+            rc = metrics_dump.main(["--fleet", live])
+            out = capsys.readouterr().out
+            assert rc == 0
+            assert live in out and "fleet (1/1 up)" in out
+            assert "7" in out  # the merged requests column
+            # one dead replica: its row is loud and the exit nonzero
+            dead = f"127.0.0.1:{_free_port()}"
+            rc = metrics_dump.main(["--fleet", f"{live},{dead}"])
+            out = capsys.readouterr().out
+            assert rc == 1
+            assert "NO" in out and "fleet (1/2 up)" in out
+
     def test_metrics_dump_grep_prints_batcher_families(
         self, tmp_path, capsys
     ):

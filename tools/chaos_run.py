@@ -59,6 +59,7 @@ import multiprocessing as mp  # noqa: E402
 import random  # noqa: E402
 import socket  # noqa: E402
 import sys  # noqa: E402
+import threading  # noqa: E402
 import time  # noqa: E402
 
 # Runnable from any cwd (and importable by spawn children).
@@ -783,6 +784,268 @@ def run_overload_seed(seed: int, verbose: bool) -> dict:
     return result
 
 
+# -- the collector lane (ISSUE 11) ------------------------------------------
+
+
+def _fleet_snapshot_consistent(snap) -> "str | None":
+    """The torn-aggregate check: every merged counter must equal the
+    sum of the FRESH per-replica scrapes' values (a stale replica
+    contributes nothing), and merged histogram bucket totals can never
+    exceed their counts.  Returns a violation string or None."""
+    expect = {}
+    for scrape in snap.replicas.values():
+        if not scrape.ok:
+            continue
+        for name, fam in (scrape.metrics or {}).items():
+            if fam.get("type") != "counter":
+                continue
+            for child in fam.get("children", ()):
+                key = (
+                    name,
+                    tuple(sorted((child.get("labels") or {}).items())),
+                )
+                expect[key] = expect.get(key, 0.0) + float(
+                    child.get("value", 0.0)
+                )
+    for (name, labelkey), want in expect.items():
+        got = None
+        for child in (snap.merged.get(name) or {}).get("children", ()):
+            if (
+                tuple(sorted((child.get("labels") or {}).items()))
+                == labelkey
+            ):
+                got = child.get("value")
+                break
+        if got is None or abs(got - want) > 1e-6:
+            return (
+                f"torn merge: counter {name}{dict(labelkey)} merged "
+                f"{got} != sum-of-fresh {want}"
+            )
+    for name, fam in snap.merged.items():
+        if fam.get("type") != "histogram":
+            continue
+        for child in fam.get("children", ()):
+            if sum(child["buckets"].values()) > child["count"]:
+                return (
+                    f"torn merge: histogram {name} bucket total "
+                    f"exceeds its count"
+                )
+    return None
+
+
+def run_collector_seed(seed: int, verbose: bool) -> dict:
+    """One collector-under-chaos scenario (``--lane collector``): a
+    FleetCollector sweeps a 3-replica grpc pool at a tight seeded
+    cadence while the driver keeps calling, the victim replica —
+    which may also serve seeded getload garbage — is SIGKILLed
+    mid-collection and later restarted.  Invariants:
+
+    K1 no hang   — sweeps, kills, and recovery all settle inside hard
+                   deadlines (a dying peer can never wedge the sweep);
+    K2 loudness  — the kill surfaces as snapshot staleness AND a
+                   ``collector.replica_stale`` flight event within a
+                   few sweeps of landing;
+    K3 never torn — EVERY snapshot's merged view equals the sum of its
+                   fresh per-replica scrapes (stale replicas
+                   contribute nothing), checked counter-exact;
+    K4 reconverge — after the victim restarts, a complete (stale-free)
+                   sweep returns, with clock offsets for every member;
+    K5 engine     — the burn engine ingests every snapshot without an
+                   exception and never reports a negative window.
+    """
+
+    def log(msg):
+        if verbose:
+            print(msg, flush=True)
+
+    from pytensor_federated_tpu.routing import (
+        NodePool,
+        PooledArraysClient,
+    )
+    from pytensor_federated_tpu.telemetry.collector import (
+        LOCAL_REPLICA,
+        FleetCollector,
+    )
+    from pytensor_federated_tpu.telemetry.slo import BurnRateEngine, Slo
+
+    rng = random.Random(seed ^ 0xC011)
+    params = {
+        "interval_s": rng.uniform(0.05, 0.15),
+        "garbage_getload": rng.random() < 0.5,
+        "kill_after_s": rng.uniform(0.5, 1.2),
+        "traffic_pause_s": rng.uniform(0.002, 0.01),
+    }
+    log(f"collector seed {seed}: {params}")
+    tspans.set_enabled(True)
+    flightrec.set_enabled(True)
+    flightrec.clear()
+
+    node_plan_json = None
+    if params["garbage_getload"]:
+        # The victim ALSO answers some GetLoad scrapes with garbage:
+        # the collector must book those as loud stale verdicts, never
+        # crash or merge them.
+        node_plan_json = fi.FaultPlan(
+            [
+                fi.FaultRule(
+                    "getload_garbage", point="server.getload", every=3
+                )
+            ],
+            seed=seed,
+            plan_id=f"collector-{seed}-node",
+        ).to_json()
+
+    ports = _free_ports(3)
+    victim = random.Random(seed ^ 0x5EED).randrange(3)
+    procs = [
+        _spawn_node("grpc", p, node_plan_json if k == victim else None)
+        for k, p in enumerate(ports)
+    ]
+    dead_addr = f"127.0.0.1:{ports[victim]}"
+    result = {"seed": seed, "transport": "collector", "ok": True}
+    pool = None
+    collector = None
+    stop_traffic = threading.Event()
+    try:
+        _wait_nodes_up("grpc", ports)
+        pool = NodePool(
+            [("127.0.0.1", p) for p in ports],
+            policy="round_robin",
+            client_kwargs=dict(use_stream=False),
+            breaker_kwargs=dict(failure_threshold=2, backoff_s=0.2),
+        )
+        client = PooledArraysClient(pool)
+        snapshots = []
+        engine = BurnRateEngine(
+            Slo(p99_s=0.25, goodput_min=0.01), windows_s=(5.0,)
+        )
+        engine_errors = []
+
+        def observer(snap):
+            snapshots.append(snap)
+            try:
+                report = engine.observe(snap)
+                for window in report["windows"].values():
+                    reqs = window.get("requests")
+                    if reqs is not None and reqs < 0:
+                        engine_errors.append(
+                            f"negative window requests: {reqs}"
+                        )
+            except Exception as e:  # noqa: BLE001 - K5 verdict
+                engine_errors.append(f"{type(e).__name__}: {e}")
+
+        def traffic():
+            x = np.array([1.0, 5.0])
+            while not stop_traffic.is_set():
+                try:
+                    client.evaluate(x)
+                except Exception:  # noqa: BLE001 - breaker churn is fine
+                    pass
+                stop_traffic.wait(params["traffic_pause_s"])
+
+        traffic_thread = threading.Thread(target=traffic, daemon=True)
+        traffic_thread.start()
+        collector = FleetCollector(
+            pool=pool,
+            interval_s=params["interval_s"],
+            timeout_s=1.0,
+            observers=[observer],
+        ).start()
+
+        time.sleep(params["kill_after_s"])
+        procs[victim].kill()  # SIGKILL, racing whatever sweep is live
+        procs[victim].join(timeout=10)
+
+        # K2: loud staleness within a bounded number of sweeps.
+        deadline_t = time.time() + 30.0
+        while time.time() < deadline_t:
+            if any(dead_addr in s.stale for s in snapshots[-8:]):
+                break
+            time.sleep(params["interval_s"])
+        else:
+            raise Violation(
+                f"collector never marked {dead_addr} stale within 30s "
+                f"of its SIGKILL"
+            )
+        if not any(
+            e["kind"] == "collector.replica_stale"
+            and e.get("replica") == dead_addr
+            for e in flightrec.events()
+        ):
+            raise Violation(
+                "no collector.replica_stale flight event for the "
+                "killed replica"
+            )
+
+        # K4: restart -> a complete sweep with offsets for everyone.
+        procs[victim] = _spawn_node("grpc", ports[victim], None)
+        _wait_nodes_up("grpc", ports)
+        n_before = len(snapshots)
+        deadline_t = time.time() + 30.0
+        recovered = None
+        while time.time() < deadline_t:
+            fresh = snapshots[n_before:]
+            complete = [s for s in fresh if not s.stale]
+            if complete:
+                recovered = complete[-1]
+                break
+            time.sleep(params["interval_s"])
+        if recovered is None:
+            raise Violation(
+                "no complete sweep within 30s of the victim restarting"
+            )
+        for addr, scrape in recovered.replicas.items():
+            if addr != LOCAL_REPLICA and scrape.clock_offset_s is None:
+                raise Violation(
+                    f"recovered sweep has no clock offset for {addr}"
+                )
+
+        stop_traffic.set()
+        traffic_thread.join(timeout=10)
+        collector.stop()
+
+        # K3: every snapshot taken across the whole scenario — kills,
+        # garbage, restarts — merged exactly from its fresh members.
+        for snap in snapshots:
+            violation = _fleet_snapshot_consistent(snap)
+            if violation is not None:
+                raise Violation(violation)
+        # K5: the engine survived every sweep.
+        if engine_errors:
+            raise Violation(
+                f"burn engine violations: {engine_errors[:3]}"
+            )
+        result["sweeps"] = len(snapshots)
+        result["stale_sweeps"] = sum(1 for s in snapshots if s.stale)
+        log(
+            f"  collector: {result['sweeps']} sweeps, "
+            f"{result['stale_sweeps']} with staleness, engine ok"
+        )
+    except Exception as e:  # noqa: BLE001 - every failure becomes a record
+        result["ok"] = False
+        result["error"] = f"{type(e).__name__}: {e}"
+        try:
+            result["bundle"] = write_incident_bundle(
+                "chaos-collector-violation",
+                attrs={"seed": seed, "violation": str(e)[:500]},
+            )
+        except Exception as be:  # pragma: no cover - disk trouble
+            result["bundle"] = f"<bundle write failed: {be}>"
+    finally:
+        stop_traffic.set()
+        if collector is not None:
+            collector.stop()
+        if pool is not None:
+            pool.close()
+        for proc in procs:
+            if proc.is_alive():
+                proc.terminate()
+        for proc in procs:
+            proc.join(timeout=10)
+        flightrec.clear()
+    return result
+
+
 def run_seed(seed: int, transport: str, verbose: bool) -> dict:
     """One full chaos scenario; returns a result dict, raising nothing —
     violations land in the dict with an incident-bundle path."""
@@ -882,13 +1145,17 @@ def main(argv=None) -> int:
                     help="run exactly one seed (replay a failure)")
     ap.add_argument("--base-seed", type=int, default=0)
     ap.add_argument("--transport", "--lane", dest="transport",
-                    choices=("grpc", "tcp", "shm", "overload"),
+                    choices=("grpc", "tcp", "shm", "overload",
+                             "collector"),
                     default="grpc",
                     help="transport lane under chaos (--lane is an "
                     "alias; 'shm' runs the zero-copy arena lane; "
                     "'overload' runs the ISSUE-10 scenario: 2x-"
                     "oversubscribed clients, one stalling replica, "
-                    "deadline/shed/budget invariants)")
+                    "deadline/shed/budget invariants; 'collector' "
+                    "runs the ISSUE-11 scenario: fleet scrapes racing "
+                    "replica SIGKILLs — no hangs, loud staleness, "
+                    "never-torn merges)")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
 
@@ -902,6 +1169,8 @@ def main(argv=None) -> int:
     for seed in seeds:
         if args.transport == "overload":
             res = run_overload_seed(seed, args.verbose)
+        elif args.transport == "collector":
+            res = run_collector_seed(seed, args.verbose)
         else:
             res = run_seed(seed, args.transport, args.verbose)
         status = "ok" if res["ok"] else "FAIL"
@@ -911,6 +1180,11 @@ def main(argv=None) -> int:
             extra = (
                 f"ok={res.get('ok_calls')} shed={res.get('deadline_shed')} "
                 f"transient={res.get('transient')}"
+            )
+        elif args.transport == "collector":
+            extra = (
+                f"sweeps={res.get('sweeps')} "
+                f"stale_sweeps={res.get('stale_sweeps')}"
             )
         else:
             extra = (

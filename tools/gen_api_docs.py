@@ -68,6 +68,11 @@ MODULES = [
     "pytensor_federated_tpu.telemetry.flightrec",
     "pytensor_federated_tpu.telemetry.watchdog",
     "pytensor_federated_tpu.telemetry.reunion",
+    # Fleet observability plane (ISSUE 11): collector/merge surface,
+    # critical-path analysis, and the SLO burn-rate engine.
+    "pytensor_federated_tpu.telemetry.collector",
+    "pytensor_federated_tpu.telemetry.critpath",
+    "pytensor_federated_tpu.telemetry.slo",
     # Fault-injection subsystem (ISSUE 5): the plan vocabulary and the
     # runtime primitives the shims call are both public surface — chaos
     # plans are authored against them (docs/robustness.md).

@@ -9,7 +9,10 @@ driver↔node trace reunion.  This tool turns it into the two formats a
 postmortem actually gets read in:
 
 - **markdown** (default): sections for the hang site (thread dump),
-  the last N flight-recorder events as a table, the merged end-to-end
+  the last N flight-recorder events as a table, the clock-aligned
+  FLEET timeline (when a FleetCollector was live at bundle time:
+  every replica's flight record interleaved onto the driver's clock,
+  plus per-replica staleness/offset rows), the merged end-to-end
   call trees (driver encode → call → node decode/queue/compute/encode,
   indented per span), and a metrics digest.
 - **JSONL** (``--jsonl``): one line per flight-recorder event plus one
@@ -148,6 +151,67 @@ def render_markdown(bundle: dict) -> str:
                     f"| {r.get('matches', '')} | {r.get('fires', '')} "
                     f"| {r.get('remaining', '∞')} |"
                 )
+        out.append("")
+
+    fleet_sections = bundle.get("fleet")
+    if isinstance(fleet_sections, dict):
+        # Pre-normalization bundles carried a lone collector's dict.
+        fleet_sections = [fleet_sections]
+    for fleet in fleet_sections if isinstance(fleet_sections, list) else ():
+        out.append("## Fleet (clock-aligned cross-process timeline)")
+        out.append("")
+        stale = fleet.get("stale") or []
+        unscraped = fleet.get("unscraped") or []
+        out.append(
+            f"- **sweep:** {_ts(fleet.get('ts', 0))}  "
+            f"**complete:** {fleet.get('complete', '?')}"
+            + (f"  **stale:** {', '.join(map(str, stale))}" if stale else "")
+            + (
+                f"  **unscraped:** {', '.join(map(str, unscraped))}"
+                if unscraped
+                else ""
+            )
+        )
+        replicas = fleet.get("replicas")
+        if isinstance(replicas, dict) and replicas:
+            out.append("")
+            out.append("| replica | up | rtt_ms | clock_offset_ms | error |")
+            out.append("|---|---|---|---|---|")
+            for addr in sorted(replicas):
+                rep = replicas[addr] or {}
+                rtt = rep.get("rtt_s")
+                off = rep.get("clock_offset_s")
+                out.append(
+                    f"| `{addr}` | {'yes' if rep.get('ok') else 'NO'} "
+                    f"| {'' if rtt is None else f'{1e3 * rtt:.2f}'} "
+                    f"| {'' if off is None else f'{1e3 * off:+.2f}'} "
+                    f"| {rep.get('error') or ''} |"
+                )
+        timeline = fleet.get("timeline")
+        out.append("")
+        if isinstance(timeline, list) and timeline:
+            out.append(
+                "| fleet time (driver clock) | replica | kind | detail |"
+            )
+            out.append("|---|---|---|---|")
+            for ev in timeline:
+                detail = {
+                    k: v
+                    for k, v in ev.items()
+                    if k
+                    not in (
+                        "seq", "ts", "ts_fleet", "kind", "trace_id",
+                        "replica",
+                    )
+                }
+                out.append(
+                    f"| {_ts(ev.get('ts_fleet', 0))} "
+                    f"| `{ev.get('replica', '?')}` "
+                    f"| `{ev.get('kind', '?')}` "
+                    f"| {json.dumps(detail, default=str)} |"
+                )
+        else:
+            out.append(f"_no timeline events ({timeline!r})_")
         out.append("")
 
     reunion = bundle.get("trace_reunion")

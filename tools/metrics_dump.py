@@ -19,6 +19,20 @@ Usage:
     python tools/metrics_dump.py --port 9100 --out tools/telemetry.jsonl
     python tools/metrics_dump.py --port 9100 --grep batch    # batcher families
     python tools/metrics_dump.py --port 9100 --pool          # replica health
+    python tools/metrics_dump.py --fleet h:p,h:p,...         # fleet view
+
+``--fleet host:port,host:port,...`` scrapes EVERY listed exposition
+endpoint's ``/snapshot`` in one shot and renders the merged fleet
+table: one health row per replica (requests/errors served, in-flight
+depth, queue-wait and compute p99 from that replica's histograms,
+estimated clock offset from the scrape RTT midpoint) plus a ``fleet``
+totals row whose quantiles come from the bucket-wise histogram merge
+— the same semantics as ``telemetry.collector.merge_metric_snapshots``
+(the canonical implementation; the compact one here keeps this tool
+importable without jax).  Exit 1 when ANY replica is unreachable —
+matching ``--pool`` semantics: a half-scraped fleet is a loud
+failure, never a silently partial table.  ``--out`` appends the
+per-replica snapshots as one JSON line.
 
 ``--pool`` renders the replica-pool picture from the ``pftpu_pool_*``
 families (routing/NodePool): one row per replica — breaker-admitted
@@ -117,6 +131,109 @@ def render_pool_view(metrics: dict) -> str:
     return "\n".join(out) + "\n"
 
 
+def _hist_stats(metrics: dict, family: str):
+    """-> (count, sum, {bound: n}) pooled over the family's children
+    (per-bucket counts, the shared fixed ladder)."""
+    count, total, buckets = 0, 0.0, {}
+    for c in _children(metrics, family):
+        count += int(c.get("count", 0))
+        total += float(c.get("sum", 0.0))
+        for bound, n in (c.get("buckets") or {}).items():
+            b = float(bound)
+            buckets[b] = buckets.get(b, 0) + int(n)
+    return count, total, buckets
+
+
+def _bucket_quantile(count: int, buckets: dict, q: float) -> float:
+    """Upper-bound-of-bucket quantile, same estimate the in-process
+    Histogram.approx_quantile makes."""
+    if count <= 0:
+        return float("nan")
+    rank, seen = q * count, 0
+    for bound in sorted(buckets):
+        seen += buckets[bound]
+        if seen >= rank and buckets[bound]:
+            return bound
+    return float("inf")
+
+
+def _counter_total(metrics: dict, family: str) -> float:
+    return sum(
+        float(c.get("value", 0.0)) for c in _children(metrics, family)
+    )
+
+
+def render_fleet_view(
+    scrapes: "list[tuple[str, dict | None, str | None, float, float | None]]",
+) -> str:
+    """The merged fleet table from per-replica /snapshot payloads:
+    ``scrapes`` rows are (address, payload-or-None, error, rtt_s,
+    clock_offset_s).  Counters sum and histogram quantiles merge
+    bucket-wise across replicas for the ``fleet`` row; a dead replica
+    renders a loud NO row and contributes nothing."""
+    header = (
+        "replica", "up", "requests", "errors", "inflight",
+        "queue_p99_ms", "compute_p99_ms", "offset_ms", "rtt_ms",
+    )
+    rows = [header]
+    fleet_req = fleet_err = fleet_inf = 0.0
+    fleet_q = [0, 0.0, {}]
+    fleet_c = [0, 0.0, {}]
+    n_up = 0
+    for addr, payload, error, rtt_s, offset_s in scrapes:
+        if payload is None:
+            rows.append(
+                (addr, "NO", "-", "-", "-", "-", "-", "-",
+                 f"{1e3 * rtt_s:.1f}")
+            )
+            continue
+        n_up += 1
+        metrics = payload.get("metrics") or {}
+        req = _counter_total(metrics, "pftpu_server_requests_total")
+        err = _counter_total(metrics, "pftpu_server_errors_total")
+        inf_ = _counter_total(metrics, "pftpu_server_inflight_requests")
+        qn, qs, qb = _hist_stats(metrics, "pftpu_server_queue_wait_seconds")
+        cn, cs, cb = _hist_stats(metrics, "pftpu_server_compute_seconds")
+        fleet_req += req
+        fleet_err += err
+        fleet_inf += inf_
+        for agg, (n, s, b) in ((fleet_q, (qn, qs, qb)),
+                               (fleet_c, (cn, cs, cb))):
+            agg[0] += n
+            agg[1] += s
+            for bound, cnt in b.items():
+                agg[2][bound] = agg[2].get(bound, 0) + cnt
+        q99 = _bucket_quantile(qn, qb, 0.99)
+        c99 = _bucket_quantile(cn, cb, 0.99)
+        rows.append(
+            (
+                addr, "yes", str(int(req)), str(int(err)),
+                str(int(inf_)),
+                "-" if q99 != q99 else f"{1e3 * q99:.2f}",
+                "-" if c99 != c99 else f"{1e3 * c99:.2f}",
+                "-" if offset_s is None else f"{1e3 * offset_s:+.1f}",
+                f"{1e3 * rtt_s:.1f}",
+            )
+        )
+    q99 = _bucket_quantile(fleet_q[0], fleet_q[2], 0.99)
+    c99 = _bucket_quantile(fleet_c[0], fleet_c[2], 0.99)
+    rows.append(
+        (
+            f"fleet ({n_up}/{len(scrapes)} up)", "",
+            str(int(fleet_req)), str(int(fleet_err)),
+            str(int(fleet_inf)),
+            "-" if q99 != q99 else f"{1e3 * q99:.2f}",
+            "-" if c99 != c99 else f"{1e3 * c99:.2f}",
+            "", "",
+        )
+    )
+    widths = [max(len(r[i]) for r in rows) for i in range(len(header))]
+    return "\n".join(
+        "  ".join(c.ljust(w) for c, w in zip(row, widths)).rstrip()
+        for row in rows
+    ) + "\n"
+
+
 def _filter_exposition(text: str, substr: str) -> str:
     """Keep only the exposition blocks of families whose name contains
     ``substr``.  A block is the ``# HELP``/``# TYPE`` pair plus its
@@ -136,8 +253,18 @@ def _filter_exposition(text: str, substr: str) -> str:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--host", default="127.0.0.1")
-    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument(
+        "--port", type=int, default=None,
+        help="exposition endpoint port (required unless --fleet)",
+    )
     mode = ap.add_mutually_exclusive_group()
+    mode.add_argument(
+        "--fleet",
+        default=None,
+        metavar="HOST:PORT,...",
+        help="scrape every listed /snapshot endpoint and render the "
+        "merged fleet table (exit 1 if ANY replica is unreachable)",
+    )
     mode.add_argument(
         "--text",
         action="store_true",
@@ -177,6 +304,76 @@ def main(argv=None) -> int:
     ap.add_argument("--timeout", type=float, default=5.0)
     args = ap.parse_args(argv)
 
+    if args.fleet is not None:
+        scrapes = []
+        n_dead = 0
+        for spec in args.fleet.split(","):
+            spec = spec.strip()
+            if not spec:
+                continue
+            host, _, port = spec.rpartition(":")
+            addr = f"{host or args.host}:{port}"
+            t0_wall = time.time()
+            t0 = time.monotonic()
+            try:
+                body = scrape(
+                    f"http://{addr}/snapshot", args.timeout
+                )
+                payload = json.loads(body)
+                if not isinstance(payload, dict) or not isinstance(
+                    payload.get("metrics"), dict
+                ):
+                    raise ValueError("no 'metrics' map in /snapshot")
+            except (
+                urllib.error.URLError, OSError, TimeoutError, ValueError,
+            ) as e:
+                n_dead += 1
+                print(
+                    f"metrics_dump: {addr} unreachable: {e}",
+                    file=sys.stderr,
+                )
+                scrapes.append(
+                    (addr, None, str(e), time.monotonic() - t0, None)
+                )
+                continue
+            rtt = time.monotonic() - t0
+            node_ts = payload.get("ts")
+            offset = (
+                node_ts - (t0_wall + time.time()) / 2.0
+                if isinstance(node_ts, (int, float))
+                else None
+            )
+            scrapes.append((addr, payload, None, rtt, offset))
+        if not scrapes:
+            print("metrics_dump: --fleet lists no endpoints",
+                  file=sys.stderr)
+            return 2
+        sys.stdout.write(render_fleet_view(scrapes))
+        if args.out:
+            rec = {
+                "ts": time.time(),
+                "fleet": {
+                    addr: payload
+                    for addr, payload, _e, _r, _o in scrapes
+                    if payload is not None
+                },
+                "unreachable": [
+                    addr
+                    for addr, payload, _e, _r, _o in scrapes
+                    if payload is None
+                ],
+            }
+            with open(args.out, "a", encoding="utf-8") as fh:
+                fh.write(json.dumps(rec) + "\n")
+            print(
+                f"metrics_dump: appended 1 line to {args.out}",
+                file=sys.stderr,
+            )
+        # --pool semantics: any unreachable replica is a failed scrape.
+        return 1 if n_dead else 0
+
+    if args.port is None:
+        ap.error("--port is required (or use --fleet)")
     base = f"http://{args.host}:{args.port}"
     route = "/traces" if args.traces else "/snapshot"
     try:
