@@ -516,6 +516,77 @@ def shm_overhead(n_pings: int = 300) -> dict:
     }
 
 
+def gateway_overhead(n_calls: int = 200) -> dict:
+    """Uncontended-path latency gate for the gateway tier (ISSUE 12):
+    the same lock-step call measured direct-to-node and through a
+    1-replica gateway, interleaved best-of-3 like the sibling gates.
+    The gateway adds one asyncio hop, the fairness admission peeks,
+    one batch-frame wrap, and one extra localhost round trip — its
+    whole job is amortizing those across thousands of connections, so
+    the per-call toll on an EMPTY gateway must stay small.
+
+    Pass line: added latency under 2.5 ms/call — an order of magnitude
+    under the ~15-30 ms a real federated logp round pays, with
+    headroom for a loaded CI container (measured ~0.3-0.8 ms idle)."""
+    import threading
+
+    from pytensor_federated_tpu.gateway import GatewayThread
+    from pytensor_federated_tpu.routing import NodePool
+    from pytensor_federated_tpu.service.tcp import (
+        TcpArraysClient,
+        serve_tcp_once,
+    )
+
+    def compute(*arrays):
+        return [np.zeros(1, np.float32)]
+
+    ports = []
+    threading.Thread(
+        target=serve_tcp_once,
+        args=(compute,),
+        kwargs=dict(ready_callback=ports.append, concurrent=True),
+        daemon=True,
+    ).start()
+    deadline = time.time() + 10.0
+    while not ports and time.time() < deadline:
+        time.sleep(0.005)
+    if not ports:
+        raise RuntimeError("gateway gate node did not come up")
+    pool = NodePool([("127.0.0.1", ports[0])], transport="tcp")
+    x = np.zeros(8, np.float32)
+    direct_s = via_s = float("inf")
+    gw = GatewayThread(pool)
+    gw.start()
+    direct = TcpArraysClient("127.0.0.1", ports[0])
+    via = TcpArraysClient("127.0.0.1", gw.port, tenant="gate")
+    try:
+        direct.evaluate(x)  # warm connects
+        via.evaluate(x)
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(n_calls):
+                direct.evaluate(x)
+            direct_s = min(
+                direct_s, (time.perf_counter() - t0) / n_calls
+            )
+            t0 = time.perf_counter()
+            for _ in range(n_calls):
+                via.evaluate(x)
+            via_s = min(via_s, (time.perf_counter() - t0) / n_calls)
+    finally:
+        via.close()
+        direct.close()
+        gw.stop()
+        pool.close()
+    added_us = (via_s - direct_s) * 1e6
+    return {
+        "direct_call_us": round(direct_s * 1e6, 2),
+        "gateway_call_us": round(via_s * 1e6, 2),
+        "added_latency_us": round(added_us, 2),
+        "pass": bool(added_us < 2500.0),
+    }
+
+
 # Module-level (multiprocessing-spawn needs an importable target): the
 # shm-lane node serving THIS benchmark's exact logp+grad — same model,
 # same data seed, so the race's numerical-equality gate applies to the
@@ -964,6 +1035,11 @@ def main():
             "error": f"{type(e).__name__}: {e}", "pass": False,
         }
 
+    try:
+        gateway_gate = gateway_overhead()
+    except Exception as e:  # same invariant
+        gateway_gate = {"error": f"{type(e).__name__}: {e}", "pass": False}
+
     # The shm race lane's node is no longer needed once measurement
     # and gates are done (the gates spin their own in-process node).
     if shm_client is not None:
@@ -994,6 +1070,7 @@ def main():
                 "shm_overhead": shm_gate,
                 "deadline_overhead": deadline_gate,
                 "collector_overhead": collector_gate,
+                "gateway_overhead": gateway_gate,
                 **flop_extra,
             }
         )

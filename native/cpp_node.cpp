@@ -18,7 +18,10 @@
 //             deadline budget in relative seconds — enforced at
 //             admission: an expired budget is answered with the
 //             in-band "deadline exceeded" classification, never
-//             computed]                               then per array:
+//             computed]
+//            [flags&32: tenant_len(u16) + utf8, the gateway tier's
+//             per-tenant identity — metered at the gateway; a node
+//             validates the framing and drops the id]  then per array:
 //            dtype_len(u16) dtype_str ndim(u8) shape(u64*ndim)
 //            data_len(u64) raw bytes
 //            [flags&4 TAIL: spans_len(u32) + JSON — node-side span
@@ -93,13 +96,14 @@ constexpr uint8_t kFlagTrace = 2;
 constexpr uint8_t kFlagSpans = 4;
 constexpr uint8_t kFlagBatch = 8;
 constexpr uint8_t kFlagDeadline = 16;
+constexpr uint8_t kFlagTenant = 32;
 // Every known flag bit, mirrored from service/wire_registry.py (the
 // declared source; graftlint's wire-registry rule cross-checks this
 // file).  Decoders reject any bit outside the mask: an unknown flag
 // means blocks this build cannot place, and skipping them would be
 // silent mis-parsing of everything after (loud-failure contract).
-constexpr uint8_t kKnownFlags =
-    kFlagError | kFlagTrace | kFlagSpans | kFlagBatch | kFlagDeadline;
+constexpr uint8_t kKnownFlags = kFlagError | kFlagTrace | kFlagSpans |
+                                kFlagBatch | kFlagDeadline | kFlagTenant;
 // flags byte offset in the payload: magic(4) + version(1)
 constexpr size_t kFlagsOff = 5;
 
@@ -234,6 +238,16 @@ bool decode(const std::vector<uint8_t>& buf, Message* msg, std::string* why) {
       return false;
     }
     msg->has_deadline = true;
+  }
+  if (flags & kFlagTenant) {
+    // Gateway-tier tenant id (u16-length utf8) — metering happens at
+    // the gateway, so a node validates the framing and drops the id.
+    uint16_t tlen = 0;
+    std::string tenant;
+    if (!r.le(&tlen) || !r.str(&tenant, tlen)) {
+      *why = "truncated tenant block";
+      return false;
+    }
   }
   // Each array needs >= 11 bytes of headers (2 dtype-len + 1 ndim +
   // 8 data-len), so any frame can hold at most remaining/11 arrays.
@@ -410,6 +424,13 @@ std::vector<uint8_t> serve_batch(const std::vector<uint8_t>& buf) {
       // the Python client maps to DeadlineExceeded).
       return batch_error_reply(
           "deadline exceeded: budget spent before admission");
+  }
+  if (flags & kFlagTenant) {
+    // Framing-validated and dropped, same posture as plain frames.
+    uint16_t tlen = 0;
+    std::string tenant;
+    if (!r.le(&tlen) || !r.str(&tenant, tlen))
+      return batch_error_reply("decode failed: truncated tenant block");
   }
   // Each item needs >= 4 bytes (its length prefix), so any frame holds
   // at most remaining/4 items — reject hostile counts before looping.

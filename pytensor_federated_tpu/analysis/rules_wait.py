@@ -54,6 +54,9 @@ _RULE = "unbounded-wait"
 _SCOPE_PREFIXES = (
     "pytensor_federated_tpu/service/",
     "pytensor_federated_tpu/routing/",
+    # The gateway accept tier (ISSUE 12): every downstream payload
+    # read, upstream round-trip, and reply future must be bounded.
+    "pytensor_federated_tpu/gateway/",
 )
 
 #: Attribute calls that park the caller until the peer says otherwise.

@@ -49,6 +49,9 @@ _LOUDNESS_SCOPE = (
     "pytensor_federated_tpu/service/",
     "pytensor_federated_tpu/routing/",
     "pytensor_federated_tpu/faultinject/",
+    # The gateway passes frames through whole; its decode seams must
+    # stay as loud as the transports it fronts.
+    "pytensor_federated_tpu/gateway/",
 )
 
 

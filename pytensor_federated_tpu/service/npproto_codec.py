@@ -30,7 +30,9 @@ the reference schema lacks (outside batches npproto errors still
 surface as gRPC aborts, unchanged); and ``deadline_s(18: double)`` —
 the request's remaining deadline budget in relative seconds
 (:mod:`.deadline`; the npproto twin of npwire flag bit 16, enforced at
-server admission).  Fields 14-18 are unknown to the
+server admission); and ``tenant_id(19: string)`` — the gateway tier's
+per-tenant identity (:mod:`..gateway.fairness`; the npproto twin of
+npwire flag bit 32).  Fields 14-19 are unknown to the
 reference schema, so an unmodified reference peer skips them by wire
 type (the standard proto3 forward-compatibility rule, property-tested
 against the official runtime); they cost nothing when absent — and a
@@ -87,6 +89,7 @@ __all__ = [
     "decode_batch_msg",
     "has_batch_items",
     "peek_deadline_msg",
+    "peek_tenant_msg",
     "append_spans_msg",
     "encode_get_load_result",
     "decode_get_load_result",
@@ -317,6 +320,7 @@ def encode_arrays_msg(
     trace_id: Optional[bytes] = None,
     error: Optional[str] = None,
     deadline_s: Optional[float] = None,
+    tenant: Optional[str] = None,
 ) -> bytes:
     """InputArrays/OutputArrays: repeated ndarray items + string uuid
     (reference: service.proto:6-19; uuid is the correlation id the
@@ -325,9 +329,10 @@ def encode_arrays_msg(
     the per-item error extension field 14 — only used on items INSIDE
     a batch reply, where the gRPC-abort channel cannot isolate one
     poisoned request; ``deadline_s`` emits the remaining-deadline
-    extension field 18 (fixed64 double, relative seconds).  All
-    ``None`` keeps the message byte-identical to the official
-    encoder's output."""
+    extension field 18 (fixed64 double, relative seconds); ``tenant``
+    emits the gateway tier's tenant-id extension field 19 (utf8
+    string, non-empty).  All ``None`` keeps the message byte-identical
+    to the official encoder's output."""
     out = bytearray()
     for a in arrays:
         out += _len_field(1, encode_ndarray(a))
@@ -343,6 +348,12 @@ def encode_arrays_msg(
         out += _len_field(15, trace_id)
     if deadline_s is not None:
         out += _tag(18, _WT_I64) + struct.pack("<d", float(deadline_s))
+    if tenant is not None:
+        if not tenant:
+            raise WireError(
+                "tenant id must be non-empty (omit it instead)"
+            )
+        out += _len_field(19, tenant.encode("utf-8"))
     if _fi.active_plan is not None:  # chaos seam (faultinject.runtime)
         return _fi.filter_bytes("npproto.encode", bytes(out))
     return bytes(out)
@@ -354,6 +365,7 @@ def encode_batch_msg(
     *,
     trace_id: Optional[bytes] = None,
     deadline_s: Optional[float] = None,
+    tenant: Optional[str] = None,
 ) -> bytes:
     """Frame K already-encoded InputArrays/OutputArrays messages as ONE
     batch message (extension field 17) — the npproto twin of
@@ -374,6 +386,12 @@ def encode_batch_msg(
         out += _len_field(15, trace_id)
     if deadline_s is not None:
         out += _tag(18, _WT_I64) + struct.pack("<d", float(deadline_s))
+    if tenant is not None:
+        if not tenant:
+            raise WireError(
+                "tenant id must be non-empty (omit it instead)"
+            )
+        out += _len_field(19, tenant.encode("utf-8"))
     for item in items:
         out += _len_field(17, item)
     if _fi.active_plan is not None:  # chaos seam (faultinject.runtime)
@@ -412,6 +430,24 @@ def peek_deadline_msg(buf: bytes) -> Optional[float]:
                 raise WireError("truncated deadline_s field")
             (budget,) = struct.unpack_from("<d", buf, pos)
             return budget
+        pos = _skip(buf, pos, wt)
+    return None
+
+
+def peek_tenant_msg(buf: bytes) -> Optional[str]:
+    """The message's tenant id (field 19, utf8 string), or ``None``
+    when absent — a skip-walk like :func:`peek_deadline_msg`, so the
+    gateway can meter quotas before paying any ndarray decode.  Raises
+    :class:`~.npwire.WireError` on structurally broken messages."""
+    pos = 0
+    while pos < len(buf):
+        field, wt, pos = _decode_tag(buf, pos)
+        if field == 19 and wt == _WT_LEN:
+            raw, pos = _decode_len(buf, pos)
+            try:
+                return raw.decode("utf-8")
+            except UnicodeDecodeError as e:
+                raise WireError(f"bad tenant id string: {e}") from None
         pos = _skip(buf, pos, wt)
     return None
 
@@ -457,6 +493,10 @@ def decode_batch_msg(
             if pos + 8 > len(buf):
                 raise WireError("truncated deadline_s field")
             pos += 8
+        elif field == 19 and wt == _WT_LEN:
+            # tenant_id: consumed and dropped (peek_tenant_msg is the
+            # gateway-side reader; same posture as deadline_s).
+            _raw, pos = _decode_len(buf, pos)
         else:
             pos = _skip(buf, pos, wt)
     return items, uuid, trace_id, spans
@@ -553,6 +593,10 @@ def decode_arrays_msg_full(
             if pos + 8 > len(buf):
                 raise WireError("truncated deadline_s field")
             pos += 8
+        elif field == 19 and wt == _WT_LEN:
+            # tenant_id: consumed and dropped (peek_tenant_msg is the
+            # gateway-side reader; see decode_batch_msg).
+            _raw, pos = _decode_len(buf, pos)
         else:
             pos = _skip(buf, pos, wt)
     return arrays, uuid, error, trace_id, spans

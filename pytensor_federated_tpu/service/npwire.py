@@ -66,17 +66,28 @@ answered with a :data:`~.deadline.DEADLINE_ERROR_PREFIX` in-band error
 and never computed.  Absent a bound deadline the flag stays clear and
 the frame is byte-identical to the pre-deadline wire (property-tested).
 
+TENANT frames (flag bit 32): a u16-length-prefixed utf8 tenant id
+after the deadline block — the per-tenant identity the gateway tier
+(:mod:`..gateway`) meters quotas and weighted-fair service by.  Like
+the deadline, it is OPTIONAL metadata: absent a tenant the flag stays
+clear and the frame is byte-identical to the pre-tenant wire
+(property-tested); servers that do not meter tenancy consume and drop
+the block.  :func:`peek_tenant` is the admission-side reader (the
+gateway classifies BEFORE paying any decode).
+
 Layout (little-endian):
   message: MAGIC(4s) version(u8) flags(u8) uuid(16s) n_arrays(u32)
            [flags&1 error: len(u32) utf8]
            [flags&2 trace: trace_id(16s)]
-           [flags&16 deadline: budget_s(f64)]  then per array:
+           [flags&16 deadline: budget_s(f64)]
+           [flags&32 tenant: len(u16) utf8]  then per array:
   array:   dtype_len(u16) dtype_str shape_ndim(u8) shape(u64*ndim)
            data_len(u64) data_bytes
   tail:    [flags&4 spans: len(u32) utf8-JSON]
   batch:   same header with flags&8; count = n_items; body is
            item_len(u32) + item_bytes per item (each a full frame);
-           same optional error/trace/deadline blocks and spans tail
+           same optional error/trace/deadline/tenant blocks and
+           spans tail
 """
 
 from __future__ import annotations
@@ -123,6 +134,7 @@ _FLAG_TRACE = 2
 _FLAG_SPANS = 4
 _FLAG_BATCH = 8
 _FLAG_DEADLINE = 16
+_FLAG_TENANT = 32
 # Every known flag bit, mirrored from service/wire_registry.py (the
 # declared source; the graftlint wire-registry rule cross-checks the
 # two).  Decoders REJECT any bit outside this mask: an unknown flag
@@ -130,7 +142,8 @@ _FLAG_DEADLINE = 16
 # around them would be silent mis-parsing — the exact version-skew
 # hazard the module docstring's loud-failure contract forbids.
 _KNOWN_FLAGS = (
-    _FLAG_ERROR | _FLAG_TRACE | _FLAG_SPANS | _FLAG_BATCH | _FLAG_DEADLINE
+    _FLAG_ERROR | _FLAG_TRACE | _FLAG_SPANS | _FLAG_BATCH
+    | _FLAG_DEADLINE | _FLAG_TENANT
 )
 # flags byte offset in the header ("<4sBB...": magic, version, flags)
 _FLAGS_OFF = 5
@@ -182,6 +195,21 @@ def _check_flags(flags: int) -> None:
             f"(known mask 0x{_KNOWN_FLAGS:02x}) — version-skewed peer? "
             "npwire peers must ship in lockstep"
         )
+
+
+def _encode_tenant(tenant: str) -> bytes:
+    """The tenant block (flag bit 32): u16 length + utf8 id.  Loud on
+    the shapes that cannot round-trip — the empty id (absent and empty
+    must stay distinguishable: absent means "no tenancy metering") and
+    ids past the u16 length prefix."""
+    raw = tenant.encode("utf-8")
+    if not raw:
+        raise WireError("tenant id must be non-empty (omit it instead)")
+    if len(raw) > 0xFFFF:
+        raise WireError(
+            f"tenant id too long ({len(raw)} utf8 bytes > 65535)"
+        )
+    return struct.pack("<H", len(raw)) + raw
 
 
 def _tupleize(descr: object) -> object:
@@ -275,6 +303,7 @@ def encode_arrays_sg(
     error: Optional[str] = None,
     trace_id: Optional[bytes] = None,
     deadline_s: Optional[float] = None,
+    tenant: Optional[str] = None,
 ) -> List[Buffer]:
     """Scatter/gather encode: the same frame as :func:`encode_arrays`
     as a BUFFER VECTOR — header/metadata ``bytes`` interleaved with
@@ -304,6 +333,10 @@ def encode_arrays_sg(
         flags |= _FLAG_TRACE
     if deadline_s is not None:
         flags |= _FLAG_DEADLINE
+    tenant_block = None
+    if tenant is not None:
+        tenant_block = _encode_tenant(tenant)
+        flags |= _FLAG_TENANT
     parts: List[Buffer] = [
         struct.pack("<4sBB16sI", MAGIC, 1, flags, uuid, len(arrays))
     ]
@@ -315,6 +348,8 @@ def encode_arrays_sg(
         parts.append(trace_id)
     if deadline_s is not None:
         parts.append(struct.pack("<d", float(deadline_s)))
+    if tenant_block is not None:
+        parts.append(tenant_block)
     for a in arrays:
         dt = _encode_dtype(a.dtype)
         parts.append(struct.pack("<H", len(dt)))
@@ -343,16 +378,19 @@ def encode_arrays(
     error: Optional[str] = None,
     trace_id: Optional[bytes] = None,
     deadline_s: Optional[float] = None,
+    tenant: Optional[str] = None,
 ) -> bytes:
-    """Encode arrays (+uuid, +optional error/trace_id/deadline_s) into
-    one framed message.  ``trace_id`` (16 bytes) is the telemetry
-    correlation id; ``deadline_s`` the remaining deadline budget (flag
-    bit 16); every optional ``None`` emits the exact pre-feature frame.
-    The contiguous form of :func:`encode_arrays_sg` — one flattening
-    join, counted under the ``encode_join`` copy stage."""
+    """Encode arrays (+uuid, +optional error/trace_id/deadline_s/
+    tenant) into one framed message.  ``trace_id`` (16 bytes) is the
+    telemetry correlation id; ``deadline_s`` the remaining deadline
+    budget (flag bit 16); ``tenant`` the gateway tier's per-tenant
+    identity (flag bit 32); every optional ``None`` emits the exact
+    pre-feature frame.  The contiguous form of
+    :func:`encode_arrays_sg` — one flattening join, counted under the
+    ``encode_join`` copy stage."""
     parts = encode_arrays_sg(
         arrays, uuid=uuid, error=error, trace_id=trace_id,
-        deadline_s=deadline_s,
+        deadline_s=deadline_s, tenant=tenant,
     )
     if len(parts) == 1 and isinstance(parts[0], bytes):
         return parts[0]  # chaos path: already joined and filtered
@@ -369,6 +407,7 @@ def encode_batch(
     error: Optional[str] = None,
     trace_id: Optional[bytes] = None,
     deadline_s: Optional[float] = None,
+    tenant: Optional[str] = None,
 ) -> bytes:
     """Frame K already-encoded npwire messages as ONE batch message
     (flag bit 8).  ``items`` are complete frames — each keeps its own
@@ -394,6 +433,10 @@ def encode_batch(
         flags |= _FLAG_TRACE
     if deadline_s is not None:
         flags |= _FLAG_DEADLINE
+    tenant_block = None
+    if tenant is not None:
+        tenant_block = _encode_tenant(tenant)
+        flags |= _FLAG_TENANT
     parts: List[bytes] = [
         struct.pack("<4sBB16sI", MAGIC, 1, flags, uuid, len(items))
     ]
@@ -405,6 +448,8 @@ def encode_batch(
         parts.append(trace_id)
     if deadline_s is not None:
         parts.append(struct.pack("<d", float(deadline_s)))
+    if tenant_block is not None:
+        parts.append(tenant_block)
     for item in items:
         if item[:4] != MAGIC:
             raise WireError("batch items must be complete npwire frames")
@@ -471,6 +516,56 @@ def peek_deadline(buf: bytes) -> Optional[float]:
     return budget
 
 
+def peek_tenant(buf: bytes) -> Optional[str]:
+    """The frame's tenant id (flag bit 32), or ``None`` when the flag
+    is clear — WITHOUT decoding arrays.  The gateway's admission
+    reader: quota and fair-queue classification happen before any
+    decode cost is paid (the :func:`peek_deadline` posture).  Raises
+    :class:`WireError` on a frame whose leading blocks are truncated
+    (the full decoder would reject it identically)."""
+    try:
+        magic, version, flags = struct.unpack_from("<4sBB", buf, 0)
+    except struct.error as e:
+        raise WireError(f"truncated header: {e}") from None
+    if magic != MAGIC:
+        raise WireError(f"bad magic {magic!r}")
+    _check_flags(flags)
+    if not flags & _FLAG_TENANT:
+        return None
+    off = struct.calcsize("<4sBB16sI")
+    if flags & _FLAG_ERROR:
+        try:
+            (elen,) = struct.unpack_from("<I", buf, off)
+        except struct.error as e:
+            raise WireError(f"truncated error block: {e}") from None
+        off += 4 + elen
+    if flags & _FLAG_TRACE:
+        off += 16
+    if flags & _FLAG_DEADLINE:
+        off += 8
+    try:
+        (tlen,) = struct.unpack_from("<H", buf, off)
+        off += 2
+        if off + tlen > len(buf):
+            raise WireError("truncated tenant block")
+        return buf[off : off + tlen].decode("utf-8")
+    except (struct.error, UnicodeDecodeError) as e:
+        raise WireError(f"corrupt tenant block: {e}") from None
+
+
+def _skip_tenant_block(buf: bytes, off: int) -> int:
+    """Consume a tenant block at ``off`` (decoders keep their
+    historical tuple shapes; :func:`peek_tenant` is the reader)."""
+    try:
+        (tlen,) = struct.unpack_from("<H", buf, off)
+    except struct.error as e:
+        raise WireError(f"truncated tenant block: {e}") from None
+    off += 2
+    if off + tlen > len(buf):
+        raise WireError("truncated tenant block")
+    return off + tlen
+
+
 def decode_batch(
     buf: bytes,
 ) -> Tuple[List[bytes], bytes, Optional[str], Optional[bytes], Optional[list]]:
@@ -516,6 +611,9 @@ def decode_batch(
         if off + 8 > len(buf):
             raise WireError("truncated deadline block")
         off += 8
+    if flags & _FLAG_TENANT:
+        # Consumed and dropped (peek_tenant is the gateway-side reader).
+        off = _skip_tenant_block(buf, off)
     items: List[bytes] = []
     for _ in range(n):
         try:
@@ -660,6 +758,9 @@ def decode_arrays_all(
         if off + 8 > len(buf):
             raise WireError("truncated deadline block")
         off += 8
+    if flags & _FLAG_TENANT:
+        # Consumed and dropped (peek_tenant is the gateway-side reader).
+        off = _skip_tenant_block(buf, off)
     arrays: List[np.ndarray] = []
     for _ in range(n):
         try:

@@ -86,6 +86,7 @@ from .npwire import (
     WIRE_BYTES_COPIED,
     WireError,
     _encode_dtype,
+    _encode_tenant,
     _parse_dtype,
     fast_uuid,
     normalize_arrays,
@@ -124,7 +125,8 @@ _KNOWN_KINDS = frozenset(range(_KIND_ATTACH, _KIND_ERROR + 1))
 _FLAG_ERROR = 1
 _FLAG_TRACE = 2
 _FLAG_DEADLINE = 4
-_KNOWN_FLAGS = _FLAG_ERROR | _FLAG_TRACE | _FLAG_DEADLINE
+_FLAG_TENANT = 8
+_KNOWN_FLAGS = _FLAG_ERROR | _FLAG_TRACE | _FLAG_DEADLINE | _FLAG_TENANT
 
 _HEADER = struct.Struct("<4sBBBB16s")
 #: The arena descriptor — layout declared as SHM_DESC_STRUCT in
@@ -161,12 +163,14 @@ def encode_frame(
     error: Optional[str] = None,
     trace_id: Optional[bytes] = None,
     deadline_s: Optional[float] = None,
+    tenant: Optional[str] = None,
 ) -> bytes:
     """One doorbell frame.  Descriptor-only — payload bytes NEVER ride
     the doorbell; they live in the arena.  ``deadline_s`` (flag bit 4)
     carries the request's remaining deadline budget in relative
-    seconds (:mod:`.deadline`); ``None`` emits the pre-deadline
-    byte-identical frame."""
+    seconds (:mod:`.deadline`); ``tenant`` (flag bit 8) the gateway
+    tier's per-tenant identity (u16-length utf8, non-empty); ``None``
+    for either emits the pre-feature byte-identical frame."""
     if len(uuid) != 16:
         raise WireError(f"uuid must be 16 bytes, got {len(uuid)}")
     flags = 0
@@ -181,6 +185,12 @@ def encode_frame(
         flags |= _FLAG_TRACE
     if deadline_s is not None:
         flags |= _FLAG_DEADLINE
+    tenant_block = None
+    if tenant is not None:
+        # The block layout is byte-identical to npwire's by design —
+        # one validator/encoder (npwire._encode_tenant) for both.
+        tenant_block = _encode_tenant(tenant)
+        flags |= _FLAG_TENANT
     parts.append(_HEADER.pack(MAGIC, 1, kind, flags, 0, uuid))
     if error is not None:
         err = error.encode("utf-8")
@@ -190,6 +200,8 @@ def encode_frame(
         parts.append(trace_id)
     if deadline_s is not None:
         parts.append(struct.pack("<d", float(deadline_s)))
+    if tenant_block is not None:
+        parts.append(tenant_block)
     parts.append(body)
     out = b"".join(parts)
     if _fi.active_plan is not None:  # chaos seam (faultinject.runtime)
@@ -248,7 +260,55 @@ def decode_frame(
         except struct.error as e:
             raise WireError(f"truncated shm deadline block: {e}") from None
         off += 8
+    if flags & _FLAG_TENANT:
+        # Consumed and dropped — the historical 7-tuple shape stays
+        # stable for every caller; :func:`frame_tenant` is the reader.
+        try:
+            (tlen,) = struct.unpack_from("<H", buf, off)
+        except struct.error as e:
+            raise WireError(f"truncated shm tenant block: {e}") from None
+        off += 2
+        if off + tlen > len(buf):
+            raise WireError("truncated shm tenant block")
+        off += tlen
     return kind, uuid, error, trace_id, deadline_s, off, buf
+
+
+def frame_tenant(buf: bytes) -> Optional[str]:
+    """The doorbell frame's tenant id (flag bit 8), or ``None`` when
+    the flag is clear — the shm twin of ``npwire.peek_tenant`` (walks
+    the same leading blocks ``decode_frame`` does, without the chaos
+    seam: a peek must not double-fire byte-lane rules)."""
+    try:
+        magic, version, _kind, flags, _pad, _uuid = _HEADER.unpack_from(
+            buf, 0
+        )
+    except struct.error as e:
+        raise WireError(f"truncated shm header: {e}") from None
+    if magic != MAGIC:
+        raise WireError(f"bad shm magic {magic!r}")
+    _check_flags(flags)
+    if not flags & _FLAG_TENANT:
+        return None
+    off = _HEADER.size
+    if flags & _FLAG_ERROR:
+        try:
+            (elen,) = struct.unpack_from("<I", buf, off)
+        except struct.error as e:
+            raise WireError(f"truncated shm error block: {e}") from None
+        off += 4 + elen
+    if flags & _FLAG_TRACE:
+        off += 16
+    if flags & _FLAG_DEADLINE:
+        off += 8
+    try:
+        (tlen,) = struct.unpack_from("<H", buf, off)
+        off += 2
+        if off + tlen > len(buf):
+            raise WireError("truncated shm tenant block")
+        return buf[off : off + tlen].decode("utf-8")
+    except (struct.error, UnicodeDecodeError) as e:
+        raise WireError(f"corrupt shm tenant block: {e}") from None
 
 
 #: One decoded descriptor: (slot, delta, length, generation, dtype, shape).

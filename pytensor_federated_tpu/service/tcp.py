@@ -157,6 +157,7 @@ class TcpArraysClient:
         connect_retries: int = 1,
         connect_backoff_s: float = 0.05,
         timeout_s: Optional[float] = None,
+        tenant: Optional[str] = None,
     ):
         """``max_inflight_bytes`` caps the pipelined window's in-flight
         REQUEST bytes (deadlock guard, see ``evaluate_many``).  The
@@ -181,10 +182,16 @@ class TcpArraysClient:
         until the watchdog fires.  A fired bound closes the
         (desynchronized) connection and surfaces as ``TimeoutError`` —
         an ``OSError``, i.e. the transient classification every retry
-        loop and pool already fails over on."""
+        loop and pool already fails over on.
+
+        ``tenant`` stamps every request with a tenant id (npwire flag
+        bit 32) — the identity the gateway tier meters quotas and
+        weighted-fair service by; ``None`` (the default) keeps every
+        frame byte-identical to the pre-tenant wire."""
         self.host = host
         self.port = int(port)
         self.retries = retries
+        self.tenant = tenant
         self.max_inflight_bytes = max_inflight_bytes
         self.timeout_s = None if timeout_s is None else float(timeout_s)
         self.connect_timeout_s = float(connect_timeout_s)
@@ -283,6 +290,7 @@ class TcpArraysClient:
                     uuid=uid,
                     trace_id=trace_id,
                     deadline_s=_deadline.wire_budget(),
+                    tenant=self.tenant,
                 )
                 request_len = sg_nbytes(request)
             last_err: Optional[Exception] = None
@@ -309,6 +317,7 @@ class TcpArraysClient:
                             uuid=uid,
                             trace_id=trace_id,
                             deadline_s=budget,
+                            tenant=self.tenant,
                         )
                         request_len = sg_nbytes(request)
                 t0 = time.perf_counter()
@@ -507,6 +516,7 @@ class TcpArraysClient:
                         uuid=uid,
                         trace_id=trace_id,
                         deadline_s=budget,
+                        tenant=self.tenant,
                     )
                     encoded.append((parts, sg_nbytes(parts), uid))
             if not encoded:
@@ -605,6 +615,7 @@ class TcpArraysClient:
                         uuid=uid,
                         trace_id=trace_id,
                         deadline_s=budget,
+                        tenant=self.tenant,
                     )
                     encoded.append((parts, sg_nbytes(parts), uid))
             if not encoded:

@@ -57,6 +57,7 @@ NPWIRE_FLAGS = {
     "SPANS": 4,     # JSON span-tree tail (reply piggyback)
     "BATCH": 8,     # count field is n_items; body is nested frames
     "DEADLINE": 16,  # f64 remaining-budget block (service/deadline.py)
+    "TENANT": 32,   # u16-len utf8 tenant id block (gateway/fairness.py)
 }
 
 #: The full known-flags mask every npwire decoder must enforce
@@ -85,6 +86,7 @@ NPPROTO_FIELDS = {
         "spans": 16,        # JSON span trees, reply piggyback
         "batch_items": 17,  # nested messages: the batch frame marker
         "deadline_s": 18,   # fixed64 double: remaining deadline budget
+        "tenant_id": 19,    # utf8 string: per-tenant identity (gateway/)
     },
     "get_load_result": {
         "n_clients": 1,
@@ -132,6 +134,7 @@ SHMWIRE_FLAGS = {
     "ERROR": 1,     # in-band error string block follows the uuid
     "TRACE": 2,     # 16-byte telemetry trace id block
     "DEADLINE": 4,  # f64 remaining-budget block (service/deadline.py)
+    "TENANT": 8,    # u16-len utf8 tenant id block (gateway/fairness.py)
 }
 
 #: The full known-flags mask every shm decoder must enforce

@@ -420,8 +420,14 @@ class FleetCollector:
     every sweep — grpc replicas ride the GetLoad lane; replicas of
     other transports are reported in :attr:`FleetSnapshot.unscraped`
     unless the mapping form of ``http_targets`` names them (the
-    TCP/shm protocols have no telemetry reply lane).  ``include_local``
-    folds this
+    TCP/shm protocols have no telemetry reply lane).  The alias
+    registry is LIVE: :meth:`add_http_target` /
+    :meth:`remove_http_target` register and drop exporter mappings at
+    runtime (the gateway autoscaler calls them as replicas spawn and
+    drain), and with a ``pool`` attached each sweep garbage-collects
+    aliases whose serving address has left the pool registry — a
+    departed replica must never linger as a stale scrape target
+    (ISSUE 12).  ``include_local`` folds this
     process's own registry and flight record in as the
     :data:`LOCAL_REPLICA` pseudo-replica (offset zero) so driver-side
     client/pool families and node families meet in one view.
@@ -468,6 +474,11 @@ class FleetCollector:
         )
         self.history: Deque[FleetSnapshot] = deque(maxlen=int(history))
         self._lock = threading.Lock()
+        # Aliases registered at RUNTIME (add_http_target) follow pool
+        # membership and are GC'd when their replica departs;
+        # constructor-passed aliases are static configuration and are
+        # never GC'd (they may name non-pool exporters).
+        self._dynamic_aliases: set = set()
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         # Addresses whose clock-offset gauge child this collector set
@@ -477,6 +488,26 @@ class FleetCollector:
         self._offset_replicas: set = set()
 
     # -- target registry --------------------------------------------------
+
+    def add_http_target(
+        self, record_as: str, target: TargetSpec
+    ) -> None:
+        """Register (or re-point) an exporter alias at runtime: the
+        exporter at ``target`` is scraped and recorded under the
+        replica's serving address ``record_as`` — the hook the gateway
+        autoscaler calls when it spawns a tcp/shm replica, so the
+        fleet view follows scale-up without a collector restart."""
+        with self._lock:
+            self._http_aliases[str(record_as)] = _as_addr(target)
+            self._dynamic_aliases.add(str(record_as))
+
+    def remove_http_target(self, record_as: str) -> None:
+        """Drop an exporter alias (idempotent) — scale-down's half of
+        :meth:`add_http_target`: a drained replica stops being scraped
+        on the next sweep instead of lingering as a stale target."""
+        with self._lock:
+            self._http_aliases.pop(str(record_as), None)
+            self._dynamic_aliases.discard(str(record_as))
 
     def _sweep_targets(
         self,
@@ -497,7 +528,42 @@ class FleetCollector:
             if f"{host}:{port}" not in seen:
                 seen.add(f"{host}:{port}")
                 out.append((host, port, "http", f"{host}:{port}"))
-        for record_as, (host, port) in self._http_aliases.items():
+        with self._lock:
+            aliases = dict(self._http_aliases)
+            dynamic = set(self._dynamic_aliases)
+        if self.pool is not None and dynamic:
+            # Runtime-registered aliases (add_http_target) follow the
+            # live pool registry: a DYNAMIC alias whose serving
+            # address has left the pool is a departed autoscaled
+            # replica — GC it so churn can neither scrape ghosts nor
+            # grow the alias map without bound.  Static (constructor)
+            # aliases are configuration and are never GC'd.  The
+            # membership re-check and the pop happen under ONE lock
+            # hold (with the registry re-read inside it), so a
+            # replica re-spawned on the same address — whose
+            # add_replica happens-before its add_http_target — can
+            # never have its fresh registration collected: either the
+            # re-read sees the replica, or the registration lands
+            # after the pop and survives.
+            live = {r.address for r in self.pool.replicas}
+            for record_as in list(aliases):
+                if record_as not in dynamic or record_as in live:
+                    continue
+                removed = False
+                with self._lock:
+                    if record_as in self._dynamic_aliases and (
+                        record_as
+                        not in {r.address for r in self.pool.replicas}
+                    ):
+                        self._http_aliases.pop(record_as, None)
+                        self._dynamic_aliases.discard(record_as)
+                        removed = True
+                if removed:
+                    del aliases[record_as]
+                    _flightrec.record(
+                        "collector.target_gc", replica=record_as
+                    )
+        for record_as, (host, port) in aliases.items():
             if record_as not in seen:
                 seen.add(record_as)
                 out.append((host, port, "http", record_as))

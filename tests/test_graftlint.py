@@ -182,8 +182,10 @@ _FLAG_TRACE = 2
 _FLAG_SPANS = 4
 _FLAG_BATCH = 8
 _FLAG_DEADLINE = 16
+_FLAG_TENANT = 32
 _KNOWN_FLAGS = (
-    _FLAG_ERROR | _FLAG_TRACE | _FLAG_SPANS | _FLAG_BATCH | _FLAG_DEADLINE
+    _FLAG_ERROR | _FLAG_TRACE | _FLAG_SPANS | _FLAG_BATCH
+    | _FLAG_DEADLINE | _FLAG_TENANT
 )
 
 
@@ -208,8 +210,10 @@ constexpr uint8_t kFlagTrace = 2;
 constexpr uint8_t kFlagSpans = 4;
 constexpr uint8_t kFlagBatch = 8;
 constexpr uint8_t kFlagDeadline = 16;
+constexpr uint8_t kFlagTenant = 32;
 constexpr uint8_t kKnownFlags =
-    kFlagError | kFlagTrace | kFlagSpans | kFlagBatch | kFlagDeadline;
+    kFlagError | kFlagTrace | kFlagSpans | kFlagBatch | kFlagDeadline |
+    kFlagTenant;
 bool decode(const Buf& b) {
   if (flags & ~kKnownFlags) return false;
   return true;
@@ -242,7 +246,8 @@ _KNOWN_KINDS = frozenset(range(1, 13))
 _FLAG_ERROR = 1
 _FLAG_TRACE = 2
 _FLAG_DEADLINE = 4
-_KNOWN_FLAGS = _FLAG_ERROR | _FLAG_TRACE | _FLAG_DEADLINE
+_FLAG_TENANT = 8
+_KNOWN_FLAGS = _FLAG_ERROR | _FLAG_TRACE | _FLAG_DEADLINE | _FLAG_TENANT
 _DESC_STRUCT = struct.Struct("<QIQQ")
 
 
@@ -288,8 +293,8 @@ class TestWireRegistry:
     def test_missing_known_mask_flagged(self, tmp_path):
         src = NPWIRE_CLEAN.replace(
             "_KNOWN_FLAGS = (\n"
-            "    _FLAG_ERROR | _FLAG_TRACE | _FLAG_SPANS | _FLAG_BATCH"
-            " | _FLAG_DEADLINE\n)",
+            "    _FLAG_ERROR | _FLAG_TRACE | _FLAG_SPANS | _FLAG_BATCH\n"
+            "    | _FLAG_DEADLINE | _FLAG_TENANT\n)",
             "",
         )
         findings = run_on(tmp_path, {NPWIRE_REL: src}, ["wire-registry"])

@@ -107,6 +107,34 @@ class TestCppNode:
             np.testing.assert_allclose(float(got), exp, rtol=1e-12)
         client.close()
 
+    def test_tenant_stamped_request_served(self, cpp_node):
+        """The tenant block (npwire flag 32, ISSUE 12) must be
+        framing-validated and dropped by the native node — a
+        gateway-fronted C++ replica serves tenant-stamped frames
+        identically to plain ones."""
+        from pytensor_federated_tpu.service import TcpArraysClient
+
+        rng = np.random.default_rng(7)
+        x = rng.normal(size=32)
+        y = 1.0 + 0.5 * x
+        client = TcpArraysClient("127.0.0.1", cpp_node, tenant="acme/eu")
+        out = client.evaluate(
+            np.float64(1.0), np.float64(0.5), np.float64(1.0), x, y
+        )
+        want = ref_logp_grad(1.0, 0.5, 1.0, x, y)
+        for got, exp in zip(out, want):
+            np.testing.assert_allclose(float(got), exp, rtol=1e-12)
+        # Pipelined + batch-framed windows keep working tenant-stamped.
+        reqs = [
+            (np.float64(0.1 * i), np.float64(0.5), np.float64(1.0), x, y)
+            for i in range(6)
+        ]
+        res = client.evaluate_many(reqs, window=3)
+        for i, out_i in enumerate(res):
+            want_i, _, _ = ref_logp_grad(0.1 * i, 0.5, 1.0, x, y)
+            np.testing.assert_allclose(float(out_i[0]), want_i, rtol=1e-12)
+        client.close()
+
     def test_many_lockstep_calls_one_connection(self, cpp_node):
         from pytensor_federated_tpu.service import TcpArraysClient
 
@@ -608,7 +636,7 @@ def test_unknown_flag_bits_rejected_loudly(cpp_node):
     frame = bytearray(
         encode_arrays([np.zeros(3, np.float64)])
     )
-    frame[_FLAGS_OFF] |= 0x20  # undeclared bit 32 (16 is DEADLINE now)
+    frame[_FLAGS_OFF] |= 0x40  # undeclared bit 64 (32 is TENANT now)
     with socket_mod.create_connection(("127.0.0.1", cpp_node), 5) as s:
         s.sendall(struct_mod.pack("<I", len(frame)) + bytes(frame))
         s.settimeout(5)

@@ -101,7 +101,7 @@ def test_unknown_flag_bits_rejected():
     )
 
     enc = bytearray(encode_arrays([np.zeros(3, np.float32)]))
-    enc[_FLAGS_OFF] |= 0x20  # undeclared bit 32 (16 = DEADLINE, ISSUE 10)
+    enc[_FLAGS_OFF] |= 0x40  # undeclared bit 64 (32 = TENANT, ISSUE 12)
     with pytest.raises(WireError, match="unknown flag bits"):
         decode_arrays(bytes(enc))
 

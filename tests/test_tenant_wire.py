@@ -1,0 +1,210 @@
+"""Property tests for the tenant-id wire field (ISSUE 12).
+
+One field, four implementations — npwire flag bit 32, npproto
+extension field 19, the shm doorbell flag bit 8, and the C++ node
+(covered in test_native_node.py) — all declared first in
+service/wire_registry.py.  The pins:
+
+- round-trip: a stamped tenant reads back exactly via the peek
+  readers on every codec, for any unicode id;
+- byte-identical: NO tenant => byte-identical frames on every codec
+  (the deadline field's property, extended);
+- forward-compat: the OFFICIAL protobuf runtime parsing under the
+  reference schema skips field 19 (proto3 unknown-field rule);
+- loud-failure: a truncated tenant block raises WireError, never a
+  silent mis-parse.
+"""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+from pytensor_federated_tpu.service import shm as shm_mod  # noqa: E402
+from pytensor_federated_tpu.service.npproto_codec import (  # noqa: E402
+    decode_arrays_msg_full,
+    decode_batch_msg,
+    encode_arrays_msg,
+    encode_batch_msg,
+    peek_tenant_msg,
+)
+from pytensor_federated_tpu.service.npwire import (  # noqa: E402
+    WireError,
+    decode_arrays_all,
+    decode_batch,
+    encode_arrays,
+    encode_batch,
+    peek_deadline,
+    peek_tenant,
+)
+
+_PROP = settings(max_examples=60, deadline=None)
+
+# Non-empty unicode ids (empty is rejected loudly: absent and empty
+# must stay distinguishable on the wire).
+_tenants = st.text(min_size=1, max_size=48)
+
+_arrays = st.lists(
+    st.integers(min_value=0, max_value=255), min_size=0, max_size=8
+).map(lambda xs: np.asarray(xs, dtype=np.float32))
+
+
+class TestNpwireTenant:
+    @_PROP
+    @given(arr=_arrays, tenant=_tenants)
+    def test_roundtrip_and_peek(self, arr, tenant):
+        buf = encode_arrays([arr], uuid=b"u" * 16, tenant=tenant)
+        assert peek_tenant(buf) == tenant
+        arrays, uuid, error, _tid, _sp = decode_arrays_all(buf)
+        assert uuid == b"u" * 16 and error is None
+        np.testing.assert_array_equal(arrays[0], arr)
+
+    @_PROP
+    @given(
+        arr=_arrays,
+        tenant=_tenants,
+        deadline=st.one_of(
+            st.none(), st.floats(0.001, 100.0, allow_nan=False)
+        ),
+    )
+    def test_tenant_composes_with_deadline(self, arr, tenant, deadline):
+        buf = encode_arrays(
+            [arr], uuid=b"u" * 16, tenant=tenant, deadline_s=deadline,
+            trace_id=b"t" * 16,
+        )
+        assert peek_tenant(buf) == tenant
+        if deadline is None:
+            assert peek_deadline(buf) is None
+        else:
+            assert peek_deadline(buf) == pytest.approx(deadline)
+        decode_arrays_all(buf)  # must stay decodable
+
+    @_PROP
+    @given(arr=_arrays)
+    def test_no_tenant_byte_identical(self, arr):
+        assert encode_arrays([arr], uuid=b"u" * 16) == encode_arrays(
+            [arr], uuid=b"u" * 16, tenant=None
+        )
+
+    @_PROP
+    @given(arr=_arrays, tenant=_tenants)
+    def test_batch_roundtrip(self, arr, tenant):
+        item = encode_arrays([arr], uuid=b"i" * 16, tenant=tenant)
+        buf = encode_batch([item], uuid=b"b" * 16, tenant=tenant)
+        assert peek_tenant(buf) == tenant
+        items, uuid, error, _tid, _sp = decode_batch(buf)
+        assert uuid == b"b" * 16 and error is None
+        assert items == [item]
+        assert encode_batch([item], uuid=b"b" * 16) == encode_batch(
+            [item], uuid=b"b" * 16, tenant=None
+        )
+
+    @_PROP
+    @given(arr=_arrays, tenant=_tenants, cut=st.integers(1, 64))
+    def test_truncation_loud(self, arr, tenant, cut):
+        """Any cut INSIDE a tenant-stamped frame raises WireError (or
+        the peek succeeds because the cut fell past the block) — never
+        another exception, never silence."""
+        buf = encode_arrays([arr], uuid=b"u" * 16, tenant=tenant)
+        prefix = buf[: max(0, len(buf) - cut)]
+        try:
+            peek_tenant(prefix)
+        except WireError:
+            pass
+        try:
+            decode_arrays_all(prefix)
+        except WireError:
+            return
+        # A successful decode means the cut only removed payload the
+        # decoder legitimately tolerated — nothing silent happened.
+
+    def test_empty_tenant_rejected(self):
+        with pytest.raises(WireError):
+            encode_arrays([], uuid=b"u" * 16, tenant="")
+
+    def test_oversized_tenant_rejected(self):
+        with pytest.raises(WireError):
+            encode_arrays([], uuid=b"u" * 16, tenant="x" * 70_000)
+
+
+class TestNpprotoTenant:
+    @_PROP
+    @given(arr=_arrays, tenant=_tenants)
+    def test_roundtrip_and_peek(self, arr, tenant):
+        buf = encode_arrays_msg([arr], "uu", tenant=tenant)
+        assert peek_tenant_msg(buf) == tenant
+        arrays, uuid, error, _tid, _sp = decode_arrays_msg_full(buf)
+        assert uuid == "uu" and error is None
+        np.testing.assert_array_equal(arrays[0], arr)
+
+    @_PROP
+    @given(arr=_arrays)
+    def test_no_tenant_byte_identical(self, arr):
+        assert encode_arrays_msg([arr], "uu") == encode_arrays_msg(
+            [arr], "uu", tenant=None
+        )
+
+    @_PROP
+    @given(arr=_arrays, tenant=_tenants)
+    def test_batch_roundtrip(self, arr, tenant):
+        item = encode_arrays_msg([arr], "ii", tenant=tenant)
+        buf = encode_batch_msg([item], "bb", tenant=tenant)
+        assert peek_tenant_msg(buf) == tenant
+        items, uuid, _tid, _sp = decode_batch_msg(buf)
+        assert uuid == "bb" and items == [item]
+
+    @_PROP
+    @given(arr=_arrays, tenant=_tenants)
+    def test_reference_runtime_skips_field_19(self, arr, tenant):
+        """The OFFICIAL protobuf runtime parsing under the reference
+        schema (no field 19) must skip the tenant id by wire type —
+        the same forward-compatibility pin fields 14-18 carry."""
+        from test_npproto_codec import _official_messages
+
+        _nd, InputArrays, _gl = _official_messages()
+        buf = encode_arrays_msg([arr], "uu", tenant=tenant)
+        msg = InputArrays()
+        msg.ParseFromString(buf)
+        assert msg.uuid == "uu"
+        assert len(msg.items) == 1
+
+
+class TestShmTenant:
+    @_PROP
+    @given(tenant=_tenants, body=st.binary(max_size=32))
+    def test_roundtrip_and_peek(self, tenant, body):
+        frame = shm_mod.encode_frame(
+            shm_mod._KIND_EVAL, b"u" * 16, body, tenant=tenant,
+            deadline_s=1.5, trace_id=b"t" * 16,
+        )
+        assert shm_mod.frame_tenant(frame) == tenant
+        kind, uuid, error, tid, deadline_s, off, buf = (
+            shm_mod.decode_frame(frame)
+        )
+        assert kind == shm_mod._KIND_EVAL and error is None
+        assert deadline_s == pytest.approx(1.5)
+        assert buf[off:] == body  # the tenant block never eats body bytes
+
+    @_PROP
+    @given(body=st.binary(max_size=32))
+    def test_no_tenant_byte_identical(self, body):
+        a = shm_mod.encode_frame(shm_mod._KIND_EVAL, b"u" * 16, body)
+        b = shm_mod.encode_frame(
+            shm_mod._KIND_EVAL, b"u" * 16, body, tenant=None
+        )
+        assert a == b
+        assert shm_mod.frame_tenant(a) is None
+
+    def test_truncated_tenant_block_loud(self):
+        frame = shm_mod.encode_frame(
+            shm_mod._KIND_EVAL, b"u" * 16, b"", tenant="acme"
+        )
+        with pytest.raises(WireError):
+            shm_mod.decode_frame(frame[:-3])
+
+    def test_empty_tenant_rejected(self):
+        with pytest.raises(WireError):
+            shm_mod.encode_frame(
+                shm_mod._KIND_EVAL, b"u" * 16, b"", tenant=""
+            )

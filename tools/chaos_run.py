@@ -1046,6 +1046,404 @@ def run_collector_seed(seed: int, verbose: bool) -> dict:
     return result
 
 
+# -- the gateway lane (ISSUE 12) --------------------------------------------
+
+
+async def _gw_client_task(
+    host, port, calls, tenant, deadline_s, tally, lock,
+    start_delay_s=0.0, pipeline=False,
+):
+    """One downstream client: one held connection, sequential calls —
+    the minimal async npwire peer (the harness cannot spend a thread
+    per client at 1k clients).  ``pipeline=True`` sends EVERY frame
+    before reading any reply (the hog's flood shape; the gateway
+    preserves per-connection FIFO so replies still correlate in
+    order).  Every outcome is classified into the shared tally; an
+    unclassified escape raises Violation."""
+    from pytensor_federated_tpu.gateway import is_overload_error
+    from pytensor_federated_tpu.service.deadline import is_deadline_error
+    from pytensor_federated_tpu.service.npwire import (
+        WireError,
+        decode_arrays_all,
+        encode_arrays,
+        fast_uuid,
+    )
+    import struct as struct_mod
+
+    async def tally_inc(key):
+        async with lock:
+            tally[tenant][key] = tally[tenant].get(key, 0) + 1
+
+    reader = writer = None
+    try:
+        if start_delay_s:
+            # Mice arrive over a window, not as one synchronized spike
+            # — a real population's arrival process; the hog (delay 0)
+            # IS the spike.
+            await asyncio.sleep(start_delay_s)
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(host, port), timeout=CALL_DEADLINE_S
+        )
+        sent = []  # (input, uid) pairs whose replies are still owed
+        if pipeline:
+            for i in calls:
+                uid = fast_uuid()
+                frame = encode_arrays(
+                    [np.array([float(i), 5.0])],
+                    uuid=uid,
+                    tenant=tenant,
+                    deadline_s=deadline_s,
+                )
+                writer.write(struct_mod.pack("<I", len(frame)) + frame)
+                sent.append((i, uid))
+            await asyncio.wait_for(
+                writer.drain(), timeout=CALL_DEADLINE_S
+            )
+        for step in range(len(calls)):
+            if pipeline:
+                i, uid = sent[step]
+            else:
+                i = calls[step]
+                uid = fast_uuid()
+                frame = encode_arrays(
+                    [np.array([float(i), 5.0])],
+                    uuid=uid,
+                    tenant=tenant,
+                    deadline_s=deadline_s,
+                )
+                writer.write(struct_mod.pack("<I", len(frame)) + frame)
+                await asyncio.wait_for(
+                    writer.drain(), timeout=CALL_DEADLINE_S
+                )
+            try:
+                hdr = await asyncio.wait_for(
+                    reader.readexactly(4), timeout=CALL_DEADLINE_S
+                )
+                (n,) = struct_mod.unpack("<I", hdr)
+                payload = await asyncio.wait_for(
+                    reader.readexactly(n), timeout=CALL_DEADLINE_S
+                )
+            except asyncio.TimeoutError:
+                raise Violation(
+                    f"gateway call hang past {CALL_DEADLINE_S}s "
+                    f"(tenant {tenant})"
+                )
+            except (asyncio.IncompleteReadError, ConnectionError, OSError):
+                # The gateway (or our socket) went away mid-call — a
+                # classified transport failure, loud by construction.
+                await tally_inc("transport")
+                return
+            try:
+                arrays, ruid, error, _tid, _sp = decode_arrays_all(payload)
+            except WireError:
+                await tally_inc("wire_error")
+                return
+            if error is not None:
+                if is_deadline_error(error):
+                    await tally_inc("deadline")
+                elif is_overload_error(error):
+                    if f"tenant {tenant}" not in error:
+                        raise Violation(
+                            f"denial without tenant label: {error[:200]}"
+                        )
+                    await tally_inc("denied")
+                else:
+                    await tally_inc("upstream_error")
+                continue
+            if ruid != uid:
+                raise Violation(
+                    f"gateway reply uuid mismatch (tenant {tenant})"
+                )
+            got = float(np.asarray(arrays[0]))
+            want = _expected(float(i))
+            if not np.isclose(got, want, rtol=1e-6):
+                raise Violation(
+                    f"gateway returned {got}, expected {want} "
+                    "(silent corruption)"
+                )
+            await tally_inc("ok")
+    finally:
+        if writer is not None:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+
+async def _run_gateway_async(seed, procs, ports, victim, params, gw,
+                             scaler, log):
+    """1k downstream clients vs the gateway, one hog tenant, the
+    victim replica SIGKILLed and restarted mid-run.  Invariants
+    (ISSUE 12 acceptance):
+
+    G1 fairness  — every non-hog tenant keeps its fair share: ok-rate
+                   >= ``fair_floor`` despite the hog's flood;
+    G2 loudness  — every denial carries the tenant in-band AND in the
+                   pftpu_gateway_denials_total labels AND as a
+                   ``gateway.denied`` flight event; no unclassified
+                   escape (the client task classifies every outcome);
+    G3 no hang   — every call settles within CALL_DEADLINE_S;
+    G4 converge  — after the flap heals and load stops, the breakers
+                   close, the autoscaler drains what it spawned, and a
+                   clean window through the gateway is exact.
+    """
+    tally = {t: {} for t in params["tenants"] + ["hog"]}
+    lock = asyncio.Lock()
+    host = "127.0.0.1"
+
+    tasks = []
+    # The mice: n_clients held connections spread over the tenants,
+    # a few sequential calls each.
+    for k in range(params["n_clients"]):
+        tenant = params["tenants"][k % len(params["tenants"])]
+        calls = [(k * 7 + j) % 12 for j in range(params["calls_per_client"])]
+        tasks.append(
+            _gw_client_task(
+                host, gw.port, calls, tenant,
+                params["deadline_s"], tally, lock,
+                start_delay_s=(k % 97) / 97.0 * params["mice_spread_s"],
+            )
+        )
+    # The hog: a handful of connections PIPELINING floods far past the
+    # quota (a lock-step hog would self-throttle on its own replies).
+    for k in range(params["hog_conns"]):
+        calls = [(k + j) % 12 for j in range(params["hog_calls_per_conn"])]
+        tasks.append(
+            _gw_client_task(
+                host, gw.port, calls, "hog",
+                params["deadline_s"], tally, lock,
+                pipeline=True,
+            )
+        )
+
+    async def flapper():
+        # The flap: SIGKILL the victim mid-traffic, restart it, let
+        # the pool re-probe it back in.
+        await asyncio.sleep(params["flap_after_s"])
+        procs[victim].kill()
+        procs[victim].join(timeout=10)
+        log(f"  flapped replica on port {ports[victim]}")
+        await asyncio.sleep(params["flap_down_s"])
+        procs[victim] = _spawn_node("tcp", ports[victim], None)
+        await _wait_nodes_up_async("tcp", [ports[victim]])
+        log("  victim restarted")
+
+    t0 = time.time()
+    await asyncio.gather(*tasks, flapper())
+    wall = time.time() - t0
+
+    totals = {
+        t: sum(c.values()) for t, c in tally.items()
+    }
+    log(f"  tally ({wall:.1f}s): {tally}")
+
+    # G1: per-tenant fairness floor for every non-hog tenant.
+    for tenant in params["tenants"]:
+        total = totals[tenant]
+        ok = tally[tenant].get("ok", 0)
+        if total and ok / total < params["fair_floor"]:
+            raise Violation(
+                f"tenant {tenant} below fair share: {ok}/{total} ok "
+                f"({ok / total:.0%} < {params['fair_floor']:.0%})"
+            )
+
+    # G2: denials happened (the hog out-ran its quota), and every one
+    # is attributable: in-band (checked per call), tenant-labeled in
+    # the metric family, and flight-recorded.
+    n_denied = sum(c.get("denied", 0) for c in tally.values())
+    if n_denied == 0:
+        raise Violation("hog never out-ran its quota — lane mis-tuned")
+    if tally["hog"].get("denied", 0) == 0:
+        raise Violation("denials landed but none on the hog tenant")
+    from pytensor_federated_tpu.telemetry.metrics import REGISTRY
+
+    fam = REGISTRY.get("pftpu_gateway_denials_total")
+    metric_denied = 0.0
+    if fam is not None:
+        for key, child in fam._children.items():
+            if key[0] == "hog":
+                metric_denied += child.value
+    if metric_denied == 0:
+        raise Violation(
+            "no tenant-labeled denial metric for the hog tenant"
+        )
+    denied_events = [
+        e for e in flightrec.events() if e["kind"] == "gateway.denied"
+    ]
+    if not any(e.get("tenant") == "hog" for e in denied_events):
+        raise Violation("no gateway.denied flight event naming the hog")
+
+    # G4: convergence after the flap + load stop.
+    deadline_t = time.time() + 30.0
+    pool = gw.pool
+    while time.time() < deadline_t:
+        await pool.probe_once_async()
+        breakers_ok = all(
+            r.breaker.state == "closed" for r in pool.replicas
+        )
+        if breakers_ok and not scaler.owned:
+            break
+        await asyncio.sleep(0.2)
+    bad = [
+        (r.address, r.breaker.state)
+        for r in pool.replicas
+        if r.breaker.state != "closed"
+    ]
+    if bad:
+        raise Violation(f"breakers never reconverged after flap: {bad}")
+    if scaler.owned:
+        raise Violation(
+            f"autoscaler never drained its spawned replicas "
+            f"({[f'{h}:{p}' for h, p, _ in scaler.owned]})"
+        )
+    # Clean window: every value exact through the gateway.
+    clean = {t: {} for t in ["clean"]}
+    await _gw_client_task(
+        host, gw.port, list(range(12)), "clean", None, clean, lock
+    )
+    if clean["clean"].get("ok", 0) != 12:
+        raise Violation(f"clean window not exact: {clean}")
+    return {
+        "ok_calls": sum(c.get("ok", 0) for c in tally.values()),
+        "denied": n_denied,
+        "hog_denied": tally["hog"].get("denied", 0),
+        "transient": sum(c.get("transport", 0) for c in tally.values()),
+        "deadline_shed": sum(
+            c.get("deadline", 0) for c in tally.values()
+        ),
+    }
+
+
+def run_gateway_seed(seed: int, verbose: bool) -> dict:
+    """One gateway scenario (``--lane gateway``); same result-dict
+    contract as :func:`run_seed`."""
+
+    def log(msg):
+        if verbose:
+            print(msg, flush=True)
+
+    from pytensor_federated_tpu.gateway import (
+        Autoscaler,
+        GatewayThread,
+        TenantFairness,
+    )
+    from pytensor_federated_tpu.routing import NodePool
+
+    rng = random.Random(seed ^ 0x6A7E)
+    params = {
+        "n_clients": 1000,
+        "calls_per_client": 2,
+        "tenants": [f"t{i}" for i in range(8)],
+        "hog_conns": 4,
+        "hog_calls_per_conn": rng.choice([150, 200]),
+        # Generous per-call budget: the lane tests fairness and the
+        # flap, not deadline pressure (the overload lane owns that).
+        "deadline_s": 30.0,
+        "fair_floor": 0.6,
+        "flap_after_s": rng.uniform(0.3, 0.8),
+        "flap_down_s": rng.uniform(0.5, 1.0),
+        # Mice arrivals spread over this window, so each mouse
+        # tenant's rate (~250 calls / spread) sits inside the quota
+        # while the hog's zero-delay flood tears through it.
+        "mice_spread_s": 2.0,
+        "quota_rate_per_s": 200.0,
+        "quota_burst": 100.0,
+    }
+    log(f"gateway seed {seed}: {params}")
+    # Metrics mutate only while telemetry is enabled (metrics.py) —
+    # and G2 counts tenant-labeled denial metrics.
+    tspans.set_enabled(True)
+    flightrec.set_enabled(True)
+    if flightrec.capacity() < 16384:
+        flightrec.set_capacity(16384)
+    flightrec.clear()
+
+    ports = _free_ports(2)
+    victim = random.Random(seed ^ 0x5EED).randrange(2)
+    procs = [_spawn_node("tcp", p, None) for p in ports]
+    extra_procs = []
+    result = {"seed": seed, "transport": "gateway", "ok": True}
+    pool = None
+    gw = None
+    scaler = None
+    try:
+        _wait_nodes_up("tcp", ports)
+        pool = NodePool(
+            [("127.0.0.1", p) for p in ports],
+            transport="tcp",
+            probe_interval_s=0.3,
+            probe_timeout_s=1.0,
+            breaker_kwargs=dict(
+                failure_threshold=2, backoff_s=0.2, jitter_frac=0.1
+            ),
+        )
+        pool.start()
+        fairness = TenantFairness(
+            quota_rate_per_s=params["quota_rate_per_s"],
+            quota_burst=params["quota_burst"],
+            max_backlog_per_tenant=4096,
+        )
+        gw = GatewayThread(pool, fairness=fairness, frame_items=16)
+        gw.start()
+
+        def spawn():
+            (port,) = _free_ports(1)
+            proc = _spawn_node("tcp", port, None)
+            extra_procs.append(proc)
+            return ("127.0.0.1", port, proc)
+
+        def stop(proc):
+            if proc.is_alive():
+                proc.terminate()
+            proc.join(timeout=10)
+
+        scaler = Autoscaler(
+            pool,
+            gw.server.signals,
+            spawn,
+            stop,
+            min_replicas=2,
+            max_replicas=3,
+            scale_up_queue_depth=64.0,
+            scale_down_queue_depth=4.0,
+            consecutive=2,
+            cooldown_up_s=1.0,
+            cooldown_down_s=1.5,
+            drain_grace_s=0.1,
+            interval_s=0.3,
+        ).start()
+        stats = asyncio.run(
+            _run_gateway_async(
+                seed, procs, ports, victim, params, gw, scaler, log
+            )
+        )
+        result.update(stats)
+    except Exception as e:  # noqa: BLE001 - every failure becomes a record
+        result["ok"] = False
+        result["error"] = f"{type(e).__name__}: {e}"
+        try:
+            result["bundle"] = write_incident_bundle(
+                "chaos-gateway-violation",
+                attrs={"seed": seed, "violation": str(e)[:500]},
+            )
+        except Exception as be:  # pragma: no cover - disk trouble
+            result["bundle"] = f"<bundle write failed: {be}>"
+    finally:
+        if scaler is not None:
+            scaler.stop(drain_owned=True)
+        if gw is not None:
+            gw.stop()
+        if pool is not None:
+            pool.close()
+        for proc in procs + extra_procs:
+            if proc.is_alive():
+                proc.terminate()
+        for proc in procs + extra_procs:
+            proc.join(timeout=10)
+        flightrec.clear()
+    return result
+
+
 def run_seed(seed: int, transport: str, verbose: bool) -> dict:
     """One full chaos scenario; returns a result dict, raising nothing —
     violations land in the dict with an incident-bundle path."""
@@ -1146,7 +1544,7 @@ def main(argv=None) -> int:
     ap.add_argument("--base-seed", type=int, default=0)
     ap.add_argument("--transport", "--lane", dest="transport",
                     choices=("grpc", "tcp", "shm", "overload",
-                             "collector"),
+                             "collector", "gateway"),
                     default="grpc",
                     help="transport lane under chaos (--lane is an "
                     "alias; 'shm' runs the zero-copy arena lane; "
@@ -1155,7 +1553,11 @@ def main(argv=None) -> int:
                     "deadline/shed/budget invariants; 'collector' "
                     "runs the ISSUE-11 scenario: fleet scrapes racing "
                     "replica SIGKILLs — no hangs, loud staleness, "
-                    "never-torn merges)")
+                    "never-torn merges; 'gateway' runs the ISSUE-12 "
+                    "scenario: 1k downstream clients through the "
+                    "front door, one hog tenant, a flapping replica — "
+                    "fairness floors, tenant-labeled denials, zero "
+                    "hangs, autoscaler convergence)")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
 
@@ -1171,11 +1573,19 @@ def main(argv=None) -> int:
             res = run_overload_seed(seed, args.verbose)
         elif args.transport == "collector":
             res = run_collector_seed(seed, args.verbose)
+        elif args.transport == "gateway":
+            res = run_gateway_seed(seed, args.verbose)
         else:
             res = run_seed(seed, args.transport, args.verbose)
         status = "ok" if res["ok"] else "FAIL"
         if not res["ok"]:
             extra = f"{res['error']} bundle={res.get('bundle')}"
+        elif args.transport == "gateway":
+            extra = (
+                f"ok={res.get('ok_calls')} denied={res.get('denied')} "
+                f"hog_denied={res.get('hog_denied')} "
+                f"transient={res.get('transient')}"
+            )
         elif args.transport == "overload":
             extra = (
                 f"ok={res.get('ok_calls')} shed={res.get('deadline_shed')} "

@@ -73,6 +73,12 @@ MODULES = [
     "pytensor_federated_tpu.telemetry.collector",
     "pytensor_federated_tpu.telemetry.critpath",
     "pytensor_federated_tpu.telemetry.slo",
+    # Gateway tier (ISSUE 12): the front door — accept tier, tenant
+    # fairness vocabulary, and the autoscaler a deployment tunes.
+    "pytensor_federated_tpu.gateway",
+    "pytensor_federated_tpu.gateway.server",
+    "pytensor_federated_tpu.gateway.fairness",
+    "pytensor_federated_tpu.gateway.autoscale",
     # Fault-injection subsystem (ISSUE 5): the plan vocabulary and the
     # runtime primitives the shims call are both public surface — chaos
     # plans are authored against them (docs/robustness.md).
