@@ -461,6 +461,71 @@ def deadline_overhead(n_check: int = 200_000, n_wire: int = 4_000) -> dict:
     }
 
 
+def partition_overhead(n_plan: int = 20_000, n_round: int = 2_000) -> dict:
+    """Shard/reassemble cost gate for the gradient-partition lane
+    (ISSUE 13): the driver-side work a reduce-scatter reply adds on
+    top of the wire — plan the partitions, slice a representative
+    flat gradient, reassemble it under the full loud-validation rules
+    — must stay a small fraction of the ~110 us RPC floor, or the
+    bytes saved would be paid back in CPU.
+
+    Two measurements, best-of-3 like the sibling gates:
+
+    - ``plan_ns``: ``plan_partitions(total, 8)`` — the pure shard
+      math both ends derive per window.
+    - ``roundtrip_us``: slice a 16k-element f64 gradient (128 KiB,
+      the production-width shape of suite config 15) into 8 partition
+      slices and reassemble them through :class:`Reassembler`
+      (every add validates geometry/overlap/dtype; result() checks
+      coverage) — the whole driver-side cost of one 8-way reduce
+      reply.
+
+    PASSES when one full slice+reassemble round trip stays under 50%
+    of the RPC floor (measured ~33 us in this container — it replaces
+    EIGHT full-gradient decodes plus their frames, so the ceiling is
+    a large net win; the gate exists to catch a validation-path
+    regression, not to race memcpy) and the plan alone stays
+    sub-microsecond-per-shard."""
+    from pytensor_federated_tpu.routing.partition import (
+        Reassembler,
+        plan_partitions,
+    )
+
+    total, count = 16_384, 8
+    flat = np.random.default_rng(0).normal(size=total)
+
+    def plan_loop() -> float:
+        t0 = time.perf_counter()
+        for _ in range(n_plan):
+            plan_partitions(total, count)
+        return (time.perf_counter() - t0) / n_plan
+
+    def round_loop() -> float:
+        t0 = time.perf_counter()
+        for _ in range(n_round):
+            plan = plan_partitions(total, count)
+            r = Reassembler(total, count, flat.dtype)
+            for p in plan:
+                r.add(p, flat[p.offset : p.offset + p.length])
+            r.result()
+        return (time.perf_counter() - t0) / n_round
+
+    plan_s = round_s = float("inf")
+    for _ in range(3):
+        plan_s = min(plan_s, plan_loop())
+        round_s = min(round_s, round_loop())
+    rpc_floor_s = 110e-6  # docs/performance.md "Host lane budget"
+    frac = round_s / rpc_floor_s
+    return {
+        "plan_ns": round(plan_s * 1e9, 1),
+        "roundtrip_us": round(round_s * 1e6, 2),
+        "total_elems": total,
+        "count": count,
+        "roundtrip_frac_of_rpc_floor": round(frac, 4),
+        "pass": bool(frac < 0.50 and plan_s < 1e-6 * count),
+    }
+
+
 def shm_overhead(n_pings: int = 300) -> dict:
     """Idle gate for the zero-copy shm transport (ISSUE 9): one
     doorbell round-trip with an EMPTY arena write — slot allocate +
@@ -1027,6 +1092,13 @@ def main():
         deadline_gate = {"error": f"{type(e).__name__}: {e}", "pass": False}
 
     try:
+        partition_gate = partition_overhead()
+    except Exception as e:  # same invariant
+        partition_gate = {
+            "error": f"{type(e).__name__}: {e}", "pass": False,
+        }
+
+    try:
         collector_gate = collector_overhead(
             runners[best], flat0, wall / n_evals
         )
@@ -1069,6 +1141,7 @@ def main():
                 "faultinject_overhead": fault_shims,
                 "shm_overhead": shm_gate,
                 "deadline_overhead": deadline_gate,
+                "partition_overhead": partition_gate,
                 "collector_overhead": collector_gate,
                 "gateway_overhead": gateway_gate,
                 **flop_extra,

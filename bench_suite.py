@@ -291,6 +291,71 @@ def _bench_serve_fed_node(port):
     run_node(compute, "127.0.0.1", port)
 
 
+def _bench_serve_partition_leaves(ports):
+    """Config 19's leaf nodes: ``(w, s) -> [logp, grad]`` with a WIDE
+    gradient (``len(w)`` elements — the bandwidth-wall shape) and a
+    per-shard pseudo-dataset derived from the scalar ``s``, so the
+    request ships one parameter vector + one scalar and the REPLY
+    carries the full gradient.  One subprocess serves several ports on
+    threads (64 leaf processes would thrash a 2-core container; the
+    parallelism under test is the DRIVER's fan-in, not leaf compute)."""
+    import logging
+    import threading as _threading
+
+    import numpy as np
+
+    logging.basicConfig(level=logging.WARNING)
+
+    def compute(w, s):
+        w = np.asarray(w)
+        d = np.sin(np.arange(w.size) * (1.0 + float(np.asarray(s))))
+        r = w - d
+        return [np.asarray(-0.5 * np.sum(r * r)), -r]
+
+    from pytensor_federated_tpu.service.tcp import serve_tcp_once
+
+    threads = [
+        _threading.Thread(
+            target=serve_tcp_once,
+            args=(compute, "127.0.0.1", p),
+            kwargs=dict(concurrent=True),
+            daemon=True,
+        )
+        for p in ports
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+
+def _bench_serve_partition_mid(port, leaf_ports):
+    """Config 19's mid-tier aggregator: forwards reduce windows to its
+    leaf pool and ships ONE partial sum upstream
+    (routing.make_aggregator_compute — the tree lane)."""
+    import logging
+
+    logging.basicConfig(level=logging.WARNING)
+
+    from pytensor_federated_tpu.routing import (
+        NodePool,
+        PooledArraysClient,
+        make_aggregator_compute,
+    )
+    from pytensor_federated_tpu.service.tcp import serve_tcp_once
+
+    pool = NodePool(
+        [("127.0.0.1", p) for p in leaf_ports], transport="tcp"
+    )
+    child = PooledArraysClient(pool)
+    serve_tcp_once(
+        make_aggregator_compute(child, window=8),
+        "127.0.0.1",
+        port,
+        concurrent=True,
+    )
+
+
 def _bench_serve_shm_node(port, use_suffstats):
     """Config 15's shm node: the C++ node's EXACT Gaussian linreg
     logp+grad contract ``(a, b, sigma, x, y) -> [logp, g_a, g_b]`` in
@@ -2714,6 +2779,240 @@ def main():
                 p.join(timeout=5)
 
     guard("gateway vs direct-dial", _c18)
+
+    # 19. Shard the gradient on the wire (ISSUE 13): one federated
+    # logp+grad evaluation = 64 shard-requests, each replying a WIDE
+    # gradient (4096 f64 = 32 KiB).  Full-array replies ship 64
+    # gradients per eval; reduce-scatter windows ship one partial sum
+    # per replica (width-bound); the width-64 tree ships one partial
+    # per MID-TIER.  Measured: driver-side reply bytes/eval (the
+    # decode_copy family — the exact bytes the full-array lane pays to
+    # decode) and evals/s, full-array vs reduce at width 8, flat
+    # fan-in vs 8x8 tree at width 64.  Acceptance: >= 4x reply-byte
+    # reduction at width 8 (theoretical bound: 8x = requests per
+    # replica) and the tree beating flat fan-in wall-clock at width
+    # 64.
+    def _c19():
+        import multiprocessing as mp
+        import socket as _socket
+        import time as _time
+
+        from pytensor_federated_tpu.routing import (
+            NodePool,
+            PooledArraysClient,
+        )
+        from pytensor_federated_tpu.service.npwire import (
+            WIRE_BYTES_COPIED,
+        )
+
+        def free_ports(n):
+            socks, ports = [], []
+            for _ in range(n):
+                s = _socket.socket()
+                s.bind(("127.0.0.1", 0))
+                socks.append(s)
+                ports.append(s.getsockname()[1])
+            for s in socks:
+                s.close()
+            return ports
+
+        P, n_reqs = 4096, 64
+        rng = np.random.default_rng(19)
+        reqs = [
+            (rng.normal(size=P), np.float64(i % 7))
+            for i in range(n_reqs)
+        ]
+
+        def local_reference():
+            def compute(w, s):
+                d = np.sin(np.arange(P) * (1.0 + float(s)))
+                r = np.asarray(w) - d
+                return [np.asarray(-0.5 * np.sum(r * r)), -r]
+
+            head = np.sum([compute(*r)[0] for r in reqs])
+            flat = np.sum([compute(*r)[1] for r in reqs], axis=0)
+            return head, flat
+
+        want_head, want_flat = local_reference()
+        decode_copied = WIRE_BYTES_COPIED.labels(
+            lane="npwire", stage="decode_copy"
+        )
+
+        from pytensor_federated_tpu.telemetry import spans as _tspans
+
+        def measure(fn, seconds=2.0):
+            """(evals/s, reply bytes/eval).  Bytes are read from the
+            decode_copy counter over ONE instrumented eval (the
+            counter only counts under telemetry, which would tax the
+            rate loop); the rate loop runs uninstrumented, equality-
+            gated inside ``fn`` every eval."""
+            fn()  # warm (connections, caches)
+            was = _tspans.enabled()
+            _tspans.set_enabled(True)
+            try:
+                b0 = decode_copied.value
+                fn()
+                bytes_per_eval = decode_copied.value - b0
+            finally:
+                _tspans.set_enabled(was)
+            t0 = _time.perf_counter()
+            done = 0
+            while _time.perf_counter() - t0 < seconds:
+                fn()
+                done += 1
+            wall = _time.perf_counter() - t0
+            return done / wall, bytes_per_eval
+
+        ctx = mp.get_context("spawn")
+        leaf_ports = free_ports(64)
+        # 8 leaf processes x 8 served ports: 64 addressable leaves.
+        leaf_procs = [
+            ctx.Process(
+                target=_bench_serve_partition_leaves,
+                args=(leaf_ports[8 * k : 8 * k + 8],),
+                daemon=True,
+            )
+            for k in range(8)
+        ]
+        mid_ports = free_ports(8)
+        mid_procs = []
+        pools = []
+        try:
+            for p in leaf_procs:
+                p.start()
+            deadline = _time.time() + 60
+            pending = set(leaf_ports)
+            while pending and _time.time() < deadline:
+                for p in list(pending):
+                    try:
+                        with _socket.create_connection(
+                            ("127.0.0.1", p), timeout=1.0
+                        ):
+                            pending.discard(p)
+                    except OSError:
+                        _time.sleep(0.1)
+            if pending:
+                raise RuntimeError(f"leaves never listened: {pending}")
+
+            def make_client(ports):
+                pool = NodePool(
+                    [("127.0.0.1", p) for p in ports], transport="tcp"
+                )
+                pools.append(pool)
+                return PooledArraysClient(pool)
+
+            # -- width 8: full-array vs reduce-scatter -------------
+            w8 = make_client(leaf_ports[:8])
+
+            def full_eval():
+                out = w8.evaluate_many(reqs, window=8)
+                head = np.sum([np.asarray(r[0]) for r in out])
+                flat = np.sum([np.asarray(r[1]) for r in out], axis=0)
+                np.testing.assert_allclose(head, want_head, rtol=1e-9)
+                np.testing.assert_allclose(flat, want_flat, rtol=1e-9)
+
+            def reduce_eval():
+                head, flat = w8.evaluate_reduced(
+                    reqs, window=8, total=P
+                )
+                np.testing.assert_allclose(head, want_head, rtol=1e-9)
+                np.testing.assert_allclose(flat, want_flat, rtol=1e-9)
+
+            full_rate, full_bytes = measure(full_eval)
+            red_rate, red_bytes = measure(reduce_eval)
+
+            # -- width 64: flat fan-in vs 8x8 tree -----------------
+            for port, k in zip(mid_ports, range(8)):
+                proc = ctx.Process(
+                    target=_bench_serve_partition_mid,
+                    args=(port, leaf_ports[8 * k : 8 * k + 8]),
+                    daemon=True,
+                )
+                proc.start()
+                mid_procs.append(proc)
+            deadline = _time.time() + 60
+            pending = set(mid_ports)
+            while pending and _time.time() < deadline:
+                for p in list(pending):
+                    try:
+                        with _socket.create_connection(
+                            ("127.0.0.1", p), timeout=1.0
+                        ):
+                            pending.discard(p)
+                    except OSError:
+                        _time.sleep(0.1)
+            if pending:
+                raise RuntimeError(f"mid-tiers never listened: {pending}")
+
+            flat64 = make_client(leaf_ports)
+            tree = make_client(mid_ports)
+
+            def flat64_eval():
+                # window=1 -> one request per replica: the true
+                # width-64 flat fan-in (64 gradient replies).
+                head, flat = flat64.evaluate_reduced(
+                    reqs, window=1, total=P
+                )
+                np.testing.assert_allclose(head, want_head, rtol=1e-9)
+                np.testing.assert_allclose(flat, want_flat, rtol=1e-9)
+
+            def tree_eval():
+                head, flat = tree.evaluate_reduced(
+                    reqs, window=8, total=P
+                )
+                np.testing.assert_allclose(head, want_head, rtol=1e-9)
+                np.testing.assert_allclose(flat, want_flat, rtol=1e-9)
+
+            flat_rate, flat_bytes = measure(flat64_eval)
+            tree_rate, tree_bytes = measure(tree_eval)
+
+            byte_reduction = full_bytes / max(red_bytes, 1.0)
+            tree_speedup = tree_rate / max(flat_rate, 1e-9)
+            for lane, rate, nbytes in (
+                ("w8-full-array", full_rate, full_bytes),
+                ("w8-reduce", red_rate, red_bytes),
+                ("w64-flat", flat_rate, flat_bytes),
+                ("w64-tree", tree_rate, tree_bytes),
+            ):
+                print(
+                    f"# partition lane {lane}: {rate:.2f} evals/s, "
+                    f"{nbytes / 1024:.1f} KiB replies/eval",
+                    file=sys.stderr,
+                )
+            record(
+                "gradient sharding on the wire (reduce-scatter + tree)",
+                red_rate,
+                unit="evals/s",
+                n_requests=n_reqs,
+                grad_elems=P,
+                full_rate=round(full_rate, 2),
+                full_reply_bytes_per_eval=int(full_bytes),
+                reduce_rate=round(red_rate, 2),
+                reduce_reply_bytes_per_eval=int(red_bytes),
+                reply_byte_reduction_w8=round(byte_reduction, 2),
+                flat64_rate=round(flat_rate, 2),
+                flat64_reply_bytes_per_eval=int(flat_bytes),
+                tree_rate=round(tree_rate, 2),
+                tree_reply_bytes_per_eval=int(tree_bytes),
+                tree_vs_flat_speedup=round(tree_speedup, 2),
+                note=(
+                    "64 shard-requests x 32 KiB gradients, equality-"
+                    "gated against the local sums every eval; "
+                    "acceptance: reply_byte_reduction_w8 >= 4 "
+                    "(theoretical bound 8x = requests per replica) "
+                    "and tree_vs_flat_speedup > 1 at width 64 "
+                    "(8 mid-tier aggregators vs 64-way driver fan-in)"
+                ),
+            )
+        finally:
+            for pool in pools:
+                pool.close()
+            for p in mid_procs + leaf_procs:
+                p.terminate()
+            for p in mid_procs + leaf_procs:
+                p.join(timeout=10)
+
+    guard("gradient sharding reduce-scatter", _c19)
 
     if results:
         print(

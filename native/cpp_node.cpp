@@ -97,13 +97,15 @@ constexpr uint8_t kFlagSpans = 4;
 constexpr uint8_t kFlagBatch = 8;
 constexpr uint8_t kFlagDeadline = 16;
 constexpr uint8_t kFlagTenant = 32;
+constexpr uint8_t kFlagPartition = 64;
 // Every known flag bit, mirrored from service/wire_registry.py (the
 // declared source; graftlint's wire-registry rule cross-checks this
 // file).  Decoders reject any bit outside the mask: an unknown flag
 // means blocks this build cannot place, and skipping them would be
 // silent mis-parsing of everything after (loud-failure contract).
 constexpr uint8_t kKnownFlags = kFlagError | kFlagTrace | kFlagSpans |
-                                kFlagBatch | kFlagDeadline | kFlagTenant;
+                                kFlagBatch | kFlagDeadline | kFlagTenant |
+                                kFlagPartition;
 // flags byte offset in the payload: magic(4) + version(1)
 constexpr size_t kFlagsOff = 5;
 
@@ -119,6 +121,17 @@ struct Array {
   }
 };
 
+// Gradient-partition index block (flag 64) — layout declared in
+// service/wire_registry.py PARTITION_STRUCT; routing/partition.py
+// owns the head/tail slice rule this node implements in serve_plain.
+struct Partition {
+  uint32_t index = 0;
+  uint32_t count = 0;
+  uint64_t offset = 0;
+  uint64_t length = 0;
+  uint64_t total = 0;
+};
+
 struct Message {
   uint8_t uuid[16];
   std::string error;  // empty = no error
@@ -127,6 +140,10 @@ struct Message {
   // wire.  has_deadline=false = unbounded (the pre-deadline wire).
   bool has_deadline = false;
   double deadline_s = 0.0;
+  // Partition request/echo (flag 64).  has_partition=false = the
+  // pre-partition wire (byte-identical replies).
+  bool has_partition = false;
+  Partition partition;
 };
 
 // ---- low-level IO -------------------------------------------------------
@@ -249,6 +266,28 @@ bool decode(const std::vector<uint8_t>& buf, Message* msg, std::string* why) {
       return false;
     }
   }
+  if (flags & kFlagPartition) {
+    // Gradient-partition block: index(u32) count(u32) offset(u64)
+    // length(u64) total(u64).  A request carrying it asks for the
+    // head/tail SLICED reply (serve_plain applies the rule); invalid
+    // geometry is rejected here, loudly, before any compute.
+    Partition& p = msg->partition;
+    if (!r.le(&p.index) || !r.le(&p.count) || !r.le(&p.offset) ||
+        !r.le(&p.length) || !r.le(&p.total)) {
+      *why = "truncated partition block";
+      return false;
+    }
+    // Overflow-safe geometry check: `offset + length > total` wraps
+    // for hostile u64 values and would admit a block that then reads
+    // as a zero-filled slice (silent wrong data) or drives a huge
+    // resize — subtract instead of add.
+    if (p.count == 0 || p.index >= p.count || p.offset > p.total ||
+        p.length > p.total - p.offset) {
+      *why = "invalid partition block";
+      return false;
+    }
+    msg->has_partition = true;
+  }
   // Each array needs >= 11 bytes of headers (2 dtype-len + 1 ndim +
   // 8 data-len), so any frame can hold at most remaining/11 arrays.
   if (n_arrays > r.remaining() / 11) {
@@ -326,12 +365,21 @@ std::vector<uint8_t> encode(const Message& msg) {
   std::vector<uint8_t> out;
   put(&out, kMagic, 4);
   put_le<uint8_t>(&out, kVersion);
-  put_le<uint8_t>(&out, msg.error.empty() ? 0 : kFlagError);
+  uint8_t flags = msg.error.empty() ? 0 : kFlagError;
+  if (msg.has_partition) flags |= kFlagPartition;
+  put_le<uint8_t>(&out, flags);
   put(&out, msg.uuid, 16);
   put_le<uint32_t>(&out, static_cast<uint32_t>(msg.arrays.size()));
   if (!msg.error.empty()) {
     put_le<uint32_t>(&out, static_cast<uint32_t>(msg.error.size()));
     put(&out, msg.error.data(), msg.error.size());
+  }
+  if (msg.has_partition) {
+    put_le<uint32_t>(&out, msg.partition.index);
+    put_le<uint32_t>(&out, msg.partition.count);
+    put_le<uint64_t>(&out, msg.partition.offset);
+    put_le<uint64_t>(&out, msg.partition.length);
+    put_le<uint64_t>(&out, msg.partition.total);
   }
   for (const auto& a : msg.arrays) {
     put_le<uint16_t>(&out, static_cast<uint16_t>(a.dtype.size()));
@@ -347,6 +395,61 @@ std::vector<uint8_t> encode(const Message& msg) {
 // ---- batch frames (flag 8) ----------------------------------------------
 
 Message compute(const Message& in);  // fwd decl (model below)
+bool is_f8(const Array& a);          // fwd decl (model below)
+
+// The head/tail slice rule (routing/partition.py): reply array 0 (the
+// logp head) rides whole; arrays 1.. are the TAIL, flat-concatenated
+// and sliced to the requested element range.  The requester's `total`
+// must equal the actual flat tail size — a driver/node shape
+// disagreement becomes an in-band error, never a mis-sliced gradient.
+void apply_partition(const Partition& p, Message* reply) {
+  if (!reply->error.empty()) return;  // error replies carry no slice
+  if (reply->arrays.empty()) {
+    reply->error = "partition requested but the reply has no head";
+    return;
+  }
+  uint64_t total = 0;
+  for (size_t i = 1; i < reply->arrays.size(); ++i) {
+    if (!is_f8(reply->arrays[i])) {
+      reply->error = "partitioned tail arrays must share one dtype";
+      return;
+    }
+    total += reply->arrays[i].nelem();
+  }
+  if (p.total != total) {
+    std::ostringstream oss;
+    oss << "partition total " << p.total << " != reply tail size "
+        << total << " (driver/node shape disagreement)";
+    reply->error = oss.str();
+    return;
+  }
+  Array slice;
+  slice.dtype = "<f8";
+  slice.shape = {p.length};
+  slice.data.resize(static_cast<size_t>(p.length) * 8);
+  uint64_t pos = 0;  // element cursor over the flat tail
+  uint64_t written = 0;
+  for (size_t i = 1; i < reply->arrays.size(); ++i) {
+    const Array& a = reply->arrays[i];
+    const uint64_t n = a.nelem();
+    const uint64_t lo = std::max<uint64_t>(pos, p.offset);
+    const uint64_t hi = std::min<uint64_t>(pos + n, p.offset + p.length);
+    if (lo < hi) {
+      std::memcpy(slice.data.data() + (lo - p.offset) * 8,
+                  a.data.data() + (lo - pos) * 8, (hi - lo) * 8);
+      written += hi - lo;
+    }
+    pos += n;
+  }
+  (void)written;
+  Message out;
+  std::memcpy(out.uuid, reply->uuid, 16);
+  out.arrays.push_back(reply->arrays[0]);
+  out.arrays.push_back(std::move(slice));
+  out.has_partition = true;
+  out.partition = p;
+  *reply = std::move(out);
+}
 
 // One plain payload -> one reply payload (shared by the lock-step loop
 // and the per-item path inside a batch frame).
@@ -362,6 +465,7 @@ std::vector<uint8_t> serve_plain(const std::vector<uint8_t>& buf) {
       reply.error = "deadline exceeded: budget spent before admission";
     } else {
       reply = compute(in);
+      if (in.has_partition) apply_partition(in.partition, &reply);
     }
   } else {
     std::memset(reply.uuid, 0, 16);
@@ -431,6 +535,20 @@ std::vector<uint8_t> serve_batch(const std::vector<uint8_t>& buf) {
     std::string tenant;
     if (!r.le(&tlen) || !r.str(&tenant, tlen))
       return batch_error_reply("decode failed: truncated tenant block");
+  }
+  if (flags & kFlagPartition) {
+    // An OUTER partition block asks for a REDUCE window (sum the
+    // items' replies, answer partition-indexed slices —
+    // routing/partition.py).  The native node serves sliced PLAIN
+    // frames but not reduce windows; the refusal is loud and in-band
+    // so a driver that mis-negotiated fails over instead of decoding
+    // garbage.
+    Partition p;
+    if (!r.le(&p.index) || !r.le(&p.count) || !r.le(&p.offset) ||
+        !r.le(&p.length) || !r.le(&p.total))
+      return batch_error_reply("decode failed: truncated partition block");
+    return batch_error_reply(
+        "partition reduce windows are not supported by the native node");
   }
   // Each item needs >= 4 bytes (its length prefix), so any frame holds
   // at most remaining/4 items — reject hostile counts before looping.

@@ -43,7 +43,10 @@ _CPP = "native/cpp_node.cpp"
 _SHM = "pytensor_federated_tpu/service/shm.py"
 
 #: npwire decode entry points that must enforce the known-flags mask.
-_NPWIRE_DECODERS = ("decode_arrays_all", "decode_batch")
+#: Since ISSUE 13 the full decoders are the ``*_part`` variants (the
+#: historical names are thin delegating wrappers over them, so the
+#: guard obligation sits on the bodies that actually parse flags).
+_NPWIRE_DECODERS = ("decode_arrays_part", "decode_batch_part")
 
 _LOUDNESS_SCOPE = (
     "pytensor_federated_tpu/service/",

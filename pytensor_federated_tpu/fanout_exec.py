@@ -29,7 +29,9 @@ import threading
 import time
 import weakref
 from concurrent.futures import ThreadPoolExecutor
-from typing import Callable, List, Sequence, Tuple
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
 
 from .telemetry import flightrec as _flightrec
 from .telemetry import metrics as _metrics
@@ -38,6 +40,7 @@ from .telemetry import spans as _tspans
 __all__ = [
     "CoalescingCaller",
     "MemberExecutorPool",
+    "PartitionedCaller",
     "member_spans",
     "run_members",
 ]
@@ -253,6 +256,99 @@ class CoalescingCaller:
         finally:
             for s in group:
                 s["event"].set()
+
+
+_PARTITIONED_SLICES = _metrics.histogram(
+    "pftpu_fanout_partitioned_slices",
+    "Partition-indexed slice fetches per oversized-reply evaluation",
+    buckets=_metrics.DEFAULT_COUNT_BUCKETS,
+)
+
+
+class PartitionedCaller:
+    """Fetch a member's oversized reply as partition-indexed slices.
+
+    The fanout layer's half of ISSUE 13's "gradients larger than one
+    reply frame": a member whose gradient exceeds what one reply frame
+    should carry (transport frame caps, arena slot sizes) wraps its
+    client here — ``evaluate(*arrays)`` issues ``count`` sliced
+    requests (the head/tail rule, ``partition=`` on the pinned
+    clients), reassembles them with the loud
+    :class:`~.routing.partition.Reassembler` rules, and returns
+    ``[head, *tail]`` with the original tail shapes restored (or
+    ``[head, flat]`` when ``tail_shapes`` is not given).
+
+    The node recomputes per slice — this trades compute for frame
+    size, the right trade exactly when a reply cannot ride one frame;
+    for per-item bandwidth reduction use the reduce windows
+    (``evaluate_reduced``) instead.
+    """
+
+    def __init__(
+        self,
+        client: object,
+        *,
+        total: int,
+        max_slice_elems: int,
+        tail_shapes: Optional[Sequence[Tuple[int, ...]]] = None,
+    ) -> None:
+        from .routing import partition as _gradpart
+
+        if max_slice_elems < 1:
+            raise ValueError(
+                f"max_slice_elems must be >= 1, got {max_slice_elems}"
+            )
+        self._gradpart = _gradpart
+        self._client = client
+        self.total = int(total)
+        self.count = max(
+            1, -(-self.total // int(max_slice_elems))
+        )  # ceil
+        self.tail_shapes = (
+            None if tail_shapes is None else [tuple(s) for s in tail_shapes]
+        )
+        if self.tail_shapes is not None:
+            declared = sum(
+                int(np.prod(s, dtype=np.int64)) for s in self.tail_shapes
+            )
+            if declared != self.total:
+                raise _gradpart.PartitionError(
+                    f"tail_shapes cover {declared} elements, total "
+                    f"declares {self.total}"
+                )
+
+    def evaluate(self, *arrays) -> list:
+        gp = self._gradpart
+        plan = gp.plan_partitions(self.total, self.count)
+        _PARTITIONED_SLICES.observe(len(plan))
+        head = None
+        reassembler = None
+        with _tspans.span(
+            "fanout.partitioned_call", count=self.count, total=self.total
+        ):
+            for part in plan:
+                reply = self._client.evaluate(*arrays, partition=part)
+                if len(reply) != 2:
+                    raise gp.PartitionError(
+                        f"sliced reply must be [head, slice], got "
+                        f"{len(reply)} arrays"
+                    )
+                head = reply[0]
+                sl = np.asarray(reply[1])
+                if reassembler is None:
+                    reassembler = gp.Reassembler(
+                        self.total,
+                        self.count,
+                        sl.dtype if sl.size else np.dtype(np.float64),
+                    )
+                reassembler.add(part, sl)
+        assert reassembler is not None
+        flat = reassembler.result()
+        if self.tail_shapes is None:
+            return [head, flat]
+        return [head, *gp.split_tail(flat, self.tail_shapes)]
+
+    __call__ = evaluate
 
 
 def member_spans(counts: Sequence[int]) -> List[Tuple[int, int]]:

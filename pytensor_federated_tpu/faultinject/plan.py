@@ -67,6 +67,15 @@ truncate_slot       scribble the arena slot's tail generation after the
 stale_generation    age the descriptor's generation so it no longer
                     matches the slot — the recycled-slot race, forced
                     (shm lane only)
+drop_shard          remove one partition-indexed slice from a reduce
+                    reply (the reassembler must refuse the incomplete
+                    gradient loudly, never return a partial sum)
+dup_shard           replace one reduce-reply slice with a copy of a
+                    sibling (duplicate index + missing index — both
+                    loud reassembly refusals)
+corrupt_shard       flip bytes inside a reduce-reply slice's partition
+                    block (geometry lies: overlap / out-of-bounds /
+                    count drift — every shape a loud WireError)
 ==================  =======================================================
 """
 
@@ -98,6 +107,9 @@ FAULT_KINDS = frozenset(
         "corrupt_descriptor",
         "truncate_slot",
         "stale_generation",
+        "drop_shard",
+        "dup_shard",
+        "corrupt_shard",
     }
 )
 

@@ -62,6 +62,11 @@ point                     primitive / applicable kinds
                           just wrote)
 ``shm.compute``           :func:`compute_filter` (same kinds as
                           ``server.compute``)
+``partition.reply``       :func:`shard_filter` — drop_shard,
+                          dup_shard, corrupt_shard (the reduce-reply
+                          slice list, before it is framed; the
+                          driver's reassembler must refuse every
+                          shape loudly)
 ========================  ==============================================
 """
 
@@ -96,6 +101,7 @@ __all__ = [
     "probe_filter_async",
     "arena_fault",
     "corrupt_descriptor_bytes",
+    "shard_filter",
     "snapshot",
 ]
 
@@ -511,6 +517,54 @@ def corrupt_descriptor_bytes(
         i = desc_off + (rng.randrange(span) if rng is not None else 0)
         out[i] ^= 0xFF
     return bytes(out)
+
+
+def shard_filter(
+    point: str,
+    items: List[bytes],
+    *,
+    block_off: int = 0,
+    block_len: int = 32,
+    peer: Optional[str] = None,
+) -> List[bytes]:
+    """Partition reduce-reply shim (ISSUE 13): mangle the slice list a
+    server is about to frame, so the DRIVER's reassembly loudness is
+    what chaos verifies.  ``drop_shard`` removes a seeded slice (the
+    reassembler's missing-index refusal); ``dup_shard`` replaces one
+    slice with a copy of a sibling (duplicate + missing — both loud);
+    ``corrupt_shard`` flips bytes inside one slice's partition/
+    descriptor block — the ``block_len`` bytes at ``block_off``, NEVER
+    payload bytes (payload damage would be silent; geometry damage is
+    guaranteed loud: overlap/out-of-bounds/count drift).  ``delay``
+    sleeps (sync server lanes only)."""
+    rule = decide(point, peer)
+    if rule is None or not items:
+        return items
+    kind = rule.kind
+    rng = rule._rng
+    idx = rng.randrange(len(items)) if rng is not None else 0
+    if kind == "drop_shard":
+        return [it for j, it in enumerate(items) if j != idx]
+    if kind == "dup_shard":
+        out = list(items)
+        out[(idx + 1) % len(out)] = out[idx]
+        return out
+    if kind == "corrupt_shard":
+        victim = bytearray(items[idx])
+        span = min(block_len, len(victim) - block_off)
+        if span > 0:
+            for _ in range(min(3, span)):
+                i = block_off + (
+                    rng.randrange(span) if rng is not None else 0
+                )
+                victim[i] ^= 0xFF
+        out = list(items)
+        out[idx] = bytes(victim)
+        return out
+    if kind == "delay":
+        time.sleep(rule.delay_s)
+        return items
+    raise FaultPlanError(f"fault kind {kind!r} not applicable at {point}")
 
 
 def probe_filter(peer: str, point: str = "pool.probe") -> bool:

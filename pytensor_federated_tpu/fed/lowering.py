@@ -38,33 +38,134 @@ from .batching import plan_windows
 from .placements import MapSpec, Placement
 from .primitives import (
     fed_broadcast,
+    fed_broadcast_p,
     fed_map,
     fed_map_p,
     fed_sum,
+    fed_sum_p,
     is_tracer as _is_tracer,
 )
 
 __all__ = ["FederatedLogpGrad", "program"]
 
 
+def _plan_reduce(
+    closed: Any,
+    plan: Dict[int, List[int]],
+    placement: Placement,
+    baked: frozenset,
+) -> Dict[int, int]:
+    """Pair eligible ``fed_sum(fed_map(...))`` equations for the
+    REDUCED window lowering (ISSUE 13) -> ``{map_eqn_idx:
+    sum_eqn_idx}``.
+
+    Eligibility (every check is a correctness gate, not a heuristic):
+
+    - the placement opted in (``reduce=True``) and provides
+      ``reduced_sum_executor``;
+    - the ``fed_map`` fits the logp+grad wire contract (one scalar
+      inexact output), ships no driver-varying closure values, and is
+      not in a window-fusion group;
+    - its single output feeds EXACTLY one equation — the ``fed_sum``
+      — and is not itself a program output (anyone else reading the
+      per-shard values needs them un-summed);
+    - every INEXACT mapped operand is ``fed_broadcast``-derived or a
+      trace-time-baked constant: the reduced gradient is ``Σ_s
+      grad_s``, which is only a usable cotangent for consumers whose
+      transpose SUMS over shards (broadcast) or who need no cotangent
+      at all (baked consts, integers).  A per-shard program INPUT
+      fails the gate and the pair falls back to the per-shard window
+      — correct, just not reduced."""
+    if not getattr(placement, "reduce", False) or not hasattr(
+        placement, "reduced_sum_executor"
+    ):
+        return {}
+    jaxpr = closed.jaxpr
+    grouped: set = set()
+    for group in plan.values():
+        grouped.update(group)
+    producer: Dict[Any, int] = {}
+    consumers: Dict[Any, List[int]] = {}
+    for i, eqn in enumerate(jaxpr.eqns):
+        for v in eqn.outvars:
+            producer[v] = i
+        for v in eqn.invars:
+            if not isinstance(v, Literal):
+                consumers.setdefault(v, []).append(i)
+    out_vars = {
+        v for v in jaxpr.outvars if not isinstance(v, Literal)
+    }
+    broadcast_out = {
+        v
+        for eqn in jaxpr.eqns
+        if eqn.primitive is fed_broadcast_p
+        for v in eqn.outvars
+    }
+    baked_or_const = baked | set(jaxpr.constvars)
+    pairs: Dict[int, int] = {}
+    for j, sum_eqn in enumerate(jaxpr.eqns):
+        if sum_eqn.primitive is not fed_sum_p:
+            continue
+        v = sum_eqn.invars[0]
+        if isinstance(v, Literal) or v in out_vars:
+            continue
+        i = producer.get(v)
+        if i is None or jaxpr.eqns[i].primitive is not fed_map_p:
+            continue
+        map_eqn = jaxpr.eqns[i]
+        if len(map_eqn.outvars) != 1 or len(consumers.get(v, ())) != 1:
+            continue
+        if i in grouped or i in pairs:
+            continue
+        spec = MapSpec.from_eqn(map_eqn, baked)
+        if not spec.grad_contract or spec.n_varying_consts:
+            continue
+        n_consts = map_eqn.params["n_consts"]
+        eligible = True
+        for xv in map_eqn.invars[n_consts:]:
+            if isinstance(xv, Literal) or xv in baked_or_const:
+                continue
+            if xv in broadcast_out:
+                continue
+            if not jnp.issubdtype(xv.aval.dtype, jnp.inexact):
+                continue
+            eligible = False
+            break
+        if eligible:
+            pairs[i] = j
+    return pairs
+
+
 def _build_executors(
     closed: Any, placement: Placement, plan: Dict[int, List[int]]
-) -> Dict[int, tuple]:
+) -> Tuple[Dict[int, tuple], Dict[int, int]]:
     """One persistent executor per ``fed_map`` equation: fused groups
-    share a group executor keyed at every member index.  Outer
-    constvars holding CONCRETE values are trace-time-baked constants
-    (``MapSpec`` uses this to tell a baked function constant from
-    driver-varying closure capture, which pool lanes must refuse)."""
+    share a group executor keyed at every member index; eligible
+    ``fed_sum(fed_map)`` pairs lower to ONE reduced window
+    (:func:`_plan_reduce`).  Outer constvars holding CONCRETE values
+    are trace-time-baked constants (``MapSpec`` uses this to tell a
+    baked function constant from driver-varying closure capture, which
+    pool lanes must refuse)."""
     jaxpr = closed.jaxpr
     baked = frozenset(
         v
         for v, c in zip(jaxpr.constvars, closed.consts)
         if not _is_tracer(c)
     )
+    reduce_pairs = _plan_reduce(closed, plan, placement, baked)
     executors: Dict[int, tuple] = {}
     done_groups: Dict[tuple, Any] = {}
     for i, eqn in enumerate(jaxpr.eqns):
         if eqn.primitive is not fed_map_p:
+            continue
+        if i in reduce_pairs:
+            executors[i] = (
+                "reduced",
+                placement.reduced_sum_executor(  # type: ignore[attr-defined]
+                    MapSpec.from_eqn(eqn, baked)
+                ),
+                reduce_pairs[i],
+            )
             continue
         group = plan.get(i)
         if group is None:
@@ -78,7 +179,7 @@ def _build_executors(
                 [MapSpec.from_eqn(jaxpr.eqns[j], baked) for j in group]
             )
         executors[i] = ("group", key, done_groups[key])
-    return executors
+    return executors, reduce_pairs
 
 
 def program(
@@ -118,12 +219,14 @@ def program(
 
             closed = jax.make_jaxpr(flat_fn)(*flat)
             plan = plan_windows(closed.jaxpr) if fuse else {}
-            executors = _build_executors(closed, placement, plan)
-            entry = (closed, out_store[0], plan, executors)
+            executors, reduce_pairs = _build_executors(
+                closed, placement, plan
+            )
+            entry = (closed, out_store[0], plan, executors, reduce_pairs)
             if not any(_is_tracer(c) for c in closed.consts):
                 cache[key] = entry
-        closed, out_tree, plan, executors = entry
-        outs = _interpret(closed, flat, plan, executors)
+        closed, out_tree, plan, executors, reduce_pairs = entry
+        outs = _interpret(closed, flat, plan, executors, reduce_pairs)
         return tree_util.tree_unflatten(out_tree, outs)
 
     wrapped.__name__ = getattr(fn, "__name__", "fed_program")
@@ -135,6 +238,7 @@ def _interpret(
     args: List[Any],
     plan: Dict[int, List[int]],
     executors: Dict[int, tuple],
+    reduce_pairs: Optional[Dict[int, int]] = None,
 ) -> list:
     jaxpr = closed.jaxpr
     env: dict = {}
@@ -172,11 +276,26 @@ def _interpret(
                 outs = [outs]
         write(eqn.outvars, outs)
 
+    reduce_pairs = reduce_pairs or {}
     remaining = set(range(len(jaxpr.eqns)))
     while remaining:
         progressed = False
         for i in sorted(remaining):
             if i not in remaining:
+                continue
+            if i in reduce_pairs:
+                # A fed_sum(fed_map) pair lowered to one REDUCED
+                # window: the executor's scalar IS the fed_sum's
+                # output; the per-shard stack never materializes
+                # (_plan_reduce guaranteed it has no other consumer).
+                if not ready(i):
+                    continue
+                j = reduce_pairs[i]
+                _, executor, _j = executors[i]
+                outs = executor(*consts_xs(jaxpr.eqns[i]))
+                write(jaxpr.eqns[j].outvars, outs)
+                remaining -= {i, j}
+                progressed = True
                 continue
             group = plan.get(i)
             if group is not None:

@@ -229,14 +229,37 @@ class PoolPlacement(Placement):
     """
 
     def __init__(
-        self, client: Any, *, window: int = 8, logp_dtype: Any = None
+        self,
+        client: Any,
+        *,
+        window: int = 8,
+        logp_dtype: Any = None,
+        reduce: bool = False,
     ) -> None:
+        """``reduce=True`` opts eligible ``fed_sum(fed_map(...))``
+        pairs into the REDUCED window lowering (ISSUE 13): the whole
+        window rides one reduce-scatter call
+        (``client.evaluate_reduced`` — a pinned tcp/shm client, a
+        :class:`~...routing.PooledArraysClient`, or a pool of mid-tier
+        aggregator nodes for O(log N) tree aggregation), so reply
+        bytes scale with pool width instead of shard count.
+        Eligibility is gated at lowering time (lowering.py
+        ``_plan_reduce``): the summed ``fed_map`` must fit the
+        logp+grad contract and every inexact mapped operand must be
+        broadcast-derived or a trace-time-baked constant — gradients
+        w.r.t. per-shard PROGRAM INPUTS cannot survive a sum, so such
+        programs fall back to the per-shard window silently-correctly
+        rather than silently-wrongly."""
         self.client = client
         self.window = int(window)
         self.logp_dtype = logp_dtype
+        self.reduce = bool(reduce)
 
     def fusion_key(self) -> tuple:
-        return ("pool", id(self.client), self.window, self.logp_dtype)
+        return (
+            "pool", id(self.client), self.window, self.logp_dtype,
+            self.reduce,
+        )
 
     # -- host side ---------------------------------------------------------
 
@@ -422,6 +445,128 @@ class PoolPlacement(Placement):
             else:
                 logps = window_call(*flat)
             return [[lp] for lp in logps]
+
+        return run
+
+    def reduced_sum_executor(self, spec: MapSpec) -> Callable:
+        """One ``fed_sum(fed_map)`` pair as a REDUCED window (built
+        once; lowering.py pairs the equations).
+
+        Forward: the shard requests ride ONE
+        ``client.evaluate_reduced`` — the node (or aggregator tree)
+        sums the per-shard ``[logp, *grads]`` replies and returns
+        ``[logp_sum, flat_grad_sum]``; the primal out is the summed
+        scalar, so the ``fed_sum`` equation is absorbed.
+
+        Backward: the cotangent of the summed logp is one scalar
+        ``g``; the summed per-operand gradient is exactly
+        ``Σ_s grad_s``, so the stacked operand's cotangent is ``g ·
+        Σ_s grad_s`` placed at shard slot 0 with zeros elsewhere — the
+        downstream ``fed_broadcast`` transpose SUMS over shards, which
+        is why eligibility requires broadcast-derived (or baked-const)
+        inexact operands: only a sum-transposed consumer makes the
+        slot-0 placement exact."""
+        n_shards = spec.n_shards
+        x_avals = list(spec.x_avals)
+        shard_shapes = [tuple(av.shape)[1:] for av in x_avals]
+        shard_sizes = [
+            int(np.prod(s, dtype=np.int64)) if s else 1
+            for s in shard_shapes
+        ]
+        total = int(sum(shard_sizes))
+        logp_dt = self.logp_dtype or spec.out_avals[0].dtype
+        grad_dts = [_grad_dtype(av.dtype) for av in x_avals]
+        client, window = self.client, self.window
+
+        logp_spec = jax.ShapeDtypeStruct((), logp_dt)
+        grad_specs = tuple(
+            jax.ShapeDtypeStruct(shape, dt)
+            for shape, dt in zip(shard_shapes, grad_dts)
+        )
+
+        def host_reduced(*arrays: Any) -> Tuple[Any, Any]:
+            requests = [
+                tuple(np.asarray(a)[s] for a in arrays)
+                for s in range(n_shards)
+            ]
+            with _spans.span(
+                "fed.reduce_window", lane="pool", requests=n_shards
+            ):
+                _flightrec.record(
+                    "fed.reduce_window",
+                    lane="pool",
+                    requests=n_shards,
+                    total=total,
+                    window=window,
+                )
+                head, flat = client.evaluate_reduced(
+                    requests, window=window, total=total
+                )
+            return np.asarray(head), np.asarray(flat)
+
+        def host_logp(*arrays: Any) -> Any:
+            head, _flat = host_reduced(*arrays)
+            return np.asarray(head, logp_dt)
+
+        def host_logp_grads(*arrays: Any) -> tuple:
+            head, flat = host_reduced(*arrays)
+            out = [np.asarray(head, logp_dt)]
+            lo = 0
+            for shape, size, dt in zip(
+                shard_shapes, shard_sizes, grad_dts
+            ):
+                out.append(
+                    np.asarray(flat[lo : lo + size], dt).reshape(shape)
+                )
+                lo += size
+            return tuple(out)
+
+        @jax.custom_vjp
+        def reduced_call(*flat: Any) -> Any:
+            return jax.pure_callback(
+                host_logp, logp_spec, *flat, vmap_method="sequential"
+            )
+
+        def fwd(*flat: Any) -> Tuple[Any, tuple]:
+            outs = jax.pure_callback(
+                host_logp_grads,
+                (logp_spec,) + grad_specs,
+                *flat,
+                vmap_method="sequential",
+            )
+            return outs[0], tuple(outs[1:])
+
+        def bwd(residual_grads: Any, g: Any) -> tuple:
+            cts = []
+            for k, av in enumerate(x_avals):
+                if not jnp.issubdtype(av.dtype, jnp.inexact):
+                    cts.append(
+                        np.zeros(tuple(av.shape), jax.dtypes.float0)
+                    )
+                    continue
+                # Slot-0 placement: the consumer's transpose SUMS over
+                # the shard axis (eligibility gate), so one slot
+                # carrying g·Σgrad is exact.
+                stacked = jnp.zeros(tuple(av.shape), av.dtype)
+                cts.append(
+                    stacked.at[0].set(
+                        (g * residual_grads[k]).astype(av.dtype)
+                    )
+                )
+            return tuple(cts)
+
+        reduced_call.defvjp(fwd, bwd)
+
+        def run(consts: Any, xs: Any) -> List[Any]:
+            # Unmapped operands are dropped, exactly like the per-shard
+            # pool window: n_varying_consts == 0 was checked at pairing
+            # time, so every const here is a trace-time-baked constant
+            # the node's deployed copy of the function already carries.
+            del consts
+            flat = list(xs)
+            if not any(map(_is_tracer, flat)):
+                return [host_logp(*flat)]  # eager fast path
+            return [reduced_call(*flat)]
 
         return run
 

@@ -68,6 +68,7 @@ from ..service.npwire import (
     frame_uuid,
     is_batch_frame,
     peek_deadline,
+    peek_partition,
     peek_tenant,
 )
 from ..telemetry import flightrec as _flightrec
@@ -493,6 +494,26 @@ class GatewayServer:
                 GATEWAY_REQUESTS.labels(outcome="bad_frame").inc()
                 await replies.put(immediate(self._shed_reply(
                     payload, batch=True, error=f"decode error: {e}"
+                )))
+                return
+            try:
+                reduce_part = peek_partition(payload)
+            except WireError:
+                reduce_part = None
+            if reduce_part is not None:
+                # A REDUCE window (outer partition block, ISSUE 13):
+                # the gateway coalesces PER ITEM across tenants, which
+                # would silently decompose the caller's partial-sum
+                # contract — refuse loudly instead (reduce windows
+                # ride direct tcp/shm pools or aggregator trees).
+                GATEWAY_REQUESTS.labels(outcome="bad_frame").inc()
+                await replies.put(immediate(encode_batch(
+                    [], uuid=outer_uuid,
+                    error=(
+                        "partition reduce windows are not served "
+                        "through the gateway (dial a tcp/shm pool or "
+                        "an aggregator tree directly)"
+                    ),
                 )))
                 return
             if not items:

@@ -24,6 +24,11 @@ every call:
   recovery path (retries, hedges, mid-window failover, fanout member
   re-runs) spends from, so a sick pool degrades to one attempt per
   call instead of multiplying its own load (:mod:`.budget`).
+- :mod:`.partition` — the gradient-sharding lane (ISSUE 13):
+  partition-index shard math, the head/tail slice rule, loud
+  reassembly, window reduction, and the mid-tier aggregator compute
+  behind ``PooledArraysClient.evaluate_reduced`` and the ``fed_sum``
+  tree lowering.
 
 Everything is observable: ``pftpu_pool_*`` metric families (catalog:
 docs/observability.md), ``pool.*`` flight-recorder events, and
@@ -33,6 +38,13 @@ call's full replica itinerary in one trace.
 
 from .breaker import CircuitBreaker
 from .budget import RetryBudget
+from .partition import (
+    GradPartition,
+    PartitionError,
+    Reassembler,
+    make_aggregator_compute,
+    plan_partitions,
+)
 from .policies import (
     EwmaLatencyPolicy,
     PowerOfTwoChoicesPolicy,
@@ -45,11 +57,16 @@ from .pooled_client import PooledArraysClient
 __all__ = [
     "CircuitBreaker",
     "EwmaLatencyPolicy",
+    "GradPartition",
     "NodePool",
+    "PartitionError",
     "PooledArraysClient",
     "PowerOfTwoChoicesPolicy",
+    "Reassembler",
     "Replica",
     "RetryBudget",
     "RoundRobinPolicy",
     "get_policy",
+    "make_aggregator_compute",
+    "plan_partitions",
 ]

@@ -50,6 +50,7 @@ import struct
 import threading
 import time
 import uuid as uuid_mod
+from functools import lru_cache
 from typing import TYPE_CHECKING, Any, Dict, List, Optional, Sequence, Tuple
 
 if TYPE_CHECKING:
@@ -113,6 +114,19 @@ _POOL_EWMA = _metrics.gauge(
 )
 
 _EWMA_ALPHA = 0.3
+
+
+@lru_cache(maxsize=1)
+def _remote_compute_error() -> type:
+    """Resolve RemoteComputeError once — ``is_transient`` runs per
+    member failure, and a per-call import there is the
+    PR-10-review function-level-import class (ISSUE-13 satellite).
+    Lazy because routing/ must not import service/ at module level
+    (service/tcp.py imports routing.partition — a module-level import
+    here would cycle)."""
+    from ..service.tcp import RemoteComputeError
+
+    return RemoteComputeError
 
 
 class Replica:
@@ -669,19 +683,13 @@ class NodePool:
         pool (transport trouble) vs deterministic (re-raising).  The
         same classification the transports use: RemoteComputeError and
         other RuntimeErrors are the request's own fault."""
-        from ..service.tcp import RemoteComputeError
-
-        if isinstance(exc, RemoteComputeError):
+        if isinstance(exc, _remote_compute_error()):
             return False
-        try:
-            import grpc
+        from .pooled_client import _grpc_classifier
 
-            if isinstance(exc, grpc.aio.AioRpcError):
-                from ..service.client import _is_retryable
-
-                return _is_retryable(exc)
-        except ImportError:
-            pass
+        aio_error, is_retryable = _grpc_classifier()
+        if aio_error is not None and isinstance(exc, aio_error):
+            return is_retryable(exc)
         return isinstance(exc, (ConnectionError, OSError, TimeoutError))
 
     def allow_retry(self, what: str = "retry") -> bool:

@@ -50,12 +50,14 @@ import contextvars
 import math
 import threading
 import time
+from functools import lru_cache
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ..telemetry import flightrec as _flightrec
 from ..telemetry import spans as _spans
+from . import partition as _gradpart
 from .pool import (
     NodePool,
     Replica,
@@ -94,20 +96,39 @@ class _LatencyRing:
         return ordered[max(rank, 0)]
 
 
+@lru_cache(maxsize=1)
+def _grpc_classifier() -> tuple:
+    """Resolve (AioRpcError, _is_retryable) ONCE — the classifier runs
+    per call result, and a per-call ``import grpc`` in that hot path
+    is the PR-10-review function-level-import class (ISSUE-13
+    satellite).  Lazy (not module-level) so importing routing/ never
+    drags grpc in on pools that only run tcp/shm lanes."""
+    try:
+        import grpc
+
+        from ..service.client import _is_retryable
+
+        return grpc.aio.AioRpcError, _is_retryable
+    except ImportError:
+        return None, None
+
+
+@lru_cache(maxsize=1)
+def _deadline_exceeded() -> type:
+    """Resolve DeadlineExceeded once (hot-path import hoist)."""
+    from ..service.deadline import DeadlineExceeded
+
+    return DeadlineExceeded
+
+
 def _is_transport_error(exc: BaseException) -> bool:
     """Transport trouble (failover-worthy) vs deterministic failure.
     Matches the pinned clients' classification: ConnectionError/OSError
     always transport; AioRpcError by status code; RemoteComputeError
     and other RuntimeErrors are the request's own fault."""
-    try:
-        import grpc
-
-        if isinstance(exc, grpc.aio.AioRpcError):
-            from ..service.client import _is_retryable
-
-            return _is_retryable(exc)
-    except ImportError:
-        pass
+    aio_error, is_retryable = _grpc_classifier()
+    if aio_error is not None and isinstance(exc, aio_error):
+        return is_retryable(exc)
     return isinstance(exc, (ConnectionError, OSError))
 
 
@@ -116,9 +137,7 @@ def _is_deadline(exc: BaseException) -> bool:
     which says nothing about the replica's health either way (the
     fail-fast guard can fire before a single byte is sent), so routing
     must book NEITHER a success nor a failure for it."""
-    from ..service.deadline import DeadlineExceeded
-
-    return isinstance(exc, DeadlineExceeded)
+    return isinstance(exc, _deadline_exceeded())
 
 
 class PooledArraysClient:
@@ -671,4 +690,260 @@ class PooledArraysClient:
 
         return get_event_loop().run_until_complete(
             self.evaluate_many_async(requests, window=window, batch=batch)
+        )
+
+    # -- reduce-scatter windows (ISSUE 13) --------------------------------
+
+    async def _reduce_replica(
+        self,
+        replica: Replica,
+        reqs: Sequence,
+        window: int,
+        slices: int,
+        total: Optional[int],
+    ) -> Tuple[Optional[np.ndarray], Optional[np.ndarray], List[int], float]:
+        """One replica's reduce pass -> ``(head, flat, unserved_local
+        _indices, wall_s)``.  tcp/shm lanes ride the wire reduce window
+        (all-or-nothing per replica: a transport failure re-queues the
+        whole shard); grpc replicas — which have no reduce wire — fall
+        back to ``evaluate_many_partial_async`` plus a DRIVER-side
+        reduction, keeping the answered items' partial sum and
+        re-queuing only the holes (bytes are not saved on that lane,
+        but a mixed pool stays correct).  Deterministic server errors
+        raise out of here."""
+        client = self.pool.client_for(replica)
+        t0 = time.perf_counter()
+        replica.inflight += len(reqs)
+        try:
+            with _spans.span(
+                "pool.reduce_window",
+                replica=replica.address,
+                n=len(reqs),
+            ):
+                if replica.transport == "grpc":
+                    partial, exc = (
+                        await client.evaluate_many_partial_async(
+                            reqs, window=window, batch="auto"
+                        )
+                    )
+                    served = [
+                        r for r in partial if r is not None
+                    ]
+                    holes = [
+                        i for i, r in enumerate(partial) if r is None
+                    ]
+                    if exc is not None and not holes:
+                        holes = list(range(len(reqs)))
+                        served = []
+                    head = flat = None
+                    if served:
+                        summed = _gradpart.reduce_replies(served)
+                        head = np.asarray(summed[0])
+                        flat = _gradpart.concat_tail(summed)
+                        if total is not None and flat.size != int(total):
+                            raise _gradpart.PartitionError(
+                                f"grpc reduce tail size {flat.size} != "
+                                f"declared total {total}"
+                            )
+                    return head, flat, holes, time.perf_counter() - t0
+                loop = asyncio.get_running_loop()
+                ctx = contextvars.copy_context()
+                try:
+                    head, flat = await loop.run_in_executor(
+                        self.pool.executor_for(replica),
+                        lambda: ctx.run(
+                            client.evaluate_reduced,
+                            reqs,
+                            window=window,
+                            slices=slices,
+                            total=total,
+                        ),
+                    )
+                except (ConnectionError, OSError):
+                    # All-or-nothing wire window: the whole shard
+                    # re-queues (holes = everything).
+                    return (
+                        None,
+                        None,
+                        list(range(len(reqs))),
+                        time.perf_counter() - t0,
+                    )
+                return head, flat, [], time.perf_counter() - t0
+        finally:
+            replica.inflight -= len(reqs)
+
+    async def evaluate_reduced_async(
+        self,
+        requests: Sequence[Sequence[np.ndarray]],
+        *,
+        window: int = 8,
+        slices: int = 1,
+        total: Optional[int] = None,
+    ) -> List[np.ndarray]:
+        """Reduce-scatter evaluation through the pool:
+        ``[head_sum, flat_tail_sum]`` over ALL requests.
+
+        Requests spread over healthy replicas exactly like
+        :meth:`evaluate_many_async` (EWMA-weighted shards), but each
+        replica answers its shard as ONE partition-indexed partial sum
+        (wire reduce windows on tcp/shm; a driver-side reduction on
+        grpc replicas, so MIXED pools stay correct), and the driver
+        sums the partials — reply bytes scale with POOL WIDTH, not
+        request count.  A replica failing mid-round re-queues only its
+        un-reduced shard onto the survivors, charging the retry budget
+        once per failed replica WITH a tail (the ``evaluate_many``
+        refund posture); deterministic errors raise immediately —
+        a partial sum is never silently returned."""
+        requests = list(requests)
+        if not requests:
+            raise _gradpart.PartitionError(
+                "cannot reduce an empty request list"
+            )
+        head: Optional[np.ndarray] = None
+        flat: Optional[np.ndarray] = None
+        with _spans.span(
+            "pool.evaluate_reduced",
+            transport=self.pool.transport,
+            n=len(requests),
+            slices=slices,
+        ) as root:
+            pending = list(range(len(requests)))
+            exclude: set = set()
+            last_exc: Optional[BaseException] = None
+            while pending:
+                k = max(1, math.ceil(len(pending) / max(1, window)))
+                replicas = self.pool.pick(k, exclude=exclude)
+                if not replicas:
+                    root.set_attr("error", "transport")
+                    raise (
+                        last_exc
+                        if last_exc is not None
+                        else ConnectionError(
+                            f"no available replicas in pool "
+                            f"({len(self.pool)} registered) with "
+                            f"{len(pending)} requests un-reduced"
+                        )
+                    )
+                shards = self._partition(pending, replicas, window)
+                sharded = {id(r) for r, _ in shards}
+                for replica in replicas:
+                    if id(replica) not in sharded:
+                        replica.breaker.release()
+                outcomes = await asyncio.gather(
+                    *(
+                        self._reduce_replica(
+                            replica,
+                            [requests[i] for i in shard],
+                            window,
+                            slices,
+                            total,
+                        )
+                        for replica, shard in shards
+                    ),
+                    return_exceptions=True,
+                )
+                new_pending: List[int] = []
+                budget_spent = False
+                granted = 0
+                server_exc: Optional[BaseException] = None
+                for (replica, shard), out in zip(shards, outcomes):
+                    if isinstance(out, BaseException):
+                        # Deterministic server/geometry error: the
+                        # replica DID serve (routing books a success);
+                        # a spent deadline books neither.
+                        if _is_deadline(out):
+                            replica.breaker.release()
+                        else:
+                            self.pool.record_result(replica, True)
+                        server_exc = server_exc or out
+                        continue
+                    r_head, r_flat, holes, wall = out
+                    if r_head is not None:
+                        assert r_flat is not None
+                        if head is None:
+                            head, flat = r_head, r_flat
+                        elif (
+                            r_head.shape != head.shape
+                            or r_flat.size != flat.size
+                        ):
+                            server_exc = server_exc or (
+                                _gradpart.PartitionError(
+                                    "replicas disagree on reply "
+                                    "geometry"
+                                )
+                            )
+                            self.pool.record_result(replica, True)
+                            continue
+                        else:
+                            head = head + r_head
+                            flat = flat + r_flat
+                    if not holes:
+                        self.pool.record_result(
+                            replica,
+                            True,
+                            latency_s=wall,
+                            n_requests=max(1, len(shard)),
+                        )
+                        continue
+                    # Transport failure with a tail to re-queue: one
+                    # budget spend per failed replica (the
+                    # evaluate_many posture — nothing charged for a
+                    # replica that served its whole shard).
+                    last_exc = last_exc or ConnectionError(
+                        f"replica {replica.address} failed "
+                        f"{len(holes)} reduce requests"
+                    )
+                    self.pool.record_result(replica, False)
+                    exclude.add(replica.address)
+                    _POOL_FAILOVERS.labels(
+                        transport=self.pool.transport
+                    ).inc()
+                    _flightrec.record(
+                        "pool.failover",
+                        replica=replica.address,
+                        requeued=len(holes),
+                        error="reduce window transport failure",
+                    )
+                    new_pending.extend(shard[i] for i in holes)
+                    if self.pool.allow_retry("failover"):
+                        granted += 1
+                    else:
+                        budget_spent = True
+                if server_exc is not None:
+                    if granted:
+                        self.pool.retry_budget.refund(granted)
+                    root.set_attr("error", "server")
+                    raise server_exc
+                if budget_spent and new_pending:
+                    if granted:
+                        self.pool.retry_budget.refund(granted)
+                    root.set_attr("error", "transport")
+                    raise (
+                        last_exc
+                        if last_exc is not None
+                        else ConnectionError(
+                            "retry budget exhausted with "
+                            f"{len(new_pending)} requests un-reduced"
+                        )
+                    )
+                new_pending.sort()
+                pending = new_pending
+            assert head is not None and flat is not None
+            return [head, flat]
+
+    def evaluate_reduced(
+        self,
+        requests: Sequence[Sequence[np.ndarray]],
+        *,
+        window: int = 8,
+        slices: int = 1,
+        total: Optional[int] = None,
+    ) -> List[np.ndarray]:
+        """Sync wrapper over :meth:`evaluate_reduced_async`."""
+        from ..utils import get_event_loop
+
+        return get_event_loop().run_until_complete(
+            self.evaluate_reduced_async(
+                requests, window=window, slices=slices, total=total
+            )
         )

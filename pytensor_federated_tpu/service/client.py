@@ -49,7 +49,10 @@ from ..telemetry import watchdog as _watchdog
 from ..utils import argmin_none_or_func, get_event_loop
 from . import _rpc_metrics
 from . import deadline as _deadline
+from . import npproto_codec
+from .npproto_codec import decode_get_load_result
 from .npwire import (
+    WireError,
     decode_arrays_all,
     decode_batch,
     encode_arrays,
@@ -196,9 +199,6 @@ async def get_load_async(
             # proto3 leniency would otherwise decode to the all-zero —
             # i.e. maximally attractive — load (unknown-fields-only
             # buffers).
-            from .npwire import WireError
-            from .npproto_codec import decode_get_load_result
-
             try:
                 return decode_get_load_result(reply)
             # A garbled load reply is a failed PROBE, not a failed call:
@@ -639,8 +639,6 @@ class ArraysToArraysServiceClient:
         # keeps the frame byte-identical to the deadline-free wire.
         deadline_s = _deadline.wire_budget()
         if self.codec == "npproto":
-            from . import npproto_codec
-
             uuid = str(uuid_mod.uuid4())
             request = npproto_codec.encode_arrays_msg(
                 arrays, uuid=uuid, trace_id=trace_id,
@@ -927,8 +925,6 @@ class ArraysToArraysServiceClient:
         active codec -> (outputs, uuid, error); piggybacked node spans
         are harvested like any reply's."""
         if self.codec == "npproto":
-            from . import npproto_codec
-
             outputs, ruuid, error, _tid, spans = (
                 npproto_codec.decode_arrays_msg_full(item)
             )
@@ -943,8 +939,6 @@ class ArraysToArraysServiceClient:
         requests -> (frame_bytes, outer_uuid)."""
         deadline_s = _deadline.wire_budget()
         if self.codec == "npproto":
-            from . import npproto_codec
-
             outer_uuid = str(uuid_mod.uuid4())
             frame = npproto_codec.encode_batch_msg(
                 [req for req, _u, _d in part],
@@ -966,8 +960,6 @@ class ArraysToArraysServiceClient:
         """Outer batch reply -> (items, outer_uuid, outer_error);
         outer spans (the node's whole-window tree) are harvested."""
         if self.codec == "npproto":
-            from . import npproto_codec
-
             items, ruuid, _tid, spans = npproto_codec.decode_batch_msg(
                 reply
             )

@@ -32,7 +32,12 @@ the request's remaining deadline budget in relative seconds
 (:mod:`.deadline`; the npproto twin of npwire flag bit 16, enforced at
 server admission); and ``tenant_id(19: string)`` — the gateway tier's
 per-tenant identity (:mod:`..gateway.fairness`; the npproto twin of
-npwire flag bit 32).  Fields 14-19 are unknown to the
+npwire flag bit 32); and ``partition(20: message)`` — the
+gradient-partition index block (``routing/partition.py``; the npproto
+twin of npwire flag bit 64): a nested message of varint sub-fields
+``index(1) count(2) offset(3) length(4) total(5)``
+(``wire_registry.NPPROTO_PARTITION_FIELDS``).  Fields 14-20 are
+unknown to the
 reference schema, so an unmodified reference peer skips them by wire
 type (the standard proto3 forward-compatibility rule, property-tested
 against the official runtime); they cost nothing when absent — and a
@@ -90,6 +95,7 @@ __all__ = [
     "has_batch_items",
     "peek_deadline_msg",
     "peek_tenant_msg",
+    "peek_partition_msg",
     "append_spans_msg",
     "encode_get_load_result",
     "decode_get_load_result",
@@ -218,6 +224,53 @@ def _decode_repeated_int64(
 
 
 # ---------------------------------------------------------------------------
+# partition sub-message (extension field 20)
+# ---------------------------------------------------------------------------
+
+
+def _encode_partition_msg(partition: Sequence[int]) -> bytes:
+    """The nested partition message: varint sub-fields in
+    ``wire_registry.NPPROTO_PARTITION_FIELDS`` order (index=1,
+    count=2, offset=3, length=4, total=5); proto3-canonical — zero
+    values are omitted."""
+    try:
+        index, count, offset, length, total = (
+            int(v) for v in partition
+        )
+    except (TypeError, ValueError) as e:
+        raise WireError(f"partition must be 5 ints: {e}") from None
+    if not 0 <= index < count:
+        raise WireError(
+            f"partition index {index} outside 0..{count - 1}"
+        )
+    if min(offset, length, total) < 0 or offset + length > total:
+        raise WireError(
+            f"partition slice [{offset}, {offset + length}) cannot "
+            f"cover total {total}"
+        )
+    out = bytearray()
+    for num, val in enumerate((index, count, offset, length, total), 1):
+        if val:
+            out += _tag(num, _WT_VARINT) + _encode_varint(val)
+    return bytes(out)
+
+
+def _decode_partition_msg(raw: bytes) -> Tuple[int, int, int, int, int]:
+    """Inverse of :func:`_encode_partition_msg`; unknown sub-fields
+    are skipped (proto3 posture), absent ones default to zero."""
+    vals = [0, 0, 0, 0, 0]
+    pos = 0
+    while pos < len(raw):
+        field, wt, pos = _decode_tag(raw, pos)
+        if 1 <= field <= 5 and wt == _WT_VARINT:
+            v, pos = _decode_varint(raw, pos)
+            vals[field - 1] = v
+        else:
+            pos = _skip(raw, pos, wt)
+    return (vals[0], vals[1], vals[2], vals[3], vals[4])
+
+
+# ---------------------------------------------------------------------------
 # npproto.ndarray
 # ---------------------------------------------------------------------------
 
@@ -321,6 +374,7 @@ def encode_arrays_msg(
     error: Optional[str] = None,
     deadline_s: Optional[float] = None,
     tenant: Optional[str] = None,
+    partition: Optional[Sequence[int]] = None,
 ) -> bytes:
     """InputArrays/OutputArrays: repeated ndarray items + string uuid
     (reference: service.proto:6-19; uuid is the correlation id the
@@ -331,8 +385,10 @@ def encode_arrays_msg(
     poisoned request; ``deadline_s`` emits the remaining-deadline
     extension field 18 (fixed64 double, relative seconds); ``tenant``
     emits the gateway tier's tenant-id extension field 19 (utf8
-    string, non-empty).  All ``None`` keeps the message byte-identical
-    to the official encoder's output."""
+    string, non-empty); ``partition`` emits the gradient-partition
+    extension field 20 (nested message — routing/partition.py owns the
+    semantics).  All ``None`` keeps the message byte-identical to the
+    official encoder's output."""
     out = bytearray()
     for a in arrays:
         out += _len_field(1, encode_ndarray(a))
@@ -354,6 +410,8 @@ def encode_arrays_msg(
                 "tenant id must be non-empty (omit it instead)"
             )
         out += _len_field(19, tenant.encode("utf-8"))
+    if partition is not None:
+        out += _len_field(20, _encode_partition_msg(partition))
     if _fi.active_plan is not None:  # chaos seam (faultinject.runtime)
         return _fi.filter_bytes("npproto.encode", bytes(out))
     return bytes(out)
@@ -366,6 +424,7 @@ def encode_batch_msg(
     trace_id: Optional[bytes] = None,
     deadline_s: Optional[float] = None,
     tenant: Optional[str] = None,
+    partition: Optional[Sequence[int]] = None,
 ) -> bytes:
     """Frame K already-encoded InputArrays/OutputArrays messages as ONE
     batch message (extension field 17) — the npproto twin of
@@ -392,6 +451,8 @@ def encode_batch_msg(
                 "tenant id must be non-empty (omit it instead)"
             )
         out += _len_field(19, tenant.encode("utf-8"))
+    if partition is not None:
+        out += _len_field(20, _encode_partition_msg(partition))
     for item in items:
         out += _len_field(17, item)
     if _fi.active_plan is not None:  # chaos seam (faultinject.runtime)
@@ -452,6 +513,22 @@ def peek_tenant_msg(buf: bytes) -> Optional[str]:
     return None
 
 
+def peek_partition_msg(buf: bytes) -> Optional[Tuple[int, int, int, int, int]]:
+    """The message's partition block (field 20) as a 5-int tuple, or
+    ``None`` when absent — a skip-walk like :func:`peek_deadline_msg`,
+    so the partitioned server lanes can dispatch before any ndarray
+    decode.  Raises :class:`~.npwire.WireError` on structurally broken
+    messages."""
+    pos = 0
+    while pos < len(buf):
+        field, wt, pos = _decode_tag(buf, pos)
+        if field == 20 and wt == _WT_LEN:
+            raw, pos = _decode_len(buf, pos)
+            return _decode_partition_msg(raw)
+        pos = _skip(buf, pos, wt)
+    return None
+
+
 def decode_batch_msg(
     buf: bytes,
 ) -> Tuple[List[bytes], str, Optional[bytes], Optional[list]]:
@@ -496,6 +573,10 @@ def decode_batch_msg(
         elif field == 19 and wt == _WT_LEN:
             # tenant_id: consumed and dropped (peek_tenant_msg is the
             # gateway-side reader; same posture as deadline_s).
+            _raw, pos = _decode_len(buf, pos)
+        elif field == 20 and wt == _WT_LEN:
+            # partition: consumed and dropped (peek_partition_msg is
+            # the partition-lane reader; same posture as deadline_s).
             _raw, pos = _decode_len(buf, pos)
         else:
             pos = _skip(buf, pos, wt)
@@ -596,6 +677,10 @@ def decode_arrays_msg_full(
         elif field == 19 and wt == _WT_LEN:
             # tenant_id: consumed and dropped (peek_tenant_msg is the
             # gateway-side reader; see decode_batch_msg).
+            _raw, pos = _decode_len(buf, pos)
+        elif field == 20 and wt == _WT_LEN:
+            # partition: consumed and dropped (peek_partition_msg is
+            # the partition-lane reader; see decode_batch_msg).
             _raw, pos = _decode_len(buf, pos)
         else:
             pos = _skip(buf, pos, wt)

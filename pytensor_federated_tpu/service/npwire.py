@@ -135,6 +135,7 @@ _FLAG_SPANS = 4
 _FLAG_BATCH = 8
 _FLAG_DEADLINE = 16
 _FLAG_TENANT = 32
+_FLAG_PARTITION = 64
 # Every known flag bit, mirrored from service/wire_registry.py (the
 # declared source; the graftlint wire-registry rule cross-checks the
 # two).  Decoders REJECT any bit outside this mask: an unknown flag
@@ -143,10 +144,26 @@ _FLAG_TENANT = 32
 # hazard the module docstring's loud-failure contract forbids.
 _KNOWN_FLAGS = (
     _FLAG_ERROR | _FLAG_TRACE | _FLAG_SPANS | _FLAG_BATCH
-    | _FLAG_DEADLINE | _FLAG_TENANT
+    | _FLAG_DEADLINE | _FLAG_TENANT | _FLAG_PARTITION
 )
 # flags byte offset in the header ("<4sBB...": magic, version, flags)
 _FLAGS_OFF = 5
+# Header and partition-block structs, preserialized at module level —
+# a struct.pack/calcsize with a literal format re-parses the format
+# string per call in the hot send path (ISSUE-13 satellite: the
+# PR-10-review bug class, swept from the client lanes too).
+_HEADER_STRUCT = struct.Struct("<4sBB16sI")
+_HEADER_SIZE = _HEADER_STRUCT.size
+_U16 = struct.Struct("<H")
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+_F64 = struct.Struct("<d")
+#: The gradient-partition index block (flag bit 64):
+#: index(u32) count(u32) offset(u64) length(u64) total(u64) — layout
+#: declared in service/wire_registry.py PARTITION_STRUCT; the
+#: semantics (head/tail slice rule, reduction, reassembly) live in
+#: routing/partition.py.
+_PARTITION_STRUCT = struct.Struct("<IIQQQ")
 
 
 class WireError(ValueError):
@@ -210,6 +227,42 @@ def _encode_tenant(tenant: str) -> bytes:
             f"tenant id too long ({len(raw)} utf8 bytes > 65535)"
         )
     return struct.pack("<H", len(raw)) + raw
+
+
+def _encode_partition(partition: Sequence[int]) -> bytes:
+    """The partition block (flag bit 64): a 5-int sequence in
+    ``wire_registry.PARTITION_FIELD_ORDER`` (index, count, offset,
+    length, total — ``routing.partition.GradPartition`` is one).  Loud
+    on shapes that cannot describe a shard; the SEMANTIC validation
+    (plan consistency, reassembly) is routing/partition.py's."""
+    try:
+        index, count, offset, length, total = (
+            int(v) for v in partition
+        )
+    except (TypeError, ValueError) as e:
+        raise WireError(f"partition must be 5 ints: {e}") from None
+    if not 0 <= index < count:
+        raise WireError(
+            f"partition index {index} outside 0..{count - 1}"
+        )
+    if min(offset, length, total) < 0 or offset + length > total:
+        raise WireError(
+            f"partition slice [{offset}, {offset + length}) cannot "
+            f"cover total {total}"
+        )
+    try:
+        return _PARTITION_STRUCT.pack(index, count, offset, length, total)
+    except struct.error as e:
+        raise WireError(f"partition out of wire range: {e}") from None
+
+
+def _decode_partition(buf: bytes, off: int) -> Tuple[tuple, int]:
+    """Parse a partition block at ``off`` -> ((5 ints), new_offset)."""
+    try:
+        fields = _PARTITION_STRUCT.unpack_from(buf, off)
+    except struct.error as e:
+        raise WireError(f"truncated partition block: {e}") from None
+    return fields, off + _PARTITION_STRUCT.size
 
 
 def _tupleize(descr: object) -> object:
@@ -304,6 +357,7 @@ def encode_arrays_sg(
     trace_id: Optional[bytes] = None,
     deadline_s: Optional[float] = None,
     tenant: Optional[str] = None,
+    partition: Optional[Sequence[int]] = None,
 ) -> List[Buffer]:
     """Scatter/gather encode: the same frame as :func:`encode_arrays`
     as a BUFFER VECTOR — header/metadata ``bytes`` interleaved with
@@ -337,26 +391,32 @@ def encode_arrays_sg(
     if tenant is not None:
         tenant_block = _encode_tenant(tenant)
         flags |= _FLAG_TENANT
+    partition_block = None
+    if partition is not None:
+        partition_block = _encode_partition(partition)
+        flags |= _FLAG_PARTITION
     parts: List[Buffer] = [
-        struct.pack("<4sBB16sI", MAGIC, 1, flags, uuid, len(arrays))
+        _HEADER_STRUCT.pack(MAGIC, 1, flags, uuid, len(arrays))
     ]
     if error is not None:
         err = error.encode("utf-8")
-        parts.append(struct.pack("<I", len(err)))
+        parts.append(_U32.pack(len(err)))
         parts.append(err)
     if trace_id is not None:
         parts.append(trace_id)
     if deadline_s is not None:
-        parts.append(struct.pack("<d", float(deadline_s)))
+        parts.append(_F64.pack(float(deadline_s)))
     if tenant_block is not None:
         parts.append(tenant_block)
+    if partition_block is not None:
+        parts.append(partition_block)
     for a in arrays:
         dt = _encode_dtype(a.dtype)
-        parts.append(struct.pack("<H", len(dt)))
+        parts.append(_U16.pack(len(dt)))
         parts.append(dt)
         parts.append(struct.pack("<B", a.ndim))
         parts.append(struct.pack(f"<{a.ndim}Q", *a.shape))
-        parts.append(struct.pack("<Q", a.nbytes))
+        parts.append(_U64.pack(a.nbytes))
         if a.nbytes:
             parts.append(payload_view(a))
     if _fi.active_plan is not None:  # chaos seam (faultinject.runtime)
@@ -379,18 +439,21 @@ def encode_arrays(
     trace_id: Optional[bytes] = None,
     deadline_s: Optional[float] = None,
     tenant: Optional[str] = None,
+    partition: Optional[Sequence[int]] = None,
 ) -> bytes:
     """Encode arrays (+uuid, +optional error/trace_id/deadline_s/
-    tenant) into one framed message.  ``trace_id`` (16 bytes) is the
-    telemetry correlation id; ``deadline_s`` the remaining deadline
-    budget (flag bit 16); ``tenant`` the gateway tier's per-tenant
-    identity (flag bit 32); every optional ``None`` emits the exact
-    pre-feature frame.  The contiguous form of
+    tenant/partition) into one framed message.  ``trace_id`` (16
+    bytes) is the telemetry correlation id; ``deadline_s`` the
+    remaining deadline budget (flag bit 16); ``tenant`` the gateway
+    tier's per-tenant identity (flag bit 32); ``partition`` the
+    gradient-partition index block (flag bit 64, a 5-int sequence —
+    routing/partition.py owns the semantics); every optional ``None``
+    emits the exact pre-feature frame.  The contiguous form of
     :func:`encode_arrays_sg` — one flattening join, counted under the
     ``encode_join`` copy stage."""
     parts = encode_arrays_sg(
         arrays, uuid=uuid, error=error, trace_id=trace_id,
-        deadline_s=deadline_s, tenant=tenant,
+        deadline_s=deadline_s, tenant=tenant, partition=partition,
     )
     if len(parts) == 1 and isinstance(parts[0], bytes):
         return parts[0]  # chaos path: already joined and filtered
@@ -408,6 +471,7 @@ def encode_batch(
     trace_id: Optional[bytes] = None,
     deadline_s: Optional[float] = None,
     tenant: Optional[str] = None,
+    partition: Optional[Sequence[int]] = None,
 ) -> bytes:
     """Frame K already-encoded npwire messages as ONE batch message
     (flag bit 8).  ``items`` are complete frames — each keeps its own
@@ -416,8 +480,11 @@ def encode_batch(
     the outer ``trace_id`` is the authoritative span-context id for
     the batch (an item's own trace block, if present, is consumed and
     dropped by the server); a zero-item batch is legal — it is the
-    TCP capability probe.  The result accepts :func:`append_spans`
-    like any reply frame."""
+    TCP capability probe.  An OUTER ``partition`` block (flag bit 64)
+    turns the window into a REDUCE request: the server sums its items'
+    replies and answers ``count`` partition-indexed slices
+    (routing/partition.py owns the rule; tcp.py/shm.py serve it).
+    The result accepts :func:`append_spans` like any reply frame."""
     if uuid is None:
         uuid = uuid_mod.uuid4().bytes
     if len(uuid) != 16:
@@ -437,23 +504,29 @@ def encode_batch(
     if tenant is not None:
         tenant_block = _encode_tenant(tenant)
         flags |= _FLAG_TENANT
+    partition_block = None
+    if partition is not None:
+        partition_block = _encode_partition(partition)
+        flags |= _FLAG_PARTITION
     parts: List[bytes] = [
-        struct.pack("<4sBB16sI", MAGIC, 1, flags, uuid, len(items))
+        _HEADER_STRUCT.pack(MAGIC, 1, flags, uuid, len(items))
     ]
     if error is not None:
         err = error.encode("utf-8")
-        parts.append(struct.pack("<I", len(err)))
+        parts.append(_U32.pack(len(err)))
         parts.append(err)
     if trace_id is not None:
         parts.append(trace_id)
     if deadline_s is not None:
-        parts.append(struct.pack("<d", float(deadline_s)))
+        parts.append(_F64.pack(float(deadline_s)))
     if tenant_block is not None:
         parts.append(tenant_block)
+    if partition_block is not None:
+        parts.append(partition_block)
     for item in items:
         if item[:4] != MAGIC:
             raise WireError("batch items must be complete npwire frames")
-        parts.append(struct.pack("<I", len(item)))
+        parts.append(_U32.pack(len(item)))
         parts.append(item)
     out = b"".join(parts)
     if _fi.active_plan is not None:  # chaos seam (faultinject.runtime)
@@ -500,7 +573,7 @@ def peek_deadline(buf: bytes) -> Optional[float]:
     _check_flags(flags)
     if not flags & _FLAG_DEADLINE:
         return None
-    off = struct.calcsize("<4sBB16sI")
+    off = _HEADER_SIZE
     if flags & _FLAG_ERROR:
         try:
             (elen,) = struct.unpack_from("<I", buf, off)
@@ -532,7 +605,7 @@ def peek_tenant(buf: bytes) -> Optional[str]:
     _check_flags(flags)
     if not flags & _FLAG_TENANT:
         return None
-    off = struct.calcsize("<4sBB16sI")
+    off = _HEADER_SIZE
     if flags & _FLAG_ERROR:
         try:
             (elen,) = struct.unpack_from("<I", buf, off)
@@ -551,6 +624,40 @@ def peek_tenant(buf: bytes) -> Optional[str]:
         return buf[off : off + tlen].decode("utf-8")
     except (struct.error, UnicodeDecodeError) as e:
         raise WireError(f"corrupt tenant block: {e}") from None
+
+
+def peek_partition(buf: bytes) -> Optional[tuple]:
+    """The frame's partition block (flag bit 64) as a 5-int tuple, or
+    ``None`` when the flag is clear — WITHOUT decoding arrays, and for
+    BOTH plain and batch frames (the block sits in front of the body
+    either way).  The server-side dispatch reader: a reduce window
+    must be recognized before items are decoded.  Raises
+    :class:`WireError` on a frame whose leading blocks are truncated
+    (the full decoder would reject it identically)."""
+    try:
+        magic, version, flags = struct.unpack_from("<4sBB", buf, 0)
+    except struct.error as e:
+        raise WireError(f"truncated header: {e}") from None
+    if magic != MAGIC:
+        raise WireError(f"bad magic {magic!r}")
+    _check_flags(flags)
+    if not flags & _FLAG_PARTITION:
+        return None
+    off = _HEADER_SIZE
+    if flags & _FLAG_ERROR:
+        try:
+            (elen,) = struct.unpack_from("<I", buf, off)
+        except struct.error as e:
+            raise WireError(f"truncated error block: {e}") from None
+        off += 4 + elen
+    if flags & _FLAG_TRACE:
+        off += 16
+    if flags & _FLAG_DEADLINE:
+        off += 8
+    if flags & _FLAG_TENANT:
+        off = _skip_tenant_block(buf, off)
+    part, _off = _decode_partition(buf, off)
+    return part
 
 
 def _skip_tenant_block(buf: bytes, off: int) -> int:
@@ -572,11 +679,31 @@ def decode_batch(
     """Decode a batch message -> (items, uuid, error, trace_id, spans).
     ``items`` are the K framed sub-messages, still encoded — decode
     each with :func:`decode_arrays_all` (they may individually carry
-    error blocks: per-item failure isolation)."""
+    error blocks: per-item failure isolation).  An outer partition
+    block (flag bit 64) is consumed and dropped — the reduce-window
+    server path reads it with :func:`decode_batch_part`."""
+    items, uuid, error, trace_id, spans, _part = decode_batch_part(buf)
+    return items, uuid, error, trace_id, spans
+
+
+def decode_batch_part(
+    buf: bytes,
+) -> Tuple[
+    List[bytes],
+    bytes,
+    Optional[str],
+    Optional[bytes],
+    Optional[list],
+    Optional[tuple],
+]:
+    """Full batch decode -> (items, uuid, error, trace_id, spans,
+    partition) where ``partition`` is the outer partition block's
+    5-int tuple (flag bit 64; ``None`` when clear) — the reduce-window
+    request/reply marker (routing/partition.py)."""
     if _fi.active_plan is not None:  # chaos seam (faultinject.runtime)
         buf = _fi.filter_bytes("npwire.decode_batch", buf)
     try:
-        magic, version, flags, uuid, n = struct.unpack_from("<4sBB16sI", buf, 0)
+        magic, version, flags, uuid, n = _HEADER_STRUCT.unpack_from(buf, 0)
     except struct.error as e:
         raise WireError(f"truncated header: {e}") from None
     if magic != MAGIC:
@@ -586,7 +713,7 @@ def decode_batch(
     _check_flags(flags)
     if not flags & _FLAG_BATCH:
         raise WireError("not a batch frame (flag bit 8 unset)")
-    off = struct.calcsize("<4sBB16sI")
+    off = _HEADER_SIZE
     error = None
     if flags & _FLAG_ERROR:
         try:
@@ -614,6 +741,9 @@ def decode_batch(
     if flags & _FLAG_TENANT:
         # Consumed and dropped (peek_tenant is the gateway-side reader).
         off = _skip_tenant_block(buf, off)
+    partition = None
+    if flags & _FLAG_PARTITION:
+        partition, off = _decode_partition(buf, off)
     items: List[bytes] = []
     for _ in range(n):
         try:
@@ -643,7 +773,7 @@ def decode_batch(
             raise WireError(
                 f"spans block must be a JSON list, got {type(spans).__name__}"
             )
-    return items, uuid, error, trace_id, spans
+    return items, uuid, error, trace_id, spans, partition
 
 
 def append_spans(frame: bytes, spans: Sequence[dict]) -> bytes:
@@ -708,7 +838,9 @@ def decode_arrays_all(
 ]:
     """Full decode -> (arrays, uuid, error, trace_id, spans) where
     ``spans`` is the piggybacked span-tree list (``None`` when the flag
-    is unset).
+    is unset).  A partition block (flag bit 64) is consumed and
+    dropped — partitioned lanes read it with
+    :func:`decode_arrays_part`.
 
     ``copy=True`` (the default, and the historical behavior) returns
     owned writable arrays.  ``copy=False`` returns READ-ONLY
@@ -716,10 +848,32 @@ def decode_arrays_all(
     the views keep the whole frame alive, so opt in where the frame is
     short-lived anyway (a server decoding a request it computes on and
     drops) rather than where results are retained."""
+    arrays, uuid, error, trace_id, spans, _part = decode_arrays_part(
+        buf, copy=copy
+    )
+    return arrays, uuid, error, trace_id, spans
+
+
+def decode_arrays_part(
+    buf: bytes,
+    *,
+    copy: bool = True,
+) -> Tuple[
+    List[np.ndarray],
+    bytes,
+    Optional[str],
+    Optional[bytes],
+    Optional[list],
+    Optional[tuple],
+]:
+    """:func:`decode_arrays_all` plus the frame's partition block as a
+    5-int tuple (flag bit 64; ``None`` when clear) — what the
+    partitioned client/server lanes decode replies with
+    (routing/partition.py owns the semantics)."""
     if _fi.active_plan is not None:  # chaos seam (faultinject.runtime)
         buf = _fi.filter_bytes("npwire.decode", buf)
     try:
-        magic, version, flags, uuid, n = struct.unpack_from("<4sBB16sI", buf, 0)
+        magic, version, flags, uuid, n = _HEADER_STRUCT.unpack_from(buf, 0)
     except struct.error as e:
         raise WireError(f"truncated header: {e}") from None
     if magic != MAGIC:
@@ -734,7 +888,7 @@ def decode_arrays_all(
         raise WireError(
             "batch frame (flag bit 8); decode with decode_batch"
         )
-    off = struct.calcsize("<4sBB16sI")
+    off = _HEADER_SIZE
     error = None
     if flags & _FLAG_ERROR:
         try:
@@ -761,6 +915,9 @@ def decode_arrays_all(
     if flags & _FLAG_TENANT:
         # Consumed and dropped (peek_tenant is the gateway-side reader).
         off = _skip_tenant_block(buf, off)
+    partition = None
+    if flags & _FLAG_PARTITION:
+        partition, off = _decode_partition(buf, off)
     arrays: List[np.ndarray] = []
     for _ in range(n):
         try:
@@ -814,4 +971,4 @@ def decode_arrays_all(
             raise WireError(
                 f"spans block must be a JSON list, got {type(spans).__name__}"
             )
-    return arrays, uuid, error, trace_id, spans
+    return arrays, uuid, error, trace_id, spans, partition

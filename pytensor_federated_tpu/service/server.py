@@ -52,6 +52,7 @@ from .npwire import (
     frame_uuid,
     is_batch_frame,
     peek_deadline,
+    peek_partition,
 )
 
 _log = logging.getLogger(__name__)
@@ -543,6 +544,28 @@ class ArraysToArraysService:
             return await _fi.call_shimmed_async(
                 encode_batch,
                 [], uuid=b"\0" * 16, error=f"decode error: {e}",
+            )
+        try:
+            reduce_part = peek_partition(request)
+        except WireError:
+            reduce_part = None
+        if reduce_part is not None:
+            # A REDUCE window (outer partition block, ISSUE 13): the
+            # gRPC lane does not serve reduce windows — answering
+            # per-item replies to a caller that asked for a partial
+            # sum would be a silent contract break, so the refusal is
+            # loud and in-band (the tcp/shm lanes, and aggregator
+            # trees over them, are the reduce transports; this repo's
+            # pooled client reduces grpc replicas driver-side).
+            return await _fi.call_shimmed_async(
+                encode_batch,
+                [],
+                uuid=outer_uuid,
+                error=(
+                    "partition reduce windows are not served on the "
+                    "grpc lane (use tcp/shm, or the pooled client's "
+                    "driver-side reduction)"
+                ),
             )
         _DECODE_S.observe(time.perf_counter() - t_arrive)
         with _spans.trace_context(trace_id), _spans.span(

@@ -69,6 +69,7 @@ import threading
 import time
 import uuid as uuid_mod
 import weakref
+from functools import lru_cache
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -99,6 +100,10 @@ from .tcp import (
     serve_npwire_payload,
 )
 
+# The partition lane (ISSUE 13) — shard math + loud reassembly rules
+# (routing/ never imports service/ at module level, so no cycle).
+from ..routing import partition as _partition
+
 __all__ = ["ShmArraysClient", "serve_shm"]
 
 MAGIC = b"SHM1"
@@ -126,7 +131,15 @@ _FLAG_ERROR = 1
 _FLAG_TRACE = 2
 _FLAG_DEADLINE = 4
 _FLAG_TENANT = 8
-_KNOWN_FLAGS = _FLAG_ERROR | _FLAG_TRACE | _FLAG_DEADLINE | _FLAG_TENANT
+_FLAG_PARTITION = 16
+_KNOWN_FLAGS = (
+    _FLAG_ERROR | _FLAG_TRACE | _FLAG_DEADLINE | _FLAG_TENANT
+    | _FLAG_PARTITION
+)
+#: The gradient-partition index block (flag bit 16): same 32-byte
+#: layout as the npwire block (wire_registry.PARTITION_STRUCT);
+#: routing/partition.py owns the semantics.
+_PARTITION_STRUCT = struct.Struct("<IIQQQ")
 
 _HEADER = struct.Struct("<4sBBBB16s")
 #: The arena descriptor — layout declared as SHM_DESC_STRUCT in
@@ -135,6 +148,15 @@ _HEADER = struct.Struct("<4sBBBB16s")
 _DESC_STRUCT = struct.Struct("<QIQQ")
 
 _BATCH_CHUNK = 32  # requests per EVAL_BATCH frame (tcp.py parity)
+
+# Preserialized packers (ISSUE-13 satellite): literal-format
+# struct.pack re-parses the format string per call — hoisted out of
+# the hot doorbell send/decode paths (the PR-10 _run_compute class).
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+_QI = struct.Struct("<QI")
+#: The empty descriptor block (n=0) — a constant on the reply paths.
+_EMPTY_DESCS = _U32.pack(0)
 
 _CALL_S = _rpc_metrics.CALL_S
 _RETRIES = _rpc_metrics.RETRIES
@@ -164,16 +186,28 @@ def encode_frame(
     trace_id: Optional[bytes] = None,
     deadline_s: Optional[float] = None,
     tenant: Optional[str] = None,
+    partition: Optional[Sequence[int]] = None,
 ) -> bytes:
     """One doorbell frame.  Descriptor-only — payload bytes NEVER ride
     the doorbell; they live in the arena.  ``deadline_s`` (flag bit 4)
     carries the request's remaining deadline budget in relative
     seconds (:mod:`.deadline`); ``tenant`` (flag bit 8) the gateway
-    tier's per-tenant identity (u16-length utf8, non-empty); ``None``
-    for either emits the pre-feature byte-identical frame."""
+    tier's per-tenant identity (u16-length utf8, non-empty);
+    ``partition`` (flag bit 16) the gradient-partition index block (a
+    5-int sequence — routing/partition.py owns the semantics);
+    ``None`` for any emits the pre-feature byte-identical frame."""
     if len(uuid) != 16:
         raise WireError(f"uuid must be 16 bytes, got {len(uuid)}")
     flags = 0
+    if error is None and trace_id is None and deadline_s is None \
+            and tenant is None and partition is None:
+        # Hot-path template (ISSUE-13 satellite): the flag-free frame
+        # — every ACK/GETLOAD/PING and most steady-state EVALs — is a
+        # prefix join, no per-block branching.
+        out = _plain_prefix(kind) + uuid + body
+        if _fi.active_plan is not None:  # chaos seam
+            out = _fi.filter_bytes("shm.encode", out)
+        return out
     parts: List[bytes] = []
     if error is not None:
         flags |= _FLAG_ERROR
@@ -191,6 +225,10 @@ def encode_frame(
         # one validator/encoder (npwire._encode_tenant) for both.
         tenant_block = _encode_tenant(tenant)
         flags |= _FLAG_TENANT
+    partition_block = None
+    if partition is not None:
+        partition_block = _encode_partition_block(partition)
+        flags |= _FLAG_PARTITION
     parts.append(_HEADER.pack(MAGIC, 1, kind, flags, 0, uuid))
     if error is not None:
         err = error.encode("utf-8")
@@ -202,6 +240,8 @@ def encode_frame(
         parts.append(struct.pack("<d", float(deadline_s)))
     if tenant_block is not None:
         parts.append(tenant_block)
+    if partition_block is not None:
+        parts.append(partition_block)
     parts.append(body)
     out = b"".join(parts)
     if _fi.active_plan is not None:  # chaos seam (faultinject.runtime)
@@ -209,19 +249,51 @@ def encode_frame(
     return out
 
 
+@lru_cache(maxsize=16)
+def _plain_prefix(kind: int) -> bytes:
+    """Preserialized ``MAGIC ver kind flags=0 pad`` header prefix for
+    the flag-free fast path (the uuid follows it)."""
+    return _HEADER.pack(MAGIC, 1, kind, 0, 0, b"\0" * 16)[: _HEADER.size - 16]
+
+
+def _encode_partition_block(partition: Sequence[int]) -> bytes:
+    """Validate + pack one partition block — delegated to the single
+    validator (:func:`..routing.partition.pack_partition`), so the shm
+    and npwire lanes cannot drift apart in what they refuse
+    (``PartitionError`` is a ``WireError`` subclass, preserving the
+    loud-failure classification)."""
+    try:
+        return _partition.pack_partition(
+            tuple(int(v) for v in partition)
+        )
+    except WireError:
+        raise
+    except (TypeError, ValueError) as e:
+        raise WireError(f"partition must be 5 ints: {e}") from None
+
+
 def decode_frame(
     buf: bytes,
 ) -> Tuple[
-    int, bytes, Optional[str], Optional[bytes], Optional[float], int, bytes
+    int,
+    bytes,
+    Optional[str],
+    Optional[bytes],
+    Optional[float],
+    Optional[tuple],
+    int,
+    bytes,
 ]:
-    """Decode a doorbell frame header ->
-    ``(kind, uuid, error, trace_id, deadline_s, body_offset, frame)``;
+    """Decode a doorbell frame header -> ``(kind, uuid, error,
+    trace_id, deadline_s, partition, body_offset, frame)``;
     kind-specific body parsing is the caller's, offset-based against
     the RETURNED ``frame`` (which is ``buf`` unless the chaos seam
     transformed it — parsing the original after a filtered header
     would silently mix two byte streams).  ``deadline_s`` is the
     remaining deadline budget off the wire (flag bit 4), ``None`` when
-    unbounded."""
+    unbounded; ``partition`` the gradient-partition block's 5-int
+    tuple (flag bit 16, ``None`` when clear — routing/partition.py
+    owns the semantics)."""
     if _fi.active_plan is not None:  # chaos seam (faultinject.runtime)
         buf = _fi.filter_bytes("shm.decode", buf)
     try:
@@ -261,8 +333,7 @@ def decode_frame(
             raise WireError(f"truncated shm deadline block: {e}") from None
         off += 8
     if flags & _FLAG_TENANT:
-        # Consumed and dropped — the historical 7-tuple shape stays
-        # stable for every caller; :func:`frame_tenant` is the reader.
+        # Consumed and dropped — :func:`frame_tenant` is the reader.
         try:
             (tlen,) = struct.unpack_from("<H", buf, off)
         except struct.error as e:
@@ -271,7 +342,16 @@ def decode_frame(
         if off + tlen > len(buf):
             raise WireError("truncated shm tenant block")
         off += tlen
-    return kind, uuid, error, trace_id, deadline_s, off, buf
+    partition = None
+    if flags & _FLAG_PARTITION:
+        try:
+            partition = _PARTITION_STRUCT.unpack_from(buf, off)
+        except struct.error as e:
+            raise WireError(
+                f"truncated shm partition block: {e}"
+            ) from None
+        off += _PARTITION_STRUCT.size
+    return kind, uuid, error, trace_id, deadline_s, partition, off, buf
 
 
 def frame_tenant(buf: bytes) -> Optional[str]:
@@ -319,7 +399,7 @@ Desc = Tuple[int, int, int, int, np.dtype, Tuple[int, ...]]
 def encode_descs(descs: Sequence[Desc]) -> bytes:
     """Descriptor block: ``n(u32)`` + one fixed struct + dtype/shape
     per array."""
-    parts: List[bytes] = [struct.pack("<I", len(descs))]
+    parts: List[bytes] = [_U32.pack(len(descs))]
     for slot, delta, length, gen, dtype, shape in descs:
         parts.append(_DESC_STRUCT.pack(slot, delta, length, gen))
         dt = _encode_dtype(dtype)
@@ -334,7 +414,7 @@ def encode_descs(descs: Sequence[Desc]) -> bytes:
 def decode_descs(buf: bytes, off: int) -> Tuple[List[Desc], int]:
     """Parse a descriptor block at ``off`` -> (descs, new_offset)."""
     try:
-        (n,) = struct.unpack_from("<I", buf, off)
+        (n,) = _U32.unpack_from(buf, off)
         off += 4
         descs: List[Desc] = []
         for _ in range(n):
@@ -358,6 +438,7 @@ def _desc_region_offset(
     kind: int,
     trace_id: Optional[bytes],
     deadline_s: Optional[float] = None,
+    partition: Optional[Sequence[int]] = None,
 ) -> int:
     """Byte offset where an OUTGOING EVAL/EVAL_BATCH frame's
     descriptor region starts (ack watermark preserved — corrupting it
@@ -367,6 +448,7 @@ def _desc_region_offset(
         _HEADER.size
         + (16 if trace_id is not None else 0)
         + (8 if deadline_s is not None else 0)
+        + (_PARTITION_STRUCT.size if partition is not None else 0)
     )
     if kind == _KIND_EVAL:
         return off + 8  # past ack_gen
@@ -544,7 +626,7 @@ class ShmArraysClient:
         assert self._sock is not None
         uid = fast_uuid()
         self._send(encode_frame(_KIND_ATTACH, uid))
-        kind, ruid, error, _tid, _dl, off, frame = decode_frame(
+        kind, ruid, error, _tid, _dl, _part, off, frame = decode_frame(
             self._read_frame()
         )
         if error is not None:
@@ -591,7 +673,7 @@ class ShmArraysClient:
             _deadline.recv_budget_s(self.timeout_s),
             self.close,
         ) as read_exact:
-            (n,) = struct.unpack("<I", read_exact(4))
+            (n,) = _U32.unpack(read_exact(4))
             buf = read_exact(n)
         if _fi.active_plan is not None:  # chaos seam
             buf = _fi.filter_bytes("shm.recv", buf, self._peer)
@@ -719,7 +801,7 @@ class ShmArraysClient:
         return descs
 
     def _eval_body(self, descs: Sequence[Desc]) -> bytes:
-        return struct.pack("<Q", self._consumed_gen) + encode_descs(descs)
+        return _U64.pack(self._consumed_gen) + encode_descs(descs)
 
     def _apply_descriptor_chaos(
         self,
@@ -727,6 +809,7 @@ class ShmArraysClient:
         kind: int,
         trace_id: Optional[bytes],
         deadline_s: Optional[float] = None,
+        partition: Optional[Sequence[int]] = None,
     ) -> bytes:
         """The ``corrupt_descriptor`` chaos seam: flip bytes inside the
         descriptor block only (header corruption is ``corrupt_bytes``
@@ -735,7 +818,7 @@ class ShmArraysClient:
             return frame
         return _fi.corrupt_descriptor_bytes(
             "shm.descriptor", frame,
-            _desc_region_offset(kind, trace_id, deadline_s),
+            _desc_region_offset(kind, trace_id, deadline_s, partition),
             peer=self._peer,
         )
 
@@ -767,7 +850,13 @@ class ShmArraysClient:
 
     # -- single evaluation -------------------------------------------------
 
-    def evaluate(self, *arrays: np.ndarray) -> List[np.ndarray]:
+    def evaluate(
+        self,
+        *arrays: np.ndarray,
+        partition: Optional[Sequence[int]] = None,
+    ) -> List[np.ndarray]:
+        """One lock-step evaluation; ``partition`` (keyword-only)
+        requests the head/tail SLICED reply, tcp.py-evaluate parity."""
         with _spans.span("rpc.evaluate", transport="shm"):
             last_err: Optional[Exception] = None
             for attempt in range(self.retries + 1):
@@ -798,9 +887,11 @@ class ShmArraysClient:
                                 self._eval_body(descs),
                                 trace_id=trace_id,
                                 deadline_s=budget,
+                                partition=partition,
                             )
                             frame = self._apply_descriptor_chaos(
-                                frame, _KIND_EVAL, trace_id, budget
+                                frame, _KIND_EVAL, trace_id, budget,
+                                partition,
                             )
                         self._send(frame)
                         reply = self._read_frame()
@@ -845,7 +936,7 @@ class ShmArraysClient:
     def _consume_reply(
         self, reply: bytes, uid: bytes, *, force_copy: bool = False
     ) -> List[np.ndarray]:
-        kind, ruid, error, _tid, _dl, off, reply = decode_frame(reply)
+        kind, ruid, error, _tid, _dl, _part, off, reply = decode_frame(reply)
         if kind == _KIND_ERROR:
             raise WireError(f"shm protocol error from node: {error}")
         if kind != _KIND_REPLY:
@@ -1009,6 +1100,252 @@ class ShmArraysClient:
             )
             return out, None
 
+    def evaluate_reduced(
+        self,
+        requests: Sequence[Sequence[np.ndarray]],
+        *,
+        window: int = 8,
+        slices: int = 1,
+        total: Optional[int] = None,
+    ) -> List[np.ndarray]:
+        """Reduce-scatter evaluation over the doorbell:
+        ``[head_sum, flat_tail_sum]`` — the shm twin of
+        :meth:`~.tcp.TcpArraysClient.evaluate_reduced` (same window
+        semantics, partition blocks on the doorbell's flag bit 16,
+        reply slices in index order under the outer uuid)."""
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        if slices < 1:
+            raise ValueError(f"slices must be >= 1, got {slices}")
+        requests = list(requests)
+        if not requests:
+            raise _partition.PartitionError(
+                "cannot reduce an empty request list"
+            )
+        with _spans.span(
+            "rpc.evaluate_reduced",
+            transport="shm",
+            n=len(requests),
+            slices=slices,
+        ):
+            t0 = time.perf_counter()
+            last_err: Optional[Exception] = None
+            for attempt in range(self.retries + 1):
+                if attempt:
+                    _RETRIES.labels(transport="shm").inc()
+                    _flightrec.record(
+                        "rpc.retry", transport="shm", attempt=attempt,
+                        batch=len(requests),
+                    )
+                    _deadline.check_remaining("shm reduce retry")
+                try:
+                    with _watchdog.armed(
+                        "shm.reduce_window",
+                        n=len(requests),
+                        window=window,
+                    ):
+                        result = self._evaluate_reduced_once(
+                            requests, window, slices, total
+                        )
+                except (ConnectionError, OSError) as e:
+                    last_err = e
+                    _DROPS.labels(transport="shm").inc()
+                    _flightrec.record(
+                        "rpc.drop", transport="shm", peer=self._peer
+                    )
+                    self.close()
+                    continue
+                except WireError:
+                    _DROPS.labels(transport="shm").inc()
+                    self.close()
+                    raise
+                _BATCH_S.labels(transport="shm").observe(
+                    time.perf_counter() - t0
+                )
+                return result
+            raise ConnectionError(
+                f"shm node {self._peer} unreachable after "
+                f"{self.retries + 1} attempts"
+            ) from last_err
+
+    def _evaluate_reduced_once(
+        self,
+        requests: Sequence[Sequence[np.ndarray]],
+        window: int,
+        slices: int,
+        total: Optional[int],
+    ) -> List[np.ndarray]:
+        self._connect()
+        trace_id = _spans.current_trace_id() if _spans.enabled() else None
+        chunk = max(1, min(window, _BATCH_CHUNK))
+        req_part = (0, slices, 0, 0, 0 if total is None else int(total))
+        head: Optional[np.ndarray] = None
+        flat: Optional[np.ndarray] = None
+        # Lock-step per frame: reduce replies are tiny (one tail per
+        # frame regardless of window width), so one-in-flight keeps
+        # the drain/reclaim story trivial (tcp.py twin's rationale).
+        for start in range(0, len(requests), chunk):
+            part_reqs = requests[start : start + chunk]
+            outer_uuid = fast_uuid()
+            budget = _deadline.wire_budget()
+            slots: List[Optional[int]] = []
+            item_parts: List[bytes] = []
+            for req in part_reqs:
+                descs, slot, _nb = self._encode_request(req)
+                slots.append(slot)
+                item_parts.append(fast_uuid() + encode_descs(descs))
+            body = (
+                _QI.pack(self._consumed_gen, len(part_reqs))
+                + b"".join(item_parts)
+            )
+            frame = encode_frame(
+                _KIND_EVAL_BATCH, outer_uuid, body,
+                trace_id=trace_id, deadline_s=budget,
+                partition=req_part,
+            )
+            _FRAME_REQS.labels(transport="shm").observe(len(part_reqs))
+            self._send(frame)
+            reply = self._read_frame()
+            try:
+                f_head, f_flat = self._consume_reduce_reply(
+                    reply, outer_uuid, slices, total
+                )
+            except (RemoteComputeError, _deadline.DeadlineExceeded):
+                # In-band failure: connection stays correlated — free
+                # the frame's slots (the node is done) and surface.
+                for slot in slots:
+                    self._free_transient(slot)
+                raise
+            except (WireError, RuntimeError):
+                _DROPS.labels(transport="shm").inc()
+                self.close()
+                raise
+            for slot in slots:
+                self._free_transient(slot)
+            if head is None:
+                head, flat = f_head, f_flat
+            else:
+                if (
+                    f_head.shape != head.shape
+                    or f_flat.size != flat.size
+                ):
+                    self.close()
+                    raise WireError(
+                        "reduce frames disagree on reply geometry"
+                    )
+                head = head + f_head
+                flat = flat + f_flat
+        self._send_ack()
+        assert head is not None and flat is not None
+        return [head, flat]
+
+    def _consume_reduce_reply(
+        self,
+        reply: bytes,
+        outer_uuid: bytes,
+        slices: int,
+        total: Optional[int],
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """One REPLY_BATCH reduce reply -> (head_sum, flat_vector);
+        items arrive in partition-index order under the outer uuid
+        (the doorbell framing has no per-item partition blocks — both
+        ends derive the same plan from (total, count))."""
+        kind, ruid, outer_err, _tid, _dl, rpart, off, reply = (
+            decode_frame(reply)
+        )
+        if kind == _KIND_ERROR:
+            raise WireError(f"shm protocol error from node: {outer_err}")
+        if kind != _KIND_REPLY_BATCH:
+            raise WireError(
+                f"unexpected shm frame kind {kind} (wanted REPLY_BATCH)"
+            )
+        if outer_err is not None:
+            if _deadline.is_deadline_error(outer_err):
+                raise _deadline.DeadlineExceeded(outer_err)
+            raise RemoteComputeError(outer_err)
+        if ruid != outer_uuid:
+            raise RuntimeError(
+                "batch reply does not correlate with its frame"
+            )
+        if rpart is None:
+            raise _partition.PartitionError(
+                "reduce reply carries no partition block"
+            )
+        _i, count, _o, _l, r_total = rpart
+        if count != slices or (
+            total is not None and r_total != int(total)
+        ):
+            raise _partition.PartitionError(
+                f"reduce reply geometry ({count}, {r_total}) does not "
+                f"match the request ({slices}, {total})"
+            )
+        try:
+            (k,) = struct.unpack_from("<I", reply, off)
+            off += 4
+        except struct.error as e:
+            raise WireError(
+                f"truncated shm reduce reply: {e}"
+            ) from None
+        if k != slices:
+            raise _partition.PartitionError(
+                f"reduce reply carries {k} slices, requested {slices}"
+            )
+        plan = _partition.plan_partitions(r_total, slices)
+        head: Optional[np.ndarray] = None
+        reassembler: Optional[_partition.Reassembler] = None
+        for j in range(k):
+            iuid = reply[off : off + 16]
+            if len(iuid) != 16:
+                raise WireError("truncated shm batch item")
+            off += 16
+            try:
+                (elen,) = struct.unpack_from("<I", reply, off)
+            except struct.error as e:
+                raise WireError(
+                    f"truncated shm batch item: {e}"
+                ) from None
+            off += 4
+            if elen:
+                if off + elen > len(reply):
+                    raise WireError("truncated shm batch item error")
+                raise RemoteComputeError(
+                    reply[off : off + elen].decode("utf-8", "replace")
+                )
+            descs, off = decode_descs(reply, off)
+            # Identity = outer uuid + partition index (see the server's
+            # construction): catches duplicated or reordered slices
+            # even when their lengths agree with the plan.
+            if iuid != outer_uuid[:12] + _U32.pack(j):
+                raise _partition.PartitionError(
+                    "reduce item identity mismatch (duplicated, "
+                    "dropped, or reordered shard)"
+                )
+            arrays = self._decode_reply_arrays(descs, force_copy=True)
+            if j == 0:
+                if len(arrays) != 2:
+                    raise _partition.PartitionError(
+                        "reduce reply item 0 must be [head, slice]"
+                    )
+                head = arrays[0]
+                slice_arr = arrays[1]
+            else:
+                if len(arrays) != 1:
+                    raise _partition.PartitionError(
+                        "reduce reply items 1.. must be [slice]"
+                    )
+                slice_arr = arrays[0]
+            if reassembler is None:
+                reassembler = _partition.Reassembler(
+                    r_total,
+                    slices,
+                    np.asarray(slice_arr).dtype
+                    if np.asarray(slice_arr).size
+                    else np.dtype(np.float64),
+                )
+            reassembler.add(plan[j], np.asarray(slice_arr))
+        assert reassembler is not None and head is not None
+        return head, reassembler.result()
+
     def _evaluate_many_once(
         self,
         requests: Sequence[Sequence[np.ndarray]],
@@ -1102,7 +1439,7 @@ class ShmArraysClient:
                 encode_frame(
                     _KIND_ACK,
                     fast_uuid(),
-                    struct.pack("<Q", self._consumed_gen),
+                    _U64.pack(self._consumed_gen),
                 )
             )
         except (ConnectionError, OSError):
@@ -1218,7 +1555,7 @@ class ShmArraysClient:
                         [d for d in descs if d is not None]
                     )
                 body = (
-                    struct.pack("<QI", self._consumed_gen, len(part))
+                    _QI.pack(self._consumed_gen, len(part))
                     + b"".join(
                         uid + block
                         for uid, block in zip(item_uids, item_blocks)
@@ -1245,7 +1582,7 @@ class ShmArraysClient:
             inflight.pop(0)
             first_error: Optional[str] = None
             try:
-                kind, ruid, outer_err, _tid, _dl, off, reply = decode_frame(
+                kind, ruid, outer_err, _tid, _dl, _part, off, reply = decode_frame(
                     reply
                 )
                 if kind == _KIND_ERROR:
@@ -1350,7 +1687,7 @@ class ShmArraysClient:
         self._send(encode_frame(_KIND_GETLOAD, uid))
         reply = self._read_frame()
         try:
-            kind, ruid, error, _tid, _dl, off, reply = decode_frame(reply)
+            kind, ruid, error, _tid, _dl, _part, off, reply = decode_frame(reply)
             if kind != _KIND_LOAD or ruid != uid or error is not None:
                 return None
             (jlen,) = struct.unpack_from("<I", reply, off)
@@ -1379,7 +1716,7 @@ class ShmArraysClient:
             encode_frame(_KIND_PING, uid, encode_descs(descs))
         )
         try:
-            kind, ruid, error, _tid, _dl, _off, _frame = decode_frame(
+            kind, ruid, error, _tid, _dl, _part, _off, _frame = decode_frame(
                 self._read_frame()
             )
             if kind != _KIND_PONG or ruid != uid:
@@ -1559,7 +1896,7 @@ class _ShmConnection:
             return serve_npwire_payload(
                 self.compute_fn, payload, transport="shm"
             )
-        kind, uid, _err, trace_id, deadline_s, off, payload = decode_frame(
+        kind, uid, _err, trace_id, deadline_s, partition, off, payload = decode_frame(
             payload
         )
         if kind == _KIND_ATTACH:
@@ -1589,7 +1926,14 @@ class _ShmConnection:
                 with _deadline.budget_scope(deadline_s):
                     if kind == _KIND_EVAL:
                         return self._serve_eval(
-                            payload, uid, trace_id, off
+                            payload, uid, trace_id, off,
+                            partition=partition,
+                        )
+                    if partition is not None:
+                        # Outer partition on a batch frame = a REDUCE
+                        # window (routing/partition.py).
+                        return self._serve_eval_reduce(
+                            payload, uid, trace_id, off, partition
                         )
                     return self._serve_eval_batch(
                         payload, uid, trace_id, off
@@ -1635,6 +1979,7 @@ class _ShmConnection:
         uid: bytes,
         trace_id: Optional[bytes],
         off: int,
+        partition: Optional[tuple] = None,
     ) -> bytes:
         # Same pftpu_server_* families as the gRPC/TCP lanes
         # (_node_metrics) so an shm node aggregates in the fleet view.
@@ -1675,6 +2020,12 @@ class _ShmConnection:
                     _node_metrics.COMPUTE_S.observe(
                         time.perf_counter() - t_c0
                     )
+                if partition is not None:
+                    # Sliced reply (routing/partition.py head/tail
+                    # rule); geometry disagreement is loud, in-band.
+                    outputs = _partition.slice_reply(
+                        outputs, _partition.GradPartition(*partition)
+                    )
                 with _spans.span("encode"):
                     t_e0 = time.perf_counter()
                     rdescs = self._write_reply_arrays(outputs)
@@ -1692,7 +2043,9 @@ class _ShmConnection:
                 return encode_frame(
                     _KIND_REPLY, uid, encode_descs([]), error=str(e)
                 )
-        return encode_frame(_KIND_REPLY, uid, encode_descs(rdescs))
+        return encode_frame(
+            _KIND_REPLY, uid, encode_descs(rdescs), partition=partition
+        )
 
     def _serve_eval_batch(
         self,
@@ -1814,6 +2167,153 @@ class _ShmConnection:
         body = struct.pack("<I", k) + b"".join(item_replies)
         _node_metrics.ENCODE_S.observe(time.perf_counter() - t_e0)
         return encode_frame(_KIND_REPLY_BATCH, uid, body)
+
+    def _serve_eval_reduce(
+        self,
+        payload: bytes,
+        uid: bytes,
+        trace_id: Optional[bytes],
+        off: int,
+        partition: tuple,
+    ) -> bytes:
+        """One REDUCE window over the doorbell (EVAL_BATCH + outer
+        partition block): sum the items' replies (head whole, tails
+        flat-concatenated — routing/partition.py), answer the sum as
+        ``count`` partition-indexed REPLY_BATCH items in INDEX ORDER
+        (item 0 = [head, slice 0], items 1.. = [slice i]; the doorbell
+        item framing has no per-item flag bits, so order+outer-echo IS
+        the correlation — both ends derive the same plan from
+        ``(total, count)``).  All-or-nothing: any item failure fails
+        the window in-band (no silent partial sums)."""
+        _node_metrics.REQUESTS.labels(method="evaluate_reduce").inc()
+        t_arrive = time.perf_counter()
+
+        def outer_error(err: str) -> bytes:
+            return encode_frame(
+                _KIND_REPLY_BATCH, uid, struct.pack("<I", 0), error=err
+            )
+
+        try:
+            req_part = _partition.GradPartition(*partition).validate()
+            ack, k = struct.unpack_from("<QI", payload, off)
+            self._reclaim(ack)
+            off += 12
+            windows: List[List[np.ndarray]] = []
+            for _ in range(k):
+                iuid = payload[off : off + 16]
+                if len(iuid) != 16:
+                    raise WireError("truncated shm batch item")
+                off += 16
+                descs, off = decode_descs(payload, off)
+                windows.append(self._request_arrays(descs))
+        except (WireError, struct.error) as e:
+            _node_metrics.ERRORS.labels(kind="decode").inc()
+            return outer_error(f"decode error: {e}")
+        t_decoded = time.perf_counter()
+        _node_metrics.DECODE_S.observe(t_decoded - t_arrive)
+        with _spans.trace_context(trace_id), _spans.span(
+            "node.evaluate_reduce", wire="shm", transport="shm",
+            n_items=k, count=req_part.count,
+        ):
+            if _fi.active_plan is not None:  # chaos seam: compute path
+                try:
+                    _fi.compute_filter("shm.compute")
+                except _fi.FaultPlanError:
+                    raise
+                except Exception as e:
+                    return outer_error(str(e))
+            try:
+                if not windows:
+                    raise _partition.PartitionError(
+                        "cannot reduce an empty window"
+                    )
+                reduce_fn = getattr(self.compute_fn, "reduce", None)
+                t_c0 = time.perf_counter()
+                _node_metrics.QUEUE_S.observe(max(0.0, t_c0 - t_decoded))
+                if reduce_fn is not None:
+                    summed = [np.asarray(o) for o in reduce_fn(windows)]
+                else:
+                    outcomes = _execute_window_sync(
+                        self.compute_fn,
+                        getattr(self.compute_fn, "batch", None),
+                        windows,
+                    )
+                    for res in outcomes:
+                        if isinstance(res, Exception):
+                            raise res
+                    summed = _partition.reduce_replies(outcomes)
+                _node_metrics.COMPUTE_S.observe(
+                    time.perf_counter() - t_c0
+                )
+                _layout, total, _dtype = _partition.tail_layout(summed)
+                if req_part.total and req_part.total != total:
+                    raise _partition.PartitionError(
+                        f"partition total {req_part.total} != window "
+                        f"tail size {total} (driver/node shape "
+                        "disagreement)"
+                    )
+                t_e0 = time.perf_counter()
+                plan = _partition.plan_partitions(total, req_part.count)
+                flat = _partition.concat_tail(summed)
+                # All slices (plus the head) pack into ONE arena slot;
+                # per-item descriptors carve it up, items in index
+                # order under the outer uuid.
+                flat_outputs: List[np.ndarray] = [np.asarray(summed[0])]
+                for p in plan:
+                    flat_outputs.append(
+                        flat[p.offset : p.offset + p.length]
+                    )
+                all_descs = self._write_reply_arrays(flat_outputs)
+                item_replies: List[bytes] = []
+                for j, p in enumerate(plan):
+                    descs = [all_descs[1 + j]]
+                    if j == 0:
+                        descs.insert(0, all_descs[0])
+                    # Item identity = outer uuid + the partition index
+                    # (doorbell items carry no per-item flag blocks):
+                    # a duplicated/reordered slice — even one of equal
+                    # length — fails the client's identity check
+                    # loudly instead of reassembling silently wrong.
+                    item_replies.append(
+                        uid[:12]
+                        + _U32.pack(p.index)
+                        + struct.pack("<I", 0)
+                        + encode_descs(descs)
+                    )
+                    _partition.PARTITION_SHARDS.labels(
+                        outcome="ok"
+                    ).inc()
+                if _fi.active_plan is not None:  # chaos seam: shards
+                    item_replies = _fi.shard_filter(
+                        "partition.reply", item_replies, block_off=20
+                    )
+                body = struct.pack("<I", len(item_replies)) + b"".join(
+                    item_replies
+                )
+                _node_metrics.ENCODE_S.observe(
+                    time.perf_counter() - t_e0
+                )
+                return encode_frame(
+                    _KIND_REPLY_BATCH,
+                    uid,
+                    body,
+                    partition=_partition.GradPartition(
+                        0, req_part.count, 0, total, total
+                    ),
+                )
+            except _fi.FaultPlanError:
+                raise  # plan-authoring bug: LOUD, never in-band
+            except Exception as e:
+                if isinstance(e, _partition.PartitionError):
+                    _partition.PARTITION_SHARDS.labels(
+                        outcome="error"
+                    ).inc()
+                _node_metrics.ERRORS.labels(kind="compute").inc()
+                _flightrec.record(
+                    "server.error", stage="reduce", wire="shm",
+                    transport="shm", error=str(e)[:200],
+                )
+                return outer_error(str(e))
 
 
 def serve_shm(

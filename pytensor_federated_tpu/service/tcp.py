@@ -38,15 +38,23 @@ from .npwire import (
     fast_uuid,
     decode_arrays_all,
     decode_arrays_ex,
+    decode_arrays_part,
     decode_batch,
+    decode_batch_part,
     encode_arrays,
     encode_arrays_sg,
     encode_batch,
     frame_uuid,
     is_batch_frame,
     peek_deadline,
+    peek_partition,
     sg_nbytes,
 )
+
+# The partition lane (ISSUE 13): shard math + loud reassembly rules.
+# routing/ deliberately never imports service/ at module level, so this
+# upward import cannot cycle (the same direction wire_registry rides).
+from ..routing import partition as _partition
 
 __all__ = ["TcpArraysClient", "serve_tcp_once", "RemoteComputeError"]
 
@@ -76,6 +84,12 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
         n -= len(b)
     return b"".join(chunks)
 
+
+# Preserialized header packers (ISSUE-13 satellite): the u32 length
+# prefix rides every frame each way, and struct.pack with a literal
+# format re-parses the format string per call in the hot send path —
+# the PR-10-review _run_compute class, swept from the client lanes.
+_U32 = struct.Struct("<I")
 
 # Linux IOV_MAX is 1024; stay under it so one sendmsg never fails
 # with EMSGSIZE however many frames a burst coalesces.
@@ -111,7 +125,7 @@ def _sendmsg_all(sock: socket.socket, parts) -> None:
 
 def _send_frame(sock: socket.socket, payload: bytes) -> None:
     # Header + payload as one sendmsg vector: no copy-to-prepend.
-    _sendmsg_all(sock, (struct.pack("<I", len(payload)), payload))
+    _sendmsg_all(sock, (_U32.pack(len(payload)), payload))
 
 
 def _send_frame_vec(sock: socket.socket, parts, nbytes: int) -> None:
@@ -119,11 +133,11 @@ def _send_frame_vec(sock: socket.socket, parts, nbytes: int) -> None:
     (``encode_arrays_sg`` output): the u32 header and every piece ride
     a single ``sendmsg``, so array payloads go source → kernel with no
     intermediate frame copy."""
-    _sendmsg_all(sock, [struct.pack("<I", nbytes), *parts])
+    _sendmsg_all(sock, [_U32.pack(nbytes), *parts])
 
 
 def _recv_frame(sock: socket.socket) -> bytes:
-    (n,) = struct.unpack("<I", _recv_exact(sock, 4))
+    (n,) = _U32.unpack(_recv_exact(sock, 4))
     return _recv_exact(sock, n)
 
 
@@ -246,7 +260,7 @@ class TcpArraysClient:
             _deadline.recv_budget_s(self.timeout_s),
             self.close,
         ) as read_exact:
-            (n,) = struct.unpack("<I", read_exact(4))
+            (n,) = _U32.unpack(read_exact(4))
             return read_exact(n)
 
     def close(self) -> None:
@@ -271,7 +285,16 @@ class TcpArraysClient:
         except Exception:
             pass
 
-    def evaluate(self, *arrays: np.ndarray) -> List[np.ndarray]:
+    def evaluate(
+        self,
+        *arrays: np.ndarray,
+        partition: Optional[Sequence[int]] = None,
+    ) -> List[np.ndarray]:
+        """One lock-step evaluation.  ``partition`` (keyword-only, a
+        5-int sequence) requests the head/tail SLICED reply — the
+        reply is ``[head, slice]`` with the block echoed; geometry
+        disagreement surfaces as :class:`RemoteComputeError`
+        (routing/partition.py owns the rule)."""
         with _spans.span("rpc.evaluate", transport="tcp"):
             with _spans.span("encode"):
                 uid = fast_uuid()
@@ -291,6 +314,7 @@ class TcpArraysClient:
                     trace_id=trace_id,
                     deadline_s=_deadline.wire_budget(),
                     tenant=self.tenant,
+                    partition=partition,
                 )
                 request_len = sg_nbytes(request)
             last_err: Optional[Exception] = None
@@ -318,6 +342,7 @@ class TcpArraysClient:
                             trace_id=trace_id,
                             deadline_s=budget,
                             tenant=self.tenant,
+                            partition=partition,
                         )
                         request_len = sg_nbytes(request)
                 t0 = time.perf_counter()
@@ -653,6 +678,242 @@ class TcpArraysClient:
             )
             return out, None
 
+    def evaluate_reduced(
+        self,
+        requests: Sequence[Sequence[np.ndarray]],
+        *,
+        window: int = 8,
+        slices: int = 1,
+        total: Optional[int] = None,
+    ) -> List[np.ndarray]:
+        """Reduce-scatter evaluation: ``[head_sum, flat_tail_sum]``.
+
+        The window rides REDUCE batch frames (outer partition block):
+        the node sums each frame's item replies — head (reply array 0)
+        summed whole, tails flat-concatenated — and returns the sum as
+        ``slices`` partition-indexed slices, reassembled here with the
+        loud :class:`~..routing.partition.Reassembler` rules; partial
+        sums from multiple frames are summed locally.  Wire bytes per
+        reply drop from ``n_requests × tail_size`` to
+        ``n_frames × tail_size`` — the ISSUE-13 bandwidth story.
+
+        ``slices > 1`` splits each frame's reply into that many
+        partition-indexed items (gradients larger than one reply frame
+        stream home in pieces); ``total``, when given, is validated
+        against the node's actual flat tail size (a driver/node shape
+        disagreement fails in-band instead of mis-assembling).
+
+        Deterministic server errors raise
+        :class:`RemoteComputeError`/:class:`WireError` after a drain;
+        transport trouble retries like :meth:`evaluate_many`."""
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        if slices < 1:
+            raise ValueError(f"slices must be >= 1, got {slices}")
+        requests = list(requests)
+        if not requests:
+            raise _partition.PartitionError(
+                "cannot reduce an empty request list"
+            )
+        with _spans.span(
+            "rpc.evaluate_reduced",
+            transport="tcp",
+            n=len(requests),
+            slices=slices,
+        ):
+            t0 = time.perf_counter()
+            last_err: Optional[Exception] = None
+            for attempt in range(self.retries + 1):
+                if attempt:
+                    _RETRIES.labels(transport="tcp").inc()
+                    _flightrec.record(
+                        "rpc.retry", transport="tcp", attempt=attempt,
+                        batch=len(requests),
+                    )
+                    _deadline.check_remaining("tcp reduce retry")
+                try:
+                    with _watchdog.armed(
+                        "tcp.reduce_window",
+                        n=len(requests),
+                        window=window,
+                    ):
+                        result = self._evaluate_reduced_once(
+                            requests, window, slices, total
+                        )
+                except (ConnectionError, OSError) as e:
+                    last_err = e
+                    _DROPS.labels(transport="tcp").inc()
+                    _flightrec.record(
+                        "rpc.drop", transport="tcp", peer=self._peer
+                    )
+                    self.close()
+                    continue
+                _BATCH_S.labels(transport="tcp").observe(
+                    time.perf_counter() - t0
+                )
+                return result
+            raise ConnectionError(
+                f"node {self.host}:{self.port} unreachable after "
+                f"{self.retries + 1} attempts"
+            ) from last_err
+
+    def _evaluate_reduced_once(self, requests, window, slices, total):
+        sock = self._connect()
+        trace_id = _spans.current_trace_id() if _spans.enabled() else None
+        chunk = max(1, min(window, self._BATCH_CHUNK))
+        req_part = (0, slices, 0, 0, 0 if total is None else int(total))
+        head: Optional[np.ndarray] = None
+        flat: Optional[np.ndarray] = None
+        # Lock-step per frame on purpose: reduce replies are tiny (one
+        # tail regardless of window width), so pipelining frames buys
+        # little and the one-in-flight mode keeps the drain trivial.
+        # Each frame encodes AT SEND TIME so it stamps the budget as
+        # it stands after the earlier frames' wall time (the ISSUE-10
+        # restamp posture; the shm twin does the same).
+        for start in range(0, len(requests), chunk):
+            part_reqs = requests[start : start + chunk]
+            outer_uuid = fast_uuid()
+            frame = encode_batch(
+                [
+                    encode_arrays(
+                        [np.asarray(a) for a in args], uuid=fast_uuid()
+                    )
+                    for args in part_reqs
+                ],
+                uuid=outer_uuid,
+                trace_id=trace_id,
+                deadline_s=_deadline.wire_budget(),
+                partition=req_part,
+            )
+            _FRAME_REQS.labels(transport="tcp").observe(len(part_reqs))
+            if _fi.active_plan is not None:  # chaos seam
+                _fi.send_frame_through(
+                    "tcp.send", sock.sendall, frame, peer=self._peer
+                )
+            else:
+                _send_frame(sock, frame)
+            reply = self._read_frame()
+            if _fi.active_plan is not None:  # chaos seam
+                reply = _fi.filter_bytes("tcp.recv", reply, self._peer)
+            f_head, f_flat = self._consume_reduce_reply(
+                reply, outer_uuid, slices, total
+            )
+            if head is None:
+                head, flat = f_head, f_flat
+            else:
+                if (
+                    f_head.shape != head.shape
+                    or f_flat.size != flat.size
+                ):
+                    self.close()
+                    raise WireError(
+                        "reduce frames disagree on reply geometry"
+                    )
+                head = head + f_head
+                flat = flat + f_flat
+        return [head, flat]
+
+    def _consume_reduce_reply(self, reply, outer_uuid, slices, total):
+        """One reduce reply frame -> (head_sum, flat_vector); loud on
+        every anomaly (the Reassembler rules), closing the connection
+        so the NEXT call reconnects cleanly."""
+        try:
+            items, ruid, outer_err, _tid, node_spans, rpart = (
+                decode_batch_part(reply)
+            )
+            if node_spans:
+                _reunion.ingest(node_spans)
+        except Exception:
+            _DROPS.labels(transport="tcp").inc()
+            self.close()
+            raise
+        if outer_err is not None:
+            if _deadline.is_deadline_error(outer_err):
+                raise _deadline.DeadlineExceeded(outer_err)
+            raise RemoteComputeError(outer_err)
+        try:
+            if ruid != outer_uuid:
+                raise WireError(
+                    "reduce reply does not correlate with its frame"
+                )
+            if rpart is None:
+                raise _partition.PartitionError(
+                    "reduce reply carries no partition block"
+                )
+            _i, count, _o, _l, r_total = rpart
+            if count != slices or (
+                total is not None and r_total != int(total)
+            ):
+                raise _partition.PartitionError(
+                    f"reduce reply geometry ({count}, {r_total}) does "
+                    f"not match the request ({slices}, {total})"
+                )
+            if len(items) != slices:
+                raise _partition.PartitionError(
+                    f"reduce reply carries {len(items)} slices, "
+                    f"requested {slices}"
+                )
+            head: Optional[np.ndarray] = None
+            reassembler: Optional[_partition.Reassembler] = None
+            for item in items:
+                arrays, _uid, err, _t, _sp, ipart = decode_arrays_part(
+                    item
+                )
+                if err is not None:
+                    raise RemoteComputeError(err)
+                if ipart is None:
+                    raise _partition.PartitionError(
+                        "reduce reply item carries no partition block"
+                    )
+                p = _partition.GradPartition(*ipart).validate()
+                # Cross-check the ITEM's block against the OUTER block
+                # (itself validated against the request) BEFORE the
+                # geometry sizes anything: a corrupt item total would
+                # otherwise size the reassembly buffer — an
+                # attacker/chaos-chosen allocation instead of the
+                # contracted loud refusal.
+                if p.count != count or p.total != r_total:
+                    raise _partition.PartitionError(
+                        f"reduce item geometry ({p.count}, {p.total}) "
+                        f"does not match the window ({count}, {r_total})"
+                    )
+                if p.index == 0:
+                    if len(arrays) != 2:
+                        raise _partition.PartitionError(
+                            "reduce reply item 0 must be [head, slice]"
+                        )
+                    head = arrays[0]
+                    slice_arr = arrays[1]
+                else:
+                    if len(arrays) != 1:
+                        raise _partition.PartitionError(
+                            "reduce reply items 1.. must be [slice]"
+                        )
+                    slice_arr = arrays[0]
+                if reassembler is None:
+                    reassembler = _partition.Reassembler(
+                        p.total,
+                        p.count,
+                        np.asarray(slice_arr).dtype
+                        if np.asarray(slice_arr).size
+                        else np.dtype(np.float64),
+                    )
+                reassembler.add(p, np.asarray(slice_arr))
+            assert reassembler is not None
+            if head is None:
+                raise _partition.PartitionError(
+                    "reduce reply carried no head item (index 0)"
+                )
+            return head, reassembler.result()
+        except RemoteComputeError:
+            raise
+        except (WireError, RuntimeError):
+            # Mis-assembled / desynchronized reply: close so the NEXT
+            # call reconnects cleanly; the error surfaces loudly.
+            _DROPS.labels(transport="tcp").inc()
+            self.close()
+            raise
+
     def _evaluate_many_once(self, encoded, window, out=None):
         # ``out`` (optional, len(encoded) of None) is filled in place
         # as replies validate — the partial-progress channel
@@ -694,7 +955,7 @@ class TcpArraysClient:
                 else:
                     vec = []
                     for parts, nbytes in burst:
-                        vec.append(struct.pack("<I", nbytes))
+                        vec.append(_U32.pack(nbytes))
                         vec.extend(parts)
                     _sendmsg_all(sock, vec)
             _WINDOW_DEPTH.labels(transport="tcp").observe(
@@ -811,7 +1072,7 @@ class TcpArraysClient:
                     # One gather syscall, no userspace concat copy.
                     vec = []
                     for p in burst:
-                        vec.append(struct.pack("<I", len(p)))
+                        vec.append(_U32.pack(len(p)))
                         vec.append(p)
                     _sendmsg_all(sock, vec)
             _WINDOW_DEPTH.labels(transport="tcp").observe(
@@ -1010,10 +1271,17 @@ def _serve_plain_payload(
     frombuffer views into the frame — one payload copy saved per
     request, at the cost of breaking compute_fns that mutate their
     inputs in place; the historical owned-copy semantics stay the
-    default."""
+    default.
+
+    A request PARTITION block (npwire flag bit 64) asks for the
+    head/tail SLICED reply (routing/partition.py's rule: array 0
+    whole, arrays 1.. flat-concatenated and sliced to the requested
+    element range, the block echoed on the reply).  Geometry or shape
+    disagreement is answered in-band, loudly — never a mis-sliced
+    gradient."""
     t_arrive = time.perf_counter()
     try:
-        arrays, uid, _, trace_id = decode_arrays_ex(
+        arrays, uid, _err, trace_id, _sp, part = decode_arrays_part(
             payload, copy=not request_views
         )
     except Exception as e:
@@ -1051,9 +1319,16 @@ def _serve_plain_payload(
                 _node_metrics.COMPUTE_S.observe(
                     time.perf_counter() - t_c0
                 )
+            if part is not None:
+                # Sliced reply (the scatter half of ISSUE 13): loud on
+                # geometry/shape disagreement — the PartitionError is a
+                # WireError and rides the in-band error arm below.
+                outputs = _partition.slice_reply(
+                    outputs, _partition.GradPartition(*part)
+                )
             with _spans.span("encode"):
                 t_e0 = time.perf_counter()
-                reply = encode_arrays(outputs, uuid=uid)
+                reply = encode_arrays(outputs, uuid=uid, partition=part)
                 _node_metrics.ENCODE_S.observe(
                     time.perf_counter() - t_e0
                 )
@@ -1069,6 +1344,138 @@ def _serve_plain_payload(
             reply = encode_arrays([], uuid=uid, error=str(e))
     # Reunion piggyback: traced requests get this node's span tree on
     # the reply tail (untraced frames stay byte-identical).
+    if trace_id is not None and root.span is not None:
+        reply = append_spans(reply, [root.span.to_dict()])
+    return reply
+
+
+def _serve_reduce_payload(
+    compute_fn: Callable[..., Sequence[np.ndarray]],
+    payload: bytes,
+    *,
+    transport: str = "tcp",
+    request_views: bool = False,
+) -> bytes:
+    """One REDUCE window (batch frame + outer partition block) -> one
+    batch reply of ``count`` partition-indexed slices.
+
+    The reduce half of ISSUE 13: the node sums its window's item
+    replies elementwise (head summed whole, tails flat-concatenated —
+    :func:`..routing.partition.reduce_replies`) and answers the sum as
+    ``count`` partition-indexed items: item 0 is ``[head_sum,
+    slice_0]``, items 1.. are ``[slice_i]``, each stamped with its
+    partition block, the outer reply echoing the (server-completed)
+    request block.  A compute_fn carrying a ``.reduce`` attribute —
+    the mid-tier AGGREGATOR contract
+    (:func:`..routing.partition`-based tree lowering of ``fed_sum``) —
+    is handed the whole window and returns the already-summed
+    ``[head, *tails]``, so a tree node forwards ONE reduced child
+    window instead of computing items itself.
+
+    Failure is all-or-nothing and in-band: any item decode or compute
+    error fails the WHOLE window loudly (an outer error reply) —
+    summing around a failed item would be the silent partial sum the
+    loud-reassembly contract forbids."""
+    t_arrive = time.perf_counter()
+    try:
+        items, outer_uuid, _err, trace_id, _sp, part = decode_batch_part(
+            payload
+        )
+        assert part is not None  # dispatched on peek_partition
+        req_part = _partition.GradPartition(*part)
+    except WireError as e:
+        _node_metrics.ERRORS.labels(kind="decode").inc()
+        return encode_batch(
+            [], uuid=b"\0" * 16, error=f"decode error: {e}"
+        )
+    t_decoded = time.perf_counter()
+    _node_metrics.DECODE_S.observe(t_decoded - t_arrive)
+    with _spans.trace_context(trace_id), _spans.span(
+        "node.evaluate_reduce", wire="npwire", transport=transport,
+        n_items=len(items), count=req_part.count,
+    ) as root:
+        root.set_attr("decode_s", t_decoded - t_arrive)
+        if _fi.active_plan is not None:  # chaos seam: compute path
+            try:
+                _fi.compute_filter()
+            except _fi.FaultPlanError:
+                raise  # a plan-authoring bug stays LOUD, never in-band
+            except Exception as e:
+                return encode_batch([], uuid=outer_uuid, error=str(e))
+        try:
+            if not items:
+                raise _partition.PartitionError(
+                    "cannot reduce an empty window"
+                )
+            decoded = []
+            for item in items:
+                arrays, _uid, _e, _t = decode_arrays_ex(
+                    item, copy=not request_views
+                )
+                decoded.append(list(arrays))
+            reduce_fn = getattr(compute_fn, "reduce", None)
+            t_c0 = time.perf_counter()
+            _node_metrics.QUEUE_S.observe(max(0.0, t_c0 - t_decoded))
+            if reduce_fn is not None:
+                summed = [np.asarray(o) for o in reduce_fn(decoded)]
+            else:
+                outcomes = _execute_window_sync(
+                    compute_fn, getattr(compute_fn, "batch", None),
+                    decoded,
+                )
+                for res in outcomes:
+                    if isinstance(res, Exception):
+                        # All-or-nothing: a failed item fails the
+                        # whole reduction (no silent partial sum).
+                        raise res
+                summed = _partition.reduce_replies(outcomes)
+            _node_metrics.COMPUTE_S.observe(time.perf_counter() - t_c0)
+            t_e0 = time.perf_counter()
+            _layout, total, _dtype = _partition.tail_layout(summed)
+            if req_part.total and req_part.total != total:
+                raise _partition.PartitionError(
+                    f"partition total {req_part.total} != window tail "
+                    f"size {total} (driver/node shape disagreement)"
+                )
+            plan = _partition.plan_partitions(total, req_part.count)
+            flat = _partition.concat_tail(summed)
+            replies = []
+            for p in plan:
+                arrs = [flat[p.offset : p.offset + p.length]]
+                if p.index == 0:
+                    arrs.insert(0, np.asarray(summed[0]))
+                replies.append(
+                    encode_arrays(arrs, uuid=outer_uuid, partition=p)
+                )
+                _partition.PARTITION_SHARDS.labels(outcome="ok").inc()
+            if _fi.active_plan is not None:  # chaos seam: shard lane
+                # block_off: item frames carry flags=PARTITION only,
+                # so the partition block sits right after the fixed
+                # 26-byte npwire header.
+                replies = _fi.shard_filter(
+                    "partition.reply", replies, block_off=26
+                )
+            reply = encode_batch(
+                replies,
+                uuid=outer_uuid,
+                partition=_partition.GradPartition(
+                    0, req_part.count, 0, total, total
+                ),
+            )
+            _node_metrics.ENCODE_S.observe(time.perf_counter() - t_e0)
+        except _fi.FaultPlanError:
+            raise  # plan-authoring bug: LOUD, never in-band
+        except Exception as e:
+            if isinstance(e, _partition.PartitionError):
+                _partition.PARTITION_SHARDS.labels(
+                    outcome="error"
+                ).inc()
+            _node_metrics.ERRORS.labels(kind="compute").inc()
+            _flightrec.record(
+                "server.error", stage="reduce", wire="npwire",
+                transport=transport, error=str(e)[:200],
+            )
+            reply = encode_batch([], uuid=outer_uuid, error=str(e))
     if trace_id is not None and root.span is not None:
         reply = append_spans(reply, [root.span.to_dict()])
     return reply
@@ -1101,6 +1508,7 @@ def serve_npwire_payload(
     SLO engine's goodput objective, the gRPC lane's GetLoad posture,
     so an idle-but-probed fleet never pages on a goodput floor)."""
     batch = is_batch_frame(payload)
+    reduce_window = False
     if batch:
         # n_items sits at the fixed header offset (<4sBB16sI then
         # <I count) — the same cheap peek posture as peek_deadline.
@@ -1108,7 +1516,17 @@ def serve_npwire_payload(
             (n_items,) = struct.unpack_from("<I", payload, 22)
         except struct.error:
             n_items = None  # truncated: the full decoder rejects it
-        method = "probe" if n_items == 0 else "evaluate_batch"
+        try:
+            # An outer partition block marks a REDUCE window (the
+            # partial-reduction lane, routing/partition.py).
+            reduce_window = peek_partition(payload) is not None
+        except WireError:
+            reduce_window = False  # the full decoder rejects it below
+        method = (
+            "probe"
+            if n_items == 0
+            else ("evaluate_reduce" if reduce_window else "evaluate_batch")
+        )
     else:
         method = "evaluate"
     _node_metrics.REQUESTS.labels(method=method).inc()
@@ -1128,6 +1546,11 @@ def serve_npwire_payload(
             return encode_arrays([], uuid=uid, error=err)
         with _deadline.budget_scope(budget):
             if batch:
+                if reduce_window:
+                    return _serve_reduce_payload(
+                        compute_fn, payload, transport=transport,
+                        request_views=request_views,
+                    )
                 return _serve_batch_payload(
                     compute_fn, payload, transport=transport,
                     request_views=request_views,

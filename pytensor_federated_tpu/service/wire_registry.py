@@ -41,6 +41,9 @@ __all__ = [
     "NPWIRE_KNOWN_FLAGS",
     "NPPROTO_FIELDS",
     "NPPROTO_EXTENSION_FIELDS",
+    "PARTITION_STRUCT",
+    "PARTITION_FIELD_ORDER",
+    "NPPROTO_PARTITION_FIELDS",
     "SHMWIRE_KINDS",
     "SHMWIRE_FLAGS",
     "SHMWIRE_KNOWN_FLAGS",
@@ -58,6 +61,7 @@ NPWIRE_FLAGS = {
     "BATCH": 8,     # count field is n_items; body is nested frames
     "DEADLINE": 16,  # f64 remaining-budget block (service/deadline.py)
     "TENANT": 32,   # u16-len utf8 tenant id block (gateway/fairness.py)
+    "PARTITION": 64,  # gradient-partition index block (routing/partition.py)
 }
 
 #: The full known-flags mask every npwire decoder must enforce
@@ -87,6 +91,9 @@ NPPROTO_FIELDS = {
         "batch_items": 17,  # nested messages: the batch frame marker
         "deadline_s": 18,   # fixed64 double: remaining deadline budget
         "tenant_id": 19,    # utf8 string: per-tenant identity (gateway/)
+        "partition": 20,    # nested message: gradient-partition index
+                            # block (routing/partition.py; sub-fields in
+                            # NPPROTO_PARTITION_FIELDS)
     },
     "get_load_result": {
         "n_clients": 1,
@@ -135,6 +142,7 @@ SHMWIRE_FLAGS = {
     "TRACE": 2,     # 16-byte telemetry trace id block
     "DEADLINE": 4,  # f64 remaining-budget block (service/deadline.py)
     "TENANT": 8,    # u16-len utf8 tenant id block (gateway/fairness.py)
+    "PARTITION": 16,  # gradient-partition index block (routing/partition.py)
 }
 
 #: The full known-flags mask every shm decoder must enforce
@@ -156,6 +164,31 @@ del _bit
 #: wire-registry rule can pin the implementation's literals to them.
 SHM_DESC_STRUCT = "<QIQQ"
 SHM_DESC_FIELD_ORDER = ("slot", "delta", "length", "generation")
+
+#: The gradient-partition index block (ISSUE 13): one fixed-layout
+#: struct describing which contiguous slice of a flat gradient vector
+#: a frame carries (or requests).  ``index``/``count`` place the shard
+#: among its siblings; ``offset``/``length`` are the element range of
+#: the slice inside the flat vector; ``total`` is the flat vector's
+#: full element count — the cross-check that makes a driver/node shape
+#: disagreement a loud error instead of a silently mis-assembled
+#: gradient.  On npwire the block rides flag bit 64 after the tenant
+#: block; on the shm doorbell, flag bit 16 in the same position; on
+#: npproto it is extension field 20, a nested message whose sub-field
+#: numbers are :data:`NPPROTO_PARTITION_FIELDS` (a reference runtime
+#: skips the whole field by wire type).  ``routing/partition.py`` owns
+#: the semantics (slice/reduce rules, reassembly).
+PARTITION_STRUCT = "<IIQQQ"
+PARTITION_FIELD_ORDER = ("index", "count", "offset", "length", "total")
+
+#: Sub-field numbers of the npproto partition message (field 20).
+NPPROTO_PARTITION_FIELDS = {
+    "index": 1,
+    "count": 2,
+    "offset": 3,
+    "length": 4,
+    "total": 5,
+}
 
 #: GetLoad request payloads.  Both wire schemas define an EMPTY
 #: GetLoad request, so every non-empty payload is an in-repo extension

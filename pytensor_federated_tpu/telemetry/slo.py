@@ -64,7 +64,12 @@ _BURN = _metrics.gauge(
 #: artifacts and chart axes alike.
 _BURN_CAP = 1000.0
 
-_EVALUATE_METHODS = ("evaluate", "evaluate_stream", "evaluate_batch")
+_EVALUATE_METHODS = (
+    "evaluate",
+    "evaluate_stream",
+    "evaluate_batch",
+    "evaluate_reduce",
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -87,6 +92,13 @@ class Slo:
     requests_metric: str = "pftpu_server_requests_total"
     sheds_metric: str = "pftpu_admission_shed_total"
     errors_metric: str = "pftpu_server_errors_total"
+    #: The partition lane's shard-granular counters (ISSUE 13) —
+    #: ``{outcome=ok|error}`` items served into reduce windows and
+    #: reassemblies.  They refine the error clamp below: shard errors
+    #: are request-granular evidence, so a replica that answered few
+    #: (or zero) FRAMES but refused shards must not fold to zero
+    #: errors and read healthy.
+    partition_metric: str = "pftpu_partition_shards_total"
 
     def __post_init__(self) -> None:
         if (
@@ -199,6 +211,10 @@ class BurnRateEngine:
                 ),
                 "errors": _counter_total(m, self.slo.errors_metric),
                 "sheds": _counter_total(m, self.slo.sheds_metric),
+                "shards": _counter_total(m, self.slo.partition_metric),
+                "shard_errors": _counter_total(
+                    m, self.slo.partition_metric, "outcome", ("error",)
+                ),
                 "latency": _hist_flat(m, self.slo.latency_metric),
             }
         return {"ts": snapshot.ts, "replicas": per_replica}
@@ -256,6 +272,7 @@ class BurnRateEngine:
             return None
         elapsed = newest["ts"] - oldest["ts"]
         requests = errors = sheds = 0.0
+        shards = shard_errors = 0.0
         latency: _Hist = (0, {})
 
         def cdelta(new_v: float, old_v: float) -> float:
@@ -271,12 +288,37 @@ class BurnRateEngine:
                 continue  # appeared mid-window: no baseline yet
             req_d = cdelta(new["requests"], old["requests"])
             requests += req_d
+            # Partition lane (ISSUE 13): shard items are
+            # request-granular — clamp per-shard ERROR deltas at
+            # per-shard REQUEST deltas, mirroring the frame-level
+            # underflow clamp below at shard granularity (a shard
+            # cannot fail more than once for goodput purposes).
+            shard_d = cdelta(
+                new.get("shards", 0.0), old.get("shards", 0.0)
+            )
+            shard_err_d = min(
+                cdelta(
+                    new.get("shard_errors", 0.0),
+                    old.get("shard_errors", 0.0),
+                ),
+                shard_d,
+            )
+            shards += shard_d
+            shard_errors += shard_err_d
             # Errors count per ITEM on the batch lanes while requests
             # count frames — clamp per replica (a frame cannot fail
             # more than once for goodput purposes) so a corrupt batch
             # window can never underflow the fleet's goodput into a
-            # false all-bad page.
-            errors += min(cdelta(new["errors"], old["errors"]), req_d)
+            # false all-bad page.  The ceiling includes the SHARD
+            # error delta: a replica that answered zero (or few)
+            # counted frames while refusing partition shards used to
+            # fold its errors to zero and read HEALTHY — shard errors
+            # are request-granular evidence and keep it in the
+            # goodput's bad column.
+            errors += min(
+                cdelta(new["errors"], old["errors"]),
+                req_d + shard_err_d,
+            )
             sheds += cdelta(new["sheds"], old["sheds"])
             d = _hist_delta(new["latency"], old["latency"])
             merged_buckets = dict(latency[1])
@@ -288,6 +330,8 @@ class BurnRateEngine:
             "requests": requests,
             "errors": errors,
             "sheds": sheds,
+            "shards": shards,
+            "shard_errors": shard_errors,
             "latency": latency,
         }
 
@@ -337,6 +381,8 @@ class BurnRateEngine:
                 "requests": delta["requests"],
                 "sheds": delta["sheds"],
                 "errors": delta["errors"],
+                "shards": delta.get("shards", 0.0),
+                "shard_errors": delta.get("shard_errors", 0.0),
                 "elapsed_s": delta["elapsed_s"],
             }
             if burn is not None:

@@ -183,9 +183,10 @@ _FLAG_SPANS = 4
 _FLAG_BATCH = 8
 _FLAG_DEADLINE = 16
 _FLAG_TENANT = 32
+_FLAG_PARTITION = 64
 _KNOWN_FLAGS = (
     _FLAG_ERROR | _FLAG_TRACE | _FLAG_SPANS | _FLAG_BATCH
-    | _FLAG_DEADLINE | _FLAG_TENANT
+    | _FLAG_DEADLINE | _FLAG_TENANT | _FLAG_PARTITION
 )
 
 
@@ -193,11 +194,11 @@ def _check_flags(flags):
     pass
 
 
-def decode_arrays_all(buf):
+def decode_arrays_part(buf):
     _check_flags(0)
 
 
-def decode_batch(buf):
+def decode_batch_part(buf):
     _check_flags(0)
 """
 
@@ -211,9 +212,10 @@ constexpr uint8_t kFlagSpans = 4;
 constexpr uint8_t kFlagBatch = 8;
 constexpr uint8_t kFlagDeadline = 16;
 constexpr uint8_t kFlagTenant = 32;
+constexpr uint8_t kFlagPartition = 64;
 constexpr uint8_t kKnownFlags =
     kFlagError | kFlagTrace | kFlagSpans | kFlagBatch | kFlagDeadline |
-    kFlagTenant;
+    kFlagTenant | kFlagPartition;
 bool decode(const Buf& b) {
   if (flags & ~kKnownFlags) return false;
   return true;
@@ -247,7 +249,11 @@ _FLAG_ERROR = 1
 _FLAG_TRACE = 2
 _FLAG_DEADLINE = 4
 _FLAG_TENANT = 8
-_KNOWN_FLAGS = _FLAG_ERROR | _FLAG_TRACE | _FLAG_DEADLINE | _FLAG_TENANT
+_FLAG_PARTITION = 16
+_KNOWN_FLAGS = (
+    _FLAG_ERROR | _FLAG_TRACE | _FLAG_DEADLINE | _FLAG_TENANT
+    | _FLAG_PARTITION
+)
 _DESC_STRUCT = struct.Struct("<QIQQ")
 
 
@@ -294,7 +300,7 @@ class TestWireRegistry:
         src = NPWIRE_CLEAN.replace(
             "_KNOWN_FLAGS = (\n"
             "    _FLAG_ERROR | _FLAG_TRACE | _FLAG_SPANS | _FLAG_BATCH\n"
-            "    | _FLAG_DEADLINE | _FLAG_TENANT\n)",
+            "    | _FLAG_DEADLINE | _FLAG_TENANT | _FLAG_PARTITION\n)",
             "",
         )
         findings = run_on(tmp_path, {NPWIRE_REL: src}, ["wire-registry"])
@@ -302,12 +308,12 @@ class TestWireRegistry:
 
     def test_unguarded_decoder_flagged(self, tmp_path):
         src = NPWIRE_CLEAN.replace(
-            "def decode_batch(buf):\n    _check_flags(0)",
-            "def decode_batch(buf):\n    return buf",
+            "def decode_batch_part(buf):\n    _check_flags(0)",
+            "def decode_batch_part(buf):\n    return buf",
         )
         findings = run_on(tmp_path, {NPWIRE_REL: src}, ["wire-registry"])
         assert any(
-            "decode_batch" in f.message and "reject" in f.message
+            "decode_batch_part" in f.message and "reject" in f.message
             for f in findings
         )
 

@@ -135,6 +135,47 @@ class TestCppNode:
             np.testing.assert_allclose(float(out_i[0]), want_i, rtol=1e-12)
         client.close()
 
+    def test_partition_sliced_reply(self, cpp_node):
+        """The partition block (npwire flag 64, ISSUE 13): the native
+        node serves the head/tail SLICED reply — [logp, slice of the
+        flat (g_a, g_b) tail] with the block echoed — and refuses a
+        geometry disagreement in-band, loudly."""
+        from pytensor_federated_tpu.routing.partition import (
+            GradPartition,
+            Reassembler,
+            plan_partitions,
+        )
+        from pytensor_federated_tpu.service import TcpArraysClient
+        from pytensor_federated_tpu.service.tcp import RemoteComputeError
+
+        rng = np.random.default_rng(3)
+        x = rng.normal(size=64)
+        y = 0.3 + 1.1 * x
+        args = (np.float64(0.3), np.float64(1.1), np.float64(0.8), x, y)
+        client = TcpArraysClient("127.0.0.1", cpp_node)
+        full = client.evaluate(*args)
+        # The tail = (g_a, g_b): 2 scalars, flat total 2.
+        re = Reassembler(2, 2)
+        for part in plan_partitions(2, 2):
+            head, sl = client.evaluate(*args, partition=part)
+            np.testing.assert_allclose(float(head), float(full[0]))
+            re.add(part, np.asarray(sl))
+        flat = re.result()
+        np.testing.assert_allclose(flat[0], float(full[1]), rtol=1e-12)
+        np.testing.assert_allclose(flat[1], float(full[2]), rtol=1e-12)
+        # Geometry disagreement: loud in-band error, connection lives.
+        with pytest.raises(RemoteComputeError, match="partition total"):
+            client.evaluate(*args, partition=GradPartition(0, 1, 0, 9, 9))
+        out = client.evaluate(*args)
+        np.testing.assert_allclose(float(out[0]), float(full[0]))
+        # A reduce window (outer partition on a batch frame) is
+        # refused loudly — the native node serves slices only.
+        with pytest.raises(RemoteComputeError, match="not supported"):
+            client.evaluate_reduced(
+                [args, args], window=2, slices=1, total=2
+            )
+        client.close()
+
     def test_many_lockstep_calls_one_connection(self, cpp_node):
         from pytensor_federated_tpu.service import TcpArraysClient
 
@@ -636,7 +677,7 @@ def test_unknown_flag_bits_rejected_loudly(cpp_node):
     frame = bytearray(
         encode_arrays([np.zeros(3, np.float64)])
     )
-    frame[_FLAGS_OFF] |= 0x40  # undeclared bit 64 (32 is TENANT now)
+    frame[_FLAGS_OFF] |= 0x80  # undeclared bit 128 (64 is PARTITION now)
     with socket_mod.create_connection(("127.0.0.1", cpp_node), 5) as s:
         s.sendall(struct_mod.pack("<I", len(frame)) + bytes(frame))
         s.settimeout(5)

@@ -101,12 +101,12 @@ def test_unknown_flag_bits_rejected():
     )
 
     enc = bytearray(encode_arrays([np.zeros(3, np.float32)]))
-    enc[_FLAGS_OFF] |= 0x40  # undeclared bit 64 (32 = TENANT, ISSUE 12)
+    enc[_FLAGS_OFF] |= 0x80  # undeclared bit 128 (64 = PARTITION, ISSUE 13)
     with pytest.raises(WireError, match="unknown flag bits"):
         decode_arrays(bytes(enc))
 
     batch = bytearray(encode_batch([encode_arrays([np.ones(2)])]))
-    batch[_FLAGS_OFF] |= 0x40  # undeclared bit 64 (batch bit stays set)
+    batch[_FLAGS_OFF] |= 0x80  # undeclared bit 128 (batch bit stays set)
     with pytest.raises(WireError, match="unknown flag bits"):
         decode_batch(bytes(batch))
 

@@ -1444,6 +1444,284 @@ def run_gateway_seed(seed: int, verbose: bool) -> dict:
     return result
 
 
+# -- the shard lane (ISSUE 13) ----------------------------------------------
+
+
+def _serve_mid_node(port: int, leaf_ports) -> None:
+    """One MID-TIER aggregator of the tree: serves TCP, forwards
+    reduce windows to its leaf pool (`make_aggregator_compute`).  A
+    PFTPU_FAULT_PLAN inherited from the parent env was activated at
+    package import — the shard fault kinds fire at this node's
+    ``partition.reply`` seam, and kill_process models a mid-tier dying
+    DURING tree aggregation."""
+    import logging
+
+    logging.disable(logging.ERROR)
+
+    from pytensor_federated_tpu.routing import (
+        NodePool,
+        PooledArraysClient,
+        make_aggregator_compute,
+    )
+    from pytensor_federated_tpu.service.tcp import serve_tcp_once
+
+    pool = NodePool(
+        [("127.0.0.1", p) for p in leaf_ports], transport="tcp"
+    )
+    child = PooledArraysClient(pool)
+    serve_tcp_once(
+        make_aggregator_compute(child, window=8),
+        "127.0.0.1",
+        port,
+        concurrent=True,
+    )
+
+
+def _spawn_mid(port: int, leaf_ports, plan_json=None):
+    saved = os.environ.get(fi.runtime.ENV_VAR)
+    if plan_json is not None:
+        os.environ[fi.runtime.ENV_VAR] = plan_json
+    else:
+        os.environ.pop(fi.runtime.ENV_VAR, None)
+    try:
+        ctx = mp.get_context("spawn")
+        proc = ctx.Process(
+            target=_serve_mid_node, args=(port, list(leaf_ports)),
+            daemon=True,
+        )
+        proc.start()
+    finally:
+        if saved is None:
+            os.environ.pop(fi.runtime.ENV_VAR, None)
+        else:
+            os.environ[fi.runtime.ENV_VAR] = saved
+    return proc
+
+
+def _shard_mid_templates():
+    """Node-side rules for the victim MID-TIER: the three shard kinds
+    at its partition.reply seam, compute faults, and SIGKILL during
+    tree aggregation."""
+    return [
+        ("drop_shard", dict(point="partition.reply", max_fires=1)),
+        ("dup_shard", dict(point="partition.reply", max_fires=1)),
+        ("corrupt_shard", dict(point="partition.reply", max_fires=1)),
+        ("compute_error", dict(point="server.compute", max_fires=1)),
+        ("kill_process", dict(point="server.compute", max_fires=1)),
+        ("disconnect", dict(point="tcp.send", max_fires=1)),
+    ]
+
+
+async def _run_shard_async(seed, mids, mid_ports, leaf_ports, log):
+    """Reduce-scatter tree under chaos.  Invariants:
+
+    S1 correctness — every ``evaluate_reduced`` either returns the
+       EXACT known sums (head and every flat element checked) or
+       raises a loud, classified error — never a silently-wrong or
+       partial gradient (the loud-reassembly contract);
+    S2 no hang — every call settles within CALL_DEADLINE_S, a
+       SIGKILLed mid-tier included;
+    S3 reconverge — once faults stop and the dead mid-tier is
+       respawned, breakers close and a clean reduce returns the exact
+       sums through the full tree.
+    """
+    from pytensor_federated_tpu.routing import NodePool, PooledArraysClient
+
+    pool = NodePool(
+        [("127.0.0.1", p) for p in mid_ports],
+        transport="tcp",
+        breaker_kwargs=dict(
+            failure_threshold=2, backoff_s=0.2, jitter_frac=0.1
+        ),
+        probe_timeout_s=2.0,
+    )
+    client = PooledArraysClient(pool)
+    n_loud = 0
+
+    n_requests = 12
+    reqs = [
+        (np.array([float(i), 5.0], np.float64),) for i in range(n_requests)
+    ]
+    want_head = sum(_expected(float(i)) for i in range(n_requests))
+    want_flat = np.sum(
+        [-2.0 * (np.array([float(i), 5.0]) - 3.0) for i in range(n_requests)],
+        axis=0,
+    )
+
+    async def deadline(coro):
+        return await asyncio.wait_for(coro, timeout=CALL_DEADLINE_S)
+
+    def check(out, where):
+        if out is None:
+            raise Violation(f"{where}: silently unreplied reduce")
+        head, flat = out
+        if not np.isclose(float(np.asarray(head)), want_head, rtol=1e-9):
+            raise Violation(
+                f"{where}: head {float(np.asarray(head))} != "
+                f"{want_head} (SILENTLY WRONG GRADIENT)"
+            )
+        if not np.allclose(np.asarray(flat), want_flat, rtol=1e-9):
+            raise Violation(
+                f"{where}: flat gradient mismatch (SILENTLY WRONG "
+                "GRADIENT)"
+            )
+
+    try:
+        # Phase A: reduce windows through the tree, chaos live.
+        for w in range(10):
+            try:
+                out = await deadline(
+                    client.evaluate_reduced_async(
+                        reqs, window=8, slices=(w % 3) + 1, total=2
+                    )
+                )
+            except asyncio.TimeoutError:
+                raise Violation(f"reduce {w}: hang past {CALL_DEADLINE_S}s")
+            except Exception as e:
+                if not _is_loud(e):
+                    raise Violation(
+                        f"reduce {w}: UNCLASSIFIED error escaped "
+                        f"({type(e).__name__}: {str(e)[:200]})"
+                    )
+                n_loud += 1
+                log(f"  reduce {w}: loud ({type(e).__name__}: "
+                    f"{str(e)[:80]})")
+            else:
+                check(out, f"reduce {w}")
+
+        # Phase B: faults stop -> respawn dead/victim mid-tiers, then
+        # the tree must serve a clean, exact reduce.
+        fi.uninstall()
+        for k, proc in enumerate(mids):
+            if not proc.is_alive():
+                log(f"  mid-tier {k} died (kill_process?): respawning")
+                mids[k] = _spawn_mid(mid_ports[k], leaf_ports, None)
+        await _wait_nodes_up_async("tcp", mid_ports)
+        deadline_t = time.time() + 30.0
+        while time.time() < deadline_t:
+            await pool.probe_once_async()
+            if all(r.breaker.state == "closed" for r in pool.replicas):
+                break
+            await asyncio.sleep(0.1)
+        bad = [
+            (r.address, r.breaker.state)
+            for r in pool.replicas
+            if r.breaker.state != "closed"
+        ]
+        if bad:
+            raise Violation(
+                f"breakers never reconverged after faults stopped: {bad}"
+            )
+        out = await deadline(
+            client.evaluate_reduced_async(reqs, window=8, slices=2, total=2)
+        )
+        check(out, "clean reduce")
+    finally:
+        fi.uninstall()
+        pool.close()
+    return n_loud
+
+
+def run_shard_seed(seed: int, verbose: bool) -> dict:
+    """One shard-lane scenario (``--lane shard``): a 2x2 aggregation
+    tree (4 leaf nodes, 2 mid-tiers, driver pool over the mid-tiers)
+    serving reduce-scatter windows while one mid-tier runs a seeded
+    plan of shard faults (dropped/duplicated/corrupt slices, compute
+    errors, SIGKILL mid-aggregation) and the driver runs byte-lane
+    faults; same result-dict shape as the transport lanes."""
+
+    def log(msg):
+        if verbose:
+            print(msg, flush=True)
+
+    rng = random.Random(seed)
+    # Driver-side byte faults on the mid-tier links.
+    driver_rules = []
+    for kind, kw in rng.sample(
+        [
+            ("delay", dict(point="tcp.send", delay_s=0.02, max_fires=2)),
+            ("disconnect", dict(point="tcp.send", max_fires=1)),
+            ("corrupt_bytes", dict(point="tcp.recv", max_fires=1)),
+            ("drop", dict(point="pool.probe", max_fires=2)),
+        ],
+        rng.randint(1, 2),
+    ):
+        driver_rules.append(fi.FaultRule(kind, **dict(kw)))
+    driver_plan = fi.FaultPlan(
+        driver_rules, seed=seed, plan_id=f"shard-{seed}-driver"
+    )
+    # Node-side shard faults on ONE victim mid-tier.
+    node_rules = []
+    for kind, kw in rng.sample(_shard_mid_templates(), rng.randint(1, 3)):
+        kw = dict(kw)
+        if rng.random() < 0.6:
+            kw["nth"] = rng.randint(1, 6)
+            kw.pop("max_fires", None)
+        node_rules.append(fi.FaultRule(kind, **kw))
+    node_plan_json = fi.FaultPlan(
+        node_rules, seed=seed, plan_id=f"shard-{seed}-mid"
+    ).to_json()
+
+    log(
+        f"seed {seed}: driver {[r.to_dict() for r in driver_plan.rules]}, "
+        f"mid {[r.to_dict() for r in node_rules]}"
+    )
+    tspans.set_enabled(True)
+    flightrec.set_enabled(True)
+    if flightrec.capacity() < 16384:
+        flightrec.set_capacity(16384)
+    telemetry.clear_traces()
+    flightrec.clear()
+    reunion.clear()
+
+    leaf_ports = _free_ports(4)
+    mid_ports = _free_ports(2)
+    victim = rng.randrange(2)
+    leaves = [_spawn_node("tcp", p, None) for p in leaf_ports]
+    result = {"seed": seed, "transport": "shard", "ok": True}
+    mids = []
+    try:
+        _wait_nodes_up("tcp", leaf_ports)
+        mids = [
+            _spawn_mid(
+                p,
+                leaf_ports[2 * k : 2 * k + 2],
+                node_plan_json if k == victim else None,
+            )
+            for k, p in enumerate(mid_ports)
+        ]
+        _wait_nodes_up("tcp", mid_ports)
+        fi.install(driver_plan)
+        n_loud = asyncio.run(
+            _run_shard_async(seed, mids, mid_ports, leaf_ports, log)
+        )
+        result["loud_errors"] = n_loud
+        result["faults_fired"] = driver_plan.total_fires
+    except Violation as v:
+        bundle = write_incident_bundle(
+            reason=f"chaos-shard-seed-{seed}", note=str(v)
+        )
+        result.update(ok=False, error=str(v), bundle=bundle)
+    except Exception as e:  # harness bug: loud, with a bundle
+        bundle = write_incident_bundle(
+            reason=f"chaos-shard-seed-{seed}-harness",
+            note=f"{type(e).__name__}: {e}",
+        )
+        result.update(
+            ok=False,
+            error=f"harness: {type(e).__name__}: {e}",
+            bundle=bundle,
+        )
+    finally:
+        fi.uninstall()
+        for proc in list(mids) + leaves:
+            if proc.is_alive():
+                proc.terminate()
+        for proc in list(mids) + leaves:
+            proc.join(timeout=10)
+    return result
+
+
 def run_seed(seed: int, transport: str, verbose: bool) -> dict:
     """One full chaos scenario; returns a result dict, raising nothing —
     violations land in the dict with an incident-bundle path."""
@@ -1544,7 +1822,7 @@ def main(argv=None) -> int:
     ap.add_argument("--base-seed", type=int, default=0)
     ap.add_argument("--transport", "--lane", dest="transport",
                     choices=("grpc", "tcp", "shm", "overload",
-                             "collector", "gateway"),
+                             "collector", "gateway", "shard"),
                     default="grpc",
                     help="transport lane under chaos (--lane is an "
                     "alias; 'shm' runs the zero-copy arena lane; "
@@ -1557,7 +1835,12 @@ def main(argv=None) -> int:
                     "scenario: 1k downstream clients through the "
                     "front door, one hog tenant, a flapping replica — "
                     "fairness floors, tenant-labeled denials, zero "
-                    "hangs, autoscaler convergence)")
+                    "hangs, autoscaler convergence; 'shard' runs the "
+                    "ISSUE-13 scenario: reduce-scatter windows over a "
+                    "2x2 aggregation tree, one mid-tier dropping/"
+                    "duplicating/corrupting shard slices and dying "
+                    "mid-aggregation — loud reassembly, zero hangs, "
+                    "no silently-wrong gradients)")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
 
@@ -1575,6 +1858,8 @@ def main(argv=None) -> int:
             res = run_collector_seed(seed, args.verbose)
         elif args.transport == "gateway":
             res = run_gateway_seed(seed, args.verbose)
+        elif args.transport == "shard":
+            res = run_shard_seed(seed, args.verbose)
         else:
             res = run_seed(seed, args.transport, args.verbose)
         status = "ok" if res["ok"] else "FAIL"

@@ -61,6 +61,8 @@ MODULES = [
     # Retry budgets (ISSUE 10): the token bucket every amplifying
     # recovery path spends from.
     "pytensor_federated_tpu.routing.budget",
+    # Gradient sharding on the wire (ISSUE 13).
+    "pytensor_federated_tpu.routing.partition",
     "pytensor_federated_tpu.telemetry",
     # Incident subsystem (ISSUE 2): flat functional surfaces, so each
     # module's __all__ is documented directly rather than only the
