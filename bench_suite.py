@@ -356,6 +356,45 @@ def _bench_serve_partition_mid(port, leaf_ports):
     )
 
 
+def _free_ports(n):
+    """Reserve-then-release n ephemeral localhost ports (the shared
+    bind/close pattern configs 19/20 and tools/chaos_run.py use)."""
+    import socket as _socket
+
+    socks, ports = [], []
+    for _ in range(n):
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _bench_serve_ppl_node(port):
+    """Config 20's replica: the ppl-compiled radon per-shard
+    ``[logp, *grads]`` compute (ISSUE 15) — built from the SAME model
+    definition the driver compiles (``ppl.radon``), so driver and
+    node cannot drift."""
+    import logging
+
+    logging.basicConfig(level=logging.WARNING)
+    from pytensor_federated_tpu.utils import force_cpu_backend
+
+    force_cpu_backend()
+
+    from pytensor_federated_tpu import ppl
+    from pytensor_federated_tpu.ppl.radon import make_radon_example
+    from pytensor_federated_tpu.service.tcp import serve_tcp_once
+
+    model, args, _ = make_radon_example(16, seed=12)
+    compiled = ppl.compile(model, args)
+    serve_tcp_once(
+        compiled.node_compute(), "127.0.0.1", port, concurrent=True
+    )
+
+
 def _bench_serve_shm_node(port, use_suffstats):
     """Config 15's shm node: the C++ node's EXACT Gaussian linreg
     logp+grad contract ``(a, b, sigma, x, y) -> [logp, g_a, g_b]`` in
@@ -2805,16 +2844,7 @@ def main():
             WIRE_BYTES_COPIED,
         )
 
-        def free_ports(n):
-            socks, ports = [], []
-            for _ in range(n):
-                s = _socket.socket()
-                s.bind(("127.0.0.1", 0))
-                socks.append(s)
-                ports.append(s.getsockname()[1])
-            for s in socks:
-                s.close()
-            return ports
+        free_ports = _free_ports
 
         P, n_reqs = 4096, 64
         rng = np.random.default_rng(19)
@@ -3013,6 +3043,298 @@ def main():
                 p.join(timeout=10)
 
     guard("gradient sharding reduce-scatter", _c19)
+
+    # 20. The ppl front end (ISSUE 15): ONE effectful radon-GLM model
+    # definition run in four modes — NUTS, parallel tempering, batch
+    # SVI, and streaming SVI through the gateway.  Part A measures
+    # posterior-quality-vs-wall-clock of batch SVI against a same-run
+    # NUTS reference (quality = RMSE of posterior means over the
+    # global parameters); part B sustains streaming SVI through the
+    # PR-12 gateway under the PR-10 deadline regime and holds a
+    # goodput floor.  Acceptance: SVI posterior-mean RMSE vs NUTS
+    # <= 0.35 at a wall-clock speedup > 1, streaming goodput >= 0.9
+    # with optimizer steps == accepted batches (no double-counted
+    # gradient).  Artifact: tools/suite_cpu_r15_ppl.jsonl.
+    def _c20():
+        import multiprocessing as mp
+        import socket as _socket
+        import time as _time
+
+        from pytensor_federated_tpu import fed, ppl
+        from pytensor_federated_tpu.gateway import (
+            GatewayThread,
+            TenantFairness,
+        )
+        from pytensor_federated_tpu.ppl.radon import make_radon_example
+        from pytensor_federated_tpu.routing import NodePool
+        from pytensor_federated_tpu.samplers import sample as mcmc_sample
+        from pytensor_federated_tpu.samplers.tempering import pt_sample
+        from pytensor_federated_tpu.service.tcp import TcpArraysClient
+
+        artifact_lines = []
+        artifact_path = "tools/suite_cpu_r15_ppl.jsonl"
+
+        def flush_artifact():
+            # Incremental + atomic, like record(): a streaming-phase
+            # failure must not discard part A's completed measurements.
+            tmp = artifact_path + ".tmp"
+            with open(tmp, "w") as f:
+                for line in artifact_lines:
+                    f.write(json.dumps(line) + "\n")
+            os.replace(tmp, artifact_path)
+
+        model, margs, true = make_radon_example(16, seed=12)
+        compiled = ppl.compile(model, margs)
+        init = compiled.init_params()
+        globals_ = ["mu_alpha", "beta", "log_sigma", "log_sigma_alpha"]
+
+        def posterior_means(samples):
+            return {
+                k: float(jnp.mean(samples[k])) for k in globals_
+            }
+
+        def rmse(a, b):
+            return float(
+                np.sqrt(
+                    np.mean(
+                        [(a[k] - b[k]) ** 2 for k in globals_]
+                    )
+                )
+            )
+
+        # -- mode 1: NUTS (the exact reference) --------------------
+        t0 = _time.perf_counter()
+        nuts = mcmc_sample(
+            compiled.logp,
+            init,
+            key=jax.random.PRNGKey(0),
+            num_warmup=300,
+            num_samples=300,
+            num_chains=2,
+        )
+        jax.block_until_ready(nuts.samples)
+        nuts_wall = _time.perf_counter() - t0
+        nuts_means = posterior_means(nuts.samples)
+
+        # -- mode 2: parallel tempering ----------------------------
+        t0 = _time.perf_counter()
+        pt = pt_sample(
+            compiled.logp,
+            init,
+            key=jax.random.PRNGKey(1),
+            num_warmup=150,
+            num_samples=150,
+            num_temps=4,
+        )
+        jax.block_until_ready(pt.samples)
+        pt_wall = _time.perf_counter() - t0
+        pt_rmse = rmse(posterior_means(pt.samples), nuts_means)
+
+        # -- mode 3: batch SVI -------------------------------------
+        t0 = _time.perf_counter()
+        svi_res, _unravel = ppl.svi_fit(
+            compiled,
+            key=jax.random.PRNGKey(2),
+            num_steps=1000,
+            n_mc=8,
+            learning_rate=2e-2,
+        )
+        jax.block_until_ready(svi_res.flat_mean)
+        svi_wall = _time.perf_counter() - t0
+        svi_means = {
+            k: float(svi_res.mean[k]) for k in globals_
+        }
+        svi_rmse = rmse(svi_means, nuts_means)
+        svi_speedup = nuts_wall / svi_wall
+        assert float(svi_res.elbo_trace[-1]) > float(
+            svi_res.elbo_trace[0]
+        ), "batch SVI never improved its ELBO"
+        assert svi_rmse <= 0.35, (
+            f"batch SVI posterior drifted: RMSE {svi_rmse:.3f} vs "
+            "NUTS means"
+        )
+        assert svi_speedup > 1.0, (
+            f"batch SVI slower than NUTS ({svi_speedup:.2f}x) — the "
+            "quality-vs-wall-clock acceptance line no longer holds"
+        )
+        print(
+            f"# ppl modes: NUTS {nuts_wall:.1f}s, tempering "
+            f"{pt_wall:.1f}s (rmse {pt_rmse:.3f}), batch SVI "
+            f"{svi_wall:.1f}s (rmse {svi_rmse:.3f}, "
+            f"{svi_speedup:.1f}x NUTS wall)",
+            file=sys.stderr,
+        )
+        artifact_lines.append(
+            {
+                "lane": "ppl-batch-modes",
+                "nuts_wall_s": round(nuts_wall, 2),
+                "tempering_wall_s": round(pt_wall, 2),
+                "svi_wall_s": round(svi_wall, 2),
+                "svi_speedup_vs_nuts": round(svi_speedup, 2),
+                "svi_rmse_vs_nuts": round(svi_rmse, 4),
+                "tempering_rmse_vs_nuts": round(pt_rmse, 4),
+                "nuts_means": {
+                    k: round(v, 4) for k, v in nuts_means.items()
+                },
+                "svi_means": {
+                    k: round(v, 4) for k, v in svi_means.items()
+                },
+            }
+        )
+        flush_artifact()
+
+        # -- mode 4: streaming SVI through the gateway -------------
+        ctx = mp.get_context("spawn")
+        ports = _free_ports(2)
+        procs = [
+            ctx.Process(
+                target=_bench_serve_ppl_node, args=(p,), daemon=True
+            )
+            for p in ports
+        ]
+        pool = None
+        gw = None
+        cli = None
+        try:
+            for p in procs:
+                p.start()
+            deadline = _time.time() + 120
+            pending = set(ports)
+            while pending and _time.time() < deadline:
+                for p in list(pending):
+                    try:
+                        with _socket.create_connection(
+                            ("127.0.0.1", p), timeout=1.0
+                        ):
+                            pending.discard(p)
+                    except OSError:
+                        _time.sleep(0.2)
+            if pending:
+                raise RuntimeError(f"ppl nodes never listened: {pending}")
+            pool = NodePool(
+                [("127.0.0.1", p) for p in ports], transport="tcp"
+            )
+            pool.start()
+            gw = GatewayThread(
+                pool, fairness=TenantFairness(), frame_items=16
+            )
+            gw.start()
+            cli = TcpArraysClient("127.0.0.1", gw.port, tenant="svi")
+            pc = ppl.compile(
+                model,
+                margs,
+                placement=fed.PoolPlacement(cli, window=8, tag="svi"),
+            )
+            svi = ppl.StreamingSVI(
+                pc,
+                key=jax.random.PRNGKey(3),
+                n_mc=2,
+                learning_rate=5e-2,
+                deadline_s=None,
+            )
+            rng = np.random.default_rng(20)
+
+            def batch():
+                return rng.choice(16, size=8, replace=False)
+
+            # warm the driver trace + both node jit caches, then
+            # derive the step deadline from measured warm latency.
+            walls = []
+            for _ in range(4):
+                t0 = _time.perf_counter()
+                svi.step(batch())
+                walls.append(_time.perf_counter() - t0)
+            step_median = sorted(walls)[len(walls) // 2]
+            svi.deadline_s = max(1.0, 6.0 * step_median)
+            base_offered, base_accepted = svi.offered, svi.accepted
+            n_batches = 60
+            t0 = _time.perf_counter()
+            for _ in range(n_batches):
+                svi.step(batch())
+            stream_wall = _time.perf_counter() - t0
+            offered = svi.offered - base_offered
+            accepted = svi.accepted - base_accepted
+            goodput = accepted / offered
+            steps_per_s = accepted / stream_wall
+            assert svi.opt_steps == svi.accepted, (
+                f"double-count: opt_steps {svi.opt_steps} != "
+                f"accepted {svi.accepted}"
+            )
+            assert goodput >= 0.9, (
+                f"streaming goodput {goodput:.2f} under the 0.9 "
+                f"floor (deadline {svi.deadline_s:.2f}s)"
+            )
+            third = max(1, len(svi.elbo_trace) // 3)
+            assert np.mean(svi.elbo_trace[-third:]) > np.mean(
+                svi.elbo_trace[:third]
+            ), "streaming ELBO never improved"
+            print(
+                f"# ppl streaming: {steps_per_s:.2f} accepted "
+                f"steps/s, goodput {goodput:.2f}, deadline "
+                f"{svi.deadline_s:.2f}s, elbo "
+                f"{svi.elbo_trace[0]:.1f} -> {svi.elbo_trace[-1]:.1f}",
+                file=sys.stderr,
+            )
+            artifact_lines.append(
+                {
+                    "lane": "ppl-streaming-gateway",
+                    "steps_per_s": round(steps_per_s, 2),
+                    "goodput": round(goodput, 3),
+                    "offered": offered,
+                    "accepted": accepted,
+                    "skipped": dict(svi.skipped),
+                    "deadline_s": round(svi.deadline_s, 2),
+                    "opt_steps": svi.opt_steps,
+                    "elbo_first": round(float(svi.elbo_trace[0]), 2),
+                    "elbo_last": round(float(svi.elbo_trace[-1]), 2),
+                }
+            )
+            flush_artifact()
+            record(
+                "ppl one-model-four-modes (radon: NUTS/tempering/"
+                "batch-SVI + streaming SVI via gateway)",
+                steps_per_s,
+                unit="accepted steps/s",
+                baseline_rate=None,
+                baseline_desc=(
+                    "same-run NUTS wall clock (svi_speedup_vs_nuts) "
+                    "and the 0.9 streaming goodput floor"
+                ),
+                nuts_wall_s=round(nuts_wall, 2),
+                tempering_wall_s=round(pt_wall, 2),
+                svi_wall_s=round(svi_wall, 2),
+                svi_speedup_vs_nuts=round(svi_speedup, 2),
+                svi_rmse_vs_nuts=round(svi_rmse, 4),
+                tempering_rmse_vs_nuts=round(pt_rmse, 4),
+                streaming_goodput=round(goodput, 3),
+                streaming_opt_steps=svi.opt_steps,
+                streaming_deadline_s=round(svi.deadline_s, 2),
+                note=(
+                    "ONE effectful model (ppl.radon) in four modes; "
+                    "quality = posterior-mean RMSE over the global "
+                    "params vs same-run NUTS (acceptance <= 0.35); "
+                    "streaming rides 2 tcp nodes through the gateway "
+                    "under a measured-latency-derived deadline "
+                    "(goodput floor 0.9, optimizer steps == accepted "
+                    "batches); artifact tools/suite_cpu_r15_ppl.jsonl"
+                ),
+            )
+        finally:
+            if cli is not None:
+                try:
+                    cli.close()
+                except Exception:
+                    pass
+            if gw is not None:
+                gw.stop()
+            if pool is not None:
+                pool.close()
+            for p in procs:
+                p.terminate()
+            for p in procs:
+                p.join(timeout=10)
+
+    guard("ppl one-model-four-modes", _c20)
 
     if results:
         print(

@@ -41,6 +41,7 @@ from .parallel import (
 )
 from . import diagnostics
 from . import fed
+from . import ppl
 from . import precision
 from .checkpoint import load_pytree, sample_checkpointed, save_pytree
 from .diagnostics import instrument_logp, profile_trace
@@ -82,6 +83,7 @@ __all__ = [
     "pack_shards",
     "parallel_host_call",
     "pdot",
+    "ppl",
     "precision",
     "profile_trace",
     "sample_checkpointed",

@@ -88,7 +88,66 @@ def _two_potential_window() -> FixtureProgram:
     )
 
 
+def _ppl_example() -> Any:
+    """One tiny effectful model compiled placement-free: a global
+    latent plus a plate-local latent over 4 shards — the exact
+    program shape the ``ppl`` compiler (ISSUE 15) emits for pool
+    lanes."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..ppl import compile as ppl_compile
+    from ..ppl import plate, sample, subsample
+    from ..ppl.distributions import Normal
+
+    data = jnp.asarray(np.arange(12.0, dtype=np.float32).reshape(4, 3))
+
+    def model(x: Any) -> None:
+        w = sample("w", Normal(0.0, 1.0))
+        with plate("shards", 4) as sh:
+            b = sample("b", Normal(0.0, 1.0))
+            xs = subsample(x, sh)
+            sample("obs", Normal(w + b[:, None], 1.0), obs=xs)
+
+    return ppl_compile(model, (data,))
+
+
+def _ppl_plate_round() -> FixtureProgram:
+    """The ppl full-data lowering: ``prior + fed_sum(fed_map(per_shard,
+    shard_ids))`` — parameters broadcast whole (mapped operands), the
+    shard id rides as an integer data leaf, data bakes into the
+    per-shard closure as a trace-time constant.  Nothing
+    driver-varying may hide in the closure or the pool lane refuses
+    the program the compiler emitted."""
+    import jax.numpy as jnp
+
+    from jax import tree_util
+
+    compiled = _ppl_example()
+    leaves = tree_util.tree_leaves(compiled.init_params())
+    return compiled.fed_model, tuple(jnp.asarray(l) for l in leaves)
+
+
+def _ppl_subsample_round() -> FixtureProgram:
+    """The ppl minibatch/streaming lowering: the same round mapped
+    over an index BATCH (a program input) with the unbiased
+    ``size/batch`` scaling — the shape every streaming-SVI step ships
+    to the pool through the gateway."""
+    import jax.numpy as jnp
+
+    from jax import tree_util
+
+    compiled = _ppl_example()
+    leaves = tree_util.tree_leaves(compiled.init_params())
+    idx = jnp.asarray([0, 2], jnp.int32)
+    return compiled.fed_batch_model(2), tuple(
+        jnp.asarray(l) for l in leaves
+    ) + (idx,)
+
+
 FIXTURES: Sequence[LintFixture] = (
     LintFixture(name="canonical-round", build=_canonical_round),
     LintFixture(name="two-potential-window", build=_two_potential_window),
+    LintFixture(name="ppl-plate-round", build=_ppl_plate_round),
+    LintFixture(name="ppl-subsample-round", build=_ppl_subsample_round),
 )

@@ -46,7 +46,39 @@ from .primitives import (
     is_tracer as _is_tracer,
 )
 
-__all__ = ["FederatedLogpGrad", "program"]
+__all__ = ["FederatedLogpGrad", "canonical_round", "program"]
+
+
+def canonical_round(
+    per_shard_fn: Callable,
+    data: Any,
+    n_shards: int,
+) -> Callable:
+    """The canonical broadcast→map→sum round as a placement-free fed
+    model: ``round(*params) = fed_sum(fed_map(per_shard_fn, (params
+    broadcast to every shard, data)))``.
+
+    ``per_shard_fn(*params, shard_data)`` is the per-shard term;
+    ``data`` is the stacked shard pytree (a concrete pytree bakes into
+    the trace, which pool lanes accept — the node's deployed copy of
+    the function carries the same data).  Parameters reach the shards
+    through ``fed_broadcast``, which makes them MAPPED operands: the
+    shape every pool deployment must follow (closure capture of
+    driver-varying values is refused at lowering), and the shape that
+    keeps the PR-13 reduced-window lowering eligible.  This is the
+    single implementation behind :class:`FederatedLogpGrad` and the
+    ``ppl`` compiler's plate lowering (ISSUE 15), so the two front
+    ends cannot drift."""
+    n = int(n_shards)
+
+    def round_model(*params: Any) -> Any:
+        pb = fed_broadcast(tuple(params), n)
+        lps = fed_map(
+            lambda shard: per_shard_fn(*shard[0], shard[1]), (pb, data)
+        )
+        return fed_sum(lps)
+
+    return round_model
 
 
 def _plan_reduce(
@@ -368,19 +400,14 @@ class FederatedLogpGrad:
             )
         self.n_shards = int(dims.pop())
         self._data_treedef = tree_util.tree_structure(data)
+        # The canonical round, in primitives (placement-free: `program`
+        # owns the lowering) — the shared canonical_round shape.
+        self._model = canonical_round(
+            self.per_shard_fn, self.data, self.n_shards
+        )
         self._program = program(
             self._model, placement=placement, fuse=fuse
         )
-
-    # The canonical round, in primitives (placement-free: `program`
-    # owns the lowering).
-    def _model(self, *params: Any) -> Any:
-        pb = fed_broadcast(tuple(params), self.n_shards)
-        lps = fed_map(
-            lambda shard: self.per_shard_fn(*shard[0], shard[1]),
-            (pb, self.data),
-        )
-        return fed_sum(lps)
 
     def fed_model(self, *params: Any) -> Any:
         """The raw primitive-level model (no placement) — what

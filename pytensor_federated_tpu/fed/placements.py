@@ -235,6 +235,7 @@ class PoolPlacement(Placement):
         window: int = 8,
         logp_dtype: Any = None,
         reduce: bool = False,
+        tag: str = "pool",
     ) -> None:
         """``reduce=True`` opts eligible ``fed_sum(fed_map(...))``
         pairs into the REDUCED window lowering (ISSUE 13): the whole
@@ -249,16 +250,22 @@ class PoolPlacement(Placement):
         broadcast-derived or a trace-time-baked constant — gradients
         w.r.t. per-shard PROGRAM INPUTS cannot survive a sum, so such
         programs fall back to the per-shard window silently-correctly
-        rather than silently-wrongly."""
+        rather than silently-wrongly.
+
+        ``tag`` labels this placement's spans/flight events (the
+        ``lane`` attribute of ``fed.window`` / ``fed.reduce_window``)
+        so concurrent consumers — the SVI lanes tag theirs ``"svi"``
+        (ISSUE 15) — stay attributable on the PR-11 telemetry plane."""
         self.client = client
         self.window = int(window)
         self.logp_dtype = logp_dtype
         self.reduce = bool(reduce)
+        self.tag = str(tag)
 
     def fusion_key(self) -> tuple:
         return (
             "pool", id(self.client), self.window, self.logp_dtype,
-            self.reduce,
+            self.reduce, self.tag,
         )
 
     # -- host side ---------------------------------------------------------
@@ -280,13 +287,13 @@ class PoolPlacement(Placement):
             slices.append((lo, len(requests)))
         with _spans.span(
             "fed.window",
-            lane="pool",
+            lane=self.tag,
             calls=len(metas),
             requests=len(requests),
         ):
             _flightrec.record(
                 "fed.fused_window",
-                lane="pool",
+                lane=self.tag,
                 calls=len(metas),
                 requests=len(requests),
                 window=self.window,
@@ -490,11 +497,11 @@ class PoolPlacement(Placement):
                 for s in range(n_shards)
             ]
             with _spans.span(
-                "fed.reduce_window", lane="pool", requests=n_shards
+                "fed.reduce_window", lane=self.tag, requests=n_shards
             ):
                 _flightrec.record(
                     "fed.reduce_window",
-                    lane="pool",
+                    lane=self.tag,
                     requests=n_shards,
                     total=total,
                     window=window,

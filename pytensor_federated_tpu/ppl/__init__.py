@@ -1,0 +1,93 @@
+"""``ppl`` — an effect-handler probabilistic front end that compiles
+plate-structured models to ``fed.program`` (ISSUE 15).
+
+One model definition, every execution mode (the NumPyro composable-
+effects design, PAPERS.md): probabilistic statements —
+:func:`sample`, :func:`deterministic`, :class:`plate`,
+:func:`subsample` — emit messages through composable handlers
+(:class:`trace`, :class:`replay`, :class:`condition`,
+:class:`substitute`, :class:`seed`, :class:`block`), and the compiler
+(:func:`compile`) maps the outermost plate onto the existing
+``fed_map``/``fed_sum`` primitives (the DrJAX plate→MapReduce
+correspondence), so the same model runs
+
+- directly (:func:`log_density`),
+- under NUTS / tempering (``samplers.sample(compiled.logp, ...)``),
+- as batch SVI through the shared ELBO core (:func:`svi_fit` —
+  which ``samplers/advi.py`` and ``samplers/flows.py`` now also
+  optimize through), and
+- as STREAMING SVI over live minibatch traffic through the gateway
+  (:class:`StreamingSVI`), under the deadline regime.
+
+Quick shape::
+
+    from pytensor_federated_tpu import fed, ppl
+    from pytensor_federated_tpu.ppl.distributions import Normal
+
+    def model(x, y):
+        w = ppl.sample("w", Normal(0.0, 1.0))
+        with ppl.plate("shards", x.shape[0]) as sh:
+            xs, ys = ppl.subsample(x, sh), ppl.subsample(y, sh)
+            ppl.sample("obs", Normal(w * xs, 1.0), obs=ys)
+
+    c = ppl.compile(model, (x, y), placement=fed.MeshPlacement(mesh))
+    value, grads = c.logp_and_grad(c.init_params())
+
+tutorial §24 walks the radon GLM through all four modes; docs/ppl.md
+is the design document.
+"""
+
+from . import distributions
+from .compiler import CompiledModel, compile, log_density
+from .elbo import (
+    gaussian_entropy,
+    meanfield_draws,
+    meanfield_neg_elbo,
+    scan_vi,
+)
+from .handlers import (
+    Messenger,
+    PPLError,
+    block,
+    condition,
+    deterministic,
+    force_subsample,
+    plate,
+    replay,
+    sample,
+    seed,
+    subsample,
+    substitute,
+    trace,
+)
+from .radon import make_radon_example, radon_model
+from .svi import StreamingSVI, SVIResult, svi_fit
+
+__all__ = [
+    "CompiledModel",
+    "Messenger",
+    "PPLError",
+    "StreamingSVI",
+    "SVIResult",
+    "block",
+    "compile",
+    "condition",
+    "deterministic",
+    "distributions",
+    "force_subsample",
+    "gaussian_entropy",
+    "log_density",
+    "make_radon_example",
+    "meanfield_draws",
+    "meanfield_neg_elbo",
+    "plate",
+    "radon_model",
+    "replay",
+    "sample",
+    "scan_vi",
+    "seed",
+    "subsample",
+    "substitute",
+    "svi_fit",
+    "trace",
+]

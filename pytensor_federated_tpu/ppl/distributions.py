@@ -1,0 +1,152 @@
+"""Minimal distribution objects for the effect-handler front end.
+
+Each distribution is a frozen value object with ``log_prob`` (the
+elementwise log-density — sites sum it themselves, so masking and
+plate scaling compose outside) and ``sample`` (a reparameterized or
+direct draw; prior-predictive discovery and ``seed``-handled traces
+use it).  The logp kernels REUSE the closed-form expressions the
+model zoo already ships (``models/linear._normal_logpdf`` is the one
+Gaussian kernel in the repo — NumPyro-style distribution objects wrap
+it rather than fork it), so a PPL model and its hand-written twin
+cannot drift numerically.
+
+Everything is batched/elementwise: parameters broadcast against the
+value exactly like ``jnp`` arithmetic, and there is no event-shape
+machinery — the :class:`~.handlers.plate` owns independence
+structure, which is all the ``fed`` compiler needs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models.linear import _normal_logpdf
+
+__all__ = [
+    "Bernoulli",
+    "Distribution",
+    "Exponential",
+    "HalfNormal",
+    "HalfNormalLog",
+    "Normal",
+]
+
+_LOG_HALF_NORMAL_CONST = 0.5 * math.log(2.0 / math.pi)
+
+
+@dataclasses.dataclass(frozen=True)
+class Distribution:
+    """Base class: elementwise ``log_prob`` + ``sample``."""
+
+    def log_prob(self, value: Any) -> jax.Array:
+        raise NotImplementedError
+
+    def sample(self, key: jax.Array, sample_shape: Tuple[int, ...] = ()) -> jax.Array:
+        raise NotImplementedError
+
+    def shape(self) -> Tuple[int, ...]:
+        """Broadcast shape of the parameters (the per-draw shape)."""
+        leaves = [
+            jnp.shape(getattr(self, f.name))
+            for f in dataclasses.fields(self)
+        ]
+        out: Tuple[int, ...] = ()
+        for s in leaves:
+            out = jnp.broadcast_shapes(out, s)
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
+class Normal(Distribution):
+    """Gaussian — wraps the repo's one ``_normal_logpdf`` kernel."""
+
+    loc: Any = 0.0
+    scale: Any = 1.0
+
+    def log_prob(self, value: Any) -> jax.Array:
+        return _normal_logpdf(value, self.loc, self.scale)
+
+    def sample(self, key: jax.Array, sample_shape: Tuple[int, ...] = ()) -> jax.Array:
+        shape = tuple(sample_shape) + self.shape()
+        return self.loc + self.scale * jax.random.normal(key, shape)
+
+
+@dataclasses.dataclass(frozen=True)
+class HalfNormal(Distribution):
+    """Half-Gaussian on ``x > 0`` (support is NOT checked — samplers
+    that need an unconstrained parameterization should use
+    :class:`HalfNormalLog` instead)."""
+
+    scale: Any = 1.0
+
+    def log_prob(self, value: Any) -> jax.Array:
+        z = value / self.scale
+        return (
+            -0.5 * z * z - jnp.log(self.scale) + _LOG_HALF_NORMAL_CONST
+        )
+
+    def sample(self, key: jax.Array, sample_shape: Tuple[int, ...] = ()) -> jax.Array:
+        shape = tuple(sample_shape) + self.shape()
+        return jnp.abs(self.scale * jax.random.normal(key, shape))
+
+
+@dataclasses.dataclass(frozen=True)
+class HalfNormalLog(Distribution):
+    """The law of ``log(X)`` for ``X ~ HalfNormal(scale)`` — the
+    repo's standard unconstrained scale prior (``-0.5 exp(2u)/s^2 + u``
+    plus constants: the HalfNormal log-density at ``exp(u)`` with the
+    log-transform Jacobian, exactly the ``hierbase.py`` /
+    ``models/glm.py`` ``log_tau`` term).  Sampling NUTS/SVI over this
+    value needs no bijector machinery."""
+
+    scale: Any = 1.0
+
+    def log_prob(self, value: Any) -> jax.Array:
+        x = jnp.exp(value) / self.scale
+        return (
+            -0.5 * x * x
+            + value
+            - jnp.log(self.scale)
+            + _LOG_HALF_NORMAL_CONST
+        )
+
+    def sample(self, key: jax.Array, sample_shape: Tuple[int, ...] = ()) -> jax.Array:
+        shape = tuple(sample_shape) + self.shape()
+        draw = jnp.abs(self.scale * jax.random.normal(key, shape))
+        return jnp.log(draw + jnp.finfo(jnp.float32).tiny)
+
+
+@dataclasses.dataclass(frozen=True)
+class Exponential(Distribution):
+    """Exponential(rate) on ``x > 0`` (support not checked)."""
+
+    rate: Any = 1.0
+
+    def log_prob(self, value: Any) -> jax.Array:
+        return jnp.log(self.rate) - self.rate * value
+
+    def sample(self, key: jax.Array, sample_shape: Tuple[int, ...] = ()) -> jax.Array:
+        shape = tuple(sample_shape) + self.shape()
+        return jax.random.exponential(key, shape) / self.rate
+
+
+@dataclasses.dataclass(frozen=True)
+class Bernoulli(Distribution):
+    """Bernoulli over {0, 1} parameterized by logits — the stable
+    ``y*eta - log(1 + e^eta)`` kernel (``models/logistic.py``)."""
+
+    logits: Any = 0.0
+
+    def log_prob(self, value: Any) -> jax.Array:
+        return value * self.logits - jnp.logaddexp(0.0, self.logits)
+
+    def sample(self, key: jax.Array, sample_shape: Tuple[int, ...] = ()) -> jax.Array:
+        shape = tuple(sample_shape) + self.shape()
+        return jax.random.bernoulli(
+            key, jax.nn.sigmoid(self.logits), shape
+        ).astype(jnp.float32)

@@ -1,0 +1,660 @@
+"""Composable effect handlers: one model definition, many executions.
+
+NumPyro-style (PAPERS.md: "Composable Effects for Flexible and
+Accelerated Probabilistic Programming in NumPyro"): a model is a plain
+Python function whose probabilistic statements — :func:`sample`,
+:func:`deterministic`, :class:`plate`, :func:`subsample` — emit
+*messages* through a stack of handlers instead of executing a fixed
+semantics.  Each handler is a context manager on a thread-local stack;
+a message travels innermost-to-outermost through
+``process_message`` (so the INNERMOST handler that resolves a site's
+value wins — the :class:`condition` / :class:`substitute` precedence
+contract, pinned in tests/test_ppl.py), gets a default resolution
+(draw from the prior if a ``seed`` handler supplied a key; a loud
+:class:`PPLError` otherwise), then travels back out through
+``postprocess_message`` (where :class:`trace` records).
+
+The same model function therefore drives every execution mode in the
+repo: direct log-density evaluation (:func:`~.compiler.log_density`),
+prior sampling (``seed`` + ``trace``), NUTS/tempering (via the
+compiled logp), and the ``fed``-lowered mesh/pool/mixed programs
+(:func:`~.compiler.compile` re-runs the model under
+:class:`force_subsample` to extract per-shard likelihoods — the
+DrJAX plate→``fed_map`` correspondence).
+
+Handlers run inside JAX traces (``fed_map`` bodies, ``jax.grad``), so
+everything here is pure Python bookkeeping over traced values — no
+host callbacks, no randomness outside an explicit ``seed``.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .distributions import Distribution
+
+__all__ = [
+    "Messenger",
+    "PPLError",
+    "block",
+    "condition",
+    "deterministic",
+    "force_subsample",
+    "plate",
+    "replay",
+    "sample",
+    "seed",
+    "subsample",
+    "substitute",
+    "trace",
+]
+
+Message = Dict[str, Any]
+
+
+class PPLError(RuntimeError):
+    """Loud failure of the effect layer: an unhandled site, a missing
+    value, a duplicate name, a geometry mismatch.  A RuntimeError
+    subclass on purpose — like :class:`~..service.deadline.
+    DeadlineExceeded`, every lane already treats RuntimeError as
+    deterministic/non-retryable."""
+
+
+class _Local(threading.local):
+    def __init__(self) -> None:
+        self.stack: List["Messenger"] = []
+
+
+_LOCAL = _Local()
+
+
+def _stack() -> List["Messenger"]:
+    return _LOCAL.stack
+
+
+class Messenger:
+    """Base handler: a context manager on the thread-local stack,
+    optionally wrapping a model function (``handler(fn)(*args)`` runs
+    ``fn`` with the handler active — handlers compose by nesting)."""
+
+    def __init__(self, fn: Optional[Callable[..., Any]] = None) -> None:
+        self.fn = fn
+
+    def __enter__(self) -> "Messenger":
+        _stack().append(self)
+        return self
+
+    def __exit__(self, *exc: object) -> None:
+        popped = _stack().pop()
+        if popped is not self:  # pragma: no cover - stack discipline bug
+            raise PPLError(
+                "handler stack corrupted: __exit__ out of order"
+            )
+
+    def process_message(self, msg: Message) -> None:
+        """Inbound pass, innermost handler first."""
+
+    def postprocess_message(self, msg: Message) -> None:
+        """Outbound pass after the value is resolved."""
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        if self.fn is None:
+            raise PPLError(
+                f"{type(self).__name__} wraps no function; use it as a "
+                "context manager or pass fn"
+            )
+        with self:
+            return self.fn(*args, **kwargs)
+
+
+def apply_stack(msg: Message) -> Message:
+    """Run one message through the active handler stack (the NumPyro
+    protocol): process innermost→outermost, stopping at a
+    :class:`block`; default-resolve the value; postprocess back from
+    the stop point inward."""
+    stack = _stack()
+    pointer = 0
+    for pointer, handler in enumerate(reversed(stack)):
+        handler.process_message(msg)
+        if msg.get("stop"):
+            break
+    if msg["value"] is None and msg["type"] == "sample":
+        if msg["rng_key"] is None:
+            raise PPLError(
+                f"sample site {msg['name']!r} has no value: provide it "
+                "via substitute/condition/replay, or wrap the model in "
+                "ppl.seed(...) to draw from the prior"
+            )
+        dist: Distribution = msg["dist"]
+        msg["value"] = dist.sample(
+            msg["rng_key"], tuple(msg["sample_shape"])
+        )
+    # Postprocess INNERMOST-first: an inner plate must gather its
+    # shard's rows before an outer trace records the site.
+    for handler in reversed(stack[len(stack) - pointer - 1 :]):
+        handler.postprocess_message(msg)
+    return msg
+
+
+# ---------------------------------------------------------------------------
+# primitives
+# ---------------------------------------------------------------------------
+
+
+def sample(
+    name: str,
+    dist: Distribution,
+    *,
+    obs: Any = None,
+    mask: Any = None,
+) -> Any:
+    """Declare a random variable.  Returns its value under the active
+    handler interpretation (observed data, a substituted parameter, a
+    replayed draw, or a fresh prior draw under ``seed``)."""
+    if not _stack():
+        raise PPLError(
+            f"sample({name!r}) outside any handler: wrap the model in "
+            "ppl.trace / ppl.seed / ppl.substitute / ... before calling"
+        )
+    msg: Message = {
+        "type": "sample",
+        "name": name,
+        "dist": dist,
+        "value": obs,
+        "observed": obs is not None,
+        "mask": mask,
+        "scale": 1.0,
+        "plates": (),
+        "rng_key": None,
+        "sample_shape": (),
+        "stop": False,
+    }
+    apply_stack(msg)
+    return msg["value"]
+
+
+def deterministic(name: str, value: Any) -> Any:
+    """Record a named derived quantity (no log-density contribution);
+    returns ``value`` unchanged."""
+    if not _stack():
+        raise PPLError(
+            f"deterministic({name!r}) outside any handler: wrap the "
+            "model in ppl.trace / ppl.seed / ... before calling"
+        )
+    msg: Message = {
+        "type": "deterministic",
+        "name": name,
+        "dist": None,
+        "value": value,
+        "observed": False,
+        "mask": None,
+        "scale": 1.0,
+        "plates": (),
+        "rng_key": None,
+        "sample_shape": (),
+        "stop": False,
+    }
+    apply_stack(msg)
+    return msg["value"]
+
+
+@dataclasses.dataclass(frozen=True)
+class PlateFrame:
+    """One plate's static identity on a site: name, declared (full)
+    size, and the effective size this execution ran with."""
+
+    name: str
+    size: int
+    effective: int
+
+
+class plate(Messenger):
+    """Vectorized independence context over a LEADING axis.
+
+    Sites declared inside carry the frame in ``msg["plates"]`` —
+    the :mod:`.compiler` maps the outermost plate onto ``fed_map``
+    shards (DrJAX's plate→map correspondence).  ``subsample_size``
+    turns the plate into a minibatch plate: under a :class:`seed`
+    handler it draws ``subsample_size`` indices without replacement,
+    :func:`subsample` gathers plate-scoped data by them, and every
+    inside site's log-density is scaled by ``size/subsample_size`` so
+    the scaled minibatch logp is an unbiased estimate of the full-data
+    logp (property-tested in tests/test_ppl.py).
+
+    A :class:`force_subsample` handler overrides the indices from
+    outside the model — the compiler's per-shard and minibatch lanes,
+    and the unbiasedness tests, use that seam.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        size: int,
+        *,
+        subsample_size: Optional[int] = None,
+    ) -> None:
+        super().__init__(None)
+        self.name = name
+        self.size = int(size)
+        if self.size < 1:
+            raise PPLError(f"plate {name!r} size must be >= 1")
+        self.subsample_size = (
+            int(subsample_size) if subsample_size is not None else self.size
+        )
+        if not (1 <= self.subsample_size <= self.size):
+            raise PPLError(
+                f"plate {name!r}: subsample_size {self.subsample_size} "
+                f"not in 1..{self.size}"
+            )
+        self._indices: Optional[jax.Array] = None
+        self._scale: float = 1.0
+        # id()s of arrays subsample() returned under THIS entry —
+        # provenance that tells an index-ordered value from a raw
+        # full-order one when their shapes coincide (see _resize).
+        self._gathered: set = set()
+
+    def __enter__(self) -> "plate":
+        super().__enter__()
+        forced = _innermost_force(self.name)
+        if forced is not None:
+            idx = jnp.asarray(forced.indices[self.name])
+            if idx.ndim != 1:
+                raise PPLError(
+                    f"forced indices for plate {self.name!r} must be "
+                    f"1-D, got shape {tuple(idx.shape)}"
+                )
+            self._indices = idx
+            n = int(idx.shape[0])
+            self._scale = (self.size / n) if forced.scale else 1.0
+        elif self.subsample_size < self.size:
+            key = _subsample_key(self.name)
+            self._indices = jax.random.choice(
+                key, self.size, (self.subsample_size,), replace=False
+            )
+            self._scale = self.size / self.subsample_size
+        else:
+            self._indices = None
+            self._scale = 1.0
+        self._gathered = set()
+        return self
+
+    @property
+    def indices(self) -> jax.Array:
+        """The active index set (``arange(size)`` when not
+        subsampling)."""
+        if self._indices is None:
+            return jnp.arange(self.size)
+        return self._indices
+
+    @property
+    def effective_size(self) -> int:
+        if self._indices is None:
+            return self.size
+        return int(self._indices.shape[0])
+
+    def process_message(self, msg: Message) -> None:
+        if msg["type"] not in ("sample", "deterministic"):
+            return
+        eff = self.effective_size
+        msg["plates"] = (
+            PlateFrame(self.name, self.size, eff),
+        ) + msg["plates"]
+        msg["scale"] = msg["scale"] * self._scale
+        if (
+            msg["type"] == "sample"
+            and not msg["observed"]
+            and msg["value"] is None
+        ):
+            msg["sample_shape"] = (eff,) + tuple(msg["sample_shape"])
+
+    def _resize(
+        self, name: str, what: str, value: Any, *, observed: bool
+    ) -> Any:
+        """Bring one plate-scoped array onto this execution's index
+        set.  LATENTS carry the FULL plate axis by contract (the
+        compiler broadcasts whole parameter arrays to every shard), so
+        they are ALWAYS gathered — even when the index set is a
+        full-length permutation, where an already-the-right-size check
+        would silently pair shard i's latent with shard j's data.
+        OBSERVED values/masks are either already index-ordered (the
+        model gathered them through subsample()) and pass through at
+        the effective size, or condition/obs-attached at the FULL
+        size and gathered here; anything else is a loud geometry
+        error — a full-size value that merely BROADCAST against
+        shard-shaped siblings would silently count the whole plate
+        once per shard."""
+        eff = self.effective_size
+        dim = int(jnp.shape(value)[0])
+        if observed and dim == eff:
+            # At eff == size an observed value's SHAPE is ambiguous:
+            # an already-index-ordered subsample() output and a raw
+            # full-order condition/obs attachment look the same.
+            # Provenance disambiguates — subsample() registered its
+            # outputs with this plate, so registered values pass;
+            # anything else under a non-identity concrete index set
+            # refuses loudly (silent row misalignment otherwise).
+            # Traced full-length indices keep the pass-through: the
+            # shipped lanes deliver pre-sliced data there
+            # (slice_data=False) or route it through subsample().
+            if (
+                eff == self.size
+                and self._indices is not None
+                and id(value) not in self._gathered
+            ):
+                try:
+                    conc = np.asarray(self._indices)
+                except Exception:  # tracer: cannot concretize
+                    conc = None
+                if conc is not None and not np.array_equal(
+                    conc, np.arange(self.size)
+                ):
+                    raise PPLError(
+                        f"{what} of observed site {name!r} inside "
+                        f"plate {self.name!r} is full-length under a "
+                        "permuted/duplicated index set — whether it "
+                        "is already index-ordered is ambiguous; route "
+                        "it through subsample() or force a strict "
+                        "subset of indices"
+                    )
+            return value
+        if dim == self.size:
+            return jnp.take(value, self._indices, axis=0)
+        expected = (
+            f"the effective size {eff} (already sliced) or the full "
+            f"plate size {self.size} (gathered by the active indices)"
+            if observed
+            else f"the full plate size {self.size} (latents are "
+            "gathered by the active indices)"
+        )
+        raise PPLError(
+            f"{what} of site {name!r} inside plate {self.name!r} has "
+            f"leading dim {dim}; expected {expected}"
+        )
+
+    def postprocess_message(self, msg: Message) -> None:
+        # Under an index override, values carrying the FULL plate axis
+        # are gathered onto this execution's rows: substituted LATENTS
+        # by contract (the compiler broadcasts whole parameter arrays
+        # to every shard), and condition/obs-attached OBSERVATIONS or
+        # masks that bypassed subsample() — anything that matches
+        # neither the full nor the effective size refuses loudly
+        # (never a silently-broadcast full-data likelihood per shard).
+        if (
+            self._indices is None
+            or msg["type"] != "sample"
+            or msg["value"] is None
+            or msg["rng_key"] is not None  # fresh draw: already sized
+        ):
+            return
+        if not any(
+            f.name == self.name for f in msg["plates"]
+        ):  # pragma: no cover - defensive
+            return
+        value = msg["value"]
+        if jnp.ndim(value) < 1:
+            if msg["observed"]:
+                return  # scalar obs broadcasts like any jnp operand
+            raise PPLError(
+                f"site {msg['name']!r} inside plate {self.name!r} has "
+                "a scalar value; plate-scoped latents must carry the "
+                "plate axis leading"
+            )
+        msg["value"] = self._resize(
+            msg["name"], "value", value, observed=msg["observed"]
+        )
+        if msg["mask"] is not None and jnp.ndim(msg["mask"]) >= 1:
+            msg["mask"] = self._resize(
+                msg["name"], "mask", msg["mask"], observed=True
+            )
+
+
+def subsample(data: Any, frame: Optional[plate] = None) -> Any:
+    """Gather plate-scoped data by the active plate's index set
+    (identity when the plate is not subsampling).  ``frame`` defaults
+    to the innermost active plate.  Under a :class:`force_subsample`
+    with ``slice_data=False`` this is the identity — the compiler's
+    streaming lane delivers pre-sliced shard data."""
+    pl = frame
+    if pl is None:
+        for handler in reversed(_stack()):
+            if isinstance(handler, plate):
+                pl = handler
+                break
+    if pl is None:
+        raise PPLError("subsample() outside any active plate")
+    if pl._indices is None:
+        return data
+    forced = _innermost_force(pl.name)
+    if forced is not None and not forced.slice_data:
+        # Pre-sliced by the caller (the streaming lane): identity,
+        # but still REGISTERED — these leaves are index-ordered.
+        for leaf in jax.tree_util.tree_leaves(data):
+            pl._gathered.add(id(leaf))
+        return data
+    idx = pl._indices
+    out = jax.tree_util.tree_map(
+        lambda leaf: jnp.take(leaf, idx, axis=0), data
+    )
+    for leaf in jax.tree_util.tree_leaves(out):
+        pl._gathered.add(id(leaf))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# handlers
+# ---------------------------------------------------------------------------
+
+
+class trace(Messenger):
+    """Record every site into an ordered dict (model execution order).
+    Duplicate site names are a loud :class:`PPLError`."""
+
+    def __init__(self, fn: Optional[Callable[..., Any]] = None) -> None:
+        super().__init__(fn)
+        self._trace: "collections.OrderedDict[str, Message]" = (
+            collections.OrderedDict()
+        )
+
+    def __enter__(self) -> "trace":
+        super().__enter__()
+        self._trace = collections.OrderedDict()
+        return self
+
+    def postprocess_message(self, msg: Message) -> None:
+        if msg["type"] not in ("sample", "deterministic"):
+            return
+        name = msg["name"]
+        if name in self._trace:
+            raise PPLError(f"duplicate site name {name!r} in one trace")
+        self._trace[name] = dict(msg)
+
+    def get_trace(
+        self, *args: Any, **kwargs: Any
+    ) -> "collections.OrderedDict[str, Message]":
+        self(*args, **kwargs)
+        return self._trace
+
+
+class replay(Messenger):
+    """Reuse the values of a previously recorded trace (sample sites
+    only; sites absent from the trace resolve normally)."""
+
+    def __init__(
+        self,
+        fn: Optional[Callable[..., Any]] = None,
+        guide_trace: Optional[Dict[str, Message]] = None,
+    ) -> None:
+        super().__init__(fn)
+        self.guide_trace = guide_trace or {}
+
+    def process_message(self, msg: Message) -> None:
+        if msg["type"] != "sample" or msg["value"] is not None:
+            return
+        site = self.guide_trace.get(msg["name"])
+        if site is not None:
+            msg["value"] = site["value"]
+
+
+class condition(Messenger):
+    """Clamp sites to OBSERVED values: the sites contribute likelihood
+    terms and count as data downstream.  The innermost handler that
+    resolves a site wins (see :class:`substitute`)."""
+
+    def __init__(
+        self,
+        fn: Optional[Callable[..., Any]] = None,
+        data: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        super().__init__(fn)
+        self.data = data or {}
+
+    def process_message(self, msg: Message) -> None:
+        if msg["type"] != "sample" or msg["value"] is not None:
+            return
+        if msg["name"] in self.data:
+            msg["value"] = self.data[msg["name"]]
+            msg["observed"] = True
+
+
+class substitute(Messenger):
+    """Set site VALUES without marking them observed — parameter
+    evaluation (the logp lanes run the model under ``substitute`` with
+    the sampler's current position).  Innermost wins: a
+    ``substitute`` nested inside a ``condition`` takes the site, and
+    vice versa — precedence is purely positional, pinned in
+    tests/test_ppl.py."""
+
+    def __init__(
+        self,
+        fn: Optional[Callable[..., Any]] = None,
+        data: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        super().__init__(fn)
+        self.data = data or {}
+
+    def process_message(self, msg: Message) -> None:
+        if msg["type"] != "sample" or msg["value"] is not None:
+            return
+        if msg["name"] in self.data:
+            msg["value"] = self.data[msg["name"]]
+
+
+class seed(Messenger):
+    """Supply PRNG keys: each unresolved sample site (in execution
+    order) consumes one split of the handler's key, so the same key
+    yields the same trace — the determinism contract the compiler's
+    seeded-trace tests pin.  Subsampling plates also draw their index
+    keys here (:func:`_subsample_key`)."""
+
+    def __init__(
+        self,
+        fn: Optional[Callable[..., Any]] = None,
+        rng_key: Optional[jax.Array] = None,
+    ) -> None:
+        super().__init__(fn)
+        if rng_key is None:
+            raise PPLError("seed(...) requires rng_key")
+        self.rng_key = rng_key
+        self._key = rng_key
+
+    def __enter__(self) -> "seed":
+        super().__enter__()
+        self._key = self.rng_key  # reentrant determinism
+        return self
+
+    def next_key(self) -> jax.Array:
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def process_message(self, msg: Message) -> None:
+        if (
+            msg["type"] == "sample"
+            and msg["value"] is None
+            and msg["rng_key"] is None
+        ):
+            msg["rng_key"] = self.next_key()
+
+
+class block(Messenger):
+    """Hide matching sites from handlers OUTSIDE this one (an outer
+    ``trace`` never records them; an outer ``substitute`` cannot set
+    them).  ``hide`` lists names; ``hide_fn`` is a message predicate;
+    with neither, everything is hidden."""
+
+    def __init__(
+        self,
+        fn: Optional[Callable[..., Any]] = None,
+        *,
+        hide: Optional[List[str]] = None,
+        hide_fn: Optional[Callable[[Message], bool]] = None,
+    ) -> None:
+        super().__init__(fn)
+        self.hide = set(hide) if hide is not None else None
+        self.hide_fn = hide_fn
+
+    def _hidden(self, msg: Message) -> bool:
+        if self.hide_fn is not None:
+            return bool(self.hide_fn(msg))
+        if self.hide is not None:
+            return msg["name"] in self.hide
+        return True
+
+    def process_message(self, msg: Message) -> None:
+        if self._hidden(msg):
+            msg["stop"] = True
+
+
+class force_subsample(Messenger):
+    """Pin plate index sets from OUTSIDE the model — the seam the
+    compiler's per-shard/minibatch lanes and the unbiasedness property
+    tests drive.
+
+    ``indices`` maps plate name → 1-D index array.  ``scale=True``
+    applies the ``size/len(indices)`` minibatch scaling (the unbiased
+    estimator); ``scale=False`` leaves terms unscaled (the compiler's
+    full-data per-shard evaluation, where every shard contributes its
+    exact term once).  ``slice_data=False`` makes :func:`subsample`
+    the identity for the forced plates — the streaming lane delivers
+    shard data already sliced, while latent parameter arrays still
+    arrive full-size and are gathered by the plate."""
+
+    def __init__(
+        self,
+        fn: Optional[Callable[..., Any]] = None,
+        indices: Optional[Dict[str, Any]] = None,
+        *,
+        scale: bool = True,
+        slice_data: bool = True,
+    ) -> None:
+        super().__init__(fn)
+        self.indices = dict(indices or {})
+        self.scale = bool(scale)
+        self.slice_data = bool(slice_data)
+
+
+def _innermost_force(plate_name: str) -> Optional[force_subsample]:
+    for handler in reversed(_stack()):
+        if (
+            isinstance(handler, force_subsample)
+            and plate_name in handler.indices
+        ):
+            return handler
+    return None
+
+
+def _subsample_key(plate_name: str) -> jax.Array:
+    for handler in reversed(_stack()):
+        if isinstance(handler, seed):
+            return handler.next_key()
+    raise PPLError(
+        f"plate {plate_name!r} subsamples but no seed handler is "
+        "active: wrap the model in ppl.seed(...) (or force indices "
+        "with ppl.force_subsample)"
+    )

@@ -1,0 +1,68 @@
+"""The hierarchical radon GLM, written ONCE as an effectful model.
+
+The ISSUE-15 endgame demo: the same model the repo ships hand-written
+(``models/glm.py`` — the BASELINE "PyMC hierarchical radon GLM"
+config) expressed through the effect layer, so ONE definition drives
+every execution mode: direct log-density, NUTS, parallel tempering,
+batch SVI, and streaming SVI through the gateway (tutorial §24;
+bench_suite config 20 measures posterior-quality-vs-wall-clock).
+
+Scales are log-parameterized through :class:`~.distributions.
+HalfNormalLog` — the same HalfNormal(1)-with-Jacobian term
+``models/glm.py`` writes by hand — so the parameter vector is fully
+unconstrained and plugs straight into the samplers.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax.numpy as jnp
+
+from ..models.glm import generate_radon_data
+from .distributions import HalfNormalLog, Normal
+from .handlers import deterministic, plate, sample, subsample
+
+__all__ = ["make_radon_example", "radon_model"]
+
+
+def radon_model(floor: Any, log_radon: Any, mask: Any) -> None:
+    """Partial-pooling radon GLM over county shards (one county = one
+    plate position = one federated shard).  Arguments are the packed
+    ``(n_counties, n_obs)`` arrays from
+    :func:`~..models.glm.generate_radon_data`."""
+    mu_alpha = sample("mu_alpha", Normal(0.0, 10.0))
+    log_sigma_alpha = sample("log_sigma_alpha", HalfNormalLog(1.0))
+    beta = sample("beta", Normal(0.0, 10.0))
+    log_sigma = sample("log_sigma", HalfNormalLog(1.0))
+    with plate("county", int(floor.shape[0])) as county:
+        alpha_raw = sample("alpha_raw", Normal(0.0, 1.0))
+        alpha = deterministic(
+            "alpha", mu_alpha + jnp.exp(log_sigma_alpha) * alpha_raw
+        )
+        f = subsample(floor, county)
+        y = subsample(log_radon, county)
+        m = subsample(mask, county)
+        eta = alpha[:, None] + beta * f
+        sample(
+            "obs",
+            Normal(eta, jnp.exp(log_sigma)),
+            obs=y,
+            mask=m,
+        )
+
+
+def make_radon_example(
+    n_counties: int = 16,
+    *,
+    mean_obs: int = 24,
+    seed: int = 11,
+) -> Tuple[Callable[..., None], Tuple[Any, ...], dict]:
+    """Synthetic radon data packed for the effectful model: returns
+    ``(model, model_args, true_params)`` ready for
+    ``ppl.compile(model, model_args, ...)``."""
+    data, true = generate_radon_data(
+        n_counties, mean_obs=mean_obs, seed=seed
+    )
+    (floor, y), mask = data.tree()
+    return radon_model, (floor, y, mask), true

@@ -1,0 +1,340 @@
+"""Stochastic variational inference on compiled ``ppl`` programs —
+batch mode and STREAMING mode.
+
+Batch mode (:func:`svi_fit`) is mean-field SVI through the shared
+ELBO core (:mod:`.elbo`): the whole optimization is one jitted
+``lax.scan``, with an optional unbiased minibatch estimator
+(``compiled.logp_minibatch``) per step — doubly stochastic VI over
+federated shards.
+
+Streaming mode (:class:`StreamingSVI`) is the scenario the exact
+NUTS/tempering lane cannot serve (ISSUE 15): optimizer state lives on
+the driver, per-shard likelihood+gradient work rides the replica pool
+— typically THROUGH the PR-12 gateway (``PoolPlacement`` over a
+``TcpArraysClient`` dialed at the front door, per-tenant quotas and
+all) — and minibatches arrive as live traffic instead of a schedule.
+Every step runs under the PR-10 deadline regime:
+
+- a batch whose windows exceed the step budget is SHED
+  (``DeadlineExceeded`` — the gateway/node classification arrives
+  in-band) and the optimizer does NOT step;
+- a batch denied by the gateway's tenant quota is shed as overload;
+- transient transport/compute failures skip the batch loudly;
+- a batch is applied at most once — the optimizer's own step counter
+  is the proof (``opt_steps == accepted``, the chaos ``--lane
+  streaming`` invariant), so shed work can never double-count.
+
+Convergence telemetry rides the PR-11 plane:
+``pftpu_svi_batches_total{outcome}``, ``pftpu_svi_elbo``, and
+``svi.step`` / ``svi.shed`` flight events.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.flatten_util import ravel_pytree
+
+from ..service import deadline as _deadline
+from ..telemetry import flightrec as _flightrec
+from ..telemetry import metrics as _metrics
+from .compiler import CompiledModel
+from .elbo import gaussian_entropy, meanfield_draws, meanfield_neg_elbo, scan_vi
+from .handlers import PPLError
+
+try:
+    import optax
+
+    _HAS_OPTAX = True
+except ModuleNotFoundError:  # pragma: no cover
+    _HAS_OPTAX = False
+
+__all__ = ["StreamingSVI", "SVIResult", "svi_fit"]
+
+SVI_BATCHES = _metrics.counter(
+    "pftpu_svi_batches_total",
+    "Streaming-SVI minibatch outcomes",
+    labelnames=("outcome",),
+)
+SVI_ELBO = _metrics.gauge(
+    "pftpu_svi_elbo", "Latest streaming-SVI ELBO estimate"
+)
+
+
+class SVIResult(NamedTuple):
+    """Mean-field fit in user pytree structure (the
+    :class:`~..samplers.advi.ADVIResult` contract)."""
+
+    mean: Any
+    sd: Any
+    elbo_trace: jax.Array
+    flat_mean: jax.Array
+    flat_log_sd: jax.Array
+
+    def sample(self, key: jax.Array, n: int, unravel: Callable[[jax.Array], Any]) -> Any:
+        eps = jax.random.normal(
+            key, (n, self.flat_mean.shape[0]), self.flat_mean.dtype
+        )
+        flat = (
+            self.flat_mean[None, :]
+            + jnp.exp(self.flat_log_sd)[None, :] * eps
+        )
+        return jax.vmap(unravel)(flat)
+
+
+def svi_fit(
+    compiled: CompiledModel,
+    *,
+    key: jax.Array,
+    num_steps: int = 1000,
+    n_mc: int = 8,
+    learning_rate: float = 1e-2,
+    init_log_sd: float = -2.0,
+    minibatch: bool = False,
+    batch_size: Optional[int] = None,
+    init_params: Optional[Any] = None,
+) -> Tuple[SVIResult, Callable[[jax.Array], Any]]:
+    """Batch mean-field SVI on a compiled model; returns ``(result,
+    unravel)``.  ``minibatch=True`` estimates each step's logp on a
+    random shard subsample (``compiled.logp_minibatch`` — unbiased by
+    the plate scaling), so per-step cost drops with the batch while
+    the ELBO gradient stays unbiased.  Best with ``placement=None``
+    (the scan jits end to end); pool placements should prefer
+    :class:`StreamingSVI`."""
+    if not _HAS_OPTAX:
+        raise ModuleNotFoundError("svi_fit requires optax")
+    init = init_params if init_params is not None else compiled.init_params()
+    flat0, unravel = ravel_pytree(init)
+    dim = int(flat0.shape[0])
+    dtype = flat0.dtype
+
+    if minibatch:
+
+        def e_logp_fn(x: jax.Array, k: jax.Array) -> jax.Array:
+            keys = jax.random.split(k, x.shape[0])
+            vals = jax.vmap(
+                lambda xi, ki: compiled.logp_minibatch(
+                    unravel(xi), ki, batch_size=batch_size
+                )
+            )(x, keys)
+            return jnp.mean(vals)
+
+    else:
+        batch_logp = jax.vmap(lambda xi: compiled.logp(unravel(xi)))
+
+        def e_logp_fn(x: jax.Array, k: jax.Array) -> jax.Array:
+            return jnp.mean(batch_logp(x))
+
+    neg_elbo = meanfield_neg_elbo(
+        e_logp_fn, dim, n_mc=n_mc, split_keys=minibatch
+    )
+    var0 = (flat0, jnp.full((dim,), init_log_sd, dtype))
+    (mu, log_sd), elbos = scan_vi(
+        neg_elbo,
+        var0,
+        key=key,
+        num_steps=num_steps,
+        optimizer=optax.adam(learning_rate),
+    )
+    result = SVIResult(
+        mean=unravel(mu),
+        sd=unravel(jnp.exp(log_sd)),
+        elbo_trace=elbos,
+        flat_mean=mu,
+        flat_log_sd=log_sd,
+    )
+    return result, unravel
+
+
+def _classify_skip(exc: BaseException) -> Optional[str]:
+    """Map a step failure to its shed/skip outcome, or None when the
+    exception is a programming error that must propagate (the loud
+    posture: only CLASSIFIED failures are absorbed).
+
+    Pool windows execute inside ``jax.pure_callback`` under
+    ``value_and_grad``, which re-raises host failures as an XLA
+    runtime error whose MESSAGE carries the original traceback — so
+    classification also matches the in-band deadline/overload strings,
+    not just the exception types."""
+    text = str(exc)
+    if isinstance(exc, PPLError) or "PPLError" in text:
+        # A model/contract bug is deterministic: propagate even when
+        # the callback layer erased the type (the traceback text
+        # still names it) — retrying/skipping forever would be silent.
+        return None
+    if isinstance(
+        exc, _deadline.DeadlineExceeded
+    ) or _deadline.is_deadline_error(text):
+        return "shed_deadline"
+    try:
+        from ..gateway.fairness import is_overload_error
+
+        if is_overload_error(text):
+            return "shed_overload"
+    except ImportError:  # pragma: no cover - gateway always ships
+        pass
+    if isinstance(exc, (RuntimeError, ValueError, ConnectionError, OSError)):
+        return "failed"
+    return None
+
+
+class StreamingSVI:
+    """Mean-field SVI whose minibatches arrive as live traffic.
+
+    ``compiled`` is a :class:`~.compiler.CompiledModel`, typically
+    with a ``PoolPlacement(TcpArraysClient(gateway_host, gateway_port,
+    tenant=...), tag="svi")`` so likelihood windows ride the gateway.
+    Each arriving batch is a 1-D array of shard indices (the federated
+    minibatch: data never leaves the nodes, only indices and
+    parameters travel).  Call :meth:`step` per batch; outcomes are
+    ``"accepted"``, ``"shed_deadline"``, ``"shed_overload"``, or
+    ``"failed"``.
+
+    Accounting contract (chaos ``--lane streaming`` proves it under
+    flapping replicas, a hog tenant, and deadline sheds):
+
+    - ``opt_steps`` (read from the optimizer state itself) ==
+      ``accepted`` — a shed batch can NEVER have stepped the
+      optimizer, and no batch steps it twice;
+    - ``offered == accepted + sum(skipped.values())`` — every batch
+      is accounted exactly once;
+    - unclassified exceptions propagate (nothing is silently eaten).
+    """
+
+    def __init__(
+        self,
+        compiled: CompiledModel,
+        *,
+        key: jax.Array,
+        learning_rate: float = 5e-2,
+        n_mc: int = 2,
+        init_log_sd: float = -2.0,
+        deadline_s: Optional[float] = None,
+        init_params: Optional[Any] = None,
+    ) -> None:
+        if not _HAS_OPTAX:
+            raise ModuleNotFoundError("StreamingSVI requires optax")
+        self.compiled = compiled
+        self.deadline_s = deadline_s
+        self.n_mc = int(n_mc)
+        init = (
+            init_params if init_params is not None
+            else compiled.init_params()
+        )
+        flat0, self._unravel = ravel_pytree(init)
+        self.dim = int(flat0.shape[0])
+        self._dtype = flat0.dtype
+        self.mu = flat0
+        self.log_sd = jnp.full((self.dim,), init_log_sd, self._dtype)
+        self._opt = optax.adam(learning_rate)
+        self._opt_state = self._opt.init((self.mu, self.log_sd))
+        self._key = key
+        self.offered = 0
+        self.accepted = 0
+        self.skipped: Dict[str, int] = {}
+        self.elbo_trace: List[float] = []
+
+    # -- accounting ----------------------------------------------------
+
+    @property
+    def opt_steps(self) -> int:
+        """The optimizer's OWN step counter (optax adam carries one) —
+        the ground truth the accepted-batch count is checked against."""
+        counts = [
+            int(np.asarray(c))
+            for c in jax.tree_util.tree_leaves(self._opt_state)
+            if jnp.ndim(c) == 0 and jnp.issubdtype(
+                jnp.result_type(c), jnp.integer
+            )
+        ]
+        return max(counts) if counts else 0
+
+    # -- the ELBO estimator --------------------------------------------
+
+    def _neg_elbo(
+        self,
+        var: Tuple[jax.Array, jax.Array],
+        key: jax.Array,
+        idx: jax.Array,
+    ) -> jax.Array:
+        mu, log_sd = var
+        x = meanfield_draws(mu, log_sd, key, self.n_mc)
+        # Python-mean over the MC draws: each draw is one pool window
+        # (vmap over a pool-placed program would serialize anyway via
+        # the callback's sequential vmap rule).
+        terms = [
+            self.compiled.logp_indices(self._unravel(x[i]), idx)
+            for i in range(self.n_mc)
+        ]
+        e_logp = sum(terms[1:], terms[0]) / float(self.n_mc)
+        return -(e_logp + gaussian_entropy(self.dim, jnp.sum(log_sd)))
+
+    def step(self, batch_idx: Any) -> str:
+        """Consume one arriving minibatch (1-D shard-index array).
+        Applies at most ONE optimizer update; returns the outcome."""
+        self.offered += 1
+        self._key, sub = jax.random.split(self._key)
+        idx = jnp.asarray(batch_idx, jnp.int32)
+        try:
+            with _deadline.deadline_scope(self.deadline_s):
+                loss, grads = jax.value_and_grad(self._neg_elbo)(
+                    (self.mu, self.log_sd), sub, idx
+                )
+                # Materialize before touching optimizer state: a pool
+                # failure must surface HERE, with zero state mutated.
+                loss = jax.block_until_ready(loss)
+                grads = jax.block_until_ready(grads)
+        except Exception as exc:  # noqa: BLE001 - classified below
+            outcome = _classify_skip(exc)
+            if outcome is None:
+                raise
+            self.skipped[outcome] = self.skipped.get(outcome, 0) + 1
+            SVI_BATCHES.labels(outcome=outcome).inc()
+            _flightrec.record(
+                "svi.shed",
+                outcome=outcome,
+                offered=self.offered,
+                error=f"{type(exc).__name__}: {str(exc)[:120]}",
+            )
+            return outcome
+        updates, self._opt_state = self._opt.update(
+            grads, self._opt_state
+        )
+        self.mu, self.log_sd = optax.apply_updates(
+            (self.mu, self.log_sd), updates
+        )
+        self.accepted += 1
+        elbo = float(-loss)
+        self.elbo_trace.append(elbo)
+        SVI_BATCHES.labels(outcome="accepted").inc()
+        SVI_ELBO.set(elbo)
+        _flightrec.record(
+            "svi.step",
+            step=self.accepted,
+            elbo=round(elbo, 3),
+            batch=int(idx.shape[0]),
+        )
+        return "accepted"
+
+    def consume(self, batches: Any) -> Dict[str, int]:
+        """Drain an iterable of index batches through :meth:`step`;
+        returns the outcome tally."""
+        tally: Dict[str, int] = {}
+        for batch in batches:
+            outcome = self.step(batch)
+            tally[outcome] = tally.get(outcome, 0) + 1
+        return tally
+
+    def result(self) -> Tuple[SVIResult, Callable[[jax.Array], Any]]:
+        """The fit so far, in the :func:`svi_fit` result shape."""
+        res = SVIResult(
+            mean=self._unravel(self.mu),
+            sd=self._unravel(jnp.exp(self.log_sd)),
+            elbo_trace=jnp.asarray(self.elbo_trace),
+            flat_mean=self.mu,
+            flat_log_sd=self.log_sd,
+        )
+        return res, self._unravel

@@ -18,7 +18,10 @@ Two approximation families:
   ``mu + L eps`` (a (d, d) matvec — MXU work), the entropy is
   ``Σ log L_ii`` in closed form.
 
-Both run the entire optimization in one ``lax.scan`` under jit.
+Both run the entire optimization in one ``lax.scan`` under jit —
+through the shared ELBO core (:mod:`..ppl.elbo`, ISSUE 15): the
+Gaussian-entropy kernel and the jitted scan loop live there ONCE,
+shared with the flow lane and the ``ppl`` SVI lanes.
 """
 
 from __future__ import annotations
@@ -28,7 +31,7 @@ from typing import Any, Callable, NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
-from ..utils import LOG_2PI
+from ..ppl.elbo import gaussian_entropy, meanfield_neg_elbo, scan_vi
 from .util import flatten_logp
 
 try:
@@ -101,39 +104,19 @@ def advi_fit(
 
     opt = optax.adam(learning_rate)
 
-    def neg_elbo(var_params, key):
-        mu, log_sd = var_params
-        if stochastic_logp_fn is None:
-            # keep the non-stochastic RNG stream EXACTLY as before the
-            # stochastic option existed (seeded tests pin it)
-            k_eps, k_mb = key, key
-        else:
-            k_eps, k_mb = jax.random.split(key)
-        eps = jax.random.normal(k_eps, (n_mc, dim), dtype)
-        x = mu[None, :] + jnp.exp(log_sd)[None, :] * eps
-        # E_q[logp] (MC; optionally minibatched) + entropy (closed form).
-        e_logp = e_logp_fn(x, k_mb)
-        entropy = jnp.sum(log_sd) + 0.5 * dim * (1.0 + LOG_2PI)
-        return -(e_logp + entropy)
-
-    @jax.jit
-    def run(key):
-        var0 = (flat_init, jnp.full((dim,), init_log_sd, dtype))
-        opt0 = opt.init(var0)
-
-        def step(carry, key):
-            var, opt_state = carry
-            loss, g = jax.value_and_grad(neg_elbo)(var, key)
-            updates, opt_state = opt.update(g, opt_state)
-            var = optax.apply_updates(var, updates)
-            return (var, opt_state), -loss
-
-        (var, _), elbos = jax.lax.scan(
-            step, (var0, opt0), jax.random.split(key, num_steps)
-        )
-        return var, elbos
-
-    (mu, log_sd), elbos = run(key)
+    # The shared estimator: split_keys=False keeps the non-stochastic
+    # RNG stream EXACTLY as before the stochastic option existed
+    # (seeded tests pin it).
+    neg_elbo = meanfield_neg_elbo(
+        e_logp_fn,
+        dim,
+        n_mc=n_mc,
+        split_keys=stochastic_logp_fn is not None,
+    )
+    var0 = (flat_init, jnp.full((dim,), init_log_sd, dtype))
+    (mu, log_sd), elbos = scan_vi(
+        neg_elbo, var0, key=key, num_steps=num_steps, optimizer=opt
+    )
     result = ADVIResult(
         mean=unravel(mu),
         sd=unravel(jnp.exp(log_sd)),
@@ -209,34 +192,19 @@ def fullrank_advi_fit(
         eps = jax.random.normal(key, (n_mc, dim), dtype)
         x = mu[None, :] + eps @ L.T
         e_logp = jnp.mean(batch_logp(x))
-        entropy = jnp.sum(jnp.log(jnp.diagonal(L))) + 0.5 * dim * (
-            1.0 + LOG_2PI
-        )
+        # Σ log L_ii is the full-rank log_sd_sum (shared kernel).
+        entropy = gaussian_entropy(dim, jnp.sum(jnp.log(jnp.diagonal(L))))
         return -(e_logp + entropy)
 
-    @jax.jit
-    def run(key):
-        theta0 = (
-            jnp.zeros((dim * (dim + 1) // 2,), dtype)
-            .at[diag_pos]
-            .set(init_log_sd)
-        )
-        var0 = (flat_init, theta0)
-        opt0 = opt.init(var0)
-
-        def step(carry, key):
-            var, opt_state = carry
-            loss, g = jax.value_and_grad(neg_elbo)(var, key)
-            updates, opt_state = opt.update(g, opt_state)
-            var = optax.apply_updates(var, updates)
-            return (var, opt_state), -loss
-
-        (var, _), elbos = jax.lax.scan(
-            step, (var0, opt0), jax.random.split(key, num_steps)
-        )
-        return var, elbos
-
-    (mu, theta), elbos = run(key)
+    theta0 = (
+        jnp.zeros((dim * (dim + 1) // 2,), dtype)
+        .at[diag_pos]
+        .set(init_log_sd)
+    )
+    var0 = (flat_init, theta0)
+    (mu, theta), elbos = scan_vi(
+        neg_elbo, var0, key=key, num_steps=num_steps, optimizer=opt
+    )
     L = _chol_from_theta(theta, dim, tril_idx)
     sd = jnp.sqrt(jnp.sum(L**2, axis=1))
     result = FullRankADVIResult(

@@ -27,6 +27,7 @@ from typing import Any, Callable, NamedTuple
 import jax
 import jax.numpy as jnp
 
+from ..ppl.elbo import gaussian_entropy, scan_vi
 from ..utils import LOG_2PI
 from .util import flatten_logp
 
@@ -147,7 +148,9 @@ def realnvp_advi_fit(
     ]
 
     opt = optax.adam(learning_rate)
-    base_entropy = 0.5 * dim * (1.0 + LOG_2PI)
+    # base-distribution entropy: the shared ppl.elbo Gaussian kernel
+    # with log_sd_sum = 0 (standard normal base).
+    base_entropy = gaussian_entropy(dim)
 
     def neg_elbo(flow, key):
         z = jax.random.normal(key, (n_mc, dim), dtype)
@@ -161,23 +164,9 @@ def realnvp_advi_fit(
         elbo = jnp.mean(batch_logp(x) + logdet) + base_entropy
         return -elbo
 
-    @jax.jit
-    def run(key):
-        opt0 = opt.init(flow0)
-
-        def step(carry, key):
-            flow, opt_state = carry
-            loss, g = jax.value_and_grad(neg_elbo)(flow, key)
-            updates, opt_state = opt.update(g, opt_state)
-            flow = optax.apply_updates(flow, updates)
-            return (flow, opt_state), -loss
-
-        (flow, _), elbos = jax.lax.scan(
-            step, (flow0, opt0), jax.random.split(key, num_steps)
-        )
-        return flow, elbos
-
-    flow, elbos = run(k_fit)
+    flow, elbos = scan_vi(
+        neg_elbo, flow0, key=k_fit, num_steps=num_steps, optimizer=opt
+    )
     result = FlowADVIResult(
         flow_params=flow,
         masks=masks,

@@ -1,0 +1,280 @@
+"""The shared ELBO core and the SVI lanes (ISSUE 15): batch SVI on
+compiled models, and streaming SVI through the gateway under the
+deadline regime — sheds skipped, never double-counted.
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytensor_federated_tpu import fed, ppl
+from pytensor_federated_tpu.ppl import PPLError
+from pytensor_federated_tpu.ppl.elbo import (
+    gaussian_entropy,
+    meanfield_draws,
+    scan_vi,
+)
+from pytensor_federated_tpu.ppl.radon import make_radon_example
+from pytensor_federated_tpu.ppl.svi import _classify_skip
+
+optax = pytest.importorskip("optax")
+
+
+@pytest.fixture(scope="module")
+def radon_small():
+    model, args, true = make_radon_example(8, mean_obs=8, seed=3)
+    return ppl.compile(model, args), true
+
+
+# ---------------------------------------------------------------------------
+# the shared core
+# ---------------------------------------------------------------------------
+
+
+class TestElboCore:
+    def test_gaussian_entropy_value(self):
+        import math
+
+        dim = 3
+        want = dim / 2 * (1 + math.log(2 * math.pi))
+        assert float(gaussian_entropy(dim)) == pytest.approx(want)
+        assert float(gaussian_entropy(dim, 1.5)) == pytest.approx(
+            want + 1.5
+        )
+
+    def test_meanfield_draws_shape_and_reparam(self):
+        mu = jnp.asarray([1.0, -1.0])
+        log_sd = jnp.asarray([0.0, jnp.log(2.0)])
+        x = meanfield_draws(mu, log_sd, jax.random.PRNGKey(0), 5000)
+        assert x.shape == (5000, 2)
+        np.testing.assert_allclose(
+            np.asarray(jnp.mean(x, 0)), [1.0, -1.0], atol=0.1
+        )
+        np.testing.assert_allclose(
+            np.asarray(jnp.std(x, 0)), [1.0, 2.0], atol=0.1
+        )
+
+    def test_scan_vi_matches_hand_rolled_loop(self):
+        """scan_vi is byte-for-byte the loop advi/flows ran: same
+        update order, same split stream, same results."""
+
+        def neg_elbo(var, key):
+            return jnp.sum((var - 3.0) ** 2) + 0.0 * key[0]
+
+        var0 = jnp.zeros((2,))
+        opt = optax.adam(0.1)
+        got_var, got_trace = scan_vi(
+            neg_elbo, var0, key=jax.random.PRNGKey(0),
+            num_steps=25, optimizer=opt,
+        )
+
+        var, opt_state = var0, opt.init(var0)
+        trace = []
+        for k in jax.random.split(jax.random.PRNGKey(0), 25):
+            loss, g = jax.value_and_grad(neg_elbo)(var, k)
+            updates, opt_state = opt.update(g, opt_state)
+            var = optax.apply_updates(var, updates)
+            trace.append(-loss)
+        np.testing.assert_allclose(
+            np.asarray(got_var), np.asarray(var), rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(got_trace), np.asarray(jnp.stack(trace)),
+            rtol=1e-5,
+        )
+
+    def test_advi_reuses_core(self):
+        """The satellite contract: samplers/advi.py optimizes through
+        the shared core (no second hand-rolled loop)."""
+        import inspect
+
+        from pytensor_federated_tpu.samplers import advi, flows
+
+        for mod in (advi, flows):
+            src = inspect.getsource(mod)
+            assert "scan_vi" in src and "gaussian_entropy" in src
+            # no residual hand-rolled optimization loop (docstrings
+            # may still SAY "lax.scan" — the call must be gone)
+            assert "jax.lax.scan(" not in src
+
+
+# ---------------------------------------------------------------------------
+# batch SVI
+# ---------------------------------------------------------------------------
+
+
+class TestBatchSVI:
+    def test_svi_fit_improves_and_recovers(self, radon_small):
+        compiled, true = radon_small
+        res, unravel = ppl.svi_fit(
+            compiled,
+            key=jax.random.PRNGKey(0),
+            num_steps=400,
+            n_mc=4,
+            learning_rate=5e-2,
+        )
+        assert float(res.elbo_trace[-1]) > float(res.elbo_trace[0])
+        assert abs(float(res.mean["mu_alpha"]) - true["mu_alpha"]) < 0.8
+        draws = res.sample(jax.random.PRNGKey(1), 16, unravel)
+        assert draws["alpha_raw"].shape == (16, 8)
+
+    def test_minibatch_svi_runs_and_improves(self, radon_small):
+        compiled, _ = radon_small
+        res, _ = ppl.svi_fit(
+            compiled,
+            key=jax.random.PRNGKey(0),
+            num_steps=300,
+            n_mc=2,
+            minibatch=True,
+            batch_size=4,
+            learning_rate=5e-2,
+        )
+        # minibatch ELBO estimates are noisy; compare smoothed ends
+        first = float(jnp.mean(res.elbo_trace[:50]))
+        last = float(jnp.mean(res.elbo_trace[-50:]))
+        assert last > first
+
+
+# ---------------------------------------------------------------------------
+# streaming SVI
+# ---------------------------------------------------------------------------
+
+
+class TestClassifySkip:
+    def test_deadline(self):
+        from pytensor_federated_tpu.service.deadline import (
+            DeadlineExceeded,
+        )
+
+        assert _classify_skip(DeadlineExceeded("x")) == "shed_deadline"
+        # wrapped by the callback layer: TYPE is lost, the in-band
+        # string survives
+        assert (
+            _classify_skip(
+                RuntimeError("... deadline exceeded: budget spent ...")
+            )
+            == "shed_deadline"
+        )
+
+    def test_overload(self):
+        from pytensor_federated_tpu.gateway.fairness import (
+            overload_error,
+        )
+
+        exc = RuntimeError(overload_error("svi", "quota"))
+        assert _classify_skip(exc) == "shed_overload"
+
+    def test_transient_vs_programming_error(self):
+        assert _classify_skip(ConnectionError("boom")) == "failed"
+        assert _classify_skip(RuntimeError("node died")) == "failed"
+        assert _classify_skip(PPLError("bad model")) is None
+        assert _classify_skip(TypeError("bug")) is None
+        # the callback layer erases the type; the traceback text
+        # still names the deterministic model bug -> must propagate
+        assert (
+            _classify_skip(
+                RuntimeError("...PPLError: duplicate site name 'w'...")
+            )
+            is None
+        )
+
+
+class TestStreamingSVI:
+    def test_local_accounting(self, radon_small):
+        compiled, _ = radon_small
+        svi = ppl.StreamingSVI(
+            compiled, key=jax.random.PRNGKey(0), n_mc=2,
+            learning_rate=5e-2,
+        )
+        rng = np.random.default_rng(0)
+        tally = svi.consume(
+            rng.choice(8, size=4, replace=False) for _ in range(15)
+        )
+        assert tally == {"accepted": 15}
+        assert svi.offered == svi.accepted == 15
+        assert svi.opt_steps == 15  # the optimizer's own counter
+        assert len(svi.elbo_trace) == 15
+        res, _ = svi.result()
+        assert res.flat_mean.shape == svi.mu.shape
+
+    def test_streaming_through_gateway_with_sheds(self, radon_small):
+        """The full streaming loop: windows ride the gateway; a
+        deadline-starved batch is SHED and provably skipped (the
+        optimizer's step counter never moves), then service resumes."""
+        from pytensor_federated_tpu.gateway import (
+            GatewayThread,
+            TenantFairness,
+        )
+        from pytensor_federated_tpu.routing import NodePool
+        from pytensor_federated_tpu.service.tcp import (
+            TcpArraysClient,
+            serve_tcp_once,
+        )
+
+        compiled, _ = radon_small
+        ports, evs = [], []
+        for _ in range(2):
+            ev = threading.Event()
+            evs.append(ev)
+            threading.Thread(
+                target=serve_tcp_once,
+                args=(compiled.node_compute(),),
+                daemon=True,
+                kwargs=dict(
+                    ready_callback=lambda p, e=ev: (
+                        ports.append(p), e.set()
+                    ),
+                    concurrent=True,
+                ),
+            ).start()
+        assert all(e.wait(30) for e in evs)
+        pool = NodePool(
+            [("127.0.0.1", p) for p in ports], transport="tcp"
+        )
+        pool.start()
+        gw = GatewayThread(
+            pool, fairness=TenantFairness(), frame_items=16
+        )
+        gw.start()
+        cli = TcpArraysClient("127.0.0.1", gw.port, tenant="svi")
+        try:
+            pc = ppl.compile(
+                compiled.model,
+                compiled.model_args,
+                placement=fed.PoolPlacement(cli, window=8, tag="svi"),
+            )
+            svi = ppl.StreamingSVI(
+                pc, key=jax.random.PRNGKey(0), n_mc=2,
+                learning_rate=5e-2, deadline_s=60.0,
+            )
+            rng = np.random.default_rng(1)
+
+            def batch():
+                return rng.choice(8, size=4, replace=False)
+
+            for _ in range(6):
+                assert svi.step(batch()) == "accepted"
+            # starve one batch
+            svi.deadline_s = 1e-4
+            assert svi.step(batch()) == "shed_deadline"
+            assert svi.opt_steps == svi.accepted == 6
+            # recovery: the shed batch did not poison the lane
+            svi.deadline_s = 60.0
+            assert svi.step(batch()) == "accepted"
+            assert svi.opt_steps == svi.accepted == 7
+            assert svi.offered == 8
+            assert svi.skipped == {"shed_deadline": 1}
+        finally:
+            cli.close()
+            gw.stop()
+            pool.close()
+
+    def test_unclassified_errors_propagate(self, radon_small):
+        compiled, _ = radon_small
+        svi = ppl.StreamingSVI(compiled, key=jax.random.PRNGKey(0))
+        with pytest.raises(PPLError):
+            svi.step(np.zeros((2, 2)))  # 2-D batch: a caller bug
+        assert svi.accepted == 0 and svi.opt_steps == 0

@@ -1699,13 +1699,14 @@ def run_shard_seed(seed: int, verbose: bool) -> dict:
         result["faults_fired"] = driver_plan.total_fires
     except Violation as v:
         bundle = write_incident_bundle(
-            reason=f"chaos-shard-seed-{seed}", note=str(v)
+            f"chaos-shard-seed-{seed}",
+            attrs={"seed": seed, "violation": str(v)[:500]},
         )
         result.update(ok=False, error=str(v), bundle=bundle)
     except Exception as e:  # harness bug: loud, with a bundle
         bundle = write_incident_bundle(
-            reason=f"chaos-shard-seed-{seed}-harness",
-            note=f"{type(e).__name__}: {e}",
+            f"chaos-shard-seed-{seed}-harness",
+            attrs={"seed": seed, "error": f"{type(e).__name__}: {e}"},
         )
         result.update(
             ok=False,
@@ -1719,6 +1720,383 @@ def run_shard_seed(seed: int, verbose: bool) -> dict:
                 proc.terminate()
         for proc in list(mids) + leaves:
             proc.join(timeout=10)
+    return result
+
+
+# -- the streaming lane (ISSUE 15) ------------------------------------------
+
+
+def _streaming_compiled(placement=None):
+    """The radon-8 ppl model BOTH sides build — driver and node
+    children import this same function, so the per-shard compute
+    cannot drift between them (the make_node_compute contract)."""
+    from pytensor_federated_tpu import ppl
+    from pytensor_federated_tpu.ppl.radon import make_radon_example
+
+    model, args, _ = make_radon_example(8, mean_obs=6, seed=7)
+    return ppl.compile(model, args, placement=placement)
+
+
+def _serve_ppl_node(port: int) -> None:
+    """One streaming-lane replica: the ppl-compiled radon per-shard
+    ``[logp, *grads]`` compute over TCP.  A PFTPU_FAULT_PLAN inherited
+    from the parent env was activated at package import — the rules
+    fire at this node's server.compute / tcp.* seams."""
+    import logging
+
+    logging.disable(logging.ERROR)
+
+    from pytensor_federated_tpu.utils import force_cpu_backend
+
+    force_cpu_backend()
+
+    from pytensor_federated_tpu.service.tcp import serve_tcp_once
+
+    compiled = _streaming_compiled()
+    serve_tcp_once(
+        compiled.node_compute(), "127.0.0.1", port, concurrent=True
+    )
+
+
+def _spawn_ppl_node(port, plan_json=None):
+    saved = os.environ.get(fi.runtime.ENV_VAR)
+    if plan_json is not None:
+        os.environ[fi.runtime.ENV_VAR] = plan_json
+    else:
+        os.environ.pop(fi.runtime.ENV_VAR, None)
+    try:
+        ctx = mp.get_context("spawn")
+        proc = ctx.Process(
+            target=_serve_ppl_node, args=(port,), daemon=True
+        )
+        proc.start()
+    finally:
+        if saved is None:
+            os.environ.pop(fi.runtime.ENV_VAR, None)
+        else:
+            os.environ[fi.runtime.ENV_VAR] = saved
+    return proc
+
+
+def _streaming_node_templates():
+    """Victim-node rules: a stall past the step deadline (must become
+    a SHED minibatch), compute errors (a skipped batch), and byte
+    faults on the reply path (classified transient skips)."""
+    return [
+        ("slow_compute", dict(point="server.compute", delay_s=8.0,
+                              max_fires=2)),
+        ("compute_error", dict(point="server.compute", max_fires=2)),
+        ("disconnect", dict(point="tcp.send", max_fires=1)),
+        ("delay", dict(point="tcp.send", delay_s=0.05, max_fires=3)),
+    ]
+
+
+def run_streaming_seed(seed: int, verbose: bool) -> dict:
+    """One streaming-SVI scenario (``--lane streaming``): the gateway
+    feeds a :class:`~pytensor_federated_tpu.ppl.StreamingSVI` driver
+    from a 2-replica pool while one replica runs a seeded fault plan,
+    one replica flaps (killed mid-stream, respawned), and a hog tenant
+    floods the front door.  Invariants (ISSUE 15 acceptance):
+
+    T1 no double-count — the optimizer's OWN step counter equals the
+       accepted-batch count equals the ELBO-trace length: a shed
+       minibatch provably never stepped the optimizer, and no batch
+       stepped it twice;
+    T2 exact accounting — offered == accepted + skipped, and the
+       ``pftpu_svi_batches_total{outcome=accepted}`` counter moved by
+       exactly the accepted count (the step-counter telemetry the
+       acceptance criterion names);
+    T3 goodput floor — despite the faults, the flap, and the hog,
+       at least ``goodput_floor`` of offered batches are accepted;
+    T4 ELBO envelope — over the accepted steps the ELBO improves
+       (mean of the last third above the mean of the first third):
+       sheds may slow convergence, never corrupt it;
+    T5 no hang — every step settles within CALL_DEADLINE_S;
+    T6 fairness — the hog tenant drew at least one loud quota denial
+       while the svi tenant kept its goodput.
+    """
+
+    def log(msg):
+        if verbose:
+            print(msg, flush=True)
+
+    import jax
+
+    from pytensor_federated_tpu import fed, ppl
+    from pytensor_federated_tpu.gateway import GatewayThread, TenantFairness
+    from pytensor_federated_tpu.gateway.fairness import is_overload_error
+    from pytensor_federated_tpu.ppl.svi import SVI_BATCHES
+    from pytensor_federated_tpu.routing import NodePool
+    from pytensor_federated_tpu.service.tcp import TcpArraysClient
+
+    rng = random.Random(seed ^ 0x57E4)
+    params = {
+        "n_batches": 42,
+        "batch": 4,
+        "deadline_s": 6.0,
+        "goodput_floor": 0.55,
+        "envelope_min_accepted": 18,
+        "flap_after_s": rng.uniform(1.0, 3.0),
+        "flap_down_s": rng.uniform(0.5, 1.5),
+        # Quota sized so the svi tenant (~25 req/s in 8-item spikes)
+        # stays inside while the hog's CONCURRENT 25-item windows
+        # (3 connections firing at once — admission is instant, so
+        # the spike lands before the node computes anything) blow
+        # straight through the burst.
+        "quota_rate_per_s": 120.0,
+        "quota_burst": 30.0,
+        "hog_conns": 3,
+        "hog_windows": 12,
+        "hog_window_items": 25,
+    }
+    node_rules = []
+    for kind, kw in rng.sample(
+        _streaming_node_templates(), rng.randint(1, 3)
+    ):
+        kw = dict(kw)
+        if rng.random() < 0.5:
+            kw["nth"] = rng.randint(3, 9)
+            kw.pop("max_fires", None)
+        node_rules.append(fi.FaultRule(kind, **kw))
+    node_plan_json = fi.FaultPlan(
+        node_rules, seed=seed, plan_id=f"streaming-{seed}-node"
+    ).to_json()
+    log(
+        f"streaming seed {seed}: {params}, victim rules "
+        f"{[r.to_dict() for r in node_rules]}"
+    )
+    tspans.set_enabled(True)
+    flightrec.set_enabled(True)
+    if flightrec.capacity() < 16384:
+        flightrec.set_capacity(16384)
+    flightrec.clear()
+
+    ports = _free_ports(2)
+    victim = rng.randrange(2)
+    flap_target = 1 - victim  # the healthy replica flaps
+    procs = [
+        _spawn_ppl_node(p, node_plan_json if k == victim else None)
+        for k, p in enumerate(ports)
+    ]
+    result = {"seed": seed, "transport": "streaming", "ok": True}
+    pool = None
+    gw = None
+    cli = None
+    stop_evt = threading.Event()
+    hog_denied = []
+    threads = []
+    try:
+        _wait_nodes_up("tcp", ports)
+        pool = NodePool(
+            [("127.0.0.1", p) for p in ports],
+            transport="tcp",
+            probe_interval_s=0.3,
+            probe_timeout_s=2.0,
+            breaker_kwargs=dict(
+                failure_threshold=2, backoff_s=0.2, jitter_frac=0.1
+            ),
+        )
+        pool.start()
+        gw = GatewayThread(
+            pool,
+            fairness=TenantFairness(
+                quota_rate_per_s=params["quota_rate_per_s"],
+                quota_burst=params["quota_burst"],
+                max_backlog_per_tenant=4096,
+            ),
+            frame_items=16,
+        )
+        gw.start()
+        cli = TcpArraysClient("127.0.0.1", gw.port, tenant="svi")
+        compiled = _streaming_compiled(
+            placement=fed.PoolPlacement(cli, window=8, tag="svi")
+        )
+        svi = ppl.StreamingSVI(
+            compiled,
+            key=jax.random.PRNGKey(seed),
+            n_mc=2,
+            learning_rate=5e-2,
+            deadline_s=None,  # warmup: no budget while jits compile
+        )
+        batches = np.random.default_rng(seed)
+
+        def next_batch():
+            return batches.choice(
+                8, size=params["batch"], replace=False
+            )
+
+        # Warm the driver trace and both node jit caches without a
+        # deadline, then baseline the ledger: the invariants cover the
+        # chaos phase only (warmup steps may already meet node-plan
+        # faults — they are part of the run, just not of the floor).
+        for _ in range(3):
+            svi.step(next_batch())
+        base = dict(
+            offered=svi.offered,
+            accepted=svi.accepted,
+            opt=svi.opt_steps,
+            elbo=len(svi.elbo_trace),
+            skipped=sum(svi.skipped.values()),
+            counter=SVI_BATCHES.labels(outcome="accepted").value,
+        )
+
+        hog_req = tuple(
+            np.asarray(leaf)
+            for leaf in jax.tree_util.tree_leaves(
+                compiled.init_params()
+            )
+        ) + (np.int32(0),)
+
+        def hog():
+            hc = TcpArraysClient(
+                "127.0.0.1", gw.port, tenant="hog", timeout_s=10.0
+            )
+            reqs = [hog_req] * params["hog_window_items"]
+            try:
+                for _ in range(params["hog_windows"]):
+                    if stop_evt.is_set():
+                        return
+                    try:
+                        hc.evaluate_many(
+                            reqs, window=params["hog_window_items"]
+                        )
+                    except Exception as e:  # noqa: BLE001 - tallied
+                        if is_overload_error(str(e)):
+                            hog_denied.append(1)
+                        else:
+                            log(
+                                f"  hog: {type(e).__name__}: "
+                                f"{str(e)[:100]}"
+                            )
+            finally:
+                try:
+                    hc.close()
+                except Exception:
+                    pass
+
+        def flapper():
+            time.sleep(params["flap_after_s"])
+            log(f"  flapping replica {flap_target}")
+            proc = procs[flap_target]
+            if proc.is_alive():
+                proc.terminate()
+            time.sleep(params["flap_down_s"])
+            procs[flap_target] = _spawn_ppl_node(
+                ports[flap_target], None
+            )
+
+        for target in [hog] * params["hog_conns"] + [flapper]:
+            t = threading.Thread(target=target, daemon=True)
+            t.start()
+            threads.append(t)
+
+        svi.deadline_s = params["deadline_s"]
+        for i in range(params["n_batches"]):
+            t0 = time.time()
+            outcome = svi.step(next_batch())
+            wall = time.time() - t0
+            if wall > CALL_DEADLINE_S:
+                raise Violation(
+                    f"step {i}: {wall:.1f}s wall past "
+                    f"{CALL_DEADLINE_S}s (hang)"
+                )
+            log(f"  batch {i}: {outcome} ({wall * 1e3:.0f} ms)")
+        stop_evt.set()
+
+        offered = svi.offered - base["offered"]
+        accepted = svi.accepted - base["accepted"]
+        opt_delta = svi.opt_steps - base["opt"]
+        elbo_delta = len(svi.elbo_trace) - base["elbo"]
+        skipped = sum(svi.skipped.values()) - base["skipped"]
+        counter_delta = (
+            SVI_BATCHES.labels(outcome="accepted").value
+            - base["counter"]
+        )
+        # T1: the optimizer's own counter is the double-count proof.
+        if not (opt_delta == accepted == elbo_delta):
+            raise Violation(
+                f"step accounting broke: opt_steps Δ{opt_delta}, "
+                f"accepted Δ{accepted}, elbo Δ{elbo_delta} "
+                "(double-counted or ghost gradient)"
+            )
+        # T2: every batch accounted exactly once, and the telemetry
+        # step counter moved in lockstep.
+        if offered != accepted + skipped:
+            raise Violation(
+                f"batch accounting broke: offered {offered} != "
+                f"accepted {accepted} + skipped {skipped}"
+            )
+        if counter_delta != accepted:
+            raise Violation(
+                f"telemetry step counter Δ{counter_delta} != "
+                f"accepted Δ{accepted}"
+            )
+        # T3: goodput floor.
+        if accepted < params["goodput_floor"] * offered:
+            raise Violation(
+                f"goodput collapsed: {accepted}/{offered} accepted "
+                f"(floor {params['goodput_floor']})"
+            )
+        # T4: ELBO monotone-ish envelope over the accepted steps.
+        if accepted >= params["envelope_min_accepted"]:
+            trace = svi.elbo_trace[base["elbo"] :]
+            third = max(1, len(trace) // 3)
+            first = float(np.mean(trace[:third]))
+            last = float(np.mean(trace[-third:]))
+            if not last > first:
+                raise Violation(
+                    f"ELBO envelope broke: first-third {first:.2f} "
+                    f">= last-third {last:.2f}"
+                )
+        # T6: the hog drew loud denials while svi kept goodput.
+        if not hog_denied:
+            raise Violation(
+                "hog never out-ran its quota — lane mis-tuned"
+            )
+        result.update(
+            offered=offered,
+            accepted=accepted,
+            skipped_kinds=dict(svi.skipped),
+            hog_denied=len(hog_denied),
+            elbo_last=round(svi.elbo_trace[-1], 2)
+            if svi.elbo_trace
+            else None,
+        )
+    except Violation as v:
+        bundle = write_incident_bundle(
+            f"chaos-streaming-seed-{seed}",
+            attrs={"seed": seed, "violation": str(v)[:500]},
+        )
+        result.update(ok=False, error=str(v), bundle=bundle)
+    except Exception as e:  # harness bug: loud, with a bundle
+        bundle = write_incident_bundle(
+            f"chaos-streaming-seed-{seed}-harness",
+            attrs={"seed": seed, "error": f"{type(e).__name__}: {e}"},
+        )
+        result.update(
+            ok=False,
+            error=f"harness: {type(e).__name__}: {e}",
+            bundle=bundle,
+        )
+    finally:
+        stop_evt.set()
+        for t in threads:
+            t.join(timeout=15)
+        if cli is not None:
+            try:
+                cli.close()
+            except Exception:
+                pass
+        if gw is not None:
+            gw.stop()
+        if pool is not None:
+            pool.close()
+        for proc in procs:
+            if proc.is_alive():
+                proc.terminate()
+        for proc in procs:
+            proc.join(timeout=10)
+        flightrec.clear()
     return result
 
 
@@ -1822,7 +2200,8 @@ def main(argv=None) -> int:
     ap.add_argument("--base-seed", type=int, default=0)
     ap.add_argument("--transport", "--lane", dest="transport",
                     choices=("grpc", "tcp", "shm", "overload",
-                             "collector", "gateway", "shard"),
+                             "collector", "gateway", "shard",
+                             "streaming"),
                     default="grpc",
                     help="transport lane under chaos (--lane is an "
                     "alias; 'shm' runs the zero-copy arena lane; "
@@ -1840,7 +2219,13 @@ def main(argv=None) -> int:
                     "2x2 aggregation tree, one mid-tier dropping/"
                     "duplicating/corrupting shard slices and dying "
                     "mid-aggregation — loud reassembly, zero hangs, "
-                    "no silently-wrong gradients)")
+                    "no silently-wrong gradients; 'streaming' runs "
+                    "the ISSUE-15 scenario: the gateway feeding "
+                    "streaming SVI with a faulted replica, a flapping "
+                    "replica, and a hog tenant — optimizer steps == "
+                    "accepted batches, shed minibatches provably "
+                    "skipped never double-counted, ELBO envelope "
+                    "holds, goodput floor)")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
 
@@ -1860,6 +2245,8 @@ def main(argv=None) -> int:
             res = run_gateway_seed(seed, args.verbose)
         elif args.transport == "shard":
             res = run_shard_seed(seed, args.verbose)
+        elif args.transport == "streaming":
+            res = run_streaming_seed(seed, args.verbose)
         else:
             res = run_seed(seed, args.transport, args.verbose)
         status = "ok" if res["ok"] else "FAIL"
@@ -1880,6 +2267,13 @@ def main(argv=None) -> int:
             extra = (
                 f"sweeps={res.get('sweeps')} "
                 f"stale_sweeps={res.get('stale_sweeps')}"
+            )
+        elif args.transport == "streaming":
+            extra = (
+                f"accepted={res.get('accepted')}/{res.get('offered')} "
+                f"skipped={res.get('skipped_kinds')} "
+                f"hog_denied={res.get('hog_denied')} "
+                f"elbo={res.get('elbo_last')}"
             )
         else:
             extra = (

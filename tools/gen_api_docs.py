@@ -81,6 +81,16 @@ MODULES = [
     "pytensor_federated_tpu.gateway.server",
     "pytensor_federated_tpu.gateway.fairness",
     "pytensor_federated_tpu.gateway.autoscale",
+    # Effect-handler probabilistic front end (ISSUE 15): primitives +
+    # handlers, the distribution objects, the plate->fed compiler, the
+    # shared ELBO core, and the SVI lanes.
+    "pytensor_federated_tpu.ppl",
+    "pytensor_federated_tpu.ppl.distributions",
+    "pytensor_federated_tpu.ppl.handlers",
+    "pytensor_federated_tpu.ppl.compiler",
+    "pytensor_federated_tpu.ppl.elbo",
+    "pytensor_federated_tpu.ppl.svi",
+    "pytensor_federated_tpu.ppl.radon",
     # Fault-injection subsystem (ISSUE 5): the plan vocabulary and the
     # runtime primitives the shims call are both public surface — chaos
     # plans are authored against them (docs/robustness.md).
