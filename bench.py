@@ -581,6 +581,90 @@ def shm_overhead(n_pings: int = 300) -> dict:
     }
 
 
+def sharded_update_overhead(n_round: int = 2_000) -> dict:
+    """Driver-side cost gate for the ZeRO-style sharded optimizer
+    (ISSUE 16): what one sharded step adds on TOP of the wire compared
+    with a plain evaluate — the version stamp on every update frame
+    and the slice-fold bookkeeping when the replies land.  Must stay a
+    small fraction of the ~110 us RPC floor: the lane's win is moving
+    optimizer state and gradient bytes off the driver, and a fat
+    driver-side fold would hand the savings straight back as CPU.
+
+    Two measurements, best-of-3 like the sibling gates:
+
+    - ``stamp_us``: encode a 16k-element f32 update request WITH the
+      partition + version blocks minus the same frame without them —
+      the pure wire delta per update request (flag byte, geometry,
+      one u64).
+    - ``apply_us``: fold 8 applied :class:`ShardResult` update slices
+      into the 16k driver parameter vector via
+      :meth:`ShardedOptimizer.apply` plus one
+      :func:`parse_stale_error` classification — the whole
+      driver-side bookkeeping of one 8-owner step.
+
+    PASSES when stamp + apply stays under 50% of the RPC floor."""
+    from pytensor_federated_tpu.optim import (
+        ShardedOptimizer,
+        parse_stale_error,
+        stale_message,
+    )
+    from pytensor_federated_tpu.optim.sharded import ShardResult
+    from pytensor_federated_tpu.service.npwire import encode_arrays
+
+    total, count = 16_384, 8
+
+    class _Stub:  # never dialed: apply() is pure driver-side math
+        evaluate_versioned = staticmethod(lambda *a, **k: None)
+
+    opt = ShardedOptimizer(total, clients=[_Stub()] * count)
+    flat = np.zeros(total, np.float32)
+    params = np.random.default_rng(0).normal(size=total).astype(np.float32)
+    slices = [
+        params[p.offset : p.offset + p.length].copy() for p in opt.parts
+    ]
+    stale = stale_message(opt.parts[0], holds=3, expected=2)
+
+    def stamp_loop() -> float:
+        part = tuple(opt.parts[0])
+        t0 = time.perf_counter()
+        for i in range(n_round):
+            encode_arrays(
+                [params], uuid=b"u" * 16, partition=part, version=i
+            )
+        versioned = (time.perf_counter() - t0) / n_round
+        t0 = time.perf_counter()
+        for _ in range(n_round):
+            encode_arrays([params], uuid=b"u" * 16)
+        plain = (time.perf_counter() - t0) / n_round
+        return max(0.0, versioned - plain)
+
+    def apply_loop() -> float:
+        results = [
+            ShardResult(k, "applied", 1, loss=0.0, update=slices[k])
+            for k in range(count)
+        ]
+        t0 = time.perf_counter()
+        for _ in range(n_round):
+            opt.apply(flat, results)
+            parse_stale_error(stale)
+        return (time.perf_counter() - t0) / n_round
+
+    stamp_s = apply_s = float("inf")
+    for _ in range(3):
+        stamp_s = min(stamp_s, stamp_loop())
+        apply_s = min(apply_s, apply_loop())
+    rpc_floor_s = 110e-6  # docs/performance.md "Host lane budget"
+    frac = (stamp_s + apply_s) / rpc_floor_s
+    return {
+        "stamp_us": round(stamp_s * 1e6, 2),
+        "apply_us": round(apply_s * 1e6, 2),
+        "total_elems": total,
+        "count": count,
+        "step_frac_of_rpc_floor": round(frac, 4),
+        "pass": bool(frac < 0.50),
+    }
+
+
 def gateway_overhead(n_calls: int = 200) -> dict:
     """Uncontended-path latency gate for the gateway tier (ISSUE 12):
     the same lock-step call measured direct-to-node and through a
@@ -1112,6 +1196,11 @@ def main():
     except Exception as e:  # same invariant
         gateway_gate = {"error": f"{type(e).__name__}: {e}", "pass": False}
 
+    try:
+        sharded_gate = sharded_update_overhead()
+    except Exception as e:  # same invariant
+        sharded_gate = {"error": f"{type(e).__name__}: {e}", "pass": False}
+
     # The shm race lane's node is no longer needed once measurement
     # and gates are done (the gates spin their own in-process node).
     if shm_client is not None:
@@ -1144,6 +1233,7 @@ def main():
                 "partition_overhead": partition_gate,
                 "collector_overhead": collector_gate,
                 "gateway_overhead": gateway_gate,
+                "sharded_update_overhead": sharded_gate,
                 **flop_extra,
             }
         )

@@ -98,14 +98,17 @@ constexpr uint8_t kFlagBatch = 8;
 constexpr uint8_t kFlagDeadline = 16;
 constexpr uint8_t kFlagTenant = 32;
 constexpr uint8_t kFlagPartition = 64;
+constexpr uint8_t kFlagVersion = 128;
 // Every known flag bit, mirrored from service/wire_registry.py (the
 // declared source; graftlint's wire-registry rule cross-checks this
 // file).  Decoders reject any bit outside the mask: an unknown flag
 // means blocks this build cannot place, and skipping them would be
 // silent mis-parsing of everything after (loud-failure contract).
+// ISSUE 16 (kFlagVersion) saturated the byte; the header's version
+// field is the remaining escape hatch for layout changes.
 constexpr uint8_t kKnownFlags = kFlagError | kFlagTrace | kFlagSpans |
                                 kFlagBatch | kFlagDeadline | kFlagTenant |
-                                kFlagPartition;
+                                kFlagPartition | kFlagVersion;
 // flags byte offset in the payload: magic(4) + version(1)
 constexpr size_t kFlagsOff = 5;
 
@@ -144,6 +147,13 @@ struct Message {
   // pre-partition wire (byte-identical replies).
   bool has_partition = false;
   Partition partition;
+  // Step-version stamp (flag 128) — the sharded-optimizer lane
+  // (optim/sharded.py).  This node holds no optimizer state, so a
+  // versioned request is refused loudly in-band (serve_plain); the
+  // block is still framing-validated here so the refusal names the
+  // right problem, never a mis-parse.
+  bool has_version = false;
+  uint64_t step_version = 0;
 };
 
 // ---- low-level IO -------------------------------------------------------
@@ -287,6 +297,15 @@ bool decode(const std::vector<uint8_t>& buf, Message* msg, std::string* why) {
       return false;
     }
     msg->has_partition = true;
+  }
+  if (flags & kFlagVersion) {
+    // Step-version stamp: one u64 after the partition block
+    // (wire_registry.VERSION_STRUCT).  Zero is a meaningful stamp.
+    if (!r.le(&msg->step_version)) {
+      *why = "truncated version block";
+      return false;
+    }
+    msg->has_version = true;
   }
   // Each array needs >= 11 bytes of headers (2 dtype-len + 1 ndim +
   // 8 data-len), so any frame can hold at most remaining/11 arrays.
@@ -463,6 +482,15 @@ std::vector<uint8_t> serve_plain(const std::vector<uint8_t>& buf) {
       // Python client maps this marker to its DeadlineExceeded class.
       std::memcpy(reply.uuid, in.uuid, 16);
       reply.error = "deadline exceeded: budget spent before admission";
+    } else if (in.has_version) {
+      // The sharded-optimizer lane (flag 128) needs node-owned
+      // optimizer state; this node has none.  Loud and in-band so a
+      // mis-negotiated driver fails over instead of decoding a reply
+      // that silently never applied its update.
+      std::memcpy(reply.uuid, in.uuid, 16);
+      reply.error =
+          "versioned sharded-optimizer updates are not supported by "
+          "the native node";
     } else {
       reply = compute(in);
       if (in.has_partition) apply_partition(in.partition, &reply);
@@ -549,6 +577,16 @@ std::vector<uint8_t> serve_batch(const std::vector<uint8_t>& buf) {
       return batch_error_reply("decode failed: truncated partition block");
     return batch_error_reply(
         "partition reduce windows are not supported by the native node");
+  }
+  if (flags & kFlagVersion) {
+    // Outer version stamp on a batch frame = the sharded-optimizer
+    // lane; same refusal posture as reduce windows above.
+    uint64_t step_version = 0;
+    if (!r.le(&step_version))
+      return batch_error_reply("decode failed: truncated version block");
+    return batch_error_reply(
+        "versioned sharded-optimizer updates are not supported by the "
+        "native node");
   }
   // Each item needs >= 4 bytes (its length prefix), so any frame holds
   // at most remaining/4 items — reject hostile counts before looping.

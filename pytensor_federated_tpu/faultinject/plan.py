@@ -110,6 +110,8 @@ FAULT_KINDS = frozenset(
         "drop_shard",
         "dup_shard",
         "corrupt_shard",
+        "stale_param_version",
+        "drop_param_refresh",
     }
 )
 

@@ -102,6 +102,8 @@ __all__ = [
     "arena_fault",
     "corrupt_descriptor_bytes",
     "shard_filter",
+    "version_filter",
+    "refresh_filter",
     "snapshot",
 ]
 
@@ -565,6 +567,48 @@ def shard_filter(
         time.sleep(rule.delay_s)
         return items
     raise FaultPlanError(f"fault kind {kind!r} not applicable at {point}")
+
+
+def version_filter(
+    point: str, version: int, peer: Optional[str] = None
+) -> int:
+    """Sharded-optimizer version-stamp shim (ISSUE 16): a
+    ``stale_param_version`` rule TWISTS the step-version stamp the
+    driver is about to put on an update request, so the node's version
+    check refuses it — what chaos verifies is the driver's recovery
+    classification (a refusal whose ``holds`` equals the driver's own
+    version means NO step happened; the driver must not count it
+    accepted).  ``delay`` sleeps (driver-side sync lane)."""
+    rule = decide(point, peer)
+    if rule is None:
+        return version
+    if rule.kind == "stale_param_version":
+        # Twist DOWN when possible (the classic lost-driver-state
+        # shape); a version-0 stamp twists up instead (u64 wire).
+        return version - 1 if version > 0 else version + 1
+    if rule.kind == "delay":
+        time.sleep(rule.delay_s)
+        return version
+    raise FaultPlanError(f"fault kind {rule.kind!r} not applicable at {point}")
+
+
+def refresh_filter(point: str, peer: Optional[str] = None) -> None:
+    """Param-refresh lane shim (ISSUE 16): a ``drop_param_refresh``
+    rule raises :class:`ConnectionError` in place of the refresh call —
+    a dropped refresh is a TRANSIENT transport failure (the shard stays
+    at its old version; the next step's already-applied recovery
+    retries the refresh), never a silent stale parameter read."""
+    rule = decide(point, peer)
+    if rule is None:
+        return
+    if rule.kind == "drop_param_refresh":
+        raise ConnectionError(
+            f"fault injection: dropped param refresh at {point}"
+        )
+    if rule.kind == "delay":
+        time.sleep(rule.delay_s)
+        return
+    raise FaultPlanError(f"fault kind {rule.kind!r} not applicable at {point}")
 
 
 def probe_filter(peer: str, point: str = "pool.probe") -> bool:

@@ -145,9 +145,47 @@ def _ppl_subsample_round() -> FixtureProgram:
     ) + (idx,)
 
 
+def _zero_owner_update() -> FixtureProgram:
+    """The sharded-optimizer OWNER round (ISSUE 16): the mean-field
+    neg-ELBO an owner replica differentiates per versioned update —
+    the minibatch lowering under MC draws, parameters arriving as
+    request arrays (mu, log_sd), the RNG key and index batch as data
+    leaves.  The update compute executes this node-LOCALLY, but the
+    inner ``logp_indices`` round is the same pool-lane program shape
+    as ``ppl-subsample-round``; registering the full estimator keeps
+    the owner's closure honest too (a driver-varying capture here
+    would bake stale state into every owner)."""
+    import jax
+    import jax.numpy as jnp
+
+    from jax.flatten_util import ravel_pytree
+
+    from ..ppl.svi import make_meanfield_neg_elbo
+
+    compiled = _ppl_example()
+    flat0, unravel = ravel_pytree(compiled.init_params())
+    dim = int(flat0.shape[0])
+    neg_elbo = make_meanfield_neg_elbo(compiled, unravel, dim, 2)
+
+    def owner_round(
+        mu: Any, log_sd: Any, key: Any, idx: Any
+    ) -> Any:
+        return neg_elbo((mu, log_sd), key, idx)
+
+    key = jax.random.PRNGKey(0)
+    idx = jnp.asarray([0, 2], jnp.int32)
+    return owner_round, (
+        jnp.zeros((dim,), flat0.dtype),
+        jnp.full((dim,), -2.0, flat0.dtype),
+        key,
+        idx,
+    )
+
+
 FIXTURES: Sequence[LintFixture] = (
     LintFixture(name="canonical-round", build=_canonical_round),
     LintFixture(name="two-potential-window", build=_two_potential_window),
     LintFixture(name="ppl-plate-round", build=_ppl_plate_round),
     LintFixture(name="ppl-subsample-round", build=_ppl_subsample_round),
+    LintFixture(name="zero-owner-update", build=_zero_owner_update),
 )

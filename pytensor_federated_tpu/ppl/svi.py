@@ -39,6 +39,7 @@ import numpy as np
 from jax.flatten_util import ravel_pytree
 
 from ..service import deadline as _deadline
+from ..service.npwire import WireError as _WireError
 from ..telemetry import flightrec as _flightrec
 from ..telemetry import metrics as _metrics
 from .compiler import CompiledModel
@@ -52,7 +53,13 @@ try:
 except ModuleNotFoundError:  # pragma: no cover
     _HAS_OPTAX = False
 
-__all__ = ["StreamingSVI", "SVIResult", "svi_fit"]
+__all__ = [
+    "StreamingSVI",
+    "SVIResult",
+    "make_meanfield_neg_elbo",
+    "make_sharded_update_compute",
+    "svi_fit",
+]
 
 SVI_BATCHES = _metrics.counter(
     "pftpu_svi_batches_total",
@@ -149,6 +156,108 @@ def svi_fit(
     return result, unravel
 
 
+def make_meanfield_neg_elbo(
+    compiled: CompiledModel,
+    unravel: Callable[[jax.Array], Any],
+    dim: int,
+    n_mc: int,
+) -> Callable[..., jax.Array]:
+    """The ONE streaming neg-ELBO estimator, shared by the
+    driver-centric lane (:meth:`StreamingSVI._neg_elbo`) and the
+    sharded-optimizer node compute
+    (:func:`make_sharded_update_compute`) — the two lanes
+    differentiate the SAME function with the same RNG stream, which is
+    why their parameter trajectories are bit-identical on CPU
+    (property-tested in tests/test_optim.py)."""
+
+    def neg_elbo(
+        var: Tuple[jax.Array, jax.Array],
+        key: jax.Array,
+        idx: jax.Array,
+    ) -> jax.Array:
+        mu, log_sd = var
+        x = meanfield_draws(mu, log_sd, key, n_mc)
+        # Python-mean over the MC draws: each draw is one pool window
+        # (vmap over a pool-placed program would serialize anyway via
+        # the callback's sequential vmap rule).
+        terms = [
+            compiled.logp_indices(unravel(x[i]), idx)
+            for i in range(n_mc)
+        ]
+        e_logp = sum(terms[1:], terms[0]) / float(n_mc)
+        return -(e_logp + gaussian_entropy(dim, jnp.sum(log_sd)))
+
+    return neg_elbo
+
+
+def make_sharded_update_compute(
+    compiled: CompiledModel,
+    store: Any,
+    *,
+    learning_rate: float = 5e-2,
+    n_mc: int = 2,
+    init_params: Optional[Any] = None,
+) -> Callable[..., list]:
+    """The OWNER-replica compute of a sharded streaming-SVI group
+    (ISSUE 16): wraps :func:`~..optim.sharded.make_update_compute`
+    around this model's neg-ELBO gradient.  Requests carry
+    ``[mu, log_sd, rng_key, idx]`` (the driver's step inputs, params
+    broadcast whole so the PR-9 pin cache absorbs them); the node
+    differentiates the same estimator the driver lane uses, slices its
+    owned shard of the flat ``concat(mu, log_sd)`` vector, applies
+    ``optax.adam(learning_rate)`` on the slice, and checkpoints into
+    ``store`` (a :class:`~..optim.state.ShardStore`) before replying.
+
+    Every owner of one group must be built with the SAME
+    ``learning_rate``/``n_mc``/``init_params`` — the shard version
+    protocol catches drift in TIME, not in hyperparameters."""
+    if not _HAS_OPTAX:
+        raise ModuleNotFoundError(
+            "make_sharded_update_compute requires optax"
+        )
+    from ..optim.sharded import make_update_compute
+
+    init = (
+        init_params if init_params is not None else compiled.init_params()
+    )
+    flat0, unravel = ravel_pytree(init)
+    dim = int(flat0.shape[0])
+    neg_elbo = make_meanfield_neg_elbo(compiled, unravel, dim, int(n_mc))
+
+    # Deliberately NOT jitted: XLA fusion changes rounding at the ULP
+    # level on CPU (measured: 3.7e-9 drift on the radon example), and
+    # the owner must stay BIT-identical to the driver lane's eager
+    # value_and_grad — the subsystem's exactness contract
+    # (tests/test_optim.py).  The eager retrace is per-call dispatch
+    # overhead both lanes pay equally.
+    def grad_fn(
+        mu: np.ndarray,
+        log_sd: np.ndarray,
+        key_data: np.ndarray,
+        idx: np.ndarray,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        loss, (g_mu, g_log_sd) = jax.value_and_grad(neg_elbo)(
+            (jnp.asarray(mu), jnp.asarray(log_sd)),
+            jnp.asarray(key_data),
+            jnp.asarray(idx, jnp.int32),
+        )
+        return np.asarray(loss), np.concatenate(
+            [np.asarray(g_mu).ravel(), np.asarray(g_log_sd).ravel()]
+        )
+
+    def params_of(arrays: Any) -> np.ndarray:
+        return np.concatenate(
+            [np.asarray(arrays[0]).ravel(), np.asarray(arrays[1]).ravel()]
+        )
+
+    return make_update_compute(
+        grad_fn,
+        optax.adam(learning_rate),
+        store,
+        params_of=params_of,
+    )
+
+
 def _classify_skip(exc: BaseException) -> Optional[str]:
     """Map a step failure to its shed/skip outcome, or None when the
     exception is a programming error that must propagate (the loud
@@ -202,6 +311,21 @@ class StreamingSVI:
     - ``offered == accepted + sum(skipped.values())`` — every batch
       is accounted exactly once;
     - unclassified exceptions propagate (nothing is silently eaten).
+
+    **Sharded mode** (ISSUE 16): pass ``sharded=`` a
+    :class:`~..optim.sharded.ShardedOptimizer` whose owner replicas
+    run :func:`make_sharded_update_compute` for this model.  Optimizer
+    state then lives ON the owners (``O(model/N)`` each — the driver
+    holds no adam state and never sees a gradient), each step
+    dispatches one versioned update per shard, and the accounting
+    contract becomes PER SHARD: ``shard_opt_steps[k] ==
+    shard_accepted[k]`` for every shard, under chaos (the ``--lane
+    zero`` invariant — a killed owner's shard restores from its
+    checkpoint or refuses loudly, never double-steps).
+    ``minibatch_mode="shared"`` sends every owner the same index batch
+    (trajectories bit-identical to driver-centric mode);
+    ``"split"`` gives each owner a disjoint slice of the batch (same
+    total compute, per-shard estimators stay unbiased).
     """
 
     def __init__(
@@ -214,6 +338,8 @@ class StreamingSVI:
         init_log_sd: float = -2.0,
         deadline_s: Optional[float] = None,
         init_params: Optional[Any] = None,
+        sharded: Optional[Any] = None,
+        minibatch_mode: str = "shared",
     ) -> None:
         if not _HAS_OPTAX:
             raise ModuleNotFoundError("StreamingSVI requires optax")
@@ -229,8 +355,31 @@ class StreamingSVI:
         self._dtype = flat0.dtype
         self.mu = flat0
         self.log_sd = jnp.full((self.dim,), init_log_sd, self._dtype)
-        self._opt = optax.adam(learning_rate)
-        self._opt_state = self._opt.init((self.mu, self.log_sd))
+        self._neg_elbo_fn = make_meanfield_neg_elbo(
+            compiled, self._unravel, self.dim, self.n_mc
+        )
+        if minibatch_mode not in ("shared", "split"):
+            raise ValueError(
+                f"minibatch_mode must be 'shared' or 'split', got "
+                f"{minibatch_mode!r}"
+            )
+        self.minibatch_mode = minibatch_mode
+        self._sharded = sharded
+        if sharded is not None:
+            if sharded.total != 2 * self.dim:
+                raise ValueError(
+                    f"sharded optimizer covers {sharded.total} elements "
+                    f"but this model's flat (mu, log_sd) vector has "
+                    f"{2 * self.dim}"
+                )
+            # No driver-side optimizer: adam state lives on the owners.
+            self._opt = None
+            self._opt_state = None
+            self.shard_accepted: List[int] = [0] * sharded.count
+        else:
+            self._opt = optax.adam(learning_rate)
+            self._opt_state = self._opt.init((self.mu, self.log_sd))
+            self.shard_accepted = []
         self._key = key
         self.offered = 0
         self.accepted = 0
@@ -241,8 +390,13 @@ class StreamingSVI:
 
     @property
     def opt_steps(self) -> int:
-        """The optimizer's OWN step counter (optax adam carries one) —
-        the ground truth the accepted-batch count is checked against."""
+        """The optimizer's OWN step counter — the ground truth the
+        accepted-batch count is checked against.  Driver-centric mode
+        reads optax adam's count; sharded mode reads the MINIMUM shard
+        version (the steps completed on EVERY shard — per-shard truth
+        is :attr:`shard_opt_steps`)."""
+        if self._sharded is not None:
+            return min(self._sharded.versions)
         counts = [
             int(np.asarray(c))
             for c in jax.tree_util.tree_leaves(self._opt_state)
@@ -252,6 +406,15 @@ class StreamingSVI:
         ]
         return max(counts) if counts else 0
 
+    @property
+    def shard_opt_steps(self) -> List[int]:
+        """Sharded mode: each shard's step version — the OWNER-side
+        adam step counter (the version IS the count).  The per-shard
+        invariant is ``shard_opt_steps[k] == shard_accepted[k]``."""
+        if self._sharded is None:
+            raise RuntimeError("shard_opt_steps needs sharded mode")
+        return list(self._sharded.versions)
+
     # -- the ELBO estimator --------------------------------------------
 
     def _neg_elbo(
@@ -260,17 +423,10 @@ class StreamingSVI:
         key: jax.Array,
         idx: jax.Array,
     ) -> jax.Array:
-        mu, log_sd = var
-        x = meanfield_draws(mu, log_sd, key, self.n_mc)
-        # Python-mean over the MC draws: each draw is one pool window
-        # (vmap over a pool-placed program would serialize anyway via
-        # the callback's sequential vmap rule).
-        terms = [
-            self.compiled.logp_indices(self._unravel(x[i]), idx)
-            for i in range(self.n_mc)
-        ]
-        e_logp = sum(terms[1:], terms[0]) / float(self.n_mc)
-        return -(e_logp + gaussian_entropy(self.dim, jnp.sum(log_sd)))
+        # Delegates to the shared estimator so the driver-centric lane
+        # and the sharded owner compute differentiate the SAME function
+        # (the bit-identical-trajectory precondition).
+        return self._neg_elbo_fn(var, key, idx)
 
     def step(self, batch_idx: Any) -> str:
         """Consume one arriving minibatch (1-D shard-index array).
@@ -278,6 +434,8 @@ class StreamingSVI:
         self.offered += 1
         self._key, sub = jax.random.split(self._key)
         idx = jnp.asarray(batch_idx, jnp.int32)
+        if self._sharded is not None:
+            return self._step_sharded(sub, idx)
         try:
             with _deadline.deadline_scope(self.deadline_s):
                 loss, grads = jax.value_and_grad(self._neg_elbo)(
@@ -316,6 +474,94 @@ class StreamingSVI:
             step=self.accepted,
             elbo=round(elbo, 3),
             batch=int(idx.shape[0]),
+        )
+        return "accepted"
+
+    def _step_sharded(self, sub: jax.Array, idx: jax.Array) -> str:
+        """One sharded-optimizer step (ISSUE 16): dispatch a versioned
+        update to every owner, fold the returned slices into the
+        driver's parameter copy.  A failed shard sheds only ITSELF —
+        its version (and so its accepted count) does not move, which is
+        exactly the per-shard ``opt_steps == accepted`` invariant; the
+        BATCH counts accepted only when every shard accepted."""
+        opt = self._sharded
+        mu_np = np.asarray(self.mu)
+        log_sd_np = np.asarray(self.log_sd)
+        key_np = np.asarray(sub)
+        idx_np = np.asarray(idx, np.int32)
+        if self.minibatch_mode == "shared":
+            arrays_for: Any = [mu_np, log_sd_np, key_np, idx_np]
+        else:
+            slices = np.array_split(idx_np, opt.count)
+
+            def arrays_for(
+                k: int, part: Any, _s: List[np.ndarray] = slices
+            ) -> List[np.ndarray]:
+                return [mu_np, log_sd_np, key_np, _s[k]]
+
+        try:
+            with _deadline.deadline_scope(self.deadline_s):
+                results = opt.step(arrays_for)
+        except Exception as exc:  # noqa: BLE001 - classified below
+            # A raise out of ShardedOptimizer.step is version
+            # divergence or a protocol/geometry violation (per-shard
+            # transport failures come back as ShardResults) — that is
+            # corruption, never a sheddable batch: propagate.
+            if isinstance(exc, _WireError):
+                raise
+            outcome = _classify_skip(exc)
+            if outcome is None:
+                raise
+            self.skipped[outcome] = self.skipped.get(outcome, 0) + 1
+            SVI_BATCHES.labels(outcome=outcome).inc()
+            _flightrec.record(
+                "svi.shed",
+                outcome=outcome,
+                offered=self.offered,
+                error=f"{type(exc).__name__}: {str(exc)[:120]}",
+            )
+            return outcome
+        flat = np.concatenate([mu_np.ravel(), log_sd_np.ravel()])
+        new_flat, accepted_shards = opt.apply(flat, results)
+        for k in accepted_shards:
+            self.shard_accepted[k] += 1
+        self.mu = jnp.asarray(new_flat[: self.dim], self._dtype)
+        self.log_sd = jnp.asarray(new_flat[self.dim :], self._dtype)
+        failures = [r for r in results if not r.accepted]
+        if failures:
+            first = next(
+                (r.error for r in failures if r.error is not None), None
+            )
+            outcome = (
+                _classify_skip(first) if first is not None else "failed"
+            )
+            if outcome is None:
+                raise first  # unclassified: the loud posture
+            self.skipped[outcome] = self.skipped.get(outcome, 0) + 1
+            SVI_BATCHES.labels(outcome=outcome).inc()
+            _flightrec.record(
+                "svi.shed",
+                outcome=outcome,
+                offered=self.offered,
+                shards_failed=[r.index for r in failures],
+                error=f"{type(first).__name__}: {str(first)[:120]}"
+                if first is not None
+                else "",
+            )
+            return outcome
+        self.accepted += 1
+        losses = [r.loss for r in results if r.loss is not None]
+        if losses:
+            elbo = float(-np.mean(losses))
+            self.elbo_trace.append(elbo)
+            SVI_ELBO.set(elbo)
+        SVI_BATCHES.labels(outcome="accepted").inc()
+        _flightrec.record(
+            "svi.step",
+            step=self.accepted,
+            elbo=round(self.elbo_trace[-1], 3) if self.elbo_trace else None,
+            batch=int(idx_np.shape[0]),
+            sharded=True,
         )
         return "accepted"
 

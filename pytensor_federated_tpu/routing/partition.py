@@ -41,7 +41,15 @@ from __future__ import annotations
 
 import struct
 import threading
-from typing import Callable, Dict, List, NamedTuple, Sequence, Tuple
+from typing import (
+    Callable,
+    Dict,
+    List,
+    NamedTuple,
+    Optional,
+    Sequence,
+    Tuple,
+)
 
 import numpy as np
 
@@ -58,6 +66,7 @@ __all__ = [
     "pack_partition",
     "plan_partitions",
     "reduce_replies",
+    "shard_label",
     "slice_reply",
     "split_tail",
     "tail_layout",
@@ -357,6 +366,20 @@ class _BufferPool:
 _REASSEMBLY_BUFFERS = _BufferPool()
 
 
+def shard_label(part: GradPartition, iuid: Optional[str] = None) -> str:
+    """The shard identity refusal messages carry (ISSUE 16 satellite):
+    the full DECLARED geometry plus — when the transport has one — the
+    reply item's wire identity, so a sharded-update refusal names WHICH
+    replica's slice broke the reassembly, not just the failure class."""
+    label = (
+        f"shard {part.index}/{part.count} [declared offset={part.offset}"
+        f" length={part.length} total={part.total}"
+    )
+    if iuid is not None:
+        label += f" iuid={iuid}"
+    return label + "]"
+
+
 class Reassembler:
     """Collect partition-indexed slices back into one flat vector.
 
@@ -396,51 +419,73 @@ class Reassembler:
             else np.empty(self.total, self.dtype)
         )
         self._seen: Dict[int, Tuple[int, int]] = {}
+        self._iuids: Dict[int, Optional[str]] = {}
         self._covered = 0
 
-    def add(self, part: GradPartition, flat: np.ndarray) -> None:
+    def add(
+        self,
+        part: GradPartition,
+        flat: np.ndarray,
+        *,
+        iuid: Optional[str] = None,
+    ) -> None:
+        """Validate and place one slice.  ``iuid`` is the reply item's
+        wire identity when the transport carries one — it rides into
+        every refusal via :func:`shard_label` so the error names the
+        offending replica's slice, not just the failure class."""
         try:
-            self._add_checked(part, flat)
+            self._add_checked(part, flat, iuid)
         except PartitionError:
             PARTITION_SHARDS.labels(outcome="error").inc()
             raise
         PARTITION_SHARDS.labels(outcome="ok").inc()
 
-    def _add_checked(self, part: GradPartition, flat: np.ndarray) -> None:
+    def _add_checked(
+        self,
+        part: GradPartition,
+        flat: np.ndarray,
+        iuid: Optional[str] = None,
+    ) -> None:
         part.validate()
+        who = shard_label(part, iuid)
         if part.count != self.count or part.total != self.total:
             raise PartitionError(
-                f"shard geometry ({part.count}, {part.total}) does not "
+                f"{who}: geometry ({part.count}, {part.total}) does not "
                 f"match the reassembly ({self.count}, {self.total})"
             )
         if part.index in self._seen:
+            first = self._iuids.get(part.index)
             raise PartitionError(
-                f"duplicate shard index {part.index} "
-                f"(already covered {self._seen[part.index]})"
+                f"duplicate {who}: index already covered "
+                f"{self._seen[part.index]}"
+                + (f" by iuid={first}" if first is not None else "")
             )
         flat = np.asarray(flat).ravel()
         if flat.size != part.length:
             raise PartitionError(
-                f"shard {part.index} carries {flat.size} elements but "
-                f"declares length {part.length}"
+                f"{who} carries {flat.size} elements but declares "
+                f"length {part.length}"
             )
         if flat.size and flat.dtype != self.dtype:
             raise PartitionError(
-                f"shard {part.index} dtype {flat.dtype} != reassembly "
-                f"dtype {self.dtype} — refusing a silent cast"
+                f"{who} dtype {flat.dtype} != reassembly dtype "
+                f"{self.dtype} — refusing a silent cast"
             )
         for idx, (lo, hi) in self._seen.items():
             if part.offset < hi and lo < part.offset + part.length:
+                other = self._iuids.get(idx)
                 raise PartitionError(
-                    f"shard {part.index} range [{part.offset}, "
+                    f"{who} range [{part.offset}, "
                     f"{part.offset + part.length}) overlaps shard "
                     f"{idx}'s [{lo}, {hi})"
+                    + (f" (iuid={other})" if other is not None else "")
                 )
         self._buf[part.offset : part.offset + part.length] = flat
         self._seen[part.index] = (
             part.offset,
             part.offset + part.length,
         )
+        self._iuids[part.index] = iuid
         self._covered += part.length
 
     @property

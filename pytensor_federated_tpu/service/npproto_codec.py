@@ -96,6 +96,7 @@ __all__ = [
     "peek_deadline_msg",
     "peek_tenant_msg",
     "peek_partition_msg",
+    "peek_version_msg",
     "append_spans_msg",
     "encode_get_load_result",
     "decode_get_load_result",
@@ -375,6 +376,7 @@ def encode_arrays_msg(
     deadline_s: Optional[float] = None,
     tenant: Optional[str] = None,
     partition: Optional[Sequence[int]] = None,
+    version: Optional[int] = None,
 ) -> bytes:
     """InputArrays/OutputArrays: repeated ndarray items + string uuid
     (reference: service.proto:6-19; uuid is the correlation id the
@@ -387,8 +389,11 @@ def encode_arrays_msg(
     emits the gateway tier's tenant-id extension field 19 (utf8
     string, non-empty); ``partition`` emits the gradient-partition
     extension field 20 (nested message — routing/partition.py owns the
-    semantics).  All ``None`` keeps the message byte-identical to the
-    official encoder's output."""
+    semantics); ``version`` emits the step-version extension field 21
+    (varint u64 — optim/sharded.py owns the semantics; emitted even
+    at 0, because field PRESENCE marks a versioned message and the
+    zero stamp is the init handshake).  All ``None`` keeps the message
+    byte-identical to the official encoder's output."""
     out = bytearray()
     for a in arrays:
         out += _len_field(1, encode_ndarray(a))
@@ -412,9 +417,24 @@ def encode_arrays_msg(
         out += _len_field(19, tenant.encode("utf-8"))
     if partition is not None:
         out += _len_field(20, _encode_partition_msg(partition))
+    if version is not None:
+        out += _tag(21, _WT_VARINT) + _encode_varint(
+            _check_version(version)
+        )
     if _fi.active_plan is not None:  # chaos seam (faultinject.runtime)
         return _fi.filter_bytes("npproto.encode", bytes(out))
     return bytes(out)
+
+
+def _check_version(version: int) -> int:
+    """Validate a step-version stamp for field 21 (varint u64)."""
+    try:
+        v = int(version)
+    except (TypeError, ValueError) as e:
+        raise WireError(f"version must be an int: {e}") from None
+    if not 0 <= v < (1 << 64):
+        raise WireError(f"version {v} outside u64 range")
+    return v
 
 
 def encode_batch_msg(
@@ -425,6 +445,7 @@ def encode_batch_msg(
     deadline_s: Optional[float] = None,
     tenant: Optional[str] = None,
     partition: Optional[Sequence[int]] = None,
+    version: Optional[int] = None,
 ) -> bytes:
     """Frame K already-encoded InputArrays/OutputArrays messages as ONE
     batch message (extension field 17) — the npproto twin of
@@ -453,6 +474,10 @@ def encode_batch_msg(
         out += _len_field(19, tenant.encode("utf-8"))
     if partition is not None:
         out += _len_field(20, _encode_partition_msg(partition))
+    if version is not None:
+        out += _tag(21, _WT_VARINT) + _encode_varint(
+            _check_version(version)
+        )
     for item in items:
         out += _len_field(17, item)
     if _fi.active_plan is not None:  # chaos seam (faultinject.runtime)
@@ -529,6 +554,24 @@ def peek_partition_msg(buf: bytes) -> Optional[Tuple[int, int, int, int, int]]:
     return None
 
 
+def peek_version_msg(buf: bytes) -> Optional[int]:
+    """The message's step-version stamp (field 21, varint u64) as an
+    int, or ``None`` when absent — a skip-walk like
+    :func:`peek_deadline_msg`, so the versioned sharded-optimizer lane
+    (optim/sharded.py) can dispatch before any ndarray decode.  Zero
+    is a meaningful stamp, which is why absence is ``None``, never 0.
+    Raises :class:`~.npwire.WireError` on structurally broken
+    messages."""
+    pos = 0
+    while pos < len(buf):
+        field, wt, pos = _decode_tag(buf, pos)
+        if field == 21 and wt == _WT_VARINT:
+            raw, pos = _decode_varint(buf, pos)
+            return raw
+        pos = _skip(buf, pos, wt)
+    return None
+
+
 def decode_batch_msg(
     buf: bytes,
 ) -> Tuple[List[bytes], str, Optional[bytes], Optional[list]]:
@@ -578,6 +621,10 @@ def decode_batch_msg(
             # partition: consumed and dropped (peek_partition_msg is
             # the partition-lane reader; same posture as deadline_s).
             _raw, pos = _decode_len(buf, pos)
+        elif field == 21 and wt == _WT_VARINT:
+            # version: consumed and dropped (peek_version_msg is the
+            # sharded-optimizer-lane reader; same posture as deadline_s).
+            _raw, pos = _decode_varint(buf, pos)
         else:
             pos = _skip(buf, pos, wt)
     return items, uuid, trace_id, spans
@@ -682,6 +729,10 @@ def decode_arrays_msg_full(
             # partition: consumed and dropped (peek_partition_msg is
             # the partition-lane reader; see decode_batch_msg).
             _raw, pos = _decode_len(buf, pos)
+        elif field == 21 and wt == _WT_VARINT:
+            # version: consumed and dropped (peek_version_msg is the
+            # sharded-optimizer-lane reader; see decode_batch_msg).
+            _raw, pos = _decode_varint(buf, pos)
         else:
             pos = _skip(buf, pos, wt)
     return arrays, uuid, error, trace_id, spans

@@ -80,7 +80,10 @@ Layout (little-endian):
            [flags&1 error: len(u32) utf8]
            [flags&2 trace: trace_id(16s)]
            [flags&16 deadline: budget_s(f64)]
-           [flags&32 tenant: len(u16) utf8]  then per array:
+           [flags&32 tenant: len(u16) utf8]
+           [flags&64 partition: index(u32) count(u32) offset(u64)
+                     length(u64) total(u64)]
+           [flags&128 version: step_version(u64)]  then per array:
   array:   dtype_len(u16) dtype_str shape_ndim(u8) shape(u64*ndim)
            data_len(u64) data_bytes
   tail:    [flags&4 spans: len(u32) utf8-JSON]
@@ -136,6 +139,7 @@ _FLAG_BATCH = 8
 _FLAG_DEADLINE = 16
 _FLAG_TENANT = 32
 _FLAG_PARTITION = 64
+_FLAG_VERSION = 128
 # Every known flag bit, mirrored from service/wire_registry.py (the
 # declared source; the graftlint wire-registry rule cross-checks the
 # two).  Decoders REJECT any bit outside this mask: an unknown flag
@@ -144,7 +148,7 @@ _FLAG_PARTITION = 64
 # hazard the module docstring's loud-failure contract forbids.
 _KNOWN_FLAGS = (
     _FLAG_ERROR | _FLAG_TRACE | _FLAG_SPANS | _FLAG_BATCH
-    | _FLAG_DEADLINE | _FLAG_TENANT | _FLAG_PARTITION
+    | _FLAG_DEADLINE | _FLAG_TENANT | _FLAG_PARTITION | _FLAG_VERSION
 )
 # flags byte offset in the header ("<4sBB...": magic, version, flags)
 _FLAGS_OFF = 5
@@ -164,6 +168,12 @@ _F64 = struct.Struct("<d")
 #: semantics (head/tail slice rule, reduction, reassembly) live in
 #: routing/partition.py.
 _PARTITION_STRUCT = struct.Struct("<IIQQQ")
+#: The step-version stamp block (flag bit 128): one u64 after the
+#: partition block — layout declared in service/wire_registry.py
+#: VERSION_STRUCT; the semantics (monotonic optimizer-step version,
+#: stale-shard refusal) live in optim/sharded.py.  Zero is meaningful
+#: (the init handshake), so presence rides the flag bit, not the value.
+_VERSION_STRUCT = struct.Struct("<Q")
 
 
 class WireError(ValueError):
@@ -265,6 +275,28 @@ def _decode_partition(buf: bytes, off: int) -> Tuple[tuple, int]:
     return fields, off + _PARTITION_STRUCT.size
 
 
+def _encode_version(version: int) -> bytes:
+    """The step-version block (flag bit 128): one u64 stamp.  Loud on
+    values the wire cannot carry; the SEMANTICS (monotonicity,
+    stale-shard refusal) are optim/sharded.py's."""
+    try:
+        v = int(version)
+    except (TypeError, ValueError) as e:
+        raise WireError(f"version must be an int: {e}") from None
+    if not 0 <= v < (1 << 64):
+        raise WireError(f"version {v} outside u64 range")
+    return _VERSION_STRUCT.pack(v)
+
+
+def _decode_version(buf: bytes, off: int) -> Tuple[int, int]:
+    """Parse a version block at ``off`` -> (version, new_offset)."""
+    try:
+        (version,) = _VERSION_STRUCT.unpack_from(buf, off)
+    except struct.error as e:
+        raise WireError(f"truncated version block: {e}") from None
+    return version, off + _VERSION_STRUCT.size
+
+
 def _tupleize(descr: object) -> object:
     """JSON round-trip turns descr tuples into lists; restore them
     recursively (field entries are tuples, nested shapes too)."""
@@ -358,6 +390,7 @@ def encode_arrays_sg(
     deadline_s: Optional[float] = None,
     tenant: Optional[str] = None,
     partition: Optional[Sequence[int]] = None,
+    version: Optional[int] = None,
 ) -> List[Buffer]:
     """Scatter/gather encode: the same frame as :func:`encode_arrays`
     as a BUFFER VECTOR — header/metadata ``bytes`` interleaved with
@@ -395,6 +428,10 @@ def encode_arrays_sg(
     if partition is not None:
         partition_block = _encode_partition(partition)
         flags |= _FLAG_PARTITION
+    version_block = None
+    if version is not None:
+        version_block = _encode_version(version)
+        flags |= _FLAG_VERSION
     parts: List[Buffer] = [
         _HEADER_STRUCT.pack(MAGIC, 1, flags, uuid, len(arrays))
     ]
@@ -410,6 +447,8 @@ def encode_arrays_sg(
         parts.append(tenant_block)
     if partition_block is not None:
         parts.append(partition_block)
+    if version_block is not None:
+        parts.append(version_block)
     for a in arrays:
         dt = _encode_dtype(a.dtype)
         parts.append(_U16.pack(len(dt)))
@@ -440,20 +479,24 @@ def encode_arrays(
     deadline_s: Optional[float] = None,
     tenant: Optional[str] = None,
     partition: Optional[Sequence[int]] = None,
+    version: Optional[int] = None,
 ) -> bytes:
     """Encode arrays (+uuid, +optional error/trace_id/deadline_s/
-    tenant/partition) into one framed message.  ``trace_id`` (16
-    bytes) is the telemetry correlation id; ``deadline_s`` the
+    tenant/partition/version) into one framed message.  ``trace_id``
+    (16 bytes) is the telemetry correlation id; ``deadline_s`` the
     remaining deadline budget (flag bit 16); ``tenant`` the gateway
     tier's per-tenant identity (flag bit 32); ``partition`` the
     gradient-partition index block (flag bit 64, a 5-int sequence —
-    routing/partition.py owns the semantics); every optional ``None``
+    routing/partition.py owns the semantics); ``version`` the u64
+    step-version stamp (flag bit 128 — optim/sharded.py owns the
+    semantics; zero is a meaningful stamp); every optional ``None``
     emits the exact pre-feature frame.  The contiguous form of
     :func:`encode_arrays_sg` — one flattening join, counted under the
     ``encode_join`` copy stage."""
     parts = encode_arrays_sg(
         arrays, uuid=uuid, error=error, trace_id=trace_id,
         deadline_s=deadline_s, tenant=tenant, partition=partition,
+        version=version,
     )
     if len(parts) == 1 and isinstance(parts[0], bytes):
         return parts[0]  # chaos path: already joined and filtered
@@ -472,6 +515,7 @@ def encode_batch(
     deadline_s: Optional[float] = None,
     tenant: Optional[str] = None,
     partition: Optional[Sequence[int]] = None,
+    version: Optional[int] = None,
 ) -> bytes:
     """Frame K already-encoded npwire messages as ONE batch message
     (flag bit 8).  ``items`` are complete frames — each keeps its own
@@ -508,6 +552,10 @@ def encode_batch(
     if partition is not None:
         partition_block = _encode_partition(partition)
         flags |= _FLAG_PARTITION
+    version_block = None
+    if version is not None:
+        version_block = _encode_version(version)
+        flags |= _FLAG_VERSION
     parts: List[bytes] = [
         _HEADER_STRUCT.pack(MAGIC, 1, flags, uuid, len(items))
     ]
@@ -523,6 +571,8 @@ def encode_batch(
         parts.append(tenant_block)
     if partition_block is not None:
         parts.append(partition_block)
+    if version_block is not None:
+        parts.append(version_block)
     for item in items:
         if item[:4] != MAGIC:
             raise WireError("batch items must be complete npwire frames")
@@ -660,6 +710,43 @@ def peek_partition(buf: bytes) -> Optional[tuple]:
     return part
 
 
+def peek_version(buf: bytes) -> Optional[int]:
+    """The frame's step-version stamp (flag bit 128) as an int, or
+    ``None`` when the flag is clear — WITHOUT decoding arrays, and for
+    BOTH plain and batch frames.  The server-side dispatch reader: a
+    versioned update/refresh request must be recognized before arrays
+    are decoded (optim/sharded.py owns the semantics; zero is a
+    meaningful stamp, which is why absence is ``None``, never 0).
+    Raises :class:`WireError` on a frame whose leading blocks are
+    truncated (the full decoder would reject it identically)."""
+    try:
+        magic, version, flags = struct.unpack_from("<4sBB", buf, 0)
+    except struct.error as e:
+        raise WireError(f"truncated header: {e}") from None
+    if magic != MAGIC:
+        raise WireError(f"bad magic {magic!r}")
+    _check_flags(flags)
+    if not flags & _FLAG_VERSION:
+        return None
+    off = _HEADER_SIZE
+    if flags & _FLAG_ERROR:
+        try:
+            (elen,) = struct.unpack_from("<I", buf, off)
+        except struct.error as e:
+            raise WireError(f"truncated error block: {e}") from None
+        off += 4 + elen
+    if flags & _FLAG_TRACE:
+        off += 16
+    if flags & _FLAG_DEADLINE:
+        off += 8
+    if flags & _FLAG_TENANT:
+        off = _skip_tenant_block(buf, off)
+    if flags & _FLAG_PARTITION:
+        off += _PARTITION_STRUCT.size
+    stamp, _off = _decode_version(buf, off)
+    return stamp
+
+
 def _skip_tenant_block(buf: bytes, off: int) -> int:
     """Consume a tenant block at ``off`` (decoders keep their
     historical tuple shapes; :func:`peek_tenant` is the reader)."""
@@ -682,7 +769,9 @@ def decode_batch(
     error blocks: per-item failure isolation).  An outer partition
     block (flag bit 64) is consumed and dropped — the reduce-window
     server path reads it with :func:`decode_batch_part`."""
-    items, uuid, error, trace_id, spans, _part = decode_batch_part(buf)
+    items, uuid, error, trace_id, spans, _part, _ver = decode_batch_part(
+        buf
+    )
     return items, uuid, error, trace_id, spans
 
 
@@ -695,11 +784,14 @@ def decode_batch_part(
     Optional[bytes],
     Optional[list],
     Optional[tuple],
+    Optional[int],
 ]:
     """Full batch decode -> (items, uuid, error, trace_id, spans,
-    partition) where ``partition`` is the outer partition block's
-    5-int tuple (flag bit 64; ``None`` when clear) — the reduce-window
-    request/reply marker (routing/partition.py)."""
+    partition, version) where ``partition`` is the outer partition
+    block's 5-int tuple (flag bit 64; ``None`` when clear) — the
+    reduce-window request/reply marker (routing/partition.py) — and
+    ``version`` the u64 step-version stamp (flag bit 128; ``None``
+    when clear — zero is a meaningful stamp; optim/sharded.py)."""
     if _fi.active_plan is not None:  # chaos seam (faultinject.runtime)
         buf = _fi.filter_bytes("npwire.decode_batch", buf)
     try:
@@ -744,6 +836,9 @@ def decode_batch_part(
     partition = None
     if flags & _FLAG_PARTITION:
         partition, off = _decode_partition(buf, off)
+    step_version = None
+    if flags & _FLAG_VERSION:
+        step_version, off = _decode_version(buf, off)
     items: List[bytes] = []
     for _ in range(n):
         try:
@@ -773,7 +868,7 @@ def decode_batch_part(
             raise WireError(
                 f"spans block must be a JSON list, got {type(spans).__name__}"
             )
-    return items, uuid, error, trace_id, spans, partition
+    return items, uuid, error, trace_id, spans, partition, step_version
 
 
 def append_spans(frame: bytes, spans: Sequence[dict]) -> bytes:
@@ -848,8 +943,8 @@ def decode_arrays_all(
     the views keep the whole frame alive, so opt in where the frame is
     short-lived anyway (a server decoding a request it computes on and
     drops) rather than where results are retained."""
-    arrays, uuid, error, trace_id, spans, _part = decode_arrays_part(
-        buf, copy=copy
+    arrays, uuid, error, trace_id, spans, _part, _ver = (
+        decode_arrays_part(buf, copy=copy)
     )
     return arrays, uuid, error, trace_id, spans
 
@@ -865,11 +960,14 @@ def decode_arrays_part(
     Optional[bytes],
     Optional[list],
     Optional[tuple],
+    Optional[int],
 ]:
     """:func:`decode_arrays_all` plus the frame's partition block as a
     5-int tuple (flag bit 64; ``None`` when clear) — what the
     partitioned client/server lanes decode replies with
-    (routing/partition.py owns the semantics)."""
+    (routing/partition.py owns the semantics) — and the u64
+    step-version stamp (flag bit 128; ``None`` when clear — zero is a
+    meaningful stamp; optim/sharded.py owns the semantics)."""
     if _fi.active_plan is not None:  # chaos seam (faultinject.runtime)
         buf = _fi.filter_bytes("npwire.decode", buf)
     try:
@@ -918,6 +1016,9 @@ def decode_arrays_part(
     partition = None
     if flags & _FLAG_PARTITION:
         partition, off = _decode_partition(buf, off)
+    step_version = None
+    if flags & _FLAG_VERSION:
+        step_version, off = _decode_version(buf, off)
     arrays: List[np.ndarray] = []
     for _ in range(n):
         try:
@@ -971,4 +1072,4 @@ def decode_arrays_part(
             raise WireError(
                 f"spans block must be a JSON list, got {type(spans).__name__}"
             )
-    return arrays, uuid, error, trace_id, spans, partition
+    return arrays, uuid, error, trace_id, spans, partition, step_version

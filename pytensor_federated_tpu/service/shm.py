@@ -132,14 +132,19 @@ _FLAG_TRACE = 2
 _FLAG_DEADLINE = 4
 _FLAG_TENANT = 8
 _FLAG_PARTITION = 16
+_FLAG_VERSION = 32
 _KNOWN_FLAGS = (
     _FLAG_ERROR | _FLAG_TRACE | _FLAG_DEADLINE | _FLAG_TENANT
-    | _FLAG_PARTITION
+    | _FLAG_PARTITION | _FLAG_VERSION
 )
 #: The gradient-partition index block (flag bit 16): same 32-byte
 #: layout as the npwire block (wire_registry.PARTITION_STRUCT);
 #: routing/partition.py owns the semantics.
 _PARTITION_STRUCT = struct.Struct("<IIQQQ")
+#: The step-version stamp (flag bit 32): one u64 after the partition
+#: block (wire_registry.VERSION_STRUCT); optim/sharded.py owns the
+#: semantics (zero is a meaningful stamp — presence is the flag).
+_VERSION_STRUCT = struct.Struct("<Q")
 
 _HEADER = struct.Struct("<4sBBBB16s")
 #: The arena descriptor — layout declared as SHM_DESC_STRUCT in
@@ -187,6 +192,7 @@ def encode_frame(
     deadline_s: Optional[float] = None,
     tenant: Optional[str] = None,
     partition: Optional[Sequence[int]] = None,
+    version: Optional[int] = None,
 ) -> bytes:
     """One doorbell frame.  Descriptor-only — payload bytes NEVER ride
     the doorbell; they live in the arena.  ``deadline_s`` (flag bit 4)
@@ -195,12 +201,15 @@ def encode_frame(
     tier's per-tenant identity (u16-length utf8, non-empty);
     ``partition`` (flag bit 16) the gradient-partition index block (a
     5-int sequence — routing/partition.py owns the semantics);
+    ``version`` (flag bit 32) the u64 step-version stamp
+    (optim/sharded.py owns the semantics; zero is meaningful);
     ``None`` for any emits the pre-feature byte-identical frame."""
     if len(uuid) != 16:
         raise WireError(f"uuid must be 16 bytes, got {len(uuid)}")
     flags = 0
     if error is None and trace_id is None and deadline_s is None \
-            and tenant is None and partition is None:
+            and tenant is None and partition is None \
+            and version is None:
         # Hot-path template (ISSUE-13 satellite): the flag-free frame
         # — every ACK/GETLOAD/PING and most steady-state EVALs — is a
         # prefix join, no per-block branching.
@@ -229,6 +238,10 @@ def encode_frame(
     if partition is not None:
         partition_block = _encode_partition_block(partition)
         flags |= _FLAG_PARTITION
+    version_block = None
+    if version is not None:
+        version_block = _encode_version_block(version)
+        flags |= _FLAG_VERSION
     parts.append(_HEADER.pack(MAGIC, 1, kind, flags, 0, uuid))
     if error is not None:
         err = error.encode("utf-8")
@@ -242,6 +255,8 @@ def encode_frame(
         parts.append(tenant_block)
     if partition_block is not None:
         parts.append(partition_block)
+    if version_block is not None:
+        parts.append(version_block)
     parts.append(body)
     out = b"".join(parts)
     if _fi.active_plan is not None:  # chaos seam (faultinject.runtime)
@@ -272,6 +287,19 @@ def _encode_partition_block(partition: Sequence[int]) -> bytes:
         raise WireError(f"partition must be 5 ints: {e}") from None
 
 
+def _encode_version_block(version: int) -> bytes:
+    """Validate + pack one step-version block (flag bit 32) — the same
+    u64 range check the npwire lane applies, so the two lanes cannot
+    drift apart in what they refuse."""
+    try:
+        v = int(version)
+    except (TypeError, ValueError) as e:
+        raise WireError(f"version must be an int: {e}") from None
+    if not 0 <= v < (1 << 64):
+        raise WireError(f"version {v} outside u64 range")
+    return _VERSION_STRUCT.pack(v)
+
+
 def decode_frame(
     buf: bytes,
 ) -> Tuple[
@@ -281,11 +309,12 @@ def decode_frame(
     Optional[bytes],
     Optional[float],
     Optional[tuple],
+    Optional[int],
     int,
     bytes,
 ]:
     """Decode a doorbell frame header -> ``(kind, uuid, error,
-    trace_id, deadline_s, partition, body_offset, frame)``;
+    trace_id, deadline_s, partition, version, body_offset, frame)``;
     kind-specific body parsing is the caller's, offset-based against
     the RETURNED ``frame`` (which is ``buf`` unless the chaos seam
     transformed it — parsing the original after a filtered header
@@ -293,7 +322,9 @@ def decode_frame(
     remaining deadline budget off the wire (flag bit 4), ``None`` when
     unbounded; ``partition`` the gradient-partition block's 5-int
     tuple (flag bit 16, ``None`` when clear — routing/partition.py
-    owns the semantics)."""
+    owns the semantics); ``version`` the u64 step-version stamp (flag
+    bit 32, ``None`` when clear — zero is a meaningful stamp;
+    optim/sharded.py owns the semantics)."""
     if _fi.active_plan is not None:  # chaos seam (faultinject.runtime)
         buf = _fi.filter_bytes("shm.decode", buf)
     try:
@@ -351,7 +382,19 @@ def decode_frame(
                 f"truncated shm partition block: {e}"
             ) from None
         off += _PARTITION_STRUCT.size
-    return kind, uuid, error, trace_id, deadline_s, partition, off, buf
+    step_version = None
+    if flags & _FLAG_VERSION:
+        try:
+            (step_version,) = _VERSION_STRUCT.unpack_from(buf, off)
+        except struct.error as e:
+            raise WireError(
+                f"truncated shm version block: {e}"
+            ) from None
+        off += _VERSION_STRUCT.size
+    return (
+        kind, uuid, error, trace_id, deadline_s, partition,
+        step_version, off, buf,
+    )
 
 
 def frame_tenant(buf: bytes) -> Optional[str]:
@@ -439,6 +482,7 @@ def _desc_region_offset(
     trace_id: Optional[bytes],
     deadline_s: Optional[float] = None,
     partition: Optional[Sequence[int]] = None,
+    version: Optional[int] = None,
 ) -> int:
     """Byte offset where an OUTGOING EVAL/EVAL_BATCH frame's
     descriptor region starts (ack watermark preserved — corrupting it
@@ -449,6 +493,7 @@ def _desc_region_offset(
         + (16 if trace_id is not None else 0)
         + (8 if deadline_s is not None else 0)
         + (_PARTITION_STRUCT.size if partition is not None else 0)
+        + (_VERSION_STRUCT.size if version is not None else 0)
     )
     if kind == _KIND_EVAL:
         return off + 8  # past ack_gen
@@ -626,7 +671,7 @@ class ShmArraysClient:
         assert self._sock is not None
         uid = fast_uuid()
         self._send(encode_frame(_KIND_ATTACH, uid))
-        kind, ruid, error, _tid, _dl, _part, off, frame = decode_frame(
+        kind, ruid, error, _tid, _dl, _part, _ver, off, frame = decode_frame(
             self._read_frame()
         )
         if error is not None:
@@ -810,6 +855,7 @@ class ShmArraysClient:
         trace_id: Optional[bytes],
         deadline_s: Optional[float] = None,
         partition: Optional[Sequence[int]] = None,
+        version: Optional[int] = None,
     ) -> bytes:
         """The ``corrupt_descriptor`` chaos seam: flip bytes inside the
         descriptor block only (header corruption is ``corrupt_bytes``
@@ -818,7 +864,9 @@ class ShmArraysClient:
             return frame
         return _fi.corrupt_descriptor_bytes(
             "shm.descriptor", frame,
-            _desc_region_offset(kind, trace_id, deadline_s, partition),
+            _desc_region_offset(
+                kind, trace_id, deadline_s, partition, version
+            ),
             peer=self._peer,
         )
 
@@ -857,6 +905,29 @@ class ShmArraysClient:
     ) -> List[np.ndarray]:
         """One lock-step evaluation; ``partition`` (keyword-only)
         requests the head/tail SLICED reply, tcp.py-evaluate parity."""
+        outputs, _ver = self._evaluate_inner(arrays, partition, None)
+        return outputs
+
+    def evaluate_versioned(
+        self,
+        *arrays: np.ndarray,
+        partition: Optional[Sequence[int]] = None,
+        version: int,
+    ) -> Tuple[List[np.ndarray], Optional[int]]:
+        """One VERSIONED round trip (the sharded-optimizer lane,
+        ISSUE 16) -> ``(outputs, reply_version)`` —
+        tcp.py-evaluate_versioned parity: the node's
+        ``versioned_update`` handler answers shard-shaped outputs
+        stamped with the NEW version; a stale stamp surfaces as
+        :class:`RemoteComputeError` (optim/sharded.py classifies)."""
+        return self._evaluate_inner(arrays, partition, version)
+
+    def _evaluate_inner(
+        self,
+        arrays: Sequence[np.ndarray],
+        partition: Optional[Sequence[int]],
+        version: Optional[int],
+    ) -> Tuple[List[np.ndarray], Optional[int]]:
         with _spans.span("rpc.evaluate", transport="shm"):
             last_err: Optional[Exception] = None
             for attempt in range(self.retries + 1):
@@ -888,10 +959,11 @@ class ShmArraysClient:
                                 trace_id=trace_id,
                                 deadline_s=budget,
                                 partition=partition,
+                                version=version,
                             )
                             frame = self._apply_descriptor_chaos(
                                 frame, _KIND_EVAL, trace_id, budget,
-                                partition,
+                                partition, version,
                             )
                         self._send(frame)
                         reply = self._read_frame()
@@ -910,7 +982,9 @@ class ShmArraysClient:
                 ) from last_err
             with _spans.span("decode"):
                 try:
-                    outputs = self._consume_reply(reply, uid)
+                    outputs, reply_version = self._consume_reply(
+                        reply, uid, return_version=True
+                    )
                 except (RemoteComputeError, _deadline.DeadlineExceeded):
                     # In-band server error (deadline sheds included):
                     # the connection is still correlated — free the
@@ -929,14 +1003,19 @@ class ShmArraysClient:
             _CALL_S.labels(transport="shm", mode="lockstep").observe(
                 time.perf_counter() - t0
             )
-            return outputs
+            return outputs, reply_version
 
     __call__ = evaluate
 
     def _consume_reply(
-        self, reply: bytes, uid: bytes, *, force_copy: bool = False
-    ) -> List[np.ndarray]:
-        kind, ruid, error, _tid, _dl, _part, off, reply = decode_frame(reply)
+        self,
+        reply: bytes,
+        uid: bytes,
+        *,
+        force_copy: bool = False,
+        return_version: bool = False,
+    ):
+        kind, ruid, error, _tid, _dl, _part, _ver, off, reply = decode_frame(reply)
         if kind == _KIND_ERROR:
             raise WireError(f"shm protocol error from node: {error}")
         if kind != _KIND_REPLY:
@@ -955,7 +1034,10 @@ class ShmArraysClient:
                 "uuid mismatch: reply does not match request"
             )
         descs, _off = decode_descs(reply, off)
-        return self._decode_reply_arrays(descs, force_copy=force_copy)
+        outputs = self._decode_reply_arrays(descs, force_copy=force_copy)
+        if return_version:
+            return outputs, _ver
+        return outputs
 
     # -- pipelined / batched windows ---------------------------------------
 
@@ -1250,7 +1332,7 @@ class ShmArraysClient:
         items arrive in partition-index order under the outer uuid
         (the doorbell framing has no per-item partition blocks — both
         ends derive the same plan from (total, count))."""
-        kind, ruid, outer_err, _tid, _dl, rpart, off, reply = (
+        kind, ruid, outer_err, _tid, _dl, rpart, _ver, off, reply = (
             decode_frame(reply)
         )
         if kind == _KIND_ERROR:
@@ -1342,7 +1424,9 @@ class ShmArraysClient:
                     if np.asarray(slice_arr).size
                     else np.dtype(np.float64),
                 )
-            reassembler.add(plan[j], np.asarray(slice_arr))
+            reassembler.add(
+                plan[j], np.asarray(slice_arr), iuid=iuid.hex()
+            )
         assert reassembler is not None and head is not None
         return head, reassembler.result()
 
@@ -1582,7 +1666,7 @@ class ShmArraysClient:
             inflight.pop(0)
             first_error: Optional[str] = None
             try:
-                kind, ruid, outer_err, _tid, _dl, _part, off, reply = decode_frame(
+                kind, ruid, outer_err, _tid, _dl, _part, _ver, off, reply = decode_frame(
                     reply
                 )
                 if kind == _KIND_ERROR:
@@ -1687,7 +1771,7 @@ class ShmArraysClient:
         self._send(encode_frame(_KIND_GETLOAD, uid))
         reply = self._read_frame()
         try:
-            kind, ruid, error, _tid, _dl, _part, off, reply = decode_frame(reply)
+            kind, ruid, error, _tid, _dl, _part, _ver, off, reply = decode_frame(reply)
             if kind != _KIND_LOAD or ruid != uid or error is not None:
                 return None
             (jlen,) = struct.unpack_from("<I", reply, off)
@@ -1716,7 +1800,7 @@ class ShmArraysClient:
             encode_frame(_KIND_PING, uid, encode_descs(descs))
         )
         try:
-            kind, ruid, error, _tid, _dl, _part, _off, _frame = decode_frame(
+            kind, ruid, error, _tid, _dl, _part, _ver, _off, _frame = decode_frame(
                 self._read_frame()
             )
             if kind != _KIND_PONG or ruid != uid:
@@ -1896,7 +1980,10 @@ class _ShmConnection:
             return serve_npwire_payload(
                 self.compute_fn, payload, transport="shm"
             )
-        kind, uid, _err, trace_id, deadline_s, partition, off, payload = decode_frame(
+        (
+            kind, uid, _err, trace_id, deadline_s, partition,
+            step_version, off, payload,
+        ) = decode_frame(
             payload
         )
         if kind == _KIND_ATTACH:
@@ -1928,6 +2015,7 @@ class _ShmConnection:
                         return self._serve_eval(
                             payload, uid, trace_id, off,
                             partition=partition,
+                            version=step_version,
                         )
                     if partition is not None:
                         # Outer partition on a batch frame = a REDUCE
@@ -1980,6 +2068,7 @@ class _ShmConnection:
         trace_id: Optional[bytes],
         off: int,
         partition: Optional[tuple] = None,
+        version: Optional[int] = None,
     ) -> bytes:
         # Same pftpu_server_* families as the gRPC/TCP lanes
         # (_node_metrics) so an shm node aggregates in the fleet view.
@@ -2006,6 +2095,7 @@ class _ShmConnection:
             "node.evaluate", wire="shm", transport="shm"
         ) as root:
             root.set_attr("decode_s", t_decoded - t_arrive)
+            reply_version: Optional[int] = None
             try:
                 if _fi.active_plan is not None:  # chaos seam
                     _fi.compute_filter("shm.compute")
@@ -2014,13 +2104,33 @@ class _ShmConnection:
                     queue_wait = max(0.0, t_c0 - t_decoded)
                     _node_metrics.QUEUE_S.observe(queue_wait)
                     c_span.set_attr("queue_wait_s", queue_wait)
-                    outputs = [
-                        np.asarray(o) for o in self.compute_fn(*arrays)
-                    ]
+                    if version is not None:
+                        # Versioned sharded-optimizer lane (ISSUE 16;
+                        # tcp.py has the twin dispatch): the handler
+                        # owns slicing/versioning, the reply carries
+                        # the NEW stamp.
+                        handler = getattr(
+                            self.compute_fn, "versioned_update", None
+                        )
+                        if handler is None:
+                            raise WireError(
+                                "versioned request (flag bit 32) but"
+                                " this node's compute has no"
+                                " versioned_update handler"
+                            )
+                        outputs, reply_version = handler(
+                            arrays, partition, version
+                        )
+                        outputs = [np.asarray(o) for o in outputs]
+                    else:
+                        outputs = [
+                            np.asarray(o)
+                            for o in self.compute_fn(*arrays)
+                        ]
                     _node_metrics.COMPUTE_S.observe(
                         time.perf_counter() - t_c0
                     )
-                if partition is not None:
+                if partition is not None and version is None:
                     # Sliced reply (routing/partition.py head/tail
                     # rule); geometry disagreement is loud, in-band.
                     outputs = _partition.slice_reply(
@@ -2044,7 +2154,8 @@ class _ShmConnection:
                     _KIND_REPLY, uid, encode_descs([]), error=str(e)
                 )
         return encode_frame(
-            _KIND_REPLY, uid, encode_descs(rdescs), partition=partition
+            _KIND_REPLY, uid, encode_descs(rdescs), partition=partition,
+            version=reply_version,
         )
 
     def _serve_eval_batch(

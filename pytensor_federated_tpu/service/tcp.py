@@ -19,7 +19,7 @@ from __future__ import annotations
 import socket
 import struct
 import time
-from typing import Callable, List, Optional, Sequence
+from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -295,6 +295,32 @@ class TcpArraysClient:
         reply is ``[head, slice]`` with the block echoed; geometry
         disagreement surfaces as :class:`RemoteComputeError`
         (routing/partition.py owns the rule)."""
+        outputs, _ver = self._evaluate_inner(arrays, partition, None)
+        return outputs
+
+    def evaluate_versioned(
+        self,
+        *arrays: np.ndarray,
+        partition: Optional[Sequence[int]] = None,
+        version: int,
+    ) -> Tuple[List[np.ndarray], Optional[int]]:
+        """One VERSIONED round trip (the sharded-optimizer lane,
+        ISSUE 16) -> ``(outputs, reply_version)``.  The request
+        carries ``version`` as its u64 step stamp (flag bit 128;
+        zero is meaningful — the init handshake) and ``partition``
+        as the owned-shard geometry; the node's ``versioned_update``
+        handler answers shard-shaped outputs stamped with the NEW
+        version.  A stale stamp surfaces as
+        :class:`RemoteComputeError` carrying the node's loud
+        refusal (optim/sharded.py classifies it)."""
+        return self._evaluate_inner(arrays, partition, version)
+
+    def _evaluate_inner(
+        self,
+        arrays: Sequence[np.ndarray],
+        partition: Optional[Sequence[int]],
+        version: Optional[int],
+    ) -> Tuple[List[np.ndarray], Optional[int]]:
         with _spans.span("rpc.evaluate", transport="tcp"):
             with _spans.span("encode"):
                 uid = fast_uuid()
@@ -315,6 +341,7 @@ class TcpArraysClient:
                     deadline_s=_deadline.wire_budget(),
                     tenant=self.tenant,
                     partition=partition,
+                    version=version,
                 )
                 request_len = sg_nbytes(request)
             last_err: Optional[Exception] = None
@@ -343,6 +370,7 @@ class TcpArraysClient:
                             deadline_s=budget,
                             tenant=self.tenant,
                             partition=partition,
+                            version=version,
                         )
                         request_len = sg_nbytes(request)
                 t0 = time.perf_counter()
@@ -378,9 +406,10 @@ class TcpArraysClient:
                 ) from last_err
             with _spans.span("decode"):
                 try:
-                    outputs, reply_uid, error, _tid, node_spans = (
-                        decode_arrays_all(reply)
-                    )
+                    (
+                        outputs, reply_uid, error, _tid, node_spans,
+                        _rpart, reply_version,
+                    ) = decode_arrays_part(reply)
                 except Exception:
                     # Corrupt reply: close so the NEXT call reconnects
                     # cleanly instead of trusting a connection whose
@@ -412,7 +441,7 @@ class TcpArraysClient:
                 raise RuntimeError(
                     "uuid mismatch: reply does not match request"
                 )
-            return outputs
+            return outputs, reply_version
 
     __call__ = evaluate
 
@@ -818,7 +847,7 @@ class TcpArraysClient:
         every anomaly (the Reassembler rules), closing the connection
         so the NEXT call reconnects cleanly."""
         try:
-            items, ruid, outer_err, _tid, node_spans, rpart = (
+            items, ruid, outer_err, _tid, node_spans, rpart, _ver = (
                 decode_batch_part(reply)
             )
             if node_spans:
@@ -856,8 +885,8 @@ class TcpArraysClient:
             head: Optional[np.ndarray] = None
             reassembler: Optional[_partition.Reassembler] = None
             for item in items:
-                arrays, _uid, err, _t, _sp, ipart = decode_arrays_part(
-                    item
+                arrays, _uid, err, _t, _sp, ipart, _iv = (
+                    decode_arrays_part(item)
                 )
                 if err is not None:
                     raise RemoteComputeError(err)
@@ -898,7 +927,7 @@ class TcpArraysClient:
                         if np.asarray(slice_arr).size
                         else np.dtype(np.float64),
                     )
-                reassembler.add(p, np.asarray(slice_arr))
+                reassembler.add(p, np.asarray(slice_arr), iuid=_uid.hex())
             assert reassembler is not None
             if head is None:
                 raise _partition.PartitionError(
@@ -1281,8 +1310,8 @@ def _serve_plain_payload(
     gradient."""
     t_arrive = time.perf_counter()
     try:
-        arrays, uid, _err, trace_id, _sp, part = decode_arrays_part(
-            payload, copy=not request_views
+        arrays, uid, _err, trace_id, _sp, part, step_version = (
+            decode_arrays_part(payload, copy=not request_views)
         )
     except Exception as e:
         # A corrupt request fails ITS reply in-band and the connection
@@ -1308,18 +1337,39 @@ def _serve_plain_payload(
         try:
             if _fi.active_plan is not None:  # chaos seam
                 _fi.compute_filter()
+            reply_version: Optional[int] = None
             with _spans.span("compute") as c_span:
                 t_c0 = time.perf_counter()
                 queue_wait = max(0.0, t_c0 - t_decoded)
                 _node_metrics.QUEUE_S.observe(queue_wait)
                 c_span.set_attr("queue_wait_s", queue_wait)
-                outputs = [
-                    np.asarray(o) for o in compute_fn(*arrays)
-                ]
+                if step_version is not None:
+                    # Versioned sharded-optimizer lane (ISSUE 16): the
+                    # handler owns slicing/versioning — outputs come
+                    # back shard-shaped, stamped with the NEW version.
+                    # A version stamp on a compute with no handler is a
+                    # dispatch error, answered loudly in-band.
+                    handler = getattr(
+                        compute_fn, "versioned_update", None
+                    )
+                    if handler is None:
+                        raise WireError(
+                            "versioned request (flag bit 128) but this"
+                            " node's compute has no versioned_update"
+                            " handler"
+                        )
+                    outputs, reply_version = handler(
+                        arrays, part, step_version
+                    )
+                    outputs = [np.asarray(o) for o in outputs]
+                else:
+                    outputs = [
+                        np.asarray(o) for o in compute_fn(*arrays)
+                    ]
                 _node_metrics.COMPUTE_S.observe(
                     time.perf_counter() - t_c0
                 )
-            if part is not None:
+            if part is not None and step_version is None:
                 # Sliced reply (the scatter half of ISSUE 13): loud on
                 # geometry/shape disagreement — the PartitionError is a
                 # WireError and rides the in-band error arm below.
@@ -1328,7 +1378,10 @@ def _serve_plain_payload(
                 )
             with _spans.span("encode"):
                 t_e0 = time.perf_counter()
-                reply = encode_arrays(outputs, uuid=uid, partition=part)
+                reply = encode_arrays(
+                    outputs, uuid=uid, partition=part,
+                    version=reply_version,
+                )
                 _node_metrics.ENCODE_S.observe(
                     time.perf_counter() - t_e0
                 )
@@ -1378,8 +1431,8 @@ def _serve_reduce_payload(
     loud-reassembly contract forbids."""
     t_arrive = time.perf_counter()
     try:
-        items, outer_uuid, _err, trace_id, _sp, part = decode_batch_part(
-            payload
+        items, outer_uuid, _err, trace_id, _sp, part, _ver = (
+            decode_batch_part(payload)
         )
         assert part is not None  # dispatched on peek_partition
         req_part = _partition.GradPartition(*part)

@@ -43,6 +43,7 @@ __all__ = [
     "NPPROTO_EXTENSION_FIELDS",
     "PARTITION_STRUCT",
     "PARTITION_FIELD_ORDER",
+    "VERSION_STRUCT",
     "NPPROTO_PARTITION_FIELDS",
     "SHMWIRE_KINDS",
     "SHMWIRE_FLAGS",
@@ -62,6 +63,7 @@ NPWIRE_FLAGS = {
     "DEADLINE": 16,  # f64 remaining-budget block (service/deadline.py)
     "TENANT": 32,   # u16-len utf8 tenant id block (gateway/fairness.py)
     "PARTITION": 64,  # gradient-partition index block (routing/partition.py)
+    "VERSION": 128,  # u64 monotonic step-version stamp (optim/sharded.py)
 }
 
 #: The full known-flags mask every npwire decoder must enforce
@@ -94,6 +96,10 @@ NPPROTO_FIELDS = {
         "partition": 20,    # nested message: gradient-partition index
                             # block (routing/partition.py; sub-fields in
                             # NPPROTO_PARTITION_FIELDS)
+        "version": 21,      # varint u64: monotonic step-version stamp
+                            # (optim/sharded.py; emitted explicitly even
+                            # at 0 — field PRESENCE marks a versioned
+                            # frame, so the zero stamp cannot be elided)
     },
     "get_load_result": {
         "n_clients": 1,
@@ -143,6 +149,7 @@ SHMWIRE_FLAGS = {
     "DEADLINE": 4,  # f64 remaining-budget block (service/deadline.py)
     "TENANT": 8,    # u16-len utf8 tenant id block (gateway/fairness.py)
     "PARTITION": 16,  # gradient-partition index block (routing/partition.py)
+    "VERSION": 32,  # u64 monotonic step-version stamp (optim/sharded.py)
 }
 
 #: The full known-flags mask every shm decoder must enforce
@@ -180,6 +187,20 @@ SHM_DESC_FIELD_ORDER = ("slot", "delta", "length", "generation")
 #: the semantics (slice/reduce rules, reassembly).
 PARTITION_STRUCT = "<IIQQQ"
 PARTITION_FIELD_ORDER = ("index", "count", "offset", "length", "total")
+
+#: The step-version stamp (ISSUE 16): a monotonic u64 counting
+#: optimizer updates applied to one gradient shard, carried on update
+#: and param-refresh frames so a stale optimizer-state shard is a loud
+#: ``WireError``-family refusal (``optim.StaleShardError``), never a
+#: silently stale moment buffer.  On npwire the stamp rides flag bit
+#: 128 as 8 little-endian bytes after the partition block; on the shm
+#: doorbell, flag bit 32 in the same position; on npproto it is
+#: extension field 21, a varint a reference runtime skips by wire
+#: type.  Zero is a meaningful stamp (the init handshake), so every
+#: codec signals the feature by PRESENCE (flag bit / field), never by
+#: value.  ``optim/sharded.py`` owns the semantics (version check,
+#: exactly-once update, restore-or-refuse).
+VERSION_STRUCT = "<Q"
 
 #: Sub-field numbers of the npproto partition message (field 20).
 NPPROTO_PARTITION_FIELDS = {

@@ -257,12 +257,12 @@ class TestShmDeadlineField:
 
         bare = shm.encode_frame(shm._KIND_EVAL, b"u" * 16, b"body")
         assert not bare[6] & shm._FLAG_DEADLINE  # flags byte offset 6
-        k, u, e, t, d, _part, off, frame = shm.decode_frame(bare)
+        k, u, e, t, d, _part, _ver, off, frame = shm.decode_frame(bare)
         assert d is None and frame[off:] == b"body"
         stamped = shm.encode_frame(
             shm._KIND_EVAL, b"u" * 16, b"body", deadline_s=0.75
         )
-        k, u, e, t, d, _part, off, frame = shm.decode_frame(stamped)
+        k, u, e, t, d, _part, _ver, off, frame = shm.decode_frame(stamped)
         assert d == 0.75 and frame[off:] == b"body"
         # The deadline block is exactly the 8-byte delta.
         assert len(stamped) == len(bare) + 8

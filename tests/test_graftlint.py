@@ -184,9 +184,10 @@ _FLAG_BATCH = 8
 _FLAG_DEADLINE = 16
 _FLAG_TENANT = 32
 _FLAG_PARTITION = 64
+_FLAG_VERSION = 128
 _KNOWN_FLAGS = (
     _FLAG_ERROR | _FLAG_TRACE | _FLAG_SPANS | _FLAG_BATCH
-    | _FLAG_DEADLINE | _FLAG_TENANT | _FLAG_PARTITION
+    | _FLAG_DEADLINE | _FLAG_TENANT | _FLAG_PARTITION | _FLAG_VERSION
 )
 
 
@@ -213,9 +214,10 @@ constexpr uint8_t kFlagBatch = 8;
 constexpr uint8_t kFlagDeadline = 16;
 constexpr uint8_t kFlagTenant = 32;
 constexpr uint8_t kFlagPartition = 64;
+constexpr uint8_t kFlagVersion = 128;
 constexpr uint8_t kKnownFlags =
     kFlagError | kFlagTrace | kFlagSpans | kFlagBatch | kFlagDeadline |
-    kFlagTenant | kFlagPartition;
+    kFlagTenant | kFlagPartition | kFlagVersion;
 bool decode(const Buf& b) {
   if (flags & ~kKnownFlags) return false;
   return true;
@@ -250,9 +252,10 @@ _FLAG_TRACE = 2
 _FLAG_DEADLINE = 4
 _FLAG_TENANT = 8
 _FLAG_PARTITION = 16
+_FLAG_VERSION = 32
 _KNOWN_FLAGS = (
     _FLAG_ERROR | _FLAG_TRACE | _FLAG_DEADLINE | _FLAG_TENANT
-    | _FLAG_PARTITION
+    | _FLAG_PARTITION | _FLAG_VERSION
 )
 _DESC_STRUCT = struct.Struct("<QIQQ")
 
@@ -300,9 +303,11 @@ class TestWireRegistry:
         src = NPWIRE_CLEAN.replace(
             "_KNOWN_FLAGS = (\n"
             "    _FLAG_ERROR | _FLAG_TRACE | _FLAG_SPANS | _FLAG_BATCH\n"
-            "    | _FLAG_DEADLINE | _FLAG_TENANT | _FLAG_PARTITION\n)",
+            "    | _FLAG_DEADLINE | _FLAG_TENANT | _FLAG_PARTITION"
+            " | _FLAG_VERSION\n)",
             "",
         )
+        assert "_KNOWN_FLAGS" not in src  # the replace target must track
         findings = run_on(tmp_path, {NPWIRE_REL: src}, ["wire-registry"])
         assert any("known-flags mask" in f.message for f in findings)
 

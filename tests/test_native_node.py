@@ -661,24 +661,11 @@ class TestFaultPlanFlag:
         assert "fault-plan" in out.stderr
 
 
-def test_unknown_flag_bits_rejected_loudly(cpp_node):
-    """Regression (graftlint wire-registry): the native node must
-    REJECT a frame carrying an undeclared flag bit — same loud-failure
-    posture as the Python decoders (npwire `_check_flags`)."""
+def _roundtrip_raw_frame(port, frame):
     import socket as socket_mod
     import struct as struct_mod
 
-    from pytensor_federated_tpu.service.npwire import (
-        _FLAGS_OFF,
-        decode_arrays,
-        encode_arrays,
-    )
-
-    frame = bytearray(
-        encode_arrays([np.zeros(3, np.float64)])
-    )
-    frame[_FLAGS_OFF] |= 0x80  # undeclared bit 128 (64 is PARTITION now)
-    with socket_mod.create_connection(("127.0.0.1", cpp_node), 5) as s:
+    with socket_mod.create_connection(("127.0.0.1", port), 5) as s:
         s.sendall(struct_mod.pack("<I", len(frame)) + bytes(frame))
         s.settimeout(5)
         hdr = s.recv(4)
@@ -689,5 +676,40 @@ def test_unknown_flag_bits_rejected_loudly(cpp_node):
             chunk = s.recv(n - len(buf))
             assert chunk, "node closed mid-reply"
             buf += chunk
-    _arrays, _uuid, error = decode_arrays(buf)
-    assert error is not None and "unknown flag" in error
+    return buf
+
+
+def test_corrupt_flag_block_rejected_loudly(cpp_node):
+    """Regression (graftlint wire-registry): ISSUE 16 saturated the
+    flag byte (128 = VERSION), so the loud-failure posture now shows as
+    a corrupt-block refusal — a flag claiming a block the frame does
+    not carry must fail in-band, never mis-parse the bytes after it."""
+    from pytensor_federated_tpu.service.npwire import (
+        _FLAGS_OFF,
+        decode_arrays,
+        encode_arrays,
+    )
+
+    frame = bytearray(encode_arrays([]))
+    frame[_FLAGS_OFF] |= 0x80  # VERSION flag with no version block
+    _arrays, _uuid, error = decode_arrays(
+        _roundtrip_raw_frame(cpp_node, frame)
+    )
+    assert error is not None and "truncated version block" in error
+
+
+def test_versioned_request_refused_loudly(cpp_node):
+    """The sharded-optimizer lane (flag 128, ISSUE 16) needs node-owned
+    optimizer state; the native node has none and must refuse IN-BAND —
+    a silent pass-through would look like an applied update."""
+    from pytensor_federated_tpu.service.npwire import (
+        decode_arrays,
+        encode_arrays,
+    )
+
+    frame = encode_arrays([np.zeros(3, np.float64)], version=7)
+    _arrays, _uuid, error = decode_arrays(
+        _roundtrip_raw_frame(cpp_node, frame)
+    )
+    assert error is not None
+    assert "versioned" in error and "not supported" in error

@@ -100,15 +100,25 @@ def test_unknown_flag_bits_rejected():
         encode_batch,
     )
 
-    enc = bytearray(encode_arrays([np.zeros(3, np.float32)]))
-    enc[_FLAGS_OFF] |= 0x80  # undeclared bit 128 (64 = PARTITION, ISSUE 13)
-    with pytest.raises(WireError, match="unknown flag bits"):
+    # ISSUE 16 saturated the flag byte (128 = VERSION), so no
+    # undeclared bit remains to flip — the loud-failure posture now
+    # shows as a corrupt-block refusal: a flag claiming a block the
+    # frame does not carry must fail as WireError, never mis-parse.
+    enc = bytearray(encode_arrays([]))
+    enc[_FLAGS_OFF] |= 0x80  # VERSION flag with no version block
+    with pytest.raises(WireError, match="truncated version block"):
         decode_arrays(bytes(enc))
 
-    batch = bytearray(encode_batch([encode_arrays([np.ones(2)])]))
-    batch[_FLAGS_OFF] |= 0x80  # undeclared bit 128 (batch bit stays set)
-    with pytest.raises(WireError, match="unknown flag bits"):
+    batch = bytearray(encode_batch([]))
+    batch[_FLAGS_OFF] |= 0x80  # VERSION flag with no version block
+    with pytest.raises(WireError, match="truncated"):
         decode_batch(bytes(batch))
+
+    # The guard itself still fires on a mask wider than one byte can
+    # carry (future-proofing the helper, not the wire).
+    from pytensor_federated_tpu.service.npwire import _check_flags
+    with pytest.raises(WireError, match="unknown flag bits"):
+        _check_flags(0x100)
 
 
 def test_known_flag_combinations_still_decode():

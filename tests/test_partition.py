@@ -156,12 +156,12 @@ class TestNpwirePartition:
             [np.arange(5.0)], partition=PART, deadline_s=1.0, tenant="t"
         )
         assert npwire.peek_partition(f) == PART
-        *_, part = npwire.decode_arrays_part(f)
-        assert part == PART
+        *_, part, _ver = npwire.decode_arrays_part(f)
+        assert part == PART and _ver is None
         b = npwire.encode_batch([f], partition=PART)
         assert npwire.peek_partition(b) == PART
-        *_, bpart = npwire.decode_batch_part(b)
-        assert bpart == PART
+        *_, bpart, _bver = npwire.decode_batch_part(b)
+        assert bpart == PART and _bver is None
 
     def test_absent_is_byte_identical(self):
         a = npwire.encode_arrays([np.arange(3.0)], uuid=b"u" * 16)
@@ -260,10 +260,10 @@ class TestShmPartition:
             shm._KIND_EVAL, b"u" * 16, b"body", partition=PART,
             deadline_s=2.0,
         )
-        k, u, e, t, d, part, off, frame = shm.decode_frame(stamped)
+        k, u, e, t, d, part, _ver, off, frame = shm.decode_frame(stamped)
         assert part == PART and d == 2.0
         assert frame[off:] == b"body"
-        k, u, e, t, d, part, off, frame = shm.decode_frame(bare)
+        k, u, e, t, d, part, _ver, off, frame = shm.decode_frame(bare)
         assert part is None
 
     def test_truncated_block_is_loud(self):
@@ -279,7 +279,7 @@ class TestShmPartition:
         from pytensor_federated_tpu.service import shm
 
         frame = bytearray(shm.encode_frame(shm._KIND_EVAL, b"u" * 16))
-        frame[6] |= 0x20  # first bit past PARTITION (16)
+        frame[6] |= 0x40  # first bit past VERSION (32)
         with pytest.raises(WireError, match="unknown shm flag"):
             shm.decode_frame(bytes(frame))
 

@@ -249,14 +249,14 @@ class TestDoorbellWire:
     def test_frame_roundtrip(self):
         uid = b"u" * 16
         frame = encode_frame(_KIND_EVAL, uid, b"body", trace_id=b"t" * 16)
-        kind, ruid, err, tid, _dl, _part, off, eff = decode_frame(frame)
+        kind, ruid, err, tid, _dl, _part, _ver, off, eff = decode_frame(frame)
         assert (kind, ruid, err, tid) == (_KIND_EVAL, uid, None, b"t" * 16)
         assert eff is frame  # no chaos plan: the effective frame IS buf
         assert frame[off:] == b"body"
 
     def test_error_block_roundtrip(self):
         frame = encode_frame(_KIND_REPLY, b"u" * 16, error="boom")
-        _k, _u, err, _t, _d, _p, _o, _f = decode_frame(frame)
+        _k, _u, err, _t, _d, _p, _v, _o, _f = decode_frame(frame)
         assert err == "boom"
 
     def test_unknown_kind_rejected(self):

@@ -174,7 +174,7 @@ def test_mutated_frame_never_partial(descs, data):
     else:
         mutated = frame[: data.draw(st.integers(0, len(frame) - 1))]
     try:
-        kind, uid, err, tid, _dl, _part, off, eff = decode_frame(mutated)
+        kind, uid, err, tid, _dl, _part, _ver, off, eff = decode_frame(mutated)
         parsed, _end = decode_descs(eff, off + 8)
     except WireError:
         return  # loud: the contract

@@ -179,7 +179,7 @@ class TestShmTenant:
             deadline_s=1.5, trace_id=b"t" * 16,
         )
         assert shm_mod.frame_tenant(frame) == tenant
-        kind, uuid, error, tid, deadline_s, off, buf = (
+        kind, uuid, error, tid, deadline_s, _part, _ver, off, buf = (
             shm_mod.decode_frame(frame)
         )
         assert kind == shm_mod._KIND_EVAL and error is None

@@ -2100,6 +2100,359 @@ def run_streaming_seed(seed: int, verbose: bool) -> dict:
     return result
 
 
+# -- the zero lane (ISSUE 16) -----------------------------------------------
+
+
+def _serve_zero_node(port: int, store_root: str) -> None:
+    """One sharded-optimizer OWNER replica: the radon ppl model's
+    versioned update compute (node-owned optax state, shard-local
+    adam) over TCP, checkpointing owned shards into the SHARED store
+    root — a respawned or failed-over replica restoring a dead owner's
+    checkpoint is what the lane verifies."""
+    import logging
+
+    logging.disable(logging.ERROR)
+
+    from pytensor_federated_tpu.utils import force_cpu_backend
+
+    force_cpu_backend()
+
+    from pytensor_federated_tpu.optim import ShardStore
+    from pytensor_federated_tpu.ppl.svi import make_sharded_update_compute
+    from pytensor_federated_tpu.service.tcp import serve_tcp_once
+
+    compiled = _streaming_compiled()
+    compute = make_sharded_update_compute(
+        compiled, ShardStore(store_root), learning_rate=5e-2, n_mc=2
+    )
+    serve_tcp_once(compute, "127.0.0.1", port, concurrent=True)
+
+
+def _spawn_zero_node(port, store_root, plan_json=None):
+    saved = os.environ.get(fi.runtime.ENV_VAR)
+    if plan_json is not None:
+        os.environ[fi.runtime.ENV_VAR] = plan_json
+    else:
+        os.environ.pop(fi.runtime.ENV_VAR, None)
+    try:
+        ctx = mp.get_context("spawn")
+        proc = ctx.Process(
+            target=_serve_zero_node, args=(port, store_root), daemon=True
+        )
+        proc.start()
+    finally:
+        if saved is None:
+            os.environ.pop(fi.runtime.ENV_VAR, None)
+        else:
+            os.environ[fi.runtime.ENV_VAR] = saved
+    return proc
+
+
+def _zero_node_templates():
+    """Victim-owner rules beyond the guaranteed SIGKILL: compute
+    errors (a refused update the driver must shed loudly) and byte
+    faults on the reply path (the maybe-applied ambiguity the version
+    check disambiguates on retry)."""
+    return [
+        ("compute_error", dict(point="server.compute", max_fires=2)),
+        ("disconnect", dict(point="tcp.send", max_fires=2)),
+        ("delay", dict(point="tcp.send", delay_s=0.05, max_fires=3)),
+    ]
+
+
+def _zero_driver_templates():
+    """Driver-side rules: twisted version stamps (the node must refuse
+    and the driver must NOT count the batch), dropped param refreshes
+    (recovery retries next step), and link delays."""
+    return [
+        ("stale_param_version",
+         dict(point="optim.update.version", max_fires=2)),
+        ("drop_param_refresh", dict(point="optim.refresh", max_fires=1)),
+        ("delay", dict(point="tcp.send", delay_s=0.02, max_fires=2)),
+    ]
+
+
+def run_zero_seed(seed: int, verbose: bool) -> dict:
+    """One sharded-optimizer scenario (``--lane zero``): a sharded
+    :class:`StreamingSVI` driver over a 3-replica TCP pool carrying 2
+    optimizer-state shards, every replica checkpointing owned shards
+    into a SHARED store; one victim replica runs a seeded fault plan
+    ALWAYS including a SIGKILL mid-update while the driver twists
+    version stamps and drops refreshes.  Invariants (ISSUE 16):
+
+    Z1 per-shard exactly-once — ``shard_opt_steps[k] ==
+       shard_accepted[k]`` for every shard after every phase: a
+       refused or failed shard update never moved the version, an
+       applied one moved it exactly once (no double-step through
+       SIGKILL + failover + retry), and versions only move UP;
+    Z2 exact accounting — offered == accepted + sum(skipped), every
+       shed batch classified (never a silent drop), and a version
+       divergence would RAISE (WireError), never shed;
+    Z3 no hang — every step settles within CALL_DEADLINE_S;
+    Z4 restore — after faults stop and dead replicas respawn, steps
+       accept again, and each shard's checkpoint in the shared store
+       agrees BIT-EXACTLY (params and version) with the driver's
+       parameter slice: replica death restored optimizer state, it
+       did not reinvent it;
+    Z5 goodput — chaos sheds stay bounded: >= 40% of chaos-phase
+       batches accepted.
+    """
+
+    def log(msg):
+        if verbose:
+            print(msg, flush=True)
+
+    import shutil
+    import tempfile
+
+    import jax
+
+    from pytensor_federated_tpu.optim import ShardStore, ShardedOptimizer
+    from pytensor_federated_tpu.ppl.svi import StreamingSVI
+    from pytensor_federated_tpu.routing import NodePool
+    from pytensor_federated_tpu.service.npwire import WireError
+
+    rng = random.Random(seed ^ 0x2E80)
+    params = {
+        "n_batches": 30,
+        "batch": 4,
+        "deadline_s": 8.0,
+        "goodput_floor": 0.4,
+        "clean_attempts": 6,
+        "clean_accepted": 3,
+    }
+    # The victim ALWAYS dies mid-update (the lane's namesake), plus
+    # sampled extras.
+    node_rules = [
+        fi.FaultRule(
+            "kill_process", point="server.compute",
+            nth=rng.randint(2, 10),
+        )
+    ]
+    for kind, kw in rng.sample(_zero_node_templates(), rng.randint(0, 2)):
+        kw = dict(kw)
+        if rng.random() < 0.5:
+            kw["nth"] = rng.randint(2, 8)
+            kw.pop("max_fires", None)
+        node_rules.append(fi.FaultRule(kind, **kw))
+    node_plan_json = fi.FaultPlan(
+        node_rules, seed=seed, plan_id=f"zero-{seed}-node"
+    ).to_json()
+    driver_rules = [
+        fi.FaultRule(kind, **dict(kw))
+        for kind, kw in rng.sample(
+            _zero_driver_templates(), rng.randint(1, 2)
+        )
+    ]
+    driver_plan = fi.FaultPlan(
+        driver_rules, seed=seed, plan_id=f"zero-{seed}-driver"
+    )
+    log(
+        f"zero seed {seed}: driver "
+        f"{[r.to_dict() for r in driver_rules]}, victim "
+        f"{[r.to_dict() for r in node_rules]}"
+    )
+    tspans.set_enabled(True)
+    flightrec.set_enabled(True)
+    if flightrec.capacity() < 16384:
+        flightrec.set_capacity(16384)
+    flightrec.clear()
+
+    store_root = tempfile.mkdtemp(prefix=f"pftpu-zero-{seed}-")
+    ports = _free_ports(3)
+    victim = rng.randrange(3)
+    procs = [
+        _spawn_zero_node(
+            p, store_root, node_plan_json if k == victim else None
+        )
+        for k, p in enumerate(ports)
+    ]
+    result = {"seed": seed, "transport": "zero", "ok": True}
+    pool = None
+    try:
+        _wait_nodes_up("tcp", ports)
+        pool = NodePool(
+            [("127.0.0.1", p) for p in ports],
+            transport="tcp",
+            probe_interval_s=0.3,
+            probe_timeout_s=2.0,
+            breaker_kwargs=dict(
+                failure_threshold=2, backoff_s=0.2, jitter_frac=0.1
+            ),
+        )
+        pool.start()
+        compiled = _streaming_compiled()
+        dim = int(
+            sum(
+                np.asarray(leaf).size
+                for leaf in jax.tree_util.tree_leaves(
+                    compiled.init_params()
+                )
+            )
+        )
+        opt = ShardedOptimizer(
+            2 * dim, pool=pool, count=2, failover_retries=3
+        )
+        svi = StreamingSVI(
+            compiled,
+            key=jax.random.PRNGKey(seed),
+            n_mc=2,
+            learning_rate=5e-2,
+            deadline_s=None,  # warmup: no budget while jits compile
+            sharded=opt,
+        )
+        batches = np.random.default_rng(seed)
+
+        def next_batch():
+            return batches.choice(8, size=params["batch"], replace=False)
+
+        def check_z1(where):
+            if svi.shard_opt_steps != svi.shard_accepted:
+                raise Violation(
+                    f"{where}: per-shard accounting broke — "
+                    f"opt_steps {svi.shard_opt_steps} != "
+                    f"accepted {svi.shard_accepted} "
+                    "(double-step or ghost version)"
+                )
+
+        def step_checked(i, where):
+            prev = list(opt.versions)
+            t0 = time.time()
+            try:
+                outcome = svi.step(next_batch())
+            except WireError as e:
+                raise Violation(
+                    f"{where} {i}: version divergence escaped "
+                    f"({str(e)[:200]})"
+                )
+            wall = time.time() - t0
+            if wall > CALL_DEADLINE_S:
+                raise Violation(
+                    f"{where} {i}: {wall:.1f}s wall past "
+                    f"{CALL_DEADLINE_S}s (hang)"
+                )
+            if any(v2 < v1 for v1, v2 in zip(prev, opt.versions)):
+                raise Violation(
+                    f"{where} {i}: shard version REWOUND "
+                    f"{prev} -> {opt.versions}"
+                )
+            log(f"  {where} {i}: {outcome} ({wall * 1e3:.0f} ms) "
+                f"versions={opt.versions}")
+            return outcome
+
+        # Warmup (node victim plan is live; that is part of the run),
+        # then baseline the ledger for the goodput floor.
+        for i in range(2):
+            step_checked(i, "warmup")
+        base_offered, base_accepted = svi.offered, svi.accepted
+
+        fi.install(driver_plan)
+        svi.deadline_s = params["deadline_s"]
+        for i in range(params["n_batches"]):
+            step_checked(i, "batch")
+        fi.uninstall()
+
+        check_z1("chaos phase")
+        offered = svi.offered - base_offered
+        accepted = svi.accepted - base_accepted
+        skipped = sum(svi.skipped.values())
+        if svi.offered != svi.accepted + skipped:
+            raise Violation(
+                f"batch accounting broke: offered {svi.offered} != "
+                f"accepted {svi.accepted} + skipped {skipped}"
+            )
+        if accepted < params["goodput_floor"] * offered:
+            raise Violation(
+                f"goodput collapsed: {accepted}/{offered} accepted "
+                f"(floor {params['goodput_floor']})"
+            )
+
+        # Phase B: respawn dead owners, wait for the pool to
+        # reconverge, then the lane must accept again and the SHARED
+        # store must agree bit-exactly with the driver.
+        for k, proc in enumerate(procs):
+            if not proc.is_alive():
+                log(f"  owner {k} died (SIGKILL mid-update): respawning")
+                procs[k] = _spawn_zero_node(ports[k], store_root, None)
+        _wait_nodes_up("tcp", ports)
+        deadline_t = time.time() + 30.0
+        while time.time() < deadline_t:
+            if all(
+                r.breaker.state == "closed" for r in pool.replicas
+            ):
+                break
+            time.sleep(0.1)
+        clean_ok = 0
+        for i in range(params["clean_attempts"]):
+            outcome = step_checked(i, "clean")
+            clean_ok = clean_ok + 1 if outcome == "accepted" else 0
+            if clean_ok >= params["clean_accepted"]:
+                break
+        if clean_ok < params["clean_accepted"]:
+            raise Violation(
+                f"never reconverged: < {params['clean_accepted']} "
+                f"consecutive accepted steps after faults stopped "
+                f"(skipped={dict(svi.skipped)})"
+            )
+        check_z1("clean phase")
+
+        flat = np.concatenate(
+            [np.asarray(svi.mu).ravel(), np.asarray(svi.log_sd).ravel()]
+        )
+        store = ShardStore(store_root)
+        for k, part in enumerate(opt.parts):
+            state = store.load(part)
+            if state is None:
+                raise Violation(f"shard {k}: checkpoint vanished")
+            if state.version != opt.versions[k]:
+                raise Violation(
+                    f"shard {k}: store version {state.version} != "
+                    f"driver version {opt.versions[k]}"
+                )
+            driver_slice = flat[part.offset : part.offset + part.length]
+            if not np.array_equal(state.params, driver_slice):
+                raise Violation(
+                    f"shard {k}: restored checkpoint params diverge "
+                    "from the driver's slice (restore reinvented "
+                    "state)"
+                )
+        result.update(
+            offered=svi.offered,
+            accepted=svi.accepted,
+            skipped_kinds=dict(svi.skipped),
+            shard_steps=list(svi.shard_opt_steps),
+            faults_fired=driver_plan.total_fires,
+        )
+    except Violation as v:
+        bundle = write_incident_bundle(
+            f"chaos-zero-seed-{seed}",
+            attrs={"seed": seed, "violation": str(v)[:500]},
+        )
+        result.update(ok=False, error=str(v), bundle=bundle)
+    except Exception as e:  # harness bug: loud, with a bundle
+        bundle = write_incident_bundle(
+            f"chaos-zero-seed-{seed}-harness",
+            attrs={"seed": seed, "error": f"{type(e).__name__}: {e}"},
+        )
+        result.update(
+            ok=False,
+            error=f"harness: {type(e).__name__}: {e}",
+            bundle=bundle,
+        )
+    finally:
+        fi.uninstall()
+        if pool is not None:
+            pool.close()
+        for proc in procs:
+            if proc.is_alive():
+                proc.terminate()
+        for proc in procs:
+            proc.join(timeout=10)
+        shutil.rmtree(store_root, ignore_errors=True)
+        flightrec.clear()
+    return result
+
+
 def run_seed(seed: int, transport: str, verbose: bool) -> dict:
     """One full chaos scenario; returns a result dict, raising nothing —
     violations land in the dict with an incident-bundle path."""
@@ -2201,7 +2554,7 @@ def main(argv=None) -> int:
     ap.add_argument("--transport", "--lane", dest="transport",
                     choices=("grpc", "tcp", "shm", "overload",
                              "collector", "gateway", "shard",
-                             "streaming"),
+                             "streaming", "zero"),
                     default="grpc",
                     help="transport lane under chaos (--lane is an "
                     "alias; 'shm' runs the zero-copy arena lane; "
@@ -2225,7 +2578,13 @@ def main(argv=None) -> int:
                     "replica, and a hog tenant — optimizer steps == "
                     "accepted batches, shed minibatches provably "
                     "skipped never double-counted, ELBO envelope "
-                    "holds, goodput floor)")
+                    "holds, goodput floor; 'zero' runs the ISSUE-16 "
+                    "scenario: sharded-optimizer SVI over a 3-owner "
+                    "pool with a replica SIGKILLed mid-update, "
+                    "twisted version stamps and dropped refreshes — "
+                    "per-shard opt_steps == accepted, loud stale "
+                    "refusals, bit-exact checkpoint restore, zero "
+                    "hangs)")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
 
@@ -2247,6 +2606,8 @@ def main(argv=None) -> int:
             res = run_shard_seed(seed, args.verbose)
         elif args.transport == "streaming":
             res = run_streaming_seed(seed, args.verbose)
+        elif args.transport == "zero":
+            res = run_zero_seed(seed, args.verbose)
         else:
             res = run_seed(seed, args.transport, args.verbose)
         status = "ok" if res["ok"] else "FAIL"
@@ -2274,6 +2635,12 @@ def main(argv=None) -> int:
                 f"skipped={res.get('skipped_kinds')} "
                 f"hog_denied={res.get('hog_denied')} "
                 f"elbo={res.get('elbo_last')}"
+            )
+        elif args.transport == "zero":
+            extra = (
+                f"accepted={res.get('accepted')}/{res.get('offered')} "
+                f"skipped={res.get('skipped_kinds')} "
+                f"shard_steps={res.get('shard_steps')}"
             )
         else:
             extra = (

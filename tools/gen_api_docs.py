@@ -84,6 +84,12 @@ MODULES = [
     # Effect-handler probabilistic front end (ISSUE 15): primitives +
     # handlers, the distribution objects, the plate->fed compiler, the
     # shared ELBO core, and the SVI lanes.
+    # Sharded optimizer (ISSUE 16): the ZeRO-over-the-pool surface —
+    # owner-side compute factory, driver-side ShardedOptimizer, and
+    # the checkpoint store whose version protocol carries exactly-once.
+    "pytensor_federated_tpu.optim",
+    "pytensor_federated_tpu.optim.sharded",
+    "pytensor_federated_tpu.optim.state",
     "pytensor_federated_tpu.ppl",
     "pytensor_federated_tpu.ppl.distributions",
     "pytensor_federated_tpu.ppl.handlers",
