@@ -472,7 +472,7 @@ def _bench_serve_zero_owner(ports, store_root):
         t.join()
 
 
-def _bench_serve_shm_node(port, use_suffstats):
+def _bench_serve_shm_node(port, use_suffstats, transport="shm"):
     """Config 15's shm node: the C++ node's EXACT Gaussian linreg
     logp+grad contract ``(a, b, sigma, x, y) -> [logp, g_a, g_b]`` in
     numpy.  With ``use_suffstats`` the node memoizes the six data
@@ -554,9 +554,14 @@ def _bench_serve_shm_node(port, use_suffstats):
             np.asarray(s_resid_x * inv_var),
         ]
 
-    from pytensor_federated_tpu.service.shm import serve_shm
+    if transport == "ring":
+        from pytensor_federated_tpu.service.ring import serve_ring
 
-    serve_shm(compute, "127.0.0.1", port)
+        serve_ring(compute, "127.0.0.1", port)
+    else:
+        from pytensor_federated_tpu.service.shm import serve_shm
+
+        serve_shm(compute, "127.0.0.1", port)
 
 
 def main():
@@ -3715,6 +3720,340 @@ def main():
         )
 
     guard("sharded-optimizer SVI", _c21)
+
+    # 22. Zero-syscall ring vs the shm doorbell (ISSUE 18): the SAME
+    # Gaussian-linreg node contract as config 15, served over (a) the
+    # shm arena + TCP doorbell (the round-9 lane, re-run in THIS
+    # container as the control) and (b) the seqlock submission/
+    # completion rings embedded in the same arenas — descriptor
+    # hand-off through shared memory, the doorbell kept only for
+    # attach + fallback.  Both lanes move zero payload bytes
+    # steady-state (pinned arrays); what the ring removes is the
+    # per-frame SOCKET hop: two syscalls per descriptor each way on
+    # the doorbell vs a seqlock read (plus an amortized futex
+    # park/wake at window edges) on the ring.  HONEST 1-CORE FRAMING
+    # (the config-15 0.63x precedent): this container has one core,
+    # so a lock-step round trip is context-switch bound on BOTH lanes
+    # and the ring's spin-hit regime — where the peer's commit lands
+    # while the consumer is still spinning, ~10-15 us round trips,
+    # zero syscalls — needs a genuinely-parallel 2-core colocated
+    # pair.  The acceptance is therefore parity-shaped: ring >= 0.7x
+    # the doorbell on the windowed production-width lane, plus the
+    # measurable half of the zero-syscall claim: the DRIVER's
+    # descriptor-path syscalls/eval (futex shim counters; strace is
+    # absent in this container) amortized below 2/eval windowed,
+    # corroborated by the process's voluntary-context-switch delta
+    # (ru_nvcsw).  Artifact: tools/suite_cpu_r18_ring.jsonl.
+    def _c22():
+        import multiprocessing as mp
+        import resource as _resource
+        import shutil
+        import socket as _socket
+        import subprocess as sp
+        import time as _time
+
+        from pytensor_federated_tpu.service import TcpArraysClient
+        from pytensor_federated_tpu.service.ring import (
+            RingArraysClient,
+            futex_available,
+            reset_syscall_counts,
+            syscall_counts,
+        )
+        from pytensor_federated_tpu.service.shm import ShmArraysClient
+
+        artifact_lines = []
+        artifact_path = "tools/suite_cpu_r18_ring.jsonl"
+
+        def flush_artifact():
+            tmp = artifact_path + ".tmp"
+            with open(tmp, "w") as f:
+                for line in artifact_lines:
+                    f.write(json.dumps(line) + "\n")
+            os.replace(tmp, artifact_path)
+
+        def free_port():
+            with _socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                return s.getsockname()[1]
+
+        rng = np.random.default_rng(22)
+        shapes = {"n64": 64, "n16k": 16384}
+        args_by_shape = {}
+        for name, n in shapes.items():
+            x = rng.normal(size=n)
+            y = 0.7 + 1.9 * x + rng.normal(size=n)
+            args_by_shape[name] = (
+                np.asarray(np.float64(0.7)),
+                np.asarray(np.float64(1.9)),
+                np.asarray(np.float64(0.5)),
+                x,
+                y,
+            )
+
+        def rate_lane(client, args, seconds=1.5, window=256, n_reqs=512):
+            reqs = [args] * n_reqs
+            client.evaluate_many(reqs, window=window, batch=True)  # warm
+            t0 = _time.perf_counter()
+            done = 0
+            while _time.perf_counter() - t0 < seconds:
+                client.evaluate_many(reqs, window=window, batch=True)
+                done += n_reqs
+            return done / (_time.perf_counter() - t0)
+
+        def p50_lockstep(client, args, n=300):
+            lat = []
+            client.evaluate(*args)  # warm
+            for _ in range(n):
+                t0 = _time.perf_counter()
+                client.evaluate(*args)
+                lat.append(_time.perf_counter() - t0)
+            return float(np.percentile(lat, 50))
+
+        def run_lane(transport, client_cls):
+            ctx = mp.get_context("spawn")
+            port = free_port()
+            proc = ctx.Process(
+                target=_bench_serve_shm_node,
+                args=(port, True, transport),
+                daemon=True,
+            )
+            proc.start()
+            out = {"lane": transport}
+            vals = {}
+            try:
+                client = client_cls(
+                    "127.0.0.1", port,
+                    connect_timeout_s=2.0, connect_retries=60,
+                    connect_backoff_s=0.25,
+                )
+                deadline = _time.time() + 60
+                while True:
+                    try:
+                        client.ping()
+                        break
+                    except (ConnectionError, OSError):
+                        if _time.time() > deadline or not proc.is_alive():
+                            raise
+                        _time.sleep(0.25)
+                if transport == "ring" and client._com_ring is None:
+                    raise RuntimeError(
+                        "ring lane fell back to the doorbell"
+                    )
+                for name, args in args_by_shape.items():
+                    vals[name] = [
+                        np.asarray(v) for v in client.evaluate(*args)
+                    ]
+                    reset_syscall_counts()
+                    ru0 = _resource.getrusage(
+                        _resource.RUSAGE_SELF
+                    ).ru_nvcsw
+                    t0 = _time.perf_counter()
+                    rate = rate_lane(client, args)
+                    n_evals = max(
+                        1, int(rate * (_time.perf_counter() - t0))
+                    )
+                    ru1 = _resource.getrusage(
+                        _resource.RUSAGE_SELF
+                    ).ru_nvcsw
+                    shim = dict(syscall_counts())
+                    out[f"{name}_rps"] = round(rate, 1)
+                    out[f"{name}_descriptor_sys_per_eval"] = round(
+                        (shim["futex_wait"] + shim["futex_wake"]
+                         + shim["fallback_poll"]) / n_evals,
+                        4,
+                    )
+                    out[f"{name}_nvcsw_per_eval"] = round(
+                        (ru1 - ru0) / n_evals, 4
+                    )
+                out["p50_lockstep_us"] = round(
+                    p50_lockstep(client, args_by_shape["n64"]) * 1e6, 1
+                )
+                client.close()
+            finally:
+                proc.terminate()
+                proc.join(timeout=10)
+            return out, vals
+
+        ring_out, ring_vals = run_lane("ring", RingArraysClient)
+        shm_out, shm_vals = run_lane("shm", ShmArraysClient)
+
+        # -- cpp-tcp-batched control (the byte-wire champion) ---------
+        # Same container, same workload: the honest "did a byte wire
+        # already beat both shared-memory lanes?" control the config-15
+        # precedent demands.  Its failure must not cost the ring/shm
+        # records (round-3 lesson), so the lane is best-effort.
+        cpp_out = None
+        cpp_vals = {}
+        native = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "native"
+        )
+        binary = os.path.join(native, "cpp_node")
+        try:
+            if shutil.which("make") and shutil.which("g++"):
+                sp.run(
+                    ["make", "-C", native],
+                    check=True, capture_output=True,
+                )
+        except Exception:
+            pass
+        if os.path.exists(binary):
+            cport = free_port()
+            cproc = sp.Popen(
+                [binary, str(cport)], stdout=sp.PIPE,
+                stderr=sp.STDOUT, text=True,
+            )
+            tclient = None
+            try:
+                line = cproc.stdout.readline()
+                if "listening" not in line:
+                    raise RuntimeError(f"cpp_node: {line!r}")
+                tclient = TcpArraysClient("127.0.0.1", cport)
+                out = {"lane": "cpp-tcp-batched"}
+                for name, args in args_by_shape.items():
+                    cpp_vals[name] = [
+                        np.asarray(v) for v in tclient.evaluate(*args)
+                    ]
+                    ru0 = _resource.getrusage(
+                        _resource.RUSAGE_SELF
+                    ).ru_nvcsw
+                    t0 = _time.perf_counter()
+                    rate = rate_lane(tclient, args)
+                    n_evals = max(
+                        1, int(rate * (_time.perf_counter() - t0))
+                    )
+                    ru1 = _resource.getrusage(
+                        _resource.RUSAGE_SELF
+                    ).ru_nvcsw
+                    out[f"{name}_rps"] = round(rate, 1)
+                    out[f"{name}_nvcsw_per_eval"] = round(
+                        (ru1 - ru0) / n_evals, 4
+                    )
+                out["p50_lockstep_us"] = round(
+                    p50_lockstep(tclient, args_by_shape["n64"]) * 1e6, 1
+                )
+                cpp_out = out
+            except Exception:
+                import traceback
+
+                traceback.print_exc(file=sys.stderr)
+                print(
+                    "# cpp-tcp-batched control failed; "
+                    "ring/shm lanes kept",
+                    file=sys.stderr,
+                )
+                cpp_out = None
+            finally:
+                if tclient is not None:
+                    tclient.close()
+                cproc.kill()
+                cproc.wait()
+
+        # Equality gate FIRST: every lane computed the same numbers.
+        for name in shapes:
+            for a, b in zip(ring_vals[name], shm_vals[name]):
+                np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=1e-6
+                )
+            for a, b in zip(ring_vals[name], cpp_vals.get(name, ())):
+                np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=1e-6
+                )
+
+        method = {
+            "lane": "method",
+            "cores": os.cpu_count(),
+            "futex_available": bool(futex_available()),
+            "note": (
+                "descriptor_sys_per_eval counts the DRIVER process's "
+                "ring shim calls (futex_wait + futex_wake + "
+                "fallback_poll) per completed eval — strace is absent "
+                "in this container, so kernel entries are counted at "
+                "the shim that makes them, corroborated by the "
+                "driver's ru_nvcsw (voluntary context switches) "
+                "delta; the shm doorbell's syscalls are socket "
+                "send/recv, visible only in nvcsw_per_eval; 1-core "
+                "container — the ring's spin-hit zero-syscall regime "
+                "requires a 2-core colocated pair, so the acceptance "
+                "is parity + amortized descriptor syscalls, not the "
+                "2-core latency target (docs/performance.md)"
+            ),
+        }
+        artifact_lines[:] = [method, ring_out, shm_out]
+        if cpp_out is not None:
+            artifact_lines.append(cpp_out)
+        flush_artifact()
+
+        for out in filter(None, (ring_out, shm_out, cpp_out)):
+            print(
+                f"# colocated lane {out['lane']}: "
+                f"n64 {out['n64_rps']:,.1f} rps, "
+                f"n16k {out['n16k_rps']:,.1f} rps, "
+                f"p50 lock-step {out['p50_lockstep_us']} us",
+                file=sys.stderr,
+            )
+
+        ratio = round(ring_out["n16k_rps"] / shm_out["n16k_rps"], 2)
+        record(
+            "zero-syscall ring vs shm-doorbell (colocated lane)",
+            ring_out["n16k_rps"],
+            unit="round-trips/s",
+            baseline_rate=shm_out["n16k_rps"],
+            baseline_desc=(
+                "shm doorbell lane re-run in this container on the "
+                "same workload — the round-9 zero-copy control (the "
+                "cpp-tcp-batched byte wire is re-run alongside as the "
+                "second honest control); acceptance: ring >= 0.7x "
+                "windowed (1-core parity) and windowed descriptor "
+                "syscalls/eval < 2"
+            ),
+            ring_rps=ring_out["n16k_rps"],
+            shm_rps=shm_out["n16k_rps"],
+            ring_vs_shm=ratio,
+            ring_small_rps=ring_out["n64_rps"],
+            shm_small_rps=shm_out["n64_rps"],
+            ring_p50_lockstep_us=ring_out["p50_lockstep_us"],
+            shm_p50_lockstep_us=shm_out["p50_lockstep_us"],
+            ring_descriptor_sys_per_eval=ring_out[
+                "n16k_descriptor_sys_per_eval"
+            ],
+            ring_nvcsw_per_eval=ring_out["n16k_nvcsw_per_eval"],
+            shm_nvcsw_per_eval=shm_out["n16k_nvcsw_per_eval"],
+            cpp_tcp_batched_rps=(
+                None if cpp_out is None else cpp_out["n16k_rps"]
+            ),
+            cpp_tcp_batched_small_rps=(
+                None if cpp_out is None else cpp_out["n64_rps"]
+            ),
+            cpp_tcp_batched_p50_lockstep_us=(
+                None if cpp_out is None
+                else cpp_out["p50_lockstep_us"]
+            ),
+            futex_available=bool(futex_available()),
+            note=(
+                "same linreg node contract both lanes, equal results "
+                "gated at rtol 1e-6; both lanes pin payloads (zero "
+                "payload bytes steady-state) — the delta under test "
+                "is descriptor transport: socket frames (doorbell) vs "
+                "seqlock records + amortized futex park/wake (ring); "
+                "1-core container, so lock-step p50 is context-switch "
+                "bound on both lanes and the ring's ~10-15 us "
+                "spin-hit regime is out of reach — parity acceptance, "
+                "config-15 honest-control precedent; the "
+                "cpp-tcp-batched byte wire (re-ships + re-decodes "
+                "every payload byte per call) is the second control; "
+                "artifact tools/suite_cpu_r18_ring.jsonl"
+            ),
+        )
+        assert ring_out["n16k_rps"] >= 0.7 * shm_out["n16k_rps"], (
+            f"ring windowed rate {ring_out['n16k_rps']} < 0.7x the "
+            f"doorbell control {shm_out['n16k_rps']}"
+        )
+        assert ring_out["n16k_descriptor_sys_per_eval"] < 2.0, (
+            "windowed descriptor path failed to amortize syscalls: "
+            f"{ring_out['n16k_descriptor_sys_per_eval']}/eval"
+        )
+
+    guard("zero-syscall ring vs shm-doorbell", _c22)
 
     if results:
         print(

@@ -52,6 +52,9 @@ or invariant that motivated it; the meta-test keeps the two in sync):
 - ``unbounded-wait`` — recv/readexactly/stream-read calls in
   service//routing/ arm a timeout or sit under an armed watchdog
   deadline on every path (:mod:`.rules_wait`)
+- ``unbounded-spin`` — while-loops around ``time.sleep`` in
+  service//routing//gateway/ carry a deadline marker, a TimeoutError
+  raise, or a deadline-checking callee (:mod:`.rules_spin`)
 """
 
 from .core import (
@@ -80,6 +83,7 @@ from . import rules_obs  # noqa: F401
 from . import rules_race  # noqa: F401
 from . import rules_resource  # noqa: F401
 from . import rules_shim  # noqa: F401
+from . import rules_spin  # noqa: F401
 from . import rules_wait  # noqa: F401
 from . import rules_wire  # noqa: F401
 

@@ -1,0 +1,227 @@
+"""unbounded-spin: every spin/poll loop in the I/O stack terminates.
+
+ISSUE 18's motivating hole: the ring lane replaced blocking socket
+reads with POLL LOOPS — a producer polling for ring space, a consumer
+spinning on a seqlock word before parking.  ``unbounded-wait`` cannot
+see these: there is no ``recv`` to flag, just a ``while`` that
+re-checks shared memory (or any other condition) around a
+``time.sleep``.  A peer that dies mid-update leaves the condition
+false FOREVER, so an unbounded poll loop is the same accept-then-
+silence hang the deadline work closed — it just spells itself
+differently.
+
+Semantics, over the shared graftflow call graph:
+
+- *spin sites*: ``while`` loops in ``service/`` / ``routing/`` /
+  ``gateway/`` whose body calls ``time.sleep(...)`` — the poll-loop
+  signature.  (``for`` loops are inherently iteration-bounded;
+  connect-retry loops bound themselves by attempt count and carry no
+  sleep-in-while shape... unless they do, in which case they must
+  bound themselves like everyone else.)
+- *locally bounded*: the loop's own subtree (test + body) references a
+  deadline-ish name (``deadline``/``budget``/``timeout``/``t_end``/
+  ``remaining``/``attempt``/``retries``/``backoff_budget``), raises
+  ``TimeoutError``/``DeadlineExceeded``, or iterates a bounded
+  counter — any marker showing the loop classifies its own expiry.
+- *covered by a checked call* (the interprocedural half, graftflow's
+  fixpoint shape): a loop whose body calls an in-package function that
+  is itself deadline-checking (its body carries a marker, or
+  transitively calls one that does) inherits the bound — e.g. a loop
+  around ``closing()`` + a helper that raises past its deadline.
+
+A deliberate exception needs an inline suppression with a reason —
+the shipped posture is that NO loop in scope needs one: the ring
+lane's loops all carry ``t_end`` bounds or per-slice liveness checks.
+Findings carry the caller chain from an entrypoint (the graftflow
+engine renders it), so a buried helper's unbounded loop names the
+concurrency context that reaches it.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, List, Set, Tuple
+
+from .core import Finding, RepoContext, rule
+from .graph import CallGraph, own_body
+
+_RULE = "unbounded-spin"
+
+_SCOPE_PREFIXES = (
+    "pytensor_federated_tpu/service/",
+    "pytensor_federated_tpu/routing/",
+    "pytensor_federated_tpu/gateway/",
+)
+
+#: Names whose presence in a loop's subtree marks it as owning its
+#: expiry: ambient-deadline derivations, explicit monotonic bounds,
+#: and attempt counters all match.
+_BOUND_NAME = re.compile(
+    r"deadline|budget|timeout|t_end|remaining|attempt|retries|expire",
+    re.IGNORECASE,
+)
+
+#: Raising one of these inside the loop IS the bound (the loop
+#: classifies its own timeout loudly).
+_TIMEOUT_RAISES = {"TimeoutError", "DeadlineExceeded"}
+
+
+def _unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse is total on exprs
+        return ""
+
+
+def _calls_sleep(loop: ast.While) -> bool:
+    for node in ast.walk(loop):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "sleep"
+        ):
+            return True
+    return False
+
+
+def _has_local_bound(loop: ast.While) -> bool:
+    """Does the loop's own subtree carry an expiry marker?"""
+    for node in ast.walk(loop):
+        if isinstance(node, ast.Name) and _BOUND_NAME.search(node.id):
+            return True
+        if isinstance(node, ast.Attribute) and _BOUND_NAME.search(node.attr):
+            return True
+        if isinstance(node, ast.arg) and _BOUND_NAME.search(node.arg):
+            return True
+        if isinstance(node, ast.Raise) and node.exc is not None:
+            exc = node.exc
+            name = ""
+            if isinstance(exc, ast.Call):
+                exc = exc.func
+            if isinstance(exc, ast.Name):
+                name = exc.id
+            elif isinstance(exc, ast.Attribute):
+                name = exc.attr
+            if name in _TIMEOUT_RAISES:
+                return True
+    return False
+
+
+def _loop_callees(loop: ast.While) -> Set[str]:
+    """Bare names and attribute tails called from inside the loop —
+    matched against the call graph's function names for the
+    interprocedural bound."""
+    out: Set[str] = set()
+    for node in ast.walk(loop):
+        if isinstance(node, ast.Call):
+            func = node.func
+            if isinstance(func, ast.Name):
+                out.add(func.id)
+            elif isinstance(func, ast.Attribute):
+                out.add(func.attr)
+    return out
+
+
+def _deadline_checking_functions(graph: CallGraph) -> Set[str]:
+    """Function NAMES whose body (directly or through in-package
+    callees, fixpoint) carries an expiry marker — calling one from a
+    poll loop bounds the loop."""
+    checking: Set[str] = set()
+    for qname, fn in graph.functions.items():
+        for node in own_body(fn.node):
+            if isinstance(node, ast.Name) and _BOUND_NAME.search(node.id):
+                checking.add(qname)
+                break
+            if isinstance(node, ast.Raise) and node.exc is not None:
+                exc = node.exc
+                if isinstance(exc, ast.Call):
+                    exc = exc.func
+                name = (
+                    exc.id
+                    if isinstance(exc, ast.Name)
+                    else getattr(exc, "attr", "")
+                )
+                if name in _TIMEOUT_RAISES:
+                    checking.add(qname)
+                    break
+    changed = True
+    while changed:
+        changed = False
+        for qname, fn in graph.functions.items():
+            if qname in checking:
+                continue
+            for edge in graph.callees_of(qname):
+                if edge.callee in checking:
+                    checking.add(qname)
+                    changed = True
+                    break
+    return {graph.functions[q].name for q in checking}
+
+
+def _witness_chain(
+    graph: CallGraph, qname: str, limit: int = 8
+) -> Tuple[str, ...]:
+    """One caller chain up from ``qname`` toward an entrypoint."""
+    hops: List[str] = []
+    seen = {qname}
+    cur = qname
+    for _ in range(limit):
+        callers = [e for e in graph.callers_of(cur) if e.caller not in seen]
+        if not callers:
+            break
+        edge = callers[0]
+        caller = graph.functions[edge.caller]
+        hops.append(
+            f"{caller.display} (calls {graph.functions[cur].name} at "
+            f"{caller.rel}:{edge.lineno})"
+        )
+        seen.add(edge.caller)
+        cur = edge.caller
+    hops.reverse()
+    return tuple(hops)
+
+
+@rule(
+    _RULE,
+    "while-loops around time.sleep in service/, routing/ and gateway/ "
+    "must bound themselves — a deadline/t_end/attempt marker in the "
+    "loop, a TimeoutError raise, or a call to a deadline-checking "
+    "helper — a peer that dies mid-update leaves a poll condition "
+    "false forever",
+    scope="repo",
+)
+def check_unbounded_spin(ctx: RepoContext) -> Iterator[Finding]:
+    graph = ctx.graph
+    checked_names = _deadline_checking_functions(graph)
+    for qname in sorted(graph.functions):
+        fn = graph.functions[qname]
+        if not fn.rel.startswith(_SCOPE_PREFIXES):
+            continue
+        for node in own_body(fn.node):
+            if not isinstance(node, ast.While):
+                continue
+            if not _calls_sleep(node):
+                continue
+            if _has_local_bound(node):
+                continue
+            if _loop_callees(node) & checked_names:
+                continue
+            chain = _witness_chain(graph, qname)
+            yield Finding(
+                rule=_RULE,
+                path=fn.rel,
+                line=node.lineno,
+                message=(
+                    f"unbounded spin/poll loop in {fn.name}: the loop "
+                    "sleeps and re-checks with no deadline marker, no "
+                    "TimeoutError raise, and no deadline-checking "
+                    "callee — a dead peer leaves the condition false "
+                    "forever; bound it with a monotonic t_end derived "
+                    "from the ambient deadline (service/deadline.py) "
+                    "or suppress with a reason if polling IS the idle "
+                    "state"
+                ),
+                chain=chain
+                + (f"unbounded spin at {fn.rel}:{node.lineno}",),
+            )

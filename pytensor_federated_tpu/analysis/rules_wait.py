@@ -72,7 +72,11 @@ _STREAMISH = re.compile(r"stream|_rfile|reader", re.IGNORECASE)
 #: budget before every chunk — the TCP socket lane and the shm
 #: doorbell both read through it, so the arming call the rule used to
 #: see inline now lives there.
-_ARMING_CALLS = {"settimeout", "wait_for", "bounded_reader"}
+#: ``recv_budget_s`` derives a concrete recv bound from the ambient
+#: deadline (service/deadline.py) — the ring lane passes it straight
+#: into ``Ring.recv(timeout_s=...)``, which re-checks liveness every
+#: park slice, so calling it is the same arming act as ``settimeout``.
+_ARMING_CALLS = {"settimeout", "wait_for", "bounded_reader", "recv_budget_s"}
 
 #: ``with …armed(…)`` — the watchdog deadline span.
 _ARMED_ATTR = "armed"

@@ -32,7 +32,7 @@ from __future__ import annotations
 
 import ast
 import re
-from typing import Dict, Iterator, List, Optional, Sequence
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 from ..service import wire_registry as REG
 from .core import Finding, SourceFile, rule
@@ -41,6 +41,7 @@ _NPWIRE = "pytensor_federated_tpu/service/npwire.py"
 _NPPROTO = "pytensor_federated_tpu/service/npproto_codec.py"
 _CPP = "native/cpp_node.cpp"
 _SHM = "pytensor_federated_tpu/service/shm.py"
+_RING = "pytensor_federated_tpu/service/ring.py"
 
 #: npwire decode entry points that must enforce the known-flags mask.
 #: Since ISSUE 13 the full decoders are the ``*_part`` variants (the
@@ -409,6 +410,81 @@ def _shm_findings(src: SourceFile) -> Iterator[Finding]:
         )
 
 
+def _ring_findings(src: SourceFile) -> Iterator[Finding]:
+    """The arena ring lane's declarations (ISSUE 18): the seqlock ring
+    header/record struct layouts and the in-mapping word offsets must
+    match service/wire_registry.py — both ends of a ring read the SAME
+    shared bytes, so silent drift here is cross-process corruption."""
+    assigns = _collect_assignments(src.tree)
+
+    def struct_literal(name: str) -> Tuple[Optional[str], int]:
+        value = assigns.get(name)
+        if value is None:
+            return None, 1
+        if (
+            isinstance(value, ast.Call)
+            and value.args
+            and isinstance(value.args[0], ast.Constant)
+            and isinstance(value.args[0].value, str)
+        ):
+            return value.args[0].value, value.lineno
+        return None, value.lineno
+
+    for name, declared, order in (
+        ("_RING_HEADER_STRUCT", REG.RING_HEADER_STRUCT,
+         REG.RING_HEADER_FIELD_ORDER),
+        ("_RING_DESC_STRUCT", REG.RING_DESC_STRUCT,
+         REG.RING_DESC_FIELD_ORDER),
+    ):
+        fmt, line = struct_literal(name)
+        if fmt is None:
+            yield src.finding(
+                "wire-registry",
+                line,
+                f"{src.rel} does not define {name} as a struct.Struct "
+                "with a literal format — the ring layout must be "
+                "pinned to service/wire_registry.py",
+            )
+        elif fmt != declared:
+            yield src.finding(
+                "wire-registry",
+                line,
+                f"ring struct {name} is {fmt!r} here but declared as "
+                f"{declared!r} in service/wire_registry.py "
+                f"(field order: {', '.join(order)})",
+            )
+    env: Dict[str, int] = {}
+    for name, value in assigns.items():
+        v = _eval_int(value, env)
+        if v is not None:
+            env[name] = v
+    for name, declared_off in (
+        ("_RING_HEADER_OFFSET", REG.RING_HEADER_OFFSET),
+        ("_RING_RECORDS_OFFSET", REG.RING_RECORDS_OFFSET),
+        ("_RING_FUTEX_WORD_OFFSET", REG.RING_FUTEX_WORD_OFFSET),
+        ("_RING_WAITING_WORD_OFFSET", REG.RING_WAITING_WORD_OFFSET),
+        ("_RING_EPOCH_WORD_OFFSET", REG.RING_EPOCH_WORD_OFFSET),
+    ):
+        value = assigns.get(name)
+        line = value.lineno if value is not None else 1
+        got = env.get(name)
+        if got is None:
+            yield src.finding(
+                "wire-registry",
+                line,
+                f"{src.rel} does not define {name} as a constant int — "
+                "ring word offsets must be pinned to "
+                "service/wire_registry.py",
+            )
+        elif got != declared_off:
+            yield src.finding(
+                "wire-registry",
+                line,
+                f"ring offset {name} is {got} here but declared as "
+                f"{declared_off} in service/wire_registry.py",
+            )
+
+
 def _npproto_message_of(func_name: str) -> str:
     """Which registry message a codec function's literals belong to —
     by the naming convention the codec module keeps."""
@@ -523,6 +599,9 @@ def check_wire_registry(sources: Sequence[SourceFile]) -> Iterator[Finding]:
     shm = by_rel.get(_SHM)
     if shm is not None:
         yield from _shm_findings(shm)
+    ring = by_rel.get(_RING)
+    if ring is not None:
+        yield from _ring_findings(ring)
 
 
 # ---------------------------------------------------------------------------

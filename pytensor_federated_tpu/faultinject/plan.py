@@ -76,6 +76,15 @@ dup_shard           replace one reduce-reply slice with a copy of a
 corrupt_shard       flip bytes inside a reduce-reply slice's partition
                     block (geometry lies: overlap / out-of-bounds /
                     count drift — every shape a loud WireError)
+torn_ring_word      leave a ring record's seqlock word mid-write (odd
+                    sequence, never committed) — the consumer's bounded
+                    wait must classify it as a loud transient timeout,
+                    never spin forever or read the torn payload
+                    (ring lane only)
+ring_stall          delay the producer's futex wake after publishing a
+                    ring record — NOT a loud fault: the parked waiter's
+                    re-check / bounded park must still consume the
+                    record (tests the lost-wake guard, ring lane only)
 ==================  =======================================================
 """
 
@@ -112,6 +121,8 @@ FAULT_KINDS = frozenset(
         "corrupt_shard",
         "stale_param_version",
         "drop_param_refresh",
+        "torn_ring_word",
+        "ring_stall",
     }
 )
 

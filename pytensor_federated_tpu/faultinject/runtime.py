@@ -67,6 +67,22 @@ point                     primitive / applicable kinds
                           slice list, before it is framed; the
                           driver's reassembler must refuse every
                           shape loudly)
+``ring.send``/``recv``    :func:`filter_bytes` — the ring record byte
+``ring.server.send``      lanes (delay, stall, truncate_frame,
+``ring.server.recv``      corrupt_bytes, drop, disconnect,
+                          kill_process), plus ``corrupt_descriptor``
+                          via :func:`corrupt_descriptor_bytes` at the
+                          same points (frame header bytes inside the
+                          record payload)
+``ring.record``           :func:`ring_record_fault` — torn_ring_word,
+                          stale_generation, delay, kill_process (the
+                          seqlock-word kinds; :mod:`..service.ring`
+                          applies the returned kind to the record it
+                          just committed)
+``ring.wake``             :func:`ring_wake_fault` — ring_stall, delay
+                          (delays the producer's futex wake; the
+                          parked consumer's lost-wake guard must
+                          still make progress)
 ========================  ==============================================
 """
 
@@ -104,6 +120,8 @@ __all__ = [
     "shard_filter",
     "version_filter",
     "refresh_filter",
+    "ring_record_fault",
+    "ring_wake_fault",
     "snapshot",
 ]
 
@@ -519,6 +537,52 @@ def corrupt_descriptor_bytes(
         i = desc_off + (rng.randrange(span) if rng is not None else 0)
         out[i] ^= 0xFF
     return bytes(out)
+
+
+def ring_record_fault(point: str, peer: Optional[str] = None) -> Optional[str]:
+    """Ring record-side shim (ISSUE 18): returns the fired seqlock-word
+    kind (``torn_ring_word`` / ``stale_generation``) for
+    :mod:`..service.ring` to apply to the record it just committed —
+    the fault needs ring-geometry knowledge (slot position, sequence
+    residue) the runtime does not have.  ``delay`` sleeps here (sync
+    lane); ``kill_process`` kills; ``None`` = no fault."""
+    rule = decide(point, peer)
+    if rule is None:
+        return None
+    kind = rule.kind
+    if kind in ("torn_ring_word", "stale_generation"):
+        return kind
+    if kind == "delay":
+        time.sleep(rule.delay_s)
+        return None
+    if kind in ("drop", "disconnect"):
+        raise ConnectionError(f"faultinject[{kind}] at {point}")
+    if kind == "kill_process":
+        _kill_now(point)
+    raise FaultPlanError(f"fault kind {kind!r} not applicable at {point}")
+
+
+def ring_wake_fault(point: str, peer: Optional[str] = None) -> None:
+    """``ring.wake`` shim (ISSUE 18): delays the producer's futex wake
+    AFTER the record is published (``ring_stall`` sleeps ``stall_s``,
+    ``delay`` sleeps ``delay_s``).  Deliberately NOT a loud fault: the
+    record is committed, so the consumer's bounded park / lost-wake
+    re-check must consume it regardless — chaos verifies liveness, not
+    an error classification.  ``kill_process`` kills (wake never
+    arrives: the peer-death path)."""
+    rule = decide(point, peer)
+    if rule is None:
+        return
+    kind = rule.kind
+    if kind == "ring_stall":
+        time.sleep(rule.stall_s)
+        return
+    if kind == "delay":
+        time.sleep(rule.delay_s)
+        return
+    if kind == "kill_process":
+        _kill_now(point)
+    raise FaultPlanError(f"fault kind {kind!r} not applicable at {point}")
 
 
 def shard_filter(

@@ -280,9 +280,9 @@ class NodePool:
         member_retries: int = 2,
         retry_budget: Optional[RetryBudget] = None,
     ) -> None:
-        if transport not in ("grpc", "tcp", "shm"):
+        if transport not in ("grpc", "tcp", "shm", "ring"):
             raise ValueError(
-                f"transport must be 'grpc', 'tcp' or 'shm', "
+                f"transport must be 'grpc', 'tcp', 'shm' or 'ring', "
                 f"got {transport!r}"
             )
         self.transport = transport
@@ -357,9 +357,11 @@ class NodePool:
         overrides the pool-level kwargs for this replica — a replica
         of a DIFFERENT transport never inherits the pool default's
         kwargs (they target another client class)."""
-        if transport is not None and transport not in ("grpc", "tcp", "shm"):
+        if transport is not None and transport not in (
+            "grpc", "tcp", "shm", "ring"
+        ):
             raise ValueError(
-                f"transport must be 'grpc', 'tcp' or 'shm', "
+                f"transport must be 'grpc', 'tcp', 'shm' or 'ring', "
                 f"got {transport!r}"
             )
         replica = self._make_replica(host, port, transport, client_kwargs)
@@ -452,6 +454,15 @@ class NodePool:
                 from ..service.shm import ShmArraysClient
 
                 replica.client = ShmArraysClient(
+                    replica.host,
+                    replica.port,
+                    retries=0,
+                    **kwargs,
+                )
+            elif replica.transport == "ring":
+                from ..service.ring import RingArraysClient
+
+                replica.client = RingArraysClient(
                     replica.host,
                     replica.port,
                     retries=0,

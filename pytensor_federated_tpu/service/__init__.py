@@ -20,6 +20,7 @@ from .npwire import (
     encode_arrays,
     encode_batch,
 )
+from .ring import RingArraysClient, serve_ring
 from .shm import ShmArraysClient, serve_shm
 from .tcp import RemoteComputeError, TcpArraysClient, serve_tcp_once
 from .server import (
@@ -44,6 +45,7 @@ __all__ = [
     "encode_arrays",
     "encode_batch",
     "RemoteComputeError",
+    "RingArraysClient",
     "ShmArraysClient",
     "TcpArraysClient",
     "get_load_async",
@@ -54,6 +56,7 @@ __all__ = [
     "get_node_traces_async",
     "run_node",
     "serve",
+    "serve_ring",
     "serve_shm",
     "serve_tcp_once",
     "thread_pid_id",

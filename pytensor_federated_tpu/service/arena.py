@@ -39,6 +39,14 @@ constants — "same-host replicas shouldn't move bytes at all").  The
 two watermarks colliding is an explicit :class:`~.npwire.WireError`,
 never an overwrite.
 
+Version-2 arenas (ISSUE 18) reserve a RING REGION between the file
+header and the slot space: a 64-byte ring header plus ``ring_slots``
+fixed-size seqlock'd records — the zero-syscall descriptor ring
+(:mod:`.ring`) that replaces the TCP doorbell round-trip for colocated
+pairs.  The arena knows only the geometry (it shifts the slot floor
+and validates bounds); :mod:`.ring` owns the record protocol.
+Version-1 files (``ring_slots == 0``) attach unchanged.
+
 The backing file lives in ``/dev/shm`` when available (tmpfs — the
 bytes never touch a disk) and the server unlinks it as soon as the
 peer has mapped it, so a SIGKILL'd process leaks nothing.
@@ -66,7 +74,12 @@ ARENA_MAGIC = b"PFA1"
 DEFAULT_ARENA_BYTES = 64 * 1024 * 1024
 
 _FILE_HEADER = struct.Struct("<4sBxxxQ")  # magic, version, capacity
+#: Version-2 header: v1 fields + the ring geometry (ISSUE 18).  The
+#: ring region layout itself (header words, record seqlocks) is
+#: declared in service/wire_registry.py and owned by service/ring.py.
+_FILE_HEADER_V2 = struct.Struct("<4sBxxxQII")  # + ring_slots, record_bytes
 _HEADER_SIZE = 64  # file header, padded to one alignment unit
+_RING_HEADER_BYTES = 64  # ring header, padded to one alignment unit
 _SLOT_HEAD = struct.Struct("<QQ")  # generation, payload_length
 _SLOT_TAIL = struct.Struct("<Q")  # generation (truncation/torn guard)
 _ALIGN = 64
@@ -102,20 +115,36 @@ class Arena:
     the owner allocates and writes."""
 
     def __init__(
-        self, path: str, mm: mmap.mmap, capacity: int, *, owner: bool
+        self,
+        path: str,
+        mm: mmap.mmap,
+        capacity: int,
+        *,
+        owner: bool,
+        ring_slots: int = 0,
+        ring_record_bytes: int = 0,
     ) -> None:
         self.path = path
         self.mm = mm
         self.capacity = capacity
         self.owner = owner
+        self.ring_slots = ring_slots
+        self.ring_record_bytes = ring_record_bytes
+        # Slot space starts past the file header AND the ring region
+        # (v1 arenas: ring_slots == 0, the floor is the header alone).
+        self.data_floor = _HEADER_SIZE + (
+            _RING_HEADER_BYTES + ring_slots * ring_record_bytes
+            if ring_slots
+            else 0
+        )
         # One long-lived view: read_view slices this instead of
         # re-exporting the mmap's buffer per call (hot-path cost).
         self._mv = memoryview(mm)
         self._lock = threading.Lock()
         self._next_gen = 1  # 0 is reserved: fresh pages read as gen 0
-        # Transient FIFO ring over [_HEADER_SIZE, _pin_floor).
-        self._head = _HEADER_SIZE
-        self._tail = _HEADER_SIZE
+        # Transient FIFO ring over [data_floor, _pin_floor).
+        self._head = self.data_floor
+        self._tail = self.data_floor
         self._live: Deque[Tuple[int, int]] = deque()  # (slot, total)
         self._pin_floor = capacity  # pinned region grows DOWN from here
 
@@ -128,12 +157,29 @@ class Arena:
         *,
         path: Optional[str] = None,
         writer: bool = True,
+        ring_slots: int = 0,
+        ring_record_bytes: int = 4096,
     ) -> "Arena":
         """Create and map a fresh arena file of ``capacity`` data
         bytes.  ``writer=False`` creates the file but leaves slot
         allocation to the peer (the server creates BOTH arenas of a
-        pair; the client allocates in the request one)."""
-        if capacity < _HEADER_SIZE + _ALIGN:
+        pair; the client allocates in the request one).
+        ``ring_slots > 0`` reserves the version-2 descriptor-ring
+        region (:mod:`.ring`); ``ring_record_bytes`` must be a
+        positive multiple of the 64-byte alignment unit so the slot
+        floor stays aligned."""
+        if ring_slots:
+            if ring_record_bytes <= 0 or ring_record_bytes % _ALIGN:
+                raise WireError(
+                    f"ring_record_bytes {ring_record_bytes} must be a "
+                    f"positive multiple of {_ALIGN}"
+                )
+            floor = _HEADER_SIZE + _RING_HEADER_BYTES + (
+                ring_slots * ring_record_bytes
+            )
+        else:
+            floor = _HEADER_SIZE
+        if capacity < floor + _ALIGN:
             raise WireError(f"arena capacity {capacity} is below one slot")
         if path is None:
             fd, path = tempfile.mkstemp(
@@ -146,8 +192,19 @@ class Arena:
             mm = mmap.mmap(fd, capacity)
         finally:
             os.close(fd)
-        mm[: _FILE_HEADER.size] = _FILE_HEADER.pack(ARENA_MAGIC, 1, capacity)
-        return cls(path, mm, capacity, owner=writer)
+        if ring_slots:
+            mm[: _FILE_HEADER_V2.size] = _FILE_HEADER_V2.pack(
+                ARENA_MAGIC, 2, capacity, ring_slots, ring_record_bytes
+            )
+        else:
+            mm[: _FILE_HEADER.size] = _FILE_HEADER.pack(
+                ARENA_MAGIC, 1, capacity
+            )
+        return cls(
+            path, mm, capacity, owner=writer,
+            ring_slots=ring_slots,
+            ring_record_bytes=ring_record_bytes if ring_slots else 0,
+        )
 
     @classmethod
     def attach(cls, path: str, *, writer: bool = False) -> "Arena":
@@ -164,10 +221,31 @@ class Arena:
         finally:
             os.close(fd)
         magic, version, capacity = _FILE_HEADER.unpack_from(mm, 0)
+        ring_slots = 0
+        ring_record_bytes = 0
         if magic != ARENA_MAGIC:
             mm.close()
             raise WireError(f"bad arena magic {magic!r} in {path!r}")
-        if version != 1:
+        if version == 2:
+            (
+                _magic, _ver, capacity, ring_slots, ring_record_bytes,
+            ) = _FILE_HEADER_V2.unpack_from(mm, 0)
+            floor = _HEADER_SIZE + _RING_HEADER_BYTES + (
+                ring_slots * ring_record_bytes
+            )
+            if (
+                ring_slots <= 0
+                or ring_record_bytes <= 0
+                or ring_record_bytes % _ALIGN
+                or floor + _ALIGN > size
+            ):
+                mm.close()
+                raise WireError(
+                    f"corrupt arena ring geometry in {path!r}: "
+                    f"{ring_slots} x {ring_record_bytes}-byte records "
+                    f"do not fit {size} bytes"
+                )
+        elif version != 1:
             mm.close()
             raise WireError(f"unsupported arena version {version}")
         if capacity != size:
@@ -176,7 +254,10 @@ class Arena:
                 f"arena header declares {capacity} bytes but the file "
                 f"holds {size}"
             )
-        return cls(path, mm, capacity, owner=writer)
+        return cls(
+            path, mm, capacity, owner=writer,
+            ring_slots=ring_slots, ring_record_bytes=ring_record_bytes,
+        )
 
     def close(self, *, unlink: bool = False) -> None:
         """Drop the mapping (and optionally the file).  If zero-copy
@@ -216,7 +297,7 @@ class Arena:
             for s, t in self._live:
                 if s + t > limit:
                     limit = s + t
-            if floor < limit or floor < _HEADER_SIZE:
+            if floor < limit or floor < self.data_floor:
                 raise WireError(
                     f"arena exhausted: pinned region cannot grow by "
                     f"{total} bytes (capacity {self.capacity})"
@@ -225,7 +306,7 @@ class Arena:
             return floor
         total = _align(total)
         if not self._live:
-            self._head = self._tail = _HEADER_SIZE
+            self._head = self._tail = self.data_floor
         elif self._head == self._tail:
             # head == tail is ambiguous: empty OR exactly full.  Live
             # slots resolve it — the ring is FULL (an exact-fit
@@ -241,9 +322,9 @@ class Arena:
             if self._head + total <= self._pin_floor:
                 slot = self._head
                 self._head += total
-            elif self._live and _HEADER_SIZE + total <= self._tail:
-                slot = _HEADER_SIZE  # wrap
-                self._head = _HEADER_SIZE + total
+            elif self._live and self.data_floor + total <= self._tail:
+                slot = self.data_floor  # wrap
+                self._head = self.data_floor + total
             else:
                 raise WireError(
                     f"arena exhausted: {total} bytes do not fit "
@@ -327,7 +408,9 @@ class Arena:
         the client's in-flight byte-cap input."""
         with self._lock:
             if not self._live:
-                return max(0, self._pin_floor - _HEADER_SIZE - 2 * _ALIGN)
+                return max(
+                    0, self._pin_floor - self.data_floor - 2 * _ALIGN
+                )
             if self._head == self._tail:
                 return 0  # exactly full (live slots resolve the tie)
             if self._tail < self._head:
@@ -335,7 +418,7 @@ class Arena:
                     0,
                     max(
                         self._pin_floor - self._head,
-                        self._tail - _HEADER_SIZE,
+                        self._tail - self.data_floor,
                     ) - 2 * _ALIGN,
                 )
             return max(0, self._tail - self._head - 2 * _ALIGN)
@@ -344,8 +427,11 @@ class Arena:
 
     def _validate(self, slot: int, delta: int, length: int, gen: int) -> int:
         """Bounds + generation checks; returns the payload base offset."""
-        if slot < _HEADER_SIZE or slot + _SLOT_HEAD.size > self.capacity:
-            raise WireError(f"descriptor slot {slot} out of arena bounds")
+        if slot < self.data_floor or slot + _SLOT_HEAD.size > self.capacity:
+            raise WireError(
+                f"descriptor slot {slot} out of arena bounds "
+                f"(slot space starts at {self.data_floor})"
+            )
         if slot % 8 or delta % 8:
             raise WireError(
                 f"descriptor misaligned (slot {slot}, delta {delta})"

@@ -160,6 +160,9 @@ _BATCH_CHUNK = 32  # requests per EVAL_BATCH frame (tcp.py parity)
 _U32 = struct.Struct("<I")
 _U64 = struct.Struct("<Q")
 _QI = struct.Struct("<QI")
+_U16 = struct.Struct("<H")
+_U8 = struct.Struct("<B")
+_F64 = struct.Struct("<d")
 #: The empty descriptor block (n=0) — a constant on the reply paths.
 _EMPTY_DESCS = _U32.pack(0)
 
@@ -245,12 +248,12 @@ def encode_frame(
     parts.append(_HEADER.pack(MAGIC, 1, kind, flags, 0, uuid))
     if error is not None:
         err = error.encode("utf-8")
-        parts.append(struct.pack("<I", len(err)))
+        parts.append(_U32.pack(len(err)))
         parts.append(err)
     if trace_id is not None:
         parts.append(trace_id)
     if deadline_s is not None:
-        parts.append(struct.pack("<d", float(deadline_s)))
+        parts.append(_F64.pack(float(deadline_s)))
     if tenant_block is not None:
         parts.append(tenant_block)
     if partition_block is not None:
@@ -342,7 +345,7 @@ def decode_frame(
     error = None
     if flags & _FLAG_ERROR:
         try:
-            (elen,) = struct.unpack_from("<I", buf, off)
+            (elen,) = _U32.unpack_from(buf, off)
             off += 4
             if off + elen > len(buf):
                 raise WireError("truncated shm error block")
@@ -359,14 +362,14 @@ def decode_frame(
     deadline_s = None
     if flags & _FLAG_DEADLINE:
         try:
-            (deadline_s,) = struct.unpack_from("<d", buf, off)
+            (deadline_s,) = _F64.unpack_from(buf, off)
         except struct.error as e:
             raise WireError(f"truncated shm deadline block: {e}") from None
         off += 8
     if flags & _FLAG_TENANT:
         # Consumed and dropped — :func:`frame_tenant` is the reader.
         try:
-            (tlen,) = struct.unpack_from("<H", buf, off)
+            (tlen,) = _U16.unpack_from(buf, off)
         except struct.error as e:
             raise WireError(f"truncated shm tenant block: {e}") from None
         off += 2
@@ -416,7 +419,7 @@ def frame_tenant(buf: bytes) -> Optional[str]:
     off = _HEADER.size
     if flags & _FLAG_ERROR:
         try:
-            (elen,) = struct.unpack_from("<I", buf, off)
+            (elen,) = _U32.unpack_from(buf, off)
         except struct.error as e:
             raise WireError(f"truncated shm error block: {e}") from None
         off += 4 + elen
@@ -425,7 +428,7 @@ def frame_tenant(buf: bytes) -> Optional[str]:
     if flags & _FLAG_DEADLINE:
         off += 8
     try:
-        (tlen,) = struct.unpack_from("<H", buf, off)
+        (tlen,) = _U16.unpack_from(buf, off)
         off += 2
         if off + tlen > len(buf):
             raise WireError("truncated shm tenant block")
@@ -446,9 +449,9 @@ def encode_descs(descs: Sequence[Desc]) -> bytes:
     for slot, delta, length, gen, dtype, shape in descs:
         parts.append(_DESC_STRUCT.pack(slot, delta, length, gen))
         dt = _encode_dtype(dtype)
-        parts.append(struct.pack("<H", len(dt)))
+        parts.append(_U16.pack(len(dt)))
         parts.append(dt)
-        parts.append(struct.pack("<B", len(shape)))
+        parts.append(_U8.pack(len(shape)))
         parts.append(struct.pack(f"<{len(shape)}Q", *shape))
     return b"".join(parts)
 
@@ -463,11 +466,11 @@ def decode_descs(buf: bytes, off: int) -> Tuple[List[Desc], int]:
         for _ in range(n):
             slot, delta, length, gen = _DESC_STRUCT.unpack_from(buf, off)
             off += _DESC_STRUCT.size
-            (dtlen,) = struct.unpack_from("<H", buf, off)
+            (dtlen,) = _U16.unpack_from(buf, off)
             off += 2
             dtype = _parse_dtype(buf[off : off + dtlen])
             off += dtlen
-            (ndim,) = struct.unpack_from("<B", buf, off)
+            (ndim,) = _U8.unpack_from(buf, off)
             off += 1
             shape = struct.unpack_from(f"<{ndim}Q", buf, off)
             off += 8 * ndim
@@ -679,7 +682,7 @@ class ShmArraysClient:
         if kind != _KIND_ATTACH_OK or ruid != uid:
             raise WireError("shm attach: unexpected reply")
         try:
-            (jlen,) = struct.unpack_from("<I", frame, off)
+            (jlen,) = _U32.unpack_from(frame, off)
             spec = json.loads(
                 frame[off + 4 : off + 4 + jlen].decode("utf-8")
             )
@@ -1362,7 +1365,7 @@ class ShmArraysClient:
                 f"match the request ({slices}, {total})"
             )
         try:
-            (k,) = struct.unpack_from("<I", reply, off)
+            (k,) = _U32.unpack_from(reply, off)
             off += 4
         except struct.error as e:
             raise WireError(
@@ -1381,7 +1384,7 @@ class ShmArraysClient:
                 raise WireError("truncated shm batch item")
             off += 16
             try:
-                (elen,) = struct.unpack_from("<I", reply, off)
+                (elen,) = _U32.unpack_from(reply, off)
             except struct.error as e:
                 raise WireError(
                     f"truncated shm batch item: {e}"
@@ -1684,7 +1687,7 @@ class ShmArraysClient:
                         "batch reply does not correlate with its frame"
                     )
                 if first_error is None:
-                    (k,) = struct.unpack_from("<I", reply, off)
+                    (k,) = _U32.unpack_from(reply, off)
                     off += 4
                     if k != len(item_uids):
                         raise RuntimeError(
@@ -1697,9 +1700,7 @@ class ShmArraysClient:
                             raise WireError("truncated shm batch item")
                         off += 16
                         try:
-                            (elen,) = struct.unpack_from(
-                                "<I", reply, off
-                            )
+                            (elen,) = _U32.unpack_from(reply, off)
                         except struct.error as e:
                             raise WireError(
                                 f"truncated shm batch item: {e}"
@@ -1774,7 +1775,7 @@ class ShmArraysClient:
             kind, ruid, error, _tid, _dl, _part, _ver, off, reply = decode_frame(reply)
             if kind != _KIND_LOAD or ruid != uid or error is not None:
                 return None
-            (jlen,) = struct.unpack_from("<I", reply, off)
+            (jlen,) = _U32.unpack_from(reply, off)
             load = json.loads(
                 reply[off + 4 : off + 4 + jlen].decode("utf-8")
             )
@@ -1823,10 +1824,10 @@ class ShmArraysClient:
 # ---------------------------------------------------------------------------
 
 
-def _load_dict(n_connections: int) -> dict:
+def _load_dict(n_connections: int, transport: str = "shm") -> dict:
     return {
         "n_clients": n_connections,
-        "transport": "shm",
+        "transport": transport,
         "batch": {"max_batch": _BATCH_CHUNK, "queue_depth": 0},
     }
 
@@ -1834,6 +1835,9 @@ def _load_dict(n_connections: int) -> dict:
 class _ShmConnection:
     """Server half of one doorbell connection: the arena pair, the
     reply-slot reclamation watermark, and the frame dispatch loop."""
+
+    #: What GetLoad reports; the ring lane overrides to "ring".
+    _transport = "shm"
 
     def __init__(
         self,
@@ -1866,7 +1870,7 @@ class _ShmConnection:
             }
         ).encode("utf-8")
         return encode_frame(
-            _KIND_ATTACH_OK, uid, struct.pack("<I", len(spec)) + spec
+            _KIND_ATTACH_OK, uid, _U32.pack(len(spec)) + spec
         )
 
     def _unlink_arenas(self) -> None:
@@ -2006,7 +2010,7 @@ class _ShmConnection:
                     )
                 return encode_frame(
                     _KIND_REPLY_BATCH, uid,
-                    struct.pack("<I", 0), error=err,
+                    _U32.pack(0), error=err,
                 )
             _node_metrics.INFLIGHT.inc()
             try:
@@ -2030,7 +2034,7 @@ class _ShmConnection:
                 _node_metrics.INFLIGHT.dec()
         if kind == _KIND_ACK:
             try:
-                (ack,) = struct.unpack_from("<Q", payload, off)
+                (ack,) = _U64.unpack_from(payload, off)
             except struct.error as e:
                 raise WireError(f"truncated shm ack: {e}") from None
             self._reclaim(ack)
@@ -2041,13 +2045,13 @@ class _ShmConnection:
                 if garbage is not None:
                     return encode_frame(
                         _KIND_LOAD, uid,
-                        struct.pack("<I", len(garbage)) + garbage,
+                        _U32.pack(len(garbage)) + garbage,
                     )
             spec = json.dumps(
-                _load_dict(self.n_connections())
+                _load_dict(self.n_connections(), self._transport)
             ).encode("utf-8")
             return encode_frame(
-                _KIND_LOAD, uid, struct.pack("<I", len(spec)) + spec
+                _KIND_LOAD, uid, _U32.pack(len(spec)) + spec
             )
         if kind == _KIND_PING:
             try:
@@ -2075,7 +2079,7 @@ class _ShmConnection:
         _node_metrics.REQUESTS.labels(method="evaluate").inc()
         t_arrive = time.perf_counter()
         try:
-            (ack,) = struct.unpack_from("<Q", payload, off)
+            (ack,) = _U64.unpack_from(payload, off)
             self._reclaim(ack)
             descs, _off = decode_descs(payload, off + 8)
             arrays = self._request_arrays(descs)
@@ -2168,7 +2172,7 @@ class _ShmConnection:
         _node_metrics.REQUESTS.labels(method="evaluate_batch").inc()
         t_arrive = time.perf_counter()
         try:
-            ack, k = struct.unpack_from("<QI", payload, off)
+            ack, k = _QI.unpack_from(payload, off)
             self._reclaim(ack)
             off += 12
             items: List[Tuple[bytes, Optional[List[Desc]], Optional[str]]] = []
@@ -2188,7 +2192,7 @@ class _ShmConnection:
             _node_metrics.ERRORS.labels(kind="decode").inc()
             return encode_frame(
                 _KIND_REPLY_BATCH, b"\0" * 16,
-                struct.pack("<I", 0),
+                _U32.pack(0),
                 error=f"decode error: {e}",
             )
         t_decoded = time.perf_counter()
@@ -2267,15 +2271,15 @@ class _ShmConnection:
                 if err is not None:
                     eb = err.encode("utf-8")
                     item_replies.append(
-                        iuid + struct.pack("<I", len(eb)) + eb
+                        iuid + _U32.pack(len(eb)) + eb
                     )
                 else:
                     item_replies.append(
                         iuid
-                        + struct.pack("<I", 0)
+                        + _U32.pack(0)
                         + encode_descs(descs_by_item.get(i, []))
                     )
-        body = struct.pack("<I", k) + b"".join(item_replies)
+        body = _U32.pack(k) + b"".join(item_replies)
         _node_metrics.ENCODE_S.observe(time.perf_counter() - t_e0)
         return encode_frame(_KIND_REPLY_BATCH, uid, body)
 
@@ -2301,12 +2305,12 @@ class _ShmConnection:
 
         def outer_error(err: str) -> bytes:
             return encode_frame(
-                _KIND_REPLY_BATCH, uid, struct.pack("<I", 0), error=err
+                _KIND_REPLY_BATCH, uid, _U32.pack(0), error=err
             )
 
         try:
             req_part = _partition.GradPartition(*partition).validate()
-            ack, k = struct.unpack_from("<QI", payload, off)
+            ack, k = _QI.unpack_from(payload, off)
             self._reclaim(ack)
             off += 12
             windows: List[List[np.ndarray]] = []
@@ -2388,7 +2392,7 @@ class _ShmConnection:
                     item_replies.append(
                         uid[:12]
                         + _U32.pack(p.index)
-                        + struct.pack("<I", 0)
+                        + _U32.pack(0)
                         + encode_descs(descs)
                     )
                     _partition.PARTITION_SHARDS.labels(
@@ -2398,7 +2402,7 @@ class _ShmConnection:
                     item_replies = _fi.shard_filter(
                         "partition.reply", item_replies, block_off=20
                     )
-                body = struct.pack("<I", len(item_replies)) + b"".join(
+                body = _U32.pack(len(item_replies)) + b"".join(
                     item_replies
                 )
                 _node_metrics.ENCODE_S.observe(
@@ -2436,6 +2440,7 @@ def serve_shm(
     max_connections: Optional[int] = None,
     arena_bytes: int = DEFAULT_ARENA_BYTES,
     concurrent: bool = True,
+    _connection_cls: Optional[Callable[..., "_ShmConnection"]] = None,
 ) -> None:
     """Blocking shm-lane node: doorbell accept loop + one arena pair
     per connection.  Mirrors :func:`~.tcp.serve_tcp_once`'s surface
@@ -2449,9 +2454,14 @@ def serve_shm(
     request arrays (they ARE the shared pages — that is the lane); a
     compute that mutates its inputs in place must copy first (or serve
     over :func:`~.tcp.serve_tcp_once`, whose default decodes owned
-    copies)."""
+    copies).
+
+    ``_connection_cls`` is a private seam for the ring lane
+    (:func:`~.ring.serve_ring`): a factory with ``_ShmConnection``'s
+    constructor signature that supplies the per-connection handler."""
     active = [0]
     lock = threading.Lock()
+    conn_cls = _ShmConnection if _connection_cls is None else _connection_cls
 
     def n_connections() -> int:
         with lock:
@@ -2461,7 +2471,7 @@ def serve_shm(
         with lock:
             active[0] += 1
         try:
-            _ShmConnection(
+            conn_cls(
                 conn, compute_fn, arena_bytes, n_connections
             ).serve()
         finally:

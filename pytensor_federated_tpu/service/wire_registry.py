@@ -50,6 +50,15 @@ __all__ = [
     "SHMWIRE_KNOWN_FLAGS",
     "SHM_DESC_STRUCT",
     "SHM_DESC_FIELD_ORDER",
+    "RING_HEADER_STRUCT",
+    "RING_HEADER_FIELD_ORDER",
+    "RING_DESC_STRUCT",
+    "RING_DESC_FIELD_ORDER",
+    "RING_HEADER_OFFSET",
+    "RING_RECORDS_OFFSET",
+    "RING_FUTEX_WORD_OFFSET",
+    "RING_WAITING_WORD_OFFSET",
+    "RING_EPOCH_WORD_OFFSET",
     "GETLOAD_PAYLOADS",
 ]
 
@@ -210,6 +219,61 @@ NPPROTO_PARTITION_FIELDS = {
     "length": 4,
     "total": 5,
 }
+
+#: The in-arena descriptor ring (ISSUE 18): the zero-syscall colocated
+#: lane embeds one SPSC ring per arena — submissions in the request
+#: arena (client produces, node consumes), completions in the reply
+#: arena (node produces, client consumes).  Records carry complete shm
+#: doorbell frames (the SHMWIRE kinds/flags/blocks above, verbatim —
+#: the ring is a CHANNEL, not a new frame format), so the preserialized
+#: deadline/partition/version templates ride unchanged.  The layouts
+#: below are declared here first; ``service/ring.py`` mirrors them and
+#: the graftlint wire-registry rule pins the implementation literals.
+#:
+#: Ring header (one per arena, 64 bytes at arena offset
+#: :data:`RING_HEADER_OFFSET`)::
+#:
+#:     produced(u64)  consumed(u64)  futex(u32)  waiting(u32)
+#:     epoch(u32)     capacity(u32)  record_bytes(u32)
+#:
+#: ``produced``/``consumed`` are the two SPSC positions (each written
+#: by exactly one side); ``futex`` is the consumer's park word (the
+#: producer bumps it per commit and FUTEX_WAKEs only when ``waiting``
+#: is set — the zero-syscall steady state); ``epoch`` is the liveness
+#: word (nonzero while the ring is attached, zeroed on clean close so
+#: a parked peer wakes to a classified ``ConnectionError``, never a
+#: hang); ``capacity``/``record_bytes`` cross-check the arena file
+#: header's ring geometry.
+RING_HEADER_STRUCT = "<QQIIIII"
+RING_HEADER_FIELD_ORDER = (
+    "produced", "consumed", "futex", "waiting",
+    "epoch", "capacity", "record_bytes",
+)
+
+#: One ring record header (records start at arena offset
+#: :data:`RING_RECORDS_OFFSET`, each ``record_bytes`` wide; the frame
+#: payload follows the 16-byte header inside the record).  ``seq`` is
+#: the seqlock word: position ``p`` commits as ``2*p + 2``, is mid-
+#: write as ``2*p + 1`` — like the arena slot generations, a torn,
+#: stale, recycled, or scribbled record is a LOUD ``WireError`` (or a
+#: bounded wait that times out as a classified transient), never a
+#: wrong-answer descriptor.  ``length`` is the TOTAL frame length on a
+#: frame's first record and the chunk length on continuation records
+#: (frames larger than one record span consecutive records).
+RING_DESC_STRUCT = "<QII"
+RING_DESC_FIELD_ORDER = ("seq", "length", "reserved")
+
+#: Arena byte offset of the ring header (immediately after the 64-byte
+#: arena file header) and of record 0 (one alignment unit later).
+RING_HEADER_OFFSET = 64
+RING_RECORDS_OFFSET = 128
+
+#: Byte offsets of the futex / waiting / epoch words INSIDE the ring
+#: header — the futex shim addresses these words directly, so the
+#: offsets are wire constants like any struct layout.
+RING_FUTEX_WORD_OFFSET = 16
+RING_WAITING_WORD_OFFSET = 20
+RING_EPOCH_WORD_OFFSET = 24
 
 #: GetLoad request payloads.  Both wire schemas define an EMPTY
 #: GetLoad request, so every non-empty payload is an in-repo extension

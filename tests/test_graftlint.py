@@ -801,6 +801,122 @@ class TestUnboundedWait:
         assert findings == []
 
 
+class TestUnboundedSpin:
+    REL = "pytensor_federated_tpu/service/fixture_mod.py"
+
+    def test_bare_poll_loop_flagged_with_chain(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {
+                self.REL: """
+                import time
+
+                def wait_for_slot(ring):
+                    while not ring.has_space():
+                        time.sleep(0.001)
+
+                def produce(ring, frame):
+                    wait_for_slot(ring)
+                    ring.put(frame)
+                """
+            },
+            ["unbounded-spin"],
+        )
+        assert rules_of(findings) == {"unbounded-spin"}
+        assert len(findings) == 1
+        assert "wait_for_slot" in findings[0].message
+        # The graftflow chain names the caller that reaches the loop.
+        assert any("produce" in hop for hop in findings[0].chain)
+
+    def test_t_end_marker_and_timeout_raise_clean(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {
+                self.REL: """
+                import time
+
+                def wait_marker(ring, t_end):
+                    while not ring.has_space():
+                        if time.monotonic() >= t_end:
+                            break
+                        time.sleep(0.001)
+
+                def wait_raise(ring, limit):
+                    while not ring.has_space():
+                        if time.monotonic() >= limit:
+                            raise TimeoutError("ring full")
+                        time.sleep(0.001)
+                """
+            },
+            ["unbounded-spin"],
+        )
+        assert findings == []
+
+    def test_deadline_checking_callee_bounds_loop(self, tmp_path):
+        """The interprocedural half: a poll loop with no marker of its
+        own is bounded by calling a helper that raises past ITS
+        deadline (transitively, fixpoint over the callee relation)."""
+        findings = run_on(
+            tmp_path,
+            {
+                self.REL: """
+                import time
+
+                def check_expiry(t_end):
+                    if time.monotonic() >= t_end:
+                        raise TimeoutError("expired")
+
+                def outer_check(bound):
+                    check_expiry(bound)
+
+                def wait_for_slot(ring, bound):
+                    while not ring.has_space():
+                        outer_check(bound)
+                        time.sleep(0.001)
+                """
+            },
+            ["unbounded-spin"],
+        )
+        assert findings == []
+
+    def test_sleepless_while_and_for_loops_out_of_scope(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {
+                self.REL: """
+                import time
+
+                def drain(ring):
+                    while ring.pop() is not None:
+                        pass
+
+                def retry(ring):
+                    for _ in range(3):
+                        time.sleep(0.001)
+                """
+            },
+            ["unbounded-spin"],
+        )
+        assert findings == []
+
+    def test_suppression_honored(self, tmp_path):
+        findings = run_on(
+            tmp_path,
+            {
+                self.REL: """
+                import time
+
+                def idle(server):
+                    # graftlint: disable=unbounded-spin -- fixture: foreground idle state
+                    while True:
+                        time.sleep(3600.0)
+                """
+            },
+            ["unbounded-spin"],
+        )
+        assert findings == []
+
+
 class TestSuppressions:
     def test_line_above_and_all_keyword(self, tmp_path):
         findings = run_on(
@@ -884,6 +1000,7 @@ class TestDriver:
             "fed-placement",
             "observability-drift",
             "unbounded-wait",
+            "unbounded-spin",
         }
         for r in analysis.RULES.values():
             assert r.scope in ("file", "repo")

@@ -1,0 +1,127 @@
+"""Property-based seqlock-ring tests (hypothesis) — ISSUE 18 satellite.
+
+The ring's loud-failure surface, explored exhaustively: random frame
+sizes (single-record, spanning, wraparound laps) must round-trip
+byte-exact through produce/recv, and a single torn seqlock WORD — any
+scribble that changes a committed record's sequence stamp — must
+surface as :class:`WireError`, never a hang (the producer's published
+counter makes a not-ready stamp definitively torn) and never silently
+wrong bytes.  The integrity the arena slots get from generations, the
+descriptor rings get from the seqlock stamps; these tests are its pin.
+"""
+
+import struct
+
+import pytest
+
+from pytensor_federated_tpu.service.arena import Arena
+from pytensor_federated_tpu.service.npwire import WireError
+from pytensor_federated_tpu.service.ring import (
+    Ring,
+    _RING_RECORDS_OFFSET,
+    _U64,
+    init_ring_header,
+)
+
+# Hypothesis-optional (the round-16 posture): the fuzz lanes below are
+# importorskip-gated; their deterministic seed twins — single torn
+# word, roundtrip across laps, future-lap/wrong-slot/zeroed stamps —
+# always run in tests/test_ring_transport.py::TestRingProtocol.
+hypothesis = pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+COMMON = settings(max_examples=50, deadline=None)
+
+_SLOTS = 8
+_RECORD_BYTES = 128
+_PAYLOAD_CAP = _RECORD_BYTES - 16
+
+
+def _fresh_rings(tmp_path, name):
+    arena = Arena.create(
+        1 << 20,
+        path=str(tmp_path / name),
+        ring_slots=_SLOTS,
+        ring_record_bytes=_RECORD_BYTES,
+    )
+    init_ring_header(arena)
+    return (
+        arena,
+        Ring(arena, role="producer"),
+        Ring(arena, role="consumer"),
+    )
+
+
+@COMMON
+@given(
+    sizes=st.lists(
+        st.integers(1, _PAYLOAD_CAP * _SLOTS), min_size=1, max_size=12
+    ),
+    seed=st.integers(0, 2**32 - 1),
+)
+def test_frames_roundtrip_any_size(tmp_path_factory, sizes, seed):
+    """Every admissible frame size — sub-record, exact-cap, spanning,
+    whole-ring — round-trips byte-exact, in order, across laps."""
+    tmp = tmp_path_factory.mktemp("ringprop")
+    arena, prod, cons = _fresh_rings(tmp, "rt.shm")
+    try:
+        for i, n in enumerate(sizes):
+            frame = bytes((seed + i * 131 + j * 7) % 256 for j in range(n))
+            assert prod.try_produce(frame)
+            assert cons.recv(timeout_s=5.0) == frame
+    finally:
+        arena.close(unlink=True)
+
+
+@COMMON
+@given(
+    size=st.integers(1, _PAYLOAD_CAP * 3),
+    record_idx=st.integers(0, 2),
+    word=st.integers(0, 2**64 - 1),
+)
+def test_single_torn_seq_word_is_loud(
+    tmp_path_factory, size, record_idx, word
+):
+    """Scribbling ONE committed record's seqlock word with any value
+    that changes it yields WireError — never a silently wrong frame,
+    never an unbounded wait (the published produced counter converts
+    'mid-write' observations into torn-write classifications)."""
+    tmp = tmp_path_factory.mktemp("ringprop")
+    arena, prod, cons = _fresh_rings(tmp, "torn.shm")
+    try:
+        frame = bytes(j % 256 for j in range(size))
+        nrec = -(-size // _PAYLOAD_CAP)
+        idx = min(record_idx, nrec - 1)
+        assert prod.try_produce(frame)
+        rec = _RING_RECORDS_OFFSET + idx * _RECORD_BYTES
+        committed = _U64.unpack_from(arena.mm, rec)[0]
+        if word == committed:
+            word ^= 1  # ensure the scribble actually changes the stamp
+        _U64.pack_into(arena.mm, rec, word)
+        with pytest.raises(WireError):
+            cons.recv(timeout_s=10.0)
+    finally:
+        arena.close(unlink=True)
+
+
+@COMMON
+@given(total=st.integers(0, 2**32 - 1))
+def test_corrupt_length_word_never_overreads(tmp_path_factory, total):
+    """A scribbled record-0 LENGTH word either reproduces a legal
+    shorter read, raises WireError (out of ring bounds), or times out
+    bounded on never-committed continuations — it can never read
+    beyond the ring or hang."""
+    tmp = tmp_path_factory.mktemp("ringprop")
+    arena, prod, cons = _fresh_rings(tmp, "len.shm")
+    try:
+        assert prod.try_produce(b"x" * 40)
+        struct.pack_into(
+            "<I", arena.mm, _RING_RECORDS_OFFSET + 8, total
+        )
+        try:
+            out = cons.recv(timeout_s=0.5)
+            assert len(out) == total  # consistent with the scribble
+        except (WireError, TimeoutError):
+            pass  # loud: oob length or never-committed continuation
+    finally:
+        arena.close(unlink=True)

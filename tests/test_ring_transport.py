@@ -1,0 +1,516 @@
+"""Zero-syscall ring lane (service/ring.py, ISSUE 18).
+
+Covers the seqlock ring protocol on a raw arena (roundtrip, spanning
+frames, wraparound laps, full-ring refusal, torn/recycled/stale/zeroed
+records all loud ``WireError``), the client/server pair
+(``RingArraysClient``/``serve_ring``: evaluate, pipelined + batched
+windows, GetLoad, ping), graceful degradation both ways (ring client vs
+plain shm node, shm client vs ring node), the npwire pool-probe
+regression on a ring-attached doorbell, pool integration (pure ring +
+mixed transports), chaos classification, and abrupt peer death (SIGKILL
+classified transient within a bounded wait, never a hang).
+"""
+
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pytensor_federated_tpu import faultinject as fi
+from pytensor_federated_tpu.service.arena import Arena
+from pytensor_federated_tpu.service.npwire import (
+    WireError,
+    decode_batch,
+    encode_batch,
+    is_batch_frame,
+)
+from pytensor_federated_tpu.service.ring import (
+    DEFAULT_RING_RECORD_BYTES,
+    DEFAULT_RING_SLOTS,
+    Ring,
+    RingArraysClient,
+    _PRODUCED_OFF,
+    _RING_RECORDS_OFFSET,
+    _U64,
+    futex_available,
+    init_ring_header,
+    reset_syscall_counts,
+    serve_ring,
+    syscall_counts,
+)
+from pytensor_federated_tpu.service.shm import ShmArraysClient, serve_shm
+
+
+def quad_compute(x):
+    x = np.asarray(x)
+    return [
+        np.asarray(-np.sum((x - 3.0) ** 2)),
+        (-2.0 * (x - 3.0)).astype(x.dtype),
+    ]
+
+
+def expected(i):
+    return -((i - 3.0) ** 2 + 4.0)
+
+
+def _ring_arena(tmp_path, *, slots=8, record_bytes=128, name="r.shm"):
+    arena = Arena.create(
+        1 << 20,
+        path=str(tmp_path / name),
+        ring_slots=slots,
+        ring_record_bytes=record_bytes,
+    )
+    init_ring_header(arena)
+    return arena
+
+
+def _pair(arena):
+    return (
+        Ring(arena, role="producer"),
+        Ring(arena, role="consumer"),
+    )
+
+
+@pytest.fixture()
+def ring_node():
+    """One in-process ring node (daemon thread) -> (host, port)."""
+    ports = []
+    threading.Thread(
+        target=serve_ring,
+        args=(quad_compute,),
+        kwargs=dict(ready_callback=ports.append),
+        daemon=True,
+    ).start()
+    deadline = time.time() + 10
+    while not ports and time.time() < deadline:
+        time.sleep(0.01)
+    assert ports, "ring node did not come up"
+    yield "127.0.0.1", ports[0]
+
+
+@pytest.fixture()
+def client(ring_node):
+    c = RingArraysClient(*ring_node)
+    yield c
+    c.close()
+
+
+# ---------------------------------------------------------------------------
+# the seqlock ring protocol
+# ---------------------------------------------------------------------------
+
+
+class TestRingProtocol:
+    def test_roundtrip_single_record(self, tmp_path):
+        arena = _ring_arena(tmp_path)
+        prod, cons = _pair(arena)
+        assert prod.try_produce(b"hello ring")
+        assert cons.recv(timeout_s=2.0) == b"hello ring"
+        arena.close(unlink=True)
+
+    def test_spanning_frame_roundtrip(self, tmp_path):
+        """A frame bigger than one record spans K records; record 0
+        carries the total, continuations their chunk length."""
+        arena = _ring_arena(tmp_path, slots=8, record_bytes=128)
+        prod, cons = _pair(arena)
+        frame = bytes(range(256)) * 2  # 512 B > 112 B payload cap
+        assert prod.try_produce(frame)
+        assert cons.recv(timeout_s=2.0) == frame
+        arena.close(unlink=True)
+
+    def test_wraparound_many_laps(self, tmp_path):
+        """Sequences stay monotone across laps: 10x the slot count of
+        varied-size frames round-trip in order."""
+        arena = _ring_arena(tmp_path, slots=4, record_bytes=128)
+        prod, cons = _pair(arena)
+        for i in range(40):
+            frame = bytes([i % 251]) * (1 + (i * 37) % 300)
+            assert prod.try_produce(frame)
+            assert cons.recv(timeout_s=2.0) == frame
+        arena.close(unlink=True)
+
+    def test_full_ring_refuses_never_blocks(self, tmp_path):
+        arena = _ring_arena(tmp_path, slots=4, record_bytes=128)
+        prod, cons = _pair(arena)
+        for _ in range(4):
+            assert prod.try_produce(b"x" * 100)
+        assert not prod.try_produce(b"x")  # full: doorbell territory
+        assert cons.recv(timeout_s=2.0) == b"x" * 100
+        assert prod.try_produce(b"y")  # one drained slot frees one
+        arena.close(unlink=True)
+
+    def test_oversized_frame_refused(self, tmp_path):
+        arena = _ring_arena(tmp_path, slots=4, record_bytes=128)
+        prod, _cons = _pair(arena)
+        cap = prod.payload_cap * prod.slots
+        assert not prod.try_produce(b"z" * (cap + 1))
+        with pytest.raises(WireError, match="exceeds"):
+            prod.produce_blocking(b"z" * (cap + 1), timeout_s=0.1)
+        arena.close(unlink=True)
+
+    def test_recv_timeout_is_loud(self, tmp_path):
+        arena = _ring_arena(tmp_path)
+        _prod, cons = _pair(arena)
+        with pytest.raises(TimeoutError, match="timed out"):
+            cons.recv(timeout_s=0.1)
+        arena.close(unlink=True)
+
+    def test_torn_record_is_wire_error(self, tmp_path):
+        """A record left mid-write (odd seq) under a PUBLISHED produced
+        counter can never be a slow producer — loud, not a hang (the
+        chaos torn_ring_word scenario)."""
+        arena = _ring_arena(tmp_path)
+        prod, cons = _pair(arena)
+        assert prod.try_produce(b"torn")
+        _U64.pack_into(arena.mm, _RING_RECORDS_OFFSET, 1)  # re-tear seq
+        t0 = time.monotonic()
+        with pytest.raises(WireError, match="torn"):
+            cons.recv(timeout_s=30.0)
+        assert time.monotonic() - t0 < 5.0  # detected, not waited out
+        arena.close(unlink=True)
+
+    def test_future_lap_seq_is_wire_error(self, tmp_path):
+        arena = _ring_arena(tmp_path, slots=8)
+        prod, cons = _pair(arena)
+        assert prod.try_produce(b"stale")
+        _U64.pack_into(arena.mm, _RING_RECORDS_OFFSET, 2 * 8 + 2)
+        with pytest.raises(WireError, match="recycled"):
+            cons.recv(timeout_s=2.0)
+        arena.close(unlink=True)
+
+    def test_wrong_slot_residue_is_wire_error(self, tmp_path):
+        """Second lap: a sub-``want`` stamp belonging to ANOTHER slot
+        is scribble, not an older lap of this slot."""
+        arena = _ring_arena(tmp_path, slots=8)
+        prod, cons = _pair(arena)
+        for _ in range(8):  # advance both ends one full lap
+            assert prod.try_produce(b"lap")
+            assert cons.recv(timeout_s=2.0) == b"lap"
+        assert prod.try_produce(b"slot")  # pos 8 -> slot 0, seq 18
+        _U64.pack_into(arena.mm, _RING_RECORDS_OFFSET, 2 * 1 + 2)
+        with pytest.raises(WireError, match="slot"):
+            cons.recv(timeout_s=2.0)
+        arena.close(unlink=True)
+
+    def test_zeroed_after_first_lap_is_wire_error(self, tmp_path):
+        arena = _ring_arena(tmp_path, slots=8)
+        prod, cons = _pair(arena)
+        for _ in range(8):
+            assert prod.try_produce(b"lap")
+            assert cons.recv(timeout_s=2.0) == b"lap"
+        assert prod.try_produce(b"zero")
+        _U64.pack_into(arena.mm, _RING_RECORDS_OFFSET, 0)
+        with pytest.raises(WireError, match="zeroed"):
+            cons.recv(timeout_s=2.0)
+        arena.close(unlink=True)
+
+    def test_producer_close_unparks_consumer(self, tmp_path):
+        """Clean close zeroes the epoch + wakes: a PARKED consumer
+        classifies the departure as ConnectionError within a slice."""
+        arena = _ring_arena(tmp_path)
+        prod, cons = _pair(arena)
+        timer = threading.Timer(0.2, prod.close)
+        timer.start()
+        t0 = time.monotonic()
+        with pytest.raises(ConnectionError, match="epoch zeroed"):
+            cons.recv(timeout_s=30.0)
+        assert time.monotonic() - t0 < 5.0
+        timer.cancel()
+        arena.close(unlink=True)
+
+    def test_v1_arena_has_no_ring(self, tmp_path):
+        arena = Arena.create(1 << 20, path=str(tmp_path / "v1.shm"))
+        with pytest.raises(WireError, match="ring"):
+            Ring(arena, role="producer")
+        with pytest.raises(WireError, match="ring"):
+            init_ring_header(arena)
+        arena.close(unlink=True)
+
+    def test_foreign_geometry_is_loud(self, tmp_path):
+        arena = _ring_arena(tmp_path, slots=8, record_bytes=128)
+        struct.pack_into("<I", arena.mm, _PRODUCED_OFF + 28, 16)
+        with pytest.raises(WireError, match="geometry"):
+            Ring(arena, role="consumer")
+        arena.close(unlink=True)
+
+    def test_syscall_counters_account_parks(self, tmp_path):
+        """The shim counters ARE the syscalls/eval measurement (no
+        strace in this container): a parked wait increments exactly
+        one wait counter family."""
+        arena = _ring_arena(tmp_path)
+        prod, cons = _pair(arena)
+        reset_syscall_counts()
+        with pytest.raises(TimeoutError):
+            cons.recv(timeout_s=0.12)
+        counts = syscall_counts()
+        if futex_available():
+            assert counts["futex_wait"] >= 1
+            assert counts["fallback_poll"] == 0
+        else:
+            assert counts["fallback_poll"] >= 1
+        prod.close()
+        arena.close(unlink=True)
+
+
+# ---------------------------------------------------------------------------
+# client/server surface
+# ---------------------------------------------------------------------------
+
+
+class TestRingClient:
+    def test_evaluate_rides_the_ring(self, client):
+        assert client.evaluate(np.array([2.0, 5.0]))  # attaches
+        assert client._com_ring is not None  # rings really negotiated
+        out = client.evaluate(np.array([1.0, 5.0]))
+        assert float(out[0]) == expected(1.0)
+        assert np.allclose(out[1], [4.0, -4.0])
+
+    def test_evaluate_many_pipelined_and_batched(self, client):
+        reqs = [(np.array([float(i), 5.0]),) for i in range(12)]
+        for kw in (dict(window=4), dict(window=4, batch=True)):
+            res = client.evaluate_many(reqs, **kw)
+            for i, r in enumerate(res):
+                assert float(r[0]) == expected(float(i))
+
+    def test_get_load_and_ping(self, client):
+        load = client.get_load()
+        assert load is not None and load["transport"] == "ring"
+        rtt = client.ping()
+        assert 0 < rtt < 5.0
+
+    def test_ring_client_against_plain_shm_node(self):
+        """No ring spec in ATTACH_OK -> every frame takes the doorbell,
+        behavior identical to the parent class."""
+        ports = []
+        threading.Thread(
+            target=serve_shm, args=(quad_compute,),
+            kwargs=dict(ready_callback=ports.append), daemon=True,
+        ).start()
+        while not ports:
+            time.sleep(0.01)
+        c = RingArraysClient("127.0.0.1", ports[0])
+        try:
+            out = c.evaluate(np.array([2.0, 5.0]))
+            assert float(out[0]) == expected(2.0)
+            assert c._com_ring is None and c._sub_ring is None
+        finally:
+            c.close()
+
+    def test_plain_shm_client_against_ring_node(self, ring_node):
+        """A ring node serves doorbell-only clients unchanged."""
+        c = ShmArraysClient(*ring_node)
+        try:
+            out = c.evaluate(np.array([4.0, 5.0]))
+            assert float(out[0]) == expected(4.0)
+        finally:
+            c.close()
+
+    def test_tiny_ring_falls_back_and_correlates(self):
+        """Frames that outgrow a tiny ring take the tcp doorbell; the
+        per-channel FIFO tags keep mixed-channel correlation straight
+        across a pipelined window."""
+        ports = []
+        threading.Thread(
+            target=serve_ring, args=(quad_compute,),
+            kwargs=dict(
+                ready_callback=ports.append,
+                ring_slots=2, ring_record_bytes=64,
+            ),
+            daemon=True,
+        ).start()
+        while not ports:
+            time.sleep(0.01)
+        c = RingArraysClient("127.0.0.1", ports[0])
+        try:
+            reqs = [(np.array([float(i), 5.0]),) for i in range(10)]
+            res = c.evaluate_many(reqs, window=5)
+            for i, r in enumerate(res):
+                assert float(r[0]) == expected(float(i))
+        finally:
+            c.close()
+
+    def test_npwire_probe_on_ring_attached_doorbell(self, ring_node):
+        """REGRESSION (satellite 3): the pool's zero-item npwire batch
+        probe must keep working on a ring node's doorbell socket."""
+        host, port = ring_node
+        uid = b"p" * 16
+        frame = encode_batch([], uuid=uid)
+        with socket.create_connection((host, port), timeout=5) as s:
+            s.sendall(struct.pack("<I", len(frame)) + frame)
+            (n,) = struct.unpack("<I", s.recv(4))
+            payload = b""
+            while len(payload) < n:
+                payload += s.recv(n - len(payload))
+        assert is_batch_frame(payload)
+        items, ruid, err, _t, _sp = decode_batch(payload)
+        assert ruid == uid and err is None and items == []
+
+    def test_sigkill_peer_classified_transient_no_hang(self):
+        """Abrupt node death: the parked client's doorbell EOF probe
+        classifies a ConnectionError within a bounded wait."""
+        import multiprocessing as mp
+
+        ctx = mp.get_context("spawn")
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        proc = ctx.Process(
+            target=_serve_ring_slow_node, args=(port,), daemon=True
+        )
+        proc.start()
+        try:
+            c = RingArraysClient(
+                "127.0.0.1", port, retries=0,
+                connect_timeout_s=2.0, connect_retries=30,
+                connect_backoff_s=0.2,
+            )
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                try:
+                    c.evaluate(np.array([0.0, 5.0]))
+                    break
+                except (ConnectionError, OSError):
+                    time.sleep(0.2)
+            assert c._com_ring is not None
+            killer = threading.Timer(0.1, proc.kill)
+            killer.start()
+            t0 = time.monotonic()
+            with pytest.raises((ConnectionError, OSError, TimeoutError)):
+                for i in range(50):
+                    c.evaluate(np.array([float(i), 5.0]))
+            killer.cancel()
+            assert time.monotonic() - t0 < 30.0  # bounded, never hung
+            c.close()
+        finally:
+            if proc.is_alive():
+                proc.kill()
+            proc.join(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# pool integration
+# ---------------------------------------------------------------------------
+
+
+class TestRingPool:
+    def test_ring_pool_evaluate_many(self, ring_node):
+        from pytensor_federated_tpu.routing import (
+            NodePool,
+            PooledArraysClient,
+        )
+
+        pool = NodePool(transport="ring", probe_timeout_s=2.0)
+        pool.add_replica(*ring_node)
+        try:
+            assert pool.probe_once() == 1
+            client = PooledArraysClient(pool)
+            reqs = [(np.array([float(i), 5.0]),) for i in range(12)]
+            res = client.evaluate_many(reqs, window=4)
+            for i in range(12):
+                assert float(res[i][0]) == expected(float(i))
+        finally:
+            pool.close()
+
+    def test_mixed_ring_shm_pool(self, ring_node):
+        from pytensor_federated_tpu.routing import (
+            NodePool,
+            PooledArraysClient,
+        )
+
+        sports = []
+        threading.Thread(
+            target=serve_shm, args=(quad_compute,),
+            kwargs=dict(ready_callback=sports.append), daemon=True,
+        ).start()
+        while not sports:
+            time.sleep(0.01)
+        pool = NodePool(transport="ring", probe_timeout_s=2.0)
+        pool.add_replica(*ring_node)
+        pool.add_replica("127.0.0.1", sports[0], transport="shm")
+        try:
+            assert pool.probe_once() == 2
+            assert {r.transport for r in pool.replicas} == {"ring", "shm"}
+            client = PooledArraysClient(pool)
+            reqs = [(np.array([float(i), 5.0]),) for i in range(16)]
+            res = client.evaluate_many(reqs, window=4)
+            for i in range(16):
+                assert float(res[i][0]) == expected(float(i))
+        finally:
+            pool.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos seams
+# ---------------------------------------------------------------------------
+
+
+class TestRingChaos:
+    def test_torn_ring_word_classified_and_recovers(self, ring_node):
+        """A torn completion record is loud (never a hang, never bad
+        data) and the next attach serves cleanly."""
+        plan = fi.FaultPlan(
+            [fi.FaultRule("torn_ring_word", point="ring.record", nth=2)],
+            seed=18,
+        )
+        c = RingArraysClient(*ring_node, retries=0)
+        out = c.evaluate(np.array([1.0, 5.0]))  # attach + warm call
+        assert float(out[0]) == expected(1.0)
+        fi.install(plan)
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(
+                (WireError, ConnectionError, TimeoutError, RuntimeError)
+            ):
+                for i in range(8):
+                    c.evaluate(np.array([float(i), 5.0]))
+            assert time.monotonic() - t0 < 40.0
+        finally:
+            fi.uninstall()
+        out = c.evaluate(np.array([2.0, 5.0]))  # fresh attach, clean
+        assert float(out[0]) == expected(2.0)
+        c.close()
+
+    def test_stale_generation_classified(self, ring_node):
+        plan = fi.FaultPlan(
+            [fi.FaultRule(
+                "stale_generation", point="ring.record", nth=2
+            )],
+            seed=19,
+        )
+        c = RingArraysClient(*ring_node, retries=0)
+        c.evaluate(np.array([1.0, 5.0]))
+        fi.install(plan)
+        try:
+            with pytest.raises(
+                (WireError, ConnectionError, TimeoutError, RuntimeError)
+            ):
+                for i in range(8):
+                    c.evaluate(np.array([float(i), 5.0]))
+        finally:
+            fi.uninstall()
+        out = c.evaluate(np.array([3.0, 5.0]))
+        assert float(out[0]) == expected(3.0)
+        c.close()
+
+
+def _serve_ring_slow_node(port):
+    """Module-level (spawn target): a ring node whose compute sleeps,
+    so a SIGKILL lands while the client is parked on the ring."""
+    import time as _time
+
+    import numpy as _np
+
+    from pytensor_federated_tpu.service.ring import serve_ring as _serve
+
+    def compute(x):
+        _time.sleep(0.05)
+        x = _np.asarray(x)
+        return [
+            _np.asarray(-_np.sum((x - 3.0) ** 2)),
+            (-2.0 * (x - 3.0)).astype(x.dtype),
+        ]
+
+    _serve(compute, "127.0.0.1", port)

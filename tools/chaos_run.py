@@ -156,6 +156,26 @@ def _serve_shm_node(port: int, delay: float) -> None:
     serve_shm(compute, "127.0.0.1", port)
 
 
+def _serve_ring_node(port: int, delay: float) -> None:
+    """The zero-syscall lane's replica: seqlock rings in the arena,
+    doorbell kept as attach channel + fallback (ISSUE 18)."""
+    import time as _time
+
+    import numpy as _np
+
+    def compute(x):
+        _time.sleep(COMPUTE_DELAY_S if delay is None else delay)
+        x = _np.asarray(x)
+        return [
+            _np.asarray(-_np.sum((x - 3.0) ** 2)),
+            (-2.0 * (x - 3.0)).astype(x.dtype),
+        ]
+
+    from pytensor_federated_tpu.service.ring import serve_ring
+
+    serve_ring(compute, "127.0.0.1", port)
+
+
 def _free_ports(n: int) -> list:
     socks, ports = [], []
     for _ in range(n):
@@ -176,6 +196,7 @@ def _spawn_node(transport: str, port: int, plan_json=None):
         "grpc": _serve_grpc_node,
         "tcp": _serve_tcp_node,
         "shm": _serve_shm_node,
+        "ring": _serve_ring_node,
     }[transport]
     saved = os.environ.get(fi.runtime.ENV_VAR)
     if plan_json is not None:
@@ -256,6 +277,27 @@ def _driver_templates(transport: str):
             ("stall", dict(point="shm.send", stall_s=1.0, max_fires=1)),
             ("drop", dict(point="pool.probe", max_fires=2)),
         ]
+    if transport == "ring":
+        # The zero-syscall lane (ISSUE 18): faults on the client's
+        # descriptor-ring seams — corrupt/truncated submission records
+        # fail THEIR reply in-band server-side, a torn/future-lap
+        # seqlock record tears the ring down loudly, a swallowed futex
+        # wake exercises the park loop's lost-wake guard — plus the
+        # doorbell faults the attach/fallback channel inherits from
+        # the shm lane.
+        return [
+            ("delay", dict(point="ring.send", delay_s=0.02, max_fires=3)),
+            ("drop", dict(point="ring.send", max_fires=2)),
+            ("corrupt_bytes", dict(point="ring.send", max_fires=1)),
+            ("truncate_frame", dict(point="ring.send", max_fires=1)),
+            ("corrupt_bytes", dict(point="ring.recv", max_fires=1)),
+            ("torn_ring_word", dict(point="ring.record", max_fires=1)),
+            ("stale_generation", dict(point="ring.record", max_fires=1)),
+            ("ring_stall",
+             dict(point="ring.wake", stall_s=0.5, max_fires=1)),
+            ("disconnect", dict(point="shm.send", max_fires=1)),
+            ("drop", dict(point="pool.probe", max_fires=2)),
+        ]
     send = "tcp.send" if transport == "tcp" else "grpc.send"
     recv = "tcp.recv" if transport == "tcp" else "grpc.recv"
     return [
@@ -292,6 +334,30 @@ def _node_templates(transport: str):
                                    max_fires=1)),
             ("stale_generation", dict(point="shm.arena.reply",
                                       max_fires=1)),
+            ("kill_process", dict(point="shm.compute", max_fires=1)),
+        ]
+    if transport == "ring":
+        # Node-side ring faults: the completion ring's producer is the
+        # only writer that can tear ITS records (torn seqlock word,
+        # future-lap stamp); a dropped reply is the accept-then-silence
+        # scenario the client's bounded recv must classify; SIGKILL
+        # mid-compute proves a parked client wakes and classifies a
+        # transient instead of hanging.
+        return [
+            ("compute_error", dict(point="shm.compute", max_fires=1)),
+            ("delay", dict(point="shm.compute", delay_s=0.05,
+                           max_fires=2)),
+            ("stall", dict(point="shm.compute", stall_s=3.0,
+                           max_fires=1)),
+            ("drop", dict(point="ring.server.send", max_fires=1)),
+            ("truncate_frame", dict(point="ring.server.send",
+                                    max_fires=1)),
+            ("corrupt_bytes", dict(point="ring.server.recv",
+                                   max_fires=1)),
+            ("torn_ring_word", dict(point="ring.record", max_fires=1)),
+            ("stale_generation", dict(point="ring.record", max_fires=1)),
+            ("ring_stall",
+             dict(point="ring.wake", stall_s=0.5, max_fires=1)),
             ("kill_process", dict(point="shm.compute", max_fires=1)),
         ]
     reply = "tcp.server.send" if transport == "tcp" else "grpc.server.reply"
@@ -2552,12 +2618,17 @@ def main(argv=None) -> int:
                     help="run exactly one seed (replay a failure)")
     ap.add_argument("--base-seed", type=int, default=0)
     ap.add_argument("--transport", "--lane", dest="transport",
-                    choices=("grpc", "tcp", "shm", "overload",
+                    choices=("grpc", "tcp", "shm", "ring", "overload",
                              "collector", "gateway", "shard",
                              "streaming", "zero"),
                     default="grpc",
                     help="transport lane under chaos (--lane is an "
                     "alias; 'shm' runs the zero-copy arena lane; "
+                    "'ring' runs the ISSUE-18 zero-syscall lane: "
+                    "seqlock descriptor rings in the arena under torn "
+                    "records, future-lap stamps, swallowed futex "
+                    "wakes, dropped replies, and a SIGKILLed node — "
+                    "every fault loud, parked waiters never hang; "
                     "'overload' runs the ISSUE-10 scenario: 2x-"
                     "oversubscribed clients, one stalling replica, "
                     "deadline/shed/budget invariants; 'collector' "
