@@ -526,6 +526,84 @@ def partition_overhead(n_plan: int = 20_000, n_round: int = 2_000) -> dict:
     }
 
 
+def linalg_block_overhead(n_hdr: int = 20_000, n_fact: int = 150) -> dict:
+    """Driver/store protocol cost gate for the blocked-linalg lane
+    (ISSUE 19): what the block-store protocol adds on top of the wire
+    and the numeric kernels.  Two measurements, best-of-3 like the
+    sibling gates:
+
+    - ``header_ns``: one op-header encode+decode plus one tile-header
+      encode+decode (with full geometry validation) — the per-request
+      bookkeeping every block-store message pays.
+    - ``step_us``: one full right-looking factorization STEP driven
+      end-to-end through the in-process store (16x16 f64 in 8-tile
+      blocks: CHOL_PANEL dispatch, panel-merge validation, SYRK
+      broadcast, every loud check on), kernels included — the
+      driver-side critical path between two wire calls.
+
+    INTEGRITY-GATED like the race: every timed factorization is
+    checked against ``np.linalg.cholesky`` and the gate fails on any
+    drift — a fast wrong factor must never pass.
+
+    PASSES when the header bookkeeping stays under 10% of the ~110 us
+    RPC floor (it rides on every message) and a full protocol step
+    stays under 5x the floor (the step spans >= 2 RPCs plus the tile
+    kernels; the gate catches a validation-path regression, not a
+    kernel race)."""
+    from pytensor_federated_tpu.linalg import (
+        BlockedCholesky,
+        BlockLayout,
+        LocalBlockClient,
+    )
+    from pytensor_federated_tpu.linalg.blocks import (
+        OPCODES,
+        decode_op_header,
+        encode_op_header,
+    )
+
+    lay = BlockLayout(16, 16, 8, 8)
+    a_mat = np.random.default_rng(0).normal(size=(16, 16))
+    a_mat = a_mat @ a_mat.T / 16 + np.eye(16)
+    ref = np.linalg.cholesky(a_mat)
+
+    def hdr_loop() -> float:
+        t0 = time.perf_counter()
+        for _ in range(n_hdr):
+            decode_op_header(encode_op_header(OPCODES["SYRK_UPDATE"], 1, 2))
+            lay.decode_tile_header(lay.encode_tile_header(1, 0))
+        return (time.perf_counter() - t0) / n_hdr
+
+    def fact_loop() -> tuple:
+        maxerr = 0.0
+        t0 = time.perf_counter()
+        for _ in range(n_fact):
+            l = BlockedCholesky(lay, [LocalBlockClient(lay)]).factor(a_mat)
+            maxerr = max(maxerr, float(np.max(np.abs(l - ref))))
+        per_step = (time.perf_counter() - t0) / n_fact / lay.grid_rows
+        return per_step, maxerr
+
+    hdr_s = step_s = float("inf")
+    maxerr = 0.0
+    for _ in range(3):
+        hdr_s = min(hdr_s, hdr_loop())
+        s, e = fact_loop()
+        step_s = min(step_s, s)
+        maxerr = max(maxerr, e)
+    rpc_floor_s = 110e-6  # docs/performance.md "Host lane budget"
+    hdr_frac = hdr_s / rpc_floor_s
+    return {
+        "header_ns": round(hdr_s * 1e9, 1),
+        "step_us": round(step_s * 1e6, 2),
+        "header_frac_of_rpc_floor": round(hdr_frac, 4),
+        "factor_maxerr": maxerr,
+        "pass": bool(
+            hdr_frac < 0.10
+            and step_s < 5 * rpc_floor_s
+            and maxerr < 1e-10
+        ),
+    }
+
+
 def shm_overhead(n_pings: int = 300) -> dict:
     """Idle gate for the zero-copy shm transport (ISSUE 9): one
     doorbell round-trip with an EMPTY arena write — slot allocate +
@@ -1278,6 +1356,11 @@ def main():
     except Exception as e:  # same invariant
         sharded_gate = {"error": f"{type(e).__name__}: {e}", "pass": False}
 
+    try:
+        linalg_gate = linalg_block_overhead()
+    except Exception as e:  # same invariant
+        linalg_gate = {"error": f"{type(e).__name__}: {e}", "pass": False}
+
     # The shm race lane's node is no longer needed once measurement
     # and gates are done (the gates spin their own in-process node).
     if shm_client is not None:
@@ -1312,6 +1395,7 @@ def main():
                 "collector_overhead": collector_gate,
                 "gateway_overhead": gateway_gate,
                 "sharded_update_overhead": sharded_gate,
+                "linalg_block_overhead": linalg_gate,
                 **flop_extra,
             }
         )

@@ -430,7 +430,14 @@ void apply_partition(const Partition& p, Message* reply) {
   uint64_t total = 0;
   for (size_t i = 1; i < reply->arrays.size(); ++i) {
     if (!is_f8(reply->arrays[i])) {
-      reply->error = "partitioned tail arrays must share one dtype";
+      // Name the offending slot and its dtype, like the python rule:
+      // the C++ node serves <f8 tails only, so any other dtype is the
+      // mismatch by definition.
+      std::ostringstream oss;
+      oss << "partitioned tail arrays must share one dtype, got "
+          << "reply[" << i << "]=" << reply->arrays[i].dtype
+          << " (this node serves <f8 tails)";
+      reply->error = oss.str();
       return;
     }
     total += reply->arrays[i].nelem();

@@ -182,10 +182,38 @@ def _zero_owner_update() -> FixtureProgram:
     )
 
 
+def _linalg_block_row_reduce() -> FixtureProgram:
+    """The blocked-linalg quadratic-form round (ISSUE 19): ``x^T A x``
+    with ``A`` sharded by block-rows through ``canonical_round`` — the
+    vector broadcasts (mapped operand), the row panels bake as
+    trace-time constants, the per-shard term is scalar.  Exactly the
+    shape that keeps the PR-13 reduce-window lowering eligible under
+    ``PoolPlacement(reduce=True)``; a driver-varying capture creeping
+    into the panels would both trip the pool refusal and kill the
+    reduce eligibility."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..linalg.ops import quadratic_per_shard
+    from .lowering import canonical_round
+
+    panels = jnp.asarray(
+        np.arange(24.0, dtype=np.float32).reshape(4, 2, 3)
+    )
+    x_rows = jnp.asarray(
+        np.arange(8.0, dtype=np.float32).reshape(4, 2)
+    )
+    model = canonical_round(quadratic_per_shard(), (panels, x_rows), 4)
+    return model, (jnp.ones((3,), jnp.float32),)
+
+
 FIXTURES: Sequence[LintFixture] = (
     LintFixture(name="canonical-round", build=_canonical_round),
     LintFixture(name="two-potential-window", build=_two_potential_window),
     LintFixture(name="ppl-plate-round", build=_ppl_plate_round),
     LintFixture(name="ppl-subsample-round", build=_ppl_subsample_round),
     LintFixture(name="zero-owner-update", build=_zero_owner_update),
+    LintFixture(
+        name="linalg-block-row-reduce", build=_linalg_block_row_reduce
+    ),
 )

@@ -1,0 +1,398 @@
+"""The block-store node compute (ISSUE 19).
+
+A stateful arrays-in/arrays-out compute serving the blocked-linalg
+operation set declared in :mod:`..service.wire_registry`
+(``LINALG_OPCODES``): tiles ship ONCE (``PUT``), live node-side keyed
+by grid coordinate, and every subsequent panel operation references
+them by block id — steady-state factorization steps move only the
+panel, never the matrix.  Deployed on any transport lane
+(``run_node``/``serve_tcp``/``serve_shm``/``serve_ring``) like any
+other compute; on the shm/ring lanes the PR-9 pin cache additionally
+makes repeated request operands (headers, re-broadcast panels) zero
+copy-bytes.
+
+Protocol state is deliberately minimal — a tile dict plus one
+``applied_step`` counter — because the DRIVER (:mod:`.ops`) owns
+recovery: on a replica failure it restores that replica's trailing
+state with a fresh ``PUT`` before retrying the step, so every op here
+can assume its inputs are current.  ``applied_step`` exists to make a
+retried trailing update idempotent (an update the node already applied
+whose reply was lost must not double-subtract) and to make a MISSED
+update a loud :class:`..linalg.blocks.BlockError` instead of silent
+numerical corruption.
+
+Numeric kernels route contractions through :func:`...precision.pdot`
+(the f32-strict policy seam — blocked contractions are exactly the
+>= few-hundred-term case CLAUDE.md flags as bf16-accurate on chip);
+float64 tiles use numpy kernels directly (the split path is an
+f32-only mitigation and would downcast).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..precision import matmul_precision_ctx, resolve_policy
+from .blocks import (
+    OPCODES,
+    BlockError,
+    BlockLayout,
+    decode_op_header,
+    unpack_coords,
+)
+
+__all__ = [
+    "make_block_store_compute",
+    "LocalBlockClient",
+    "chol_kernel",
+    "trsm_kernel",
+    "dot_kernel",
+    "is_restore_needed",
+]
+
+#: In-band refusals a DRIVER can heal by restoring the replica's
+#: trailing tiles and retrying the leg (the store is in the wrong
+#: state, not the wrong geometry).  Transport clients retry
+#: transparently (reconnect + re-send), so a re-sent panel op can land
+#: on a cold respawned store with no transport error ever reaching the
+#: driver — these markers are how the stateful protocol reports that
+#: loss in-band.  Kept as exact message fragments because the error
+#: crosses the wire as text (:class:`..service.tcp.RemoteComputeError`
+#: erases the type, the PR-15 lesson).
+_RESTORE_MARKS = (
+    "must be restored with PUT first",
+    "the driver must restore before retrying",
+    "a missed panel would silently corrupt the factor",
+)
+
+
+def is_restore_needed(exc: BaseException) -> bool:
+    """True when ``exc`` is a block-store state refusal the driver heals
+    with a restore (re-``PUT`` of trailing tiles) + retry.  Geometry and
+    numerical refusals (wrong layout, non-PD tile) never match — those
+    are deterministic and must propagate."""
+    msg = str(exc)
+    return any(mark in msg for mark in _RESTORE_MARKS)
+
+
+# ---------------------------------------------------------------------------
+# numeric kernels (shared with the driver in ops.py — one implementation,
+# so a driver-side recovery recompute is BIT-identical to the node's path)
+# ---------------------------------------------------------------------------
+
+
+def dot_kernel(
+    a: np.ndarray, b: np.ndarray, policy: Optional[str] = None
+) -> np.ndarray:
+    """Policy-routed tile contraction ``a @ b`` on host arrays."""
+    a = np.asarray(a)
+    b = np.asarray(b)
+    if a.dtype == np.float64 or b.dtype == np.float64:
+        # The bf16x3 split is an f32 mitigation; float64 contracts
+        # exactly in numpy (the reference framework's CPU posture).
+        return np.matmul(a, b)
+    from ..precision import pdot
+
+    return np.asarray(pdot(a, b, policy), dtype=np.result_type(a, b))
+
+
+def chol_kernel(a: np.ndarray, policy: Optional[str] = None) -> np.ndarray:
+    """Lower Cholesky of one diagonal tile; loud on non-PD input."""
+    a = np.asarray(a)
+    try:
+        if a.dtype == np.float64:
+            return np.linalg.cholesky(a)
+        import jax.numpy as jnp
+
+        with matmul_precision_ctx(policy):
+            l = np.asarray(jnp.linalg.cholesky(jnp.asarray(a)), dtype=a.dtype)
+        if not np.all(np.isfinite(l)):
+            raise np.linalg.LinAlgError("non-finite factor")
+        return l
+    except np.linalg.LinAlgError as e:
+        raise BlockError(f"diagonal tile is not positive definite: {e}") from e
+
+
+def trsm_kernel(
+    a_ik: np.ndarray, l_kk: np.ndarray, policy: Optional[str] = None
+) -> np.ndarray:
+    """Panel solve ``X = A_ik @ inv(L_kk)^T`` (right-looking Cholesky's
+    off-diagonal step), via the triangular solve ``L_kk X^T = A_ik^T``."""
+    a_ik = np.asarray(a_ik)
+    l_kk = np.asarray(l_kk)
+    if a_ik.dtype == np.float64:
+        return np.linalg.solve(l_kk, a_ik.T).T
+    import jax.numpy as jnp
+    from jax.scipy.linalg import solve_triangular
+
+    with matmul_precision_ctx(policy):
+        x = solve_triangular(
+            jnp.asarray(l_kk), jnp.asarray(a_ik).T, lower=True
+        ).T
+    return np.asarray(x, dtype=a_ik.dtype)
+
+
+# ---------------------------------------------------------------------------
+# the block store
+# ---------------------------------------------------------------------------
+
+
+class _BlockStore:
+    """One node's tile state: the dict plus the trailing-update clock."""
+
+    def __init__(self, layout: BlockLayout, policy: Optional[str]) -> None:
+        self.layout = layout
+        self.policy = policy
+        self.tiles: Dict[Tuple[int, int], np.ndarray] = {}
+        #: Number of trailing updates applied (updates for panel steps
+        #: ``0..applied_step-1`` are in the stored tiles).
+        self.applied_step = 0
+        #: Exactly-once replay cache for the current step's panel ops.
+        #: CHOL_PANEL/TRSM_PANEL solve tiles IN PLACE, so a re-sent
+        #: request (transport clients reconnect and re-send after a
+        #: lost reply) re-solving an already-solved panel would be
+        #: silent corruption — the replay returns the recorded reply
+        #: instead.  Invalidated by PUT (a restore replaces the tiles)
+        #: and by the step advancing.
+        self._panel_replies: Dict[Tuple[str, int], List[np.ndarray]] = {}
+
+    # -- op handlers -------------------------------------------------------
+
+    def put(self, step: int, count: int, args: List[np.ndarray]) -> List[np.ndarray]:
+        if len(args) != 2 * count:
+            raise BlockError(
+                f"PUT header claims {count} tiles but carries "
+                f"{len(args)} arrays (want {2 * count}: header+tile pairs)"
+            )
+        staged: Dict[Tuple[int, int], np.ndarray] = {}
+        for t in range(count):
+            coord = self.layout.decode_tile_header(args[2 * t])
+            if coord in staged:
+                raise BlockError(f"PUT ships tile {coord} twice")
+            tile = self.layout.check_tile(*coord, args[2 * t + 1])
+            staged[coord] = np.ascontiguousarray(tile)
+        self.tiles.update(staged)
+        # The driver stamps the restore point: tiles as shipped have
+        # exactly `step` trailing updates applied.
+        self.applied_step = step
+        self._panel_replies.clear()
+        return [np.int64(len(self.tiles))]
+
+    def get(self, args: List[np.ndarray]) -> List[np.ndarray]:
+        if len(args) != 1:
+            raise BlockError(f"GET wants one coordinate array, got {len(args)}")
+        out = []
+        for coord in unpack_coords(args[0]):
+            tile = self.tiles.get(coord)
+            if tile is None:
+                raise BlockError(
+                    f"GET of tile {coord} this store does not hold "
+                    f"({len(self.tiles)} tiles stored) — geometry "
+                    "disagreement or a restarted replica"
+                )
+            out.append(tile)
+        return out
+
+    def gemm_panel(self, args: List[np.ndarray]) -> List[np.ndarray]:
+        if len(args) != 2:
+            raise BlockError(f"GEMM_PANEL wants [a, b], got {len(args)} arrays")
+        a, b = np.asarray(args[0]), np.asarray(args[1])
+        if a.ndim != 2 or b.ndim != 2 or a.shape[1] != b.shape[0]:
+            raise BlockError(
+                f"GEMM_PANEL shapes do not contract: {a.shape} @ {b.shape}"
+            )
+        return [dot_kernel(a, b, self.policy)]
+
+    def _own_panel_rows(self, k: int) -> List[int]:
+        return sorted(
+            i for (i, j) in self.tiles if j == k and i > k
+        )
+
+    def _require(self, coord: Tuple[int, int], what: str) -> np.ndarray:
+        tile = self.tiles.get(coord)
+        if tile is None:
+            raise BlockError(
+                f"{what} needs tile {coord} this store does not hold — "
+                "a restarted replica must be restored with PUT first"
+            )
+        return tile
+
+    def chol_panel(self, k: int, args: List[np.ndarray]) -> List[np.ndarray]:
+        if args:
+            raise BlockError("CHOL_PANEL carries no arrays beyond the header")
+        if self.applied_step != k:
+            raise BlockError(
+                f"CHOL_PANEL step {k} but this store has "
+                f"{self.applied_step} trailing updates applied — "
+                "the driver must restore before retrying"
+            )
+        cached = self._panel_replies.get(("chol", k))
+        if cached is not None:
+            # A re-sent request after a lost reply: the solves already
+            # happened in place; solving again would corrupt silently.
+            return cached
+        a_kk = self._require((k, k), f"CHOL_PANEL({k})")
+        l_kk = chol_kernel(a_kk, self.policy)
+        self.tiles[(k, k)] = l_kk
+        rows = self._own_panel_rows(k)
+        out: List[np.ndarray] = [l_kk, np.asarray(rows, dtype=np.int64)]
+        for i in rows:
+            l_ik = trsm_kernel(self.tiles[(i, k)], l_kk, self.policy)
+            self.tiles[(i, k)] = l_ik
+            out.append(l_ik)
+        self._panel_replies[("chol", k)] = out
+        return out
+
+    def trsm_panel(self, k: int, args: List[np.ndarray]) -> List[np.ndarray]:
+        if len(args) != 1:
+            raise BlockError(f"TRSM_PANEL wants [L_kk], got {len(args)} arrays")
+        if self.applied_step != k:
+            raise BlockError(
+                f"TRSM_PANEL step {k} but this store has "
+                f"{self.applied_step} trailing updates applied — "
+                "the driver must restore before retrying"
+            )
+        cached = self._panel_replies.get(("trsm", k))
+        if cached is not None:
+            return cached
+        l_kk = self.layout.check_tile(k, k, args[0])
+        rows = self._own_panel_rows(k)
+        out: List[np.ndarray] = [np.asarray(rows, dtype=np.int64)]
+        for i in rows:
+            l_ik = trsm_kernel(self.tiles[(i, k)], l_kk, self.policy)
+            self.tiles[(i, k)] = l_ik
+            out.append(l_ik)
+        self._panel_replies[("trsm", k)] = out
+        return out
+
+    def syrk_update(self, k: int, args: List[np.ndarray]) -> List[np.ndarray]:
+        if not args:
+            raise BlockError("SYRK_UPDATE wants [rows, panel tiles...]")
+        rows_arr = np.asarray(args[0])
+        if rows_arr.dtype != np.int64 or rows_arr.ndim != 1:
+            raise BlockError(
+                f"SYRK_UPDATE rows must be int64 (n,), got "
+                f"{rows_arr.dtype} {rows_arr.shape}"
+            )
+        if self.applied_step > k:
+            # Already applied (a retried update whose reply was lost):
+            # idempotent no-op, signalled in-band with the -1 sentinel.
+            return [np.int64(-1)]
+        if self.applied_step < k:
+            raise BlockError(
+                f"SYRK_UPDATE step {k} but only {self.applied_step} "
+                "updates applied — a missed panel would silently "
+                "corrupt the factor"
+            )
+        rows = [int(i) for i in rows_arr]
+        if len(args) != 1 + len(rows):
+            raise BlockError(
+                f"SYRK_UPDATE claims {len(rows)} panel rows but "
+                f"carries {len(args) - 1} tiles"
+            )
+        panel = {}
+        for i, tile in zip(rows, args[1:]):
+            if i <= k:
+                raise BlockError(
+                    f"SYRK_UPDATE({k}) panel row {i} is not below the panel"
+                )
+            panel[i] = self.layout.check_tile(i, k, tile)
+        updated = 0
+        for (i, j), tile in list(self.tiles.items()):
+            if j <= k or j > i:
+                continue
+            l_ik = panel.get(i)
+            l_jk = panel.get(j)
+            if l_ik is None or l_jk is None:
+                raise BlockError(
+                    f"SYRK_UPDATE({k}) needs panel rows {i} and {j} "
+                    f"for stored tile ({i}, {j}) but the request only "
+                    f"carries rows {sorted(panel)}"
+                )
+            self.tiles[(i, j)] = tile - dot_kernel(
+                l_ik, l_jk.T, self.policy
+            ).astype(tile.dtype)
+            updated += 1
+        self.applied_step = k + 1
+        # The step advanced: step-k panel replays are now impossible
+        # (the applied_step guard refuses them loudly) and the cache
+        # would only pin dead tiles.
+        self._panel_replies.clear()
+        return [np.int64(updated)]
+
+    def reset(self) -> List[np.ndarray]:
+        n = len(self.tiles)
+        self.tiles.clear()
+        self.applied_step = 0
+        self._panel_replies.clear()
+        return [np.int64(n)]
+
+    def stats(self) -> List[np.ndarray]:
+        return [
+            np.int64(len(self.tiles)),
+            np.int64(sum(t.nbytes for t in self.tiles.values())),
+        ]
+
+
+def make_block_store_compute(
+    layout: BlockLayout, *, policy: Optional[str] = None
+) -> Callable[..., List[np.ndarray]]:
+    """Node-side compute serving the block-store operation set for ONE
+    block layout (the layout bakes at deploy time, like a pool
+    compute's per-shard function; a driver speaking a different
+    geometry gets a loud in-band :class:`BlockError`)."""
+    resolve_policy(policy)  # typo'd policies refuse at deploy time
+    store = _BlockStore(layout, policy)
+    ops = OPCODES
+
+    def compute(*arrays: Any) -> List[np.ndarray]:
+        if not arrays:
+            raise BlockError("block-store request carries no op header")
+        args = [np.asarray(a) for a in arrays]
+        opcode, step, count = decode_op_header(args[0])
+        rest = args[1:]
+        if opcode == ops["PUT"]:
+            return store.put(step, count, rest)
+        if opcode == ops["GET"]:
+            return store.get(rest)
+        if opcode == ops["GEMM_PANEL"]:
+            return store.gemm_panel(rest)
+        if opcode == ops["CHOL_PANEL"]:
+            return store.chol_panel(step, rest)
+        if opcode == ops["TRSM_PANEL"]:
+            return store.trsm_panel(step, rest)
+        if opcode == ops["SYRK_UPDATE"]:
+            return store.syrk_update(step, rest)
+        if opcode == ops["RESET"]:
+            return store.reset()
+        if opcode == ops["STATS"]:
+            return store.stats()
+        raise BlockError(f"unhandled linalg opcode {opcode}")
+
+    # Tests and the local lane reach the state for accounting.
+    compute.store = store  # type: ignore[attr-defined]
+    return compute
+
+
+class LocalBlockClient:
+    """In-process stand-in for a transport client over one block-store
+    compute — the clientless lane (``linalg.cholesky(a)`` with no pool)
+    and the unit-test seam.  Mirrors the pinned-client ``evaluate``
+    surface the driver uses."""
+
+    def __init__(
+        self, layout: BlockLayout, *, policy: Optional[str] = None
+    ) -> None:
+        self._compute = make_block_store_compute(layout, policy=policy)
+
+    @property
+    def store(self) -> _BlockStore:
+        return self._compute.store  # type: ignore[attr-defined]
+
+    def evaluate(self, *arrays: np.ndarray) -> List[np.ndarray]:
+        return [np.asarray(a) for a in self._compute(*arrays)]
+
+    def close(self) -> None:  # surface parity with transport clients
+        pass

@@ -68,6 +68,42 @@ def _jitter_scale(variance):
     return jnp.maximum(jnp.sum(v), jnp.prod(v))
 
 
+#: Posterior covariances at or above this order route their draw
+#: Cholesky through the ISSUE-19 blocked factorization when the values
+#: are concrete (outside any trace).  Below it the dense jnp kernel
+#: wins on dispatch overhead; tests shrink it to gate the two paths
+#: against each other on the same matrix.
+_BLOCKED_CHOL_MIN = 256
+
+
+def _posterior_chol(cov, vjit, policy=None, *, block: int = 128):
+    """Jitter-stabilized Cholesky of a posterior covariance.
+
+    A CONCRETE 2-D covariance of order >= :data:`_BLOCKED_CHOL_MIN`
+    factors through :func:`...linalg.cholesky` (the blocked
+    right-looking path — distributable over a block-store pool, and
+    policy-routed per f32-strict); traced values (inside
+    ``jit``/``vmap``), batched covariances, and small matrices stay on
+    ``jnp.linalg.cholesky``.  The two paths are equality-gated against
+    each other in tests/test_gp.py, so the dispatch can never silently
+    change the posterior draws.
+    """
+    from ..fed.primitives import is_tracer
+
+    n = cov.shape[-1]
+    if (
+        cov.ndim == 2
+        and n >= _BLOCKED_CHOL_MIN
+        and not (is_tracer(cov) or is_tracer(vjit))
+    ):
+        from ..linalg import cholesky as _blocked_cholesky
+
+        a = np.asarray(cov)
+        a = a + np.asarray(vjit, dtype=a.dtype) * np.eye(n, dtype=a.dtype)
+        return jnp.asarray(_blocked_cholesky(a, block=block, policy=policy))
+    return jnp.linalg.cholesky(cov + vjit * jnp.eye(n, dtype=cov.dtype))
+
+
 def _masked_cov(x, mask, variance, lengthscale, noise, kern=None):
     """Masked exact-GP covariance with identity rows on padded slots.
 
@@ -555,8 +591,8 @@ class FederatedSparseGP:
         with matmul_precision_ctx(self.f32_policy):
             n = cov.shape[0]
             variance, _, _ = _unpack(params)
-            chol = jnp.linalg.cholesky(
-                cov + _JITTER * _jitter_scale(variance) * jnp.eye(n)
+            chol = _posterior_chol(
+                cov, _JITTER * _jitter_scale(variance), self.f32_policy
             )
             eps = jax.random.normal(key, (num_draws, n), mean.dtype)
             return mean[None, :] + pdot(eps, chol.T, self.f32_policy)
@@ -740,8 +776,11 @@ class FederatedExactGP:
         with matmul_precision_ctx(self.f32_policy):
             variance, _, _ = _unpack(params)
             n = cov.shape[-1]
-            chol = jnp.linalg.cholesky(
-                cov + _JITTER * _jitter_scale(variance) * jnp.eye(n)
+            # Batched (n_shards, n, n) covariances take the helper's
+            # jnp fallback; a future per-shard blocked route would
+            # loop shards through the same seam.
+            chol = _posterior_chol(
+                cov, _JITTER * _jitter_scale(variance), self.f32_policy
             )
             eps = jax.random.normal(
                 key, (num_draws, mean.shape[0], n), mean.dtype

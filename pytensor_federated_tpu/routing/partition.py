@@ -190,9 +190,15 @@ def tail_layout(
     tail = [np.asarray(a) for a in arrays[1:]]
     dtypes = {a.dtype for a in tail}
     if len(dtypes) > 1:
+        # Name WHICH tail slot carries which dtype (reply index 1..):
+        # "got [...]" alone sends the node author diffing reply shapes
+        # by hand; the offender list pins the mismatched output.
+        per_slot = ", ".join(
+            f"reply[{i + 1}]={a.dtype}" for i, a in enumerate(tail)
+        )
         raise PartitionError(
             "partitioned tail arrays must share one dtype, got "
-            f"{sorted(str(d) for d in dtypes)}"
+            f"{sorted(str(d) for d in dtypes)} ({per_slot})"
         )
     dtype = dtypes.pop() if dtypes else np.dtype(np.float64)
     layout = [(tuple(a.shape), int(a.size)) for a in tail]

@@ -60,6 +60,11 @@ __all__ = [
     "RING_WAITING_WORD_OFFSET",
     "RING_EPOCH_WORD_OFFSET",
     "GETLOAD_PAYLOADS",
+    "LINALG_OP_STRUCT",
+    "LINALG_OP_FIELD_ORDER",
+    "LINALG_TILE_STRUCT",
+    "LINALG_TILE_FIELD_ORDER",
+    "LINALG_OPCODES",
 ]
 
 #: npwire frame flag bits, by canonical name.  npwire.py spells these
@@ -289,4 +294,58 @@ GETLOAD_PAYLOADS = {
     "TRACES": b"traces",    # + recent span trees (trace reunion pull)
     "TELEMETRY": b"telemetry",  # + full telemetry snapshot + flightrec
                                 # tail + node wall clock (fleet collector)
+}
+
+#: Blocked linear algebra headers (ISSUE 19).  The ``linalg`` block
+#: store is an ordinary arrays-in/arrays-out compute — tiles ride the
+#: existing npwire/npproto/shm/ring frames unchanged, so no new flag
+#: bits or field numbers exist.  What IS wire format is the two packed
+#: headers the driver and the block-store node must agree on, carried
+#: as the leading ``uint8`` request arrays of every linalg operation.
+#: They are declared here and IMPORTED by ``linalg/blocks.py`` (one
+#: source, drift impossible by construction — unlike the C++/cross-
+#: codec tables above there is exactly one implementation).
+#:
+#: Operation header (first request array of every block-store call)::
+#:
+#:     opcode(u32)  step(u32)  count(u32)  flags(u32)
+#:
+#: ``opcode`` is a :data:`LINALG_OPCODES` value; ``step`` is the outer
+#: factorization index ``k`` where one applies; ``count`` is the number
+#: of (tile-header, tile) pairs or coordinate rows that follow;
+#: ``flags`` is reserved (must be zero — the node refuses nonzero, the
+#: npwire unknown-flag posture).
+LINALG_OP_STRUCT = "<IIII"
+LINALG_OP_FIELD_ORDER = ("opcode", "step", "count", "flags")
+
+#: Tile header (precedes each shipped tile)::
+#:
+#:     grid_rows(u32) grid_cols(u32) row(u32) col(u32) rows(u64) cols(u64)
+#:
+#: ``grid_rows``/``grid_cols`` bind the tile to ONE block layout —
+#: a driver/node geometry disagreement is a loud ``BlockError``
+#: (⊂ ``WireError``), never a silently mis-placed tile; ``rows``/
+#: ``cols`` are the tile's own extent, cross-checked against both the
+#: layout's ``tile_shape(row, col)`` and the shipped array's shape.
+LINALG_TILE_STRUCT = "<IIIIQQ"
+LINALG_TILE_FIELD_ORDER = (
+    "grid_rows", "grid_cols", "row", "col", "rows", "cols",
+)
+
+#: Block-store opcodes (``linalg/service.py`` owns the semantics).
+#: Values are frozen wire constants: a driver built against one table
+#: revision talking to a node built against another must fail loudly
+#: (unknown opcode -> in-band BlockError), never run the wrong op.
+LINALG_OPCODES = {
+    "PUT": 1,          # store tiles: [op, (tile_hdr, tile)*] -> [stored]
+    "GET": 2,          # fetch tiles: [op, coords i64 (n,2)] -> [tiles...]
+    "GEMM_PANEL": 3,   # stateless partial product: [op, a, b] -> [a @ b]
+    "CHOL_PANEL": 4,   # factor step k on the owner of block-row k:
+                       # [op(k)] -> [L_kk, own_rows i64, L_ik...]
+    "TRSM_PANEL": 5,   # panel solve on a non-owner: [op(k), L_kk]
+                       # -> [own_rows i64, L_ik...]
+    "SYRK_UPDATE": 6,  # trailing update: [op(k), rows i64, L_ik...]
+                       # -> [n_updated]
+    "RESET": 7,        # drop every stored tile -> [n_dropped]
+    "STATS": 8,        # -> [n_tiles, n_bytes] (tests/accounting)
 }

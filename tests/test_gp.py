@@ -686,3 +686,73 @@ class TestPosteriorCovAndSampling:
         np.testing.assert_allclose(
             draws.mean(axis=0), np.asarray(mean_d), atol=0.05
         )
+
+
+class TestBlockedPosteriorChol:
+    """ISSUE 19 equality gate: the posterior-draw Cholesky dispatches
+    concrete large covariances onto the blocked factorization
+    (``linalg.cholesky``) — the two paths must agree on the SAME
+    matrix, and traced callers must always get the jnp fallback."""
+
+    def _spd(self, n, seed=0):
+        rng = np.random.default_rng(seed)
+        m = rng.normal(size=(n, n)).astype(np.float32)
+        return (m @ m.T / n + np.eye(n, dtype=np.float32))
+
+    def test_blocked_path_matches_jnp_path(self, monkeypatch):
+        from pytensor_federated_tpu.models import gp as gp_mod
+
+        cov = jnp.asarray(self._spd(40, seed=21))
+        vjit = jnp.float32(1e-4)
+        ref = np.asarray(
+            jnp.linalg.cholesky(cov + vjit * jnp.eye(40, dtype=cov.dtype))
+        )
+        monkeypatch.setattr(gp_mod, "_BLOCKED_CHOL_MIN", 8)
+        blocked = np.asarray(gp_mod._posterior_chol(cov, vjit, block=16))
+        np.testing.assert_allclose(blocked, ref, rtol=1e-4, atol=1e-5)
+
+    def test_sparse_sample_identical_through_dispatch(self, monkeypatch):
+        """The actual consumer: identical draws (same key) whether the
+        covariance factors on the jnp or the blocked path."""
+        from pytensor_federated_tpu.models import gp as gp_mod
+        from pytensor_federated_tpu.models.gp import (
+            FederatedSparseGP,
+            generate_gp_data,
+        )
+
+        data, _ = generate_gp_data(4, n_obs=32, seed=4)
+        z = np.linspace(-2, 2, 12).astype(np.float32)
+        sgp = FederatedSparseGP(data, z)
+        p = sgp.init_params()
+        xs = np.linspace(-1.5, 1.5, 9).astype(np.float32)
+        key = jax.random.PRNGKey(7)
+
+        monkeypatch.setattr(gp_mod, "_BLOCKED_CHOL_MIN", 10**9)
+        via_jnp = np.asarray(sgp.posterior_sample(p, key, xs, num_draws=3))
+        monkeypatch.setattr(gp_mod, "_BLOCKED_CHOL_MIN", 2)
+        via_blocked = np.asarray(
+            sgp.posterior_sample(p, key, xs, num_draws=3)
+        )
+        np.testing.assert_allclose(
+            via_blocked, via_jnp, rtol=1e-4, atol=1e-5
+        )
+
+    def test_traced_caller_gets_the_jnp_fallback(self, monkeypatch):
+        from pytensor_federated_tpu.models import gp as gp_mod
+
+        monkeypatch.setattr(gp_mod, "_BLOCKED_CHOL_MIN", 2)
+        cov = jnp.asarray(self._spd(12, seed=22))
+        vjit = jnp.float32(1e-4)
+        eager = np.asarray(gp_mod._posterior_chol(cov, vjit))
+        jitted = np.asarray(
+            jax.jit(gp_mod._posterior_chol)(cov, vjit)
+        )
+        np.testing.assert_allclose(jitted, eager, rtol=1e-4, atol=1e-6)
+
+    def test_batched_covariance_takes_fallback(self, monkeypatch):
+        from pytensor_federated_tpu.models import gp as gp_mod
+
+        monkeypatch.setattr(gp_mod, "_BLOCKED_CHOL_MIN", 2)
+        cov = jnp.stack([jnp.asarray(self._spd(6, seed=s)) for s in (1, 2)])
+        out = np.asarray(gp_mod._posterior_chol(cov, jnp.float32(1e-4)))
+        assert out.shape == (2, 6, 6)

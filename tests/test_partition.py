@@ -82,6 +82,18 @@ class TestHeadTailRule:
                 [np.float64(0), np.zeros(2), np.zeros(2, np.float32)]
             )
 
+    def test_mixed_tail_dtype_names_the_offending_slots(self):
+        """ISSUE 19 satellite: the refusal names WHICH reply slot
+        carries which dtype (tail slots are reply indices 1..), not
+        just the dtype set."""
+        with pytest.raises(
+            PartitionError,
+            match=r"reply\[1\]=float64, reply\[2\]=float32",
+        ):
+            gp.tail_layout(
+                [np.float64(0), np.zeros(2), np.zeros(2, np.float32)]
+            )
+
     def test_split_tail_roundtrip(self):
         outs = [np.float64(0), np.arange(6.0).reshape(2, 3), np.ones(4)]
         flat = gp.concat_tail(outs)

@@ -2519,6 +2519,323 @@ def run_zero_seed(seed: int, verbose: bool) -> dict:
     return result
 
 
+# -- the linalg lane (ISSUE 19) ---------------------------------------------
+
+#: The lane's fixed problem: a 64x64 SPD matrix in 16-tile blocks
+#: (4x4 grid) over 2 block-store replicas — small enough that a seed
+#: runs in seconds, large enough that every protocol leg (PUT,
+#: CHOL_PANEL, TRSM_PANEL, SYRK_UPDATE) fires several times per
+#: factorization, so a mid-step SIGKILL has real state to corrupt.
+_LINALG_N = 64
+_LINALG_B = 16
+
+
+def _serve_linalg_node(port: int) -> None:
+    """One block-store replica: the stateful ISSUE-19 compute (tiles
+    pinned node-side, panel ops by block id) over TCP.  A
+    PFTPU_FAULT_PLAN inherited from the parent env was activated at
+    package import — kill_process at server.compute is the lane's
+    namesake fault."""
+    import logging
+
+    logging.disable(logging.ERROR)
+
+    from pytensor_federated_tpu.utils import force_cpu_backend
+
+    force_cpu_backend()
+
+    from pytensor_federated_tpu.linalg import (
+        BlockLayout,
+        make_block_store_compute,
+    )
+    from pytensor_federated_tpu.service.tcp import serve_tcp_once
+
+    lay = BlockLayout(_LINALG_N, _LINALG_N, _LINALG_B, _LINALG_B)
+    serve_tcp_once(
+        make_block_store_compute(lay), "127.0.0.1", port, concurrent=True
+    )
+
+
+def _spawn_linalg_node(port, plan_json=None):
+    saved = os.environ.get(fi.runtime.ENV_VAR)
+    if plan_json is not None:
+        os.environ[fi.runtime.ENV_VAR] = plan_json
+    else:
+        os.environ.pop(fi.runtime.ENV_VAR, None)
+    try:
+        ctx = mp.get_context("spawn")
+        proc = ctx.Process(
+            target=_serve_linalg_node, args=(port,), daemon=True
+        )
+        proc.start()
+    finally:
+        if saved is None:
+            os.environ.pop(fi.runtime.ENV_VAR, None)
+        else:
+            os.environ[fi.runtime.ENV_VAR] = saved
+    return proc
+
+
+def _linalg_node_templates():
+    """Victim rules beyond the guaranteed SIGKILL: byte faults on the
+    reply path (the maybe-applied ambiguity the step stamps
+    disambiguate) and link delays.  Every rule transient-classified —
+    the driver must restore-and-retry, never assemble a partial
+    factor."""
+    return [
+        ("disconnect", dict(point="tcp.send", max_fires=1)),
+        ("delay", dict(point="tcp.send", delay_s=0.05, max_fires=3)),
+    ]
+
+
+def _linalg_driver_templates():
+    """Driver-side rules: request-path disconnects (a HEALTHY replica's
+    link dying must restore only that replica) and link delays."""
+    return [
+        ("disconnect", dict(point="tcp.send", max_fires=1)),
+        ("delay", dict(point="tcp.send", delay_s=0.02, max_fires=2)),
+        ("delay", dict(point="tcp.recv", delay_s=0.02, max_fires=2)),
+    ]
+
+
+def run_linalg_seed(seed: int, verbose: bool) -> dict:
+    """One blocked-factorization scenario (``--lane linalg``): a
+    :class:`~pytensor_federated_tpu.linalg.BlockedCholesky` driver over
+    a 2-replica TCP block-store pool; the victim replica runs a seeded
+    plan ALWAYS including a SIGKILL mid-factorization (a watcher thread
+    respawns it cold — empty store) while the driver runs link faults.
+    Invariants (ISSUE 19 acceptance):
+
+    L1 never a silently wrong factor — ``factor()`` must complete and
+       ``L @ L.T`` must reproduce ``A`` to f64 accuracy (and match
+       ``np.linalg.cholesky`` — recovery recomputes trailing state
+       driver-side through the same ``dot_kernel``, so the recovered
+       factor is the no-fault factor, not merely a nearby one);
+    L2 recovery locality — only replicas that actually LOST state
+       (flight-recorded ``linalg.replica_lost``) re-ship tiles, every
+       re-shipped tile belongs to that replica's block rows, and the
+       guaranteed SIGKILL means at least one restore happened;
+    L3 no hang — the factorization (including reconnect + respawn +
+       re-ship) settles within ``CALL_DEADLINE_S``;
+    L4 clean reconvergence + accounting — after faults stop, a fresh
+       factorization over the SAME (respawned) replicas completes with
+       ZERO restores, and every driver-fired fault left its ``fault.*``
+       flight event.
+    """
+
+    def log(msg):
+        if verbose:
+            print(msg, flush=True)
+
+    from pytensor_federated_tpu.linalg import BlockedCholesky, BlockLayout
+    from pytensor_federated_tpu.service.tcp import TcpArraysClient
+
+    rng = random.Random(seed ^ 0x11A6)
+    lay = BlockLayout(_LINALG_N, _LINALG_N, _LINALG_B, _LINALG_B)
+    mat_rng = np.random.default_rng(seed)
+    a = mat_rng.normal(size=(_LINALG_N, _LINALG_N))
+    a = a @ a.T / _LINALG_N + np.eye(_LINALG_N)
+    ref = np.linalg.cholesky(a)
+
+    # The victim ALWAYS dies mid-factorization; nth <= 6 lands inside
+    # the first factor() no matter which replica is the victim (the
+    # lighter-loaded replica serves 6 requests per clean run).
+    node_rules = [
+        fi.FaultRule(
+            "kill_process", point="server.compute", nth=rng.randint(2, 6)
+        )
+    ]
+    for kind, kw in rng.sample(_linalg_node_templates(), rng.randint(0, 2)):
+        node_rules.append(fi.FaultRule(kind, **dict(kw)))
+    node_plan_json = fi.FaultPlan(
+        node_rules, seed=seed, plan_id=f"linalg-{seed}-node"
+    ).to_json()
+    driver_rules = [
+        fi.FaultRule(kind, **dict(kw))
+        for kind, kw in rng.sample(
+            _linalg_driver_templates(), rng.randint(1, 2)
+        )
+    ]
+    driver_plan = fi.FaultPlan(
+        driver_rules, seed=seed, plan_id=f"linalg-{seed}-driver"
+    )
+    log(
+        f"linalg seed {seed}: driver "
+        f"{[r.to_dict() for r in driver_rules]}, victim "
+        f"{[r.to_dict() for r in node_rules]}"
+    )
+    tspans.set_enabled(True)
+    flightrec.set_enabled(True)
+    if flightrec.capacity() < 16384:
+        flightrec.set_capacity(16384)
+    flightrec.clear()
+
+    ports = _free_ports(2)
+    victim = rng.randrange(2)
+    procs = [
+        _spawn_linalg_node(p, node_plan_json if k == victim else None)
+        for k, p in enumerate(ports)
+    ]
+    result = {"seed": seed, "transport": "linalg", "ok": True}
+    stop = threading.Event()
+    respawns = [0, 0]
+
+    def watcher():
+        # Respawn dead replicas cold (no plan, EMPTY store): recovery
+        # must re-ship state, it cannot find it waiting.
+        while not stop.is_set():
+            for k, proc in enumerate(procs):
+                if not proc.is_alive():
+                    respawns[k] += 1
+                    log(f"  replica {k} died: respawning cold")
+                    procs[k] = _spawn_linalg_node(ports[k], None)
+            stop.wait(0.2)
+
+    clients = []
+    watch = threading.Thread(target=watcher, daemon=True)
+    try:
+        _wait_nodes_up("tcp", ports)
+        watch.start()
+        clients = [TcpArraysClient("127.0.0.1", p) for p in ports]
+        chol = BlockedCholesky(
+            lay,
+            clients,
+            reconnect=lambda p: TcpArraysClient("127.0.0.1", ports[p]),
+            restore_attempts=6,
+            reconnect_timeout_s=30.0,
+        )
+        fi.install(driver_plan)
+        t0 = time.time()
+        try:
+            l_fact = chol.factor(a)
+        except Exception as e:
+            raise Violation(
+                f"factorization failed to recover: "
+                f"{type(e).__name__}: {str(e)[:300]}"
+            )
+        wall = time.time() - t0
+        fi.uninstall()
+        if wall > CALL_DEADLINE_S:
+            raise Violation(
+                f"factorization took {wall:.1f}s "
+                f"(> {CALL_DEADLINE_S}s: hang)"
+            )
+        resid = float(np.max(np.abs(l_fact @ l_fact.T - a)))
+        if resid > 1e-8 or not np.allclose(l_fact, ref, atol=1e-8):
+            raise Violation(
+                f"WRONG FACTOR survived recovery: max|LL^T - A| = "
+                f"{resid:.3e} (restores={chol.restores})"
+            )
+        lost = {
+            e["replica"]
+            for e in flightrec.events()
+            if e["kind"] == "linalg.replica_lost"
+        }
+        if chol.restores < 1:
+            raise Violation(
+                "the guaranteed SIGKILL never surfaced: zero restores "
+                f"(lost={sorted(lost)}, respawns={respawns})"
+            )
+        bad_owner = [
+            (p, c)
+            for p, c in chol.reshipped
+            if c[0] % len(clients) != p
+        ]
+        if bad_owner:
+            raise Violation(
+                f"re-shipped tiles outside the dead replica's rows: "
+                f"{bad_owner[:8]}"
+            )
+        leaked = {p for p, _ in chol.reshipped} - lost
+        if leaked:
+            raise Violation(
+                f"replicas {sorted(leaked)} re-shipped tiles without "
+                f"ever losing state (lost={sorted(lost)}) — recovery "
+                "is not local"
+            )
+        log(
+            f"  chaos factor ok: wall {wall:.1f}s, restores "
+            f"{chol.restores}, reshipped {len(chol.reshipped)}, "
+            f"resid {resid:.1e}"
+        )
+
+        # L4a: accounting — every driver-side fired fault left its
+        # flight event.
+        fault_events = [
+            e
+            for e in flightrec.events()
+            if e["kind"].startswith("fault.")
+            and e["kind"][6:] in fi.FAULT_KINDS
+        ]
+        if len(fault_events) != driver_plan.total_fires:
+            raise Violation(
+                f"telemetry accounting: plan fired "
+                f"{driver_plan.total_fires} faults but "
+                f"{len(fault_events)} fault.* events were recorded"
+            )
+
+        # L4b: clean reconvergence — same replicas, fresh driver, a
+        # DIFFERENT matrix, zero restores allowed.
+        a2 = a + np.eye(_LINALG_N)
+        clean = BlockedCholesky(
+            lay,
+            chol.clients,
+            reconnect=lambda p: TcpArraysClient("127.0.0.1", ports[p]),
+        )
+        t0 = time.time()
+        l2 = clean.factor(a2)
+        wall2 = time.time() - t0
+        if wall2 > CALL_DEADLINE_S:
+            raise Violation(f"clean factor took {wall2:.1f}s (hang)")
+        if clean.restores != 0:
+            raise Violation(
+                f"clean phase needed {clean.restores} restores after "
+                "faults stopped — never reconverged"
+            )
+        if not np.allclose(l2, np.linalg.cholesky(a2), atol=1e-8):
+            raise Violation("clean-phase factor diverged")
+        result.update(
+            restores=chol.restores,
+            reshipped=len(chol.reshipped),
+            respawns=sum(respawns),
+            faults_fired=driver_plan.total_fires,
+            wall_s=round(wall, 1),
+        )
+    except Violation as v:
+        bundle = write_incident_bundle(
+            f"chaos-linalg-seed-{seed}",
+            attrs={"seed": seed, "violation": str(v)[:500]},
+        )
+        result.update(ok=False, error=str(v), bundle=bundle)
+    except Exception as e:  # harness bug: loud, with a bundle
+        bundle = write_incident_bundle(
+            f"chaos-linalg-seed-{seed}-harness",
+            attrs={"seed": seed, "error": f"{type(e).__name__}: {e}"},
+        )
+        result.update(
+            ok=False,
+            error=f"harness: {type(e).__name__}: {e}",
+            bundle=bundle,
+        )
+    finally:
+        fi.uninstall()
+        stop.set()
+        if watch.is_alive():
+            watch.join(timeout=5)
+        for c in clients:
+            try:
+                c.close()
+            except Exception:
+                pass
+        for proc in procs:
+            if proc.is_alive():
+                proc.terminate()
+        for proc in procs:
+            proc.join(timeout=10)
+        flightrec.clear()
+    return result
+
+
 def run_seed(seed: int, transport: str, verbose: bool) -> dict:
     """One full chaos scenario; returns a result dict, raising nothing —
     violations land in the dict with an incident-bundle path."""
@@ -2620,7 +2937,7 @@ def main(argv=None) -> int:
     ap.add_argument("--transport", "--lane", dest="transport",
                     choices=("grpc", "tcp", "shm", "ring", "overload",
                              "collector", "gateway", "shard",
-                             "streaming", "zero"),
+                             "streaming", "zero", "linalg"),
                     default="grpc",
                     help="transport lane under chaos (--lane is an "
                     "alias; 'shm' runs the zero-copy arena lane; "
@@ -2655,7 +2972,12 @@ def main(argv=None) -> int:
                     "twisted version stamps and dropped refreshes — "
                     "per-shard opt_steps == accepted, loud stale "
                     "refusals, bit-exact checkpoint restore, zero "
-                    "hangs)")
+                    "hangs; 'linalg' runs the ISSUE-19 scenario: "
+                    "blocked Cholesky over a 2-replica block-store "
+                    "pool with a replica SIGKILLed mid-factorization "
+                    "and respawned cold — only the dead replica's "
+                    "tiles re-ship, the recovered factor reproduces "
+                    "A exactly, zero hangs, clean reconvergence)")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
 
@@ -2679,6 +3001,8 @@ def main(argv=None) -> int:
             res = run_streaming_seed(seed, args.verbose)
         elif args.transport == "zero":
             res = run_zero_seed(seed, args.verbose)
+        elif args.transport == "linalg":
+            res = run_linalg_seed(seed, args.verbose)
         else:
             res = run_seed(seed, args.transport, args.verbose)
         status = "ok" if res["ok"] else "FAIL"
@@ -2712,6 +3036,14 @@ def main(argv=None) -> int:
                 f"accepted={res.get('accepted')}/{res.get('offered')} "
                 f"skipped={res.get('skipped_kinds')} "
                 f"shard_steps={res.get('shard_steps')}"
+            )
+        elif args.transport == "linalg":
+            extra = (
+                f"restores={res.get('restores')} "
+                f"reshipped={res.get('reshipped')} "
+                f"respawns={res.get('respawns')} "
+                f"faults={res.get('faults_fired')} "
+                f"wall={res.get('wall_s')}s"
             )
         else:
             extra = (
